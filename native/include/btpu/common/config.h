@@ -1,0 +1,80 @@
+// YAML-subset configuration parser.
+//
+// Role parity: the reference parses configs with yaml-cpp
+// (src/common/types.cpp:20-101, src/worker/worker_service.cpp:25-108).
+// yaml-cpp is not available in this image, so we ship a small parser for the
+// subset our configs use: indentation-nested maps, block lists ("- item",
+// including lists of maps), scalars (string/int/float/bool, single- or
+// double-quoted), and '#' comments. Anchors, flow style, multi-doc and
+// multiline scalars are out of scope.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "btpu/common/result.h"
+
+namespace btpu::yaml {
+
+class Node;
+using NodePtr = std::shared_ptr<Node>;
+
+class Node {
+ public:
+  enum class Kind { kNull, kScalar, kMap, kList };
+
+  Kind kind() const noexcept { return kind_; }
+  bool is_null() const noexcept { return kind_ == Kind::kNull; }
+  bool is_scalar() const noexcept { return kind_ == Kind::kScalar; }
+  bool is_map() const noexcept { return kind_ == Kind::kMap; }
+  bool is_list() const noexcept { return kind_ == Kind::kList; }
+
+  // Map access. Returns nullptr when the key is absent or node is not a map.
+  NodePtr get(const std::string& key) const;
+  // Path access with '.' separator: get_path("coordination.endpoints").
+  NodePtr get_path(const std::string& dotted) const;
+  const std::map<std::string, NodePtr>& entries() const { return map_; }
+  const std::vector<NodePtr>& items() const { return list_; }
+
+  // Scalar conversions (nullopt when not a scalar or not convertible).
+  std::optional<std::string> as_string() const;
+  std::optional<int64_t> as_int() const;
+  std::optional<uint64_t> as_uint() const;
+  std::optional<double> as_double() const;
+  std::optional<bool> as_bool() const;
+
+  // Conversions with defaults, for config-reading call sites.
+  std::string str_or(const std::string& def) const { return as_string().value_or(def); }
+  int64_t int_or(int64_t def) const { return as_int().value_or(def); }
+  uint64_t uint_or(uint64_t def) const { return as_uint().value_or(def); }
+  double double_or(double def) const { return as_double().value_or(def); }
+  bool bool_or(bool def) const { return as_bool().value_or(def); }
+
+  static NodePtr make_null();
+  static NodePtr make_scalar(std::string value, bool quoted = false);
+  static NodePtr make_map();
+  static NodePtr make_list();
+
+  void map_set(const std::string& key, NodePtr value) { map_[key] = std::move(value); }
+  void list_append(NodePtr value) { list_.push_back(std::move(value)); }
+  bool was_quoted() const noexcept { return quoted_; }
+
+ private:
+  Kind kind_{Kind::kNull};
+  std::string scalar_;
+  bool quoted_{false};
+  std::map<std::string, NodePtr> map_;
+  std::vector<NodePtr> list_;
+};
+
+// Parse YAML text / file. Error carries INVALID_CONFIGURATION on bad syntax.
+Result<NodePtr> parse(const std::string& text);
+Result<NodePtr> parse_file(const std::string& path);
+
+// Convenience for callers that read "size: 64MB"-style values.
+std::optional<uint64_t> parse_byte_size(const std::string& text);
+
+}  // namespace btpu::yaml

@@ -1,0 +1,105 @@
+// Domain-partitioned error codes.
+//
+// Parity target: reference include/blackbird/common/error/error_domain.h:14-38 and
+// error_codes.h:15-79 — each subsystem owns a 1000-code block and enumerator names
+// match the reference API so client code ports unchanged. Implementation is ours.
+#pragma once
+
+#include <cstdint>
+#include <string_view>
+
+namespace btpu {
+
+enum class Domain : uint32_t {
+  SUCCESS = 0,
+  SYSTEM = 1000,
+  STORAGE = 2000,
+  NETWORK = 3000,
+  COORDINATION = 4000,
+  DATA = 5000,
+  CLIENT = 6000,
+  CONFIG = 7000,
+};
+
+constexpr uint32_t domain_base(Domain d) noexcept { return static_cast<uint32_t>(d); }
+
+enum class ErrorCode : uint32_t {
+  OK = 0,
+
+  // System (1000-1999)
+  INTERNAL_ERROR = domain_base(Domain::SYSTEM),
+  INITIALIZATION_FAILED,
+  INVALID_STATE,
+  OPERATION_TIMEOUT,
+  RESOURCE_EXHAUSTED,
+  NOT_IMPLEMENTED,
+
+  // Storage (2000-2999)
+  BUFFER_OVERFLOW = domain_base(Domain::STORAGE),
+  OUT_OF_MEMORY,
+  MEMORY_POOL_NOT_FOUND,
+  MEMORY_POOL_ALREADY_EXISTS,
+  INVALID_MEMORY_POOL,
+  ALLOCATION_FAILED,
+  INSUFFICIENT_SPACE,
+  MEMORY_ACCESS_ERROR,
+
+  // Network (3000-3999)
+  NETWORK_ERROR = domain_base(Domain::NETWORK),
+  CONNECTION_FAILED,
+  TRANSFER_FAILED,
+  TRANSPORT_ERROR,  // generalizes the reference's UCX_ERROR to any transport
+  INVALID_ADDRESS,
+  REMOTE_ENDPOINT_ERROR,
+  RPC_FAILED,
+
+  // Coordination (4000-4999)
+  COORD_ERROR = domain_base(Domain::COORDINATION),  // reference: ETCD_ERROR
+  COORD_KEY_NOT_FOUND,
+  COORD_TRANSACTION_FAILED,
+  COORD_LEASE_ERROR,
+  COORD_WATCH_ERROR,
+  LEADER_ELECTION_FAILED,
+  SERVICE_REGISTRATION_FAILED,
+
+  // Data (5000-5999)
+  OBJECT_NOT_FOUND = domain_base(Domain::DATA),
+  OBJECT_ALREADY_EXISTS,
+  INVALID_KEY,
+  INVALID_WORKER,
+  WORKER_NOT_READY,
+  NO_COMPLETE_WORKER,
+  DATA_CORRUPTION,
+  CHECKSUM_MISMATCH,
+
+  // Client (6000-6999)
+  CLIENT_ERROR = domain_base(Domain::CLIENT),
+  CLIENT_NOT_FOUND,
+  CLIENT_ALREADY_EXISTS,
+  CLIENT_DISCONNECTED,
+  SESSION_EXPIRED,
+  INVALID_CLIENT_STATE,
+
+  // Config (7000-7999)
+  CONFIG_ERROR = domain_base(Domain::CONFIG),
+  INVALID_CONFIGURATION,
+  INVALID_PARAMETERS,
+  MISSING_REQUIRED_FIELD,
+  VALUE_OUT_OF_RANGE,
+};
+
+constexpr Domain error_domain(ErrorCode code) noexcept {
+  const auto v = static_cast<uint32_t>(code);
+  if (v < 1000) return Domain::SUCCESS;
+  return static_cast<Domain>((v / 1000) * 1000);
+}
+
+constexpr bool is_ok(ErrorCode code) noexcept { return code == ErrorCode::OK; }
+
+// Short symbolic name, e.g. "OBJECT_NOT_FOUND".
+std::string_view to_string(ErrorCode code) noexcept;
+// One-line human description.
+std::string_view describe(ErrorCode code) noexcept;
+std::string_view domain_name(Domain d) noexcept;
+
+}  // namespace btpu

@@ -1,0 +1,68 @@
+// Minimal leveled logging (role parity with the reference's glog usage —
+// LOG(INFO/WARNING/ERROR) + VLOG(1/2), e.g. reference range_allocator.cpp:32,60).
+// Level via env BTPU_LOG = error|warn|info|debug|trace (default warn).
+#pragma once
+
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <sstream>
+#include <string>
+
+namespace btpu::log {
+
+enum class Level : int { kError = 0, kWarn = 1, kInfo = 2, kDebug = 3, kTrace = 4 };
+
+inline Level global_level() {
+  static Level lvl = [] {
+    const char* e = std::getenv("BTPU_LOG");
+    if (!e) return Level::kWarn;
+    if (!std::strcmp(e, "error")) return Level::kError;
+    if (!std::strcmp(e, "warn")) return Level::kWarn;
+    if (!std::strcmp(e, "info")) return Level::kInfo;
+    if (!std::strcmp(e, "debug")) return Level::kDebug;
+    if (!std::strcmp(e, "trace")) return Level::kTrace;
+    return Level::kWarn;
+  }();
+  return lvl;
+}
+
+inline bool enabled(Level l) { return static_cast<int>(l) <= static_cast<int>(global_level()); }
+
+void emit(Level l, const char* file, int line, const std::string& msg);
+
+class Line {
+ public:
+  Line(Level l, const char* file, int line) : level_(l), file_(file), line_(line) {}
+  ~Line() { emit(level_, file_, line_, ss_.str()); }
+  template <typename T>
+  Line& operator<<(const T& v) {
+    ss_ << v;
+    return *this;
+  }
+
+ private:
+  Level level_;
+  const char* file_;
+  int line_;
+  std::ostringstream ss_;
+};
+
+struct Sink {  // swallows the stream when the level is disabled
+  template <typename T>
+  Sink& operator<<(const T&) { return *this; }
+};
+
+}  // namespace btpu::log
+
+#define BTPU_LOG(lvl)                                        \
+  if (!::btpu::log::enabled(::btpu::log::Level::lvl)) {      \
+  } else                                                     \
+    ::btpu::log::Line(::btpu::log::Level::lvl, __FILE__, __LINE__)
+
+#define LOG_ERROR BTPU_LOG(kError)
+#define LOG_WARN BTPU_LOG(kWarn)
+#define LOG_INFO BTPU_LOG(kInfo)
+#define LOG_DEBUG BTPU_LOG(kDebug)
+#define LOG_TRACE BTPU_LOG(kTrace)

@@ -1,0 +1,74 @@
+// Result<T>: value-or-ErrorCode, the return convention across the framework.
+//
+// Parity target: reference include/blackbird/common/types.h:31-49 exposes
+// Result<T> = std::variant<T, ErrorCode> with free is_ok/get_value/get_error.
+// We keep those free functions for API parity but implement Result as a real
+// class with ergonomics (ok(), value(), error(), value_or, map) — and we keep
+// the variant layout so wire serialization of batch results matches the
+// one-of-two encoding the reference uses (types.h:392-ish batch responses).
+#pragma once
+
+#include <utility>
+#include <variant>
+
+#include "btpu/common/error.h"
+
+namespace btpu {
+
+template <typename T>
+class Result {
+ public:
+  // Default state is an error so a forgotten assignment is never a fake success
+  // (needed by wire decode, which value-initializes before filling in).
+  Result() : v_(ErrorCode::INTERNAL_ERROR) {}
+  Result(T value) : v_(std::move(value)) {}                      // NOLINT(implicit)
+  Result(ErrorCode code) : v_(code) {}                           // NOLINT(implicit)
+
+  bool ok() const noexcept { return std::holds_alternative<T>(v_); }
+  explicit operator bool() const noexcept { return ok(); }
+
+  T& value() & { return std::get<T>(v_); }
+  const T& value() const& { return std::get<T>(v_); }
+  T&& value() && { return std::get<T>(std::move(v_)); }
+
+  ErrorCode error() const noexcept {
+    return ok() ? ErrorCode::OK : std::get<ErrorCode>(v_);
+  }
+
+  T value_or(T fallback) const& { return ok() ? value() : std::move(fallback); }
+
+  template <typename F>
+  auto map(F&& f) const -> Result<decltype(f(std::declval<const T&>()))> {
+    if (!ok()) return error();
+    return f(value());
+  }
+
+  const std::variant<T, ErrorCode>& raw() const noexcept { return v_; }
+
+ private:
+  std::variant<T, ErrorCode> v_;
+};
+
+// Free-function surface matching the reference (types.h:37-49).
+template <typename T>
+bool is_ok(const Result<T>& r) { return r.ok(); }
+template <typename T>
+T get_value(const Result<T>& r) { return r.value(); }
+template <typename T>
+ErrorCode get_error(const Result<T>& r) { return r.error(); }
+
+#define BTPU_RETURN_IF_ERROR(expr)                       \
+  do {                                                   \
+    ::btpu::ErrorCode _btpu_ec = (expr);                 \
+    if (_btpu_ec != ::btpu::ErrorCode::OK) return _btpu_ec; \
+  } while (0)
+
+#define BTPU_CONCAT_INNER(a, b) a##b
+#define BTPU_CONCAT(a, b) BTPU_CONCAT_INNER(a, b)
+#define BTPU_ASSIGN_OR_RETURN(lhs, expr)                                     \
+  auto BTPU_CONCAT(_btpu_res_, __LINE__) = (expr);                           \
+  if (!BTPU_CONCAT(_btpu_res_, __LINE__).ok())                               \
+    return BTPU_CONCAT(_btpu_res_, __LINE__).error();                        \
+  lhs = std::move(BTPU_CONCAT(_btpu_res_, __LINE__)).value()
+
+}  // namespace btpu

@@ -1,0 +1,303 @@
+// Core data model of the TPU-native distributed object store.
+//
+// Parity target: reference include/blackbird/common/types.h:50-513. The public
+// contracts (put_start/put_complete lifecycle structs, placements carrying
+// {endpoint, remote_addr, rkey}, batch request/response pairs) match the
+// reference so a Blackbird user finds the same API surface. The internals are
+// redesigned TPU-first:
+//   * transports are pluggable — a generic RemoteDescriptor replaces the four
+//     hard-coded ucx_* fields on MemoryPool (reference types.h:471-475);
+//   * StorageClass puts TPU HBM where the reference put (broken) RAM_GPU
+//     (reference worker_service.cpp:196 flags RAM_GPU as broken);
+//   * every pool carries TopoCoord {slice, host, chip} so placement can be
+//     ICI/DCN-aware instead of node-string-only (reference
+//     range_allocator.cpp:436-438 only knows node ids).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <string>
+#include <unordered_map>
+#include <variant>
+#include <vector>
+
+#include "btpu/common/result.h"
+
+namespace btpu {
+
+using ObjectKey = std::string;
+using MemoryPoolId = std::string;
+using NodeId = std::string;
+using Version = uint64_t;
+using ViewVersionId = int64_t;
+using LeaseId = int64_t;
+
+// -------------------------------------------------------------------------
+// Constants (reference types.h:69-74)
+// -------------------------------------------------------------------------
+inline constexpr const char* kDefaultClusterId = "btpu_cluster";
+inline constexpr double kDefaultHighWatermark = 0.9;
+inline constexpr int64_t kDefaultClientTtlSec = 10;
+inline constexpr size_t kDefaultReplicationFactor = 3;
+inline constexpr size_t kDefaultMaxWorkersPerCopy = 4;
+
+// -------------------------------------------------------------------------
+// Storage tiers (reference types.h:82-93, with RAM_GPU -> HBM_TPU)
+// -------------------------------------------------------------------------
+enum class StorageClass : uint32_t {
+  STORAGE_UNSPECIFIED = 0,
+  RAM_CPU = 1,   // host DRAM
+  HBM_TPU = 2,   // TPU on-chip HBM — top tier (replaces reference RAM_GPU)
+  NVME = 3,
+  SSD = 4,
+  HDD = 5,
+  CXL_MEMORY = 6,
+  CXL_TYPE2_DEVICE = 7,
+  CUSTOM = 999,
+};
+
+std::string_view storage_class_name(StorageClass c) noexcept;
+std::optional<StorageClass> storage_class_from_name(std::string_view name) noexcept;
+
+// -------------------------------------------------------------------------
+// Transports. The reference hard-codes UCX in four places; here every shard
+// placement names the transport a client must use to reach its bytes.
+// -------------------------------------------------------------------------
+enum class TransportKind : uint32_t {
+  TRANSPORT_UNSPECIFIED = 0,
+  LOCAL = 1,  // same-process memcpy (hermetic tests, embedded cluster)
+  SHM = 2,    // same-host shared memory (TPU-VM-local zero copy)
+  TCP = 3,    // sockets — dev fallback + DCN inter-slice path
+  ICI = 4,    // intra-slice one-sided DMA (device mesh collectives / libtpu)
+  HBM = 5,    // on-device HBM regions fronted by the HBM provider
+};
+
+std::string_view transport_kind_name(TransportKind k) noexcept;
+std::optional<TransportKind> transport_kind_from_name(std::string_view name) noexcept;
+
+// Where a worker sits in the TPU pod: used for ICI-vs-DCN placement decisions.
+// slice_id: which TPU slice; host_id: which TPU VM host within the slice;
+// chip_id: device ordinal on that host (-1 = host memory, not chip-attached).
+struct TopoCoord {
+  int32_t slice_id{0};
+  int32_t host_id{0};
+  int32_t chip_id{-1};
+
+  bool same_host(const TopoCoord& o) const noexcept {
+    return slice_id == o.slice_id && host_id == o.host_id;
+  }
+  bool same_slice(const TopoCoord& o) const noexcept { return slice_id == o.slice_id; }
+
+  bool operator==(const TopoCoord&) const = default;
+};
+
+// How a client reaches a worker's registered region: the transport to dial,
+// the endpoint to dial it at, and the key that authorizes one-sided access.
+// Parity: reference UcxEndpoint (types.h:97-102) + the ucx_* advertisement
+// fields on MemoryPool (types.h:471-475), folded into one descriptor.
+struct RemoteDescriptor {
+  TransportKind transport{TransportKind::TRANSPORT_UNSPECIFIED};
+  std::string endpoint;      // "host:port" (tcp), shm name (shm), mesh axis addr (ici)
+  uint64_t remote_base{0};   // base remote address of the registered region
+  std::string rkey_hex;      // packed region key, hex-encoded
+
+  bool operator==(const RemoteDescriptor&) const = default;
+};
+
+// -------------------------------------------------------------------------
+// Shard locations (reference types.h:107-136)
+// -------------------------------------------------------------------------
+struct MemoryLocation {
+  uint64_t remote_addr{0};
+  uint64_t rkey{0};  // 64-bit; the reference truncates to u32 (types.h:109)
+  uint64_t size{0};
+  bool operator==(const MemoryLocation&) const = default;
+};
+
+struct FileLocation {
+  std::string file_path;
+  uint64_t file_offset{0};
+  bool operator==(const FileLocation&) const = default;
+};
+
+// On-device (TPU HBM) region — generalizes the reference's CxlMemoryLocation
+// (types.h:124-130) to any device-attached memory with region ids.
+struct DeviceLocation {
+  std::string device_id;   // e.g. "tpu:0"
+  uint64_t region_id{0};
+  uint64_t offset{0};
+  uint64_t size{0};
+  bool operator==(const DeviceLocation&) const = default;
+};
+
+using LocationDetail = std::variant<MemoryLocation, FileLocation, DeviceLocation>;
+
+// -------------------------------------------------------------------------
+// Placements (reference types.h:139-157)
+// -------------------------------------------------------------------------
+struct ShardPlacement {
+  MemoryPoolId pool_id;
+  NodeId worker_id;
+  RemoteDescriptor remote;
+  StorageClass storage_class{StorageClass::STORAGE_UNSPECIFIED};
+  uint64_t length{0};
+  LocationDetail location{MemoryLocation{}};
+};
+
+struct CopyPlacement {
+  uint32_t copy_index{0};
+  std::vector<ShardPlacement> shards;
+  size_t shards_size() const noexcept { return shards.size(); }
+};
+
+// -------------------------------------------------------------------------
+// Placement policy per object (reference WorkerConfig, types.h:161-189)
+// -------------------------------------------------------------------------
+struct WorkerConfig {
+  size_t replication_factor{kDefaultReplicationFactor};
+  size_t max_workers_per_copy{kDefaultMaxWorkersPerCopy};
+  bool enable_soft_pin{false};
+  std::string preferred_node;
+  std::vector<StorageClass> preferred_classes;
+  uint64_t ttl_ms{30ull * 60ull * 1000ull};
+  bool enable_locality_awareness{true};
+  bool prefer_contiguous{false};
+  size_t min_shard_size{4096};
+  // TPU extension: when set, placement prefers pools on this slice and only
+  // spills across slices (DCN) when the slice cannot hold the object.
+  int32_t preferred_slice{-1};
+};
+
+struct ClusterStats {
+  uint64_t total_workers{0};
+  uint64_t total_memory_pools{0};
+  uint64_t total_objects{0};
+  uint64_t total_capacity{0};
+  uint64_t used_capacity{0};
+  double avg_utilization{0.0};
+};
+
+// -------------------------------------------------------------------------
+// Memory pool registry entry (reference types.h:464-493)
+// -------------------------------------------------------------------------
+struct MemoryPool {
+  MemoryPoolId id;
+  NodeId node_id;
+  uint64_t base_addr{0};
+  uint64_t size{0};
+  uint64_t used{0};
+  StorageClass storage_class{StorageClass::STORAGE_UNSPECIFIED};
+  RemoteDescriptor remote;
+  TopoCoord topo;
+
+  double utilization() const noexcept {
+    return size > 0 ? static_cast<double>(used) / static_cast<double>(size) : 0.0;
+  }
+  uint64_t available() const noexcept { return size > used ? size - used : 0; }
+};
+
+// -------------------------------------------------------------------------
+// RPC wire structs, 1:1 with keystone methods (reference types.h:217-407).
+// Batch results use the Result<T> one-of encoding.
+// -------------------------------------------------------------------------
+struct ObjectExistsRequest { ObjectKey key; };
+struct ObjectExistsResponse { bool exists{false}; ErrorCode error_code{ErrorCode::OK}; };
+
+struct GetWorkersRequest { ObjectKey key; };
+struct GetWorkersResponse { std::vector<CopyPlacement> copies; ErrorCode error_code{ErrorCode::OK}; };
+
+struct PutStartRequest { ObjectKey key; uint64_t data_size{0}; WorkerConfig config; };
+struct PutStartResponse { std::vector<CopyPlacement> copies; ErrorCode error_code{ErrorCode::OK}; };
+
+struct PutCompleteRequest { ObjectKey key; };
+struct PutCompleteResponse { ErrorCode error_code{ErrorCode::OK}; };
+
+struct PutCancelRequest { ObjectKey key; };
+struct PutCancelResponse { ErrorCode error_code{ErrorCode::OK}; };
+
+struct RemoveObjectRequest { ObjectKey key; };
+struct RemoveObjectResponse { ErrorCode error_code{ErrorCode::OK}; };
+
+struct RemoveAllObjectsRequest {};
+struct RemoveAllObjectsResponse { uint64_t objects_removed{0}; ErrorCode error_code{ErrorCode::OK}; };
+
+struct GetClusterStatsRequest {};
+struct GetClusterStatsResponse { ClusterStats stats; ErrorCode error_code{ErrorCode::OK}; };
+
+struct GetViewVersionRequest {};
+struct GetViewVersionResponse { ViewVersionId view_version{0}; ErrorCode error_code{ErrorCode::OK}; };
+
+struct BatchObjectExistsRequest { std::vector<ObjectKey> keys; };
+struct BatchObjectExistsResponse {
+  std::vector<Result<bool>> results;
+  ErrorCode error_code{ErrorCode::OK};
+};
+
+struct BatchGetWorkersRequest { std::vector<ObjectKey> keys; };
+struct BatchGetWorkersResponse {
+  std::vector<Result<std::vector<CopyPlacement>>> results;
+  ErrorCode error_code{ErrorCode::OK};
+};
+
+struct BatchPutStartItem { ObjectKey key; uint64_t data_size{0}; WorkerConfig config; };
+struct BatchPutStartRequest { std::vector<BatchPutStartItem> requests; };
+struct BatchPutStartResponse {
+  std::vector<Result<std::vector<CopyPlacement>>> results;
+  ErrorCode error_code{ErrorCode::OK};
+};
+
+struct BatchPutCompleteRequest { std::vector<ObjectKey> keys; };
+struct BatchPutCompleteResponse { std::vector<ErrorCode> results; ErrorCode error_code{ErrorCode::OK}; };
+
+struct BatchPutCancelRequest { std::vector<ObjectKey> keys; };
+struct BatchPutCancelResponse { std::vector<ErrorCode> results; ErrorCode error_code{ErrorCode::OK}; };
+
+struct PingResponse { ViewVersionId view_version{0}; };
+
+// -------------------------------------------------------------------------
+// Service configs (reference KeystoneConfig types.h:410-445,
+// ClientConfig :448-461; worker config lives in worker/worker_service.h)
+// -------------------------------------------------------------------------
+struct KeystoneConfig {
+  std::string cluster_id{kDefaultClusterId};
+  std::string coord_endpoints;            // coordination service endpoints ("" = in-process)
+  std::string listen_address{"0.0.0.0:9090"};
+  std::string http_metrics_port{"9091"};
+  std::string service_id;                 // auto-generated when empty
+
+  bool enable_gc{true};
+  bool enable_ha{false};
+  double eviction_ratio{0.1};
+  double high_watermark{kDefaultHighWatermark};
+  int64_t client_ttl_sec{kDefaultClientTtlSec};
+  int64_t worker_heartbeat_ttl_sec{30};
+
+  int64_t service_registration_ttl_sec{60};
+  int64_t service_refresh_interval_sec{30};
+  int64_t gc_interval_sec{30};
+  int64_t health_check_interval_sec{10};
+
+  int32_t max_replicas{3};
+  int32_t default_replicas{1};
+
+  // TPU extensions
+  bool enable_repair{true};       // re-replicate objects after worker death
+  bool tier_aware_eviction{true}; // evict per-tier, not on global average
+
+  // Loads a YAML config file (subset grammar, see config.h). Throws
+  // std::runtime_error on parse/validation failure like the reference
+  // (src/common/types.cpp:76-85).
+  static KeystoneConfig from_yaml(const std::string& file_path);
+  ErrorCode validate() const;
+};
+
+struct ClientConfig {
+  std::string node_id;
+  std::string keystone_address;
+  std::string local_address{"0.0.0.0:0"};
+  uint64_t memory_pool_size{1ull << 30};
+  std::string storage_path;
+};
+
+}  // namespace btpu

@@ -1,0 +1,345 @@
+// Binary wire format for RPC payloads and coordination-store values.
+//
+// Role parity: the reference serializes RPC structs with YLT struct_pack
+// (types.h:19-21, rpc_service.cpp:360-385). YLT is not a dependency here;
+// this is our own compact encoding (fixed-width scalars in native byte order;
+// a static_assert pins the build to little-endian hosts, which covers every
+// TPU VM / x86 / ARM deployment target):
+//   scalars    little-endian fixed width
+//   string     u32 length + bytes
+//   vector<T>  u32 count + elements
+//   variant    u8 alternative index + alternative
+//   Result<T>  u8 {0=value,1=error} + payload
+// Decode is bounds-checked everywhere; a truncated or corrupt frame yields
+// false, never UB.
+#pragma once
+
+#include <bit>
+#include <cstring>
+#include <limits>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include "btpu/common/types.h"
+
+namespace btpu::wire {
+
+static_assert(std::endian::native == std::endian::little,
+              "btpu wire format requires a little-endian host");
+
+class Writer {
+ public:
+  std::vector<uint8_t>& buffer() noexcept { return buf_; }
+  std::vector<uint8_t> take() noexcept { return std::move(buf_); }
+  size_t size() const noexcept { return buf_.size(); }
+
+  void put_bytes(const void* p, size_t n) {
+    const auto* b = static_cast<const uint8_t*>(p);
+    buf_.insert(buf_.end(), b, b + n);
+  }
+
+  template <typename T>
+    requires std::is_arithmetic_v<T> || std::is_enum_v<T>
+  void put(T v) {
+    put_bytes(&v, sizeof(T));
+  }
+
+  void put_string(std::string_view s) {
+    if (s.size() > std::numeric_limits<uint32_t>::max())
+      throw std::length_error("wire: string exceeds u32 length prefix");
+    put<uint32_t>(static_cast<uint32_t>(s.size()));
+    put_bytes(s.data(), s.size());
+  }
+
+ private:
+  std::vector<uint8_t> buf_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t size) : data_(data), size_(size) {}
+  explicit Reader(const std::vector<uint8_t>& v) : Reader(v.data(), v.size()) {}
+
+  size_t remaining() const noexcept { return size_ - pos_; }
+  bool exhausted() const noexcept { return pos_ == size_; }
+
+  bool get_bytes(void* out, size_t n) {
+    if (remaining() < n) return false;
+    std::memcpy(out, data_ + pos_, n);
+    pos_ += n;
+    return true;
+  }
+
+  template <typename T>
+    requires std::is_arithmetic_v<T> || std::is_enum_v<T>
+  bool get(T& out) {
+    return get_bytes(&out, sizeof(T));
+  }
+
+  bool get_string(std::string& out) {
+    uint32_t n = 0;
+    if (!get(n) || remaining() < n) return false;
+    out.assign(reinterpret_cast<const char*>(data_ + pos_), n);
+    pos_ += n;
+    return true;
+  }
+
+ private:
+  const uint8_t* data_;
+  size_t size_;
+  size_t pos_{0};
+};
+
+// ---- encode/decode overload set ------------------------------------------
+
+template <typename T>
+  requires std::is_arithmetic_v<T> || std::is_enum_v<T>
+inline void encode(Writer& w, const T& v) { w.put(v); }
+template <typename T>
+  requires std::is_arithmetic_v<T> || std::is_enum_v<T>
+inline bool decode(Reader& r, T& v) { return r.get(v); }
+
+inline void encode(Writer& w, const std::string& s) { w.put_string(s); }
+inline bool decode(Reader& r, std::string& s) { return r.get_string(s); }
+
+// bool gets an explicit one-byte encoding: raw memcpy into a bool from
+// untrusted bytes would create an invalid value representation (UB).
+inline void encode(Writer& w, const bool& v) { w.put<uint8_t>(v ? 1 : 0); }
+inline bool decode(Reader& r, bool& v) {
+  uint8_t b = 0;
+  if (!r.get(b) || b > 1) return false;
+  v = (b == 1);
+  return true;
+}
+
+template <typename T>
+void encode(Writer& w, const std::vector<T>& v);
+template <typename T>
+bool decode(Reader& r, std::vector<T>& v);
+
+template <typename T>
+void encode(Writer& w, const Result<T>& res) {
+  if (res.ok()) {
+    w.put<uint8_t>(0);
+    encode(w, res.value());
+  } else {
+    w.put<uint8_t>(1);
+    w.put(res.error());
+  }
+}
+
+template <typename T>
+bool decode(Reader& r, Result<T>& out) {
+  uint8_t tag = 0;
+  if (!r.get(tag)) return false;
+  if (tag == 0) {
+    T v{};
+    if (!decode(r, v)) return false;
+    out = Result<T>(std::move(v));
+    return true;
+  }
+  if (tag != 1) return false;  // only {0=value, 1=error} are legal
+  ErrorCode ec{};
+  if (!r.get(ec)) return false;
+  // An "error" arm carrying OK would make ok()==false yet error()==OK,
+  // which silently passes `error() != OK` checks — reject the frame.
+  if (ec == ErrorCode::OK) return false;
+  out = Result<T>(ec);
+  return true;
+}
+
+// Struct field helpers: encode_fields(w, a, b, c) / decode_fields(r, a, b, c).
+inline void encode_fields(Writer&) {}
+template <typename T, typename... Rest>
+void encode_fields(Writer& w, const T& first, const Rest&... rest) {
+  encode(w, first);
+  encode_fields(w, rest...);
+}
+inline bool decode_fields(Reader&) { return true; }
+template <typename T, typename... Rest>
+bool decode_fields(Reader& r, T& first, Rest&... rest) {
+  return decode(r, first) && decode_fields(r, rest...);
+}
+
+// ---- data-model overloads -------------------------------------------------
+
+inline void encode(Writer& w, const TopoCoord& t) { encode_fields(w, t.slice_id, t.host_id, t.chip_id); }
+inline bool decode(Reader& r, TopoCoord& t) { return decode_fields(r, t.slice_id, t.host_id, t.chip_id); }
+
+inline void encode(Writer& w, const RemoteDescriptor& d) {
+  encode_fields(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
+}
+inline bool decode(Reader& r, RemoteDescriptor& d) {
+  return decode_fields(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
+}
+
+inline void encode(Writer& w, const MemoryLocation& m) { encode_fields(w, m.remote_addr, m.rkey, m.size); }
+inline bool decode(Reader& r, MemoryLocation& m) { return decode_fields(r, m.remote_addr, m.rkey, m.size); }
+
+inline void encode(Writer& w, const FileLocation& f) { encode_fields(w, f.file_path, f.file_offset); }
+inline bool decode(Reader& r, FileLocation& f) { return decode_fields(r, f.file_path, f.file_offset); }
+
+inline void encode(Writer& w, const DeviceLocation& d) {
+  encode_fields(w, d.device_id, d.region_id, d.offset, d.size);
+}
+inline bool decode(Reader& r, DeviceLocation& d) {
+  return decode_fields(r, d.device_id, d.region_id, d.offset, d.size);
+}
+
+inline void encode(Writer& w, const LocationDetail& loc) {
+  w.put<uint8_t>(static_cast<uint8_t>(loc.index()));
+  std::visit([&w](const auto& alt) { encode(w, alt); }, loc);
+}
+inline bool decode(Reader& r, LocationDetail& loc) {
+  uint8_t idx = 0;
+  if (!r.get(idx)) return false;
+  switch (idx) {
+    case 0: { MemoryLocation m; if (!decode(r, m)) return false; loc = m; return true; }
+    case 1: { FileLocation f; if (!decode(r, f)) return false; loc = f; return true; }
+    case 2: { DeviceLocation d; if (!decode(r, d)) return false; loc = d; return true; }
+    default: return false;
+  }
+}
+
+inline void encode(Writer& w, const ShardPlacement& s) {
+  encode_fields(w, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
+}
+inline bool decode(Reader& r, ShardPlacement& s) {
+  return decode_fields(r, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
+}
+
+inline void encode(Writer& w, const CopyPlacement& c) { encode_fields(w, c.copy_index, c.shards); }
+inline bool decode(Reader& r, CopyPlacement& c) { return decode_fields(r, c.copy_index, c.shards); }
+
+inline void encode(Writer& w, const WorkerConfig& c) {
+  encode_fields(w, static_cast<uint64_t>(c.replication_factor),
+                static_cast<uint64_t>(c.max_workers_per_copy), c.enable_soft_pin,
+                c.preferred_node, c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
+                c.prefer_contiguous, static_cast<uint64_t>(c.min_shard_size), c.preferred_slice);
+}
+inline bool decode(Reader& r, WorkerConfig& c) {
+  uint64_t rf = 0, mw = 0, ms = 0;
+  if (!decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
+                     c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
+                     c.preferred_slice))
+    return false;
+  c.replication_factor = rf;
+  c.max_workers_per_copy = mw;
+  c.min_shard_size = ms;
+  return true;
+}
+
+inline void encode(Writer& w, const ClusterStats& s) {
+  encode_fields(w, s.total_workers, s.total_memory_pools, s.total_objects, s.total_capacity,
+                s.used_capacity, s.avg_utilization);
+}
+inline bool decode(Reader& r, ClusterStats& s) {
+  return decode_fields(r, s.total_workers, s.total_memory_pools, s.total_objects,
+                       s.total_capacity, s.used_capacity, s.avg_utilization);
+}
+
+inline void encode(Writer& w, const MemoryPool& p) {
+  encode_fields(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote, p.topo);
+}
+inline bool decode(Reader& r, MemoryPool& p) {
+  return decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
+                       p.remote, p.topo);
+}
+
+inline void encode(Writer& w, const BatchPutStartItem& i) {
+  encode_fields(w, i.key, i.data_size, i.config);
+}
+inline bool decode(Reader& r, BatchPutStartItem& i) {
+  return decode_fields(r, i.key, i.data_size, i.config);
+}
+
+template <typename T>
+void encode(Writer& w, const std::vector<T>& v) {
+  if (v.size() > std::numeric_limits<uint32_t>::max())
+    throw std::length_error("wire: vector exceeds u32 count prefix");
+  w.put<uint32_t>(static_cast<uint32_t>(v.size()));
+  for (const auto& e : v) encode(w, e);
+}
+
+template <typename T>
+bool decode(Reader& r, std::vector<T>& v) {
+  uint32_t n = 0;
+  if (!r.get(n)) return false;
+  // Guard against hostile counts: each element costs >= 1 byte on the wire.
+  if (n > r.remaining()) return false;
+  v.clear();
+  v.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    T e{};
+    if (!decode(r, e)) return false;
+    v.push_back(std::move(e));
+  }
+  return true;
+}
+
+// ---- request/response structs --------------------------------------------
+// X-macro: each RPC struct lists its fields once.
+#define BTPU_WIRE_STRUCT(Type, ...)                                   \
+  inline void encode(Writer& w, const Type& m) {                      \
+    auto& [__VA_ARGS__] = m;                                          \
+    encode_fields(w, __VA_ARGS__);                                    \
+  }                                                                   \
+  inline bool decode(Reader& r, Type& m) {                            \
+    auto& [__VA_ARGS__] = m;                                          \
+    return decode_fields(r, __VA_ARGS__);                             \
+  }
+
+#define BTPU_WIRE_EMPTY(Type)                       \
+  inline void encode(Writer&, const Type&) {}       \
+  inline bool decode(Reader&, Type&) { return true; }
+
+BTPU_WIRE_STRUCT(ObjectExistsRequest, f0)
+BTPU_WIRE_STRUCT(ObjectExistsResponse, f0, f1)
+BTPU_WIRE_STRUCT(GetWorkersRequest, f0)
+BTPU_WIRE_STRUCT(GetWorkersResponse, f0, f1)
+BTPU_WIRE_STRUCT(PutStartRequest, f0, f1, f2)
+BTPU_WIRE_STRUCT(PutStartResponse, f0, f1)
+BTPU_WIRE_STRUCT(PutCompleteRequest, f0)
+BTPU_WIRE_STRUCT(PutCompleteResponse, f0)
+BTPU_WIRE_STRUCT(PutCancelRequest, f0)
+BTPU_WIRE_STRUCT(PutCancelResponse, f0)
+BTPU_WIRE_STRUCT(RemoveObjectRequest, f0)
+BTPU_WIRE_STRUCT(RemoveObjectResponse, f0)
+BTPU_WIRE_EMPTY(RemoveAllObjectsRequest)
+BTPU_WIRE_STRUCT(RemoveAllObjectsResponse, f0, f1)
+BTPU_WIRE_EMPTY(GetClusterStatsRequest)
+BTPU_WIRE_STRUCT(GetClusterStatsResponse, f0, f1)
+BTPU_WIRE_EMPTY(GetViewVersionRequest)
+BTPU_WIRE_STRUCT(GetViewVersionResponse, f0, f1)
+BTPU_WIRE_STRUCT(BatchObjectExistsRequest, f0)
+BTPU_WIRE_STRUCT(BatchObjectExistsResponse, f0, f1)
+BTPU_WIRE_STRUCT(BatchGetWorkersRequest, f0)
+BTPU_WIRE_STRUCT(BatchGetWorkersResponse, f0, f1)
+BTPU_WIRE_STRUCT(BatchPutStartRequest, f0)
+BTPU_WIRE_STRUCT(BatchPutStartResponse, f0, f1)
+BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0)
+BTPU_WIRE_STRUCT(BatchPutCompleteResponse, f0, f1)
+BTPU_WIRE_STRUCT(BatchPutCancelRequest, f0)
+BTPU_WIRE_STRUCT(BatchPutCancelResponse, f0, f1)
+BTPU_WIRE_STRUCT(PingResponse, f0)
+
+#undef BTPU_WIRE_STRUCT
+#undef BTPU_WIRE_EMPTY
+
+// Convenience: serialize a whole message to bytes / parse from bytes.
+template <typename T>
+std::vector<uint8_t> to_bytes(const T& msg) {
+  Writer w;
+  encode(w, msg);
+  return w.take();
+}
+
+template <typename T>
+bool from_bytes(const std::vector<uint8_t>& bytes, T& out) {
+  Reader r(bytes);
+  return decode(r, out) && r.exhausted();
+}
+
+}  // namespace btpu::wire

@@ -1,0 +1,349 @@
+#include "btpu/common/config.h"
+
+#include <algorithm>
+#include <cctype>
+#include <charconv>
+#include <fstream>
+#include <sstream>
+
+#include "btpu/common/log.h"
+#include "btpu/common/types.h"
+
+namespace btpu::yaml {
+
+NodePtr Node::make_null() {
+  auto n = std::make_shared<Node>();
+  n->kind_ = Kind::kNull;
+  return n;
+}
+NodePtr Node::make_scalar(std::string value, bool quoted) {
+  auto n = std::make_shared<Node>();
+  n->kind_ = Kind::kScalar;
+  n->scalar_ = std::move(value);
+  n->quoted_ = quoted;
+  return n;
+}
+NodePtr Node::make_map() {
+  auto n = std::make_shared<Node>();
+  n->kind_ = Kind::kMap;
+  return n;
+}
+NodePtr Node::make_list() {
+  auto n = std::make_shared<Node>();
+  n->kind_ = Kind::kList;
+  return n;
+}
+
+NodePtr Node::get(const std::string& key) const {
+  if (!is_map()) return nullptr;
+  auto it = map_.find(key);
+  return it == map_.end() ? nullptr : it->second;
+}
+
+NodePtr Node::get_path(const std::string& dotted) const {
+  size_t start = 0;
+  const Node* cur = this;
+  NodePtr result;
+  while (start <= dotted.size()) {
+    size_t dot = dotted.find('.', start);
+    std::string part = dotted.substr(start, dot == std::string::npos ? std::string::npos : dot - start);
+    result = cur->get(part);
+    if (!result) return nullptr;
+    if (dot == std::string::npos) return result;
+    cur = result.get();
+    start = dot + 1;
+  }
+  return result;
+}
+
+std::optional<std::string> Node::as_string() const {
+  if (!is_scalar()) return std::nullopt;
+  return scalar_;
+}
+
+std::optional<int64_t> Node::as_int() const {
+  if (!is_scalar()) return std::nullopt;
+  int64_t v = 0;
+  auto [p, ec] = std::from_chars(scalar_.data(), scalar_.data() + scalar_.size(), v);
+  if (ec != std::errc{} || p != scalar_.data() + scalar_.size()) return std::nullopt;
+  return v;
+}
+
+std::optional<uint64_t> Node::as_uint() const {
+  if (!is_scalar()) return std::nullopt;
+  uint64_t v = 0;
+  auto [p, ec] = std::from_chars(scalar_.data(), scalar_.data() + scalar_.size(), v);
+  if (ec != std::errc{} || p != scalar_.data() + scalar_.size()) return std::nullopt;
+  return v;
+}
+
+std::optional<double> Node::as_double() const {
+  if (!is_scalar()) return std::nullopt;
+  try {
+    size_t pos = 0;
+    double v = std::stod(scalar_, &pos);
+    if (pos != scalar_.size()) return std::nullopt;
+    return v;
+  } catch (...) {
+    return std::nullopt;
+  }
+}
+
+std::optional<bool> Node::as_bool() const {
+  if (!is_scalar()) return std::nullopt;
+  if (scalar_ == "true" || scalar_ == "True" || scalar_ == "yes" || scalar_ == "on") return true;
+  if (scalar_ == "false" || scalar_ == "False" || scalar_ == "no" || scalar_ == "off") return false;
+  return std::nullopt;
+}
+
+namespace {
+
+struct Line {
+  int indent;
+  std::string content;  // stripped of indentation and trailing comment
+  size_t number;
+};
+
+// Strip a trailing comment that is not inside quotes.
+std::string strip_comment(const std::string& s) {
+  bool in_single = false, in_double = false;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (c == '\'' && !in_double) in_single = !in_single;
+    else if (c == '"' && !in_single) in_double = !in_double;
+    else if (c == '#' && !in_single && !in_double && (i == 0 || s[i - 1] == ' ' || s[i - 1] == '\t'))
+      return s.substr(0, i);
+  }
+  return s;
+}
+
+std::string rstrip(std::string s) {
+  while (!s.empty() && (s.back() == ' ' || s.back() == '\t' || s.back() == '\r')) s.pop_back();
+  return s;
+}
+
+// Parse a scalar token: strip quotes, detect null.
+NodePtr scalar_node(std::string tok) {
+  if (tok.empty() || tok == "~" || tok == "null") return Node::make_null();
+  if (tok.size() >= 2 && ((tok.front() == '"' && tok.back() == '"') ||
+                          (tok.front() == '\'' && tok.back() == '\''))) {
+    return Node::make_scalar(tok.substr(1, tok.size() - 2), /*quoted=*/true);
+  }
+  return Node::make_scalar(std::move(tok));
+}
+
+class Parser {
+ public:
+  explicit Parser(std::vector<Line> lines) : lines_(std::move(lines)) {}
+
+  Result<NodePtr> run() {
+    if (lines_.empty()) return Node::make_map();
+    auto node = parse_block(lines_[0].indent);
+    if (!node.ok()) return node;
+    if (pos_ != lines_.size()) {
+      LOG_ERROR << "yaml: unexpected content at line " << lines_[pos_].number;
+      return ErrorCode::INVALID_CONFIGURATION;
+    }
+    return node;
+  }
+
+ private:
+  // Parses a block (map or list) whose items sit at `indent`.
+  Result<NodePtr> parse_block(int indent) {
+    if (pos_ >= lines_.size()) return Node::make_null();
+    const bool is_list = lines_[pos_].content.rfind("- ", 0) == 0 || lines_[pos_].content == "-";
+    return is_list ? parse_list(indent) : parse_map(indent);
+  }
+
+  Result<NodePtr> parse_map(int indent) {
+    auto map = Node::make_map();
+    while (pos_ < lines_.size() && lines_[pos_].indent == indent) {
+      const Line& line = lines_[pos_];
+      if (line.content.rfind("- ", 0) == 0 || line.content == "-") break;  // list item at map level: stop
+      size_t colon = find_key_colon(line.content);
+      if (colon == std::string::npos) {
+        LOG_ERROR << "yaml: expected 'key: value' at line " << line.number;
+        return ErrorCode::INVALID_CONFIGURATION;
+      }
+      std::string key = rstrip(line.content.substr(0, colon));
+      std::string rest = line.content.substr(colon + 1);
+      size_t first = rest.find_first_not_of(" \t");
+      rest = first == std::string::npos ? "" : rest.substr(first);
+      ++pos_;
+      if (!rest.empty()) {
+        map->map_set(key, scalar_node(rest));
+      } else if (pos_ < lines_.size() && lines_[pos_].indent > indent) {
+        auto child = parse_block(lines_[pos_].indent);
+        if (!child.ok()) return child;
+        map->map_set(key, child.value());
+      } else {
+        map->map_set(key, Node::make_null());
+      }
+    }
+    if (pos_ < lines_.size() && lines_[pos_].indent > indent) {
+      LOG_ERROR << "yaml: bad indentation at line " << lines_[pos_].number;
+      return ErrorCode::INVALID_CONFIGURATION;
+    }
+    return map;
+  }
+
+  Result<NodePtr> parse_list(int indent) {
+    auto list = Node::make_list();
+    while (pos_ < lines_.size() && lines_[pos_].indent == indent &&
+           (lines_[pos_].content.rfind("- ", 0) == 0 || lines_[pos_].content == "-")) {
+      Line line = lines_[pos_];
+      std::string rest = line.content == "-" ? "" : line.content.substr(2);
+      size_t first = rest.find_first_not_of(" \t");
+      rest = first == std::string::npos ? "" : rest.substr(first);
+      if (rest.empty()) {
+        ++pos_;
+        if (pos_ < lines_.size() && lines_[pos_].indent > indent) {
+          auto child = parse_block(lines_[pos_].indent);
+          if (!child.ok()) return child;
+          list->list_append(child.value());
+        } else {
+          list->list_append(Node::make_null());
+        }
+      } else if (find_key_colon(rest) != std::string::npos) {
+        // Inline first pair of a map item: rewrite "- k: v" as a map whose
+        // first line is at the rest's indentation, then continue that map.
+        int item_indent = line.indent + 2;
+        lines_[pos_] = Line{item_indent, rest, line.number};
+        auto child = parse_map(item_indent);
+        if (!child.ok()) return child;
+        list->list_append(child.value());
+      } else {
+        list->list_append(scalar_node(rest));
+        ++pos_;
+      }
+    }
+    return list;
+  }
+
+  // Finds the ':' separating key from value (not inside quotes; must be at
+  // end or followed by whitespace).
+  static size_t find_key_colon(const std::string& s) {
+    bool in_single = false, in_double = false;
+    for (size_t i = 0; i < s.size(); ++i) {
+      char c = s[i];
+      if (c == '\'' && !in_double) in_single = !in_single;
+      else if (c == '"' && !in_single) in_double = !in_double;
+      else if (c == ':' && !in_single && !in_double &&
+               (i + 1 == s.size() || s[i + 1] == ' ' || s[i + 1] == '\t'))
+        return i;
+    }
+    return std::string::npos;
+  }
+
+  std::vector<Line> lines_;
+  size_t pos_{0};
+};
+
+}  // namespace
+
+Result<NodePtr> parse(const std::string& text) {
+  std::vector<Line> lines;
+  std::istringstream in(text);
+  std::string raw;
+  size_t number = 0;
+  while (std::getline(in, raw)) {
+    ++number;
+    std::string no_comment = rstrip(strip_comment(raw));
+    size_t indent = no_comment.find_first_not_of(' ');
+    if (indent == std::string::npos) continue;  // blank line
+    std::string content = no_comment.substr(indent);
+    if (content == "---") continue;  // document marker
+    if (content.find('\t') == 0) {
+      LOG_ERROR << "yaml: tab indentation at line " << number;
+      return ErrorCode::INVALID_CONFIGURATION;
+    }
+    lines.push_back({static_cast<int>(indent), content, number});
+  }
+  return Parser(std::move(lines)).run();
+}
+
+Result<NodePtr> parse_file(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) {
+    LOG_ERROR << "yaml: cannot open " << path;
+    return ErrorCode::CONFIG_ERROR;
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return parse(ss.str());
+}
+
+std::optional<uint64_t> parse_byte_size(const std::string& text) {
+  if (text.empty()) return std::nullopt;
+  size_t i = 0;
+  uint64_t value = 0;
+  bool any = false;
+  while (i < text.size() && std::isdigit(static_cast<unsigned char>(text[i]))) {
+    value = value * 10 + (text[i] - '0');
+    any = true;
+    ++i;
+  }
+  if (!any) return std::nullopt;
+  std::string suffix = text.substr(i);
+  std::transform(suffix.begin(), suffix.end(), suffix.begin(),
+                 [](unsigned char c) { return std::toupper(c); });
+  if (suffix.empty() || suffix == "B") return value;
+  if (suffix == "K" || suffix == "KB" || suffix == "KIB") return value << 10;
+  if (suffix == "M" || suffix == "MB" || suffix == "MIB") return value << 20;
+  if (suffix == "G" || suffix == "GB" || suffix == "GIB") return value << 30;
+  if (suffix == "T" || suffix == "TB" || suffix == "TIB") return value << 40;
+  return std::nullopt;
+}
+
+}  // namespace yaml
+
+// ---------------------------------------------------------------------------
+// KeystoneConfig::from_yaml — parity with reference src/common/types.cpp:20-101
+// (throws std::runtime_error on unreadable/invalid config).
+// ---------------------------------------------------------------------------
+namespace btpu {
+
+KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
+  auto parsed = yaml::parse_file(file_path);
+  if (!parsed.ok()) {
+    throw std::runtime_error("failed to parse keystone config " + file_path + ": " +
+                             std::string(to_string(parsed.error())));
+  }
+  const auto& root = *parsed.value();
+  KeystoneConfig cfg;
+  if (auto n = root.get("cluster_id")) cfg.cluster_id = n->str_or(cfg.cluster_id);
+  if (auto n = root.get("coord_endpoints")) cfg.coord_endpoints = n->str_or("");
+  if (auto n = root.get("etcd_endpoints")) cfg.coord_endpoints = n->str_or("");  // reference key
+  if (auto n = root.get("listen_address")) cfg.listen_address = n->str_or(cfg.listen_address);
+  if (auto n = root.get("http_metrics_port")) cfg.http_metrics_port = n->str_or(cfg.http_metrics_port);
+  if (auto n = root.get("service_id")) cfg.service_id = n->str_or("");
+
+  if (auto n = root.get("enable_gc")) cfg.enable_gc = n->bool_or(cfg.enable_gc);
+  if (auto n = root.get("enable_ha")) cfg.enable_ha = n->bool_or(cfg.enable_ha);
+  if (auto n = root.get("eviction_ratio")) cfg.eviction_ratio = n->double_or(cfg.eviction_ratio);
+  if (auto n = root.get("high_watermark")) cfg.high_watermark = n->double_or(cfg.high_watermark);
+  if (auto n = root.get("client_ttl_sec")) cfg.client_ttl_sec = n->int_or(cfg.client_ttl_sec);
+  if (auto n = root.get("worker_heartbeat_ttl_sec"))
+    cfg.worker_heartbeat_ttl_sec = n->int_or(cfg.worker_heartbeat_ttl_sec);
+  if (auto n = root.get("service_registration_ttl_sec"))
+    cfg.service_registration_ttl_sec = n->int_or(cfg.service_registration_ttl_sec);
+  if (auto n = root.get("service_refresh_interval_sec"))
+    cfg.service_refresh_interval_sec = n->int_or(cfg.service_refresh_interval_sec);
+  if (auto n = root.get("gc_interval_sec")) cfg.gc_interval_sec = n->int_or(cfg.gc_interval_sec);
+  if (auto n = root.get("health_check_interval_sec"))
+    cfg.health_check_interval_sec = n->int_or(cfg.health_check_interval_sec);
+  if (auto n = root.get("max_replicas")) cfg.max_replicas = static_cast<int32_t>(n->int_or(cfg.max_replicas));
+  if (auto n = root.get("default_replicas"))
+    cfg.default_replicas = static_cast<int32_t>(n->int_or(cfg.default_replicas));
+  if (auto n = root.get("enable_repair")) cfg.enable_repair = n->bool_or(cfg.enable_repair);
+  if (auto n = root.get("tier_aware_eviction"))
+    cfg.tier_aware_eviction = n->bool_or(cfg.tier_aware_eviction);
+
+  if (auto ec = cfg.validate(); ec != ErrorCode::OK) {
+    throw std::runtime_error("invalid keystone config " + file_path + ": " +
+                             std::string(to_string(ec)));
+  }
+  return cfg;
+}
+
+}  // namespace btpu

@@ -1,0 +1,127 @@
+#include "btpu/common/error.h"
+
+namespace btpu {
+
+std::string_view to_string(ErrorCode code) noexcept {
+  switch (code) {
+    case ErrorCode::OK: return "OK";
+    case ErrorCode::INTERNAL_ERROR: return "INTERNAL_ERROR";
+    case ErrorCode::INITIALIZATION_FAILED: return "INITIALIZATION_FAILED";
+    case ErrorCode::INVALID_STATE: return "INVALID_STATE";
+    case ErrorCode::OPERATION_TIMEOUT: return "OPERATION_TIMEOUT";
+    case ErrorCode::RESOURCE_EXHAUSTED: return "RESOURCE_EXHAUSTED";
+    case ErrorCode::NOT_IMPLEMENTED: return "NOT_IMPLEMENTED";
+    case ErrorCode::BUFFER_OVERFLOW: return "BUFFER_OVERFLOW";
+    case ErrorCode::OUT_OF_MEMORY: return "OUT_OF_MEMORY";
+    case ErrorCode::MEMORY_POOL_NOT_FOUND: return "MEMORY_POOL_NOT_FOUND";
+    case ErrorCode::MEMORY_POOL_ALREADY_EXISTS: return "MEMORY_POOL_ALREADY_EXISTS";
+    case ErrorCode::INVALID_MEMORY_POOL: return "INVALID_MEMORY_POOL";
+    case ErrorCode::ALLOCATION_FAILED: return "ALLOCATION_FAILED";
+    case ErrorCode::INSUFFICIENT_SPACE: return "INSUFFICIENT_SPACE";
+    case ErrorCode::MEMORY_ACCESS_ERROR: return "MEMORY_ACCESS_ERROR";
+    case ErrorCode::NETWORK_ERROR: return "NETWORK_ERROR";
+    case ErrorCode::CONNECTION_FAILED: return "CONNECTION_FAILED";
+    case ErrorCode::TRANSFER_FAILED: return "TRANSFER_FAILED";
+    case ErrorCode::TRANSPORT_ERROR: return "TRANSPORT_ERROR";
+    case ErrorCode::INVALID_ADDRESS: return "INVALID_ADDRESS";
+    case ErrorCode::REMOTE_ENDPOINT_ERROR: return "REMOTE_ENDPOINT_ERROR";
+    case ErrorCode::RPC_FAILED: return "RPC_FAILED";
+    case ErrorCode::COORD_ERROR: return "COORD_ERROR";
+    case ErrorCode::COORD_KEY_NOT_FOUND: return "COORD_KEY_NOT_FOUND";
+    case ErrorCode::COORD_TRANSACTION_FAILED: return "COORD_TRANSACTION_FAILED";
+    case ErrorCode::COORD_LEASE_ERROR: return "COORD_LEASE_ERROR";
+    case ErrorCode::COORD_WATCH_ERROR: return "COORD_WATCH_ERROR";
+    case ErrorCode::LEADER_ELECTION_FAILED: return "LEADER_ELECTION_FAILED";
+    case ErrorCode::SERVICE_REGISTRATION_FAILED: return "SERVICE_REGISTRATION_FAILED";
+    case ErrorCode::OBJECT_NOT_FOUND: return "OBJECT_NOT_FOUND";
+    case ErrorCode::OBJECT_ALREADY_EXISTS: return "OBJECT_ALREADY_EXISTS";
+    case ErrorCode::INVALID_KEY: return "INVALID_KEY";
+    case ErrorCode::INVALID_WORKER: return "INVALID_WORKER";
+    case ErrorCode::WORKER_NOT_READY: return "WORKER_NOT_READY";
+    case ErrorCode::NO_COMPLETE_WORKER: return "NO_COMPLETE_WORKER";
+    case ErrorCode::DATA_CORRUPTION: return "DATA_CORRUPTION";
+    case ErrorCode::CHECKSUM_MISMATCH: return "CHECKSUM_MISMATCH";
+    case ErrorCode::CLIENT_ERROR: return "CLIENT_ERROR";
+    case ErrorCode::CLIENT_NOT_FOUND: return "CLIENT_NOT_FOUND";
+    case ErrorCode::CLIENT_ALREADY_EXISTS: return "CLIENT_ALREADY_EXISTS";
+    case ErrorCode::CLIENT_DISCONNECTED: return "CLIENT_DISCONNECTED";
+    case ErrorCode::SESSION_EXPIRED: return "SESSION_EXPIRED";
+    case ErrorCode::INVALID_CLIENT_STATE: return "INVALID_CLIENT_STATE";
+    case ErrorCode::CONFIG_ERROR: return "CONFIG_ERROR";
+    case ErrorCode::INVALID_CONFIGURATION: return "INVALID_CONFIGURATION";
+    case ErrorCode::INVALID_PARAMETERS: return "INVALID_PARAMETERS";
+    case ErrorCode::MISSING_REQUIRED_FIELD: return "MISSING_REQUIRED_FIELD";
+    case ErrorCode::VALUE_OUT_OF_RANGE: return "VALUE_OUT_OF_RANGE";
+  }
+  return "UNKNOWN_ERROR";
+}
+
+std::string_view describe(ErrorCode code) noexcept {
+  switch (code) {
+    case ErrorCode::OK: return "operation completed successfully";
+    case ErrorCode::INTERNAL_ERROR: return "unexpected internal error";
+    case ErrorCode::INITIALIZATION_FAILED: return "subsystem failed to initialize";
+    case ErrorCode::INVALID_STATE: return "operation not valid in current state";
+    case ErrorCode::OPERATION_TIMEOUT: return "operation did not complete in time";
+    case ErrorCode::RESOURCE_EXHAUSTED: return "a system resource is exhausted";
+    case ErrorCode::NOT_IMPLEMENTED: return "feature not implemented";
+    case ErrorCode::BUFFER_OVERFLOW: return "write past the end of a buffer";
+    case ErrorCode::OUT_OF_MEMORY: return "memory allocation failed";
+    case ErrorCode::MEMORY_POOL_NOT_FOUND: return "referenced memory pool does not exist";
+    case ErrorCode::MEMORY_POOL_ALREADY_EXISTS: return "memory pool id already registered";
+    case ErrorCode::INVALID_MEMORY_POOL: return "memory pool descriptor is malformed";
+    case ErrorCode::ALLOCATION_FAILED: return "allocator could not satisfy the request";
+    case ErrorCode::INSUFFICIENT_SPACE: return "not enough free space in eligible pools";
+    case ErrorCode::MEMORY_ACCESS_ERROR: return "invalid access to a registered region";
+    case ErrorCode::NETWORK_ERROR: return "generic network failure";
+    case ErrorCode::CONNECTION_FAILED: return "could not connect to remote endpoint";
+    case ErrorCode::TRANSFER_FAILED: return "one-sided data transfer failed";
+    case ErrorCode::TRANSPORT_ERROR: return "transport-layer failure";
+    case ErrorCode::INVALID_ADDRESS: return "address could not be parsed or resolved";
+    case ErrorCode::REMOTE_ENDPOINT_ERROR: return "remote endpoint rejected the operation";
+    case ErrorCode::RPC_FAILED: return "rpc call failed";
+    case ErrorCode::COORD_ERROR: return "coordination service failure";
+    case ErrorCode::COORD_KEY_NOT_FOUND: return "key not present in coordination store";
+    case ErrorCode::COORD_TRANSACTION_FAILED: return "coordination transaction aborted";
+    case ErrorCode::COORD_LEASE_ERROR: return "lease grant/keepalive/revoke failed";
+    case ErrorCode::COORD_WATCH_ERROR: return "watch could not be established";
+    case ErrorCode::LEADER_ELECTION_FAILED: return "leader election failed";
+    case ErrorCode::SERVICE_REGISTRATION_FAILED: return "service registration failed";
+    case ErrorCode::OBJECT_NOT_FOUND: return "object key not found";
+    case ErrorCode::OBJECT_ALREADY_EXISTS: return "object key already exists";
+    case ErrorCode::INVALID_KEY: return "object key is malformed";
+    case ErrorCode::INVALID_WORKER: return "worker id unknown or malformed";
+    case ErrorCode::WORKER_NOT_READY: return "worker has not completed startup";
+    case ErrorCode::NO_COMPLETE_WORKER: return "no replica has a complete copy";
+    case ErrorCode::DATA_CORRUPTION: return "stored data failed validation";
+    case ErrorCode::CHECKSUM_MISMATCH: return "checksum does not match stored digest";
+    case ErrorCode::CLIENT_ERROR: return "generic client-side failure";
+    case ErrorCode::CLIENT_NOT_FOUND: return "client session not found";
+    case ErrorCode::CLIENT_ALREADY_EXISTS: return "client session already registered";
+    case ErrorCode::CLIENT_DISCONNECTED: return "client connection lost";
+    case ErrorCode::SESSION_EXPIRED: return "client session ttl expired";
+    case ErrorCode::INVALID_CLIENT_STATE: return "client operation out of order";
+    case ErrorCode::CONFIG_ERROR: return "configuration system failure";
+    case ErrorCode::INVALID_CONFIGURATION: return "configuration failed validation";
+    case ErrorCode::INVALID_PARAMETERS: return "call parameters failed validation";
+    case ErrorCode::MISSING_REQUIRED_FIELD: return "required config field missing";
+    case ErrorCode::VALUE_OUT_OF_RANGE: return "config value outside legal range";
+  }
+  return "unknown error code";
+}
+
+std::string_view domain_name(Domain d) noexcept {
+  switch (d) {
+    case Domain::SUCCESS: return "success";
+    case Domain::SYSTEM: return "system";
+    case Domain::STORAGE: return "storage";
+    case Domain::NETWORK: return "network";
+    case Domain::COORDINATION: return "coordination";
+    case Domain::DATA: return "data";
+    case Domain::CLIENT: return "client";
+    case Domain::CONFIG: return "config";
+  }
+  return "unknown";
+}
+
+}  // namespace btpu

@@ -1,0 +1,65 @@
+#include "btpu/common/types.h"
+
+namespace btpu {
+
+std::string_view storage_class_name(StorageClass c) noexcept {
+  switch (c) {
+    case StorageClass::STORAGE_UNSPECIFIED: return "unspecified";
+    case StorageClass::RAM_CPU: return "ram_cpu";
+    case StorageClass::HBM_TPU: return "hbm_tpu";
+    case StorageClass::NVME: return "nvme";
+    case StorageClass::SSD: return "ssd";
+    case StorageClass::HDD: return "hdd";
+    case StorageClass::CXL_MEMORY: return "cxl_memory";
+    case StorageClass::CXL_TYPE2_DEVICE: return "cxl_type2";
+    case StorageClass::CUSTOM: return "custom";
+  }
+  return "unknown";
+}
+
+std::optional<StorageClass> storage_class_from_name(std::string_view name) noexcept {
+  if (name == "ram_cpu" || name == "RAM_CPU" || name == "dram") return StorageClass::RAM_CPU;
+  if (name == "hbm_tpu" || name == "HBM_TPU" || name == "hbm") return StorageClass::HBM_TPU;
+  if (name == "nvme" || name == "NVME") return StorageClass::NVME;
+  if (name == "ssd" || name == "SSD") return StorageClass::SSD;
+  if (name == "hdd" || name == "HDD") return StorageClass::HDD;
+  if (name == "cxl_memory" || name == "CXL_MEMORY") return StorageClass::CXL_MEMORY;
+  if (name == "cxl_type2" || name == "CXL_TYPE2_DEVICE") return StorageClass::CXL_TYPE2_DEVICE;
+  if (name == "custom" || name == "CUSTOM") return StorageClass::CUSTOM;
+  if (name == "unspecified") return StorageClass::STORAGE_UNSPECIFIED;
+  return std::nullopt;
+}
+
+std::string_view transport_kind_name(TransportKind k) noexcept {
+  switch (k) {
+    case TransportKind::TRANSPORT_UNSPECIFIED: return "unspecified";
+    case TransportKind::LOCAL: return "local";
+    case TransportKind::SHM: return "shm";
+    case TransportKind::TCP: return "tcp";
+    case TransportKind::ICI: return "ici";
+    case TransportKind::HBM: return "hbm";
+  }
+  return "unknown";
+}
+
+std::optional<TransportKind> transport_kind_from_name(std::string_view name) noexcept {
+  if (name == "local") return TransportKind::LOCAL;
+  if (name == "shm") return TransportKind::SHM;
+  if (name == "tcp") return TransportKind::TCP;
+  if (name == "ici") return TransportKind::ICI;
+  if (name == "hbm") return TransportKind::HBM;
+  if (name == "unspecified") return TransportKind::TRANSPORT_UNSPECIFIED;
+  return std::nullopt;
+}
+
+ErrorCode KeystoneConfig::validate() const {
+  if (cluster_id.empty()) return ErrorCode::MISSING_REQUIRED_FIELD;
+  if (high_watermark <= 0.0 || high_watermark > 1.0) return ErrorCode::VALUE_OUT_OF_RANGE;
+  if (eviction_ratio < 0.0 || eviction_ratio > 1.0) return ErrorCode::VALUE_OUT_OF_RANGE;
+  if (gc_interval_sec <= 0 || health_check_interval_sec <= 0) return ErrorCode::VALUE_OUT_OF_RANGE;
+  if (max_replicas <= 0 || default_replicas <= 0 || default_replicas > max_replicas)
+    return ErrorCode::VALUE_OUT_OF_RANGE;
+  return ErrorCode::OK;
+}
+
+}  // namespace btpu

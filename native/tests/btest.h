@@ -1,0 +1,138 @@
+// Tiny self-contained unit-test framework (gtest is not available in this
+// image and network fetch is disallowed, so we ship our own runner).
+// Usage:   BTEST(Suite, Name) { BT_EXPECT_EQ(a, b); ... }
+// Runner:  btpu_tests [--filter=substring] [--list]
+#pragma once
+
+#include <cstdio>
+#include <functional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace btest {
+
+struct TestCase {
+  std::string name;
+  std::function<void()> fn;
+};
+
+inline std::vector<TestCase>& registry() {
+  static std::vector<TestCase> r;
+  return r;
+}
+
+inline int& failure_count() {
+  static int n = 0;
+  return n;
+}
+
+inline bool& current_failed() {
+  static bool f = false;
+  return f;
+}
+
+struct Registrar {
+  Registrar(std::string name, std::function<void()> fn) {
+    registry().push_back({std::move(name), std::move(fn)});
+  }
+};
+
+template <typename A, typename B>
+std::string fmt_cmp(const char* op, const A& a, const B& b) {
+  std::ostringstream ss;
+  ss << "expected: " << a << " " << op << " " << b;
+  return ss.str();
+}
+
+inline void report_failure(const char* file, int line, const std::string& msg) {
+  std::fprintf(stderr, "  FAIL %s:%d: %s\n", file, line, msg.c_str());
+  current_failed() = true;
+}
+
+inline int run_all(int argc, char** argv) {
+  std::string filter;
+  bool list = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a.rfind("--filter=", 0) == 0) filter = a.substr(9);
+    if (a == "--list") list = true;
+  }
+  int ran = 0, failed = 0;
+  for (auto& tc : registry()) {
+    if (!filter.empty() && tc.name.find(filter) == std::string::npos) continue;
+    if (list) {
+      std::printf("%s\n", tc.name.c_str());
+      continue;
+    }
+    current_failed() = false;
+    std::printf("[ RUN  ] %s\n", tc.name.c_str());
+    std::fflush(stdout);
+    try {
+      tc.fn();
+    } catch (const std::exception& e) {
+      report_failure("<exception>", 0, std::string("uncaught exception: ") + e.what());
+    } catch (...) {
+      report_failure("<exception>", 0, "uncaught non-std exception");
+    }
+    ++ran;
+    if (current_failed()) {
+      ++failed;
+      std::printf("[ FAIL ] %s\n", tc.name.c_str());
+    } else {
+      std::printf("[  OK  ] %s\n", tc.name.c_str());
+    }
+    std::fflush(stdout);
+  }
+  if (!list) {
+    std::printf("%d tests ran, %d failed\n", ran, failed);
+  }
+  return failed == 0 ? 0 : 1;
+}
+
+}  // namespace btest
+
+#define BTEST(Suite, Name)                                                   \
+  static void btest_##Suite##_##Name();                                      \
+  static ::btest::Registrar btest_reg_##Suite##_##Name(#Suite "." #Name,     \
+                                                       btest_##Suite##_##Name); \
+  static void btest_##Suite##_##Name()
+
+#define BT_EXPECT(cond)                                                      \
+  do {                                                                       \
+    if (!(cond)) ::btest::report_failure(__FILE__, __LINE__, "expected: " #cond); \
+  } while (0)
+
+#define BT_EXPECT_EQ(a, b)                                                   \
+  do {                                                                       \
+    auto _va = (a);                                                          \
+    auto _vb = (b);                                                          \
+    if (!(_va == _vb))                                                       \
+      ::btest::report_failure(__FILE__, __LINE__, ::btest::fmt_cmp("==", _va, _vb)); \
+  } while (0)
+
+#define BT_EXPECT_NE(a, b)                                                   \
+  do {                                                                       \
+    auto _va = (a);                                                          \
+    auto _vb = (b);                                                          \
+    if (_va == _vb)                                                          \
+      ::btest::report_failure(__FILE__, __LINE__, ::btest::fmt_cmp("!=", _va, _vb)); \
+  } while (0)
+
+#define BT_ASSERT(cond)                                                      \
+  do {                                                                       \
+    if (!(cond)) {                                                           \
+      ::btest::report_failure(__FILE__, __LINE__, "required: " #cond);       \
+      return;                                                                \
+    }                                                                        \
+  } while (0)
+
+#define BT_ASSERT_OK(result_expr)                                            \
+  do {                                                                       \
+    if (!(result_expr).ok()) {                                               \
+      ::btest::report_failure(__FILE__, __LINE__,                            \
+                              std::string("required OK, got error ") +       \
+                                  std::string(::btpu::to_string((result_expr).error()))); \
+      return;                                                                \
+    }                                                                        \
+  } while (0)

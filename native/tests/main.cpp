@@ -1,0 +1,3 @@
+#include "btest.h"
+
+int main(int argc, char** argv) { return btest::run_all(argc, argv); }

@@ -1,0 +1,244 @@
+// Core type / error / wire-format unit tests.
+// Mirrors the serialization-roundtrip test stage from SURVEY.md §7 step 1.
+#include "btest.h"
+#include "btpu/common/error.h"
+#include "btpu/common/result.h"
+#include "btpu/common/types.h"
+#include "btpu/common/wire.h"
+
+using namespace btpu;
+
+BTEST(Error, DomainsPartitionCodes) {
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::OK), 0u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::INTERNAL_ERROR), 1000u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::BUFFER_OVERFLOW), 2000u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::NETWORK_ERROR), 3000u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::COORD_ERROR), 4000u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::OBJECT_NOT_FOUND), 5000u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::CLIENT_ERROR), 6000u);
+  BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::CONFIG_ERROR), 7000u);
+  BT_EXPECT(error_domain(ErrorCode::INSUFFICIENT_SPACE) == Domain::STORAGE);
+  BT_EXPECT(error_domain(ErrorCode::OBJECT_ALREADY_EXISTS) == Domain::DATA);
+  BT_EXPECT(error_domain(ErrorCode::OK) == Domain::SUCCESS);
+}
+
+BTEST(Error, EveryCodeHasStrings) {
+  for (auto code : {ErrorCode::OK, ErrorCode::NOT_IMPLEMENTED, ErrorCode::INSUFFICIENT_SPACE,
+                    ErrorCode::TRANSFER_FAILED, ErrorCode::COORD_LEASE_ERROR,
+                    ErrorCode::CHECKSUM_MISMATCH, ErrorCode::SESSION_EXPIRED,
+                    ErrorCode::VALUE_OUT_OF_RANGE}) {
+    BT_EXPECT_NE(to_string(code), "UNKNOWN_ERROR");
+    BT_EXPECT_NE(describe(code), "unknown error code");
+  }
+}
+
+BTEST(Result, ValueAndErrorPaths) {
+  Result<int> ok_result(42);
+  BT_EXPECT(ok_result.ok());
+  BT_EXPECT_EQ(ok_result.value(), 42);
+  BT_EXPECT(ok_result.error() == ErrorCode::OK);
+
+  Result<int> err_result(ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT(!err_result.ok());
+  BT_EXPECT(err_result.error() == ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT_EQ(err_result.value_or(-1), -1);
+
+  // Free-function parity surface (reference types.h:37-49).
+  BT_EXPECT(is_ok(ok_result));
+  BT_EXPECT_EQ(get_value(ok_result), 42);
+  BT_EXPECT(get_error(err_result) == ErrorCode::OBJECT_NOT_FOUND);
+
+  auto mapped = ok_result.map([](int v) { return v * 2; });
+  BT_EXPECT_EQ(mapped.value(), 84);
+}
+
+BTEST(Result, DefaultIsError) {
+  Result<bool> r;
+  BT_EXPECT(!r.ok());
+}
+
+BTEST(Wire, ScalarAndStringRoundtrip) {
+  wire::Writer w;
+  w.put<uint64_t>(0xdeadbeefcafe1234ull);
+  w.put<double>(3.25);
+  w.put_string("hello");
+  w.put<uint8_t>(7);
+
+  wire::Reader r(w.buffer());
+  uint64_t u = 0;
+  double d = 0;
+  std::string s;
+  uint8_t b = 0;
+  BT_ASSERT(r.get(u) && r.get(d) && r.get_string(s) && r.get(b));
+  BT_EXPECT_EQ(u, 0xdeadbeefcafe1234ull);
+  BT_EXPECT_EQ(d, 3.25);
+  BT_EXPECT_EQ(s, "hello");
+  BT_EXPECT_EQ(int(b), 7);
+  BT_EXPECT(r.exhausted());
+}
+
+BTEST(Wire, TruncatedInputFailsCleanly) {
+  PutStartRequest req{.key = "obj/a", .data_size = 4096, .config = {}};
+  auto bytes = wire::to_bytes(req);
+  for (size_t cut = 0; cut < bytes.size(); ++cut) {
+    std::vector<uint8_t> prefix(bytes.begin(), bytes.begin() + cut);
+    PutStartRequest out{};
+    BT_EXPECT(!wire::from_bytes(prefix, out));
+  }
+}
+
+BTEST(Wire, HostileBoolRejected) {
+  // bool must reject byte values other than 0/1 (no invalid value repr UB).
+  ObjectExistsResponse resp{.exists = true, .error_code = ErrorCode::OK};
+  auto bytes = wire::to_bytes(resp);
+  bytes[0] = 0x02;
+  ObjectExistsResponse out{};
+  BT_EXPECT(!wire::from_bytes(bytes, out));
+}
+
+BTEST(Wire, ResultErrorArmCannotCarryOk) {
+  // tag=1 (error) + ErrorCode::OK is a contradiction — frame must be rejected.
+  wire::Writer w;
+  w.put<uint32_t>(1);  // one element
+  w.put<uint8_t>(1);   // error arm
+  w.put(ErrorCode::OK);
+  std::vector<Result<bool>> out;
+  wire::Reader r(w.buffer());
+  BT_EXPECT(!wire::decode(r, out));
+
+  // tag outside {0,1} is also rejected.
+  wire::Writer w2;
+  w2.put<uint32_t>(1);
+  w2.put<uint8_t>(7);
+  std::vector<Result<bool>> out2;
+  wire::Reader r2(w2.buffer());
+  BT_EXPECT(!wire::decode(r2, out2));
+}
+
+BTEST(Wire, HostileVectorCountRejected) {
+  // A 4-byte frame claiming 2^32-1 elements must not allocate or crash.
+  std::vector<uint8_t> evil = {0xff, 0xff, 0xff, 0xff};
+  std::vector<std::string> out;
+  wire::Reader r(evil);
+  BT_EXPECT(!wire::decode(r, out));
+}
+
+BTEST(Wire, PlacementRoundtrip) {
+  ShardPlacement shard{
+      .pool_id = "pool-7",
+      .worker_id = "worker-3",
+      .remote = {TransportKind::TCP, "10.0.0.3:7070", 0x7f0000000000ull, "a1b2c3"},
+      .storage_class = StorageClass::HBM_TPU,
+      .length = 1 << 20,
+      .location = MemoryLocation{0x7f0000001000ull, 0x55aaull, 1 << 20},
+  };
+  CopyPlacement copy{.copy_index = 2, .shards = {shard, shard}};
+  PutStartResponse resp{.copies = {copy}, .error_code = ErrorCode::OK};
+
+  auto bytes = wire::to_bytes(resp);
+  PutStartResponse out{};
+  BT_ASSERT(wire::from_bytes(bytes, out));
+  BT_ASSERT(out.copies.size() == 1);
+  BT_EXPECT_EQ(out.copies[0].copy_index, 2u);
+  BT_ASSERT(out.copies[0].shards.size() == 2);
+  const auto& s = out.copies[0].shards[1];
+  BT_EXPECT_EQ(s.pool_id, "pool-7");
+  BT_EXPECT_EQ(s.worker_id, "worker-3");
+  BT_EXPECT(s.remote == shard.remote);
+  BT_EXPECT(s.storage_class == StorageClass::HBM_TPU);
+  BT_EXPECT(std::get<MemoryLocation>(s.location) == std::get<MemoryLocation>(shard.location));
+}
+
+BTEST(Wire, LocationVariantAlternatives) {
+  for (LocationDetail loc : std::initializer_list<LocationDetail>{
+           MemoryLocation{1, 2, 3}, FileLocation{"/data/x", 77},
+           DeviceLocation{"tpu:0", 5, 4096, 1 << 16}}) {
+    wire::Writer w;
+    wire::encode(w, loc);
+    LocationDetail out;
+    wire::Reader r(w.buffer());
+    BT_ASSERT(wire::decode(r, out));
+    BT_EXPECT(loc == out);
+  }
+}
+
+BTEST(Wire, BatchResultsEncodeValueOrError) {
+  BatchObjectExistsResponse resp;
+  resp.results.emplace_back(true);
+  resp.results.emplace_back(ErrorCode::OBJECT_NOT_FOUND);
+  resp.results.emplace_back(false);
+
+  auto bytes = wire::to_bytes(resp);
+  BatchObjectExistsResponse out{};
+  BT_ASSERT(wire::from_bytes(bytes, out));
+  BT_ASSERT(out.results.size() == 3);
+  BT_EXPECT(out.results[0].ok() && out.results[0].value());
+  BT_EXPECT(!out.results[1].ok());
+  BT_EXPECT(out.results[1].error() == ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT(out.results[2].ok() && !out.results[2].value());
+}
+
+BTEST(Wire, WorkerConfigRoundtrip) {
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 8;
+  cfg.preferred_node = "host-1";
+  cfg.preferred_classes = {StorageClass::HBM_TPU, StorageClass::RAM_CPU};
+  cfg.ttl_ms = 1234;
+  cfg.min_shard_size = 512;
+  cfg.preferred_slice = 3;
+
+  auto bytes = wire::to_bytes(cfg);
+  WorkerConfig out{};
+  BT_ASSERT(wire::from_bytes(bytes, out));
+  BT_EXPECT_EQ(out.replication_factor, 2u);
+  BT_EXPECT_EQ(out.max_workers_per_copy, 8u);
+  BT_EXPECT_EQ(out.preferred_node, "host-1");
+  BT_ASSERT(out.preferred_classes.size() == 2);
+  BT_EXPECT(out.preferred_classes[0] == StorageClass::HBM_TPU);
+  BT_EXPECT_EQ(out.ttl_ms, 1234ull);
+  BT_EXPECT_EQ(out.min_shard_size, 512u);
+  BT_EXPECT_EQ(out.preferred_slice, 3);
+}
+
+BTEST(Types, StorageClassNamesRoundtrip) {
+  for (auto c : {StorageClass::RAM_CPU, StorageClass::HBM_TPU, StorageClass::NVME,
+                 StorageClass::SSD, StorageClass::HDD, StorageClass::CXL_MEMORY}) {
+    auto name = storage_class_name(c);
+    auto back = storage_class_from_name(name);
+    BT_ASSERT(back.has_value());
+    BT_EXPECT(*back == c);
+  }
+}
+
+BTEST(Types, MemoryPoolUtilization) {
+  MemoryPool pool;
+  pool.size = 1000;
+  pool.used = 250;
+  BT_EXPECT_EQ(pool.available(), 750ull);
+  BT_EXPECT_EQ(pool.utilization(), 0.25);
+  pool.size = 0;
+  BT_EXPECT_EQ(pool.utilization(), 0.0);
+  BT_EXPECT_EQ(pool.available(), 0ull);
+}
+
+BTEST(Types, TopoCoordLocality) {
+  TopoCoord a{0, 1, 2}, b{0, 1, 3}, c{0, 2, 0}, d{1, 1, 2};
+  BT_EXPECT(a.same_host(b));
+  BT_EXPECT(!a.same_host(c));
+  BT_EXPECT(a.same_slice(c));
+  BT_EXPECT(!a.same_slice(d));
+}
+
+BTEST(Types, KeystoneConfigValidation) {
+  KeystoneConfig cfg;
+  BT_EXPECT(cfg.validate() == ErrorCode::OK);
+  cfg.high_watermark = 1.5;
+  BT_EXPECT(cfg.validate() == ErrorCode::VALUE_OUT_OF_RANGE);
+  cfg = {};
+  cfg.cluster_id = "";
+  BT_EXPECT(cfg.validate() == ErrorCode::MISSING_REQUIRED_FIELD);
+  cfg = {};
+  cfg.default_replicas = 5;  // > max_replicas (3)
+  BT_EXPECT(cfg.validate() == ErrorCode::VALUE_OUT_OF_RANGE);
+}

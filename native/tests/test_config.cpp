@@ -1,0 +1,128 @@
+// YAML-subset parser + KeystoneConfig::from_yaml tests
+// (parity: reference src/common/types.cpp:20-101 config loading).
+#include <cstdio>
+#include <fstream>
+
+#include "btest.h"
+#include "btpu/common/config.h"
+#include "btpu/common/types.h"
+
+using namespace btpu;
+
+namespace {
+std::string write_temp(const std::string& content) {
+  static int counter = 0;
+  std::string path = "/tmp/btpu_test_cfg_" + std::to_string(getpid()) + "_" +
+                     std::to_string(counter++) + ".yaml";
+  std::ofstream f(path);
+  f << content;
+  return path;
+}
+}  // namespace
+
+BTEST(Yaml, ScalarsMapsListsNesting) {
+  auto r = yaml::parse(R"(
+# keystone config
+cluster_id: prod-cluster
+port: 9090
+ratio: 0.25
+enabled: true
+disabled: false
+empty_val:
+quoted: "hello: world"   # colon inside quotes
+nested:
+  inner:
+    deep: 42
+  other: x
+pools:
+  - id: pool-a
+    size: 1024
+  - id: pool-b
+    size: 2048
+tags:
+  - alpha
+  - beta
+)");
+  BT_ASSERT(r.ok());
+  const auto& root = *r.value();
+  BT_EXPECT_EQ(root.get("cluster_id")->str_or(""), "prod-cluster");
+  BT_EXPECT_EQ(root.get("port")->int_or(0), 9090);
+  BT_EXPECT_EQ(root.get("ratio")->double_or(0), 0.25);
+  BT_EXPECT(root.get("enabled")->bool_or(false));
+  BT_EXPECT(!root.get("disabled")->bool_or(true));
+  BT_EXPECT(root.get("empty_val")->is_null());
+  BT_EXPECT_EQ(root.get("quoted")->str_or(""), "hello: world");
+  BT_EXPECT_EQ(root.get_path("nested.inner.deep")->int_or(0), 42);
+  BT_EXPECT_EQ(root.get_path("nested.other")->str_or(""), "x");
+
+  auto pools = root.get("pools");
+  BT_ASSERT(pools && pools->is_list());
+  BT_ASSERT(pools->items().size() == 2);
+  BT_EXPECT_EQ(pools->items()[0]->get("id")->str_or(""), "pool-a");
+  BT_EXPECT_EQ(pools->items()[1]->get("size")->int_or(0), 2048);
+
+  auto tags = root.get("tags");
+  BT_ASSERT(tags && tags->is_list());
+  BT_ASSERT(tags->items().size() == 2);
+  BT_EXPECT_EQ(tags->items()[0]->str_or(""), "alpha");
+}
+
+BTEST(Yaml, RejectsMalformed) {
+  BT_EXPECT(!yaml::parse("key_without_colon").ok());
+  // a scalar "8080" is not an int when it has trailing junk
+  auto r = yaml::parse("port: 8080x");
+  BT_ASSERT(r.ok());
+  BT_EXPECT(!r.value()->get("port")->as_int().has_value());
+}
+
+BTEST(Yaml, ByteSizes) {
+  BT_EXPECT_EQ(yaml::parse_byte_size("1024").value_or(0), 1024ull);
+  BT_EXPECT_EQ(yaml::parse_byte_size("64MB").value_or(0), 64ull << 20);
+  BT_EXPECT_EQ(yaml::parse_byte_size("2GiB").value_or(0), 2ull << 30);
+  BT_EXPECT_EQ(yaml::parse_byte_size("1k").value_or(0), 1024ull);
+  BT_EXPECT(!yaml::parse_byte_size("MB").has_value());
+  BT_EXPECT(!yaml::parse_byte_size("12XB").has_value());
+}
+
+BTEST(Yaml, KeystoneConfigFromYaml) {
+  auto path = write_temp(R"(
+cluster_id: test_cluster
+listen_address: 127.0.0.1:9590
+http_metrics_port: 9591
+enable_gc: false
+eviction_ratio: 0.2
+high_watermark: 0.85
+gc_interval_sec: 5
+worker_heartbeat_ttl_sec: 7
+enable_repair: true
+)");
+  auto cfg = KeystoneConfig::from_yaml(path);
+  BT_EXPECT_EQ(cfg.cluster_id, "test_cluster");
+  BT_EXPECT_EQ(cfg.listen_address, "127.0.0.1:9590");
+  BT_EXPECT(!cfg.enable_gc);
+  BT_EXPECT_EQ(cfg.eviction_ratio, 0.2);
+  BT_EXPECT_EQ(cfg.high_watermark, 0.85);
+  BT_EXPECT_EQ(cfg.gc_interval_sec, 5);
+  BT_EXPECT_EQ(cfg.worker_heartbeat_ttl_sec, 7);
+  std::remove(path.c_str());
+}
+
+BTEST(Yaml, KeystoneConfigThrowsOnInvalid) {
+  auto path = write_temp("cluster_id: x\nhigh_watermark: 2.5\n");
+  bool threw = false;
+  try {
+    (void)KeystoneConfig::from_yaml(path);
+  } catch (const std::runtime_error&) {
+    threw = true;
+  }
+  BT_EXPECT(threw);
+  std::remove(path.c_str());
+
+  threw = false;
+  try {
+    (void)KeystoneConfig::from_yaml("/nonexistent/path.yaml");
+  } catch (const std::runtime_error&) {
+    threw = true;
+  }
+  BT_EXPECT(threw);
+}

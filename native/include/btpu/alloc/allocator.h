@@ -1,0 +1,81 @@
+// Cluster-wide placement allocator interface.
+//
+// Parity target: reference include/blackbird/allocation/allocator_interface.h
+// (IAllocator :64-109, AllocationRequest :27-42, AllocationResult :47-60,
+// AllocatorStats :15-22, AllocatorFactory :114-124).
+#pragma once
+
+#include <memory>
+#include <optional>
+#include <unordered_map>
+#include <vector>
+
+#include "btpu/common/types.h"
+
+namespace btpu::alloc {
+
+struct AllocatorStats {
+  uint64_t total_allocated_bytes{0};
+  uint64_t total_free_bytes{0};
+  uint64_t total_objects{0};
+  uint64_t total_shards{0};
+  double fragmentation_ratio{0.0};  // free-weighted mean of per-pool ratios
+  std::unordered_map<StorageClass, uint64_t> bytes_per_class;
+};
+
+struct AllocationRequest {
+  ObjectKey object_key;
+  uint64_t data_size{0};
+  size_t replication_factor{1};
+  size_t max_workers_per_copy{1};
+  std::vector<StorageClass> preferred_classes;
+  NodeId preferred_node;
+  bool enable_locality_awareness{true};
+
+  bool enable_striping{true};
+  bool prefer_contiguous{false};
+  uint64_t min_shard_size{4096};
+
+  // TPU extension: slice affinity. >=0 ranks same-slice pools first so
+  // copies ride ICI; cross-slice (DCN) pools are used only as spillover.
+  int32_t preferred_slice{-1};
+};
+
+struct AllocationResult {
+  std::vector<CopyPlacement> copies;
+  uint64_t total_shards_created{0};
+  uint64_t pools_used{0};
+  struct Stats {
+    uint64_t fragmentation_score{0};  // 0-100
+    bool required_spillover{false};   // used non-preferred storage classes
+    uint64_t avg_shard_size{0};
+  } stats;
+};
+
+using PoolMap = std::unordered_map<MemoryPoolId, MemoryPool>;
+
+class IAllocator {
+ public:
+  virtual ~IAllocator() = default;
+
+  virtual Result<AllocationResult> allocate(const AllocationRequest& request,
+                                            const PoolMap& pools) = 0;
+  virtual ErrorCode free(const ObjectKey& object_key) = 0;
+  virtual AllocatorStats get_stats(
+      std::optional<StorageClass> storage_class = std::nullopt) const = 0;
+  virtual uint64_t get_free_space(StorageClass storage_class) const = 0;
+  virtual bool can_allocate(const AllocationRequest& request,
+                            const PoolMap& pools) const = 0;
+  // Drops per-pool state for a pool that left the cluster (worker death).
+  // Objects still referencing it are repaired by keystone, not here.
+  virtual void forget_pool(const MemoryPoolId& pool_id) = 0;
+};
+
+class AllocatorFactory {
+ public:
+  enum class Strategy { RANGE_BASED, SLAB, HYBRID };
+  static std::unique_ptr<IAllocator> create(Strategy strategy);
+  static std::unique_ptr<IAllocator> create_range_based();
+};
+
+}  // namespace btpu::alloc

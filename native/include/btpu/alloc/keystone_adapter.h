@@ -1,0 +1,63 @@
+// Bridges keystone's per-object WorkerConfig to allocator AllocationRequests.
+//
+// Parity target: reference include/blackbird/allocation/keystone_allocator_adapter.h:15-76
+// and src/allocation/keystone_allocator_adapter.cpp:16-105 — striping is
+// enabled iff max_workers_per_copy > 1 (reference :80,99), all other policy
+// flows through unchanged.
+#pragma once
+
+#include <memory>
+
+#include "btpu/alloc/allocator.h"
+
+namespace btpu::alloc {
+
+class KeystoneAllocatorAdapter {
+ public:
+  explicit KeystoneAllocatorAdapter(std::unique_ptr<IAllocator> allocator)
+      : allocator_(std::move(allocator)) {}
+
+  Result<std::vector<CopyPlacement>> allocate_data_copies(const ObjectKey& key,
+                                                          uint64_t data_size,
+                                                          const WorkerConfig& config,
+                                                          const PoolMap& pools) {
+    auto result = allocator_->allocate(to_allocation_request(key, data_size, config), pools);
+    if (!result.ok()) return result.error();
+    return std::move(result).value().copies;
+  }
+
+  ErrorCode free_object(const ObjectKey& key) { return allocator_->free(key); }
+
+  AllocatorStats get_stats() const { return allocator_->get_stats(); }
+
+  bool can_allocate(const ObjectKey& key, uint64_t data_size, const WorkerConfig& config,
+                    const PoolMap& pools) const {
+    return allocator_->can_allocate(to_allocation_request(key, data_size, config), pools);
+  }
+
+  void forget_pool(const MemoryPoolId& pool_id) { allocator_->forget_pool(pool_id); }
+
+  static AllocationRequest to_allocation_request(const ObjectKey& key, uint64_t data_size,
+                                                 const WorkerConfig& config) {
+    AllocationRequest req;
+    req.object_key = key;
+    req.data_size = data_size;
+    req.replication_factor = config.replication_factor;
+    req.max_workers_per_copy = config.max_workers_per_copy;
+    req.preferred_classes = config.preferred_classes;
+    req.preferred_node = config.preferred_node;
+    req.enable_locality_awareness = config.enable_locality_awareness;
+    req.enable_striping = config.max_workers_per_copy > 1;
+    req.prefer_contiguous = config.prefer_contiguous;
+    req.min_shard_size = config.min_shard_size;
+    req.preferred_slice = config.preferred_slice;
+    return req;
+  }
+
+  IAllocator& allocator() { return *allocator_; }
+
+ private:
+  std::unique_ptr<IAllocator> allocator_;
+};
+
+}  // namespace btpu::alloc

@@ -1,0 +1,81 @@
+// Per-pool free-range allocator.
+//
+// Parity target: reference include/blackbird/allocation/range_allocator.h:36-69
+// and src/allocation/range_allocator.cpp:12-146 (PoolAllocator): a free-range
+// map offset->length with best-fit/first-fit carve, merge-on-free, and
+// conversion of ranges into absolute remote addresses. Two deliberate changes:
+//   * best-fit runs on a size-ordered secondary index (O(log n)) instead of
+//     the reference's linear map scan (range_allocator.cpp:133-146);
+//   * the region key comes from the pool's generic RemoteDescriptor rather
+//     than UCX-specific fields, and validation happens in the constructor
+//     (throws std::invalid_argument, matching reference ctor behavior).
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <optional>
+
+#include "btpu/common/types.h"
+
+namespace btpu::alloc {
+
+struct Range {
+  uint64_t offset{0};
+  uint64_t length{0};
+
+  uint64_t end() const noexcept { return offset + length; }
+  bool adjacent_to(const Range& o) const noexcept {
+    return end() == o.offset || o.end() == offset;
+  }
+  bool operator==(const Range&) const = default;
+};
+
+class PoolAllocator {
+ public:
+  // Validates the pool descriptor; throws std::invalid_argument when the pool
+  // has zero size, an unspecified transport, an empty endpoint, or a
+  // non-hex rkey (parity: reference PoolAllocator ctor + to_memory_location
+  // strict rkey validation, range_allocator.cpp:12-35,125-131).
+  explicit PoolAllocator(const MemoryPool& pool);
+
+  std::optional<Range> allocate(uint64_t size, bool prefer_best_fit = true);
+  void free(const Range& range);
+
+  uint64_t total_free() const;
+  uint64_t largest_free_block() const;
+  // 1 - largest_free_block/total_free; 0 when empty or unfragmented
+  // (parity: reference AllocatorStats fragmentation definition,
+  // allocator_interface.h:15-22).
+  double fragmentation_ratio() const;
+  bool can_allocate(uint64_t size) const;
+  size_t free_range_count() const;
+
+  const MemoryPoolId& pool_id() const noexcept { return pool_id_; }
+  StorageClass storage_class() const noexcept { return storage_class_; }
+  const NodeId& node_id() const noexcept { return node_id_; }
+  const TopoCoord& topo() const noexcept { return topo_; }
+  uint64_t pool_size() const noexcept { return pool_size_; }
+  const RemoteDescriptor& remote() const noexcept { return remote_; }
+
+  // Converts a carved range into the absolute remote location a client dials:
+  // remote_base + offset, with the region key parsed from rkey_hex.
+  MemoryLocation to_memory_location(const Range& range) const;
+
+ private:
+  MemoryPoolId pool_id_;
+  StorageClass storage_class_;
+  NodeId node_id_;
+  TopoCoord topo_;
+  RemoteDescriptor remote_;
+  uint64_t rkey_{0};
+  uint64_t pool_size_;
+
+  mutable std::mutex mutex_;
+  std::map<uint64_t, uint64_t> free_by_offset_;          // offset -> length
+  std::multimap<uint64_t, uint64_t> free_by_size_;       // length -> offset
+
+  void insert_free(uint64_t offset, uint64_t length);
+  void erase_free(std::map<uint64_t, uint64_t>::iterator it);
+};
+
+}  // namespace btpu::alloc

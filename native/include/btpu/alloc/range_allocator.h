@@ -1,0 +1,66 @@
+// Range-based striping allocator.
+//
+// Parity target: reference include/blackbird/allocation/range_allocator.h:74-131
+// and src/allocation/range_allocator.cpp:162-553. Behaviors preserved:
+//   * candidate selection filters by preferred node + storage class, sorts by
+//     available space, then searches worker count w from max down for
+//     per-pool feasibility (reference :421-486);
+//   * each copy stripes round-robin across w pools with the remainder spread
+//     one byte at a time (reference :307-341);
+//   * min-shard-size guard fails the allocation (reference :318-324);
+//   * any failure rolls back every range carved so far (reference :526-537);
+//   * committed ranges are tracked per object key for free() (reference
+//     :506-524); freeing an unknown key returns OBJECT_NOT_FOUND.
+// Changes from the reference:
+//   * can_allocate mirrors the real class filter instead of only crediting
+//     RAM_CPU-preferring requests (reference quirk, :269-283);
+//   * slice affinity: same-slice pools rank ahead of cross-slice ones when
+//     the request names a preferred slice (ICI before DCN);
+//   * forget_pool supports worker-death repair.
+#pragma once
+
+#include <shared_mutex>
+
+#include "btpu/alloc/allocator.h"
+#include "btpu/alloc/pool_allocator.h"
+
+namespace btpu::alloc {
+
+class RangeAllocator : public IAllocator {
+ public:
+  RangeAllocator() = default;
+  ~RangeAllocator() override = default;
+
+  Result<AllocationResult> allocate(const AllocationRequest& request,
+                                    const PoolMap& pools) override;
+  ErrorCode free(const ObjectKey& object_key) override;
+  AllocatorStats get_stats(std::optional<StorageClass> storage_class) const override;
+  uint64_t get_free_space(StorageClass storage_class) const override;
+  bool can_allocate(const AllocationRequest& request, const PoolMap& pools) const override;
+  void forget_pool(const MemoryPoolId& pool_id) override;
+
+ private:
+  mutable std::shared_mutex pools_mutex_;
+  std::unordered_map<MemoryPoolId, std::unique_ptr<PoolAllocator>> pool_allocators_;
+
+  struct ObjectAllocation {
+    std::vector<std::pair<MemoryPoolId, Range>> ranges;
+    uint64_t total_size{0};
+  };
+  mutable std::shared_mutex allocations_mutex_;
+  std::unordered_map<ObjectKey, ObjectAllocation> object_allocations_;
+
+  ErrorCode ensure_pool_allocator(const MemoryPool& pool);
+  std::vector<MemoryPoolId> select_candidate_pools(const AllocationRequest& request,
+                                                   const PoolMap& pools) const;
+  Result<AllocationResult> allocate_with_striping(const AllocationRequest& request,
+                                                  const std::vector<MemoryPoolId>& candidates,
+                                                  const PoolMap& pools);
+  ErrorCode commit_allocation(const ObjectKey& key,
+                              const std::vector<std::pair<MemoryPoolId, Range>>& ranges);
+  void rollback_allocation(const std::vector<std::pair<MemoryPoolId, Range>>& ranges);
+  Result<ShardPlacement> create_shard_placement(const MemoryPoolId& pool_id, const Range& range,
+                                                const PoolMap& pools) const;
+};
+
+}  // namespace btpu::alloc

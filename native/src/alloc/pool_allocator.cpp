@@ -1,0 +1,149 @@
+#include "btpu/alloc/pool_allocator.h"
+
+#include <cctype>
+#include <charconv>
+#include <stdexcept>
+
+#include "btpu/common/log.h"
+
+namespace btpu::alloc {
+
+namespace {
+bool parse_hex_u64(const std::string& hex, uint64_t& out) {
+  if (hex.empty() || hex.size() > 16) return false;
+  out = 0;
+  auto [p, ec] = std::from_chars(hex.data(), hex.data() + hex.size(), out, 16);
+  return ec == std::errc{} && p == hex.data() + hex.size();
+}
+}  // namespace
+
+PoolAllocator::PoolAllocator(const MemoryPool& pool)
+    : pool_id_(pool.id),
+      storage_class_(pool.storage_class),
+      node_id_(pool.node_id),
+      topo_(pool.topo),
+      remote_(pool.remote),
+      pool_size_(pool.size) {
+  if (pool.size == 0) throw std::invalid_argument("pool " + pool.id + " has zero size");
+  if (pool.remote.transport == TransportKind::TRANSPORT_UNSPECIFIED)
+    throw std::invalid_argument("pool " + pool.id + " has no transport");
+  if (pool.remote.endpoint.empty())
+    throw std::invalid_argument("pool " + pool.id + " has no endpoint");
+  if (!pool.remote.rkey_hex.empty() && !parse_hex_u64(pool.remote.rkey_hex, rkey_))
+    throw std::invalid_argument("pool " + pool.id + " has invalid rkey_hex '" +
+                                pool.remote.rkey_hex + "'");
+  insert_free(0, pool.size);
+}
+
+void PoolAllocator::insert_free(uint64_t offset, uint64_t length) {
+  free_by_offset_[offset] = length;
+  free_by_size_.emplace(length, offset);
+}
+
+void PoolAllocator::erase_free(std::map<uint64_t, uint64_t>::iterator it) {
+  auto [lo, hi] = free_by_size_.equal_range(it->second);
+  for (auto s = lo; s != hi; ++s) {
+    if (s->second == it->first) {
+      free_by_size_.erase(s);
+      break;
+    }
+  }
+  free_by_offset_.erase(it);
+}
+
+std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit) {
+  if (size == 0) return std::nullopt;
+  std::lock_guard<std::mutex> lock(mutex_);
+
+  std::map<uint64_t, uint64_t>::iterator chosen = free_by_offset_.end();
+  if (prefer_best_fit) {
+    // Smallest block that fits, via the size index.
+    auto s = free_by_size_.lower_bound(size);
+    if (s != free_by_size_.end()) chosen = free_by_offset_.find(s->second);
+  } else {
+    // Lowest-offset block that fits.
+    for (auto it = free_by_offset_.begin(); it != free_by_offset_.end(); ++it) {
+      if (it->second >= size) {
+        chosen = it;
+        break;
+      }
+    }
+  }
+  if (chosen == free_by_offset_.end()) return std::nullopt;
+
+  const uint64_t offset = chosen->first;
+  const uint64_t block_len = chosen->second;
+  erase_free(chosen);
+  if (block_len > size) insert_free(offset + size, block_len - size);
+
+  LOG_TRACE << "pool " << pool_id_ << " carved [" << offset << "," << offset + size << ")";
+  return Range{offset, size};
+}
+
+void PoolAllocator::free(const Range& range) {
+  if (range.length == 0) return;
+  std::lock_guard<std::mutex> lock(mutex_);
+
+  uint64_t offset = range.offset;
+  uint64_t length = range.length;
+
+  // Merge with right neighbor.
+  auto right = free_by_offset_.lower_bound(offset);
+  if (right != free_by_offset_.end() && right->first == offset + length) {
+    length += right->second;
+    erase_free(right);
+  }
+  // Merge with left neighbor.
+  auto left = free_by_offset_.lower_bound(offset);
+  if (left != free_by_offset_.begin()) {
+    --left;
+    if (left->first + left->second == offset) {
+      offset = left->first;
+      length += left->second;
+      erase_free(left);
+    }
+  }
+  insert_free(offset, length);
+}
+
+uint64_t PoolAllocator::total_free() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  uint64_t total = 0;
+  for (const auto& [off, len] : free_by_offset_) total += len;
+  return total;
+}
+
+uint64_t PoolAllocator::largest_free_block() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return free_by_size_.empty() ? 0 : free_by_size_.rbegin()->first;
+}
+
+double PoolAllocator::fragmentation_ratio() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  uint64_t total = 0;
+  for (const auto& [off, len] : free_by_offset_) total += len;
+  if (total == 0) return 0.0;
+  const uint64_t largest = free_by_size_.rbegin()->first;
+  return 1.0 - static_cast<double>(largest) / static_cast<double>(total);
+}
+
+bool PoolAllocator::can_allocate(uint64_t size) const {
+  if (size == 0) return false;
+  std::lock_guard<std::mutex> lock(mutex_);
+  return !free_by_size_.empty() && free_by_size_.rbegin()->first >= size;
+}
+
+size_t PoolAllocator::free_range_count() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return free_by_offset_.size();
+}
+
+MemoryLocation PoolAllocator::to_memory_location(const Range& range) const {
+  return MemoryLocation{
+      .remote_addr = remote_.remote_base + range.offset,
+      .rkey = rkey_,
+      .size = range.length,
+  };
+}
+
+}  // namespace btpu::alloc

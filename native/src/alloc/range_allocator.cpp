@@ -1,0 +1,373 @@
+#include "btpu/alloc/range_allocator.h"
+
+#include <algorithm>
+#include <numeric>
+
+#include "btpu/common/log.h"
+
+namespace btpu::alloc {
+
+namespace {
+uint64_t ceil_div(uint64_t a, uint64_t b) { return (a + b - 1) / b; }
+}  // namespace
+
+ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
+  std::unique_lock lock(pools_mutex_);
+  if (pool_allocators_.contains(pool.id)) return ErrorCode::OK;
+  try {
+    pool_allocators_[pool.id] = std::make_unique<PoolAllocator>(pool);
+    LOG_DEBUG << "created allocator for pool " << pool.id << " (" << pool.size << " bytes, "
+              << storage_class_name(pool.storage_class) << ")";
+    return ErrorCode::OK;
+  } catch (const std::invalid_argument& e) {
+    LOG_ERROR << "bad pool " << pool.id << ": " << e.what();
+    return ErrorCode::INVALID_PARAMETERS;
+  } catch (const std::exception& e) {
+    LOG_ERROR << "pool " << pool.id << ": " << e.what();
+    return ErrorCode::INTERNAL_ERROR;
+  }
+}
+
+// Candidate selection: filter by node + class preference, rank by (slice
+// affinity, available space), then search the largest worker count w such
+// that w pools can each hold ceil(total/w) bytes.
+std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
+    const AllocationRequest& request, const PoolMap& pools) const {
+  const bool has_class_pref = !request.preferred_classes.empty();
+  auto class_preferred = [&](StorageClass c) {
+    if (!has_class_pref) return true;
+    return std::find(request.preferred_classes.begin(), request.preferred_classes.end(), c) !=
+           request.preferred_classes.end();
+  };
+
+  std::vector<MemoryPoolId> preferred, fallback;
+  for (const auto& [id, pool] : pools) {
+    if (!request.preferred_node.empty() && pool.node_id != request.preferred_node) continue;
+    (class_preferred(pool.storage_class) ? preferred : fallback).push_back(id);
+  }
+
+  auto rank = [&](std::vector<MemoryPoolId>& v) {
+    std::sort(v.begin(), v.end(), [&](const MemoryPoolId& a, const MemoryPoolId& b) {
+      const MemoryPool& pa = pools.at(a);
+      const MemoryPool& pb = pools.at(b);
+      if (request.preferred_slice >= 0) {
+        const bool sa = pa.topo.slice_id == request.preferred_slice;
+        const bool sb = pb.topo.slice_id == request.preferred_slice;
+        if (sa != sb) return sa;  // same-slice (ICI-reachable) pools first
+      }
+      if (pa.available() != pb.available()) return pa.available() > pb.available();
+      return a < b;  // deterministic tie-break
+    });
+  };
+  rank(preferred);
+  rank(fallback);
+
+  const uint64_t total_bytes = request.data_size * request.replication_factor;
+  const size_t want = request.max_workers_per_copy * request.replication_factor;
+  const size_t max_w = std::min(want, preferred.size() + fallback.size());
+
+  for (size_t w = max_w; w >= 1; --w) {
+    const uint64_t per_pool = ceil_div(total_bytes, w);
+    std::vector<MemoryPoolId> selected;
+    selected.reserve(w);
+    for (const auto& id : preferred) {
+      if (selected.size() == w) break;
+      if (pools.at(id).available() >= per_pool) selected.push_back(id);
+    }
+    for (const auto& id : fallback) {
+      if (selected.size() == w) break;
+      if (pools.at(id).available() >= per_pool) selected.push_back(id);
+    }
+    if (selected.size() == w) return selected;
+    if (w == 1) break;
+  }
+  return {};
+}
+
+Result<AllocationResult> RangeAllocator::allocate(const AllocationRequest& request,
+                                                  const PoolMap& pools) {
+  if (request.data_size == 0) return ErrorCode::INVALID_PARAMETERS;
+  if (request.replication_factor == 0) return ErrorCode::INVALID_PARAMETERS;
+
+  for (const auto& [id, pool] : pools) {
+    BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
+  }
+
+  auto candidates = select_candidate_pools(request, pools);
+  if (candidates.empty()) {
+    LOG_WARN << "no eligible pools for object " << request.object_key << " ("
+             << request.data_size << "B x" << request.replication_factor << ")";
+    return ErrorCode::INSUFFICIENT_SPACE;
+  }
+
+  if (!request.enable_striping || request.prefer_contiguous) {
+    // Contiguous = striping degenerated to one worker per copy.
+    AllocationRequest contiguous = request;
+    contiguous.max_workers_per_copy = 1;
+    auto narrowed = select_candidate_pools(contiguous, pools);
+    if (narrowed.empty()) return ErrorCode::INSUFFICIENT_SPACE;
+    return allocate_with_striping(contiguous, narrowed, pools);
+  }
+  return allocate_with_striping(request, candidates, pools);
+}
+
+Result<AllocationResult> RangeAllocator::allocate_with_striping(
+    const AllocationRequest& request, const std::vector<MemoryPoolId>& candidates,
+    const PoolMap& pools) {
+  const uint64_t per_copy = request.data_size;
+  size_t workers_per_copy = std::min(request.max_workers_per_copy, candidates.size());
+
+  // With replication, trade stripe width for replica spread so copies land on
+  // disjoint pools when the pool count allows (reference :291-300).
+  if (request.replication_factor > 1 && candidates.size() > workers_per_copy) {
+    const size_t ideal = candidates.size() / request.replication_factor;
+    if (ideal >= 1) workers_per_copy = std::min(workers_per_copy, ideal);
+  }
+  // Respect min_shard_size up front: never stripe so wide that shards would
+  // fall below the floor (the reference detects this mid-carve and aborts the
+  // whole request, :318-324 — we clamp instead and only fail when even one
+  // worker per copy cannot fit).
+  if (workers_per_copy > 1 && per_copy / workers_per_copy < request.min_shard_size) {
+    workers_per_copy = std::max<size_t>(1, per_copy / std::max<uint64_t>(request.min_shard_size, 1));
+    workers_per_copy = std::min(workers_per_copy, candidates.size());
+  }
+
+  AllocationResult result{};
+  result.copies.reserve(request.replication_factor);
+  std::vector<std::pair<MemoryPoolId, Range>> all_ranges;
+
+  for (size_t copy_idx = 0; copy_idx < request.replication_factor; ++copy_idx) {
+    const uint64_t base_shard = per_copy / workers_per_copy;
+    const uint64_t remainder = per_copy % workers_per_copy;
+
+    CopyPlacement copy;
+    copy.copy_index = static_cast<uint32_t>(copy_idx);
+    copy.shards.reserve(workers_per_copy);
+
+    for (size_t widx = 0; widx < workers_per_copy; ++widx) {
+      const size_t pool_idx = (copy_idx * workers_per_copy + widx) % candidates.size();
+      const MemoryPoolId& pool_id = candidates[pool_idx];
+      const uint64_t shard_size = base_shard + (widx < remainder ? 1 : 0);
+
+      std::optional<Range> range;
+      {
+        std::shared_lock lock(pools_mutex_);
+        auto it = pool_allocators_.find(pool_id);
+        if (it == pool_allocators_.end()) {
+          rollback_allocation(all_ranges);
+          return ErrorCode::MEMORY_POOL_NOT_FOUND;
+        }
+        range = it->second->allocate(shard_size);
+      }
+      if (!range) {
+        rollback_allocation(all_ranges);
+        return ErrorCode::INSUFFICIENT_SPACE;
+      }
+      all_ranges.emplace_back(pool_id, *range);
+
+      auto shard = create_shard_placement(pool_id, *range, pools);
+      if (!shard.ok()) {
+        rollback_allocation(all_ranges);
+        return shard.error();
+      }
+      copy.shards.push_back(std::move(shard).value());
+    }
+    result.total_shards_created += copy.shards.size();
+    result.copies.push_back(std::move(copy));
+  }
+
+  if (auto ec = commit_allocation(request.object_key, all_ranges); ec != ErrorCode::OK) {
+    rollback_allocation(all_ranges);
+    return ec;
+  }
+
+  result.pools_used = candidates.size();
+  result.stats.avg_shard_size =
+      result.total_shards_created ? request.data_size * request.replication_factor /
+                                        result.total_shards_created
+                                  : 0;
+  if (!request.preferred_classes.empty()) {
+    for (const auto& copy : result.copies) {
+      for (const auto& shard : copy.shards) {
+        if (std::find(request.preferred_classes.begin(), request.preferred_classes.end(),
+                      shard.storage_class) == request.preferred_classes.end()) {
+          result.stats.required_spillover = true;
+        }
+      }
+    }
+  }
+  {
+    std::shared_lock lock(pools_mutex_);
+    double frag = 0.0;
+    size_t counted = 0;
+    for (const auto& id : candidates) {
+      auto it = pool_allocators_.find(id);
+      if (it != pool_allocators_.end()) {
+        frag += it->second->fragmentation_ratio();
+        ++counted;
+      }
+    }
+    result.stats.fragmentation_score =
+        counted ? static_cast<uint64_t>(100.0 * frag / static_cast<double>(counted)) : 0;
+  }
+  return result;
+}
+
+Result<ShardPlacement> RangeAllocator::create_shard_placement(const MemoryPoolId& pool_id,
+                                                              const Range& range,
+                                                              const PoolMap& pools) const {
+  auto pool_it = pools.find(pool_id);
+  if (pool_it == pools.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
+  const MemoryPool& pool = pool_it->second;
+
+  std::shared_lock lock(pools_mutex_);
+  auto alloc_it = pool_allocators_.find(pool_id);
+  if (alloc_it == pool_allocators_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
+
+  ShardPlacement shard;
+  shard.pool_id = pool_id;
+  shard.worker_id = pool.node_id;
+  shard.remote = pool.remote;
+  shard.storage_class = pool.storage_class;
+  shard.length = range.length;
+  if (pool.storage_class == StorageClass::HBM_TPU && pool.remote.transport == TransportKind::HBM) {
+    // On-device tier: clients address {device, region, offset} instead of a
+    // flat remote pointer.
+    shard.location = DeviceLocation{
+        .device_id = pool.remote.endpoint,
+        .region_id = pool.base_addr,
+        .offset = range.offset,
+        .size = range.length,
+    };
+  } else {
+    shard.location = alloc_it->second->to_memory_location(range);
+  }
+  return shard;
+}
+
+ErrorCode RangeAllocator::commit_allocation(
+    const ObjectKey& key, const std::vector<std::pair<MemoryPoolId, Range>>& ranges) {
+  std::unique_lock lock(allocations_mutex_);
+  if (object_allocations_.contains(key)) {
+    LOG_WARN << "object " << key << " already has an allocation";
+    return ErrorCode::OBJECT_ALREADY_EXISTS;
+  }
+  ObjectAllocation alloc;
+  alloc.ranges = ranges;
+  alloc.total_size = std::accumulate(
+      ranges.begin(), ranges.end(), uint64_t{0},
+      [](uint64_t sum, const auto& pr) { return sum + pr.second.length; });
+  object_allocations_[key] = std::move(alloc);
+  return ErrorCode::OK;
+}
+
+void RangeAllocator::rollback_allocation(
+    const std::vector<std::pair<MemoryPoolId, Range>>& ranges) {
+  std::shared_lock lock(pools_mutex_);
+  for (const auto& [pool_id, range] : ranges) {
+    auto it = pool_allocators_.find(pool_id);
+    if (it != pool_allocators_.end()) it->second->free(range);
+  }
+  if (!ranges.empty()) LOG_DEBUG << "rolled back " << ranges.size() << " ranges";
+}
+
+ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
+  std::unique_lock lock(allocations_mutex_);
+  auto it = object_allocations_.find(object_key);
+  if (it == object_allocations_.end()) {
+    LOG_DEBUG << "free of unknown object " << object_key;
+    return ErrorCode::OBJECT_NOT_FOUND;
+  }
+  {
+    std::shared_lock pools_lock(pools_mutex_);
+    for (const auto& [pool_id, range] : it->second.ranges) {
+      auto pa = pool_allocators_.find(pool_id);
+      if (pa != pool_allocators_.end()) pa->second->free(range);
+    }
+  }
+  LOG_DEBUG << "freed object " << object_key << " (" << it->second.total_size << " bytes, "
+            << it->second.ranges.size() << " ranges)";
+  object_allocations_.erase(it);
+  return ErrorCode::OK;
+}
+
+AllocatorStats RangeAllocator::get_stats(std::optional<StorageClass> storage_class) const {
+  std::shared_lock pools_lock(pools_mutex_);
+  std::shared_lock alloc_lock(allocations_mutex_);
+
+  AllocatorStats stats{};
+  for (const auto& [id, pa] : pool_allocators_) {
+    if (storage_class && pa->storage_class() != *storage_class) continue;
+    const uint64_t free_bytes = pa->total_free();
+    stats.total_free_bytes += free_bytes;
+    stats.bytes_per_class[pa->storage_class()] += free_bytes;
+  }
+  for (const auto& [key, alloc] : object_allocations_) {
+    stats.total_allocated_bytes += alloc.total_size;
+    stats.total_shards += alloc.ranges.size();
+    ++stats.total_objects;
+  }
+  // Free-weighted mean fragmentation across pools (reference :215-254).
+  if (stats.total_free_bytes > 0) {
+    double weighted = 0.0;
+    for (const auto& [id, pa] : pool_allocators_) {
+      if (storage_class && pa->storage_class() != *storage_class) continue;
+      const uint64_t pool_free = pa->total_free();
+      if (pool_free > 0) {
+        weighted += (static_cast<double>(pool_free) /
+                     static_cast<double>(stats.total_free_bytes)) *
+                    pa->fragmentation_ratio();
+      }
+    }
+    stats.fragmentation_ratio = weighted;
+  }
+  return stats;
+}
+
+uint64_t RangeAllocator::get_free_space(StorageClass storage_class) const {
+  std::shared_lock lock(pools_mutex_);
+  uint64_t total = 0;
+  for (const auto& [id, pa] : pool_allocators_) {
+    if (pa->storage_class() == storage_class) total += pa->total_free();
+  }
+  return total;
+}
+
+// Feasibility probe mirroring select_candidate_pools' class/node filter.
+// (The reference only credits requests preferring RAM_CPU — documented quirk
+// at range_allocator.cpp:269-283 — which we deliberately fix.)
+bool RangeAllocator::can_allocate(const AllocationRequest& request, const PoolMap& pools) const {
+  if (request.data_size == 0 || request.replication_factor == 0) return false;
+  const uint64_t needed = request.data_size * request.replication_factor;
+  uint64_t available = 0;
+  for (const auto& [id, pool] : pools) {
+    if (!request.preferred_node.empty() && pool.node_id != request.preferred_node) continue;
+    if (!request.preferred_classes.empty() &&
+        std::find(request.preferred_classes.begin(), request.preferred_classes.end(),
+                  pool.storage_class) == request.preferred_classes.end())
+      continue;
+    available += pool.available();
+  }
+  return available >= needed;
+}
+
+void RangeAllocator::forget_pool(const MemoryPoolId& pool_id) {
+  std::unique_lock lock(pools_mutex_);
+  pool_allocators_.erase(pool_id);
+}
+
+std::unique_ptr<IAllocator> AllocatorFactory::create(Strategy strategy) {
+  switch (strategy) {
+    case Strategy::RANGE_BASED:
+      return create_range_based();
+    default:
+      LOG_ERROR << "unsupported allocator strategy";
+      return nullptr;
+  }
+}
+
+std::unique_ptr<IAllocator> AllocatorFactory::create_range_based() {
+  return std::make_unique<RangeAllocator>();
+}
+
+}  // namespace btpu::alloc

@@ -1,0 +1,223 @@
+// PoolAllocator unit tests.
+// Behavior parity with reference tests/allocation/test_pool_allocator.cpp
+// (free-range init, exact alloc/free merge-back, split remainder, best-fit vs
+// first-fit, neighbor merges, fragmentation math, concurrency stress).
+#include <atomic>
+#include <random>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/alloc/pool_allocator.h"
+
+using namespace btpu;
+using namespace btpu::alloc;
+
+namespace {
+MemoryPool make_pool(const std::string& id = "pool-0", uint64_t size = 1 << 20,
+                     StorageClass cls = StorageClass::RAM_CPU) {
+  MemoryPool p;
+  p.id = id;
+  p.node_id = "node-0";
+  p.size = size;
+  p.storage_class = cls;
+  p.remote = {TransportKind::TCP, "127.0.0.1:7000", 0x10000000, "beef"};
+  return p;
+}
+}  // namespace
+
+BTEST(PoolAllocator, StartsWithOneFreeRangeCoveringPool) {
+  PoolAllocator pa(make_pool("p", 4096));
+  BT_EXPECT_EQ(pa.total_free(), 4096ull);
+  BT_EXPECT_EQ(pa.largest_free_block(), 4096ull);
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+  BT_EXPECT_EQ(pa.fragmentation_ratio(), 0.0);
+}
+
+BTEST(PoolAllocator, RejectsInvalidPoolDescriptors) {
+  auto expect_throw = [](MemoryPool p) {
+    bool threw = false;
+    try {
+      PoolAllocator pa(p);
+    } catch (const std::invalid_argument&) {
+      threw = true;
+    }
+    BT_EXPECT(threw);
+  };
+  auto zero = make_pool();
+  zero.size = 0;
+  expect_throw(zero);
+  auto no_transport = make_pool();
+  no_transport.remote.transport = TransportKind::TRANSPORT_UNSPECIFIED;
+  expect_throw(no_transport);
+  auto no_endpoint = make_pool();
+  no_endpoint.remote.endpoint = "";
+  expect_throw(no_endpoint);
+  auto bad_rkey = make_pool();
+  bad_rkey.remote.rkey_hex = "xyzzy";
+  expect_throw(bad_rkey);
+}
+
+BTEST(PoolAllocator, ExactAllocationConsumesWholeBlock) {
+  PoolAllocator pa(make_pool("p", 4096));
+  auto r = pa.allocate(4096);
+  BT_ASSERT(r.has_value());
+  BT_EXPECT_EQ(r->offset, 0ull);
+  BT_EXPECT_EQ(r->length, 4096ull);
+  BT_EXPECT_EQ(pa.total_free(), 0ull);
+  BT_EXPECT(!pa.allocate(1).has_value());
+  pa.free(*r);
+  BT_EXPECT_EQ(pa.total_free(), 4096ull);
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+}
+
+BTEST(PoolAllocator, SplitLeavesRemainder) {
+  PoolAllocator pa(make_pool("p", 4096));
+  auto r = pa.allocate(1000);
+  BT_ASSERT(r.has_value());
+  BT_EXPECT_EQ(pa.total_free(), 3096ull);
+  BT_EXPECT_EQ(pa.largest_free_block(), 3096ull);
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+}
+
+BTEST(PoolAllocator, ZeroSizeAllocationFails) {
+  PoolAllocator pa(make_pool());
+  BT_EXPECT(!pa.allocate(0).has_value());
+  BT_EXPECT(!pa.can_allocate(0));
+}
+
+BTEST(PoolAllocator, BestFitPicksSmallestSufficientHole) {
+  PoolAllocator pb(make_pool("pb", 10000));
+  auto r1 = pb.allocate(2000);  // [0,2000)
+  auto r2 = pb.allocate(500);   // [2000,2500) - separator
+  auto r3 = pb.allocate(3000);  // [2500,5500)
+  auto r4 = pb.allocate(500);   // [5500,6000) - separator
+  auto r5 = pb.allocate(4000);  // [6000,10000)
+  BT_ASSERT(r1 && r2 && r3 && r4 && r5);
+  pb.free(*r1);
+  pb.free(*r3);
+  pb.free(*r5);
+  // Holes now: 2000 @0, 3000 @2500, 4000 @6000. Best fit for 2500 -> @2500.
+  auto best = pb.allocate(2500, /*prefer_best_fit=*/true);
+  BT_ASSERT(best.has_value());
+  BT_EXPECT_EQ(best->offset, 2500ull);
+}
+
+BTEST(PoolAllocator, FirstFitPicksLowestOffsetHole) {
+  PoolAllocator pa(make_pool("p", 10000));
+  auto r1 = pa.allocate(3000);  // [0,3000)
+  auto r2 = pa.allocate(500);
+  auto r3 = pa.allocate(2000);  // [3500,5500)
+  BT_ASSERT(r1 && r2 && r3);
+  pa.free(*r1);
+  pa.free(*r3);
+  // Holes: 3000 @0, 2000 @3500, 4500 @5500. First fit for 1500 -> @0.
+  auto first = pa.allocate(1500, /*prefer_best_fit=*/false);
+  BT_ASSERT(first.has_value());
+  BT_EXPECT_EQ(first->offset, 0ull);
+}
+
+BTEST(PoolAllocator, FreeMergesWithLeftNeighbor) {
+  PoolAllocator pa(make_pool("p", 8192));
+  auto a = pa.allocate(1024);
+  auto b = pa.allocate(1024);
+  BT_ASSERT(a && b);
+  pa.free(*a);
+  BT_EXPECT_EQ(pa.free_range_count(), 2u);  // hole @0 + tail
+  pa.free(*b);                              // merges left into @0 and right into tail
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+  BT_EXPECT_EQ(pa.total_free(), 8192ull);
+}
+
+BTEST(PoolAllocator, FreeMergesWithRightNeighbor) {
+  PoolAllocator pa(make_pool("p", 8192));
+  auto a = pa.allocate(1024);
+  auto b = pa.allocate(1024);
+  BT_ASSERT(a && b);
+  pa.free(*b);  // adjacent to tail -> merge right
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+  BT_EXPECT_EQ(pa.largest_free_block(), 8192ull - 1024ull);
+  pa.free(*a);
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+  BT_EXPECT_EQ(pa.total_free(), 8192ull);
+}
+
+BTEST(PoolAllocator, FreeMergesBothSides) {
+  PoolAllocator pa(make_pool("p", 3 * 1024));
+  auto a = pa.allocate(1024);
+  auto b = pa.allocate(1024);
+  auto c = pa.allocate(1024);
+  BT_ASSERT(a && b && c);
+  BT_EXPECT_EQ(pa.total_free(), 0ull);
+  pa.free(*a);
+  pa.free(*c);
+  BT_EXPECT_EQ(pa.free_range_count(), 2u);
+  pa.free(*b);  // bridges both holes
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+  BT_EXPECT_EQ(pa.largest_free_block(), 3 * 1024ull);
+}
+
+BTEST(PoolAllocator, FragmentationMath) {
+  PoolAllocator pa(make_pool("p", 10000));
+  auto r1 = pa.allocate(2000);  // [0,2000)
+  auto r2 = pa.allocate(2000);  // [2000,4000)
+  auto r3 = pa.allocate(6000);  // [4000,10000)
+  BT_ASSERT(r1 && r2 && r3);
+  pa.free(*r1);  // hole 2000
+  pa.free(*r3);  // hole 6000
+  // total_free = 8000, largest = 6000 -> frag = 1 - 6000/8000 = 0.25
+  BT_EXPECT_EQ(pa.total_free(), 8000ull);
+  BT_EXPECT_EQ(pa.largest_free_block(), 6000ull);
+  BT_EXPECT(std::abs(pa.fragmentation_ratio() - 0.25) < 1e-9);
+  BT_EXPECT(pa.can_allocate(6000));
+  BT_EXPECT(!pa.can_allocate(6001));  // 8000 free but not contiguous
+}
+
+BTEST(PoolAllocator, ToMemoryLocationAddsBaseAndParsesRkey) {
+  auto pool = make_pool("p", 1 << 16);
+  pool.remote.remote_base = 0xAB000000;
+  pool.remote.rkey_hex = "1f2e";
+  PoolAllocator pa(pool);
+  auto r = pa.allocate(4096);
+  BT_ASSERT(r.has_value());
+  auto loc = pa.to_memory_location(*r);
+  BT_EXPECT_EQ(loc.remote_addr, 0xAB000000ull + r->offset);
+  BT_EXPECT_EQ(loc.rkey, 0x1f2eull);
+  BT_EXPECT_EQ(loc.size, 4096ull);
+}
+
+BTEST(PoolAllocator, ConcurrentAllocateFreeStress) {
+  // Parity with the reference's only concurrency test
+  // (test_pool_allocator.cpp:184): hammer allocate/free from many threads and
+  // verify conservation afterwards.
+  PoolAllocator pa(make_pool("p", 8 << 20));
+  constexpr int kThreads = 8;
+  constexpr int kIters = 500;
+  std::atomic<bool> failed{false};
+
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&pa, &failed, t] {
+      std::mt19937 rng(t);
+      std::vector<Range> held;
+      for (int i = 0; i < kIters; ++i) {
+        if (held.empty() || (rng() % 2 == 0)) {
+          uint64_t size = 64 + rng() % 4096;
+          auto r = pa.allocate(size);
+          if (r) {
+            if (r->length != size) failed = true;
+            held.push_back(*r);
+          }
+        } else {
+          size_t idx = rng() % held.size();
+          pa.free(held[idx]);
+          held.erase(held.begin() + idx);
+        }
+      }
+      for (const auto& r : held) pa.free(r);
+    });
+  }
+  for (auto& th : threads) th.join();
+  BT_EXPECT(!failed.load());
+  BT_EXPECT_EQ(pa.total_free(), uint64_t{8 << 20});
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);  // everything merged back
+}

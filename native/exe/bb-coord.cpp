@@ -1,0 +1,42 @@
+// bb-coord: standalone coordination service (the etcd role in the reference
+// deployment, scripts/start_cluster.sh launches etcd first — here the
+// framework ships its own).
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "btpu/common/log.h"
+#include "btpu/coord/coord_server.h"
+
+namespace {
+volatile std::sig_atomic_t g_stop = 0;
+void handle_signal(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  uint16_t port = 9290;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--host") && i + 1 < argc) host = argv[++i];
+    else if (!std::strcmp(argv[i], "--port") && i + 1 < argc) port = static_cast<uint16_t>(std::stoi(argv[++i]));
+    else if (!std::strcmp(argv[i], "--help")) {
+      std::printf("usage: bb-coord [--host H] [--port P]\n");
+      return 0;
+    }
+  }
+  btpu::coord::CoordServer server(host, port);
+  if (server.start() != btpu::ErrorCode::OK) {
+    std::fprintf(stderr, "bb-coord: failed to listen on %s:%u\n", host.c_str(), port);
+    return 1;
+  }
+  std::printf("bb-coord listening on %s\n", server.endpoint().c_str());
+  std::fflush(stdout);
+  std::signal(SIGINT, handle_signal);
+  std::signal(SIGTERM, handle_signal);
+  while (!g_stop) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+  server.stop();
+  return 0;
+}

@@ -1,0 +1,35 @@
+// Wire protocol between RemoteCoordinator and CoordServer.
+//
+// Two connections per client session:
+//   * call channel  — strict request/response, one frame each way;
+//   * event channel — client registers watches/campaigns, server pushes
+//     kEvent / kLeaderEvent frames asynchronously.
+// Response payloads start with ErrorCode (u32), then result fields.
+#pragma once
+
+#include <cstdint>
+
+namespace btpu::coord {
+
+enum class Op : uint8_t {
+  kGet = 1,
+  kPut = 2,
+  kPutTtl = 3,
+  kDel = 4,
+  kGetPrefix = 5,
+  kLeaseGrant = 6,
+  kLeaseKeepalive = 7,
+  kLeaseRevoke = 8,
+  kPutWithLease = 9,
+  kWatchPrefix = 10,   // event channel: {watch_id, prefix}
+  kUnwatch = 11,       // event channel: {watch_id}
+  kEvent = 12,         // server push: {watch_id, type u8, key, value}
+  kCampaign = 13,      // event channel: {election, candidate_id, ttl_ms}
+  kResign = 14,        // event channel: {election, candidate_id}
+  kLeaderEvent = 15,   // server push: {election, candidate_id, is_leader}
+  kCurrentLeader = 16, // call channel
+  kHello = 17,         // opens a channel: {u8 kind: 0=call, 1=event}
+  kPing = 18,
+};
+
+}  // namespace btpu::coord

@@ -1,0 +1,45 @@
+// TCP server exposing a MemCoordinator to remote processes (bb-coord).
+// Replaces the reference's external etcd dependency for multi-process
+// clusters while keeping the Coordinator interface etcd-shaped.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "btpu/coord/mem_coordinator.h"
+#include "btpu/net/net.h"
+
+namespace btpu::coord {
+
+class CoordServer {
+ public:
+  // host:port with port 0 = pick an ephemeral port (see port()).
+  CoordServer(std::string host, uint16_t port);
+  ~CoordServer();
+
+  ErrorCode start();
+  void stop();
+  uint16_t port() const noexcept { return port_; }
+  std::string endpoint() const { return host_ + ":" + std::to_string(port_); }
+  MemCoordinator& store() { return store_; }
+
+ private:
+  void accept_loop();
+  void serve_connection(std::shared_ptr<net::Socket> sock);
+
+  std::string host_;
+  uint16_t port_;
+  net::Socket listener_;
+  MemCoordinator store_;
+  std::thread accept_thread_;
+  std::atomic<bool> running_{false};
+
+  std::mutex conns_mutex_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<std::shared_ptr<net::Socket>> conns_;  // live sockets, for shutdown
+};
+
+}  // namespace btpu::coord

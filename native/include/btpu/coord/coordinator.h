@@ -1,0 +1,94 @@
+// Coordination service interface: KV + TTL leases + prefix watches + service
+// registry + leader election.
+//
+// Parity target: reference include/blackbird/etcd/etcd_service.h:30-246 /
+// src/etcd/etcd_service.cpp:60-408 (EtcdService over etcd-cpp-apiv3). etcd is
+// not available in this image, so the framework defines the interface and
+// ships two implementations:
+//   * MemCoordinator  — in-process store with real TTL expiry + watch events
+//     (the hermetic fake SURVEY.md §4 calls for);
+//   * RemoteCoordinator/CoordServer — the same store served over TCP for
+//     multi-process clusters (bb-coord executable).
+// Unlike the reference, leader election is implemented, not stubbed
+// (reference etcd_service.cpp:379-385 is a stub).
+#pragma once
+
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "btpu/common/result.h"
+#include "btpu/common/types.h"
+
+namespace btpu::coord {
+
+struct WatchEvent {
+  enum class Type { kPut, kDelete };
+  Type type;
+  std::string key;
+  std::string value;  // empty for deletes
+};
+
+using WatchCallback = std::function<void(const WatchEvent&)>;
+using WatchId = int64_t;
+
+struct KeyValue {
+  std::string key;
+  std::string value;
+};
+
+class Coordinator {
+ public:
+  virtual ~Coordinator() = default;
+
+  // --- KV ---
+  virtual Result<std::string> get(const std::string& key) = 0;
+  virtual ErrorCode put(const std::string& key, const std::string& value) = 0;
+  // Lease-per-call TTL put (reference etcd_service.cpp:130-157).
+  virtual ErrorCode put_with_ttl(const std::string& key, const std::string& value,
+                                 int64_t ttl_ms) = 0;
+  virtual ErrorCode del(const std::string& key) = 0;
+  virtual Result<std::vector<KeyValue>> get_with_prefix(const std::string& prefix) = 0;
+
+  // --- Leases ---
+  virtual Result<LeaseId> lease_grant(int64_t ttl_ms) = 0;
+  virtual ErrorCode lease_keepalive(LeaseId lease) = 0;
+  virtual ErrorCode lease_revoke(LeaseId lease) = 0;
+  virtual ErrorCode put_with_lease(const std::string& key, const std::string& value,
+                                   LeaseId lease) = 0;
+
+  // --- Watches ---
+  // Callback fires for every PUT/DELETE under prefix, including TTL expiry
+  // (delivered as kDelete — the availability path, reference
+  // keystone_service.cpp:728-751 relies on this).
+  virtual Result<WatchId> watch_prefix(const std::string& prefix, WatchCallback cb) = 0;
+  virtual ErrorCode unwatch(WatchId id) = 0;
+
+  // --- Service registry (reference etcd_service.cpp:339-377) ---
+  virtual ErrorCode register_service(const std::string& service_name, const std::string& id,
+                                     const std::string& address, int64_t ttl_ms) = 0;
+  virtual Result<std::vector<KeyValue>> discover_service(const std::string& service_name) = 0;
+  virtual ErrorCode unregister_service(const std::string& service_name, const std::string& id) = 0;
+
+  // --- Leader election ---
+  // First campaigner under `election` wins; on leader death/resign the next
+  // campaigner is promoted and its callback fires with is_leader=true.
+  virtual ErrorCode campaign(const std::string& election, const std::string& candidate_id,
+                             int64_t lease_ttl_ms, std::function<void(bool is_leader)> cb) = 0;
+  virtual ErrorCode resign(const std::string& election, const std::string& candidate_id) = 0;
+  virtual Result<std::string> current_leader(const std::string& election) = 0;
+
+  virtual bool connected() const = 0;
+};
+
+// Well-known key scheme (reference keystone_service.cpp:590-604).
+std::string workers_prefix(const std::string& cluster_id);
+std::string worker_key(const std::string& cluster_id, const std::string& worker_id);
+std::string pools_prefix(const std::string& cluster_id);
+std::string pool_key(const std::string& cluster_id, const std::string& worker_id,
+                     const std::string& pool_id);
+std::string heartbeat_prefix(const std::string& cluster_id);
+std::string heartbeat_key(const std::string& cluster_id, const std::string& worker_id);
+std::string services_prefix(const std::string& service_name);
+
+}  // namespace btpu::coord

@@ -1,0 +1,91 @@
+// In-process coordination store with real TTL expiry and watch delivery.
+// See coordinator.h for the interface contract.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "btpu/coord/coordinator.h"
+
+namespace btpu::coord {
+
+class MemCoordinator : public Coordinator {
+ public:
+  MemCoordinator();
+  ~MemCoordinator() override;
+
+  Result<std::string> get(const std::string& key) override;
+  ErrorCode put(const std::string& key, const std::string& value) override;
+  ErrorCode put_with_ttl(const std::string& key, const std::string& value,
+                         int64_t ttl_ms) override;
+  ErrorCode del(const std::string& key) override;
+  Result<std::vector<KeyValue>> get_with_prefix(const std::string& prefix) override;
+
+  Result<LeaseId> lease_grant(int64_t ttl_ms) override;
+  ErrorCode lease_keepalive(LeaseId lease) override;
+  ErrorCode lease_revoke(LeaseId lease) override;
+  ErrorCode put_with_lease(const std::string& key, const std::string& value,
+                           LeaseId lease) override;
+
+  Result<WatchId> watch_prefix(const std::string& prefix, WatchCallback cb) override;
+  ErrorCode unwatch(WatchId id) override;
+
+  ErrorCode register_service(const std::string& service_name, const std::string& id,
+                             const std::string& address, int64_t ttl_ms) override;
+  Result<std::vector<KeyValue>> discover_service(const std::string& service_name) override;
+  ErrorCode unregister_service(const std::string& service_name, const std::string& id) override;
+
+  ErrorCode campaign(const std::string& election, const std::string& candidate_id,
+                     int64_t lease_ttl_ms, std::function<void(bool)> cb) override;
+  ErrorCode resign(const std::string& election, const std::string& candidate_id) override;
+  Result<std::string> current_leader(const std::string& election) override;
+
+  bool connected() const override { return true; }
+
+ private:
+  using Clock = std::chrono::steady_clock;
+
+  struct Entry {
+    std::string value;
+    LeaseId lease{0};  // 0 = no lease
+  };
+  struct Lease {
+    int64_t ttl_ms{0};
+    Clock::time_point deadline;
+    std::vector<std::string> keys;
+  };
+  struct Watch {
+    WatchId id;
+    std::string prefix;
+    WatchCallback cb;
+  };
+  struct Candidate {
+    std::string id;
+    LeaseId lease;
+    std::function<void(bool)> cb;
+  };
+
+  void expiry_loop();
+  // Collects matching callbacks under the lock, invokes them outside it.
+  void notify(WatchEvent::Type type, const std::string& key, const std::string& value);
+  ErrorCode del_locked(const std::string& key, std::unique_lock<std::mutex>& lock);
+  void promote_next_locked(const std::string& election, std::unique_lock<std::mutex>& lock);
+
+  mutable std::mutex mutex_;
+  std::map<std::string, Entry> data_;  // ordered: prefix scans are ranges
+  std::unordered_map<LeaseId, Lease> leases_;
+  std::vector<Watch> watches_;
+  std::map<std::string, std::vector<Candidate>> elections_;  // front() = leader
+  std::atomic<LeaseId> next_lease_{1};
+  std::atomic<WatchId> next_watch_{1};
+
+  std::thread expiry_thread_;
+  std::condition_variable expiry_cv_;
+  bool stopping_{false};
+};
+
+}  // namespace btpu::coord

@@ -1,0 +1,86 @@
+// Coordinator client talking to a CoordServer over TCP.
+// See coordinator.h for the interface contract and coord_proto.h for framing.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "btpu/coord/coordinator.h"
+#include "btpu/net/net.h"
+
+namespace btpu::coord {
+
+class RemoteCoordinator : public Coordinator {
+ public:
+  // endpoint "host:port". connect() must succeed before other calls.
+  explicit RemoteCoordinator(std::string endpoint);
+  ~RemoteCoordinator() override;
+
+  ErrorCode connect();
+  void disconnect();
+
+  Result<std::string> get(const std::string& key) override;
+  ErrorCode put(const std::string& key, const std::string& value) override;
+  ErrorCode put_with_ttl(const std::string& key, const std::string& value,
+                         int64_t ttl_ms) override;
+  ErrorCode del(const std::string& key) override;
+  Result<std::vector<KeyValue>> get_with_prefix(const std::string& prefix) override;
+
+  Result<LeaseId> lease_grant(int64_t ttl_ms) override;
+  ErrorCode lease_keepalive(LeaseId lease) override;
+  ErrorCode lease_revoke(LeaseId lease) override;
+  ErrorCode put_with_lease(const std::string& key, const std::string& value,
+                           LeaseId lease) override;
+
+  Result<WatchId> watch_prefix(const std::string& prefix, WatchCallback cb) override;
+  ErrorCode unwatch(WatchId id) override;
+
+  ErrorCode register_service(const std::string& service_name, const std::string& id,
+                             const std::string& address, int64_t ttl_ms) override;
+  Result<std::vector<KeyValue>> discover_service(const std::string& service_name) override;
+  ErrorCode unregister_service(const std::string& service_name, const std::string& id) override;
+
+  ErrorCode campaign(const std::string& election, const std::string& candidate_id,
+                     int64_t lease_ttl_ms, std::function<void(bool)> cb) override;
+  ErrorCode resign(const std::string& election, const std::string& candidate_id) override;
+  Result<std::string> current_leader(const std::string& election) override;
+
+  bool connected() const override { return connected_.load(); }
+
+ private:
+  // Strict request/response on the call channel.
+  ErrorCode call(uint8_t opcode, const std::vector<uint8_t>& req, std::vector<uint8_t>& resp);
+  // Request/response on the event channel (responses interleave with pushes;
+  // the reader thread routes them back via a rendezvous).
+  ErrorCode event_call(uint8_t opcode, const std::vector<uint8_t>& req,
+                       std::vector<uint8_t>& resp);
+  void event_reader_loop();
+
+  std::string endpoint_;
+  std::atomic<bool> connected_{false};
+  std::atomic<bool> stopping_{false};
+
+  std::mutex call_mutex_;
+  net::Socket call_sock_;
+
+  std::mutex event_write_mutex_;
+  net::Socket event_sock_;
+  std::thread event_reader_;
+
+  // Rendezvous for event-channel responses.
+  std::mutex resp_mutex_;
+  std::condition_variable resp_cv_;
+  bool resp_ready_{false};
+  uint8_t resp_opcode_{0};
+  std::vector<uint8_t> resp_payload_;
+
+  std::mutex watch_mutex_;
+  std::unordered_map<int64_t, WatchCallback> watch_cbs_;
+  std::unordered_map<std::string, std::function<void(bool)>> leader_cbs_;  // election/candidate
+  std::atomic<int64_t> next_watch_{1};
+};
+
+}  // namespace btpu::coord

@@ -1,0 +1,72 @@
+// Low-level socket utilities shared by the coordination service, the keystone
+// RPC server, the metrics HTTP server, and the TCP data-plane transport.
+//
+// Role parity: the reference leans on etcd-cpp-apiv3 + YLT coro_rpc for these
+// layers; neither exists in this image, so the framework owns its sockets.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "btpu/common/result.h"
+
+namespace btpu::net {
+
+// RAII fd wrapper.
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  ~Socket();
+
+  int fd() const noexcept { return fd_; }
+  bool valid() const noexcept { return fd_ >= 0; }
+  void close();
+  // Wakes any thread blocked in read()/write() on this socket (close() alone
+  // does not unblock readers on Linux).
+  void shutdown();
+  int release() noexcept {
+    int f = fd_;
+    fd_ = -1;
+    return f;
+  }
+
+ private:
+  int fd_{-1};
+};
+
+struct HostPort {
+  std::string host;
+  uint16_t port{0};
+};
+std::optional<HostPort> parse_host_port(const std::string& endpoint);
+
+// Listening socket bound to host:port (port 0 = ephemeral). Returns the socket
+// and the actually bound port.
+Result<Socket> tcp_listen(const std::string& host, uint16_t port, uint16_t* bound_port);
+Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_ms = 5000);
+// Accept with optional timeout; CONNECTION_FAILED on error, OPERATION_TIMEOUT
+// when the poll expires.
+Result<Socket> tcp_accept(const Socket& listener, int timeout_ms = -1);
+
+ErrorCode read_exact(int fd, void* buf, size_t n);
+ErrorCode write_all(int fd, const void* buf, size_t n);
+// Scatter-gather write of header + payload without copying the payload.
+ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn);
+
+void set_nodelay(int fd);
+void set_keepalive(int fd);
+
+// Frame layout: [u32 payload_len][u8 opcode][payload]. Max 1 GiB payload.
+inline constexpr uint32_t kMaxFrameBytes = 1u << 30;
+
+ErrorCode send_frame(int fd, uint8_t opcode, const void* payload, size_t n);
+ErrorCode recv_frame(int fd, uint8_t& opcode, std::vector<uint8_t>& payload);
+
+}  // namespace btpu::net

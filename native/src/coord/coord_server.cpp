@@ -1,0 +1,284 @@
+#include "btpu/coord/coord_server.h"
+
+#include <unordered_map>
+
+#include "btpu/common/log.h"
+#include "btpu/common/wire.h"
+#include "btpu/coord/coord_proto.h"
+
+namespace btpu::coord {
+
+using wire::Reader;
+using wire::Writer;
+
+CoordServer::CoordServer(std::string host, uint16_t port)
+    : host_(std::move(host)), port_(port) {}
+
+CoordServer::~CoordServer() { stop(); }
+
+ErrorCode CoordServer::start() {
+  uint16_t bound = 0;
+  auto listener = net::tcp_listen(host_, port_, &bound);
+  if (!listener.ok()) return listener.error();
+  listener_ = std::move(listener).value();
+  port_ = bound;
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  LOG_INFO << "coord server listening on " << endpoint();
+  return ErrorCode::OK;
+}
+
+void CoordServer::stop() {
+  if (!running_.exchange(false)) return;
+  listener_.close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::vector<std::thread> threads;
+  {
+    std::lock_guard<std::mutex> lock(conns_mutex_);
+    threads.swap(conn_threads_);
+    // Wake connection threads blocked in recv so they can exit.
+    for (auto& s : conns_) s->shutdown();
+    conns_.clear();
+  }
+  for (auto& t : threads) {
+    if (t.joinable()) t.join();
+  }
+}
+
+void CoordServer::accept_loop() {
+  while (running_) {
+    auto sock = net::tcp_accept(listener_, 200);
+    if (!sock.ok()) {
+      if (sock.error() == ErrorCode::OPERATION_TIMEOUT) continue;
+      if (!running_) break;
+      continue;
+    }
+    auto conn = std::make_shared<net::Socket>(std::move(sock).value());
+    std::lock_guard<std::mutex> lock(conns_mutex_);
+    conns_.push_back(conn);
+    conn_threads_.emplace_back([this, conn] { serve_connection(conn); });
+  }
+}
+
+namespace {
+
+// Serializes pushes on the event channel (watch callbacks fire from the
+// expiry thread and from writer threads concurrently).
+struct EventChannel {
+  std::mutex mutex;
+  int fd;
+  bool alive{true};
+
+  void push(Op op, const std::vector<uint8_t>& payload) {
+    std::lock_guard<std::mutex> lock(mutex);
+    if (!alive) return;
+    if (net::send_frame(fd, static_cast<uint8_t>(op), payload.data(), payload.size()) !=
+        ErrorCode::OK) {
+      alive = false;
+    }
+  }
+};
+
+}  // namespace
+
+void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
+  const int fd = sock->fd();
+  uint8_t opcode = 0;
+  std::vector<uint8_t> payload;
+
+  // First frame must be kHello declaring the channel kind.
+  if (net::recv_frame(fd, opcode, payload) != ErrorCode::OK ||
+      static_cast<Op>(opcode) != Op::kHello || payload.size() != 1) {
+    return;
+  }
+  const bool is_event_channel = payload[0] == 1;
+  {
+    Writer w;
+    w.put(ErrorCode::OK);
+    net::send_frame(fd, opcode, w.buffer().data(), w.size());
+  }
+
+  auto channel = std::make_shared<EventChannel>();
+  channel->fd = fd;
+  // Per-connection registrations (cleaned up on disconnect).
+  std::unordered_map<int64_t, WatchId> watches;                  // client id -> store id
+  std::vector<std::pair<std::string, std::string>> campaigns;    // election, candidate
+
+  while (running_) {
+    if (net::recv_frame(fd, opcode, payload) != ErrorCode::OK) break;
+    Reader r(payload);
+    Writer w;
+
+    switch (static_cast<Op>(opcode)) {
+      case Op::kPing: {
+        w.put(ErrorCode::OK);
+        break;
+      }
+      case Op::kGet: {
+        std::string key;
+        if (!wire::decode(r, key)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        auto res = store_.get(key);
+        w.put(res.error() == ErrorCode::OK && res.ok() ? ErrorCode::OK : res.error());
+        if (res.ok()) wire::encode(w, res.value());
+        break;
+      }
+      case Op::kPut: {
+        std::string key, value;
+        if (!wire::decode_fields(r, key, value)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        w.put(store_.put(key, value));
+        break;
+      }
+      case Op::kPutTtl: {
+        std::string key, value;
+        int64_t ttl_ms = 0;
+        if (!wire::decode_fields(r, key, value, ttl_ms)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        w.put(store_.put_with_ttl(key, value, ttl_ms));
+        break;
+      }
+      case Op::kDel: {
+        std::string key;
+        if (!wire::decode(r, key)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        w.put(store_.del(key));
+        break;
+      }
+      case Op::kGetPrefix: {
+        std::string prefix;
+        if (!wire::decode(r, prefix)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        auto res = store_.get_with_prefix(prefix);
+        w.put(res.ok() ? ErrorCode::OK : res.error());
+        if (res.ok()) {
+          w.put<uint32_t>(static_cast<uint32_t>(res.value().size()));
+          for (const auto& kv : res.value()) {
+            wire::encode(w, kv.key);
+            wire::encode(w, kv.value);
+          }
+        }
+        break;
+      }
+      case Op::kLeaseGrant: {
+        int64_t ttl_ms = 0;
+        if (!wire::decode(r, ttl_ms)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        auto res = store_.lease_grant(ttl_ms);
+        w.put(res.ok() ? ErrorCode::OK : res.error());
+        if (res.ok()) w.put<int64_t>(res.value());
+        break;
+      }
+      case Op::kLeaseKeepalive: {
+        int64_t lease = 0;
+        if (!wire::decode(r, lease)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        w.put(store_.lease_keepalive(lease));
+        break;
+      }
+      case Op::kLeaseRevoke: {
+        int64_t lease = 0;
+        if (!wire::decode(r, lease)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        w.put(store_.lease_revoke(lease));
+        break;
+      }
+      case Op::kPutWithLease: {
+        std::string key, value;
+        int64_t lease = 0;
+        if (!wire::decode_fields(r, key, value, lease)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        w.put(store_.put_with_lease(key, value, lease));
+        break;
+      }
+      case Op::kCurrentLeader: {
+        std::string election;
+        if (!wire::decode(r, election)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        auto res = store_.current_leader(election);
+        w.put(res.ok() ? ErrorCode::OK : res.error());
+        if (res.ok()) wire::encode(w, res.value());
+        break;
+      }
+      case Op::kWatchPrefix: {
+        if (!is_event_channel) { w.put(ErrorCode::INVALID_STATE); break; }
+        int64_t client_watch_id = 0;
+        std::string prefix;
+        if (!wire::decode_fields(r, client_watch_id, prefix)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        auto res = store_.watch_prefix(prefix, [channel, client_watch_id](const WatchEvent& ev) {
+          Writer pw;
+          pw.put<int64_t>(client_watch_id);
+          pw.put<uint8_t>(ev.type == WatchEvent::Type::kPut ? 0 : 1);
+          wire::encode(pw, ev.key);
+          wire::encode(pw, ev.value);
+          channel->push(Op::kEvent, pw.buffer());
+        });
+        w.put(res.ok() ? ErrorCode::OK : res.error());
+        if (res.ok()) watches[client_watch_id] = res.value();
+        break;
+      }
+      case Op::kUnwatch: {
+        int64_t client_watch_id = 0;
+        if (!wire::decode(r, client_watch_id)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        auto it = watches.find(client_watch_id);
+        if (it == watches.end()) {
+          w.put(ErrorCode::COORD_WATCH_ERROR);
+        } else {
+          w.put(store_.unwatch(it->second));
+          watches.erase(it);
+        }
+        break;
+      }
+      case Op::kCampaign: {
+        if (!is_event_channel) { w.put(ErrorCode::INVALID_STATE); break; }
+        std::string election, candidate;
+        int64_t ttl_ms = 0;
+        if (!wire::decode_fields(r, election, candidate, ttl_ms)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        auto ec = store_.campaign(election, candidate, ttl_ms,
+                                  [channel, election, candidate](bool is_leader) {
+                                    Writer pw;
+                                    wire::encode(pw, election);
+                                    wire::encode(pw, candidate);
+                                    wire::encode(pw, is_leader);
+                                    channel->push(Op::kLeaderEvent, pw.buffer());
+                                  });
+        w.put(ec);
+        if (ec == ErrorCode::OK) campaigns.emplace_back(election, candidate);
+        break;
+      }
+      case Op::kResign: {
+        std::string election, candidate;
+        if (!wire::decode_fields(r, election, candidate)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        w.put(store_.resign(election, candidate));
+        std::erase(campaigns, std::make_pair(election, candidate));
+        break;
+      }
+      default:
+        w.put(ErrorCode::NOT_IMPLEMENTED);
+        break;
+    }
+
+    // Responses ride the same channel; on the event channel they interleave
+    // with pushes, serialized through the channel mutex.
+    std::lock_guard<std::mutex> lock(channel->mutex);
+    if (!channel->alive ||
+        net::send_frame(fd, opcode, w.buffer().data(), w.size()) != ErrorCode::OK) {
+      break;
+    }
+  }
+
+  // Session teardown: drop this connection's watches and candidacies.
+  {
+    std::lock_guard<std::mutex> lock(channel->mutex);
+    channel->alive = false;
+  }
+  for (const auto& [cid, sid] : watches) store_.unwatch(sid);
+  for (const auto& [election, candidate] : campaigns) store_.resign(election, candidate);
+}
+
+}  // namespace btpu::coord

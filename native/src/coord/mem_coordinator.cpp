@@ -1,0 +1,280 @@
+#include "btpu/coord/mem_coordinator.h"
+
+#include <algorithm>
+
+#include "btpu/common/log.h"
+
+namespace btpu::coord {
+
+// ---- key scheme -----------------------------------------------------------
+
+std::string workers_prefix(const std::string& c) { return "/btpu/clusters/" + c + "/workers/"; }
+std::string worker_key(const std::string& c, const std::string& w) {
+  return workers_prefix(c) + w;
+}
+std::string pools_prefix(const std::string& c) {
+  return "/btpu/clusters/" + c + "/memory_pools/";
+}
+std::string pool_key(const std::string& c, const std::string& w, const std::string& p) {
+  return pools_prefix(c) + w + "/" + p;
+}
+std::string heartbeat_prefix(const std::string& c) {
+  return "/btpu/clusters/" + c + "/heartbeat/";
+}
+std::string heartbeat_key(const std::string& c, const std::string& w) {
+  return heartbeat_prefix(c) + w;
+}
+std::string services_prefix(const std::string& s) { return "/btpu/services/" + s + "/"; }
+
+// ---- MemCoordinator -------------------------------------------------------
+
+MemCoordinator::MemCoordinator() {
+  expiry_thread_ = std::thread([this] { expiry_loop(); });
+}
+
+MemCoordinator::~MemCoordinator() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stopping_ = true;
+  }
+  expiry_cv_.notify_all();
+  if (expiry_thread_.joinable()) expiry_thread_.join();
+}
+
+void MemCoordinator::expiry_loop() {
+  std::unique_lock<std::mutex> lock(mutex_);
+  while (!stopping_) {
+    expiry_cv_.wait_for(lock, std::chrono::milliseconds(20));
+    if (stopping_) break;
+
+    const auto now = Clock::now();
+    std::vector<LeaseId> expired;
+    for (const auto& [id, lease] : leases_) {
+      if (lease.deadline <= now) expired.push_back(id);
+    }
+    for (LeaseId id : expired) {
+      auto it = leases_.find(id);
+      if (it == leases_.end()) continue;
+      auto keys = it->second.keys;
+      leases_.erase(it);
+      LOG_DEBUG << "lease " << id << " expired (" << keys.size() << " keys)";
+      for (const auto& key : keys) {
+        // del_locked unlocks while firing watch callbacks.
+        del_locked(key, lock);
+      }
+      // A leader whose lease expired loses the election.
+      for (auto& [election, candidates] : elections_) {
+        auto dead = std::find_if(candidates.begin(), candidates.end(),
+                                 [&](const Candidate& c) { return c.lease == id; });
+        if (dead != candidates.end()) {
+          const bool was_leader = dead == candidates.begin();
+          candidates.erase(dead);
+          if (was_leader) promote_next_locked(election, lock);
+        }
+      }
+    }
+  }
+}
+
+void MemCoordinator::notify(WatchEvent::Type type, const std::string& key,
+                            const std::string& value) {
+  std::vector<WatchCallback> to_call;
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    for (const auto& w : watches_) {
+      if (key.rfind(w.prefix, 0) == 0) to_call.push_back(w.cb);
+    }
+  }
+  WatchEvent ev{type, key, value};
+  for (auto& cb : to_call) cb(ev);
+}
+
+ErrorCode MemCoordinator::del_locked(const std::string& key, std::unique_lock<std::mutex>& lock) {
+  auto it = data_.find(key);
+  if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
+  data_.erase(it);
+  std::vector<WatchCallback> to_call;
+  for (const auto& w : watches_) {
+    if (key.rfind(w.prefix, 0) == 0) to_call.push_back(w.cb);
+  }
+  if (!to_call.empty()) {
+    lock.unlock();
+    WatchEvent ev{WatchEvent::Type::kDelete, key, ""};
+    for (auto& cb : to_call) cb(ev);
+    lock.lock();
+  }
+  return ErrorCode::OK;
+}
+
+Result<std::string> MemCoordinator::get(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = data_.find(key);
+  if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
+  return it->second.value;
+}
+
+ErrorCode MemCoordinator::put(const std::string& key, const std::string& value) {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    data_[key] = Entry{value, 0};
+  }
+  notify(WatchEvent::Type::kPut, key, value);
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::put_with_ttl(const std::string& key, const std::string& value,
+                                       int64_t ttl_ms) {
+  auto lease = lease_grant(ttl_ms);
+  if (!lease.ok()) return lease.error();
+  return put_with_lease(key, value, lease.value());
+}
+
+ErrorCode MemCoordinator::put_with_lease(const std::string& key, const std::string& value,
+                                         LeaseId lease) {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = leases_.find(lease);
+    if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
+    it->second.keys.push_back(key);
+    data_[key] = Entry{value, lease};
+  }
+  notify(WatchEvent::Type::kPut, key, value);
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::del(const std::string& key) {
+  std::unique_lock<std::mutex> lock(mutex_);
+  return del_locked(key, lock);
+}
+
+Result<std::vector<KeyValue>> MemCoordinator::get_with_prefix(const std::string& prefix) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  std::vector<KeyValue> out;
+  for (auto it = data_.lower_bound(prefix); it != data_.end(); ++it) {
+    if (it->first.rfind(prefix, 0) != 0) break;
+    out.push_back({it->first, it->second.value});
+  }
+  return out;
+}
+
+Result<LeaseId> MemCoordinator::lease_grant(int64_t ttl_ms) {
+  if (ttl_ms <= 0) return ErrorCode::INVALID_PARAMETERS;
+  std::lock_guard<std::mutex> lock(mutex_);
+  LeaseId id = next_lease_++;
+  leases_[id] = Lease{ttl_ms, Clock::now() + std::chrono::milliseconds(ttl_ms), {}};
+  return id;
+}
+
+ErrorCode MemCoordinator::lease_keepalive(LeaseId lease) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = leases_.find(lease);
+  if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
+  it->second.deadline = Clock::now() + std::chrono::milliseconds(it->second.ttl_ms);
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
+  std::unique_lock<std::mutex> lock(mutex_);
+  auto it = leases_.find(lease);
+  if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
+  auto keys = it->second.keys;
+  leases_.erase(it);
+  for (const auto& key : keys) del_locked(key, lock);
+  for (auto& [election, candidates] : elections_) {
+    auto dead = std::find_if(candidates.begin(), candidates.end(),
+                             [&](const Candidate& c) { return c.lease == lease; });
+    if (dead != candidates.end()) {
+      const bool was_leader = dead == candidates.begin();
+      candidates.erase(dead);
+      if (was_leader) promote_next_locked(election, lock);
+    }
+  }
+  return ErrorCode::OK;
+}
+
+Result<WatchId> MemCoordinator::watch_prefix(const std::string& prefix, WatchCallback cb) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  WatchId id = next_watch_++;
+  watches_.push_back({id, prefix, std::move(cb)});
+  return id;
+}
+
+ErrorCode MemCoordinator::unwatch(WatchId id) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = std::find_if(watches_.begin(), watches_.end(),
+                         [id](const Watch& w) { return w.id == id; });
+  if (it == watches_.end()) return ErrorCode::COORD_WATCH_ERROR;
+  watches_.erase(it);
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::register_service(const std::string& service_name, const std::string& id,
+                                           const std::string& address, int64_t ttl_ms) {
+  return put_with_ttl(services_prefix(service_name) + id, address, ttl_ms);
+}
+
+Result<std::vector<KeyValue>> MemCoordinator::discover_service(const std::string& service_name) {
+  return get_with_prefix(services_prefix(service_name));
+}
+
+ErrorCode MemCoordinator::unregister_service(const std::string& service_name,
+                                             const std::string& id) {
+  return del(services_prefix(service_name) + id);
+}
+
+void MemCoordinator::promote_next_locked(const std::string& election,
+                                         std::unique_lock<std::mutex>& lock) {
+  auto it = elections_.find(election);
+  if (it == elections_.end() || it->second.empty()) return;
+  auto cb = it->second.front().cb;
+  const std::string leader_id = it->second.front().id;
+  LOG_INFO << "election '" << election << "': " << leader_id << " is now leader";
+  if (cb) {
+    lock.unlock();
+    cb(true);
+    lock.lock();
+  }
+}
+
+ErrorCode MemCoordinator::campaign(const std::string& election, const std::string& candidate_id,
+                                   int64_t lease_ttl_ms, std::function<void(bool)> cb) {
+  auto lease = lease_grant(lease_ttl_ms);
+  if (!lease.ok()) return lease.error();
+  bool is_leader = false;
+  {
+    std::unique_lock<std::mutex> lock(mutex_);
+    auto& candidates = elections_[election];
+    if (std::any_of(candidates.begin(), candidates.end(),
+                    [&](const Candidate& c) { return c.id == candidate_id; }))
+      return ErrorCode::CLIENT_ALREADY_EXISTS;
+    candidates.push_back({candidate_id, lease.value(), cb});
+    is_leader = candidates.size() == 1;
+  }
+  if (cb) cb(is_leader);
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::resign(const std::string& election, const std::string& candidate_id) {
+  std::unique_lock<std::mutex> lock(mutex_);
+  auto it = elections_.find(election);
+  if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+  auto& candidates = it->second;
+  auto me = std::find_if(candidates.begin(), candidates.end(),
+                         [&](const Candidate& c) { return c.id == candidate_id; });
+  if (me == candidates.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+  const bool was_leader = me == candidates.begin();
+  const LeaseId lease = me->lease;
+  candidates.erase(me);
+  leases_.erase(lease);
+  if (was_leader) promote_next_locked(election, lock);
+  return ErrorCode::OK;
+}
+
+Result<std::string> MemCoordinator::current_leader(const std::string& election) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = elections_.find(election);
+  if (it == elections_.end() || it->second.empty()) return ErrorCode::COORD_KEY_NOT_FOUND;
+  return it->second.front().id;
+}
+
+}  // namespace btpu::coord

@@ -1,0 +1,347 @@
+#include "btpu/coord/remote_coordinator.h"
+
+#include "btpu/common/log.h"
+#include "btpu/common/wire.h"
+#include "btpu/coord/coord_proto.h"
+
+namespace btpu::coord {
+
+using wire::Reader;
+using wire::Writer;
+
+namespace {
+ErrorCode open_channel(const std::string& endpoint, uint8_t kind, net::Socket& out) {
+  auto hp = net::parse_host_port(endpoint);
+  if (!hp) return ErrorCode::INVALID_ADDRESS;
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  if (!sock.ok()) return sock.error();
+  out = std::move(sock).value();
+  uint8_t hello = kind;
+  BTPU_RETURN_IF_ERROR(
+      net::send_frame(out.fd(), static_cast<uint8_t>(Op::kHello), &hello, 1));
+  uint8_t opcode = 0;
+  std::vector<uint8_t> payload;
+  BTPU_RETURN_IF_ERROR(net::recv_frame(out.fd(), opcode, payload));
+  Reader r(payload);
+  ErrorCode ec{};
+  if (!r.get(ec)) return ErrorCode::RPC_FAILED;
+  return ec;
+}
+
+// Pulls the leading ErrorCode off a response payload.
+ErrorCode take_status(Reader& r) {
+  ErrorCode ec{};
+  if (!r.get(ec)) return ErrorCode::RPC_FAILED;
+  return ec;
+}
+}  // namespace
+
+RemoteCoordinator::RemoteCoordinator(std::string endpoint) : endpoint_(std::move(endpoint)) {}
+
+RemoteCoordinator::~RemoteCoordinator() { disconnect(); }
+
+ErrorCode RemoteCoordinator::connect() {
+  if (connected_) return ErrorCode::OK;
+  BTPU_RETURN_IF_ERROR(open_channel(endpoint_, 0, call_sock_));
+  BTPU_RETURN_IF_ERROR(open_channel(endpoint_, 1, event_sock_));
+  stopping_ = false;
+  connected_ = true;
+  event_reader_ = std::thread([this] { event_reader_loop(); });
+  LOG_DEBUG << "coordinator client connected to " << endpoint_;
+  return ErrorCode::OK;
+}
+
+void RemoteCoordinator::disconnect() {
+  if (!connected_.exchange(false)) return;
+  stopping_ = true;
+  call_sock_.shutdown();
+  event_sock_.shutdown();  // wakes the event reader blocked in recv
+  if (event_reader_.joinable()) event_reader_.join();
+  call_sock_.close();
+  event_sock_.close();
+}
+
+ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& req,
+                                  std::vector<uint8_t>& resp) {
+  if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
+  std::lock_guard<std::mutex> lock(call_mutex_);
+  BTPU_RETURN_IF_ERROR(net::send_frame(call_sock_.fd(), opcode, req.data(), req.size()));
+  uint8_t resp_op = 0;
+  BTPU_RETURN_IF_ERROR(net::recv_frame(call_sock_.fd(), resp_op, resp));
+  if (resp_op != opcode) return ErrorCode::RPC_FAILED;
+  return ErrorCode::OK;
+}
+
+ErrorCode RemoteCoordinator::event_call(uint8_t opcode, const std::vector<uint8_t>& req,
+                                        std::vector<uint8_t>& resp) {
+  if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
+  std::unique_lock<std::mutex> lock(event_write_mutex_);
+  {
+    std::lock_guard<std::mutex> rlock(resp_mutex_);
+    resp_ready_ = false;
+  }
+  BTPU_RETURN_IF_ERROR(net::send_frame(event_sock_.fd(), opcode, req.data(), req.size()));
+  std::unique_lock<std::mutex> rlock(resp_mutex_);
+  if (!resp_cv_.wait_for(rlock, std::chrono::seconds(10), [this] { return resp_ready_; }))
+    return ErrorCode::OPERATION_TIMEOUT;
+  if (resp_opcode_ != opcode) return ErrorCode::RPC_FAILED;
+  resp = std::move(resp_payload_);
+  return ErrorCode::OK;
+}
+
+void RemoteCoordinator::event_reader_loop() {
+  uint8_t opcode = 0;
+  std::vector<uint8_t> payload;
+  while (!stopping_) {
+    if (net::recv_frame(event_sock_.fd(), opcode, payload) != ErrorCode::OK) break;
+    const Op op = static_cast<Op>(opcode);
+    if (op == Op::kEvent) {
+      Reader r(payload);
+      int64_t watch_id = 0;
+      uint8_t type = 0;
+      std::string key, value;
+      if (!r.get(watch_id) || !r.get(type) || !wire::decode(r, key) || !wire::decode(r, value))
+        continue;
+      WatchCallback cb;
+      {
+        std::lock_guard<std::mutex> lock(watch_mutex_);
+        auto it = watch_cbs_.find(watch_id);
+        if (it != watch_cbs_.end()) cb = it->second;
+      }
+      if (cb) {
+        cb(WatchEvent{type == 0 ? WatchEvent::Type::kPut : WatchEvent::Type::kDelete, key,
+                      value});
+      }
+    } else if (op == Op::kLeaderEvent) {
+      Reader r(payload);
+      std::string election, candidate;
+      bool is_leader = false;
+      if (!wire::decode_fields(r, election, candidate, is_leader)) continue;
+      std::function<void(bool)> cb;
+      {
+        std::lock_guard<std::mutex> lock(watch_mutex_);
+        auto it = leader_cbs_.find(election + "/" + candidate);
+        if (it != leader_cbs_.end()) cb = it->second;
+      }
+      if (cb) cb(is_leader);
+    } else {
+      // Response to an event-channel request.
+      std::lock_guard<std::mutex> lock(resp_mutex_);
+      resp_opcode_ = opcode;
+      resp_payload_ = std::move(payload);
+      resp_ready_ = true;
+      resp_cv_.notify_one();
+    }
+  }
+}
+
+Result<std::string> RemoteCoordinator::get(const std::string& key) {
+  Writer w;
+  wire::encode(w, key);
+  std::vector<uint8_t> resp;
+  auto ec = call(static_cast<uint8_t>(Op::kGet), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  if (ec != ErrorCode::OK) return ec;
+  std::string value;
+  if (!wire::decode(r, value)) return ErrorCode::RPC_FAILED;
+  return value;
+}
+
+ErrorCode RemoteCoordinator::put(const std::string& key, const std::string& value) {
+  Writer w;
+  wire::encode_fields(w, key, value);
+  std::vector<uint8_t> resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kPut), w.buffer(), resp));
+  Reader r(resp);
+  return take_status(r);
+}
+
+ErrorCode RemoteCoordinator::put_with_ttl(const std::string& key, const std::string& value,
+                                          int64_t ttl_ms) {
+  Writer w;
+  wire::encode_fields(w, key, value, ttl_ms);
+  std::vector<uint8_t> resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kPutTtl), w.buffer(), resp));
+  Reader r(resp);
+  return take_status(r);
+}
+
+ErrorCode RemoteCoordinator::del(const std::string& key) {
+  Writer w;
+  wire::encode(w, key);
+  std::vector<uint8_t> resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kDel), w.buffer(), resp));
+  Reader r(resp);
+  return take_status(r);
+}
+
+Result<std::vector<KeyValue>> RemoteCoordinator::get_with_prefix(const std::string& prefix) {
+  Writer w;
+  wire::encode(w, prefix);
+  std::vector<uint8_t> resp;
+  auto ec = call(static_cast<uint8_t>(Op::kGetPrefix), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  if (ec != ErrorCode::OK) return ec;
+  uint32_t count = 0;
+  if (!r.get(count)) return ErrorCode::RPC_FAILED;
+  std::vector<KeyValue> out;
+  out.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    KeyValue kv;
+    if (!wire::decode(r, kv.key) || !wire::decode(r, kv.value)) return ErrorCode::RPC_FAILED;
+    out.push_back(std::move(kv));
+  }
+  return out;
+}
+
+Result<LeaseId> RemoteCoordinator::lease_grant(int64_t ttl_ms) {
+  Writer w;
+  w.put<int64_t>(ttl_ms);
+  std::vector<uint8_t> resp;
+  auto ec = call(static_cast<uint8_t>(Op::kLeaseGrant), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  if (ec != ErrorCode::OK) return ec;
+  int64_t lease = 0;
+  if (!r.get(lease)) return ErrorCode::RPC_FAILED;
+  return lease;
+}
+
+ErrorCode RemoteCoordinator::lease_keepalive(LeaseId lease) {
+  Writer w;
+  w.put<int64_t>(lease);
+  std::vector<uint8_t> resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kLeaseKeepalive), w.buffer(), resp));
+  Reader r(resp);
+  return take_status(r);
+}
+
+ErrorCode RemoteCoordinator::lease_revoke(LeaseId lease) {
+  Writer w;
+  w.put<int64_t>(lease);
+  std::vector<uint8_t> resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kLeaseRevoke), w.buffer(), resp));
+  Reader r(resp);
+  return take_status(r);
+}
+
+ErrorCode RemoteCoordinator::put_with_lease(const std::string& key, const std::string& value,
+                                            LeaseId lease) {
+  Writer w;
+  wire::encode_fields(w, key, value, lease);
+  std::vector<uint8_t> resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kPutWithLease), w.buffer(), resp));
+  Reader r(resp);
+  return take_status(r);
+}
+
+Result<WatchId> RemoteCoordinator::watch_prefix(const std::string& prefix, WatchCallback cb) {
+  const int64_t id = next_watch_++;
+  {
+    std::lock_guard<std::mutex> lock(watch_mutex_);
+    watch_cbs_[id] = std::move(cb);
+  }
+  Writer w;
+  w.put<int64_t>(id);
+  wire::encode(w, prefix);
+  std::vector<uint8_t> resp;
+  auto ec = event_call(static_cast<uint8_t>(Op::kWatchPrefix), w.buffer(), resp);
+  if (ec == ErrorCode::OK) {
+    Reader r(resp);
+    ec = take_status(r);
+  }
+  if (ec != ErrorCode::OK) {
+    std::lock_guard<std::mutex> lock(watch_mutex_);
+    watch_cbs_.erase(id);
+    return ec;
+  }
+  return static_cast<WatchId>(id);
+}
+
+ErrorCode RemoteCoordinator::unwatch(WatchId id) {
+  Writer w;
+  w.put<int64_t>(id);
+  std::vector<uint8_t> resp;
+  auto ec = event_call(static_cast<uint8_t>(Op::kUnwatch), w.buffer(), resp);
+  if (ec == ErrorCode::OK) {
+    Reader r(resp);
+    ec = take_status(r);
+  }
+  std::lock_guard<std::mutex> lock(watch_mutex_);
+  watch_cbs_.erase(id);
+  return ec;
+}
+
+ErrorCode RemoteCoordinator::register_service(const std::string& service_name,
+                                              const std::string& id, const std::string& address,
+                                              int64_t ttl_ms) {
+  return put_with_ttl(services_prefix(service_name) + id, address, ttl_ms);
+}
+
+Result<std::vector<KeyValue>> RemoteCoordinator::discover_service(
+    const std::string& service_name) {
+  return get_with_prefix(services_prefix(service_name));
+}
+
+ErrorCode RemoteCoordinator::unregister_service(const std::string& service_name,
+                                                const std::string& id) {
+  return del(services_prefix(service_name) + id);
+}
+
+ErrorCode RemoteCoordinator::campaign(const std::string& election,
+                                      const std::string& candidate_id, int64_t lease_ttl_ms,
+                                      std::function<void(bool)> cb) {
+  {
+    std::lock_guard<std::mutex> lock(watch_mutex_);
+    leader_cbs_[election + "/" + candidate_id] = std::move(cb);
+  }
+  Writer w;
+  wire::encode_fields(w, election, candidate_id, lease_ttl_ms);
+  std::vector<uint8_t> resp;
+  auto ec = event_call(static_cast<uint8_t>(Op::kCampaign), w.buffer(), resp);
+  if (ec == ErrorCode::OK) {
+    Reader r(resp);
+    ec = take_status(r);
+  }
+  if (ec != ErrorCode::OK) {
+    std::lock_guard<std::mutex> lock(watch_mutex_);
+    leader_cbs_.erase(election + "/" + candidate_id);
+  }
+  return ec;
+}
+
+ErrorCode RemoteCoordinator::resign(const std::string& election,
+                                    const std::string& candidate_id) {
+  Writer w;
+  wire::encode_fields(w, election, candidate_id);
+  std::vector<uint8_t> resp;
+  auto ec = event_call(static_cast<uint8_t>(Op::kResign), w.buffer(), resp);
+  if (ec == ErrorCode::OK) {
+    Reader r(resp);
+    ec = take_status(r);
+  }
+  std::lock_guard<std::mutex> lock(watch_mutex_);
+  leader_cbs_.erase(election + "/" + candidate_id);
+  return ec;
+}
+
+Result<std::string> RemoteCoordinator::current_leader(const std::string& election) {
+  Writer w;
+  wire::encode(w, election);
+  std::vector<uint8_t> resp;
+  auto ec = call(static_cast<uint8_t>(Op::kCurrentLeader), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  if (ec != ErrorCode::OK) return ec;
+  std::string leader;
+  if (!wire::decode(r, leader)) return ErrorCode::RPC_FAILED;
+  return leader;
+}
+
+}  // namespace btpu::coord

@@ -1,0 +1,203 @@
+#include "btpu/net/net.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "btpu/common/log.h"
+
+namespace btpu::net {
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+Socket::~Socket() { close(); }
+
+void Socket::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Socket::shutdown() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+std::optional<HostPort> parse_host_port(const std::string& endpoint) {
+  auto colon = endpoint.rfind(':');
+  if (colon == std::string::npos || colon == 0 || colon + 1 >= endpoint.size()) return std::nullopt;
+  HostPort hp;
+  hp.host = endpoint.substr(0, colon);
+  try {
+    int port = std::stoi(endpoint.substr(colon + 1));
+    if (port < 0 || port > 65535) return std::nullopt;
+    hp.port = static_cast<uint16_t>(port);
+  } catch (...) {
+    return std::nullopt;
+  }
+  return hp;
+}
+
+Result<Socket> tcp_listen(const std::string& host, uint16_t port, uint16_t* bound_port) {
+  Socket s(::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0));
+  if (!s.valid()) return ErrorCode::NETWORK_ERROR;
+  int one = 1;
+  ::setsockopt(s.fd(), SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (host.empty() || host == "0.0.0.0") {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    return ErrorCode::INVALID_ADDRESS;
+  }
+  if (::bind(s.fd(), reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    LOG_ERROR << "bind " << host << ":" << port << " failed: " << std::strerror(errno);
+    return ErrorCode::NETWORK_ERROR;
+  }
+  if (::listen(s.fd(), 128) != 0) return ErrorCode::NETWORK_ERROR;
+  if (bound_port) {
+    sockaddr_in actual{};
+    socklen_t len = sizeof(actual);
+    if (::getsockname(s.fd(), reinterpret_cast<sockaddr*>(&actual), &len) == 0)
+      *bound_port = ntohs(actual.sin_port);
+  }
+  return s;
+}
+
+Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_ms) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  const std::string port_str = std::to_string(port);
+  if (::getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res) != 0 || !res)
+    return ErrorCode::INVALID_ADDRESS;
+
+  Socket s(::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0));
+  if (!s.valid()) {
+    ::freeaddrinfo(res);
+    return ErrorCode::NETWORK_ERROR;
+  }
+  int rc = ::connect(s.fd(), res->ai_addr, res->ai_addrlen);
+  ::freeaddrinfo(res);
+  if (rc != 0) {
+    LOG_DEBUG << "connect " << host << ":" << port << " failed: " << std::strerror(errno);
+    return ErrorCode::CONNECTION_FAILED;
+  }
+  set_nodelay(s.fd());
+  return s;
+}
+
+Result<Socket> tcp_accept(const Socket& listener, int timeout_ms) {
+  if (timeout_ms >= 0) {
+    pollfd pfd{listener.fd(), POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms);
+    if (rc == 0) return ErrorCode::OPERATION_TIMEOUT;
+    if (rc < 0) return ErrorCode::NETWORK_ERROR;
+  }
+  int fd = ::accept4(listener.fd(), nullptr, nullptr, SOCK_CLOEXEC);
+  if (fd < 0) return ErrorCode::CONNECTION_FAILED;
+  set_nodelay(fd);
+  return Socket(fd);
+}
+
+ErrorCode read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t rc = ::read(fd, p, n);
+    if (rc == 0) return ErrorCode::CLIENT_DISCONNECTED;
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return ErrorCode::NETWORK_ERROR;
+    }
+    p += rc;
+    n -= static_cast<size_t>(rc);
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode write_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t rc = ::write(fd, p, n);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return ErrorCode::NETWORK_ERROR;
+    }
+    p += rc;
+    n -= static_cast<size_t>(rc);
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn) {
+  iovec iov[2] = {{const_cast<void*>(h), hn}, {const_cast<void*>(p), pn}};
+  size_t idx = 0;
+  while (idx < 2) {
+    ssize_t rc = ::writev(fd, &iov[idx], static_cast<int>(2 - idx));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return ErrorCode::NETWORK_ERROR;
+    }
+    auto remaining = static_cast<size_t>(rc);
+    while (idx < 2 && remaining >= iov[idx].iov_len) {
+      remaining -= iov[idx].iov_len;
+      ++idx;
+    }
+    if (idx < 2 && remaining > 0) {
+      iov[idx].iov_base = static_cast<uint8_t*>(iov[idx].iov_base) + remaining;
+      iov[idx].iov_len -= remaining;
+    }
+  }
+  return ErrorCode::OK;
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void set_keepalive(int fd) {
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+}
+
+ErrorCode send_frame(int fd, uint8_t opcode, const void* payload, size_t n) {
+  if (n > kMaxFrameBytes) return ErrorCode::BUFFER_OVERFLOW;
+  uint8_t header[5];
+  const uint32_t len = static_cast<uint32_t>(n);
+  std::memcpy(header, &len, 4);
+  header[4] = opcode;
+  return write_iov2(fd, header, sizeof(header), payload, n);
+}
+
+ErrorCode recv_frame(int fd, uint8_t& opcode, std::vector<uint8_t>& payload) {
+  uint8_t header[5];
+  BTPU_RETURN_IF_ERROR(read_exact(fd, header, sizeof(header)));
+  uint32_t len = 0;
+  std::memcpy(&len, header, 4);
+  opcode = header[4];
+  if (len > kMaxFrameBytes) return ErrorCode::BUFFER_OVERFLOW;
+  payload.resize(len);
+  if (len > 0) BTPU_RETURN_IF_ERROR(read_exact(fd, payload.data(), len));
+  return ErrorCode::OK;
+}
+
+}  // namespace btpu::net

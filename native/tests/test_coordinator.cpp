@@ -1,0 +1,218 @@
+// Coordination layer tests: KV/TTL/watch/leases/elections on the in-memory
+// store, and the same contract over TCP (CoordServer + RemoteCoordinator).
+// Parity notes: reference EtcdService covers KV/TTL/watch/registry
+// (etcd_service.cpp:60-408) but leaves leader election stubbed (:379-385) and
+// has no automated tests; here both are tested hermetically.
+#include <atomic>
+#include <chrono>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/coord/coord_server.h"
+#include "btpu/coord/mem_coordinator.h"
+#include "btpu/coord/remote_coordinator.h"
+
+using namespace btpu;
+using namespace btpu::coord;
+using namespace std::chrono_literals;
+
+namespace {
+// Polls until pred() or timeout; avoids sleeping fixed amounts.
+bool eventually(const std::function<bool()>& pred, int timeout_ms = 2000) {
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (pred()) return true;
+    std::this_thread::sleep_for(5ms);
+  }
+  return pred();
+}
+
+void run_kv_suite(Coordinator& c) {
+  // get/put/del
+  BT_EXPECT(!c.get("/a/b").ok());
+  BT_EXPECT(c.put("/a/b", "v1") == ErrorCode::OK);
+  auto got = c.get("/a/b");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value(), "v1");
+  BT_EXPECT(c.put("/a/b", "v2") == ErrorCode::OK);  // overwrite
+  BT_EXPECT_EQ(c.get("/a/b").value(), "v2");
+  BT_EXPECT(c.del("/a/b") == ErrorCode::OK);
+  BT_EXPECT(c.del("/a/b") == ErrorCode::COORD_KEY_NOT_FOUND);
+
+  // prefix scan is ordered and bounded
+  c.put("/p/1", "a");
+  c.put("/p/2", "b");
+  c.put("/p2/x", "c");
+  auto scan = c.get_with_prefix("/p/");
+  BT_ASSERT_OK(scan);
+  BT_ASSERT(scan.value().size() == 2);
+  BT_EXPECT_EQ(scan.value()[0].key, "/p/1");
+  BT_EXPECT_EQ(scan.value()[1].value, "b");
+}
+
+void run_ttl_watch_suite(Coordinator& c) {
+  std::atomic<int> puts{0}, deletes{0};
+  std::string last_deleted;
+  std::mutex m;
+  auto watch = c.watch_prefix("/hb/", [&](const WatchEvent& ev) {
+    std::lock_guard<std::mutex> lock(m);
+    if (ev.type == WatchEvent::Type::kPut) ++puts;
+    if (ev.type == WatchEvent::Type::kDelete) {
+      ++deletes;
+      last_deleted = ev.key;
+    }
+  });
+  BT_ASSERT_OK(watch);
+
+  BT_EXPECT(c.put_with_ttl("/hb/worker-1", "alive", 80) == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return puts.load() == 1; }));
+  // TTL expiry must surface as a DELETE event (the failure-detection path).
+  BT_EXPECT(eventually([&] { return deletes.load() == 1; }, 3000));
+  {
+    std::lock_guard<std::mutex> lock(m);
+    BT_EXPECT_EQ(last_deleted, "/hb/worker-1");
+  }
+  BT_EXPECT(!c.get("/hb/worker-1").ok());
+
+  // Keepalive extends a lease past its ttl.
+  auto lease = c.lease_grant(150);
+  BT_ASSERT_OK(lease);
+  BT_EXPECT(c.put_with_lease("/hb/worker-2", "alive", lease.value()) == ErrorCode::OK);
+  for (int i = 0; i < 6; ++i) {
+    std::this_thread::sleep_for(50ms);
+    BT_EXPECT(c.lease_keepalive(lease.value()) == ErrorCode::OK);
+  }
+  BT_EXPECT(c.get("/hb/worker-2").ok());  // survived 300ms with 150ms ttl
+  // Revoke deletes the key and fires the watch.
+  BT_EXPECT(c.lease_revoke(lease.value()) == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return deletes.load() == 2; }));
+  BT_EXPECT(c.lease_keepalive(lease.value()) == ErrorCode::COORD_LEASE_ERROR);
+
+  const int puts_before = puts.load();
+  BT_EXPECT(c.unwatch(watch.value()) == ErrorCode::OK);
+  c.put("/hb/worker-3", "x");
+  std::this_thread::sleep_for(30ms);
+  BT_EXPECT_EQ(puts.load(), puts_before);  // no events after unwatch
+}
+
+void run_registry_suite(Coordinator& c) {
+  BT_EXPECT(c.register_service("keystone", "ks-1", "10.0.0.1:9090", 60000) == ErrorCode::OK);
+  BT_EXPECT(c.register_service("keystone", "ks-2", "10.0.0.2:9090", 60000) == ErrorCode::OK);
+  auto found = c.discover_service("keystone");
+  BT_ASSERT_OK(found);
+  BT_EXPECT_EQ(found.value().size(), 2u);
+  BT_EXPECT(c.unregister_service("keystone", "ks-1") == ErrorCode::OK);
+  found = c.discover_service("keystone");
+  BT_ASSERT_OK(found);
+  BT_ASSERT(found.value().size() == 1);
+  BT_EXPECT_EQ(found.value()[0].value, "10.0.0.2:9090");
+}
+
+void run_election_suite(Coordinator& c) {
+  std::atomic<bool> a_leader{false}, b_leader{false};
+  BT_EXPECT(c.campaign("ks", "a", 60000, [&](bool l) { a_leader = l; }) == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return a_leader.load(); }));
+  BT_EXPECT(c.campaign("ks", "b", 60000, [&](bool l) { b_leader = l; }) == ErrorCode::OK);
+  std::this_thread::sleep_for(20ms);
+  BT_EXPECT(!b_leader.load());
+  auto leader = c.current_leader("ks");
+  BT_ASSERT_OK(leader);
+  BT_EXPECT_EQ(leader.value(), "a");
+  // Leader resigns -> b promoted and notified.
+  BT_EXPECT(c.resign("ks", "a") == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return b_leader.load(); }));
+  BT_EXPECT_EQ(c.current_leader("ks").value(), "b");
+  BT_EXPECT(c.resign("ks", "b") == ErrorCode::OK);
+  BT_EXPECT(!c.current_leader("ks").ok());
+}
+}  // namespace
+
+BTEST(MemCoordinator, KvOperations) {
+  MemCoordinator c;
+  run_kv_suite(c);
+}
+
+BTEST(MemCoordinator, TtlAndWatches) {
+  MemCoordinator c;
+  run_ttl_watch_suite(c);
+}
+
+BTEST(MemCoordinator, ServiceRegistry) {
+  MemCoordinator c;
+  run_registry_suite(c);
+}
+
+BTEST(MemCoordinator, LeaderElection) {
+  MemCoordinator c;
+  run_election_suite(c);
+}
+
+BTEST(MemCoordinator, LeaderLeaseExpiryPromotesNext) {
+  MemCoordinator c;
+  std::atomic<bool> b_leader{false};
+  BT_EXPECT(c.campaign("ks", "a", 100, nullptr) == ErrorCode::OK);
+  BT_EXPECT(c.campaign("ks", "b", 60000, [&](bool l) { b_leader = l; }) == ErrorCode::OK);
+  // a's lease dies silently (no keepalive) -> b becomes leader.
+  BT_EXPECT(eventually([&] { return b_leader.load(); }, 3000));
+  BT_EXPECT_EQ(c.current_leader("ks").value(), "b");
+}
+
+// --- the same contract over TCP ---
+
+namespace {
+struct RemoteFixture {
+  CoordServer server{"127.0.0.1", 0};
+  std::unique_ptr<RemoteCoordinator> client;
+
+  bool up() {
+    if (server.start() != ErrorCode::OK) return false;
+    client = std::make_unique<RemoteCoordinator>(server.endpoint());
+    return client->connect() == ErrorCode::OK;
+  }
+};
+}  // namespace
+
+BTEST(RemoteCoordinator, KvOperations) {
+  RemoteFixture f;
+  BT_ASSERT(f.up());
+  run_kv_suite(*f.client);
+}
+
+BTEST(RemoteCoordinator, TtlAndWatches) {
+  RemoteFixture f;
+  BT_ASSERT(f.up());
+  run_ttl_watch_suite(*f.client);
+}
+
+BTEST(RemoteCoordinator, ServiceRegistry) {
+  RemoteFixture f;
+  BT_ASSERT(f.up());
+  run_registry_suite(*f.client);
+}
+
+BTEST(RemoteCoordinator, LeaderElection) {
+  RemoteFixture f;
+  BT_ASSERT(f.up());
+  run_election_suite(*f.client);
+}
+
+BTEST(RemoteCoordinator, TwoClientsShareState) {
+  CoordServer server{"127.0.0.1", 0};
+  BT_ASSERT(server.start() == ErrorCode::OK);
+  RemoteCoordinator c1(server.endpoint()), c2(server.endpoint());
+  BT_ASSERT(c1.connect() == ErrorCode::OK);
+  BT_ASSERT(c2.connect() == ErrorCode::OK);
+
+  std::atomic<int> c2_events{0};
+  BT_ASSERT_OK(c2.watch_prefix("/shared/", [&](const WatchEvent&) { ++c2_events; }));
+  BT_EXPECT(c1.put("/shared/x", "from-c1") == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return c2_events.load() == 1; }));
+  BT_EXPECT_EQ(c2.get("/shared/x").value(), "from-c1");
+
+  // Disconnecting a campaigner client promotes the survivor (session cleanup).
+  std::atomic<bool> c2_leader{false};
+  BT_EXPECT(c1.campaign("ks", "one", 60000, nullptr) == ErrorCode::OK);
+  BT_EXPECT(c2.campaign("ks", "two", 60000, [&](bool l) { c2_leader = l; }) == ErrorCode::OK);
+  c1.disconnect();
+  BT_EXPECT(eventually([&] { return c2_leader.load(); }, 3000));
+}

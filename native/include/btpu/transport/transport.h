@@ -1,0 +1,75 @@
+// Pluggable one-sided data-plane transport.
+//
+// Parity target: reference include/blackbird/transport/ucx_engine.h:17-55 +
+// src/transport/ucx_engine.cpp (worker side: register_memory -> {remote_addr,
+// rkey} descriptor, listener) and the client-side UCX helpers inside
+// src/client/blackbird_client.cpp:128-202 (endpoint create, put/get, wait).
+// The reference hard-codes UCX in four places; here the transport is an
+// interface with three wire implementations:
+//   * LOCAL — same-process registry, memcpy (hermetic tests, embedded cluster)
+//   * TCP   — sockets; dev fallback and the DCN inter-slice path. The client
+//     side pools connections per endpoint, fixing the reference's
+//     per-transfer endpoint creation + busy-wait spin
+//     (blackbird_client.cpp:162-200, flagged in SURVEY §7 hard parts).
+//   * SHM   — POSIX shared memory for same-host zero-copy (the TPU-VM-local
+//     tier; clients map the worker's region and address it directly).
+// The HBM tier registers DeviceLocation regions served by the HBM provider
+// (see storage/hbm_backend.h) rather than flat remote addresses.
+//
+// Contract notes (mirrors UCX semantics the reference relies on):
+//   * register_region advertises {endpoint, remote_base, rkey}; placements
+//     embed absolute remote_addr = remote_base + allocator offset
+//     (reference range_allocator.cpp:125-131);
+//   * clients read/write with no per-op worker involvement;
+//   * every access is validated against the registered region bounds + rkey.
+#pragma once
+
+#include <memory>
+
+#include "btpu/common/types.h"
+
+namespace btpu::transport {
+
+// Worker side: owns registered regions and (for wire transports) a listener.
+class TransportServer {
+ public:
+  virtual ~TransportServer() = default;
+
+  virtual TransportKind kind() const noexcept = 0;
+  // Starts the listener (no-op for LOCAL/SHM). port 0 picks ephemeral.
+  virtual ErrorCode start(const std::string& host, uint16_t port) = 0;
+  virtual void stop() = 0;
+  // Registers [base, base+len) for one-sided remote access. `tag` names the
+  // region (pool id) — SHM uses it as the segment name.
+  virtual Result<RemoteDescriptor> register_region(void* base, uint64_t len,
+                                                   const std::string& tag) = 0;
+  virtual ErrorCode unregister_region(const RemoteDescriptor& desc) = 0;
+  // Transports whose regions must live in transport-owned memory (SHM
+  // segments) allocate it here; nullptr means caller-owned memory is fine
+  // and the caller should malloc/mmap itself, then register_region it.
+  virtual void* alloc_region(uint64_t len, const std::string& tag) {
+    (void)len;
+    (void)tag;
+    return nullptr;
+  }
+};
+
+// Client side: one-sided read/write against any advertised descriptor.
+// Thread-safe; concurrent calls proceed in parallel (pooled connections).
+class TransportClient {
+ public:
+  virtual ~TransportClient() = default;
+  virtual ErrorCode read(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
+                         void* dst, uint64_t len) = 0;
+  virtual ErrorCode write(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
+                          const void* src, uint64_t len) = 0;
+};
+
+// Factory: server for one kind; mux client that routes on descriptor kind.
+std::unique_ptr<TransportServer> make_transport_server(TransportKind kind);
+std::unique_ptr<TransportClient> make_transport_client();
+
+// Formats/parses rkey hex (shared by transports and allocator tests).
+std::string rkey_to_hex(uint64_t rkey);
+
+}  // namespace btpu::transport

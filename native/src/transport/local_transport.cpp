@@ -1,0 +1,109 @@
+// LOCAL transport: process-global region registry + memcpy. The hermetic
+// in-process fake SURVEY.md §4 calls for; also the embedded-cluster fast path.
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+
+#include "btpu/common/log.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::transport {
+
+namespace {
+
+struct LocalRegion {
+  uint8_t* base;
+  uint64_t len;
+  uint64_t remote_base;  // advertised == (uintptr_t)base
+};
+
+struct LocalRegistry {
+  std::mutex mutex;
+  std::unordered_map<uint64_t, LocalRegion> by_rkey;
+  std::mt19937_64 rng{0x6274707545ull};  // deterministic for debuggability
+
+  static LocalRegistry& instance() {
+    static LocalRegistry r;
+    return r;
+  }
+};
+
+class LocalTransportServer : public TransportServer {
+ public:
+  TransportKind kind() const noexcept override { return TransportKind::LOCAL; }
+
+  ErrorCode start(const std::string&, uint16_t) override { return ErrorCode::OK; }
+  void stop() override {
+    auto& reg = LocalRegistry::instance();
+    std::lock_guard<std::mutex> lock(reg.mutex);
+    for (uint64_t rkey : my_rkeys_) reg.by_rkey.erase(rkey);
+    my_rkeys_.clear();
+  }
+
+  Result<RemoteDescriptor> register_region(void* base, uint64_t len,
+                                           const std::string& tag) override {
+    if (!base || len == 0) return ErrorCode::INVALID_PARAMETERS;
+    auto& reg = LocalRegistry::instance();
+    std::lock_guard<std::mutex> lock(reg.mutex);
+    uint64_t rkey = reg.rng() | 1;  // nonzero
+    while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
+    const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
+    reg.by_rkey[rkey] = {static_cast<uint8_t*>(base), len, remote_base};
+    my_rkeys_.push_back(rkey);
+    RemoteDescriptor d;
+    d.transport = TransportKind::LOCAL;
+    d.endpoint = "local:" + tag;
+    d.remote_base = remote_base;
+    d.rkey_hex = rkey_to_hex(rkey);
+    return d;
+  }
+
+  ErrorCode unregister_region(const RemoteDescriptor& desc) override {
+    uint64_t rkey = 0;
+    try {
+      rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+    } catch (...) {
+      return ErrorCode::INVALID_PARAMETERS;
+    }
+    auto& reg = LocalRegistry::instance();
+    std::lock_guard<std::mutex> lock(reg.mutex);
+    reg.by_rkey.erase(rkey);
+    std::erase(my_rkeys_, rkey);
+    return ErrorCode::OK;
+  }
+
+ private:
+  std::vector<uint64_t> my_rkeys_;
+};
+
+}  // namespace
+
+// Bounds+rkey-checked access used by the mux client (local kind).
+ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
+                       bool is_write) {
+  auto& reg = LocalRegistry::instance();
+  uint8_t* target = nullptr;
+  {
+    std::lock_guard<std::mutex> lock(reg.mutex);
+    auto it = reg.by_rkey.find(rkey);
+    if (it == reg.by_rkey.end()) return ErrorCode::MEMORY_ACCESS_ERROR;
+    const LocalRegion& region = it->second;
+    if (remote_addr < region.remote_base || remote_addr + len > region.remote_base + region.len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    target = region.base + (remote_addr - region.remote_base);
+  }
+  if (is_write) {
+    std::memcpy(target, buf, len);
+  } else {
+    std::memcpy(buf, target, len);
+  }
+  return ErrorCode::OK;
+}
+
+std::unique_ptr<TransportServer> make_local_transport_server() {
+  return std::make_unique<LocalTransportServer>();
+}
+
+}  // namespace btpu::transport

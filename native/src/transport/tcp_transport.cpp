@@ -1,0 +1,285 @@
+// TCP transport: the dev-fallback + DCN inter-slice data plane.
+//
+// Wire format (fixed headers, no generic framing — this is the hot path):
+//   request:  u8 op (1=read, 2=write), u64 addr, u64 rkey, u64 len
+//             [+ len payload bytes for write]
+//   response: u32 status                        (write)
+//             u32 status [+ len payload bytes]  (read, len from request)
+// The worker side services requests against registered regions with bounds +
+// rkey validation; the client side keeps a per-endpoint connection pool so a
+// transfer costs zero connection setups in steady state (the reference paid
+// one UCX endpoint creation per transfer, blackbird_client.cpp:162-188).
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <unordered_map>
+
+#include "btpu/common/log.h"
+#include "btpu/net/net.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::transport {
+
+namespace {
+
+constexpr uint8_t kOpRead = 1;
+constexpr uint8_t kOpWrite = 2;
+
+#pragma pack(push, 1)
+struct DataRequestHeader {
+  uint8_t op;
+  uint64_t addr;
+  uint64_t rkey;
+  uint64_t len;
+};
+#pragma pack(pop)
+static_assert(sizeof(DataRequestHeader) == 25);
+
+struct Region {
+  uint8_t* base;
+  uint64_t len;
+  uint64_t remote_base;
+};
+
+class TcpTransportServer : public TransportServer {
+ public:
+  ~TcpTransportServer() override { stop(); }
+
+  TransportKind kind() const noexcept override { return TransportKind::TCP; }
+
+  ErrorCode start(const std::string& host, uint16_t port) override {
+    uint16_t bound = 0;
+    auto listener = net::tcp_listen(host, port, &bound);
+    if (!listener.ok()) return listener.error();
+    listener_ = std::move(listener).value();
+    host_ = (host.empty() || host == "0.0.0.0") ? "127.0.0.1" : host;
+    port_ = bound;
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    LOG_INFO << "tcp transport listening on " << host_ << ":" << port_;
+    return ErrorCode::OK;
+  }
+
+  void stop() override {
+    if (!running_.exchange(false)) return;
+    listener_.close();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> threads;
+    {
+      std::lock_guard<std::mutex> lock(conns_mutex_);
+      threads.swap(conn_threads_);
+      for (auto& s : conns_) s->shutdown();
+      conns_.clear();
+    }
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+  }
+
+  Result<RemoteDescriptor> register_region(void* base, uint64_t len,
+                                           const std::string& tag) override {
+    if (!base || len == 0) return ErrorCode::INVALID_PARAMETERS;
+    if (!running_) return ErrorCode::INVALID_STATE;
+    std::lock_guard<std::mutex> lock(regions_mutex_);
+    uint64_t rkey = rng_() | 1;
+    while (regions_.contains(rkey)) rkey = rng_() | 1;
+    const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
+    regions_[rkey] = {static_cast<uint8_t*>(base), len, remote_base};
+    RemoteDescriptor d;
+    d.transport = TransportKind::TCP;
+    d.endpoint = host_ + ":" + std::to_string(port_);
+    d.remote_base = remote_base;
+    d.rkey_hex = rkey_to_hex(rkey);
+    LOG_DEBUG << "registered tcp region " << tag << " rkey=" << d.rkey_hex << " len=" << len;
+    return d;
+  }
+
+  ErrorCode unregister_region(const RemoteDescriptor& desc) override {
+    uint64_t rkey = 0;
+    try {
+      rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+    } catch (...) {
+      return ErrorCode::INVALID_PARAMETERS;
+    }
+    std::lock_guard<std::mutex> lock(regions_mutex_);
+    return regions_.erase(rkey) ? ErrorCode::OK : ErrorCode::MEMORY_POOL_NOT_FOUND;
+  }
+
+ private:
+  void accept_loop() {
+    while (running_) {
+      auto sock = net::tcp_accept(listener_, 200);
+      if (!sock.ok()) continue;
+      auto conn = std::make_shared<net::Socket>(std::move(sock).value());
+      std::lock_guard<std::mutex> lock(conns_mutex_);
+      conns_.push_back(conn);
+      conn_threads_.emplace_back([this, conn] { serve(conn); });
+    }
+  }
+
+  // Resolves (addr, rkey, len) to a raw pointer, or nullptr on violation.
+  uint8_t* resolve(uint64_t addr, uint64_t rkey, uint64_t len) {
+    std::lock_guard<std::mutex> lock(regions_mutex_);
+    auto it = regions_.find(rkey);
+    if (it == regions_.end()) return nullptr;
+    const Region& region = it->second;
+    if (addr < region.remote_base || len > region.len ||
+        addr - region.remote_base > region.len - len)
+      return nullptr;
+    return region.base + (addr - region.remote_base);
+  }
+
+  void serve(std::shared_ptr<net::Socket> sock) {
+    const int fd = sock->fd();
+    DataRequestHeader hdr{};
+    while (running_) {
+      if (net::read_exact(fd, &hdr, sizeof(hdr)) != ErrorCode::OK) break;
+      if (hdr.op == kOpWrite) {
+        uint8_t* target = resolve(hdr.addr, hdr.rkey, hdr.len);
+        uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
+        if (!target) {
+          // Must still drain the payload to keep the stream aligned.
+          status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+          std::vector<uint8_t> sink(64 * 1024);
+          uint64_t left = hdr.len;
+          while (left > 0) {
+            const uint64_t chunk = std::min<uint64_t>(left, sink.size());
+            if (net::read_exact(fd, sink.data(), chunk) != ErrorCode::OK) return;
+            left -= chunk;
+          }
+        } else if (net::read_exact(fd, target, hdr.len) != ErrorCode::OK) {
+          return;  // bytes land directly in the registered region: zero copy
+        }
+        if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+      } else if (hdr.op == kOpRead) {
+        uint8_t* target = resolve(hdr.addr, hdr.rkey, hdr.len);
+        uint32_t status = static_cast<uint32_t>(
+            target ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR);
+        if (!target) {
+          if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+          continue;
+        }
+        // Header + region bytes in one gather write: zero copy out.
+        if (net::write_iov2(fd, &status, sizeof(status), target, hdr.len) != ErrorCode::OK)
+          return;
+      } else {
+        break;  // protocol violation
+      }
+    }
+  }
+
+  std::string host_;
+  uint16_t port_{0};
+  net::Socket listener_;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+
+  std::mutex conns_mutex_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<std::shared_ptr<net::Socket>> conns_;
+
+  std::mutex regions_mutex_;
+  std::unordered_map<uint64_t, Region> regions_;
+  std::mt19937_64 rng_{0x7463707265670aull};
+};
+
+}  // namespace
+
+// ---- client-side connection pool ------------------------------------------
+
+// One pooled connection per concurrent transfer per endpoint; connections are
+// created on demand and returned after use.
+class TcpEndpointPool {
+ public:
+  static TcpEndpointPool& instance() {
+    static TcpEndpointPool pool;
+    return pool;
+  }
+
+  Result<net::Socket> acquire(const std::string& endpoint) {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      auto& free_list = pools_[endpoint];
+      if (!free_list.empty()) {
+        net::Socket s = std::move(free_list.back());
+        free_list.pop_back();
+        return s;
+      }
+    }
+    auto hp = net::parse_host_port(endpoint);
+    if (!hp) return ErrorCode::INVALID_ADDRESS;
+    return net::tcp_connect(hp->host, hp->port);
+  }
+
+  void release(const std::string& endpoint, net::Socket sock) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto& free_list = pools_[endpoint];
+    if (free_list.size() < kMaxPooledPerEndpoint) free_list.push_back(std::move(sock));
+    // else: Socket dtor closes it
+  }
+
+  void drop_endpoint(const std::string& endpoint) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    pools_.erase(endpoint);
+  }
+
+ private:
+  static constexpr size_t kMaxPooledPerEndpoint = 16;
+  std::mutex mutex_;
+  std::unordered_map<std::string, std::vector<net::Socket>> pools_;
+};
+
+ErrorCode tcp_one_sided(const std::string& endpoint, uint8_t op, uint64_t addr, uint64_t rkey,
+                        void* buf, uint64_t len) {
+  auto sock = TcpEndpointPool::instance().acquire(endpoint);
+  if (!sock.ok()) return sock.error();
+  net::Socket s = std::move(sock).value();
+
+  DataRequestHeader hdr{op, addr, rkey, len};
+  ErrorCode ec;
+  if (op == kOpWrite) {
+    ec = net::write_iov2(s.fd(), &hdr, sizeof(hdr), buf, len);
+  } else {
+    ec = net::write_all(s.fd(), &hdr, sizeof(hdr));
+  }
+  if (ec != ErrorCode::OK) return ec;  // dead pooled conn: caller may retry
+
+  uint32_t status = 0;
+  if ((ec = net::read_exact(s.fd(), &status, sizeof(status))) != ErrorCode::OK) return ec;
+  if (static_cast<ErrorCode>(status) != ErrorCode::OK) {
+    TcpEndpointPool::instance().release(endpoint, std::move(s));
+    return static_cast<ErrorCode>(status);
+  }
+  if (op == kOpRead) {
+    if ((ec = net::read_exact(s.fd(), buf, len)) != ErrorCode::OK) return ec;
+  }
+  TcpEndpointPool::instance().release(endpoint, std::move(s));
+  return ErrorCode::OK;
+}
+
+ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
+                   uint64_t len) {
+  auto ec = tcp_one_sided(endpoint, kOpRead, addr, rkey, dst, len);
+  if (ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED) {
+    // A stale pooled connection (worker restarted): retry once on a fresh one.
+    TcpEndpointPool::instance().drop_endpoint(endpoint);
+    ec = tcp_one_sided(endpoint, kOpRead, addr, rkey, dst, len);
+  }
+  return ec;
+}
+
+ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
+                    uint64_t len) {
+  auto ec = tcp_one_sided(endpoint, kOpWrite, addr, rkey, const_cast<void*>(src), len);
+  if (ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED) {
+    TcpEndpointPool::instance().drop_endpoint(endpoint);
+    ec = tcp_one_sided(endpoint, kOpWrite, addr, rkey, const_cast<void*>(src), len);
+  }
+  return ec;
+}
+
+std::unique_ptr<TransportServer> make_tcp_transport_server() {
+  return std::make_unique<TcpTransportServer>();
+}
+
+}  // namespace btpu::transport

@@ -1,0 +1,78 @@
+// Transport factory + the mux client routing on descriptor kind.
+#include <charconv>
+#include <cstdio>
+
+#include "btpu/common/log.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::transport {
+
+// Implemented in the per-kind translation units.
+std::unique_ptr<TransportServer> make_local_transport_server();
+std::unique_ptr<TransportServer> make_tcp_transport_server();
+std::unique_ptr<TransportServer> make_shm_transport_server();
+ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
+                       bool is_write);
+ErrorCode shm_access(const std::string& name, uint64_t offset, void* buf, uint64_t len,
+                     bool is_write);
+ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
+                   uint64_t len);
+ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
+                    uint64_t len);
+
+std::string rkey_to_hex(uint64_t rkey) {
+  char buf[17];
+  std::snprintf(buf, sizeof(buf), "%llx", static_cast<unsigned long long>(rkey));
+  return buf;
+}
+
+std::unique_ptr<TransportServer> make_transport_server(TransportKind kind) {
+  switch (kind) {
+    case TransportKind::LOCAL: return make_local_transport_server();
+    case TransportKind::TCP: return make_tcp_transport_server();
+    case TransportKind::SHM: return make_shm_transport_server();
+    default:
+      LOG_ERROR << "no transport server for kind " << transport_kind_name(kind);
+      return nullptr;
+  }
+}
+
+namespace {
+
+class MuxTransportClient : public TransportClient {
+ public:
+  ErrorCode read(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey, void* dst,
+                 uint64_t len) override {
+    return access(remote, remote_addr, rkey, dst, len, /*is_write=*/false);
+  }
+
+  ErrorCode write(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
+                  const void* src, uint64_t len) override {
+    return access(remote, remote_addr, rkey, const_cast<void*>(src), len, /*is_write=*/true);
+  }
+
+ private:
+  static ErrorCode access(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                          void* buf, uint64_t len, bool is_write) {
+    if (len == 0) return ErrorCode::OK;
+    switch (remote.transport) {
+      case TransportKind::LOCAL:
+        return local_access(addr, rkey, buf, len, is_write);
+      case TransportKind::SHM:
+        return shm_access(remote.endpoint, addr, buf, len, is_write);
+      case TransportKind::TCP:
+        return is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len)
+                        : tcp_read(remote.endpoint, addr, rkey, buf, len);
+      default:
+        return ErrorCode::TRANSPORT_ERROR;
+    }
+  }
+};
+
+}  // namespace
+
+std::unique_ptr<TransportClient> make_transport_client() {
+  return std::make_unique<MuxTransportClient>();
+}
+
+}  // namespace btpu::transport

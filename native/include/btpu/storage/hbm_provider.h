@@ -1,0 +1,42 @@
+// HBM provider: the C ABI seam between the native worker and the device
+// runtime that actually owns TPU HBM.
+//
+// On real TPU VMs the provider is implemented by the Python/JAX layer
+// (blackbird_tpu/hbm.py registers ctypes callbacks: regions are device
+// buffers, read/write are host<->device transfers). Tests and CPU-only dev
+// use the built-in emulated provider (host memory). This mirrors the
+// north-star's "TPU-HBM allocator behind the same region-descriptor
+// contract" (BASELINE.json) without pretending libtpu exposes raw one-sided
+// DMA to third parties.
+//
+// All functions return 0 on success, nonzero on failure.
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+typedef struct BtpuHbmProviderV1 {
+  void* ctx;
+  // Allocates a device region of `size` bytes on `device_id` ("tpu:0").
+  int (*alloc_region)(void* ctx, const char* device_id, uint64_t size, uint64_t* out_region_id);
+  int (*free_region)(void* ctx, uint64_t region_id);
+  // Host -> device and device -> host byte transfers within a region.
+  int (*write)(void* ctx, uint64_t region_id, uint64_t offset, const void* src, uint64_t len);
+  int (*read)(void* ctx, uint64_t region_id, uint64_t offset, void* dst, uint64_t len);
+  // Bytes of free HBM remaining on the device (best effort; 0 = unknown).
+  uint64_t (*available)(void* ctx, const char* device_id);
+} BtpuHbmProviderV1;
+
+// Installs the process-wide provider (Python calls this through ctypes).
+// Passing NULL restores the built-in emulated provider.
+void btpu_register_hbm_provider(const BtpuHbmProviderV1* provider);
+
+}  // extern "C"
+
+namespace btpu::storage {
+// Returns the active provider (emulated one if none registered).
+const BtpuHbmProviderV1& hbm_provider();
+// True when the active provider is the built-in host-memory emulation.
+bool hbm_provider_is_emulated();
+}  // namespace btpu::storage

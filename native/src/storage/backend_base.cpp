@@ -1,0 +1,118 @@
+#include "backend_base.h"
+
+#include "btpu/common/log.h"
+
+namespace btpu::storage {
+
+ErrorCode OffsetBackendBase::init_allocator() {
+  if (config_.capacity == 0) return ErrorCode::INVALID_CONFIGURATION;
+  // The pool allocator needs a valid descriptor; offsets are all we use here,
+  // so feed it a synthetic local descriptor.
+  MemoryPool pool;
+  pool.id = config_.pool_id;
+  pool.node_id = config_.node_id;
+  pool.size = config_.capacity;
+  pool.storage_class = config_.storage_class;
+  pool.remote = {TransportKind::LOCAL, "backend:" + config_.pool_id, 0, ""};
+  try {
+    allocator_ = std::make_unique<alloc::PoolAllocator>(pool);
+  } catch (const std::exception& e) {
+    LOG_ERROR << "backend " << config_.pool_id << ": " << e.what();
+    return ErrorCode::INVALID_CONFIGURATION;
+  }
+  return ErrorCode::OK;
+}
+
+void OffsetBackendBase::sweep_expired_locked() {
+  const auto now = std::chrono::steady_clock::now();
+  for (auto it = reservations_.begin(); it != reservations_.end();) {
+    if (it->second.expires_at <= now) {
+      LOG_DEBUG << "backend " << config_.pool_id << ": reservation " << it->first
+                << " expired, reclaiming " << it->second.size << " bytes";
+      allocator_->free({it->second.offset, it->second.size});
+      it = reservations_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+Result<ReservationToken> OffsetBackendBase::reserve_shard(uint64_t size) {
+  if (!allocator_) return ErrorCode::INVALID_STATE;
+  if (size == 0) return ErrorCode::INVALID_PARAMETERS;
+  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  sweep_expired_locked();
+  auto range = allocator_->allocate(size);
+  if (!range) return ErrorCode::INSUFFICIENT_SPACE;
+  ReservationToken token;
+  token.id = next_token_++;
+  token.offset = range->offset;
+  token.size = range->length;
+  token.expires_at = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(config_.reservation_ttl_ms);
+  reservations_[token.id] = token;
+  ++total_reserves_;
+  return token;
+}
+
+ErrorCode OffsetBackendBase::commit_shard(const ReservationToken& token) {
+  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  auto it = reservations_.find(token.id);
+  if (it == reservations_.end()) return ErrorCode::INVALID_PARAMETERS;
+  if (it->second.expired()) {
+    // Expired-but-not-yet-swept: the space is still reserved, so reclaim it
+    // and refuse the commit (reference semantics: expired tokens are invalid).
+    allocator_->free({it->second.offset, it->second.size});
+    reservations_.erase(it);
+    return ErrorCode::OPERATION_TIMEOUT;
+  }
+  committed_[it->second.offset] = it->second.size;
+  reservations_.erase(it);
+  ++total_commits_;
+  return ErrorCode::OK;
+}
+
+ErrorCode OffsetBackendBase::abort_shard(const ReservationToken& token) {
+  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  auto it = reservations_.find(token.id);
+  if (it == reservations_.end()) return ErrorCode::INVALID_PARAMETERS;
+  allocator_->free({it->second.offset, it->second.size});
+  reservations_.erase(it);
+  ++total_aborts_;
+  return ErrorCode::OK;
+}
+
+ErrorCode OffsetBackendBase::free_shard(uint64_t offset, uint64_t size) {
+  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  auto it = committed_.find(offset);
+  if (it == committed_.end() || it->second != size) return ErrorCode::INVALID_PARAMETERS;
+  committed_.erase(it);
+  allocator_->free({offset, size});
+  ++total_frees_;
+  return ErrorCode::OK;
+}
+
+uint64_t OffsetBackendBase::used() const {
+  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  uint64_t total = 0;
+  for (const auto& [off, size] : committed_) total += size;
+  for (const auto& [id, token] : reservations_) total += token.size;
+  return total;
+}
+
+StorageStats OffsetBackendBase::stats() const {
+  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  StorageStats s;
+  s.capacity = config_.capacity;
+  for (const auto& [off, size] : committed_) s.used += size;
+  for (const auto& [id, token] : reservations_) s.reserved += token.size;
+  s.shard_count = committed_.size();
+  s.total_reserves = total_reserves_;
+  s.total_commits = total_commits_;
+  s.total_aborts = total_aborts_;
+  s.total_frees = total_frees_;
+  s.fragmentation = allocator_ ? allocator_->fragmentation_ratio() : 0.0;
+  return s;
+}
+
+}  // namespace btpu::storage

@@ -1,0 +1,48 @@
+// Shared reserve/commit/abort/free lifecycle over a PoolAllocator.
+// Internal header (src-local): tier backends derive and supply init/io.
+#pragma once
+
+#include <atomic>
+#include <map>
+#include <mutex>
+
+#include "btpu/alloc/pool_allocator.h"
+#include "btpu/storage/backend.h"
+
+namespace btpu::storage {
+
+class OffsetBackendBase : public StorageBackend {
+ public:
+  explicit OffsetBackendBase(BackendConfig config) : config_(std::move(config)) {}
+
+  Result<ReservationToken> reserve_shard(uint64_t size) override;
+  ErrorCode commit_shard(const ReservationToken& token) override;
+  ErrorCode abort_shard(const ReservationToken& token) override;
+  ErrorCode free_shard(uint64_t offset, uint64_t size) override;
+
+  uint64_t capacity() const override { return config_.capacity; }
+  uint64_t used() const override;
+  StorageStats stats() const override;
+  StorageClass storage_class() const override { return config_.storage_class; }
+  const std::string& pool_id() const override { return config_.pool_id; }
+
+ protected:
+  // Called by initialize() in subclasses once memory/files are ready.
+  ErrorCode init_allocator();
+  // Reclaims expired reservations (called opportunistically from reserve).
+  void sweep_expired_locked();
+
+  BackendConfig config_;
+  std::unique_ptr<alloc::PoolAllocator> allocator_;
+
+  mutable std::mutex lifecycle_mutex_;
+  std::map<uint64_t, ReservationToken> reservations_;     // token id -> token
+  std::map<uint64_t, uint64_t> committed_;                // offset -> size
+  std::atomic<uint64_t> next_token_{1};
+
+  // counters
+  std::atomic<uint64_t> total_reserves_{0}, total_commits_{0}, total_aborts_{0},
+      total_frees_{0};
+};
+
+}  // namespace btpu::storage

@@ -1,0 +1,163 @@
+// HBM_TPU tier: device memory behind the provider C ABI (hbm_provider.h).
+// Replaces the reference's broken RAM_GPU tier (worker_service.cpp:196) with
+// the BASELINE.json north-star arrangement: a TPU-HBM allocator exposing the
+// same region/offset contract as every other tier.
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+
+#include "backend_base.h"
+#include "btpu/common/log.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::storage {
+
+// ---- built-in emulated provider (host memory) -----------------------------
+
+namespace {
+
+struct EmulatedState {
+  std::mutex mutex;
+  std::unordered_map<uint64_t, std::pair<uint8_t*, uint64_t>> regions;
+  uint64_t next_id{1};
+
+  static EmulatedState& instance() {
+    static EmulatedState s;
+    return s;
+  }
+};
+
+int emu_alloc(void*, const char*, uint64_t size, uint64_t* out_id) {
+  auto* mem = static_cast<uint8_t*>(std::malloc(size));
+  if (!mem) return 1;
+  auto& st = EmulatedState::instance();
+  std::lock_guard<std::mutex> lock(st.mutex);
+  *out_id = st.next_id++;
+  st.regions[*out_id] = {mem, size};
+  return 0;
+}
+
+int emu_free(void*, uint64_t region_id) {
+  auto& st = EmulatedState::instance();
+  std::lock_guard<std::mutex> lock(st.mutex);
+  auto it = st.regions.find(region_id);
+  if (it == st.regions.end()) return 1;
+  std::free(it->second.first);
+  st.regions.erase(it);
+  return 0;
+}
+
+int emu_write(void*, uint64_t region_id, uint64_t offset, const void* src, uint64_t len) {
+  auto& st = EmulatedState::instance();
+  std::lock_guard<std::mutex> lock(st.mutex);
+  auto it = st.regions.find(region_id);
+  if (it == st.regions.end() || len > it->second.second || offset > it->second.second - len)
+    return 1;
+  std::memcpy(it->second.first + offset, src, len);
+  return 0;
+}
+
+int emu_read(void*, uint64_t region_id, uint64_t offset, void* dst, uint64_t len) {
+  auto& st = EmulatedState::instance();
+  std::lock_guard<std::mutex> lock(st.mutex);
+  auto it = st.regions.find(region_id);
+  if (it == st.regions.end() || len > it->second.second || offset > it->second.second - len)
+    return 1;
+  std::memcpy(dst, it->second.first + offset, len);
+  return 0;
+}
+
+uint64_t emu_available(void*, const char*) { return 0; }
+
+const BtpuHbmProviderV1 kEmulatedProvider = {
+    nullptr, emu_alloc, emu_free, emu_write, emu_read, emu_available,
+};
+
+std::mutex g_provider_mutex;
+BtpuHbmProviderV1 g_provider = kEmulatedProvider;
+bool g_provider_emulated = true;
+
+}  // namespace
+
+const BtpuHbmProviderV1& hbm_provider() {
+  std::lock_guard<std::mutex> lock(g_provider_mutex);
+  return g_provider;
+}
+
+bool hbm_provider_is_emulated() {
+  std::lock_guard<std::mutex> lock(g_provider_mutex);
+  return g_provider_emulated;
+}
+
+// ---- HbmBackend -----------------------------------------------------------
+
+class HbmBackend : public OffsetBackendBase {
+ public:
+  explicit HbmBackend(BackendConfig config) : OffsetBackendBase(std::move(config)) {}
+  ~HbmBackend() override { shutdown(); }
+
+  ErrorCode initialize() override {
+    const auto& provider = hbm_provider();
+    if (provider.alloc_region(provider.ctx, config_.device_id.c_str(), config_.capacity,
+                              &region_id_) != 0) {
+      LOG_ERROR << "hbm provider failed to allocate " << config_.capacity << " bytes on "
+                << config_.device_id;
+      return ErrorCode::OUT_OF_MEMORY;
+    }
+    active_ = true;
+    LOG_INFO << "hbm region " << region_id_ << " on " << config_.device_id << " ("
+             << config_.capacity << " bytes, "
+             << (hbm_provider_is_emulated() ? "emulated" : "device") << ")";
+    return init_allocator();
+  }
+
+  void shutdown() override {
+    if (active_) {
+      const auto& provider = hbm_provider();
+      provider.free_region(provider.ctx, region_id_);
+      active_ = false;
+    }
+  }
+
+  void* base_address() const override { return nullptr; }  // no host mapping
+  uint64_t region_id() const { return region_id_; }
+  const std::string& device_id() const { return config_.device_id; }
+
+  ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
+    if (!active_) return ErrorCode::INVALID_STATE;
+    const auto& provider = hbm_provider();
+    return provider.write(provider.ctx, region_id_, offset, src, len) == 0
+               ? ErrorCode::OK
+               : ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+
+  ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
+    if (!active_) return ErrorCode::INVALID_STATE;
+    const auto& provider = hbm_provider();
+    return provider.read(provider.ctx, region_id_, offset, dst, len) == 0
+               ? ErrorCode::OK
+               : ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+
+ private:
+  uint64_t region_id_{0};
+  bool active_{false};
+};
+
+std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config) {
+  return std::make_unique<HbmBackend>(config);
+}
+
+}  // namespace btpu::storage
+
+extern "C" void btpu_register_hbm_provider(const BtpuHbmProviderV1* provider) {
+  std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  if (provider) {
+    btpu::storage::g_provider = *provider;
+    btpu::storage::g_provider_emulated = false;
+  } else {
+    btpu::storage::g_provider = btpu::storage::kEmulatedProvider;
+    btpu::storage::g_provider_emulated = true;
+  }
+}

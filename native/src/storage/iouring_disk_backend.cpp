@@ -1,0 +1,313 @@
+// io_uring disk tier (NVME/SSD): kernel-async reads/writes on one backing
+// file, raw io_uring syscalls (liburing is not in this image).
+//
+// Parity target: reference src/worker/storage/iouring_disk_backend.cpp.
+// Deliberate change: one pre-sized backing file with allocator offsets
+// instead of the reference's file-per-shard scheme (iouring_disk_backend.cpp
+// :326-343 synthesized fake remote addrs from path hashes and created files
+// synchronously anyway) — a flat file keeps the same placement math as every
+// other tier and avoids per-shard metadata ops on the hot path.
+// O_DIRECT (default for NVME) bypasses page cache; unaligned edges go
+// through a bounce buffer. Falls back to pread/pwrite when io_uring is
+// unavailable (e.g. sandboxed kernels).
+#include <fcntl.h>
+#include <linux/io_uring.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstring>
+#include <filesystem>
+#include <mutex>
+
+#include "backend_base.h"
+#include "btpu/common/log.h"
+
+namespace btpu::storage {
+
+namespace {
+
+int io_uring_setup(unsigned entries, io_uring_params* p) {
+  return static_cast<int>(::syscall(__NR_io_uring_setup, entries, p));
+}
+int io_uring_enter(int ring_fd, unsigned to_submit, unsigned min_complete, unsigned flags) {
+  return static_cast<int>(
+      ::syscall(__NR_io_uring_enter, ring_fd, to_submit, min_complete, flags, nullptr, 0));
+}
+
+// Minimal single-issuer ring: submit one SQE, wait for its CQE.
+class MiniRing {
+ public:
+  ~MiniRing() { close_ring(); }
+
+  bool init(unsigned entries = 32) {
+    io_uring_params params{};
+    ring_fd_ = io_uring_setup(entries, &params);
+    if (ring_fd_ < 0) return false;
+
+    sq_ring_sz_ = params.sq_off.array + params.sq_entries * sizeof(unsigned);
+    cq_ring_sz_ = params.cq_off.cqes + params.cq_entries * sizeof(io_uring_cqe);
+    sq_ring_ = ::mmap(nullptr, sq_ring_sz_, PROT_READ | PROT_WRITE, MAP_SHARED | MAP_POPULATE,
+                      ring_fd_, IORING_OFF_SQ_RING);
+    cq_ring_ = ::mmap(nullptr, cq_ring_sz_, PROT_READ | PROT_WRITE, MAP_SHARED | MAP_POPULATE,
+                      ring_fd_, IORING_OFF_CQ_RING);
+    sqes_sz_ = params.sq_entries * sizeof(io_uring_sqe);
+    sqes_ = static_cast<io_uring_sqe*>(::mmap(nullptr, sqes_sz_, PROT_READ | PROT_WRITE,
+                                              MAP_SHARED | MAP_POPULATE, ring_fd_,
+                                              IORING_OFF_SQES));
+    if (sq_ring_ == MAP_FAILED || cq_ring_ == MAP_FAILED || sqes_ == MAP_FAILED) {
+      close_ring();
+      return false;
+    }
+    auto* sq = static_cast<uint8_t*>(sq_ring_);
+    sq_head_ = reinterpret_cast<std::atomic<unsigned>*>(sq + params.sq_off.head);
+    sq_tail_ = reinterpret_cast<std::atomic<unsigned>*>(sq + params.sq_off.tail);
+    sq_mask_ = *reinterpret_cast<unsigned*>(sq + params.sq_off.ring_mask);
+    sq_array_ = reinterpret_cast<unsigned*>(sq + params.sq_off.array);
+    auto* cq = static_cast<uint8_t*>(cq_ring_);
+    cq_head_ = reinterpret_cast<std::atomic<unsigned>*>(cq + params.cq_off.head);
+    cq_tail_ = reinterpret_cast<std::atomic<unsigned>*>(cq + params.cq_off.tail);
+    cq_mask_ = *reinterpret_cast<unsigned*>(cq + params.cq_off.ring_mask);
+    cqes_ = reinterpret_cast<io_uring_cqe*>(cq + params.cq_off.cqes);
+    return true;
+  }
+
+  // Blocking single-op submit+wait. Returns op result (>=0) or -errno.
+  int32_t run(uint8_t opcode, int fd, void* buf, uint32_t len, uint64_t file_offset) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    const unsigned tail = sq_tail_->load(std::memory_order_relaxed);
+    const unsigned idx = tail & sq_mask_;
+    io_uring_sqe& sqe = sqes_[idx];
+    std::memset(&sqe, 0, sizeof(sqe));
+    sqe.opcode = opcode;
+    sqe.fd = fd;
+    sqe.addr = reinterpret_cast<uint64_t>(buf);
+    sqe.len = len;
+    sqe.off = file_offset;
+    sq_array_[idx] = idx;
+    sq_tail_->store(tail + 1, std::memory_order_release);
+
+    if (io_uring_enter(ring_fd_, 1, 1, IORING_ENTER_GETEVENTS) < 0) return -errno;
+
+    const unsigned head = cq_head_->load(std::memory_order_acquire);
+    if (head == cq_tail_->load(std::memory_order_acquire)) return -EIO;
+    const io_uring_cqe& cqe = cqes_[head & cq_mask_];
+    const int32_t res = cqe.res;
+    cq_head_->store(head + 1, std::memory_order_release);
+    return res;
+  }
+
+  bool ok() const { return ring_fd_ >= 0; }
+
+ private:
+  void close_ring() {
+    if (sq_ring_ && sq_ring_ != MAP_FAILED) ::munmap(sq_ring_, sq_ring_sz_);
+    if (cq_ring_ && cq_ring_ != MAP_FAILED) ::munmap(cq_ring_, cq_ring_sz_);
+    if (sqes_ && sqes_ != reinterpret_cast<io_uring_sqe*>(MAP_FAILED)) ::munmap(sqes_, sqes_sz_);
+    if (ring_fd_ >= 0) ::close(ring_fd_);
+    sq_ring_ = cq_ring_ = nullptr;
+    sqes_ = nullptr;
+    ring_fd_ = -1;
+  }
+
+  int ring_fd_{-1};
+  void* sq_ring_{nullptr};
+  void* cq_ring_{nullptr};
+  io_uring_sqe* sqes_{nullptr};
+  size_t sq_ring_sz_{0}, cq_ring_sz_{0}, sqes_sz_{0};
+  std::atomic<unsigned>*sq_head_{}, *sq_tail_{}, *cq_head_{}, *cq_tail_{};
+  unsigned sq_mask_{0}, cq_mask_{0};
+  unsigned* sq_array_{nullptr};
+  io_uring_cqe* cqes_{nullptr};
+  std::mutex mutex_;
+};
+
+constexpr uint64_t kAlign = 512;
+
+}  // namespace
+
+class IoUringDiskBackend : public OffsetBackendBase {
+ public:
+  explicit IoUringDiskBackend(BackendConfig config) : OffsetBackendBase(std::move(config)) {}
+  ~IoUringDiskBackend() override { shutdown(); }
+
+  ErrorCode initialize() override {
+    if (fd_ >= 0) return ErrorCode::INVALID_STATE;
+    if (config_.path.empty()) return ErrorCode::MISSING_REQUIRED_FIELD;
+    std::error_code fs_ec;
+    std::filesystem::create_directories(
+        std::filesystem::path(config_.path).parent_path(), fs_ec);
+
+    int flags = O_CREAT | O_RDWR | O_CLOEXEC;
+    if (config_.use_odirect) flags |= O_DIRECT;
+    fd_ = ::open(config_.path.c_str(), flags, 0644);
+    if (fd_ < 0 && config_.use_odirect) {
+      // Filesystem without O_DIRECT support (tmpfs): fall back to buffered.
+      LOG_WARN << "iouring backend: O_DIRECT unsupported on " << config_.path
+               << ", using buffered I/O";
+      odirect_active_ = false;
+      fd_ = ::open(config_.path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0644);
+    } else {
+      odirect_active_ = config_.use_odirect;
+    }
+    if (fd_ < 0) return ErrorCode::INITIALIZATION_FAILED;
+    if (::ftruncate(fd_, static_cast<off_t>(config_.capacity)) != 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return ErrorCode::INSUFFICIENT_SPACE;
+    }
+    ring_ = std::make_unique<MiniRing>();
+    if (!ring_->init()) {
+      LOG_WARN << "io_uring unavailable (" << std::strerror(errno)
+               << "), falling back to pread/pwrite";
+      ring_.reset();
+    }
+    if (odirect_active_) {
+      bounce_.resize(1 << 20);
+      if (posix_memalign(&bounce_aligned_, kAlign, bounce_.size()) != 0)
+        return ErrorCode::OUT_OF_MEMORY;
+    }
+    return init_allocator();
+  }
+
+  void shutdown() override {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+    ring_.reset();
+    if (bounce_aligned_) {
+      std::free(bounce_aligned_);
+      bounce_aligned_ = nullptr;
+    }
+  }
+
+  void* base_address() const override { return nullptr; }  // served via read/write_at
+  bool persistent() const override { return true; }
+
+  ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
+    return io_at(offset, const_cast<void*>(src), len, /*is_write=*/true);
+  }
+  ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
+    return io_at(offset, dst, len, /*is_write=*/false);
+  }
+
+ private:
+  // Aligned direct I/O when possible; bounce buffer for unaligned O_DIRECT.
+  ErrorCode io_at(uint64_t offset, void* buf, uint64_t len, bool is_write) {
+    if (fd_ < 0) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    if (len == 0) return ErrorCode::OK;
+
+    const bool aligned = !odirect_active_ ||
+                         ((offset % kAlign) == 0 && (len % kAlign) == 0 &&
+                          (reinterpret_cast<uintptr_t>(buf) % kAlign) == 0);
+    if (aligned) return raw_io(offset, buf, len, is_write);
+
+    // Unaligned O_DIRECT: widen to aligned window through the bounce buffer.
+    std::lock_guard<std::mutex> lock(bounce_mutex_);
+    uint64_t pos = offset;
+    auto* user = static_cast<uint8_t*>(buf);
+    uint64_t remaining = len;
+    while (remaining > 0) {
+      const uint64_t win_start = pos & ~(kAlign - 1);
+      const uint64_t max_win = bounce_.size();
+      uint64_t win_len = std::min<uint64_t>(max_win, ((pos + remaining) - win_start + kAlign - 1) &
+                                                         ~(kAlign - 1));
+      win_len = std::min(win_len, ((config_.capacity - win_start) & ~(kAlign - 1)));
+      if (win_len == 0) return ErrorCode::MEMORY_ACCESS_ERROR;
+      BTPU_RETURN_IF_ERROR(raw_io(win_start, bounce_aligned_, win_len, /*is_write=*/false));
+      const uint64_t in_win = std::min(remaining, win_len - (pos - win_start));
+      auto* window = static_cast<uint8_t*>(bounce_aligned_);
+      if (is_write) {
+        std::memcpy(window + (pos - win_start), user, in_win);
+        BTPU_RETURN_IF_ERROR(raw_io(win_start, bounce_aligned_, win_len, /*is_write=*/true));
+      } else {
+        std::memcpy(user, window + (pos - win_start), in_win);
+      }
+      pos += in_win;
+      user += in_win;
+      remaining -= in_win;
+    }
+    return ErrorCode::OK;
+  }
+
+  ErrorCode raw_io(uint64_t offset, void* buf, uint64_t len, bool is_write) {
+    auto* p = static_cast<uint8_t*>(buf);
+    uint64_t done = 0;
+    while (done < len) {
+      const uint32_t chunk = static_cast<uint32_t>(std::min<uint64_t>(len - done, 1u << 30));
+      int32_t rc;
+      if (ring_) {
+        rc = ring_->run(is_write ? IORING_OP_WRITE : IORING_OP_READ, fd_, p + done, chunk,
+                        offset + done);
+      } else {
+        rc = static_cast<int32_t>(is_write ? ::pwrite(fd_, p + done, chunk, offset + done)
+                                           : ::pread(fd_, p + done, chunk, offset + done));
+        if (rc < 0) rc = -errno;
+      }
+      if (rc < 0) {
+        LOG_ERROR << "disk io failed at " << offset + done << ": " << std::strerror(-rc);
+        return ErrorCode::MEMORY_ACCESS_ERROR;
+      }
+      if (rc == 0) {
+        // Read past EOF inside capacity (sparse file): zero-fill.
+        if (!is_write) {
+          std::memset(p + done, 0, len - done);
+          return ErrorCode::OK;
+        }
+        return ErrorCode::MEMORY_ACCESS_ERROR;
+      }
+      done += static_cast<uint64_t>(rc);
+    }
+    return ErrorCode::OK;
+  }
+
+  int fd_{-1};
+  bool odirect_active_{false};
+  std::unique_ptr<MiniRing> ring_;
+  std::vector<uint8_t> bounce_;  // sizing only; aligned buffer is below
+  void* bounce_aligned_{nullptr};
+  std::mutex bounce_mutex_;
+};
+
+std::unique_ptr<StorageBackend> make_iouring_disk_backend(const BackendConfig& config) {
+  return std::make_unique<IoUringDiskBackend>(config);
+}
+
+// ---- factory (all storage classes wired; reference gap fixed) -------------
+
+std::unique_ptr<StorageBackend> make_ram_backend(const BackendConfig& config);
+std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config);
+std::unique_ptr<StorageBackend> make_mmap_disk_backend(const BackendConfig& config);
+
+std::unique_ptr<StorageBackend> create_storage_backend(const BackendConfig& config) {
+  BackendConfig cfg = config;
+  switch (config.storage_class) {
+    case StorageClass::RAM_CPU:
+    case StorageClass::CXL_MEMORY:
+    case StorageClass::CXL_TYPE2_DEVICE:
+      return make_ram_backend(cfg);
+    case StorageClass::HBM_TPU:
+      return make_hbm_backend(cfg);
+    case StorageClass::NVME:
+      if (config.path.empty()) return nullptr;
+      cfg.use_odirect = true;
+      return make_iouring_disk_backend(cfg);
+    case StorageClass::SSD:
+      if (config.path.empty()) return nullptr;
+      return make_iouring_disk_backend(cfg);
+    case StorageClass::HDD:
+      if (config.path.empty()) return nullptr;
+      return make_mmap_disk_backend(cfg);
+    default:
+      LOG_ERROR << "no backend for storage class "
+                << storage_class_name(config.storage_class);
+      return nullptr;
+  }
+}
+
+}  // namespace btpu::storage

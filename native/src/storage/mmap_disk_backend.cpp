@@ -1,0 +1,90 @@
+// Mmap disk tier: one pre-sized backing file mapped read/write, so disk bytes
+// are directly addressable (and transport-registrable) host memory.
+//
+// Parity target: reference src/worker/storage/mmap_disk_backend.cpp
+// (create_backing_file :279-298, setup_mmap + MADV_RANDOM :300-325, internal
+// PoolAllocator :219-229). Bytes persist across restarts in the backing file.
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <filesystem>
+
+#include "backend_base.h"
+#include "btpu/common/log.h"
+
+namespace btpu::storage {
+
+class MmapDiskBackend : public OffsetBackendBase {
+ public:
+  explicit MmapDiskBackend(BackendConfig config) : OffsetBackendBase(std::move(config)) {}
+  ~MmapDiskBackend() override { shutdown(); }
+
+  ErrorCode initialize() override {
+    if (base_) return ErrorCode::INVALID_STATE;
+    if (config_.path.empty()) return ErrorCode::MISSING_REQUIRED_FIELD;
+
+    std::error_code fs_ec;
+    std::filesystem::create_directories(
+        std::filesystem::path(config_.path).parent_path(), fs_ec);
+
+    int fd = ::open(config_.path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0644);
+    if (fd < 0) {
+      LOG_ERROR << "mmap backend: open " << config_.path << ": " << std::strerror(errno);
+      return ErrorCode::INITIALIZATION_FAILED;
+    }
+    if (::ftruncate(fd, static_cast<off_t>(config_.capacity)) != 0) {
+      ::close(fd);
+      return ErrorCode::INSUFFICIENT_SPACE;
+    }
+    void* base =
+        ::mmap(nullptr, config_.capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED) {
+      LOG_ERROR << "mmap backend: mmap failed: " << std::strerror(errno);
+      return ErrorCode::INITIALIZATION_FAILED;
+    }
+    ::madvise(base, config_.capacity, MADV_RANDOM);
+    base_ = static_cast<uint8_t*>(base);
+    return init_allocator();
+  }
+
+  void shutdown() override {
+    if (base_) {
+      ::msync(base_, config_.capacity, MS_ASYNC);
+      ::munmap(base_, config_.capacity);
+      base_ = nullptr;
+    }
+  }
+
+  void* base_address() const override { return base_; }
+  bool persistent() const override { return true; }
+
+  ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
+    if (!base_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    std::memcpy(base_ + offset, src, len);
+    return ErrorCode::OK;
+  }
+
+  ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
+    if (!base_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    std::memcpy(dst, base_ + offset, len);
+    return ErrorCode::OK;
+  }
+
+ private:
+  uint8_t* base_{nullptr};
+};
+
+std::unique_ptr<StorageBackend> make_mmap_disk_backend(const BackendConfig& config) {
+  return std::make_unique<MmapDiskBackend>(config);
+}
+
+}  // namespace btpu::storage

@@ -1,0 +1,77 @@
+// RAM tier (host DRAM / CXL-style memory): flat allocation, direct mapping.
+//
+// Parity target: reference src/worker/storage/ram_backend.cpp (malloc pool,
+// reserve/commit lifecycle) and cxl_memory_backend.cpp (mmap'd device
+// memory with anonymous fallback) — both collapse to one backend here since
+// the only difference is where the bytes live; the worker may hand us
+// transport-owned memory (shm segment) via set_external_region.
+#include <cstdlib>
+#include <cstring>
+
+#include "backend_base.h"
+#include "btpu/common/log.h"
+
+namespace btpu::storage {
+
+class RamBackend : public OffsetBackendBase {
+ public:
+  explicit RamBackend(BackendConfig config) : OffsetBackendBase(std::move(config)) {}
+  ~RamBackend() override { shutdown(); }
+
+  // Adopt caller-owned memory (e.g. a shm segment) instead of mallocing.
+  void set_external_region(void* base) { external_base_ = base; }
+
+  ErrorCode initialize() override {
+    if (base_) return ErrorCode::INVALID_STATE;
+    if (external_base_) {
+      base_ = static_cast<uint8_t*>(external_base_);
+      owned_ = false;
+    } else {
+      base_ = static_cast<uint8_t*>(std::malloc(config_.capacity));
+      if (!base_) return ErrorCode::OUT_OF_MEMORY;
+      owned_ = true;
+    }
+    return init_allocator();
+  }
+
+  void shutdown() override {
+    if (base_ && owned_) std::free(base_);
+    base_ = nullptr;
+  }
+
+  void* base_address() const override { return base_; }
+
+  ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
+    if (!base_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    std::memcpy(base_ + offset, src, len);
+    return ErrorCode::OK;
+  }
+
+  ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
+    if (!base_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    std::memcpy(dst, base_ + offset, len);
+    return ErrorCode::OK;
+  }
+
+ private:
+  uint8_t* base_{nullptr};
+  void* external_base_{nullptr};
+  bool owned_{false};
+};
+
+std::unique_ptr<StorageBackend> make_ram_backend(const BackendConfig& config) {
+  return std::make_unique<RamBackend>(config);
+}
+
+std::unique_ptr<StorageBackend> create_ram_backend_with_region(const BackendConfig& config,
+                                                               void* region) {
+  auto backend = std::make_unique<RamBackend>(config);
+  backend->set_external_region(region);
+  return backend;
+}
+
+}  // namespace btpu::storage

@@ -1,0 +1,241 @@
+// Storage backend tests across all tiers.
+// Behavior parity with reference tests/storage/test_iouring_disk_backend.cpp
+// (init, class support, reserve/commit, out-of-space, expired tokens, free
+// mismatches, persistence, multi-shard, invalid directory, stats, concurrent
+// operations) — run here as a shared suite over RAM, HBM (emulated), mmap-HDD
+// and io_uring-NVME backends, plus factory coverage for every class (the
+// reference factory returned nullptr for disk classes).
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <filesystem>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/storage/backend.h"
+#include "btpu/storage/hbm_provider.h"
+
+using namespace btpu;
+using namespace btpu::storage;
+
+namespace {
+
+std::string temp_dir() {
+  static std::atomic<int> counter{0};
+  auto dir = std::filesystem::temp_directory_path() /
+             ("btpu_storage_" + std::to_string(::getpid()) + "_" + std::to_string(counter++));
+  std::filesystem::create_directories(dir);
+  return dir.string();
+}
+
+BackendConfig make_config(StorageClass cls, uint64_t capacity = 1 << 20,
+                          const std::string& dir = "") {
+  BackendConfig cfg;
+  cfg.pool_id = "pool-test";
+  cfg.node_id = "node-test";
+  cfg.storage_class = cls;
+  cfg.capacity = capacity;
+  if (!dir.empty()) cfg.path = dir + "/backing.dat";
+  return cfg;
+}
+
+void run_backend_suite(StorageBackend& backend) {
+  BT_ASSERT(backend.initialize() == ErrorCode::OK);
+  BT_EXPECT_EQ(backend.capacity(), uint64_t{1 << 20});
+  BT_EXPECT_EQ(backend.used(), 0ull);
+
+  // reserve -> write -> commit -> read back
+  auto res = backend.reserve_shard(64 * 1024);
+  BT_ASSERT_OK(res);
+  const auto token = res.value();
+  BT_EXPECT_EQ(token.size, 64 * 1024ull);
+  BT_EXPECT_EQ(backend.used(), 64 * 1024ull);  // reserved counts as used
+
+  std::vector<uint8_t> data(64 * 1024);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i % 251);
+  BT_EXPECT(backend.write_at(token.offset, data.data(), data.size()) == ErrorCode::OK);
+  BT_EXPECT(backend.commit_shard(token) == ErrorCode::OK);
+
+  std::vector<uint8_t> back(64 * 1024, 0);
+  BT_EXPECT(backend.read_at(token.offset, back.data(), back.size()) == ErrorCode::OK);
+  BT_EXPECT(std::memcmp(data.data(), back.data(), data.size()) == 0);
+
+  // double commit of the same token is invalid
+  BT_EXPECT(backend.commit_shard(token) == ErrorCode::INVALID_PARAMETERS);
+
+  // abort returns space
+  auto res2 = backend.reserve_shard(32 * 1024);
+  BT_ASSERT_OK(res2);
+  BT_EXPECT(backend.abort_shard(res2.value()) == ErrorCode::OK);
+  BT_EXPECT_EQ(backend.used(), 64 * 1024ull);
+
+  // out of space
+  auto too_big = backend.reserve_shard(2 << 20);
+  BT_EXPECT(!too_big.ok());
+  BT_EXPECT(too_big.error() == ErrorCode::INSUFFICIENT_SPACE);
+
+  // free mismatches rejected
+  BT_EXPECT(backend.free_shard(token.offset + 1, token.size) == ErrorCode::INVALID_PARAMETERS);
+  BT_EXPECT(backend.free_shard(token.offset, token.size - 1) == ErrorCode::INVALID_PARAMETERS);
+  BT_EXPECT(backend.free_shard(token.offset, token.size) == ErrorCode::OK);
+  BT_EXPECT_EQ(backend.used(), 0ull);
+  BT_EXPECT(backend.free_shard(token.offset, token.size) == ErrorCode::INVALID_PARAMETERS);
+
+  // multi-shard + stats
+  std::vector<ReservationToken> tokens;
+  for (int i = 0; i < 8; ++i) {
+    auto r = backend.reserve_shard(4096);
+    BT_ASSERT_OK(r);
+    BT_EXPECT(backend.commit_shard(r.value()) == ErrorCode::OK);
+    tokens.push_back(r.value());
+  }
+  auto st = backend.stats();
+  BT_EXPECT_EQ(st.shard_count, 8ull);
+  BT_EXPECT_EQ(st.used, 8 * 4096ull);
+  BT_EXPECT(st.total_commits >= 9);
+  BT_EXPECT(st.total_aborts >= 1);
+
+  // bounds-checked io
+  uint8_t byte = 0;
+  BT_EXPECT(backend.read_at(backend.capacity() - 0, &byte, 1) == ErrorCode::MEMORY_ACCESS_ERROR);
+  BT_EXPECT(backend.write_at(backend.capacity() - 1, &byte, 2) == ErrorCode::MEMORY_ACCESS_ERROR);
+
+  // concurrent reserve/commit/free
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&backend, &failures] {
+      for (int i = 0; i < 50; ++i) {
+        auto r = backend.reserve_shard(1024);
+        if (!r.ok()) { ++failures; continue; }
+        if (backend.commit_shard(r.value()) != ErrorCode::OK) { ++failures; continue; }
+        if (backend.free_shard(r.value().offset, 1024) != ErrorCode::OK) ++failures;
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  BT_EXPECT_EQ(failures.load(), 0);
+
+  for (const auto& t : tokens) backend.free_shard(t.offset, t.size);
+  backend.shutdown();
+}
+
+}  // namespace
+
+BTEST(Storage, RamBackendSuite) {
+  auto backend = create_storage_backend(make_config(StorageClass::RAM_CPU));
+  BT_ASSERT(backend != nullptr);
+  run_backend_suite(*backend);
+}
+
+BTEST(Storage, HbmEmulatedBackendSuite) {
+  BT_ASSERT(hbm_provider_is_emulated());
+  auto backend = create_storage_backend(make_config(StorageClass::HBM_TPU));
+  BT_ASSERT(backend != nullptr);
+  BT_EXPECT(backend->base_address() == nullptr);  // device tier: no host map
+  run_backend_suite(*backend);
+}
+
+BTEST(Storage, MmapHddBackendSuite) {
+  auto dir = temp_dir();
+  auto backend = create_storage_backend(make_config(StorageClass::HDD, 1 << 20, dir));
+  BT_ASSERT(backend != nullptr);
+  run_backend_suite(*backend);
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(Storage, IoUringNvmeBackendSuite) {
+  auto dir = temp_dir();
+  auto backend = create_storage_backend(make_config(StorageClass::NVME, 1 << 20, dir));
+  BT_ASSERT(backend != nullptr);
+  run_backend_suite(*backend);
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(Storage, SsdBackendSuite) {
+  auto dir = temp_dir();
+  auto backend = create_storage_backend(make_config(StorageClass::SSD, 1 << 20, dir));
+  BT_ASSERT(backend != nullptr);
+  run_backend_suite(*backend);
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(Storage, FactoryCoversEveryClassOrFailsLoudly) {
+  // Memory classes need no path; disk classes need one (nullptr otherwise —
+  // but NEVER nullptr for a fully-specified config, unlike the reference).
+  for (auto cls : {StorageClass::RAM_CPU, StorageClass::HBM_TPU, StorageClass::CXL_MEMORY}) {
+    BT_EXPECT(create_storage_backend(make_config(cls)) != nullptr);
+  }
+  auto dir = temp_dir();
+  for (auto cls : {StorageClass::NVME, StorageClass::SSD, StorageClass::HDD}) {
+    BT_EXPECT(create_storage_backend(make_config(cls, 1 << 20, dir)) != nullptr);
+    BT_EXPECT(create_storage_backend(make_config(cls)) == nullptr);  // no path
+  }
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(Storage, DiskTiersPersistAcrossReopen) {
+  auto dir = temp_dir();
+  const uint64_t offset = 4096;
+  std::vector<uint8_t> data(8192);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 13 + 5);
+
+  for (auto cls : {StorageClass::HDD, StorageClass::NVME}) {
+    auto cfg = make_config(cls, 1 << 20, dir + "/" + std::string(storage_class_name(cls)));
+    {
+      auto backend = create_storage_backend(cfg);
+      BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+      BT_EXPECT(backend->write_at(offset, data.data(), data.size()) == ErrorCode::OK);
+      BT_EXPECT(backend->persistent());
+      backend->shutdown();
+    }
+    {
+      auto backend = create_storage_backend(cfg);
+      BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+      std::vector<uint8_t> back(8192, 0);
+      BT_EXPECT(backend->read_at(offset, back.data(), back.size()) == ErrorCode::OK);
+      BT_EXPECT(std::memcmp(data.data(), back.data(), data.size()) == 0);
+      backend->shutdown();
+    }
+  }
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(Storage, ExpiredReservationIsReclaimed) {
+  auto cfg = make_config(StorageClass::RAM_CPU, 64 * 1024);
+  cfg.reservation_ttl_ms = 30;
+  auto backend = create_storage_backend(cfg);
+  BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+
+  auto res = backend->reserve_shard(64 * 1024);  // whole pool
+  BT_ASSERT_OK(res);
+  BT_EXPECT(!backend->reserve_shard(1024).ok());  // full
+  std::this_thread::sleep_for(std::chrono::milliseconds(60));
+  // Commit of an expired token fails...
+  BT_EXPECT(backend->commit_shard(res.value()) == ErrorCode::OPERATION_TIMEOUT);
+  // ...and the space is usable again.
+  auto res2 = backend->reserve_shard(1024);
+  BT_EXPECT(res2.ok());
+  backend->shutdown();
+}
+
+BTEST(Storage, InvalidPathFailsInitialization) {
+  auto cfg = make_config(StorageClass::NVME, 1 << 20);
+  cfg.path = "/proc/definitely/not/writable/backing.dat";
+  auto backend = create_storage_backend(cfg);
+  BT_ASSERT(backend != nullptr);
+  BT_EXPECT(backend->initialize() != ErrorCode::OK);
+}
+
+BTEST(Storage, RamBackendWithExternalRegion) {
+  std::vector<uint8_t> region(64 * 1024);
+  auto cfg = make_config(StorageClass::RAM_CPU, region.size());
+  auto backend = create_ram_backend_with_region(cfg, region.data());
+  BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+  BT_EXPECT(backend->base_address() == region.data());
+  uint8_t v = 0x5a;
+  BT_EXPECT(backend->write_at(100, &v, 1) == ErrorCode::OK);
+  BT_EXPECT_EQ(int(region[100]), 0x5a);  // wrote through to caller memory
+  backend->shutdown();
+}

@@ -55,6 +55,7 @@ class KeystoneAllocatorAdapter {
   }
 
   IAllocator& allocator() { return *allocator_; }
+  const IAllocator& allocator() const { return *allocator_; }
 
  private:
   std::unique_ptr<IAllocator> allocator_;

@@ -53,6 +53,11 @@ class RangeAllocator : public IAllocator {
   ErrorCode ensure_pool_allocator(const MemoryPool& pool);
   std::vector<MemoryPoolId> select_candidate_pools(const AllocationRequest& request,
                                                    const PoolMap& pools) const;
+  // Live free space for a pool: the pool allocator's view when it exists
+  // (the registry's `used` field is a stale snapshot — the reference selects
+  // on it and over-commits pools, range_allocator.cpp:449), else the
+  // registry's.
+  uint64_t avail_of(const MemoryPoolId& id, const MemoryPool& pool) const;
   Result<AllocationResult> allocate_with_striping(const AllocationRequest& request,
                                                   const std::vector<MemoryPoolId>& candidates,
                                                   const PoolMap& pools);

@@ -1,0 +1,184 @@
+// Keystone: the control plane. Object metadata, put lifecycle, placement via
+// the allocator, TTL GC, watermark eviction, worker/pool registries mirrored
+// from the coordination service, failure detection, and repair.
+//
+// Parity target: reference include/blackbird/keystone/keystone_service.h:84-322
+// and src/keystone/keystone_service.cpp. Behaviors preserved: the 14-method
+// object API incl. batches, allocate-on-put_start / free-on-cancel/remove/GC,
+// TTL GC thread, health thread with high-watermark eviction honoring
+// soft-pin, view-version counter, heartbeat-DELETE-driven dead-worker
+// cleanup, boot-time registry replay. Changes from the reference:
+//   * re-replication repair: objects referencing a dead worker are rebuilt
+//     from surviving replicas through the data-plane transport (the reference
+//     leaves placements dangling, keystone_service.cpp:956-1004 + SURVEY §3.5);
+//   * tier-aware eviction: watermark pressure is evaluated per storage class
+//     so a hot HBM tier evicts without the global average hiding it
+//     (reference eviction is global-average based, :530-584);
+//   * cleanup_stale_workers is implemented (reference stub :527-528);
+//   * HA: keystone campaigns for leadership when enable_ha is set (reference
+//     flag exists but election was stubbed).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <shared_mutex>
+#include <thread>
+
+#include "btpu/alloc/keystone_adapter.h"
+#include "btpu/coord/coordinator.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::keystone {
+
+struct WorkerInfo {
+  NodeId worker_id;
+  std::string address;  // "host:port" of the worker's transport listener
+  TopoCoord topo;
+  int64_t registered_at_ms{0};
+  int64_t last_heartbeat_ms{0};
+
+  bool is_stale(int64_t now_ms, int64_t ttl_ms) const {
+    return last_heartbeat_ms > 0 && now_ms - last_heartbeat_ms > ttl_ms;
+  }
+};
+
+enum class ObjectState : uint8_t { kPending = 0, kComplete = 1 };
+
+// Registry advertisement codecs (coordinator store values; also used by the
+// worker service when advertising itself).
+std::string encode_worker_info(const WorkerInfo& info);
+bool decode_worker_info(const std::string& bytes, WorkerInfo& out);
+std::string encode_pool_record(const MemoryPool& pool);
+bool decode_pool_record(const std::string& bytes, MemoryPool& out);
+
+struct ObjectInfo {
+  uint64_t size{0};
+  uint64_t ttl_ms{0};
+  bool soft_pin{false};
+  ObjectState state{ObjectState::kPending};
+  WorkerConfig config;  // original placement policy (needed for repair)
+  std::chrono::steady_clock::time_point created_at;
+  std::chrono::steady_clock::time_point last_access;
+  std::vector<CopyPlacement> copies;
+
+  bool expired(std::chrono::steady_clock::time_point now) const {
+    return ttl_ms > 0 && now >= created_at + std::chrono::milliseconds(ttl_ms);
+  }
+};
+
+struct KeystoneCounters {
+  std::atomic<uint64_t> put_starts{0};
+  std::atomic<uint64_t> put_completes{0};
+  std::atomic<uint64_t> put_cancels{0};
+  std::atomic<uint64_t> gets{0};
+  std::atomic<uint64_t> removes{0};
+  std::atomic<uint64_t> gc_collected{0};
+  std::atomic<uint64_t> evicted{0};
+  std::atomic<uint64_t> workers_lost{0};
+  std::atomic<uint64_t> objects_repaired{0};
+  std::atomic<uint64_t> objects_lost{0};
+};
+
+class KeystoneService {
+ public:
+  // coordinator may be null: pure in-process mode (reference runs etcd-less
+  // too, keystone_service.cpp:42-44); registries are then fed by
+  // register_worker/register_memory_pool directly.
+  KeystoneService(KeystoneConfig config, std::shared_ptr<coord::Coordinator> coordinator);
+  ~KeystoneService();
+
+  ErrorCode initialize();
+  ErrorCode start();
+  void stop();
+
+  // ---- object API (RPC surface, reference keystone_service.h:84-322) ----
+  Result<bool> object_exists(const ObjectKey& key);
+  Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
+  Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
+                                               const WorkerConfig& config);
+  ErrorCode put_complete(const ObjectKey& key);
+  ErrorCode put_cancel(const ObjectKey& key);
+  ErrorCode remove_object(const ObjectKey& key);
+  Result<uint64_t> remove_all_objects();
+
+  std::vector<Result<bool>> batch_object_exists(const std::vector<ObjectKey>& keys);
+  std::vector<Result<std::vector<CopyPlacement>>> batch_get_workers(
+      const std::vector<ObjectKey>& keys);
+  std::vector<Result<std::vector<CopyPlacement>>> batch_put_start(
+      const std::vector<BatchPutStartItem>& items);
+  std::vector<ErrorCode> batch_put_complete(const std::vector<ObjectKey>& keys);
+  std::vector<ErrorCode> batch_put_cancel(const std::vector<ObjectKey>& keys);
+
+  Result<ClusterStats> get_cluster_stats() const;
+  ViewVersionId get_view_version() const noexcept { return view_version_.load(); }
+
+  // ---- registry (coordinator watches call these; embedded mode calls them
+  // directly) ----
+  ErrorCode register_worker(const WorkerInfo& worker);
+  ErrorCode register_memory_pool(const MemoryPool& pool);
+  ErrorCode remove_worker(const NodeId& worker_id);
+
+  // Snapshot views
+  std::vector<WorkerInfo> workers() const;
+  alloc::PoolMap memory_pools() const;
+  const KeystoneConfig& config() const noexcept { return config_; }
+  const KeystoneCounters& counters() const noexcept { return counters_; }
+  bool is_leader() const noexcept { return is_leader_.load(); }
+
+  // Exposed for tests/ops: run one GC / health sweep synchronously.
+  void run_gc_once();
+  void run_health_check_once();
+
+ private:
+  void gc_loop();
+  void health_loop();
+  void keepalive_loop();
+  void bump_view() noexcept { view_version_.fetch_add(1); }
+  int64_t now_wall_ms() const;
+
+  ErrorCode setup_coordinator_integration();
+  void load_existing_state();
+  void on_heartbeat_event(const coord::WatchEvent& ev);
+  void on_worker_event(const coord::WatchEvent& ev);
+  void on_pool_event(const coord::WatchEvent& ev);
+  void cleanup_dead_worker(const NodeId& worker_id);
+  void cleanup_stale_workers();
+
+  // Repair: rebuild placements that referenced a dead worker from surviving
+  // replicas over the data plane. Returns number of objects repaired.
+  size_t repair_objects_for_dead_worker(const NodeId& worker_id);
+
+  // Eviction: evict least-recently-accessed, non-soft-pinned complete
+  // objects until the (per-tier when configured) utilization drops below
+  // high_watermark * (1 - eviction_ratio).
+  void evict_for_pressure();
+  double tier_utilization(std::optional<StorageClass> cls) const;
+
+  ErrorCode free_object_locked(const ObjectKey& key, ObjectInfo& info);
+
+  KeystoneConfig config_;
+  std::shared_ptr<coord::Coordinator> coordinator_;
+  alloc::KeystoneAllocatorAdapter adapter_;
+  std::unique_ptr<transport::TransportClient> data_client_;  // for repair
+
+  mutable std::shared_mutex objects_mutex_;
+  std::unordered_map<ObjectKey, ObjectInfo> objects_;
+
+  mutable std::shared_mutex registry_mutex_;
+  std::unordered_map<NodeId, WorkerInfo> workers_;
+  alloc::PoolMap pools_;
+
+  std::atomic<ViewVersionId> view_version_{0};
+  std::atomic<bool> running_{false};
+  std::atomic<bool> is_leader_{false};
+  std::thread gc_thread_, health_thread_, keepalive_thread_;
+  std::condition_variable_any stop_cv_;
+  std::mutex stop_mutex_;
+
+  std::vector<coord::WatchId> watch_ids_;
+  KeystoneCounters counters_;
+  std::string service_id_;
+};
+
+}  // namespace btpu::keystone

@@ -28,6 +28,12 @@ ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
   }
 }
 
+uint64_t RangeAllocator::avail_of(const MemoryPoolId& id, const MemoryPool& pool) const {
+  std::shared_lock lock(pools_mutex_);
+  auto it = pool_allocators_.find(id);
+  return it != pool_allocators_.end() ? it->second->total_free() : pool.available();
+}
+
 // Candidate selection: filter by node + class preference, rank by (slice
 // affinity, available space), then search the largest worker count w such
 // that w pools can each hold ceil(total/w) bytes.
@@ -55,7 +61,9 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
         const bool sb = pb.topo.slice_id == request.preferred_slice;
         if (sa != sb) return sa;  // same-slice (ICI-reachable) pools first
       }
-      if (pa.available() != pb.available()) return pa.available() > pb.available();
+      const uint64_t fa = avail_of(a, pa);
+      const uint64_t fb = avail_of(b, pb);
+      if (fa != fb) return fa > fb;
       return a < b;  // deterministic tie-break
     });
   };
@@ -72,11 +80,11 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
     selected.reserve(w);
     for (const auto& id : preferred) {
       if (selected.size() == w) break;
-      if (pools.at(id).available() >= per_pool) selected.push_back(id);
+      if (avail_of(id, pools.at(id)) >= per_pool) selected.push_back(id);
     }
     for (const auto& id : fallback) {
       if (selected.size() == w) break;
-      if (pools.at(id).available() >= per_pool) selected.push_back(id);
+      if (avail_of(id, pools.at(id)) >= per_pool) selected.push_back(id);
     }
     if (selected.size() == w) return selected;
     if (w == 1) break;
@@ -346,7 +354,7 @@ bool RangeAllocator::can_allocate(const AllocationRequest& request, const PoolMa
         std::find(request.preferred_classes.begin(), request.preferred_classes.end(),
                   pool.storage_class) == request.preferred_classes.end())
       continue;
-    available += pool.available();
+    available += avail_of(id, pool);
   }
   return available >= needed;
 }

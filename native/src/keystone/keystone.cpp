@@ -1,0 +1,699 @@
+#include "btpu/keystone/keystone.h"
+
+#include <algorithm>
+
+#include "btpu/common/log.h"
+#include "btpu/common/wire.h"
+
+namespace btpu::keystone {
+
+using coord::WatchEvent;
+
+// ---- registry codecs ------------------------------------------------------
+
+std::string encode_worker_info(const WorkerInfo& info) {
+  wire::Writer w;
+  wire::encode_fields(w, info.worker_id, info.address, info.topo, info.registered_at_ms,
+                      info.last_heartbeat_ms);
+  auto bytes = w.take();
+  return std::string(bytes.begin(), bytes.end());
+}
+
+bool decode_worker_info(const std::string& bytes, WorkerInfo& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  return wire::decode_fields(r, out.worker_id, out.address, out.topo, out.registered_at_ms,
+                             out.last_heartbeat_ms) &&
+         r.exhausted();
+}
+
+std::string encode_pool_record(const MemoryPool& pool) {
+  wire::Writer w;
+  wire::encode(w, pool);
+  auto bytes = w.take();
+  return std::string(bytes.begin(), bytes.end());
+}
+
+bool decode_pool_record(const std::string& bytes, MemoryPool& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  return wire::decode(r, out) && r.exhausted();
+}
+
+// ---- lifecycle ------------------------------------------------------------
+
+KeystoneService::KeystoneService(KeystoneConfig config,
+                                 std::shared_ptr<coord::Coordinator> coordinator)
+    : config_(std::move(config)),
+      coordinator_(std::move(coordinator)),
+      adapter_(alloc::AllocatorFactory::create_range_based()),
+      data_client_(transport::make_transport_client()) {
+  service_id_ = config_.service_id.empty()
+                    ? config_.cluster_id + "-keystone-" + std::to_string(now_wall_ms())
+                    : config_.service_id;
+}
+
+KeystoneService::~KeystoneService() { stop(); }
+
+int64_t KeystoneService::now_wall_ms() const {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+ErrorCode KeystoneService::initialize() {
+  BTPU_RETURN_IF_ERROR(config_.validate());
+  if (coordinator_) BTPU_RETURN_IF_ERROR(setup_coordinator_integration());
+  LOG_INFO << "keystone " << service_id_ << " initialized (cluster " << config_.cluster_id
+           << ", coordinator " << (coordinator_ ? "attached" : "none") << ")";
+  return ErrorCode::OK;
+}
+
+ErrorCode KeystoneService::setup_coordinator_integration() {
+  if (!coordinator_->connected()) return ErrorCode::COORD_ERROR;
+  BTPU_RETURN_IF_ERROR(coordinator_->register_service(
+      "btpu-keystone", service_id_, config_.listen_address,
+      config_.service_registration_ttl_sec * 1000));
+  load_existing_state();
+
+  auto watch = [this](auto handler) {
+    return [this, handler](const WatchEvent& ev) { (this->*handler)(ev); };
+  };
+  auto w1 = coordinator_->watch_prefix(coord::workers_prefix(config_.cluster_id),
+                                       watch(&KeystoneService::on_worker_event));
+  auto w2 = coordinator_->watch_prefix(coord::pools_prefix(config_.cluster_id),
+                                       watch(&KeystoneService::on_pool_event));
+  auto w3 = coordinator_->watch_prefix(coord::heartbeat_prefix(config_.cluster_id),
+                                       watch(&KeystoneService::on_heartbeat_event));
+  if (!w1.ok() || !w2.ok() || !w3.ok()) return ErrorCode::COORD_WATCH_ERROR;
+  watch_ids_ = {w1.value(), w2.value(), w3.value()};
+
+  if (config_.enable_ha) {
+    coordinator_->campaign("btpu-keystone-leader/" + config_.cluster_id, service_id_,
+                           config_.service_registration_ttl_sec * 1000,
+                           [this](bool leader) {
+                             is_leader_ = leader;
+                             LOG_INFO << "keystone " << service_id_
+                                      << (leader ? " became leader" : " is standby");
+                           });
+  } else {
+    is_leader_ = true;
+  }
+  return ErrorCode::OK;
+}
+
+// Boot-time replay of workers + pools (reference keystone_service.cpp:909-945).
+void KeystoneService::load_existing_state() {
+  auto workers = coordinator_->get_with_prefix(coord::workers_prefix(config_.cluster_id));
+  if (workers.ok()) {
+    for (const auto& kv : workers.value()) {
+      WorkerInfo info;
+      if (decode_worker_info(kv.value, info)) register_worker(info);
+    }
+  }
+  auto pools = coordinator_->get_with_prefix(coord::pools_prefix(config_.cluster_id));
+  if (pools.ok()) {
+    for (const auto& kv : pools.value()) {
+      MemoryPool pool;
+      if (decode_pool_record(kv.value, pool)) register_memory_pool(pool);
+    }
+  }
+  LOG_INFO << "replayed " << (workers.ok() ? workers.value().size() : 0) << " workers, "
+           << (pools.ok() ? pools.value().size() : 0) << " pools from coordinator";
+}
+
+ErrorCode KeystoneService::start() {
+  if (running_.exchange(true)) return ErrorCode::INVALID_STATE;
+  if (config_.enable_gc) gc_thread_ = std::thread([this] { gc_loop(); });
+  health_thread_ = std::thread([this] { health_loop(); });
+  if (coordinator_) keepalive_thread_ = std::thread([this] { keepalive_loop(); });
+  return ErrorCode::OK;
+}
+
+void KeystoneService::stop() {
+  if (!running_.exchange(false)) return;
+  stop_cv_.notify_all();
+  for (auto* t : {&gc_thread_, &health_thread_, &keepalive_thread_}) {
+    if (t->joinable()) t->join();
+  }
+  if (coordinator_) {
+    for (auto id : watch_ids_) coordinator_->unwatch(id);
+    watch_ids_.clear();
+    coordinator_->unregister_service("btpu-keystone", service_id_);
+  }
+}
+
+// ---- threads --------------------------------------------------------------
+
+void KeystoneService::gc_loop() {
+  std::unique_lock<std::mutex> lock(stop_mutex_);
+  while (running_) {
+    stop_cv_.wait_for(lock, std::chrono::seconds(config_.gc_interval_sec),
+                      [this] { return !running_.load(); });
+    if (!running_) break;
+    lock.unlock();
+    run_gc_once();
+    lock.lock();
+  }
+}
+
+void KeystoneService::health_loop() {
+  std::unique_lock<std::mutex> lock(stop_mutex_);
+  while (running_) {
+    stop_cv_.wait_for(lock, std::chrono::seconds(config_.health_check_interval_sec),
+                      [this] { return !running_.load(); });
+    if (!running_) break;
+    lock.unlock();
+    run_health_check_once();
+    lock.lock();
+  }
+}
+
+void KeystoneService::keepalive_loop() {
+  std::unique_lock<std::mutex> lock(stop_mutex_);
+  while (running_) {
+    stop_cv_.wait_for(lock, std::chrono::seconds(config_.service_refresh_interval_sec),
+                      [this] { return !running_.load(); });
+    if (!running_) break;
+    lock.unlock();
+    coordinator_->register_service("btpu-keystone", service_id_, config_.listen_address,
+                                   config_.service_registration_ttl_sec * 1000);
+    lock.lock();
+  }
+}
+
+void KeystoneService::run_gc_once() {
+  const auto now = std::chrono::steady_clock::now();
+  std::vector<ObjectKey> expired;
+  {
+    std::shared_lock lock(objects_mutex_);
+    for (const auto& [key, info] : objects_) {
+      if (info.expired(now)) expired.push_back(key);
+    }
+  }
+  for (const auto& key : expired) {
+    std::unique_lock lock(objects_mutex_);
+    auto it = objects_.find(key);
+    if (it == objects_.end() || !it->second.expired(std::chrono::steady_clock::now())) continue;
+    free_object_locked(key, it->second);
+    objects_.erase(it);
+    ++counters_.gc_collected;
+    bump_view();
+    LOG_DEBUG << "gc collected expired object " << key;
+  }
+}
+
+void KeystoneService::run_health_check_once() {
+  cleanup_stale_workers();
+  evict_for_pressure();
+}
+
+// ---- object API -----------------------------------------------------------
+
+Result<bool> KeystoneService::object_exists(const ObjectKey& key) {
+  std::shared_lock lock(objects_mutex_);
+  return objects_.contains(key);
+}
+
+Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey& key) {
+  std::unique_lock lock(objects_mutex_);  // touch mutates last_access
+  auto it = objects_.find(key);
+  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  it->second.last_access = std::chrono::steady_clock::now();
+  ++counters_.gets;
+  return it->second.copies;
+}
+
+Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& key,
+                                                              uint64_t size,
+                                                              const WorkerConfig& config) {
+  if (key.empty()) return ErrorCode::INVALID_KEY;
+  if (size == 0) return ErrorCode::INVALID_PARAMETERS;
+
+  WorkerConfig effective = config;
+  if (effective.replication_factor == 0)
+    effective.replication_factor = static_cast<size_t>(config_.default_replicas);
+  effective.replication_factor =
+      std::min(effective.replication_factor, static_cast<size_t>(config_.max_replicas));
+  if (effective.max_workers_per_copy == 0) effective.max_workers_per_copy = 1;
+
+  std::unique_lock lock(objects_mutex_);
+  if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
+
+  alloc::PoolMap pools_snapshot;
+  {
+    std::shared_lock rlock(registry_mutex_);
+    pools_snapshot = pools_;
+  }
+  auto placed = adapter_.allocate_data_copies(key, size, effective, pools_snapshot);
+  if (!placed.ok()) return placed.error();
+
+  ObjectInfo info;
+  info.size = size;
+  info.ttl_ms = effective.ttl_ms;
+  info.soft_pin = effective.enable_soft_pin;
+  info.config = effective;
+  info.state = ObjectState::kPending;
+  info.created_at = info.last_access = std::chrono::steady_clock::now();
+  info.copies = placed.value();
+  objects_[key] = std::move(info);
+  ++counters_.put_starts;
+  bump_view();
+  return placed;
+}
+
+ErrorCode KeystoneService::put_complete(const ObjectKey& key) {
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  it->second.state = ObjectState::kComplete;
+  it->second.last_access = std::chrono::steady_clock::now();
+  ++counters_.put_completes;
+  return ErrorCode::OK;
+}
+
+ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  free_object_locked(key, it->second);
+  objects_.erase(it);
+  ++counters_.put_cancels;
+  bump_view();
+  return ErrorCode::OK;
+}
+
+ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  free_object_locked(key, it->second);
+  objects_.erase(it);
+  ++counters_.removes;
+  bump_view();
+  return ErrorCode::OK;
+}
+
+Result<uint64_t> KeystoneService::remove_all_objects() {
+  std::unique_lock lock(objects_mutex_);
+  const uint64_t count = objects_.size();
+  for (auto& [key, info] : objects_) free_object_locked(key, info);
+  objects_.clear();
+  counters_.removes += count;
+  bump_view();
+  return count;
+}
+
+ErrorCode KeystoneService::free_object_locked(const ObjectKey& key, ObjectInfo&) {
+  return adapter_.free_object(key);
+}
+
+std::vector<Result<bool>> KeystoneService::batch_object_exists(
+    const std::vector<ObjectKey>& keys) {
+  std::vector<Result<bool>> out;
+  out.reserve(keys.size());
+  for (const auto& key : keys) out.push_back(object_exists(key));
+  return out;
+}
+
+std::vector<Result<std::vector<CopyPlacement>>> KeystoneService::batch_get_workers(
+    const std::vector<ObjectKey>& keys) {
+  std::vector<Result<std::vector<CopyPlacement>>> out;
+  out.reserve(keys.size());
+  for (const auto& key : keys) out.push_back(get_workers(key));
+  return out;
+}
+
+std::vector<Result<std::vector<CopyPlacement>>> KeystoneService::batch_put_start(
+    const std::vector<BatchPutStartItem>& items) {
+  std::vector<Result<std::vector<CopyPlacement>>> out;
+  out.reserve(items.size());
+  for (const auto& item : items) out.push_back(put_start(item.key, item.data_size, item.config));
+  return out;
+}
+
+std::vector<ErrorCode> KeystoneService::batch_put_complete(const std::vector<ObjectKey>& keys) {
+  std::vector<ErrorCode> out;
+  out.reserve(keys.size());
+  for (const auto& key : keys) out.push_back(put_complete(key));
+  return out;
+}
+
+std::vector<ErrorCode> KeystoneService::batch_put_cancel(const std::vector<ObjectKey>& keys) {
+  std::vector<ErrorCode> out;
+  out.reserve(keys.size());
+  for (const auto& key : keys) out.push_back(put_cancel(key));
+  return out;
+}
+
+Result<ClusterStats> KeystoneService::get_cluster_stats() const {
+  ClusterStats stats;
+  {
+    std::shared_lock lock(registry_mutex_);
+    stats.total_workers = workers_.size();
+    stats.total_memory_pools = pools_.size();
+    for (const auto& [id, pool] : pools_) stats.total_capacity += pool.size;
+  }
+  {
+    std::shared_lock lock(objects_mutex_);
+    stats.total_objects = objects_.size();
+  }
+  auto alloc_stats = adapter_.get_stats();
+  stats.used_capacity = alloc_stats.total_allocated_bytes;
+  stats.avg_utilization =
+      stats.total_capacity
+          ? static_cast<double>(stats.used_capacity) / static_cast<double>(stats.total_capacity)
+          : 0.0;
+  return stats;
+}
+
+// ---- registry -------------------------------------------------------------
+
+ErrorCode KeystoneService::register_worker(const WorkerInfo& worker) {
+  if (worker.worker_id.empty()) return ErrorCode::INVALID_WORKER;
+  std::unique_lock lock(registry_mutex_);
+  auto& slot = workers_[worker.worker_id];
+  const bool fresh = slot.worker_id.empty();
+  slot = worker;
+  if (slot.last_heartbeat_ms == 0) slot.last_heartbeat_ms = now_wall_ms();
+  lock.unlock();
+  if (fresh) {
+    LOG_INFO << "worker " << worker.worker_id << " registered (" << worker.address << ")";
+    bump_view();
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode KeystoneService::register_memory_pool(const MemoryPool& pool) {
+  if (pool.id.empty() || pool.size == 0) return ErrorCode::INVALID_MEMORY_POOL;
+  std::unique_lock lock(registry_mutex_);
+  const bool fresh = !pools_.contains(pool.id);
+  pools_[pool.id] = pool;
+  lock.unlock();
+  if (fresh) {
+    LOG_INFO << "pool " << pool.id << " registered (" << pool.size << " bytes, "
+             << storage_class_name(pool.storage_class) << " on " << pool.node_id << ")";
+    bump_view();
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode KeystoneService::remove_worker(const NodeId& worker_id) {
+  {
+    std::shared_lock lock(registry_mutex_);
+    if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
+  }
+  cleanup_dead_worker(worker_id);
+  return ErrorCode::OK;
+}
+
+std::vector<WorkerInfo> KeystoneService::workers() const {
+  std::shared_lock lock(registry_mutex_);
+  std::vector<WorkerInfo> out;
+  out.reserve(workers_.size());
+  for (const auto& [id, info] : workers_) out.push_back(info);
+  return out;
+}
+
+alloc::PoolMap KeystoneService::memory_pools() const {
+  std::shared_lock lock(registry_mutex_);
+  return pools_;
+}
+
+// ---- coordinator watch handlers ------------------------------------------
+
+void KeystoneService::on_worker_event(const WatchEvent& ev) {
+  if (ev.type == WatchEvent::Type::kPut) {
+    WorkerInfo info;
+    if (decode_worker_info(ev.value, info)) register_worker(info);
+  }
+  // Persistent-key DELETE means a clean unregister; the heartbeat watcher is
+  // the authoritative death signal, so nothing else to do here.
+}
+
+void KeystoneService::on_pool_event(const WatchEvent& ev) {
+  if (ev.type == WatchEvent::Type::kPut) {
+    MemoryPool pool;
+    if (decode_pool_record(ev.value, pool)) register_memory_pool(pool);
+  }
+}
+
+void KeystoneService::on_heartbeat_event(const WatchEvent& ev) {
+  // Key layout: <heartbeat_prefix><worker_id>
+  const auto prefix = coord::heartbeat_prefix(config_.cluster_id);
+  if (ev.key.size() <= prefix.size()) return;
+  const NodeId worker_id = ev.key.substr(prefix.size());
+  if (ev.type == WatchEvent::Type::kPut) {
+    std::unique_lock lock(registry_mutex_);
+    auto it = workers_.find(worker_id);
+    if (it != workers_.end()) it->second.last_heartbeat_ms = now_wall_ms();
+  } else {
+    LOG_WARN << "worker " << worker_id << " heartbeat lost";
+    cleanup_dead_worker(worker_id);
+  }
+}
+
+// ---- failure handling -----------------------------------------------------
+
+void KeystoneService::cleanup_stale_workers() {
+  const int64_t now = now_wall_ms();
+  const int64_t ttl = config_.worker_heartbeat_ttl_sec * 1000;
+  std::vector<NodeId> stale;
+  {
+    std::shared_lock lock(registry_mutex_);
+    for (const auto& [id, info] : workers_) {
+      if (info.is_stale(now, ttl)) stale.push_back(id);
+    }
+  }
+  for (const auto& id : stale) {
+    LOG_WARN << "worker " << id << " is stale, cleaning up";
+    cleanup_dead_worker(id);
+  }
+}
+
+void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
+  std::vector<MemoryPoolId> dead_pools;
+  {
+    std::unique_lock lock(registry_mutex_);
+    if (!workers_.erase(worker_id)) return;  // already handled
+    for (auto it = pools_.begin(); it != pools_.end();) {
+      if (it->second.node_id == worker_id) {
+        dead_pools.push_back(it->first);
+        it = pools_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (const auto& pool_id : dead_pools) adapter_.forget_pool(pool_id);
+  ++counters_.workers_lost;
+
+  if (coordinator_) {
+    coordinator_->del(coord::worker_key(config_.cluster_id, worker_id));
+    for (const auto& pool_id : dead_pools)
+      coordinator_->del(coord::pool_key(config_.cluster_id, worker_id, pool_id));
+    coordinator_->del(coord::heartbeat_key(config_.cluster_id, worker_id));
+  }
+  bump_view();
+  LOG_WARN << "worker " << worker_id << " removed (" << dead_pools.size() << " pools)";
+
+  if (config_.enable_repair) {
+    const size_t repaired = repair_objects_for_dead_worker(worker_id);
+    if (repaired) {
+      LOG_INFO << "repaired " << repaired << " objects after losing " << worker_id;
+    }
+  }
+}
+
+// Rebuilds every object that had placements on `worker_id` from a surviving
+// replica over the data plane. The reference has no equivalent — placements
+// dangle after worker death (SURVEY §3.5) — but TPU-VM preemption makes
+// repair mandatory (SURVEY §7 hard parts).
+size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) {
+  alloc::PoolMap live_pools;
+  {
+    std::shared_lock lock(registry_mutex_);
+    live_pools = pools_;
+  }
+
+  size_t repaired = 0;
+  std::unique_lock lock(objects_mutex_);
+  for (auto it = objects_.begin(); it != objects_.end();) {
+    ObjectInfo& info = it->second;
+    auto damaged = [&](const CopyPlacement& copy) {
+      return std::any_of(copy.shards.begin(), copy.shards.end(),
+                         [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
+    };
+    std::vector<CopyPlacement> surviving;
+    bool any_damaged = false;
+    for (const auto& copy : info.copies) {
+      if (damaged(copy)) {
+        any_damaged = true;
+      } else {
+        surviving.push_back(copy);
+      }
+    }
+    if (!any_damaged) {
+      ++it;
+      continue;
+    }
+    if (surviving.empty()) {
+      LOG_WARN << "object " << it->first << " lost all replicas with worker " << worker_id;
+      adapter_.free_object(it->first);
+      it = objects_.erase(it);
+      ++counters_.objects_lost;
+      bump_view();
+      continue;
+    }
+
+    // Read the object back from the first surviving copy...
+    std::vector<uint8_t> bytes(info.size);
+    bool read_ok = true;
+    uint64_t offset = 0;
+    for (const auto& shard : surviving.front().shards) {
+      const auto* mem = std::get_if<MemoryLocation>(&shard.location);
+      if (!mem || offset + shard.length > bytes.size()) {
+        read_ok = false;
+        break;
+      }
+      if (data_client_->read(shard.remote, mem->remote_addr, mem->rkey, bytes.data() + offset,
+                             shard.length) != ErrorCode::OK) {
+        read_ok = false;
+        break;
+      }
+      offset += shard.length;
+    }
+    if (!read_ok || offset != info.size) {
+      // Can't reach the survivor right now: keep the surviving placements and
+      // drop the damaged ones so clients never dial the dead worker.
+      info.copies = std::move(surviving);
+      ++it;
+      bump_view();
+      continue;
+    }
+
+    // ...re-place at full replication and rewrite every copy.
+    const ObjectKey key = it->first;
+    adapter_.free_object(key);
+    auto placed = adapter_.allocate_data_copies(key, info.size, info.config, live_pools);
+    if (!placed.ok()) {
+      // Not enough healthy capacity: degrade to the surviving copies. Their
+      // ranges were just freed, so re-commit them shard by shard is not
+      // possible — instead re-allocate only what fits.
+      WorkerConfig degraded = info.config;
+      degraded.replication_factor = surviving.size();
+      placed = adapter_.allocate_data_copies(key, info.size, degraded, live_pools);
+      if (!placed.ok()) {
+        LOG_ERROR << "repair failed for object " << key << ": "
+                  << to_string(placed.error());
+        it = objects_.erase(it);
+        ++counters_.objects_lost;
+        bump_view();
+        continue;
+      }
+    }
+    bool write_ok = true;
+    for (const auto& copy : placed.value()) {
+      uint64_t woff = 0;
+      for (const auto& shard : copy.shards) {
+        const auto* mem = std::get_if<MemoryLocation>(&shard.location);
+        if (!mem || data_client_->write(shard.remote, mem->remote_addr, mem->rkey,
+                                        bytes.data() + woff, shard.length) != ErrorCode::OK) {
+          write_ok = false;
+          break;
+        }
+        woff += shard.length;
+      }
+      if (!write_ok) break;
+    }
+    if (!write_ok) {
+      LOG_ERROR << "repair rewrite failed for object " << key;
+      adapter_.free_object(key);
+      it = objects_.erase(it);
+      ++counters_.objects_lost;
+      bump_view();
+      continue;
+    }
+    info.copies = std::move(placed).value();
+    ++counters_.objects_repaired;
+    ++repaired;
+    bump_view();
+    ++it;
+  }
+  return repaired;
+}
+
+// ---- eviction -------------------------------------------------------------
+
+double KeystoneService::tier_utilization(std::optional<StorageClass> cls) const {
+  uint64_t capacity = 0;
+  {
+    std::shared_lock lock(registry_mutex_);
+    for (const auto& [id, pool] : pools_) {
+      if (!cls || pool.storage_class == *cls) capacity += pool.size;
+    }
+  }
+  if (capacity == 0) return 0.0;
+  auto stats = adapter_.allocator().get_stats(cls);
+  const uint64_t free_bytes = stats.total_free_bytes;
+  const uint64_t used = capacity > free_bytes ? capacity - free_bytes : 0;
+  return static_cast<double>(used) / static_cast<double>(capacity);
+}
+
+void KeystoneService::evict_for_pressure() {
+  // Determine which tiers are over the watermark.
+  std::vector<std::optional<StorageClass>> scopes;
+  if (config_.tier_aware_eviction) {
+    std::vector<StorageClass> classes;
+    {
+      std::shared_lock lock(registry_mutex_);
+      for (const auto& [id, pool] : pools_) {
+        if (std::find(classes.begin(), classes.end(), pool.storage_class) == classes.end())
+          classes.push_back(pool.storage_class);
+      }
+    }
+    for (auto c : classes) scopes.emplace_back(c);
+  } else {
+    scopes.emplace_back(std::nullopt);
+  }
+
+  for (const auto& scope : scopes) {
+    if (tier_utilization(scope) < config_.high_watermark) continue;
+    const double target = config_.high_watermark * (1.0 - config_.eviction_ratio);
+    LOG_WARN << "eviction pressure on tier "
+             << (scope ? storage_class_name(*scope) : "all") << " (util "
+             << tier_utilization(scope) << " >= " << config_.high_watermark << ")";
+
+    // LRU order over evictable objects in this scope.
+    std::vector<std::pair<std::chrono::steady_clock::time_point, ObjectKey>> candidates;
+    {
+      std::shared_lock lock(objects_mutex_);
+      for (const auto& [key, info] : objects_) {
+        if (info.soft_pin || info.state != ObjectState::kComplete) continue;
+        if (scope) {
+          bool touches_tier = false;
+          for (const auto& copy : info.copies) {
+            for (const auto& shard : copy.shards) {
+              if (shard.storage_class == *scope) touches_tier = true;
+            }
+          }
+          if (!touches_tier) continue;
+        }
+        candidates.emplace_back(info.last_access, key);
+      }
+    }
+    std::sort(candidates.begin(), candidates.end());
+
+    for (const auto& [ts, key] : candidates) {
+      if (tier_utilization(scope) <= target) break;
+      std::unique_lock lock(objects_mutex_);
+      auto it = objects_.find(key);
+      if (it == objects_.end()) continue;
+      free_object_locked(key, it->second);
+      objects_.erase(it);
+      ++counters_.evicted;
+      bump_view();
+      LOG_INFO << "evicted object " << key << " for tier pressure";
+    }
+  }
+}
+
+}  // namespace btpu::keystone

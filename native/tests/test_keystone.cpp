@@ -1,0 +1,356 @@
+// Keystone control-plane tests: object lifecycle, batches, TTL GC, watermark
+// eviction, registry watches, heartbeat-driven failure detection, and repair.
+// Parity notes: the reference has NO keystone unit tests (its control plane
+// is only exercised by the localhost cluster script); this suite covers the
+// behaviors documented in SURVEY §2 (KeystoneService row) + §3.5 hermetically.
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/coord/mem_coordinator.h"
+#include "btpu/keystone/keystone.h"
+#include "btpu/transport/transport.h"
+
+using namespace btpu;
+using namespace btpu::keystone;
+using namespace std::chrono_literals;
+
+namespace {
+
+bool eventually(const std::function<bool()>& pred, int timeout_ms = 3000) {
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (pred()) return true;
+    std::this_thread::sleep_for(5ms);
+  }
+  return pred();
+}
+
+// A fake worker: local-transport region + registered pool. Owns its memory.
+struct FakeWorker {
+  std::string id;
+  std::vector<uint8_t> memory;
+  std::unique_ptr<transport::TransportServer> server;
+  MemoryPool pool;
+
+  FakeWorker(const std::string& worker_id, uint64_t size,
+             StorageClass cls = StorageClass::RAM_CPU, int32_t slice = 0)
+      : id(worker_id), memory(size) {
+    server = transport::make_transport_server(TransportKind::LOCAL);
+    server->start("", 0);
+    auto reg = server->register_region(memory.data(), size, worker_id + "-pool");
+    pool.id = worker_id + "-pool";
+    pool.node_id = worker_id;
+    pool.size = size;
+    pool.storage_class = cls;
+    pool.remote = reg.value();
+    pool.topo = {slice, 0, -1};
+  }
+
+  WorkerInfo info() const {
+    WorkerInfo w;
+    w.worker_id = id;
+    w.address = "local:" + id;
+    w.topo = pool.topo;
+    return w;
+  }
+};
+
+KeystoneConfig fast_config() {
+  KeystoneConfig cfg;
+  cfg.gc_interval_sec = 1;
+  cfg.health_check_interval_sec = 1;
+  cfg.worker_heartbeat_ttl_sec = 1;
+  return cfg;
+}
+
+uint64_t shard_bytes(const std::vector<CopyPlacement>& copies) {
+  uint64_t total = 0;
+  for (const auto& c : copies)
+    for (const auto& s : c.shards) total += s.length;
+  return total;
+}
+
+}  // namespace
+
+BTEST(Keystone, PutLifecycleAndLookup) {
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  const auto v0 = ks.get_view_version();
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  auto placed = ks.put_start("obj/a", 64 * 1024, cfg);
+  BT_ASSERT_OK(placed);
+  BT_EXPECT_EQ(shard_bytes(placed.value()), 64 * 1024ull);
+  BT_EXPECT(ks.get_view_version() > v0);
+
+  // Double put_start on the same key fails.
+  BT_EXPECT(ks.put_start("obj/a", 1024, cfg).error() == ErrorCode::OBJECT_ALREADY_EXISTS);
+
+  BT_EXPECT(ks.object_exists("obj/a").value());
+  BT_EXPECT(ks.put_complete("obj/a") == ErrorCode::OK);
+  auto got = ks.get_workers("obj/a");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(shard_bytes(got.value()), 64 * 1024ull);
+
+  BT_EXPECT(ks.remove_object("obj/a") == ErrorCode::OK);
+  BT_EXPECT(!ks.object_exists("obj/a").value());
+  BT_EXPECT(ks.get_workers("obj/a").error() == ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT(ks.remove_object("obj/a") == ErrorCode::OBJECT_NOT_FOUND);
+
+  // Cancel frees the allocation.
+  BT_ASSERT_OK(ks.put_start("obj/b", 512 * 1024, cfg));
+  BT_EXPECT(ks.put_cancel("obj/b") == ErrorCode::OK);
+  auto stats = ks.get_cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().used_capacity, 0ull);
+  BT_EXPECT_EQ(stats.value().total_workers, 1ull);
+  BT_EXPECT_EQ(stats.value().total_memory_pools, 1ull);
+}
+
+BTEST(Keystone, ValidationAndDefaults) {
+  auto cfg = fast_config();
+  cfg.default_replicas = 2;
+  cfg.max_replicas = 2;
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+  ks.register_worker(w2.info());
+  ks.register_memory_pool(w2.pool);
+
+  BT_EXPECT(ks.put_start("", 1024, {}).error() == ErrorCode::INVALID_KEY);
+  BT_EXPECT(ks.put_start("k", 0, {}).error() == ErrorCode::INVALID_PARAMETERS);
+
+  // replication_factor 0 -> default_replicas; 99 -> clamped to max_replicas.
+  WorkerConfig wc;
+  wc.replication_factor = 0;
+  wc.max_workers_per_copy = 1;
+  auto placed = ks.put_start("k0", 1024, wc);
+  BT_ASSERT_OK(placed);
+  BT_EXPECT_EQ(placed.value().size(), 2u);
+  wc.replication_factor = 99;
+  auto placed2 = ks.put_start("k1", 1024, wc);
+  BT_ASSERT_OK(placed2);
+  BT_EXPECT_EQ(placed2.value().size(), 2u);
+}
+
+BTEST(Keystone, BatchOperations) {
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  std::vector<BatchPutStartItem> items = {
+      {"b/0", 1024, cfg}, {"b/1", 2048, cfg}, {"", 100, cfg} /* invalid */};
+  auto started = ks.batch_put_start(items);
+  BT_ASSERT(started.size() == 3);
+  BT_EXPECT(started[0].ok());
+  BT_EXPECT(started[1].ok());
+  BT_EXPECT(!started[2].ok());
+
+  auto exists = ks.batch_object_exists({"b/0", "b/1", "b/2"});
+  BT_EXPECT(exists[0].value() && exists[1].value() && !exists[2].value());
+
+  auto completes = ks.batch_put_complete({"b/0", "b/1", "b/2"});
+  BT_EXPECT(completes[0] == ErrorCode::OK);
+  BT_EXPECT(completes[2] == ErrorCode::OBJECT_NOT_FOUND);
+
+  auto fetched = ks.batch_get_workers({"b/0", "b/2"});
+  BT_EXPECT(fetched[0].ok());
+  BT_EXPECT(fetched[1].error() == ErrorCode::OBJECT_NOT_FOUND);
+
+  auto cancels = ks.batch_put_cancel({"b/1"});
+  BT_EXPECT(cancels[0] == ErrorCode::OK);
+
+  auto removed = ks.remove_all_objects();
+  BT_ASSERT_OK(removed);
+  BT_EXPECT_EQ(removed.value(), 1ull);  // only b/0 remained
+}
+
+BTEST(Keystone, TtlGcCollectsExpiredObjects) {
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  cfg.ttl_ms = 40;
+  BT_ASSERT_OK(ks.put_start("ephemeral", 4096, cfg));
+  BT_EXPECT(ks.put_complete("ephemeral") == ErrorCode::OK);
+  cfg.ttl_ms = 0;  // immortal
+  BT_ASSERT_OK(ks.put_start("pinned", 4096, cfg));
+
+  std::this_thread::sleep_for(60ms);
+  ks.run_gc_once();
+  BT_EXPECT(!ks.object_exists("ephemeral").value());
+  BT_EXPECT(ks.object_exists("pinned").value());
+  BT_EXPECT_EQ(ks.counters().gc_collected.load(), 1ull);
+  auto stats = ks.get_cluster_stats();
+  BT_EXPECT_EQ(stats.value().used_capacity, 4096ull);
+}
+
+BTEST(Keystone, WatermarkEvictionLruHonorsSoftPin) {
+  auto cfg = fast_config();
+  cfg.high_watermark = 0.5;
+  cfg.eviction_ratio = 0.2;  // target 0.4 after eviction: one 20KB eviction
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 100 * 1024);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  // Fill to 60%: three 20KB objects. First is soft-pinned.
+  wc.enable_soft_pin = true;
+  BT_ASSERT_OK(ks.put_start("pinned", 20 * 1024, wc));
+  ks.put_complete("pinned");
+  wc.enable_soft_pin = false;
+  BT_ASSERT_OK(ks.put_start("old", 20 * 1024, wc));
+  ks.put_complete("old");
+  std::this_thread::sleep_for(5ms);
+  BT_ASSERT_OK(ks.put_start("newer", 20 * 1024, wc));
+  ks.put_complete("newer");
+  std::this_thread::sleep_for(5ms);
+  ks.get_workers("old");  // touch: now "newer" is the LRU victim
+
+  ks.run_health_check_once();
+  BT_EXPECT(ks.object_exists("pinned").value());   // soft-pin survives
+  BT_EXPECT(ks.object_exists("old").value());      // recently touched survives
+  BT_EXPECT(!ks.object_exists("newer").value());   // LRU evicted
+  BT_EXPECT_EQ(ks.counters().evicted.load(), 1ull);
+}
+
+BTEST(Keystone, CoordinatorRegistryAndHeartbeatDeath) {
+  // Full §3.5 path: worker advertises itself through the coordinator; its
+  // heartbeat TTL lapses; keystone's watcher cleans it up.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  cfg.enable_repair = false;
+  KeystoneService ks(cfg, coordinator);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  BT_ASSERT(ks.start() == ErrorCode::OK);
+
+  FakeWorker w1("w1", 1 << 20);
+  const auto cluster = cfg.cluster_id;
+  coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info()));
+  coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool));
+  coordinator->put_with_ttl(coord::heartbeat_key(cluster, "w1"), "alive", 100);
+
+  BT_EXPECT(eventually([&] { return ks.workers().size() == 1; }));
+  BT_EXPECT(eventually([&] { return ks.memory_pools().size() == 1; }));
+
+  // Heartbeat lapses -> worker and pools purged, view bumped.
+  BT_EXPECT(eventually([&] { return ks.workers().empty(); }));
+  BT_EXPECT(ks.memory_pools().empty());
+  BT_EXPECT_EQ(ks.counters().workers_lost.load(), 1ull);
+  // Persistent keys deleted from the coordinator too.
+  BT_EXPECT(!coordinator->get(coord::worker_key(cluster, "w1")).ok());
+  ks.stop();
+}
+
+BTEST(Keystone, BootReplayFromCoordinator) {
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  FakeWorker w1("w1", 1 << 20);
+  const std::string cluster = "btpu_cluster";
+  coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info()));
+  coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool));
+
+  KeystoneService ks(fast_config(), coordinator);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);  // replays state
+  BT_EXPECT_EQ(ks.workers().size(), 1u);
+  BT_EXPECT_EQ(ks.memory_pools().size(), 1u);
+}
+
+BTEST(Keystone, DeadWorkerRepairRebuildsReplicas) {
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20), w3("w3", 1 << 20);
+  for (auto* w : {&w1, &w2, &w3}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+
+  // Two replicas, one shard each -> two distinct workers hold the object.
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto placed = ks.put_start("precious", 32 * 1024, cfg);
+  BT_ASSERT_OK(placed);
+  BT_ASSERT(placed.value().size() == 2);
+
+  // Write distinct bytes through the data plane to both copies.
+  auto client = transport::make_transport_client();
+  std::vector<uint8_t> payload(32 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 7 + 3);
+  for (const auto& copy : placed.value()) {
+    uint64_t off = 0;
+    for (const auto& shard : copy.shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
+                              shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+  }
+  BT_EXPECT(ks.put_complete("precious") == ErrorCode::OK);
+
+  // Kill the worker holding copy 0.
+  const NodeId victim = placed.value()[0].shards[0].worker_id;
+  BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
+  BT_EXPECT_EQ(ks.counters().objects_repaired.load(), 1ull);
+
+  // Object still has 2 replicas, none on the dead worker, bytes intact.
+  auto got = ks.get_workers("precious");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value().size(), 2u);
+  for (const auto& copy : got.value()) {
+    uint64_t off = 0;
+    std::vector<uint8_t> back(32 * 1024, 0);
+    for (const auto& shard : copy.shards) {
+      BT_EXPECT_NE(shard.worker_id, victim);
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->read(shard.remote, mem.remote_addr, mem.rkey, back.data() + off,
+                             shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+    BT_EXPECT(std::memcmp(back.data(), payload.data(), payload.size()) == 0);
+  }
+}
+
+BTEST(Keystone, SingleReplicaLostObjectIsDropped) {
+  auto cfg = fast_config();
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+  ks.register_worker(w2.info());
+  ks.register_memory_pool(w2.pool);
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  auto placed = ks.put_start("fragile", 4096, wc);
+  BT_ASSERT_OK(placed);
+  ks.put_complete("fragile");
+  const NodeId victim = placed.value()[0].shards[0].worker_id;
+  BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
+  BT_EXPECT(!ks.object_exists("fragile").value());
+  BT_EXPECT_EQ(ks.counters().objects_lost.load(), 1ull);
+}

@@ -1,0 +1,44 @@
+// Minimal HTTP/1.1 server exposing Prometheus text metrics + /healthz.
+//
+// Parity target: the reference runs a coro_http metrics server but never
+// registers the /metrics route (rpc_service.cpp:387-390, README claims
+// notwithstanding) — here it is real.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <string>
+#include <thread>
+
+#include "btpu/net/net.h"
+
+namespace btpu::keystone {
+class KeystoneService;
+}
+
+namespace btpu::rpc {
+
+class MetricsHttpServer {
+ public:
+  MetricsHttpServer(keystone::KeystoneService& service, std::string host, uint16_t port);
+  ~MetricsHttpServer();
+
+  ErrorCode start();
+  void stop();
+  uint16_t port() const noexcept { return port_; }
+
+  // Prometheus exposition text for the wrapped keystone (exposed for tests).
+  std::string render_metrics() const;
+
+ private:
+  void accept_loop();
+
+  keystone::KeystoneService& service_;
+  std::string host_;
+  uint16_t port_;
+  net::Socket listener_;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+};
+
+}  // namespace btpu::rpc

@@ -1,0 +1,31 @@
+// Keystone RPC protocol: opcodes map 1:1 to KeystoneService methods.
+//
+// Parity target: reference include/blackbird/rpc/rpc_service.h:28-274 — 14
+// rpc_* handlers over YLT coro_rpc (rpc_service.cpp:360-385). Framing is the
+// shared net.h frame: [u32 len][u8 opcode][wire-encoded struct]; responses
+// reuse the request opcode.
+#pragma once
+
+#include <cstdint>
+
+namespace btpu::rpc {
+
+enum class Method : uint8_t {
+  kObjectExists = 1,
+  kGetWorkers = 2,
+  kPutStart = 3,
+  kPutComplete = 4,
+  kPutCancel = 5,
+  kRemoveObject = 6,
+  kRemoveAllObjects = 7,
+  kGetClusterStats = 8,
+  kGetViewVersion = 9,
+  kBatchObjectExists = 10,
+  kBatchGetWorkers = 11,
+  kBatchPutStart = 12,
+  kBatchPutComplete = 13,
+  kBatchPutCancel = 14,
+  kPing = 15,
+};
+
+}  // namespace btpu::rpc

@@ -1,0 +1,53 @@
+// Keystone RPC client: same method surface as KeystoneService, over TCP.
+// Reconnects transparently after keystone restarts (one retry per call).
+#pragma once
+
+#include <mutex>
+
+#include "btpu/common/types.h"
+#include "btpu/net/net.h"
+
+namespace btpu::rpc {
+
+class KeystoneRpcClient {
+ public:
+  explicit KeystoneRpcClient(std::string endpoint);
+  ~KeystoneRpcClient();
+
+  ErrorCode connect();
+  void disconnect();
+  bool connected() const noexcept { return sock_.valid(); }
+
+  Result<bool> object_exists(const ObjectKey& key);
+  Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
+  Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
+                                               const WorkerConfig& config);
+  ErrorCode put_complete(const ObjectKey& key);
+  ErrorCode put_cancel(const ObjectKey& key);
+  ErrorCode remove_object(const ObjectKey& key);
+  Result<uint64_t> remove_all_objects();
+  Result<ClusterStats> get_cluster_stats();
+  Result<ViewVersionId> get_view_version();
+  Result<ViewVersionId> ping();
+
+  Result<std::vector<Result<bool>>> batch_object_exists(const std::vector<ObjectKey>& keys);
+  Result<std::vector<Result<std::vector<CopyPlacement>>>> batch_get_workers(
+      const std::vector<ObjectKey>& keys);
+  Result<std::vector<Result<std::vector<CopyPlacement>>>> batch_put_start(
+      const std::vector<BatchPutStartItem>& items);
+  Result<std::vector<ErrorCode>> batch_put_complete(const std::vector<ObjectKey>& keys);
+  Result<std::vector<ErrorCode>> batch_put_cancel(const std::vector<ObjectKey>& keys);
+
+ private:
+  template <typename Req, typename Resp>
+  ErrorCode call(uint8_t opcode, const Req& req, Resp& resp);
+  ErrorCode call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
+                     std::vector<uint8_t>& resp);
+  ErrorCode ensure_connected_locked();
+
+  std::string endpoint_;
+  std::mutex mutex_;
+  net::Socket sock_;
+};
+
+}  // namespace btpu::rpc

@@ -1,0 +1,57 @@
+// RPC server wrapping a KeystoneService, plus the bootstrap helper.
+// Parity target: reference RpcService (rpc_service.h:28-274,
+// create_and_start_keystone rpc_service.cpp:434-467).
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "btpu/keystone/keystone.h"
+#include "btpu/net/net.h"
+#include "btpu/rpc/http_metrics.h"
+
+namespace btpu::rpc {
+
+class KeystoneRpcServer {
+ public:
+  KeystoneRpcServer(keystone::KeystoneService& service, std::string host, uint16_t port);
+  ~KeystoneRpcServer();
+
+  ErrorCode start();
+  void stop();
+  uint16_t port() const noexcept { return port_; }
+  std::string endpoint() const { return host_ + ":" + std::to_string(port_); }
+
+ private:
+  void accept_loop();
+  void serve(std::shared_ptr<net::Socket> sock);
+  std::vector<uint8_t> dispatch(uint8_t opcode, const std::vector<uint8_t>& payload);
+
+  keystone::KeystoneService& service_;
+  std::string host_;
+  uint16_t port_;
+  net::Socket listener_;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conns_mutex_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<std::shared_ptr<net::Socket>> conns_;
+};
+
+// Bundled keystone + RPC + metrics, one call to boot a control plane
+// (reference create_and_start_keystone).
+struct KeystoneStack {
+  std::unique_ptr<keystone::KeystoneService> service;
+  std::unique_ptr<KeystoneRpcServer> rpc;
+  std::unique_ptr<MetricsHttpServer> metrics;
+
+  ~KeystoneStack();
+  void stop();
+};
+
+Result<std::unique_ptr<KeystoneStack>> create_and_start_keystone(
+    const KeystoneConfig& config, std::shared_ptr<coord::Coordinator> coordinator);
+
+}  // namespace btpu::rpc

@@ -1,0 +1,118 @@
+#include "btpu/rpc/http_metrics.h"
+
+#include <sstream>
+
+#include "btpu/common/log.h"
+#include "btpu/keystone/keystone.h"
+
+namespace btpu::rpc {
+
+MetricsHttpServer::MetricsHttpServer(keystone::KeystoneService& service, std::string host,
+                                     uint16_t port)
+    : service_(service), host_(std::move(host)), port_(port) {}
+
+MetricsHttpServer::~MetricsHttpServer() { stop(); }
+
+ErrorCode MetricsHttpServer::start() {
+  uint16_t bound = 0;
+  auto listener = net::tcp_listen(host_, port_, &bound);
+  if (!listener.ok()) return listener.error();
+  listener_ = std::move(listener).value();
+  port_ = bound;
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  LOG_INFO << "metrics http on " << host_ << ":" << port_;
+  return ErrorCode::OK;
+}
+
+void MetricsHttpServer::stop() {
+  if (!running_.exchange(false)) return;
+  listener_.close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+}
+
+std::string MetricsHttpServer::render_metrics() const {
+  std::ostringstream out;
+  const auto& c = service_.counters();
+  auto counter = [&](const char* name, const char* help, uint64_t value) {
+    out << "# HELP " << name << " " << help << "\n# TYPE " << name << " counter\n"
+        << name << " " << value << "\n";
+  };
+  auto gauge = [&](const std::string& name, const char* help, double value,
+                   const std::string& labels = "") {
+    out << "# HELP " << name << " " << help << "\n# TYPE " << name << " gauge\n"
+        << name << labels << " " << value << "\n";
+  };
+
+  counter("btpu_put_starts_total", "put_start calls", c.put_starts.load());
+  counter("btpu_put_completes_total", "put_complete calls", c.put_completes.load());
+  counter("btpu_put_cancels_total", "put_cancel calls", c.put_cancels.load());
+  counter("btpu_gets_total", "get_workers calls", c.gets.load());
+  counter("btpu_removes_total", "remove_object calls", c.removes.load());
+  counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());
+  counter("btpu_evicted_total", "objects evicted for watermark pressure", c.evicted.load());
+  counter("btpu_workers_lost_total", "workers declared dead", c.workers_lost.load());
+  counter("btpu_objects_repaired_total", "objects re-replicated after worker death",
+          c.objects_repaired.load());
+  counter("btpu_objects_lost_total", "objects lost with their last replica",
+          c.objects_lost.load());
+
+  auto stats = service_.get_cluster_stats();
+  if (stats.ok()) {
+    const auto& s = stats.value();
+    gauge("btpu_workers", "registered workers", static_cast<double>(s.total_workers));
+    gauge("btpu_memory_pools", "registered memory pools",
+          static_cast<double>(s.total_memory_pools));
+    gauge("btpu_objects", "stored objects", static_cast<double>(s.total_objects));
+    gauge("btpu_capacity_bytes", "total cluster capacity",
+          static_cast<double>(s.total_capacity));
+    gauge("btpu_used_bytes", "allocated bytes", static_cast<double>(s.used_capacity));
+    gauge("btpu_utilization", "used/capacity", s.avg_utilization);
+  }
+  gauge("btpu_view_version", "placement view version",
+        static_cast<double>(service_.get_view_version()));
+  gauge("btpu_keystone_leader", "1 when this keystone holds leadership",
+        service_.is_leader() ? 1.0 : 0.0);
+  return out.str();
+}
+
+void MetricsHttpServer::accept_loop() {
+  while (running_) {
+    auto sock = net::tcp_accept(listener_, 200);
+    if (!sock.ok()) continue;
+    net::Socket conn = std::move(sock).value();
+    // Minimal HTTP: read until end of headers, answer, close.
+    std::string request;
+    char buf[2048];
+    while (request.find("\r\n\r\n") == std::string::npos) {
+      ssize_t n = ::read(conn.fd(), buf, sizeof(buf));
+      if (n <= 0) break;
+      request.append(buf, static_cast<size_t>(n));
+      if (request.size() > 64 * 1024) break;
+    }
+    std::string path;
+    {
+      auto sp1 = request.find(' ');
+      auto sp2 = request.find(' ', sp1 + 1);
+      if (sp1 != std::string::npos && sp2 != std::string::npos)
+        path = request.substr(sp1 + 1, sp2 - sp1 - 1);
+    }
+    std::string body, status = "200 OK", content_type = "text/plain; version=0.0.4";
+    if (path == "/metrics") {
+      body = render_metrics();
+    } else if (path == "/healthz") {
+      body = "ok\n";
+    } else {
+      status = "404 Not Found";
+      body = "not found\n";
+    }
+    std::ostringstream resp;
+    resp << "HTTP/1.1 " << status << "\r\nContent-Type: " << content_type
+         << "\r\nContent-Length: " << body.size() << "\r\nConnection: close\r\n\r\n"
+         << body;
+    const std::string text = resp.str();
+    net::write_all(conn.fd(), text.data(), text.size());
+  }
+}
+
+}  // namespace btpu::rpc

@@ -1,0 +1,186 @@
+#include "btpu/rpc/rpc_client.h"
+
+#include "btpu/common/log.h"
+#include "btpu/common/wire.h"
+#include "btpu/rpc/rpc.h"
+
+namespace btpu::rpc {
+
+KeystoneRpcClient::KeystoneRpcClient(std::string endpoint) : endpoint_(std::move(endpoint)) {}
+
+KeystoneRpcClient::~KeystoneRpcClient() { disconnect(); }
+
+ErrorCode KeystoneRpcClient::connect() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return ensure_connected_locked();
+}
+
+void KeystoneRpcClient::disconnect() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  sock_.shutdown();
+  sock_.close();
+}
+
+ErrorCode KeystoneRpcClient::ensure_connected_locked() {
+  if (sock_.valid()) return ErrorCode::OK;
+  auto hp = net::parse_host_port(endpoint_);
+  if (!hp) return ErrorCode::INVALID_ADDRESS;
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  if (!sock.ok()) return sock.error();
+  sock_ = std::move(sock).value();
+  return ErrorCode::OK;
+}
+
+ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
+                                      std::vector<uint8_t>& resp) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (ensure_connected_locked() != ErrorCode::OK) {
+      if (attempt == 1) return ErrorCode::CONNECTION_FAILED;
+      continue;
+    }
+    if (net::send_frame(sock_.fd(), opcode, req.data(), req.size()) == ErrorCode::OK) {
+      uint8_t resp_op = 0;
+      if (net::recv_frame(sock_.fd(), resp_op, resp) == ErrorCode::OK && resp_op == opcode) {
+        return ErrorCode::OK;
+      }
+    }
+    // Stale connection (keystone restarted): drop and retry once.
+    sock_.close();
+  }
+  return ErrorCode::RPC_FAILED;
+}
+
+template <typename Req, typename Resp>
+ErrorCode KeystoneRpcClient::call(uint8_t opcode, const Req& req, Resp& resp) {
+  std::vector<uint8_t> resp_bytes;
+  BTPU_RETURN_IF_ERROR(call_raw(opcode, wire::to_bytes(req), resp_bytes));
+  if (!wire::from_bytes(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
+  return ErrorCode::OK;
+}
+
+Result<bool> KeystoneRpcClient::object_exists(const ObjectKey& key) {
+  ObjectExistsResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kObjectExists),
+                            ObjectExistsRequest{key}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return resp.exists;
+}
+
+Result<std::vector<CopyPlacement>> KeystoneRpcClient::get_workers(const ObjectKey& key) {
+  GetWorkersResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kGetWorkers), GetWorkersRequest{key},
+                            resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.copies);
+}
+
+Result<std::vector<CopyPlacement>> KeystoneRpcClient::put_start(const ObjectKey& key,
+                                                                uint64_t size,
+                                                                const WorkerConfig& config) {
+  PutStartResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutStart),
+                            PutStartRequest{key, size, config}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.copies);
+}
+
+ErrorCode KeystoneRpcClient::put_complete(const ObjectKey& key) {
+  PutCompleteResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutComplete),
+                            PutCompleteRequest{key}, resp));
+  return resp.error_code;
+}
+
+ErrorCode KeystoneRpcClient::put_cancel(const ObjectKey& key) {
+  PutCancelResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutCancel), PutCancelRequest{key},
+                            resp));
+  return resp.error_code;
+}
+
+ErrorCode KeystoneRpcClient::remove_object(const ObjectKey& key) {
+  RemoveObjectResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kRemoveObject),
+                            RemoveObjectRequest{key}, resp));
+  return resp.error_code;
+}
+
+Result<uint64_t> KeystoneRpcClient::remove_all_objects() {
+  RemoveAllObjectsResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kRemoveAllObjects),
+                            RemoveAllObjectsRequest{}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return resp.objects_removed;
+}
+
+Result<ClusterStats> KeystoneRpcClient::get_cluster_stats() {
+  GetClusterStatsResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kGetClusterStats),
+                            GetClusterStatsRequest{}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return resp.stats;
+}
+
+Result<ViewVersionId> KeystoneRpcClient::get_view_version() {
+  GetViewVersionResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kGetViewVersion),
+                            GetViewVersionRequest{}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return resp.view_version;
+}
+
+Result<ViewVersionId> KeystoneRpcClient::ping() {
+  std::vector<uint8_t> resp_bytes;
+  BTPU_RETURN_IF_ERROR(call_raw(static_cast<uint8_t>(Method::kPing), {}, resp_bytes));
+  PingResponse resp;
+  if (!wire::from_bytes(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
+  return resp.view_version;
+}
+
+Result<std::vector<Result<bool>>> KeystoneRpcClient::batch_object_exists(
+    const std::vector<ObjectKey>& keys) {
+  BatchObjectExistsResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchObjectExists),
+                            BatchObjectExistsRequest{keys}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.results);
+}
+
+Result<std::vector<Result<std::vector<CopyPlacement>>>> KeystoneRpcClient::batch_get_workers(
+    const std::vector<ObjectKey>& keys) {
+  BatchGetWorkersResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchGetWorkers),
+                            BatchGetWorkersRequest{keys}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.results);
+}
+
+Result<std::vector<Result<std::vector<CopyPlacement>>>> KeystoneRpcClient::batch_put_start(
+    const std::vector<BatchPutStartItem>& items) {
+  BatchPutStartResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchPutStart),
+                            BatchPutStartRequest{items}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.results);
+}
+
+Result<std::vector<ErrorCode>> KeystoneRpcClient::batch_put_complete(
+    const std::vector<ObjectKey>& keys) {
+  BatchPutCompleteResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchPutComplete),
+                            BatchPutCompleteRequest{keys}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.results);
+}
+
+Result<std::vector<ErrorCode>> KeystoneRpcClient::batch_put_cancel(
+    const std::vector<ObjectKey>& keys) {
+  BatchPutCancelResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchPutCancel),
+                            BatchPutCancelRequest{keys}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.results);
+}
+
+}  // namespace btpu::rpc

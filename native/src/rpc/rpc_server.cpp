@@ -1,0 +1,202 @@
+#include "btpu/rpc/rpc_server.h"
+
+#include "btpu/common/log.h"
+#include "btpu/common/wire.h"
+#include "btpu/rpc/rpc.h"
+
+namespace btpu::rpc {
+
+using wire::Reader;
+using wire::Writer;
+
+KeystoneRpcServer::KeystoneRpcServer(keystone::KeystoneService& service, std::string host,
+                                     uint16_t port)
+    : service_(service), host_(std::move(host)), port_(port) {}
+
+KeystoneRpcServer::~KeystoneRpcServer() { stop(); }
+
+ErrorCode KeystoneRpcServer::start() {
+  uint16_t bound = 0;
+  auto listener = net::tcp_listen(host_, port_, &bound);
+  if (!listener.ok()) return listener.error();
+  listener_ = std::move(listener).value();
+  port_ = bound;
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  LOG_INFO << "keystone rpc listening on " << endpoint();
+  return ErrorCode::OK;
+}
+
+void KeystoneRpcServer::stop() {
+  if (!running_.exchange(false)) return;
+  listener_.close();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::vector<std::thread> threads;
+  {
+    std::lock_guard<std::mutex> lock(conns_mutex_);
+    threads.swap(conn_threads_);
+    for (auto& s : conns_) s->shutdown();
+    conns_.clear();
+  }
+  for (auto& t : threads)
+    if (t.joinable()) t.join();
+}
+
+void KeystoneRpcServer::accept_loop() {
+  while (running_) {
+    auto sock = net::tcp_accept(listener_, 200);
+    if (!sock.ok()) continue;
+    auto conn = std::make_shared<net::Socket>(std::move(sock).value());
+    std::lock_guard<std::mutex> lock(conns_mutex_);
+    conns_.push_back(conn);
+    conn_threads_.emplace_back([this, conn] { serve(conn); });
+  }
+}
+
+void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
+  const int fd = sock->fd();
+  uint8_t opcode = 0;
+  std::vector<uint8_t> payload;
+  while (running_) {
+    if (net::recv_frame(fd, opcode, payload) != ErrorCode::OK) break;
+    auto response = dispatch(opcode, payload);
+    if (net::send_frame(fd, opcode, response.data(), response.size()) != ErrorCode::OK) break;
+  }
+}
+
+namespace {
+// Decodes the request, runs the handler, encodes the response; malformed
+// requests produce a response whose error_code is INVALID_PARAMETERS.
+template <typename Req, typename Resp, typename Handler>
+std::vector<uint8_t> handle(const std::vector<uint8_t>& payload, Handler&& handler) {
+  Req req{};
+  Resp resp{};
+  if (!wire::from_bytes(payload, req)) {
+    resp.error_code = ErrorCode::INVALID_PARAMETERS;
+  } else {
+    try {
+      handler(req, resp);
+    } catch (const std::exception& e) {
+      LOG_ERROR << "rpc handler threw: " << e.what();
+      resp.error_code = ErrorCode::INTERNAL_ERROR;
+    }
+  }
+  return wire::to_bytes(resp);
+}
+}  // namespace
+
+std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
+                                                 const std::vector<uint8_t>& payload) {
+  auto& ks = service_;
+  switch (static_cast<Method>(opcode)) {
+    case Method::kObjectExists:
+      return handle<ObjectExistsRequest, ObjectExistsResponse>(
+          payload, [&](const auto& req, auto& resp) {
+            auto r = ks.object_exists(req.key);
+            if (r.ok()) resp.exists = r.value();
+            resp.error_code = r.error();
+          });
+    case Method::kGetWorkers:
+      return handle<GetWorkersRequest, GetWorkersResponse>(
+          payload, [&](const auto& req, auto& resp) {
+            auto r = ks.get_workers(req.key);
+            if (r.ok()) resp.copies = std::move(r).value();
+            resp.error_code = r.error();
+          });
+    case Method::kPutStart:
+      return handle<PutStartRequest, PutStartResponse>(payload, [&](const auto& req, auto& resp) {
+        auto r = ks.put_start(req.key, req.data_size, req.config);
+        if (r.ok()) resp.copies = std::move(r).value();
+        resp.error_code = r.error();
+      });
+    case Method::kPutComplete:
+      return handle<PutCompleteRequest, PutCompleteResponse>(
+          payload, [&](const auto& req, auto& resp) { resp.error_code = ks.put_complete(req.key); });
+    case Method::kPutCancel:
+      return handle<PutCancelRequest, PutCancelResponse>(
+          payload, [&](const auto& req, auto& resp) { resp.error_code = ks.put_cancel(req.key); });
+    case Method::kRemoveObject:
+      return handle<RemoveObjectRequest, RemoveObjectResponse>(
+          payload, [&](const auto& req, auto& resp) { resp.error_code = ks.remove_object(req.key); });
+    case Method::kRemoveAllObjects:
+      return handle<RemoveAllObjectsRequest, RemoveAllObjectsResponse>(
+          payload, [&](const auto&, auto& resp) {
+            auto r = ks.remove_all_objects();
+            if (r.ok()) resp.objects_removed = r.value();
+            resp.error_code = r.error();
+          });
+    case Method::kGetClusterStats:
+      return handle<GetClusterStatsRequest, GetClusterStatsResponse>(
+          payload, [&](const auto&, auto& resp) {
+            auto r = ks.get_cluster_stats();
+            if (r.ok()) resp.stats = r.value();
+            resp.error_code = r.error();
+          });
+    case Method::kGetViewVersion:
+      return handle<GetViewVersionRequest, GetViewVersionResponse>(
+          payload, [&](const auto&, auto& resp) { resp.view_version = ks.get_view_version(); });
+    case Method::kBatchObjectExists:
+      return handle<BatchObjectExistsRequest, BatchObjectExistsResponse>(
+          payload,
+          [&](const auto& req, auto& resp) { resp.results = ks.batch_object_exists(req.keys); });
+    case Method::kBatchGetWorkers:
+      return handle<BatchGetWorkersRequest, BatchGetWorkersResponse>(
+          payload,
+          [&](const auto& req, auto& resp) { resp.results = ks.batch_get_workers(req.keys); });
+    case Method::kBatchPutStart:
+      return handle<BatchPutStartRequest, BatchPutStartResponse>(
+          payload,
+          [&](const auto& req, auto& resp) { resp.results = ks.batch_put_start(req.requests); });
+    case Method::kBatchPutComplete:
+      return handle<BatchPutCompleteRequest, BatchPutCompleteResponse>(
+          payload,
+          [&](const auto& req, auto& resp) { resp.results = ks.batch_put_complete(req.keys); });
+    case Method::kBatchPutCancel:
+      return handle<BatchPutCancelRequest, BatchPutCancelResponse>(
+          payload,
+          [&](const auto& req, auto& resp) { resp.results = ks.batch_put_cancel(req.keys); });
+    case Method::kPing: {
+      PingResponse resp{service_.get_view_version()};
+      return wire::to_bytes(resp);
+    }
+  }
+  LOG_WARN << "unknown rpc opcode " << int(opcode);
+  Writer w;
+  w.put(ErrorCode::NOT_IMPLEMENTED);
+  return w.take();
+}
+
+// ---- bundled stack --------------------------------------------------------
+
+KeystoneStack::~KeystoneStack() { stop(); }
+
+void KeystoneStack::stop() {
+  if (metrics) metrics->stop();
+  if (rpc) rpc->stop();
+  if (service) service->stop();
+}
+
+Result<std::unique_ptr<KeystoneStack>> create_and_start_keystone(
+    const KeystoneConfig& config, std::shared_ptr<coord::Coordinator> coordinator) {
+  auto stack = std::make_unique<KeystoneStack>();
+  stack->service = std::make_unique<keystone::KeystoneService>(config, std::move(coordinator));
+  BTPU_RETURN_IF_ERROR(stack->service->initialize());
+  BTPU_RETURN_IF_ERROR(stack->service->start());
+
+  auto hp = net::parse_host_port(config.listen_address);
+  if (!hp) return ErrorCode::INVALID_ADDRESS;
+  stack->rpc = std::make_unique<KeystoneRpcServer>(*stack->service, hp->host, hp->port);
+  BTPU_RETURN_IF_ERROR(stack->rpc->start());
+
+  uint16_t metrics_port = 0;
+  try {
+    metrics_port = static_cast<uint16_t>(std::stoi(config.http_metrics_port));
+  } catch (...) {
+    metrics_port = 0;
+  }
+  stack->metrics = std::make_unique<MetricsHttpServer>(*stack->service, hp->host, metrics_port);
+  BTPU_RETURN_IF_ERROR(stack->metrics->start());
+  return stack;
+}
+
+}  // namespace btpu::rpc

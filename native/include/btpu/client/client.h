@@ -1,0 +1,68 @@
+// Object client SDK: put/get orchestration over keystone RPC + one-sided
+// data transfers.
+//
+// Parity target: reference include/blackbird/client/blackbird_client.h:22-138
+// / src/client/blackbird_client.cpp. Fixes the documented reference defects
+// (SURVEY §2 BlackbirdClient row):
+//   * local buffer offsets use a running per-copy offset, not
+//     `data + remote_addr` (reference blackbird_client.cpp:233);
+//   * region keys come from the shard's MemoryLocation.rkey as filled by the
+//     allocator from worker advertisements, not the never-populated
+//     endpoint.worker_key (reference :225,310);
+//   * get() fails over across replicas instead of only trying copies.front()
+//     (reference :283 TODO);
+//   * transfers reuse pooled transport connections (reference created a UCX
+//     endpoint per transfer).
+#pragma once
+
+#include <memory>
+
+#include "btpu/keystone/keystone.h"
+#include "btpu/rpc/rpc_client.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::client {
+
+struct ClientOptions {
+  std::string keystone_address;   // "host:port"
+  size_t io_parallelism{8};       // concurrent shard transfers
+  WorkerConfig default_config;    // placement policy defaults for put()
+};
+
+class ObjectClient {
+ public:
+  explicit ObjectClient(ClientOptions options);
+  // Embedded mode: talk to an in-process keystone directly (no RPC).
+  ObjectClient(ClientOptions options, keystone::KeystoneService* embedded);
+  ~ObjectClient();
+
+  ErrorCode connect();
+
+  Result<bool> object_exists(const ObjectKey& key);
+  Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
+
+  ErrorCode put(const ObjectKey& key, const void* data, uint64_t size);
+  ErrorCode put(const ObjectKey& key, const void* data, uint64_t size,
+                const WorkerConfig& config);
+  Result<std::vector<uint8_t>> get(const ObjectKey& key);
+  // Zero-allocation variant; buffer must hold the object (size returned).
+  Result<uint64_t> get_into(const ObjectKey& key, void* buffer, uint64_t buffer_size);
+
+  ErrorCode remove(const ObjectKey& key);
+  Result<uint64_t> remove_all();
+  Result<ClusterStats> cluster_stats();
+  Result<ViewVersionId> ping();
+
+ private:
+  // Writes `data` into every shard of `copy` (running offset), in parallel.
+  ErrorCode transfer_copy_put(const CopyPlacement& copy, const uint8_t* data, uint64_t size);
+  ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);
+  ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);
+
+  ClientOptions options_;
+  std::unique_ptr<rpc::KeystoneRpcClient> rpc_;
+  keystone::KeystoneService* embedded_{nullptr};
+  std::unique_ptr<transport::TransportClient> data_;
+};
+
+}  // namespace btpu::client

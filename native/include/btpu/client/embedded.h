@@ -1,0 +1,54 @@
+// Embedded cluster: keystone + N workers + client in one process. The
+// hermetic harness used by tests, the benchmark, and the Python bindings —
+// the reference has no equivalent (its distributed behavior is only
+// exercised by a localhost shell script, SURVEY §4).
+#pragma once
+
+#include <memory>
+
+#include "btpu/client/client.h"
+#include "btpu/coord/mem_coordinator.h"
+#include "btpu/worker/worker.h"
+
+namespace btpu::client {
+
+struct EmbeddedClusterOptions {
+  KeystoneConfig keystone;
+  std::vector<worker::WorkerServiceConfig> workers;
+  bool use_coordinator{true};  // in-memory coordinator wiring vs direct feed
+  TransportKind transport{TransportKind::LOCAL};
+
+  // Convenience: n workers x one RAM pool of pool_bytes each.
+  static EmbeddedClusterOptions simple(size_t n_workers, uint64_t pool_bytes,
+                                       StorageClass cls = StorageClass::RAM_CPU);
+};
+
+class EmbeddedCluster {
+ public:
+  explicit EmbeddedCluster(EmbeddedClusterOptions options);
+  ~EmbeddedCluster();
+
+  ErrorCode start();
+  void stop();
+
+  keystone::KeystoneService& keystone() { return *keystone_; }
+  worker::WorkerService& worker(size_t i) { return *workers_.at(i); }
+  size_t worker_count() const { return workers_.size(); }
+  coord::MemCoordinator* coordinator() { return coordinator_.get(); }
+
+  // A client wired to this cluster (embedded keystone, local data plane).
+  std::unique_ptr<ObjectClient> make_client(ClientOptions options = {});
+
+  // Kills worker i abruptly (no clean unregister): stops heartbeats and
+  // drops its transport, as a preemption would.
+  void kill_worker(size_t i);
+
+ private:
+  EmbeddedClusterOptions options_;
+  std::shared_ptr<coord::MemCoordinator> coordinator_;
+  std::unique_ptr<keystone::KeystoneService> keystone_;
+  std::vector<std::unique_ptr<worker::WorkerService>> workers_;
+  bool running_{false};
+};
+
+}  // namespace btpu::client

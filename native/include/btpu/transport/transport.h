@@ -24,11 +24,17 @@
 //   * every access is validated against the registered region bounds + rkey.
 #pragma once
 
+#include <functional>
 #include <memory>
 
 #include "btpu/common/types.h"
 
 namespace btpu::transport {
+
+// Accessors for regions without a flat host mapping (io_uring files, HBM
+// device memory): the transport server forwards one-sided ops to these.
+using RegionReadFn = std::function<ErrorCode(uint64_t offset, void* dst, uint64_t len)>;
+using RegionWriteFn = std::function<ErrorCode(uint64_t offset, const void* src, uint64_t len)>;
 
 // Worker side: owns registered regions and (for wire transports) a listener.
 class TransportServer {
@@ -51,6 +57,18 @@ class TransportServer {
     (void)len;
     (void)tag;
     return nullptr;
+  }
+  // Registers a callback-backed region (addresses are offsets starting at the
+  // descriptor's remote_base = 0). Supported by LOCAL and TCP; SHM regions
+  // are memory by definition.
+  virtual Result<RemoteDescriptor> register_virtual_region(uint64_t len, const std::string& tag,
+                                                           RegionReadFn read_fn,
+                                                           RegionWriteFn write_fn) {
+    (void)len;
+    (void)tag;
+    (void)read_fn;
+    (void)write_fn;
+    return ErrorCode::NOT_IMPLEMENTED;
   }
 };
 

@@ -1,0 +1,94 @@
+// Worker service: the data plane. Builds tiered storage pools, registers
+// them with the transport, advertises pools + itself through the
+// coordination service, and heartbeats. After registration workers never
+// touch the data path — clients move bytes with one-sided transfers.
+//
+// Parity target: reference include/blackbird/worker/worker_service.h:21-154 /
+// src/worker/worker_service.cpp (YAML config :25-108, backend construction
+// :317-360, transport registration :167-221, advertisement :399-432,
+// heartbeat :434-459, key deletion on stop :256-297). Changes:
+//   * all tiers advertise, including NVME/SSD (reference's factory gap) and
+//     HBM (reference flags RAM_GPU registration broken, :196) — non-mapped
+//     tiers ride callback-backed virtual transport regions;
+//   * transport is chosen per config (tcp | shm | local), not hard-coded UCX.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <thread>
+
+#include "btpu/coord/coordinator.h"
+#include "btpu/keystone/keystone.h"
+#include "btpu/storage/backend.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::worker {
+
+struct PoolConfig {
+  std::string id;
+  StorageClass storage_class{StorageClass::RAM_CPU};
+  uint64_t capacity{0};
+  std::string path;       // disk tiers
+  std::string device_id;  // hbm tier ("tpu:0")
+};
+
+struct WorkerServiceConfig {
+  NodeId worker_id;
+  std::string cluster_id{kDefaultClusterId};
+  std::string coord_endpoints;  // "" = standalone (keystone fed directly)
+  TransportKind transport{TransportKind::TCP};
+  std::string listen_host{"0.0.0.0"};
+  uint16_t listen_port{0};  // 0 = ephemeral, advertised after bind
+  TopoCoord topo;
+  int64_t heartbeat_ttl_ms{10000};
+  int64_t heartbeat_interval_ms{5000};
+  std::vector<PoolConfig> pools;
+
+  // Loads the YAML subset schema (configs/worker.yaml). Throws
+  // std::runtime_error on parse/validation failure.
+  static WorkerServiceConfig from_yaml(const std::string& file_path);
+  ErrorCode validate() const;
+};
+
+class WorkerService {
+ public:
+  WorkerService(WorkerServiceConfig config, std::shared_ptr<coord::Coordinator> coordinator);
+  ~WorkerService();
+
+  ErrorCode initialize();  // backends + transports + regions
+  ErrorCode start();       // advertise + heartbeat
+  void stop();
+
+  const WorkerServiceConfig& config() const noexcept { return config_; }
+  // Advertised pool records (valid after initialize()).
+  std::vector<MemoryPool> pools() const;
+  keystone::WorkerInfo info() const;
+  // Worker-local stats per pool.
+  std::vector<std::pair<std::string, storage::StorageStats>> stats() const;
+  storage::StorageBackend* backend(const std::string& pool_id);
+
+ private:
+  void heartbeat_loop();
+  void advertise();
+
+  WorkerServiceConfig config_;
+  std::shared_ptr<coord::Coordinator> coordinator_;
+  std::unique_ptr<transport::TransportServer> primary_transport_;
+  std::unique_ptr<transport::TransportServer> virtual_transport_;  // for non-mapped tiers
+
+  struct PoolRuntime {
+    PoolConfig config;
+    std::unique_ptr<storage::StorageBackend> backend;
+    MemoryPool record;
+  };
+  std::vector<PoolRuntime> pools_;
+
+  std::atomic<bool> running_{false};
+  std::thread heartbeat_thread_;
+  std::condition_variable stop_cv_;
+  std::mutex stop_mutex_;
+  bool initialized_{false};
+};
+
+}  // namespace btpu::worker

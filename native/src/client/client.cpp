@@ -1,0 +1,207 @@
+#include "btpu/client/client.h"
+
+#include <atomic>
+#include <thread>
+
+#include "btpu/common/log.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::client {
+
+ObjectClient::ObjectClient(ClientOptions options)
+    : options_(std::move(options)), data_(transport::make_transport_client()) {
+  rpc_ = std::make_unique<rpc::KeystoneRpcClient>(options_.keystone_address);
+}
+
+ObjectClient::ObjectClient(ClientOptions options, keystone::KeystoneService* embedded)
+    : options_(std::move(options)),
+      embedded_(embedded),
+      data_(transport::make_transport_client()) {}
+
+ObjectClient::~ObjectClient() = default;
+
+ErrorCode ObjectClient::connect() {
+  if (embedded_) return ErrorCode::OK;
+  return rpc_->connect();
+}
+
+Result<bool> ObjectClient::object_exists(const ObjectKey& key) {
+  return embedded_ ? embedded_->object_exists(key) : rpc_->object_exists(key);
+}
+
+Result<std::vector<CopyPlacement>> ObjectClient::get_workers(const ObjectKey& key) {
+  return embedded_ ? embedded_->get_workers(key) : rpc_->get_workers(key);
+}
+
+ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size) {
+  return put(key, data, size, options_.default_config);
+}
+
+ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
+                            const WorkerConfig& config) {
+  auto placed = embedded_ ? embedded_->put_start(key, size, config)
+                          : rpc_->put_start(key, size, config);
+  if (!placed.ok()) return placed.error();
+
+  const auto* bytes = static_cast<const uint8_t*>(data);
+  for (const auto& copy : placed.value()) {
+    if (auto ec = transfer_copy_put(copy, bytes, size); ec != ErrorCode::OK) {
+      // Roll back the reservation (reference blackbird_client.cpp:104-107).
+      LOG_WARN << "put " << key << " transfer failed (" << to_string(ec) << "), cancelling";
+      if (embedded_) {
+        embedded_->put_cancel(key);
+      } else {
+        rpc_->put_cancel(key);
+      }
+      return ec;
+    }
+  }
+  return embedded_ ? embedded_->put_complete(key) : rpc_->put_complete(key);
+}
+
+Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
+  auto copies = get_workers(key);
+  if (!copies.ok()) return copies.error();
+  uint64_t size = 0;
+  if (!copies.value().empty()) {
+    for (const auto& shard : copies.value().front().shards) size += shard.length;
+  }
+  std::vector<uint8_t> buffer(size);
+  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
+  for (const auto& copy : copies.value()) {
+    uint64_t copy_size = 0;
+    for (const auto& shard : copy.shards) copy_size += shard.length;
+    if (copy_size != size) buffer.resize(copy_size);
+    if (auto ec = transfer_copy_get(copy, buffer.data(), copy_size); ec == ErrorCode::OK) {
+      return buffer;
+    } else {
+      last = ec;
+      LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
+               << to_string(ec) << "), trying next replica";
+    }
+  }
+  return last;
+}
+
+Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
+                                        uint64_t buffer_size) {
+  auto copies = get_workers(key);
+  if (!copies.ok()) return copies.error();
+  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
+  for (const auto& copy : copies.value()) {
+    uint64_t copy_size = 0;
+    for (const auto& shard : copy.shards) copy_size += shard.length;
+    if (copy_size > buffer_size) return ErrorCode::BUFFER_OVERFLOW;
+    if (auto ec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer), copy_size);
+        ec == ErrorCode::OK) {
+      return copy_size;
+    } else {
+      last = ec;
+    }
+  }
+  return last;
+}
+
+ErrorCode ObjectClient::remove(const ObjectKey& key) {
+  return embedded_ ? embedded_->remove_object(key) : rpc_->remove_object(key);
+}
+
+Result<uint64_t> ObjectClient::remove_all() {
+  return embedded_ ? embedded_->remove_all_objects() : rpc_->remove_all_objects();
+}
+
+Result<ClusterStats> ObjectClient::cluster_stats() {
+  return embedded_ ? embedded_->get_cluster_stats() : rpc_->get_cluster_stats();
+}
+
+Result<ViewVersionId> ObjectClient::ping() {
+  if (embedded_) return embedded_->get_view_version();
+  return rpc_->ping();
+}
+
+// One shard transfer; `buf` already points at the shard's slice of the
+// object buffer (running-offset math lives in the copy-level loop).
+ErrorCode ObjectClient::shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write) {
+  if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
+    return is_write ? data_->write(shard.remote, mem->remote_addr, mem->rkey, buf, shard.length)
+                    : data_->read(shard.remote, mem->remote_addr, mem->rkey, buf, shard.length);
+  }
+  if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
+    // On-device tier addressed through the in-process HBM provider.
+    const auto& provider = storage::hbm_provider();
+    const int rc = is_write
+                       ? provider.write(provider.ctx, dev->region_id, dev->offset, buf,
+                                        shard.length)
+                       : provider.read(provider.ctx, dev->region_id, dev->offset, buf,
+                                       shard.length);
+    return rc == 0 ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  // FileLocation shards are served by the worker via virtual regions and
+  // should never surface here.
+  return ErrorCode::NOT_IMPLEMENTED;
+}
+
+namespace {
+// Runs `count` shard jobs on up to `parallelism` threads. Jobs must be
+// independent. Returns the first error observed.
+ErrorCode run_parallel(size_t count, size_t parallelism,
+                       const std::function<ErrorCode(size_t)>& job) {
+  if (count == 0) return ErrorCode::OK;
+  if (count == 1 || parallelism <= 1) {
+    for (size_t i = 0; i < count; ++i) {
+      if (auto ec = job(i); ec != ErrorCode::OK) return ec;
+    }
+    return ErrorCode::OK;
+  }
+  std::atomic<size_t> next{0};
+  std::atomic<uint32_t> first_error{static_cast<uint32_t>(ErrorCode::OK)};
+  const size_t threads = std::min(parallelism, count);
+  std::vector<std::thread> workers;
+  workers.reserve(threads);
+  for (size_t t = 0; t < threads; ++t) {
+    workers.emplace_back([&] {
+      for (size_t i = next.fetch_add(1); i < count; i = next.fetch_add(1)) {
+        if (first_error.load() != static_cast<uint32_t>(ErrorCode::OK)) return;
+        if (auto ec = job(i); ec != ErrorCode::OK) {
+          uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
+          first_error.compare_exchange_strong(expected, static_cast<uint32_t>(ec));
+          return;
+        }
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  return static_cast<ErrorCode>(first_error.load());
+}
+}  // namespace
+
+ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
+                                          uint64_t size) {
+  // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
+  std::vector<uint64_t> offsets(copy.shards.size());
+  uint64_t off = 0;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    offsets[i] = off;
+    off += copy.shards[i].length;
+  }
+  if (off != size) return ErrorCode::INVALID_PARAMETERS;
+  return run_parallel(copy.shards.size(), options_.io_parallelism, [&](size_t i) {
+    return shard_io(copy.shards[i], const_cast<uint8_t*>(data) + offsets[i], /*is_write=*/true);
+  });
+}
+
+ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* data,
+                                          uint64_t size) {
+  std::vector<uint64_t> offsets(copy.shards.size());
+  uint64_t off = 0;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    offsets[i] = off;
+    off += copy.shards[i].length;
+  }
+  if (off != size) return ErrorCode::INVALID_PARAMETERS;
+  return run_parallel(copy.shards.size(), options_.io_parallelism, [&](size_t i) {
+    return shard_io(copy.shards[i], data + offsets[i], /*is_write=*/false);
+  });
+}
+
+}  // namespace btpu::client

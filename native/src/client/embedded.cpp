@@ -1,0 +1,85 @@
+#include "btpu/client/embedded.h"
+
+#include "btpu/common/log.h"
+
+namespace btpu::client {
+
+EmbeddedClusterOptions EmbeddedClusterOptions::simple(size_t n_workers, uint64_t pool_bytes,
+                                                      StorageClass cls) {
+  EmbeddedClusterOptions options;
+  options.keystone.gc_interval_sec = 1;
+  options.keystone.health_check_interval_sec = 1;
+  for (size_t i = 0; i < n_workers; ++i) {
+    worker::WorkerServiceConfig w;
+    w.worker_id = "worker-" + std::to_string(i);
+    w.cluster_id = options.keystone.cluster_id;
+    w.transport = TransportKind::LOCAL;
+    w.heartbeat_interval_ms = 100;
+    w.heartbeat_ttl_ms = 500;
+    w.topo = {0, static_cast<int32_t>(i), -1};
+    worker::PoolConfig pool;
+    pool.id = "pool-" + std::to_string(i);
+    pool.storage_class = cls;
+    pool.capacity = pool_bytes;
+    w.pools.push_back(pool);
+    options.workers.push_back(std::move(w));
+  }
+  return options;
+}
+
+EmbeddedCluster::EmbeddedCluster(EmbeddedClusterOptions options)
+    : options_(std::move(options)) {}
+
+EmbeddedCluster::~EmbeddedCluster() { stop(); }
+
+ErrorCode EmbeddedCluster::start() {
+  if (running_) return ErrorCode::INVALID_STATE;
+  if (options_.use_coordinator) coordinator_ = std::make_shared<coord::MemCoordinator>();
+  keystone_ = std::make_unique<keystone::KeystoneService>(options_.keystone, coordinator_);
+  BTPU_RETURN_IF_ERROR(keystone_->initialize());
+  BTPU_RETURN_IF_ERROR(keystone_->start());
+
+  for (auto worker_cfg : options_.workers) {
+    if (worker_cfg.transport == TransportKind::TRANSPORT_UNSPECIFIED)
+      worker_cfg.transport = options_.transport;
+    auto worker = std::make_unique<worker::WorkerService>(worker_cfg, coordinator_);
+    BTPU_RETURN_IF_ERROR(worker->initialize());
+    BTPU_RETURN_IF_ERROR(worker->start());
+    if (!coordinator_) {
+      // Direct feed: no coordination service in the loop.
+      keystone_->register_worker(worker->info());
+      for (const auto& pool : worker->pools()) keystone_->register_memory_pool(pool);
+    }
+    workers_.push_back(std::move(worker));
+  }
+  running_ = true;
+  return ErrorCode::OK;
+}
+
+void EmbeddedCluster::stop() {
+  if (!running_) return;
+  running_ = false;
+  for (auto& w : workers_) {
+    if (w) w->stop();
+  }
+  workers_.clear();
+  if (keystone_) keystone_->stop();
+  keystone_.reset();
+  coordinator_.reset();
+}
+
+std::unique_ptr<ObjectClient> EmbeddedCluster::make_client(ClientOptions options) {
+  return std::make_unique<ObjectClient>(std::move(options), keystone_.get());
+}
+
+void EmbeddedCluster::kill_worker(size_t i) {
+  if (i >= workers_.size() || !workers_[i]) return;
+  const NodeId id = workers_[i]->config().worker_id;
+  // Tearing the worker down deletes its heartbeat key, which drives the same
+  // keystone death path TTL expiry would (cleanup + repair fire before the
+  // surviving workers' regions go anywhere).
+  workers_[i].reset();
+  if (!coordinator_) keystone_->remove_worker(id);
+}
+
+}  // namespace btpu::client

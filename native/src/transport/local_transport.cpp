@@ -14,9 +14,11 @@ namespace btpu::transport {
 namespace {
 
 struct LocalRegion {
-  uint8_t* base;
-  uint64_t len;
-  uint64_t remote_base;  // advertised == (uintptr_t)base
+  uint8_t* base{nullptr};  // null for virtual regions
+  uint64_t len{0};
+  uint64_t remote_base{0};  // advertised == (uintptr_t)base; 0 for virtual
+  RegionReadFn read_fn;
+  RegionWriteFn write_fn;
 };
 
 struct LocalRegistry {
@@ -50,12 +52,30 @@ class LocalTransportServer : public TransportServer {
     uint64_t rkey = reg.rng() | 1;  // nonzero
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
-    reg.by_rkey[rkey] = {static_cast<uint8_t*>(base), len, remote_base};
+    reg.by_rkey[rkey] = {static_cast<uint8_t*>(base), len, remote_base, nullptr, nullptr};
     my_rkeys_.push_back(rkey);
     RemoteDescriptor d;
     d.transport = TransportKind::LOCAL;
     d.endpoint = "local:" + tag;
     d.remote_base = remote_base;
+    d.rkey_hex = rkey_to_hex(rkey);
+    return d;
+  }
+
+  Result<RemoteDescriptor> register_virtual_region(uint64_t len, const std::string& tag,
+                                                   RegionReadFn read_fn,
+                                                   RegionWriteFn write_fn) override {
+    if (len == 0 || !read_fn || !write_fn) return ErrorCode::INVALID_PARAMETERS;
+    auto& reg = LocalRegistry::instance();
+    std::lock_guard<std::mutex> lock(reg.mutex);
+    uint64_t rkey = reg.rng() | 1;
+    while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
+    reg.by_rkey[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn)};
+    my_rkeys_.push_back(rkey);
+    RemoteDescriptor d;
+    d.transport = TransportKind::LOCAL;
+    d.endpoint = "local:" + tag;
+    d.remote_base = 0;
     d.rkey_hex = rkey_to_hex(rkey);
     return d;
   }
@@ -85,21 +105,34 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
                        bool is_write) {
   auto& reg = LocalRegistry::instance();
   uint8_t* target = nullptr;
+  RegionReadFn read_fn;
+  RegionWriteFn write_fn;
+  uint64_t offset = 0;
   {
     std::lock_guard<std::mutex> lock(reg.mutex);
     auto it = reg.by_rkey.find(rkey);
     if (it == reg.by_rkey.end()) return ErrorCode::MEMORY_ACCESS_ERROR;
     const LocalRegion& region = it->second;
-    if (remote_addr < region.remote_base || remote_addr + len > region.remote_base + region.len)
+    if (remote_addr < region.remote_base || len > region.len ||
+        remote_addr - region.remote_base > region.len - len)
       return ErrorCode::MEMORY_ACCESS_ERROR;
-    target = region.base + (remote_addr - region.remote_base);
+    offset = remote_addr - region.remote_base;
+    if (region.base) {
+      target = region.base + offset;
+    } else {
+      read_fn = region.read_fn;
+      write_fn = region.write_fn;
+    }
   }
-  if (is_write) {
-    std::memcpy(target, buf, len);
-  } else {
-    std::memcpy(buf, target, len);
+  if (target) {
+    if (is_write) {
+      std::memcpy(target, buf, len);
+    } else {
+      std::memcpy(buf, target, len);
+    }
+    return ErrorCode::OK;
   }
-  return ErrorCode::OK;
+  return is_write ? write_fn(offset, buf, len) : read_fn(offset, buf, len);
 }
 
 std::unique_ptr<TransportServer> make_local_transport_server() {

@@ -37,9 +37,11 @@ struct DataRequestHeader {
 static_assert(sizeof(DataRequestHeader) == 25);
 
 struct Region {
-  uint8_t* base;
-  uint64_t len;
-  uint64_t remote_base;
+  uint8_t* base{nullptr};  // null for virtual (callback-backed) regions
+  uint64_t len{0};
+  uint64_t remote_base{0};
+  RegionReadFn read_fn;
+  RegionWriteFn write_fn;
 };
 
 class TcpTransportServer : public TransportServer {
@@ -84,13 +86,31 @@ class TcpTransportServer : public TransportServer {
     uint64_t rkey = rng_() | 1;
     while (regions_.contains(rkey)) rkey = rng_() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
-    regions_[rkey] = {static_cast<uint8_t*>(base), len, remote_base};
+    regions_[rkey] = {static_cast<uint8_t*>(base), len, remote_base, nullptr, nullptr};
     RemoteDescriptor d;
     d.transport = TransportKind::TCP;
     d.endpoint = host_ + ":" + std::to_string(port_);
     d.remote_base = remote_base;
     d.rkey_hex = rkey_to_hex(rkey);
     LOG_DEBUG << "registered tcp region " << tag << " rkey=" << d.rkey_hex << " len=" << len;
+    return d;
+  }
+
+  Result<RemoteDescriptor> register_virtual_region(uint64_t len, const std::string& tag,
+                                                   RegionReadFn read_fn,
+                                                   RegionWriteFn write_fn) override {
+    if (len == 0 || !read_fn || !write_fn) return ErrorCode::INVALID_PARAMETERS;
+    if (!running_) return ErrorCode::INVALID_STATE;
+    std::lock_guard<std::mutex> lock(regions_mutex_);
+    uint64_t rkey = rng_() | 1;
+    while (regions_.contains(rkey)) rkey = rng_() | 1;
+    regions_[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn)};
+    RemoteDescriptor d;
+    d.transport = TransportKind::TCP;
+    d.endpoint = host_ + ":" + std::to_string(port_);
+    d.remote_base = 0;
+    d.rkey_hex = rkey_to_hex(rkey);
+    LOG_DEBUG << "registered tcp virtual region " << tag << " rkey=" << d.rkey_hex;
     return d;
   }
 
@@ -117,27 +137,41 @@ class TcpTransportServer : public TransportServer {
     }
   }
 
-  // Resolves (addr, rkey, len) to a raw pointer, or nullptr on violation.
-  uint8_t* resolve(uint64_t addr, uint64_t rkey, uint64_t len) {
+  // Resolves (addr, rkey, len); returns false on violation. On success either
+  // `target` points into a flat region or `region_out` carries callbacks.
+  bool resolve(uint64_t addr, uint64_t rkey, uint64_t len, uint8_t*& target, Region& region_out,
+               uint64_t& offset) {
     std::lock_guard<std::mutex> lock(regions_mutex_);
     auto it = regions_.find(rkey);
-    if (it == regions_.end()) return nullptr;
+    if (it == regions_.end()) return false;
     const Region& region = it->second;
     if (addr < region.remote_base || len > region.len ||
         addr - region.remote_base > region.len - len)
-      return nullptr;
-    return region.base + (addr - region.remote_base);
+      return false;
+    offset = addr - region.remote_base;
+    if (region.base) {
+      target = region.base + offset;
+    } else {
+      target = nullptr;
+      region_out = region;
+    }
+    return true;
   }
 
   void serve(std::shared_ptr<net::Socket> sock) {
     const int fd = sock->fd();
     DataRequestHeader hdr{};
+    std::vector<uint8_t> scratch;
     while (running_) {
       if (net::read_exact(fd, &hdr, sizeof(hdr)) != ErrorCode::OK) break;
+      uint8_t* target = nullptr;
+      Region virt;
+      uint64_t offset = 0;
+      const bool valid = resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
+
       if (hdr.op == kOpWrite) {
-        uint8_t* target = resolve(hdr.addr, hdr.rkey, hdr.len);
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
-        if (!target) {
+        if (!valid) {
           // Must still drain the payload to keep the stream aligned.
           status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
           std::vector<uint8_t> sink(64 * 1024);
@@ -147,19 +181,36 @@ class TcpTransportServer : public TransportServer {
             if (net::read_exact(fd, sink.data(), chunk) != ErrorCode::OK) return;
             left -= chunk;
           }
-        } else if (net::read_exact(fd, target, hdr.len) != ErrorCode::OK) {
-          return;  // bytes land directly in the registered region: zero copy
+        } else if (target) {
+          // Bytes land directly in the registered region: zero copy.
+          if (net::read_exact(fd, target, hdr.len) != ErrorCode::OK) return;
+        } else {
+          scratch.resize(hdr.len);
+          if (net::read_exact(fd, scratch.data(), hdr.len) != ErrorCode::OK) return;
+          status = static_cast<uint32_t>(virt.write_fn(offset, scratch.data(), hdr.len));
         }
         if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
       } else if (hdr.op == kOpRead) {
-        uint8_t* target = resolve(hdr.addr, hdr.rkey, hdr.len);
-        uint32_t status = static_cast<uint32_t>(
-            target ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR);
-        if (!target) {
+        if (!valid) {
+          const uint32_t status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
           if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
           continue;
         }
+        if (!target) {
+          scratch.resize(hdr.len);
+          const auto ec = virt.read_fn(offset, scratch.data(), hdr.len);
+          const uint32_t status = static_cast<uint32_t>(ec);
+          if (ec != ErrorCode::OK) {
+            if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+            continue;
+          }
+          if (net::write_iov2(fd, &status, sizeof(status), scratch.data(), hdr.len) !=
+              ErrorCode::OK)
+            return;
+          continue;
+        }
         // Header + region bytes in one gather write: zero copy out.
+        const uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
         if (net::write_iov2(fd, &status, sizeof(status), target, hdr.len) != ErrorCode::OK)
           return;
       } else {

@@ -1,0 +1,260 @@
+#include "btpu/worker/worker.h"
+
+#include "btpu/common/config.h"
+#include "btpu/common/log.h"
+
+namespace btpu::worker {
+
+// ---- config ---------------------------------------------------------------
+
+ErrorCode WorkerServiceConfig::validate() const {
+  if (worker_id.empty()) return ErrorCode::MISSING_REQUIRED_FIELD;
+  if (cluster_id.empty()) return ErrorCode::MISSING_REQUIRED_FIELD;
+  if (pools.empty()) return ErrorCode::INVALID_CONFIGURATION;
+  for (const auto& pool : pools) {
+    if (pool.id.empty() || pool.capacity == 0) return ErrorCode::INVALID_CONFIGURATION;
+    const bool is_disk = pool.storage_class == StorageClass::NVME ||
+                         pool.storage_class == StorageClass::SSD ||
+                         pool.storage_class == StorageClass::HDD;
+    if (is_disk && pool.path.empty()) return ErrorCode::MISSING_REQUIRED_FIELD;
+  }
+  if (heartbeat_interval_ms <= 0 || heartbeat_ttl_ms <= heartbeat_interval_ms)
+    return ErrorCode::VALUE_OUT_OF_RANGE;
+  return ErrorCode::OK;
+}
+
+// Schema (configs/worker.yaml):
+//   worker_id / cluster_id / coord_endpoints / transport / listen_host /
+//   listen_port / slice_id / host_id / heartbeat: {interval_ms, ttl_ms} /
+//   pools: [- id, storage_class, capacity ("8GB"), path, device_id]
+WorkerServiceConfig WorkerServiceConfig::from_yaml(const std::string& file_path) {
+  auto parsed = yaml::parse_file(file_path);
+  if (!parsed.ok()) {
+    throw std::runtime_error("failed to parse worker config " + file_path + ": " +
+                             std::string(to_string(parsed.error())));
+  }
+  const auto& root = *parsed.value();
+  WorkerServiceConfig cfg;
+  if (auto n = root.get("worker_id")) cfg.worker_id = n->str_or("");
+  if (auto n = root.get("cluster_id")) cfg.cluster_id = n->str_or(cfg.cluster_id);
+  if (auto n = root.get("coord_endpoints")) cfg.coord_endpoints = n->str_or("");
+  if (auto n = root.get("etcd_endpoints")) cfg.coord_endpoints = n->str_or("");  // reference key
+  if (auto n = root.get("transport")) {
+    auto kind = transport_kind_from_name(n->str_or("tcp"));
+    if (!kind) throw std::runtime_error("unknown transport in " + file_path);
+    cfg.transport = *kind;
+  }
+  if (auto n = root.get("listen_host")) cfg.listen_host = n->str_or(cfg.listen_host);
+  if (auto n = root.get("listen_port"))
+    cfg.listen_port = static_cast<uint16_t>(n->int_or(cfg.listen_port));
+  if (auto n = root.get("slice_id")) cfg.topo.slice_id = static_cast<int32_t>(n->int_or(0));
+  if (auto n = root.get("host_id")) cfg.topo.host_id = static_cast<int32_t>(n->int_or(0));
+  if (auto hb = root.get("heartbeat")) {
+    if (auto n = hb->get("interval_ms")) cfg.heartbeat_interval_ms = n->int_or(5000);
+    if (auto n = hb->get("ttl_ms")) cfg.heartbeat_ttl_ms = n->int_or(10000);
+  }
+  if (auto pools = root.get("pools"); pools && pools->is_list()) {
+    for (const auto& item : pools->items()) {
+      PoolConfig pool;
+      if (auto n = item->get("id")) pool.id = n->str_or("");
+      if (auto n = item->get("storage_class")) {
+        auto cls = storage_class_from_name(n->str_or(""));
+        if (!cls) throw std::runtime_error("unknown storage_class in " + file_path);
+        pool.storage_class = *cls;
+      }
+      if (auto n = item->get("capacity")) {
+        auto bytes = yaml::parse_byte_size(n->str_or("0"));
+        if (!bytes) throw std::runtime_error("bad capacity in " + file_path);
+        pool.capacity = *bytes;
+      }
+      if (auto n = item->get("path")) pool.path = n->str_or("");
+      if (auto n = item->get("device_id")) pool.device_id = n->str_or("");
+      cfg.pools.push_back(std::move(pool));
+    }
+  }
+  if (auto ec = cfg.validate(); ec != ErrorCode::OK) {
+    throw std::runtime_error("invalid worker config " + file_path + ": " +
+                             std::string(to_string(ec)));
+  }
+  return cfg;
+}
+
+// ---- service --------------------------------------------------------------
+
+WorkerService::WorkerService(WorkerServiceConfig config,
+                             std::shared_ptr<coord::Coordinator> coordinator)
+    : config_(std::move(config)), coordinator_(std::move(coordinator)) {}
+
+WorkerService::~WorkerService() { stop(); }
+
+ErrorCode WorkerService::initialize() {
+  if (initialized_) return ErrorCode::INVALID_STATE;
+  BTPU_RETURN_IF_ERROR(config_.validate());
+
+  primary_transport_ = transport::make_transport_server(config_.transport);
+  if (!primary_transport_) return ErrorCode::INVALID_CONFIGURATION;
+  BTPU_RETURN_IF_ERROR(primary_transport_->start(config_.listen_host, config_.listen_port));
+
+  for (const auto& pool_cfg : config_.pools) {
+    storage::BackendConfig backend_cfg;
+    backend_cfg.pool_id = pool_cfg.id;
+    backend_cfg.node_id = config_.worker_id;
+    backend_cfg.storage_class = pool_cfg.storage_class;
+    backend_cfg.capacity = pool_cfg.capacity;
+    backend_cfg.path = pool_cfg.path;
+    if (!pool_cfg.device_id.empty()) backend_cfg.device_id = pool_cfg.device_id;
+
+    PoolRuntime runtime;
+    runtime.config = pool_cfg;
+
+    const bool memory_tier = pool_cfg.storage_class == StorageClass::RAM_CPU ||
+                             pool_cfg.storage_class == StorageClass::CXL_MEMORY ||
+                             pool_cfg.storage_class == StorageClass::CXL_TYPE2_DEVICE;
+    // Memory tiers may live inside transport-owned memory (shm segments).
+    void* transport_memory =
+        memory_tier ? primary_transport_->alloc_region(pool_cfg.capacity, pool_cfg.id) : nullptr;
+    runtime.backend = transport_memory
+                          ? storage::create_ram_backend_with_region(backend_cfg, transport_memory)
+                          : storage::create_storage_backend(backend_cfg);
+    if (!runtime.backend) {
+      LOG_ERROR << "no backend for pool " << pool_cfg.id;
+      return ErrorCode::INVALID_CONFIGURATION;
+    }
+    BTPU_RETURN_IF_ERROR(runtime.backend->initialize());
+
+    // Register the pool with the data plane.
+    Result<RemoteDescriptor> registered = ErrorCode::INTERNAL_ERROR;
+    if (void* base = runtime.backend->base_address()) {
+      registered = primary_transport_->register_region(base, pool_cfg.capacity, pool_cfg.id);
+    } else {
+      // Non-mapped tier: callback-backed region. Falls back to a TCP virtual
+      // transport when the primary (e.g. shm) cannot host callbacks.
+      auto* backend = runtime.backend.get();
+      auto read_fn = [backend](uint64_t off, void* dst, uint64_t len) {
+        return backend->read_at(off, dst, len);
+      };
+      auto write_fn = [backend](uint64_t off, const void* src, uint64_t len) {
+        return backend->write_at(off, src, len);
+      };
+      registered = primary_transport_->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
+                                                               read_fn, write_fn);
+      if (!registered.ok() && registered.error() == ErrorCode::NOT_IMPLEMENTED) {
+        if (!virtual_transport_) {
+          virtual_transport_ = transport::make_transport_server(TransportKind::TCP);
+          BTPU_RETURN_IF_ERROR(virtual_transport_->start(config_.listen_host, 0));
+        }
+        registered = virtual_transport_->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
+                                                                 read_fn, write_fn);
+      }
+    }
+    if (!registered.ok()) {
+      LOG_ERROR << "transport registration failed for pool " << pool_cfg.id;
+      return registered.error();
+    }
+
+    runtime.record.id = pool_cfg.id;
+    runtime.record.node_id = config_.worker_id;
+    runtime.record.size = pool_cfg.capacity;
+    runtime.record.used = 0;
+    runtime.record.storage_class = pool_cfg.storage_class;
+    runtime.record.remote = registered.value();
+    runtime.record.topo = config_.topo;
+    pools_.push_back(std::move(runtime));
+  }
+  initialized_ = true;
+  LOG_INFO << "worker " << config_.worker_id << " initialized with " << pools_.size()
+           << " pools over " << transport_kind_name(config_.transport);
+  return ErrorCode::OK;
+}
+
+keystone::WorkerInfo WorkerService::info() const {
+  keystone::WorkerInfo info;
+  info.worker_id = config_.worker_id;
+  info.address = transport_kind_name(config_.transport).data() +
+                 std::string(":") + config_.listen_host;
+  info.topo = config_.topo;
+  info.registered_at_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                              std::chrono::system_clock::now().time_since_epoch())
+                              .count();
+  return info;
+}
+
+std::vector<MemoryPool> WorkerService::pools() const {
+  std::vector<MemoryPool> out;
+  out.reserve(pools_.size());
+  for (const auto& p : pools_) out.push_back(p.record);
+  return out;
+}
+
+std::vector<std::pair<std::string, storage::StorageStats>> WorkerService::stats() const {
+  std::vector<std::pair<std::string, storage::StorageStats>> out;
+  for (const auto& p : pools_) out.emplace_back(p.config.id, p.backend->stats());
+  return out;
+}
+
+storage::StorageBackend* WorkerService::backend(const std::string& pool_id) {
+  for (auto& p : pools_) {
+    if (p.config.id == pool_id) return p.backend.get();
+  }
+  return nullptr;
+}
+
+void WorkerService::advertise() {
+  if (!coordinator_) return;
+  coordinator_->put(coord::worker_key(config_.cluster_id, config_.worker_id),
+                    keystone::encode_worker_info(info()));
+  for (const auto& p : pools_) {
+    coordinator_->put(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id),
+                      keystone::encode_pool_record(p.record));
+  }
+}
+
+ErrorCode WorkerService::start() {
+  if (!initialized_) return ErrorCode::INVALID_STATE;
+  if (running_.exchange(true)) return ErrorCode::INVALID_STATE;
+  advertise();
+  if (coordinator_) {
+    coordinator_->put_with_ttl(coord::heartbeat_key(config_.cluster_id, config_.worker_id),
+                               "alive", config_.heartbeat_ttl_ms);
+    heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+  }
+  LOG_INFO << "worker " << config_.worker_id << " started";
+  return ErrorCode::OK;
+}
+
+void WorkerService::heartbeat_loop() {
+  std::unique_lock<std::mutex> lock(stop_mutex_);
+  while (running_) {
+    stop_cv_.wait_for(lock, std::chrono::milliseconds(config_.heartbeat_interval_ms),
+                      [this] { return !running_.load(); });
+    if (!running_) break;
+    lock.unlock();
+    coordinator_->put_with_ttl(coord::heartbeat_key(config_.cluster_id, config_.worker_id),
+                               "alive", config_.heartbeat_ttl_ms);
+    lock.lock();
+  }
+}
+
+void WorkerService::stop() {
+  const bool was_running = running_.exchange(false);
+  if (was_running) {
+    stop_cv_.notify_all();
+    if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+    if (coordinator_) {
+      // Clean unregister (reference worker_service.cpp:256-297).
+      coordinator_->del(coord::heartbeat_key(config_.cluster_id, config_.worker_id));
+      coordinator_->del(coord::worker_key(config_.cluster_id, config_.worker_id));
+      for (const auto& p : pools_)
+        coordinator_->del(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id));
+    }
+  }
+  for (auto& p : pools_) {
+    if (p.backend) p.backend->shutdown();
+  }
+  pools_.clear();
+  if (virtual_transport_) virtual_transport_->stop();
+  if (primary_transport_) primary_transport_->stop();
+  initialized_ = false;
+}
+
+}  // namespace btpu::worker

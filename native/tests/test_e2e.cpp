@@ -1,0 +1,324 @@
+// End-to-end tests: client -> keystone -> allocator -> transport -> worker
+// backends, in every wiring (embedded/local, shm, full TCP with RPC), plus
+// failure/failover flows. This is the hermetic put->write->complete->
+// get->verify slice SURVEY §7 defines as the minimum e2e artifact.
+#include <cstring>
+#include <filesystem>
+#include <fstream>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/client/embedded.h"
+#include "btpu/rpc/rpc_server.h"
+
+using namespace btpu;
+using namespace btpu::client;
+
+namespace {
+
+std::vector<uint8_t> pattern(uint64_t size, uint8_t seed = 1) {
+  std::vector<uint8_t> data(size);
+  for (uint64_t i = 0; i < size; ++i) data[i] = static_cast<uint8_t>(i * 131 + seed);
+  return data;
+}
+
+bool eventually(const std::function<bool()>& pred, int timeout_ms = 3000) {
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (pred()) return true;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  return pred();
+}
+
+}  // namespace
+
+BTEST(EndToEnd, PutGetStripedAcrossWorkers) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(4, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 4;
+  auto data = pattern(1 << 20);
+  BT_ASSERT(client->put("e2e/striped", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("e2e/striped");
+  BT_ASSERT_OK(placements);
+  BT_EXPECT_EQ(placements.value()[0].shards.size(), 4u);  // striped wide
+
+  auto back = client->get("e2e/striped");
+  BT_ASSERT_OK(back);
+  BT_ASSERT(back.value().size() == data.size());
+  BT_EXPECT(std::memcmp(back.value().data(), data.data(), data.size()) == 0);
+
+  // Non-page-aligned odd size too.
+  auto odd = pattern(123457, 9);
+  BT_ASSERT(client->put("e2e/odd", odd.data(), odd.size(), cfg) == ErrorCode::OK);
+  auto odd_back = client->get("e2e/odd");
+  BT_ASSERT_OK(odd_back);
+  BT_EXPECT(odd_back.value() == odd);
+
+  BT_EXPECT(client->remove("e2e/striped") == ErrorCode::OK);
+  BT_EXPECT(client->get("e2e/striped").error() == ErrorCode::OBJECT_NOT_FOUND);
+}
+
+BTEST(EndToEnd, ReplicatedPutWritesAllCopies) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(4, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 2;
+  auto data = pattern(256 * 1024, 3);
+  BT_ASSERT(client->put("e2e/replicated", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("e2e/replicated");
+  BT_ASSERT_OK(placements);
+  BT_ASSERT(placements.value().size() == 2);
+
+  // Verify every copy independently through the data plane.
+  auto data_client = transport::make_transport_client();
+  for (const auto& copy : placements.value()) {
+    std::vector<uint8_t> buf(data.size());
+    uint64_t off = 0;
+    for (const auto& shard : copy.shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(data_client->read(shard.remote, mem.remote_addr, mem.rkey, buf.data() + off,
+                                  shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+    BT_EXPECT(buf == data);
+  }
+}
+
+BTEST(EndToEnd, WorkerDeathRepairThenGet) {
+  auto options = EmbeddedClusterOptions::simple(3, 4 << 20);
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(128 * 1024, 7);
+  BT_ASSERT(client->put("e2e/survivor", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto before = client->get_workers("e2e/survivor");
+  BT_ASSERT_OK(before);
+  const NodeId victim = before.value()[0].shards[0].worker_id;
+  size_t victim_idx = 0;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    // worker ids are worker-<i>
+    if ("worker-" + std::to_string(i) == victim) victim_idx = i;
+  }
+  cluster.kill_worker(victim_idx);
+
+  // Repair re-replicates onto the remaining workers.
+  BT_EXPECT(eventually(
+      [&] { return cluster.keystone().counters().objects_repaired.load() == 1; }));
+  auto after = client->get_workers("e2e/survivor");
+  BT_ASSERT_OK(after);
+  BT_EXPECT_EQ(after.value().size(), 2u);
+  for (const auto& copy : after.value()) {
+    for (const auto& shard : copy.shards) BT_EXPECT_NE(shard.worker_id, victim);
+  }
+  auto back = client->get("e2e/survivor");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(EndToEnd, GetFailsOverToSurvivingReplicaWithoutRepair) {
+  auto options = EmbeddedClusterOptions::simple(2, 4 << 20);
+  options.keystone.enable_repair = false;
+  options.use_coordinator = false;  // direct feed: death only via remove_worker
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(64 * 1024, 5);
+  BT_ASSERT(client->put("e2e/failover", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("e2e/failover");
+  BT_ASSERT_OK(placements);
+  // Stop the worker behind copy 0's transport (regions unregister), leaving
+  // placements stale — get() must fail over to copy 1.
+  const NodeId victim = placements.value()[0].shards[0].worker_id;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim) {
+      // Stop only the transport by killing the worker but keeping keystone
+      // metadata (repair disabled; remove_worker not called).
+      cluster.kill_worker(i);
+    }
+  }
+  // NOTE: kill_worker with no coordinator calls remove_worker, which prunes
+  // dead placements even with repair off — so copies shrink to the survivor.
+  auto back = client->get("e2e/failover");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(EndToEnd, ShmTransportSameHostRoundtrip) {
+  auto options = EmbeddedClusterOptions::simple(2, 4 << 20);
+  for (auto& w : options.workers) w.transport = TransportKind::SHM;
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 2;
+  auto data = pattern(512 * 1024, 11);
+  BT_ASSERT(client->put("e2e/shm", data.data(), data.size(), cfg) == ErrorCode::OK);
+  auto placements = client->get_workers("e2e/shm");
+  BT_ASSERT_OK(placements);
+  BT_EXPECT(placements.value()[0].shards[0].remote.transport == TransportKind::SHM);
+  auto back = client->get("e2e/shm");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(EndToEnd, FullTcpWireModeWithRpc) {
+  // Everything over real sockets: TCP data plane + RPC control plane.
+  auto options = EmbeddedClusterOptions::simple(2, 4 << 20);
+  for (auto& w : options.workers) {
+    w.transport = TransportKind::TCP;
+    w.listen_host = "127.0.0.1";
+  }
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+
+  rpc::KeystoneRpcServer rpc_server(cluster.keystone(), "127.0.0.1", 0);
+  BT_ASSERT(rpc_server.start() == ErrorCode::OK);
+
+  ClientOptions copts;
+  copts.keystone_address = rpc_server.endpoint();
+  ObjectClient remote_client(copts);  // real RPC client, not embedded
+  BT_ASSERT(remote_client.connect() == ErrorCode::OK);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 2;
+  auto data = pattern(1 << 20, 13);
+  BT_ASSERT(remote_client.put("e2e/tcp", data.data(), data.size(), cfg) == ErrorCode::OK);
+  auto back = remote_client.get("e2e/tcp");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+  BT_EXPECT_EQ(remote_client.cluster_stats().value().total_objects, 1ull);
+}
+
+BTEST(EndToEnd, TieredPoolsHbmPreferredWithDiskSpill) {
+  auto dir = std::filesystem::temp_directory_path() /
+             ("btpu_e2e_" + std::to_string(::getpid()));
+  std::filesystem::create_directories(dir);
+
+  EmbeddedClusterOptions options;
+  options.keystone.gc_interval_sec = 1;
+  options.keystone.health_check_interval_sec = 1;
+  worker::WorkerServiceConfig w;
+  w.worker_id = "tiered-worker";
+  w.transport = TransportKind::LOCAL;
+  w.heartbeat_interval_ms = 100;
+  w.heartbeat_ttl_ms = 500;
+  w.pools = {
+      {"hbm-pool", StorageClass::HBM_TPU, 64 * 1024, "", "tpu:0"},
+      {"nvme-pool", StorageClass::NVME, 4 << 20, (dir / "nvme.dat").string(), ""},
+  };
+  options.workers.push_back(w);
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  cfg.preferred_classes = {StorageClass::HBM_TPU};
+  cfg.min_shard_size = 1024;
+
+  // Small object lands in HBM.
+  auto small = pattern(16 * 1024, 21);
+  BT_ASSERT(client->put("tier/hot", small.data(), small.size(), cfg) == ErrorCode::OK);
+  auto hot = client->get_workers("tier/hot");
+  BT_ASSERT_OK(hot);
+  BT_EXPECT(hot.value()[0].shards[0].storage_class == StorageClass::HBM_TPU);
+
+  // Big object spills to NVMe (HBM pool too small), served via the virtual
+  // region data path.
+  auto big = pattern(1 << 20, 22);
+  BT_ASSERT(client->put("tier/cold", big.data(), big.size(), cfg) == ErrorCode::OK);
+  auto cold = client->get_workers("tier/cold");
+  BT_ASSERT_OK(cold);
+  BT_EXPECT(cold.value()[0].shards[0].storage_class == StorageClass::NVME);
+
+  auto hot_back = client->get("tier/hot");
+  auto cold_back = client->get("tier/cold");
+  BT_ASSERT_OK(hot_back);
+  BT_ASSERT_OK(cold_back);
+  BT_EXPECT(hot_back.value() == small);
+  BT_EXPECT(cold_back.value() == big);
+
+  cluster.stop();
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(EndToEnd, WorkerConfigFromYaml) {
+  auto path = std::filesystem::temp_directory_path() /
+              ("btpu_worker_" + std::to_string(::getpid()) + ".yaml");
+  {
+    std::ofstream f(path);
+    f << R"(worker_id: yaml-worker
+cluster_id: test_cluster
+transport: tcp
+listen_host: 127.0.0.1
+slice_id: 2
+host_id: 5
+heartbeat:
+  interval_ms: 1000
+  ttl_ms: 4000
+pools:
+  - id: dram
+    storage_class: ram_cpu
+    capacity: 64MB
+  - id: scratch
+    storage_class: nvme
+    capacity: 1GB
+    path: /tmp/btpu-scratch/backing.dat
+  - id: hot
+    storage_class: hbm_tpu
+    capacity: 32MB
+    device_id: tpu:0
+)";
+  }
+  auto cfg = worker::WorkerServiceConfig::from_yaml(path.string());
+  BT_EXPECT_EQ(cfg.worker_id, "yaml-worker");
+  BT_EXPECT(cfg.transport == TransportKind::TCP);
+  BT_EXPECT_EQ(cfg.topo.slice_id, 2);
+  BT_EXPECT_EQ(cfg.topo.host_id, 5);
+  BT_EXPECT_EQ(cfg.heartbeat_interval_ms, 1000);
+  BT_ASSERT(cfg.pools.size() == 3);
+  BT_EXPECT_EQ(cfg.pools[0].capacity, 64ull << 20);
+  BT_EXPECT(cfg.pools[1].storage_class == StorageClass::NVME);
+  BT_EXPECT_EQ(cfg.pools[2].device_id, "tpu:0");
+  std::filesystem::remove(path);
+
+  // Invalid: disk pool without path throws.
+  auto bad = std::filesystem::temp_directory_path() /
+             ("btpu_worker_bad_" + std::to_string(::getpid()) + ".yaml");
+  {
+    std::ofstream f(bad);
+    f << "worker_id: x\npools:\n  - id: d\n    storage_class: nvme\n    capacity: 1MB\n";
+  }
+  bool threw = false;
+  try {
+    (void)worker::WorkerServiceConfig::from_yaml(bad.string());
+  } catch (const std::runtime_error&) {
+    threw = true;
+  }
+  BT_EXPECT(threw);
+  std::filesystem::remove(bad);
+}

@@ -1,0 +1,161 @@
+// bb-bench: put/get throughput + latency percentiles.
+//
+// Role parity: reference clients/benchmark_client.cpp (iterated put/get MB/s
+// with rotating offsets, CLI --size/--iterations/--replicas/--max-workers)
+// plus what it lacked: p50/p99 latency (the BASELINE.md scoreboard metric),
+// a hermetic --embedded mode, and JSON output for driver harnesses.
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+#include "btpu/client/embedded.h"
+
+using namespace btpu;
+using Clock = std::chrono::steady_clock;
+
+namespace {
+
+double percentile(std::vector<double>& sorted, double p) {
+  if (sorted.empty()) return 0.0;
+  const size_t idx = std::min(sorted.size() - 1,
+                              static_cast<size_t>(p / 100.0 * static_cast<double>(sorted.size())));
+  return sorted[idx];
+}
+
+struct OpStats {
+  double total_s{0};
+  std::vector<double> latencies_us;
+
+  void record(double seconds) {
+    total_s += seconds;
+    latencies_us.push_back(seconds * 1e6);
+  }
+  void summarize(const char* name, uint64_t bytes_per_op, bool json) {
+    std::sort(latencies_us.begin(), latencies_us.end());
+    const double n = static_cast<double>(latencies_us.size());
+    const double gbps = n * static_cast<double>(bytes_per_op) / total_s / 1e9;
+    const double p50 = percentile(latencies_us, 50), p99 = percentile(latencies_us, 99);
+    if (json) {
+      std::printf(
+          "{\"op\": \"%s\", \"bytes\": %llu, \"iters\": %zu, \"gbps\": %.4f, "
+          "\"p50_us\": %.1f, \"p99_us\": %.1f}\n",
+          name, (unsigned long long)bytes_per_op, latencies_us.size(), gbps, p50, p99);
+    } else {
+      std::printf("%-4s %8llu B x%-5zu  %8.3f GB/s   p50 %8.1f us   p99 %8.1f us\n", name,
+                  (unsigned long long)bytes_per_op, latencies_us.size(), gbps, p50, p99);
+    }
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string keystone;
+  uint64_t size = 1 << 20;
+  int iterations = 100;
+  int embedded_workers = 0;
+  std::string transport = "local";
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 4;
+  bool json = false, sweep = false;
+
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--keystone") && i + 1 < argc) keystone = argv[++i];
+    else if (!std::strcmp(argv[i], "--size") && i + 1 < argc) size = std::stoull(argv[++i]);
+    else if (!std::strcmp(argv[i], "--iterations") && i + 1 < argc)
+      iterations = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--replicas") && i + 1 < argc)
+      wc.replication_factor = std::stoul(argv[++i]);
+    else if (!std::strcmp(argv[i], "--max-workers") && i + 1 < argc)
+      wc.max_workers_per_copy = std::stoul(argv[++i]);
+    else if (!std::strcmp(argv[i], "--embedded") && i + 1 < argc)
+      embedded_workers = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--transport") && i + 1 < argc) transport = argv[++i];
+    else if (!std::strcmp(argv[i], "--json")) json = true;
+    else if (!std::strcmp(argv[i], "--sweep")) sweep = true;
+    else if (!std::strcmp(argv[i], "--help")) {
+      std::printf(
+          "usage: bb-bench (--keystone host:port | --embedded N) [--size BYTES]\n"
+          "       [--iterations N] [--replicas R] [--max-workers W]\n"
+          "       [--transport local|shm|tcp] [--json] [--sweep]\n");
+      return 0;
+    }
+  }
+
+  std::unique_ptr<client::EmbeddedCluster> cluster;
+  std::unique_ptr<client::ObjectClient> client_ptr;
+  if (embedded_workers > 0) {
+    auto kind = transport_kind_from_name(transport);
+    if (!kind) {
+      std::fprintf(stderr, "unknown transport %s\n", transport.c_str());
+      return 1;
+    }
+    const uint64_t pool_bytes =
+        std::max<uint64_t>(64ull << 20, 4 * size * wc.replication_factor);
+    auto options = client::EmbeddedClusterOptions::simple(
+        static_cast<size_t>(embedded_workers), pool_bytes);
+    options.use_coordinator = false;
+    for (auto& w : options.workers) {
+      w.transport = *kind;
+      if (*kind == TransportKind::TCP) w.listen_host = "127.0.0.1";
+    }
+    cluster = std::make_unique<client::EmbeddedCluster>(std::move(options));
+    if (cluster->start() != ErrorCode::OK) {
+      std::fprintf(stderr, "embedded cluster failed to start\n");
+      return 1;
+    }
+    client_ptr = cluster->make_client();
+  } else if (!keystone.empty()) {
+    client::ClientOptions options;
+    options.keystone_address = keystone;
+    client_ptr = std::make_unique<client::ObjectClient>(options);
+    if (client_ptr->connect() != ErrorCode::OK) {
+      std::fprintf(stderr, "cannot reach keystone at %s\n", keystone.c_str());
+      return 1;
+    }
+  } else {
+    std::fprintf(stderr, "need --keystone or --embedded (see --help)\n");
+    return 1;
+  }
+  auto& client = *client_ptr;
+
+  std::vector<uint64_t> sizes = sweep ? std::vector<uint64_t>{4 << 10, 64 << 10, 1 << 20, 16 << 20}
+                                      : std::vector<uint64_t>{size};
+  for (uint64_t sz : sizes) {
+    std::vector<uint8_t> data(sz);
+    for (uint64_t i = 0; i < sz; ++i) data[i] = static_cast<uint8_t>(i * 131 + 17);
+    std::vector<uint8_t> readback(sz);
+
+    OpStats put_stats, get_stats;
+    int warmup = std::max(1, iterations / 10);
+    for (int it = -warmup; it < iterations; ++it) {
+      const std::string key = "bench/" + std::to_string(sz) + "/" + std::to_string(it + warmup);
+      auto t0 = Clock::now();
+      if (auto ec = client.put(key, data.data(), sz, wc); ec != ErrorCode::OK) {
+        std::fprintf(stderr, "put failed: %s\n", std::string(to_string(ec)).c_str());
+        return 1;
+      }
+      auto t1 = Clock::now();
+      auto got = client.get_into(key, readback.data(), sz);
+      auto t2 = Clock::now();
+      if (!got.ok() || got.value() != sz) {
+        std::fprintf(stderr, "get failed\n");
+        return 1;
+      }
+      client.remove(key);
+      if (it >= 0) {
+        put_stats.record(std::chrono::duration<double>(t1 - t0).count());
+        get_stats.record(std::chrono::duration<double>(t2 - t1).count());
+      }
+    }
+    if (std::memcmp(readback.data(), data.data(), sz) != 0) {
+      std::fprintf(stderr, "verification failed\n");
+      return 1;
+    }
+    put_stats.summarize("put", sz, json);
+    get_stats.summarize("get", sz, json);
+  }
+  return 0;
+}

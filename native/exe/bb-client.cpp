@@ -1,0 +1,117 @@
+// bb-client: CLI for put/get/exists/remove/stats against a running cluster
+// (role of reference examples/simple_client_test.cpp + clients/ucx_client.cpp
+// demo flows, as a shippable tool).
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+
+#include "btpu/client/client.h"
+
+using namespace btpu;
+
+namespace {
+int usage() {
+  std::printf(
+      "usage: bb-client --keystone host:port <command> [args]\n"
+      "  put <key> (--file path | --size N) [--replicas R] [--max-workers W]\n"
+      "  get <key> [--out path]\n"
+      "  exists <key>\n"
+      "  remove <key>\n"
+      "  stats\n"
+      "  ping\n");
+  return 2;
+}
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string keystone, command, key, file, out;
+  uint64_t size = 0;
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 4;
+
+  std::vector<std::string> positional;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--keystone") && i + 1 < argc) keystone = argv[++i];
+    else if (!std::strcmp(argv[i], "--file") && i + 1 < argc) file = argv[++i];
+    else if (!std::strcmp(argv[i], "--out") && i + 1 < argc) out = argv[++i];
+    else if (!std::strcmp(argv[i], "--size") && i + 1 < argc) size = std::stoull(argv[++i]);
+    else if (!std::strcmp(argv[i], "--replicas") && i + 1 < argc)
+      wc.replication_factor = std::stoul(argv[++i]);
+    else if (!std::strcmp(argv[i], "--max-workers") && i + 1 < argc)
+      wc.max_workers_per_copy = std::stoul(argv[++i]);
+    else if (!std::strcmp(argv[i], "--help")) return usage();
+    else positional.push_back(argv[i]);
+  }
+  if (keystone.empty() || positional.empty()) return usage();
+  command = positional[0];
+  if (positional.size() > 1) key = positional[1];
+
+  client::ClientOptions options;
+  options.keystone_address = keystone;
+  client::ObjectClient client(options);
+  if (client.connect() != ErrorCode::OK) {
+    std::fprintf(stderr, "bb-client: cannot reach keystone at %s\n", keystone.c_str());
+    return 1;
+  }
+
+  auto fail = [](ErrorCode ec) {
+    std::fprintf(stderr, "error: %s\n", std::string(to_string(ec)).c_str());
+    return 1;
+  };
+
+  if (command == "put") {
+    std::vector<uint8_t> data;
+    if (!file.empty()) {
+      std::ifstream in(file, std::ios::binary);
+      if (!in) {
+        std::fprintf(stderr, "cannot read %s\n", file.c_str());
+        return 1;
+      }
+      data.assign(std::istreambuf_iterator<char>(in), std::istreambuf_iterator<char>());
+    } else if (size > 0) {
+      data.resize(size);
+      for (uint64_t i = 0; i < size; ++i) data[i] = static_cast<uint8_t>(i * 31 + 7);
+    } else {
+      return usage();
+    }
+    if (auto ec = client.put(key, data.data(), data.size(), wc); ec != ErrorCode::OK)
+      return fail(ec);
+    std::printf("put %s (%zu bytes, %zu replicas)\n", key.c_str(), data.size(),
+                wc.replication_factor);
+  } else if (command == "get") {
+    auto data = client.get(key);
+    if (!data.ok()) return fail(data.error());
+    if (!out.empty()) {
+      std::ofstream of(out, std::ios::binary);
+      of.write(reinterpret_cast<const char*>(data.value().data()),
+               static_cast<std::streamsize>(data.value().size()));
+      std::printf("got %s -> %s (%zu bytes)\n", key.c_str(), out.c_str(), data.value().size());
+    } else {
+      std::printf("got %s (%zu bytes)\n", key.c_str(), data.value().size());
+    }
+  } else if (command == "exists") {
+    auto r = client.object_exists(key);
+    if (!r.ok()) return fail(r.error());
+    std::printf("%s\n", r.value() ? "true" : "false");
+    return r.value() ? 0 : 3;
+  } else if (command == "remove") {
+    if (auto ec = client.remove(key); ec != ErrorCode::OK) return fail(ec);
+    std::printf("removed %s\n", key.c_str());
+  } else if (command == "stats") {
+    auto stats = client.cluster_stats();
+    if (!stats.ok()) return fail(stats.error());
+    const auto& s = stats.value();
+    std::printf("workers=%llu pools=%llu objects=%llu used=%llu/%llu (%.1f%%)\n",
+                (unsigned long long)s.total_workers, (unsigned long long)s.total_memory_pools,
+                (unsigned long long)s.total_objects, (unsigned long long)s.used_capacity,
+                (unsigned long long)s.total_capacity, 100.0 * s.avg_utilization);
+  } else if (command == "ping") {
+    auto view = client.ping();
+    if (!view.ok()) return fail(view.error());
+    std::printf("view_version=%lld\n", (long long)view.value());
+  } else {
+    return usage();
+  }
+  return 0;
+}

@@ -1,0 +1,91 @@
+// bb-keystone: control-plane daemon (the reference planned this binary in
+// src/executables/CMakeLists.txt but never shipped it; its role was filled by
+// examples/keystone_example.cpp, whose flags this follows).
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+
+#include "btpu/common/log.h"
+#include "btpu/coord/remote_coordinator.h"
+#include "btpu/rpc/rpc_server.h"
+
+namespace {
+volatile std::sig_atomic_t g_stop = 0;
+void handle_signal(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string config_path;
+  std::string coord_override;
+  std::string listen_override;
+  int stats_interval_sec = 60;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--config") && i + 1 < argc) config_path = argv[++i];
+    else if (!std::strcmp(argv[i], "--coord") && i + 1 < argc) coord_override = argv[++i];
+    else if (!std::strcmp(argv[i], "--listen") && i + 1 < argc) listen_override = argv[++i];
+    else if (!std::strcmp(argv[i], "--stats-interval") && i + 1 < argc)
+      stats_interval_sec = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--help")) {
+      std::printf(
+          "usage: bb-keystone [--config keystone.yaml] [--coord host:port]\n"
+          "                   [--listen host:port] [--stats-interval sec]\n");
+      return 0;
+    }
+  }
+
+  btpu::KeystoneConfig config;
+  try {
+    if (!config_path.empty()) config = btpu::KeystoneConfig::from_yaml(config_path);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "bb-keystone: %s\n", e.what());
+    return 1;
+  }
+  if (!coord_override.empty()) config.coord_endpoints = coord_override;
+  if (!listen_override.empty()) config.listen_address = listen_override;
+
+  std::shared_ptr<btpu::coord::Coordinator> coordinator;
+  if (!config.coord_endpoints.empty()) {
+    auto remote = std::make_shared<btpu::coord::RemoteCoordinator>(config.coord_endpoints);
+    if (remote->connect() != btpu::ErrorCode::OK) {
+      std::fprintf(stderr, "bb-keystone: cannot reach coordinator at %s\n",
+                   config.coord_endpoints.c_str());
+      return 1;
+    }
+    coordinator = remote;
+  }
+
+  auto stack = btpu::rpc::create_and_start_keystone(config, coordinator);
+  if (!stack.ok()) {
+    std::fprintf(stderr, "bb-keystone: start failed: %s\n",
+                 std::string(btpu::to_string(stack.error())).c_str());
+    return 1;
+  }
+  auto& keystone = *stack.value()->service;
+  std::printf("bb-keystone up: rpc %s, metrics :%u\n",
+              stack.value()->rpc->endpoint().c_str(), stack.value()->metrics->port());
+  std::fflush(stdout);
+
+  std::signal(SIGINT, handle_signal);
+  std::signal(SIGTERM, handle_signal);
+  auto last_stats = std::chrono::steady_clock::now();
+  while (!g_stop) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    if (std::chrono::steady_clock::now() - last_stats >=
+        std::chrono::seconds(stats_interval_sec)) {
+      last_stats = std::chrono::steady_clock::now();
+      auto stats = keystone.get_cluster_stats();
+      if (stats.ok()) {
+        const auto& s = stats.value();
+        std::printf("[stats] workers=%llu pools=%llu objects=%llu used=%llu/%llu (%.1f%%)\n",
+                    (unsigned long long)s.total_workers,
+                    (unsigned long long)s.total_memory_pools,
+                    (unsigned long long)s.total_objects, (unsigned long long)s.used_capacity,
+                    (unsigned long long)s.total_capacity, 100.0 * s.avg_utilization);
+        std::fflush(stdout);
+      }
+    }
+  }
+  stack.value()->stop();
+  return 0;
+}

@@ -1,0 +1,67 @@
+// bb-worker: data-plane daemon (role of reference examples/worker_example.cpp,
+// planned as a production binary in src/executables/CMakeLists.txt).
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+
+#include "btpu/common/log.h"
+#include "btpu/coord/remote_coordinator.h"
+#include "btpu/worker/worker.h"
+
+namespace {
+volatile std::sig_atomic_t g_stop = 0;
+void handle_signal(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string config_path;
+  std::string coord_override;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--config") && i + 1 < argc) config_path = argv[++i];
+    else if (!std::strcmp(argv[i], "--coord") && i + 1 < argc) coord_override = argv[++i];
+    else if (!std::strcmp(argv[i], "--help")) {
+      std::printf("usage: bb-worker --config worker.yaml [--coord host:port]\n");
+      return 0;
+    }
+  }
+  if (config_path.empty()) {
+    std::fprintf(stderr, "bb-worker: --config is required\n");
+    return 1;
+  }
+
+  btpu::worker::WorkerServiceConfig config;
+  try {
+    config = btpu::worker::WorkerServiceConfig::from_yaml(config_path);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "bb-worker: %s\n", e.what());
+    return 1;
+  }
+  if (!coord_override.empty()) config.coord_endpoints = coord_override;
+
+  std::shared_ptr<btpu::coord::Coordinator> coordinator;
+  if (!config.coord_endpoints.empty()) {
+    auto remote = std::make_shared<btpu::coord::RemoteCoordinator>(config.coord_endpoints);
+    if (remote->connect() != btpu::ErrorCode::OK) {
+      std::fprintf(stderr, "bb-worker: cannot reach coordinator at %s\n",
+                   config.coord_endpoints.c_str());
+      return 1;
+    }
+    coordinator = remote;
+  }
+
+  btpu::worker::WorkerService worker(config, coordinator);
+  if (worker.initialize() != btpu::ErrorCode::OK || worker.start() != btpu::ErrorCode::OK) {
+    std::fprintf(stderr, "bb-worker: startup failed\n");
+    return 1;
+  }
+  std::printf("bb-worker %s up with %zu pools\n", config.worker_id.c_str(),
+              config.pools.size());
+  std::fflush(stdout);
+
+  std::signal(SIGINT, handle_signal);
+  std::signal(SIGTERM, handle_signal);
+  while (!g_stop) std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  worker.stop();
+  return 0;
+}

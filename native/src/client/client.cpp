@@ -4,6 +4,7 @@
 #include <thread>
 
 #include "btpu/common/log.h"
+#include "btpu/common/thread_pool.h"
 #include "btpu/storage/hbm_provider.h"
 
 namespace btpu::client {
@@ -142,35 +143,35 @@ ErrorCode ObjectClient::shard_io(const ShardPlacement& shard, uint8_t* buf, bool
 }
 
 namespace {
-// Runs `count` shard jobs on up to `parallelism` threads. Jobs must be
-// independent. Returns the first error observed.
-ErrorCode run_parallel(size_t count, size_t parallelism,
+// Shared transfer pool: persistent threads amortized across all clients in
+// the process (per-op thread spawn costs ~100us, see thread_pool.h).
+ThreadPool& transfer_pool() {
+  static ThreadPool pool(8);
+  return pool;
+}
+
+// Below this many bytes per shard, parallel dispatch costs more than the
+// transfer itself: run inline.
+constexpr uint64_t kInlineShardBytes = 128 * 1024;
+
+// Runs `count` shard jobs, parallel when worthwhile. Returns first error.
+ErrorCode run_parallel(size_t count, size_t parallelism, uint64_t bytes_per_shard,
                        const std::function<ErrorCode(size_t)>& job) {
   if (count == 0) return ErrorCode::OK;
-  if (count == 1 || parallelism <= 1) {
+  if (count == 1 || parallelism <= 1 || bytes_per_shard < kInlineShardBytes) {
     for (size_t i = 0; i < count; ++i) {
       if (auto ec = job(i); ec != ErrorCode::OK) return ec;
     }
     return ErrorCode::OK;
   }
-  std::atomic<size_t> next{0};
   std::atomic<uint32_t> first_error{static_cast<uint32_t>(ErrorCode::OK)};
-  const size_t threads = std::min(parallelism, count);
-  std::vector<std::thread> workers;
-  workers.reserve(threads);
-  for (size_t t = 0; t < threads; ++t) {
-    workers.emplace_back([&] {
-      for (size_t i = next.fetch_add(1); i < count; i = next.fetch_add(1)) {
-        if (first_error.load() != static_cast<uint32_t>(ErrorCode::OK)) return;
-        if (auto ec = job(i); ec != ErrorCode::OK) {
-          uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
-          first_error.compare_exchange_strong(expected, static_cast<uint32_t>(ec));
-          return;
-        }
-      }
-    });
-  }
-  for (auto& w : workers) w.join();
+  transfer_pool().run_batch(count, [&](size_t i) {
+    if (first_error.load() != static_cast<uint32_t>(ErrorCode::OK)) return;
+    if (auto ec = job(i); ec != ErrorCode::OK) {
+      uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
+      first_error.compare_exchange_strong(expected, static_cast<uint32_t>(ec));
+    }
+  });
   return static_cast<ErrorCode>(first_error.load());
 }
 }  // namespace
@@ -185,7 +186,8 @@ ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8
     off += copy.shards[i].length;
   }
   if (off != size) return ErrorCode::INVALID_PARAMETERS;
-  return run_parallel(copy.shards.size(), options_.io_parallelism, [&](size_t i) {
+  const uint64_t per_shard = copy.shards.empty() ? 0 : size / copy.shards.size();
+  return run_parallel(copy.shards.size(), options_.io_parallelism, per_shard, [&](size_t i) {
     return shard_io(copy.shards[i], const_cast<uint8_t*>(data) + offsets[i], /*is_write=*/true);
   });
 }
@@ -199,7 +201,8 @@ ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* da
     off += copy.shards[i].length;
   }
   if (off != size) return ErrorCode::INVALID_PARAMETERS;
-  return run_parallel(copy.shards.size(), options_.io_parallelism, [&](size_t i) {
+  const uint64_t per_shard = copy.shards.empty() ? 0 : size / copy.shards.size();
+  return run_parallel(copy.shards.size(), options_.io_parallelism, per_shard, [&](size_t i) {
     return shard_io(copy.shards[i], data + offsets[i], /*is_write=*/false);
   });
 }

@@ -1,0 +1,22 @@
+"""blackbird_tpu: TPU-native distributed object store.
+
+A from-scratch rebuild of blackbird-io/blackbird for TPU deployments: a C++20
+core (control plane, allocator, transports, tiered storage backends) plus a
+JAX-backed TPU HBM tier and mesh/collective helpers for the intra-slice (ICI)
+data plane.
+
+Layout:
+    blackbird_tpu.native    ctypes bindings to libbtpu.so (auto-builds)
+    blackbird_tpu.cluster   embedded in-process cluster harness
+    blackbird_tpu.client    object client (put/get bytes or numpy arrays)
+    blackbird_tpu.hbm       JAX HBM provider: device buffers as the top tier
+    blackbird_tpu.topology  TPU pod/slice topology discovery from jax.devices()
+    blackbird_tpu.parallel  mesh/sharding helpers for the ICI data plane
+    blackbird_tpu.ops       pallas/jnp kernels (checksums, shard repacking)
+"""
+
+from blackbird_tpu.native import ErrorCode, StorageClass, TransportKind, lib  # noqa: F401
+from blackbird_tpu.cluster import EmbeddedCluster  # noqa: F401
+from blackbird_tpu.client import Client  # noqa: F401
+
+__version__ = "0.1.0"

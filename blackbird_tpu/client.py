@@ -1,0 +1,125 @@
+"""Object client: put/get bytes or numpy arrays against a cluster."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from blackbird_tpu.native import StorageClass, check, lib
+
+
+class Client:
+    """put/get/exists/remove against an embedded or remote cluster.
+
+    Parity surface: reference BlackbirdClient (blackbird_client.h:47-106) —
+    connect/object_exists/put/get/remove — with numpy-friendly helpers.
+    """
+
+    def __init__(self, keystone_endpoint: str):
+        self._cluster_ref = None
+        self._handle = lib.btpu_client_create_remote(keystone_endpoint.encode())
+        if not self._handle:
+            raise RuntimeError(f"cannot reach keystone at {keystone_endpoint}")
+
+    @classmethod
+    def _embedded(cls, cluster):
+        self = cls.__new__(cls)
+        self._cluster_ref = cluster  # keep alive
+        self._handle = lib.btpu_client_create_embedded(cluster._handle)
+        if not self._handle:
+            raise RuntimeError("embedded client creation failed")
+        return self
+
+    def put(
+        self,
+        key: str,
+        data: bytes | bytearray | memoryview | np.ndarray,
+        *,
+        replicas: int = 1,
+        max_workers: int = 4,
+        preferred_class: StorageClass | None = None,
+    ) -> None:
+        if isinstance(data, np.ndarray):
+            data = np.ascontiguousarray(data)
+            buf = data.ctypes.data_as(ctypes.c_void_p)
+            size = data.nbytes
+        else:
+            data = bytes(data)
+            buf = ctypes.cast(ctypes.create_string_buffer(data, len(data)), ctypes.c_void_p)
+            size = len(data)
+        check(
+            lib.btpu_put(
+                self._handle,
+                key.encode(),
+                buf,
+                size,
+                replicas,
+                max_workers,
+                int(preferred_class) if preferred_class else 0,
+            ),
+            f"put {key!r}",
+        )
+
+    def get(self, key: str) -> bytes:
+        size = ctypes.c_uint64()
+        check(lib.btpu_get(self._handle, key.encode(), None, 0, ctypes.byref(size)),
+              f"get {key!r}")
+        buffer = ctypes.create_string_buffer(size.value)
+        out = ctypes.c_uint64()
+        check(
+            lib.btpu_get(self._handle, key.encode(), buffer, size.value, ctypes.byref(out)),
+            f"get {key!r}",
+        )
+        return buffer.raw[: out.value]
+
+    def get_array(self, key: str, dtype=np.uint8, shape=None) -> np.ndarray:
+        raw = np.frombuffer(self.get(key), dtype=dtype)
+        return raw.reshape(shape) if shape is not None else raw
+
+    def get_into(self, key: str, out: np.ndarray) -> int:
+        """Reads into a preallocated array; returns the object size."""
+        assert out.flags["C_CONTIGUOUS"]
+        got = ctypes.c_uint64()
+        check(
+            lib.btpu_get(
+                self._handle,
+                key.encode(),
+                out.ctypes.data_as(ctypes.c_void_p),
+                out.nbytes,
+                ctypes.byref(got),
+            ),
+            f"get {key!r}",
+        )
+        return got.value
+
+    def exists(self, key: str) -> bool:
+        flag = ctypes.c_int32()
+        check(lib.btpu_exists(self._handle, key.encode(), ctypes.byref(flag)),
+              f"exists {key!r}")
+        return bool(flag.value)
+
+    def remove(self, key: str) -> None:
+        check(lib.btpu_remove(self._handle, key.encode()), f"remove {key!r}")
+
+    def stats(self) -> dict[str, int]:
+        out = (ctypes.c_uint64 * 5)()
+        check(lib.btpu_stats(self._handle, out), "stats")
+        return {
+            "workers": out[0],
+            "pools": out[1],
+            "objects": out[2],
+            "capacity": out[3],
+            "used": out[4],
+        }
+
+    def close(self) -> None:
+        if self._handle:
+            lib.btpu_client_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,78 @@
+"""Embedded in-process cluster: keystone + N workers, for tests and benches."""
+
+from __future__ import annotations
+
+import ctypes
+
+from blackbird_tpu.native import StorageClass, TransportKind, lib
+
+
+class EmbeddedCluster:
+    """Hermetic cluster (keystone + workers + coordination) in this process.
+
+    Example:
+        with EmbeddedCluster(workers=4, pool_bytes=64 << 20) as cluster:
+            client = cluster.client()
+            client.put("k", b"hello")
+            assert client.get("k") == b"hello"
+    """
+
+    def __init__(
+        self,
+        workers: int = 2,
+        pool_bytes: int = 64 << 20,
+        storage_class: StorageClass = StorageClass.RAM_CPU,
+        transport: TransportKind = TransportKind.LOCAL,
+        tiered_device_bytes: int | None = None,
+    ):
+        if tiered_device_bytes is not None:
+            self._handle = lib.btpu_cluster_create_tiered(
+                workers, tiered_device_bytes, pool_bytes
+            )
+        else:
+            self._handle = lib.btpu_cluster_create(
+                workers, pool_bytes, int(storage_class), int(transport)
+            )
+        if not self._handle:
+            raise RuntimeError("embedded cluster failed to start")
+
+    def client(self):
+        from blackbird_tpu.client import Client
+
+        return Client._embedded(self)
+
+    @property
+    def worker_count(self) -> int:
+        return lib.btpu_cluster_worker_count(self._handle)
+
+    def kill_worker(self, index: int) -> None:
+        """Abrupt worker death: drives keystone failure detection + repair."""
+        lib.btpu_cluster_kill_worker(self._handle, index)
+
+    def counters(self) -> dict[str, int]:
+        out = (ctypes.c_uint64 * 5)()
+        lib.btpu_cluster_counters(self._handle, out)
+        return {
+            "objects_repaired": out[0],
+            "objects_lost": out[1],
+            "evicted": out[2],
+            "gc_collected": out[3],
+            "workers_lost": out[4],
+        }
+
+    def close(self) -> None:
+        if self._handle:
+            lib.btpu_cluster_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

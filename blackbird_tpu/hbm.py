@@ -1,0 +1,163 @@
+"""JAX-backed HBM provider: TPU device buffers as the top storage tier.
+
+The native HbmBackend talks to a C ABI provider table (hbm_provider.h). This
+module implements that table with JAX: a region is a list of fixed-size
+device-resident uint8 chunks on one TPU chip; read/write are host<->device
+transfers. Registering the provider flips every HBM_TPU pool in this process
+from the built-in host-memory emulation to real device memory.
+
+Granularity: writes/reads are chunk-based (default 1 MiB). Whole-chunk
+writes cost one device_put; partial-chunk writes read-modify-write through
+the host, so align shard sizes to the chunk size for peak throughput (the
+native allocator's min_shard_size does this for you when set to >= chunk).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from blackbird_tpu.native import lib
+
+_u64 = ctypes.c_uint64
+
+_ALLOC_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, _u64,
+                             ctypes.POINTER(_u64))
+_FREE_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64)
+_WRITE_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64, _u64, ctypes.c_void_p, _u64)
+_READ_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64, _u64, ctypes.c_void_p, _u64)
+_AVAIL_FN = ctypes.CFUNCTYPE(_u64, ctypes.c_void_p, ctypes.c_char_p)
+
+
+class _ProviderStruct(ctypes.Structure):
+    _fields_ = [
+        ("ctx", ctypes.c_void_p),
+        ("alloc_region", _ALLOC_FN),
+        ("free_region", _FREE_FN),
+        ("write", _WRITE_FN),
+        ("read", _READ_FN),
+        ("available", _AVAIL_FN),
+    ]
+
+
+class JaxHbmProvider:
+    """Chunked device-buffer regions managed through JAX."""
+
+    def __init__(self, chunk_bytes: int = 1 << 20):
+        import jax
+
+        self._jax = jax
+        self.chunk_bytes = chunk_bytes
+        self._lock = threading.Lock()
+        self._regions: dict[int, dict] = {}
+        self._next_id = 1
+        self._struct = None  # built in register()
+
+    # -- device helpers ----------------------------------------------------
+
+    def _device_for(self, device_id: str):
+        devices = self._jax.local_devices()
+        if ":" in device_id:
+            try:
+                ordinal = int(device_id.split(":", 1)[1])
+                if 0 <= ordinal < len(devices):
+                    return devices[ordinal]
+            except ValueError:
+                pass
+        return devices[0]
+
+    # -- provider callbacks ------------------------------------------------
+
+    def _alloc(self, _ctx, device_id, size, out_id):
+        try:
+            device = self._device_for(device_id.decode() if device_id else "tpu:0")
+            n_chunks = (size + self.chunk_bytes - 1) // self.chunk_bytes
+            zero = np.zeros(self.chunk_bytes, dtype=np.uint8)
+            chunks = [self._jax.device_put(zero, device) for _ in range(n_chunks)]
+            with self._lock:
+                region_id = self._next_id
+                self._next_id += 1
+                self._regions[region_id] = {
+                    "chunks": chunks,
+                    "size": size,
+                    "device": device,
+                }
+            out_id[0] = region_id
+            return 0
+        except Exception:  # noqa: BLE001 - must not raise through the C ABI
+            return 1
+
+    def _free(self, _ctx, region_id):
+        with self._lock:
+            return 0 if self._regions.pop(region_id, None) is not None else 1
+
+    def _rw(self, region_id, offset, buf, length, is_write):
+        try:
+            with self._lock:
+                region = self._regions.get(region_id)
+            if region is None or offset + length > region["size"]:
+                return 1
+            jax = self._jax
+            cb = self.chunk_bytes
+            src = (
+                np.ctypeslib.as_array(ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)),
+                                      shape=(length,))
+                if length
+                else np.empty(0, np.uint8)
+            )
+            pos = 0
+            while pos < length:
+                chunk_idx = (offset + pos) // cb
+                chunk_off = (offset + pos) % cb
+                n = min(length - pos, cb - chunk_off)
+                if is_write:
+                    if chunk_off == 0 and n == cb:
+                        new_chunk = np.array(src[pos : pos + n], copy=True)
+                    else:
+                        host = np.asarray(region["chunks"][chunk_idx])
+                        new_chunk = host.copy()
+                        new_chunk[chunk_off : chunk_off + n] = src[pos : pos + n]
+                    region["chunks"][chunk_idx] = jax.device_put(new_chunk, region["device"])
+                else:
+                    host = np.asarray(region["chunks"][chunk_idx])
+                    src[pos : pos + n] = host[chunk_off : chunk_off + n]
+                pos += n
+            return 0
+        except Exception:  # noqa: BLE001
+            return 1
+
+    def _write(self, _ctx, region_id, offset, buf, length):
+        return self._rw(region_id, offset, buf, length, is_write=True)
+
+    def _read(self, _ctx, region_id, offset, buf, length):
+        return self._rw(region_id, offset, buf, length, is_write=False)
+
+    def _available(self, _ctx, _device_id):
+        return 0  # unknown
+
+    # -- registration ------------------------------------------------------
+
+    def register(self) -> "JaxHbmProvider":
+        """Installs this provider process-wide for all HBM_TPU backends."""
+        self._struct = _ProviderStruct(
+            ctx=None,
+            alloc_region=_ALLOC_FN(self._alloc),
+            free_region=_FREE_FN(self._free),
+            write=_WRITE_FN(self._write),
+            read=_READ_FN(self._read),
+            available=_AVAIL_FN(self._available),
+        )
+        lib.btpu_register_hbm_provider(ctypes.cast(ctypes.pointer(self._struct),
+                                                   ctypes.c_void_p))
+        return self
+
+    @staticmethod
+    def unregister() -> None:
+        """Restores the built-in host-memory emulation."""
+        lib.btpu_register_hbm_provider(None)
+
+    def region_count(self) -> int:
+        with self._lock:
+            return len(self._regions)

@@ -1,0 +1,122 @@
+"""ctypes bindings to the native core (libbtpu.so), with build-on-demand."""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import os
+import subprocess
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_BUILD_DIR = _REPO_ROOT / "build"
+_LIB_PATH = _BUILD_DIR / "libbtpu.so"
+
+
+class ErrorCode(enum.IntEnum):
+    """Mirror of btpu::ErrorCode domain bases + common codes (error.h)."""
+
+    OK = 0
+    INTERNAL_ERROR = 1000
+    NOT_IMPLEMENTED = 1005
+    MEMORY_POOL_NOT_FOUND = 2002
+    INSUFFICIENT_SPACE = 2006
+    MEMORY_ACCESS_ERROR = 2007
+    CONNECTION_FAILED = 3001
+    TRANSFER_FAILED = 3002
+    OBJECT_NOT_FOUND = 5000
+    OBJECT_ALREADY_EXISTS = 5001
+    NO_COMPLETE_WORKER = 5005
+    INVALID_PARAMETERS = 7002
+
+
+class StorageClass(enum.IntEnum):
+    RAM_CPU = 1
+    HBM_TPU = 2
+    NVME = 3
+    SSD = 4
+    HDD = 5
+    CXL_MEMORY = 6
+
+
+class TransportKind(enum.IntEnum):
+    LOCAL = 1
+    SHM = 2
+    TCP = 3
+    ICI = 4
+    HBM = 5
+
+
+def _needs_build() -> bool:
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    native_dir = _REPO_ROOT / "native"
+    for path in native_dir.rglob("*"):
+        if path.suffix in (".cpp", ".h") and path.stat().st_mtime > lib_mtime:
+            return True
+    return False
+
+
+def build_native(force: bool = False) -> None:
+    """(Re)builds libbtpu.so when sources are newer than the artifact."""
+    if not force and not _needs_build():
+        return
+    subprocess.run(
+        ["cmake", "-B", str(_BUILD_DIR), "-G", "Ninja"],
+        cwd=_REPO_ROOT,
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", str(_BUILD_DIR)],
+        cwd=_REPO_ROOT,
+        check=True,
+        capture_output=True,
+    )
+
+
+def _load() -> ctypes.CDLL:
+    build_native()
+    handle = ctypes.CDLL(str(_LIB_PATH))
+
+    c = ctypes.c_void_p
+    u32, u64, i32 = ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int32
+    sig = {
+        "btpu_cluster_create": (c, [u32, u64, u32, u32]),
+        "btpu_cluster_create_tiered": (c, [u32, u64, u64]),
+        "btpu_cluster_destroy": (None, [c]),
+        "btpu_cluster_kill_worker": (i32, [c, u32]),
+        "btpu_cluster_worker_count": (u32, [c]),
+        "btpu_cluster_counters": (None, [c, ctypes.POINTER(u64)]),
+        "btpu_client_create_embedded": (c, [c]),
+        "btpu_client_create_remote": (c, [ctypes.c_char_p]),
+        "btpu_client_destroy": (None, [c]),
+        "btpu_put": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32, u32]),
+        "btpu_get": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, ctypes.POINTER(u64)]),
+        "btpu_exists": (i32, [c, ctypes.c_char_p, ctypes.POINTER(i32)]),
+        "btpu_remove": (i32, [c, ctypes.c_char_p]),
+        "btpu_stats": (i32, [c, ctypes.POINTER(u64)]),
+        "btpu_error_name": (ctypes.c_char_p, [i32]),
+        "btpu_register_hbm_provider": (None, [ctypes.c_void_p]),
+    }
+    for name, (restype, argtypes) in sig.items():
+        fn = getattr(handle, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return handle
+
+
+lib = _load()
+
+
+class BtpuError(RuntimeError):
+    def __init__(self, code: int, operation: str):
+        self.code = code
+        name = lib.btpu_error_name(code).decode()
+        super().__init__(f"{operation} failed: {name} ({code})")
+
+
+def check(code: int, operation: str) -> None:
+    if code != 0:
+        raise BtpuError(code, operation)

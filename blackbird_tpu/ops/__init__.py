@@ -1,0 +1,1 @@
+from blackbird_tpu.ops.checksum import checksum_u32  # noqa: F401

@@ -1,0 +1,67 @@
+"""Object checksums on-device.
+
+End-to-end integrity is a gap in the reference (its DATA_CORRUPTION /
+CHECKSUM_MISMATCH codes exist but nothing computes checksums). Here shard
+digests run on the TPU: a pallas kernel folds a uint32 view of the object
+into per-block partial sums on the MXU-adjacent VPU, and jnp reduces the
+partials. CPU/interpret fallbacks keep the same semantics for dev machines.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# TPU-friendly tile: (8, 128) lanes of uint32 = 4 KiB per block.
+_BLOCK_ROWS = 8
+_BLOCK_COLS = 128
+_BLOCK_ELEMS = _BLOCK_ROWS * _BLOCK_COLS
+
+
+def _pallas_partials(x2d: jax.Array, interpret: bool) -> jax.Array:
+    """Per-block uint32 sums of a (rows, 128) uint32 array via pallas."""
+    from jax.experimental import pallas as pl
+
+    rows = x2d.shape[0]
+    grid = rows // _BLOCK_ROWS
+
+    def kernel(x_ref, o_ref):
+        o_ref[0, 0] = jnp.sum(x_ref[...], dtype=jnp.uint32)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_BLOCK_ROWS, _BLOCK_COLS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((grid, 1), jnp.uint32),
+        interpret=interpret,
+    )(x2d)
+
+
+@functools.partial(jax.jit, static_argnames=("use_pallas", "interpret"))
+def checksum_u32(data: jax.Array, use_pallas: bool = False, interpret: bool = False):
+    """Additive uint32 checksum (mod 2^32) of a uint32 array of any shape.
+
+    With use_pallas=True the partial sums run as a pallas kernel (TPU, or
+    interpret=True anywhere); otherwise a plain jnp reduction, which XLA
+    fuses into neighboring ops on TPU regardless.
+    """
+    flat = jnp.ravel(data).astype(jnp.uint32)
+    if not use_pallas:
+        return jnp.sum(flat, dtype=jnp.uint32)
+    pad = (-flat.shape[0]) % _BLOCK_ELEMS
+    padded = jnp.pad(flat, (0, pad))
+    x2d = padded.reshape(-1, _BLOCK_COLS)
+    partials = _pallas_partials(x2d, interpret)
+    return jnp.sum(partials, dtype=jnp.uint32)
+
+
+def checksum_bytes(data: bytes) -> int:
+    """Host-side reference checksum with identical semantics."""
+    import numpy as np
+
+    pad = (-len(data)) % 4
+    buf = np.frombuffer(data + b"\x00" * pad, dtype=np.uint32)
+    return int(np.sum(buf, dtype=np.uint64) % (1 << 32))

@@ -1,0 +1,5 @@
+from blackbird_tpu.parallel.engine import (  # noqa: F401
+    ShardedPool,
+    make_mesh,
+    replicate_ring_step,
+)

@@ -1,0 +1,49 @@
+"""TPU pod/slice topology discovery.
+
+Role parity: the north star replaces etcd-registered NIC endpoints with
+placement driven by TPU topology (BASELINE.json). On TPU VMs jax exposes the
+pod structure; here it is mapped onto the native TopoCoord scheme
+{slice_id, host_id, chip_id} used by the allocator's slice-affinity ranking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TopoCoord:
+    slice_id: int
+    host_id: int
+    chip_id: int
+
+
+def discover() -> list[TopoCoord]:
+    """One TopoCoord per addressable device, in jax.devices() order."""
+    import jax
+
+    coords = []
+    for device in jax.devices():
+        slice_id = getattr(device, "slice_index", 0) or 0
+        host_id = getattr(device, "process_index", 0) or 0
+        chip_id = getattr(device, "id", 0)
+        coords.append(TopoCoord(slice_id, host_id, chip_id))
+    return coords
+
+
+def local_coord() -> TopoCoord:
+    """Coordinate of this host (chip_id = -1 marks host memory)."""
+    import jax
+
+    devices = jax.local_devices()
+    if not devices:
+        return TopoCoord(0, 0, -1)
+    first = devices[0]
+    return TopoCoord(getattr(first, "slice_index", 0) or 0,
+                     getattr(first, "process_index", 0) or 0, -1)
+
+
+def worker_yaml_fields() -> dict[str, int]:
+    """slice_id/host_id fields for a worker config on this host."""
+    coord = local_coord()
+    return {"slice_id": coord.slice_id, "host_id": coord.host_id}

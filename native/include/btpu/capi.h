@@ -1,0 +1,48 @@
+// C ABI for the Python bindings (ctypes — pybind11 is not in this image).
+// Exposes the embedded cluster, object client, and cluster introspection.
+// All functions return 0 on success or a btpu::ErrorCode value.
+#pragma once
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct btpu_cluster btpu_cluster;
+typedef struct btpu_client btpu_client;
+
+// storage_class / transport take the numeric enum values from types.h
+// (RAM_CPU=1, HBM_TPU=2, NVME=3, ...; LOCAL=1, SHM=2, TCP=3).
+btpu_cluster* btpu_cluster_create(uint32_t n_workers, uint64_t pool_bytes,
+                                  uint32_t storage_class, uint32_t transport);
+// Workers with two pools each: a device tier (HBM) + a host tier, for
+// tiering tests from Python. device_bytes may be 0 to skip the device pool.
+btpu_cluster* btpu_cluster_create_tiered(uint32_t n_workers, uint64_t device_bytes,
+                                         uint64_t host_bytes);
+void btpu_cluster_destroy(btpu_cluster* cluster);
+int32_t btpu_cluster_kill_worker(btpu_cluster* cluster, uint32_t index);
+uint32_t btpu_cluster_worker_count(btpu_cluster* cluster);
+// Counters snapshot: [repaired, lost, evicted, gc_collected, workers_lost].
+void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[5]);
+
+btpu_client* btpu_client_create_embedded(btpu_cluster* cluster);
+btpu_client* btpu_client_create_remote(const char* keystone_endpoint);
+void btpu_client_destroy(btpu_client* client);
+
+// preferred_class 0 = no preference. replicas 0 = cluster default.
+int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,
+                 uint32_t replicas, uint32_t max_workers, uint32_t preferred_class);
+// Returns object size via out_size; buffer may be NULL to query size only.
+int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size,
+                 uint64_t* out_size);
+int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists);
+int32_t btpu_remove(btpu_client* client, const char* key);
+// out: [workers, pools, objects, capacity, used]
+int32_t btpu_stats(btpu_client* client, uint64_t out[5]);
+
+const char* btpu_error_name(int32_t code);
+
+#ifdef __cplusplus
+}
+#endif

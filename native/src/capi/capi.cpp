@@ -1,0 +1,170 @@
+#include "btpu/capi.h"
+
+#include "btpu/client/embedded.h"
+#include "btpu/common/log.h"
+
+using namespace btpu;
+
+struct btpu_cluster {
+  std::unique_ptr<client::EmbeddedCluster> impl;
+};
+
+struct btpu_client {
+  std::unique_ptr<client::ObjectClient> impl;
+};
+
+extern "C" {
+
+btpu_cluster* btpu_cluster_create(uint32_t n_workers, uint64_t pool_bytes,
+                                  uint32_t storage_class, uint32_t transport) {
+  auto options = client::EmbeddedClusterOptions::simple(
+      n_workers, pool_bytes, static_cast<StorageClass>(storage_class));
+  const auto kind = static_cast<TransportKind>(transport);
+  for (auto& w : options.workers) {
+    w.transport = kind;
+    if (kind == TransportKind::TCP) w.listen_host = "127.0.0.1";
+  }
+  auto cluster = std::make_unique<client::EmbeddedCluster>(std::move(options));
+  if (cluster->start() != ErrorCode::OK) return nullptr;
+  auto* handle = new btpu_cluster;
+  handle->impl = std::move(cluster);
+  return handle;
+}
+
+btpu_cluster* btpu_cluster_create_tiered(uint32_t n_workers, uint64_t device_bytes,
+                                         uint64_t host_bytes) {
+  client::EmbeddedClusterOptions options;
+  options.keystone.gc_interval_sec = 1;
+  options.keystone.health_check_interval_sec = 1;
+  for (uint32_t i = 0; i < n_workers; ++i) {
+    worker::WorkerServiceConfig w;
+    w.worker_id = "worker-" + std::to_string(i);
+    w.cluster_id = options.keystone.cluster_id;
+    w.transport = TransportKind::LOCAL;
+    w.heartbeat_interval_ms = 100;
+    w.heartbeat_ttl_ms = 500;
+    w.topo = {0, static_cast<int32_t>(i), -1};
+    if (device_bytes > 0) {
+      worker::PoolConfig hbm;
+      hbm.id = "hbm-" + std::to_string(i);
+      hbm.storage_class = StorageClass::HBM_TPU;
+      hbm.capacity = device_bytes;
+      hbm.device_id = "tpu:" + std::to_string(i);
+      w.pools.push_back(hbm);
+    }
+    worker::PoolConfig host;
+    host.id = "dram-" + std::to_string(i);
+    host.storage_class = StorageClass::RAM_CPU;
+    host.capacity = host_bytes;
+    w.pools.push_back(host);
+    options.workers.push_back(std::move(w));
+  }
+  auto cluster = std::make_unique<client::EmbeddedCluster>(std::move(options));
+  if (cluster->start() != ErrorCode::OK) return nullptr;
+  auto* handle = new btpu_cluster;
+  handle->impl = std::move(cluster);
+  return handle;
+}
+
+void btpu_cluster_destroy(btpu_cluster* cluster) { delete cluster; }
+
+int32_t btpu_cluster_kill_worker(btpu_cluster* cluster, uint32_t index) {
+  if (!cluster) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  cluster->impl->kill_worker(index);
+  return 0;
+}
+
+uint32_t btpu_cluster_worker_count(btpu_cluster* cluster) {
+  return cluster ? static_cast<uint32_t>(cluster->impl->worker_count()) : 0;
+}
+
+void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[5]) {
+  if (!cluster || !out) return;
+  const auto& c = cluster->impl->keystone().counters();
+  out[0] = c.objects_repaired.load();
+  out[1] = c.objects_lost.load();
+  out[2] = c.evicted.load();
+  out[3] = c.gc_collected.load();
+  out[4] = c.workers_lost.load();
+}
+
+btpu_client* btpu_client_create_embedded(btpu_cluster* cluster) {
+  if (!cluster) return nullptr;
+  auto* handle = new btpu_client;
+  handle->impl = cluster->impl->make_client();
+  return handle;
+}
+
+btpu_client* btpu_client_create_remote(const char* keystone_endpoint) {
+  if (!keystone_endpoint) return nullptr;
+  client::ClientOptions options;
+  options.keystone_address = keystone_endpoint;
+  auto client = std::make_unique<client::ObjectClient>(options);
+  if (client->connect() != ErrorCode::OK) return nullptr;
+  auto* handle = new btpu_client;
+  handle->impl = std::move(client);
+  return handle;
+}
+
+void btpu_client_destroy(btpu_client* client) { delete client; }
+
+int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,
+                 uint32_t replicas, uint32_t max_workers, uint32_t preferred_class) {
+  if (!client || !key || !data) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  WorkerConfig cfg;
+  cfg.replication_factor = replicas == 0 ? 1 : replicas;
+  cfg.max_workers_per_copy = max_workers == 0 ? 1 : max_workers;
+  if (preferred_class != 0)
+    cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
+  return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
+}
+
+int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size,
+                 uint64_t* out_size) {
+  if (!client || !key || !out_size) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  if (!buffer) {
+    auto placements = client->impl->get_workers(key);
+    if (!placements.ok()) return static_cast<int32_t>(placements.error());
+    uint64_t size = 0;
+    if (!placements.value().empty()) {
+      for (const auto& shard : placements.value().front().shards) size += shard.length;
+    }
+    *out_size = size;
+    return 0;
+  }
+  auto got = client->impl->get_into(key, buffer, buffer_size);
+  if (!got.ok()) return static_cast<int32_t>(got.error());
+  *out_size = got.value();
+  return 0;
+}
+
+int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists) {
+  if (!client || !key || !out_exists) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto r = client->impl->object_exists(key);
+  if (!r.ok()) return static_cast<int32_t>(r.error());
+  *out_exists = r.value() ? 1 : 0;
+  return 0;
+}
+
+int32_t btpu_remove(btpu_client* client, const char* key) {
+  if (!client || !key) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  return static_cast<int32_t>(client->impl->remove(key));
+}
+
+int32_t btpu_stats(btpu_client* client, uint64_t out[5]) {
+  if (!client || !out) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto stats = client->impl->cluster_stats();
+  if (!stats.ok()) return static_cast<int32_t>(stats.error());
+  out[0] = stats.value().total_workers;
+  out[1] = stats.value().total_memory_pools;
+  out[2] = stats.value().total_objects;
+  out[3] = stats.value().total_capacity;
+  out[4] = stats.value().used_capacity;
+  return 0;
+}
+
+const char* btpu_error_name(int32_t code) {
+  return to_string(static_cast<ErrorCode>(code)).data();
+}
+
+}  // extern "C"

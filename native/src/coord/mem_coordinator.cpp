@@ -59,6 +59,11 @@ void MemCoordinator::expiry_loop() {
       leases_.erase(it);
       LOG_DEBUG << "lease " << id << " expired (" << keys.size() << " keys)";
       for (const auto& key : keys) {
+        // Only delete entries still owned by this lease: a key refreshed via
+        // a later put_with_ttl belongs to the new lease and must survive
+        // (heartbeat refresh pattern).
+        auto entry = data_.find(key);
+        if (entry == data_.end() || entry->second.lease != id) continue;
         // del_locked unlocks while firing watch callbacks.
         del_locked(key, lock);
       }
@@ -179,7 +184,11 @@ ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
   if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
   auto keys = it->second.keys;
   leases_.erase(it);
-  for (const auto& key : keys) del_locked(key, lock);
+  for (const auto& key : keys) {
+    auto entry = data_.find(key);
+    if (entry == data_.end() || entry->second.lease != lease) continue;
+    del_locked(key, lock);
+  }
   for (auto& [election, candidates] : elections_) {
     auto dead = std::find_if(candidates.begin(), candidates.end(),
                              [&](const Candidate& c) { return c.lease == lease; });

@@ -95,6 +95,27 @@ void run_ttl_watch_suite(Coordinator& c) {
   BT_EXPECT_EQ(puts.load(), puts_before);  // no events after unwatch
 }
 
+void run_heartbeat_refresh_suite(Coordinator& c) {
+  // Regression: refreshing a key with repeated put_with_ttl must keep it
+  // alive — the first lease's expiry must not delete the refreshed entry
+  // (worker heartbeat pattern: new lease per put).
+  std::atomic<int> deletes{0};
+  auto watch = c.watch_prefix("/hb2/", [&](const WatchEvent& ev) {
+    if (ev.type == WatchEvent::Type::kDelete) ++deletes;
+  });
+  BT_ASSERT_OK(watch);
+  for (int i = 0; i < 8; ++i) {
+    BT_EXPECT(c.put_with_ttl("/hb2/w", "alive", 120) == ErrorCode::OK);
+    std::this_thread::sleep_for(60ms);  // well within ttl, beyond half
+  }
+  BT_EXPECT(c.get("/hb2/w").ok());
+  BT_EXPECT_EQ(deletes.load(), 0);
+  // Stop refreshing: the key dies exactly once.
+  BT_EXPECT(eventually([&] { return deletes.load() == 1; }, 2000));
+  BT_EXPECT(!c.get("/hb2/w").ok());
+  c.unwatch(watch.value());
+}
+
 void run_registry_suite(Coordinator& c) {
   BT_EXPECT(c.register_service("keystone", "ks-1", "10.0.0.1:9090", 60000) == ErrorCode::OK);
   BT_EXPECT(c.register_service("keystone", "ks-2", "10.0.0.2:9090", 60000) == ErrorCode::OK);
@@ -135,6 +156,11 @@ BTEST(MemCoordinator, KvOperations) {
 BTEST(MemCoordinator, TtlAndWatches) {
   MemCoordinator c;
   run_ttl_watch_suite(c);
+}
+
+BTEST(MemCoordinator, HeartbeatRefreshKeepsKeyAlive) {
+  MemCoordinator c;
+  run_heartbeat_refresh_suite(c);
 }
 
 BTEST(MemCoordinator, ServiceRegistry) {
@@ -182,6 +208,12 @@ BTEST(RemoteCoordinator, TtlAndWatches) {
   RemoteFixture f;
   BT_ASSERT(f.up());
   run_ttl_watch_suite(*f.client);
+}
+
+BTEST(RemoteCoordinator, HeartbeatRefreshKeepsKeyAlive) {
+  RemoteFixture f;
+  BT_ASSERT(f.up());
+  run_heartbeat_refresh_suite(*f.client);
 }
 
 BTEST(RemoteCoordinator, ServiceRegistry) {

@@ -1,0 +1,40 @@
+"""Pytest config: force a virtual 8-device CPU mesh before jax loads.
+
+Tests must be hermetic and runnable without TPU hardware; the multi-chip
+sharding paths are validated on XLA's host-platform virtual devices. The
+driver separately dry-runs the multichip path via __graft_entry__.py and
+benches on the real chip via bench.py.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# Must happen before any jax import anywhere in the test session. Force-set:
+# the ambient environment may point JAX_PLATFORMS at real TPU hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+# Some images register a TPU PJRT plugin from sitecustomize and force the
+# platform past the env var; pin the config explicitly as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def built_native():
+    from blackbird_tpu import native
+
+    native.build_native()
+    return native

@@ -1,0 +1,22 @@
+"""Runs the native C++ unit/e2e suite (btpu_tests) under pytest.
+
+The native suite is the dense coverage layer (allocator, coordinator,
+transports, storage tiers, keystone, rpc, e2e — see native/tests/); this
+wrapper keeps `python -m pytest tests/` the single green/red signal.
+"""
+
+import subprocess
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_native_suite_passes(built_native):
+    binary = REPO_ROOT / "build" / "btpu_tests"
+    assert binary.exists(), "btpu_tests missing — native build failed?"
+    result = subprocess.run(
+        [str(binary)], capture_output=True, text=True, timeout=600, cwd=REPO_ROOT
+    )
+    tail = "\n".join(result.stdout.splitlines()[-30:])
+    assert result.returncode == 0, f"native tests failed:\n{tail}\n{result.stderr[-2000:]}"
+    assert ", 0 failed" in result.stdout

@@ -1,0 +1,89 @@
+"""Python bindings: embedded cluster + client put/get/remove/failure flows."""
+
+import numpy as np
+import pytest
+
+from blackbird_tpu import Client, EmbeddedCluster, StorageClass, TransportKind
+from blackbird_tpu.native import BtpuError, ErrorCode
+
+
+def test_put_get_bytes_roundtrip():
+    with EmbeddedCluster(workers=4, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        payload = bytes(bytearray(range(256)) * 1024)  # 256 KiB
+        client.put("py/obj", payload, max_workers=4)
+        assert client.exists("py/obj")
+        assert client.get("py/obj") == payload
+        client.remove("py/obj")
+        assert not client.exists("py/obj")
+
+
+def test_put_get_numpy_roundtrip():
+    with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        array = np.arange(65536, dtype=np.float32).reshape(256, 256)
+        client.put("py/array", array)
+        back = client.get_array("py/array", dtype=np.float32, shape=(256, 256))
+        np.testing.assert_array_equal(array, back)
+
+        out = np.empty_like(array)
+        n = client.get_into("py/array", out)
+        assert n == array.nbytes
+        np.testing.assert_array_equal(array, out)
+
+
+def test_missing_object_raises_object_not_found():
+    with EmbeddedCluster(workers=1, pool_bytes=1 << 20) as cluster:
+        client = cluster.client()
+        with pytest.raises(BtpuError) as excinfo:
+            client.get("nope")
+        assert excinfo.value.code == ErrorCode.OBJECT_NOT_FOUND
+        with pytest.raises(BtpuError):
+            client.put("dup", b"x")
+            client.put("dup", b"x")
+
+
+def test_replication_and_worker_death_repair():
+    with EmbeddedCluster(workers=3, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        payload = np.random.default_rng(7).bytes(128 * 1024)
+        client.put("py/precious", payload, replicas=2, max_workers=1)
+        cluster.kill_worker(0)
+        # Repair happens synchronously in the death path; data must survive
+        # regardless of which worker held which copy.
+        counters = cluster.counters()
+        assert counters["workers_lost"] == 1
+        assert client.get("py/precious") == payload
+
+
+def test_stats_and_cluster_shapes():
+    with EmbeddedCluster(workers=2, pool_bytes=8 << 20) as cluster:
+        client = cluster.client()
+        stats = client.stats()
+        assert stats["workers"] == 2
+        assert stats["pools"] == 2
+        client.put("py/s", b"abcd" * 1024)
+        assert client.stats()["objects"] == 1
+        assert client.stats()["used"] >= 4096
+
+
+def test_shm_transport_cluster():
+    with EmbeddedCluster(workers=2, pool_bytes=8 << 20,
+                         transport=TransportKind.SHM) as cluster:
+        client = cluster.client()
+        payload = b"shm-bytes" * 5000
+        client.put("py/shm", payload, max_workers=2)
+        assert client.get("py/shm") == payload
+
+
+def test_tiered_cluster_hbm_preference():
+    with EmbeddedCluster(workers=1, pool_bytes=16 << 20,
+                         tiered_device_bytes=1 << 20) as cluster:
+        client = cluster.client()
+        small = b"hot" * 1000
+        client.put("py/hot", small, preferred_class=StorageClass.HBM_TPU)
+        assert client.get("py/hot") == small
+        # Larger than the HBM pool: spills to DRAM but still round-trips.
+        big = np.random.default_rng(3).bytes(4 << 20)
+        client.put("py/cold", big, preferred_class=StorageClass.HBM_TPU)
+        assert client.get("py/cold") == big

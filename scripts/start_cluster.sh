@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Brings up a full localhost cluster: coordination service -> keystone ->
+# worker -> smoke test. (Role parity: reference scripts/start_cluster.sh,
+# which launched etcd + keystone_example + worker_example + a smoke client.)
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+BUILD="$REPO_ROOT/build"
+RUN_DIR="${BTPU_RUN_DIR:-/tmp/btpu-cluster}"
+COORD_PORT="${BTPU_COORD_PORT:-9290}"
+KEYSTONE_PORT="${BTPU_KEYSTONE_PORT:-9090}"
+
+mkdir -p "$RUN_DIR"
+
+if [[ ! -x "$BUILD/bb-coord" ]]; then
+  echo "building native binaries..."
+  cmake -B "$BUILD" -G Ninja >/dev/null
+  ninja -C "$BUILD" >/dev/null
+fi
+
+cleanup() {
+  echo "stopping cluster..."
+  [[ -n "${WORKER_PID:-}" ]] && kill "$WORKER_PID" 2>/dev/null || true
+  [[ -n "${KEYSTONE_PID:-}" ]] && kill "$KEYSTONE_PID" 2>/dev/null || true
+  [[ -n "${COORD_PID:-}" ]] && kill "$COORD_PID" 2>/dev/null || true
+}
+trap cleanup EXIT INT TERM
+
+echo "starting bb-coord on :$COORD_PORT"
+"$BUILD/bb-coord" --host 127.0.0.1 --port "$COORD_PORT" >"$RUN_DIR/coord.log" 2>&1 &
+COORD_PID=$!
+sleep 0.3
+
+echo "starting bb-keystone on :$KEYSTONE_PORT"
+"$BUILD/bb-keystone" --config "$REPO_ROOT/configs/keystone.yaml" \
+  --coord "127.0.0.1:$COORD_PORT" --listen "127.0.0.1:$KEYSTONE_PORT" \
+  >"$RUN_DIR/keystone.log" 2>&1 &
+KEYSTONE_PID=$!
+sleep 0.5
+
+echo "starting bb-worker"
+"$BUILD/bb-worker" --config "$REPO_ROOT/configs/worker.yaml" \
+  --coord "127.0.0.1:$COORD_PORT" >"$RUN_DIR/worker.log" 2>&1 &
+WORKER_PID=$!
+sleep 0.7
+
+echo "smoke test: put/get/verify through bb-client"
+"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" put smoke/obj --size 1048576
+"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" get smoke/obj --out "$RUN_DIR/smoke.bin"
+"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" stats
+"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" remove smoke/obj
+echo "metrics scrape:"
+curl -sf "http://127.0.0.1:9091/metrics" | head -5 || true
+
+echo
+echo "cluster up. PIDs: coord=$COORD_PID keystone=$KEYSTONE_PID worker=$WORKER_PID"
+echo "logs in $RUN_DIR. Ctrl-C to stop."
+if [[ "${BTPU_CLUSTER_ONESHOT:-0}" == "1" ]]; then
+  exit 0
+fi
+wait

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Stops any bb-* processes from start_cluster.sh.
+set -uo pipefail
+pkill -f 'bb-worker --config' 2>/dev/null
+pkill -f 'bb-keystone --config' 2>/dev/null
+pkill -f 'bb-coord' 2>/dev/null
+echo "stopped"

@@ -1,0 +1,140 @@
+"""Multi-process integration: real bb-coord / bb-keystone / bb-worker
+processes on localhost, driven by the Python client over RPC + TCP data
+plane, including worker-death failover across processes.
+
+The reference has NO automated multi-process tests (SURVEY §4) — its
+distributed behavior was only exercised by a manual shell script.
+"""
+
+import signal
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BUILD = REPO_ROOT / "build"
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def port_open(port: int) -> bool:
+    with socket.socket() as sock:
+        sock.settimeout(0.2)
+        return sock.connect_ex(("127.0.0.1", port)) == 0
+
+
+def write_worker_config(tmp_path: Path, worker_id: str, coord_port: int) -> Path:
+    path = tmp_path / f"{worker_id}.yaml"
+    path.write_text(
+        f"""worker_id: {worker_id}
+cluster_id: mp_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+transport: tcp
+listen_host: 127.0.0.1
+heartbeat:
+  interval_ms: 300
+  ttl_ms: 1200
+pools:
+  - id: {worker_id}-dram
+    storage_class: ram_cpu
+    capacity: 32MB
+""")
+    return path
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    coord_port = free_port()
+    keystone_port = free_port()
+    metrics_port = free_port()
+
+    keystone_cfg = tmp_path / "keystone.yaml"
+    keystone_cfg.write_text(
+        f"""cluster_id: mp_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{keystone_port}
+http_metrics_port: "{metrics_port}"
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 2
+""")
+
+    procs = []
+
+    def spawn(args, name):
+        proc = subprocess.Popen(
+            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        procs.append((name, proc))
+        return proc
+
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
+        wait_for(lambda: port_open(keystone_port), what="bb-keystone")
+        workers = []
+        for i in range(2):
+            cfg = write_worker_config(tmp_path, f"mpw-{i}", coord_port)
+            workers.append(spawn([str(BUILD / "bb-worker"), "--config", str(cfg)],
+                                 f"worker-{i}"))
+        yield {
+            "keystone_port": keystone_port,
+            "metrics_port": metrics_port,
+            "workers": workers,
+        }
+    finally:
+        for name, proc in reversed(procs):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for name, proc in procs:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def test_multiprocess_put_get_failover(cluster):
+    from blackbird_tpu import Client
+
+    client = Client(f"127.0.0.1:{cluster['keystone_port']}")
+    # Workers register asynchronously via the coordinator.
+    wait_for(lambda: client.stats()["workers"] == 2, timeout=15, what="2 workers")
+
+    payload = bytes(bytearray(range(251)) * 2048)  # ~500 KiB
+    client.put("mp/obj", payload, replicas=2, max_workers=1)
+    assert client.get("mp/obj") == payload
+
+    # Kill one worker process (SIGKILL = crash). Heartbeat TTL lapses, the
+    # keystone repairs from the surviving replica, and reads keep working.
+    victim = cluster["workers"][0]
+    victim.kill()
+    wait_for(lambda: client.stats()["workers"] == 1, timeout=15, what="death detection")
+    assert client.get("mp/obj") == payload
+
+    # Metrics endpoint is live and counts the loss.
+    import urllib.request
+
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{cluster['metrics_port']}/metrics", timeout=5
+    ).read().decode()
+    assert "btpu_workers_lost_total 1" in body
+    assert "btpu_objects 1" in body

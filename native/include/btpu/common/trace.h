@@ -1,0 +1,58 @@
+// First-class span timing.
+//
+// Role parity: the reference has no structured tracing — demo clients
+// hand-roll high_resolution_clock spans (clients/ucx_client.cpp:116-148).
+// Since the scoreboard metric is p50/p99 latency (BASELINE.md), the
+// framework aggregates spans always-on (~20ns/op) and can emit JSONL events
+// when BTPU_TRACE=<path> is set. Aggregates surface in /metrics as
+// btpu_span_{p50,p99}_us{span="..."} gauges.
+//
+// Usage:  { TRACE_SPAN("client.put.transfer"); ...hot path... }
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace btpu::trace {
+
+struct SpanStats {
+  std::string name;
+  uint64_t count{0};
+  double total_us{0};
+  double p50_us{0};
+  double p99_us{0};
+  double max_us{0};
+};
+
+// Records one duration sample for `name`.
+void record(std::string_view name, double duration_us);
+
+// Aggregated percentiles per span name (reservoir of recent samples).
+std::vector<SpanStats> summary();
+void reset();
+
+// RAII span.
+class Span {
+ public:
+  explicit Span(std::string_view name)
+      : name_(name), start_(std::chrono::steady_clock::now()) {}
+  ~Span() {
+    const auto end = std::chrono::steady_clock::now();
+    record(name_, std::chrono::duration<double, std::micro>(end - start_).count());
+  }
+  Span(const Span&) = delete;
+  Span& operator=(const Span&) = delete;
+
+ private:
+  std::string_view name_;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace btpu::trace
+
+#define BTPU_TRACE_CONCAT_INNER(a, b) a##b
+#define BTPU_TRACE_CONCAT(a, b) BTPU_TRACE_CONCAT_INNER(a, b)
+#define TRACE_SPAN(name) ::btpu::trace::Span BTPU_TRACE_CONCAT(_btpu_span_, __LINE__)(name)

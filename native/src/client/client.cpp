@@ -5,6 +5,7 @@
 
 #include "btpu/common/log.h"
 #include "btpu/common/thread_pool.h"
+#include "btpu/common/trace.h"
 #include "btpu/storage/hbm_provider.h"
 
 namespace btpu::client {
@@ -40,11 +41,17 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
                             const WorkerConfig& config) {
-  auto placed = embedded_ ? embedded_->put_start(key, size, config)
-                          : rpc_->put_start(key, size, config);
+  TRACE_SPAN("client.put");
+  Result<std::vector<CopyPlacement>> placed = ErrorCode::INTERNAL_ERROR;
+  {
+    TRACE_SPAN("client.put.start_rpc");
+    placed = embedded_ ? embedded_->put_start(key, size, config)
+                       : rpc_->put_start(key, size, config);
+  }
   if (!placed.ok()) return placed.error();
 
   const auto* bytes = static_cast<const uint8_t*>(data);
+  TRACE_SPAN("client.put.transfer");
   for (const auto& copy : placed.value()) {
     if (auto ec = transfer_copy_put(copy, bytes, size); ec != ErrorCode::OK) {
       // Roll back the reservation (reference blackbird_client.cpp:104-107).
@@ -61,6 +68,7 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 }
 
 Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
+  TRACE_SPAN("client.get");
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
   uint64_t size = 0;
@@ -86,6 +94,7 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
 
 Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
                                         uint64_t buffer_size) {
+  TRACE_SPAN("client.get");
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;

@@ -1,0 +1,101 @@
+#include "btpu/common/trace.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+
+namespace btpu::trace {
+
+namespace {
+
+constexpr size_t kReservoir = 4096;
+
+struct SpanAccumulator {
+  uint64_t count{0};
+  double total_us{0};
+  double max_us{0};
+  std::vector<double> samples;  // ring of recent durations
+  size_t next{0};
+
+  void add(double us) {
+    ++count;
+    total_us += us;
+    max_us = std::max(max_us, us);
+    if (samples.size() < kReservoir) {
+      samples.push_back(us);
+    } else {
+      samples[next] = us;
+      next = (next + 1) % kReservoir;
+    }
+  }
+};
+
+struct Registry {
+  std::mutex mutex;
+  std::map<std::string, SpanAccumulator, std::less<>> spans;
+  FILE* jsonl{nullptr};
+
+  Registry() {
+    if (const char* path = std::getenv("BTPU_TRACE")) {
+      jsonl = std::fopen(path, "a");
+    }
+  }
+
+  static Registry& instance() {
+    static Registry* r = new Registry;  // leaked: spans recorded at exit
+    return *r;
+  }
+};
+
+double percentile_of(std::vector<double>& sorted, double p) {
+  if (sorted.empty()) return 0.0;
+  const size_t idx =
+      std::min(sorted.size() - 1, static_cast<size_t>(p * static_cast<double>(sorted.size())));
+  return sorted[idx];
+}
+
+}  // namespace
+
+void record(std::string_view name, double duration_us) {
+  auto& reg = Registry::instance();
+  std::lock_guard<std::mutex> lock(reg.mutex);
+  auto it = reg.spans.find(name);
+  if (it == reg.spans.end()) {
+    it = reg.spans.emplace(std::string(name), SpanAccumulator{}).first;
+  }
+  it->second.add(duration_us);
+  if (reg.jsonl) {
+    std::fprintf(reg.jsonl, "{\"span\":\"%.*s\",\"us\":%.2f}\n",
+                 static_cast<int>(name.size()), name.data(), duration_us);
+  }
+}
+
+std::vector<SpanStats> summary() {
+  auto& reg = Registry::instance();
+  std::lock_guard<std::mutex> lock(reg.mutex);
+  std::vector<SpanStats> out;
+  out.reserve(reg.spans.size());
+  for (auto& [name, acc] : reg.spans) {
+    SpanStats stats;
+    stats.name = name;
+    stats.count = acc.count;
+    stats.total_us = acc.total_us;
+    stats.max_us = acc.max_us;
+    auto sorted = acc.samples;
+    std::sort(sorted.begin(), sorted.end());
+    stats.p50_us = percentile_of(sorted, 0.50);
+    stats.p99_us = percentile_of(sorted, 0.99);
+    out.push_back(std::move(stats));
+  }
+  return out;
+}
+
+void reset() {
+  auto& reg = Registry::instance();
+  std::lock_guard<std::mutex> lock(reg.mutex);
+  reg.spans.clear();
+}
+
+}  // namespace btpu::trace

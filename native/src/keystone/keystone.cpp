@@ -3,6 +3,7 @@
 #include <algorithm>
 
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 
 namespace btpu::keystone {
@@ -235,6 +236,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
       std::min(effective.replication_factor, static_cast<size_t>(config_.max_replicas));
   if (effective.max_workers_per_copy == 0) effective.max_workers_per_copy = 1;
 
+  TRACE_SPAN("keystone.put_start");
   std::unique_lock lock(objects_mutex_);
   if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
 
@@ -243,7 +245,11 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
     std::shared_lock rlock(registry_mutex_);
     pools_snapshot = pools_;
   }
-  auto placed = adapter_.allocate_data_copies(key, size, effective, pools_snapshot);
+  Result<std::vector<CopyPlacement>> placed = ErrorCode::INTERNAL_ERROR;
+  {
+    TRACE_SPAN("keystone.allocate");
+    placed = adapter_.allocate_data_copies(key, size, effective, pools_snapshot);
+  }
   if (!placed.ok()) return placed.error();
 
   ObjectInfo info;

@@ -3,6 +3,7 @@
 #include <sstream>
 
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/keystone/keystone.h"
 
 namespace btpu::rpc {
@@ -73,6 +74,18 @@ std::string MetricsHttpServer::render_metrics() const {
         static_cast<double>(service_.get_view_version()));
   gauge("btpu_keystone_leader", "1 when this keystone holds leadership",
         service_.is_leader() ? 1.0 : 0.0);
+
+  // Span latency aggregates (count + p50/p99 over recent samples).
+  out << "# HELP btpu_span_p50_us span p50 latency (us)\n# TYPE btpu_span_p50_us gauge\n";
+  auto spans = trace::summary();
+  for (const auto& s : spans)
+    out << "btpu_span_p50_us{span=\"" << s.name << "\"} " << s.p50_us << "\n";
+  out << "# HELP btpu_span_p99_us span p99 latency (us)\n# TYPE btpu_span_p99_us gauge\n";
+  for (const auto& s : spans)
+    out << "btpu_span_p99_us{span=\"" << s.name << "\"} " << s.p99_us << "\n";
+  out << "# HELP btpu_span_count_total span samples\n# TYPE btpu_span_count_total counter\n";
+  for (const auto& s : spans)
+    out << "btpu_span_count_total{span=\"" << s.name << "\"} " << s.count << "\n";
   return out.str();
 }
 

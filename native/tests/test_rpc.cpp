@@ -3,6 +3,7 @@
 #include <cstring>
 
 #include "btest.h"
+#include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/rpc/rpc_client.h"
@@ -178,4 +179,39 @@ BTEST(Rpc, MetricsEndpointServesPrometheusText) {
     response2.append(buf, static_cast<size_t>(n));
   BT_EXPECT(response2.find("404") != std::string::npos);
   metrics.stop();
+}
+
+BTEST(Trace, SpansAggregateAndExportInMetrics) {
+  btpu::trace::reset();
+  {
+    RpcFixture f;
+    BT_ASSERT(f.up());
+    WorkerConfig wc;
+    wc.replication_factor = 1;
+    wc.max_workers_per_copy = 1;
+    for (int i = 0; i < 20; ++i) {
+      f.client->put_start("t/" + std::to_string(i), 1024, wc);
+      f.client->put_complete("t/" + std::to_string(i));
+    }
+    auto spans = btpu::trace::summary();
+    bool found_alloc = false;
+    for (const auto& s : spans) {
+      if (s.name == "keystone.allocate") {
+        found_alloc = true;
+        BT_EXPECT_EQ(s.count, 20ull);
+        BT_EXPECT(s.p50_us > 0.0);
+        BT_EXPECT(s.p99_us >= s.p50_us);
+        BT_EXPECT(s.max_us >= s.p99_us);
+      }
+    }
+    BT_EXPECT(found_alloc);
+
+    MetricsHttpServer metrics(f.ks, "127.0.0.1", 0);
+    BT_ASSERT(metrics.start() == ErrorCode::OK);
+    auto text = metrics.render_metrics();
+    BT_EXPECT(text.find("btpu_span_p99_us{span=\"keystone.allocate\"}") != std::string::npos);
+    BT_EXPECT(text.find("btpu_span_count_total{span=\"keystone.put_start\"} 20") !=
+              std::string::npos);
+    metrics.stop();
+  }
 }

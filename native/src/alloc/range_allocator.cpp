@@ -280,18 +280,18 @@ void RangeAllocator::rollback_allocation(
 }
 
 ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
+  // Lock order: pools before allocations, matching get_stats (verified by
+  // TSan: the reverse order forms a cycle with the stats path).
+  std::shared_lock pools_lock(pools_mutex_);
   std::unique_lock lock(allocations_mutex_);
   auto it = object_allocations_.find(object_key);
   if (it == object_allocations_.end()) {
     LOG_DEBUG << "free of unknown object " << object_key;
     return ErrorCode::OBJECT_NOT_FOUND;
   }
-  {
-    std::shared_lock pools_lock(pools_mutex_);
-    for (const auto& [pool_id, range] : it->second.ranges) {
-      auto pa = pool_allocators_.find(pool_id);
-      if (pa != pool_allocators_.end()) pa->second->free(range);
-    }
+  for (const auto& [pool_id, range] : it->second.ranges) {
+    auto pa = pool_allocators_.find(pool_id);
+    if (pa != pool_allocators_.end()) pa->second->free(range);
   }
   LOG_DEBUG << "freed object " << object_key << " (" << it->second.total_size << " bytes, "
             << it->second.ranges.size() << " ranges)";

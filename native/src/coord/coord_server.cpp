@@ -30,8 +30,10 @@ ErrorCode CoordServer::start() {
 
 void CoordServer::stop() {
   if (!running_.exchange(false)) return;
-  listener_.close();
+  // Join the accept loop (its poll wakes within 200ms) before touching the
+  // listener: closing a socket under a concurrent poll is a data race.
   if (accept_thread_.joinable()) accept_thread_.join();
+  listener_.close();
   std::vector<std::thread> threads;
   {
     std::lock_guard<std::mutex> lock(conns_mutex_);

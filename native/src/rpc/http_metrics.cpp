@@ -28,8 +28,8 @@ ErrorCode MetricsHttpServer::start() {
 
 void MetricsHttpServer::stop() {
   if (!running_.exchange(false)) return;
+  if (accept_thread_.joinable()) accept_thread_.join();  // poll wakes <=200ms
   listener_.close();
-  if (accept_thread_.joinable()) accept_thread_.join();
 }
 
 std::string MetricsHttpServer::render_metrics() const {

@@ -65,8 +65,8 @@ class TcpTransportServer : public TransportServer {
 
   void stop() override {
     if (!running_.exchange(false)) return;
+    if (accept_thread_.joinable()) accept_thread_.join();  // poll wakes <=200ms
     listener_.close();
-    if (accept_thread_.joinable()) accept_thread_.join();
     std::vector<std::thread> threads;
     {
       std::lock_guard<std::mutex> lock(conns_mutex_);

@@ -248,12 +248,15 @@ void WorkerService::stop() {
         coordinator_->del(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id));
     }
   }
+  // Transports first: their connection threads may be mid-transfer inside
+  // backend regions; stopping them joins every serving thread. Only then is
+  // it safe to free backend memory.
+  if (virtual_transport_) virtual_transport_->stop();
+  if (primary_transport_) primary_transport_->stop();
   for (auto& p : pools_) {
     if (p.backend) p.backend->shutdown();
   }
   pools_.clear();
-  if (virtual_transport_) virtual_transport_->stop();
-  if (primary_transport_) primary_transport_->stop();
   initialized_ = false;
 }
 

@@ -1,0 +1,59 @@
+// Transport comparison: REAL one-sided read/write bandwidth per transport
+// (the reference's examples/benchmark_ucx_transports.cpp only memcpy-simulated
+// its numbers — SURVEY §6).
+#include <chrono>
+#include <cstdio>
+
+#include "btpu/transport/transport.h"
+
+using namespace btpu;
+using namespace btpu::transport;
+using Clock = std::chrono::steady_clock;
+
+static void bench(TransportKind kind) {
+  auto server = make_transport_server(kind);
+  auto client = make_transport_client();
+  if (!server || server->start("127.0.0.1", 0) != ErrorCode::OK) {
+    std::printf("%-6s unavailable\n", transport_kind_name(kind).data());
+    return;
+  }
+  constexpr uint64_t kRegion = 64 << 20;
+  std::vector<uint8_t> memory;
+  void* base = server->alloc_region(kRegion, "bench");
+  if (!base) {
+    memory.resize(kRegion);
+    base = memory.data();
+  }
+  auto reg = server->register_region(base, kRegion, "bench");
+  if (!reg.ok()) {
+    std::printf("%-6s register failed\n", transport_kind_name(kind).data());
+    return;
+  }
+  const auto desc = reg.value();
+  const uint64_t rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+
+  std::vector<uint8_t> buf(1 << 20, 0x5A);
+  constexpr int kIters = 256;
+  auto t0 = Clock::now();
+  for (int i = 0; i < kIters; ++i) {
+    client->write(desc, desc.remote_base + (i % 32) * buf.size(), rkey, buf.data(), buf.size());
+  }
+  const double wr = kIters * double(buf.size()) /
+                    std::chrono::duration<double>(Clock::now() - t0).count() / 1e9;
+  t0 = Clock::now();
+  for (int i = 0; i < kIters; ++i) {
+    client->read(desc, desc.remote_base + (i % 32) * buf.size(), rkey, buf.data(), buf.size());
+  }
+  const double rd = kIters * double(buf.size()) /
+                    std::chrono::duration<double>(Clock::now() - t0).count() / 1e9;
+  std::printf("%-6s write %7.2f GB/s   read %7.2f GB/s   (1 MiB ops)\n",
+              transport_kind_name(kind).data(), wr, rd);
+  server->stop();
+}
+
+int main() {
+  bench(TransportKind::LOCAL);
+  bench(TransportKind::SHM);
+  bench(TransportKind::TCP);
+  return 0;
+}

@@ -1,0 +1,39 @@
+// Minimal end-to-end demo: embedded cluster, put -> get -> verify.
+// (Role of reference examples/simple_client_test.cpp.)
+#include <cstdio>
+#include <cstring>
+
+#include "btpu/client/embedded.h"
+
+using namespace btpu;
+
+int main() {
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(2, 64 << 20));
+  if (cluster.start() != ErrorCode::OK) {
+    std::fprintf(stderr, "cluster start failed\n");
+    return 1;
+  }
+  auto client = cluster.make_client();
+
+  std::vector<uint8_t> data(1 << 20);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 7);
+
+  WorkerConfig config;
+  config.replication_factor = 2;
+  config.max_workers_per_copy = 1;
+  if (client->put("demo/object", data.data(), data.size(), config) != ErrorCode::OK) {
+    std::fprintf(stderr, "put failed\n");
+    return 1;
+  }
+  auto back = client->get("demo/object");
+  if (!back.ok() || std::memcmp(back.value().data(), data.data(), data.size()) != 0) {
+    std::fprintf(stderr, "get/verify failed\n");
+    return 1;
+  }
+  auto stats = client->cluster_stats().value();
+  std::printf("ok: %zu bytes, %llu workers, %llu objects, %llu bytes used\n",
+              back.value().size(), (unsigned long long)stats.total_workers,
+              (unsigned long long)stats.total_objects,
+              (unsigned long long)stats.used_capacity);
+  return 0;
+}

@@ -1,0 +1,48 @@
+// Tiered worker demo: HBM -> DRAM -> NVMe with class preference + spillover.
+// (Role of reference examples/cxl_example.cpp, with tiers that actually run.)
+#include <cstdio>
+#include <filesystem>
+
+#include "btpu/client/embedded.h"
+
+using namespace btpu;
+
+int main() {
+  auto dir = std::filesystem::temp_directory_path() / "btpu_tiered_demo";
+  std::filesystem::create_directories(dir);
+
+  client::EmbeddedClusterOptions options;
+  worker::WorkerServiceConfig w;
+  w.worker_id = "tiered";
+  w.transport = TransportKind::LOCAL;
+  w.heartbeat_interval_ms = 1000;
+  w.heartbeat_ttl_ms = 5000;
+  w.pools = {
+      {"hbm", StorageClass::HBM_TPU, 8 << 20, "", "tpu:0"},
+      {"dram", StorageClass::RAM_CPU, 64 << 20, "", ""},
+      {"nvme", StorageClass::NVME, 256 << 20, (dir / "nvme.dat").string(), ""},
+  };
+  options.workers.push_back(w);
+
+  client::EmbeddedCluster cluster(std::move(options));
+  if (cluster.start() != ErrorCode::OK) return 1;
+  auto client = cluster.make_client();
+
+  WorkerConfig hot;
+  hot.replication_factor = 1;
+  hot.max_workers_per_copy = 1;
+  hot.preferred_classes = {StorageClass::HBM_TPU};
+
+  std::vector<uint8_t> small(1 << 20, 1), large(32 << 20, 2);
+  client->put("hot-object", small.data(), small.size(), hot);
+  client->put("big-object", large.data(), large.size(), hot);  // spills past HBM
+
+  for (const char* key : {"hot-object", "big-object"}) {
+    auto placements = client->get_workers(key).value();
+    std::printf("%-10s -> tier %s (%llu bytes)\n", key,
+                storage_class_name(placements[0].shards[0].storage_class).data(),
+                (unsigned long long)placements[0].shards[0].length);
+  }
+  std::filesystem::remove_all(dir);
+  return 0;
+}

@@ -59,11 +59,14 @@ ErrorCode EmbeddedCluster::start() {
 void EmbeddedCluster::stop() {
   if (!running_) return;
   running_ = false;
+  // Keystone first: its watchers come down before the workers delete their
+  // heartbeat keys, so orderly shutdown doesn't masquerade as worker death
+  // and trigger repair churn.
+  if (keystone_) keystone_->stop();
   for (auto& w : workers_) {
     if (w) w->stop();
   }
   workers_.clear();
-  if (keystone_) keystone_->stop();
   keystone_.reset();
   coordinator_.reset();
 }

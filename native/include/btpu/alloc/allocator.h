@@ -14,6 +14,18 @@
 
 namespace btpu::alloc {
 
+// A contiguous extent within one pool (offset-addressed).
+struct Range {
+  uint64_t offset{0};
+  uint64_t length{0};
+
+  uint64_t end() const noexcept { return offset + length; }
+  bool adjacent_to(const Range& o) const noexcept {
+    return end() == o.offset || o.end() == offset;
+  }
+  bool operator==(const Range&) const = default;
+};
+
 struct AllocatorStats {
   uint64_t total_allocated_bytes{0};
   uint64_t total_free_bytes{0};
@@ -69,6 +81,10 @@ class IAllocator {
   // Drops per-pool state for a pool that left the cluster (worker death).
   // Objects still referencing it are repaired by keystone, not here.
   virtual void forget_pool(const MemoryPoolId& pool_id) = 0;
+  // Restart replay: re-marks persisted ranges as allocated under `key`.
+  virtual ErrorCode adopt_allocation(const ObjectKey& key,
+                                     const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
+                                     const PoolMap& pools) = 0;
 };
 
 class AllocatorFactory {

@@ -37,6 +37,12 @@ class KeystoneAllocatorAdapter {
 
   void forget_pool(const MemoryPoolId& pool_id) { allocator_->forget_pool(pool_id); }
 
+  ErrorCode adopt_allocation(const ObjectKey& key,
+                             const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
+                             const PoolMap& pools) {
+    return allocator_->adopt_allocation(key, ranges, pools);
+  }
+
   static AllocationRequest to_allocation_request(const ObjectKey& key, uint64_t data_size,
                                                  const WorkerConfig& config) {
     AllocationRequest req;

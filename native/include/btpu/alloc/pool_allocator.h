@@ -15,20 +15,10 @@
 #include <mutex>
 #include <optional>
 
+#include "btpu/alloc/allocator.h"
 #include "btpu/common/types.h"
 
 namespace btpu::alloc {
-
-struct Range {
-  uint64_t offset{0};
-  uint64_t length{0};
-
-  uint64_t end() const noexcept { return offset + length; }
-  bool adjacent_to(const Range& o) const noexcept {
-    return end() == o.offset || o.end() == offset;
-  }
-  bool operator==(const Range&) const = default;
-};
 
 class PoolAllocator {
  public:
@@ -39,6 +29,9 @@ class PoolAllocator {
   explicit PoolAllocator(const MemoryPool& pool);
 
   std::optional<Range> allocate(uint64_t size, bool prefer_best_fit = true);
+  // Carves a SPECIFIC range out of the free map (keystone restart replay of
+  // persisted placements). Fails when any byte of it is already allocated.
+  bool allocate_at(const Range& range);
   void free(const Range& range);
 
   uint64_t total_free() const;

@@ -33,6 +33,11 @@ class RangeAllocator : public IAllocator {
 
   Result<AllocationResult> allocate(const AllocationRequest& request,
                                     const PoolMap& pools) override;
+  // Restart replay: re-marks persisted ranges as allocated under `key`
+  // (all-or-nothing; rolls back on any conflict or missing pool).
+  ErrorCode adopt_allocation(const ObjectKey& key,
+                             const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
+                             const PoolMap& pools);
   ErrorCode free(const ObjectKey& object_key) override;
   AllocatorStats get_stats(std::optional<StorageClass> storage_class) const override;
   uint64_t get_free_space(StorageClass storage_class) const override;

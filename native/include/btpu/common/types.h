@@ -284,6 +284,10 @@ struct KeystoneConfig {
   // TPU extensions
   bool enable_repair{true};       // re-replicate objects after worker death
   bool tier_aware_eviction{true}; // evict per-tier, not on global average
+  // Persist object metadata through the coordination service so a keystone
+  // restart recovers the object map (the reference forgets all objects on
+  // restart, SURVEY §5 checkpoint/resume). No-op without a coordinator.
+  bool persist_objects{true};
 
   // Loads a YAML config file (subset grammar, see config.h). Throws
   // std::runtime_error on parse/validation failure like the reference

@@ -90,5 +90,7 @@ std::string pool_key(const std::string& cluster_id, const std::string& worker_id
 std::string heartbeat_prefix(const std::string& cluster_id);
 std::string heartbeat_key(const std::string& cluster_id, const std::string& worker_id);
 std::string services_prefix(const std::string& service_name);
+std::string objects_prefix(const std::string& cluster_id);
+std::string object_record_key(const std::string& cluster_id, const std::string& object_key);
 
 }  // namespace btpu::coord

@@ -139,6 +139,11 @@ class KeystoneService {
 
   ErrorCode setup_coordinator_integration();
   void load_existing_state();
+  void load_persisted_objects();
+  // Durable object metadata (persist_objects): COMPLETE objects are written
+  // to the coordinator and replayed (with allocator range adoption) on boot.
+  void persist_object(const ObjectKey& key, const ObjectInfo& info);
+  void unpersist_object(const ObjectKey& key);
   void on_heartbeat_event(const coord::WatchEvent& ev);
   void on_worker_event(const coord::WatchEvent& ev);
   void on_pool_event(const coord::WatchEvent& ev);

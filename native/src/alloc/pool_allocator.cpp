@@ -80,6 +80,23 @@ std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit
   return Range{offset, size};
 }
 
+bool PoolAllocator::allocate_at(const Range& range) {
+  if (range.length == 0 || range.end() > pool_size_) return false;
+  std::lock_guard<std::mutex> lock(mutex_);
+  // Find the free block starting at or before range.offset.
+  auto it = free_by_offset_.upper_bound(range.offset);
+  if (it == free_by_offset_.begin()) return false;
+  --it;
+  const uint64_t block_off = it->first;
+  const uint64_t block_len = it->second;
+  if (range.offset < block_off || range.end() > block_off + block_len) return false;
+  erase_free(it);
+  if (range.offset > block_off) insert_free(block_off, range.offset - block_off);
+  if (range.end() < block_off + block_len)
+    insert_free(range.end(), block_off + block_len - range.end());
+  return true;
+}
+
 void PoolAllocator::free(const Range& range) {
   if (range.length == 0) return;
   std::lock_guard<std::mutex> lock(mutex_);

@@ -279,6 +279,35 @@ void RangeAllocator::rollback_allocation(
   if (!ranges.empty()) LOG_DEBUG << "rolled back " << ranges.size() << " ranges";
 }
 
+ErrorCode RangeAllocator::adopt_allocation(
+    const ObjectKey& key, const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
+    const PoolMap& pools) {
+  for (const auto& [id, pool] : pools) {
+    BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
+  }
+  std::vector<std::pair<MemoryPoolId, Range>> carved;
+  {
+    std::shared_lock lock(pools_mutex_);
+    for (const auto& [pool_id, range] : ranges) {
+      auto it = pool_allocators_.find(pool_id);
+      if (it == pool_allocators_.end() || !it->second->allocate_at(range)) {
+        for (const auto& [cid, crange] : carved) {
+          auto cit = pool_allocators_.find(cid);
+          if (cit != pool_allocators_.end()) cit->second->free(crange);
+        }
+        return it == pool_allocators_.end() ? ErrorCode::MEMORY_POOL_NOT_FOUND
+                                            : ErrorCode::ALLOCATION_FAILED;
+      }
+      carved.emplace_back(pool_id, range);
+    }
+  }
+  if (auto ec = commit_allocation(key, ranges); ec != ErrorCode::OK) {
+    rollback_allocation(carved);
+    return ec;
+  }
+  return ErrorCode::OK;
+}
+
 ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
   // Lock order: pools before allocations, matching get_stats (verified by
   // TSan: the reverse order forms a cycle with the stats path).

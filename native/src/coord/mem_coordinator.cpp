@@ -25,6 +25,10 @@ std::string heartbeat_key(const std::string& c, const std::string& w) {
   return heartbeat_prefix(c) + w;
 }
 std::string services_prefix(const std::string& s) { return "/btpu/services/" + s + "/"; }
+std::string objects_prefix(const std::string& c) { return "/btpu/clusters/" + c + "/objects/"; }
+std::string object_record_key(const std::string& c, const std::string& key) {
+  return objects_prefix(c) + key;
+}
 
 // ---- MemCoordinator -------------------------------------------------------
 

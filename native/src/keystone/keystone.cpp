@@ -39,6 +39,56 @@ bool decode_pool_record(const std::string& bytes, MemoryPool& out) {
   return wire::decode(r, out) && r.exhausted();
 }
 
+namespace {
+// Durable object record: everything needed to resurrect ObjectInfo +
+// allocator state after a keystone restart.
+struct ObjectRecord {
+  uint64_t size{0};
+  uint64_t ttl_ms{0};
+  bool soft_pin{false};
+  uint8_t state{0};
+  WorkerConfig config;
+  std::vector<CopyPlacement> copies;
+  int64_t created_wall_ms{0};
+  int64_t last_access_wall_ms{0};
+};
+
+std::string encode_object_record(const ObjectRecord& rec) {
+  wire::Writer w;
+  wire::encode_fields(w, rec.size, rec.ttl_ms, rec.soft_pin, rec.state, rec.config,
+                      rec.copies, rec.created_wall_ms, rec.last_access_wall_ms);
+  auto bytes = w.take();
+  return std::string(bytes.begin(), bytes.end());
+}
+
+bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
+                             out.copies, out.created_wall_ms, out.last_access_wall_ms) &&
+         r.exhausted();
+}
+
+// Maps a shard placement back to (pool, offset-range) for allocator adoption.
+std::optional<std::pair<MemoryPoolId, alloc::Range>> shard_to_range(
+    const ShardPlacement& shard, const alloc::PoolMap& pools) {
+  auto it = pools.find(shard.pool_id);
+  if (it == pools.end()) return std::nullopt;
+  if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
+    if (mem->remote_addr < it->second.remote.remote_base) return std::nullopt;
+    return std::make_pair(shard.pool_id,
+                          alloc::Range{mem->remote_addr - it->second.remote.remote_base,
+                                       shard.length});
+  }
+  if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
+    return std::make_pair(shard.pool_id, alloc::Range{dev->offset, shard.length});
+  }
+  if (const auto* file = std::get_if<FileLocation>(&shard.location)) {
+    return std::make_pair(shard.pool_id, alloc::Range{file->file_offset, shard.length});
+  }
+  return std::nullopt;
+}
+}  // namespace
+
 // ---- lifecycle ------------------------------------------------------------
 
 KeystoneService::KeystoneService(KeystoneConfig config,
@@ -119,6 +169,106 @@ void KeystoneService::load_existing_state() {
   }
   LOG_INFO << "replayed " << (workers.ok() ? workers.value().size() : 0) << " workers, "
            << (pools.ok() ? pools.value().size() : 0) << " pools from coordinator";
+  load_persisted_objects();
+}
+
+void KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
+  if (!coordinator_ || !config_.persist_objects) return;
+  const auto steady_now = std::chrono::steady_clock::now();
+  const int64_t wall_now = now_wall_ms();
+  auto to_wall = [&](std::chrono::steady_clock::time_point tp) {
+    return wall_now - std::chrono::duration_cast<std::chrono::milliseconds>(steady_now - tp)
+                          .count();
+  };
+  ObjectRecord rec;
+  rec.size = info.size;
+  rec.ttl_ms = info.ttl_ms;
+  rec.soft_pin = info.soft_pin;
+  rec.state = static_cast<uint8_t>(info.state);
+  rec.config = info.config;
+  rec.copies = info.copies;
+  rec.created_wall_ms = to_wall(info.created_at);
+  rec.last_access_wall_ms = to_wall(info.last_access);
+  coordinator_->put(coord::object_record_key(config_.cluster_id, key),
+                    encode_object_record(rec));
+}
+
+void KeystoneService::unpersist_object(const ObjectKey& key) {
+  if (!coordinator_ || !config_.persist_objects) return;
+  coordinator_->del(coord::object_record_key(config_.cluster_id, key));
+}
+
+// Replays persisted object records: rebuild metadata and re-adopt allocator
+// ranges so new allocations cannot collide with surviving placements.
+void KeystoneService::load_persisted_objects() {
+  if (!config_.persist_objects) return;
+  auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
+  if (!records.ok()) return;
+  const auto prefix = coord::objects_prefix(config_.cluster_id);
+  alloc::PoolMap pools_snapshot;
+  {
+    std::shared_lock lock(registry_mutex_);
+    pools_snapshot = pools_;
+  }
+  const auto steady_now = std::chrono::steady_clock::now();
+  const int64_t wall_now = now_wall_ms();
+  size_t restored = 0, dropped = 0;
+  for (const auto& kv : records.value()) {
+    if (kv.key.size() <= prefix.size()) continue;
+    const ObjectKey key = kv.key.substr(prefix.size());
+    ObjectRecord rec;
+    if (!decode_object_record(kv.value, rec)) {
+      coordinator_->del(kv.key);
+      ++dropped;
+      continue;
+    }
+    // Keep only copies whose every shard still maps onto a live pool.
+    std::vector<CopyPlacement> live_copies;
+    std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
+    for (const auto& copy : rec.copies) {
+      std::vector<std::pair<MemoryPoolId, alloc::Range>> copy_ranges;
+      bool ok = true;
+      for (const auto& shard : copy.shards) {
+        auto mapped = shard_to_range(shard, pools_snapshot);
+        if (!mapped) {
+          ok = false;
+          break;
+        }
+        copy_ranges.push_back(std::move(*mapped));
+      }
+      if (ok) {
+        live_copies.push_back(copy);
+        ranges.insert(ranges.end(), copy_ranges.begin(), copy_ranges.end());
+      }
+    }
+    if (live_copies.empty() ||
+        adapter_.adopt_allocation(key, ranges, pools_snapshot) != ErrorCode::OK) {
+      coordinator_->del(kv.key);
+      ++dropped;
+      continue;
+    }
+    ObjectInfo info;
+    info.size = rec.size;
+    info.ttl_ms = rec.ttl_ms;
+    info.soft_pin = rec.soft_pin;
+    info.state = static_cast<ObjectState>(rec.state);
+    info.config = rec.config;
+    info.copies = std::move(live_copies);
+    auto from_wall = [&](int64_t wall_ms) {
+      return steady_now - std::chrono::milliseconds(std::max<int64_t>(0, wall_now - wall_ms));
+    };
+    info.created_at = from_wall(rec.created_wall_ms);
+    info.last_access = from_wall(rec.last_access_wall_ms);
+    {
+      std::unique_lock lock(objects_mutex_);
+      objects_[key] = std::move(info);
+    }
+    ++restored;
+  }
+  if (restored || dropped) {
+    LOG_INFO << "restored " << restored << " persisted objects (" << dropped << " dropped)";
+    bump_view();
+  }
 }
 
 ErrorCode KeystoneService::start() {
@@ -197,6 +347,7 @@ void KeystoneService::run_gc_once() {
     free_object_locked(key, it->second);
     objects_.erase(it);
     ++counters_.gc_collected;
+    unpersist_object(key);
     bump_view();
     LOG_DEBUG << "gc collected expired object " << key;
   }
@@ -273,6 +424,7 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key) {
   it->second.state = ObjectState::kComplete;
   it->second.last_access = std::chrono::steady_clock::now();
   ++counters_.put_completes;
+  persist_object(key, it->second);
   return ErrorCode::OK;
 }
 
@@ -283,6 +435,7 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.put_cancels;
+  unpersist_object(key);
   bump_view();
   return ErrorCode::OK;
 }
@@ -294,6 +447,7 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.removes;
+  unpersist_object(key);
   bump_view();
   return ErrorCode::OK;
 }
@@ -301,7 +455,10 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
 Result<uint64_t> KeystoneService::remove_all_objects() {
   std::unique_lock lock(objects_mutex_);
   const uint64_t count = objects_.size();
-  for (auto& [key, info] : objects_) free_object_locked(key, info);
+  for (auto& [key, info] : objects_) {
+    free_object_locked(key, info);
+    unpersist_object(key);
+  }
   objects_.clear();
   counters_.removes += count;
   bump_view();
@@ -544,6 +701,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     if (surviving.empty()) {
       LOG_WARN << "object " << it->first << " lost all replicas with worker " << worker_id;
       adapter_.free_object(it->first);
+      unpersist_object(it->first);
       it = objects_.erase(it);
       ++counters_.objects_lost;
       bump_view();
@@ -571,6 +729,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       // Can't reach the survivor right now: keep the surviving placements and
       // drop the damaged ones so clients never dial the dead worker.
       info.copies = std::move(surviving);
+      persist_object(it->first, info);
       ++it;
       bump_view();
       continue;
@@ -590,6 +749,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       if (!placed.ok()) {
         LOG_ERROR << "repair failed for object " << key << ": "
                   << to_string(placed.error());
+        unpersist_object(key);
         it = objects_.erase(it);
         ++counters_.objects_lost;
         bump_view();
@@ -613,12 +773,14 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     if (!write_ok) {
       LOG_ERROR << "repair rewrite failed for object " << key;
       adapter_.free_object(key);
+      unpersist_object(key);
       it = objects_.erase(it);
       ++counters_.objects_lost;
       bump_view();
       continue;
     }
     info.copies = std::move(placed).value();
+    persist_object(key, info);
     ++counters_.objects_repaired;
     ++repaired;
     bump_view();
@@ -696,6 +858,7 @@ void KeystoneService::evict_for_pressure() {
       free_object_locked(key, it->second);
       objects_.erase(it);
       ++counters_.evicted;
+      unpersist_object(key);
       bump_view();
       LOG_INFO << "evicted object " << key << " for tier pressure";
     }

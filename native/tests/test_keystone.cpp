@@ -333,6 +333,103 @@ BTEST(Keystone, DeadWorkerRepairRebuildsReplicas) {
   }
 }
 
+BTEST(Keystone, RestartRecoversPersistedObjects) {
+  // The reference forgets every object when keystone restarts (object map is
+  // RAM-only, SURVEY §5). With persist_objects, a new keystone replays the
+  // object map from the coordinator AND re-adopts allocator ranges so new
+  // allocations cannot collide with surviving placements.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
+  const auto cluster = cfg.cluster_id;
+  auto advertise = [&](FakeWorker& w) {
+    coordinator->put(coord::worker_key(cluster, w.id), encode_worker_info(w.info()));
+    coordinator->put(coord::pool_key(cluster, w.id, w.pool.id), encode_pool_record(w.pool));
+    coordinator->put_with_ttl(coord::heartbeat_key(cluster, w.id), "alive", 60000);
+  };
+
+  std::vector<CopyPlacement> original;
+  std::vector<uint8_t> payload(64 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 3 + 1);
+  {
+    KeystoneService ks(cfg, coordinator);
+    BT_ASSERT(ks.initialize() == ErrorCode::OK);
+    advertise(w1);
+    advertise(w2);
+    BT_EXPECT(eventually([&] { return ks.memory_pools().size() == 2; }));
+
+    WorkerConfig wc;
+    wc.replication_factor = 2;
+    wc.max_workers_per_copy = 1;
+    auto placed = ks.put_start("durable/obj", payload.size(), wc);
+    BT_ASSERT_OK(placed);
+    original = placed.value();
+    auto client = transport::make_transport_client();
+    for (const auto& copy : original) {
+      uint64_t off = 0;
+      for (const auto& shard : copy.shards) {
+        const auto& mem = std::get<MemoryLocation>(shard.location);
+        client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
+                      shard.length);
+        off += shard.length;
+      }
+    }
+    BT_EXPECT(ks.put_complete("durable/obj") == ErrorCode::OK);
+    // PENDING objects are not persisted: only COMPLETE ones survive restart.
+    BT_ASSERT_OK(ks.put_start("pending/obj", 4096, wc));
+    ks.stop();
+  }  // keystone "crashes"
+
+  {
+    KeystoneService ks2(cfg, coordinator);
+    BT_ASSERT(ks2.initialize() == ErrorCode::OK);  // replays registries + objects
+    BT_EXPECT(ks2.object_exists("durable/obj").value());
+    BT_EXPECT(!ks2.object_exists("pending/obj").value());
+
+    auto got = ks2.get_workers("durable/obj");
+    BT_ASSERT_OK(got);
+    BT_EXPECT_EQ(got.value().size(), 2u);
+
+    // Read the bytes back through the recovered placements.
+    auto client = transport::make_transport_client();
+    std::vector<uint8_t> back(payload.size(), 0);
+    uint64_t off = 0;
+    for (const auto& shard : got.value()[0].shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->read(shard.remote, mem.remote_addr, mem.rkey, back.data() + off,
+                             shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+    BT_EXPECT(back == payload);
+
+    // The allocator re-adopted the ranges: a fresh allocation must not
+    // overlap the recovered object's placements.
+    WorkerConfig wc;
+    wc.replication_factor = 2;
+    wc.max_workers_per_copy = 1;
+    auto fresh = ks2.put_start("durable/obj2", 64 * 1024, wc);
+    BT_ASSERT_OK(fresh);
+    for (const auto& copy : fresh.value()) {
+      for (const auto& shard : copy.shards) {
+        const auto& mem = std::get<MemoryLocation>(shard.location);
+        for (const auto& ocopy : original) {
+          for (const auto& oshard : ocopy.shards) {
+            const auto& omem = std::get<MemoryLocation>(oshard.location);
+            if (shard.pool_id == oshard.pool_id) {
+              const bool overlap = mem.remote_addr < omem.remote_addr + omem.size &&
+                                   omem.remote_addr < mem.remote_addr + mem.size;
+              BT_EXPECT(!overlap);
+            }
+          }
+        }
+      }
+    }
+    // Removing the recovered object clears its durable record.
+    BT_EXPECT(ks2.remove_object("durable/obj") == ErrorCode::OK);
+    BT_EXPECT(!coordinator->get(coord::object_record_key(cluster, "durable/obj")).ok());
+  }
+}
+
 BTEST(Keystone, SingleReplicaLostObjectIsDropped) {
   auto cfg = fast_config();
   KeystoneService ks(cfg, nullptr);

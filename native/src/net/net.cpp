@@ -172,6 +172,10 @@ ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn)
 void set_nodelay(int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Deep buffers for the bulk data path (kernel clamps to net.core maxima).
+  int buf = 4 << 20;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
 }
 
 void set_keepalive(int fd) {

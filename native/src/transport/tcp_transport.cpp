@@ -9,6 +9,7 @@
 // rkey validation; the client side keeps a per-endpoint connection pool so a
 // transfer costs zero connection setups in steady state (the reference paid
 // one UCX endpoint creation per transfer, blackbird_client.cpp:162-188).
+#include <atomic>
 #include <cstring>
 #include <mutex>
 #include <random>
@@ -308,25 +309,62 @@ ErrorCode tcp_one_sided(const std::string& endpoint, uint8_t op, uint64_t addr, 
   return ErrorCode::OK;
 }
 
-ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
-                   uint64_t len) {
-  auto ec = tcp_one_sided(endpoint, kOpRead, addr, rkey, dst, len);
+namespace {
+// One connection saturates around a couple GB/s on loopback; wide transfers
+// split into chunks issued over several pooled connections in parallel.
+constexpr uint64_t kParallelCutover = 4ull << 20;  // split ops above this
+constexpr uint64_t kChunkBytes = 2ull << 20;
+constexpr size_t kMaxStreams = 4;
+
+ErrorCode tcp_one_sided_retry(const std::string& endpoint, uint8_t op, uint64_t addr,
+                              uint64_t rkey, void* buf, uint64_t len) {
+  auto ec = tcp_one_sided(endpoint, op, addr, rkey, buf, len);
   if (ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED) {
     // A stale pooled connection (worker restarted): retry once on a fresh one.
     TcpEndpointPool::instance().drop_endpoint(endpoint);
-    ec = tcp_one_sided(endpoint, kOpRead, addr, rkey, dst, len);
+    ec = tcp_one_sided(endpoint, op, addr, rkey, buf, len);
   }
   return ec;
 }
 
+ErrorCode tcp_chunked(const std::string& endpoint, uint8_t op, uint64_t addr, uint64_t rkey,
+                      void* buf, uint64_t len) {
+  if (len < kParallelCutover) return tcp_one_sided_retry(endpoint, op, addr, rkey, buf, len);
+  const uint64_t n_chunks = (len + kChunkBytes - 1) / kChunkBytes;
+  const size_t streams = static_cast<size_t>(std::min<uint64_t>(kMaxStreams, n_chunks));
+  std::atomic<uint64_t> next{0};
+  std::atomic<uint32_t> first_error{static_cast<uint32_t>(ErrorCode::OK)};
+  auto worker = [&] {
+    for (uint64_t i = next.fetch_add(1); i < n_chunks; i = next.fetch_add(1)) {
+      if (first_error.load() != static_cast<uint32_t>(ErrorCode::OK)) return;
+      const uint64_t off = i * kChunkBytes;
+      const uint64_t n = std::min(kChunkBytes, len - off);
+      auto ec = tcp_one_sided_retry(endpoint, op, addr + off, rkey,
+                                    static_cast<uint8_t*>(buf) + off, n);
+      if (ec != ErrorCode::OK) {
+        uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
+        first_error.compare_exchange_strong(expected, static_cast<uint32_t>(ec));
+        return;
+      }
+    }
+  };
+  std::vector<std::thread> helpers;
+  helpers.reserve(streams - 1);
+  for (size_t t = 1; t < streams; ++t) helpers.emplace_back(worker);
+  worker();
+  for (auto& h : helpers) h.join();
+  return static_cast<ErrorCode>(first_error.load());
+}
+}  // namespace
+
+ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
+                   uint64_t len) {
+  return tcp_chunked(endpoint, kOpRead, addr, rkey, dst, len);
+}
+
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
                     uint64_t len) {
-  auto ec = tcp_one_sided(endpoint, kOpWrite, addr, rkey, const_cast<void*>(src), len);
-  if (ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED) {
-    TcpEndpointPool::instance().drop_endpoint(endpoint);
-    ec = tcp_one_sided(endpoint, kOpWrite, addr, rkey, const_cast<void*>(src), len);
-  }
-  return ec;
+  return tcp_chunked(endpoint, kOpWrite, addr, rkey, const_cast<void*>(src), len);
 }
 
 std::unique_ptr<TransportServer> make_tcp_transport_server() {

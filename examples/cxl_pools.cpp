@@ -1,0 +1,73 @@
+// CXL tier demo: three backend configurations exercised end to end
+// (role of reference examples/cxl_example.cpp, which drives three
+// CxlDeviceConfigs through reserve/commit).
+//   1. anonymous-fallback CXL.mem pool (dev machine, no device present)
+//   2. file-backed pmem emulation (persistent across restarts)
+//   3. type-2 device pool with a coarse interleave granularity
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <vector>
+
+#include "btpu/storage/backend.h"
+
+using namespace btpu;
+using namespace btpu::storage;
+
+static int drive(StorageBackend& backend, uint64_t interleave) {
+  if (backend.initialize() != ErrorCode::OK) {
+    std::fprintf(stderr, "  init failed\n");
+    return 1;
+  }
+  auto res = backend.reserve_shard(1000);  // rounds up to cache lines
+  if (!res.ok()) return 1;
+  const auto token = res.value();
+  std::vector<uint8_t> data(token.size);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 31 + 7);
+  if (backend.write_at(token.offset, data.data(), data.size()) != ErrorCode::OK) return 1;
+  if (backend.commit_shard(token) != ErrorCode::OK) return 1;
+
+  std::vector<uint8_t> back(token.size, 0);
+  if (backend.read_at(token.offset, back.data(), back.size()) != ErrorCode::OK) return 1;
+  const bool verified = std::memcmp(data.data(), back.data(), data.size()) == 0;
+
+  auto st = backend.stats();
+  std::printf("  shard: %llu B at offset %llu, interleave region %llu, verify %s\n",
+              (unsigned long long)token.size, (unsigned long long)token.offset,
+              (unsigned long long)cxl_region_id(token.offset, interleave),
+              verified ? "OK" : "FAILED");
+  std::printf("  stats: used=%llu/%llu persistent=%d\n", (unsigned long long)st.used,
+              (unsigned long long)st.capacity, backend.persistent() ? 1 : 0);
+  backend.shutdown();
+  return verified ? 0 : 1;
+}
+
+int main() {
+  int rc = 0;
+  auto dir = std::filesystem::temp_directory_path() / "btpu_cxl_demo";
+
+  std::printf("[1/3] CXL.mem, anonymous fallback (no device)\n");
+  BackendConfig anon;
+  anon.pool_id = "cxl-anon";
+  anon.node_id = "demo";
+  anon.storage_class = StorageClass::CXL_MEMORY;
+  anon.capacity = 16 << 20;
+  if (auto b = create_storage_backend(anon)) rc |= drive(*b, anon.interleave_granularity);
+
+  std::printf("[2/3] CXL.mem, file-backed pmem emulation\n");
+  BackendConfig pmem = anon;
+  pmem.pool_id = "cxl-pmem";
+  pmem.path = (dir / "pmem0.dat").string();
+  if (auto b = create_storage_backend(pmem)) rc |= drive(*b, pmem.interleave_granularity);
+
+  std::printf("[3/3] CXL type-2 device, 4 KiB interleave\n");
+  BackendConfig type2 = anon;
+  type2.pool_id = "cxl-type2";
+  type2.storage_class = StorageClass::CXL_TYPE2_DEVICE;
+  type2.interleave_granularity = 4096;
+  if (auto b = create_storage_backend(type2)) rc |= drive(*b, type2.interleave_granularity);
+
+  std::filesystem::remove_all(dir);
+  std::printf(rc == 0 ? "all CXL configs OK\n" : "FAILED\n");
+  return rc;
+}

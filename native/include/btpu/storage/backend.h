@@ -56,7 +56,15 @@ struct BackendConfig {
   bool use_odirect{false};        // io_uring tier: O_DIRECT for NVME/SSD
   std::string device_id{"tpu:0"}; // HBM tier: provider device
   int64_t reservation_ttl_ms{10 * 60 * 1000};  // reference: 10 min
+  uint64_t interleave_granularity{256};  // CXL tier: bytes per interleave region
+  int numa_node{-1};                     // CXL tier: bind region to this node (-1 = off)
 };
+
+// CXL interleave region an offset falls in (reference computes this per
+// shard, cxl_memory_backend.cpp:171).
+inline uint64_t cxl_region_id(uint64_t offset, uint64_t interleave_granularity) {
+  return interleave_granularity ? offset / interleave_granularity : 0;
+}
 
 class StorageBackend {
  public:
@@ -90,7 +98,8 @@ class StorageBackend {
 };
 
 // Builds a backend for any storage class (no nullptr gaps):
-//   RAM_CPU/CXL_*  -> RamBackend (malloc or caller-provided region)
+//   RAM_CPU        -> RamBackend (malloc or caller-provided region)
+//   CXL_*          -> CxlBackend (DAX/file mmap with anonymous fallback)
 //   HBM_TPU        -> HbmBackend (provider-backed device memory)
 //   NVME/SSD       -> IoUringDiskBackend (O_DIRECT default for NVME)
 //   HDD            -> MmapDiskBackend
@@ -99,6 +108,11 @@ std::unique_ptr<StorageBackend> create_storage_backend(const BackendConfig& conf
 // RAM backend adopting caller-owned memory (e.g. a transport-allocated shm
 // segment) instead of mallocing its own.
 std::unique_ptr<StorageBackend> create_ram_backend_with_region(const BackendConfig& config,
+                                                               void* region);
+
+// CXL backend adopting caller-owned memory: alignment + interleave semantics
+// are preserved even when the bytes live in a transport segment.
+std::unique_ptr<StorageBackend> create_cxl_backend_with_region(const BackendConfig& config,
                                                                void* region);
 
 }  // namespace btpu::storage

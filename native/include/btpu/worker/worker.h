@@ -29,8 +29,10 @@ struct PoolConfig {
   std::string id;
   StorageClass storage_class{StorageClass::RAM_CPU};
   uint64_t capacity{0};
-  std::string path;       // disk tiers
+  std::string path;       // disk tiers; CXL tiers: DAX device / pmem file
   std::string device_id;  // hbm tier ("tpu:0")
+  uint64_t interleave_granularity{256};  // cxl tiers
+  int numa_node{-1};                     // cxl tiers (-1 = unbound)
 };
 
 struct WorkerServiceConfig {

@@ -1,0 +1,218 @@
+// CXL memory tier: mmap'd device/file memory with anonymous fallback.
+//
+// Parity target: reference src/worker/storage/cxl_memory_backend.cpp —
+// DAX device mmap with MAP_POPULATE (:73-121), anonymous-mmap fallback for
+// dev machines (:102-118), cache-line-aligned shard sizes (:157), interleave
+// region ids (:171), NUMA binding (:123-129, a TODO stub there; implemented
+// here via the mbind syscall).
+//
+// Differences from the reference:
+//   * regular files are accepted as backing (pmem emulation): they are grown
+//     to capacity with ftruncate and mapped MAP_SHARED, so bytes persist;
+//   * NUMA binding is real when `numa_node >= 0` (raw mbind(2); non-fatal on
+//     EPERM/ENOSYS so dev machines without the node simply proceed);
+//   * offsets come from the shared PoolAllocator lifecycle instead of a
+//     linear rescan.
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <filesystem>
+
+#include "backend_base.h"
+#include "btpu/common/log.h"
+
+namespace btpu::storage {
+
+namespace {
+constexpr uint64_t kCacheLine = 64;
+
+uint64_t align_up(uint64_t n, uint64_t align) {
+  return (n + align - 1) / align * align;
+}
+}  // namespace
+
+class CxlBackend : public OffsetBackendBase {
+ public:
+  explicit CxlBackend(BackendConfig config) : OffsetBackendBase(std::move(config)) {}
+  ~CxlBackend() override { shutdown(); }
+
+  // Adopt caller-owned memory (e.g. a transport shm segment) — keeps the
+  // CXL alignment/interleave semantics while the bytes live elsewhere.
+  void set_external_region(void* base) { external_base_ = base; }
+
+  ErrorCode initialize() override {
+    if (base_) return ErrorCode::INVALID_STATE;
+    if (config_.capacity == 0) return ErrorCode::INVALID_CONFIGURATION;
+
+    if (external_base_) {
+      base_ = static_cast<uint8_t*>(external_base_);
+      owned_ = false;
+      bind_numa_node();
+      return init_allocator();
+    }
+
+    if (!config_.path.empty()) map_device(config_.path);
+    if (!base_) {
+      // Dev-machine fallback: plain anonymous memory standing in for the
+      // CXL-attached region (same as the reference's fallback path).
+      void* base = ::mmap(nullptr, config_.capacity, PROT_READ | PROT_WRITE,
+                          MAP_PRIVATE | MAP_ANONYMOUS | MAP_POPULATE, -1, 0);
+      if (base == MAP_FAILED) return ErrorCode::OUT_OF_MEMORY;
+      base_ = static_cast<uint8_t*>(base);
+      file_backed_ = false;
+    }
+
+    bind_numa_node();
+    return init_allocator();
+  }
+
+  void shutdown() override {
+    if (base_ && owned_) {
+      if (file_backed_) ::msync(base_, config_.capacity, MS_ASYNC);
+      ::munmap(base_, config_.capacity);
+    }
+    base_ = nullptr;
+  }
+
+  // CXL shard sizes are cache-line aligned so interleaved accesses never
+  // split a line across devices (reference cxl_memory_backend.cpp:157).
+  Result<ReservationToken> reserve_shard(uint64_t size) override {
+    if (size == 0) return ErrorCode::INVALID_PARAMETERS;
+    auto token = OffsetBackendBase::reserve_shard(align_up(size, kCacheLine));
+    if (token.ok()) {
+      LOG_DEBUG << "cxl " << config_.pool_id << ": reserved " << token.value().size
+                << "B in interleave region "
+                << cxl_region_id(token.value().offset, config_.interleave_granularity);
+    }
+    return token;
+  }
+
+  void* base_address() const override { return base_; }
+  bool persistent() const override { return file_backed_; }
+
+  ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
+    if (!base_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    std::memcpy(base_ + offset, src, len);
+    return ErrorCode::OK;
+  }
+
+  ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
+    if (!base_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    std::memcpy(dst, base_ + offset, len);
+    return ErrorCode::OK;
+  }
+
+ private:
+  void map_device(const std::string& path) {
+    int fd = ::open(path.c_str(), O_RDWR | O_CLOEXEC);
+    if (fd < 0 && errno == ENOENT) {
+      // Regular-file pmem emulation: create the backing file on demand — but
+      // never under /dev: a missing DAX device must not become a devtmpfs
+      // regular file that falsely reports persistence and vanishes on reboot.
+      if (path.rfind("/dev/", 0) == 0) {
+        LOG_WARN << "cxl " << config_.pool_id << ": device " << path
+                 << " not present — falling back to anonymous memory";
+        return;
+      }
+      std::error_code fs_ec;
+      std::filesystem::create_directories(std::filesystem::path(path).parent_path(), fs_ec);
+      fd = ::open(path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0644);
+    }
+    if (fd < 0) {
+      LOG_WARN << "cxl " << config_.pool_id << ": open " << path << ": "
+               << std::strerror(errno) << " — falling back to anonymous memory";
+      return;
+    }
+
+    struct stat st {};
+    if (::fstat(fd, &st) == 0 && S_ISREG(st.st_mode) &&
+        st.st_size < static_cast<off_t>(config_.capacity)) {
+      if (::ftruncate(fd, static_cast<off_t>(config_.capacity)) != 0) {
+        LOG_WARN << "cxl " << config_.pool_id << ": ftruncate " << path << ": "
+                 << std::strerror(errno) << " — falling back to anonymous memory";
+        ::close(fd);
+        return;
+      }
+      // Reserve blocks up front: a sparse file turns write_at into SIGBUS
+      // when the filesystem fills mid-write.
+      int falloc_rc = ::posix_fallocate(fd, 0, static_cast<off_t>(config_.capacity));
+      if (falloc_rc == ENOSPC) {
+        LOG_WARN << "cxl " << config_.pool_id << ": not enough disk for " << path
+                 << " — falling back to anonymous memory";
+        ::close(fd);
+        return;
+      }
+      if (falloc_rc != 0) {
+        LOG_WARN << "cxl " << config_.pool_id << ": posix_fallocate " << path << ": "
+                 << std::strerror(falloc_rc) << " (continuing with sparse file)";
+      }
+    }
+
+    void* base = ::mmap(nullptr, config_.capacity, PROT_READ | PROT_WRITE,
+                        MAP_SHARED | MAP_POPULATE, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED) {
+      LOG_WARN << "cxl " << config_.pool_id << ": mmap " << path << ": "
+               << std::strerror(errno) << " — falling back to anonymous memory";
+      return;
+    }
+    base_ = static_cast<uint8_t*>(base);
+    file_backed_ = true;
+    LOG_INFO << "cxl " << config_.pool_id << ": mapped " << path << " ("
+             << config_.capacity << "B, interleave "
+             << config_.interleave_granularity << "B)";
+  }
+
+  void bind_numa_node() {
+    if (config_.numa_node < 0 || !base_) return;
+    if (config_.numa_node >= static_cast<int>(sizeof(unsigned long) * 8)) {
+      LOG_WARN << "cxl " << config_.pool_id << ": numa_node " << config_.numa_node
+               << " out of range (max " << sizeof(unsigned long) * 8 - 1
+               << ") — skipping NUMA binding";
+      return;
+    }
+#ifdef SYS_mbind
+    // numaif.h is not a baked-in dep, so the constants are spelled out.
+    constexpr int kMpolBind = 2;
+    constexpr unsigned kMpolMfMove = 2;  // migrate already-faulted pages too
+    unsigned long nodemask = 1UL << config_.numa_node;
+    long rc = ::syscall(SYS_mbind, base_, config_.capacity, kMpolBind, &nodemask,
+                        sizeof(nodemask) * 8, kMpolMfMove);
+    if (rc != 0) {
+      LOG_WARN << "cxl " << config_.pool_id << ": mbind to node " << config_.numa_node
+               << " failed: " << std::strerror(errno) << " (continuing unbound)";
+    } else {
+      LOG_INFO << "cxl " << config_.pool_id << ": bound to NUMA node " << config_.numa_node;
+    }
+#else
+    LOG_WARN << "cxl " << config_.pool_id << ": mbind unavailable on this platform";
+#endif
+  }
+
+  uint8_t* base_{nullptr};
+  void* external_base_{nullptr};
+  bool owned_{true};
+  bool file_backed_{false};
+};
+
+std::unique_ptr<StorageBackend> make_cxl_backend(const BackendConfig& config) {
+  return std::make_unique<CxlBackend>(config);
+}
+
+std::unique_ptr<StorageBackend> create_cxl_backend_with_region(const BackendConfig& config,
+                                                               void* region) {
+  auto backend = std::make_unique<CxlBackend>(config);
+  backend->set_external_region(region);
+  return backend;
+}
+
+}  // namespace btpu::storage

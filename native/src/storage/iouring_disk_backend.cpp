@@ -281,6 +281,7 @@ std::unique_ptr<StorageBackend> make_iouring_disk_backend(const BackendConfig& c
 // ---- factory (all storage classes wired; reference gap fixed) -------------
 
 std::unique_ptr<StorageBackend> make_ram_backend(const BackendConfig& config);
+std::unique_ptr<StorageBackend> make_cxl_backend(const BackendConfig& config);
 std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config);
 std::unique_ptr<StorageBackend> make_mmap_disk_backend(const BackendConfig& config);
 
@@ -288,9 +289,10 @@ std::unique_ptr<StorageBackend> create_storage_backend(const BackendConfig& conf
   BackendConfig cfg = config;
   switch (config.storage_class) {
     case StorageClass::RAM_CPU:
+      return make_ram_backend(cfg);
     case StorageClass::CXL_MEMORY:
     case StorageClass::CXL_TYPE2_DEVICE:
-      return make_ram_backend(cfg);
+      return make_cxl_backend(cfg);
     case StorageClass::HBM_TPU:
       return make_hbm_backend(cfg);
     case StorageClass::NVME:

@@ -26,7 +26,8 @@ ErrorCode WorkerServiceConfig::validate() const {
 // Schema (configs/worker.yaml):
 //   worker_id / cluster_id / coord_endpoints / transport / listen_host /
 //   listen_port / slice_id / host_id / heartbeat: {interval_ms, ttl_ms} /
-//   pools: [- id, storage_class, capacity ("8GB"), path, device_id]
+//   pools: [- id, storage_class, capacity ("8GB"), path, device_id,
+//             interleave_granularity, numa_node]
 WorkerServiceConfig WorkerServiceConfig::from_yaml(const std::string& file_path) {
   auto parsed = yaml::parse_file(file_path);
   if (!parsed.ok()) {
@@ -69,6 +70,9 @@ WorkerServiceConfig WorkerServiceConfig::from_yaml(const std::string& file_path)
       }
       if (auto n = item->get("path")) pool.path = n->str_or("");
       if (auto n = item->get("device_id")) pool.device_id = n->str_or("");
+      if (auto n = item->get("interleave_granularity"))
+        pool.interleave_granularity = static_cast<uint64_t>(n->int_or(256));
+      if (auto n = item->get("numa_node")) pool.numa_node = static_cast<int>(n->int_or(-1));
       cfg.pools.push_back(std::move(pool));
     }
   }
@@ -103,30 +107,56 @@ ErrorCode WorkerService::initialize() {
     backend_cfg.capacity = pool_cfg.capacity;
     backend_cfg.path = pool_cfg.path;
     if (!pool_cfg.device_id.empty()) backend_cfg.device_id = pool_cfg.device_id;
+    backend_cfg.interleave_granularity = pool_cfg.interleave_granularity;
+    backend_cfg.numa_node = pool_cfg.numa_node;
 
     PoolRuntime runtime;
     runtime.config = pool_cfg;
 
-    const bool memory_tier = pool_cfg.storage_class == StorageClass::RAM_CPU ||
-                             pool_cfg.storage_class == StorageClass::CXL_MEMORY ||
-                             pool_cfg.storage_class == StorageClass::CXL_TYPE2_DEVICE;
+    const bool is_cxl = pool_cfg.storage_class == StorageClass::CXL_MEMORY ||
+                        pool_cfg.storage_class == StorageClass::CXL_TYPE2_DEVICE;
+    // A CXL pool that names a device/file or a NUMA node has placement
+    // requirements transport-owned memory can't honor — keep the CxlBackend.
+    const bool cxl_pinned = is_cxl && (!pool_cfg.path.empty() || pool_cfg.numa_node >= 0);
+    const bool memory_tier =
+        pool_cfg.storage_class == StorageClass::RAM_CPU || (is_cxl && !cxl_pinned);
     // Memory tiers may live inside transport-owned memory (shm segments).
     void* transport_memory =
         memory_tier ? primary_transport_->alloc_region(pool_cfg.capacity, pool_cfg.id) : nullptr;
-    runtime.backend = transport_memory
-                          ? storage::create_ram_backend_with_region(backend_cfg, transport_memory)
-                          : storage::create_storage_backend(backend_cfg);
+    runtime.backend =
+        transport_memory
+            ? (is_cxl ? storage::create_cxl_backend_with_region(backend_cfg, transport_memory)
+                      : storage::create_ram_backend_with_region(backend_cfg, transport_memory))
+            : storage::create_storage_backend(backend_cfg);
     if (!runtime.backend) {
       LOG_ERROR << "no backend for pool " << pool_cfg.id;
       return ErrorCode::INVALID_CONFIGURATION;
     }
     BTPU_RETURN_IF_ERROR(runtime.backend->initialize());
 
-    // Register the pool with the data plane.
+    // Register the pool with the data plane. The shm transport can only
+    // serve memory it allocated itself, so a pinned CXL mapping under shm
+    // goes straight to the callback path instead of a doomed attempt.
     Result<RemoteDescriptor> registered = ErrorCode::INTERNAL_ERROR;
-    if (void* base = runtime.backend->base_address()) {
+    void* base = runtime.backend->base_address();
+    const bool shm_cannot_serve =
+        cxl_pinned && !transport_memory && primary_transport_->kind() == TransportKind::SHM;
+    if (base && !shm_cannot_serve) {
       registered = primary_transport_->register_region(base, pool_cfg.capacity, pool_cfg.id);
-    } else {
+      if (!registered.ok()) {
+        // A mapped tier the transport claims to support failed to register:
+        // that is a real error, not a reason to silently lose zero-copy.
+        LOG_ERROR << "transport registration failed for mapped pool " << pool_cfg.id;
+        return registered.error();
+      }
+    }
+    if (!registered.ok()) {
+      if (base) {
+        LOG_WARN << "pool " << pool_cfg.id << ": shm transport cannot serve pinned CXL "
+                 << "mapping — degrading to callback-backed region";
+      }
+      // Tier with no host mapping, or mapped memory the primary transport
+      // can't serve: callback-backed region, TCP virtual transport fallback.
       // Non-mapped tier: callback-backed region. Falls back to a TCP virtual
       // transport when the primary (e.g. shm) cannot host callbacks.
       auto* backend = runtime.backend.get();

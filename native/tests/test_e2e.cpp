@@ -322,3 +322,38 @@ pools:
   BT_EXPECT(threw);
   std::filesystem::remove(bad);
 }
+
+BTEST(EndToEnd, PinnedCxlPoolUnderShmTransport) {
+  // A CXL pool with a backing path keeps its CxlBackend (persistence, NUMA)
+  // even when the primary transport is shm; registration falls back to a
+  // callback-backed virtual region instead of failing worker init.
+  auto dir = std::filesystem::temp_directory_path() /
+             ("btpu_e2e_cxl_" + std::to_string(::getpid()));
+  std::filesystem::create_directories(dir);
+
+  EmbeddedClusterOptions options;
+  worker::WorkerServiceConfig w;
+  w.worker_id = "cxl-worker";
+  w.transport = TransportKind::SHM;
+  w.heartbeat_interval_ms = 100;
+  w.heartbeat_ttl_ms = 500;
+  w.pools = {
+      {"cxl-pool", StorageClass::CXL_MEMORY, 4 << 20, (dir / "pmem.dat").string(), ""},
+  };
+  options.workers.push_back(w);
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  cfg.preferred_classes = {StorageClass::CXL_MEMORY};
+  auto data = pattern(256 * 1024, 21);
+  BT_ASSERT(client->put("e2e/cxl", data.data(), data.size(), cfg) == ErrorCode::OK);
+  auto back = client->get("e2e/cxl");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  std::filesystem::remove_all(dir);
+}

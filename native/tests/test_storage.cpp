@@ -239,3 +239,93 @@ BTEST(Storage, RamBackendWithExternalRegion) {
   BT_EXPECT_EQ(int(region[100]), 0x5a);  // wrote through to caller memory
   backend->shutdown();
 }
+
+BTEST(Storage, CxlAnonymousFallbackSuite) {
+  // No device path: the CXL tier runs on anonymous memory (dev machines),
+  // mirroring the reference fallback (cxl_memory_backend.cpp:102-118).
+  auto backend = create_storage_backend(make_config(StorageClass::CXL_MEMORY));
+  BT_ASSERT(backend != nullptr);
+  run_backend_suite(*backend);
+}
+
+BTEST(Storage, CxlType2Suite) {
+  auto backend = create_storage_backend(make_config(StorageClass::CXL_TYPE2_DEVICE));
+  BT_ASSERT(backend != nullptr);
+  run_backend_suite(*backend);
+}
+
+BTEST(Storage, CxlShardSizesAreCacheLineAligned) {
+  auto backend = create_storage_backend(make_config(StorageClass::CXL_MEMORY, 64 * 1024));
+  BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+  auto res = backend->reserve_shard(100);
+  BT_ASSERT_OK(res);
+  BT_EXPECT_EQ(res.value().size, 128ull);  // 100 rounded up to 64B lines
+  BT_EXPECT(backend->commit_shard(res.value()) == ErrorCode::OK);
+  BT_EXPECT_EQ(backend->stats().used, 128ull);
+  BT_EXPECT(backend->free_shard(res.value().offset, 128) == ErrorCode::OK);
+  backend->shutdown();
+}
+
+BTEST(Storage, CxlFileBackedPersistsAcrossReopen) {
+  // Regular-file pmem emulation: bytes survive a backend restart.
+  auto dir = temp_dir();
+  auto cfg = make_config(StorageClass::CXL_MEMORY, 1 << 20, dir);
+  std::vector<uint8_t> data(4096);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 7 + 3);
+  {
+    auto backend = create_storage_backend(cfg);
+    BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+    BT_EXPECT(backend->persistent());
+    BT_EXPECT(backend->base_address() != nullptr);
+    BT_EXPECT(backend->write_at(8192, data.data(), data.size()) == ErrorCode::OK);
+    backend->shutdown();
+  }
+  {
+    auto backend = create_storage_backend(cfg);
+    BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+    std::vector<uint8_t> back(4096, 0);
+    BT_EXPECT(backend->read_at(8192, back.data(), back.size()) == ErrorCode::OK);
+    BT_EXPECT(std::memcmp(data.data(), back.data(), data.size()) == 0);
+    backend->shutdown();
+  }
+  std::filesystem::remove_all(dir);
+}
+
+BTEST(Storage, CxlUnmappablePathFallsBackToAnonymous) {
+  // An unusable device path degrades to anonymous memory with a warning
+  // instead of failing init (reference behavior).
+  auto cfg = make_config(StorageClass::CXL_MEMORY, 64 * 1024);
+  cfg.path = "/proc/definitely/not/a/dax/device";
+  auto backend = create_storage_backend(cfg);
+  BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+  BT_EXPECT(!backend->persistent());  // fallback is volatile
+  uint8_t v = 0x7f;
+  BT_EXPECT(backend->write_at(0, &v, 1) == ErrorCode::OK);
+  backend->shutdown();
+}
+
+BTEST(Storage, CxlInterleaveRegionIds) {
+  BT_EXPECT_EQ(cxl_region_id(0, 256), 0ull);
+  BT_EXPECT_EQ(cxl_region_id(255, 256), 0ull);
+  BT_EXPECT_EQ(cxl_region_id(256, 256), 1ull);
+  BT_EXPECT_EQ(cxl_region_id(4096, 256), 16ull);
+  BT_EXPECT_EQ(cxl_region_id(4096, 4096), 1ull);
+  BT_EXPECT_EQ(cxl_region_id(123, 0), 0ull);  // degenerate granularity
+}
+
+BTEST(Storage, CxlExternalRegionKeepsAlignment) {
+  // Transport-owned memory adopted by the CXL tier still honors the
+  // cache-line alignment invariant.
+  std::vector<uint8_t> region(64 * 1024);
+  auto cfg = make_config(StorageClass::CXL_MEMORY, region.size());
+  auto backend = create_cxl_backend_with_region(cfg, region.data());
+  BT_ASSERT(backend && backend->initialize() == ErrorCode::OK);
+  BT_EXPECT(backend->base_address() == region.data());
+  auto res = backend->reserve_shard(100);
+  BT_ASSERT_OK(res);
+  BT_EXPECT_EQ(res.value().size, 128ull);
+  uint8_t v = 0x3c;
+  BT_EXPECT(backend->write_at(64, &v, 1) == ErrorCode::OK);
+  BT_EXPECT_EQ(int(region[64]), 0x3c);  // wrote through to caller memory
+  backend->shutdown();
+}

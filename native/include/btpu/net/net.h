@@ -61,6 +61,9 @@ ErrorCode write_all(int fd, const void* buf, size_t n);
 ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn);
 
 void set_nodelay(int fd);
+// Fixed-size socket buffers for bulk transfers; disables kernel autotuning,
+// so apply to data-plane sockets only.
+void set_bulk_buffers(int fd, int bytes = 4 << 20);
 void set_keepalive(int fd);
 
 // Frame layout: [u32 payload_len][u8 opcode][payload]. Max 1 GiB payload.

@@ -17,6 +17,7 @@
 #include <unordered_map>
 
 #include "btpu/common/log.h"
+#include "btpu/common/thread_pool.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/transport.h"
 
@@ -131,6 +132,7 @@ class TcpTransportServer : public TransportServer {
     while (running_) {
       auto sock = net::tcp_accept(listener_, 200);
       if (!sock.ok()) continue;
+      net::set_bulk_buffers(sock.value().fd());
       auto conn = std::make_shared<net::Socket>(std::move(sock).value());
       std::lock_guard<std::mutex> lock(conns_mutex_);
       conns_.push_back(conn);
@@ -260,7 +262,9 @@ class TcpEndpointPool {
     }
     auto hp = net::parse_host_port(endpoint);
     if (!hp) return ErrorCode::INVALID_ADDRESS;
-    return net::tcp_connect(hp->host, hp->port);
+    auto sock = net::tcp_connect(hp->host, hp->port);
+    if (sock.ok()) net::set_bulk_buffers(sock.value().fd());
+    return sock;
   }
 
   void release(const std::string& endpoint, net::Socket sock) {
@@ -348,11 +352,13 @@ ErrorCode tcp_chunked(const std::string& endpoint, uint8_t op, uint64_t addr, ui
       }
     }
   };
-  std::vector<std::thread> helpers;
-  helpers.reserve(streams - 1);
-  for (size_t t = 1; t < streams; ++t) helpers.emplace_back(worker);
-  worker();
-  for (auto& h : helpers) h.join();
+  // Shared persistent helpers: spawning threads per transfer costs ~100us
+  // of setup on the hot path and can throw under resource exhaustion. Sized
+  // for several concurrent wide transfers (client shard fan-out is 8-wide);
+  // each caller also works, so exhaustion degrades to fewer streams, never
+  // to a stall.
+  static ThreadPool stream_pool(4 * (kMaxStreams - 1));
+  stream_pool.run_batch(streams, [&](size_t) { worker(); });
   return static_cast<ErrorCode>(first_error.load());
 }
 }  // namespace

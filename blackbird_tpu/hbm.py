@@ -107,6 +107,15 @@ class JaxHbmProvider:
                 if length
                 else np.empty(0, np.uint8)
             )
+            if not is_write and length:
+                # Prefetch every chunk the read spans before the copy loop:
+                # device->host transfers overlap instead of serializing, which
+                # matters most when the host<->device link is latency-bound.
+                first = offset // cb
+                last = (offset + length - 1) // cb
+                for chunk in region["chunks"][first : last + 1]:
+                    if hasattr(chunk, "copy_to_host_async"):
+                        chunk.copy_to_host_async()
             pos = 0
             while pos < length:
                 chunk_idx = (offset + pos) // cb
@@ -161,3 +170,15 @@ class JaxHbmProvider:
     def region_count(self) -> int:
         with self._lock:
             return len(self._regions)
+
+    def synchronize(self) -> None:
+        """Blocks until all in-flight device transfers have completed.
+
+        jax.device_put is asynchronous, so a write that has returned may
+        still be copying host->device; call this before timing-sensitive
+        checkpoints (benchmarks, barrier points)."""
+        with self._lock:
+            chunks = [c for r in self._regions.values() for c in r["chunks"]]
+        for chunk in chunks:
+            if hasattr(chunk, "block_until_ready"):
+                chunk.block_until_ready()

@@ -50,7 +50,11 @@ std::optional<HostPort> parse_host_port(const std::string& endpoint);
 // Listening socket bound to host:port (port 0 = ephemeral). Returns the socket
 // and the actually bound port.
 Result<Socket> tcp_listen(const std::string& host, uint16_t port, uint16_t* bound_port);
-Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_ms = 5000);
+// bulk_buffers: apply data-plane socket buffer sizing BEFORE connect() so the
+// receive window scale is negotiated with the deep buffer (tcp(7): setting
+// SO_RCVBUF after the handshake is too late).
+Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_ms = 5000,
+                           bool bulk_buffers = false);
 // Accept with optional timeout; CONNECTION_FAILED on error, OPERATION_TIMEOUT
 // when the poll expires.
 Result<Socket> tcp_accept(const Socket& listener, int timeout_ms = -1);
@@ -62,7 +66,9 @@ ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn)
 
 void set_nodelay(int fd);
 // Fixed-size socket buffers for bulk transfers; disables kernel autotuning,
-// so apply to data-plane sockets only.
+// so apply to data-plane sockets only — and before connect()/listen() so the
+// window scaling reflects them. BTPU_SOCK_BUFS=auto skips the pinning
+// entirely (WAN autotuning); =N pins both directions to N bytes.
 void set_bulk_buffers(int fd, int bytes = 4 << 20);
 void set_keepalive(int fd);
 

@@ -82,7 +82,8 @@ Result<Socket> tcp_listen(const std::string& host, uint16_t port, uint16_t* boun
   return s;
 }
 
-Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_ms) {
+Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_ms,
+                           bool bulk_buffers) {
   addrinfo hints{};
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
@@ -96,6 +97,7 @@ Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_m
     ::freeaddrinfo(res);
     return ErrorCode::NETWORK_ERROR;
   }
+  if (bulk_buffers) set_bulk_buffers(s.fd());  // pre-connect: affects window scaling
   int rc = ::connect(s.fd(), res->ai_addr, res->ai_addrlen);
   ::freeaddrinfo(res);
   if (rc != 0) {
@@ -177,20 +179,20 @@ void set_nodelay(int fd) {
 
 void set_bulk_buffers(int fd, int bytes) {
   // Deep buffers for bulk data-path sockets only; control-plane sockets keep
-  // kernel autotuning (an explicit SO_RCVBUF disables it and pins kernel
+  // kernel autotuning (explicit buffer sizes disable it and pin kernel
   // memory per socket, which a coordinator with many workers multiplies).
-  // Explicit RCVBUF caps the window below what autotune reaches on
-  // high-BDP links (net.ipv4.tcp_rmem max > our pin), but measures ~1.7x
-  // faster for 1 MiB gets on same-host paths, which is where the shm/tcp
-  // data plane actually runs; BTPU_SOCK_RCVBUF=auto opts WAN-ish
-  // deployments back into autotuning, or =N pins a custom size.
-  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
-  static const char* rcv_mode = std::getenv("BTPU_SOCK_RCVBUF");
-  if (rcv_mode && std::strcmp(rcv_mode, "auto") == 0) return;
-  if (rcv_mode) {
-    int custom = std::atoi(rcv_mode);
+  // Pinned buffers cap the window below what autotune reaches on high-BDP
+  // links (net.ipv4.tcp_{r,w}mem max > our pin), but measure ~1.7x faster
+  // for 1 MiB gets on same-host paths, which is where the shm/tcp data
+  // plane actually runs. BTPU_SOCK_BUFS=auto leaves both directions to
+  // autotuning for WAN-ish deployments; =N pins both to N bytes.
+  static const char* mode = std::getenv("BTPU_SOCK_BUFS");
+  if (mode && std::strcmp(mode, "auto") == 0) return;
+  if (mode) {
+    int custom = std::atoi(mode);
     if (custom > 0) bytes = custom;
   }
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
 }
 

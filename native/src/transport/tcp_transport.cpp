@@ -57,6 +57,10 @@ class TcpTransportServer : public TransportServer {
     auto listener = net::tcp_listen(host, port, &bound);
     if (!listener.ok()) return listener.error();
     listener_ = std::move(listener).value();
+    // Accepted data-plane sockets inherit the listener's buffer sizes, and
+    // the receive window scale is negotiated at accept time — so size the
+    // listener, not the accepted fds (tcp(7)).
+    net::set_bulk_buffers(listener_.fd());
     host_ = (host.empty() || host == "0.0.0.0") ? "127.0.0.1" : host;
     port_ = bound;
     running_ = true;
@@ -132,7 +136,6 @@ class TcpTransportServer : public TransportServer {
     while (running_) {
       auto sock = net::tcp_accept(listener_, 200);
       if (!sock.ok()) continue;
-      net::set_bulk_buffers(sock.value().fd());
       auto conn = std::make_shared<net::Socket>(std::move(sock).value());
       std::lock_guard<std::mutex> lock(conns_mutex_);
       conns_.push_back(conn);
@@ -262,9 +265,7 @@ class TcpEndpointPool {
     }
     auto hp = net::parse_host_port(endpoint);
     if (!hp) return ErrorCode::INVALID_ADDRESS;
-    auto sock = net::tcp_connect(hp->host, hp->port);
-    if (sock.ok()) net::set_bulk_buffers(sock.value().fd());
-    return sock;
+    return net::tcp_connect(hp->host, hp->port, 5000, /*bulk_buffers=*/true);
   }
 
   void release(const std::string& endpoint, net::Socket sock) {

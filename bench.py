@@ -69,6 +69,7 @@ def bench_hbm_tier() -> None:
                 iters = 8
                 for i in range(iters):  # batched puts
                     client.put(f"bench/hbm{i}", payload, max_workers=1)
+                provider.synchronize()  # don't bill in-flight H2D to the get loop
                 t0 = time.perf_counter()
                 for i in range(iters):
                     client.get(f"bench/hbm{i}")

@@ -7,9 +7,11 @@ transfers. Registering the provider flips every HBM_TPU pool in this process
 from the built-in host-memory emulation to real device memory.
 
 Granularity: writes/reads are chunk-based (default 1 MiB). Whole-chunk
-writes cost one device_put; partial-chunk writes read-modify-write through
-the host, so align shard sizes to the chunk size for peak throughput (the
-native allocator's min_shard_size does this for you when set to >= chunk).
+writes cost one device_put; partial-chunk writes stage the payload on device
+and apply `lax.dynamic_update_slice` there (no device->host readback), and
+partial-chunk reads slice on device first so only the requested bytes cross
+the host<->device link. Aligning shard sizes to the chunk size still gives
+peak throughput by hitting the whole-chunk paths.
 """
 
 from __future__ import annotations
@@ -45,15 +47,71 @@ class _ProviderStruct(ctypes.Structure):
 class JaxHbmProvider:
     """Chunked device-buffer regions managed through JAX."""
 
-    def __init__(self, chunk_bytes: int = 1 << 20):
+    def __init__(self, chunk_bytes: int = 1 << 20, assemble_limit_bytes: int = 64 << 20):
         import jax
 
         self._jax = jax
         self.chunk_bytes = chunk_bytes
+        # Reads up to this size are gathered into one device buffer for a
+        # single D2H transfer; larger reads stream per chunk (no extra
+        # device memory).
+        self.assemble_limit_bytes = assemble_limit_bytes
         self._lock = threading.Lock()
         self._regions: dict[int, dict] = {}
         self._next_id = 1
         self._struct = None  # built in register()
+        # jit caches: bucketed by power-of-two length so each holds at most
+        # log2(chunk_bytes) executables; offsets/leads stay traced scalars so
+        # varying positions reuse one executable.
+        self._slice_fns: dict[int, object] = {}
+        self._merge_fns: dict[int, object] = {}
+
+    def _device_slice(self, chunk, off: int, n: int):
+        """Device-side byte-range slice, compile-bounded.
+
+        Slice lengths are rounded up to the next power of two (capped at the
+        chunk size) so the jit cache holds at most log2(chunk_bytes) entries
+        instead of one per distinct request length; the caller trims the
+        bucket back down on the host. When the bucket would run past the
+        chunk end the start is pulled back and the host trim skips the lead.
+        Returns (device_array, lead) — the requested bytes are
+        device_array[lead : lead + n].
+        """
+        cb = self.chunk_bytes
+        bucket = 1 << max(0, (n - 1).bit_length())
+        bucket = min(bucket, cb)
+        start = min(off, cb - bucket)
+        lead = off - start
+        fn = self._slice_fns.get(bucket)
+        if fn is None:
+            lax = self._jax.lax
+            fn = self._jax.jit(
+                lambda c, o, _n=bucket: lax.dynamic_slice(c, (o,), (_n,))
+            )
+            self._slice_fns[bucket] = fn
+        return fn(chunk, np.uint32(start)), lead
+
+    def _device_merge(self, chunk, part_b, start: int, lead: int, n: int):
+        """Writes part_b[lead:lead+n] into chunk at start+lead, on device.
+
+        part_b is a host buffer padded to a power-of-two bucket; the merge
+        masks in only the live [lead, lead+n) bytes against the current
+        chunk contents, so — like _device_slice — the jit cache is bounded
+        at one executable per bucket size, not per distinct write length.
+        """
+        jnp, lax = self._jax.numpy, self._jax.lax
+        b = len(part_b)
+        fn = self._merge_fns.get(b)
+        if fn is None:
+            def merge(c, p, s, l, m, _b=b):
+                cur = lax.dynamic_slice(c, (s,), (_b,))
+                idx = lax.iota(jnp.uint32, _b)
+                merged = jnp.where((idx >= l) & (idx < l + m), p, cur)
+                return lax.dynamic_update_slice(c, merged, (s,))
+
+            fn = self._jax.jit(merge)
+            self._merge_fns[b] = fn
+        return fn(chunk, part_b, np.uint32(start), np.uint32(lead), np.uint32(n))
 
     # -- device helpers ----------------------------------------------------
 
@@ -75,7 +133,11 @@ class JaxHbmProvider:
             device = self._device_for(device_id.decode() if device_id else "tpu:0")
             n_chunks = (size + self.chunk_bytes - 1) // self.chunk_bytes
             zero = np.zeros(self.chunk_bytes, dtype=np.uint8)
-            chunks = [self._jax.device_put(zero, device) for _ in range(n_chunks)]
+            # One H2D transfer; chunks alias the same device buffer. Safe
+            # because writes never mutate in place — they replace list slots
+            # with freshly-built arrays (copy-on-write).
+            shared_zero = self._jax.device_put(zero, device)
+            chunks = [shared_zero] * n_chunks
             with self._lock:
                 region_id = self._next_id
                 self._next_id += 1
@@ -108,30 +170,74 @@ class JaxHbmProvider:
                 else np.empty(0, np.uint8)
             )
             if not is_write and length:
-                # Prefetch every chunk the read spans before the copy loop:
-                # device->host transfers overlap instead of serializing, which
-                # matters most when the host<->device link is latency-bound.
-                first = offset // cb
-                last = (offset + length - 1) // cb
-                for chunk in region["chunks"][first : last + 1]:
-                    if hasattr(chunk, "copy_to_host_async"):
-                        chunk.copy_to_host_async()
+                # Assemble the requested byte range ON DEVICE (slice partial
+                # chunks, concatenate spans), then do exactly ONE
+                # device->host transfer. One transfer per read beats
+                # per-chunk pulls when the link is latency-bound, and
+                # copy_to_host_async is deliberately avoided: on some
+                # platforms (observed on tunneled dev TPUs) it does not share
+                # its transfer with the later np.asarray, tripling the cost.
+                spans = []  # (dst pos, n, device part, lead bytes to skip)
+                pos = 0
+                while pos < length:
+                    chunk_idx = (offset + pos) // cb
+                    chunk_off = (offset + pos) % cb
+                    n = min(length - pos, cb - chunk_off)
+                    chunk = region["chunks"][chunk_idx]
+                    if n == cb:
+                        spans.append((pos, n, chunk, 0))
+                    else:
+                        part, lead = self._device_slice(chunk, chunk_off, n)
+                        spans.append((pos, n, part, lead))
+                    pos += n
+                # Assemble in batches of at most assemble_limit_bytes: one
+                # D2H per batch, and the device never needs more than the
+                # batch size of extra memory (an almost-full HBM can't spare
+                # `length` bytes for one giant concatenation).
+                def flush(batch):
+                    if len(batch) == 1:
+                        pos, n, part, lead = batch[0]
+                        src[pos : pos + n] = np.asarray(part)[lead : lead + n]
+                        return
+                    joined = np.asarray(jax.numpy.concatenate([b[2] for b in batch]))
+                    acc = 0
+                    for pos, n, part, lead in batch:
+                        src[pos : pos + n] = joined[acc + lead : acc + lead + n]
+                        acc += part.shape[0]
+
+                batch, batch_width = [], 0
+                for span in spans:
+                    width = span[2].shape[0]
+                    if batch and batch_width + width > self.assemble_limit_bytes:
+                        flush(batch)
+                        batch, batch_width = [], 0
+                    batch.append(span)
+                    batch_width += width
+                if batch:
+                    flush(batch)
+                return 0
             pos = 0
             while pos < length:
                 chunk_idx = (offset + pos) // cb
                 chunk_off = (offset + pos) % cb
                 n = min(length - pos, cb - chunk_off)
-                if is_write:
-                    if chunk_off == 0 and n == cb:
-                        new_chunk = np.array(src[pos : pos + n], copy=True)
-                    else:
-                        host = np.asarray(region["chunks"][chunk_idx])
-                        new_chunk = host.copy()
-                        new_chunk[chunk_off : chunk_off + n] = src[pos : pos + n]
-                    region["chunks"][chunk_idx] = jax.device_put(new_chunk, region["device"])
+                if chunk_off == 0 and n == cb:
+                    new_chunk = jax.device_put(
+                        np.array(src[pos : pos + n], copy=True), region["device"]
+                    )
                 else:
-                    host = np.asarray(region["chunks"][chunk_idx])
-                    src[pos : pos + n] = host[chunk_off : chunk_off + n]
+                    # Stage only the payload on device (padded to a pow2
+                    # bucket), merge there — no device->host readback of the
+                    # surrounding chunk, bounded jit cache.
+                    bucket = min(1 << max(0, (n - 1).bit_length()), cb)
+                    start = min(chunk_off, cb - bucket)
+                    lead = chunk_off - start
+                    part_b = np.zeros(bucket, dtype=np.uint8)
+                    part_b[lead : lead + n] = src[pos : pos + n]
+                    new_chunk = self._device_merge(
+                        region["chunks"][chunk_idx], part_b, start, lead, n
+                    )
+                region["chunks"][chunk_idx] = new_chunk
                 pos += n
             return 0
         except Exception:  # noqa: BLE001

@@ -28,6 +28,9 @@ class PoolAllocator {
   // strict rkey validation, range_allocator.cpp:12-35,125-131).
   explicit PoolAllocator(const MemoryPool& pool);
 
+  // Carved offsets honor the pool's advertised alignment (MemoryPool::
+  // alignment): the chosen block is padded up to the boundary and the
+  // leading gap returns to the free map.
   std::optional<Range> allocate(uint64_t size, bool prefer_best_fit = true);
   // Carves a SPECIFIC range out of the free map (keystone restart replay of
   // persisted placements). Fails when any byte of it is already allocated.
@@ -62,6 +65,7 @@ class PoolAllocator {
   RemoteDescriptor remote_;
   uint64_t rkey_{0};
   uint64_t pool_size_;
+  uint64_t alignment_{0};  // 0/1 = unaligned
 
   mutable std::mutex mutex_;
   std::map<uint64_t, uint64_t> free_by_offset_;          // offset -> length

@@ -190,6 +190,10 @@ struct MemoryPool {
   StorageClass storage_class{StorageClass::STORAGE_UNSPECIFIED};
   RemoteDescriptor remote;
   TopoCoord topo;
+  // Placement offsets in this pool are rounded up to this boundary
+  // (0/1 = none). HBM pools advertise the provider chunk size so shards hit
+  // the whole-chunk fast path (no read-modify-write on device).
+  uint64_t alignment{0};
 
   double utilization() const noexcept {
     return size > 0 ? static_cast<double>(used) / static_cast<double>(size) : 0.0;

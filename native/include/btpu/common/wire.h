@@ -241,11 +241,23 @@ inline bool decode(Reader& r, ClusterStats& s) {
 }
 
 inline void encode(Writer& w, const MemoryPool& p) {
-  encode_fields(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote, p.topo);
+  encode_fields(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote, p.topo,
+                p.alignment);
 }
 inline bool decode(Reader& r, MemoryPool& p) {
-  return decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
-                       p.remote, p.topo);
+  if (!decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
+                     p.remote, p.topo))
+    return false;
+  // `alignment` is a trailing optional field: records persisted by binaries
+  // that predate it decode with the default (0 = unaligned) instead of
+  // failing, which would silently drop pools (and every recovered object)
+  // on the first restart after an upgrade. NOTE: the optionality relies on
+  // MemoryPool only ever being decoded as a standalone record (keystone
+  // registry); embedding it mid-stream in a larger message would misread
+  // the next field — add a count/version prefix first if that's ever needed.
+  p.alignment = 0;
+  if (!r.exhausted() && !decode(r, p.alignment)) return false;
+  return true;
 }
 
 inline void encode(Writer& w, const BatchPutStartItem& i) {

@@ -33,6 +33,9 @@ struct PoolConfig {
   std::string device_id;  // hbm tier ("tpu:0")
   uint64_t interleave_granularity{256};  // cxl tiers
   int numa_node{-1};                     // cxl tiers (-1 = unbound)
+  // Advertised placement alignment; 0 = tier default (HBM: provider chunk
+  // size so shards hit whole-chunk device transfers; others: none).
+  uint64_t alignment{0};
 };
 
 struct WorkerServiceConfig {

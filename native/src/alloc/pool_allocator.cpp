@@ -23,7 +23,8 @@ PoolAllocator::PoolAllocator(const MemoryPool& pool)
       node_id_(pool.node_id),
       topo_(pool.topo),
       remote_(pool.remote),
-      pool_size_(pool.size) {
+      pool_size_(pool.size),
+      alignment_(pool.alignment) {
   if (pool.size == 0) throw std::invalid_argument("pool " + pool.id + " has zero size");
   if (pool.remote.transport == TransportKind::TRANSPORT_UNSPECIFIED)
     throw std::invalid_argument("pool " + pool.id + " has no transport");
@@ -55,16 +56,34 @@ std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit
   if (size == 0) return std::nullopt;
   std::lock_guard<std::mutex> lock(mutex_);
 
+  // Alignment only pays off for shards of at least one aligned unit (e.g.
+  // a whole HBM chunk): smaller shards are partial-chunk no matter where
+  // they land, and rounding them up would waste a full unit each.
+  const uint64_t align = (alignment_ > 1 && size >= alignment_) ? alignment_ : 1;
+  const auto pad_for = [align](uint64_t offset) { return (align - offset % align) % align; };
+
   std::map<uint64_t, uint64_t>::iterator chosen = free_by_offset_.end();
+  uint64_t pad = 0;
   if (prefer_best_fit) {
-    // Smallest block that fits, via the size index.
-    auto s = free_by_size_.lower_bound(size);
-    if (s != free_by_size_.end()) chosen = free_by_offset_.find(s->second);
+    // Smallest block that fits (including alignment padding), via the size
+    // index. Blocks whose start happens to be misaligned just past the
+    // padded size are skipped in favor of the next size up.
+    for (auto s = free_by_size_.lower_bound(size); s != free_by_size_.end(); ++s) {
+      auto it = free_by_offset_.find(s->second);
+      const uint64_t p = pad_for(it->first);
+      if (it->second >= p + size) {
+        chosen = it;
+        pad = p;
+        break;
+      }
+    }
   } else {
     // Lowest-offset block that fits.
     for (auto it = free_by_offset_.begin(); it != free_by_offset_.end(); ++it) {
-      if (it->second >= size) {
+      const uint64_t p = pad_for(it->first);
+      if (it->second >= p + size) {
         chosen = it;
+        pad = p;
         break;
       }
     }
@@ -74,10 +93,12 @@ std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit
   const uint64_t offset = chosen->first;
   const uint64_t block_len = chosen->second;
   erase_free(chosen);
-  if (block_len > size) insert_free(offset + size, block_len - size);
+  if (pad > 0) insert_free(offset, pad);  // leading gap stays free
+  const uint64_t carved = offset + pad;
+  if (block_len > pad + size) insert_free(carved + size, block_len - pad - size);
 
-  LOG_TRACE << "pool " << pool_id_ << " carved [" << offset << "," << offset + size << ")";
-  return Range{offset, size};
+  LOG_TRACE << "pool " << pool_id_ << " carved [" << carved << "," << carved + size << ")";
+  return Range{carved, size};
 }
 
 bool PoolAllocator::allocate_at(const Range& range) {
@@ -147,7 +168,13 @@ double PoolAllocator::fragmentation_ratio() const {
 bool PoolAllocator::can_allocate(uint64_t size) const {
   if (size == 0) return false;
   std::lock_guard<std::mutex> lock(mutex_);
-  return !free_by_size_.empty() && free_by_size_.rbegin()->first >= size;
+  if (free_by_size_.empty() || free_by_size_.rbegin()->first < size) return false;
+  if (alignment_ <= 1 || size < alignment_) return true;  // mirrors allocate()
+  for (const auto& [off, len] : free_by_offset_) {
+    const uint64_t pad = (alignment_ - off % alignment_) % alignment_;
+    if (len >= pad + size) return true;
+  }
+  return false;
 }
 
 size_t PoolAllocator::free_range_count() const {

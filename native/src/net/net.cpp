@@ -1,6 +1,7 @@
 #include "btpu/net/net.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -10,6 +11,7 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <chrono>
 #include <cstdlib>
 #include <cstring>
 
@@ -98,12 +100,45 @@ Result<Socket> tcp_connect(const std::string& host, uint16_t port, int timeout_m
     return ErrorCode::NETWORK_ERROR;
   }
   if (bulk_buffers) set_bulk_buffers(s.fd());  // pre-connect: affects window scaling
+  // Non-blocking connect + poll so timeout_ms is honored: the kernel's
+  // default SYN-retry timeout (~2 min) would otherwise stall data-path
+  // threads on unreachable workers (preemption/failover latency).
+  const int flags = ::fcntl(s.fd(), F_GETFL, 0);
+  ::fcntl(s.fd(), F_SETFL, flags | O_NONBLOCK);
   int rc = ::connect(s.fd(), res->ai_addr, res->ai_addrlen);
+  const int connect_errno = errno;  // freeaddrinfo may clobber errno
   ::freeaddrinfo(res);
-  if (rc != 0) {
-    LOG_DEBUG << "connect " << host << ":" << port << " failed: " << std::strerror(errno);
+  if (rc != 0 && connect_errno != EINPROGRESS) {
+    LOG_DEBUG << "connect " << host << ":" << port
+              << " failed: " << std::strerror(connect_errno);
     return ErrorCode::CONNECTION_FAILED;
   }
+  if (rc != 0) {
+    pollfd pfd{s.fd(), POLLOUT, 0};
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 0);
+    int ready;
+    for (;;) {
+      int wait_ms = -1;
+      if (timeout_ms > 0) {
+        wait_ms = static_cast<int>(std::chrono::duration_cast<std::chrono::milliseconds>(
+                                       deadline - std::chrono::steady_clock::now())
+                                       .count());
+        if (wait_ms < 0) wait_ms = 0;
+      }
+      ready = ::poll(&pfd, 1, wait_ms);
+      if (ready >= 0 || errno != EINTR) break;  // EINTR: retry with remaining budget
+    }
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    if (ready <= 0 ||
+        ::getsockopt(s.fd(), SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 || soerr != 0) {
+      LOG_DEBUG << "connect " << host << ":" << port
+                << (ready <= 0 ? " timed out" : " failed: ") << (soerr ? std::strerror(soerr) : "");
+      return ErrorCode::CONNECTION_FAILED;
+    }
+  }
+  ::fcntl(s.fd(), F_SETFL, flags);  // back to blocking for the data path
   set_nodelay(s.fd());
   return s;
 }

@@ -27,7 +27,7 @@ ErrorCode WorkerServiceConfig::validate() const {
 //   worker_id / cluster_id / coord_endpoints / transport / listen_host /
 //   listen_port / slice_id / host_id / heartbeat: {interval_ms, ttl_ms} /
 //   pools: [- id, storage_class, capacity ("8GB"), path, device_id,
-//             interleave_granularity, numa_node]
+//             interleave_granularity, numa_node, alignment]
 WorkerServiceConfig WorkerServiceConfig::from_yaml(const std::string& file_path) {
   auto parsed = yaml::parse_file(file_path);
   if (!parsed.ok()) {
@@ -73,6 +73,7 @@ WorkerServiceConfig WorkerServiceConfig::from_yaml(const std::string& file_path)
       if (auto n = item->get("interleave_granularity"))
         pool.interleave_granularity = static_cast<uint64_t>(n->int_or(256));
       if (auto n = item->get("numa_node")) pool.numa_node = static_cast<int>(n->int_or(-1));
+      if (auto n = item->get("alignment")) pool.alignment = static_cast<uint64_t>(n->int_or(0));
       cfg.pools.push_back(std::move(pool));
     }
   }
@@ -189,6 +190,14 @@ ErrorCode WorkerService::initialize() {
     runtime.record.storage_class = pool_cfg.storage_class;
     runtime.record.remote = registered.value();
     runtime.record.topo = config_.topo;
+    // HBM placements default to provider-chunk alignment so whole shards
+    // map to whole device chunks (single transfer, no read-modify-write).
+    // Matches JaxHbmProvider's default chunk_bytes; set `alignment` in the
+    // pool config when using a non-default chunk size.
+    runtime.record.alignment =
+        pool_cfg.alignment != 0
+            ? pool_cfg.alignment
+            : (pool_cfg.storage_class == StorageClass::HBM_TPU ? (1ull << 20) : 0);
     pools_.push_back(std::move(runtime));
   }
   initialized_ = true;

@@ -221,3 +221,47 @@ BTEST(PoolAllocator, ConcurrentAllocateFreeStress) {
   BT_EXPECT_EQ(pa.total_free(), uint64_t{8 << 20});
   BT_EXPECT_EQ(pa.free_range_count(), 1u);  // everything merged back
 }
+
+BTEST(PoolAllocator, AlignedCarveRoundsOffsetsUp) {
+  auto pool = make_pool("p", 1 << 20);
+  pool.alignment = 4096;
+  PoolAllocator pa(pool);
+  // Misalign the free map: carve 100 bytes (sub-unit, packs at 0), then a
+  // unit-sized request must skip to the next 4 KiB boundary, not start at 100.
+  auto head = pa.allocate(100);
+  BT_EXPECT(head.has_value());
+  BT_EXPECT_EQ(head->offset, 0ull);
+  auto aligned = pa.allocate(8192);
+  BT_EXPECT(aligned.has_value());
+  BT_EXPECT_EQ(aligned->offset, 4096ull);
+  // Sub-unit shards keep packing into the leading gap — alignment never
+  // wastes a whole unit on small objects.
+  auto gap = pa.allocate(1000);
+  BT_EXPECT(gap.has_value());
+  BT_EXPECT_EQ(gap->offset, 100ull);
+  BT_EXPECT_EQ(pa.total_free(), (1ull << 20) - 100 - 8192 - 1000);
+}
+
+BTEST(PoolAllocator, AlignmentPaddingMergesBackOnFree) {
+  auto pool = make_pool("p", 64 << 10);
+  pool.alignment = 4096;
+  PoolAllocator pa(pool);
+  auto a = pa.allocate(100);
+  auto b = pa.allocate(4096);
+  BT_EXPECT(a && b);
+  pa.free(*a);
+  pa.free(*b);
+  BT_EXPECT_EQ(pa.total_free(), uint64_t{64 << 10});
+  BT_EXPECT_EQ(pa.free_range_count(), 1u);
+}
+
+BTEST(PoolAllocator, CanAllocateAccountsForAlignmentPadding) {
+  auto pool = make_pool("p", 8192);
+  pool.alignment = 4096;
+  PoolAllocator pa(pool);
+  auto head = pa.allocate(100);  // free space is now [100,8192) = 8092 bytes
+  BT_EXPECT(head.has_value());
+  BT_EXPECT(pa.can_allocate(4096));    // fits at offset 4096
+  BT_EXPECT(!pa.can_allocate(8000));   // 8092 free, but only 4096 aligned-usable
+  BT_EXPECT(!pa.allocate(8000).has_value());
+}

@@ -66,22 +66,29 @@ class JaxHbmProvider:
         self._slice_fns: dict[int, object] = {}
         self._merge_fns: dict[int, object] = {}
 
-    def _device_slice(self, chunk, off: int, n: int):
-        """Device-side byte-range slice, compile-bounded.
+    def _bucket_span(self, off: int, n: int):
+        """Pow2 staging window for [off, off+n) within a chunk.
 
-        Slice lengths are rounded up to the next power of two (capped at the
-        chunk size) so the jit cache holds at most log2(chunk_bytes) entries
-        instead of one per distinct request length; the caller trims the
-        bucket back down on the host. When the bucket would run past the
-        chunk end the start is pulled back and the host trim skips the lead.
+        Lengths round up to the next power of two (capped at the chunk size)
+        so the jit caches hold at most log2(chunk_bytes) executables instead
+        of one per distinct request length. When the bucket would run past
+        the chunk end, the start is pulled back and `lead` bytes at the front
+        are outside the requested range. Returns (bucket, start, lead) with
+        the invariant [start+lead, start+lead+n) == [off, off+n); both the
+        slice and merge paths MUST use this one mapping.
+        """
+        cb = self.chunk_bytes
+        bucket = min(1 << max(0, (n - 1).bit_length()), cb)
+        start = min(off, cb - bucket)
+        return bucket, start, off - start
+
+    def _device_slice(self, chunk, off: int, n: int):
+        """Device-side byte-range slice, compile-bounded (see _bucket_span).
+
         Returns (device_array, lead) — the requested bytes are
         device_array[lead : lead + n].
         """
-        cb = self.chunk_bytes
-        bucket = 1 << max(0, (n - 1).bit_length())
-        bucket = min(bucket, cb)
-        start = min(off, cb - bucket)
-        lead = off - start
+        bucket, start, lead = self._bucket_span(off, n)
         fn = self._slice_fns.get(bucket)
         if fn is None:
             lax = self._jax.lax
@@ -229,9 +236,7 @@ class JaxHbmProvider:
                     # Stage only the payload on device (padded to a pow2
                     # bucket), merge there — no device->host readback of the
                     # surrounding chunk, bounded jit cache.
-                    bucket = min(1 << max(0, (n - 1).bit_length()), cb)
-                    start = min(chunk_off, cb - bucket)
-                    lead = chunk_off - start
+                    bucket, start, lead = self._bucket_span(chunk_off, n)
                     part_b = np.zeros(bucket, dtype=np.uint8)
                     part_b[lead : lead + n] = src[pos : pos + n]
                     new_chunk = self._device_merge(

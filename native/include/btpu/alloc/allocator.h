@@ -43,6 +43,14 @@ struct AllocationRequest {
   std::vector<StorageClass> preferred_classes;
   NodeId preferred_node;
   bool enable_locality_awareness{true};
+  // When true, pools outside preferred_classes are excluded outright instead
+  // of serving as spillover — used by tier demotion, which must never place
+  // an object back into the tier it is being demoted out of.
+  bool restrict_to_preferred{false};
+  // Pools on these nodes are never candidates. Repair top-ups exclude the
+  // nodes already holding surviving replicas so a "repaired" object doesn't
+  // end up with two copies behind one failure domain.
+  std::vector<NodeId> excluded_nodes;
 
   bool enable_striping{true};
   bool prefer_contiguous{false};
@@ -85,6 +93,25 @@ class IAllocator {
   virtual ErrorCode adopt_allocation(const ObjectKey& key,
                                      const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
                                      const PoolMap& pools) = 0;
+  // Transfers an allocation's bookkeeping to a new key; ranges are untouched.
+  // Used by tier demotion, which stages the replacement placement under a
+  // temporary key while bytes move outside the metadata lock, then renames.
+  virtual ErrorCode rename_object(const ObjectKey& from, const ObjectKey& to) = 0;
+  // Appends `from`'s ranges onto `to`'s allocation and erases `from`, in one
+  // atomic step — repair merges staged top-up copies into the object without
+  // ever releasing the ranges (no free-then-adopt window a concurrent
+  // allocation could race into).
+  virtual ErrorCode merge_objects(const ObjectKey& from, const ObjectKey& to) = 0;
+  // Drops `key`'s bookkeeping entries on `pool_id` without touching the pool
+  // free-map (the pool has left the cluster). Keeps a later free/merge from
+  // corrupting a re-registered pool's free-map with stale ranges.
+  virtual void remove_pool_ranges(const ObjectKey& key, const MemoryPoolId& pool_id) = 0;
+  // Frees ONE of `key`'s ranges back to its (live) pool and drops it from the
+  // object's bookkeeping. Repair uses it for the live-worker remnants of a
+  // partially-damaged striped copy — those shards lose their placement, and
+  // without an explicit release their bytes would stay allocated forever.
+  virtual ErrorCode release_range(const ObjectKey& key, const MemoryPoolId& pool_id,
+                                  const Range& range) = 0;
 };
 
 class AllocatorFactory {

@@ -43,6 +43,11 @@ class RangeAllocator : public IAllocator {
   uint64_t get_free_space(StorageClass storage_class) const override;
   bool can_allocate(const AllocationRequest& request, const PoolMap& pools) const override;
   void forget_pool(const MemoryPoolId& pool_id) override;
+  ErrorCode rename_object(const ObjectKey& from, const ObjectKey& to) override;
+  ErrorCode merge_objects(const ObjectKey& from, const ObjectKey& to) override;
+  void remove_pool_ranges(const ObjectKey& key, const MemoryPoolId& pool_id) override;
+  ErrorCode release_range(const ObjectKey& key, const MemoryPoolId& pool_id,
+                          const Range& range) override;
 
  private:
   mutable std::shared_mutex pools_mutex_;

@@ -60,6 +60,11 @@ enum class StorageClass : uint32_t {
 std::string_view storage_class_name(StorageClass c) noexcept;
 std::optional<StorageClass> storage_class_from_name(std::string_view name) noexcept;
 
+// Tier height for the eviction/demotion ladder: lower rank = faster tier.
+// HBM_TPU(0) > RAM_CPU(1) > CXL_MEMORY(2) > CXL_TYPE2(3) > NVME(4) > SSD(5)
+// > HDD(6); CUSTOM/UNSPECIFIED sort last.
+int tier_rank(StorageClass c) noexcept;
+
 // -------------------------------------------------------------------------
 // Transports. The reference hard-codes UCX in four places; here every shard
 // placement names the transport a client must use to reach its bytes.
@@ -288,6 +293,11 @@ struct KeystoneConfig {
   // TPU extensions
   bool enable_repair{true};       // re-replicate objects after worker death
   bool tier_aware_eviction{true}; // evict per-tier, not on global average
+  // Under tier pressure, move LRU objects down the tier ladder
+  // (HBM -> DRAM -> CXL -> NVMe/SSD/HDD) over the data plane instead of
+  // deleting them; deletion remains the fallback when no lower tier fits.
+  // (The reference only deletes, keystone_service.cpp:530-584.)
+  bool enable_tier_demotion{true};
   // Persist object metadata through the coordination service so a keystone
   // restart recovers the object map (the reference forgets all objects on
   // restart, SURVEY §5 checkpoint/resume). No-op without a coordinator.

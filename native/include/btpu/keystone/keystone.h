@@ -61,6 +61,12 @@ struct ObjectInfo {
   std::chrono::steady_clock::time_point created_at;
   std::chrono::steady_clock::time_point last_access;
   std::vector<CopyPlacement> copies;
+  // Monotonic placement revision (process-local, from a keystone-wide
+  // counter; bumped on every copies mutation and fresh on every create).
+  // Lock-free movers (demotion, repair) snapshot it and swap placements in
+  // only if it is unchanged — unlike comparing the placements themselves,
+  // an epoch cannot suffer ABA when a remove+re-put reuses the same ranges.
+  uint64_t epoch{0};
 
   bool expired(std::chrono::steady_clock::time_point now) const {
     return ttl_ms > 0 && now >= created_at + std::chrono::milliseconds(ttl_ms);
@@ -75,6 +81,7 @@ struct KeystoneCounters {
   std::atomic<uint64_t> removes{0};
   std::atomic<uint64_t> gc_collected{0};
   std::atomic<uint64_t> evicted{0};
+  std::atomic<uint64_t> objects_demoted{0};
   std::atomic<uint64_t> workers_lost{0};
   std::atomic<uint64_t> objects_repaired{0};
   std::atomic<uint64_t> objects_lost{0};
@@ -154,6 +161,18 @@ class KeystoneService {
   // replicas over the data plane. Returns number of objects repaired.
   size_t repair_objects_for_dead_worker(const NodeId& worker_id);
 
+  // Demotion: move an object's bytes out of the pressured tier `from` into
+  // the nearest lower tier with capacity (ladder order per tier_rank, capped
+  // at HDD — CUSTOM/unspecified pools are never an eviction backstop), over
+  // the data plane. The transfer runs WITHOUT objects_mutex_ held: the new
+  // placement is staged under a temporary allocator key while the old ranges
+  // stay live, then swapped in under the lock only if the object did not
+  // change in the meantime (wire-encoded placement fingerprint).
+  // kFailed -> caller falls back to delete-eviction; kSkipped -> object was
+  // removed/changed concurrently, caller leaves it alone.
+  enum class DemoteOutcome { kDemoted, kFailed, kSkipped };
+  DemoteOutcome demote_object(const ObjectKey& key, StorageClass from);
+
   // Eviction: evict least-recently-accessed, non-soft-pinned complete
   // objects until the (per-tier when configured) utilization drops below
   // high_watermark * (1 - eviction_ratio).
@@ -175,6 +194,7 @@ class KeystoneService {
   alloc::PoolMap pools_;
 
   std::atomic<ViewVersionId> view_version_{0};
+  std::atomic<uint64_t> next_epoch_{1};  // feeds ObjectInfo::epoch
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
   std::thread gc_thread_, health_thread_, keepalive_thread_;

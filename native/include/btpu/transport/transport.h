@@ -87,6 +87,15 @@ class TransportClient {
 std::unique_ptr<TransportServer> make_transport_server(TransportKind kind);
 std::unique_ptr<TransportClient> make_transport_client();
 
+// One shard-range transfer dispatched on the placement's location kind:
+// MemoryLocation through `client`'s one-sided path, DeviceLocation through
+// the in-process HBM provider (HBM-kind placements only exist for pools in
+// this process). `in_off` is a byte offset within the shard. Single home for
+// this dispatch — shared by the client SDK and keystone's repair/demotion
+// data movers so new location kinds cannot diverge between them.
+ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_t in_off,
+                   uint8_t* buf, uint64_t len, bool is_write);
+
 // Formats/parses rkey hex (shared by transports and allocator tests).
 std::string rkey_to_hex(uint64_t rkey);
 

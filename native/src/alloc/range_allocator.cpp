@@ -49,7 +49,14 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
   std::vector<MemoryPoolId> preferred, fallback;
   for (const auto& [id, pool] : pools) {
     if (!request.preferred_node.empty() && pool.node_id != request.preferred_node) continue;
-    (class_preferred(pool.storage_class) ? preferred : fallback).push_back(id);
+    if (std::find(request.excluded_nodes.begin(), request.excluded_nodes.end(),
+                  pool.node_id) != request.excluded_nodes.end())
+      continue;
+    if (!class_preferred(pool.storage_class)) {
+      if (!request.restrict_to_preferred) fallback.push_back(id);
+      continue;
+    }
+    preferred.push_back(id);
   }
 
   auto rank = [&](std::vector<MemoryPoolId>& v) {
@@ -276,7 +283,9 @@ void RangeAllocator::rollback_allocation(
     auto it = pool_allocators_.find(pool_id);
     if (it != pool_allocators_.end()) it->second->free(range);
   }
-  if (!ranges.empty()) LOG_DEBUG << "rolled back " << ranges.size() << " ranges";
+  if (!ranges.empty()) {
+    LOG_DEBUG << "rolled back " << ranges.size() << " ranges";
+  }
 }
 
 ErrorCode RangeAllocator::adopt_allocation(
@@ -306,6 +315,67 @@ ErrorCode RangeAllocator::adopt_allocation(
     return ec;
   }
   return ErrorCode::OK;
+}
+
+ErrorCode RangeAllocator::rename_object(const ObjectKey& from, const ObjectKey& to) {
+  std::unique_lock lock(allocations_mutex_);
+  auto it = object_allocations_.find(from);
+  if (it == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  if (object_allocations_.contains(to)) return ErrorCode::OBJECT_ALREADY_EXISTS;
+  object_allocations_[to] = std::move(it->second);
+  object_allocations_.erase(it);
+  return ErrorCode::OK;
+}
+
+ErrorCode RangeAllocator::merge_objects(const ObjectKey& from, const ObjectKey& to) {
+  std::unique_lock lock(allocations_mutex_);
+  auto src = object_allocations_.find(from);
+  if (src == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  auto dst = object_allocations_.find(to);
+  if (dst == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  dst->second.ranges.insert(dst->second.ranges.end(),
+                            std::make_move_iterator(src->second.ranges.begin()),
+                            std::make_move_iterator(src->second.ranges.end()));
+  dst->second.total_size += src->second.total_size;
+  object_allocations_.erase(src);
+  return ErrorCode::OK;
+}
+
+ErrorCode RangeAllocator::release_range(const ObjectKey& key, const MemoryPoolId& pool_id,
+                                        const Range& range) {
+  // Lock order: pools before allocations, matching free()/get_stats.
+  std::shared_lock pools_lock(pools_mutex_);
+  std::unique_lock lock(allocations_mutex_);
+  auto it = object_allocations_.find(key);
+  if (it == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  auto& ranges = it->second.ranges;
+  auto rit = std::find_if(ranges.begin(), ranges.end(),
+                          [&](const std::pair<MemoryPoolId, Range>& pr) {
+                            return pr.first == pool_id && pr.second.offset == range.offset &&
+                                   pr.second.length == range.length;
+                          });
+  if (rit == ranges.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  auto pa = pool_allocators_.find(pool_id);
+  if (pa != pool_allocators_.end()) pa->second->free(range);
+  it->second.total_size -= std::min(it->second.total_size, range.length);
+  ranges.erase(rit);
+  return ErrorCode::OK;
+}
+
+void RangeAllocator::remove_pool_ranges(const ObjectKey& key, const MemoryPoolId& pool_id) {
+  std::unique_lock lock(allocations_mutex_);
+  auto it = object_allocations_.find(key);
+  if (it == object_allocations_.end()) return;
+  auto& ranges = it->second.ranges;
+  uint64_t dropped = 0;
+  ranges.erase(std::remove_if(ranges.begin(), ranges.end(),
+                              [&](const std::pair<MemoryPoolId, Range>& pr) {
+                                if (pr.first != pool_id) return false;
+                                dropped += pr.second.length;
+                                return true;
+                              }),
+               ranges.end());
+  it->second.total_size -= std::min(it->second.total_size, dropped);
 }
 
 ErrorCode RangeAllocator::free(const ObjectKey& object_key) {

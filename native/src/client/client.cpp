@@ -131,24 +131,10 @@ Result<ViewVersionId> ObjectClient::ping() {
 
 // One shard transfer; `buf` already points at the shard's slice of the
 // object buffer (running-offset math lives in the copy-level loop).
+// Location dispatch lives in transport::shard_io, shared with keystone's
+// repair/demotion data movers.
 ErrorCode ObjectClient::shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write) {
-  if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
-    return is_write ? data_->write(shard.remote, mem->remote_addr, mem->rkey, buf, shard.length)
-                    : data_->read(shard.remote, mem->remote_addr, mem->rkey, buf, shard.length);
-  }
-  if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
-    // On-device tier addressed through the in-process HBM provider.
-    const auto& provider = storage::hbm_provider();
-    const int rc = is_write
-                       ? provider.write(provider.ctx, dev->region_id, dev->offset, buf,
-                                        shard.length)
-                       : provider.read(provider.ctx, dev->region_id, dev->offset, buf,
-                                       shard.length);
-    return rc == 0 ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR;
-  }
-  // FileLocation shards are served by the worker via virtual regions and
-  // should never surface here.
-  return ErrorCode::NOT_IMPLEMENTED;
+  return transport::shard_io(*data_, shard, 0, buf, shard.length, is_write);
 }
 
 namespace {

@@ -338,6 +338,8 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
   if (auto n = root.get("enable_repair")) cfg.enable_repair = n->bool_or(cfg.enable_repair);
   if (auto n = root.get("tier_aware_eviction"))
     cfg.tier_aware_eviction = n->bool_or(cfg.tier_aware_eviction);
+  if (auto n = root.get("enable_tier_demotion"))
+    cfg.enable_tier_demotion = n->bool_or(cfg.enable_tier_demotion);
   if (auto n = root.get("persist_objects"))
     cfg.persist_objects = n->bool_or(cfg.persist_objects);
 

@@ -17,6 +17,21 @@ std::string_view storage_class_name(StorageClass c) noexcept {
   return "unknown";
 }
 
+int tier_rank(StorageClass c) noexcept {
+  switch (c) {
+    case StorageClass::HBM_TPU: return 0;
+    case StorageClass::RAM_CPU: return 1;
+    case StorageClass::CXL_MEMORY: return 2;
+    case StorageClass::CXL_TYPE2_DEVICE: return 3;
+    case StorageClass::NVME: return 4;
+    case StorageClass::SSD: return 5;
+    case StorageClass::HDD: return 6;
+    case StorageClass::CUSTOM: return 7;
+    case StorageClass::STORAGE_UNSPECIFIED: return 8;
+  }
+  return 8;
+}
+
 std::optional<StorageClass> storage_class_from_name(std::string_view name) noexcept {
   if (name == "ram_cpu" || name == "RAM_CPU" || name == "dram") return StorageClass::RAM_CPU;
   if (name == "hbm_tpu" || name == "HBM_TPU" || name == "hbm") return StorageClass::HBM_TPU;

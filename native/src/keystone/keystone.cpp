@@ -5,6 +5,7 @@
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
+#include "btpu/storage/hbm_provider.h"
 
 namespace btpu::keystone {
 
@@ -20,11 +21,14 @@ std::string encode_worker_info(const WorkerInfo& info) {
   return std::string(bytes.begin(), bytes.end());
 }
 
+// Top-level registry/object records tolerate trailing bytes: a newer binary
+// may append fields, and an older keystone must keep decoding the prefix it
+// knows instead of silently dropping the record (which would erase pools or
+// objects from the registry during a mixed-version rolling upgrade).
 bool decode_worker_info(const std::string& bytes, WorkerInfo& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
   return wire::decode_fields(r, out.worker_id, out.address, out.topo, out.registered_at_ms,
-                             out.last_heartbeat_ms) &&
-         r.exhausted();
+                             out.last_heartbeat_ms);
 }
 
 std::string encode_pool_record(const MemoryPool& pool) {
@@ -36,7 +40,7 @@ std::string encode_pool_record(const MemoryPool& pool) {
 
 bool decode_pool_record(const std::string& bytes, MemoryPool& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  return wire::decode(r, out) && r.exhausted();
+  return wire::decode(r, out);
 }
 
 namespace {
@@ -64,8 +68,53 @@ std::string encode_object_record(const ObjectRecord& rec) {
 bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
   return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
-                             out.copies, out.created_wall_ms, out.last_access_wall_ms) &&
-         r.exhausted();
+                             out.copies, out.created_wall_ms, out.last_access_wall_ms);
+}
+
+// Reads or writes [obj_off, obj_off+len) of one copy through its shards.
+// Partial-shard access offsets into the shard's registered region.
+ErrorCode copy_io(transport::TransportClient& client, const CopyPlacement& copy,
+                  uint64_t obj_off, uint8_t* buf, uint64_t len, bool is_write) {
+  uint64_t shard_start = 0;
+  uint64_t cur = obj_off, remaining = len;
+  uint8_t* p = buf;
+  for (const auto& shard : copy.shards) {
+    const uint64_t shard_end = shard_start + shard.length;
+    if (cur < shard_end && remaining > 0) {
+      const uint64_t in_off = cur - shard_start;
+      const uint64_t n = std::min(remaining, shard.length - in_off);
+      if (auto ec = transport::shard_io(client, shard, in_off, p, n, is_write);
+          ec != ErrorCode::OK)
+        return ec;
+      p += n;
+      cur += n;
+      remaining -= n;
+    }
+    shard_start = shard_end;
+    if (remaining == 0) break;
+  }
+  return remaining == 0 ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
+}
+
+// Streams `size` bytes from `src` into every copy in `dsts` through a bounded
+// chunk buffer, so keystone-side data movement (repair, demotion) never
+// buffers a whole object in host memory.
+ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
+                            const std::vector<CopyPlacement>& dsts, uint64_t size) {
+  constexpr uint64_t kChunk = 16ull << 20;
+  std::vector<uint8_t> buf(static_cast<size_t>(std::min(size, kChunk)));
+  for (uint64_t off = 0; off < size; off += kChunk) {
+    const uint64_t n = std::min(kChunk, size - off);
+    if (auto ec = copy_io(client, src, off, buf.data(), n, /*is_write=*/false);
+        ec != ErrorCode::OK)
+      return ec;
+    for (const auto& dst : dsts) {
+      if (auto ec = copy_io(client, dst, off, buf.data(), n, /*is_write=*/true);
+          ec != ErrorCode::OK)
+        return ec;
+    }
+  }
+  return ErrorCode::OK;
 }
 
 // Maps a shard placement back to (pool, offset-range) for allocator adoption.
@@ -259,6 +308,7 @@ void KeystoneService::load_persisted_objects() {
     };
     info.created_at = from_wall(rec.created_wall_ms);
     info.last_access = from_wall(rec.last_access_wall_ms);
+    info.epoch = next_epoch_.fetch_add(1);
     {
       std::unique_lock lock(objects_mutex_);
       objects_[key] = std::move(info);
@@ -378,6 +428,10 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
                                                               uint64_t size,
                                                               const WorkerConfig& config) {
   if (key.empty()) return ErrorCode::INVALID_KEY;
+  // 0x01 is reserved as the internal staging-key separator (demotion/repair
+  // stage replacement placements under "<key>\x01..."); letting clients use
+  // it could collide with an in-flight staging allocation.
+  if (key.find('\x01') != ObjectKey::npos) return ErrorCode::INVALID_KEY;
   if (size == 0) return ErrorCode::INVALID_PARAMETERS;
 
   WorkerConfig effective = config;
@@ -411,6 +465,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   info.state = ObjectState::kPending;
   info.created_at = info.last_access = std::chrono::steady_clock::now();
   info.copies = placed.value();
+  info.epoch = next_epoch_.fetch_add(1);
   objects_[key] = std::move(info);
   ++counters_.put_starts;
   bump_view();
@@ -677,114 +732,148 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     live_pools = pools_;
   }
 
-  size_t repaired = 0;
-  std::unique_lock lock(objects_mutex_);
-  for (auto it = objects_.begin(); it != objects_.end();) {
-    ObjectInfo& info = it->second;
-    auto damaged = [&](const CopyPlacement& copy) {
-      return std::any_of(copy.shards.begin(), copy.shards.end(),
-                         [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
-    };
+  // Pass 1 — metadata only, under the lock: prune dead placements so clients
+  // stop dialing the dead worker immediately, drop objects that lost every
+  // copy, and queue the rest for re-replication. No data moves here, so the
+  // lock hold is bounded by map size, not object bytes.
+  struct PendingRepair {
+    ObjectKey key;
+    uint64_t size{0};
+    uint64_t epoch{0};
+    size_t needed{0};
+    WorkerConfig config;
     std::vector<CopyPlacement> surviving;
-    bool any_damaged = false;
-    for (const auto& copy : info.copies) {
-      if (damaged(copy)) {
-        any_damaged = true;
-      } else {
-        surviving.push_back(copy);
+  };
+  std::vector<PendingRepair> pending;
+  {
+    std::unique_lock lock(objects_mutex_);
+    for (auto it = objects_.begin(); it != objects_.end();) {
+      ObjectInfo& info = it->second;
+      auto damaged = [&](const CopyPlacement& copy) {
+        return std::any_of(copy.shards.begin(), copy.shards.end(),
+                           [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
+      };
+      std::vector<CopyPlacement> surviving;
+      bool any_damaged = false;
+      for (const auto& copy : info.copies) {
+        if (damaged(copy)) {
+          any_damaged = true;
+        } else {
+          surviving.push_back(copy);
+        }
       }
-    }
-    if (!any_damaged) {
-      ++it;
-      continue;
-    }
-    if (surviving.empty()) {
-      LOG_WARN << "object " << it->first << " lost all replicas with worker " << worker_id;
-      adapter_.free_object(it->first);
-      unpersist_object(it->first);
-      it = objects_.erase(it);
-      ++counters_.objects_lost;
-      bump_view();
-      continue;
-    }
-
-    // Read the object back from the first surviving copy...
-    std::vector<uint8_t> bytes(info.size);
-    bool read_ok = true;
-    uint64_t offset = 0;
-    for (const auto& shard : surviving.front().shards) {
-      const auto* mem = std::get_if<MemoryLocation>(&shard.location);
-      if (!mem || offset + shard.length > bytes.size()) {
-        read_ok = false;
-        break;
+      if (!any_damaged) {
+        ++it;
+        continue;
       }
-      if (data_client_->read(shard.remote, mem->remote_addr, mem->rkey, bytes.data() + offset,
-                             shard.length) != ErrorCode::OK) {
-        read_ok = false;
-        break;
+      const ObjectKey key = it->first;
+      // Every damaged copy is dropped whole, so release all its ranges now:
+      // dead-worker shards lose only their bookkeeping (a later free of
+      // ranges on a re-registered pool would corrupt the fresh free-map),
+      // while live-worker shards of a partially-damaged striped copy hand
+      // their bytes back to the pool — otherwise worker churn slowly fills
+      // the surviving pools with orphaned, unreadable ranges.
+      for (const auto& copy : info.copies) {
+        if (!damaged(copy)) continue;
+        for (const auto& shard : copy.shards) {
+          if (shard.worker_id == worker_id) {
+            adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          } else if (auto pr = shard_to_range(shard, live_pools)) {
+            adapter_.allocator().release_range(key, pr->first, pr->second);
+          }
+        }
       }
-      offset += shard.length;
-    }
-    if (!read_ok || offset != info.size) {
-      // Can't reach the survivor right now: keep the surviving placements and
-      // drop the damaged ones so clients never dial the dead worker.
-      info.copies = std::move(surviving);
-      persist_object(it->first, info);
-      ++it;
-      bump_view();
-      continue;
-    }
-
-    // ...re-place at full replication and rewrite every copy.
-    const ObjectKey key = it->first;
-    adapter_.free_object(key);
-    auto placed = adapter_.allocate_data_copies(key, info.size, info.config, live_pools);
-    if (!placed.ok()) {
-      // Not enough healthy capacity: degrade to the surviving copies. Their
-      // ranges were just freed, so re-commit them shard by shard is not
-      // possible — instead re-allocate only what fits.
-      WorkerConfig degraded = info.config;
-      degraded.replication_factor = surviving.size();
-      placed = adapter_.allocate_data_copies(key, info.size, degraded, live_pools);
-      if (!placed.ok()) {
-        LOG_ERROR << "repair failed for object " << key << ": "
-                  << to_string(placed.error());
+      if (surviving.empty()) {
+        LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
+        adapter_.free_object(key);
         unpersist_object(key);
         it = objects_.erase(it);
         ++counters_.objects_lost;
         bump_view();
         continue;
       }
-    }
-    bool write_ok = true;
-    for (const auto& copy : placed.value()) {
-      uint64_t woff = 0;
-      for (const auto& shard : copy.shards) {
-        const auto* mem = std::get_if<MemoryLocation>(&shard.location);
-        if (!mem || data_client_->write(shard.remote, mem->remote_addr, mem->rkey,
-                                        bytes.data() + woff, shard.length) != ErrorCode::OK) {
-          write_ok = false;
-          break;
-        }
-        woff += shard.length;
-      }
-      if (!write_ok) break;
-    }
-    if (!write_ok) {
-      LOG_ERROR << "repair rewrite failed for object " << key;
-      adapter_.free_object(key);
-      unpersist_object(key);
-      it = objects_.erase(it);
-      ++counters_.objects_lost;
+      info.copies = surviving;
+      for (size_t i = 0; i < info.copies.size(); ++i) info.copies[i].copy_index = i;
+      info.epoch = next_epoch_.fetch_add(1);
+      const size_t needed = info.config.replication_factor > surviving.size()
+                                ? info.config.replication_factor - surviving.size()
+                                : 0;
+      persist_object(key, info);
       bump_view();
+      if (needed > 0 && info.state == ObjectState::kComplete) {
+        pending.push_back(
+            {key, info.size, info.epoch, needed, info.config, std::move(surviving)});
+      }
+      ++it;
+    }
+  }
+
+  // Pass 2 — no metadata lock while bytes move: stage the top-up copies
+  // under a temporary allocator key, stream from a survivor, then merge the
+  // staging allocation into the object atomically iff its epoch is unchanged.
+  size_t repaired = 0;
+  for (auto& p : pending) {
+    const ObjectKey staging_key = p.key + "\x01" "repair";
+    alloc::AllocationRequest req =
+        alloc::KeystoneAllocatorAdapter::to_allocation_request(staging_key, p.size, p.config);
+    req.replication_factor = p.needed;
+    // Anti-affinity: a repaired copy must not land behind a failure domain
+    // that already holds a survivor; relax only if the cluster is too small.
+    for (const auto& copy : p.surviving) {
+      for (const auto& shard : copy.shards) {
+        if (std::find(req.excluded_nodes.begin(), req.excluded_nodes.end(),
+                      shard.worker_id) == req.excluded_nodes.end())
+          req.excluded_nodes.push_back(shard.worker_id);
+      }
+    }
+    auto attempt = adapter_.allocator().allocate(req, live_pools);
+    if (!attempt.ok()) {
+      req.excluded_nodes.clear();
+      attempt = adapter_.allocator().allocate(req, live_pools);
+    }
+    if (!attempt.ok()) {
+      // No room to re-replicate: the object stays degraded on its survivors
+      // (pass 1 already pruned the dead placements) — never deleted.
+      LOG_WARN << "repair of " << p.key << " degraded to " << p.surviving.size()
+               << " copies: " << to_string(attempt.error());
       continue;
     }
-    info.copies = std::move(placed).value();
-    persist_object(key, info);
+    std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
+
+    bool streamed = false;
+    for (const auto& src : p.surviving) {
+      if (copy_object_bytes(*data_client_, src, staged, p.size) == ErrorCode::OK) {
+        streamed = true;
+        break;
+      }
+    }
+    if (!streamed) {
+      adapter_.free_object(staging_key);
+      continue;  // survivors still serve reads; retry on a later event
+    }
+
+    std::unique_lock lock(objects_mutex_);
+    auto it = objects_.find(p.key);
+    if (it == objects_.end() || it->second.epoch != p.epoch) {
+      lock.unlock();
+      adapter_.free_object(staging_key);
+      continue;  // object changed while the bytes moved; its new state wins
+    }
+    if (adapter_.allocator().merge_objects(staging_key, p.key) != ErrorCode::OK) {
+      lock.unlock();
+      LOG_ERROR << "repair merge failed for " << p.key;
+      adapter_.free_object(staging_key);
+      continue;
+    }
+    for (auto& copy : staged) {
+      copy.copy_index = it->second.copies.size();
+      it->second.copies.push_back(std::move(copy));
+    }
+    it->second.epoch = next_epoch_.fetch_add(1);
+    persist_object(p.key, it->second);
     ++counters_.objects_repaired;
     ++repaired;
     bump_view();
-    ++it;
   }
   return repaired;
 }
@@ -818,6 +907,11 @@ void KeystoneService::evict_for_pressure() {
           classes.push_back(pool.storage_class);
       }
     }
+    // Fastest tier first: demotions out of a hot tier land in lower tiers,
+    // and those are evaluated later in the same pass so they can shed the
+    // cascade immediately instead of waiting a full health interval.
+    std::sort(classes.begin(), classes.end(),
+              [](StorageClass a, StorageClass b) { return tier_rank(a) < tier_rank(b); });
     for (auto c : classes) scopes.emplace_back(c);
   } else {
     scopes.emplace_back(std::nullopt);
@@ -852,6 +946,16 @@ void KeystoneService::evict_for_pressure() {
 
     for (const auto& [ts, key] : candidates) {
       if (tier_utilization(scope) <= target) break;
+      if (scope && config_.enable_tier_demotion) {
+        const DemoteOutcome outcome = demote_object(key, *scope);
+        if (outcome == DemoteOutcome::kDemoted) {
+          ++counters_.objects_demoted;
+          LOG_INFO << "demoted object " << key << " out of tier "
+                   << storage_class_name(*scope);
+          continue;
+        }
+        if (outcome == DemoteOutcome::kSkipped) continue;
+      }
       std::unique_lock lock(objects_mutex_);
       auto it = objects_.find(key);
       if (it == objects_.end()) continue;
@@ -863,6 +967,114 @@ void KeystoneService::evict_for_pressure() {
       LOG_INFO << "evicted object " << key << " for tier pressure";
     }
   }
+}
+
+KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& key,
+                                                              StorageClass from) {
+  alloc::PoolMap live_pools;
+  {
+    std::shared_lock lock(registry_mutex_);
+    live_pools = pools_;
+  }
+
+  // Lower tiers that actually have pools, nearest first. The ladder stops at
+  // HDD: CUSTOM/unspecified pools are application-owned, never a backstop.
+  std::vector<StorageClass> ladder;
+  for (const auto& [id, pool] : live_pools) {
+    const int rank = tier_rank(pool.storage_class);
+    if (rank <= tier_rank(from) || rank > tier_rank(StorageClass::HDD)) continue;
+    if (std::find(ladder.begin(), ladder.end(), pool.storage_class) == ladder.end())
+      ladder.push_back(pool.storage_class);
+  }
+  if (ladder.empty()) return DemoteOutcome::kFailed;
+  std::sort(ladder.begin(), ladder.end(),
+            [](StorageClass a, StorageClass b) { return tier_rank(a) < tier_rank(b); });
+
+  // Snapshot the object, then move bytes with NO metadata lock held — a
+  // multi-hundred-MB transfer must not stall every put_start/get_workers.
+  uint64_t size = 0;
+  uint64_t epoch_snap = 0;
+  WorkerConfig config;
+  std::vector<CopyPlacement> old_copies;
+  {
+    std::shared_lock lock(objects_mutex_);
+    auto it = objects_.find(key);
+    if (it == objects_.end() || it->second.state != ObjectState::kComplete)
+      return DemoteOutcome::kSkipped;
+    size = it->second.size;
+    epoch_snap = it->second.epoch;
+    config = it->second.config;
+    old_copies = it->second.copies;
+  }
+  // Demotion moves whole objects. Only objects fully resident in the
+  // pressured tier qualify — re-placing a mixed-tier object would drag its
+  // healthy faster-tier replicas down the ladder too. Mixed objects keep
+  // delete-eviction semantics (the caller's fallback).
+  for (const auto& copy : old_copies) {
+    for (const auto& shard : copy.shards) {
+      if (shard.storage_class != from) return DemoteOutcome::kFailed;
+    }
+  }
+
+  // Stage the replacement under a temporary allocator key; the old ranges
+  // stay live the whole time, so concurrent readers are never broken.
+  const ObjectKey staging_key = key + "\x01" "demote";
+  alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+      staging_key, size, config);
+  req.restrict_to_preferred = true;
+  // The object is leaving its tier regardless; a node pin (often a node that
+  // only hosts the hot tier) must not veto the move — without this, pinned
+  // objects could never demote and would always fall through to deletion.
+  req.preferred_node.clear();
+  Result<std::vector<CopyPlacement>> placed = ErrorCode::INSUFFICIENT_SPACE;
+  for (StorageClass target_class : ladder) {
+    req.preferred_classes = {target_class};
+    auto attempt = adapter_.allocator().allocate(req, live_pools);
+    if (attempt.ok()) {
+      placed = std::move(attempt).value().copies;
+      break;
+    }
+  }
+  if (!placed.ok()) return DemoteOutcome::kFailed;
+
+  // Stream from the first readable copy into the staged placements.
+  bool moved = false;
+  for (const auto& src : old_copies) {
+    if (copy_object_bytes(*data_client_, src, placed.value(), size) == ErrorCode::OK) {
+      moved = true;
+      break;
+    }
+  }
+  if (!moved) {
+    adapter_.free_object(staging_key);
+    return DemoteOutcome::kFailed;
+  }
+
+  // Swap the placements in only if the object didn't change underneath us.
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end() || it->second.epoch != epoch_snap) {
+    lock.unlock();
+    adapter_.free_object(staging_key);
+    return DemoteOutcome::kSkipped;
+  }
+  adapter_.free_object(key);
+  if (auto ec = adapter_.allocator().rename_object(staging_key, key); ec != ErrorCode::OK) {
+    // Unreachable in practice (staging exists, key was just freed); treat the
+    // object as lost rather than leave metadata pointing at freed ranges.
+    LOG_ERROR << "demotion rename failed for " << key << ": " << to_string(ec);
+    adapter_.free_object(staging_key);
+    objects_.erase(it);
+    unpersist_object(key);
+    ++counters_.objects_lost;
+    bump_view();
+    return DemoteOutcome::kSkipped;
+  }
+  it->second.copies = std::move(placed).value();
+  it->second.epoch = next_epoch_.fetch_add(1);
+  persist_object(key, it->second);
+  bump_view();
+  return DemoteOutcome::kDemoted;
 }
 
 }  // namespace btpu::keystone

@@ -3,6 +3,7 @@
 #include <cstdio>
 
 #include "btpu/common/log.h"
+#include "btpu/storage/hbm_provider.h"
 #include "btpu/transport/transport.h"
 
 namespace btpu::transport {
@@ -73,6 +74,28 @@ class MuxTransportClient : public TransportClient {
 
 std::unique_ptr<TransportClient> make_transport_client() {
   return std::make_unique<MuxTransportClient>();
+}
+
+ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_t in_off,
+                   uint8_t* buf, uint64_t len, bool is_write) {
+  if (in_off + len > shard.length) return ErrorCode::INVALID_PARAMETERS;
+  if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
+    return is_write
+               ? client.write(shard.remote, mem->remote_addr + in_off, mem->rkey, buf, len)
+               : client.read(shard.remote, mem->remote_addr + in_off, mem->rkey, buf, len);
+  }
+  if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
+    const auto& provider = storage::hbm_provider();
+    const int rc = is_write
+                       ? provider.write(provider.ctx, dev->region_id, dev->offset + in_off,
+                                        buf, len)
+                       : provider.read(provider.ctx, dev->region_id, dev->offset + in_off,
+                                       buf, len);
+    return rc == 0 ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  // FileLocation shards are served by the worker via virtual regions and
+  // should never surface on a client data path.
+  return ErrorCode::NOT_IMPLEMENTED;
 }
 
 }  // namespace btpu::transport

@@ -127,6 +127,9 @@ BTEST(Keystone, ValidationAndDefaults) {
   ks.register_memory_pool(w2.pool);
 
   BT_EXPECT(ks.put_start("", 1024, {}).error() == ErrorCode::INVALID_KEY);
+  // 0x01 is the reserved staging-key separator (demotion/repair).
+  BT_EXPECT(ks.put_start(std::string("k\x01") + "x", 1024, {}).error() ==
+            ErrorCode::INVALID_KEY);
   BT_EXPECT(ks.put_start("k", 0, {}).error() == ErrorCode::INVALID_PARAMETERS);
 
   // replication_factor 0 -> default_replicas; 99 -> clamped to max_replicas.
@@ -237,6 +240,139 @@ BTEST(Keystone, WatermarkEvictionLruHonorsSoftPin) {
   BT_EXPECT_EQ(ks.counters().evicted.load(), 1ull);
 }
 
+BTEST(Keystone, PartiallyDamagedStripedCopyReleasesLiveRemnants) {
+  // A copy striped across a dead and a live worker is dropped whole; the
+  // live worker's shard ranges must return to its pool (not leak as used).
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
+  for (auto* w : {&w1, &w2}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 2;
+  cfg.min_shard_size = 1024;
+  auto placed = ks.put_start("striped", 64 * 1024, cfg);
+  BT_ASSERT_OK(placed);
+  BT_ASSERT(placed.value()[0].shards.size() == 2);
+  ks.put_complete("striped");
+
+  const NodeId victim = placed.value()[0].shards[0].worker_id;
+  BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
+
+  // Sole copy lost a shard -> object dropped; the LIVE worker's 32 KiB half
+  // must be back to free, so its pool can hold a fresh full-pool object.
+  BT_EXPECT(!ks.object_exists("striped").value());
+  auto stats = ks.get_cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().used_capacity, 0ull);
+  WorkerConfig full;
+  full.replication_factor = 1;
+  full.max_workers_per_copy = 1;
+  BT_ASSERT_OK(ks.put_start("refill", 1 << 20, full));
+}
+
+BTEST(Keystone, TierPressureDemotesDownLadderWithBytesIntact) {
+  // Acceptance-ladder item 4 (BASELINE.md): HBM -> DRAM -> disk-class
+  // demotion under pressure. Small "HBM" tier over the watermark, roomy SSD
+  // tier below it: the LRU object must MOVE (not die) and keep its bytes.
+  auto cfg = fast_config();
+  cfg.high_watermark = 0.5;
+  cfg.eviction_ratio = 0.2;
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker hot("hot", 100 * 1024, StorageClass::HBM_TPU);
+  FakeWorker cold("cold", 1 << 20, StorageClass::SSD);
+  for (auto* w : {&hot, &cold}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  wc.preferred_classes = {StorageClass::HBM_TPU};
+
+  auto client = transport::make_transport_client();
+  std::vector<uint8_t> payload(20 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 13 + 5);
+  for (const char* key : {"a", "b", "c"}) {  // 60% of the hot tier
+    auto placed = ks.put_start(key, payload.size(), wc);
+    BT_ASSERT_OK(placed);
+    BT_EXPECT(placed.value()[0].shards[0].storage_class == StorageClass::HBM_TPU);
+    uint64_t off = 0;
+    for (const auto& shard : placed.value()[0].shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
+                              shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+    ks.put_complete(key);
+    std::this_thread::sleep_for(5ms);
+  }
+  ks.get_workers("a");  // touch: "b" becomes the LRU victim
+  ks.get_workers("c");
+
+  const auto v0 = ks.get_view_version();
+  ks.run_health_check_once();
+  BT_EXPECT_EQ(ks.counters().objects_demoted.load(), 1ull);
+  BT_EXPECT_EQ(ks.counters().evicted.load(), 0ull);
+  BT_EXPECT(ks.get_view_version() > v0);
+
+  // All three objects still exist; "b" now lives on the SSD tier with the
+  // same bytes readable over the data plane.
+  for (const char* key : {"a", "b", "c"}) BT_EXPECT(ks.object_exists(key).value());
+  auto moved = ks.get_workers("b");
+  BT_ASSERT_OK(moved);
+  std::vector<uint8_t> back(payload.size(), 0);
+  uint64_t off = 0;
+  for (const auto& shard : moved.value()[0].shards) {
+    BT_EXPECT(shard.storage_class == StorageClass::SSD);
+    BT_EXPECT_EQ(shard.worker_id, "cold");
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    BT_ASSERT(client->read(shard.remote, mem.remote_addr, mem.rkey, back.data() + off,
+                           shard.length) == ErrorCode::OK);
+    off += shard.length;
+  }
+  BT_EXPECT(std::memcmp(back.data(), payload.data(), payload.size()) == 0);
+
+  // The hot tier is back under the watermark; a fresh HBM-preferring put
+  // lands in HBM again.
+  auto placed = ks.put_start("d", 8 * 1024, wc);
+  BT_ASSERT_OK(placed);
+  BT_EXPECT(placed.value()[0].shards[0].storage_class == StorageClass::HBM_TPU);
+}
+
+BTEST(Keystone, DemotionDisabledFallsBackToEviction) {
+  auto cfg = fast_config();
+  cfg.high_watermark = 0.5;
+  cfg.eviction_ratio = 0.2;
+  cfg.enable_tier_demotion = false;
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker hot("hot", 100 * 1024, StorageClass::HBM_TPU);
+  FakeWorker cold("cold", 1 << 20, StorageClass::SSD);
+  for (auto* w : {&hot, &cold}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  wc.preferred_classes = {StorageClass::HBM_TPU};
+  for (const char* key : {"a", "b", "c"}) {
+    BT_ASSERT_OK(ks.put_start(key, 20 * 1024, wc));
+    ks.put_complete(key);
+    std::this_thread::sleep_for(5ms);
+  }
+  ks.run_health_check_once();
+  BT_EXPECT_EQ(ks.counters().objects_demoted.load(), 0ull);
+  BT_EXPECT(ks.counters().evicted.load() >= 1ull);
+}
+
 BTEST(Keystone, CoordinatorRegistryAndHeartbeatDeath) {
   // Full §3.5 path: worker advertises itself through the coordinator; its
   // heartbeat TTL lapses; keystone's watcher cleans it up.
@@ -315,10 +451,13 @@ BTEST(Keystone, DeadWorkerRepairRebuildsReplicas) {
   BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
   BT_EXPECT_EQ(ks.counters().objects_repaired.load(), 1ull);
 
-  // Object still has 2 replicas, none on the dead worker, bytes intact.
+  // Object still has 2 replicas, none on the dead worker, bytes intact —
+  // and the repaired copy landed on a DIFFERENT worker than the survivor
+  // (anti-affinity), or losing that one worker would lose both replicas.
   auto got = ks.get_workers("precious");
   BT_ASSERT_OK(got);
   BT_EXPECT_EQ(got.value().size(), 2u);
+  BT_EXPECT_NE(got.value()[0].shards[0].worker_id, got.value()[1].shards[0].worker_id);
   for (const auto& copy : got.value()) {
     uint64_t off = 0;
     std::vector<uint8_t> back(32 * 1024, 0);

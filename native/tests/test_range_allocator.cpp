@@ -209,6 +209,73 @@ BTEST(RangeAllocator, SpilloverToFallbackClassWhenPreferredFull) {
   BT_EXPECT(res.value().stats.required_spillover);
 }
 
+BTEST(RangeAllocator, RestrictToPreferredForbidsSpillover) {
+  RangeAllocator ra;
+  PoolMap pools;
+  pools["hbm"] = make_pool("hbm", "n0", 8 * 1024, StorageClass::HBM_TPU);
+  pools["dram"] = make_pool("dram", "n1", 1 << 20, StorageClass::RAM_CPU);
+  auto req = make_request("obj", 64 * 1024, 1, 1);
+  req.preferred_classes = {StorageClass::HBM_TPU};
+  req.restrict_to_preferred = true;
+  BT_EXPECT(ra.allocate(req, pools).error() == ErrorCode::INSUFFICIENT_SPACE);
+
+  // Same request fits when restricted to the class that has room.
+  req.preferred_classes = {StorageClass::RAM_CPU};
+  auto res = ra.allocate(req, pools);
+  BT_ASSERT_OK(res);
+  BT_EXPECT_EQ(res.value().copies[0].shards[0].pool_id, "dram");
+}
+
+BTEST(RangeAllocator, ExcludedNodesNeverSelected) {
+  RangeAllocator ra;
+  PoolMap pools = six_pools();
+  auto req = make_request("obj", 256 * 1024, 1, 6);
+  req.excluded_nodes = {"node-0", "node-1"};
+  auto res = ra.allocate(req, pools);
+  BT_ASSERT_OK(res);
+  for (const auto& copy : res.value().copies) {
+    for (const auto& shard : copy.shards) {
+      BT_EXPECT_NE(shard.worker_id, "node-0");
+      BT_EXPECT_NE(shard.worker_id, "node-1");
+    }
+  }
+  // Excluding every node leaves nothing.
+  req.excluded_nodes = {"node-0", "node-1", "node-2", "node-3", "node-4", "node-5"};
+  BT_EXPECT(ra.allocate(req, pools).error() == ErrorCode::INSUFFICIENT_SPACE);
+}
+
+BTEST(RangeAllocator, RenameMergeAndPoolRangeRemoval) {
+  RangeAllocator ra;
+  PoolMap pools = six_pools();
+  BT_ASSERT_OK(ra.allocate(make_request("a", 64 * 1024, 1, 1), pools));
+  BT_ASSERT_OK(ra.allocate(make_request("b", 64 * 1024, 1, 1), pools));
+
+  // Rename: "a" -> "c"; old key is gone, new key frees cleanly.
+  BT_EXPECT(ra.rename_object("a", "c") == ErrorCode::OK);
+  BT_EXPECT(ra.rename_object("a", "d") == ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT(ra.rename_object("b", "c") == ErrorCode::OBJECT_ALREADY_EXISTS);
+  BT_EXPECT(ra.free("a") == ErrorCode::OBJECT_NOT_FOUND);
+
+  // Merge: "b" folds into "c"; freeing "c" returns all the space.
+  const auto before = ra.get_stats(std::nullopt).total_free_bytes;
+  BT_EXPECT(ra.merge_objects("b", "c") == ErrorCode::OK);
+  BT_EXPECT(ra.merge_objects("b", "c") == ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT(ra.free("c") == ErrorCode::OK);
+  BT_EXPECT_EQ(ra.get_stats(std::nullopt).total_free_bytes, before + 2 * 64 * 1024);
+
+  // remove_pool_ranges drops only the named pool's entries: the later free
+  // must not return that pool's bytes (its pool left the cluster).
+  auto striped = ra.allocate(make_request("s", 128 * 1024, 1, 2), pools);
+  BT_ASSERT_OK(striped);
+  BT_ASSERT(striped.value().copies[0].shards.size() == 2);
+  const auto dead_pool = striped.value().copies[0].shards[0].pool_id;
+  ra.remove_pool_ranges("s", dead_pool);
+  ra.forget_pool(dead_pool);
+  const auto mid = ra.get_stats(std::nullopt).total_free_bytes;
+  BT_EXPECT(ra.free("s") == ErrorCode::OK);
+  BT_EXPECT_EQ(ra.get_stats(std::nullopt).total_free_bytes, mid + 64 * 1024);
+}
+
 BTEST(RangeAllocator, NodeLocalityPinsAllocation) {
   RangeAllocator ra;
   auto pools = six_pools();

@@ -48,7 +48,9 @@ int main(int argc, char** argv) {
   if (positional.size() > 1) key = positional[1];
 
   client::ClientOptions options;
-  options.keystone_address = keystone;
+  // --keystone accepts a comma-separated endpoint list: first is the
+  // primary, the rest are HA fallbacks.
+  options.set_keystone_endpoints(keystone);
   client::ObjectClient client(options);
   if (client.connect() != ErrorCode::OK) {
     std::fprintf(stderr, "bb-client: cannot reach keystone at %s\n", keystone.c_str());

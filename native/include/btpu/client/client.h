@@ -25,8 +25,20 @@ namespace btpu::client {
 
 struct ClientOptions {
   std::string keystone_address;   // "host:port"
+  // HA: additional keystone endpoints. When a call fails with NOT_LEADER
+  // (sent to a standby) or — for idempotent calls — a connection error
+  // (leader died), the client rotates through keystone_address + fallbacks
+  // and retries once per endpoint until it finds the active leader.
+  // Mutations are NOT retried after connection errors: the request may have
+  // executed before the reply was lost, and re-running it would misreport
+  // (e.g. a succeeded remove coming back OBJECT_NOT_FOUND).
+  std::vector<std::string> keystone_fallbacks;
   size_t io_parallelism{8};       // concurrent shard transfers
   WorkerConfig default_config;    // placement policy defaults for put()
+
+  // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
+  // (empty segments are skipped).
+  void set_keystone_endpoints(const std::string& list);
 };
 
 class ObjectClient {
@@ -59,8 +71,38 @@ class ObjectClient {
   ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);
   ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);
 
+  static bool is_connection_error(ErrorCode ec) noexcept {
+    return ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CONNECTION_FAILED ||
+           ec == ErrorCode::CLIENT_DISCONNECTED;
+  }
+  static ErrorCode error_of(ErrorCode ec) noexcept { return ec; }
+  template <typename T>
+  static ErrorCode error_of(const Result<T>& r) noexcept {
+    return r.ok() ? ErrorCode::OK : r.error();
+  }
+  // Points rpc_ at the next configured keystone endpoint.
+  void rotate_keystone();
+  // Runs `fn(rpc client)`, rotating through the configured endpoints and
+  // retrying once per endpoint. NOT_LEADER always retries (the standby
+  // provably did not execute the call). Connection errors retry only when
+  // `idempotent`: a lost reply leaves a mutation's outcome unknown.
+  template <typename Fn>
+  auto rpc_failover(bool idempotent, Fn&& fn) {
+    auto result = fn(*rpc_);
+    auto should_retry = [&](ErrorCode ec) {
+      return ec == ErrorCode::NOT_LEADER || (idempotent && is_connection_error(ec));
+    };
+    const size_t endpoints = 1 + options_.keystone_fallbacks.size();
+    for (size_t i = 0; i + 1 < endpoints && should_retry(error_of(result)); ++i) {
+      rotate_keystone();
+      result = fn(*rpc_);
+    }
+    return result;
+  }
+
   ClientOptions options_;
   std::unique_ptr<rpc::KeystoneRpcClient> rpc_;
+  size_t keystone_index_{0};  // into [keystone_address] + keystone_fallbacks
   keystone::KeystoneService* embedded_{nullptr};
   std::unique_ptr<transport::TransportClient> data_;
 };

@@ -61,6 +61,7 @@ enum class ErrorCode : uint32_t {
   COORD_WATCH_ERROR,
   LEADER_ELECTION_FAILED,
   SERVICE_REGISTRATION_FAILED,
+  NOT_LEADER,  // mutation sent to a standby keystone; retry against the leader
 
   // Data (5000-5999)
   OBJECT_NOT_FOUND = domain_base(Domain::DATA),

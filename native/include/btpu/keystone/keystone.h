@@ -151,9 +151,22 @@ class KeystoneService {
   // to the coordinator and replayed (with allocator range adoption) on boot.
   void persist_object(const ObjectKey& key, const ObjectInfo& info);
   void unpersist_object(const ObjectKey& key);
+  // Installs/replaces the local view of one persisted object record (map
+  // entry + allocator ranges). Standbys mirror the leader's writes through
+  // this; boot replay and promotion reconcile reuse it. Returns false when
+  // the record is undecodable or no copy maps onto live pools.
+  bool apply_object_record(const ObjectKey& key, const std::string& bytes);
+  // Removes the local view of one object (map entry + allocator ranges)
+  // without touching coordinator state — the mirror of the leader's delete.
+  void drop_object_locally(const ObjectKey& key);
+  // Leadership transition: standby -> leader re-reads every persisted record
+  // so writes that raced the promotion are not lost, and drops local entries
+  // whose records are gone.
+  void on_promoted();
   void on_heartbeat_event(const coord::WatchEvent& ev);
   void on_worker_event(const coord::WatchEvent& ev);
   void on_pool_event(const coord::WatchEvent& ev);
+  void on_object_event(const coord::WatchEvent& ev);
   void cleanup_dead_worker(const NodeId& worker_id);
   void cleanup_stale_workers();
 

@@ -10,6 +10,25 @@
 
 namespace btpu::client {
 
+void ClientOptions::set_keystone_endpoints(const std::string& list) {
+  keystone_address.clear();
+  keystone_fallbacks.clear();
+  size_t pos = 0;
+  while (pos <= list.size()) {
+    const size_t next = list.find(',', pos);
+    const std::string part = list.substr(pos, next - pos);
+    if (!part.empty()) {
+      if (keystone_address.empty()) {
+        keystone_address = part;
+      } else {
+        keystone_fallbacks.push_back(part);
+      }
+    }
+    if (next == std::string::npos) break;
+    pos = next + 1;
+  }
+}
+
 ObjectClient::ObjectClient(ClientOptions options)
     : options_(std::move(options)), data_(transport::make_transport_client()) {
   rpc_ = std::make_unique<rpc::KeystoneRpcClient>(options_.keystone_address);
@@ -24,15 +43,36 @@ ObjectClient::~ObjectClient() = default;
 
 ErrorCode ObjectClient::connect() {
   if (embedded_) return ErrorCode::OK;
-  return rpc_->connect();
+  auto ec = rpc_->connect();
+  // Initial connect participates in failover too: the configured primary
+  // may already be a dead or standby keystone.
+  const size_t endpoints = 1 + options_.keystone_fallbacks.size();
+  for (size_t i = 0; i + 1 < endpoints && ec != ErrorCode::OK; ++i) {
+    rotate_keystone();
+    ec = rpc_->connect();
+  }
+  return ec;
+}
+
+void ObjectClient::rotate_keystone() {
+  const size_t endpoints = 1 + options_.keystone_fallbacks.size();
+  keystone_index_ = (keystone_index_ + 1) % endpoints;
+  const std::string& address = keystone_index_ == 0
+                                   ? options_.keystone_address
+                                   : options_.keystone_fallbacks[keystone_index_ - 1];
+  LOG_WARN << "keystone failover: switching to " << address;
+  rpc_ = std::make_unique<rpc::KeystoneRpcClient>(address);
+  rpc_->connect();
 }
 
 Result<bool> ObjectClient::object_exists(const ObjectKey& key) {
-  return embedded_ ? embedded_->object_exists(key) : rpc_->object_exists(key);
+  if (embedded_) return embedded_->object_exists(key);
+  return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.object_exists(key); });
 }
 
 Result<std::vector<CopyPlacement>> ObjectClient::get_workers(const ObjectKey& key) {
-  return embedded_ ? embedded_->get_workers(key) : rpc_->get_workers(key);
+  if (embedded_) return embedded_->get_workers(key);
+  return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.get_workers(key); });
 }
 
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size) {
@@ -45,8 +85,11 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
   Result<std::vector<CopyPlacement>> placed = ErrorCode::INTERNAL_ERROR;
   {
     TRACE_SPAN("client.put.start_rpc");
-    placed = embedded_ ? embedded_->put_start(key, size, config)
-                       : rpc_->put_start(key, size, config);
+    placed = embedded_
+                 ? embedded_->put_start(key, size, config)
+                 : rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
+                     return r.put_start(key, size, config);
+                   });
   }
   if (!placed.ok()) return placed.error();
 
@@ -59,12 +102,15 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
       if (embedded_) {
         embedded_->put_cancel(key);
       } else {
-        rpc_->put_cancel(key);
+        rpc_failover(/*idempotent=*/false,
+                     [&](rpc::KeystoneRpcClient& r) { return r.put_cancel(key); });
       }
       return ec;
     }
   }
-  return embedded_ ? embedded_->put_complete(key) : rpc_->put_complete(key);
+  if (embedded_) return embedded_->put_complete(key);
+  return rpc_failover(/*idempotent=*/false,
+                      [&](rpc::KeystoneRpcClient& r) { return r.put_complete(key); });
 }
 
 Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
@@ -113,20 +159,26 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
 }
 
 ErrorCode ObjectClient::remove(const ObjectKey& key) {
-  return embedded_ ? embedded_->remove_object(key) : rpc_->remove_object(key);
+  if (embedded_) return embedded_->remove_object(key);
+  return rpc_failover(/*idempotent=*/false,
+                      [&](rpc::KeystoneRpcClient& r) { return r.remove_object(key); });
 }
 
 Result<uint64_t> ObjectClient::remove_all() {
-  return embedded_ ? embedded_->remove_all_objects() : rpc_->remove_all_objects();
+  if (embedded_) return embedded_->remove_all_objects();
+  return rpc_failover(/*idempotent=*/false,
+                      [&](rpc::KeystoneRpcClient& r) { return r.remove_all_objects(); });
 }
 
 Result<ClusterStats> ObjectClient::cluster_stats() {
-  return embedded_ ? embedded_->get_cluster_stats() : rpc_->get_cluster_stats();
+  if (embedded_) return embedded_->get_cluster_stats();
+  return rpc_failover(/*idempotent=*/true,
+                      [&](rpc::KeystoneRpcClient& r) { return r.get_cluster_stats(); });
 }
 
 Result<ViewVersionId> ObjectClient::ping() {
   if (embedded_) return embedded_->get_view_version();
-  return rpc_->ping();
+  return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.ping(); });
 }
 
 // One shard transfer; `buf` already points at the shard's slice of the

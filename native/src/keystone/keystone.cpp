@@ -1,6 +1,7 @@
 #include "btpu/keystone/keystone.h"
 
 #include <algorithm>
+#include <unordered_set>
 
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
@@ -161,7 +162,11 @@ int64_t KeystoneService::now_wall_ms() const {
 
 ErrorCode KeystoneService::initialize() {
   BTPU_RETURN_IF_ERROR(config_.validate());
-  if (coordinator_) BTPU_RETURN_IF_ERROR(setup_coordinator_integration());
+  if (coordinator_) {
+    BTPU_RETURN_IF_ERROR(setup_coordinator_integration());
+  } else {
+    is_leader_ = true;  // pure in-process mode: sole keystone by definition
+  }
   LOG_INFO << "keystone " << service_id_ << " initialized (cluster " << config_.cluster_id
            << ", coordinator " << (coordinator_ ? "attached" : "none") << ")";
   return ErrorCode::OK;
@@ -185,11 +190,27 @@ ErrorCode KeystoneService::setup_coordinator_integration() {
                                        watch(&KeystoneService::on_heartbeat_event));
   if (!w1.ok() || !w2.ok() || !w3.ok()) return ErrorCode::COORD_WATCH_ERROR;
   watch_ids_ = {w1.value(), w2.value(), w3.value()};
+  if (config_.persist_objects) {
+    // Standbys mirror the leader's object writes so a promotion starts from
+    // a warm, near-current map instead of a cold replay.
+    auto w4 = coordinator_->watch_prefix(coord::objects_prefix(config_.cluster_id),
+                                         watch(&KeystoneService::on_object_event));
+    if (!w4.ok()) return ErrorCode::COORD_WATCH_ERROR;
+    watch_ids_.push_back(w4.value());
+  }
 
   if (config_.enable_ha) {
     coordinator_->campaign("btpu-keystone-leader/" + config_.cluster_id, service_id_,
                            config_.service_registration_ttl_sec * 1000,
                            [this](bool leader) {
+                             const bool was = is_leader_.load();
+                             if (leader && !was) {
+                               // Reconcile BEFORE accepting mutations: while
+                               // is_leader_ is still false, every put_start
+                               // is rejected with NOT_LEADER, so the stale
+                               // scan cannot race an in-flight allocation.
+                               on_promoted();
+                             }
                              is_leader_ = leader;
                              LOG_INFO << "keystone " << service_id_
                                       << (leader ? " became leader" : " is standby");
@@ -254,71 +275,150 @@ void KeystoneService::load_persisted_objects() {
   auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
   if (!records.ok()) return;
   const auto prefix = coord::objects_prefix(config_.cluster_id);
+  size_t restored = 0, dropped = 0;
+  for (const auto& kv : records.value()) {
+    if (kv.key.size() <= prefix.size()) continue;
+    const ObjectKey key = kv.key.substr(prefix.size());
+    if (apply_object_record(key, kv.value)) {
+      ++restored;
+    } else {
+      // Undecodable/unmappable records are garbage; deleting them is
+      // idempotent and safe from any keystone (leadership is not resolved
+      // yet at boot), and leaving them would re-drop them every restart.
+      coordinator_->del(kv.key);
+      ++dropped;
+    }
+  }
+  if (restored || dropped) {
+    LOG_INFO << "restored " << restored << " persisted objects (" << dropped << " dropped)";
+  }
+}
+
+bool KeystoneService::apply_object_record(const ObjectKey& key, const std::string& bytes) {
+  ObjectRecord rec;
+  if (!decode_object_record(bytes, rec)) return false;
   alloc::PoolMap pools_snapshot;
   {
     std::shared_lock lock(registry_mutex_);
     pools_snapshot = pools_;
   }
+  // Keep only copies whose every shard still maps onto a live pool.
+  std::vector<CopyPlacement> live_copies;
+  std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
+  for (const auto& copy : rec.copies) {
+    std::vector<std::pair<MemoryPoolId, alloc::Range>> copy_ranges;
+    bool ok = true;
+    for (const auto& shard : copy.shards) {
+      auto mapped = shard_to_range(shard, pools_snapshot);
+      if (!mapped) {
+        ok = false;
+        break;
+      }
+      copy_ranges.push_back(std::move(*mapped));
+    }
+    if (ok) {
+      live_copies.push_back(copy);
+      ranges.insert(ranges.end(), copy_ranges.begin(), copy_ranges.end());
+    }
+  }
+  if (live_copies.empty()) return false;
+
+  std::unique_lock lock(objects_mutex_);
+  std::optional<ObjectInfo> previous;
+  if (auto it = objects_.find(key); it != objects_.end()) {
+    // Replace semantics: the record wins. The old ranges must be freed
+    // before adopting the new ones (records usually reuse most of them).
+    previous = std::move(it->second);
+    adapter_.free_object(key);
+    objects_.erase(it);
+  }
+  if (adapter_.adopt_allocation(key, ranges, pools_snapshot) != ErrorCode::OK) {
+    // Put the previous (still valid) state back rather than silently
+    // destroying a serveable object over a transient adoption failure.
+    if (previous) {
+      std::vector<std::pair<MemoryPoolId, alloc::Range>> old_ranges;
+      bool ok = true;
+      for (const auto& copy : previous->copies) {
+        for (const auto& shard : copy.shards) {
+          auto mapped = shard_to_range(shard, pools_snapshot);
+          if (!mapped) {
+            ok = false;
+            break;
+          }
+          old_ranges.push_back(std::move(*mapped));
+        }
+        if (!ok) break;
+      }
+      if (ok && adapter_.adopt_allocation(key, old_ranges, pools_snapshot) == ErrorCode::OK) {
+        objects_[key] = std::move(*previous);
+      } else {
+        LOG_ERROR << "object " << key << " lost during record re-apply";
+        bump_view();
+      }
+    }
+    return false;
+  }
   const auto steady_now = std::chrono::steady_clock::now();
   const int64_t wall_now = now_wall_ms();
-  size_t restored = 0, dropped = 0;
+  ObjectInfo info;
+  info.size = rec.size;
+  info.ttl_ms = rec.ttl_ms;
+  info.soft_pin = rec.soft_pin;
+  info.state = static_cast<ObjectState>(rec.state);
+  info.config = rec.config;
+  info.copies = std::move(live_copies);
+  auto from_wall = [&](int64_t wall_ms) {
+    return steady_now - std::chrono::milliseconds(std::max<int64_t>(0, wall_now - wall_ms));
+  };
+  info.created_at = from_wall(rec.created_wall_ms);
+  info.last_access = from_wall(rec.last_access_wall_ms);
+  info.epoch = next_epoch_.fetch_add(1);
+  objects_[key] = std::move(info);
+  bump_view();
+  return true;
+}
+
+void KeystoneService::drop_object_locally(const ObjectKey& key) {
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end()) return;
+  adapter_.free_object(key);
+  objects_.erase(it);
+  bump_view();
+}
+
+// Standby -> leader: the promoted keystone re-reads every persisted record so
+// writes that raced the promotion are not lost, and drops local entries whose
+// records are gone (removed by the old leader after our mirror applied them).
+void KeystoneService::on_promoted() {
+  if (!coordinator_ || !config_.persist_objects) return;
+  auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
+  if (!records.ok()) return;
+  const auto prefix = coord::objects_prefix(config_.cluster_id);
+  std::unordered_set<ObjectKey> persisted;
   for (const auto& kv : records.value()) {
     if (kv.key.size() <= prefix.size()) continue;
     const ObjectKey key = kv.key.substr(prefix.size());
-    ObjectRecord rec;
-    if (!decode_object_record(kv.value, rec)) {
+    if (apply_object_record(key, kv.value)) {
+      persisted.insert(key);
+    } else {
+      // Unserveable record (e.g. every copy on pools that died with the old
+      // leader): keeping a local entry would hand clients dead placements,
+      // and keeping the record would resurrect it on the next promotion.
+      drop_object_locally(key);
       coordinator_->del(kv.key);
-      ++dropped;
-      continue;
     }
-    // Keep only copies whose every shard still maps onto a live pool.
-    std::vector<CopyPlacement> live_copies;
-    std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
-    for (const auto& copy : rec.copies) {
-      std::vector<std::pair<MemoryPoolId, alloc::Range>> copy_ranges;
-      bool ok = true;
-      for (const auto& shard : copy.shards) {
-        auto mapped = shard_to_range(shard, pools_snapshot);
-        if (!mapped) {
-          ok = false;
-          break;
-        }
-        copy_ranges.push_back(std::move(*mapped));
-      }
-      if (ok) {
-        live_copies.push_back(copy);
-        ranges.insert(ranges.end(), copy_ranges.begin(), copy_ranges.end());
-      }
-    }
-    if (live_copies.empty() ||
-        adapter_.adopt_allocation(key, ranges, pools_snapshot) != ErrorCode::OK) {
-      coordinator_->del(kv.key);
-      ++dropped;
-      continue;
-    }
-    ObjectInfo info;
-    info.size = rec.size;
-    info.ttl_ms = rec.ttl_ms;
-    info.soft_pin = rec.soft_pin;
-    info.state = static_cast<ObjectState>(rec.state);
-    info.config = rec.config;
-    info.copies = std::move(live_copies);
-    auto from_wall = [&](int64_t wall_ms) {
-      return steady_now - std::chrono::milliseconds(std::max<int64_t>(0, wall_now - wall_ms));
-    };
-    info.created_at = from_wall(rec.created_wall_ms);
-    info.last_access = from_wall(rec.last_access_wall_ms);
-    info.epoch = next_epoch_.fetch_add(1);
-    {
-      std::unique_lock lock(objects_mutex_);
-      objects_[key] = std::move(info);
-    }
-    ++restored;
   }
-  if (restored || dropped) {
-    LOG_INFO << "restored " << restored << " persisted objects (" << dropped << " dropped)";
-    bump_view();
+  std::vector<ObjectKey> stale;
+  {
+    std::shared_lock lock(objects_mutex_);
+    for (const auto& [key, info] : objects_) {
+      if (!persisted.contains(key)) stale.push_back(key);
+    }
   }
+  for (const auto& key : stale) drop_object_locally(key);
+  LOG_INFO << "promoted: reconciled " << persisted.size() << " objects, dropped "
+           << stale.size() << " stale";
 }
 
 ErrorCode KeystoneService::start() {
@@ -330,14 +430,22 @@ ErrorCode KeystoneService::start() {
 }
 
 void KeystoneService::stop() {
-  if (!running_.exchange(false)) return;
-  stop_cv_.notify_all();
-  for (auto* t : {&gc_thread_, &health_thread_, &keepalive_thread_}) {
-    if (t->joinable()) t->join();
+  if (running_.exchange(false)) {
+    stop_cv_.notify_all();
+    for (auto* t : {&gc_thread_, &health_thread_, &keepalive_thread_}) {
+      if (t->joinable()) t->join();
+    }
   }
-  if (coordinator_) {
+  // Coordinator teardown is independent of the thread state: an initialized
+  // keystone holds watches and (under HA) possibly the leadership whether or
+  // not start() ever ran, and both must be released exactly once.
+  if (coordinator_ && !watch_ids_.empty()) {
     for (auto id : watch_ids_) coordinator_->unwatch(id);
     watch_ids_.clear();
+    if (config_.enable_ha) {
+      coordinator_->resign("btpu-keystone-leader/" + config_.cluster_id, service_id_);
+      is_leader_ = false;
+    }
     coordinator_->unregister_service("btpu-keystone", service_id_);
   }
 }
@@ -382,6 +490,7 @@ void KeystoneService::keepalive_loop() {
 }
 
 void KeystoneService::run_gc_once() {
+  if (!is_leader_.load()) return;  // the leader owns the object lifecycle
   const auto now = std::chrono::steady_clock::now();
   std::vector<ObjectKey> expired;
   {
@@ -404,6 +513,7 @@ void KeystoneService::run_gc_once() {
 }
 
 void KeystoneService::run_health_check_once() {
+  if (!is_leader_.load()) return;  // the leader owns eviction/demotion/repair
   cleanup_stale_workers();
   evict_for_pressure();
 }
@@ -433,6 +543,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   // it could collide with an in-flight staging allocation.
   if (key.find('\x01') != ObjectKey::npos) return ErrorCode::INVALID_KEY;
   if (size == 0) return ErrorCode::INVALID_PARAMETERS;
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
 
   WorkerConfig effective = config;
   if (effective.replication_factor == 0)
@@ -473,6 +584,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
 }
 
 ErrorCode KeystoneService::put_complete(const ObjectKey& key) {
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
@@ -484,6 +596,7 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key) {
 }
 
 ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
@@ -496,6 +609,7 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
 }
 
 ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
@@ -508,6 +622,7 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
 }
 
 Result<uint64_t> KeystoneService::remove_all_objects() {
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
   const uint64_t count = objects_.size();
   for (auto& [key, info] : objects_) {
@@ -654,6 +769,20 @@ void KeystoneService::on_pool_event(const WatchEvent& ev) {
   }
 }
 
+void KeystoneService::on_object_event(const WatchEvent& ev) {
+  // The leader's own writes echo back through this watch; its in-memory map
+  // is the source of truth, so only standbys apply the mirror.
+  if (is_leader_.load()) return;
+  const auto prefix = coord::objects_prefix(config_.cluster_id);
+  if (ev.key.size() <= prefix.size()) return;
+  const ObjectKey key = ev.key.substr(prefix.size());
+  if (ev.type == WatchEvent::Type::kPut) {
+    apply_object_record(key, ev.value);
+  } else {
+    drop_object_locally(key);
+  }
+}
+
 void KeystoneService::on_heartbeat_event(const WatchEvent& ev) {
   // Key layout: <heartbeat_prefix><worker_id>
   const auto prefix = coord::heartbeat_prefix(config_.cluster_id);
@@ -704,7 +833,10 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
   for (const auto& pool_id : dead_pools) adapter_.forget_pool(pool_id);
   ++counters_.workers_lost;
 
-  if (coordinator_) {
+  // Registry-local cleanup runs on every keystone (each one watches the
+  // heartbeat prefix); coordinator-state deletion and repair are the
+  // leader's job — a standby mutating either would race the leader.
+  if (coordinator_ && is_leader_.load()) {
     coordinator_->del(coord::worker_key(config_.cluster_id, worker_id));
     for (const auto& pool_id : dead_pools)
       coordinator_->del(coord::pool_key(config_.cluster_id, worker_id, pool_id));
@@ -713,7 +845,7 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
   bump_view();
   LOG_WARN << "worker " << worker_id << " removed (" << dead_pools.size() << " pools)";
 
-  if (config_.enable_repair) {
+  if (config_.enable_repair && is_leader_.load()) {
     const size_t repaired = repair_objects_for_dead_worker(worker_id);
     if (repaired) {
       LOG_INFO << "repaired " << repaired << " objects after losing " << worker_id;

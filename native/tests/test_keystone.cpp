@@ -401,6 +401,85 @@ BTEST(Keystone, CoordinatorRegistryAndHeartbeatDeath) {
   ks.stop();
 }
 
+BTEST(Keystone, HaStandbyMirrorsObjectsAndTakesOverOnLeaderDeath) {
+  // Two keystones share one coordinator. The leader serves all mutations and
+  // persists object records; the standby rejects mutations with NOT_LEADER
+  // while mirroring the records. When the leader resigns, the standby is
+  // promoted, reconciles, and serves the same objects.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  cfg.enable_ha = true;
+  cfg.service_id = "ks-a";
+  auto ks_a = std::make_unique<KeystoneService>(cfg, coordinator);
+  BT_ASSERT(ks_a->initialize() == ErrorCode::OK);
+  cfg.service_id = "ks-b";
+  KeystoneService ks_b(cfg, coordinator);
+  BT_ASSERT(ks_b.initialize() == ErrorCode::OK);
+  BT_EXPECT(ks_a->is_leader());
+  BT_EXPECT(!ks_b.is_leader());
+
+  // Worker advertises through the coordinator so BOTH keystones mirror it.
+  FakeWorker w1("w1", 1 << 20);
+  const auto cluster = cfg.cluster_id;
+  coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info()));
+  coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool));
+  BT_ASSERT(eventually([&] { return !ks_a->memory_pools().empty(); }));
+  BT_ASSERT(eventually([&] { return !ks_b.memory_pools().empty(); }));
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+
+  // Standby refuses the whole mutation surface.
+  BT_EXPECT(ks_b.put_start("ha/obj", 4096, wc).error() == ErrorCode::NOT_LEADER);
+  BT_EXPECT(ks_b.remove_object("ha/obj") == ErrorCode::NOT_LEADER);
+
+  // Leader accepts: write real bytes so the takeover can be read back.
+  auto placed = ks_a->put_start("ha/obj", 4096, wc);
+  BT_ASSERT_OK(placed);
+  auto client = transport::make_transport_client();
+  std::vector<uint8_t> payload(4096);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 11 + 3);
+  {
+    uint64_t off = 0;
+    for (const auto& shard : placed.value()[0].shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
+                              shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+  }
+  BT_EXPECT(ks_a->put_complete("ha/obj") == ErrorCode::OK);
+
+  // Standby mirrors the persisted record (watch-driven).
+  BT_EXPECT(eventually([&] { return ks_b.object_exists("ha/obj").value(); }));
+
+  // Leader dies; standby is promoted and still serves the object.
+  ks_a->stop();
+  ks_a.reset();
+  BT_ASSERT(eventually([&] { return ks_b.is_leader(); }));
+  auto got = ks_b.get_workers("ha/obj");
+  BT_ASSERT_OK(got);
+  std::vector<uint8_t> back(4096, 0);
+  uint64_t off = 0;
+  for (const auto& shard : got.value()[0].shards) {
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    BT_ASSERT(client->read(shard.remote, mem.remote_addr, mem.rkey, back.data() + off,
+                           shard.length) == ErrorCode::OK);
+    off += shard.length;
+  }
+  BT_EXPECT(std::memcmp(back.data(), payload.data(), payload.size()) == 0);
+
+  // The new leader owns the mutation surface: fresh puts and removes work,
+  // and its allocator adopted the mirrored ranges (no double-allocation).
+  BT_ASSERT_OK(ks_b.put_start("ha/obj2", 4096, wc));
+  BT_EXPECT(ks_b.put_complete("ha/obj2") == ErrorCode::OK);
+  BT_EXPECT(ks_b.remove_object("ha/obj") == ErrorCode::OK);
+  auto stats = ks_b.get_cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().used_capacity, 4096ull);
+}
+
 BTEST(Keystone, BootReplayFromCoordinator) {
   auto coordinator = std::make_shared<coord::MemCoordinator>();
   FakeWorker w1("w1", 1 << 20);

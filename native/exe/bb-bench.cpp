@@ -109,9 +109,9 @@ int main(int argc, char** argv) {
     client_ptr = cluster->make_client();
   } else if (!keystone.empty()) {
     client::ClientOptions options;
-    // --keystone accepts a comma-separated endpoint list: first is the
-  // primary, the rest are HA fallbacks.
-  options.set_keystone_endpoints(keystone);
+      // --keystone accepts a comma-separated endpoint list: first is the
+    // primary, the rest are HA fallbacks.
+    options.set_keystone_endpoints(keystone);
     client_ptr = std::make_unique<client::ObjectClient>(options);
     if (client_ptr->connect() != ErrorCode::OK) {
       std::fprintf(stderr, "cannot reach keystone at %s\n", keystone.c_str());

@@ -30,6 +30,7 @@ enum class Op : uint8_t {
   kCurrentLeader = 16, // call channel
   kHello = 17,         // opens a channel: {u8 kind: 0=call, 1=event}
   kPing = 18,
+  kCampaignKeepalive = 19,  // event or call channel: {election, candidate_id}
 };
 
 }  // namespace btpu::coord

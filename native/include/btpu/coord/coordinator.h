@@ -76,6 +76,11 @@ class Coordinator {
   virtual ErrorCode campaign(const std::string& election, const std::string& candidate_id,
                              int64_t lease_ttl_ms, std::function<void(bool is_leader)> cb) = 0;
   virtual ErrorCode resign(const std::string& election, const std::string& candidate_id) = 0;
+  // Refreshes the candidate's election lease. A candidate (leader or
+  // standby) that stops calling this within its lease TTL is treated as
+  // dead and removed from the election — the liveness half of failover.
+  virtual ErrorCode campaign_keepalive(const std::string& election,
+                                       const std::string& candidate_id) = 0;
   virtual Result<std::string> current_leader(const std::string& election) = 0;
 
   virtual bool connected() const = 0;

@@ -46,6 +46,8 @@ class RemoteCoordinator : public Coordinator {
   ErrorCode campaign(const std::string& election, const std::string& candidate_id,
                      int64_t lease_ttl_ms, std::function<void(bool)> cb) override;
   ErrorCode resign(const std::string& election, const std::string& candidate_id) override;
+  ErrorCode campaign_keepalive(const std::string& election,
+                               const std::string& candidate_id) override;
   Result<std::string> current_leader(const std::string& election) override;
 
   bool connected() const override { return connected_.load(); }

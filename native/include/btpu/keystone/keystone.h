@@ -142,6 +142,7 @@ class KeystoneService {
   void health_loop();
   void keepalive_loop();
   void bump_view() noexcept { view_version_.fetch_add(1); }
+  std::string election_name() const { return "btpu-keystone-leader/" + config_.cluster_id; }
   int64_t now_wall_ms() const;
 
   ErrorCode setup_coordinator_integration();
@@ -153,16 +154,27 @@ class KeystoneService {
   void unpersist_object(const ObjectKey& key);
   // Installs/replaces the local view of one persisted object record (map
   // entry + allocator ranges). Standbys mirror the leader's writes through
-  // this; boot replay and promotion reconcile reuse it. Returns false when
-  // the record is undecodable or no copy maps onto live pools.
-  bool apply_object_record(const ObjectKey& key, const std::string& bytes);
+  // this; boot replay and promotion reconcile reuse it. kGarbage = the
+  // record is undecodable (safe to purge from the coordinator); kFailed = a
+  // transient local condition (no live pools yet, range conflict) — the
+  // durable record must be kept so a retry can succeed.
+  enum class ApplyResult { kApplied, kGarbage, kFailed };
+  ApplyResult apply_object_record(const ObjectKey& key, const std::string& bytes,
+                                  const alloc::PoolMap& pools);
   // Removes the local view of one object (map entry + allocator ranges)
   // without touching coordinator state — the mirror of the leader's delete.
   void drop_object_locally(const ObjectKey& key);
+  // Registers this keystone as an election candidate; re-invoked (back of
+  // the queue) when a promotion has to be refused.
+  ErrorCode start_campaign();
   // Leadership transition: standby -> leader re-reads every persisted record
   // so writes that raced the promotion are not lost, and drops local entries
-  // whose records are gone.
-  void on_promoted();
+  // whose records are gone. Returns false when the coordinator cannot be
+  // read even after retries — the caller must refuse leadership.
+  bool on_promoted();
+  // Leader -> standby: drop never-persisted pending objects staged by our
+  // own put_starts so their ranges don't linger and fight the mirror.
+  void on_demoted();
   void on_heartbeat_event(const coord::WatchEvent& ev);
   void on_worker_event(const coord::WatchEvent& ev);
   void on_pool_event(const coord::WatchEvent& ev);

@@ -260,6 +260,15 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
         std::erase(campaigns, std::make_pair(election, candidate));
         break;
       }
+      case Op::kCampaignKeepalive: {
+        std::string election, candidate;
+        if (!wire::decode_fields(r, election, candidate)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        w.put(store_.campaign_keepalive(election, candidate));
+        break;
+      }
       default:
         w.put(ErrorCode::NOT_IMPLEMENTED);
         break;

@@ -283,6 +283,21 @@ ErrorCode MemCoordinator::resign(const std::string& election, const std::string&
   return ErrorCode::OK;
 }
 
+ErrorCode MemCoordinator::campaign_keepalive(const std::string& election,
+                                             const std::string& candidate_id) {
+  LeaseId lease = 0;
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = elections_.find(election);
+    if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+    auto me = std::find_if(it->second.begin(), it->second.end(),
+                           [&](const Candidate& c) { return c.id == candidate_id; });
+    if (me == it->second.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+    lease = me->lease;
+  }
+  return lease_keepalive(lease);
+}
+
 Result<std::string> MemCoordinator::current_leader(const std::string& election) {
   std::lock_guard<std::mutex> lock(mutex_);
   auto it = elections_.find(election);

@@ -330,6 +330,19 @@ ErrorCode RemoteCoordinator::resign(const std::string& election,
   return ec;
 }
 
+ErrorCode RemoteCoordinator::campaign_keepalive(const std::string& election,
+                                                const std::string& candidate_id) {
+  Writer w;
+  wire::encode_fields(w, election, candidate_id);
+  std::vector<uint8_t> resp;
+  auto ec = event_call(static_cast<uint8_t>(Op::kCampaignKeepalive), w.buffer(), resp);
+  if (ec == ErrorCode::OK) {
+    Reader r(resp);
+    ec = take_status(r);
+  }
+  return ec;
+}
+
 Result<std::string> RemoteCoordinator::current_leader(const std::string& election) {
   Writer w;
   wire::encode(w, election);

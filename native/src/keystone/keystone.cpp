@@ -137,6 +137,20 @@ std::optional<std::pair<MemoryPoolId, alloc::Range>> shard_to_range(
   }
   return std::nullopt;
 }
+
+// All-or-nothing mapping of every shard of `copies` onto (pool, range) pairs.
+std::optional<std::vector<std::pair<MemoryPoolId, alloc::Range>>> map_copies_to_ranges(
+    const std::vector<CopyPlacement>& copies, const alloc::PoolMap& pools) {
+  std::vector<std::pair<MemoryPoolId, alloc::Range>> out;
+  for (const auto& copy : copies) {
+    for (const auto& shard : copy.shards) {
+      auto mapped = shard_to_range(shard, pools);
+      if (!mapped) return std::nullopt;
+      out.push_back(std::move(*mapped));
+    }
+  }
+  return out;
+}
 }  // namespace
 
 // ---- lifecycle ------------------------------------------------------------
@@ -200,25 +214,38 @@ ErrorCode KeystoneService::setup_coordinator_integration() {
   }
 
   if (config_.enable_ha) {
-    coordinator_->campaign("btpu-keystone-leader/" + config_.cluster_id, service_id_,
-                           config_.service_registration_ttl_sec * 1000,
-                           [this](bool leader) {
-                             const bool was = is_leader_.load();
-                             if (leader && !was) {
-                               // Reconcile BEFORE accepting mutations: while
-                               // is_leader_ is still false, every put_start
-                               // is rejected with NOT_LEADER, so the stale
-                               // scan cannot race an in-flight allocation.
-                               on_promoted();
-                             }
-                             is_leader_ = leader;
-                             LOG_INFO << "keystone " << service_id_
-                                      << (leader ? " became leader" : " is standby");
-                           });
+    BTPU_RETURN_IF_ERROR(start_campaign());
   } else {
     is_leader_ = true;
   }
   return ErrorCode::OK;
+}
+
+ErrorCode KeystoneService::start_campaign() {
+  return coordinator_->campaign(
+      election_name(), service_id_, config_.service_registration_ttl_sec * 1000,
+      [this](bool leader) {
+        const bool was = is_leader_.load();
+        if (leader && !was) {
+          // Reconcile BEFORE accepting mutations: while is_leader_ is still
+          // false, every put_start is rejected with NOT_LEADER, so the stale
+          // scan cannot race an in-flight allocation.
+          if (!on_promoted()) {
+            LOG_ERROR << "refusing leadership (reconcile failed); re-campaigning";
+            coordinator_->resign(election_name(), service_id_);
+            std::this_thread::sleep_for(std::chrono::milliseconds(100));
+            start_campaign();  // back of the queue; another candidate may win
+            return;
+          }
+        }
+        if (!leader && was) {
+          is_leader_ = false;
+          on_demoted();
+        }
+        is_leader_ = leader;
+        LOG_INFO << "keystone " << service_id_
+                 << (leader ? " became leader" : " is standby");
+      });
 }
 
 // Boot-time replay of workers + pools (reference keystone_service.cpp:909-945).
@@ -275,18 +302,30 @@ void KeystoneService::load_persisted_objects() {
   auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
   if (!records.ok()) return;
   const auto prefix = coord::objects_prefix(config_.cluster_id);
+  alloc::PoolMap pools_snapshot;
+  {
+    std::shared_lock lock(registry_mutex_);
+    pools_snapshot = pools_;
+  }
   size_t restored = 0, dropped = 0;
   for (const auto& kv : records.value()) {
     if (kv.key.size() <= prefix.size()) continue;
     const ObjectKey key = kv.key.substr(prefix.size());
-    if (apply_object_record(key, kv.value)) {
-      ++restored;
-    } else {
-      // Undecodable/unmappable records are garbage; deleting them is
-      // idempotent and safe from any keystone (leadership is not resolved
-      // yet at boot), and leaving them would re-drop them every restart.
-      coordinator_->del(kv.key);
-      ++dropped;
+    switch (apply_object_record(key, kv.value, pools_snapshot)) {
+      case ApplyResult::kApplied:
+        ++restored;
+        break;
+      case ApplyResult::kGarbage:
+        // Undecodable records are purged; deleting garbage is idempotent and
+        // safe from any keystone (leadership is not resolved yet at boot).
+        coordinator_->del(kv.key);
+        ++dropped;
+        break;
+      case ApplyResult::kFailed:
+        // Transient (e.g. pools not yet advertised): keep the durable
+        // record — a later reconcile can still resurrect the object.
+        ++dropped;
+        break;
     }
   }
   if (restored || dropped) {
@@ -294,34 +333,20 @@ void KeystoneService::load_persisted_objects() {
   }
 }
 
-bool KeystoneService::apply_object_record(const ObjectKey& key, const std::string& bytes) {
+KeystoneService::ApplyResult KeystoneService::apply_object_record(
+    const ObjectKey& key, const std::string& bytes, const alloc::PoolMap& pools) {
   ObjectRecord rec;
-  if (!decode_object_record(bytes, rec)) return false;
-  alloc::PoolMap pools_snapshot;
-  {
-    std::shared_lock lock(registry_mutex_);
-    pools_snapshot = pools_;
-  }
+  if (!decode_object_record(bytes, rec)) return ApplyResult::kGarbage;
   // Keep only copies whose every shard still maps onto a live pool.
   std::vector<CopyPlacement> live_copies;
   std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
   for (const auto& copy : rec.copies) {
-    std::vector<std::pair<MemoryPoolId, alloc::Range>> copy_ranges;
-    bool ok = true;
-    for (const auto& shard : copy.shards) {
-      auto mapped = shard_to_range(shard, pools_snapshot);
-      if (!mapped) {
-        ok = false;
-        break;
-      }
-      copy_ranges.push_back(std::move(*mapped));
-    }
-    if (ok) {
+    if (auto copy_ranges = map_copies_to_ranges({copy}, pools)) {
       live_copies.push_back(copy);
-      ranges.insert(ranges.end(), copy_ranges.begin(), copy_ranges.end());
+      ranges.insert(ranges.end(), copy_ranges->begin(), copy_ranges->end());
     }
   }
-  if (live_copies.empty()) return false;
+  if (live_copies.empty()) return ApplyResult::kFailed;
 
   std::unique_lock lock(objects_mutex_);
   std::optional<ObjectInfo> previous;
@@ -332,31 +357,20 @@ bool KeystoneService::apply_object_record(const ObjectKey& key, const std::strin
     adapter_.free_object(key);
     objects_.erase(it);
   }
-  if (adapter_.adopt_allocation(key, ranges, pools_snapshot) != ErrorCode::OK) {
+  if (adapter_.adopt_allocation(key, ranges, pools) != ErrorCode::OK) {
     // Put the previous (still valid) state back rather than silently
     // destroying a serveable object over a transient adoption failure.
     if (previous) {
-      std::vector<std::pair<MemoryPoolId, alloc::Range>> old_ranges;
-      bool ok = true;
-      for (const auto& copy : previous->copies) {
-        for (const auto& shard : copy.shards) {
-          auto mapped = shard_to_range(shard, pools_snapshot);
-          if (!mapped) {
-            ok = false;
-            break;
-          }
-          old_ranges.push_back(std::move(*mapped));
-        }
-        if (!ok) break;
-      }
-      if (ok && adapter_.adopt_allocation(key, old_ranges, pools_snapshot) == ErrorCode::OK) {
+      auto old_ranges = map_copies_to_ranges(previous->copies, pools);
+      if (old_ranges &&
+          adapter_.adopt_allocation(key, *old_ranges, pools) == ErrorCode::OK) {
         objects_[key] = std::move(*previous);
       } else {
         LOG_ERROR << "object " << key << " lost during record re-apply";
         bump_view();
       }
     }
-    return false;
+    return ApplyResult::kFailed;
   }
   const auto steady_now = std::chrono::steady_clock::now();
   const int64_t wall_now = now_wall_ms();
@@ -375,7 +389,7 @@ bool KeystoneService::apply_object_record(const ObjectKey& key, const std::strin
   info.epoch = next_epoch_.fetch_add(1);
   objects_[key] = std::move(info);
   bump_view();
-  return true;
+  return ApplyResult::kApplied;
 }
 
 void KeystoneService::drop_object_locally(const ObjectKey& key) {
@@ -390,25 +404,28 @@ void KeystoneService::drop_object_locally(const ObjectKey& key) {
 // Standby -> leader: the promoted keystone re-reads every persisted record so
 // writes that raced the promotion are not lost, and drops local entries whose
 // records are gone (removed by the old leader after our mirror applied them).
-void KeystoneService::on_promoted() {
-  if (!coordinator_ || !config_.persist_objects) return;
-  auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
-  if (!records.ok()) return;
+bool KeystoneService::on_promoted() {
+  if (!coordinator_ || !config_.persist_objects) return true;
+  Result<std::vector<coord::KeyValue>> records = ErrorCode::COORD_ERROR;
+  for (int attempt = 0; attempt < 5; ++attempt) {
+    records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
+    if (records.ok()) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  if (!records.ok()) {
+    LOG_ERROR << "promotion reconcile cannot read the coordinator: "
+              << to_string(records.error());
+    return false;
+  }
   const auto prefix = coord::objects_prefix(config_.cluster_id);
   std::unordered_set<ObjectKey> persisted;
   for (const auto& kv : records.value()) {
-    if (kv.key.size() <= prefix.size()) continue;
-    const ObjectKey key = kv.key.substr(prefix.size());
-    if (apply_object_record(key, kv.value)) {
-      persisted.insert(key);
-    } else {
-      // Unserveable record (e.g. every copy on pools that died with the old
-      // leader): keeping a local entry would hand clients dead placements,
-      // and keeping the record would resurrect it on the next promotion.
-      drop_object_locally(key);
-      coordinator_->del(kv.key);
-    }
+    if (kv.key.size() > prefix.size()) persisted.insert(kv.key.substr(prefix.size()));
   }
+
+  // Sweep stale local entries FIRST: a mirror entry whose record is gone
+  // (delete event lost with the old leader) still holds allocator ranges
+  // that would otherwise conflict with re-applying valid records below.
   std::vector<ObjectKey> stale;
   {
     std::shared_lock lock(objects_mutex_);
@@ -417,8 +434,56 @@ void KeystoneService::on_promoted() {
     }
   }
   for (const auto& key : stale) drop_object_locally(key);
-  LOG_INFO << "promoted: reconciled " << persisted.size() << " objects, dropped "
-           << stale.size() << " stale";
+
+  alloc::PoolMap pools_snapshot;
+  {
+    std::shared_lock lock(registry_mutex_);
+    pools_snapshot = pools_;
+  }
+  size_t applied = 0;
+  for (const auto& kv : records.value()) {
+    if (kv.key.size() <= prefix.size()) continue;
+    const ObjectKey key = kv.key.substr(prefix.size());
+    switch (apply_object_record(key, kv.value, pools_snapshot)) {
+      case ApplyResult::kApplied:
+        ++applied;
+        break;
+      case ApplyResult::kGarbage:
+        drop_object_locally(key);
+        coordinator_->del(kv.key);
+        break;
+      case ApplyResult::kFailed:
+        // Do not serve placements we could not adopt, but KEEP the durable
+        // record: pools may still be advertising (watch in flight) and the
+        // next reconcile can resurrect the object.
+        drop_object_locally(key);
+        break;
+    }
+  }
+  LOG_INFO << "promoted: reconciled " << applied << "/" << persisted.size()
+           << " objects, dropped " << stale.size() << " stale";
+  return true;
+}
+
+// Leader -> standby: pending objects were staged by our own put_starts and
+// never persisted; the new leader knows nothing about them, their clients
+// fail over and retry, and keeping their ranges would fight the mirror.
+void KeystoneService::on_demoted() {
+  size_t dropped = 0;
+  std::unique_lock lock(objects_mutex_);
+  for (auto it = objects_.begin(); it != objects_.end();) {
+    if (it->second.state == ObjectState::kPending) {
+      adapter_.free_object(it->first);
+      it = objects_.erase(it);
+      ++dropped;
+    } else {
+      ++it;
+    }
+  }
+  if (dropped) {
+    bump_view();
+    LOG_WARN << "demoted: dropped " << dropped << " pending objects";
+  }
 }
 
 ErrorCode KeystoneService::start() {
@@ -443,7 +508,7 @@ void KeystoneService::stop() {
     for (auto id : watch_ids_) coordinator_->unwatch(id);
     watch_ids_.clear();
     if (config_.enable_ha) {
-      coordinator_->resign("btpu-keystone-leader/" + config_.cluster_id, service_id_);
+      coordinator_->resign(election_name(), service_id_);
       is_leader_ = false;
     }
     coordinator_->unregister_service("btpu-keystone", service_id_);
@@ -485,6 +550,9 @@ void KeystoneService::keepalive_loop() {
     lock.unlock();
     coordinator_->register_service("btpu-keystone", service_id_, config_.listen_address,
                                    config_.service_registration_ttl_sec * 1000);
+    // The election lease must be refreshed too: a candidate (leader or
+    // standby) that misses its TTL is treated as dead and removed.
+    if (config_.enable_ha) coordinator_->campaign_keepalive(election_name(), service_id_);
     lock.lock();
   }
 }
@@ -777,7 +845,12 @@ void KeystoneService::on_object_event(const WatchEvent& ev) {
   if (ev.key.size() <= prefix.size()) return;
   const ObjectKey key = ev.key.substr(prefix.size());
   if (ev.type == WatchEvent::Type::kPut) {
-    apply_object_record(key, ev.value);
+    alloc::PoolMap pools_snapshot;
+    {
+      std::shared_lock lock(registry_mutex_);
+      pools_snapshot = pools_;
+    }
+    apply_object_record(key, ev.value, pools_snapshot);
   } else {
     drop_object_locally(key);
   }

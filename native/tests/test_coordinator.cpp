@@ -183,6 +183,24 @@ BTEST(MemCoordinator, LeaderLeaseExpiryPromotesNext) {
   BT_EXPECT_EQ(c.current_leader("ks").value(), "b");
 }
 
+BTEST(MemCoordinator, CampaignKeepaliveRetainsLeadership) {
+  MemCoordinator c;
+  std::atomic<bool> a_leader{false}, b_leader{false};
+  BT_EXPECT(c.campaign("ks", "a", 150, [&](bool l) { a_leader = l; }) == ErrorCode::OK);
+  BT_EXPECT(c.campaign("ks", "b", 60000, [&](bool l) { b_leader = l; }) == ErrorCode::OK);
+  BT_EXPECT(a_leader.load());
+  // Refreshing within the TTL keeps "a" the leader well past its lease.
+  for (int i = 0; i < 6; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(60));
+    BT_EXPECT(c.campaign_keepalive("ks", "a") == ErrorCode::OK);
+  }
+  BT_EXPECT(!b_leader.load());
+  BT_EXPECT_EQ(c.current_leader("ks").value(), "a");
+  // Once the refreshes stop, the lease lapses and "b" takes over.
+  BT_EXPECT(eventually([&] { return b_leader.load(); }, 3000));
+  BT_EXPECT(c.campaign_keepalive("ks", "a") == ErrorCode::LEADER_ELECTION_FAILED);
+}
+
 // --- the same contract over TCP ---
 
 namespace {
@@ -226,6 +244,22 @@ BTEST(RemoteCoordinator, LeaderElection) {
   RemoteFixture f;
   BT_ASSERT(f.up());
   run_election_suite(*f.client);
+}
+
+BTEST(RemoteCoordinator, CampaignKeepaliveOverTcp) {
+  RemoteFixture f;
+  BT_ASSERT(f.up());
+  std::atomic<bool> a_leader{false};
+  BT_EXPECT(f.client->campaign("ks", "a", 200, [&](bool l) { a_leader = l; }) ==
+            ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return a_leader.load(); }, 2000));
+  for (int i = 0; i < 4; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(80));
+    BT_EXPECT(f.client->campaign_keepalive("ks", "a") == ErrorCode::OK);
+  }
+  BT_EXPECT_EQ(f.client->current_leader("ks").value(), "a");
+  BT_EXPECT(f.client->campaign_keepalive("ks", "missing") ==
+            ErrorCode::LEADER_ELECTION_FAILED);
 }
 
 BTEST(RemoteCoordinator, TwoClientsShareState) {

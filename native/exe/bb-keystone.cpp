@@ -19,6 +19,9 @@ int main(int argc, char** argv) {
   std::string config_path;
   std::string coord_override;
   std::string listen_override;
+  std::string metrics_port_override;
+  std::string service_id_override;
+  bool ha_override = false;
   int stats_interval_sec = 60;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--config") && i + 1 < argc) config_path = argv[++i];
@@ -26,10 +29,17 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--listen") && i + 1 < argc) listen_override = argv[++i];
     else if (!std::strcmp(argv[i], "--stats-interval") && i + 1 < argc)
       stats_interval_sec = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--metrics-port") && i + 1 < argc)
+      metrics_port_override = argv[++i];
+    else if (!std::strcmp(argv[i], "--service-id") && i + 1 < argc)
+      service_id_override = argv[++i];
+    else if (!std::strcmp(argv[i], "--ha"))
+      ha_override = true;
     else if (!std::strcmp(argv[i], "--help")) {
       std::printf(
           "usage: bb-keystone [--config keystone.yaml] [--coord host:port]\n"
-          "                   [--listen host:port] [--stats-interval sec]\n");
+          "                   [--listen host:port] [--metrics-port port]\n"
+          "                   [--service-id id] [--ha] [--stats-interval sec]\n");
       return 0;
     }
   }
@@ -43,6 +53,9 @@ int main(int argc, char** argv) {
   }
   if (!coord_override.empty()) config.coord_endpoints = coord_override;
   if (!listen_override.empty()) config.listen_address = listen_override;
+  if (!metrics_port_override.empty()) config.http_metrics_port = metrics_port_override;
+  if (!service_id_override.empty()) config.service_id = service_id_override;
+  if (ha_override) config.enable_ha = true;
 
   std::shared_ptr<btpu::coord::Coordinator> coordinator;
   if (!config.coord_endpoints.empty()) {

@@ -220,6 +220,15 @@ class KeystoneService {
 
   std::atomic<ViewVersionId> view_version_{0};
   std::atomic<uint64_t> next_epoch_{1};  // feeds ObjectInfo::epoch
+  // Set when a promotion had to be refused (reconcile failed): the keepalive
+  // thread resigns and re-campaigns. Deferred because leader callbacks run
+  // on the coordinator's event thread, where issuing coordinator RPCs would
+  // self-deadlock (the response is delivered by that same thread).
+  std::atomic<bool> needs_recampaign_{false};
+  // Wakes the keepalive thread immediately for the FIRST attempt; retries
+  // after a failure pace at the normal refresh interval so a down
+  // coordinator cannot busy-spin the loop.
+  std::atomic<bool> recampaign_asap_{false};
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
   std::thread gc_thread_, health_thread_, keepalive_thread_;

@@ -60,16 +60,20 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
   }
 
   auto rank = [&](std::vector<MemoryPoolId>& v) {
+    // Snapshot availability BEFORE sorting: concurrent allocations mutate
+    // per-pool free space, and a comparator whose keys change mid-sort
+    // violates strict weak ordering — UB that can corrupt the vector.
+    std::unordered_map<MemoryPoolId, uint64_t> avail;
+    avail.reserve(v.size());
+    for (const auto& id : v) avail.emplace(id, avail_of(id, pools.at(id)));
     std::sort(v.begin(), v.end(), [&](const MemoryPoolId& a, const MemoryPoolId& b) {
-      const MemoryPool& pa = pools.at(a);
-      const MemoryPool& pb = pools.at(b);
       if (request.preferred_slice >= 0) {
-        const bool sa = pa.topo.slice_id == request.preferred_slice;
-        const bool sb = pb.topo.slice_id == request.preferred_slice;
+        const bool sa = pools.at(a).topo.slice_id == request.preferred_slice;
+        const bool sb = pools.at(b).topo.slice_id == request.preferred_slice;
         if (sa != sb) return sa;  // same-slice (ICI-reachable) pools first
       }
-      const uint64_t fa = avail_of(a, pa);
-      const uint64_t fb = avail_of(b, pb);
+      const uint64_t fa = avail.at(a);
+      const uint64_t fb = avail.at(b);
       if (fa != fb) return fa > fb;
       return a < b;  // deterministic tie-break
     });

@@ -138,16 +138,26 @@ std::optional<std::pair<MemoryPoolId, alloc::Range>> shard_to_range(
   return std::nullopt;
 }
 
-// All-or-nothing mapping of every shard of `copies` onto (pool, range) pairs.
+// All-or-nothing mapping of shards onto (pool, range) pairs.
+bool append_copy_ranges(const CopyPlacement& copy, const alloc::PoolMap& pools,
+                        std::vector<std::pair<MemoryPoolId, alloc::Range>>& out) {
+  const size_t mark = out.size();
+  for (const auto& shard : copy.shards) {
+    auto mapped = shard_to_range(shard, pools);
+    if (!mapped) {
+      out.resize(mark);
+      return false;
+    }
+    out.push_back(std::move(*mapped));
+  }
+  return true;
+}
+
 std::optional<std::vector<std::pair<MemoryPoolId, alloc::Range>>> map_copies_to_ranges(
     const std::vector<CopyPlacement>& copies, const alloc::PoolMap& pools) {
   std::vector<std::pair<MemoryPoolId, alloc::Range>> out;
   for (const auto& copy : copies) {
-    for (const auto& shard : copy.shards) {
-      auto mapped = shard_to_range(shard, pools);
-      if (!mapped) return std::nullopt;
-      out.push_back(std::move(*mapped));
-    }
+    if (!append_copy_ranges(copy, pools, out)) return std::nullopt;
   }
   return out;
 }
@@ -231,10 +241,13 @@ ErrorCode KeystoneService::start_campaign() {
           // false, every put_start is rejected with NOT_LEADER, so the stale
           // scan cannot race an in-flight allocation.
           if (!on_promoted()) {
+            // No coordinator RPCs here: this callback runs on the
+            // coordinator's event thread, which must stay free to deliver
+            // their responses. The keepalive thread resigns + re-campaigns.
             LOG_ERROR << "refusing leadership (reconcile failed); re-campaigning";
-            coordinator_->resign(election_name(), service_id_);
-            std::this_thread::sleep_for(std::chrono::milliseconds(100));
-            start_campaign();  // back of the queue; another candidate may win
+            needs_recampaign_ = true;
+            recampaign_asap_ = true;
+            stop_cv_.notify_all();
             return;
           }
         }
@@ -341,10 +354,7 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
   std::vector<CopyPlacement> live_copies;
   std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
   for (const auto& copy : rec.copies) {
-    if (auto copy_ranges = map_copies_to_ranges({copy}, pools)) {
-      live_copies.push_back(copy);
-      ranges.insert(ranges.end(), copy_ranges->begin(), copy_ranges->end());
-    }
+    if (append_copy_ranges(copy, pools, ranges)) live_copies.push_back(copy);
   }
   if (live_copies.empty()) return ApplyResult::kFailed;
 
@@ -545,14 +555,37 @@ void KeystoneService::keepalive_loop() {
   std::unique_lock<std::mutex> lock(stop_mutex_);
   while (running_) {
     stop_cv_.wait_for(lock, std::chrono::seconds(config_.service_refresh_interval_sec),
-                      [this] { return !running_.load(); });
+                      [this] { return !running_.load() || recampaign_asap_.load(); });
     if (!running_) break;
     lock.unlock();
     coordinator_->register_service("btpu-keystone", service_id_, config_.listen_address,
                                    config_.service_registration_ttl_sec * 1000);
-    // The election lease must be refreshed too: a candidate (leader or
-    // standby) that misses its TTL is treated as dead and removed.
-    if (config_.enable_ha) coordinator_->campaign_keepalive(election_name(), service_id_);
+    if (config_.enable_ha) {
+      recampaign_asap_ = false;
+      if (needs_recampaign_.exchange(false)) {
+        // A refused promotion left us server-side leader with is_leader_
+        // false: step out and rejoin at the back of the queue. Retried
+        // every tick until it sticks — dropping out of the election
+        // silently would leave the pair leaderless at the next failure.
+        coordinator_->resign(election_name(), service_id_);
+        const ErrorCode ec = start_campaign();
+        if (ec != ErrorCode::OK) {
+          // CLIENT_ALREADY_EXISTS means a stale server-side candidacy whose
+          // leader callback was already torn down client-side — resign so
+          // the retry re-registers a candidacy that can actually notify us.
+          if (ec == ErrorCode::CLIENT_ALREADY_EXISTS)
+            coordinator_->resign(election_name(), service_id_);
+          LOG_ERROR << "re-campaign failed: " << to_string(ec) << "; will retry";
+          needs_recampaign_ = true;  // next tick; no asap -> no busy spin
+        }
+      } else if (coordinator_->campaign_keepalive(election_name(), service_id_) !=
+                 ErrorCode::OK) {
+        // Evicted from the election (lease lapsed during a stall): rejoin
+        // rather than silently remaining a non-candidate forever.
+        LOG_WARN << "election lease lost; re-campaigning";
+        needs_recampaign_ = true;
+      }
+    }
     lock.lock();
   }
 }

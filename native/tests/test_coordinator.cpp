@@ -186,12 +186,13 @@ BTEST(MemCoordinator, LeaderLeaseExpiryPromotesNext) {
 BTEST(MemCoordinator, CampaignKeepaliveRetainsLeadership) {
   MemCoordinator c;
   std::atomic<bool> a_leader{false}, b_leader{false};
-  BT_EXPECT(c.campaign("ks", "a", 150, [&](bool l) { a_leader = l; }) == ErrorCode::OK);
+  BT_EXPECT(c.campaign("ks", "a", 500, [&](bool l) { a_leader = l; }) == ErrorCode::OK);
   BT_EXPECT(c.campaign("ks", "b", 60000, [&](bool l) { b_leader = l; }) == ErrorCode::OK);
   BT_EXPECT(a_leader.load());
-  // Refreshing within the TTL keeps "a" the leader well past its lease.
-  for (int i = 0; i < 6; ++i) {
-    std::this_thread::sleep_for(std::chrono::milliseconds(60));
+  // Refreshing within the TTL keeps "a" the leader well past its lease
+  // (generous slack so sanitizer scheduling jitter cannot flake this).
+  for (int i = 0; i < 7; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
     BT_EXPECT(c.campaign_keepalive("ks", "a") == ErrorCode::OK);
   }
   BT_EXPECT(!b_leader.load());
@@ -250,11 +251,11 @@ BTEST(RemoteCoordinator, CampaignKeepaliveOverTcp) {
   RemoteFixture f;
   BT_ASSERT(f.up());
   std::atomic<bool> a_leader{false};
-  BT_EXPECT(f.client->campaign("ks", "a", 200, [&](bool l) { a_leader = l; }) ==
+  BT_EXPECT(f.client->campaign("ks", "a", 600, [&](bool l) { a_leader = l; }) ==
             ErrorCode::OK);
   BT_EXPECT(eventually([&] { return a_leader.load(); }, 2000));
-  for (int i = 0; i < 4; ++i) {
-    std::this_thread::sleep_for(std::chrono::milliseconds(80));
+  for (int i = 0; i < 5; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(150));
     BT_EXPECT(f.client->campaign_keepalive("ks", "a") == ErrorCode::OK);
   }
   BT_EXPECT_EQ(f.client->current_leader("ks").value(), "a");

@@ -163,6 +163,90 @@ BTEST(EndToEnd, GetFailsOverToSurvivingReplicaWithoutRepair) {
   BT_EXPECT(back.value() == data);
 }
 
+BTEST(EndToEnd, MultiSlicePlacementPrefersIciAndSpillsToDcn) {
+  // Acceptance-ladder item 5's placement story on the CPU harness: two
+  // "slices" of TCP workers (TCP = the DCN path). Slice-affine puts stay on
+  // the preferred slice while it has room, spill across only when it is
+  // full, and repair after a preemption re-replicates across slices.
+  EmbeddedClusterOptions options;
+  options.keystone.gc_interval_sec = 60;
+  options.keystone.health_check_interval_sec = 3600;
+  for (int i = 0; i < 4; ++i) {
+    worker::WorkerServiceConfig w;
+    w.worker_id = "slice" + std::to_string(i / 2) + "-w" + std::to_string(i % 2);
+    w.transport = TransportKind::TCP;
+    w.listen_host = "127.0.0.1";
+    w.topo = {/*slice_id=*/i / 2, /*host_id=*/i % 2, -1};
+    w.heartbeat_interval_ms = 100;
+    w.heartbeat_ttl_ms = 60000;
+    w.pools = {{"pool-" + w.worker_id, StorageClass::RAM_CPU, 1 << 20, "", ""}};
+    options.workers.push_back(w);
+  }
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 2;
+  cfg.min_shard_size = 4096;
+  cfg.preferred_slice = 0;
+
+  // Fits in slice 0: every shard must ride ICI (stay on slice 0).
+  auto small = pattern(512 * 1024, 31);
+  BT_ASSERT(client->put("dcn/ici", small.data(), small.size(), cfg) == ErrorCode::OK);
+  auto placed = client->get_workers("dcn/ici");
+  BT_ASSERT_OK(placed);
+  for (const auto& shard : placed.value()[0].shards) {
+    BT_EXPECT_EQ(shard.worker_id.substr(0, 6), "slice0");
+  }
+
+  // Too big for what's left of slice 0 (2 MiB total): spills across DCN.
+  auto big = pattern((2 << 20) + (512 << 10), 32);
+  cfg.max_workers_per_copy = 4;
+  BT_ASSERT(client->put("dcn/spill", big.data(), big.size(), cfg) == ErrorCode::OK);
+  auto spilled = client->get_workers("dcn/spill");
+  BT_ASSERT_OK(spilled);
+  bool crossed = false;
+  for (const auto& shard : spilled.value()[0].shards) {
+    if (shard.worker_id.substr(0, 6) == "slice1") crossed = true;
+  }
+  BT_EXPECT(crossed);
+  auto big_back = client->get("dcn/spill");
+  BT_ASSERT_OK(big_back);
+  BT_EXPECT(big_back.value() == big);
+
+  // Preemption on slice 0: replicated object must be repaired onto workers
+  // that are still alive, and remain readable.
+  BT_EXPECT(client->remove("dcn/spill") == ErrorCode::OK);
+  WorkerConfig rep = cfg;
+  rep.replication_factor = 2;
+  rep.max_workers_per_copy = 1;
+  auto prec = pattern(256 * 1024, 33);
+  BT_ASSERT(client->put("dcn/replicated", prec.data(), prec.size(), rep) == ErrorCode::OK);
+  auto before = client->get_workers("dcn/replicated");
+  BT_ASSERT_OK(before);
+  const NodeId victim = before.value()[0].shards[0].worker_id;
+  size_t victim_index = 0;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if (cluster.worker(i).config().worker_id == victim) victim_index = i;
+  }
+  cluster.kill_worker(victim_index);
+  BT_ASSERT(eventually([&] {
+    auto copies = client->get_workers("dcn/replicated");
+    if (!copies.ok() || copies.value().size() != 2) return false;
+    for (const auto& copy : copies.value()) {
+      for (const auto& shard : copy.shards) {
+        if (shard.worker_id == victim) return false;
+      }
+    }
+    return true;
+  }));
+  auto prec_back = client->get("dcn/replicated");
+  BT_ASSERT_OK(prec_back);
+  BT_EXPECT(prec_back.value() == prec);
+}
+
 BTEST(EndToEnd, ShmTransportSameHostRoundtrip) {
   auto options = EmbeddedClusterOptions::simple(2, 4 << 20);
   for (auto& w : options.workers) w.transport = TransportKind::SHM;

@@ -21,6 +21,7 @@ fi
 cleanup() {
   echo "stopping cluster..."
   [[ -n "${WORKER_PID:-}" ]] && kill "$WORKER_PID" 2>/dev/null || true
+  [[ -n "${KEYSTONE2_PID:-}" ]] && kill "$KEYSTONE2_PID" 2>/dev/null || true
   [[ -n "${KEYSTONE_PID:-}" ]] && kill "$KEYSTONE_PID" 2>/dev/null || true
   [[ -n "${COORD_PID:-}" ]] && kill "$COORD_PID" 2>/dev/null || true
 }
@@ -31,12 +32,31 @@ echo "starting bb-coord on :$COORD_PORT"
 COORD_PID=$!
 sleep 0.3
 
+# BTPU_HA=1 runs an active/standby keystone pair; clients get both endpoints.
+HA="${BTPU_HA:-0}"
+KEYSTONE2_PORT="${BTPU_KEYSTONE2_PORT:-9092}"
+HA_FLAGS=()
+[[ "$HA" == "1" ]] && HA_FLAGS=(--ha)
+
 echo "starting bb-keystone on :$KEYSTONE_PORT"
 "$BUILD/bb-keystone" --config "$REPO_ROOT/configs/keystone.yaml" \
   --coord "127.0.0.1:$COORD_PORT" --listen "127.0.0.1:$KEYSTONE_PORT" \
+  --service-id ks-primary ${HA_FLAGS[@]+"${HA_FLAGS[@]}"} \
   >"$RUN_DIR/keystone.log" 2>&1 &
 KEYSTONE_PID=$!
 sleep 0.5
+
+CLIENT_ENDPOINTS="127.0.0.1:$KEYSTONE_PORT"
+if [[ "$HA" == "1" ]]; then
+  echo "starting standby bb-keystone on :$KEYSTONE2_PORT"
+  "$BUILD/bb-keystone" --config "$REPO_ROOT/configs/keystone.yaml" \
+    --coord "127.0.0.1:$COORD_PORT" --listen "127.0.0.1:$KEYSTONE2_PORT" \
+    --metrics-port 9093 --service-id ks-standby --ha \
+    >"$RUN_DIR/keystone2.log" 2>&1 &
+  KEYSTONE2_PID=$!
+  CLIENT_ENDPOINTS="$CLIENT_ENDPOINTS,127.0.0.1:$KEYSTONE2_PORT"
+  sleep 0.5
+fi
 
 echo "starting bb-worker"
 "$BUILD/bb-worker" --config "$REPO_ROOT/configs/worker.yaml" \
@@ -45,15 +65,15 @@ WORKER_PID=$!
 sleep 0.7
 
 echo "smoke test: put/get/verify through bb-client"
-"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" put smoke/obj --size 1048576
-"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" get smoke/obj --out "$RUN_DIR/smoke.bin"
-"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" stats
-"$BUILD/bb-client" --keystone "127.0.0.1:$KEYSTONE_PORT" remove smoke/obj
+"$BUILD/bb-client" --keystone "$CLIENT_ENDPOINTS" put smoke/obj --size 1048576
+"$BUILD/bb-client" --keystone "$CLIENT_ENDPOINTS" get smoke/obj --out "$RUN_DIR/smoke.bin"
+"$BUILD/bb-client" --keystone "$CLIENT_ENDPOINTS" stats
+"$BUILD/bb-client" --keystone "$CLIENT_ENDPOINTS" remove smoke/obj
 echo "metrics scrape:"
 curl -sf "http://127.0.0.1:9091/metrics" | head -5 || true
 
 echo
-echo "cluster up. PIDs: coord=$COORD_PID keystone=$KEYSTONE_PID worker=$WORKER_PID"
+echo "cluster up. PIDs: coord=$COORD_PID keystone=$KEYSTONE_PID${KEYSTONE2_PID:+ standby=$KEYSTONE2_PID} worker=$WORKER_PID"
 echo "logs in $RUN_DIR. Ctrl-C to stop."
 if [[ "${BTPU_CLUSTER_ONESHOT:-0}" == "1" ]]; then
   exit 0

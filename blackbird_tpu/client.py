@@ -17,6 +17,9 @@ class Client:
     """
 
     def __init__(self, keystone_endpoint: str):
+        """keystone_endpoint may be a comma-separated list ("host:a,host:b"):
+        the first entry is the primary, the rest HA fallbacks the client
+        rotates through on NOT_LEADER or connection failure."""
         self._cluster_ref = None
         self._handle = lib.btpu_client_create_remote(keystone_endpoint.encode())
         if not self._handle:

@@ -50,7 +50,7 @@ class EmbeddedCluster:
         lib.btpu_cluster_kill_worker(self._handle, index)
 
     def counters(self) -> dict[str, int]:
-        out = (ctypes.c_uint64 * 5)()
+        out = (ctypes.c_uint64 * 6)()
         lib.btpu_cluster_counters(self._handle, out)
         return {
             "objects_repaired": out[0],
@@ -58,6 +58,7 @@ class EmbeddedCluster:
             "evicted": out[2],
             "gc_collected": out[3],
             "workers_lost": out[4],
+            "objects_demoted": out[5],
         }
 
     def close(self) -> None:

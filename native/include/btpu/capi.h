@@ -23,10 +23,13 @@ btpu_cluster* btpu_cluster_create_tiered(uint32_t n_workers, uint64_t device_byt
 void btpu_cluster_destroy(btpu_cluster* cluster);
 int32_t btpu_cluster_kill_worker(btpu_cluster* cluster, uint32_t index);
 uint32_t btpu_cluster_worker_count(btpu_cluster* cluster);
-// Counters snapshot: [repaired, lost, evicted, gc_collected, workers_lost].
-void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[5]);
+// Counters snapshot: [repaired, lost, evicted, gc_collected, workers_lost, demoted].
+void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[6]);
 
 btpu_client* btpu_client_create_embedded(btpu_cluster* cluster);
+/* keystone_endpoint accepts a comma-separated list: the first entry is the
+ * primary, the rest HA fallbacks rotated through on NOT_LEADER / connection
+ * failure. */
 btpu_client* btpu_client_create_remote(const char* keystone_endpoint);
 void btpu_client_destroy(btpu_client* client);
 

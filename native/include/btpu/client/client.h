@@ -71,10 +71,6 @@ class ObjectClient {
   ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);
   ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);
 
-  static bool is_connection_error(ErrorCode ec) noexcept {
-    return ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CONNECTION_FAILED ||
-           ec == ErrorCode::CLIENT_DISCONNECTED;
-  }
   static ErrorCode error_of(ErrorCode ec) noexcept { return ec; }
   template <typename T>
   static ErrorCode error_of(const Result<T>& r) noexcept {
@@ -83,14 +79,19 @@ class ObjectClient {
   // Points rpc_ at the next configured keystone endpoint.
   void rotate_keystone();
   // Runs `fn(rpc client)`, rotating through the configured endpoints and
-  // retrying once per endpoint. NOT_LEADER always retries (the standby
-  // provably did not execute the call). Connection errors retry only when
-  // `idempotent`: a lost reply leaves a mutation's outcome unknown.
+  // retrying once per endpoint. Always rotates on NOT_LEADER (the standby
+  // provably did not execute) and CONNECTION_FAILED (the request was never
+  // sent — the RPC client returns it only when no connection could be
+  // established). Mid-call failures (reply lost) rotate only when
+  // `idempotent`: a mutation may have executed before the reply vanished.
   template <typename Fn>
   auto rpc_failover(bool idempotent, Fn&& fn) {
     auto result = fn(*rpc_);
     auto should_retry = [&](ErrorCode ec) {
-      return ec == ErrorCode::NOT_LEADER || (idempotent && is_connection_error(ec));
+      if (ec == ErrorCode::NOT_LEADER || ec == ErrorCode::CONNECTION_FAILED) return true;
+      return idempotent &&
+             (ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED ||
+              ec == ErrorCode::RPC_FAILED);
     };
     const size_t endpoints = 1 + options_.keystone_fallbacks.size();
     for (size_t i = 0; i + 1 < endpoints && should_retry(error_of(result)); ++i) {

@@ -229,6 +229,7 @@ class KeystoneService {
   // after a failure pace at the normal refresh interval so a down
   // coordinator cannot busy-spin the loop.
   std::atomic<bool> recampaign_asap_{false};
+  std::atomic<uint32_t> promotion_refusals_{0};  // streak; reset on success
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
   std::thread gc_thread_, health_thread_, keepalive_thread_;

@@ -78,7 +78,7 @@ uint32_t btpu_cluster_worker_count(btpu_cluster* cluster) {
   return cluster ? static_cast<uint32_t>(cluster->impl->worker_count()) : 0;
 }
 
-void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[5]) {
+void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[6]) {
   if (!cluster || !out) return;
   const auto& c = cluster->impl->keystone().counters();
   out[0] = c.objects_repaired.load();
@@ -86,6 +86,7 @@ void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[5]) {
   out[2] = c.evicted.load();
   out[3] = c.gc_collected.load();
   out[4] = c.workers_lost.load();
+  out[5] = c.objects_demoted.load();
 }
 
 btpu_client* btpu_client_create_embedded(btpu_cluster* cluster) {
@@ -98,7 +99,8 @@ btpu_client* btpu_client_create_embedded(btpu_cluster* cluster) {
 btpu_client* btpu_client_create_remote(const char* keystone_endpoint) {
   if (!keystone_endpoint) return nullptr;
   client::ClientOptions options;
-  options.keystone_address = keystone_endpoint;
+  options.set_keystone_endpoints(keystone_endpoint);
+  if (options.keystone_address.empty()) return nullptr;
   auto client = std::make_unique<client::ObjectClient>(options);
   if (client->connect() != ErrorCode::OK) return nullptr;
   auto* handle = new btpu_client;

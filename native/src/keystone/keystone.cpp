@@ -244,12 +244,19 @@ ErrorCode KeystoneService::start_campaign() {
             // No coordinator RPCs here: this callback runs on the
             // coordinator's event thread, which must stay free to deliver
             // their responses. The keepalive thread resigns + re-campaigns.
+            // Only the FIRST refusal in a streak wakes it immediately —
+            // repeated refusals pace at the refresh interval, or a sole
+            // candidate whose reconcile keeps failing would busy-spin
+            // (campaign -> instant re-promotion -> refusal -> campaign).
             LOG_ERROR << "refusing leadership (reconcile failed); re-campaigning";
             needs_recampaign_ = true;
-            recampaign_asap_ = true;
-            stop_cv_.notify_all();
+            if (promotion_refusals_.fetch_add(1) == 0) {
+              recampaign_asap_ = true;
+              stop_cv_.notify_all();
+            }
             return;
           }
+          promotion_refusals_ = 0;
         }
         if (!leader && was) {
           is_leader_ = false;
@@ -580,9 +587,13 @@ void KeystoneService::keepalive_loop() {
         }
       } else if (coordinator_->campaign_keepalive(election_name(), service_id_) !=
                  ErrorCode::OK) {
-        // Evicted from the election (lease lapsed during a stall): rejoin
-        // rather than silently remaining a non-candidate forever.
+        // Evicted from the election (lease lapsed during a stall). If we
+        // still believed we were leader, step down NOW — the coordinator
+        // has already promoted someone else, and serving mutations here
+        // would be split-brain. Then rejoin rather than silently remaining
+        // a non-candidate forever.
         LOG_WARN << "election lease lost; re-campaigning";
+        if (is_leader_.exchange(false)) on_demoted();
         needs_recampaign_ = true;
       }
     }

@@ -34,12 +34,18 @@ ErrorCode KeystoneRpcClient::ensure_connected_locked() {
 ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                       std::vector<uint8_t>& resp) {
   std::lock_guard<std::mutex> lock(mutex_);
+  // CONNECTION_FAILED is a *contract*: it may only be returned when no frame
+  // was ever sent, so callers (client failover) can safely replay the call
+  // against another keystone. Once a frame went out, every failure is
+  // RPC_FAILED — the request may have executed and the reply been lost.
+  bool sent = false;
   for (int attempt = 0; attempt < 2; ++attempt) {
     if (ensure_connected_locked() != ErrorCode::OK) {
-      if (attempt == 1) return ErrorCode::CONNECTION_FAILED;
+      if (attempt == 1) return sent ? ErrorCode::RPC_FAILED : ErrorCode::CONNECTION_FAILED;
       continue;
     }
     if (net::send_frame(sock_.fd(), opcode, req.data(), req.size()) == ErrorCode::OK) {
+      sent = true;
       uint8_t resp_op = 0;
       if (net::recv_frame(sock_.fd(), resp_op, resp) == ErrorCode::OK && resp_op == opcode) {
         return ErrorCode::OK;

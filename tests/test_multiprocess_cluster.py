@@ -138,3 +138,101 @@ def test_multiprocess_put_get_failover(cluster):
     ).read().decode()
     assert "btpu_workers_lost_total 1" in body
     assert "btpu_objects 1" in body
+
+
+def test_multiprocess_ha_keystone_failover(tmp_path):
+    """Active/standby keystone pair over a real bb-coord: the Python client
+    holds both endpoints, the leader is SIGKILLed, and puts/gets keep
+    working against the promoted standby (which mirrored the records)."""
+    from blackbird_tpu import Client
+
+    coord_port = free_port()
+    ks_ports = [free_port(), free_port()]
+    metrics_ports = [free_port(), free_port()]
+    procs = []
+
+    def spawn(args, name):
+        proc = subprocess.Popen(
+            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        procs.append((name, proc))
+        return proc
+
+    def keystone_cfg(i: int) -> Path:
+        path = tmp_path / f"ks{i}.yaml"
+        path.write_text(
+            f"""cluster_id: ha_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{ks_ports[i]}
+http_metrics_port: "{metrics_ports[i]}"
+enable_ha: true
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 5
+service_registration_ttl_sec: 3
+service_refresh_interval_sec: 1
+""")
+        return path
+
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        ks_procs = []
+        for i in range(2):
+            ks_procs.append(spawn(
+                [str(BUILD / "bb-keystone"), "--config", str(keystone_cfg(i)),
+                 "--service-id", f"ks-{i}"], f"keystone-{i}"))
+            wait_for(lambda: port_open(ks_ports[i]), what=f"bb-keystone-{i}")
+        worker_cfg = tmp_path / "haw.yaml"
+        worker_cfg.write_text(
+            f"""worker_id: haw-0
+cluster_id: ha_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+transport: tcp
+listen_host: 127.0.0.1
+heartbeat:
+  interval_ms: 300
+  ttl_ms: 2000
+pools:
+  - id: haw-0-dram
+    storage_class: ram_cpu
+    capacity: 32MB
+""")
+        spawn([str(BUILD / "bb-worker"), "--config", str(worker_cfg)], "worker")
+
+        endpoints = f"127.0.0.1:{ks_ports[0]},127.0.0.1:{ks_ports[1]}"
+        client = Client(endpoints)
+        wait_for(lambda: client.stats()["workers"] == 1, timeout=15, what="worker")
+
+        payload = bytes(bytearray(range(241)) * 1024)
+        client.put("ha/before", payload)
+        assert client.get("ha/before") == payload
+
+        # Crash the leader (first keystone wins the election). The standby
+        # mirrors object records and takes over; the same client object
+        # rotates endpoints transparently.
+        ks_procs[0].kill()
+        deadline = time.time() + 20
+        last_error = None
+        while time.time() < deadline:
+            try:
+                client.put("ha/after", payload)
+                break
+            except Exception as exc:  # noqa: BLE001 - retry until promoted
+                last_error = exc
+                time.sleep(0.3)
+        else:
+            raise AssertionError(f"no leader took over: {last_error}")
+        assert client.get("ha/before") == payload  # mirrored record survived
+        assert client.get("ha/after") == payload
+    finally:
+        for name, proc in reversed(procs):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for name, proc in procs:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()

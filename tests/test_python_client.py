@@ -87,3 +87,33 @@ def test_tiered_cluster_hbm_preference():
         big = np.random.default_rng(3).bytes(4 << 20)
         client.put("py/cold", big, preferred_class=StorageClass.HBM_TPU)
         assert client.get("py/cold") == big
+
+
+def test_tiered_cluster_demotes_under_pressure():
+    """Watermark pressure on the device tier moves objects down to DRAM
+    (objects_demoted counter) instead of deleting them; bytes stay intact."""
+    import time
+
+    with EmbeddedCluster(workers=1, pool_bytes=64 << 20,
+                         tiered_device_bytes=4 << 20) as cluster:
+        client = cluster.client()
+        rng = np.random.default_rng(7)
+        payloads = {}
+        for i in range(4):  # ~3.9 MiB of a 4 MiB device tier: > 90% watermark
+            key = f"py/demote/{i}"
+            payloads[key] = rng.bytes(1000 * 1024)
+            # max_workers=1 keeps each object whole on the device tier
+            # (striping would spread it over HBM+DRAM and dilute pressure).
+            client.put(key, payloads[key], max_workers=1,
+                       preferred_class=StorageClass.HBM_TPU)
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if cluster.counters()["objects_demoted"] >= 1:
+                break
+            time.sleep(0.2)
+        counters = cluster.counters()
+        assert counters["objects_demoted"] >= 1
+        assert counters["evicted"] == 0  # moved, not deleted
+        for key, expected in payloads.items():
+            assert client.get(key) == expected

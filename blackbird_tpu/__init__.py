@@ -12,6 +12,7 @@ Layout:
     blackbird_tpu.hbm       JAX HBM provider: device buffers as the top tier
     blackbird_tpu.topology  TPU pod/slice topology discovery from jax.devices()
     blackbird_tpu.parallel  mesh/sharding helpers for the ICI data plane
+    blackbird_tpu.checkpoint sharded-array checkpoint/restore via the store
     blackbird_tpu.ops       pallas/jnp kernels (checksums, shard repacking)
 """
 

@@ -258,6 +258,7 @@ ErrorCode KeystoneService::start_campaign() {
           }
           promotion_refusals_ = 0;
         }
+        if (!leader) promotion_refusals_ = 0;  // streak ends with the attempt cycle
         if (!leader && was) {
           is_leader_ = false;
           on_demoted();

@@ -34,27 +34,37 @@ ErrorCode KeystoneRpcClient::ensure_connected_locked() {
 ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                       std::vector<uint8_t>& resp) {
   std::lock_guard<std::mutex> lock(mutex_);
-  // CONNECTION_FAILED is a *contract*: it may only be returned when no frame
-  // was ever sent, so callers (client failover) can safely replay the call
-  // against another keystone. Once a frame went out, every failure is
-  // RPC_FAILED — the request may have executed and the reply been lost.
-  bool sent = false;
+  // CONNECTION_FAILED is a *contract*: it may only be returned when no whole
+  // frame was ever delivered, so callers (client failover) can safely replay
+  // the call against another keystone. Once a mutation frame went out, a
+  // lost reply is RPC_FAILED and the request is never re-sent — it may have
+  // executed. Read-only methods ARE re-sent after a lost reply (stale
+  // pooled connection, keystone restart): replaying them is harmless and
+  // keeps single-endpoint clients transparent across restarts.
+  const bool read_only = opcode == static_cast<uint8_t>(Method::kObjectExists) ||
+                         opcode == static_cast<uint8_t>(Method::kGetWorkers) ||
+                         opcode == static_cast<uint8_t>(Method::kGetClusterStats) ||
+                         opcode == static_cast<uint8_t>(Method::kGetViewVersion) ||
+                         opcode == static_cast<uint8_t>(Method::kBatchObjectExists) ||
+                         opcode == static_cast<uint8_t>(Method::kBatchGetWorkers) ||
+                         opcode == static_cast<uint8_t>(Method::kPing);
   for (int attempt = 0; attempt < 2; ++attempt) {
-    if (ensure_connected_locked() != ErrorCode::OK) {
-      if (attempt == 1) return sent ? ErrorCode::RPC_FAILED : ErrorCode::CONNECTION_FAILED;
+    if (ensure_connected_locked() != ErrorCode::OK) continue;
+    if (net::send_frame(sock_.fd(), opcode, req.data(), req.size()) != ErrorCode::OK) {
+      // Stale connection discovered at send time (keystone restarted): at
+      // most a partial frame left this socket, which the server discards
+      // without executing — safe to reconnect and try again.
+      sock_.close();
       continue;
     }
-    if (net::send_frame(sock_.fd(), opcode, req.data(), req.size()) == ErrorCode::OK) {
-      sent = true;
-      uint8_t resp_op = 0;
-      if (net::recv_frame(sock_.fd(), resp_op, resp) == ErrorCode::OK && resp_op == opcode) {
-        return ErrorCode::OK;
-      }
+    uint8_t resp_op = 0;
+    if (net::recv_frame(sock_.fd(), resp_op, resp) == ErrorCode::OK && resp_op == opcode) {
+      return ErrorCode::OK;
     }
-    // Stale connection (keystone restarted): drop and retry once.
     sock_.close();
+    if (!read_only) return ErrorCode::RPC_FAILED;  // delivered, outcome unknown
   }
-  return ErrorCode::RPC_FAILED;
+  return ErrorCode::CONNECTION_FAILED;
 }
 
 template <typename Req, typename Resp>

@@ -1,0 +1,124 @@
+"""Sharded-array checkpoint/restore through the object store: save on one
+mesh layout, restore on another (resharding), replicated-shard dedup."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from blackbird_tpu import EmbeddedCluster
+from blackbird_tpu.checkpoint import load_sharded, remove_checkpoint, save_sharded
+from blackbird_tpu.parallel import make_mesh
+
+
+@pytest.fixture()
+def store():
+    with EmbeddedCluster(workers=4, pool_bytes=64 << 20) as cluster:
+        yield cluster.client()
+
+
+def test_save_and_restore_same_sharding(store):
+    mesh = make_mesh(8)
+    sharding = NamedSharding(mesh, P("workers", None))
+    arr = jax.device_put(
+        np.arange(8 * 16 * 32, dtype=np.float32).reshape(8 * 16, 32), sharding
+    )
+    save_sharded(store, "ckpt/a", arr)
+    back = load_sharded(store, "ckpt/a", sharding=sharding)
+    assert back.sharding == sharding
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+
+
+def test_restore_onto_different_mesh_layout(store):
+    mesh8 = make_mesh(8)
+    arr = jax.device_put(
+        np.random.default_rng(5).normal(size=(64, 48)).astype(np.float32),
+        NamedSharding(mesh8, P("workers", None)),
+    )
+    save_sharded(store, "ckpt/reshard", arr)
+
+    # Restore sharded over the SECOND axis on a 4-device mesh.
+    mesh4 = make_mesh(4)
+    target = NamedSharding(mesh4, P(None, "workers"))
+    back = load_sharded(store, "ckpt/reshard", sharding=target)
+    assert back.sharding == target
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+
+    # And to a plain host array.
+    host = load_sharded(store, "ckpt/reshard")
+    np.testing.assert_array_equal(host, np.asarray(arr))
+
+
+def _shard_keys(store, prefix):
+    import json
+
+    meta = json.loads(bytes(store.get(prefix + "/meta")))
+    return [s["key"] for s in meta["shards"]]
+
+
+def test_replicated_sharding_stores_one_copy(store):
+    mesh = make_mesh(8)
+    replicated = NamedSharding(mesh, P())  # same bytes on every device
+    arr = jax.device_put(np.arange(1024, dtype=np.int32), replicated)
+    save_sharded(store, "ckpt/rep", arr)
+    keys = _shard_keys(store, "ckpt/rep")
+    assert len(keys) == 1  # deduplicated: one object for all 8 replicas
+    assert store.exists(keys[0])
+    back = load_sharded(store, "ckpt/rep", sharding=replicated)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
+
+
+def test_remove_checkpoint_cleans_all_objects(store):
+    mesh = make_mesh(8)
+    arr = jax.device_put(
+        np.zeros((32, 8), dtype=np.float32), NamedSharding(mesh, P("workers", None))
+    )
+    save_sharded(store, "ckpt/tmp", arr)
+    assert store.exists("ckpt/tmp/meta")
+    keys = _shard_keys(store, "ckpt/tmp")
+    remove_checkpoint(store, "ckpt/tmp")
+    assert not store.exists("ckpt/tmp/meta")
+    for key in keys:
+        assert not store.exists(key)
+
+
+def test_int_dtypes_and_odd_shapes(store):
+    mesh = make_mesh(8)
+    arr = jax.device_put(
+        np.random.default_rng(9).integers(-1000, 1000, size=(17, 13, 5),
+                                          dtype=np.int16),
+        NamedSharding(mesh, P(None)),
+    )
+    save_sharded(store, "ckpt/odd", arr)
+    np.testing.assert_array_equal(load_sharded(store, "ckpt/odd"), np.asarray(arr))
+
+
+def test_resave_replaces_and_reclaims_stale_shards(store):
+    mesh = make_mesh(8)
+    arr8 = jax.device_put(
+        np.arange(64 * 8, dtype=np.float32).reshape(64, 8),
+        NamedSharding(mesh, P("workers", None)),
+    )
+    save_sharded(store, "ckpt/resave", arr8)
+    first_keys = set(_shard_keys(store, "ckpt/resave"))
+    assert len(first_keys) == 8
+
+    # Re-save the (different) array replicated: 1 shard; the 8 old shard
+    # objects must be reclaimed, and loads must see the NEW bytes.
+    arr_new = jax.device_put(
+        np.ones((64, 8), dtype=np.float32), NamedSharding(mesh, P())
+    )
+    save_sharded(store, "ckpt/resave", arr_new)
+    second_keys = set(_shard_keys(store, "ckpt/resave"))
+    assert len(second_keys) == 1
+    for stale in first_keys - second_keys:
+        assert not store.exists(stale)
+    np.testing.assert_array_equal(
+        load_sharded(store, "ckpt/resave"), np.asarray(arr_new)
+    )
+
+
+def test_scalar_and_zero_d_arrays(store):
+    step = jax.numpy.asarray(12345, dtype=jax.numpy.int32)  # 0-d
+    save_sharded(store, "ckpt/step", step)
+    assert int(load_sharded(store, "ckpt/step")) == 12345

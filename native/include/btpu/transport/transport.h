@@ -87,6 +87,17 @@ class TransportClient {
 std::unique_ptr<TransportServer> make_transport_server(TransportKind kind);
 std::unique_ptr<TransportClient> make_transport_client();
 
+// Fault injection for hermetic failure-path tests (the reference has no
+// fault injection of any kind, SURVEY §5): wraps a client and fails the
+// n-th read/write exactly once with the given error.
+struct FaultSpec {
+  uint32_t fail_nth_write{0};  // 1-based op count; 0 = never fail
+  uint32_t fail_nth_read{0};
+  ErrorCode error{ErrorCode::NETWORK_ERROR};
+};
+std::unique_ptr<TransportClient> make_faulty_transport_client(
+    std::unique_ptr<TransportClient> inner, FaultSpec spec);
+
 // One shard-range transfer dispatched on the placement's location kind:
 // MemoryLocation through `client`'s one-sided path, DeviceLocation through
 // the in-process HBM provider (HBM-kind placements only exist for pools in

@@ -76,6 +76,40 @@ std::unique_ptr<TransportClient> make_transport_client() {
   return std::make_unique<MuxTransportClient>();
 }
 
+namespace {
+class FaultyTransportClient final : public TransportClient {
+ public:
+  FaultyTransportClient(std::unique_ptr<TransportClient> inner, FaultSpec spec)
+      : inner_(std::move(inner)), spec_(spec) {}
+
+  ErrorCode read(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
+                 void* dst, uint64_t len) override {
+    if (spec_.fail_nth_read != 0 &&
+        reads_.fetch_add(1) + 1 == spec_.fail_nth_read)
+      return spec_.error;
+    return inner_->read(remote, remote_addr, rkey, dst, len);
+  }
+  ErrorCode write(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
+                  const void* src, uint64_t len) override {
+    if (spec_.fail_nth_write != 0 &&
+        writes_.fetch_add(1) + 1 == spec_.fail_nth_write)
+      return spec_.error;
+    return inner_->write(remote, remote_addr, rkey, src, len);
+  }
+
+ private:
+  std::unique_ptr<TransportClient> inner_;
+  FaultSpec spec_;
+  std::atomic<uint32_t> reads_{0};
+  std::atomic<uint32_t> writes_{0};
+};
+}  // namespace
+
+std::unique_ptr<TransportClient> make_faulty_transport_client(
+    std::unique_ptr<TransportClient> inner, FaultSpec spec) {
+  return std::make_unique<FaultyTransportClient>(std::move(inner), spec);
+}
+
 ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_t in_off,
                    uint8_t* buf, uint64_t len, bool is_write) {
   if (in_off + len > shard.length) return ErrorCode::INVALID_PARAMETERS;

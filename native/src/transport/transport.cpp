@@ -1,4 +1,5 @@
 // Transport factory + the mux client routing on descriptor kind.
+#include <atomic>
 #include <charconv>
 #include <cstdio>
 

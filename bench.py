@@ -48,41 +48,90 @@ def bench_hbm_tier() -> None:
     """Acceptance ladder item 2 (BASELINE.md): batched 1 MiB put/get against
     the HBM_TPU tier. On a TPU VM the JAX provider puts objects in real
     device HBM; elsewhere this exercises the same path on the CPU device.
-    Secondary metric -> stderr (stdout stays the one-line contract)."""
+
+    Alongside the tier numbers, the RAW host<->device link is measured in
+    the same process (one device_put / one device->host read of the same
+    total bytes): the link is the physical ceiling, and tier efficiency =
+    tier / link is the honest measure of framework overhead. (On tunneled
+    dev TPUs the link itself can be ~MB/s-slow and asymmetric; on a real
+    TPU VM it is PCIe-class.) Secondary metric -> stderr (stdout stays the
+    one-line contract)."""
     import time
 
     try:
         import jax
+        import numpy as np
 
         from blackbird_tpu import EmbeddedCluster, StorageClass
         from blackbird_tpu.hbm import JaxHbmProvider
 
-        platform = jax.devices()[0].platform
-        provider = JaxHbmProvider(chunk_bytes=1 << 20).register()
+        device = jax.devices()[0]
+        platform = device.platform
+        iters, obj_bytes = 64, 1 << 20
+        total_gb = iters * obj_bytes / 1e9
+        payloads = {
+            f"bench/hbm{i}": np.random.default_rng(i).integers(
+                0, 256, obj_bytes, dtype=np.uint8).tobytes()
+            for i in range(iters)
+        }
+
+        # Raw host->device link ceiling for the same total bytes, one op.
+        # The device->host ceiling is measured LAST: on tunneled dev TPUs a
+        # single large D2H degrades subsequent H2D from ~1.4 GB/s to
+        # ~0.03 GB/s for a long while (measured), so every put timing must
+        # happen before any device read.
+        flat = np.frombuffer(b"".join(payloads.values()), dtype=np.uint8)
+        dev_arr = jax.device_put(flat, device)
+        dev_arr.block_until_ready()  # warm transfer path
+        t0 = time.perf_counter()
+        dev_arr = jax.device_put(flat, device)
+        dev_arr.block_until_ready()
+        link_h2d_s = time.perf_counter() - t0
+
+        provider = JaxHbmProvider().register()
         try:
-            with EmbeddedCluster(workers=1, pool_bytes=256 << 20,
+            with EmbeddedCluster(workers=1, pool_bytes=768 << 20,
                                  storage_class=StorageClass.HBM_TPU) as cluster:
                 client = cluster.client()
-                payload = b"\xa5" * (1 << 20)
-                # Tunneled dev TPUs read back at ~0.1 GB/s, so keep the
-                # iteration count low; real TPU-VM HBM sustains GB/s.
-                iters = 8
-                for i in range(iters):  # batched puts
-                    client.put(f"bench/hbm{i}", payload, max_workers=1)
-                provider.synchronize()  # don't bill in-flight H2D to the get loop
+                # Warm the put executables with a batch that pads to the SAME
+                # page bucket as the timed batches (33 objects -> 528 pages
+                # -> pow2 pad 1024, identical to 64 objects' exact 1024), so
+                # the warmup is cheap but the timed path is fully compiled.
+                # All put rounds run before any get: the tunnel's slow D2H
+                # direction otherwise congests the link under the put timer.
+                warm = {f"bench/warm{i}": payloads[f"bench/hbm{i}"] for i in range(33)}
+                client.put_many(warm, max_workers=1)
+
+                put_times = []
+                for r in range(3):
+                    batch = {f"bench/put{r}/{i}": p for i, p in enumerate(payloads.values())}
+                    t0 = time.perf_counter()
+                    client.put_many(batch, max_workers=1)  # flushes internally
+                    put_times.append(time.perf_counter() - t0)
+                put_s = sorted(put_times)[1]  # median of 3 (bursty shared link)
+
+                client.get_many(list(warm))  # warm the gather executables
+                get_times = []
+                for r in range(3):
+                    t0 = time.perf_counter()
+                    client.get_many([f"bench/put{r}/{i}" for i in range(iters)])
+                    get_times.append(time.perf_counter() - t0)
+                get_s = sorted(get_times)[1]
+
+                # Raw device->host ceiling, measured last (see note above).
+                fresh = dev_arr + np.uint8(0)  # defeat the host-value cache
+                fresh.block_until_ready()
                 t0 = time.perf_counter()
-                for i in range(iters):
-                    client.get(f"bench/hbm{i}")
-                get_s = time.perf_counter() - t0
-                t0 = time.perf_counter()
-                for i in range(iters):
-                    client.put(f"bench/hbm_w{i}", payload, max_workers=1)
-                provider.synchronize()  # device_put is async; time real completion
-                put_s = time.perf_counter() - t0
-                gb = iters * len(payload) / 1e9
+                np.asarray(fresh)
+                link_d2h_s = time.perf_counter() - t0
+                put_eff = link_h2d_s / put_s * 100
+                get_eff = link_d2h_s / get_s * 100
                 print(
-                    f"hbm tier ({platform}): put 1MiB {gb / put_s:.2f} GB/s | "
-                    f"get 1MiB {gb / get_s:.2f} GB/s",
+                    f"hbm tier ({platform}, batched {iters}x1MiB, median of 3): "
+                    f"put {total_gb / put_s:.2f} GB/s ({put_eff:.0f}% of raw link "
+                    f"{total_gb / link_h2d_s:.2f} GB/s) | "
+                    f"get {total_gb / get_s:.2f} GB/s ({get_eff:.0f}% of raw link "
+                    f"{total_gb / link_d2h_s:.2f} GB/s)",
                     file=sys.stderr,
                 )
         finally:

@@ -94,11 +94,19 @@ def _load() -> ctypes.CDLL:
         "btpu_client_destroy": (None, [c]),
         "btpu_put": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32, u32]),
         "btpu_get": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, ctypes.POINTER(u64)]),
+        "btpu_put_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),
+                                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64),
+                                u32, u32, u32, ctypes.POINTER(i32)]),
+        "btpu_get_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),
+                                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64),
+                                ctypes.POINTER(u64), ctypes.POINTER(i32)]),
+        "btpu_sizes_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),
+                                  ctypes.POINTER(u64), ctypes.POINTER(i32)]),
         "btpu_exists": (i32, [c, ctypes.c_char_p, ctypes.POINTER(i32)]),
         "btpu_remove": (i32, [c, ctypes.c_char_p]),
         "btpu_stats": (i32, [c, ctypes.POINTER(u64)]),
         "btpu_error_name": (ctypes.c_char_p, [i32]),
-        "btpu_register_hbm_provider": (None, [ctypes.c_void_p]),
+        "btpu_register_hbm_provider_v2": (None, [ctypes.c_void_p]),
     }
     for name, (restype, argtypes) in sig.items():
         fn = getattr(handle, name)

@@ -32,7 +32,12 @@ struct AllocatorStats {
   uint64_t total_objects{0};
   uint64_t total_shards{0};
   double fragmentation_ratio{0.0};  // free-weighted mean of per-pool ratios
-  std::unordered_map<StorageClass, uint64_t> bytes_per_class;
+  std::unordered_map<StorageClass, uint64_t> bytes_per_class;  // free bytes
+  // Live allocated bytes. Unlike capacity - total_free_bytes, this is
+  // correct even while pool allocators are still lazily unmaterialized
+  // (an untouched pool has no allocator and therefore no "free" bytes,
+  // which would misread as fully used).
+  std::unordered_map<StorageClass, uint64_t> allocated_per_class;
 };
 
 struct AllocationRequest {

@@ -39,6 +39,21 @@ int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_
 // Returns object size via out_size; buffer may be NULL to query size only.
 int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size,
                  uint64_t* out_size);
+/* Batched object I/O: one keystone round trip and one coalesced device
+ * transfer for the whole batch (BASELINE.md acceptance ladder item 2).
+ * out_codes[i] receives the per-item ErrorCode; the call returns 0 when the
+ * batch machinery itself ran (individual items may still have failed). */
+int32_t btpu_put_many(btpu_client* client, uint32_t n, const char* const* keys,
+                      const void* const* bufs, const uint64_t* sizes, uint32_t replicas,
+                      uint32_t max_workers, uint32_t preferred_class, int32_t* out_codes);
+/* out_sizes[i] receives the object size on success. */
+int32_t btpu_get_many(btpu_client* client, uint32_t n, const char* const* keys,
+                      void* const* bufs, const uint64_t* buf_sizes, uint64_t* out_sizes,
+                      int32_t* out_codes);
+/* Batched size probe (one keystone round trip, no data movement). */
+int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys,
+                        uint64_t* out_sizes, int32_t* out_codes);
+
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists);
 int32_t btpu_remove(btpu_client* client, const char* key);
 // out: [workers, pools, objects, capacity, used]

@@ -60,6 +60,31 @@ class ObjectClient {
   // Zero-allocation variant; buffer must hold the object (size returned).
   Result<uint64_t> get_into(const ObjectKey& key, void* buffer, uint64_t buffer_size);
 
+  // ---- batched object I/O ------------------------------------------------
+  // One keystone round trip (batch_put_start/batch_put_complete, parity:
+  // reference batch RPCs) and ONE device transfer for all HBM shards across
+  // the whole batch — device links pay per-operation latency, so batching N
+  // objects into one scatter/gather is the difference between latency-bound
+  // and bandwidth-bound throughput (BASELINE.md acceptance ladder item 2:
+  // "batched 1 MB put/get, HBM tier").
+  struct PutItem {
+    ObjectKey key;
+    const void* data{nullptr};
+    uint64_t size{0};
+  };
+  struct GetItem {
+    ObjectKey key;
+    void* buffer{nullptr};      // must hold the object
+    uint64_t buffer_size{0};
+  };
+  // Per-item results, same order as the input.
+  std::vector<Result<std::vector<CopyPlacement>>> get_workers_many(
+      const std::vector<ObjectKey>& keys);
+  std::vector<ErrorCode> put_many(const std::vector<PutItem>& items);
+  std::vector<ErrorCode> put_many(const std::vector<PutItem>& items,
+                                  const WorkerConfig& config);
+  std::vector<Result<uint64_t>> get_many(const std::vector<GetItem>& items);
+
   ErrorCode remove(const ObjectKey& key);
   Result<uint64_t> remove_all();
   Result<ClusterStats> cluster_stats();
@@ -69,6 +94,9 @@ class ObjectClient {
   // Writes `data` into every shard of `copy` (running offset), in parallel.
   ErrorCode transfer_copy_put(const CopyPlacement& copy, const uint8_t* data, uint64_t size);
   ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);
+  // Shared body: device shards as one provider batch, wire shards in parallel.
+  ErrorCode transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                          bool is_write);
   ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);
 
   static ErrorCode error_of(ErrorCode ec) noexcept { return ec; }

@@ -95,6 +95,15 @@ class StorageBackend {
 
   // Disk tiers persist bytes across restarts; memory tiers do not.
   virtual bool persistent() const { return false; }
+
+  // Device-tier backends (HBM) expose their provider region so placements
+  // can address {device, region, offset} directly instead of a flat remote
+  // pointer; 0 = not device-backed.
+  virtual uint64_t device_region_id() const { return 0; }
+  virtual const std::string& device_id() const {
+    static const std::string kNone;
+    return kNone;
+  }
 };
 
 // Builds a backend for any storage class (no nullptr gaps):

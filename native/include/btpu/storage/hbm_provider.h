@@ -9,14 +9,32 @@
 // contract" (BASELINE.json) without pretending libtpu exposes raw one-sided
 // DMA to third parties.
 //
+// v2 adds scatter/gather batch transfers and a flush barrier. Device links
+// are latency-bound per operation (one PJRT call per op), so the native data
+// movers hand the provider ONE call per multi-shard transfer and the
+// provider turns it into one host<->device transfer plus on-device
+// scatter/gather. Writes may complete asynchronously; flush() blocks until
+// every accepted write is durably in device memory.
+//
 // All functions return 0 on success, nonzero on failure.
 #pragma once
 
 #include <cstdint>
 
+#include "btpu/common/error.h"
+
 extern "C" {
 
-typedef struct BtpuHbmProviderV1 {
+// One element of a scatter/gather batch. `buf` is the host-side source
+// (writes) or destination (reads).
+typedef struct BtpuHbmIoVec {
+  uint64_t region_id;
+  uint64_t offset;
+  void* buf;
+  uint64_t len;
+} BtpuHbmIoVec;
+
+typedef struct BtpuHbmProviderV2 {
   void* ctx;
   // Allocates a device region of `size` bytes on `device_id` ("tpu:0").
   int (*alloc_region)(void* ctx, const char* device_id, uint64_t size, uint64_t* out_region_id);
@@ -26,17 +44,32 @@ typedef struct BtpuHbmProviderV1 {
   int (*read)(void* ctx, uint64_t region_id, uint64_t offset, void* dst, uint64_t len);
   // Bytes of free HBM remaining on the device (best effort; 0 = unknown).
   uint64_t (*available)(void* ctx, const char* device_id);
-} BtpuHbmProviderV1;
+  // Scatter/gather batches: the whole batch is one logical transfer and the
+  // provider is free to coalesce it into a single device op. May be null —
+  // callers must fall back to per-op write/read (hbm_batch_io does).
+  int (*write_batch)(void* ctx, const BtpuHbmIoVec* vecs, uint64_t n);
+  int (*read_batch)(void* ctx, const BtpuHbmIoVec* vecs, uint64_t n);
+  // Barrier: returns once all previously accepted writes are in device
+  // memory. May be null when writes complete synchronously.
+  int (*flush)(void* ctx);
+} BtpuHbmProviderV2;
 
 // Installs the process-wide provider (Python calls this through ctypes).
-// Passing NULL restores the built-in emulated provider.
-void btpu_register_hbm_provider(const BtpuHbmProviderV1* provider);
+// Passing NULL restores the built-in emulated provider. The v2 suffix makes
+// a stale library/binding pair fail loudly at symbol lookup instead of
+// reading past the end of a smaller struct.
+void btpu_register_hbm_provider_v2(const BtpuHbmProviderV2* provider);
 
 }  // extern "C"
 
 namespace btpu::storage {
 // Returns the active provider (emulated one if none registered).
-const BtpuHbmProviderV1& hbm_provider();
+const BtpuHbmProviderV2& hbm_provider();
 // True when the active provider is the built-in host-memory emulation.
 bool hbm_provider_is_emulated();
+// One batched transfer through the active provider, falling back to per-vec
+// write/read when the provider has no batch entry points.
+ErrorCode hbm_batch_io(const BtpuHbmIoVec* vecs, uint64_t n, bool is_write);
+// Blocks until all accepted writes are durably in device memory.
+ErrorCode hbm_flush();
 }  // namespace btpu::storage

@@ -107,6 +107,24 @@ std::unique_ptr<TransportClient> make_faulty_transport_client(
 ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_t in_off,
                    uint8_t* buf, uint64_t len, bool is_write);
 
+// One element of a multi-shard transfer (buf already points at this shard's
+// slice of the object buffer).
+struct ShardJob {
+  const ShardPlacement* shard{nullptr};
+  uint64_t in_off{0};
+  uint8_t* buf{nullptr};
+  uint64_t len{0};
+};
+
+// Moves every job in one logical transfer. DeviceLocation jobs are coalesced
+// into a single HBM-provider batch call (device links pay per-op latency —
+// one PJRT call per batch instead of per shard, see hbm_provider.h v2);
+// every other location kind goes through shard_io one by one. Callers that
+// want wire-transport parallelism should fan the non-device jobs out
+// themselves (client.cpp run_parallel does) and pass only device jobs here.
+ErrorCode shard_io_batch(TransportClient& client, const ShardJob* jobs, size_t n,
+                         bool is_write);
+
 // Formats/parses rkey hex (shared by transports and allocator tests).
 std::string rkey_to_hex(uint64_t rkey);
 

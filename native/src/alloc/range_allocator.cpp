@@ -417,6 +417,11 @@ AllocatorStats RangeAllocator::get_stats(std::optional<StorageClass> storage_cla
     stats.total_allocated_bytes += alloc.total_size;
     stats.total_shards += alloc.ranges.size();
     ++stats.total_objects;
+    for (const auto& [pool_id, range] : alloc.ranges) {
+      auto pa = pool_allocators_.find(pool_id);
+      if (pa != pool_allocators_.end())
+        stats.allocated_per_class[pa->second->storage_class()] += range.length;
+    }
   }
   // Free-weighted mean fragmentation across pools (reference :215-254).
   if (stats.total_free_bytes > 0) {

@@ -140,6 +140,70 @@ int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t bu
   return 0;
 }
 
+int32_t btpu_put_many(btpu_client* client, uint32_t n, const char* const* keys,
+                      const void* const* bufs, const uint64_t* sizes, uint32_t replicas,
+                      uint32_t max_workers, uint32_t preferred_class, int32_t* out_codes) {
+  if (!client || (n && (!keys || !bufs || !sizes)) || !out_codes)
+    return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  WorkerConfig cfg;
+  cfg.replication_factor = replicas == 0 ? 1 : replicas;
+  cfg.max_workers_per_copy = max_workers == 0 ? 1 : max_workers;
+  if (preferred_class != 0)
+    cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
+  std::vector<client::ObjectClient::PutItem> items(n);
+  for (uint32_t i = 0; i < n; ++i) items[i] = {keys[i], bufs[i], sizes[i]};
+  const auto results = client->impl->put_many(items, cfg);
+  for (uint32_t i = 0; i < n; ++i) out_codes[i] = static_cast<int32_t>(results[i]);
+  return 0;
+}
+
+int32_t btpu_get_many(btpu_client* client, uint32_t n, const char* const* keys,
+                      void* const* bufs, const uint64_t* buf_sizes, uint64_t* out_sizes,
+                      int32_t* out_codes) {
+  if (!client || (n && (!keys || !bufs || !buf_sizes)) || !out_sizes || !out_codes)
+    return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  std::vector<client::ObjectClient::GetItem> items(n);
+  for (uint32_t i = 0; i < n; ++i) items[i] = {keys[i], bufs[i], buf_sizes[i]};
+  auto results = client->impl->get_many(items);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (results[i].ok()) {
+      out_sizes[i] = results[i].value();
+      out_codes[i] = 0;
+    } else {
+      out_sizes[i] = 0;
+      out_codes[i] = static_cast<int32_t>(results[i].error());
+    }
+  }
+  return 0;
+}
+
+int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys,
+                        uint64_t* out_sizes, int32_t* out_codes) {
+  if (!client || (n && !keys) || !out_sizes || !out_codes)
+    return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  std::vector<ObjectKey> key_vec(keys, keys + n);
+  const auto placements = client->impl->get_workers_many(key_vec);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (!placements[i].ok()) {
+      out_sizes[i] = 0;
+      out_codes[i] = static_cast<int32_t>(placements[i].error());
+      continue;
+    }
+    if (placements[i].value().empty()) {
+      // Object known but no complete copy (failed put, eviction in
+      // flight): distinguishable from a genuine zero-byte object.
+      out_sizes[i] = 0;
+      out_codes[i] = static_cast<int32_t>(ErrorCode::NO_COMPLETE_WORKER);
+      continue;
+    }
+    uint64_t size = 0;
+    for (const auto& shard : placements[i].value().front().shards) size += shard.length;
+    out_sizes[i] = size;
+    out_codes[i] = 0;
+  }
+  return 0;
+}
+
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists) {
   if (!client || !key || !out_exists) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   auto r = client->impl->object_exists(key);

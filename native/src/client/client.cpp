@@ -223,8 +223,11 @@ ErrorCode run_parallel(size_t count, size_t parallelism, uint64_t bytes_per_shar
 }
 }  // namespace
 
-ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
-                                          uint64_t size) {
+// Shared by the single-object and batched paths: device-location shards are
+// coalesced into ONE provider scatter/gather call (per-op device latency is
+// the enemy, hbm_provider.h v2), wire shards fan out over the thread pool.
+ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                                      bool is_write) {
   // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
   std::vector<uint64_t> offsets(copy.shards.size());
   uint64_t off = 0;
@@ -233,25 +236,319 @@ ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8
     off += copy.shards[i].length;
   }
   if (off != size) return ErrorCode::INVALID_PARAMETERS;
-  const uint64_t per_shard = copy.shards.empty() ? 0 : size / copy.shards.size();
-  return run_parallel(copy.shards.size(), options_.io_parallelism, per_shard, [&](size_t i) {
-    return shard_io(copy.shards[i], const_cast<uint8_t*>(data) + offsets[i], /*is_write=*/true);
+  std::vector<transport::ShardJob> device_jobs;
+  std::vector<size_t> wire_idx;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    if (std::holds_alternative<DeviceLocation>(copy.shards[i].location)) {
+      device_jobs.push_back({&copy.shards[i], 0, data + offsets[i], copy.shards[i].length});
+    } else {
+      wire_idx.push_back(i);
+    }
+  }
+  if (!device_jobs.empty()) {
+    if (auto ec = transport::shard_io_batch(*data_, device_jobs.data(), device_jobs.size(),
+                                            is_write);
+        ec != ErrorCode::OK)
+      return ec;
+    // Device writes may be asynchronous; a single-object put must be durable
+    // in the tier before put_complete is sent (put_many batches this flush).
+    if (is_write) {
+      if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
+    }
+  }
+  const uint64_t per_shard = wire_idx.empty() ? 0 : size / copy.shards.size();
+  return run_parallel(wire_idx.size(), options_.io_parallelism, per_shard, [&](size_t j) {
+    const size_t i = wire_idx[j];
+    return shard_io(copy.shards[i], data + offsets[i], is_write);
   });
+}
+
+ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
+                                          uint64_t size) {
+  return transfer_copy(copy, const_cast<uint8_t*>(data), size, /*is_write=*/true);
 }
 
 ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* data,
                                           uint64_t size) {
-  std::vector<uint64_t> offsets(copy.shards.size());
+  return transfer_copy(copy, data, size, /*is_write=*/false);
+}
+
+// ---- batched object I/O ----------------------------------------------------
+
+namespace {
+
+// Per-item shard jobs for a whole batch, partitioned by data path.
+struct BatchJobs {
+  std::vector<transport::ShardJob> device;   // all items' device shards
+  std::vector<size_t> device_item;           // item index per device job
+  std::vector<transport::ShardJob> wire;     // all items' wire shards
+  std::vector<size_t> wire_item;
+};
+
+// Splits one copy of `size` bytes at `data` into jobs, appending to `jobs`.
+// Returns INVALID_PARAMETERS when the shard lengths do not sum to size.
+ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                           size_t item_index, BatchJobs& jobs) {
   uint64_t off = 0;
-  for (size_t i = 0; i < copy.shards.size(); ++i) {
-    offsets[i] = off;
-    off += copy.shards[i].length;
+  for (const auto& shard : copy.shards) {
+    if (off + shard.length > size) return ErrorCode::INVALID_PARAMETERS;
+    transport::ShardJob job{&shard, 0, data + off, shard.length};
+    if (std::holds_alternative<DeviceLocation>(shard.location)) {
+      jobs.device.push_back(job);
+      jobs.device_item.push_back(item_index);
+    } else {
+      jobs.wire.push_back(job);
+      jobs.wire_item.push_back(item_index);
+    }
+    off += shard.length;
   }
-  if (off != size) return ErrorCode::INVALID_PARAMETERS;
-  const uint64_t per_shard = copy.shards.empty() ? 0 : size / copy.shards.size();
-  return run_parallel(copy.shards.size(), options_.io_parallelism, per_shard, [&](size_t i) {
-    return shard_io(copy.shards[i], data + offsets[i], /*is_write=*/false);
+  return off == size ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
+}
+
+// Runs the device jobs as ONE provider batch; when the whole batch fails,
+// retries per job so one poisoned item cannot sink the rest, recording
+// errors into per-item slots.
+void run_device_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
+                     std::vector<ErrorCode>& item_errors) {
+  if (jobs.device.empty()) return;
+  if (transport::shard_io_batch(client, jobs.device.data(), jobs.device.size(), is_write) ==
+      ErrorCode::OK)
+    return;
+  for (size_t j = 0; j < jobs.device.size(); ++j) {
+    if (item_errors[jobs.device_item[j]] != ErrorCode::OK) continue;
+    if (auto ec = transport::shard_io_batch(client, &jobs.device[j], 1, is_write);
+        ec != ErrorCode::OK)
+      item_errors[jobs.device_item[j]] = ec;
+  }
+}
+
+}  // namespace
+
+std::vector<Result<std::vector<CopyPlacement>>> ObjectClient::get_workers_many(
+    const std::vector<ObjectKey>& keys) {
+  if (embedded_) return embedded_->batch_get_workers(keys);
+  auto r = rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& c) {
+    return c.batch_get_workers(keys);
   });
+  if (!r.ok())
+    return std::vector<Result<std::vector<CopyPlacement>>>(keys.size(), r.error());
+  return std::move(r.value());
+}
+
+std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items) {
+  return put_many(items, options_.default_config);
+}
+
+std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
+                                              const WorkerConfig& config) {
+  TRACE_SPAN("client.put_many");
+  std::vector<ErrorCode> results(items.size(), ErrorCode::OK);
+  if (items.empty()) return results;
+
+  std::vector<BatchPutStartItem> starts;
+  starts.reserve(items.size());
+  for (const auto& item : items) starts.push_back({item.key, item.size, config});
+  std::vector<Result<std::vector<CopyPlacement>>> placed;
+  if (embedded_) {
+    placed = embedded_->batch_put_start(starts);
+  } else {
+    auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+      return c.batch_put_start(starts);
+    });
+    if (!r.ok()) return std::vector<ErrorCode>(items.size(), r.error());
+    placed = std::move(r.value());
+  }
+
+  BatchJobs jobs;
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placed[i].ok()) {
+      results[i] = placed[i].error();
+      continue;
+    }
+    auto* data = const_cast<uint8_t*>(static_cast<const uint8_t*>(items[i].data));
+    for (const auto& copy : placed[i].value()) {
+      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs);
+          ec != ErrorCode::OK) {
+        results[i] = ec;
+        break;
+      }
+    }
+  }
+
+  run_device_jobs(*data_, jobs, /*is_write=*/true, results);
+  if (!jobs.wire.empty()) {
+    const uint64_t per_shard = jobs.wire.front().len;
+    // Items already failed keep their first error; wire jobs for them are
+    // skipped (their reservation is cancelled below anyway).
+    std::vector<std::atomic<uint32_t>> slots(items.size());
+    for (auto& s : slots) s.store(static_cast<uint32_t>(ErrorCode::OK));
+    run_parallel(jobs.wire.size(), options_.io_parallelism, per_shard, [&](size_t j) {
+      const size_t item = jobs.wire_item[j];
+      if (results[item] != ErrorCode::OK ||
+          slots[item].load() != static_cast<uint32_t>(ErrorCode::OK))
+        return ErrorCode::OK;  // item already failed; don't sink the batch
+      const auto& job = jobs.wire[j];
+      if (auto ec = transport::shard_io(*data_, *job.shard, job.in_off, job.buf, job.len,
+                                        /*is_write=*/true);
+          ec != ErrorCode::OK) {
+        uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
+        slots[item].compare_exchange_strong(expected, static_cast<uint32_t>(ec));
+      }
+      return ErrorCode::OK;
+    });
+    for (size_t i = 0; i < items.size(); ++i) {
+      if (results[i] == ErrorCode::OK)
+        results[i] = static_cast<ErrorCode>(slots[i].load());
+    }
+  }
+  // Device writes may be asynchronous; put_complete must not be sent until
+  // the bytes are durably in the tier.
+  if (!jobs.device.empty()) {
+    if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) {
+      for (size_t j = 0; j < jobs.device.size(); ++j) {
+        if (results[jobs.device_item[j]] == ErrorCode::OK) results[jobs.device_item[j]] = ec;
+      }
+    }
+  }
+
+  std::vector<ObjectKey> completes, cancels;
+  std::vector<size_t> complete_idx;
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placed[i].ok()) continue;  // never reserved
+    if (results[i] == ErrorCode::OK) {
+      completes.push_back(items[i].key);
+      complete_idx.push_back(i);
+    } else {
+      cancels.push_back(items[i].key);
+    }
+  }
+  if (!completes.empty()) {
+    std::vector<ErrorCode> ecs;
+    if (embedded_) {
+      ecs = embedded_->batch_put_complete(completes);
+    } else {
+      auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+        return c.batch_put_complete(completes);
+      });
+      ecs = r.ok() ? std::move(r.value())
+                   : std::vector<ErrorCode>(completes.size(), r.error());
+    }
+    for (size_t j = 0; j < complete_idx.size() && j < ecs.size(); ++j)
+      results[complete_idx[j]] = ecs[j];
+  }
+  if (!cancels.empty()) {
+    if (embedded_) {
+      embedded_->batch_put_cancel(cancels);
+    } else {
+      rpc_failover(/*idempotent=*/false,
+                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(cancels); });
+    }
+  }
+  return results;
+}
+
+std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items) {
+  TRACE_SPAN("client.get_many");
+  std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
+  if (items.empty()) return results;
+
+  std::vector<ObjectKey> keys;
+  keys.reserve(items.size());
+  for (const auto& item : items) keys.push_back(item.key);
+  std::vector<Result<std::vector<CopyPlacement>>> placements;
+  if (embedded_) {
+    placements = embedded_->batch_get_workers(keys);
+  } else {
+    auto r = rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& c) {
+      return c.batch_get_workers(keys);
+    });
+    if (!r.ok()) return std::vector<Result<uint64_t>>(items.size(), r.error());
+    placements = std::move(r.value());
+  }
+
+  // First pass: batched transfer of every item's first replica.
+  BatchJobs jobs;
+  std::vector<ErrorCode> errors(items.size(), ErrorCode::OK);
+  std::vector<uint64_t> sizes(items.size(), 0);
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placements[i].ok()) {
+      errors[i] = placements[i].error();
+      continue;
+    }
+    if (placements[i].value().empty()) {
+      errors[i] = ErrorCode::NO_COMPLETE_WORKER;
+      continue;
+    }
+    const auto& copy = placements[i].value().front();
+    uint64_t copy_size = 0;
+    for (const auto& shard : copy.shards) copy_size += shard.length;
+    sizes[i] = copy_size;
+    if (copy_size > items[i].buffer_size) {
+      errors[i] = ErrorCode::BUFFER_OVERFLOW;
+      continue;
+    }
+    if (auto ec = append_copy_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
+                                   jobs);
+        ec != ErrorCode::OK)
+      errors[i] = ec;
+  }
+  run_device_jobs(*data_, jobs, /*is_write=*/false, errors);
+  if (!jobs.wire.empty()) {
+    std::vector<std::atomic<uint32_t>> slots(items.size());
+    for (auto& s : slots) s.store(static_cast<uint32_t>(ErrorCode::OK));
+    run_parallel(jobs.wire.size(), options_.io_parallelism, jobs.wire.front().len,
+                 [&](size_t j) {
+                   const size_t item = jobs.wire_item[j];
+                   if (errors[item] != ErrorCode::OK ||
+                       slots[item].load() != static_cast<uint32_t>(ErrorCode::OK))
+                     return ErrorCode::OK;
+                   const auto& job = jobs.wire[j];
+                   if (auto ec = transport::shard_io(*data_, *job.shard, job.in_off, job.buf,
+                                                     job.len, /*is_write=*/false);
+                       ec != ErrorCode::OK) {
+                     uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
+                     slots[item].compare_exchange_strong(expected, static_cast<uint32_t>(ec));
+                   }
+                   return ErrorCode::OK;
+                 });
+    for (size_t i = 0; i < items.size(); ++i) {
+      if (errors[i] == ErrorCode::OK) errors[i] = static_cast<ErrorCode>(slots[i].load());
+    }
+  }
+
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placements[i].ok() || placements[i].value().empty() ||
+        errors[i] == ErrorCode::BUFFER_OVERFLOW) {
+      results[i] = errors[i];
+      continue;
+    }
+    if (errors[i] == ErrorCode::OK) {
+      results[i] = sizes[i];
+      continue;
+    }
+    // Replica failover, one item at a time (first copy already failed).
+    ErrorCode last = errors[i];
+    bool done = false;
+    const auto& copies = placements[i].value();
+    for (size_t c = 1; c < copies.size() && !done; ++c) {
+      uint64_t copy_size = 0;
+      for (const auto& shard : copies[c].shards) copy_size += shard.length;
+      if (copy_size > items[i].buffer_size) {
+        last = ErrorCode::BUFFER_OVERFLOW;
+        continue;
+      }
+      if (auto ec = transfer_copy_get(copies[c], static_cast<uint8_t*>(items[i].buffer),
+                                      copy_size);
+          ec == ErrorCode::OK) {
+        results[i] = copy_size;
+        done = true;
+      } else {
+        last = ec;
+      }
+    }
+    if (!done) results[i] = last;
+  }
+  return results;
 }
 
 }  // namespace btpu::client

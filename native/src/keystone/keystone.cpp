@@ -1139,9 +1139,19 @@ double KeystoneService::tier_utilization(std::optional<StorageClass> cls) const 
     }
   }
   if (capacity == 0) return 0.0;
+  // Allocated bytes, NOT capacity - free: pool allocators materialize
+  // lazily, so an untouched pool reports no free bytes and capacity-free
+  // would misread a near-empty tier as full (observed: spurious "eviction
+  // pressure ... util 1" on a fresh HBM pool, with the health loop then
+  // evicting live objects mid-benchmark).
   auto stats = adapter_.allocator().get_stats(cls);
-  const uint64_t free_bytes = stats.total_free_bytes;
-  const uint64_t used = capacity > free_bytes ? capacity - free_bytes : 0;
+  uint64_t used = 0;
+  if (cls) {
+    auto it = stats.allocated_per_class.find(*cls);
+    used = it == stats.allocated_per_class.end() ? 0 : it->second;
+  } else {
+    used = stats.total_allocated_bytes;
+  }
   return static_cast<double>(used) / static_cast<double>(capacity);
 }
 

@@ -70,17 +70,36 @@ int emu_read(void*, uint64_t region_id, uint64_t offset, void* dst, uint64_t len
 
 uint64_t emu_available(void*, const char*) { return 0; }
 
-const BtpuHbmProviderV1 kEmulatedProvider = {
-    nullptr, emu_alloc, emu_free, emu_write, emu_read, emu_available,
+int emu_write_batch(void* ctx, const BtpuHbmIoVec* vecs, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    if (emu_write(ctx, vecs[i].region_id, vecs[i].offset, vecs[i].buf, vecs[i].len) != 0)
+      return 1;
+  }
+  return 0;
+}
+
+int emu_read_batch(void* ctx, const BtpuHbmIoVec* vecs, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    if (emu_read(ctx, vecs[i].region_id, vecs[i].offset, vecs[i].buf, vecs[i].len) != 0)
+      return 1;
+  }
+  return 0;
+}
+
+int emu_flush(void*) { return 0; }  // memcpy writes are synchronous
+
+const BtpuHbmProviderV2 kEmulatedProvider = {
+    nullptr,  emu_alloc,       emu_free,       emu_write, emu_read,
+    emu_available, emu_write_batch, emu_read_batch, emu_flush,
 };
 
 std::mutex g_provider_mutex;
-BtpuHbmProviderV1 g_provider = kEmulatedProvider;
+BtpuHbmProviderV2 g_provider = kEmulatedProvider;
 bool g_provider_emulated = true;
 
 }  // namespace
 
-const BtpuHbmProviderV1& hbm_provider() {
+const BtpuHbmProviderV2& hbm_provider() {
   std::lock_guard<std::mutex> lock(g_provider_mutex);
   return g_provider;
 }
@@ -88,6 +107,31 @@ const BtpuHbmProviderV1& hbm_provider() {
 bool hbm_provider_is_emulated() {
   std::lock_guard<std::mutex> lock(g_provider_mutex);
   return g_provider_emulated;
+}
+
+ErrorCode hbm_batch_io(const BtpuHbmIoVec* vecs, uint64_t n, bool is_write) {
+  if (n == 0) return ErrorCode::OK;
+  const auto& provider = hbm_provider();
+  auto* batch_fn = is_write ? provider.write_batch : provider.read_batch;
+  if (batch_fn != nullptr) {
+    return batch_fn(provider.ctx, vecs, n) == 0 ? ErrorCode::OK
+                                                : ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  for (uint64_t i = 0; i < n; ++i) {
+    const int rc = is_write
+                       ? provider.write(provider.ctx, vecs[i].region_id, vecs[i].offset,
+                                        vecs[i].buf, vecs[i].len)
+                       : provider.read(provider.ctx, vecs[i].region_id, vecs[i].offset,
+                                       vecs[i].buf, vecs[i].len);
+    if (rc != 0) return ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode hbm_flush() {
+  const auto& provider = hbm_provider();
+  if (provider.flush == nullptr) return ErrorCode::OK;
+  return provider.flush(provider.ctx) == 0 ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR;
 }
 
 // ---- HbmBackend -----------------------------------------------------------
@@ -121,8 +165,8 @@ class HbmBackend : public OffsetBackendBase {
   }
 
   void* base_address() const override { return nullptr; }  // no host mapping
-  uint64_t region_id() const { return region_id_; }
-  const std::string& device_id() const { return config_.device_id; }
+  uint64_t device_region_id() const override { return region_id_; }
+  const std::string& device_id() const override { return config_.device_id; }
 
   ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
     if (!active_) return ErrorCode::INVALID_STATE;
@@ -151,7 +195,7 @@ std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config) {
 
 }  // namespace btpu::storage
 
-extern "C" void btpu_register_hbm_provider(const BtpuHbmProviderV1* provider) {
+extern "C" void btpu_register_hbm_provider_v2(const BtpuHbmProviderV2* provider) {
   std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
   if (provider) {
     btpu::storage::g_provider = *provider;

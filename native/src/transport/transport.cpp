@@ -133,4 +133,23 @@ ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_
   return ErrorCode::NOT_IMPLEMENTED;
 }
 
+ErrorCode shard_io_batch(TransportClient& client, const ShardJob* jobs, size_t n,
+                         bool is_write) {
+  std::vector<BtpuHbmIoVec> device_vecs;
+  for (size_t i = 0; i < n; ++i) {
+    const ShardJob& job = jobs[i];
+    if (job.len == 0) continue;
+    if (job.in_off + job.len > job.shard->length) return ErrorCode::INVALID_PARAMETERS;
+    if (const auto* dev = std::get_if<DeviceLocation>(&job.shard->location)) {
+      device_vecs.push_back(
+          {dev->region_id, dev->offset + job.in_off, job.buf, job.len});
+    } else {
+      if (auto ec = shard_io(client, *job.shard, job.in_off, job.buf, job.len, is_write);
+          ec != ErrorCode::OK)
+        return ec;
+    }
+  }
+  return storage::hbm_batch_io(device_vecs.data(), device_vecs.size(), is_write);
+}
+
 }  // namespace btpu::transport

@@ -142,7 +142,24 @@ ErrorCode WorkerService::initialize() {
     void* base = runtime.backend->base_address();
     const bool shm_cannot_serve =
         cxl_pinned && !transport_memory && primary_transport_->kind() == TransportKind::SHM;
-    if (base && !shm_cannot_serve) {
+    if (pool_cfg.storage_class == StorageClass::HBM_TPU &&
+        runtime.backend->device_region_id() != 0 &&
+        primary_transport_->kind() == TransportKind::LOCAL) {
+      // In-process data plane: advertise the provider region itself so
+      // placements become DeviceLocation and clients coalesce whole
+      // multi-shard transfers into one provider scatter/gather call
+      // (hbm_provider.h v2) instead of per-op callback reads. Cross-process
+      // workers keep the callback path below until the ICI transport can
+      // serve device regions remotely.
+      RemoteDescriptor desc;
+      desc.transport = TransportKind::HBM;
+      desc.endpoint = runtime.backend->device_id().empty() ? "tpu:0"
+                                                           : runtime.backend->device_id();
+      desc.remote_base = 0;
+      desc.rkey_hex = transport::rkey_to_hex(runtime.backend->device_region_id());
+      registered = desc;
+      runtime.record.base_addr = runtime.backend->device_region_id();
+    } else if (base && !shm_cannot_serve) {
       registered = primary_transport_->register_region(base, pool_cfg.capacity, pool_cfg.id);
       if (!registered.ok()) {
         // A mapped tier the transport claims to support failed to register:

@@ -505,3 +505,92 @@ BTEST(EndToEnd, PinnedCxlPoolUnderShmTransport) {
 
   std::filesystem::remove_all(dir);
 }
+
+BTEST(EndToEnd, PutManyGetManyBatchedRam) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(4, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  constexpr size_t kN = 12;
+  std::vector<std::vector<uint8_t>> payloads;
+  std::vector<ObjectClient::PutItem> puts;
+  for (size_t i = 0; i < kN; ++i) {
+    payloads.push_back(pattern(100 * 1024 + i * 7, static_cast<uint8_t>(i)));
+    puts.push_back({"batch/ram" + std::to_string(i), payloads[i].data(), payloads[i].size()});
+  }
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 2;
+  auto put_ecs = client->put_many(puts, cfg);
+  BT_ASSERT(put_ecs.size() == kN);
+  for (auto ec : put_ecs) BT_EXPECT(ec == ErrorCode::OK);
+
+  // Duplicate keys are rejected per item without sinking the batch.
+  auto dup_ecs = client->put_many({puts[0]}, cfg);
+  BT_EXPECT(dup_ecs[0] == ErrorCode::OBJECT_ALREADY_EXISTS);
+
+  std::vector<std::vector<uint8_t>> bufs(kN);
+  std::vector<ObjectClient::GetItem> gets;
+  for (size_t i = 0; i < kN; ++i) {
+    bufs[i].resize(payloads[i].size());
+    gets.push_back({puts[i].key, bufs[i].data(), bufs[i].size()});
+  }
+  auto got = client->get_many(gets);
+  BT_ASSERT(got.size() == kN);
+  for (size_t i = 0; i < kN; ++i) {
+    BT_ASSERT_OK(got[i]);
+    BT_EXPECT_EQ(got[i].value(), payloads[i].size());
+    BT_EXPECT(bufs[i] == payloads[i]);
+  }
+
+  // Missing keys report per item; present keys still succeed.
+  std::vector<uint8_t> small(16);
+  auto mixed = client->get_many({{"batch/ram0", bufs[0].data(), bufs[0].size()},
+                                 {"batch/definitely-missing", bufs[1].data(), bufs[1].size()},
+                                 {"batch/ram1", small.data(), small.size()}});
+  BT_ASSERT(mixed.size() == 3);
+  BT_EXPECT(mixed[0].ok());
+  BT_EXPECT(mixed[1].error() == ErrorCode::OBJECT_NOT_FOUND);
+  BT_EXPECT(mixed[2].error() == ErrorCode::BUFFER_OVERFLOW);
+}
+
+BTEST(EndToEnd, PutManyGetManyDeviceTier) {
+  // HBM pools (emulated provider): the batch must travel the provider's
+  // scatter/gather path, one coalesced call for all shards.
+  EmbeddedCluster cluster(
+      EmbeddedClusterOptions::simple(2, 16 << 20, StorageClass::HBM_TPU));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  constexpr size_t kN = 8;
+  std::vector<std::vector<uint8_t>> payloads;
+  std::vector<ObjectClient::PutItem> puts;
+  for (size_t i = 0; i < kN; ++i) {
+    payloads.push_back(pattern(1 << 20, static_cast<uint8_t>(40 + i)));
+    puts.push_back({"batch/hbm" + std::to_string(i), payloads[i].data(), payloads[i].size()});
+  }
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  cfg.preferred_classes = {StorageClass::HBM_TPU};
+  auto put_ecs = client->put_many(puts, cfg);
+  for (auto ec : put_ecs) BT_ASSERT(ec == ErrorCode::OK);
+
+  // Placements must actually be device locations (not silently spilled).
+  auto placements = client->get_workers("batch/hbm0");
+  BT_ASSERT_OK(placements);
+  BT_ASSERT(std::holds_alternative<DeviceLocation>(
+      placements.value().front().shards.front().location));
+
+  std::vector<std::vector<uint8_t>> bufs(kN);
+  std::vector<ObjectClient::GetItem> gets;
+  for (size_t i = 0; i < kN; ++i) {
+    bufs[i].resize(payloads[i].size());
+    gets.push_back({puts[i].key, bufs[i].data(), bufs[i].size()});
+  }
+  auto got = client->get_many(gets);
+  for (size_t i = 0; i < kN; ++i) {
+    BT_ASSERT_OK(got[i]);
+    BT_EXPECT(bufs[i] == payloads[i]);
+  }
+}

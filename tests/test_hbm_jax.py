@@ -9,7 +9,7 @@ from blackbird_tpu.hbm import JaxHbmProvider
 
 @pytest.fixture()
 def jax_provider():
-    provider = JaxHbmProvider(chunk_bytes=64 * 1024).register()
+    provider = JaxHbmProvider(page_bytes=64 * 1024).register()
     yield provider
     JaxHbmProvider.unregister()
 
@@ -19,7 +19,7 @@ def test_hbm_tier_backed_by_jax_buffers(jax_provider):
                          storage_class=StorageClass.HBM_TPU) as cluster:
         assert jax_provider.region_count() == 2  # one region per worker pool
         client = cluster.client()
-        payload = np.random.default_rng(11).bytes(300 * 1024)  # partial chunks too
+        payload = np.random.default_rng(11).bytes(300 * 1024)  # partial pages too
         client.put("hbm/obj", payload, max_workers=2)
         assert client.get("hbm/obj") == payload
 
@@ -39,3 +39,49 @@ def test_hbm_unaligned_edges(jax_provider):
             payload = np.random.default_rng(size).bytes(size)
             client.put(f"hbm/sz{size}", payload)
             assert client.get(f"hbm/sz{size}") == payload
+
+
+def test_hbm_batched_put_get_many(jax_provider):
+    """The batched client path must coalesce the whole batch through the
+    provider's scatter/gather entry points (BASELINE.md ladder item 2)."""
+    with EmbeddedCluster(workers=2, pool_bytes=32 << 20,
+                         storage_class=StorageClass.HBM_TPU) as cluster:
+        client = cluster.client()
+        rng = np.random.default_rng(7)
+        items = {f"hbm/batch{i}": rng.bytes((1 << 20) + i * 11) for i in range(8)}
+        client.put_many(items, max_workers=1)
+        back = client.get_many(list(items))
+        for got, (key, want) in zip(back, items.items()):
+            assert got == want, key
+
+        # Mixed batch against existing keys fails per item, not wholesale.
+        with pytest.raises(Exception, match="ALREADY_EXISTS"):
+            client.put_many({"hbm/batch0": b"x"})
+
+
+def test_hbm_write_visible_before_flush(jax_provider):
+    """Reads must observe prior writes even though writes dispatch
+    asynchronously (same-stream ordering): put then immediate get."""
+    with EmbeddedCluster(workers=1, pool_bytes=8 << 20,
+                         storage_class=StorageClass.HBM_TPU) as cluster:
+        client = cluster.client()
+        payload = np.random.default_rng(3).bytes(2 << 20)
+        client.put("hbm/rw", payload)
+        assert client.get("hbm/rw") == payload  # no explicit synchronize
+
+
+def test_hbm_overwrite_neighbor_isolation(jax_provider):
+    """Partial-page merges must not disturb neighboring bytes: two objects
+    sharing the same region, rewrite one, the other stays intact."""
+    with EmbeddedCluster(workers=1, pool_bytes=4 << 20,
+                         storage_class=StorageClass.HBM_TPU) as cluster:
+        client = cluster.client()
+        a = np.random.default_rng(1).bytes(90 * 1024)   # not page aligned
+        b = np.random.default_rng(2).bytes(70 * 1024)
+        client.put("hbm/a", a)
+        client.put("hbm/b", b)
+        client.remove("hbm/a")
+        a2 = np.random.default_rng(9).bytes(33 * 1024)
+        client.put("hbm/a2", a2)
+        assert client.get("hbm/b") == b
+        assert client.get("hbm/a2") == a2

@@ -17,15 +17,21 @@ void handle_signal(int) { g_stop = 1; }
 int main(int argc, char** argv) {
   std::string host = "0.0.0.0";
   uint16_t port = 9290;
+  btpu::coord::DurabilityOptions durability;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--host") && i + 1 < argc) host = argv[++i];
     else if (!std::strcmp(argv[i], "--port") && i + 1 < argc) port = static_cast<uint16_t>(std::stoi(argv[++i]));
+    else if (!std::strcmp(argv[i], "--data-dir") && i + 1 < argc) durability.dir = argv[++i];
+    else if (!std::strcmp(argv[i], "--no-fsync")) durability.fsync = false;
     else if (!std::strcmp(argv[i], "--help")) {
-      std::printf("usage: bb-coord [--host H] [--port P]\n");
+      std::printf("usage: bb-coord [--host H] [--port P] [--data-dir DIR] [--no-fsync]\n"
+                  "  --data-dir DIR  persist state (WAL + snapshot); restart recovers\n"
+                  "                  keys, leases (re-armed to full TTL), and objects\n"
+                  "  --no-fsync      skip per-record fsync (tests/benchmarks)\n");
       return 0;
     }
   }
-  btpu::coord::CoordServer server(host, port);
+  btpu::coord::CoordServer server(host, port, durability);
   if (server.start() != btpu::ErrorCode::OK) {
     std::fprintf(stderr, "bb-coord: failed to listen on %s:%u\n", host.c_str(), port);
     return 1;

@@ -17,7 +17,7 @@ namespace btpu::coord {
 class CoordServer {
  public:
   // host:port with port 0 = pick an ephemeral port (see port()).
-  CoordServer(std::string host, uint16_t port);
+  CoordServer(std::string host, uint16_t port, DurabilityOptions durability = {});
   ~CoordServer();
 
   ErrorCode start();

@@ -13,9 +13,23 @@
 
 namespace btpu::coord {
 
+// Durability for the coordination store (the etcd-cluster role the
+// reference delegates to deployment — etcd_service.cpp wraps a durable,
+// replicated etcd; bb-coord must survive restarts on its own). State is a
+// write-ahead log + snapshot: every mutation appends a record (fsync'd by
+// default), and the log compacts into a snapshot once it grows. On load,
+// leases are re-armed to their full TTL so live owners get one refresh
+// interval to resume heartbeats before expiry fires; elections and watches
+// are session state and are re-established by reconnecting clients.
+struct DurabilityOptions {
+  std::string dir;             // empty = memory-only (no persistence)
+  bool fsync{true};            // fsync the WAL after every record
+  size_t compact_every{4096};  // WAL records between snapshot compactions
+};
+
 class MemCoordinator : public Coordinator {
  public:
-  MemCoordinator();
+  explicit MemCoordinator(DurabilityOptions durability = {});
   ~MemCoordinator() override;
 
   Result<std::string> get(const std::string& key) override;
@@ -76,6 +90,17 @@ class MemCoordinator : public Coordinator {
   void notify(WatchEvent::Type type, const std::string& key, const std::string& value);
   ErrorCode del_locked(const std::string& key, std::unique_lock<std::mutex>& lock);
   void promote_next_locked(const std::string& election, std::unique_lock<std::mutex>& lock);
+
+  // ---- durability (no-ops when durability_.dir is empty) ----
+  void journal_load();                       // ctor only, before threads
+  void journal_append_locked(const std::vector<uint8_t>& record);
+  void journal_compact_locked();             // snapshot + truncate WAL
+  std::string snapshot_path() const;
+  std::string wal_path() const;
+
+  DurabilityOptions durability_;
+  int wal_fd_{-1};
+  size_t wal_records_{0};
 
   mutable std::mutex mutex_;
   std::map<std::string, Entry> data_;  // ordered: prefix scans are ranges

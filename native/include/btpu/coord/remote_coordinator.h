@@ -6,6 +6,7 @@
 #include <condition_variable>
 #include <mutex>
 #include <thread>
+#include <tuple>
 #include <unordered_map>
 
 #include "btpu/coord/coordinator.h"
@@ -19,6 +20,12 @@ class RemoteCoordinator : public Coordinator {
   explicit RemoteCoordinator(std::string endpoint);
   ~RemoteCoordinator() override;
 
+  // Connects both channels and replays any session state (watch
+  // registrations, election candidacies) recorded on a previous connection.
+  // Calls that hit a dead connection tear down, reconnect, and retry ONCE —
+  // so a restarted bb-coord is transparently re-joined by workers, keystone,
+  // and clients on their next heartbeat/keepalive (the etcd-client behavior
+  // the reference relies on, etcd_service.cpp:60-408).
   ErrorCode connect();
   void disconnect();
 
@@ -53,17 +60,37 @@ class RemoteCoordinator : public Coordinator {
   bool connected() const override { return connected_.load(); }
 
  private:
-  // Strict request/response on the call channel.
-  ErrorCode call(uint8_t opcode, const std::vector<uint8_t>& req, std::vector<uint8_t>& resp);
+  // Strict request/response on the call channel. `retried` (optional)
+  // reports whether the op was re-sent after a reconnect — callers of
+  // non-idempotent ops (del) use it to interpret at-least-once outcomes.
+  ErrorCode call(uint8_t opcode, const std::vector<uint8_t>& req, std::vector<uint8_t>& resp,
+                 bool* retried = nullptr);
   // Request/response on the event channel (responses interleave with pushes;
   // the reader thread routes them back via a rendezvous).
   ErrorCode event_call(uint8_t opcode, const std::vector<uint8_t>& req,
                        std::vector<uint8_t>& resp);
+  // Single attempt, no reconnect (used by the retry wrapper AND the replay
+  // path, which already holds reconnect_mutex_).
+  ErrorCode event_call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
+                           std::vector<uint8_t>& resp);
   void event_reader_loop();
+  // True for errors meaning "the connection is dead", not "the op failed".
+  static bool is_connection_error(ErrorCode ec) noexcept;
+  // Tears down and redials unless another thread already reconnected since
+  // `seen_generation`; replays watches + campaigns on success.
+  ErrorCode reconnect(uint64_t seen_generation);
+  ErrorCode connect_locked();
+  // Sends the registration for one watch / one campaign (used live + replay).
+  ErrorCode send_watch(int64_t id, const std::string& prefix);
+  ErrorCode send_campaign(const std::string& election, const std::string& candidate,
+                          int64_t ttl_ms);
 
   std::string endpoint_;
   std::atomic<bool> connected_{false};
   std::atomic<bool> stopping_{false};
+  // Set by disconnect() (under reconnect_mutex_): auto-reconnect must never
+  // resurrect a connection the owner explicitly tore down.
+  bool terminated_{false};
 
   std::mutex call_mutex_;
   net::Socket call_sock_;
@@ -76,13 +103,24 @@ class RemoteCoordinator : public Coordinator {
   std::mutex resp_mutex_;
   std::condition_variable resp_cv_;
   bool resp_ready_{false};
+  bool reader_dead_{false};  // reader exited on connection loss: wake waiters
   uint8_t resp_opcode_{0};
   std::vector<uint8_t> resp_payload_;
 
   std::mutex watch_mutex_;
   std::unordered_map<int64_t, WatchCallback> watch_cbs_;
+  std::unordered_map<int64_t, std::string> watch_prefixes_;  // for replay
   std::unordered_map<std::string, std::function<void(bool)>> leader_cbs_;  // election/candidate
+  // election/candidate -> (election, candidate, lease ttl), for replay.
+  std::unordered_map<std::string, std::tuple<std::string, std::string, int64_t>> campaigns_;
   std::atomic<int64_t> next_watch_{1};
+
+  std::mutex reconnect_mutex_;
+  std::atomic<uint64_t> generation_{0};  // bumped on every successful connect
+  // The event reader's thread id: user callbacks run on that thread, and a
+  // reconnect from inside one would self-join (deadlock) — such calls fail
+  // fast instead and the next external call redials.
+  std::atomic<std::thread::id> reader_thread_id_{};
 };
 
 }  // namespace btpu::coord

@@ -11,8 +11,8 @@ namespace btpu::coord {
 using wire::Reader;
 using wire::Writer;
 
-CoordServer::CoordServer(std::string host, uint16_t port)
-    : host_(std::move(host)), port_(port) {}
+CoordServer::CoordServer(std::string host, uint16_t port, DurabilityOptions durability)
+    : host_(std::move(host)), port_(port), store_(std::move(durability)) {}
 
 CoordServer::~CoordServer() { stop(); }
 
@@ -205,6 +205,14 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
         if (!wire::decode_fields(r, client_watch_id, prefix)) {
           w.put(ErrorCode::INVALID_PARAMETERS);
           break;
+        }
+        // Idempotent re-registration (reconnect replay + call retry can both
+        // send the same id): drop the previous store watch first, or events
+        // would be delivered twice.
+        auto existing = watches.find(client_watch_id);
+        if (existing != watches.end()) {
+          store_.unwatch(existing->second);
+          watches.erase(existing);
         }
         auto res = store_.watch_prefix(prefix, [channel, client_watch_id](const WatchEvent& ev) {
           Writer pw;

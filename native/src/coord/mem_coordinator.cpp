@@ -1,8 +1,16 @@
 #include "btpu/coord/mem_coordinator.h"
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <algorithm>
+#include <cstring>
+#include <filesystem>
+#include <fstream>
 
 #include "btpu/common/log.h"
+#include "btpu/common/wire.h"
+#include "btpu/net/net.h"
 
 namespace btpu::coord {
 
@@ -30,9 +38,260 @@ std::string object_record_key(const std::string& c, const std::string& key) {
   return objects_prefix(c) + key;
 }
 
+// ---- journal --------------------------------------------------------------
+//
+// WAL record payloads are wire-encoded, length-prefixed in the file:
+//   [u32 len][u8 type][fields]
+// A torn tail (crash mid-append) is detected by a short/oversized length and
+// the file is truncated there on load. Lease keepalives are NOT journaled:
+// recovery re-arms every lease to its full TTL instead, giving live owners
+// one refresh interval to resume before expiry fires.
+
+namespace {
+constexpr uint32_t kSnapshotMagic = 0x53435442;  // "BTCS"
+constexpr uint32_t kSnapshotVersion = 1;
+constexpr uint8_t kRecPut = 1;      // key, value, lease id (0 = none)
+constexpr uint8_t kRecDel = 2;      // key
+constexpr uint8_t kRecGrant = 3;    // lease id, ttl_ms
+constexpr uint8_t kRecRevoke = 4;   // lease id (deletes owned keys on replay)
+constexpr uint32_t kMaxRecordBytes = 64u << 20;
+
+std::vector<uint8_t> rec_put(const std::string& key, const std::string& value, int64_t lease) {
+  wire::Writer w;
+  w.put<uint8_t>(kRecPut);
+  wire::encode(w, key);
+  wire::encode(w, value);
+  w.put<int64_t>(lease);
+  return w.take();
+}
+
+std::vector<uint8_t> rec_del(const std::string& key) {
+  wire::Writer w;
+  w.put<uint8_t>(kRecDel);
+  wire::encode(w, key);
+  return w.take();
+}
+
+std::vector<uint8_t> rec_grant(int64_t id, int64_t ttl_ms) {
+  wire::Writer w;
+  w.put<uint8_t>(kRecGrant);
+  w.put<int64_t>(id);
+  w.put<int64_t>(ttl_ms);
+  return w.take();
+}
+
+std::vector<uint8_t> rec_revoke(int64_t id) {
+  wire::Writer w;
+  w.put<uint8_t>(kRecRevoke);
+  w.put<int64_t>(id);
+  return w.take();
+}
+}  // namespace
+
+std::string MemCoordinator::snapshot_path() const { return durability_.dir + "/snapshot.bin"; }
+std::string MemCoordinator::wal_path() const { return durability_.dir + "/wal.bin"; }
+
+void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
+  if (wal_fd_ < 0) return;
+  // True end of file, not SEEK_CUR: with O_APPEND the descriptor offset is 0
+  // until the first write, and a rollback from 0 would wipe the surviving WAL.
+  const off_t start = ::lseek(wal_fd_, 0, SEEK_END);
+  const uint32_t len = static_cast<uint32_t>(record.size());
+  if (net::write_all(wal_fd_, &len, sizeof(len)) != ErrorCode::OK ||
+      net::write_all(wal_fd_, record.data(), record.size()) != ErrorCode::OK) {
+    // Roll the partial record back: leaving garbage mid-file would make
+    // recovery's torn-tail truncation silently discard every LATER record.
+    if (start < 0 || ::ftruncate(wal_fd_, start) != 0) {
+      LOG_ERROR << "coordinator WAL unrecoverable (errno " << errno
+                << "); disabling persistence for this process";
+      ::close(wal_fd_);
+      wal_fd_ = -1;
+      return;
+    }
+    ::lseek(wal_fd_, start, SEEK_SET);
+    LOG_ERROR << "coordinator WAL append failed (errno " << errno << "); record dropped, "
+              << "state may not survive a restart";
+    return;
+  }
+  if (durability_.fsync) ::fsync(wal_fd_);
+  if (++wal_records_ >= durability_.compact_every) journal_compact_locked();
+}
+
+void MemCoordinator::journal_compact_locked() {
+  if (wal_fd_ < 0) return;
+  wire::Writer w;
+  w.put<uint32_t>(kSnapshotMagic);
+  w.put<uint32_t>(kSnapshotVersion);
+  w.put<uint64_t>(next_lease_.load());
+  w.put<uint64_t>(leases_.size());
+  for (const auto& [id, lease] : leases_) {
+    w.put<int64_t>(id);
+    w.put<int64_t>(lease.ttl_ms);
+  }
+  w.put<uint64_t>(data_.size());
+  for (const auto& [key, entry] : data_) {
+    wire::encode(w, key);
+    wire::encode(w, entry.value);
+    w.put<int64_t>(entry.lease);
+  }
+  const std::string tmp = snapshot_path() + ".tmp";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0 || net::write_all(fd, w.buffer().data(), w.buffer().size()) != ErrorCode::OK) {
+    LOG_ERROR << "coordinator snapshot write failed (errno " << errno << ")";
+    if (fd >= 0) ::close(fd);
+    wal_records_ = 0;  // space retries out; don't re-snapshot on every op
+    return;
+  }
+  ::fsync(fd);
+  ::close(fd);
+  if (::rename(tmp.c_str(), snapshot_path().c_str()) != 0) {
+    LOG_ERROR << "coordinator snapshot rename failed (errno " << errno << ")";
+    wal_records_ = 0;
+    return;
+  }
+  // Durable rename, then drop the WAL (replaying a few pre-snapshot records
+  // after a crash in this window is idempotent).
+  int dir_fd = ::open(durability_.dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dir_fd >= 0) {
+    ::fsync(dir_fd);
+    ::close(dir_fd);
+  }
+  ::ftruncate(wal_fd_, 0);
+  ::lseek(wal_fd_, 0, SEEK_SET);
+  wal_records_ = 0;
+  LOG_DEBUG << "coordinator journal compacted: " << data_.size() << " entries, "
+            << leases_.size() << " leases";
+}
+
+void MemCoordinator::journal_load() {
+  std::error_code fs_ec;
+  std::filesystem::create_directories(durability_.dir, fs_ec);
+
+  auto apply_put = [&](const std::string& key, std::string value, int64_t lease) {
+    if (lease != 0) {
+      auto it = leases_.find(lease);
+      if (it == leases_.end()) return;  // lease already gone: key would expire
+      it->second.keys.push_back(key);
+    }
+    data_[key] = Entry{std::move(value), lease};
+  };
+
+  // Snapshot first.
+  {
+    std::ifstream in(snapshot_path(), std::ios::binary);
+    if (in) {
+      std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
+                                 std::istreambuf_iterator<char>());
+      wire::Reader r(bytes);
+      uint32_t magic = 0, version = 0;
+      uint64_t next_lease = 0, n_leases = 0, n_entries = 0;
+      if (r.get(magic) && magic == kSnapshotMagic && r.get(version) &&
+          version == kSnapshotVersion && r.get(next_lease) && r.get(n_leases)) {
+        next_lease_ = next_lease;
+        bool ok = true;
+        for (uint64_t i = 0; ok && i < n_leases; ++i) {
+          int64_t id = 0, ttl = 0;
+          ok = r.get(id) && r.get(ttl);
+          if (ok) leases_[id] = Lease{ttl, Clock::now(), {}};  // re-armed below
+        }
+        ok = ok && r.get(n_entries);
+        for (uint64_t i = 0; ok && i < n_entries; ++i) {
+          std::string key, value;
+          int64_t lease = 0;
+          ok = wire::decode(r, key) && wire::decode(r, value) && r.get(lease);
+          if (ok) apply_put(key, std::move(value), lease);
+        }
+        if (!ok) LOG_ERROR << "coordinator snapshot truncated; continuing with partial state";
+      } else {
+        LOG_ERROR << "coordinator snapshot unreadable; ignoring";
+      }
+    }
+  }
+
+  // Then the WAL, tolerating a torn tail.
+  int64_t max_lease_seen = static_cast<int64_t>(next_lease_.load());
+  {
+    std::ifstream in(wal_path(), std::ios::binary);
+    if (in) {
+      std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
+                                 std::istreambuf_iterator<char>());
+      size_t pos = 0;
+      size_t valid_end = 0;
+      while (pos + sizeof(uint32_t) <= bytes.size()) {
+        uint32_t len = 0;
+        std::memcpy(&len, bytes.data() + pos, sizeof(len));
+        if (len == 0 || len > kMaxRecordBytes || pos + sizeof(len) + len > bytes.size()) break;
+        wire::Reader r(bytes.data() + pos + sizeof(len), len);
+        uint8_t type = 0;
+        bool ok = r.get(type);
+        std::string key, value;
+        int64_t id = 0, ttl = 0;
+        switch (ok ? type : 0) {
+          case kRecPut:
+            ok = wire::decode(r, key) && wire::decode(r, value) && r.get(id);
+            if (ok) apply_put(key, std::move(value), id);
+            break;
+          case kRecDel:
+            ok = wire::decode(r, key);
+            if (ok) data_.erase(key);
+            break;
+          case kRecGrant:
+            ok = r.get(id) && r.get(ttl);
+            // Never reset an existing lease's key list (double-replay after
+            // a crash between snapshot rename and WAL truncate).
+            if (ok && !leases_.contains(id)) leases_[id] = Lease{ttl, Clock::now(), {}};
+            if (ok) max_lease_seen = std::max(max_lease_seen, id);
+            break;
+          case kRecRevoke:
+            ok = r.get(id);
+            if (ok) {
+              auto it = leases_.find(id);
+              if (it != leases_.end()) {
+                for (const auto& k : it->second.keys) {
+                  auto entry = data_.find(k);
+                  if (entry != data_.end() && entry->second.lease == id) data_.erase(entry);
+                }
+                leases_.erase(it);
+              }
+            }
+            break;
+          default:
+            ok = false;
+        }
+        if (!ok) break;
+        pos += sizeof(len) + len;
+        valid_end = pos;
+      }
+      if (valid_end < bytes.size()) {
+        LOG_WARN << "coordinator WAL torn tail at " << valid_end << "/" << bytes.size()
+                 << " bytes; truncating";
+        ::truncate(wal_path().c_str(), static_cast<off_t>(valid_end));
+      }
+    }
+  }
+  next_lease_ = static_cast<LeaseId>(max_lease_seen) + 1;
+
+  // Re-arm every surviving lease to its full TTL: owners are reconnecting
+  // and get one refresh interval before expiry fires.
+  const auto now = Clock::now();
+  for (auto& [id, lease] : leases_) {
+    lease.deadline = now + std::chrono::milliseconds(lease.ttl_ms);
+  }
+
+  wal_fd_ = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (wal_fd_ < 0) {
+    LOG_ERROR << "coordinator WAL open failed (errno " << errno << "); running memory-only";
+  } else if (!data_.empty() || !leases_.empty()) {
+    LOG_INFO << "coordinator recovered " << data_.size() << " keys, " << leases_.size()
+             << " leases from " << durability_.dir;
+  }
+}
+
 // ---- MemCoordinator -------------------------------------------------------
 
-MemCoordinator::MemCoordinator() {
+MemCoordinator::MemCoordinator(DurabilityOptions durability)
+    : durability_(std::move(durability)) {
+  if (!durability_.dir.empty()) journal_load();
   expiry_thread_ = std::thread([this] { expiry_loop(); });
 }
 
@@ -43,6 +302,7 @@ MemCoordinator::~MemCoordinator() {
   }
   expiry_cv_.notify_all();
   if (expiry_thread_.joinable()) expiry_thread_.join();
+  if (wal_fd_ >= 0) ::close(wal_fd_);
 }
 
 void MemCoordinator::expiry_loop() {
@@ -61,6 +321,7 @@ void MemCoordinator::expiry_loop() {
       if (it == leases_.end()) continue;
       auto keys = it->second.keys;
       leases_.erase(it);
+      journal_append_locked(rec_revoke(id));
       LOG_DEBUG << "lease " << id << " expired (" << keys.size() << " keys)";
       for (const auto& key : keys) {
         // Only delete entries still owned by this lease: a key refreshed via
@@ -102,6 +363,7 @@ ErrorCode MemCoordinator::del_locked(const std::string& key, std::unique_lock<st
   auto it = data_.find(key);
   if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
   data_.erase(it);
+  journal_append_locked(rec_del(key));
   std::vector<WatchCallback> to_call;
   for (const auto& w : watches_) {
     if (key.rfind(w.prefix, 0) == 0) to_call.push_back(w.cb);
@@ -126,6 +388,7 @@ ErrorCode MemCoordinator::put(const std::string& key, const std::string& value) 
   {
     std::lock_guard<std::mutex> lock(mutex_);
     data_[key] = Entry{value, 0};
+    journal_append_locked(rec_put(key, value, 0));
   }
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
@@ -146,6 +409,7 @@ ErrorCode MemCoordinator::put_with_lease(const std::string& key, const std::stri
     if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
     it->second.keys.push_back(key);
     data_[key] = Entry{value, lease};
+    journal_append_locked(rec_put(key, value, lease));
   }
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
@@ -171,6 +435,7 @@ Result<LeaseId> MemCoordinator::lease_grant(int64_t ttl_ms) {
   std::lock_guard<std::mutex> lock(mutex_);
   LeaseId id = next_lease_++;
   leases_[id] = Lease{ttl_ms, Clock::now() + std::chrono::milliseconds(ttl_ms), {}};
+  journal_append_locked(rec_grant(id, ttl_ms));
   return id;
 }
 
@@ -188,6 +453,7 @@ ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
   if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
   auto keys = it->second.keys;
   leases_.erase(it);
+  journal_append_locked(rec_revoke(lease));
   for (const auto& key : keys) {
     auto entry = data_.find(key);
     if (entry == data_.end() || entry->second.lease != lease) continue;
@@ -279,6 +545,7 @@ ErrorCode MemCoordinator::resign(const std::string& election, const std::string&
   const LeaseId lease = me->lease;
   candidates.erase(me);
   leases_.erase(lease);
+  journal_append_locked(rec_revoke(lease));
   if (was_leader) promote_next_locked(election, lock);
   return ErrorCode::OK;
 }

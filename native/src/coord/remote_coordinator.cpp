@@ -41,19 +41,60 @@ RemoteCoordinator::RemoteCoordinator(std::string endpoint) : endpoint_(std::move
 RemoteCoordinator::~RemoteCoordinator() { disconnect(); }
 
 ErrorCode RemoteCoordinator::connect() {
+  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  terminated_ = false;  // an explicit connect() revives a disconnected client
+  return connect_locked();
+}
+
+ErrorCode RemoteCoordinator::connect_locked() {
   if (connected_) return ErrorCode::OK;
+  if (terminated_) return ErrorCode::CLIENT_DISCONNECTED;
+  if (event_reader_.joinable()) event_reader_.join();  // from a dead session
   BTPU_RETURN_IF_ERROR(open_channel(endpoint_, 0, call_sock_));
   BTPU_RETURN_IF_ERROR(open_channel(endpoint_, 1, event_sock_));
   stopping_ = false;
+  {
+    std::lock_guard<std::mutex> rlock(resp_mutex_);
+    reader_dead_ = false;
+  }
   connected_ = true;
-  event_reader_ = std::thread([this] { event_reader_loop(); });
+  generation_.fetch_add(1);
+  event_reader_ = std::thread([this] {
+    reader_thread_id_.store(std::this_thread::get_id());
+    event_reader_loop();
+  });
   LOG_DEBUG << "coordinator client connected to " << endpoint_;
+
+  // Replay session state from a previous connection (no-op on first
+  // connect): watches and election candidacies live in the server's memory
+  // and died with it.
+  std::vector<std::pair<int64_t, std::string>> watches;
+  std::vector<std::tuple<std::string, std::string, int64_t>> campaigns;
+  {
+    std::lock_guard<std::mutex> wlock(watch_mutex_);
+    for (const auto& [id, prefix] : watch_prefixes_) watches.emplace_back(id, prefix);
+    for (const auto& [key, meta] : campaigns_) campaigns.push_back(meta);
+  }
+  for (const auto& [id, prefix] : watches) {
+    if (auto ec = send_watch(id, prefix); ec != ErrorCode::OK)
+      LOG_WARN << "watch replay failed for prefix " << prefix << ": " << to_string(ec);
+  }
+  for (const auto& [election, candidate, ttl] : campaigns) {
+    if (auto ec = send_campaign(election, candidate, ttl); ec != ErrorCode::OK)
+      LOG_WARN << "campaign replay failed for " << election << "/" << candidate << ": "
+               << to_string(ec);
+  }
   return ErrorCode::OK;
 }
 
 void RemoteCoordinator::disconnect() {
-  if (!connected_.exchange(false)) return;
+  // Serialize against auto-reconnect: taking reconnect_mutex_ waits out any
+  // in-flight redial, and terminated_ stops later ones from resurrecting
+  // the connection after we tear it down.
+  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  terminated_ = true;
   stopping_ = true;
+  connected_ = false;
   call_sock_.shutdown();
   event_sock_.shutdown();  // wakes the event reader blocked in recv
   if (event_reader_.joinable()) event_reader_.join();
@@ -61,19 +102,68 @@ void RemoteCoordinator::disconnect() {
   event_sock_.close();
 }
 
-ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& req,
-                                  std::vector<uint8_t>& resp) {
-  if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
-  std::lock_guard<std::mutex> lock(call_mutex_);
-  BTPU_RETURN_IF_ERROR(net::send_frame(call_sock_.fd(), opcode, req.data(), req.size()));
-  uint8_t resp_op = 0;
-  BTPU_RETURN_IF_ERROR(net::recv_frame(call_sock_.fd(), resp_op, resp));
-  if (resp_op != opcode) return ErrorCode::RPC_FAILED;
-  return ErrorCode::OK;
+bool RemoteCoordinator::is_connection_error(ErrorCode ec) noexcept {
+  return ec == ErrorCode::CLIENT_DISCONNECTED || ec == ErrorCode::NETWORK_ERROR ||
+         ec == ErrorCode::CONNECTION_FAILED || ec == ErrorCode::OPERATION_TIMEOUT;
 }
 
-ErrorCode RemoteCoordinator::event_call(uint8_t opcode, const std::vector<uint8_t>& req,
-                                        std::vector<uint8_t>& resp) {
+ErrorCode RemoteCoordinator::reconnect(uint64_t seen_generation) {
+  // Never from the event reader thread: reconnect joins that thread, and a
+  // user watch/leader callback issuing a coordinator op on it would
+  // self-join through the mutex (deadlock). Fail fast; the next call from
+  // any other thread redials.
+  if (std::this_thread::get_id() == reader_thread_id_.load())
+    return ErrorCode::CONNECTION_FAILED;
+  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  if (terminated_) return ErrorCode::CLIENT_DISCONNECTED;
+  if (generation_.load() != seen_generation) {
+    // Another thread already reconnected since the failure was observed.
+    return connected_ ? ErrorCode::OK : ErrorCode::CONNECTION_FAILED;
+  }
+  // Tear the dead session down fully before redialing. Shutdown ALWAYS runs
+  // (even when the reader already cleared connected_): it is what wakes any
+  // thread still blocked in recv on the old sockets. Then drain in-flight
+  // RPCs by passing through their channel mutexes, so no recv can survive
+  // into the new connection and read its bytes off a reused fd.
+  stopping_ = true;
+  connected_ = false;
+  call_sock_.shutdown();
+  event_sock_.shutdown();
+  {
+    std::scoped_lock<std::mutex, std::mutex> drain(call_mutex_, event_write_mutex_);
+  }
+  if (event_reader_.joinable()) event_reader_.join();
+  call_sock_.close();
+  event_sock_.close();
+  LOG_WARN << "coordinator connection lost; redialing " << endpoint_;
+  return connect_locked();
+}
+
+ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& req,
+                                  std::vector<uint8_t>& resp, bool* retried) {
+  if (retried) *retried = false;
+  auto attempt = [&]() -> ErrorCode {
+    if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
+    std::lock_guard<std::mutex> lock(call_mutex_);
+    BTPU_RETURN_IF_ERROR(net::send_frame(call_sock_.fd(), opcode, req.data(), req.size()));
+    uint8_t resp_op = 0;
+    BTPU_RETURN_IF_ERROR(net::recv_frame(call_sock_.fd(), resp_op, resp));
+    if (resp_op != opcode) return ErrorCode::RPC_FAILED;
+    return ErrorCode::OK;
+  };
+  const uint64_t gen = generation_.load();
+  auto ec = attempt();
+  if (is_connection_error(ec) && !stopping_) {
+    if (reconnect(gen) == ErrorCode::OK) {
+      if (retried) *retried = true;
+      ec = attempt();
+    }
+  }
+  return ec;
+}
+
+ErrorCode RemoteCoordinator::event_call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
+                                            std::vector<uint8_t>& resp) {
   if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
   std::unique_lock<std::mutex> lock(event_write_mutex_);
   {
@@ -82,18 +172,81 @@ ErrorCode RemoteCoordinator::event_call(uint8_t opcode, const std::vector<uint8_
   }
   BTPU_RETURN_IF_ERROR(net::send_frame(event_sock_.fd(), opcode, req.data(), req.size()));
   std::unique_lock<std::mutex> rlock(resp_mutex_);
-  if (!resp_cv_.wait_for(rlock, std::chrono::seconds(10), [this] { return resp_ready_; }))
+  if (!resp_cv_.wait_for(rlock, std::chrono::seconds(10),
+                         [this] { return resp_ready_ || reader_dead_; }))
     return ErrorCode::OPERATION_TIMEOUT;
+  if (!resp_ready_) return ErrorCode::CLIENT_DISCONNECTED;  // reader died
   if (resp_opcode_ != opcode) return ErrorCode::RPC_FAILED;
   resp = std::move(resp_payload_);
   return ErrorCode::OK;
+}
+
+ErrorCode RemoteCoordinator::event_call(uint8_t opcode, const std::vector<uint8_t>& req,
+                                        std::vector<uint8_t>& resp) {
+  const uint64_t gen = generation_.load();
+  auto ec = event_call_raw(opcode, req, resp);
+  if (is_connection_error(ec) && !stopping_) {
+    if (reconnect(gen) == ErrorCode::OK) ec = event_call_raw(opcode, req, resp);
+  }
+  return ec;
+}
+
+ErrorCode RemoteCoordinator::send_watch(int64_t id, const std::string& prefix) {
+  Writer w;
+  w.put<int64_t>(id);
+  wire::encode(w, prefix);
+  std::vector<uint8_t> resp;
+  auto ec = event_call_raw(static_cast<uint8_t>(Op::kWatchPrefix), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  return take_status(r);
+}
+
+ErrorCode RemoteCoordinator::send_campaign(const std::string& election,
+                                           const std::string& candidate, int64_t ttl_ms) {
+  Writer w;
+  wire::encode_fields(w, election, candidate, ttl_ms);
+  std::vector<uint8_t> resp;
+  auto ec = event_call_raw(static_cast<uint8_t>(Op::kCampaign), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  if (ec == ErrorCode::CLIENT_ALREADY_EXISTS) {
+    // The surviving candidacy belongs to a previous half-dead session; when
+    // the server notices that session die it will resign it, silently
+    // evicting us. Take the candidacy over: resign the stale one, then
+    // re-register under THIS session.
+    Writer rw;
+    wire::encode_fields(rw, election, candidate);
+    std::vector<uint8_t> rresp;
+    if (auto rec = event_call_raw(static_cast<uint8_t>(Op::kResign), rw.buffer(), rresp);
+        rec != ErrorCode::OK)
+      return rec;
+    std::vector<uint8_t> cresp;
+    ec = event_call_raw(static_cast<uint8_t>(Op::kCampaign), w.buffer(), cresp);
+    if (ec != ErrorCode::OK) return ec;
+    Reader cr(cresp);
+    ec = take_status(cr);
+  }
+  return ec;
 }
 
 void RemoteCoordinator::event_reader_loop() {
   uint8_t opcode = 0;
   std::vector<uint8_t> payload;
   while (!stopping_) {
-    if (net::recv_frame(event_sock_.fd(), opcode, payload) != ErrorCode::OK) break;
+    if (net::recv_frame(event_sock_.fd(), opcode, payload) != ErrorCode::OK) {
+      // Server went away: flag the session dead so the next call redials,
+      // and wake any event_call waiter so it fails fast instead of burning
+      // its full timeout (leadership keepalives are TTL-sensitive).
+      if (!stopping_) connected_ = false;
+      {
+        std::lock_guard<std::mutex> rlock(resp_mutex_);
+        reader_dead_ = true;
+      }
+      resp_cv_.notify_all();
+      break;
+    }
     const Op op = static_cast<Op>(opcode);
     if (op == Op::kEvent) {
       Reader r(payload);
@@ -172,9 +325,15 @@ ErrorCode RemoteCoordinator::del(const std::string& key) {
   Writer w;
   wire::encode(w, key);
   std::vector<uint8_t> resp;
-  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kDel), w.buffer(), resp));
+  bool retried = false;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Op::kDel), w.buffer(), resp, &retried));
   Reader r(resp);
-  return take_status(r);
+  auto ec = take_status(r);
+  // At-least-once: when the op was re-sent after a reconnect, the first
+  // attempt may have deleted the key before the reply was lost — NOT_FOUND
+  // on the retry then means "already done", not failure.
+  if (retried && ec == ErrorCode::COORD_KEY_NOT_FOUND) return ErrorCode::OK;
+  return ec;
 }
 
 Result<std::vector<KeyValue>> RemoteCoordinator::get_with_prefix(const std::string& prefix) {
@@ -245,19 +404,18 @@ Result<WatchId> RemoteCoordinator::watch_prefix(const std::string& prefix, Watch
   {
     std::lock_guard<std::mutex> lock(watch_mutex_);
     watch_cbs_[id] = std::move(cb);
+    watch_prefixes_[id] = prefix;  // recorded first: a mid-call reconnect replays it
   }
-  Writer w;
-  w.put<int64_t>(id);
-  wire::encode(w, prefix);
-  std::vector<uint8_t> resp;
-  auto ec = event_call(static_cast<uint8_t>(Op::kWatchPrefix), w.buffer(), resp);
-  if (ec == ErrorCode::OK) {
-    Reader r(resp);
-    ec = take_status(r);
+  const uint64_t gen = generation_.load();
+  auto ec = send_watch(id, prefix);
+  if (is_connection_error(ec) && !stopping_) {
+    // reconnect() replays watch_prefixes_ (including this one) on success.
+    ec = reconnect(gen);
   }
   if (ec != ErrorCode::OK) {
     std::lock_guard<std::mutex> lock(watch_mutex_);
     watch_cbs_.erase(id);
+    watch_prefixes_.erase(id);
     return ec;
   }
   return static_cast<WatchId>(id);
@@ -274,6 +432,7 @@ ErrorCode RemoteCoordinator::unwatch(WatchId id) {
   }
   std::lock_guard<std::mutex> lock(watch_mutex_);
   watch_cbs_.erase(id);
+  watch_prefixes_.erase(id);
   return ec;
 }
 
@@ -296,21 +455,22 @@ ErrorCode RemoteCoordinator::unregister_service(const std::string& service_name,
 ErrorCode RemoteCoordinator::campaign(const std::string& election,
                                       const std::string& candidate_id, int64_t lease_ttl_ms,
                                       std::function<void(bool)> cb) {
+  const std::string key = election + "/" + candidate_id;
   {
     std::lock_guard<std::mutex> lock(watch_mutex_);
-    leader_cbs_[election + "/" + candidate_id] = std::move(cb);
+    leader_cbs_[key] = std::move(cb);
+    campaigns_[key] = {election, candidate_id, lease_ttl_ms};
   }
-  Writer w;
-  wire::encode_fields(w, election, candidate_id, lease_ttl_ms);
-  std::vector<uint8_t> resp;
-  auto ec = event_call(static_cast<uint8_t>(Op::kCampaign), w.buffer(), resp);
-  if (ec == ErrorCode::OK) {
-    Reader r(resp);
-    ec = take_status(r);
+  const uint64_t gen = generation_.load();
+  auto ec = send_campaign(election, candidate_id, lease_ttl_ms);
+  if (is_connection_error(ec) && !stopping_) {
+    // reconnect() replays campaigns_ (including this one) on success.
+    ec = reconnect(gen);
   }
   if (ec != ErrorCode::OK) {
     std::lock_guard<std::mutex> lock(watch_mutex_);
-    leader_cbs_.erase(election + "/" + candidate_id);
+    leader_cbs_.erase(key);
+    campaigns_.erase(key);
   }
   return ec;
 }
@@ -327,6 +487,7 @@ ErrorCode RemoteCoordinator::resign(const std::string& election,
   }
   std::lock_guard<std::mutex> lock(watch_mutex_);
   leader_cbs_.erase(election + "/" + candidate_id);
+  campaigns_.erase(election + "/" + candidate_id);
   return ec;
 }
 

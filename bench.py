@@ -30,11 +30,12 @@ def ensure_built() -> Path:
     return REPO_ROOT / "build" / "bb-bench"
 
 
-def run_bench(binary: Path, size: int, iterations: int):
+def run_bench(binary: Path, size: int, iterations: int, transport: str = "tcp"):
     result = subprocess.run(
         [
             str(binary), "--embedded", "4", "--size", str(size),
             "--iterations", str(iterations), "--max-workers", "4", "--json",
+            "--transport", transport,
         ],
         capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
     )
@@ -142,22 +143,37 @@ def bench_hbm_tier() -> None:
 
 def main() -> int:
     binary = ensure_built()
-    main_rows = run_bench(binary, size=1 << 20, iterations=150)
-    small_rows = run_bench(binary, size=64 << 10, iterations=300)
+    # Headline is measured over REAL sockets (TCP transport, loopback):
+    # every shard transfer crosses the kernel socket stack, like the
+    # reference's benchmark_client crosses a NIC. LOCAL (same-address-space
+    # memcpy) is reported only as a labeled ceiling on stderr.
+    main_rows = run_bench(binary, size=1 << 20, iterations=150, transport="tcp")
+    small_rows = run_bench(binary, size=64 << 10, iterations=300, transport="tcp")
+    local_rows = run_bench(binary, size=1 << 20, iterations=150, transport="local")
 
     get_gbps = main_rows["get"]["gbps"]
     print(
-        f"put 1MiB: {main_rows['put']['gbps']:.2f} GB/s (p99 {main_rows['put']['p99_us']:.0f}us) | "
-        f"get 64KiB p99: {small_rows['get']['p99_us']:.1f}us (north star <50us) | "
-        f"put 64KiB p99: {small_rows['put']['p99_us']:.1f}us",
+        f"tcp (headline): put 1MiB {main_rows['put']['gbps']:.2f} GB/s "
+        f"(p99 {main_rows['put']['p99_us']:.0f}us) | "
+        f"get 1MiB {get_gbps:.2f} GB/s (p99 {main_rows['get']['p99_us']:.0f}us) | "
+        f"get 64KiB p99 {small_rows['get']['p99_us']:.1f}us (north star <50us) | "
+        f"put 64KiB p99 {small_rows['put']['p99_us']:.1f}us",
+        file=sys.stderr,
+    )
+    print(
+        f"local ceiling (in-process memcpy, not the headline): "
+        f"put 1MiB {local_rows['put']['gbps']:.2f} GB/s | "
+        f"get 1MiB {local_rows['get']['gbps']:.2f} GB/s",
         file=sys.stderr,
     )
     bench_hbm_tier()
     print(json.dumps({
-        "metric": "get_gbps_1mib_striped4",
+        "metric": "get_gbps_1mib_striped4_tcp",
         "value": round(get_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(get_gbps / BASELINE_GBPS, 3),
+        "local_ceiling_get_gbps": round(local_rows["get"]["gbps"], 3),
+        "tcp_get_64kib_p99_us": round(small_rows["get"]["p99_us"], 1),
     }))
     return 0
 

@@ -49,6 +49,28 @@ def _box_name(boxes: list[list[int]]) -> str:
     return "x".join(f"{a}-{b}" for a, b in boxes) if boxes else "scalar"
 
 
+def _put_fresh(client, key: str, data, **kwargs) -> None:
+    """put that overwrites: on OBJECT_ALREADY_EXISTS, remove + retry once.
+
+    The store's put_start rejects existing keys (keystone.cpp put lifecycle);
+    a checkpoint save must win over whatever a crashed/partial previous save
+    left behind, including shards no longer listed in any readable meta.
+    """
+    try:
+        client.put(key, data, **kwargs)
+        return
+    except Exception as exc:  # noqa: BLE001 - duck-typed client
+        from blackbird_tpu.native import ErrorCode
+
+        if getattr(exc, "code", None) != int(ErrorCode.OBJECT_ALREADY_EXISTS):
+            raise
+    try:
+        client.remove(key)
+    except Exception:  # noqa: BLE001 - lost race / already gone
+        pass
+    client.put(key, data, **kwargs)
+
+
 def save_sharded(client, prefix: str, array, *, replicas: int = 1,
                  preferred_class=None) -> None:
     """Saves `array` (sharded or single-device) under `prefix`.
@@ -56,9 +78,12 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
     Writes one object per *distinct* shard box (replicated shards are
     deduplicated) and a `<prefix>/meta` JSON object describing them. The
     layout is multi-host safe by construction: shard keys are derived from
-    the shard's index box (not a per-process counter), the metadata is
-    computed from the GLOBAL sharding so every host writes byte-identical
-    meta, and each host puts only the shard objects it can address.
+    the shard's index box (not a per-process counter), and every object has
+    exactly ONE writer — each shard box is written by the process owning
+    the lowest device id replicating that box, and the meta object (plus
+    stale-shard cleanup) by the process owning the lowest device id in the
+    sharding. Other hosts skip those keys entirely, so no host ever trips
+    on another's put.
     """
     import jax  # local: keep module import-light for non-JAX users
 
@@ -67,6 +92,26 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
     kwargs = {"replicas": replicas}
     if preferred_class is not None:
         kwargs["preferred_class"] = preferred_class
+    my_process = jax.process_index()
+
+    # Global layout from the sharding, identical on every host; the owner
+    # of each box (lowest device id among its replicas) is its sole writer.
+    index_map = array.sharding.devices_indices_map(array.shape)
+    shards_meta: list[dict[str, Any]] = []
+    box_owner: dict[str, Any] = {}
+    for device, index in index_map.items():
+        boxes = _index_to_boxes(index)
+        name = _box_name(boxes)
+        if name not in box_owner:
+            shape = [
+                (b if b >= 0 else dim) - a for (a, b), dim in zip(boxes, array.shape)
+            ]
+            shards_meta.append(
+                {"key": f"{prefix}{_SHARD_SUFFIX}{name}", "boxes": boxes, "shape": shape}
+            )
+        if name not in box_owner or device.id < box_owner[name].id:
+            box_owner[name] = device
+    meta_owner = min(index_map, key=lambda d: d.id)
 
     # Stale shards from a previous save under this prefix must go, or a
     # re-save with fewer/different boxes would leak the rest forever.
@@ -77,35 +122,21 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
     except Exception:  # noqa: BLE001 - no previous checkpoint
         pass
 
-    # Global layout from the sharding, identical on every host.
-    index_map = array.sharding.devices_indices_map(array.shape)
-    shards_meta: list[dict[str, Any]] = []
-    seen_boxes: set[str] = set()
-    for index in index_map.values():
-        boxes = _index_to_boxes(index)
-        name = _box_name(boxes)
-        if name in seen_boxes:
-            continue  # replica of an already-listed box
-        seen_boxes.add(name)
-        shape = [
-            (b if b >= 0 else dim) - a for (a, b), dim in zip(boxes, array.shape)
-        ]
-        shards_meta.append(
-            {"key": f"{prefix}{_SHARD_SUFFIX}{name}", "boxes": boxes, "shape": shape}
-        )
-
-    # Each host writes only the shard bytes it owns (dedup within host).
-    written: set[str] = set()
     for shard in array.addressable_shards:
         name = _box_name(_index_to_boxes(shard.index))
+        if shard.device != box_owner[name]:
+            continue  # another device/host owns this box
         key = f"{prefix}{_SHARD_SUFFIX}{name}"
-        if key in written:
-            continue
-        written.add(key)
-        host = np.ascontiguousarray(np.asarray(shard.data))
         if key in old_keys:  # re-save over an existing object
-            client.remove(key)
-        client.put(key, host.reshape(-1).view(np.uint8), **kwargs)
+            try:
+                client.remove(key)
+            except Exception:  # noqa: BLE001 - listed but never written/evicted
+                pass
+        host = np.ascontiguousarray(np.asarray(shard.data))
+        _put_fresh(client, key, host.reshape(-1).view(np.uint8), **kwargs)
+
+    if meta_owner.process_index != my_process:
+        return
     meta = {
         "global_shape": list(array.shape),
         "dtype": np.dtype(array.dtype).str,
@@ -116,7 +147,7 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
             client.remove(prefix + _META_SUFFIX)
         except Exception:  # noqa: BLE001
             pass
-    client.put(prefix + _META_SUFFIX, json.dumps(meta).encode(), **kwargs)
+    _put_fresh(client, prefix + _META_SUFFIX, json.dumps(meta).encode(), **kwargs)
     # Drop old shard objects the new layout no longer references.
     for stale in old_keys - {s["key"] for s in shards_meta}:
         try:

@@ -1298,6 +1298,11 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   if (!placed.ok()) return DemoteOutcome::kFailed;
 
   // Stream from the first readable copy into the staged placements.
+  // DeviceLocation shards are readable here by construction: workers only
+  // advertise TransportKind::HBM descriptors (which yield DeviceLocation
+  // placements, range_allocator.cpp) on an in-process LOCAL data plane
+  // (worker.cpp), so a keystone seeing them shares the provider's process.
+  // Cross-process HBM pools register callback-backed regions instead.
   bool moved = false;
   for (const auto& src : old_copies) {
     if (copy_object_bytes(*data_client_, src, placed.value(), size) == ErrorCode::OK) {

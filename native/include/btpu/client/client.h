@@ -90,6 +90,13 @@ class ObjectClient {
   Result<ClusterStats> cluster_stats();
   Result<ViewVersionId> ping();
 
+  // Test-only: swaps the data-plane transport so fault-injection tests can
+  // fail the n-th shard transfer (make_faulty_transport_client). Not
+  // thread-safe against in-flight transfers.
+  void inject_data_client_for_test(std::unique_ptr<transport::TransportClient> data) {
+    data_ = std::move(data);
+  }
+
  private:
   // Writes `data` into every shard of `copy` (running offset), in parallel.
   ErrorCode transfer_copy_put(const CopyPlacement& copy, const uint8_t* data, uint64_t size);

@@ -137,6 +137,13 @@ class KeystoneService {
   void run_gc_once();
   void run_health_check_once();
 
+  // Test-only: swaps the repair/demotion data mover so fault-injection
+  // tests can fail a repair stream mid-copy. Inject before the failure
+  // event fires; not thread-safe against in-flight repairs.
+  void inject_data_client_for_test(std::unique_ptr<transport::TransportClient> client) {
+    data_client_ = std::move(client);
+  }
+
  private:
   void gc_loop();
   void health_loop();

@@ -594,3 +594,111 @@ BTEST(EndToEnd, PutManyGetManyDeviceTier) {
     BT_EXPECT(bufs[i] == payloads[i]);
   }
 }
+
+// ---- fault injection (VERDICT r1 task 6: the reference has none) ---------
+
+BTEST(FaultInjection, PutMidStripeFailureRollsBackAllocatorState) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(4, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+  auto stats_before = client->cluster_stats();
+  BT_ASSERT_OK(stats_before);
+  const uint64_t used_before = stats_before.value().used_capacity;
+
+  // Fail the 3rd shard write of a 4-shard striped put.
+  transport::FaultSpec spec;
+  spec.fail_nth_write = 3;
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 4;
+  auto data = pattern(1 << 20, 21);
+  BT_EXPECT(client->put("fault/putfail", data.data(), data.size(), cfg) ==
+            ErrorCode::NETWORK_ERROR);
+
+  // put_cancel must have rolled everything back: no metadata, no leaked
+  // ranges (used bytes return to the pre-put level), key reusable.
+  auto exists = client->object_exists("fault/putfail");
+  BT_ASSERT_OK(exists);
+  BT_EXPECT(!exists.value());
+  auto stats = client->cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().used_capacity, used_before);
+
+  // The injected fault fires exactly once; the retry lands clean.
+  BT_ASSERT(client->put("fault/putfail", data.data(), data.size(), cfg) == ErrorCode::OK);
+  auto back = client->get("fault/putfail");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(FaultInjection, GetReadFailureFailsOverToSecondReplica) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(3, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(256 * 1024, 33);
+  BT_ASSERT(client->put("fault/getfail", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  transport::FaultSpec spec;
+  spec.fail_nth_read = 1;  // first copy's read dies; client must fail over
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+  auto back = client->get("fault/getfail");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(FaultInjection, RepairStreamFailureKeepsObjectDegradedButReadable) {
+  auto options = EmbeddedClusterOptions::simple(3, 4 << 20);
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(128 * 1024, 55);
+  BT_ASSERT(client->put("fault/repair", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  // Every repair read the keystone issues fails (fail op 1, and far beyond
+  // any retry budget via a huge spec on a second injection is unnecessary:
+  // one failed stream aborts this repair pass for the object).
+  transport::FaultSpec spec;
+  spec.fail_nth_read = 1;
+  cluster.keystone().inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+
+  auto before = client->get_workers("fault/repair");
+  BT_ASSERT_OK(before);
+  const NodeId victim = before.value()[0].shards[0].worker_id;
+  size_t victim_idx = 0;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim) victim_idx = i;
+  }
+  cluster.kill_worker(victim_idx);
+
+  // The dead placement is pruned promptly even though re-replication failed.
+  BT_EXPECT(eventually([&] {
+    auto placements = client->get_workers("fault/repair");
+    if (!placements.ok()) return false;
+    for (const auto& copy : placements.value())
+      for (const auto& shard : copy.shards)
+        if (shard.worker_id == victim) return false;
+    return true;
+  }));
+
+  // Degraded (one copy) but never deleted, and still readable.
+  auto placements = client->get_workers("fault/repair");
+  BT_ASSERT_OK(placements);
+  BT_EXPECT_EQ(placements.value().size(), 1u);
+  BT_EXPECT_EQ(cluster.keystone().counters().objects_repaired.load(), 0u);
+  auto back = client->get("fault/repair");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}

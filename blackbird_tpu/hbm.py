@@ -1,6 +1,6 @@
 """JAX-backed HBM provider: TPU device buffers as the top storage tier.
 
-The native HbmBackend talks to a C ABI provider table (hbm_provider.h v2).
+The native HbmBackend talks to a C ABI provider table (hbm_provider.h v3).
 This module implements that table with JAX: a region is ONE device-resident
 uint8 buffer shaped (n_pages, PAGE); reads/writes are host<->device
 transfers.
@@ -62,10 +62,11 @@ class _IoVec(ctypes.Structure):
 
 _BATCH_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(_IoVec), _u64)
 _FLUSH_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+_COPY_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64, _u64, _u64, _u64, _u64)
 
 
 class _ProviderStruct(ctypes.Structure):
-    # Must match BtpuHbmProviderV2 (hbm_provider.h) field for field.
+    # Must match BtpuHbmProviderV3 (hbm_provider.h) field for field.
     _fields_ = [
         ("ctx", ctypes.c_void_p),
         ("alloc_region", _ALLOC_FN),
@@ -76,6 +77,7 @@ class _ProviderStruct(ctypes.Structure):
         ("write_batch", _BATCH_FN),
         ("read_batch", _BATCH_FN),
         ("flush", _FLUSH_FN),
+        ("copy", _COPY_FN),
     ]
 
 
@@ -108,6 +110,7 @@ class JaxHbmProvider:
         self._next_id = 1
         self._struct = None                      # built in register()
         self._dirty: set[int] = set()            # regions with in-flight writes
+        self.copy_calls = 0                      # device-to-device copies served
 
         P = page_bytes
         jnp = jax.numpy
@@ -370,6 +373,66 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
+    # -- device-to-device copy (the ICI path) ------------------------------
+
+    def _copy(self, _ctx, src_region, src_off, dst_region, dst_off, length):
+        """Region-to-region copy with no host staging.
+
+        Pages are gathered on the source device, moved with ONE device_put —
+        which XLA routes over ICI when the regions live on different chips —
+        and merged into the destination region's buffer on its own device.
+        Offsets must be congruent mod the page size (allocator HBM placements
+        are chunk-aligned, so this holds in practice); other layouts return
+        nonzero and the native side stages through host memory (hbm_copy)."""
+        try:
+            jax = self._jax
+            P = self.page_bytes
+            if (src_off - dst_off) % P != 0:
+                return 1
+            with self._lock:
+                src = self._regions.get(src_region)
+                dst = self._regions.get(dst_region)
+            if src is None or dst is None:
+                return 1
+            if src_off + length > src["size"] or dst_off + length > dst["size"]:
+                return 1
+            if length == 0:
+                return 0
+            spans = []  # (src_page, dst_page, v0, v1)
+            pos = 0
+            while pos < length:
+                a = (src_off + pos) % P
+                n = min(length - pos, P - a)
+                spans.append(((src_off + pos) // P, (dst_off + pos) // P, a, a + n))
+                pos += n
+            max_pages = max(1, self.max_staging_bytes // P)
+            for start in range(0, len(spans), max_pages):
+                chunk = spans[start : start + max_pages]
+                m_padded = _pow2_at_least(len(chunk))
+                gidx = np.zeros(m_padded, dtype=np.int32)
+                meta = np.zeros((3, m_padded), dtype=np.int32)
+                meta[0, :] = dst["n_pages"]  # padding rows dropped by scatter
+                for k, (sp, dp, a, b) in enumerate(chunk):
+                    gidx[k] = sp
+                    meta[0, k] = dp
+                    meta[1, k] = a
+                    meta[2, k] = b
+                # Sequential (never nested) region locks: lock order cannot
+                # deadlock with a concurrent opposite-direction copy.
+                with src["lock"]:
+                    pages = self._read_fn(src["buf"], jax.device_put(gidx, src["device"]))
+                moved = jax.device_put(pages, dst["device"])  # ICI when cross-chip
+                dev_meta = jax.device_put(meta, dst["device"])
+                with dst["lock"]:
+                    dst["buf"] = self._write_fn(dst["buf"], moved, dev_meta)
+            with self._lock:
+                if dst_region in self._regions:
+                    self._dirty.add(dst_region)
+                self.copy_calls += 1
+            return 0
+        except Exception:  # noqa: BLE001
+            return 1
+
     def _flush(self, _ctx):
         try:
             self.synchronize()
@@ -394,15 +457,16 @@ class JaxHbmProvider:
             write_batch=_BATCH_FN(self._write_batch),
             read_batch=_BATCH_FN(self._read_batch),
             flush=_FLUSH_FN(self._flush),
+            copy=_COPY_FN(self._copy),
         )
-        lib.btpu_register_hbm_provider_v2(
+        lib.btpu_register_hbm_provider_v3(
             ctypes.cast(ctypes.pointer(self._struct), ctypes.c_void_p))
         return self
 
     @staticmethod
     def unregister() -> None:
         """Restores the built-in host-memory emulation."""
-        lib.btpu_register_hbm_provider_v2(None)
+        lib.btpu_register_hbm_provider_v3(None)
 
     def region_count(self) -> int:
         with self._lock:

@@ -106,7 +106,7 @@ def _load() -> ctypes.CDLL:
         "btpu_remove": (i32, [c, ctypes.c_char_p]),
         "btpu_stats": (i32, [c, ctypes.POINTER(u64)]),
         "btpu_error_name": (ctypes.c_char_p, [i32]),
-        "btpu_register_hbm_provider_v2": (None, [ctypes.c_void_p]),
+        "btpu_register_hbm_provider_v3": (None, [ctypes.c_void_p]),
     }
     for name, (restype, argtypes) in sig.items():
         fn = getattr(handle, name)

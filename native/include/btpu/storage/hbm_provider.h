@@ -34,7 +34,7 @@ typedef struct BtpuHbmIoVec {
   uint64_t len;
 } BtpuHbmIoVec;
 
-typedef struct BtpuHbmProviderV2 {
+typedef struct BtpuHbmProviderV3 {
   void* ctx;
   // Allocates a device region of `size` bytes on `device_id` ("tpu:0").
   int (*alloc_region)(void* ctx, const char* device_id, uint64_t size, uint64_t* out_region_id);
@@ -52,19 +52,27 @@ typedef struct BtpuHbmProviderV2 {
   // Barrier: returns once all previously accepted writes are in device
   // memory. May be null when writes complete synchronously.
   int (*flush)(void* ctx);
-} BtpuHbmProviderV2;
+  // v3: device-to-device copy between regions — THE ICI data path. When the
+  // regions live on different chips the provider moves the bytes over the
+  // interconnect with no host staging (JAX provider: a device_put between
+  // committed device buffers, which XLA routes over ICI). May be null, and
+  // may fail for layouts it cannot express (callers fall back to a staged
+  // read+write through host memory — hbm_copy does).
+  int (*copy)(void* ctx, uint64_t src_region, uint64_t src_offset, uint64_t dst_region,
+              uint64_t dst_offset, uint64_t len);
+} BtpuHbmProviderV3;
 
 // Installs the process-wide provider (Python calls this through ctypes).
-// Passing NULL restores the built-in emulated provider. The v2 suffix makes
+// Passing NULL restores the built-in emulated provider. The v3 suffix makes
 // a stale library/binding pair fail loudly at symbol lookup instead of
 // reading past the end of a smaller struct.
-void btpu_register_hbm_provider_v2(const BtpuHbmProviderV2* provider);
+void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider);
 
 }  // extern "C"
 
 namespace btpu::storage {
 // Returns the active provider (emulated one if none registered).
-const BtpuHbmProviderV2& hbm_provider();
+const BtpuHbmProviderV3& hbm_provider();
 // True when the active provider is the built-in host-memory emulation.
 bool hbm_provider_is_emulated();
 // One batched transfer through the active provider, falling back to per-vec
@@ -72,4 +80,8 @@ bool hbm_provider_is_emulated();
 ErrorCode hbm_batch_io(const BtpuHbmIoVec* vecs, uint64_t n, bool is_write);
 // Blocks until all accepted writes are durably in device memory.
 ErrorCode hbm_flush();
+// Device-to-device copy (ICI when cross-chip). Uses the provider's copy
+// entry when present, else stages through a bounded host buffer.
+ErrorCode hbm_copy(uint64_t src_region, uint64_t src_offset, uint64_t dst_region,
+                   uint64_t dst_offset, uint64_t len);
 }  // namespace btpu::storage

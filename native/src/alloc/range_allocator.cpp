@@ -249,7 +249,9 @@ Result<ShardPlacement> RangeAllocator::create_shard_placement(const MemoryPoolId
   shard.remote = pool.remote;
   shard.storage_class = pool.storage_class;
   shard.length = range.length;
-  if (pool.storage_class == StorageClass::HBM_TPU && pool.remote.transport == TransportKind::HBM) {
+  if (pool.storage_class == StorageClass::HBM_TPU &&
+      (pool.remote.transport == TransportKind::HBM ||
+       pool.remote.transport == TransportKind::ICI)) {
     // On-device tier: clients address {device, region, offset} instead of a
     // flat remote pointer.
     shard.location = DeviceLocation{

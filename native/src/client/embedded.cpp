@@ -21,6 +21,13 @@ EmbeddedClusterOptions EmbeddedClusterOptions::simple(size_t n_workers, uint64_t
     pool.id = "pool-" + std::to_string(i);
     pool.storage_class = cls;
     pool.capacity = pool_bytes;
+    if (cls == StorageClass::HBM_TPU) {
+      // One chip per worker: on a mesh the provider pins region i to device
+      // i (falling back to device 0 when the process sees fewer devices), so
+      // striping across workers stripes across chips and repair streams ride
+      // the interconnect.
+      pool.device_id = "tpu:" + std::to_string(i);
+    }
     w.pools.push_back(pool);
     options.workers.push_back(std::move(w));
   }

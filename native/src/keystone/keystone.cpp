@@ -97,11 +97,59 @@ ErrorCode copy_io(transport::TransportClient& client, const CopyPlacement& copy,
   return remaining == 0 ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
 }
 
+bool all_shards_on_device(const CopyPlacement& copy) {
+  return !copy.shards.empty() &&
+         std::all_of(copy.shards.begin(), copy.shards.end(), [](const ShardPlacement& s) {
+           return std::holds_alternative<DeviceLocation>(s.location);
+         });
+}
+
+// Device-resident copy-to-copy transfer: walks both shard lists and moves
+// each overlapping segment region-to-region through the HBM provider — on a
+// TPU mesh that is the ICI path (chip-to-chip, no host staging).
+ErrorCode device_copy_object(const CopyPlacement& src, const CopyPlacement& dst,
+                             uint64_t size) {
+  size_t si = 0, di = 0;
+  uint64_t s_off = 0, d_off = 0, pos = 0;
+  while (pos < size) {
+    if (si >= src.shards.size() || di >= dst.shards.size())
+      return ErrorCode::INVALID_PARAMETERS;
+    const ShardPlacement& ss = src.shards[si];
+    const ShardPlacement& ds = dst.shards[di];
+    const auto& sl = std::get<DeviceLocation>(ss.location);
+    const auto& dl = std::get<DeviceLocation>(ds.location);
+    const uint64_t n = std::min({ss.length - s_off, ds.length - d_off, size - pos});
+    if (auto ec = storage::hbm_copy(sl.region_id, sl.offset + s_off, dl.region_id,
+                                    dl.offset + d_off, n);
+        ec != ErrorCode::OK)
+      return ec;
+    pos += n;
+    s_off += n;
+    d_off += n;
+    if (s_off == ss.length) { ++si; s_off = 0; }
+    if (d_off == ds.length) { ++di; d_off = 0; }
+  }
+  return ErrorCode::OK;
+}
+
 // Streams `size` bytes from `src` into every copy in `dsts` through a bounded
 // chunk buffer, so keystone-side data movement (repair, demotion) never
-// buffers a whole object in host memory.
+// buffers a whole object in host memory. Fully device-resident src->dst
+// pairs skip the host entirely (ICI path).
 ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
                             const std::vector<CopyPlacement>& dsts, uint64_t size) {
+  std::vector<const CopyPlacement*> staged;
+  if (all_shards_on_device(src)) {
+    for (const auto& dst : dsts) {
+      if (all_shards_on_device(dst) && device_copy_object(src, dst, size) == ErrorCode::OK)
+        continue;  // moved chip-to-chip, no host bytes
+      staged.push_back(&dst);
+    }
+  } else {
+    for (const auto& dst : dsts) staged.push_back(&dst);
+  }
+  if (staged.empty()) return ErrorCode::OK;
+
   constexpr uint64_t kChunk = 16ull << 20;
   std::vector<uint8_t> buf(static_cast<size_t>(std::min(size, kChunk)));
   for (uint64_t off = 0; off < size; off += kChunk) {
@@ -109,8 +157,8 @@ ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacem
     if (auto ec = copy_io(client, src, off, buf.data(), n, /*is_write=*/false);
         ec != ErrorCode::OK)
       return ec;
-    for (const auto& dst : dsts) {
-      if (auto ec = copy_io(client, dst, off, buf.data(), n, /*is_write=*/true);
+    for (const CopyPlacement* dst : staged) {
+      if (auto ec = copy_io(client, *dst, off, buf.data(), n, /*is_write=*/true);
           ec != ErrorCode::OK)
         return ec;
     }

@@ -3,6 +3,7 @@
 // the BASELINE.json north-star arrangement: a TPU-HBM allocator exposing the
 // same region/offset contract as every other tier.
 #include <cstdlib>
+#include <vector>
 #include <cstring>
 #include <mutex>
 #include <unordered_map>
@@ -88,18 +89,31 @@ int emu_read_batch(void* ctx, const BtpuHbmIoVec* vecs, uint64_t n) {
 
 int emu_flush(void*) { return 0; }  // memcpy writes are synchronous
 
-const BtpuHbmProviderV2 kEmulatedProvider = {
+int emu_copy(void*, uint64_t src_region, uint64_t src_off, uint64_t dst_region,
+             uint64_t dst_off, uint64_t len) {
+  auto& st = EmulatedState::instance();
+  std::lock_guard<std::mutex> lock(st.mutex);
+  auto src = st.regions.find(src_region);
+  auto dst = st.regions.find(dst_region);
+  if (src == st.regions.end() || dst == st.regions.end()) return 1;
+  if (len > src->second.second || src_off > src->second.second - len) return 1;
+  if (len > dst->second.second || dst_off > dst->second.second - len) return 1;
+  std::memmove(dst->second.first + dst_off, src->second.first + src_off, len);
+  return 0;
+}
+
+const BtpuHbmProviderV3 kEmulatedProvider = {
     nullptr,  emu_alloc,       emu_free,       emu_write, emu_read,
-    emu_available, emu_write_batch, emu_read_batch, emu_flush,
+    emu_available, emu_write_batch, emu_read_batch, emu_flush, emu_copy,
 };
 
 std::mutex g_provider_mutex;
-BtpuHbmProviderV2 g_provider = kEmulatedProvider;
+BtpuHbmProviderV3 g_provider = kEmulatedProvider;
 bool g_provider_emulated = true;
 
 }  // namespace
 
-const BtpuHbmProviderV2& hbm_provider() {
+const BtpuHbmProviderV3& hbm_provider() {
   std::lock_guard<std::mutex> lock(g_provider_mutex);
   return g_provider;
 }
@@ -132,6 +146,27 @@ ErrorCode hbm_flush() {
   const auto& provider = hbm_provider();
   if (provider.flush == nullptr) return ErrorCode::OK;
   return provider.flush(provider.ctx) == 0 ? ErrorCode::OK : ErrorCode::MEMORY_ACCESS_ERROR;
+}
+
+ErrorCode hbm_copy(uint64_t src_region, uint64_t src_offset, uint64_t dst_region,
+                   uint64_t dst_offset, uint64_t len) {
+  if (len == 0) return ErrorCode::OK;
+  const auto& provider = hbm_provider();
+  if (provider.copy != nullptr &&
+      provider.copy(provider.ctx, src_region, src_offset, dst_region, dst_offset, len) == 0)
+    return ErrorCode::OK;
+  // Fallback: bounded staging through host memory (the provider either has
+  // no device-to-device path or could not express this copy).
+  constexpr uint64_t kChunk = 16ull << 20;
+  std::vector<uint8_t> buf(static_cast<size_t>(std::min(len, kChunk)));
+  for (uint64_t off = 0; off < len; off += kChunk) {
+    const uint64_t n = std::min(kChunk, len - off);
+    if (provider.read(provider.ctx, src_region, src_offset + off, buf.data(), n) != 0)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    if (provider.write(provider.ctx, dst_region, dst_offset + off, buf.data(), n) != 0)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  return hbm_flush();
 }
 
 // ---- HbmBackend -----------------------------------------------------------
@@ -195,7 +230,7 @@ std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config) {
 
 }  // namespace btpu::storage
 
-extern "C" void btpu_register_hbm_provider_v2(const BtpuHbmProviderV2* provider) {
+extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider) {
   std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
   if (provider) {
     btpu::storage::g_provider = *provider;

@@ -28,11 +28,41 @@ std::string rkey_to_hex(uint64_t rkey) {
   return buf;
 }
 
+namespace {
+
+// ICI transport: the data plane for device-resident (HBM) pools on a TPU
+// mesh. There is no listener and no flat remote address space — regions ARE
+// device buffers owned by the HBM provider, placements are DeviceLocation
+// {device, region, offset}, and transfers go through the provider ABI:
+// host<->device for client put/get, device-to-device (riding ICI, no host
+// staging) for keystone repair/demotion via provider.copy. The reference's
+// analog is the UCX engine's registered-region + rkey contract
+// (ucx_engine.cpp:150-180); here the "registration" is the provider region
+// advertised by the worker (worker.cpp HBM branch) and the "rkey" is the
+// region id. Host-mapped tiers on an ICI worker are served by the TCP
+// virtual-region fallback instead (the DCN path) — this server deliberately
+// registers nothing itself.
+class IciTransportServer final : public TransportServer {
+ public:
+  TransportKind kind() const noexcept override { return TransportKind::ICI; }
+  ErrorCode start(const std::string&, uint16_t) override { return ErrorCode::OK; }
+  void stop() override {}
+  Result<RemoteDescriptor> register_region(void*, uint64_t, const std::string&) override {
+    // Host memory has no ICI path; workers route host tiers to the TCP
+    // virtual transport (worker.cpp fallback chain).
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
+  ErrorCode unregister_region(const RemoteDescriptor&) override { return ErrorCode::OK; }
+};
+
+}  // namespace
+
 std::unique_ptr<TransportServer> make_transport_server(TransportKind kind) {
   switch (kind) {
     case TransportKind::LOCAL: return make_local_transport_server();
     case TransportKind::TCP: return make_tcp_transport_server();
     case TransportKind::SHM: return make_shm_transport_server();
+    case TransportKind::ICI: return std::make_unique<IciTransportServer>();
     default:
       LOG_ERROR << "no transport server for kind " << transport_kind_name(kind);
       return nullptr;

@@ -144,22 +144,27 @@ ErrorCode WorkerService::initialize() {
         cxl_pinned && !transport_memory && primary_transport_->kind() == TransportKind::SHM;
     if (pool_cfg.storage_class == StorageClass::HBM_TPU &&
         runtime.backend->device_region_id() != 0 &&
-        primary_transport_->kind() == TransportKind::LOCAL) {
-      // In-process data plane: advertise the provider region itself so
+        (primary_transport_->kind() == TransportKind::LOCAL ||
+         primary_transport_->kind() == TransportKind::ICI)) {
+      // Device-resident data plane: advertise the provider region itself so
       // placements become DeviceLocation and clients coalesce whole
       // multi-shard transfers into one provider scatter/gather call
-      // (hbm_provider.h v2) instead of per-op callback reads. Cross-process
-      // workers keep the callback path below until the ICI transport can
-      // serve device regions remotely.
+      // (hbm_provider.h v3) instead of per-op callback reads. Under the ICI
+      // transport the descriptor says so, which lets placement treat the
+      // pool as mesh-addressable (repair/demotion then move bytes
+      // chip-to-chip through provider.copy with no host staging).
       RemoteDescriptor desc;
-      desc.transport = TransportKind::HBM;
+      desc.transport = primary_transport_->kind() == TransportKind::ICI
+                           ? TransportKind::ICI
+                           : TransportKind::HBM;
       desc.endpoint = runtime.backend->device_id().empty() ? "tpu:0"
                                                            : runtime.backend->device_id();
       desc.remote_base = 0;
       desc.rkey_hex = transport::rkey_to_hex(runtime.backend->device_region_id());
       registered = desc;
       runtime.record.base_addr = runtime.backend->device_region_id();
-    } else if (base && !shm_cannot_serve) {
+    } else if (base && !shm_cannot_serve &&
+               primary_transport_->kind() != TransportKind::ICI) {
       registered = primary_transport_->register_region(base, pool_cfg.capacity, pool_cfg.id);
       if (!registered.ok()) {
         // A mapped tier the transport claims to support failed to register:

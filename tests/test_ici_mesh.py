@@ -1,0 +1,78 @@
+"""Native ICI transport over the virtual 8-device mesh.
+
+Acceptance (VERDICT r1 task 3): the native put/get path runs across an
+8-device mesh with ICI-kind pools — one JAX device buffer per worker, one
+chip per worker — and keystone repair moves bytes chip-to-chip through the
+provider's device-to-device copy entry (jax.device_put between devices,
+which is the ICI hop on real TPU hardware), never through host staging.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from blackbird_tpu import EmbeddedCluster, StorageClass
+from blackbird_tpu.hbm import JaxHbmProvider
+from blackbird_tpu.native import TransportKind
+
+
+@pytest.fixture()
+def jax_provider():
+    provider = JaxHbmProvider(page_bytes=64 * 1024).register()
+    yield provider
+    JaxHbmProvider.unregister()
+
+
+def _wait_for(pred, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_ici_mesh_one_region_per_device_put_get(jax_provider):
+    with EmbeddedCluster(workers=8, pool_bytes=4 << 20,
+                         storage_class=StorageClass.HBM_TPU,
+                         transport=TransportKind.ICI) as cluster:
+        # One device region per worker pool, spread across all 8 mesh devices.
+        assert jax_provider.region_count() == 8
+        devices = {r["device"].id for r in jax_provider._regions.values()}
+        assert len(devices) == 8
+
+        client = cluster.client()
+        payload = np.random.default_rng(42).bytes(5 << 20)
+        client.put("ici/wide", payload, max_workers=8)
+        assert client.get("ici/wide") == payload
+
+
+def test_ici_repair_streams_chip_to_chip(jax_provider):
+    with EmbeddedCluster(workers=4, pool_bytes=8 << 20,
+                         storage_class=StorageClass.HBM_TPU,
+                         transport=TransportKind.ICI) as cluster:
+        client = cluster.client()
+        payload = np.random.default_rng(7).bytes(2 << 20)
+        # Two copies, each striped over two of the four workers; copies land
+        # on disjoint workers, so killing ANY worker damages exactly one copy.
+        client.put("ici/rep", payload, replicas=2, max_workers=2)
+
+        assert jax_provider.copy_calls == 0
+        cluster.kill_worker(0)
+        assert _wait_for(lambda: cluster.counters()["objects_repaired"] >= 1)
+        assert jax_provider.copy_calls > 0  # bytes moved without host staging
+        assert client.get("ici/rep") == payload
+
+
+def test_ici_batched_many_objects_roundtrip(jax_provider):
+    with EmbeddedCluster(workers=8, pool_bytes=8 << 20,
+                         storage_class=StorageClass.HBM_TPU,
+                         transport=TransportKind.ICI) as cluster:
+        client = cluster.client()
+        rng = np.random.default_rng(3)
+        items = {f"ici/b{i}": rng.bytes((1 << 20) + 13 * i) for i in range(12)}
+        client.put_many(items, max_workers=2)
+        back = client.get_many(list(items))
+        for got, (key, want) in zip(back, items.items()):
+            assert got == want, key

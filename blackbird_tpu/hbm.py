@@ -406,6 +406,7 @@ class JaxHbmProvider:
                 spans.append(((src_off + pos) // P, (dst_off + pos) // P, a, a + n))
                 pos += n
             max_pages = max(1, self.max_staging_bytes // P)
+            max_pages = 1 << (max_pages.bit_length() - 1)  # pow2: pad stays in cap
             for start in range(0, len(spans), max_pages):
                 chunk = spans[start : start + max_pages]
                 m_padded = _pow2_at_least(len(chunk))

@@ -85,3 +85,73 @@ def test_sharded_put_get_jit_compiles_once(mesh):
     pool.put("y", obj2)  # same shard shape: cache hit
     np.testing.assert_array_equal(pool.get("y", n_elems=obj2.size), obj2)
     np.testing.assert_array_equal(before, np.ones(1024, dtype=np.uint32))
+
+
+# ---- keystone mode: one namespace with the native store (VERDICT r1 #3) ----
+
+
+@pytest.fixture()
+def ici_cluster():
+    from blackbird_tpu import EmbeddedCluster, StorageClass
+    from blackbird_tpu.hbm import JaxHbmProvider
+    from blackbird_tpu.native import TransportKind
+
+    provider = JaxHbmProvider(page_bytes=64 * 1024).register()
+    try:
+        with EmbeddedCluster(workers=8, pool_bytes=8 << 20,
+                             storage_class=StorageClass.HBM_TPU,
+                             transport=TransportKind.ICI) as cluster:
+            yield cluster, provider
+    finally:
+        JaxHbmProvider.unregister()
+
+
+def test_keystone_mode_shares_namespace_with_native_client(mesh, ici_cluster):
+    cluster, _provider = ici_cluster
+    pool = ShardedPool(mesh, pool_elems_per_worker=1 << 20, cluster=cluster)
+    obj = np.random.default_rng(1).integers(0, 2**32, size=200_000, dtype=np.uint32)
+    pool.put("shared/obj", obj)
+
+    # The native client sees the same object: same key, same bytes.
+    native_client = cluster.client()
+    assert native_client.exists("shared/obj")
+    assert native_client.get("shared/obj") == obj.tobytes()
+
+    # And keystone counts it in cluster stats (metadata, not a shadow world).
+    stats = native_client.stats()
+    assert stats["objects"] == 1
+    assert stats["used"] >= obj.nbytes
+
+    # The reverse direction holds too: native puts are pool-readable.
+    native_client.put("shared/rev", np.arange(64, dtype=np.uint32).view(np.uint8))
+    np.testing.assert_array_equal(
+        pool.get("shared/rev"), np.arange(64, dtype=np.uint32))
+
+    pool.remove("shared/obj")
+    assert not native_client.exists("shared/obj")
+
+
+def test_keystone_mode_replicated_object_survives_worker_death(mesh, ici_cluster):
+    import time
+
+    cluster, provider = ici_cluster
+    pool = ShardedPool(mesh, pool_elems_per_worker=1 << 20, cluster=cluster,
+                       replicas=2)
+    obj = np.random.default_rng(2).integers(0, 2**32, size=100_000, dtype=np.uint32)
+    pool.put("ha/obj", obj)
+
+    cluster.kill_worker(0)
+    deadline = time.monotonic() + 10
+    while (cluster.counters()["workers_lost"] < 1 and time.monotonic() < deadline):
+        time.sleep(0.02)
+    # Whether or not worker 0 held a shard, the object must stay readable —
+    # keystone pruned/repaired placements, the pool just reads the key.
+    np.testing.assert_array_equal(pool.get("ha/obj"), obj)
+    expected = int(np.sum(obj, dtype=np.uint64) % (1 << 32))
+    assert pool.checksum("ha/obj") == expected
+
+
+def test_keystone_mode_rejects_mismatched_mesh(ici_cluster):
+    cluster, _provider = ici_cluster
+    with pytest.raises(ValueError, match="one device pool per row"):
+        ShardedPool(make_mesh(4), pool_elems_per_worker=1024, cluster=cluster)

@@ -76,18 +76,18 @@ def bench_hbm_tier() -> None:
             for i in range(iters)
         }
 
-        # Raw host->device link ceiling for the same total bytes, one op.
-        # The device->host ceiling is measured LAST: on tunneled dev TPUs a
-        # single large D2H degrades subsequent H2D from ~1.4 GB/s to
-        # ~0.03 GB/s for a long while (measured), so every put timing must
-        # happen before any device read.
+        # The raw host->device link is sampled immediately BEFORE each timed
+        # put round (below) so tier and ceiling are always measured in the
+        # same link regime: this tunneled dev TPU bursts ~1.5 GB/s for the
+        # first few hundred MiB of a session, then throttles to ~0.11 GB/s
+        # steady-state (measured with a bare device_put loop — no framework
+        # in the loop), so a single up-front ceiling sample would overstate
+        # the ceiling for every later round. The device->host ceiling is
+        # still measured LAST: one large D2H also degrades subsequent H2D
+        # for a long while.
         flat = np.frombuffer(b"".join(payloads.values()), dtype=np.uint8)
         dev_arr = jax.device_put(flat, device)
         dev_arr.block_until_ready()  # warm transfer path
-        t0 = time.perf_counter()
-        dev_arr = jax.device_put(flat, device)
-        dev_arr.block_until_ready()
-        link_h2d_s = time.perf_counter() - t0
 
         provider = JaxHbmProvider().register()
         try:
@@ -103,13 +103,17 @@ def bench_hbm_tier() -> None:
                 warm = {f"bench/warm{i}": payloads[f"bench/hbm{i}"] for i in range(33)}
                 client.put_many(warm, max_workers=1)
 
-                put_times = []
+                put_rounds = []  # (tier_s, matched link_s)
                 for r in range(3):
+                    t0 = time.perf_counter()
+                    dev_arr = jax.device_put(flat, device)
+                    dev_arr.block_until_ready()
+                    link_s = time.perf_counter() - t0
                     batch = {f"bench/put{r}/{i}": p for i, p in enumerate(payloads.values())}
                     t0 = time.perf_counter()
                     client.put_many(batch, max_workers=1)  # flushes internally
-                    put_times.append(time.perf_counter() - t0)
-                put_s = sorted(put_times)[1]  # median of 3 (bursty shared link)
+                    put_rounds.append((time.perf_counter() - t0, link_s))
+                put_s, link_h2d_s = sorted(put_rounds)[1]  # median round
 
                 client.get_many(list(warm))  # warm the gather executables
                 get_times = []

@@ -48,8 +48,8 @@ class Client:
             buf = data.ctypes.data_as(ctypes.c_void_p)
             size = data.nbytes
         else:
-            data = bytes(data)
-            buf = ctypes.cast(ctypes.create_string_buffer(data, len(data)), ctypes.c_void_p)
+            data = bytes(data)  # zero-copy: put never mutates the buffer
+            buf = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
             size = len(data)
         check(
             lib.btpu_put(
@@ -120,9 +120,13 @@ class Client:
                 bufs[i] = data.ctypes.data_as(ctypes.c_void_p)
                 sizes[i] = data.nbytes
             else:
-                raw = ctypes.create_string_buffer(bytes(data), len(data))
-                keep_alive.append(raw)
-                bufs[i] = ctypes.cast(raw, ctypes.c_void_p)
+                # Zero-copy: point straight into the immutable bytes object
+                # (the C side never mutates put buffers and gets an explicit
+                # length, so neither NUL-termination nor a private copy is
+                # needed — copying here cost a full memcpy of every batch).
+                data = bytes(data)
+                keep_alive.append(data)
+                bufs[i] = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
                 sizes[i] = len(data)
             keys[i] = key.encode()
         check(

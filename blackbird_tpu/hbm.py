@@ -111,6 +111,15 @@ class JaxHbmProvider:
         self._struct = None                      # built in register()
         self._dirty: set[int] = set()            # regions with in-flight writes
         self.copy_calls = 0                      # device-to-device copies served
+        # Reusable host staging buffers: re-faulting a fresh multi-MiB array
+        # every batch cost ~20 ms/64 MiB. Keyed by device; entry =
+        # [array, consumer_bufs]. Guarded by _staging_lock, which is held
+        # across fill+dispatch — concurrent writers to one device serialize,
+        # which the device link forces anyway. Lock order: _staging_lock may
+        # take a region lock inside; nothing takes _staging_lock while
+        # holding a region lock (synchronize releases region locks first).
+        self._staging: dict = {}
+        self._staging_lock = threading.Lock()
 
         P = page_bytes
         jnp = jax.numpy
@@ -215,6 +224,39 @@ class JaxHbmProvider:
                 pos += n
         return regions, grouped
 
+    @staticmethod
+    def _await_consumers(entry) -> None:
+        """Blocks until every computation that read `entry`'s buffer is done.
+
+        A consumer buffer may already have been donated away by a later
+        write/copy on its region; deletion of a donated buffer implies its
+        producing computation ran, and that computation is what read the
+        staging bytes — so "already deleted" means "safe", not an error."""
+        for consumer in entry[1]:
+            try:
+                consumer.block_until_ready()
+            except Exception:  # noqa: BLE001 - deleted == consumed
+                pass
+        entry[1] = []
+
+    def _staging_for(self, dev, rows: int, page_bytes: int) -> np.ndarray:
+        """A reusable (rows, page) host staging view for `dev`.
+
+        Before handing the buffer out again we block on every computation
+        that consumed it last round — not merely the device_put transfer:
+        the CPU backend's device_put is ZERO-COPY (the device buffer aliases
+        the staging memory), so the bytes are only safe to overwrite once
+        the merge kernels that read them have finished. Blocking on the
+        resulting region buffers covers both backends and is a no-op in
+        steady state (every put batch ends in a flush that already waited).
+        Caller holds _staging_lock."""
+        entry = self._staging.get(dev)
+        if entry is None or entry[0].shape[0] < rows or entry[0].shape[1] != page_bytes:
+            entry = self._staging[dev] = [np.empty((rows, page_bytes), dtype=np.uint8), []]
+        else:
+            self._await_consumers(entry)
+        return entry[0][:rows]
+
     # -- batched write -----------------------------------------------------
 
     def _write_vecs(self, vecs):
@@ -281,32 +323,35 @@ class JaxHbmProvider:
                     m_padded = _pow2_at_least(len(spans))
                     layouts.append((region_id, total, m_padded, spans))
                     total += m_padded
-                flat = np.empty((total, P), dtype=np.uint8)  # pad rows unused
-                meta = np.zeros((3, total), dtype=np.int32)  # idx / v0 / v1
-                for region_id, start, m_padded, spans in layouts:
-                    # Padding rows carry an out-of-bounds page index so the
-                    # scatter drops them (mode='drop').
-                    meta[0, start : start + m_padded] = regions[region_id]["n_pages"]
-                    for k, (page_idx, a, b, src) in enumerate(spans):
-                        row = start + k
-                        meta[0, row] = page_idx
-                        meta[1, row] = a
-                        meta[2, row] = b
-                        flat[row, a:b] = src
-                dev_flat = jax.device_put(flat, dev)
-                dev_meta = jax.device_put(meta, dev)
-                for region_id, start, m_padded, _spans in layouts:
-                    region = regions[region_id]
-                    if len(layouts) == 1:
-                        pages, pmeta = dev_flat, dev_meta  # no slicing dispatches
-                    else:
-                        pages = jax.lax.dynamic_slice_in_dim(dev_flat, start, m_padded, axis=0)
-                        pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
-                    with region["lock"]:
-                        region["buf"] = self._write_fn(region["buf"], pages, pmeta)
-                    with self._lock:
-                        if region_id in self._regions:
-                            self._dirty.add(region_id)
+                with self._staging_lock:
+                    flat = self._staging_for(dev, total, P)  # pad rows unused
+                    meta = np.zeros((3, total), dtype=np.int32)  # idx / v0 / v1
+                    for region_id, start, m_padded, spans in layouts:
+                        # Padding rows carry an out-of-bounds page index so
+                        # the scatter drops them (mode='drop').
+                        meta[0, start : start + m_padded] = regions[region_id]["n_pages"]
+                        for k, (page_idx, a, b, src) in enumerate(spans):
+                            row = start + k
+                            meta[0, row] = page_idx
+                            meta[1, row] = a
+                            meta[2, row] = b
+                            flat[row, a:b] = src
+                    dev_flat = jax.device_put(flat, dev)
+                    dev_meta = jax.device_put(meta, dev)
+                    for region_id, start, m_padded, _spans in layouts:
+                        region = regions[region_id]
+                        if len(layouts) == 1:
+                            pages, pmeta = dev_flat, dev_meta  # no slicing dispatches
+                        else:
+                            pages = jax.lax.dynamic_slice_in_dim(dev_flat, start, m_padded,
+                                                                 axis=0)
+                            pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
+                        with region["lock"]:
+                            region["buf"] = self._write_fn(region["buf"], pages, pmeta)
+                        self._staging[dev][1].append(region["buf"])  # guards reuse
+                        with self._lock:
+                            if region_id in self._regions:
+                                self._dirty.add(region_id)
 
     # -- batched read ------------------------------------------------------
 
@@ -493,3 +538,10 @@ class JaxHbmProvider:
                     buf.block_until_ready()
             with self._lock:
                 self._dirty.discard(region_id)
+        # Release the staging consumer pins now that writes have landed —
+        # otherwise the last-written region buffers of an idle device would
+        # stay referenced (and their HBM resident) until that device's next
+        # write, even past region free.
+        with self._staging_lock:
+            for entry in self._staging.values():
+                self._await_consumers(entry)

@@ -113,11 +113,13 @@ class JaxHbmProvider:
         self.copy_calls = 0                      # device-to-device copies served
         # Reusable host staging buffers: re-faulting a fresh multi-MiB array
         # every batch cost ~20 ms/64 MiB. Keyed by device; entry =
-        # [array, consumer_bufs]. Guarded by _staging_lock, which is held
-        # across fill+dispatch — concurrent writers to one device serialize,
-        # which the device link forces anyway. Lock order: _staging_lock may
-        # take a region lock inside; nothing takes _staging_lock while
-        # holding a region lock (synchronize releases region locks first).
+        # {buf, fences, lock}. _staging_lock guards only the dict; each
+        # entry's lock is held across that device's fill+dispatch, so
+        # concurrent writers to ONE device serialize (its link forces that
+        # anyway) while different devices proceed in parallel. Lock order:
+        # entry lock may take a region lock inside; nothing takes an entry
+        # lock while holding a region lock (synchronize releases region
+        # locks first).
         self._staging: dict = {}
         self._staging_lock = threading.Lock()
 
@@ -142,6 +144,12 @@ class JaxHbmProvider:
 
         self._write_fn = jax.jit(write_pages, donate_argnums=0)
         self._read_fn = jax.jit(lambda region, idx: region.at[idx].get(mode="clip"))
+        # Staging-reuse fence: a tiny slice of a freshly written region
+        # buffer. It executes after the merge kernel, we hold its only
+        # reference (so unlike the region buffer itself it can never be
+        # donated away at another op's dispatch), and blocking on it proves
+        # the merge — and therefore the staging read — completed.
+        self._fence_fn = jax.jit(lambda r: r[:1, :1])
 
     # -- device helpers ----------------------------------------------------
 
@@ -225,37 +233,44 @@ class JaxHbmProvider:
         return regions, grouped
 
     @staticmethod
-    def _await_consumers(entry) -> None:
-        """Blocks until every computation that read `entry`'s buffer is done.
+    def _await_fences(entry) -> None:
+        """Blocks until every fence for `entry`'s buffer has executed.
 
-        A consumer buffer may already have been donated away by a later
-        write/copy on its region; deletion of a donated buffer implies its
-        producing computation ran, and that computation is what read the
-        staging bytes — so "already deleted" means "safe", not an error."""
-        for consumer in entry[1]:
+        Fences are never donated (this provider holds their only reference),
+        so block_until_ready cannot see a deleted array; the guard stays for
+        interpreter-shutdown robustness only. Caller holds entry["lock"]."""
+        for fence in entry["fences"]:
             try:
-                consumer.block_until_ready()
-            except Exception:  # noqa: BLE001 - deleted == consumed
+                fence.block_until_ready()
+            except Exception:  # noqa: BLE001 - teardown only
                 pass
-        entry[1] = []
+        entry["fences"] = []
 
-    def _staging_for(self, dev, rows: int, page_bytes: int) -> np.ndarray:
-        """A reusable (rows, page) host staging view for `dev`.
+    def _staging_entry(self, dev) -> dict:
+        with self._staging_lock:
+            entry = self._staging.get(dev)
+            if entry is None:
+                entry = self._staging[dev] = {
+                    "buf": None, "fences": [], "lock": threading.Lock()}
+            return entry
 
-        Before handing the buffer out again we block on every computation
-        that consumed it last round — not merely the device_put transfer:
-        the CPU backend's device_put is ZERO-COPY (the device buffer aliases
-        the staging memory), so the bytes are only safe to overwrite once
-        the merge kernels that read them have finished. Blocking on the
-        resulting region buffers covers both backends and is a no-op in
-        steady state (every put batch ends in a flush that already waited).
-        Caller holds _staging_lock."""
-        entry = self._staging.get(dev)
-        if entry is None or entry[0].shape[0] < rows or entry[0].shape[1] != page_bytes:
-            entry = self._staging[dev] = [np.empty((rows, page_bytes), dtype=np.uint8), []]
+    def _staging_for(self, entry, rows: int, page_bytes: int) -> np.ndarray:
+        """A reusable (rows, page) host staging view for one device.
+
+        Before handing the buffer out again we block on the fences of every
+        computation that consumed it last round — not merely the device_put
+        transfer: the CPU backend's device_put is ZERO-COPY (the device
+        buffer aliases the staging memory), so the bytes are only safe to
+        overwrite once the merge kernels that read them have finished. The
+        wait is a no-op in steady state (every put batch ends in a flush
+        that already waited). Caller holds entry["lock"]."""
+        buf = entry["buf"]
+        if buf is None or buf.shape[0] < rows or buf.shape[1] != page_bytes:
+            self._await_fences(entry)  # old buffer may still be being read
+            buf = entry["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
         else:
-            self._await_consumers(entry)
-        return entry[0][:rows]
+            self._await_fences(entry)
+        return buf[:rows]
 
     # -- batched write -----------------------------------------------------
 
@@ -323,8 +338,9 @@ class JaxHbmProvider:
                     m_padded = _pow2_at_least(len(spans))
                     layouts.append((region_id, total, m_padded, spans))
                     total += m_padded
-                with self._staging_lock:
-                    flat = self._staging_for(dev, total, P)  # pad rows unused
+                entry = self._staging_entry(dev)
+                with entry["lock"]:
+                    flat = self._staging_for(entry, total, P)  # pad rows unused
                     meta = np.zeros((3, total), dtype=np.int32)  # idx / v0 / v1
                     for region_id, start, m_padded, spans in layouts:
                         # Padding rows carry an out-of-bounds page index so
@@ -348,7 +364,7 @@ class JaxHbmProvider:
                             pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
                         with region["lock"]:
                             region["buf"] = self._write_fn(region["buf"], pages, pmeta)
-                        self._staging[dev][1].append(region["buf"])  # guards reuse
+                            entry["fences"].append(self._fence_fn(region["buf"]))
                         with self._lock:
                             if region_id in self._regions:
                                 self._dirty.add(region_id)
@@ -538,10 +554,11 @@ class JaxHbmProvider:
                     buf.block_until_ready()
             with self._lock:
                 self._dirty.discard(region_id)
-        # Release the staging consumer pins now that writes have landed —
-        # otherwise the last-written region buffers of an idle device would
-        # stay referenced (and their HBM resident) until that device's next
-        # write, even past region free.
+        # Drop completed fences so an idle device's list cannot grow stale
+        # references between writes (fences are one element each, so this is
+        # hygiene, not memory pressure).
         with self._staging_lock:
-            for entry in self._staging.values():
-                self._await_consumers(entry)
+            entries = list(self._staging.values())
+        for entry in entries:
+            with entry["lock"]:
+                self._await_fences(entry)

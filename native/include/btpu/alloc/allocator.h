@@ -59,7 +59,7 @@ struct AllocationRequest {
 
   bool enable_striping{true};
   bool prefer_contiguous{false};
-  uint64_t min_shard_size{4096};
+  uint64_t min_shard_size{256 * 1024};  // see WorkerConfig::min_shard_size
 
   // TPU extension: slice affinity. >=0 ranks same-slice pools first so
   // copies ride ICI; cross-slice (DCN) pools are used only as spillover.

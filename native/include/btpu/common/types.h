@@ -168,7 +168,12 @@ struct WorkerConfig {
   uint64_t ttl_ms{30ull * 60ull * 1000ull};
   bool enable_locality_awareness{true};
   bool prefer_contiguous{false};
-  size_t min_shard_size{4096};
+  // Striping floor: never split so wide that shards drop below this. The
+  // default keeps latency-bound small objects (the <50 us p99 64 KiB north
+  // star, BASELINE.md) on a SINGLE shard — one wire round trip — while
+  // bandwidth-bound objects >=2x this still stripe. Lower it explicitly for
+  // workloads that want tiny wide stripes.
+  size_t min_shard_size{256 * 1024};
   // TPU extension: when set, placement prefers pools on this slice and only
   // spills across slices (DCN) when the slice cannot hold the object.
   int32_t preferred_slice{-1};

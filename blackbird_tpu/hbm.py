@@ -264,12 +264,10 @@ class JaxHbmProvider:
         overwrite once the merge kernels that read them have finished. The
         wait is a no-op in steady state (every put batch ends in a flush
         that already waited). Caller holds entry["lock"]."""
+        self._await_fences(entry)  # also covers an old buffer being replaced
         buf = entry["buf"]
         if buf is None or buf.shape[0] < rows or buf.shape[1] != page_bytes:
-            self._await_fences(entry)  # old buffer may still be being read
             buf = entry["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
-        else:
-            self._await_fences(entry)
         return buf[:rows]
 
     # -- batched write -----------------------------------------------------

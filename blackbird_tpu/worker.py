@@ -1,0 +1,90 @@
+"""Standalone worker host: the production TPU-VM worker process.
+
+On a TPU VM the process that owns the chip is the one with the JAX runtime
+in it, so the native worker must run in THAT process for the HBM tier to
+serve real device memory — the pure-C++ `bb-worker` can only offer the
+emulated (host-memory) provider. This module is the deployment shape for
+device-tier workers:
+
+    python -m blackbird_tpu.worker --config worker.yaml \
+        [--coord host:port[,host:port...]] [--no-jax]
+
+It registers a `JaxHbmProvider` (unless --no-jax), then starts the native
+WorkerService from the same worker.yaml `bb-worker` reads: pools come up,
+transport regions register (HBM pools as callback-backed regions served by
+the provider — cross-process clients reach them over the worker's TCP/SHM
+data plane; in-process ICI meshes use EmbeddedCluster instead), the worker
+advertises itself to the coordinator and heartbeats. Role parity:
+reference examples/worker_example.cpp + src/worker/worker_service.cpp,
+with the device tier actually functional (the reference's RAM_GPU tier was
+broken, worker_service.cpp:196).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from blackbird_tpu.native import lib
+
+
+class WorkerHost:
+    """A running native worker, optionally fronting JAX device memory."""
+
+    def __init__(self, config_path: str, coord: str | None = None,
+                 jax_provider: bool = True):
+        self._provider = None
+        if jax_provider:
+            from blackbird_tpu.hbm import JaxHbmProvider
+
+            self._provider = JaxHbmProvider().register()
+        self._handle = lib.btpu_worker_create(
+            config_path.encode(), coord.encode() if coord else None)
+        if not self._handle:
+            if self._provider is not None:
+                self._provider.unregister()
+            raise RuntimeError(f"worker startup failed (config {config_path!r})")
+
+    @property
+    def pool_count(self) -> int:
+        return lib.btpu_worker_pool_count(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            lib.btpu_worker_destroy(self._handle)
+            self._handle = None
+        if self._provider is not None:
+            self._provider.unregister()
+            self._provider = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--config", required=True, help="worker.yaml path")
+    parser.add_argument("--coord", default=None,
+                        help="coordinator endpoint list override (host:port,...)")
+    parser.add_argument("--no-jax", action="store_true",
+                        help="skip the JAX HBM provider (host tiers only)")
+    args = parser.parse_args(argv)
+
+    host = WorkerHost(args.config, coord=args.coord, jax_provider=not args.no_jax)
+    print(f"worker up with {host.pool_count} pools", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    host.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

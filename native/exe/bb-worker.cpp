@@ -6,7 +6,6 @@
 #include <thread>
 
 #include "btpu/common/log.h"
-#include "btpu/coord/remote_coordinator.h"
 #include "btpu/worker/worker.h"
 
 namespace {
@@ -30,31 +29,15 @@ int main(int argc, char** argv) {
     return 1;
   }
 
-  btpu::worker::WorkerServiceConfig config;
-  try {
-    config = btpu::worker::WorkerServiceConfig::from_yaml(config_path);
-  } catch (const std::exception& e) {
-    std::fprintf(stderr, "bb-worker: %s\n", e.what());
+  auto service = btpu::worker::WorkerService::create_from_yaml(config_path, coord_override);
+  if (!service.ok()) {
+    std::fprintf(stderr, "bb-worker: startup failed (%s)\n",
+                 std::string(btpu::to_string(service.error())).c_str());
     return 1;
   }
-  if (!coord_override.empty()) config.coord_endpoints = coord_override;
-
-  std::shared_ptr<btpu::coord::Coordinator> coordinator;
-  if (!config.coord_endpoints.empty()) {
-    auto remote = std::make_shared<btpu::coord::RemoteCoordinator>(config.coord_endpoints);
-    if (remote->connect() != btpu::ErrorCode::OK) {
-      std::fprintf(stderr, "bb-worker: cannot reach coordinator at %s\n",
-                   config.coord_endpoints.c_str());
-      return 1;
-    }
-    coordinator = remote;
-  }
-
-  btpu::worker::WorkerService worker(config, coordinator);
-  if (worker.initialize() != btpu::ErrorCode::OK || worker.start() != btpu::ErrorCode::OK) {
-    std::fprintf(stderr, "bb-worker: startup failed\n");
-    return 1;
-  }
+  auto worker_ptr = std::move(service).value();
+  auto& worker = *worker_ptr;
+  const auto& config = worker.config();
   std::printf("bb-worker %s up with %zu pools\n", config.worker_id.c_str(),
               config.pools.size());
   std::fflush(stdout);

@@ -26,6 +26,18 @@ uint32_t btpu_cluster_worker_count(btpu_cluster* cluster);
 // Counters snapshot: [repaired, lost, evicted, gc_collected, workers_lost, demoted].
 void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[6]);
 
+/* Standalone worker daemon, for Python worker hosts: on a real TPU VM the
+ * process that owns the chip (the JAX runtime) must also run the native
+ * worker so the HBM provider serves device pools in-process; C++ bb-worker
+ * can only offer the emulated provider. Loads the same worker.yaml as
+ * bb-worker; coord_endpoints (may be NULL) overrides the config's
+ * coordinator list. Returns NULL on any startup failure. */
+typedef struct btpu_worker btpu_worker;
+btpu_worker* btpu_worker_create(const char* config_yaml_path, const char* coord_endpoints);
+/* Worker id / pool count introspection for logs. */
+uint32_t btpu_worker_pool_count(btpu_worker* worker);
+void btpu_worker_destroy(btpu_worker* worker);
+
 btpu_client* btpu_client_create_embedded(btpu_cluster* cluster);
 /* keystone_endpoint accepts a comma-separated list: the first entry is the
  * primary, the rest HA fallbacks rotated through on NOT_LEADER / connection

@@ -118,6 +118,9 @@ class KeystoneService {
   std::vector<ErrorCode> batch_put_cancel(const std::vector<ObjectKey>& keys);
 
   Result<ClusterStats> get_cluster_stats() const;
+  // Allocator view with per-storage-class breakdowns (metrics exports the
+  // same numbers tier-aware eviction keys off).
+  alloc::AllocatorStats allocator_stats() const { return adapter_.get_stats(); }
   ViewVersionId get_view_version() const noexcept { return view_version_.load(); }
 
   // ---- registry (coordinator watches call these; embedded mode calls them

@@ -59,6 +59,12 @@ struct WorkerServiceConfig {
 class WorkerService {
  public:
   WorkerService(WorkerServiceConfig config, std::shared_ptr<coord::Coordinator> coordinator);
+
+  // One-call production startup shared by bb-worker and the Python worker
+  // host (capi): yaml load (+ optional coordinator override), coordinator
+  // connect, initialize, start. Returns a RUNNING worker or the first error.
+  static Result<std::unique_ptr<WorkerService>> create_from_yaml(
+      const std::string& config_path, const std::string& coord_override = "");
   ~WorkerService();
 
   ErrorCode initialize();  // backends + transports + regions

@@ -9,6 +9,10 @@ struct btpu_cluster {
   std::unique_ptr<client::EmbeddedCluster> impl;
 };
 
+struct btpu_worker {
+  std::unique_ptr<worker::WorkerService> impl;
+};
+
 struct btpu_client {
   std::unique_ptr<client::ObjectClient> impl;
 };
@@ -87,6 +91,26 @@ void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[6]) {
   out[3] = c.gc_collected.load();
   out[4] = c.workers_lost.load();
   out[5] = c.objects_demoted.load();
+}
+
+btpu_worker* btpu_worker_create(const char* config_yaml_path, const char* coord_endpoints) {
+  if (!config_yaml_path) return nullptr;
+  auto service = worker::WorkerService::create_from_yaml(
+      config_yaml_path, coord_endpoints ? coord_endpoints : "");
+  if (!service.ok()) return nullptr;
+  auto* handle = new btpu_worker;
+  handle->impl = std::move(service).value();
+  return handle;
+}
+
+uint32_t btpu_worker_pool_count(btpu_worker* worker) {
+  return worker ? static_cast<uint32_t>(worker->impl->pools().size()) : 0;
+}
+
+void btpu_worker_destroy(btpu_worker* worker) {
+  if (!worker) return;
+  worker->impl->stop();
+  delete worker;
 }
 
 btpu_client* btpu_client_create_embedded(btpu_cluster* cluster) {

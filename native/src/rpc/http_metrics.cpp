@@ -1,5 +1,6 @@
 #include "btpu/rpc/http_metrics.h"
 
+#include <map>
 #include <sstream>
 
 #include "btpu/common/log.h"
@@ -71,6 +72,26 @@ std::string MetricsHttpServer::render_metrics() const {
           static_cast<double>(s.total_capacity));
     gauge("btpu_used_bytes", "allocated bytes", static_cast<double>(s.used_capacity));
     gauge("btpu_utilization", "used/capacity", s.avg_utilization);
+  }
+  // Per-tier breakdown: the same utilizations tier-aware eviction keys off
+  // (evict_for_pressure), so dashboards and the health loop agree.
+  {
+    std::map<StorageClass, uint64_t> cap_per_class;
+    for (const auto& [id, pool] : service_.memory_pools())
+      cap_per_class[pool.storage_class] += pool.size;
+    const auto alloc_stats = service_.allocator_stats();
+    out << "# HELP btpu_tier_capacity_bytes capacity by storage class\n"
+           "# TYPE btpu_tier_capacity_bytes gauge\n";
+    for (const auto& [cls, cap] : cap_per_class)
+      out << "btpu_tier_capacity_bytes{class=\"" << storage_class_name(cls) << "\"} " << cap
+          << "\n";
+    out << "# HELP btpu_tier_used_bytes allocated bytes by storage class\n"
+           "# TYPE btpu_tier_used_bytes gauge\n";
+    for (const auto& [cls, cap] : cap_per_class) {
+      auto it = alloc_stats.allocated_per_class.find(cls);
+      out << "btpu_tier_used_bytes{class=\"" << storage_class_name(cls) << "\"} "
+          << (it == alloc_stats.allocated_per_class.end() ? 0 : it->second) << "\n";
+    }
   }
   gauge("btpu_view_version", "placement view version",
         static_cast<double>(service_.get_view_version()));

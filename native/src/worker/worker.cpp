@@ -1,5 +1,7 @@
 #include "btpu/worker/worker.h"
 
+#include "btpu/coord/remote_coordinator.h"
+
 #include "btpu/common/config.h"
 #include "btpu/common/log.h"
 
@@ -226,6 +228,31 @@ ErrorCode WorkerService::initialize() {
   LOG_INFO << "worker " << config_.worker_id << " initialized with " << pools_.size()
            << " pools over " << transport_kind_name(config_.transport);
   return ErrorCode::OK;
+}
+
+Result<std::unique_ptr<WorkerService>> WorkerService::create_from_yaml(
+    const std::string& config_path, const std::string& coord_override) {
+  WorkerServiceConfig config;
+  try {
+    config = WorkerServiceConfig::from_yaml(config_path);
+  } catch (const std::exception& e) {
+    LOG_ERROR << "worker config: " << e.what();
+    return ErrorCode::INVALID_CONFIGURATION;
+  }
+  if (!coord_override.empty()) config.coord_endpoints = coord_override;
+  std::shared_ptr<coord::Coordinator> coordinator;
+  if (!config.coord_endpoints.empty()) {
+    auto remote = std::make_shared<coord::RemoteCoordinator>(config.coord_endpoints);
+    if (remote->connect() != ErrorCode::OK) {
+      LOG_ERROR << "cannot reach coordinator at " << config.coord_endpoints;
+      return ErrorCode::CONNECTION_FAILED;
+    }
+    coordinator = remote;
+  }
+  auto service = std::make_unique<WorkerService>(std::move(config), std::move(coordinator));
+  BTPU_RETURN_IF_ERROR(service->initialize());
+  BTPU_RETURN_IF_ERROR(service->start());
+  return service;
 }
 
 keystone::WorkerInfo WorkerService::info() const {

@@ -4,6 +4,7 @@
 #include <csignal>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <string>
 
 #include "btpu/common/log.h"
@@ -17,32 +18,62 @@ void handle_signal(int) { g_stop = 1; }
 int main(int argc, char** argv) {
   std::string host = "0.0.0.0";
   uint16_t port = 9290;
+  std::string follow;
+  int64_t takeover_ms = 3000;
   btpu::coord::DurabilityOptions durability;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--host") && i + 1 < argc) host = argv[++i];
     else if (!std::strcmp(argv[i], "--port") && i + 1 < argc) port = static_cast<uint16_t>(std::stoi(argv[++i]));
     else if (!std::strcmp(argv[i], "--data-dir") && i + 1 < argc) durability.dir = argv[++i];
     else if (!std::strcmp(argv[i], "--no-fsync")) durability.fsync = false;
+    else if (!std::strcmp(argv[i], "--follow") && i + 1 < argc) follow = argv[++i];
+    else if (!std::strcmp(argv[i], "--takeover-ms") && i + 1 < argc) takeover_ms = std::stoll(argv[++i]);
     else if (!std::strcmp(argv[i], "--help")) {
       std::printf("usage: bb-coord [--host H] [--port P] [--data-dir DIR] [--no-fsync]\n"
+                  "                [--follow PRIMARY:PORT] [--takeover-ms N]\n"
                   "  --data-dir DIR  persist state (WAL + snapshot); restart recovers\n"
                   "                  keys, leases (re-armed to full TTL), and objects\n"
-                  "  --no-fsync      skip per-record fsync (tests/benchmarks)\n");
+                  "  --no-fsync      skip per-record fsync (tests/benchmarks)\n"
+                  "  --follow EP     run as a mirroring standby of the primary at EP;\n"
+                  "                  serves reads, answers writes NOT_LEADER, and takes\n"
+                  "                  over after the primary is unreachable --takeover-ms\n");
       return 0;
     }
   }
   btpu::coord::CoordServer server(host, port, durability);
+  if (!follow.empty()) server.set_follower(true);
   if (server.start() != btpu::ErrorCode::OK) {
     std::fprintf(stderr, "bb-coord: failed to listen on %s:%u\n", host.c_str(), port);
     return 1;
   }
-  std::printf("bb-coord listening on %s\n", server.endpoint().c_str());
+  std::unique_ptr<btpu::coord::CoordFollower> follower;
+  if (!follow.empty()) {
+    btpu::coord::CoordFollower::Options options;
+    options.primary_endpoint = follow;
+    options.takeover_grace_ms = takeover_ms;
+    follower = std::make_unique<btpu::coord::CoordFollower>(server, options);
+    if (follower->start() != btpu::ErrorCode::OK) {
+      std::fprintf(stderr, "bb-coord: initial sync with %s failed\n", follow.c_str());
+      return 1;
+    }
+    std::printf("bb-coord standby on %s following %s\n", server.endpoint().c_str(),
+                follow.c_str());
+  } else {
+    std::printf("bb-coord listening on %s\n", server.endpoint().c_str());
+  }
   std::fflush(stdout);
   std::signal(SIGINT, handle_signal);
   std::signal(SIGTERM, handle_signal);
+  bool announced_promotion = false;
   while (!g_stop) {
     std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    if (follower && follower->promoted() && !announced_promotion) {
+      announced_promotion = true;
+      std::printf("bb-coord promoted to primary\n");
+      std::fflush(stdout);
+    }
   }
+  if (follower) follower->stop();
   server.stop();
   return 0;
 }

@@ -31,6 +31,12 @@ enum class Op : uint8_t {
   kHello = 17,         // opens a channel: {u8 kind: 0=call, 1=event}
   kPing = 18,
   kCampaignKeepalive = 19,  // event or call channel: {election, candidate_id}
+  // Replication (standby bb-coord). A mirror channel (kHello kind 2) sends
+  // ONE kMirror request and receives {ErrorCode, u64 snap_seq, snapshot
+  // bytes}, then the server pushes every subsequent mutation as
+  // kMirrorRecord {u64 seq, WAL-encoded record}.
+  kMirror = 20,
+  kMirrorRecord = 21,
 };
 
 }  // namespace btpu::coord

@@ -1,9 +1,23 @@
 // TCP server exposing a MemCoordinator to remote processes (bb-coord).
 // Replaces the reference's external etcd dependency for multi-process
 // clusters while keeping the Coordinator interface etcd-shaped.
+//
+// HA: a second bb-coord started with `--follow primary` runs this server as
+// a FOLLOWER (mutations answered NOT_LEADER, reads served) while a
+// CoordFollower mirrors the primary's state — an initial snapshot plus a
+// stream of WAL-encoded mutation records over a dedicated mirror channel.
+// When the primary stays unreachable past a grace period the follower
+// promotes: leases re-arm to full TTL, mutations are accepted, and clients
+// holding both endpoints rotate over (RemoteCoordinator NOT_LEADER /
+// connection-failure rotation). The reference gets this whole layer from an
+// etcd cluster (etcd_service.cpp wraps it); limitation vs raft: with only
+// two nodes a network partition can yield two primaries — deploy an odd
+// quorum of watchers or external fencing where that matters.
 #pragma once
 
 #include <atomic>
+#include <condition_variable>
+#include <deque>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -26,9 +40,16 @@ class CoordServer {
   std::string endpoint() const { return host_ + ":" + std::to_string(port_); }
   MemCoordinator& store() { return store_; }
 
+  // Role control (see header comment). set_follower(true) before start().
+  void set_follower(bool follower);
+  bool is_follower() const { return follower_.load(); }
+  void promote();
+
  private:
   void accept_loop();
   void serve_connection(std::shared_ptr<net::Socket> sock);
+  void serve_mirror(std::shared_ptr<net::Socket> sock);
+  static bool is_mutation(uint8_t opcode) noexcept;
 
   std::string host_;
   uint16_t port_;
@@ -36,10 +57,53 @@ class CoordServer {
   MemCoordinator store_;
   std::thread accept_thread_;
   std::atomic<bool> running_{false};
+  std::atomic<bool> follower_{false};
 
   std::mutex conns_mutex_;
   std::vector<std::thread> conn_threads_;
   std::vector<std::shared_ptr<net::Socket>> conns_;  // live sockets, for shutdown
+
+  // Replication fan-out: every mutation record lands here (from the store's
+  // sink, under the store mutex — enqueue only); mirror connections stream
+  // records with seq > their snapshot point. Bounded: a follower that lags
+  // past the window is disconnected and re-syncs from a fresh snapshot.
+  static constexpr size_t kReplBufferMax = 16384;
+  std::mutex repl_mutex_;
+  std::condition_variable repl_cv_;
+  std::deque<std::pair<uint64_t, std::vector<uint8_t>>> repl_buffer_;
+};
+
+// Standby engine: mirrors `primary_endpoint` into `server`'s store and
+// promotes the server when the primary stays unreachable past the grace.
+class CoordFollower {
+ public:
+  struct Options {
+    std::string primary_endpoint;
+    int64_t takeover_grace_ms{3000};  // unreachable this long => promote
+    int64_t redial_interval_ms{200};
+  };
+
+  CoordFollower(CoordServer& server, Options options);
+  ~CoordFollower();
+
+  // Performs the initial snapshot sync synchronously (so a misconfigured
+  // endpoint fails loudly instead of promoting an empty standby), then
+  // streams in the background.
+  ErrorCode start();
+  void stop();
+  bool promoted() const { return promoted_.load(); }
+
+ private:
+  ErrorCode sync_once(net::Socket& sock);  // dial + handshake + snapshot
+  void run(net::Socket sock);
+
+  CoordServer& server_;
+  Options options_;
+  std::thread thread_;
+  std::atomic<bool> stopping_{false};
+  std::atomic<bool> promoted_{false};
+  std::mutex sock_mutex_;
+  net::Socket* live_sock_{nullptr};  // for stop() to shutdown a blocked recv
 };
 
 }  // namespace btpu::coord

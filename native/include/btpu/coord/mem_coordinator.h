@@ -62,6 +62,23 @@ class MemCoordinator : public Coordinator {
 
   bool connected() const override { return true; }
 
+  // ---- replication (standby bb-coord mirroring; see coord_server.h) ----
+  // The sink receives every mutation record (same encoding as the WAL) with
+  // a monotonically increasing sequence. Called UNDER the store mutex: the
+  // sink must only enqueue, never call back into the store.
+  void set_replication_sink(std::function<void(uint64_t, const std::vector<uint8_t>&)> sink);
+  // Consistent snapshot + the sequence of the last record it includes.
+  std::pair<std::vector<uint8_t>, uint64_t> snapshot_with_seq();
+  // Follower side: replaces state wholesale / applies one streamed record.
+  ErrorCode load_replica_snapshot(const std::vector<uint8_t>& bytes);
+  ErrorCode apply_replica_record(const std::vector<uint8_t>& record);
+  // Followers never expire leases (only the primary owns liveness); promote()
+  // re-arms every lease to its full TTL and resumes expiry — the same grace
+  // journal recovery gives reconnecting owners.
+  void set_follower(bool follower);
+  void promote();
+  bool is_follower() const;
+
  private:
   using Clock = std::chrono::steady_clock;
 
@@ -97,10 +114,22 @@ class MemCoordinator : public Coordinator {
   void journal_compact_locked();             // snapshot + truncate WAL
   std::string snapshot_path() const;
   std::string wal_path() const;
+  // Journal + replication sink, every mutation goes through here.
+  void log_locked(const std::vector<uint8_t>& record);
+  std::vector<uint8_t> snapshot_bytes_locked() const;
+  bool decode_snapshot_locked(const std::vector<uint8_t>& bytes);
+  // Applies one WAL-encoded record: shared by crash recovery (no journal fd
+  // open yet, no watches registered) and live follower mirroring (journals
+  // and notifies). Returns false on a malformed record.
+  bool apply_record_locked(const uint8_t* data, size_t len,
+                           std::unique_lock<std::mutex>& lock);
 
   DurabilityOptions durability_;
   int wal_fd_{-1};
   size_t wal_records_{0};
+  std::function<void(uint64_t, const std::vector<uint8_t>&)> repl_sink_;
+  uint64_t repl_seq_{0};
+  bool follower_{false};
 
   mutable std::mutex mutex_;
   std::map<std::string, Entry> data_;  // ordered: prefix scans are ranges

@@ -16,7 +16,10 @@ namespace btpu::coord {
 
 class RemoteCoordinator : public Coordinator {
  public:
-  // endpoint "host:port". connect() must succeed before other calls.
+  // endpoint "host:port" or a comma-separated list "host:a,host:b": the
+  // client dials the first reachable endpoint and rotates to the next on
+  // connection failure or NOT_LEADER (a standby bb-coord answering reads
+  // but not writes) — the HA client half of the coordinator failover story.
   explicit RemoteCoordinator(std::string endpoint);
   ~RemoteCoordinator() override;
 
@@ -84,8 +87,14 @@ class RemoteCoordinator : public Coordinator {
   ErrorCode send_watch(int64_t id, const std::string& prefix);
   ErrorCode send_campaign(const std::string& election, const std::string& candidate,
                           int64_t ttl_ms);
+  // Advances to the next configured endpoint and redials (NOT_LEADER
+  // handling). Skipped when another thread already reconnected since
+  // `seen_generation` (same guard as reconnect()). No-op single-endpoint.
+  ErrorCode rotate_endpoint(uint64_t seen_generation);
+  const std::string& endpoint() const { return endpoints_[endpoint_index_]; }
 
-  std::string endpoint_;
+  std::vector<std::string> endpoints_;
+  size_t endpoint_index_{0};
   std::atomic<bool> connected_{false};
   std::atomic<bool> stopping_{false};
   // Set by disconnect() (under reconnect_mutex_): auto-reconnect must never

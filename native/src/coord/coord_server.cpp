@@ -23,13 +23,58 @@ ErrorCode CoordServer::start() {
   listener_ = std::move(listener).value();
   port_ = bound;
   running_ = true;
+  // Every mutation streams into the replication buffer (the sink runs under
+  // the store mutex: enqueue only). Registered even with no followers — the
+  // buffer is bounded and cheap, and a follower can attach at any time.
+  store_.set_replication_sink([this](uint64_t seq, const std::vector<uint8_t>& rec) {
+    {
+      std::lock_guard<std::mutex> lock(repl_mutex_);
+      repl_buffer_.emplace_back(seq, rec);
+      while (repl_buffer_.size() > kReplBufferMax) repl_buffer_.pop_front();
+    }
+    repl_cv_.notify_all();
+  });
   accept_thread_ = std::thread([this] { accept_loop(); });
-  LOG_INFO << "coord server listening on " << endpoint();
+  LOG_INFO << "coord server listening on " << endpoint()
+           << (follower_.load() ? " (follower)" : "");
   return ErrorCode::OK;
+}
+
+void CoordServer::set_follower(bool follower) {
+  follower_ = follower;
+  store_.set_follower(follower);
+}
+
+void CoordServer::promote() {
+  if (!follower_.exchange(false)) return;
+  store_.promote();
+}
+
+bool CoordServer::is_mutation(uint8_t opcode) noexcept {
+  switch (static_cast<Op>(opcode)) {
+    case Op::kPut:
+    case Op::kPutTtl:
+    case Op::kDel:
+    case Op::kLeaseGrant:
+    case Op::kLeaseKeepalive:
+    case Op::kLeaseRevoke:
+    case Op::kPutWithLease:
+    case Op::kCampaign:
+    case Op::kResign:
+    case Op::kCampaignKeepalive:
+      return true;
+    default:
+      return false;
+  }
 }
 
 void CoordServer::stop() {
   if (!running_.exchange(false)) return;
+  // Detach the sink first: the store's expiry thread outlives this call (it
+  // is joined in ~MemCoordinator, after the repl members are destroyed) and
+  // must not fire into a dead buffer/mutex.
+  store_.set_replication_sink(nullptr);
+  repl_cv_.notify_all();  // wake mirror streamers so they observe !running_
   // Join the accept loop (its poll wakes within 200ms) before touching the
   // listener: closing a socket under a concurrent poll is a data race.
   if (accept_thread_.joinable()) accept_thread_.join();
@@ -94,10 +139,15 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
     return;
   }
   const bool is_event_channel = payload[0] == 1;
+  const bool is_mirror_channel = payload[0] == 2;
   {
     Writer w;
     w.put(ErrorCode::OK);
     net::send_frame(fd, opcode, w.buffer().data(), w.size());
+  }
+  if (is_mirror_channel) {
+    serve_mirror(sock);
+    return;
   }
 
   auto channel = std::make_shared<EventChannel>();
@@ -110,6 +160,17 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
     if (net::recv_frame(fd, opcode, payload) != ErrorCode::OK) break;
     Reader r(payload);
     Writer w;
+
+    if (follower_.load() && is_mutation(opcode)) {
+      // Standby: reads are served, mutations belong to the primary. Clients
+      // holding both endpoints rotate on NOT_LEADER.
+      w.put(ErrorCode::NOT_LEADER);
+      std::lock_guard<std::mutex> lock(channel->mutex);
+      if (!channel->alive ||
+          net::send_frame(fd, opcode, w.buffer().data(), w.size()) != ErrorCode::OK)
+        break;
+      continue;
+    }
 
     switch (static_cast<Op>(opcode)) {
       case Op::kPing: {
@@ -298,6 +359,160 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
   }
   for (const auto& [cid, sid] : watches) store_.unwatch(sid);
   for (const auto& [election, candidate] : campaigns) store_.resign(election, candidate);
+}
+
+void CoordServer::serve_mirror(std::shared_ptr<net::Socket> sock) {
+  const int fd = sock->fd();
+  uint8_t opcode = 0;
+  std::vector<uint8_t> payload;
+  if (net::recv_frame(fd, opcode, payload) != ErrorCode::OK ||
+      static_cast<Op>(opcode) != Op::kMirror)
+    return;
+
+  // Consistent handoff: the snapshot's sequence is taken under the store
+  // mutex, and every record with a greater sequence is already (or will be)
+  // in repl_buffer_ — the sink enqueues before the mutation's lock releases.
+  auto [snapshot, snap_seq] = store_.snapshot_with_seq();
+  {
+    Writer w;
+    w.put(ErrorCode::OK);
+    w.put<uint64_t>(snap_seq);
+    wire::encode(w, snapshot);
+    if (net::send_frame(fd, opcode, w.buffer().data(), w.size()) != ErrorCode::OK) return;
+  }
+  LOG_INFO << "mirror follower attached at seq " << snap_seq;
+
+  uint64_t last_sent = snap_seq;
+  while (running_) {
+    std::vector<std::pair<uint64_t, std::vector<uint8_t>>> pending;
+    {
+      std::unique_lock<std::mutex> lock(repl_mutex_);
+      repl_cv_.wait_for(lock, std::chrono::milliseconds(200), [&] {
+        return !running_ || (!repl_buffer_.empty() && repl_buffer_.back().first > last_sent);
+      });
+      if (!running_) break;
+      if (!repl_buffer_.empty() && repl_buffer_.front().first > last_sent + 1) {
+        // This follower lagged out of the window; it must re-sync.
+        LOG_WARN << "mirror follower too slow (needs seq " << last_sent + 1
+                 << ", window starts at " << repl_buffer_.front().first << "); dropping";
+        return;
+      }
+      for (const auto& [seq, rec] : repl_buffer_) {
+        if (seq > last_sent) pending.emplace_back(seq, rec);
+      }
+    }
+    for (const auto& [seq, rec] : pending) {
+      Writer w;
+      w.put<uint64_t>(seq);
+      wire::encode(w, rec);
+      if (net::send_frame(fd, static_cast<uint8_t>(Op::kMirrorRecord), w.buffer().data(),
+                          w.size()) != ErrorCode::OK)
+        return;
+      last_sent = seq;
+    }
+  }
+}
+
+// ---- CoordFollower --------------------------------------------------------
+
+CoordFollower::CoordFollower(CoordServer& server, Options options)
+    : server_(server), options_(std::move(options)) {}
+
+CoordFollower::~CoordFollower() { stop(); }
+
+ErrorCode CoordFollower::sync_once(net::Socket& sock) {
+  auto hp = net::parse_host_port(options_.primary_endpoint);
+  if (!hp) return ErrorCode::INVALID_ADDRESS;
+  auto dialed = net::tcp_connect(hp->host, hp->port);
+  if (!dialed.ok()) return dialed.error();
+  sock = std::move(dialed).value();
+
+  uint8_t hello = 2;  // mirror channel
+  BTPU_RETURN_IF_ERROR(net::send_frame(sock.fd(), static_cast<uint8_t>(Op::kHello), &hello, 1));
+  uint8_t opcode = 0;
+  std::vector<uint8_t> payload;
+  BTPU_RETURN_IF_ERROR(net::recv_frame(sock.fd(), opcode, payload));
+
+  BTPU_RETURN_IF_ERROR(
+      net::send_frame(sock.fd(), static_cast<uint8_t>(Op::kMirror), nullptr, 0));
+  BTPU_RETURN_IF_ERROR(net::recv_frame(sock.fd(), opcode, payload));
+  if (static_cast<Op>(opcode) != Op::kMirror) return ErrorCode::RPC_FAILED;
+  Reader r(payload);
+  ErrorCode ec{};
+  uint64_t snap_seq = 0;
+  std::vector<uint8_t> snapshot;
+  if (!r.get(ec) || ec != ErrorCode::OK || !r.get(snap_seq) ||
+      !wire::decode(r, snapshot))
+    return ec != ErrorCode{} ? ec : ErrorCode::RPC_FAILED;
+  return server_.store().load_replica_snapshot(snapshot);
+}
+
+ErrorCode CoordFollower::start() {
+  net::Socket sock;
+  if (auto ec = sync_once(sock); ec != ErrorCode::OK) {
+    LOG_ERROR << "standby initial sync with " << options_.primary_endpoint
+              << " failed: " << to_string(ec);
+    return ec;
+  }
+  LOG_INFO << "standby synced from " << options_.primary_endpoint;
+  thread_ = std::thread([this, s = std::move(sock)]() mutable { run(std::move(s)); });
+  return ErrorCode::OK;
+}
+
+void CoordFollower::stop() {
+  stopping_ = true;
+  {
+    std::lock_guard<std::mutex> lock(sock_mutex_);
+    if (live_sock_) live_sock_->shutdown();
+  }
+  if (thread_.joinable()) thread_.join();
+}
+
+void CoordFollower::run(net::Socket sock) {
+  using Clock = std::chrono::steady_clock;
+  while (!stopping_) {
+    {
+      std::lock_guard<std::mutex> lock(sock_mutex_);
+      live_sock_ = &sock;
+    }
+    // Stream records until the connection dies.
+    uint8_t opcode = 0;
+    std::vector<uint8_t> payload;
+    while (!stopping_) {
+      if (net::recv_frame(sock.fd(), opcode, payload) != ErrorCode::OK) break;
+      if (static_cast<Op>(opcode) != Op::kMirrorRecord) continue;
+      Reader r(payload);
+      uint64_t seq = 0;
+      std::vector<uint8_t> rec;
+      if (!r.get(seq) || !wire::decode(r, rec)) break;
+      if (auto ec = server_.store().apply_replica_record(rec); ec != ErrorCode::OK)
+        LOG_ERROR << "mirror record " << seq << " failed to apply: " << to_string(ec);
+    }
+    {
+      std::lock_guard<std::mutex> lock(sock_mutex_);
+      live_sock_ = nullptr;
+    }
+    sock.close();
+    if (stopping_) return;
+
+    // Primary lost: retry within the grace window, then take over.
+    const auto deadline = Clock::now() + std::chrono::milliseconds(options_.takeover_grace_ms);
+    bool resynced = false;
+    while (!stopping_ && Clock::now() < deadline) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(options_.redial_interval_ms));
+      if (stopping_) return;
+      if (sync_once(sock) == ErrorCode::OK) {
+        LOG_INFO << "standby re-synced from " << options_.primary_endpoint;
+        resynced = true;
+        break;
+      }
+    }
+    if (resynced) continue;
+    if (stopping_) return;
+    promoted_ = true;
+    server_.promote();
+    return;
+  }
 }
 
 }  // namespace btpu::coord

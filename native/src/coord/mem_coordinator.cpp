@@ -117,8 +117,66 @@ void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
   if (++wal_records_ >= durability_.compact_every) journal_compact_locked();
 }
 
-void MemCoordinator::journal_compact_locked() {
-  if (wal_fd_ < 0) return;
+void MemCoordinator::log_locked(const std::vector<uint8_t>& record) {
+  journal_append_locked(record);
+  if (repl_sink_) repl_sink_(++repl_seq_, record);
+}
+
+void MemCoordinator::set_replication_sink(
+    std::function<void(uint64_t, const std::vector<uint8_t>&)> sink) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  repl_sink_ = std::move(sink);
+}
+
+std::pair<std::vector<uint8_t>, uint64_t> MemCoordinator::snapshot_with_seq() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return {snapshot_bytes_locked(), repl_seq_};
+}
+
+ErrorCode MemCoordinator::load_replica_snapshot(const std::vector<uint8_t>& bytes) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  data_.clear();
+  leases_.clear();
+  if (!decode_snapshot_locked(bytes)) return ErrorCode::DATA_CORRUPTION;
+  // Persist the freshly mirrored state so a durable standby restart does not
+  // need the primary to still be alive.
+  if (wal_fd_ >= 0) journal_compact_locked();
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::apply_replica_record(const std::vector<uint8_t>& record) {
+  std::unique_lock<std::mutex> lock(mutex_);
+  return apply_record_locked(record.data(), record.size(), lock)
+             ? ErrorCode::OK
+             : ErrorCode::DATA_CORRUPTION;
+}
+
+void MemCoordinator::set_follower(bool follower) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  follower_ = follower;
+}
+
+bool MemCoordinator::is_follower() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return follower_;
+}
+
+void MemCoordinator::promote() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (!follower_) return;
+    follower_ = false;
+    const auto now = Clock::now();
+    for (auto& [id, lease] : leases_) {
+      lease.deadline = now + std::chrono::milliseconds(lease.ttl_ms);
+    }
+    LOG_WARN << "coordinator promoted to primary: " << data_.size() << " keys, "
+             << leases_.size() << " leases re-armed";
+  }
+  expiry_cv_.notify_all();
+}
+
+std::vector<uint8_t> MemCoordinator::snapshot_bytes_locked() const {
   wire::Writer w;
   w.put<uint32_t>(kSnapshotMagic);
   w.put<uint32_t>(kSnapshotVersion);
@@ -134,9 +192,15 @@ void MemCoordinator::journal_compact_locked() {
     wire::encode(w, entry.value);
     w.put<int64_t>(entry.lease);
   }
+  return w.take();
+}
+
+void MemCoordinator::journal_compact_locked() {
+  if (wal_fd_ < 0) return;
+  const std::vector<uint8_t> snapshot = snapshot_bytes_locked();
   const std::string tmp = snapshot_path() + ".tmp";
   int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0 || net::write_all(fd, w.buffer().data(), w.buffer().size()) != ErrorCode::OK) {
+  if (fd < 0 || net::write_all(fd, snapshot.data(), snapshot.size()) != ErrorCode::OK) {
     LOG_ERROR << "coordinator snapshot write failed (errno " << errno << ")";
     if (fd >= 0) ::close(fd);
     wal_records_ = 0;  // space retries out; don't re-snapshot on every op
@@ -163,53 +227,124 @@ void MemCoordinator::journal_compact_locked() {
             << leases_.size() << " leases";
 }
 
+bool MemCoordinator::decode_snapshot_locked(const std::vector<uint8_t>& bytes) {
+  wire::Reader r(bytes);
+  uint32_t magic = 0, version = 0;
+  uint64_t next_lease = 0, n_leases = 0, n_entries = 0;
+  if (!r.get(magic) || magic != kSnapshotMagic || !r.get(version) ||
+      version != kSnapshotVersion || !r.get(next_lease) || !r.get(n_leases))
+    return false;
+  next_lease_ = next_lease;
+  bool ok = true;
+  for (uint64_t i = 0; ok && i < n_leases; ++i) {
+    int64_t id = 0, ttl = 0;
+    ok = r.get(id) && r.get(ttl);
+    if (ok) leases_[id] = Lease{ttl, Clock::now(), {}};  // re-armed by caller
+  }
+  ok = ok && r.get(n_entries);
+  for (uint64_t i = 0; ok && i < n_entries; ++i) {
+    std::string key, value;
+    int64_t lease = 0;
+    ok = wire::decode(r, key) && wire::decode(r, value) && r.get(lease);
+    if (ok) {
+      if (lease != 0) {
+        auto it = leases_.find(lease);
+        if (it == leases_.end()) continue;  // lease already gone: key would expire
+        it->second.keys.push_back(key);
+      }
+      data_[key] = Entry{std::move(value), lease};
+    }
+  }
+  return ok;
+}
+
+bool MemCoordinator::apply_record_locked(const uint8_t* bytes, size_t len,
+                                         std::unique_lock<std::mutex>& lock) {
+  wire::Reader r(bytes, len);
+  uint8_t type = 0;
+  if (!r.get(type)) return false;
+  std::string key, value;
+  int64_t id = 0, ttl = 0;
+  switch (type) {
+    case kRecPut: {
+      if (!wire::decode(r, key) || !wire::decode(r, value) || !r.get(id)) return false;
+      if (id != 0) {
+        auto it = leases_.find(id);
+        if (it == leases_.end()) return true;  // lease already gone: skip
+        it->second.keys.push_back(key);
+      }
+      data_[key] = Entry{value, id};
+      log_locked(rec_put(key, value, id));
+      // Fire watches outside the lock, like put() does.
+      std::vector<WatchCallback> to_call;
+      for (const auto& w : watches_) {
+        if (key.rfind(w.prefix, 0) == 0) to_call.push_back(w.cb);
+      }
+      if (!to_call.empty()) {
+        lock.unlock();
+        WatchEvent ev{WatchEvent::Type::kPut, key, value};
+        for (auto& cb : to_call) cb(ev);
+        lock.lock();
+      }
+      return true;
+    }
+    case kRecDel: {
+      if (!wire::decode(r, key)) return false;
+      del_locked(key, lock);  // NOT_FOUND is fine (already gone)
+      return true;
+    }
+    case kRecGrant: {
+      if (!r.get(id) || !r.get(ttl)) return false;
+      // Never reset an existing lease's key list (double-replay after a
+      // crash between snapshot rename and WAL truncate).
+      if (!leases_.contains(id)) {
+        leases_[id] = Lease{ttl, Clock::now() + std::chrono::milliseconds(ttl), {}};
+        log_locked(rec_grant(id, ttl));
+      }
+      LeaseId expect = next_lease_.load();
+      while (expect <= static_cast<LeaseId>(id) &&
+             !next_lease_.compare_exchange_weak(expect, static_cast<LeaseId>(id) + 1)) {
+      }
+      return true;
+    }
+    case kRecRevoke: {
+      if (!r.get(id)) return false;
+      auto it = leases_.find(id);
+      if (it == leases_.end()) return true;
+      auto keys = it->second.keys;
+      leases_.erase(it);
+      log_locked(rec_revoke(id));
+      for (const auto& k : keys) {
+        auto entry = data_.find(k);
+        if (entry == data_.end() || entry->second.lease != id) continue;
+        del_locked(k, lock);
+      }
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
 void MemCoordinator::journal_load() {
   std::error_code fs_ec;
   std::filesystem::create_directories(durability_.dir, fs_ec);
 
-  auto apply_put = [&](const std::string& key, std::string value, int64_t lease) {
-    if (lease != 0) {
-      auto it = leases_.find(lease);
-      if (it == leases_.end()) return;  // lease already gone: key would expire
-      it->second.keys.push_back(key);
-    }
-    data_[key] = Entry{std::move(value), lease};
-  };
-
-  // Snapshot first.
+  // Snapshot first. No lock needed (ctor, pre-thread) but apply_record_locked
+  // wants one for its unlock-notify-relock dance (a no-op here: no watches,
+  // no WAL fd, no sink yet).
+  std::unique_lock<std::mutex> lock(mutex_);
   {
     std::ifstream in(snapshot_path(), std::ios::binary);
     if (in) {
       std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
                                  std::istreambuf_iterator<char>());
-      wire::Reader r(bytes);
-      uint32_t magic = 0, version = 0;
-      uint64_t next_lease = 0, n_leases = 0, n_entries = 0;
-      if (r.get(magic) && magic == kSnapshotMagic && r.get(version) &&
-          version == kSnapshotVersion && r.get(next_lease) && r.get(n_leases)) {
-        next_lease_ = next_lease;
-        bool ok = true;
-        for (uint64_t i = 0; ok && i < n_leases; ++i) {
-          int64_t id = 0, ttl = 0;
-          ok = r.get(id) && r.get(ttl);
-          if (ok) leases_[id] = Lease{ttl, Clock::now(), {}};  // re-armed below
-        }
-        ok = ok && r.get(n_entries);
-        for (uint64_t i = 0; ok && i < n_entries; ++i) {
-          std::string key, value;
-          int64_t lease = 0;
-          ok = wire::decode(r, key) && wire::decode(r, value) && r.get(lease);
-          if (ok) apply_put(key, std::move(value), lease);
-        }
-        if (!ok) LOG_ERROR << "coordinator snapshot truncated; continuing with partial state";
-      } else {
-        LOG_ERROR << "coordinator snapshot unreadable; ignoring";
-      }
+      if (!bytes.empty() && !decode_snapshot_locked(bytes))
+        LOG_ERROR << "coordinator snapshot truncated/unreadable; continuing with partial state";
     }
   }
 
   // Then the WAL, tolerating a torn tail.
-  int64_t max_lease_seen = static_cast<int64_t>(next_lease_.load());
   {
     std::ifstream in(wal_path(), std::ios::binary);
     if (in) {
@@ -221,44 +356,7 @@ void MemCoordinator::journal_load() {
         uint32_t len = 0;
         std::memcpy(&len, bytes.data() + pos, sizeof(len));
         if (len == 0 || len > kMaxRecordBytes || pos + sizeof(len) + len > bytes.size()) break;
-        wire::Reader r(bytes.data() + pos + sizeof(len), len);
-        uint8_t type = 0;
-        bool ok = r.get(type);
-        std::string key, value;
-        int64_t id = 0, ttl = 0;
-        switch (ok ? type : 0) {
-          case kRecPut:
-            ok = wire::decode(r, key) && wire::decode(r, value) && r.get(id);
-            if (ok) apply_put(key, std::move(value), id);
-            break;
-          case kRecDel:
-            ok = wire::decode(r, key);
-            if (ok) data_.erase(key);
-            break;
-          case kRecGrant:
-            ok = r.get(id) && r.get(ttl);
-            // Never reset an existing lease's key list (double-replay after
-            // a crash between snapshot rename and WAL truncate).
-            if (ok && !leases_.contains(id)) leases_[id] = Lease{ttl, Clock::now(), {}};
-            if (ok) max_lease_seen = std::max(max_lease_seen, id);
-            break;
-          case kRecRevoke:
-            ok = r.get(id);
-            if (ok) {
-              auto it = leases_.find(id);
-              if (it != leases_.end()) {
-                for (const auto& k : it->second.keys) {
-                  auto entry = data_.find(k);
-                  if (entry != data_.end() && entry->second.lease == id) data_.erase(entry);
-                }
-                leases_.erase(it);
-              }
-            }
-            break;
-          default:
-            ok = false;
-        }
-        if (!ok) break;
+        if (!apply_record_locked(bytes.data() + pos + sizeof(len), len, lock)) break;
         pos += sizeof(len) + len;
         valid_end = pos;
       }
@@ -269,7 +367,6 @@ void MemCoordinator::journal_load() {
       }
     }
   }
-  next_lease_ = static_cast<LeaseId>(max_lease_seen) + 1;
 
   // Re-arm every surviving lease to its full TTL: owners are reconnecting
   // and get one refresh interval before expiry fires.
@@ -311,6 +408,7 @@ void MemCoordinator::expiry_loop() {
     expiry_cv_.wait_for(lock, std::chrono::milliseconds(20));
     if (stopping_) break;
 
+    if (follower_) continue;  // only the primary owns liveness
     const auto now = Clock::now();
     std::vector<LeaseId> expired;
     for (const auto& [id, lease] : leases_) {
@@ -321,7 +419,7 @@ void MemCoordinator::expiry_loop() {
       if (it == leases_.end()) continue;
       auto keys = it->second.keys;
       leases_.erase(it);
-      journal_append_locked(rec_revoke(id));
+      log_locked(rec_revoke(id));
       LOG_DEBUG << "lease " << id << " expired (" << keys.size() << " keys)";
       for (const auto& key : keys) {
         // Only delete entries still owned by this lease: a key refreshed via
@@ -363,7 +461,7 @@ ErrorCode MemCoordinator::del_locked(const std::string& key, std::unique_lock<st
   auto it = data_.find(key);
   if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
   data_.erase(it);
-  journal_append_locked(rec_del(key));
+  log_locked(rec_del(key));
   std::vector<WatchCallback> to_call;
   for (const auto& w : watches_) {
     if (key.rfind(w.prefix, 0) == 0) to_call.push_back(w.cb);
@@ -388,7 +486,7 @@ ErrorCode MemCoordinator::put(const std::string& key, const std::string& value) 
   {
     std::lock_guard<std::mutex> lock(mutex_);
     data_[key] = Entry{value, 0};
-    journal_append_locked(rec_put(key, value, 0));
+    log_locked(rec_put(key, value, 0));
   }
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
@@ -409,7 +507,7 @@ ErrorCode MemCoordinator::put_with_lease(const std::string& key, const std::stri
     if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
     it->second.keys.push_back(key);
     data_[key] = Entry{value, lease};
-    journal_append_locked(rec_put(key, value, lease));
+    log_locked(rec_put(key, value, lease));
   }
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
@@ -435,7 +533,7 @@ Result<LeaseId> MemCoordinator::lease_grant(int64_t ttl_ms) {
   std::lock_guard<std::mutex> lock(mutex_);
   LeaseId id = next_lease_++;
   leases_[id] = Lease{ttl_ms, Clock::now() + std::chrono::milliseconds(ttl_ms), {}};
-  journal_append_locked(rec_grant(id, ttl_ms));
+  log_locked(rec_grant(id, ttl_ms));
   return id;
 }
 
@@ -453,7 +551,7 @@ ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
   if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
   auto keys = it->second.keys;
   leases_.erase(it);
-  journal_append_locked(rec_revoke(lease));
+  log_locked(rec_revoke(lease));
   for (const auto& key : keys) {
     auto entry = data_.find(key);
     if (entry == data_.end() || entry->second.lease != lease) continue;
@@ -545,7 +643,7 @@ ErrorCode MemCoordinator::resign(const std::string& election, const std::string&
   const LeaseId lease = me->lease;
   candidates.erase(me);
   leases_.erase(lease);
-  journal_append_locked(rec_revoke(lease));
+  log_locked(rec_revoke(lease));
   if (was_leader) promote_next_locked(election, lock);
   return ErrorCode::OK;
 }

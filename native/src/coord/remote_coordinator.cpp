@@ -36,7 +36,18 @@ ErrorCode take_status(Reader& r) {
 }
 }  // namespace
 
-RemoteCoordinator::RemoteCoordinator(std::string endpoint) : endpoint_(std::move(endpoint)) {}
+RemoteCoordinator::RemoteCoordinator(std::string endpoint) {
+  size_t start = 0;
+  while (start <= endpoint.size()) {
+    const size_t comma = endpoint.find(',', start);
+    const std::string part =
+        endpoint.substr(start, comma == std::string::npos ? std::string::npos : comma - start);
+    if (!part.empty()) endpoints_.push_back(part);
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  if (endpoints_.empty()) endpoints_.push_back("");
+}
 
 RemoteCoordinator::~RemoteCoordinator() { disconnect(); }
 
@@ -50,8 +61,23 @@ ErrorCode RemoteCoordinator::connect_locked() {
   if (connected_) return ErrorCode::OK;
   if (terminated_) return ErrorCode::CLIENT_DISCONNECTED;
   if (event_reader_.joinable()) event_reader_.join();  // from a dead session
-  BTPU_RETURN_IF_ERROR(open_channel(endpoint_, 0, call_sock_));
-  BTPU_RETURN_IF_ERROR(open_channel(endpoint_, 1, event_sock_));
+  // Dial endpoints starting at the current one; a dead primary rotates to
+  // its standby here.
+  ErrorCode dial_ec = ErrorCode::CONNECTION_FAILED;
+  bool dialed = false;
+  for (size_t attempt = 0; attempt < endpoints_.size(); ++attempt) {
+    dial_ec = open_channel(endpoint(), 0, call_sock_);
+    if (dial_ec == ErrorCode::OK) {
+      dial_ec = open_channel(endpoint(), 1, event_sock_);
+      if (dial_ec == ErrorCode::OK) {
+        dialed = true;
+        break;
+      }
+      call_sock_.close();
+    }
+    endpoint_index_ = (endpoint_index_ + 1) % endpoints_.size();
+  }
+  if (!dialed) return dial_ec;
   stopping_ = false;
   {
     std::lock_guard<std::mutex> rlock(resp_mutex_);
@@ -63,7 +89,7 @@ ErrorCode RemoteCoordinator::connect_locked() {
     reader_thread_id_.store(std::this_thread::get_id());
     event_reader_loop();
   });
-  LOG_DEBUG << "coordinator client connected to " << endpoint_;
+  LOG_DEBUG << "coordinator client connected to " << endpoint();
 
   // Replay session state from a previous connection (no-op on first
   // connect): watches and election candidacies live in the server's memory
@@ -135,14 +161,54 @@ ErrorCode RemoteCoordinator::reconnect(uint64_t seen_generation) {
   if (event_reader_.joinable()) event_reader_.join();
   call_sock_.close();
   event_sock_.close();
-  LOG_WARN << "coordinator connection lost; redialing " << endpoint_;
+  LOG_WARN << "coordinator connection lost; redialing";
   return connect_locked();
+}
+
+ErrorCode RemoteCoordinator::rotate_endpoint(uint64_t seen_generation) {
+  if (endpoints_.size() < 2) return ErrorCode::NOT_LEADER;
+  if (std::this_thread::get_id() == reader_thread_id_.load())
+    return ErrorCode::NOT_LEADER;  // see reconnect(): never from the reader
+  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  if (terminated_) return ErrorCode::CLIENT_DISCONNECTED;
+  if (generation_.load() != seen_generation) {
+    // Another thread already rotated/reconnected since this NOT_LEADER was
+    // observed — retry on the current connection instead of rotating away
+    // from a freshly found primary.
+    return connected_ ? ErrorCode::OK : ErrorCode::CONNECTION_FAILED;
+  }
+  endpoint_index_ = (endpoint_index_ + 1) % endpoints_.size();
+  stopping_ = true;
+  connected_ = false;
+  call_sock_.shutdown();
+  event_sock_.shutdown();
+  {
+    std::scoped_lock<std::mutex, std::mutex> drain(call_mutex_, event_write_mutex_);
+  }
+  if (event_reader_.joinable()) event_reader_.join();
+  call_sock_.close();
+  event_sock_.close();
+  LOG_WARN << "coordinator answered NOT_LEADER; rotating to " << endpoint();
+  return connect_locked();
+}
+
+// Peeks the op-level status that leads every response payload.
+static ErrorCode peek_status(const std::vector<uint8_t>& resp) {
+  Reader r(resp);
+  ErrorCode ec{};
+  return r.get(ec) ? ec : ErrorCode::RPC_FAILED;
 }
 
 ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& req,
                                   std::vector<uint8_t>& resp, bool* retried) {
   if (retried) *retried = false;
+  // The generation of the connection each attempt ran on: a NOT_LEADER
+  // answer only justifies rotating away from THAT connection (another
+  // thread may have rotated to the primary since — rotate_endpoint no-ops
+  // then and the retry lands on the fresh connection).
+  uint64_t attempt_gen = 0;
   auto attempt = [&]() -> ErrorCode {
+    attempt_gen = generation_.load();
     if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
     std::lock_guard<std::mutex> lock(call_mutex_);
     BTPU_RETURN_IF_ERROR(net::send_frame(call_sock_.fd(), opcode, req.data(), req.size()));
@@ -158,6 +224,14 @@ ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& re
       if (retried) *retried = true;
       ec = attempt();
     }
+  }
+  // A standby answered: the op provably did NOT execute, so rotating and
+  // re-sending is safe even for mutations. One full cycle at most.
+  for (size_t hops = 0; ec == ErrorCode::OK && peek_status(resp) == ErrorCode::NOT_LEADER &&
+                        hops + 1 < endpoints_.size();
+       ++hops) {
+    if (rotate_endpoint(attempt_gen) != ErrorCode::OK) break;
+    ec = attempt();
   }
   return ec;
 }
@@ -187,6 +261,15 @@ ErrorCode RemoteCoordinator::event_call(uint8_t opcode, const std::vector<uint8_
   auto ec = event_call_raw(opcode, req, resp);
   if (is_connection_error(ec) && !stopping_) {
     if (reconnect(gen) == ErrorCode::OK) ec = event_call_raw(opcode, req, resp);
+  }
+  // Standby rejection: rotate to the primary (see call()). Session state
+  // (watches, campaigns) is replayed by connect_locked on the new endpoint.
+  for (size_t hops = 0; ec == ErrorCode::OK && peek_status(resp) == ErrorCode::NOT_LEADER &&
+                        hops + 1 < endpoints_.size();
+       ++hops) {
+    const uint64_t attempt_gen = generation_.load();
+    if (rotate_endpoint(attempt_gen) != ErrorCode::OK) break;
+    ec = event_call_raw(opcode, req, resp);
   }
   return ec;
 }
@@ -466,6 +549,14 @@ ErrorCode RemoteCoordinator::campaign(const std::string& election,
   if (is_connection_error(ec) && !stopping_) {
     // reconnect() replays campaigns_ (including this one) on success.
     ec = reconnect(gen);
+  }
+  // A standby rejects candidacies: rotate to the primary and re-send
+  // (send_campaign absorbs the ALREADY_EXISTS left by connect replay).
+  for (size_t hops = 0;
+       ec == ErrorCode::NOT_LEADER && !stopping_ && hops + 1 < endpoints_.size(); ++hops) {
+    const uint64_t attempt_gen = generation_.load();
+    if (rotate_endpoint(attempt_gen) != ErrorCode::OK) break;
+    ec = send_campaign(election, candidate_id, lease_ttl_ms);
   }
   if (ec != ErrorCode::OK) {
     std::lock_guard<std::mutex> lock(watch_mutex_);

@@ -395,3 +395,113 @@ BTEST(Durability, ServerRestartClientsReconnectAndResume) {
   BT_EXPECT(eventually([&] { return events.load() == before_events + 1; }, 3000));
   BT_EXPECT_EQ(client.get("/r/after").value(), "2");
 }
+
+// ---- coordinator HA: primary/standby mirroring + takeover -----------------
+
+BTEST(CoordHA, StandbyMirrorsServesReadsRejectsWrites) {
+  coord::CoordServer primary("127.0.0.1", 0);
+  BT_ASSERT(primary.start() == ErrorCode::OK);
+  primary.store().put("/pre/a", "1");
+
+  coord::CoordServer standby("127.0.0.1", 0);
+  standby.set_follower(true);
+  BT_ASSERT(standby.start() == ErrorCode::OK);
+  coord::CoordFollower follower(
+      standby, {.primary_endpoint = primary.endpoint(), .takeover_grace_ms = 60000});
+  BT_ASSERT(follower.start() == ErrorCode::OK);
+
+  // Snapshot carried the pre-existing key; the stream carries later ones.
+  BT_EXPECT(standby.store().get("/pre/a").ok());
+  primary.store().put("/live/b", "2");
+  BT_EXPECT(eventually([&] { return standby.store().get("/live/b").ok(); }));
+
+  // Through the wire: a client pointed at the standby can read but not write.
+  coord::RemoteCoordinator client(standby.endpoint());
+  BT_ASSERT(client.connect() == ErrorCode::OK);
+  auto got = client.get("/live/b");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value(), "2");
+  BT_EXPECT(client.put("/live/c", "3") == ErrorCode::NOT_LEADER);
+
+  // Deletes and TTL state mirror too; the standby must NOT expire leases.
+  primary.store().put_with_ttl("/live/ttl", "x", 200);
+  primary.store().del("/live/b");
+  BT_EXPECT(eventually([&] { return !standby.store().get("/live/b").ok(); }));
+  BT_EXPECT(standby.store().get("/live/ttl").ok());
+  std::this_thread::sleep_for(std::chrono::milliseconds(350));
+  // Expired on the primary (owner of liveness), then mirrored as a delete.
+  BT_EXPECT(eventually([&] { return !standby.store().get("/live/ttl").ok(); }));
+
+  follower.stop();
+}
+
+BTEST(CoordHA, StandbyPromotesOnPrimaryLossAndClientsFailOver) {
+  auto primary = std::make_unique<coord::CoordServer>("127.0.0.1", 0);
+  BT_ASSERT(primary->start() == ErrorCode::OK);
+  const std::string primary_ep = primary->endpoint();
+
+  coord::CoordServer standby("127.0.0.1", 0);
+  standby.set_follower(true);
+  BT_ASSERT(standby.start() == ErrorCode::OK);
+  coord::CoordFollower follower(
+      standby, {.primary_endpoint = primary_ep, .takeover_grace_ms = 300,
+                .redial_interval_ms = 50});
+  BT_ASSERT(follower.start() == ErrorCode::OK);
+
+  // Client holds both endpoints; all ops land on the primary.
+  coord::RemoteCoordinator client(primary_ep + "," + standby.endpoint());
+  BT_ASSERT(client.connect() == ErrorCode::OK);
+  BT_ASSERT(client.put("/ha/k", "v1") == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return standby.store().get("/ha/k").ok(); }));
+
+  // A watch and a TTL'd heartbeat key, to survive the failover.
+  std::atomic<int> watch_events{0};
+  auto watch = client.watch_prefix("/ha/", [&](const coord::WatchEvent&) { ++watch_events; });
+  BT_ASSERT_OK(watch);
+
+  primary->stop();
+  primary.reset();  // hard death
+
+  BT_EXPECT(eventually([&] { return follower.promoted(); }, 5000));
+  BT_EXPECT(!standby.is_follower());
+
+  // The client's next mutation rotates to the promoted standby and lands.
+  BT_EXPECT(eventually([&] { return client.put("/ha/k2", "v2") == ErrorCode::OK; }, 5000));
+  auto back = client.get("/ha/k");
+  BT_ASSERT_OK(back);
+  BT_EXPECT_EQ(back.value(), "v1");
+
+  // The replayed watch fires against the new primary.
+  BT_EXPECT(eventually([&] { return client.put("/ha/k3", "v3") == ErrorCode::OK; }, 2000));
+  BT_EXPECT(eventually([&] { return watch_events.load() >= 1; }, 3000));
+
+  follower.stop();
+}
+
+BTEST(CoordHA, StandbyResyncsWhenPrimaryComesBackInGrace) {
+  coord::CoordServer primary("127.0.0.1", 0);
+  BT_ASSERT(primary.start() == ErrorCode::OK);
+  const uint16_t primary_port = primary.port();
+  primary.store().put("/rs/a", "1");
+
+  coord::CoordServer standby("127.0.0.1", 0);
+  standby.set_follower(true);
+  BT_ASSERT(standby.start() == ErrorCode::OK);
+  coord::CoordFollower follower(
+      standby, {.primary_endpoint = primary.endpoint(), .takeover_grace_ms = 5000,
+                .redial_interval_ms = 50});
+  BT_ASSERT(follower.start() == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return standby.store().get("/rs/a").ok(); }));
+
+  // Bounce the primary on the SAME port within the grace window: the
+  // standby re-syncs (fresh snapshot) instead of promoting.
+  primary.stop();
+  coord::CoordServer primary2("127.0.0.1", primary_port);
+  BT_ASSERT(primary2.start() == ErrorCode::OK);
+  primary2.store().put("/rs/b", "2");
+
+  BT_EXPECT(eventually([&] { return standby.store().get("/rs/b").ok(); }, 5000));
+  BT_EXPECT(!follower.promoted());
+  BT_EXPECT(standby.is_follower());
+  follower.stop();
+}

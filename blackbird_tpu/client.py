@@ -159,6 +159,26 @@ class Client:
             check(codes[i], f"get {key!r}")
         return [buffers[i].raw[: out_sizes[i]] for i in range(n)]
 
+    def placements(self, key: str) -> list[dict]:
+        """Where the object's bytes live: one dict per copy, with shards
+        carrying worker/pool/storage-class/transport and the location
+        (memory address, device region, or file). Parity: the C++ SDK's
+        get_workers (reference BlackbirdClient::get_workers)."""
+        import json
+
+        size = ctypes.c_uint64()
+        check(lib.btpu_placements_json(self._handle, key.encode(), None, 0,
+                                       ctypes.byref(size)),
+              f"placements {key!r}")
+        while True:
+            cap = size.value
+            buffer = ctypes.create_string_buffer(cap)
+            check(lib.btpu_placements_json(self._handle, key.encode(), buffer,
+                                           cap, ctypes.byref(size)),
+                  f"placements {key!r}")
+            if size.value <= cap:  # else grew between calls (repair/demotion)
+                return json.loads(buffer.raw[: size.value].decode())
+
     def exists(self, key: str) -> bool:
         flag = ctypes.c_int32()
         check(lib.btpu_exists(self._handle, key.encode(), ctypes.byref(flag)),

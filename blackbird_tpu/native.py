@@ -107,6 +107,8 @@ def _load() -> ctypes.CDLL:
         "btpu_stats": (i32, [c, ctypes.POINTER(u64)]),
         "btpu_error_name": (ctypes.c_char_p, [i32]),
         "btpu_register_hbm_provider_v3": (None, [ctypes.c_void_p]),
+        "btpu_placements_json": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64,
+                                       ctypes.POINTER(u64)]),
         "btpu_worker_create": (c, [ctypes.c_char_p, ctypes.c_char_p]),
         "btpu_worker_pool_count": (u32, [c]),
         "btpu_worker_destroy": (None, [c]),

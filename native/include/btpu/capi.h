@@ -66,6 +66,14 @@ int32_t btpu_get_many(btpu_client* client, uint32_t n, const char* const* keys,
 int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys,
                         uint64_t* out_sizes, int32_t* out_codes);
 
+/* Placement introspection: writes a JSON array of copies
+ * [{"copy_index":N,"shards":[{"worker","pool","class","transport",
+ *   "length","location":{...}}]}] into buffer. Returns the full length via
+ * out_len; when it exceeds buffer_size the JSON is truncated (call again
+ * with a bigger buffer). buffer may be NULL to query the size. */
+int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
+                             uint64_t buffer_size, uint64_t* out_len);
+
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists);
 int32_t btpu_remove(btpu_client* client, const char* key);
 // out: [workers, pools, objects, capacity, used]

@@ -1,5 +1,7 @@
 #include "btpu/capi.h"
 
+#include <cstring>
+
 #include "btpu/client/embedded.h"
 #include "btpu/common/log.h"
 
@@ -250,6 +252,63 @@ int32_t btpu_stats(btpu_client* client, uint64_t out[5]) {
   out[2] = stats.value().total_objects;
   out[3] = stats.value().total_capacity;
   out[4] = stats.value().used_capacity;
+  return 0;
+}
+
+int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
+                             uint64_t buffer_size, uint64_t* out_len) {
+  if (!client || !key || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto placements = client->impl->get_workers(key);
+  if (!placements.ok()) return static_cast<int32_t>(placements.error());
+
+  std::string json = "[";
+  auto esc = [](const std::string& s) {
+    std::string out;
+    for (char c : s) {
+      if (c == '"' || c == '\\') out += '\\';
+      out += c;
+    }
+    return out;
+  };
+  bool first_copy = true;
+  for (const auto& copy : placements.value()) {
+    if (!first_copy) json += ",";
+    first_copy = false;
+    json += "{\"copy_index\":" + std::to_string(copy.copy_index) + ",\"shards\":[";
+    bool first_shard = true;
+    for (const auto& shard : copy.shards) {
+      if (!first_shard) json += ",";
+      first_shard = false;
+      json += "{\"worker\":\"" + esc(shard.worker_id) + "\",\"pool\":\"" +
+              esc(shard.pool_id) + "\",\"class\":\"" +
+              std::string(storage_class_name(shard.storage_class)) +
+              "\",\"transport\":\"" +
+              std::string(transport_kind_name(shard.remote.transport)) +
+              "\",\"length\":" + std::to_string(shard.length) + ",\"location\":";
+      if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
+        json += "{\"kind\":\"memory\",\"remote_addr\":" +
+                std::to_string(mem->remote_addr) + "}";
+      } else if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
+        json += "{\"kind\":\"device\",\"device\":\"" + esc(dev->device_id) +
+                "\",\"region\":" + std::to_string(dev->region_id) +
+                ",\"offset\":" + std::to_string(dev->offset) + "}";
+      } else if (const auto* file = std::get_if<FileLocation>(&shard.location)) {
+        json += "{\"kind\":\"file\",\"path\":\"" + esc(file->file_path) +
+                "\",\"offset\":" + std::to_string(file->file_offset) + "}";
+      } else {
+        json += "{\"kind\":\"unknown\"}";
+      }
+      json += "}";
+    }
+    json += "]}";
+  }
+  json += "]";
+
+  *out_len = json.size();
+  if (buffer && buffer_size > 0) {
+    const uint64_t n = std::min<uint64_t>(buffer_size, json.size());
+    std::memcpy(buffer, json.data(), n);
+  }
   return 0;
 }
 

@@ -1,5 +1,8 @@
 #include "btpu/coord/coord_server.h"
 
+#include <sys/socket.h>
+#include <sys/time.h>
+
 #include <unordered_map>
 
 #include "btpu/common/log.h"
@@ -29,6 +32,10 @@ ErrorCode CoordServer::start() {
   store_.set_replication_sink([this](uint64_t seq, const std::vector<uint8_t>& rec) {
     {
       std::lock_guard<std::mutex> lock(repl_mutex_);
+      // Only retained while a mirror is attached (followers always start
+      // from a fresh snapshot, so an empty buffer loses nothing) — a non-HA
+      // deployment must not pin the last N mutation payloads forever.
+      if (mirror_count_.load() == 0) return;
       repl_buffer_.emplace_back(seq, rec);
       while (repl_buffer_.size() > kReplBufferMax) repl_buffer_.pop_front();
     }
@@ -369,6 +376,19 @@ void CoordServer::serve_mirror(std::shared_ptr<net::Socket> sock) {
       static_cast<Op>(opcode) != Op::kMirror)
     return;
 
+  // Buffer retention starts BEFORE the snapshot so no record between the
+  // two can be missed; the follower skips seqs the snapshot already covers.
+  mirror_count_.fetch_add(1);
+  struct MirrorGuard {
+    CoordServer* server;
+    ~MirrorGuard() {
+      if (server->mirror_count_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lock(server->repl_mutex_);
+        server->repl_buffer_.clear();  // nobody is listening anymore
+      }
+    }
+  } guard{this};
+
   // Consistent handoff: the snapshot's sequence is taken under the store
   // mutex, and every record with a greater sequence is already (or will be)
   // in repl_buffer_ — the sink enqueues before the mutation's lock releases.
@@ -383,6 +403,7 @@ void CoordServer::serve_mirror(std::shared_ptr<net::Socket> sock) {
   LOG_INFO << "mirror follower attached at seq " << snap_seq;
 
   uint64_t last_sent = snap_seq;
+  auto last_frame = std::chrono::steady_clock::now();
   while (running_) {
     std::vector<std::pair<uint64_t, std::vector<uint8_t>>> pending;
     {
@@ -409,6 +430,14 @@ void CoordServer::serve_mirror(std::shared_ptr<net::Socket> sock) {
                           w.size()) != ErrorCode::OK)
         return;
       last_sent = seq;
+      last_frame = std::chrono::steady_clock::now();
+    }
+    // Liveness: an idle stream still carries pings so the follower's recv
+    // timeout distinguishes "quiet primary" from "hung/partitioned primary".
+    if (std::chrono::steady_clock::now() - last_frame > std::chrono::milliseconds(500)) {
+      if (net::send_frame(fd, static_cast<uint8_t>(Op::kPing), nullptr, 0) != ErrorCode::OK)
+        return;
+      last_frame = std::chrono::steady_clock::now();
     }
   }
 }
@@ -426,6 +455,13 @@ ErrorCode CoordFollower::sync_once(net::Socket& sock) {
   auto dialed = net::tcp_connect(hp->host, hp->port);
   if (!dialed.ok()) return dialed.error();
   sock = std::move(dialed).value();
+  // A hung (SIGSTOP'd / partitioned) primary must look like a dead one:
+  // the stream carries pings at least every ~500ms, so a 2s recv drought
+  // means primary loss and starts the takeover clock.
+  {
+    struct timeval tv{2, 0};
+    ::setsockopt(sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
 
   uint8_t hello = 2;  // mirror channel
   BTPU_RETURN_IF_ERROR(net::send_frame(sock.fd(), static_cast<uint8_t>(Op::kHello), &hello, 1));
@@ -480,7 +516,7 @@ void CoordFollower::run(net::Socket sock) {
     std::vector<uint8_t> payload;
     while (!stopping_) {
       if (net::recv_frame(sock.fd(), opcode, payload) != ErrorCode::OK) break;
-      if (static_cast<Op>(opcode) != Op::kMirrorRecord) continue;
+      if (static_cast<Op>(opcode) != Op::kMirrorRecord) continue;  // pings: liveness only
       Reader r(payload);
       uint64_t seq = 0;
       std::vector<uint8_t> rec;

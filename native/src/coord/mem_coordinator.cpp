@@ -134,13 +134,33 @@ std::pair<std::vector<uint8_t>, uint64_t> MemCoordinator::snapshot_with_seq() {
 }
 
 ErrorCode MemCoordinator::load_replica_snapshot(const std::vector<uint8_t>& bytes) {
-  std::lock_guard<std::mutex> lock(mutex_);
-  data_.clear();
-  leases_.clear();
-  if (!decode_snapshot_locked(bytes)) return ErrorCode::DATA_CORRUPTION;
-  // Persist the freshly mirrored state so a durable standby restart does not
-  // need the primary to still be alive.
-  if (wal_fd_ >= 0) journal_compact_locked();
+  // Watchers attached to a standby must not miss changes that happened
+  // while the mirror stream was down: diff old vs new state and fire the
+  // same events the live stream would have.
+  std::vector<WatchEvent> events;
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    std::map<std::string, std::string> old_values;
+    for (const auto& [key, entry] : data_) old_values.emplace(key, entry.value);
+    data_.clear();
+    leases_.clear();
+    if (!decode_snapshot_locked(bytes)) return ErrorCode::DATA_CORRUPTION;
+    if (!watches_.empty()) {
+      for (const auto& [key, entry] : data_) {
+        auto old = old_values.find(key);
+        if (old == old_values.end() || old->second != entry.value)
+          events.push_back({WatchEvent::Type::kPut, key, entry.value});
+      }
+      for (const auto& [key, value] : old_values) {
+        if (!data_.contains(key))
+          events.push_back({WatchEvent::Type::kDelete, key, ""});
+      }
+    }
+    // Persist the freshly mirrored state so a durable standby restart does
+    // not need the primary to still be alive.
+    if (wal_fd_ >= 0) journal_compact_locked();
+  }
+  for (const auto& ev : events) notify(ev.type, ev.key, ev.value);
   return ErrorCode::OK;
 }
 

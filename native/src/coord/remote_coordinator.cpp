@@ -257,18 +257,23 @@ ErrorCode RemoteCoordinator::event_call_raw(uint8_t opcode, const std::vector<ui
 
 ErrorCode RemoteCoordinator::event_call(uint8_t opcode, const std::vector<uint8_t>& req,
                                         std::vector<uint8_t>& resp) {
-  const uint64_t gen = generation_.load();
+  // Captured BEFORE each attempt: a NOT_LEADER answer only justifies
+  // rotating away from the connection that produced it (see call()).
+  uint64_t attempt_gen = generation_.load();
   auto ec = event_call_raw(opcode, req, resp);
   if (is_connection_error(ec) && !stopping_) {
-    if (reconnect(gen) == ErrorCode::OK) ec = event_call_raw(opcode, req, resp);
+    if (reconnect(attempt_gen) == ErrorCode::OK) {
+      attempt_gen = generation_.load();
+      ec = event_call_raw(opcode, req, resp);
+    }
   }
   // Standby rejection: rotate to the primary (see call()). Session state
   // (watches, campaigns) is replayed by connect_locked on the new endpoint.
   for (size_t hops = 0; ec == ErrorCode::OK && peek_status(resp) == ErrorCode::NOT_LEADER &&
                         hops + 1 < endpoints_.size();
        ++hops) {
-    const uint64_t attempt_gen = generation_.load();
     if (rotate_endpoint(attempt_gen) != ErrorCode::OK) break;
+    attempt_gen = generation_.load();
     ec = event_call_raw(opcode, req, resp);
   }
   return ec;
@@ -544,18 +549,18 @@ ErrorCode RemoteCoordinator::campaign(const std::string& election,
     leader_cbs_[key] = std::move(cb);
     campaigns_[key] = {election, candidate_id, lease_ttl_ms};
   }
-  const uint64_t gen = generation_.load();
+  uint64_t attempt_gen = generation_.load();
   auto ec = send_campaign(election, candidate_id, lease_ttl_ms);
   if (is_connection_error(ec) && !stopping_) {
     // reconnect() replays campaigns_ (including this one) on success.
-    ec = reconnect(gen);
+    ec = reconnect(attempt_gen);
   }
   // A standby rejects candidacies: rotate to the primary and re-send
   // (send_campaign absorbs the ALREADY_EXISTS left by connect replay).
   for (size_t hops = 0;
        ec == ErrorCode::NOT_LEADER && !stopping_ && hops + 1 < endpoints_.size(); ++hops) {
-    const uint64_t attempt_gen = generation_.load();
     if (rotate_endpoint(attempt_gen) != ErrorCode::OK) break;
+    attempt_gen = generation_.load();
     ec = send_campaign(election, candidate_id, lease_ttl_ms);
   }
   if (ec != ErrorCode::OK) {

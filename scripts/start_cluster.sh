@@ -23,24 +23,39 @@ cleanup() {
   [[ -n "${WORKER_PID:-}" ]] && kill "$WORKER_PID" 2>/dev/null || true
   [[ -n "${KEYSTONE2_PID:-}" ]] && kill "$KEYSTONE2_PID" 2>/dev/null || true
   [[ -n "${KEYSTONE_PID:-}" ]] && kill "$KEYSTONE_PID" 2>/dev/null || true
+  [[ -n "${COORD2_PID:-}" ]] && kill "$COORD2_PID" 2>/dev/null || true
   [[ -n "${COORD_PID:-}" ]] && kill "$COORD_PID" 2>/dev/null || true
 }
 trap cleanup EXIT INT TERM
 
+# BTPU_HA=1 runs active/standby pairs of BOTH control services: a mirroring
+# standby bb-coord (promotes on primary loss) and a standby keystone;
+# clients and services get both endpoints of each.
+HA="${BTPU_HA:-0}"
+COORD2_PORT="${BTPU_COORD2_PORT:-9294}"
+
 echo "starting bb-coord on :$COORD_PORT"
-"$BUILD/bb-coord" --host 127.0.0.1 --port "$COORD_PORT" >"$RUN_DIR/coord.log" 2>&1 &
+"$BUILD/bb-coord" --host 127.0.0.1 --port "$COORD_PORT" \
+  --data-dir "$RUN_DIR/coord-data" >"$RUN_DIR/coord.log" 2>&1 &
 COORD_PID=$!
 sleep 0.3
 
-# BTPU_HA=1 runs an active/standby keystone pair; clients get both endpoints.
-HA="${BTPU_HA:-0}"
+COORD_ENDPOINTS="127.0.0.1:$COORD_PORT"
+if [[ "$HA" == "1" ]]; then
+  echo "starting standby bb-coord on :$COORD2_PORT (following :$COORD_PORT)"
+  "$BUILD/bb-coord" --host 127.0.0.1 --port "$COORD2_PORT" \
+    --follow "127.0.0.1:$COORD_PORT" >"$RUN_DIR/coord2.log" 2>&1 &
+  COORD2_PID=$!
+  COORD_ENDPOINTS="$COORD_ENDPOINTS,127.0.0.1:$COORD2_PORT"
+  sleep 0.3
+fi
 KEYSTONE2_PORT="${BTPU_KEYSTONE2_PORT:-9092}"
 HA_FLAGS=()
 [[ "$HA" == "1" ]] && HA_FLAGS=(--ha)
 
 echo "starting bb-keystone on :$KEYSTONE_PORT"
 "$BUILD/bb-keystone" --config "$REPO_ROOT/configs/keystone.yaml" \
-  --coord "127.0.0.1:$COORD_PORT" --listen "127.0.0.1:$KEYSTONE_PORT" \
+  --coord "$COORD_ENDPOINTS" --listen "127.0.0.1:$KEYSTONE_PORT" \
   --service-id ks-primary ${HA_FLAGS[@]+"${HA_FLAGS[@]}"} \
   >"$RUN_DIR/keystone.log" 2>&1 &
 KEYSTONE_PID=$!
@@ -50,7 +65,7 @@ CLIENT_ENDPOINTS="127.0.0.1:$KEYSTONE_PORT"
 if [[ "$HA" == "1" ]]; then
   echo "starting standby bb-keystone on :$KEYSTONE2_PORT"
   "$BUILD/bb-keystone" --config "$REPO_ROOT/configs/keystone.yaml" \
-    --coord "127.0.0.1:$COORD_PORT" --listen "127.0.0.1:$KEYSTONE2_PORT" \
+    --coord "$COORD_ENDPOINTS" --listen "127.0.0.1:$KEYSTONE2_PORT" \
     --metrics-port 9093 --service-id ks-standby --ha \
     >"$RUN_DIR/keystone2.log" 2>&1 &
   KEYSTONE2_PID=$!
@@ -60,7 +75,7 @@ fi
 
 echo "starting bb-worker"
 "$BUILD/bb-worker" --config "$REPO_ROOT/configs/worker.yaml" \
-  --coord "127.0.0.1:$COORD_PORT" >"$RUN_DIR/worker.log" 2>&1 &
+  --coord "$COORD_ENDPOINTS" >"$RUN_DIR/worker.log" 2>&1 &
 WORKER_PID=$!
 sleep 0.7
 
@@ -73,7 +88,7 @@ echo "metrics scrape:"
 curl -sf "http://127.0.0.1:9091/metrics" | head -5 || true
 
 echo
-echo "cluster up. PIDs: coord=$COORD_PID keystone=$KEYSTONE_PID${KEYSTONE2_PID:+ standby=$KEYSTONE2_PID} worker=$WORKER_PID"
+echo "cluster up. PIDs: coord=$COORD_PID${COORD2_PID:+ coord-standby=$COORD2_PID} keystone=$KEYSTONE_PID${KEYSTONE2_PID:+ standby=$KEYSTONE2_PID} worker=$WORKER_PID"
 echo "logs in $RUN_DIR. Ctrl-C to stop."
 if [[ "${BTPU_CLUSTER_ONESHOT:-0}" == "1" ]]; then
   exit 0

@@ -117,3 +117,22 @@ def test_tiered_cluster_demotes_under_pressure():
         assert counters["evicted"] == 0  # moved, not deleted
         for key, expected in payloads.items():
             assert client.get(key) == expected
+
+
+def test_placements_introspection():
+    from blackbird_tpu import EmbeddedCluster
+
+    with EmbeddedCluster(workers=4, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        client.put("intro/obj", b"z" * (1 << 20), replicas=2, max_workers=2)
+        copies = client.placements("intro/obj")
+        assert len(copies) == 2
+        workers = set()
+        for copy in copies:
+            assert len(copy["shards"]) == 2  # striped x2 (256 KiB floor)
+            for shard in copy["shards"]:
+                assert shard["class"] == "ram_cpu"
+                assert shard["location"]["kind"] == "memory"
+                assert shard["length"] > 0
+                workers.add(shard["worker"])
+        assert len(workers) == 4  # copies spread over disjoint workers

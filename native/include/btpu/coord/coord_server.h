@@ -71,7 +71,7 @@ class CoordServer {
   std::mutex repl_mutex_;
   std::condition_variable repl_cv_;
   std::deque<std::pair<uint64_t, std::vector<uint8_t>>> repl_buffer_;
-  std::atomic<size_t> mirror_count_{0};  // buffer retained only while > 0
+  size_t mirror_count_{0};  // guarded by repl_mutex_; buffer retained while > 0
 };
 
 // Standby engine: mirrors `primary_endpoint` into `server`'s store and

@@ -1,5 +1,6 @@
 #include "btpu/capi.h"
 
+#include <cstdio>
 #include <cstring>
 
 #include "btpu/client/embedded.h"
@@ -264,9 +265,17 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
   std::string json = "[";
   auto esc = [](const std::string& s) {
     std::string out;
+    char hex[8];
     for (char c : s) {
-      if (c == '"' || c == '\\') out += '\\';
-      out += c;
+      if (c == '"' || c == '\\') {
+        out += '\\';
+        out += c;
+      } else if (static_cast<unsigned char>(c) < 0x20) {
+        std::snprintf(hex, sizeof(hex), "\\u%04x", c);
+        out += hex;
+      } else {
+        out += c;
+      }
     }
     return out;
   };

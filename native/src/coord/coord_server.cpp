@@ -35,7 +35,7 @@ ErrorCode CoordServer::start() {
       // Only retained while a mirror is attached (followers always start
       // from a fresh snapshot, so an empty buffer loses nothing) — a non-HA
       // deployment must not pin the last N mutation payloads forever.
-      if (mirror_count_.load() == 0) return;
+      if (mirror_count_ == 0) return;
       repl_buffer_.emplace_back(seq, rec);
       while (repl_buffer_.size() > kReplBufferMax) repl_buffer_.pop_front();
     }
@@ -378,14 +378,18 @@ void CoordServer::serve_mirror(std::shared_ptr<net::Socket> sock) {
 
   // Buffer retention starts BEFORE the snapshot so no record between the
   // two can be missed; the follower skips seqs the snapshot already covers.
-  mirror_count_.fetch_add(1);
+  // Count and clear move together under repl_mutex_: a detach that raced a
+  // fresh attach must never clear records the new follower still needs.
+  {
+    std::lock_guard<std::mutex> lock(repl_mutex_);
+    ++mirror_count_;
+  }
   struct MirrorGuard {
     CoordServer* server;
     ~MirrorGuard() {
-      if (server->mirror_count_.fetch_sub(1) == 1) {
-        std::lock_guard<std::mutex> lock(server->repl_mutex_);
+      std::lock_guard<std::mutex> lock(server->repl_mutex_);
+      if (--server->mirror_count_ == 0)
         server->repl_buffer_.clear();  // nobody is listening anymore
-      }
     }
   } guard{this};
 

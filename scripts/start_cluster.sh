@@ -34,6 +34,11 @@ trap cleanup EXIT INT TERM
 HA="${BTPU_HA:-0}"
 COORD2_PORT="${BTPU_COORD2_PORT:-9294}"
 
+# Fresh durable state per bring-up (reference parity: start_cluster.sh gave
+# etcd a fresh datadir) — a leftover WAL would resurrect the previous run's
+# objects and registry into this "clean" cluster.
+rm -rf "$RUN_DIR/coord-data"
+
 echo "starting bb-coord on :$COORD_PORT"
 "$BUILD/bb-coord" --host 127.0.0.1 --port "$COORD_PORT" \
   --data-dir "$RUN_DIR/coord-data" >"$RUN_DIR/coord.log" 2>&1 &

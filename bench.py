@@ -155,6 +155,29 @@ def main() -> int:
     small_rows = run_bench(binary, size=64 << 10, iterations=300, transport="tcp")
     shm_rows = run_bench(binary, size=1 << 20, iterations=150, transport="shm")
     local_rows = run_bench(binary, size=1 << 20, iterations=150, transport="local")
+    # One bb-bench --sweep run covers the remaining size points (4KiB/16MiB;
+    # its 64KiB/1MiB rows duplicate the dedicated headline runs above).
+    result = subprocess.run(
+        [str(binary), "--embedded", "4", "--iterations", "60", "--max-workers", "4",
+         "--json", "--transport", "tcp", "--sweep"],
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+    )
+    if result.returncode == 0:
+        sweep = {}
+        for line in result.stdout.splitlines():
+            row = json.loads(line)
+            sweep[(row["op"], row["bytes"])] = row
+        for size in (4 << 10, 16 << 20):
+            put, get = sweep.get(("put", size)), sweep.get(("get", size))
+            if not put or not get:
+                continue
+            label = f"{size // 1024}KiB" if size < (1 << 20) else f"{size >> 20}MiB"
+            print(
+                f"tcp sweep {label}: put {put['gbps']:.2f} GB/s "
+                f"(p99 {put['p99_us']:.0f}us) | get {get['gbps']:.2f} GB/s "
+                f"(p99 {get['p99_us']:.0f}us)",
+                file=sys.stderr,
+            )
 
     get_gbps = main_rows["get"]["gbps"]
     print(

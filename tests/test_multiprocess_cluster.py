@@ -40,23 +40,49 @@ def port_open(port: int) -> bool:
         return sock.connect_ex(("127.0.0.1", port)) == 0
 
 
-def write_worker_config(tmp_path: Path, worker_id: str, coord_port: int) -> Path:
+def write_worker_config(tmp_path: Path, worker_id: str, coord_endpoints: str,
+                        cluster_id: str = "mp_cluster", ttl_ms: int = 1200) -> Path:
     path = tmp_path / f"{worker_id}.yaml"
     path.write_text(
         f"""worker_id: {worker_id}
-cluster_id: mp_cluster
-coord_endpoints: 127.0.0.1:{coord_port}
+cluster_id: {cluster_id}
+coord_endpoints: {coord_endpoints}
 transport: tcp
 listen_host: 127.0.0.1
 heartbeat:
   interval_ms: 300
-  ttl_ms: 1200
+  ttl_ms: {ttl_ms}
 pools:
   - id: {worker_id}-dram
     storage_class: ram_cpu
     capacity: 32MB
 """)
     return path
+
+
+def make_spawner(procs):
+    """Returns spawn(args, name) appending to `procs` for teardown()."""
+
+    def spawn(args, name):
+        proc = subprocess.Popen(
+            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        procs.append((name, proc))
+        return proc
+
+    return spawn
+
+
+def teardown(procs, timeout=10):
+    for name, proc in reversed(procs):
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    for name, proc in procs:
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
 
 
 @pytest.fixture()
@@ -78,13 +104,7 @@ worker_heartbeat_ttl_sec: 2
 
     procs = []
 
-    def spawn(args, name):
-        proc = subprocess.Popen(
-            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append((name, proc))
-        return proc
+    spawn = make_spawner(procs)
 
     try:
         spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
@@ -94,7 +114,7 @@ worker_heartbeat_ttl_sec: 2
         wait_for(lambda: port_open(keystone_port), what="bb-keystone")
         workers = []
         for i in range(2):
-            cfg = write_worker_config(tmp_path, f"mpw-{i}", coord_port)
+            cfg = write_worker_config(tmp_path, f"mpw-{i}", f"127.0.0.1:{coord_port}")
             workers.append(spawn([str(BUILD / "bb-worker"), "--config", str(cfg)],
                                  f"worker-{i}"))
         yield {
@@ -103,14 +123,7 @@ worker_heartbeat_ttl_sec: 2
             "workers": workers,
         }
     finally:
-        for name, proc in reversed(procs):
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for name, proc in procs:
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        teardown(procs, timeout=5)
 
 
 def test_multiprocess_put_get_failover(cluster):
@@ -152,13 +165,7 @@ def test_multiprocess_ha_keystone_failover(tmp_path):
     metrics_ports = [free_port(), free_port()]
     procs = []
 
-    def spawn(args, name):
-        proc = subprocess.Popen(
-            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append((name, proc))
-        return proc
+    spawn = make_spawner(procs)
 
     def keystone_cfg(i: int) -> Path:
         path = tmp_path / f"ks{i}.yaml"
@@ -229,14 +236,7 @@ pools:
         assert client.get("ha/before") == payload  # mirrored record survived
         assert client.get("ha/after") == payload
     finally:
-        for name, proc in reversed(procs):
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for name, proc in procs:
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        teardown(procs, timeout=5)
 
 
 def test_multiprocess_coordinator_crash_restart(tmp_path):
@@ -253,13 +253,7 @@ def test_multiprocess_coordinator_crash_restart(tmp_path):
     coord_dir = tmp_path / "coord-data"
     procs = []
 
-    def spawn(args, name):
-        proc = subprocess.Popen(
-            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append((name, proc))
-        return proc
+    spawn = make_spawner(procs)
 
     keystone_cfg = tmp_path / "keystone.yaml"
     keystone_cfg.write_text(
@@ -282,7 +276,7 @@ worker_heartbeat_ttl_sec: 5
         spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
         wait_for(lambda: port_open(keystone_port), what="bb-keystone")
         for i in range(2):
-            cfg = write_worker_config(tmp_path, f"crw-{i}", coord_port)
+            cfg = write_worker_config(tmp_path, f"crw-{i}", f"127.0.0.1:{coord_port}")
             cfg.write_text(cfg.read_text().replace("mp_cluster", "cr_cluster"))
             spawn([str(BUILD / "bb-worker"), "--config", str(cfg)], f"worker-{i}")
 
@@ -320,14 +314,7 @@ worker_heartbeat_ttl_sec: 5
         assert client.get("cr/after") == payload
         assert client.stats()["workers"] == 2
     finally:
-        for name, proc in reversed(procs):
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for name, proc in procs:
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        teardown(procs, timeout=5)
 
 
 def test_multiprocess_leader_kill_during_inflight_puts(tmp_path):
@@ -344,13 +331,7 @@ def test_multiprocess_leader_kill_during_inflight_puts(tmp_path):
     metrics_ports = [free_port(), free_port()]
     procs = []
 
-    def spawn(args, name):
-        proc = subprocess.Popen(
-            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append((name, proc))
-        return proc
+    spawn = make_spawner(procs)
 
     def keystone_cfg(i: int) -> Path:
         path = tmp_path / f"ks{i}.yaml"
@@ -378,7 +359,7 @@ service_refresh_interval_sec: 1
                 [str(BUILD / "bb-keystone"), "--config", str(keystone_cfg(i)),
                  "--service-id", f"ks-{i}"], f"keystone-{i}"))
             wait_for(lambda: port_open(ks_ports[i]), what=f"bb-keystone-{i}")
-        cfg = write_worker_config(tmp_path, "ifw-0", coord_port)
+        cfg = write_worker_config(tmp_path, "ifw-0", f"127.0.0.1:{coord_port}")
         cfg.write_text(cfg.read_text().replace("mp_cluster", "if_cluster"))
         spawn([str(BUILD / "bb-worker"), "--config", str(cfg)], "worker")
 
@@ -416,14 +397,7 @@ service_refresh_interval_sec: 1
         # The stream recovered: the tail of the run succeeded again.
         assert succeeded[-1] == stop_at - 1, (succeeded[-5:], failed[-5:])
     finally:
-        for name, proc in reversed(procs):
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for name, proc in procs:
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        teardown(procs, timeout=5)
 
 
 def test_multiprocess_python_worker_serves_jax_hbm_tier(tmp_path):
@@ -468,13 +442,7 @@ pools:
 
     procs = []
 
-    def spawn(args, name):
-        proc = subprocess.Popen(
-            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append((name, proc))
-        return proc
+    spawn = make_spawner(procs)
 
     try:
         spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
@@ -489,9 +457,13 @@ pools:
         from blackbird_tpu import Client, StorageClass
 
         client = Client(f"127.0.0.1:{keystone_port}")
-        wait_for(lambda: client.stats()["pools"] == 2, timeout=60,
-                 what="python worker pools (JAX import is slow)")
-        assert worker.poll() is None, "python worker exited early"
+        # JAX import + jit warmup in the worker can take minutes on a loaded
+        # single-CPU box; poll generously but bail fast if it died.
+        def pools_up():
+            assert worker.poll() is None, "python worker exited early"
+            return client.stats()["pools"] == 2
+
+        wait_for(pools_up, timeout=240, what="python worker pools")
 
         payload = bytes(bytearray(range(256)) * 4096)  # 1 MiB
         client.put("mp/jaxhbm", payload, max_workers=1,
@@ -514,14 +486,7 @@ pools:
             r'btpu_tier_used_bytes\{class="hbm_tpu"\} (\d+)', body).group(1))
         assert hbm_used >= len(payload) + len(small)
     finally:
-        for name, proc in reversed(procs):
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for name, proc in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        teardown(procs)
 
 
 def test_multiprocess_coordinator_standby_failover(tmp_path):
@@ -550,13 +515,7 @@ worker_heartbeat_ttl_sec: 2
 
     procs = []
 
-    def spawn(args, name):
-        proc = subprocess.Popen(
-            args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append((name, proc))
-        return proc
+    spawn = make_spawner(procs)
 
     try:
         primary = spawn(
@@ -571,10 +530,7 @@ worker_heartbeat_ttl_sec: 2
         spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
         wait_for(lambda: port_open(keystone_port), what="bb-keystone")
         for i in range(2):
-            cfg = write_worker_config(tmp_path, f"ha-{i}", coord_port)
-            cfg.write_text(cfg.read_text().replace(
-                f"coord_endpoints: 127.0.0.1:{coord_port}",
-                f"coord_endpoints: {coord_list}"))
+            cfg = write_worker_config(tmp_path, f"ha-{i}", coord_list)
             spawn([str(BUILD / "bb-worker"), "--config", str(cfg)], f"worker-{i}")
 
         client = Client(f"127.0.0.1:{keystone_port}")
@@ -613,11 +569,88 @@ worker_heartbeat_ttl_sec: 2
                  what="death detection through the promoted standby")
         assert client.get("ha/before") == payload  # replica on the survivor
     finally:
-        for name, proc in reversed(procs):
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for name, proc in procs:
+        teardown(procs)
+
+
+def test_multiprocess_full_control_plane_failover(tmp_path):
+    """The maximal availability scenario: BOTH control services lose their
+    primary at once. Coordinator primary + standby, keystone leader +
+    standby (elected through the coordinator), two workers. SIGKILL the
+    coordinator primary AND the keystone leader together; the coordinator
+    standby promotes, the keystone standby wins the re-formed election over
+    the promoted coordinator, workers re-heartbeat, and the same client
+    object keeps reading pre-crash data and accepting new puts."""
+    from blackbird_tpu import Client
+
+    coord_ports = [free_port(), free_port()]
+    ks_ports = [free_port(), free_port()]
+    ks_metrics_ports = [free_port(), free_port()]
+    coord_list = f"127.0.0.1:{coord_ports[0]},127.0.0.1:{coord_ports[1]}"
+    procs = []
+
+    spawn = make_spawner(procs)
+
+    def keystone_cfg(i: int) -> Path:
+        path = tmp_path / f"fks{i}.yaml"
+        path.write_text(
+            f"""cluster_id: full_ha
+coord_endpoints: {coord_list}
+listen_address: 127.0.0.1:{ks_ports[i]}
+http_metrics_port: "{ks_metrics_ports[i]}"
+enable_ha: true
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 5
+service_registration_ttl_sec: 3
+service_refresh_interval_sec: 1
+""")
+        return path
+
+    try:
+        coord_primary = spawn(
+            [str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port",
+             str(coord_ports[0])], "coord-primary")
+        wait_for(lambda: port_open(coord_ports[0]), what="coord primary")
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port",
+               str(coord_ports[1]), "--follow", f"127.0.0.1:{coord_ports[0]}",
+               "--takeover-ms", "1500"], "coord-standby")
+        wait_for(lambda: port_open(coord_ports[1]), what="coord standby")
+
+        ks_leader = spawn(
+            [str(BUILD / "bb-keystone"), "--config", str(keystone_cfg(0)),
+             "--service-id", "fks-0"], "keystone-0")
+        wait_for(lambda: port_open(ks_ports[0]), what="keystone leader")
+        spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg(1)),
+               "--service-id", "fks-1"], "keystone-1")
+        wait_for(lambda: port_open(ks_ports[1]), what="keystone standby")
+
+        for i in range(2):
+            wcfg = write_worker_config(tmp_path, f"fhw-{i}", coord_list,
+                                       cluster_id="full_ha", ttl_ms=2000)
+            spawn([str(BUILD / "bb-worker"), "--config", str(wcfg)], f"worker-{i}")
+
+        client = Client(f"127.0.0.1:{ks_ports[0]},127.0.0.1:{ks_ports[1]}")
+        wait_for(lambda: client.stats()["workers"] == 2, timeout=20, what="2 workers")
+
+        payload = bytes(bytearray(range(233)) * 1024)
+        client.put("full/before", payload, replicas=2, max_workers=1)
+        assert client.get("full/before") == payload
+
+        # Double decapitation.
+        coord_primary.kill()
+        ks_leader.kill()
+
+        def recovered():
             try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+                key = f"full/after-{time.monotonic_ns()}"
+                client.put(key, b"alive", max_workers=1)
+                return client.get(key) == b"alive"
+            except Exception:
+                return False
+
+        wait_for(recovered, timeout=40, what="puts after double control-plane loss")
+        assert client.get("full/before") == payload
+        wait_for(lambda: client.stats()["workers"] == 2, timeout=20,
+                 what="both workers back on the promoted control plane")
+    finally:
+        teardown(procs)

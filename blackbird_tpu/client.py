@@ -42,7 +42,16 @@ class Client:
         replicas: int = 1,
         max_workers: int = 4,
         preferred_class: StorageClass | None = None,
+        ttl_ms: int | None = None,
+        soft_pin: bool = False,
     ) -> None:
+        """ttl_ms: None = the framework default (30 min), 0 = never
+        expires, >0 = the GC collects the object that long after CREATION
+        (a fixed deadline, not a sliding window — reads do not extend it).
+        soft_pin exempts the object from watermark eviction (demotion
+        still applies)."""
+        if ttl_ms is not None and ttl_ms < 0:
+            raise ValueError(f"ttl_ms must be >= 0, got {ttl_ms}")
         if isinstance(data, np.ndarray):
             data = np.ascontiguousarray(data)
             buf = data.ctypes.data_as(ctypes.c_void_p)
@@ -52,7 +61,7 @@ class Client:
             buf = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
             size = len(data)
         check(
-            lib.btpu_put(
+            lib.btpu_put_ex(
                 self._handle,
                 key.encode(),
                 buf,
@@ -60,6 +69,8 @@ class Client:
                 replicas,
                 max_workers,
                 int(preferred_class) if preferred_class else 0,
+                -1 if ttl_ms is None else ttl_ms,
+                1 if soft_pin else 0,
             ),
             f"put {key!r}",
         )

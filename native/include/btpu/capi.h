@@ -48,6 +48,12 @@ void btpu_client_destroy(btpu_client* client);
 // preferred_class 0 = no preference. replicas 0 = cluster default.
 int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,
                  uint32_t replicas, uint32_t max_workers, uint32_t preferred_class);
+/* Full placement-policy put: ttl_ms -1 = cluster default, 0 = never expires,
+ * >0 = GC collects after that long; soft_pin exempts the object from
+ * watermark eviction (reference WorkerConfig ttl/soft-pin semantics). */
+int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint64_t size,
+                    uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
+                    int64_t ttl_ms, int32_t soft_pin);
 // Returns object size via out_size; buffer may be NULL to query size only.
 int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size,
                  uint64_t* out_size);

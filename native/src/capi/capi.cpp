@@ -139,12 +139,21 @@ void btpu_client_destroy(btpu_client* client) { delete client; }
 
 int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,
                  uint32_t replicas, uint32_t max_workers, uint32_t preferred_class) {
+  return btpu_put_ex(client, key, data, size, replicas, max_workers, preferred_class,
+                     /*ttl_ms=*/-1, /*soft_pin=*/0);
+}
+
+int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint64_t size,
+                    uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
+                    int64_t ttl_ms, int32_t soft_pin) {
   if (!client || !key || !data) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   WorkerConfig cfg;
   cfg.replication_factor = replicas == 0 ? 1 : replicas;
   cfg.max_workers_per_copy = max_workers == 0 ? 1 : max_workers;
   if (preferred_class != 0)
     cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
+  if (ttl_ms >= 0) cfg.ttl_ms = static_cast<uint64_t>(ttl_ms);
+  cfg.enable_soft_pin = soft_pin != 0;
   return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
 }
 

@@ -276,8 +276,8 @@ worker_heartbeat_ttl_sec: 5
         spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
         wait_for(lambda: port_open(keystone_port), what="bb-keystone")
         for i in range(2):
-            cfg = write_worker_config(tmp_path, f"crw-{i}", f"127.0.0.1:{coord_port}")
-            cfg.write_text(cfg.read_text().replace("mp_cluster", "cr_cluster"))
+            cfg = write_worker_config(tmp_path, f"crw-{i}", f"127.0.0.1:{coord_port}",
+                                      cluster_id="cr_cluster")
             spawn([str(BUILD / "bb-worker"), "--config", str(cfg)], f"worker-{i}")
 
         client = Client(f"127.0.0.1:{keystone_port}")
@@ -359,8 +359,8 @@ service_refresh_interval_sec: 1
                 [str(BUILD / "bb-keystone"), "--config", str(keystone_cfg(i)),
                  "--service-id", f"ks-{i}"], f"keystone-{i}"))
             wait_for(lambda: port_open(ks_ports[i]), what=f"bb-keystone-{i}")
-        cfg = write_worker_config(tmp_path, "ifw-0", f"127.0.0.1:{coord_port}")
-        cfg.write_text(cfg.read_text().replace("mp_cluster", "if_cluster"))
+        cfg = write_worker_config(tmp_path, "ifw-0", f"127.0.0.1:{coord_port}",
+                                  cluster_id="if_cluster")
         spawn([str(BUILD / "bb-worker"), "--config", str(cfg)], "worker")
 
         client = Client(f"127.0.0.1:{ks_ports[0]},127.0.0.1:{ks_ports[1]}")

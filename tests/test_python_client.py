@@ -136,3 +136,23 @@ def test_placements_introspection():
                 assert shard["length"] > 0
                 workers.add(shard["worker"])
         assert len(workers) == 4  # copies spread over disjoint workers
+
+
+def test_object_ttl_and_soft_pin():
+    import time
+
+    from blackbird_tpu import EmbeddedCluster
+
+    with EmbeddedCluster(workers=1, pool_bytes=8 << 20) as cluster:
+        client = cluster.client()
+        client.put("ttl/short", b"ephemeral", ttl_ms=300)
+        client.put("ttl/forever", b"permanent", ttl_ms=0)
+        client.put("ttl/pinned", b"pinned", soft_pin=True)
+        assert client.exists("ttl/short")
+
+        deadline = time.monotonic() + 10  # gc interval is 1s in embedded
+        while client.exists("ttl/short") and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not client.exists("ttl/short")  # TTL'd object collected
+        assert client.get("ttl/forever") == b"permanent"  # ttl_ms=0: never
+        assert client.get("ttl/pinned") == b"pinned"

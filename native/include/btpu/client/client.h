@@ -98,6 +98,12 @@ class ObjectClient {
   }
 
  private:
+  // Fast path for wide replicated reads: slices the byte range round-robin
+  // across replicas and pulls the slices in parallel. Returns NOT_IMPLEMENTED
+  // when not applicable (single copy, small object, device shards, or
+  // divergent copy sizes) — callers fall back to the per-copy loop.
+  ErrorCode try_split_read(const std::vector<CopyPlacement>& copies, uint8_t* buffer,
+                           uint64_t size);
   // Writes `data` into every shard of `copy` (running offset), in parallel.
   ErrorCode transfer_copy_put(const CopyPlacement& copy, const uint8_t* data, uint64_t size);
   ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);

@@ -89,10 +89,12 @@ std::unique_ptr<TransportClient> make_transport_client();
 
 // Fault injection for hermetic failure-path tests (the reference has no
 // fault injection of any kind, SURVEY §5): wraps a client and fails the
-// n-th read/write exactly once with the given error.
+// n-th read/write exactly once with the given error, and/or persistently
+// fails every op aimed at one endpoint (a dead replica/worker).
 struct FaultSpec {
   uint32_t fail_nth_write{0};  // 1-based op count; 0 = never fail
   uint32_t fail_nth_read{0};
+  std::string fail_endpoint;   // every op on this endpoint fails; "" = off
   ErrorCode error{ErrorCode::NETWORK_ERROR};
 };
 std::unique_ptr<TransportClient> make_faulty_transport_client(
@@ -106,6 +108,13 @@ std::unique_ptr<TransportClient> make_faulty_transport_client(
 // data movers so new location kinds cannot diverge between them.
 ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_t in_off,
                    uint8_t* buf, uint64_t len, bool is_write);
+
+// Reads or writes [obj_off, obj_off+len) of one copy through its shards
+// (running-offset walk; partial-shard access offsets into the registered
+// region). Shared by the client SDK's split-replica reads and keystone's
+// repair/demotion movers.
+ErrorCode copy_range_io(TransportClient& client, const CopyPlacement& copy, uint64_t obj_off,
+                        uint8_t* buf, uint64_t len, bool is_write);
 
 // One element of a multi-shard transfer (buf already points at this shard's
 // slice of the object buffer).

@@ -122,6 +122,7 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
     for (const auto& shard : copies.value().front().shards) size += shard.length;
   }
   std::vector<uint8_t> buffer(size);
+  if (try_split_read(copies.value(), buffer.data(), size) == ErrorCode::OK) return buffer;
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
   for (const auto& copy : copies.value()) {
     uint64_t copy_size = 0;
@@ -143,6 +144,13 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
   TRACE_SPAN("client.get");
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
+  uint64_t size = 0;
+  if (!copies.value().empty()) {
+    for (const auto& shard : copies.value().front().shards) size += shard.length;
+  }
+  if (size <= buffer_size &&
+      try_split_read(copies.value(), static_cast<uint8_t*>(buffer), size) == ErrorCode::OK)
+    return size;
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
   for (const auto& copy : copies.value()) {
     uint64_t copy_size = 0;
@@ -222,6 +230,40 @@ ErrorCode run_parallel(size_t count, size_t parallelism, uint64_t bytes_per_shar
   return static_cast<ErrorCode>(first_error.load());
 }
 }  // namespace
+
+// Wide replicated reads split the byte range into parallel slices assigned
+// round-robin across replicas — aggregate read bandwidth is every replica's
+// link, not one (the reference left this as a TODO,
+// blackbird_client.cpp:283), while slice-level fan-out keeps the intra-copy
+// parallelism the whole-copy path has. Any failure reports back and the
+// caller falls back to sequential per-copy reads, so a dead replica costs a
+// retry, never the object.
+ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
+                                       uint8_t* buffer, uint64_t size) {
+  constexpr uint64_t kSplitReadMin = 512 * 1024;  // below this, one copy wins
+  if (copies.size() < 2 || size < kSplitReadMin || options_.io_parallelism < 2)
+    return ErrorCode::NOT_IMPLEMENTED;
+  for (const auto& copy : copies) {
+    uint64_t copy_size = 0;
+    for (const auto& shard : copy.shards) {
+      if (std::holds_alternative<DeviceLocation>(shard.location))
+        return ErrorCode::NOT_IMPLEMENTED;  // device reads batch better whole
+      copy_size += shard.length;
+    }
+    if (copy_size != size) return ErrorCode::NOT_IMPLEMENTED;  // divergent copies
+  }
+  const uint64_t n_slices =
+      std::min<uint64_t>(options_.io_parallelism, size / (kSplitReadMin / 2));
+  const uint64_t slice = (size + n_slices - 1) / n_slices;
+  return run_parallel(static_cast<size_t>(n_slices), options_.io_parallelism, slice,
+                      [&](size_t j) {
+                        const uint64_t lo = j * slice;
+                        const uint64_t len = std::min(slice, size - lo);
+                        return transport::copy_range_io(*data_, copies[j % copies.size()],
+                                                        lo, buffer + lo, len,
+                                                        /*is_write=*/false);
+                      });
+}
 
 // Shared by the single-object and batched paths: device-location shards are
 // coalesced into ONE provider scatter/gather call (per-op device latency is

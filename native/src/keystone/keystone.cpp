@@ -72,29 +72,11 @@ bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
                              out.copies, out.created_wall_ms, out.last_access_wall_ms);
 }
 
-// Reads or writes [obj_off, obj_off+len) of one copy through its shards.
-// Partial-shard access offsets into the shard's registered region.
+// Reads or writes [obj_off, obj_off+len) of one copy through its shards
+// (shared walk lives in transport::copy_range_io).
 ErrorCode copy_io(transport::TransportClient& client, const CopyPlacement& copy,
                   uint64_t obj_off, uint8_t* buf, uint64_t len, bool is_write) {
-  uint64_t shard_start = 0;
-  uint64_t cur = obj_off, remaining = len;
-  uint8_t* p = buf;
-  for (const auto& shard : copy.shards) {
-    const uint64_t shard_end = shard_start + shard.length;
-    if (cur < shard_end && remaining > 0) {
-      const uint64_t in_off = cur - shard_start;
-      const uint64_t n = std::min(remaining, shard.length - in_off);
-      if (auto ec = transport::shard_io(client, shard, in_off, p, n, is_write);
-          ec != ErrorCode::OK)
-        return ec;
-      p += n;
-      cur += n;
-      remaining -= n;
-    }
-    shard_start = shard_end;
-    if (remaining == 0) break;
-  }
-  return remaining == 0 ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
+  return transport::copy_range_io(client, copy, obj_off, buf, len, is_write);
 }
 
 bool all_shards_on_device(const CopyPlacement& copy) {

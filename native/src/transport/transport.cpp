@@ -1,4 +1,5 @@
 // Transport factory + the mux client routing on descriptor kind.
+#include <algorithm>
 #include <atomic>
 #include <charconv>
 #include <cstdio>
@@ -115,6 +116,8 @@ class FaultyTransportClient final : public TransportClient {
 
   ErrorCode read(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
                  void* dst, uint64_t len) override {
+    if (!spec_.fail_endpoint.empty() && remote.endpoint == spec_.fail_endpoint)
+      return spec_.error;
     if (spec_.fail_nth_read != 0 &&
         reads_.fetch_add(1) + 1 == spec_.fail_nth_read)
       return spec_.error;
@@ -122,6 +125,8 @@ class FaultyTransportClient final : public TransportClient {
   }
   ErrorCode write(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
                   const void* src, uint64_t len) override {
+    if (!spec_.fail_endpoint.empty() && remote.endpoint == spec_.fail_endpoint)
+      return spec_.error;
     if (spec_.fail_nth_write != 0 &&
         writes_.fetch_add(1) + 1 == spec_.fail_nth_write)
       return spec_.error;
@@ -161,6 +166,28 @@ ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_
   // FileLocation shards are served by the worker via virtual regions and
   // should never surface on a client data path.
   return ErrorCode::NOT_IMPLEMENTED;
+}
+
+ErrorCode copy_range_io(TransportClient& client, const CopyPlacement& copy, uint64_t obj_off,
+                        uint8_t* buf, uint64_t len, bool is_write) {
+  uint64_t shard_start = 0;
+  uint64_t cur = obj_off, remaining = len;
+  uint8_t* p = buf;
+  for (const auto& shard : copy.shards) {
+    const uint64_t shard_end = shard_start + shard.length;
+    if (cur < shard_end && remaining > 0) {
+      const uint64_t in_off = cur - shard_start;
+      const uint64_t n = std::min(remaining, shard.length - in_off);
+      if (auto ec = shard_io(client, shard, in_off, p, n, is_write); ec != ErrorCode::OK)
+        return ec;
+      p += n;
+      cur += n;
+      remaining -= n;
+    }
+    shard_start = shard_end;
+    if (remaining == 0) break;
+  }
+  return remaining == 0 ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
 }
 
 ErrorCode shard_io_batch(TransportClient& client, const ShardJob* jobs, size_t n,

@@ -762,3 +762,43 @@ BTEST(EndToEnd, IciMeshPutGetRepairAndDemotionPaths) {
   BT_ASSERT_OK(repaired);
   BT_EXPECT(repaired.value() == data);
 }
+
+BTEST(EndToEnd, SplitReplicaGetReadsBothCopiesAndFallsBack) {
+  // A wide replicated object: the read splits its byte range across both
+  // replicas in parallel (reference TODO blackbird_client.cpp:283); any
+  // slice failure falls back to whole-copy reads, costing a retry, never
+  // the object.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(4, 16 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 2;
+  auto data = pattern(4 << 20, 91);
+  BT_ASSERT(client->put("split/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto back = client->get("split/obj");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // Odd (non-divisible) size exercises the tail-slice math.
+  auto odd = pattern((2 << 20) + 12345, 17);
+  BT_ASSERT(client->put("split/odd", odd.data(), odd.size(), cfg) == ErrorCode::OK);
+  auto odd_back = client->get("split/odd");
+  BT_ASSERT_OK(odd_back);
+  BT_EXPECT(odd_back.value() == odd);
+
+  // Persistently kill ONE replica's endpoint (a dead worker): the split
+  // path fails on its slices, and the fallback must produce the full object
+  // from the surviving copy — not retry the dead one forever.
+  auto placements = client->get_workers("split/obj");
+  BT_ASSERT_OK(placements);
+  transport::FaultSpec spec;
+  spec.fail_endpoint = placements.value()[0].shards[0].remote.endpoint;
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+  auto after = client->get("split/obj");
+  BT_ASSERT_OK(after);
+  BT_EXPECT(after.value() == data);
+}

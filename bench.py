@@ -155,6 +155,19 @@ def main() -> int:
     small_rows = run_bench(binary, size=64 << 10, iterations=300, transport="tcp")
     shm_rows = run_bench(binary, size=1 << 20, iterations=150, transport="shm")
     local_rows = run_bench(binary, size=1 << 20, iterations=150, transport="local")
+    # Replicated read: split across both copies in parallel (vs one link).
+    result = subprocess.run(
+        [str(binary), "--embedded", "4", "--size", str(4 << 20), "--iterations", "60",
+         "--max-workers", "2", "--replicas", "2", "--json", "--transport", "tcp"],
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+    )
+    if result.returncode == 0:
+        rows = {json.loads(l)["op"]: json.loads(l) for l in result.stdout.splitlines() if l.strip()}
+        print(
+            f"tcp replicated 4MiB (x2 copies, split-replica read): "
+            f"get {rows['get']['gbps']:.2f} GB/s | put {rows['put']['gbps']:.2f} GB/s",
+            file=sys.stderr,
+        )
     # One bb-bench --sweep run covers the remaining size points (4KiB/16MiB;
     # its 64KiB/1MiB rows duplicate the dedicated headline runs above).
     result = subprocess.run(

@@ -190,6 +190,18 @@ class Client:
             if size.value <= cap:  # else grew between calls (repair/demotion)
                 return json.loads(buffer.raw[: size.value].decode())
 
+    def drain_worker(self, worker_id: str) -> int:
+        """Gracefully evacuates a LIVE worker (e.g. on a TPU preemption
+        notice): every copy it holds is rebuilt on the remaining workers —
+        streamed from the still-alive source, so replicas=1 objects survive
+        where a crash would lose them — and the worker is retired. Returns
+        the number of copies migrated."""
+        moved = ctypes.c_uint64()
+        check(lib.btpu_drain_worker(self._handle, worker_id.encode(),
+                                    ctypes.byref(moved)),
+              f"drain {worker_id!r}")
+        return moved.value
+
     def exists(self, key: str) -> bool:
         flag = ctypes.c_int32()
         check(lib.btpu_exists(self._handle, key.encode(), ctypes.byref(flag)),

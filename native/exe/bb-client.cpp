@@ -18,6 +18,7 @@ int usage() {
       "  exists <key>\n"
       "  remove <key>\n"
       "  stats\n"
+      "  drain <worker-id>       migrate every copy off a live worker, then retire it\n"
       "  ping\n");
   return 2;
 }
@@ -100,6 +101,12 @@ int main(int argc, char** argv) {
   } else if (command == "remove") {
     if (auto ec = client.remove(key); ec != ErrorCode::OK) return fail(ec);
     std::printf("removed %s\n", key.c_str());
+  } else if (command == "drain") {
+    if (positional.size() < 2) return usage();
+    auto moved = client.drain_worker(positional[1]);
+    if (!moved.ok()) return fail(moved.error());
+    std::printf("drained %s: %llu copies migrated\n", positional[1].c_str(),
+                (unsigned long long)moved.value());
   } else if (command == "stats") {
     auto stats = client.cluster_stats();
     if (!stats.ok()) return fail(stats.error());

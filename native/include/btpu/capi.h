@@ -80,6 +80,10 @@ int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys
 int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
                              uint64_t buffer_size, uint64_t* out_len);
 
+/* Graceful worker evacuation (TPU preemption notice): migrates every copy
+ * off the live worker then retires it; out_moved = copies migrated. */
+int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved);
+
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists);
 int32_t btpu_remove(btpu_client* client, const char* key);
 // out: [workers, pools, objects, capacity, used]

@@ -87,6 +87,8 @@ class ObjectClient {
 
   ErrorCode remove(const ObjectKey& key);
   Result<uint64_t> remove_all();
+  // Graceful worker evacuation (keystone::drain_worker semantics).
+  Result<uint64_t> drain_worker(const NodeId& worker_id);
   Result<ClusterStats> cluster_stats();
   Result<ViewVersionId> ping();
 

@@ -70,6 +70,7 @@ enum class ErrorCode : uint32_t {
   INVALID_WORKER,
   WORKER_NOT_READY,
   NO_COMPLETE_WORKER,
+  WORKER_DRAIN_INCOMPLETE,  // some copies could not migrate; worker kept, retry
   DATA_CORRUPTION,
   CHECKSUM_MISMATCH,
 

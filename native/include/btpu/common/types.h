@@ -236,6 +236,9 @@ struct RemoveObjectResponse { ErrorCode error_code{ErrorCode::OK}; };
 struct RemoveAllObjectsRequest {};
 struct RemoveAllObjectsResponse { uint64_t objects_removed{0}; ErrorCode error_code{ErrorCode::OK}; };
 
+struct DrainWorkerRequest { NodeId worker_id; };
+struct DrainWorkerResponse { uint64_t copies_migrated{0}; ErrorCode error_code{ErrorCode::OK}; };
+
 struct GetClusterStatsRequest {};
 struct GetClusterStatsResponse { ClusterStats stats; ErrorCode error_code{ErrorCode::OK}; };
 

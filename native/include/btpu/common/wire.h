@@ -321,6 +321,8 @@ BTPU_WIRE_STRUCT(RemoveObjectRequest, f0)
 BTPU_WIRE_STRUCT(RemoveObjectResponse, f0)
 BTPU_WIRE_EMPTY(RemoveAllObjectsRequest)
 BTPU_WIRE_STRUCT(RemoveAllObjectsResponse, f0, f1)
+BTPU_WIRE_STRUCT(DrainWorkerRequest, f0)
+BTPU_WIRE_STRUCT(DrainWorkerResponse, f0, f1)
 BTPU_WIRE_EMPTY(GetClusterStatsRequest)
 BTPU_WIRE_STRUCT(GetClusterStatsResponse, f0, f1)
 BTPU_WIRE_EMPTY(GetViewVersionRequest)

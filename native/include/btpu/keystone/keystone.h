@@ -23,6 +23,7 @@
 #include <condition_variable>
 #include <memory>
 #include <shared_mutex>
+#include <unordered_set>
 #include <thread>
 
 #include "btpu/alloc/keystone_adapter.h"
@@ -128,6 +129,17 @@ class KeystoneService {
   ErrorCode register_worker(const WorkerInfo& worker);
   ErrorCode register_memory_pool(const MemoryPool& pool);
   ErrorCode remove_worker(const NodeId& worker_id);
+  // Gracefully evacuates a LIVE worker (TPU-VM preemption notice): new
+  // placements skip it immediately, every copy with shards on it is rebuilt
+  // on the remaining workers — streamed from the still-alive source, so
+  // replication_factor=1 objects survive where a crash would lose them —
+  // and the worker is retired only once NOTHING references it (in-flight
+  // puts are waited out and re-scanned). Returns copies migrated;
+  // WORKER_DRAIN_INCOMPLETE leaves the worker registered and still excluded
+  // from new placements so the drain can be retried after fixing capacity
+  // or transport. Neither the reference nor its etcd layer has an
+  // equivalent.
+  Result<uint64_t> drain_worker(const NodeId& worker_id);
 
   // Snapshot views
   std::vector<WorkerInfo> workers() const;
@@ -190,6 +202,8 @@ class KeystoneService {
   void on_pool_event(const coord::WatchEvent& ev);
   void on_object_event(const coord::WatchEvent& ev);
   void cleanup_dead_worker(const NodeId& worker_id);
+  // Pools eligible for NEW placements: draining workers' pools excluded.
+  alloc::PoolMap allocatable_pools_snapshot() const;
   void cleanup_stale_workers();
 
   // Repair: rebuild placements that referenced a dead worker from surviving
@@ -248,6 +262,7 @@ class KeystoneService {
 
   std::vector<coord::WatchId> watch_ids_;
   KeystoneCounters counters_;
+  std::unordered_set<NodeId> draining_;  // guarded by registry_mutex_
   std::string service_id_;
 };
 

@@ -26,6 +26,7 @@ enum class Method : uint8_t {
   kBatchPutComplete = 13,
   kBatchPutCancel = 14,
   kPing = 15,
+  kDrainWorker = 16,
 };
 
 }  // namespace btpu::rpc

@@ -26,6 +26,7 @@ class KeystoneRpcClient {
   ErrorCode put_cancel(const ObjectKey& key);
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
+  Result<uint64_t> drain_worker(const NodeId& worker_id);
   Result<ClusterStats> get_cluster_stats();
   Result<ViewVersionId> get_view_version();
   Result<ViewVersionId> ping();

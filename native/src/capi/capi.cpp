@@ -265,6 +265,14 @@ int32_t btpu_stats(btpu_client* client, uint64_t out[5]) {
   return 0;
 }
 
+int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved) {
+  if (!client || !worker_id) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto moved = client->impl->drain_worker(worker_id);
+  if (!moved.ok()) return static_cast<int32_t>(moved.error());
+  if (out_moved) *out_moved = moved.value();
+  return 0;
+}
+
 int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
                              uint64_t buffer_size, uint64_t* out_len) {
   if (!client || !key || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);

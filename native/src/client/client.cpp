@@ -178,6 +178,13 @@ Result<uint64_t> ObjectClient::remove_all() {
                       [&](rpc::KeystoneRpcClient& r) { return r.remove_all_objects(); });
 }
 
+Result<uint64_t> ObjectClient::drain_worker(const NodeId& worker_id) {
+  if (embedded_) return embedded_->drain_worker(worker_id);
+  // A long-running mutation: NOT_LEADER rotates, lost replies do not retry.
+  return rpc_failover(/*idempotent=*/false,
+                      [&](rpc::KeystoneRpcClient& r) { return r.drain_worker(worker_id); });
+}
+
 Result<ClusterStats> ObjectClient::cluster_stats() {
   if (embedded_) return embedded_->get_cluster_stats();
   return rpc_failover(/*idempotent=*/true,

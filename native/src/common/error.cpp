@@ -40,6 +40,7 @@ std::string_view to_string(ErrorCode code) noexcept {
     case ErrorCode::INVALID_WORKER: return "INVALID_WORKER";
     case ErrorCode::WORKER_NOT_READY: return "WORKER_NOT_READY";
     case ErrorCode::NO_COMPLETE_WORKER: return "NO_COMPLETE_WORKER";
+    case ErrorCode::WORKER_DRAIN_INCOMPLETE: return "WORKER_DRAIN_INCOMPLETE";
     case ErrorCode::DATA_CORRUPTION: return "DATA_CORRUPTION";
     case ErrorCode::CHECKSUM_MISMATCH: return "CHECKSUM_MISMATCH";
     case ErrorCode::CLIENT_ERROR: return "CLIENT_ERROR";
@@ -95,6 +96,9 @@ std::string_view describe(ErrorCode code) noexcept {
     case ErrorCode::INVALID_WORKER: return "worker id unknown or malformed";
     case ErrorCode::WORKER_NOT_READY: return "worker has not completed startup";
     case ErrorCode::NO_COMPLETE_WORKER: return "no replica has a complete copy";
+    case ErrorCode::WORKER_DRAIN_INCOMPLETE:
+      return "drain left copies on the worker (capacity, churn, or transport failures); "
+             "worker kept registered and excluded from new placements - fix and retry";
     case ErrorCode::DATA_CORRUPTION: return "stored data failed validation";
     case ErrorCode::CHECKSUM_MISMATCH: return "checksum does not match stored digest";
     case ErrorCode::CLIENT_ERROR: return "generic client-side failure";

@@ -699,11 +699,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   std::unique_lock lock(objects_mutex_);
   if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
 
-  alloc::PoolMap pools_snapshot;
-  {
-    std::shared_lock rlock(registry_mutex_);
-    pools_snapshot = pools_;
-  }
+  const alloc::PoolMap pools_snapshot = allocatable_pools_snapshot();
   Result<std::vector<CopyPlacement>> placed = ErrorCode::INTERNAL_ERROR;
   {
     TRACE_SPAN("keystone.allocate");
@@ -872,6 +868,161 @@ ErrorCode KeystoneService::register_memory_pool(const MemoryPool& pool) {
   return ErrorCode::OK;
 }
 
+alloc::PoolMap KeystoneService::allocatable_pools_snapshot() const {
+  std::shared_lock lock(registry_mutex_);
+  if (draining_.empty()) return pools_;
+  alloc::PoolMap out;
+  for (const auto& [id, pool] : pools_) {
+    if (!draining_.contains(pool.node_id)) out.emplace(id, pool);
+  }
+  return out;
+}
+
+Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+  // Drains are rare, operator-triggered, and share staging bookkeeping —
+  // serialize them outright instead of reasoning about interleavings.
+  static std::mutex drain_mutex;
+  std::lock_guard<std::mutex> drain_lock(drain_mutex);
+  {
+    std::unique_lock lock(registry_mutex_);
+    if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
+    draining_.insert(worker_id);
+  }
+  LOG_INFO << "draining worker " << worker_id;
+  const alloc::PoolMap targets = allocatable_pools_snapshot();
+
+  struct Move {
+    ObjectKey key;
+    uint64_t size{0};
+    uint64_t epoch{0};
+    size_t copy_index{0};
+    WorkerConfig config;
+    CopyPlacement src;
+    std::vector<NodeId> other_workers;
+  };
+  auto scan_moves = [&](bool& pending_touches) {
+    std::vector<Move> moves;
+    pending_touches = false;
+    std::shared_lock lock(objects_mutex_);
+    for (const auto& [key, info] : objects_) {
+      for (size_t ci = 0; ci < info.copies.size(); ++ci) {
+        const bool touches = std::any_of(
+            info.copies[ci].shards.begin(), info.copies[ci].shards.end(),
+            [&](const ShardPlacement& sh) { return sh.worker_id == worker_id; });
+        if (!touches) continue;
+        if (info.state != ObjectState::kComplete) {
+          // In-flight put placed before the draining flag: it will complete
+          // (or cancel) shortly; a later round migrates it.
+          pending_touches = true;
+          continue;
+        }
+        Move m{key, info.size, info.epoch, ci, info.config, info.copies[ci], {}};
+        for (size_t cj = 0; cj < info.copies.size(); ++cj) {
+          if (cj == ci) continue;
+          for (const auto& shard : info.copies[cj].shards)
+            m.other_workers.push_back(shard.worker_id);
+        }
+        moves.push_back(std::move(m));
+      }
+    }
+    return moves;
+  };
+
+  // Rounds: migrate what is complete, wait out in-flight puts, re-scan.
+  // The loop ends only when NOTHING references the worker (the real check —
+  // a straggler put that lands late is picked up by a later round) or when a
+  // round makes no progress (capacity/transport trouble: give up, keep the
+  // worker registered and excluded so the drain can be retried).
+  uint64_t total_moved = 0;
+  bool clean = false;
+  for (int round = 0; round < 60; ++round) {
+    bool pending_touches = false;
+    auto moves = scan_moves(pending_touches);
+    if (moves.empty() && !pending_touches) {
+      clean = true;
+      break;
+    }
+    if (moves.empty()) {  // only pendings: give them time to land
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      continue;
+    }
+
+    uint64_t moved = 0;
+    std::unordered_map<ObjectKey, uint64_t> epoch_now;  // tracks our own swaps
+    for (auto& m : moves) {
+      const ObjectKey staging_key = m.key + "\x01" "drain:" + worker_id;
+      alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+          staging_key, m.size, m.config);
+      req.replication_factor = 1;
+      // Anti-affinity vs the surviving copies; relaxed if the cluster is small.
+      req.excluded_nodes = m.other_workers;
+      auto attempt = adapter_.allocator().allocate(req, targets);
+      if (!attempt.ok()) {
+        req.excluded_nodes.clear();
+        attempt = adapter_.allocator().allocate(req, targets);
+      }
+      if (!attempt.ok()) continue;
+      std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
+
+      // Stream from the SOURCE copy — alive, unlike the repair path.
+      if (copy_object_bytes(*data_client_, m.src, staged, m.size) != ErrorCode::OK) {
+        adapter_.free_object(staging_key);
+        continue;
+      }
+
+      std::unique_lock lock(objects_mutex_);
+      auto it = objects_.find(m.key);
+      const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
+      if (it == objects_.end() || it->second.epoch != expect ||
+          m.copy_index >= it->second.copies.size()) {
+        lock.unlock();
+        adapter_.free_object(staging_key);
+        continue;  // object changed underneath the move; the re-scan retries
+      }
+      if (adapter_.allocator().merge_objects(staging_key, m.key) != ErrorCode::OK) {
+        lock.unlock();
+        adapter_.free_object(staging_key);
+        continue;
+      }
+      // Release the evacuated copy's ranges and swap the new copy in.
+      for (const auto& shard : it->second.copies[m.copy_index].shards) {
+        if (auto pr = shard_to_range(shard, memory_pools())) {
+          adapter_.allocator().release_range(m.key, pr->first, pr->second);
+        }
+      }
+      staged[0].copy_index = m.copy_index;
+      it->second.copies[m.copy_index] = std::move(staged[0]);
+      it->second.epoch = next_epoch_.fetch_add(1);
+      epoch_now[m.key] = it->second.epoch;
+      persist_object(m.key, it->second);
+      bump_view();
+      ++moved;
+    }
+    total_moved += moved;
+    if (moved == 0 && !pending_touches) break;  // no progress: stop retrying
+  }
+
+  if (!clean) {
+    // Keep the worker registered AND still marked draining (no new data
+    // lands on it); the operator retries after fixing capacity/transport.
+    // If the worker dies first, cleanup_dead_worker clears the flag.
+    LOG_WARN << "drain of " << worker_id << " incomplete after " << total_moved
+             << " migrated copies";
+    return ErrorCode::WORKER_DRAIN_INCOMPLETE;
+  }
+
+  // Nothing references the worker anymore: retire it for real. The draining
+  // flag drops only AFTER retirement, so no allocation window reopens.
+  cleanup_dead_worker(worker_id);
+  {
+    std::unique_lock lock(registry_mutex_);
+    draining_.erase(worker_id);
+  }
+  LOG_INFO << "drained worker " << worker_id << ": " << total_moved << " copies migrated";
+  return total_moved;
+}
+
 ErrorCode KeystoneService::remove_worker(const NodeId& worker_id) {
   {
     std::shared_lock lock(registry_mutex_);
@@ -968,6 +1119,10 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
   std::vector<MemoryPoolId> dead_pools;
   {
     std::unique_lock lock(registry_mutex_);
+    // A worker that dies mid-drain (or after a failed drain) must not leave
+    // its id in draining_ forever — a replacement re-registering under the
+    // same id would be silently unallocatable.
+    draining_.erase(worker_id);
     if (!workers_.erase(worker_id)) return;  // already handled
     for (auto it = pools_.begin(); it != pools_.end();) {
       if (it->second.node_id == worker_id) {

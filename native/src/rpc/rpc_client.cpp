@@ -130,6 +130,14 @@ Result<uint64_t> KeystoneRpcClient::remove_all_objects() {
   return resp.objects_removed;
 }
 
+Result<uint64_t> KeystoneRpcClient::drain_worker(const NodeId& worker_id) {
+  DrainWorkerResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kDrainWorker),
+                            DrainWorkerRequest{worker_id}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return resp.copies_migrated;
+}
+
 Result<ClusterStats> KeystoneRpcClient::get_cluster_stats() {
   GetClusterStatsResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kGetClusterStats),

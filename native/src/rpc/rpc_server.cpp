@@ -155,6 +155,13 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
       return handle<BatchPutCancelRequest, BatchPutCancelResponse>(
           payload,
           [&](const auto& req, auto& resp) { resp.results = ks.batch_put_cancel(req.keys); });
+    case Method::kDrainWorker:
+      return handle<DrainWorkerRequest, DrainWorkerResponse>(
+          payload, [&](const auto& req, auto& resp) {
+            auto r = ks.drain_worker(req.worker_id);
+            if (r.ok()) resp.copies_migrated = r.value();
+            resp.error_code = r.ok() ? ErrorCode::OK : r.error();
+          });
     case Method::kPing: {
       PingResponse resp{service_.get_view_version()};
       return wire::to_bytes(resp);

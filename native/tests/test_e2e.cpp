@@ -802,3 +802,85 @@ BTEST(EndToEnd, SplitReplicaGetReadsBothCopiesAndFallsBack) {
   BT_ASSERT_OK(after);
   BT_EXPECT(after.value() == data);
 }
+
+BTEST(EndToEnd, DrainWorkerMigratesEverythingIncludingRf1) {
+  // Graceful evacuation (TPU preemption notice): unlike crash repair, drain
+  // streams from the still-alive worker, so replication_factor=1 objects
+  // survive. After the drain the worker is retired and no placement
+  // references it; new puts avoid a draining worker from the first moment.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(3, 16 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig rf1;
+  rf1.replication_factor = 1;
+  rf1.max_workers_per_copy = 3;  // striped across all workers incl. victim
+  auto a = pattern(1 << 20, 11);
+  BT_ASSERT(client->put("drain/rf1", a.data(), a.size(), rf1) == ErrorCode::OK);
+
+  WorkerConfig rf2;
+  rf2.replication_factor = 2;
+  rf2.max_workers_per_copy = 1;
+  auto b = pattern(512 * 1024, 22);
+  BT_ASSERT(client->put("drain/rf2", b.data(), b.size(), rf2) == ErrorCode::OK);
+
+  auto moved = client->drain_worker("worker-0");
+  BT_ASSERT_OK(moved);
+  BT_EXPECT(moved.value() >= 1);  // at least the striped rf1 copy moved
+
+  // Worker is gone from the registry and from every placement.
+  auto stats = client->cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().total_workers, 2u);
+  for (const char* key : {"drain/rf1", "drain/rf2"}) {
+    auto placements = client->get_workers(key);
+    BT_ASSERT_OK(placements);
+    for (const auto& copy : placements.value())
+      for (const auto& shard : copy.shards) BT_EXPECT_NE(shard.worker_id, "worker-0");
+  }
+
+  // Bytes intact — including the rf=1 object a crash would have lost.
+  auto back_a = client->get("drain/rf1");
+  BT_ASSERT_OK(back_a);
+  BT_EXPECT(back_a.value() == a);
+  auto back_b = client->get("drain/rf2");
+  BT_ASSERT_OK(back_b);
+  BT_EXPECT(back_b.value() == b);
+
+  // New puts land on the survivors.
+  auto c = pattern(64 * 1024, 33);
+  BT_ASSERT(client->put("drain/after", c.data(), c.size(), rf1) == ErrorCode::OK);
+  auto after = client->get_workers("drain/after");
+  BT_ASSERT_OK(after);
+  for (const auto& copy : after.value())
+    for (const auto& shard : copy.shards) BT_EXPECT_NE(shard.worker_id, "worker-0");
+}
+
+BTEST(EndToEnd, DrainOnIciMeshMovesDeviceBytesChipToChip) {
+  // Device-tier drain: the copies move through the provider's
+  // device-to-device entry (ICI), never staging through host memory.
+  auto options = EmbeddedClusterOptions::simple(3, 8 << 20, StorageClass::HBM_TPU);
+  options.transport = TransportKind::ICI;
+  for (auto& w : options.workers) w.transport = TransportKind::ICI;
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(2 << 20, 44);
+  BT_ASSERT(client->put("drain/ici", data.data(), data.size(), cfg) == ErrorCode::OK);
+  const NodeId victim = [&] {
+    auto p = client->get_workers("drain/ici");
+    return p.ok() ? p.value()[0].shards[0].worker_id : NodeId{};
+  }();
+  BT_ASSERT(!victim.empty());
+
+  auto moved = client->drain_worker(victim);
+  BT_ASSERT_OK(moved);
+  BT_EXPECT_EQ(moved.value(), 1u);
+  auto back = client->get("drain/ici");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}

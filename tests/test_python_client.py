@@ -156,3 +156,22 @@ def test_object_ttl_and_soft_pin():
         assert not client.exists("ttl/short")  # TTL'd object collected
         assert client.get("ttl/forever") == b"permanent"  # ttl_ms=0: never
         assert client.get("ttl/pinned") == b"pinned"
+
+
+def test_drain_worker_preserves_rf1_objects():
+    """Graceful evacuation vs crash: a replicas=1 object on the drained
+    worker survives (streamed off the live source) where kill_worker would
+    have lost it."""
+    from blackbird_tpu import EmbeddedCluster
+
+    with EmbeddedCluster(workers=3, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        payload = b"precious" * 100_000
+        client.put("drain/obj", payload, replicas=1, max_workers=3)
+        moved = client.drain_worker("worker-1")
+        assert moved >= 1
+        assert client.stats()["workers"] == 2
+        assert client.get("drain/obj") == payload
+        for copy in client.placements("drain/obj"):
+            for shard in copy["shards"]:
+                assert shard["worker"] != "worker-1"

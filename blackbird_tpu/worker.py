@@ -23,11 +23,26 @@ broken, worker_service.cpp:196).
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
 
 from blackbird_tpu.native import lib
+
+
+def _pin_jax_platform() -> None:
+    """Honor JAX_PLATFORMS before the backend initializes: some images
+    register a hardware PJRT plugin from sitecustomize that overrides the
+    env var, and initializing a sick tunneled device can hang outright."""
+    if not os.environ.get("JAX_PLATFORMS"):
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:  # noqa: BLE001
+        pass
 
 
 class WorkerHost:
@@ -37,6 +52,7 @@ class WorkerHost:
                  jax_provider: bool = True):
         self._provider = None
         if jax_provider:
+            _pin_jax_platform()
             from blackbird_tpu.hbm import JaxHbmProvider
 
             self._provider = JaxHbmProvider().register()
@@ -66,6 +82,21 @@ class WorkerHost:
         self.close()
 
 
+def _config_worker_id(config_path: str) -> str | None:
+    """worker_id from the YAML, matching the native parser's handling of
+    trailing comments and quotes (config.cpp strip_comment/unquote) — a
+    mismatch here would drain a nonexistent id."""
+    for line in open(config_path, encoding="utf-8"):
+        line = line.strip()
+        if not line.startswith("worker_id:"):
+            continue
+        value = line.split(":", 1)[1].split("#", 1)[0].strip()
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
+            value = value[1:-1]
+        return value or None
+    return None
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--config", required=True, help="worker.yaml path")
@@ -73,6 +104,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="coordinator endpoint list override (host:port,...)")
     parser.add_argument("--no-jax", action="store_true",
                         help="skip the JAX HBM provider (host tiers only)")
+    parser.add_argument("--drain-on-term", metavar="KEYSTONE",
+                        help="on SIGTERM (the TPU preemption notice), ask the "
+                             "keystone at this endpoint list to drain this "
+                             "worker — every copy migrates off the live "
+                             "process — before shutting down")
     args = parser.parse_args(argv)
 
     host = WorkerHost(args.config, coord=args.coord, jax_provider=not args.no_jax)
@@ -82,6 +118,16 @@ def main(argv: list[str] | None = None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    if args.drain_on_term:
+        worker_id = _config_worker_id(args.config)
+        if worker_id:
+            try:
+                from blackbird_tpu.client import Client
+
+                moved = Client(args.drain_on_term).drain_worker(worker_id)
+                print(f"drained {worker_id}: {moved} copies migrated", flush=True)
+            except Exception as exc:  # noqa: BLE001 - shut down regardless
+                print(f"drain failed ({exc}); shutting down anyway", flush=True)
     host.close()
     return 0
 

@@ -204,6 +204,8 @@ class KeystoneService {
   void cleanup_dead_worker(const NodeId& worker_id);
   // Pools eligible for NEW placements: draining workers' pools excluded.
   alloc::PoolMap allocatable_pools_snapshot() const;
+  // One live shard's bytes into a staged placement (device fast path incl.).
+  ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst);
   void cleanup_stale_workers();
 
   // Repair: rebuild placements that referenced a dead worker from surviving
@@ -263,6 +265,7 @@ class KeystoneService {
   std::vector<coord::WatchId> watch_ids_;
   KeystoneCounters counters_;
   std::unordered_set<NodeId> draining_;  // guarded by registry_mutex_
+  std::mutex drain_mutex_;               // serializes drain_worker per service
   std::string service_id_;
 };
 

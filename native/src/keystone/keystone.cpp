@@ -881,24 +881,25 @@ alloc::PoolMap KeystoneService::allocatable_pools_snapshot() const {
 Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   // Drains are rare, operator-triggered, and share staging bookkeeping —
-  // serialize them outright instead of reasoning about interleavings.
-  static std::mutex drain_mutex;
-  std::lock_guard<std::mutex> drain_lock(drain_mutex);
+  // serialize them per service instead of reasoning about interleavings.
+  std::lock_guard<std::mutex> drain_lock(drain_mutex_);
   {
     std::unique_lock lock(registry_mutex_);
     if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
     draining_.insert(worker_id);
   }
   LOG_INFO << "draining worker " << worker_id;
-  const alloc::PoolMap targets = allocatable_pools_snapshot();
 
+  // One migration unit per SHARD on the draining worker (not per copy):
+  // bytes already correct on surviving workers are never re-streamed, which
+  // matters inside a preemption grace window.
   struct Move {
     ObjectKey key;
-    uint64_t size{0};
     uint64_t epoch{0};
     size_t copy_index{0};
+    size_t shard_index{0};
+    ShardPlacement shard;        // the victim shard (still readable)
     WorkerConfig config;
-    CopyPlacement src;
     std::vector<NodeId> other_workers;
   };
   auto scan_moves = [&](bool& pending_touches) {
@@ -907,36 +908,42 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     std::shared_lock lock(objects_mutex_);
     for (const auto& [key, info] : objects_) {
       for (size_t ci = 0; ci < info.copies.size(); ++ci) {
-        const bool touches = std::any_of(
-            info.copies[ci].shards.begin(), info.copies[ci].shards.end(),
-            [&](const ShardPlacement& sh) { return sh.worker_id == worker_id; });
-        if (!touches) continue;
-        if (info.state != ObjectState::kComplete) {
-          // In-flight put placed before the draining flag: it will complete
-          // (or cancel) shortly; a later round migrates it.
-          pending_touches = true;
-          continue;
+        for (size_t si = 0; si < info.copies[ci].shards.size(); ++si) {
+          const ShardPlacement& sh = info.copies[ci].shards[si];
+          if (sh.worker_id != worker_id) continue;
+          if (info.state != ObjectState::kComplete) {
+            // In-flight put placed before the draining flag: it completes
+            // (or cancels) shortly; a later round migrates it.
+            pending_touches = true;
+            continue;
+          }
+          Move m{key, info.epoch, ci, si, sh, info.config, {}};
+          for (size_t cj = 0; cj < info.copies.size(); ++cj) {
+            if (cj == ci) continue;
+            for (const auto& other : info.copies[cj].shards)
+              m.other_workers.push_back(other.worker_id);
+          }
+          moves.push_back(std::move(m));
         }
-        Move m{key, info.size, info.epoch, ci, info.config, info.copies[ci], {}};
-        for (size_t cj = 0; cj < info.copies.size(); ++cj) {
-          if (cj == ci) continue;
-          for (const auto& shard : info.copies[cj].shards)
-            m.other_workers.push_back(shard.worker_id);
-        }
-        moves.push_back(std::move(m));
       }
     }
     return moves;
   };
 
   // Rounds: migrate what is complete, wait out in-flight puts, re-scan.
-  // The loop ends only when NOTHING references the worker (the real check —
-  // a straggler put that lands late is picked up by a later round) or when a
-  // round makes no progress (capacity/transport trouble: give up, keep the
-  // worker registered and excluded so the drain can be retried).
+  // The loop ends only when NOTHING references the worker (a straggler put
+  // that lands late is picked up by a later round) or when a round makes no
+  // progress (capacity/transport trouble: give up, keep the worker
+  // registered and excluded so the drain can be retried).
   uint64_t total_moved = 0;
   bool clean = false;
   for (int round = 0; round < 60; ++round) {
+    // Leadership can move during a minutes-long drain; a deposed keystone
+    // must stop mutating placements immediately.
+    if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+    // Re-snapshot targets each round: workers registering mid-drain add
+    // capacity, workers dying mid-drain stop being selected.
+    const alloc::PoolMap targets = allocatable_pools_snapshot();
     bool pending_touches = false;
     auto moves = scan_moves(pending_touches);
     if (moves.empty() && !pending_touches) {
@@ -952,10 +959,14 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     std::unordered_map<ObjectKey, uint64_t> epoch_now;  // tracks our own swaps
     for (auto& m : moves) {
       const ObjectKey staging_key = m.key + "\x01" "drain:" + worker_id;
+      WorkerConfig shard_cfg = m.config;
+      shard_cfg.replication_factor = 1;
+      shard_cfg.max_workers_per_copy = 1;  // one shard in, one shard out
       alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
-          staging_key, m.size, m.config);
-      req.replication_factor = 1;
-      // Anti-affinity vs the surviving copies; relaxed if the cluster is small.
+          staging_key, m.shard.length, shard_cfg);
+      // Keep the shard in its tier (a drain is not a demotion); placement
+      // may still spill classes if the tier has no room elsewhere.
+      req.preferred_classes = {m.shard.storage_class};
       req.excluded_nodes = m.other_workers;
       auto attempt = adapter_.allocator().allocate(req, targets);
       if (!attempt.ok()) {
@@ -965,8 +976,8 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       if (!attempt.ok()) continue;
       std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
 
-      // Stream from the SOURCE copy — alive, unlike the repair path.
-      if (copy_object_bytes(*data_client_, m.src, staged, m.size) != ErrorCode::OK) {
+      // Stream straight from the victim shard — alive, unlike crash repair.
+      if (stream_shard(m.shard, staged[0]) != ErrorCode::OK) {
         adapter_.free_object(staging_key);
         continue;
       }
@@ -975,7 +986,8 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       auto it = objects_.find(m.key);
       const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
       if (it == objects_.end() || it->second.epoch != expect ||
-          m.copy_index >= it->second.copies.size()) {
+          m.copy_index >= it->second.copies.size() ||
+          m.shard_index >= it->second.copies[m.copy_index].shards.size()) {
         lock.unlock();
         adapter_.free_object(staging_key);
         continue;  // object changed underneath the move; the re-scan retries
@@ -985,14 +997,15 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
         adapter_.free_object(staging_key);
         continue;
       }
-      // Release the evacuated copy's ranges and swap the new copy in.
-      for (const auto& shard : it->second.copies[m.copy_index].shards) {
-        if (auto pr = shard_to_range(shard, memory_pools())) {
-          adapter_.allocator().release_range(m.key, pr->first, pr->second);
-        }
+      // Release the evacuated shard's range and splice the replacement in
+      // (the staged allocation may itself be several ranges).
+      auto& shards = it->second.copies[m.copy_index].shards;
+      if (auto pr = shard_to_range(shards[m.shard_index], memory_pools())) {
+        adapter_.allocator().release_range(m.key, pr->first, pr->second);
       }
-      staged[0].copy_index = m.copy_index;
-      it->second.copies[m.copy_index] = std::move(staged[0]);
+      shards.erase(shards.begin() + static_cast<ptrdiff_t>(m.shard_index));
+      shards.insert(shards.begin() + static_cast<ptrdiff_t>(m.shard_index),
+                    staged[0].shards.begin(), staged[0].shards.end());
       it->second.epoch = next_epoch_.fetch_add(1);
       epoch_now[m.key] = it->second.epoch;
       persist_object(m.key, it->second);
@@ -1008,7 +1021,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     // lands on it); the operator retries after fixing capacity/transport.
     // If the worker dies first, cleanup_dead_worker clears the flag.
     LOG_WARN << "drain of " << worker_id << " incomplete after " << total_moved
-             << " migrated copies";
+             << " migrated shards";
     return ErrorCode::WORKER_DRAIN_INCOMPLETE;
   }
 
@@ -1019,8 +1032,35 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     std::unique_lock lock(registry_mutex_);
     draining_.erase(worker_id);
   }
-  LOG_INFO << "drained worker " << worker_id << ": " << total_moved << " copies migrated";
+  LOG_INFO << "drained worker " << worker_id << ": " << total_moved << " shards migrated";
   return total_moved;
+}
+
+// Streams one live shard's bytes into a freshly staged placement, device
+// fast path included (chip-to-chip, no host staging, when both ends are
+// device-resident).
+ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPlacement& dst) {
+  const auto* src_dev = std::get_if<DeviceLocation>(&src.location);
+  if (src_dev && dst.shards.size() == 1) {
+    if (const auto* dst_dev = std::get_if<DeviceLocation>(&dst.shards[0].location)) {
+      return storage::hbm_copy(src_dev->region_id, src_dev->offset, dst_dev->region_id,
+                               dst_dev->offset, src.length);
+    }
+  }
+  constexpr uint64_t kChunk = 16ull << 20;
+  std::vector<uint8_t> buf(static_cast<size_t>(std::min<uint64_t>(src.length, kChunk)));
+  for (uint64_t off = 0; off < src.length; off += kChunk) {
+    const uint64_t n = std::min(kChunk, src.length - off);
+    if (auto ec = transport::shard_io(*data_client_, src, off, buf.data(), n,
+                                      /*is_write=*/false);
+        ec != ErrorCode::OK)
+      return ec;
+    if (auto ec = transport::copy_range_io(*data_client_, dst, off, buf.data(), n,
+                                           /*is_write=*/true);
+        ec != ErrorCode::OK)
+      return ec;
+  }
+  return ErrorCode::OK;
 }
 
 ErrorCode KeystoneService::remove_worker(const NodeId& worker_id) {
@@ -1161,11 +1201,14 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
 // dangle after worker death (SURVEY §3.5) — but TPU-VM preemption makes
 // repair mandatory (SURVEY §7 hard parts).
 size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) {
+  // Full registry view for range release (draining workers' ranges must
+  // still map back correctly); ALLOCATION targets exclude draining workers.
   alloc::PoolMap live_pools;
   {
     std::shared_lock lock(registry_mutex_);
     live_pools = pools_;
   }
+  const alloc::PoolMap target_pools = allocatable_pools_snapshot();
 
   // Pass 1 — metadata only, under the lock: prune dead placements so clients
   // stop dialing the dead worker immediately, drop objects that lost every
@@ -1261,10 +1304,10 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           req.excluded_nodes.push_back(shard.worker_id);
       }
     }
-    auto attempt = adapter_.allocator().allocate(req, live_pools);
+    auto attempt = adapter_.allocator().allocate(req, target_pools);
     if (!attempt.ok()) {
       req.excluded_nodes.clear();
-      attempt = adapter_.allocator().allocate(req, live_pools);
+      attempt = adapter_.allocator().allocate(req, target_pools);
     }
     if (!attempt.ok()) {
       // No room to re-replicate: the object stays degraded on its survivors
@@ -1416,11 +1459,8 @@ void KeystoneService::evict_for_pressure() {
 
 KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& key,
                                                               StorageClass from) {
-  alloc::PoolMap live_pools;
-  {
-    std::shared_lock lock(registry_mutex_);
-    live_pools = pools_;
-  }
+  // Demotion never places new bytes onto a draining worker.
+  const alloc::PoolMap live_pools = allocatable_pools_snapshot();
 
   // Lower tiers that actually have pools, nearest first. The ladder stops at
   // HDD: CUSTOM/unspecified pools are application-owned, never a backstop.

@@ -654,3 +654,64 @@ service_refresh_interval_sec: 1
                  what="both workers back on the promoted control plane")
     finally:
         teardown(procs)
+
+
+def test_multiprocess_python_worker_drains_itself_on_sigterm(tmp_path):
+    """The complete preemption story: the Python worker host receives
+    SIGTERM (the TPU preemption notice), asks the keystone to drain it —
+    its replicas=1 shards migrate to the surviving worker while the process
+    is still alive — and only then exits. The object survives with zero
+    replication."""
+    from blackbird_tpu import Client
+
+    coord_port = free_port()
+    keystone_port = free_port()
+    keystone_cfg = tmp_path / "keystone.yaml"
+    keystone_cfg.write_text(
+        f"""cluster_id: mp_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{keystone_port}
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 2
+""")
+    procs = []
+    spawn = make_spawner(procs)
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
+        wait_for(lambda: port_open(keystone_port), what="bb-keystone")
+
+        survivor_cfg = write_worker_config(tmp_path, "stay-0",
+                                           f"127.0.0.1:{coord_port}")
+        spawn([str(BUILD / "bb-worker"), "--config", str(survivor_cfg)], "survivor")
+        victim_cfg = write_worker_config(tmp_path, "leave-0",
+                                         f"127.0.0.1:{coord_port}")
+        victim = spawn(
+            [sys.executable, "-m", "blackbird_tpu.worker", "--config", str(victim_cfg),
+             "--no-jax", "--drain-on-term", f"127.0.0.1:{keystone_port}"],
+            "py-victim")
+
+        client = Client(f"127.0.0.1:{keystone_port}")
+        wait_for(lambda: client.stats()["workers"] == 2, timeout=120,
+                 what="both workers")
+
+        payload = b"survives-preemption" * 50_000
+        client.put("preempt/obj", payload, replicas=1, max_workers=2)
+        assert client.get("preempt/obj") == payload
+
+        victim.send_signal(signal.SIGTERM)  # the preemption notice
+        wait_for(lambda: victim.poll() is not None, timeout=120,
+                 what="victim drained and exited")
+        assert "drained leave-0" in (victim.stdout.read() or "")
+
+        wait_for(lambda: client.stats()["workers"] == 1, timeout=15,
+                 what="victim retired")
+        assert client.get("preempt/obj") == payload  # rf=1, zero loss
+        for copy in client.placements("preempt/obj"):
+            for shard in copy["shards"]:
+                assert shard["worker"] == "stay-0"
+    finally:
+        teardown(procs)

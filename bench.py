@@ -226,6 +226,9 @@ def main() -> int:
             capture_output=True, text=True, timeout=900, cwd=REPO_ROOT,
         )
         sys.stderr.write(child.stderr)
+        if child.returncode != 0:
+            print(f"hbm tier bench skipped: child exited {child.returncode}",
+                  file=sys.stderr)
     except subprocess.TimeoutExpired:
         print("hbm tier bench skipped: device backend hung (tunnel down?)",
               file=sys.stderr)

@@ -192,10 +192,10 @@ class Client:
 
     def drain_worker(self, worker_id: str) -> int:
         """Gracefully evacuates a LIVE worker (e.g. on a TPU preemption
-        notice): every copy it holds is rebuilt on the remaining workers —
+        notice): every shard it holds is rebuilt on the remaining workers —
         streamed from the still-alive source, so replicas=1 objects survive
         where a crash would lose them — and the worker is retired. Returns
-        the number of copies migrated."""
+        the number of shards migrated."""
         moved = ctypes.c_uint64()
         check(lib.btpu_drain_worker(self._handle, worker_id.encode(),
                                     ctypes.byref(moved)),

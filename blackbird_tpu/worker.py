@@ -85,12 +85,26 @@ class WorkerHost:
 def _config_worker_id(config_path: str) -> str | None:
     """worker_id from the YAML, matching the native parser's handling of
     trailing comments and quotes (config.cpp strip_comment/unquote) — a
-    mismatch here would drain a nonexistent id."""
+    mismatch here would drain a nonexistent id. Like the native parser, a
+    '#' starts a comment only when preceded by whitespace and outside
+    quotes, so ids like tpu#3 survive."""
     for line in open(config_path, encoding="utf-8"):
         line = line.strip()
         if not line.startswith("worker_id:"):
             continue
-        value = line.split(":", 1)[1].split("#", 1)[0].strip()
+        value = line.split(":", 1)[1]
+        in_quote = ""
+        cut = len(value)
+        for i, ch in enumerate(value):
+            if in_quote:
+                if ch == in_quote:
+                    in_quote = ""
+            elif ch in "'\"":
+                in_quote = ch
+            elif ch == "#" and (i == 0 or value[i - 1].isspace()):
+                cut = i
+                break
+        value = value[:cut].strip()
         if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
             value = value[1:-1]
         return value or None
@@ -115,17 +129,25 @@ def main(argv: list[str] | None = None) -> int:
     print(f"worker up with {host.pool_count} pools", flush=True)
 
     stop = threading.Event()
+    got_signal = {"sig": None}
+
+    def on_signal(signum, _frame):
+        got_signal["sig"] = signum
+        stop.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+        signal.signal(sig, on_signal)
     stop.wait()
-    if args.drain_on_term:
+    # Drain only on SIGTERM (the preemption notice); Ctrl-C stays a prompt
+    # dev shutdown.
+    if args.drain_on_term and got_signal["sig"] == signal.SIGTERM:
         worker_id = _config_worker_id(args.config)
         if worker_id:
             try:
                 from blackbird_tpu.client import Client
 
                 moved = Client(args.drain_on_term).drain_worker(worker_id)
-                print(f"drained {worker_id}: {moved} copies migrated", flush=True)
+                print(f"drained {worker_id}: {moved} shards migrated", flush=True)
             except Exception as exc:  # noqa: BLE001 - shut down regardless
                 print(f"drain failed ({exc}); shutting down anyway", flush=True)
     host.close()

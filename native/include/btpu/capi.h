@@ -81,7 +81,7 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
                              uint64_t buffer_size, uint64_t* out_len);
 
 /* Graceful worker evacuation (TPU preemption notice): migrates every copy
- * off the live worker then retires it; out_moved = copies migrated. */
+ * off the live worker then retires it; out_moved = shards migrated. */
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved);
 
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists);

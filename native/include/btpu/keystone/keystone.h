@@ -134,7 +134,8 @@ class KeystoneService {
   // on the remaining workers — streamed from the still-alive source, so
   // replication_factor=1 objects survive where a crash would lose them —
   // and the worker is retired only once NOTHING references it (in-flight
-  // puts are waited out and re-scanned). Returns copies migrated;
+  // puts are waited out and re-scanned). Returns SHARDS migrated (bytes on
+  // surviving workers are never re-streamed);
   // WORKER_DRAIN_INCOMPLETE leaves the worker registered and still excluded
   // from new placements so the drain can be retried after fixing capacity
   // or transport. Neither the reference nor its etcd layer has an

@@ -939,8 +939,14 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   bool clean = false;
   for (int round = 0; round < 60; ++round) {
     // Leadership can move during a minutes-long drain; a deposed keystone
-    // must stop mutating placements immediately.
-    if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+    // must stop mutating placements immediately — and must not keep the
+    // worker invisibly excluded on THIS instance (the new leader owns the
+    // drain now; the operator retries against it).
+    if (!is_leader_.load()) {
+      std::unique_lock lock(registry_mutex_);
+      draining_.erase(worker_id);
+      return ErrorCode::NOT_LEADER;
+    }
     // Re-snapshot targets each round: workers registering mid-drain add
     // capacity, workers dying mid-drain stop being selected.
     const alloc::PoolMap targets = allocatable_pools_snapshot();

@@ -3,6 +3,7 @@
 // failure/failover flows. This is the hermetic put->write->complete->
 // get->verify slice SURVEY §7 defines as the minimum e2e artifact.
 #include <cstring>
+#include <random>
 #include <filesystem>
 #include <fstream>
 #include <thread>
@@ -883,4 +884,51 @@ BTEST(EndToEnd, DrainOnIciMeshMovesDeviceBytesChipToChip) {
   auto back = client->get("drain/ici");
   BT_ASSERT_OK(back);
   BT_EXPECT(back.value() == data);
+}
+
+BTEST(EndToEnd, ChurnLeavesNoLeakedRangesOrFragmentation) {
+  // Heavy put/remove churn with mixed sizes and policies must return the
+  // allocator to a clean state: used bytes back to zero, and the largest
+  // possible object still placeable afterwards (no fragmentation creep,
+  // no orphaned ranges — the availability bug class repair/demotion/drain
+  // bookkeeping could introduce).
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(4, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  std::mt19937 rng(7);
+  std::vector<std::string> live;
+  for (int iter = 0; iter < 400; ++iter) {
+    if (live.empty() || rng() % 3 != 0) {
+      const uint64_t size = 1024 + rng() % (512 * 1024);
+      WorkerConfig cfg;
+      cfg.replication_factor = 1 + rng() % 2;
+      cfg.max_workers_per_copy = 1 + rng() % 4;
+      auto data = pattern(size, static_cast<uint8_t>(iter));
+      const std::string key = "churn/" + std::to_string(iter);
+      auto ec = client->put(key, data.data(), size, cfg);
+      if (ec == ErrorCode::OK) live.push_back(key);
+      else BT_ASSERT(ec == ErrorCode::INSUFFICIENT_SPACE);  // pool full is fine
+    } else {
+      const size_t pick = rng() % live.size();
+      BT_ASSERT(client->remove(live[pick]) == ErrorCode::OK);
+      live.erase(live.begin() + static_cast<ptrdiff_t>(pick));
+    }
+  }
+  for (const auto& key : live) BT_ASSERT(client->remove(key) == ErrorCode::OK);
+
+  auto stats = client->cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().used_capacity, 0u);
+
+  // The whole cluster must still be one allocatable space: a max-striped
+  // object spanning ~all remaining capacity places cleanly.
+  WorkerConfig wide;
+  wide.replication_factor = 1;
+  wide.max_workers_per_copy = 4;
+  auto big = pattern(24 << 20, 99);  // 24 MiB of the 32 MiB total
+  BT_ASSERT(client->put("churn/final", big.data(), big.size(), wide) == ErrorCode::OK);
+  auto back = client->get("churn/final");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == big);
 }

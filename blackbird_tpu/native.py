@@ -114,6 +114,7 @@ def _load() -> ctypes.CDLL:
         "btpu_drain_worker": (i32, [c, ctypes.c_char_p, ctypes.POINTER(u64)]),
         "btpu_worker_create": (c, [ctypes.c_char_p, ctypes.c_char_p]),
         "btpu_worker_pool_count": (u32, [c]),
+        "btpu_worker_id": (ctypes.c_char_p, [c]),
         "btpu_worker_destroy": (None, [c]),
     }
     for name, (restype, argtypes) in sig.items():

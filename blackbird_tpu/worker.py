@@ -67,6 +67,10 @@ class WorkerHost:
     def pool_count(self) -> int:
         return lib.btpu_worker_pool_count(self._handle)
 
+    @property
+    def worker_id(self) -> str:
+        return lib.btpu_worker_id(self._handle).decode()
+
     def close(self) -> None:
         if self._handle:
             lib.btpu_worker_destroy(self._handle)
@@ -80,35 +84,6 @@ class WorkerHost:
 
     def __exit__(self, *exc):
         self.close()
-
-
-def _config_worker_id(config_path: str) -> str | None:
-    """worker_id from the YAML, matching the native parser's handling of
-    trailing comments and quotes (config.cpp strip_comment/unquote) — a
-    mismatch here would drain a nonexistent id. Like the native parser, a
-    '#' starts a comment only when preceded by whitespace and outside
-    quotes, so ids like tpu#3 survive."""
-    for line in open(config_path, encoding="utf-8"):
-        line = line.strip()
-        if not line.startswith("worker_id:"):
-            continue
-        value = line.split(":", 1)[1]
-        in_quote = ""
-        cut = len(value)
-        for i, ch in enumerate(value):
-            if in_quote:
-                if ch == in_quote:
-                    in_quote = ""
-            elif ch in "'\"":
-                in_quote = ch
-            elif ch == "#" and (i == 0 or value[i - 1].isspace()):
-                cut = i
-                break
-        value = value[:cut].strip()
-        if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
-            value = value[1:-1]
-        return value or None
-    return None
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -141,15 +116,16 @@ def main(argv: list[str] | None = None) -> int:
     # Drain only on SIGTERM (the preemption notice); Ctrl-C stays a prompt
     # dev shutdown.
     if args.drain_on_term and got_signal["sig"] == signal.SIGTERM:
-        worker_id = _config_worker_id(args.config)
-        if worker_id:
-            try:
-                from blackbird_tpu.client import Client
+        # The id comes from the native worker itself (btpu_worker_id) — no
+        # second YAML parser to drift from the one that registered it.
+        worker_id = host.worker_id
+        try:
+            from blackbird_tpu.client import Client
 
-                moved = Client(args.drain_on_term).drain_worker(worker_id)
-                print(f"drained {worker_id}: {moved} shards migrated", flush=True)
-            except Exception as exc:  # noqa: BLE001 - shut down regardless
-                print(f"drain failed ({exc}); shutting down anyway", flush=True)
+            moved = Client(args.drain_on_term).drain_worker(worker_id)
+            print(f"drained {worker_id}: {moved} shards migrated", flush=True)
+        except Exception as exc:  # noqa: BLE001 - shut down regardless
+            print(f"drain failed ({exc}); shutting down anyway", flush=True)
     host.close()
     return 0
 

@@ -34,8 +34,10 @@ void btpu_cluster_counters(btpu_cluster* cluster, uint64_t out[6]);
  * coordinator list. Returns NULL on any startup failure. */
 typedef struct btpu_worker btpu_worker;
 btpu_worker* btpu_worker_create(const char* config_yaml_path, const char* coord_endpoints);
-/* Worker id / pool count introspection for logs. */
+/* Worker id / pool count introspection for logs. The id pointer stays
+ * valid for the worker's lifetime. */
 uint32_t btpu_worker_pool_count(btpu_worker* worker);
+const char* btpu_worker_id(btpu_worker* worker);
 void btpu_worker_destroy(btpu_worker* worker);
 
 btpu_client* btpu_client_create_embedded(btpu_cluster* cluster);

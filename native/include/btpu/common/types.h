@@ -148,6 +148,7 @@ struct ShardPlacement {
   StorageClass storage_class{StorageClass::STORAGE_UNSPECIFIED};
   uint64_t length{0};
   LocationDetail location{MemoryLocation{}};
+  bool operator==(const ShardPlacement&) const = default;
 };
 
 struct CopyPlacement {

@@ -86,6 +86,7 @@ struct KeystoneCounters {
   std::atomic<uint64_t> workers_lost{0};
   std::atomic<uint64_t> objects_repaired{0};
   std::atomic<uint64_t> objects_lost{0};
+  std::atomic<uint64_t> shards_drained{0};
 };
 
 class KeystoneService {

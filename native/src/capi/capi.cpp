@@ -110,6 +110,10 @@ uint32_t btpu_worker_pool_count(btpu_worker* worker) {
   return worker ? static_cast<uint32_t>(worker->impl->pools().size()) : 0;
 }
 
+const char* btpu_worker_id(btpu_worker* worker) {
+  return worker ? worker->impl->config().worker_id.c_str() : "";
+}
+
 void btpu_worker_destroy(btpu_worker* worker) {
   if (!worker) return;
   worker->impl->stop();

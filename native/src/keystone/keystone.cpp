@@ -943,6 +943,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     // worker invisibly excluded on THIS instance (the new leader owns the
     // drain now; the operator retries against it).
     if (!is_leader_.load()) {
+      counters_.shards_drained.fetch_add(total_moved);
       std::unique_lock lock(registry_mutex_);
       draining_.erase(worker_id);
       return ErrorCode::NOT_LEADER;
@@ -993,7 +994,12 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
       if (it == objects_.end() || it->second.epoch != expect ||
           m.copy_index >= it->second.copies.size() ||
-          m.shard_index >= it->second.copies[m.copy_index].shards.size()) {
+          m.shard_index >= it->second.copies[m.copy_index].shards.size() ||
+          // Our own earlier splice in this copy may have shifted indices
+          // (a staged allocation can insert several shards): the shard at
+          // this index must still BE the scanned victim, or releasing it
+          // would free a healthy live range. Mismatches retry via re-scan.
+          !(it->second.copies[m.copy_index].shards[m.shard_index] == m.shard)) {
         lock.unlock();
         adapter_.free_object(staging_key);
         continue;  // object changed underneath the move; the re-scan retries
@@ -1026,6 +1032,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     // Keep the worker registered AND still marked draining (no new data
     // lands on it); the operator retries after fixing capacity/transport.
     // If the worker dies first, cleanup_dead_worker clears the flag.
+    counters_.shards_drained.fetch_add(total_moved);
     LOG_WARN << "drain of " << worker_id << " incomplete after " << total_moved
              << " migrated shards";
     return ErrorCode::WORKER_DRAIN_INCOMPLETE;
@@ -1038,6 +1045,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     std::unique_lock lock(registry_mutex_);
     draining_.erase(worker_id);
   }
+  counters_.shards_drained.fetch_add(total_moved);
   LOG_INFO << "drained worker " << worker_id << ": " << total_moved << " shards migrated";
   return total_moved;
 }

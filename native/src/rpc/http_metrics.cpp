@@ -60,6 +60,8 @@ std::string MetricsHttpServer::render_metrics() const {
           c.objects_repaired.load());
   counter("btpu_objects_lost_total", "objects lost with their last replica",
           c.objects_lost.load());
+  counter("btpu_shards_drained_total", "shards migrated by graceful worker drains",
+          c.shards_drained.load());
 
   auto stats = service_.get_cluster_stats();
   if (stats.ok()) {

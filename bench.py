@@ -160,7 +160,9 @@ def main() -> int:
     # reference's benchmark_client crosses a NIC. LOCAL (same-address-space
     # memcpy) is reported only as a labeled ceiling on stderr.
     main_rows = run_bench(binary, size=1 << 20, iterations=150, transport="tcp")
-    small_rows = run_bench(binary, size=64 << 10, iterations=300, transport="tcp")
+    # p99 needs samples: at 300 iters it is the 3rd-worst draw and scheduler
+    # noise dominates; 1500 iters costs ~0.1s and stabilizes it.
+    small_rows = run_bench(binary, size=64 << 10, iterations=1500, transport="tcp")
     shm_rows = run_bench(binary, size=1 << 20, iterations=150, transport="shm")
     local_rows = run_bench(binary, size=1 << 20, iterations=150, transport="local")
     # Replicated read: split across both copies in parallel (vs one link).

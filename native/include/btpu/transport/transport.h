@@ -72,6 +72,18 @@ class TransportServer {
   }
 };
 
+// One wire-level one-sided transfer in a batch. Always flat addressing
+// (MemoryLocation-style, including virtual regions); device shards batch
+// through shard_io_batch instead.
+struct WireOp {
+  const RemoteDescriptor* remote{nullptr};
+  uint64_t addr{0};
+  uint64_t rkey{0};
+  uint8_t* buf{nullptr};
+  uint64_t len{0};
+  ErrorCode status{ErrorCode::OK};  // per-op result, set by the batch call
+};
+
 // Client side: one-sided read/write against any advertised descriptor.
 // Thread-safe; concurrent calls proceed in parallel (pooled connections).
 class TransportClient {
@@ -81,6 +93,17 @@ class TransportClient {
                          void* dst, uint64_t len) = 0;
   virtual ErrorCode write(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
                           const void* src, uint64_t len) = 0;
+
+  // Batched one-sided ops. The mux implementation pipelines TCP ops: every
+  // request is issued before any response is awaited, so a batch of n
+  // transfers costs ~one round-trip of latency instead of n and needs no
+  // fan-out threads (the reference instead paid a std::async thread per
+  // shard, blackbird_client.cpp:250-267). Every op is attempted; per-op
+  // results land in op.status and the first failure is returned.
+  // `max_concurrency` caps in-flight requests (connections per batch);
+  // 0 = transport default.
+  virtual ErrorCode read_batch(WireOp* ops, size_t n, size_t max_concurrency = 0);
+  virtual ErrorCode write_batch(WireOp* ops, size_t n, size_t max_concurrency = 0);
 };
 
 // Factory: server for one kind; mux client that routes on descriptor kind.
@@ -115,6 +138,19 @@ ErrorCode shard_io(TransportClient& client, const ShardPlacement& shard, uint64_
 // repair/demotion movers.
 ErrorCode copy_range_io(TransportClient& client, const CopyPlacement& copy, uint64_t obj_off,
                         uint8_t* buf, uint64_t len, bool is_write);
+
+// Flattens one wire shard access into a WireOp. Returns false for location
+// kinds with no flat client data path (FileLocation is worker-served;
+// DeviceLocation batches through shard_io_batch).
+bool make_wire_op(const ShardPlacement& shard, uint64_t in_off, uint8_t* buf, uint64_t len,
+                  WireOp& op);
+
+// Appends WireOps covering [obj_off, obj_off+len) of one copy (the
+// running-offset walk of copy_range_io, emitting ops instead of moving
+// bytes; buf points at the range start). Returns false when a shard in
+// range is not flat-addressable.
+bool append_range_wire_ops(const CopyPlacement& copy, uint64_t obj_off, uint64_t len,
+                           uint8_t* buf, std::vector<WireOp>& ops);
 
 // One element of a multi-shard transfer (buf already points at this shard's
 // slice of the object buffer).

@@ -1,10 +1,6 @@
 #include "btpu/client/client.h"
 
-#include <atomic>
-#include <thread>
-
 #include "btpu/common/log.h"
-#include "btpu/common/thread_pool.h"
 #include "btpu/common/trace.h"
 #include "btpu/storage/hbm_provider.h"
 
@@ -204,45 +200,10 @@ ErrorCode ObjectClient::shard_io(const ShardPlacement& shard, uint8_t* buf, bool
   return transport::shard_io(*data_, shard, 0, buf, shard.length, is_write);
 }
 
-namespace {
-// Shared transfer pool: persistent threads amortized across all clients in
-// the process (per-op thread spawn costs ~100us, see thread_pool.h).
-ThreadPool& transfer_pool() {
-  static ThreadPool pool(8);
-  return pool;
-}
-
-// Below this many bytes per shard, parallel dispatch costs more than the
-// transfer itself: run inline.
-constexpr uint64_t kInlineShardBytes = 128 * 1024;
-
-// Runs `count` shard jobs, parallel when worthwhile. Returns first error.
-ErrorCode run_parallel(size_t count, size_t parallelism, uint64_t bytes_per_shard,
-                       const std::function<ErrorCode(size_t)>& job) {
-  if (count == 0) return ErrorCode::OK;
-  if (count == 1 || parallelism <= 1 || bytes_per_shard < kInlineShardBytes) {
-    for (size_t i = 0; i < count; ++i) {
-      if (auto ec = job(i); ec != ErrorCode::OK) return ec;
-    }
-    return ErrorCode::OK;
-  }
-  std::atomic<uint32_t> first_error{static_cast<uint32_t>(ErrorCode::OK)};
-  transfer_pool().run_batch(count, [&](size_t i) {
-    if (first_error.load() != static_cast<uint32_t>(ErrorCode::OK)) return;
-    if (auto ec = job(i); ec != ErrorCode::OK) {
-      uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
-      first_error.compare_exchange_strong(expected, static_cast<uint32_t>(ec));
-    }
-  });
-  return static_cast<ErrorCode>(first_error.load());
-}
-}  // namespace
-
-// Wide replicated reads split the byte range into parallel slices assigned
-// round-robin across replicas — aggregate read bandwidth is every replica's
-// link, not one (the reference left this as a TODO,
-// blackbird_client.cpp:283), while slice-level fan-out keeps the intra-copy
-// parallelism the whole-copy path has. Any failure reports back and the
+// Wide replicated reads split the byte range into slices assigned
+// round-robin across replicas, issued as ONE pipelined batch — aggregate
+// read bandwidth is every replica's link, not one (the reference left this
+// as a TODO, blackbird_client.cpp:283). Any failure reports back and the
 // caller falls back to sequential per-copy reads, so a dead replica costs a
 // retry, never the object.
 ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
@@ -253,7 +214,7 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
   for (const auto& copy : copies) {
     uint64_t copy_size = 0;
     for (const auto& shard : copy.shards) {
-      if (std::holds_alternative<DeviceLocation>(shard.location))
+      if (!std::holds_alternative<MemoryLocation>(shard.location))
         return ErrorCode::NOT_IMPLEMENTED;  // device reads batch better whole
       copy_size += shard.length;
     }
@@ -262,19 +223,20 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
   const uint64_t n_slices =
       std::min<uint64_t>(options_.io_parallelism, size / (kSplitReadMin / 2));
   const uint64_t slice = (size + n_slices - 1) / n_slices;
-  return run_parallel(static_cast<size_t>(n_slices), options_.io_parallelism, slice,
-                      [&](size_t j) {
-                        const uint64_t lo = j * slice;
-                        const uint64_t len = std::min(slice, size - lo);
-                        return transport::copy_range_io(*data_, copies[j % copies.size()],
-                                                        lo, buffer + lo, len,
-                                                        /*is_write=*/false);
-                      });
+  std::vector<transport::WireOp> ops;
+  for (uint64_t j = 0; j < n_slices; ++j) {
+    const uint64_t lo = j * slice;
+    const uint64_t len = std::min(slice, size - lo);
+    if (!transport::append_range_wire_ops(copies[j % copies.size()], lo, len, buffer + lo,
+                                          ops))
+      return ErrorCode::NOT_IMPLEMENTED;
+  }
+  return data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
 }
 
 // Shared by the single-object and batched paths: device-location shards are
 // coalesced into ONE provider scatter/gather call (per-op device latency is
-// the enemy, hbm_provider.h v2), wire shards fan out over the thread pool.
+// the enemy, hbm_provider.h v2), wire shards move as one pipelined batch.
 ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
                                       bool is_write) {
   // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
@@ -305,11 +267,20 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
       if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
     }
   }
-  const uint64_t per_shard = wire_idx.empty() ? 0 : size / copy.shards.size();
-  return run_parallel(wire_idx.size(), options_.io_parallelism, per_shard, [&](size_t j) {
-    const size_t i = wire_idx[j];
-    return shard_io(copy.shards[i], data + offsets[i], is_write);
-  });
+  if (wire_idx.empty()) return ErrorCode::OK;
+  // Wire shards move as one pipelined batch: every request issued before any
+  // response is awaited, so a striped object costs ~one round trip.
+  std::vector<transport::WireOp> ops;
+  ops.reserve(wire_idx.size());
+  for (size_t i : wire_idx) {
+    const auto& shard = copy.shards[i];
+    transport::WireOp op;
+    if (!transport::make_wire_op(shard, 0, data + offsets[i], shard.length, op))
+      return ErrorCode::NOT_IMPLEMENTED;  // FileLocation: worker-served
+    ops.push_back(op);
+  }
+  return is_write ? data_->write_batch(ops.data(), ops.size(), options_.io_parallelism)
+                  : data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
 }
 
 ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
@@ -352,6 +323,39 @@ ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t si
     off += shard.length;
   }
   return off == size ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
+}
+
+// Runs the wire jobs as ONE pipelined batch; per-op failures land on their
+// item, jobs of items that already failed are skipped (their reservation is
+// cancelled by the caller anyway).
+void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
+                   size_t max_concurrency, std::vector<ErrorCode>& item_errors) {
+  if (jobs.wire.empty()) return;
+  std::vector<transport::WireOp> ops;
+  std::vector<size_t> op_item;
+  ops.reserve(jobs.wire.size());
+  for (size_t j = 0; j < jobs.wire.size(); ++j) {
+    const size_t item = jobs.wire_item[j];
+    if (item_errors[item] != ErrorCode::OK) continue;
+    const auto& job = jobs.wire[j];
+    transport::WireOp op;
+    if (!transport::make_wire_op(*job.shard, job.in_off, job.buf, job.len, op)) {
+      // FileLocation: worker-served, never a client target.
+      item_errors[item] = ErrorCode::NOT_IMPLEMENTED;
+      continue;
+    }
+    ops.push_back(op);
+    op_item.push_back(item);
+  }
+  if (is_write) {
+    client.write_batch(ops.data(), ops.size(), max_concurrency);
+  } else {
+    client.read_batch(ops.data(), ops.size(), max_concurrency);
+  }
+  for (size_t j = 0; j < ops.size(); ++j) {
+    if (ops[j].status != ErrorCode::OK && item_errors[op_item[j]] == ErrorCode::OK)
+      item_errors[op_item[j]] = ops[j].status;
+  }
 }
 
 // Runs the device jobs as ONE provider batch; when the whole batch fails,
@@ -425,31 +429,7 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   }
 
   run_device_jobs(*data_, jobs, /*is_write=*/true, results);
-  if (!jobs.wire.empty()) {
-    const uint64_t per_shard = jobs.wire.front().len;
-    // Items already failed keep their first error; wire jobs for them are
-    // skipped (their reservation is cancelled below anyway).
-    std::vector<std::atomic<uint32_t>> slots(items.size());
-    for (auto& s : slots) s.store(static_cast<uint32_t>(ErrorCode::OK));
-    run_parallel(jobs.wire.size(), options_.io_parallelism, per_shard, [&](size_t j) {
-      const size_t item = jobs.wire_item[j];
-      if (results[item] != ErrorCode::OK ||
-          slots[item].load() != static_cast<uint32_t>(ErrorCode::OK))
-        return ErrorCode::OK;  // item already failed; don't sink the batch
-      const auto& job = jobs.wire[j];
-      if (auto ec = transport::shard_io(*data_, *job.shard, job.in_off, job.buf, job.len,
-                                        /*is_write=*/true);
-          ec != ErrorCode::OK) {
-        uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
-        slots[item].compare_exchange_strong(expected, static_cast<uint32_t>(ec));
-      }
-      return ErrorCode::OK;
-    });
-    for (size_t i = 0; i < items.size(); ++i) {
-      if (results[i] == ErrorCode::OK)
-        results[i] = static_cast<ErrorCode>(slots[i].load());
-    }
-  }
+  run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results);
   // Device writes may be asynchronous; put_complete must not be sent until
   // the bytes are durably in the tier.
   if (!jobs.device.empty()) {
@@ -542,28 +522,7 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
       errors[i] = ec;
   }
   run_device_jobs(*data_, jobs, /*is_write=*/false, errors);
-  if (!jobs.wire.empty()) {
-    std::vector<std::atomic<uint32_t>> slots(items.size());
-    for (auto& s : slots) s.store(static_cast<uint32_t>(ErrorCode::OK));
-    run_parallel(jobs.wire.size(), options_.io_parallelism, jobs.wire.front().len,
-                 [&](size_t j) {
-                   const size_t item = jobs.wire_item[j];
-                   if (errors[item] != ErrorCode::OK ||
-                       slots[item].load() != static_cast<uint32_t>(ErrorCode::OK))
-                     return ErrorCode::OK;
-                   const auto& job = jobs.wire[j];
-                   if (auto ec = transport::shard_io(*data_, *job.shard, job.in_off, job.buf,
-                                                     job.len, /*is_write=*/false);
-                       ec != ErrorCode::OK) {
-                     uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
-                     slots[item].compare_exchange_strong(expected, static_cast<uint32_t>(ec));
-                   }
-                   return ErrorCode::OK;
-                 });
-    for (size_t i = 0; i < items.size(); ++i) {
-      if (errors[i] == ErrorCode::OK) errors[i] = static_cast<ErrorCode>(slots[i].load());
-    }
-  }
+  run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors);
 
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placements[i].ok() || placements[i].value().empty() ||

@@ -11,13 +11,13 @@
 // one UCX endpoint creation per transfer, blackbird_client.cpp:162-188).
 #include <atomic>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <random>
 #include <thread>
 #include <unordered_map>
 
 #include "btpu/common/log.h"
-#include "btpu/common/thread_pool.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/transport.h"
 
@@ -286,92 +286,179 @@ class TcpEndpointPool {
   std::unordered_map<std::string, std::vector<net::Socket>> pools_;
 };
 
-ErrorCode tcp_one_sided(const std::string& endpoint, uint8_t op, uint64_t addr, uint64_t rkey,
-                        void* buf, uint64_t len) {
-  auto sock = TcpEndpointPool::instance().acquire(endpoint);
-  if (!sock.ok()) return sock.error();
-  net::Socket s = std::move(sock).value();
+// ---- pipelined batch engine ------------------------------------------------
+//
+// Every request in a batch is issued before any response is awaited, one
+// pooled connection per in-flight sub-op. The server side processes the
+// requests concurrently (thread per connection) while the client drains
+// responses in issue order, so a batch costs ~one round trip of latency and
+// zero fan-out threads; ops wider than kChunkBytes are split so one huge
+// transfer also pipelines. One-sided reads and writes are idempotent, so a
+// sub-op whose connection dies mid-flight (worker restarted, stale pooled
+// socket) is simply re-run once on a fresh connection.
 
-  DataRequestHeader hdr{op, addr, rkey, len};
-  ErrorCode ec;
-  if (op == kOpWrite) {
-    ec = net::write_iov2(s.fd(), &hdr, sizeof(hdr), buf, len);
-  } else {
-    ec = net::write_all(s.fd(), &hdr, sizeof(hdr));
-  }
-  if (ec != ErrorCode::OK) return ec;  // dead pooled conn: caller may retry
+namespace {
 
+constexpr uint64_t kChunkBytes = 4ull << 20;  // fits the 4 MiB socket buffers
+constexpr size_t kMaxInflight = 12;           // < kMaxPooledPerEndpoint
+
+struct SubOp {
+  WireOp* op;
+  uint64_t addr;   // absolute remote address of this chunk
+  uint8_t* buf;    // client-side slice
+  uint64_t len;
+};
+
+ErrorCode issue_sub(const net::Socket& s, const SubOp& sub, uint8_t opcode) {
+  DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len};
+  if (opcode == kOpWrite)
+    return net::write_iov2(s.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
+  return net::write_all(s.fd(), &hdr, sizeof(hdr));
+}
+
+// Reads one response. `healthy` reports whether the stream is still aligned
+// (server-reported errors keep the connection reusable; socket errors don't).
+ErrorCode collect_sub(const net::Socket& s, const SubOp& sub, uint8_t opcode, bool& healthy) {
   uint32_t status = 0;
-  if ((ec = net::read_exact(s.fd(), &status, sizeof(status))) != ErrorCode::OK) return ec;
+  healthy = false;
+  if (auto ec = net::read_exact(s.fd(), &status, sizeof(status)); ec != ErrorCode::OK)
+    return ec;
   if (static_cast<ErrorCode>(status) != ErrorCode::OK) {
-    TcpEndpointPool::instance().release(endpoint, std::move(s));
+    healthy = true;  // error responses carry no payload
     return static_cast<ErrorCode>(status);
   }
-  if (op == kOpRead) {
-    if ((ec = net::read_exact(s.fd(), buf, len)) != ErrorCode::OK) return ec;
+  if (opcode == kOpRead) {
+    if (auto ec = net::read_exact(s.fd(), sub.buf, sub.len); ec != ErrorCode::OK) return ec;
   }
-  TcpEndpointPool::instance().release(endpoint, std::move(s));
+  healthy = true;
   return ErrorCode::OK;
 }
 
-namespace {
-// One connection saturates around a couple GB/s on loopback; wide transfers
-// split into chunks issued over several pooled connections in parallel.
-constexpr uint64_t kParallelCutover = 4ull << 20;  // split ops above this
-constexpr uint64_t kChunkBytes = 2ull << 20;
-constexpr size_t kMaxStreams = 4;
+bool is_socket_failure(ErrorCode ec) {
+  return ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED ||
+         ec == ErrorCode::CONNECTION_FAILED;
+}
 
-ErrorCode tcp_one_sided_retry(const std::string& endpoint, uint8_t op, uint64_t addr,
-                              uint64_t rkey, void* buf, uint64_t len) {
-  auto ec = tcp_one_sided(endpoint, op, addr, rkey, buf, len);
-  if (ec == ErrorCode::NETWORK_ERROR || ec == ErrorCode::CLIENT_DISCONNECTED) {
-    // A stale pooled connection (worker restarted): retry once on a fresh one.
-    TcpEndpointPool::instance().drop_endpoint(endpoint);
-    ec = tcp_one_sided(endpoint, op, addr, rkey, buf, len);
+// Endpoints whose connect failed once in this batch: every later sub-op to
+// them fails immediately instead of re-paying the connect timeout serially
+// (a preempted worker must not stall the whole pipeline N x 5s — the caller
+// falls back to another replica).
+using DeadEndpoints = std::unordered_map<std::string, ErrorCode>;
+
+// Synchronous single-shot on a fresh connection (retry path).
+ErrorCode run_sub_fresh(const SubOp& sub, uint8_t opcode, DeadEndpoints& dead) {
+  auto& pool = TcpEndpointPool::instance();
+  const std::string& endpoint = sub.op->remote->endpoint;
+  if (auto it = dead.find(endpoint); it != dead.end()) return it->second;
+  pool.drop_endpoint(endpoint);  // the whole pool is suspect once one died
+  auto acquired = pool.acquire(endpoint);
+  if (!acquired.ok()) {
+    dead.emplace(endpoint, acquired.error());
+    return acquired.error();
   }
+  net::Socket s = std::move(acquired).value();
+  if (auto ec = issue_sub(s, sub, opcode); ec != ErrorCode::OK) return ec;
+  bool healthy = false;
+  const ErrorCode ec = collect_sub(s, sub, opcode, healthy);
+  if (healthy) pool.release(endpoint, std::move(s));
   return ec;
 }
 
-ErrorCode tcp_chunked(const std::string& endpoint, uint8_t op, uint64_t addr, uint64_t rkey,
-                      void* buf, uint64_t len) {
-  if (len < kParallelCutover) return tcp_one_sided_retry(endpoint, op, addr, rkey, buf, len);
-  const uint64_t n_chunks = (len + kChunkBytes - 1) / kChunkBytes;
-  const size_t streams = static_cast<size_t>(std::min<uint64_t>(kMaxStreams, n_chunks));
-  std::atomic<uint64_t> next{0};
-  std::atomic<uint32_t> first_error{static_cast<uint32_t>(ErrorCode::OK)};
-  auto worker = [&] {
-    for (uint64_t i = next.fetch_add(1); i < n_chunks; i = next.fetch_add(1)) {
-      if (first_error.load() != static_cast<uint32_t>(ErrorCode::OK)) return;
-      const uint64_t off = i * kChunkBytes;
-      const uint64_t n = std::min(kChunkBytes, len - off);
-      auto ec = tcp_one_sided_retry(endpoint, op, addr + off, rkey,
-                                    static_cast<uint8_t*>(buf) + off, n);
-      if (ec != ErrorCode::OK) {
-        uint32_t expected = static_cast<uint32_t>(ErrorCode::OK);
-        first_error.compare_exchange_strong(expected, static_cast<uint32_t>(ec));
-        return;
-      }
-    }
-  };
-  // Shared persistent helpers: spawning threads per transfer costs ~100us
-  // of setup on the hot path and can throw under resource exhaustion. Sized
-  // for several concurrent wide transfers (client shard fan-out is 8-wide);
-  // each caller also works, so exhaustion degrades to fewer streams, never
-  // to a stall.
-  static ThreadPool stream_pool(4 * (kMaxStreams - 1));
-  stream_pool.run_batch(streams, [&](size_t) { worker(); });
-  return static_cast<ErrorCode>(first_error.load());
-}
 }  // namespace
+
+ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency) {
+  const uint8_t opcode = is_write ? kOpWrite : kOpRead;
+  const size_t inflight_cap =
+      max_concurrency ? std::min(max_concurrency, kMaxInflight) : kMaxInflight;
+  std::vector<SubOp> subs;
+  subs.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    ops[i].status = ErrorCode::OK;
+    for (uint64_t off = 0; off < ops[i].len; off += kChunkBytes) {
+      const uint64_t len = std::min(kChunkBytes, ops[i].len - off);
+      subs.push_back({&ops[i], ops[i].addr + off, ops[i].buf + off, len});
+    }
+  }
+
+  auto& pool = TcpEndpointPool::instance();
+  ErrorCode first = ErrorCode::OK;
+  auto fail = [&](WireOp* op, ErrorCode ec) {
+    if (op->status == ErrorCode::OK) op->status = ec;
+    if (first == ErrorCode::OK) first = ec;
+  };
+
+  struct Flight {
+    size_t sub;
+    net::Socket sock;
+  };
+  std::deque<Flight> inflight;
+  DeadEndpoints dead;
+  size_t next = 0;
+  while (next < subs.size() || !inflight.empty()) {
+    if (next < subs.size() && inflight.size() < inflight_cap) {
+      const SubOp& sub = subs[next];
+      if (sub.op->status != ErrorCode::OK) {  // sibling chunk already failed
+        ++next;
+        continue;
+      }
+      if (auto it = dead.find(sub.op->remote->endpoint); it != dead.end()) {
+        fail(sub.op, it->second);
+        ++next;
+        continue;
+      }
+      auto acquired = pool.acquire(sub.op->remote->endpoint);
+      if (!acquired.ok()) {
+        dead.emplace(sub.op->remote->endpoint, acquired.error());
+        fail(sub.op, acquired.error());
+        ++next;
+        continue;
+      }
+      net::Socket s = std::move(acquired).value();
+      if (auto ec = issue_sub(s, sub, opcode); ec != ErrorCode::OK) {
+        // Stale pooled connection dies at send time: one fresh retry.
+        if (auto rec = is_socket_failure(ec) ? run_sub_fresh(sub, opcode, dead) : ec;
+            rec != ErrorCode::OK)
+          fail(sub.op, rec);
+        ++next;
+        continue;
+      }
+      inflight.push_back({next, std::move(s)});
+      ++next;
+      continue;
+    }
+    Flight flight = std::move(inflight.front());
+    inflight.pop_front();
+    const SubOp& sub = subs[flight.sub];
+    bool healthy = false;
+    ErrorCode ec = collect_sub(flight.sock, sub, opcode, healthy);
+    if (healthy) {
+      pool.release(sub.op->remote->endpoint, std::move(flight.sock));
+    } else if (is_socket_failure(ec)) {
+      // Stale pooled connection dies at response time (or the worker
+      // restarted mid-op): the op is idempotent, re-run it once.
+      ec = run_sub_fresh(sub, opcode, dead);
+    }
+    if (ec != ErrorCode::OK) fail(sub.op, ec);
+  }
+  return first;
+}
 
 ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
                    uint64_t len) {
-  return tcp_chunked(endpoint, kOpRead, addr, rkey, dst, len);
+  RemoteDescriptor remote;
+  remote.transport = TransportKind::TCP;
+  remote.endpoint = endpoint;
+  WireOp op{&remote, addr, rkey, static_cast<uint8_t*>(dst), len};
+  return tcp_batch(&op, 1, /*is_write=*/false, 0);
 }
 
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
                     uint64_t len) {
-  return tcp_chunked(endpoint, kOpWrite, addr, rkey, const_cast<void*>(src), len);
+  RemoteDescriptor remote;
+  remote.transport = TransportKind::TCP;
+  remote.endpoint = endpoint;
+  WireOp op{&remote, addr, rkey, const_cast<uint8_t*>(static_cast<const uint8_t*>(src)), len};
+  return tcp_batch(&op, 1, /*is_write=*/true, 0);
 }
 
 std::unique_ptr<TransportServer> make_tcp_transport_server() {

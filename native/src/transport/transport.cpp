@@ -22,6 +22,8 @@ ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, vo
                    uint64_t len);
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
                     uint64_t len);
+ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write,
+                    size_t max_concurrency);  // pipelined, tcp_transport.cpp
 
 std::string rkey_to_hex(uint64_t rkey) {
   char buf[17];
@@ -84,7 +86,42 @@ class MuxTransportClient : public TransportClient {
     return access(remote, remote_addr, rkey, const_cast<void*>(src), len, /*is_write=*/true);
   }
 
+  // TCP ops pipeline (one round trip for the whole batch); memory-backed
+  // kinds (LOCAL/SHM) are memcpy-bound and run inline — parallel memcpy
+  // buys nothing the memory bus doesn't already give.
+  ErrorCode read_batch(WireOp* ops, size_t n, size_t max_concurrency) override {
+    return batch(ops, n, false, max_concurrency);
+  }
+  ErrorCode write_batch(WireOp* ops, size_t n, size_t max_concurrency) override {
+    return batch(ops, n, true, max_concurrency);
+  }
+
  private:
+  static ErrorCode batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency) {
+    ErrorCode first = ErrorCode::OK;
+    std::vector<WireOp*> tcp_ops;
+    for (size_t i = 0; i < n; ++i) {
+      WireOp& op = ops[i];
+      op.status = ErrorCode::OK;
+      if (op.len == 0) continue;
+      if (op.remote->transport == TransportKind::TCP) {
+        tcp_ops.push_back(&op);
+        continue;
+      }
+      op.status = access(*op.remote, op.addr, op.rkey, op.buf, op.len, is_write);
+      if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
+    }
+    if (!tcp_ops.empty()) {
+      // Compact the TCP subset so the pipeline sees a contiguous array.
+      std::vector<WireOp> subset(tcp_ops.size());
+      for (size_t i = 0; i < tcp_ops.size(); ++i) subset[i] = *tcp_ops[i];
+      const ErrorCode ec = tcp_batch(subset.data(), subset.size(), is_write, max_concurrency);
+      for (size_t i = 0; i < tcp_ops.size(); ++i) tcp_ops[i]->status = subset[i].status;
+      if (ec != ErrorCode::OK && first == ErrorCode::OK) first = ec;
+    }
+    return first;
+  }
+
   static ErrorCode access(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
                           void* buf, uint64_t len, bool is_write) {
     if (len == 0) return ErrorCode::OK;
@@ -103,6 +140,58 @@ class MuxTransportClient : public TransportClient {
 };
 
 }  // namespace
+
+// Default: attempt every op through the virtual single-op path (keeps
+// wrappers like the fault injector in the loop for each op).
+ErrorCode TransportClient::read_batch(WireOp* ops, size_t n, size_t) {
+  ErrorCode first = ErrorCode::OK;
+  for (size_t i = 0; i < n; ++i) {
+    WireOp& op = ops[i];
+    op.status = op.len == 0 ? ErrorCode::OK
+                            : read(*op.remote, op.addr, op.rkey, op.buf, op.len);
+    if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
+  }
+  return first;
+}
+
+ErrorCode TransportClient::write_batch(WireOp* ops, size_t n, size_t) {
+  ErrorCode first = ErrorCode::OK;
+  for (size_t i = 0; i < n; ++i) {
+    WireOp& op = ops[i];
+    op.status = op.len == 0 ? ErrorCode::OK
+                            : write(*op.remote, op.addr, op.rkey, op.buf, op.len);
+    if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
+  }
+  return first;
+}
+
+bool make_wire_op(const ShardPlacement& shard, uint64_t in_off, uint8_t* buf, uint64_t len,
+                  WireOp& op) {
+  const auto* mem = std::get_if<MemoryLocation>(&shard.location);
+  if (!mem) return false;
+  op = {&shard.remote, mem->remote_addr + in_off, mem->rkey, buf, len, ErrorCode::OK};
+  return true;
+}
+
+bool append_range_wire_ops(const CopyPlacement& copy, uint64_t obj_off, uint64_t len,
+                           uint8_t* buf, std::vector<WireOp>& ops) {
+  uint64_t shard_start = 0, cur = obj_off, remaining = len;
+  for (const auto& shard : copy.shards) {
+    const uint64_t shard_end = shard_start + shard.length;
+    if (cur < shard_end && remaining > 0) {
+      const uint64_t in_off = cur - shard_start;
+      const uint64_t n = std::min(remaining, shard.length - in_off);
+      WireOp op;
+      if (!make_wire_op(shard, in_off, buf + (cur - obj_off), n, op)) return false;
+      ops.push_back(op);
+      cur += n;
+      remaining -= n;
+    }
+    shard_start = shard_end;
+    if (remaining == 0) break;
+  }
+  return remaining == 0;
+}
 
 std::unique_ptr<TransportClient> make_transport_client() {
   return std::make_unique<MuxTransportClient>();

@@ -60,6 +60,7 @@ int main(int argc, char** argv) {
   wc.replication_factor = 1;
   wc.max_workers_per_copy = 4;
   bool json = false, sweep = false;
+  int batch = 0;  // >0: measure put_many/get_many over `batch` objects per op
 
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--keystone") && i + 1 < argc) keystone = argv[++i];
@@ -75,11 +76,12 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--transport") && i + 1 < argc) transport = argv[++i];
     else if (!std::strcmp(argv[i], "--json")) json = true;
     else if (!std::strcmp(argv[i], "--sweep")) sweep = true;
+    else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--help")) {
       std::printf(
           "usage: bb-bench (--keystone host:port | --embedded N) [--size BYTES]\n"
           "       [--iterations N] [--replicas R] [--max-workers W]\n"
-          "       [--transport local|shm|tcp] [--json] [--sweep]\n");
+          "       [--transport local|shm|tcp] [--json] [--sweep] [--batch N]\n");
       return 0;
     }
   }
@@ -92,8 +94,11 @@ int main(int argc, char** argv) {
       std::fprintf(stderr, "unknown transport %s\n", transport.c_str());
       return 1;
     }
-    const uint64_t pool_bytes =
-        std::max<uint64_t>(64ull << 20, 4 * size * wc.replication_factor);
+    // Size pools for the LARGEST point that will run (sweep maxes at 16 MiB),
+    // so large batched points don't run under eviction pressure.
+    const uint64_t max_size = sweep ? std::max<uint64_t>(size, 16ull << 20) : size;
+    const uint64_t pool_bytes = std::max<uint64_t>(
+        64ull << 20, 4 * max_size * wc.replication_factor * std::max(1, batch));
     auto options = client::EmbeddedClusterOptions::simple(
         static_cast<size_t>(embedded_workers), pool_bytes);
     options.use_coordinator = false;
@@ -125,6 +130,59 @@ int main(int argc, char** argv) {
 
   std::vector<uint64_t> sizes = sweep ? std::vector<uint64_t>{4 << 10, 64 << 10, 1 << 20, 16 << 20}
                                       : std::vector<uint64_t>{size};
+
+  if (batch > 0) {
+    // Batched-API mode: one put_many/get_many round moves `batch` objects —
+    // the placement RPC is one call and the data plane pipelines across
+    // objects, so this is the aggregate-throughput view (the reference's
+    // batch RPCs existed but its data path still moved one shard at a time).
+    for (uint64_t sz : sizes) {
+      std::vector<uint8_t> data(sz);
+      for (uint64_t i = 0; i < sz; ++i) data[i] = static_cast<uint8_t>(i * 131 + 17);
+      std::vector<std::vector<uint8_t>> readbacks(batch, std::vector<uint8_t>(sz));
+      OpStats put_stats, get_stats;
+      const int warmup = std::max(1, iterations / 10);
+      for (int it = -warmup; it < iterations; ++it) {
+        std::vector<client::ObjectClient::PutItem> puts;
+        std::vector<client::ObjectClient::GetItem> gets;
+        std::vector<ObjectKey> keys;
+        for (int j = 0; j < batch; ++j) {
+          keys.push_back("bench/batch/" + std::to_string(it + warmup) + "/" +
+                         std::to_string(j));
+          puts.push_back({keys.back(), data.data(), sz});
+          gets.push_back({keys.back(), readbacks[j].data(), sz});
+        }
+        auto t0 = Clock::now();
+        for (auto ec : client.put_many(puts, wc)) {
+          if (ec != ErrorCode::OK) {
+            std::fprintf(stderr, "put_many failed: %s\n", std::string(to_string(ec)).c_str());
+            return 1;
+          }
+        }
+        auto t1 = Clock::now();
+        for (auto& r : client.get_many(gets)) {
+          if (!r.ok() || r.value() != sz) {
+            std::fprintf(stderr, "get_many failed\n");
+            return 1;
+          }
+        }
+        auto t2 = Clock::now();
+        for (const auto& key : keys) client.remove(key);
+        if (it >= 0) {
+          put_stats.record(std::chrono::duration<double>(t1 - t0).count());
+          get_stats.record(std::chrono::duration<double>(t2 - t1).count());
+        }
+      }
+      if (std::memcmp(readbacks.back().data(), data.data(), sz) != 0) {
+        std::fprintf(stderr, "verification failed\n");
+        return 1;
+      }
+      put_stats.summarize("put_many", sz * static_cast<uint64_t>(batch), json);
+      get_stats.summarize("get_many", sz * static_cast<uint64_t>(batch), json);
+    }
+    return 0;
+  }
+
   for (uint64_t sz : sizes) {
     std::vector<uint8_t> data(sz);
     for (uint64_t i = 0; i < sz; ++i) data[i] = static_cast<uint8_t>(i * 131 + 17);

@@ -3,6 +3,7 @@
 // Parity notes: the reference only exercises its transport via manual demo
 // binaries (clients/ucx_client.cpp); here the contract is unit-tested.
 #include <atomic>
+#include <chrono>
 #include <cstring>
 #include <thread>
 
@@ -204,6 +205,71 @@ BTEST(Transport, TcpBatchSplitsWideOps) {
   WireOp get{&desc, desc.remote_base, parse_rkey(desc), dst.data(), len};
   BT_EXPECT(make_transport_client()->read_batch(&get, 1) == ErrorCode::OK);
   BT_EXPECT(std::memcmp(src.data(), dst.data(), len) == 0);
+  server->stop();
+}
+
+BTEST(Transport, TcpBatchFailsFastOnDeadEndpoint) {
+  // One unreachable endpoint in a batch must not sink the ops aimed at the
+  // live one, and every op to the dead endpoint shares one connect attempt
+  // (the per-batch memoization; a preempted worker otherwise costs
+  // N x connect-timeout serially).
+  auto server = make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  std::vector<uint8_t> region(8192, 9);
+  auto reg = server->register_region(region.data(), region.size(), "live");
+  BT_ASSERT_OK(reg);
+  const auto live = reg.value();
+
+  // A port with no listener: loopback connects fail immediately (RST).
+  RemoteDescriptor dead;
+  dead.transport = TransportKind::TCP;
+  {
+    uint16_t free_port = 0;
+    auto probe = net::tcp_listen("127.0.0.1", 0, &free_port);
+    BT_ASSERT_OK(probe);
+    dead.endpoint = "127.0.0.1:" + std::to_string(free_port);
+  }  // listener closed: the port is dead
+
+  auto client = make_transport_client();
+  std::vector<uint8_t> dst(4 * 1024, 0);
+  const auto t0 = std::chrono::steady_clock::now();
+  WireOp ops[4] = {
+      {&dead, 0x1000, 1, dst.data(), 1024},
+      {&live, live.remote_base, parse_rkey(live), dst.data() + 1024, 1024},
+      {&dead, 0x2000, 1, dst.data() + 2048, 1024},
+      {&live, live.remote_base + 1024, parse_rkey(live), dst.data() + 3072, 1024},
+  };
+  BT_EXPECT(client->read_batch(ops, 4) != ErrorCode::OK);
+  const auto elapsed = std::chrono::steady_clock::now() - t0;
+  BT_EXPECT(ops[0].status != ErrorCode::OK);
+  BT_EXPECT(ops[1].status == ErrorCode::OK);
+  BT_EXPECT(ops[2].status != ErrorCode::OK);
+  BT_EXPECT(ops[3].status == ErrorCode::OK);
+  BT_EXPECT_EQ(int(dst[1024]), 9);  // live reads actually landed
+  BT_EXPECT_EQ(int(dst[3072]), 9);
+  // Far below any connect-timeout multiple (loopback refusals are instant;
+  // the bound guards against serial timeout stacking on regression).
+  BT_EXPECT(std::chrono::duration_cast<std::chrono::milliseconds>(elapsed).count() < 2000);
+  server->stop();
+}
+
+BTEST(Transport, BatchHonorsConcurrencyCap) {
+  // max_concurrency=1 serializes the pipeline; the batch must still complete
+  // correctly (the cap is a resource bound, not a semantic change).
+  auto server = make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  std::vector<uint8_t> region(64 * 1024);
+  for (size_t i = 0; i < region.size(); ++i) region[i] = static_cast<uint8_t>(i * 3 + 1);
+  auto reg = server->register_region(region.data(), region.size(), "cap");
+  BT_ASSERT_OK(reg);
+  const auto desc = reg.value();
+  std::vector<uint8_t> dst(64 * 1024, 0);
+  std::vector<WireOp> ops;
+  for (size_t j = 0; j < 8; ++j)
+    ops.push_back({&desc, desc.remote_base + j * 8192, parse_rkey(desc), dst.data() + j * 8192,
+                   8192});
+  BT_EXPECT(make_transport_client()->read_batch(ops.data(), ops.size(), 1) == ErrorCode::OK);
+  BT_EXPECT(std::memcmp(region.data(), dst.data(), region.size()) == 0);
   server->stop();
 }
 

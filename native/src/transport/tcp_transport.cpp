@@ -10,12 +10,14 @@
 // transfer costs zero connection setups in steady state (the reference paid
 // one UCX endpoint creation per transfer, blackbird_client.cpp:162-188).
 #include <atomic>
+#include <cerrno>
 #include <cstring>
-#include <deque>
 #include <mutex>
 #include <random>
 #include <thread>
 #include <unordered_map>
+
+#include <poll.h>
 
 #include "btpu/common/log.h"
 #include "btpu/net/net.h"
@@ -291,9 +293,10 @@ class TcpEndpointPool {
 // Every request in a batch is issued before any response is awaited, one
 // pooled connection per in-flight sub-op. The server side processes the
 // requests concurrently (thread per connection) while the client drains
-// responses in issue order, so a batch costs ~one round trip of latency and
-// zero fan-out threads; ops wider than kChunkBytes are split so one huge
-// transfer also pipelines. One-sided reads and writes are idempotent, so a
+// whichever response polls ready first (a slow endpoint in a mixed batch
+// cannot head-of-line-block buffered responses), so a batch costs ~one
+// round trip of latency and zero fan-out threads; ops wider than
+// kChunkBytes are split so one huge transfer also pipelines. One-sided reads and writes are idempotent, so a
 // sub-op whose connection dies mid-flight (worker restarted, stale pooled
 // socket) is simply re-run once on a fresh connection.
 
@@ -391,7 +394,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     size_t sub;
     net::Socket sock;
   };
-  std::deque<Flight> inflight;
+  std::vector<Flight> inflight;
   DeadEndpoints dead;
   size_t next = 0;
   while (next < subs.size() || !inflight.empty()) {
@@ -426,8 +429,29 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
       ++next;
       continue;
     }
-    Flight flight = std::move(inflight.front());
-    inflight.pop_front();
+    // Collect whichever response is ready first — a slow endpoint in a
+    // mixed batch must not head-of-line-block responses already buffered
+    // on other sockets.
+    size_t pick = 0;
+    if (inflight.size() > 1) {
+      std::vector<pollfd> fds(inflight.size());
+      for (size_t i = 0; i < inflight.size(); ++i)
+        fds[i] = {inflight[i].sock.fd(), POLLIN, 0};
+      int rc;
+      do {
+        rc = ::poll(fds.data(), static_cast<nfds_t>(fds.size()), -1);
+      } while (rc < 0 && errno == EINTR);
+      if (rc > 0) {
+        for (size_t i = 0; i < fds.size(); ++i) {
+          if (fds[i].revents != 0) {  // ready, error, or invalid: collect it
+            pick = i;
+            break;
+          }
+        }
+      }
+    }
+    Flight flight = std::move(inflight[pick]);
+    inflight.erase(inflight.begin() + static_cast<ptrdiff_t>(pick));
     const SubOp& sub = subs[flight.sub];
     bool healthy = false;
     ErrorCode ec = collect_sub(flight.sock, sub, opcode, healthy);

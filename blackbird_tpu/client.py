@@ -170,6 +170,25 @@ class Client:
             check(codes[i], f"get {key!r}")
         return [buffers[i].raw[: out_sizes[i]] for i in range(n)]
 
+    def list(self, prefix: str = "", limit: int = 0) -> list[dict]:
+        """Complete objects whose key starts with `prefix`, lexicographic:
+        [{"key", "size", "copies", "soft_pin"}]. limit 0 = unlimited. No
+        reference counterpart — its object map was not enumerable."""
+        import json
+
+        size = ctypes.c_uint64()
+        check(lib.btpu_list_json(self._handle, prefix.encode(), limit, None, 0,
+                                 ctypes.byref(size)),
+              f"list {prefix!r}")
+        while True:
+            cap = max(size.value, 2)
+            buffer = ctypes.create_string_buffer(cap)
+            check(lib.btpu_list_json(self._handle, prefix.encode(), limit, buffer,
+                                     cap, ctypes.byref(size)),
+                  f"list {prefix!r}")
+            if size.value <= cap:  # else grew between calls (concurrent puts)
+                return json.loads(buffer.raw[: size.value].decode())
+
     def placements(self, key: str) -> list[dict]:
         """Where the object's bytes live: one dict per copy, with shards
         carrying worker/pool/storage-class/transport and the location

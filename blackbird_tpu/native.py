@@ -109,6 +109,8 @@ def _load() -> ctypes.CDLL:
         "btpu_register_hbm_provider_v3": (None, [ctypes.c_void_p]),
         "btpu_placements_json": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64,
                                        ctypes.POINTER(u64)]),
+        "btpu_list_json": (i32, [c, ctypes.c_char_p, u64, ctypes.c_char_p, u64,
+                                 ctypes.POINTER(u64)]),
         "btpu_put_ex": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
                               u32, ctypes.c_int64, i32]),
         "btpu_drain_worker": (i32, [c, ctypes.c_char_p, ctypes.POINTER(u64)]),

@@ -17,6 +17,7 @@ int usage() {
       "  get <key> [--out path]\n"
       "  exists <key>\n"
       "  remove <key>\n"
+      "  list [prefix] [--size LIMIT]\n"
       "  stats\n"
       "  drain <worker-id>       migrate every copy off a live worker, then retire it\n"
       "  ping\n");
@@ -107,6 +108,17 @@ int main(int argc, char** argv) {
     if (!moved.ok()) return fail(moved.error());
     std::printf("drained %s: %llu copies migrated\n", positional[1].c_str(),
                 (unsigned long long)moved.value());
+  } else if (command == "list") {
+    const std::string prefix = positional.size() > 1 ? positional[1] : "";
+    auto listed = client.list_objects(prefix, size);  // --size doubles as limit
+    if (!listed.ok()) return fail(listed.error());
+    for (const auto& obj : listed.value()) {
+      std::printf("%-48s %12llu B  x%u%s\n", obj.key.c_str(),
+                  (unsigned long long)obj.size, obj.complete_copies,
+                  obj.soft_pin ? "  pinned" : "");
+    }
+    std::printf("%zu objects%s\n", listed.value().size(), prefix.empty()
+                ? "" : (" with prefix " + prefix).c_str());
   } else if (command == "stats") {
     auto stats = client.cluster_stats();
     if (!stats.ok()) return fail(stats.error());

@@ -86,6 +86,12 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
  * off the live worker then retires it; out_moved = shards migrated. */
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved);
 
+/* Prefix listing of COMPLETE objects, lexicographic (limit 0 = unlimited):
+ * writes a JSON array [{"key","size","copies","soft_pin"}] into buffer.
+ * Same truncation contract as btpu_placements_json (NULL buffer sizes). */
+int32_t btpu_list_json(btpu_client* client, const char* prefix, uint64_t limit, char* buffer,
+                       uint64_t buffer_size, uint64_t* out_len);
+
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists);
 int32_t btpu_remove(btpu_client* client, const char* key);
 // out: [workers, pools, objects, capacity, used]

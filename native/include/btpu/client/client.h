@@ -89,6 +89,9 @@ class ObjectClient {
   Result<uint64_t> remove_all();
   // Graceful worker evacuation (keystone::drain_worker semantics).
   Result<uint64_t> drain_worker(const NodeId& worker_id);
+  // Prefix listing of complete objects, lexicographic, limit 0 = unlimited.
+  Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix,
+                                                  uint64_t limit = 0);
   Result<ClusterStats> cluster_stats();
   Result<ViewVersionId> ping();
 

@@ -246,6 +246,21 @@ struct GetClusterStatsResponse { ClusterStats stats; ErrorCode error_code{ErrorC
 struct GetViewVersionRequest {};
 struct GetViewVersionResponse { ViewVersionId view_version{0}; ErrorCode error_code{ErrorCode::OK}; };
 
+// Listing API (no reference counterpart — the reference object map is
+// enumerable only via logs; checkpoint/driver tooling needs prefix listing
+// to discover keys, keystone_service.h:84-322 offers none).
+struct ObjectSummary {
+  ObjectKey key;
+  uint64_t size{0};
+  uint32_t complete_copies{0};
+  bool soft_pin{false};
+};
+struct ListObjectsRequest { std::string prefix; uint64_t limit{0}; };  // 0 = unlimited
+struct ListObjectsResponse {
+  std::vector<ObjectSummary> objects;
+  ErrorCode error_code{ErrorCode::OK};
+};
+
 struct BatchObjectExistsRequest { std::vector<ObjectKey> keys; };
 struct BatchObjectExistsResponse {
   std::vector<Result<bool>> results;

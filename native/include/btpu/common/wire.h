@@ -327,6 +327,9 @@ BTPU_WIRE_EMPTY(GetClusterStatsRequest)
 BTPU_WIRE_STRUCT(GetClusterStatsResponse, f0, f1)
 BTPU_WIRE_EMPTY(GetViewVersionRequest)
 BTPU_WIRE_STRUCT(GetViewVersionResponse, f0, f1)
+BTPU_WIRE_STRUCT(ObjectSummary, f0, f1, f2, f3)
+BTPU_WIRE_STRUCT(ListObjectsRequest, f0, f1)
+BTPU_WIRE_STRUCT(ListObjectsResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchObjectExistsRequest, f0)
 BTPU_WIRE_STRUCT(BatchObjectExistsResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchGetWorkersRequest, f0)

@@ -119,6 +119,12 @@ class KeystoneService {
   std::vector<ErrorCode> batch_put_complete(const std::vector<ObjectKey>& keys);
   std::vector<ErrorCode> batch_put_cancel(const std::vector<ObjectKey>& keys);
 
+  // Prefix listing ("" = everything), lexicographically ordered, COMPLETE
+  // objects only (pending puts are invisible, like object placement reads).
+  // limit 0 = unlimited. A read: standbys serve it too.
+  Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix,
+                                                  uint64_t limit = 0) const;
+
   Result<ClusterStats> get_cluster_stats() const;
   // Allocator view with per-storage-class breakdowns (metrics exports the
   // same numbers tier-aware eviction keys off).

@@ -27,6 +27,7 @@ enum class Method : uint8_t {
   kBatchPutCancel = 14,
   kPing = 15,
   kDrainWorker = 16,
+  kListObjects = 17,
 };
 
 }  // namespace btpu::rpc

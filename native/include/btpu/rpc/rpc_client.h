@@ -27,6 +27,7 @@ class KeystoneRpcClient {
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
   Result<uint64_t> drain_worker(const NodeId& worker_id);
+  Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix, uint64_t limit);
   Result<ClusterStats> get_cluster_stats();
   Result<ViewVersionId> get_view_version();
   Result<ViewVersionId> ping();

@@ -277,6 +277,30 @@ int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* 
   return 0;
 }
 
+namespace {
+// JSON string escape. Bytes >= 0x80 are escaped as \u00xx too: keys are
+// arbitrary bytes (only "" and '\x01' are rejected at put time), and raw
+// non-UTF-8 bytes would make the whole JSON document undecodable on the
+// Python side because of one odd key.
+std::string json_escape(const std::string& s) {
+  std::string out;
+  char hex[8];
+  for (char c : s) {
+    const auto u = static_cast<unsigned char>(c);
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += c;
+    } else if (u < 0x20 || u >= 0x80) {
+      std::snprintf(hex, sizeof(hex), "\\u%04x", u);
+      out += hex;
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+}  // namespace
+
 int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
                              uint64_t buffer_size, uint64_t* out_len) {
   if (!client || !key || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
@@ -284,22 +308,7 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
   if (!placements.ok()) return static_cast<int32_t>(placements.error());
 
   std::string json = "[";
-  auto esc = [](const std::string& s) {
-    std::string out;
-    char hex[8];
-    for (char c : s) {
-      if (c == '"' || c == '\\') {
-        out += '\\';
-        out += c;
-      } else if (static_cast<unsigned char>(c) < 0x20) {
-        std::snprintf(hex, sizeof(hex), "\\u%04x", c);
-        out += hex;
-      } else {
-        out += c;
-      }
-    }
-    return out;
-  };
+  const auto& esc = json_escape;
   bool first_copy = true;
   for (const auto& copy : placements.value()) {
     if (!first_copy) json += ",";
@@ -331,6 +340,32 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
       json += "}";
     }
     json += "]}";
+  }
+  json += "]";
+
+  *out_len = json.size();
+  if (buffer && buffer_size > 0) {
+    const uint64_t n = std::min<uint64_t>(buffer_size, json.size());
+    std::memcpy(buffer, json.data(), n);
+  }
+  return 0;
+}
+
+int32_t btpu_list_json(btpu_client* client, const char* prefix, uint64_t limit, char* buffer,
+                       uint64_t buffer_size, uint64_t* out_len) {
+  if (!client || !prefix || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto listed = client->impl->list_objects(prefix, limit);
+  if (!listed.ok()) return static_cast<int32_t>(listed.error());
+
+  const auto& esc = json_escape;
+  std::string json = "[";
+  bool first = true;
+  for (const auto& obj : listed.value()) {
+    if (!first) json += ",";
+    first = false;
+    json += "{\"key\":\"" + esc(obj.key) + "\",\"size\":" + std::to_string(obj.size) +
+            ",\"copies\":" + std::to_string(obj.complete_copies) +
+            ",\"soft_pin\":" + (obj.soft_pin ? "true" : "false") + "}";
   }
   json += "]";
 

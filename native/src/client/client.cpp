@@ -181,6 +181,14 @@ Result<uint64_t> ObjectClient::drain_worker(const NodeId& worker_id) {
                       [&](rpc::KeystoneRpcClient& r) { return r.drain_worker(worker_id); });
 }
 
+Result<std::vector<ObjectSummary>> ObjectClient::list_objects(const std::string& prefix,
+                                                              uint64_t limit) {
+  if (embedded_) return embedded_->list_objects(prefix, limit);
+  return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) {
+    return r.list_objects(prefix, limit);
+  });
+}
+
 Result<ClusterStats> ObjectClient::cluster_stats() {
   if (embedded_) return embedded_->get_cluster_stats();
   return rpc_failover(/*idempotent=*/true,

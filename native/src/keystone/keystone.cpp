@@ -668,6 +668,34 @@ Result<bool> KeystoneService::object_exists(const ObjectKey& key) {
   return objects_.contains(key);
 }
 
+Result<std::vector<ObjectSummary>> KeystoneService::list_objects(const std::string& prefix,
+                                                                 uint64_t limit) const {
+  // With a limit, keep a bounded max-heap while scanning (the lexicographic
+  // FIRST `limit` keys win) so a tiny listing of a huge store is O(n log k)
+  // and never materializes every match.
+  const auto key_less = [](const ObjectSummary& a, const ObjectSummary& b) {
+    return a.key < b.key;
+  };
+  std::vector<ObjectSummary> out;
+  {
+    std::shared_lock lock(objects_mutex_);
+    for (const auto& [key, info] : objects_) {
+      if (info.state != ObjectState::kComplete) continue;
+      if (key.compare(0, prefix.size(), prefix) != 0) continue;
+      if (limit != 0 && out.size() == limit) {
+        if (key >= out.front().key) continue;  // heap max: not in the first k
+        std::pop_heap(out.begin(), out.end(), key_less);
+        out.pop_back();
+      }
+      out.push_back({key, info.size, static_cast<uint32_t>(info.copies.size()),
+                     info.soft_pin});
+      if (limit != 0) std::push_heap(out.begin(), out.end(), key_less);
+    }
+  }
+  std::sort(out.begin(), out.end(), key_less);
+  return out;
+}
+
 Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey& key) {
   std::unique_lock lock(objects_mutex_);  // touch mutates last_access
   auto it = objects_.find(key);

@@ -138,6 +138,15 @@ Result<uint64_t> KeystoneRpcClient::drain_worker(const NodeId& worker_id) {
   return resp.copies_migrated;
 }
 
+Result<std::vector<ObjectSummary>> KeystoneRpcClient::list_objects(const std::string& prefix,
+                                                                   uint64_t limit) {
+  ListObjectsResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kListObjects),
+                            ListObjectsRequest{prefix, limit}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.objects);
+}
+
 Result<ClusterStats> KeystoneRpcClient::get_cluster_stats() {
   GetClusterStatsResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kGetClusterStats),

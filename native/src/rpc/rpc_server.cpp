@@ -135,6 +135,13 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
     case Method::kGetViewVersion:
       return handle<GetViewVersionRequest, GetViewVersionResponse>(
           payload, [&](const auto&, auto& resp) { resp.view_version = ks.get_view_version(); });
+    case Method::kListObjects:
+      return handle<ListObjectsRequest, ListObjectsResponse>(
+          payload, [&](const auto& req, auto& resp) {
+            auto r = ks.list_objects(req.prefix, req.limit);
+            if (r.ok()) resp.objects = std::move(r).value();
+            resp.error_code = r.error();
+          });
     case Method::kBatchObjectExists:
       return handle<BatchObjectExistsRequest, BatchObjectExistsResponse>(
           payload,

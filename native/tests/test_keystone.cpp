@@ -114,6 +114,47 @@ BTEST(Keystone, PutLifecycleAndLookup) {
   BT_EXPECT_EQ(stats.value().total_memory_pools, 1ull);
 }
 
+BTEST(Keystone, ListObjectsPrefixOrderLimit) {
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 4 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  for (const char* key : {"ckpt/step1/b", "ckpt/step1/a", "ckpt/step2/a", "other/x"}) {
+    BT_ASSERT_OK(ks.put_start(key, 4096, cfg));
+    BT_EXPECT(ks.put_complete(key) == ErrorCode::OK);
+  }
+  // A pending (uncommitted) put is invisible to listing.
+  BT_ASSERT_OK(ks.put_start("ckpt/step1/pending", 4096, cfg));
+
+  auto all = ks.list_objects("");
+  BT_ASSERT_OK(all);
+  BT_EXPECT_EQ(all.value().size(), size_t{4});
+
+  auto step1 = ks.list_objects("ckpt/step1/");
+  BT_ASSERT_OK(step1);
+  BT_ASSERT(step1.value().size() == 2);
+  BT_EXPECT_EQ(step1.value()[0].key, "ckpt/step1/a");  // lexicographic
+  BT_EXPECT_EQ(step1.value()[1].key, "ckpt/step1/b");
+  BT_EXPECT_EQ(step1.value()[0].size, 4096ull);
+  BT_EXPECT_EQ(step1.value()[0].complete_copies, 1u);
+
+  auto limited = ks.list_objects("ckpt/", 2);
+  BT_ASSERT_OK(limited);
+  BT_EXPECT_EQ(limited.value().size(), size_t{2});
+  BT_EXPECT_EQ(limited.value()[0].key, "ckpt/step1/a");
+
+  BT_EXPECT(ks.list_objects("nope/").value().empty());
+
+  // Completing the pending put makes it appear.
+  BT_EXPECT(ks.put_complete("ckpt/step1/pending") == ErrorCode::OK);
+  BT_EXPECT_EQ(ks.list_objects("ckpt/step1/").value().size(), size_t{3});
+}
+
 BTEST(Keystone, ValidationAndDefaults) {
   auto cfg = fast_config();
   cfg.default_replicas = 2;

@@ -1,4 +1,4 @@
-// RPC layer tests: all 15 methods over real TCP, malformed frames, reconnect,
+// RPC layer tests: the full method surface over real TCP, malformed frames, reconnect,
 // and the live /metrics endpoint (the reference's was unimplemented).
 #include <cstring>
 
@@ -82,6 +82,14 @@ BTEST(Rpc, FullMethodSurfaceOverTcp) {
   auto ping = c.ping();
   BT_ASSERT_OK(ping);
   BT_EXPECT_EQ(ping.value(), view1.value());
+
+  auto listed = c.list_objects("rpc/", 0);
+  BT_ASSERT_OK(listed);
+  BT_ASSERT(listed.value().size() == 1);
+  BT_EXPECT_EQ(listed.value()[0].key, "rpc/obj");
+  BT_EXPECT_EQ(listed.value()[0].size, 4096ull);
+  BT_EXPECT_EQ(listed.value()[0].complete_copies, 1u);
+  BT_EXPECT(c.list_objects("zzz/", 0).value().empty());
 
   // Batches (values and per-item errors).
   auto bexists = c.batch_object_exists({"rpc/obj", "missing"});

@@ -138,6 +138,26 @@ def test_placements_introspection():
         assert len(workers) == 4  # copies spread over disjoint workers
 
 
+def test_list_objects_by_prefix():
+    with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        client.put("ls/a", b"x" * 1024)
+        client.put("ls/b", b"y" * 2048, replicas=2)
+        client.put("other/c", b"z" * 512)
+
+        everything = client.list()
+        assert {o["key"] for o in everything} == {"ls/a", "ls/b", "other/c"}
+
+        ls = client.list("ls/")
+        assert [o["key"] for o in ls] == ["ls/a", "ls/b"]  # lexicographic
+        assert ls[0]["size"] == 1024
+        assert ls[1]["copies"] == 2
+        assert ls[0]["soft_pin"] is False
+
+        assert client.list("ls/", limit=1) == [ls[0]]
+        assert client.list("nope/") == []
+
+
 def test_object_ttl_and_soft_pin():
     import time
 

@@ -216,15 +216,44 @@ def load_sharded(client, prefix: str, *, sharding=None):
     return jax.make_array_from_callback(global_shape, sharding, read_slice)
 
 
+def list_checkpoints(client, root: str = "") -> list[str]:
+    """Checkpoint prefixes under `root` (keys holding a readable meta).
+
+    Discovery for resume-after-preemption: a restarting trainer lists
+    `ckpt/` and picks its checkpoint without tracking keys externally
+    (uses the store's prefix listing, which the reference lacks). To pick
+    the LATEST step, parse the step number — lexicographic max() breaks
+    across digit-count boundaries ("step999" > "step1000") unless step
+    names are zero-padded."""
+    suffix = _META_SUFFIX
+    return [
+        obj["key"][: -len(suffix)]
+        for obj in client.list(root)
+        if obj["key"].endswith(suffix)
+    ]
+
+
 def remove_checkpoint(client, prefix: str) -> None:
-    """Deletes the metadata and every shard object of a checkpoint."""
+    """Deletes the metadata and every shard object of a checkpoint.
+
+    The meta goes FIRST: a removal interrupted halfway must not leave a
+    discoverable-but-unloadable checkpoint for `list_checkpoints` resume.
+    The shard sweep then unions the prefix listing (orphans from
+    interrupted saves, never listed in any meta) with the meta's own shard
+    list (shards stranded mid-put are PENDING and invisible to listing)."""
+    shard_keys = set()
     try:
         meta = json.loads(bytes(client.get(prefix + _META_SUFFIX)))
-    except Exception:  # noqa: BLE001 - missing/partial checkpoint
-        return
-    for shard_meta in meta.get("shards", []):
+        shard_keys.update(s["key"] for s in meta.get("shards", []))
+    except Exception:  # noqa: BLE001 - missing/unreadable meta (partial save)
+        pass
+    try:
+        client.remove(prefix + _META_SUFFIX)
+    except Exception:  # noqa: BLE001 - already gone
+        pass
+    shard_keys.update(obj["key"] for obj in client.list(prefix + _SHARD_SUFFIX))
+    for key in shard_keys:
         try:
-            client.remove(shard_meta["key"])
-        except Exception:  # noqa: BLE001
+            client.remove(key)
+        except Exception:  # noqa: BLE001 - lost race / already gone
             pass
-    client.remove(prefix + _META_SUFFIX)

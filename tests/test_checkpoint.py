@@ -76,10 +76,30 @@ def test_remove_checkpoint_cleans_all_objects(store):
     save_sharded(store, "ckpt/tmp", arr)
     assert store.exists("ckpt/tmp/meta")
     keys = _shard_keys(store, "ckpt/tmp")
+    # An orphan from an interrupted save: written, listed in no meta.
+    store.put("ckpt/tmp/shard/999-1000", b"orphan")
     remove_checkpoint(store, "ckpt/tmp")
     assert not store.exists("ckpt/tmp/meta")
     for key in keys:
         assert not store.exists(key)
+    assert not store.exists("ckpt/tmp/shard/999-1000")
+
+
+def test_list_checkpoints_discovers_prefixes(store):
+    from blackbird_tpu.checkpoint import list_checkpoints
+
+    mesh = make_mesh(8)
+    arr = jax.device_put(np.zeros(64, dtype=np.float32), NamedSharding(mesh, P()))
+    save_sharded(store, "ckpt/step999", arr)
+    save_sharded(store, "ckpt/step1000", arr)
+    save_sharded(store, "other/x", arr)
+    assert list_checkpoints(store, "ckpt/") == ["ckpt/step1000", "ckpt/step999"]
+    assert sorted(list_checkpoints(store)) == ["ckpt/step1000", "ckpt/step999", "other/x"]
+    # Resume pattern: latest step by PARSED step number (lexicographic max
+    # would wrongly pick step999 over step1000).
+    latest = max(list_checkpoints(store, "ckpt/"),
+                 key=lambda p: int(p.rsplit("step", 1)[1]))
+    assert latest == "ckpt/step1000"
 
 
 def test_int_dtypes_and_odd_shapes(store):

@@ -310,6 +310,12 @@ struct KeystoneConfig {
   int64_t service_refresh_interval_sec{30};
   int64_t gc_interval_sec{30};
   int64_t health_check_interval_sec{10};
+  // Reclaim puts stuck in the pending state (client crashed between
+  // put_start and put_complete/cancel) after this long; 0 disables. Plays
+  // the role of the reference's 10-min backend reservation-token expiry
+  // (ram_backend.cpp:69) at the control plane, where the allocation
+  // actually lives here.
+  int64_t pending_put_timeout_sec{900};
 
   int32_t max_replicas{3};
   int32_t default_replicas{1};

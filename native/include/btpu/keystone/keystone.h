@@ -81,6 +81,7 @@ struct KeystoneCounters {
   std::atomic<uint64_t> gets{0};
   std::atomic<uint64_t> removes{0};
   std::atomic<uint64_t> gc_collected{0};
+  std::atomic<uint64_t> pending_reclaimed{0};  // abandoned mid-put reservations
   std::atomic<uint64_t> evicted{0};
   std::atomic<uint64_t> objects_demoted{0};
   std::atomic<uint64_t> workers_lost{0};

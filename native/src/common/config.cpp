@@ -332,6 +332,8 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
   if (auto n = root.get("gc_interval_sec")) cfg.gc_interval_sec = n->int_or(cfg.gc_interval_sec);
   if (auto n = root.get("health_check_interval_sec"))
     cfg.health_check_interval_sec = n->int_or(cfg.health_check_interval_sec);
+  if (auto n = root.get("pending_put_timeout_sec"))
+    cfg.pending_put_timeout_sec = n->int_or(cfg.pending_put_timeout_sec);
   if (auto n = root.get("max_replicas")) cfg.max_replicas = static_cast<int32_t>(n->int_or(cfg.max_replicas));
   if (auto n = root.get("default_replicas"))
     cfg.default_replicas = static_cast<int32_t>(n->int_or(cfg.default_replicas));

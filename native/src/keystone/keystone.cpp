@@ -635,23 +635,50 @@ void KeystoneService::keepalive_loop() {
 void KeystoneService::run_gc_once() {
   if (!is_leader_.load()) return;  // the leader owns the object lifecycle
   const auto now = std::chrono::steady_clock::now();
+  // A put stuck in kPending longer than the timeout means the client died
+  // between put_start and put_complete/cancel: its reservation would leak
+  // forever (the reference bounded this with backend reservation-token
+  // expiry; here the allocation lives at the control plane). One-sided
+  // writes carry no progress signal, so a still-alive slow writer is
+  // indistinguishable from a dead one — the deadline therefore also scales
+  // with object size at a deliberately pessimistic 1 MiB/s floor, giving a
+  // large transfer proportionally more grace before its ranges can be
+  // reclaimed (and handed to someone else) under a live writer.
+  constexpr uint64_t kMinPutBytesPerMs = 1048;  // ~1 MiB/s worst-case floor
+  auto pending_stale = [&](const ObjectInfo& info,
+                           std::chrono::steady_clock::time_point at) {
+    if (config_.pending_put_timeout_sec <= 0 || info.state != ObjectState::kPending)
+      return false;
+    const auto deadline =
+        std::chrono::seconds(config_.pending_put_timeout_sec) +
+        std::chrono::milliseconds(info.size / kMinPutBytesPerMs);
+    return at >= info.created_at + deadline;
+  };
   std::vector<ObjectKey> expired;
   {
     std::shared_lock lock(objects_mutex_);
     for (const auto& [key, info] : objects_) {
-      if (info.expired(now)) expired.push_back(key);
+      if (info.expired(now) || pending_stale(info, now)) expired.push_back(key);
     }
   }
   for (const auto& key : expired) {
     std::unique_lock lock(objects_mutex_);
     auto it = objects_.find(key);
-    if (it == objects_.end() || !it->second.expired(std::chrono::steady_clock::now())) continue;
+    if (it == objects_.end()) continue;
+    const auto recheck = std::chrono::steady_clock::now();
+    const bool stale_pending = pending_stale(it->second, recheck);
+    if (!it->second.expired(recheck) && !stale_pending) continue;
     free_object_locked(key, it->second);
     objects_.erase(it);
-    ++counters_.gc_collected;
+    if (stale_pending) {
+      ++counters_.pending_reclaimed;
+      LOG_WARN << "gc reclaimed abandoned pending put " << key;
+    } else {
+      ++counters_.gc_collected;
+      LOG_DEBUG << "gc collected expired object " << key;
+    }
     unpersist_object(key);
     bump_view();
-    LOG_DEBUG << "gc collected expired object " << key;
   }
 }
 

@@ -52,6 +52,8 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_gets_total", "get_workers calls", c.gets.load());
   counter("btpu_removes_total", "remove_object calls", c.removes.load());
   counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());
+  counter("btpu_pending_reclaimed_total", "abandoned mid-put reservations reclaimed",
+          c.pending_reclaimed.load());
   counter("btpu_evicted_total", "objects evicted for watermark pressure", c.evicted.load());
   counter("btpu_objects_demoted_total", "objects moved down the tier ladder under pressure",
           c.objects_demoted.load());

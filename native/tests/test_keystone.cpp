@@ -114,6 +114,40 @@ BTEST(Keystone, PutLifecycleAndLookup) {
   BT_EXPECT_EQ(stats.value().total_memory_pools, 1ull);
 }
 
+BTEST(Keystone, GcReclaimsAbandonedPendingPuts) {
+  // A client that dies between put_start and put_complete/cancel must not
+  // leak its reservation forever (the reference bounded this with backend
+  // reservation-token expiry; here allocations live at the control plane).
+  auto cfg = fast_config();
+  cfg.pending_put_timeout_sec = 1;
+  // The fake worker sends no heartbeats; keep the stale-worker reaper from
+  // removing its pool while this test waits out the pending timeout.
+  cfg.worker_heartbeat_ttl_sec = 3600;
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  BT_ASSERT(ks.start() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  BT_ASSERT_OK(ks.put_start("dead-client/obj", 256 * 1024, wc));
+  BT_EXPECT(eventually([&] {
+    return !ks.object_exists("dead-client/obj").value() &&
+           ks.get_cluster_stats().value().used_capacity == 0;
+  }, 5000));
+
+  // The reclaimed space is allocatable again. put_start succeeding proves
+  // the ranges were freed; deliberately no put_complete assert — GC could
+  // legitimately reclaim this pending put too if the test thread stalls
+  // past the (deliberately tiny) timeout.
+  BT_ASSERT_OK(ks.put_start("fresh/obj", 900 * 1024, wc));
+  ks.put_cancel("fresh/obj");
+  ks.stop();
+}
+
 BTEST(Keystone, ListObjectsPrefixOrderLimit) {
   KeystoneService ks(fast_config(), nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);

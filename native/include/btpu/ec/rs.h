@@ -1,0 +1,41 @@
+// Systematic Reed-Solomon erasure coding over GF(2^8).
+//
+// An object is split into k equal data shards; m parity shards are computed
+// such that ANY k of the k+m shards reconstruct the data (tolerates any m
+// losses). Systematic: the k data shards are stored verbatim, so reads that
+// find all data shards never pay a decode.
+//
+// No reference counterpart — blackbird only replicates (WorkerConfig
+// .replication_factor, types.h:161); EC gives the same worker-loss
+// tolerance at (k+m)/k storage overhead instead of (1+m)x. Parity rows use
+// a Cauchy matrix (every square submatrix of a Cauchy matrix is invertible,
+// which is exactly the any-k-of-n property).
+//
+// Limits: 1 <= k, 1 <= m, k + m <= 128 (x_j = k+j and y_i = i must be
+// distinct elements of GF(256) with x_j != y_i).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace btpu::ec {
+
+inline constexpr size_t kMaxTotalShards = 128;
+
+// parity[j][0..len) = sum_i C(j,i) * data[i][0..len)  (GF(256) arithmetic).
+// data: k pointers, parity: m pointers, all buffers len bytes. Returns
+// false (parity untouched) when the geometry is out of range.
+bool rs_encode(const uint8_t* const* data, size_t k, uint8_t* const* parity, size_t m,
+               size_t len);
+
+// Reconstructs missing DATA shards from any k present shards.
+//   present[i] for i in [0, k+m): shard i's bytes, or nullptr if lost.
+//   out[i]: for each i < k with present[i] == nullptr, a len-byte buffer
+//           that receives the reconstructed shard (ignored otherwise).
+// Returns false when fewer than k shards are present (or parameters are out
+// of range). Missing PARITY shards are not rebuilt here; re-encode from the
+// (now complete) data instead.
+bool rs_reconstruct(const uint8_t* const* present, size_t k, size_t m, size_t len,
+                    uint8_t* const* out);
+
+}  // namespace btpu::ec

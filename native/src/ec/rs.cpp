@@ -1,0 +1,162 @@
+// Reed-Solomon over GF(2^8) with the 0x11d primitive polynomial.
+//
+// Shard i (data) is row i of the identity; parity row j is the Cauchy row
+// C(j,i) = 1 / (x_j ^ y_i) with x_j = k + j, y_i = i. Any k rows of
+// [I; C] form an invertible matrix (Cauchy property), so any k surviving
+// shards determine the data. Reconstruction builds that k x k matrix from
+// the surviving rows, inverts it with Gauss-Jordan over GF(256), and
+// multiplies only the rows needed for the missing data shards.
+#include "btpu/ec/rs.h"
+
+#include <array>
+#include <cstring>
+#include <vector>
+
+namespace btpu::ec {
+
+namespace {
+
+// ---- GF(256) tables --------------------------------------------------------
+
+struct GfTables {
+  std::array<uint8_t, 256> log{};
+  std::array<uint8_t, 512> exp{};  // doubled so mul skips a mod
+
+  GfTables() {
+    uint16_t x = 1;
+    for (int i = 0; i < 255; ++i) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = static_cast<uint8_t>(i);
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11d;
+    }
+    for (int i = 255; i < 512; ++i) exp[i] = exp[i - 255];
+  }
+};
+
+const GfTables& gf() {
+  static const GfTables tables;
+  return tables;
+}
+
+inline uint8_t gf_mul(uint8_t a, uint8_t b) {
+  if (a == 0 || b == 0) return 0;
+  const auto& t = gf();
+  return t.exp[t.log[a] + t.log[b]];
+}
+
+inline uint8_t gf_inv(uint8_t a) {
+  const auto& t = gf();
+  return t.exp[255 - t.log[a]];
+}
+
+// dst[0..len) ^= c * src[0..len). The hot loop: one 256-byte row of the
+// multiplication table, applied byte-wise (table lookup + xor).
+void gf_mul_add(uint8_t* dst, const uint8_t* src, uint8_t c, size_t len) {
+  if (c == 0) return;
+  if (c == 1) {
+    for (size_t i = 0; i < len; ++i) dst[i] ^= src[i];
+    return;
+  }
+  const auto& t = gf();
+  const uint8_t lc = t.log[c];
+  uint8_t row[256];
+  row[0] = 0;
+  for (int v = 1; v < 256; ++v) row[v] = t.exp[lc + t.log[v]];
+  for (size_t i = 0; i < len; ++i) dst[i] ^= row[src[i]];
+}
+
+// Cauchy coefficient for parity row j, data column i.
+inline uint8_t cauchy(size_t j, size_t k, size_t i) {
+  return gf_inv(static_cast<uint8_t>((k + j) ^ i));
+}
+
+// Gauss-Jordan inversion of an n x n matrix over GF(256). Returns false on
+// a singular matrix (cannot happen for rows of [I; Cauchy], kept anyway).
+bool gf_invert(std::vector<uint8_t>& a, size_t n) {
+  std::vector<uint8_t> inv(n * n, 0);
+  for (size_t i = 0; i < n; ++i) inv[i * n + i] = 1;
+  for (size_t col = 0; col < n; ++col) {
+    size_t pivot = col;
+    while (pivot < n && a[pivot * n + col] == 0) ++pivot;
+    if (pivot == n) return false;
+    if (pivot != col) {
+      for (size_t x = 0; x < n; ++x) {
+        std::swap(a[pivot * n + x], a[col * n + x]);
+        std::swap(inv[pivot * n + x], inv[col * n + x]);
+      }
+    }
+    const uint8_t scale = gf_inv(a[col * n + col]);
+    for (size_t x = 0; x < n; ++x) {
+      a[col * n + x] = gf_mul(a[col * n + x], scale);
+      inv[col * n + x] = gf_mul(inv[col * n + x], scale);
+    }
+    for (size_t row = 0; row < n; ++row) {
+      if (row == col) continue;
+      const uint8_t c = a[row * n + col];
+      if (c == 0) continue;
+      for (size_t x = 0; x < n; ++x) {
+        a[row * n + x] ^= gf_mul(c, a[col * n + x]);
+        inv[row * n + x] ^= gf_mul(c, inv[col * n + x]);
+      }
+    }
+  }
+  a.swap(inv);
+  return true;
+}
+
+}  // namespace
+
+bool rs_encode(const uint8_t* const* data, size_t k, uint8_t* const* parity, size_t m,
+               size_t len) {
+  // Same geometry limits as rs_reconstruct: past them the uint8_t Cauchy
+  // coordinates collide and the parity would be silently unrecoverable.
+  if (k == 0 || m == 0 || k + m > kMaxTotalShards) return false;
+  for (size_t j = 0; j < m; ++j) {
+    std::memset(parity[j], 0, len);
+    for (size_t i = 0; i < k; ++i) gf_mul_add(parity[j], data[i], cauchy(j, k, i), len);
+  }
+  return true;
+}
+
+bool rs_reconstruct(const uint8_t* const* present, size_t k, size_t m, size_t len,
+                    uint8_t* const* out) {
+  if (k == 0 || m == 0 || k + m > kMaxTotalShards) return false;
+
+  // Fast path: every data shard survives — nothing to solve (parity-only
+  // losses are re-encoded by the caller, not reconstructed here).
+  bool data_missing = false;
+  for (size_t i = 0; i < k && !data_missing; ++i) data_missing = present[i] == nullptr;
+  if (!data_missing) return true;
+
+  // Pick the first k present shards as the solving basis.
+  std::vector<size_t> basis;
+  basis.reserve(k);
+  for (size_t i = 0; i < k + m && basis.size() < k; ++i) {
+    if (present[i]) basis.push_back(i);
+  }
+  if (basis.size() < k) return false;
+
+  // Rows of [I; C] for the basis shards: basis_matrix * data = basis_bytes.
+  std::vector<uint8_t> matrix(k * k, 0);
+  for (size_t r = 0; r < k; ++r) {
+    const size_t shard = basis[r];
+    if (shard < k) {
+      matrix[r * k + shard] = 1;
+    } else {
+      for (size_t i = 0; i < k; ++i) matrix[r * k + i] = cauchy(shard - k, k, i);
+    }
+  }
+  if (!gf_invert(matrix, k)) return false;
+
+  // data[i] = sum_r inv[i][r] * basis_bytes[r]; only missing rows are built.
+  for (size_t i = 0; i < k; ++i) {
+    if (present[i]) continue;
+    std::memset(out[i], 0, len);
+    for (size_t r = 0; r < k; ++r)
+      gf_mul_add(out[i], present[basis[r]], matrix[i * k + r], len);
+  }
+  return true;
+}
+
+}  // namespace btpu::ec

@@ -1,0 +1,100 @@
+// Reed-Solomon codec tests: encode/reconstruct under every loss pattern the
+// geometry tolerates, plus rejection past the tolerance. (No reference
+// counterpart — blackbird only replicates.)
+#include <cstring>
+#include <random>
+#include <vector>
+
+#include "btest.h"
+#include "btpu/ec/rs.h"
+
+using namespace btpu;
+
+namespace {
+
+struct Coded {
+  size_t k, m, len;
+  std::vector<std::vector<uint8_t>> shards;  // k data then m parity
+
+  Coded(size_t k_, size_t m_, size_t len_, uint64_t seed) : k(k_), m(m_), len(len_) {
+    std::mt19937_64 rng(seed);
+    shards.assign(k + m, std::vector<uint8_t>(len));
+    for (size_t i = 0; i < k; ++i)
+      for (auto& b : shards[i]) b = static_cast<uint8_t>(rng());
+    std::vector<const uint8_t*> data;
+    std::vector<uint8_t*> parity;
+    for (size_t i = 0; i < k; ++i) data.push_back(shards[i].data());
+    for (size_t j = 0; j < m; ++j) parity.push_back(shards[k + j].data());
+    encode_ok = ec::rs_encode(data.data(), k, parity.data(), m, len);
+  }
+  bool encode_ok{false};
+
+  // Reconstructs with `lost` shard indices removed; returns true when every
+  // lost DATA shard came back byte-identical.
+  bool recovers(const std::vector<size_t>& lost) {
+    std::vector<const uint8_t*> present;
+    for (size_t i = 0; i < k + m; ++i) present.push_back(shards[i].data());
+    for (size_t i : lost) present[i] = nullptr;
+    std::vector<std::vector<uint8_t>> rebuilt(k, std::vector<uint8_t>(len, 0xEE));
+    std::vector<uint8_t*> out;
+    for (size_t i = 0; i < k; ++i) out.push_back(rebuilt[i].data());
+    if (!ec::rs_reconstruct(present.data(), k, m, len, out.data())) return false;
+    for (size_t i : lost) {
+      if (i >= k) continue;  // parity: not rebuilt by rs_reconstruct
+      if (std::memcmp(rebuilt[i].data(), shards[i].data(), len) != 0) return false;
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+BTEST(Ec, EveryDoubleLossPatternRecovers) {
+  // k=4, m=2: any 2 of 6 shards may vanish.
+  Coded c(4, 2, 4096, 42);
+  for (size_t a = 0; a < 6; ++a) {
+    for (size_t b = a + 1; b < 6; ++b) {
+      BT_EXPECT(c.recovers({a, b}));
+    }
+  }
+  BT_EXPECT(c.recovers({}));   // nothing lost
+  BT_EXPECT(c.recovers({3}));  // single data loss
+  BT_EXPECT(c.recovers({5}));  // single parity loss (no-op for data)
+}
+
+BTEST(Ec, LossBeyondToleranceIsRejected) {
+  Coded c(4, 2, 512, 7);
+  BT_EXPECT(!c.recovers({0, 1, 2}));  // 3 lost > m=2
+  // Degenerate parameters.
+  const uint8_t* none[2] = {nullptr, nullptr};
+  uint8_t* out[1] = {nullptr};
+  BT_EXPECT(!ec::rs_reconstruct(none, 1, 0, 8, out));      // m == 0
+  BT_EXPECT(!ec::rs_reconstruct(none, 0, 1, 8, out));      // k == 0
+  // Encode rejects out-of-range geometry instead of emitting bad parity.
+  Coded big(100, 28, 64, 1);
+  BT_EXPECT(big.encode_ok);
+  Coded toobig(100, 29, 64, 1);  // k + m = 129 > 128
+  BT_EXPECT(!toobig.encode_ok);
+}
+
+BTEST(Ec, WideGeometriesAndOddLengths) {
+  // k=10, m=4 at a non-power-of-two length; knock out 4 data shards.
+  Coded wide(10, 4, 1000, 99);
+  BT_EXPECT(wide.recovers({0, 3, 7, 9}));
+  BT_EXPECT(wide.recovers({10, 11, 12, 13}));  // all parity lost: data intact
+  BT_EXPECT(wide.recovers({0, 11, 5, 13}));    // mixed data+parity loss
+  // k=1, m=2 degenerates to replication-by-parity (parity == data).
+  Coded mirror(1, 2, 256, 5);
+  BT_EXPECT(mirror.recovers({0}));
+  BT_EXPECT(mirror.recovers({0, 1}));
+  // Parity of a k=1 code is the data itself (Cauchy row is a scalar, and
+  // reconstruction must still invert it correctly).
+}
+
+BTEST(Ec, EncodeIsDeterministicAndSystematic) {
+  Coded a(3, 2, 2048, 1), b(3, 2, 2048, 1);
+  for (size_t i = 0; i < 5; ++i) BT_EXPECT(a.shards[i] == b.shards[i]);
+  // Systematic: data shards are the original bytes (stored verbatim) — by
+  // construction here, but assert parity differs from data (a real code).
+  BT_EXPECT(a.shards[3] != a.shards[0]);
+}

@@ -44,12 +44,16 @@ class Client:
         preferred_class: StorageClass | None = None,
         ttl_ms: int | None = None,
         soft_pin: bool = False,
+        ec: tuple[int, int] | None = None,
     ) -> None:
         """ttl_ms: None = the framework default (30 min), 0 = never
         expires, >0 = the GC collects the object that long after CREATION
         (a fixed deadline, not a sliding window — reads do not extend it).
         soft_pin exempts the object from watermark eviction (demotion
-        still applies)."""
+        still applies). ec=(k, m) stores ONE Reed-Solomon coded copy of k
+        data + m parity shards instead of replicas: any m worker losses
+        tolerated at (k+m)/k storage overhead (e.g. ec=(4, 2) survives two
+        losses at 1.5x, where replicas=3 costs 3x)."""
         if ttl_ms is not None and ttl_ms < 0:
             raise ValueError(f"ttl_ms must be >= 0, got {ttl_ms}")
         if isinstance(data, np.ndarray):
@@ -60,6 +64,25 @@ class Client:
             data = bytes(data)  # zero-copy: put never mutates the buffer
             buf = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
             size = len(data)
+        if ec is not None:
+            k, m = ec
+            if k < 1 or m < 1:
+                raise ValueError(f"ec needs k >= 1 and m >= 1, got {ec}")
+            check(
+                lib.btpu_put_ec(
+                    self._handle,
+                    key.encode(),
+                    buf,
+                    size,
+                    k,
+                    m,
+                    int(preferred_class) if preferred_class else 0,
+                    -1 if ttl_ms is None else ttl_ms,
+                    1 if soft_pin else 0,
+                ),
+                f"put {key!r}",
+            )
+            return
         check(
             lib.btpu_put_ex(
                 self._handle,

@@ -14,6 +14,7 @@ int usage() {
   std::printf(
       "usage: bb-client --keystone host:port <command> [args]\n"
       "  put <key> (--file path | --size N) [--replicas R] [--max-workers W]\n"
+      "      [--ec K,M]            Reed-Solomon: K data + M parity shards\n"
       "  get <key> [--out path]\n"
       "  exists <key>\n"
       "  remove <key>\n"
@@ -42,6 +43,14 @@ int main(int argc, char** argv) {
       wc.replication_factor = std::stoul(argv[++i]);
     else if (!std::strcmp(argv[i], "--max-workers") && i + 1 < argc)
       wc.max_workers_per_copy = std::stoul(argv[++i]);
+    else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
+      // K,M: Reed-Solomon k data + m parity shards (replaces --replicas).
+      const std::string km = argv[++i];
+      const size_t comma = km.find(',');
+      if (comma == std::string::npos) return usage();
+      wc.ec_data_shards = std::stoul(km.substr(0, comma));
+      wc.ec_parity_shards = std::stoul(km.substr(comma + 1));
+    }
     else if (!std::strcmp(argv[i], "--help")) return usage();
     else positional.push_back(argv[i]);
   }
@@ -81,8 +90,13 @@ int main(int argc, char** argv) {
     }
     if (auto ec = client.put(key, data.data(), data.size(), wc); ec != ErrorCode::OK)
       return fail(ec);
-    std::printf("put %s (%zu bytes, %zu replicas)\n", key.c_str(), data.size(),
-                wc.replication_factor);
+    if (wc.ec_parity_shards > 0) {
+      std::printf("put %s (%zu bytes, rs(%zu,%zu))\n", key.c_str(), data.size(),
+                  wc.ec_data_shards, wc.ec_parity_shards);
+    } else {
+      std::printf("put %s (%zu bytes, %zu replicas)\n", key.c_str(), data.size(),
+                  wc.replication_factor);
+    }
   } else if (command == "get") {
     auto data = client.get(key);
     if (!data.ok()) return fail(data.error());

@@ -61,6 +61,14 @@ struct AllocationRequest {
   bool prefer_contiguous{false};
   uint64_t min_shard_size{256 * 1024};  // see WorkerConfig::min_shard_size
 
+  // Erasure coding: when ec_parity_shards > 0, allocate ONE coded copy of
+  // exactly (ec_data_shards + ec_parity_shards) equal shards of
+  // ceil(data_size / ec_data_shards) bytes, round-robin across candidate
+  // pools (anti-affine when the pool count allows). replication_factor,
+  // striping, and min_shard_size do not apply.
+  size_t ec_data_shards{0};
+  size_t ec_parity_shards{0};
+
   // TPU extension: slice affinity. >=0 ranks same-slice pools first so
   // copies ride ICI; cross-slice (DCN) pools are used only as spillover.
   int32_t preferred_slice{-1};

@@ -57,6 +57,8 @@ class KeystoneAllocatorAdapter {
     req.prefer_contiguous = config.prefer_contiguous;
     req.min_shard_size = config.min_shard_size;
     req.preferred_slice = config.preferred_slice;
+    req.ec_data_shards = config.ec_data_shards;
+    req.ec_parity_shards = config.ec_parity_shards;
     return req;
   }
 

@@ -68,6 +68,9 @@ class RangeAllocator : public IAllocator {
   // on it and over-commits pools, range_allocator.cpp:449), else the
   // registry's.
   uint64_t avail_of(const MemoryPoolId& id, const MemoryPool& pool) const;
+  Result<AllocationResult> allocate_ec(const AllocationRequest& request,
+                                       const std::vector<MemoryPoolId>& candidates,
+                                       const PoolMap& pools);
   Result<AllocationResult> allocate_with_striping(const AllocationRequest& request,
                                                   const std::vector<MemoryPoolId>& candidates,
                                                   const PoolMap& pools);

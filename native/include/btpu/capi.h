@@ -86,6 +86,13 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
  * off the live worker then retires it; out_moved = shards migrated. */
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved);
 
+/* Erasure-coded put: ec_data (k) + ec_parity (m) Reed-Solomon shards, any m
+ * losses tolerated at (k+m)/k storage overhead (replication_factor does not
+ * apply — one coded copy). ttl_ms < 0 keeps the default TTL. */
+int32_t btpu_put_ec(btpu_client* client, const char* key, const void* data, uint64_t size,
+                    uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
+                    int64_t ttl_ms, int32_t soft_pin);
+
 /* Prefix listing of COMPLETE objects, lexicographic (limit 0 = unlimited):
  * writes a JSON array [{"key","size","copies","soft_pin"}] into buffer.
  * Same truncation contract as btpu_placements_json (NULL buffer sizes). */

@@ -113,6 +113,8 @@ class ObjectClient {
   ErrorCode transfer_copy_put(const CopyPlacement& copy, const uint8_t* data, uint64_t size);
   ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);
   // Shared body: device shards as one provider batch, wire shards in parallel.
+  ErrorCode transfer_copy_ec(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                             bool is_write);
   ErrorCode transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
                           bool is_write);
   ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);

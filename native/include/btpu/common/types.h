@@ -154,8 +154,26 @@ struct ShardPlacement {
 struct CopyPlacement {
   uint32_t copy_index{0};
   std::vector<ShardPlacement> shards;
+  // Erasure geometry; 0,0 = plain replicated/striped copy. When
+  // ec_data_shards = k > 0: the first k shards hold the object bytes
+  // (k equal shards of ceil(size/k), the last zero-padded), the remaining
+  // ec_parity_shards are Reed-Solomon parity (btpu/ec/rs.h), and
+  // ec_object_size is the logical size (shard lengths sum past it by the
+  // padding + parity).
+  uint32_t ec_data_shards{0};
+  uint32_t ec_parity_shards{0};
+  uint64_t ec_object_size{0};
   size_t shards_size() const noexcept { return shards.size(); }
 };
+
+// Logical object bytes held by one copy (EC-aware; replicated copies are
+// the sum of their shard lengths).
+inline uint64_t copy_logical_size(const CopyPlacement& c) {
+  if (c.ec_data_shards > 0) return c.ec_object_size;
+  uint64_t total = 0;
+  for (const auto& s : c.shards) total += s.length;
+  return total;
+}
 
 // -------------------------------------------------------------------------
 // Placement policy per object (reference WorkerConfig, types.h:161-189)
@@ -178,6 +196,13 @@ struct WorkerConfig {
   // TPU extension: when set, placement prefers pools on this slice and only
   // spills across slices (DCN) when the slice cannot hold the object.
   int32_t preferred_slice{-1};
+  // Erasure coding (no reference counterpart — it only replicates): when
+  // ec_parity_shards > 0 the object is stored as ONE coded copy of
+  // ec_data_shards data + ec_parity_shards parity shards (any
+  // ec_parity_shards losses tolerated at (k+m)/k storage overhead);
+  // replication_factor is ignored.
+  size_t ec_data_shards{0};
+  size_t ec_parity_shards{0};
 };
 
 struct ClusterStats {

@@ -210,24 +210,34 @@ inline bool decode(Reader& r, ShardPlacement& s) {
   return decode_fields(r, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
 }
 
-inline void encode(Writer& w, const CopyPlacement& c) { encode_fields(w, c.copy_index, c.shards); }
-inline bool decode(Reader& r, CopyPlacement& c) { return decode_fields(r, c.copy_index, c.shards); }
+inline void encode(Writer& w, const CopyPlacement& c) {
+  encode_fields(w, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
+                c.ec_object_size);
+}
+inline bool decode(Reader& r, CopyPlacement& c) {
+  return decode_fields(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
+                       c.ec_object_size);
+}
 
 inline void encode(Writer& w, const WorkerConfig& c) {
   encode_fields(w, static_cast<uint64_t>(c.replication_factor),
                 static_cast<uint64_t>(c.max_workers_per_copy), c.enable_soft_pin,
                 c.preferred_node, c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
-                c.prefer_contiguous, static_cast<uint64_t>(c.min_shard_size), c.preferred_slice);
+                c.prefer_contiguous, static_cast<uint64_t>(c.min_shard_size), c.preferred_slice,
+                static_cast<uint64_t>(c.ec_data_shards),
+                static_cast<uint64_t>(c.ec_parity_shards));
 }
 inline bool decode(Reader& r, WorkerConfig& c) {
-  uint64_t rf = 0, mw = 0, ms = 0;
+  uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
   if (!decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
                      c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
-                     c.preferred_slice))
+                     c.preferred_slice, eck, ecm))
     return false;
   c.replication_factor = rf;
   c.max_workers_per_copy = mw;
   c.min_shard_size = ms;
+  c.ec_data_shards = eck;
+  c.ec_parity_shards = ecm;
   return true;
 }
 

@@ -2,8 +2,10 @@
 
 #include <algorithm>
 #include <numeric>
+#include <unordered_set>
 
 #include "btpu/common/log.h"
+#include "btpu/ec/rs.h"
 
 namespace btpu::alloc {
 
@@ -46,8 +48,15 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
            request.preferred_classes.end();
   };
 
+  const bool is_ec = request.ec_parity_shards > 0 && request.ec_data_shards > 0;
   std::vector<MemoryPoolId> preferred, fallback;
   for (const auto& [id, pool] : pools) {
+    // Coded shards have a wire-only client path: device-tier pools must not
+    // consume selection slots (allocate_ec would drop them afterward and
+    // overload the rest past what the capacity check vetted).
+    if (is_ec && (pool.remote.transport == TransportKind::HBM ||
+                  pool.remote.transport == TransportKind::ICI))
+      continue;
     if (!request.preferred_node.empty() && pool.node_id != request.preferred_node) continue;
     if (std::find(request.excluded_nodes.begin(), request.excluded_nodes.end(),
                   pool.node_id) != request.excluded_nodes.end())
@@ -81,12 +90,24 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
   rank(preferred);
   rank(fallback);
 
-  const uint64_t total_bytes = request.data_size * request.replication_factor;
-  const size_t want = request.max_workers_per_copy * request.replication_factor;
+  // EC copies need (k+m) * ceil(size/k) bytes over k+m slots; replication
+  // needs size * r over (stripe width * r) slots.
+  const uint64_t total_bytes =
+      is_ec ? ceil_div(request.data_size, request.ec_data_shards) *
+                  (request.ec_data_shards + request.ec_parity_shards)
+            : request.data_size * request.replication_factor;
+  const size_t want = is_ec ? request.ec_data_shards + request.ec_parity_shards
+                            : request.max_workers_per_copy * request.replication_factor;
   const size_t max_w = std::min(want, preferred.size() + fallback.size());
 
   for (size_t w = max_w; w >= 1; --w) {
-    const uint64_t per_pool = ceil_div(total_bytes, w);
+    // EC shards are indivisible units: with w pools, round-robin puts
+    // ceil(n_shards/w) whole shards on the fullest pool, which is more
+    // than the even-split ceil(total/w) estimate.
+    const uint64_t per_pool =
+        is_ec ? ceil_div(request.ec_data_shards + request.ec_parity_shards, w) *
+                    ceil_div(request.data_size, request.ec_data_shards)
+              : ceil_div(total_bytes, w);
     std::vector<MemoryPoolId> selected;
     selected.reserve(w);
     for (const auto& id : preferred) {
@@ -107,6 +128,10 @@ Result<AllocationResult> RangeAllocator::allocate(const AllocationRequest& reque
                                                   const PoolMap& pools) {
   if (request.data_size == 0) return ErrorCode::INVALID_PARAMETERS;
   if (request.replication_factor == 0) return ErrorCode::INVALID_PARAMETERS;
+  if (request.ec_parity_shards > 0 &&
+      (request.ec_data_shards == 0 ||
+       request.ec_data_shards + request.ec_parity_shards > ec::kMaxTotalShards))
+    return ErrorCode::INVALID_PARAMETERS;
 
   for (const auto& [id, pool] : pools) {
     BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
@@ -119,6 +144,8 @@ Result<AllocationResult> RangeAllocator::allocate(const AllocationRequest& reque
     return ErrorCode::INSUFFICIENT_SPACE;
   }
 
+  if (request.ec_parity_shards > 0) return allocate_ec(request, candidates, pools);
+
   if (!request.enable_striping || request.prefer_contiguous) {
     // Contiguous = striping degenerated to one worker per copy.
     AllocationRequest contiguous = request;
@@ -128,6 +155,85 @@ Result<AllocationResult> RangeAllocator::allocate(const AllocationRequest& reque
     return allocate_with_striping(contiguous, narrowed, pools);
   }
   return allocate_with_striping(request, candidates, pools);
+}
+
+// One erasure-coded copy: exactly k+m equal shards of ceil(size/k) bytes.
+// Shards round-robin over DISTINCT WORKERS first (the tolerance contract is
+// "any m WORKER losses" — two shards behind one failure domain would
+// silently halve it), and only wrap onto reused workers when the cluster is
+// smaller than k+m. Device-tier pools (DeviceLocation placements) are not
+// eligible: the coded data path is wire-only.
+Result<AllocationResult> RangeAllocator::allocate_ec(
+    const AllocationRequest& request, const std::vector<MemoryPoolId>& candidates,
+    const PoolMap& pools) {
+  const size_t k = request.ec_data_shards;
+  const size_t m = request.ec_parity_shards;
+  if (k == 0 || k + m > ec::kMaxTotalShards) return ErrorCode::INVALID_PARAMETERS;
+  const uint64_t shard_len = ceil_div(request.data_size, k);
+
+  // Order candidates so the first n entries cover distinct workers (rank
+  // order preserved within each pass), excluding device-tier pools.
+  std::vector<MemoryPoolId> ordered;
+  {
+    std::unordered_set<NodeId> seen;
+    std::vector<MemoryPoolId> rest;
+    for (const auto& id : candidates) {
+      const MemoryPool& pool = pools.at(id);
+      if (pool.remote.transport == TransportKind::HBM ||
+          pool.remote.transport == TransportKind::ICI)
+        continue;  // DeviceLocation shards have no coded client path
+      if (seen.insert(pool.node_id).second) {
+        ordered.push_back(id);
+      } else {
+        rest.push_back(id);
+      }
+    }
+    ordered.insert(ordered.end(), rest.begin(), rest.end());
+  }
+  if (ordered.empty()) return ErrorCode::INSUFFICIENT_SPACE;
+
+  AllocationResult result{};
+  std::vector<std::pair<MemoryPoolId, Range>> all_ranges;
+  CopyPlacement copy;
+  copy.copy_index = 0;
+  copy.ec_data_shards = static_cast<uint32_t>(k);
+  copy.ec_parity_shards = static_cast<uint32_t>(m);
+  copy.ec_object_size = request.data_size;
+  copy.shards.reserve(k + m);
+
+  for (size_t i = 0; i < k + m; ++i) {
+    const MemoryPoolId& pool_id = ordered[i % ordered.size()];
+    std::optional<Range> range;
+    {
+      std::shared_lock lock(pools_mutex_);
+      auto it = pool_allocators_.find(pool_id);
+      if (it == pool_allocators_.end()) {
+        rollback_allocation(all_ranges);
+        return ErrorCode::MEMORY_POOL_NOT_FOUND;
+      }
+      range = it->second->allocate(shard_len);
+    }
+    if (!range) {
+      rollback_allocation(all_ranges);
+      return ErrorCode::INSUFFICIENT_SPACE;
+    }
+    all_ranges.emplace_back(pool_id, *range);
+    auto shard = create_shard_placement(pool_id, *range, pools);
+    if (!shard.ok()) {
+      rollback_allocation(all_ranges);
+      return shard.error();
+    }
+    copy.shards.push_back(std::move(shard).value());
+  }
+  if (auto ec = commit_allocation(request.object_key, all_ranges); ec != ErrorCode::OK) {
+    rollback_allocation(all_ranges);
+    return ec;
+  }
+  result.copies.push_back(std::move(copy));
+  result.pools_used = std::min(ordered.size(), k + m);
+  result.total_shards_created = k + m;
+  result.stats.avg_shard_size = shard_len;
+  return result;
 }
 
 Result<AllocationResult> RangeAllocator::allocate_with_striping(

@@ -161,17 +161,27 @@ int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint
   return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
 }
 
+int32_t btpu_put_ec(btpu_client* client, const char* key, const void* data, uint64_t size,
+                    uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
+                    int64_t ttl_ms, int32_t soft_pin) {
+  if (!client || !key || !data) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  WorkerConfig cfg;
+  cfg.ec_data_shards = ec_data;
+  cfg.ec_parity_shards = ec_parity;
+  if (preferred_class != 0)
+    cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
+  if (ttl_ms >= 0) cfg.ttl_ms = static_cast<uint64_t>(ttl_ms);
+  cfg.enable_soft_pin = soft_pin != 0;
+  return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
+}
+
 int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size,
                  uint64_t* out_size) {
   if (!client || !key || !out_size) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   if (!buffer) {
     auto placements = client->impl->get_workers(key);
     if (!placements.ok()) return static_cast<int32_t>(placements.error());
-    uint64_t size = 0;
-    if (!placements.value().empty()) {
-      for (const auto& shard : placements.value().front().shards) size += shard.length;
-    }
-    *out_size = size;
+    *out_size = placements.value().empty() ? 0 : copy_logical_size(placements.value().front());
     return 0;
   }
   auto got = client->impl->get_into(key, buffer, buffer_size);
@@ -236,9 +246,7 @@ int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys
       out_codes[i] = static_cast<int32_t>(ErrorCode::NO_COMPLETE_WORKER);
       continue;
     }
-    uint64_t size = 0;
-    for (const auto& shard : placements[i].value().front().shards) size += shard.length;
-    out_sizes[i] = size;
+    out_sizes[i] = copy_logical_size(placements[i].value().front());
     out_codes[i] = 0;
   }
   return 0;
@@ -313,7 +321,13 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
   for (const auto& copy : placements.value()) {
     if (!first_copy) json += ",";
     first_copy = false;
-    json += "{\"copy_index\":" + std::to_string(copy.copy_index) + ",\"shards\":[";
+    json += "{\"copy_index\":" + std::to_string(copy.copy_index);
+    if (copy.ec_data_shards > 0) {
+      json += ",\"ec\":{\"data_shards\":" + std::to_string(copy.ec_data_shards) +
+              ",\"parity_shards\":" + std::to_string(copy.ec_parity_shards) +
+              ",\"object_size\":" + std::to_string(copy.ec_object_size) + "}";
+    }
+    json += ",\"shards\":[";
     bool first_shard = true;
     for (const auto& shard : copy.shards) {
       if (!first_shard) json += ",";

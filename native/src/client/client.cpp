@@ -1,7 +1,10 @@
 #include "btpu/client/client.h"
 
+#include <cstring>
+
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
+#include "btpu/ec/rs.h"
 #include "btpu/storage/hbm_provider.h"
 
 namespace btpu::client {
@@ -114,15 +117,12 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
   uint64_t size = 0;
-  if (!copies.value().empty()) {
-    for (const auto& shard : copies.value().front().shards) size += shard.length;
-  }
+  if (!copies.value().empty()) size = copy_logical_size(copies.value().front());
   std::vector<uint8_t> buffer(size);
   if (try_split_read(copies.value(), buffer.data(), size) == ErrorCode::OK) return buffer;
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
   for (const auto& copy : copies.value()) {
-    uint64_t copy_size = 0;
-    for (const auto& shard : copy.shards) copy_size += shard.length;
+    const uint64_t copy_size = copy_logical_size(copy);
     if (copy_size != size) buffer.resize(copy_size);
     if (auto ec = transfer_copy_get(copy, buffer.data(), copy_size); ec == ErrorCode::OK) {
       return buffer;
@@ -141,16 +141,13 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
   uint64_t size = 0;
-  if (!copies.value().empty()) {
-    for (const auto& shard : copies.value().front().shards) size += shard.length;
-  }
+  if (!copies.value().empty()) size = copy_logical_size(copies.value().front());
   if (size <= buffer_size &&
       try_split_read(copies.value(), static_cast<uint8_t*>(buffer), size) == ErrorCode::OK)
     return size;
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
   for (const auto& copy : copies.value()) {
-    uint64_t copy_size = 0;
-    for (const auto& shard : copy.shards) copy_size += shard.length;
+    const uint64_t copy_size = copy_logical_size(copy);
     if (copy_size > buffer_size) return ErrorCode::BUFFER_OVERFLOW;
     if (auto ec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer), copy_size);
         ec == ErrorCode::OK) {
@@ -242,11 +239,138 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
   return data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
 }
 
+// ---- erasure-coded copies --------------------------------------------------
+//
+// An EC copy holds k data shards (equal length L = ceil(size/k), last one
+// zero-padded) + m Reed-Solomon parity shards (btpu/ec/rs.h). Writes encode
+// and send all k+m in one pipelined batch; reads fetch the k data shards
+// and only on failure fetch survivors + parity and reconstruct (systematic
+// code: the healthy path never decodes).
+
+ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* data,
+                                         uint64_t size, bool is_write) {
+  const size_t k = copy.ec_data_shards;
+  const size_t m = copy.ec_parity_shards;
+  if (copy.shards.size() != k + m || size != copy.ec_object_size)
+    return ErrorCode::INVALID_PARAMETERS;
+  const uint64_t L = copy.shards.front().length;
+  for (const auto& shard : copy.shards) {
+    if (shard.length != L) return ErrorCode::INVALID_PARAMETERS;
+  }
+  // Data shard i holds object bytes [i*L, i*L+valid_of(i)); with small
+  // objects (size < k*L - L) SEVERAL trailing shards are partly or wholly
+  // padding, not just the last one.
+  auto valid_of = [&](size_t i) -> uint64_t {
+    const uint64_t start = i * L;
+    return start >= size ? 0 : std::min<uint64_t>(L, size - start);
+  };
+  // Shards with padding read/write through a temp; full shards use the
+  // user buffer directly.
+  std::vector<std::vector<uint8_t>> temps(k);
+  auto shard_buf = [&](size_t i) -> uint8_t* {
+    if (valid_of(i) == L) return data + i * L;
+    if (temps[i].empty()) temps[i].assign(L, 0);
+    return temps[i].data();
+  };
+
+  if (is_write) {
+    std::vector<const uint8_t*> data_ptrs(k);
+    for (size_t i = 0; i < k; ++i) {
+      uint8_t* buf = shard_buf(i);
+      if (valid_of(i) < L && valid_of(i) > 0) std::memcpy(buf, data + i * L, valid_of(i));
+      data_ptrs[i] = buf;
+    }
+    std::vector<std::vector<uint8_t>> parity(m, std::vector<uint8_t>(L));
+    std::vector<uint8_t*> parity_ptrs(m);
+    for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity[j].data();
+    if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
+      return ErrorCode::INVALID_PARAMETERS;
+
+    std::vector<transport::WireOp> ops(k + m);
+    for (size_t i = 0; i < k + m; ++i) {
+      uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity[i - k].data();
+      if (!transport::make_wire_op(copy.shards[i], 0, buf, L, ops[i]))
+        return ErrorCode::NOT_IMPLEMENTED;
+    }
+    return data_->write_batch(ops.data(), ops.size(), options_.io_parallelism);
+  }
+
+  // Read path: fetch the k data shards (systematic code: no decode when
+  // they all arrive). A shard with no wire address (e.g. one mid-repair or
+  // mis-placed on a device tier) counts as MISSING — that is exactly the
+  // failure parity exists to absorb, not a reason to abort the read.
+  std::vector<transport::WireOp> ops(k);
+  std::vector<bool> addressable(k + m, true);
+  for (size_t i = 0; i < k; ++i) {
+    if (!transport::make_wire_op(copy.shards[i], 0, shard_buf(i), L, ops[i])) {
+      addressable[i] = false;
+      ops[i] = {};  // len 0: skipped by the batch
+    }
+  }
+  data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  std::vector<bool> have(k + m, false);
+  size_t missing = 0;
+  for (size_t i = 0; i < k; ++i) {
+    have[i] = addressable[i] && ops[i].status == ErrorCode::OK;
+    if (!have[i]) ++missing;
+  }
+  auto copy_out = [&](size_t i, const uint8_t* src) {
+    if (valid_of(i) > 0 && valid_of(i) < L) std::memcpy(data + i * L, src, valid_of(i));
+  };
+  if (missing == 0) {
+    for (size_t i = 0; i < k; ++i) {
+      if (!temps[i].empty()) copy_out(i, temps[i].data());
+    }
+    return ErrorCode::OK;
+  }
+  if (missing > m) return ErrorCode::NO_COMPLETE_WORKER;
+
+  // Degraded read: fetch parity shards, reconstruct the missing data.
+  LOG_WARN << "ec read: " << missing << " data shard(s) unreadable, reconstructing";
+  std::vector<std::vector<uint8_t>> parity(m, std::vector<uint8_t>(L));
+  std::vector<transport::WireOp> pops(m);
+  for (size_t j = 0; j < m; ++j) {
+    if (!transport::make_wire_op(copy.shards[k + j], 0, parity[j].data(), L, pops[j])) {
+      addressable[k + j] = false;
+      pops[j] = {};
+    }
+  }
+  data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);
+  for (size_t j = 0; j < m; ++j)
+    have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK;
+
+  std::vector<std::vector<uint8_t>> rebuilt(k);
+  std::vector<const uint8_t*> present(k + m, nullptr);
+  std::vector<uint8_t*> out(k, nullptr);
+  for (size_t i = 0; i < k; ++i) {
+    if (have[i]) {
+      present[i] = temps[i].empty() ? data + i * L : temps[i].data();
+    } else {
+      rebuilt[i].resize(L);
+      out[i] = rebuilt[i].data();
+    }
+  }
+  for (size_t j = 0; j < m; ++j) {
+    if (have[k + j]) present[k + j] = parity[j].data();
+  }
+  if (!ec::rs_reconstruct(present.data(), k, m, L, out.data()))
+    return ErrorCode::NO_COMPLETE_WORKER;
+  for (size_t i = 0; i < k; ++i) {
+    if (have[i]) {
+      if (!temps[i].empty()) copy_out(i, temps[i].data());
+    } else if (valid_of(i) > 0) {
+      std::memcpy(data + i * L, rebuilt[i].data(), valid_of(i));
+    }
+  }
+  return ErrorCode::OK;
+}
+
 // Shared by the single-object and batched paths: device-location shards are
 // coalesced into ONE provider scatter/gather call (per-op device latency is
 // the enemy, hbm_provider.h v2), wire shards move as one pipelined batch.
 ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
                                       bool is_write) {
+  if (copy.ec_data_shards > 0) return transfer_copy_ec(copy, data, size, is_write);
   // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
   std::vector<uint64_t> offsets(copy.shards.size());
   uint64_t off = 0;
@@ -331,6 +455,78 @@ ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t si
     off += shard.length;
   }
   return off == size ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
+}
+
+// Coded-copy batch helpers. Arena owns padded-data and parity buffers until
+// the wire batch executes (inner-vector buffers stay put when the arena
+// grows). EC pools are wire-only by placement, so every job is a wire job.
+ErrorCode append_ec_put_jobs(const CopyPlacement& copy, const uint8_t* data, uint64_t size,
+                             size_t item_index, std::vector<std::vector<uint8_t>>& arena,
+                             BatchJobs& jobs) {
+  const size_t k = copy.ec_data_shards, m = copy.ec_parity_shards;
+  if (copy.shards.size() != k + m || size != copy.ec_object_size)
+    return ErrorCode::INVALID_PARAMETERS;
+  const uint64_t L = copy.shards.front().length;
+  for (const auto& s : copy.shards) {
+    if (s.length != L) return ErrorCode::INVALID_PARAMETERS;
+  }
+  std::vector<const uint8_t*> data_ptrs(k);
+  for (size_t i = 0; i < k; ++i) {
+    const uint64_t start = i * L;
+    const uint64_t valid = start >= size ? 0 : std::min<uint64_t>(L, size - start);
+    if (valid == L) {
+      data_ptrs[i] = data + start;
+    } else {
+      arena.emplace_back(L, 0);
+      if (valid > 0) std::memcpy(arena.back().data(), data + start, valid);
+      data_ptrs[i] = arena.back().data();
+    }
+  }
+  std::vector<uint8_t*> parity_ptrs(m);
+  for (size_t j = 0; j < m; ++j) {
+    arena.emplace_back(L);
+    parity_ptrs[j] = arena.back().data();
+  }
+  if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
+    return ErrorCode::INVALID_PARAMETERS;
+  for (size_t i = 0; i < k + m; ++i) {
+    uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity_ptrs[i - k];
+    jobs.wire.push_back({&copy.shards[i], 0, buf, L});
+    jobs.wire_item.push_back(item_index);
+  }
+  return ErrorCode::OK;
+}
+
+// Post-batch copy of a padded shard's valid bytes into the user buffer.
+struct EcReadFixup {
+  size_t item;
+  uint8_t* dst;
+  const uint8_t* src;
+  uint64_t n;
+};
+
+// Appends the k data-shard reads of one coded copy (the healthy fast path;
+// a failed item falls back to the full reconstructing read).
+void append_ec_get_jobs(const CopyPlacement& copy, uint8_t* buffer, uint64_t size,
+                        size_t item_index, std::vector<std::vector<uint8_t>>& arena,
+                        BatchJobs& jobs, std::vector<EcReadFixup>& fixups) {
+  const size_t k = copy.ec_data_shards;
+  const uint64_t L = copy.shards.front().length;
+  for (size_t i = 0; i < k; ++i) {
+    const uint64_t start = i * L;
+    const uint64_t valid = start >= size ? 0 : std::min<uint64_t>(L, size - start);
+    if (valid == 0) continue;  // pure padding: nothing to read
+    uint8_t* buf;
+    if (valid == L) {
+      buf = buffer + start;
+    } else {
+      arena.emplace_back(L);
+      buf = arena.back().data();
+      fixups.push_back({item_index, buffer + start, buf, valid});
+    }
+    jobs.wire.push_back({&copy.shards[i], 0, buf, L});
+    jobs.wire_item.push_back(item_index);
+  }
 }
 
 // Runs the wire jobs as ONE pipelined batch; per-op failures land on their
@@ -421,12 +617,19 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   }
 
   BatchJobs jobs;
+  std::vector<std::vector<uint8_t>> ec_arena;
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok()) {
       results[i] = placed[i].error();
       continue;
     }
     auto* data = const_cast<uint8_t*>(static_cast<const uint8_t*>(items[i].data));
+    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
+      // Erasure-coded item: encode now, ship with the shared wire batch.
+      results[i] = append_ec_put_jobs(placed[i].value().front(), data, items[i].size, i,
+                                      ec_arena, jobs);
+      continue;
+    }
     for (const auto& copy : placed[i].value()) {
       if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs);
           ec != ErrorCode::OK) {
@@ -505,6 +708,8 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
 
   // First pass: batched transfer of every item's first replica.
   BatchJobs jobs;
+  std::vector<std::vector<uint8_t>> ec_arena;
+  std::vector<EcReadFixup> ec_fixups;
   std::vector<ErrorCode> errors(items.size(), ErrorCode::OK);
   std::vector<uint64_t> sizes(items.size(), 0);
   for (size_t i = 0; i < items.size(); ++i) {
@@ -517,11 +722,17 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
       continue;
     }
     const auto& copy = placements[i].value().front();
-    uint64_t copy_size = 0;
-    for (const auto& shard : copy.shards) copy_size += shard.length;
+    const uint64_t copy_size = copy_logical_size(copy);
     sizes[i] = copy_size;
     if (copy_size > items[i].buffer_size) {
       errors[i] = ErrorCode::BUFFER_OVERFLOW;
+      continue;
+    }
+    if (copy.ec_data_shards > 0) {
+      // Erasure-coded item: data-shard reads ride the shared batch; a
+      // failed item retries below through the reconstructing path.
+      append_ec_get_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
+                         ec_arena, jobs, ec_fixups);
       continue;
     }
     if (auto ec = append_copy_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
@@ -531,6 +742,9 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
   }
   run_device_jobs(*data_, jobs, /*is_write=*/false, errors);
   run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors);
+  for (const auto& fix : ec_fixups) {
+    if (errors[fix.item] == ErrorCode::OK) std::memcpy(fix.dst, fix.src, fix.n);
+  }
 
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placements[i].ok() || placements[i].value().empty() ||
@@ -546,9 +760,19 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
     ErrorCode last = errors[i];
     bool done = false;
     const auto& copies = placements[i].value();
+    if (copies.front().ec_data_shards > 0) {
+      // Coded object: the retry IS the degraded read (fetch survivors +
+      // parity, reconstruct).
+      if (transfer_copy_ec(copies.front(), static_cast<uint8_t*>(items[i].buffer), sizes[i],
+                           /*is_write=*/false) == ErrorCode::OK) {
+        results[i] = sizes[i];
+      } else {
+        results[i] = last;
+      }
+      continue;
+    }
     for (size_t c = 1; c < copies.size() && !done; ++c) {
-      uint64_t copy_size = 0;
-      for (const auto& shard : copies[c].shards) copy_size += shard.length;
+      const uint64_t copy_size = copy_logical_size(copies[c]);
       if (copy_size > items[i].buffer_size) {
         last = ErrorCode::BUFFER_OVERFLOW;
         continue;

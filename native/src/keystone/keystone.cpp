@@ -6,6 +6,7 @@
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
 #include "btpu/storage/hbm_provider.h"
 
 namespace btpu::keystone {
@@ -66,10 +67,53 @@ std::string encode_object_record(const ObjectRecord& rec) {
   return std::string(bytes.begin(), bytes.end());
 }
 
+// Pre-erasure-coding layouts (records persisted before the ec fields were
+// appended to CopyPlacement/WorkerConfig). Both structs are embedded
+// mid-stream here, so wire.h's trailing-optional convention cannot express
+// the upgrade; instead a failed new-format decode retries with the legacy
+// layout and defaults the ec fields — a restart over a pre-upgrade data dir
+// must recover its objects, not purge them as garbage.
+bool decode_copy_legacy(wire::Reader& r, CopyPlacement& c) {
+  c.ec_data_shards = c.ec_parity_shards = 0;
+  c.ec_object_size = 0;
+  return wire::decode_fields(r, c.copy_index, c.shards);
+}
+
+bool decode_config_legacy(wire::Reader& r, WorkerConfig& c) {
+  uint64_t rf = 0, mw = 0, ms = 0;
+  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node,
+                           c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
+                           c.prefer_contiguous, ms, c.preferred_slice))
+    return false;
+  c.replication_factor = rf;
+  c.max_workers_per_copy = mw;
+  c.min_shard_size = ms;
+  c.ec_data_shards = c.ec_parity_shards = 0;
+  return true;
+}
+
+bool decode_object_record_legacy(const std::string& bytes, ObjectRecord& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
+  if (!decode_config_legacy(r, out.config)) return false;
+  uint32_t n = 0;
+  if (!r.get(n) || n > r.remaining()) return false;
+  out.copies.clear();
+  out.copies.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    CopyPlacement c;
+    if (!decode_copy_legacy(r, c)) return false;
+    out.copies.push_back(std::move(c));
+  }
+  return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
+}
+
 bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
-                             out.copies, out.created_wall_ms, out.last_access_wall_ms);
+  if (wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
+                          out.copies, out.created_wall_ms, out.last_access_wall_ms))
+    return true;
+  return decode_object_record_legacy(bytes, out);
 }
 
 // Reads or writes [obj_off, obj_off+len) of one copy through its shards
@@ -749,6 +793,15 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   effective.replication_factor =
       std::min(effective.replication_factor, static_cast<size_t>(config_.max_replicas));
   if (effective.max_workers_per_copy == 0) effective.max_workers_per_copy = 1;
+  if (effective.ec_parity_shards > 0) {
+    // Erasure coding replaces replication: one coded copy.
+    if (effective.ec_data_shards == 0 ||
+        effective.ec_data_shards + effective.ec_parity_shards > ec::kMaxTotalShards)
+      return ErrorCode::INVALID_PARAMETERS;
+    effective.replication_factor = 1;
+  } else {
+    effective.ec_data_shards = 0;  // k without m is meaningless: plain striping
+  }
 
   TRACE_SPAN("keystone.put_start");
   std::unique_lock lock(objects_mutex_);
@@ -978,6 +1031,15 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
             for (const auto& other : info.copies[cj].shards)
               m.other_workers.push_back(other.worker_id);
           }
+          if (info.copies[ci].ec_data_shards > 0) {
+            // Coded copy: the SIBLING shards are the failure domains the
+            // "any m worker losses" contract counts — never stack the
+            // migrated shard behind one of them.
+            for (size_t sj = 0; sj < info.copies[ci].shards.size(); ++sj) {
+              if (sj != si)
+                m.other_workers.push_back(info.copies[ci].shards[sj].worker_id);
+            }
+          }
           moves.push_back(std::move(m));
         }
       }
@@ -1024,11 +1086,20 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       WorkerConfig shard_cfg = m.config;
       shard_cfg.replication_factor = 1;
       shard_cfg.max_workers_per_copy = 1;  // one shard in, one shard out
+      // Shard-level move, even for coded objects: the staged allocation is
+      // one plain shard (the splice keeps its position in the geometry).
+      const bool coded = m.config.ec_parity_shards > 0;
+      shard_cfg.ec_data_shards = 0;
+      shard_cfg.ec_parity_shards = 0;
       alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
           staging_key, m.shard.length, shard_cfg);
       // Keep the shard in its tier (a drain is not a demotion); placement
-      // may still spill classes if the tier has no room elsewhere.
+      // may still spill classes if the tier has no room elsewhere — except
+      // for coded shards, whose client path is wire-only: landing one on a
+      // device tier would make the whole object unreadable, so the move
+      // fails (and the drain retries) rather than spill.
       req.preferred_classes = {m.shard.storage_class};
+      req.restrict_to_preferred = coded;
       req.excluded_nodes = m.other_workers;
       auto attempt = adapter_.allocator().allocate(req, targets);
       if (!attempt.ok()) {
@@ -1291,6 +1362,16 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     WorkerConfig config;
     std::vector<CopyPlacement> surviving;
   };
+  // Live-worker snapshot for EC recoverability counting (a coded object may
+  // already carry shards lost to EARLIER deaths; tolerance is cumulative).
+  std::unordered_set<NodeId> live_workers;
+  {
+    std::shared_lock lock(registry_mutex_);
+    for (const auto& [id, w] : workers_) {
+      if (id != worker_id) live_workers.insert(id);
+    }
+  }
+
   std::vector<PendingRepair> pending;
   {
     std::unique_lock lock(objects_mutex_);
@@ -1300,6 +1381,41 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         return std::any_of(copy.shards.begin(), copy.shards.end(),
                            [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
       };
+
+      // Erasure-coded objects have ONE copy whose shard ORDER is the code
+      // geometry — the copy is never dropped whole. Dead shards stay listed
+      // (clients fail reading them and reconstruct from any k survivors:
+      // degraded-but-readable); only past the parity tolerance is the
+      // object gone. Dead-worker range bookkeeping is released either way.
+      if (!info.copies.empty() && info.copies.front().ec_data_shards > 0) {
+        CopyPlacement& copy = info.copies.front();
+        if (!damaged(copy)) {
+          ++it;
+          continue;
+        }
+        const ObjectKey key = it->first;
+        size_t dead = 0;
+        for (const auto& shard : copy.shards) {
+          if (shard.worker_id == worker_id)
+            adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          if (!live_workers.contains(shard.worker_id)) ++dead;
+        }
+        if (dead > copy.ec_parity_shards) {
+          LOG_WARN << "coded object " << key << " lost " << dead << " shards (tolerance "
+                   << copy.ec_parity_shards << ") with worker " << worker_id;
+          adapter_.free_object(key);
+          unpersist_object(key);
+          it = objects_.erase(it);
+          ++counters_.objects_lost;
+          bump_view();
+          continue;
+        }
+        info.epoch = next_epoch_.fetch_add(1);
+        persist_object(key, info);
+        bump_view();
+        ++it;
+        continue;
+      }
       std::vector<CopyPlacement> surviving;
       bool any_damaged = false;
       for (const auto& copy : info.copies) {
@@ -1563,7 +1679,11 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   // Demotion moves whole objects. Only objects fully resident in the
   // pressured tier qualify — re-placing a mixed-tier object would drag its
   // healthy faster-tier replicas down the ladder too. Mixed objects keep
-  // delete-eviction semantics (the caller's fallback).
+  // delete-eviction semantics (the caller's fallback). Erasure-coded copies
+  // interleave parity with data, which this replication-shaped byte mover
+  // does not understand: same fallback.
+  if (!old_copies.empty() && old_copies.front().ec_data_shards > 0)
+    return DemoteOutcome::kFailed;
   for (const auto& copy : old_copies) {
     for (const auto& shard : copy.shards) {
       if (shard.storage_class != from) return DemoteOutcome::kFailed;

@@ -4,6 +4,7 @@
 // get->verify slice SURVEY §7 defines as the minimum e2e artifact.
 #include <cstring>
 #include <random>
+#include <set>
 #include <filesystem>
 #include <fstream>
 #include <thread>
@@ -931,4 +932,167 @@ BTEST(EndToEnd, ChurnLeavesNoLeakedRangesOrFragmentation) {
   auto back = client->get("churn/final");
   BT_ASSERT_OK(back);
   BT_EXPECT(back.value() == big);
+}
+
+// ---- erasure coding (no reference counterpart: it only replicates) --------
+
+BTEST(ErasureCoding, PutGetRoundtripAndGeometry) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(6, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  cfg.replication_factor = 3;  // ignored under EC: one coded copy
+  auto data = pattern(1 << 20, 17);
+  BT_ASSERT(client->put("ec/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("ec/obj");
+  BT_ASSERT_OK(placements);
+  BT_ASSERT(placements.value().size() == 1);  // ONE coded copy, not replicas
+  const auto& copy = placements.value()[0];
+  BT_EXPECT_EQ(copy.ec_data_shards, 4u);
+  BT_EXPECT_EQ(copy.ec_parity_shards, 2u);
+  BT_EXPECT_EQ(copy.ec_object_size, data.size());
+  BT_ASSERT(copy.shards.size() == 6);
+  const uint64_t L = copy.shards[0].length;
+  BT_EXPECT_EQ(L, (data.size() + 3) / 4);
+  std::set<std::string> workers;
+  for (const auto& s : copy.shards) {
+    BT_EXPECT_EQ(s.length, L);  // equal shards (parity needs equal lengths)
+    workers.insert(s.worker_id);
+  }
+  BT_EXPECT_EQ(workers.size(), 6u);  // anti-affine: one shard per worker
+
+  auto back = client->get("ec/obj");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // Odd (non-divisible) size exercises the padded last shard.
+  auto odd = pattern(123457, 3);
+  BT_ASSERT(client->put("ec/odd", odd.data(), odd.size(), cfg) == ErrorCode::OK);
+  auto odd_back = client->get("ec/odd");
+  BT_ASSERT_OK(odd_back);
+  BT_EXPECT(odd_back.value() == odd);
+
+  // Tiny objects: size < (k-1)*L means SEVERAL trailing shards are pure
+  // padding (L = ceil(5/4) = 2, shards 2..3 hold no data at all).
+  for (uint64_t tiny_size : {1ull, 5ull, 7ull}) {
+    const std::string tkey = "ec/tiny" + std::to_string(tiny_size);
+    auto tiny = pattern(tiny_size, 11);
+    BT_ASSERT(client->put(tkey, tiny.data(), tiny.size(), cfg) == ErrorCode::OK);
+    auto tiny_back = client->get(tkey);
+    BT_ASSERT_OK(tiny_back);
+    BT_EXPECT(tiny_back.value() == tiny);
+  }
+
+  // Batched APIs route coded items correctly too.
+  std::vector<ObjectClient::GetItem> gets;
+  std::vector<uint8_t> buf_a(data.size()), buf_b(odd.size());
+  gets.push_back({"ec/obj", buf_a.data(), buf_a.size()});
+  gets.push_back({"ec/odd", buf_b.data(), buf_b.size()});
+  auto many = client->get_many(gets);
+  BT_ASSERT(many[0].ok() && many[1].ok());
+  BT_EXPECT_EQ(many[0].value(), data.size());
+  BT_EXPECT(std::memcmp(buf_a.data(), data.data(), data.size()) == 0);
+  BT_EXPECT(std::memcmp(buf_b.data(), odd.data(), odd.size()) == 0);
+}
+
+BTEST(ErasureCoding, DegradedReadReconstructsThroughParity) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(6, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  auto data = pattern(1 << 20, 29);
+  BT_ASSERT(client->put("ec/degraded", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  // Fail the first two data-shard reads: the client must fetch parity and
+  // reconstruct (m=2 tolerates exactly this).
+  transport::FaultSpec spec;
+  spec.fail_nth_read = 1;
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+  auto back = client->get("ec/degraded");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // The batched path degrades the same way: a failed item falls back to
+  // the reconstructing read.
+  transport::FaultSpec bspec;
+  bspec.fail_nth_read = 2;
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), bspec));
+  std::vector<uint8_t> bbuf(data.size());
+  std::vector<ObjectClient::GetItem> bitems{{"ec/degraded", bbuf.data(), bbuf.size()}};
+  auto bres = client->get_many(bitems);
+  BT_ASSERT(bres[0].ok());
+  BT_EXPECT(std::memcmp(bbuf.data(), data.data(), data.size()) == 0);
+
+  // Beyond tolerance: every read fails -> NO_COMPLETE_WORKER, not garbage.
+  transport::FaultSpec all;
+  all.fail_endpoint = "";  // count-based: fail reads 1..8 (data + parity)
+  all.fail_nth_read = 1;
+  auto inner = transport::make_faulty_transport_client(
+      transport::make_transport_client(), all);
+  for (uint32_t n = 2; n <= 8; ++n) {
+    transport::FaultSpec extra;
+    extra.fail_nth_read = 1;
+    inner = transport::make_faulty_transport_client(std::move(inner), extra);
+  }
+  client->inject_data_client_for_test(std::move(inner));
+  auto dead = client->get("ec/degraded");
+  BT_ASSERT(!dead.ok());
+}
+
+BTEST(ErasureCoding, WorkerDeathLeavesObjectDegradedButReadable) {
+  auto options = EmbeddedClusterOptions::simple(6, 4 << 20);
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  auto data = pattern(768 * 1024, 41);
+  BT_ASSERT(client->put("ec/survive", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  // Kill the worker holding data shard 0. The coded copy must NOT be
+  // dropped (the replication repairer would have deleted a 1-copy object);
+  // reads reconstruct through parity.
+  auto placements = client->get_workers("ec/survive");
+  BT_ASSERT_OK(placements);
+  const auto victim = placements.value()[0].shards[0].worker_id;
+  size_t victim_idx = 0;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim) victim_idx = i;  // ids are worker-<i>
+  }
+  cluster.kill_worker(victim_idx);
+
+  BT_EXPECT(eventually([&] {
+    auto p = client->get_workers("ec/survive");
+    return p.ok() && !p.value().empty();
+  }));
+  auto exists = client->object_exists("ec/survive");
+  BT_ASSERT_OK(exists);
+  BT_EXPECT(exists.value());  // degraded, NOT deleted
+
+  auto back = client->get("ec/survive");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // A second death within tolerance still reads; the third loss kills it.
+  auto p2 = client->get_workers("ec/survive");
+  BT_ASSERT_OK(p2);
+  const auto victim2 = p2.value()[0].shards[1].worker_id;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim2) cluster.kill_worker(i);
+  }
+  BT_EXPECT(eventually([&] {
+    auto back2 = client->get("ec/survive");
+    return back2.ok() && back2.value() == data;
+  }));
 }

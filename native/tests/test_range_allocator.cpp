@@ -4,6 +4,7 @@
 // spillover, endpoint/rkey integrity, invalid descriptors, fragmentation under
 // concurrency, zero-size, node locality, duplicate keys, offset math,
 // free-unknown-object) plus TPU additions (slice affinity, forget_pool).
+#include <map>
 #include <set>
 #include <thread>
 
@@ -478,4 +479,77 @@ BTEST(KeystoneAdapter, AllocateFreeRoundtrip) {
   BT_EXPECT_EQ(res.value().size(), 2u);
   BT_EXPECT(adapter.free_object("obj") == ErrorCode::OK);
   BT_EXPECT(adapter.free_object("obj") == ErrorCode::OBJECT_NOT_FOUND);
+}
+
+BTEST(RangeAllocator, EcSpreadsOverDistinctWorkersNotPools) {
+  // Two pools per worker on 3 workers: a 4+2 code must round-robin shards
+  // over WORKERS (2 each), never stack shards on one worker while another
+  // goes unused ("any m worker losses" is the contract, not pool losses).
+  RangeAllocator alloc;
+  PoolMap pools;
+  for (int w = 0; w < 3; ++w) {
+    for (int p = 0; p < 2; ++p) {
+      auto id = "n" + std::to_string(w) + "-p" + std::to_string(p);
+      pools[id] = make_pool(id, "node-" + std::to_string(w), 1 << 20);
+    }
+  }
+  auto req = make_request("ec-obj", 240 * 1024);
+  req.ec_data_shards = 4;
+  req.ec_parity_shards = 2;
+  auto result = alloc.allocate(req, pools);
+  BT_ASSERT_OK(result);
+  const auto& copy = result.value().copies[0];
+  BT_ASSERT(copy.shards.size() == 6);
+  BT_EXPECT_EQ(copy.ec_data_shards, 4u);
+  std::map<std::string, int> per_worker;
+  for (const auto& s : copy.shards) {
+    BT_EXPECT_EQ(s.length, 60 * 1024ull);  // equal shards, ceil(240k/4)
+    per_worker[s.worker_id]++;
+  }
+  BT_ASSERT(per_worker.size() == 3);
+  for (const auto& [node, n] : per_worker) BT_EXPECT_EQ(n, 2);  // balanced
+
+  // Device-tier pools are never EC candidates (no coded client path).
+  PoolMap dev_pools;
+  auto hbm = make_pool("hbm0", "node-9", 1 << 20, StorageClass::HBM_TPU);
+  hbm.remote.transport = TransportKind::HBM;
+  dev_pools["hbm0"] = hbm;
+  auto dev_req = make_request("ec-dev", 64 * 1024);
+  dev_req.ec_data_shards = 2;
+  dev_req.ec_parity_shards = 1;
+  BT_EXPECT(alloc.allocate(dev_req, dev_pools).error() == ErrorCode::INSUFFICIENT_SPACE);
+
+  // Geometry limits are enforced.
+  auto bad = make_request("ec-bad", 1024);
+  bad.ec_data_shards = 0;
+  bad.ec_parity_shards = 2;
+  BT_EXPECT(alloc.allocate(bad, pools).error() == ErrorCode::INVALID_PARAMETERS);
+}
+
+BTEST(RangeAllocator, EcCapacityCheckCountsWholeShards) {
+  // 2 pools, 3+1 code, shard 100 KiB: each pool takes ceil(4/2)=2 whole
+  // shards = 200 KiB. Pools with 150 KiB free must be rejected up front
+  // (the even-split estimate ceil(400k/2) would wrongly admit them).
+  RangeAllocator alloc;
+  PoolMap pools;
+  pools["a"] = make_pool("a", "na", 150 * 1024);
+  pools["b"] = make_pool("b", "nb", 150 * 1024);
+  auto req = make_request("ec-tight", 300 * 1024);
+  req.ec_data_shards = 3;
+  req.ec_parity_shards = 1;
+  BT_EXPECT(alloc.allocate(req, pools).error() == ErrorCode::INSUFFICIENT_SPACE);
+
+  // With 200 KiB+ free per pool the same request fits.
+  PoolMap roomy;
+  roomy["a"] = make_pool("a", "na", 220 * 1024);
+  roomy["b"] = make_pool("b", "nb", 220 * 1024);
+  auto fits = alloc.allocate(make_request("ec-fits", 1), roomy);  // warm allocators
+  (void)fits;
+  RangeAllocator fresh;
+  auto req2 = make_request("ec-tight2", 300 * 1024);
+  req2.ec_data_shards = 3;
+  req2.ec_parity_shards = 1;
+  auto ok = fresh.allocate(req2, roomy);
+  BT_ASSERT_OK(ok);
+  BT_EXPECT_EQ(ok.value().copies[0].shards.size(), size_t{4});
 }

@@ -158,6 +158,29 @@ def test_list_objects_by_prefix():
         assert client.list("nope/") == []
 
 
+def test_erasure_coded_put_get():
+    with EmbeddedCluster(workers=6, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        payload = bytes(bytearray(range(256)) * 2048)  # 512 KiB
+        client.put("ec/py", payload, ec=(4, 2))
+        assert client.get("ec/py") == payload
+
+        copies = client.placements("ec/py")
+        assert len(copies) == 1  # one coded copy, not replicas
+        assert copies[0]["ec"] == {
+            "data_shards": 4, "parity_shards": 2, "object_size": len(payload),
+        }
+        assert len(copies[0]["shards"]) == 6
+        assert len({s["worker"] for s in copies[0]["shards"]}) == 6  # anti-affine
+
+        # Listing and size queries report the LOGICAL size, not k+m shards.
+        listed = client.list("ec/")
+        assert listed[0]["size"] == len(payload)
+
+        with pytest.raises(ValueError):
+            client.put("ec/bad", b"x", ec=(0, 2))
+
+
 def test_object_ttl_and_soft_pin():
     import time
 

@@ -48,8 +48,13 @@ int main(int argc, char** argv) {
       const std::string km = argv[++i];
       const size_t comma = km.find(',');
       if (comma == std::string::npos) return usage();
-      wc.ec_data_shards = std::stoul(km.substr(0, comma));
-      wc.ec_parity_shards = std::stoul(km.substr(comma + 1));
+      try {
+        wc.ec_data_shards = std::stoul(km.substr(0, comma));
+        wc.ec_parity_shards = std::stoul(km.substr(comma + 1));
+      } catch (...) {
+        return usage();
+      }
+      if (wc.ec_data_shards == 0 || wc.ec_parity_shards == 0) return usage();
     }
     else if (!std::strcmp(argv[i], "--help")) return usage();
     else positional.push_back(argv[i]);

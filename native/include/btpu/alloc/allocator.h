@@ -61,6 +61,12 @@ struct AllocationRequest {
   bool prefer_contiguous{false};
   uint64_t min_shard_size{256 * 1024};  // see WorkerConfig::min_shard_size
 
+  // Restricts candidates to wire-addressable pools (excludes HBM/ICI
+  // device tiers). Set for single-shard staging of coded objects (repair,
+  // drain): a DeviceLocation shard would be unreadable to the coded client
+  // path. allocate_ec implies this.
+  bool wire_only{false};
+
   // Erasure coding: when ec_parity_shards > 0, allocate ONE coded copy of
   // exactly (ec_data_shards + ec_parity_shards) equal shards of
   // ceil(data_size / ec_data_shards) bytes, round-robin across candidate

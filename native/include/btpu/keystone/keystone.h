@@ -215,6 +215,11 @@ class KeystoneService {
   alloc::PoolMap allocatable_pools_snapshot() const;
   // One live shard's bytes into a staged placement (device fast path incl.).
   ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst);
+  // Reconstructs the dead shards of one erasure-coded copy from any k
+  // survivors (segmented) onto fresh placements and splices them in.
+  bool repair_ec_object(const ObjectKey& key, uint64_t epoch, const CopyPlacement& copy,
+                        const std::vector<size_t>& dead_idx,
+                        const alloc::PoolMap& target_pools);
   void cleanup_stale_workers();
 
   // Repair: rebuild placements that referenced a dead worker from surviving

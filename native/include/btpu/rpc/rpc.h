@@ -1,5 +1,11 @@
 // Keystone RPC protocol: opcodes map 1:1 to KeystoneService methods.
 //
+// Versioning stance: wire structs are NOT cross-version stable (no
+// negotiation — matching the reference's struct_pack RPC, which had none
+// either). Upgrades are atomic per cluster: restart keystones and clients
+// together. Durable records are the exception — they outlive binaries, so
+// keystone.cpp keeps legacy decode fallbacks for them.
+//
 // Parity target: reference include/blackbird/rpc/rpc_service.h:28-274 — 14
 // rpc_* handlers over YLT coro_rpc (rpc_service.cpp:360-385). Framing is the
 // shared net.h frame: [u32 len][u8 opcode][wire-encoded struct]; responses

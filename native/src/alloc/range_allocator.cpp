@@ -53,9 +53,11 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
   for (const auto& [id, pool] : pools) {
     // Coded shards have a wire-only client path: device-tier pools must not
     // consume selection slots (allocate_ec would drop them afterward and
-    // overload the rest past what the capacity check vetted).
-    if (is_ec && (pool.remote.transport == TransportKind::HBM ||
-                  pool.remote.transport == TransportKind::ICI))
+    // overload the rest past what the capacity check vetted). Same for
+    // explicit wire_only staging requests (EC repair/drain moves).
+    if ((is_ec || request.wire_only) &&
+        (pool.remote.transport == TransportKind::HBM ||
+         pool.remote.transport == TransportKind::ICI))
       continue;
     if (!request.preferred_node.empty() && pool.node_id != request.preferred_node) continue;
     if (std::find(request.excluded_nodes.begin(), request.excluded_nodes.end(),

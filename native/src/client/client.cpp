@@ -301,7 +301,16 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
   // failure parity exists to absorb, not a reason to abort the read.
   std::vector<transport::WireOp> ops(k);
   std::vector<bool> addressable(k + m, true);
+  std::vector<bool> padding_only(k, false);
   for (size_t i = 0; i < k; ++i) {
+    if (valid_of(i) == 0) {
+      // Pure padding: content is all zeros by construction — shard_buf's
+      // temp already is; no wire fetch, and it can serve reconstruction.
+      padding_only[i] = true;
+      (void)shard_buf(i);
+      ops[i] = {};
+      continue;
+    }
     if (!transport::make_wire_op(copy.shards[i], 0, shard_buf(i), L, ops[i])) {
       addressable[i] = false;
       ops[i] = {};  // len 0: skipped by the batch
@@ -311,7 +320,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
   std::vector<bool> have(k + m, false);
   size_t missing = 0;
   for (size_t i = 0; i < k; ++i) {
-    have[i] = addressable[i] && ops[i].status == ErrorCode::OK;
+    have[i] = padding_only[i] || (addressable[i] && ops[i].status == ErrorCode::OK);
     if (!have[i]) ++missing;
   }
   auto copy_out = [&](size_t i, const uint8_t* src) {

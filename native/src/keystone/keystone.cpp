@@ -1094,12 +1094,11 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
           staging_key, m.shard.length, shard_cfg);
       // Keep the shard in its tier (a drain is not a demotion); placement
-      // may still spill classes if the tier has no room elsewhere — except
-      // for coded shards, whose client path is wire-only: landing one on a
-      // device tier would make the whole object unreadable, so the move
-      // fails (and the drain retries) rather than spill.
+      // may still spill classes if the tier has no room elsewhere — but a
+      // coded shard may only spill within WIRE tiers (a device-tier shard
+      // would make the whole object unreadable to the coded client path).
       req.preferred_classes = {m.shard.storage_class};
-      req.restrict_to_preferred = coded;
+      req.wire_only = coded;
       req.excluded_nodes = m.other_workers;
       auto attempt = adapter_.allocator().allocate(req, targets);
       if (!attempt.ok()) {
@@ -1362,6 +1361,14 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     WorkerConfig config;
     std::vector<CopyPlacement> surviving;
   };
+  struct PendingEcRepair {
+    ObjectKey key;
+    uint64_t epoch{0};
+    CopyPlacement copy;  // snapshot, dead shards still listed at their indices
+    std::vector<size_t> dead_idx;
+    WorkerConfig config;
+  };
+  std::vector<PendingEcRepair> ec_pending;
   // Live-worker snapshot for EC recoverability counting (a coded object may
   // already carry shards lost to EARLIER deaths; tolerance is cumulative).
   std::unordered_set<NodeId> live_workers;
@@ -1413,6 +1420,16 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         info.epoch = next_epoch_.fetch_add(1);
         persist_object(key, info);
         bump_view();
+        if (info.state == ObjectState::kComplete) {
+          // Queue reconstruction of EVERY dead shard (including ones from
+          // earlier deaths): without healing, losses accumulate until the
+          // tolerance is exceeded and a recoverable object dies.
+          std::vector<size_t> dead_idx;
+          for (size_t si = 0; si < copy.shards.size(); ++si) {
+            if (!live_workers.contains(copy.shards[si].worker_id)) dead_idx.push_back(si);
+          }
+          ec_pending.push_back({key, info.epoch, copy, std::move(dead_idx), info.config});
+        }
         ++it;
         continue;
       }
@@ -1538,7 +1555,194 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     ++repaired;
     bump_view();
   }
+
+  // Pass 2b — erasure-coded objects: reconstruct every dead shard from any
+  // k survivors (segmented, bounded memory) onto fresh placements and
+  // splice them in at their geometry positions. Without this, coded
+  // objects never heal — losses accumulate across deaths until tolerance
+  // is exceeded and a recoverable object dies.
+  for (auto& r : ec_pending) {
+    if (repair_ec_object(r.key, r.epoch, r.copy, r.dead_idx, target_pools)) {
+      ++counters_.objects_repaired;
+      ++repaired;
+    }
+  }
   return repaired;
+}
+
+// Rebuilds the dead shards of one coded copy. Returns true when the object
+// was fully healed (every dead shard reconstructed and spliced).
+bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
+                                       const CopyPlacement& copy,
+                                       const std::vector<size_t>& dead_idx,
+                                       const alloc::PoolMap& target_pools) {
+  if (dead_idx.empty()) return false;
+  const size_t k = copy.ec_data_shards;
+  const size_t m = copy.ec_parity_shards;
+  const size_t n = copy.shards.size();
+  if (k == 0 || n != k + m) return false;
+  const uint64_t L = copy.shards.front().length;
+
+  std::vector<bool> dead(n, false);
+  for (size_t d : dead_idx) dead[d] = true;
+
+  // 1. Fresh placements, one plain wire shard per dead index; anti-affine
+  // with every worker the copy still touches (and earlier replacements).
+  std::vector<NodeId> excluded;
+  for (size_t i = 0; i < n; ++i) {
+    if (!dead[i]) excluded.push_back(copy.shards[i].worker_id);
+  }
+  struct Staged {
+    std::string staging_key;
+    CopyPlacement placement;
+  };
+  std::vector<Staged> staged(dead_idx.size());
+  auto free_all_staged = [&](size_t upto) {
+    for (size_t j = 0; j < upto; ++j) adapter_.free_object(staged[j].staging_key);
+  };
+  for (size_t j = 0; j < dead_idx.size(); ++j) {
+    const size_t d = dead_idx[j];
+    WorkerConfig cfg = {};
+    cfg.replication_factor = 1;
+    cfg.max_workers_per_copy = 1;
+    staged[j].staging_key = key + "\x01" "ecrepair" + std::to_string(d);
+    alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+        staged[j].staging_key, L, cfg);
+    // Stay in a wire tier (a device shard would be unreadable to the coded
+    // client path, even on the relaxed retry); same class as the lost shard
+    // when possible.
+    req.wire_only = true;
+    req.preferred_classes = {copy.shards[d].storage_class};
+    req.excluded_nodes = excluded;
+    auto attempt = adapter_.allocator().allocate(req, target_pools);
+    if (!attempt.ok()) {
+      req.excluded_nodes.clear();
+      attempt = adapter_.allocator().allocate(req, target_pools);
+    }
+    // The coded geometry needs exactly ONE shard at this position.
+    if (!attempt.ok() || attempt.value().copies[0].shards.size() != 1 ||
+        std::holds_alternative<DeviceLocation>(
+            attempt.value().copies[0].shards[0].location)) {
+      if (attempt.ok()) adapter_.free_object(staged[j].staging_key);
+      free_all_staged(j);
+      LOG_WARN << "ec repair of " << key << " stays degraded: no placement for shard " << d;
+      return false;
+    }
+    staged[j].placement = std::move(attempt).value().copies[0];
+    excluded.push_back(staged[j].placement.shards[0].worker_id);
+  }
+
+  // 2. Segmented reconstruction: read each segment from k survivors,
+  // rebuild missing data rows, re-encode missing parity rows, write out.
+  constexpr uint64_t kSeg = 8ull << 20;
+  std::vector<size_t> basis;  // the k survivors we read (data first)
+  for (size_t i = 0; i < n && basis.size() < k; ++i) {
+    if (!dead[i]) basis.push_back(i);
+  }
+  if (basis.size() < k) {
+    free_all_staged(staged.size());
+    return false;  // beyond tolerance (pass 1 should have caught this)
+  }
+  bool parity_dead = false;
+  for (size_t d : dead_idx) parity_dead |= d >= k;
+
+  std::vector<std::vector<uint8_t>> seg_bufs(n);  // read/rebuilt segments
+  const uint64_t seg_cap = std::min<uint64_t>(L, kSeg);
+  for (size_t i : basis) seg_bufs[i].resize(seg_cap);
+  for (size_t d : dead_idx) seg_bufs[d].resize(seg_cap);
+  // Parity re-encode needs every data row; data rows outside the basis and
+  // not dead can stay empty unless parity is being rebuilt.
+  if (parity_dead) {
+    for (size_t i = 0; i < k; ++i) seg_bufs[i].resize(seg_cap);
+  }
+  std::vector<std::vector<uint8_t>> parity_rows;
+  if (parity_dead) parity_rows.assign(m, std::vector<uint8_t>(seg_cap));
+
+  for (uint64_t off = 0; off < L; off += kSeg) {
+    const uint64_t seg = std::min(kSeg, L - off);
+    std::vector<const uint8_t*> present(n, nullptr);
+    for (size_t i : basis) {
+      if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(), seg,
+                              /*is_write=*/false) != ErrorCode::OK) {
+        LOG_WARN << "ec repair of " << key << " stays degraded: survivor " << i
+                 << " unreadable";
+        free_all_staged(staged.size());
+        return false;
+      }
+      present[i] = seg_bufs[i].data();
+    }
+    // Data rows needed for parity re-encode but outside the basis (only
+    // possible when they are alive: read them too).
+    if (parity_dead) {
+      for (size_t i = 0; i < k; ++i) {
+        if (present[i] || dead[i]) continue;
+        if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(), seg,
+                                /*is_write=*/false) != ErrorCode::OK) {
+          free_all_staged(staged.size());
+          return false;
+        }
+        present[i] = seg_bufs[i].data();
+      }
+    }
+    std::vector<uint8_t*> out(k, nullptr);
+    for (size_t d : dead_idx) {
+      if (d < k) out[d] = seg_bufs[d].data();
+    }
+    if (!ec::rs_reconstruct(present.data(), k, m, seg, out.data())) {
+      free_all_staged(staged.size());
+      return false;
+    }
+    if (parity_dead) {
+      std::vector<const uint8_t*> data_rows(k);
+      for (size_t i = 0; i < k; ++i) data_rows[i] = seg_bufs[i].data();
+      std::vector<uint8_t*> parity_ptrs(m);
+      for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity_rows[j].data();
+      if (!ec::rs_encode(data_rows.data(), k, parity_ptrs.data(), m, seg)) {
+        free_all_staged(staged.size());
+        return false;
+      }
+    }
+    for (size_t j = 0; j < dead_idx.size(); ++j) {
+      const size_t d = dead_idx[j];
+      const uint8_t* src = d < k ? seg_bufs[d].data() : parity_rows[d - k].data();
+      if (transport::shard_io(*data_client_, staged[j].placement.shards[0], off,
+                              const_cast<uint8_t*>(src), seg,
+                              /*is_write=*/true) != ErrorCode::OK) {
+        free_all_staged(staged.size());
+        return false;
+      }
+    }
+  }
+
+  // 3. Splice under the lock iff the object didn't change underneath us.
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end() || it->second.epoch != epoch ||
+      it->second.copies.empty() || it->second.copies.front().shards.size() != n) {
+    lock.unlock();
+    free_all_staged(staged.size());
+    return false;
+  }
+  for (const auto& st : staged) {
+    if (adapter_.allocator().merge_objects(st.staging_key, key) != ErrorCode::OK) {
+      lock.unlock();
+      LOG_ERROR << "ec repair merge failed for " << key;
+      // Staged keys not yet merged are freed; merged ranges now belong to
+      // the object and are released when it is removed.
+      free_all_staged(staged.size());
+      return false;
+    }
+  }
+  for (size_t j = 0; j < dead_idx.size(); ++j) {
+    // Dead shards' range bookkeeping was already dropped in pass 1; the
+    // entries are replaced in place, preserving the geometry order.
+    it->second.copies.front().shards[dead_idx[j]] = staged[j].placement.shards[0];
+  }
+  it->second.epoch = next_epoch_.fetch_add(1);
+  persist_object(key, it->second);
+  bump_view();
+  LOG_INFO << "ec repair rebuilt " << dead_idx.size() << " shard(s) of " << key;
+  return true;
 }
 
 // ---- eviction -------------------------------------------------------------

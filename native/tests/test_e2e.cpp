@@ -1048,6 +1048,61 @@ BTEST(ErasureCoding, DegradedReadReconstructsThroughParity) {
   BT_ASSERT(!dead.ok());
 }
 
+BTEST(ErasureCoding, RepairReconstructsLostShardsOntoFreshWorkers) {
+  // 7 workers, ec=(4,2): kill one shard's worker; repair must REBUILD that
+  // shard from survivors onto the spare worker (not just leave the object
+  // degraded), restoring full m-loss tolerance.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(7, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  auto data = pattern(700 * 1024, 55);
+  BT_ASSERT(client->put("ec/heal", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto before = client->get_workers("ec/heal");
+  BT_ASSERT_OK(before);
+  const auto victim = before.value()[0].shards[2].worker_id;  // a data shard
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim) cluster.kill_worker(i);
+  }
+
+  BT_EXPECT(eventually(
+      [&] { return cluster.keystone().counters().objects_repaired.load() >= 1; }));
+  auto after = client->get_workers("ec/heal");
+  BT_ASSERT_OK(after);
+  const auto& copy = after.value()[0];
+  BT_ASSERT(copy.shards.size() == 6);  // geometry intact
+  BT_EXPECT_EQ(copy.ec_data_shards, 4u);
+  for (const auto& s : copy.shards) {
+    BT_EXPECT(s.worker_id != victim);  // the lost shard moved to a live worker
+  }
+  // Anti-affinity preserved: still one shard per worker.
+  std::set<std::string> workers;
+  for (const auto& s : copy.shards) workers.insert(s.worker_id);
+  BT_EXPECT_EQ(workers.size(), 6u);
+
+  auto back = client->get("ec/heal");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // Healed means FULL tolerance is back: two more deaths still read.
+  auto p2 = client->get_workers("ec/heal");
+  BT_ASSERT_OK(p2);
+  for (size_t si : {size_t{0}, size_t{5}}) {
+    const auto w = p2.value()[0].shards[si].worker_id;
+    for (size_t i = 0; i < cluster.worker_count(); ++i) {
+      if ("worker-" + std::to_string(i) == w) cluster.kill_worker(i);
+    }
+  }
+  BT_EXPECT(eventually([&] {
+    auto b2 = client->get("ec/heal");
+    return b2.ok() && b2.value() == data;
+  }, 8000));
+}
+
 BTEST(ErasureCoding, WorkerDeathLeavesObjectDegradedButReadable) {
   auto options = EmbeddedClusterOptions::simple(6, 4 << 20);
   EmbeddedCluster cluster(options);

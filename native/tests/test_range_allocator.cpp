@@ -543,8 +543,6 @@ BTEST(RangeAllocator, EcCapacityCheckCountsWholeShards) {
   PoolMap roomy;
   roomy["a"] = make_pool("a", "na", 220 * 1024);
   roomy["b"] = make_pool("b", "nb", 220 * 1024);
-  auto fits = alloc.allocate(make_request("ec-fits", 1), roomy);  // warm allocators
-  (void)fits;
   RangeAllocator fresh;
   auto req2 = make_request("ec-tight2", 300 * 1024);
   req2.ec_data_shards = 3;

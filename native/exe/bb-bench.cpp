@@ -77,10 +77,23 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--json")) json = true;
     else if (!std::strcmp(argv[i], "--sweep")) sweep = true;
     else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
+      const std::string km = argv[++i];
+      const size_t comma = km.find(',');
+      if (comma == std::string::npos) { std::fprintf(stderr, "--ec needs K,M\n"); return 2; }
+      try {
+        wc.ec_data_shards = std::stoul(km.substr(0, comma));
+        wc.ec_parity_shards = std::stoul(km.substr(comma + 1));
+      } catch (...) { std::fprintf(stderr, "--ec needs K,M\n"); return 2; }
+      if (wc.ec_data_shards == 0 || wc.ec_parity_shards == 0) {
+        std::fprintf(stderr, "--ec needs K >= 1 and M >= 1\n");
+        return 2;
+      }
+    }
     else if (!std::strcmp(argv[i], "--help")) {
       std::printf(
           "usage: bb-bench (--keystone host:port | --embedded N) [--size BYTES]\n"
-          "       [--iterations N] [--replicas R] [--max-workers W]\n"
+          "       [--iterations N] [--replicas R] [--max-workers W] [--ec K,M]\n"
           "       [--transport local|shm|tcp] [--json] [--sweep] [--batch N]\n");
       return 0;
     }
@@ -97,8 +110,11 @@ int main(int argc, char** argv) {
     // Size pools for the LARGEST point that will run (sweep maxes at 16 MiB),
     // so large batched points don't run under eviction pressure.
     const uint64_t max_size = sweep ? std::max<uint64_t>(size, 16ull << 20) : size;
+    const uint64_t stored_factor = wc.ec_parity_shards > 0
+        ? (wc.ec_data_shards + wc.ec_parity_shards + wc.ec_data_shards - 1) / wc.ec_data_shards
+        : wc.replication_factor;
     const uint64_t pool_bytes = std::max<uint64_t>(
-        64ull << 20, 4 * max_size * wc.replication_factor * std::max(1, batch));
+        64ull << 20, 4 * max_size * stored_factor * std::max(1, batch));
     auto options = client::EmbeddedClusterOptions::simple(
         static_cast<size_t>(embedded_workers), pool_bytes);
     options.use_coordinator = false;

@@ -912,11 +912,18 @@ BTEST(EndToEnd, ChurnLeavesNoLeakedRangesOrFragmentation) {
       else BT_ASSERT(ec == ErrorCode::INSUFFICIENT_SPACE);  // pool full is fine
     } else {
       const size_t pick = rng() % live.size();
-      BT_ASSERT(client->remove(live[pick]) == ErrorCode::OK);
+      // Watermark eviction may legitimately beat the remove to an unpinned
+      // LRU object when churn holds utilization near the threshold (seen
+      // under TSan's slowdown, where the health loop runs mid-churn).
+      const auto ec = client->remove(live[pick]);
+      BT_ASSERT(ec == ErrorCode::OK || ec == ErrorCode::OBJECT_NOT_FOUND);
       live.erase(live.begin() + static_cast<ptrdiff_t>(pick));
     }
   }
-  for (const auto& key : live) BT_ASSERT(client->remove(key) == ErrorCode::OK);
+  for (const auto& key : live) {
+    const auto ec = client->remove(key);
+    BT_ASSERT(ec == ErrorCode::OK || ec == ErrorCode::OBJECT_NOT_FOUND);
+  }
 
   auto stats = client->cluster_stats();
   BT_ASSERT_OK(stats);

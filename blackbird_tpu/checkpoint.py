@@ -72,7 +72,7 @@ def _put_fresh(client, key: str, data, **kwargs) -> None:
 
 
 def save_sharded(client, prefix: str, array, *, replicas: int = 1,
-                 preferred_class=None) -> None:
+                 preferred_class=None, ec: tuple[int, int] | None = None) -> None:
     """Saves `array` (sharded or single-device) under `prefix`.
 
     Writes one object per *distinct* shard box (replicated shards are
@@ -90,6 +90,14 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
     if not isinstance(array, jax.Array):
         array = jax.numpy.asarray(array)
     kwargs = {"replicas": replicas}
+    if ec is not None:
+        # Checkpoints are the natural erasure-coding consumer: large, cold,
+        # durability-critical. ec=(k, m) stores each shard object as one
+        # RS-coded copy — any m worker losses tolerated at (k+m)/k storage
+        # (replicas is ignored by the store when ec is set). The tiny meta
+        # object stays replicated: coding a few hundred bytes k-ways wastes
+        # placement slots for no durability gain.
+        kwargs["ec"] = ec
     if preferred_class is not None:
         kwargs["preferred_class"] = preferred_class
     my_process = jax.process_index()
@@ -147,7 +155,15 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
             client.remove(prefix + _META_SUFFIX)
         except Exception:  # noqa: BLE001
             pass
-    _put_fresh(client, prefix + _META_SUFFIX, json.dumps(meta).encode(), **kwargs)
+    meta_kwargs = {k: v for k, v in kwargs.items() if k != "ec"}
+    if ec is not None:
+        # The meta must survive what the coded shards survive (m losses).
+        # ec=(1, m) degenerates to m+1 single-shard copies (scalar multiples
+        # of the data; any ONE reconstructs it) on distinct workers — unlike
+        # `replicas`, not clamped by the keystone's max_replicas, so the
+        # tolerance actually matches.
+        meta_kwargs["ec"] = (1, ec[1])
+    _put_fresh(client, prefix + _META_SUFFIX, json.dumps(meta).encode(), **meta_kwargs)
     # Drop old shard objects the new layout no longer references.
     for stale in old_keys - {s["key"] for s in shards_meta}:
         try:

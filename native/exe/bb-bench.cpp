@@ -79,6 +79,10 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
       const std::string km = argv[++i];
+      if (km.find('-') != std::string::npos) {  // stoul silently wraps negatives
+        std::fprintf(stderr, "--ec needs K,M\n");
+        return 2;
+      }
       const size_t comma = km.find(',');
       if (comma == std::string::npos) { std::fprintf(stderr, "--ec needs K,M\n"); return 2; }
       try {

@@ -245,3 +245,22 @@ def test_checkpoint_onto_ici_device_mesh():
             np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
     finally:
         JaxHbmProvider.unregister()
+
+
+def test_erasure_coded_checkpoint_roundtrip(store):
+    mesh = make_mesh(8)
+    arr = jax.device_put(
+        np.arange(8192, dtype=np.float32).reshape(64, 128),
+        NamedSharding(mesh, P("workers", None)),
+    )
+    save_sharded(store, "ckpt/ec", arr, ec=(2, 1))
+    # Every shard object is one coded copy; the meta stays replicated.
+    for obj in store.list("ckpt/ec/shard/"):
+        copies = store.placements(obj["key"])
+        assert len(copies) == 1 and copies[0]["ec"]["data_shards"] == 2
+    # Meta is stored as a degenerate (1, m) code: m+1 single-shard copies
+    # on distinct workers — the same loss tolerance as the coded shards.
+    meta_ec = store.placements("ckpt/ec/meta")[0]["ec"]
+    assert meta_ec["data_shards"] == 1 and meta_ec["parity_shards"] == 1
+    back = load_sharded(store, "ckpt/ec", sharding=NamedSharding(mesh, P(None, "workers")))
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))

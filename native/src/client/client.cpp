@@ -80,36 +80,13 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
                             const WorkerConfig& config) {
+  // One-item batch: put_many pipelines the wire shards of EVERY copy in a
+  // single pass (a replicated put costs ~one round trip, not one per copy),
+  // coalesces device shards, and rolls back failed reservations — the exact
+  // single-object semantics (put_start -> transfer -> complete/cancel,
+  // reference blackbird_client.cpp:87-117) with none of the code repeated.
   TRACE_SPAN("client.put");
-  Result<std::vector<CopyPlacement>> placed = ErrorCode::INTERNAL_ERROR;
-  {
-    TRACE_SPAN("client.put.start_rpc");
-    placed = embedded_
-                 ? embedded_->put_start(key, size, config)
-                 : rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
-                     return r.put_start(key, size, config);
-                   });
-  }
-  if (!placed.ok()) return placed.error();
-
-  const auto* bytes = static_cast<const uint8_t*>(data);
-  TRACE_SPAN("client.put.transfer");
-  for (const auto& copy : placed.value()) {
-    if (auto ec = transfer_copy_put(copy, bytes, size); ec != ErrorCode::OK) {
-      // Roll back the reservation (reference blackbird_client.cpp:104-107).
-      LOG_WARN << "put " << key << " transfer failed (" << to_string(ec) << "), cancelling";
-      if (embedded_) {
-        embedded_->put_cancel(key);
-      } else {
-        rpc_failover(/*idempotent=*/false,
-                     [&](rpc::KeystoneRpcClient& r) { return r.put_cancel(key); });
-      }
-      return ec;
-    }
-  }
-  if (embedded_) return embedded_->put_complete(key);
-  return rpc_failover(/*idempotent=*/false,
-                      [&](rpc::KeystoneRpcClient& r) { return r.put_complete(key); });
+  return put_many({{key, data, size}}, config)[0];
 }
 
 Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
@@ -648,8 +625,11 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     }
   }
 
-  run_device_jobs(*data_, jobs, /*is_write=*/true, results);
-  run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results);
+  {
+    TRACE_SPAN("client.put.transfer");
+    run_device_jobs(*data_, jobs, /*is_write=*/true, results);
+    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results);
+  }
   // Device writes may be asynchronous; put_complete must not be sent until
   // the bytes are durably in the tier.
   if (!jobs.device.empty()) {
@@ -668,6 +648,8 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
       completes.push_back(items[i].key);
       complete_idx.push_back(i);
     } else {
+      LOG_WARN << "put " << items[i].key << " transfer failed ("
+               << to_string(results[i]) << "), cancelling";
       cancels.push_back(items[i].key);
     }
   }

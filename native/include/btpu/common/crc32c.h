@@ -1,0 +1,19 @@
+// CRC32C (Castagnoli) — the end-to-end object integrity checksum.
+//
+// Clients stamp objects at put_start and verify on get; a mismatch is
+// treated as copy/shard loss (replica failover, or parity reconstruction
+// for erasure-coded objects), making bit-rot self-healing where redundancy
+// exists. No reference counterpart — blackbird trusts the transport.
+// Hardware CRC32 instruction (SSE4.2) when available, sliced table fallback.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace btpu {
+
+// CRC32C of [data, data+len); `seed` chains incremental computation
+// (pass the previous return value). 0 is the conventional initial seed.
+uint32_t crc32c(const void* data, size_t len, uint32_t seed = 0);
+
+}  // namespace btpu

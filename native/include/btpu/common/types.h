@@ -163,6 +163,10 @@ struct CopyPlacement {
   uint32_t ec_data_shards{0};
   uint32_t ec_parity_shards{0};
   uint64_t ec_object_size{0};
+  // CRC32C of the object bytes, stamped by the writing client at put_start
+  // (0 = unknown). Readers verify after assembling the object; a mismatch
+  // is treated as copy loss (failover / parity reconstruction).
+  uint32_t content_crc{0};
   size_t shards_size() const noexcept { return shards.size(); }
 };
 
@@ -247,7 +251,12 @@ struct ObjectExistsResponse { bool exists{false}; ErrorCode error_code{ErrorCode
 struct GetWorkersRequest { ObjectKey key; };
 struct GetWorkersResponse { std::vector<CopyPlacement> copies; ErrorCode error_code{ErrorCode::OK}; };
 
-struct PutStartRequest { ObjectKey key; uint64_t data_size{0}; WorkerConfig config; };
+struct PutStartRequest {
+  ObjectKey key;
+  uint64_t data_size{0};
+  WorkerConfig config;
+  uint32_t content_crc{0};  // CRC32C of the bytes about to be written
+};
 struct PutStartResponse { std::vector<CopyPlacement> copies; ErrorCode error_code{ErrorCode::OK}; };
 
 struct PutCompleteRequest { ObjectKey key; };
@@ -298,7 +307,12 @@ struct BatchGetWorkersResponse {
   ErrorCode error_code{ErrorCode::OK};
 };
 
-struct BatchPutStartItem { ObjectKey key; uint64_t data_size{0}; WorkerConfig config; };
+struct BatchPutStartItem {
+  ObjectKey key;
+  uint64_t data_size{0};
+  WorkerConfig config;
+  uint32_t content_crc{0};
+};
 struct BatchPutStartRequest { std::vector<BatchPutStartItem> requests; };
 struct BatchPutStartResponse {
   std::vector<Result<std::vector<CopyPlacement>>> results;

@@ -212,11 +212,11 @@ inline bool decode(Reader& r, ShardPlacement& s) {
 
 inline void encode(Writer& w, const CopyPlacement& c) {
   encode_fields(w, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                c.ec_object_size);
+                c.ec_object_size, c.content_crc);
 }
 inline bool decode(Reader& r, CopyPlacement& c) {
   return decode_fields(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                       c.ec_object_size);
+                       c.ec_object_size, c.content_crc);
 }
 
 inline void encode(Writer& w, const WorkerConfig& c) {
@@ -271,10 +271,10 @@ inline bool decode(Reader& r, MemoryPool& p) {
 }
 
 inline void encode(Writer& w, const BatchPutStartItem& i) {
-  encode_fields(w, i.key, i.data_size, i.config);
+  encode_fields(w, i.key, i.data_size, i.config, i.content_crc);
 }
 inline bool decode(Reader& r, BatchPutStartItem& i) {
-  return decode_fields(r, i.key, i.data_size, i.config);
+  return decode_fields(r, i.key, i.data_size, i.config, i.content_crc);
 }
 
 template <typename T>
@@ -321,7 +321,7 @@ BTPU_WIRE_STRUCT(ObjectExistsRequest, f0)
 BTPU_WIRE_STRUCT(ObjectExistsResponse, f0, f1)
 BTPU_WIRE_STRUCT(GetWorkersRequest, f0)
 BTPU_WIRE_STRUCT(GetWorkersResponse, f0, f1)
-BTPU_WIRE_STRUCT(PutStartRequest, f0, f1, f2)
+BTPU_WIRE_STRUCT(PutStartRequest, f0, f1, f2, f3)
 BTPU_WIRE_STRUCT(PutStartResponse, f0, f1)
 BTPU_WIRE_STRUCT(PutCompleteRequest, f0)
 BTPU_WIRE_STRUCT(PutCompleteResponse, f0)

@@ -105,8 +105,11 @@ class KeystoneService {
   // ---- object API (RPC surface, reference keystone_service.h:84-322) ----
   Result<bool> object_exists(const ObjectKey& key);
   Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
+  // content_crc: CRC32C of the bytes the client is about to write (0 =
+  // unknown); stamped into every returned CopyPlacement so readers verify.
   Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
-                                               const WorkerConfig& config);
+                                               const WorkerConfig& config,
+                                               uint32_t content_crc = 0);
   ErrorCode put_complete(const ObjectKey& key);
   ErrorCode put_cancel(const ObjectKey& key);
   ErrorCode remove_object(const ObjectKey& key);

@@ -21,7 +21,8 @@ class KeystoneRpcClient {
   Result<bool> object_exists(const ObjectKey& key);
   Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
   Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
-                                               const WorkerConfig& config);
+                                               const WorkerConfig& config,
+                                               uint32_t content_crc = 0);
   ErrorCode put_complete(const ObjectKey& key);
   ErrorCode put_cancel(const ObjectKey& key);
   ErrorCode remove_object(const ObjectKey& key);

@@ -322,6 +322,8 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
     if (!first_copy) json += ",";
     first_copy = false;
     json += "{\"copy_index\":" + std::to_string(copy.copy_index);
+    if (copy.content_crc != 0)
+      json += ",\"crc\":" + std::to_string(copy.content_crc);
     if (copy.ec_data_shards > 0) {
       json += ",\"ec\":{\"data_shards\":" + std::to_string(copy.ec_data_shards) +
               ",\"parity_shards\":" + std::to_string(copy.ec_parity_shards) +

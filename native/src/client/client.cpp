@@ -2,6 +2,7 @@
 
 #include <cstring>
 
+#include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/ec/rs.h"
@@ -213,7 +214,17 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
                                           ops))
       return ErrorCode::NOT_IMPLEMENTED;
   }
-  return data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+      ec != ErrorCode::OK)
+    return ec;
+  const uint32_t expect = copies.front().content_crc;
+  if (expect != 0 && crc32c(buffer, size) != expect) {
+    // Some slice came from a corrupt replica; the caller's per-copy
+    // (verified) reads identify the healthy one.
+    LOG_WARN << "content crc mismatch on split-replica read: retrying per copy";
+    return ErrorCode::CHECKSUM_MISMATCH;
+  }
+  return ErrorCode::OK;
 }
 
 // ---- erasure-coded copies --------------------------------------------------
@@ -303,34 +314,88 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
   auto copy_out = [&](size_t i, const uint8_t* src) {
     if (valid_of(i) > 0 && valid_of(i) < L) std::memcpy(data + i * L, src, valid_of(i));
   };
-  if (missing == 0) {
-    for (size_t i = 0; i < k; ++i) {
-      if (!temps[i].empty()) copy_out(i, temps[i].data());
+  // Parity fetch (shared by the degraded path and the corruption hunt).
+  std::vector<std::vector<uint8_t>> parity;
+  auto fetch_parity = [&] {
+    if (!parity.empty()) return;
+    parity.assign(m, std::vector<uint8_t>(L));
+    std::vector<transport::WireOp> pops(m);
+    for (size_t j = 0; j < m; ++j) {
+      if (!transport::make_wire_op(copy.shards[k + j], 0, parity[j].data(), L, pops[j])) {
+        addressable[k + j] = false;
+        pops[j] = {};
+      }
     }
-    return ErrorCode::OK;
+    data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);
+    for (size_t j = 0; j < m; ++j)
+      have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK;
+  };
+  // Shard i's current bytes (user buffer or padded temp).
+  auto shard_bytes = [&](size_t i) -> const uint8_t* {
+    return temps[i].empty() ? data + i * L : temps[i].data();
+  };
+  // Verifies the object CRC treating per-shard sources; `override_i`/bytes
+  // substitute one shard (the corruption hunt's candidate reconstruction).
+  auto crc_with = [&](size_t override_i, const uint8_t* override_bytes) {
+    uint32_t crc = 0;
+    for (size_t i = 0; i < k; ++i) {
+      const uint64_t valid = valid_of(i);
+      if (valid == 0) break;
+      const uint8_t* src = i == override_i ? override_bytes : shard_bytes(i);
+      crc = crc32c(src, valid, crc);
+    }
+    return crc;
+  };
+
+  if (missing == 0) {
+    if (copy.content_crc == 0 || crc_with(k + m, nullptr) == copy.content_crc) {
+      for (size_t i = 0; i < k; ++i) {
+        if (!temps[i].empty()) copy_out(i, temps[i].data());
+      }
+      return ErrorCode::OK;
+    }
+    // CRC mismatch with every data shard readable: one of them is silently
+    // corrupt (bit rot). Hunt it — reconstruct each candidate from parity
+    // in turn and keep the variant whose CRC matches.
+    LOG_WARN << "ec read: content crc mismatch, hunting the corrupt shard";
+    fetch_parity();
+    std::vector<uint8_t> candidate(L);
+    for (size_t i = 0; i < k; ++i) {
+      if (valid_of(i) == 0) break;  // padding shards cannot corrupt the crc
+      std::vector<const uint8_t*> present(k + m, nullptr);
+      for (size_t x = 0; x < k; ++x) {
+        if (x != i) present[x] = shard_bytes(x);
+      }
+      for (size_t j = 0; j < m; ++j) {
+        if (have[k + j]) present[k + j] = parity[j].data();
+      }
+      std::vector<uint8_t*> out(k, nullptr);
+      out[i] = candidate.data();
+      if (!ec::rs_reconstruct(present.data(), k, m, L, out.data())) continue;
+      if (crc_with(i, candidate.data()) == copy.content_crc) {
+        LOG_WARN << "ec read: shard " << i << " was corrupt; reconstructed through parity";
+        const uint64_t valid = valid_of(i);
+        std::memcpy(data + i * L, candidate.data(), valid);
+        for (size_t x = 0; x < k; ++x) {
+          if (x != i && !temps[x].empty()) copy_out(x, temps[x].data());
+        }
+        return ErrorCode::OK;
+      }
+    }
+    return ErrorCode::CHECKSUM_MISMATCH;  // multi-shard corruption: beyond m=?
   }
   if (missing > m) return ErrorCode::NO_COMPLETE_WORKER;
 
   // Degraded read: fetch parity shards, reconstruct the missing data.
   LOG_WARN << "ec read: " << missing << " data shard(s) unreadable, reconstructing";
-  std::vector<std::vector<uint8_t>> parity(m, std::vector<uint8_t>(L));
-  std::vector<transport::WireOp> pops(m);
-  for (size_t j = 0; j < m; ++j) {
-    if (!transport::make_wire_op(copy.shards[k + j], 0, parity[j].data(), L, pops[j])) {
-      addressable[k + j] = false;
-      pops[j] = {};
-    }
-  }
-  data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);
-  for (size_t j = 0; j < m; ++j)
-    have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK;
+  fetch_parity();
 
   std::vector<std::vector<uint8_t>> rebuilt(k);
   std::vector<const uint8_t*> present(k + m, nullptr);
   std::vector<uint8_t*> out(k, nullptr);
   for (size_t i = 0; i < k; ++i) {
     if (have[i]) {
-      present[i] = temps[i].empty() ? data + i * L : temps[i].data();
+      present[i] = shard_bytes(i);
     } else {
       rebuilt[i].resize(L);
       out[i] = rebuilt[i].data();
@@ -346,6 +411,17 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
       if (!temps[i].empty()) copy_out(i, temps[i].data());
     } else if (valid_of(i) > 0) {
       std::memcpy(data + i * L, rebuilt[i].data(), valid_of(i));
+    }
+  }
+  if (copy.content_crc != 0) {
+    uint32_t crc = 0;
+    for (size_t i = 0; i < k && valid_of(i) > 0; ++i) {
+      const uint8_t* src = have[i] ? shard_bytes(i) : rebuilt[i].data();
+      crc = crc32c(src, valid_of(i), crc);
+    }
+    if (crc != copy.content_crc) {
+      LOG_WARN << "ec read: crc mismatch after degraded reconstruction";
+      return ErrorCode::CHECKSUM_MISMATCH;
     }
   }
   return ErrorCode::OK;
@@ -385,20 +461,34 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
       if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
     }
   }
-  if (wire_idx.empty()) return ErrorCode::OK;
-  // Wire shards move as one pipelined batch: every request issued before any
-  // response is awaited, so a striped object costs ~one round trip.
-  std::vector<transport::WireOp> ops;
-  ops.reserve(wire_idx.size());
-  for (size_t i : wire_idx) {
-    const auto& shard = copy.shards[i];
-    transport::WireOp op;
-    if (!transport::make_wire_op(shard, 0, data + offsets[i], shard.length, op))
-      return ErrorCode::NOT_IMPLEMENTED;  // FileLocation: worker-served
-    ops.push_back(op);
+  if (!wire_idx.empty()) {
+    // Wire shards move as one pipelined batch: every request issued before
+    // any response is awaited, so a striped object costs ~one round trip.
+    std::vector<transport::WireOp> ops;
+    ops.reserve(wire_idx.size());
+    for (size_t i : wire_idx) {
+      const auto& shard = copy.shards[i];
+      transport::WireOp op;
+      if (!transport::make_wire_op(shard, 0, data + offsets[i], shard.length, op))
+        return ErrorCode::NOT_IMPLEMENTED;  // FileLocation: worker-served
+      ops.push_back(op);
+    }
+    if (is_write)
+      return data_->write_batch(ops.data(), ops.size(), options_.io_parallelism);
+    if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+        ec != ErrorCode::OK)
+      return ec;
+  } else if (is_write) {
+    return ErrorCode::OK;
   }
-  return is_write ? data_->write_batch(ops.data(), ops.size(), options_.io_parallelism)
-                  : data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  // Verify AFTER every shard (device and wire alike) has landed: a
+  // device-only copy bit-rots just as silently as a host one.
+  if (copy.content_crc != 0 && crc32c(data, size) != copy.content_crc) {
+    LOG_WARN << "content crc mismatch on copy " << copy.copy_index
+             << " (bit rot or torn write): treating as copy loss";
+    return ErrorCode::CHECKSUM_MISMATCH;
+  }
+  return ErrorCode::OK;
 }
 
 ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
@@ -590,7 +680,8 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
 
   std::vector<BatchPutStartItem> starts;
   starts.reserve(items.size());
-  for (const auto& item : items) starts.push_back({item.key, item.size, config});
+  for (const auto& item : items)
+    starts.push_back({item.key, item.size, config, crc32c(item.data, item.size)});
   std::vector<Result<std::vector<CopyPlacement>>> placed;
   if (embedded_) {
     placed = embedded_->batch_put_start(starts);
@@ -735,6 +826,18 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
   run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors);
   for (const auto& fix : ec_fixups) {
     if (errors[fix.item] == ErrorCode::OK) std::memcpy(fix.dst, fix.src, fix.n);
+  }
+  // Integrity gate: a clean-looking first-pass read with a CRC mismatch is
+  // demoted to a failure so the per-item retry below heals it (replica
+  // failover, or the coded path's corruption hunt).
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (errors[i] != ErrorCode::OK || !placements[i].ok() || placements[i].value().empty())
+      continue;
+    const uint32_t expect = placements[i].value().front().content_crc;
+    if (expect != 0 && crc32c(items[i].buffer, sizes[i]) != expect) {
+      LOG_WARN << "get_many: content crc mismatch on " << items[i].key << "; retrying";
+      errors[i] = ErrorCode::CHECKSUM_MISMATCH;
+    }
   }
 
   for (size_t i = 0; i < items.size(); ++i) {

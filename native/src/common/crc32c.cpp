@@ -1,0 +1,63 @@
+#include "btpu/common/crc32c.h"
+
+#include <array>
+
+#if defined(__x86_64__)
+#include <nmmintrin.h>
+#endif
+
+namespace btpu {
+
+namespace {
+
+// Table fallback (single-slice; the hardware path is the one that matters).
+struct Crc32cTable {
+  std::array<uint32_t, 256> t{};
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int b = 0; b < 8; ++b) c = (c >> 1) ^ (0x82f63b78u & (0u - (c & 1)));
+      t[i] = c;
+    }
+  }
+};
+
+const Crc32cTable& table() {
+  static const Crc32cTable tbl;
+  return tbl;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2"))) uint32_t crc32c_hw(const uint8_t* p, size_t len,
+                                                     uint32_t crc) {
+  while (len >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = _mm_crc32_u8(crc, *p++);
+  return crc;
+}
+
+bool have_sse42() {
+  static const bool yes = __builtin_cpu_supports("sse4.2");
+  return yes;
+}
+#endif
+
+}  // namespace
+
+uint32_t crc32c(const void* data, size_t len, uint32_t seed) {
+  const auto* p = static_cast<const uint8_t*>(data);
+  uint32_t crc = ~seed;
+#if defined(__x86_64__)
+  if (have_sse42()) return ~crc32c_hw(p, len, crc);
+#endif
+  const auto& t = table().t;
+  for (size_t i = 0; i < len; ++i) crc = (crc >> 8) ^ t[(crc ^ p[i]) & 0xff];
+  return ~crc;
+}
+
+}  // namespace btpu

@@ -5,6 +5,7 @@
 
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/common/wire.h"
 #include "btpu/ec/rs.h"
 #include "btpu/storage/hbm_provider.h"
@@ -92,6 +93,30 @@ bool decode_config_legacy(wire::Reader& r, WorkerConfig& c) {
   return true;
 }
 
+// EC-era layout: CopyPlacement carries the ec fields but predates
+// content_crc. Same upgrade-survival rule as the pre-EC layout.
+bool decode_copy_ec_legacy(wire::Reader& r, CopyPlacement& c) {
+  c.content_crc = 0;
+  return wire::decode_fields(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
+                             c.ec_object_size);
+}
+
+bool decode_object_record_ec_legacy(const std::string& bytes, ObjectRecord& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
+  if (!wire::decode(r, out.config)) return false;
+  uint32_t n = 0;
+  if (!r.get(n) || n > r.remaining()) return false;
+  out.copies.clear();
+  out.copies.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    CopyPlacement c;
+    if (!decode_copy_ec_legacy(r, c)) return false;
+    out.copies.push_back(std::move(c));
+  }
+  return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
+}
+
 bool decode_object_record_legacy(const std::string& bytes, ObjectRecord& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
   if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
@@ -113,6 +138,7 @@ bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
   if (wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
                           out.copies, out.created_wall_ms, out.last_access_wall_ms))
     return true;
+  if (decode_object_record_ec_legacy(bytes, out)) return true;
   return decode_object_record_legacy(bytes, out);
 }
 
@@ -161,7 +187,10 @@ ErrorCode device_copy_object(const CopyPlacement& src, const CopyPlacement& dst,
 // Streams `size` bytes from `src` into every copy in `dsts` through a bounded
 // chunk buffer, so keystone-side data movement (repair, demotion) never
 // buffers a whole object in host memory. Fully device-resident src->dst
-// pairs skip the host entirely (ICI path).
+// pairs skip the host entirely (ICI path). The source's CRC (when stamped)
+// is verified as the bytes stream: a mover must never propagate a
+// bit-rotten copy — the caller fails over to the next source instead
+// (device->device moves skip the check; those bytes never touch the host).
 ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
                             const std::vector<CopyPlacement>& dsts, uint64_t size) {
   std::vector<const CopyPlacement*> staged;
@@ -178,16 +207,23 @@ ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacem
 
   constexpr uint64_t kChunk = 16ull << 20;
   std::vector<uint8_t> buf(static_cast<size_t>(std::min(size, kChunk)));
+  uint32_t crc = 0;
   for (uint64_t off = 0; off < size; off += kChunk) {
     const uint64_t n = std::min(kChunk, size - off);
     if (auto ec = copy_io(client, src, off, buf.data(), n, /*is_write=*/false);
         ec != ErrorCode::OK)
       return ec;
+    crc = crc32c(buf.data(), n, crc);
     for (const CopyPlacement* dst : staged) {
       if (auto ec = copy_io(client, *dst, off, buf.data(), n, /*is_write=*/true);
           ec != ErrorCode::OK)
         return ec;
     }
+  }
+  if (src.content_crc != 0 && crc != src.content_crc) {
+    LOG_WARN << "mover source copy " << src.copy_index
+             << " failed crc verification; trying another source";
+    return ErrorCode::CHECKSUM_MISMATCH;
   }
   return ErrorCode::OK;
 }
@@ -778,7 +814,8 @@ Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey&
 
 Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& key,
                                                               uint64_t size,
-                                                              const WorkerConfig& config) {
+                                                              const WorkerConfig& config,
+                                                              uint32_t content_crc) {
   if (key.empty()) return ErrorCode::INVALID_KEY;
   // 0x01 is reserved as the internal staging-key separator (demotion/repair
   // stage replacement placements under "<key>\x01..."); letting clients use
@@ -814,6 +851,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
     placed = adapter_.allocate_data_copies(key, size, effective, pools_snapshot);
   }
   if (!placed.ok()) return placed.error();
+  for (auto& copy : placed.value()) copy.content_crc = content_crc;
 
   ObjectInfo info;
   info.size = size;
@@ -906,7 +944,8 @@ std::vector<Result<std::vector<CopyPlacement>>> KeystoneService::batch_put_start
     const std::vector<BatchPutStartItem>& items) {
   std::vector<Result<std::vector<CopyPlacement>>> out;
   out.reserve(items.size());
-  for (const auto& item : items) out.push_back(put_start(item.key, item.data_size, item.config));
+  for (const auto& item : items)
+    out.push_back(put_start(item.key, item.data_size, item.config, item.content_crc));
   return out;
 }
 
@@ -1547,6 +1586,9 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     }
     for (auto& copy : staged) {
       copy.copy_index = it->second.copies.size();
+      copy.content_crc = it->second.copies.empty()
+                             ? 0
+                             : it->second.copies.front().content_crc;
       it->second.copies.push_back(std::move(copy));
     }
     it->second.epoch = next_epoch_.fetch_add(1);
@@ -1954,6 +1996,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     return DemoteOutcome::kSkipped;
   }
   it->second.copies = std::move(placed).value();
+  for (auto& copy : it->second.copies) copy.content_crc = old_copies.front().content_crc;
   it->second.epoch = next_epoch_.fetch_add(1);
   persist_object(key, it->second);
   bump_view();

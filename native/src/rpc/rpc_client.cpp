@@ -93,10 +93,11 @@ Result<std::vector<CopyPlacement>> KeystoneRpcClient::get_workers(const ObjectKe
 
 Result<std::vector<CopyPlacement>> KeystoneRpcClient::put_start(const ObjectKey& key,
                                                                 uint64_t size,
-                                                                const WorkerConfig& config) {
+                                                                const WorkerConfig& config,
+                                                                uint32_t content_crc) {
   PutStartResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutStart),
-                            PutStartRequest{key, size, config}, resp));
+                            PutStartRequest{key, size, config, content_crc}, resp));
   if (resp.error_code != ErrorCode::OK) return resp.error_code;
   return std::move(resp.copies);
 }

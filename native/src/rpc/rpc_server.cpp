@@ -105,7 +105,7 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
           });
     case Method::kPutStart:
       return handle<PutStartRequest, PutStartResponse>(payload, [&](const auto& req, auto& resp) {
-        auto r = ks.put_start(req.key, req.data_size, req.config);
+        auto r = ks.put_start(req.key, req.data_size, req.config, req.content_crc);
         if (r.ok()) resp.copies = std::move(r).value();
         resp.error_code = r.error();
       });

@@ -11,6 +11,7 @@
 
 #include "btest.h"
 #include "btpu/client/embedded.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/rpc/rpc_server.h"
 
 using namespace btpu;
@@ -1157,4 +1158,139 @@ BTEST(ErasureCoding, WorkerDeathLeavesObjectDegradedButReadable) {
     auto back2 = client->get("ec/survive");
     return back2.ok() && back2.value() == data;
   }));
+}
+
+// ---- end-to-end integrity (CRC32C; no reference counterpart) --------------
+
+BTEST(Integrity, Crc32cKnownVector) {
+  // RFC 3720 test vector: crc32c("123456789") = 0xE3069283.
+  BT_EXPECT_EQ(crc32c("123456789", 9), 0xE3069283u);
+  // Chained == one-shot.
+  BT_EXPECT_EQ(crc32c("6789", 4, crc32c("12345", 5)), 0xE3069283u);
+  BT_EXPECT_EQ(crc32c("", 0), 0u);
+}
+
+BTEST(Integrity, CorruptReplicaSelfHealsFromTheOther) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(256 * 1024, 61);
+  BT_ASSERT(client->put("crc/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  // Flip bytes inside copy 0's region through a raw transport client —
+  // exactly what bit rot or a torn write would leave behind.
+  auto placements = client->get_workers("crc/obj");
+  BT_ASSERT_OK(placements);
+  BT_EXPECT(placements.value()[0].content_crc != 0u);
+  auto corrupt = [&](const CopyPlacement& copy) {
+    const auto& shard = copy.shards[0];
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    std::vector<uint8_t> garbage(4096, 0x5a);
+    auto raw = transport::make_transport_client();
+    BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 1000, mem.rkey, garbage.data(),
+                         garbage.size()) == ErrorCode::OK);
+  };
+  corrupt(placements.value()[0]);
+
+  // get() must detect the mismatch on copy 0 and heal from copy 1.
+  auto back = client->get("crc/obj");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // Batched path heals the same way.
+  std::vector<uint8_t> buf(data.size());
+  std::vector<ObjectClient::GetItem> items{{"crc/obj", buf.data(), buf.size()}};
+  auto many = client->get_many(items);
+  BT_ASSERT(many[0].ok());
+  BT_EXPECT(std::memcmp(buf.data(), data.data(), data.size()) == 0);
+
+  // Both copies corrupt: detection, not garbage.
+  corrupt(placements.value()[1]);
+  auto dead = client->get("crc/obj");
+  BT_ASSERT(!dead.ok());
+  BT_EXPECT(dead.error() == ErrorCode::CHECKSUM_MISMATCH);
+}
+
+BTEST(Integrity, CorruptEcShardHuntedAndReconstructed) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(6, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  auto data = pattern(512 * 1024, 67);
+  BT_ASSERT(client->put("crc/ec", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("crc/ec");
+  BT_ASSERT_OK(placements);
+  const auto& copy = placements.value()[0];
+  auto corrupt_shard = [&](size_t idx) {
+    const auto& shard = copy.shards[idx];
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    std::vector<uint8_t> garbage(2048, 0xa5);
+    auto raw = transport::make_transport_client();
+    BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 512, mem.rkey, garbage.data(),
+                         garbage.size()) == ErrorCode::OK);
+  };
+  // Silently corrupt data shard 2: the healthy read sees every shard OK but
+  // the CRC disagrees — the hunt must identify shard 2 and reconstruct it.
+  corrupt_shard(2);
+  auto back = client->get("crc/ec");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // Two corrupt data shards exceed what an object-level CRC can localize
+  // with m=2 parity: detection (CHECKSUM_MISMATCH), never silent garbage.
+  corrupt_shard(0);
+  auto dead = client->get("crc/ec");
+  BT_ASSERT(!dead.ok());
+  BT_EXPECT(dead.error() == ErrorCode::CHECKSUM_MISMATCH);
+}
+
+BTEST(Integrity, RepairRefusesToPropagateCorruptSource) {
+  // r=2 object; corrupt copy 0, then kill copy 1's worker. Repair's only
+  // source is the corrupt copy — it must refuse (CHECKSUM_MISMATCH on the
+  // stream) rather than mint a "repaired" copy from rotten bytes.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(3, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(128 * 1024, 71);
+  BT_ASSERT(client->put("crc/repair", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("crc/repair");
+  BT_ASSERT_OK(placements);
+  {
+    const auto& shard = placements.value()[0].shards[0];
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    std::vector<uint8_t> garbage(1024, 0x3c);
+    auto raw = transport::make_transport_client();
+    BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 64, mem.rkey, garbage.data(),
+                         garbage.size()) == ErrorCode::OK);
+  }
+  const auto victim = placements.value()[1].shards[0].worker_id;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim) cluster.kill_worker(i);
+  }
+
+  // Repair runs, finds its only source corrupt, and refuses.
+  BT_EXPECT(eventually([&] {
+    auto p = client->get_workers("crc/repair");
+    return p.ok() && p.value().size() == 1;  // dead copy pruned, no top-up
+  }));
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));  // let repair finish
+  BT_EXPECT_EQ(cluster.keystone().counters().objects_repaired.load(), 0u);
+
+  // The surviving copy is corrupt: reads DETECT it, never return garbage.
+  auto back = client->get("crc/repair");
+  BT_ASSERT(!back.ok());
+  BT_EXPECT(back.error() == ErrorCode::CHECKSUM_MISMATCH);
 }

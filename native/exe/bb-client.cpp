@@ -15,6 +15,7 @@ int usage() {
       "usage: bb-client --keystone host:port <command> [args]\n"
       "  put <key> (--file path | --size N) [--replicas R] [--max-workers W]\n"
       "      [--ec K,M]            Reed-Solomon: K data + M parity shards\n"
+      "      [--class ram_cpu|hbm_tpu|nvme|ssd|...]  preferred storage tier\n"
       "  get <key> [--out path]\n"
       "  exists <key>\n"
       "  remove <key>\n"
@@ -43,6 +44,11 @@ int main(int argc, char** argv) {
       wc.replication_factor = std::stoul(argv[++i]);
     else if (!std::strcmp(argv[i], "--max-workers") && i + 1 < argc)
       wc.max_workers_per_copy = std::stoul(argv[++i]);
+    else if (!std::strcmp(argv[i], "--class") && i + 1 < argc) {
+      auto cls = storage_class_from_name(argv[++i]);
+      if (!cls) return usage();
+      wc.preferred_classes = {*cls};
+    }
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
       // K,M: Reed-Solomon k data + m parity shards (replaces --replicas).
       const std::string km = argv[++i];

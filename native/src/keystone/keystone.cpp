@@ -1925,16 +1925,13 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   // Demotion moves whole objects. Only objects fully resident in the
   // pressured tier qualify — re-placing a mixed-tier object would drag its
   // healthy faster-tier replicas down the ladder too. Mixed objects keep
-  // delete-eviction semantics (the caller's fallback). Erasure-coded copies
-  // interleave parity with data, which this replication-shaped byte mover
-  // does not understand: same fallback.
-  if (!old_copies.empty() && old_copies.front().ec_data_shards > 0)
-    return DemoteOutcome::kFailed;
+  // delete-eviction semantics (the caller's fallback).
   for (const auto& copy : old_copies) {
     for (const auto& shard : copy.shards) {
       if (shard.storage_class != from) return DemoteOutcome::kFailed;
     }
   }
+  const bool coded = !old_copies.empty() && old_copies.front().ec_data_shards > 0;
 
   // Stage the replacement under a temporary allocator key; the old ranges
   // stay live the whole time, so concurrent readers are never broken.
@@ -1964,10 +1961,65 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   // (worker.cpp), so a keystone seeing them shares the provider's process.
   // Cross-process HBM pools register callback-backed regions instead.
   bool moved = false;
-  for (const auto& src : old_copies) {
-    if (copy_object_bytes(*data_client_, src, placed.value(), size) == ErrorCode::OK) {
+  if (coded) {
+    // Coded objects move SHARD-VERBATIM: the staged allocation reused the
+    // object's (k, m) config, so it has the identical geometry and every
+    // shard (data and parity alike) copies bytes straight across with no
+    // decode. The mover invariant still holds: the object CRC accumulates
+    // over the data shards' valid bytes AS they stream, and a mismatch
+    // aborts the move — the object stays put (kSkipped, never the delete
+    // fallback: the bytes are still parity-recoverable by client reads).
+    const CopyPlacement& src = old_copies.front();
+    const size_t k = src.ec_data_shards;
+    const uint64_t L = src.shards.empty() ? 0 : src.shards.front().length;
+    uint32_t crc = 0;
+    constexpr uint64_t kChunk = 8ull << 20;
+    std::vector<uint8_t> buf(static_cast<size_t>(std::min<uint64_t>(L, kChunk)));
+    auto stream_one = [&](const ShardPlacement& s, const ShardPlacement& d,
+                          uint64_t crc_bytes) -> ErrorCode {
+      for (uint64_t off = 0; off < s.length; off += kChunk) {
+        const uint64_t n = std::min(kChunk, s.length - off);
+        BTPU_RETURN_IF_ERROR(
+            transport::shard_io(*data_client_, s, off, buf.data(), n, /*is_write=*/false));
+        if (off < crc_bytes)
+          crc = crc32c(buf.data(), std::min(n, crc_bytes - off), crc);
+        BTPU_RETURN_IF_ERROR(
+            transport::shard_io(*data_client_, d, off, buf.data(), n, /*is_write=*/true));
+      }
+      return ErrorCode::OK;
+    };
+    if (placed.value().size() == 1 &&
+        placed.value().front().shards.size() == src.shards.size()) {
       moved = true;
-      break;
+      for (size_t i = 0; i < src.shards.size() && moved; ++i) {
+        const uint64_t start = i * L;
+        const uint64_t crc_bytes =
+            i < k && start < size ? std::min<uint64_t>(L, size - start) : 0;
+        if (stream_one(src.shards[i], placed.value().front().shards[i], crc_bytes) !=
+            ErrorCode::OK)
+          moved = false;
+      }
+      if (moved && src.content_crc != 0 && crc != src.content_crc) {
+        LOG_WARN << "demotion of coded " << key
+                 << " aborted: source failed crc verification (still "
+                    "parity-recoverable in place)";
+        adapter_.free_object(staging_key);
+        return DemoteOutcome::kSkipped;
+      }
+    }
+    if (!moved) {
+      // A transiently unreadable shard (hung worker, death inside the
+      // heartbeat TTL) or a staging-geometry surprise must NEVER funnel a
+      // parity-recoverable object into the caller's delete fallback.
+      adapter_.free_object(staging_key);
+      return DemoteOutcome::kSkipped;
+    }
+  } else {
+    for (const auto& src : old_copies) {
+      if (copy_object_bytes(*data_client_, src, placed.value(), size) == ErrorCode::OK) {
+        moved = true;
+        break;
+      }
     }
   }
   if (!moved) {

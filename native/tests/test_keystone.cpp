@@ -9,6 +9,7 @@
 
 #include "btest.h"
 #include "btpu/coord/mem_coordinator.h"
+#include "btpu/common/wire.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/transport/transport.h"
 
@@ -743,4 +744,103 @@ BTEST(Keystone, SingleReplicaLostObjectIsDropped) {
   BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
   BT_EXPECT(!ks.object_exists("fragile").value());
   BT_EXPECT_EQ(ks.counters().objects_lost.load(), 1ull);
+}
+
+BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
+  // Records persisted by OLDER builds — before erasure coding, and before
+  // content CRCs — must decode through the legacy fallbacks on restart, not
+  // be purged as garbage. Both historical layouts are hand-encoded here
+  // exactly as those builds wrote them.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  FakeWorker w1("w1", 1 << 20);
+  coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), encode_worker_info(w1.info()));
+  coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
+                   encode_pool_record(w1.pool));
+  coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000);
+
+  auto encode_shard = [&](wire::Writer& w, uint64_t off, uint64_t len) {
+    ShardPlacement s;
+    s.pool_id = w1.pool.id;
+    s.worker_id = w1.id;
+    s.remote = w1.pool.remote;
+    s.storage_class = StorageClass::RAM_CPU;
+    s.length = len;
+    s.location = MemoryLocation{w1.pool.remote.remote_base + off,
+                                std::stoull(w1.pool.remote.rkey_hex, nullptr, 16), len};
+    wire::encode(w, s);
+  };
+  auto encode_config_legacy = [](wire::Writer& w) {
+    // Pre-EC WorkerConfig: 10 fields, no ec_data/ec_parity.
+    wire::encode_fields(w, uint64_t{1}, uint64_t{1}, false, std::string{},
+                        std::vector<StorageClass>{}, uint64_t{0}, true, false,
+                        uint64_t{256 * 1024}, int32_t{-1});
+  };
+
+  {  // Layout 1: pre-EC (copy = copy_index + shards only).
+    wire::Writer w;
+    wire::encode_fields(w, uint64_t{4096}, uint64_t{0}, false, uint8_t{1});
+    encode_config_legacy(w);
+    w.put<uint32_t>(1);          // one copy
+    w.put<uint32_t>(0);          // copy_index
+    w.put<uint32_t>(1);          // one shard
+    encode_shard(w, 0, 4096);
+    wire::encode_fields(w, int64_t{1}, int64_t{2});  // wall-clock stamps
+    auto bytes = w.take();
+    coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/pre-ec"),
+                     std::string(bytes.begin(), bytes.end()));
+  }
+  {  // Layout 2: EC-era (copy carries ec fields, config carries ec fields,
+     //           but neither has content_crc).
+    wire::Writer w;
+    wire::encode_fields(w, uint64_t{8000}, uint64_t{0}, false, uint8_t{1});
+    wire::encode_fields(w, uint64_t{1}, uint64_t{1}, false, std::string{},
+                        std::vector<StorageClass>{}, uint64_t{0}, true, false,
+                        uint64_t{256 * 1024}, int32_t{-1}, uint64_t{2}, uint64_t{1});
+    w.put<uint32_t>(1);          // one copy
+    w.put<uint32_t>(0);          // copy_index
+    w.put<uint32_t>(3);          // three shards (2 data + 1 parity)
+    encode_shard(w, 8192, 4000);
+    encode_shard(w, 16384, 4000);
+    encode_shard(w, 24576, 4000);
+    wire::encode_fields(w, uint32_t{2}, uint32_t{1}, uint64_t{8000});  // ec geometry
+    wire::encode_fields(w, int64_t{3}, int64_t{4});
+    auto bytes = w.take();
+    coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/ec-era"),
+                     std::string(bytes.begin(), bytes.end()));
+  }
+
+  KeystoneService ks(cfg, coordinator);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  BT_EXPECT(ks.object_exists("legacy/pre-ec").value());
+  BT_EXPECT(ks.object_exists("legacy/ec-era").value());
+
+  auto pre = ks.get_workers("legacy/pre-ec");
+  BT_ASSERT_OK(pre);
+  BT_EXPECT_EQ(pre.value()[0].shards.size(), 1u);
+  BT_EXPECT_EQ(pre.value()[0].ec_data_shards, 0u);
+  BT_EXPECT_EQ(pre.value()[0].content_crc, 0u);  // unknown: reads skip verify
+
+  auto ec = ks.get_workers("legacy/ec-era");
+  BT_ASSERT_OK(ec);
+  BT_EXPECT_EQ(ec.value()[0].ec_data_shards, 2u);
+  BT_EXPECT_EQ(ec.value()[0].ec_parity_shards, 1u);
+  BT_EXPECT_EQ(ec.value()[0].ec_object_size, 8000u);
+  BT_EXPECT_EQ(ec.value()[0].content_crc, 0u);
+
+  // Adoption really registered the ranges: fresh allocations avoid them.
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  auto fresh = ks.put_start("legacy/new", 4096, wc);
+  BT_ASSERT_OK(fresh);
+  const auto& mem = std::get<MemoryLocation>(fresh.value()[0].shards[0].location);
+  const uint64_t lo = mem.remote_addr - w1.pool.remote.remote_base;
+  const uint64_t hi = lo + 4096;
+  // The actual invariant: no overlap with ANY adopted legacy range.
+  const std::pair<uint64_t, uint64_t> adopted[] = {
+      {0, 4096}, {8192, 12192}, {16384, 20384}, {24576, 28576}};
+  for (const auto& [a, b] : adopted) {
+    BT_EXPECT(hi <= a || lo >= b);
+  }
 }

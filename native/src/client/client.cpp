@@ -105,7 +105,9 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
     if (auto ec = transfer_copy_get(copy, buffer.data(), copy_size); ec == ErrorCode::OK) {
       return buffer;
     } else {
-      last = ec;
+      // Corruption is the strongest signal — a later replica's transport
+      // error must not mask it (scrubbers key off CHECKSUM_MISMATCH).
+      if (last != ErrorCode::CHECKSUM_MISMATCH) last = ec;
       LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
                << to_string(ec) << "), trying next replica";
     }
@@ -131,7 +133,7 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
         ec == ErrorCode::OK) {
       return copy_size;
     } else {
-      last = ec;
+      if (last != ErrorCode::CHECKSUM_MISMATCH) last = ec;
     }
   }
   return last;

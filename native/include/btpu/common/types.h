@@ -167,6 +167,11 @@ struct CopyPlacement {
   // (0 = unknown). Readers verify after assembling the object; a mismatch
   // is treated as copy loss (failover / parity reconstruction).
   uint32_t content_crc{0};
+  // Per-shard CRC32C, parallel to `shards` (empty = not stamped — records
+  // from pre-shard-CRC builds). The object CRC detects corruption; these
+  // localize it to a shard, which is what lets EC repair reconstruct
+  // multiple corrupt shards and scrub name the corrupt worker/pool.
+  std::vector<uint32_t> shard_crcs;
   size_t shards_size() const noexcept { return shards.size(); }
 };
 
@@ -325,7 +330,11 @@ struct BatchPutCompleteResponse { std::vector<ErrorCode> results; ErrorCode erro
 struct BatchPutCancelRequest { std::vector<ObjectKey> keys; };
 struct BatchPutCancelResponse { std::vector<ErrorCode> results; ErrorCode error_code{ErrorCode::OK}; };
 
-struct PingResponse { ViewVersionId view_version{0}; };
+// Ping doubles as the protocol-version handshake: each side sends the
+// highest wire-protocol version it speaks (rpc.h kProtocolVersion). A peer
+// that predates the handshake leaves the field 0.
+struct PingRequest { uint32_t proto_version{0}; };
+struct PingResponse { ViewVersionId view_version{0}; uint32_t proto_version{0}; };
 
 // -------------------------------------------------------------------------
 // Service configs (reference KeystoneConfig types.h:410-445,

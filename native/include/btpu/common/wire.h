@@ -10,8 +10,19 @@
 //   vector<T>  u32 count + elements
 //   variant    u8 alternative index + alternative
 //   Result<T>  u8 {0=value,1=error} + payload
+//   struct     u32 body length + fields (see below)
 // Decode is bounds-checked everywhere; a truncated or corrupt frame yields
 // false, never UB.
+//
+// Cross-version evolution (wire format v2): every composite struct is
+// size-prefixed and decoded tail-tolerantly — a decoder reads the fields it
+// knows, defaults any fields missing from the body (older peer), and skips
+// any bytes past the fields it knows (newer peer). Top-level RPC messages
+// get the same tail tolerance from the frame length instead of a prefix.
+// The evolution rule this buys: APPEND-ONLY — new fields go at the end of a
+// struct, never in the middle, and existing field types never change. Under
+// that rule a mixed-version fleet (rolling upgrade) interoperates in both
+// directions; test_rpc.cpp proves both with hand-framed newer/older peers.
 #pragma once
 
 #include <bit>
@@ -82,6 +93,14 @@ class Reader {
     uint32_t n = 0;
     if (!get(n) || remaining() < n) return false;
     out.assign(reinterpret_cast<const char*>(data_ + pos_), n);
+    pos_ += n;
+    return true;
+  }
+
+  const uint8_t* cursor() const noexcept { return data_ + pos_; }
+
+  bool skip(size_t n) {
+    if (remaining() < n) return false;
     pos_ += n;
     return true;
   }
@@ -163,29 +182,68 @@ bool decode_fields(Reader& r, T& first, Rest&... rest) {
   return decode(r, first) && decode_fields(r, rest...);
 }
 
-// ---- data-model overloads -------------------------------------------------
+// Tail-tolerant variant: a clean end-of-input at a field boundary leaves the
+// remaining fields defaulted (older peer omitted them); a partial field is
+// still an error (corruption, not version skew).
+inline bool decode_fields_tail(Reader&) { return true; }
+template <typename T, typename... Rest>
+bool decode_fields_tail(Reader& r, T& first, Rest&... rest) {
+  if (r.exhausted()) {
+    first = T{};
+    return decode_fields_tail(r, rest...);
+  }
+  return decode(r, first) && decode_fields_tail(r, rest...);
+}
 
-inline void encode(Writer& w, const TopoCoord& t) { encode_fields(w, t.slice_id, t.host_id, t.chip_id); }
-inline bool decode(Reader& r, TopoCoord& t) { return decode_fields(r, t.slice_id, t.host_id, t.chip_id); }
+// Composite structs on the wire: [u32 body length][fields]. Decoding reads
+// the known fields out of the body (missing trailing fields default) and
+// skips whatever a newer peer appended after them.
+template <typename... Fields>
+void encode_struct(Writer& w, const Fields&... fields) {
+  auto& buf = w.buffer();
+  const size_t at = buf.size();
+  w.put<uint32_t>(0);
+  encode_fields(w, fields...);
+  if (buf.size() - at - 4 > std::numeric_limits<uint32_t>::max())
+    throw std::length_error("wire: struct exceeds u32 body length");
+  const uint32_t len = static_cast<uint32_t>(buf.size() - at - 4);
+  std::memcpy(buf.data() + at, &len, sizeof(len));
+}
+
+template <typename... Fields>
+bool decode_struct(Reader& r, Fields&... fields) {
+  uint32_t len = 0;
+  if (!r.get(len) || r.remaining() < len) return false;
+  Reader body(r.cursor(), len);
+  if (!decode_fields_tail(body, fields...)) return false;
+  return r.skip(len);
+}
+
+// ---- data-model overloads -------------------------------------------------
+// All composites are size-prefixed (encode_struct) so appended fields are
+// version-tolerant even when the struct is nested inside vectors/messages.
+
+inline void encode(Writer& w, const TopoCoord& t) { encode_struct(w, t.slice_id, t.host_id, t.chip_id); }
+inline bool decode(Reader& r, TopoCoord& t) { return decode_struct(r, t.slice_id, t.host_id, t.chip_id); }
 
 inline void encode(Writer& w, const RemoteDescriptor& d) {
-  encode_fields(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
+  encode_struct(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
 }
 inline bool decode(Reader& r, RemoteDescriptor& d) {
-  return decode_fields(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
+  return decode_struct(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
 }
 
-inline void encode(Writer& w, const MemoryLocation& m) { encode_fields(w, m.remote_addr, m.rkey, m.size); }
-inline bool decode(Reader& r, MemoryLocation& m) { return decode_fields(r, m.remote_addr, m.rkey, m.size); }
+inline void encode(Writer& w, const MemoryLocation& m) { encode_struct(w, m.remote_addr, m.rkey, m.size); }
+inline bool decode(Reader& r, MemoryLocation& m) { return decode_struct(r, m.remote_addr, m.rkey, m.size); }
 
-inline void encode(Writer& w, const FileLocation& f) { encode_fields(w, f.file_path, f.file_offset); }
-inline bool decode(Reader& r, FileLocation& f) { return decode_fields(r, f.file_path, f.file_offset); }
+inline void encode(Writer& w, const FileLocation& f) { encode_struct(w, f.file_path, f.file_offset); }
+inline bool decode(Reader& r, FileLocation& f) { return decode_struct(r, f.file_path, f.file_offset); }
 
 inline void encode(Writer& w, const DeviceLocation& d) {
-  encode_fields(w, d.device_id, d.region_id, d.offset, d.size);
+  encode_struct(w, d.device_id, d.region_id, d.offset, d.size);
 }
 inline bool decode(Reader& r, DeviceLocation& d) {
-  return decode_fields(r, d.device_id, d.region_id, d.offset, d.size);
+  return decode_struct(r, d.device_id, d.region_id, d.offset, d.size);
 }
 
 inline void encode(Writer& w, const LocationDetail& loc) {
@@ -204,23 +262,23 @@ inline bool decode(Reader& r, LocationDetail& loc) {
 }
 
 inline void encode(Writer& w, const ShardPlacement& s) {
-  encode_fields(w, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
+  encode_struct(w, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
 }
 inline bool decode(Reader& r, ShardPlacement& s) {
-  return decode_fields(r, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
+  return decode_struct(r, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
 }
 
 inline void encode(Writer& w, const CopyPlacement& c) {
-  encode_fields(w, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                c.ec_object_size, c.content_crc);
+  encode_struct(w, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
+                c.ec_object_size, c.content_crc, c.shard_crcs);
 }
 inline bool decode(Reader& r, CopyPlacement& c) {
-  return decode_fields(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                       c.ec_object_size, c.content_crc);
+  return decode_struct(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
+                       c.ec_object_size, c.content_crc, c.shard_crcs);
 }
 
 inline void encode(Writer& w, const WorkerConfig& c) {
-  encode_fields(w, static_cast<uint64_t>(c.replication_factor),
+  encode_struct(w, static_cast<uint64_t>(c.replication_factor),
                 static_cast<uint64_t>(c.max_workers_per_copy), c.enable_soft_pin,
                 c.preferred_node, c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
                 c.prefer_contiguous, static_cast<uint64_t>(c.min_shard_size), c.preferred_slice,
@@ -229,7 +287,7 @@ inline void encode(Writer& w, const WorkerConfig& c) {
 }
 inline bool decode(Reader& r, WorkerConfig& c) {
   uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
-  if (!decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
+  if (!decode_struct(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
                      c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
                      c.preferred_slice, eck, ecm))
     return false;
@@ -242,39 +300,37 @@ inline bool decode(Reader& r, WorkerConfig& c) {
 }
 
 inline void encode(Writer& w, const ClusterStats& s) {
-  encode_fields(w, s.total_workers, s.total_memory_pools, s.total_objects, s.total_capacity,
+  encode_struct(w, s.total_workers, s.total_memory_pools, s.total_objects, s.total_capacity,
                 s.used_capacity, s.avg_utilization);
 }
 inline bool decode(Reader& r, ClusterStats& s) {
-  return decode_fields(r, s.total_workers, s.total_memory_pools, s.total_objects,
+  return decode_struct(r, s.total_workers, s.total_memory_pools, s.total_objects,
                        s.total_capacity, s.used_capacity, s.avg_utilization);
 }
 
 inline void encode(Writer& w, const MemoryPool& p) {
-  encode_fields(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote, p.topo,
-                p.alignment);
+  encode_struct(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote,
+                p.topo, p.alignment);
 }
 inline bool decode(Reader& r, MemoryPool& p) {
-  if (!decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
-                     p.remote, p.topo))
-    return false;
-  // `alignment` is a trailing optional field: records persisted by binaries
-  // that predate it decode with the default (0 = unaligned) instead of
-  // failing, which would silently drop pools (and every recovered object)
-  // on the first restart after an upgrade. NOTE: the optionality relies on
-  // MemoryPool only ever being decoded as a standalone record (keystone
-  // registry); embedding it mid-stream in a larger message would misread
-  // the next field — add a count/version prefix first if that's ever needed.
-  p.alignment = 0;
-  if (!r.exhausted() && !decode(r, p.alignment)) return false;
-  return true;
+  // `alignment` was appended after v1 shipped; decode_struct's tail
+  // tolerance defaults it (0 = unaligned) for records that predate it.
+  return decode_struct(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
+                       p.remote, p.topo, p.alignment);
+}
+
+inline void encode(Writer& w, const ObjectSummary& o) {
+  encode_struct(w, o.key, o.size, o.complete_copies, o.soft_pin);
+}
+inline bool decode(Reader& r, ObjectSummary& o) {
+  return decode_struct(r, o.key, o.size, o.complete_copies, o.soft_pin);
 }
 
 inline void encode(Writer& w, const BatchPutStartItem& i) {
-  encode_fields(w, i.key, i.data_size, i.config, i.content_crc);
+  encode_struct(w, i.key, i.data_size, i.config, i.content_crc);
 }
 inline bool decode(Reader& r, BatchPutStartItem& i) {
-  return decode_fields(r, i.key, i.data_size, i.config, i.content_crc);
+  return decode_struct(r, i.key, i.data_size, i.config, i.content_crc);
 }
 
 template <typename T>
@@ -302,7 +358,10 @@ bool decode(Reader& r, std::vector<T>& v) {
 }
 
 // ---- request/response structs --------------------------------------------
-// X-macro: each RPC struct lists its fields once.
+// X-macro: each RPC struct lists its fields once. Messages are bounded by
+// the RPC frame, so they decode tail-tolerantly without a length prefix:
+// fields an older peer omitted default, bytes a newer peer appended are
+// ignored (the frame decoder never requires exhaustion — from_bytes_lax).
 #define BTPU_WIRE_STRUCT(Type, ...)                                   \
   inline void encode(Writer& w, const Type& m) {                      \
     auto& [__VA_ARGS__] = m;                                          \
@@ -310,7 +369,7 @@ bool decode(Reader& r, std::vector<T>& v) {
   }                                                                   \
   inline bool decode(Reader& r, Type& m) {                            \
     auto& [__VA_ARGS__] = m;                                          \
-    return decode_fields(r, __VA_ARGS__);                             \
+    return decode_fields_tail(r, __VA_ARGS__);                        \
   }
 
 #define BTPU_WIRE_EMPTY(Type)                       \
@@ -337,7 +396,6 @@ BTPU_WIRE_EMPTY(GetClusterStatsRequest)
 BTPU_WIRE_STRUCT(GetClusterStatsResponse, f0, f1)
 BTPU_WIRE_EMPTY(GetViewVersionRequest)
 BTPU_WIRE_STRUCT(GetViewVersionResponse, f0, f1)
-BTPU_WIRE_STRUCT(ObjectSummary, f0, f1, f2, f3)
 BTPU_WIRE_STRUCT(ListObjectsRequest, f0, f1)
 BTPU_WIRE_STRUCT(ListObjectsResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchObjectExistsRequest, f0)
@@ -350,7 +408,8 @@ BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutCompleteResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutCancelRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutCancelResponse, f0, f1)
-BTPU_WIRE_STRUCT(PingResponse, f0)
+BTPU_WIRE_STRUCT(PingRequest, f0)
+BTPU_WIRE_STRUCT(PingResponse, f0, f1)
 
 #undef BTPU_WIRE_STRUCT
 #undef BTPU_WIRE_EMPTY
@@ -367,6 +426,15 @@ template <typename T>
 bool from_bytes(const std::vector<uint8_t>& bytes, T& out) {
   Reader r(bytes);
   return decode(r, out) && r.exhausted();
+}
+
+// Message-boundary parse: tolerates trailing bytes a newer peer appended
+// after the fields this build knows. Use for RPC frames; from_bytes stays
+// strict for contexts where trailing garbage means corruption.
+template <typename T>
+bool from_bytes_lax(const std::vector<uint8_t>& bytes, T& out) {
+  Reader r(bytes);
+  return decode(r, out);
 }
 
 }  // namespace btpu::wire

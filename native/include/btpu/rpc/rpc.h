@@ -1,39 +1,49 @@
 // Keystone RPC protocol: opcodes map 1:1 to KeystoneService methods.
 //
-// Versioning stance: wire structs are NOT cross-version stable (no
-// negotiation — matching the reference's struct_pack RPC, which had none
-// either). Upgrades are atomic per cluster: restart keystones and clients
-// together. Durable records are the exception — they outlive binaries, so
-// keystone.cpp keeps legacy decode fallbacks for them.
+// Versioning stance: the wire protocol IS cross-version stable within the
+// v2 opcode epoch. Every composite struct is size-prefixed and every
+// message decodes tail-tolerantly (wire.h), so the append-only evolution
+// rule — new fields only at the end, types never change — lets older and
+// newer peers interoperate in both directions during a rolling upgrade;
+// test_rpc.cpp's compatibility tests frame newer- and older-peer messages
+// by hand and prove it. kPing carries each side's kProtocolVersion so
+// operators can audit a mixed fleet. The v1 epoch (opcodes 1-17, unprefixed
+// structs) predates this guarantee; v2 opcodes live at +64 so a cross-epoch
+// call fails loudly with an unknown-opcode error instead of a mis-decode.
 //
 // Parity target: reference include/blackbird/rpc/rpc_service.h:28-274 — 14
-// rpc_* handlers over YLT coro_rpc (rpc_service.cpp:360-385). Framing is the
-// shared net.h frame: [u32 len][u8 opcode][wire-encoded struct]; responses
-// reuse the request opcode.
+// rpc_* handlers over YLT coro_rpc (rpc_service.cpp:360-385; struct_pack had
+// no version tolerance — this is our own bar, not the reference's). Framing
+// is the shared net.h frame: [u32 len][u8 opcode][wire-encoded struct];
+// responses reuse the request opcode.
 #pragma once
 
 #include <cstdint>
 
 namespace btpu::rpc {
 
+// Wire-protocol version advertised in the kPing handshake. Bump when the
+// append-only rule is insufficient to describe a change (should be never).
+inline constexpr uint32_t kProtocolVersion = 2;
+
 enum class Method : uint8_t {
-  kObjectExists = 1,
-  kGetWorkers = 2,
-  kPutStart = 3,
-  kPutComplete = 4,
-  kPutCancel = 5,
-  kRemoveObject = 6,
-  kRemoveAllObjects = 7,
-  kGetClusterStats = 8,
-  kGetViewVersion = 9,
-  kBatchObjectExists = 10,
-  kBatchGetWorkers = 11,
-  kBatchPutStart = 12,
-  kBatchPutComplete = 13,
-  kBatchPutCancel = 14,
-  kPing = 15,
-  kDrainWorker = 16,
-  kListObjects = 17,
+  kObjectExists = 65,
+  kGetWorkers = 66,
+  kPutStart = 67,
+  kPutComplete = 68,
+  kPutCancel = 69,
+  kRemoveObject = 70,
+  kRemoveAllObjects = 71,
+  kGetClusterStats = 72,
+  kGetViewVersion = 73,
+  kBatchObjectExists = 74,
+  kBatchGetWorkers = 75,
+  kBatchPutStart = 76,
+  kBatchPutComplete = 77,
+  kBatchPutCancel = 78,
+  kPing = 79,
+  kDrainWorker = 80,
+  kListObjects = 81,
 };
 
 }  // namespace btpu::rpc

@@ -2,6 +2,7 @@
 // Reconnects transparently after keystone restarts (one retry per call).
 #pragma once
 
+#include <atomic>
 #include <mutex>
 
 #include "btpu/common/types.h"
@@ -32,6 +33,11 @@ class KeystoneRpcClient {
   Result<ClusterStats> get_cluster_stats();
   Result<ViewVersionId> get_view_version();
   Result<ViewVersionId> ping();
+  // Wire-protocol version the server reported in the last successful ping
+  // (0 = never pinged, or the server predates the handshake).
+  uint32_t server_proto_version() const noexcept {
+    return server_proto_version_.load(std::memory_order_relaxed);
+  }
 
   Result<std::vector<Result<bool>>> batch_object_exists(const std::vector<ObjectKey>& keys);
   Result<std::vector<Result<std::vector<CopyPlacement>>>> batch_get_workers(
@@ -51,6 +57,7 @@ class KeystoneRpcClient {
   std::string endpoint_;
   std::mutex mutex_;
   net::Socket sock_;
+  std::atomic<uint32_t> server_proto_version_{0};
 };
 
 }  // namespace btpu::rpc

@@ -14,28 +14,219 @@ namespace btpu::keystone {
 
 using coord::WatchEvent;
 
+// ---- record envelope ------------------------------------------------------
+// Durable records (coordinator values) outlive binaries, so unlike RPC
+// frames they need an explicit format marker: records this build writes are
+// [u64 0xFF..FF][u8 format=2][wire-v2 payload]. The magic cannot collide
+// with any pre-envelope record: worker/pool records begin with a non-empty
+// id string's u32 length (never 0xFFFFFFFF = a 4 GiB id) and object records
+// with a u64 object size (never 2^64-1). Records without the marker decode
+// through the hand-rolled legacy layouts in `v1` below — a restart over a
+// pre-upgrade data dir must recover its objects, not purge them as garbage
+// (proven by test_keystone.cpp RestartRecoversPreUpgradeRecordLayouts).
+//
+// COMPATIBILITY BOUNDARY: the envelope guarantee is one-directional across
+// its introduction. Builds FROM this one on read every older layout, and —
+// because wire v2 is append-only and future-format records are skipped, not
+// deleted — they stay safe under records from newer builds too. But
+// PRE-envelope builds cannot read enveloped records (they see a 4 GiB
+// string length / 2^64-1 size and may purge them as garbage): rolling a
+// binary BACK across the envelope introduction is unsupported — upgrade
+// keystones+workers across it as one step and don't roll back, exactly the
+// atomic-upgrade stance those older builds documented for themselves
+// (their rpc.h: "Upgrades are atomic per cluster").
+
+namespace {
+constexpr uint64_t kRecordMagic = ~0ull;
+constexpr uint8_t kRecordFormat = 2;
+
+enum class RecordEra : uint8_t {
+  kLegacy,   // no envelope: pre-envelope build wrote it (reader untouched)
+  kCurrent,  // envelope, format we speak (reader advanced past envelope)
+  kFuture,   // envelope, bumped format byte: an intentionally incompatible
+             // future layout — unusable here, but NOT garbage (keep it;
+             // deleting would destroy data during a rollback window)
+};
+
+void put_record_envelope(wire::Writer& w) {
+  w.put(kRecordMagic);
+  w.put(kRecordFormat);
+}
+
+RecordEra take_record_envelope(wire::Reader& r) {
+  if (r.remaining() < 9) return RecordEra::kLegacy;
+  uint64_t magic = 0;
+  std::memcpy(&magic, r.cursor(), sizeof(magic));
+  if (magic != kRecordMagic) return RecordEra::kLegacy;
+  uint8_t format = 0;
+  std::memcpy(&format, r.cursor() + sizeof(magic), sizeof(format));
+  // Append-only evolution never bumps the format byte, so != is "future".
+  if (format != kRecordFormat) return RecordEra::kFuture;
+  r.skip(sizeof(magic) + sizeof(format));
+  return RecordEra::kCurrent;
+}
+
+// Decoders for the layouts pre-envelope builds wrote: no length prefixes on
+// composite structs, so every nested layout is pinned by hand here (the
+// wire:: overloads have moved on to the self-describing v2 encoding).
+namespace v1 {
+
+bool topo(wire::Reader& r, TopoCoord& t) {
+  return wire::decode_fields(r, t.slice_id, t.host_id, t.chip_id);
+}
+
+bool remote(wire::Reader& r, RemoteDescriptor& d) {
+  return wire::decode_fields(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
+}
+
+bool location(wire::Reader& r, LocationDetail& loc) {
+  uint8_t idx = 0;
+  if (!r.get(idx)) return false;
+  switch (idx) {
+    case 0: {
+      MemoryLocation m;
+      if (!wire::decode_fields(r, m.remote_addr, m.rkey, m.size)) return false;
+      loc = m;
+      return true;
+    }
+    case 1: {
+      FileLocation f;
+      if (!wire::decode_fields(r, f.file_path, f.file_offset)) return false;
+      loc = f;
+      return true;
+    }
+    case 2: {
+      DeviceLocation d;
+      if (!wire::decode_fields(r, d.device_id, d.region_id, d.offset, d.size)) return false;
+      loc = d;
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+bool shard(wire::Reader& r, ShardPlacement& s) {
+  return wire::decode_fields(r, s.pool_id, s.worker_id) && remote(r, s.remote) &&
+         wire::decode_fields(r, s.storage_class, s.length) && location(r, s.location);
+}
+
+bool shards(wire::Reader& r, std::vector<ShardPlacement>& out) {
+  uint32_t n = 0;
+  if (!r.get(n) || n > r.remaining()) return false;
+  out.clear();
+  out.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    ShardPlacement s;
+    if (!shard(r, s)) return false;
+    out.push_back(std::move(s));
+  }
+  return true;
+}
+
+// The last pre-envelope copy layout (carries ec geometry + content_crc).
+bool copy(wire::Reader& r, CopyPlacement& c) {
+  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards) &&
+         wire::decode_fields(r, c.ec_data_shards, c.ec_parity_shards, c.ec_object_size,
+                             c.content_crc);
+}
+
+// EC-era layout: ec geometry but no content_crc yet.
+bool copy_ec_era(wire::Reader& r, CopyPlacement& c) {
+  c.content_crc = 0;
+  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards) &&
+         wire::decode_fields(r, c.ec_data_shards, c.ec_parity_shards, c.ec_object_size);
+}
+
+// Pre-EC layout: copy = copy_index + shards only.
+bool copy_pre_ec(wire::Reader& r, CopyPlacement& c) {
+  c.ec_data_shards = c.ec_parity_shards = 0;
+  c.ec_object_size = 0;
+  c.content_crc = 0;
+  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards);
+}
+
+// The last pre-envelope config layout (12 fields, with ec geometry).
+bool config(wire::Reader& r, WorkerConfig& c) {
+  uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
+  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
+                           c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
+                           c.preferred_slice, eck, ecm))
+    return false;
+  c.replication_factor = rf;
+  c.max_workers_per_copy = mw;
+  c.min_shard_size = ms;
+  c.ec_data_shards = eck;
+  c.ec_parity_shards = ecm;
+  return true;
+}
+
+// Pre-EC config layout: 10 fields, no ec geometry.
+bool config_pre_ec(wire::Reader& r, WorkerConfig& c) {
+  uint64_t rf = 0, mw = 0, ms = 0;
+  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node,
+                           c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
+                           c.prefer_contiguous, ms, c.preferred_slice))
+    return false;
+  c.replication_factor = rf;
+  c.max_workers_per_copy = mw;
+  c.min_shard_size = ms;
+  c.ec_data_shards = c.ec_parity_shards = 0;
+  return true;
+}
+
+bool pool_record(const std::string& bytes, MemoryPool& p) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  if (!wire::decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class) ||
+      !remote(r, p.remote) || !topo(r, p.topo))
+    return false;
+  // `alignment` was a trailing optional field in the v1 layout.
+  p.alignment = 0;
+  if (!r.exhausted() && !wire::decode(r, p.alignment)) return false;
+  return true;
+}
+
+bool worker_record(const std::string& bytes, WorkerInfo& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  return wire::decode_fields(r, out.worker_id, out.address) && topo(r, out.topo) &&
+         wire::decode_fields(r, out.registered_at_ms, out.last_heartbeat_ms);
+}
+
+}  // namespace v1
+}  // namespace
+
 // ---- registry codecs ------------------------------------------------------
 
 std::string encode_worker_info(const WorkerInfo& info) {
   wire::Writer w;
+  put_record_envelope(w);
   wire::encode_fields(w, info.worker_id, info.address, info.topo, info.registered_at_ms,
                       info.last_heartbeat_ms);
   auto bytes = w.take();
   return std::string(bytes.begin(), bytes.end());
 }
 
-// Top-level registry/object records tolerate trailing bytes: a newer binary
-// may append fields, and an older keystone must keep decoding the prefix it
-// knows instead of silently dropping the record (which would erase pools or
-// objects from the registry during a mixed-version rolling upgrade).
+// Current-format records tolerate trailing bytes (a newer binary may append
+// fields; an older keystone keeps decoding the prefix it knows instead of
+// dropping the record mid-rolling-upgrade); envelope-less records fall back
+// to the pinned v1 layouts.
 bool decode_worker_info(const std::string& bytes, WorkerInfo& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  switch (take_record_envelope(r)) {
+    case RecordEra::kLegacy:
+      return v1::worker_record(bytes, out);
+    case RecordEra::kFuture:
+      return false;  // unusable here; caller skips, never deletes
+    case RecordEra::kCurrent:
+      break;
+  }
   return wire::decode_fields(r, out.worker_id, out.address, out.topo, out.registered_at_ms,
                              out.last_heartbeat_ms);
 }
 
 std::string encode_pool_record(const MemoryPool& pool) {
   wire::Writer w;
+  put_record_envelope(w);
   wire::encode(w, pool);
   auto bytes = w.take();
   return std::string(bytes.begin(), bytes.end());
@@ -43,6 +234,14 @@ std::string encode_pool_record(const MemoryPool& pool) {
 
 bool decode_pool_record(const std::string& bytes, MemoryPool& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  switch (take_record_envelope(r)) {
+    case RecordEra::kLegacy:
+      return v1::pool_record(bytes, out);
+    case RecordEra::kFuture:
+      return false;  // unusable here; caller skips, never deletes
+    case RecordEra::kCurrent:
+      break;
+  }
   return wire::decode(r, out);
 }
 
@@ -62,72 +261,30 @@ struct ObjectRecord {
 
 std::string encode_object_record(const ObjectRecord& rec) {
   wire::Writer w;
+  put_record_envelope(w);
   wire::encode_fields(w, rec.size, rec.ttl_ms, rec.soft_pin, rec.state, rec.config,
                       rec.copies, rec.created_wall_ms, rec.last_access_wall_ms);
   auto bytes = w.take();
   return std::string(bytes.begin(), bytes.end());
 }
 
-// Pre-erasure-coding layouts (records persisted before the ec fields were
-// appended to CopyPlacement/WorkerConfig). Both structs are embedded
-// mid-stream here, so wire.h's trailing-optional convention cannot express
-// the upgrade; instead a failed new-format decode retries with the legacy
-// layout and defaults the ec fields — a restart over a pre-upgrade data dir
-// must recover its objects, not purge them as garbage.
-bool decode_copy_legacy(wire::Reader& r, CopyPlacement& c) {
-  c.ec_data_shards = c.ec_parity_shards = 0;
-  c.ec_object_size = 0;
-  return wire::decode_fields(r, c.copy_index, c.shards);
-}
-
-bool decode_config_legacy(wire::Reader& r, WorkerConfig& c) {
-  uint64_t rf = 0, mw = 0, ms = 0;
-  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node,
-                           c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
-                           c.prefer_contiguous, ms, c.preferred_slice))
+// Envelope-less object records: three historical layouts, newest first. The
+// copy/config decoders are shared with the registry fallbacks (v1 above);
+// which copy layout applies is what distinguishes the generations.
+template <typename CopyDecoder>
+bool decode_object_record_generation(const std::string& bytes, ObjectRecord& out,
+                                     bool config_has_ec, CopyDecoder&& copy_decoder) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
+  if (config_has_ec ? !v1::config(r, out.config) : !v1::config_pre_ec(r, out.config))
     return false;
-  c.replication_factor = rf;
-  c.max_workers_per_copy = mw;
-  c.min_shard_size = ms;
-  c.ec_data_shards = c.ec_parity_shards = 0;
-  return true;
-}
-
-// EC-era layout: CopyPlacement carries the ec fields but predates
-// content_crc. Same upgrade-survival rule as the pre-EC layout.
-bool decode_copy_ec_legacy(wire::Reader& r, CopyPlacement& c) {
-  c.content_crc = 0;
-  return wire::decode_fields(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                             c.ec_object_size);
-}
-
-bool decode_object_record_ec_legacy(const std::string& bytes, ObjectRecord& out) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
-  if (!wire::decode(r, out.config)) return false;
   uint32_t n = 0;
   if (!r.get(n) || n > r.remaining()) return false;
   out.copies.clear();
   out.copies.reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
     CopyPlacement c;
-    if (!decode_copy_ec_legacy(r, c)) return false;
-    out.copies.push_back(std::move(c));
-  }
-  return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
-}
-
-bool decode_object_record_legacy(const std::string& bytes, ObjectRecord& out) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
-  if (!decode_config_legacy(r, out.config)) return false;
-  uint32_t n = 0;
-  if (!r.get(n) || n > r.remaining()) return false;
-  out.copies.clear();
-  out.copies.reserve(n);
-  for (uint32_t i = 0; i < n; ++i) {
-    CopyPlacement c;
-    if (!decode_copy_legacy(r, c)) return false;
+    if (!copy_decoder(r, c)) return false;
     out.copies.push_back(std::move(c));
   }
   return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
@@ -135,11 +292,20 @@ bool decode_object_record_legacy(const std::string& bytes, ObjectRecord& out) {
 
 bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  if (wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
-                          out.copies, out.created_wall_ms, out.last_access_wall_ms))
-    return true;
-  if (decode_object_record_ec_legacy(bytes, out)) return true;
-  return decode_object_record_legacy(bytes, out);
+  switch (take_record_envelope(r)) {
+    case RecordEra::kCurrent:
+      return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
+                                 out.copies, out.created_wall_ms, out.last_access_wall_ms);
+    case RecordEra::kFuture:
+      return false;  // apply_object_record pre-screens this era; belt+braces
+    case RecordEra::kLegacy:
+      break;
+  }
+  // Newest envelope-less layout (content CRCs) first, then EC-era, then
+  // pre-EC.
+  if (decode_object_record_generation(bytes, out, true, v1::copy)) return true;
+  if (decode_object_record_generation(bytes, out, true, v1::copy_ec_era)) return true;
+  return decode_object_record_generation(bytes, out, false, v1::copy_pre_ec);
 }
 
 // Reads or writes [obj_off, obj_off+len) of one copy through its shards
@@ -466,6 +632,13 @@ void KeystoneService::load_persisted_objects() {
 
 KeystoneService::ApplyResult KeystoneService::apply_object_record(
     const ObjectKey& key, const std::string& bytes, const alloc::PoolMap& pools) {
+  {
+    // A record from a bumped future format is unusable by this build but is
+    // NOT garbage: report kFailed so callers keep the durable record (a
+    // newer keystone will serve it) instead of deleting object metadata.
+    wire::Reader probe(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+    if (take_record_envelope(probe) == RecordEra::kFuture) return ApplyResult::kFailed;
+  }
   ObjectRecord rec;
   if (!decode_object_record(bytes, rec)) return ApplyResult::kGarbage;
   // Keep only copies whose every shard still maps onto a live pool.

@@ -71,7 +71,7 @@ template <typename Req, typename Resp>
 ErrorCode KeystoneRpcClient::call(uint8_t opcode, const Req& req, Resp& resp) {
   std::vector<uint8_t> resp_bytes;
   BTPU_RETURN_IF_ERROR(call_raw(opcode, wire::to_bytes(req), resp_bytes));
-  if (!wire::from_bytes(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
+  if (!wire::from_bytes_lax(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
   return ErrorCode::OK;
 }
 
@@ -166,9 +166,11 @@ Result<ViewVersionId> KeystoneRpcClient::get_view_version() {
 
 Result<ViewVersionId> KeystoneRpcClient::ping() {
   std::vector<uint8_t> resp_bytes;
-  BTPU_RETURN_IF_ERROR(call_raw(static_cast<uint8_t>(Method::kPing), {}, resp_bytes));
+  BTPU_RETURN_IF_ERROR(call_raw(static_cast<uint8_t>(Method::kPing),
+                                wire::to_bytes(PingRequest{kProtocolVersion}), resp_bytes));
   PingResponse resp;
-  if (!wire::from_bytes(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
+  if (!wire::from_bytes_lax(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
+  server_proto_version_.store(resp.proto_version, std::memory_order_relaxed);
   return resp.view_version;
 }
 

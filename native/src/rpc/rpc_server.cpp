@@ -71,7 +71,7 @@ template <typename Req, typename Resp, typename Handler>
 std::vector<uint8_t> handle(const std::vector<uint8_t>& payload, Handler&& handler) {
   Req req{};
   Resp resp{};
-  if (!wire::from_bytes(payload, req)) {
+  if (!wire::from_bytes_lax(payload, req)) {
     resp.error_code = ErrorCode::INVALID_PARAMETERS;
   } else {
     try {
@@ -170,11 +170,26 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
             resp.error_code = r.ok() ? ErrorCode::OK : r.error();
           });
     case Method::kPing: {
-      PingResponse resp{service_.get_view_version()};
+      PingRequest req{};  // empty payload (pre-handshake peer) decodes as 0
+      if (!wire::from_bytes_lax(payload, req)) {
+        // Mid-field truncation is corruption, not version skew — answer as
+        // loudly as every handle()-routed method does.
+        Writer w;
+        w.put(ErrorCode::INVALID_PARAMETERS);
+        return w.take();
+      }
+      if (req.proto_version != 0 && req.proto_version != kProtocolVersion)
+        LOG_WARN << "peer speaks protocol v" << req.proto_version << ", this build is v"
+                 << kProtocolVersion << " (append-only rule keeps these compatible)";
+      PingResponse resp{service_.get_view_version(), kProtocolVersion};
       return wire::to_bytes(resp);
     }
   }
-  LOG_WARN << "unknown rpc opcode " << int(opcode);
+  if (opcode >= 1 && opcode <= 17)
+    LOG_WARN << "rpc opcode " << int(opcode)
+             << " is from the v1 protocol epoch — upgrade the calling binary";
+  else
+    LOG_WARN << "unknown rpc opcode " << int(opcode);
   Writer w;
   w.put(ErrorCode::NOT_IMPLEMENTED);
   return w.take();

@@ -1,5 +1,7 @@
 // Core type / error / wire-format unit tests.
 // Mirrors the serialization-roundtrip test stage from SURVEY.md §7 step 1.
+#include <algorithm>
+
 #include "btest.h"
 #include "btpu/common/error.h"
 #include "btpu/common/result.h"
@@ -78,12 +80,38 @@ BTEST(Wire, ScalarAndStringRoundtrip) {
 }
 
 BTEST(Wire, TruncatedInputFailsCleanly) {
+  // Message decode is tail-tolerant at FIELD boundaries (an older peer's
+  // frame simply ends early and the remaining fields default) but a cut
+  // mid-field is corruption and must fail, never UB.
   PutStartRequest req{.key = "obj/a", .data_size = 4096, .config = {}};
   auto bytes = wire::to_bytes(req);
-  for (size_t cut = 0; cut < bytes.size(); ++cut) {
+
+  // Compute the clean field boundaries by encoding field prefixes.
+  std::vector<size_t> boundaries = {0};
+  {
+    wire::Writer w;
+    wire::encode(w, req.key);
+    boundaries.push_back(w.size());
+    wire::encode(w, req.data_size);
+    boundaries.push_back(w.size());
+    wire::encode(w, req.config);
+    boundaries.push_back(w.size());
+    wire::encode(w, req.content_crc);
+    boundaries.push_back(w.size());
+  }
+  BT_EXPECT_EQ(boundaries.back(), bytes.size());
+
+  for (size_t cut = 0; cut <= bytes.size(); ++cut) {
     std::vector<uint8_t> prefix(bytes.begin(), bytes.begin() + cut);
     PutStartRequest out{};
-    BT_EXPECT(!wire::from_bytes(prefix, out));
+    const bool at_boundary =
+        std::find(boundaries.begin(), boundaries.end(), cut) != boundaries.end();
+    BT_EXPECT_EQ(wire::from_bytes_lax(prefix, out), at_boundary);
+    if (at_boundary && cut >= boundaries[2]) {
+      // Everything up to the cut decoded; the tail defaulted.
+      BT_EXPECT_EQ(out.key, req.key);
+      BT_EXPECT_EQ(out.data_size, req.data_size);
+    }
   }
 }
 

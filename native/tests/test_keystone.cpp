@@ -754,21 +754,39 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
   auto coordinator = std::make_shared<coord::MemCoordinator>();
   auto cfg = fast_config();
   FakeWorker w1("w1", 1 << 20);
-  coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), encode_worker_info(w1.info()));
-  coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
-                   encode_pool_record(w1.pool));
+  {  // Registry records in the pre-envelope (v1) layout those builds wrote.
+    wire::Writer w;
+    const auto info = w1.info();
+    wire::encode_fields(w, info.worker_id, info.address, info.topo.slice_id,
+                        info.topo.host_id, info.topo.chip_id, info.registered_at_ms,
+                        info.last_heartbeat_ms);
+    auto b = w.take();
+    coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), std::string(b.begin(), b.end()));
+  }
+  {
+    wire::Writer w;
+    wire::encode_fields(w, w1.pool.id, w1.pool.node_id, w1.pool.base_addr, w1.pool.size,
+                        w1.pool.used, w1.pool.storage_class, w1.pool.remote.transport,
+                        w1.pool.remote.endpoint, w1.pool.remote.remote_base,
+                        w1.pool.remote.rkey_hex, w1.pool.topo.slice_id, w1.pool.topo.host_id,
+                        w1.pool.topo.chip_id);
+    // v1 pool records could end here (pre-alignment) — exercise exactly that.
+    auto b = w.take();
+    coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
+                     std::string(b.begin(), b.end()));
+  }
   coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000);
 
+  // Shards in the historical layouts were UNPREFIXED (pre-wire-v2): every
+  // nested field back-to-back, exactly as those builds wrote them.
   auto encode_shard = [&](wire::Writer& w, uint64_t off, uint64_t len) {
-    ShardPlacement s;
-    s.pool_id = w1.pool.id;
-    s.worker_id = w1.id;
-    s.remote = w1.pool.remote;
-    s.storage_class = StorageClass::RAM_CPU;
-    s.length = len;
-    s.location = MemoryLocation{w1.pool.remote.remote_base + off,
-                                std::stoull(w1.pool.remote.rkey_hex, nullptr, 16), len};
-    wire::encode(w, s);
+    wire::encode_fields(w, w1.pool.id, w1.id);                            // pool, worker
+    wire::encode_fields(w, w1.pool.remote.transport, w1.pool.remote.endpoint,
+                        w1.pool.remote.remote_base, w1.pool.remote.rkey_hex);
+    wire::encode_fields(w, StorageClass::RAM_CPU, len);
+    w.put<uint8_t>(0);  // LocationDetail alternative: MemoryLocation
+    wire::encode_fields(w, w1.pool.remote.remote_base + off,
+                        std::stoull(w1.pool.remote.rkey_hex, nullptr, 16), len);
   };
   auto encode_config_legacy = [](wire::Writer& w) {
     // Pre-EC WorkerConfig: 10 fields, no ec_data/ec_parity.
@@ -809,6 +827,24 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
     coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/ec-era"),
                      std::string(bytes.begin(), bytes.end()));
   }
+  {  // Layout 3: last pre-envelope generation — content_crc present, but no
+     //           struct length prefixes and no record envelope.
+    wire::Writer w;
+    wire::encode_fields(w, uint64_t{2048}, uint64_t{0}, false, uint8_t{1});
+    wire::encode_fields(w, uint64_t{1}, uint64_t{1}, false, std::string{},
+                        std::vector<StorageClass>{}, uint64_t{0}, true, false,
+                        uint64_t{256 * 1024}, int32_t{-1}, uint64_t{0}, uint64_t{0});
+    w.put<uint32_t>(1);          // one copy
+    w.put<uint32_t>(0);          // copy_index
+    w.put<uint32_t>(1);          // one shard
+    encode_shard(w, 32768, 2048);
+    wire::encode_fields(w, uint32_t{0}, uint32_t{0}, uint64_t{0});  // ec geometry (none)
+    wire::encode_fields(w, uint32_t{0xABCD1234});                   // content_crc
+    wire::encode_fields(w, int64_t{5}, int64_t{6});
+    auto bytes = w.take();
+    coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/crc-era"),
+                     std::string(bytes.begin(), bytes.end()));
+  }
 
   KeystoneService ks(cfg, coordinator);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
@@ -828,6 +864,12 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
   BT_EXPECT_EQ(ec.value()[0].ec_object_size, 8000u);
   BT_EXPECT_EQ(ec.value()[0].content_crc, 0u);
 
+  BT_EXPECT(ks.object_exists("legacy/crc-era").value());
+  auto crc = ks.get_workers("legacy/crc-era");
+  BT_ASSERT_OK(crc);
+  BT_EXPECT_EQ(crc.value()[0].content_crc, 0xABCD1234u);
+  BT_EXPECT(crc.value()[0].shard_crcs.empty());  // pre-shard-CRC record
+
   // Adoption really registered the ranges: fresh allocations avoid them.
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -839,8 +881,47 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
   const uint64_t hi = lo + 4096;
   // The actual invariant: no overlap with ANY adopted legacy range.
   const std::pair<uint64_t, uint64_t> adopted[] = {
-      {0, 4096}, {8192, 12192}, {16384, 20384}, {24576, 28576}};
+      {0, 4096}, {8192, 12192}, {16384, 20384}, {24576, 28576}, {32768, 34816}};
   for (const auto& [a, b] : adopted) {
     BT_EXPECT(hi <= a || lo >= b);
   }
+}
+
+BTEST(Keystone, FutureFormatRecordsAreKeptNotDeleted) {
+  // A record enveloped with a bumped format byte (written by a build newer
+  // than this one, seen during a rollback window) is unusable here — but it
+  // is object metadata, not garbage: boot must keep it in the coordinator
+  // for the newer keystone to serve, and must not serve the object itself.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  FakeWorker w1("w1", 1 << 20);
+  coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), encode_worker_info(w1.info()));
+  coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
+                   encode_pool_record(w1.pool));
+  coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000);
+
+  const auto key = coord::object_record_key(cfg.cluster_id, "future/obj");
+  {
+    wire::Writer w;
+    w.put(~0ull);          // record magic
+    w.put<uint8_t>(3);     // bumped format: incompatible future layout
+    wire::encode_fields(w, std::string("opaque future payload"));
+    auto b = w.take();
+    coordinator->put(key, std::string(b.begin(), b.end()));
+  }
+  {  // Plain garbage (no envelope, undecodable) IS deleted at boot.
+    wire::Writer w;
+    wire::encode_fields(w, std::string("#!"));
+    auto b = w.take();
+    coordinator->put(coord::object_record_key(cfg.cluster_id, "garbage/obj"),
+                     std::string(b.begin(), b.end()));
+  }
+
+  KeystoneService ks(cfg, coordinator);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  BT_EXPECT(!ks.object_exists("future/obj").value());
+  auto kept = coordinator->get(key);
+  BT_EXPECT(kept.ok());  // record survived boot
+  auto purged = coordinator->get(coord::object_record_key(cfg.cluster_id, "garbage/obj"));
+  BT_EXPECT(!purged.ok());  // garbage did not
 }

@@ -6,6 +6,7 @@
 #include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/keystone/keystone.h"
+#include "btpu/rpc/rpc.h"
 #include "btpu/rpc/rpc_client.h"
 #include "btpu/rpc/rpc_server.h"
 #include "btpu/transport/transport.h"
@@ -138,13 +139,13 @@ BTEST(Rpc, MalformedFrameYieldsErrorNotCrash) {
   auto sock = net::tcp_connect(hp->host, hp->port);
   BT_ASSERT(sock.ok());
   std::vector<uint8_t> garbage = {0xde, 0xad};
-  BT_ASSERT(net::send_frame(sock.value().fd(), 3 /*kPutStart*/, garbage.data(),
-                            garbage.size()) == ErrorCode::OK);
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(Method::kPutStart),
+                            garbage.data(), garbage.size()) == ErrorCode::OK);
   uint8_t opcode = 0;
   std::vector<uint8_t> payload;
   BT_ASSERT(net::recv_frame(sock.value().fd(), opcode, payload) == ErrorCode::OK);
   PutStartResponse resp;
-  BT_ASSERT(wire::from_bytes(payload, resp));
+  BT_ASSERT(wire::from_bytes_lax(payload, resp));
   BT_EXPECT(resp.error_code == ErrorCode::INVALID_PARAMETERS);
   // Server is still alive.
   BT_ASSERT_OK(f.client->ping());
@@ -222,4 +223,145 @@ BTEST(Trace, SpansAggregateAndExportInMetrics) {
               std::string::npos);
     metrics.stop();
   }
+}
+
+// ---- cross-version compatibility (wire v2, rpc.h versioning stance) -------
+
+namespace {
+// Simulates a NEWER peer: splice extra bytes into a size-prefixed struct's
+// body (as if fields were appended to the struct definition).
+std::vector<uint8_t> append_into_struct(std::vector<uint8_t> bytes,
+                                        const std::vector<uint8_t>& extra) {
+  uint32_t len = 0;
+  std::memcpy(&len, bytes.data(), sizeof(len));
+  len += static_cast<uint32_t>(extra.size());
+  std::memcpy(bytes.data(), &len, sizeof(len));
+  bytes.insert(bytes.end(), extra.begin(), extra.end());
+  return bytes;
+}
+}  // namespace
+
+BTEST(Rpc, NewerPeerAppendedFieldsAreServed) {
+  // A peer built from a future revision appends fields both inside a nested
+  // struct (WorkerConfig) and at the end of the message (PutStartRequest).
+  // This build must serve the request, reading the prefix it knows.
+  RpcFixture f;
+  BT_ASSERT(f.up());
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+
+  wire::Writer extra_w;
+  wire::encode_fields(extra_w, uint64_t{42}, std::string{"future-knob"});
+  const std::vector<uint8_t> extra = extra_w.take();
+
+  wire::Writer payload;
+  wire::encode(payload, std::string("compat/newer"));
+  wire::encode(payload, uint64_t{4096});
+  {
+    wire::Writer cfg_w;
+    wire::encode(cfg_w, wc);
+    auto cfg_bytes = append_into_struct(cfg_w.take(), extra);  // nested append
+    payload.put_bytes(cfg_bytes.data(), cfg_bytes.size());
+  }
+  wire::encode(payload, uint32_t{0});                      // content_crc
+  payload.put_bytes(extra.data(), extra.size());           // message-level append
+
+  auto hp = net::parse_host_port(f.server->endpoint());
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  BT_ASSERT(sock.ok());
+  auto req = payload.take();
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(Method::kPutStart),
+                            req.data(), req.size()) == ErrorCode::OK);
+  uint8_t opcode = 0;
+  std::vector<uint8_t> resp_bytes;
+  BT_ASSERT(net::recv_frame(sock.value().fd(), opcode, resp_bytes) == ErrorCode::OK);
+  PutStartResponse resp;
+  BT_ASSERT(wire::from_bytes_lax(resp_bytes, resp));
+  BT_EXPECT(resp.error_code == ErrorCode::OK);
+  BT_ASSERT(resp.copies.size() == 1u);
+
+  // The object really placed — visible through the normal client.
+  BT_ASSERT(f.client->put_complete("compat/newer") == ErrorCode::OK);
+  auto got = f.client->get_workers("compat/newer");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value()[0].shards.size(), 1u);
+}
+
+BTEST(Rpc, OlderPeerOmittedTrailingFieldsDefault) {
+  // A peer built BEFORE trailing fields existed: its PutStartRequest ends
+  // after the config (no content_crc), and its WorkerConfig body ends after
+  // preferred_slice (no ec fields). Both must decode with defaults.
+  RpcFixture f;
+  BT_ASSERT(f.up());
+
+  wire::Writer payload;
+  wire::encode(payload, std::string("compat/older"));
+  wire::encode(payload, uint64_t{2048});
+  wire::encode_struct(payload, uint64_t{1}, uint64_t{1}, false, std::string{},
+                      std::vector<StorageClass>{}, uint64_t{0}, true, false,
+                      uint64_t{256 * 1024}, int32_t{-1});  // 10-field config body
+  // message ends here: no content_crc
+
+  auto hp = net::parse_host_port(f.server->endpoint());
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  BT_ASSERT(sock.ok());
+  auto req = payload.take();
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(Method::kPutStart),
+                            req.data(), req.size()) == ErrorCode::OK);
+  uint8_t opcode = 0;
+  std::vector<uint8_t> resp_bytes;
+  BT_ASSERT(net::recv_frame(sock.value().fd(), opcode, resp_bytes) == ErrorCode::OK);
+  PutStartResponse resp;
+  BT_ASSERT(wire::from_bytes_lax(resp_bytes, resp));
+  BT_EXPECT(resp.error_code == ErrorCode::OK);
+  BT_ASSERT(resp.copies.size() == 1u);
+  BT_EXPECT_EQ(resp.copies[0].content_crc, 0u);  // defaulted: reads skip verify
+}
+
+BTEST(Rpc, PingHandshakeReportsProtocolVersion) {
+  RpcFixture f;
+  BT_ASSERT(f.up());
+  BT_EXPECT_EQ(f.client->server_proto_version(), 0u);  // not yet pinged
+  BT_ASSERT_OK(f.client->ping());
+  BT_EXPECT_EQ(f.client->server_proto_version(), kProtocolVersion);
+
+  // A pre-handshake peer pings with an empty payload — still answered.
+  auto hp = net::parse_host_port(f.server->endpoint());
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  BT_ASSERT(sock.ok());
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(Method::kPing), nullptr,
+                            0) == ErrorCode::OK);
+  uint8_t opcode = 0;
+  std::vector<uint8_t> resp_bytes;
+  BT_ASSERT(net::recv_frame(sock.value().fd(), opcode, resp_bytes) == ErrorCode::OK);
+  PingResponse resp;
+  BT_ASSERT(wire::from_bytes_lax(resp_bytes, resp));
+  BT_EXPECT_EQ(resp.proto_version, kProtocolVersion);
+}
+
+BTEST(Rpc, V1EpochOpcodeFailsLoudlyNotSilently) {
+  // Opcodes 1-17 belong to the pre-stability v1 epoch: the server must
+  // answer with an error, never attempt a mis-decode of the payload.
+  RpcFixture f;
+  BT_ASSERT(f.up());
+  auto hp = net::parse_host_port(f.server->endpoint());
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  BT_ASSERT(sock.ok());
+  // A well-formed v1 PutStartRequest prefix (key + size) — still rejected.
+  wire::Writer payload;
+  wire::encode(payload, std::string("v1/obj"));
+  wire::encode(payload, uint64_t{4096});
+  auto req = payload.take();
+  BT_ASSERT(net::send_frame(sock.value().fd(), 3 /*v1 kPutStart*/, req.data(), req.size()) ==
+            ErrorCode::OK);
+  uint8_t opcode = 0;
+  std::vector<uint8_t> resp_bytes;
+  BT_ASSERT(net::recv_frame(sock.value().fd(), opcode, resp_bytes) == ErrorCode::OK);
+  BT_ASSERT(resp_bytes.size() == sizeof(ErrorCode));
+  ErrorCode ec{};
+  std::memcpy(&ec, resp_bytes.data(), sizeof(ec));
+  BT_EXPECT(ec == ErrorCode::NOT_IMPLEMENTED);
+  BT_EXPECT(!f.ks.object_exists("v1/obj").value());  // nothing was placed
 }

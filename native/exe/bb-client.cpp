@@ -150,17 +150,37 @@ int main(int argc, char** argv) {
     std::printf("%zu objects%s\n", listed.value().size(), prefix.empty()
                 ? "" : (" with prefix " + prefix).c_str());
   } else if (command == "scrub") {
-    // Data scrubber: verified-read every object under the prefix and report
-    // integrity. Reads go through the normal client path, so a corrupt
-    // replica is healed over transparently (and logged) — only objects
-    // with NO healthy source count as corrupt.
+    // Data scrubber: per-shard integrity audit of every object under the
+    // prefix — EVERY shard of EVERY copy is read and checked against its
+    // writer-stamped CRC32C, so silent rot is found and NAMED (worker/pool)
+    // even while reads still heal over it transparently. Objects whose
+    // findings leave no healthy read path report CORRUPT; heal-able rot
+    // reports DEGRADED. Exit 4 on any finding: in-place rot needs an
+    // operator (or repair) even when serving still works.
     const std::string prefix = positional.size() > 1 ? positional[1] : "";
     auto listed = client.list_objects(prefix, 0);
     if (!listed.ok()) return fail(listed.error());
-    size_t ok = 0, corrupt = 0, unreadable = 0;
+    size_t ok = 0, degraded = 0, corrupt = 0, unreadable = 0;
     uint64_t bytes = 0;
     std::vector<uint8_t> buf;
     for (const auto& obj : listed.value()) {
+      auto findings = client.scrub_object(obj.key);
+      if (!findings.ok()) {
+        ++unreadable;
+        std::printf("UNREADABLE %s (%s)\n", obj.key.c_str(),
+                    std::string(to_string(findings.error())).c_str());
+        continue;
+      }
+      size_t flagged = 0;
+      for (const auto& f : findings.value()) {
+        if (f.status != ErrorCode::OK) ++flagged;
+      }
+      if (flagged == 0) {
+        ++ok;
+        bytes += obj.size;
+        continue;
+      }
+      // Some shard is rotten or unreachable: is the object still readable?
       Result<uint64_t> got = ErrorCode::OUT_OF_MEMORY;
       try {
         buf.resize(obj.size);
@@ -169,8 +189,9 @@ int main(int argc, char** argv) {
         // An object bigger than this machine's RAM: count it, keep going.
       }
       if (got.ok()) {
-        ++ok;
+        ++degraded;
         bytes += got.value();
+        std::printf("DEGRADED   %s (readable; %zu bad shard(s))\n", obj.key.c_str(), flagged);
       } else if (got.error() == ErrorCode::CHECKSUM_MISMATCH) {
         ++corrupt;
         std::printf("CORRUPT    %s\n", obj.key.c_str());
@@ -179,10 +200,22 @@ int main(int argc, char** argv) {
         std::printf("UNREADABLE %s (%s)\n", obj.key.c_str(),
                     std::string(to_string(got.error())).c_str());
       }
+      for (const auto& f : findings.value()) {
+        if (f.status == ErrorCode::OK) continue;
+        if (f.shard_index == client::ObjectClient::ShardFinding::kWholeCopy) {
+          std::printf("  copy %u: %s (no shard CRCs: pre-upgrade object)\n", f.copy_index,
+                      std::string(to_string(f.status)).c_str());
+        } else {
+          std::printf("  copy %u shard %u: %s (pool %s, worker %s)\n", f.copy_index,
+                      f.shard_index, std::string(to_string(f.status)).c_str(),
+                      f.pool_id.c_str(), f.worker_id.c_str());
+        }
+      }
     }
-    std::printf("scrubbed %zu objects (%llu bytes): %zu ok, %zu corrupt, %zu unreadable\n",
-                listed.value().size(), (unsigned long long)bytes, ok, corrupt, unreadable);
-    return corrupt + unreadable == 0 ? 0 : 4;
+    std::printf(
+        "scrubbed %zu objects (%llu bytes): %zu ok, %zu degraded, %zu corrupt, %zu unreadable\n",
+        listed.value().size(), (unsigned long long)bytes, ok, degraded, corrupt, unreadable);
+    return degraded + corrupt + unreadable == 0 ? 0 : 4;
   } else if (command == "stats") {
     auto stats = client.cluster_stats();
     if (!stats.ok()) return fail(stats.error());

@@ -85,6 +85,21 @@ class ObjectClient {
                                   const WorkerConfig& config);
   std::vector<Result<uint64_t>> get_many(const std::vector<GetItem>& items);
 
+  // Per-shard integrity report for one object (the scrub localization
+  // surface): reads every shard of every copy individually and checks it
+  // against the writer-stamped shard CRC. Copies without shard CRCs fall
+  // back to a whole-copy read verified against the object CRC, reported as
+  // one finding with shard_index = kWholeCopy.
+  struct ShardFinding {
+    uint32_t copy_index{0};
+    uint32_t shard_index{0};
+    static constexpr uint32_t kWholeCopy = ~0u;
+    MemoryPoolId pool_id;
+    NodeId worker_id;
+    ErrorCode status{ErrorCode::OK};  // OK / CHECKSUM_MISMATCH / transport error
+  };
+  Result<std::vector<ShardFinding>> scrub_object(const ObjectKey& key);
+
   ErrorCode remove(const ObjectKey& key);
   Result<uint64_t> remove_all();
   // Graceful worker evacuation (keystone::drain_worker semantics).

@@ -264,7 +264,19 @@ struct PutStartRequest {
 };
 struct PutStartResponse { std::vector<CopyPlacement> copies; ErrorCode error_code{ErrorCode::OK}; };
 
-struct PutCompleteRequest { ObjectKey key; };
+// Per-shard CRC32C stamps for one copy, reported by the writing client at
+// put_complete (shard boundaries are chosen by placement, so the client can
+// only compute these AFTER put_start). For coded copies the vector covers
+// all k+m shards, parity included.
+struct CopyShardCrcs {
+  uint32_t copy_index{0};
+  std::vector<uint32_t> crcs;
+};
+
+struct PutCompleteRequest {
+  ObjectKey key;
+  std::vector<CopyShardCrcs> shard_crcs;  // may be empty (older clients)
+};
 struct PutCompleteResponse { ErrorCode error_code{ErrorCode::OK}; };
 
 struct PutCancelRequest { ObjectKey key; };
@@ -324,7 +336,11 @@ struct BatchPutStartResponse {
   ErrorCode error_code{ErrorCode::OK};
 };
 
-struct BatchPutCompleteRequest { std::vector<ObjectKey> keys; };
+struct BatchPutCompleteRequest {
+  std::vector<ObjectKey> keys;
+  // Parallel to `keys`; empty, or one (possibly empty) entry per key.
+  std::vector<std::vector<CopyShardCrcs>> shard_crcs;
+};
 struct BatchPutCompleteResponse { std::vector<ErrorCode> results; ErrorCode error_code{ErrorCode::OK}; };
 
 struct BatchPutCancelRequest { std::vector<ObjectKey> keys; };

@@ -333,6 +333,9 @@ inline bool decode(Reader& r, BatchPutStartItem& i) {
   return decode_struct(r, i.key, i.data_size, i.config, i.content_crc);
 }
 
+inline void encode(Writer& w, const CopyShardCrcs& c) { encode_struct(w, c.copy_index, c.crcs); }
+inline bool decode(Reader& r, CopyShardCrcs& c) { return decode_struct(r, c.copy_index, c.crcs); }
+
 template <typename T>
 void encode(Writer& w, const std::vector<T>& v) {
   if (v.size() > std::numeric_limits<uint32_t>::max())
@@ -382,7 +385,7 @@ BTPU_WIRE_STRUCT(GetWorkersRequest, f0)
 BTPU_WIRE_STRUCT(GetWorkersResponse, f0, f1)
 BTPU_WIRE_STRUCT(PutStartRequest, f0, f1, f2, f3)
 BTPU_WIRE_STRUCT(PutStartResponse, f0, f1)
-BTPU_WIRE_STRUCT(PutCompleteRequest, f0)
+BTPU_WIRE_STRUCT(PutCompleteRequest, f0, f1)
 BTPU_WIRE_STRUCT(PutCompleteResponse, f0)
 BTPU_WIRE_STRUCT(PutCancelRequest, f0)
 BTPU_WIRE_STRUCT(PutCancelResponse, f0)
@@ -404,7 +407,7 @@ BTPU_WIRE_STRUCT(BatchGetWorkersRequest, f0)
 BTPU_WIRE_STRUCT(BatchGetWorkersResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutStartRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutStartResponse, f0, f1)
-BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0)
+BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutCompleteResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutCancelRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutCancelResponse, f0, f1)

@@ -110,7 +110,10 @@ class KeystoneService {
   Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
                                                const WorkerConfig& config,
                                                uint32_t content_crc = 0);
-  ErrorCode put_complete(const ObjectKey& key);
+  // shard_crcs: per-copy per-shard CRC32C stamps the writing client computed
+  // against the placement put_start returned (empty = not stamped); entries
+  // that don't match a copy's index/shard count are ignored.
+  ErrorCode put_complete(const ObjectKey& key, const std::vector<CopyShardCrcs>& shard_crcs = {});
   ErrorCode put_cancel(const ObjectKey& key);
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
@@ -120,7 +123,9 @@ class KeystoneService {
       const std::vector<ObjectKey>& keys);
   std::vector<Result<std::vector<CopyPlacement>>> batch_put_start(
       const std::vector<BatchPutStartItem>& items);
-  std::vector<ErrorCode> batch_put_complete(const std::vector<ObjectKey>& keys);
+  std::vector<ErrorCode> batch_put_complete(
+      const std::vector<ObjectKey>& keys,
+      const std::vector<std::vector<CopyShardCrcs>>& shard_crcs = {});
   std::vector<ErrorCode> batch_put_cancel(const std::vector<ObjectKey>& keys);
 
   // Prefix listing ("" = everything), lexicographically ordered, COMPLETE

@@ -24,7 +24,8 @@ class KeystoneRpcClient {
   Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
                                                const WorkerConfig& config,
                                                uint32_t content_crc = 0);
-  ErrorCode put_complete(const ObjectKey& key);
+  ErrorCode put_complete(const ObjectKey& key,
+                         const std::vector<CopyShardCrcs>& shard_crcs = {});
   ErrorCode put_cancel(const ObjectKey& key);
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
@@ -44,7 +45,9 @@ class KeystoneRpcClient {
       const std::vector<ObjectKey>& keys);
   Result<std::vector<Result<std::vector<CopyPlacement>>>> batch_put_start(
       const std::vector<BatchPutStartItem>& items);
-  Result<std::vector<ErrorCode>> batch_put_complete(const std::vector<ObjectKey>& keys);
+  Result<std::vector<ErrorCode>> batch_put_complete(
+      const std::vector<ObjectKey>& keys,
+      const std::vector<std::vector<CopyShardCrcs>>& shard_crcs = {});
   Result<std::vector<ErrorCode>> batch_put_cancel(const std::vector<ObjectKey>& keys);
 
  private:

@@ -307,10 +307,32 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
     }
   }
   data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  // Shard i's current bytes (user buffer or padded temp).
+  auto shard_bytes = [&](size_t i) -> const uint8_t* {
+    return temps[i].empty() ? data + i * L : temps[i].data();
+  };
+  // Per-shard CRCs (when the writer stamped them) LOCALIZE corruption: a
+  // shard whose bytes arrived but fail its own CRC is treated exactly like
+  // a missing shard, so the one reconstruction path below absorbs any mix
+  // of lost and bit-rotten shards up to m — multi-shard corruption included
+  // (the object-level CRC alone can only detect that case, not repair it).
+  const bool stamped = copy.shard_crcs.size() == k + m;
+  size_t condemned = 0;  // shards whose bytes arrived but failed their CRC
+  auto shard_corrupt = [&](size_t i, const uint8_t* bytes) {
+    if (!stamped) return false;
+    if (crc32c(bytes, L) == copy.shard_crcs[i]) return false;
+    const auto& s = copy.shards[i];
+    LOG_WARN << "ec read: shard " << i << " corrupt (pool " << s.pool_id << ", worker "
+             << s.worker_id << ")";
+    ++condemned;
+    return true;
+  };
   std::vector<bool> have(k + m, false);
   size_t missing = 0;
   for (size_t i = 0; i < k; ++i) {
-    have[i] = padding_only[i] || (addressable[i] && ops[i].status == ErrorCode::OK);
+    have[i] = padding_only[i] ||
+              (addressable[i] && ops[i].status == ErrorCode::OK &&
+               !shard_corrupt(i, shard_bytes(i)));
     if (!have[i]) ++missing;
   }
   auto copy_out = [&](size_t i, const uint8_t* src) {
@@ -330,11 +352,8 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
     }
     data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);
     for (size_t j = 0; j < m; ++j)
-      have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK;
-  };
-  // Shard i's current bytes (user buffer or padded temp).
-  auto shard_bytes = [&](size_t i) -> const uint8_t* {
-    return temps[i].empty() ? data + i * L : temps[i].data();
+      have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK &&
+                    !shard_corrupt(k + j, parity[j].data());
   };
   // Verifies the object CRC treating per-shard sources; `override_i`/bytes
   // substitute one shard (the corruption hunt's candidate reconstruction).
@@ -386,7 +405,11 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
     }
     return ErrorCode::CHECKSUM_MISMATCH;  // multi-shard corruption: beyond m=?
   }
-  if (missing > m) return ErrorCode::NO_COMPLETE_WORKER;
+  // Beyond tolerance: when CRC condemnation contributed, report corruption
+  // (scrubbers key off CHECKSUM_MISMATCH, not transport loss).
+  if (missing > m) {
+    return condemned > 0 ? ErrorCode::CHECKSUM_MISMATCH : ErrorCode::NO_COMPLETE_WORKER;
+  }
 
   // Degraded read: fetch parity shards, reconstruct the missing data.
   LOG_WARN << "ec read: " << missing << " data shard(s) unreadable, reconstructing";
@@ -407,7 +430,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
     if (have[k + j]) present[k + j] = parity[j].data();
   }
   if (!ec::rs_reconstruct(present.data(), k, m, L, out.data()))
-    return ErrorCode::NO_COMPLETE_WORKER;
+    return condemned > 0 ? ErrorCode::CHECKSUM_MISMATCH : ErrorCode::NO_COMPLETE_WORKER;
   for (size_t i = 0; i < k; ++i) {
     if (have[i]) {
       if (!temps[i].empty()) copy_out(i, temps[i].data());
@@ -488,6 +511,16 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
   if (copy.content_crc != 0 && crc32c(data, size) != copy.content_crc) {
     LOG_WARN << "content crc mismatch on copy " << copy.copy_index
              << " (bit rot or torn write): treating as copy loss";
+    // Shard CRCs (when stamped) localize the rot for the operator/scrubber.
+    if (copy.shard_crcs.size() == copy.shards.size()) {
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        if (crc32c(data + offsets[i], copy.shards[i].length) != copy.shard_crcs[i]) {
+          const auto& s = copy.shards[i];
+          LOG_WARN << "  corrupt shard " << i << " (pool " << s.pool_id << ", worker "
+                   << s.worker_id << ")";
+        }
+      }
+    }
     return ErrorCode::CHECKSUM_MISMATCH;
   }
   return ErrorCode::OK;
@@ -501,6 +534,82 @@ ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8
 ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* data,
                                           uint64_t size) {
   return transfer_copy(copy, data, size, /*is_write=*/false);
+}
+
+Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
+    const ObjectKey& key) {
+  auto copies = get_workers(key);
+  if (!copies.ok()) return copies.error();
+  std::vector<ShardFinding> findings;
+  // Stamped copies: every shard of every copy reads as ONE pipelined wire
+  // batch (per-op status lands on its finding), so the audit costs ~one
+  // round trip per object, not one per shard. Device-located shards can't
+  // ride the wire batch; they go through shard_io below.
+  std::vector<transport::WireOp> ops;
+  std::vector<size_t> op_finding;
+  std::vector<std::vector<uint8_t>> bufs;
+  struct Deferred {  // device shards + expected CRC, checked after the batch
+    size_t finding;
+    const ShardPlacement* shard;
+    uint32_t expect;
+  };
+  std::vector<Deferred> deferred;
+  std::vector<uint32_t> expected;  // parallel to findings (stamped ones)
+  std::vector<uint8_t> buf;
+  for (const auto& copy : copies.value()) {
+    if (copy.shard_crcs.size() == copy.shards.size() && !copy.shards.empty()) {
+      // Writer-stamped shard CRCs: verify each shard in isolation so the
+      // report names exactly which worker/pool holds rotten bytes.
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        const auto& shard = copy.shards[i];
+        findings.push_back({copy.copy_index, static_cast<uint32_t>(i), shard.pool_id,
+                            shard.worker_id, ErrorCode::OK});
+        expected.resize(findings.size(), 0);
+        expected.back() = copy.shard_crcs[i];
+        bufs.emplace_back(shard.length);
+        transport::WireOp op;
+        if (transport::make_wire_op(shard, 0, bufs.back().data(), shard.length, op)) {
+          ops.push_back(op);
+          op_finding.push_back(findings.size() - 1);
+        } else {
+          deferred.push_back({findings.size() - 1, &shard, copy.shard_crcs[i]});
+        }
+      }
+      continue;
+    }
+    // Pre-shard-CRC copy: the object CRC can only judge the copy as a whole.
+    const uint64_t size = copy_logical_size(copy);
+    ShardFinding f{copy.copy_index, ShardFinding::kWholeCopy, {}, {}, ErrorCode::OK};
+    try {
+      buf.resize(size);
+      f.status = transfer_copy_get(copy, buf.data(), size);
+    } catch (const std::bad_alloc&) {
+      f.status = ErrorCode::OUT_OF_MEMORY;
+    }
+    findings.push_back(std::move(f));
+    expected.resize(findings.size(), 0);
+  }
+  if (!ops.empty()) data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  for (size_t j = 0; j < ops.size(); ++j) {
+    auto& f = findings[op_finding[j]];
+    if (ops[j].status != ErrorCode::OK) {
+      f.status = ops[j].status;
+    } else if (crc32c(ops[j].buf, ops[j].len) != expected[op_finding[j]]) {
+      f.status = ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  for (const auto& d : deferred) {
+    auto& f = findings[d.finding];
+    buf.resize(d.shard->length);
+    if (auto ec = transport::shard_io(*data_, *d.shard, 0, buf.data(), d.shard->length,
+                                      /*is_write=*/false);
+        ec != ErrorCode::OK) {
+      f.status = ec;
+    } else if (crc32c(buf.data(), d.shard->length) != d.expect) {
+      f.status = ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  return findings;
 }
 
 // ---- batched object I/O ----------------------------------------------------
@@ -517,8 +626,17 @@ struct BatchJobs {
 
 // Splits one copy of `size` bytes at `data` into jobs, appending to `jobs`.
 // Returns INVALID_PARAMETERS when the shard lengths do not sum to size.
+// `crcs_out` (when non-null) receives this copy's per-shard CRC32C stamps —
+// computed here because the put path is the one place the shard boundaries
+// and the bytes are both in hand.
 ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t size,
-                           size_t item_index, BatchJobs& jobs) {
+                           size_t item_index, BatchJobs& jobs,
+                           CopyShardCrcs* crcs_out = nullptr) {
+  if (crcs_out) {
+    crcs_out->copy_index = copy.copy_index;
+    crcs_out->crcs.clear();
+    crcs_out->crcs.reserve(copy.shards.size());
+  }
   uint64_t off = 0;
   for (const auto& shard : copy.shards) {
     if (off + shard.length > size) return ErrorCode::INVALID_PARAMETERS;
@@ -530,6 +648,7 @@ ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t si
       jobs.wire.push_back(job);
       jobs.wire_item.push_back(item_index);
     }
+    if (crcs_out) crcs_out->crcs.push_back(crc32c(data + off, shard.length));
     off += shard.length;
   }
   return off == size ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
@@ -540,7 +659,7 @@ ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t si
 // grows). EC pools are wire-only by placement, so every job is a wire job.
 ErrorCode append_ec_put_jobs(const CopyPlacement& copy, const uint8_t* data, uint64_t size,
                              size_t item_index, std::vector<std::vector<uint8_t>>& arena,
-                             BatchJobs& jobs) {
+                             BatchJobs& jobs, CopyShardCrcs* crcs_out = nullptr) {
   const size_t k = copy.ec_data_shards, m = copy.ec_parity_shards;
   if (copy.shards.size() != k + m || size != copy.ec_object_size)
     return ErrorCode::INVALID_PARAMETERS;
@@ -567,10 +686,18 @@ ErrorCode append_ec_put_jobs(const CopyPlacement& copy, const uint8_t* data, uin
   }
   if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
     return ErrorCode::INVALID_PARAMETERS;
+  if (crcs_out) {
+    crcs_out->copy_index = copy.copy_index;
+    crcs_out->crcs.clear();
+    crcs_out->crcs.reserve(k + m);
+  }
   for (size_t i = 0; i < k + m; ++i) {
     uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity_ptrs[i - k];
     jobs.wire.push_back({&copy.shards[i], 0, buf, L});
     jobs.wire_item.push_back(item_index);
+    // Shard CRCs cover the full L wire bytes (padding included) so readers
+    // and scrubbers can verify a shard without knowing the object size.
+    if (crcs_out) crcs_out->crcs.push_back(crc32c(buf, L));
   }
   return ErrorCode::OK;
 }
@@ -697,6 +824,7 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
 
   BatchJobs jobs;
   std::vector<std::vector<uint8_t>> ec_arena;
+  std::vector<std::vector<CopyShardCrcs>> item_crcs(items.size());
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok()) {
       results[i] = placed[i].error();
@@ -705,16 +833,20 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     auto* data = const_cast<uint8_t*>(static_cast<const uint8_t*>(items[i].data));
     if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
       // Erasure-coded item: encode now, ship with the shared wire batch.
+      CopyShardCrcs crcs;
       results[i] = append_ec_put_jobs(placed[i].value().front(), data, items[i].size, i,
-                                      ec_arena, jobs);
+                                      ec_arena, jobs, &crcs);
+      if (results[i] == ErrorCode::OK) item_crcs[i].push_back(std::move(crcs));
       continue;
     }
     for (const auto& copy : placed[i].value()) {
-      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs);
+      CopyShardCrcs crcs;
+      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs, &crcs);
           ec != ErrorCode::OK) {
         results[i] = ec;
         break;
       }
+      item_crcs[i].push_back(std::move(crcs));
     }
   }
 
@@ -734,11 +866,13 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   }
 
   std::vector<ObjectKey> completes, cancels;
+  std::vector<std::vector<CopyShardCrcs>> complete_crcs;
   std::vector<size_t> complete_idx;
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok()) continue;  // never reserved
     if (results[i] == ErrorCode::OK) {
       completes.push_back(items[i].key);
+      complete_crcs.push_back(std::move(item_crcs[i]));
       complete_idx.push_back(i);
     } else {
       LOG_WARN << "put " << items[i].key << " transfer failed ("
@@ -749,10 +883,10 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   if (!completes.empty()) {
     std::vector<ErrorCode> ecs;
     if (embedded_) {
-      ecs = embedded_->batch_put_complete(completes);
+      ecs = embedded_->batch_put_complete(completes, complete_crcs);
     } else {
       auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
-        return c.batch_put_complete(completes);
+        return c.batch_put_complete(completes, complete_crcs);
       });
       ecs = r.ok() ? std::move(r.value())
                    : std::vector<ErrorCode>(completes.size(), r.error());

@@ -315,6 +315,19 @@ ErrorCode copy_io(transport::TransportClient& client, const CopyPlacement& copy,
   return transport::copy_range_io(client, copy, obj_off, buf, len, is_write);
 }
 
+// Shard CRCs are layout-bound: after a byte-identical move (repair top-up,
+// demotion), the source's stamps remain valid for the destination only when
+// it striped identically. A different layout stays unstamped rather than
+// wrongly stamped.
+void carry_shard_crcs(const CopyPlacement& src, CopyPlacement& dst) {
+  if (src.shard_crcs.size() != src.shards.size()) return;
+  if (dst.shards.size() != src.shards.size()) return;
+  for (size_t i = 0; i < dst.shards.size(); ++i) {
+    if (dst.shards[i].length != src.shards[i].length) return;
+  }
+  dst.shard_crcs = src.shard_crcs;
+}
+
 bool all_shards_on_device(const CopyPlacement& copy) {
   return !copy.shards.empty() &&
          std::all_of(copy.shards.begin(), copy.shards.end(), [](const ShardPlacement& s) {
@@ -1041,11 +1054,20 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   return placed;
 }
 
-ErrorCode KeystoneService::put_complete(const ObjectKey& key) {
+ErrorCode KeystoneService::put_complete(const ObjectKey& key,
+                                        const std::vector<CopyShardCrcs>& shard_crcs) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  for (const auto& sc : shard_crcs) {
+    for (auto& copy : it->second.copies) {
+      if (copy.copy_index == sc.copy_index && copy.shards.size() == sc.crcs.size()) {
+        copy.shard_crcs = sc.crcs;
+        break;
+      }
+    }
+  }
   it->second.state = ObjectState::kComplete;
   it->second.last_access = std::chrono::steady_clock::now();
   ++counters_.put_completes;
@@ -1122,10 +1144,15 @@ std::vector<Result<std::vector<CopyPlacement>>> KeystoneService::batch_put_start
   return out;
 }
 
-std::vector<ErrorCode> KeystoneService::batch_put_complete(const std::vector<ObjectKey>& keys) {
+std::vector<ErrorCode> KeystoneService::batch_put_complete(
+    const std::vector<ObjectKey>& keys,
+    const std::vector<std::vector<CopyShardCrcs>>& shard_crcs) {
   std::vector<ErrorCode> out;
   out.reserve(keys.size());
-  for (const auto& key : keys) out.push_back(put_complete(key));
+  for (size_t i = 0; i < keys.size(); ++i) {
+    out.push_back(put_complete(
+        keys[i], i < shard_crcs.size() ? shard_crcs[i] : std::vector<CopyShardCrcs>{}));
+  }
   return out;
 }
 
@@ -1352,6 +1379,12 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       if (auto pr = shard_to_range(shards[m.shard_index], memory_pools())) {
         adapter_.allocator().release_range(m.key, pr->first, pr->second);
       }
+      // Shard CRCs: a 1:1 splice moves identical bytes, so the stamp at this
+      // index stays valid untouched. A 1:n splice changes the shard layout —
+      // the stamps no longer line up, so the copy degrades to unstamped
+      // (empty) rather than carrying stamps attributed to the wrong shards.
+      if (staged[0].shards.size() != 1)
+        it->second.copies[m.copy_index].shard_crcs.clear();
       shards.erase(shards.begin() + static_cast<ptrdiff_t>(m.shard_index));
       shards.insert(shards.begin() + static_cast<ptrdiff_t>(m.shard_index),
                     staged[0].shards.begin(), staged[0].shards.end());
@@ -1732,14 +1765,14 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     }
     std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
 
-    bool streamed = false;
+    const CopyPlacement* streamed_src = nullptr;
     for (const auto& src : p.surviving) {
       if (copy_object_bytes(*data_client_, src, staged, p.size) == ErrorCode::OK) {
-        streamed = true;
+        streamed_src = &src;
         break;
       }
     }
-    if (!streamed) {
+    if (!streamed_src) {
       adapter_.free_object(staging_key);
       continue;  // survivors still serve reads; retry on a later event
     }
@@ -1762,6 +1795,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       copy.content_crc = it->second.copies.empty()
                              ? 0
                              : it->second.copies.front().content_crc;
+      carry_shard_crcs(*streamed_src, copy);
       it->second.copies.push_back(std::move(copy));
     }
     it->second.epoch = next_epoch_.fetch_add(1);
@@ -1787,6 +1821,13 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
 
 // Rebuilds the dead shards of one coded copy. Returns true when the object
 // was fully healed (every dead shard reconstructed and spliced).
+//
+// When the copy carries per-shard CRC stamps, every shard read during
+// reconstruction is screened against its stamp. A live-but-rotten shard
+// must never serve as a reconstruction basis (the rebuild would be garbage,
+// restamped as valid — turning recoverable rot into permanent loss);
+// instead it is promoted to a repair target itself, so repair heals silent
+// corruption in the same pass that heals worker death.
 bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
                                        const CopyPlacement& copy,
                                        const std::vector<size_t>& dead_idx,
@@ -1797,145 +1838,213 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
   const size_t n = copy.shards.size();
   if (k == 0 || n != k + m) return false;
   const uint64_t L = copy.shards.front().length;
+  const bool stamped = copy.shard_crcs.size() == n;
 
-  std::vector<bool> dead(n, false);
-  for (size_t d : dead_idx) dead[d] = true;
+  // Repair targets: the caller's dead shards, plus any live shard a CRC
+  // screen condemns below (each retry may extend this list).
+  std::vector<size_t> targets = dead_idx;
+  const std::vector<size_t> original_dead = dead_idx;
 
-  // 1. Fresh placements, one plain wire shard per dead index; anti-affine
-  // with every worker the copy still touches (and earlier replacements).
-  std::vector<NodeId> excluded;
-  for (size_t i = 0; i < n; ++i) {
-    if (!dead[i]) excluded.push_back(copy.shards[i].worker_id);
-  }
   struct Staged {
     std::string staging_key;
     CopyPlacement placement;
   };
-  std::vector<Staged> staged(dead_idx.size());
-  auto free_all_staged = [&](size_t upto) {
-    for (size_t j = 0; j < upto; ++j) adapter_.free_object(staged[j].staging_key);
+  std::vector<Staged> staged;
+  auto free_all_staged = [&] {
+    for (auto& st : staged) adapter_.free_object(st.staging_key);
+    staged.clear();
   };
-  for (size_t j = 0; j < dead_idx.size(); ++j) {
-    const size_t d = dead_idx[j];
-    WorkerConfig cfg = {};
-    cfg.replication_factor = 1;
-    cfg.max_workers_per_copy = 1;
-    staged[j].staging_key = key + "\x01" "ecrepair" + std::to_string(d);
-    alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
-        staged[j].staging_key, L, cfg);
-    // Stay in a wire tier (a device shard would be unreadable to the coded
-    // client path, even on the relaxed retry); same class as the lost shard
-    // when possible.
-    req.wire_only = true;
-    req.preferred_classes = {copy.shards[d].storage_class};
-    req.excluded_nodes = excluded;
-    auto attempt = adapter_.allocator().allocate(req, target_pools);
-    if (!attempt.ok()) {
-      req.excluded_nodes.clear();
-      attempt = adapter_.allocator().allocate(req, target_pools);
+  std::vector<uint32_t> rebuilt_crcs;
+
+  // Each attempt either completes the segmented reconstruction with a clean
+  // basis, or condemns at least one more shard (bounded by tolerance m).
+  for (;;) {
+    std::vector<bool> dead(n, false);
+    for (size_t d : targets) dead[d] = true;
+
+    // 1. Fresh placements, one plain wire shard per target index;
+    // anti-affine with every worker the copy still touches (and earlier
+    // replacements).
+    std::vector<NodeId> excluded;
+    for (size_t i = 0; i < n; ++i) {
+      if (!dead[i]) excluded.push_back(copy.shards[i].worker_id);
     }
-    // The coded geometry needs exactly ONE shard at this position.
-    if (!attempt.ok() || attempt.value().copies[0].shards.size() != 1 ||
-        std::holds_alternative<DeviceLocation>(
-            attempt.value().copies[0].shards[0].location)) {
-      if (attempt.ok()) adapter_.free_object(staged[j].staging_key);
-      free_all_staged(j);
-      LOG_WARN << "ec repair of " << key << " stays degraded: no placement for shard " << d;
+    staged.assign(targets.size(), {});
+    bool staged_ok = true;
+    for (size_t j = 0; j < targets.size() && staged_ok; ++j) {
+      const size_t d = targets[j];
+      WorkerConfig cfg = {};
+      cfg.replication_factor = 1;
+      cfg.max_workers_per_copy = 1;
+      staged[j].staging_key = key + "\x01" "ecrepair" + std::to_string(d);
+      alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+          staged[j].staging_key, L, cfg);
+      // Stay in a wire tier (a device shard would be unreadable to the coded
+      // client path, even on the relaxed retry); same class as the lost
+      // shard when possible.
+      req.wire_only = true;
+      req.preferred_classes = {copy.shards[d].storage_class};
+      req.excluded_nodes = excluded;
+      auto attempt = adapter_.allocator().allocate(req, target_pools);
+      if (!attempt.ok()) {
+        req.excluded_nodes.clear();
+        attempt = adapter_.allocator().allocate(req, target_pools);
+      }
+      // The coded geometry needs exactly ONE shard at this position.
+      if (!attempt.ok() || attempt.value().copies[0].shards.size() != 1 ||
+          std::holds_alternative<DeviceLocation>(
+              attempt.value().copies[0].shards[0].location)) {
+        if (attempt.ok()) adapter_.free_object(staged[j].staging_key);
+        staged.resize(j);
+        staged_ok = false;
+        LOG_WARN << "ec repair of " << key << " stays degraded: no placement for shard "
+                 << d;
+        break;
+      }
+      staged[j].placement = std::move(attempt).value().copies[0];
+      excluded.push_back(staged[j].placement.shards[0].worker_id);
+    }
+    if (!staged_ok) {
+      free_all_staged();
       return false;
     }
-    staged[j].placement = std::move(attempt).value().copies[0];
-    excluded.push_back(staged[j].placement.shards[0].worker_id);
-  }
 
-  // 2. Segmented reconstruction: read each segment from k survivors,
-  // rebuild missing data rows, re-encode missing parity rows, write out.
-  constexpr uint64_t kSeg = 8ull << 20;
-  std::vector<size_t> basis;  // the k survivors we read (data first)
-  for (size_t i = 0; i < n && basis.size() < k; ++i) {
-    if (!dead[i]) basis.push_back(i);
-  }
-  if (basis.size() < k) {
-    free_all_staged(staged.size());
-    return false;  // beyond tolerance (pass 1 should have caught this)
-  }
-  bool parity_dead = false;
-  for (size_t d : dead_idx) parity_dead |= d >= k;
-
-  std::vector<std::vector<uint8_t>> seg_bufs(n);  // read/rebuilt segments
-  const uint64_t seg_cap = std::min<uint64_t>(L, kSeg);
-  for (size_t i : basis) seg_bufs[i].resize(seg_cap);
-  for (size_t d : dead_idx) seg_bufs[d].resize(seg_cap);
-  // Parity re-encode needs every data row; data rows outside the basis and
-  // not dead can stay empty unless parity is being rebuilt.
-  if (parity_dead) {
-    for (size_t i = 0; i < k; ++i) seg_bufs[i].resize(seg_cap);
-  }
-  std::vector<std::vector<uint8_t>> parity_rows;
-  if (parity_dead) parity_rows.assign(m, std::vector<uint8_t>(seg_cap));
-
-  for (uint64_t off = 0; off < L; off += kSeg) {
-    const uint64_t seg = std::min(kSeg, L - off);
-    std::vector<const uint8_t*> present(n, nullptr);
-    for (size_t i : basis) {
-      if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(), seg,
-                              /*is_write=*/false) != ErrorCode::OK) {
-        LOG_WARN << "ec repair of " << key << " stays degraded: survivor " << i
-                 << " unreadable";
-        free_all_staged(staged.size());
-        return false;
-      }
-      present[i] = seg_bufs[i].data();
+    // 2. Segmented reconstruction: read each segment from k survivors,
+    // rebuild missing data rows, re-encode missing parity rows, write out.
+    constexpr uint64_t kSeg = 8ull << 20;
+    std::vector<size_t> basis;  // the k survivors we read (data first)
+    for (size_t i = 0; i < n && basis.size() < k; ++i) {
+      if (!dead[i]) basis.push_back(i);
     }
-    // Data rows needed for parity re-encode but outside the basis (only
-    // possible when they are alive: read them too).
+    if (basis.size() < k) {
+      free_all_staged();
+      return false;  // beyond tolerance (pass 1 should have caught this)
+    }
+    bool parity_dead = false;
+    for (size_t d : targets) parity_dead |= d >= k;
+
+    std::vector<std::vector<uint8_t>> seg_bufs(n);  // read/rebuilt segments
+    const uint64_t seg_cap = std::min<uint64_t>(L, kSeg);
+    for (size_t i : basis) seg_bufs[i].resize(seg_cap);
+    for (size_t d : targets) seg_bufs[d].resize(seg_cap);
+    // Parity re-encode needs every data row; data rows outside the basis and
+    // not dead can stay empty unless parity is being rebuilt.
     if (parity_dead) {
-      for (size_t i = 0; i < k; ++i) {
-        if (present[i] || dead[i]) continue;
+      for (size_t i = 0; i < k; ++i) seg_bufs[i].resize(seg_cap);
+    }
+    std::vector<std::vector<uint8_t>> parity_rows;
+    if (parity_dead) parity_rows.assign(m, std::vector<uint8_t>(seg_cap));
+    rebuilt_crcs.assign(targets.size(), 0);
+    // Incremental CRC per shard we read, for the basis screen.
+    std::vector<uint32_t> read_crcs(n, 0);
+    std::vector<bool> was_read(n, false);
+
+    bool io_failed = false;
+    for (uint64_t off = 0; off < L && !io_failed; off += kSeg) {
+      const uint64_t seg = std::min(kSeg, L - off);
+      std::vector<const uint8_t*> present(n, nullptr);
+      for (size_t i : basis) {
         if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(), seg,
                                 /*is_write=*/false) != ErrorCode::OK) {
-          free_all_staged(staged.size());
-          return false;
+          LOG_WARN << "ec repair of " << key << " stays degraded: survivor " << i
+                   << " unreadable";
+          io_failed = true;
+          break;
         }
+        read_crcs[i] = crc32c(seg_bufs[i].data(), seg, read_crcs[i]);
+        was_read[i] = true;
         present[i] = seg_bufs[i].data();
       }
+      if (io_failed) break;
+      // Data rows needed for parity re-encode but outside the basis (only
+      // possible when they are alive: read them too).
+      if (parity_dead) {
+        for (size_t i = 0; i < k; ++i) {
+          if (present[i] || dead[i]) continue;
+          if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(),
+                                  seg,
+                                  /*is_write=*/false) != ErrorCode::OK) {
+            io_failed = true;
+            break;
+          }
+          read_crcs[i] = crc32c(seg_bufs[i].data(), seg, read_crcs[i]);
+          was_read[i] = true;
+          present[i] = seg_bufs[i].data();
+        }
+        if (io_failed) break;
+      }
+      std::vector<uint8_t*> out(k, nullptr);
+      for (size_t d : targets) {
+        if (d < k) out[d] = seg_bufs[d].data();
+      }
+      if (!ec::rs_reconstruct(present.data(), k, m, seg, out.data())) {
+        io_failed = true;
+        break;
+      }
+      if (parity_dead) {
+        std::vector<const uint8_t*> data_rows(k);
+        for (size_t i = 0; i < k; ++i) data_rows[i] = seg_bufs[i].data();
+        std::vector<uint8_t*> parity_ptrs(m);
+        for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity_rows[j].data();
+        if (!ec::rs_encode(data_rows.data(), k, parity_ptrs.data(), m, seg)) {
+          io_failed = true;
+          break;
+        }
+      }
+      for (size_t j = 0; j < targets.size(); ++j) {
+        const size_t d = targets[j];
+        const uint8_t* src = d < k ? seg_bufs[d].data() : parity_rows[d - k].data();
+        if (transport::shard_io(*data_client_, staged[j].placement.shards[0], off,
+                                const_cast<uint8_t*>(src), seg,
+                                /*is_write=*/true) != ErrorCode::OK) {
+          io_failed = true;
+          break;
+        }
+        // Restamp as we write: segments stream in order, so the incremental
+        // CRC over them IS the rebuilt shard's CRC32C.
+        rebuilt_crcs[j] = crc32c(src, seg, rebuilt_crcs[j]);
+      }
     }
-    std::vector<uint8_t*> out(k, nullptr);
-    for (size_t d : dead_idx) {
-      if (d < k) out[d] = seg_bufs[d].data();
-    }
-    if (!ec::rs_reconstruct(present.data(), k, m, seg, out.data())) {
-      free_all_staged(staged.size());
+    if (io_failed) {
+      free_all_staged();
       return false;
     }
-    if (parity_dead) {
-      std::vector<const uint8_t*> data_rows(k);
-      for (size_t i = 0; i < k; ++i) data_rows[i] = seg_bufs[i].data();
-      std::vector<uint8_t*> parity_ptrs(m);
-      for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity_rows[j].data();
-      if (!ec::rs_encode(data_rows.data(), k, parity_ptrs.data(), m, seg)) {
-        free_all_staged(staged.size());
-        return false;
+
+    // 3. The basis screen: a source shard whose bytes fail its stamp fed
+    // garbage into the reconstruction — condemn it, drop this attempt's
+    // staging, and retry with the rotten shard as a repair target too.
+    if (stamped) {
+      std::vector<size_t> condemned;
+      for (size_t i = 0; i < n; ++i) {
+        if (was_read[i] && read_crcs[i] != copy.shard_crcs[i]) condemned.push_back(i);
+      }
+      if (!condemned.empty()) {
+        for (size_t c : condemned) {
+          LOG_WARN << "ec repair of " << key << ": live shard " << c
+                   << " failed its CRC stamp (pool " << copy.shards[c].pool_id
+                   << ", worker " << copy.shards[c].worker_id
+                   << ") — promoting to repair target";
+          targets.push_back(c);
+        }
+        free_all_staged();
+        if (targets.size() > m) {
+          LOG_WARN << "ec repair of " << key << " stays degraded: " << targets.size()
+                   << " dead+rotten shards exceed tolerance m=" << m;
+          return false;
+        }
+        continue;  // retry with a clean basis
       }
     }
-    for (size_t j = 0; j < dead_idx.size(); ++j) {
-      const size_t d = dead_idx[j];
-      const uint8_t* src = d < k ? seg_bufs[d].data() : parity_rows[d - k].data();
-      if (transport::shard_io(*data_client_, staged[j].placement.shards[0], off,
-                              const_cast<uint8_t*>(src), seg,
-                              /*is_write=*/true) != ErrorCode::OK) {
-        free_all_staged(staged.size());
-        return false;
-      }
-    }
+    break;  // reconstruction complete with a verified basis
   }
 
-  // 3. Splice under the lock iff the object didn't change underneath us.
+  // 4. Splice under the lock iff the object didn't change underneath us.
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end() || it->second.epoch != epoch ||
       it->second.copies.empty() || it->second.copies.front().shards.size() != n) {
     lock.unlock();
-    free_all_staged(staged.size());
+    free_all_staged();
     return false;
   }
   for (const auto& st : staged) {
@@ -1944,19 +2053,29 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
       LOG_ERROR << "ec repair merge failed for " << key;
       // Staged keys not yet merged are freed; merged ranges now belong to
       // the object and are released when it is removed.
-      free_all_staged(staged.size());
+      free_all_staged();
       return false;
     }
   }
-  for (size_t j = 0; j < dead_idx.size(); ++j) {
-    // Dead shards' range bookkeeping was already dropped in pass 1; the
-    // entries are replaced in place, preserving the geometry order.
-    it->second.copies.front().shards[dead_idx[j]] = staged[j].placement.shards[0];
+  for (size_t j = 0; j < targets.size(); ++j) {
+    const size_t d = targets[j];
+    // Dead shards' range bookkeeping was already dropped in pass 1 — but a
+    // shard promoted here (live, rotten) still holds its range: release it,
+    // or the pool leaks the space forever.
+    if (std::find(original_dead.begin(), original_dead.end(), d) == original_dead.end()) {
+      if (auto pr = shard_to_range(it->second.copies.front().shards[d], memory_pools())) {
+        adapter_.allocator().release_range(key, pr->first, pr->second);
+      }
+    }
+    // Entries are replaced in place, preserving the geometry order.
+    it->second.copies.front().shards[d] = staged[j].placement.shards[0];
+    if (it->second.copies.front().shard_crcs.size() == n)
+      it->second.copies.front().shard_crcs[d] = rebuilt_crcs[j];
   }
   it->second.epoch = next_epoch_.fetch_add(1);
   persist_object(key, it->second);
   bump_view();
-  LOG_INFO << "ec repair rebuilt " << dead_idx.size() << " shard(s) of " << key;
+  LOG_INFO << "ec repair rebuilt " << targets.size() << " shard(s) of " << key;
   return true;
 }
 
@@ -2134,6 +2253,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   // (worker.cpp), so a keystone seeing them shares the provider's process.
   // Cross-process HBM pools register callback-backed regions instead.
   bool moved = false;
+  const CopyPlacement* moved_src = nullptr;
   if (coded) {
     // Coded objects move SHARD-VERBATIM: the staged allocation reused the
     // object's (k, m) config, so it has the identical geometry and every
@@ -2191,6 +2311,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     for (const auto& src : old_copies) {
       if (copy_object_bytes(*data_client_, src, placed.value(), size) == ErrorCode::OK) {
         moved = true;
+        moved_src = &src;
         break;
       }
     }
@@ -2221,7 +2342,11 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     return DemoteOutcome::kSkipped;
   }
   it->second.copies = std::move(placed).value();
-  for (auto& copy : it->second.copies) copy.content_crc = old_copies.front().content_crc;
+  if (!moved_src) moved_src = &old_copies.front();  // coded path: shard-verbatim
+  for (auto& copy : it->second.copies) {
+    copy.content_crc = old_copies.front().content_crc;
+    carry_shard_crcs(*moved_src, copy);
+  }
   it->second.epoch = next_epoch_.fetch_add(1);
   persist_object(key, it->second);
   bump_view();

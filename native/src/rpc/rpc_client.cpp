@@ -102,10 +102,11 @@ Result<std::vector<CopyPlacement>> KeystoneRpcClient::put_start(const ObjectKey&
   return std::move(resp.copies);
 }
 
-ErrorCode KeystoneRpcClient::put_complete(const ObjectKey& key) {
+ErrorCode KeystoneRpcClient::put_complete(const ObjectKey& key,
+                                          const std::vector<CopyShardCrcs>& shard_crcs) {
   PutCompleteResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutComplete),
-                            PutCompleteRequest{key}, resp));
+                            PutCompleteRequest{key, shard_crcs}, resp));
   return resp.error_code;
 }
 
@@ -202,10 +203,11 @@ Result<std::vector<Result<std::vector<CopyPlacement>>>> KeystoneRpcClient::batch
 }
 
 Result<std::vector<ErrorCode>> KeystoneRpcClient::batch_put_complete(
-    const std::vector<ObjectKey>& keys) {
+    const std::vector<ObjectKey>& keys,
+    const std::vector<std::vector<CopyShardCrcs>>& shard_crcs) {
   BatchPutCompleteResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchPutComplete),
-                            BatchPutCompleteRequest{keys}, resp));
+                            BatchPutCompleteRequest{keys, shard_crcs}, resp));
   if (resp.error_code != ErrorCode::OK) return resp.error_code;
   return std::move(resp.results);
 }

@@ -111,7 +111,9 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
       });
     case Method::kPutComplete:
       return handle<PutCompleteRequest, PutCompleteResponse>(
-          payload, [&](const auto& req, auto& resp) { resp.error_code = ks.put_complete(req.key); });
+          payload, [&](const auto& req, auto& resp) {
+            resp.error_code = ks.put_complete(req.key, req.shard_crcs);
+          });
     case Method::kPutCancel:
       return handle<PutCancelRequest, PutCancelResponse>(
           payload, [&](const auto& req, auto& resp) { resp.error_code = ks.put_cancel(req.key); });
@@ -156,8 +158,9 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
           [&](const auto& req, auto& resp) { resp.results = ks.batch_put_start(req.requests); });
     case Method::kBatchPutComplete:
       return handle<BatchPutCompleteRequest, BatchPutCompleteResponse>(
-          payload,
-          [&](const auto& req, auto& resp) { resp.results = ks.batch_put_complete(req.keys); });
+          payload, [&](const auto& req, auto& resp) {
+            resp.results = ks.batch_put_complete(req.keys, req.shard_crcs);
+          });
     case Method::kBatchPutCancel:
       return handle<BatchPutCancelRequest, BatchPutCancelResponse>(
           payload,

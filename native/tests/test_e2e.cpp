@@ -1087,6 +1087,12 @@ BTEST(ErasureCoding, RepairReconstructsLostShardsOntoFreshWorkers) {
   for (const auto& s : copy.shards) {
     BT_EXPECT(s.worker_id != victim);  // the lost shard moved to a live worker
   }
+  // Repair restamped the rebuilt shard's CRC: the copy is still fully
+  // stamped and every stamp verifies (scrub_object reads each shard).
+  BT_ASSERT(copy.shard_crcs.size() == copy.shards.size());
+  auto scrubbed = client->scrub_object("ec/heal");
+  BT_ASSERT_OK(scrubbed);
+  for (const auto& f : scrubbed.value()) BT_EXPECT(f.status == ErrorCode::OK);
   // Anti-affinity preserved: still one shard per worker.
   std::set<std::string> workers;
   for (const auto& s : copy.shards) workers.insert(s.worker_id);
@@ -1161,6 +1167,56 @@ BTEST(ErasureCoding, WorkerDeathLeavesObjectDegradedButReadable) {
 }
 
 // ---- end-to-end integrity (CRC32C; no reference counterpart) --------------
+
+BTEST(ErasureCoding, RepairScreensRottenBasisAndHealsItInPlace) {
+  // A live-but-rotten shard must never serve as a reconstruction basis
+  // (the rebuild would be garbage restamped as valid); repair promotes it
+  // to a repair target and heals BOTH the dead and the rotten shard.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(8, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  auto data = pattern(640 * 1024, 83);
+  BT_ASSERT(client->put("ec/rot", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto before = client->get_workers("ec/rot");
+  BT_ASSERT_OK(before);
+  const auto& copy = before.value()[0];
+  BT_ASSERT(copy.shard_crcs.size() == 6);
+
+  // Rot data shard 1 silently (it would land in the naive basis {0,1,3,4}
+  // once shard 2 dies), then kill shard 2's worker.
+  {
+    const auto& shard = copy.shards[1];
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    std::vector<uint8_t> garbage(4096, 0x77);
+    auto raw = transport::make_transport_client();
+    BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 256, mem.rkey, garbage.data(),
+                         garbage.size()) == ErrorCode::OK);
+  }
+  const auto victim = copy.shards[2].worker_id;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if ("worker-" + std::to_string(i) == victim) cluster.kill_worker(i);
+  }
+
+  BT_EXPECT(eventually(
+      [&] { return cluster.keystone().counters().objects_repaired.load() >= 1; }, 10000));
+
+  // Healed: the object reads byte-correct and every shard passes its stamp
+  // (the rotten shard was rebuilt too, not just the dead one).
+  auto back = client->get("ec/rot");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+  auto scrubbed = client->scrub_object("ec/rot");
+  BT_ASSERT_OK(scrubbed);
+  for (const auto& f : scrubbed.value()) BT_EXPECT(f.status == ErrorCode::OK);
+  auto after = client->get_workers("ec/rot");
+  BT_ASSERT_OK(after);
+  BT_EXPECT(after.value()[0].shards[1].worker_id != copy.shards[1].worker_id);
+}
 
 BTEST(Integrity, Crc32cKnownVector) {
   // RFC 3720 test vector: crc32c("123456789") = 0xE3069283.
@@ -1238,18 +1294,84 @@ BTEST(Integrity, CorruptEcShardHuntedAndReconstructed) {
                          garbage.size()) == ErrorCode::OK);
   };
   // Silently corrupt data shard 2: the healthy read sees every shard OK but
-  // the CRC disagrees — the hunt must identify shard 2 and reconstruct it.
+  // the CRCs disagree — shard 2 must be identified and reconstructed.
   corrupt_shard(2);
   auto back = client->get("crc/ec");
   BT_ASSERT_OK(back);
   BT_EXPECT(back.value() == data);
 
-  // Two corrupt data shards exceed what an object-level CRC can localize
-  // with m=2 parity: detection (CHECKSUM_MISMATCH), never silent garbage.
+  // TWO corrupt shards (0 and 2 — the store still holds 2's rot; reads heal
+  // transiently, not in place): per-shard CRCs localize both and parity
+  // m=2 reconstructs both. An object-level CRC alone could only detect this.
   corrupt_shard(0);
+  auto two = client->get("crc/ec");
+  BT_ASSERT_OK(two);
+  BT_EXPECT(two.value() == data);
+
+  // A corrupt PARITY shard on top (3 corrupt of 6, beyond the m=2
+  // tolerance): parity 5 is condemned by its own CRC, leaving only 3
+  // readable rows < k. Detection (CHECKSUM_MISMATCH), never silent garbage.
+  corrupt_shard(5);
   auto dead = client->get("crc/ec");
   BT_ASSERT(!dead.ok());
   BT_EXPECT(dead.error() == ErrorCode::CHECKSUM_MISMATCH);
+}
+
+BTEST(Integrity, ScrubObjectNamesCorruptWorkerAndPool) {
+  // The scrub localization surface (bb-client scrub): per-shard CRCs turn
+  // "this object is corrupt" into "THIS shard on THIS worker/pool is".
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(6, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.ec_data_shards = 4;
+  cfg.ec_parity_shards = 2;
+  auto data = pattern(512 * 1024, 71);
+  BT_ASSERT(client->put("scrub/ec", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("scrub/ec");
+  BT_ASSERT_OK(placements);
+  const auto& copy = placements.value()[0];
+  BT_ASSERT(copy.shard_crcs.size() == copy.shards.size());  // writer stamped
+
+  // A healthy object scrubs clean.
+  auto clean = client->scrub_object("scrub/ec");
+  BT_ASSERT_OK(clean);
+  BT_ASSERT(clean.value().size() == copy.shards.size());
+  for (const auto& f : clean.value()) BT_EXPECT(f.status == ErrorCode::OK);
+
+  // Corrupt data shard 1 and parity shard 4; scrub must name exactly those,
+  // with the pool/worker the placement points at.
+  auto corrupt_shard = [&](size_t idx) {
+    const auto& shard = copy.shards[idx];
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    std::vector<uint8_t> garbage(1024, 0x3c);
+    auto raw = transport::make_transport_client();
+    BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 64, mem.rkey, garbage.data(),
+                         garbage.size()) == ErrorCode::OK);
+  };
+  corrupt_shard(1);
+  corrupt_shard(4);
+
+  auto findings = client->scrub_object("scrub/ec");
+  BT_ASSERT_OK(findings);
+  size_t flagged = 0;
+  for (const auto& f : findings.value()) {
+    if (f.status == ErrorCode::OK) continue;
+    ++flagged;
+    BT_EXPECT(f.status == ErrorCode::CHECKSUM_MISMATCH);
+    BT_ASSERT(f.shard_index == 1 || f.shard_index == 4);
+    BT_EXPECT_EQ(f.pool_id, copy.shards[f.shard_index].pool_id);
+    BT_EXPECT_EQ(f.worker_id, copy.shards[f.shard_index].worker_id);
+  }
+  BT_EXPECT_EQ(flagged, size_t{2});
+
+  // And the object still READS correctly: 2 corruptions within rs(4,2)
+  // tolerance reconstruct transparently.
+  auto back = client->get("scrub/ec");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
 }
 
 BTEST(Integrity, RepairRefusesToPropagateCorruptSource) {

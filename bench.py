@@ -169,6 +169,16 @@ def main() -> int:
         return max(runs, key=lambda rows: rows["get"]["gbps"])
 
     main_rows = best_of(3, size=1 << 20, iterations=150, transport="tcp")
+    # Raw (verify=off) companion row: same workload without the end-to-end
+    # CRC check, showing what integrity costs. DEFAULT stays verified — the
+    # headline metric is the verified number.
+    try:
+        raw_rows = best_of(3, size=1 << 20, iterations=150, transport="tcp",
+                           extra_args=("--no-verify",))
+        raw_get_gbps = raw_rows["get"]["gbps"]
+    except RuntimeError as exc:
+        print(f"no-verify row skipped: {exc}", file=sys.stderr)
+        raw_rows, raw_get_gbps = None, None
     # p99 needs samples: at 300 iters it is the 3rd-worst draw and scheduler
     # noise dominates; 1500 iters costs ~0.1s and stabilizes it.
     small_runs = [run_bench(binary, size=64 << 10, iterations=1500, transport="tcp")
@@ -240,13 +250,20 @@ def main() -> int:
 
     get_gbps = main_rows["get"]["gbps"]
     print(
-        f"tcp (headline): put 1MiB {main_rows['put']['gbps']:.2f} GB/s "
+        f"tcp (headline, verified reads): put 1MiB {main_rows['put']['gbps']:.2f} GB/s "
         f"(p99 {main_rows['put']['p99_us']:.0f}us) | "
         f"get 1MiB {get_gbps:.2f} GB/s (p99 {main_rows['get']['p99_us']:.0f}us) | "
         f"get 64KiB p99 {small_rows['get']['p99_us']:.1f}us (north star <50us) | "
         f"put 64KiB p99 {small_rows['put']['p99_us']:.1f}us",
         file=sys.stderr,
     )
+    if raw_rows is not None:
+        print(
+            f"tcp (raw, --no-verify): get 1MiB {raw_get_gbps:.2f} GB/s "
+            f"(p99 {raw_rows['get']['p99_us']:.0f}us) — integrity check costs "
+            f"{max(0.0, (1 - get_gbps / raw_get_gbps) * 100):.0f}% at this size",
+            file=sys.stderr,
+        )
     print(
         f"shm (same-host zero-copy, the TPU-VM-local path): "
         f"put 1MiB {shm_rows['put']['gbps']:.2f} GB/s | "
@@ -270,14 +287,17 @@ def main() -> int:
     except subprocess.TimeoutExpired:
         print("hbm tier bench skipped: device backend hung (tunnel down?)",
               file=sys.stderr)
-    print(json.dumps({
+    summary = {
         "metric": "get_gbps_1mib_striped4_tcp",
         "value": round(get_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(get_gbps / BASELINE_GBPS, 3),
         "local_ceiling_get_gbps": round(local_rows["get"]["gbps"], 3),
         "tcp_get_64kib_p99_us": round(small_rows["get"]["p99_us"], 1),
-    }))
+    }
+    if raw_get_gbps is not None:
+        summary["raw_get_gbps_no_verify"] = round(raw_get_gbps, 3)
+    print(json.dumps(summary))
     return 0
 
 

@@ -16,14 +16,24 @@ class Client:
     connect/object_exists/put/get/remove — with numpy-friendly helpers.
     """
 
-    def __init__(self, keystone_endpoint: str):
+    def __init__(self, keystone_endpoint: str, *, verify: bool = True):
         """keystone_endpoint may be a comma-separated list ("host:a,host:b"):
         the first entry is the primary, the rest HA fallbacks the client
-        rotates through on NOT_LEADER or connection failure."""
+        rotates through on NOT_LEADER or connection failure.
+
+        verify=False skips CRC verification on reads (and with it
+        corrupt-replica failover / corrupt-shard reconstruction) — for
+        latency-critical paths that rely on background scrub instead."""
         self._cluster_ref = None
         self._handle = lib.btpu_client_create_remote(keystone_endpoint.encode())
         if not self._handle:
             raise RuntimeError(f"cannot reach keystone at {keystone_endpoint}")
+        if not verify:
+            lib.btpu_client_set_verify(self._handle, 0)
+
+    def set_verify(self, verify: bool) -> None:
+        """Toggle CRC verification on this client's reads (default on)."""
+        lib.btpu_client_set_verify(self._handle, 1 if verify else 0)
 
     @classmethod
     def _embedded(cls, cluster):

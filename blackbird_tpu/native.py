@@ -92,6 +92,7 @@ def _load() -> ctypes.CDLL:
         "btpu_client_create_embedded": (c, [c]),
         "btpu_client_create_remote": (c, [ctypes.c_char_p]),
         "btpu_client_destroy": (None, [c]),
+        "btpu_client_set_verify": (None, [c, i32]),
         "btpu_put": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32, u32]),
         "btpu_get": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, ctypes.POINTER(u64)]),
         "btpu_put_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),

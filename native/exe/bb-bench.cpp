@@ -59,7 +59,7 @@ int main(int argc, char** argv) {
   WorkerConfig wc;
   wc.replication_factor = 1;
   wc.max_workers_per_copy = 4;
-  bool json = false, sweep = false;
+  bool json = false, sweep = false, no_verify = false;
   int batch = 0;  // >0: measure put_many/get_many over `batch` objects per op
 
   for (int i = 1; i < argc; ++i) {
@@ -75,6 +75,7 @@ int main(int argc, char** argv) {
       embedded_workers = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--transport") && i + 1 < argc) transport = argv[++i];
     else if (!std::strcmp(argv[i], "--json")) json = true;
+    else if (!std::strcmp(argv[i], "--no-verify")) no_verify = true;
     else if (!std::strcmp(argv[i], "--sweep")) sweep = true;
     else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
@@ -98,7 +99,9 @@ int main(int argc, char** argv) {
       std::printf(
           "usage: bb-bench (--keystone host:port | --embedded N) [--size BYTES]\n"
           "       [--iterations N] [--replicas R] [--max-workers W] [--ec K,M]\n"
-          "       [--transport local|shm|tcp] [--json] [--sweep] [--batch N]\n");
+          "       [--transport local|shm|tcp] [--json] [--sweep] [--batch N]\n"
+          "       [--no-verify]   skip CRC verification on reads (raw ceiling;\n"
+          "                       default reads are verified end to end)\n");
       return 0;
     }
   }
@@ -147,6 +150,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   auto& client = *client_ptr;
+  if (no_verify) client.set_verify_reads(false);
 
   std::vector<uint64_t> sizes = sweep ? std::vector<uint64_t>{4 << 10, 64 << 10, 1 << 20, 16 << 20}
                                       : std::vector<uint64_t>{size};

@@ -46,6 +46,11 @@ btpu_client* btpu_client_create_embedded(btpu_cluster* cluster);
  * failure. */
 btpu_client* btpu_client_create_remote(const char* keystone_endpoint);
 void btpu_client_destroy(btpu_client* client);
+/* Toggle CRC verification on this client's reads (default on). Off skips
+ * the end-to-end integrity check — and with it corrupt-replica failover and
+ * corrupt-shard reconstruction — for latency-critical paths that rely on
+ * background scrub instead. */
+void btpu_client_set_verify(btpu_client* client, int32_t verify);
 
 // preferred_class 0 = no preference. replicas 0 = cluster default.
 int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,

@@ -15,7 +15,9 @@
 //     endpoint per transfer).
 #pragma once
 
+#include <atomic>
 #include <memory>
+#include <optional>
 
 #include "btpu/keystone/keystone.h"
 #include "btpu/rpc/rpc_client.h"
@@ -35,6 +37,12 @@ struct ClientOptions {
   std::vector<std::string> keystone_fallbacks;
   size_t io_parallelism{8};       // concurrent shard transfers
   WorkerConfig default_config;    // placement policy defaults for put()
+  // Verify CRCs on every read (default). Turning this off skips the
+  // end-to-end integrity check (and with it corrupt-replica failover /
+  // corrupt-shard reconstruction) — reads return whatever the bytes are.
+  // For latency-critical paths that rely on background scrub instead; the
+  // per-call `verify` overrides on get/get_into/get_many take precedence.
+  bool verify_reads{true};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).
@@ -50,15 +58,29 @@ class ObjectClient {
 
   ErrorCode connect();
 
+  // Session-level default for read verification (per-call `verify` args
+  // override). Safe to toggle concurrently with in-flight reads: each read
+  // samples the flag once at entry.
+  void set_verify_reads(bool v) noexcept {
+    verify_default_.store(v, std::memory_order_relaxed);
+  }
+  bool verify_reads() const noexcept {
+    return verify_default_.load(std::memory_order_relaxed);
+  }
+
   Result<bool> object_exists(const ObjectKey& key);
   Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
 
   ErrorCode put(const ObjectKey& key, const void* data, uint64_t size);
   ErrorCode put(const ObjectKey& key, const void* data, uint64_t size,
                 const WorkerConfig& config);
-  Result<std::vector<uint8_t>> get(const ObjectKey& key);
+  // `verify` overrides options_.verify_reads for this call (nullopt = use
+  // the client default).
+  Result<std::vector<uint8_t>> get(const ObjectKey& key,
+                                   std::optional<bool> verify = std::nullopt);
   // Zero-allocation variant; buffer must hold the object (size returned).
-  Result<uint64_t> get_into(const ObjectKey& key, void* buffer, uint64_t buffer_size);
+  Result<uint64_t> get_into(const ObjectKey& key, void* buffer, uint64_t buffer_size,
+                            std::optional<bool> verify = std::nullopt);
 
   // ---- batched object I/O ------------------------------------------------
   // One keystone round trip (batch_put_start/batch_put_complete, parity:
@@ -83,7 +105,8 @@ class ObjectClient {
   std::vector<ErrorCode> put_many(const std::vector<PutItem>& items);
   std::vector<ErrorCode> put_many(const std::vector<PutItem>& items,
                                   const WorkerConfig& config);
-  std::vector<Result<uint64_t>> get_many(const std::vector<GetItem>& items);
+  std::vector<Result<uint64_t>> get_many(const std::vector<GetItem>& items,
+                                         std::optional<bool> verify = std::nullopt);
 
   // Per-shard integrity report for one object (the scrub localization
   // surface): reads every shard of every copy individually and checks it
@@ -123,15 +146,16 @@ class ObjectClient {
   // when not applicable (single copy, small object, device shards, or
   // divergent copy sizes) — callers fall back to the per-copy loop.
   ErrorCode try_split_read(const std::vector<CopyPlacement>& copies, uint8_t* buffer,
-                           uint64_t size);
+                           uint64_t size, bool verify);
   // Writes `data` into every shard of `copy` (running offset), in parallel.
   ErrorCode transfer_copy_put(const CopyPlacement& copy, const uint8_t* data, uint64_t size);
-  ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size);
+  ErrorCode transfer_copy_get(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                              bool verify);
   // Shared body: device shards as one provider batch, wire shards in parallel.
   ErrorCode transfer_copy_ec(const CopyPlacement& copy, uint8_t* data, uint64_t size,
-                             bool is_write);
+                             bool is_write, bool verify);
   ErrorCode transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
-                          bool is_write);
+                          bool is_write, bool verify);
   ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);
 
   static ErrorCode error_of(ErrorCode ec) noexcept { return ec; }
@@ -165,6 +189,7 @@ class ObjectClient {
   }
 
   ClientOptions options_;
+  std::atomic<bool> verify_default_{true};  // seeded from options_.verify_reads
   std::unique_ptr<rpc::KeystoneRpcClient> rpc_;
   size_t keystone_index_{0};  // into [keystone_address] + keystone_fallbacks
   keystone::KeystoneService* embedded_{nullptr};

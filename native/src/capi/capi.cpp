@@ -141,6 +141,10 @@ btpu_client* btpu_client_create_remote(const char* keystone_endpoint) {
 
 void btpu_client_destroy(btpu_client* client) { delete client; }
 
+void btpu_client_set_verify(btpu_client* client, int32_t verify) {
+  if (client && client->impl) client->impl->set_verify_reads(verify != 0);
+}
+
 int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,
                  uint32_t replicas, uint32_t max_workers, uint32_t preferred_class) {
   return btpu_put_ex(client, key, data, size, replicas, max_workers, preferred_class,

@@ -30,12 +30,15 @@ void ClientOptions::set_keystone_endpoints(const std::string& list) {
 }
 
 ObjectClient::ObjectClient(ClientOptions options)
-    : options_(std::move(options)), data_(transport::make_transport_client()) {
+    : options_(std::move(options)),
+      verify_default_(options_.verify_reads),
+      data_(transport::make_transport_client()) {
   rpc_ = std::make_unique<rpc::KeystoneRpcClient>(options_.keystone_address);
 }
 
 ObjectClient::ObjectClient(ClientOptions options, keystone::KeystoneService* embedded)
     : options_(std::move(options)),
+      verify_default_(options_.verify_reads),
       embedded_(embedded),
       data_(transport::make_transport_client()) {}
 
@@ -90,19 +93,21 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
   return put_many({{key, data, size}}, config)[0];
 }
 
-Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
+Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
+                                               std::optional<bool> verify) {
   TRACE_SPAN("client.get");
+  const bool v = verify.value_or(verify_reads());
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
   uint64_t size = 0;
   if (!copies.value().empty()) size = copy_logical_size(copies.value().front());
   std::vector<uint8_t> buffer(size);
-  if (try_split_read(copies.value(), buffer.data(), size) == ErrorCode::OK) return buffer;
+  if (try_split_read(copies.value(), buffer.data(), size, v) == ErrorCode::OK) return buffer;
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
   for (const auto& copy : copies.value()) {
     const uint64_t copy_size = copy_logical_size(copy);
     if (copy_size != size) buffer.resize(copy_size);
-    if (auto ec = transfer_copy_get(copy, buffer.data(), copy_size); ec == ErrorCode::OK) {
+    if (auto ec = transfer_copy_get(copy, buffer.data(), copy_size, v); ec == ErrorCode::OK) {
       return buffer;
     } else {
       // Corruption is the strongest signal — a later replica's transport
@@ -116,20 +121,21 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key) {
 }
 
 Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
-                                        uint64_t buffer_size) {
+                                        uint64_t buffer_size, std::optional<bool> verify) {
   TRACE_SPAN("client.get");
+  const bool v = verify.value_or(verify_reads());
   auto copies = get_workers(key);
   if (!copies.ok()) return copies.error();
   uint64_t size = 0;
   if (!copies.value().empty()) size = copy_logical_size(copies.value().front());
   if (size <= buffer_size &&
-      try_split_read(copies.value(), static_cast<uint8_t*>(buffer), size) == ErrorCode::OK)
+      try_split_read(copies.value(), static_cast<uint8_t*>(buffer), size, v) == ErrorCode::OK)
     return size;
   ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
   for (const auto& copy : copies.value()) {
     const uint64_t copy_size = copy_logical_size(copy);
     if (copy_size > buffer_size) return ErrorCode::BUFFER_OVERFLOW;
-    if (auto ec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer), copy_size);
+    if (auto ec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer), copy_size, v);
         ec == ErrorCode::OK) {
       return copy_size;
     } else {
@@ -192,7 +198,7 @@ ErrorCode ObjectClient::shard_io(const ShardPlacement& shard, uint8_t* buf, bool
 // caller falls back to sequential per-copy reads, so a dead replica costs a
 // retry, never the object.
 ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
-                                       uint8_t* buffer, uint64_t size) {
+                                       uint8_t* buffer, uint64_t size, bool verify) {
   constexpr uint64_t kSplitReadMin = 512 * 1024;  // below this, one copy wins
   if (copies.size() < 2 || size < kSplitReadMin || options_.io_parallelism < 2)
     return ErrorCode::NOT_IMPLEMENTED;
@@ -220,7 +226,7 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
       ec != ErrorCode::OK)
     return ec;
   const uint32_t expect = copies.front().content_crc;
-  if (expect != 0 && crc32c(buffer, size) != expect) {
+  if (verify && expect != 0 && crc32c(buffer, size) != expect) {
     // Some slice came from a corrupt replica; the caller's per-copy
     // (verified) reads identify the healthy one.
     LOG_WARN << "content crc mismatch on split-replica read: retrying per copy";
@@ -238,7 +244,7 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
 // code: the healthy path never decodes).
 
 ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* data,
-                                         uint64_t size, bool is_write) {
+                                         uint64_t size, bool is_write, bool verify) {
   const size_t k = copy.ec_data_shards;
   const size_t m = copy.ec_parity_shards;
   if (copy.shards.size() != k + m || size != copy.ec_object_size)
@@ -316,7 +322,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
   // a missing shard, so the one reconstruction path below absorbs any mix
   // of lost and bit-rotten shards up to m — multi-shard corruption included
   // (the object-level CRC alone can only detect that case, not repair it).
-  const bool stamped = copy.shard_crcs.size() == k + m;
+  const bool stamped = verify && copy.shard_crcs.size() == k + m;
   size_t condemned = 0;  // shards whose bytes arrived but failed their CRC
   auto shard_corrupt = [&](size_t i, const uint8_t* bytes) {
     if (!stamped) return false;
@@ -369,7 +375,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
   };
 
   if (missing == 0) {
-    if (copy.content_crc == 0 || crc_with(k + m, nullptr) == copy.content_crc) {
+    if (!verify || copy.content_crc == 0 || crc_with(k + m, nullptr) == copy.content_crc) {
       for (size_t i = 0; i < k; ++i) {
         if (!temps[i].empty()) copy_out(i, temps[i].data());
       }
@@ -438,7 +444,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
       std::memcpy(data + i * L, rebuilt[i].data(), valid_of(i));
     }
   }
-  if (copy.content_crc != 0) {
+  if (verify && copy.content_crc != 0) {
     uint32_t crc = 0;
     for (size_t i = 0; i < k && valid_of(i) > 0; ++i) {
       const uint8_t* src = have[i] ? shard_bytes(i) : rebuilt[i].data();
@@ -456,8 +462,8 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
 // coalesced into ONE provider scatter/gather call (per-op device latency is
 // the enemy, hbm_provider.h v2), wire shards move as one pipelined batch.
 ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
-                                      bool is_write) {
-  if (copy.ec_data_shards > 0) return transfer_copy_ec(copy, data, size, is_write);
+                                      bool is_write, bool verify) {
+  if (copy.ec_data_shards > 0) return transfer_copy_ec(copy, data, size, is_write, verify);
   // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
   std::vector<uint64_t> offsets(copy.shards.size());
   uint64_t off = 0;
@@ -508,7 +514,7 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
   }
   // Verify AFTER every shard (device and wire alike) has landed: a
   // device-only copy bit-rots just as silently as a host one.
-  if (copy.content_crc != 0 && crc32c(data, size) != copy.content_crc) {
+  if (verify && copy.content_crc != 0 && crc32c(data, size) != copy.content_crc) {
     LOG_WARN << "content crc mismatch on copy " << copy.copy_index
              << " (bit rot or torn write): treating as copy loss";
     // Shard CRCs (when stamped) localize the rot for the operator/scrubber.
@@ -528,12 +534,14 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
 
 ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
                                           uint64_t size) {
-  return transfer_copy(copy, const_cast<uint8_t*>(data), size, /*is_write=*/true);
+  // Writes never verify-on-read; the flag is meaningless here.
+  return transfer_copy(copy, const_cast<uint8_t*>(data), size, /*is_write=*/true,
+                       /*verify=*/false);
 }
 
 ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* data,
-                                          uint64_t size) {
-  return transfer_copy(copy, data, size, /*is_write=*/false);
+                                          uint64_t size, bool verify) {
+  return transfer_copy(copy, data, size, /*is_write=*/false, verify);
 }
 
 Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
@@ -582,7 +590,7 @@ Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
     ShardFinding f{copy.copy_index, ShardFinding::kWholeCopy, {}, {}, ErrorCode::OK};
     try {
       buf.resize(size);
-      f.status = transfer_copy_get(copy, buf.data(), size);
+      f.status = transfer_copy_get(copy, buf.data(), size, /*verify=*/true);
     } catch (const std::bad_alloc&) {
       f.status = ErrorCode::OUT_OF_MEMORY;
     }
@@ -905,8 +913,10 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   return results;
 }
 
-std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items) {
+std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,
+                                                     std::optional<bool> verify) {
   TRACE_SPAN("client.get_many");
+  const bool v = verify.value_or(verify_reads());
   std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
   if (items.empty()) return results;
 
@@ -970,7 +980,7 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
     if (errors[i] != ErrorCode::OK || !placements[i].ok() || placements[i].value().empty())
       continue;
     const uint32_t expect = placements[i].value().front().content_crc;
-    if (expect != 0 && crc32c(items[i].buffer, sizes[i]) != expect) {
+    if (v && expect != 0 && crc32c(items[i].buffer, sizes[i]) != expect) {
       LOG_WARN << "get_many: content crc mismatch on " << items[i].key << "; retrying";
       errors[i] = ErrorCode::CHECKSUM_MISMATCH;
     }
@@ -994,7 +1004,7 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
       // Coded object: the retry IS the degraded read (fetch survivors +
       // parity, reconstruct).
       if (transfer_copy_ec(copies.front(), static_cast<uint8_t*>(items[i].buffer), sizes[i],
-                           /*is_write=*/false) == ErrorCode::OK) {
+                           /*is_write=*/false, v) == ErrorCode::OK) {
         results[i] = sizes[i];
       } else {
         results[i] = last;
@@ -1008,7 +1018,7 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
         continue;
       }
       if (auto ec = transfer_copy_get(copies[c], static_cast<uint8_t*>(items[i].buffer),
-                                      copy_size);
+                                      copy_size, v);
           ec == ErrorCode::OK) {
         results[i] = copy_size;
         done = true;

@@ -1374,6 +1374,55 @@ BTEST(Integrity, ScrubObjectNamesCorruptWorkerAndPool) {
   BT_EXPECT(back.value() == data);
 }
 
+BTEST(Integrity, NoVerifyReadSkipsCrcAndItsProtections) {
+  // verify=false is the documented raw mode: reads return whatever the
+  // bytes are — no CHECKSUM_MISMATCH, no corrupt-replica failover. Both the
+  // per-call override and the client-level default behave identically.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(1, 4 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(128 * 1024, 91);
+  BT_ASSERT(client->put("raw/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  auto placements = client->get_workers("raw/obj");
+  BT_ASSERT_OK(placements);
+  {
+    const auto& shard = placements.value()[0].shards[0];
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    std::vector<uint8_t> garbage(512, 0x42);
+    auto raw = transport::make_transport_client();
+    BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 100, mem.rkey, garbage.data(),
+                         garbage.size()) == ErrorCode::OK);
+  }
+
+  // Default (verified): single replica, corrupt -> CHECKSUM_MISMATCH.
+  auto verified = client->get("raw/obj");
+  BT_ASSERT(!verified.ok());
+  BT_EXPECT(verified.error() == ErrorCode::CHECKSUM_MISMATCH);
+
+  // Per-call override: bytes come back (corrupt, by request).
+  auto raw_read = client->get("raw/obj", /*verify=*/false);
+  BT_ASSERT_OK(raw_read);
+  BT_EXPECT(raw_read.value().size() == data.size());
+  BT_EXPECT(raw_read.value() != data);  // it IS the rotten bytes
+
+  // Client-level default off: same result through get_into and get_many.
+  client->set_verify_reads(false);
+  std::vector<uint8_t> buf(data.size());
+  auto into = client->get_into("raw/obj", buf.data(), buf.size());
+  BT_ASSERT_OK(into);
+  std::vector<ObjectClient::GetItem> items{{"raw/obj", buf.data(), buf.size()}};
+  auto many = client->get_many(items);
+  BT_ASSERT(many[0].ok());
+  // And the per-call override wins over the client default, both ways.
+  client->set_verify_reads(true);
+  BT_ASSERT_OK(client->get_into("raw/obj", buf.data(), buf.size(), /*verify=*/false));
+}
+
 BTEST(Integrity, RepairRefusesToPropagateCorruptSource) {
   // r=2 object; corrupt copy 0, then kill copy 1's worker. Repair's only
   // source is the corrupt copy — it must refuse (CHECKSUM_MISMATCH on the

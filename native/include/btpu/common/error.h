@@ -62,6 +62,10 @@ enum class ErrorCode : uint32_t {
   LEADER_ELECTION_FAILED,
   SERVICE_REGISTRATION_FAILED,
   NOT_LEADER,  // mutation sent to a standby keystone; retry against the leader
+  // Fencing-token mismatch: a mutation carried an election epoch older than
+  // the current leader's — the writer was deposed (split-brain window) and
+  // must step down instead of retrying.
+  FENCED,
 
   // Data (5000-5999)
   OBJECT_NOT_FOUND = domain_base(Domain::DATA),

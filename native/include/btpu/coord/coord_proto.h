@@ -37,6 +37,9 @@ enum class Op : uint8_t {
   // kMirrorRecord {u64 seq, WAL-encoded record}.
   kMirror = 20,
   kMirrorRecord = 21,
+  kElectionEpoch = 22,  // call channel: {election} -> {ErrorCode, u64 epoch}
+  kPutFenced = 23,      // call channel: {key, value, election, u64 epoch}
+  kDelFenced = 24,      // call channel: {key, election, u64 epoch}
 };
 
 }  // namespace btpu::coord

@@ -70,11 +70,18 @@ class Coordinator {
   virtual Result<std::vector<KeyValue>> discover_service(const std::string& service_name) = 0;
   virtual ErrorCode unregister_service(const std::string& service_name, const std::string& id) = 0;
 
-  // --- Leader election ---
+  // --- Leader election (with fencing tokens) ---
   // First campaigner under `election` wins; on leader death/resign the next
   // campaigner is promoted and its callback fires with is_leader=true.
+  // Every promotion MINTS a fencing epoch — monotonic across the store's
+  // whole lifetime (durable, shared by all elections) — delivered to the
+  // new leader in the callback. A deposed leader that resumes (GC pause,
+  // SIGSTOP, partition heal) still holds its old epoch; the *_fenced
+  // mutations below reject it, which is what makes split-brain windows
+  // harmless (the raft-safety analog of the reference's etcd).
+  using CampaignCallback = std::function<void(bool is_leader, uint64_t epoch)>;
   virtual ErrorCode campaign(const std::string& election, const std::string& candidate_id,
-                             int64_t lease_ttl_ms, std::function<void(bool is_leader)> cb) = 0;
+                             int64_t lease_ttl_ms, CampaignCallback cb) = 0;
   virtual ErrorCode resign(const std::string& election, const std::string& candidate_id) = 0;
   // Refreshes the candidate's election lease. A candidate (leader or
   // standby) that stops calling this within its lease TTL is treated as
@@ -82,6 +89,18 @@ class Coordinator {
   virtual ErrorCode campaign_keepalive(const std::string& election,
                                        const std::string& candidate_id) = 0;
   virtual Result<std::string> current_leader(const std::string& election) = 0;
+  // Current fencing epoch of the election (COORD_KEY_NOT_FOUND when it has
+  // no leader).
+  virtual Result<uint64_t> election_epoch(const std::string& election) = 0;
+
+  // --- Fenced mutations ---
+  // Execute iff `epoch` equals the election's current epoch; otherwise
+  // FENCED and no state changes. A leader routes every durable write it
+  // performs on behalf of its leadership through these.
+  virtual ErrorCode put_fenced(const std::string& key, const std::string& value,
+                               const std::string& election, uint64_t epoch) = 0;
+  virtual ErrorCode del_fenced(const std::string& key, const std::string& election,
+                               uint64_t epoch) = 0;
 
   virtual bool connected() const = 0;
 };

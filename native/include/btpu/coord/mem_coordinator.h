@@ -54,11 +54,17 @@ class MemCoordinator : public Coordinator {
   ErrorCode unregister_service(const std::string& service_name, const std::string& id) override;
 
   ErrorCode campaign(const std::string& election, const std::string& candidate_id,
-                     int64_t lease_ttl_ms, std::function<void(bool)> cb) override;
+                     int64_t lease_ttl_ms, CampaignCallback cb) override;
   ErrorCode resign(const std::string& election, const std::string& candidate_id) override;
   ErrorCode campaign_keepalive(const std::string& election,
                                const std::string& candidate_id) override;
   Result<std::string> current_leader(const std::string& election) override;
+  Result<uint64_t> election_epoch(const std::string& election) override;
+
+  ErrorCode put_fenced(const std::string& key, const std::string& value,
+                       const std::string& election, uint64_t epoch) override;
+  ErrorCode del_fenced(const std::string& key, const std::string& election,
+                       uint64_t epoch) override;
 
   bool connected() const override { return true; }
 
@@ -99,7 +105,11 @@ class MemCoordinator : public Coordinator {
   struct Candidate {
     std::string id;
     LeaseId lease;
-    std::function<void(bool)> cb;
+    CampaignCallback cb;
+  };
+  struct Election {
+    std::vector<Candidate> candidates;  // front() = leader
+    uint64_t epoch{0};                  // fencing token of the current leader
   };
 
   void expiry_loop();
@@ -107,6 +117,11 @@ class MemCoordinator : public Coordinator {
   void notify(WatchEvent::Type type, const std::string& key, const std::string& value);
   ErrorCode del_locked(const std::string& key, std::unique_lock<std::mutex>& lock);
   void promote_next_locked(const std::string& election, std::unique_lock<std::mutex>& lock);
+  // Mints the next fencing epoch for `election` (monotonic across restarts
+  // and across all elections: journaled).
+  uint64_t mint_epoch_locked(const std::string& election);
+  // OK iff `election` currently has a leader whose epoch == `epoch`.
+  ErrorCode check_fence_locked(const std::string& election, uint64_t epoch) const;
 
   // ---- durability (no-ops when durability_.dir is empty) ----
   void journal_load();                       // ctor only, before threads
@@ -135,7 +150,14 @@ class MemCoordinator : public Coordinator {
   std::map<std::string, Entry> data_;  // ordered: prefix scans are ranges
   std::unordered_map<LeaseId, Lease> leases_;
   std::vector<Watch> watches_;
-  std::map<std::string, std::vector<Candidate>> elections_;  // front() = leader
+  std::map<std::string, Election> elections_;
+  // Fencing clock. max_epoch_ is the mint counter (global: tokens are
+  // unique across elections); election_epochs_ remembers each election's
+  // last minted epoch DURABLY, so the fence still judges correctly in the
+  // window after a coordinator restart when elections_ (session state) is
+  // empty but leaders still hold their tokens.
+  uint64_t max_epoch_{0};
+  std::map<std::string, uint64_t> election_epochs_;
   std::atomic<LeaseId> next_lease_{1};
   std::atomic<WatchId> next_watch_{1};
 

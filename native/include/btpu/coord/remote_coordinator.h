@@ -54,11 +54,17 @@ class RemoteCoordinator : public Coordinator {
   ErrorCode unregister_service(const std::string& service_name, const std::string& id) override;
 
   ErrorCode campaign(const std::string& election, const std::string& candidate_id,
-                     int64_t lease_ttl_ms, std::function<void(bool)> cb) override;
+                     int64_t lease_ttl_ms, CampaignCallback cb) override;
   ErrorCode resign(const std::string& election, const std::string& candidate_id) override;
   ErrorCode campaign_keepalive(const std::string& election,
                                const std::string& candidate_id) override;
   Result<std::string> current_leader(const std::string& election) override;
+  Result<uint64_t> election_epoch(const std::string& election) override;
+
+  ErrorCode put_fenced(const std::string& key, const std::string& value,
+                       const std::string& election, uint64_t epoch) override;
+  ErrorCode del_fenced(const std::string& key, const std::string& election,
+                       uint64_t epoch) override;
 
   bool connected() const override { return connected_.load(); }
 
@@ -119,7 +125,7 @@ class RemoteCoordinator : public Coordinator {
   std::mutex watch_mutex_;
   std::unordered_map<int64_t, WatchCallback> watch_cbs_;
   std::unordered_map<int64_t, std::string> watch_prefixes_;  // for replay
-  std::unordered_map<std::string, std::function<void(bool)>> leader_cbs_;  // election/candidate
+  std::unordered_map<std::string, CampaignCallback> leader_cbs_;  // election/candidate
   // election/candidate -> (election, candidate, lease ttl), for replay.
   std::unordered_map<std::string, std::tuple<std::string, std::string, int64_t>> campaigns_;
   std::atomic<int64_t> next_watch_{1};

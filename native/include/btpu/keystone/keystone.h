@@ -189,8 +189,20 @@ class KeystoneService {
   void load_persisted_objects();
   // Durable object metadata (persist_objects): COMPLETE objects are written
   // to the coordinator and replayed (with allocator range adoption) on boot.
-  void persist_object(const ObjectKey& key, const ObjectInfo& info);
-  void unpersist_object(const ObjectKey& key);
+  // Durable object-record writes. Under HA these are FENCED with the
+  // leader epoch minted at this keystone's promotion: a deposed leader
+  // (SIGSTOP/GC-pause window) gets FENCED back, steps down, and the
+  // mutation provably never reached durable state. Returns the write's
+  // outcome so commit points (put_complete) can fail closed.
+  ErrorCode persist_object(const ObjectKey& key, const ObjectInfo& info);
+  ErrorCode unpersist_object(const ObjectKey& key);
+  // Routes a leader-owned coordinator write through the fence (plain write
+  // when HA is off). FENCED triggers fence_stepdown().
+  ErrorCode coord_put_record(const std::string& key, const std::string& value);
+  ErrorCode coord_del_record(const std::string& key);
+  // A FENCED write proves this node was deposed: stop claiming leadership
+  // immediately and let the keepalive thread resign + re-campaign.
+  void fence_stepdown();
   // Installs/replaces the local view of one persisted object record (map
   // entry + allocator ranges). Standbys mirror the leader's writes through
   // this; boot replay and promotion reconcile reuse it. kGarbage = the
@@ -280,6 +292,7 @@ class KeystoneService {
   std::atomic<uint32_t> promotion_refusals_{0};  // streak; reset on success
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
+  std::atomic<uint64_t> leader_epoch_{0};  // fencing token from promotion
   std::thread gc_thread_, health_thread_, keepalive_thread_;
   std::condition_variable_any stop_cv_;
   std::mutex stop_mutex_;

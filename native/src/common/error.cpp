@@ -34,6 +34,7 @@ std::string_view to_string(ErrorCode code) noexcept {
     case ErrorCode::LEADER_ELECTION_FAILED: return "LEADER_ELECTION_FAILED";
     case ErrorCode::SERVICE_REGISTRATION_FAILED: return "SERVICE_REGISTRATION_FAILED";
     case ErrorCode::NOT_LEADER: return "NOT_LEADER";
+    case ErrorCode::FENCED: return "FENCED";
     case ErrorCode::OBJECT_NOT_FOUND: return "OBJECT_NOT_FOUND";
     case ErrorCode::OBJECT_ALREADY_EXISTS: return "OBJECT_ALREADY_EXISTS";
     case ErrorCode::INVALID_KEY: return "INVALID_KEY";
@@ -90,6 +91,7 @@ std::string_view describe(ErrorCode code) noexcept {
     case ErrorCode::LEADER_ELECTION_FAILED: return "leader election failed";
     case ErrorCode::SERVICE_REGISTRATION_FAILED: return "service registration failed";
     case ErrorCode::NOT_LEADER: return "mutation sent to a standby keystone; retry against the leader";
+    case ErrorCode::FENCED: return "stale leader epoch: the writer was deposed and must step down";
     case ErrorCode::OBJECT_NOT_FOUND: return "object key not found";
     case ErrorCode::OBJECT_ALREADY_EXISTS: return "object key already exists";
     case ErrorCode::INVALID_KEY: return "object key is malformed";

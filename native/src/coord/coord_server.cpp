@@ -69,6 +69,8 @@ bool CoordServer::is_mutation(uint8_t opcode) noexcept {
     case Op::kCampaign:
     case Op::kResign:
     case Op::kCampaignKeepalive:
+    case Op::kPutFenced:
+    case Op::kDelFenced:
       return true;
     default:
       return false;
@@ -214,6 +216,34 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
         w.put(store_.del(key));
         break;
       }
+      case Op::kPutFenced: {
+        std::string key, value, election;
+        uint64_t epoch = 0;
+        if (!wire::decode_fields(r, key, value, election, epoch)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        w.put(store_.put_fenced(key, value, election, epoch));
+        break;
+      }
+      case Op::kDelFenced: {
+        std::string key, election;
+        uint64_t epoch = 0;
+        if (!wire::decode_fields(r, key, election, epoch)) {
+          w.put(ErrorCode::INVALID_PARAMETERS);
+          break;
+        }
+        w.put(store_.del_fenced(key, election, epoch));
+        break;
+      }
+      case Op::kElectionEpoch: {
+        std::string election;
+        if (!wire::decode(r, election)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
+        auto res = store_.election_epoch(election);
+        w.put(res.ok() ? ErrorCode::OK : res.error());
+        if (res.ok()) w.put<uint64_t>(res.value());
+        break;
+      }
       case Op::kGetPrefix: {
         std::string prefix;
         if (!wire::decode(r, prefix)) { w.put(ErrorCode::INVALID_PARAMETERS); break; }
@@ -315,11 +345,13 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
           break;
         }
         auto ec = store_.campaign(election, candidate, ttl_ms,
-                                  [channel, election, candidate](bool is_leader) {
+                                  [channel, election, candidate](bool is_leader,
+                                                                 uint64_t epoch) {
                                     Writer pw;
                                     wire::encode(pw, election);
                                     wire::encode(pw, candidate);
                                     wire::encode(pw, is_leader);
+                                    pw.put<uint64_t>(epoch);
                                     channel->push(Op::kLeaderEvent, pw.buffer());
                                   });
         w.put(ec);

@@ -49,11 +49,12 @@ std::string object_record_key(const std::string& c, const std::string& key) {
 
 namespace {
 constexpr uint32_t kSnapshotMagic = 0x53435442;  // "BTCS"
-constexpr uint32_t kSnapshotVersion = 1;
+constexpr uint32_t kSnapshotVersion = 2;  // v2 appends max_epoch_
 constexpr uint8_t kRecPut = 1;      // key, value, lease id (0 = none)
 constexpr uint8_t kRecDel = 2;      // key
 constexpr uint8_t kRecGrant = 3;    // lease id, ttl_ms
 constexpr uint8_t kRecRevoke = 4;   // lease id (deletes owned keys on replay)
+constexpr uint8_t kRecEpoch = 5;    // fencing epoch minted: {election, epoch}
 constexpr uint32_t kMaxRecordBytes = 64u << 20;
 
 std::vector<uint8_t> rec_put(const std::string& key, const std::string& value, int64_t lease) {
@@ -84,6 +85,14 @@ std::vector<uint8_t> rec_revoke(int64_t id) {
   wire::Writer w;
   w.put<uint8_t>(kRecRevoke);
   w.put<int64_t>(id);
+  return w.take();
+}
+
+std::vector<uint8_t> rec_epoch(const std::string& election, uint64_t epoch) {
+  wire::Writer w;
+  w.put<uint8_t>(kRecEpoch);
+  wire::encode(w, election);
+  w.put<uint64_t>(epoch);
   return w.take();
 }
 }  // namespace
@@ -212,6 +221,13 @@ std::vector<uint8_t> MemCoordinator::snapshot_bytes_locked() const {
     wire::encode(w, entry.value);
     w.put<int64_t>(entry.lease);
   }
+  // v2 tail: the fencing clock.
+  w.put<uint64_t>(max_epoch_);
+  w.put<uint64_t>(election_epochs_.size());
+  for (const auto& [election, epoch] : election_epochs_) {
+    wire::encode(w, election);
+    w.put<uint64_t>(epoch);
+  }
   return w.take();
 }
 
@@ -251,8 +267,8 @@ bool MemCoordinator::decode_snapshot_locked(const std::vector<uint8_t>& bytes) {
   wire::Reader r(bytes);
   uint32_t magic = 0, version = 0;
   uint64_t next_lease = 0, n_leases = 0, n_entries = 0;
-  if (!r.get(magic) || magic != kSnapshotMagic || !r.get(version) ||
-      version != kSnapshotVersion || !r.get(next_lease) || !r.get(n_leases))
+  if (!r.get(magic) || magic != kSnapshotMagic || !r.get(version) || version < 1 ||
+      version > kSnapshotVersion || !r.get(next_lease) || !r.get(n_leases))
     return false;
   next_lease_ = next_lease;
   bool ok = true;
@@ -273,6 +289,20 @@ bool MemCoordinator::decode_snapshot_locked(const std::vector<uint8_t>& bytes) {
         it->second.keys.push_back(key);
       }
       data_[key] = Entry{std::move(value), lease};
+    }
+  }
+  if (ok && version >= 2) {
+    uint64_t epoch = 0, n = 0;
+    ok = r.get(epoch) && r.get(n);
+    if (ok) max_epoch_ = std::max(max_epoch_, epoch);
+    for (uint64_t i = 0; ok && i < n; ++i) {
+      std::string election;
+      uint64_t e = 0;
+      ok = wire::decode(r, election) && r.get(e);
+      if (ok) {
+        auto& stored = election_epochs_[election];
+        stored = std::max(stored, e);
+      }
     }
   }
   return ok;
@@ -339,6 +369,16 @@ bool MemCoordinator::apply_record_locked(const uint8_t* bytes, size_t len,
         if (entry == data_.end() || entry->second.lease != id) continue;
         del_locked(k, lock);
       }
+      return true;
+    }
+    case kRecEpoch: {
+      std::string election;
+      uint64_t epoch = 0;
+      if (!wire::decode(r, election) || !r.get(epoch)) return false;
+      max_epoch_ = std::max(max_epoch_, epoch);
+      auto& stored = election_epochs_[election];
+      stored = std::max(stored, epoch);
+      log_locked(rec_epoch(election, epoch));
       return true;
     }
     default:
@@ -451,12 +491,12 @@ void MemCoordinator::expiry_loop() {
         del_locked(key, lock);
       }
       // A leader whose lease expired loses the election.
-      for (auto& [election, candidates] : elections_) {
-        auto dead = std::find_if(candidates.begin(), candidates.end(),
+      for (auto& [election, e] : elections_) {
+        auto dead = std::find_if(e.candidates.begin(), e.candidates.end(),
                                  [&](const Candidate& c) { return c.lease == id; });
-        if (dead != candidates.end()) {
-          const bool was_leader = dead == candidates.begin();
-          candidates.erase(dead);
+        if (dead != e.candidates.end()) {
+          const bool was_leader = dead == e.candidates.begin();
+          e.candidates.erase(dead);
           if (was_leader) promote_next_locked(election, lock);
         }
       }
@@ -577,12 +617,12 @@ ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
     if (entry == data_.end() || entry->second.lease != lease) continue;
     del_locked(key, lock);
   }
-  for (auto& [election, candidates] : elections_) {
-    auto dead = std::find_if(candidates.begin(), candidates.end(),
+  for (auto& [election, e] : elections_) {
+    auto dead = std::find_if(e.candidates.begin(), e.candidates.end(),
                              [&](const Candidate& c) { return c.lease == lease; });
-    if (dead != candidates.end()) {
-      const bool was_leader = dead == candidates.begin();
-      candidates.erase(dead);
+    if (dead != e.candidates.end()) {
+      const bool was_leader = dead == e.candidates.begin();
+      e.candidates.erase(dead);
       if (was_leader) promote_next_locked(election, lock);
     }
   }
@@ -619,35 +659,63 @@ ErrorCode MemCoordinator::unregister_service(const std::string& service_name,
   return del(services_prefix(service_name) + id);
 }
 
+uint64_t MemCoordinator::mint_epoch_locked(const std::string& election) {
+  ++max_epoch_;
+  election_epochs_[election] = max_epoch_;
+  log_locked(rec_epoch(election, max_epoch_));
+  return max_epoch_;
+}
+
+ErrorCode MemCoordinator::check_fence_locked(const std::string& election,
+                                             uint64_t epoch) const {
+  auto it = elections_.find(election);
+  if (it != elections_.end() && !it->second.candidates.empty())
+    return epoch == it->second.epoch ? ErrorCode::OK : ErrorCode::FENCED;
+  // No live election (coordinator restarted, or every candidate lapsed):
+  // judge against THIS election's durable last-minted epoch — the holder of
+  // that token is still the rightful leader until someone re-campaigns and
+  // mints a newer one. Comparing to a global counter here would wrongly
+  // fence election A's leader whenever election B promoted more recently.
+  auto stored = election_epochs_.find(election);
+  if (stored == election_epochs_.end()) return ErrorCode::FENCED;
+  return epoch == stored->second ? ErrorCode::OK : ErrorCode::FENCED;
+}
+
 void MemCoordinator::promote_next_locked(const std::string& election,
                                          std::unique_lock<std::mutex>& lock) {
   auto it = elections_.find(election);
-  if (it == elections_.end() || it->second.empty()) return;
-  auto cb = it->second.front().cb;
-  const std::string leader_id = it->second.front().id;
-  LOG_INFO << "election '" << election << "': " << leader_id << " is now leader";
+  if (it == elections_.end() || it->second.candidates.empty()) return;
+  it->second.epoch = mint_epoch_locked(election);
+  const uint64_t epoch = it->second.epoch;
+  auto cb = it->second.candidates.front().cb;
+  const std::string leader_id = it->second.candidates.front().id;
+  LOG_INFO << "election '" << election << "': " << leader_id << " is now leader (epoch "
+           << epoch << ")";
   if (cb) {
     lock.unlock();
-    cb(true);
+    cb(true, epoch);
     lock.lock();
   }
 }
 
 ErrorCode MemCoordinator::campaign(const std::string& election, const std::string& candidate_id,
-                                   int64_t lease_ttl_ms, std::function<void(bool)> cb) {
+                                   int64_t lease_ttl_ms, CampaignCallback cb) {
   auto lease = lease_grant(lease_ttl_ms);
   if (!lease.ok()) return lease.error();
   bool is_leader = false;
+  uint64_t epoch = 0;
   {
     std::unique_lock<std::mutex> lock(mutex_);
-    auto& candidates = elections_[election];
-    if (std::any_of(candidates.begin(), candidates.end(),
+    auto& e = elections_[election];
+    if (std::any_of(e.candidates.begin(), e.candidates.end(),
                     [&](const Candidate& c) { return c.id == candidate_id; }))
       return ErrorCode::CLIENT_ALREADY_EXISTS;
-    candidates.push_back({candidate_id, lease.value(), cb});
-    is_leader = candidates.size() == 1;
+    e.candidates.push_back({candidate_id, lease.value(), cb});
+    is_leader = e.candidates.size() == 1;
+    if (is_leader) e.epoch = mint_epoch_locked(election);
+    epoch = e.epoch;
   }
-  if (cb) cb(is_leader);
+  if (cb) cb(is_leader, is_leader ? epoch : 0);
   return ErrorCode::OK;
 }
 
@@ -655,7 +723,7 @@ ErrorCode MemCoordinator::resign(const std::string& election, const std::string&
   std::unique_lock<std::mutex> lock(mutex_);
   auto it = elections_.find(election);
   if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
-  auto& candidates = it->second;
+  auto& candidates = it->second.candidates;
   auto me = std::find_if(candidates.begin(), candidates.end(),
                          [&](const Candidate& c) { return c.id == candidate_id; });
   if (me == candidates.end()) return ErrorCode::LEADER_ELECTION_FAILED;
@@ -675,9 +743,9 @@ ErrorCode MemCoordinator::campaign_keepalive(const std::string& election,
     std::lock_guard<std::mutex> lock(mutex_);
     auto it = elections_.find(election);
     if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
-    auto me = std::find_if(it->second.begin(), it->second.end(),
+    auto me = std::find_if(it->second.candidates.begin(), it->second.candidates.end(),
                            [&](const Candidate& c) { return c.id == candidate_id; });
-    if (me == it->second.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+    if (me == it->second.candidates.end()) return ErrorCode::LEADER_ELECTION_FAILED;
     lease = me->lease;
   }
   return lease_keepalive(lease);
@@ -686,8 +754,36 @@ ErrorCode MemCoordinator::campaign_keepalive(const std::string& election,
 Result<std::string> MemCoordinator::current_leader(const std::string& election) {
   std::lock_guard<std::mutex> lock(mutex_);
   auto it = elections_.find(election);
-  if (it == elections_.end() || it->second.empty()) return ErrorCode::COORD_KEY_NOT_FOUND;
-  return it->second.front().id;
+  if (it == elections_.end() || it->second.candidates.empty())
+    return ErrorCode::COORD_KEY_NOT_FOUND;
+  return it->second.candidates.front().id;
+}
+
+Result<uint64_t> MemCoordinator::election_epoch(const std::string& election) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = elections_.find(election);
+  if (it == elections_.end() || it->second.candidates.empty())
+    return ErrorCode::COORD_KEY_NOT_FOUND;
+  return it->second.epoch;
+}
+
+ErrorCode MemCoordinator::put_fenced(const std::string& key, const std::string& value,
+                                     const std::string& election, uint64_t epoch) {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (auto ec = check_fence_locked(election, epoch); ec != ErrorCode::OK) return ec;
+    data_[key] = Entry{value, 0};
+    log_locked(rec_put(key, value, 0));
+  }
+  notify(WatchEvent::Type::kPut, key, value);
+  return ErrorCode::OK;
+}
+
+ErrorCode MemCoordinator::del_fenced(const std::string& key, const std::string& election,
+                                     uint64_t epoch) {
+  std::unique_lock<std::mutex> lock(mutex_);
+  if (auto ec = check_fence_locked(election, epoch); ec != ErrorCode::OK) return ec;
+  return del_locked(key, lock);
 }
 
 }  // namespace btpu::coord

@@ -358,13 +358,18 @@ void RemoteCoordinator::event_reader_loop() {
       std::string election, candidate;
       bool is_leader = false;
       if (!wire::decode_fields(r, election, candidate, is_leader)) continue;
-      std::function<void(bool)> cb;
+      // Fencing epoch: appended by epoch-aware servers (tail-tolerant: 0
+      // from older ones, malformed tail = discard the event — a torn epoch
+      // must never masquerade as epoch 0).
+      uint64_t epoch = 0;
+      if (!wire::decode_fields_tail(r, epoch)) continue;
+      CampaignCallback cb;
       {
         std::lock_guard<std::mutex> lock(watch_mutex_);
         auto it = leader_cbs_.find(election + "/" + candidate);
         if (it != leader_cbs_.end()) cb = it->second;
       }
-      if (cb) cb(is_leader);
+      if (cb) cb(is_leader, epoch);
     } else {
       // Response to an event-channel request.
       std::lock_guard<std::mutex> lock(resp_mutex_);
@@ -542,7 +547,7 @@ ErrorCode RemoteCoordinator::unregister_service(const std::string& service_name,
 
 ErrorCode RemoteCoordinator::campaign(const std::string& election,
                                       const std::string& candidate_id, int64_t lease_ttl_ms,
-                                      std::function<void(bool)> cb) {
+                                      CampaignCallback cb) {
   const std::string key = election + "/" + candidate_id;
   {
     std::lock_guard<std::mutex> lock(watch_mutex_);
@@ -612,6 +617,49 @@ Result<std::string> RemoteCoordinator::current_leader(const std::string& electio
   std::string leader;
   if (!wire::decode(r, leader)) return ErrorCode::RPC_FAILED;
   return leader;
+}
+
+Result<uint64_t> RemoteCoordinator::election_epoch(const std::string& election) {
+  Writer w;
+  wire::encode(w, election);
+  std::vector<uint8_t> resp;
+  auto ec = call(static_cast<uint8_t>(Op::kElectionEpoch), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  if (ec != ErrorCode::OK) return ec;
+  uint64_t epoch = 0;
+  if (!r.get(epoch)) return ErrorCode::RPC_FAILED;
+  return epoch;
+}
+
+ErrorCode RemoteCoordinator::put_fenced(const std::string& key, const std::string& value,
+                                        const std::string& election, uint64_t epoch) {
+  Writer w;
+  wire::encode_fields(w, key, value, election, epoch);
+  std::vector<uint8_t> resp;
+  // Fenced puts are safe to retry after a reconnect: re-executing is
+  // idempotent (same value) and the fence re-checks the epoch server-side.
+  auto ec = call(static_cast<uint8_t>(Op::kPutFenced), w.buffer(), resp);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  return take_status(r);
+}
+
+ErrorCode RemoteCoordinator::del_fenced(const std::string& key, const std::string& election,
+                                        uint64_t epoch) {
+  Writer w;
+  wire::encode_fields(w, key, election, epoch);
+  std::vector<uint8_t> resp;
+  bool retried = false;
+  auto ec = call(static_cast<uint8_t>(Op::kDelFenced), w.buffer(), resp, &retried);
+  if (ec != ErrorCode::OK) return ec;
+  Reader r(resp);
+  ec = take_status(r);
+  // At-least-once + replay: a retried delete that reports NOT_FOUND may
+  // have executed on the first attempt (same contract as plain del()).
+  if (ec == ErrorCode::COORD_KEY_NOT_FOUND && retried) return ErrorCode::OK;
+  return ec;
 }
 
 }  // namespace btpu::coord

@@ -523,7 +523,10 @@ ErrorCode KeystoneService::setup_coordinator_integration() {
 ErrorCode KeystoneService::start_campaign() {
   return coordinator_->campaign(
       election_name(), service_id_, config_.service_registration_ttl_sec * 1000,
-      [this](bool leader) {
+      [this](bool leader, uint64_t epoch) {
+        // The fencing token must be visible BEFORE is_leader_ flips true:
+        // a mutation admitted by the new leadership must carry its epoch.
+        if (leader) leader_epoch_.store(epoch);
         const bool was = is_leader_.load();
         if (leader && !was) {
           // Reconcile BEFORE accepting mutations: while is_leader_ is still
@@ -579,8 +582,8 @@ void KeystoneService::load_existing_state() {
   load_persisted_objects();
 }
 
-void KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
-  if (!coordinator_ || !config_.persist_objects) return;
+ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
+  if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
   const auto steady_now = std::chrono::steady_clock::now();
   const int64_t wall_now = now_wall_ms();
   auto to_wall = [&](std::chrono::steady_clock::time_point tp) {
@@ -596,13 +599,47 @@ void KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& inf
   rec.copies = info.copies;
   rec.created_wall_ms = to_wall(info.created_at);
   rec.last_access_wall_ms = to_wall(info.last_access);
-  coordinator_->put(coord::object_record_key(config_.cluster_id, key),
-                    encode_object_record(rec));
+  return coord_put_record(coord::object_record_key(config_.cluster_id, key),
+                          encode_object_record(rec));
 }
 
-void KeystoneService::unpersist_object(const ObjectKey& key) {
-  if (!coordinator_ || !config_.persist_objects) return;
-  coordinator_->del(coord::object_record_key(config_.cluster_id, key));
+ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
+  if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
+  auto ec = coord_del_record(coord::object_record_key(config_.cluster_id, key));
+  return ec == ErrorCode::COORD_KEY_NOT_FOUND ? ErrorCode::OK : ec;
+}
+
+ErrorCode KeystoneService::coord_put_record(const std::string& key, const std::string& value) {
+  if (!config_.enable_ha) return coordinator_->put(key, value);
+  auto ec = coordinator_->put_fenced(key, value, election_name(), leader_epoch_.load());
+  if (ec == ErrorCode::FENCED) fence_stepdown();
+  return ec;
+}
+
+ErrorCode KeystoneService::coord_del_record(const std::string& key) {
+  if (!config_.enable_ha) return coordinator_->del(key);
+  auto ec = coordinator_->del_fenced(key, election_name(), leader_epoch_.load());
+  if (ec == ErrorCode::FENCED) fence_stepdown();
+  return ec;
+}
+
+void KeystoneService::fence_stepdown() {
+  if (is_leader_.exchange(false)) {
+    LOG_ERROR << "FENCED: this keystone's leader epoch " << leader_epoch_.load()
+              << " is stale (deposed during a stall) — stepping down; the promoted "
+                 "leader's state is untouched";
+    // The keepalive thread owns resign/re-campaign (on_demoted included via
+    // the lease-lost path's machinery); wake it now. The flags are set under
+    // stop_mutex_ so the notify cannot slip between the waiter's predicate
+    // check and its park (lost wakeup = stale node out of the election for
+    // a full refresh interval).
+    {
+      std::lock_guard<std::mutex> lock(stop_mutex_);
+      needs_recampaign_ = true;
+      recampaign_asap_ = true;
+    }
+    stop_cv_.notify_all();
+  }
 }
 
 // Replays persisted object records: rebuild metadata and re-adopt allocator
@@ -1070,8 +1107,13 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
   }
   it->second.state = ObjectState::kComplete;
   it->second.last_access = std::chrono::steady_clock::now();
+  if (auto ec = persist_object(key, it->second); ec == ErrorCode::FENCED) {
+    // Commit point, fail closed: the durable record never landed, so the
+    // object must not read back as complete from this (deposed) node.
+    it->second.state = ObjectState::kPending;
+    return ec;
+  }
   ++counters_.put_completes;
-  persist_object(key, it->second);
   return ErrorCode::OK;
 }
 
@@ -1564,10 +1606,10 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
   // heartbeat prefix); coordinator-state deletion and repair are the
   // leader's job — a standby mutating either would race the leader.
   if (coordinator_ && is_leader_.load()) {
-    coordinator_->del(coord::worker_key(config_.cluster_id, worker_id));
+    coord_del_record(coord::worker_key(config_.cluster_id, worker_id));
     for (const auto& pool_id : dead_pools)
-      coordinator_->del(coord::pool_key(config_.cluster_id, worker_id, pool_id));
-    coordinator_->del(coord::heartbeat_key(config_.cluster_id, worker_id));
+      coord_del_record(coord::pool_key(config_.cluster_id, worker_id, pool_id));
+    coord_del_record(coord::heartbeat_key(config_.cluster_id, worker_id));
   }
   bump_view();
   LOG_WARN << "worker " << worker_id << " removed (" << dead_pools.size() << " pools)";

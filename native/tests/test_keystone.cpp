@@ -925,3 +925,53 @@ BTEST(Keystone, FutureFormatRecordsAreKeptNotDeleted) {
   auto purged = coordinator->get(coord::object_record_key(cfg.cluster_id, "garbage/obj"));
   BT_EXPECT(!purged.ok());  // garbage did not
 }
+
+BTEST(Keystone, FencedPersistStepsDownStaleLeader) {
+  // The split-brain window fencing exists for: a leader whose election
+  // lease lapsed during a stall (SIGSTOP/GC pause) and whose keepalive
+  // thread has NOT yet noticed (refresh interval here is effectively
+  // never). Lease expiry erases its candidacy with no callback, so it
+  // still believes it leads — its next durable mutation must come back
+  // FENCED, fail the client call, and force the stepdown.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  cfg.enable_ha = true;
+  cfg.service_registration_ttl_sec = 1;      // candidacy lease: 1s
+  cfg.service_refresh_interval_sec = 3600;   // keepalive: effectively never
+  KeystoneService ks(cfg, coordinator);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  BT_ASSERT(ks.start() == ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return ks.is_leader(); }));
+
+  FakeWorker w1("w1", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  BT_ASSERT_OK(ks.put_start("fence/obj", 4096, wc));
+
+  // The lease lapses (no keepalives) and an imposter wins the election
+  // with a strictly newer epoch. ks gets NO signal of any of this.
+  const std::string election = "btpu-keystone-leader/" + cfg.cluster_id;
+  BT_EXPECT(eventually([&] {
+    return coordinator->current_leader(election).ok() == false;
+  }, 3000));
+  std::atomic<bool> imposter_leader{false};
+  BT_ASSERT(coordinator->campaign(election, "imposter", 60000,
+                                  [&](bool l, uint64_t) { imposter_leader = l; }) ==
+            ErrorCode::OK);
+  BT_EXPECT(eventually([&] { return imposter_leader.load(); }));
+  BT_EXPECT(ks.is_leader());  // still believes — exactly the danger window
+
+  // The commit point is where fencing bites: the durable record is refused,
+  // the client call fails, and the stale leader steps down.
+  BT_EXPECT(ks.put_complete("fence/obj") == ErrorCode::FENCED);
+  BT_EXPECT(!ks.is_leader());
+  BT_EXPECT(ks.put_start("fence/late", 1024, wc).error() == ErrorCode::NOT_LEADER);
+  // Nothing leaked into durable state from the deposed leader.
+  auto rec = coordinator->get(coord::object_record_key(cfg.cluster_id, "fence/obj"));
+  BT_EXPECT(!rec.ok());
+  ks.stop();
+}

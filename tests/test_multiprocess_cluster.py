@@ -783,3 +783,103 @@ worker_heartbeat_ttl_sec: 2
         assert client.get("mp/ec") == payload
     finally:
         teardown(procs, timeout=5)
+
+
+def test_multiprocess_fencing_sigstopped_leader_cannot_commit(tmp_path):
+    """Split-brain fencing (VERDICT r2 item 7): SIGSTOP the leader keystone,
+    let its election lease lapse so the standby promotes with a newer
+    fencing epoch, then SIGCONT the old leader and fire mutations at it
+    DIRECTLY (no endpoint failover). Every durable commit from the deposed
+    leader must be rejected — by the coordinator's epoch fence (FENCED at
+    the put_complete commit point, forcing stepdown) or, if its keepalive
+    thread noticed first, by NOT_LEADER. Either way: no mutation may
+    succeed, and the promoted leader's state stays untouched."""
+    from blackbird_tpu import Client
+
+    coord_port = free_port()
+    ks_ports = [free_port(), free_port()]
+    metrics_ports = [free_port(), free_port()]
+    procs = []
+    spawn = make_spawner(procs)
+
+    def keystone_cfg(i: int) -> Path:
+        path = tmp_path / f"fks{i}.yaml"
+        path.write_text(
+            f"""cluster_id: fence_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{ks_ports[i]}
+http_metrics_port: "{metrics_ports[i]}"
+enable_ha: true
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 30
+service_registration_ttl_sec: 2
+service_refresh_interval_sec: 1
+""")
+        return path
+
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        ks_procs = []
+        for i in range(2):
+            ks_procs.append(spawn(
+                [str(BUILD / "bb-keystone"), "--config", str(keystone_cfg(i)),
+                 "--service-id", f"fks-{i}"], f"keystone-{i}"))
+            wait_for(lambda: port_open(ks_ports[i]), what=f"bb-keystone-{i}")
+        cfg = write_worker_config(tmp_path, "fw-0", f"127.0.0.1:{coord_port}",
+                                  cluster_id="fence_cluster")
+        spawn([str(BUILD / "bb-worker"), "--config", str(cfg)], "worker")
+
+        leader = Client(f"127.0.0.1:{ks_ports[0]}")   # pinned: NO failover
+        standby = Client(f"127.0.0.1:{ks_ports[1]}")  # pinned the other way
+        wait_for(lambda: leader.stats()["workers"] == 1, timeout=15, what="worker")
+
+        payload = bytes(bytearray(range(251)) * 512)
+        leader.put("fence/before", payload)
+        assert leader.get("fence/before") == payload
+
+        # Stall the leader past its 2s election lease: the coordinator
+        # erases its candidacy (no callback reaches a stopped process) and
+        # promotes the standby with a freshly minted epoch.
+        ks_procs[0].send_signal(signal.SIGSTOP)
+
+        def standby_leads():
+            try:
+                standby.put("fence/during", payload)
+                return True
+            except Exception:  # noqa: BLE001 - not promoted yet
+                return False
+        wait_for(standby_leads, timeout=20, what="standby promotion")
+
+        # Resume the deposed leader and immediately fire mutations at it.
+        # For the first ~refresh interval it may still believe it leads —
+        # the window where ONLY the epoch fence stands between a client and
+        # split-brain. Nothing may commit through it, ever.
+        ks_procs[0].send_signal(signal.SIGCONT)
+        outcomes = []
+        deadline = time.time() + 6
+        i = 0
+        while time.time() < deadline:
+            try:
+                leader.put(f"fence/stale-{i}", payload)
+                raise AssertionError(
+                    f"deposed leader committed fence/stale-{i} — split-brain!")
+            except AssertionError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - rejection is the point
+                outcomes.append(str(exc))
+            i += 1
+            time.sleep(0.2)
+        assert outcomes, "no mutation attempts reached the deposed leader"
+
+        # The promoted leader's view is intact and none of the stale puts
+        # exist anywhere (reads through the CURRENT leader).
+        assert standby.get("fence/before") == payload
+        assert standby.get("fence/during") == payload
+        listed = standby.list()
+        assert listed and all(
+            not o["key"].startswith("fence/stale-") for o in listed)
+    finally:
+        teardown(procs, timeout=5)

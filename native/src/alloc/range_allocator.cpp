@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <numeric>
+#include <unordered_map>
 #include <unordered_set>
 
 #include "btpu/common/log.h"
@@ -11,6 +12,41 @@ namespace btpu::alloc {
 
 namespace {
 uint64_t ceil_div(uint64_t a, uint64_t b) { return (a + b - 1) / b; }
+
+// Pool ids grouped by owning worker, preserving rank order of first
+// appearance — the shared substrate for worker-level anti-affinity (replica
+// spread) and within-copy worker striping.
+struct NodeGroups {
+  std::vector<NodeId> order;
+  std::unordered_map<NodeId, std::vector<MemoryPoolId>> pools;
+};
+
+NodeGroups group_by_node(const PoolMap& pools, const std::vector<MemoryPoolId>& ids) {
+  NodeGroups g;
+  for (const auto& id : ids) {
+    const NodeId& node = pools.at(id).node_id;
+    auto [it, inserted] = g.pools.try_emplace(node);
+    if (inserted) g.order.push_back(node);
+    it->second.push_back(id);
+  }
+  return g;
+}
+
+// Round-robin passes over workers (rank order preserved within each pass):
+// any prefix of the result covers as many distinct workers as possible.
+std::vector<MemoryPoolId> interleave_nodes(const NodeGroups& g) {
+  std::vector<MemoryPoolId> out;
+  size_t total = 0;
+  for (const auto& [node, ids] : g.pools) total += ids.size();
+  out.reserve(total);
+  for (size_t pass = 0; out.size() < total; ++pass) {
+    for (const auto& node : g.order) {
+      const auto& ids = g.pools.at(node);
+      if (pass < ids.size()) out.push_back(ids[pass]);
+    }
+  }
+  return out;
+}
 }  // namespace
 
 ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
@@ -91,6 +127,15 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
   };
   rank(preferred);
   rank(fallback);
+
+  // Replicated requests narrow to `want` pools below; if those all sit on
+  // one worker (several pools per worker process), copies could never reach
+  // disjoint failure domains. Re-order so the selection covers as many
+  // distinct workers as the cluster has.
+  if (request.replication_factor > 1) {
+    preferred = interleave_nodes(group_by_node(pools, preferred));
+    fallback = interleave_nodes(group_by_node(pools, fallback));
+  }
 
   // EC copies need (k+m) * ceil(size/k) bytes over k+m slots; replication
   // needs size * r over (stripe width * r) slots.
@@ -259,54 +304,99 @@ Result<AllocationResult> RangeAllocator::allocate_with_striping(
     workers_per_copy = std::min(workers_per_copy, candidates.size());
   }
 
-  AllocationResult result{};
-  result.copies.reserve(request.replication_factor);
-  std::vector<std::pair<MemoryPoolId, Range>> all_ranges;
-
-  for (size_t copy_idx = 0; copy_idx < request.replication_factor; ++copy_idx) {
-    const uint64_t base_shard = per_copy / workers_per_copy;
-    const uint64_t remainder = per_copy % workers_per_copy;
-
-    CopyPlacement copy;
-    copy.copy_index = static_cast<uint32_t>(copy_idx);
-    copy.shards.reserve(workers_per_copy);
-
-    for (size_t widx = 0; widx < workers_per_copy; ++widx) {
-      const size_t pool_idx = (copy_idx * workers_per_copy + widx) % candidates.size();
-      const MemoryPoolId& pool_id = candidates[pool_idx];
-      const uint64_t shard_size = base_shard + (widx < remainder ? 1 : 0);
-
-      std::optional<Range> range;
-      {
-        std::shared_lock lock(pools_mutex_);
-        auto it = pool_allocators_.find(pool_id);
-        if (it == pool_allocators_.end()) {
-          rollback_allocation(all_ranges);
-          return ErrorCode::MEMORY_POOL_NOT_FOUND;
+  // Replica copies must not share a FAILURE DOMAIN (worker) when the cluster
+  // is big enough: a multi-controller device plane runs several pools per
+  // worker process, and pool-disjoint-but-worker-colocated copies would let
+  // one process death take every copy (reference replication_factor contract,
+  // keystone_service.cpp allocate path). Partition candidates by worker,
+  // round-robin whole workers across copies; if the partitioned layout cannot
+  // fit (uneven free space), fall back to the pool-interleaved layout —
+  // co-location beats failing the put.
+  std::vector<std::vector<MemoryPoolId>> per_copy_pools;
+  if (request.replication_factor > 1) {
+    const NodeGroups g = group_by_node(pools, candidates);
+    if (g.order.size() >= request.replication_factor) {
+      per_copy_pools.resize(request.replication_factor);
+      for (size_t c = 0; c < request.replication_factor; ++c) {
+        // Whole workers round-robin across copies, then each copy's pool
+        // list is itself worker-interleaved so its stripe (the first
+        // `width` entries below) spans the copy's workers, not just the
+        // first one's pools.
+        NodeGroups sub;
+        for (size_t ni = c; ni < g.order.size(); ni += request.replication_factor) {
+          sub.order.push_back(g.order[ni]);
+          sub.pools.emplace(g.order[ni], g.pools.at(g.order[ni]));
         }
-        range = it->second->allocate(shard_size);
+        per_copy_pools[c] = interleave_nodes(sub);
       }
-      if (!range) {
-        rollback_allocation(all_ranges);
-        return ErrorCode::INSUFFICIENT_SPACE;
-      }
-      all_ranges.emplace_back(pool_id, *range);
-
-      auto shard = create_shard_placement(pool_id, *range, pools);
-      if (!shard.ok()) {
-        rollback_allocation(all_ranges);
-        return shard.error();
-      }
-      copy.shards.push_back(std::move(shard).value());
     }
-    result.total_shards_created += copy.shards.size();
-    result.copies.push_back(std::move(copy));
   }
 
-  if (auto ec = commit_allocation(request.object_key, all_ranges); ec != ErrorCode::OK) {
-    rollback_allocation(all_ranges);
-    return ec;
+  auto try_layout = [&](bool disjoint) -> Result<AllocationResult> {
+    AllocationResult result{};
+    result.copies.reserve(request.replication_factor);
+    std::vector<std::pair<MemoryPoolId, Range>> all_ranges;
+
+    for (size_t copy_idx = 0; copy_idx < request.replication_factor; ++copy_idx) {
+      const std::vector<MemoryPoolId>& copy_pools =
+          disjoint ? per_copy_pools[copy_idx] : candidates;
+      const size_t width = std::min(workers_per_copy, copy_pools.size());
+      const uint64_t base_shard = per_copy / width;
+      const uint64_t remainder = per_copy % width;
+
+      CopyPlacement copy;
+      copy.copy_index = static_cast<uint32_t>(copy_idx);
+      copy.shards.reserve(width);
+
+      for (size_t widx = 0; widx < width; ++widx) {
+        const size_t pool_idx = disjoint
+                                    ? widx
+                                    : (copy_idx * workers_per_copy + widx) % copy_pools.size();
+        const MemoryPoolId& pool_id = copy_pools[pool_idx];
+        const uint64_t shard_size = base_shard + (widx < remainder ? 1 : 0);
+
+        std::optional<Range> range;
+        {
+          std::shared_lock lock(pools_mutex_);
+          auto it = pool_allocators_.find(pool_id);
+          if (it == pool_allocators_.end()) {
+            rollback_allocation(all_ranges);
+            return ErrorCode::MEMORY_POOL_NOT_FOUND;
+          }
+          range = it->second->allocate(shard_size);
+        }
+        if (!range) {
+          rollback_allocation(all_ranges);
+          return ErrorCode::INSUFFICIENT_SPACE;
+        }
+        all_ranges.emplace_back(pool_id, *range);
+
+        auto shard = create_shard_placement(pool_id, *range, pools);
+        if (!shard.ok()) {
+          rollback_allocation(all_ranges);
+          return shard.error();
+        }
+        copy.shards.push_back(std::move(shard).value());
+      }
+      result.total_shards_created += copy.shards.size();
+      result.copies.push_back(std::move(copy));
+    }
+
+    if (auto ec = commit_allocation(request.object_key, all_ranges); ec != ErrorCode::OK) {
+      rollback_allocation(all_ranges);
+      return ec;
+    }
+    return result;
+  };
+
+  Result<AllocationResult> attempt = ErrorCode::INSUFFICIENT_SPACE;
+  if (!per_copy_pools.empty()) {
+    attempt = try_layout(/*disjoint=*/true);
+    if (!attempt.ok() && attempt.error() != ErrorCode::INSUFFICIENT_SPACE) return attempt;
   }
+  if (!attempt.ok()) attempt = try_layout(/*disjoint=*/false);
+  if (!attempt.ok()) return attempt;
+  AllocationResult result = std::move(attempt).value();
 
   result.pools_used = candidates.size();
   result.stats.avg_shard_size =

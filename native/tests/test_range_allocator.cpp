@@ -116,6 +116,106 @@ BTEST(RangeAllocator, ReplicasSpreadAcrossDisjointPools) {
   BT_EXPECT_EQ(all_pools.size(), shard_count);  // no pool reused across copies
 }
 
+BTEST(RangeAllocator, ReplicasLandOnDisjointWorkersWithMultiPoolNodes) {
+  // Multi-controller shape: each worker process owns several pools (one per
+  // device). Copies must spread over disjoint WORKERS, not merely disjoint
+  // pools — otherwise one process death takes every copy.
+  RangeAllocator ra;
+  PoolMap pools;
+  for (int n = 0; n < 2; ++n) {
+    for (int p = 0; p < 4; ++p) {
+      auto id = "w" + std::to_string(n) + "-pool-" + std::to_string(p);
+      pools[id] = make_pool(id, "worker-" + std::to_string(n), 1 << 20);
+    }
+  }
+  // max_workers=2: the old pool-interleaved layout would put both copies on
+  // worker-0's four pools.
+  auto res = ra.allocate(make_request("obj", 64 * 1024, 2, 2), pools);
+  BT_ASSERT_OK(res);
+  BT_ASSERT(res.value().copies.size() == 2);
+  std::set<std::string> copy_workers[2];
+  for (int c = 0; c < 2; ++c) {
+    for (const auto& s : res.value().copies[c].shards) {
+      copy_workers[c].insert(s.worker_id);
+    }
+  }
+  for (const auto& w : copy_workers[0]) {
+    BT_EXPECT(!copy_workers[1].contains(w));
+  }
+}
+
+BTEST(RangeAllocator, DisjointCopyStillStripesAcrossItsOwnWorkers) {
+  // 3 workers x 2 pools, rf=2, max_workers=2: copy 0 is assigned two workers
+  // and must stripe across BOTH (aggregate bandwidth), not collapse onto the
+  // first worker's two pools.
+  RangeAllocator ra;
+  PoolMap pools;
+  for (int n = 0; n < 3; ++n) {
+    for (int p = 0; p < 2; ++p) {
+      auto id = "s" + std::to_string(n) + "-pool-" + std::to_string(p);
+      pools[id] = make_pool(id, "sworker-" + std::to_string(n), 1 << 20);
+    }
+  }
+  auto res = ra.allocate(make_request("obj", 64 * 1024, 2, 2), pools);
+  BT_ASSERT_OK(res);
+  BT_ASSERT(res.value().copies.size() == 2);
+  std::set<std::string> copy_workers[2];
+  for (int c = 0; c < 2; ++c) {
+    for (const auto& s : res.value().copies[c].shards) {
+      copy_workers[c].insert(s.worker_id);
+    }
+  }
+  for (const auto& w : copy_workers[0]) {
+    BT_EXPECT(!copy_workers[1].contains(w));
+  }
+  // One copy got two workers; its two shards sit on distinct workers.
+  const size_t widest = std::max(copy_workers[0].size(), copy_workers[1].size());
+  BT_EXPECT_EQ(widest, 2u);
+}
+
+BTEST(RangeAllocator, ReplicasColocateWhenSingleWorkerRatherThanFail) {
+  // Too few failure domains for disjoint copies: keep the old pool-level
+  // spread instead of refusing the put.
+  RangeAllocator ra;
+  PoolMap pools;
+  for (int p = 0; p < 4; ++p) {
+    auto id = "only-pool-" + std::to_string(p);
+    pools[id] = make_pool(id, "only-worker", 1 << 20);
+  }
+  auto res = ra.allocate(make_request("obj", 64 * 1024, 2, 2), pools);
+  BT_ASSERT_OK(res);
+  BT_ASSERT(res.value().copies.size() == 2);
+  std::set<MemoryPoolId> all_pools;
+  size_t shard_count = 0;
+  for (const auto& copy : res.value().copies) {
+    BT_EXPECT_EQ(copy_total(copy), 64 * 1024ull);
+    for (const auto& s : copy.shards) {
+      all_pools.insert(s.pool_id);
+      ++shard_count;
+    }
+  }
+  BT_EXPECT_EQ(all_pools.size(), shard_count);  // still pool-disjoint
+}
+
+BTEST(RangeAllocator, DisjointWorkerLayoutFallsBackOnUnevenSpace) {
+  // Worker-1's pools are too small to hold a whole copy; the partitioned
+  // layout cannot fit, so the allocator falls back to co-location on
+  // worker-0 rather than failing the put.
+  RangeAllocator ra;
+  PoolMap pools;
+  for (int p = 0; p < 4; ++p) {
+    auto id = "big-pool-" + std::to_string(p);
+    pools[id] = make_pool(id, "worker-big", 1 << 20);
+  }
+  pools["small-pool"] = make_pool("small-pool", "worker-small", 4 * 1024);
+  auto res = ra.allocate(make_request("obj", 64 * 1024, 2, 2), pools);
+  BT_ASSERT_OK(res);
+  BT_ASSERT(res.value().copies.size() == 2);
+  for (const auto& copy : res.value().copies) {
+    BT_EXPECT_EQ(copy_total(copy), 64 * 1024ull);
+  }
+}
+
 BTEST(RangeAllocator, CopyIndicesAreSequential) {
   RangeAllocator ra;
   auto pools = six_pools();

@@ -290,6 +290,10 @@ class KeystoneService {
   // coordinator cannot busy-spin the loop.
   std::atomic<bool> recampaign_asap_{false};
   std::atomic<uint32_t> promotion_refusals_{0};  // streak; reset on success
+  // Set by fence_stepdown(): on_demoted() must run (drop this node's own
+  // never-persisted pending objects), but the fenced op's caller holds
+  // objects_mutex_, so the cleanup is deferred to the keepalive thread.
+  std::atomic<bool> pending_demote_cleanup_{false};
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
   std::atomic<uint64_t> leader_epoch_{0};  // fencing token from promotion

@@ -637,6 +637,10 @@ void KeystoneService::fence_stepdown() {
       std::lock_guard<std::mutex> lock(stop_mutex_);
       needs_recampaign_ = true;
       recampaign_asap_ = true;
+      // on_demoted() cannot run here: the fenced op's caller holds
+      // objects_mutex_ and on_demoted takes it. The keepalive thread runs
+      // the cleanup before its next campaign step.
+      pending_demote_cleanup_ = true;
     }
     stop_cv_.notify_all();
   }
@@ -903,6 +907,10 @@ void KeystoneService::keepalive_loop() {
                                    config_.service_registration_ttl_sec * 1000);
     if (config_.enable_ha) {
       recampaign_asap_ = false;
+      // Deferred demotion cleanup from fence_stepdown (see the flag's
+      // declaration): drop our never-persisted pending objects before
+      // rejoining the election, as every other demotion path does.
+      if (pending_demote_cleanup_.exchange(false)) on_demoted();
       if (needs_recampaign_.exchange(false)) {
         // A refused promotion left us server-side leader with is_leader_
         // false: step out and rejoin at the back of the queue. Retried
@@ -971,6 +979,9 @@ void KeystoneService::run_gc_once() {
     const auto recheck = std::chrono::steady_clock::now();
     const bool stale_pending = pending_stale(it->second, recheck);
     if (!it->second.expired(recheck) && !stale_pending) continue;
+    // Fence-first: a deposed/offline keystone must not free worker ranges
+    // the promoted leader's record still references; retry next GC pass.
+    if (unpersist_object(key) != ErrorCode::OK) continue;
     free_object_locked(key, it->second);
     objects_.erase(it);
     if (stale_pending) {
@@ -980,7 +991,6 @@ void KeystoneService::run_gc_once() {
       ++counters_.gc_collected;
       LOG_DEBUG << "gc collected expired object " << key;
     }
-    unpersist_object(key);
     bump_view();
   }
 }
@@ -1107,9 +1117,11 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
   }
   it->second.state = ObjectState::kComplete;
   it->second.last_access = std::chrono::steady_clock::now();
-  if (auto ec = persist_object(key, it->second); ec == ErrorCode::FENCED) {
-    // Commit point, fail closed: the durable record never landed, so the
-    // object must not read back as complete from this (deposed) node.
+  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
+    // Commit point, fail closed on ANY persist failure (fence OR coordinator
+    // outage): the durable record never landed, so the object must not ack —
+    // and never read back — as complete from this node. The client retries;
+    // its exactly-once replay makes the retry safe.
     it->second.state = ObjectState::kPending;
     return ec;
   }
@@ -1122,10 +1134,13 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  // Deletes fence FIRST: destroying worker ranges and only then discovering
+  // the durable delete is rejected (deposed leader) would ack a removal the
+  // promoted leader still lists — its metadata would point at freed bytes.
+  if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.put_cancels;
-  unpersist_object(key);
   bump_view();
   return ErrorCode::OK;
 }
@@ -1135,10 +1150,11 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  // Same fence-first ordering as put_cancel (see comment there).
+  if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.removes;
-  unpersist_object(key);
   bump_view();
   return ErrorCode::OK;
 }
@@ -1146,12 +1162,18 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
 Result<uint64_t> KeystoneService::remove_all_objects() {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
-  const uint64_t count = objects_.size();
-  for (auto& [key, info] : objects_) {
-    free_object_locked(key, info);
-    unpersist_object(key);
+  uint64_t count = 0;
+  for (auto it = objects_.begin(); it != objects_.end();) {
+    // Fence-first per object; a failed durable delete keeps the object (the
+    // caller sees a partial count and can retry).
+    if (unpersist_object(it->first) != ErrorCode::OK) {
+      ++it;
+      continue;
+    }
+    free_object_locked(it->first, it->second);
+    it = objects_.erase(it);
+    ++count;
   }
-  objects_.clear();
   counters_.removes += count;
   bump_view();
   return count;
@@ -1690,22 +1712,41 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         const ObjectKey key = it->first;
         size_t dead = 0;
         for (const auto& shard : copy.shards) {
-          if (shard.worker_id == worker_id)
-            adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
           if (!live_workers.contains(shard.worker_id)) ++dead;
         }
+        auto drop_dead_worker_bookkeeping = [&] {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id)
+              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          }
+        };
         if (dead > copy.ec_parity_shards) {
           LOG_WARN << "coded object " << key << " lost " << dead << " shards (tolerance "
                    << copy.ec_parity_shards << ") with worker " << worker_id;
+          // Fence-first: a deposed leader must not free the survivors'
+          // ranges; the promoted leader owns the loss accounting.
+          if (unpersist_object(key) != ErrorCode::OK) {
+            ++it;
+            continue;
+          }
+          drop_dead_worker_bookkeeping();
           adapter_.free_object(key);
-          unpersist_object(key);
           it = objects_.erase(it);
           ++counters_.objects_lost;
           bump_view();
           continue;
         }
+        // Persist the bumped epoch BEFORE touching allocator state: a
+        // rejected durable write (deposed leader / coordinator outage)
+        // leaves the object exactly as the durable record describes it.
+        const uint64_t prev_epoch = info.epoch;
         info.epoch = next_epoch_.fetch_add(1);
-        persist_object(key, info);
+        if (persist_object(key, info) != ErrorCode::OK) {
+          info.epoch = prev_epoch;
+          ++it;
+          continue;
+        }
+        drop_dead_worker_bookkeeping();
         bump_view();
         if (info.state == ObjectState::kComplete) {
           // Queue reconstruction of EVERY dead shard (including ones from
@@ -1734,12 +1775,44 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         continue;
       }
       const ObjectKey key = it->first;
+      if (surviving.empty()) {
+        LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
+        // Fence-first, as in the coded branch above.
+        if (unpersist_object(key) != ErrorCode::OK) {
+          ++it;
+          continue;
+        }
+        // Dead-worker shards lose only their bookkeeping (a later free of
+        // ranges on a re-registered pool would corrupt the fresh free-map).
+        for (const auto& copy : info.copies) {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id)
+              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          }
+        }
+        adapter_.free_object(key);
+        it = objects_.erase(it);
+        ++counters_.objects_lost;
+        bump_view();
+        continue;
+      }
+      // Make the pruned state durable BEFORE releasing any ranges: if the
+      // durable write is rejected (deposed leader / coordinator outage),
+      // this node must not hand ranges the durable record — and therefore
+      // the promoted leader — still maps back to the pools.
+      ObjectInfo updated = info;
+      updated.copies = surviving;
+      for (size_t i = 0; i < updated.copies.size(); ++i) updated.copies[i].copy_index = i;
+      updated.epoch = next_epoch_.fetch_add(1);
+      if (persist_object(key, updated) != ErrorCode::OK) {
+        ++it;
+        continue;
+      }
       // Every damaged copy is dropped whole, so release all its ranges now:
-      // dead-worker shards lose only their bookkeeping (a later free of
-      // ranges on a re-registered pool would corrupt the fresh free-map),
-      // while live-worker shards of a partially-damaged striped copy hand
-      // their bytes back to the pool — otherwise worker churn slowly fills
-      // the surviving pools with orphaned, unreadable ranges.
+      // dead-worker shards lose only their bookkeeping (see above), while
+      // live-worker shards of a partially-damaged striped copy hand their
+      // bytes back to the pool — otherwise worker churn slowly fills the
+      // surviving pools with orphaned, unreadable ranges.
       for (const auto& copy : info.copies) {
         if (!damaged(copy)) continue;
         for (const auto& shard : copy.shards) {
@@ -1750,22 +1823,10 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           }
         }
       }
-      if (surviving.empty()) {
-        LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
-        adapter_.free_object(key);
-        unpersist_object(key);
-        it = objects_.erase(it);
-        ++counters_.objects_lost;
-        bump_view();
-        continue;
-      }
-      info.copies = surviving;
-      for (size_t i = 0; i < info.copies.size(); ++i) info.copies[i].copy_index = i;
-      info.epoch = next_epoch_.fetch_add(1);
+      info = std::move(updated);
       const size_t needed = info.config.replication_factor > surviving.size()
                                 ? info.config.replication_factor - surviving.size()
                                 : 0;
-      persist_object(key, info);
       bump_view();
       if (needed > 0 && info.state == ObjectState::kComplete) {
         pending.push_back(
@@ -1780,6 +1841,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // staging allocation into the object atomically iff its epoch is unchanged.
   size_t repaired = 0;
   for (auto& p : pending) {
+    if (!is_leader_.load()) break;  // deposed mid-repair: stop streaming
     const ObjectKey staging_key = p.key + "\x01" "repair";
     alloc::AllocationRequest req =
         alloc::KeystoneAllocatorAdapter::to_allocation_request(staging_key, p.size, p.config);
@@ -1841,7 +1903,16 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       it->second.copies.push_back(std::move(copy));
     }
     it->second.epoch = next_epoch_.fetch_add(1);
-    persist_object(p.key, it->second);
+    if (auto ec = persist_object(p.key, it->second); ec != ErrorCode::OK) {
+      // The merge already landed locally (memory + allocator are consistent)
+      // but the durable record is stale. A coordinator outage heals at this
+      // key's next successful persist; a fence means this node is deposed
+      // and the promoted leader's reconcile-on-promotion owns the truth.
+      // Either way the repair cannot be claimed.
+      LOG_ERROR << "repair of " << p.key << " not durably recorded: " << to_string(ec);
+      bump_view();
+      continue;
+    }
     ++counters_.objects_repaired;
     ++repaired;
     bump_view();
@@ -1853,6 +1924,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // objects never heal — losses accumulate across deaths until tolerance
   // is exceeded and a recoverable object dies.
   for (auto& r : ec_pending) {
+    if (!is_leader_.load()) break;  // deposed mid-repair: stop streaming
     if (repair_ec_object(r.key, r.epoch, r.copy, r.dead_idx, target_pools)) {
       ++counters_.objects_repaired;
       ++repaired;
@@ -2212,10 +2284,11 @@ void KeystoneService::evict_for_pressure() {
       std::unique_lock lock(objects_mutex_);
       auto it = objects_.find(key);
       if (it == objects_.end()) continue;
+      // Fence-first (see gc): never free ranges a promoted leader still maps.
+      if (unpersist_object(key) != ErrorCode::OK) continue;
       free_object_locked(key, it->second);
       objects_.erase(it);
       ++counters_.evicted;
-      unpersist_object(key);
       bump_view();
       LOG_INFO << "evicted object " << key << " for tier pressure";
     }

@@ -304,6 +304,11 @@ class KeystoneService {
   std::vector<coord::WatchId> watch_ids_;
   KeystoneCounters counters_;
   std::unordered_set<NodeId> draining_;  // guarded by registry_mutex_
+  // Dead workers whose repair pass could not finish (coordinator outage or
+  // deposition mid-pass): the health loop re-runs repair for them — the
+  // death event itself fires only once per worker.
+  std::mutex repair_retry_mutex_;
+  std::unordered_set<NodeId> repair_retry_;
   std::mutex drain_mutex_;               // serializes drain_worker per service
   std::string service_id_;
 };

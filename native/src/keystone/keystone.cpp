@@ -998,6 +998,21 @@ void KeystoneService::run_gc_once() {
 void KeystoneService::run_health_check_once() {
   if (!is_leader_.load()) return;  // the leader owns eviction/demotion/repair
   cleanup_stale_workers();
+  if (config_.enable_repair) {
+    // Finish repair passes that a coordinator outage or deposition cut
+    // short (see repair_retry_): the death event only fires once.
+    std::vector<NodeId> retry;
+    {
+      std::lock_guard<std::mutex> lock(repair_retry_mutex_);
+      retry.assign(repair_retry_.begin(), repair_retry_.end());
+    }
+    for (const auto& id : retry) {
+      LOG_INFO << "retrying deferred repair for dead worker " << id;
+      if (const size_t repaired = repair_objects_for_dead_worker(id)) {
+        LOG_INFO << "deferred repair recovered " << repaired << " objects of " << id;
+      }
+    }
+  }
   evict_for_pressure();
 }
 
@@ -1164,6 +1179,10 @@ Result<uint64_t> KeystoneService::remove_all_objects() {
   std::unique_lock lock(objects_mutex_);
   uint64_t count = 0;
   for (auto it = objects_.begin(); it != objects_.end();) {
+    // Once deposed (first FENCED stepped us down) every further RPC is
+    // doomed — bail instead of round-tripping once per remaining object
+    // while holding the exclusive objects lock.
+    if (!is_leader_.load()) break;
     // Fence-first per object; a failed durable delete keeps the object (the
     // caller sees a partial count and can retry).
     if (unpersist_object(it->first) != ErrorCode::OK) {
@@ -1689,9 +1708,18 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   }
 
   std::vector<PendingRepair> pending;
+  // Any durable write that fails mid-pass defers the rest of this worker's
+  // repair to the health loop (repair_retry_): the death event fires once,
+  // so without the retry a transient coordinator outage would strand
+  // objects with dead placements forever.
+  bool deferred = false;
   {
     std::unique_lock lock(objects_mutex_);
     for (auto it = objects_.begin(); it != objects_.end();) {
+      if (!is_leader_.load()) {  // deposed mid-pass: stop issuing doomed RPCs
+        deferred = true;
+        break;
+      }
       ObjectInfo& info = it->second;
       auto damaged = [&](const CopyPlacement& copy) {
         return std::any_of(copy.shards.begin(), copy.shards.end(),
@@ -1726,6 +1754,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           // Fence-first: a deposed leader must not free the survivors'
           // ranges; the promoted leader owns the loss accounting.
           if (unpersist_object(key) != ErrorCode::OK) {
+            deferred = true;
             ++it;
             continue;
           }
@@ -1743,6 +1772,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         info.epoch = next_epoch_.fetch_add(1);
         if (persist_object(key, info) != ErrorCode::OK) {
           info.epoch = prev_epoch;
+          deferred = true;
           ++it;
           continue;
         }
@@ -1779,6 +1809,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
         // Fence-first, as in the coded branch above.
         if (unpersist_object(key) != ErrorCode::OK) {
+          deferred = true;
           ++it;
           continue;
         }
@@ -1805,6 +1836,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       for (size_t i = 0; i < updated.copies.size(); ++i) updated.copies[i].copy_index = i;
       updated.epoch = next_epoch_.fetch_add(1);
       if (persist_object(key, updated) != ErrorCode::OK) {
+        deferred = true;
         ++it;
         continue;
       }
@@ -1841,7 +1873,10 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // staging allocation into the object atomically iff its epoch is unchanged.
   size_t repaired = 0;
   for (auto& p : pending) {
-    if (!is_leader_.load()) break;  // deposed mid-repair: stop streaming
+    if (!is_leader_.load()) {  // deposed mid-repair: stop streaming
+      deferred = true;
+      break;
+    }
     const ObjectKey staging_key = p.key + "\x01" "repair";
     alloc::AllocationRequest req =
         alloc::KeystoneAllocatorAdapter::to_allocation_request(staging_key, p.size, p.config);
@@ -1878,7 +1913,8 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     }
     if (!streamed_src) {
       adapter_.free_object(staging_key);
-      continue;  // survivors still serve reads; retry on a later event
+      deferred = true;  // survivors still serve reads; health loop retries
+      continue;
     }
 
     std::unique_lock lock(objects_mutex_);
@@ -1892,6 +1928,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       lock.unlock();
       LOG_ERROR << "repair merge failed for " << p.key;
       adapter_.free_object(staging_key);
+      deferred = true;
       continue;
     }
     for (auto& copy : staged) {
@@ -1911,6 +1948,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       // Either way the repair cannot be claimed.
       LOG_ERROR << "repair of " << p.key << " not durably recorded: " << to_string(ec);
       bump_view();
+      deferred = true;
       continue;
     }
     ++counters_.objects_repaired;
@@ -1924,10 +1962,21 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // objects never heal — losses accumulate across deaths until tolerance
   // is exceeded and a recoverable object dies.
   for (auto& r : ec_pending) {
-    if (!is_leader_.load()) break;  // deposed mid-repair: stop streaming
+    if (!is_leader_.load()) {  // deposed mid-repair: stop streaming
+      deferred = true;
+      break;
+    }
     if (repair_ec_object(r.key, r.epoch, r.copy, r.dead_idx, target_pools)) {
       ++counters_.objects_repaired;
       ++repaired;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lock(repair_retry_mutex_);
+    if (deferred) {
+      repair_retry_.insert(worker_id);
+    } else {
+      repair_retry_.erase(worker_id);
     }
   }
   return repaired;

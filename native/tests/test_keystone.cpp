@@ -938,6 +938,10 @@ BTEST(Keystone, FencedPersistStepsDownStaleLeader) {
   cfg.enable_ha = true;
   cfg.service_registration_ttl_sec = 1;      // candidacy lease: 1s
   cfg.service_refresh_interval_sec = 3600;   // keepalive: effectively never
+  // This test deliberately idles for seconds; the 1s fast_config heartbeat
+  // TTL would let the health loop reap w1 (and repair-delete fence/obj)
+  // mid-test. Worker liveness is not what is under test here.
+  cfg.worker_heartbeat_ttl_sec = 3600;
   KeystoneService ks(cfg, coordinator);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   BT_ASSERT(ks.start() == ErrorCode::OK);

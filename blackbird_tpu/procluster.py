@@ -1,0 +1,241 @@
+"""Multi-controller process cluster: the production multi-host shape.
+
+A real TPU pod is many hosts, each running ONE process that owns that
+host's chips (jax.distributed); device-tier objects must be served,
+striped, and repaired ACROSS those processes. This launcher brings up that
+shape on one machine: a coordinator (`bb-coord`), a keystone
+(`bb-keystone`), and N `python -m blackbird_tpu.worker` processes, each
+owning a disjoint set of JAX devices (virtual CPU devices by default, so
+the multi-controller data plane is testable without a pod; on real
+hardware pass ``virtual_devices=False`` and let each process see its own
+chips).
+
+Every worker advertises one HBM pool per device; placement stripes
+objects across the processes' device pools, replicas land on disjoint
+worker processes (failure domains), and when a process dies the keystone
+re-replicates from the surviving process across the process boundary —
+the DCN-style repair lane.
+
+Role parity: the reference's multi-host bring-up is one worker_service
+process per host registered through etcd (reference
+examples/worker_example.cpp, src/worker/worker_service.cpp:236-297); it
+ships only a manual shell script for this. This launcher is the tested
+equivalent, used by tests/test_multiprocess_cluster.py, the driver's
+dryrun (`__graft_entry__.dryrun_multichip`), and local ops drills.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BUILD_DIR = REPO_ROOT / "build"
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _port_open(port: int) -> bool:
+    with socket.socket() as sock:
+        sock.settimeout(0.2)
+        return sock.connect_ex(("127.0.0.1", port)) == 0
+
+
+class ProcessCluster:
+    """Coordinator + keystone + N device-owning worker processes."""
+
+    def __init__(
+        self,
+        workers: int = 2,
+        devices_per_worker: int = 4,
+        pool_mb: int = 8,
+        *,
+        dram_pool_mb: int = 0,
+        virtual_devices: bool = True,
+        workdir: str | None = None,
+        heartbeat_ttl_ms: int = 2000,
+    ):
+        self.n_workers = workers
+        self.devices_per_worker = devices_per_worker
+        self._procs: list[tuple[str, subprocess.Popen]] = []
+        self.worker_procs: list[subprocess.Popen] = []
+        self._tmp = None
+        if workdir is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="btpu_procluster_")
+            workdir = self._tmp.name
+        self.workdir = Path(workdir)
+        self.coord_port = free_port()
+        self.keystone_port = free_port()
+        self.metrics_port = free_port()
+
+        keystone_cfg = self.workdir / "keystone.yaml"
+        keystone_cfg.write_text(
+            f"""cluster_id: procluster
+coord_endpoints: 127.0.0.1:{self.coord_port}
+listen_address: 127.0.0.1:{self.keystone_port}
+http_metrics_port: "{self.metrics_port}"
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: {max(1, heartbeat_ttl_ms // 1000)}
+""")
+
+        try:
+            self._spawn([str(BUILD_DIR / "bb-coord"), "--host", "127.0.0.1",
+                         "--port", str(self.coord_port)], "coord")
+            self._wait(lambda: _port_open(self.coord_port), 15, "bb-coord")
+            self._spawn([str(BUILD_DIR / "bb-keystone"), "--config",
+                         str(keystone_cfg)], "keystone")
+            self._wait(lambda: _port_open(self.keystone_port), 15, "bb-keystone")
+            for i in range(workers):
+                cfg = self._worker_config(i, pool_mb, dram_pool_mb, heartbeat_ttl_ms)
+                env = dict(os.environ)
+                env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+                if virtual_devices:
+                    # Each process owns its OWN disjoint virtual device set —
+                    # overriding any ambient mesh-wide flags from the parent.
+                    env["JAX_PLATFORMS"] = "cpu"
+                    env["XLA_FLAGS"] = (
+                        f"--xla_force_host_platform_device_count={devices_per_worker}")
+                proc = self._spawn(
+                    [sys.executable, "-m", "blackbird_tpu.worker", "--config", str(cfg)],
+                    f"worker-{i}", env=env)
+                self.worker_procs.append(proc)
+        except Exception:
+            self.close()
+            raise
+
+    def _worker_config(self, index: int, pool_mb: int, dram_pool_mb: int,
+                       heartbeat_ttl_ms: int) -> Path:
+        pools = []
+        for d in range(self.devices_per_worker):
+            pools.append(
+                f"""  - id: mc-{index}-hbm-{d}
+    storage_class: hbm_tpu
+    capacity: {pool_mb}MB
+    device_id: tpu:{d}
+""")
+        if dram_pool_mb:
+            pools.append(
+                f"""  - id: mc-{index}-dram
+    storage_class: ram_cpu
+    capacity: {dram_pool_mb}MB
+""")
+        path = self.workdir / f"worker-{index}.yaml"
+        path.write_text(
+            f"""worker_id: mc-{index}
+cluster_id: procluster
+coord_endpoints: 127.0.0.1:{self.coord_port}
+transport: tcp
+listen_host: 127.0.0.1
+host_id: {index}
+heartbeat:
+  interval_ms: 300
+  ttl_ms: {heartbeat_ttl_ms}
+pools:
+{"".join(pools)}""")
+        return path
+
+    def _spawn(self, args: list[str], name: str, env: dict | None = None):
+        # Output goes to a file, never a pipe: a long-lived chatty worker
+        # (XLA warnings + logging) would fill a 64 KiB pipe buffer, block on
+        # its next write, stop heartbeating, and wedge the cluster with
+        # spurious repair.
+        log = open(self.workdir / f"{name}.log", "w")
+        try:
+            proc = subprocess.Popen(
+                args, cwd=REPO_ROOT, env=env, stdout=log, stderr=subprocess.STDOUT,
+                text=True)
+        finally:
+            log.close()  # the child holds its own fd now
+        self._procs.append((name, proc))
+        return proc
+
+    def process_log(self, name: str, tail: int = 2000) -> str:
+        path = self.workdir / f"{name}.log"
+        return path.read_text()[-tail:] if path.exists() else ""
+
+    @staticmethod
+    def _wait(predicate, timeout: float, what: str) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if predicate():
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    # -- cluster interaction -------------------------------------------------
+
+    def client(self):
+        from blackbird_tpu.client import Client
+
+        return Client(f"127.0.0.1:{self.keystone_port}")
+
+    def wait_ready(self, timeout: float = 300.0):
+        """Blocks until every worker process registered all its pools.
+
+        Generous by default: each worker pays a cold JAX import (+ jit
+        warmup on first writes) and CI boxes may be single-core.
+        """
+        client = self.client()
+        expected_pools = self.n_workers * self.devices_per_worker + sum(
+            1 for i in range(self.n_workers)
+            if "dram" in (self.workdir / f"worker-{i}.yaml").read_text()
+        )
+
+        def ready():
+            for name, proc in self._procs:
+                if name.startswith("worker") and proc.poll() is not None:
+                    raise RuntimeError(
+                        f"{name} exited early:\n{self.process_log(name)}")
+            stats = client.stats()
+            return (stats["workers"] == self.n_workers
+                    and stats["pools"] >= expected_pools)
+
+        self._wait(ready, timeout, f"{self.n_workers} workers / {expected_pools} pools")
+        return client
+
+    def kill_worker(self, index: int) -> None:
+        """SIGKILL one worker process: a host crash, not a drain."""
+        self.worker_procs[index].kill()
+
+    def metrics(self) -> str:
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{self.metrics_port}/metrics", timeout=5
+        ).read().decode()
+
+    def objects_repaired(self) -> int:
+        for line in self.metrics().splitlines():
+            if line.startswith("btpu_objects_repaired_total"):
+                return int(line.split()[-1])
+        return 0
+
+    def close(self) -> None:
+        for name, proc in reversed(self._procs):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for name, proc in self._procs:
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._procs.clear()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+            self._tmp = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

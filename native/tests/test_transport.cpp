@@ -106,6 +106,61 @@ BTEST(Transport, TcpRoundtrip) {
   run_roundtrip_suite(*server, *client);
 }
 
+BTEST(Transport, TcpStagedLaneEngagesSameHost) {
+  // Same-host TCP rides the shm-staged lane: payloads move through the
+  // client-created segment, only headers cross the socket — including for
+  // VIRTUAL regions, whose callbacks target the shared segment directly
+  // (the out-of-process device-tier data path).
+  auto server = make_transport_server(TransportKind::TCP);
+  auto client = make_transport_client();
+  BT_ASSERT(server && client);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+
+  std::vector<uint8_t> flat_region(256 * 1024, 0);
+  auto flat = server->register_region(flat_region.data(), flat_region.size(), "flat");
+  BT_ASSERT_OK(flat);
+
+  std::vector<uint8_t> store(256 * 1024, 0);  // backing for a virtual region
+  auto virt = server->register_virtual_region(
+      store.size(), "virt",
+      [&](uint64_t off, void* dst, uint64_t len) {
+        std::memcpy(dst, store.data() + off, len);
+        return ErrorCode::OK;
+      },
+      [&](uint64_t off, const void* src, uint64_t len) {
+        std::memcpy(store.data() + off, src, len);
+        return ErrorCode::OK;
+      });
+  BT_ASSERT_OK(virt);
+
+  const uint64_t staged_before = tcp_staged_op_count();
+  std::vector<uint8_t> payload(100 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 31);
+  std::vector<uint8_t> back(payload.size(), 0);
+
+  for (const auto& desc : {flat.value(), virt.value()}) {
+    const uint64_t rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+    BT_EXPECT(client->write(desc, desc.remote_base + 512, rkey, payload.data(),
+                            payload.size()) == ErrorCode::OK);
+    std::fill(back.begin(), back.end(), 0);
+    BT_EXPECT(client->read(desc, desc.remote_base + 512, rkey, back.data(),
+                           back.size()) == ErrorCode::OK);
+    BT_EXPECT(std::memcmp(payload.data(), back.data(), payload.size()) == 0);
+  }
+  // Both regions' bytes really are in place server-side.
+  BT_EXPECT(std::memcmp(flat_region.data() + 512, payload.data(), payload.size()) == 0);
+  BT_EXPECT(std::memcmp(store.data() + 512, payload.data(), payload.size()) == 0);
+  // All four ops (2 writes + 2 reads) used the staged lane.
+  BT_EXPECT(tcp_staged_op_count() >= staged_before + 4);
+
+  // Bounds violations fail cleanly over the staged lane too.
+  const auto& desc = flat.value();
+  const uint64_t rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+  BT_EXPECT(client->read(desc, desc.remote_base + flat_region.size() - 8, rkey,
+                         back.data(), 64) == ErrorCode::MEMORY_ACCESS_ERROR);
+  server->stop();
+}
+
 BTEST(Transport, ShmRoundtrip) {
   auto server = make_transport_server(TransportKind::SHM);
   auto client = make_transport_client();

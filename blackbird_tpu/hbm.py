@@ -270,9 +270,132 @@ class JaxHbmProvider:
             buf = entry["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
         return buf[:rows]
 
+    # -- aligned fast path -------------------------------------------------
+
+    def _aligned_runs(self, vecs, *, check_overlap: bool):
+        """Groups whole-page-aligned vecs as (page0, n_pages, host_view) runs.
+
+        Returns (regions, {region_id: [runs]}) when EVERY vec is page-aligned
+        (allocator HBM placements are chunk-aligned, so real put/get batches
+        always are) — or None to route through the generic span machinery.
+        Writes also require non-overlapping runs per region (scatter order
+        for duplicate pages is undefined)."""
+        P = self.page_bytes
+        with self._lock:
+            regions = dict(self._regions)
+        per_region: dict[int, list] = {}
+        for region_id, offset, buf, length in vecs:
+            if length == 0:
+                continue
+            if offset % P or length % P:
+                return None
+            region = regions.get(region_id)
+            if region is None or offset + length > region["size"]:
+                raise ValueError("bad region/range")
+            host = np.ctypeslib.as_array(
+                ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), shape=(length,))
+            per_region.setdefault(region_id, []).append((offset // P, length // P, host))
+        if check_overlap:
+            for runs in per_region.values():
+                ordered = sorted(r[:2] for r in runs)
+                last_end = -1
+                for p0, n in ordered:
+                    if p0 < last_end:
+                        return None
+                    last_end = p0 + n
+        return regions, per_region
+
+    def _write_vecs_aligned(self, regions, per_region) -> None:
+        """Whole-page batch write: one BULK staging copy per run (the
+        generic span path below fills page by page in Python — on 64x1MiB
+        batches that loop cost more than the copy itself), then the same
+        one-device_put-one-scatter-per-region dispatch as the generic path.
+
+        The staging buffer (not the caller's memory) feeds device_put: the
+        write ABI promises sources may be reused the moment the call
+        returns, and on the CPU backend device_put zero-copy ALIASES host
+        memory until the merge kernel runs — aliasing caller buffers here
+        would corrupt in-flight writes (see _staging_for).
+
+        Rounds bound the staging footprint the same way the generic cap
+        does."""
+        P = self.page_bytes
+        cap = max(1, self.max_staging_bytes // P)
+        round_pr: dict[int, list] = {}
+        count = 0
+
+        def flush_round():
+            nonlocal round_pr, count
+            if round_pr:
+                self._write_aligned_round(regions, round_pr)
+            round_pr, count = {}, 0
+
+        for region_id, runs in per_region.items():
+            for p0, n, host in runs:
+                pos = 0
+                while pos < n:
+                    take = min(n - pos, cap - count)
+                    if take == 0:
+                        flush_round()
+                        continue
+                    round_pr.setdefault(region_id, []).append(
+                        (p0 + pos, take, host[pos * P : (pos + take) * P]))
+                    count += take
+                    pos += take
+        flush_round()
+
+    def _write_aligned_round(self, regions, per_region) -> None:
+        jax = self._jax
+        P = self.page_bytes
+        by_device: dict = {}
+        for region_id, runs in per_region.items():
+            by_device.setdefault(regions[region_id]["device"], []).append(
+                (region_id, runs))
+        for dev, entries in by_device.items():
+            layouts = []  # (region_id, start_row, m_padded, runs)
+            total_rows = 0
+            for region_id, runs in entries:
+                m_padded = _pow2_at_least(sum(n for _p0, n, _h in runs))
+                layouts.append((region_id, total_rows, m_padded, runs))
+                total_rows += m_padded
+            entry = self._staging_entry(dev)
+            with entry["lock"]:
+                flat = self._staging_for(entry, total_rows, P)
+                meta = np.zeros((3, total_rows), dtype=np.int32)
+                for region_id, start, m_padded, runs in layouts:
+                    # Padding rows carry an out-of-bounds page index so the
+                    # scatter drops them (mode='drop').
+                    meta[0, start : start + m_padded] = regions[region_id]["n_pages"]
+                    row = start
+                    for p0, n, host in runs:
+                        meta[0, row : row + n] = np.arange(p0, p0 + n, dtype=np.int32)
+                        meta[2, row : row + n] = P  # full pages: v0=0, v1=P
+                        flat[row : row + n] = host.reshape(n, P)  # ONE copy per run
+                        row += n
+                dev_flat = jax.device_put(flat, dev)
+                dev_meta = jax.device_put(meta, dev)
+                for region_id, start, m_padded, _runs in layouts:
+                    region = regions[region_id]
+                    if len(layouts) == 1:
+                        pages, pmeta = dev_flat, dev_meta  # no slicing dispatches
+                    else:
+                        pages = jax.lax.dynamic_slice_in_dim(dev_flat, start, m_padded,
+                                                             axis=0)
+                        pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
+                    with region["lock"]:
+                        region["buf"] = self._write_fn(region["buf"], pages, pmeta)
+                        entry["fences"].append(self._fence_fn(region["buf"]))
+                    with self._lock:
+                        if region_id in self._regions:
+                            self._dirty.add(region_id)
+
     # -- batched write -----------------------------------------------------
 
     def _write_vecs(self, vecs):
+        aligned = self._aligned_runs(vecs, check_overlap=True)
+        if aligned is not None:
+            self._write_vecs_aligned(*aligned)
+            return
         jax = self._jax
         P = self.page_bytes
         regions, grouped = self._decompose(vecs)
@@ -369,7 +492,40 @@ class JaxHbmProvider:
 
     # -- batched read ------------------------------------------------------
 
+    def _read_vecs_aligned(self, regions, per_region) -> None:
+        """Whole-page batch read: one gather dispatch per region, async D2H,
+        then ONE vectorized copy per destination buffer (the generic span
+        path below scatters page by page in Python)."""
+        jax = self._jax
+        P = self.page_bytes
+        fetches = []  # (out device array, runs)
+        for region_id, runs in per_region.items():
+            region = regions[region_id]
+            total = sum(n for _p0, n, _h in runs)
+            m_padded = _pow2_at_least(total)
+            idx = np.zeros(m_padded, dtype=np.int32)
+            row = 0
+            for p0, n, _h in runs:
+                idx[row : row + n] = np.arange(p0, p0 + n, dtype=np.int32)
+                row += n
+            with region["lock"]:
+                out = self._read_fn(region["buf"], jax.device_put(idx, region["device"]))
+            fetches.append((out, runs))
+        for out, _runs in fetches:
+            if hasattr(out, "copy_to_host_async"):
+                out.copy_to_host_async()
+        for out, runs in fetches:
+            host = np.asarray(out)
+            row = 0
+            for _p0, n, dst in runs:
+                dst[:] = host[row : row + n].reshape(-1)
+                row += n
+
     def _read_vecs(self, vecs):
+        aligned = self._aligned_runs(vecs, check_overlap=False)
+        if aligned is not None:
+            self._read_vecs_aligned(*aligned)
+            return
         jax = self._jax
         regions, grouped = self._decompose(vecs)
         if not grouped:

@@ -68,6 +68,8 @@ class ProcessCluster:
     ):
         self.n_workers = workers
         self.devices_per_worker = devices_per_worker
+        self.expected_pools = workers * devices_per_worker + (
+            workers if dram_pool_mb else 0)
         self._procs: list[tuple[str, subprocess.Popen]] = []
         self.worker_procs: list[subprocess.Popen] = []
         self._tmp = None
@@ -101,15 +103,17 @@ worker_heartbeat_ttl_sec: {max(1, heartbeat_ttl_ms // 1000)}
                 cfg = self._worker_config(i, pool_mb, dram_pool_mb, heartbeat_ttl_ms)
                 env = dict(os.environ)
                 env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
-                if virtual_devices:
+                args = [sys.executable, "-m", "blackbird_tpu.worker",
+                        "--config", str(cfg)]
+                if devices_per_worker == 0:
+                    args.append("--no-jax")  # host tiers only: skip JAX entirely
+                elif virtual_devices:
                     # Each process owns its OWN disjoint virtual device set —
                     # overriding any ambient mesh-wide flags from the parent.
                     env["JAX_PLATFORMS"] = "cpu"
                     env["XLA_FLAGS"] = (
                         f"--xla_force_host_platform_device_count={devices_per_worker}")
-                proc = self._spawn(
-                    [sys.executable, "-m", "blackbird_tpu.worker", "--config", str(cfg)],
-                    f"worker-{i}", env=env)
+                proc = self._spawn(args, f"worker-{i}", env=env)
                 self.worker_procs.append(proc)
         except Exception:
             self.close()
@@ -188,10 +192,7 @@ pools:
         warmup on first writes) and CI boxes may be single-core.
         """
         client = self.client()
-        expected_pools = self.n_workers * self.devices_per_worker + sum(
-            1 for i in range(self.n_workers)
-            if "dram" in (self.workdir / f"worker-{i}.yaml").read_text()
-        )
+        expected_pools = self.expected_pools
 
         def ready():
             for name, proc in self._procs:

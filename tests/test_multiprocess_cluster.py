@@ -19,10 +19,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 BUILD = REPO_ROOT / "build"
 
 
-def free_port() -> int:
-    with socket.socket() as sock:
-        sock.bind(("127.0.0.1", 0))
-        return sock.getsockname()[1]
+from blackbird_tpu.procluster import free_port  # shared with the launcher
 
 
 def wait_for(predicate, timeout=10.0, what="condition"):

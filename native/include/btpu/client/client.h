@@ -16,8 +16,12 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
+#include <functional>
 #include <memory>
+#include <mutex>
 #include <optional>
+#include <unordered_map>
 
 #include "btpu/keystone/keystone.h"
 #include "btpu/rpc/rpc_client.h"
@@ -43,6 +47,14 @@ struct ClientOptions {
   // For latency-critical paths that rely on background scrub instead; the
   // per-call `verify` overrides on get/get_into/get_many take precedence.
   bool verify_reads{true};
+  // Placement cache TTL for single-object VERIFIED reads (0 = off). Tiny
+  // objects are metadata-RPC-bound: a cached placement skips the keystone
+  // round trip, and staleness is safe because the content CRC catches any
+  // moved/rewritten bytes — on ANY failure through a cached placement the
+  // entry is dropped and the read retries with fresh metadata. Raw
+  // (verify=false) reads never use the cache: they could not detect stale
+  // bytes. Remote clients only; embedded metadata is already in-process.
+  uint32_t placement_cache_ms{1000};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).
@@ -158,6 +170,17 @@ class ObjectClient {
                           bool is_write, bool verify);
   ErrorCode shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write);
 
+  // Placement cache (see ClientOptions::placement_cache_ms). `from_cache`
+  // tells the caller whether a read failure should invalidate + refetch.
+  Result<std::vector<CopyPlacement>> get_workers_cached(const ObjectKey& key,
+                                                        bool& from_cache);
+  void cache_placements(const ObjectKey& key, const std::vector<CopyPlacement>& copies);
+  void invalidate_placements(const ObjectKey& key);
+  void invalidate_all_placements();
+  ErrorCode read_with_cache(
+      const ObjectKey& key, bool verify,
+      const std::function<ErrorCode(const std::vector<CopyPlacement>&)>& attempt);
+
   static ErrorCode error_of(ErrorCode ec) noexcept { return ec; }
   template <typename T>
   static ErrorCode error_of(const Result<T>& r) noexcept {
@@ -194,6 +217,13 @@ class ObjectClient {
   size_t keystone_index_{0};  // into [keystone_address] + keystone_fallbacks
   keystone::KeystoneService* embedded_{nullptr};
   std::unique_ptr<transport::TransportClient> data_;
+
+  struct PlacementCacheEntry {
+    std::vector<CopyPlacement> copies;
+    std::chrono::steady_clock::time_point fetched_at;
+  };
+  std::mutex placement_cache_mutex_;
+  std::unordered_map<ObjectKey, PlacementCacheEntry> placement_cache_;
 };
 
 }  // namespace btpu::client

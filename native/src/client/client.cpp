@@ -78,6 +78,76 @@ Result<std::vector<CopyPlacement>> ObjectClient::get_workers(const ObjectKey& ke
   return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.get_workers(key); });
 }
 
+// ---- placement cache (ClientOptions::placement_cache_ms) -------------------
+
+Result<std::vector<CopyPlacement>> ObjectClient::get_workers_cached(const ObjectKey& key,
+                                                                    bool& from_cache) {
+  from_cache = false;
+  if (options_.placement_cache_ms > 0 && !embedded_) {
+    const auto now = std::chrono::steady_clock::now();
+    std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+    auto it = placement_cache_.find(key);
+    if (it != placement_cache_.end()) {
+      if (now - it->second.fetched_at <=
+          std::chrono::milliseconds(options_.placement_cache_ms)) {
+        from_cache = true;
+        return it->second.copies;
+      }
+      placement_cache_.erase(it);
+    }
+  }
+  auto copies = get_workers(key);
+  if (copies.ok()) cache_placements(key, copies.value());
+  return copies;
+}
+
+void ObjectClient::cache_placements(const ObjectKey& key,
+                                    const std::vector<CopyPlacement>& copies) {
+  if (options_.placement_cache_ms == 0 || embedded_) return;
+  // Staleness detection rides the content CRC; an unstamped copy (legacy
+  // record) could serve stale bytes undetected, so it is never cached.
+  for (const auto& copy : copies) {
+    if (copy.content_crc == 0) return;
+  }
+  std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+  // Bounded: entries expire by TTL anyway, so a rare full reset under churn
+  // beats per-access LRU bookkeeping on the hot read path.
+  if (placement_cache_.size() >= 4096) placement_cache_.clear();
+  placement_cache_[key] = {copies, std::chrono::steady_clock::now()};
+}
+
+void ObjectClient::invalidate_placements(const ObjectKey& key) {
+  if (options_.placement_cache_ms == 0 || embedded_) return;
+  std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+  placement_cache_.erase(key);
+}
+
+void ObjectClient::invalidate_all_placements() {
+  if (options_.placement_cache_ms == 0 || embedded_) return;
+  std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+  placement_cache_.clear();
+}
+
+// Runs `attempt` against possibly-cached placements with ONE fresh-metadata
+// retry when every cached placement failed — the single home of the cache
+// discipline documented on ClientOptions::placement_cache_ms.
+ErrorCode ObjectClient::read_with_cache(
+    const ObjectKey& key, bool verify,
+    const std::function<ErrorCode(const std::vector<CopyPlacement>&)>& attempt) {
+  bool from_cache = false;
+  auto copies = verify ? get_workers_cached(key, from_cache) : get_workers(key);
+  if (!copies.ok()) return copies.error();
+  ErrorCode ec = attempt(copies.value());
+  if (ec == ErrorCode::OK || !from_cache) return ec;
+  // Cached placements failed (moved bytes, dead worker, size change):
+  // drop the entry and retry once with fresh metadata.
+  invalidate_placements(key);
+  from_cache = false;
+  copies = get_workers_cached(key, from_cache);
+  if (!copies.ok()) return copies.error();
+  return attempt(copies.value());
+}
+
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size) {
   return put(key, data, size, options_.default_config);
 }
@@ -97,61 +167,86 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
                                                std::optional<bool> verify) {
   TRACE_SPAN("client.get");
   const bool v = verify.value_or(verify_reads());
-  auto copies = get_workers(key);
-  if (!copies.ok()) return copies.error();
-  uint64_t size = 0;
-  if (!copies.value().empty()) size = copy_logical_size(copies.value().front());
-  std::vector<uint8_t> buffer(size);
-  if (try_split_read(copies.value(), buffer.data(), size, v) == ErrorCode::OK) return buffer;
-  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
-  for (const auto& copy : copies.value()) {
-    const uint64_t copy_size = copy_logical_size(copy);
-    if (copy_size != size) buffer.resize(copy_size);
-    if (auto ec = transfer_copy_get(copy, buffer.data(), copy_size, v); ec == ErrorCode::OK) {
-      return buffer;
-    } else {
-      // Corruption is the strongest signal — a later replica's transport
-      // error must not mask it (scrubbers key off CHECKSUM_MISMATCH).
-      if (last != ErrorCode::CHECKSUM_MISMATCH) last = ec;
-      LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
-               << to_string(ec) << "), trying next replica";
-    }
-  }
-  return last;
+  std::vector<uint8_t> buffer;
+  const ErrorCode ec = read_with_cache(
+      key, v, [&](const std::vector<CopyPlacement>& copies) -> ErrorCode {
+        uint64_t size = 0;
+        if (!copies.empty()) size = copy_logical_size(copies.front());
+        buffer.resize(size);
+        if (try_split_read(copies, buffer.data(), size, v) == ErrorCode::OK)
+          return ErrorCode::OK;
+        ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
+        for (const auto& copy : copies) {
+          const uint64_t copy_size = copy_logical_size(copy);
+          if (copy_size != size) buffer.resize(copy_size);
+          if (auto tec = transfer_copy_get(copy, buffer.data(), copy_size, v);
+              tec == ErrorCode::OK) {
+            return ErrorCode::OK;
+          } else {
+            // Corruption is the strongest signal — a later replica's
+            // transport error must not mask it (scrubbers key off
+            // CHECKSUM_MISMATCH).
+            if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
+            LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
+                     << to_string(tec) << "), trying next replica";
+          }
+        }
+        return last;
+      });
+  if (ec != ErrorCode::OK) return ec;
+  return buffer;
 }
 
 Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
                                         uint64_t buffer_size, std::optional<bool> verify) {
   TRACE_SPAN("client.get");
   const bool v = verify.value_or(verify_reads());
-  auto copies = get_workers(key);
-  if (!copies.ok()) return copies.error();
-  uint64_t size = 0;
-  if (!copies.value().empty()) size = copy_logical_size(copies.value().front());
-  if (size <= buffer_size &&
-      try_split_read(copies.value(), static_cast<uint8_t*>(buffer), size, v) == ErrorCode::OK)
-    return size;
-  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
-  for (const auto& copy : copies.value()) {
-    const uint64_t copy_size = copy_logical_size(copy);
-    if (copy_size > buffer_size) return ErrorCode::BUFFER_OVERFLOW;
-    if (auto ec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer), copy_size, v);
-        ec == ErrorCode::OK) {
-      return copy_size;
-    } else {
-      if (last != ErrorCode::CHECKSUM_MISMATCH) last = ec;
-    }
-  }
-  return last;
+  uint64_t got = 0;
+  const ErrorCode ec = read_with_cache(
+      key, v, [&](const std::vector<CopyPlacement>& copies) -> ErrorCode {
+        uint64_t size = 0;
+        if (!copies.empty()) size = copy_logical_size(copies.front());
+        if (size <= buffer_size &&
+            try_split_read(copies, static_cast<uint8_t*>(buffer), size, v) ==
+                ErrorCode::OK) {
+          got = size;
+          return ErrorCode::OK;
+        }
+        ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
+        for (const auto& copy : copies) {
+          const uint64_t copy_size = copy_logical_size(copy);
+          if (copy_size > buffer_size) {
+            // Participates in the cache-retry: a stale cached size must not
+            // surface as a spurious overflow when fresh metadata fits.
+            if (last == ErrorCode::NO_COMPLETE_WORKER) last = ErrorCode::BUFFER_OVERFLOW;
+            continue;
+          }
+          if (auto tec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer),
+                                           copy_size, v);
+              tec == ErrorCode::OK) {
+            got = copy_size;
+            return ErrorCode::OK;
+          } else {
+            if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
+            LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
+                     << to_string(tec) << "), trying next replica";
+          }
+        }
+        return last;
+      });
+  if (ec != ErrorCode::OK) return ec;
+  return got;
 }
 
 ErrorCode ObjectClient::remove(const ObjectKey& key) {
+  invalidate_placements(key);  // a re-created key must not serve stale bytes
   if (embedded_) return embedded_->remove_object(key);
   return rpc_failover(/*idempotent=*/false,
                       [&](rpc::KeystoneRpcClient& r) { return r.remove_object(key); });
 }
 
 Result<uint64_t> ObjectClient::remove_all() {
+  invalidate_all_placements();  // same re-created-key rule as remove()
   if (embedded_) return embedded_->remove_all_objects();
   return rpc_failover(/*idempotent=*/false,
                       [&](rpc::KeystoneRpcClient& r) { return r.remove_all_objects(); });
@@ -817,8 +912,12 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
 
   std::vector<BatchPutStartItem> starts;
   starts.reserve(items.size());
-  for (const auto& item : items)
+  for (const auto& item : items) {
+    // A put of a removed-then-recreated key must not let this client's own
+    // cached placement serve the PREVIOUS object's bytes afterwards.
+    invalidate_placements(item.key);
     starts.push_back({item.key, item.size, config, crc32c(item.data, item.size)});
+  }
   std::vector<Result<std::vector<CopyPlacement>>> placed;
   if (embedded_) {
     placed = embedded_->batch_put_start(starts);
@@ -848,13 +947,13 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
       continue;
     }
     for (const auto& copy : placed[i].value()) {
-      CopyShardCrcs crcs;
-      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs, &crcs);
+      // Shard CRCs are computed AFTER the device dispatch below, riding
+      // under the in-flight transfer instead of serializing before it.
+      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs, nullptr);
           ec != ErrorCode::OK) {
         results[i] = ec;
         break;
       }
-      item_crcs[i].push_back(std::move(crcs));
     }
   }
 
@@ -862,6 +961,26 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     TRACE_SPAN("client.put.transfer");
     run_device_jobs(*data_, jobs, /*is_write=*/true, results);
     run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results);
+  }
+  // Replicated/striped shard CRC stamps: one pass over the source bytes,
+  // overlapped with any still-draining device DMA (the flush below is the
+  // only wait). EC items computed theirs during encode (parity shards have
+  // no plain-data source).
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placed[i].ok() || results[i] != ErrorCode::OK) continue;
+    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) continue;
+    const auto* data = static_cast<const uint8_t*>(items[i].data);
+    for (const auto& copy : placed[i].value()) {
+      CopyShardCrcs crcs;
+      crcs.copy_index = copy.copy_index;
+      crcs.crcs.reserve(copy.shards.size());
+      uint64_t off = 0;
+      for (const auto& shard : copy.shards) {
+        crcs.crcs.push_back(crc32c(data + off, shard.length));
+        off += shard.length;
+      }
+      item_crcs[i].push_back(std::move(crcs));
+    }
   }
   // Device writes may be asynchronous; put_complete must not be sent until
   // the bytes are durably in the tier.

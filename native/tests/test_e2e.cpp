@@ -299,6 +299,69 @@ BTEST(EndToEnd, FullTcpWireModeWithRpc) {
   BT_EXPECT_EQ(remote_client.cluster_stats().value().total_objects, 1ull);
 }
 
+BTEST(EndToEnd, PlacementCacheServesReadsAndHealsStalePlacements) {
+  // Small-object reads are metadata-RPC-bound; verified reads may reuse
+  // cached placements (ClientOptions::placement_cache_ms). Two properties:
+  // (1) a cache hit needs NO control plane — reads keep working with the
+  // keystone RPC server stopped; (2) a stale cached placement (bytes moved
+  // by drain, old worker dead) fails, invalidates, refetches, and the read
+  // succeeds — the client never returns an error for an object that is
+  // alive and well somewhere else.
+  auto options = EmbeddedClusterOptions::simple(2, 8 << 20);
+  for (auto& w : options.workers) {
+    w.transport = TransportKind::TCP;
+    w.listen_host = "127.0.0.1";
+  }
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  rpc::KeystoneRpcServer rpc_server(cluster.keystone(), "127.0.0.1", 0);
+  BT_ASSERT(rpc_server.start() == ErrorCode::OK);
+
+  ClientOptions copts;
+  copts.keystone_address = rpc_server.endpoint();
+  copts.placement_cache_ms = 60'000;  // hits must come from the cache, not luck
+  ObjectClient remote_client(copts);
+  BT_ASSERT(remote_client.connect() == ErrorCode::OK);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(256 * 1024, 29);
+  BT_ASSERT(remote_client.put("cache/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+  auto first = remote_client.get("cache/obj");  // fetches + caches placements
+  BT_ASSERT_OK(first);
+  BT_EXPECT(first.value() == data);
+
+  // (1) Control plane down: the cached placement alone serves the read.
+  rpc_server.stop();
+  auto cached = remote_client.get("cache/obj");
+  BT_ASSERT_OK(cached);
+  BT_EXPECT(cached.value() == data);
+
+  // (2) Restart the control plane on the SAME port, move the bytes (drain
+  // streams them to the other worker), and kill the old home. The cached
+  // placement now points at a dead endpoint: the read fails against it,
+  // invalidates, refetches fresh metadata, and lands on the drained-to
+  // worker — the client never errors for an object alive elsewhere.
+  const uint16_t rpc_port = rpc_server.port();
+  rpc::KeystoneRpcServer rpc_server2(cluster.keystone(), "127.0.0.1", rpc_port);
+  BT_ASSERT(rpc_server2.start() == ErrorCode::OK);
+  const auto placed = cluster.keystone().get_workers("cache/obj");
+  BT_ASSERT_OK(placed);
+  const NodeId home = placed.value().front().shards.front().worker_id;
+  BT_ASSERT_OK(cluster.keystone().drain_worker(home));
+  size_t home_idx = options.workers.size();
+  for (size_t i = 0; i < options.workers.size(); ++i) {
+    if (cluster.worker(i).config().worker_id == home) home_idx = i;
+  }
+  BT_ASSERT(home_idx < options.workers.size());
+  cluster.kill_worker(home_idx);
+
+  auto moved = remote_client.get("cache/obj");  // stale cache -> heal -> read
+  BT_ASSERT_OK(moved);
+  BT_EXPECT(moved.value() == data);
+}
+
 BTEST(EndToEnd, TierPressureDemotesHbmObjectsToDiskThroughRealBackends) {
   // Acceptance-ladder item 4 end-to-end: a real worker's HBM tier (emulated
   // provider, virtual-region data path) crosses the watermark and the LRU

@@ -178,8 +178,8 @@ def bench_cross_process(shm_get_gbps: float | None, hbm: bool) -> None:
             )
             if result.returncode != 0:
                 raise RuntimeError(f"bb-bench failed: {result.stderr[-300:]}")
-            rows = {json.loads(l)["op"]: json.loads(l)
-                    for l in result.stdout.splitlines() if l.strip()}
+            rows = {row["op"]: row for row in map(
+                json.loads, filter(str.strip, result.stdout.splitlines()))}
         get_gbps = rows["get"]["gbps"]
         vs_shm = (f" ({get_gbps / shm_get_gbps * 100:.0f}% of in-process shm get)"
                   if shm_get_gbps else "")

@@ -88,7 +88,7 @@ def _pow2_at_least(n: int) -> int:
 class JaxHbmProvider:
     """Page-batched device-buffer regions managed through JAX."""
 
-    def __init__(self, page_bytes: int = 64 << 10, max_staging_bytes: int = 128 << 20):
+    def __init__(self, page_bytes: int = 64 << 10, max_staging_bytes: int = 32 << 20):
         import jax
 
         # Donation is an optimization (in-place region updates); backends
@@ -103,7 +103,9 @@ class JaxHbmProvider:
         self.page_bytes = page_bytes
         # Upper bound on the flat host->device staging array per flush round;
         # larger batches are split so the device never needs more than this
-        # much transient memory on top of the regions themselves.
+        # much transient memory on top of the regions themselves — and so a
+        # multi-round batch pipelines: fill of round N+1 overlaps the
+        # transfer of round N (two staging slots per device).
         self.max_staging_bytes = max_staging_bytes
         self._lock = threading.Lock()            # region table
         self._regions: dict[int, dict] = {}
@@ -113,7 +115,8 @@ class JaxHbmProvider:
         self.copy_calls = 0                      # device-to-device copies served
         # Reusable host staging buffers: re-faulting a fresh multi-MiB array
         # every batch cost ~20 ms/64 MiB. Keyed by device; entry =
-        # {buf, fences, lock}. _staging_lock guards only the dict; each
+        # {slots: [{buf, fences} x2], next, lock} — two slots so round N+1's
+        # fill overlaps round N's transfer. _staging_lock guards only the dict; each
         # entry's lock is held across that device's fill+dispatch, so
         # concurrent writers to ONE device serialize (its link forces that
         # anyway) while different devices proceed in parallel. Lock order:
@@ -250,25 +253,36 @@ class JaxHbmProvider:
         with self._staging_lock:
             entry = self._staging.get(dev)
             if entry is None:
+                # TWO slots per device: round N+1 fills one buffer while
+                # round N's transfer/merge still drains the other, so the
+                # host staging pass overlaps the device link instead of
+                # serializing with it (round size = max_staging_bytes).
                 entry = self._staging[dev] = {
-                    "buf": None, "fences": [], "lock": threading.Lock()}
+                    "slots": [{"buf": None, "fences": []} for _ in range(2)],
+                    "next": 0,
+                    "lock": threading.Lock(),
+                }
             return entry
 
-    def _staging_for(self, entry, rows: int, page_bytes: int) -> np.ndarray:
-        """A reusable (rows, page) host staging view for one device.
+    def _staging_for(self, entry, rows: int, page_bytes: int):
+        """A reusable (rows, page) host staging view for one device, plus
+        the slot whose fences the caller must append its dispatches to.
 
-        Before handing the buffer out again we block on the fences of every
-        computation that consumed it last round — not merely the device_put
-        transfer: the CPU backend's device_put is ZERO-COPY (the device
-        buffer aliases the staging memory), so the bytes are only safe to
-        overwrite once the merge kernels that read them have finished. The
-        wait is a no-op in steady state (every put batch ends in a flush
-        that already waited). Caller holds entry["lock"]."""
-        self._await_fences(entry)  # also covers an old buffer being replaced
-        buf = entry["buf"]
+        Before handing a slot's buffer out again we block on the fences of
+        every computation that consumed it last time — not merely the
+        device_put transfer: the CPU backend's device_put is ZERO-COPY (the
+        device buffer aliases the staging memory), so the bytes are only
+        safe to overwrite once the merge kernels that read them have
+        finished. With two slots the wait only fires two rounds back —
+        hidden under the intervening round's transfer. Caller holds
+        entry["lock"]."""
+        slot = entry["slots"][entry["next"]]
+        entry["next"] = (entry["next"] + 1) % len(entry["slots"])
+        self._await_fences(slot)  # also covers an old buffer being replaced
+        buf = slot["buf"]
         if buf is None or buf.shape[0] < rows or buf.shape[1] != page_bytes:
-            buf = entry["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
-        return buf[:rows]
+            buf = slot["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
+        return buf[:rows], slot
 
     # -- aligned fast path -------------------------------------------------
 
@@ -360,7 +374,7 @@ class JaxHbmProvider:
                 total_rows += m_padded
             entry = self._staging_entry(dev)
             with entry["lock"]:
-                flat = self._staging_for(entry, total_rows, P)
+                flat, slot = self._staging_for(entry, total_rows, P)
                 meta = np.zeros((3, total_rows), dtype=np.int32)
                 for region_id, start, m_padded, runs in layouts:
                     # Padding rows carry an out-of-bounds page index so the
@@ -384,7 +398,7 @@ class JaxHbmProvider:
                         pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
                     with region["lock"]:
                         region["buf"] = self._write_fn(region["buf"], pages, pmeta)
-                        entry["fences"].append(self._fence_fn(region["buf"]))
+                        slot["fences"].append(self._fence_fn(region["buf"]))
                     with self._lock:
                         if region_id in self._regions:
                             self._dirty.add(region_id)
@@ -461,7 +475,7 @@ class JaxHbmProvider:
                     total += m_padded
                 entry = self._staging_entry(dev)
                 with entry["lock"]:
-                    flat = self._staging_for(entry, total, P)  # pad rows unused
+                    flat, slot = self._staging_for(entry, total, P)  # pad rows unused
                     meta = np.zeros((3, total), dtype=np.int32)  # idx / v0 / v1
                     for region_id, start, m_padded, spans in layouts:
                         # Padding rows carry an out-of-bounds page index so
@@ -485,7 +499,7 @@ class JaxHbmProvider:
                             pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
                         with region["lock"]:
                             region["buf"] = self._write_fn(region["buf"], pages, pmeta)
-                            entry["fences"].append(self._fence_fn(region["buf"]))
+                            slot["fences"].append(self._fence_fn(region["buf"]))
                         with self._lock:
                             if region_id in self._regions:
                                 self._dirty.add(region_id)
@@ -715,4 +729,5 @@ class JaxHbmProvider:
             entries = list(self._staging.values())
         for entry in entries:
             with entry["lock"]:
-                self._await_fences(entry)
+                for slot in entry["slots"]:
+                    self._await_fences(slot)

@@ -34,17 +34,24 @@ std::string rkey_to_hex(uint64_t rkey) {
 namespace {
 
 // ICI transport: the data plane for device-resident (HBM) pools on a TPU
-// mesh. There is no listener and no flat remote address space — regions ARE
-// device buffers owned by the HBM provider, placements are DeviceLocation
-// {device, region, offset}, and transfers go through the provider ABI:
-// host<->device for client put/get, device-to-device (riding ICI, no host
-// staging) for keystone repair/demotion via provider.copy. The reference's
-// analog is the UCX engine's registered-region + rkey contract
-// (ucx_engine.cpp:150-180); here the "registration" is the provider region
-// advertised by the worker (worker.cpp HBM branch) and the "rkey" is the
-// region id. Host-mapped tiers on an ICI worker are served by the TCP
-// virtual-region fallback instead (the DCN path) — this server deliberately
-// registers nothing itself.
+// mesh WITHIN one process. There is no listener and no flat remote address
+// space — regions ARE device buffers owned by the HBM provider, placements
+// are DeviceLocation {device, region, offset}, and transfers go through the
+// provider ABI: host<->device for client put/get, device-to-device (riding
+// ICI, no host staging) for keystone repair/demotion via provider.copy.
+// The reference's analog is the UCX engine's registered-region + rkey
+// contract (ucx_engine.cpp:150-180); here the "registration" is the
+// provider region advertised by the worker (worker.cpp HBM branch) and the
+// "rkey" is the region id.
+//
+// ACROSS processes (the multi-controller pod shape: one worker process per
+// host, blackbird_tpu/procluster.py) device pools are served instead by the
+// worker's TCP transport as shm-STAGED virtual regions — the provider moves
+// bytes device<->shared-segment directly, headers ride the socket
+// (tcp_transport.cpp staged lane), and keystone repair streams DCN-style
+// between processes. So this class intentionally registers nothing: host
+// memory has no ICI path, and cross-process device traffic belongs to the
+// staged TCP lane, not here.
 class IciTransportServer final : public TransportServer {
  public:
   TransportKind kind() const noexcept override { return TransportKind::ICI; }

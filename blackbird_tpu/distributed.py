@@ -1,0 +1,163 @@
+"""Multi-host bridge: derive this host's worker from the JAX runtime.
+
+On a pod every host runs ONE process that owns that host's chips
+(jax.distributed); the multi-controller data plane (docs/OPERATIONS.md)
+wants exactly one `hbm_tpu` pool per local device in that process. This
+module turns the JAX runtime's own view of the host into that worker:
+
+    import blackbird_tpu.distributed as btd
+    btd.init()                       # jax.distributed when env says so
+    btd.serve(coord_endpoints="coord:9300",
+              pool_bytes_per_device=8 << 30,
+              keystone_endpoints="ks:9100")   # drain-on-preemption target
+
+`init()` is a thin, idempotent wrapper over jax.distributed.initialize —
+on single-process runs (no coordinator env) it is a no-op, so the same
+entrypoint works on a laptop, a single TPU VM, and a pod slice.
+
+Role parity: the reference's multi-host story is "run worker_service on
+every host with a hand-written config" (examples/worker_example.cpp); here
+the config is derived from the runtime so it cannot drift from the devices
+the process actually owns.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+
+
+_initialized = False
+
+
+def init(coordinator_address: str | None = None,
+         num_processes: int | None = None,
+         process_id: int | None = None) -> None:
+    """Joins the multi-host JAX runtime when one is configured.
+
+    Explicit args win; otherwise JAX_COORDINATOR_ADDRESS (jax's own env) or
+    COORDINATOR_ADDRESS supplies the address. With no coordinator
+    configured anywhere this is a no-op, keeping single-host runs on the
+    same code path. Idempotent: a second call (entrypoint re-run, or user
+    code that initialized jax.distributed itself) does nothing.
+    """
+    global _initialized
+    import jax
+
+    if coordinator_address is None:
+        # jax only reads JAX_COORDINATOR_ADDRESS itself; honor the plain
+        # name too since this module's docs advertise it as a trigger.
+        coordinator_address = os.environ.get(
+            "JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
+        if coordinator_address is None:
+            return
+    if _initialized:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as exc:
+        # jax 0.9 raises "distributed.initialize should only be called
+        # once."; older builds said "already initialized" — both mean the
+        # runtime is up, which is what this wrapper promises.
+        msg = str(exc).lower()
+        if "already" not in msg and "only be called once" not in msg:
+            raise
+    _initialized = True
+
+
+def _advertise_host_for(coord_endpoints: str) -> str:
+    """The address OTHER hosts can reach this one at.
+
+    Binding 0.0.0.0 would make the transport advertise 127.0.0.1 — every
+    pod host would register pools at loopback and cross-host reads/repair
+    would dial themselves. The interface that routes to the coordinator is
+    the one peers share, so a connected UDP socket (no traffic) to it
+    yields the right local address; hostname resolution is the fallback.
+    """
+    import socket
+
+    first = coord_endpoints.split(",")[0]
+    host, _, port = first.rpartition(":")
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+            probe.connect((host or first, int(port) if port else 9300))
+            return probe.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+
+def worker_config_for_this_host(
+    coord_endpoints: str,
+    *,
+    pool_bytes_per_device: int,
+    dram_pool_bytes: int = 0,
+    cluster_id: str = "blackbird",
+    listen_host: str | None = None,
+    slice_id: int = 0,
+    heartbeat_interval_ms: int = 1000,
+    heartbeat_ttl_ms: int = 5000,
+    workdir: str | None = None,
+) -> Path:
+    """Writes this process's worker.yaml: one hbm_tpu pool per LOCAL device.
+
+    host_id comes from jax.process_index() and the worker id is derived
+    from it, so every pod host gets a distinct, stable identity and the
+    allocator's worker-level anti-affinity sees one failure domain per
+    process — the property cross-process repair relies on. listen_host
+    defaults to the address peers can actually reach (see
+    _advertise_host_for), never 0.0.0.0.
+    """
+    import jax
+
+    from blackbird_tpu.worker import write_worker_yaml
+
+    process_index = jax.process_index()
+    worker_id = f"{cluster_id}-host{process_index}"
+    pools = [
+        {"id": f"{worker_id}-hbm-{d}", "storage_class": "hbm_tpu",
+         "capacity": pool_bytes_per_device, "device_id": f"tpu:{d}"}
+        for d in range(len(jax.local_devices()))
+    ]
+    if dram_pool_bytes:
+        pools.append({"id": f"{worker_id}-dram", "storage_class": "ram_cpu",
+                      "capacity": dram_pool_bytes})
+    out_dir = Path(workdir) if workdir else Path(tempfile.mkdtemp(prefix="btpu_host_"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"{worker_id}.yaml"
+    write_worker_yaml(
+        path, worker_id=worker_id, cluster_id=cluster_id,
+        coord_endpoints=coord_endpoints, pools=pools,
+        listen_host=listen_host or _advertise_host_for(coord_endpoints),
+        host_id=process_index, slice_id=slice_id,
+        heartbeat_interval_ms=heartbeat_interval_ms,
+        heartbeat_ttl_ms=heartbeat_ttl_ms)
+    return path
+
+
+def serve(coord_endpoints: str, *, pool_bytes_per_device: int,
+          dram_pool_bytes: int = 0, cluster_id: str = "blackbird",
+          keystone_endpoints: str | None = None, **config_kwargs) -> int:
+    """Derives this host's worker config and runs the worker host until a
+    signal arrives; SIGTERM (the preemption notice) drains through
+    `keystone_endpoints` first when given. Blocks; returns the exit code."""
+    from blackbird_tpu import worker
+
+    config = worker_config_for_this_host(
+        coord_endpoints,
+        pool_bytes_per_device=pool_bytes_per_device,
+        dram_pool_bytes=dram_pool_bytes,
+        cluster_id=cluster_id,
+        **config_kwargs,
+    )
+    argv = ["--config", str(config)]
+    if keystone_endpoints:
+        argv += ["--drain-on-term", keystone_endpoints]
+    return worker.main(argv)

@@ -121,33 +121,22 @@ worker_heartbeat_ttl_sec: {max(1, heartbeat_ttl_ms // 1000)}
 
     def _worker_config(self, index: int, pool_mb: int, dram_pool_mb: int,
                        heartbeat_ttl_ms: int) -> Path:
-        pools = []
-        for d in range(self.devices_per_worker):
-            pools.append(
-                f"""  - id: mc-{index}-hbm-{d}
-    storage_class: hbm_tpu
-    capacity: {pool_mb}MB
-    device_id: tpu:{d}
-""")
+        from blackbird_tpu.worker import write_worker_yaml
+
+        pools = [
+            {"id": f"mc-{index}-hbm-{d}", "storage_class": "hbm_tpu",
+             "capacity": f"{pool_mb}MB", "device_id": f"tpu:{d}"}
+            for d in range(self.devices_per_worker)
+        ]
         if dram_pool_mb:
-            pools.append(
-                f"""  - id: mc-{index}-dram
-    storage_class: ram_cpu
-    capacity: {dram_pool_mb}MB
-""")
+            pools.append({"id": f"mc-{index}-dram", "storage_class": "ram_cpu",
+                          "capacity": f"{dram_pool_mb}MB"})
         path = self.workdir / f"worker-{index}.yaml"
-        path.write_text(
-            f"""worker_id: mc-{index}
-cluster_id: procluster
-coord_endpoints: 127.0.0.1:{self.coord_port}
-transport: tcp
-listen_host: 127.0.0.1
-host_id: {index}
-heartbeat:
-  interval_ms: 300
-  ttl_ms: {heartbeat_ttl_ms}
-pools:
-{"".join(pools)}""")
+        write_worker_yaml(
+            path, worker_id=f"mc-{index}", cluster_id="procluster",
+            coord_endpoints=f"127.0.0.1:{self.coord_port}", pools=pools,
+            listen_host="127.0.0.1", host_id=index,
+            heartbeat_interval_ms=300, heartbeat_ttl_ms=heartbeat_ttl_ms)
         return path
 
     def _spawn(self, args: list[str], name: str, env: dict | None = None):

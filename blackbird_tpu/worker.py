@@ -31,6 +31,39 @@ import threading
 from blackbird_tpu.native import lib
 
 
+def write_worker_yaml(path, *, worker_id: str, cluster_id: str,
+                      coord_endpoints: str, pools: list[dict],
+                      listen_host: str = "0.0.0.0", host_id: int = 0,
+                      slice_id: int = 0, heartbeat_interval_ms: int = 1000,
+                      heartbeat_ttl_ms: int = 5000) -> None:
+    """Writes a worker.yaml — THE single source for the config shape used by
+    every programmatic launcher (procluster, the jax.distributed bridge).
+
+    Each pool dict: {"id", "storage_class", "capacity" (int bytes or a
+    "8MB"-style string), optional "device_id"}."""
+    lines = [
+        f"worker_id: {worker_id}",
+        f"cluster_id: {cluster_id}",
+        f"coord_endpoints: {coord_endpoints}",
+        "transport: tcp",
+        f"listen_host: {listen_host}",
+        f"slice_id: {slice_id}",
+        f"host_id: {host_id}",
+        "heartbeat:",
+        f"  interval_ms: {heartbeat_interval_ms}",
+        f"  ttl_ms: {heartbeat_ttl_ms}",
+        "pools:",
+    ]
+    for pool in pools:
+        lines.append(f"  - id: {pool['id']}")
+        lines.append(f"    storage_class: {pool['storage_class']}")
+        lines.append(f"    capacity: {pool['capacity']}")
+        if pool.get("device_id"):
+            lines.append(f"    device_id: {pool['device_id']}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
 def _pin_jax_platform() -> None:
     """Honor JAX_PLATFORMS before the backend initializes: some images
     register a hardware PJRT plugin from sitecustomize that overrides the

@@ -1,0 +1,113 @@
+"""jax.distributed bridge: the worker a pod host derives from its own JAX
+runtime (blackbird_tpu/distributed.py). Single-process here — process_index
+is 0 and local_devices is the conftest 8-device CPU mesh — which is exactly
+the shape init() degrades to on one host."""
+
+import time
+from pathlib import Path
+
+import jax
+
+from blackbird_tpu.procluster import free_port
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BUILD = REPO_ROOT / "build"
+
+
+def test_init_is_noop_without_coordinator(monkeypatch):
+    import blackbird_tpu.distributed as btd
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    btd.init()  # must not raise or try to reach a coordinator
+    assert len(jax.devices()) == 8  # runtime untouched
+
+
+def test_worker_config_matches_local_devices(tmp_path):
+    import blackbird_tpu.distributed as btd
+
+    cfg = btd.worker_config_for_this_host(
+        "127.0.0.1:9999", pool_bytes_per_device=4 << 20,
+        dram_pool_bytes=8 << 20, cluster_id="podtest", workdir=str(tmp_path))
+    text = cfg.read_text()
+    assert "worker_id: podtest-host0" in text
+    assert "host_id: 0" in text
+    # One hbm pool per local device, addressed by local ordinal.
+    for d in range(len(jax.local_devices())):
+        assert f"device_id: tpu:{d}" in text
+    assert text.count("storage_class: hbm_tpu") == len(jax.local_devices())
+    assert "storage_class: ram_cpu" in text
+    # The advertised address must be one peers can reach — never the
+    # 0.0.0.0 bind-all that the transport would rewrite to loopback.
+    assert "listen_host: 0.0.0.0" not in text
+
+
+def test_derived_worker_serves_device_tier_end_to_end(tmp_path):
+    """The generated config actually boots: WorkerHost (in this process,
+    owning the 8 virtual devices through JaxHbmProvider) registers
+    8 hbm pools + 1 dram pool with a real coordinator/keystone pair, and a
+    client stores and reads device-tier bytes striped across the derived
+    pools."""
+    import signal
+    import socket
+    import subprocess
+
+    import blackbird_tpu.distributed as btd
+    from blackbird_tpu import Client, StorageClass
+    from blackbird_tpu.worker import WorkerHost
+
+    coord_port, keystone_port = free_port(), free_port()
+    keystone_cfg = tmp_path / "keystone.yaml"
+    keystone_cfg.write_text(
+        f"""cluster_id: podtest
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{keystone_port}
+gc_interval_sec: 5
+health_check_interval_sec: 5
+worker_heartbeat_ttl_sec: 10
+""")
+    procs = []
+    try:
+        for args in ([str(BUILD / "bb-coord"), "--host", "127.0.0.1",
+                      "--port", str(coord_port)],
+                     [str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)]):
+            procs.append(subprocess.Popen(
+                args, cwd=REPO_ROOT, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT))
+            port = coord_port if len(procs) == 1 else keystone_port
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                with socket.socket() as s:
+                    s.settimeout(0.2)
+                    if s.connect_ex(("127.0.0.1", port)) == 0:
+                        break
+                time.sleep(0.1)
+
+        cfg = btd.worker_config_for_this_host(
+            f"127.0.0.1:{coord_port}", pool_bytes_per_device=4 << 20,
+            dram_pool_bytes=8 << 20, cluster_id="podtest",
+            listen_host="127.0.0.1", workdir=str(tmp_path))
+        with WorkerHost(str(cfg)) as host:
+            assert host.pool_count == len(jax.local_devices()) + 1
+            client = Client(f"127.0.0.1:{keystone_port}")
+            deadline = time.time() + 30
+            while time.time() < deadline and client.stats()["pools"] < 9:
+                time.sleep(0.2)
+            assert client.stats()["workers"] == 1
+            payload = bytes(bytearray(range(251)) * 8360)  # ~2 MiB: stripes
+            client.put("pod/obj", payload, max_workers=4,
+                       preferred_class=StorageClass.HBM_TPU)
+            assert client.get("pod/obj") == payload
+            copies = client.placements("pod/obj")
+            shards = [s for c in copies for s in c["shards"]]
+            assert all(s["class"] == "hbm_tpu" for s in shards), copies
+            # Striped across several of the derived per-device pools.
+            assert len({s["pool"] for s in shards}) >= 2, copies
+    finally:
+        for proc in reversed(procs):
+            proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -14,6 +14,10 @@ Layout:
     blackbird_tpu.parallel  mesh/sharding helpers for the ICI data plane
     blackbird_tpu.checkpoint sharded-array checkpoint/restore via the store
     blackbird_tpu.ops       pallas/jnp kernels (checksums, shard repacking)
+    blackbird_tpu.worker    standalone TPU-VM worker host (python -m ...)
+    blackbird_tpu.procluster multi-controller process-cluster launcher
+    blackbird_tpu.distributed jax.distributed bridge: derive this host's
+                            worker from the runtime (pods)
 """
 
 from blackbird_tpu.native import ErrorCode, StorageClass, TransportKind, lib  # noqa: F401

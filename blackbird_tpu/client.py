@@ -72,6 +72,7 @@ class Client:
         ttl_ms: int | None = None,
         soft_pin: bool = False,
         ec: tuple[int, int] | None = None,
+        preferred_slice: int | None = None,
     ) -> None:
         """ttl_ms: None = the framework default (30 min), 0 = never
         expires, >0 = the GC collects the object that long after CREATION
@@ -80,7 +81,9 @@ class Client:
         still applies). ec=(k, m) stores ONE Reed-Solomon coded copy of k
         data + m parity shards instead of replicas: any m worker losses
         tolerated at (k+m)/k storage overhead (e.g. ec=(4, 2) survives two
-        losses at 1.5x, where replicas=3 costs 3x)."""
+        losses at 1.5x, where replicas=3 costs 3x). preferred_slice ranks
+        pools on that TPU slice first so placements ride ICI and spill to
+        other slices (the DCN path) only when the slice is full."""
         if ttl_ms is not None and ttl_ms < 0:
             raise ValueError(f"ttl_ms must be >= 0, got {ttl_ms}")
         if isinstance(data, np.ndarray):
@@ -96,7 +99,7 @@ class Client:
             if k < 1 or m < 1:
                 raise ValueError(f"ec needs k >= 1 and m >= 1, got {ec}")
             check(
-                lib.btpu_put_ec(
+                lib.btpu_put_ec2(
                     self._handle,
                     key.encode(),
                     buf,
@@ -106,12 +109,13 @@ class Client:
                     int(preferred_class) if preferred_class else 0,
                     -1 if ttl_ms is None else ttl_ms,
                     1 if soft_pin else 0,
+                    -1 if preferred_slice is None else preferred_slice,
                 ),
                 f"put {key!r}",
             )
             return
         check(
-            lib.btpu_put_ex(
+            lib.btpu_put_ex2(
                 self._handle,
                 key.encode(),
                 buf,
@@ -121,6 +125,7 @@ class Client:
                 int(preferred_class) if preferred_class else 0,
                 -1 if ttl_ms is None else ttl_ms,
                 1 if soft_pin else 0,
+                -1 if preferred_slice is None else preferred_slice,
             ),
             f"put {key!r}",
         )

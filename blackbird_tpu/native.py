@@ -112,10 +112,10 @@ def _load() -> ctypes.CDLL:
                                        ctypes.POINTER(u64)]),
         "btpu_list_json": (i32, [c, ctypes.c_char_p, u64, ctypes.c_char_p, u64,
                                  ctypes.POINTER(u64)]),
-        "btpu_put_ex": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
-                              u32, ctypes.c_int64, i32]),
-        "btpu_put_ec": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
-                              u32, ctypes.c_int64, i32]),
+        "btpu_put_ex2": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
+                               u32, ctypes.c_int64, i32, i32]),
+        "btpu_put_ec2": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
+                               u32, ctypes.c_int64, i32, i32]),
         "btpu_drain_worker": (i32, [c, ctypes.c_char_p, ctypes.POINTER(u64)]),
         "btpu_worker_create": (c, [ctypes.c_char_p, ctypes.c_char_p]),
         "btpu_worker_pool_count": (u32, [c]),

@@ -65,11 +65,20 @@ class ProcessCluster:
         virtual_devices: bool = True,
         workdir: str | None = None,
         heartbeat_ttl_ms: int = 2000,
+        slice_ids: list[int] | None = None,
     ):
+        """slice_ids: per-worker TPU slice id (default: all slice 0).
+        Workers on different slices model the multi-slice pod: placement
+        ranks same-slice pools first and spills across slices (the DCN
+        path) only when needed."""
         self.n_workers = workers
         self.devices_per_worker = devices_per_worker
         self.expected_pools = workers * devices_per_worker + (
             workers if dram_pool_mb else 0)
+        if slice_ids is not None and len(slice_ids) != workers:
+            raise ValueError(
+                f"slice_ids has {len(slice_ids)} entries for {workers} workers")
+        self.slice_ids = slice_ids or [0] * workers
         self._procs: list[tuple[str, subprocess.Popen]] = []
         self.worker_procs: list[subprocess.Popen] = []
         self._tmp = None
@@ -136,6 +145,7 @@ worker_heartbeat_ttl_sec: {max(1, heartbeat_ttl_ms // 1000)}
             path, worker_id=f"mc-{index}", cluster_id="procluster",
             coord_endpoints=f"127.0.0.1:{self.coord_port}", pools=pools,
             listen_host="127.0.0.1", host_id=index,
+            slice_id=self.slice_ids[index],
             heartbeat_interval_ms=300, heartbeat_ttl_ms=heartbeat_ttl_ms)
         return path
 

@@ -61,6 +61,12 @@ int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_
 int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint64_t size,
                     uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
                     int64_t ttl_ms, int32_t soft_pin);
+
+// v2 entry points: original signatures above stay ABI-stable; new knobs
+// (slice affinity) are appended here.
+int32_t btpu_put_ex2(btpu_client* client, const char* key, const void* data, uint64_t size,
+                     uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
+                     int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice);
 // Returns object size via out_size; buffer may be NULL to query size only.
 int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size,
                  uint64_t* out_size);
@@ -97,6 +103,9 @@ int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* 
 int32_t btpu_put_ec(btpu_client* client, const char* key, const void* data, uint64_t size,
                     uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
                     int64_t ttl_ms, int32_t soft_pin);
+int32_t btpu_put_ec2(btpu_client* client, const char* key, const void* data, uint64_t size,
+                     uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
+                     int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice);
 
 /* Prefix listing of COMPLETE objects, lexicographic (limit 0 = unlimited):
  * writes a JSON array [{"key","size","copies","soft_pin"}] into buffer.

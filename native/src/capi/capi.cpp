@@ -147,13 +147,23 @@ void btpu_client_set_verify(btpu_client* client, int32_t verify) {
 
 int32_t btpu_put(btpu_client* client, const char* key, const void* data, uint64_t size,
                  uint32_t replicas, uint32_t max_workers, uint32_t preferred_class) {
-  return btpu_put_ex(client, key, data, size, replicas, max_workers, preferred_class,
-                     /*ttl_ms=*/-1, /*soft_pin=*/0);
+  return btpu_put_ex2(client, key, data, size, replicas, max_workers, preferred_class,
+                      /*ttl_ms=*/-1, /*soft_pin=*/0, /*preferred_slice=*/-1);
 }
 
+// Kept at its original 9-arg signature: exported C symbols never change
+// shape in place (a stale caller would pass garbage for the new arg).
+// New knobs land in a NEW entry point below.
 int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint64_t size,
                     uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
                     int64_t ttl_ms, int32_t soft_pin) {
+  return btpu_put_ex2(client, key, data, size, replicas, max_workers, preferred_class,
+                      ttl_ms, soft_pin, /*preferred_slice=*/-1);
+}
+
+int32_t btpu_put_ex2(btpu_client* client, const char* key, const void* data, uint64_t size,
+                     uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
+                     int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice) {
   if (!client || !key || !data) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   WorkerConfig cfg;
   cfg.replication_factor = replicas == 0 ? 1 : replicas;
@@ -162,12 +172,20 @@ int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint
     cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
   if (ttl_ms >= 0) cfg.ttl_ms = static_cast<uint64_t>(ttl_ms);
   cfg.enable_soft_pin = soft_pin != 0;
+  cfg.preferred_slice = preferred_slice;  // -1 = no slice affinity
   return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
 }
 
 int32_t btpu_put_ec(btpu_client* client, const char* key, const void* data, uint64_t size,
                     uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
                     int64_t ttl_ms, int32_t soft_pin) {
+  return btpu_put_ec2(client, key, data, size, ec_data, ec_parity, preferred_class,
+                      ttl_ms, soft_pin, /*preferred_slice=*/-1);
+}
+
+int32_t btpu_put_ec2(btpu_client* client, const char* key, const void* data, uint64_t size,
+                     uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
+                     int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice) {
   if (!client || !key || !data) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   WorkerConfig cfg;
   cfg.ec_data_shards = ec_data;
@@ -176,6 +194,7 @@ int32_t btpu_put_ec(btpu_client* client, const char* key, const void* data, uint
     cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
   if (ttl_ms >= 0) cfg.ttl_ms = static_cast<uint64_t>(ttl_ms);
   cfg.enable_soft_pin = soft_pin != 0;
+  cfg.preferred_slice = preferred_slice;  // -1 = no slice affinity
   return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
 }
 

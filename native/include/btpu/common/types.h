@@ -384,6 +384,16 @@ struct KeystoneConfig {
   int32_t max_replicas{3};
   int32_t default_replicas{1};
 
+  // Background integrity scrub (leader only): every scrub_interval_sec the
+  // health loop verified-reads up to scrub_objects_per_pass objects' shards
+  // against their writer-stamped CRC32C, healing corrupt replicated shards
+  // byte-identically from a healthy copy and corrupt coded shards through
+  // parity reconstruction. 0 disables. This server-side floor is what makes
+  // raw (verify=false) client reads an honest latency trade. The reference
+  // has no integrity checking at all.
+  int64_t scrub_interval_sec{0};
+  uint32_t scrub_objects_per_pass{16};
+
   // TPU extensions
   bool enable_repair{true};       // re-replicate objects after worker death
   bool tier_aware_eviction{true}; // evict per-tier, not on global average

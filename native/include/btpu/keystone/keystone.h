@@ -88,6 +88,9 @@ struct KeystoneCounters {
   std::atomic<uint64_t> objects_repaired{0};
   std::atomic<uint64_t> objects_lost{0};
   std::atomic<uint64_t> shards_drained{0};
+  std::atomic<uint64_t> scrub_checked{0};   // objects verified by background scrub
+  std::atomic<uint64_t> scrub_corrupt{0};   // corrupt shards found
+  std::atomic<uint64_t> scrub_healed{0};    // corrupt shards restored
 };
 
 class KeystoneService {
@@ -133,6 +136,14 @@ class KeystoneService {
   // limit 0 = unlimited. A read: standbys serve it too.
   Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix,
                                                   uint64_t limit = 0) const;
+
+  // One background-scrub pass (the health loop drives this on
+  // scrub_interval_sec; tools/tests may call it directly): verifies up to
+  // config_.scrub_objects_per_pass complete objects' stamped shards against
+  // their CRC32C and heals what it can — replicated shards byte-identically
+  // from a healthy sibling copy, coded shards via parity reconstruction.
+  // Returns the number of corrupt shards found.
+  size_t run_scrub_once();
 
   Result<ClusterStats> get_cluster_stats() const;
   // Allocator view with per-storage-class breakdowns (metrics exports the
@@ -241,6 +252,7 @@ class KeystoneService {
                         const std::vector<size_t>& dead_idx,
                         const alloc::PoolMap& target_pools);
   void cleanup_stale_workers();
+  void scrub_loop();
 
   // Repair: rebuild placements that referenced a dead worker from surviving
   // replicas over the data plane. Returns number of objects repaired.
@@ -297,7 +309,7 @@ class KeystoneService {
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
   std::atomic<uint64_t> leader_epoch_{0};  // fencing token from promotion
-  std::thread gc_thread_, health_thread_, keepalive_thread_;
+  std::thread gc_thread_, health_thread_, keepalive_thread_, scrub_thread_;
   std::condition_variable_any stop_cv_;
   std::mutex stop_mutex_;
 
@@ -309,6 +321,8 @@ class KeystoneService {
   // death event itself fires only once per worker.
   std::mutex repair_retry_mutex_;
   std::unordered_set<NodeId> repair_retry_;
+  // Background scrub ring position (scrub thread only).
+  ObjectKey scrub_cursor_;
   std::mutex drain_mutex_;               // serializes drain_worker per service
   std::string service_id_;
 };

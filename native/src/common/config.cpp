@@ -330,6 +330,10 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
   if (auto n = root.get("service_refresh_interval_sec"))
     cfg.service_refresh_interval_sec = n->int_or(cfg.service_refresh_interval_sec);
   if (auto n = root.get("gc_interval_sec")) cfg.gc_interval_sec = n->int_or(cfg.gc_interval_sec);
+  if (auto n = root.get("scrub_interval_sec"))
+    cfg.scrub_interval_sec = n->int_or(cfg.scrub_interval_sec);
+  if (auto n = root.get("scrub_objects_per_pass"))
+    cfg.scrub_objects_per_pass = static_cast<uint32_t>(n->int_or(cfg.scrub_objects_per_pass));
   if (auto n = root.get("health_check_interval_sec"))
     cfg.health_check_interval_sec = n->int_or(cfg.health_check_interval_sec);
   if (auto n = root.get("pending_put_timeout_sec"))

@@ -1,6 +1,7 @@
 #include "btpu/keystone/keystone.h"
 
 #include <algorithm>
+#include <optional>
 #include <unordered_set>
 
 #include "btpu/common/log.h"
@@ -845,6 +846,8 @@ ErrorCode KeystoneService::start() {
   if (running_.exchange(true)) return ErrorCode::INVALID_STATE;
   if (config_.enable_gc) gc_thread_ = std::thread([this] { gc_loop(); });
   health_thread_ = std::thread([this] { health_loop(); });
+  if (config_.scrub_interval_sec > 0)
+    scrub_thread_ = std::thread([this] { scrub_loop(); });
   if (coordinator_) keepalive_thread_ = std::thread([this] { keepalive_loop(); });
   return ErrorCode::OK;
 }
@@ -852,7 +855,7 @@ ErrorCode KeystoneService::start() {
 void KeystoneService::stop() {
   if (running_.exchange(false)) {
     stop_cv_.notify_all();
-    for (auto* t : {&gc_thread_, &health_thread_, &keepalive_thread_}) {
+    for (auto* t : {&gc_thread_, &health_thread_, &keepalive_thread_, &scrub_thread_}) {
       if (t->joinable()) t->join();
     }
   }
@@ -995,6 +998,155 @@ void KeystoneService::run_gc_once() {
   }
 }
 
+// ---- background scrub ------------------------------------------------------
+//
+// Server-side integrity floor: round-robin over the object map, verified-
+// reading every writer-stamped shard against its CRC32C and healing what it
+// can — replicated shards byte-identically from a healthy copy, coded shards
+// through parity reconstruction (repair_ec_object already treats a corrupt
+// shard as a repair target). This is what makes raw (verify=false) client
+// reads an honest latency trade: the fleet still converges on intact bytes.
+// The reference has no integrity machinery at all.
+size_t KeystoneService::run_scrub_once() {
+  if (!is_leader_.load() || config_.scrub_objects_per_pass == 0) return 0;
+  struct Target {
+    ObjectKey key;
+    uint64_t epoch{0};
+    std::vector<CopyPlacement> copies;
+  };
+  std::vector<Target> batch;
+  {
+    std::shared_lock lock(objects_mutex_);
+    std::vector<const ObjectKey*> keys;
+    keys.reserve(objects_.size());
+    for (const auto& [k, info] : objects_) {
+      if (info.state == ObjectState::kComplete) keys.push_back(&k);
+    }
+    std::sort(keys.begin(), keys.end(),
+              [](const ObjectKey* a, const ObjectKey* b) { return *a < *b; });
+    if (keys.empty()) return 0;
+    // The smallest keys strictly after the cursor, wrapping — a ring walk.
+    auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_,
+                                  [](const ObjectKey& c, const ObjectKey* k) { return c < *k; });
+    for (size_t taken = 0; taken < config_.scrub_objects_per_pass &&
+                           taken < keys.size();
+         ++taken) {
+      if (start == keys.end()) start = keys.begin();
+      const auto& info = objects_.at(**start);
+      batch.push_back({**start, info.epoch, info.copies});
+      ++start;
+    }
+    scrub_cursor_ = batch.back().key;
+  }
+
+  const alloc::PoolMap target_pools = allocatable_pools_snapshot();
+  constexpr uint64_t kSeg = 4ull << 20;  // bounded scrub memory
+  std::vector<uint8_t> buf;
+  // One segmented read-and-CRC walk shared by every verify/heal path; the
+  // reader fills buf with segment [off, off+n).
+  auto segmented_crc = [&](uint64_t len, auto&& reader) -> std::optional<uint32_t> {
+    uint32_t crc = 0;
+    for (uint64_t off = 0; off < len; off += kSeg) {
+      const uint64_t n = std::min(kSeg, len - off);
+      buf.resize(n);
+      if (!reader(off, n)) return std::nullopt;
+      crc = crc32c(buf.data(), n, crc);
+    }
+    return crc;
+  };
+  size_t corrupt_found = 0;
+  for (const auto& t : batch) {
+    if (!is_leader_.load()) break;
+    ++counters_.scrub_checked;
+    // Coded object: CRC every stamped shard; corrupt ones become repair
+    // targets for parity reconstruction (onto FRESH placements — never an
+    // in-place write through a snapshot).
+    if (!t.copies.empty() && t.copies.front().ec_data_shards > 0) {
+      const CopyPlacement& copy = t.copies.front();
+      if (copy.shard_crcs.size() != copy.shards.size()) continue;  // unstamped
+      std::vector<size_t> corrupt;
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        const auto crc = segmented_crc(copy.shards[i].length, [&](uint64_t off, uint64_t n) {
+          return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+                                     /*is_write=*/false) == ErrorCode::OK;
+        });
+        if (crc && *crc != copy.shard_crcs[i]) corrupt.push_back(i);
+      }
+      if (!corrupt.empty()) {
+        corrupt_found += corrupt.size();
+        counters_.scrub_corrupt += corrupt.size();
+        for (size_t i : corrupt) {
+          LOG_WARN << "scrub: corrupt coded shard " << i << " of " << t.key << " (pool "
+                   << copy.shards[i].pool_id << ", worker " << copy.shards[i].worker_id
+                   << "); reconstructing through parity";
+        }
+        if (repair_ec_object(t.key, t.epoch, copy, corrupt, target_pools)) {
+          counters_.scrub_healed += corrupt.size();
+        }
+      }
+      continue;
+    }
+    // Replicated/striped object: per-copy shard CRCs; a corrupt shard is
+    // restored byte-identically from a sibling copy (shard boundaries
+    // differ per copy, so the heal reads the logical BYTE RANGE through
+    // copy_range_io). The heal is ONE pass per sibling: read a sibling
+    // segment, write it over the corrupt shard, accumulate the CRC; only a
+    // final CRC matching the stamp counts as healed — the destination was
+    // already corrupt, so intermediate wrong bytes cost nothing. Every
+    // segment's read+write runs under a shared objects lock with the epoch
+    // re-checked, so a concurrent mover/remove (unique lock + epoch bump)
+    // can never let the write land on a freed, reallocated range.
+    for (size_t ci = 0; ci < t.copies.size(); ++ci) {
+      const CopyPlacement& copy = t.copies[ci];
+      if (copy.shard_crcs.size() != copy.shards.size()) continue;  // unstamped
+      uint64_t shard_off = 0;
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        const uint64_t len = copy.shards[i].length;
+        const auto crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
+          return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+                                     /*is_write=*/false) == ErrorCode::OK;
+        });
+        if (crc && *crc != copy.shard_crcs[i]) {
+          ++corrupt_found;
+          ++counters_.scrub_corrupt;
+          LOG_WARN << "scrub: corrupt shard " << i << " of " << t.key << " copy " << ci
+                   << " (pool " << copy.shards[i].pool_id << ", worker "
+                   << copy.shards[i].worker_id << "); healing from a sibling copy";
+          bool healed = false;
+          bool stale = false;
+          for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
+            if (sj == ci) continue;
+            const auto src_crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
+              std::shared_lock lock(objects_mutex_);
+              auto it = objects_.find(t.key);
+              if (it == objects_.end() || it->second.epoch != t.epoch) {
+                stale = true;
+                return false;
+              }
+              return transport::copy_range_io(*data_client_, t.copies[sj], shard_off + off,
+                                              buf.data(), n,
+                                              /*is_write=*/false) == ErrorCode::OK &&
+                     transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+                                         /*is_write=*/true) == ErrorCode::OK;
+            });
+            healed = src_crc && *src_crc == copy.shard_crcs[i];
+          }
+          if (healed) {
+            ++counters_.scrub_healed;
+            LOG_INFO << "scrub: healed shard " << i << " of " << t.key << " copy " << ci;
+          } else if (!stale) {
+            LOG_WARN << "scrub: no intact sibling for shard " << i << " of " << t.key
+                     << " copy " << ci << " — detect-only (replica failover still "
+                        "serves reads from other copies)";
+          }
+        }
+        shard_off += len;
+      }
+    }
+  }
+  return corrupt_found;
+}
+
 void KeystoneService::run_health_check_once() {
   if (!is_leader_.load()) return;  // the leader owns eviction/demotion/repair
   cleanup_stale_workers();
@@ -1014,6 +1166,21 @@ void KeystoneService::run_health_check_once() {
     }
   }
   evict_for_pressure();
+}
+
+// Own thread (like GC): a pass does real network I/O, and running it inline
+// on the health thread would stall failure detection and eviction for the
+// pass duration.
+void KeystoneService::scrub_loop() {
+  std::unique_lock<std::mutex> lock(stop_mutex_);
+  while (running_) {
+    stop_cv_.wait_for(lock, std::chrono::seconds(config_.scrub_interval_sec),
+                      [this] { return !running_.load(); });
+    if (!running_) break;
+    lock.unlock();
+    run_scrub_once();
+    lock.lock();
+  }
 }
 
 // ---- object API -----------------------------------------------------------

@@ -64,6 +64,12 @@ std::string MetricsHttpServer::render_metrics() const {
           c.objects_lost.load());
   counter("btpu_shards_drained_total", "shards migrated by graceful worker drains",
           c.shards_drained.load());
+  counter("btpu_scrub_checked_total", "objects verified by the background scrub",
+          c.scrub_checked.load());
+  counter("btpu_scrub_corrupt_total", "corrupt shards found by the background scrub",
+          c.scrub_corrupt.load());
+  counter("btpu_scrub_healed_total", "corrupt shards restored by the background scrub",
+          c.scrub_healed.load());
 
   auto stats = service_.get_cluster_stats();
   if (stats.ok()) {

@@ -840,6 +840,41 @@ def test_multicontroller_device_plane(tmp_path):
         assert client.get("mc/obj") == payload
 
 
+def test_erasure_coding_over_cross_process_device_tier(tmp_path):
+    """Coded objects on DEVICE memory across worker processes: in-process
+    device pools are wire-unreachable (coded shards need a client data
+    path), but a standalone worker's HBM pool is served over the staged TCP
+    lane as a wire region — so rs(2,1) stripes coded shards across three
+    processes' device memory, survives a process SIGKILL via parity, and
+    the repairer restores full tolerance."""
+    from blackbird_tpu.procluster import ProcessCluster
+
+    with ProcessCluster(workers=3, devices_per_worker=1, pool_mb=8,
+                        workdir=str(tmp_path)) as pc:
+        client = pc.wait_ready(timeout=300)
+
+        payload = bytes(bytearray(range(241)) * 2048)  # ~480 KiB
+        client.put("xec/obj", payload, ec=(2, 1))
+        copies = client.placements("xec/obj")
+        assert copies[0]["ec"]["data_shards"] == 2
+        shards = copies[0]["shards"]
+        # One coded shard per process, all on device-tier pools.
+        assert {s["worker"] for s in shards} == {"mc-0", "mc-1", "mc-2"}
+        assert all(s["class"] == "hbm_tpu" for s in shards), shards
+        assert client.get("xec/obj") == payload
+
+        pc.kill_worker(0)  # a device-owning process dies with its shard
+        wait_for(lambda: pc.client().stats()["workers"] == 2, timeout=30,
+                 what="process death detection")
+        assert client.get("xec/obj") == payload  # degraded read via parity
+        wait_for(lambda: pc.objects_repaired() >= 1, timeout=60,
+                 what="cross-process EC repair")
+        after = client.placements("xec/obj")
+        assert len(after[0]["shards"]) == 3
+        assert all(s["worker"] != "mc-0" for s in after[0]["shards"])
+        assert client.get("xec/obj") == payload
+
+
 def test_multislice_placement_prefers_the_requested_slice(tmp_path):
     """Acceptance ladder item 5, multi-slice flavor: two worker PROCESSES on
     DIFFERENT TPU slices under one keystone. preferred_slice ranks the

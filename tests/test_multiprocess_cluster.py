@@ -840,6 +840,35 @@ def test_multicontroller_device_plane(tmp_path):
         assert client.get("mc/obj") == payload
 
 
+def test_drain_evacuates_device_tier_across_processes(tmp_path):
+    """TPU preemption on the device tier: drain a LIVE device-owning worker
+    process and every shard it holds — replicas=1 included — streams off
+    its device memory onto the other process's devices before it retires.
+    A crash would need a surviving replica; drain needs none."""
+    from blackbird_tpu.procluster import ProcessCluster
+
+    with ProcessCluster(workers=2, devices_per_worker=2, pool_mb=8,
+                        workdir=str(tmp_path)) as pc:
+        from blackbird_tpu import StorageClass
+
+        client = pc.wait_ready(timeout=300)
+        payload = bytes(bytearray(range(251)) * 4096)  # ~1 MiB
+        client.put("dr/obj", payload, replicas=1, max_workers=4,
+                   preferred_class=StorageClass.HBM_TPU)
+        before = {s["worker"] for c in client.placements("dr/obj")
+                  for s in c["shards"]}
+        assert before == {"mc-0", "mc-1"}  # striped across both processes
+
+        moved = client.drain_worker("mc-0")
+        assert moved >= 1
+        after = [s for c in client.placements("dr/obj") for s in c["shards"]]
+        assert all(s["worker"] == "mc-1" for s in after), after
+        assert all(s["class"] == "hbm_tpu" for s in after), after
+        assert client.get("dr/obj") == payload
+        wait_for(lambda: pc.client().stats()["workers"] == 1, timeout=20,
+                 what="drained worker retired")
+
+
 def test_erasure_coding_over_cross_process_device_tier(tmp_path):
     """Coded objects on DEVICE memory across worker processes: in-process
     device pools are wire-unreachable (coded shards need a client data

@@ -840,6 +840,87 @@ def test_multicontroller_device_plane(tmp_path):
         assert client.get("mc/obj") == payload
 
 
+def test_churn_worker_killed_and_replaced_under_write_load(tmp_path):
+    """Data-plane churn: a writer streams replicated puts while a worker
+    process is SIGKILLed mid-stream and a REPLACEMENT worker (fresh id)
+    joins. Every put that REPORTED success must read back byte-correct at
+    the end — repair + placement re-routing absorb the loss, and the
+    replacement is absorbed into service."""
+    import threading
+
+    from blackbird_tpu import Client
+
+    coord_port = free_port()
+    keystone_port = free_port()
+    keystone_cfg = tmp_path / "keystone.yaml"
+    keystone_cfg.write_text(
+        f"""cluster_id: churn_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{keystone_port}
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 2
+""")
+    procs = []
+    spawn = make_spawner(procs)
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
+        wait_for(lambda: port_open(keystone_port), what="bb-keystone")
+        workers = []
+        for i in range(3):
+            cfg = write_worker_config(tmp_path, f"chw-{i}", f"127.0.0.1:{coord_port}",
+                                      cluster_id="churn_cluster")
+            workers.append(spawn([str(BUILD / "bb-worker"), "--config", str(cfg)],
+                                 f"worker-{i}"))
+
+        client = Client(f"127.0.0.1:{keystone_port}")
+        wait_for(lambda: client.stats()["workers"] == 3, timeout=15, what="3 workers")
+
+        payload_for = lambda i: bytes([(i * 7) % 251]) * (64 * 1024 + i)
+        succeeded: list[int] = []
+        victim_killed = threading.Event()
+        total = 100
+
+        def writer():
+            for i in range(total):
+                try:
+                    client.put(f"ch/{i}", payload_for(i), replicas=2, max_workers=1)
+                    succeeded.append(i)
+                except Exception:  # noqa: BLE001 - churn window
+                    pass
+                if i == 10:
+                    victim_killed.set()
+                # The stream must OUTLAST failure detection (2s heartbeat TTL
+                # + 1s health tick): puts fail against the dead worker until
+                # the prune re-routes placement, then succeed again.
+                time.sleep(0.05)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        victim_killed.wait(timeout=30)
+        workers[0].kill()  # SIGKILL a data-plane process mid-stream
+        # A REPLACEMENT worker with a fresh id joins while writes continue.
+        rcfg = write_worker_config(tmp_path, "chw-new", f"127.0.0.1:{coord_port}",
+                                   cluster_id="churn_cluster")
+        spawn([str(BUILD / "bb-worker"), "--config", str(rcfg)], "worker-new")
+        t.join(timeout=120)
+        assert not t.is_alive()
+
+        # Dead worker pruned, replacement absorbed.
+        wait_for(lambda: client.stats()["workers"] == 3, timeout=20,
+                 what="replacement worker in service")
+        # The stream recovered past the kill and EVERY acked put is intact.
+        assert len(succeeded) >= total // 3, len(succeeded)
+        assert succeeded[-1] == total - 1, succeeded[-5:]
+        for i in succeeded:
+            assert client.get(f"ch/{i}") == payload_for(i), f"ch/{i} corrupted"
+    finally:
+        teardown(procs, timeout=5)
+
+
 def test_drain_evacuates_device_tier_across_processes(tmp_path):
     """TPU preemption on the device tier: drain a LIVE device-owning worker
     process and every shard it holds — replicas=1 included — streams off

@@ -41,25 +41,36 @@ def write_worker_yaml(path, *, worker_id: str, cluster_id: str,
 
     Each pool dict: {"id", "storage_class", "capacity" (int bytes or a
     "8MB"-style string), optional "device_id"}."""
+
+    def q(value) -> str:
+        # Interpolated strings are single-quoted so ':'/'#' cannot corrupt
+        # the document; the native parser strips one layer of quotes but has
+        # no escape for an embedded quote, so those are rejected outright.
+        s = str(value)
+        if "'" in s or '"' in s or "\n" in s:
+            raise ValueError(f"unrepresentable YAML scalar: {s!r}")
+        return f"'{s}'"
+
     lines = [
-        f"worker_id: {worker_id}",
-        f"cluster_id: {cluster_id}",
-        f"coord_endpoints: {coord_endpoints}",
+        f"worker_id: {q(worker_id)}",
+        f"cluster_id: {q(cluster_id)}",
+        f"coord_endpoints: {q(coord_endpoints)}",
         "transport: tcp",
-        f"listen_host: {listen_host}",
-        f"slice_id: {slice_id}",
-        f"host_id: {host_id}",
+        f"listen_host: {q(listen_host)}",
+        f"slice_id: {slice_id:d}",
+        f"host_id: {host_id:d}",
         "heartbeat:",
-        f"  interval_ms: {heartbeat_interval_ms}",
-        f"  ttl_ms: {heartbeat_ttl_ms}",
+        f"  interval_ms: {heartbeat_interval_ms:d}",
+        f"  ttl_ms: {heartbeat_ttl_ms:d}",
         "pools:",
     ]
     for pool in pools:
-        lines.append(f"  - id: {pool['id']}")
-        lines.append(f"    storage_class: {pool['storage_class']}")
-        lines.append(f"    capacity: {pool['capacity']}")
-        if pool.get("device_id"):
-            lines.append(f"    device_id: {pool['device_id']}")
+        lines.append(f"  - id: {q(pool['id'])}")
+        lines.append(f"    storage_class: {q(pool['storage_class'])}")
+        lines.append(f"    capacity: {q(pool['capacity'])}")
+        # `is not None`, not truthiness: device 0 is a real device.
+        if pool.get("device_id") is not None:
+            lines.append(f"    device_id: {q(pool['device_id'])}")
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
 

@@ -47,14 +47,19 @@ struct ClientOptions {
   // For latency-critical paths that rely on background scrub instead; the
   // per-call `verify` overrides on get/get_into/get_many take precedence.
   bool verify_reads{true};
-  // Placement cache TTL for single-object VERIFIED reads (0 = off). Tiny
-  // objects are metadata-RPC-bound: a cached placement skips the keystone
-  // round trip, and staleness is safe because the content CRC catches any
-  // moved/rewritten bytes — on ANY failure through a cached placement the
-  // entry is dropped and the read retries with fresh metadata. Raw
-  // (verify=false) reads never use the cache: they could not detect stale
-  // bytes. Remote clients only; embedded metadata is already in-process.
-  uint32_t placement_cache_ms{1000};
+  // Placement cache TTL for single-object VERIFIED reads (0 = off, the
+  // default). Tiny objects are metadata-RPC-bound: a cached placement skips
+  // the keystone round trip, and most staleness is caught by the content CRC
+  // (moved/rewritten bytes fail verification, the entry is dropped, and the
+  // read retries with fresh metadata). OFF by default because the CRC is not
+  // airtight across clients: if ANOTHER client removes and re-puts this key
+  // within the TTL, the cached entry still carries the old object's
+  // content_crc, and until the freed ranges are reused a verified read can
+  // return the deleted object's bytes with a passing CRC. Opt in only when
+  // the workload is read-mostly or keys are immutable-once-written (the
+  // common object-store discipline). Raw (verify=false) reads never use the
+  // cache; remote clients only — embedded metadata is already in-process.
+  uint32_t placement_cache_ms{0};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).

@@ -207,6 +207,11 @@ class KeystoneService {
   // outcome so commit points (put_complete) can fail closed.
   ErrorCode persist_object(const ObjectKey& key, const ObjectInfo& info);
   ErrorCode unpersist_object(const ObjectKey& key);
+  // For mutation sites that cannot fail closed (the splice already landed in
+  // memory): queue the key so the health loop re-persists it from current
+  // memory until the durable record catches up.
+  void mark_persist_dirty(const ObjectKey& key);
+  void retry_dirty_persists();
   // Routes a leader-owned coordinator write through the fence (plain write
   // when HA is off). FENCED triggers fence_stepdown().
   ErrorCode coord_put_record(const std::string& key, const std::string& value);
@@ -321,6 +326,13 @@ class KeystoneService {
   // death event itself fires only once per worker.
   std::mutex repair_retry_mutex_;
   std::unordered_set<NodeId> repair_retry_;
+  // Objects whose in-memory state advanced but whose durable-record write
+  // failed (coordinator outage, fence race): repair/demotion/drain splices
+  // are irreversible in memory, so "fail closed" is not available to them —
+  // instead the health loop re-persists these keys from current memory
+  // until the record catches up (retry_dirty_persists).
+  std::mutex persist_retry_mutex_;
+  std::unordered_set<ObjectKey> persist_retry_;
   // Background scrub ring position (scrub thread only).
   ObjectKey scrub_cursor_;
   std::mutex drain_mutex_;               // serializes drain_worker per service

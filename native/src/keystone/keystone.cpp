@@ -610,6 +610,58 @@ ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
   return ec == ErrorCode::COORD_KEY_NOT_FOUND ? ErrorCode::OK : ec;
 }
 
+void KeystoneService::mark_persist_dirty(const ObjectKey& key) {
+  if (!coordinator_ || !config_.persist_objects) return;
+  std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+  persist_retry_.insert(key);
+}
+
+void KeystoneService::retry_dirty_persists() {
+  if (!coordinator_ || !config_.persist_objects) return;
+  std::vector<ObjectKey> keys;
+  {
+    std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+    if (persist_retry_.empty()) return;
+    keys.assign(persist_retry_.begin(), persist_retry_.end());
+  }
+  for (const auto& key : keys) {
+    if (!is_leader_.load()) return;  // deposed: the promoted leader owns truth
+    // The coordinator RPC runs under the shared objects lock on purpose: no
+    // mutator (unique lock) can advance the object or re-create a removed
+    // key mid-write, so the retry can never clobber a NEWER durable record
+    // with this snapshot. Rare path (persist previously failed), bounded by
+    // the coordinator RPC timeout.
+    std::shared_lock lock(objects_mutex_);
+    auto it = objects_.find(key);
+    ErrorCode ec;
+    bool caught_up = false;
+    if (it == objects_.end()) {
+      // Removed since it went dirty. The remove itself failed closed on its
+      // durable delete, so any remaining record for this key is the stale
+      // one this entry tracked — deleting it is the catch-up.
+      ec = unpersist_object(key);
+      caught_up = ec == ErrorCode::OK;
+    } else if (it->second.state != ObjectState::kComplete) {
+      // Removed AND re-created: the successful remove already deleted the
+      // stale record, and a pending object must leave no durable trace until
+      // put_complete commits — drop the entry without writing anything.
+      ec = ErrorCode::OK;
+    } else {
+      ec = persist_object(key, it->second);
+      caught_up = ec == ErrorCode::OK;
+    }
+    if (ec == ErrorCode::OK) {
+      // Erase while still holding the objects lock: mutators mark keys dirty
+      // under the unique lock, so a FRESHER dirty mark (splice + failed
+      // persist racing this loop) cannot be interleaved and wiped here.
+      std::lock_guard<std::mutex> dirty(persist_retry_mutex_);
+      persist_retry_.erase(key);
+      if (caught_up)
+        LOG_INFO << "durable record for " << key << " caught up after deferred persist";
+    }
+  }
+}
+
 ErrorCode KeystoneService::coord_put_record(const std::string& key, const std::string& value) {
   if (!config_.enable_ha) return coordinator_->put(key, value);
   auto ec = coordinator_->put_fenced(key, value, election_name(), leader_epoch_.load());
@@ -825,6 +877,13 @@ bool KeystoneService::on_promoted() {
 // never persisted; the new leader knows nothing about them, their clients
 // fail over and retry, and keeping their ranges would fight the mirror.
 void KeystoneService::on_demoted() {
+  // This node's deferred-persist debts die with its term: the promoted
+  // leader owns the durable records now, and replaying a stale entry after
+  // re-promotion could unpersist a record the reconcile intentionally kept.
+  {
+    std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+    persist_retry_.clear();
+  }
   size_t dropped = 0;
   std::unique_lock lock(objects_mutex_);
   for (auto it = objects_.begin(); it != objects_.end();) {
@@ -1093,9 +1152,10 @@ size_t KeystoneService::run_scrub_once() {
     // segment, write it over the corrupt shard, accumulate the CRC; only a
     // final CRC matching the stamp counts as healed — the destination was
     // already corrupt, so intermediate wrong bytes cost nothing. Every
-    // segment's read+write runs under a shared objects lock with the epoch
-    // re-checked, so a concurrent mover/remove (unique lock + epoch bump)
-    // can never let the write land on a freed, reallocated range.
+    // segment's WRITE runs under a shared objects lock with the epoch
+    // re-checked (the sibling read stays lock-free), so a concurrent
+    // mover/remove (unique lock + epoch bump) can never let the write land
+    // on a freed, reallocated range.
     for (size_t ci = 0; ci < t.copies.size(); ++ci) {
       const CopyPlacement& copy = t.copies[ci];
       if (copy.shard_crcs.size() != copy.shards.size()) continue;  // unstamped
@@ -1117,16 +1177,21 @@ size_t KeystoneService::run_scrub_once() {
           for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
             if (sj == ci) continue;
             const auto src_crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
+              // The sibling read runs lock-free so a hung source worker never
+              // stalls metadata writers behind objects_mutex_; a read off a
+              // concurrently freed range yields garbage, which the epoch
+              // re-check below (or the final CRC gate) discards.
+              if (transport::copy_range_io(*data_client_, t.copies[sj], shard_off + off,
+                                           buf.data(), n,
+                                           /*is_write=*/false) != ErrorCode::OK)
+                return false;
               std::shared_lock lock(objects_mutex_);
               auto it = objects_.find(t.key);
               if (it == objects_.end() || it->second.epoch != t.epoch) {
                 stale = true;
                 return false;
               }
-              return transport::copy_range_io(*data_client_, t.copies[sj], shard_off + off,
-                                              buf.data(), n,
-                                              /*is_write=*/false) == ErrorCode::OK &&
-                     transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+              return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
                                          /*is_write=*/true) == ErrorCode::OK;
             });
             healed = src_crc && *src_crc == copy.shard_crcs[i];
@@ -1149,6 +1214,7 @@ size_t KeystoneService::run_scrub_once() {
 
 void KeystoneService::run_health_check_once() {
   if (!is_leader_.load()) return;  // the leader owns eviction/demotion/repair
+  retry_dirty_persists();
   cleanup_stale_workers();
   if (config_.enable_repair) {
     // Finish repair passes that a coordinator outage or deposition cut
@@ -1640,7 +1706,10 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
                     staged[0].shards.begin(), staged[0].shards.end());
       it->second.epoch = next_epoch_.fetch_add(1);
       epoch_now[m.key] = it->second.epoch;
-      persist_object(m.key, it->second);
+      if (persist_object(m.key, it->second) != ErrorCode::OK) {
+        // Splice landed in memory; the health loop re-persists.
+        mark_persist_dirty(m.key);
+      }
       bump_view();
       ++moved;
     }
@@ -2112,8 +2181,12 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       // but the durable record is stale. A coordinator outage heals at this
       // key's next successful persist; a fence means this node is deposed
       // and the promoted leader's reconcile-on-promotion owns the truth.
-      // Either way the repair cannot be claimed.
+      // Either way the repair cannot be claimed. The splice is irreversible
+      // in memory, so queue the key for the health loop's re-persist — a
+      // healthy object is never revisited by repair, so nothing else would
+      // ever write the record again.
       LOG_ERROR << "repair of " << p.key << " not durably recorded: " << to_string(ec);
+      mark_persist_dirty(p.key);
       bump_view();
       deferred = true;
       continue;
@@ -2403,7 +2476,18 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
       it->second.copies.front().shard_crcs[d] = rebuilt_crcs[j];
   }
   it->second.epoch = next_epoch_.fetch_add(1);
-  persist_object(key, it->second);
+  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
+    // Same discipline as the replicated merge path: the splice already landed
+    // locally (memory + allocator are consistent) but the durable record is
+    // stale — a promoted leader would still map the condemned shard
+    // locations. The repair cannot be claimed (scrub_healed stays honest),
+    // and because the now-healthy object will never be revisited by repair,
+    // the key is queued for the health loop's re-persist.
+    LOG_ERROR << "ec repair of " << key << " not durably recorded: " << to_string(ec);
+    mark_persist_dirty(key);
+    bump_view();
+    return false;
+  }
   bump_view();
   LOG_INFO << "ec repair rebuilt " << targets.size() << " shard(s) of " << key;
   return true;
@@ -2679,7 +2763,17 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     carry_shard_crcs(*moved_src, copy);
   }
   it->second.epoch = next_epoch_.fetch_add(1);
-  persist_object(key, it->second);
+  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
+    // The move already landed locally; the durable record still names the old
+    // (now released) placements. Don't claim the demotion — kSkipped keeps
+    // the pressure loop honest — and queue the key for the health loop's
+    // re-persist: a never-again-mutated key would otherwise keep its stale
+    // record forever.
+    LOG_ERROR << "demotion of " << key << " not durably recorded: " << to_string(ec);
+    mark_persist_dirty(key);
+    bump_view();
+    return DemoteOutcome::kSkipped;
+  }
   bump_view();
   return DemoteOutcome::kDemoted;
 }

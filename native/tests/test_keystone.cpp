@@ -724,6 +724,113 @@ BTEST(Keystone, RestartRecoversPersistedObjects) {
   }
 }
 
+namespace {
+// Fails the Nth object-record put (1-based), passing all others: repair and
+// demotion splice memory BEFORE their durable write, so a failed write there
+// must be healed later by the health loop's re-persist.
+class FlakyCoordinator : public coord::MemCoordinator {
+ public:
+  explicit FlakyCoordinator(std::string cluster)
+      : prefix_(coord::objects_prefix(std::move(cluster))) {}
+  ErrorCode put(const std::string& key, const std::string& value) override {
+    if (key.rfind(prefix_, 0) == 0 && armed_.load()) {
+      if (countdown_.fetch_sub(1) == 1) {
+        armed_.store(false);
+        ++failed_;
+        return ErrorCode::COORD_ERROR;
+      }
+    }
+    return coord::MemCoordinator::put(key, value);
+  }
+  void fail_nth_object_put(int n) {
+    countdown_.store(n);
+    armed_.store(true);
+  }
+  int failed() const { return failed_.load(); }
+
+ private:
+  const std::string prefix_;
+  std::atomic<bool> armed_{false};
+  std::atomic<int> countdown_{0};
+  std::atomic<int> failed_{0};
+};
+}  // namespace
+
+BTEST(Keystone, DeferredPersistCatchesUpAfterCoordinatorOutage) {
+  // Repair's merge persists AFTER the splice lands in memory; fail closed is
+  // unavailable there. A transient coordinator outage at that exact write
+  // must not leave the durable record naming the condemned (released) shard
+  // placements forever — the health loop re-persists from current memory.
+  auto cfg = fast_config();
+  auto coordinator = std::make_shared<FlakyCoordinator>(cfg.cluster_id);
+  KeystoneService ks(cfg, coordinator);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20), w3("w3", 1 << 20);
+  // Advertised through the coordinator so the post-outage restart can
+  // re-adopt placements against replayed pools.
+  for (auto* w : {&w1, &w2, &w3}) {
+    coordinator->put(coord::worker_key(cfg.cluster_id, w->id), encode_worker_info(w->info()));
+    coordinator->put(coord::pool_key(cfg.cluster_id, w->id, w->pool.id),
+                     encode_pool_record(w->pool));
+    coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w->id), "alive", 60000);
+  }
+  BT_EXPECT(eventually([&] { return ks.memory_pools().size() == 3; }));
+
+  WorkerConfig wc;
+  wc.replication_factor = 2;
+  wc.max_workers_per_copy = 1;
+  auto placed = ks.put_start("durable/repaired", 32 * 1024, wc);
+  BT_ASSERT_OK(placed);
+  auto client = transport::make_transport_client();
+  std::vector<uint8_t> payload(32 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 13 + 5);
+  for (const auto& copy : placed.value()) {
+    uint64_t off = 0;
+    for (const auto& shard : copy.shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
+                              shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+  }
+  BT_EXPECT(ks.put_complete("durable/repaired") == ErrorCode::OK);
+
+  // Repair writes the record twice: the pruned state (pass 1, fail-closed)
+  // and the merged repaired state (pass 2, splice-first). Fail pass 2's.
+  coordinator->fail_nth_object_put(2);
+  const NodeId victim = placed.value()[0].shards[0].worker_id;
+  BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
+  BT_EXPECT_EQ(coordinator->failed(), 1);
+  // The repair is NOT claimed while the durable record lags...
+  BT_EXPECT_EQ(ks.counters().objects_repaired.load(), 0ull);
+  // ...but memory already serves two healthy copies.
+  BT_EXPECT_EQ(ks.get_workers("durable/repaired").value().size(), 2u);
+
+  // The health loop re-persists the dirty key from current memory.
+  ks.run_health_check_once();
+
+  // Restart proves durability: a fresh keystone replays TWO copies, none on
+  // the dead worker, bytes intact through re-adopted placements.
+  ks.stop();
+  KeystoneService ks2(cfg, coordinator);
+  BT_ASSERT(ks2.initialize() == ErrorCode::OK);
+  auto got = ks2.get_workers("durable/repaired");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value().size(), 2u);
+  for (const auto& copy : got.value()) {
+    uint64_t off = 0;
+    std::vector<uint8_t> back(payload.size(), 0);
+    for (const auto& shard : copy.shards) {
+      BT_EXPECT_NE(shard.worker_id, victim);
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->read(shard.remote, mem.remote_addr, mem.rkey, back.data() + off,
+                             shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+    BT_EXPECT(std::memcmp(back.data(), payload.data(), payload.size()) == 0);
+  }
+}
+
 BTEST(Keystone, SingleReplicaLostObjectIsDropped) {
   auto cfg = fast_config();
   KeystoneService ks(cfg, nullptr);

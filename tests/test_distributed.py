@@ -30,16 +30,17 @@ def test_worker_config_matches_local_devices(tmp_path):
         "127.0.0.1:9999", pool_bytes_per_device=4 << 20,
         dram_pool_bytes=8 << 20, cluster_id="podtest", workdir=str(tmp_path))
     text = cfg.read_text()
-    assert "worker_id: podtest-host0" in text
+    # String scalars are single-quoted so ids carrying ':' survive the parser.
+    assert "worker_id: 'podtest-host0'" in text
     assert "host_id: 0" in text
     # One hbm pool per local device, addressed by local ordinal.
     for d in range(len(jax.local_devices())):
-        assert f"device_id: tpu:{d}" in text
-    assert text.count("storage_class: hbm_tpu") == len(jax.local_devices())
-    assert "storage_class: ram_cpu" in text
+        assert f"device_id: 'tpu:{d}'" in text
+    assert text.count("storage_class: 'hbm_tpu'") == len(jax.local_devices())
+    assert "storage_class: 'ram_cpu'" in text
     # The advertised address must be one peers can reach — never the
     # 0.0.0.0 bind-all that the transport would rewrite to loopback.
-    assert "listen_host: 0.0.0.0" not in text
+    assert "listen_host: '0.0.0.0'" not in text
 
 
 def test_derived_worker_serves_device_tier_end_to_end(tmp_path):

@@ -29,11 +29,21 @@ Replaces the round-1 design (per-1MiB-chunk copy-on-write lists, one ctypes
 + jit dispatch per chunk) that measured 0.01 GB/s on a real TPU: per-object
 device ops were latency-bound. With batching, throughput is limited by the
 host<->device link, not the framework.
+
+Host-view mode: when a device's buffers are host-addressable (CPU backend;
+any unified-memory platform), regions detect it at alloc with a
+write-through probe and serve ALL their I/O by plain memcpy through a
+stable zero-copy host view — no per-op device dispatch at all, which is
+what makes the cross-process staged lane to a CPU-device worker run at
+memory speed. Real TPU HBM is not host-addressable; those regions keep the
+dispatch-thin jit paths (single-run ops compute their page indices on
+device from a scalar start, skipping the index/meta transfers).
 """
 
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import warnings
 
@@ -88,7 +98,8 @@ def _pow2_at_least(n: int) -> int:
 class JaxHbmProvider:
     """Page-batched device-buffer regions managed through JAX."""
 
-    def __init__(self, page_bytes: int = 64 << 10, max_staging_bytes: int = 32 << 20):
+    def __init__(self, page_bytes: int = 64 << 10, max_staging_bytes: int = 32 << 20,
+                 host_view: str | bool = "auto"):
         import jax
 
         # Donation is an optimization (in-place region updates); backends
@@ -100,6 +111,21 @@ class JaxHbmProvider:
             "ignore", message="Some donated buffers were not usable")
 
         self._jax = jax
+        # Host-view mode: when a device's buffers are host-addressable
+        # (the CPU backend — and by extension any unified-memory platform
+        # where np.asarray of a committed array is a zero-copy alias), every
+        # region I/O is served by plain memcpy through a stable host view of
+        # the region buffer, with ZERO per-op device dispatches. The region's
+        # jax buffer is never donated in this mode, so the view stays valid
+        # for the region's lifetime, and jit consumers (none in steady state)
+        # still see view writes because the memory IS the device memory.
+        # Real TPUs are not host-addressable: the probe fails and the jit
+        # scatter/gather paths below serve as before. "auto" probes at alloc;
+        # False forces the device path (used by tests to keep it covered);
+        # BTPU_HBM_HOST_VIEW=0 force-disables process-wide.
+        if os.environ.get("BTPU_HBM_HOST_VIEW") == "0":
+            host_view = False
+        self._host_view = host_view
         self.page_bytes = page_bytes
         # Upper bound on the flat host->device staging array per flush round;
         # larger batches are split so the device never needs more than this
@@ -109,6 +135,7 @@ class JaxHbmProvider:
         self.max_staging_bytes = max_staging_bytes
         self._lock = threading.Lock()            # region table
         self._regions: dict[int, dict] = {}
+        self._view_regions = 0                   # count with a host view
         self._next_id = 1
         self._struct = None                      # built in register()
         self._dirty: set[int] = set()            # regions with in-flight writes
@@ -153,6 +180,36 @@ class JaxHbmProvider:
         # donated away at another op's dispatch), and blocking on it proves
         # the merge — and therefore the staging read — completed.
         self._fence_fn = jax.jit(lambda r: r[:1, :1])
+        # Single-run fast paths: the serving-shape op is ONE contiguous
+        # whole-page run per region (a 1 MiB staged-lane read/write). Those
+        # skip the index/meta arrays entirely — the page index is computed ON
+        # DEVICE from a scalar start, saving one host->device transfer per
+        # op (device links pay per-operation latency). Cached per padded run
+        # length, so the jit cache stays log2-bounded like the idx paths.
+        self._read_run_fns: dict[int, object] = {}
+        self._write_run_fns: dict[int, object] = {}
+
+    def _read_run_fn(self, m: int):
+        fn = self._read_run_fns.get(m)
+        if fn is None:
+            jnp = self._jax.numpy
+            fn = self._read_run_fns[m] = self._jax.jit(
+                lambda r, p0: r.at[p0 + jnp.arange(m, dtype=jnp.int32)].get(mode="clip"))
+        return fn
+
+    def _write_run_fn(self, m: int):
+        fn = self._write_run_fns.get(m)
+        if fn is None:
+            jnp = self._jax.numpy
+
+            def set_run(r, pages, p0, n_valid):
+                k = jnp.arange(m, dtype=jnp.int32)
+                # Padding rows get an out-of-bounds index -> dropped.
+                idx = jnp.where(k < n_valid, p0 + k, r.shape[0])
+                return r.at[idx].set(pages, mode="drop")
+
+            fn = self._write_run_fns[m] = self._jax.jit(set_run, donate_argnums=0)
+        return fn
 
     # -- device helpers ----------------------------------------------------
 
@@ -181,14 +238,21 @@ class JaxHbmProvider:
             # second executable once the donated output comes back committed.
             buf = self._jax.device_put(buf, device)
             buf.block_until_ready()
+            view = self._probe_host_view(buf, device, n_pages)
             with self._lock:
                 region_id = self._next_id
                 self._next_id += 1
+                if view is not None:
+                    self._view_regions += 1
                 self._regions[region_id] = {
                     "buf": buf,
                     "size": size,
                     "n_pages": n_pages,
                     "device": device,
+                    # Zero-copy writable alias of the device buffer, or None.
+                    # When set, ALL I/O for this region is plain memcpy and
+                    # the buffer is never donated (see __init__ notes).
+                    "view": view,
                     # Serializes dispatches per region: the write path donates
                     # the buffer, so a concurrent reader must never pick up a
                     # reference that is about to be invalidated.
@@ -199,10 +263,38 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001 - must not raise through the C ABI
             return 1
 
+    def _probe_host_view(self, buf, device, n_pages):
+        """A writable zero-copy alias of `buf`'s memory, or None.
+
+        Gated on the platform claiming host-addressable buffers, then PROVEN
+        by a write-through probe: a byte written through the candidate view
+        must be observed by a jit read of the buffer (np.asarray may return a
+        cached COPY on some stacks, which would silently disconnect the view
+        from device memory — only the round trip is trusted)."""
+        if self._host_view is False or device.platform != "cpu":
+            return None
+        try:
+            ro = np.asarray(buf)
+            if not ro.flags["C_CONTIGUOUS"] or ro.size != n_pages * self.page_bytes:
+                return None
+            ptr = ro.__array_interface__["data"][0]
+            view = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(n_pages * self.page_bytes,))
+            view[0] = 0xAA
+            seen = int(np.asarray(self._fence_fn(buf)).reshape(())[()])
+            view[0] = 0
+            return view if seen == 0xAA else None
+        except Exception:  # noqa: BLE001 - fall back to the device path
+            return None
+
     def _free(self, _ctx, region_id):
         with self._lock:
             self._dirty.discard(region_id)
-            return 0 if self._regions.pop(region_id, None) is not None else 1
+            region = self._regions.pop(region_id, None)
+            if region is not None and region["view"] is not None:
+                self._view_regions -= 1
+            return 0 if region is not None else 1
 
     # -- page decomposition (host-side, pure numpy) ------------------------
 
@@ -361,6 +453,29 @@ class JaxHbmProvider:
     def _write_aligned_round(self, regions, per_region) -> None:
         jax = self._jax
         P = self.page_bytes
+        if len(per_region) == 1:
+            ((region_id, runs),) = per_region.items()
+            if len(runs) == 1:
+                # Single region, single contiguous run (the serving shape):
+                # skip the meta array — the scatter index is p0 + arange
+                # computed on device, bounded by n_valid so padding rows
+                # drop. One staging fill, one device_put, one dispatch.
+                p0, n, host = runs[0]
+                region = regions[region_id]
+                m_padded = _pow2_at_least(n)
+                entry = self._staging_entry(region["device"])
+                with entry["lock"]:
+                    flat, slot = self._staging_for(entry, m_padded, P)
+                    flat[:n] = host.reshape(n, P)
+                    dev_flat = jax.device_put(flat, region["device"])
+                    with region["lock"]:
+                        region["buf"] = self._write_run_fn(m_padded)(
+                            region["buf"], dev_flat, np.int32(p0), np.int32(n))
+                        slot["fences"].append(self._fence_fn(region["buf"]))
+                    with self._lock:
+                        if region_id in self._regions:
+                            self._dirty.add(region_id)
+                return
         by_device: dict = {}
         for region_id, runs in per_region.items():
             by_device.setdefault(regions[region_id]["device"], []).append(
@@ -403,9 +518,47 @@ class JaxHbmProvider:
                         if region_id in self._regions:
                             self._dirty.add(region_id)
 
+    # -- host-view fast path -----------------------------------------------
+
+    def _serve_view_vecs(self, vecs, *, is_write):
+        """Serves vecs whose region has a host view; returns the remainder.
+
+        Pure memcpy, no locks: writes are synchronous (nothing to flush) and
+        concurrent overlapping ops are the client's contract, exactly as on
+        the DRAM tier. Bounds are validated here because served vecs never
+        reach the device-path validators. On platforms with no host-visible
+        regions (real TPUs) this is a single counter check — the hot path
+        pays no extra table copy or vec pass."""
+        with self._lock:
+            if self._view_regions == 0:
+                return vecs
+            regions = dict(self._regions)
+        rest = []
+        for vec in vecs:
+            region_id, offset, buf, length = vec
+            region = regions.get(region_id)
+            if region is None or offset + length > region["size"]:
+                raise ValueError("bad region/range")
+            view = region["view"]
+            if view is None:
+                rest.append(vec)
+                continue
+            if length == 0:
+                continue
+            host = np.ctypeslib.as_array(
+                ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), shape=(length,))
+            if is_write:
+                view[offset : offset + length] = host
+            else:
+                host[:] = view[offset : offset + length]
+        return rest
+
     # -- batched write -----------------------------------------------------
 
     def _write_vecs(self, vecs):
+        vecs = self._serve_view_vecs(vecs, is_write=True)
+        if not vecs:
+            return
         aligned = self._aligned_runs(vecs, check_overlap=True)
         if aligned is not None:
             self._write_vecs_aligned(*aligned)
@@ -517,6 +670,14 @@ class JaxHbmProvider:
             region = regions[region_id]
             total = sum(n for _p0, n, _h in runs)
             m_padded = _pow2_at_least(total)
+            if len(runs) == 1:
+                # Single contiguous run (the serving shape): the page index
+                # is p0 + arange computed on device — no idx transfer.
+                # Padding rows clip to the last page and are discarded below.
+                with region["lock"]:
+                    out = self._read_run_fn(m_padded)(region["buf"], np.int32(runs[0][0]))
+                fetches.append((out, runs))
+                continue
             idx = np.zeros(m_padded, dtype=np.int32)
             row = 0
             for p0, n, _h in runs:
@@ -536,6 +697,9 @@ class JaxHbmProvider:
                 row += n
 
     def _read_vecs(self, vecs):
+        vecs = self._serve_view_vecs(vecs, is_write=False)
+        if not vecs:
+            return
         aligned = self._aligned_runs(vecs, check_overlap=False)
         if aligned is not None:
             self._read_vecs_aligned(*aligned)
@@ -627,6 +791,23 @@ class JaxHbmProvider:
                 return 1
             if length == 0:
                 return 0
+            if src["view"] is not None and dst["view"] is not None:
+                # Host-visible both sides: one memcpy (bytes() snapshot only
+                # for a same-region overlapping move, where slice assignment
+                # direction would matter).
+                chunk = src["view"][src_off : src_off + length]
+                if src_region == dst_region and abs(src_off - dst_off) < length:
+                    chunk = bytes(chunk)
+                dst["view"][dst_off : dst_off + length] = np.frombuffer(
+                    chunk, dtype=np.uint8) if isinstance(chunk, bytes) else chunk
+                with self._lock:
+                    self.copy_calls += 1
+                return 0
+            if src["view"] is not None or dst["view"] is not None:
+                # Mixed modes (should not occur within one process/platform):
+                # let the native side stage through read/write, each of which
+                # picks its own fast path.
+                return 1
             spans = []  # (src_page, dst_page, v0, v1)
             pos = 0
             while pos < length:

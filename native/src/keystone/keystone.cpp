@@ -658,6 +658,13 @@ void KeystoneService::retry_dirty_persists() {
       persist_retry_.erase(key);
       if (caught_up)
         LOG_INFO << "durable record for " << key << " caught up after deferred persist";
+    } else {
+      // One failed RPC means the coordinator is (still) unreachable or this
+      // node was fenced: stop after ONE timeout instead of paying it per
+      // dirty key — a mass drain/repair during an outage can queue
+      // thousands, and each timed-out RPC under the shared lock stalls
+      // every metadata writer for its duration.
+      return;
     }
   }
 }

@@ -1,4 +1,9 @@
-"""JAX HBM provider: device buffers (cpu here, TPU in prod) as the top tier."""
+"""JAX HBM provider: device buffers (cpu here, TPU in prod) as the top tier.
+
+Parametrized over both region modes: "auto" exercises the host-view fast
+path (CPU buffers are host-addressable, as the bench host's are), while
+host_view=False forces the jit scatter/gather path — the one a real TPU
+takes — so CPU CI keeps covering it."""
 
 import numpy as np
 import pytest
@@ -7,9 +12,10 @@ from blackbird_tpu import EmbeddedCluster, StorageClass
 from blackbird_tpu.hbm import JaxHbmProvider
 
 
-@pytest.fixture()
-def jax_provider():
-    provider = JaxHbmProvider(page_bytes=64 * 1024).register()
+@pytest.fixture(params=["auto", False], ids=["host-view", "device-path"])
+def jax_provider(request):
+    provider = JaxHbmProvider(page_bytes=64 * 1024,
+                              host_view=request.param).register()
     yield provider
     JaxHbmProvider.unregister()
 
@@ -68,6 +74,24 @@ def test_hbm_write_visible_before_flush(jax_provider):
         payload = np.random.default_rng(3).bytes(2 << 20)
         client.put("hbm/rw", payload)
         assert client.get("hbm/rw") == payload  # no explicit synchronize
+
+
+def test_host_view_mode_engages_on_cpu():
+    """On a host-addressable backend the probe must actually engage the
+    memcpy fast path (a silent fall-through to the dispatch path would be
+    correct but 6x slower — the exact regression this guards)."""
+    provider = JaxHbmProvider(page_bytes=64 * 1024).register()
+    try:
+        with EmbeddedCluster(workers=1, pool_bytes=2 << 20,
+                             storage_class=StorageClass.HBM_TPU) as cluster:
+            regions = list(provider._regions.values())
+            assert regions and all(r["view"] is not None for r in regions)
+            client = cluster.client()
+            payload = np.random.default_rng(5).bytes(1 << 20)
+            client.put("hv/obj", payload)
+            assert client.get("hv/obj") == payload
+    finally:
+        JaxHbmProvider.unregister()
 
 
 def test_hbm_overwrite_neighbor_isolation(jax_provider):

@@ -17,9 +17,13 @@ from blackbird_tpu.hbm import JaxHbmProvider
 from blackbird_tpu.native import TransportKind
 
 
-@pytest.fixture()
-def jax_provider():
-    provider = JaxHbmProvider(page_bytes=64 * 1024).register()
+@pytest.fixture(params=["auto", False], ids=["host-view", "device-path"])
+def jax_provider(request):
+    # Both region modes: "auto" serves via host views on these CPU devices;
+    # False forces the jit/device_put machinery — the path real TPU chips
+    # take, including the device-to-device copy span in _copy.
+    provider = JaxHbmProvider(page_bytes=64 * 1024,
+                              host_view=request.param).register()
     yield provider
     JaxHbmProvider.unregister()
 

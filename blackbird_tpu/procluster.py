@@ -52,6 +52,39 @@ def _port_open(port: int) -> bool:
         return sock.connect_ex(("127.0.0.1", port)) == 0
 
 
+def write_keystone_yaml(path, *, cluster_id: str, coord_port: int,
+                        keystone_port: int, metrics_port: int | None = None,
+                        heartbeat_ttl_sec: int = 2) -> None:
+    """The single source for programmatic keystone configs (ProcessCluster,
+    the jax.distributed pod drill) so launchers cannot drift apart."""
+    lines = [
+        f"cluster_id: {cluster_id}",
+        f"coord_endpoints: 127.0.0.1:{coord_port}",
+        f"listen_address: 127.0.0.1:{keystone_port}",
+    ]
+    if metrics_port is not None:
+        lines.append(f'http_metrics_port: "{metrics_port}"')
+    lines += [
+        "gc_interval_sec: 1",
+        "health_check_interval_sec: 1",
+        f"worker_heartbeat_ttl_sec: {heartbeat_ttl_sec}",
+    ]
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
+def spawn_logged(args, log_path, *, cwd=REPO_ROOT, env=None):
+    """Popen with output to a FILE, never a pipe: a long-lived chatty child
+    (XLA warnings + logging) would fill a 64 KiB pipe buffer, block on its
+    next write, stop heartbeating, and wedge the cluster with spurious
+    repair."""
+    log = open(log_path, "w")
+    try:
+        return subprocess.Popen(args, cwd=cwd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT, text=True)
+    finally:
+        log.close()  # the child holds its own fd now
+
+
 class ProcessCluster:
     """Coordinator + keystone + N device-owning worker processes."""
 
@@ -91,15 +124,10 @@ class ProcessCluster:
         self.metrics_port = free_port()
 
         keystone_cfg = self.workdir / "keystone.yaml"
-        keystone_cfg.write_text(
-            f"""cluster_id: procluster
-coord_endpoints: 127.0.0.1:{self.coord_port}
-listen_address: 127.0.0.1:{self.keystone_port}
-http_metrics_port: "{self.metrics_port}"
-gc_interval_sec: 1
-health_check_interval_sec: 1
-worker_heartbeat_ttl_sec: {max(1, heartbeat_ttl_ms // 1000)}
-""")
+        write_keystone_yaml(
+            keystone_cfg, cluster_id="procluster", coord_port=self.coord_port,
+            keystone_port=self.keystone_port, metrics_port=self.metrics_port,
+            heartbeat_ttl_sec=max(1, heartbeat_ttl_ms // 1000))
 
         try:
             self._spawn([str(BUILD_DIR / "bb-coord"), "--host", "127.0.0.1",
@@ -150,17 +178,7 @@ worker_heartbeat_ttl_sec: {max(1, heartbeat_ttl_ms // 1000)}
         return path
 
     def _spawn(self, args: list[str], name: str, env: dict | None = None):
-        # Output goes to a file, never a pipe: a long-lived chatty worker
-        # (XLA warnings + logging) would fill a 64 KiB pipe buffer, block on
-        # its next write, stop heartbeating, and wedge the cluster with
-        # spurious repair.
-        log = open(self.workdir / f"{name}.log", "w")
-        try:
-            proc = subprocess.Popen(
-                args, cwd=REPO_ROOT, env=env, stdout=log, stderr=subprocess.STDOUT,
-                text=True)
-        finally:
-            log.close()  # the child holds its own fd now
+        proc = spawn_logged(args, self.workdir / f"{name}.log", env=env)
         self._procs.append((name, proc))
         return proc
 

@@ -1,0 +1,272 @@
+"""One pod-host process for the REAL 2-process jax.distributed drill.
+
+Launched (not collected) by tests/test_jaxdist_pod.py and the driver's
+dryrun: each instance joins an actual ``jax.distributed`` runtime (CPU
+backend, Gloo collectives), proves the global runtime is up with a
+cross-process barrier, derives its worker from the DISTRIBUTED runtime
+(``jax.process_index()`` -> host id, local devices -> hbm pools), serves
+device-tier pools against the shared keystone, and participates in a
+cross-host data exchange: host 0 puts, host 1 reads the same bytes back
+through the other process's pools and acks with a marker object. The
+process then serves until signalled — host 1 is SIGKILLed by the
+orchestrator to exercise cross-host repair.
+
+Role parity: multi-host worker registration in the reference,
+src/worker/worker_service.cpp:399-459 — which has no automated multi-host
+test at all (SURVEY §4).
+"""
+
+import argparse
+import signal
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DRILL_KEY = "pod/drill"
+DONE_KEY = "pod/done"
+PAYLOAD_SEED = 1234
+PAYLOAD_BYTES = 512 * 1024
+
+
+def drill_payload() -> bytes:
+    import numpy as np
+
+    return np.random.default_rng(PAYLOAD_SEED).bytes(PAYLOAD_BYTES)
+
+
+def run_pod_drill(workdir: str) -> None:
+    """Orchestrates the full 2-process drill (used by the pytest AND the
+    driver's dryrun): coordinator + keystone + two jax.distributed host
+    processes, cross-host put/get, SIGKILL of host 1, cross-host repair,
+    byte verification from this (third) process. Raises on any failure."""
+    import os
+    import subprocess
+    import urllib.request
+
+    from blackbird_tpu.procluster import (_port_open, free_port, spawn_logged,
+                                          write_keystone_yaml)
+
+    repo_root = Path(__file__).resolve().parent.parent
+    build = repo_root / "build"
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+
+    from blackbird_tpu import Client
+
+    jax_port, coord_port = free_port(), free_port()
+    keystone_port, metrics_port = free_port(), free_port()
+    keystone_cfg = workdir / "keystone.yaml"
+    # Heartbeat TTL 10s: a 1-core CI box can deschedule a JAX-heavy host for
+    # seconds, and a spurious lapse removes the worker under the writer.
+    write_keystone_yaml(keystone_cfg, cluster_id="jaxpod",
+                        coord_port=coord_port, keystone_port=keystone_port,
+                        metrics_port=metrics_port, heartbeat_ttl_sec=10)
+
+    def spawn(args, log_path, env=None):
+        return spawn_logged(args, log_path, cwd=repo_root, env=env)
+
+    def wait(pred, timeout, what, watch=()):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for name, proc in watch:
+                if proc.poll() is not None and proc.returncode != 0:
+                    log = (workdir / f"{name}.log")
+                    tail = log.read_text()[-2000:] if log.exists() else ""
+                    raise RuntimeError(f"{name} exited rc={proc.returncode}:\n{tail}")
+            if pred():
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    procs = []
+    try:
+        procs.append(("coord", spawn(
+            [str(build / "bb-coord"), "--host", "127.0.0.1",
+             "--port", str(coord_port)], workdir / "coord.log")))
+        wait(lambda: _port_open(coord_port), 15, "bb-coord", procs)
+        procs.append(("keystone", spawn(
+            [str(build / "bb-keystone"), "--config", str(keystone_cfg)],
+            workdir / "keystone.log")))
+        wait(lambda: _port_open(keystone_port), 15, "bb-keystone", procs)
+
+        hosts = []
+        for pid in range(2):
+            env = dict(os.environ)
+            # Append, never replace: some images load the TPU plugin through
+            # the ambient PYTHONPATH and jax.config pins cpu afterwards.
+            env["PYTHONPATH"] = (str(repo_root) + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+            proc = spawn(
+                [sys.executable, str(Path(__file__).resolve()),
+                 "--jax-coordinator", f"127.0.0.1:{jax_port}",
+                 "--process-id", str(pid), "--num-processes", "2",
+                 "--coord", f"127.0.0.1:{coord_port}",
+                 "--keystone", f"127.0.0.1:{keystone_port}",
+                 "--workdir", str(workdir / f"host{pid}")],
+                workdir / f"host{pid}.log", env=env)
+            procs.append((f"host{pid}", proc))
+            hosts.append(proc)
+
+        client = Client(f"127.0.0.1:{keystone_port}")
+        # host1's ack object proves the full cross-host exchange happened
+        # UNDER the shared jax.distributed runtime: barrier passed, both
+        # workers registered, host0's bytes read back by host1.
+        wait(lambda: client.exists(DONE_KEY), 180, "cross-host exchange", procs)
+
+        # The two replicas live on disjoint host processes.
+        copies = client.placements(DRILL_KEY)
+        assert len(copies) == 2, copies
+        per_copy = [{s["worker"] for s in c["shards"]} for c in copies]
+        assert per_copy[0] and per_copy[1] and not (per_copy[0] & per_copy[1])
+        assert {w for ws in per_copy for w in ws} <= {"jaxpod-host0",
+                                                      "jaxpod-host1"}
+
+        # Crash host 1: the keystone must repair the drill object onto the
+        # survivor, and a third process (this one) still reads the bytes.
+        hosts[1].kill()
+
+        def repaired() -> bool:
+            try:
+                metrics = urllib.request.urlopen(
+                    f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+                ).read().decode()
+            except OSError:  # transient metrics hiccup: poll again
+                return False
+            for line in metrics.splitlines():
+                if line.startswith("btpu_objects_repaired_total"):
+                    return int(line.split()[-1]) >= 1
+            return False
+
+        wait(repaired, 120, "cross-host repair",
+             [p for p in procs if p[0] != "host1"])
+        for copy in client.placements(DRILL_KEY):
+            for shard in copy["shards"]:
+                assert shard["worker"] == "jaxpod-host0", copy
+        assert client.get(DRILL_KEY) == drill_payload()
+
+        hosts[0].send_signal(signal.SIGTERM)
+        hosts[0].wait(timeout=30)
+        assert hosts[0].returncode == 0, \
+            (workdir / "host0.log").read_text()[-2000:]
+    finally:
+        for name, proc in reversed(procs):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for name, proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jax-coordinator", required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--coord", required=True, help="bb-coord endpoints")
+    ap.add_argument("--keystone", required=True)
+    ap.add_argument("--workdir", required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - older jax
+        pass
+
+    import blackbird_tpu.distributed as btd
+
+    # The real thing: jax.distributed.initialize, not an independent runtime
+    # per process. The barrier below runs an actual cross-process collective.
+    btd.init(args.jax_coordinator, num_processes=args.num_processes,
+             process_id=args.process_id)
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    assert jax.process_index() == args.process_id
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("btpu_jaxdist_drill_up")
+    print(f"host{args.process_id}: jax.distributed up "
+          f"({jax.process_count()} processes, {len(jax.devices())} global "
+          f"devices)", flush=True)
+
+    # The worker is derived from the DISTRIBUTED runtime: process_index
+    # names the host, local_devices shape the pools.
+    # Generous heartbeat TTL: a 1-core CI box can deschedule a JAX-heavy
+    # process for several seconds, and a spurious heartbeat lapse mid-drill
+    # removes the worker under the writer (observed: both hosts pruned, the
+    # in-flight put cancelled). Crash detection still bounds the repair wait.
+    cfg = btd.worker_config_for_this_host(
+        args.coord, pool_bytes_per_device=32 << 20, cluster_id="jaxpod",
+        listen_host="127.0.0.1", workdir=args.workdir,
+        heartbeat_interval_ms=500, heartbeat_ttl_ms=10_000)
+
+    from blackbird_tpu import Client, StorageClass
+    from blackbird_tpu.worker import WorkerHost
+
+    payload = drill_payload()
+    with WorkerHost(str(cfg)):
+        client = Client(args.keystone)
+        deadline = time.time() + 120
+        if args.process_id == 0:
+            # Both hosts' POOLS must be registered (not just the worker
+            # records) so the two replicas land on disjoint host processes.
+            while time.time() < deadline:
+                stats = client.stats()
+                if stats["workers"] >= 2 and stats["pools"] >= 4:
+                    break
+                time.sleep(0.2)
+            for attempt in range(5):
+                try:
+                    client.put(DRILL_KEY, payload, replicas=2, max_workers=2,
+                               preferred_class=StorageClass.HBM_TPU)
+                    break
+                except Exception:  # noqa: BLE001 - worker flap under load
+                    if attempt == 4:
+                        raise
+                    time.sleep(1.0)
+            print("host0: put done", flush=True)
+        else:
+            # exists() is true for a PENDING put too, and a read racing the
+            # writer fails its CRC (by design) — retry until the put commits.
+            got = None
+            while time.time() < deadline and got is None:
+                try:
+                    if client.exists(DRILL_KEY):
+                        got = client.get(DRILL_KEY)
+                except Exception:  # noqa: BLE001 - put still in flight
+                    time.sleep(0.2)
+                else:
+                    if got is None:
+                        time.sleep(0.2)
+            assert got == payload, "cross-host readback mismatch"
+            client.put(DONE_KEY, b"host1-read-ok", replicas=1)
+            print("host1: cross-host read verified", flush=True)
+
+        # Serve until the orchestrator signals. SIGTERM = clean exit;
+        # host 1 instead gets SIGKILLed to exercise crash repair.
+        stop = [False]
+
+        def on_term(_sig, _frm):
+            stop[0] = True
+
+        signal.signal(signal.SIGTERM, on_term)
+        while not stop[0]:
+            time.sleep(0.1)
+    # Hard exit: the worker is already closed cleanly, but jax.distributed's
+    # atexit shutdown blocks forever once a peer was SIGKILLed (the
+    # coordinator service in process 0 waits for process 1) — exactly the
+    # crash this drill stages. Survivors must not hang on a dead peer.
+    sys.stdout.flush()
+    import os
+
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,10 +76,12 @@ def test_hbm_write_visible_before_flush(jax_provider):
         assert client.get("hbm/rw") == payload  # no explicit synchronize
 
 
-def test_host_view_mode_engages_on_cpu():
+def test_host_view_mode_engages_on_cpu(monkeypatch):
     """On a host-addressable backend the probe must actually engage the
     memcpy fast path (a silent fall-through to the dispatch path would be
     correct but 6x slower — the exact regression this guards)."""
+    # The process-wide kill switch must not defeat the regression guard.
+    monkeypatch.delenv("BTPU_HBM_HOST_VIEW", raising=False)
     provider = JaxHbmProvider(page_bytes=64 * 1024).register()
     try:
         with EmbeddedCluster(workers=1, pool_bytes=2 << 20,

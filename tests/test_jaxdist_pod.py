@@ -1,0 +1,20 @@
+"""The pod shape under a REAL jax.distributed runtime (VERDICT r3 item 2).
+
+Every prior multi-process test gave each worker its own independent JAX
+runtime; here two host processes join ONE ``jax.distributed`` runtime (CPU
+backend, real Gloo collectives, cross-process barrier) and each serves the
+worker derived from it (blackbird_tpu/distributed.py) against one shared
+keystone. Host 0 puts; host 1 reads the bytes back across the process
+boundary and acks; then host 1 is SIGKILLed and the keystone re-replicates
+the drill object onto the survivor, where a third process verifies the
+bytes. The drill itself lives in jaxdist_host.run_pod_drill so the
+driver's dryrun runs the identical leg. Reference analog: multi-host
+worker registration, src/worker/worker_service.cpp:399-459 — untested in
+the reference.
+"""
+
+import jaxdist_host
+
+
+def test_two_process_jax_distributed_pod(tmp_path):
+    jaxdist_host.run_pod_drill(str(tmp_path))

@@ -60,6 +60,26 @@ struct ClientOptions {
   // common object-store discipline). Raw (verify=false) reads never use the
   // cache; remote clients only — embedded metadata is already in-process.
   uint32_t placement_cache_ms{0};
+  // Pooled small puts: keep up to this many pre-allocated anonymous PENDING
+  // slots per (size, config) class, so a repeat put of that class costs ONE
+  // control round trip (commit-with-refill) instead of two
+  // (put_start + put_complete). 0 disables. Commit is the same fail-closed
+  // exactly-once point as put_complete; a reclaimed/unknown slot falls back
+  // to the two-RTT path transparently. Idle slots reserve capacity
+  // server-side until the keystone's slot TTL (default 60 s) reclaims them.
+  // Remote clients only; embedded metadata has no round trip to save.
+  uint32_t put_slots{4};
+  // Only puts at or below this size use slots (larger objects are
+  // bandwidth-, not RTT-bound; the default matches min_shard_size, so slot
+  // puts are single-shard in the default config).
+  uint64_t put_slot_max_bytes{256 * 1024};
+  // Pooled slots older than this are discarded (and cancelled) instead of
+  // used: the keystone reclaims idle slots after its slot_ttl_sec, and a
+  // data-plane write into a RECLAIMED slot could land on ranges already
+  // reallocated to another object. Must stay well below the keystone's
+  // slot_ttl_sec (default 60 s) — the margin is the same pessimistic-
+  // deadline defense the pending-put reclamation uses.
+  uint32_t put_slot_max_age_ms{20'000};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).
@@ -229,6 +249,21 @@ class ObjectClient {
   };
   std::mutex placement_cache_mutex_;
   std::unordered_map<ObjectKey, PlacementCacheEntry> placement_cache_;
+
+  // Pooled put slots (ClientOptions::put_slots): classes keyed by
+  // (size, wire-encoded config). nullopt result = not applicable here, the
+  // caller runs the normal two-RTT path.
+  std::optional<ErrorCode> put_via_slot(const ObjectKey& key, const void* data,
+                                        uint64_t size, const WorkerConfig& config);
+  void cancel_pooled_slots();  // best-effort, destructor path
+  struct PooledSlot {
+    PutSlot slot;
+    std::chrono::steady_clock::time_point granted_at;
+  };
+  std::mutex slot_mutex_;
+  std::unordered_map<std::string, std::vector<PooledSlot>> slot_pool_;
+  std::string slot_tag_;          // random per client session
+  bool slots_unsupported_{false};  // server predates the opcodes (guarded by slot_mutex_)
 };
 
 }  // namespace btpu::client

@@ -16,4 +16,11 @@ namespace btpu {
 // (pass the previous return value). 0 is the conventional initial seed.
 uint32_t crc32c(const void* data, size_t len, uint32_t seed = 0);
 
+// crc32c(X || Y) from crc32c(X), crc32c(Y) and |Y|: lets independent chains
+// (per-shard stamps, per-chunk streaming CRCs) merge without re-reading the
+// bytes. The zero-byte advance operator is cached per length — repeated
+// lengths (fixed stripe widths, staging chunks) cost ~32 xors; a new length
+// pays one GF(2) matrix exponentiation (~tens of us).
+uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b);
+
 }  // namespace btpu

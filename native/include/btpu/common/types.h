@@ -346,6 +346,49 @@ struct BatchPutCompleteResponse { std::vector<ErrorCode> results; ErrorCode erro
 struct BatchPutCancelRequest { std::vector<ObjectKey> keys; };
 struct BatchPutCancelResponse { std::vector<ErrorCode> results; ErrorCode error_code{ErrorCode::OK}; };
 
+// Pooled small-put slots (no reference counterpart; the reference pays two
+// control RTTs per put, blackbird_client.cpp:87-117). put_start_pooled
+// pre-allocates `count` anonymous PENDING objects of one (size, config)
+// class under internal "\x01slot/<tag>/<seq>" keys; a later put writes a
+// slot's placements and commits it AS the final key in ONE control round
+// trip (put_commit_slot), which can piggyback a refill in the same RTT.
+// Unused slots are reclaimed like any abandoned pending put, on the
+// shorter KeystoneConfig::slot_ttl_sec deadline.
+struct PutSlot {
+  ObjectKey slot_key;
+  std::vector<CopyPlacement> copies;
+};
+struct PutStartPooledRequest {
+  uint64_t data_size{0};
+  WorkerConfig config;
+  uint32_t count{1};
+  std::string client_tag;  // namespaces slot keys per client session
+};
+// error_code leads (unlike the older responses) so the NOT_IMPLEMENTED
+// single-field frame an old server answers unknown opcodes with decodes
+// cleanly and the client can fall back to the two-RTT path.
+struct PutStartPooledResponse {
+  ErrorCode error_code{ErrorCode::OK};
+  std::vector<PutSlot> slots;  // may be fewer than requested
+};
+struct PutCommitSlotRequest {
+  ObjectKey slot_key;
+  ObjectKey key;  // final user-visible key
+  uint32_t content_crc{0};
+  std::vector<CopyShardCrcs> shard_crcs;
+  // Piggybacked replacement-slot grant: the same RTT that commits this put
+  // pre-allocates the next slots of the class (data_size, config, tag are
+  // repeated because the commit must not depend on server-side lookups).
+  uint32_t refill_count{0};
+  uint64_t data_size{0};
+  WorkerConfig config;
+  std::string client_tag;
+};
+struct PutCommitSlotResponse {
+  ErrorCode error_code{ErrorCode::OK};  // commit outcome (see request note)
+  std::vector<PutSlot> slots;           // refills; best-effort, may be empty
+};
+
 // Ping doubles as the protocol-version handshake: each side sends the
 // highest wire-protocol version it speaks (rpc.h kProtocolVersion). A peer
 // that predates the handshake leaves the field 0.
@@ -380,6 +423,12 @@ struct KeystoneConfig {
   // (ram_backend.cpp:69) at the control plane, where the allocation
   // actually lives here.
   int64_t pending_put_timeout_sec{900};
+  // Unused pooled put slots (put_start_pooled) are reclaimed after this
+  // much idle time — much shorter than pending_put_timeout_sec because a
+  // slot holds reserved capacity with no writer attached until a put picks
+  // it up; a client that loses its slot transparently falls back to the
+  // two-RTT put path. 0 disables slot granting entirely.
+  int64_t slot_ttl_sec{60};
 
   int32_t max_replicas{3};
   int32_t default_replicas{1};

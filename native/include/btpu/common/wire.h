@@ -277,6 +277,13 @@ inline bool decode(Reader& r, CopyPlacement& c) {
                        c.ec_object_size, c.content_crc, c.shard_crcs);
 }
 
+inline void encode(Writer& w, const PutSlot& s) {
+  encode_struct(w, s.slot_key, s.copies);
+}
+inline bool decode(Reader& r, PutSlot& s) {
+  return decode_struct(r, s.slot_key, s.copies);
+}
+
 inline void encode(Writer& w, const WorkerConfig& c) {
   encode_struct(w, static_cast<uint64_t>(c.replication_factor),
                 static_cast<uint64_t>(c.max_workers_per_copy), c.enable_soft_pin,
@@ -411,6 +418,10 @@ BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutCompleteResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutCancelRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutCancelResponse, f0, f1)
+BTPU_WIRE_STRUCT(PutStartPooledRequest, f0, f1, f2, f3)
+BTPU_WIRE_STRUCT(PutStartPooledResponse, f0, f1)
+BTPU_WIRE_STRUCT(PutCommitSlotRequest, f0, f1, f2, f3, f4, f5, f6, f7)
+BTPU_WIRE_STRUCT(PutCommitSlotResponse, f0, f1)
 BTPU_WIRE_STRUCT(PingRequest, f0)
 BTPU_WIRE_STRUCT(PingResponse, f0, f1)
 

@@ -68,6 +68,10 @@ struct ObjectInfo {
   // only if it is unchanged — unlike comparing the placements themselves,
   // an epoch cannot suffer ABA when a remove+re-put reuses the same ranges.
   uint64_t epoch{0};
+  // Anonymous pooled put slot (put_start_pooled): pending with no writer
+  // attached yet; reclaimed on the shorter slot_ttl_sec deadline. Never
+  // persisted (pending objects are not persisted at all).
+  bool slot{false};
 
   bool expired(std::chrono::steady_clock::time_point now) const {
     return ttl_ms > 0 && now >= created_at + std::chrono::milliseconds(ttl_ms);
@@ -78,6 +82,8 @@ struct KeystoneCounters {
   std::atomic<uint64_t> put_starts{0};
   std::atomic<uint64_t> put_completes{0};
   std::atomic<uint64_t> put_cancels{0};
+  std::atomic<uint64_t> slots_granted{0};
+  std::atomic<uint64_t> slot_commits{0};
   std::atomic<uint64_t> gets{0};
   std::atomic<uint64_t> removes{0};
   std::atomic<uint64_t> gc_collected{0};
@@ -118,6 +124,16 @@ class KeystoneService {
   // that don't match a copy's index/shard count are ignored.
   ErrorCode put_complete(const ObjectKey& key, const std::vector<CopyShardCrcs>& shard_crcs = {});
   ErrorCode put_cancel(const ObjectKey& key);
+  // Pooled small-put slots (see PutSlot in types.h): grants up to `count`
+  // anonymous PENDING allocations of one (size, config) class; commit
+  // renames a slot to its final key and completes it in one call — the
+  // 1-RTT control path for small objects. A reclaimed/unknown slot commits
+  // as OBJECT_NOT_FOUND and the client falls back to put_start/complete.
+  Result<std::vector<PutSlot>> put_start_pooled(uint64_t size, const WorkerConfig& config,
+                                                uint32_t count, const std::string& client_tag);
+  ErrorCode put_commit_slot(const ObjectKey& slot_key, const ObjectKey& key,
+                            uint32_t content_crc,
+                            const std::vector<CopyShardCrcs>& shard_crcs);
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
 
@@ -205,6 +221,7 @@ class KeystoneService {
   // (SIGSTOP/GC-pause window) gets FENCED back, steps down, and the
   // mutation provably never reached durable state. Returns the write's
   // outcome so commit points (put_complete) can fail closed.
+  ErrorCode normalize_put_config(WorkerConfig& effective) const;
   ErrorCode persist_object(const ObjectKey& key, const ObjectInfo& info);
   ErrorCode unpersist_object(const ObjectKey& key);
   // For mutation sites that cannot fail closed (the splice already landed in
@@ -335,6 +352,7 @@ class KeystoneService {
   std::unordered_set<ObjectKey> persist_retry_;
   // Background scrub ring position (scrub thread only).
   ObjectKey scrub_cursor_;
+  std::atomic<uint64_t> slot_seq_{0};  // unique suffix for pooled slot keys
   std::mutex drain_mutex_;               // serializes drain_worker per service
   std::string service_id_;
 };

@@ -44,6 +44,8 @@ enum class Method : uint8_t {
   kPing = 79,
   kDrainWorker = 80,
   kListObjects = 81,
+  kPutStartPooled = 82,
+  kPutCommitSlot = 83,
 };
 
 }  // namespace btpu::rpc

@@ -27,6 +27,13 @@ class KeystoneRpcClient {
   ErrorCode put_complete(const ObjectKey& key,
                          const std::vector<CopyShardCrcs>& shard_crcs = {});
   ErrorCode put_cancel(const ObjectKey& key);
+  // Pooled small-put slots (1-RTT commit path; see PutSlot in types.h).
+  Result<std::vector<PutSlot>> put_start_pooled(uint64_t size, const WorkerConfig& config,
+                                                uint32_t count, const std::string& client_tag);
+  // Commits slot_key AS key; refill_slots (when non-null) receives the
+  // piggybacked replacement grant from the same round trip.
+  ErrorCode put_commit_slot(const PutCommitSlotRequest& request,
+                            std::vector<PutSlot>* refill_slots);
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
   Result<uint64_t> drain_worker(const NodeId& worker_id);

@@ -1,8 +1,12 @@
 #include "btpu/client/client.h"
 
+#include <cstdio>
 #include <cstring>
+#include <map>
+#include <random>
 
 #include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/ec/rs.h"
@@ -29,10 +33,21 @@ void ClientOptions::set_keystone_endpoints(const std::string& list) {
   }
 }
 
+namespace {
+// Namespaces this client session's pooled slot keys on the keystone.
+std::string random_slot_tag() {
+  std::random_device rd;
+  char buf[17];
+  std::snprintf(buf, sizeof(buf), "%08x%08x", rd(), rd());
+  return buf;
+}
+}  // namespace
+
 ObjectClient::ObjectClient(ClientOptions options)
     : options_(std::move(options)),
       verify_default_(options_.verify_reads),
-      data_(transport::make_transport_client()) {
+      data_(transport::make_transport_client()),
+      slot_tag_(random_slot_tag()) {
   rpc_ = std::make_unique<rpc::KeystoneRpcClient>(options_.keystone_address);
 }
 
@@ -42,7 +57,7 @@ ObjectClient::ObjectClient(ClientOptions options, keystone::KeystoneService* emb
       embedded_(embedded),
       data_(transport::make_transport_client()) {}
 
-ObjectClient::~ObjectClient() = default;
+ObjectClient::~ObjectClient() { cancel_pooled_slots(); }
 
 ErrorCode ObjectClient::connect() {
   if (embedded_) return ErrorCode::OK;
@@ -154,12 +169,17 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
                             const WorkerConfig& config) {
+  TRACE_SPAN("client.put");
+  // Small objects ride the pooled-slot path when possible: write into a
+  // pre-allocated slot, then ONE control RTT commits it as `key` (and
+  // refills the pool in the same round trip). nullopt = not applicable
+  // (disabled, oversized, EC, embedded, slot reclaimed) — fall through.
+  if (auto pooled = put_via_slot(key, data, size, config)) return *pooled;
   // One-item batch: put_many pipelines the wire shards of EVERY copy in a
   // single pass (a replicated put costs ~one round trip, not one per copy),
   // coalesces device shards, and rolls back failed reservations — the exact
   // single-object semantics (put_start -> transfer -> complete/cancel,
   // reference blackbird_client.cpp:87-117) with none of the code repeated.
-  TRACE_SPAN("client.put");
   return put_many({{key, data, size}}, config)[0];
 }
 
@@ -837,6 +857,38 @@ void append_ec_get_jobs(const CopyPlacement& copy, uint8_t* buffer, uint64_t siz
   }
 }
 
+// Per-copy shard CRC stamps for replicated/striped copies: replica copies
+// cover the SAME bytes, so each distinct (offset, length) range is hashed
+// once and reused — and a whole-object shard reuses the already-computed
+// content CRC, which makes the single-shard small put ONE CRC pass total.
+std::vector<CopyShardCrcs> stamp_copy_crcs(const std::vector<CopyPlacement>& copies,
+                                           const uint8_t* data, uint64_t size,
+                                           uint32_t content_crc) {
+  std::vector<CopyShardCrcs> out;
+  out.reserve(copies.size());
+  std::map<std::pair<uint64_t, uint64_t>, uint32_t> range_crc;
+  for (const auto& copy : copies) {
+    CopyShardCrcs crcs;
+    crcs.copy_index = copy.copy_index;
+    crcs.crcs.reserve(copy.shards.size());
+    uint64_t off = 0;
+    for (const auto& shard : copy.shards) {
+      uint32_t crc;
+      if (off == 0 && shard.length == size) {
+        crc = content_crc;
+      } else {
+        auto [it, fresh] = range_crc.try_emplace({off, shard.length}, 0);
+        if (fresh) it->second = crc32c(data + off, shard.length);
+        crc = it->second;
+      }
+      crcs.crcs.push_back(crc);
+      off += shard.length;
+    }
+    out.push_back(std::move(crcs));
+  }
+  return out;
+}
+
 // Runs the wire jobs as ONE pipelined batch; per-op failures land on their
 // item, jobs of items that already failed are skipped (their reservation is
 // cancelled by the caller anyway).
@@ -969,18 +1021,9 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok() || results[i] != ErrorCode::OK) continue;
     if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) continue;
-    const auto* data = static_cast<const uint8_t*>(items[i].data);
-    for (const auto& copy : placed[i].value()) {
-      CopyShardCrcs crcs;
-      crcs.copy_index = copy.copy_index;
-      crcs.crcs.reserve(copy.shards.size());
-      uint64_t off = 0;
-      for (const auto& shard : copy.shards) {
-        crcs.crcs.push_back(crc32c(data + off, shard.length));
-        off += shard.length;
-      }
-      item_crcs[i].push_back(std::move(crcs));
-    }
+    item_crcs[i] = stamp_copy_crcs(placed[i].value(),
+                                   static_cast<const uint8_t*>(items[i].data),
+                                   items[i].size, starts[i].content_crc);
   }
   // Device writes may be asynchronous; put_complete must not be sent until
   // the bytes are durably in the tier.
@@ -1030,6 +1073,185 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     }
   }
   return results;
+}
+
+std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const void* data,
+                                                    uint64_t size,
+                                                    const WorkerConfig& config) {
+  if (embedded_ || options_.put_slots == 0 || size == 0 ||
+      size > options_.put_slot_max_bytes || config.ec_parity_shards > 0 || key.empty() ||
+      key.find('\x01') != ObjectKey::npos)
+    return std::nullopt;
+  // Slot classes are exact-(size, config): the commit renames placements
+  // verbatim, so shard geometry must match the bytes exactly. Repeat puts
+  // of one class — the fixed-block serving pattern — hit the pool.
+  std::string class_key;
+  {
+    wire::Writer w;
+    wire::encode(w, config);
+    const auto cfg = w.take();
+    class_key.assign(reinterpret_cast<const char*>(cfg.data()), cfg.size());
+    class_key += '/' + std::to_string(size);
+  }
+
+  invalidate_placements(key);  // same re-created-key rule as the normal path
+  PutSlot slot;
+  auto slot_granted_at = std::chrono::steady_clock::now();
+  std::vector<ObjectKey> expired;
+  {
+    std::lock_guard<std::mutex> lock(slot_mutex_);
+    if (slots_unsupported_) return std::nullopt;
+    auto& pool = slot_pool_[class_key];
+    // Age gate: a slot the keystone may have reclaimed (slot TTL) must
+    // never see a data-plane write — its ranges could already belong to
+    // another object. Expired entries are cancelled below, not used.
+    const auto now = std::chrono::steady_clock::now();
+    const auto max_age = std::chrono::milliseconds(options_.put_slot_max_age_ms);
+    while (!pool.empty()) {
+      PooledSlot entry = std::move(pool.back());
+      pool.pop_back();
+      if (now - entry.granted_at > max_age) {
+        expired.push_back(std::move(entry.slot.slot_key));
+        continue;
+      }
+      slot = std::move(entry.slot);
+      slot_granted_at = entry.granted_at;
+      break;
+    }
+  }
+  if (!expired.empty()) {
+    // Best-effort release of the stale reservations (the TTL reclaims them
+    // regardless); outside the pool lock, one batch RPC.
+    rpc_failover(/*idempotent=*/false,
+                 [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(expired); });
+  }
+  if (slot.slot_key.empty()) {
+    // First put of this class pays the same two RTTs as the normal path,
+    // but the grant covers this put AND the pool for the next ones.
+    auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+      return c.put_start_pooled(size, config, options_.put_slots + 1, slot_tag_);
+    });
+    if (!r.ok() || r.value().empty()) {
+      if (r.error() == ErrorCode::NOT_IMPLEMENTED) {
+        // Old server or slots disabled server-side: stop asking.
+        std::lock_guard<std::mutex> lock(slot_mutex_);
+        slots_unsupported_ = true;
+      }
+      return std::nullopt;  // the normal path reports the real outcome
+    }
+    auto slots = std::move(r).value();
+    slot = std::move(slots.back());
+    slots.pop_back();
+    if (!slots.empty()) {
+      const auto now = std::chrono::steady_clock::now();
+      std::lock_guard<std::mutex> lock(slot_mutex_);
+      auto& pool = slot_pool_[class_key];
+      for (auto& s : slots) pool.push_back({std::move(s), now});
+    }
+  }
+
+  // Transfer into the slot's placements — the same jobs machinery as
+  // put_many, for one item.
+  auto* bytes = const_cast<uint8_t*>(static_cast<const uint8_t*>(data));
+  const uint32_t content_crc = crc32c(bytes, size);
+  BatchJobs jobs;
+  std::vector<ErrorCode> item_errors(1, ErrorCode::OK);
+  std::vector<CopyShardCrcs> crcs;
+  for (const auto& copy : slot.copies) {
+    if (auto ec = append_copy_jobs(copy, bytes, size, 0, jobs, nullptr);
+        ec != ErrorCode::OK) {
+      item_errors[0] = ec;
+      break;
+    }
+  }
+  if (item_errors[0] == ErrorCode::OK) {
+    TRACE_SPAN("client.put.transfer");
+    run_device_jobs(*data_, jobs, /*is_write=*/true, item_errors);
+    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, item_errors);
+    // Shard stamps ride under the in-flight transfer (one CRC pass total
+    // for the single-shard small-put norm).
+    crcs = stamp_copy_crcs(slot.copies, bytes, size, content_crc);
+    if (!jobs.device.empty() && item_errors[0] == ErrorCode::OK)
+      item_errors[0] = storage::hbm_flush();
+  }
+  if (item_errors[0] != ErrorCode::OK) {
+    // The slot's worker may be the problem (crashed after the grant): drop
+    // the slot and FALL BACK — the normal path re-reserves on currently
+    // healthy workers, preserving the pre-slot availability story.
+    LOG_WARN << "put " << key << " slot transfer failed (" << to_string(item_errors[0])
+             << "), cancelling slot and falling back";
+    rpc_failover(/*idempotent=*/false,
+                 [&](rpc::KeystoneRpcClient& c) { return c.put_cancel(slot.slot_key); });
+    return std::nullopt;
+  }
+
+  PutCommitSlotRequest req;
+  req.slot_key = slot.slot_key;
+  req.key = key;
+  req.content_crc = content_crc;
+  req.shard_crcs = std::move(crcs);
+  req.data_size = size;
+  req.config = config;
+  req.client_tag = slot_tag_;
+  {
+    std::lock_guard<std::mutex> lock(slot_mutex_);
+    const size_t have = slot_pool_[class_key].size();
+    req.refill_count =
+        have < options_.put_slots ? static_cast<uint32_t>(options_.put_slots - have) : 0;
+  }
+  std::vector<PutSlot> refills;
+  const ErrorCode ec = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+    return c.put_commit_slot(req, &refills);
+  });
+  if (ec == ErrorCode::OK) {
+    std::vector<ObjectKey> overflow;
+    {
+      const auto now = std::chrono::steady_clock::now();
+      std::lock_guard<std::mutex> lock(slot_mutex_);
+      auto& pool = slot_pool_[class_key];
+      for (auto& s : refills) {
+        // Overflow (a concurrent put of this class refilled first) is
+        // cancelled, not dropped: each refill reserves real capacity.
+        if (pool.size() >= options_.put_slots) {
+          overflow.push_back(std::move(s.slot_key));
+        } else {
+          pool.push_back({std::move(s), now});
+        }
+      }
+    }
+    if (!overflow.empty()) {
+      rpc_failover(/*idempotent=*/false,
+                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(overflow); });
+    }
+    return ErrorCode::OK;
+  }
+  if (ec == ErrorCode::OBJECT_NOT_FOUND) {
+    // Slot reclaimed (TTL) or minted by a deposed leader: transparent
+    // fallback — the normal path re-reserves and re-writes.
+    return std::nullopt;
+  }
+  // Duplicate key, fail-closed persist, etc.: the slot survives server-side
+  // (commit rolled it back), so it can serve the next put of this class.
+  {
+    std::lock_guard<std::mutex> lock(slot_mutex_);
+    slot_pool_[class_key].push_back({std::move(slot), slot_granted_at});
+  }
+  return ec;
+}
+
+void ObjectClient::cancel_pooled_slots() {
+  std::vector<ObjectKey> keys;
+  {
+    std::lock_guard<std::mutex> lock(slot_mutex_);
+    for (auto& [cls, pool] : slot_pool_) {
+      for (auto& s : pool) keys.push_back(std::move(s.slot.slot_key));
+    }
+    slot_pool_.clear();
+  }
+  // Only when already connected: the destructor must not pay a connect
+  // timeout for a dead keystone — the slot TTL reclaims either way.
+  if (keys.empty() || embedded_ || !rpc_ || !rpc_->connected()) return;
+  rpc_->batch_put_cancel(keys);
 }
 
 std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,

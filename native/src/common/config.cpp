@@ -338,6 +338,8 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
     cfg.health_check_interval_sec = n->int_or(cfg.health_check_interval_sec);
   if (auto n = root.get("pending_put_timeout_sec"))
     cfg.pending_put_timeout_sec = n->int_or(cfg.pending_put_timeout_sec);
+  if (auto n = root.get("slot_ttl_sec"))
+    cfg.slot_ttl_sec = n->int_or(cfg.slot_ttl_sec);
   if (auto n = root.get("max_replicas")) cfg.max_replicas = static_cast<int32_t>(n->int_or(cfg.max_replicas));
   if (auto n = root.get("default_replicas"))
     cfg.default_replicas = static_cast<int32_t>(n->int_or(cfg.default_replicas));

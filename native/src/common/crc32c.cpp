@@ -1,6 +1,8 @@
 #include "btpu/common/crc32c.h"
 
 #include <array>
+#include <mutex>
+#include <unordered_map>
 
 #if defined(__x86_64__)
 #include <nmmintrin.h>
@@ -147,6 +149,31 @@ uint32_t crc32c(const void* data, size_t len, uint32_t seed) {
   const auto& t = table().t;
   for (size_t i = 0; i < len; ++i) crc = (crc >> 8) ^ t[(crc ^ p[i]) & 0xff];
   return ~crc;
+}
+
+uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b) {
+  if (len_b == 0) return crc_a;
+  // The pre/post conditioning cancels through the linear operator, so the
+  // identity holds directly on final values:
+  //   crc(X || Y) = shift_{|Y|}(crc(X)) ^ crc(Y).
+  // Cached operator per length: building one costs a matrix exponentiation,
+  // applying one is 32 xors — and shard/chunk lengths repeat heavily.
+  static std::mutex ops_mutex;
+  static std::unordered_map<uint64_t, std::array<uint32_t, 32>> ops;
+  std::array<uint32_t, 32> op{};
+  {
+    std::lock_guard<std::mutex> lock(ops_mutex);
+    auto it = ops.find(len_b);
+    if (it == ops.end()) {
+      if (ops.size() >= 256) ops.clear();  // degenerate workloads only
+      std::array<uint32_t, 32> m{};
+      for (int bit = 0; bit < 32; ++bit)
+        m[static_cast<size_t>(bit)] = crc32c_shift(1u << bit, len_b);
+      it = ops.emplace(len_b, m).first;
+    }
+    op = it->second;
+  }
+  return gf2_matrix_times(op.data(), crc_a) ^ crc_b;
 }
 
 }  // namespace btpu

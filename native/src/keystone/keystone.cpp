@@ -1027,11 +1027,15 @@ void KeystoneService::run_gc_once() {
   constexpr uint64_t kMinPutBytesPerMs = 1048;  // ~1 MiB/s worst-case floor
   auto pending_stale = [&](const ObjectInfo& info,
                            std::chrono::steady_clock::time_point at) {
-    if (config_.pending_put_timeout_sec <= 0 || info.state != ObjectState::kPending)
-      return false;
-    const auto deadline =
-        std::chrono::seconds(config_.pending_put_timeout_sec) +
-        std::chrono::milliseconds(info.size / kMinPutBytesPerMs);
+    if (info.state != ObjectState::kPending) return false;
+    // Pooled slots idle on reserved capacity with no writer attached, so
+    // they expire on the much shorter slot TTL (still size-graced: a commit
+    // may be racing the deadline with its transfer in flight).
+    const int64_t base_sec =
+        info.slot ? config_.slot_ttl_sec : config_.pending_put_timeout_sec;
+    if (base_sec <= 0) return false;
+    const auto deadline = std::chrono::seconds(base_sec) +
+                          std::chrono::milliseconds(info.size / kMinPutBytesPerMs);
     return at >= info.created_at + deadline;
   };
   std::vector<ObjectKey> expired;
@@ -1300,19 +1304,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey&
   return it->second.copies;
 }
 
-Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& key,
-                                                              uint64_t size,
-                                                              const WorkerConfig& config,
-                                                              uint32_t content_crc) {
-  if (key.empty()) return ErrorCode::INVALID_KEY;
-  // 0x01 is reserved as the internal staging-key separator (demotion/repair
-  // stage replacement placements under "<key>\x01..."); letting clients use
-  // it could collide with an in-flight staging allocation.
-  if (key.find('\x01') != ObjectKey::npos) return ErrorCode::INVALID_KEY;
-  if (size == 0) return ErrorCode::INVALID_PARAMETERS;
-  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-
-  WorkerConfig effective = config;
+ErrorCode KeystoneService::normalize_put_config(WorkerConfig& effective) const {
   if (effective.replication_factor == 0)
     effective.replication_factor = static_cast<size_t>(config_.default_replicas);
   effective.replication_factor =
@@ -1327,6 +1319,23 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   } else {
     effective.ec_data_shards = 0;  // k without m is meaningless: plain striping
   }
+  return ErrorCode::OK;
+}
+
+Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& key,
+                                                              uint64_t size,
+                                                              const WorkerConfig& config,
+                                                              uint32_t content_crc) {
+  if (key.empty()) return ErrorCode::INVALID_KEY;
+  // 0x01 is reserved as the internal staging-key separator (demotion/repair
+  // stage replacement placements under "<key>\x01..."); letting clients use
+  // it could collide with an in-flight staging allocation.
+  if (key.find('\x01') != ObjectKey::npos) return ErrorCode::INVALID_KEY;
+  if (size == 0) return ErrorCode::INVALID_PARAMETERS;
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+
+  WorkerConfig effective = config;
+  if (auto ec = normalize_put_config(effective); ec != ErrorCode::OK) return ec;
 
   TRACE_SPAN("keystone.put_start");
   std::unique_lock lock(objects_mutex_);
@@ -1396,6 +1405,113 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.put_cancels;
+  bump_view();
+  return ErrorCode::OK;
+}
+
+Result<std::vector<PutSlot>> KeystoneService::put_start_pooled(uint64_t size,
+                                                               const WorkerConfig& config,
+                                                               uint32_t count,
+                                                               const std::string& client_tag) {
+  if (size == 0 || count == 0 || client_tag.empty() || client_tag.size() > 64 ||
+      client_tag.find('\x01') != std::string::npos)
+    return ErrorCode::INVALID_PARAMETERS;
+  if (config_.slot_ttl_sec <= 0) return ErrorCode::NOT_IMPLEMENTED;  // disabled
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+  WorkerConfig effective = config;
+  if (auto ec = normalize_put_config(effective); ec != ErrorCode::OK) return ec;
+  count = std::min<uint32_t>(count, 16);
+
+  TRACE_SPAN("keystone.put_start_pooled");
+  std::unique_lock lock(objects_mutex_);
+  const alloc::PoolMap pools_snapshot = allocatable_pools_snapshot();
+  std::vector<PutSlot> slots;
+  for (uint32_t i = 0; i < count; ++i) {
+    // '\x01' prefix: invisible to user keys (put_start rejects the byte)
+    // and to prefix listings.
+    ObjectKey slot_key = std::string("\x01") + "slot/" + client_tag + "/" +
+                         std::to_string(slot_seq_.fetch_add(1));
+    auto placed = adapter_.allocate_data_copies(slot_key, size, effective, pools_snapshot);
+    if (!placed.ok()) {
+      // Partial grants are fine (count is a target, not a contract); a
+      // zero-grant reports why.
+      if (slots.empty()) return placed.error();
+      break;
+    }
+    ObjectInfo info;
+    info.size = size;
+    info.ttl_ms = effective.ttl_ms;
+    info.soft_pin = effective.enable_soft_pin;
+    info.config = effective;
+    info.state = ObjectState::kPending;
+    info.slot = true;
+    info.created_at = info.last_access = std::chrono::steady_clock::now();
+    info.copies = placed.value();
+    info.epoch = next_epoch_.fetch_add(1);
+    objects_[slot_key] = std::move(info);
+    slots.push_back({std::move(slot_key), std::move(placed).value()});
+  }
+  counters_.slots_granted.fetch_add(slots.size());
+  bump_view();
+  return slots;
+}
+
+ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const ObjectKey& key,
+                                           uint32_t content_crc,
+                                           const std::vector<CopyShardCrcs>& shard_crcs) {
+  if (key.empty() || key.find('\x01') != ObjectKey::npos) return ErrorCode::INVALID_KEY;
+  if (slot_key.rfind(std::string("\x01") + "slot/", 0) != 0) return ErrorCode::INVALID_KEY;
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+
+  TRACE_SPAN("keystone.put_commit_slot");
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(slot_key);
+  // Reclaimed (slot TTL) or minted by a previous leader: the client falls
+  // back to the two-RTT path on this code.
+  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  if (!it->second.slot || it->second.state != ObjectState::kPending)
+    return ErrorCode::INVALID_STATE;
+  if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
+  if (auto ec = adapter_.allocator().rename_object(slot_key, key); ec != ErrorCode::OK)
+    return ec;  // slot untouched; client falls back
+
+  ObjectInfo info = std::move(it->second);
+  info.slot = false;
+  info.state = ObjectState::kComplete;
+  // TTL runs from the COMMIT, not from the slot grant — the object is born
+  // now as far as its writer is concerned.
+  info.created_at = info.last_access = std::chrono::steady_clock::now();
+  for (auto& copy : info.copies) copy.content_crc = content_crc;
+  for (const auto& sc : shard_crcs) {
+    for (auto& copy : info.copies) {
+      if (copy.copy_index == sc.copy_index && copy.shards.size() == sc.crcs.size()) {
+        copy.shard_crcs = sc.crcs;
+        break;
+      }
+    }
+  }
+  info.epoch = next_epoch_.fetch_add(1);
+  objects_.erase(it);
+  auto [fit, inserted] = objects_.emplace(key, std::move(info));
+  (void)inserted;
+  if (auto ec = persist_object(key, fit->second); ec != ErrorCode::OK) {
+    // Same fail-closed commit point as put_complete: the durable record
+    // never landed, so the commit must not ack. Roll the slot back intact
+    // (pending, unstamped) so the TTL reclaims it; the client falls back.
+    ObjectInfo back = std::move(fit->second);
+    objects_.erase(fit);
+    back.slot = true;
+    back.state = ObjectState::kPending;
+    for (auto& copy : back.copies) {
+      copy.content_crc = 0;
+      copy.shard_crcs.clear();
+    }
+    adapter_.allocator().rename_object(key, slot_key);
+    objects_[slot_key] = std::move(back);
+    return ec;
+  }
+  ++counters_.put_completes;
+  ++counters_.slot_commits;
   bump_view();
   return ErrorCode::OK;
 }
@@ -1559,6 +1675,33 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     draining_.insert(worker_id);
   }
   LOG_INFO << "draining worker " << worker_id;
+
+  // Idle pooled slots (put_start_pooled) with any shard on the draining
+  // worker are cancelled outright: they have no writer attached, clients
+  // transparently fall back / refill elsewhere, and leaving them would pin
+  // the worker until the slot TTL. A slot whose commit is racing this
+  // cancel commits as OBJECT_NOT_FOUND and the client re-puts normally.
+  {
+    std::unique_lock lock(objects_mutex_);
+    for (auto it = objects_.begin(); it != objects_.end();) {
+      bool on_worker = false;
+      if (it->second.slot) {
+        for (const auto& copy : it->second.copies) {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id) on_worker = true;
+          }
+        }
+      }
+      if (!on_worker) {
+        ++it;
+        continue;
+      }
+      free_object_locked(it->first, it->second);
+      it = objects_.erase(it);
+      ++counters_.put_cancels;
+    }
+    bump_view();
+  }
 
   // One migration unit per SHARD on the draining worker (not per copy):
   // bytes already correct on surviving workers are never re-streamed, which

@@ -49,6 +49,10 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_put_starts_total", "put_start calls", c.put_starts.load());
   counter("btpu_put_completes_total", "put_complete calls", c.put_completes.load());
   counter("btpu_put_cancels_total", "put_cancel calls", c.put_cancels.load());
+  counter("btpu_put_slots_granted_total", "pooled put slots granted (put_start_pooled)",
+          c.slots_granted.load());
+  counter("btpu_put_slot_commits_total", "puts committed through a pooled slot (1-RTT path)",
+          c.slot_commits.load());
   counter("btpu_gets_total", "get_workers calls", c.gets.load());
   counter("btpu_removes_total", "remove_object calls", c.removes.load());
   counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());

@@ -110,6 +110,25 @@ ErrorCode KeystoneRpcClient::put_complete(const ObjectKey& key,
   return resp.error_code;
 }
 
+Result<std::vector<PutSlot>> KeystoneRpcClient::put_start_pooled(uint64_t size,
+                                                                 const WorkerConfig& config,
+                                                                 uint32_t count,
+                                                                 const std::string& client_tag) {
+  PutStartPooledResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutStartPooled),
+                            PutStartPooledRequest{size, config, count, client_tag}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.slots);
+}
+
+ErrorCode KeystoneRpcClient::put_commit_slot(const PutCommitSlotRequest& request,
+                                             std::vector<PutSlot>* refill_slots) {
+  PutCommitSlotResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutCommitSlot), request, resp));
+  if (refill_slots && resp.error_code == ErrorCode::OK) *refill_slots = std::move(resp.slots);
+  return resp.error_code;
+}
+
 ErrorCode KeystoneRpcClient::put_cancel(const ObjectKey& key) {
   PutCancelResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutCancel), PutCancelRequest{key},

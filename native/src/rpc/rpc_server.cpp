@@ -165,6 +165,28 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
       return handle<BatchPutCancelRequest, BatchPutCancelResponse>(
           payload,
           [&](const auto& req, auto& resp) { resp.results = ks.batch_put_cancel(req.keys); });
+    case Method::kPutStartPooled:
+      return handle<PutStartPooledRequest, PutStartPooledResponse>(
+          payload, [&](const auto& req, auto& resp) {
+            auto r = ks.put_start_pooled(req.data_size, req.config, req.count, req.client_tag);
+            if (r.ok()) resp.slots = std::move(r).value();
+            resp.error_code = r.error();
+          });
+    case Method::kPutCommitSlot:
+      return handle<PutCommitSlotRequest, PutCommitSlotResponse>(
+          payload, [&](const auto& req, auto& resp) {
+            resp.error_code =
+                ks.put_commit_slot(req.slot_key, req.key, req.content_crc, req.shard_crcs);
+            // The refill rides the same response frame: one client RTT buys
+            // the commit AND the next slot grant. Best-effort — a failed
+            // refill must not taint a committed put.
+            if (resp.error_code == ErrorCode::OK && req.refill_count > 0 &&
+                req.data_size > 0) {
+              auto r = ks.put_start_pooled(req.data_size, req.config, req.refill_count,
+                                           req.client_tag);
+              if (r.ok()) resp.slots = std::move(r).value();
+            }
+          });
     case Method::kDrainWorker:
       return handle<DrainWorkerRequest, DrainWorkerResponse>(
           payload, [&](const auto& req, auto& resp) {

@@ -3,12 +3,32 @@
 #include <algorithm>
 
 #include "btest.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/common/error.h"
 #include "btpu/common/result.h"
 #include "btpu/common/types.h"
 #include "btpu/common/wire.h"
 
 using namespace btpu;
+
+BTEST(Crc32c, CombineMatchesConcatenation) {
+  // crc(X || Y) == combine(crc(X), crc(Y), |Y|) — the identity per-chunk
+  // streaming CRCs and per-shard stamps rely on to merge without re-reading.
+  std::vector<uint8_t> data(100'000);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 131 + 7);
+  const uint32_t whole = crc32c(data.data(), data.size());
+  for (size_t split : {size_t{0}, size_t{1}, size_t{13}, size_t{4096}, size_t{65536},
+                       data.size() - 1, data.size()}) {
+    const uint32_t a = crc32c(data.data(), split);
+    const uint32_t b = crc32c(data.data() + split, data.size() - split);
+    BT_EXPECT_EQ(crc32c_combine(a, b, data.size() - split), whole);
+  }
+  // Three-way merge (repeated lengths hit the cached operator).
+  const uint32_t c1 = crc32c(data.data(), 30'000);
+  const uint32_t c2 = crc32c(data.data() + 30'000, 30'000);
+  const uint32_t c3 = crc32c(data.data() + 60'000, 40'000);
+  BT_EXPECT_EQ(crc32c_combine(crc32c_combine(c1, c2, 30'000), c3, 40'000), whole);
+}
 
 BTEST(Error, DomainsPartitionCodes) {
   BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::OK), 0u);

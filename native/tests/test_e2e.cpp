@@ -299,6 +299,60 @@ BTEST(EndToEnd, FullTcpWireModeWithRpc) {
   BT_EXPECT_EQ(remote_client.cluster_stats().value().total_objects, 1ull);
 }
 
+BTEST(EndToEnd, PooledSlotsServeRepeatSmallPutsAndFallBackWhenReclaimed) {
+  // Remote small puts ride the slot pool: after the first put of a
+  // (size, config) class, every put is write + ONE commit RPC. The fallback
+  // contract: when the keystone reclaims a client's slots (TTL, here forced
+  // via remove_all + restartish flush), puts keep succeeding through the
+  // normal two-RTT path.
+  auto options = EmbeddedClusterOptions::simple(2, 16 << 20);
+  for (auto& w : options.workers) {
+    w.transport = TransportKind::TCP;
+    w.listen_host = "127.0.0.1";
+  }
+  EmbeddedCluster cluster(options);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  rpc::KeystoneRpcServer rpc_server(cluster.keystone(), "127.0.0.1", 0);
+  BT_ASSERT(rpc_server.start() == ErrorCode::OK);
+
+  ClientOptions copts;
+  copts.keystone_address = rpc_server.endpoint();
+  copts.put_slots = 3;
+  ObjectClient remote_client(copts);
+  BT_ASSERT(remote_client.connect() == ErrorCode::OK);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;  // replicated slots work too
+  cfg.max_workers_per_copy = 1;
+  const auto& counters = cluster.keystone().counters();
+  for (int i = 0; i < 8; ++i) {
+    auto data = pattern(64 * 1024, static_cast<uint8_t>(i + 1));
+    const std::string key = "slots/obj" + std::to_string(i);
+    BT_ASSERT(remote_client.put(key, data.data(), data.size(), cfg) == ErrorCode::OK);
+    auto back = remote_client.get(key);
+    BT_ASSERT_OK(back);
+    BT_EXPECT(back.value() == data);
+  }
+  // All but the first (pool-priming) put committed through a slot.
+  BT_EXPECT(counters.slot_commits.load() >= 7ull);
+  // Duplicate key via the slot path reports cleanly and the slot survives.
+  auto dup = pattern(64 * 1024, 9);
+  BT_EXPECT(remote_client.put("slots/obj0", dup.data(), dup.size(), cfg) ==
+            ErrorCode::OBJECT_ALREADY_EXISTS);
+
+  // Forced reclaim of every pooled slot server-side (remove_all wipes slot
+  // objects too): the client's next slot commit misses and falls back.
+  BT_ASSERT_OK(remote_client.remove_all());
+  const uint64_t commits_before = counters.slot_commits.load();
+  auto data = pattern(64 * 1024, 42);
+  BT_ASSERT(remote_client.put("slots/after", data.data(), data.size(), cfg) ==
+            ErrorCode::OK);
+  auto back = remote_client.get("slots/after");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+  BT_EXPECT_EQ(counters.slot_commits.load(), commits_before);  // fallback path
+}
+
 BTEST(EndToEnd, PlacementCacheServesReadsAndHealsStalePlacements) {
   // Small-object reads are metadata-RPC-bound; verified reads may reuse
   // cached placements (ClientOptions::placement_cache_ms). Two properties:

@@ -831,6 +831,43 @@ BTEST(Keystone, DeferredPersistCatchesUpAfterCoordinatorOutage) {
   }
 }
 
+BTEST(Keystone, IdleSlotsReclaimedOnSlotTtlAndCancelledByDrain) {
+  auto cfg = fast_config();
+  cfg.slot_ttl_sec = 1;
+  cfg.pending_put_timeout_sec = 3600;  // slots must NOT wait for this one
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
+  for (auto* w : {&w1, &w2}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+
+  // Idle slots expire on the short slot TTL, releasing their capacity.
+  auto granted = ks.put_start_pooled(4096, wc, 4, "c1");
+  BT_ASSERT_OK(granted);
+  BT_EXPECT_EQ(granted.value().size(), 4u);
+  const uint64_t used = ks.get_cluster_stats().value().used_capacity;
+  BT_EXPECT(used >= 4 * 4096);
+  std::this_thread::sleep_for(1200ms);
+  ks.run_gc_once();
+  BT_EXPECT_EQ(ks.get_cluster_stats().value().used_capacity, 0ull);
+  BT_EXPECT(ks.put_commit_slot(granted.value()[0].slot_key, "late", 0, {}) ==
+            ErrorCode::OBJECT_NOT_FOUND);
+
+  // A drain cancels idle slots on the drained worker outright — no writer
+  // is attached, so nothing pins the worker until the TTL.
+  auto g2 = ks.put_start_pooled(4096, wc, 2, "c2");
+  BT_ASSERT_OK(g2);
+  const NodeId host = g2.value()[0].copies[0].shards[0].worker_id;
+  BT_ASSERT_OK(ks.drain_worker(host));
+  BT_EXPECT(ks.put_commit_slot(g2.value()[0].slot_key, "drained", 0, {}) ==
+            ErrorCode::OBJECT_NOT_FOUND);
+}
+
 BTEST(Keystone, SingleReplicaLostObjectIsDropped) {
   auto cfg = fast_config();
   KeystoneService ks(cfg, nullptr);

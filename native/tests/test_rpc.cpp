@@ -3,6 +3,7 @@
 #include <cstring>
 
 #include "btest.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/keystone/keystone.h"
@@ -117,6 +118,70 @@ BTEST(Rpc, FullMethodSurfaceOverTcp) {
   auto removed = c.remove_all_objects();
   BT_ASSERT_OK(removed);
   BT_EXPECT_EQ(removed.value(), 0ull);
+}
+
+BTEST(Rpc, PooledSlotCommitIsOneRoundTrip) {
+  // The 1-RTT small-put path: pre-granted anonymous slots, data written
+  // into a slot's placements, then ONE commit RPC that renames + completes
+  // + refills. (The reference pays put_start AND put_complete per put,
+  // blackbird_client.cpp:87-117.)
+  RpcFixture f;
+  BT_ASSERT(f.up());
+  auto& c = *f.client;
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+
+  auto granted = c.put_start_pooled(8192, wc, 3, "testclient");
+  BT_ASSERT_OK(granted);
+  BT_ASSERT(granted.value().size() == 3);
+  auto slot = granted.value()[0];
+  BT_ASSERT(slot.copies.size() == 1 && slot.copies[0].shards.size() == 1);
+  // Slots are internal: invisible to listings, unknown as user keys.
+  BT_EXPECT(c.list_objects("", 0).value().empty());
+
+  // Write through the data plane, then commit with a refill piggyback.
+  std::vector<uint8_t> data(8192);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 31 + 5);
+  auto dclient = transport::make_transport_client();
+  const auto& shard = slot.copies[0].shards[0];
+  const auto& mem = std::get<MemoryLocation>(shard.location);
+  BT_ASSERT(dclient->write(shard.remote, mem.remote_addr, mem.rkey, data.data(),
+                           data.size()) == ErrorCode::OK);
+  PutCommitSlotRequest req;
+  req.slot_key = slot.slot_key;
+  req.key = "pooled/obj";
+  req.content_crc = crc32c(data.data(), data.size());
+  req.shard_crcs = {{0, {req.content_crc}}};
+  req.refill_count = 2;
+  req.data_size = 8192;
+  req.config = wc;
+  req.client_tag = "testclient";
+  std::vector<PutSlot> refills;
+  BT_EXPECT(c.put_commit_slot(req, &refills) == ErrorCode::OK);
+  BT_EXPECT_EQ(refills.size(), 2u);
+
+  // Committed object is a first-class citizen: readable, listed, stamped.
+  auto got = c.get_workers("pooled/obj");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value()[0].content_crc, req.content_crc);
+  std::vector<uint8_t> back(data.size(), 0);
+  const auto& gshard = got.value()[0].shards[0];
+  const auto& gmem = std::get<MemoryLocation>(gshard.location);
+  BT_ASSERT(dclient->read(gshard.remote, gmem.remote_addr, gmem.rkey, back.data(),
+                          back.size()) == ErrorCode::OK);
+  BT_EXPECT(back == data);
+  BT_EXPECT_EQ(c.list_objects("", 0).value().size(), 1u);
+
+  // Commit of a consumed/unknown slot -> OBJECT_NOT_FOUND (client fallback
+  // trigger); duplicate final key -> ALREADY_EXISTS and the slot survives.
+  std::vector<PutSlot> none;
+  BT_EXPECT(c.put_commit_slot(req, &none) == ErrorCode::OBJECT_NOT_FOUND);
+  PutCommitSlotRequest dup = req;
+  dup.slot_key = granted.value()[1].slot_key;
+  BT_EXPECT(c.put_commit_slot(dup, &none) == ErrorCode::OBJECT_ALREADY_EXISTS);
+  dup.key = "pooled/obj2";
+  BT_EXPECT(c.put_commit_slot(dup, &none) == ErrorCode::OK);
 }
 
 BTEST(Rpc, ClientReconnectsAfterServerRestart) {

@@ -23,4 +23,9 @@ uint32_t crc32c(const void* data, size_t len, uint32_t seed = 0);
 // pays one GF(2) matrix exponentiation (~tens of us).
 uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b);
 
+// memcpy(dst, src, len) fused with crc32c(src, len, seed) in one pass —
+// the copy out of a staging segment IS the only read of the bytes, so the
+// verified-read paths hash them while they move instead of re-reading.
+uint32_t crc32c_copy(void* dst, const void* src, size_t len, uint32_t seed = 0);
+
 }  // namespace btpu

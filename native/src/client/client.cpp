@@ -337,15 +337,26 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
                                           ops))
       return ErrorCode::NOT_IMPLEMENTED;
   }
+  const uint32_t expect = copies.front().content_crc;
+  const bool check = verify && expect != 0;
+  // Transport-computed CRCs: ops cover [0, size) contiguously in array
+  // order (slices ascending, ranges within a slice ascending), so their
+  // ordered combine IS the object CRC — no post-pass over the buffer.
+  for (auto& op : ops) op.want_crc = check;
   if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
       ec != ErrorCode::OK)
     return ec;
-  const uint32_t expect = copies.front().content_crc;
-  if (verify && expect != 0 && crc32c(buffer, size) != expect) {
-    // Some slice came from a corrupt replica; the caller's per-copy
-    // (verified) reads identify the healthy one.
-    LOG_WARN << "content crc mismatch on split-replica read: retrying per copy";
-    return ErrorCode::CHECKSUM_MISMATCH;
+  if (check) {
+    uint32_t combined = 0;
+    for (size_t j = 0; j < ops.size(); ++j) {
+      combined = j == 0 ? ops[j].crc : crc32c_combine(combined, ops[j].crc, ops[j].len);
+    }
+    if (combined != expect) {
+      // Some slice came from a corrupt replica; the caller's per-copy
+      // (verified) reads identify the healthy one.
+      LOG_WARN << "content crc mismatch on split-replica read: retrying per copy";
+      return ErrorCode::CHECKSUM_MISMATCH;
+    }
   }
   return ErrorCode::OK;
 }
@@ -607,16 +618,21 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
       if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
     }
   }
+  const bool check = verify && !is_write && copy.content_crc != 0;
+  std::vector<transport::WireOp> ops;
   if (!wire_idx.empty()) {
     // Wire shards move as one pipelined batch: every request issued before
     // any response is awaited, so a striped object costs ~one round trip.
-    std::vector<transport::WireOp> ops;
     ops.reserve(wire_idx.size());
     for (size_t i : wire_idx) {
       const auto& shard = copy.shards[i];
       transport::WireOp op;
       if (!transport::make_wire_op(shard, 0, data + offsets[i], shard.length, op))
         return ErrorCode::NOT_IMPLEMENTED;  // FileLocation: worker-served
+      // Verified reads: the transport hashes the bytes WHILE they move
+      // (per-segment under the socket drain, fused with staging copies), so
+      // the integrity check below needs no second pass over wire shards.
+      op.want_crc = check;
       ops.push_back(op);
     }
     if (is_write)
@@ -628,21 +644,34 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
     return ErrorCode::OK;
   }
   // Verify AFTER every shard (device and wire alike) has landed: a
-  // device-only copy bit-rots just as silently as a host one.
-  if (verify && copy.content_crc != 0 && crc32c(data, size) != copy.content_crc) {
-    LOG_WARN << "content crc mismatch on copy " << copy.copy_index
-             << " (bit rot or torn write): treating as copy loss";
-    // Shard CRCs (when stamped) localize the rot for the operator/scrubber.
-    if (copy.shard_crcs.size() == copy.shards.size()) {
-      for (size_t i = 0; i < copy.shards.size(); ++i) {
-        if (crc32c(data + offsets[i], copy.shards[i].length) != copy.shard_crcs[i]) {
-          const auto& s = copy.shards[i];
-          LOG_WARN << "  corrupt shard " << i << " (pool " << s.pool_id << ", worker "
-                   << s.worker_id << ")";
+  // device-only copy bit-rots just as silently as a host one. Wire shard
+  // CRCs come from the transport; device shards (provider-filled) are
+  // hashed here; the object CRC is their ordered combine.
+  if (check) {
+    std::vector<uint32_t> shard_crc(copy.shards.size(), 0);
+    for (size_t j = 0; j < wire_idx.size(); ++j) shard_crc[wire_idx[j]] = ops[j].crc;
+    uint32_t combined = 0;
+    for (size_t i = 0; i < copy.shards.size(); ++i) {
+      if (std::holds_alternative<DeviceLocation>(copy.shards[i].location))
+        shard_crc[i] = crc32c(data + offsets[i], copy.shards[i].length);
+      combined = i == 0 ? shard_crc[i]
+                        : crc32c_combine(combined, shard_crc[i], copy.shards[i].length);
+    }
+    if (combined != copy.content_crc) {
+      LOG_WARN << "content crc mismatch on copy " << copy.copy_index
+               << " (bit rot or torn write): treating as copy loss";
+      // Stamped shard CRCs localize the rot for the operator/scrubber.
+      if (copy.shard_crcs.size() == copy.shards.size()) {
+        for (size_t i = 0; i < copy.shards.size(); ++i) {
+          if (shard_crc[i] != copy.shard_crcs[i]) {
+            const auto& s = copy.shards[i];
+            LOG_WARN << "  corrupt shard " << i << " (pool " << s.pool_id << ", worker "
+                     << s.worker_id << ")";
+          }
         }
       }
+      return ErrorCode::CHECKSUM_MISMATCH;
     }
-    return ErrorCode::CHECKSUM_MISMATCH;
   }
   return ErrorCode::OK;
 }

@@ -1,6 +1,7 @@
 #include "btpu/common/crc32c.h"
 
 #include <array>
+#include <cstring>
 #include <mutex>
 #include <unordered_map>
 
@@ -72,20 +73,6 @@ uint32_t crc32c_shift(uint32_t crc, size_t len2) {
 }
 
 #if defined(__x86_64__)
-// Single serial chain (raw crc in/out).
-__attribute__((target("sse4.2"))) uint32_t crc32c_chain(const uint8_t* p, size_t len,
-                                                        uint32_t crc) {
-  while (len >= 8) {
-    uint64_t v;
-    __builtin_memcpy(&v, p, 8);
-    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
-    p += 8;
-    len -= 8;
-  }
-  while (len--) crc = _mm_crc32_u8(crc, *p++);
-  return crc;
-}
-
 // The crc32 instruction has ~3-cycle latency but 1/cycle throughput: one
 // serial chain caps at ~5 GB/s. Three independent chains saturate the unit
 // (~3x), merged per fixed-size triplet with a PRECOMPUTED shift operator —
@@ -108,28 +95,58 @@ const ShiftOp& lane_shift() {
   return op;
 }
 
-__attribute__((target("sse4.2"))) uint32_t crc32c_hw(const uint8_t* p, size_t len,
-                                                     uint32_t crc) {
+// One kernel, two modes: kStore=false is the plain 3-lane hash; kStore=true
+// fuses a copy into the same pass (each load feeds a store AND the crc32
+// unit — a single serial crc chain would throttle the fused pass to the
+// instruction's ~5 GB/s latency bound, below memcpy + separate crc).
+template <bool kStore>
+__attribute__((target("sse4.2"))) uint32_t crc32c_hw_kernel(uint8_t* dst, const uint8_t* src,
+                                                            size_t len, uint32_t crc) {
   const ShiftOp& shift = lane_shift();
   while (len >= 3 * kLane) {
-    const uint8_t* pa = p;
-    const uint8_t* pb = p + kLane;
-    const uint8_t* pc = p + 2 * kLane;
+    const uint8_t* sa = src;
+    const uint8_t* sb = src + kLane;
+    const uint8_t* sc = src + 2 * kLane;
     uint32_t a = crc, b = 0, c = 0;
     for (size_t i = 0; i < kLane; i += 8) {
       uint64_t va, vb, vc;
-      __builtin_memcpy(&va, pa + i, 8);
-      __builtin_memcpy(&vb, pb + i, 8);
-      __builtin_memcpy(&vc, pc + i, 8);
+      __builtin_memcpy(&va, sa + i, 8);
+      __builtin_memcpy(&vb, sb + i, 8);
+      __builtin_memcpy(&vc, sc + i, 8);
+      if constexpr (kStore) {
+        __builtin_memcpy(dst + i, &va, 8);
+        __builtin_memcpy(dst + kLane + i, &vb, 8);
+        __builtin_memcpy(dst + 2 * kLane + i, &vc, 8);
+      }
       a = static_cast<uint32_t>(_mm_crc32_u64(a, va));
       b = static_cast<uint32_t>(_mm_crc32_u64(b, vb));
       c = static_cast<uint32_t>(_mm_crc32_u64(c, vc));
     }
     crc = gf2_matrix_times(shift.mat, gf2_matrix_times(shift.mat, a) ^ b) ^ c;
-    p += 3 * kLane;
+    src += 3 * kLane;
+    if constexpr (kStore) dst += 3 * kLane;
     len -= 3 * kLane;
   }
-  return crc32c_chain(p, len, crc);
+  while (len >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, src, 8);
+    if constexpr (kStore) {
+      __builtin_memcpy(dst, &v, 8);
+      dst += 8;
+    }
+    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
+    src += 8;
+    len -= 8;
+  }
+  while (len--) {
+    if constexpr (kStore) *dst++ = *src;
+    crc = _mm_crc32_u8(crc, *src++);
+  }
+  return crc;
+}
+
+uint32_t crc32c_hw(const uint8_t* p, size_t len, uint32_t crc) {
+  return crc32c_hw_kernel<false>(nullptr, p, len, crc);
 }
 
 bool have_sse42() {
@@ -149,6 +166,18 @@ uint32_t crc32c(const void* data, size_t len, uint32_t seed) {
   const auto& t = table().t;
   for (size_t i = 0; i < len; ++i) crc = (crc >> 8) ^ t[(crc ^ p[i]) & 0xff];
   return ~crc;
+}
+
+uint32_t crc32c_copy(void* dst, const void* src, size_t len, uint32_t seed) {
+  auto* d = static_cast<uint8_t*>(dst);
+  const auto* s = static_cast<const uint8_t*>(src);
+#if defined(__x86_64__)
+  if (have_sse42()) return ~crc32c_hw_kernel<true>(d, s, len, ~seed);
+#endif
+  std::memcpy(d, s, len);
+  // Hash the DESTINATION: cache-hot, and it describes the bytes actually
+  // delivered even if the (possibly shared) source moves underneath.
+  return crc32c(d, len, seed);
 }
 
 uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b) {

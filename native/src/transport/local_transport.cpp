@@ -6,6 +6,7 @@
 #include <random>
 #include <unordered_map>
 
+#include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/transport/transport.h"
 
@@ -102,7 +103,7 @@ class LocalTransportServer : public TransportServer {
 
 // Bounds+rkey-checked access used by the mux client (local kind).
 ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
-                       bool is_write) {
+                       bool is_write, uint32_t* crc_out) {
   auto& reg = LocalRegistry::instance();
   uint8_t* target = nullptr;
   RegionReadFn read_fn;
@@ -127,12 +128,17 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
   if (target) {
     if (is_write) {
       std::memcpy(target, buf, len);
+    } else if (crc_out) {
+      *crc_out = crc32c_copy(buf, target, len);  // fused: hash while moving
     } else {
       std::memcpy(buf, target, len);
     }
     return ErrorCode::OK;
   }
-  return is_write ? write_fn(offset, buf, len) : read_fn(offset, buf, len);
+  const ErrorCode ec = is_write ? write_fn(offset, buf, len) : read_fn(offset, buf, len);
+  // Callback-backed regions fill `buf` opaquely; the hash is a second pass.
+  if (ec == ErrorCode::OK && !is_write && crc_out) *crc_out = crc32c(buf, len);
+  return ec;
 }
 
 std::unique_ptr<TransportServer> make_local_transport_server() {

@@ -17,6 +17,7 @@
 #include <random>
 #include <unordered_map>
 
+#include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/transport/transport.h"
 
@@ -162,13 +163,15 @@ class ShmMapCache {
 }  // namespace
 
 ErrorCode shm_access(const std::string& name, uint64_t offset, void* buf, uint64_t len,
-                     bool is_write) {
+                     bool is_write, uint32_t* crc_out) {
   uint64_t seg_len = 0;
   uint8_t* base = ShmMapCache::instance().map(name, seg_len);
   if (!base) return ErrorCode::CONNECTION_FAILED;
   if (len > seg_len || offset > seg_len - len) return ErrorCode::MEMORY_ACCESS_ERROR;
   if (is_write) {
     std::memcpy(base + offset, buf, len);
+  } else if (crc_out) {
+    *crc_out = crc32c_copy(buf, base + offset, len);  // fused: hash while moving
   } else {
     std::memcpy(buf, base + offset, len);
   }

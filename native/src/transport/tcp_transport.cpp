@@ -24,6 +24,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/transport.h"
@@ -338,11 +339,10 @@ constexpr uint64_t kStagingBytes = 4ull << 20;  // == kChunkBytes: every sub-op 
 std::atomic<uint64_t> g_staged_ops{0};
 
 bool staged_lane_enabled() {
-  static const bool enabled = [] {
-    const char* env = std::getenv("BTPU_STAGED_DATA");
-    return !(env && env[0] == '0');
-  }();
-  return enabled;
+  // Read per call (it only runs when a NEW connection probes the lane):
+  // tests and operators can flip BTPU_STAGED_DATA without a restart.
+  const char* env = std::getenv("BTPU_STAGED_DATA");
+  return !(env && env[0] == '0');
 }
 
 }  // namespace
@@ -517,6 +517,8 @@ struct SubOp {
   uint64_t addr;   // absolute remote address of this chunk
   uint8_t* buf;    // client-side slice
   uint64_t len;
+  uint64_t off;    // offset within the op (orders the crc combine)
+  uint32_t crc;    // this chunk's crc32c (op->want_crc reads only)
 };
 
 bool use_staged(const PooledConn& c, const SubOp& sub) {
@@ -544,7 +546,7 @@ ErrorCode issue_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode) {
 
 // Reads one response. `healthy` reports whether the stream is still aligned
 // (server-reported errors keep the connection reusable; socket errors don't).
-ErrorCode collect_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode, bool& healthy) {
+ErrorCode collect_sub(const PooledConn& c, SubOp& sub, uint8_t opcode, bool& healthy) {
   uint32_t status = 0;
   healthy = false;
   if (auto ec = net::read_exact(c.sock.fd(), &status, sizeof(status)); ec != ErrorCode::OK)
@@ -554,11 +556,28 @@ ErrorCode collect_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode, boo
     return static_cast<ErrorCode>(status);
   }
   if (opcode == kOpRead) {
+    const bool want_crc = sub.op->want_crc;
     if (use_staged(c, sub)) {
-      std::memcpy(sub.buf, c.stg_base, sub.len);
-    } else if (auto ec = net::read_exact(c.sock.fd(), sub.buf, sub.len);
-               ec != ErrorCode::OK) {
-      return ec;
+      // Fused copy+crc: the drain out of the staging segment is the only
+      // read of the bytes either way.
+      sub.crc = want_crc ? crc32c_copy(sub.buf, c.stg_base, sub.len)
+                         : (std::memcpy(sub.buf, c.stg_base, sub.len), 0u);
+    } else if (!want_crc) {
+      if (auto ec = net::read_exact(c.sock.fd(), sub.buf, sub.len); ec != ErrorCode::OK)
+        return ec;
+    } else {
+      // Segmented drain: hash each segment after it lands while TCP keeps
+      // delivering the next one into the socket buffer — the CRC rides
+      // under the wire instead of costing a post-pass.
+      constexpr uint64_t kSeg = 256 * 1024;
+      uint32_t crc = 0;
+      for (uint64_t pos = 0; pos < sub.len; pos += kSeg) {
+        const uint64_t n = std::min(kSeg, sub.len - pos);
+        if (auto ec = net::read_exact(c.sock.fd(), sub.buf + pos, n); ec != ErrorCode::OK)
+          return ec;
+        crc = crc32c(sub.buf + pos, n, crc);
+      }
+      sub.crc = crc;
     }
   }
   healthy = true;
@@ -577,7 +596,7 @@ bool is_socket_failure(ErrorCode ec) {
 using DeadEndpoints = std::unordered_map<std::string, ErrorCode>;
 
 // Synchronous single-shot on a fresh connection (retry path).
-ErrorCode run_sub_fresh(const SubOp& sub, uint8_t opcode, DeadEndpoints& dead) {
+ErrorCode run_sub_fresh(SubOp& sub, uint8_t opcode, DeadEndpoints& dead) {
   auto& pool = TcpEndpointPool::instance();
   const std::string& endpoint = sub.op->remote->endpoint;
   if (auto it = dead.find(endpoint); it != dead.end()) return it->second;
@@ -605,9 +624,10 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
   subs.reserve(n);
   for (size_t i = 0; i < n; ++i) {
     ops[i].status = ErrorCode::OK;
+    ops[i].crc = 0;
     for (uint64_t off = 0; off < ops[i].len; off += kChunkBytes) {
       const uint64_t len = std::min(kChunkBytes, ops[i].len - off);
-      subs.push_back({&ops[i], ops[i].addr + off, ops[i].buf + off, len});
+      subs.push_back({&ops[i], ops[i].addr + off, ops[i].buf + off, len, off, 0});
     }
   }
 
@@ -627,7 +647,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
   size_t next = 0;
   while (next < subs.size() || !inflight.empty()) {
     if (next < subs.size() && inflight.size() < inflight_cap) {
-      const SubOp& sub = subs[next];
+      SubOp& sub = subs[next];
       if (sub.op->status != ErrorCode::OK) {  // sibling chunk already failed
         ++next;
         continue;
@@ -680,7 +700,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     }
     Flight flight = std::move(inflight[pick]);
     inflight.erase(inflight.begin() + static_cast<ptrdiff_t>(pick));
-    const SubOp& sub = subs[flight.sub];
+    SubOp& sub = subs[flight.sub];
     bool healthy = false;
     ErrorCode ec = collect_sub(flight.conn, sub, opcode, healthy);
     if (healthy) {
@@ -691,6 +711,17 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
       ec = run_sub_fresh(sub, opcode, dead);
     }
     if (ec != ErrorCode::OK) fail(sub.op, ec);
+  }
+  if (!is_write) {
+    // Per-op CRC from the per-chunk CRCs. Chunks completed in any order,
+    // but each op's subs sit contiguously in offset order here, so one
+    // forward fold (cached combine operators — chunk lengths repeat) per
+    // op reassembles its crc.
+    for (const SubOp& sub : subs) {
+      WireOp* op = sub.op;
+      if (!op->want_crc || op->status != ErrorCode::OK) continue;
+      op->crc = sub.off == 0 ? sub.crc : crc32c_combine(op->crc, sub.crc, sub.len);
+    }
   }
   return first;
 }

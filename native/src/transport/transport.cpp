@@ -4,6 +4,7 @@
 #include <charconv>
 #include <cstdio>
 
+#include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/storage/hbm_provider.h"
 #include "btpu/transport/transport.h"
@@ -15,9 +16,9 @@ std::unique_ptr<TransportServer> make_local_transport_server();
 std::unique_ptr<TransportServer> make_tcp_transport_server();
 std::unique_ptr<TransportServer> make_shm_transport_server();
 ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
-                       bool is_write);
+                       bool is_write, uint32_t* crc_out = nullptr);
 ErrorCode shm_access(const std::string& name, uint64_t offset, void* buf, uint64_t len,
-                     bool is_write);
+                     bool is_write, uint32_t* crc_out = nullptr);
 ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
                    uint64_t len);
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
@@ -115,7 +116,8 @@ class MuxTransportClient : public TransportClient {
         tcp_ops.push_back(&op);
         continue;
       }
-      op.status = access(*op.remote, op.addr, op.rkey, op.buf, op.len, is_write);
+      op.status = access(*op.remote, op.addr, op.rkey, op.buf, op.len, is_write,
+                         !is_write && op.want_crc ? &op.crc : nullptr);
       if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
     }
     if (!tcp_ops.empty()) {
@@ -123,23 +125,35 @@ class MuxTransportClient : public TransportClient {
       std::vector<WireOp> subset(tcp_ops.size());
       for (size_t i = 0; i < tcp_ops.size(); ++i) subset[i] = *tcp_ops[i];
       const ErrorCode ec = tcp_batch(subset.data(), subset.size(), is_write, max_concurrency);
-      for (size_t i = 0; i < tcp_ops.size(); ++i) tcp_ops[i]->status = subset[i].status;
+      for (size_t i = 0; i < tcp_ops.size(); ++i) {
+        tcp_ops[i]->status = subset[i].status;
+        tcp_ops[i]->crc = subset[i].crc;
+      }
       if (ec != ErrorCode::OK && first == ErrorCode::OK) first = ec;
     }
     return first;
   }
 
   static ErrorCode access(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
-                          void* buf, uint64_t len, bool is_write) {
-    if (len == 0) return ErrorCode::OK;
+                          void* buf, uint64_t len, bool is_write,
+                          uint32_t* crc_out = nullptr) {
+    if (len == 0) {
+      if (crc_out) *crc_out = 0;
+      return ErrorCode::OK;
+    }
     switch (remote.transport) {
       case TransportKind::LOCAL:
-        return local_access(addr, rkey, buf, len, is_write);
+        return local_access(addr, rkey, buf, len, is_write, crc_out);
       case TransportKind::SHM:
-        return shm_access(remote.endpoint, addr, buf, len, is_write);
-      case TransportKind::TCP:
-        return is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len)
-                        : tcp_read(remote.endpoint, addr, rkey, buf, len);
+        return shm_access(remote.endpoint, addr, buf, len, is_write, crc_out);
+      case TransportKind::TCP: {
+        // The single-op helpers route through tcp_batch, which fills crc
+        // for want_crc ops; plain single ops hash post-hoc when asked.
+        const ErrorCode ec = is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len)
+                                      : tcp_read(remote.endpoint, addr, rkey, buf, len);
+        if (ec == ErrorCode::OK && crc_out) *crc_out = crc32c(buf, len);
+        return ec;
+      }
       default:
         return ErrorCode::TRANSPORT_ERROR;
     }
@@ -156,6 +170,9 @@ ErrorCode TransportClient::read_batch(WireOp* ops, size_t n, size_t) {
     WireOp& op = ops[i];
     op.status = op.len == 0 ? ErrorCode::OK
                             : read(*op.remote, op.addr, op.rkey, op.buf, op.len);
+    // Wrappers that route per-op (fault injector) still honor the CRC
+    // contract, post-hoc.
+    if (op.status == ErrorCode::OK && op.want_crc) op.crc = crc32c(op.buf, op.len);
     if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
   }
   return first;

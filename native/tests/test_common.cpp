@@ -28,6 +28,18 @@ BTEST(Crc32c, CombineMatchesConcatenation) {
   const uint32_t c2 = crc32c(data.data() + 30'000, 30'000);
   const uint32_t c3 = crc32c(data.data() + 60'000, 40'000);
   BT_EXPECT_EQ(crc32c_combine(crc32c_combine(c1, c2, 30'000), c3, 40'000), whole);
+
+  // Fused copy+crc: same hash as the plain function, bytes really copied,
+  // seeds chain for segmented drains.
+  std::vector<uint8_t> dst(data.size(), 0);
+  BT_EXPECT_EQ(crc32c_copy(dst.data(), data.data(), data.size()), whole);
+  BT_EXPECT(dst == data);
+  std::fill(dst.begin(), dst.end(), 0);
+  uint32_t chained = crc32c_copy(dst.data(), data.data(), 12'345);
+  chained = crc32c_copy(dst.data() + 12'345, data.data() + 12'345, data.size() - 12'345,
+                        chained);
+  BT_EXPECT_EQ(chained, whole);
+  BT_EXPECT(dst == data);
 }
 
 BTEST(Error, DomainsPartitionCodes) {

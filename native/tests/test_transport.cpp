@@ -4,10 +4,12 @@
 // binaries (clients/ucx_client.cpp); here the contract is unit-tested.
 #include <atomic>
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 
 #include "btest.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/transport.h"
 
@@ -261,6 +263,62 @@ BTEST(Transport, TcpBatchSplitsWideOps) {
   BT_EXPECT(make_transport_client()->read_batch(&get, 1) == ErrorCode::OK);
   BT_EXPECT(std::memcmp(src.data(), dst.data(), len) == 0);
   server->stop();
+}
+
+BTEST(Transport, TcpWantCrcCoversStagedAndMultiChunkReads) {
+  // The want_crc contract over real TCP: per-chunk CRCs (an op wider than
+  // kChunkBytes splits internally) must combine to the whole op's crc32c,
+  // on both the staged (same-host shm segment, fused copy) and streaming
+  // lanes. A fold/ordering bug here would surface in production as
+  // spurious CHECKSUM_MISMATCH on every verified read past 4 MiB.
+  auto server = make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  const uint64_t len = 9ull << 20;  // > 2 chunks
+  std::vector<uint8_t> region(len);
+  auto reg = server->register_region(region.data(), region.size(), "crc");
+  BT_ASSERT_OK(reg);
+  const auto desc = reg.value();
+  std::vector<uint8_t> src(len);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = static_cast<uint8_t>(i * 131 >> 4 ^ i);
+  WireOp put{&desc, desc.remote_base, parse_rkey(desc), src.data(), len};
+  BT_EXPECT(make_transport_client()->write_batch(&put, 1) == ErrorCode::OK);
+
+  auto client = make_transport_client();
+  // Staged lane (default on same host): wide op, per-chunk fused copies.
+  std::vector<uint8_t> dst(len, 0);
+  WireOp get{&desc, desc.remote_base, parse_rkey(desc), dst.data(), len};
+  get.want_crc = true;
+  const uint64_t staged_before = tcp_staged_op_count();
+  BT_EXPECT(client->read_batch(&get, 1) == ErrorCode::OK);
+  BT_EXPECT(tcp_staged_op_count() > staged_before);
+  BT_EXPECT(dst == src);
+  BT_EXPECT_EQ(get.crc, crc32c(src.data(), len));
+  // Small op (single chunk) keeps the contract too.
+  WireOp small{&desc, desc.remote_base + 12345, parse_rkey(desc), dst.data(), 70000};
+  small.want_crc = true;
+  BT_EXPECT(client->read_batch(&small, 1) == ErrorCode::OK);
+  BT_EXPECT_EQ(small.crc, crc32c(src.data() + 12345, 70000));
+  server->stop();
+
+  // Streaming lane (staged lane disabled): the segmented drain hashes as
+  // segments land; same combined result.
+  setenv("BTPU_STAGED_DATA", "0", 1);
+  auto server2 = make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server2->start("127.0.0.1", 0) == ErrorCode::OK);
+  std::vector<uint8_t> region2(len);
+  auto reg2 = server2->register_region(region2.data(), region2.size(), "crc2");
+  BT_ASSERT_OK(reg2);
+  const auto desc2 = reg2.value();
+  WireOp put2{&desc2, desc2.remote_base, parse_rkey(desc2), src.data(), len};
+  BT_EXPECT(make_transport_client()->write_batch(&put2, 1) == ErrorCode::OK);
+  std::fill(dst.begin(), dst.end(), 0);
+  WireOp get2{&desc2, desc2.remote_base, parse_rkey(desc2), dst.data(), len};
+  get2.want_crc = true;
+  BT_EXPECT(make_transport_client()->read_batch(&get2, 1) == ErrorCode::OK);
+  BT_EXPECT(dst == src);
+  BT_EXPECT_EQ(get2.crc, crc32c(src.data(), len));
+  unsetenv("BTPU_STAGED_DATA");
+  server2->stop();
 }
 
 BTEST(Transport, TcpBatchFailsFastOnDeadEndpoint) {

@@ -73,10 +73,18 @@ class _IoVec(ctypes.Structure):
 _BATCH_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(_IoVec), _u64)
 _FLUSH_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
 _COPY_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64, _u64, _u64, _u64, _u64)
+# NOTE: the out-buffer is c_void_p, NOT c_char_p — ctypes converts c_char_p
+# callback arguments to an immutable bytes COPY, so writes through it would
+# never reach the caller's buffer.
+_FABRIC_ADDR_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, _u64)
+_FABRIC_OFFER_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64, _u64, _u64, _u64)
+_FABRIC_PULL_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, _u64,
+                                   _u64, _u64, _u64)
 
 
 class _ProviderStruct(ctypes.Structure):
-    # Must match BtpuHbmProviderV3 (hbm_provider.h) field for field.
+    # Must match BtpuHbmProviderV4 (hbm_provider.h) field for field: the V3
+    # table followed by the device-fabric entries.
     _fields_ = [
         ("ctx", ctypes.c_void_p),
         ("alloc_region", _ALLOC_FN),
@@ -88,6 +96,9 @@ class _ProviderStruct(ctypes.Structure):
         ("read_batch", _BATCH_FN),
         ("flush", _FLUSH_FN),
         ("copy", _COPY_FN),
+        ("fabric_address", _FABRIC_ADDR_FN),
+        ("fabric_offer", _FABRIC_OFFER_FN),
+        ("fabric_pull", _FABRIC_PULL_FN),
     ]
 
 
@@ -152,6 +163,15 @@ class JaxHbmProvider:
         # locks first).
         self._staging: dict = {}
         self._staging_lock = threading.Lock()
+        # Cross-process device fabric (lazily started transfer server):
+        # None = not probed, False = unavailable/disabled.
+        self._fabric = None
+        self._fabric_lock = threading.Lock()
+        self._fabric_conns: dict = {}
+        self._offered: dict = {}  # transfer_id -> (spec, offered_at)
+        self.fabric_offers = 0
+        self.fabric_pulls = 0
+        self.fabric_discards = 0
 
         P = page_bytes
         jnp = jax.numpy
@@ -844,6 +864,154 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
+    # -- cross-process device fabric (jax.experimental.transfer) -----------
+
+    def _fabric_server(self):
+        """The lazily started per-process transfer server, or None.
+
+        On TPU the transfer rides the chip fabric; on CPU it is a bulk
+        socket between the two processes' runtimes — either way the bytes
+        never pass through the keystone or the worker's staged host lane.
+        BTPU_HBM_FABRIC=0 disables."""
+        with self._fabric_lock:
+            if self._fabric is not None:
+                return self._fabric or None
+            if os.environ.get("BTPU_HBM_FABRIC") == "0":
+                self._fabric = False
+                return None
+            try:
+                from jax.experimental import transfer
+
+                dev = self._jax.local_devices()[0]
+                self._fabric = transfer.start_transfer_server(
+                    dev.client, "127.0.0.1:0", ["127.0.0.1:0"])
+            except Exception:  # noqa: BLE001 - no fabric on this stack
+                self._fabric = False
+                return None
+            return self._fabric
+
+    def _fabric_connection(self, addr: str):
+        server = self._fabric_server()  # before the lock: it takes the same lock
+        with self._fabric_lock:
+            conn = self._fabric_conns.get(addr)
+            if conn is None:
+                conn = self._fabric_conns[addr] = server.connect(addr)
+            return conn
+
+    def _fabric_range_array(self, region, offset: int, length: int):
+        """The region's [offset, offset+len) bytes as a 1-D device array —
+        the unit the fabric transfers (both sides agree on uint8[len])."""
+        if region["view"] is not None:
+            return self._jax.device_put(
+                np.asarray(region["view"][offset : offset + length]), region["device"])
+        P = self.page_bytes
+        p0, a = offset // P, offset % P
+        m_padded = _pow2_at_least(-(-(a + length) // P))  # keep jit cache log2-bounded
+        with region["lock"]:
+            pages = self._read_run_fn(m_padded)(region["buf"], np.int32(p0))
+        # Chunk-aligned placements make this a pure reshape in practice;
+        # padded rows (clipped reads) fall off the slice.
+        return pages.reshape(-1)[a : a + length]
+
+    def _fabric_address(self, _ctx, buf, cap):
+        try:
+            server = self._fabric_server()
+            if server is None:
+                return 1
+            addr = server.address().encode()
+            if len(addr) + 1 > cap:
+                return 1
+            ctypes.memmove(buf, addr, len(addr) + 1)
+            return 0
+        except Exception:  # noqa: BLE001
+            return 1
+
+    def _fabric_gc_offers(self) -> None:
+        """Discards offers whose pull never came (orchestrator fell back):
+        the transfer server pins each offered device array until SOMETHING
+        pulls it, and the API has no cancel — so stale offers are drained by
+        a self-pull. Runs opportunistically before each new offer."""
+        import time
+
+        now = time.monotonic()
+        with self._fabric_lock:
+            stale = [(tid, spec) for tid, (spec, at) in self._offered.items()
+                     if now - at > 60.0]
+            for tid, _spec in stale:
+                del self._offered[tid]
+        for tid, spec in stale:
+            try:
+                self._fabric_connection(self._fabric_server().address()).pull(tid, [spec])
+                self.fabric_discards += 1
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
+    def _fabric_offer(self, _ctx, region_id, offset, length, transfer_id):
+        try:
+            server = self._fabric_server()
+            with self._lock:
+                region = self._regions.get(region_id)
+            if server is None or region is None or offset + length > region["size"]:
+                return 1
+            self._fabric_gc_offers()
+            arr = self._fabric_range_array(region, offset, length)
+            server.await_pull(int(transfer_id), [arr])
+            import time
+
+            from jax.sharding import SingleDeviceSharding
+
+            spec = self._jax.ShapeDtypeStruct(
+                arr.shape, arr.dtype, sharding=SingleDeviceSharding(region["device"]))
+            with self._fabric_lock:
+                self._offered[int(transfer_id)] = (spec, time.monotonic())
+            self.fabric_offers += 1
+            return 0
+        except Exception:  # noqa: BLE001
+            return 1
+
+    def _fabric_pull(self, _ctx, remote_addr, transfer_id, region_id, offset, length):
+        try:
+            jax = self._jax
+            jnp = jax.numpy
+            from jax.sharding import SingleDeviceSharding
+
+            if self._fabric_server() is None:
+                return 1
+            with self._lock:
+                region = self._regions.get(region_id)
+            if region is None or offset + length > region["size"]:
+                return 1
+            conn = self._fabric_connection(remote_addr.decode())
+            spec = jax.ShapeDtypeStruct((int(length),), jnp.uint8,
+                                        sharding=SingleDeviceSharding(region["device"]))
+            out = conn.pull(int(transfer_id), [spec])[0]
+            if region["view"] is not None:
+                region["view"][offset : offset + length] = np.asarray(out)
+            else:
+                # Pad to whole pow2 pages on device, then the masked scatter
+                # the write path uses (phase bytes masked by v0/v1, pad rows
+                # dropped via an out-of-range index) — pow2 keeps the jit
+                # cache log2-bounded like every other dispatch here.
+                P = self.page_bytes
+                p0, a = offset // P, offset % P
+                m = -(-(a + length) // P)
+                m_padded = _pow2_at_least(m)
+                pages = jnp.pad(out, (a, m_padded * P - a - length)).reshape(m_padded, P)
+                meta = np.zeros((3, m_padded), dtype=np.int32)
+                meta[0, :] = region["n_pages"]  # pad rows: dropped by scatter
+                meta[0, :m] = np.arange(p0, p0 + m, dtype=np.int32)
+                meta[1, 0] = a
+                meta[2, :m] = P
+                meta[2, m - 1] = (a + length - 1) % P + 1
+                dev_meta = jax.device_put(meta, region["device"])
+                with region["lock"]:
+                    region["buf"] = self._write_fn(region["buf"], pages, dev_meta)
+                    region["buf"].block_until_ready()  # pull blocks until durable
+            self.fabric_pulls += 1
+            return 0
+        except Exception:  # noqa: BLE001
+            return 1
+
     def _flush(self, _ctx):
         try:
             self.synchronize()
@@ -869,15 +1037,24 @@ class JaxHbmProvider:
             read_batch=_BATCH_FN(self._read_batch),
             flush=_FLUSH_FN(self._flush),
             copy=_COPY_FN(self._copy),
+            fabric_address=_FABRIC_ADDR_FN(self._fabric_address),
+            fabric_offer=_FABRIC_OFFER_FN(self._fabric_offer),
+            fabric_pull=_FABRIC_PULL_FN(self._fabric_pull),
         )
-        lib.btpu_register_hbm_provider_v3(
-            ctypes.cast(ctypes.pointer(self._struct), ctypes.c_void_p))
+        ptr = ctypes.cast(ctypes.pointer(self._struct), ctypes.c_void_p)
+        if hasattr(lib, "btpu_register_hbm_provider_v4"):
+            lib.btpu_register_hbm_provider_v4(ptr)
+        else:  # older library: the v3 prefix of the struct matches exactly
+            lib.btpu_register_hbm_provider_v3(ptr)
         return self
 
     @staticmethod
     def unregister() -> None:
         """Restores the built-in host-memory emulation."""
-        lib.btpu_register_hbm_provider_v3(None)
+        if hasattr(lib, "btpu_register_hbm_provider_v4"):
+            lib.btpu_register_hbm_provider_v4(None)
+        else:
+            lib.btpu_register_hbm_provider_v3(None)
 
     def region_count(self) -> int:
         with self._lock:

@@ -99,6 +99,7 @@ class ProcessCluster:
         workdir: str | None = None,
         heartbeat_ttl_ms: int = 2000,
         slice_ids: list[int] | None = None,
+        worker_env: dict | None = None,
     ):
         """slice_ids: per-worker TPU slice id (default: all slice 0).
         Workers on different slices model the multi-slice pod: placement
@@ -140,6 +141,8 @@ class ProcessCluster:
                 cfg = self._worker_config(i, pool_mb, dram_pool_mb, heartbeat_ttl_ms)
                 env = dict(os.environ)
                 env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+                if worker_env:
+                    env.update(worker_env)
                 args = [sys.executable, "-m", "blackbird_tpu.worker",
                         "--config", str(cfg)]
                 if devices_per_worker == 0:

@@ -10,6 +10,7 @@
 #include <cstring>
 
 #include "btpu/client/embedded.h"
+#include "btpu/rpc/rpc_server.h"
 
 using namespace btpu;
 using Clock = std::chrono::steady_clock;
@@ -240,6 +241,51 @@ int main(int argc, char** argv) {
     }
     put_stats.summarize("put", sz, json);
     get_stats.summarize("get", sz, json);
+
+    // Repeat-read rows: ONE key read over and over — the serving-cache
+    // shape. "get_repeat" pays the metadata RPC per read; "get_cached"
+    // opts into the placement cache (ClientOptions::placement_cache_ms)
+    // and skips it on every hit. Both run against a REAL RPC keystone —
+    // in --embedded mode one is spun up here — because the cache exists
+    // to elide a network round trip.
+    {
+      client::ClientOptions copts;
+      std::unique_ptr<rpc::KeystoneRpcServer> repeat_rpc;
+      if (cluster) {
+        repeat_rpc = std::make_unique<rpc::KeystoneRpcServer>(cluster->keystone(),
+                                                              "127.0.0.1", 0);
+        if (repeat_rpc->start() != ErrorCode::OK) return 1;
+        copts.keystone_address = repeat_rpc->endpoint();
+      } else {
+        copts.set_keystone_endpoints(keystone);
+      }
+      const std::string rkey_name = "bench/repeat/" + std::to_string(sz);
+      if (auto ec = client.put(rkey_name, data.data(), sz, wc); ec != ErrorCode::OK) {
+        std::fprintf(stderr, "repeat-row put failed: %s\n",
+                     std::string(to_string(ec)).c_str());
+        return 1;
+      }
+      for (const uint32_t cache_ms : {0u, 60'000u}) {
+        copts.placement_cache_ms = cache_ms;
+        if (no_verify) copts.verify_reads = false;  // raw reads skip the cache
+        client::ObjectClient reader(copts);
+        if (reader.connect() != ErrorCode::OK) return 1;
+        OpStats stats;
+        const int warmup = std::max(1, iterations / 10);
+        for (int it = -warmup; it < iterations; ++it) {
+          auto t0 = Clock::now();
+          auto got = reader.get_into(rkey_name, readback.data(), sz);
+          auto t1 = Clock::now();
+          if (!got.ok() || got.value() != sz) {
+            std::fprintf(stderr, "repeat-row get failed\n");
+            return 1;
+          }
+          if (it >= 0) stats.record(std::chrono::duration<double>(t1 - t0).count());
+        }
+        stats.summarize(cache_ms ? "get_cached" : "get_repeat", sz, json);
+      }
+      client.remove(rkey_name);
+    }
   }
   return 0;
 }

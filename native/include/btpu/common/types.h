@@ -239,6 +239,11 @@ struct MemoryPool {
   // (0/1 = none). HBM pools advertise the provider chunk size so shards hit
   // the whole-chunk fast path (no read-modify-write on device).
   uint64_t alignment{0};
+  // Cross-process device fabric endpoint (hbm_provider v4; "" = none): when
+  // BOTH ends of a keystone-driven move advertise one, the bytes ride the
+  // device fabric (jax.experimental.transfer — chip fabric on TPU) instead
+  // of the staged host lane.
+  std::string fabric_addr;
 
   double utilization() const noexcept {
     return size > 0 ? static_cast<double>(used) / static_cast<double>(size) : 0.0;

@@ -317,13 +317,13 @@ inline bool decode(Reader& r, ClusterStats& s) {
 
 inline void encode(Writer& w, const MemoryPool& p) {
   encode_struct(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote,
-                p.topo, p.alignment);
+                p.topo, p.alignment, p.fabric_addr);
 }
 inline bool decode(Reader& r, MemoryPool& p) {
-  // `alignment` was appended after v1 shipped; decode_struct's tail
-  // tolerance defaults it (0 = unaligned) for records that predate it.
+  // `alignment` and `fabric_addr` were appended after v1 shipped;
+  // decode_struct's tail tolerance defaults them for older records.
   return decode_struct(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
-                       p.remote, p.topo, p.alignment);
+                       p.remote, p.topo, p.alignment, p.fabric_addr);
 }
 
 inline void encode(Writer& w, const ObjectSummary& o) {

@@ -84,6 +84,8 @@ struct KeystoneCounters {
   std::atomic<uint64_t> put_cancels{0};
   std::atomic<uint64_t> slots_granted{0};
   std::atomic<uint64_t> slot_commits{0};
+  // Cross-process device moves that rode the fabric instead of the host lane.
+  std::atomic<uint64_t> fabric_moves{0};
   std::atomic<uint64_t> gets{0};
   std::atomic<uint64_t> removes{0};
   std::atomic<uint64_t> gc_collected{0};
@@ -267,7 +269,9 @@ class KeystoneService {
   // Pools eligible for NEW placements: draining workers' pools excluded.
   alloc::PoolMap allocatable_pools_snapshot() const;
   // One live shard's bytes into a staged placement (device fast path incl.).
-  ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst);
+  // `pools`: caller-hoisted pool snapshot (drain calls this per shard).
+  ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
+                         const alloc::PoolMap& pools);
   // Reconstructs the dead shards of one erasure-coded copy from any k
   // survivors (segmented) onto fresh placements and splices them in.
   bool repair_ec_object(const ObjectKey& key, uint64_t epoch, const CopyPlacement& copy,

@@ -104,6 +104,24 @@ class StorageBackend {
     static const std::string kNone;
     return kNone;
   }
+
+  // Cross-process device fabric (hbm_provider v4). fabric_address() == ""
+  // means this backend has no fabric and the hooks return NOT_IMPLEMENTED.
+  virtual std::string fabric_address() const { return {}; }
+  virtual ErrorCode fabric_offer(uint64_t offset, uint64_t len, uint64_t transfer_id) {
+    (void)offset;
+    (void)len;
+    (void)transfer_id;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
+  virtual ErrorCode fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
+                                uint64_t offset, uint64_t len) {
+    (void)remote_addr;
+    (void)transfer_id;
+    (void)offset;
+    (void)len;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
 };
 
 // Builds a backend for any storage class (no nullptr gaps):

@@ -20,6 +20,7 @@
 #pragma once
 
 #include <cstdint>
+#include <string>
 
 #include "btpu/common/error.h"
 
@@ -62,11 +63,37 @@ typedef struct BtpuHbmProviderV3 {
               uint64_t dst_offset, uint64_t len);
 } BtpuHbmProviderV3;
 
+// v4 appends the CROSS-PROCESS device fabric: one-sided pulls between the
+// device runtimes of different worker processes (JAX provider: a
+// jax.experimental.transfer server per process — on TPU the bytes ride the
+// chip fabric, never a host socket). The keystone orchestrates: it tells
+// the source worker to OFFER a region range under a transfer id, then the
+// destination worker to PULL it straight into its own region. All three
+// entries may be null (no fabric — movers stage through the host lane).
+typedef struct BtpuHbmProviderV4 {
+  BtpuHbmProviderV3 base;
+  // Address other processes' pulls can reach this provider's fabric server
+  // at. Returns 0 and fills `buf` (NUL-terminated, `cap` bytes) or nonzero
+  // when no fabric is available.
+  int (*fabric_address)(void* ctx, char* buf, uint64_t cap);
+  // Stages [offset, offset+len) of `region` for exactly one pull under
+  // `transfer_id`. Returns once the range is offered (not once pulled).
+  int (*fabric_offer)(void* ctx, uint64_t region_id, uint64_t offset, uint64_t len,
+                      uint64_t transfer_id);
+  // Pulls `len` bytes offered under `transfer_id` at `remote_fabric_addr`
+  // into [offset, offset+len) of `region`. Blocks until the bytes are in
+  // device memory.
+  int (*fabric_pull)(void* ctx, const char* remote_fabric_addr, uint64_t transfer_id,
+                     uint64_t region_id, uint64_t offset, uint64_t len);
+} BtpuHbmProviderV4;
+
 // Installs the process-wide provider (Python calls this through ctypes).
-// Passing NULL restores the built-in emulated provider. The v3 suffix makes
-// a stale library/binding pair fail loudly at symbol lookup instead of
-// reading past the end of a smaller struct.
+// Passing NULL restores the built-in emulated provider. The version suffix
+// makes a stale library/binding pair fail loudly at symbol lookup instead
+// of reading past the end of a smaller struct. v3 registration keeps
+// working (fabric entries default to null).
 void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider);
+void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider);
 
 }  // extern "C"
 
@@ -84,4 +111,10 @@ ErrorCode hbm_flush();
 // entry when present, else stages through a bounded host buffer.
 ErrorCode hbm_copy(uint64_t src_region, uint64_t src_offset, uint64_t dst_region,
                    uint64_t dst_offset, uint64_t len);
+// Cross-process device fabric (v4; empty string / NOT_IMPLEMENTED without).
+std::string hbm_fabric_address();
+ErrorCode hbm_fabric_offer(uint64_t region_id, uint64_t offset, uint64_t len,
+                           uint64_t transfer_id);
+ErrorCode hbm_fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
+                          uint64_t region_id, uint64_t offset, uint64_t len);
 }  // namespace btpu::storage

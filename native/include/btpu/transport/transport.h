@@ -35,6 +35,15 @@ namespace btpu::transport {
 // device memory): the transport server forwards one-sided ops to these.
 using RegionReadFn = std::function<ErrorCode(uint64_t offset, void* dst, uint64_t len)>;
 using RegionWriteFn = std::function<ErrorCode(uint64_t offset, const void* src, uint64_t len)>;
+// Device-fabric hooks for callback-backed device regions (hbm_provider v4):
+// offer stages a range for one cross-process pull under a transfer id; pull
+// fetches an offered range from a remote fabric address straight into this
+// region — on TPU the bytes ride the chip fabric, never this transport.
+using RegionOfferFn =
+    std::function<ErrorCode(uint64_t offset, uint64_t len, uint64_t transfer_id)>;
+using RegionPullFn = std::function<ErrorCode(const std::string& remote_fabric_addr,
+                                             uint64_t transfer_id, uint64_t offset,
+                                             uint64_t len)>;
 
 // Worker side: owns registered regions and (for wire transports) a listener.
 class TransportServer {
@@ -68,6 +77,16 @@ class TransportServer {
     (void)tag;
     (void)read_fn;
     (void)write_fn;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
+  // Attaches device-fabric hooks to an already-registered (virtual) region.
+  // Transports that cannot serve fabric commands ignore this (the keystone
+  // falls back to the staged host lane).
+  virtual ErrorCode attach_fabric(const RemoteDescriptor& desc, RegionOfferFn offer_fn,
+                                  RegionPullFn pull_fn) {
+    (void)desc;
+    (void)offer_fn;
+    (void)pull_fn;
     return ErrorCode::NOT_IMPLEMENTED;
   }
 };
@@ -111,6 +130,24 @@ class TransportClient {
   // 0 = transport default.
   virtual ErrorCode read_batch(WireOp* ops, size_t n, size_t max_concurrency = 0);
   virtual ErrorCode write_batch(WireOp* ops, size_t n, size_t max_concurrency = 0);
+
+  // Device-fabric commands against a worker's callback-backed device region
+  // (RegionOfferFn/RegionPullFn on the server side). The command rides the
+  // control lane; the PAYLOAD rides the device fabric between the two
+  // worker processes. NOT_IMPLEMENTED = no fabric on this transport — the
+  // caller stages through the host lane instead.
+  virtual ErrorCode fabric_offer(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                                 uint64_t len, uint64_t transfer_id) {
+    (void)remote, (void)addr, (void)rkey, (void)len, (void)transfer_id;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
+  virtual ErrorCode fabric_pull(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                                uint64_t len, uint64_t transfer_id,
+                                const std::string& src_fabric_addr) {
+    (void)remote, (void)addr, (void)rkey, (void)len, (void)transfer_id,
+        (void)src_fabric_addr;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
 };
 
 // Factory: server for one kind; mux client that routes on descriptor kind.

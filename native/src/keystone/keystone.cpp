@@ -364,15 +364,70 @@ ErrorCode device_copy_object(const CopyPlacement& src, const CopyPlacement& dst,
   return ErrorCode::OK;
 }
 
+// Cross-process device fabric: when every overlapping (src, dst) segment
+// sits on pools that BOTH advertise a fabric endpoint (hbm_provider v4),
+// the keystone orchestrates offer+pull between the two worker processes and
+// the bytes ride the device fabric (chip fabric on TPU) — never this
+// keystone, never the staged host lane. Returns false on any miss; the
+// caller falls back (a partially fabric-moved destination is re-streamed
+// whole, which is correct if wasteful — failures here are rare).
+bool fabric_copy_object(transport::TransportClient& client, const CopyPlacement& src,
+                        const CopyPlacement& dst, uint64_t size, const alloc::PoolMap& pools) {
+  static std::atomic<uint64_t> transfer_salt{0x66616272u};  // process-unique ids
+  size_t si = 0, di = 0;
+  uint64_t s_off = 0, d_off = 0, pos = 0;
+  while (pos < size) {
+    if (si >= src.shards.size() || di >= dst.shards.size()) return false;
+    const ShardPlacement& ss = src.shards[si];
+    const ShardPlacement& ds = dst.shards[di];
+    const auto* sm = std::get_if<MemoryLocation>(&ss.location);
+    const auto* dm = std::get_if<MemoryLocation>(&ds.location);
+    if (!sm || !dm) return false;
+    auto sp = pools.find(ss.pool_id);
+    auto dp = pools.find(ds.pool_id);
+    if (sp == pools.end() || dp == pools.end()) return false;
+    const std::string& src_fabric = sp->second.fabric_addr;
+    if (src_fabric.empty() || dp->second.fabric_addr.empty()) return false;
+    // Same process (one fabric server serves all its pools): the host lane
+    // is a local memcpy there and a self-pull buys nothing.
+    if (src_fabric == dp->second.fabric_addr) return false;
+    // Bounded segments: each offer pins a staged device array on the source
+    // until pulled (or GC'd), so cap what a single failed round can strand.
+    constexpr uint64_t kFabricSeg = 32ull << 20;
+    const uint64_t n =
+        std::min({ss.length - s_off, ds.length - d_off, size - pos, kFabricSeg});
+    const uint64_t id =
+        (static_cast<uint64_t>(std::chrono::steady_clock::now().time_since_epoch().count())
+         << 16) ^
+        transfer_salt.fetch_add(1);
+    if (client.fabric_offer(ss.remote, sm->remote_addr + s_off, sm->rkey, n, id) !=
+        ErrorCode::OK)
+      return false;
+    if (client.fabric_pull(ds.remote, dm->remote_addr + d_off, dm->rkey, n, id,
+                           src_fabric) != ErrorCode::OK)
+      return false;
+    pos += n;
+    s_off += n;
+    d_off += n;
+    if (s_off == ss.length) { ++si; s_off = 0; }
+    if (d_off == ds.length) { ++di; d_off = 0; }
+  }
+  return true;
+}
+
 // Streams `size` bytes from `src` into every copy in `dsts` through a bounded
 // chunk buffer, so keystone-side data movement (repair, demotion) never
 // buffers a whole object in host memory. Fully device-resident src->dst
-// pairs skip the host entirely (ICI path). The source's CRC (when stamped)
-// is verified as the bytes stream: a mover must never propagate a
-// bit-rotten copy — the caller fails over to the next source instead
-// (device->device moves skip the check; those bytes never touch the host).
+// pairs skip the host entirely (ICI path), and cross-process device pools
+// with fabric endpoints move over the device fabric (when `pools` is
+// given). The source's CRC (when stamped) is verified as the bytes stream:
+// a mover must never propagate a bit-rotten copy — the caller fails over to
+// the next source instead (device->device and fabric moves skip the check;
+// those bytes never touch the host).
 ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
-                            const std::vector<CopyPlacement>& dsts, uint64_t size) {
+                            const std::vector<CopyPlacement>& dsts, uint64_t size,
+                            const alloc::PoolMap* pools = nullptr,
+                            std::atomic<uint64_t>* fabric_moves = nullptr) {
   std::vector<const CopyPlacement*> staged;
   if (all_shards_on_device(src)) {
     for (const auto& dst : dsts) {
@@ -382,6 +437,17 @@ ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacem
     }
   } else {
     for (const auto& dst : dsts) staged.push_back(&dst);
+  }
+  if (!staged.empty() && pools) {
+    std::vector<const CopyPlacement*> rest;
+    for (const CopyPlacement* dst : staged) {
+      if (fabric_copy_object(client, src, *dst, size, *pools)) {
+        if (fabric_moves) fabric_moves->fetch_add(1);
+      } else {
+        rest.push_back(dst);
+      }
+    }
+    staged.swap(rest);
   }
   if (staged.empty()) return ErrorCode::OK;
 
@@ -1771,8 +1837,11 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       return ErrorCode::NOT_LEADER;
     }
     // Re-snapshot targets each round: workers registering mid-drain add
-    // capacity, workers dying mid-drain stop being selected.
+    // capacity, workers dying mid-drain stop being selected. The full pool
+    // map is hoisted per round too — stream_shard consults it per shard for
+    // the fabric lane.
     const alloc::PoolMap targets = allocatable_pools_snapshot();
+    const alloc::PoolMap all_pools = memory_pools();
     bool pending_touches = false;
     auto moves = scan_moves(pending_touches);
     if (moves.empty() && !pending_touches) {
@@ -1814,7 +1883,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
 
       // Stream straight from the victim shard — alive, unlike crash repair.
-      if (stream_shard(m.shard, staged[0]) != ErrorCode::OK) {
+      if (stream_shard(m.shard, staged[0], all_pools) != ErrorCode::OK) {
         adapter_.free_object(staging_key);
         continue;
       }
@@ -1892,12 +1961,23 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
 // Streams one live shard's bytes into a freshly staged placement, device
 // fast path included (chip-to-chip, no host staging, when both ends are
 // device-resident).
-ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPlacement& dst) {
+ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
+                                        const alloc::PoolMap& pools) {
   const auto* src_dev = std::get_if<DeviceLocation>(&src.location);
   if (src_dev && dst.shards.size() == 1) {
     if (const auto* dst_dev = std::get_if<DeviceLocation>(&dst.shards[0].location)) {
       return storage::hbm_copy(src_dev->region_id, src_dev->offset, dst_dev->region_id,
                                dst_dev->offset, src.length);
+    }
+  }
+  {
+    // Cross-process device pools: ride the fabric (drain is the preemption
+    // path — moving device bytes without the host lane is the whole point).
+    CopyPlacement src_copy;
+    src_copy.shards.push_back(src);
+    if (fabric_copy_object(*data_client_, src_copy, dst, src.length, pools)) {
+      counters_.fabric_moves.fetch_add(1);
+      return ErrorCode::OK;
     }
   }
   constexpr uint64_t kChunk = 16ull << 20;
@@ -2291,8 +2371,10 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
 
     const CopyPlacement* streamed_src = nullptr;
+    const alloc::PoolMap fabric_pools = memory_pools();
     for (const auto& src : p.surviving) {
-      if (copy_object_bytes(*data_client_, src, staged, p.size) == ErrorCode::OK) {
+      if (copy_object_bytes(*data_client_, src, staged, p.size, &fabric_pools,
+                            &counters_.fabric_moves) == ErrorCode::OK) {
         streamed_src = &src;
         break;
       }
@@ -2873,8 +2955,10 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
       return DemoteOutcome::kSkipped;
     }
   } else {
+    const alloc::PoolMap fabric_pools = memory_pools();
     for (const auto& src : old_copies) {
-      if (copy_object_bytes(*data_client_, src, placed.value(), size) == ErrorCode::OK) {
+      if (copy_object_bytes(*data_client_, src, placed.value(), size, &fabric_pools,
+                            &counters_.fabric_moves) == ErrorCode::OK) {
         moved = true;
         moved_src = &src;
         break;

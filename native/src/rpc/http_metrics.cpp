@@ -53,6 +53,9 @@ std::string MetricsHttpServer::render_metrics() const {
           c.slots_granted.load());
   counter("btpu_put_slot_commits_total", "puts committed through a pooled slot (1-RTT path)",
           c.slot_commits.load());
+  counter("btpu_fabric_moves_total",
+          "cross-process device moves over the device fabric (vs host lane)",
+          c.fabric_moves.load());
   counter("btpu_gets_total", "get_workers calls", c.gets.load());
   counter("btpu_removes_total", "remove_object calls", c.removes.load());
   counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());

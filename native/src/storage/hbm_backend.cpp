@@ -110,6 +110,13 @@ const BtpuHbmProviderV3 kEmulatedProvider = {
 std::mutex g_provider_mutex;
 BtpuHbmProviderV3 g_provider = kEmulatedProvider;
 bool g_provider_emulated = true;
+// v4 fabric entries; all-null for v3 registrations and the emulation.
+struct FabricEntries {
+  int (*address)(void*, char*, uint64_t){nullptr};
+  int (*offer)(void*, uint64_t, uint64_t, uint64_t, uint64_t){nullptr};
+  int (*pull)(void*, const char*, uint64_t, uint64_t, uint64_t, uint64_t){nullptr};
+};
+FabricEntries g_fabric;
 
 }  // namespace
 
@@ -219,6 +226,17 @@ class HbmBackend : public OffsetBackendBase {
                : ErrorCode::MEMORY_ACCESS_ERROR;
   }
 
+  std::string fabric_address() const override { return hbm_fabric_address(); }
+  ErrorCode fabric_offer(uint64_t offset, uint64_t len, uint64_t transfer_id) override {
+    if (!active_) return ErrorCode::INVALID_STATE;
+    return hbm_fabric_offer(region_id_, offset, len, transfer_id);
+  }
+  ErrorCode fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
+                        uint64_t offset, uint64_t len) override {
+    if (!active_) return ErrorCode::INVALID_STATE;
+    return hbm_fabric_pull(remote_addr, transfer_id, region_id_, offset, len);
+  }
+
  private:
   uint64_t region_id_{0};
   bool active_{false};
@@ -228,15 +246,75 @@ std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config) {
   return std::make_unique<HbmBackend>(config);
 }
 
+std::string hbm_fabric_address() {
+  FabricEntries fabric;
+  void* ctx;
+  {
+    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    fabric = g_fabric;
+    ctx = g_provider.ctx;
+  }
+  if (!fabric.address) return {};
+  char buf[256] = {};
+  if (fabric.address(ctx, buf, sizeof(buf)) != 0) return {};
+  buf[sizeof(buf) - 1] = '\0';
+  return buf;
+}
+
+ErrorCode hbm_fabric_offer(uint64_t region_id, uint64_t offset, uint64_t len,
+                           uint64_t transfer_id) {
+  FabricEntries fabric;
+  void* ctx;
+  {
+    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    fabric = g_fabric;
+    ctx = g_provider.ctx;
+  }
+  if (!fabric.offer) return ErrorCode::NOT_IMPLEMENTED;
+  return fabric.offer(ctx, region_id, offset, len, transfer_id) == 0
+             ? ErrorCode::OK
+             : ErrorCode::MEMORY_ACCESS_ERROR;
+}
+
+ErrorCode hbm_fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
+                          uint64_t region_id, uint64_t offset, uint64_t len) {
+  FabricEntries fabric;
+  void* ctx;
+  {
+    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    fabric = g_fabric;
+    ctx = g_provider.ctx;
+  }
+  if (!fabric.pull) return ErrorCode::NOT_IMPLEMENTED;
+  return fabric.pull(ctx, remote_addr.c_str(), transfer_id, region_id, offset, len) == 0
+             ? ErrorCode::OK
+             : ErrorCode::MEMORY_ACCESS_ERROR;
+}
+
 }  // namespace btpu::storage
 
 extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider) {
   std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::storage::g_fabric = {};  // v3 has no fabric entries
   if (provider) {
     btpu::storage::g_provider = *provider;
     btpu::storage::g_provider_emulated = false;
   } else {
     btpu::storage::g_provider = btpu::storage::kEmulatedProvider;
+    btpu::storage::g_provider_emulated = true;
+  }
+}
+
+extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider) {
+  std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  if (provider) {
+    btpu::storage::g_provider = provider->base;
+    btpu::storage::g_fabric = {provider->fabric_address, provider->fabric_offer,
+                               provider->fabric_pull};
+    btpu::storage::g_provider_emulated = false;
+  } else {
+    btpu::storage::g_provider = btpu::storage::kEmulatedProvider;
+    btpu::storage::g_fabric = {};
     btpu::storage::g_provider_emulated = true;
   }
 }

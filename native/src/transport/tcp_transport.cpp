@@ -21,7 +21,9 @@
 #include <fcntl.h>
 #include <poll.h>
 #include <sys/mman.h>
+#include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include "btpu/common/crc32c.h"
@@ -50,6 +52,14 @@ constexpr uint8_t kOpWrite = 2;
 constexpr uint8_t kOpHello = 3;
 constexpr uint8_t kOpReadStaged = 4;
 constexpr uint8_t kOpWriteStaged = 5;
+// Device-fabric commands for callback-backed device regions (hbm_provider
+// v4): kOpFabricOffer stages [addr, addr+len) of the region for ONE
+// cross-process pull under a trailing u64 transfer id; kOpFabricPull (u64
+// id + u16 addr_len + remote fabric address) fetches an offered range from
+// another process's fabric server straight into this region — the payload
+// bytes ride the device fabric, never this socket.
+constexpr uint8_t kOpFabricOffer = 6;
+constexpr uint8_t kOpFabricPull = 7;
 
 #pragma pack(push, 1)
 struct DataRequestHeader {
@@ -67,6 +77,8 @@ struct Region {
   uint64_t remote_base{0};
   RegionReadFn read_fn;
   RegionWriteFn write_fn;
+  RegionOfferFn offer_fn;  // device-fabric hooks (attach_fabric); may be null
+  RegionPullFn pull_fn;
 };
 
 class TcpTransportServer : public TransportServer {
@@ -152,6 +164,22 @@ class TcpTransportServer : public TransportServer {
     }
     std::lock_guard<std::mutex> lock(regions_mutex_);
     return regions_.erase(rkey) ? ErrorCode::OK : ErrorCode::MEMORY_POOL_NOT_FOUND;
+  }
+
+  ErrorCode attach_fabric(const RemoteDescriptor& desc, RegionOfferFn offer_fn,
+                          RegionPullFn pull_fn) override {
+    uint64_t rkey = 0;
+    try {
+      rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+    } catch (...) {
+      return ErrorCode::INVALID_PARAMETERS;
+    }
+    std::lock_guard<std::mutex> lock(regions_mutex_);
+    auto it = regions_.find(rkey);
+    if (it == regions_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
+    it->second.offer_fn = std::move(offer_fn);
+    it->second.pull_fn = std::move(pull_fn);
+    return ErrorCode::OK;
   }
 
  private:
@@ -254,6 +282,34 @@ class TcpTransportServer : public TransportServer {
             // segment (HBM provider: device -> shm, no scratch).
             status = static_cast<uint32_t>(virt.read_fn(offset, stg_base + shm_off, hdr.len));
           }
+        }
+        if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+        continue;
+      }
+      if (hdr.op == kOpFabricOffer || hdr.op == kOpFabricPull) {
+        uint64_t transfer_id = 0;
+        if (net::read_exact(fd, &transfer_id, sizeof(transfer_id)) != ErrorCode::OK) break;
+        std::string fabric_addr;
+        if (hdr.op == kOpFabricPull) {
+          uint16_t alen = 0;
+          if (net::read_exact(fd, &alen, sizeof(alen)) != ErrorCode::OK) break;
+          if (alen == 0 || alen > 255) break;  // protocol violation
+          fabric_addr.resize(alen);
+          if (net::read_exact(fd, fabric_addr.data(), alen) != ErrorCode::OK) break;
+        }
+        uint8_t* target = nullptr;
+        Region virt;
+        uint64_t offset = 0;
+        uint32_t status = static_cast<uint32_t>(ErrorCode::NOT_IMPLEMENTED);
+        if (!resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset) || target) {
+          status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+        } else if (hdr.op == kOpFabricOffer && virt.offer_fn) {
+          status = static_cast<uint32_t>(virt.offer_fn(offset, hdr.len, transfer_id));
+        } else if (hdr.op == kOpFabricPull && virt.pull_fn) {
+          // Blocks this connection thread until the bytes are in device
+          // memory — the caller's status read doubles as the completion.
+          status = static_cast<uint32_t>(virt.pull_fn(fabric_addr, transfer_id, offset,
+                                                      hdr.len));
         }
         if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
         continue;
@@ -724,6 +780,57 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     }
   }
   return first;
+}
+
+namespace {
+// Shared shape of the two fabric commands: header + trailer, one status.
+ErrorCode tcp_fabric_command(const std::string& endpoint, uint8_t opcode, uint64_t addr,
+                             uint64_t rkey, uint64_t len, const void* trailer,
+                             size_t trailer_len) {
+  auto& pool = TcpEndpointPool::instance();
+  auto acquired = pool.acquire(endpoint);
+  if (!acquired.ok()) return acquired.error();
+  PooledConn c = std::move(acquired).value();
+  DataRequestHeader hdr{opcode, addr, rkey, len};
+  uint32_t status = 0;
+  // Deadline on the status read: a wedged provider on the far side must not
+  // hang the caller's drain/repair thread forever — time out, drop the
+  // connection (stream state unknown), and let the caller fall back to the
+  // host lane. Generous bound: the pull moves up to a 32 MiB segment.
+  constexpr int kFabricTimeoutMs = 60'000;
+  struct timeval tv{kFabricTimeoutMs / 1000, 0};
+  ::setsockopt(c.sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  const bool ok =
+      net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), trailer, trailer_len) ==
+          ErrorCode::OK &&
+      net::read_exact(c.sock.fd(), &status, sizeof(status)) == ErrorCode::OK;
+  if (!ok) return ErrorCode::NETWORK_ERROR;  // dead/timed-out conn: not repooled
+  struct timeval off{0, 0};
+  ::setsockopt(c.sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+  pool.release(endpoint, std::move(c));
+  return static_cast<ErrorCode>(status);
+}
+}  // namespace
+
+ErrorCode tcp_fabric_offer(const std::string& endpoint, uint64_t addr, uint64_t rkey,
+                           uint64_t len, uint64_t transfer_id) {
+  return tcp_fabric_command(endpoint, kOpFabricOffer, addr, rkey, len, &transfer_id,
+                            sizeof(transfer_id));
+}
+
+ErrorCode tcp_fabric_pull(const std::string& endpoint, uint64_t addr, uint64_t rkey,
+                          uint64_t len, uint64_t transfer_id,
+                          const std::string& src_fabric_addr) {
+  if (src_fabric_addr.empty() || src_fabric_addr.size() > 255)
+    return ErrorCode::INVALID_PARAMETERS;
+  std::vector<uint8_t> trailer(sizeof(uint64_t) + sizeof(uint16_t) + src_fabric_addr.size());
+  std::memcpy(trailer.data(), &transfer_id, sizeof(transfer_id));
+  const uint16_t alen = static_cast<uint16_t>(src_fabric_addr.size());
+  std::memcpy(trailer.data() + sizeof(uint64_t), &alen, sizeof(alen));
+  std::memcpy(trailer.data() + sizeof(uint64_t) + sizeof(uint16_t), src_fabric_addr.data(),
+              src_fabric_addr.size());
+  return tcp_fabric_command(endpoint, kOpFabricPull, addr, rkey, len, trailer.data(),
+                            trailer.size());
 }
 
 ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,

@@ -23,6 +23,11 @@ ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, vo
                    uint64_t len);
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
                     uint64_t len);
+ErrorCode tcp_fabric_offer(const std::string& endpoint, uint64_t addr, uint64_t rkey,
+                           uint64_t len, uint64_t transfer_id);
+ErrorCode tcp_fabric_pull(const std::string& endpoint, uint64_t addr, uint64_t rkey,
+                          uint64_t len, uint64_t transfer_id,
+                          const std::string& src_fabric_addr);
 ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write,
                     size_t max_concurrency);  // pipelined, tcp_transport.cpp
 
@@ -102,6 +107,18 @@ class MuxTransportClient : public TransportClient {
   }
   ErrorCode write_batch(WireOp* ops, size_t n, size_t max_concurrency) override {
     return batch(ops, n, true, max_concurrency);
+  }
+
+  ErrorCode fabric_offer(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                         uint64_t len, uint64_t transfer_id) override {
+    if (remote.transport != TransportKind::TCP) return ErrorCode::NOT_IMPLEMENTED;
+    return tcp_fabric_offer(remote.endpoint, addr, rkey, len, transfer_id);
+  }
+  ErrorCode fabric_pull(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                        uint64_t len, uint64_t transfer_id,
+                        const std::string& src_fabric_addr) override {
+    if (remote.transport != TransportKind::TCP) return ErrorCode::NOT_IMPLEMENTED;
+    return tcp_fabric_pull(remote.endpoint, addr, rkey, len, transfer_id, src_fabric_addr);
   }
 
  private:

@@ -191,15 +191,36 @@ ErrorCode WorkerService::initialize() {
       auto write_fn = [backend](uint64_t off, const void* src, uint64_t len) {
         return backend->write_at(off, src, len);
       };
-      registered = primary_transport_->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
-                                                               read_fn, write_fn);
+      transport::TransportServer* host = primary_transport_.get();
+      registered = host->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
+                                                 read_fn, write_fn);
       if (!registered.ok() && registered.error() == ErrorCode::NOT_IMPLEMENTED) {
         if (!virtual_transport_) {
           virtual_transport_ = transport::make_transport_server(TransportKind::TCP);
           BTPU_RETURN_IF_ERROR(virtual_transport_->start(config_.listen_host, 0));
         }
-        registered = virtual_transport_->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
-                                                                 read_fn, write_fn);
+        host = virtual_transport_.get();
+        registered = host->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
+                                                   read_fn, write_fn);
+      }
+      // Device fabric (hbm_provider v4): advertise the provider's fabric
+      // endpoint and serve offer/pull commands for this region, so
+      // keystone-driven cross-process moves ride the device fabric instead
+      // of the staged host lane.
+      if (registered.ok()) {
+        const std::string fabric = backend->fabric_address();
+        if (!fabric.empty() &&
+            host->attach_fabric(
+                registered.value(),
+                [backend](uint64_t off, uint64_t len, uint64_t id) {
+                  return backend->fabric_offer(off, len, id);
+                },
+                [backend](const std::string& addr, uint64_t id, uint64_t off, uint64_t len) {
+                  return backend->fabric_pull(addr, id, off, len);
+                }) == ErrorCode::OK) {
+          runtime.record.fabric_addr = fabric;
+          LOG_INFO << "pool " << pool_cfg.id << " fabric endpoint " << fabric;
+        }
       }
     }
     if (!registered.ok()) {

@@ -950,6 +950,41 @@ def test_drain_evacuates_device_tier_across_processes(tmp_path):
                  what="drained worker retired")
 
 
+@pytest.mark.parametrize("worker_env", [{}, {"BTPU_HBM_HOST_VIEW": "0"}],
+                         ids=["host-view", "device-path"])
+def test_cross_process_device_moves_ride_the_fabric(tmp_path, worker_env):
+    """VERDICT r3 item 8: when both ends of a keystone-driven move are
+    device pools in DIFFERENT worker processes, the bytes ride the device
+    fabric (jax.experimental.transfer — the chip fabric on TPU) instead of
+    the staged host lane. Drain is the preemption-shaped trigger; the
+    btpu_fabric_moves_total metric proves the path taken, in both region
+    modes (host-view and the jit path a real TPU uses)."""
+    from blackbird_tpu import StorageClass
+    from blackbird_tpu.procluster import ProcessCluster
+
+    with ProcessCluster(workers=2, devices_per_worker=1, pool_mb=8,
+                        workdir=str(tmp_path), worker_env=worker_env) as pc:
+        client = pc.wait_ready(timeout=300)
+        payload = bytes(bytearray(range(241)) * 3000)  # ~700 KiB, odd size
+        client.put("fab/obj", payload, replicas=1, max_workers=1,
+                   preferred_class=StorageClass.HBM_TPU)
+        src = {s["worker"] for c in client.placements("fab/obj") for s in c["shards"]}
+        assert len(src) == 1
+        victim = src.pop()
+
+        moved = client.drain_worker(victim)
+        assert moved >= 1
+        survivor = "mc-1" if victim == "mc-0" else "mc-0"
+        after = [s for c in client.placements("fab/obj") for s in c["shards"]]
+        assert all(s["worker"] == survivor for s in after), after
+        assert client.get("fab/obj") == payload
+        fabric_moves = 0
+        for line in pc.metrics().splitlines():
+            if line.startswith("btpu_fabric_moves_total"):
+                fabric_moves = int(line.split()[-1])
+        assert fabric_moves >= 1, "drain moved device bytes over the host lane"
+
+
 def test_erasure_coding_over_cross_process_device_tier(tmp_path):
     """Coded objects on DEVICE memory across worker processes: in-process
     device pools are wire-unreachable (coded shards need a client data

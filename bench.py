@@ -229,7 +229,8 @@ def main() -> int:
         raw_rows, raw_get_gbps = None, None
     # p99 needs samples: at 300 iters it is the 3rd-worst draw and scheduler
     # noise dominates; 1500 iters costs ~0.1s and stabilizes it.
-    small_runs = [run_bench(binary, size=64 << 10, iterations=1500, transport="tcp")
+    small_runs = [run_bench(binary, size=64 << 10, iterations=1500, transport="tcp",
+                            extra_args=("--repeat-rows",))
                   for _ in range(3)]
     small_rows = min(small_runs, key=lambda rows: rows["get"]["p99_us"])
     shm_rows = run_bench(binary, size=1 << 20, iterations=150, transport="shm")
@@ -296,6 +297,18 @@ def main() -> int:
                 file=sys.stderr,
             )
 
+    # Repeat-read row (VERDICT r3 item 7): one key read repeatedly over a
+    # real RPC keystone — uncached pays the metadata round trip per get,
+    # cached reuses the placement (opt-in placement_cache_ms).
+    if "get_repeat" in small_rows and "get_cached" in small_rows:
+        ur, cr = small_rows["get_repeat"], small_rows["get_cached"]
+        print(
+            f"tcp repeat-read 64KiB (remote rpc): uncached p50 {ur['p50_us']:.1f}us "
+            f"p99 {ur['p99_us']:.1f}us | placement-cached p50 {cr['p50_us']:.1f}us "
+            f"p99 {cr['p99_us']:.1f}us",
+            file=sys.stderr,
+        )
+
     get_gbps = main_rows["get"]["gbps"]
     print(
         f"tcp (headline, verified reads): put 1MiB {main_rows['put']['gbps']:.2f} GB/s "
@@ -352,6 +365,9 @@ def main() -> int:
     }
     if raw_get_gbps is not None:
         summary["raw_get_gbps_no_verify"] = round(raw_get_gbps, 3)
+    if "get_repeat" in small_rows and "get_cached" in small_rows:
+        summary["repeat_get_64kib_p50_us"] = round(small_rows["get_repeat"]["p50_us"], 1)
+        summary["cached_get_64kib_p50_us"] = round(small_rows["get_cached"]["p50_us"], 1)
     print(json.dumps(summary))
     return 0
 

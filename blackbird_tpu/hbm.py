@@ -930,7 +930,11 @@ class JaxHbmProvider:
         """Discards offers whose pull never came (orchestrator fell back):
         the transfer server pins each offered device array until SOMETHING
         pulls it, and the API has no cancel — so stale offers are drained by
-        a self-pull. Runs opportunistically before each new offer."""
+        a self-pull. The source never learns of a successful remote pull, so
+        consumed ids are self-pulled once too — measured to complete quickly
+        (the server answers; no hang), so the only cost is a wasted local
+        round trip per entry, once. Runs opportunistically before each new
+        offer."""
         import time
 
         now = time.monotonic()

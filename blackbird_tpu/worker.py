@@ -71,6 +71,8 @@ def write_worker_yaml(path, *, worker_id: str, cluster_id: str,
         # `is not None`, not truthiness: device 0 is a real device.
         if pool.get("device_id") is not None:
             lines.append(f"    device_id: {q(pool['device_id'])}")
+        if pool.get("path") is not None:  # file-backed tiers (mmap/io_uring)
+            lines.append(f"    path: {q(pool['path'])}")
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
 

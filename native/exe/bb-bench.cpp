@@ -60,7 +60,7 @@ int main(int argc, char** argv) {
   WorkerConfig wc;
   wc.replication_factor = 1;
   wc.max_workers_per_copy = 4;
-  bool json = false, sweep = false, no_verify = false;
+  bool json = false, sweep = false, no_verify = false, repeat_rows = false;
   int batch = 0;  // >0: measure put_many/get_many over `batch` objects per op
 
   for (int i = 1; i < argc; ++i) {
@@ -77,6 +77,7 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--transport") && i + 1 < argc) transport = argv[++i];
     else if (!std::strcmp(argv[i], "--json")) json = true;
     else if (!std::strcmp(argv[i], "--no-verify")) no_verify = true;
+    else if (!std::strcmp(argv[i], "--repeat-rows")) repeat_rows = true;
     else if (!std::strcmp(argv[i], "--sweep")) sweep = true;
     else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
@@ -242,13 +243,14 @@ int main(int argc, char** argv) {
     put_stats.summarize("put", sz, json);
     get_stats.summarize("get", sz, json);
 
-    // Repeat-read rows: ONE key read over and over — the serving-cache
-    // shape. "get_repeat" pays the metadata RPC per read; "get_cached"
-    // opts into the placement cache (ClientOptions::placement_cache_ms)
-    // and skips it on every hit. Both run against a REAL RPC keystone —
-    // in --embedded mode one is spun up here — because the cache exists
-    // to elide a network round trip.
-    {
+    // Repeat-read rows (--repeat-rows): ONE key read over and over — the
+    // serving-cache shape. "get_repeat" pays the metadata RPC per read;
+    // "get_cached" opts into the placement cache
+    // (ClientOptions::placement_cache_ms) and skips it on every hit. Both
+    // run against a REAL RPC keystone — in --embedded mode one is spun up
+    // here — because the cache exists to elide a network round trip.
+    // Flag-gated: the rows double a run's data-plane work.
+    if (repeat_rows) {
       client::ClientOptions copts;
       std::unique_ptr<rpc::KeystoneRpcServer> repeat_rpc;
       if (cluster) {

@@ -112,6 +112,17 @@ class IAllocator {
   virtual ErrorCode adopt_allocation(const ObjectKey& key,
                                      const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
                                      const PoolMap& pools) = 0;
+  // Re-carves `ranges` in `pool`'s free map WITHOUT touching key-level
+  // bookkeeping (which survived the pool's absence): the re-adoption path
+  // when a persistent-tier pool returns after a worker restart — its
+  // allocator state was dropped by forget_pool but the offline objects kept
+  // their allocation entries.
+  virtual ErrorCode readopt_pool_ranges(const MemoryPool& pool,
+                                        const std::vector<Range>& ranges) {
+    (void)pool;
+    (void)ranges;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
   // Transfers an allocation's bookkeeping to a new key; ranges are untouched.
   // Used by tier demotion, which stages the replacement placement under a
   // temporary key while bytes move outside the metadata lock, then renames.

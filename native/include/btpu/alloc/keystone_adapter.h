@@ -37,6 +37,9 @@ class KeystoneAllocatorAdapter {
 
   void forget_pool(const MemoryPoolId& pool_id) { allocator_->forget_pool(pool_id); }
 
+  ErrorCode readopt_pool_ranges(const MemoryPool& pool, const std::vector<Range>& ranges) {
+    return allocator_->readopt_pool_ranges(pool, ranges);
+  }
   ErrorCode adopt_allocation(const ObjectKey& key,
                              const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
                              const PoolMap& pools) {

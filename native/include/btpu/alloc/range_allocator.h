@@ -35,6 +35,8 @@ class RangeAllocator : public IAllocator {
                                     const PoolMap& pools) override;
   // Restart replay: re-marks persisted ranges as allocated under `key`
   // (all-or-nothing; rolls back on any conflict or missing pool).
+  ErrorCode readopt_pool_ranges(const MemoryPool& pool,
+                                const std::vector<Range>& ranges) override;
   ErrorCode adopt_allocation(const ObjectKey& key,
                              const std::vector<std::pair<MemoryPoolId, Range>>& ranges,
                              const PoolMap& pools);

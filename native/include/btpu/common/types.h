@@ -58,6 +58,13 @@ enum class StorageClass : uint32_t {
 };
 
 std::string_view storage_class_name(StorageClass c) noexcept;
+
+// Tiers whose bytes survive the owning process (file-backed: mmap and
+// io_uring disk backends). Memory tiers — DRAM, HBM, CXL without a backing
+// path — die with the worker.
+inline bool storage_class_is_persistent(StorageClass c) noexcept {
+  return c == StorageClass::NVME || c == StorageClass::SSD || c == StorageClass::HDD;
+}
 std::optional<StorageClass> storage_class_from_name(std::string_view name) noexcept;
 
 // Tier height for the eviction/demotion ladder: lower rank = faster tier.

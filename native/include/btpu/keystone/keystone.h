@@ -86,6 +86,12 @@ struct KeystoneCounters {
   std::atomic<uint64_t> slot_commits{0};
   // Cross-process device moves that rode the fabric instead of the host lane.
   std::atomic<uint64_t> fabric_moves{0};
+  // Objects spared from the loss path because their bytes sit on a dead
+  // worker's PERSISTENT pools (mmap/io_uring backing files survive the
+  // process), and objects whose placements were re-validated and refreshed
+  // when such a pool re-registered.
+  std::atomic<uint64_t> objects_offline{0};
+  std::atomic<uint64_t> objects_adopted{0};
   std::atomic<uint64_t> gets{0};
   std::atomic<uint64_t> removes{0};
   std::atomic<uint64_t> gc_collected{0};
@@ -272,6 +278,14 @@ class KeystoneService {
   // `pools`: caller-hoisted pool snapshot (drain calls this per shard).
   ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
                          const alloc::PoolMap& pools);
+  // A persistent-tier pool re-registered after its worker restarted:
+  // re-carve the spared objects' ranges, rewrite their placements onto the
+  // new base/rkey, and re-validate stamped shards by CRC. Runs BEFORE the
+  // pool becomes allocatable so fresh allocations cannot race the carve.
+  void readopt_offline_pool(const MemoryPool& pool);
+  // Health-loop leg: CRC-revalidates re-adopted stamped shards (queued by
+  // readopt_offline_pool — the watch thread must not stream pool bytes).
+  void run_readopt_checks();
   // Reconstructs the dead shards of one erasure-coded copy from any k
   // survivors (segmented) onto fresh placements and splices them in.
   bool repair_ec_object(const ObjectKey& key, uint64_t epoch, const CopyPlacement& copy,
@@ -359,6 +373,19 @@ class KeystoneService {
   std::atomic<uint64_t> slot_seq_{0};  // unique suffix for pooled slot keys
   std::mutex drain_mutex_;               // serializes drain_worker per service
   std::string service_id_;
+  // Persistent-tier pools of dead workers, as last advertised (old base +
+  // rkey), awaiting re-adoption when the restarted worker re-registers them
+  // (guarded by registry_mutex_). Consumed by readopt_offline_pool.
+  std::unordered_map<MemoryPoolId, MemoryPool> offline_pools_;
+  // Re-adopted stamped shards pending CRC revalidation (run_readopt_checks).
+  struct ReadoptCheck {
+    ObjectKey key;
+    uint64_t epoch;
+    ShardPlacement shard;
+    uint32_t expect;
+  };
+  std::mutex readopt_checks_mutex_;
+  std::vector<ReadoptCheck> readopt_checks_;
 };
 
 }  // namespace btpu::keystone

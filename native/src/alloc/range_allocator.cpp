@@ -672,6 +672,23 @@ void RangeAllocator::forget_pool(const MemoryPoolId& pool_id) {
   pool_allocators_.erase(pool_id);
 }
 
+ErrorCode RangeAllocator::readopt_pool_ranges(const MemoryPool& pool,
+                                              const std::vector<Range>& ranges) {
+  BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
+  std::shared_lock lock(pools_mutex_);
+  auto it = pool_allocators_.find(pool.id);
+  if (it == pool_allocators_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
+  std::vector<Range> carved;
+  for (const Range& range : ranges) {
+    if (!it->second->allocate_at(range)) {
+      for (const Range& c : carved) it->second->free(c);
+      return ErrorCode::ALLOCATION_FAILED;
+    }
+    carved.push_back(range);
+  }
+  return ErrorCode::OK;
+}
+
 std::unique_ptr<IAllocator> AllocatorFactory::create(Strategy strategy) {
   switch (strategy) {
     case Strategy::RANGE_BASED:

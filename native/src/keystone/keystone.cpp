@@ -1292,6 +1292,7 @@ size_t KeystoneService::run_scrub_once() {
 void KeystoneService::run_health_check_once() {
   if (!is_leader_.load()) return;  // the leader owns eviction/demotion/repair
   retry_dirty_persists();
+  run_readopt_checks();
   cleanup_stale_workers();
   if (config_.enable_repair) {
     // Finish repair passes that a coordinator outage or deposition cut
@@ -1706,8 +1707,171 @@ ErrorCode KeystoneService::register_worker(const WorkerInfo& worker) {
   return ErrorCode::OK;
 }
 
+// The dead worker's backing files came back: spared objects' placements
+// still name the pool with the OLD base address and rkey. Re-carve their
+// ranges into the fresh pool allocator, rewrite placements onto the new
+// advertisement, and re-validate stamped shards by CRC — a stale or
+// replaced backing file must surface as loss, not as silent wrong bytes.
+void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
+  if (!is_leader_.load()) return;  // keep the entry: a promoted leader adopts
+  MemoryPool old;
+  {
+    std::unique_lock lock(registry_mutex_);
+    auto it = offline_pools_.find(pool.id);
+    if (it == offline_pools_.end()) return;
+    old = it->second;
+    offline_pools_.erase(it);
+  }
+  const uint64_t old_base = old.remote.remote_base;
+  const uint64_t new_base = pool.remote.remote_base;
+  uint64_t new_rkey = 0;
+  try {
+    new_rkey = std::stoull(pool.remote.rkey_hex, nullptr, 16);
+  } catch (...) {
+    LOG_ERROR << "re-adoption of pool " << pool.id << ": unparseable rkey";
+    return;
+  }
+
+  // Pass 1 (unique objects lock; metadata only, no network): per object,
+  // CARVE FIRST, rewrite placements only if the carve landed — an object
+  // whose ranges cannot be re-reserved must never be published onto the new
+  // base, or a fresh allocation could overwrite its served bytes.
+  size_t adopted = 0;
+  std::vector<ReadoptCheck> checks;
+  {
+    std::unique_lock lock(objects_mutex_);
+    for (auto it = objects_.begin(); it != objects_.end();) {
+      auto& [key, info] = *it;
+      struct Hit {
+        CopyPlacement* copy;
+        size_t index;
+        uint64_t offset;
+      };
+      std::vector<Hit> hits;
+      std::vector<alloc::Range> ranges;
+      bool skip_object = false;
+      for (auto& copy : info.copies) {
+        for (size_t i = 0; i < copy.shards.size(); ++i) {
+          ShardPlacement& shard = copy.shards[i];
+          if (shard.pool_id != pool.id) continue;
+          auto* mem = std::get_if<MemoryLocation>(&shard.location);
+          if (!mem || mem->remote_addr < old_base ||
+              mem->remote_addr - old_base + shard.length > pool.size) {
+            skip_object = true;  // unmappable (shrunk/alien pool): stay offline
+            break;
+          }
+          hits.push_back({&copy, i, mem->remote_addr - old_base});
+          ranges.push_back({mem->remote_addr - old_base, shard.length});
+        }
+        if (skip_object) break;
+      }
+      if (hits.empty() || skip_object) {
+        ++it;
+        continue;
+      }
+      if (adapter_.readopt_pool_ranges(pool, ranges) != ErrorCode::OK) {
+        // Cannot re-reserve (overlapping stale metadata): the object must
+        // not serve from unreserved ranges — drop it, fence-first.
+        LOG_ERROR << "re-adoption carve failed for " << key << " on pool " << pool.id
+                  << "; dropping the object";
+        if (unpersist_object(key) == ErrorCode::OK) {
+          free_object_locked(key, info);
+          it = objects_.erase(it);
+          ++counters_.objects_lost;
+        } else {
+          ++it;  // stays offline (old placements); a later pass may retry
+        }
+        continue;
+      }
+      for (const Hit& hit : hits) {
+        ShardPlacement& shard = hit.copy->shards[hit.index];
+        auto& mem = std::get<MemoryLocation>(shard.location);
+        mem.remote_addr = new_base + hit.offset;
+        mem.rkey = new_rkey;
+        shard.remote = pool.remote;
+        shard.worker_id = pool.node_id;
+      }
+      info.epoch = next_epoch_.fetch_add(1);
+      for (const Hit& hit : hits) {
+        if (hit.copy->shard_crcs.size() == hit.copy->shards.size()) {
+          checks.push_back({key, info.epoch, hit.copy->shards[hit.index],
+                            hit.copy->shard_crcs[hit.index]});
+        }
+      }
+      if (persist_object(key, info) != ErrorCode::OK) mark_persist_dirty(key);
+      ++adopted;
+      ++counters_.objects_adopted;
+      ++it;
+    }
+  }
+  if (adopted) {
+    bump_view();
+    LOG_INFO << "pool " << pool.id << " re-adopted: " << adopted
+             << " offline objects refreshed onto the restarted worker";
+  }
+  if (!checks.empty()) {
+    // Revalidation reads real bytes over the network — queued for the
+    // health loop instead of running inline here: register_memory_pool is
+    // reached from the coordinator watch thread, which must not stall on
+    // streaming a multi-GB pool. Until the checks run, reads are guarded by
+    // the client-side verify default (stale bytes fail their CRC).
+    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
+    readopt_checks_.insert(readopt_checks_.end(),
+                           std::make_move_iterator(checks.begin()),
+                           std::make_move_iterator(checks.end()));
+  }
+}
+
+// Health-loop leg of re-adoption: verify stamped re-adopted shards through
+// the NEW endpoint. The backing file may be stale or replaced — a CRC miss
+// demotes the object to the loss path it was spared from (epoch-guarded
+// against racers); a failed durable delete re-queues the check.
+void KeystoneService::run_readopt_checks() {
+  std::vector<ReadoptCheck> checks;
+  {
+    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
+    checks.swap(readopt_checks_);
+  }
+  if (checks.empty()) return;
+  constexpr uint64_t kSeg = 4ull << 20;
+  std::vector<uint8_t> buf;
+  for (const auto& check : checks) {
+    uint32_t crc = 0;
+    bool io_ok = true;
+    for (uint64_t off = 0; off < check.shard.length && io_ok; off += kSeg) {
+      const uint64_t n = std::min(kSeg, check.shard.length - off);
+      buf.resize(n);
+      io_ok = transport::shard_io(*data_client_, check.shard, off, buf.data(), n,
+                                  /*is_write=*/false) == ErrorCode::OK;
+      if (io_ok) crc = crc32c(buf.data(), n, crc);
+    }
+    if (io_ok && crc == check.expect) continue;
+    LOG_WARN << "re-adopted shard of " << check.key << " failed revalidation ("
+             << (io_ok ? "crc mismatch: stale/replaced backing file" : "unreadable")
+             << "); dropping the object";
+    std::unique_lock lock(objects_mutex_);
+    auto it = objects_.find(check.key);
+    if (it == objects_.end() || it->second.epoch != check.epoch) continue;
+    if (unpersist_object(check.key) != ErrorCode::OK) {
+      // Fence-first failed (outage): the corrupt object must not quietly
+      // keep serving — re-queue so the next health tick retries the drop.
+      lock.unlock();
+      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      readopt_checks_.push_back(check);
+      continue;
+    }
+    free_object_locked(check.key, it->second);
+    objects_.erase(it);
+    ++counters_.objects_lost;
+    bump_view();
+  }
+}
+
 ErrorCode KeystoneService::register_memory_pool(const MemoryPool& pool) {
   if (pool.id.empty() || pool.size == 0) return ErrorCode::INVALID_MEMORY_POOL;
+  // Adoption runs BEFORE the pool becomes allocatable, so fresh allocations
+  // cannot carve over the spared objects' re-adopted ranges.
+  readopt_offline_pool(pool);
   std::unique_lock lock(registry_mutex_);
   const bool fresh = !pools_.contains(pool.id);
   pools_[pool.id] = pool;
@@ -2100,6 +2264,13 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
     for (auto it = pools_.begin(); it != pools_.end();) {
       if (it->second.node_id == worker_id) {
         dead_pools.push_back(it->first);
+        // Persistent tiers (mmap/io_uring backing files) keep their bytes
+        // across the process: remember the pool's last advertisement so a
+        // restarted worker's re-registration can re-adopt instead of
+        // re-replicating (readopt_offline_pool).
+        if (storage_class_is_persistent(it->second.storage_class)) {
+          offline_pools_[it->first] = it->second;
+        }
         it = pools_.erase(it);
       } else {
         ++it;
@@ -2215,6 +2386,26 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           }
         };
         if (dead > copy.ec_parity_shards) {
+          // Same persistent-tier exception as the replicated loss branch.
+          bool adoptable = true;
+          {
+            std::shared_lock rlock(registry_mutex_);
+            for (const auto& shard : copy.shards) {
+              if (live_workers.contains(shard.worker_id)) continue;
+              if (!offline_pools_.contains(shard.pool_id)) {
+                adoptable = false;
+                break;
+              }
+            }
+          }
+          if (adoptable) {
+            ++counters_.objects_offline;
+            LOG_WARN << "coded object " << key << " OFFLINE past tolerance with worker "
+                     << worker_id << ": bytes persist on file-backed pools — kept for "
+                        "re-adoption at restart";
+            ++it;
+            continue;
+          }
           LOG_WARN << "coded object " << key << " lost " << dead << " shards (tolerance "
                    << copy.ec_parity_shards << ") with worker " << worker_id;
           // Fence-first: a deposed leader must not free the survivors'
@@ -2272,6 +2463,40 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       }
       const ObjectKey key = it->first;
       if (surviving.empty()) {
+        // Persistent-tier exception: a copy whose every dead shard sits on
+        // an OFFLINE PERSISTENT pool (mmap/io_uring backing file — the
+        // bytes outlive the process) is kept intact, placements and
+        // durable record untouched, and re-validated + refreshed when the
+        // restarted worker re-registers the pool (readopt_offline_pool).
+        // The reference's disk bytes also survive restarts
+        // (iouring_disk_backend.cpp:419-438) but its keystone forgets the
+        // metadata; here neither side forgets.
+        bool adoptable = false;
+        {
+          std::shared_lock rlock(registry_mutex_);
+          for (const auto& copy : info.copies) {
+            bool ok = !copy.shards.empty();
+            for (const auto& shard : copy.shards) {
+              if (live_workers.contains(shard.worker_id)) continue;
+              if (!offline_pools_.contains(shard.pool_id)) {
+                ok = false;
+                break;
+              }
+            }
+            if (ok) {
+              adoptable = true;
+              break;
+            }
+          }
+        }
+        if (adoptable) {
+          ++counters_.objects_offline;
+          LOG_WARN << "object " << key << " OFFLINE with worker " << worker_id
+                   << ": bytes persist on its file-backed pools — kept for "
+                      "re-adoption at restart, not re-replicated";
+          ++it;
+          continue;
+        }
         LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
         // Fence-first, as in the coded branch above.
         if (unpersist_object(key) != ErrorCode::OK) {
@@ -2371,9 +2596,10 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
 
     const CopyPlacement* streamed_src = nullptr;
-    const alloc::PoolMap fabric_pools = memory_pools();
     for (const auto& src : p.surviving) {
-      if (copy_object_bytes(*data_client_, src, staged, p.size, &fabric_pools,
+      // live_pools: the full registry snapshot from the top of the pass —
+      // the fabric lane needs fabric_addr for BOTH ends' pools.
+      if (copy_object_bytes(*data_client_, src, staged, p.size, &live_pools,
                             &counters_.fabric_moves) == ErrorCode::OK) {
         streamed_src = &src;
         break;

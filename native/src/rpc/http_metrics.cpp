@@ -56,6 +56,12 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_fabric_moves_total",
           "cross-process device moves over the device fabric (vs host lane)",
           c.fabric_moves.load());
+  counter("btpu_objects_offline_total",
+          "objects spared from loss: bytes persist on a dead worker's file-backed pools",
+          c.objects_offline.load());
+  counter("btpu_objects_adopted_total",
+          "offline objects re-validated and refreshed after a worker restart",
+          c.objects_adopted.load());
   counter("btpu_gets_total", "get_workers calls", c.gets.load());
   counter("btpu_removes_total", "remove_object calls", c.removes.load());
   counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());

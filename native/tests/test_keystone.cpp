@@ -9,6 +9,7 @@
 
 #include "btest.h"
 #include "btpu/coord/mem_coordinator.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/common/wire.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/transport/transport.h"
@@ -866,6 +867,131 @@ BTEST(Keystone, IdleSlotsReclaimedOnSlotTtlAndCancelledByDrain) {
   BT_ASSERT_OK(ks.drain_worker(host));
   BT_EXPECT(ks.put_commit_slot(g2.value()[0].slot_key, "drained", 0, {}) ==
             ErrorCode::OBJECT_NOT_FOUND);
+}
+
+BTEST(Keystone, WorkerRestartReadoptsPersistentPools) {
+  // A dead worker whose pools are FILE-BACKED (mmap/io_uring) keeps its
+  // bytes across the process: the keystone spares such objects from the
+  // loss path (OFFLINE, metadata intact) and, when the restarted worker
+  // re-registers the pool under a NEW base/rkey, re-carves the ranges,
+  // rewrites placements, re-validates the CRC stamps, and serves the
+  // object again — zero re-replication. Reference analog: its disk bytes
+  // persist too (iouring_disk_backend.cpp:419-438) but its keystone
+  // forgets the metadata.
+  auto cfg = fast_config();
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  auto w1 = std::make_unique<FakeWorker>("w1", 1 << 20, StorageClass::NVME);
+  ks.register_worker(w1->info());
+  ks.register_memory_pool(w1->pool);
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  std::vector<uint8_t> payload(200 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 17 + 3);
+  auto placed = ks.put_start("disk/obj", payload.size(), wc,
+                             crc32c(payload.data(), payload.size()));
+  BT_ASSERT_OK(placed);
+  auto client = transport::make_transport_client();
+  {
+    uint64_t off = 0;
+    for (const auto& shard : placed.value()[0].shards) {
+      const auto& mem = std::get<MemoryLocation>(shard.location);
+      BT_ASSERT(client->write(shard.remote, mem.remote_addr, mem.rkey,
+                              payload.data() + off, shard.length) == ErrorCode::OK);
+      off += shard.length;
+    }
+  }
+  CopyShardCrcs stamps;
+  stamps.copy_index = 0;
+  {
+    uint64_t off = 0;
+    for (const auto& shard : placed.value()[0].shards) {
+      stamps.crcs.push_back(crc32c(payload.data() + off, shard.length));
+      off += shard.length;
+    }
+  }
+  BT_EXPECT(ks.put_complete("disk/obj", {stamps}) == ErrorCode::OK);
+
+  // "Crash": keep the backing bytes (the file), lose the process (region).
+  std::vector<uint8_t> backing = w1->memory;
+  BT_EXPECT(ks.remove_worker("w1") == ErrorCode::OK);
+  w1.reset();  // old region unregistered — stale placements now unreadable
+  BT_EXPECT(ks.object_exists("disk/obj").value());  // spared, not lost
+  BT_EXPECT_EQ(ks.counters().objects_lost.load(), 0ull);
+  BT_EXPECT_EQ(ks.counters().objects_offline.load(), 1ull);
+
+  // "Restart": same worker id + pool id, same bytes, NEW base + rkey.
+  FakeWorker w1b("w1", 1 << 20, StorageClass::NVME);
+  std::copy(backing.begin(), backing.end(), w1b.memory.begin());
+  ks.register_worker(w1b.info());
+  ks.register_memory_pool(w1b.pool);
+
+  auto got = ks.get_workers("disk/obj");
+  BT_ASSERT_OK(got);
+  std::vector<uint8_t> back(payload.size(), 0);
+  uint64_t off = 0;
+  for (const auto& shard : got.value()[0].shards) {
+    const auto& mem = std::get<MemoryLocation>(shard.location);
+    BT_ASSERT(client->read(shard.remote, mem.remote_addr, mem.rkey, back.data() + off,
+                           shard.length) == ErrorCode::OK);
+    off += shard.length;
+  }
+  BT_EXPECT(back == payload);
+  BT_EXPECT_EQ(ks.counters().objects_adopted.load(), 1ull);
+  BT_EXPECT_EQ(ks.counters().objects_repaired.load(), 0ull);
+
+  // The re-carved ranges are real: a fresh allocation cannot overlap them.
+  auto fresh = ks.put_start("disk/obj2", 500 * 1024, wc);
+  BT_ASSERT_OK(fresh);
+  const auto& nmem = std::get<MemoryLocation>(fresh.value()[0].shards[0].location);
+  const auto& omem = std::get<MemoryLocation>(got.value()[0].shards[0].location);
+  const bool overlap = nmem.remote_addr < omem.remote_addr + omem.size &&
+                       omem.remote_addr < nmem.remote_addr + nmem.size;
+  BT_EXPECT(!overlap);
+}
+
+BTEST(Keystone, StaleBackingFileFailsReadoptionValidation) {
+  // The restarted worker's backing file was wiped/replaced: the CRC
+  // revalidation must demote the object to loss — never serve wrong bytes.
+  auto cfg = fast_config();
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  auto w1 = std::make_unique<FakeWorker>("w1", 1 << 20, StorageClass::HDD);
+  ks.register_worker(w1->info());
+  ks.register_memory_pool(w1->pool);
+
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  std::vector<uint8_t> payload(64 * 1024);
+  for (size_t i = 0; i < payload.size(); ++i) payload[i] = static_cast<uint8_t>(i * 31 + 7);
+  auto placed = ks.put_start("stale/obj", payload.size(), wc,
+                             crc32c(payload.data(), payload.size()));
+  BT_ASSERT_OK(placed);
+  auto client = transport::make_transport_client();
+  const auto& shard = placed.value()[0].shards[0];
+  const auto& mem = std::get<MemoryLocation>(shard.location);
+  BT_ASSERT(client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data(),
+                          payload.size()) == ErrorCode::OK);
+  CopyShardCrcs stale_stamp;
+  stale_stamp.copy_index = 0;
+  stale_stamp.crcs.push_back(crc32c(payload.data(), payload.size()));
+  BT_EXPECT(ks.put_complete("stale/obj", {stale_stamp}) == ErrorCode::OK);
+
+  BT_EXPECT(ks.remove_worker("w1") == ErrorCode::OK);
+  w1.reset();
+  BT_EXPECT(ks.object_exists("stale/obj").value());
+
+  // Restart with a ZEROED "backing file": revalidation must fail. The CRC
+  // checks run on the health loop (the watch thread must not stream bytes).
+  FakeWorker w1b("w1", 1 << 20, StorageClass::HDD);  // memory starts zeroed
+  ks.register_worker(w1b.info());
+  ks.register_memory_pool(w1b.pool);
+  ks.run_health_check_once();
+  BT_EXPECT(!ks.object_exists("stale/obj").value());
+  BT_EXPECT_EQ(ks.counters().objects_lost.load(), 1ull);
 }
 
 BTEST(Keystone, SingleReplicaLostObjectIsDropped) {

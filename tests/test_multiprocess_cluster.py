@@ -950,6 +950,96 @@ def test_drain_evacuates_device_tier_across_processes(tmp_path):
                  what="drained worker retired")
 
 
+@pytest.mark.parametrize("disk_class", ["nvme", "hdd"])
+def test_worker_restart_readopts_disk_objects(tmp_path, disk_class):
+    """VERDICT r3 item 4, the real-process version: SIGKILL a worker whose
+    only pool is FILE-BACKED while it holds a replicas=1 object; the
+    keystone keeps the object OFFLINE instead of declaring it lost, and a
+    restarted worker with the intact backing file serves it again after
+    CRC revalidation — btpu_objects_repaired_total stays 0. nvme exercises
+    the io_uring (virtual-region) lane, hdd the mmap (rebased flat-region)
+    lane."""
+    import subprocess
+    import urllib.request
+
+    from blackbird_tpu.procluster import (_port_open, free_port, spawn_logged,
+                                          write_keystone_yaml)
+    from blackbird_tpu.worker import write_worker_yaml
+    from blackbird_tpu import Client
+
+    coord_port, keystone_port, metrics_port = free_port(), free_port(), free_port()
+    write_keystone_yaml(tmp_path / "keystone.yaml", cluster_id="diskpod",
+                        coord_port=coord_port, keystone_port=keystone_port,
+                        metrics_port=metrics_port, heartbeat_ttl_sec=1)
+    cfg = tmp_path / "worker.yaml"
+    write_worker_yaml(
+        cfg, worker_id="disk-0", cluster_id="diskpod",
+        coord_endpoints=f"127.0.0.1:{coord_port}", listen_host="127.0.0.1",
+        heartbeat_interval_ms=300, heartbeat_ttl_ms=1000,
+        pools=[{"id": "disk-0-pool", "storage_class": disk_class,
+                "capacity": "16MB", "path": str(tmp_path / "backing.dat")}])
+
+    def metric(name):
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/metrics", timeout=5).read().decode()
+        for line in text.splitlines():
+            if line.startswith(name + " ") or line.startswith(name + "\t"):
+                return int(line.split()[-1])
+        return 0
+
+    def start_worker():
+        return spawn_logged(
+            [str(BUILD / "bb-worker"), "--config", str(cfg)],
+            tmp_path / "worker.log")
+
+    procs = []
+    try:
+        procs.append(spawn_logged(
+            [str(BUILD / "bb-coord"), "--host", "127.0.0.1",
+             "--port", str(coord_port)], tmp_path / "coord.log"))
+        wait_for(lambda: _port_open(coord_port), timeout=15, what="coord")
+        procs.append(spawn_logged(
+            [str(BUILD / "bb-keystone"), "--config", str(tmp_path / "keystone.yaml")],
+            tmp_path / "keystone.log"))
+        wait_for(lambda: _port_open(keystone_port), timeout=15, what="keystone")
+        worker = start_worker()
+        procs.append(worker)
+
+        client = Client(f"127.0.0.1:{keystone_port}")
+        wait_for(lambda: client.stats()["workers"] == 1, timeout=30, what="worker up")
+        payload = bytes(bytearray(range(249)) * 2000)  # ~490 KiB
+        client.put("disk/precious", payload, replicas=1)
+
+        worker.kill()  # crash, not drain
+        wait_for(lambda: client.stats()["workers"] == 0, timeout=30,
+                 what="worker death detected")
+        # Spared, not lost: metadata intact while the bytes sit in the file.
+        # (wait_for: the repair pass that spares runs after the worker-count
+        # stat already shows the death.)
+        wait_for(lambda: metric("btpu_objects_offline_total") == 1, timeout=20,
+                 what="object spared offline")
+        assert client.exists("disk/precious")
+        assert metric("btpu_objects_lost_total") == 0
+
+        worker2 = start_worker()  # same config, same backing file
+        procs.append(worker2)
+        wait_for(lambda: client.stats()["workers"] == 1, timeout=30,
+                 what="restarted worker up")
+        wait_for(lambda: metric("btpu_objects_adopted_total") >= 1, timeout=30,
+                 what="re-adoption")
+        assert client.get("disk/precious") == payload
+        assert metric("btpu_objects_repaired_total") == 0
+    finally:
+        for proc in reversed(procs):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 @pytest.mark.parametrize("worker_env", [{}, {"BTPU_HBM_HOST_VIEW": "0"}],
                          ids=["host-view", "device-path"])
 def test_cross_process_device_moves_ride_the_fabric(tmp_path, worker_env):

@@ -69,10 +69,12 @@ struct ClientOptions {
   // server-side until the keystone's slot TTL (default 60 s) reclaims them.
   // Remote clients only; embedded metadata has no round trip to save.
   uint32_t put_slots{4};
-  // Only puts at or below this size use slots (larger objects are
-  // bandwidth-, not RTT-bound; the default matches min_shard_size, so slot
-  // puts are single-shard in the default config).
-  uint64_t put_slot_max_bytes{256 * 1024};
+  // Only puts at or below this size use slots; larger objects are
+  // bandwidth-, not RTT-bound (at 1 MiB on the same-host staged lane the
+  // control round trip is still ~15% of the put, so the default covers it;
+  // idle reservation stays bounded at put_slots x this x replicas per
+  // active class).
+  uint64_t put_slot_max_bytes{1 << 20};
   // Pooled slots older than this are discarded (and cancelled) instead of
   // used: the keystone reclaims idle slots after its slot_ttl_sec, and a
   // data-plane write into a RECLAIMED slot could land on ranges already

@@ -371,6 +371,9 @@ class KeystoneService {
   // Background scrub ring position (scrub thread only).
   ObjectKey scrub_cursor_;
   std::atomic<uint64_t> slot_seq_{0};  // unique suffix for pooled slot keys
+  // Live pooled slots (granted, not yet committed/cancelled/reclaimed):
+  // keeps get_cluster_stats O(1) when excluding them from total_objects.
+  std::atomic<int64_t> slot_objects_{0};
   std::mutex drain_mutex_;               // serializes drain_worker per service
   std::string service_id_;
   // Persistent-tier pools of dead workers, as last advertised (old base +

@@ -961,6 +961,7 @@ void KeystoneService::on_demoted() {
   std::unique_lock lock(objects_mutex_);
   for (auto it = objects_.begin(); it != objects_.end();) {
     if (it->second.state == ObjectState::kPending) {
+      if (it->second.slot) slot_objects_.fetch_sub(1);
       adapter_.free_object(it->first);
       it = objects_.erase(it);
       ++dropped;
@@ -1121,6 +1122,7 @@ void KeystoneService::run_gc_once() {
     // Fence-first: a deposed/offline keystone must not free worker ranges
     // the promoted leader's record still references; retry next GC pass.
     if (unpersist_object(key) != ErrorCode::OK) continue;
+    if (it->second.slot) slot_objects_.fetch_sub(1);
     free_object_locked(key, it->second);
     objects_.erase(it);
     if (stale_pending) {
@@ -1469,6 +1471,7 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   // the durable delete is rejected (deposed leader) would ack a removal the
   // promoted leader still lists — its metadata would point at freed bytes.
   if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
+  if (it->second.slot) slot_objects_.fetch_sub(1);
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.put_cancels;
@@ -1519,6 +1522,7 @@ Result<std::vector<PutSlot>> KeystoneService::put_start_pooled(uint64_t size,
     slots.push_back({std::move(slot_key), std::move(placed).value()});
   }
   counters_.slots_granted.fetch_add(slots.size());
+  slot_objects_.fetch_add(static_cast<int64_t>(slots.size()));
   bump_view();
   return slots;
 }
@@ -1579,6 +1583,7 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
   }
   ++counters_.put_completes;
   ++counters_.slot_commits;
+  slot_objects_.fetch_sub(1);
   bump_view();
   return ErrorCode::OK;
 }
@@ -1590,6 +1595,7 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   // Same fence-first ordering as put_cancel (see comment there).
   if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
+  if (it->second.slot) slot_objects_.fetch_sub(1);
   free_object_locked(key, it->second);
   objects_.erase(it);
   ++counters_.removes;
@@ -1612,6 +1618,7 @@ Result<uint64_t> KeystoneService::remove_all_objects() {
       ++it;
       continue;
     }
+    if (it->second.slot) slot_objects_.fetch_sub(1);
     free_object_locked(it->first, it->second);
     it = objects_.erase(it);
     ++count;
@@ -1679,7 +1686,14 @@ Result<ClusterStats> KeystoneService::get_cluster_stats() const {
   }
   {
     std::shared_lock lock(objects_mutex_);
-    stats.total_objects = objects_.size();
+    // Pooled put slots are internal plumbing, not objects an operator put:
+    // keep them out of the count (their reserved capacity still shows in
+    // used_capacity, which is honest — the ranges are really held). O(1):
+    // slot_objects_ is maintained at every grant/commit/cancel/reclaim
+    // site; the clamp keeps a (bug-grade) drift from underflowing.
+    const int64_t slots = std::max<int64_t>(0, slot_objects_.load());
+    stats.total_objects =
+        objects_.size() - std::min<uint64_t>(objects_.size(), static_cast<uint64_t>(slots));
   }
   auto alloc_stats = adapter_.get_stats();
   stats.used_capacity = alloc_stats.total_allocated_bytes;
@@ -1926,6 +1940,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
         ++it;
         continue;
       }
+      slot_objects_.fetch_sub(1);
       free_object_locked(it->first, it->second);
       it = objects_.erase(it);
       ++counters_.put_cancels;
@@ -2362,6 +2377,25 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         return std::any_of(copy.shards.begin(), copy.shards.end(),
                            [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
       };
+
+      // Pooled put slots touching the dead worker are simply cancelled: no
+      // writer is attached, so there is nothing to repair, spare, or count
+      // as lost — the owning client's commit misses and falls back.
+      if (info.slot && std::any_of(info.copies.begin(), info.copies.end(), damaged)) {
+        const ObjectKey key = it->first;
+        for (const auto& copy : info.copies) {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id)
+              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          }
+        }
+        slot_objects_.fetch_sub(1);
+        free_object_locked(key, info);
+        it = objects_.erase(it);
+        ++counters_.put_cancels;
+        bump_view();
+        continue;
+      }
 
       // Erasure-coded objects have ONE copy whose shard ORDER is the code
       // geometry — the copy is never dropped whole. Dead shards stay listed

@@ -169,6 +169,9 @@ class JaxHbmProvider:
         self._fabric_lock = threading.Lock()
         self._fabric_conns: dict = {}
         self._offered: dict = {}  # transfer_id -> (spec, offered_at)
+        # Single GC drainer (created under _fabric_lock on first use): stale
+        # offers queue here; one thread self-pulls them serially.
+        self._fabric_gc_queue = None
         self.fabric_offers = 0
         self.fabric_pulls = 0
         self.fabric_discards = 0
@@ -932,9 +935,13 @@ class JaxHbmProvider:
         pulls it, and the API has no cancel — so stale offers are drained by
         a self-pull. The source never learns of a successful remote pull, so
         consumed ids are self-pulled once too — measured to complete quickly
-        (the server answers; no hang), so the only cost is a wasted local
-        round trip per entry, once. Runs opportunistically before each new
-        offer."""
+        (the server answers; no hang), but that is observed, not documented
+        behavior, so the pulls run on ONE long-lived daemon thread fed by a
+        queue: if a JAX version ever blocks on a consumed/unknown id, that
+        thread wedges in isolation while the transport thread serving live
+        offers keeps going — and because there is only ever one drainer, two
+        pulls can never race on the shared cached connection. Runs
+        opportunistically before each new offer."""
         import time
 
         now = time.monotonic()
@@ -943,12 +950,27 @@ class JaxHbmProvider:
                      if now - at > 60.0]
             for tid, _spec in stale:
                 del self._offered[tid]
-        for tid, spec in stale:
-            try:
-                self._fabric_connection(self._fabric_server().address()).pull(tid, [spec])
-                self.fabric_discards += 1
-            except Exception:  # noqa: BLE001 - best effort
-                pass
+            if not stale:
+                return
+            if self._fabric_gc_queue is None:
+                import queue
+
+                self._fabric_gc_queue = queue.Queue()
+
+                def _drain():
+                    while True:
+                        tid, spec = self._fabric_gc_queue.get()
+                        try:
+                            self._fabric_connection(
+                                self._fabric_server().address()).pull(tid, [spec])
+                            self.fabric_discards += 1
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
+
+                threading.Thread(
+                    target=_drain, daemon=True, name="btpu-fabric-gc").start()
+        for entry in stale:
+            self._fabric_gc_queue.put(entry)
 
     def _fabric_offer(self, _ctx, region_id, offset, length, transfer_id):
         try:

@@ -168,6 +168,10 @@ class KeystoneService {
   // from a healthy sibling copy, coded shards via parity reconstruction.
   // Returns the number of corrupt shards found.
   size_t run_scrub_once();
+  // Queue one object for verification ahead of the next pass's ring walk
+  // (on top of the pass budget). Movers use this for fabric-moved bytes,
+  // which carry their stamps without the staged lane's streaming CRC gate.
+  void queue_scrub_target(const ObjectKey& key);
 
   Result<ClusterStats> get_cluster_stats() const;
   // Allocator view with per-storage-class breakdowns (metrics exports the
@@ -276,8 +280,10 @@ class KeystoneService {
   alloc::PoolMap allocatable_pools_snapshot() const;
   // One live shard's bytes into a staged placement (device fast path incl.).
   // `pools`: caller-hoisted pool snapshot (drain calls this per shard).
+  // `used_unchecked` (optional) reports a fabric or chip-to-chip move — those skip the staged
+  // lane's CRC gate, so the caller queues the object for scrub revalidation.
   ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
-                         const alloc::PoolMap& pools);
+                         const alloc::PoolMap& pools, bool* used_unchecked = nullptr);
   // A persistent-tier pool re-registered after its worker restarted:
   // re-carve the spared objects' ranges, rewrite their placements onto the
   // new base/rkey, and re-validate stamped shards by CRC. Runs BEFORE the
@@ -381,14 +387,23 @@ class KeystoneService {
   // (guarded by registry_mutex_). Consumed by readopt_offline_pool.
   std::unordered_map<MemoryPoolId, MemoryPool> offline_pools_;
   // Re-adopted stamped shards pending CRC revalidation (run_readopt_checks).
+  // Keyed by the shard's placement + stamped CRC, not the object epoch:
+  // epochs move for unrelated reasons (a second pool adopting the same
+  // object bumps it), and a stale check must neither be dropped for that
+  // nor condemn a shard that a later repair/re-put has since replaced.
   struct ReadoptCheck {
     ObjectKey key;
-    uint64_t epoch;
     ShardPlacement shard;
     uint32_t expect;
   };
   std::mutex readopt_checks_mutex_;
   std::vector<ReadoptCheck> readopt_checks_;
+  // Objects whose bytes moved over the device fabric without the staged
+  // lane's streaming CRC gate (stamps are carried, bytes unchecked). The
+  // scrub verifies them on its next pass, ahead of the ring walk, healing
+  // through the normal sibling/parity machinery.
+  std::mutex scrub_targets_mutex_;
+  std::unordered_set<ObjectKey> scrub_targets_;
 };
 
 }  // namespace btpu::keystone

@@ -422,17 +422,25 @@ bool fabric_copy_object(transport::TransportClient& client, const CopyPlacement&
 // with fabric endpoints move over the device fabric (when `pools` is
 // given). The source's CRC (when stamped) is verified as the bytes stream:
 // a mover must never propagate a bit-rotten copy — the caller fails over to
-// the next source instead (device->device and fabric moves skip the check;
-// those bytes never touch the host).
+// the next source instead. Device->device and fabric moves skip that check
+// (those bytes never touch the host); such destinations are reported
+// through `used_unchecked` so the caller can queue the object for scrub
+// revalidation — stamps are carried, so rot in the source would otherwise
+// ride along unchecked until a client verify or ring-walk scrub.
 ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
                             const std::vector<CopyPlacement>& dsts, uint64_t size,
                             const alloc::PoolMap* pools = nullptr,
-                            std::atomic<uint64_t>* fabric_moves = nullptr) {
+                            std::atomic<uint64_t>* fabric_moves = nullptr,
+                            bool* used_unchecked = nullptr) {
   std::vector<const CopyPlacement*> staged;
   if (all_shards_on_device(src)) {
     for (const auto& dst : dsts) {
-      if (all_shards_on_device(dst) && device_copy_object(src, dst, size) == ErrorCode::OK)
-        continue;  // moved chip-to-chip, no host bytes
+      if (all_shards_on_device(dst) &&
+          device_copy_object(src, dst, size) == ErrorCode::OK) {
+        // Moved chip-to-chip, no host bytes — and no CRC gate either.
+        if (used_unchecked) *used_unchecked = true;
+        continue;
+      }
       staged.push_back(&dst);
     }
   } else {
@@ -443,6 +451,7 @@ ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacem
     for (const CopyPlacement* dst : staged) {
       if (fabric_copy_object(client, src, *dst, size, *pools)) {
         if (fabric_moves) fabric_moves->fetch_add(1);
+        if (used_unchecked) *used_unchecked = true;
       } else {
         rest.push_back(dst);
       }
@@ -722,8 +731,9 @@ void KeystoneService::retry_dirty_persists() {
       // persist racing this loop) cannot be interleaved and wiped here.
       std::lock_guard<std::mutex> dirty(persist_retry_mutex_);
       persist_retry_.erase(key);
-      if (caught_up)
+      if (caught_up) {
         LOG_INFO << "durable record for " << key << " caught up after deferred persist";
+      }
     } else {
       // One failed RPC means the coordinator is (still) unreachable or this
       // node was fenced: stop after ONE timeout instead of paying it per
@@ -1145,6 +1155,15 @@ void KeystoneService::run_gc_once() {
 // shard as a repair target). This is what makes raw (verify=false) client
 // reads an honest latency trade: the fleet still converges on intact bytes.
 // The reference has no integrity machinery at all.
+void KeystoneService::queue_scrub_target(const ObjectKey& key) {
+  // No scrub thread (interval 0) or no pass budget: nothing will ever drain
+  // the queue, so don't grow it. Movers call this from metadata critical
+  // sections — hence the O(1) set insert, not a scan.
+  if (config_.scrub_interval_sec <= 0 || config_.scrub_objects_per_pass == 0) return;
+  std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
+  scrub_targets_.insert(key);
+}
+
 size_t KeystoneService::run_scrub_once() {
   if (!is_leader_.load() || config_.scrub_objects_per_pass == 0) return 0;
   struct Target {
@@ -1153,8 +1172,23 @@ size_t KeystoneService::run_scrub_once() {
     std::vector<CopyPlacement> copies;
   };
   std::vector<Target> batch;
+  // Queued targets (fabric-moved objects whose stamps were carried without a
+  // byte check) verify ahead of the ring walk, on top of the pass budget.
+  std::vector<ObjectKey> priority;
+  {
+    std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
+    priority.assign(scrub_targets_.begin(), scrub_targets_.end());
+    scrub_targets_.clear();
+  }
   {
     std::shared_lock lock(objects_mutex_);
+    std::unordered_set<std::string_view> taken_keys;
+    for (const auto& key : priority) {
+      auto it = objects_.find(key);
+      if (it != objects_.end() && it->second.state == ObjectState::kComplete &&
+          taken_keys.insert(it->first).second)
+        batch.push_back({key, it->second.epoch, it->second.copies});
+    }
     std::vector<const ObjectKey*> keys;
     keys.reserve(objects_.size());
     for (const auto& [k, info] : objects_) {
@@ -1162,20 +1196,28 @@ size_t KeystoneService::run_scrub_once() {
     }
     std::sort(keys.begin(), keys.end(),
               [](const ObjectKey* a, const ObjectKey* b) { return *a < *b; });
-    if (keys.empty()) return 0;
-    // The smallest keys strictly after the cursor, wrapping — a ring walk.
-    auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_,
-                                  [](const ObjectKey& c, const ObjectKey* k) { return c < *k; });
-    for (size_t taken = 0; taken < config_.scrub_objects_per_pass &&
-                           taken < keys.size();
-         ++taken) {
-      if (start == keys.end()) start = keys.begin();
-      const auto& info = objects_.at(**start);
-      batch.push_back({**start, info.epoch, info.copies});
-      ++start;
+    if (!keys.empty()) {
+      // The smallest keys strictly after the cursor, wrapping — a ring walk.
+      // Keys already taken as priority targets are visited (the cursor must
+      // advance past them) but not scrubbed twice in one pass.
+      auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_,
+                                    [](const ObjectKey& c, const ObjectKey* k) { return c < *k; });
+      const ObjectKey* last_visited = nullptr;
+      for (size_t taken = 0; taken < config_.scrub_objects_per_pass &&
+                             taken < keys.size();
+           ++taken) {
+        if (start == keys.end()) start = keys.begin();
+        last_visited = *start;
+        if (!taken_keys.contains(**start)) {
+          const auto& info = objects_.at(**start);
+          batch.push_back({**start, info.epoch, info.copies});
+        }
+        ++start;
+      }
+      if (last_visited) scrub_cursor_ = *last_visited;
     }
-    scrub_cursor_ = batch.back().key;
   }
+  if (batch.empty()) return 0;
 
   const alloc::PoolMap target_pools = allocatable_pools_snapshot();
   constexpr uint64_t kSeg = 4ull << 20;  // bounded scrub memory
@@ -1201,7 +1243,11 @@ size_t KeystoneService::run_scrub_once() {
     // in-place write through a snapshot).
     if (!t.copies.empty() && t.copies.front().ec_data_shards > 0) {
       const CopyPlacement& copy = t.copies.front();
-      if (copy.shard_crcs.size() != copy.shards.size()) continue;  // unstamped
+      // Unstamped coded = a put that never stamped (nothing to verify
+      // against). No mover can strip a coded copy's stamps: every mover
+      // preserves coded geometry 1:1 (drain rejects fragmented staging,
+      // demote/repair require exact positions), so stamps always carry.
+      if (copy.shard_crcs.size() != copy.shards.size()) continue;
       std::vector<size_t> corrupt;
       for (size_t i = 0; i < copy.shards.size(); ++i) {
         const auto crc = segmented_crc(copy.shards[i].length, [&](uint64_t off, uint64_t n) {
@@ -1237,7 +1283,53 @@ size_t KeystoneService::run_scrub_once() {
     // on a freed, reallocated range.
     for (size_t ci = 0; ci < t.copies.size(); ++ci) {
       const CopyPlacement& copy = t.copies[ci];
-      if (copy.shard_crcs.size() != copy.shards.size()) continue;  // unstamped
+      if (copy.shard_crcs.size() != copy.shards.size()) {
+        // Unstamped — a 1:n drain splice cleared the stamps, or the mover's
+        // geometry prevented carrying them — but the whole-copy CRC still
+        // travels with every verified put. Verify the copy end to end so
+        // fabric/device-moved bytes cannot escape revalidation just because
+        // per-shard stamps could not carry; heal is whole-copy from a
+        // sibling under the same epoch-guarded write discipline.
+        if (copy.content_crc == 0) continue;
+        uint64_t total = 0;
+        for (const auto& s : copy.shards) total += s.length;
+        const auto crc = segmented_crc(total, [&](uint64_t off, uint64_t n) {
+          return transport::copy_range_io(*data_client_, copy, off, buf.data(), n,
+                                          /*is_write=*/false) == ErrorCode::OK;
+        });
+        if (!crc || *crc == copy.content_crc) continue;
+        ++corrupt_found;
+        ++counters_.scrub_corrupt;
+        LOG_WARN << "scrub: corrupt unstamped copy " << ci << " of " << t.key
+                 << "; healing whole-copy from a sibling";
+        bool healed = false;
+        bool stale = false;
+        for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
+          if (sj == ci) continue;
+          const auto src_crc = segmented_crc(total, [&](uint64_t off, uint64_t n) {
+            if (transport::copy_range_io(*data_client_, t.copies[sj], off, buf.data(), n,
+                                         /*is_write=*/false) != ErrorCode::OK)
+              return false;
+            std::shared_lock lock(objects_mutex_);
+            auto it = objects_.find(t.key);
+            if (it == objects_.end() || it->second.epoch != t.epoch) {
+              stale = true;
+              return false;
+            }
+            return transport::copy_range_io(*data_client_, copy, off, buf.data(), n,
+                                            /*is_write=*/true) == ErrorCode::OK;
+          });
+          healed = src_crc && *src_crc == copy.content_crc;
+        }
+        if (healed) {
+          ++counters_.scrub_healed;
+          LOG_INFO << "scrub: healed unstamped copy " << ci << " of " << t.key;
+        } else if (!stale) {
+          LOG_WARN << "scrub: no intact sibling for unstamped copy " << ci << " of "
+                   << t.key << " — detect-only";
+        }
+        continue;
+      }
       uint64_t shard_off = 0;
       for (size_t i = 0; i < copy.shards.size(); ++i) {
         const uint64_t len = copy.shards[i].length;
@@ -1577,7 +1669,18 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
       copy.content_crc = 0;
       copy.shard_crcs.clear();
     }
-    adapter_.allocator().rename_object(key, slot_key);
+    if (adapter_.allocator().rename_object(key, slot_key) != ErrorCode::OK) {
+      // Allocator bookkeeping is stuck under `key` with no object entry to
+      // match: reinstating the slot would leave its TTL reclaim freeing
+      // nothing while the reserved ranges leak until restart. Reclaim the
+      // allocation now, under the key the allocator actually tracks, and
+      // drop the slot — the client's fallback re-places from scratch.
+      LOG_ERROR << "slot commit rollback: back-rename to " << slot_key
+                << " failed; freeing the allocation under " << key;
+      adapter_.free_object(key);
+      slot_objects_.fetch_sub(1);
+      return ec;
+    }
     objects_[slot_key] = std::move(back);
     return ec;
   }
@@ -1752,6 +1855,12 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
   // base, or a fresh allocation could overwrite its served bytes.
   size_t adopted = 0;
   std::vector<ReadoptCheck> checks;
+  // One-timeout discipline (mirrors retry_dirty_persists): this loop runs on
+  // the coordinator watch thread under the unique objects lock — if the
+  // coordinator is down, the FIRST failed persist proves it, and every
+  // remaining object goes straight to the dirty queue instead of paying a
+  // full RPC timeout each while all metadata operations stall behind us.
+  bool persist_down = false;
   {
     std::unique_lock lock(objects_mutex_);
     for (auto it = objects_.begin(); it != objects_.end();) {
@@ -1808,11 +1917,16 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
       info.epoch = next_epoch_.fetch_add(1);
       for (const Hit& hit : hits) {
         if (hit.copy->shard_crcs.size() == hit.copy->shards.size()) {
-          checks.push_back({key, info.epoch, hit.copy->shards[hit.index],
-                            hit.copy->shard_crcs[hit.index]});
+          checks.push_back(
+              {key, hit.copy->shards[hit.index], hit.copy->shard_crcs[hit.index]});
         }
       }
-      if (persist_object(key, info) != ErrorCode::OK) mark_persist_dirty(key);
+      if (persist_down) {
+        mark_persist_dirty(key);
+      } else if (persist_object(key, info) != ErrorCode::OK) {
+        persist_down = true;
+        mark_persist_dirty(key);
+      }
       ++adopted;
       ++counters_.objects_adopted;
       ++it;
@@ -1865,7 +1979,25 @@ void KeystoneService::run_readopt_checks() {
              << "); dropping the object";
     std::unique_lock lock(objects_mutex_);
     auto it = objects_.find(check.key);
-    if (it == objects_.end() || it->second.epoch != check.epoch) continue;
+    // The check condemns only the exact shard it was queued for: same
+    // placement AND same stamp. An epoch comparison would be both too strict
+    // (a second offline pool's adoption of the same object bumps the epoch
+    // without touching this shard — the revalidation must still run) and
+    // too loose once dropped (a re-put or repair may have landed fresh
+    // bytes at the same address, which this stale expectation must not
+    // drop).
+    if (it == objects_.end()) continue;
+    const bool still_applies = [&] {
+      for (const auto& copy : it->second.copies) {
+        if (copy.shard_crcs.size() != copy.shards.size()) continue;
+        for (size_t i = 0; i < copy.shards.size(); ++i) {
+          if (copy.shards[i] == check.shard && copy.shard_crcs[i] == check.expect)
+            return true;
+        }
+      }
+      return false;
+    }();
+    if (!still_applies) continue;
     if (unpersist_object(check.key) != ErrorCode::OK) {
       // Fence-first failed (outage): the corrupt object must not quietly
       // keep serving — re-queue so the next health tick retries the drop.
@@ -2060,9 +2192,18 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       }
       if (!attempt.ok()) continue;
       std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
+      // A coded shard must re-land as exactly ONE range: the coded client
+      // read path requires shards.size() == k+m (client.cpp), so a 1:n
+      // splice would leave the object unreadable (and clear the stamps the
+      // scrub needs). A fragmented pool just defers this shard's move.
+      if (coded && staged[0].shards.size() != 1) {
+        adapter_.free_object(staging_key);
+        continue;
+      }
 
       // Stream straight from the victim shard — alive, unlike crash repair.
-      if (stream_shard(m.shard, staged[0], all_pools) != ErrorCode::OK) {
+      bool used_unchecked = false;
+      if (stream_shard(m.shard, staged[0], all_pools, &used_unchecked) != ErrorCode::OK) {
         adapter_.free_object(staging_key);
         continue;
       }
@@ -2104,6 +2245,8 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
                     staged[0].shards.begin(), staged[0].shards.end());
       it->second.epoch = next_epoch_.fetch_add(1);
       epoch_now[m.key] = it->second.epoch;
+      // Fabric-drained bytes skipped the staged lane's CRC gate: scrub them.
+      if (used_unchecked) queue_scrub_target(m.key);
       if (persist_object(m.key, it->second) != ErrorCode::OK) {
         // Splice landed in memory; the health loop re-persists.
         mark_persist_dirty(m.key);
@@ -2141,12 +2284,15 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
 // fast path included (chip-to-chip, no host staging, when both ends are
 // device-resident).
 ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
-                                        const alloc::PoolMap& pools) {
+                                        const alloc::PoolMap& pools, bool* used_unchecked) {
   const auto* src_dev = std::get_if<DeviceLocation>(&src.location);
   if (src_dev && dst.shards.size() == 1) {
     if (const auto* dst_dev = std::get_if<DeviceLocation>(&dst.shards[0].location)) {
-      return storage::hbm_copy(src_dev->region_id, src_dev->offset, dst_dev->region_id,
-                               dst_dev->offset, src.length);
+      auto ec = storage::hbm_copy(src_dev->region_id, src_dev->offset, dst_dev->region_id,
+                                  dst_dev->offset, src.length);
+      // Chip-to-chip, no host bytes and no CRC gate: report for scrub.
+      if (ec == ErrorCode::OK && used_unchecked) *used_unchecked = true;
+      return ec;
     }
   }
   {
@@ -2156,6 +2302,7 @@ ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPla
     src_copy.shards.push_back(src);
     if (fabric_copy_object(*data_client_, src_copy, dst, src.length, pools)) {
       counters_.fabric_moves.fetch_add(1);
+      if (used_unchecked) *used_unchecked = true;
       return ErrorCode::OK;
     }
   }
@@ -2630,11 +2777,13 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
 
     const CopyPlacement* streamed_src = nullptr;
+    bool used_unchecked = false;
     for (const auto& src : p.surviving) {
       // live_pools: the full registry snapshot from the top of the pass —
       // the fabric lane needs fabric_addr for BOTH ends' pools.
+      used_unchecked = false;
       if (copy_object_bytes(*data_client_, src, staged, p.size, &live_pools,
-                            &counters_.fabric_moves) == ErrorCode::OK) {
+                            &counters_.fabric_moves, &used_unchecked) == ErrorCode::OK) {
         streamed_src = &src;
         break;
       }
@@ -2668,6 +2817,11 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       it->second.copies.push_back(std::move(copy));
     }
     it->second.epoch = next_epoch_.fetch_add(1);
+    // Fabric- and chip-to-chip-moved bytes bypassed the staged lane's
+    // streaming CRC gate but carry the source's stamps: have the scrub
+    // verify them ahead of its ring walk (and heal from a sibling if the
+    // source was rotten).
+    if (used_unchecked) queue_scrub_target(p.key);
     if (auto ec = persist_object(p.key, it->second); ec != ErrorCode::OK) {
       // The merge already landed locally (memory + allocator are consistent)
       // but the durable record is stale. A coordinator outage heals at this
@@ -3161,6 +3315,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   // Cross-process HBM pools register callback-backed regions instead.
   bool moved = false;
   const CopyPlacement* moved_src = nullptr;
+  bool used_unchecked = false;
   if (coded) {
     // Coded objects move SHARD-VERBATIM: the staged allocation reused the
     // object's (k, m) config, so it has the identical geometry and every
@@ -3217,8 +3372,9 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   } else {
     const alloc::PoolMap fabric_pools = memory_pools();
     for (const auto& src : old_copies) {
+      used_unchecked = false;
       if (copy_object_bytes(*data_client_, src, placed.value(), size, &fabric_pools,
-                            &counters_.fabric_moves) == ErrorCode::OK) {
+                            &counters_.fabric_moves, &used_unchecked) == ErrorCode::OK) {
         moved = true;
         moved_src = &src;
         break;
@@ -3257,6 +3413,9 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     carry_shard_crcs(*moved_src, copy);
   }
   it->second.epoch = next_epoch_.fetch_add(1);
+  // Fabric/device moves carry stamps without the staged lane's CRC gate:
+  // scrub them.
+  if (used_unchecked) queue_scrub_target(key);
   if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
     // The move already landed locally; the durable record still names the old
     // (now released) placements. Don't claim the demotion — kSkipped keeps

@@ -1372,6 +1372,52 @@ BTEST(Integrity, BackgroundScrubHealsCorruptReplicatedShard) {
   BT_EXPECT(back.value() == data);
 }
 
+BTEST(Integrity, QueuedScrubTargetVerifiedAheadOfRing) {
+  // Movers queue fabric-moved objects for revalidation: a queued target is
+  // scrubbed on the NEXT pass, ahead of the ring walk and on top of its
+  // budget — rot propagated over the device fabric (whose moves carry CRC
+  // stamps without the staged lane's streaming check) cannot hide behind a
+  // long ring.
+  auto opts = EmbeddedClusterOptions::simple(2, 16 << 20);
+  opts.keystone.scrub_objects_per_pass = 1;  // ring crawls one object a pass
+  // A scrub thread must exist for targets to queue (the guard refuses to
+  // grow a queue nothing drains); the hour-long interval keeps it parked
+  // while the test drives passes by hand.
+  opts.keystone.scrub_interval_sec = 3600;
+  EmbeddedCluster cluster(std::move(opts));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(256 * 1024, 11);
+  for (char c : {'a', 'b', 'c', 'd', 'e', 'f'}) {
+    BT_ASSERT(client->put(std::string("ring/") + c, data.data(), data.size(), cfg) ==
+              ErrorCode::OK);
+  }
+
+  // Rot the LAST ring key — a budget-1 ring pass starting from scratch
+  // would reach it five passes from now.
+  auto placements = client->get_workers("ring/f");
+  BT_ASSERT_OK(placements);
+  const auto& shard = placements.value()[0].shards[0];
+  const auto& mem = std::get<MemoryLocation>(shard.location);
+  std::vector<uint8_t> garbage(4096, 0x21);
+  auto raw = transport::make_transport_client();
+  BT_ASSERT(raw->write(shard.remote, mem.remote_addr + 512, mem.rkey, garbage.data(),
+                       garbage.size()) == ErrorCode::OK);
+
+  auto& ks = cluster.keystone();
+  ks.queue_scrub_target("ring/f");
+  BT_EXPECT_EQ(ks.run_scrub_once(), 1u);  // found out of ring order...
+  BT_EXPECT_EQ(ks.counters().scrub_healed.load(), 1u);
+  // ...and healed: both copies now serve intact bytes even unverified.
+  auto back = client->get("ring/f", /*verify=*/false);
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
 BTEST(Integrity, BackgroundScrubReconstructsCorruptCodedShard) {
   EmbeddedCluster cluster(EmbeddedClusterOptions::simple(3, 8 << 20));
   BT_ASSERT(cluster.start() == ErrorCode::OK);

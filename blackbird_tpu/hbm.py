@@ -173,6 +173,7 @@ class JaxHbmProvider:
         # offers queue here; one thread self-pulls them serially.
         self._fabric_gc_queue = None
         self.fabric_offers = 0
+        self.fabric_gc_dropped = 0  # stale offers dropped: drainer stuck
         self.fabric_pulls = 0
         self.fabric_discards = 0
 
@@ -955,7 +956,13 @@ class JaxHbmProvider:
             if self._fabric_gc_queue is None:
                 import queue
 
-                self._fabric_gc_queue = queue.Queue()
+                # Bounded: if the drainer ever wedges (the scenario this
+                # design isolates), the queue fills and further entries are
+                # DROPPED with a counter instead of accumulating forever —
+                # their device arrays stay pinned either way (only a pull
+                # releases an offer), so the counter is the observable
+                # signal that HBM is leaking and the runtime needs a bounce.
+                self._fabric_gc_queue = queue.Queue(maxsize=256)
 
                 def _drain():
                     while True:
@@ -970,7 +977,10 @@ class JaxHbmProvider:
                 threading.Thread(
                     target=_drain, daemon=True, name="btpu-fabric-gc").start()
         for entry in stale:
-            self._fabric_gc_queue.put(entry)
+            try:
+                self._fabric_gc_queue.put_nowait(entry)
+            except Exception:  # noqa: BLE001 - queue full: drainer is stuck
+                self.fabric_gc_dropped += 1
 
     def _fabric_offer(self, _ctx, region_id, offset, length, transfer_id):
         try:

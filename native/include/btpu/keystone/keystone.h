@@ -280,10 +280,14 @@ class KeystoneService {
   alloc::PoolMap allocatable_pools_snapshot() const;
   // One live shard's bytes into a staged placement (device fast path incl.).
   // `pools`: caller-hoisted pool snapshot (drain calls this per shard).
-  // `used_unchecked` (optional) reports a fabric or chip-to-chip move — those skip the staged
-  // lane's CRC gate, so the caller queues the object for scrub revalidation.
+  // `used_unchecked` (optional) reports a fabric or chip-to-chip move —
+  // those skip the staged lane's CRC gate, so the caller queues the object
+  // for scrub revalidation. `host_crc` (optional) returns the CRC32C of the
+  // bytes as streamed when the HOST lane carried them (untouched otherwise):
+  // the caller holds the shard's stamp and can detect a rotten source.
   ErrorCode stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
-                         const alloc::PoolMap& pools, bool* used_unchecked = nullptr);
+                         const alloc::PoolMap& pools, bool* used_unchecked = nullptr,
+                         uint32_t* host_crc = nullptr);
   // A persistent-tier pool re-registered after its worker restarted:
   // re-carve the spared objects' ranges, rewrite their placements onto the
   // new base/rkey, and re-validate stamped shards by CRC. Runs BEFORE the
@@ -395,9 +399,18 @@ class KeystoneService {
     ObjectKey key;
     ShardPlacement shard;
     uint32_t expect;
+    // Adoption sequence of the pool when this check was queued. A later
+    // re-adoption of the same pool supersedes outstanding checks (its own
+    // fresh checks govern): without this, a check whose lock-free CRC read
+    // raced a pool bounce could condemn bytes the second adoption restored.
+    uint64_t seq{0};
   };
   std::mutex readopt_checks_mutex_;
   std::vector<ReadoptCheck> readopt_checks_;
+  // Latest adoption sequence per pool (guarded by readopt_checks_mutex_;
+  // written under objects_mutex_ so checkers holding it see a stable value).
+  std::unordered_map<MemoryPoolId, uint64_t> readopt_seq_;
+  std::atomic<uint64_t> readopt_seq_counter_{0};
   // Objects whose bytes moved over the device fabric without the staged
   // lane's streaming CRC gate (stamps are carried, bytes unchecked). The
   // scrub verifies them on its next pass, ahead of the ring walk, healing

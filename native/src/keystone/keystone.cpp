@@ -15,519 +15,6 @@ namespace btpu::keystone {
 
 using coord::WatchEvent;
 
-// ---- record envelope ------------------------------------------------------
-// Durable records (coordinator values) outlive binaries, so unlike RPC
-// frames they need an explicit format marker: records this build writes are
-// [u64 0xFF..FF][u8 format=2][wire-v2 payload]. The magic cannot collide
-// with any pre-envelope record: worker/pool records begin with a non-empty
-// id string's u32 length (never 0xFFFFFFFF = a 4 GiB id) and object records
-// with a u64 object size (never 2^64-1). Records without the marker decode
-// through the hand-rolled legacy layouts in `v1` below — a restart over a
-// pre-upgrade data dir must recover its objects, not purge them as garbage
-// (proven by test_keystone.cpp RestartRecoversPreUpgradeRecordLayouts).
-//
-// COMPATIBILITY BOUNDARY: the envelope guarantee is one-directional across
-// its introduction. Builds FROM this one on read every older layout, and —
-// because wire v2 is append-only and future-format records are skipped, not
-// deleted — they stay safe under records from newer builds too. But
-// PRE-envelope builds cannot read enveloped records (they see a 4 GiB
-// string length / 2^64-1 size and may purge them as garbage): rolling a
-// binary BACK across the envelope introduction is unsupported — upgrade
-// keystones+workers across it as one step and don't roll back, exactly the
-// atomic-upgrade stance those older builds documented for themselves
-// (their rpc.h: "Upgrades are atomic per cluster").
-
-namespace {
-constexpr uint64_t kRecordMagic = ~0ull;
-constexpr uint8_t kRecordFormat = 2;
-
-enum class RecordEra : uint8_t {
-  kLegacy,   // no envelope: pre-envelope build wrote it (reader untouched)
-  kCurrent,  // envelope, format we speak (reader advanced past envelope)
-  kFuture,   // envelope, bumped format byte: an intentionally incompatible
-             // future layout — unusable here, but NOT garbage (keep it;
-             // deleting would destroy data during a rollback window)
-};
-
-void put_record_envelope(wire::Writer& w) {
-  w.put(kRecordMagic);
-  w.put(kRecordFormat);
-}
-
-RecordEra take_record_envelope(wire::Reader& r) {
-  if (r.remaining() < 9) return RecordEra::kLegacy;
-  uint64_t magic = 0;
-  std::memcpy(&magic, r.cursor(), sizeof(magic));
-  if (magic != kRecordMagic) return RecordEra::kLegacy;
-  uint8_t format = 0;
-  std::memcpy(&format, r.cursor() + sizeof(magic), sizeof(format));
-  // Append-only evolution never bumps the format byte, so != is "future".
-  if (format != kRecordFormat) return RecordEra::kFuture;
-  r.skip(sizeof(magic) + sizeof(format));
-  return RecordEra::kCurrent;
-}
-
-// Decoders for the layouts pre-envelope builds wrote: no length prefixes on
-// composite structs, so every nested layout is pinned by hand here (the
-// wire:: overloads have moved on to the self-describing v2 encoding).
-namespace v1 {
-
-bool topo(wire::Reader& r, TopoCoord& t) {
-  return wire::decode_fields(r, t.slice_id, t.host_id, t.chip_id);
-}
-
-bool remote(wire::Reader& r, RemoteDescriptor& d) {
-  return wire::decode_fields(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
-}
-
-bool location(wire::Reader& r, LocationDetail& loc) {
-  uint8_t idx = 0;
-  if (!r.get(idx)) return false;
-  switch (idx) {
-    case 0: {
-      MemoryLocation m;
-      if (!wire::decode_fields(r, m.remote_addr, m.rkey, m.size)) return false;
-      loc = m;
-      return true;
-    }
-    case 1: {
-      FileLocation f;
-      if (!wire::decode_fields(r, f.file_path, f.file_offset)) return false;
-      loc = f;
-      return true;
-    }
-    case 2: {
-      DeviceLocation d;
-      if (!wire::decode_fields(r, d.device_id, d.region_id, d.offset, d.size)) return false;
-      loc = d;
-      return true;
-    }
-    default:
-      return false;
-  }
-}
-
-bool shard(wire::Reader& r, ShardPlacement& s) {
-  return wire::decode_fields(r, s.pool_id, s.worker_id) && remote(r, s.remote) &&
-         wire::decode_fields(r, s.storage_class, s.length) && location(r, s.location);
-}
-
-bool shards(wire::Reader& r, std::vector<ShardPlacement>& out) {
-  uint32_t n = 0;
-  if (!r.get(n) || n > r.remaining()) return false;
-  out.clear();
-  out.reserve(n);
-  for (uint32_t i = 0; i < n; ++i) {
-    ShardPlacement s;
-    if (!shard(r, s)) return false;
-    out.push_back(std::move(s));
-  }
-  return true;
-}
-
-// The last pre-envelope copy layout (carries ec geometry + content_crc).
-bool copy(wire::Reader& r, CopyPlacement& c) {
-  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards) &&
-         wire::decode_fields(r, c.ec_data_shards, c.ec_parity_shards, c.ec_object_size,
-                             c.content_crc);
-}
-
-// EC-era layout: ec geometry but no content_crc yet.
-bool copy_ec_era(wire::Reader& r, CopyPlacement& c) {
-  c.content_crc = 0;
-  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards) &&
-         wire::decode_fields(r, c.ec_data_shards, c.ec_parity_shards, c.ec_object_size);
-}
-
-// Pre-EC layout: copy = copy_index + shards only.
-bool copy_pre_ec(wire::Reader& r, CopyPlacement& c) {
-  c.ec_data_shards = c.ec_parity_shards = 0;
-  c.ec_object_size = 0;
-  c.content_crc = 0;
-  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards);
-}
-
-// The last pre-envelope config layout (12 fields, with ec geometry).
-bool config(wire::Reader& r, WorkerConfig& c) {
-  uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
-  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
-                           c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
-                           c.preferred_slice, eck, ecm))
-    return false;
-  c.replication_factor = rf;
-  c.max_workers_per_copy = mw;
-  c.min_shard_size = ms;
-  c.ec_data_shards = eck;
-  c.ec_parity_shards = ecm;
-  return true;
-}
-
-// Pre-EC config layout: 10 fields, no ec geometry.
-bool config_pre_ec(wire::Reader& r, WorkerConfig& c) {
-  uint64_t rf = 0, mw = 0, ms = 0;
-  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node,
-                           c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
-                           c.prefer_contiguous, ms, c.preferred_slice))
-    return false;
-  c.replication_factor = rf;
-  c.max_workers_per_copy = mw;
-  c.min_shard_size = ms;
-  c.ec_data_shards = c.ec_parity_shards = 0;
-  return true;
-}
-
-bool pool_record(const std::string& bytes, MemoryPool& p) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  if (!wire::decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class) ||
-      !remote(r, p.remote) || !topo(r, p.topo))
-    return false;
-  // `alignment` was a trailing optional field in the v1 layout.
-  p.alignment = 0;
-  if (!r.exhausted() && !wire::decode(r, p.alignment)) return false;
-  return true;
-}
-
-bool worker_record(const std::string& bytes, WorkerInfo& out) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  return wire::decode_fields(r, out.worker_id, out.address) && topo(r, out.topo) &&
-         wire::decode_fields(r, out.registered_at_ms, out.last_heartbeat_ms);
-}
-
-}  // namespace v1
-}  // namespace
-
-// ---- registry codecs ------------------------------------------------------
-
-std::string encode_worker_info(const WorkerInfo& info) {
-  wire::Writer w;
-  put_record_envelope(w);
-  wire::encode_fields(w, info.worker_id, info.address, info.topo, info.registered_at_ms,
-                      info.last_heartbeat_ms);
-  auto bytes = w.take();
-  return std::string(bytes.begin(), bytes.end());
-}
-
-// Current-format records tolerate trailing bytes (a newer binary may append
-// fields; an older keystone keeps decoding the prefix it knows instead of
-// dropping the record mid-rolling-upgrade); envelope-less records fall back
-// to the pinned v1 layouts.
-bool decode_worker_info(const std::string& bytes, WorkerInfo& out) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  switch (take_record_envelope(r)) {
-    case RecordEra::kLegacy:
-      return v1::worker_record(bytes, out);
-    case RecordEra::kFuture:
-      return false;  // unusable here; caller skips, never deletes
-    case RecordEra::kCurrent:
-      break;
-  }
-  return wire::decode_fields(r, out.worker_id, out.address, out.topo, out.registered_at_ms,
-                             out.last_heartbeat_ms);
-}
-
-std::string encode_pool_record(const MemoryPool& pool) {
-  wire::Writer w;
-  put_record_envelope(w);
-  wire::encode(w, pool);
-  auto bytes = w.take();
-  return std::string(bytes.begin(), bytes.end());
-}
-
-bool decode_pool_record(const std::string& bytes, MemoryPool& out) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  switch (take_record_envelope(r)) {
-    case RecordEra::kLegacy:
-      return v1::pool_record(bytes, out);
-    case RecordEra::kFuture:
-      return false;  // unusable here; caller skips, never deletes
-    case RecordEra::kCurrent:
-      break;
-  }
-  return wire::decode(r, out);
-}
-
-namespace {
-// Durable object record: everything needed to resurrect ObjectInfo +
-// allocator state after a keystone restart.
-struct ObjectRecord {
-  uint64_t size{0};
-  uint64_t ttl_ms{0};
-  bool soft_pin{false};
-  uint8_t state{0};
-  WorkerConfig config;
-  std::vector<CopyPlacement> copies;
-  int64_t created_wall_ms{0};
-  int64_t last_access_wall_ms{0};
-};
-
-std::string encode_object_record(const ObjectRecord& rec) {
-  wire::Writer w;
-  put_record_envelope(w);
-  wire::encode_fields(w, rec.size, rec.ttl_ms, rec.soft_pin, rec.state, rec.config,
-                      rec.copies, rec.created_wall_ms, rec.last_access_wall_ms);
-  auto bytes = w.take();
-  return std::string(bytes.begin(), bytes.end());
-}
-
-// Envelope-less object records: three historical layouts, newest first. The
-// copy/config decoders are shared with the registry fallbacks (v1 above);
-// which copy layout applies is what distinguishes the generations.
-template <typename CopyDecoder>
-bool decode_object_record_generation(const std::string& bytes, ObjectRecord& out,
-                                     bool config_has_ec, CopyDecoder&& copy_decoder) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
-  if (config_has_ec ? !v1::config(r, out.config) : !v1::config_pre_ec(r, out.config))
-    return false;
-  uint32_t n = 0;
-  if (!r.get(n) || n > r.remaining()) return false;
-  out.copies.clear();
-  out.copies.reserve(n);
-  for (uint32_t i = 0; i < n; ++i) {
-    CopyPlacement c;
-    if (!copy_decoder(r, c)) return false;
-    out.copies.push_back(std::move(c));
-  }
-  return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
-}
-
-bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
-  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-  switch (take_record_envelope(r)) {
-    case RecordEra::kCurrent:
-      return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
-                                 out.copies, out.created_wall_ms, out.last_access_wall_ms);
-    case RecordEra::kFuture:
-      return false;  // apply_object_record pre-screens this era; belt+braces
-    case RecordEra::kLegacy:
-      break;
-  }
-  // Newest envelope-less layout (content CRCs) first, then EC-era, then
-  // pre-EC.
-  if (decode_object_record_generation(bytes, out, true, v1::copy)) return true;
-  if (decode_object_record_generation(bytes, out, true, v1::copy_ec_era)) return true;
-  return decode_object_record_generation(bytes, out, false, v1::copy_pre_ec);
-}
-
-// Reads or writes [obj_off, obj_off+len) of one copy through its shards
-// (shared walk lives in transport::copy_range_io).
-ErrorCode copy_io(transport::TransportClient& client, const CopyPlacement& copy,
-                  uint64_t obj_off, uint8_t* buf, uint64_t len, bool is_write) {
-  return transport::copy_range_io(client, copy, obj_off, buf, len, is_write);
-}
-
-// Shard CRCs are layout-bound: after a byte-identical move (repair top-up,
-// demotion), the source's stamps remain valid for the destination only when
-// it striped identically. A different layout stays unstamped rather than
-// wrongly stamped.
-void carry_shard_crcs(const CopyPlacement& src, CopyPlacement& dst) {
-  if (src.shard_crcs.size() != src.shards.size()) return;
-  if (dst.shards.size() != src.shards.size()) return;
-  for (size_t i = 0; i < dst.shards.size(); ++i) {
-    if (dst.shards[i].length != src.shards[i].length) return;
-  }
-  dst.shard_crcs = src.shard_crcs;
-}
-
-bool all_shards_on_device(const CopyPlacement& copy) {
-  return !copy.shards.empty() &&
-         std::all_of(copy.shards.begin(), copy.shards.end(), [](const ShardPlacement& s) {
-           return std::holds_alternative<DeviceLocation>(s.location);
-         });
-}
-
-// Device-resident copy-to-copy transfer: walks both shard lists and moves
-// each overlapping segment region-to-region through the HBM provider — on a
-// TPU mesh that is the ICI path (chip-to-chip, no host staging).
-ErrorCode device_copy_object(const CopyPlacement& src, const CopyPlacement& dst,
-                             uint64_t size) {
-  size_t si = 0, di = 0;
-  uint64_t s_off = 0, d_off = 0, pos = 0;
-  while (pos < size) {
-    if (si >= src.shards.size() || di >= dst.shards.size())
-      return ErrorCode::INVALID_PARAMETERS;
-    const ShardPlacement& ss = src.shards[si];
-    const ShardPlacement& ds = dst.shards[di];
-    const auto& sl = std::get<DeviceLocation>(ss.location);
-    const auto& dl = std::get<DeviceLocation>(ds.location);
-    const uint64_t n = std::min({ss.length - s_off, ds.length - d_off, size - pos});
-    if (auto ec = storage::hbm_copy(sl.region_id, sl.offset + s_off, dl.region_id,
-                                    dl.offset + d_off, n);
-        ec != ErrorCode::OK)
-      return ec;
-    pos += n;
-    s_off += n;
-    d_off += n;
-    if (s_off == ss.length) { ++si; s_off = 0; }
-    if (d_off == ds.length) { ++di; d_off = 0; }
-  }
-  return ErrorCode::OK;
-}
-
-// Cross-process device fabric: when every overlapping (src, dst) segment
-// sits on pools that BOTH advertise a fabric endpoint (hbm_provider v4),
-// the keystone orchestrates offer+pull between the two worker processes and
-// the bytes ride the device fabric (chip fabric on TPU) — never this
-// keystone, never the staged host lane. Returns false on any miss; the
-// caller falls back (a partially fabric-moved destination is re-streamed
-// whole, which is correct if wasteful — failures here are rare).
-bool fabric_copy_object(transport::TransportClient& client, const CopyPlacement& src,
-                        const CopyPlacement& dst, uint64_t size, const alloc::PoolMap& pools) {
-  static std::atomic<uint64_t> transfer_salt{0x66616272u};  // process-unique ids
-  size_t si = 0, di = 0;
-  uint64_t s_off = 0, d_off = 0, pos = 0;
-  while (pos < size) {
-    if (si >= src.shards.size() || di >= dst.shards.size()) return false;
-    const ShardPlacement& ss = src.shards[si];
-    const ShardPlacement& ds = dst.shards[di];
-    const auto* sm = std::get_if<MemoryLocation>(&ss.location);
-    const auto* dm = std::get_if<MemoryLocation>(&ds.location);
-    if (!sm || !dm) return false;
-    auto sp = pools.find(ss.pool_id);
-    auto dp = pools.find(ds.pool_id);
-    if (sp == pools.end() || dp == pools.end()) return false;
-    const std::string& src_fabric = sp->second.fabric_addr;
-    if (src_fabric.empty() || dp->second.fabric_addr.empty()) return false;
-    // Same process (one fabric server serves all its pools): the host lane
-    // is a local memcpy there and a self-pull buys nothing.
-    if (src_fabric == dp->second.fabric_addr) return false;
-    // Bounded segments: each offer pins a staged device array on the source
-    // until pulled (or GC'd), so cap what a single failed round can strand.
-    constexpr uint64_t kFabricSeg = 32ull << 20;
-    const uint64_t n =
-        std::min({ss.length - s_off, ds.length - d_off, size - pos, kFabricSeg});
-    const uint64_t id =
-        (static_cast<uint64_t>(std::chrono::steady_clock::now().time_since_epoch().count())
-         << 16) ^
-        transfer_salt.fetch_add(1);
-    if (client.fabric_offer(ss.remote, sm->remote_addr + s_off, sm->rkey, n, id) !=
-        ErrorCode::OK)
-      return false;
-    if (client.fabric_pull(ds.remote, dm->remote_addr + d_off, dm->rkey, n, id,
-                           src_fabric) != ErrorCode::OK)
-      return false;
-    pos += n;
-    s_off += n;
-    d_off += n;
-    if (s_off == ss.length) { ++si; s_off = 0; }
-    if (d_off == ds.length) { ++di; d_off = 0; }
-  }
-  return true;
-}
-
-// Streams `size` bytes from `src` into every copy in `dsts` through a bounded
-// chunk buffer, so keystone-side data movement (repair, demotion) never
-// buffers a whole object in host memory. Fully device-resident src->dst
-// pairs skip the host entirely (ICI path), and cross-process device pools
-// with fabric endpoints move over the device fabric (when `pools` is
-// given). The source's CRC (when stamped) is verified as the bytes stream:
-// a mover must never propagate a bit-rotten copy — the caller fails over to
-// the next source instead. Device->device and fabric moves skip that check
-// (those bytes never touch the host); such destinations are reported
-// through `used_unchecked` so the caller can queue the object for scrub
-// revalidation — stamps are carried, so rot in the source would otherwise
-// ride along unchecked until a client verify or ring-walk scrub.
-ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
-                            const std::vector<CopyPlacement>& dsts, uint64_t size,
-                            const alloc::PoolMap* pools = nullptr,
-                            std::atomic<uint64_t>* fabric_moves = nullptr,
-                            bool* used_unchecked = nullptr) {
-  std::vector<const CopyPlacement*> staged;
-  if (all_shards_on_device(src)) {
-    for (const auto& dst : dsts) {
-      if (all_shards_on_device(dst) &&
-          device_copy_object(src, dst, size) == ErrorCode::OK) {
-        // Moved chip-to-chip, no host bytes — and no CRC gate either.
-        if (used_unchecked) *used_unchecked = true;
-        continue;
-      }
-      staged.push_back(&dst);
-    }
-  } else {
-    for (const auto& dst : dsts) staged.push_back(&dst);
-  }
-  if (!staged.empty() && pools) {
-    std::vector<const CopyPlacement*> rest;
-    for (const CopyPlacement* dst : staged) {
-      if (fabric_copy_object(client, src, *dst, size, *pools)) {
-        if (fabric_moves) fabric_moves->fetch_add(1);
-        if (used_unchecked) *used_unchecked = true;
-      } else {
-        rest.push_back(dst);
-      }
-    }
-    staged.swap(rest);
-  }
-  if (staged.empty()) return ErrorCode::OK;
-
-  constexpr uint64_t kChunk = 16ull << 20;
-  std::vector<uint8_t> buf(static_cast<size_t>(std::min(size, kChunk)));
-  uint32_t crc = 0;
-  for (uint64_t off = 0; off < size; off += kChunk) {
-    const uint64_t n = std::min(kChunk, size - off);
-    if (auto ec = copy_io(client, src, off, buf.data(), n, /*is_write=*/false);
-        ec != ErrorCode::OK)
-      return ec;
-    crc = crc32c(buf.data(), n, crc);
-    for (const CopyPlacement* dst : staged) {
-      if (auto ec = copy_io(client, *dst, off, buf.data(), n, /*is_write=*/true);
-          ec != ErrorCode::OK)
-        return ec;
-    }
-  }
-  if (src.content_crc != 0 && crc != src.content_crc) {
-    LOG_WARN << "mover source copy " << src.copy_index
-             << " failed crc verification; trying another source";
-    return ErrorCode::CHECKSUM_MISMATCH;
-  }
-  return ErrorCode::OK;
-}
-
-// Maps a shard placement back to (pool, offset-range) for allocator adoption.
-std::optional<std::pair<MemoryPoolId, alloc::Range>> shard_to_range(
-    const ShardPlacement& shard, const alloc::PoolMap& pools) {
-  auto it = pools.find(shard.pool_id);
-  if (it == pools.end()) return std::nullopt;
-  if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
-    if (mem->remote_addr < it->second.remote.remote_base) return std::nullopt;
-    return std::make_pair(shard.pool_id,
-                          alloc::Range{mem->remote_addr - it->second.remote.remote_base,
-                                       shard.length});
-  }
-  if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
-    return std::make_pair(shard.pool_id, alloc::Range{dev->offset, shard.length});
-  }
-  if (const auto* file = std::get_if<FileLocation>(&shard.location)) {
-    return std::make_pair(shard.pool_id, alloc::Range{file->file_offset, shard.length});
-  }
-  return std::nullopt;
-}
-
-// All-or-nothing mapping of shards onto (pool, range) pairs.
-bool append_copy_ranges(const CopyPlacement& copy, const alloc::PoolMap& pools,
-                        std::vector<std::pair<MemoryPoolId, alloc::Range>>& out) {
-  const size_t mark = out.size();
-  for (const auto& shard : copy.shards) {
-    auto mapped = shard_to_range(shard, pools);
-    if (!mapped) {
-      out.resize(mark);
-      return false;
-    }
-    out.push_back(std::move(*mapped));
-  }
-  return true;
-}
-
-std::optional<std::vector<std::pair<MemoryPoolId, alloc::Range>>> map_copies_to_ranges(
-    const std::vector<CopyPlacement>& copies, const alloc::PoolMap& pools) {
-  std::vector<std::pair<MemoryPoolId, alloc::Range>> out;
-  for (const auto& copy : copies) {
-    if (!append_copy_ranges(copy, pools, out)) return std::nullopt;
-  }
-  return out;
-}
-}  // namespace
-
 // ---- lifecycle ------------------------------------------------------------
 
 KeystoneService::KeystoneService(KeystoneConfig config,
@@ -658,239 +145,6 @@ void KeystoneService::load_existing_state() {
   load_persisted_objects();
 }
 
-ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
-  if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
-  const auto steady_now = std::chrono::steady_clock::now();
-  const int64_t wall_now = now_wall_ms();
-  auto to_wall = [&](std::chrono::steady_clock::time_point tp) {
-    return wall_now - std::chrono::duration_cast<std::chrono::milliseconds>(steady_now - tp)
-                          .count();
-  };
-  ObjectRecord rec;
-  rec.size = info.size;
-  rec.ttl_ms = info.ttl_ms;
-  rec.soft_pin = info.soft_pin;
-  rec.state = static_cast<uint8_t>(info.state);
-  rec.config = info.config;
-  rec.copies = info.copies;
-  rec.created_wall_ms = to_wall(info.created_at);
-  rec.last_access_wall_ms = to_wall(info.last_access);
-  return coord_put_record(coord::object_record_key(config_.cluster_id, key),
-                          encode_object_record(rec));
-}
-
-ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
-  if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
-  auto ec = coord_del_record(coord::object_record_key(config_.cluster_id, key));
-  return ec == ErrorCode::COORD_KEY_NOT_FOUND ? ErrorCode::OK : ec;
-}
-
-void KeystoneService::mark_persist_dirty(const ObjectKey& key) {
-  if (!coordinator_ || !config_.persist_objects) return;
-  std::lock_guard<std::mutex> lock(persist_retry_mutex_);
-  persist_retry_.insert(key);
-}
-
-void KeystoneService::retry_dirty_persists() {
-  if (!coordinator_ || !config_.persist_objects) return;
-  std::vector<ObjectKey> keys;
-  {
-    std::lock_guard<std::mutex> lock(persist_retry_mutex_);
-    if (persist_retry_.empty()) return;
-    keys.assign(persist_retry_.begin(), persist_retry_.end());
-  }
-  for (const auto& key : keys) {
-    if (!is_leader_.load()) return;  // deposed: the promoted leader owns truth
-    // The coordinator RPC runs under the shared objects lock on purpose: no
-    // mutator (unique lock) can advance the object or re-create a removed
-    // key mid-write, so the retry can never clobber a NEWER durable record
-    // with this snapshot. Rare path (persist previously failed), bounded by
-    // the coordinator RPC timeout.
-    std::shared_lock lock(objects_mutex_);
-    auto it = objects_.find(key);
-    ErrorCode ec;
-    bool caught_up = false;
-    if (it == objects_.end()) {
-      // Removed since it went dirty. The remove itself failed closed on its
-      // durable delete, so any remaining record for this key is the stale
-      // one this entry tracked — deleting it is the catch-up.
-      ec = unpersist_object(key);
-      caught_up = ec == ErrorCode::OK;
-    } else if (it->second.state != ObjectState::kComplete) {
-      // Removed AND re-created: the successful remove already deleted the
-      // stale record, and a pending object must leave no durable trace until
-      // put_complete commits — drop the entry without writing anything.
-      ec = ErrorCode::OK;
-    } else {
-      ec = persist_object(key, it->second);
-      caught_up = ec == ErrorCode::OK;
-    }
-    if (ec == ErrorCode::OK) {
-      // Erase while still holding the objects lock: mutators mark keys dirty
-      // under the unique lock, so a FRESHER dirty mark (splice + failed
-      // persist racing this loop) cannot be interleaved and wiped here.
-      std::lock_guard<std::mutex> dirty(persist_retry_mutex_);
-      persist_retry_.erase(key);
-      if (caught_up) {
-        LOG_INFO << "durable record for " << key << " caught up after deferred persist";
-      }
-    } else {
-      // One failed RPC means the coordinator is (still) unreachable or this
-      // node was fenced: stop after ONE timeout instead of paying it per
-      // dirty key — a mass drain/repair during an outage can queue
-      // thousands, and each timed-out RPC under the shared lock stalls
-      // every metadata writer for its duration.
-      return;
-    }
-  }
-}
-
-ErrorCode KeystoneService::coord_put_record(const std::string& key, const std::string& value) {
-  if (!config_.enable_ha) return coordinator_->put(key, value);
-  auto ec = coordinator_->put_fenced(key, value, election_name(), leader_epoch_.load());
-  if (ec == ErrorCode::FENCED) fence_stepdown();
-  return ec;
-}
-
-ErrorCode KeystoneService::coord_del_record(const std::string& key) {
-  if (!config_.enable_ha) return coordinator_->del(key);
-  auto ec = coordinator_->del_fenced(key, election_name(), leader_epoch_.load());
-  if (ec == ErrorCode::FENCED) fence_stepdown();
-  return ec;
-}
-
-void KeystoneService::fence_stepdown() {
-  if (is_leader_.exchange(false)) {
-    LOG_ERROR << "FENCED: this keystone's leader epoch " << leader_epoch_.load()
-              << " is stale (deposed during a stall) — stepping down; the promoted "
-                 "leader's state is untouched";
-    // The keepalive thread owns resign/re-campaign (on_demoted included via
-    // the lease-lost path's machinery); wake it now. The flags are set under
-    // stop_mutex_ so the notify cannot slip between the waiter's predicate
-    // check and its park (lost wakeup = stale node out of the election for
-    // a full refresh interval).
-    {
-      std::lock_guard<std::mutex> lock(stop_mutex_);
-      needs_recampaign_ = true;
-      recampaign_asap_ = true;
-      // on_demoted() cannot run here: the fenced op's caller holds
-      // objects_mutex_ and on_demoted takes it. The keepalive thread runs
-      // the cleanup before its next campaign step.
-      pending_demote_cleanup_ = true;
-    }
-    stop_cv_.notify_all();
-  }
-}
-
-// Replays persisted object records: rebuild metadata and re-adopt allocator
-// ranges so new allocations cannot collide with surviving placements.
-void KeystoneService::load_persisted_objects() {
-  if (!config_.persist_objects) return;
-  auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
-  if (!records.ok()) return;
-  const auto prefix = coord::objects_prefix(config_.cluster_id);
-  alloc::PoolMap pools_snapshot;
-  {
-    std::shared_lock lock(registry_mutex_);
-    pools_snapshot = pools_;
-  }
-  size_t restored = 0, dropped = 0;
-  for (const auto& kv : records.value()) {
-    if (kv.key.size() <= prefix.size()) continue;
-    const ObjectKey key = kv.key.substr(prefix.size());
-    switch (apply_object_record(key, kv.value, pools_snapshot)) {
-      case ApplyResult::kApplied:
-        ++restored;
-        break;
-      case ApplyResult::kGarbage:
-        // Undecodable records are purged; deleting garbage is idempotent and
-        // safe from any keystone (leadership is not resolved yet at boot).
-        coordinator_->del(kv.key);
-        ++dropped;
-        break;
-      case ApplyResult::kFailed:
-        // Transient (e.g. pools not yet advertised): keep the durable
-        // record — a later reconcile can still resurrect the object.
-        ++dropped;
-        break;
-    }
-  }
-  if (restored || dropped) {
-    LOG_INFO << "restored " << restored << " persisted objects (" << dropped << " dropped)";
-  }
-}
-
-KeystoneService::ApplyResult KeystoneService::apply_object_record(
-    const ObjectKey& key, const std::string& bytes, const alloc::PoolMap& pools) {
-  {
-    // A record from a bumped future format is unusable by this build but is
-    // NOT garbage: report kFailed so callers keep the durable record (a
-    // newer keystone will serve it) instead of deleting object metadata.
-    wire::Reader probe(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
-    if (take_record_envelope(probe) == RecordEra::kFuture) return ApplyResult::kFailed;
-  }
-  ObjectRecord rec;
-  if (!decode_object_record(bytes, rec)) return ApplyResult::kGarbage;
-  // Keep only copies whose every shard still maps onto a live pool.
-  std::vector<CopyPlacement> live_copies;
-  std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
-  for (const auto& copy : rec.copies) {
-    if (append_copy_ranges(copy, pools, ranges)) live_copies.push_back(copy);
-  }
-  if (live_copies.empty()) return ApplyResult::kFailed;
-
-  std::unique_lock lock(objects_mutex_);
-  std::optional<ObjectInfo> previous;
-  if (auto it = objects_.find(key); it != objects_.end()) {
-    // Replace semantics: the record wins. The old ranges must be freed
-    // before adopting the new ones (records usually reuse most of them).
-    previous = std::move(it->second);
-    adapter_.free_object(key);
-    objects_.erase(it);
-  }
-  if (adapter_.adopt_allocation(key, ranges, pools) != ErrorCode::OK) {
-    // Put the previous (still valid) state back rather than silently
-    // destroying a serveable object over a transient adoption failure.
-    if (previous) {
-      auto old_ranges = map_copies_to_ranges(previous->copies, pools);
-      if (old_ranges &&
-          adapter_.adopt_allocation(key, *old_ranges, pools) == ErrorCode::OK) {
-        objects_[key] = std::move(*previous);
-      } else {
-        LOG_ERROR << "object " << key << " lost during record re-apply";
-        bump_view();
-      }
-    }
-    return ApplyResult::kFailed;
-  }
-  const auto steady_now = std::chrono::steady_clock::now();
-  const int64_t wall_now = now_wall_ms();
-  ObjectInfo info;
-  info.size = rec.size;
-  info.ttl_ms = rec.ttl_ms;
-  info.soft_pin = rec.soft_pin;
-  info.state = static_cast<ObjectState>(rec.state);
-  info.config = rec.config;
-  info.copies = std::move(live_copies);
-  auto from_wall = [&](int64_t wall_ms) {
-    return steady_now - std::chrono::milliseconds(std::max<int64_t>(0, wall_now - wall_ms));
-  };
-  info.created_at = from_wall(rec.created_wall_ms);
-  info.last_access = from_wall(rec.last_access_wall_ms);
-  info.epoch = next_epoch_.fetch_add(1);
-  objects_[key] = std::move(info);
-  bump_view();
-  return ApplyResult::kApplied;
-}
-
-void KeystoneService::drop_object_locally(const ObjectKey& key) {
-  std::unique_lock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end()) return;
-  adapter_.free_object(key);
-  objects_.erase(it);
-  bump_view();
-}
 
 // Standby -> leader: the promoted keystone re-reads every persisted record so
 // writes that raced the promotion are not lost, and drops local entries whose
@@ -1146,243 +400,6 @@ void KeystoneService::run_gc_once() {
   }
 }
 
-// ---- background scrub ------------------------------------------------------
-//
-// Server-side integrity floor: round-robin over the object map, verified-
-// reading every writer-stamped shard against its CRC32C and healing what it
-// can — replicated shards byte-identically from a healthy copy, coded shards
-// through parity reconstruction (repair_ec_object already treats a corrupt
-// shard as a repair target). This is what makes raw (verify=false) client
-// reads an honest latency trade: the fleet still converges on intact bytes.
-// The reference has no integrity machinery at all.
-void KeystoneService::queue_scrub_target(const ObjectKey& key) {
-  // No scrub thread (interval 0) or no pass budget: nothing will ever drain
-  // the queue, so don't grow it. Movers call this from metadata critical
-  // sections — hence the O(1) set insert, not a scan.
-  if (config_.scrub_interval_sec <= 0 || config_.scrub_objects_per_pass == 0) return;
-  std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
-  scrub_targets_.insert(key);
-}
-
-size_t KeystoneService::run_scrub_once() {
-  if (!is_leader_.load() || config_.scrub_objects_per_pass == 0) return 0;
-  struct Target {
-    ObjectKey key;
-    uint64_t epoch{0};
-    std::vector<CopyPlacement> copies;
-  };
-  std::vector<Target> batch;
-  // Queued targets (fabric-moved objects whose stamps were carried without a
-  // byte check) verify ahead of the ring walk, on top of the pass budget.
-  std::vector<ObjectKey> priority;
-  {
-    std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
-    priority.assign(scrub_targets_.begin(), scrub_targets_.end());
-    scrub_targets_.clear();
-  }
-  {
-    std::shared_lock lock(objects_mutex_);
-    std::unordered_set<std::string_view> taken_keys;
-    for (const auto& key : priority) {
-      auto it = objects_.find(key);
-      if (it != objects_.end() && it->second.state == ObjectState::kComplete &&
-          taken_keys.insert(it->first).second)
-        batch.push_back({key, it->second.epoch, it->second.copies});
-    }
-    std::vector<const ObjectKey*> keys;
-    keys.reserve(objects_.size());
-    for (const auto& [k, info] : objects_) {
-      if (info.state == ObjectState::kComplete) keys.push_back(&k);
-    }
-    std::sort(keys.begin(), keys.end(),
-              [](const ObjectKey* a, const ObjectKey* b) { return *a < *b; });
-    if (!keys.empty()) {
-      // The smallest keys strictly after the cursor, wrapping — a ring walk.
-      // Keys already taken as priority targets are visited (the cursor must
-      // advance past them) but not scrubbed twice in one pass.
-      auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_,
-                                    [](const ObjectKey& c, const ObjectKey* k) { return c < *k; });
-      const ObjectKey* last_visited = nullptr;
-      for (size_t taken = 0; taken < config_.scrub_objects_per_pass &&
-                             taken < keys.size();
-           ++taken) {
-        if (start == keys.end()) start = keys.begin();
-        last_visited = *start;
-        if (!taken_keys.contains(**start)) {
-          const auto& info = objects_.at(**start);
-          batch.push_back({**start, info.epoch, info.copies});
-        }
-        ++start;
-      }
-      if (last_visited) scrub_cursor_ = *last_visited;
-    }
-  }
-  if (batch.empty()) return 0;
-
-  const alloc::PoolMap target_pools = allocatable_pools_snapshot();
-  constexpr uint64_t kSeg = 4ull << 20;  // bounded scrub memory
-  std::vector<uint8_t> buf;
-  // One segmented read-and-CRC walk shared by every verify/heal path; the
-  // reader fills buf with segment [off, off+n).
-  auto segmented_crc = [&](uint64_t len, auto&& reader) -> std::optional<uint32_t> {
-    uint32_t crc = 0;
-    for (uint64_t off = 0; off < len; off += kSeg) {
-      const uint64_t n = std::min(kSeg, len - off);
-      buf.resize(n);
-      if (!reader(off, n)) return std::nullopt;
-      crc = crc32c(buf.data(), n, crc);
-    }
-    return crc;
-  };
-  size_t corrupt_found = 0;
-  for (const auto& t : batch) {
-    if (!is_leader_.load()) break;
-    ++counters_.scrub_checked;
-    // Coded object: CRC every stamped shard; corrupt ones become repair
-    // targets for parity reconstruction (onto FRESH placements — never an
-    // in-place write through a snapshot).
-    if (!t.copies.empty() && t.copies.front().ec_data_shards > 0) {
-      const CopyPlacement& copy = t.copies.front();
-      // Unstamped coded = a put that never stamped (nothing to verify
-      // against). No mover can strip a coded copy's stamps: every mover
-      // preserves coded geometry 1:1 (drain rejects fragmented staging,
-      // demote/repair require exact positions), so stamps always carry.
-      if (copy.shard_crcs.size() != copy.shards.size()) continue;
-      std::vector<size_t> corrupt;
-      for (size_t i = 0; i < copy.shards.size(); ++i) {
-        const auto crc = segmented_crc(copy.shards[i].length, [&](uint64_t off, uint64_t n) {
-          return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
-                                     /*is_write=*/false) == ErrorCode::OK;
-        });
-        if (crc && *crc != copy.shard_crcs[i]) corrupt.push_back(i);
-      }
-      if (!corrupt.empty()) {
-        corrupt_found += corrupt.size();
-        counters_.scrub_corrupt += corrupt.size();
-        for (size_t i : corrupt) {
-          LOG_WARN << "scrub: corrupt coded shard " << i << " of " << t.key << " (pool "
-                   << copy.shards[i].pool_id << ", worker " << copy.shards[i].worker_id
-                   << "); reconstructing through parity";
-        }
-        if (repair_ec_object(t.key, t.epoch, copy, corrupt, target_pools)) {
-          counters_.scrub_healed += corrupt.size();
-        }
-      }
-      continue;
-    }
-    // Replicated/striped object: per-copy shard CRCs; a corrupt shard is
-    // restored byte-identically from a sibling copy (shard boundaries
-    // differ per copy, so the heal reads the logical BYTE RANGE through
-    // copy_range_io). The heal is ONE pass per sibling: read a sibling
-    // segment, write it over the corrupt shard, accumulate the CRC; only a
-    // final CRC matching the stamp counts as healed — the destination was
-    // already corrupt, so intermediate wrong bytes cost nothing. Every
-    // segment's WRITE runs under a shared objects lock with the epoch
-    // re-checked (the sibling read stays lock-free), so a concurrent
-    // mover/remove (unique lock + epoch bump) can never let the write land
-    // on a freed, reallocated range.
-    for (size_t ci = 0; ci < t.copies.size(); ++ci) {
-      const CopyPlacement& copy = t.copies[ci];
-      if (copy.shard_crcs.size() != copy.shards.size()) {
-        // Unstamped — a 1:n drain splice cleared the stamps, or the mover's
-        // geometry prevented carrying them — but the whole-copy CRC still
-        // travels with every verified put. Verify the copy end to end so
-        // fabric/device-moved bytes cannot escape revalidation just because
-        // per-shard stamps could not carry; heal is whole-copy from a
-        // sibling under the same epoch-guarded write discipline.
-        if (copy.content_crc == 0) continue;
-        uint64_t total = 0;
-        for (const auto& s : copy.shards) total += s.length;
-        const auto crc = segmented_crc(total, [&](uint64_t off, uint64_t n) {
-          return transport::copy_range_io(*data_client_, copy, off, buf.data(), n,
-                                          /*is_write=*/false) == ErrorCode::OK;
-        });
-        if (!crc || *crc == copy.content_crc) continue;
-        ++corrupt_found;
-        ++counters_.scrub_corrupt;
-        LOG_WARN << "scrub: corrupt unstamped copy " << ci << " of " << t.key
-                 << "; healing whole-copy from a sibling";
-        bool healed = false;
-        bool stale = false;
-        for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
-          if (sj == ci) continue;
-          const auto src_crc = segmented_crc(total, [&](uint64_t off, uint64_t n) {
-            if (transport::copy_range_io(*data_client_, t.copies[sj], off, buf.data(), n,
-                                         /*is_write=*/false) != ErrorCode::OK)
-              return false;
-            std::shared_lock lock(objects_mutex_);
-            auto it = objects_.find(t.key);
-            if (it == objects_.end() || it->second.epoch != t.epoch) {
-              stale = true;
-              return false;
-            }
-            return transport::copy_range_io(*data_client_, copy, off, buf.data(), n,
-                                            /*is_write=*/true) == ErrorCode::OK;
-          });
-          healed = src_crc && *src_crc == copy.content_crc;
-        }
-        if (healed) {
-          ++counters_.scrub_healed;
-          LOG_INFO << "scrub: healed unstamped copy " << ci << " of " << t.key;
-        } else if (!stale) {
-          LOG_WARN << "scrub: no intact sibling for unstamped copy " << ci << " of "
-                   << t.key << " — detect-only";
-        }
-        continue;
-      }
-      uint64_t shard_off = 0;
-      for (size_t i = 0; i < copy.shards.size(); ++i) {
-        const uint64_t len = copy.shards[i].length;
-        const auto crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
-          return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
-                                     /*is_write=*/false) == ErrorCode::OK;
-        });
-        if (crc && *crc != copy.shard_crcs[i]) {
-          ++corrupt_found;
-          ++counters_.scrub_corrupt;
-          LOG_WARN << "scrub: corrupt shard " << i << " of " << t.key << " copy " << ci
-                   << " (pool " << copy.shards[i].pool_id << ", worker "
-                   << copy.shards[i].worker_id << "); healing from a sibling copy";
-          bool healed = false;
-          bool stale = false;
-          for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
-            if (sj == ci) continue;
-            const auto src_crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
-              // The sibling read runs lock-free so a hung source worker never
-              // stalls metadata writers behind objects_mutex_; a read off a
-              // concurrently freed range yields garbage, which the epoch
-              // re-check below (or the final CRC gate) discards.
-              if (transport::copy_range_io(*data_client_, t.copies[sj], shard_off + off,
-                                           buf.data(), n,
-                                           /*is_write=*/false) != ErrorCode::OK)
-                return false;
-              std::shared_lock lock(objects_mutex_);
-              auto it = objects_.find(t.key);
-              if (it == objects_.end() || it->second.epoch != t.epoch) {
-                stale = true;
-                return false;
-              }
-              return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
-                                         /*is_write=*/true) == ErrorCode::OK;
-            });
-            healed = src_crc && *src_crc == copy.shard_crcs[i];
-          }
-          if (healed) {
-            ++counters_.scrub_healed;
-            LOG_INFO << "scrub: healed shard " << i << " of " << t.key << " copy " << ci;
-          } else if (!stale) {
-            LOG_WARN << "scrub: no intact sibling for shard " << i << " of " << t.key
-                     << " copy " << ci << " — detect-only (replica failover still "
-                        "serves reads from other copies)";
-          }
-        }
-        shard_off += len;
-      }
-    }
-  }
-  return corrupt_found;
-}
-
 void KeystoneService::run_health_check_once() {
   if (!is_leader_.load()) return;  // the leader owns eviction/demotion/repair
   retry_dirty_persists();
@@ -1404,21 +421,6 @@ void KeystoneService::run_health_check_once() {
     }
   }
   evict_for_pressure();
-}
-
-// Own thread (like GC): a pass does real network I/O, and running it inline
-// on the health thread would stall failure detection and eviction for the
-// pass duration.
-void KeystoneService::scrub_loop() {
-  std::unique_lock<std::mutex> lock(stop_mutex_);
-  while (running_) {
-    stop_cv_.wait_for(lock, std::chrono::seconds(config_.scrub_interval_sec),
-                      [this] { return !running_.load(); });
-    if (!running_) break;
-    lock.unlock();
-    run_scrub_once();
-    lock.lock();
-  }
 }
 
 // ---- object API -----------------------------------------------------------
@@ -1824,194 +826,6 @@ ErrorCode KeystoneService::register_worker(const WorkerInfo& worker) {
   return ErrorCode::OK;
 }
 
-// The dead worker's backing files came back: spared objects' placements
-// still name the pool with the OLD base address and rkey. Re-carve their
-// ranges into the fresh pool allocator, rewrite placements onto the new
-// advertisement, and re-validate stamped shards by CRC — a stale or
-// replaced backing file must surface as loss, not as silent wrong bytes.
-void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
-  if (!is_leader_.load()) return;  // keep the entry: a promoted leader adopts
-  MemoryPool old;
-  {
-    std::unique_lock lock(registry_mutex_);
-    auto it = offline_pools_.find(pool.id);
-    if (it == offline_pools_.end()) return;
-    old = it->second;
-    offline_pools_.erase(it);
-  }
-  const uint64_t old_base = old.remote.remote_base;
-  const uint64_t new_base = pool.remote.remote_base;
-  uint64_t new_rkey = 0;
-  try {
-    new_rkey = std::stoull(pool.remote.rkey_hex, nullptr, 16);
-  } catch (...) {
-    LOG_ERROR << "re-adoption of pool " << pool.id << ": unparseable rkey";
-    return;
-  }
-
-  // Pass 1 (unique objects lock; metadata only, no network): per object,
-  // CARVE FIRST, rewrite placements only if the carve landed — an object
-  // whose ranges cannot be re-reserved must never be published onto the new
-  // base, or a fresh allocation could overwrite its served bytes.
-  size_t adopted = 0;
-  std::vector<ReadoptCheck> checks;
-  // One-timeout discipline (mirrors retry_dirty_persists): this loop runs on
-  // the coordinator watch thread under the unique objects lock — if the
-  // coordinator is down, the FIRST failed persist proves it, and every
-  // remaining object goes straight to the dirty queue instead of paying a
-  // full RPC timeout each while all metadata operations stall behind us.
-  bool persist_down = false;
-  {
-    std::unique_lock lock(objects_mutex_);
-    for (auto it = objects_.begin(); it != objects_.end();) {
-      auto& [key, info] = *it;
-      struct Hit {
-        CopyPlacement* copy;
-        size_t index;
-        uint64_t offset;
-      };
-      std::vector<Hit> hits;
-      std::vector<alloc::Range> ranges;
-      bool skip_object = false;
-      for (auto& copy : info.copies) {
-        for (size_t i = 0; i < copy.shards.size(); ++i) {
-          ShardPlacement& shard = copy.shards[i];
-          if (shard.pool_id != pool.id) continue;
-          auto* mem = std::get_if<MemoryLocation>(&shard.location);
-          if (!mem || mem->remote_addr < old_base ||
-              mem->remote_addr - old_base + shard.length > pool.size) {
-            skip_object = true;  // unmappable (shrunk/alien pool): stay offline
-            break;
-          }
-          hits.push_back({&copy, i, mem->remote_addr - old_base});
-          ranges.push_back({mem->remote_addr - old_base, shard.length});
-        }
-        if (skip_object) break;
-      }
-      if (hits.empty() || skip_object) {
-        ++it;
-        continue;
-      }
-      if (adapter_.readopt_pool_ranges(pool, ranges) != ErrorCode::OK) {
-        // Cannot re-reserve (overlapping stale metadata): the object must
-        // not serve from unreserved ranges — drop it, fence-first.
-        LOG_ERROR << "re-adoption carve failed for " << key << " on pool " << pool.id
-                  << "; dropping the object";
-        if (unpersist_object(key) == ErrorCode::OK) {
-          free_object_locked(key, info);
-          it = objects_.erase(it);
-          ++counters_.objects_lost;
-        } else {
-          ++it;  // stays offline (old placements); a later pass may retry
-        }
-        continue;
-      }
-      for (const Hit& hit : hits) {
-        ShardPlacement& shard = hit.copy->shards[hit.index];
-        auto& mem = std::get<MemoryLocation>(shard.location);
-        mem.remote_addr = new_base + hit.offset;
-        mem.rkey = new_rkey;
-        shard.remote = pool.remote;
-        shard.worker_id = pool.node_id;
-      }
-      info.epoch = next_epoch_.fetch_add(1);
-      for (const Hit& hit : hits) {
-        if (hit.copy->shard_crcs.size() == hit.copy->shards.size()) {
-          checks.push_back(
-              {key, hit.copy->shards[hit.index], hit.copy->shard_crcs[hit.index]});
-        }
-      }
-      if (persist_down) {
-        mark_persist_dirty(key);
-      } else if (persist_object(key, info) != ErrorCode::OK) {
-        persist_down = true;
-        mark_persist_dirty(key);
-      }
-      ++adopted;
-      ++counters_.objects_adopted;
-      ++it;
-    }
-  }
-  if (adopted) {
-    bump_view();
-    LOG_INFO << "pool " << pool.id << " re-adopted: " << adopted
-             << " offline objects refreshed onto the restarted worker";
-  }
-  if (!checks.empty()) {
-    // Revalidation reads real bytes over the network — queued for the
-    // health loop instead of running inline here: register_memory_pool is
-    // reached from the coordinator watch thread, which must not stall on
-    // streaming a multi-GB pool. Until the checks run, reads are guarded by
-    // the client-side verify default (stale bytes fail their CRC).
-    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
-    readopt_checks_.insert(readopt_checks_.end(),
-                           std::make_move_iterator(checks.begin()),
-                           std::make_move_iterator(checks.end()));
-  }
-}
-
-// Health-loop leg of re-adoption: verify stamped re-adopted shards through
-// the NEW endpoint. The backing file may be stale or replaced — a CRC miss
-// demotes the object to the loss path it was spared from (epoch-guarded
-// against racers); a failed durable delete re-queues the check.
-void KeystoneService::run_readopt_checks() {
-  std::vector<ReadoptCheck> checks;
-  {
-    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
-    checks.swap(readopt_checks_);
-  }
-  if (checks.empty()) return;
-  constexpr uint64_t kSeg = 4ull << 20;
-  std::vector<uint8_t> buf;
-  for (const auto& check : checks) {
-    uint32_t crc = 0;
-    bool io_ok = true;
-    for (uint64_t off = 0; off < check.shard.length && io_ok; off += kSeg) {
-      const uint64_t n = std::min(kSeg, check.shard.length - off);
-      buf.resize(n);
-      io_ok = transport::shard_io(*data_client_, check.shard, off, buf.data(), n,
-                                  /*is_write=*/false) == ErrorCode::OK;
-      if (io_ok) crc = crc32c(buf.data(), n, crc);
-    }
-    if (io_ok && crc == check.expect) continue;
-    LOG_WARN << "re-adopted shard of " << check.key << " failed revalidation ("
-             << (io_ok ? "crc mismatch: stale/replaced backing file" : "unreadable")
-             << "); dropping the object";
-    std::unique_lock lock(objects_mutex_);
-    auto it = objects_.find(check.key);
-    // The check condemns only the exact shard it was queued for: same
-    // placement AND same stamp. An epoch comparison would be both too strict
-    // (a second offline pool's adoption of the same object bumps the epoch
-    // without touching this shard — the revalidation must still run) and
-    // too loose once dropped (a re-put or repair may have landed fresh
-    // bytes at the same address, which this stale expectation must not
-    // drop).
-    if (it == objects_.end()) continue;
-    const bool still_applies = [&] {
-      for (const auto& copy : it->second.copies) {
-        if (copy.shard_crcs.size() != copy.shards.size()) continue;
-        for (size_t i = 0; i < copy.shards.size(); ++i) {
-          if (copy.shards[i] == check.shard && copy.shard_crcs[i] == check.expect)
-            return true;
-        }
-      }
-      return false;
-    }();
-    if (!still_applies) continue;
-    if (unpersist_object(check.key) != ErrorCode::OK) {
-      // Fence-first failed (outage): the corrupt object must not quietly
-      // keep serving — re-queue so the next health tick retries the drop.
-      lock.unlock();
-      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
-      readopt_checks_.push_back(check);
-      continue;
-    }
-    free_object_locked(check.key, it->second);
-    objects_.erase(it);
-    ++counters_.objects_lost;
-    bump_view();
-  }
-}
 
 ErrorCode KeystoneService::register_memory_pool(const MemoryPool& pool) {
   if (pool.id.empty() || pool.size == 0) return ErrorCode::INVALID_MEMORY_POOL;
@@ -2038,288 +852,6 @@ alloc::PoolMap KeystoneService::allocatable_pools_snapshot() const {
     if (!draining_.contains(pool.node_id)) out.emplace(id, pool);
   }
   return out;
-}
-
-Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
-  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  // Drains are rare, operator-triggered, and share staging bookkeeping —
-  // serialize them per service instead of reasoning about interleavings.
-  std::lock_guard<std::mutex> drain_lock(drain_mutex_);
-  {
-    std::unique_lock lock(registry_mutex_);
-    if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
-    draining_.insert(worker_id);
-  }
-  LOG_INFO << "draining worker " << worker_id;
-
-  // Idle pooled slots (put_start_pooled) with any shard on the draining
-  // worker are cancelled outright: they have no writer attached, clients
-  // transparently fall back / refill elsewhere, and leaving them would pin
-  // the worker until the slot TTL. A slot whose commit is racing this
-  // cancel commits as OBJECT_NOT_FOUND and the client re-puts normally.
-  {
-    std::unique_lock lock(objects_mutex_);
-    for (auto it = objects_.begin(); it != objects_.end();) {
-      bool on_worker = false;
-      if (it->second.slot) {
-        for (const auto& copy : it->second.copies) {
-          for (const auto& shard : copy.shards) {
-            if (shard.worker_id == worker_id) on_worker = true;
-          }
-        }
-      }
-      if (!on_worker) {
-        ++it;
-        continue;
-      }
-      slot_objects_.fetch_sub(1);
-      free_object_locked(it->first, it->second);
-      it = objects_.erase(it);
-      ++counters_.put_cancels;
-    }
-    bump_view();
-  }
-
-  // One migration unit per SHARD on the draining worker (not per copy):
-  // bytes already correct on surviving workers are never re-streamed, which
-  // matters inside a preemption grace window.
-  struct Move {
-    ObjectKey key;
-    uint64_t epoch{0};
-    size_t copy_index{0};
-    size_t shard_index{0};
-    ShardPlacement shard;        // the victim shard (still readable)
-    WorkerConfig config;
-    std::vector<NodeId> other_workers;
-  };
-  auto scan_moves = [&](bool& pending_touches) {
-    std::vector<Move> moves;
-    pending_touches = false;
-    std::shared_lock lock(objects_mutex_);
-    for (const auto& [key, info] : objects_) {
-      for (size_t ci = 0; ci < info.copies.size(); ++ci) {
-        for (size_t si = 0; si < info.copies[ci].shards.size(); ++si) {
-          const ShardPlacement& sh = info.copies[ci].shards[si];
-          if (sh.worker_id != worker_id) continue;
-          if (info.state != ObjectState::kComplete) {
-            // In-flight put placed before the draining flag: it completes
-            // (or cancels) shortly; a later round migrates it.
-            pending_touches = true;
-            continue;
-          }
-          Move m{key, info.epoch, ci, si, sh, info.config, {}};
-          for (size_t cj = 0; cj < info.copies.size(); ++cj) {
-            if (cj == ci) continue;
-            for (const auto& other : info.copies[cj].shards)
-              m.other_workers.push_back(other.worker_id);
-          }
-          if (info.copies[ci].ec_data_shards > 0) {
-            // Coded copy: the SIBLING shards are the failure domains the
-            // "any m worker losses" contract counts — never stack the
-            // migrated shard behind one of them.
-            for (size_t sj = 0; sj < info.copies[ci].shards.size(); ++sj) {
-              if (sj != si)
-                m.other_workers.push_back(info.copies[ci].shards[sj].worker_id);
-            }
-          }
-          moves.push_back(std::move(m));
-        }
-      }
-    }
-    return moves;
-  };
-
-  // Rounds: migrate what is complete, wait out in-flight puts, re-scan.
-  // The loop ends only when NOTHING references the worker (a straggler put
-  // that lands late is picked up by a later round) or when a round makes no
-  // progress (capacity/transport trouble: give up, keep the worker
-  // registered and excluded so the drain can be retried).
-  uint64_t total_moved = 0;
-  bool clean = false;
-  for (int round = 0; round < 60; ++round) {
-    // Leadership can move during a minutes-long drain; a deposed keystone
-    // must stop mutating placements immediately — and must not keep the
-    // worker invisibly excluded on THIS instance (the new leader owns the
-    // drain now; the operator retries against it).
-    if (!is_leader_.load()) {
-      counters_.shards_drained.fetch_add(total_moved);
-      std::unique_lock lock(registry_mutex_);
-      draining_.erase(worker_id);
-      return ErrorCode::NOT_LEADER;
-    }
-    // Re-snapshot targets each round: workers registering mid-drain add
-    // capacity, workers dying mid-drain stop being selected. The full pool
-    // map is hoisted per round too — stream_shard consults it per shard for
-    // the fabric lane.
-    const alloc::PoolMap targets = allocatable_pools_snapshot();
-    const alloc::PoolMap all_pools = memory_pools();
-    bool pending_touches = false;
-    auto moves = scan_moves(pending_touches);
-    if (moves.empty() && !pending_touches) {
-      clean = true;
-      break;
-    }
-    if (moves.empty()) {  // only pendings: give them time to land
-      std::this_thread::sleep_for(std::chrono::milliseconds(100));
-      continue;
-    }
-
-    uint64_t moved = 0;
-    std::unordered_map<ObjectKey, uint64_t> epoch_now;  // tracks our own swaps
-    for (auto& m : moves) {
-      const ObjectKey staging_key = m.key + "\x01" "drain:" + worker_id;
-      WorkerConfig shard_cfg = m.config;
-      shard_cfg.replication_factor = 1;
-      shard_cfg.max_workers_per_copy = 1;  // one shard in, one shard out
-      // Shard-level move, even for coded objects: the staged allocation is
-      // one plain shard (the splice keeps its position in the geometry).
-      const bool coded = m.config.ec_parity_shards > 0;
-      shard_cfg.ec_data_shards = 0;
-      shard_cfg.ec_parity_shards = 0;
-      alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
-          staging_key, m.shard.length, shard_cfg);
-      // Keep the shard in its tier (a drain is not a demotion); placement
-      // may still spill classes if the tier has no room elsewhere — but a
-      // coded shard may only spill within WIRE tiers (a device-tier shard
-      // would make the whole object unreadable to the coded client path).
-      req.preferred_classes = {m.shard.storage_class};
-      req.wire_only = coded;
-      req.excluded_nodes = m.other_workers;
-      auto attempt = adapter_.allocator().allocate(req, targets);
-      if (!attempt.ok()) {
-        req.excluded_nodes.clear();
-        attempt = adapter_.allocator().allocate(req, targets);
-      }
-      if (!attempt.ok()) continue;
-      std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
-      // A coded shard must re-land as exactly ONE range: the coded client
-      // read path requires shards.size() == k+m (client.cpp), so a 1:n
-      // splice would leave the object unreadable (and clear the stamps the
-      // scrub needs). A fragmented pool just defers this shard's move.
-      if (coded && staged[0].shards.size() != 1) {
-        adapter_.free_object(staging_key);
-        continue;
-      }
-
-      // Stream straight from the victim shard — alive, unlike crash repair.
-      bool used_unchecked = false;
-      if (stream_shard(m.shard, staged[0], all_pools, &used_unchecked) != ErrorCode::OK) {
-        adapter_.free_object(staging_key);
-        continue;
-      }
-
-      std::unique_lock lock(objects_mutex_);
-      auto it = objects_.find(m.key);
-      const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
-      if (it == objects_.end() || it->second.epoch != expect ||
-          m.copy_index >= it->second.copies.size() ||
-          m.shard_index >= it->second.copies[m.copy_index].shards.size() ||
-          // Our own earlier splice in this copy may have shifted indices
-          // (a staged allocation can insert several shards): the shard at
-          // this index must still BE the scanned victim, or releasing it
-          // would free a healthy live range. Mismatches retry via re-scan.
-          !(it->second.copies[m.copy_index].shards[m.shard_index] == m.shard)) {
-        lock.unlock();
-        adapter_.free_object(staging_key);
-        continue;  // object changed underneath the move; the re-scan retries
-      }
-      if (adapter_.allocator().merge_objects(staging_key, m.key) != ErrorCode::OK) {
-        lock.unlock();
-        adapter_.free_object(staging_key);
-        continue;
-      }
-      // Release the evacuated shard's range and splice the replacement in
-      // (the staged allocation may itself be several ranges).
-      auto& shards = it->second.copies[m.copy_index].shards;
-      if (auto pr = shard_to_range(shards[m.shard_index], memory_pools())) {
-        adapter_.allocator().release_range(m.key, pr->first, pr->second);
-      }
-      // Shard CRCs: a 1:1 splice moves identical bytes, so the stamp at this
-      // index stays valid untouched. A 1:n splice changes the shard layout —
-      // the stamps no longer line up, so the copy degrades to unstamped
-      // (empty) rather than carrying stamps attributed to the wrong shards.
-      if (staged[0].shards.size() != 1)
-        it->second.copies[m.copy_index].shard_crcs.clear();
-      shards.erase(shards.begin() + static_cast<ptrdiff_t>(m.shard_index));
-      shards.insert(shards.begin() + static_cast<ptrdiff_t>(m.shard_index),
-                    staged[0].shards.begin(), staged[0].shards.end());
-      it->second.epoch = next_epoch_.fetch_add(1);
-      epoch_now[m.key] = it->second.epoch;
-      // Fabric-drained bytes skipped the staged lane's CRC gate: scrub them.
-      if (used_unchecked) queue_scrub_target(m.key);
-      if (persist_object(m.key, it->second) != ErrorCode::OK) {
-        // Splice landed in memory; the health loop re-persists.
-        mark_persist_dirty(m.key);
-      }
-      bump_view();
-      ++moved;
-    }
-    total_moved += moved;
-    if (moved == 0 && !pending_touches) break;  // no progress: stop retrying
-  }
-
-  if (!clean) {
-    // Keep the worker registered AND still marked draining (no new data
-    // lands on it); the operator retries after fixing capacity/transport.
-    // If the worker dies first, cleanup_dead_worker clears the flag.
-    counters_.shards_drained.fetch_add(total_moved);
-    LOG_WARN << "drain of " << worker_id << " incomplete after " << total_moved
-             << " migrated shards";
-    return ErrorCode::WORKER_DRAIN_INCOMPLETE;
-  }
-
-  // Nothing references the worker anymore: retire it for real. The draining
-  // flag drops only AFTER retirement, so no allocation window reopens.
-  cleanup_dead_worker(worker_id);
-  {
-    std::unique_lock lock(registry_mutex_);
-    draining_.erase(worker_id);
-  }
-  counters_.shards_drained.fetch_add(total_moved);
-  LOG_INFO << "drained worker " << worker_id << ": " << total_moved << " shards migrated";
-  return total_moved;
-}
-
-// Streams one live shard's bytes into a freshly staged placement, device
-// fast path included (chip-to-chip, no host staging, when both ends are
-// device-resident).
-ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
-                                        const alloc::PoolMap& pools, bool* used_unchecked) {
-  const auto* src_dev = std::get_if<DeviceLocation>(&src.location);
-  if (src_dev && dst.shards.size() == 1) {
-    if (const auto* dst_dev = std::get_if<DeviceLocation>(&dst.shards[0].location)) {
-      auto ec = storage::hbm_copy(src_dev->region_id, src_dev->offset, dst_dev->region_id,
-                                  dst_dev->offset, src.length);
-      // Chip-to-chip, no host bytes and no CRC gate: report for scrub.
-      if (ec == ErrorCode::OK && used_unchecked) *used_unchecked = true;
-      return ec;
-    }
-  }
-  {
-    // Cross-process device pools: ride the fabric (drain is the preemption
-    // path — moving device bytes without the host lane is the whole point).
-    CopyPlacement src_copy;
-    src_copy.shards.push_back(src);
-    if (fabric_copy_object(*data_client_, src_copy, dst, src.length, pools)) {
-      counters_.fabric_moves.fetch_add(1);
-      if (used_unchecked) *used_unchecked = true;
-      return ErrorCode::OK;
-    }
-  }
-  constexpr uint64_t kChunk = 16ull << 20;
-  std::vector<uint8_t> buf(static_cast<size_t>(std::min<uint64_t>(src.length, kChunk)));
-  for (uint64_t off = 0; off < src.length; off += kChunk) {
-    const uint64_t n = std::min(kChunk, src.length - off);
-    if (auto ec = transport::shard_io(*data_client_, src, off, buf.data(), n,
-                                      /*is_write=*/false);
-        ec != ErrorCode::OK)
-      return ec;
-    if (auto ec = transport::copy_range_io(*data_client_, dst, off, buf.data(), n,
-                                           /*is_write=*/true);
-        ec != ErrorCode::OK)
-      return ec;
-  }
-  return ErrorCode::OK;
 }
 
 ErrorCode KeystoneService::remove_worker(const NodeId& worker_id) {
@@ -2396,1039 +928,6 @@ void KeystoneService::on_heartbeat_event(const WatchEvent& ev) {
   }
 }
 
-// ---- failure handling -----------------------------------------------------
 
-void KeystoneService::cleanup_stale_workers() {
-  const int64_t now = now_wall_ms();
-  const int64_t ttl = config_.worker_heartbeat_ttl_sec * 1000;
-  std::vector<NodeId> stale;
-  {
-    std::shared_lock lock(registry_mutex_);
-    for (const auto& [id, info] : workers_) {
-      if (info.is_stale(now, ttl)) stale.push_back(id);
-    }
-  }
-  for (const auto& id : stale) {
-    LOG_WARN << "worker " << id << " is stale, cleaning up";
-    cleanup_dead_worker(id);
-  }
-}
-
-void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
-  std::vector<MemoryPoolId> dead_pools;
-  {
-    std::unique_lock lock(registry_mutex_);
-    // A worker that dies mid-drain (or after a failed drain) must not leave
-    // its id in draining_ forever — a replacement re-registering under the
-    // same id would be silently unallocatable.
-    draining_.erase(worker_id);
-    if (!workers_.erase(worker_id)) return;  // already handled
-    for (auto it = pools_.begin(); it != pools_.end();) {
-      if (it->second.node_id == worker_id) {
-        dead_pools.push_back(it->first);
-        // Persistent tiers (mmap/io_uring backing files) keep their bytes
-        // across the process: remember the pool's last advertisement so a
-        // restarted worker's re-registration can re-adopt instead of
-        // re-replicating (readopt_offline_pool).
-        if (storage_class_is_persistent(it->second.storage_class)) {
-          offline_pools_[it->first] = it->second;
-        }
-        it = pools_.erase(it);
-      } else {
-        ++it;
-      }
-    }
-  }
-  for (const auto& pool_id : dead_pools) adapter_.forget_pool(pool_id);
-  ++counters_.workers_lost;
-
-  // Registry-local cleanup runs on every keystone (each one watches the
-  // heartbeat prefix); coordinator-state deletion and repair are the
-  // leader's job — a standby mutating either would race the leader.
-  if (coordinator_ && is_leader_.load()) {
-    coord_del_record(coord::worker_key(config_.cluster_id, worker_id));
-    for (const auto& pool_id : dead_pools)
-      coord_del_record(coord::pool_key(config_.cluster_id, worker_id, pool_id));
-    coord_del_record(coord::heartbeat_key(config_.cluster_id, worker_id));
-  }
-  bump_view();
-  LOG_WARN << "worker " << worker_id << " removed (" << dead_pools.size() << " pools)";
-
-  if (config_.enable_repair && is_leader_.load()) {
-    const size_t repaired = repair_objects_for_dead_worker(worker_id);
-    if (repaired) {
-      LOG_INFO << "repaired " << repaired << " objects after losing " << worker_id;
-    }
-  }
-}
-
-// Rebuilds every object that had placements on `worker_id` from a surviving
-// replica over the data plane. The reference has no equivalent — placements
-// dangle after worker death (SURVEY §3.5) — but TPU-VM preemption makes
-// repair mandatory (SURVEY §7 hard parts).
-size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) {
-  // Full registry view for range release (draining workers' ranges must
-  // still map back correctly); ALLOCATION targets exclude draining workers.
-  alloc::PoolMap live_pools;
-  {
-    std::shared_lock lock(registry_mutex_);
-    live_pools = pools_;
-  }
-  const alloc::PoolMap target_pools = allocatable_pools_snapshot();
-
-  // Pass 1 — metadata only, under the lock: prune dead placements so clients
-  // stop dialing the dead worker immediately, drop objects that lost every
-  // copy, and queue the rest for re-replication. No data moves here, so the
-  // lock hold is bounded by map size, not object bytes.
-  struct PendingRepair {
-    ObjectKey key;
-    uint64_t size{0};
-    uint64_t epoch{0};
-    size_t needed{0};
-    WorkerConfig config;
-    std::vector<CopyPlacement> surviving;
-  };
-  struct PendingEcRepair {
-    ObjectKey key;
-    uint64_t epoch{0};
-    CopyPlacement copy;  // snapshot, dead shards still listed at their indices
-    std::vector<size_t> dead_idx;
-    WorkerConfig config;
-  };
-  std::vector<PendingEcRepair> ec_pending;
-  // Live-worker snapshot for EC recoverability counting (a coded object may
-  // already carry shards lost to EARLIER deaths; tolerance is cumulative).
-  std::unordered_set<NodeId> live_workers;
-  {
-    std::shared_lock lock(registry_mutex_);
-    for (const auto& [id, w] : workers_) {
-      if (id != worker_id) live_workers.insert(id);
-    }
-  }
-
-  std::vector<PendingRepair> pending;
-  // Any durable write that fails mid-pass defers the rest of this worker's
-  // repair to the health loop (repair_retry_): the death event fires once,
-  // so without the retry a transient coordinator outage would strand
-  // objects with dead placements forever.
-  bool deferred = false;
-  {
-    std::unique_lock lock(objects_mutex_);
-    for (auto it = objects_.begin(); it != objects_.end();) {
-      if (!is_leader_.load()) {  // deposed mid-pass: stop issuing doomed RPCs
-        deferred = true;
-        break;
-      }
-      ObjectInfo& info = it->second;
-      auto damaged = [&](const CopyPlacement& copy) {
-        return std::any_of(copy.shards.begin(), copy.shards.end(),
-                           [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
-      };
-
-      // Pooled put slots touching the dead worker are simply cancelled: no
-      // writer is attached, so there is nothing to repair, spare, or count
-      // as lost — the owning client's commit misses and falls back.
-      if (info.slot && std::any_of(info.copies.begin(), info.copies.end(), damaged)) {
-        const ObjectKey key = it->first;
-        for (const auto& copy : info.copies) {
-          for (const auto& shard : copy.shards) {
-            if (shard.worker_id == worker_id)
-              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
-          }
-        }
-        slot_objects_.fetch_sub(1);
-        free_object_locked(key, info);
-        it = objects_.erase(it);
-        ++counters_.put_cancels;
-        bump_view();
-        continue;
-      }
-
-      // Erasure-coded objects have ONE copy whose shard ORDER is the code
-      // geometry — the copy is never dropped whole. Dead shards stay listed
-      // (clients fail reading them and reconstruct from any k survivors:
-      // degraded-but-readable); only past the parity tolerance is the
-      // object gone. Dead-worker range bookkeeping is released either way.
-      if (!info.copies.empty() && info.copies.front().ec_data_shards > 0) {
-        CopyPlacement& copy = info.copies.front();
-        if (!damaged(copy)) {
-          ++it;
-          continue;
-        }
-        const ObjectKey key = it->first;
-        size_t dead = 0;
-        for (const auto& shard : copy.shards) {
-          if (!live_workers.contains(shard.worker_id)) ++dead;
-        }
-        auto drop_dead_worker_bookkeeping = [&] {
-          for (const auto& shard : copy.shards) {
-            if (shard.worker_id == worker_id)
-              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
-          }
-        };
-        if (dead > copy.ec_parity_shards) {
-          // Same persistent-tier exception as the replicated loss branch.
-          bool adoptable = true;
-          {
-            std::shared_lock rlock(registry_mutex_);
-            for (const auto& shard : copy.shards) {
-              if (live_workers.contains(shard.worker_id)) continue;
-              if (!offline_pools_.contains(shard.pool_id)) {
-                adoptable = false;
-                break;
-              }
-            }
-          }
-          if (adoptable) {
-            ++counters_.objects_offline;
-            LOG_WARN << "coded object " << key << " OFFLINE past tolerance with worker "
-                     << worker_id << ": bytes persist on file-backed pools — kept for "
-                        "re-adoption at restart";
-            ++it;
-            continue;
-          }
-          LOG_WARN << "coded object " << key << " lost " << dead << " shards (tolerance "
-                   << copy.ec_parity_shards << ") with worker " << worker_id;
-          // Fence-first: a deposed leader must not free the survivors'
-          // ranges; the promoted leader owns the loss accounting.
-          if (unpersist_object(key) != ErrorCode::OK) {
-            deferred = true;
-            ++it;
-            continue;
-          }
-          drop_dead_worker_bookkeeping();
-          adapter_.free_object(key);
-          it = objects_.erase(it);
-          ++counters_.objects_lost;
-          bump_view();
-          continue;
-        }
-        // Persist the bumped epoch BEFORE touching allocator state: a
-        // rejected durable write (deposed leader / coordinator outage)
-        // leaves the object exactly as the durable record describes it.
-        const uint64_t prev_epoch = info.epoch;
-        info.epoch = next_epoch_.fetch_add(1);
-        if (persist_object(key, info) != ErrorCode::OK) {
-          info.epoch = prev_epoch;
-          deferred = true;
-          ++it;
-          continue;
-        }
-        drop_dead_worker_bookkeeping();
-        bump_view();
-        if (info.state == ObjectState::kComplete) {
-          // Queue reconstruction of EVERY dead shard (including ones from
-          // earlier deaths): without healing, losses accumulate until the
-          // tolerance is exceeded and a recoverable object dies.
-          std::vector<size_t> dead_idx;
-          for (size_t si = 0; si < copy.shards.size(); ++si) {
-            if (!live_workers.contains(copy.shards[si].worker_id)) dead_idx.push_back(si);
-          }
-          ec_pending.push_back({key, info.epoch, copy, std::move(dead_idx), info.config});
-        }
-        ++it;
-        continue;
-      }
-      std::vector<CopyPlacement> surviving;
-      bool any_damaged = false;
-      for (const auto& copy : info.copies) {
-        if (damaged(copy)) {
-          any_damaged = true;
-        } else {
-          surviving.push_back(copy);
-        }
-      }
-      if (!any_damaged) {
-        ++it;
-        continue;
-      }
-      const ObjectKey key = it->first;
-      if (surviving.empty()) {
-        // Persistent-tier exception: a copy whose every dead shard sits on
-        // an OFFLINE PERSISTENT pool (mmap/io_uring backing file — the
-        // bytes outlive the process) is kept intact, placements and
-        // durable record untouched, and re-validated + refreshed when the
-        // restarted worker re-registers the pool (readopt_offline_pool).
-        // The reference's disk bytes also survive restarts
-        // (iouring_disk_backend.cpp:419-438) but its keystone forgets the
-        // metadata; here neither side forgets.
-        bool adoptable = false;
-        {
-          std::shared_lock rlock(registry_mutex_);
-          for (const auto& copy : info.copies) {
-            bool ok = !copy.shards.empty();
-            for (const auto& shard : copy.shards) {
-              if (live_workers.contains(shard.worker_id)) continue;
-              if (!offline_pools_.contains(shard.pool_id)) {
-                ok = false;
-                break;
-              }
-            }
-            if (ok) {
-              adoptable = true;
-              break;
-            }
-          }
-        }
-        if (adoptable) {
-          ++counters_.objects_offline;
-          LOG_WARN << "object " << key << " OFFLINE with worker " << worker_id
-                   << ": bytes persist on its file-backed pools — kept for "
-                      "re-adoption at restart, not re-replicated";
-          ++it;
-          continue;
-        }
-        LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
-        // Fence-first, as in the coded branch above.
-        if (unpersist_object(key) != ErrorCode::OK) {
-          deferred = true;
-          ++it;
-          continue;
-        }
-        // Dead-worker shards lose only their bookkeeping (a later free of
-        // ranges on a re-registered pool would corrupt the fresh free-map).
-        for (const auto& copy : info.copies) {
-          for (const auto& shard : copy.shards) {
-            if (shard.worker_id == worker_id)
-              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
-          }
-        }
-        adapter_.free_object(key);
-        it = objects_.erase(it);
-        ++counters_.objects_lost;
-        bump_view();
-        continue;
-      }
-      // Make the pruned state durable BEFORE releasing any ranges: if the
-      // durable write is rejected (deposed leader / coordinator outage),
-      // this node must not hand ranges the durable record — and therefore
-      // the promoted leader — still maps back to the pools.
-      ObjectInfo updated = info;
-      updated.copies = surviving;
-      for (size_t i = 0; i < updated.copies.size(); ++i) updated.copies[i].copy_index = i;
-      updated.epoch = next_epoch_.fetch_add(1);
-      if (persist_object(key, updated) != ErrorCode::OK) {
-        deferred = true;
-        ++it;
-        continue;
-      }
-      // Every damaged copy is dropped whole, so release all its ranges now:
-      // dead-worker shards lose only their bookkeeping (see above), while
-      // live-worker shards of a partially-damaged striped copy hand their
-      // bytes back to the pool — otherwise worker churn slowly fills the
-      // surviving pools with orphaned, unreadable ranges.
-      for (const auto& copy : info.copies) {
-        if (!damaged(copy)) continue;
-        for (const auto& shard : copy.shards) {
-          if (shard.worker_id == worker_id) {
-            adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
-          } else if (auto pr = shard_to_range(shard, live_pools)) {
-            adapter_.allocator().release_range(key, pr->first, pr->second);
-          }
-        }
-      }
-      info = std::move(updated);
-      const size_t needed = info.config.replication_factor > surviving.size()
-                                ? info.config.replication_factor - surviving.size()
-                                : 0;
-      bump_view();
-      if (needed > 0 && info.state == ObjectState::kComplete) {
-        pending.push_back(
-            {key, info.size, info.epoch, needed, info.config, std::move(surviving)});
-      }
-      ++it;
-    }
-  }
-
-  // Pass 2 — no metadata lock while bytes move: stage the top-up copies
-  // under a temporary allocator key, stream from a survivor, then merge the
-  // staging allocation into the object atomically iff its epoch is unchanged.
-  size_t repaired = 0;
-  for (auto& p : pending) {
-    if (!is_leader_.load()) {  // deposed mid-repair: stop streaming
-      deferred = true;
-      break;
-    }
-    const ObjectKey staging_key = p.key + "\x01" "repair";
-    alloc::AllocationRequest req =
-        alloc::KeystoneAllocatorAdapter::to_allocation_request(staging_key, p.size, p.config);
-    req.replication_factor = p.needed;
-    // Anti-affinity: a repaired copy must not land behind a failure domain
-    // that already holds a survivor; relax only if the cluster is too small.
-    for (const auto& copy : p.surviving) {
-      for (const auto& shard : copy.shards) {
-        if (std::find(req.excluded_nodes.begin(), req.excluded_nodes.end(),
-                      shard.worker_id) == req.excluded_nodes.end())
-          req.excluded_nodes.push_back(shard.worker_id);
-      }
-    }
-    auto attempt = adapter_.allocator().allocate(req, target_pools);
-    if (!attempt.ok()) {
-      req.excluded_nodes.clear();
-      attempt = adapter_.allocator().allocate(req, target_pools);
-    }
-    if (!attempt.ok()) {
-      // No room to re-replicate: the object stays degraded on its survivors
-      // (pass 1 already pruned the dead placements) — never deleted.
-      LOG_WARN << "repair of " << p.key << " degraded to " << p.surviving.size()
-               << " copies: " << to_string(attempt.error());
-      continue;
-    }
-    std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
-
-    const CopyPlacement* streamed_src = nullptr;
-    bool used_unchecked = false;
-    for (const auto& src : p.surviving) {
-      // live_pools: the full registry snapshot from the top of the pass —
-      // the fabric lane needs fabric_addr for BOTH ends' pools.
-      used_unchecked = false;
-      if (copy_object_bytes(*data_client_, src, staged, p.size, &live_pools,
-                            &counters_.fabric_moves, &used_unchecked) == ErrorCode::OK) {
-        streamed_src = &src;
-        break;
-      }
-    }
-    if (!streamed_src) {
-      adapter_.free_object(staging_key);
-      deferred = true;  // survivors still serve reads; health loop retries
-      continue;
-    }
-
-    std::unique_lock lock(objects_mutex_);
-    auto it = objects_.find(p.key);
-    if (it == objects_.end() || it->second.epoch != p.epoch) {
-      lock.unlock();
-      adapter_.free_object(staging_key);
-      continue;  // object changed while the bytes moved; its new state wins
-    }
-    if (adapter_.allocator().merge_objects(staging_key, p.key) != ErrorCode::OK) {
-      lock.unlock();
-      LOG_ERROR << "repair merge failed for " << p.key;
-      adapter_.free_object(staging_key);
-      deferred = true;
-      continue;
-    }
-    for (auto& copy : staged) {
-      copy.copy_index = it->second.copies.size();
-      copy.content_crc = it->second.copies.empty()
-                             ? 0
-                             : it->second.copies.front().content_crc;
-      carry_shard_crcs(*streamed_src, copy);
-      it->second.copies.push_back(std::move(copy));
-    }
-    it->second.epoch = next_epoch_.fetch_add(1);
-    // Fabric- and chip-to-chip-moved bytes bypassed the staged lane's
-    // streaming CRC gate but carry the source's stamps: have the scrub
-    // verify them ahead of its ring walk (and heal from a sibling if the
-    // source was rotten).
-    if (used_unchecked) queue_scrub_target(p.key);
-    if (auto ec = persist_object(p.key, it->second); ec != ErrorCode::OK) {
-      // The merge already landed locally (memory + allocator are consistent)
-      // but the durable record is stale. A coordinator outage heals at this
-      // key's next successful persist; a fence means this node is deposed
-      // and the promoted leader's reconcile-on-promotion owns the truth.
-      // Either way the repair cannot be claimed. The splice is irreversible
-      // in memory, so queue the key for the health loop's re-persist — a
-      // healthy object is never revisited by repair, so nothing else would
-      // ever write the record again.
-      LOG_ERROR << "repair of " << p.key << " not durably recorded: " << to_string(ec);
-      mark_persist_dirty(p.key);
-      bump_view();
-      deferred = true;
-      continue;
-    }
-    ++counters_.objects_repaired;
-    ++repaired;
-    bump_view();
-  }
-
-  // Pass 2b — erasure-coded objects: reconstruct every dead shard from any
-  // k survivors (segmented, bounded memory) onto fresh placements and
-  // splice them in at their geometry positions. Without this, coded
-  // objects never heal — losses accumulate across deaths until tolerance
-  // is exceeded and a recoverable object dies.
-  for (auto& r : ec_pending) {
-    if (!is_leader_.load()) {  // deposed mid-repair: stop streaming
-      deferred = true;
-      break;
-    }
-    if (repair_ec_object(r.key, r.epoch, r.copy, r.dead_idx, target_pools)) {
-      ++counters_.objects_repaired;
-      ++repaired;
-    }
-  }
-  {
-    std::lock_guard<std::mutex> lock(repair_retry_mutex_);
-    if (deferred) {
-      repair_retry_.insert(worker_id);
-    } else {
-      repair_retry_.erase(worker_id);
-    }
-  }
-  return repaired;
-}
-
-// Rebuilds the dead shards of one coded copy. Returns true when the object
-// was fully healed (every dead shard reconstructed and spliced).
-//
-// When the copy carries per-shard CRC stamps, every shard read during
-// reconstruction is screened against its stamp. A live-but-rotten shard
-// must never serve as a reconstruction basis (the rebuild would be garbage,
-// restamped as valid — turning recoverable rot into permanent loss);
-// instead it is promoted to a repair target itself, so repair heals silent
-// corruption in the same pass that heals worker death.
-bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
-                                       const CopyPlacement& copy,
-                                       const std::vector<size_t>& dead_idx,
-                                       const alloc::PoolMap& target_pools) {
-  if (dead_idx.empty()) return false;
-  const size_t k = copy.ec_data_shards;
-  const size_t m = copy.ec_parity_shards;
-  const size_t n = copy.shards.size();
-  if (k == 0 || n != k + m) return false;
-  const uint64_t L = copy.shards.front().length;
-  const bool stamped = copy.shard_crcs.size() == n;
-
-  // Repair targets: the caller's dead shards, plus any live shard a CRC
-  // screen condemns below (each retry may extend this list).
-  std::vector<size_t> targets = dead_idx;
-  const std::vector<size_t> original_dead = dead_idx;
-
-  struct Staged {
-    std::string staging_key;
-    CopyPlacement placement;
-  };
-  std::vector<Staged> staged;
-  auto free_all_staged = [&] {
-    for (auto& st : staged) adapter_.free_object(st.staging_key);
-    staged.clear();
-  };
-  std::vector<uint32_t> rebuilt_crcs;
-
-  // Each attempt either completes the segmented reconstruction with a clean
-  // basis, or condemns at least one more shard (bounded by tolerance m).
-  for (;;) {
-    std::vector<bool> dead(n, false);
-    for (size_t d : targets) dead[d] = true;
-
-    // 1. Fresh placements, one plain wire shard per target index;
-    // anti-affine with every worker the copy still touches (and earlier
-    // replacements).
-    std::vector<NodeId> excluded;
-    for (size_t i = 0; i < n; ++i) {
-      if (!dead[i]) excluded.push_back(copy.shards[i].worker_id);
-    }
-    staged.assign(targets.size(), {});
-    bool staged_ok = true;
-    for (size_t j = 0; j < targets.size() && staged_ok; ++j) {
-      const size_t d = targets[j];
-      WorkerConfig cfg = {};
-      cfg.replication_factor = 1;
-      cfg.max_workers_per_copy = 1;
-      staged[j].staging_key = key + "\x01" "ecrepair" + std::to_string(d);
-      alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
-          staged[j].staging_key, L, cfg);
-      // Stay in a wire tier (a device shard would be unreadable to the coded
-      // client path, even on the relaxed retry); same class as the lost
-      // shard when possible.
-      req.wire_only = true;
-      req.preferred_classes = {copy.shards[d].storage_class};
-      req.excluded_nodes = excluded;
-      auto attempt = adapter_.allocator().allocate(req, target_pools);
-      if (!attempt.ok()) {
-        req.excluded_nodes.clear();
-        attempt = adapter_.allocator().allocate(req, target_pools);
-      }
-      // The coded geometry needs exactly ONE shard at this position.
-      if (!attempt.ok() || attempt.value().copies[0].shards.size() != 1 ||
-          std::holds_alternative<DeviceLocation>(
-              attempt.value().copies[0].shards[0].location)) {
-        if (attempt.ok()) adapter_.free_object(staged[j].staging_key);
-        staged.resize(j);
-        staged_ok = false;
-        LOG_WARN << "ec repair of " << key << " stays degraded: no placement for shard "
-                 << d;
-        break;
-      }
-      staged[j].placement = std::move(attempt).value().copies[0];
-      excluded.push_back(staged[j].placement.shards[0].worker_id);
-    }
-    if (!staged_ok) {
-      free_all_staged();
-      return false;
-    }
-
-    // 2. Segmented reconstruction: read each segment from k survivors,
-    // rebuild missing data rows, re-encode missing parity rows, write out.
-    constexpr uint64_t kSeg = 8ull << 20;
-    std::vector<size_t> basis;  // the k survivors we read (data first)
-    for (size_t i = 0; i < n && basis.size() < k; ++i) {
-      if (!dead[i]) basis.push_back(i);
-    }
-    if (basis.size() < k) {
-      free_all_staged();
-      return false;  // beyond tolerance (pass 1 should have caught this)
-    }
-    bool parity_dead = false;
-    for (size_t d : targets) parity_dead |= d >= k;
-
-    std::vector<std::vector<uint8_t>> seg_bufs(n);  // read/rebuilt segments
-    const uint64_t seg_cap = std::min<uint64_t>(L, kSeg);
-    for (size_t i : basis) seg_bufs[i].resize(seg_cap);
-    for (size_t d : targets) seg_bufs[d].resize(seg_cap);
-    // Parity re-encode needs every data row; data rows outside the basis and
-    // not dead can stay empty unless parity is being rebuilt.
-    if (parity_dead) {
-      for (size_t i = 0; i < k; ++i) seg_bufs[i].resize(seg_cap);
-    }
-    std::vector<std::vector<uint8_t>> parity_rows;
-    if (parity_dead) parity_rows.assign(m, std::vector<uint8_t>(seg_cap));
-    rebuilt_crcs.assign(targets.size(), 0);
-    // Incremental CRC per shard we read, for the basis screen.
-    std::vector<uint32_t> read_crcs(n, 0);
-    std::vector<bool> was_read(n, false);
-
-    bool io_failed = false;
-    for (uint64_t off = 0; off < L && !io_failed; off += kSeg) {
-      const uint64_t seg = std::min(kSeg, L - off);
-      std::vector<const uint8_t*> present(n, nullptr);
-      for (size_t i : basis) {
-        if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(), seg,
-                                /*is_write=*/false) != ErrorCode::OK) {
-          LOG_WARN << "ec repair of " << key << " stays degraded: survivor " << i
-                   << " unreadable";
-          io_failed = true;
-          break;
-        }
-        read_crcs[i] = crc32c(seg_bufs[i].data(), seg, read_crcs[i]);
-        was_read[i] = true;
-        present[i] = seg_bufs[i].data();
-      }
-      if (io_failed) break;
-      // Data rows needed for parity re-encode but outside the basis (only
-      // possible when they are alive: read them too).
-      if (parity_dead) {
-        for (size_t i = 0; i < k; ++i) {
-          if (present[i] || dead[i]) continue;
-          if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(),
-                                  seg,
-                                  /*is_write=*/false) != ErrorCode::OK) {
-            io_failed = true;
-            break;
-          }
-          read_crcs[i] = crc32c(seg_bufs[i].data(), seg, read_crcs[i]);
-          was_read[i] = true;
-          present[i] = seg_bufs[i].data();
-        }
-        if (io_failed) break;
-      }
-      std::vector<uint8_t*> out(k, nullptr);
-      for (size_t d : targets) {
-        if (d < k) out[d] = seg_bufs[d].data();
-      }
-      if (!ec::rs_reconstruct(present.data(), k, m, seg, out.data())) {
-        io_failed = true;
-        break;
-      }
-      if (parity_dead) {
-        std::vector<const uint8_t*> data_rows(k);
-        for (size_t i = 0; i < k; ++i) data_rows[i] = seg_bufs[i].data();
-        std::vector<uint8_t*> parity_ptrs(m);
-        for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity_rows[j].data();
-        if (!ec::rs_encode(data_rows.data(), k, parity_ptrs.data(), m, seg)) {
-          io_failed = true;
-          break;
-        }
-      }
-      for (size_t j = 0; j < targets.size(); ++j) {
-        const size_t d = targets[j];
-        const uint8_t* src = d < k ? seg_bufs[d].data() : parity_rows[d - k].data();
-        if (transport::shard_io(*data_client_, staged[j].placement.shards[0], off,
-                                const_cast<uint8_t*>(src), seg,
-                                /*is_write=*/true) != ErrorCode::OK) {
-          io_failed = true;
-          break;
-        }
-        // Restamp as we write: segments stream in order, so the incremental
-        // CRC over them IS the rebuilt shard's CRC32C.
-        rebuilt_crcs[j] = crc32c(src, seg, rebuilt_crcs[j]);
-      }
-    }
-    if (io_failed) {
-      free_all_staged();
-      return false;
-    }
-
-    // 3. The basis screen: a source shard whose bytes fail its stamp fed
-    // garbage into the reconstruction — condemn it, drop this attempt's
-    // staging, and retry with the rotten shard as a repair target too.
-    if (stamped) {
-      std::vector<size_t> condemned;
-      for (size_t i = 0; i < n; ++i) {
-        if (was_read[i] && read_crcs[i] != copy.shard_crcs[i]) condemned.push_back(i);
-      }
-      if (!condemned.empty()) {
-        for (size_t c : condemned) {
-          LOG_WARN << "ec repair of " << key << ": live shard " << c
-                   << " failed its CRC stamp (pool " << copy.shards[c].pool_id
-                   << ", worker " << copy.shards[c].worker_id
-                   << ") — promoting to repair target";
-          targets.push_back(c);
-        }
-        free_all_staged();
-        if (targets.size() > m) {
-          LOG_WARN << "ec repair of " << key << " stays degraded: " << targets.size()
-                   << " dead+rotten shards exceed tolerance m=" << m;
-          return false;
-        }
-        continue;  // retry with a clean basis
-      }
-    }
-    break;  // reconstruction complete with a verified basis
-  }
-
-  // 4. Splice under the lock iff the object didn't change underneath us.
-  std::unique_lock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end() || it->second.epoch != epoch ||
-      it->second.copies.empty() || it->second.copies.front().shards.size() != n) {
-    lock.unlock();
-    free_all_staged();
-    return false;
-  }
-  for (const auto& st : staged) {
-    if (adapter_.allocator().merge_objects(st.staging_key, key) != ErrorCode::OK) {
-      lock.unlock();
-      LOG_ERROR << "ec repair merge failed for " << key;
-      // Staged keys not yet merged are freed; merged ranges now belong to
-      // the object and are released when it is removed.
-      free_all_staged();
-      return false;
-    }
-  }
-  for (size_t j = 0; j < targets.size(); ++j) {
-    const size_t d = targets[j];
-    // Dead shards' range bookkeeping was already dropped in pass 1 — but a
-    // shard promoted here (live, rotten) still holds its range: release it,
-    // or the pool leaks the space forever.
-    if (std::find(original_dead.begin(), original_dead.end(), d) == original_dead.end()) {
-      if (auto pr = shard_to_range(it->second.copies.front().shards[d], memory_pools())) {
-        adapter_.allocator().release_range(key, pr->first, pr->second);
-      }
-    }
-    // Entries are replaced in place, preserving the geometry order.
-    it->second.copies.front().shards[d] = staged[j].placement.shards[0];
-    if (it->second.copies.front().shard_crcs.size() == n)
-      it->second.copies.front().shard_crcs[d] = rebuilt_crcs[j];
-  }
-  it->second.epoch = next_epoch_.fetch_add(1);
-  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
-    // Same discipline as the replicated merge path: the splice already landed
-    // locally (memory + allocator are consistent) but the durable record is
-    // stale — a promoted leader would still map the condemned shard
-    // locations. The repair cannot be claimed (scrub_healed stays honest),
-    // and because the now-healthy object will never be revisited by repair,
-    // the key is queued for the health loop's re-persist.
-    LOG_ERROR << "ec repair of " << key << " not durably recorded: " << to_string(ec);
-    mark_persist_dirty(key);
-    bump_view();
-    return false;
-  }
-  bump_view();
-  LOG_INFO << "ec repair rebuilt " << targets.size() << " shard(s) of " << key;
-  return true;
-}
-
-// ---- eviction -------------------------------------------------------------
-
-double KeystoneService::tier_utilization(std::optional<StorageClass> cls) const {
-  uint64_t capacity = 0;
-  {
-    std::shared_lock lock(registry_mutex_);
-    for (const auto& [id, pool] : pools_) {
-      if (!cls || pool.storage_class == *cls) capacity += pool.size;
-    }
-  }
-  if (capacity == 0) return 0.0;
-  // Allocated bytes, NOT capacity - free: pool allocators materialize
-  // lazily, so an untouched pool reports no free bytes and capacity-free
-  // would misread a near-empty tier as full (observed: spurious "eviction
-  // pressure ... util 1" on a fresh HBM pool, with the health loop then
-  // evicting live objects mid-benchmark).
-  auto stats = adapter_.allocator().get_stats(cls);
-  uint64_t used = 0;
-  if (cls) {
-    auto it = stats.allocated_per_class.find(*cls);
-    used = it == stats.allocated_per_class.end() ? 0 : it->second;
-  } else {
-    used = stats.total_allocated_bytes;
-  }
-  return static_cast<double>(used) / static_cast<double>(capacity);
-}
-
-void KeystoneService::evict_for_pressure() {
-  // Determine which tiers are over the watermark.
-  std::vector<std::optional<StorageClass>> scopes;
-  if (config_.tier_aware_eviction) {
-    std::vector<StorageClass> classes;
-    {
-      std::shared_lock lock(registry_mutex_);
-      for (const auto& [id, pool] : pools_) {
-        if (std::find(classes.begin(), classes.end(), pool.storage_class) == classes.end())
-          classes.push_back(pool.storage_class);
-      }
-    }
-    // Fastest tier first: demotions out of a hot tier land in lower tiers,
-    // and those are evaluated later in the same pass so they can shed the
-    // cascade immediately instead of waiting a full health interval.
-    std::sort(classes.begin(), classes.end(),
-              [](StorageClass a, StorageClass b) { return tier_rank(a) < tier_rank(b); });
-    for (auto c : classes) scopes.emplace_back(c);
-  } else {
-    scopes.emplace_back(std::nullopt);
-  }
-
-  for (const auto& scope : scopes) {
-    if (tier_utilization(scope) < config_.high_watermark) continue;
-    const double target = config_.high_watermark * (1.0 - config_.eviction_ratio);
-    LOG_WARN << "eviction pressure on tier "
-             << (scope ? storage_class_name(*scope) : "all") << " (util "
-             << tier_utilization(scope) << " >= " << config_.high_watermark << ")";
-
-    // LRU order over evictable objects in this scope.
-    std::vector<std::pair<std::chrono::steady_clock::time_point, ObjectKey>> candidates;
-    {
-      std::shared_lock lock(objects_mutex_);
-      for (const auto& [key, info] : objects_) {
-        if (info.soft_pin || info.state != ObjectState::kComplete) continue;
-        if (scope) {
-          bool touches_tier = false;
-          for (const auto& copy : info.copies) {
-            for (const auto& shard : copy.shards) {
-              if (shard.storage_class == *scope) touches_tier = true;
-            }
-          }
-          if (!touches_tier) continue;
-        }
-        candidates.emplace_back(info.last_access, key);
-      }
-    }
-    std::sort(candidates.begin(), candidates.end());
-
-    for (const auto& [ts, key] : candidates) {
-      if (tier_utilization(scope) <= target) break;
-      if (scope && config_.enable_tier_demotion) {
-        const DemoteOutcome outcome = demote_object(key, *scope);
-        if (outcome == DemoteOutcome::kDemoted) {
-          ++counters_.objects_demoted;
-          LOG_INFO << "demoted object " << key << " out of tier "
-                   << storage_class_name(*scope);
-          continue;
-        }
-        if (outcome == DemoteOutcome::kSkipped) continue;
-      }
-      std::unique_lock lock(objects_mutex_);
-      auto it = objects_.find(key);
-      if (it == objects_.end()) continue;
-      // Fence-first (see gc): never free ranges a promoted leader still maps.
-      if (unpersist_object(key) != ErrorCode::OK) continue;
-      free_object_locked(key, it->second);
-      objects_.erase(it);
-      ++counters_.evicted;
-      bump_view();
-      LOG_INFO << "evicted object " << key << " for tier pressure";
-    }
-  }
-}
-
-KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& key,
-                                                              StorageClass from) {
-  // Demotion never places new bytes onto a draining worker.
-  const alloc::PoolMap live_pools = allocatable_pools_snapshot();
-
-  // Lower tiers that actually have pools, nearest first. The ladder stops at
-  // HDD: CUSTOM/unspecified pools are application-owned, never a backstop.
-  std::vector<StorageClass> ladder;
-  for (const auto& [id, pool] : live_pools) {
-    const int rank = tier_rank(pool.storage_class);
-    if (rank <= tier_rank(from) || rank > tier_rank(StorageClass::HDD)) continue;
-    if (std::find(ladder.begin(), ladder.end(), pool.storage_class) == ladder.end())
-      ladder.push_back(pool.storage_class);
-  }
-  if (ladder.empty()) return DemoteOutcome::kFailed;
-  std::sort(ladder.begin(), ladder.end(),
-            [](StorageClass a, StorageClass b) { return tier_rank(a) < tier_rank(b); });
-
-  // Snapshot the object, then move bytes with NO metadata lock held — a
-  // multi-hundred-MB transfer must not stall every put_start/get_workers.
-  uint64_t size = 0;
-  uint64_t epoch_snap = 0;
-  WorkerConfig config;
-  std::vector<CopyPlacement> old_copies;
-  {
-    std::shared_lock lock(objects_mutex_);
-    auto it = objects_.find(key);
-    if (it == objects_.end() || it->second.state != ObjectState::kComplete)
-      return DemoteOutcome::kSkipped;
-    size = it->second.size;
-    epoch_snap = it->second.epoch;
-    config = it->second.config;
-    old_copies = it->second.copies;
-  }
-  // Demotion moves whole objects. Only objects fully resident in the
-  // pressured tier qualify — re-placing a mixed-tier object would drag its
-  // healthy faster-tier replicas down the ladder too. Mixed objects keep
-  // delete-eviction semantics (the caller's fallback).
-  for (const auto& copy : old_copies) {
-    for (const auto& shard : copy.shards) {
-      if (shard.storage_class != from) return DemoteOutcome::kFailed;
-    }
-  }
-  const bool coded = !old_copies.empty() && old_copies.front().ec_data_shards > 0;
-
-  // Stage the replacement under a temporary allocator key; the old ranges
-  // stay live the whole time, so concurrent readers are never broken.
-  const ObjectKey staging_key = key + "\x01" "demote";
-  alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
-      staging_key, size, config);
-  req.restrict_to_preferred = true;
-  // The object is leaving its tier regardless; a node pin (often a node that
-  // only hosts the hot tier) must not veto the move — without this, pinned
-  // objects could never demote and would always fall through to deletion.
-  req.preferred_node.clear();
-  Result<std::vector<CopyPlacement>> placed = ErrorCode::INSUFFICIENT_SPACE;
-  for (StorageClass target_class : ladder) {
-    req.preferred_classes = {target_class};
-    auto attempt = adapter_.allocator().allocate(req, live_pools);
-    if (attempt.ok()) {
-      placed = std::move(attempt).value().copies;
-      break;
-    }
-  }
-  if (!placed.ok()) return DemoteOutcome::kFailed;
-
-  // Stream from the first readable copy into the staged placements.
-  // DeviceLocation shards are readable here by construction: workers only
-  // advertise TransportKind::HBM descriptors (which yield DeviceLocation
-  // placements, range_allocator.cpp) on an in-process LOCAL data plane
-  // (worker.cpp), so a keystone seeing them shares the provider's process.
-  // Cross-process HBM pools register callback-backed regions instead.
-  bool moved = false;
-  const CopyPlacement* moved_src = nullptr;
-  bool used_unchecked = false;
-  if (coded) {
-    // Coded objects move SHARD-VERBATIM: the staged allocation reused the
-    // object's (k, m) config, so it has the identical geometry and every
-    // shard (data and parity alike) copies bytes straight across with no
-    // decode. The mover invariant still holds: the object CRC accumulates
-    // over the data shards' valid bytes AS they stream, and a mismatch
-    // aborts the move — the object stays put (kSkipped, never the delete
-    // fallback: the bytes are still parity-recoverable by client reads).
-    const CopyPlacement& src = old_copies.front();
-    const size_t k = src.ec_data_shards;
-    const uint64_t L = src.shards.empty() ? 0 : src.shards.front().length;
-    uint32_t crc = 0;
-    constexpr uint64_t kChunk = 8ull << 20;
-    std::vector<uint8_t> buf(static_cast<size_t>(std::min<uint64_t>(L, kChunk)));
-    auto stream_one = [&](const ShardPlacement& s, const ShardPlacement& d,
-                          uint64_t crc_bytes) -> ErrorCode {
-      for (uint64_t off = 0; off < s.length; off += kChunk) {
-        const uint64_t n = std::min(kChunk, s.length - off);
-        BTPU_RETURN_IF_ERROR(
-            transport::shard_io(*data_client_, s, off, buf.data(), n, /*is_write=*/false));
-        if (off < crc_bytes)
-          crc = crc32c(buf.data(), std::min(n, crc_bytes - off), crc);
-        BTPU_RETURN_IF_ERROR(
-            transport::shard_io(*data_client_, d, off, buf.data(), n, /*is_write=*/true));
-      }
-      return ErrorCode::OK;
-    };
-    if (placed.value().size() == 1 &&
-        placed.value().front().shards.size() == src.shards.size()) {
-      moved = true;
-      for (size_t i = 0; i < src.shards.size() && moved; ++i) {
-        const uint64_t start = i * L;
-        const uint64_t crc_bytes =
-            i < k && start < size ? std::min<uint64_t>(L, size - start) : 0;
-        if (stream_one(src.shards[i], placed.value().front().shards[i], crc_bytes) !=
-            ErrorCode::OK)
-          moved = false;
-      }
-      if (moved && src.content_crc != 0 && crc != src.content_crc) {
-        LOG_WARN << "demotion of coded " << key
-                 << " aborted: source failed crc verification (still "
-                    "parity-recoverable in place)";
-        adapter_.free_object(staging_key);
-        return DemoteOutcome::kSkipped;
-      }
-    }
-    if (!moved) {
-      // A transiently unreadable shard (hung worker, death inside the
-      // heartbeat TTL) or a staging-geometry surprise must NEVER funnel a
-      // parity-recoverable object into the caller's delete fallback.
-      adapter_.free_object(staging_key);
-      return DemoteOutcome::kSkipped;
-    }
-  } else {
-    const alloc::PoolMap fabric_pools = memory_pools();
-    for (const auto& src : old_copies) {
-      used_unchecked = false;
-      if (copy_object_bytes(*data_client_, src, placed.value(), size, &fabric_pools,
-                            &counters_.fabric_moves, &used_unchecked) == ErrorCode::OK) {
-        moved = true;
-        moved_src = &src;
-        break;
-      }
-    }
-  }
-  if (!moved) {
-    adapter_.free_object(staging_key);
-    return DemoteOutcome::kFailed;
-  }
-
-  // Swap the placements in only if the object didn't change underneath us.
-  std::unique_lock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end() || it->second.epoch != epoch_snap) {
-    lock.unlock();
-    adapter_.free_object(staging_key);
-    return DemoteOutcome::kSkipped;
-  }
-  adapter_.free_object(key);
-  if (auto ec = adapter_.allocator().rename_object(staging_key, key); ec != ErrorCode::OK) {
-    // Unreachable in practice (staging exists, key was just freed); treat the
-    // object as lost rather than leave metadata pointing at freed ranges.
-    LOG_ERROR << "demotion rename failed for " << key << ": " << to_string(ec);
-    adapter_.free_object(staging_key);
-    objects_.erase(it);
-    unpersist_object(key);
-    ++counters_.objects_lost;
-    bump_view();
-    return DemoteOutcome::kSkipped;
-  }
-  it->second.copies = std::move(placed).value();
-  if (!moved_src) moved_src = &old_copies.front();  // coded path: shard-verbatim
-  for (auto& copy : it->second.copies) {
-    copy.content_crc = old_copies.front().content_crc;
-    carry_shard_crcs(*moved_src, copy);
-  }
-  it->second.epoch = next_epoch_.fetch_add(1);
-  // Fabric/device moves carry stamps without the staged lane's CRC gate:
-  // scrub them.
-  if (used_unchecked) queue_scrub_target(key);
-  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
-    // The move already landed locally; the durable record still names the old
-    // (now released) placements. Don't claim the demotion — kSkipped keeps
-    // the pressure loop honest — and queue the key for the health loop's
-    // re-persist: a never-again-mutated key would otherwise keep its stale
-    // record forever.
-    LOG_ERROR << "demotion of " << key << " not durably recorded: " << to_string(ec);
-    mark_persist_dirty(key);
-    bump_view();
-    return DemoteOutcome::kSkipped;
-  }
-  bump_view();
-  return DemoteOutcome::kDemoted;
-}
 
 }  // namespace btpu::keystone

@@ -1,0 +1,327 @@
+// Graceful evacuation of a live worker (TPU-VM preemption path).
+#include "btpu/keystone/keystone.h"
+
+#include "keystone_internal.h"
+
+#include <algorithm>
+#include <optional>
+#include <unordered_set>
+
+#include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::keystone {
+
+using coord::WatchEvent;
+
+using namespace detail;
+
+Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+  // Drains are rare, operator-triggered, and share staging bookkeeping —
+  // serialize them per service instead of reasoning about interleavings.
+  std::lock_guard<std::mutex> drain_lock(drain_mutex_);
+  {
+    std::unique_lock lock(registry_mutex_);
+    if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
+    draining_.insert(worker_id);
+  }
+  LOG_INFO << "draining worker " << worker_id;
+
+  // Idle pooled slots (put_start_pooled) with any shard on the draining
+  // worker are cancelled outright: they have no writer attached, clients
+  // transparently fall back / refill elsewhere, and leaving them would pin
+  // the worker until the slot TTL. A slot whose commit is racing this
+  // cancel commits as OBJECT_NOT_FOUND and the client re-puts normally.
+  {
+    std::unique_lock lock(objects_mutex_);
+    for (auto it = objects_.begin(); it != objects_.end();) {
+      bool on_worker = false;
+      if (it->second.slot) {
+        for (const auto& copy : it->second.copies) {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id) on_worker = true;
+          }
+        }
+      }
+      if (!on_worker) {
+        ++it;
+        continue;
+      }
+      slot_objects_.fetch_sub(1);
+      free_object_locked(it->first, it->second);
+      it = objects_.erase(it);
+      ++counters_.put_cancels;
+    }
+    bump_view();
+  }
+
+  // One migration unit per SHARD on the draining worker (not per copy):
+  // bytes already correct on surviving workers are never re-streamed, which
+  // matters inside a preemption grace window.
+  struct Move {
+    ObjectKey key;
+    uint64_t epoch{0};
+    size_t copy_index{0};
+    size_t shard_index{0};
+    ShardPlacement shard;        // the victim shard (still readable)
+    WorkerConfig config;
+    std::vector<NodeId> other_workers;
+  };
+  auto scan_moves = [&](bool& pending_touches) {
+    std::vector<Move> moves;
+    pending_touches = false;
+    std::shared_lock lock(objects_mutex_);
+    for (const auto& [key, info] : objects_) {
+      for (size_t ci = 0; ci < info.copies.size(); ++ci) {
+        for (size_t si = 0; si < info.copies[ci].shards.size(); ++si) {
+          const ShardPlacement& sh = info.copies[ci].shards[si];
+          if (sh.worker_id != worker_id) continue;
+          if (info.state != ObjectState::kComplete) {
+            // In-flight put placed before the draining flag: it completes
+            // (or cancels) shortly; a later round migrates it.
+            pending_touches = true;
+            continue;
+          }
+          Move m{key, info.epoch, ci, si, sh, info.config, {}};
+          for (size_t cj = 0; cj < info.copies.size(); ++cj) {
+            if (cj == ci) continue;
+            for (const auto& other : info.copies[cj].shards)
+              m.other_workers.push_back(other.worker_id);
+          }
+          if (info.copies[ci].ec_data_shards > 0) {
+            // Coded copy: the SIBLING shards are the failure domains the
+            // "any m worker losses" contract counts — never stack the
+            // migrated shard behind one of them.
+            for (size_t sj = 0; sj < info.copies[ci].shards.size(); ++sj) {
+              if (sj != si)
+                m.other_workers.push_back(info.copies[ci].shards[sj].worker_id);
+            }
+          }
+          moves.push_back(std::move(m));
+        }
+      }
+    }
+    return moves;
+  };
+
+  // Rounds: migrate what is complete, wait out in-flight puts, re-scan.
+  // The loop ends only when NOTHING references the worker (a straggler put
+  // that lands late is picked up by a later round) or when a round makes no
+  // progress (capacity/transport trouble: give up, keep the worker
+  // registered and excluded so the drain can be retried).
+  uint64_t total_moved = 0;
+  bool clean = false;
+  for (int round = 0; round < 60; ++round) {
+    // Leadership can move during a minutes-long drain; a deposed keystone
+    // must stop mutating placements immediately — and must not keep the
+    // worker invisibly excluded on THIS instance (the new leader owns the
+    // drain now; the operator retries against it).
+    if (!is_leader_.load()) {
+      counters_.shards_drained.fetch_add(total_moved);
+      std::unique_lock lock(registry_mutex_);
+      draining_.erase(worker_id);
+      return ErrorCode::NOT_LEADER;
+    }
+    // Re-snapshot targets each round: workers registering mid-drain add
+    // capacity, workers dying mid-drain stop being selected. The full pool
+    // map is hoisted per round too — stream_shard consults it per shard for
+    // the fabric lane.
+    const alloc::PoolMap targets = allocatable_pools_snapshot();
+    const alloc::PoolMap all_pools = memory_pools();
+    bool pending_touches = false;
+    auto moves = scan_moves(pending_touches);
+    if (moves.empty() && !pending_touches) {
+      clean = true;
+      break;
+    }
+    if (moves.empty()) {  // only pendings: give them time to land
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      continue;
+    }
+
+    uint64_t moved = 0;
+    std::unordered_map<ObjectKey, uint64_t> epoch_now;  // tracks our own swaps
+    for (auto& m : moves) {
+      const ObjectKey staging_key = m.key + "\x01" "drain:" + worker_id;
+      WorkerConfig shard_cfg = m.config;
+      shard_cfg.replication_factor = 1;
+      shard_cfg.max_workers_per_copy = 1;  // one shard in, one shard out
+      // Shard-level move, even for coded objects: the staged allocation is
+      // one plain shard (the splice keeps its position in the geometry).
+      const bool coded = m.config.ec_parity_shards > 0;
+      shard_cfg.ec_data_shards = 0;
+      shard_cfg.ec_parity_shards = 0;
+      alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+          staging_key, m.shard.length, shard_cfg);
+      // Keep the shard in its tier (a drain is not a demotion); placement
+      // may still spill classes if the tier has no room elsewhere — but a
+      // coded shard may only spill within WIRE tiers (a device-tier shard
+      // would make the whole object unreadable to the coded client path).
+      req.preferred_classes = {m.shard.storage_class};
+      req.wire_only = coded;
+      req.excluded_nodes = m.other_workers;
+      auto attempt = adapter_.allocator().allocate(req, targets);
+      if (!attempt.ok()) {
+        req.excluded_nodes.clear();
+        attempt = adapter_.allocator().allocate(req, targets);
+      }
+      if (!attempt.ok()) continue;
+      std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
+      // A coded shard must re-land as exactly ONE range: the coded client
+      // read path requires shards.size() == k+m (client.cpp), so a 1:n
+      // splice would leave the object unreadable (and clear the stamps the
+      // scrub needs). A fragmented pool just defers this shard's move.
+      if (coded && staged[0].shards.size() != 1) {
+        adapter_.free_object(staging_key);
+        continue;
+      }
+
+      // Stream straight from the victim shard — alive, unlike crash repair.
+      bool used_unchecked = false;
+      uint32_t host_crc = 0;
+      if (stream_shard(m.shard, staged[0], all_pools, &used_unchecked, &host_crc) !=
+          ErrorCode::OK) {
+        adapter_.free_object(staging_key);
+        continue;
+      }
+
+      std::unique_lock lock(objects_mutex_);
+      auto it = objects_.find(m.key);
+      const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
+      if (it == objects_.end() || it->second.epoch != expect ||
+          m.copy_index >= it->second.copies.size() ||
+          m.shard_index >= it->second.copies[m.copy_index].shards.size() ||
+          // Our own earlier splice in this copy may have shifted indices
+          // (a staged allocation can insert several shards): the shard at
+          // this index must still BE the scanned victim, or releasing it
+          // would free a healthy live range. Mismatches retry via re-scan.
+          !(it->second.copies[m.copy_index].shards[m.shard_index] == m.shard)) {
+        lock.unlock();
+        adapter_.free_object(staging_key);
+        continue;  // object changed underneath the move; the re-scan retries
+      }
+      if (adapter_.allocator().merge_objects(staging_key, m.key) != ErrorCode::OK) {
+        lock.unlock();
+        adapter_.free_object(staging_key);
+        continue;
+      }
+      // Release the evacuated shard's range and splice the replacement in
+      // (the staged allocation may itself be several ranges).
+      auto& shards = it->second.copies[m.copy_index].shards;
+      if (auto pr = shard_to_range(shards[m.shard_index], memory_pools())) {
+        adapter_.allocator().release_range(m.key, pr->first, pr->second);
+      }
+      // Shard CRCs: a 1:1 splice moves identical bytes, so the stamp at this
+      // index stays valid untouched. A 1:n splice changes the shard layout —
+      // the stamps no longer line up, so the copy degrades to unstamped
+      // (empty) rather than carrying stamps attributed to the wrong shards.
+      auto& stamps = it->second.copies[m.copy_index].shard_crcs;
+      // Host-lane moves hand back the streamed bytes' CRC: a mismatch with
+      // the stamp means the SOURCE was already rotten (the stamp still
+      // describes the intended bytes, so it stays) — the move proceeds (the
+      // drain must finish) and the scrub heals the new location from a
+      // sibling/parity ahead of its ring walk.
+      if (!used_unchecked && stamps.size() == shards.size() &&
+          host_crc != stamps[m.shard_index]) {
+        LOG_WARN << "drain moved a stamp-mismatched shard of " << m.key
+                 << "; queueing priority scrub";
+        used_unchecked = true;  // same revalidation path as fabric moves
+      }
+      if (staged[0].shards.size() != 1)
+        it->second.copies[m.copy_index].shard_crcs.clear();
+      shards.erase(shards.begin() + static_cast<ptrdiff_t>(m.shard_index));
+      shards.insert(shards.begin() + static_cast<ptrdiff_t>(m.shard_index),
+                    staged[0].shards.begin(), staged[0].shards.end());
+      it->second.epoch = next_epoch_.fetch_add(1);
+      epoch_now[m.key] = it->second.epoch;
+      // Fabric-drained bytes skipped the staged lane's CRC gate: scrub them.
+      if (used_unchecked) queue_scrub_target(m.key);
+      if (persist_object(m.key, it->second) != ErrorCode::OK) {
+        // Splice landed in memory; the health loop re-persists.
+        mark_persist_dirty(m.key);
+      }
+      bump_view();
+      ++moved;
+    }
+    total_moved += moved;
+    if (moved == 0 && !pending_touches) break;  // no progress: stop retrying
+  }
+
+  if (!clean) {
+    // Keep the worker registered AND still marked draining (no new data
+    // lands on it); the operator retries after fixing capacity/transport.
+    // If the worker dies first, cleanup_dead_worker clears the flag.
+    counters_.shards_drained.fetch_add(total_moved);
+    LOG_WARN << "drain of " << worker_id << " incomplete after " << total_moved
+             << " migrated shards";
+    return ErrorCode::WORKER_DRAIN_INCOMPLETE;
+  }
+
+  // Nothing references the worker anymore: retire it for real. The draining
+  // flag drops only AFTER retirement, so no allocation window reopens.
+  cleanup_dead_worker(worker_id);
+  {
+    std::unique_lock lock(registry_mutex_);
+    draining_.erase(worker_id);
+  }
+  counters_.shards_drained.fetch_add(total_moved);
+  LOG_INFO << "drained worker " << worker_id << ": " << total_moved << " shards migrated";
+  return total_moved;
+}
+
+// Streams one live shard's bytes into a freshly staged placement, device
+// fast path included (chip-to-chip, no host staging, when both ends are
+// device-resident).
+ErrorCode KeystoneService::stream_shard(const ShardPlacement& src, const CopyPlacement& dst,
+                                        const alloc::PoolMap& pools, bool* used_unchecked,
+                                        uint32_t* host_crc) {
+  const auto* src_dev = std::get_if<DeviceLocation>(&src.location);
+  if (src_dev && dst.shards.size() == 1) {
+    if (const auto* dst_dev = std::get_if<DeviceLocation>(&dst.shards[0].location)) {
+      auto ec = storage::hbm_copy(src_dev->region_id, src_dev->offset, dst_dev->region_id,
+                                  dst_dev->offset, src.length);
+      // Chip-to-chip, no host bytes and no CRC gate: report for scrub.
+      if (ec == ErrorCode::OK && used_unchecked) *used_unchecked = true;
+      return ec;
+    }
+  }
+  {
+    // Cross-process device pools: ride the fabric (drain is the preemption
+    // path — moving device bytes without the host lane is the whole point).
+    CopyPlacement src_copy;
+    src_copy.shards.push_back(src);
+    if (fabric_copy_object(*data_client_, src_copy, dst, src.length, pools)) {
+      counters_.fabric_moves.fetch_add(1);
+      if (used_unchecked) *used_unchecked = true;
+      return ErrorCode::OK;
+    }
+  }
+  constexpr uint64_t kChunk = 16ull << 20;
+  std::vector<uint8_t> buf(static_cast<size_t>(std::min<uint64_t>(src.length, kChunk)));
+  uint32_t crc = 0;
+  for (uint64_t off = 0; off < src.length; off += kChunk) {
+    const uint64_t n = std::min(kChunk, src.length - off);
+    if (auto ec = transport::shard_io(*data_client_, src, off, buf.data(), n,
+                                      /*is_write=*/false);
+        ec != ErrorCode::OK)
+      return ec;
+    crc = crc32c(buf.data(), n, crc);
+    if (auto ec = transport::copy_range_io(*data_client_, dst, off, buf.data(), n,
+                                           /*is_write=*/true);
+        ec != ErrorCode::OK)
+      return ec;
+  }
+  // Host lane: the bytes passed through this CPU anyway, so hand the caller
+  // their CRC — it holds the shard's stamp (this function doesn't) and can
+  // queue a heal if the source was already rotten.
+  if (host_crc) *host_crc = crc;
+  return ErrorCode::OK;
+}
+
+
+}  // namespace btpu::keystone

@@ -1,0 +1,315 @@
+// Watermark pressure: tier-aware eviction and tier demotion.
+#include "btpu/keystone/keystone.h"
+
+#include "keystone_internal.h"
+
+#include <algorithm>
+#include <optional>
+#include <unordered_set>
+
+#include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::keystone {
+
+using coord::WatchEvent;
+
+using namespace detail;
+
+// ---- eviction -------------------------------------------------------------
+
+double KeystoneService::tier_utilization(std::optional<StorageClass> cls) const {
+  uint64_t capacity = 0;
+  {
+    std::shared_lock lock(registry_mutex_);
+    for (const auto& [id, pool] : pools_) {
+      if (!cls || pool.storage_class == *cls) capacity += pool.size;
+    }
+  }
+  if (capacity == 0) return 0.0;
+  // Allocated bytes, NOT capacity - free: pool allocators materialize
+  // lazily, so an untouched pool reports no free bytes and capacity-free
+  // would misread a near-empty tier as full (observed: spurious "eviction
+  // pressure ... util 1" on a fresh HBM pool, with the health loop then
+  // evicting live objects mid-benchmark).
+  auto stats = adapter_.allocator().get_stats(cls);
+  uint64_t used = 0;
+  if (cls) {
+    auto it = stats.allocated_per_class.find(*cls);
+    used = it == stats.allocated_per_class.end() ? 0 : it->second;
+  } else {
+    used = stats.total_allocated_bytes;
+  }
+  return static_cast<double>(used) / static_cast<double>(capacity);
+}
+
+void KeystoneService::evict_for_pressure() {
+  // Determine which tiers are over the watermark.
+  std::vector<std::optional<StorageClass>> scopes;
+  if (config_.tier_aware_eviction) {
+    std::vector<StorageClass> classes;
+    {
+      std::shared_lock lock(registry_mutex_);
+      for (const auto& [id, pool] : pools_) {
+        if (std::find(classes.begin(), classes.end(), pool.storage_class) == classes.end())
+          classes.push_back(pool.storage_class);
+      }
+    }
+    // Fastest tier first: demotions out of a hot tier land in lower tiers,
+    // and those are evaluated later in the same pass so they can shed the
+    // cascade immediately instead of waiting a full health interval.
+    std::sort(classes.begin(), classes.end(),
+              [](StorageClass a, StorageClass b) { return tier_rank(a) < tier_rank(b); });
+    for (auto c : classes) scopes.emplace_back(c);
+  } else {
+    scopes.emplace_back(std::nullopt);
+  }
+
+  for (const auto& scope : scopes) {
+    if (tier_utilization(scope) < config_.high_watermark) continue;
+    const double target = config_.high_watermark * (1.0 - config_.eviction_ratio);
+    LOG_WARN << "eviction pressure on tier "
+             << (scope ? storage_class_name(*scope) : "all") << " (util "
+             << tier_utilization(scope) << " >= " << config_.high_watermark << ")";
+
+    // LRU order over evictable objects in this scope.
+    std::vector<std::pair<std::chrono::steady_clock::time_point, ObjectKey>> candidates;
+    {
+      std::shared_lock lock(objects_mutex_);
+      for (const auto& [key, info] : objects_) {
+        if (info.soft_pin || info.state != ObjectState::kComplete) continue;
+        if (scope) {
+          bool touches_tier = false;
+          for (const auto& copy : info.copies) {
+            for (const auto& shard : copy.shards) {
+              if (shard.storage_class == *scope) touches_tier = true;
+            }
+          }
+          if (!touches_tier) continue;
+        }
+        candidates.emplace_back(info.last_access, key);
+      }
+    }
+    std::sort(candidates.begin(), candidates.end());
+
+    for (const auto& [ts, key] : candidates) {
+      if (tier_utilization(scope) <= target) break;
+      if (scope && config_.enable_tier_demotion) {
+        const DemoteOutcome outcome = demote_object(key, *scope);
+        if (outcome == DemoteOutcome::kDemoted) {
+          ++counters_.objects_demoted;
+          LOG_INFO << "demoted object " << key << " out of tier "
+                   << storage_class_name(*scope);
+          continue;
+        }
+        if (outcome == DemoteOutcome::kSkipped) continue;
+      }
+      std::unique_lock lock(objects_mutex_);
+      auto it = objects_.find(key);
+      if (it == objects_.end()) continue;
+      // Fence-first (see gc): never free ranges a promoted leader still maps.
+      if (unpersist_object(key) != ErrorCode::OK) continue;
+      free_object_locked(key, it->second);
+      objects_.erase(it);
+      ++counters_.evicted;
+      bump_view();
+      LOG_INFO << "evicted object " << key << " for tier pressure";
+    }
+  }
+}
+
+KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& key,
+                                                              StorageClass from) {
+  // Demotion never places new bytes onto a draining worker.
+  const alloc::PoolMap live_pools = allocatable_pools_snapshot();
+
+  // Lower tiers that actually have pools, nearest first. The ladder stops at
+  // HDD: CUSTOM/unspecified pools are application-owned, never a backstop.
+  std::vector<StorageClass> ladder;
+  for (const auto& [id, pool] : live_pools) {
+    const int rank = tier_rank(pool.storage_class);
+    if (rank <= tier_rank(from) || rank > tier_rank(StorageClass::HDD)) continue;
+    if (std::find(ladder.begin(), ladder.end(), pool.storage_class) == ladder.end())
+      ladder.push_back(pool.storage_class);
+  }
+  if (ladder.empty()) return DemoteOutcome::kFailed;
+  std::sort(ladder.begin(), ladder.end(),
+            [](StorageClass a, StorageClass b) { return tier_rank(a) < tier_rank(b); });
+
+  // Snapshot the object, then move bytes with NO metadata lock held — a
+  // multi-hundred-MB transfer must not stall every put_start/get_workers.
+  uint64_t size = 0;
+  uint64_t epoch_snap = 0;
+  WorkerConfig config;
+  std::vector<CopyPlacement> old_copies;
+  {
+    std::shared_lock lock(objects_mutex_);
+    auto it = objects_.find(key);
+    if (it == objects_.end() || it->second.state != ObjectState::kComplete)
+      return DemoteOutcome::kSkipped;
+    size = it->second.size;
+    epoch_snap = it->second.epoch;
+    config = it->second.config;
+    old_copies = it->second.copies;
+  }
+  // Demotion moves whole objects. Only objects fully resident in the
+  // pressured tier qualify — re-placing a mixed-tier object would drag its
+  // healthy faster-tier replicas down the ladder too. Mixed objects keep
+  // delete-eviction semantics (the caller's fallback).
+  for (const auto& copy : old_copies) {
+    for (const auto& shard : copy.shards) {
+      if (shard.storage_class != from) return DemoteOutcome::kFailed;
+    }
+  }
+  const bool coded = !old_copies.empty() && old_copies.front().ec_data_shards > 0;
+
+  // Stage the replacement under a temporary allocator key; the old ranges
+  // stay live the whole time, so concurrent readers are never broken.
+  const ObjectKey staging_key = key + "\x01" "demote";
+  alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+      staging_key, size, config);
+  req.restrict_to_preferred = true;
+  // The object is leaving its tier regardless; a node pin (often a node that
+  // only hosts the hot tier) must not veto the move — without this, pinned
+  // objects could never demote and would always fall through to deletion.
+  req.preferred_node.clear();
+  Result<std::vector<CopyPlacement>> placed = ErrorCode::INSUFFICIENT_SPACE;
+  for (StorageClass target_class : ladder) {
+    req.preferred_classes = {target_class};
+    auto attempt = adapter_.allocator().allocate(req, live_pools);
+    if (attempt.ok()) {
+      placed = std::move(attempt).value().copies;
+      break;
+    }
+  }
+  if (!placed.ok()) return DemoteOutcome::kFailed;
+
+  // Stream from the first readable copy into the staged placements.
+  // DeviceLocation shards are readable here by construction: workers only
+  // advertise TransportKind::HBM descriptors (which yield DeviceLocation
+  // placements, range_allocator.cpp) on an in-process LOCAL data plane
+  // (worker.cpp), so a keystone seeing them shares the provider's process.
+  // Cross-process HBM pools register callback-backed regions instead.
+  bool moved = false;
+  const CopyPlacement* moved_src = nullptr;
+  bool used_unchecked = false;
+  if (coded) {
+    // Coded objects move SHARD-VERBATIM: the staged allocation reused the
+    // object's (k, m) config, so it has the identical geometry and every
+    // shard (data and parity alike) copies bytes straight across with no
+    // decode. The mover invariant still holds: the object CRC accumulates
+    // over the data shards' valid bytes AS they stream, and a mismatch
+    // aborts the move — the object stays put (kSkipped, never the delete
+    // fallback: the bytes are still parity-recoverable by client reads).
+    const CopyPlacement& src = old_copies.front();
+    const size_t k = src.ec_data_shards;
+    const uint64_t L = src.shards.empty() ? 0 : src.shards.front().length;
+    uint32_t crc = 0;
+    constexpr uint64_t kChunk = 8ull << 20;
+    std::vector<uint8_t> buf(static_cast<size_t>(std::min<uint64_t>(L, kChunk)));
+    auto stream_one = [&](const ShardPlacement& s, const ShardPlacement& d,
+                          uint64_t crc_bytes) -> ErrorCode {
+      for (uint64_t off = 0; off < s.length; off += kChunk) {
+        const uint64_t n = std::min(kChunk, s.length - off);
+        BTPU_RETURN_IF_ERROR(
+            transport::shard_io(*data_client_, s, off, buf.data(), n, /*is_write=*/false));
+        if (off < crc_bytes)
+          crc = crc32c(buf.data(), std::min(n, crc_bytes - off), crc);
+        BTPU_RETURN_IF_ERROR(
+            transport::shard_io(*data_client_, d, off, buf.data(), n, /*is_write=*/true));
+      }
+      return ErrorCode::OK;
+    };
+    if (placed.value().size() == 1 &&
+        placed.value().front().shards.size() == src.shards.size()) {
+      moved = true;
+      for (size_t i = 0; i < src.shards.size() && moved; ++i) {
+        const uint64_t start = i * L;
+        const uint64_t crc_bytes =
+            i < k && start < size ? std::min<uint64_t>(L, size - start) : 0;
+        if (stream_one(src.shards[i], placed.value().front().shards[i], crc_bytes) !=
+            ErrorCode::OK)
+          moved = false;
+      }
+      if (moved && src.content_crc != 0 && crc != src.content_crc) {
+        LOG_WARN << "demotion of coded " << key
+                 << " aborted: source failed crc verification (still "
+                    "parity-recoverable in place)";
+        adapter_.free_object(staging_key);
+        return DemoteOutcome::kSkipped;
+      }
+    }
+    if (!moved) {
+      // A transiently unreadable shard (hung worker, death inside the
+      // heartbeat TTL) or a staging-geometry surprise must NEVER funnel a
+      // parity-recoverable object into the caller's delete fallback.
+      adapter_.free_object(staging_key);
+      return DemoteOutcome::kSkipped;
+    }
+  } else {
+    const alloc::PoolMap fabric_pools = memory_pools();
+    for (const auto& src : old_copies) {
+      used_unchecked = false;
+      if (copy_object_bytes(*data_client_, src, placed.value(), size, &fabric_pools,
+                            &counters_.fabric_moves, &used_unchecked) == ErrorCode::OK) {
+        moved = true;
+        moved_src = &src;
+        break;
+      }
+    }
+  }
+  if (!moved) {
+    adapter_.free_object(staging_key);
+    return DemoteOutcome::kFailed;
+  }
+
+  // Swap the placements in only if the object didn't change underneath us.
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end() || it->second.epoch != epoch_snap) {
+    lock.unlock();
+    adapter_.free_object(staging_key);
+    return DemoteOutcome::kSkipped;
+  }
+  adapter_.free_object(key);
+  if (auto ec = adapter_.allocator().rename_object(staging_key, key); ec != ErrorCode::OK) {
+    // Unreachable in practice (staging exists, key was just freed); treat the
+    // object as lost rather than leave metadata pointing at freed ranges.
+    LOG_ERROR << "demotion rename failed for " << key << ": " << to_string(ec);
+    adapter_.free_object(staging_key);
+    objects_.erase(it);
+    unpersist_object(key);
+    ++counters_.objects_lost;
+    bump_view();
+    return DemoteOutcome::kSkipped;
+  }
+  it->second.copies = std::move(placed).value();
+  if (!moved_src) moved_src = &old_copies.front();  // coded path: shard-verbatim
+  for (auto& copy : it->second.copies) {
+    copy.content_crc = old_copies.front().content_crc;
+    carry_shard_crcs(*moved_src, copy);
+  }
+  it->second.epoch = next_epoch_.fetch_add(1);
+  // Fabric/device moves carry stamps without the staged lane's CRC gate:
+  // scrub them.
+  if (used_unchecked) queue_scrub_target(key);
+  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
+    // The move already landed locally; the durable record still names the old
+    // (now released) placements. Don't claim the demotion — kSkipped keeps
+    // the pressure loop honest — and queue the key for the health loop's
+    // re-persist: a never-again-mutated key would otherwise keep its stale
+    // record forever.
+    LOG_ERROR << "demotion of " << key << " not durably recorded: " << to_string(ec);
+    mark_persist_dirty(key);
+    bump_view();
+    return DemoteOutcome::kSkipped;
+  }
+  bump_view();
+  return DemoteOutcome::kDemoted;
+}
+
+}  // namespace btpu::keystone

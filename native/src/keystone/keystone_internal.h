@@ -1,0 +1,42 @@
+// File-internal helpers shared by the keystone's translation units (core,
+// persist, scrub, drain, repair, evict). Not part of the public API.
+#pragma once
+
+#include <atomic>
+#include <optional>
+#include <utility>
+#include <vector>
+
+#include "btpu/keystone/keystone.h"
+
+namespace btpu::keystone::detail {
+
+// Maps a shard placement back to (pool, offset-range) for allocator adoption.
+std::optional<std::pair<MemoryPoolId, alloc::Range>> shard_to_range(
+    const ShardPlacement& shard, const alloc::PoolMap& pools);
+
+// All-or-nothing mapping of shards onto (pool, range) pairs.
+bool append_copy_ranges(const CopyPlacement& copy, const alloc::PoolMap& pools,
+                        std::vector<std::pair<MemoryPoolId, alloc::Range>>& out);
+
+std::optional<std::vector<std::pair<MemoryPoolId, alloc::Range>>> map_copies_to_ranges(
+    const std::vector<CopyPlacement>& copies, const alloc::PoolMap& pools);
+
+// Shard CRCs are layout-bound: carries the source's stamps onto a
+// destination only when it striped identically.
+void carry_shard_crcs(const CopyPlacement& src, CopyPlacement& dst);
+
+// Cross-process device fabric move (offer + pull between worker processes).
+bool fabric_copy_object(transport::TransportClient& client, const CopyPlacement& src,
+                        const CopyPlacement& dst, uint64_t size, const alloc::PoolMap& pools);
+
+// Streams `size` bytes from `src` into every copy in `dsts` (bounded chunk
+// buffer; device->device and fabric fast paths when available). See the
+// definition for the CRC-gate contract and the `used_unchecked` report.
+ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
+                            const std::vector<CopyPlacement>& dsts, uint64_t size,
+                            const alloc::PoolMap* pools = nullptr,
+                            std::atomic<uint64_t>* fabric_moves = nullptr,
+                            bool* used_unchecked = nullptr);
+
+}  // namespace btpu::keystone::detail

@@ -1,0 +1,554 @@
+// Durable metadata: record envelope + legacy layouts, registry codecs,
+// object persistence, and record application (restart / HA promotion).
+#include "btpu/keystone/keystone.h"
+
+#include "keystone_internal.h"
+
+#include <algorithm>
+#include <optional>
+#include <unordered_set>
+
+#include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::keystone {
+
+using coord::WatchEvent;
+
+using namespace detail;
+
+// ---- record envelope ------------------------------------------------------
+// Durable records (coordinator values) outlive binaries, so unlike RPC
+// frames they need an explicit format marker: records this build writes are
+// [u64 0xFF..FF][u8 format=2][wire-v2 payload]. The magic cannot collide
+// with any pre-envelope record: worker/pool records begin with a non-empty
+// id string's u32 length (never 0xFFFFFFFF = a 4 GiB id) and object records
+// with a u64 object size (never 2^64-1). Records without the marker decode
+// through the hand-rolled legacy layouts in `v1` below — a restart over a
+// pre-upgrade data dir must recover its objects, not purge them as garbage
+// (proven by test_keystone.cpp RestartRecoversPreUpgradeRecordLayouts).
+//
+// COMPATIBILITY BOUNDARY: the envelope guarantee is one-directional across
+// its introduction. Builds FROM this one on read every older layout, and —
+// because wire v2 is append-only and future-format records are skipped, not
+// deleted — they stay safe under records from newer builds too. But
+// PRE-envelope builds cannot read enveloped records (they see a 4 GiB
+// string length / 2^64-1 size and may purge them as garbage): rolling a
+// binary BACK across the envelope introduction is unsupported — upgrade
+// keystones+workers across it as one step and don't roll back, exactly the
+// atomic-upgrade stance those older builds documented for themselves
+// (their rpc.h: "Upgrades are atomic per cluster").
+
+namespace {
+constexpr uint64_t kRecordMagic = ~0ull;
+constexpr uint8_t kRecordFormat = 2;
+
+enum class RecordEra : uint8_t {
+  kLegacy,   // no envelope: pre-envelope build wrote it (reader untouched)
+  kCurrent,  // envelope, format we speak (reader advanced past envelope)
+  kFuture,   // envelope, bumped format byte: an intentionally incompatible
+             // future layout — unusable here, but NOT garbage (keep it;
+             // deleting would destroy data during a rollback window)
+};
+
+void put_record_envelope(wire::Writer& w) {
+  w.put(kRecordMagic);
+  w.put(kRecordFormat);
+}
+
+RecordEra take_record_envelope(wire::Reader& r) {
+  if (r.remaining() < 9) return RecordEra::kLegacy;
+  uint64_t magic = 0;
+  std::memcpy(&magic, r.cursor(), sizeof(magic));
+  if (magic != kRecordMagic) return RecordEra::kLegacy;
+  uint8_t format = 0;
+  std::memcpy(&format, r.cursor() + sizeof(magic), sizeof(format));
+  // Append-only evolution never bumps the format byte, so != is "future".
+  if (format != kRecordFormat) return RecordEra::kFuture;
+  r.skip(sizeof(magic) + sizeof(format));
+  return RecordEra::kCurrent;
+}
+
+// Decoders for the layouts pre-envelope builds wrote: no length prefixes on
+// composite structs, so every nested layout is pinned by hand here (the
+// wire:: overloads have moved on to the self-describing v2 encoding).
+namespace v1 {
+
+bool topo(wire::Reader& r, TopoCoord& t) {
+  return wire::decode_fields(r, t.slice_id, t.host_id, t.chip_id);
+}
+
+bool remote(wire::Reader& r, RemoteDescriptor& d) {
+  return wire::decode_fields(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex);
+}
+
+bool location(wire::Reader& r, LocationDetail& loc) {
+  uint8_t idx = 0;
+  if (!r.get(idx)) return false;
+  switch (idx) {
+    case 0: {
+      MemoryLocation m;
+      if (!wire::decode_fields(r, m.remote_addr, m.rkey, m.size)) return false;
+      loc = m;
+      return true;
+    }
+    case 1: {
+      FileLocation f;
+      if (!wire::decode_fields(r, f.file_path, f.file_offset)) return false;
+      loc = f;
+      return true;
+    }
+    case 2: {
+      DeviceLocation d;
+      if (!wire::decode_fields(r, d.device_id, d.region_id, d.offset, d.size)) return false;
+      loc = d;
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+bool shard(wire::Reader& r, ShardPlacement& s) {
+  return wire::decode_fields(r, s.pool_id, s.worker_id) && remote(r, s.remote) &&
+         wire::decode_fields(r, s.storage_class, s.length) && location(r, s.location);
+}
+
+bool shards(wire::Reader& r, std::vector<ShardPlacement>& out) {
+  uint32_t n = 0;
+  if (!r.get(n) || n > r.remaining()) return false;
+  out.clear();
+  out.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    ShardPlacement s;
+    if (!shard(r, s)) return false;
+    out.push_back(std::move(s));
+  }
+  return true;
+}
+
+// The last pre-envelope copy layout (carries ec geometry + content_crc).
+bool copy(wire::Reader& r, CopyPlacement& c) {
+  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards) &&
+         wire::decode_fields(r, c.ec_data_shards, c.ec_parity_shards, c.ec_object_size,
+                             c.content_crc);
+}
+
+// EC-era layout: ec geometry but no content_crc yet.
+bool copy_ec_era(wire::Reader& r, CopyPlacement& c) {
+  c.content_crc = 0;
+  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards) &&
+         wire::decode_fields(r, c.ec_data_shards, c.ec_parity_shards, c.ec_object_size);
+}
+
+// Pre-EC layout: copy = copy_index + shards only.
+bool copy_pre_ec(wire::Reader& r, CopyPlacement& c) {
+  c.ec_data_shards = c.ec_parity_shards = 0;
+  c.ec_object_size = 0;
+  c.content_crc = 0;
+  return wire::decode_fields(r, c.copy_index) && shards(r, c.shards);
+}
+
+// The last pre-envelope config layout (12 fields, with ec geometry).
+bool config(wire::Reader& r, WorkerConfig& c) {
+  uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
+  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
+                           c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
+                           c.preferred_slice, eck, ecm))
+    return false;
+  c.replication_factor = rf;
+  c.max_workers_per_copy = mw;
+  c.min_shard_size = ms;
+  c.ec_data_shards = eck;
+  c.ec_parity_shards = ecm;
+  return true;
+}
+
+// Pre-EC config layout: 10 fields, no ec geometry.
+bool config_pre_ec(wire::Reader& r, WorkerConfig& c) {
+  uint64_t rf = 0, mw = 0, ms = 0;
+  if (!wire::decode_fields(r, rf, mw, c.enable_soft_pin, c.preferred_node,
+                           c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
+                           c.prefer_contiguous, ms, c.preferred_slice))
+    return false;
+  c.replication_factor = rf;
+  c.max_workers_per_copy = mw;
+  c.min_shard_size = ms;
+  c.ec_data_shards = c.ec_parity_shards = 0;
+  return true;
+}
+
+bool pool_record(const std::string& bytes, MemoryPool& p) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  if (!wire::decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class) ||
+      !remote(r, p.remote) || !topo(r, p.topo))
+    return false;
+  // `alignment` was a trailing optional field in the v1 layout.
+  p.alignment = 0;
+  if (!r.exhausted() && !wire::decode(r, p.alignment)) return false;
+  return true;
+}
+
+bool worker_record(const std::string& bytes, WorkerInfo& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  return wire::decode_fields(r, out.worker_id, out.address) && topo(r, out.topo) &&
+         wire::decode_fields(r, out.registered_at_ms, out.last_heartbeat_ms);
+}
+
+}  // namespace v1
+}  // namespace
+
+// ---- registry codecs ------------------------------------------------------
+
+std::string encode_worker_info(const WorkerInfo& info) {
+  wire::Writer w;
+  put_record_envelope(w);
+  wire::encode_fields(w, info.worker_id, info.address, info.topo, info.registered_at_ms,
+                      info.last_heartbeat_ms);
+  auto bytes = w.take();
+  return std::string(bytes.begin(), bytes.end());
+}
+
+// Current-format records tolerate trailing bytes (a newer binary may append
+// fields; an older keystone keeps decoding the prefix it knows instead of
+// dropping the record mid-rolling-upgrade); envelope-less records fall back
+// to the pinned v1 layouts.
+bool decode_worker_info(const std::string& bytes, WorkerInfo& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  switch (take_record_envelope(r)) {
+    case RecordEra::kLegacy:
+      return v1::worker_record(bytes, out);
+    case RecordEra::kFuture:
+      return false;  // unusable here; caller skips, never deletes
+    case RecordEra::kCurrent:
+      break;
+  }
+  return wire::decode_fields(r, out.worker_id, out.address, out.topo, out.registered_at_ms,
+                             out.last_heartbeat_ms);
+}
+
+std::string encode_pool_record(const MemoryPool& pool) {
+  wire::Writer w;
+  put_record_envelope(w);
+  wire::encode(w, pool);
+  auto bytes = w.take();
+  return std::string(bytes.begin(), bytes.end());
+}
+
+bool decode_pool_record(const std::string& bytes, MemoryPool& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  switch (take_record_envelope(r)) {
+    case RecordEra::kLegacy:
+      return v1::pool_record(bytes, out);
+    case RecordEra::kFuture:
+      return false;  // unusable here; caller skips, never deletes
+    case RecordEra::kCurrent:
+      break;
+  }
+  return wire::decode(r, out);
+}
+
+namespace {
+// Durable object record: everything needed to resurrect ObjectInfo +
+// allocator state after a keystone restart.
+struct ObjectRecord {
+  uint64_t size{0};
+  uint64_t ttl_ms{0};
+  bool soft_pin{false};
+  uint8_t state{0};
+  WorkerConfig config;
+  std::vector<CopyPlacement> copies;
+  int64_t created_wall_ms{0};
+  int64_t last_access_wall_ms{0};
+};
+
+std::string encode_object_record(const ObjectRecord& rec) {
+  wire::Writer w;
+  put_record_envelope(w);
+  wire::encode_fields(w, rec.size, rec.ttl_ms, rec.soft_pin, rec.state, rec.config,
+                      rec.copies, rec.created_wall_ms, rec.last_access_wall_ms);
+  auto bytes = w.take();
+  return std::string(bytes.begin(), bytes.end());
+}
+
+// Envelope-less object records: three historical layouts, newest first. The
+// copy/config decoders are shared with the registry fallbacks (v1 above);
+// which copy layout applies is what distinguishes the generations.
+template <typename CopyDecoder>
+bool decode_object_record_generation(const std::string& bytes, ObjectRecord& out,
+                                     bool config_has_ec, CopyDecoder&& copy_decoder) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  if (!wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state)) return false;
+  if (config_has_ec ? !v1::config(r, out.config) : !v1::config_pre_ec(r, out.config))
+    return false;
+  uint32_t n = 0;
+  if (!r.get(n) || n > r.remaining()) return false;
+  out.copies.clear();
+  out.copies.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    CopyPlacement c;
+    if (!copy_decoder(r, c)) return false;
+    out.copies.push_back(std::move(c));
+  }
+  return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
+}
+
+bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
+  wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+  switch (take_record_envelope(r)) {
+    case RecordEra::kCurrent:
+      return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
+                                 out.copies, out.created_wall_ms, out.last_access_wall_ms);
+    case RecordEra::kFuture:
+      return false;  // apply_object_record pre-screens this era; belt+braces
+    case RecordEra::kLegacy:
+      break;
+  }
+  // Newest envelope-less layout (content CRCs) first, then EC-era, then
+  // pre-EC.
+  if (decode_object_record_generation(bytes, out, true, v1::copy)) return true;
+  if (decode_object_record_generation(bytes, out, true, v1::copy_ec_era)) return true;
+  return decode_object_record_generation(bytes, out, false, v1::copy_pre_ec);
+}
+
+}  // namespace
+
+ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
+  if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
+  const auto steady_now = std::chrono::steady_clock::now();
+  const int64_t wall_now = now_wall_ms();
+  auto to_wall = [&](std::chrono::steady_clock::time_point tp) {
+    return wall_now - std::chrono::duration_cast<std::chrono::milliseconds>(steady_now - tp)
+                          .count();
+  };
+  ObjectRecord rec;
+  rec.size = info.size;
+  rec.ttl_ms = info.ttl_ms;
+  rec.soft_pin = info.soft_pin;
+  rec.state = static_cast<uint8_t>(info.state);
+  rec.config = info.config;
+  rec.copies = info.copies;
+  rec.created_wall_ms = to_wall(info.created_at);
+  rec.last_access_wall_ms = to_wall(info.last_access);
+  return coord_put_record(coord::object_record_key(config_.cluster_id, key),
+                          encode_object_record(rec));
+}
+
+ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
+  if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
+  auto ec = coord_del_record(coord::object_record_key(config_.cluster_id, key));
+  return ec == ErrorCode::COORD_KEY_NOT_FOUND ? ErrorCode::OK : ec;
+}
+
+void KeystoneService::mark_persist_dirty(const ObjectKey& key) {
+  if (!coordinator_ || !config_.persist_objects) return;
+  std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+  persist_retry_.insert(key);
+}
+
+void KeystoneService::retry_dirty_persists() {
+  if (!coordinator_ || !config_.persist_objects) return;
+  std::vector<ObjectKey> keys;
+  {
+    std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+    if (persist_retry_.empty()) return;
+    keys.assign(persist_retry_.begin(), persist_retry_.end());
+  }
+  for (const auto& key : keys) {
+    if (!is_leader_.load()) return;  // deposed: the promoted leader owns truth
+    // The coordinator RPC runs under the shared objects lock on purpose: no
+    // mutator (unique lock) can advance the object or re-create a removed
+    // key mid-write, so the retry can never clobber a NEWER durable record
+    // with this snapshot. Rare path (persist previously failed), bounded by
+    // the coordinator RPC timeout.
+    std::shared_lock lock(objects_mutex_);
+    auto it = objects_.find(key);
+    ErrorCode ec;
+    bool caught_up = false;
+    if (it == objects_.end()) {
+      // Removed since it went dirty. The remove itself failed closed on its
+      // durable delete, so any remaining record for this key is the stale
+      // one this entry tracked — deleting it is the catch-up.
+      ec = unpersist_object(key);
+      caught_up = ec == ErrorCode::OK;
+    } else if (it->second.state != ObjectState::kComplete) {
+      // Removed AND re-created: the successful remove already deleted the
+      // stale record, and a pending object must leave no durable trace until
+      // put_complete commits — drop the entry without writing anything.
+      ec = ErrorCode::OK;
+    } else {
+      ec = persist_object(key, it->second);
+      caught_up = ec == ErrorCode::OK;
+    }
+    if (ec == ErrorCode::OK) {
+      // Erase while still holding the objects lock: mutators mark keys dirty
+      // under the unique lock, so a FRESHER dirty mark (splice + failed
+      // persist racing this loop) cannot be interleaved and wiped here.
+      std::lock_guard<std::mutex> dirty(persist_retry_mutex_);
+      persist_retry_.erase(key);
+      if (caught_up) {
+        LOG_INFO << "durable record for " << key << " caught up after deferred persist";
+      }
+    } else {
+      // One failed RPC means the coordinator is (still) unreachable or this
+      // node was fenced: stop after ONE timeout instead of paying it per
+      // dirty key — a mass drain/repair during an outage can queue
+      // thousands, and each timed-out RPC under the shared lock stalls
+      // every metadata writer for its duration.
+      return;
+    }
+  }
+}
+
+ErrorCode KeystoneService::coord_put_record(const std::string& key, const std::string& value) {
+  if (!config_.enable_ha) return coordinator_->put(key, value);
+  auto ec = coordinator_->put_fenced(key, value, election_name(), leader_epoch_.load());
+  if (ec == ErrorCode::FENCED) fence_stepdown();
+  return ec;
+}
+
+ErrorCode KeystoneService::coord_del_record(const std::string& key) {
+  if (!config_.enable_ha) return coordinator_->del(key);
+  auto ec = coordinator_->del_fenced(key, election_name(), leader_epoch_.load());
+  if (ec == ErrorCode::FENCED) fence_stepdown();
+  return ec;
+}
+
+void KeystoneService::fence_stepdown() {
+  if (is_leader_.exchange(false)) {
+    LOG_ERROR << "FENCED: this keystone's leader epoch " << leader_epoch_.load()
+              << " is stale (deposed during a stall) — stepping down; the promoted "
+                 "leader's state is untouched";
+    // The keepalive thread owns resign/re-campaign (on_demoted included via
+    // the lease-lost path's machinery); wake it now. The flags are set under
+    // stop_mutex_ so the notify cannot slip between the waiter's predicate
+    // check and its park (lost wakeup = stale node out of the election for
+    // a full refresh interval).
+    {
+      std::lock_guard<std::mutex> lock(stop_mutex_);
+      needs_recampaign_ = true;
+      recampaign_asap_ = true;
+      // on_demoted() cannot run here: the fenced op's caller holds
+      // objects_mutex_ and on_demoted takes it. The keepalive thread runs
+      // the cleanup before its next campaign step.
+      pending_demote_cleanup_ = true;
+    }
+    stop_cv_.notify_all();
+  }
+}
+
+// Replays persisted object records: rebuild metadata and re-adopt allocator
+// ranges so new allocations cannot collide with surviving placements.
+void KeystoneService::load_persisted_objects() {
+  if (!config_.persist_objects) return;
+  auto records = coordinator_->get_with_prefix(coord::objects_prefix(config_.cluster_id));
+  if (!records.ok()) return;
+  const auto prefix = coord::objects_prefix(config_.cluster_id);
+  alloc::PoolMap pools_snapshot;
+  {
+    std::shared_lock lock(registry_mutex_);
+    pools_snapshot = pools_;
+  }
+  size_t restored = 0, dropped = 0;
+  for (const auto& kv : records.value()) {
+    if (kv.key.size() <= prefix.size()) continue;
+    const ObjectKey key = kv.key.substr(prefix.size());
+    switch (apply_object_record(key, kv.value, pools_snapshot)) {
+      case ApplyResult::kApplied:
+        ++restored;
+        break;
+      case ApplyResult::kGarbage:
+        // Undecodable records are purged; deleting garbage is idempotent and
+        // safe from any keystone (leadership is not resolved yet at boot).
+        coordinator_->del(kv.key);
+        ++dropped;
+        break;
+      case ApplyResult::kFailed:
+        // Transient (e.g. pools not yet advertised): keep the durable
+        // record — a later reconcile can still resurrect the object.
+        ++dropped;
+        break;
+    }
+  }
+  if (restored || dropped) {
+    LOG_INFO << "restored " << restored << " persisted objects (" << dropped << " dropped)";
+  }
+}
+
+KeystoneService::ApplyResult KeystoneService::apply_object_record(
+    const ObjectKey& key, const std::string& bytes, const alloc::PoolMap& pools) {
+  {
+    // A record from a bumped future format is unusable by this build but is
+    // NOT garbage: report kFailed so callers keep the durable record (a
+    // newer keystone will serve it) instead of deleting object metadata.
+    wire::Reader probe(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
+    if (take_record_envelope(probe) == RecordEra::kFuture) return ApplyResult::kFailed;
+  }
+  ObjectRecord rec;
+  if (!decode_object_record(bytes, rec)) return ApplyResult::kGarbage;
+  // Keep only copies whose every shard still maps onto a live pool.
+  std::vector<CopyPlacement> live_copies;
+  std::vector<std::pair<MemoryPoolId, alloc::Range>> ranges;
+  for (const auto& copy : rec.copies) {
+    if (append_copy_ranges(copy, pools, ranges)) live_copies.push_back(copy);
+  }
+  if (live_copies.empty()) return ApplyResult::kFailed;
+
+  std::unique_lock lock(objects_mutex_);
+  std::optional<ObjectInfo> previous;
+  if (auto it = objects_.find(key); it != objects_.end()) {
+    // Replace semantics: the record wins. The old ranges must be freed
+    // before adopting the new ones (records usually reuse most of them).
+    previous = std::move(it->second);
+    adapter_.free_object(key);
+    objects_.erase(it);
+  }
+  if (adapter_.adopt_allocation(key, ranges, pools) != ErrorCode::OK) {
+    // Put the previous (still valid) state back rather than silently
+    // destroying a serveable object over a transient adoption failure.
+    if (previous) {
+      auto old_ranges = map_copies_to_ranges(previous->copies, pools);
+      if (old_ranges &&
+          adapter_.adopt_allocation(key, *old_ranges, pools) == ErrorCode::OK) {
+        objects_[key] = std::move(*previous);
+      } else {
+        LOG_ERROR << "object " << key << " lost during record re-apply";
+        bump_view();
+      }
+    }
+    return ApplyResult::kFailed;
+  }
+  const auto steady_now = std::chrono::steady_clock::now();
+  const int64_t wall_now = now_wall_ms();
+  ObjectInfo info;
+  info.size = rec.size;
+  info.ttl_ms = rec.ttl_ms;
+  info.soft_pin = rec.soft_pin;
+  info.state = static_cast<ObjectState>(rec.state);
+  info.config = rec.config;
+  info.copies = std::move(live_copies);
+  auto from_wall = [&](int64_t wall_ms) {
+    return steady_now - std::chrono::milliseconds(std::max<int64_t>(0, wall_now - wall_ms));
+  };
+  info.created_at = from_wall(rec.created_wall_ms);
+  info.last_access = from_wall(rec.last_access_wall_ms);
+  info.epoch = next_epoch_.fetch_add(1);
+  objects_[key] = std::move(info);
+  bump_view();
+  return ApplyResult::kApplied;
+}
+
+void KeystoneService::drop_object_locally(const ObjectKey& key) {
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end()) return;
+  adapter_.free_object(key);
+  objects_.erase(it);
+  bump_view();
+}
+
+}  // namespace btpu::keystone

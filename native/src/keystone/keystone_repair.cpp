@@ -1,0 +1,767 @@
+// Failure handling: dead-worker cleanup, replica re-replication, and
+// erasure-coded reconstruction.
+#include "btpu/keystone/keystone.h"
+
+#include "keystone_internal.h"
+
+#include <algorithm>
+#include <optional>
+#include <unordered_set>
+
+#include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::keystone {
+
+using coord::WatchEvent;
+
+using namespace detail;
+
+// ---- failure handling -----------------------------------------------------
+
+void KeystoneService::cleanup_stale_workers() {
+  const int64_t now = now_wall_ms();
+  const int64_t ttl = config_.worker_heartbeat_ttl_sec * 1000;
+  std::vector<NodeId> stale;
+  {
+    std::shared_lock lock(registry_mutex_);
+    for (const auto& [id, info] : workers_) {
+      if (info.is_stale(now, ttl)) stale.push_back(id);
+    }
+  }
+  for (const auto& id : stale) {
+    LOG_WARN << "worker " << id << " is stale, cleaning up";
+    cleanup_dead_worker(id);
+  }
+}
+
+void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
+  std::vector<MemoryPoolId> dead_pools;
+  {
+    std::unique_lock lock(registry_mutex_);
+    // A worker that dies mid-drain (or after a failed drain) must not leave
+    // its id in draining_ forever — a replacement re-registering under the
+    // same id would be silently unallocatable.
+    draining_.erase(worker_id);
+    if (!workers_.erase(worker_id)) return;  // already handled
+    for (auto it = pools_.begin(); it != pools_.end();) {
+      if (it->second.node_id == worker_id) {
+        dead_pools.push_back(it->first);
+        // Persistent tiers (mmap/io_uring backing files) keep their bytes
+        // across the process: remember the pool's last advertisement so a
+        // restarted worker's re-registration can re-adopt instead of
+        // re-replicating (readopt_offline_pool).
+        if (storage_class_is_persistent(it->second.storage_class)) {
+          offline_pools_[it->first] = it->second;
+        }
+        it = pools_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (const auto& pool_id : dead_pools) adapter_.forget_pool(pool_id);
+  ++counters_.workers_lost;
+
+  // Registry-local cleanup runs on every keystone (each one watches the
+  // heartbeat prefix); coordinator-state deletion and repair are the
+  // leader's job — a standby mutating either would race the leader.
+  if (coordinator_ && is_leader_.load()) {
+    coord_del_record(coord::worker_key(config_.cluster_id, worker_id));
+    for (const auto& pool_id : dead_pools)
+      coord_del_record(coord::pool_key(config_.cluster_id, worker_id, pool_id));
+    coord_del_record(coord::heartbeat_key(config_.cluster_id, worker_id));
+  }
+  bump_view();
+  LOG_WARN << "worker " << worker_id << " removed (" << dead_pools.size() << " pools)";
+
+  if (config_.enable_repair && is_leader_.load()) {
+    const size_t repaired = repair_objects_for_dead_worker(worker_id);
+    if (repaired) {
+      LOG_INFO << "repaired " << repaired << " objects after losing " << worker_id;
+    }
+  }
+}
+
+// Rebuilds every object that had placements on `worker_id` from a surviving
+// replica over the data plane. The reference has no equivalent — placements
+// dangle after worker death (SURVEY §3.5) — but TPU-VM preemption makes
+// repair mandatory (SURVEY §7 hard parts).
+size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) {
+  // Full registry view for range release (draining workers' ranges must
+  // still map back correctly); ALLOCATION targets exclude draining workers.
+  alloc::PoolMap live_pools;
+  {
+    std::shared_lock lock(registry_mutex_);
+    live_pools = pools_;
+  }
+  const alloc::PoolMap target_pools = allocatable_pools_snapshot();
+
+  // Pass 1 — metadata only, under the lock: prune dead placements so clients
+  // stop dialing the dead worker immediately, drop objects that lost every
+  // copy, and queue the rest for re-replication. No data moves here, so the
+  // lock hold is bounded by map size, not object bytes.
+  struct PendingRepair {
+    ObjectKey key;
+    uint64_t size{0};
+    uint64_t epoch{0};
+    size_t needed{0};
+    WorkerConfig config;
+    std::vector<CopyPlacement> surviving;
+  };
+  struct PendingEcRepair {
+    ObjectKey key;
+    uint64_t epoch{0};
+    CopyPlacement copy;  // snapshot, dead shards still listed at their indices
+    std::vector<size_t> dead_idx;
+    WorkerConfig config;
+  };
+  std::vector<PendingEcRepair> ec_pending;
+  // Live-worker snapshot for EC recoverability counting (a coded object may
+  // already carry shards lost to EARLIER deaths; tolerance is cumulative).
+  std::unordered_set<NodeId> live_workers;
+  {
+    std::shared_lock lock(registry_mutex_);
+    for (const auto& [id, w] : workers_) {
+      if (id != worker_id) live_workers.insert(id);
+    }
+  }
+
+  std::vector<PendingRepair> pending;
+  // Any durable write that fails mid-pass defers the rest of this worker's
+  // repair to the health loop (repair_retry_): the death event fires once,
+  // so without the retry a transient coordinator outage would strand
+  // objects with dead placements forever.
+  bool deferred = false;
+  {
+    std::unique_lock lock(objects_mutex_);
+    for (auto it = objects_.begin(); it != objects_.end();) {
+      if (!is_leader_.load()) {  // deposed mid-pass: stop issuing doomed RPCs
+        deferred = true;
+        break;
+      }
+      ObjectInfo& info = it->second;
+      auto damaged = [&](const CopyPlacement& copy) {
+        return std::any_of(copy.shards.begin(), copy.shards.end(),
+                           [&](const ShardPlacement& s) { return s.worker_id == worker_id; });
+      };
+
+      // Pooled put slots touching the dead worker are simply cancelled: no
+      // writer is attached, so there is nothing to repair, spare, or count
+      // as lost — the owning client's commit misses and falls back.
+      if (info.slot && std::any_of(info.copies.begin(), info.copies.end(), damaged)) {
+        const ObjectKey key = it->first;
+        for (const auto& copy : info.copies) {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id)
+              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          }
+        }
+        slot_objects_.fetch_sub(1);
+        free_object_locked(key, info);
+        it = objects_.erase(it);
+        ++counters_.put_cancels;
+        bump_view();
+        continue;
+      }
+
+      // Erasure-coded objects have ONE copy whose shard ORDER is the code
+      // geometry — the copy is never dropped whole. Dead shards stay listed
+      // (clients fail reading them and reconstruct from any k survivors:
+      // degraded-but-readable); only past the parity tolerance is the
+      // object gone. Dead-worker range bookkeeping is released either way.
+      if (!info.copies.empty() && info.copies.front().ec_data_shards > 0) {
+        CopyPlacement& copy = info.copies.front();
+        if (!damaged(copy)) {
+          ++it;
+          continue;
+        }
+        const ObjectKey key = it->first;
+        size_t dead = 0;
+        for (const auto& shard : copy.shards) {
+          if (!live_workers.contains(shard.worker_id)) ++dead;
+        }
+        auto drop_dead_worker_bookkeeping = [&] {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id)
+              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          }
+        };
+        if (dead > copy.ec_parity_shards) {
+          // Same persistent-tier exception as the replicated loss branch.
+          bool adoptable = true;
+          {
+            std::shared_lock rlock(registry_mutex_);
+            for (const auto& shard : copy.shards) {
+              if (live_workers.contains(shard.worker_id)) continue;
+              if (!offline_pools_.contains(shard.pool_id)) {
+                adoptable = false;
+                break;
+              }
+            }
+          }
+          if (adoptable) {
+            ++counters_.objects_offline;
+            LOG_WARN << "coded object " << key << " OFFLINE past tolerance with worker "
+                     << worker_id << ": bytes persist on file-backed pools — kept for "
+                        "re-adoption at restart";
+            ++it;
+            continue;
+          }
+          LOG_WARN << "coded object " << key << " lost " << dead << " shards (tolerance "
+                   << copy.ec_parity_shards << ") with worker " << worker_id;
+          // Fence-first: a deposed leader must not free the survivors'
+          // ranges; the promoted leader owns the loss accounting.
+          if (unpersist_object(key) != ErrorCode::OK) {
+            deferred = true;
+            ++it;
+            continue;
+          }
+          drop_dead_worker_bookkeeping();
+          adapter_.free_object(key);
+          it = objects_.erase(it);
+          ++counters_.objects_lost;
+          bump_view();
+          continue;
+        }
+        // Persist the bumped epoch BEFORE touching allocator state: a
+        // rejected durable write (deposed leader / coordinator outage)
+        // leaves the object exactly as the durable record describes it.
+        const uint64_t prev_epoch = info.epoch;
+        info.epoch = next_epoch_.fetch_add(1);
+        if (persist_object(key, info) != ErrorCode::OK) {
+          info.epoch = prev_epoch;
+          deferred = true;
+          ++it;
+          continue;
+        }
+        drop_dead_worker_bookkeeping();
+        bump_view();
+        if (info.state == ObjectState::kComplete) {
+          // Queue reconstruction of EVERY dead shard (including ones from
+          // earlier deaths): without healing, losses accumulate until the
+          // tolerance is exceeded and a recoverable object dies.
+          std::vector<size_t> dead_idx;
+          for (size_t si = 0; si < copy.shards.size(); ++si) {
+            if (!live_workers.contains(copy.shards[si].worker_id)) dead_idx.push_back(si);
+          }
+          ec_pending.push_back({key, info.epoch, copy, std::move(dead_idx), info.config});
+        }
+        ++it;
+        continue;
+      }
+      std::vector<CopyPlacement> surviving;
+      bool any_damaged = false;
+      for (const auto& copy : info.copies) {
+        if (damaged(copy)) {
+          any_damaged = true;
+        } else {
+          surviving.push_back(copy);
+        }
+      }
+      if (!any_damaged) {
+        ++it;
+        continue;
+      }
+      const ObjectKey key = it->first;
+      if (surviving.empty()) {
+        // Persistent-tier exception: a copy whose every dead shard sits on
+        // an OFFLINE PERSISTENT pool (mmap/io_uring backing file — the
+        // bytes outlive the process) is kept intact, placements and
+        // durable record untouched, and re-validated + refreshed when the
+        // restarted worker re-registers the pool (readopt_offline_pool).
+        // The reference's disk bytes also survive restarts
+        // (iouring_disk_backend.cpp:419-438) but its keystone forgets the
+        // metadata; here neither side forgets.
+        bool adoptable = false;
+        {
+          std::shared_lock rlock(registry_mutex_);
+          for (const auto& copy : info.copies) {
+            bool ok = !copy.shards.empty();
+            for (const auto& shard : copy.shards) {
+              if (live_workers.contains(shard.worker_id)) continue;
+              if (!offline_pools_.contains(shard.pool_id)) {
+                ok = false;
+                break;
+              }
+            }
+            if (ok) {
+              adoptable = true;
+              break;
+            }
+          }
+        }
+        if (adoptable) {
+          ++counters_.objects_offline;
+          LOG_WARN << "object " << key << " OFFLINE with worker " << worker_id
+                   << ": bytes persist on its file-backed pools — kept for "
+                      "re-adoption at restart, not re-replicated";
+          ++it;
+          continue;
+        }
+        LOG_WARN << "object " << key << " lost all replicas with worker " << worker_id;
+        // Fence-first, as in the coded branch above.
+        if (unpersist_object(key) != ErrorCode::OK) {
+          deferred = true;
+          ++it;
+          continue;
+        }
+        // Dead-worker shards lose only their bookkeeping (a later free of
+        // ranges on a re-registered pool would corrupt the fresh free-map).
+        for (const auto& copy : info.copies) {
+          for (const auto& shard : copy.shards) {
+            if (shard.worker_id == worker_id)
+              adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          }
+        }
+        adapter_.free_object(key);
+        it = objects_.erase(it);
+        ++counters_.objects_lost;
+        bump_view();
+        continue;
+      }
+      // Make the pruned state durable BEFORE releasing any ranges: if the
+      // durable write is rejected (deposed leader / coordinator outage),
+      // this node must not hand ranges the durable record — and therefore
+      // the promoted leader — still maps back to the pools.
+      ObjectInfo updated = info;
+      updated.copies = surviving;
+      for (size_t i = 0; i < updated.copies.size(); ++i) updated.copies[i].copy_index = i;
+      updated.epoch = next_epoch_.fetch_add(1);
+      if (persist_object(key, updated) != ErrorCode::OK) {
+        deferred = true;
+        ++it;
+        continue;
+      }
+      // Every damaged copy is dropped whole, so release all its ranges now:
+      // dead-worker shards lose only their bookkeeping (see above), while
+      // live-worker shards of a partially-damaged striped copy hand their
+      // bytes back to the pool — otherwise worker churn slowly fills the
+      // surviving pools with orphaned, unreadable ranges.
+      for (const auto& copy : info.copies) {
+        if (!damaged(copy)) continue;
+        for (const auto& shard : copy.shards) {
+          if (shard.worker_id == worker_id) {
+            adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
+          } else if (auto pr = shard_to_range(shard, live_pools)) {
+            adapter_.allocator().release_range(key, pr->first, pr->second);
+          }
+        }
+      }
+      info = std::move(updated);
+      const size_t needed = info.config.replication_factor > surviving.size()
+                                ? info.config.replication_factor - surviving.size()
+                                : 0;
+      bump_view();
+      if (needed > 0 && info.state == ObjectState::kComplete) {
+        pending.push_back(
+            {key, info.size, info.epoch, needed, info.config, std::move(surviving)});
+      }
+      ++it;
+    }
+  }
+
+  // Pass 2 — no metadata lock while bytes move: stage the top-up copies
+  // under a temporary allocator key, stream from a survivor, then merge the
+  // staging allocation into the object atomically iff its epoch is unchanged.
+  size_t repaired = 0;
+  for (auto& p : pending) {
+    if (!is_leader_.load()) {  // deposed mid-repair: stop streaming
+      deferred = true;
+      break;
+    }
+    const ObjectKey staging_key = p.key + "\x01" "repair";
+    alloc::AllocationRequest req =
+        alloc::KeystoneAllocatorAdapter::to_allocation_request(staging_key, p.size, p.config);
+    req.replication_factor = p.needed;
+    // Anti-affinity: a repaired copy must not land behind a failure domain
+    // that already holds a survivor; relax only if the cluster is too small.
+    for (const auto& copy : p.surviving) {
+      for (const auto& shard : copy.shards) {
+        if (std::find(req.excluded_nodes.begin(), req.excluded_nodes.end(),
+                      shard.worker_id) == req.excluded_nodes.end())
+          req.excluded_nodes.push_back(shard.worker_id);
+      }
+    }
+    auto attempt = adapter_.allocator().allocate(req, target_pools);
+    if (!attempt.ok()) {
+      req.excluded_nodes.clear();
+      attempt = adapter_.allocator().allocate(req, target_pools);
+    }
+    if (!attempt.ok()) {
+      // No room to re-replicate: the object stays degraded on its survivors
+      // (pass 1 already pruned the dead placements) — never deleted.
+      LOG_WARN << "repair of " << p.key << " degraded to " << p.surviving.size()
+               << " copies: " << to_string(attempt.error());
+      continue;
+    }
+    std::vector<CopyPlacement> staged = std::move(attempt).value().copies;
+
+    const CopyPlacement* streamed_src = nullptr;
+    bool used_unchecked = false;
+    for (const auto& src : p.surviving) {
+      // live_pools: the full registry snapshot from the top of the pass —
+      // the fabric lane needs fabric_addr for BOTH ends' pools.
+      used_unchecked = false;
+      if (copy_object_bytes(*data_client_, src, staged, p.size, &live_pools,
+                            &counters_.fabric_moves, &used_unchecked) == ErrorCode::OK) {
+        streamed_src = &src;
+        break;
+      }
+    }
+    if (!streamed_src) {
+      adapter_.free_object(staging_key);
+      deferred = true;  // survivors still serve reads; health loop retries
+      continue;
+    }
+
+    std::unique_lock lock(objects_mutex_);
+    auto it = objects_.find(p.key);
+    if (it == objects_.end() || it->second.epoch != p.epoch) {
+      lock.unlock();
+      adapter_.free_object(staging_key);
+      continue;  // object changed while the bytes moved; its new state wins
+    }
+    if (adapter_.allocator().merge_objects(staging_key, p.key) != ErrorCode::OK) {
+      lock.unlock();
+      LOG_ERROR << "repair merge failed for " << p.key;
+      adapter_.free_object(staging_key);
+      deferred = true;
+      continue;
+    }
+    for (auto& copy : staged) {
+      copy.copy_index = it->second.copies.size();
+      copy.content_crc = it->second.copies.empty()
+                             ? 0
+                             : it->second.copies.front().content_crc;
+      carry_shard_crcs(*streamed_src, copy);
+      it->second.copies.push_back(std::move(copy));
+    }
+    it->second.epoch = next_epoch_.fetch_add(1);
+    // Fabric- and chip-to-chip-moved bytes bypassed the staged lane's
+    // streaming CRC gate but carry the source's stamps: have the scrub
+    // verify them ahead of its ring walk (and heal from a sibling if the
+    // source was rotten).
+    if (used_unchecked) queue_scrub_target(p.key);
+    if (auto ec = persist_object(p.key, it->second); ec != ErrorCode::OK) {
+      // The merge already landed locally (memory + allocator are consistent)
+      // but the durable record is stale. A coordinator outage heals at this
+      // key's next successful persist; a fence means this node is deposed
+      // and the promoted leader's reconcile-on-promotion owns the truth.
+      // Either way the repair cannot be claimed. The splice is irreversible
+      // in memory, so queue the key for the health loop's re-persist — a
+      // healthy object is never revisited by repair, so nothing else would
+      // ever write the record again.
+      LOG_ERROR << "repair of " << p.key << " not durably recorded: " << to_string(ec);
+      mark_persist_dirty(p.key);
+      bump_view();
+      deferred = true;
+      continue;
+    }
+    ++counters_.objects_repaired;
+    ++repaired;
+    bump_view();
+  }
+
+  // Pass 2b — erasure-coded objects: reconstruct every dead shard from any
+  // k survivors (segmented, bounded memory) onto fresh placements and
+  // splice them in at their geometry positions. Without this, coded
+  // objects never heal — losses accumulate across deaths until tolerance
+  // is exceeded and a recoverable object dies.
+  for (auto& r : ec_pending) {
+    if (!is_leader_.load()) {  // deposed mid-repair: stop streaming
+      deferred = true;
+      break;
+    }
+    if (repair_ec_object(r.key, r.epoch, r.copy, r.dead_idx, target_pools)) {
+      ++counters_.objects_repaired;
+      ++repaired;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lock(repair_retry_mutex_);
+    if (deferred) {
+      repair_retry_.insert(worker_id);
+    } else {
+      repair_retry_.erase(worker_id);
+    }
+  }
+  return repaired;
+}
+
+// Rebuilds the dead shards of one coded copy. Returns true when the object
+// was fully healed (every dead shard reconstructed and spliced).
+//
+// When the copy carries per-shard CRC stamps, every shard read during
+// reconstruction is screened against its stamp. A live-but-rotten shard
+// must never serve as a reconstruction basis (the rebuild would be garbage,
+// restamped as valid — turning recoverable rot into permanent loss);
+// instead it is promoted to a repair target itself, so repair heals silent
+// corruption in the same pass that heals worker death.
+bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
+                                       const CopyPlacement& copy,
+                                       const std::vector<size_t>& dead_idx,
+                                       const alloc::PoolMap& target_pools) {
+  if (dead_idx.empty()) return false;
+  const size_t k = copy.ec_data_shards;
+  const size_t m = copy.ec_parity_shards;
+  const size_t n = copy.shards.size();
+  if (k == 0 || n != k + m) return false;
+  const uint64_t L = copy.shards.front().length;
+  const bool stamped = copy.shard_crcs.size() == n;
+
+  // Repair targets: the caller's dead shards, plus any live shard a CRC
+  // screen condemns below (each retry may extend this list).
+  std::vector<size_t> targets = dead_idx;
+  const std::vector<size_t> original_dead = dead_idx;
+
+  struct Staged {
+    std::string staging_key;
+    CopyPlacement placement;
+  };
+  std::vector<Staged> staged;
+  auto free_all_staged = [&] {
+    for (auto& st : staged) adapter_.free_object(st.staging_key);
+    staged.clear();
+  };
+  std::vector<uint32_t> rebuilt_crcs;
+
+  // Each attempt either completes the segmented reconstruction with a clean
+  // basis, or condemns at least one more shard (bounded by tolerance m).
+  for (;;) {
+    std::vector<bool> dead(n, false);
+    for (size_t d : targets) dead[d] = true;
+
+    // 1. Fresh placements, one plain wire shard per target index;
+    // anti-affine with every worker the copy still touches (and earlier
+    // replacements).
+    std::vector<NodeId> excluded;
+    for (size_t i = 0; i < n; ++i) {
+      if (!dead[i]) excluded.push_back(copy.shards[i].worker_id);
+    }
+    staged.assign(targets.size(), {});
+    bool staged_ok = true;
+    for (size_t j = 0; j < targets.size() && staged_ok; ++j) {
+      const size_t d = targets[j];
+      WorkerConfig cfg = {};
+      cfg.replication_factor = 1;
+      cfg.max_workers_per_copy = 1;
+      staged[j].staging_key = key + "\x01" "ecrepair" + std::to_string(d);
+      alloc::AllocationRequest req = alloc::KeystoneAllocatorAdapter::to_allocation_request(
+          staged[j].staging_key, L, cfg);
+      // Stay in a wire tier (a device shard would be unreadable to the coded
+      // client path, even on the relaxed retry); same class as the lost
+      // shard when possible.
+      req.wire_only = true;
+      req.preferred_classes = {copy.shards[d].storage_class};
+      req.excluded_nodes = excluded;
+      auto attempt = adapter_.allocator().allocate(req, target_pools);
+      if (!attempt.ok()) {
+        req.excluded_nodes.clear();
+        attempt = adapter_.allocator().allocate(req, target_pools);
+      }
+      // The coded geometry needs exactly ONE shard at this position.
+      if (!attempt.ok() || attempt.value().copies[0].shards.size() != 1 ||
+          std::holds_alternative<DeviceLocation>(
+              attempt.value().copies[0].shards[0].location)) {
+        if (attempt.ok()) adapter_.free_object(staged[j].staging_key);
+        staged.resize(j);
+        staged_ok = false;
+        LOG_WARN << "ec repair of " << key << " stays degraded: no placement for shard "
+                 << d;
+        break;
+      }
+      staged[j].placement = std::move(attempt).value().copies[0];
+      excluded.push_back(staged[j].placement.shards[0].worker_id);
+    }
+    if (!staged_ok) {
+      free_all_staged();
+      return false;
+    }
+
+    // 2. Segmented reconstruction: read each segment from k survivors,
+    // rebuild missing data rows, re-encode missing parity rows, write out.
+    constexpr uint64_t kSeg = 8ull << 20;
+    std::vector<size_t> basis;  // the k survivors we read (data first)
+    for (size_t i = 0; i < n && basis.size() < k; ++i) {
+      if (!dead[i]) basis.push_back(i);
+    }
+    if (basis.size() < k) {
+      free_all_staged();
+      return false;  // beyond tolerance (pass 1 should have caught this)
+    }
+    bool parity_dead = false;
+    for (size_t d : targets) parity_dead |= d >= k;
+
+    std::vector<std::vector<uint8_t>> seg_bufs(n);  // read/rebuilt segments
+    const uint64_t seg_cap = std::min<uint64_t>(L, kSeg);
+    for (size_t i : basis) seg_bufs[i].resize(seg_cap);
+    for (size_t d : targets) seg_bufs[d].resize(seg_cap);
+    // Parity re-encode needs every data row; data rows outside the basis and
+    // not dead can stay empty unless parity is being rebuilt.
+    if (parity_dead) {
+      for (size_t i = 0; i < k; ++i) seg_bufs[i].resize(seg_cap);
+    }
+    std::vector<std::vector<uint8_t>> parity_rows;
+    if (parity_dead) parity_rows.assign(m, std::vector<uint8_t>(seg_cap));
+    rebuilt_crcs.assign(targets.size(), 0);
+    // Incremental CRC per shard we read, for the basis screen.
+    std::vector<uint32_t> read_crcs(n, 0);
+    std::vector<bool> was_read(n, false);
+
+    bool io_failed = false;
+    for (uint64_t off = 0; off < L && !io_failed; off += kSeg) {
+      const uint64_t seg = std::min(kSeg, L - off);
+      std::vector<const uint8_t*> present(n, nullptr);
+      for (size_t i : basis) {
+        if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(), seg,
+                                /*is_write=*/false) != ErrorCode::OK) {
+          LOG_WARN << "ec repair of " << key << " stays degraded: survivor " << i
+                   << " unreadable";
+          io_failed = true;
+          break;
+        }
+        read_crcs[i] = crc32c(seg_bufs[i].data(), seg, read_crcs[i]);
+        was_read[i] = true;
+        present[i] = seg_bufs[i].data();
+      }
+      if (io_failed) break;
+      // Data rows needed for parity re-encode but outside the basis (only
+      // possible when they are alive: read them too).
+      if (parity_dead) {
+        for (size_t i = 0; i < k; ++i) {
+          if (present[i] || dead[i]) continue;
+          if (transport::shard_io(*data_client_, copy.shards[i], off, seg_bufs[i].data(),
+                                  seg,
+                                  /*is_write=*/false) != ErrorCode::OK) {
+            io_failed = true;
+            break;
+          }
+          read_crcs[i] = crc32c(seg_bufs[i].data(), seg, read_crcs[i]);
+          was_read[i] = true;
+          present[i] = seg_bufs[i].data();
+        }
+        if (io_failed) break;
+      }
+      std::vector<uint8_t*> out(k, nullptr);
+      for (size_t d : targets) {
+        if (d < k) out[d] = seg_bufs[d].data();
+      }
+      if (!ec::rs_reconstruct(present.data(), k, m, seg, out.data())) {
+        io_failed = true;
+        break;
+      }
+      if (parity_dead) {
+        std::vector<const uint8_t*> data_rows(k);
+        for (size_t i = 0; i < k; ++i) data_rows[i] = seg_bufs[i].data();
+        std::vector<uint8_t*> parity_ptrs(m);
+        for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity_rows[j].data();
+        if (!ec::rs_encode(data_rows.data(), k, parity_ptrs.data(), m, seg)) {
+          io_failed = true;
+          break;
+        }
+      }
+      for (size_t j = 0; j < targets.size(); ++j) {
+        const size_t d = targets[j];
+        const uint8_t* src = d < k ? seg_bufs[d].data() : parity_rows[d - k].data();
+        if (transport::shard_io(*data_client_, staged[j].placement.shards[0], off,
+                                const_cast<uint8_t*>(src), seg,
+                                /*is_write=*/true) != ErrorCode::OK) {
+          io_failed = true;
+          break;
+        }
+        // Restamp as we write: segments stream in order, so the incremental
+        // CRC over them IS the rebuilt shard's CRC32C.
+        rebuilt_crcs[j] = crc32c(src, seg, rebuilt_crcs[j]);
+      }
+    }
+    if (io_failed) {
+      free_all_staged();
+      return false;
+    }
+
+    // 3. The basis screen: a source shard whose bytes fail its stamp fed
+    // garbage into the reconstruction — condemn it, drop this attempt's
+    // staging, and retry with the rotten shard as a repair target too.
+    if (stamped) {
+      std::vector<size_t> condemned;
+      for (size_t i = 0; i < n; ++i) {
+        if (was_read[i] && read_crcs[i] != copy.shard_crcs[i]) condemned.push_back(i);
+      }
+      if (!condemned.empty()) {
+        for (size_t c : condemned) {
+          LOG_WARN << "ec repair of " << key << ": live shard " << c
+                   << " failed its CRC stamp (pool " << copy.shards[c].pool_id
+                   << ", worker " << copy.shards[c].worker_id
+                   << ") — promoting to repair target";
+          targets.push_back(c);
+        }
+        free_all_staged();
+        if (targets.size() > m) {
+          LOG_WARN << "ec repair of " << key << " stays degraded: " << targets.size()
+                   << " dead+rotten shards exceed tolerance m=" << m;
+          return false;
+        }
+        continue;  // retry with a clean basis
+      }
+    }
+    break;  // reconstruction complete with a verified basis
+  }
+
+  // 4. Splice under the lock iff the object didn't change underneath us.
+  std::unique_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end() || it->second.epoch != epoch ||
+      it->second.copies.empty() || it->second.copies.front().shards.size() != n) {
+    lock.unlock();
+    free_all_staged();
+    return false;
+  }
+  for (const auto& st : staged) {
+    if (adapter_.allocator().merge_objects(st.staging_key, key) != ErrorCode::OK) {
+      lock.unlock();
+      LOG_ERROR << "ec repair merge failed for " << key;
+      // Staged keys not yet merged are freed; merged ranges now belong to
+      // the object and are released when it is removed.
+      free_all_staged();
+      return false;
+    }
+  }
+  for (size_t j = 0; j < targets.size(); ++j) {
+    const size_t d = targets[j];
+    // Dead shards' range bookkeeping was already dropped in pass 1 — but a
+    // shard promoted here (live, rotten) still holds its range: release it,
+    // or the pool leaks the space forever.
+    if (std::find(original_dead.begin(), original_dead.end(), d) == original_dead.end()) {
+      if (auto pr = shard_to_range(it->second.copies.front().shards[d], memory_pools())) {
+        adapter_.allocator().release_range(key, pr->first, pr->second);
+      }
+    }
+    // Entries are replaced in place, preserving the geometry order.
+    it->second.copies.front().shards[d] = staged[j].placement.shards[0];
+    if (it->second.copies.front().shard_crcs.size() == n)
+      it->second.copies.front().shard_crcs[d] = rebuilt_crcs[j];
+  }
+  it->second.epoch = next_epoch_.fetch_add(1);
+  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
+    // Same discipline as the replicated merge path: the splice already landed
+    // locally (memory + allocator are consistent) but the durable record is
+    // stale — a promoted leader would still map the condemned shard
+    // locations. The repair cannot be claimed (scrub_healed stays honest),
+    // and because the now-healthy object will never be revisited by repair,
+    // the key is queued for the health loop's re-persist.
+    LOG_ERROR << "ec repair of " << key << " not durably recorded: " << to_string(ec);
+    mark_persist_dirty(key);
+    bump_view();
+    return false;
+  }
+  bump_view();
+  LOG_INFO << "ec repair rebuilt " << targets.size() << " shard(s) of " << key;
+  return true;
+}
+
+}  // namespace btpu::keystone

@@ -1,0 +1,489 @@
+// Integrity: background scrub (verify + heal), priority scrub targets,
+// and restart re-adoption CRC revalidation.
+#include "btpu/keystone/keystone.h"
+
+#include <algorithm>
+#include <optional>
+#include <unordered_set>
+
+#include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
+#include "btpu/storage/hbm_provider.h"
+
+namespace btpu::keystone {
+
+using coord::WatchEvent;
+
+// ---- background scrub ------------------------------------------------------
+//
+// Server-side integrity floor: round-robin over the object map, verified-
+// reading every writer-stamped shard against its CRC32C and healing what it
+// can — replicated shards byte-identically from a healthy copy, coded shards
+// through parity reconstruction (repair_ec_object already treats a corrupt
+// shard as a repair target). This is what makes raw (verify=false) client
+// reads an honest latency trade: the fleet still converges on intact bytes.
+// The reference has no integrity machinery at all.
+void KeystoneService::queue_scrub_target(const ObjectKey& key) {
+  // No scrub thread (interval 0) or no pass budget: nothing will ever drain
+  // the queue, so don't grow it. Movers call this from metadata critical
+  // sections — hence the O(1) set insert, not a scan.
+  if (config_.scrub_interval_sec <= 0 || config_.scrub_objects_per_pass == 0) return;
+  std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
+  scrub_targets_.insert(key);
+}
+
+size_t KeystoneService::run_scrub_once() {
+  if (!is_leader_.load() || config_.scrub_objects_per_pass == 0) return 0;
+  struct Target {
+    ObjectKey key;
+    uint64_t epoch{0};
+    std::vector<CopyPlacement> copies;
+  };
+  std::vector<Target> batch;
+  // Queued targets (fabric-moved objects whose stamps were carried without a
+  // byte check) verify ahead of the ring walk, on top of the pass budget.
+  std::vector<ObjectKey> priority;
+  {
+    // Bounded to the pass budget (so one pass is at most 2x budget): a mass
+    // drain/repair can queue thousands of targets, and an unbounded batch
+    // would full-read them all in one pass, defeating the budget's purpose.
+    // The overflow keeps its priority and drains on subsequent passes.
+    std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
+    auto it = scrub_targets_.begin();
+    while (it != scrub_targets_.end() && priority.size() < config_.scrub_objects_per_pass) {
+      priority.push_back(*it);
+      it = scrub_targets_.erase(it);
+    }
+  }
+  {
+    std::shared_lock lock(objects_mutex_);
+    std::unordered_set<std::string_view> taken_keys;
+    for (const auto& key : priority) {
+      auto it = objects_.find(key);
+      if (it != objects_.end() && it->second.state == ObjectState::kComplete &&
+          taken_keys.insert(it->first).second)
+        batch.push_back({key, it->second.epoch, it->second.copies});
+    }
+    std::vector<const ObjectKey*> keys;
+    keys.reserve(objects_.size());
+    for (const auto& [k, info] : objects_) {
+      if (info.state == ObjectState::kComplete) keys.push_back(&k);
+    }
+    std::sort(keys.begin(), keys.end(),
+              [](const ObjectKey* a, const ObjectKey* b) { return *a < *b; });
+    if (!keys.empty()) {
+      // The smallest keys strictly after the cursor, wrapping — a ring walk.
+      // Keys already taken as priority targets are visited (the cursor must
+      // advance past them) but not scrubbed twice in one pass.
+      auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_,
+                                    [](const ObjectKey& c, const ObjectKey* k) { return c < *k; });
+      const ObjectKey* last_visited = nullptr;
+      for (size_t taken = 0; taken < config_.scrub_objects_per_pass &&
+                             taken < keys.size();
+           ++taken) {
+        if (start == keys.end()) start = keys.begin();
+        last_visited = *start;
+        if (!taken_keys.contains(**start)) {
+          const auto& info = objects_.at(**start);
+          batch.push_back({**start, info.epoch, info.copies});
+        }
+        ++start;
+      }
+      if (last_visited) scrub_cursor_ = *last_visited;
+    }
+  }
+  if (batch.empty()) return 0;
+
+  const alloc::PoolMap target_pools = allocatable_pools_snapshot();
+  constexpr uint64_t kSeg = 4ull << 20;  // bounded scrub memory
+  std::vector<uint8_t> buf;
+  // One segmented read-and-CRC walk shared by every verify/heal path; the
+  // reader fills buf with segment [off, off+n).
+  auto segmented_crc = [&](uint64_t len, auto&& reader) -> std::optional<uint32_t> {
+    uint32_t crc = 0;
+    for (uint64_t off = 0; off < len; off += kSeg) {
+      const uint64_t n = std::min(kSeg, len - off);
+      buf.resize(n);
+      if (!reader(off, n)) return std::nullopt;
+      crc = crc32c(buf.data(), n, crc);
+    }
+    return crc;
+  };
+  size_t corrupt_found = 0;
+  for (const auto& t : batch) {
+    if (!is_leader_.load()) break;
+    ++counters_.scrub_checked;
+    // Coded object: CRC every stamped shard; corrupt ones become repair
+    // targets for parity reconstruction (onto FRESH placements — never an
+    // in-place write through a snapshot).
+    if (!t.copies.empty() && t.copies.front().ec_data_shards > 0) {
+      const CopyPlacement& copy = t.copies.front();
+      // Unstamped coded = a put that never stamped (nothing to verify
+      // against). No mover can strip a coded copy's stamps: every mover
+      // preserves coded geometry 1:1 (drain rejects fragmented staging,
+      // demote/repair require exact positions), so stamps always carry.
+      if (copy.shard_crcs.size() != copy.shards.size()) continue;
+      std::vector<size_t> corrupt;
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        const auto crc = segmented_crc(copy.shards[i].length, [&](uint64_t off, uint64_t n) {
+          return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+                                     /*is_write=*/false) == ErrorCode::OK;
+        });
+        if (crc && *crc != copy.shard_crcs[i]) corrupt.push_back(i);
+      }
+      if (!corrupt.empty()) {
+        corrupt_found += corrupt.size();
+        counters_.scrub_corrupt += corrupt.size();
+        for (size_t i : corrupt) {
+          LOG_WARN << "scrub: corrupt coded shard " << i << " of " << t.key << " (pool "
+                   << copy.shards[i].pool_id << ", worker " << copy.shards[i].worker_id
+                   << "); reconstructing through parity";
+        }
+        if (repair_ec_object(t.key, t.epoch, copy, corrupt, target_pools)) {
+          counters_.scrub_healed += corrupt.size();
+        }
+      }
+      continue;
+    }
+    // Replicated/striped object: per-copy shard CRCs; a corrupt shard is
+    // restored byte-identically from a sibling copy (shard boundaries
+    // differ per copy, so the heal reads the logical BYTE RANGE through
+    // copy_range_io). The heal is ONE pass per sibling: read a sibling
+    // segment, write it over the corrupt shard, accumulate the CRC; only a
+    // final CRC matching the stamp counts as healed — the destination was
+    // already corrupt, so intermediate wrong bytes cost nothing. Every
+    // segment's WRITE runs under a shared objects lock with the epoch
+    // re-checked (the sibling read stays lock-free), so a concurrent
+    // mover/remove (unique lock + epoch bump) can never let the write land
+    // on a freed, reallocated range.
+    for (size_t ci = 0; ci < t.copies.size(); ++ci) {
+      const CopyPlacement& copy = t.copies[ci];
+      if (copy.shard_crcs.size() != copy.shards.size()) {
+        // Unstamped — a 1:n drain splice cleared the stamps, or the mover's
+        // geometry prevented carrying them — but the whole-copy CRC still
+        // travels with every verified put. Verify the copy end to end so
+        // fabric/device-moved bytes cannot escape revalidation just because
+        // per-shard stamps could not carry; heal is whole-copy from a
+        // sibling under the same epoch-guarded write discipline.
+        if (copy.content_crc == 0) continue;
+        uint64_t total = 0;
+        for (const auto& s : copy.shards) total += s.length;
+        const auto crc = segmented_crc(total, [&](uint64_t off, uint64_t n) {
+          return transport::copy_range_io(*data_client_, copy, off, buf.data(), n,
+                                          /*is_write=*/false) == ErrorCode::OK;
+        });
+        if (!crc || *crc == copy.content_crc) continue;
+        ++corrupt_found;
+        ++counters_.scrub_corrupt;
+        LOG_WARN << "scrub: corrupt unstamped copy " << ci << " of " << t.key
+                 << "; healing whole-copy from a sibling";
+        bool healed = false;
+        bool stale = false;
+        for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
+          if (sj == ci) continue;
+          const auto src_crc = segmented_crc(total, [&](uint64_t off, uint64_t n) {
+            if (transport::copy_range_io(*data_client_, t.copies[sj], off, buf.data(), n,
+                                         /*is_write=*/false) != ErrorCode::OK)
+              return false;
+            std::shared_lock lock(objects_mutex_);
+            auto it = objects_.find(t.key);
+            if (it == objects_.end() || it->second.epoch != t.epoch) {
+              stale = true;
+              return false;
+            }
+            return transport::copy_range_io(*data_client_, copy, off, buf.data(), n,
+                                            /*is_write=*/true) == ErrorCode::OK;
+          });
+          healed = src_crc && *src_crc == copy.content_crc;
+        }
+        if (healed) {
+          ++counters_.scrub_healed;
+          LOG_INFO << "scrub: healed unstamped copy " << ci << " of " << t.key;
+        } else if (!stale) {
+          LOG_WARN << "scrub: no intact sibling for unstamped copy " << ci << " of "
+                   << t.key << " — detect-only";
+        }
+        continue;
+      }
+      uint64_t shard_off = 0;
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        const uint64_t len = copy.shards[i].length;
+        const auto crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
+          return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+                                     /*is_write=*/false) == ErrorCode::OK;
+        });
+        if (crc && *crc != copy.shard_crcs[i]) {
+          ++corrupt_found;
+          ++counters_.scrub_corrupt;
+          LOG_WARN << "scrub: corrupt shard " << i << " of " << t.key << " copy " << ci
+                   << " (pool " << copy.shards[i].pool_id << ", worker "
+                   << copy.shards[i].worker_id << "); healing from a sibling copy";
+          bool healed = false;
+          bool stale = false;
+          for (size_t sj = 0; sj < t.copies.size() && !healed && !stale; ++sj) {
+            if (sj == ci) continue;
+            const auto src_crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
+              // The sibling read runs lock-free so a hung source worker never
+              // stalls metadata writers behind objects_mutex_; a read off a
+              // concurrently freed range yields garbage, which the epoch
+              // re-check below (or the final CRC gate) discards.
+              if (transport::copy_range_io(*data_client_, t.copies[sj], shard_off + off,
+                                           buf.data(), n,
+                                           /*is_write=*/false) != ErrorCode::OK)
+                return false;
+              std::shared_lock lock(objects_mutex_);
+              auto it = objects_.find(t.key);
+              if (it == objects_.end() || it->second.epoch != t.epoch) {
+                stale = true;
+                return false;
+              }
+              return transport::shard_io(*data_client_, copy.shards[i], off, buf.data(), n,
+                                         /*is_write=*/true) == ErrorCode::OK;
+            });
+            healed = src_crc && *src_crc == copy.shard_crcs[i];
+          }
+          if (healed) {
+            ++counters_.scrub_healed;
+            LOG_INFO << "scrub: healed shard " << i << " of " << t.key << " copy " << ci;
+          } else if (!stale) {
+            LOG_WARN << "scrub: no intact sibling for shard " << i << " of " << t.key
+                     << " copy " << ci << " — detect-only (replica failover still "
+                        "serves reads from other copies)";
+          }
+        }
+        shard_off += len;
+      }
+    }
+  }
+  return corrupt_found;
+}
+
+
+
+// Own thread (like GC): a pass does real network I/O, and running it inline
+// on the health thread would stall failure detection and eviction for the
+// pass duration.
+void KeystoneService::scrub_loop() {
+  std::unique_lock<std::mutex> lock(stop_mutex_);
+  while (running_) {
+    stop_cv_.wait_for(lock, std::chrono::seconds(config_.scrub_interval_sec),
+                      [this] { return !running_.load(); });
+    if (!running_) break;
+    lock.unlock();
+    run_scrub_once();
+    lock.lock();
+  }
+}
+
+// The dead worker's backing files came back: spared objects' placements
+// still name the pool with the OLD base address and rkey. Re-carve their
+// ranges into the fresh pool allocator, rewrite placements onto the new
+// advertisement, and re-validate stamped shards by CRC — a stale or
+// replaced backing file must surface as loss, not as silent wrong bytes.
+void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
+  if (!is_leader_.load()) return;  // keep the entry: a promoted leader adopts
+  MemoryPool old;
+  {
+    std::unique_lock lock(registry_mutex_);
+    auto it = offline_pools_.find(pool.id);
+    if (it == offline_pools_.end()) return;
+    old = it->second;
+    offline_pools_.erase(it);
+  }
+  const uint64_t old_base = old.remote.remote_base;
+  const uint64_t new_base = pool.remote.remote_base;
+  uint64_t new_rkey = 0;
+  try {
+    new_rkey = std::stoull(pool.remote.rkey_hex, nullptr, 16);
+  } catch (...) {
+    LOG_ERROR << "re-adoption of pool " << pool.id << ": unparseable rkey";
+    return;
+  }
+
+  // Pass 1 (unique objects lock; metadata only, no network): per object,
+  // CARVE FIRST, rewrite placements only if the carve landed — an object
+  // whose ranges cannot be re-reserved must never be published onto the new
+  // base, or a fresh allocation could overwrite its served bytes.
+  size_t adopted = 0;
+  std::vector<ReadoptCheck> checks;
+  // One-timeout discipline (mirrors retry_dirty_persists): this loop runs on
+  // the coordinator watch thread under the unique objects lock — if the
+  // coordinator is down, the FIRST failed persist proves it, and every
+  // remaining object goes straight to the dirty queue instead of paying a
+  // full RPC timeout each while all metadata operations stall behind us.
+  bool persist_down = false;
+  // This adoption supersedes any outstanding revalidation checks for the
+  // same pool: their lock-free CRC reads may race this pass's placement
+  // rewrite, and condemning bytes this adoption just restored would turn a
+  // healthy pool bounce into data loss. Stamped under objects_mutex_ so
+  // run_readopt_checks (which holds it when acting) sees a stable value.
+  const uint64_t adoption_seq = readopt_seq_counter_.fetch_add(1) + 1;
+  {
+    std::unique_lock lock(objects_mutex_);
+    {
+      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      readopt_seq_[pool.id] = adoption_seq;
+    }
+    for (auto it = objects_.begin(); it != objects_.end();) {
+      auto& [key, info] = *it;
+      struct Hit {
+        CopyPlacement* copy;
+        size_t index;
+        uint64_t offset;
+      };
+      std::vector<Hit> hits;
+      std::vector<alloc::Range> ranges;
+      bool skip_object = false;
+      for (auto& copy : info.copies) {
+        for (size_t i = 0; i < copy.shards.size(); ++i) {
+          ShardPlacement& shard = copy.shards[i];
+          if (shard.pool_id != pool.id) continue;
+          auto* mem = std::get_if<MemoryLocation>(&shard.location);
+          if (!mem || mem->remote_addr < old_base ||
+              mem->remote_addr - old_base + shard.length > pool.size) {
+            skip_object = true;  // unmappable (shrunk/alien pool): stay offline
+            break;
+          }
+          hits.push_back({&copy, i, mem->remote_addr - old_base});
+          ranges.push_back({mem->remote_addr - old_base, shard.length});
+        }
+        if (skip_object) break;
+      }
+      if (hits.empty() || skip_object) {
+        ++it;
+        continue;
+      }
+      if (adapter_.readopt_pool_ranges(pool, ranges) != ErrorCode::OK) {
+        // Cannot re-reserve (overlapping stale metadata): the object must
+        // not serve from unreserved ranges — drop it, fence-first.
+        LOG_ERROR << "re-adoption carve failed for " << key << " on pool " << pool.id
+                  << "; dropping the object";
+        if (unpersist_object(key) == ErrorCode::OK) {
+          free_object_locked(key, info);
+          it = objects_.erase(it);
+          ++counters_.objects_lost;
+        } else {
+          ++it;  // stays offline (old placements); a later pass may retry
+        }
+        continue;
+      }
+      for (const Hit& hit : hits) {
+        ShardPlacement& shard = hit.copy->shards[hit.index];
+        auto& mem = std::get<MemoryLocation>(shard.location);
+        mem.remote_addr = new_base + hit.offset;
+        mem.rkey = new_rkey;
+        shard.remote = pool.remote;
+        shard.worker_id = pool.node_id;
+      }
+      info.epoch = next_epoch_.fetch_add(1);
+      for (const Hit& hit : hits) {
+        if (hit.copy->shard_crcs.size() == hit.copy->shards.size()) {
+          checks.push_back({key, hit.copy->shards[hit.index],
+                            hit.copy->shard_crcs[hit.index], adoption_seq});
+        }
+      }
+      if (persist_down) {
+        mark_persist_dirty(key);
+      } else if (persist_object(key, info) != ErrorCode::OK) {
+        persist_down = true;
+        mark_persist_dirty(key);
+      }
+      ++adopted;
+      ++counters_.objects_adopted;
+      ++it;
+    }
+  }
+  if (adopted) {
+    bump_view();
+    LOG_INFO << "pool " << pool.id << " re-adopted: " << adopted
+             << " offline objects refreshed onto the restarted worker";
+  }
+  if (!checks.empty()) {
+    // Revalidation reads real bytes over the network — queued for the
+    // health loop instead of running inline here: register_memory_pool is
+    // reached from the coordinator watch thread, which must not stall on
+    // streaming a multi-GB pool. Until the checks run, reads are guarded by
+    // the client-side verify default (stale bytes fail their CRC).
+    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
+    readopt_checks_.insert(readopt_checks_.end(),
+                           std::make_move_iterator(checks.begin()),
+                           std::make_move_iterator(checks.end()));
+  }
+}
+
+// Health-loop leg of re-adoption: verify stamped re-adopted shards through
+// the NEW endpoint. The backing file may be stale or replaced — a CRC miss
+// demotes the object to the loss path it was spared from (epoch-guarded
+// against racers); a failed durable delete re-queues the check.
+void KeystoneService::run_readopt_checks() {
+  std::vector<ReadoptCheck> checks;
+  {
+    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
+    checks.swap(readopt_checks_);
+  }
+  if (checks.empty()) return;
+  constexpr uint64_t kSeg = 4ull << 20;
+  std::vector<uint8_t> buf;
+  for (const auto& check : checks) {
+    uint32_t crc = 0;
+    bool io_ok = true;
+    for (uint64_t off = 0; off < check.shard.length && io_ok; off += kSeg) {
+      const uint64_t n = std::min(kSeg, check.shard.length - off);
+      buf.resize(n);
+      io_ok = transport::shard_io(*data_client_, check.shard, off, buf.data(), n,
+                                  /*is_write=*/false) == ErrorCode::OK;
+      if (io_ok) crc = crc32c(buf.data(), n, crc);
+    }
+    if (io_ok && crc == check.expect) continue;
+    LOG_WARN << "re-adopted shard of " << check.key << " failed revalidation ("
+             << (io_ok ? "crc mismatch: stale/replaced backing file" : "unreadable")
+             << "); dropping the object";
+    std::unique_lock lock(objects_mutex_);
+    // A later re-adoption of the same pool supersedes this check: its
+    // placement rewrite may have raced the lock-free CRC read above, and
+    // its OWN queued checks govern the restored bytes. (Checked under
+    // objects_mutex_, which every adoption holds while stamping its seq.)
+    {
+      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      auto seq_it = readopt_seq_.find(check.shard.pool_id);
+      if (seq_it != readopt_seq_.end() && seq_it->second != check.seq) continue;
+    }
+    auto it = objects_.find(check.key);
+    // The check condemns only the exact shard it was queued for: same
+    // placement AND same stamp. An epoch comparison would be both too strict
+    // (a second offline pool's adoption of the same object bumps the epoch
+    // without touching this shard — the revalidation must still run) and
+    // too loose once dropped (a re-put or repair may have landed fresh
+    // bytes at the same address, which this stale expectation must not
+    // drop).
+    if (it == objects_.end()) continue;
+    const bool still_applies = [&] {
+      for (const auto& copy : it->second.copies) {
+        if (copy.shard_crcs.size() != copy.shards.size()) continue;
+        for (size_t i = 0; i < copy.shards.size(); ++i) {
+          if (copy.shards[i] == check.shard && copy.shard_crcs[i] == check.expect)
+            return true;
+        }
+      }
+      return false;
+    }();
+    if (!still_applies) continue;
+    if (unpersist_object(check.key) != ErrorCode::OK) {
+      // Fence-first failed (outage): the corrupt object must not quietly
+      // keep serving — re-queue so the next health tick retries the drop.
+      lock.unlock();
+      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      readopt_checks_.push_back(check);
+      continue;
+    }
+    free_object_locked(check.key, it->second);
+    objects_.erase(it);
+    ++counters_.objects_lost;
+    bump_view();
+  }
+}
+
+}  // namespace btpu::keystone

@@ -1,0 +1,237 @@
+// Data-movement helpers shared by the keystone mover TUs (repair, drain,
+// evict) and the persistence TU (allocator re-adoption mapping).
+#include "btpu/keystone/keystone.h"
+
+#include <algorithm>
+#include <optional>
+#include <unordered_set>
+
+#include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
+#include "btpu/ec/rs.h"
+#include "btpu/storage/hbm_provider.h"
+
+#include "keystone_internal.h"
+
+namespace btpu::keystone::detail {
+// Reads or writes [obj_off, obj_off+len) of one copy through its shards
+// (shared walk lives in transport::copy_range_io).
+ErrorCode copy_io(transport::TransportClient& client, const CopyPlacement& copy,
+                  uint64_t obj_off, uint8_t* buf, uint64_t len, bool is_write) {
+  return transport::copy_range_io(client, copy, obj_off, buf, len, is_write);
+}
+
+// Shard CRCs are layout-bound: after a byte-identical move (repair top-up,
+// demotion), the source's stamps remain valid for the destination only when
+// it striped identically. A different layout stays unstamped rather than
+// wrongly stamped.
+void carry_shard_crcs(const CopyPlacement& src, CopyPlacement& dst) {
+  if (src.shard_crcs.size() != src.shards.size()) return;
+  if (dst.shards.size() != src.shards.size()) return;
+  for (size_t i = 0; i < dst.shards.size(); ++i) {
+    if (dst.shards[i].length != src.shards[i].length) return;
+  }
+  dst.shard_crcs = src.shard_crcs;
+}
+
+bool all_shards_on_device(const CopyPlacement& copy) {
+  return !copy.shards.empty() &&
+         std::all_of(copy.shards.begin(), copy.shards.end(), [](const ShardPlacement& s) {
+           return std::holds_alternative<DeviceLocation>(s.location);
+         });
+}
+
+// Device-resident copy-to-copy transfer: walks both shard lists and moves
+// each overlapping segment region-to-region through the HBM provider — on a
+// TPU mesh that is the ICI path (chip-to-chip, no host staging).
+ErrorCode device_copy_object(const CopyPlacement& src, const CopyPlacement& dst,
+                             uint64_t size) {
+  size_t si = 0, di = 0;
+  uint64_t s_off = 0, d_off = 0, pos = 0;
+  while (pos < size) {
+    if (si >= src.shards.size() || di >= dst.shards.size())
+      return ErrorCode::INVALID_PARAMETERS;
+    const ShardPlacement& ss = src.shards[si];
+    const ShardPlacement& ds = dst.shards[di];
+    const auto& sl = std::get<DeviceLocation>(ss.location);
+    const auto& dl = std::get<DeviceLocation>(ds.location);
+    const uint64_t n = std::min({ss.length - s_off, ds.length - d_off, size - pos});
+    if (auto ec = storage::hbm_copy(sl.region_id, sl.offset + s_off, dl.region_id,
+                                    dl.offset + d_off, n);
+        ec != ErrorCode::OK)
+      return ec;
+    pos += n;
+    s_off += n;
+    d_off += n;
+    if (s_off == ss.length) { ++si; s_off = 0; }
+    if (d_off == ds.length) { ++di; d_off = 0; }
+  }
+  return ErrorCode::OK;
+}
+
+// Cross-process device fabric: when every overlapping (src, dst) segment
+// sits on pools that BOTH advertise a fabric endpoint (hbm_provider v4),
+// the keystone orchestrates offer+pull between the two worker processes and
+// the bytes ride the device fabric (chip fabric on TPU) — never this
+// keystone, never the staged host lane. Returns false on any miss; the
+// caller falls back (a partially fabric-moved destination is re-streamed
+// whole, which is correct if wasteful — failures here are rare).
+bool fabric_copy_object(transport::TransportClient& client, const CopyPlacement& src,
+                        const CopyPlacement& dst, uint64_t size, const alloc::PoolMap& pools) {
+  static std::atomic<uint64_t> transfer_salt{0x66616272u};  // process-unique ids
+  size_t si = 0, di = 0;
+  uint64_t s_off = 0, d_off = 0, pos = 0;
+  while (pos < size) {
+    if (si >= src.shards.size() || di >= dst.shards.size()) return false;
+    const ShardPlacement& ss = src.shards[si];
+    const ShardPlacement& ds = dst.shards[di];
+    const auto* sm = std::get_if<MemoryLocation>(&ss.location);
+    const auto* dm = std::get_if<MemoryLocation>(&ds.location);
+    if (!sm || !dm) return false;
+    auto sp = pools.find(ss.pool_id);
+    auto dp = pools.find(ds.pool_id);
+    if (sp == pools.end() || dp == pools.end()) return false;
+    const std::string& src_fabric = sp->second.fabric_addr;
+    if (src_fabric.empty() || dp->second.fabric_addr.empty()) return false;
+    // Same process (one fabric server serves all its pools): the host lane
+    // is a local memcpy there and a self-pull buys nothing.
+    if (src_fabric == dp->second.fabric_addr) return false;
+    // Bounded segments: each offer pins a staged device array on the source
+    // until pulled (or GC'd), so cap what a single failed round can strand.
+    constexpr uint64_t kFabricSeg = 32ull << 20;
+    const uint64_t n =
+        std::min({ss.length - s_off, ds.length - d_off, size - pos, kFabricSeg});
+    const uint64_t id =
+        (static_cast<uint64_t>(std::chrono::steady_clock::now().time_since_epoch().count())
+         << 16) ^
+        transfer_salt.fetch_add(1);
+    if (client.fabric_offer(ss.remote, sm->remote_addr + s_off, sm->rkey, n, id) !=
+        ErrorCode::OK)
+      return false;
+    if (client.fabric_pull(ds.remote, dm->remote_addr + d_off, dm->rkey, n, id,
+                           src_fabric) != ErrorCode::OK)
+      return false;
+    pos += n;
+    s_off += n;
+    d_off += n;
+    if (s_off == ss.length) { ++si; s_off = 0; }
+    if (d_off == ds.length) { ++di; d_off = 0; }
+  }
+  return true;
+}
+
+// Streams `size` bytes from `src` into every copy in `dsts` through a bounded
+// chunk buffer, so keystone-side data movement (repair, demotion) never
+// buffers a whole object in host memory. Fully device-resident src->dst
+// pairs skip the host entirely (ICI path), and cross-process device pools
+// with fabric endpoints move over the device fabric (when `pools` is
+// given). The source's CRC (when stamped) is verified as the bytes stream:
+// a mover must never propagate a bit-rotten copy — the caller fails over to
+// the next source instead. Device->device and fabric moves skip that check
+// (those bytes never touch the host); such destinations are reported
+// through `used_unchecked` so the caller can queue the object for scrub
+// revalidation — stamps are carried, so rot in the source would otherwise
+// ride along unchecked until a client verify or ring-walk scrub.
+ErrorCode copy_object_bytes(transport::TransportClient& client, const CopyPlacement& src,
+                            const std::vector<CopyPlacement>& dsts, uint64_t size,
+                            const alloc::PoolMap* pools,
+                            std::atomic<uint64_t>* fabric_moves,
+                            bool* used_unchecked) {
+  std::vector<const CopyPlacement*> staged;
+  if (all_shards_on_device(src)) {
+    for (const auto& dst : dsts) {
+      if (all_shards_on_device(dst) &&
+          device_copy_object(src, dst, size) == ErrorCode::OK) {
+        // Moved chip-to-chip, no host bytes — and no CRC gate either.
+        if (used_unchecked) *used_unchecked = true;
+        continue;
+      }
+      staged.push_back(&dst);
+    }
+  } else {
+    for (const auto& dst : dsts) staged.push_back(&dst);
+  }
+  if (!staged.empty() && pools) {
+    std::vector<const CopyPlacement*> rest;
+    for (const CopyPlacement* dst : staged) {
+      if (fabric_copy_object(client, src, *dst, size, *pools)) {
+        if (fabric_moves) fabric_moves->fetch_add(1);
+        if (used_unchecked) *used_unchecked = true;
+      } else {
+        rest.push_back(dst);
+      }
+    }
+    staged.swap(rest);
+  }
+  if (staged.empty()) return ErrorCode::OK;
+
+  constexpr uint64_t kChunk = 16ull << 20;
+  std::vector<uint8_t> buf(static_cast<size_t>(std::min(size, kChunk)));
+  uint32_t crc = 0;
+  for (uint64_t off = 0; off < size; off += kChunk) {
+    const uint64_t n = std::min(kChunk, size - off);
+    if (auto ec = copy_io(client, src, off, buf.data(), n, /*is_write=*/false);
+        ec != ErrorCode::OK)
+      return ec;
+    crc = crc32c(buf.data(), n, crc);
+    for (const CopyPlacement* dst : staged) {
+      if (auto ec = copy_io(client, *dst, off, buf.data(), n, /*is_write=*/true);
+          ec != ErrorCode::OK)
+        return ec;
+    }
+  }
+  if (src.content_crc != 0 && crc != src.content_crc) {
+    LOG_WARN << "mover source copy " << src.copy_index
+             << " failed crc verification; trying another source";
+    return ErrorCode::CHECKSUM_MISMATCH;
+  }
+  return ErrorCode::OK;
+}
+
+// Maps a shard placement back to (pool, offset-range) for allocator adoption.
+std::optional<std::pair<MemoryPoolId, alloc::Range>> shard_to_range(
+    const ShardPlacement& shard, const alloc::PoolMap& pools) {
+  auto it = pools.find(shard.pool_id);
+  if (it == pools.end()) return std::nullopt;
+  if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
+    if (mem->remote_addr < it->second.remote.remote_base) return std::nullopt;
+    return std::make_pair(shard.pool_id,
+                          alloc::Range{mem->remote_addr - it->second.remote.remote_base,
+                                       shard.length});
+  }
+  if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
+    return std::make_pair(shard.pool_id, alloc::Range{dev->offset, shard.length});
+  }
+  if (const auto* file = std::get_if<FileLocation>(&shard.location)) {
+    return std::make_pair(shard.pool_id, alloc::Range{file->file_offset, shard.length});
+  }
+  return std::nullopt;
+}
+
+// All-or-nothing mapping of shards onto (pool, range) pairs.
+bool append_copy_ranges(const CopyPlacement& copy, const alloc::PoolMap& pools,
+                        std::vector<std::pair<MemoryPoolId, alloc::Range>>& out) {
+  const size_t mark = out.size();
+  for (const auto& shard : copy.shards) {
+    auto mapped = shard_to_range(shard, pools);
+    if (!mapped) {
+      out.resize(mark);
+      return false;
+    }
+    out.push_back(std::move(*mapped));
+  }
+  return true;
+}
+
+std::optional<std::vector<std::pair<MemoryPoolId, alloc::Range>>> map_copies_to_ranges(
+    const std::vector<CopyPlacement>& copies, const alloc::PoolMap& pools) {
+  std::vector<std::pair<MemoryPoolId, alloc::Range>> out;
+  for (const auto& copy : copies) {
+    if (!append_copy_ranges(copy, pools, out)) return std::nullopt;
+  }
+  return out;
+}
+
+}  // namespace btpu::keystone::detail

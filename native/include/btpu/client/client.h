@@ -82,6 +82,18 @@ struct ClientOptions {
   // slot_ttl_sec (default 60 s) — the margin is the same pessimistic-
   // deadline defense the pending-put reclamation uses.
   uint32_t put_slot_max_age_ms{20'000};
+  // Single-object put() at or below this size is offered to the keystone's
+  // INLINE tier first (one control RTT, bytes live in the object map; see
+  // KeystoneConfig::inline_max_bytes): tiny objects are RTT-bound and the
+  // data-plane hop is pure overhead for them. Only default-placement puts
+  // qualify (explicit replicas/EC/tier/node requests are data-plane
+  // contracts). put_many keeps the placed path — a batch already amortizes
+  // its control RTTs, and N sequential inline RPCs would cost more. Must be
+  // <= the server's inline_max_bytes to avoid a wasted refusal round trip
+  // per put (a refusing or pre-inline server costs one extra RTT, then the
+  // put falls back to slots/placed and the client remembers the refusal
+  // for a while). 0 disables.
+  uint64_t inline_max_bytes{4096};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).
@@ -266,6 +278,16 @@ class ObjectClient {
   std::unordered_map<std::string, std::vector<PooledSlot>> slot_pool_;
   std::string slot_tag_;          // random per client session
   bool slots_unsupported_{false};  // server predates the opcodes (guarded by slot_mutex_)
+
+  // Inline tier (ClientOptions::inline_max_bytes): nullopt = not applicable
+  // (disabled, oversized, EC, or the server refused recently) — the caller
+  // falls through to slots/placed.
+  std::optional<ErrorCode> put_via_inline(const ObjectKey& key, const void* data,
+                                          uint64_t size, const WorkerConfig& config);
+  // A refusing server (disabled tier / smaller server-side limit / budget
+  // spent) is remembered for a while so every small put doesn't pay a
+  // wasted refusal RTT; budget refusals are transient, hence the re-probe.
+  std::atomic<int64_t> inline_retry_after_ms_{0};
 };
 
 }  // namespace btpu::client

@@ -179,12 +179,20 @@ struct CopyPlacement {
   // localize it to a shard, which is what lets EC repair reconstruct
   // multiple corrupt shards and scrub name the corrupt worker/pool.
   std::vector<uint32_t> shard_crcs;
+  // Inline tier: small objects' bytes live HERE, in the keystone's object
+  // map, instead of on worker pools (`shards` is then empty). The durable
+  // record carries them (restart + HA mirror come for free), get_workers
+  // returns them (a first verified read is ONE control RTT, no data-plane
+  // hop), and put_inline stores them in one RPC. Wire-append-only: older
+  // peers decode this struct fine and see a shardless copy.
+  std::string inline_data;
   size_t shards_size() const noexcept { return shards.size(); }
 };
 
 // Logical object bytes held by one copy (EC-aware; replicated copies are
-// the sum of their shard lengths).
+// the sum of their shard lengths; inline copies carry the bytes themselves).
 inline uint64_t copy_logical_size(const CopyPlacement& c) {
+  if (!c.inline_data.empty()) return c.inline_data.size();
   if (c.ec_data_shards > 0) return c.ec_object_size;
   uint64_t total = 0;
   for (const auto& s : c.shards) total += s.length;
@@ -228,6 +236,9 @@ struct ClusterStats {
   uint64_t total_capacity{0};
   uint64_t used_capacity{0};
   double avg_utilization{0.0};
+  // Bytes resident in the keystone's inline tier (not pool capacity —
+  // inline objects live in the object map; see KeystoneConfig).
+  uint64_t inline_bytes{0};
 };
 
 // -------------------------------------------------------------------------
@@ -401,6 +412,20 @@ struct PutCommitSlotResponse {
   std::vector<PutSlot> slots;           // refills; best-effort, may be empty
 };
 
+// Inline-tier put: one control RTT stores a small object's bytes in the
+// keystone's object map (see KeystoneConfig::inline_max_bytes). A server
+// that refuses (disabled, oversized, budget spent, or a pre-inline build
+// answering an unknown opcode) returns NOT_IMPLEMENTED in a single-field
+// frame and the client falls back to the placed path — same convention as
+// the pooled-slot RPCs.
+struct PutInlineRequest {
+  ObjectKey key;
+  WorkerConfig config;      // ttl / soft-pin policy (placement fields unused)
+  uint32_t content_crc{0};  // CRC32C of `data` (0 = unstamped)
+  std::string data;
+};
+struct PutInlineResponse { ErrorCode error_code{ErrorCode::OK}; };
+
 // Ping doubles as the protocol-version handshake: each side sends the
 // highest wire-protocol version it speaks (rpc.h kProtocolVersion). A peer
 // that predates the handshake leaves the field 0.
@@ -454,6 +479,16 @@ struct KeystoneConfig {
   // has no integrity checking at all.
   int64_t scrub_interval_sec{0};
   uint32_t scrub_objects_per_pass{16};
+
+  // Inline tier: objects up to inline_max_bytes are stored IN the keystone's
+  // object map (durable record + HA mirror carry the bytes) instead of on
+  // worker pools — put_inline is one control RTT, and get_workers returns
+  // the bytes so a first verified read never touches the data plane. The
+  // keystone-wide budget caps resident inline bytes; past it (or past
+  // inline_max_bytes) clients transparently fall back to the placed path.
+  // 0 disables granting (clients fall back).
+  uint64_t inline_max_bytes{4096};
+  uint64_t inline_total_bytes{256ull << 20};
 
   // TPU extensions
   bool enable_repair{true};       // re-replicate objects after worker death

@@ -270,11 +270,11 @@ inline bool decode(Reader& r, ShardPlacement& s) {
 
 inline void encode(Writer& w, const CopyPlacement& c) {
   encode_struct(w, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                c.ec_object_size, c.content_crc, c.shard_crcs);
+                c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data);
 }
 inline bool decode(Reader& r, CopyPlacement& c) {
   return decode_struct(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                       c.ec_object_size, c.content_crc, c.shard_crcs);
+                       c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data);
 }
 
 inline void encode(Writer& w, const PutSlot& s) {
@@ -308,11 +308,11 @@ inline bool decode(Reader& r, WorkerConfig& c) {
 
 inline void encode(Writer& w, const ClusterStats& s) {
   encode_struct(w, s.total_workers, s.total_memory_pools, s.total_objects, s.total_capacity,
-                s.used_capacity, s.avg_utilization);
+                s.used_capacity, s.avg_utilization, s.inline_bytes);
 }
 inline bool decode(Reader& r, ClusterStats& s) {
   return decode_struct(r, s.total_workers, s.total_memory_pools, s.total_objects,
-                       s.total_capacity, s.used_capacity, s.avg_utilization);
+                       s.total_capacity, s.used_capacity, s.avg_utilization, s.inline_bytes);
 }
 
 inline void encode(Writer& w, const MemoryPool& p) {
@@ -422,6 +422,8 @@ BTPU_WIRE_STRUCT(PutStartPooledRequest, f0, f1, f2, f3)
 BTPU_WIRE_STRUCT(PutStartPooledResponse, f0, f1)
 BTPU_WIRE_STRUCT(PutCommitSlotRequest, f0, f1, f2, f3, f4, f5, f6, f7)
 BTPU_WIRE_STRUCT(PutCommitSlotResponse, f0, f1)
+BTPU_WIRE_STRUCT(PutInlineRequest, f0, f1, f2, f3)
+BTPU_WIRE_STRUCT(PutInlineResponse, f0)
 BTPU_WIRE_STRUCT(PingRequest, f0)
 BTPU_WIRE_STRUCT(PingResponse, f0, f1)
 

@@ -84,6 +84,7 @@ struct KeystoneCounters {
   std::atomic<uint64_t> put_cancels{0};
   std::atomic<uint64_t> slots_granted{0};
   std::atomic<uint64_t> slot_commits{0};
+  std::atomic<uint64_t> inline_puts{0};  // puts absorbed by the inline tier
   // Cross-process device moves that rode the fabric instead of the host lane.
   std::atomic<uint64_t> fabric_moves{0};
   // Objects spared from the loss path because their bytes sit on a dead
@@ -142,6 +143,14 @@ class KeystoneService {
   ErrorCode put_commit_slot(const ObjectKey& slot_key, const ObjectKey& key,
                             uint32_t content_crc,
                             const std::vector<CopyShardCrcs>& shard_crcs);
+  // Inline tier (KeystoneConfig::inline_max_bytes): stores a small object's
+  // bytes directly in the object map as a shardless copy — the durable
+  // record carries them, get_workers returns them. One control RTT per put,
+  // zero data-plane hops per get. NOT_IMPLEMENTED = refuse (disabled /
+  // oversized / budget spent); the client falls back to the placed path.
+  ErrorCode put_inline(const ObjectKey& key, const WorkerConfig& config,
+                       uint32_t content_crc, std::string data);
+  uint64_t inline_bytes_resident() const noexcept { return inline_bytes_.load(); }
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
 
@@ -381,6 +390,10 @@ class KeystoneService {
   // Background scrub ring position (scrub thread only).
   ObjectKey scrub_cursor_;
   std::atomic<uint64_t> slot_seq_{0};  // unique suffix for pooled slot keys
+  // Resident inline-tier bytes (budget: KeystoneConfig::inline_total_bytes).
+  // Credited by put_inline, debited wherever an inline object leaves the
+  // map (free_object_locked, record replace/drop on the mirror path).
+  std::atomic<uint64_t> inline_bytes_{0};
   // Live pooled slots (granted, not yet committed/cancelled/reclaimed):
   // keeps get_cluster_stats O(1) when excluding them from total_objects.
   std::atomic<int64_t> slot_objects_{0};

@@ -46,6 +46,7 @@ enum class Method : uint8_t {
   kListObjects = 81,
   kPutStartPooled = 82,
   kPutCommitSlot = 83,
+  kPutInline = 84,
 };
 
 }  // namespace btpu::rpc

@@ -34,6 +34,10 @@ class KeystoneRpcClient {
   // piggybacked replacement grant from the same round trip.
   ErrorCode put_commit_slot(const PutCommitSlotRequest& request,
                             std::vector<PutSlot>* refill_slots);
+  // Inline tier: one RTT stores the bytes in the keystone's object map.
+  // NOT_IMPLEMENTED (any vintage of refusal) = use the placed path.
+  ErrorCode put_inline(const ObjectKey& key, const WorkerConfig& config,
+                       uint32_t content_crc, std::string data);
   ErrorCode remove_object(const ObjectKey& key);
   Result<uint64_t> remove_all_objects();
   Result<uint64_t> drain_worker(const NodeId& worker_id);

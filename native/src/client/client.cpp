@@ -170,6 +170,11 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
                             const WorkerConfig& config) {
   TRACE_SPAN("client.put");
+  // Tiny objects ride the inline tier when the keystone grants it: ONE
+  // control RTT stores the bytes in the object map, and the first verified
+  // read needs no data-plane hop at all. nullopt = not applicable — fall
+  // through to slots/placed.
+  if (auto inl = put_via_inline(key, data, size, config)) return *inl;
   // Small objects ride the pooled-slot path when possible: write into a
   // pre-allocated slot, then ONE control RTT commits it as `key` (and
   // refills the pool in the same round trip). nullopt = not applicable
@@ -589,6 +594,18 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
 // the enemy, hbm_provider.h v2), wire shards move as one pipelined batch.
 ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
                                       bool is_write, bool verify) {
+  if (!copy.inline_data.empty()) {
+    // Inline tier: the metadata reply already carried the bytes — a read is
+    // a memcpy (plus the CRC gate), and a write is meaningless here (inline
+    // objects are written whole through put_inline, never through
+    // placements).
+    if (is_write || size != copy.inline_data.size()) return ErrorCode::INVALID_PARAMETERS;
+    if (verify && copy.content_crc != 0 &&
+        crc32c(copy.inline_data.data(), copy.inline_data.size()) != copy.content_crc)
+      return ErrorCode::CHECKSUM_MISMATCH;
+    std::memcpy(data, copy.inline_data.data(), copy.inline_data.size());
+    return ErrorCode::OK;
+  }
   if (copy.ec_data_shards > 0) return transfer_copy_ec(copy, data, size, is_write, verify);
   // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
   std::vector<uint64_t> offsets(copy.shards.size());
@@ -1104,6 +1121,46 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   return results;
 }
 
+std::optional<ErrorCode> ObjectClient::put_via_inline(const ObjectKey& key, const void* data,
+                                                      uint64_t size,
+                                                      const WorkerConfig& config) {
+  // Explicit placement intent (replicas, EC, a tier or node preference)
+  // means the caller wants bytes ON THE DATA PLANE — e.g. 2 KiB of HBM-tier
+  // metadata read device-locally — so only default-placement puts are
+  // offered to the inline tier.
+  if (options_.inline_max_bytes == 0 || size == 0 || size > options_.inline_max_bytes ||
+      config.replication_factor > 1 || config.ec_parity_shards > 0 ||
+      !config.preferred_classes.empty() || !config.preferred_node.empty() || key.empty() ||
+      key.find('\x01') != ObjectKey::npos)
+    return std::nullopt;
+  const int64_t now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                             std::chrono::steady_clock::now().time_since_epoch())
+                             .count();
+  if (now_ms < inline_retry_after_ms_.load(std::memory_order_relaxed)) return std::nullopt;
+
+  invalidate_placements(key);  // same re-created-key rule as the normal path
+  const uint32_t crc = crc32c(data, size);
+  std::string bytes(static_cast<const char*>(data), size);
+  ErrorCode ec;
+  if (embedded_) {
+    ec = embedded_->put_inline(key, config, crc, std::move(bytes));
+  } else {
+    // Mutation: NOT_LEADER rotates, lost replies do not retry (matching
+    // put_complete's stance — a resend could misreport ALREADY_EXISTS).
+    ec = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
+      return r.put_inline(key, config, crc, bytes);
+    });
+  }
+  if (ec == ErrorCode::NOT_IMPLEMENTED) {
+    // Refused: disabled, the server's limit is smaller than ours, or the
+    // budget is spent. Budget refusals clear as objects expire, so re-probe
+    // after a while rather than pinning the fallback forever.
+    inline_retry_after_ms_.store(now_ms + 60'000, std::memory_order_relaxed);
+    return std::nullopt;
+  }
+  return ec;
+}
+
 std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const void* data,
                                                     uint64_t size,
                                                     const WorkerConfig& config) {
@@ -1324,6 +1381,12 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
     sizes[i] = copy_size;
     if (copy_size > items[i].buffer_size) {
       errors[i] = ErrorCode::BUFFER_OVERFLOW;
+      continue;
+    }
+    if (!copy.inline_data.empty()) {
+      // Inline item: the metadata reply already carried the bytes (the CRC
+      // gate below judges them like any other first-pass read).
+      std::memcpy(items[i].buffer, copy.inline_data.data(), copy.inline_data.size());
       continue;
     }
     if (copy.ec_data_shards > 0) {

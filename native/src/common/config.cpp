@@ -334,6 +334,10 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
     cfg.scrub_interval_sec = n->int_or(cfg.scrub_interval_sec);
   if (auto n = root.get("scrub_objects_per_pass"))
     cfg.scrub_objects_per_pass = static_cast<uint32_t>(n->int_or(cfg.scrub_objects_per_pass));
+  if (auto n = root.get("inline_max_bytes"))
+    cfg.inline_max_bytes = static_cast<uint64_t>(n->int_or(cfg.inline_max_bytes));
+  if (auto n = root.get("inline_total_bytes"))
+    cfg.inline_total_bytes = static_cast<uint64_t>(n->int_or(cfg.inline_total_bytes));
   if (auto n = root.get("health_check_interval_sec"))
     cfg.health_check_interval_sec = n->int_or(cfg.health_check_interval_sec);
   if (auto n = root.get("pending_put_timeout_sec"))

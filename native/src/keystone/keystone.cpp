@@ -573,6 +573,65 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   return ErrorCode::OK;
 }
 
+ErrorCode KeystoneService::put_inline(const ObjectKey& key, const WorkerConfig& config,
+                                      uint32_t content_crc, std::string data) {
+  if (key.empty() || key.find('\x01') != ObjectKey::npos) return ErrorCode::INVALID_KEY;
+  if (data.empty()) return ErrorCode::INVALID_PARAMETERS;
+  // Refusals the client treats as "use the placed path" — disabled tier,
+  // oversized object, or budget spent. NOT_IMPLEMENTED mirrors what a
+  // pre-inline server answers for the unknown opcode, so one client code
+  // path covers every vintage.
+  if (config_.inline_max_bytes == 0 || data.size() > config_.inline_max_bytes)
+    return ErrorCode::NOT_IMPLEMENTED;
+  // Explicit placement intent (replicas, EC, tier/node preference) is a
+  // data-plane contract — refuse rather than silently downgrade it to a
+  // single keystone-resident copy (the client guards this too).
+  if (config.replication_factor > 1 || config.ec_parity_shards > 0 ||
+      !config.preferred_classes.empty() || !config.preferred_node.empty())
+    return ErrorCode::NOT_IMPLEMENTED;
+  if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+
+  TRACE_SPAN("keystone.put_inline");
+  const uint64_t size = data.size();
+  // Budget gate: credit first, roll back on refusal, so concurrent puts
+  // cannot stampede past the cap between a check and an insert.
+  if (inline_bytes_.fetch_add(size) + size > config_.inline_total_bytes) {
+    inline_bytes_.fetch_sub(size);
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
+  std::unique_lock lock(objects_mutex_);
+  if (objects_.contains(key)) {
+    inline_bytes_.fetch_sub(size);
+    return ErrorCode::OBJECT_ALREADY_EXISTS;
+  }
+  ObjectInfo info;
+  info.size = size;
+  info.ttl_ms = config.ttl_ms;
+  info.soft_pin = config.enable_soft_pin;
+  info.config = config;
+  info.state = ObjectState::kComplete;
+  info.created_at = info.last_access = std::chrono::steady_clock::now();
+  CopyPlacement copy;
+  copy.copy_index = 0;
+  copy.content_crc = content_crc;
+  copy.inline_data = std::move(data);
+  info.copies.push_back(std::move(copy));
+  info.epoch = next_epoch_.fetch_add(1);
+  auto [it, inserted] = objects_.emplace(key, std::move(info));
+  (void)inserted;
+  if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
+    // Same fail-closed commit point as put_complete: no durable record, no
+    // ack — and nothing to keep, since the bytes live nowhere else.
+    objects_.erase(it);
+    inline_bytes_.fetch_sub(size);
+    return ec;
+  }
+  ++counters_.put_completes;
+  ++counters_.inline_puts;
+  bump_view();
+  return ErrorCode::OK;
+}
+
 Result<std::vector<PutSlot>> KeystoneService::put_start_pooled(uint64_t size,
                                                                const WorkerConfig& config,
                                                                uint32_t count,
@@ -733,7 +792,12 @@ Result<uint64_t> KeystoneService::remove_all_objects() {
   return count;
 }
 
-ErrorCode KeystoneService::free_object_locked(const ObjectKey& key, ObjectInfo&) {
+ErrorCode KeystoneService::free_object_locked(const ObjectKey& key, ObjectInfo& info) {
+  // Inline objects own no allocator ranges; their exit returns budget.
+  if (!info.copies.empty() && !info.copies.front().inline_data.empty()) {
+    inline_bytes_.fetch_sub(info.copies.front().inline_data.size());
+    return ErrorCode::OK;
+  }
   return adapter_.free_object(key);
 }
 
@@ -802,6 +866,7 @@ Result<ClusterStats> KeystoneService::get_cluster_stats() const {
   }
   auto alloc_stats = adapter_.get_stats();
   stats.used_capacity = alloc_stats.total_allocated_bytes;
+  stats.inline_bytes = inline_bytes_.load();
   stats.avg_utilization =
       stats.total_capacity
           ? static_cast<double>(stats.used_capacity) / static_cast<double>(stats.total_capacity)

@@ -82,6 +82,11 @@ void KeystoneService::evict_for_pressure() {
       std::shared_lock lock(objects_mutex_);
       for (const auto& [key, info] : objects_) {
         if (info.soft_pin || info.state != ObjectState::kComplete) continue;
+        // Inline objects hold no pool capacity: evicting one cannot relieve
+        // allocator pressure (the loop's exit condition), so under the
+        // global (non-tier-aware) scope they'd be destroyed for zero
+        // benefit. Their growth is bounded by the inline budget instead.
+        if (!info.copies.empty() && !info.copies.front().inline_data.empty()) continue;
         if (scope) {
           bool touches_tier = false;
           for (const auto& copy : info.copies) {

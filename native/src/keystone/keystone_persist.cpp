@@ -502,18 +502,28 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
   std::optional<ObjectInfo> previous;
   if (auto it = objects_.find(key); it != objects_.end()) {
     // Replace semantics: the record wins. The old ranges must be freed
-    // before adopting the new ones (records usually reuse most of them).
+    // before adopting the new ones (records usually reuse most of them) —
+    // free_object_locked also returns an inline object's budget.
     previous = std::move(it->second);
-    adapter_.free_object(key);
+    free_object_locked(key, *previous);
     objects_.erase(it);
   }
-  if (adapter_.adopt_allocation(key, ranges, pools) != ErrorCode::OK) {
+  // Inline records own no ranges: adopting an empty allocation would leave
+  // a stray allocator entry that nothing ever frees (free_object_locked
+  // short-circuits inline objects).
+  if (!ranges.empty() && adapter_.adopt_allocation(key, ranges, pools) != ErrorCode::OK) {
     // Put the previous (still valid) state back rather than silently
     // destroying a serveable object over a transient adoption failure.
     if (previous) {
       auto old_ranges = map_copies_to_ranges(previous->copies, pools);
+      // Same empty-adoption guard as the forward path: an inline previous
+      // owns no ranges, and adopting an empty allocation would plant a
+      // stray allocator entry that wedges this key's future re-applies.
       if (old_ranges &&
-          adapter_.adopt_allocation(key, *old_ranges, pools) == ErrorCode::OK) {
+          (old_ranges->empty() ||
+           adapter_.adopt_allocation(key, *old_ranges, pools) == ErrorCode::OK)) {
+        if (!previous->copies.empty() && !previous->copies.front().inline_data.empty())
+          inline_bytes_.fetch_add(previous->copies.front().inline_data.size());
         objects_[key] = std::move(*previous);
       } else {
         LOG_ERROR << "object " << key << " lost during record re-apply";
@@ -537,6 +547,8 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
   info.created_at = from_wall(rec.created_wall_ms);
   info.last_access = from_wall(rec.last_access_wall_ms);
   info.epoch = next_epoch_.fetch_add(1);
+  if (!info.copies.empty() && !info.copies.front().inline_data.empty())
+    inline_bytes_.fetch_add(info.copies.front().inline_data.size());
   objects_[key] = std::move(info);
   bump_view();
   return ApplyResult::kApplied;
@@ -546,7 +558,7 @@ void KeystoneService::drop_object_locally(const ObjectKey& key) {
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return;
-  adapter_.free_object(key);
+  free_object_locked(key, it->second);
   objects_.erase(it);
   bump_view();
 }

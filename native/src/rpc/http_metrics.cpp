@@ -53,6 +53,10 @@ std::string MetricsHttpServer::render_metrics() const {
           c.slots_granted.load());
   counter("btpu_put_slot_commits_total", "puts committed through a pooled slot (1-RTT path)",
           c.slot_commits.load());
+  counter("btpu_inline_puts_total", "puts absorbed by the keystone inline tier (1-RTT, no data plane)",
+          c.inline_puts.load());
+  gauge("btpu_inline_bytes", "bytes resident in the keystone inline tier",
+        static_cast<double>(service_.inline_bytes_resident()));
   counter("btpu_fabric_moves_total",
           "cross-process device moves over the device fabric (vs host lane)",
           c.fabric_moves.load());

@@ -129,6 +129,15 @@ ErrorCode KeystoneRpcClient::put_commit_slot(const PutCommitSlotRequest& request
   return resp.error_code;
 }
 
+ErrorCode KeystoneRpcClient::put_inline(const ObjectKey& key, const WorkerConfig& config,
+                                        uint32_t content_crc, std::string data) {
+  PutInlineResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutInline),
+                            PutInlineRequest{key, config, content_crc, std::move(data)},
+                            resp));
+  return resp.error_code;
+}
+
 ErrorCode KeystoneRpcClient::put_cancel(const ObjectKey& key) {
   PutCancelResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutCancel), PutCancelRequest{key},

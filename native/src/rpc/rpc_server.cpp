@@ -187,6 +187,12 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
               if (r.ok()) resp.slots = std::move(r).value();
             }
           });
+    case Method::kPutInline:
+      return handle<PutInlineRequest, PutInlineResponse>(
+          payload, [&](auto& req, auto& resp) {
+            resp.error_code =
+                ks.put_inline(req.key, req.config, req.content_crc, std::move(req.data));
+          });
     case Method::kDrainWorker:
       return handle<DrainWorkerRequest, DrainWorkerResponse>(
           payload, [&](const auto& req, auto& resp) {

@@ -1372,6 +1372,76 @@ BTEST(Integrity, BackgroundScrubHealsCorruptReplicatedShard) {
   BT_EXPECT(back.value() == data);
 }
 
+BTEST(InlineTier, SmallPutsRideTheMetadataPlane) {
+  // A tiny put is absorbed by the keystone's inline tier (one control RTT,
+  // bytes in the object map) and a verified get never touches the data
+  // plane — the metadata reply carries the bytes.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(1, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  // Inline applies to default-placement puts only (rf<=1, no tier/node
+  // preference, no EC): an explicit replica or tier request is a data-plane
+  // contract the client must not silently downgrade.
+  ClientOptions copts;
+  copts.default_config.replication_factor = 1;
+  auto client = cluster.make_client(copts);
+
+  auto data = pattern(1024, 41);
+  BT_ASSERT(client->put("inl/small", data.data(), data.size()) == ErrorCode::OK);
+  BT_EXPECT_EQ(cluster.keystone().counters().inline_puts.load(), 1u);
+  BT_EXPECT_EQ(cluster.keystone().inline_bytes_resident(), data.size());
+
+  auto placements = client->get_workers("inl/small");
+  BT_ASSERT_OK(placements);
+  BT_ASSERT(placements.value().size() == 1);
+  BT_EXPECT(placements.value()[0].shards.empty());  // no data-plane bytes
+
+  auto back = client->get("inl/small", /*verify=*/true);
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+
+  // Client-side audit judges the inline copy through its content CRC.
+  auto findings = client->scrub_object("inl/small");
+  BT_ASSERT_OK(findings);
+  for (const auto& f : findings.value()) BT_EXPECT(f.status == ErrorCode::OK);
+
+  // An oversized put falls through to the placed path transparently.
+  auto big = pattern(64 * 1024, 42);
+  BT_ASSERT(client->put("inl/big", big.data(), big.size()) == ErrorCode::OK);
+  BT_EXPECT_EQ(cluster.keystone().counters().inline_puts.load(), 1u);  // unchanged
+  auto big_placed = client->get_workers("inl/big");
+  BT_ASSERT_OK(big_placed);
+  BT_EXPECT(!big_placed.value()[0].shards.empty());
+  auto big_back = client->get("inl/big");
+  BT_ASSERT_OK(big_back);
+  BT_EXPECT(big_back.value() == big);
+
+  BT_EXPECT(client->remove("inl/small") == ErrorCode::OK);
+  BT_EXPECT_EQ(cluster.keystone().inline_bytes_resident(), 0u);
+  BT_EXPECT(!client->object_exists("inl/small").value());
+}
+
+BTEST(InlineTier, GetManyAndBatchedMetadataSeeInlineObjects) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(1, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.default_config.replication_factor = 1;
+  auto client = cluster.make_client(copts);
+  auto a = pattern(512, 3), b = pattern(2048, 5);
+  BT_ASSERT(client->put("inl/a", a.data(), a.size()) == ErrorCode::OK);
+  BT_ASSERT(client->put("inl/b", b.data(), b.size()) == ErrorCode::OK);
+  std::vector<uint8_t> ba(a.size()), bb(b.size());
+  auto many = client->get_many({{"inl/a", ba.data(), ba.size()},
+                                {"inl/b", bb.data(), bb.size()}});
+  BT_ASSERT(many.size() == 2);
+  BT_ASSERT_OK(many[0]);
+  BT_ASSERT_OK(many[1]);
+  BT_EXPECT(ba == a);
+  BT_EXPECT(bb == b);
+  auto listed = client->list_objects("inl/");
+  BT_ASSERT_OK(listed);
+  BT_EXPECT_EQ(listed.value().size(), 2u);
+}
+
 BTEST(Integrity, QueuedScrubTargetVerifiedAheadOfRing) {
   // Movers queue fabric-moved objects for revalidation: a queued target is
   // scrubbed on the NEXT pass, ahead of the ring walk and on top of its

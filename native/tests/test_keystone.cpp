@@ -628,6 +628,75 @@ BTEST(Keystone, DeadWorkerRepairRebuildsReplicas) {
   }
 }
 
+BTEST(Keystone, InlineObjectsLiveInKeystoneAndSurviveRestart) {
+  // Inline tier: the bytes live in the object map (no pools involved at
+  // all), the durable record carries them, and a restarted keystone serves
+  // them back — with the budget counter restored.
+  auto coordinator = std::make_shared<coord::MemCoordinator>();
+  auto cfg = fast_config();
+  std::string bytes = "inline tier payload: small, hot, and RTT-bound";
+  const uint32_t crc = crc32c(bytes.data(), bytes.size());
+  WorkerConfig wc;
+  wc.replication_factor = 1;  // inline serves default-placement puts only
+  {
+    KeystoneService ks(cfg, coordinator);
+    BT_ASSERT(ks.initialize() == ErrorCode::OK);
+    BT_EXPECT(ks.put_inline("inl/x", wc, crc, bytes) == ErrorCode::OK);
+    BT_EXPECT_EQ(ks.counters().inline_puts.load(), 1u);
+    BT_EXPECT_EQ(ks.inline_bytes_resident(), bytes.size());
+    // Duplicate key: refused, budget unchanged.
+    BT_EXPECT(ks.put_inline("inl/x", wc, crc, bytes) == ErrorCode::OBJECT_ALREADY_EXISTS);
+    BT_EXPECT_EQ(ks.inline_bytes_resident(), bytes.size());
+    // Oversized: refused with the fallback code.
+    BT_EXPECT(ks.put_inline("inl/big", wc, 0, std::string(cfg.inline_max_bytes + 1, 'x')) ==
+              ErrorCode::NOT_IMPLEMENTED);
+    ks.stop();
+  }
+  {
+    KeystoneService ks2(cfg, coordinator);
+    BT_ASSERT(ks2.initialize() == ErrorCode::OK);
+    BT_EXPECT(ks2.object_exists("inl/x").value());
+    BT_EXPECT_EQ(ks2.inline_bytes_resident(), bytes.size());
+    auto got = ks2.get_workers("inl/x");
+    BT_ASSERT_OK(got);
+    BT_ASSERT(got.value().size() == 1);
+    BT_EXPECT(got.value()[0].shards.empty());
+    BT_EXPECT(got.value()[0].inline_data == bytes);
+    BT_EXPECT_EQ(got.value()[0].content_crc, crc);
+    // Remove returns the budget.
+    BT_EXPECT(ks2.remove_object("inl/x") == ErrorCode::OK);
+    BT_EXPECT_EQ(ks2.inline_bytes_resident(), 0u);
+    ks2.stop();
+  }
+}
+
+BTEST(Keystone, InlineObjectsExpireLikeAnyOther) {
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.ttl_ms = 1;
+  BT_EXPECT(ks.put_inline("inl/ttl", wc, 0, "ephemeral") == ErrorCode::OK);
+  std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  ks.run_gc_once();
+  BT_EXPECT(!ks.object_exists("inl/ttl").value());
+  BT_EXPECT_EQ(ks.inline_bytes_resident(), 0u);
+}
+
+BTEST(Keystone, InlineBudgetGateFallsBackWhenSpent) {
+  auto cfg = fast_config();
+  cfg.inline_total_bytes = 1024;
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  BT_EXPECT(ks.put_inline("inl/1", wc, 0, std::string(600, 'a')) == ErrorCode::OK);
+  BT_EXPECT(ks.put_inline("inl/2", wc, 0, std::string(600, 'b')) ==
+            ErrorCode::NOT_IMPLEMENTED);
+  BT_EXPECT(ks.remove_object("inl/1") == ErrorCode::OK);
+  BT_EXPECT(ks.put_inline("inl/2", wc, 0, std::string(600, 'b')) == ErrorCode::OK);
+}
+
 BTEST(Keystone, RestartRecoversPersistedObjects) {
   // The reference forgets every object when keystone restarts (object map is
   // RAM-only, SURVEY §5). With persist_objects, a new keystone replays the

@@ -184,6 +184,26 @@ BTEST(Rpc, PooledSlotCommitIsOneRoundTrip) {
   BT_EXPECT(c.put_commit_slot(dup, &none) == ErrorCode::OK);
 }
 
+BTEST(Rpc, InlinePutRoundTripsOverTcp) {
+  RpcFixture f;
+  BT_ASSERT(f.up());
+  auto& c = *f.client;
+  WorkerConfig wc;
+  wc.replication_factor = 1;  // inline serves default-placement puts only
+  std::string bytes(512, 'q');
+  const uint32_t crc = crc32c(bytes.data(), bytes.size());
+  BT_EXPECT(c.put_inline("rpc/inl", wc, crc, bytes) == ErrorCode::OK);
+  auto got = c.get_workers("rpc/inl");
+  BT_ASSERT_OK(got);
+  BT_ASSERT(got.value().size() == 1);
+  BT_EXPECT(got.value()[0].shards.empty());
+  BT_EXPECT(got.value()[0].inline_data == bytes);
+  BT_EXPECT_EQ(got.value()[0].content_crc, crc);
+  // Oversized: the refusal code the client keys its fallback on.
+  BT_EXPECT(c.put_inline("rpc/inl2", wc, 0, std::string(1 << 20, 'x')) ==
+            ErrorCode::NOT_IMPLEMENTED);
+}
+
 BTEST(Rpc, ClientReconnectsAfterServerRestart) {
   RpcFixture f;
   BT_ASSERT(f.up());

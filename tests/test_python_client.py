@@ -62,9 +62,16 @@ def test_stats_and_cluster_shapes():
         stats = client.stats()
         assert stats["workers"] == 2
         assert stats["pools"] == 2
+        # A 4 KiB object rides the keystone's inline tier: it counts as an
+        # object but consumes no pool capacity.
         client.put("py/s", b"abcd" * 1024)
         assert client.stats()["objects"] == 1
-        assert client.stats()["used"] >= 4096
+        assert client.stats()["used"] == 0
+        assert client.get("py/s") == b"abcd" * 1024
+        # A 64 KiB object takes the placed path and holds real pool ranges.
+        client.put("py/big", b"wxyz" * 16384)
+        assert client.stats()["objects"] == 2
+        assert client.stats()["used"] >= 65536
 
 
 def test_shm_transport_cluster():

@@ -23,5 +23,6 @@ Layout:
 from blackbird_tpu.native import ErrorCode, StorageClass, TransportKind, lib  # noqa: F401
 from blackbird_tpu.cluster import EmbeddedCluster  # noqa: F401
 from blackbird_tpu.client import Client  # noqa: F401
+from blackbird_tpu.fabric import FabricClient, FabricUnavailable  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,191 @@
+"""Client-side device fabric: runtime-owning clients move bytes themselves.
+
+The reference's defining property is that CLIENTS move bytes with one-sided
+RMA — workers never touch the data path after registration
+(/root/reference/src/client/blackbird_client.cpp:276-343 `ucp_get_nbx`,
+/root/reference/src/transport/ucx_engine.cpp:150-180 register-once). On the
+device tier the TPU-native equivalent is the transfer fabric
+(jax.experimental.transfer — chip fabric on real TPUs): a client process
+that owns a JAX runtime
+
+  get: commands the worker to OFFER a shard range on its fabric server
+       (btpu_fabric_offer), then pulls it with its OWN runtime — the bytes
+       go device-to-device, never through the worker's staged host lane;
+  put: grants placements (btpu_put_start_json), offers each shard's bytes
+       on its OWN fabric server, commands the worker to PULL them
+       (btpu_fabric_pull with src_fabric = this client's address), then
+       publishes with btpu_put_complete.
+
+Runtime-less clients keep the staged host lane; FabricClient raises
+FabricUnavailable when a copy has no fabric endpoints, and callers fall
+back to the ordinary Client byte path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import secrets
+
+import numpy as np
+
+from blackbird_tpu.client import Client
+from blackbird_tpu.native import check, lib
+from blackbird_tpu.transferlink import TransferLink
+
+__all__ = ["FabricClient", "FabricUnavailable"]
+
+
+class FabricUnavailable(RuntimeError):
+    """The object (or this process) cannot use the device fabric; fall back
+    to the staged byte path (Client.get / Client.put)."""
+
+
+class FabricClient:
+    """Fabric-direct get/put for a client process that owns a JAX runtime.
+
+    Wraps an ordinary `Client` (which keeps serving metadata and the staged
+    fallback) and adds a transfer server bound to this process's first
+    local device. One FabricClient per process is the intended shape — it
+    mirrors the worker-side provider (hbm.py) one-server-per-process rule.
+    """
+
+    def __init__(self, client: Client, jax_module=None):
+        if jax_module is None:
+            import jax as jax_module  # noqa: PLC0415 - optional heavy import
+        self._client = client
+        self._jax = jax_module
+        # Shared fabric lifecycle (server, connections, offer GC) — the same
+        # TransferLink class the worker-side provider uses, so the stale-
+        # offer drain and single-drainer invariants apply to client offers
+        # too (a put whose worker-side pull never fires would otherwise pin
+        # the offered device array forever).
+        self._link = TransferLink(jax_module)
+        self.fabric_gets = 0
+        self.fabric_puts = 0
+
+    @staticmethod
+    def _eligible(copy: dict) -> bool:
+        shards = copy.get("shards", [])
+        if not shards or "ec" in copy:
+            return False
+        return all(
+            s.get("fabric") and s.get("location", {}).get("kind") == "memory"
+            for s in shards)
+
+    # -- fabric get ---------------------------------------------------------
+
+    def get(self, key: str):
+        """Returns the object as a uint8[size] jax.Array on this process's
+        device, pulled shard-by-shard over the fabric. Raises
+        FabricUnavailable when no copy is fully fabric-reachable (caller
+        falls back to Client.get)."""
+        jnp = self._jax.numpy
+        copies = self._client.placements(key)
+        last: Exception | None = None
+        for copy in copies:
+            if not self._eligible(copy):
+                continue
+            try:
+                parts = []
+                for shard in copy["shards"]:
+                    loc = shard["location"]
+                    tid = secrets.randbits(63)
+                    check(
+                        lib.btpu_fabric_offer(
+                            self._client._handle, shard["transport"].encode(),
+                            shard["endpoint"].encode(), loc["remote_addr"],
+                            loc.get("rkey", 0), shard["length"], tid),
+                        f"fabric offer {key!r}")
+                    parts.append(self._link.pull(shard["fabric"], tid, shard["length"]))
+                out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                self.fabric_gets += 1
+                return out
+            except Exception as exc:  # noqa: BLE001 - try the next copy
+                last = exc
+        raise FabricUnavailable(
+            f"no fabric-reachable copy of {key!r}"
+            + (f" (last error: {last})" if last else ""))
+
+    def get_bytes(self, key: str) -> bytes:
+        """Fabric get with a transparent staged fallback; returns host bytes
+        (the convenience shape for checkpoint tooling)."""
+        try:
+            return np.asarray(self.get(key)).tobytes()
+        except FabricUnavailable:
+            return self._client.get(key)
+
+    # -- fabric put ---------------------------------------------------------
+
+    def put(self, key: str, data, *, replicas: int = 1, max_workers: int = 4,
+            preferred_class: str = "hbm_tpu") -> None:
+        """Stores `data` (jax.Array / numpy, any dtype) under `key` with the
+        bytes moving over the fabric: this process offers each shard range
+        and the worker pulls it straight into its device region. Raises
+        FabricUnavailable (after cancelling the reservation) when the
+        granted placement has no fabric endpoints — callers fall back to
+        Client.put.
+
+        Fabric puts are unstamped (no content CRC): the bytes never pass
+        through this host, so there is nothing cheap to hash them with.
+        Verified reads of such objects skip the CRC gate, like any legacy
+        unstamped object."""
+        jnp = self._jax.numpy
+        arr = jnp.asarray(data)
+        if arr.dtype == jnp.uint8:
+            flat = arr.reshape(-1)
+        else:
+            # Byte view without leaving the device: bitcast f32[n] ->
+            # u8[n, itemsize], then flatten.
+            flat = self._jax.lax.bitcast_convert_type(
+                arr.reshape(-1), jnp.uint8).reshape(-1)
+        size = int(flat.size)
+        handle = self._client._handle
+        out_len = ctypes.c_uint64(0)
+        buf = ctypes.create_string_buffer(1 << 20)
+        check(
+            lib.btpu_put_start_json(handle, key.encode(), size, replicas, max_workers,
+                                    preferred_class.encode(), buf, len(buf), out_len),
+            f"put_start {key!r}")
+        # Everything from here on runs under the cancel guard: a truncated
+        # placements document (out_len > buffer) or a failed shard push must
+        # release the reservation, not leave the key blocked until GC.
+        try:
+            if out_len.value > len(buf):
+                raise FabricUnavailable(
+                    f"placements for {key!r} exceed {len(buf)} bytes "
+                    f"({out_len.value}); fall back to the staged path")
+            copies = json.loads(buf.raw[: out_len.value].decode())
+            addr = self._link.address()
+            if addr is None:
+                raise FabricUnavailable("no transfer server in this process")
+            pushed = 0
+            for copy in copies:
+                if not self._eligible(copy):
+                    continue
+                off = 0
+                for shard in copy["shards"]:
+                    loc = shard["location"]
+                    n = shard["length"]
+                    tid = secrets.randbits(63)
+                    # offer() tracks the array for the stale-offer GC: if
+                    # the worker's pull never fires, the self-pull drain
+                    # unpins it instead of leaking device memory.
+                    self._link.offer(tid, flat[off : off + n])
+                    check(
+                        lib.btpu_fabric_pull(handle, shard["transport"].encode(),
+                                             shard["endpoint"].encode(),
+                                             loc["remote_addr"], loc.get("rkey", 0), n,
+                                             tid, addr.encode()),
+                        f"fabric pull {key!r}")
+                    off += n
+                pushed += 1
+            if pushed != len(copies):
+                raise FabricUnavailable(
+                    f"{len(copies) - pushed} of {len(copies)} copies lack fabric "
+                    f"endpoints for {key!r}")
+            check(lib.btpu_put_complete(handle, key.encode()), f"put_complete {key!r}")
+            self.fabric_puts += 1
+        except Exception:
+            lib.btpu_put_cancel(handle, key.encode())
+            raise

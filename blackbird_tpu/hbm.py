@@ -163,19 +163,13 @@ class JaxHbmProvider:
         # locks first).
         self._staging: dict = {}
         self._staging_lock = threading.Lock()
-        # Cross-process device fabric (lazily started transfer server):
-        # None = not probed, False = unavailable/disabled.
-        self._fabric = None
-        self._fabric_lock = threading.Lock()
-        self._fabric_conns: dict = {}
-        self._offered: dict = {}  # transfer_id -> (spec, offered_at)
-        # Single GC drainer (created under _fabric_lock on first use): stale
-        # offers queue here; one thread self-pulls them serially.
-        self._fabric_gc_queue = None
-        self.fabric_offers = 0
-        self.fabric_gc_dropped = 0  # stale offers dropped: drainer stuck
+        # Cross-process device fabric: the shared per-process transfer
+        # endpoint (server + connections + offer GC) lives in TransferLink,
+        # one lifecycle for this provider and fabric.py's FabricClient.
+        from blackbird_tpu.transferlink import TransferLink
+
+        self._link = TransferLink(jax)
         self.fabric_pulls = 0
-        self.fabric_discards = 0
 
         P = page_bytes
         jnp = jax.numpy
@@ -869,38 +863,23 @@ class JaxHbmProvider:
             return 1
 
     # -- cross-process device fabric (jax.experimental.transfer) -----------
+    # Server/connection/offer-GC lifecycle is shared with fabric.py through
+    # TransferLink; this provider adds only the region <-> array glue.
+
+    @property
+    def fabric_offers(self):
+        return self._link.offers
+
+    @property
+    def fabric_discards(self):
+        return self._link.discards
+
+    @property
+    def fabric_gc_dropped(self):
+        return self._link.gc_dropped
 
     def _fabric_server(self):
-        """The lazily started per-process transfer server, or None.
-
-        On TPU the transfer rides the chip fabric; on CPU it is a bulk
-        socket between the two processes' runtimes — either way the bytes
-        never pass through the keystone or the worker's staged host lane.
-        BTPU_HBM_FABRIC=0 disables."""
-        with self._fabric_lock:
-            if self._fabric is not None:
-                return self._fabric or None
-            if os.environ.get("BTPU_HBM_FABRIC") == "0":
-                self._fabric = False
-                return None
-            try:
-                from jax.experimental import transfer
-
-                dev = self._jax.local_devices()[0]
-                self._fabric = transfer.start_transfer_server(
-                    dev.client, "127.0.0.1:0", ["127.0.0.1:0"])
-            except Exception:  # noqa: BLE001 - no fabric on this stack
-                self._fabric = False
-                return None
-            return self._fabric
-
-    def _fabric_connection(self, addr: str):
-        server = self._fabric_server()  # before the lock: it takes the same lock
-        with self._fabric_lock:
-            conn = self._fabric_conns.get(addr)
-            if conn is None:
-                conn = self._fabric_conns[addr] = server.connect(addr)
-            return conn
+        return self._link.server()
 
     def _fabric_range_array(self, region, offset: int, length: int):
         """The region's [offset, offset+len) bytes as a 1-D device array —
@@ -930,77 +909,15 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
-    def _fabric_gc_offers(self) -> None:
-        """Discards offers whose pull never came (orchestrator fell back):
-        the transfer server pins each offered device array until SOMETHING
-        pulls it, and the API has no cancel — so stale offers are drained by
-        a self-pull. The source never learns of a successful remote pull, so
-        consumed ids are self-pulled once too — measured to complete quickly
-        (the server answers; no hang), but that is observed, not documented
-        behavior, so the pulls run on ONE long-lived daemon thread fed by a
-        queue: if a JAX version ever blocks on a consumed/unknown id, that
-        thread wedges in isolation while the transport thread serving live
-        offers keeps going — and because there is only ever one drainer, two
-        pulls can never race on the shared cached connection. Runs
-        opportunistically before each new offer."""
-        import time
-
-        now = time.monotonic()
-        with self._fabric_lock:
-            stale = [(tid, spec) for tid, (spec, at) in self._offered.items()
-                     if now - at > 60.0]
-            for tid, _spec in stale:
-                del self._offered[tid]
-            if not stale:
-                return
-            if self._fabric_gc_queue is None:
-                import queue
-
-                # Bounded: if the drainer ever wedges (the scenario this
-                # design isolates), the queue fills and further entries are
-                # DROPPED with a counter instead of accumulating forever —
-                # their device arrays stay pinned either way (only a pull
-                # releases an offer), so the counter is the observable
-                # signal that HBM is leaking and the runtime needs a bounce.
-                self._fabric_gc_queue = queue.Queue(maxsize=256)
-
-                def _drain():
-                    while True:
-                        tid, spec = self._fabric_gc_queue.get()
-                        try:
-                            self._fabric_connection(
-                                self._fabric_server().address()).pull(tid, [spec])
-                            self.fabric_discards += 1
-                        except Exception:  # noqa: BLE001 - best effort
-                            pass
-
-                threading.Thread(
-                    target=_drain, daemon=True, name="btpu-fabric-gc").start()
-        for entry in stale:
-            try:
-                self._fabric_gc_queue.put_nowait(entry)
-            except Exception:  # noqa: BLE001 - queue full: drainer is stuck
-                self.fabric_gc_dropped += 1
-
     def _fabric_offer(self, _ctx, region_id, offset, length, transfer_id):
         try:
-            server = self._fabric_server()
             with self._lock:
                 region = self._regions.get(region_id)
-            if server is None or region is None or offset + length > region["size"]:
+            if (self._link.server() is None or region is None
+                    or offset + length > region["size"]):
                 return 1
-            self._fabric_gc_offers()
             arr = self._fabric_range_array(region, offset, length)
-            server.await_pull(int(transfer_id), [arr])
-            import time
-
-            from jax.sharding import SingleDeviceSharding
-
-            spec = self._jax.ShapeDtypeStruct(
-                arr.shape, arr.dtype, sharding=SingleDeviceSharding(region["device"]))
-            with self._fabric_lock:
-                self._offered[int(transfer_id)] = (spec, time.monotonic())
-            self.fabric_offers += 1
+            self._link.offer(int(transfer_id), arr, device=region["device"])
             return 0
         except Exception:  # noqa: BLE001
             return 1
@@ -1009,18 +926,15 @@ class JaxHbmProvider:
         try:
             jax = self._jax
             jnp = jax.numpy
-            from jax.sharding import SingleDeviceSharding
 
-            if self._fabric_server() is None:
+            if self._link.server() is None:
                 return 1
             with self._lock:
                 region = self._regions.get(region_id)
             if region is None or offset + length > region["size"]:
                 return 1
-            conn = self._fabric_connection(remote_addr.decode())
-            spec = jax.ShapeDtypeStruct((int(length),), jnp.uint8,
-                                        sharding=SingleDeviceSharding(region["device"]))
-            out = conn.pull(int(transfer_id), [spec])[0]
+            out = self._link.pull(remote_addr.decode(), int(transfer_id), int(length),
+                                  device=region["device"])
             if region["view"] is not None:
                 region["view"][offset : offset + length] = np.asarray(out)
             else:

@@ -117,6 +117,15 @@ def _load() -> ctypes.CDLL:
         "btpu_put_ec2": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
                                u32, ctypes.c_int64, i32, i32]),
         "btpu_drain_worker": (i32, [c, ctypes.c_char_p, ctypes.POINTER(u64)]),
+        "btpu_put_start_json": (i32, [c, ctypes.c_char_p, u64, u32, u32,
+                                      ctypes.c_char_p, ctypes.c_char_p, u64,
+                                      ctypes.POINTER(u64)]),
+        "btpu_put_complete": (i32, [c, ctypes.c_char_p]),
+        "btpu_put_cancel": (i32, [c, ctypes.c_char_p]),
+        "btpu_fabric_offer": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64, u64,
+                                    u64, u64]),
+        "btpu_fabric_pull": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64, u64,
+                                   u64, u64, ctypes.c_char_p]),
         "btpu_worker_create": (c, [ctypes.c_char_p, ctypes.c_char_p]),
         "btpu_worker_pool_count": (u32, [c]),
         "btpu_worker_id": (ctypes.c_char_p, [c]),

@@ -1,0 +1,148 @@
+"""Shared per-process endpoint for the device fabric (jax.experimental.transfer).
+
+One lifecycle, two users: the worker-side HBM provider (hbm.py) serving
+keystone-commanded offers/pulls, and the client-side FabricClient
+(fabric.py) moving bytes with its own runtime. Both need exactly the same
+hard-won plumbing, which therefore lives here once:
+
+  * lazy server start bound to this process's device client, with the
+    BTPU_HBM_FABRIC=0 gate and a graceful "no fabric on this stack" probe
+    (None, never an exception, on the serving paths);
+  * a connection cache keyed by remote address;
+  * offer bookkeeping with stale-offer GC: the transfer server pins every
+    await_pull'd array until SOMETHING pulls it and the API has no cancel,
+    so stale offers are drained by self-pulls — on ONE long-lived daemon
+    thread fed by a bounded queue, so a wedged pull isolates instead of
+    stalling the serving path, two pulls never race on the shared cached
+    connection, and a stuck drainer surfaces as `gc_dropped` instead of an
+    unbounded queue.
+
+On TPU the transfer rides the chip fabric; on CPU it is a bulk socket
+between the two processes' runtimes — either way the bytes never pass
+through the keystone or the worker's staged host lane.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["TransferLink"]
+
+
+class TransferLink:
+    def __init__(self, jax_module, device=None):
+        self._jax = jax_module
+        self._device = device  # default: first local device, resolved lazily
+        self._server = None  # None = unprobed, False = unavailable/disabled
+        self._lock = threading.Lock()
+        self._conns: dict[str, object] = {}
+        self._offered: dict[int, tuple[object, float]] = {}
+        self._gc_queue = None
+        self.offers = 0
+        self.discards = 0  # stale offers drained by the GC self-pull
+        self.gc_dropped = 0  # stale offers dropped: drainer is stuck
+
+    # -- server / connections ----------------------------------------------
+
+    def device(self):
+        if self._device is None:
+            self._device = self._jax.local_devices()[0]
+        return self._device
+
+    def server(self):
+        """The lazily started per-process transfer server, or None
+        (disabled via BTPU_HBM_FABRIC=0, or unavailable on this stack)."""
+        with self._lock:
+            if self._server is not None:
+                return self._server or None
+            if os.environ.get("BTPU_HBM_FABRIC") == "0":
+                self._server = False
+                return None
+            try:
+                from jax.experimental import transfer  # noqa: PLC0415
+
+                self._server = transfer.start_transfer_server(
+                    self.device().client, "127.0.0.1:0", ["127.0.0.1:0"])
+            except Exception:  # noqa: BLE001 - no fabric on this stack
+                self._server = False
+                return None
+            return self._server
+
+    def address(self) -> str | None:
+        server = self.server()
+        return server.address() if server is not None else None
+
+    def connect(self, addr: str):
+        server = self.server()  # before the lock: it takes the same lock
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._conns[addr] = server.connect(addr)
+            return conn
+
+    def _spec(self, shape, dtype, device):
+        from jax.sharding import SingleDeviceSharding  # noqa: PLC0415
+
+        return self._jax.ShapeDtypeStruct(
+            shape, dtype, sharding=SingleDeviceSharding(device))
+
+    # -- offers --------------------------------------------------------------
+
+    def offer(self, transfer_id: int, arr, device=None) -> None:
+        """Registers `arr` for a remote pull under `transfer_id` and tracks
+        it for GC. Raises when the server is unavailable."""
+        server = self.server()
+        if server is None:
+            raise RuntimeError("device fabric unavailable")
+        self.gc_offers()
+        server.await_pull(int(transfer_id), [arr])
+        spec = self._spec(arr.shape, arr.dtype, device or self.device())
+        with self._lock:
+            self._offered[int(transfer_id)] = (spec, time.monotonic())
+        self.offers += 1
+
+    def pull(self, addr: str, transfer_id: int, length: int, device=None):
+        """Pulls uint8[length] offered under `transfer_id` at `addr` into
+        this process's runtime; returns the device array."""
+        import numpy as np  # noqa: PLC0415
+
+        spec = self._spec((int(length),), np.uint8, device or self.device())
+        return self.connect(addr).pull(int(transfer_id), [spec])[0]
+
+    def gc_offers(self, max_age_s: float = 60.0) -> None:
+        """Discards offers whose pull never came (the peer fell back): the
+        source never learns of a successful remote pull either, so consumed
+        ids are self-pulled once too — measured to complete quickly, but
+        that is observed, not documented, behavior, hence the isolated
+        single drainer thread (see module docstring)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [(tid, spec) for tid, (spec, at) in self._offered.items()
+                     if now - at > max_age_s]
+            for tid, _spec in stale:
+                del self._offered[tid]
+            if not stale:
+                return
+            if self._gc_queue is None:
+                import queue  # noqa: PLC0415
+
+                self._gc_queue = queue.Queue(maxsize=256)
+
+                def _drain():
+                    while True:
+                        tid, spec = self._gc_queue.get()
+                        try:
+                            self.connect(self.server().address()).pull(tid, [spec])
+                            self.discards += 1
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
+
+                threading.Thread(
+                    target=_drain, daemon=True, name="btpu-fabric-gc").start()
+        for entry in stale:
+            try:
+                self._gc_queue.put_nowait(entry)
+            except Exception:  # noqa: BLE001 - queue full: drainer is stuck
+                self.gc_dropped += 1

@@ -97,6 +97,31 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
  * off the live worker then retires it; out_moved = shards migrated. */
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved);
 
+/* ---- client-driven device fabric (runtime-owning clients) ----------------
+ * A client that owns a JAX runtime moves device-tier bytes itself over the
+ * transfer fabric instead of the worker's staged host lane:
+ *   get: btpu_fabric_offer commands the worker to offer a shard range under
+ *        transfer_id; the caller pulls it with its own runtime from the
+ *        shard's "fabric" address (btpu_placements_json carries it).
+ *   put: btpu_put_start_json grants placements; the caller offers each
+ *        shard's bytes on its OWN fabric server and commands the worker to
+ *        pull them (btpu_fabric_pull with src_fabric = caller's address),
+ *        then btpu_put_complete publishes (or btpu_put_cancel rolls back).
+ * transport/endpoint/remote_addr/rkey come verbatim from the placements
+ * JSON ("transport", "endpoint", location "remote_addr"/"rkey"). */
+int32_t btpu_put_start_json(btpu_client* client, const char* key, uint64_t size,
+                            uint32_t replicas, uint32_t max_workers,
+                            const char* preferred_class, char* buffer,
+                            uint64_t buffer_size, uint64_t* out_len);
+int32_t btpu_put_complete(btpu_client* client, const char* key);
+int32_t btpu_put_cancel(btpu_client* client, const char* key);
+int32_t btpu_fabric_offer(btpu_client* client, const char* transport, const char* endpoint,
+                          uint64_t remote_addr, uint64_t rkey, uint64_t len,
+                          uint64_t transfer_id);
+int32_t btpu_fabric_pull(btpu_client* client, const char* transport, const char* endpoint,
+                         uint64_t remote_addr, uint64_t rkey, uint64_t len,
+                         uint64_t transfer_id, const char* src_fabric);
+
 /* Erasure-coded put: ec_data (k) + ec_parity (m) Reed-Solomon shards, any m
  * losses tolerated at (k+m)/k storage overhead (replication_factor does not
  * apply — one coded copy). ttl_ms < 0 keeps the default TTL. */

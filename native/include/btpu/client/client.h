@@ -174,6 +174,28 @@ class ObjectClient {
   };
   Result<std::vector<ShardFinding>> scrub_object(const ObjectKey& key);
 
+  // ---- client-driven device fabric (runtime-owning clients) ---------------
+  // The reference's defining property is that clients move bytes themselves
+  // (blackbird_client.cpp:276-343, one-sided RMA). On the device tier the
+  // TPU-native equivalent is the transfer fabric: a client that OWNS a JAX
+  // runtime commands the worker to OFFER a shard range on its fabric (then
+  // pulls it with its own runtime, device-to-device), or to PULL a range
+  // the client offered (fabric put). Plumbing for blackbird_tpu/fabric.py;
+  // the staged host lane remains the fallback for runtime-less clients.
+  ErrorCode fabric_offer(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                         uint64_t len, uint64_t transfer_id);
+  ErrorCode fabric_pull(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
+                        uint64_t len, uint64_t transfer_id, const std::string& src_fabric);
+  // Put lifecycle for out-of-band writers (the fabric put path): placements
+  // from put_start, bytes moved by the caller, then complete/cancel. The
+  // packaged put()/put_many() remain the one-call path for host writers.
+  Result<std::vector<CopyPlacement>> put_start(const ObjectKey& key, uint64_t size,
+                                               const WorkerConfig& config,
+                                               uint32_t content_crc = 0);
+  ErrorCode put_complete(const ObjectKey& key,
+                         const std::vector<CopyShardCrcs>& shard_crcs = {});
+  ErrorCode put_cancel(const ObjectKey& key);
+
   ErrorCode remove(const ObjectKey& key);
   Result<uint64_t> remove_all();
   // Graceful worker evacuation (keystone::drain_worker semantics).

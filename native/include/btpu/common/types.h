@@ -113,6 +113,11 @@ struct RemoteDescriptor {
   std::string endpoint;      // "host:port" (tcp), shm name (shm), mesh axis addr (ici)
   uint64_t remote_base{0};   // base remote address of the registered region
   std::string rkey_hex;      // packed region key, hex-encoded
+  // Device-fabric endpoint serving this region ("" = none): rides into
+  // every ShardPlacement cut from the pool, so a runtime-owning CLIENT can
+  // fabric-pull/offer shards directly (jax.experimental.transfer) instead
+  // of staging through the worker's host lane. Wire-append-only.
+  std::string fabric_addr;
 
   bool operator==(const RemoteDescriptor&) const = default;
 };

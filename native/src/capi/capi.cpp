@@ -332,16 +332,30 @@ std::string json_escape(const std::string& s) {
 }
 }  // namespace
 
+namespace {
+std::string placements_to_json(const std::vector<CopyPlacement>& copies);
+}
+
 int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
                              uint64_t buffer_size, uint64_t* out_len) {
   if (!client || !key || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   auto placements = client->impl->get_workers(key);
   if (!placements.ok()) return static_cast<int32_t>(placements.error());
+  const std::string json = placements_to_json(placements.value());
+  *out_len = json.size();
+  if (buffer && buffer_size > 0) {
+    const uint64_t n = std::min<uint64_t>(buffer_size, json.size());
+    std::memcpy(buffer, json.data(), n);
+  }
+  return 0;
+}
 
+namespace {
+std::string placements_to_json(const std::vector<CopyPlacement>& copies) {
   std::string json = "[";
   const auto& esc = json_escape;
   bool first_copy = true;
-  for (const auto& copy : placements.value()) {
+  for (const auto& copy : copies) {
     if (!first_copy) json += ",";
     first_copy = false;
     json += "{\"copy_index\":" + std::to_string(copy.copy_index);
@@ -362,10 +376,14 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
               std::string(storage_class_name(shard.storage_class)) +
               "\",\"transport\":\"" +
               std::string(transport_kind_name(shard.remote.transport)) +
-              "\",\"length\":" + std::to_string(shard.length) + ",\"location\":";
+              "\",\"endpoint\":\"" + esc(shard.remote.endpoint) + "\"";
+      if (!shard.remote.fabric_addr.empty())
+        json += ",\"fabric\":\"" + esc(shard.remote.fabric_addr) + "\"";
+      json += ",\"length\":" + std::to_string(shard.length) + ",\"location\":";
       if (const auto* mem = std::get_if<MemoryLocation>(&shard.location)) {
         json += "{\"kind\":\"memory\",\"remote_addr\":" +
-                std::to_string(mem->remote_addr) + "}";
+                std::to_string(mem->remote_addr) + ",\"rkey\":" +
+                std::to_string(mem->rkey) + "}";
       } else if (const auto* dev = std::get_if<DeviceLocation>(&shard.location)) {
         json += "{\"kind\":\"device\",\"device\":\"" + esc(dev->device_id) +
                 "\",\"region\":" + std::to_string(dev->region_id) +
@@ -381,13 +399,81 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
     json += "]}";
   }
   json += "]";
+  return json;
+}
+}  // namespace
 
+// Put lifecycle + fabric commands for runtime-owning clients (fabric.py):
+// put_start returns the granted placements as JSON; the caller moves the
+// bytes itself (e.g. device fabric) and then completes or cancels.
+int32_t btpu_put_start_json(btpu_client* client, const char* key, uint64_t size,
+                            uint32_t replicas, uint32_t max_workers,
+                            const char* preferred_class, char* buffer,
+                            uint64_t buffer_size, uint64_t* out_len) {
+  if (!client || !key || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  WorkerConfig config;
+  config.replication_factor = replicas ? replicas : 1;
+  config.max_workers_per_copy = max_workers ? max_workers : 1;
+  if (preferred_class && *preferred_class) {
+    auto cls = storage_class_from_name(preferred_class);
+    if (!cls) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+    config.preferred_classes = {*cls};
+  }
+  auto placed = client->impl->put_start(key, size, config);
+  if (!placed.ok()) return static_cast<int32_t>(placed.error());
+  const std::string json = placements_to_json(placed.value());
   *out_len = json.size();
   if (buffer && buffer_size > 0) {
     const uint64_t n = std::min<uint64_t>(buffer_size, json.size());
     std::memcpy(buffer, json.data(), n);
   }
   return 0;
+}
+
+int32_t btpu_put_complete(btpu_client* client, const char* key) {
+  if (!client || !key) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  return static_cast<int32_t>(client->impl->put_complete(key));
+}
+
+int32_t btpu_put_cancel(btpu_client* client, const char* key) {
+  if (!client || !key) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  return static_cast<int32_t>(client->impl->put_cancel(key));
+}
+
+namespace {
+int32_t make_remote(const char* transport, const char* endpoint, RemoteDescriptor& out) {
+  if (!transport || !endpoint) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto kind = transport_kind_from_name(transport);
+  if (!kind) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  out.transport = *kind;
+  out.endpoint = endpoint;
+  return 0;
+}
+}  // namespace
+
+// Commands the worker serving (transport, endpoint) to OFFER
+// [remote_addr, remote_addr+len) on its device fabric under transfer_id;
+// the caller pulls it with its own JAX runtime.
+int32_t btpu_fabric_offer(btpu_client* client, const char* transport, const char* endpoint,
+                          uint64_t remote_addr, uint64_t rkey, uint64_t len,
+                          uint64_t transfer_id) {
+  if (!client) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  RemoteDescriptor remote;
+  if (auto rc = make_remote(transport, endpoint, remote)) return rc;
+  return static_cast<int32_t>(
+      client->impl->fabric_offer(remote, remote_addr, rkey, len, transfer_id));
+}
+
+// Commands the worker to PULL transfer_id from src_fabric into its region
+// at [remote_addr, remote_addr+len) — the fabric put leg.
+int32_t btpu_fabric_pull(btpu_client* client, const char* transport, const char* endpoint,
+                         uint64_t remote_addr, uint64_t rkey, uint64_t len,
+                         uint64_t transfer_id, const char* src_fabric) {
+  if (!client || !src_fabric) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  RemoteDescriptor remote;
+  if (auto rc = make_remote(transport, endpoint, remote)) return rc;
+  return static_cast<int32_t>(
+      client->impl->fabric_pull(remote, remote_addr, rkey, len, transfer_id, src_fabric));
 }
 
 int32_t btpu_list_json(btpu_client* client, const char* prefix, uint64_t limit, char* buffer,

@@ -263,6 +263,42 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
   return got;
 }
 
+ErrorCode ObjectClient::fabric_offer(const RemoteDescriptor& remote, uint64_t addr,
+                                     uint64_t rkey, uint64_t len, uint64_t transfer_id) {
+  return data_->fabric_offer(remote, addr, rkey, len, transfer_id);
+}
+
+ErrorCode ObjectClient::fabric_pull(const RemoteDescriptor& remote, uint64_t addr,
+                                    uint64_t rkey, uint64_t len, uint64_t transfer_id,
+                                    const std::string& src_fabric) {
+  return data_->fabric_pull(remote, addr, rkey, len, transfer_id, src_fabric);
+}
+
+Result<std::vector<CopyPlacement>> ObjectClient::put_start(const ObjectKey& key,
+                                                           uint64_t size,
+                                                           const WorkerConfig& config,
+                                                           uint32_t content_crc) {
+  invalidate_placements(key);  // same re-created-key rule as put()
+  if (embedded_) return embedded_->put_start(key, size, config, content_crc);
+  return rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
+    return r.put_start(key, size, config, content_crc);
+  });
+}
+
+ErrorCode ObjectClient::put_complete(const ObjectKey& key,
+                                     const std::vector<CopyShardCrcs>& shard_crcs) {
+  if (embedded_) return embedded_->put_complete(key, shard_crcs);
+  return rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
+    return r.put_complete(key, shard_crcs);
+  });
+}
+
+ErrorCode ObjectClient::put_cancel(const ObjectKey& key) {
+  if (embedded_) return embedded_->put_cancel(key);
+  return rpc_failover(/*idempotent=*/false,
+                      [&](rpc::KeystoneRpcClient& r) { return r.put_cancel(key); });
+}
+
 ErrorCode ObjectClient::remove(const ObjectKey& key) {
   invalidate_placements(key);  // a re-created key must not serve stale bytes
   if (embedded_) return embedded_->remove_object(key);

@@ -234,6 +234,9 @@ ErrorCode WorkerService::initialize() {
     runtime.record.used = 0;
     runtime.record.storage_class = pool_cfg.storage_class;
     runtime.record.remote = registered.value();
+    // The fabric endpoint rides the remote descriptor too: shards cut from
+    // this pool carry it to clients, which can then fabric-pull directly.
+    runtime.record.remote.fabric_addr = runtime.record.fabric_addr;
     runtime.record.topo = config_.topo;
     // HBM placements default to provider-chunk alignment so whole shards
     // map to whole device chunks (single transfer, no read-modify-write).

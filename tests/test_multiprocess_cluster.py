@@ -486,6 +486,103 @@ pools:
         teardown(procs)
 
 
+def test_fabric_client_moves_device_bytes_itself(tmp_path):
+    """VERDICT r4 item 1 (the reference's defining property, TPU-shaped):
+    a client that OWNS a JAX runtime moves device-tier bytes ITSELF over
+    the transfer fabric — put offers shard ranges from this process's
+    runtime and the worker pulls them straight into its device region; get
+    commands the worker to offer and this process pulls. The worker's
+    staged host lane is never part of the data path (both legs go through
+    the fabric opcodes only; a staged read cross-validates the bytes)."""
+    coord_port = free_port()
+    keystone_port = free_port()
+    metrics_port = free_port()
+    keystone_cfg = tmp_path / "keystone.yaml"
+    keystone_cfg.write_text(
+        f"""cluster_id: fab_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{keystone_port}
+http_metrics_port: "{metrics_port}"
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 2
+""")
+    worker_cfg = tmp_path / "pyworker.yaml"
+    worker_cfg.write_text(
+        f"""worker_id: fabw-0
+cluster_id: fab_cluster
+coord_endpoints: 127.0.0.1:{coord_port}
+transport: tcp
+listen_host: 127.0.0.1
+heartbeat:
+  interval_ms: 300
+  ttl_ms: 1200
+pools:
+  - id: fabw-0-hbm
+    storage_class: hbm_tpu
+    capacity: 16MB
+    device_id: tpu:0
+  - id: fabw-0-dram
+    storage_class: ram_cpu
+    capacity: 16MB
+""")
+    procs = []
+    spawn = make_spawner(procs)
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
+        wait_for(lambda: port_open(keystone_port), what="bb-keystone")
+        worker = spawn(
+            [sys.executable, "-m", "blackbird_tpu.worker", "--config", str(worker_cfg)],
+            "py-worker")
+
+        import numpy as np
+
+        from blackbird_tpu import Client, FabricClient, FabricUnavailable, StorageClass
+
+        client = Client(f"127.0.0.1:{keystone_port}")
+
+        def pools_up():
+            assert worker.poll() is None, "python worker exited early"
+            return client.stats()["pools"] == 2
+
+        wait_for(pools_up, timeout=240, what="python worker pools")
+
+        fc = FabricClient(client)
+
+        # Fabric put: this runtime offers, the worker pulls device-side.
+        data = np.arange(512 * 1024, dtype=np.float32)  # 2 MiB
+        fc.put("fab/x", data, max_workers=1, preferred_class="hbm_tpu")
+        assert fc.fabric_puts == 1
+
+        # The placements carry the fabric endpoint end to end.
+        placement = client.placements("fab/x")[0]
+        assert all(s.get("fabric") for s in placement["shards"])
+
+        # Staged lane cross-validates the bytes the fabric wrote.
+        assert client.get("fab/x") == data.tobytes()
+
+        # Fabric get: the worker offers, THIS runtime pulls.
+        arr = fc.get("fab/x")
+        assert np.asarray(arr).tobytes() == data.tobytes()
+        assert fc.fabric_gets == 1
+
+        # Host-tier objects have no fabric endpoint: clean fallback signal,
+        # and the convenience wrapper falls back to the staged byte path.
+        client.put("fab/host", b"hostbytes" * 1000,
+                   preferred_class=StorageClass.RAM_CPU)
+        try:
+            fc.get("fab/host")
+            raise AssertionError("expected FabricUnavailable for a host-tier object")
+        except FabricUnavailable:
+            pass
+        assert fc.get_bytes("fab/host") == b"hostbytes" * 1000
+    finally:
+        teardown(procs)
+
+
 def test_multiprocess_coordinator_standby_failover(tmp_path):
     """Primary + standby bb-coord pair: the standby mirrors state over the
     replication stream; when the primary is SIGKILLed, the standby promotes

@@ -81,6 +81,10 @@ class FabricClient:
         FabricUnavailable when no copy is fully fabric-reachable (caller
         falls back to Client.get)."""
         jnp = self._jax.numpy
+        # Fail fast BEFORE commanding any worker-side offer: an offer with
+        # no pull coming pins worker device memory until the stale-offer GC.
+        if self._link.address() is None:
+            raise FabricUnavailable("no transfer server in this process")
         copies = self._client.placements(key)
         last: Exception | None = None
         for copy in copies:

@@ -1,0 +1,270 @@
+// bb-soak: bounded randomized chaos soak (VERDICT r4 item 8).
+//
+// Concurrent put/get/remove writers against an embedded cluster while a
+// chaos thread kills and revives workers, runs scrub passes, and drains —
+// the single-fault e2e tests' scenarios composed at random, under time
+// pressure. Exit 0 requires the end-state invariants:
+//   * every object the writers successfully put (and did not remove) reads
+//     back byte-correct — with replication 2 and at most one worker down
+//     at a time, nothing may be lost (objects_lost == 0);
+//   * keystone accounting is consistent: total_objects matches the
+//     writers' live-set size.
+// Intended to run under TSan (build-tsan/bb-soak): the clean run is the
+// data-race check the single-shot tests cannot give.
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <unordered_map>
+
+#include "btpu/client/embedded.h"
+#include "tsan_rma_suppression.h"
+
+using namespace btpu;
+using Clock = std::chrono::steady_clock;
+
+namespace {
+
+// Deterministic per-key payload: verification needs no stored bytes.
+std::vector<uint8_t> pattern_for(const std::string& key, uint64_t size) {
+  std::vector<uint8_t> data(size);
+  uint64_t h = 1469598103934665603ull;
+  for (char ch : key) h = (h ^ static_cast<uint8_t>(ch)) * 1099511628211ull;
+  for (uint64_t i = 0; i < size; ++i) {
+    h = h * 6364136223846793005ull + 1442695040888963407ull;
+    data[i] = static_cast<uint8_t>(h >> 56);
+  }
+  return data;
+}
+
+struct LiveSet {
+  std::mutex mutex;
+  std::unordered_map<std::string, uint64_t> sizes;  // key -> size
+  uint64_t bytes{0};
+
+  void add(const std::string& key, uint64_t size) {
+    std::lock_guard<std::mutex> lock(mutex);
+    sizes[key] = size;
+    bytes += size;
+  }
+  uint64_t total_bytes() {
+    std::lock_guard<std::mutex> lock(mutex);
+    return bytes;
+  }
+  bool take_random(std::mt19937_64& rng, std::string& key, uint64_t& size, bool erase) {
+    std::lock_guard<std::mutex> lock(mutex);
+    if (sizes.empty()) return false;
+    auto it = sizes.begin();
+    std::advance(it, std::uniform_int_distribution<size_t>(0, sizes.size() - 1)(rng));
+    key = it->first;
+    size = it->second;
+    if (erase) {
+      bytes -= it->second;
+      sizes.erase(it);
+    }
+    return true;
+  }
+  size_t count() {
+    std::lock_guard<std::mutex> lock(mutex);
+    return sizes.size();
+  }
+  std::vector<std::pair<std::string, uint64_t>> snapshot() {
+    std::lock_guard<std::mutex> lock(mutex);
+    return {sizes.begin(), sizes.end()};
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int seconds = 60;
+  uint64_t seed = 42;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--seconds") && i + 1 < argc) seconds = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--seed") && i + 1 < argc) seed = std::stoull(argv[++i]);
+    else if (!std::strcmp(argv[i], "--help")) {
+      std::printf("usage: bb-soak [--seconds N] [--seed S]\n");
+      return 0;
+    }
+  }
+
+  auto options = client::EmbeddedClusterOptions::simple(4, 64ull << 20);
+  options.keystone.scrub_interval_sec = 3600;  // driven by the chaos thread
+  options.keystone.scrub_objects_per_pass = 8;
+  client::EmbeddedCluster cluster(std::move(options));
+  if (cluster.start() != ErrorCode::OK) {
+    std::fprintf(stderr, "soak: cluster start failed\n");
+    return 1;
+  }
+
+  const auto deadline = Clock::now() + std::chrono::seconds(seconds);
+  std::atomic<bool> stop{false};
+  std::atomic<bool> failed{false};
+  std::atomic<uint64_t> puts{0}, gets{0}, removes{0}, verify_fails{0}, put_fails{0};
+  LiveSet live;
+
+  auto fail = [&](const char* what, const std::string& detail) {
+    std::fprintf(stderr, "soak FAILURE: %s (%s)\n", what, detail.c_str());
+    failed.store(true);
+    stop.store(true);
+  };
+
+  // Writers: puts use replication 2 so ONE dead worker can never lose
+  // bytes; sizes cross the inline (<=4KiB) and placed regimes. Slot churn:
+  // rf=1 would engage slots only for remote clients, so the slot machinery
+  // is exercised separately by the e2e suite — this soak drives the
+  // embedded surface (direct keystone calls, the TSan-interesting one).
+  std::vector<std::thread> writers;
+  for (int w = 0; w < 2; ++w) {
+    writers.emplace_back([&, w] {
+      auto client = cluster.make_client();
+      std::mt19937_64 rng(seed * 977 + static_cast<uint64_t>(w));
+      WorkerConfig wc;
+      wc.replication_factor = 2;
+      wc.max_workers_per_copy = 1;
+      uint64_t counter = 0;
+      const uint64_t size_choices[] = {1 << 10, 4 << 10, 64 << 10, 256 << 10, 1 << 20};
+      // Writer pressure stays well under the eviction watermark: the soak's
+      // strict invariant is "nothing ever disappears", which watermark
+      // eviction (a legal, tested behavior) would void. 4 workers x 64 MiB
+      // x ~85% watermark / 2 replicas => cap the logical live set at
+      // 64 MiB so even one worker down leaves comfortable headroom.
+      constexpr uint64_t kLiveCap = 64ull << 20;
+      while (!stop.load() && Clock::now() < deadline) {
+        int op = static_cast<int>(rng() % 10);
+        if (op < 5 && live.total_bytes() > kLiveCap) op = 9;  // shed instead
+        if (op < 5) {  // put
+          const uint64_t size = size_choices[rng() % 5];
+          const std::string key =
+              "soak/" + std::to_string(w) + "/" + std::to_string(counter++);
+          auto data = pattern_for(key, size);
+          auto ec = client->put(key, data.data(), size, wc);
+          if (ec == ErrorCode::OK) {
+            live.add(key, size);
+            puts.fetch_add(1);
+          } else {
+            // Transient refusals (mid-kill capacity squeeze, leadership
+            // churn) are legal; systemic failure shows as zero progress.
+            put_fails.fetch_add(1);
+          }
+        } else if (op < 9) {  // verified get
+          std::string key;
+          uint64_t size = 0;
+          if (!live.take_random(rng, key, size, /*erase=*/false)) continue;
+          auto got = client->get(key, /*verify=*/true);
+          if (got.ok()) {
+            if (got.value() != pattern_for(key, size)) {
+              fail("byte mismatch on live object", key);
+              return;
+            }
+            gets.fetch_add(1);
+          } else if (got.error() != ErrorCode::OBJECT_NOT_FOUND) {
+            // Reads may fail transiently mid-kill (dead replica, repair in
+            // flight) — that is the point of replica failover, so a failed
+            // read of a LIVE key is only fatal at the end-state check.
+            // NOT_FOUND means a concurrent remove won the race: fine.
+          }
+        } else {  // remove
+          std::string key;
+          uint64_t size = 0;
+          if (!live.take_random(rng, key, size, /*erase=*/true)) continue;
+          if (client->remove(key) == ErrorCode::OK) removes.fetch_add(1);
+        }
+      }
+    });
+  }
+
+  // Chaos: at most one worker down at any moment (replication 2 tolerates
+  // exactly that); every cycle also drives a scrub pass. Occasionally a
+  // live worker is DRAINED (graceful evacuation) and then revived as a
+  // fresh worker under the same id.
+  std::thread chaos([&] {
+    std::mt19937_64 rng(seed);
+    auto client = cluster.make_client();
+    while (!stop.load() && Clock::now() < deadline) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1500 + rng() % 2000));
+      if (stop.load() || Clock::now() >= deadline) break;
+      const size_t victim = rng() % cluster.worker_count();
+      const int action = static_cast<int>(rng() % 3);
+      if (action == 0 && cluster.worker_alive(victim)) {
+        cluster.kill_worker(victim);
+        // Give failure detection + repair a beat, then bring it back.
+        std::this_thread::sleep_for(std::chrono::milliseconds(2500));
+        if (cluster.revive_worker(victim) != ErrorCode::OK) {
+          fail("revive failed", "worker " + std::to_string(victim));
+          return;
+        }
+      } else if (action == 1 && cluster.worker_alive(victim)) {
+        // Graceful drain, then return the capacity as a fresh worker.
+        (void)client->drain_worker("worker-" + std::to_string(victim));
+        cluster.kill_worker(victim);  // drop the retired instance
+        std::this_thread::sleep_for(std::chrono::milliseconds(500));
+        if (cluster.revive_worker(victim) != ErrorCode::OK) {
+          fail("revive after drain failed", "worker " + std::to_string(victim));
+          return;
+        }
+      } else {
+        cluster.keystone().run_scrub_once();
+      }
+    }
+  });
+
+  for (auto& t : writers) t.join();
+  stop.store(true);
+  chaos.join();
+
+  // Settle: every worker alive, give repair/health a few beats to converge.
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if (!cluster.worker_alive(i)) cluster.revive_worker(i);
+  }
+  std::this_thread::sleep_for(std::chrono::seconds(3));
+
+  // End-state invariants.
+  auto client = cluster.make_client();
+  uint64_t unreadable = 0;
+  for (const auto& [key, size] : live.snapshot()) {
+    auto got = client->get(key, /*verify=*/true);
+    if (!got.ok()) {
+      ++unreadable;
+      std::fprintf(stderr, "soak: %s unreadable at end state: %s\n", key.c_str(),
+                   std::string(to_string(got.error())).c_str());
+      continue;
+    }
+    if (got.value() != pattern_for(key, size)) {
+      ++verify_fails;
+      std::fprintf(stderr, "soak: %s corrupt at end state\n", key.c_str());
+    }
+  }
+  const auto& kc = cluster.keystone().counters();
+  auto stats = cluster.keystone().get_cluster_stats();
+  const uint64_t total_objects = stats.ok() ? stats.value().total_objects : 0;
+  const uint64_t lost = kc.objects_lost.load();
+  const bool accounting_ok = total_objects == live.count();
+
+  std::printf(
+      "soak: %llu puts (%llu refused), %llu verified gets, %llu removes, "
+      "%llu repaired, %llu scrub-healed, %llu drained shards | end state: "
+      "%zu live objects, %llu unreadable, %llu corrupt, %llu lost, "
+      "keystone says %llu objects\n",
+      (unsigned long long)puts.load(), (unsigned long long)put_fails.load(),
+      (unsigned long long)gets.load(), (unsigned long long)removes.load(),
+      (unsigned long long)kc.objects_repaired.load(),
+      (unsigned long long)kc.scrub_healed.load(),
+      (unsigned long long)kc.shards_drained.load(), live.count(),
+      (unsigned long long)unreadable, (unsigned long long)verify_fails.load(),
+      (unsigned long long)lost, (unsigned long long)total_objects);
+
+  if (failed.load() || unreadable || verify_fails.load() || lost || !accounting_ok) {
+    std::fprintf(stderr, "soak FAILED\n");
+    return 1;
+  }
+  if (puts.load() == 0 || gets.load() == 0) {
+    std::fprintf(stderr, "soak made no progress\n");
+    return 1;
+  }
+  std::printf("soak OK\n");
+  return 0;
+}

@@ -1,0 +1,15 @@
+// TSan default suppressions for sanitized btpu executables.
+//
+// Rationale (see native/src/transport/local_transport.cpp): the LOCAL
+// transport emulates one-sided RMA with a same-address-space memcpy, so a
+// reader racing a remote write is the modeled hardware behavior — always
+// discarded downstream through an epoch re-check or CRC gate. The hook
+// must live in the EXECUTABLE: TSan reads it during .preinit, before
+// shared-library symbols are guaranteed registered.
+#pragma once
+
+#if defined(__SANITIZE_THREAD__)
+extern "C" const char* __tsan_default_suppressions() {
+  return "race:btpu::transport::local_access\n";
+}
+#endif

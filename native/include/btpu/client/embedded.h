@@ -42,8 +42,17 @@ class EmbeddedCluster {
   // Kills worker i abruptly (no clean unregister): stops heartbeats and
   // drops its transport, as a preemption would.
   void kill_worker(size_t i);
+  // Brings a killed worker back as a FRESH process would come back: same id
+  // and pool ids, new memory (RAM pools lose their bytes — the keystone's
+  // repair already re-replicated them). The chaos-soak restart primitive.
+  ErrorCode revive_worker(size_t i);
+  bool worker_alive(size_t i) const { return i < workers_.size() && workers_[i] != nullptr; }
 
  private:
+  // Shared bring-up for start() and revive_worker (initialize + start +
+  // direct-feed registration): revived workers must be indistinguishable
+  // from originally-started ones.
+  Result<std::unique_ptr<worker::WorkerService>> start_worker_instance(size_t i);
   EmbeddedClusterOptions options_;
   std::shared_ptr<coord::MemCoordinator> coordinator_;
   std::unique_ptr<keystone::KeystoneService> keystone_;

@@ -39,6 +39,24 @@ EmbeddedCluster::EmbeddedCluster(EmbeddedClusterOptions options)
 
 EmbeddedCluster::~EmbeddedCluster() { stop(); }
 
+// One bring-up sequence for first start AND chaos-soak revival: a revived
+// worker must be indistinguishable from an originally-started one.
+Result<std::unique_ptr<worker::WorkerService>> EmbeddedCluster::start_worker_instance(
+    size_t i) {
+  auto worker_cfg = options_.workers[i];
+  if (worker_cfg.transport == TransportKind::TRANSPORT_UNSPECIFIED)
+    worker_cfg.transport = options_.transport;
+  auto worker = std::make_unique<worker::WorkerService>(worker_cfg, coordinator_);
+  BTPU_RETURN_IF_ERROR(worker->initialize());
+  BTPU_RETURN_IF_ERROR(worker->start());
+  if (!coordinator_) {
+    // Direct feed: no coordination service in the loop.
+    keystone_->register_worker(worker->info());
+    for (const auto& pool : worker->pools()) keystone_->register_memory_pool(pool);
+  }
+  return worker;
+}
+
 ErrorCode EmbeddedCluster::start() {
   if (running_) return ErrorCode::INVALID_STATE;
   if (options_.use_coordinator) coordinator_ = std::make_shared<coord::MemCoordinator>();
@@ -46,18 +64,10 @@ ErrorCode EmbeddedCluster::start() {
   BTPU_RETURN_IF_ERROR(keystone_->initialize());
   BTPU_RETURN_IF_ERROR(keystone_->start());
 
-  for (auto worker_cfg : options_.workers) {
-    if (worker_cfg.transport == TransportKind::TRANSPORT_UNSPECIFIED)
-      worker_cfg.transport = options_.transport;
-    auto worker = std::make_unique<worker::WorkerService>(worker_cfg, coordinator_);
-    BTPU_RETURN_IF_ERROR(worker->initialize());
-    BTPU_RETURN_IF_ERROR(worker->start());
-    if (!coordinator_) {
-      // Direct feed: no coordination service in the loop.
-      keystone_->register_worker(worker->info());
-      for (const auto& pool : worker->pools()) keystone_->register_memory_pool(pool);
-    }
-    workers_.push_back(std::move(worker));
+  for (size_t i = 0; i < options_.workers.size(); ++i) {
+    auto worker = start_worker_instance(i);
+    if (!worker.ok()) return worker.error();
+    workers_.push_back(std::move(worker).value());
   }
   running_ = true;
   return ErrorCode::OK;
@@ -90,6 +100,14 @@ void EmbeddedCluster::kill_worker(size_t i) {
   // surviving workers' regions go anywhere).
   workers_[i].reset();
   if (!coordinator_) keystone_->remove_worker(id);
+}
+
+ErrorCode EmbeddedCluster::revive_worker(size_t i) {
+  if (i >= workers_.size() || workers_[i]) return ErrorCode::INVALID_STATE;
+  auto worker = start_worker_instance(i);
+  if (!worker.ok()) return worker.error();
+  workers_[i] = std::move(worker).value();
+  return ErrorCode::OK;
 }
 
 }  // namespace btpu::client

@@ -101,6 +101,20 @@ class LocalTransportServer : public TransportServer {
 
 }  // namespace
 
+// TSan exemption, scoped to local_access's stack: LOCAL transport emulates
+// ONE-SIDED RMA (the reference's ucp_get_nbx/ucp_put_nbx into worker
+// memory) with a same-address-space memcpy. One-sided reads racing remote
+// writes are the modeled hardware behavior — a reader that raced a
+// concurrent reallocation gets garbage bytes, which every consumer
+// discards through an epoch re-check or a CRC gate before acting (repair
+// re-checks the object epoch before publishing; scrub heals only behind a
+// final stamp match; client verify fails over). The suppression is
+// declared in each sanitized EXECUTABLE (native/exe/tsan_rma_suppression.h
+// — TSan reads the default-suppressions hook during .preinit, before this
+// shared library's symbols are guaranteed registered), while TSan keeps
+// full power over the actual shared-state code (registries, object map,
+// allocator), where a report IS a bug.
+
 // Bounds+rkey-checked access used by the mux client (local kind).
 ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
                        bool is_write, uint32_t* crc_out) {

@@ -1,3 +1,6 @@
 #include "btest.h"
 
+// TSan one-sided-RMA suppression, shared with the sanitized executables.
+#include "../exe/tsan_rma_suppression.h"
+
 int main(int argc, char** argv) { return btest::run_all(argc, argv); }

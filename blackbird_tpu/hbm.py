@@ -80,11 +80,12 @@ _FABRIC_ADDR_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_void_
 _FABRIC_OFFER_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, _u64, _u64, _u64, _u64)
 _FABRIC_PULL_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, _u64,
                                    _u64, _u64, _u64)
+_HOST_VIEW_FN = ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p, _u64)
 
 
 class _ProviderStruct(ctypes.Structure):
-    # Must match BtpuHbmProviderV4 (hbm_provider.h) field for field: the V3
-    # table followed by the device-fabric entries.
+    # Must match BtpuHbmProviderV5 (hbm_provider.h) field for field: the V3
+    # table, the device-fabric entries, then the host-view entry.
     _fields_ = [
         ("ctx", ctypes.c_void_p),
         ("alloc_region", _ALLOC_FN),
@@ -99,6 +100,7 @@ class _ProviderStruct(ctypes.Structure):
         ("fabric_address", _FABRIC_ADDR_FN),
         ("fabric_offer", _FABRIC_OFFER_FN),
         ("fabric_pull", _FABRIC_PULL_FN),
+        ("host_view_base", _HOST_VIEW_FN),
     ]
 
 
@@ -962,6 +964,21 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
+    def _host_view_base(self, _ctx, region_id):
+        """v5: the region's stable CPU-addressable base, or None. Only
+        host-view regions qualify — their buffer is never donated (all I/O
+        is memcpy through the probed view), so the pointer stays valid for
+        the region's whole life. Handing it to the native side removes the
+        per-op ctypes dispatch from the staged data path entirely."""
+        try:
+            with self._lock:
+                region = self._regions.get(region_id)
+            if region is None or region["view"] is None:
+                return None
+            return region["view"].ctypes.data
+        except Exception:  # noqa: BLE001
+            return None
+
     def _flush(self, _ctx):
         try:
             self.synchronize()
@@ -990,10 +1007,13 @@ class JaxHbmProvider:
             fabric_address=_FABRIC_ADDR_FN(self._fabric_address),
             fabric_offer=_FABRIC_OFFER_FN(self._fabric_offer),
             fabric_pull=_FABRIC_PULL_FN(self._fabric_pull),
+            host_view_base=_HOST_VIEW_FN(self._host_view_base),
         )
         ptr = ctypes.cast(ctypes.pointer(self._struct), ctypes.c_void_p)
-        if hasattr(lib, "btpu_register_hbm_provider_v4"):
-            lib.btpu_register_hbm_provider_v4(ptr)
+        if hasattr(lib, "btpu_register_hbm_provider_v5"):
+            lib.btpu_register_hbm_provider_v5(ptr)
+        elif hasattr(lib, "btpu_register_hbm_provider_v4"):
+            lib.btpu_register_hbm_provider_v4(ptr)  # v4 prefix matches
         else:  # older library: the v3 prefix of the struct matches exactly
             lib.btpu_register_hbm_provider_v3(ptr)
         return self
@@ -1001,7 +1021,9 @@ class JaxHbmProvider:
     @staticmethod
     def unregister() -> None:
         """Restores the built-in host-memory emulation."""
-        if hasattr(lib, "btpu_register_hbm_provider_v4"):
+        if hasattr(lib, "btpu_register_hbm_provider_v5"):
+            lib.btpu_register_hbm_provider_v5(None)
+        elif hasattr(lib, "btpu_register_hbm_provider_v4"):
             lib.btpu_register_hbm_provider_v4(None)
         else:
             lib.btpu_register_hbm_provider_v3(None)

@@ -135,6 +135,14 @@ def _load() -> ctypes.CDLL:
         fn = getattr(handle, name)
         fn.restype = restype
         fn.argtypes = argtypes
+    # Newer provider-registration entry points are OPTIONAL: hbm.py probes
+    # with hasattr() and falls back down the version chain, so a prebuilt
+    # older library must not fail the whole import here.
+    for name in ("btpu_register_hbm_provider_v4", "btpu_register_hbm_provider_v5"):
+        if hasattr(handle, name):
+            fn = getattr(handle, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_void_p]
     return handle
 
 

@@ -87,13 +87,27 @@ typedef struct BtpuHbmProviderV4 {
                      uint64_t region_id, uint64_t offset, uint64_t len);
 } BtpuHbmProviderV4;
 
+// v5 appends the HOST-VIEW escape hatch: when a region's device memory is
+// CPU-addressable (the provider's host_view mode — CPU devices today,
+// host-mapped HBM if a runtime ever exposes it), the provider hands the
+// native side the region's stable base pointer ONCE and every subsequent
+// read_at/write_at becomes a native memcpy with zero provider dispatch —
+// the per-op ctypes/Python tax (the dominant cost of the cross-process
+// staged device lane on dev boxes) disappears from the data path. Returns
+// NULL for device-resident regions; may be null entirely.
+typedef struct BtpuHbmProviderV5 {
+  BtpuHbmProviderV4 base;
+  void* (*host_view_base)(void* ctx, uint64_t region_id);
+} BtpuHbmProviderV5;
+
 // Installs the process-wide provider (Python calls this through ctypes).
 // Passing NULL restores the built-in emulated provider. The version suffix
 // makes a stale library/binding pair fail loudly at symbol lookup instead
-// of reading past the end of a smaller struct. v3 registration keeps
-// working (fabric entries default to null).
+// of reading past the end of a smaller struct. v3/v4 registration keeps
+// working (newer entries default to null).
 void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider);
 void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider);
+void btpu_register_hbm_provider_v5(const BtpuHbmProviderV5* provider);
 
 }  // extern "C"
 
@@ -111,6 +125,12 @@ ErrorCode hbm_flush();
 // entry when present, else stages through a bounded host buffer.
 ErrorCode hbm_copy(uint64_t src_region, uint64_t src_offset, uint64_t dst_region,
                    uint64_t dst_offset, uint64_t len);
+// Host-view base pointer of a region (v5; nullptr when device-resident or
+// the provider predates v5).
+void* hbm_host_view_base(uint64_t region_id);
+// Monotonic registration generation: bumped by every (un)register call.
+// Consumers caching provider-derived pointers revalidate against it.
+uint64_t hbm_provider_generation();
 // Cross-process device fabric (v4; empty string / NOT_IMPLEMENTED without).
 std::string hbm_fabric_address();
 ErrorCode hbm_fabric_offer(uint64_t region_id, uint64_t offset, uint64_t len,

@@ -2,6 +2,7 @@
 // Replaces the reference's broken RAM_GPU tier (worker_service.cpp:196) with
 // the BASELINE.json north-star arrangement: a TPU-HBM allocator exposing the
 // same region/offset contract as every other tier.
+#include <atomic>
 #include <cstdlib>
 #include <vector>
 #include <cstring>
@@ -117,6 +118,12 @@ struct FabricEntries {
   int (*pull)(void*, const char*, uint64_t, uint64_t, uint64_t, uint64_t){nullptr};
 };
 FabricEntries g_fabric;
+// v5 host-view entry; null for older registrations and the emulation.
+void* (*g_host_view_base)(void*, uint64_t) = nullptr;
+// Bumped on every (un)registration: backends cache the host-view pointer
+// and revalidate it with one relaxed load per op, so a provider swap can
+// never leave them copying through a pointer into freed Python memory.
+std::atomic<uint64_t> g_provider_gen{1};
 
 }  // namespace
 
@@ -192,9 +199,14 @@ class HbmBackend : public OffsetBackendBase {
       return ErrorCode::OUT_OF_MEMORY;
     }
     active_ = true;
+    view_gen_.store(hbm_provider_generation());
+    host_view_.store(static_cast<uint8_t*>(hbm_host_view_base(region_id_)));
     LOG_INFO << "hbm region " << region_id_ << " on " << config_.device_id << " ("
              << config_.capacity << " bytes, "
-             << (hbm_provider_is_emulated() ? "emulated" : "device") << ")";
+             << (hbm_provider_is_emulated()
+                     ? "emulated"
+                     : (host_view_.load() ? "device, host-view" : "device"))
+             << ")";
     return init_allocator();
   }
 
@@ -210,8 +222,31 @@ class HbmBackend : public OffsetBackendBase {
   uint64_t device_region_id() const override { return region_id_; }
   const std::string& device_id() const override { return config_.device_id; }
 
+  // Host-view fast path (provider v5): CPU-addressable device memory moves
+  // by native memcpy — no provider dispatch in the data path, so the
+  // per-op ctypes/Python tax on the cross-process staged device lane
+  // vanishes. On real TPUs the view is null and every byte goes through
+  // the provider as before. The cached pointer revalidates against the
+  // registration generation with one relaxed load: a provider swap mid-
+  // flight must never leave us copying through freed Python memory.
+  uint8_t* host_view() const {
+    const uint64_t gen = hbm_provider_generation();
+    if (gen != view_gen_.load(std::memory_order_acquire)) {
+      host_view_.store(static_cast<uint8_t*>(hbm_host_view_base(region_id_)),
+                       std::memory_order_release);
+      view_gen_.store(gen, std::memory_order_release);
+    }
+    return host_view_.load(std::memory_order_acquire);
+  }
+
   ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
     if (!active_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    if (uint8_t* view = host_view()) {
+      std::memcpy(view + offset, src, len);
+      return ErrorCode::OK;
+    }
     const auto& provider = hbm_provider();
     return provider.write(provider.ctx, region_id_, offset, src, len) == 0
                ? ErrorCode::OK
@@ -220,6 +255,12 @@ class HbmBackend : public OffsetBackendBase {
 
   ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
     if (!active_) return ErrorCode::INVALID_STATE;
+    if (len > config_.capacity || offset > config_.capacity - len)
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    if (uint8_t* view = host_view()) {
+      std::memcpy(dst, view + offset, len);
+      return ErrorCode::OK;
+    }
     const auto& provider = hbm_provider();
     return provider.read(provider.ctx, region_id_, offset, dst, len) == 0
                ? ErrorCode::OK
@@ -240,10 +281,27 @@ class HbmBackend : public OffsetBackendBase {
  private:
   uint64_t region_id_{0};
   bool active_{false};
+  // Cached CPU-addressable view of the region (provider v5), or null;
+  // revalidated against the registration generation (see host_view()).
+  mutable std::atomic<uint8_t*> host_view_{nullptr};
+  mutable std::atomic<uint64_t> view_gen_{0};
 };
 
 std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config) {
   return std::make_unique<HbmBackend>(config);
+}
+
+uint64_t hbm_provider_generation() { return g_provider_gen.load(std::memory_order_acquire); }
+
+void* hbm_host_view_base(uint64_t region_id) {
+  void* (*fn)(void*, uint64_t);
+  void* ctx;
+  {
+    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    fn = g_host_view_base;
+    ctx = g_provider.ctx;
+  }
+  return fn ? fn(ctx, region_id) : nullptr;
 }
 
 std::string hbm_fabric_address() {
@@ -295,7 +353,9 @@ ErrorCode hbm_fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
 
 extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider) {
   std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_fabric = {};  // v3 has no fabric entries
+  btpu::storage::g_host_view_base = nullptr;
   if (provider) {
     btpu::storage::g_provider = *provider;
     btpu::storage::g_provider_emulated = false;
@@ -307,6 +367,8 @@ extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider)
 
 extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider) {
   std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
+  btpu::storage::g_host_view_base = nullptr;
   if (provider) {
     btpu::storage::g_provider = provider->base;
     btpu::storage::g_fabric = {provider->fabric_address, provider->fabric_offer,
@@ -317,4 +379,11 @@ extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider)
     btpu::storage::g_fabric = {};
     btpu::storage::g_provider_emulated = true;
   }
+}
+
+extern "C" void btpu_register_hbm_provider_v5(const BtpuHbmProviderV5* provider) {
+  btpu_register_hbm_provider_v4(provider ? &provider->base : nullptr);
+  std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
+  btpu::storage::g_host_view_base = provider ? provider->host_view_base : nullptr;
 }

@@ -86,8 +86,15 @@ def test_host_view_mode_engages_on_cpu(monkeypatch):
     try:
         with EmbeddedCluster(workers=1, pool_bytes=2 << 20,
                              storage_class=StorageClass.HBM_TPU) as cluster:
-            regions = list(provider._regions.values())
-            assert regions and all(r["view"] is not None for r in regions)
+            regions = list(provider._regions.items())
+            assert regions and all(r["view"] is not None for _, r in regions)
+            # Provider v5: the native backend gets the region's stable host
+            # pointer, taking the per-op ctypes dispatch out of the staged
+            # data path entirely (the cross-process device lane's dominant
+            # cost on dev boxes). The callback must agree with the view.
+            for region_id, r in regions:
+                base = provider._host_view_base(None, region_id)
+                assert base == r["view"].ctypes.data
             client = cluster.client()
             payload = np.random.default_rng(5).bytes(1 << 20)
             client.put("hv/obj", payload)

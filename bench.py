@@ -166,7 +166,14 @@ def bench_cross_process(shm_get_gbps: float | None, hbm: bool) -> None:
         kwargs = (dict(devices_per_worker=1, pool_mb=192) if hbm
                   else dict(devices_per_worker=0, dram_pool_mb=192))
         label = "hbm (device tier)" if hbm else "dram (host tier)"
-        iters = 16 if hbm else 100  # device tier: a tunneled dev link is slow
+        # This row's device workers always run on VIRTUAL CPU devices
+        # (ProcessCluster defaults virtual_devices=True and forces
+        # JAX_PLATFORMS=cpu in the worker env) — it measures the
+        # cross-process lane, not the chip link, so a slow tunneled TPU can
+        # never be behind it (the real chip is the separate --hbm-only
+        # leg). With the v5 host-view path the lane is memcpy-speed, so 48
+        # iterations amortize warmup like the host row's 100.
+        iters = 48 if hbm else 100
         with ProcessCluster(workers=1, **kwargs) as pc:
             pc.wait_ready(timeout=300)
             # The C++ client (bb-bench --keystone) measures the DATA PLANE:
@@ -284,11 +291,15 @@ def main() -> int:
         print(f"no-verify row skipped: {exc}", file=sys.stderr)
         raw_rows, raw_get_gbps = None, None
     # p99 needs samples: at 300 iters it is the 3rd-worst draw and scheduler
-    # noise dominates; 1500 iters costs ~0.1s and stabilizes it.
+    # noise dominates; 1500 iters costs ~0.1s and stabilizes it. Best-of is
+    # per OP: selecting the whole run by get p99 made the put number a
+    # random draw from the interference distribution.
     small_runs = [run_bench(binary, size=64 << 10, iterations=1500, transport="tcp",
                             extra_args=("--repeat-rows",))
                   for _ in range(3)]
     small_rows = min(small_runs, key=lambda rows: rows["get"]["p99_us"])
+    small_rows = dict(small_rows)
+    small_rows["put"] = min((r["put"] for r in small_runs), key=lambda x: x["p99_us"])
     shm_rows = run_bench(binary, size=1 << 20, iterations=150, transport="shm")
     local_rows = run_bench(binary, size=1 << 20, iterations=150, transport="local")
     # Replicated read: split across both copies in parallel (vs one link).

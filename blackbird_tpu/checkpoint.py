@@ -49,15 +49,16 @@ def _box_name(boxes: list[list[int]]) -> str:
     return "x".join(f"{a}-{b}" for a, b in boxes) if boxes else "scalar"
 
 
-def _put_fresh(client, key: str, data, **kwargs) -> None:
-    """put that overwrites: on OBJECT_ALREADY_EXISTS, remove + retry once.
+def _overwrite(client, key: str, do_put) -> None:
+    """Runs `do_put` with overwrite semantics: on OBJECT_ALREADY_EXISTS,
+    remove + retry once.
 
     The store's put_start rejects existing keys (keystone.cpp put lifecycle);
     a checkpoint save must win over whatever a crashed/partial previous save
     left behind, including shards no longer listed in any readable meta.
     """
     try:
-        client.put(key, data, **kwargs)
+        do_put()
         return
     except Exception as exc:  # noqa: BLE001 - duck-typed client
         from blackbird_tpu.native import ErrorCode
@@ -68,12 +69,46 @@ def _put_fresh(client, key: str, data, **kwargs) -> None:
         client.remove(key)
     except Exception:  # noqa: BLE001 - lost race / already gone
         pass
-    client.put(key, data, **kwargs)
+    do_put()
+
+
+def _put_fresh(client, key: str, data, **kwargs) -> None:
+    _overwrite(client, key, lambda: client.put(key, data, **kwargs))
+
+
+def _is_device_class(preferred_class) -> bool:
+    name = (preferred_class.name.lower() if hasattr(preferred_class, "name")
+            else str(preferred_class or "")).lower()
+    return name == "hbm_tpu"
+
+
+def _fabric_put_fresh(client, fabric, key: str, shard_data, kwargs) -> bool:
+    """Fabric leg of the checkpoint writer: True when the shard landed over
+    the fabric (with the same overwrite semantics as _put_fresh), False =
+    use the staged byte path."""
+    from blackbird_tpu.fabric import FabricUnavailable
+
+    pc = kwargs.get("preferred_class")
+    name = pc.name.lower() if hasattr(pc, "name") else (pc or "hbm_tpu")
+    fabric_kwargs = {"replicas": kwargs.get("replicas", 1), "preferred_class": name}
+    try:
+        _overwrite(client, key, lambda: fabric.put(key, shard_data, **fabric_kwargs))
+        return True
+    except FabricUnavailable:
+        return False
 
 
 def save_sharded(client, prefix: str, array, *, replicas: int = 1,
-                 preferred_class=None, ec: tuple[int, int] | None = None) -> None:
+                 preferred_class=None, ec: tuple[int, int] | None = None,
+                 fabric=None) -> None:
     """Saves `array` (sharded or single-device) under `prefix`.
+
+    With `fabric` (a `blackbird_tpu.FabricClient`), device-resident shard
+    bytes move over the transfer fabric — this process offers each shard
+    from its own runtime and the worker pulls it straight into device
+    memory, no host staging (the production TPU checkpoint shape). Shards
+    the fabric cannot take (no fabric endpoints, EC requested) fall back
+    to the staged byte path transparently.
 
     Writes one object per *distinct* shard box (replicated shards are
     deduplicated) and a `<prefix>/meta` JSON object describing them. The
@@ -140,6 +175,12 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
                 client.remove(key)
             except Exception:  # noqa: BLE001 - listed but never written/evicted
                 pass
+        # Fabric attempt only for device-tier targets: a host-tier
+        # placement can never carry fabric endpoints, and probing it would
+        # cost a reserve+cancel keystone round trip per shard.
+        if fabric is not None and ec is None and _is_device_class(preferred_class):
+            if _fabric_put_fresh(client, fabric, key, shard.data, kwargs):
+                continue
         host = np.ascontiguousarray(np.asarray(shard.data))
         _put_fresh(client, key, host.reshape(-1).view(np.uint8), **kwargs)
 
@@ -172,12 +213,17 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
             pass
 
 
-def load_sharded(client, prefix: str, *, sharding=None):
+def load_sharded(client, prefix: str, *, sharding=None, fabric=None):
     """Restores an array saved by `save_sharded`.
 
     With `sharding` (any `jax.sharding.Sharding`), returns a `jax.Array`
     laid out accordingly — the target does not need to match the sharding
     the array was saved with. Without it, returns a host `numpy` array.
+
+    With `fabric` (a `blackbird_tpu.FabricClient`), device-tier shards are
+    pulled over the transfer fabric by THIS process's runtime instead of
+    the worker's staged host lane; host-tier shards fall back to the
+    staged path transparently.
     """
     meta = json.loads(bytes(client.get(prefix + _META_SUFFIX)))
     global_shape = tuple(meta["global_shape"])
@@ -189,7 +235,10 @@ def load_sharded(client, prefix: str, *, sharding=None):
     def fetch(shard_meta) -> np.ndarray:
         key = shard_meta["key"]
         if key not in cache:
-            raw = np.frombuffer(bytes(client.get(key)), dtype=np.uint8)
+            if fabric is not None:
+                raw = np.frombuffer(fabric.get_bytes(key), dtype=np.uint8)
+            else:
+                raw = np.frombuffer(bytes(client.get(key)), dtype=np.uint8)
             cache[key] = raw.view(dtype).reshape(shard_meta["shape"])
         return cache[key]
 

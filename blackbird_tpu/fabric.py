@@ -90,8 +90,14 @@ class FabricClient:
         for copy in copies:
             if not self._eligible(copy):
                 continue
+            # pending: offers commanded but not yet pulled — drained on ANY
+            # failure so a mid-list error cannot strand shards pinned in
+            # worker device memory until the 60s stale-offer GC.
+            pending = []
             try:
-                parts = []
+                # Phase 1: command every worker to offer its shard (the
+                # workers stage concurrently); phase 2: pull them in order.
+                # On a mesh this overlaps per-worker staging with the pulls.
                 for shard in copy["shards"]:
                     loc = shard["location"]
                     tid = secrets.randbits(63)
@@ -101,12 +107,22 @@ class FabricClient:
                             shard["endpoint"].encode(), loc["remote_addr"],
                             loc.get("rkey", 0), shard["length"], tid),
                         f"fabric offer {key!r}")
-                    parts.append(self._link.pull(shard["fabric"], tid, shard["length"]))
+                    pending.append((shard["fabric"], tid, shard["length"]))
+                parts = []
+                while pending:
+                    addr, tid, length = pending[0]
+                    parts.append(self._link.pull(addr, tid, length))
+                    pending.pop(0)
                 out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
                 self.fabric_gets += 1
                 return out
             except Exception as exc:  # noqa: BLE001 - try the next copy
                 last = exc
+                for addr, tid, length in pending:  # discard stranded offers
+                    try:
+                        self._link.pull(addr, tid, length)
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
         raise FabricUnavailable(
             f"no fabric-reachable copy of {key!r}"
             + (f" (last error: {last})" if last else ""))

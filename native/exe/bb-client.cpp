@@ -220,10 +220,12 @@ int main(int argc, char** argv) {
     auto stats = client.cluster_stats();
     if (!stats.ok()) return fail(stats.error());
     const auto& s = stats.value();
-    std::printf("workers=%llu pools=%llu objects=%llu used=%llu/%llu (%.1f%%)\n",
+    std::printf("workers=%llu pools=%llu objects=%llu used=%llu/%llu (%.1f%%)"
+                " inline=%llu\n",
                 (unsigned long long)s.total_workers, (unsigned long long)s.total_memory_pools,
                 (unsigned long long)s.total_objects, (unsigned long long)s.used_capacity,
-                (unsigned long long)s.total_capacity, 100.0 * s.avg_utilization);
+                (unsigned long long)s.total_capacity, 100.0 * s.avg_utilization,
+                (unsigned long long)s.inline_bytes);
   } else if (command == "ping") {
     auto view = client.ping();
     if (!view.ok()) return fail(view.error());

@@ -304,6 +304,11 @@ struct CopyShardCrcs {
 struct PutCompleteRequest {
   ObjectKey key;
   std::vector<CopyShardCrcs> shard_crcs;  // may be empty (older clients)
+  // Whole-object CRC32C, carried here (not put_start) so clients can fuse
+  // the hash into the transfer itself and fold shard stamps into it —
+  // nothing reads it while the object is still kPending. 0 = keep whatever
+  // put_start stamped (older clients hash up front and send it there).
+  uint32_t content_crc{0};
 };
 struct PutCompleteResponse { ErrorCode error_code{ErrorCode::OK}; };
 
@@ -368,6 +373,9 @@ struct BatchPutCompleteRequest {
   std::vector<ObjectKey> keys;
   // Parallel to `keys`; empty, or one (possibly empty) entry per key.
   std::vector<std::vector<CopyShardCrcs>> shard_crcs;
+  // Parallel to `keys`; empty, or one entry per key (0 = keep put_start's
+  // stamp). See PutCompleteRequest::content_crc.
+  std::vector<uint32_t> content_crcs;
 };
 struct BatchPutCompleteResponse { std::vector<ErrorCode> results; ErrorCode error_code{ErrorCode::OK}; };
 

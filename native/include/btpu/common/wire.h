@@ -392,7 +392,7 @@ BTPU_WIRE_STRUCT(GetWorkersRequest, f0)
 BTPU_WIRE_STRUCT(GetWorkersResponse, f0, f1)
 BTPU_WIRE_STRUCT(PutStartRequest, f0, f1, f2, f3)
 BTPU_WIRE_STRUCT(PutStartResponse, f0, f1)
-BTPU_WIRE_STRUCT(PutCompleteRequest, f0, f1)
+BTPU_WIRE_STRUCT(PutCompleteRequest, f0, f1, f2)
 BTPU_WIRE_STRUCT(PutCompleteResponse, f0)
 BTPU_WIRE_STRUCT(PutCancelRequest, f0)
 BTPU_WIRE_STRUCT(PutCancelResponse, f0)
@@ -414,7 +414,7 @@ BTPU_WIRE_STRUCT(BatchGetWorkersRequest, f0)
 BTPU_WIRE_STRUCT(BatchGetWorkersResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutStartRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutStartResponse, f0, f1)
-BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0, f1)
+BTPU_WIRE_STRUCT(BatchPutCompleteRequest, f0, f1, f2)
 BTPU_WIRE_STRUCT(BatchPutCompleteResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchPutCancelRequest, f0)
 BTPU_WIRE_STRUCT(BatchPutCancelResponse, f0, f1)

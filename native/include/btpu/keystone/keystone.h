@@ -130,8 +130,11 @@ class KeystoneService {
                                                uint32_t content_crc = 0);
   // shard_crcs: per-copy per-shard CRC32C stamps the writing client computed
   // against the placement put_start returned (empty = not stamped); entries
-  // that don't match a copy's index/shard count are ignored.
-  ErrorCode put_complete(const ObjectKey& key, const std::vector<CopyShardCrcs>& shard_crcs = {});
+  // that don't match a copy's index/shard count are ignored. content_crc:
+  // whole-object stamp computed under the transfer (0 = keep put_start's) —
+  // carried here so clients can hash while the bytes move.
+  ErrorCode put_complete(const ObjectKey& key, const std::vector<CopyShardCrcs>& shard_crcs = {},
+                         uint32_t content_crc = 0);
   ErrorCode put_cancel(const ObjectKey& key);
   // Pooled small-put slots (see PutSlot in types.h): grants up to `count`
   // anonymous PENDING allocations of one (size, config) class; commit
@@ -161,7 +164,8 @@ class KeystoneService {
       const std::vector<BatchPutStartItem>& items);
   std::vector<ErrorCode> batch_put_complete(
       const std::vector<ObjectKey>& keys,
-      const std::vector<std::vector<CopyShardCrcs>>& shard_crcs = {});
+      const std::vector<std::vector<CopyShardCrcs>>& shard_crcs = {},
+      const std::vector<uint32_t>& content_crcs = {});
   std::vector<ErrorCode> batch_put_cancel(const std::vector<ObjectKey>& keys);
 
   // Prefix listing ("" = everything), lexicographically ordered, COMPLETE

@@ -23,8 +23,15 @@
 namespace btpu::rpc {
 
 // Wire-protocol version advertised in the kPing handshake. Bump when the
-// append-only rule is insufficient to describe a change (should be never).
-inline constexpr uint32_t kProtocolVersion = 2;
+// append-only rule is insufficient to describe a change (should be rare).
+inline constexpr uint32_t kProtocolVersion = 3;
+
+// First version whose put_complete APPLIES the appended content_crc field.
+// A newer client talking to an older keystone must keep stamping the
+// whole-object CRC at put_start (the old path) — deferring it would decode
+// cleanly but silently leave every object unstamped, disabling the
+// verified-read gate for bytes written during a rolling upgrade.
+inline constexpr uint32_t kProtoContentCrcAtComplete = 3;
 
 enum class Method : uint8_t {
   kObjectExists = 65,

@@ -25,7 +25,8 @@ class KeystoneRpcClient {
                                                const WorkerConfig& config,
                                                uint32_t content_crc = 0);
   ErrorCode put_complete(const ObjectKey& key,
-                         const std::vector<CopyShardCrcs>& shard_crcs = {});
+                         const std::vector<CopyShardCrcs>& shard_crcs = {},
+                         uint32_t content_crc = 0);
   ErrorCode put_cancel(const ObjectKey& key);
   // Pooled small-put slots (1-RTT commit path; see PutSlot in types.h).
   Result<std::vector<PutSlot>> put_start_pooled(uint64_t size, const WorkerConfig& config,
@@ -58,7 +59,8 @@ class KeystoneRpcClient {
       const std::vector<BatchPutStartItem>& items);
   Result<std::vector<ErrorCode>> batch_put_complete(
       const std::vector<ObjectKey>& keys,
-      const std::vector<std::vector<CopyShardCrcs>>& shard_crcs = {});
+      const std::vector<std::vector<CopyShardCrcs>>& shard_crcs = {},
+      const std::vector<uint32_t>& content_crcs = {});
   Result<std::vector<ErrorCode>> batch_put_cancel(const std::vector<ObjectKey>& keys);
 
  private:

@@ -101,11 +101,11 @@ struct WireOp {
   uint8_t* buf{nullptr};
   uint64_t len{0};
   ErrorCode status{ErrorCode::OK};  // per-op result, set by the batch call
-  // READS with want_crc get `crc` = crc32c of the op's bytes, computed by
+  // Ops with want_crc get `crc` = crc32c of the op's bytes, computed by
   // the transport WHILE they move (per-segment during socket drains, fused
-  // with the staging-segment copy) instead of by a second client pass —
-  // the verified-read integrity check then costs ~no extra sweep. Ignored
-  // for writes.
+  // with the staging-segment copy in both directions) instead of by a
+  // second client pass — verified reads check and puts stamp their shard
+  // CRCs with ~no extra sweep of the bytes.
   bool want_crc{false};
   uint32_t crc{0};
 };

@@ -10,6 +10,7 @@
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/ec/rs.h"
+#include "btpu/rpc/rpc.h"
 #include "btpu/storage/hbm_provider.h"
 
 namespace btpu::client {
@@ -379,6 +380,12 @@ ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
       return ErrorCode::NOT_IMPLEMENTED;
   }
   const uint32_t expect = copies.front().content_crc;
+  // Content-unstamped but shard-stamped (pre-v3 completion): bow out so the
+  // per-copy path runs its shard-stamp fallback — a split read here would
+  // silently skip verification.
+  if (verify && expect == 0 &&
+      copies.front().shard_crcs.size() == copies.front().shards.size())
+    return ErrorCode::NOT_IMPLEMENTED;
   const bool check = verify && expect != 0;
   // Transport-computed CRCs: ops cover [0, size) contiguously in array
   // order (slices ascending, ranges within a slice ascending), so their
@@ -671,7 +678,14 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
       if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
     }
   }
-  const bool check = verify && !is_write && copy.content_crc != 0;
+  // Whole-object stamp preferred; per-shard stamps arm verification when
+  // the content stamp is missing (e.g. an object completed through a
+  // pre-v3 keystone during a rolling upgrade drops the appended
+  // content_crc field but still applies shard_crcs — integrity must not
+  // silently lapse for those).
+  const bool have_shard_stamps =
+      copy.shard_crcs.size() == copy.shards.size() && !copy.shards.empty();
+  const bool check = verify && !is_write && (copy.content_crc != 0 || have_shard_stamps);
   std::vector<transport::WireOp> ops;
   if (!wire_idx.empty()) {
     // Wire shards move as one pipelined batch: every request issued before
@@ -703,18 +717,27 @@ ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, 
   if (check) {
     std::vector<uint32_t> shard_crc(copy.shards.size(), 0);
     for (size_t j = 0; j < wire_idx.size(); ++j) shard_crc[wire_idx[j]] = ops[j].crc;
-    uint32_t combined = 0;
     for (size_t i = 0; i < copy.shards.size(); ++i) {
       if (std::holds_alternative<DeviceLocation>(copy.shards[i].location))
         shard_crc[i] = crc32c(data + offsets[i], copy.shards[i].length);
-      combined = i == 0 ? shard_crc[i]
-                        : crc32c_combine(combined, shard_crc[i], copy.shards[i].length);
     }
-    if (combined != copy.content_crc) {
+    bool ok;
+    if (copy.content_crc != 0) {
+      uint32_t combined = 0;
+      for (size_t i = 0; i < copy.shards.size(); ++i)
+        combined = i == 0 ? shard_crc[i]
+                          : crc32c_combine(combined, shard_crc[i], copy.shards[i].length);
+      ok = combined == copy.content_crc;
+    } else {
+      // Shard-stamp fallback: every shard must match its own stamp.
+      ok = true;
+      for (size_t i = 0; i < copy.shards.size(); ++i) ok &= shard_crc[i] == copy.shard_crcs[i];
+    }
+    if (!ok) {
       LOG_WARN << "content crc mismatch on copy " << copy.copy_index
                << " (bit rot or torn write): treating as copy loss";
       // Stamped shard CRCs localize the rot for the operator/scrubber.
-      if (copy.shard_crcs.size() == copy.shards.size()) {
+      if (have_shard_stamps) {
         for (size_t i = 0; i < copy.shards.size(); ++i) {
           if (shard_crc[i] != copy.shard_crcs[i]) {
             const auto& s = copy.shards[i];
@@ -939,31 +962,29 @@ void append_ec_get_jobs(const CopyPlacement& copy, uint8_t* buffer, uint64_t siz
   }
 }
 
+// Range (offset, length) -> CRC32C map. Prefilled by the transport's fused
+// write hashes; stamp_copy_crcs fills the gaps (device shards, failed ops).
+using RangeCrcMap = std::map<std::pair<uint64_t, uint64_t>, uint32_t>;
+
 // Per-copy shard CRC stamps for replicated/striped copies: replica copies
 // cover the SAME bytes, so each distinct (offset, length) range is hashed
-// once and reused — and a whole-object shard reuses the already-computed
-// content CRC, which makes the single-shard small put ONE CRC pass total.
+// once and reused. Wire shards arrive pre-hashed in `range_crc` (the
+// transport fused the CRC into its copy/send of the bytes), so the typical
+// put stamps every shard with ZERO standalone passes; only device shards
+// and retried ranges fall back to hashing here.
 std::vector<CopyShardCrcs> stamp_copy_crcs(const std::vector<CopyPlacement>& copies,
-                                           const uint8_t* data, uint64_t size,
-                                           uint32_t content_crc) {
+                                           const uint8_t* data, RangeCrcMap& range_crc) {
   std::vector<CopyShardCrcs> out;
   out.reserve(copies.size());
-  std::map<std::pair<uint64_t, uint64_t>, uint32_t> range_crc;
   for (const auto& copy : copies) {
     CopyShardCrcs crcs;
     crcs.copy_index = copy.copy_index;
     crcs.crcs.reserve(copy.shards.size());
     uint64_t off = 0;
     for (const auto& shard : copy.shards) {
-      uint32_t crc;
-      if (off == 0 && shard.length == size) {
-        crc = content_crc;
-      } else {
-        auto [it, fresh] = range_crc.try_emplace({off, shard.length}, 0);
-        if (fresh) it->second = crc32c(data + off, shard.length);
-        crc = it->second;
-      }
-      crcs.crcs.push_back(crc);
+      auto [it, fresh] = range_crc.try_emplace({off, shard.length}, 0);
+      if (fresh) it->second = crc32c(data + off, shard.length);
+      crcs.crcs.push_back(it->second);
       off += shard.length;
     }
     out.push_back(std::move(crcs));
@@ -971,14 +992,47 @@ std::vector<CopyShardCrcs> stamp_copy_crcs(const std::vector<CopyPlacement>& cop
   return out;
 }
 
+// Whole-object CRC folded from one copy's shard stamps (shards tile the
+// object contiguously in order — append_copy_jobs enforces exact cover).
+// With fused wire hashes this makes the content stamp FREE: no pass over
+// the bytes anywhere in the put path.
+uint32_t fold_content_crc(const CopyShardCrcs& crcs, const CopyPlacement& copy) {
+  uint32_t crc = 0;
+  for (size_t i = 0; i < crcs.crcs.size(); ++i)
+    crc = i == 0 ? crcs.crcs[0] : crc32c_combine(crc, crcs.crcs[i], copy.shards[i].length);
+  return crc;
+}
+
+// Collects one item's fused write hashes out of run_wire_jobs' output into
+// the (object offset, length) -> crc form stamp_copy_crcs consumes. `item`
+// filters a batch down to one object; 0-crc entries (skipped/failed ops, or
+// the rare genuine zero) fall through to stamp_copy_crcs' own hashing.
+void harvest_wire_ranges(const BatchJobs& jobs, const std::vector<uint32_t>& wire_crcs,
+                         size_t item, const uint8_t* base, RangeCrcMap& ranges) {
+  for (size_t j = 0; j < jobs.wire.size() && j < wire_crcs.size(); ++j) {
+    if (jobs.wire_item[j] != item || wire_crcs[j] == 0) continue;
+    ranges[{static_cast<uint64_t>(jobs.wire[j].buf - base), jobs.wire[j].len}] =
+        wire_crcs[j];
+  }
+}
+
 // Runs the wire jobs as ONE pipelined batch; per-op failures land on their
 // item, jobs of items that already failed are skipped (their reservation is
-// cancelled by the caller anyway).
+// cancelled by the caller anyway). With `wire_crcs` (put path) ops ask the
+// transport for a fused hash of the bytes they moved; (*wire_crcs)[j] gets
+// job j's crc for ops that completed (entries stay 0 for skipped/failed
+// jobs — stamp_copy_crcs treats a missing range as "hash it here").
+// `crc_items` (parallel to the caller's items, may be null = all) limits
+// the request to items whose hashes will actually be harvested — EC items
+// stamp during encode, so hashing their padded/parity ranges is waste.
 void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
-                   size_t max_concurrency, std::vector<ErrorCode>& item_errors) {
+                   size_t max_concurrency, std::vector<ErrorCode>& item_errors,
+                   std::vector<uint32_t>* wire_crcs = nullptr,
+                   const std::vector<bool>* crc_items = nullptr) {
   if (jobs.wire.empty()) return;
+  if (wire_crcs) wire_crcs->assign(jobs.wire.size(), 0);
   std::vector<transport::WireOp> ops;
-  std::vector<size_t> op_item;
+  std::vector<size_t> op_item, op_job;
   ops.reserve(jobs.wire.size());
   for (size_t j = 0; j < jobs.wire.size(); ++j) {
     const size_t item = jobs.wire_item[j];
@@ -990,8 +1044,11 @@ void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bo
       item_errors[item] = ErrorCode::NOT_IMPLEMENTED;
       continue;
     }
+    op.want_crc =
+        wire_crcs != nullptr && (!crc_items || (item < crc_items->size() && (*crc_items)[item]));
     ops.push_back(op);
     op_item.push_back(item);
+    op_job.push_back(j);
   }
   if (is_write) {
     client.write_batch(ops.data(), ops.size(), max_concurrency);
@@ -1001,6 +1058,7 @@ void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bo
   for (size_t j = 0; j < ops.size(); ++j) {
     if (ops[j].status != ErrorCode::OK && item_errors[op_item[j]] == ErrorCode::OK)
       item_errors[op_item[j]] = ops[j].status;
+    if (wire_crcs && ops[j].status == ErrorCode::OK) (*wire_crcs)[op_job[j]] = ops[j].crc;
   }
 }
 
@@ -1050,13 +1108,29 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     // A put of a removed-then-recreated key must not let this client's own
     // cached placement serve the PREVIOUS object's bytes afterwards.
     invalidate_placements(item.key);
-    starts.push_back({item.key, item.size, config, crc32c(item.data, item.size)});
+    // content_crc rides in batch_put_complete instead (folded from the
+    // transport's fused shard hashes) — hashing the bytes here would cost a
+    // full standalone pass before the transfer even starts.
+    starts.push_back({item.key, item.size, config, 0});
   }
   std::vector<Result<std::vector<CopyPlacement>>> placed;
   if (embedded_) {
     placed = embedded_->batch_put_start(starts);
   } else {
     auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+      // Deferred content stamps require a keystone that applies them at
+      // put_complete. Against an older server, stamp at put_start like the
+      // pre-fusion path — otherwise every object written during a rolling
+      // upgrade would complete unstamped and verified reads would silently
+      // skip the CRC gate. One ping learns the version (and a v1 server
+      // that cannot answer it stays at 0 = conservative up-front hashing).
+      if (c.server_proto_version() == 0) c.ping();
+      if (c.server_proto_version() < rpc::kProtoContentCrcAtComplete) {
+        for (size_t i = 0; i < starts.size(); ++i) {
+          if (starts[i].content_crc == 0)
+            starts[i].content_crc = crc32c(items[i].data, items[i].size);
+        }
+      }
       return c.batch_put_start(starts);
     });
     if (!r.ok()) return std::vector<ErrorCode>(items.size(), r.error());
@@ -1066,6 +1140,7 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   BatchJobs jobs;
   std::vector<std::vector<uint8_t>> ec_arena;
   std::vector<std::vector<CopyShardCrcs>> item_crcs(items.size());
+  std::vector<bool> fuse_crc(items.size(), true);  // EC items stamp at encode
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok()) {
       results[i] = placed[i].error();
@@ -1074,6 +1149,7 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     auto* data = const_cast<uint8_t*>(static_cast<const uint8_t*>(items[i].data));
     if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
       // Erasure-coded item: encode now, ship with the shared wire batch.
+      fuse_crc[i] = false;
       CopyShardCrcs crcs;
       results[i] = append_ec_put_jobs(placed[i].value().front(), data, items[i].size, i,
                                       ec_arena, jobs, &crcs);
@@ -1091,21 +1167,36 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     }
   }
 
+  std::vector<uint32_t> wire_crcs;
   {
     TRACE_SPAN("client.put.transfer");
     run_device_jobs(*data_, jobs, /*is_write=*/true, results);
-    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results);
+    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results,
+                  &wire_crcs, &fuse_crc);
   }
-  // Replicated/striped shard CRC stamps: one pass over the source bytes,
-  // overlapped with any still-draining device DMA (the flush below is the
-  // only wait). EC items computed theirs during encode (parity shards have
-  // no plain-data source).
+  // Replicated/striped shard CRC stamps: harvested from the transport's
+  // FUSED write hashes (computed while the bytes moved), so the typical put
+  // sweeps the source bytes zero extra times; device shards and retried
+  // ranges are hashed in stamp_copy_crcs, overlapped with any still-
+  // draining device DMA (the flush below is the only wait). EC items
+  // computed theirs during encode (parity shards have no plain-data
+  // source; their wire bufs live in the arena, so they are excluded from
+  // the offset harvest).
+  std::vector<uint32_t> item_content_crcs(items.size(), 0);
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok() || results[i] != ErrorCode::OK) continue;
-    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) continue;
-    item_crcs[i] = stamp_copy_crcs(placed[i].value(),
-                                   static_cast<const uint8_t*>(items[i].data),
-                                   items[i].size, starts[i].content_crc);
+    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
+      // Coded object: shard stamps cover padded/parity wire bytes, so the
+      // whole-object stamp still needs its own pass here.
+      item_content_crcs[i] = crc32c(items[i].data, items[i].size);
+      continue;
+    }
+    const auto* base = static_cast<const uint8_t*>(items[i].data);
+    RangeCrcMap ranges;
+    harvest_wire_ranges(jobs, wire_crcs, i, base, ranges);
+    item_crcs[i] = stamp_copy_crcs(placed[i].value(), base, ranges);
+    if (!item_crcs[i].empty() && !placed[i].value().empty())
+      item_content_crcs[i] = fold_content_crc(item_crcs[i][0], placed[i].value()[0]);
   }
   // Device writes may be asynchronous; put_complete must not be sent until
   // the bytes are durably in the tier.
@@ -1119,12 +1210,14 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
 
   std::vector<ObjectKey> completes, cancels;
   std::vector<std::vector<CopyShardCrcs>> complete_crcs;
+  std::vector<uint32_t> complete_content_crcs;
   std::vector<size_t> complete_idx;
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placed[i].ok()) continue;  // never reserved
     if (results[i] == ErrorCode::OK) {
       completes.push_back(items[i].key);
       complete_crcs.push_back(std::move(item_crcs[i]));
+      complete_content_crcs.push_back(item_content_crcs[i]);
       complete_idx.push_back(i);
     } else {
       LOG_WARN << "put " << items[i].key << " transfer failed ("
@@ -1135,10 +1228,10 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
   if (!completes.empty()) {
     std::vector<ErrorCode> ecs;
     if (embedded_) {
-      ecs = embedded_->batch_put_complete(completes, complete_crcs);
+      ecs = embedded_->batch_put_complete(completes, complete_crcs, complete_content_crcs);
     } else {
       auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
-        return c.batch_put_complete(completes, complete_crcs);
+        return c.batch_put_complete(completes, complete_crcs, complete_content_crcs);
       });
       ecs = r.ok() ? std::move(r.value())
                    : std::vector<ErrorCode>(completes.size(), r.error());
@@ -1275,7 +1368,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
   // Transfer into the slot's placements — the same jobs machinery as
   // put_many, for one item.
   auto* bytes = const_cast<uint8_t*>(static_cast<const uint8_t*>(data));
-  const uint32_t content_crc = crc32c(bytes, size);
+  uint32_t content_crc = 0;
   BatchJobs jobs;
   std::vector<ErrorCode> item_errors(1, ErrorCode::OK);
   std::vector<CopyShardCrcs> crcs;
@@ -1288,13 +1381,22 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
   }
   if (item_errors[0] == ErrorCode::OK) {
     TRACE_SPAN("client.put.transfer");
+    std::vector<uint32_t> wire_crcs;
     run_device_jobs(*data_, jobs, /*is_write=*/true, item_errors);
-    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, item_errors);
-    // Shard stamps ride under the in-flight transfer (one CRC pass total
-    // for the single-shard small-put norm).
-    crcs = stamp_copy_crcs(slot.copies, bytes, size, content_crc);
-    if (!jobs.device.empty() && item_errors[0] == ErrorCode::OK)
-      item_errors[0] = storage::hbm_flush();
+    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, item_errors,
+                  &wire_crcs);
+    if (item_errors[0] == ErrorCode::OK) {
+      // Shard stamps come from the transport's fused write hashes; the
+      // content stamp folds out of them — zero standalone passes for the
+      // single-shard small-put norm. (Skipped entirely on transfer failure:
+      // the fallback branch below discards them.)
+      RangeCrcMap ranges;
+      harvest_wire_ranges(jobs, wire_crcs, 0, bytes, ranges);
+      crcs = stamp_copy_crcs(slot.copies, bytes, ranges);
+      if (!crcs.empty() && !slot.copies.empty())
+        content_crc = fold_content_crc(crcs[0], slot.copies[0]);
+      if (!jobs.device.empty()) item_errors[0] = storage::hbm_flush();
+    }
   }
   if (item_errors[0] != ErrorCode::OK) {
     // The slot's worker may be the problem (crashed after the grant): drop

@@ -529,7 +529,8 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
 }
 
 ErrorCode KeystoneService::put_complete(const ObjectKey& key,
-                                        const std::vector<CopyShardCrcs>& shard_crcs) {
+                                        const std::vector<CopyShardCrcs>& shard_crcs,
+                                        uint32_t content_crc) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::unique_lock lock(objects_mutex_);
   auto it = objects_.find(key);
@@ -542,6 +543,8 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
       }
     }
   }
+  if (content_crc != 0)
+    for (auto& copy : it->second.copies) copy.content_crc = content_crc;
   it->second.state = ObjectState::kComplete;
   it->second.last_access = std::chrono::steady_clock::now();
   if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
@@ -828,12 +831,14 @@ std::vector<Result<std::vector<CopyPlacement>>> KeystoneService::batch_put_start
 
 std::vector<ErrorCode> KeystoneService::batch_put_complete(
     const std::vector<ObjectKey>& keys,
-    const std::vector<std::vector<CopyShardCrcs>>& shard_crcs) {
+    const std::vector<std::vector<CopyShardCrcs>>& shard_crcs,
+    const std::vector<uint32_t>& content_crcs) {
   std::vector<ErrorCode> out;
   out.reserve(keys.size());
   for (size_t i = 0; i < keys.size(); ++i) {
     out.push_back(put_complete(
-        keys[i], i < shard_crcs.size() ? shard_crcs[i] : std::vector<CopyShardCrcs>{}));
+        keys[i], i < shard_crcs.size() ? shard_crcs[i] : std::vector<CopyShardCrcs>{},
+        i < content_crcs.size() ? content_crcs[i] : 0));
   }
   return out;
 }

@@ -103,10 +103,11 @@ Result<std::vector<CopyPlacement>> KeystoneRpcClient::put_start(const ObjectKey&
 }
 
 ErrorCode KeystoneRpcClient::put_complete(const ObjectKey& key,
-                                          const std::vector<CopyShardCrcs>& shard_crcs) {
+                                          const std::vector<CopyShardCrcs>& shard_crcs,
+                                          uint32_t content_crc) {
   PutCompleteResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kPutComplete),
-                            PutCompleteRequest{key, shard_crcs}, resp));
+                            PutCompleteRequest{key, shard_crcs, content_crc}, resp));
   return resp.error_code;
 }
 
@@ -232,10 +233,11 @@ Result<std::vector<Result<std::vector<CopyPlacement>>>> KeystoneRpcClient::batch
 
 Result<std::vector<ErrorCode>> KeystoneRpcClient::batch_put_complete(
     const std::vector<ObjectKey>& keys,
-    const std::vector<std::vector<CopyShardCrcs>>& shard_crcs) {
+    const std::vector<std::vector<CopyShardCrcs>>& shard_crcs,
+    const std::vector<uint32_t>& content_crcs) {
   BatchPutCompleteResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kBatchPutComplete),
-                            BatchPutCompleteRequest{keys, shard_crcs}, resp));
+                            BatchPutCompleteRequest{keys, shard_crcs, content_crcs}, resp));
   if (resp.error_code != ErrorCode::OK) return resp.error_code;
   return std::move(resp.results);
 }

@@ -112,7 +112,7 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
     case Method::kPutComplete:
       return handle<PutCompleteRequest, PutCompleteResponse>(
           payload, [&](const auto& req, auto& resp) {
-            resp.error_code = ks.put_complete(req.key, req.shard_crcs);
+            resp.error_code = ks.put_complete(req.key, req.shard_crcs, req.content_crc);
           });
     case Method::kPutCancel:
       return handle<PutCancelRequest, PutCancelResponse>(
@@ -159,7 +159,7 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
     case Method::kBatchPutComplete:
       return handle<BatchPutCompleteRequest, BatchPutCompleteResponse>(
           payload, [&](const auto& req, auto& resp) {
-            resp.results = ks.batch_put_complete(req.keys, req.shard_crcs);
+            resp.results = ks.batch_put_complete(req.keys, req.shard_crcs, req.content_crcs);
           });
     case Method::kBatchPutCancel:
       return handle<BatchPutCancelRequest, BatchPutCancelResponse>(

@@ -141,7 +141,11 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
   }
   if (target) {
     if (is_write) {
-      std::memcpy(target, buf, len);
+      if (crc_out) {
+        *crc_out = crc32c_copy(target, buf, len);  // fused: hash while moving
+      } else {
+        std::memcpy(target, buf, len);
+      }
     } else if (crc_out) {
       *crc_out = crc32c_copy(buf, target, len);  // fused: hash while moving
     } else {
@@ -150,8 +154,8 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
     return ErrorCode::OK;
   }
   const ErrorCode ec = is_write ? write_fn(offset, buf, len) : read_fn(offset, buf, len);
-  // Callback-backed regions fill `buf` opaquely; the hash is a second pass.
-  if (ec == ErrorCode::OK && !is_write && crc_out) *crc_out = crc32c(buf, len);
+  // Callback-backed regions consume/fill `buf` opaquely; hash is a second pass.
+  if (ec == ErrorCode::OK && crc_out) *crc_out = crc32c(buf, len);
   return ec;
 }
 

@@ -169,7 +169,11 @@ ErrorCode shm_access(const std::string& name, uint64_t offset, void* buf, uint64
   if (!base) return ErrorCode::CONNECTION_FAILED;
   if (len > seg_len || offset > seg_len - len) return ErrorCode::MEMORY_ACCESS_ERROR;
   if (is_write) {
-    std::memcpy(base + offset, buf, len);
+    if (crc_out) {
+      *crc_out = crc32c_copy(base + offset, buf, len);  // fused: hash while moving
+    } else {
+      std::memcpy(base + offset, buf, len);
+    }
   } else if (crc_out) {
     *crc_out = crc32c_copy(buf, base + offset, len);  // fused: hash while moving
   } else {

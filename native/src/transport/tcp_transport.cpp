@@ -574,19 +574,25 @@ struct SubOp {
   uint8_t* buf;    // client-side slice
   uint64_t len;
   uint64_t off;    // offset within the op (orders the crc combine)
-  uint32_t crc;    // this chunk's crc32c (op->want_crc reads only)
+  uint32_t crc;    // this chunk's crc32c (op->want_crc only)
 };
 
 bool use_staged(const PooledConn& c, const SubOp& sub) {
   return c.stg_base != nullptr && sub.len <= c.stg_len;
 }
 
-ErrorCode issue_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode) {
+ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
   if (use_staged(c, sub)) {
     const uint8_t op = opcode == kOpWrite ? kOpWriteStaged : kOpReadStaged;
     DataRequestHeader hdr{op, sub.addr, sub.op->rkey, sub.len};
     const uint64_t shm_off = 0;  // one in-flight op per connection
-    if (op == kOpWriteStaged) std::memcpy(c.stg_base, sub.buf, sub.len);
+    if (op == kOpWriteStaged) {
+      // Fused copy+crc: the staging of the bytes is the only client-side
+      // read of them either way, so want_crc writes get their shard stamp
+      // for free here (put-path mirror of the read-side drain fusion).
+      sub.crc = sub.op->want_crc ? crc32c_copy(c.stg_base, sub.buf, sub.len)
+                                 : (std::memcpy(c.stg_base, sub.buf, sub.len), 0u);
+    }
     g_staged_ops.fetch_add(1);
     struct {
       DataRequestHeader h;
@@ -595,8 +601,13 @@ ErrorCode issue_sub(const PooledConn& c, const SubOp& sub, uint8_t opcode) {
     return net::write_all(c.sock.fd(), &framed, sizeof(framed));
   }
   DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len};
-  if (opcode == kOpWrite)
-    return net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
+  if (opcode == kOpWrite) {
+    const ErrorCode ec = net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
+    // No copy to fuse into on the plain socket lane: hash after the send so
+    // the pass overlaps sibling chunks already moving through the kernel.
+    if (ec == ErrorCode::OK && sub.op->want_crc) sub.crc = crc32c(sub.buf, sub.len);
+    return ec;
+  }
   return net::write_all(c.sock.fd(), &hdr, sizeof(hdr));
 }
 
@@ -768,16 +779,14 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     }
     if (ec != ErrorCode::OK) fail(sub.op, ec);
   }
-  if (!is_write) {
-    // Per-op CRC from the per-chunk CRCs. Chunks completed in any order,
-    // but each op's subs sit contiguously in offset order here, so one
-    // forward fold (cached combine operators — chunk lengths repeat) per
-    // op reassembles its crc.
-    for (const SubOp& sub : subs) {
-      WireOp* op = sub.op;
-      if (!op->want_crc || op->status != ErrorCode::OK) continue;
-      op->crc = sub.off == 0 ? sub.crc : crc32c_combine(op->crc, sub.crc, sub.len);
-    }
+  // Per-op CRC from the per-chunk CRCs (reads hash while draining, writes
+  // while staging/sending). Chunks completed in any order, but each op's
+  // subs sit contiguously in offset order here, so one forward fold (cached
+  // combine operators — chunk lengths repeat) per op reassembles its crc.
+  for (const SubOp& sub : subs) {
+    WireOp* op = sub.op;
+    if (!op->want_crc || op->status != ErrorCode::OK) continue;
+    op->crc = sub.off == 0 ? sub.crc : crc32c_combine(op->crc, sub.crc, sub.len);
   }
   return first;
 }

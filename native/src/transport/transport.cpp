@@ -134,7 +134,7 @@ class MuxTransportClient : public TransportClient {
         continue;
       }
       op.status = access(*op.remote, op.addr, op.rkey, op.buf, op.len, is_write,
-                         !is_write && op.want_crc ? &op.crc : nullptr);
+                         op.want_crc ? &op.crc : nullptr);
       if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
     }
     if (!tcp_ops.empty()) {
@@ -201,6 +201,9 @@ ErrorCode TransportClient::write_batch(WireOp* ops, size_t n, size_t) {
     WireOp& op = ops[i];
     op.status = op.len == 0 ? ErrorCode::OK
                             : write(*op.remote, op.addr, op.rkey, op.buf, op.len);
+    // Wrappers that route per-op (fault injector) still honor the CRC
+    // contract, post-hoc.
+    if (op.status == ErrorCode::OK && op.want_crc) op.crc = crc32c(op.buf, op.len);
     if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
   }
   return first;

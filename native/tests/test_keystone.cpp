@@ -116,6 +116,33 @@ BTEST(Keystone, PutLifecycleAndLookup) {
   BT_EXPECT_EQ(stats.value().total_memory_pools, 1ull);
 }
 
+BTEST(Keystone, PutCompleteCarriesContentCrc) {
+  // Clients that fuse hashing into the transfer only know the whole-object
+  // CRC at put_complete time; a nonzero value there must stamp every copy,
+  // and 0 must keep whatever put_start carried (older-client path).
+  KeystoneService ks(fast_config(), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  FakeWorker w1("w1", 1 << 20);
+  ks.register_worker(w1.info());
+  ks.register_memory_pool(w1.pool);
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+
+  BT_ASSERT_OK(ks.put_start("crc/fused", 4096, cfg, /*content_crc=*/0));
+  BT_EXPECT(ks.put_complete("crc/fused", {}, /*content_crc=*/0xDEADBEEF) == ErrorCode::OK);
+  auto got = ks.get_workers("crc/fused");
+  BT_ASSERT_OK(got);
+  BT_EXPECT_EQ(got.value().front().content_crc, 0xDEADBEEFu);
+
+  // Up-front stamp survives a 0 at complete.
+  BT_ASSERT_OK(ks.put_start("crc/upfront", 4096, cfg, /*content_crc=*/0x1234));
+  BT_EXPECT(ks.put_complete("crc/upfront") == ErrorCode::OK);
+  auto got2 = ks.get_workers("crc/upfront");
+  BT_ASSERT_OK(got2);
+  BT_EXPECT_EQ(got2.value().front().content_crc, 0x1234u);
+}
+
 BTEST(Keystone, GcReclaimsAbandonedPendingPuts) {
   // A client that dies between put_start and put_complete/cancel must not
   // leak its reservation forever (the reference bounded this with backend

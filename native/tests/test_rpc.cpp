@@ -405,6 +405,40 @@ BTEST(Rpc, OlderPeerOmittedTrailingFieldsDefault) {
   BT_EXPECT_EQ(resp.copies[0].content_crc, 0u);  // defaulted: reads skip verify
 }
 
+BTEST(Rpc, OlderPutCompleteWithoutContentCrcStillCompletes) {
+  // A pre-fused-hash peer: its PutCompleteRequest ends after shard_crcs
+  // (no content_crc field). The object must complete, keeping put_start's
+  // up-front stamp instead of clobbering it.
+  RpcFixture f;
+  BT_ASSERT(f.up());
+  rpc::KeystoneRpcClient client(f.server->endpoint());
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  cfg.max_workers_per_copy = 1;
+  BT_ASSERT_OK(client.put_start("compat/complete", 1024, cfg, /*content_crc=*/0x77));
+
+  wire::Writer payload;
+  wire::encode(payload, std::string("compat/complete"));
+  wire::encode(payload, std::vector<CopyShardCrcs>{});
+  // message ends here: no content_crc
+
+  auto hp = net::parse_host_port(f.server->endpoint());
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  BT_ASSERT(sock.ok());
+  auto req = payload.take();
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(Method::kPutComplete),
+                            req.data(), req.size()) == ErrorCode::OK);
+  uint8_t opcode = 0;
+  std::vector<uint8_t> resp_bytes;
+  BT_ASSERT(net::recv_frame(sock.value().fd(), opcode, resp_bytes) == ErrorCode::OK);
+  PutCompleteResponse resp;
+  BT_ASSERT(wire::from_bytes_lax(resp_bytes, resp));
+  BT_EXPECT(resp.error_code == ErrorCode::OK);
+  auto placed = client.get_workers("compat/complete");
+  BT_ASSERT_OK(placed);
+  BT_EXPECT_EQ(placed.value().front().content_crc, 0x77u);  // put_start's kept
+}
+
 BTEST(Rpc, PingHandshakeReportsProtocolVersion) {
   RpcFixture f;
   BT_ASSERT(f.up());

@@ -321,6 +321,70 @@ BTEST(Transport, TcpWantCrcCoversStagedAndMultiChunkReads) {
   server2->stop();
 }
 
+BTEST(Transport, WantCrcFusesIntoWritesAcrossLanes) {
+  // Put-path mirror of the read fusion: a write with want_crc must return
+  // the crc32c of the bytes it moved — fused with the staging copy on the
+  // staged lane, folded across chunks when the op splits, post-send on the
+  // streaming lane, and fused with the memcpy on SHM/LOCAL. The client
+  // stamps shard CRCs straight from these, so a wrong value here would
+  // poison every later verified read of the object.
+  const uint64_t len = 9ull << 20;  // > 2 chunks on the TCP lane
+  std::vector<uint8_t> src(len);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = static_cast<uint8_t>(i * 37 >> 3 ^ i);
+  const uint32_t expect = crc32c(src.data(), len);
+
+  {  // TCP staged (default same-host) — wide op, per-chunk fused copies.
+    auto server = make_transport_server(TransportKind::TCP);
+    BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+    std::vector<uint8_t> region(len);
+    auto reg = server->register_region(region.data(), region.size(), "wcrc");
+    BT_ASSERT_OK(reg);
+    const auto desc = reg.value();
+    WireOp put{&desc, desc.remote_base, parse_rkey(desc), src.data(), len};
+    put.want_crc = true;
+    BT_EXPECT(make_transport_client()->write_batch(&put, 1) == ErrorCode::OK);
+    BT_EXPECT_EQ(put.crc, expect);
+    BT_EXPECT(region == src);
+    // Single-chunk op at an offset keeps the contract.
+    WireOp small{&desc, desc.remote_base + 4321, parse_rkey(desc), src.data(), 70000};
+    small.want_crc = true;
+    BT_EXPECT(make_transport_client()->write_batch(&small, 1) == ErrorCode::OK);
+    BT_EXPECT_EQ(small.crc, crc32c(src.data(), 70000));
+    server->stop();
+  }
+  {  // TCP streaming lane (staged lane disabled): hash rides post-send.
+    setenv("BTPU_STAGED_DATA", "0", 1);
+    auto server = make_transport_server(TransportKind::TCP);
+    BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+    std::vector<uint8_t> region(len);
+    auto reg = server->register_region(region.data(), region.size(), "wcrc2");
+    BT_ASSERT_OK(reg);
+    const auto desc = reg.value();
+    WireOp put{&desc, desc.remote_base, parse_rkey(desc), src.data(), len};
+    put.want_crc = true;
+    BT_EXPECT(make_transport_client()->write_batch(&put, 1) == ErrorCode::OK);
+    BT_EXPECT_EQ(put.crc, expect);
+    BT_EXPECT(region == src);
+    unsetenv("BTPU_STAGED_DATA");
+    server->stop();
+  }
+  {  // SHM: fused with the segment memcpy.
+    auto server = make_transport_server(TransportKind::SHM);
+    BT_ASSERT(server->start("", 0) == ErrorCode::OK);
+    void* base = server->alloc_region(len, "wcrc3");
+    BT_ASSERT(base != nullptr);
+    auto reg = server->register_region(base, len, "wcrc3");
+    BT_ASSERT_OK(reg);
+    const auto desc = reg.value();
+    WireOp put{&desc, desc.remote_base, parse_rkey(desc), src.data(), len};
+    put.want_crc = true;
+    BT_EXPECT(make_transport_client()->write_batch(&put, 1) == ErrorCode::OK);
+    BT_EXPECT_EQ(put.crc, expect);
+    BT_EXPECT(std::memcmp(base, src.data(), len) == 0);
+    server->stop();
+  }
+}
+
 BTEST(Transport, TcpBatchFailsFastOnDeadEndpoint) {
   // One unreachable endpoint in a batch must not sink the ops aimed at the
   // live one, and every op to the dead endpoint shares one connect attempt

@@ -203,6 +203,60 @@ def bench_cross_process(shm_get_gbps: float | None, hbm: bool) -> None:
               file=sys.stderr)
 
 
+_SUBSTRATE_SERVER_SRC = """
+import os, sys, time
+import numpy as np
+import jax
+plat = os.environ.get("JAX_PLATFORMS")
+if plat:
+    jax.config.update("jax_platforms", plat)
+from jax.experimental import transfer
+dev = jax.local_devices()[0]
+srv = transfer.start_transfer_server(dev.client, "127.0.0.1:0", ["127.0.0.1:0"])
+arr = jax.device_put(
+    np.random.default_rng(0).integers(0, 255, int(sys.argv[1]), dtype=np.uint8), dev)
+arr.block_until_ready()
+for tid in range(6):
+    srv.await_pull(tid, [arr])
+print(srv.address(), flush=True)
+time.sleep(120)
+"""
+
+
+def _raw_fabric_substrate_gbps(nbytes: int) -> float:
+    """Cross-process jax.experimental.transfer ceiling: a sibling runtime
+    offers `nbytes`; this process pulls it raw. 0.0 when unavailable."""
+    import numpy as np
+
+    import jax
+
+    try:
+        from jax.experimental import transfer
+        from jax.sharding import SingleDeviceSharding
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SUBSTRATE_SERVER_SRC, str(nbytes)],
+            stdout=subprocess.PIPE, text=True, cwd=REPO_ROOT)
+        try:
+            addr = proc.stdout.readline().strip()
+            if not addr:
+                return 0.0
+            dev = jax.local_devices()[0]
+            srv = transfer.start_transfer_server(dev.client, "127.0.0.1:0", ["127.0.0.1:0"])
+            conn = srv.connect(addr)
+            spec = jax.ShapeDtypeStruct((nbytes,), np.uint8,
+                                        sharding=SingleDeviceSharding(dev))
+            conn.pull(0, [spec])[0].block_until_ready()  # warm
+            t0 = time.perf_counter()
+            for tid in range(1, 5):
+                conn.pull(tid, [spec])[0].block_until_ready()
+            return 4 * nbytes / (time.perf_counter() - t0) / 1e9
+        finally:
+            proc.kill()
+    except Exception:  # noqa: BLE001 - substrate row is best-effort
+        return 0.0
+
+
 def bench_fabric_client() -> None:
     """Client-driven device fabric (VERDICT r4 item 1): THIS process owns a
     JAX runtime and moves device-tier bytes itself over the transfer fabric
@@ -226,29 +280,51 @@ def bench_fabric_client() -> None:
     from blackbird_tpu import Client, FabricClient
     from blackbird_tpu.procluster import ProcessCluster
 
-    with ProcessCluster(workers=1, devices_per_worker=1, pool_mb=192) as pc:
+    with ProcessCluster(workers=1, devices_per_worker=1, pool_mb=256) as pc:
         pc.wait_ready(timeout=300)
         client = Client(f"127.0.0.1:{pc.keystone_port}")
         fc = FabricClient(client)
         data = np.random.default_rng(7).integers(
             0, 255, size=4 << 20, dtype=np.uint8)
         n = 8
-        t0 = time.perf_counter()
-        for i in range(n):
-            fc.put(f"fab/{i}", data, max_workers=1, preferred_class="hbm_tpu")
-        put_gbps = n * data.nbytes / (time.perf_counter() - t0) / 1e9
-        np.asarray(fc.get("fab/0"))  # warm the pull path
-        t0 = time.perf_counter()
-        for i in range(n):
-            fc.get(f"fab/{i}").block_until_ready()
-        get_gbps = n * data.nbytes / (time.perf_counter() - t0) / 1e9
-        ok = np.asarray(fc.get("fab/1")).tobytes() == data.tobytes()
+        # Warm both directions (compilation + connection caches), then
+        # best-of-3 like every other row — the first cold pass on this
+        # noisy 1-core box routinely reads 40% under the warm capability.
+        fc.put_many({"fab/warm": data}, max_workers=1, preferred_class="hbm_tpu")
+        np.asarray(fc.get("fab/warm"))
+        put_gbps = 0.0
+        for r in range(3):
+            batch = {f"fab/{r}/{i}": data for i in range(n)}
+            t0 = time.perf_counter()
+            fc.put_many(batch, max_workers=1, preferred_class="hbm_tpu")
+            put_gbps = max(put_gbps, n * data.nbytes / (time.perf_counter() - t0) / 1e9)
+            if r < 2:  # keep the last round resident for the get rows
+                for key in batch:
+                    client.remove(key)
+        get_gbps = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for arr in fc.get_many([f"fab/2/{i}" for i in range(n)]):
+                arr.block_until_ready()
+            get_gbps = max(get_gbps, n * data.nbytes / (time.perf_counter() - t0) / 1e9)
+        ok = np.asarray(fc.get("fab/2/1")).tobytes() == data.tobytes()
         if not ok:
             raise RuntimeError("fabric readback mismatch")
+        # The SUBSTRATE ceiling, measured in the same run: raw transfer-
+        # server pulls of the same bytes from a SIBLING process's runtime
+        # (cross-process like the real path — a self-pull would shortcut
+        # the socket), no framework in the loop. Fabric efficiency =
+        # fabric / substrate is the framework-overhead number; comparing
+        # fabric GB/s against the staged lane's shm memcpy substrate is
+        # apples-to-oranges on CPU (the real-chip leg is where the fabric
+        # substrate wins, riding ICI DMA instead of a loopback socket).
+        raw_gbps = _raw_fabric_substrate_gbps(data.nbytes)
+        eff = (f" | raw fabric substrate {raw_gbps:.2f} GB/s -> get efficiency "
+               f"{get_gbps / raw_gbps * 100:.0f}%" if raw_gbps else "")
         print(
-            f"client device fabric (runtime-owning client, 4MiB, zero staged "
-            f"bytes): put {put_gbps:.2f} GB/s | get {get_gbps:.2f} GB/s "
-            f"({fc.fabric_puts} puts/{fc.fabric_gets} gets rode the fabric)",
+            f"client device fabric (runtime-owning client, 8x4MiB batched, zero "
+            f"staged bytes): put {put_gbps:.2f} GB/s | get {get_gbps:.2f} GB/s "
+            f"({fc.fabric_puts} puts/{fc.fabric_gets} gets rode the fabric){eff}",
             file=sys.stderr,
         )
 

@@ -38,6 +38,14 @@ class TransferLink:
         self._server = None  # None = unprobed, False = unavailable/disabled
         self._lock = threading.Lock()
         self._conns: dict[str, object] = {}
+        # jax.experimental.transfer documents no thread-safety contract, and
+        # callers (FabricClient batch APIs, concurrent worker-side command
+        # handlers) may reach this link from several threads: serialize
+        # await_pull on the shared server and pull per shared connection.
+        # Cross-process parallelism (the kind that matters on a mesh) is
+        # untouched — each process has its own link.
+        self._offer_lock = threading.Lock()
+        self._conn_locks: dict[str, threading.Lock] = {}
         self._offered: dict[int, tuple[object, float]] = {}
         self._gc_queue = None
         self.offers = 0
@@ -82,6 +90,10 @@ class TransferLink:
                 conn = self._conns[addr] = server.connect(addr)
             return conn
 
+    def _conn_lock(self, addr: str) -> threading.Lock:
+        with self._lock:
+            return self._conn_locks.setdefault(addr, threading.Lock())
+
     def _spec(self, shape, dtype, device):
         from jax.sharding import SingleDeviceSharding  # noqa: PLC0415
 
@@ -97,7 +109,8 @@ class TransferLink:
         if server is None:
             raise RuntimeError("device fabric unavailable")
         self.gc_offers()
-        server.await_pull(int(transfer_id), [arr])
+        with self._offer_lock:
+            server.await_pull(int(transfer_id), [arr])
         spec = self._spec(arr.shape, arr.dtype, device or self.device())
         with self._lock:
             self._offered[int(transfer_id)] = (spec, time.monotonic())
@@ -109,7 +122,9 @@ class TransferLink:
         import numpy as np  # noqa: PLC0415
 
         spec = self._spec((int(length),), np.uint8, device or self.device())
-        return self.connect(addr).pull(int(transfer_id), [spec])[0]
+        conn = self.connect(addr)
+        with self._conn_lock(addr):
+            return conn.pull(int(transfer_id), [spec])[0]
 
     def gc_offers(self, max_age_s: float = 60.0) -> None:
         """Discards offers whose pull never came (the peer fell back): the
@@ -134,7 +149,10 @@ class TransferLink:
                     while True:
                         tid, spec = self._gc_queue.get()
                         try:
-                            self.connect(self.server().address()).pull(tid, [spec])
+                            gc_addr = self.server().address()
+                            conn = self.connect(gc_addr)
+                            with self._conn_lock(gc_addr):
+                                conn.pull(tid, [spec])
                             self.discards += 1
                         except Exception:  # noqa: BLE001 - best effort
                             pass

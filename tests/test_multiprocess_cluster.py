@@ -569,6 +569,20 @@ pools:
         assert np.asarray(arr).tobytes() == data.tobytes()
         assert fc.fabric_gets == 1
 
+        # Batch APIs: put_many/get_many pipeline the command phase per key
+        # (the checkpoint-restore shape). Same bytes, same fabric path.
+        batch = {f"fab/b{i}": np.full(1024, i, dtype=np.float32) for i in range(3)}
+        fc.put_many(batch, max_workers=1, preferred_class="hbm_tpu")
+        assert fc.fabric_puts == 4
+        outs = fc.get_many(list(batch))
+        for (key, want), got in zip(batch.items(), outs):
+            assert np.asarray(got).tobytes() == want.tobytes(), key
+        assert fc.fabric_gets == 4
+        # ...and with the multi-core prefetch window enabled.
+        outs = fc.get_many(list(batch), pipeline_ahead=1)
+        for (key, want), got in zip(batch.items(), outs):
+            assert np.asarray(got).tobytes() == want.tobytes(), key
+
         # Host-tier objects have no fabric endpoint: clean fallback signal,
         # and the convenience wrapper falls back to the staged byte path.
         client.put("fab/host", b"hostbytes" * 1000,
@@ -579,6 +593,13 @@ pools:
         except FabricUnavailable:
             pass
         assert fc.get_bytes("fab/host") == b"hostbytes" * 1000
+        # A batch with any fabric-less key refuses whole (callers fall back
+        # per key via get_bytes).
+        try:
+            fc.get_many(["fab/b0", "fab/host"])
+            raise AssertionError("expected FabricUnavailable for a mixed batch")
+        except FabricUnavailable:
+            pass
 
         # Checkpointing over the fabric — the production TPU restore shape:
         # save offers device shards from this runtime (worker pulls), load

@@ -566,21 +566,27 @@ def main() -> int:
     # excuse (VERDICT r4 item 5; r4's record said "tunnel down?" with a
     # question mark).
     probe_detail: dict = {}
-    try:
-        pr = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; ds = jax.devices(); "
-             "print(len(ds), ds[0].platform, ds[0].device_kind)"],
-            capture_output=True, text=True, timeout=60, cwd=REPO_ROOT,
-        )
-        if pr.returncode == 0:
-            probe_detail = {"devices": pr.stdout.strip()}
-        else:
+    # Two attempts: the tunnel flaps on the scale of minutes (observed up at
+    # minute 0, hung at minute 40, up again later) and answers within ~20 s
+    # when healthy, so a second 75 s try meaningfully raises the odds of
+    # catching a window without risking a long hang.
+    for attempt in (1, 2):
+        try:
+            pr = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; ds = jax.devices(); "
+                 "print(len(ds), ds[0].platform, ds[0].device_kind)"],
+                capture_output=True, text=True, timeout=75, cwd=REPO_ROOT,
+            )
+            if pr.returncode == 0:
+                probe_detail = {"devices": pr.stdout.strip(), "probe_attempt": attempt}
+                break
             probe_detail = {"skipped": "tunnel", "probe_rc": pr.returncode,
+                            "probe_attempts": attempt,
                             "probe_stderr": pr.stderr.strip()[-200:]}
-    except subprocess.TimeoutExpired:
-        probe_detail = {"skipped": "tunnel", "probe_rc": "timeout",
-                        "probe_timeout_s": 60}
+        except subprocess.TimeoutExpired:
+            probe_detail = {"skipped": "tunnel", "probe_rc": "timeout",
+                            "probe_timeout_s": 75, "probe_attempts": attempt}
     if "skipped" in probe_detail:
         print(f"hbm tier bench skipped: {json.dumps(probe_detail)}", file=sys.stderr)
     else:

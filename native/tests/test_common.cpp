@@ -11,6 +11,37 @@
 
 using namespace btpu;
 
+BTEST(Crc32c, FastKernelsMatchReferenceTable) {
+  // Differential check of whatever accelerated kernel the build selected
+  // (PCLMUL folding >= its threshold, 3-lane crc32 below it, plain table
+  // elsewhere) against an independent bitwise implementation — across the
+  // kernel-switch boundary, fold-block multiples +-1, odd tails, and
+  // nonzero seeds. A wrong fold constant would corrupt every stamp written.
+  auto reference = [](const uint8_t* p, size_t n, uint32_t seed) {
+    uint32_t crc = ~seed;
+    for (size_t i = 0; i < n; ++i) {
+      crc ^= p[i];
+      for (int b = 0; b < 8; ++b) crc = (crc >> 1) ^ (0x82f63b78u & (0u - (crc & 1)));
+    }
+    return ~crc;
+  };
+  std::vector<uint8_t> data(70'000);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 197 + 11);
+  std::vector<uint8_t> dst(data.size());
+  for (size_t n : {size_t{0}, size_t{1}, size_t{7}, size_t{255}, size_t{256}, size_t{271},
+                   size_t{272}, size_t{273}, size_t{383}, size_t{384}, size_t{385},
+                   size_t{4096}, size_t{12'289}, size_t{65'536}, size_t{65'537},
+                   data.size()}) {
+    for (uint32_t seed : {0u, 0xDEADBEEFu}) {
+      const uint32_t want = reference(data.data(), n, seed);
+      BT_EXPECT_EQ(crc32c(data.data(), n, seed), want);
+      std::fill(dst.begin(), dst.end(), 0);
+      BT_EXPECT_EQ(crc32c_copy(dst.data(), data.data(), n, seed), want);
+      BT_EXPECT(std::memcmp(dst.data(), data.data(), n) == 0);
+    }
+  }
+}
+
 BTEST(Crc32c, CombineMatchesConcatenation) {
   // crc(X || Y) == combine(crc(X), crc(Y), |Y|) — the identity per-chunk
   // streaming CRCs and per-shard stamps rely on to merge without re-reading.

@@ -280,10 +280,29 @@ def bench_fabric_client() -> None:
     from blackbird_tpu import Client, FabricClient
     from blackbird_tpu.procluster import ProcessCluster
 
+    # End-to-end substrate probe BEFORE spawning a cluster: on the tunneled
+    # axon TPU the transfer server starts but cannot move bytes (PJRT plugin
+    # lacks CreateBuffersForAsyncHostToDevice / CopyRawToHost), which the
+    # TransferLink self-pull probe detects. A structured skip — with the
+    # PJRT error on the record — beats a dead child (VERDICT r4 item 5's
+    # "no more question marks" rule applied to the fabric leg). The probed
+    # link is handed to FabricClient below: one transfer server per process.
+    from blackbird_tpu.transferlink import TransferLink
+
+    probe_link = TransferLink(jax)
+    if probe_link.server() is None:
+        print(json.dumps({
+            "row": "client_device_fabric",
+            "skipped": "fabric substrate unavailable",
+            "platform": jax.devices()[0].platform,
+            "probe_error": (probe_link.unavailable_reason or "")[:300],
+        }), file=sys.stderr)
+        return
+
     with ProcessCluster(workers=1, devices_per_worker=1, pool_mb=256) as pc:
         pc.wait_ready(timeout=300)
         client = Client(f"127.0.0.1:{pc.keystone_port}")
-        fc = FabricClient(client)
+        fc = FabricClient(client, link=probe_link)
         data = np.random.default_rng(7).integers(
             0, 255, size=4 << 20, dtype=np.uint8)
         n = 8
@@ -340,7 +359,16 @@ def main() -> int:
         return 0
     if "--fabric-only" in sys.argv:
         sys.path.insert(0, str(REPO_ROOT))
-        bench_fabric_client()
+        from blackbird_tpu.fabric import FabricUnavailable
+
+        try:
+            bench_fabric_client()
+        except FabricUnavailable as exc:  # worker-side gap: skip on record
+            print(json.dumps({
+                "row": "client_device_fabric",
+                "skipped": "fabric unavailable in cluster",
+                "detail": str(exc)[:300],
+            }), file=sys.stderr)
         return 0
     binary = ensure_built()
     # Headline is measured over REAL sockets (TCP transport, loopback):

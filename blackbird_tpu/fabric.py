@@ -51,7 +51,7 @@ class FabricClient:
     mirrors the worker-side provider (hbm.py) one-server-per-process rule.
     """
 
-    def __init__(self, client: Client, jax_module=None):
+    def __init__(self, client: Client, jax_module=None, link=None):
         if jax_module is None:
             import jax as jax_module  # noqa: PLC0415 - optional heavy import
         self._client = client
@@ -60,10 +60,17 @@ class FabricClient:
         # TransferLink class the worker-side provider uses, so the stale-
         # offer drain and single-drainer invariants apply to client offers
         # too (a put whose worker-side pull never fires would otherwise pin
-        # the offered device array forever).
-        self._link = TransferLink(jax_module)
+        # the offered device array forever). Callers that already probed a
+        # link pass it in (one transfer server per process).
+        self._link = link if link is not None else TransferLink(jax_module)
         self.fabric_gets = 0
         self.fabric_puts = 0
+
+    def _no_server(self) -> "FabricUnavailable":
+        reason = self._link.unavailable_reason
+        return FabricUnavailable(
+            "no transfer server in this process"
+            + (f" ({reason})" if reason else ""))
 
     @staticmethod
     def _eligible(copy: dict) -> bool:
@@ -85,7 +92,7 @@ class FabricClient:
         # Fail fast BEFORE commanding any worker-side offer: an offer with
         # no pull coming pins worker device memory until the stale-offer GC.
         if self._link.address() is None:
-            raise FabricUnavailable("no transfer server in this process")
+            raise self._no_server()
         copies = self._client.placements(key)
         last: Exception | None = None
         for copy in copies:
@@ -185,7 +192,7 @@ class FabricClient:
         GC."""
         jnp = self._jax.numpy
         if self._link.address() is None:
-            raise FabricUnavailable("no transfer server in this process")
+            raise self._no_server()
         plan = []  # per key: (cmds, shards=(fabric_addr, tid, length))
         for key in keys:
             copies = self._client.placements(key)
@@ -286,7 +293,7 @@ class FabricClient:
             copies = json.loads(buf.raw[: out_len.value].decode())
             addr = self._link.address()
             if addr is None:
-                raise FabricUnavailable("no transfer server in this process")
+                raise self._no_server()
             pushed = 0
             for copy in copies:
                 if not self._eligible(copy):
@@ -329,10 +336,10 @@ class FabricClient:
         Like put(), fabric puts are unstamped (the bytes never pass through
         this host)."""
         jnp = self._jax.numpy
-        handle = self._client._handle
         addr = self._link.address()
         if addr is None:
-            raise FabricUnavailable("no transfer server in this process")
+            raise self._no_server()
+        handle = self._client._handle
         started: list[str] = []
         try:
             for key, data in items.items():

@@ -36,6 +36,7 @@ class TransferLink:
         self._jax = jax_module
         self._device = device  # default: first local device, resolved lazily
         self._server = None  # None = unprobed, False = unavailable/disabled
+        self.unavailable_reason: str | None = None  # set when probe fails
         self._lock = threading.Lock()
         self._conns: dict[str, object] = {}
         # jax.experimental.transfer documents no thread-safety contract, and
@@ -61,21 +62,77 @@ class TransferLink:
 
     def server(self):
         """The lazily started per-process transfer server, or None
-        (disabled via BTPU_HBM_FABRIC=0, or unavailable on this stack)."""
+        (disabled via BTPU_HBM_FABRIC=0, or unavailable on this stack).
+
+        Availability is probed END TO END, not just by server start: on the
+        tunneled axon TPU stack `start_transfer_server` succeeds but every
+        pull dies in the PJRT plugin (`PJRT_Client_CreateBuffersForAsync-
+        HostToDevice is not implemented`, and the serving direction lacks
+        `PJRT_Buffer_CopyRawToHost`), so a tiny self offer/pull is the only
+        honest test. A stack that fails the probe reports None here — the
+        worker then advertises no fabric endpoints and every caller takes
+        the staged lane — with the first error preserved in
+        `unavailable_reason` so benches/operators see the real cause."""
         with self._lock:
             if self._server is not None:
                 return self._server or None
             if os.environ.get("BTPU_HBM_FABRIC") == "0":
                 self._server = False
+                self.unavailable_reason = "disabled (BTPU_HBM_FABRIC=0)"
                 return None
             try:
                 from jax.experimental import transfer  # noqa: PLC0415
 
-                self._server = transfer.start_transfer_server(
+                server = transfer.start_transfer_server(
                     self.device().client, "127.0.0.1:0", ["127.0.0.1:0"])
-            except Exception:  # noqa: BLE001 - no fabric on this stack
+            except Exception as exc:  # noqa: BLE001 - no fabric on this stack
                 self._server = False
+                self.unavailable_reason = f"server start failed: {exc}"
                 return None
+            # Self-probe on a DEADLINED daemon thread: the same flapping
+            # stack can also WEDGE a pull rather than error it (observed:
+            # jax.devices() itself hangs when the tunnel is sick), and this
+            # runs under self._lock — an unbounded hang here would freeze
+            # every server()/address()/connect() caller in the process. The
+            # thread touches only locals, so an abandoned probe can't corrupt
+            # link state; its offered 16 bytes stay pinned in a process whose
+            # fabric is now off.
+            import secrets  # noqa: PLC0415
+            import numpy as np  # noqa: PLC0415
+
+            result: dict = {}
+
+            def _probe():
+                try:
+                    tid = secrets.randbits(63)
+                    arr = self._jax.device_put(
+                        np.zeros(16, dtype=np.uint8), self.device())
+                    arr.block_until_ready()
+                    server.await_pull(tid, [arr])
+                    conn = server.connect(server.address())
+                    out = conn.pull(
+                        tid, [self._spec((16,), np.uint8, self.device())])[0]
+                    np.asarray(out)  # force materialization: axon fails HERE
+                    result["ok"] = True
+                except Exception as exc:  # noqa: BLE001 - can't move bytes
+                    result["error"] = exc
+
+            timeout_s = float(os.environ.get("BTPU_FABRIC_PROBE_TIMEOUT_S", "30"))
+            t = threading.Thread(target=_probe, daemon=True,
+                                 name="btpu-fabric-probe")
+            t.start()
+            t.join(timeout_s)
+            if not result.get("ok"):
+                self._server = False
+                # Keep the half-dead server referenced: its teardown path is
+                # unproven on the failing stack and a leaked listener is safer
+                # than a destructor crash in a serving process.
+                self._probe_failed_server = server
+                self.unavailable_reason = (
+                    f"probe pull failed: {result['error']}" if "error" in result
+                    else f"probe pull wedged (> {timeout_s:.0f}s)")
+                return None
+            self._server = server
             return self._server
 
     def address(self) -> str | None:

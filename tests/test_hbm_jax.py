@@ -118,3 +118,68 @@ def test_hbm_overwrite_neighbor_isolation(jax_provider):
         client.put("hbm/a2", a2)
         assert client.get("hbm/b") == b
         assert client.get("hbm/a2") == a2
+
+
+def test_transfer_probe_degrades_gracefully(monkeypatch):
+    """A stack whose transfer server STARTS but cannot move bytes (the
+    tunneled axon TPU: PJRT_Client_CreateBuffersForAsyncHostToDevice /
+    PJRT_Buffer_CopyRawToHost unimplemented) must read as fabric-unavailable
+    — server() None with the PJRT error preserved — so workers advertise no
+    fabric endpoints and clients fall back to the staged lane instead of
+    dying mid-put with MEMORY_ACCESS_ERROR (observed on real hardware,
+    BENCH r5)."""
+    import jax
+
+    from blackbird_tpu.fabric import FabricClient, FabricUnavailable
+    from blackbird_tpu.transferlink import TransferLink
+
+    class StubConn:
+        def pull(self, tid, specs):
+            raise RuntimeError(
+                "UNIMPLEMENTED: PJRT_Client_CreateBuffersForAsyncHostToDevice "
+                "is not implemented")
+
+    class StubServer:
+        def address(self):
+            return "127.0.0.1:1"
+
+        def await_pull(self, tid, arrs):
+            pass
+
+        def connect(self, addr):
+            return StubConn()
+
+    from jax.experimental import transfer
+
+    monkeypatch.setattr(transfer, "start_transfer_server",
+                        lambda *a, **k: StubServer())
+
+    link = TransferLink(jax)
+    assert link.server() is None
+    assert link.address() is None
+    assert "UNIMPLEMENTED" in (link.unavailable_reason or "")
+
+    # FabricClient on the same stack fails fast with the reason, BEFORE
+    # touching the metadata plane (client is a bare object on purpose).
+    fc = FabricClient(object(), jax_module=jax)
+    with pytest.raises(FabricUnavailable, match="UNIMPLEMENTED"):
+        fc.get("any/key")
+    with pytest.raises(FabricUnavailable, match="UNIMPLEMENTED"):
+        fc.put_many({"k": np.zeros(4, np.uint8)})
+
+
+def test_transfer_probe_passes_on_working_stack():
+    """The CPU runtime's transfer fabric is real: the self-pull probe must
+    pass and leave the server usable (offer -> pull roundtrip)."""
+    import jax
+
+    from blackbird_tpu.transferlink import TransferLink
+
+    link = TransferLink(jax)
+    if link.server() is None:
+        pytest.skip(f"fabric unavailable here: {link.unavailable_reason}")
+    payload = np.arange(1024, dtype=np.uint8)
+    arr = jax.device_put(payload, link.device())
+    link.offer(424242, arr)
+    out = link.pull(link.address(), 424242, 1024)
+    assert np.array_equal(np.asarray(out), payload)

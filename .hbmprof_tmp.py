@@ -1,0 +1,25 @@
+import faulthandler; faulthandler.dump_traceback_later(120, exit=True)
+import time, numpy as np, jax
+from blackbird_tpu import EmbeddedCluster, StorageClass
+from blackbird_tpu.hbm import JaxHbmProvider
+
+iters, obj = 64, 1 << 20
+payloads = {f"b/{i}": np.random.default_rng(i).integers(0, 256, obj, dtype=np.uint8).tobytes() for i in range(iters)}
+prov = JaxHbmProvider().register()
+try:
+    with EmbeddedCluster(workers=1, pool_bytes=768 << 20, storage_class=StorageClass.HBM_TPU) as cluster:
+        client = cluster.client()
+        warm = {f"w/{i}": payloads[f"b/{i}"] for i in range(33)}
+        t0 = time.perf_counter(); client.put_many(warm, max_workers=1)
+        print(f"warm {1e3*(time.perf_counter()-t0):.0f} ms", flush=True)
+        for r in range(3):
+            t0 = time.perf_counter()
+            a = jax.device_put(np.frombuffer(payloads["b/0"], np.uint8), jax.devices()[0]); a.block_until_ready()
+            link = time.perf_counter() - t0
+            batch = {f"p{r}/{i}": p for i, p in enumerate(payloads.values())}
+            t0 = time.perf_counter()
+            client.put_many(batch, max_workers=1)
+            dt = time.perf_counter() - t0
+            print(f"round {r}: link(1MiB) {obj/link/1e9:.2f} GB/s | put {iters*obj/dt/1e9:.2f} GB/s ({dt*1e3:.0f} ms)", flush=True)
+finally:
+    JaxHbmProvider.unregister()

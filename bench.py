@@ -291,10 +291,17 @@ def bench_fabric_client() -> None:
 
     probe_link = TransferLink(jax)
     if probe_link.server() is None:
+        # probe_link.device() is already resolved from the probe — never
+        # re-enumerate devices here (jax.devices() can hang on the exact
+        # wedged stack this skip path exists for).
+        try:
+            platform = probe_link.device().platform
+        except Exception:  # noqa: BLE001 - probe failed before resolving
+            platform = "unknown"
         print(json.dumps({
             "row": "client_device_fabric",
             "skipped": "fabric substrate unavailable",
-            "platform": jax.devices()[0].platform,
+            "platform": platform,
             "probe_error": (probe_link.unavailable_reason or "")[:300],
         }), file=sys.stderr)
         return

@@ -348,12 +348,28 @@ class JaxHbmProvider:
         return regions, grouped
 
     @staticmethod
+    def _join_pending(slot) -> None:
+        """Joins a slot's in-flight dispatch without consuming it (the
+        result is cached, so a later join is free). Fences are appended by
+        the dispatcher thread; a slot's fence list is only complete — and
+        safe to drain destructively — after this returns. Exceptions were
+        already raised to the write that owned the dispatch."""
+        pending = slot.get("pending")
+        if pending is not None:
+            try:
+                pending.result()
+            except Exception:  # noqa: BLE001 - raised to its writer already
+                pass
+
+    @staticmethod
     def _await_fences(entry) -> None:
         """Blocks until every fence for `entry`'s buffer has executed.
 
         Fences are never donated (this provider holds their only reference),
         so block_until_ready cannot see a deleted array; the guard stays for
-        interpreter-shutdown robustness only. Caller holds entry["lock"]."""
+        interpreter-shutdown robustness only. Caller holds entry["lock"] AND
+        has joined the slot's pending dispatch (else the reassignment below
+        could discard a fence being appended concurrently)."""
         for fence in entry["fences"]:
             try:
                 fence.block_until_ready()
@@ -369,10 +385,23 @@ class JaxHbmProvider:
                 # round N's transfer/merge still drains the other, so the
                 # host staging pass overlaps the device link instead of
                 # serializing with it (round size = max_staging_bytes).
+                # The single-thread dispatcher is what makes the overlap
+                # REAL on hardware backends: device_put there BLOCKS its
+                # calling thread for the whole H2D (measured 22 ms / 32 MiB
+                # on the tunneled TPU — async dispatch only covers compiled
+                # computations, not host transfers), so transfers run on
+                # this thread while the caller fills the next slot. One
+                # thread per device also preserves round order (duplicate-
+                # page chunks rely on rounds landing in sequence).
+                from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+
                 entry = self._staging[dev] = {
-                    "slots": [{"buf": None, "fences": []} for _ in range(2)],
+                    "slots": [{"buf": None, "fences": [], "pending": None}
+                              for _ in range(2)],
                     "next": 0,
                     "lock": threading.Lock(),
+                    "exec": ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="btpu-hbm-dispatch"),
                 }
             return entry
 
@@ -385,16 +414,64 @@ class JaxHbmProvider:
         device_put transfer: the CPU backend's device_put is ZERO-COPY (the
         device buffer aliases the staging memory), so the bytes are only
         safe to overwrite once the merge kernels that read them have
-        finished. With two slots the wait only fires two rounds back —
-        hidden under the intervening round's transfer. Caller holds
-        entry["lock"]."""
+        finished. A slot's fences are appended by the dispatcher thread, so
+        the slot's in-flight dispatch (`pending`) is joined FIRST — only
+        then is the fence list complete. With two slots the wait only fires
+        two rounds back — hidden under the intervening round's transfer.
+        Caller holds entry["lock"]."""
         slot = entry["slots"][entry["next"]]
         entry["next"] = (entry["next"] + 1) % len(entry["slots"])
+        self._join_pending(slot)
+        slot["pending"] = None
         self._await_fences(slot)  # also covers an old buffer being replaced
         buf = slot["buf"]
         if buf is None or buf.shape[0] < rows or buf.shape[1] != page_bytes:
             buf = slot["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
         return buf[:rows], slot
+
+    def _run_device_round(self, flat, meta, dev, layouts, slot, regions) -> None:
+        """Dispatcher-thread body shared by the aligned and generic write
+        paths: ONE H2D of the filled staging segment + metadata, then each
+        region's donated merge over its slice, fence append, dirty mark."""
+        jax = self._jax
+        dev_flat = jax.device_put(flat, dev)
+        dev_meta = jax.device_put(meta, dev)
+        for region_id, start, m_padded, _spans in layouts:
+            region = regions[region_id]
+            if len(layouts) == 1:
+                pages, pmeta = dev_flat, dev_meta  # no slicing dispatches
+            else:
+                pages = jax.lax.dynamic_slice_in_dim(dev_flat, start, m_padded,
+                                                     axis=0)
+                pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
+            with region["lock"]:
+                region["buf"] = self._write_fn(region["buf"], pages, pmeta)
+                slot["fences"].append(self._fence_fn(region["buf"]))
+            with self._lock:
+                if region_id in self._regions:
+                    self._dirty.add(region_id)
+
+    def _dispatch(self, entry, slot, fn, futures: list) -> None:
+        """Queues `fn` (device_put + merge dispatches for one filled slot)
+        on the device's dispatcher thread. The caller thread is then free to
+        fill the next slot while this round's H2D occupies the link. Every
+        write path JOINS its futures before returning (_join_dispatches):
+        batch errors stay synchronous at the ABI, and a read issued after
+        write_batch returns can never see a pre-merge region buffer."""
+        fut = entry["exec"].submit(fn)
+        slot["pending"] = fut
+        futures.append(fut)
+
+    @staticmethod
+    def _join_dispatches(futures: list) -> None:
+        err = None
+        for fut in futures:  # settle ALL before raising: slots stay sane
+            try:
+                fut.result()
+            except Exception as exc:  # noqa: BLE001
+                err = err or exc
+        if err is not None:
+            raise err
 
     # -- aligned fast path -------------------------------------------------
 
@@ -449,28 +526,38 @@ class JaxHbmProvider:
         cap = max(1, self.max_staging_bytes // P)
         round_pr: dict[int, list] = {}
         count = 0
+        futures: list = []
 
         def flush_round():
             nonlocal round_pr, count
             if round_pr:
-                self._write_aligned_round(regions, round_pr)
+                self._write_aligned_round(regions, round_pr, futures)
             round_pr, count = {}, 0
 
-        for region_id, runs in per_region.items():
-            for p0, n, host in runs:
-                pos = 0
-                while pos < n:
-                    take = min(n - pos, cap - count)
-                    if take == 0:
-                        flush_round()
-                        continue
-                    round_pr.setdefault(region_id, []).append(
-                        (p0 + pos, take, host[pos * P : (pos + take) * P]))
-                    count += take
-                    pos += take
-        flush_round()
+        try:
+            for region_id, runs in per_region.items():
+                for p0, n, host in runs:
+                    pos = 0
+                    while pos < n:
+                        take = min(n - pos, cap - count)
+                        if take == 0:
+                            flush_round()
+                            continue
+                        round_pr.setdefault(region_id, []).append(
+                            (p0 + pos, take, host[pos * P : (pos + take) * P]))
+                        count += take
+                        pos += take
+            flush_round()
+        finally:
+            self._join_dispatches(futures)
 
-    def _write_aligned_round(self, regions, per_region) -> None:
+    def _write_aligned_round(self, regions, per_region, futures: list) -> None:
+        """Fills staging for one round on the CALLER thread, then queues the
+        device work (H2D + merge dispatch) on the device's dispatcher thread
+        — the caller immediately proceeds to fill the next round's slot, so
+        on backends whose device_put blocks (real TPU) consecutive rounds
+        pipeline fill(N+1) under transfer(N). _write_vecs_aligned joins the
+        futures before returning."""
         jax = self._jax
         P = self.page_bytes
         if len(per_region) == 1:
@@ -487,14 +574,20 @@ class JaxHbmProvider:
                 with entry["lock"]:
                     flat, slot = self._staging_for(entry, m_padded, P)
                     flat[:n] = host.reshape(n, P)
-                    dev_flat = jax.device_put(flat, region["device"])
-                    with region["lock"]:
-                        region["buf"] = self._write_run_fn(m_padded)(
-                            region["buf"], dev_flat, np.int32(p0), np.int32(n))
-                        slot["fences"].append(self._fence_fn(region["buf"]))
-                    with self._lock:
-                        if region_id in self._regions:
-                            self._dirty.add(region_id)
+
+                    def run_single(flat=flat, slot=slot, region=region,
+                                   region_id=region_id, p0=p0, n=n,
+                                   m_padded=m_padded):
+                        dev_flat = jax.device_put(flat, region["device"])
+                        with region["lock"]:
+                            region["buf"] = self._write_run_fn(m_padded)(
+                                region["buf"], dev_flat, np.int32(p0), np.int32(n))
+                            slot["fences"].append(self._fence_fn(region["buf"]))
+                        with self._lock:
+                            if region_id in self._regions:
+                                self._dirty.add(region_id)
+
+                    self._dispatch(entry, slot, run_single, futures)
                 return
         by_device: dict = {}
         for region_id, runs in per_region.items():
@@ -521,22 +614,13 @@ class JaxHbmProvider:
                         meta[2, row : row + n] = P  # full pages: v0=0, v1=P
                         flat[row : row + n] = host.reshape(n, P)  # ONE copy per run
                         row += n
-                dev_flat = jax.device_put(flat, dev)
-                dev_meta = jax.device_put(meta, dev)
-                for region_id, start, m_padded, _runs in layouts:
-                    region = regions[region_id]
-                    if len(layouts) == 1:
-                        pages, pmeta = dev_flat, dev_meta  # no slicing dispatches
-                    else:
-                        pages = jax.lax.dynamic_slice_in_dim(dev_flat, start, m_padded,
-                                                             axis=0)
-                        pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
-                    with region["lock"]:
-                        region["buf"] = self._write_fn(region["buf"], pages, pmeta)
-                        slot["fences"].append(self._fence_fn(region["buf"]))
-                    with self._lock:
-                        if region_id in self._regions:
-                            self._dirty.add(region_id)
+
+                self._dispatch(
+                    entry, slot,
+                    lambda flat=flat, slot=slot, meta=meta, dev=dev,
+                           layouts=layouts: self._run_device_round(
+                        flat, meta, dev, layouts, slot, regions),
+                    futures)
 
     # -- host-view fast path -----------------------------------------------
 
@@ -629,53 +713,51 @@ class JaxHbmProvider:
         if current:
             rounds.append(current)
 
-        for round_spans in rounds:
-            # Group regions by device; per device, build ONE flat (M, P)
-            # host staging array covering every region's (padded) pages and
-            # move it with ONE device_put. Each region then runs one donated
-            # scan over its segment of the staging array — the only
-            # per-region ops are async dispatches, not transfers.
-            by_device: dict = {}
-            for region_id, spans in round_spans.items():
-                dev = regions[region_id]["device"]
-                by_device.setdefault(dev, []).append((region_id, spans))
-            for dev, entries in by_device.items():
-                layouts = []  # (region_id, start_row, m_padded, spans)
-                total = 0
-                for region_id, spans in entries:
-                    m_padded = _pow2_at_least(len(spans))
-                    layouts.append((region_id, total, m_padded, spans))
-                    total += m_padded
-                entry = self._staging_entry(dev)
-                with entry["lock"]:
-                    flat, slot = self._staging_for(entry, total, P)  # pad rows unused
-                    meta = np.zeros((3, total), dtype=np.int32)  # idx / v0 / v1
-                    for region_id, start, m_padded, spans in layouts:
-                        # Padding rows carry an out-of-bounds page index so
-                        # the scatter drops them (mode='drop').
-                        meta[0, start : start + m_padded] = regions[region_id]["n_pages"]
-                        for k, (page_idx, a, b, src) in enumerate(spans):
-                            row = start + k
-                            meta[0, row] = page_idx
-                            meta[1, row] = a
-                            meta[2, row] = b
-                            flat[row, a:b] = src
-                    dev_flat = jax.device_put(flat, dev)
-                    dev_meta = jax.device_put(meta, dev)
-                    for region_id, start, m_padded, _spans in layouts:
-                        region = regions[region_id]
-                        if len(layouts) == 1:
-                            pages, pmeta = dev_flat, dev_meta  # no slicing dispatches
-                        else:
-                            pages = jax.lax.dynamic_slice_in_dim(dev_flat, start, m_padded,
-                                                                 axis=0)
-                            pmeta = jax.lax.dynamic_slice(dev_meta, (0, start), (3, m_padded))
-                        with region["lock"]:
-                            region["buf"] = self._write_fn(region["buf"], pages, pmeta)
-                            slot["fences"].append(self._fence_fn(region["buf"]))
-                        with self._lock:
-                            if region_id in self._regions:
-                                self._dirty.add(region_id)
+        futures: list = []
+        try:
+            for round_spans in rounds:
+                # Group regions by device; per device, build ONE flat (M, P)
+                # host staging array covering every region's (padded) pages
+                # and move it with ONE device_put on the device's dispatcher
+                # thread (blocking H2D there overlaps the caller filling the
+                # next round — same pipeline as the aligned path). Each
+                # region then runs one donated scan over its segment of the
+                # staging array.
+                by_device: dict = {}
+                for region_id, spans in round_spans.items():
+                    dev = regions[region_id]["device"]
+                    by_device.setdefault(dev, []).append((region_id, spans))
+                for dev, entries in by_device.items():
+                    layouts = []  # (region_id, start_row, m_padded, spans)
+                    total = 0
+                    for region_id, spans in entries:
+                        m_padded = _pow2_at_least(len(spans))
+                        layouts.append((region_id, total, m_padded, spans))
+                        total += m_padded
+                    entry = self._staging_entry(dev)
+                    with entry["lock"]:
+                        flat, slot = self._staging_for(entry, total, P)  # pad rows unused
+                        meta = np.zeros((3, total), dtype=np.int32)  # idx / v0 / v1
+                        for region_id, start, m_padded, spans in layouts:
+                            # Padding rows carry an out-of-bounds page index
+                            # so the scatter drops them (mode='drop').
+                            meta[0, start : start + m_padded] = (
+                                regions[region_id]["n_pages"])
+                            for k, (page_idx, a, b, src) in enumerate(spans):
+                                row = start + k
+                                meta[0, row] = page_idx
+                                meta[1, row] = a
+                                meta[2, row] = b
+                                flat[row, a:b] = src
+
+                        self._dispatch(
+                            entry, slot,
+                            lambda flat=flat, slot=slot, meta=meta, dev=dev,
+                                   layouts=layouts: self._run_device_round(
+                                flat, meta, dev, layouts, slot, regions),
+                            futures)
+        finally:
+            self._join_dispatches(futures)
 
     # -- batched read ------------------------------------------------------
 
@@ -1060,4 +1142,5 @@ class JaxHbmProvider:
         for entry in entries:
             with entry["lock"]:
                 for slot in entry["slots"]:
+                    self._join_pending(slot)  # fence list complete after this
                     self._await_fences(slot)

@@ -106,6 +106,7 @@ def _load() -> ctypes.CDLL:
         "btpu_exists": (i32, [c, ctypes.c_char_p, ctypes.POINTER(i32)]),
         "btpu_remove": (i32, [c, ctypes.c_char_p]),
         "btpu_stats": (i32, [c, ctypes.POINTER(u64)]),
+        "btpu_pvm_op_count": (u64, []),
         "btpu_error_name": (ctypes.c_char_p, [i32]),
         "btpu_register_hbm_provider_v3": (None, [ctypes.c_void_p]),
         "btpu_placements_json": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64,

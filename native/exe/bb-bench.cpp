@@ -476,5 +476,12 @@ int main(int argc, char** argv) {
         (unsigned long long)kc.slot_commits.load(),
         (unsigned long long)kc.inline_puts.load());
   }
+  // Which data lane moved the bytes? pvm = same-host one-sided
+  // process_vm_readv/writev (zero worker CPU); staged = shm-staged TCP.
+  if (json) {
+    std::printf("{\"op\": \"lanes\", \"pvm_ops\": %llu, \"staged_ops\": %llu}\n",
+                (unsigned long long)transport::pvm_op_count(),
+                (unsigned long long)transport::tcp_staged_op_count());
+  }
   return 0;
 }

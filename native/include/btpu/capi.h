@@ -97,6 +97,11 @@ int32_t btpu_placements_json(btpu_client* client, const char* key, char* buffer,
  * off the live worker then retires it; out_moved = shards migrated. */
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved);
 
+// Process-global count of data-plane ops completed over the same-host
+// one-sided PVM lane (process_vm_readv/writev). Diagnostics: benches and
+// tests assert the lane engages.
+uint64_t btpu_pvm_op_count(void);
+
 /* ---- client-driven device fabric (runtime-owning clients) ----------------
  * A client that owns a JAX runtime moves device-tier bytes itself over the
  * transfer fabric instead of the worker's staged host lane:

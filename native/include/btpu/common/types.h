@@ -118,6 +118,15 @@ struct RemoteDescriptor {
   // fabric-pull/offer shards directly (jax.experimental.transfer) instead
   // of staging through the worker's host lane. Wire-append-only.
   std::string fabric_addr;
+  // Same-host one-sided lane ("" = none): "bootid:pid:starttime:base:len"
+  // (hex base/len) naming the serving process and the region's virtual
+  // base. A client on the SAME boot reads/writes the bytes itself with
+  // process_vm_readv/writev — one kernel copy, zero worker CPU, no socket
+  // — the reference's ucp_get_nbx one-sided principle for host-addressable
+  // tiers across processes (pvm_transport.cpp). Clients elsewhere (or on a
+  // stack where the syscall is denied) fall back to the primary transport
+  // above. Wire-append-only.
+  std::string pvm_endpoint;
 
   bool operator==(const RemoteDescriptor&) const = default;
 };

@@ -227,10 +227,13 @@ inline void encode(Writer& w, const TopoCoord& t) { encode_struct(w, t.slice_id,
 inline bool decode(Reader& r, TopoCoord& t) { return decode_struct(r, t.slice_id, t.host_id, t.chip_id); }
 
 inline void encode(Writer& w, const RemoteDescriptor& d) {
-  encode_struct(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr);
+  encode_struct(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr,
+                d.pvm_endpoint);
 }
 inline bool decode(Reader& r, RemoteDescriptor& d) {
-  return decode_struct(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr);
+  // `pvm_endpoint` appended after fabric_addr; old frames leave it "".
+  return decode_struct(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr,
+                       d.pvm_endpoint);
 }
 
 inline void encode(Writer& w, const MemoryLocation& m) { encode_struct(w, m.remote_addr, m.rkey, m.size); }

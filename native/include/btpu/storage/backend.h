@@ -90,6 +90,13 @@ class StorageBackend {
   // read_at/write_at instead.
   virtual void* base_address() const = 0;
 
+  // Stable CPU-addressable alias of the region for tiers whose primary
+  // store is NOT host memory (HBM provider v5 host-view mode); nullptr
+  // otherwise. Valid for the region's whole life when non-null — the
+  // worker advertises it on the same-host one-sided PVM lane. Tiers with a
+  // real base_address() don't need this (the base itself is advertised).
+  virtual void* host_view_base() const { return nullptr; }
+
   virtual ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) = 0;
   virtual ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) = 0;
 

@@ -221,4 +221,23 @@ std::string rkey_to_hex(uint64_t rkey);
 // shm-staged TCP lane (diagnostics: benches + tests assert the lane engages).
 uint64_t tcp_staged_op_count() noexcept;
 
+// PVM lane (same-host one-sided via process_vm_readv/writev — see
+// pvm_transport.cpp). Workers advertise `pvm_make_endpoint(base, len)` on
+// every host-addressable region; the client mux calls `pvm_access` first
+// and falls back to the primary transport when it returns false (other
+// host, dead/restarted pid, denied syscall, out-of-window address).
+// `writable=false` marks regions whose backing pointer the server may swap
+// (HBM host views): clients then one-sided READ only — writes take the
+// staged path, which revalidates through the provider.
+std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable = true);
+// Names another live process's region (tests; the serving process normally
+// advertises itself via pvm_make_endpoint).
+std::string pvm_make_endpoint_for_pid(long pid, const void* base, uint64_t len,
+                                      bool writable = true);
+bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf, uint64_t len,
+                bool is_write, uint32_t* crc_out);
+// Ops this process completed over the PVM lane (diagnostics, like
+// tcp_staged_op_count).
+uint64_t pvm_op_count() noexcept;
+
 }  // namespace btpu::transport

@@ -5,6 +5,7 @@
 
 #include "btpu/client/embedded.h"
 #include "btpu/common/log.h"
+#include "btpu/transport/transport.h"
 
 using namespace btpu;
 
@@ -299,6 +300,8 @@ int32_t btpu_stats(btpu_client* client, uint64_t out[5]) {
   out[4] = stats.value().used_capacity;
   return 0;
 }
+
+uint64_t btpu_pvm_op_count(void) { return transport::pvm_op_count(); }
 
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved) {
   if (!client || !worker_id) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);

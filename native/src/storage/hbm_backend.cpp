@@ -239,6 +239,13 @@ class HbmBackend : public OffsetBackendBase {
     return host_view_.load(std::memory_order_acquire);
   }
 
+  // PVM-lane advertisement (backend.h): the view is the region buffer
+  // itself (never donated in host-view mode), stable until the region is
+  // freed — a provider SWAP invalidates it, which the worker host never
+  // does mid-life; clients behind a swap are caught by the verified-read
+  // CRC gate like any stale one-sided read.
+  void* host_view_base() const override { return active_ ? host_view() : nullptr; }
+
   ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
     if (!active_) return ErrorCode::INVALID_STATE;
     if (len > config_.capacity || offset > config_.capacity - len)

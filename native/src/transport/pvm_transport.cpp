@@ -1,0 +1,276 @@
+// PVM lane: same-host one-sided reads/writes with process_vm_readv/writev.
+//
+// The reference's defining data-plane property is that clients move bytes
+// themselves with one-sided RMA — the worker is not scheduled per op
+// (/root/reference/src/client/blackbird_client.cpp:276-343 `ucp_get_nbx`
+// straight into registered worker memory). For two processes on one host,
+// Linux has that primitive natively: process_vm_readv/writev copy between
+// address spaces in ONE kernel pass, no socket, no shared segment, no
+// serving thread. Every host-addressable pool (ram/cxl/mmap tiers, and
+// device tiers in host-view mode) advertises a `pvm_endpoint` alongside its
+// primary transport:
+//
+//     bootid:pid:starttime:base:len        (base/len hex)
+//
+// A client whose /proc boot_id matches attempts the syscall after verifying
+// the pid is alive with the SAME start time (pid reuse across worker
+// restarts cannot alias — starttime is in clock ticks since that boot).
+// Everything else — other hosts, dead pids, denied syscalls (YAMA), partial
+// copies — falls back to the primary transport per op, so the lane is a
+// pure upgrade and never a liveness dependency.
+//
+// Trust model: identical to the shm segment and the reference's packed
+// rkeys — same-uid processes on one host already share a trust domain (a
+// same-uid peer can ptrace). Bounds are enforced client-side against the
+// advertised [base, base+len) window; the staged lane's worker-side rkey
+// check still guards every fallback op.
+//
+// Consistency: one-sided reads racing frees/repair follow the same modeled
+// RMA contract as the LOCAL/SHM lanes (see local_transport.cpp) — stale
+// bytes are discarded behind epoch re-checks or the CRC gate.
+
+#include <atomic>
+#include <cctype>
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <sys/uio.h>
+#include <unistd.h>
+#include <unordered_map>
+
+#include "btpu/common/error.h"
+#include "btpu/common/log.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::transport {
+
+namespace {
+
+std::atomic<uint64_t> g_pvm_ops{0};
+
+// This boot's id, hex-ish token with dashes stripped (matches endpoint form).
+std::string local_boot_id() {
+  static const std::string id = [] {
+    std::string out;
+    if (FILE* f = std::fopen("/proc/sys/kernel/random/boot_id", "r")) {
+      char buf[64] = {};
+      if (std::fgets(buf, sizeof(buf), f)) {
+        for (const char* p = buf; *p; ++p)
+          if (std::isxdigit(static_cast<unsigned char>(*p))) out.push_back(*p);
+      }
+      std::fclose(f);
+    }
+    return out;
+  }();
+  return id;
+}
+
+// starttime: field 22 of /proc/<pid>/stat, in clock ticks since boot —
+// (pid, starttime) uniquely names a process for the life of a boot.
+bool pid_starttime(long pid, unsigned long long& out) {
+  char path[64];
+  std::snprintf(path, sizeof(path), "/proc/%ld/stat", pid);
+  FILE* f = std::fopen(path, "r");
+  if (!f) return false;
+  char buf[1024] = {};
+  const size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  if (n == 0) return false;
+  // comm (field 2) may contain spaces/parens: scan from the LAST ')'.
+  const char* p = std::strrchr(buf, ')');
+  if (!p) return false;
+  ++p;
+  for (int field = 3; field < 22; ++field) {
+    p = std::strchr(p + 1, ' ');
+    if (!p) return false;
+  }
+  return std::sscanf(p, " %llu", &out) == 1;
+}
+
+struct PvmTarget {
+  long pid{0};
+  uint64_t base{0};
+  uint64_t len{0};
+  bool writable{true};
+};
+
+// Endpoint validation cache. `valid` entries are re-checked for liveness
+// every couple seconds (a restarted worker re-advertises a NEW endpoint
+// string, so a stale entry only ever turns dead, never wrong); failed
+// entries are remembered so an off-host or denied endpoint costs one parse,
+// not a /proc probe per op.
+struct CacheEntry {
+  bool usable{false};
+  PvmTarget target;
+  unsigned long long starttime{0};
+  std::chrono::steady_clock::time_point checked;
+};
+
+std::mutex g_cache_mutex;
+std::unordered_map<std::string, CacheEntry> g_cache;
+
+bool parse_endpoint(const std::string& ep, std::string& boot, long& pid,
+                    unsigned long long& starttime, uint64_t& base, uint64_t& len,
+                    bool& writable) {
+  // bootid:pid:starttime:base:len[:ro] (base/len hex). The optional mode
+  // token marks regions whose backing pointer the serving process may swap
+  // (HBM host views behind a provider re-registration): one-sided READS of
+  // a stale pointer are caught by the verified-read CRC gate, but a WRITE
+  // would corrupt whatever now lives at the old address — so those regions
+  // take the staged write path, which revalidates through the provider.
+  size_t a = ep.find(':');
+  if (a == std::string::npos) return false;
+  size_t b = ep.find(':', a + 1);
+  if (b == std::string::npos) return false;
+  size_t c = ep.find(':', b + 1);
+  if (c == std::string::npos) return false;
+  size_t d = ep.find(':', c + 1);
+  if (d == std::string::npos) return false;
+  const size_t e = ep.find(':', d + 1);
+  try {
+    boot = ep.substr(0, a);
+    pid = std::stol(ep.substr(a + 1, b - a - 1));
+    starttime = std::stoull(ep.substr(b + 1, c - b - 1));
+    base = std::stoull(ep.substr(c + 1, d - c - 1), nullptr, 16);
+    len = std::stoull(ep.substr(d + 1, e == std::string::npos ? std::string::npos
+                                                              : e - d - 1),
+                      nullptr, 16);
+    writable = e == std::string::npos || ep.substr(e + 1) != "ro";
+  } catch (...) {
+    return false;
+  }
+  return pid > 0 && len > 0;
+}
+
+// Resolves an endpoint to a live same-boot target, through the cache.
+bool resolve(const std::string& ep, PvmTarget& out) {
+  static const bool disabled = [] {
+    const char* env = std::getenv("BTPU_PVM");
+    return env && std::strcmp(env, "0") == 0;
+  }();
+  if (disabled) return false;
+  const auto now = std::chrono::steady_clock::now();
+  {
+    std::lock_guard<std::mutex> lock(g_cache_mutex);
+    auto it = g_cache.find(ep);
+    if (it != g_cache.end()) {
+      // Negative entries retry after a beat: a transient failure (EPERM
+      // from a sandbox change, partial copy during teardown) should not
+      // condemn the lane forever, but re-probing EVERY op would thrash
+      // /proc on a persistently dead endpoint.
+      if (!it->second.usable) {
+        if (now - it->second.checked < std::chrono::seconds(5)) return false;
+        g_cache.erase(it);  // stale negative: fall through and re-resolve
+      } else if (now - it->second.checked < std::chrono::seconds(2)) {
+        out = it->second.target;
+        return true;
+      }
+      // Revalidate liveness below (same pid must still carry the same
+      // starttime); fall through without holding the lock.
+    }
+  }
+  std::string boot;
+  long pid = 0;
+  unsigned long long starttime = 0;
+  uint64_t base = 0, len = 0;
+  bool writable = true;
+  CacheEntry entry;
+  entry.checked = now;
+  // Own-process regions are excluded: the in-process LOCAL lane is a plain
+  // memcpy, strictly cheaper than a self-targeted process_vm syscall.
+  if (parse_endpoint(ep, boot, pid, starttime, base, len, writable) &&
+      pid != ::getpid() && boot == local_boot_id() && !local_boot_id().empty()) {
+    unsigned long long live_start = 0;
+    if (pid_starttime(pid, live_start) && live_start == starttime) {
+      entry.usable = true;
+      entry.target = {pid, base, len, writable};
+      entry.starttime = starttime;
+    }
+  }
+  std::lock_guard<std::mutex> lock(g_cache_mutex);
+  // Bound the cache: every worker restart mints a fresh endpoint string per
+  // pool, so a long-lived client would otherwise accumulate dead entries
+  // forever. Unusable entries are pure negatives — safe to drop wholesale.
+  if (g_cache.size() >= 256) {
+    for (auto it = g_cache.begin(); it != g_cache.end();)
+      it = it->second.usable ? std::next(it) : g_cache.erase(it);
+  }
+  g_cache[ep] = entry;
+  if (entry.usable) out = entry.target;
+  return entry.usable;
+}
+
+void invalidate(const std::string& ep) {
+  // A negative entry (not an erase): the 5 s backoff in resolve() keeps a
+  // persistently failing endpoint from re-probing /proc on every op.
+  std::lock_guard<std::mutex> lock(g_cache_mutex);
+  CacheEntry entry;
+  entry.checked = std::chrono::steady_clock::now();
+  g_cache[ep] = entry;
+}
+
+}  // namespace
+
+std::string pvm_make_endpoint_for_pid(long pid, const void* base, uint64_t len,
+                                      bool writable) {
+  const std::string boot = local_boot_id();
+  if (boot.empty() || base == nullptr || len == 0) return "";
+  unsigned long long starttime = 0;
+  if (!pid_starttime(pid, starttime)) return "";
+  char buf[160];
+  std::snprintf(buf, sizeof(buf), "%s:%ld:%llu:%llx:%llx%s", boot.c_str(), pid, starttime,
+                static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(base)),
+                static_cast<unsigned long long>(len), writable ? "" : ":ro");
+  return buf;
+}
+
+std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable) {
+  return pvm_make_endpoint_for_pid(::getpid(), base, len, writable);
+}
+
+bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf, uint64_t len,
+                bool is_write, uint32_t* crc_out) {
+  if (remote.pvm_endpoint.empty() || len == 0) return false;
+  PvmTarget target;
+  if (!resolve(remote.pvm_endpoint, target)) return false;
+  if (is_write && !target.writable) return false;  // :ro region (see parse)
+  // remote_addr lives in the REGISTERED region's address space; translate
+  // through the descriptor's base to an offset, then bounds-check against
+  // the advertised window.
+  const uint64_t off = remote_addr - remote.remote_base;
+  if (remote_addr < remote.remote_base || off > target.len || len > target.len - off)
+    return false;
+  struct iovec local {
+    buf, static_cast<size_t>(len)
+  };
+  struct iovec rem {
+    reinterpret_cast<void*>(static_cast<uintptr_t>(target.base + off)),
+        static_cast<size_t>(len)
+  };
+  const ssize_t got = is_write ? ::process_vm_writev(target.pid, &local, 1, &rem, 1, 0)
+                               : ::process_vm_readv(target.pid, &local, 1, &rem, 1, 0);
+  if (got != static_cast<ssize_t>(len)) {
+    const int err = errno;  // before invalidate(): lock/map ops may clobber
+    // Dead/denied/partial: drop the lane for this endpoint (a partial copy
+    // cannot be resumed — the caller re-runs the whole op on the primary
+    // transport, which is idempotent for one-sided reads AND writes).
+    invalidate(remote.pvm_endpoint);
+    LOG_DEBUG << "pvm lane fell back (" << (got < 0 ? std::strerror(err) : "partial")
+              << "), op re-runs on " << transport_kind_name(remote.transport);
+    return false;
+  }
+  // The kernel did the copy, so the hash is a post-pass over the local
+  // buffer — still one full copy cheaper than the two-copy staged lane.
+  if (crc_out) *crc_out = crc32c(buf, len);
+  g_pvm_ops.fetch_add(1);
+  return true;
+}
+
+uint64_t pvm_op_count() noexcept { return g_pvm_ops.load(); }
+
+}  // namespace btpu::transport

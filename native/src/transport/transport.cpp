@@ -130,6 +130,15 @@ class MuxTransportClient : public TransportClient {
       op.status = ErrorCode::OK;
       if (op.len == 0) continue;
       if (op.remote->transport == TransportKind::TCP) {
+        // Same-host one-sided lane first: the client moves the bytes itself
+        // (one kernel copy, zero worker CPU) instead of the two-copy staged
+        // pipeline. Only TCP descriptors consult it — LOCAL is already an
+        // in-process memcpy and SHM a direct segment copy, both cheaper
+        // than a process_vm syscall. false = op proceeds on the pipeline.
+        if (pvm_access(*op.remote, op.addr, op.buf, op.len, is_write,
+                       op.want_crc ? &op.crc : nullptr)) {
+          continue;
+        }
         tcp_ops.push_back(&op);
         continue;
       }
@@ -164,6 +173,8 @@ class MuxTransportClient : public TransportClient {
       case TransportKind::SHM:
         return shm_access(remote.endpoint, addr, buf, len, is_write, crc_out);
       case TransportKind::TCP: {
+        // Same-host one-sided lane first (see batch()); then the sockets.
+        if (pvm_access(remote, addr, buf, len, is_write, crc_out)) return ErrorCode::OK;
         // The single-op helpers route through tcp_batch, which fills crc
         // for want_crc ops; plain single ops hash post-hoc when asked.
         const ErrorCode ec = is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len)

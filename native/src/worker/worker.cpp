@@ -237,6 +237,22 @@ ErrorCode WorkerService::initialize() {
     // The fabric endpoint rides the remote descriptor too: shards cut from
     // this pool carry it to clients, which can then fabric-pull directly.
     runtime.record.remote.fabric_addr = runtime.record.fabric_addr;
+    // Same-host one-sided PVM lane: any region a same-boot client could
+    // reach by plain memory copy is advertised for process_vm_readv/writev
+    // — the client moves the bytes itself, this worker is never scheduled.
+    // Covers flat host tiers (base, read-write) and host-viewed device
+    // regions (READ-ONLY: the view pointer is provider-generation-dependent,
+    // and a one-sided write through a stale pointer would corrupt whatever
+    // replaced it — reads are CRC-gated, so they stay one-sided). Only
+    // MemoryLocation placements consult it (device-mesh DeviceLocation
+    // pools address the provider instead).
+    if (base) {
+      runtime.record.remote.pvm_endpoint =
+          transport::pvm_make_endpoint(base, pool_cfg.capacity, /*writable=*/true);
+    } else if (const void* view = runtime.backend->host_view_base()) {
+      runtime.record.remote.pvm_endpoint =
+          transport::pvm_make_endpoint(view, pool_cfg.capacity, /*writable=*/false);
+    }
     runtime.record.topo = config_.topo;
     // HBM placements default to provider-chunk alignment so whole shards
     // map to whole device chunks (single transfer, no read-modify-write).

@@ -1374,3 +1374,49 @@ service_refresh_interval_sec: 1
             not o["key"].startswith("fence/stale-") for o in listed)
     finally:
         teardown(procs, timeout=5)
+
+
+def test_pvm_lane_serves_cross_process_reads_one_sided(tmp_path):
+    """Same-host one-sided lane (the reference's ucp_get_nbx principle,
+    blackbird_client.cpp:276-343): a separate worker process advertises its
+    pool region for process_vm_readv/writev, and THIS process's client
+    moves the bytes itself — zero worker CPU, no socket payload, no shared
+    segment. Asserts bytes AND that the lane (not the staged fallback)
+    carried them; then proves the fallback stays correct with the lane
+    disabled."""
+    import os
+
+    from blackbird_tpu.procluster import ProcessCluster
+
+    with ProcessCluster(workers=1, devices_per_worker=0, dram_pool_mb=64) as pc:
+        pc.wait_ready(timeout=120)
+
+        import numpy as np
+
+        from blackbird_tpu import Client, StorageClass
+        from blackbird_tpu.native import lib
+
+        client = Client(f"127.0.0.1:{pc.keystone_port}")
+        payload = np.random.default_rng(21).bytes(2 << 20)
+        before = lib.btpu_pvm_op_count()
+        client.put("pvm/a", payload, preferred_class=StorageClass.RAM_CPU)
+        assert client.get("pvm/a") == payload  # verified read (CRC post-pass)
+        assert lib.btpu_pvm_op_count() > before, "ops did not ride the PVM lane"
+
+        # The staged lane still serves the same bytes when PVM is off —
+        # subprocess (the disable is latched per process at first use).
+        code = (
+            "import sys; sys.path.insert(0, %r); "
+            "from blackbird_tpu import Client; from blackbird_tpu.native import lib; "
+            "c = Client('127.0.0.1:%d'); "
+            "assert c.get('pvm/a') == open(%r, 'rb').read(); "
+            "assert lib.btpu_pvm_op_count() == 0; print('staged ok')"
+        )
+        ref = tmp_path / "payload.bin"
+        ref.write_bytes(payload)
+        env = dict(os.environ, BTPU_PVM="0")
+        r = subprocess.run(
+            [sys.executable, "-c", code % (str(REPO_ROOT), pc.keystone_port, str(ref))],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO_ROOT)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "staged ok" in r.stdout

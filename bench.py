@@ -197,16 +197,20 @@ def bench_cross_process(shm_get_gbps: float | None, hbm: bool) -> None:
                     raise RuntimeError(f"bb-bench failed: {result.stderr[-300:]}")
                 for row in map(json.loads, filter(str.strip,
                                                   result.stdout.splitlines())):
-                    if row["op"] not in per_op or row["gbps"] > per_op[row["op"]]["gbps"]:
+                    if (row["op"] not in per_op
+                            or row.get("gbps", 0) > per_op[row["op"]].get("gbps", 0)):
                         per_op[row["op"]] = row
             rows = per_op
         get_gbps = rows["get"]["gbps"]
         vs_shm = (f" ({get_gbps / shm_get_gbps * 100:.0f}% of in-process shm get)"
                   if shm_get_gbps else "")
+        lanes = rows.get("lanes", {})
+        lane_note = (f" | lanes: pvm {lanes.get('pvm_ops', 0)} / staged "
+                     f"{lanes.get('staged_ops', 0)}" if lanes else "")
         print(
-            f"cross-process worker {label}, staged lane, 1MiB: "
+            f"cross-process worker {label}, 1MiB: "
             f"put {rows['put']['gbps']:.2f} GB/s | get {get_gbps:.2f} GB/s"
-            f"{vs_shm} | get p50 {rows['get']['p50_us']:.0f}us",
+            f"{vs_shm} | get p50 {rows['get']['p50_us']:.0f}us{lane_note}",
             file=sys.stderr,
         )
     except Exception as exc:  # secondary metric: never break the contract

@@ -603,6 +603,45 @@ def main() -> int:
         )
     except Exception as exc:
         print(f"concurrency rows skipped: {exc}", file=sys.stderr)
+    # Multi-PROCESS clients against a real worker process — the production
+    # concurrency shape (N consumers on one TPU-VM host). Each client is a
+    # whole bb-bench process with its own key namespace (--prefix); on the
+    # PVM lane every client copies its own bytes, so aggregate throughput
+    # holds where the in-process threaded row (above) pays lock-holder
+    # preemption.
+    try:
+        from blackbird_tpu.procluster import ProcessCluster
+
+        def spawn_clients(pc, n, iters):
+            procs = [subprocess.Popen(
+                [str(binary), "--keystone", f"127.0.0.1:{pc.keystone_port}",
+                 "--size", str(64 << 10), "--iterations", str(iters),
+                 "--prefix", f"mp{n}c{i}", "--max-workers", "1", "--json"],
+                stdout=subprocess.PIPE, text=True, cwd=REPO_ROOT) for i in range(n)]
+            agg = {"put": 0.0, "get": 0.0}
+            for p in procs:
+                if p.wait() != 0:
+                    raise RuntimeError("client process failed")
+                for line in p.stdout.read().splitlines():
+                    row = json.loads(line)
+                    if row["op"] in agg:
+                        agg[row["op"]] += row["gbps"]
+            return agg
+
+        with ProcessCluster(workers=1, devices_per_worker=0, dram_pool_mb=256) as pc:
+            pc.wait_ready(timeout=300)
+            one = spawn_clients(pc, 1, 400)
+            four = spawn_clients(pc, 4, 400)
+        print(
+            f"4-process clients 64KiB vs 1 (pvm lane, aggregate): "
+            f"put {one['put']:.2f} -> {four['put']:.2f} GB/s "
+            f"({four['put'] / one['put'] * 100:.0f}% retained) | "
+            f"get {one['get']:.2f} -> {four['get']:.2f} GB/s "
+            f"({four['get'] / one['get'] * 100:.0f}% retained)",
+            file=sys.stderr,
+        )
+    except Exception as exc:
+        print(f"multi-process client row skipped: {exc}", file=sys.stderr)
     # Client-driven fabric row (VERDICT r4 item 1): runs in a time-boxed
     # child with a CPU-pinned runtime (the sitecustomize TPU plugin would
     # otherwise force the tunneled platform and can hang when it is sick).

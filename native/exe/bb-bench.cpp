@@ -66,6 +66,7 @@ int main(int argc, char** argv) {
   bool control_plane = false;  // metadata ops/sec closed loop, no data plane
   int batch = 0;  // >0: measure put_many/get_many over `batch` objects per op
   int threads = 1;  // >1: concurrent clients, each its own connection
+  std::string prefix = "bench";  // key namespace (multi-process runs pass distinct ones)
 
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--keystone") && i + 1 < argc) keystone = argv[++i];
@@ -86,6 +87,8 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--threads") && i + 1 < argc)
       threads = std::max(1, std::stoi(argv[++i]));
+    else if (!std::strcmp(argv[i], "--prefix") && i + 1 < argc)
+      prefix = argv[++i];  // key namespace: lets N bb-bench PROCESSES share a cluster
     else if (!std::strcmp(argv[i], "--control-plane")) control_plane = true;
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
       const std::string km = argv[++i];
@@ -208,7 +211,7 @@ int main(int argc, char** argv) {
         auto& c = *worker_clients[t];
         for (int it = 0; it < iterations && !failed.load(); ++it) {
           const std::string key =
-              "bench/meta/" + std::to_string(t) + "/" + std::to_string(it);
+              prefix + "/meta/" + std::to_string(t) + "/" + std::to_string(it);
           auto t0 = Clock::now();
           auto placed = c.put_start(key, size, wc);
           if (!placed.ok() || !c.get_workers(key).ok() ||
@@ -271,7 +274,7 @@ int main(int argc, char** argv) {
             auto& c = *worker_clients[t];
             std::vector<uint8_t> readback(sz);
             for (int it = 0; it < iterations && !failed.load(); ++it) {
-              const std::string key = "bench/mt/" + std::to_string(t) + "/" +
+              const std::string key = prefix + "/mt/" + std::to_string(t) + "/" +
                                       std::to_string(sz) + "/" + std::to_string(it);
               auto t0 = Clock::now();
               if (is_put) {
@@ -323,7 +326,7 @@ int main(int argc, char** argv) {
       }
       for (int t = 0; t < threads; ++t) {
         for (int it = 0; it < iterations; ++it) {
-          worker_clients[t]->remove("bench/mt/" + std::to_string(t) + "/" +
+          worker_clients[t]->remove(prefix + "/mt/" + std::to_string(t) + "/" +
                                     std::to_string(sz) + "/" + std::to_string(it));
         }
       }
@@ -347,7 +350,7 @@ int main(int argc, char** argv) {
         std::vector<client::ObjectClient::GetItem> gets;
         std::vector<ObjectKey> keys;
         for (int j = 0; j < batch; ++j) {
-          keys.push_back("bench/batch/" + std::to_string(it + warmup) + "/" +
+          keys.push_back(prefix + "/batch/" + std::to_string(it + warmup) + "/" +
                          std::to_string(j));
           puts.push_back({keys.back(), data.data(), sz});
           gets.push_back({keys.back(), readbacks[j].data(), sz});
@@ -391,7 +394,7 @@ int main(int argc, char** argv) {
     OpStats put_stats, get_stats;
     int warmup = std::max(1, iterations / 10);
     for (int it = -warmup; it < iterations; ++it) {
-      const std::string key = "bench/" + std::to_string(sz) + "/" + std::to_string(it + warmup);
+      const std::string key = prefix + "/" + std::to_string(sz) + "/" + std::to_string(it + warmup);
       auto t0 = Clock::now();
       if (auto ec = client.put(key, data.data(), sz, wc); ec != ErrorCode::OK) {
         std::fprintf(stderr, "put failed: %s\n", std::string(to_string(ec)).c_str());
@@ -435,7 +438,7 @@ int main(int argc, char** argv) {
       } else {
         copts.set_keystone_endpoints(keystone);
       }
-      const std::string rkey_name = "bench/repeat/" + std::to_string(sz);
+      const std::string rkey_name = prefix + "/repeat/" + std::to_string(sz);
       if (auto ec = client.put(rkey_name, data.data(), sz, wc); ec != ErrorCode::OK) {
         std::fprintf(stderr, "repeat-row put failed: %s\n",
                      std::string(to_string(ec)).c_str());

@@ -1420,3 +1420,30 @@ def test_pvm_lane_serves_cross_process_reads_one_sided(tmp_path):
             capture_output=True, text=True, timeout=120, env=env, cwd=REPO_ROOT)
         assert r.returncode == 0, r.stderr[-500:]
         assert "staged ok" in r.stdout
+
+
+def test_pvm_lane_striped_across_two_worker_processes(tmp_path):
+    """A striped object (max_workers=2) whose shards live in TWO separate
+    worker processes: the client one-sided-reads each shard from its owning
+    process over the PVM lane, and the reassembled object is byte-correct
+    (the remote_base translation is per-descriptor, so shard offsets must
+    land in the right process's window)."""
+    from blackbird_tpu.procluster import ProcessCluster
+
+    with ProcessCluster(workers=2, devices_per_worker=0, dram_pool_mb=64) as pc:
+        pc.wait_ready(timeout=120)
+
+        import numpy as np
+
+        from blackbird_tpu import Client, StorageClass
+        from blackbird_tpu.native import lib
+
+        client = Client(f"127.0.0.1:{pc.keystone_port}")
+        payload = np.random.default_rng(33).bytes(4 << 20)
+        client.put("pvm/striped", payload, max_workers=2,
+                   preferred_class=StorageClass.RAM_CPU)
+        shards = client.placements("pvm/striped")[0]["shards"]
+        assert len({s["worker"] for s in shards}) == 2, "object did not stripe"
+        before = lib.btpu_pvm_op_count()
+        assert client.get("pvm/striped") == payload
+        assert lib.btpu_pvm_op_count() >= before + 2, "shards did not ride PVM"

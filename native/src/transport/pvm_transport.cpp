@@ -19,6 +19,13 @@
 // copies — falls back to the primary transport per op, so the lane is a
 // pure upgrade and never a liveness dependency.
 //
+// Pid namespaces: a client in a DIFFERENT pid namespace (sibling container)
+// resolves the advertised pid to an unrelated process, which the starttime
+// check rejects in all but an astronomically unlikely same-tick collision —
+// and verified reads would still CRC-gate such bytes. Deployments that want
+// the lane across containers must share the pid namespace (and run same-
+// uid); otherwise those clients simply stay on the staged lane.
+//
 // Trust model: identical to the shm segment and the reference's packed
 // rkeys — same-uid processes on one host already share a trust domain (a
 // same-uid peer can ptrace). Bounds are enforced client-side against the

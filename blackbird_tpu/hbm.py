@@ -45,6 +45,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+from functools import partial
 import warnings
 
 import numpy as np
@@ -429,6 +430,19 @@ class JaxHbmProvider:
             buf = slot["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
         return buf[:rows], slot
 
+    def _run_single_round(self, flat, slot, region, region_id, p0, n,
+                          m_padded) -> None:
+        """Dispatcher-thread body for the single-region single-run fast path
+        (no meta array: the scatter index is p0 + arange on device)."""
+        dev_flat = self._jax.device_put(flat, region["device"])
+        with region["lock"]:
+            region["buf"] = self._write_run_fn(m_padded)(
+                region["buf"], dev_flat, np.int32(p0), np.int32(n))
+            slot["fences"].append(self._fence_fn(region["buf"]))
+        with self._lock:
+            if region_id in self._regions:
+                self._dirty.add(region_id)
+
     def _run_device_round(self, flat, meta, dev, layouts, slot, regions) -> None:
         """Dispatcher-thread body shared by the aligned and generic write
         paths: ONE H2D of the filled staging segment + metadata, then each
@@ -574,20 +588,11 @@ class JaxHbmProvider:
                 with entry["lock"]:
                     flat, slot = self._staging_for(entry, m_padded, P)
                     flat[:n] = host.reshape(n, P)
-
-                    def run_single(flat=flat, slot=slot, region=region,
-                                   region_id=region_id, p0=p0, n=n,
-                                   m_padded=m_padded):
-                        dev_flat = jax.device_put(flat, region["device"])
-                        with region["lock"]:
-                            region["buf"] = self._write_run_fn(m_padded)(
-                                region["buf"], dev_flat, np.int32(p0), np.int32(n))
-                            slot["fences"].append(self._fence_fn(region["buf"]))
-                        with self._lock:
-                            if region_id in self._regions:
-                                self._dirty.add(region_id)
-
-                    self._dispatch(entry, slot, run_single, futures)
+                    self._dispatch(
+                        entry, slot,
+                        partial(self._run_single_round, flat, slot, region,
+                                region_id, p0, n, m_padded),
+                        futures)
                 return
         by_device: dict = {}
         for region_id, runs in per_region.items():
@@ -617,9 +622,8 @@ class JaxHbmProvider:
 
                 self._dispatch(
                     entry, slot,
-                    lambda flat=flat, slot=slot, meta=meta, dev=dev,
-                           layouts=layouts: self._run_device_round(
-                        flat, meta, dev, layouts, slot, regions),
+                    partial(self._run_device_round, flat, meta, dev, layouts,
+                            slot, regions),
                     futures)
 
     # -- host-view fast path -----------------------------------------------
@@ -752,9 +756,8 @@ class JaxHbmProvider:
 
                         self._dispatch(
                             entry, slot,
-                            lambda flat=flat, slot=slot, meta=meta, dev=dev,
-                                   layouts=layouts: self._run_device_round(
-                                flat, meta, dev, layouts, slot, regions),
+                            partial(self._run_device_round, flat, meta, dev,
+                                    layouts, slot, regions),
                             futures)
         finally:
             self._join_dispatches(futures)

@@ -6,6 +6,7 @@
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/keystone/keystone.h"
+#include "btpu/transport/transport.h"
 
 namespace btpu::rpc {
 
@@ -60,6 +61,10 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_fabric_moves_total",
           "cross-process device moves over the device fabric (vs host lane)",
           c.fabric_moves.load());
+  counter("btpu_pvm_ops_total",
+          "data-plane ops THIS process completed over the same-host one-sided "
+          "PVM lane (keystone-side: repair/demotion/drain byte moves)",
+          static_cast<uint64_t>(transport::pvm_op_count()));
   counter("btpu_objects_offline_total",
           "objects spared from loss: bytes persist on a dead worker's file-backed pools",
           c.objects_offline.load());

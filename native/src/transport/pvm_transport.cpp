@@ -162,6 +162,26 @@ bool resolve(const std::string& ep, PvmTarget& out) {
   }();
   if (disabled) return false;
   const auto now = std::chrono::steady_clock::now();
+  // Per-thread positive cache: the data-path common case (hot endpoint,
+  // checked within the liveness window) touches no shared state at all.
+  // Staleness is bounded by the same 2 s the global entries carry — a
+  // thread holding a just-died endpoint wastes at most one syscall, which
+  // fails cleanly and falls back (invalidate() fixes the GLOBAL map; this
+  // thread's copy ages out on its own clock).
+  struct TlEntry {
+    PvmTarget target;
+    std::chrono::steady_clock::time_point checked;
+  };
+  thread_local std::unordered_map<std::string, TlEntry> tl_cache;
+  if (auto it = tl_cache.find(ep); it != tl_cache.end()) {
+    if (now - it->second.checked < std::chrono::seconds(2)) {
+      out = it->second.target;
+      return true;
+    }
+    tl_cache.erase(it);
+    if (tl_cache.size() >= 64)  // worker restarts mint new strings
+      tl_cache.clear();
+  }
   {
     std::lock_guard<std::mutex> lock(g_cache_mutex);
     auto it = g_cache.find(ep);
@@ -175,6 +195,7 @@ bool resolve(const std::string& ep, PvmTarget& out) {
         g_cache.erase(it);  // stale negative: fall through and re-resolve
       } else if (now - it->second.checked < std::chrono::seconds(2)) {
         out = it->second.target;
+        tl_cache[ep] = {it->second.target, it->second.checked};
         return true;
       }
       // Revalidate liveness below (same pid must still carry the same
@@ -208,7 +229,10 @@ bool resolve(const std::string& ep, PvmTarget& out) {
       it = it->second.usable ? std::next(it) : g_cache.erase(it);
   }
   g_cache[ep] = entry;
-  if (entry.usable) out = entry.target;
+  if (entry.usable) {
+    out = entry.target;
+    tl_cache[ep] = {entry.target, now};
+  }
   return entry.usable;
 }
 

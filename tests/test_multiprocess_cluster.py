@@ -1447,3 +1447,110 @@ def test_pvm_lane_striped_across_two_worker_processes(tmp_path):
         before = lib.btpu_pvm_op_count()
         assert client.get("pvm/striped") == payload
         assert lib.btpu_pvm_op_count() >= before + 2, "shards did not ride PVM"
+
+
+def test_pvm_soak_concurrent_clients_survive_worker_churn(tmp_path):
+    """Process-level chaos for the one-sided lane (bb-soak runs in ONE
+    process, where PVM never engages): two CLIENT PROCESSES hammer
+    replicated put/verified-get/remove loops over PVM while a worker is
+    SIGKILLed mid-stream and a replacement joins. Every key a client
+    reported stored must read back byte-correct at the end — mid-op
+    endpoint death must fall back, never corrupt — and the lane must have
+    actually carried ops in both clients."""
+    coord_port = free_port()
+    keystone_port = free_port()
+    keystone_cfg = tmp_path / "keystone.yaml"
+    keystone_cfg.write_text(
+        f"""cluster_id: pvmsoak
+coord_endpoints: 127.0.0.1:{coord_port}
+listen_address: 127.0.0.1:{keystone_port}
+gc_interval_sec: 1
+health_check_interval_sec: 1
+worker_heartbeat_ttl_sec: 2
+""")
+    procs = []
+    spawn = make_spawner(procs)
+    client_src = r"""
+import sys, time
+sys.path.insert(0, sys.argv[3])
+from blackbird_tpu import Client
+from blackbird_tpu.native import lib
+
+tag, port = sys.argv[1], int(sys.argv[2])
+client = Client(f"127.0.0.1:{port}")
+payload_for = lambda i: bytes([(i * 11 + 3) % 251]) * (48 * 1024 + i)
+from blackbird_tpu.native import BtpuError
+
+stored = []
+verified = 0
+deadline = time.time() + 25
+i = 0
+while time.time() < deadline:
+    key = f"soak/{tag}/{i}"
+    try:
+        client.put(key, payload_for(i), replicas=2, max_workers=1)
+        stored.append(i)
+        if i % 3 == 0 and stored[:-1]:          # verified read of an older key
+            j = stored[len(stored) // 2]
+            try:
+                assert client.get(f"soak/{tag}/{j}") == payload_for(j), j
+                verified += 1
+            except BtpuError:
+                pass  # evicted under watermark pressure: accounted loss
+        if i % 7 == 0 and len(stored) > 4:       # churn the namespace
+            client.remove(f"soak/{tag}/{stored.pop(0)}")
+    except Exception:
+        pass  # churn window: keystone reroutes after the prune
+    i += 1
+    time.sleep(0.01)
+# Final sweep: a key may have been EVICTED (watermark pressure is designed
+# behavior, an accounted loss) — but any key that READS must be
+# byte-correct: mid-op endpoint death must never serve torn bytes.
+for j in stored:
+    try:
+        got = client.get(f"soak/{tag}/{j}")
+    except BtpuError:
+        continue  # evicted
+    assert got == payload_for(j), f"soak/{tag}/{j} corrupted"
+    verified += 1
+print("PVM_OPS", lib.btpu_pvm_op_count())
+print("VERIFIED", verified)
+"""
+    try:
+        spawn([str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port", str(coord_port)],
+              "coord")
+        wait_for(lambda: port_open(coord_port), what="bb-coord")
+        spawn([str(BUILD / "bb-keystone"), "--config", str(keystone_cfg)], "keystone")
+        wait_for(lambda: port_open(keystone_port), what="bb-keystone")
+        workers = []
+        for i in range(3):
+            cfg = write_worker_config(tmp_path, f"pvw-{i}", f"127.0.0.1:{coord_port}",
+                                      cluster_id="pvmsoak")
+            workers.append(spawn([str(BUILD / "bb-worker"), "--config", str(cfg)],
+                                 f"worker-{i}"))
+
+        from blackbird_tpu import Client
+
+        control = Client(f"127.0.0.1:{keystone_port}")
+        wait_for(lambda: control.stats()["workers"] == 3, timeout=15, what="3 workers")
+
+        clients = [subprocess.Popen(
+            [sys.executable, "-c", client_src, tag, str(keystone_port), str(REPO_ROOT)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO_ROOT)
+            for tag in ("a", "b")]
+
+        time.sleep(6)
+        workers[0].kill()  # SIGKILL one worker mid-stream
+        rcfg = write_worker_config(tmp_path, "pvw-new", f"127.0.0.1:{coord_port}",
+                                   cluster_id="pvmsoak")
+        spawn([str(BUILD / "bb-worker"), "--config", str(rcfg)], "worker-new")
+
+        for p in clients:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err[-800:]
+            pvm_ops = int(out.split("PVM_OPS")[1].split()[0])
+            n_verified = int(out.split("VERIFIED")[1].split()[0])
+            assert pvm_ops > 0, "client never rode the PVM lane"
+            assert n_verified > 5, f"client verified too little ({n_verified})"
+    finally:
+        teardown(procs, timeout=5)

@@ -201,6 +201,30 @@ def bench_cross_process(shm_get_gbps: float | None, hbm: bool) -> None:
                             or row.get("gbps", 0) > per_op[row["op"]].get("gbps", 0)):
                         per_op[row["op"]] = row
             rows = per_op
+            # Small-object REMOTE point (host tier only — same for both):
+            # first-gets of <=4 KiB objects ride the INLINE tier, so the
+            # metadata reply carries the bytes and a verified read is one
+            # RPC. r4's weak item was 111 us p99 here.
+            if not hbm:
+                try:
+                    result = subprocess.run(
+                        [str(REPO_ROOT / "build" / "bb-bench"), "--keystone",
+                         f"127.0.0.1:{pc.keystone_port}", "--size", "4096",
+                         "--iterations", "1000", "--max-workers", "1", "--json"],
+                        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+                    )
+                    small = {row["op"]: row for row in map(
+                        json.loads, filter(str.strip, result.stdout.splitlines()))}
+                    print(
+                        f"remote 4KiB (inline tier, 1-RTT): "
+                        f"put p50 {small['put']['p50_us']:.1f}us "
+                        f"p99 {small['put']['p99_us']:.1f}us | "
+                        f"get p50 {small['get']['p50_us']:.1f}us "
+                        f"p99 {small['get']['p99_us']:.1f}us",
+                        file=sys.stderr,
+                    )
+                except Exception as exc:  # noqa: BLE001 - secondary row
+                    print(f"remote 4KiB row skipped: {exc}", file=sys.stderr)
         get_gbps = rows["get"]["gbps"]
         vs_shm = (f" ({get_gbps / shm_get_gbps * 100:.0f}% of in-process shm get)"
                   if shm_get_gbps else "")

@@ -43,7 +43,19 @@ def main() -> None:
         # Put: this runtime offers each shard, the worker pulls it into its
         # device region. Works for any dtype — bytes are bitcast on device.
         weights = np.linspace(0.0, 1.0, 262_144, dtype=np.float32)  # 1 MiB
-        fc.put("demo/weights", weights, max_workers=1, preferred_class="hbm_tpu")
+        try:
+            fc.put("demo/weights", weights, max_workers=1,
+                   preferred_class="hbm_tpu")
+        except FabricUnavailable as exc:
+            # A stack whose PJRT plugin can't move transfer-fabric bytes
+            # (TransferLink's end-to-end probe failed): every data path
+            # still works over the staged lane — demonstrate that instead.
+            print(f"fabric unavailable on this stack: {exc}")
+            client.put("demo/weights", weights.tobytes(),
+                       preferred_class=None)
+            assert client.get("demo/weights") == weights.tobytes()
+            print("staged lane served the same bytes; nothing else to demo")
+            return
         print(f"fabric put: {weights.nbytes} bytes "
               f"({fc.fabric_puts} puts rode the fabric)")
 

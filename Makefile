@@ -1,0 +1,49 @@
+# GNU-make fallback build — mirrors CMakeLists.txt for containers that ship
+# only gcc/make (no cmake/ninja). `blackbird_tpu.native.build_native()` uses
+# this automatically when cmake is missing; artifacts land in build/ exactly
+# where the cmake build puts them, so nothing downstream cares which ran.
+#
+#   make -j$(nproc)            # libbtpu.so + btpu_tests + bb-* executables
+#   make examples              # example binaries (not needed by tests/bench)
+
+CXX      ?= g++
+BUILD    ?= build
+CXXFLAGS ?= -std=c++20 -O2 -g -fPIC -Wall -Wextra -Wno-unused-parameter \
+            -Inative/include -pthread
+# -lrt: shm_open/shm_unlink live in librt on pre-2.34 glibc
+LDFLAGS  ?= -pthread -lrt
+
+LIB_SRCS := $(wildcard native/src/*/*.cpp)
+LIB_OBJS := $(patsubst %.cpp,$(BUILD)/obj/%.o,$(LIB_SRCS))
+TEST_SRCS := $(wildcard native/tests/*.cpp)
+TEST_OBJS := $(patsubst %.cpp,$(BUILD)/obj/%.o,$(TEST_SRCS))
+EXE_SRCS := $(wildcard native/exe/*.cpp)
+EXES     := $(patsubst native/exe/%.cpp,$(BUILD)/%,$(EXE_SRCS))
+EXAMPLE_SRCS := $(wildcard examples/*.cpp)
+EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
+
+HDRS := $(shell find native/include native/src -name '*.h')
+
+.PHONY: all native examples clean
+all: native
+native: $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES)
+examples: $(EXAMPLES)
+
+$(BUILD)/obj/%.o: %.cpp $(HDRS)
+	@mkdir -p $(dir $@)
+	$(CXX) $(CXXFLAGS) -c $< -o $@
+
+$(BUILD)/libbtpu.so: $(LIB_OBJS)
+	$(CXX) -shared $^ $(LDFLAGS) -o $@
+
+$(BUILD)/btpu_tests: $(TEST_OBJS) $(BUILD)/libbtpu.so
+	$(CXX) $(TEST_OBJS) -L$(BUILD) -lbtpu $(LDFLAGS) -Wl,-rpath,'$$ORIGIN' -o $@
+
+$(BUILD)/%: $(BUILD)/obj/native/exe/%.o $(BUILD)/libbtpu.so
+	$(CXX) $< -L$(BUILD) -lbtpu $(LDFLAGS) -Wl,-rpath,'$$ORIGIN' -o $@
+
+$(BUILD)/example_%: $(BUILD)/obj/examples/%.o $(BUILD)/libbtpu.so
+	$(CXX) $< -L$(BUILD) -lbtpu $(LDFLAGS) -Wl,-rpath,'$$ORIGIN' -o $@
+
+clean:
+	rm -rf $(BUILD)/obj $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES) $(EXAMPLES)

@@ -417,10 +417,17 @@ def main() -> int:
             }), file=sys.stderr)
         return 0
     binary = ensure_built()
-    # Headline is measured over REAL sockets (TCP transport, loopback):
-    # every shard transfer crosses the kernel socket stack, like the
-    # reference's benchmark_client crosses a NIC. LOCAL (same-address-space
-    # memcpy) is reported only as a labeled ceiling on stderr.
+    # Headline: TCP-transport cluster, same host. Since the one-copy lane
+    # work (PR 1) the client moves host-tier bytes itself over the
+    # same-host one-sided lane (self-registry direct copy in the embedded
+    # shape, process_vm_readv across processes) and only falls back to the
+    # socket/staged lanes when the one-sided lane declines — exactly the
+    # lane selection production same-host clients get. The lanes counter
+    # line below reports which lane actually carried the bytes and the
+    # resulting copies-per-byte; socket/staged behavior is still covered by
+    # the cross-process device-tier row, whose virtual regions cannot ride
+    # the one-sided lane. LOCAL (same-address-space memcpy) is reported as
+    # a labeled ceiling on stderr.
     # This host is a 1-core microVM with variable outside interference;
     # single runs swing +-30%. Interference only ever makes numbers WORSE,
     # so best-of-3 short runs is the least-biased estimate of the actual
@@ -555,20 +562,29 @@ def main() -> int:
             f"{max(0.0, (1 - get_gbps / raw_get_gbps) * 100):.0f}% at this size",
             file=sys.stderr,
         )
-        # Raw-vs-ceiling ratio (VERDICT r4 item 4) with its root cause: the
-        # same-host tcp lane is structurally TWO-copy (the worker stages the
-        # payload into the shared segment, the client copies it out; headers
-        # ride the socket), while the in-process local row is ONE copy — so
-        # raw tcp's ceiling is ~half the local row plus header-RTT overhead,
-        # and the ratio is expected to sit near 50%, not 70%. It fell from
-        # r3's 81% because the DENOMINATOR got faster (in-place result
-        # fills), not because raw regressed (r3 5.30 -> now within the
-        # +-30% noise band); --no-verify skips hashing entirely, so the
-        # want_crc restructure is not in this path.
+        # Raw-vs-ceiling ratio (VERDICT r4 item 4). Through r05 the same-host
+        # tcp lane was structurally TWO-copy (worker stages into the shared
+        # segment, client copies out) and this ratio sat near 50%. The
+        # one-copy lane (PR 1: self-registry direct copies / process_vm)
+        # removed the structural deficit: host-tier bytes now take exactly
+        # one pass, so the ratio should sit near (or above) 100% — the
+        # "ceiling" row is a single-threaded in-process memcpy, which the
+        # shard-parallel one-sided lane can legitimately beat on multicore.
         print(
             f"raw tcp get = {raw_get_gbps / local_rows['get']['gbps'] * 100:.0f}% of "
             f"the in-process ceiling {local_rows['get']['gbps']:.2f} GB/s "
-            f"(two-copy staged lane vs one-copy ceiling: ~50% is structural)",
+            f"(one-sided same-host lane: one copy per byte)",
+            file=sys.stderr,
+        )
+    # Lane scoreboard for the headline run (ISSUE 1 bench item): which lane
+    # moved the bytes and the byte-weighted copies-per-byte over the wire
+    # lanes (pvm 1, staged 2, stream 2 — 1.0 is the one-sided ideal).
+    lanes = main_rows.get("lanes")
+    if lanes and "copies_per_byte" in lanes:
+        print(
+            f"headline lanes: pvm {lanes.get('pvm_ops', 0)} / staged "
+            f"{lanes.get('staged_ops', 0)} / stream {lanes.get('stream_ops', 0)} ops "
+            f"-> copies_per_byte {lanes['copies_per_byte']:.2f}",
             file=sys.stderr,
         )
     print(
@@ -750,6 +766,12 @@ def main() -> int:
     }
     if raw_get_gbps is not None:
         summary["raw_get_gbps_no_verify"] = round(raw_get_gbps, 3)
+        # Tracks the CRC-folding win round over round (ISSUE 1 acceptance:
+        # verified get within 5% of --no-verify; r05 measured an 11% gap).
+        summary["verify_overhead_pct"] = round(
+            max(0.0, (1 - get_gbps / raw_get_gbps) * 100), 1)
+    if lanes and "copies_per_byte" in lanes:
+        summary["copies_per_byte"] = lanes["copies_per_byte"]
     if "get_repeat" in small_rows and "get_cached" in small_rows:
         summary["repeat_get_64kib_p50_us"] = round(small_rows["get_repeat"]["p50_us"], 1)
         summary["cached_get_64kib_p50_us"] = round(small_rows["get_cached"]["p50_us"], 1)

@@ -302,6 +302,25 @@ class Client:
             "used": out[4],
         }
 
+    @staticmethod
+    def lane_counters() -> dict[str, int]:
+        """Process-global data-lane scoreboard: which lane moved this
+        process's bytes, and how many. pvm = same-host one-sided
+        process_vm_readv/writev (1 user-space copy per byte), staged =
+        shm-staged TCP (2 copies), stream = socket payload (1 client-side
+        copy + the kernel socket path). Keys missing from older prebuilt
+        libraries read as 0."""
+        names = {
+            "pvm_ops": "btpu_pvm_op_count",
+            "pvm_bytes": "btpu_pvm_byte_count",
+            "staged_ops": "btpu_tcp_staged_op_count",
+            "staged_bytes": "btpu_tcp_staged_byte_count",
+            "stream_ops": "btpu_tcp_stream_op_count",
+            "stream_bytes": "btpu_tcp_stream_byte_count",
+        }
+        return {key: int(getattr(lib, fn)()) if hasattr(lib, fn) else 0
+                for key, fn in names.items()}
+
     def close(self) -> None:
         if self._handle:
             lib.btpu_client_destroy(self._handle)

@@ -54,6 +54,19 @@ def init(coordinator_address: str | None = None,
             return
     if _initialized:
         return
+    # Multi-process collectives on the CPU backend need the Gloo
+    # implementation selected explicitly on some jax versions (newer ones
+    # pick it automatically; without it, cross-process psum fails with
+    # "Multiprocess computations aren't implemented on the CPU backend").
+    # Checked via config/env, NOT jax.default_backend(): querying the
+    # backend would initialize it before jax.distributed.initialize.
+    try:
+        platforms = (getattr(jax.config, "jax_platforms", None)
+                     or os.environ.get("JAX_PLATFORMS") or "")
+        if "cpu" in platforms:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - option absent on this jax version
+        pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1076,6 +1076,21 @@ class JaxHbmProvider:
 
     # -- registration ------------------------------------------------------
 
+    def close(self) -> None:
+        """Releases the per-device staging machinery: joins in-flight
+        dispatches, drains fences, and shuts the dispatcher threads down.
+        Idempotent. Without this, repeated provider create/destroy cycles in
+        one process leak one dispatcher thread per device per instance (the
+        executors are otherwise only parked, never joined)."""
+        with self._staging_lock:
+            entries, self._staging = self._staging, {}
+        for entry in entries.values():
+            with entry["lock"]:
+                for slot in entry["slots"]:
+                    self._join_pending(slot)
+                    self._await_fences(slot)
+            entry["exec"].shutdown(wait=True)
+
     def register(self) -> "JaxHbmProvider":
         """Installs this provider process-wide for all HBM_TPU backends."""
         self._struct = _ProviderStruct(
@@ -1101,17 +1116,24 @@ class JaxHbmProvider:
             lib.btpu_register_hbm_provider_v4(ptr)  # v4 prefix matches
         else:  # older library: the v3 prefix of the struct matches exactly
             lib.btpu_register_hbm_provider_v3(ptr)
+        JaxHbmProvider._registered = self
         return self
+
+    _registered: "JaxHbmProvider | None" = None
 
     @staticmethod
     def unregister() -> None:
-        """Restores the built-in host-memory emulation."""
+        """Restores the built-in host-memory emulation and tears down the
+        registered provider's dispatcher threads (see close())."""
         if hasattr(lib, "btpu_register_hbm_provider_v5"):
             lib.btpu_register_hbm_provider_v5(None)
         elif hasattr(lib, "btpu_register_hbm_provider_v4"):
             lib.btpu_register_hbm_provider_v4(None)
         else:
             lib.btpu_register_hbm_provider_v3(None)
+        registered, JaxHbmProvider._registered = JaxHbmProvider._registered, None
+        if registered is not None:
+            registered.close()
 
     def region_count(self) -> int:
         with self._lock:

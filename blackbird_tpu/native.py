@@ -5,6 +5,7 @@ from __future__ import annotations
 import ctypes
 import enum
 import os
+import shutil
 import subprocess
 from pathlib import Path
 
@@ -59,17 +60,30 @@ def _needs_build() -> bool:
 
 
 def build_native(force: bool = False) -> None:
-    """(Re)builds libbtpu.so when sources are newer than the artifact."""
+    """(Re)builds libbtpu.so when sources are newer than the artifact.
+
+    Prefers the cmake/ninja build; containers that ship only gcc+make fall
+    back to the mirror Makefile (same artifacts in the same build/ layout).
+    """
     if not force and not _needs_build():
         return
+    if shutil.which("cmake") and shutil.which("ninja"):
+        subprocess.run(
+            ["cmake", "-B", str(_BUILD_DIR), "-G", "Ninja"],
+            cwd=_REPO_ROOT,
+            check=True,
+            capture_output=True,
+        )
+        subprocess.run(
+            ["ninja", "-C", str(_BUILD_DIR)],
+            cwd=_REPO_ROOT,
+            check=True,
+            capture_output=True,
+        )
+        return
+    jobs = str(max(2, os.cpu_count() or 1))
     subprocess.run(
-        ["cmake", "-B", str(_BUILD_DIR), "-G", "Ninja"],
-        cwd=_REPO_ROOT,
-        check=True,
-        capture_output=True,
-    )
-    subprocess.run(
-        ["ninja", "-C", str(_BUILD_DIR)],
+        ["make", "-j", jobs, "native"],
         cwd=_REPO_ROOT,
         check=True,
         capture_output=True,
@@ -144,6 +158,14 @@ def _load() -> ctypes.CDLL:
             fn = getattr(handle, name)
             fn.restype = None
             fn.argtypes = [ctypes.c_void_p]
+    # Lane scoreboard counters (optional for the same prebuilt-library reason).
+    for name in ("btpu_pvm_byte_count", "btpu_tcp_staged_op_count",
+                 "btpu_tcp_staged_byte_count", "btpu_tcp_stream_op_count",
+                 "btpu_tcp_stream_byte_count"):
+        if hasattr(handle, name):
+            fn = getattr(handle, name)
+            fn.restype = u64
+            fn.argtypes = []
     return handle
 
 

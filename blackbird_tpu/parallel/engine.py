@@ -22,6 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map landed in 0.4.x-late / 0.5; older runtimes ship it as
+# jax.experimental.shard_map.shard_map with the same signature. Resolve once
+# so the kernels below run on either.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 AXIS = "workers"
 
 
@@ -43,7 +51,7 @@ def _pool_write(pool, shards, offset, *, mesh):
     def write_one(pool_row, shard_row):
         return jax.lax.dynamic_update_slice(pool_row, shard_row, (0, offset))
 
-    return jax.shard_map(
+    return _shard_map(
         write_one, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
         out_specs=P(AXIS, None),
     )(pool, shards)
@@ -58,7 +66,7 @@ def _pool_read_gather(pool, offset, *, mesh, shard_elems):
         gathered = jax.lax.all_gather(shard[0], AXIS)  # [workers, shard_elems]
         return gathered.reshape(1, -1)
 
-    return jax.shard_map(
+    return _shard_map(
         read_one, mesh=mesh, in_specs=(P(AXIS, None),), out_specs=P(AXIS, None),
     )(pool)
 
@@ -80,7 +88,7 @@ def _pool_ring_replicate(pool, src_offset, dst_offset, *, mesh, shard_elems):
         neighbor = jax.lax.ppermute(shard[0], AXIS, perm)
         return jax.lax.dynamic_update_slice(pool_row, neighbor[None, :], (0, dst_offset))
 
-    return jax.shard_map(
+    return _shard_map(
         step, mesh=mesh, in_specs=(P(AXIS, None),), out_specs=P(AXIS, None),
     )(pool)
 
@@ -94,7 +102,7 @@ def _pool_checksum_agree(pool, offset, *, mesh, shard_elems):
         partial = jnp.sum(shard, dtype=jnp.uint32)
         return jax.lax.psum(partial, AXIS)[None]
 
-    out = jax.shard_map(
+    out = _shard_map(
         digest, mesh=mesh, in_specs=(P(AXIS, None),), out_specs=P(AXIS),
     )(pool)
     return out[0]

@@ -480,11 +480,26 @@ int main(int argc, char** argv) {
         (unsigned long long)kc.inline_puts.load());
   }
   // Which data lane moved the bytes? pvm = same-host one-sided
-  // process_vm_readv/writev (zero worker CPU); staged = shm-staged TCP.
+  // process_vm_readv/writev (zero worker CPU, 1 copy/byte); staged =
+  // shm-staged TCP (2 copies/byte); stream = socket payload (client copy +
+  // kernel socket path, counted as 2). copies_per_byte is the byte-weighted
+  // mean over those lanes — the scoreboard for the one-copy work (ISSUE 1);
+  // 1.0 is the one-sided ideal the paper's design promises.
   if (json) {
-    std::printf("{\"op\": \"lanes\", \"pvm_ops\": %llu, \"staged_ops\": %llu}\n",
-                (unsigned long long)transport::pvm_op_count(),
-                (unsigned long long)transport::tcp_staged_op_count());
+    const unsigned long long pvm_b = transport::pvm_byte_count();
+    const unsigned long long staged_b = transport::tcp_staged_byte_count();
+    const unsigned long long stream_b = transport::tcp_stream_byte_count();
+    const unsigned long long total_b = pvm_b + staged_b + stream_b;
+    const double copies_per_byte =
+        total_b ? double(pvm_b + 2 * staged_b + 2 * stream_b) / double(total_b) : 0.0;
+    std::printf(
+        "{\"op\": \"lanes\", \"pvm_ops\": %llu, \"staged_ops\": %llu, "
+        "\"stream_ops\": %llu, \"pvm_bytes\": %llu, \"staged_bytes\": %llu, "
+        "\"stream_bytes\": %llu, \"copies_per_byte\": %.3f}\n",
+        (unsigned long long)transport::pvm_op_count(),
+        (unsigned long long)transport::tcp_staged_op_count(),
+        (unsigned long long)transport::tcp_stream_op_count(), pvm_b, staged_b, stream_b,
+        copies_per_byte);
   }
   return 0;
 }

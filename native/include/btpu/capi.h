@@ -102,6 +102,16 @@ int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* 
 // tests assert the lane engages.
 uint64_t btpu_pvm_op_count(void);
 
+/* Lane scoreboard: ops and bytes per client data lane, for the
+ * copies-per-byte line in bench.py. pvm moves one user-space copy per byte,
+ * staged (shm segment) moves two, stream (socket payload) one client-side
+ * plus the kernel socket path. */
+uint64_t btpu_pvm_byte_count(void);
+uint64_t btpu_tcp_staged_op_count(void);
+uint64_t btpu_tcp_staged_byte_count(void);
+uint64_t btpu_tcp_stream_op_count(void);
+uint64_t btpu_tcp_stream_byte_count(void);
+
 /* ---- client-driven device fabric (runtime-owning clients) ----------------
  * A client that owns a JAX runtime moves device-tier bytes itself over the
  * transfer fabric instead of the worker's staged host lane:

@@ -28,4 +28,28 @@ uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b);
 // verified-read paths hash them while they move instead of re-reading.
 uint32_t crc32c_copy(void* dst, const void* src, size_t len, uint32_t seed = 0);
 
+// Streaming accumulator over an in-order byte stream: the chunked/pipelined
+// transports feed each chunk as it moves (update_copy fuses the chunk's
+// memcpy) and read the whole-stream CRC at the end — no post-pass, no
+// combine step for sequentially-consumed streams. For chunks that complete
+// OUT of order, hash per chunk and fold with crc32c_combine instead.
+class Crc32cStream {
+ public:
+  void update(const void* data, size_t len) {
+    crc_ = crc32c(data, len, crc_);
+    length_ += len;
+  }
+  // Copies [src, src+len) to dst and absorbs the bytes in the same pass.
+  void update_copy(void* dst, const void* src, size_t len) {
+    crc_ = crc32c_copy(dst, src, len, crc_);
+    length_ += len;
+  }
+  uint32_t value() const { return crc_; }
+  uint64_t length() const { return length_; }
+
+ private:
+  uint32_t crc_{0};
+  uint64_t length_{0};
+};
+
 }  // namespace btpu

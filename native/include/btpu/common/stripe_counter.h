@@ -1,0 +1,40 @@
+// Cache-line-striped event counter for hot data-path accounting.
+//
+// The lane/op counters (staged ops, pvm ops, moved bytes) sit on every
+// transfer's fast path; a single shared atomic makes N client threads bounce
+// one cache line per sub-op. Each thread adds to one of 8 padded stripes
+// (picked once per thread), and readers sum — counts are monotonic and only
+// read for diagnostics/benchmarks, so the non-atomic snapshot of a moving
+// total is fine.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace btpu {
+
+class StripeCounter {
+ public:
+  void add(uint64_t n = 1) noexcept { stripe().fetch_add(n, std::memory_order_relaxed); }
+
+  uint64_t total() const noexcept {
+    uint64_t sum = 0;
+    for (const auto& s : stripes_) sum += s.v.load(std::memory_order_relaxed);
+    return sum;
+  }
+
+ private:
+  struct alignas(64) Stripe {
+    std::atomic<uint64_t> v{0};
+  };
+
+  std::atomic<uint64_t>& stripe() noexcept {
+    static std::atomic<unsigned> next{0};
+    thread_local const unsigned idx = next.fetch_add(1, std::memory_order_relaxed) & 7u;
+    return stripes_[idx].v;
+  }
+
+  Stripe stripes_[8];
+};
+
+}  // namespace btpu

@@ -220,6 +220,22 @@ std::string rkey_to_hex(uint64_t rkey);
 // Number of data-plane ops this process served through the same-host
 // shm-staged TCP lane (diagnostics: benches + tests assert the lane engages).
 uint64_t tcp_staged_op_count() noexcept;
+// Lane accounting for the copies-per-byte scoreboard (bb-bench / bench.py):
+// bytes moved over the staged lane (2 user-space copies per byte), and
+// ops/bytes over the plain streaming socket lane (1 user-space copy client-
+// side plus the kernel socket path). The pvm lane's counterparts live in
+// pvm_op_count/pvm_byte_count below (1 copy per byte).
+uint64_t tcp_staged_byte_count() noexcept;
+uint64_t tcp_stream_op_count() noexcept;
+uint64_t tcp_stream_byte_count() noexcept;
+
+// Shared data-path worker pool (tcp_transport.cpp): runs fn(0..n-1) across
+// the pool plus the calling thread and returns when all calls completed.
+// Used for shard-parallel striped fetches and parallel memory-lane copies;
+// degrades to the caller's inline loop on single-core machines
+// (wire_parallel_capacity() == 0).
+void wire_parallel_for(size_t n, const std::function<void(size_t)>& fn);
+size_t wire_parallel_capacity() noexcept;
 
 // PVM lane (same-host one-sided via process_vm_readv/writev — see
 // pvm_transport.cpp). Workers advertise `pvm_make_endpoint(base, len)` on
@@ -229,15 +245,30 @@ uint64_t tcp_staged_op_count() noexcept;
 // `writable=false` marks regions whose backing pointer the server may swap
 // (HBM host views): clients then one-sided READ only — writes take the
 // staged path, which revalidates through the provider.
-std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable = true);
+// `self_gen` (from pvm_register_self_region) bakes the self-registry
+// generation into the endpoint as `:sN`; 0 omits the token.
+std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable = true,
+                              uint64_t self_gen = 0);
 // Names another live process's region (tests; the serving process normally
 // advertises itself via pvm_make_endpoint).
 std::string pvm_make_endpoint_for_pid(long pid, const void* base, uint64_t len,
-                                      bool writable = true);
+                                      bool writable = true, uint64_t self_gen = 0);
+// Self-region registry: a worker that advertises a WRITABLE host region in
+// its own process registers it here, which upgrades same-process accesses
+// to a direct fused one-pass copy (zero syscalls, CRC folded in). The
+// returned generation must ride the advertised endpoint (pvm_make_endpoint
+// self_gen) — it is what keeps a stale placement from addressing a NEW
+// region whose mmap reused the same base. Retire BEFORE freeing the
+// region's memory — retirement blocks until in-flight direct copies drain,
+// and unregistered/mismatched regions simply fall back to the
+// syscall/staged lanes, so skipping registration is safe but slower.
+uint64_t pvm_register_self_region(const void* base, uint64_t len);
+void pvm_retire_self_region(const void* base);
 bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf, uint64_t len,
                 bool is_write, uint32_t* crc_out);
-// Ops this process completed over the PVM lane (diagnostics, like
+// Ops/bytes this process completed over the PVM lane (diagnostics, like
 // tcp_staged_op_count).
 uint64_t pvm_op_count() noexcept;
+uint64_t pvm_byte_count() noexcept;
 
 }  // namespace btpu::transport

@@ -302,6 +302,11 @@ int32_t btpu_stats(btpu_client* client, uint64_t out[5]) {
 }
 
 uint64_t btpu_pvm_op_count(void) { return transport::pvm_op_count(); }
+uint64_t btpu_pvm_byte_count(void) { return transport::pvm_byte_count(); }
+uint64_t btpu_tcp_staged_op_count(void) { return transport::tcp_staged_op_count(); }
+uint64_t btpu_tcp_staged_byte_count(void) { return transport::tcp_staged_byte_count(); }
+uint64_t btpu_tcp_stream_op_count(void) { return transport::tcp_stream_op_count(); }
+uint64_t btpu_tcp_stream_byte_count(void) { return transport::tcp_stream_byte_count(); }
 
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved) {
   if (!client || !worker_id) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);

@@ -1003,6 +1003,23 @@ uint32_t fold_content_crc(const CopyShardCrcs& crcs, const CopyPlacement& copy) 
   return crc;
 }
 
+// Read-side mirror of stamp_copy_crcs: folds one copy's object CRC from the
+// transport's fused read hashes, hashing only the gaps (device shards,
+// skipped ops, the rare genuine-zero crc). The batched verified get then
+// checks integrity with ~no second pass over wire bytes.
+uint32_t fold_ranges_crc(const CopyPlacement& copy, const uint8_t* base, RangeCrcMap& ranges) {
+  uint32_t crc = 0;
+  uint64_t off = 0;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    const uint64_t len = copy.shards[i].length;
+    auto [it, fresh] = ranges.try_emplace({off, len}, 0);
+    if (fresh) it->second = crc32c(base + off, len);
+    crc = i == 0 ? it->second : crc32c_combine(crc, it->second, len);
+    off += len;
+  }
+  return crc;
+}
+
 // Collects one item's fused write hashes out of run_wire_jobs' output into
 // the (object offset, length) -> crc form stamp_copy_crcs consumes. `item`
 // filters a batch down to one object; 0-crc entries (skipped/failed ops, or
@@ -1505,6 +1522,11 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
   std::vector<EcReadFixup> ec_fixups;
   std::vector<ErrorCode> errors(items.size(), ErrorCode::OK);
   std::vector<uint64_t> sizes(items.size(), 0);
+  // Items whose integrity gate can fold the transport's fused read hashes
+  // instead of re-hashing the whole buffer: plain striped/replicated copies
+  // with a content stamp. EC reads cover padded arena buffers (their ranges
+  // don't map onto the object) and inline items carry no wire ops.
+  std::vector<bool> fuse_crc(items.size(), false);
   for (size_t i = 0; i < items.size(); ++i) {
     if (!placements[i].ok()) {
       errors[i] = placements[i].error();
@@ -1538,20 +1560,44 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
                                    jobs);
         ec != ErrorCode::OK)
       errors[i] = ec;
+    else
+      fuse_crc[i] = v && copy.content_crc != 0;
   }
   run_device_jobs(*data_, jobs, /*is_write=*/false, errors);
-  run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors);
+  std::vector<uint32_t> wire_crcs;
+  run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors,
+                v ? &wire_crcs : nullptr, v ? &fuse_crc : nullptr);
   for (const auto& fix : ec_fixups) {
     if (errors[fix.item] == ErrorCode::OK) std::memcpy(fix.dst, fix.src, fix.n);
   }
   // Integrity gate: a clean-looking first-pass read with a CRC mismatch is
   // demoted to a failure so the per-item retry below heals it (replica
-  // failover, or the coded path's corruption hunt).
+  // failover, or the coded path's corruption hunt). Wire shards were hashed
+  // WHILE they moved (fuse_crc items): their fold replaces the old whole-
+  // buffer post-pass, which cost ~11% of verified get throughput at 1 MiB.
+  // One pass over the batch's jobs distributes the fused hashes to their
+  // items (a per-item harvest would rescan the whole job list K times).
+  std::vector<RangeCrcMap> item_ranges(v ? items.size() : 0);
+  if (v) {
+    for (size_t j = 0; j < jobs.wire.size() && j < wire_crcs.size(); ++j) {
+      const size_t item = jobs.wire_item[j];
+      if (wire_crcs[j] == 0 || !fuse_crc[item]) continue;
+      const auto* base = static_cast<const uint8_t*>(items[item].buffer);
+      item_ranges[item][{static_cast<uint64_t>(jobs.wire[j].buf - base),
+                         jobs.wire[j].len}] = wire_crcs[j];
+    }
+  }
   for (size_t i = 0; i < items.size(); ++i) {
     if (errors[i] != ErrorCode::OK || !placements[i].ok() || placements[i].value().empty())
       continue;
-    const uint32_t expect = placements[i].value().front().content_crc;
-    if (v && expect != 0 && crc32c(items[i].buffer, sizes[i]) != expect) {
+    const auto& copy = placements[i].value().front();
+    const uint32_t expect = copy.content_crc;
+    if (!v || expect == 0) continue;
+    const uint32_t got =
+        fuse_crc[i] ? fold_ranges_crc(copy, static_cast<const uint8_t*>(items[i].buffer),
+                                      item_ranges[i])
+                    : crc32c(items[i].buffer, sizes[i]);
+    if (got != expect) {
       LOG_WARN << "get_many: content crc mismatch on " << items[i].key << "; retrying";
       errors[i] = ErrorCode::CHECKSUM_MISMATCH;
     }

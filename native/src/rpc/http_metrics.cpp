@@ -1,5 +1,7 @@
 #include "btpu/rpc/http_metrics.h"
 
+#include <unistd.h>
+
 #include <map>
 #include <sstream>
 

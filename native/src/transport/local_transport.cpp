@@ -4,6 +4,7 @@
 #include <cstring>
 #include <mutex>
 #include <random>
+#include <shared_mutex>
 #include <unordered_map>
 
 #include "btpu/common/crc32c.h"
@@ -23,7 +24,9 @@ struct LocalRegion {
 };
 
 struct LocalRegistry {
-  std::mutex mutex;
+  // Reader-writer lock: the access path (every LOCAL one-sided op) takes a
+  // shared lock for its rkey lookup; registration/teardown take it unique.
+  std::shared_mutex mutex;
   std::unordered_map<uint64_t, LocalRegion> by_rkey;
   std::mt19937_64 rng{0x6274707545ull};  // deterministic for debuggability
 
@@ -40,7 +43,7 @@ class LocalTransportServer : public TransportServer {
   ErrorCode start(const std::string&, uint16_t) override { return ErrorCode::OK; }
   void stop() override {
     auto& reg = LocalRegistry::instance();
-    std::lock_guard<std::mutex> lock(reg.mutex);
+    std::unique_lock<std::shared_mutex> lock(reg.mutex);
     for (uint64_t rkey : my_rkeys_) reg.by_rkey.erase(rkey);
     my_rkeys_.clear();
   }
@@ -49,7 +52,7 @@ class LocalTransportServer : public TransportServer {
                                            const std::string& tag) override {
     if (!base || len == 0) return ErrorCode::INVALID_PARAMETERS;
     auto& reg = LocalRegistry::instance();
-    std::lock_guard<std::mutex> lock(reg.mutex);
+    std::unique_lock<std::shared_mutex> lock(reg.mutex);
     uint64_t rkey = reg.rng() | 1;  // nonzero
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
@@ -68,7 +71,7 @@ class LocalTransportServer : public TransportServer {
                                                    RegionWriteFn write_fn) override {
     if (len == 0 || !read_fn || !write_fn) return ErrorCode::INVALID_PARAMETERS;
     auto& reg = LocalRegistry::instance();
-    std::lock_guard<std::mutex> lock(reg.mutex);
+    std::unique_lock<std::shared_mutex> lock(reg.mutex);
     uint64_t rkey = reg.rng() | 1;
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
     reg.by_rkey[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn)};
@@ -89,7 +92,7 @@ class LocalTransportServer : public TransportServer {
       return ErrorCode::INVALID_PARAMETERS;
     }
     auto& reg = LocalRegistry::instance();
-    std::lock_guard<std::mutex> lock(reg.mutex);
+    std::unique_lock<std::shared_mutex> lock(reg.mutex);
     reg.by_rkey.erase(rkey);
     std::erase(my_rkeys_, rkey);
     return ErrorCode::OK;
@@ -124,7 +127,7 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
   RegionWriteFn write_fn;
   uint64_t offset = 0;
   {
-    std::lock_guard<std::mutex> lock(reg.mutex);
+    std::shared_lock<std::shared_mutex> lock(reg.mutex);
     auto it = reg.by_rkey.find(rkey);
     if (it == reg.by_rkey.end()) return ErrorCode::MEMORY_ACCESS_ERROR;
     const LocalRegion& region = it->second;

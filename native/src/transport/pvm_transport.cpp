@@ -44,6 +44,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <string>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -52,13 +53,15 @@
 #include "btpu/common/error.h"
 #include "btpu/common/log.h"
 #include "btpu/common/crc32c.h"
+#include "btpu/common/stripe_counter.h"
 #include "btpu/transport/transport.h"
 
 namespace btpu::transport {
 
 namespace {
 
-std::atomic<uint64_t> g_pvm_ops{0};
+StripeCounter g_pvm_ops;
+StripeCounter g_pvm_bytes;
 
 // This boot's id, hex-ish token with dashes stripped (matches endpoint form).
 std::string local_boot_id() {
@@ -104,6 +107,42 @@ struct PvmTarget {
   uint64_t base{0};
   uint64_t len{0};
   bool writable{true};
+  bool self{false};      // endpoint names THIS process (see self registry)
+  uint64_t self_gen{0};  // registration generation baked into the endpoint
+};
+
+// ---- self-region registry --------------------------------------------------
+// Writable host regions this process itself advertised (worker pools in the
+// embedded / same-process shape). For these the one-sided lane is a DIRECT
+// memcpy — zero syscalls, and the CRC folds into the single pass
+// (crc32c_copy), which no cross-address-space primitive can offer. The
+// registry is what makes that safe: an access holds the shared lock across
+// the copy, and worker teardown retires the region under the unique lock
+// BEFORE the backend frees the memory — so a direct copy can never race a
+// munmap (a stale placement simply misses the registry and falls back to
+// the staged lane, whose server-side rkey check fails it cleanly).
+// Entries carry a GENERATION, echoed into the advertised endpoint (`:sN`):
+// a revived in-process worker whose pool mmap lands at the SAME address
+// registers a fresh generation, so a client holding the dead worker's
+// placement mismatches and falls back instead of silently addressing the
+// replacement pool's bytes.
+// Read-only self endpoints (HBM host views, whose backing pointer the
+// provider may swap) are NOT registered; their reads ride process_vm_readv
+// on the own pid instead — one kernel copy, clean EFAULT on a stale
+// pointer, and the verified-read CRC gate judges the bytes.
+struct SelfRegistry {
+  struct Entry {
+    uint64_t len{0};
+    uint64_t gen{0};  // distinguishes re-registrations at a REUSED address
+  };
+  std::shared_mutex mutex;
+  std::unordered_map<uint64_t, Entry> regions;  // base -> entry
+  uint64_t next_gen{1};
+
+  static SelfRegistry& instance() {
+    static SelfRegistry r;
+    return r;
+  }
 };
 
 // Endpoint validation cache. `valid` entries are re-checked for liveness
@@ -123,13 +162,14 @@ std::unordered_map<std::string, CacheEntry> g_cache;
 
 bool parse_endpoint(const std::string& ep, std::string& boot, long& pid,
                     unsigned long long& starttime, uint64_t& base, uint64_t& len,
-                    bool& writable) {
-  // bootid:pid:starttime:base:len[:ro] (base/len hex). The optional mode
-  // token marks regions whose backing pointer the serving process may swap
-  // (HBM host views behind a provider re-registration): one-sided READS of
-  // a stale pointer are caught by the verified-read CRC gate, but a WRITE
-  // would corrupt whatever now lives at the old address — so those regions
-  // take the staged write path, which revalidates through the provider.
+                    bool& writable, uint64_t& self_gen) {
+  // bootid:pid:starttime:base:len[:ro][:sN] (base/len hex). The optional
+  // `ro` token marks regions whose backing pointer the serving process may
+  // swap (HBM host views behind a provider re-registration): one-sided
+  // READS of a stale pointer are caught by the verified-read CRC gate, but
+  // a WRITE would corrupt whatever now lives at the old address — so those
+  // regions take the staged write path, which revalidates through the
+  // provider. `sN` carries the self-registry generation (see SelfRegistry).
   size_t a = ep.find(':');
   if (a == std::string::npos) return false;
   size_t b = ep.find(':', a + 1);
@@ -138,7 +178,7 @@ bool parse_endpoint(const std::string& ep, std::string& boot, long& pid,
   if (c == std::string::npos) return false;
   size_t d = ep.find(':', c + 1);
   if (d == std::string::npos) return false;
-  const size_t e = ep.find(':', d + 1);
+  size_t e = ep.find(':', d + 1);
   try {
     boot = ep.substr(0, a);
     pid = std::stol(ep.substr(a + 1, b - a - 1));
@@ -147,15 +187,41 @@ bool parse_endpoint(const std::string& ep, std::string& boot, long& pid,
     len = std::stoull(ep.substr(d + 1, e == std::string::npos ? std::string::npos
                                                               : e - d - 1),
                       nullptr, 16);
-    writable = e == std::string::npos || ep.substr(e + 1) != "ro";
+    writable = true;
+    self_gen = 0;
+    while (e != std::string::npos) {
+      const size_t next = ep.find(':', e + 1);
+      const std::string token =
+          ep.substr(e + 1, next == std::string::npos ? std::string::npos : next - e - 1);
+      if (token == "ro") {
+        writable = false;
+      } else if (token.size() > 1 && token[0] == 's') {
+        self_gen = std::stoull(token.substr(1));
+      } else {
+        return false;  // unknown token: refuse rather than mis-trust
+      }
+      e = next;
+    }
   } catch (...) {
     return false;
   }
   return pid > 0 && len > 0;
 }
 
+// Re-verifies that `pid` still carries `starttime` — the write-path gate on
+// cached entries. A pid recycled onto the exact cached value inside the 2 s
+// positive-cache TTL would be READ harmlessly (the CRC gate discards the
+// bytes) but a write would corrupt an unrelated process, so cached writes
+// pay one /proc read; fresh resolves just checked it.
+bool still_same_process(long pid, unsigned long long starttime) {
+  unsigned long long live = 0;
+  return pid_starttime(pid, live) && live == starttime;
+}
+
 // Resolves an endpoint to a live same-boot target, through the cache.
-bool resolve(const std::string& ep, PvmTarget& out) {
+// `for_write` gates cached entries behind a starttime re-check (see above);
+// reads keep the no-syscall fast path.
+bool resolve(const std::string& ep, PvmTarget& out, bool for_write) {
   static const bool disabled = [] {
     const char* env = std::getenv("BTPU_PVM");
     return env && std::strcmp(env, "0") == 0;
@@ -170,11 +236,17 @@ bool resolve(const std::string& ep, PvmTarget& out) {
   // thread's copy ages out on its own clock).
   struct TlEntry {
     PvmTarget target;
+    unsigned long long starttime;
     std::chrono::steady_clock::time_point checked;
   };
   thread_local std::unordered_map<std::string, TlEntry> tl_cache;
   if (auto it = tl_cache.find(ep); it != tl_cache.end()) {
-    if (now - it->second.checked < std::chrono::seconds(2)) {
+    // Self targets skip the write-path starttime re-check: their per-op
+    // authority is the self registry (checked under its lock in pvm_access),
+    // which is strictly stronger than a /proc probe.
+    if (now - it->second.checked < std::chrono::seconds(2) &&
+        (!for_write || it->second.target.self ||
+         still_same_process(it->second.target.pid, it->second.starttime))) {
       out = it->second.target;
       return true;
     }
@@ -193,9 +265,12 @@ bool resolve(const std::string& ep, PvmTarget& out) {
       if (!it->second.usable) {
         if (now - it->second.checked < std::chrono::seconds(5)) return false;
         g_cache.erase(it);  // stale negative: fall through and re-resolve
-      } else if (now - it->second.checked < std::chrono::seconds(2)) {
+      } else if (now - it->second.checked < std::chrono::seconds(2) &&
+                 (!for_write || it->second.target.self ||
+                  still_same_process(it->second.target.pid, it->second.starttime))) {
         out = it->second.target;
-        tl_cache[ep] = {it->second.target, it->second.checked};
+        if (tl_cache.size() >= 64) tl_cache.clear();  // bound inserts too
+        tl_cache[ep] = {it->second.target, it->second.starttime, it->second.checked};
         return true;
       }
       // Revalidate liveness below (same pid must still carry the same
@@ -207,17 +282,38 @@ bool resolve(const std::string& ep, PvmTarget& out) {
   unsigned long long starttime = 0;
   uint64_t base = 0, len = 0;
   bool writable = true;
+  uint64_t self_gen = 0;
   CacheEntry entry;
   entry.checked = now;
-  // Own-process regions are excluded: the in-process LOCAL lane is a plain
-  // memcpy, strictly cheaper than a self-targeted process_vm syscall.
-  if (parse_endpoint(ep, boot, pid, starttime, base, len, writable) &&
-      pid != ::getpid() && boot == local_boot_id() && !local_boot_id().empty()) {
-    unsigned long long live_start = 0;
-    if (pid_starttime(pid, live_start) && live_start == starttime) {
-      entry.usable = true;
-      entry.target = {pid, base, len, writable};
-      entry.starttime = starttime;
+  if (parse_endpoint(ep, boot, pid, starttime, base, len, writable, self_gen) &&
+      boot == local_boot_id() && !local_boot_id().empty()) {
+    if (pid == ::getpid()) {
+      // Own-process endpoint (embedded cluster / client inside the worker
+      // process): the lane serves it as the ONE-COPY fast path — a direct
+      // fused copy through the self registry for writable flat regions, a
+      // self-targeted process_vm read for host-view (`:ro`) ones. It used
+      // to be excluded on the theory that the LOCAL transport covers
+      // in-process traffic, but TCP-kind descriptors never route there, so
+      // same-process clients paid the two-copy staged lane instead.
+      // Starttime must still match OUR OWN: a same-boot pid-reuse could
+      // hand this process an endpoint minted by its pid's previous owner.
+      static const unsigned long long own_start = [] {
+        unsigned long long s = 0;
+        pid_starttime(::getpid(), s);
+        return s;
+      }();
+      if (starttime == own_start) {
+        entry.usable = true;
+        entry.target = {pid, base, len, writable, /*self=*/true, self_gen};
+        entry.starttime = starttime;
+      }
+    } else {
+      unsigned long long live_start = 0;
+      if (pid_starttime(pid, live_start) && live_start == starttime) {
+        entry.usable = true;
+        entry.target = {pid, base, len, writable};
+        entry.starttime = starttime;
+      }
     }
   }
   std::lock_guard<std::mutex> lock(g_cache_mutex);
@@ -231,7 +327,10 @@ bool resolve(const std::string& ep, PvmTarget& out) {
   g_cache[ep] = entry;
   if (entry.usable) {
     out = entry.target;
-    tl_cache[ep] = {entry.target, now};
+    // Same size bound as the stale-lookup path: a long-lived client thread
+    // otherwise leaks one dead entry per worker restart forever.
+    if (tl_cache.size() >= 64) tl_cache.clear();
+    tl_cache[ep] = {entry.target, entry.starttime, now};
   }
   return entry.usable;
 }
@@ -248,27 +347,52 @@ void invalidate(const std::string& ep) {
 }  // namespace
 
 std::string pvm_make_endpoint_for_pid(long pid, const void* base, uint64_t len,
-                                      bool writable) {
+                                      bool writable, uint64_t self_gen) {
   const std::string boot = local_boot_id();
   if (boot.empty() || base == nullptr || len == 0) return "";
   unsigned long long starttime = 0;
   if (!pid_starttime(pid, starttime)) return "";
-  char buf[160];
-  std::snprintf(buf, sizeof(buf), "%s:%ld:%llu:%llx:%llx%s", boot.c_str(), pid, starttime,
-                static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(base)),
-                static_cast<unsigned long long>(len), writable ? "" : ":ro");
+  char buf[192];
+  int n = std::snprintf(buf, sizeof(buf), "%s:%ld:%llu:%llx:%llx%s", boot.c_str(), pid,
+                        starttime,
+                        static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(base)),
+                        static_cast<unsigned long long>(len), writable ? "" : ":ro");
+  if (self_gen != 0 && n > 0 && n < static_cast<int>(sizeof(buf))) {
+    std::snprintf(buf + n, sizeof(buf) - static_cast<size_t>(n), ":s%llu",
+                  static_cast<unsigned long long>(self_gen));
+  }
   return buf;
 }
 
-std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable) {
-  return pvm_make_endpoint_for_pid(::getpid(), base, len, writable);
+std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable,
+                              uint64_t self_gen) {
+  return pvm_make_endpoint_for_pid(::getpid(), base, len, writable, self_gen);
+}
+
+uint64_t pvm_register_self_region(const void* base, uint64_t len) {
+  if (!base || len == 0) return 0;
+  auto& sr = SelfRegistry::instance();
+  std::unique_lock<std::shared_mutex> lock(sr.mutex);
+  const uint64_t gen = sr.next_gen++;
+  sr.regions[reinterpret_cast<uintptr_t>(base)] = {len, gen};
+  return gen;
+}
+
+void pvm_retire_self_region(const void* base) {
+  if (!base) return;
+  auto& sr = SelfRegistry::instance();
+  // The unique lock is the teardown fence: it waits out every in-flight
+  // direct copy (shared holders), after which no new access can resolve the
+  // region — only then may the caller free the memory.
+  std::unique_lock<std::shared_mutex> lock(sr.mutex);
+  sr.regions.erase(reinterpret_cast<uintptr_t>(base));
 }
 
 bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf, uint64_t len,
                 bool is_write, uint32_t* crc_out) {
   if (remote.pvm_endpoint.empty() || len == 0) return false;
   PvmTarget target;
-  if (!resolve(remote.pvm_endpoint, target)) return false;
+  if (!resolve(remote.pvm_endpoint, target, is_write)) return false;
   if (is_write && !target.writable) return false;  // :ro region (see parse)
   // remote_addr lives in the REGISTERED region's address space; translate
   // through the descriptor's base to an offset, then bounds-check against
@@ -276,6 +400,42 @@ bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf,
   const uint64_t off = remote_addr - remote.remote_base;
   if (remote_addr < remote.remote_base || off > target.len || len > target.len - off)
     return false;
+  if (target.self && target.writable) {
+    // Own-process writable region: ONE fused pass, zero syscalls. The
+    // shared lock held across the copy is what excludes a concurrent
+    // teardown's munmap (pvm_retire_self_region takes it unique before the
+    // backend frees the memory).
+    auto& sr = SelfRegistry::instance();
+    std::shared_lock<std::shared_mutex> lock(sr.mutex);
+    auto it = sr.regions.find(target.base);
+    // Generation must match the endpoint's `:sN` token: a revived worker
+    // whose pool mmap reused this address registered a NEW generation, and
+    // serving the old placement against it would address the wrong bytes.
+    if (it != sr.regions.end() && it->second.gen == target.self_gen &&
+        off <= it->second.len && len <= it->second.len - off) {
+      auto* p = reinterpret_cast<uint8_t*>(static_cast<uintptr_t>(target.base + off));
+      if (is_write) {
+        if (crc_out) {
+          *crc_out = crc32c_copy(p, buf, len);  // fused: hash while moving
+        } else {
+          std::memcpy(p, buf, len);
+        }
+      } else if (crc_out) {
+        *crc_out = crc32c_copy(buf, p, len);  // fused: hash while moving
+      } else {
+        std::memcpy(buf, p, len);
+      }
+      g_pvm_ops.add();
+      g_pvm_bytes.add(len);
+      return true;
+    }
+    // Registry miss: a stale placement (worker torn down / revived) or an
+    // endpoint nobody vouched for. The registry is authoritative for
+    // writable self regions — no syscall fallback, which could read
+    // recycled heap as a "successful" raw read; decline and let the staged
+    // lane's server-side rkey check judge it.
+    return false;
+  }
   struct iovec local {
     buf, static_cast<size_t>(len)
   };
@@ -298,10 +458,12 @@ bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf,
   // The kernel did the copy, so the hash is a post-pass over the local
   // buffer — still one full copy cheaper than the two-copy staged lane.
   if (crc_out) *crc_out = crc32c(buf, len);
-  g_pvm_ops.fetch_add(1);
+  g_pvm_ops.add();
+  g_pvm_bytes.add(len);
   return true;
 }
 
-uint64_t pvm_op_count() noexcept { return g_pvm_ops.load(); }
+uint64_t pvm_op_count() noexcept { return g_pvm_ops.total(); }
+uint64_t pvm_byte_count() noexcept { return g_pvm_bytes.total(); }
 
 }  // namespace btpu::transport

@@ -15,6 +15,7 @@
 #include <cstring>
 #include <mutex>
 #include <random>
+#include <shared_mutex>
 #include <unordered_map>
 
 #include "btpu/common/crc32c.h"
@@ -114,7 +115,10 @@ class ShmTransportServer : public TransportServer {
   std::mt19937_64 rng_{0x73686d726567ull};
 };
 
-// Client-side cache of mapped segments.
+// Client-side cache of mapped segments. Reader-writer lock: every same-host
+// transfer resolves its segment here, so N client threads share the hit
+// path instead of convoying on one mutex per op (mappings change only when
+// a worker (re)starts).
 class ShmMapCache {
  public:
   static ShmMapCache& instance() {
@@ -124,11 +128,13 @@ class ShmMapCache {
 
   // Maps (or returns cached) segment; out_len = segment size.
   uint8_t* map(const std::string& name, uint64_t& out_len) {
-    std::lock_guard<std::mutex> lock(mutex_);
-    auto it = maps_.find(name);
-    if (it != maps_.end()) {
-      out_len = it->second.len;
-      return it->second.base;
+    {
+      std::shared_lock<std::shared_mutex> lock(mutex_);
+      auto it = maps_.find(name);
+      if (it != maps_.end()) {
+        out_len = it->second.len;
+        return it->second.base;
+      }
     }
     int fd = ::shm_open(name.c_str(), O_RDWR, 0600);
     if (fd < 0) return nullptr;
@@ -141,13 +147,19 @@ class ShmMapCache {
         ::mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
     ::close(fd);
     if (base == MAP_FAILED) return nullptr;
-    maps_[name] = {name, static_cast<uint8_t*>(base), static_cast<uint64_t>(st.st_size)};
-    out_len = static_cast<uint64_t>(st.st_size);
-    return static_cast<uint8_t*>(base);
+    std::unique_lock<std::shared_mutex> lock(mutex_);
+    auto [it, inserted] = maps_.try_emplace(
+        name, ShmSegment{name, static_cast<uint8_t*>(base), static_cast<uint64_t>(st.st_size)});
+    if (!inserted) {
+      // A racing thread mapped it first: keep the cached mapping, drop ours.
+      ::munmap(base, static_cast<size_t>(st.st_size));
+    }
+    out_len = it->second.len;
+    return it->second.base;
   }
 
   void drop(const std::string& name) {
-    std::lock_guard<std::mutex> lock(mutex_);
+    std::unique_lock<std::shared_mutex> lock(mutex_);
     auto it = maps_.find(name);
     if (it != maps_.end()) {
       ::munmap(it->second.base, it->second.len);
@@ -156,7 +168,7 @@ class ShmMapCache {
   }
 
  private:
-  std::mutex mutex_;
+  std::shared_mutex mutex_;
   std::unordered_map<std::string, ShmSegment> maps_;
 };
 

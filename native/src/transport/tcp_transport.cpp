@@ -26,8 +26,13 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <condition_variable>
+#include <deque>
+#include <functional>
+
 #include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
+#include "btpu/common/stripe_counter.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/transport.h"
 
@@ -392,7 +397,10 @@ namespace {
 
 constexpr uint64_t kStagingBytes = 4ull << 20;  // == kChunkBytesMax: every sub-op fits
 
-std::atomic<uint64_t> g_staged_ops{0};
+StripeCounter g_staged_ops;
+StripeCounter g_staged_bytes;
+StripeCounter g_stream_ops;
+StripeCounter g_stream_bytes;
 
 bool staged_lane_enabled() {
   // Read per call (it only runs when a NEW connection probes the lane):
@@ -403,7 +411,10 @@ bool staged_lane_enabled() {
 
 }  // namespace
 
-uint64_t tcp_staged_op_count() noexcept { return g_staged_ops.load(); }
+uint64_t tcp_staged_op_count() noexcept { return g_staged_ops.total(); }
+uint64_t tcp_staged_byte_count() noexcept { return g_staged_bytes.total(); }
+uint64_t tcp_stream_op_count() noexcept { return g_stream_ops.total(); }
+uint64_t tcp_stream_byte_count() noexcept { return g_stream_bytes.total(); }
 
 // A pooled data-plane connection, optionally with a negotiated same-host
 // staging segment (see the opcode block comment).
@@ -445,6 +456,11 @@ struct PooledConn {
 // created on demand and returned after use. At creation the pool probes the
 // staged lane once per endpoint (hello handshake); cross-host endpoints
 // refuse or drop the probe connection and are remembered as stream-only.
+//
+// Sharded by endpoint hash: N client threads (or the shard-parallel batch
+// engine's workers) hitting DIFFERENT endpoints never share a lock, and
+// same-endpoint acquire/release critical sections are a few pointer moves —
+// the 4-process/4-thread retention rows convoyed on the old single mutex.
 class TcpEndpointPool {
  public:
   static TcpEndpointPool& instance() {
@@ -453,17 +469,18 @@ class TcpEndpointPool {
   }
 
   Result<PooledConn> acquire(const std::string& endpoint) {
+    Shard& shard = shard_for(endpoint);
     int staged_hint;
     {
-      std::lock_guard<std::mutex> lock(mutex_);
-      auto& free_list = pools_[endpoint];
+      std::lock_guard<std::mutex> lock(shard.mutex);
+      auto& free_list = shard.pools[endpoint];
       if (!free_list.empty()) {
         PooledConn c = std::move(free_list.back());
         free_list.pop_back();
         return c;
       }
-      auto it = staged_support_.find(endpoint);
-      staged_hint = it == staged_support_.end() ? 0 : it->second;
+      auto it = shard.staged_support.find(endpoint);
+      staged_hint = it == shard.staged_support.end() ? 0 : it->second;
     }
     auto hp = net::parse_host_port(endpoint);
     if (!hp) return ErrorCode::INVALID_ADDRESS;
@@ -483,26 +500,38 @@ class TcpEndpointPool {
         // 0 = client-local shm setup failed (/dev/shm full, EMFILE):
         // transient, so the next connection re-probes. Only a server
         // answer (yes / refused / dropped) is worth remembering.
-        std::lock_guard<std::mutex> lock(mutex_);
-        staged_support_[endpoint] = verdict;
+        std::lock_guard<std::mutex> lock(shard.mutex);
+        shard.staged_support[endpoint] = verdict;
       }
     }
     return conn;
   }
 
   void release(const std::string& endpoint, PooledConn conn) {
-    std::lock_guard<std::mutex> lock(mutex_);
-    auto& free_list = pools_[endpoint];
+    Shard& shard = shard_for(endpoint);
+    std::lock_guard<std::mutex> lock(shard.mutex);
+    auto& free_list = shard.pools[endpoint];
     if (free_list.size() < kMaxPooledPerEndpoint) free_list.push_back(std::move(conn));
     // else: dtor closes socket + unmaps staging
   }
 
   void drop_endpoint(const std::string& endpoint) {
-    std::lock_guard<std::mutex> lock(mutex_);
-    pools_.erase(endpoint);
+    Shard& shard = shard_for(endpoint);
+    std::lock_guard<std::mutex> lock(shard.mutex);
+    shard.pools.erase(endpoint);
   }
 
  private:
+  struct Shard {
+    std::mutex mutex;
+    std::unordered_map<std::string, std::vector<PooledConn>> pools;
+    std::unordered_map<std::string, int> staged_support;  // 1 yes, -1 no
+  };
+
+  Shard& shard_for(const std::string& endpoint) {
+    return shards_[std::hash<std::string>{}(endpoint) & (kShards - 1)];
+  }
+
   // Returns 1 staged (conn now carries a mapped segment), -1 stream-only
   // (server refused or dropped — sticky), 0 client-local shm failure
   // (transient — not recorded). On -1 the connection may be dead (old
@@ -546,9 +575,111 @@ class TcpEndpointPool {
   }
 
   static constexpr size_t kMaxPooledPerEndpoint = 16;
+  static constexpr size_t kShards = 8;  // power of two (mask in shard_for)
+  Shard shards_[kShards];
+};
+
+// ---- shared wire worker pool ----------------------------------------------
+//
+// A small process-wide pool for data-path parallelism: shard-parallel
+// striped transfers (each worker drives its own sub-ops on its own pooled
+// connections) and parallel memory-lane copies. Threads are lazy, detached,
+// and park on a condvar between jobs; on a single-core machine the pool is
+// empty and run() degrades to the caller's inline loop. The caller always
+// participates, so a saturated pool delays work but can never deadlock it.
+class WireWorkers {
+ public:
+  static WireWorkers& instance() {
+    // Leaked on purpose: detached workers may outlive static destructors.
+    static WireWorkers* pool = new WireWorkers();
+    return *pool;
+  }
+
+  size_t capacity() const noexcept { return nthreads_; }
+
+  // Runs fn(0..n-1) across the pool + the calling thread; returns when every
+  // call has completed (the completion barrier of a shard-parallel fetch).
+  void run(size_t n, const std::function<void(size_t)>& fn) {
+    if (n == 0) return;
+    if (nthreads_ == 0 || n == 1) {
+      for (size_t i = 0; i < n; ++i) fn(i);
+      return;
+    }
+    auto job = std::make_shared<Job>();
+    job->fn = &fn;
+    job->n = n;
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      jobs_.push_back(job);
+    }
+    cv_.notify_all();
+    help(*job);
+    std::unique_lock<std::mutex> lock(job->done_mutex);
+    job->done_cv.wait(lock, [&] { return job->done.load() >= job->n; });
+    std::lock_guard<std::mutex> qlock(mutex_);
+    std::erase(jobs_, job);
+  }
+
+ private:
+  struct Job {
+    const std::function<void(size_t)>* fn{nullptr};
+    size_t n{0};
+    std::atomic<size_t> next{0};
+    std::atomic<size_t> done{0};
+    std::mutex done_mutex;
+    std::condition_variable done_cv;
+  };
+
+  WireWorkers() {
+    const unsigned hw = std::thread::hardware_concurrency();
+    nthreads_ = hw > 1 ? std::min(hw - 1, 6u) : 0;
+    for (size_t i = 0; i < nthreads_; ++i) {
+      std::thread([this] { worker_loop(); }).detach();
+    }
+  }
+
+  static void help(Job& job) {
+    for (;;) {
+      const size_t i = job.next.fetch_add(1);
+      if (i >= job.n) return;
+      // Containment, not handling: fn owns its error reporting (the batch
+      // call sites catch inside fn and mark their ops failed). An escaped
+      // exception here would std::terminate a detached worker, or strand
+      // the job with dangling captures if it escaped the calling thread's
+      // help() — either way `done` must still advance.
+      try {
+        (*job.fn)(i);
+      } catch (...) {
+      }
+      if (job.done.fetch_add(1) + 1 == job.n) {
+        std::lock_guard<std::mutex> lock(job.done_mutex);
+        job.done_cv.notify_all();
+      }
+    }
+  }
+
+  void worker_loop() {
+    for (;;) {
+      std::shared_ptr<Job> job;
+      {
+        std::unique_lock<std::mutex> lock(mutex_);
+        cv_.wait(lock, [&] { return !jobs_.empty(); });
+        job = jobs_.front();
+        if (job->next.load() >= job->n) {
+          // Exhausted but not yet erased by its owner: skip past it so a
+          // straggling worker cannot spin on a drained job.
+          jobs_.pop_front();
+          continue;
+        }
+      }
+      help(*job);
+    }
+  }
+
+  size_t nthreads_{0};
   std::mutex mutex_;
-  std::unordered_map<std::string, std::vector<PooledConn>> pools_;
-  std::unordered_map<std::string, int> staged_support_;  // 1 yes, -1 no
+  std::condition_variable cv_;
+  std::deque<std::shared_ptr<Job>> jobs_;
 };
 
 // ---- pipelined batch engine ------------------------------------------------
@@ -558,10 +689,27 @@ class TcpEndpointPool {
 // requests concurrently (thread per connection) while the client drains
 // whichever response polls ready first (a slow endpoint in a mixed batch
 // cannot head-of-line-block buffered responses), so a batch costs ~one
-// round trip of latency and zero fan-out threads; ops wider than
-// the batch-adaptive chunk size are split so one huge transfer also pipelines. One-sided reads and writes are idempotent, so a
-// sub-op whose connection dies mid-flight (worker restarted, stale pooled
-// socket) is simply re-run once on a fresh connection.
+// round trip of latency and zero fan-out threads; ops wider than the
+// batch-adaptive chunk size are split so one huge transfer also pipelines.
+// One-sided reads and writes are idempotent, so a sub-op whose connection
+// dies mid-flight (worker restarted, stale pooled socket) is simply re-run
+// once on a fresh connection.
+//
+// Two further levels of overlap inside a batch:
+//   * Intra-connection chunk pipeline (staged lane): a staged sub-op no
+//     longer moves as stage-whole -> status -> drain-whole. It is sliced
+//     into pipe chunks at distinct segment offsets; the client streams the
+//     chunk requests and the server answers them in order, so while the
+//     client copies+hashes chunk N out of the segment the server is already
+//     copying chunk N+1 in — the two memcpy passes of the staged lane run
+//     concurrently instead of back to back, and the CRC rides the one
+//     client-side pass (seed-chained, no combine, no post-pass).
+//   * Shard-parallel drains: a batch with several ops (a striped get's
+//     shards, split-replica slices) is partitioned BY OP across the wire
+//     worker pool, each slice driving its own sub-ops on its own pooled
+//     connections, with a completion barrier before the CRC fold. The
+//     client-side copy out of the segments was previously serialized on the
+//     calling thread even though the worker side served shards in parallel.
 
 namespace {
 
@@ -573,6 +721,10 @@ namespace {
 constexpr uint64_t kChunkBytesMax = 4ull << 20;   // fits the 4 MiB segments
 constexpr uint64_t kChunkBytesMin = 512ull << 10; // below this, RTTs dominate
 constexpr size_t kMaxInflight = 12;           // < kMaxPooledPerEndpoint
+// Batches smaller than this stay on the calling thread: handing a few
+// hundred KiB to the worker pool costs more in wakeups than the parallel
+// memcpy returns.
+constexpr uint64_t kShardParallelMin = 512ull << 10;
 
 uint64_t pick_chunk_bytes(uint64_t total_batch_bytes) {
   static const uint64_t forced = [] {
@@ -588,6 +740,20 @@ uint64_t pick_chunk_bytes(uint64_t total_batch_bytes) {
   return std::clamp(want, kChunkBytesMin, kChunkBytesMax);
 }
 
+// Intra-connection pipeline slice for staged sub-ops (see the block comment
+// above). 256 KiB keeps both sides inside L2 while giving the server a
+// useful head start; BTPU_PIPE_CHUNK overrides for perf experiments.
+constexpr uint64_t kPipeChunkMin = 64ull << 10;  // bounds the frame array too
+
+uint64_t pipe_chunk_bytes() {
+  static const uint64_t v = [] {
+    const char* env = std::getenv("BTPU_PIPE_CHUNK");
+    const uint64_t forced = env ? std::strtoull(env, nullptr, 10) : 0ull;
+    return forced ? std::clamp(forced, kPipeChunkMin, kStagingBytes) : 256ull << 10;
+  }();
+  return v;
+}
+
 struct SubOp {
   WireOp* op;
   uint64_t addr;   // absolute remote address of this chunk
@@ -601,24 +767,47 @@ bool use_staged(const PooledConn& c, const SubOp& sub) {
   return c.stg_base != nullptr && sub.len <= c.stg_len;
 }
 
+// A staged request with its trailing segment offset, as it crosses the wire.
+struct StagedFrame {
+  DataRequestHeader h;
+  uint64_t shm_off;
+} __attribute__((packed));
+
 ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
   if (use_staged(c, sub)) {
-    const uint8_t op = opcode == kOpWrite ? kOpWriteStaged : kOpReadStaged;
-    DataRequestHeader hdr{op, sub.addr, sub.op->rkey, sub.len};
-    const uint64_t shm_off = 0;  // one in-flight op per connection
-    if (op == kOpWriteStaged) {
-      // Fused copy+crc: the staging of the bytes is the only client-side
-      // read of them either way, so want_crc writes get their shard stamp
-      // for free here (put-path mirror of the read-side drain fusion).
-      sub.crc = sub.op->want_crc ? crc32c_copy(c.stg_base, sub.buf, sub.len)
-                                 : (std::memcpy(c.stg_base, sub.buf, sub.len), 0u);
+    const uint64_t pipe = pipe_chunk_bytes();
+    if (opcode == kOpWrite) {
+      // Pipelined staging: copy+hash one chunk into the segment, send its
+      // header, move to the next — the server's segment->target copy of
+      // chunk N runs while chunk N+1 is being staged. The staging copy is
+      // the only client-side read of the bytes, so want_crc writes get
+      // their shard stamp for free (seed-chained across chunks).
+      Crc32cStream crc;
+      for (uint64_t off = 0; off < sub.len; off += pipe) {
+        const uint64_t n = std::min(pipe, sub.len - off);
+        if (sub.op->want_crc) {
+          crc.update_copy(c.stg_base + off, sub.buf + off, n);
+        } else {
+          std::memcpy(c.stg_base + off, sub.buf + off, n);
+        }
+        StagedFrame framed{{kOpWriteStaged, sub.addr + off, sub.op->rkey, n}, off};
+        if (auto ec = net::write_all(c.sock.fd(), &framed, sizeof(framed));
+            ec != ErrorCode::OK)
+          return ec;
+      }
+      if (sub.op->want_crc) sub.crc = crc.value();
+      return ErrorCode::OK;
     }
-    g_staged_ops.fetch_add(1);
-    struct {
-      DataRequestHeader h;
-      uint64_t off;
-    } __attribute__((packed)) framed{hdr, shm_off};
-    return net::write_all(c.sock.fd(), &framed, sizeof(framed));
+    // Staged read: every chunk request goes out in one send; the server
+    // fills chunk N's segment slice and acks it while the client is still
+    // draining chunk N-1 (the drain happens in collect_sub, in order).
+    StagedFrame frames[kStagingBytes / kPipeChunkMin];
+    size_t nframes = 0;
+    for (uint64_t off = 0; off < sub.len; off += pipe) {
+      const uint64_t n = std::min(pipe, sub.len - off);
+      frames[nframes++] = {{kOpReadStaged, sub.addr + off, sub.op->rkey, n}, off};
+    }
+    return net::write_all(c.sock.fd(), frames, nframes * sizeof(StagedFrame));
   }
   DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len};
   if (opcode == kOpWrite) {
@@ -634,8 +823,47 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
 // Reads one response. `healthy` reports whether the stream is still aligned
 // (server-reported errors keep the connection reusable; socket errors don't).
 ErrorCode collect_sub(const PooledConn& c, SubOp& sub, uint8_t opcode, bool& healthy) {
-  uint32_t status = 0;
   healthy = false;
+  if (use_staged(c, sub)) {
+    // Per-chunk statuses, in issue order. Every status is drained even past
+    // the first error so the stream stays aligned for the next op.
+    const uint64_t pipe = pipe_chunk_bytes();
+    ErrorCode first = ErrorCode::OK;
+    Crc32cStream crc;
+    const bool want_crc = sub.op->want_crc;
+    for (uint64_t off = 0; off < sub.len; off += pipe) {
+      const uint64_t n = std::min(pipe, sub.len - off);
+      uint32_t status = 0;
+      if (auto ec = net::read_exact(c.sock.fd(), &status, sizeof(status));
+          ec != ErrorCode::OK)
+        return ec;
+      if (static_cast<ErrorCode>(status) != ErrorCode::OK) {
+        if (first == ErrorCode::OK) first = static_cast<ErrorCode>(status);
+        continue;
+      }
+      if (opcode == kOpRead) {
+        // Fused copy+crc: the drain out of the staging segment is the only
+        // read of the bytes either way; meanwhile the server is already
+        // copying the NEXT chunk into its slice of the segment.
+        if (want_crc) {
+          crc.update_copy(sub.buf + off, c.stg_base + off, n);
+        } else {
+          std::memcpy(sub.buf + off, c.stg_base + off, n);
+        }
+      }
+    }
+    if (opcode == kOpRead && want_crc) sub.crc = crc.value();
+    healthy = true;
+    // Lane accounting on COMPLETION only: a failed or retried sub-op must
+    // not inflate the copies-per-byte scoreboard (the pvm counters follow
+    // the same rule).
+    if (first == ErrorCode::OK) {
+      g_staged_ops.add();
+      g_staged_bytes.add(sub.len);
+    }
+    return first;
+  }
+  uint32_t status = 0;
   if (auto ec = net::read_exact(c.sock.fd(), &status, sizeof(status)); ec != ErrorCode::OK)
     return ec;
   if (static_cast<ErrorCode>(status) != ErrorCode::OK) {
@@ -644,12 +872,7 @@ ErrorCode collect_sub(const PooledConn& c, SubOp& sub, uint8_t opcode, bool& hea
   }
   if (opcode == kOpRead) {
     const bool want_crc = sub.op->want_crc;
-    if (use_staged(c, sub)) {
-      // Fused copy+crc: the drain out of the staging segment is the only
-      // read of the bytes either way.
-      sub.crc = want_crc ? crc32c_copy(sub.buf, c.stg_base, sub.len)
-                         : (std::memcpy(sub.buf, c.stg_base, sub.len), 0u);
-    } else if (!want_crc) {
+    if (!want_crc) {
       if (auto ec = net::read_exact(c.sock.fd(), sub.buf, sub.len); ec != ErrorCode::OK)
         return ec;
     } else {
@@ -657,17 +880,19 @@ ErrorCode collect_sub(const PooledConn& c, SubOp& sub, uint8_t opcode, bool& hea
       // delivering the next one into the socket buffer — the CRC rides
       // under the wire instead of costing a post-pass.
       constexpr uint64_t kSeg = 256 * 1024;
-      uint32_t crc = 0;
+      Crc32cStream crc;
       for (uint64_t pos = 0; pos < sub.len; pos += kSeg) {
         const uint64_t n = std::min(kSeg, sub.len - pos);
         if (auto ec = net::read_exact(c.sock.fd(), sub.buf + pos, n); ec != ErrorCode::OK)
           return ec;
-        crc = crc32c(sub.buf + pos, n, crc);
+        crc.update(sub.buf + pos, n);
       }
-      sub.crc = crc;
+      sub.crc = crc.value();
     }
   }
   healthy = true;
+  g_stream_ops.add();  // completion-only accounting, like the staged branch
+  g_stream_bytes.add(sub.len);
   return ErrorCode::OK;
 }
 
@@ -676,21 +901,44 @@ bool is_socket_failure(ErrorCode ec) {
          ec == ErrorCode::CONNECTION_FAILED;
 }
 
-// Endpoints whose connect failed once in this batch: every later sub-op to
-// them fails immediately instead of re-paying the connect timeout serially
-// (a preempted worker must not stall the whole pipeline N x 5s — the caller
-// falls back to another replica).
-using DeadEndpoints = std::unordered_map<std::string, ErrorCode>;
+// State shared across the batch's engine slices. `dead` memoizes endpoints
+// whose connect failed once in this batch: every later sub-op to them fails
+// immediately instead of re-paying the connect timeout serially (a preempted
+// worker must not stall the whole pipeline N x 5s — the caller falls back to
+// another replica). Ops are partitioned whole onto slices, so op->status
+// stays single-writer; only `dead` and `first` cross threads.
+struct BatchShared {
+  std::mutex mutex;
+  std::unordered_map<std::string, ErrorCode> dead;
+  ErrorCode first{ErrorCode::OK};
+
+  bool known_dead(const std::string& endpoint, ErrorCode& ec) {
+    std::lock_guard<std::mutex> lock(mutex);
+    auto it = dead.find(endpoint);
+    if (it == dead.end()) return false;
+    ec = it->second;
+    return true;
+  }
+  void mark_dead(const std::string& endpoint, ErrorCode ec) {
+    std::lock_guard<std::mutex> lock(mutex);
+    dead.emplace(endpoint, ec);
+  }
+  void fail(WireOp* op, ErrorCode ec) {
+    if (op->status == ErrorCode::OK) op->status = ec;
+    std::lock_guard<std::mutex> lock(mutex);
+    if (first == ErrorCode::OK) first = ec;
+  }
+};
 
 // Synchronous single-shot on a fresh connection (retry path).
-ErrorCode run_sub_fresh(SubOp& sub, uint8_t opcode, DeadEndpoints& dead) {
+ErrorCode run_sub_fresh(SubOp& sub, uint8_t opcode, BatchShared& shared) {
   auto& pool = TcpEndpointPool::instance();
   const std::string& endpoint = sub.op->remote->endpoint;
-  if (auto it = dead.find(endpoint); it != dead.end()) return it->second;
+  if (ErrorCode dead_ec; shared.known_dead(endpoint, dead_ec)) return dead_ec;
   pool.drop_endpoint(endpoint);  // the whole pool is suspect once one died
   auto acquired = pool.acquire(endpoint);
   if (!acquired.ok()) {
-    dead.emplace(endpoint, acquired.error());
+    shared.mark_dead(endpoint, acquired.error());
     return acquired.error();
   }
   PooledConn c = std::move(acquired).value();
@@ -701,69 +949,47 @@ ErrorCode run_sub_fresh(SubOp& sub, uint8_t opcode, DeadEndpoints& dead) {
   return ec;
 }
 
-}  // namespace
-
-ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency) {
-  const uint8_t opcode = is_write ? kOpWrite : kOpRead;
-  const size_t inflight_cap =
-      max_concurrency ? std::min(max_concurrency, kMaxInflight) : kMaxInflight;
-  uint64_t total_bytes = 0;
-  for (size_t i = 0; i < n; ++i) total_bytes += ops[i].len;
-  const uint64_t chunk_bytes = pick_chunk_bytes(total_bytes);
-  std::vector<SubOp> subs;
-  subs.reserve(n);
-  for (size_t i = 0; i < n; ++i) {
-    ops[i].status = ErrorCode::OK;
-    ops[i].crc = 0;
-    for (uint64_t off = 0; off < ops[i].len; off += chunk_bytes) {
-      const uint64_t len = std::min(chunk_bytes, ops[i].len - off);
-      subs.push_back({&ops[i], ops[i].addr + off, ops[i].buf + off, len, off, 0});
-    }
-  }
-
+// One engine slice: issues/collects the sub-ops named by `order` with its
+// own in-flight window and pooled connections. Runs standalone for a serial
+// batch, or as one lane of the shard-parallel fan-out.
+void run_subs(std::vector<SubOp>& subs, const std::vector<size_t>& order, uint8_t opcode,
+              size_t inflight_cap, BatchShared& shared) {
   auto& pool = TcpEndpointPool::instance();
-  ErrorCode first = ErrorCode::OK;
-  auto fail = [&](WireOp* op, ErrorCode ec) {
-    if (op->status == ErrorCode::OK) op->status = ec;
-    if (first == ErrorCode::OK) first = ec;
-  };
-
   struct Flight {
     size_t sub;
     PooledConn conn;
   };
   std::vector<Flight> inflight;
-  DeadEndpoints dead;
   size_t next = 0;
-  while (next < subs.size() || !inflight.empty()) {
-    if (next < subs.size() && inflight.size() < inflight_cap) {
-      SubOp& sub = subs[next];
+  while (next < order.size() || !inflight.empty()) {
+    if (next < order.size() && inflight.size() < inflight_cap) {
+      SubOp& sub = subs[order[next]];
       if (sub.op->status != ErrorCode::OK) {  // sibling chunk already failed
         ++next;
         continue;
       }
-      if (auto it = dead.find(sub.op->remote->endpoint); it != dead.end()) {
-        fail(sub.op, it->second);
+      if (ErrorCode dead_ec; shared.known_dead(sub.op->remote->endpoint, dead_ec)) {
+        shared.fail(sub.op, dead_ec);
         ++next;
         continue;
       }
       auto acquired = pool.acquire(sub.op->remote->endpoint);
       if (!acquired.ok()) {
-        dead.emplace(sub.op->remote->endpoint, acquired.error());
-        fail(sub.op, acquired.error());
+        shared.mark_dead(sub.op->remote->endpoint, acquired.error());
+        shared.fail(sub.op, acquired.error());
         ++next;
         continue;
       }
       PooledConn c = std::move(acquired).value();
       if (auto ec = issue_sub(c, sub, opcode); ec != ErrorCode::OK) {
         // Stale pooled connection dies at send time: one fresh retry.
-        if (auto rec = is_socket_failure(ec) ? run_sub_fresh(sub, opcode, dead) : ec;
+        if (auto rec = is_socket_failure(ec) ? run_sub_fresh(sub, opcode, shared) : ec;
             rec != ErrorCode::OK)
-          fail(sub.op, rec);
+          shared.fail(sub.op, rec);
         ++next;
         continue;
       }
-      inflight.push_back({next, std::move(c)});
+      inflight.push_back({order[next], std::move(c)});
       ++next;
       continue;
     }
@@ -798,9 +1024,74 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     } else if (is_socket_failure(ec)) {
       // Stale pooled connection dies at response time (or the worker
       // restarted mid-op): the op is idempotent, re-run it once.
-      ec = run_sub_fresh(sub, opcode, dead);
+      ec = run_sub_fresh(sub, opcode, shared);
     }
-    if (ec != ErrorCode::OK) fail(sub.op, ec);
+    if (ec != ErrorCode::OK) shared.fail(sub.op, ec);
+  }
+}
+
+}  // namespace
+
+void wire_parallel_for(size_t n, const std::function<void(size_t)>& fn) {
+  WireWorkers::instance().run(n, fn);
+}
+
+size_t wire_parallel_capacity() noexcept { return WireWorkers::instance().capacity(); }
+
+ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency) {
+  const uint8_t opcode = is_write ? kOpWrite : kOpRead;
+  const size_t inflight_cap =
+      max_concurrency ? std::min(max_concurrency, kMaxInflight) : kMaxInflight;
+  uint64_t total_bytes = 0;
+  for (size_t i = 0; i < n; ++i) total_bytes += ops[i].len;
+  const uint64_t chunk_bytes = pick_chunk_bytes(total_bytes);
+  std::vector<SubOp> subs;
+  subs.reserve(n);
+  // Sub-ops of one op stay contiguous (the CRC fold below relies on offset
+  // order) and `groups` records each op's [begin, end) span so the parallel
+  // path can partition whole ops onto slices.
+  std::vector<std::pair<size_t, size_t>> groups;
+  groups.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    ops[i].status = ErrorCode::OK;
+    ops[i].crc = 0;
+    const size_t begin = subs.size();
+    for (uint64_t off = 0; off < ops[i].len; off += chunk_bytes) {
+      const uint64_t len = std::min(chunk_bytes, ops[i].len - off);
+      subs.push_back({&ops[i], ops[i].addr + off, ops[i].buf + off, len, off, 0});
+    }
+    if (subs.size() > begin) groups.emplace_back(begin, subs.size());
+  }
+
+  BatchShared shared;
+  size_t nslices = 1;
+  if (groups.size() > 1 && inflight_cap > 1 && total_bytes >= kShardParallelMin)
+    nslices = std::min({groups.size(), wire_parallel_capacity() + 1, inflight_cap});
+  if (nslices <= 1) {
+    std::vector<size_t> order(subs.size());
+    for (size_t i = 0; i < subs.size(); ++i) order[i] = i;
+    run_subs(subs, order, opcode, inflight_cap, shared);
+  } else {
+    // Shard-parallel: ops round-robin onto slices (shards of a striped get
+    // are near-equal, so this balances bytes), each slice drains its own
+    // connections concurrently; WireWorkers::run is the completion barrier.
+    std::vector<std::vector<size_t>> slices(nslices);
+    for (size_t g = 0; g < groups.size(); ++g) {
+      auto& slice = slices[g % nslices];
+      for (size_t s = groups[g].first; s < groups[g].second; ++s) slice.push_back(s);
+    }
+    const size_t slice_cap = std::max<size_t>(2, inflight_cap / nslices);
+    wire_parallel_for(nslices, [&](size_t s) {
+      try {
+        run_subs(subs, slices[s], opcode, slice_cap, shared);
+      } catch (...) {
+        // Allocation failure mid-slice (inflight/pollfd growth): fail the
+        // slice's ops — conservative for sub-ops that already landed, but
+        // one-sided ops are idempotent and the caller retries/fails over.
+        // Silently dropping them would report success for unmoved bytes.
+        for (size_t idx : slices[s]) shared.fail(subs[idx].op, ErrorCode::INTERNAL_ERROR);
+      }
+    });
   }
   // Per-op CRC from the per-chunk CRCs (reads hash while draining, writes
   // while staging/sending). Chunks completed in any order, but each op's
@@ -811,7 +1102,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     if (!op->want_crc || op->status != ErrorCode::OK) continue;
     op->crc = sub.off == 0 ? sub.crc : crc32c_combine(op->crc, sub.crc, sub.len);
   }
-  return first;
+  return shared.first;
 }
 
 namespace {

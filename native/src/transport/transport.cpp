@@ -123,28 +123,66 @@ class MuxTransportClient : public TransportClient {
 
  private:
   static ErrorCode batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency) {
-    ErrorCode first = ErrorCode::OK;
-    std::vector<WireOp*> tcp_ops;
+    // Memory-lane ops (LOCAL/SHM memcpy, pvm syscall) of a large batch run
+    // shard-parallel across the wire worker pool: a striped get's shards
+    // previously copied one after another on the calling thread even though
+    // each shard is an independent one-sided copy. Below the threshold (or
+    // on a single-core box) the inline loop stays — fan-out wakeups cost
+    // more than a few hundred KiB of memcpy returns.
+    constexpr uint64_t kParallelMemBytes = 512ull << 10;
+    uint64_t mem_bytes = 0;
+    size_t mem_ops = 0;
     for (size_t i = 0; i < n; ++i) {
+      if (ops[i].len == 0) continue;
+      ++mem_ops;  // pvm-eligible TCP ops count too; the lane IS a memcpy
+      mem_bytes += ops[i].len;
+    }
+    // to_tcp[i] marks ops the socket pipeline must carry (TCP descriptors
+    // the pvm lane declined); set by run_one, consumed after the barrier.
+    std::vector<uint8_t> to_tcp(n, 0);
+    auto run_one = [&](size_t i) {
       WireOp& op = ops[i];
       op.status = ErrorCode::OK;
-      if (op.len == 0) continue;
+      if (op.len == 0) return;
       if (op.remote->transport == TransportKind::TCP) {
         // Same-host one-sided lane first: the client moves the bytes itself
         // (one kernel copy, zero worker CPU) instead of the two-copy staged
         // pipeline. Only TCP descriptors consult it — LOCAL is already an
         // in-process memcpy and SHM a direct segment copy, both cheaper
         // than a process_vm syscall. false = op proceeds on the pipeline.
-        if (pvm_access(*op.remote, op.addr, op.buf, op.len, is_write,
-                       op.want_crc ? &op.crc : nullptr)) {
-          continue;
+        if (!pvm_access(*op.remote, op.addr, op.buf, op.len, is_write,
+                        op.want_crc ? &op.crc : nullptr)) {
+          to_tcp[i] = 1;
         }
-        tcp_ops.push_back(&op);
-        continue;
+        return;
       }
       op.status = access(*op.remote, op.addr, op.rkey, op.buf, op.len, is_write,
                          op.want_crc ? &op.crc : nullptr);
-      if (op.status != ErrorCode::OK && first == ErrorCode::OK) first = op.status;
+    };
+    // The wrapper (not run_one itself) owns exception containment: on a
+    // pool worker an escaped exception is swallowed by the pool and the op
+    // would otherwise read as success for unmoved bytes.
+    auto run_one_contained = [&](size_t i) {
+      try {
+        run_one(i);
+      } catch (...) {
+        ops[i].status = ErrorCode::INTERNAL_ERROR;
+      }
+    };
+    if (mem_ops > 1 && mem_bytes >= kParallelMemBytes && wire_parallel_capacity() > 0 &&
+        max_concurrency != 1) {
+      wire_parallel_for(n, run_one_contained);
+    } else {
+      for (size_t i = 0; i < n; ++i) run_one(i);
+    }
+    ErrorCode first = ErrorCode::OK;
+    std::vector<WireOp*> tcp_ops;
+    for (size_t i = 0; i < n; ++i) {
+      if (to_tcp[i]) {
+        tcp_ops.push_back(&ops[i]);
+      } else if (ops[i].status != ErrorCode::OK && first == ErrorCode::OK) {
+        first = ops[i].status;
+      }
     }
     if (!tcp_ops.empty()) {
       // Compact the TCP subset so the pipeline sees a contiguous array.

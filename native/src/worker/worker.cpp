@@ -247,8 +247,14 @@ ErrorCode WorkerService::initialize() {
     // MemoryLocation placements consult it (device-mesh DeviceLocation
     // pools address the provider instead).
     if (base) {
-      runtime.record.remote.pvm_endpoint =
-          transport::pvm_make_endpoint(base, pool_cfg.capacity, /*writable=*/true);
+      // Same-process clients (embedded cluster) get the one-copy direct
+      // lane only for regions this registry vouches for; the generation in
+      // the endpoint pins the placement to THIS registration, and stop()
+      // retires it before the backing memory is freed.
+      const uint64_t self_gen =
+          transport::pvm_register_self_region(base, pool_cfg.capacity);
+      runtime.record.remote.pvm_endpoint = transport::pvm_make_endpoint(
+          base, pool_cfg.capacity, /*writable=*/true, self_gen);
     } else if (const void* view = runtime.backend->host_view_base()) {
       runtime.record.remote.pvm_endpoint =
           transport::pvm_make_endpoint(view, pool_cfg.capacity, /*writable=*/false);
@@ -381,6 +387,13 @@ void WorkerService::stop() {
   // it safe to free backend memory.
   if (virtual_transport_) virtual_transport_->stop();
   if (primary_transport_) primary_transport_->stop();
+  for (auto& p : pools_) {
+    // Retire the same-process one-copy lane before the memory goes away;
+    // this blocks until in-flight direct copies drain (see transport.h).
+    if (p.backend) {
+      if (void* b = p.backend->base_address()) transport::pvm_retire_self_region(b);
+    }
+  }
   for (auto& p : pools_) {
     if (p.backend) p.backend->shutdown();
   }

@@ -73,6 +73,48 @@ BTEST(Crc32c, CombineMatchesConcatenation) {
   BT_EXPECT(dst == data);
 }
 
+BTEST(Crc32c, StreamMatchesWholeObjectAcrossUnevenChunks) {
+  // The pipelined staged lane feeds Crc32cStream one pipe chunk at a time;
+  // its final value must equal the whole-object crc32c for ANY chunking —
+  // including uneven boundaries (last chunk short, chunk > remaining, a
+  // 1-byte chunk mid-stream). A seed-chaining bug here would surface as
+  // spurious CHECKSUM_MISMATCH on every pipelined verified read.
+  std::vector<uint8_t> data(200'001);  // odd length: the tail never aligns
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 89 + 3);
+  const uint32_t whole = crc32c(data.data(), data.size());
+
+  for (size_t chunk : {size_t{1}, size_t{333}, size_t{4096}, size_t{65'536},
+                       size_t{131'072}, data.size(), data.size() + 1}) {
+    Crc32cStream plain;
+    Crc32cStream fused;
+    std::vector<uint8_t> dst(data.size(), 0);
+    for (size_t off = 0; off < data.size(); off += chunk) {
+      const size_t n = std::min(chunk, data.size() - off);
+      plain.update(data.data() + off, n);
+      fused.update_copy(dst.data() + off, data.data() + off, n);
+    }
+    BT_EXPECT_EQ(plain.value(), whole);
+    BT_EXPECT_EQ(fused.value(), whole);
+    BT_EXPECT_EQ(plain.length(), data.size());
+    BT_EXPECT(dst == data);
+  }
+
+  // Mixed uneven chunks in one stream (the shapes a retried/split transfer
+  // produces), and equivalence with the combine fold of per-chunk CRCs.
+  Crc32cStream mixed;
+  const size_t cuts[] = {1, 12'345, 50'000, 99'999, data.size()};
+  size_t prev = 0;
+  uint32_t folded = 0;
+  for (size_t cut : cuts) {
+    mixed.update(data.data() + prev, cut - prev);
+    const uint32_t piece = crc32c(data.data() + prev, cut - prev);
+    folded = prev == 0 ? piece : crc32c_combine(folded, piece, cut - prev);
+    prev = cut;
+  }
+  BT_EXPECT_EQ(mixed.value(), whole);
+  BT_EXPECT_EQ(folded, whole);
+}
+
 BTEST(Error, DomainsPartitionCodes) {
   BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::OK), 0u);
   BT_EXPECT_EQ(static_cast<uint32_t>(ErrorCode::INTERNAL_ERROR), 1000u);

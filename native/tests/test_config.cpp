@@ -1,5 +1,7 @@
 // YAML-subset parser + KeystoneConfig::from_yaml tests
 // (parity: reference src/common/types.cpp:20-101 config loading).
+#include <unistd.h>
+
 #include <cstdio>
 #include <fstream>
 

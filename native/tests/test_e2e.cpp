@@ -2,6 +2,8 @@
 // backends, in every wiring (embedded/local, shm, full TCP with RPC), plus
 // failure/failover flows. This is the hermetic put->write->complete->
 // get->verify slice SURVEY §7 defines as the minimum e2e artifact.
+#include <unistd.h>
+
 #include <cstring>
 #include <random>
 #include <set>

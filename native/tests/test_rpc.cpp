@@ -1,5 +1,7 @@
 // RPC layer tests: the full method surface over real TCP, malformed frames, reconnect,
 // and the live /metrics endpoint (the reference's was unimplemented).
+#include <unistd.h>
+
 #include <cstring>
 
 #include "btest.h"

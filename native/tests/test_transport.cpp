@@ -3,6 +3,7 @@
 // Parity notes: the reference only exercises its transport via manual demo
 // binaries (clients/ucx_client.cpp); here the contract is unit-tested.
 #include <atomic>
+#include <algorithm>
 #include <chrono>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -387,6 +388,119 @@ BTEST(Transport, WantCrcFusesIntoWritesAcrossLanes) {
   }
 }
 
+BTEST(Transport, TcpStagedPipelineChunksRoundtripUnevenSizes) {
+  // The staged lane moves a sub-op through the segment in pipe chunks
+  // (client drains chunk N while the server stages chunk N+1). Sizes chosen
+  // to hit every boundary shape: below one pipe chunk, exactly one, a
+  // multiple, and a multi-chunk op with a short odd tail — each must
+  // roundtrip byte-exact with the fused CRC equal to the whole-range hash,
+  // in both directions, on flat AND virtual (callback-backed) regions.
+  auto server = make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  constexpr uint64_t kRegion = 4ull << 20;
+  std::vector<uint8_t> flat_region(kRegion, 0);
+  auto flat = server->register_region(flat_region.data(), kRegion, "pipe");
+  BT_ASSERT_OK(flat);
+  std::vector<uint8_t> store(kRegion, 0);
+  auto virt = server->register_virtual_region(
+      kRegion, "pipe-virt",
+      [&](uint64_t off, void* dst, uint64_t len) {
+        std::memcpy(dst, store.data() + off, len);
+        return ErrorCode::OK;
+      },
+      [&](uint64_t off, const void* src, uint64_t len) {
+        std::memcpy(store.data() + off, src, len);
+        return ErrorCode::OK;
+      });
+  BT_ASSERT_OK(virt);
+  auto client = make_transport_client();
+
+  for (uint64_t len : {uint64_t{70'000}, uint64_t{256} << 10, uint64_t{512} << 10,
+                       (uint64_t{3} << 20) + 12'345}) {
+    std::vector<uint8_t> src(len);
+    for (size_t i = 0; i < src.size(); ++i)
+      src[i] = static_cast<uint8_t>((i * 151 + len) >> 2 ^ i);
+    const uint32_t expect = crc32c(src.data(), len);
+    for (const auto& desc : {flat.value(), virt.value()}) {
+      WireOp put{&desc, desc.remote_base + 777, parse_rkey(desc), src.data(), len};
+      put.want_crc = true;
+      BT_EXPECT(client->write_batch(&put, 1) == ErrorCode::OK);
+      BT_EXPECT_EQ(put.crc, expect);
+      std::vector<uint8_t> dst(len, 0);
+      WireOp get{&desc, desc.remote_base + 777, parse_rkey(desc), dst.data(), len};
+      get.want_crc = true;
+      BT_EXPECT(client->read_batch(&get, 1) == ErrorCode::OK);
+      BT_EXPECT_EQ(get.crc, expect);
+      BT_EXPECT(dst == src);
+    }
+    BT_EXPECT(std::memcmp(flat_region.data() + 777, src.data(), len) == 0);
+    BT_EXPECT(std::memcmp(store.data() + 777, src.data(), len) == 0);
+  }
+  // A bounds violation on a pipelined op fails cleanly AND leaves the
+  // connection stream aligned (every chunk status is drained): the next op
+  // on the pooled connection still works.
+  const auto& desc = flat.value();
+  std::vector<uint8_t> buf(2ull << 20);
+  WireOp bad{&desc, desc.remote_base + kRegion - 4096, parse_rkey(desc), buf.data(),
+             buf.size()};
+  BT_EXPECT(make_transport_client()->read_batch(&bad, 1) == ErrorCode::MEMORY_ACCESS_ERROR);
+  WireOp ok{&desc, desc.remote_base + 777, parse_rkey(desc), buf.data(), 70'000};
+  ok.want_crc = true;
+  BT_EXPECT(make_transport_client()->read_batch(&ok, 1) == ErrorCode::OK);
+  BT_EXPECT_EQ(ok.crc, crc32c(flat_region.data() + 777, 70'000));
+  server->stop();
+}
+
+BTEST(Transport, TcpStagedLaneFourConcurrentClients) {
+  // >= 4 client threads against ONE worker through the staged lane (the
+  // multi-client contention shape the sharded pool/counters exist for):
+  // every thread runs verified batched writes+reads of its own disjoint
+  // window, all bytes and fused CRCs must come back exact, and the staged
+  // lane must actually have carried the ops.
+  auto server = make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  constexpr int kThreads = 4;
+  constexpr uint64_t kWindow = 768ull << 10;  // > pipe chunk: chunked subs too
+  std::vector<uint8_t> region(kThreads * kWindow, 0);
+  auto reg = server->register_region(region.data(), region.size(), "mt");
+  BT_ASSERT_OK(reg);
+  const auto desc = reg.value();
+  const uint64_t rkey = parse_rkey(desc);
+  const uint64_t staged_before = tcp_staged_op_count();
+
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&, t] {
+      auto client = make_transport_client();
+      const uint64_t base = desc.remote_base + static_cast<uint64_t>(t) * kWindow;
+      std::vector<uint8_t> src(kWindow);
+      std::vector<uint8_t> dst(kWindow);
+      for (int round = 0; round < 6; ++round) {
+        for (size_t i = 0; i < src.size(); ++i)
+          src[i] = static_cast<uint8_t>(i * (t + 3) + round * 17);
+        const uint32_t expect = crc32c(src.data(), src.size());
+        WireOp put{&desc, base, rkey, src.data(), kWindow};
+        put.want_crc = true;
+        if (client->write_batch(&put, 1) != ErrorCode::OK || put.crc != expect) {
+          ++failures;
+          continue;
+        }
+        std::fill(dst.begin(), dst.end(), 0);
+        WireOp get{&desc, base, rkey, dst.data(), kWindow};
+        get.want_crc = true;
+        if (client->read_batch(&get, 1) != ErrorCode::OK || get.crc != expect ||
+            dst != src)
+          ++failures;
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  BT_EXPECT_EQ(failures.load(), 0);
+  BT_EXPECT(tcp_staged_op_count() > staged_before);
+  server->stop();
+}
+
 BTEST(Transport, TcpBatchFailsFastOnDeadEndpoint) {
   // One unreachable endpoint in a batch must not sink the ops aimed at the
   // live one, and every op to the dead endpoint shares one connect attempt
@@ -485,17 +599,59 @@ BTEST(Transport, RkeyHexRoundtrip) {
 
 // ---- PVM lane (same-host one-sided via process_vm_readv/writev) -----------
 
-BTEST(Transport, PvmEndpointSelfProcessExcluded) {
-  // Own-process regions must NOT route through the syscall (the LOCAL lane
-  // is a plain memcpy): pvm_access declines and the caller falls through.
-  std::vector<uint8_t> region(4096, 7);
+BTEST(Transport, PvmSelfProcessServesRegisteredRegionsOneCopy) {
+  // Own-process endpoints are the ONE-COPY fast path, gated on the self
+  // registry: a writable region the worker registered serves direct fused
+  // copies; an unregistered endpoint (stale placement, or a minted string
+  // nobody vouched for) must decline writes — pvm_access falls back and
+  // the staged lane's rkey check judges it. Retirement closes the lane
+  // again BEFORE the memory is freed.
+  std::vector<uint8_t> region(8192, 7);
   RemoteDescriptor desc;
-  desc.transport = TransportKind::LOCAL;
-  desc.remote_base = 0;
-  desc.pvm_endpoint = pvm_make_endpoint(region.data(), region.size());
-  BT_EXPECT(!desc.pvm_endpoint.empty());
+  desc.transport = TransportKind::TCP;
+  desc.remote_base = 0x4000;
   std::vector<uint8_t> out(64, 0);
-  BT_EXPECT(!pvm_access(desc, 0, out.data(), out.size(), /*is_write=*/false, nullptr));
+  uint8_t val = 0xAB;
+  {
+    // Unregistered (no generation token): the registry is authoritative for
+    // writable self regions — both directions decline (a stale placement
+    // must fail over cleanly, not read recycled heap), and the caller falls
+    // back to the staged lane.
+    RemoteDescriptor unvouched = desc;
+    unvouched.pvm_endpoint = pvm_make_endpoint(region.data(), region.size());
+    BT_EXPECT(!unvouched.pvm_endpoint.empty());
+    BT_EXPECT(!pvm_access(unvouched, 0x4000, &val, 1, /*is_write=*/true, nullptr));
+    BT_EXPECT(!pvm_access(unvouched, 0x4000, out.data(), out.size(), false, nullptr));
+  }
+
+  const uint64_t gen = pvm_register_self_region(region.data(), region.size());
+  BT_EXPECT(gen != 0);
+  desc.pvm_endpoint = pvm_make_endpoint(region.data(), region.size(),
+                                        /*writable=*/true, gen);
+  BT_EXPECT(!desc.pvm_endpoint.empty());
+  const uint64_t ops_before = pvm_op_count();
+  uint32_t crc = 0;
+  BT_EXPECT(pvm_access(desc, 0x4000 + 100, out.data(), out.size(), false, &crc));
+  BT_EXPECT(std::all_of(out.begin(), out.end(), [](uint8_t b) { return b == 7; }));
+  BT_EXPECT_EQ(crc, crc32c(out.data(), out.size()));
+  BT_EXPECT(pvm_access(desc, 0x4000 + 500, &val, 1, /*is_write=*/true, nullptr));
+  BT_EXPECT_EQ(int(region[500]), 0xAB);
+  BT_EXPECT(pvm_op_count() >= ops_before + 2);
+  // Bounds still enforced against the advertised window.
+  BT_EXPECT(!pvm_access(desc, 0x4000 + region.size() - 4, out.data(), 64, false, nullptr));
+
+  // Address reuse: a NEW registration at the same base (revived worker whose
+  // pool mmap landed on the old address) mints a new generation — the OLD
+  // placement's endpoint must mismatch and decline, never address the
+  // replacement pool's bytes.
+  pvm_retire_self_region(region.data());
+  const uint64_t gen2 = pvm_register_self_region(region.data(), region.size());
+  BT_EXPECT(gen2 != gen);
+  BT_EXPECT(!pvm_access(desc, 0x4000, &val, 1, /*is_write=*/true, nullptr));
+  BT_EXPECT(!pvm_access(desc, 0x4000, out.data(), out.size(), false, nullptr));
+
+  pvm_retire_self_region(region.data());
+  BT_EXPECT(!pvm_access(desc, 0x4000, &val, 1, /*is_write=*/true, nullptr));
 }
 
 BTEST(Transport, PvmCrossProcessRoundtripAndBounds) {

@@ -38,3 +38,14 @@ def built_native():
 
     native.build_native()
     return native
+
+
+def transfer_api_available() -> bool:
+    """Whether this jax ships jax.experimental.transfer (the device-fabric
+    substrate). Skip gate for fabric tests; the library itself degrades
+    through TransferLink when it is absent."""
+    try:
+        from jax.experimental import transfer  # noqa: F401, PLC0415
+        return True
+    except ImportError:
+        return False

@@ -20,6 +20,7 @@ BUILD = REPO_ROOT / "build"
 
 
 from blackbird_tpu.procluster import free_port  # shared with the launcher
+from conftest import transfer_api_available
 
 
 def wait_for(predicate, timeout=10.0, what="condition"):
@@ -486,6 +487,9 @@ pools:
         teardown(procs)
 
 
+@pytest.mark.skipif(not transfer_api_available(),
+                    reason="jax.experimental.transfer absent in this jax — "
+                           "no fabric substrate to ride")
 def test_fabric_client_moves_device_bytes_itself(tmp_path):
     """VERDICT r4 item 1 (the reference's defining property, TPU-shaped):
     a client that OWNS a JAX runtime moves device-tier bytes ITSELF over
@@ -1174,6 +1178,9 @@ def test_worker_restart_readopts_disk_objects(tmp_path, disk_class):
                 proc.kill()
 
 
+@pytest.mark.skipif(not transfer_api_available(),
+                    reason="jax.experimental.transfer absent in this jax — "
+                           "no fabric substrate to ride")
 @pytest.mark.parametrize("worker_env", [{}, {"BTPU_HBM_HOST_VIEW": "0"}],
                          ids=["host-view", "device-path"])
 def test_cross_process_device_moves_ride_the_fabric(tmp_path, worker_env):
@@ -1450,8 +1457,10 @@ def test_pvm_lane_striped_across_two_worker_processes(tmp_path):
 
 
 def test_pvm_soak_concurrent_clients_survive_worker_churn(tmp_path):
-    """Process-level chaos for the one-sided lane (bb-soak runs in ONE
-    process, where PVM never engages): two CLIENT PROCESSES hammer
+    """Process-level chaos for the one-sided lane (bb-soak covers the
+    in-process/self-registry shape; this covers the process_vm_readv
+    cross-process shape, whose failure modes — dead pids, partial copies —
+    only exist between processes): two CLIENT PROCESSES hammer
     replicated put/verified-get/remove loops over PVM while a worker is
     SIGKILLed mid-stream and a replacement joins. Every key a client
     reported stored must read back byte-correct at the end — mid-op

@@ -24,10 +24,31 @@ EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
 
 HDRS := $(shell find native/include native/src -name '*.h')
 
-.PHONY: all native examples clean
+.PHONY: all native examples clean tsan
 all: native
 native: $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES)
 examples: $(EXAMPLES)
+
+# ThreadSanitizer leg: rebuilds the native suite under -fsanitize=thread into
+# its own tree (objects are ABI-incompatible with the normal build) and runs
+# the concurrency-heavy suites — the object cache (lookup/fill/invalidate
+# races are its whole job) plus transport. main.cpp already compiles in
+# exe/tsan_rma_suppression.h, which silences the MODELED one-sided-RMA race
+# of the LOCAL transport (reader racing a remote write is emulated hardware
+# behavior, discarded through epoch/CRC gates downstream).
+# One command: `make tsan` (or scripts/tsan.sh).
+TSAN_BUILD := $(BUILD)/tsan
+TSAN_FILTERS ?= Cache Transport
+tsan:
+	$(MAKE) BUILD=$(TSAN_BUILD) \
+	  CXXFLAGS="-std=c++20 -O1 -g -fPIC -Wall -Wextra -Wno-unused-parameter \
+	            -Inative/include -pthread -fsanitize=thread" \
+	  LDFLAGS="-pthread -lrt -fsanitize=thread" \
+	  $(TSAN_BUILD)/libbtpu.so $(TSAN_BUILD)/btpu_tests
+	@set -e; for f in $(TSAN_FILTERS); do \
+	  echo "== tsan: $$f =="; \
+	  $(TSAN_BUILD)/btpu_tests --filter=$$f; \
+	done
 
 $(BUILD)/obj/%.o: %.cpp $(HDRS)
 	@mkdir -p $(dir $@)

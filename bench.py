@@ -23,6 +23,50 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent
 BASELINE_GBPS = 3.125  # 25 Gbps reference link (configs/worker.yaml:24)
 
+# Memoized TPU-device probe verdict (see tpu_probe below). The tunnel's
+# health is a process-lifetime fact; the old flow re-ran the 2x75 s timeout
+# dance for every device-dependent section.
+_TPU_PROBE: dict | None = None
+
+
+def tpu_probe() -> dict:
+    """Bounded TPU-device probe: throwaway subprocess + hard timeout, run AT
+    MOST ONCE per bench process. Two attempts because the tunnel flaps on
+    the scale of minutes and answers within ~20 s when healthy. The verdict
+    (devices found, or a recorded skip with probe_rc) is cached for the
+    process lifetime and printed exactly once; every device-tier section
+    consults it instead of probing — and timing out — again. A genuine
+    device-backend regression still can't hide: a section that hangs AFTER
+    a good probe is reported as a backend bug, not the tunnel."""
+    global _TPU_PROBE
+    if _TPU_PROBE is not None:
+        return _TPU_PROBE
+    probe_detail: dict = {}
+    for attempt in (1, 2):
+        try:
+            pr = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; ds = jax.devices(); "
+                 "print(len(ds), ds[0].platform, ds[0].device_kind)"],
+                capture_output=True, text=True, timeout=75, cwd=REPO_ROOT,
+            )
+            if pr.returncode == 0:
+                probe_detail = {"devices": pr.stdout.strip(), "probe_attempt": attempt}
+                break
+            probe_detail = {"skipped": "tunnel", "probe_rc": pr.returncode,
+                            "probe_attempts": attempt,
+                            "probe_stderr": pr.stderr.strip()[-200:]}
+        except subprocess.TimeoutExpired:
+            probe_detail = {"skipped": "tunnel", "probe_rc": "timeout",
+                            "probe_timeout_s": 75, "probe_attempts": attempt}
+    _TPU_PROBE = probe_detail
+    if "skipped" in probe_detail:
+        print(f"tpu probe: {json.dumps(probe_detail)} — device-tier sections skip "
+              "on this verdict (probed once, not per section)", file=sys.stderr)
+    else:
+        print(f"tpu probe ok: {json.dumps(probe_detail)}", file=sys.stderr)
+    return _TPU_PROBE
+
 
 def ensure_built() -> Path:
     sys.path.insert(0, str(REPO_ROOT))
@@ -457,6 +501,11 @@ def main() -> int:
     small_rows = min(small_runs, key=lambda rows: rows["get"]["p99_us"])
     small_rows = dict(small_rows)
     small_rows["put"] = min((r["put"] for r in small_runs), key=lambda x: x["p99_us"])
+    # Hot-get rows are best-of per op too (interference never helps).
+    for op in ("get_hot", "get_hot_cached"):
+        cands = [r[op] for r in small_runs if op in r]
+        if cands:
+            small_rows[op] = min(cands, key=lambda x: x["p99_us"])
     shm_rows = run_bench(binary, size=1 << 20, iterations=150, transport="shm")
     local_rows = run_bench(binary, size=1 << 20, iterations=150, transport="local")
     # Replicated read: split across both copies in parallel (vs one link).
@@ -532,6 +581,25 @@ def main() -> int:
             f"tcp repeat-read 64KiB (remote rpc): uncached p50 {ur['p50_us']:.1f}us "
             f"p99 {ur['p99_us']:.1f}us | placement-cached p50 {cr['p50_us']:.1f}us "
             f"p99 {cr['p99_us']:.1f}us",
+            file=sys.stderr,
+        )
+
+    # Hot-get A/B (ISSUE 2): one 64 KiB key re-read over a real RPC
+    # keystone, object cache off vs on. A hit is a lease-coherent local
+    # memcpy — zero keystone RTT, zero worker read, zero wire bytes (the
+    # lanes row counts it in the `cached` lane at 1 copy/byte).
+    if "get_hot" in small_rows and "get_hot_cached" in small_rows:
+        hu, hc = small_rows["get_hot"], small_rows["get_hot_cached"]
+        hit_ratio = small_rows.get("cache", {}).get("hit_ratio")
+        speedup = hc["gbps"] / hu["gbps"] if hu.get("gbps") else 0.0
+        ratio_note = f", hit_ratio {hit_ratio:.3f}" if hit_ratio is not None else ""
+        print(
+            f"hot-get 64KiB (object cache A/B, remote rpc): uncached "
+            f"p50 {hu['p50_us']:.1f}us p99 {hu['p99_us']:.1f}us "
+            f"({hu['gbps']:.2f} GB/s) | cached p50 {hc['p50_us']:.1f}us "
+            f"p99 {hc['p99_us']:.1f}us ({hc['gbps']:.2f} GB/s, "
+            f"{speedup:.1f}x{ratio_note}) — hits serve at memcpy speed with "
+            f"zero worker involvement",
             file=sys.stderr,
         )
 
@@ -698,38 +766,16 @@ def main() -> int:
     except subprocess.TimeoutExpired:
         print("fabric client row skipped: timed out", file=sys.stderr)
     # The device-tier section initializes the (possibly tunneled) TPU
-    # backend, which can HANG outright when the tunnel is sick. A bounded
-    # PRE-PROBE (throwaway subprocess, hard timeout) makes the skip reason a
-    # recorded FACT — "tunnel down, probe_rc=timeout" — so a genuine
-    # device-backend regression can never hide behind the environment
-    # excuse (VERDICT r4 item 5; r4's record said "tunnel down?" with a
-    # question mark).
-    probe_detail: dict = {}
-    # Two attempts: the tunnel flaps on the scale of minutes (observed up at
-    # minute 0, hung at minute 40, up again later) and answers within ~20 s
-    # when healthy, so a second 75 s try meaningfully raises the odds of
-    # catching a window without risking a long hang.
-    for attempt in (1, 2):
-        try:
-            pr = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; ds = jax.devices(); "
-                 "print(len(ds), ds[0].platform, ds[0].device_kind)"],
-                capture_output=True, text=True, timeout=75, cwd=REPO_ROOT,
-            )
-            if pr.returncode == 0:
-                probe_detail = {"devices": pr.stdout.strip(), "probe_attempt": attempt}
-                break
-            probe_detail = {"skipped": "tunnel", "probe_rc": pr.returncode,
-                            "probe_attempts": attempt,
-                            "probe_stderr": pr.stderr.strip()[-200:]}
-        except subprocess.TimeoutExpired:
-            probe_detail = {"skipped": "tunnel", "probe_rc": "timeout",
-                            "probe_timeout_s": 75, "probe_attempts": attempt}
+    # backend, which can HANG outright when the tunnel is sick. The bounded
+    # pre-probe (tpu_probe, memoized for the process lifetime) makes the
+    # skip reason a recorded FACT — "tunnel down, probe_rc=timeout" — so a
+    # genuine device-backend regression can never hide behind the
+    # environment excuse (VERDICT r4 item 5), and the 2x75 s timeout dance
+    # runs at most once per bench run, not once per section.
+    probe_detail = tpu_probe()
     if "skipped" in probe_detail:
-        print(f"hbm tier bench skipped: {json.dumps(probe_detail)}", file=sys.stderr)
+        print("hbm tier bench skipped (see tpu probe verdict above)", file=sys.stderr)
     else:
-        print(f"tpu probe ok: {json.dumps(probe_detail)}", file=sys.stderr)
         try:
             child = subprocess.run(
                 [sys.executable, str(Path(__file__).resolve()), "--hbm-only"],
@@ -775,6 +821,19 @@ def main() -> int:
     if "get_repeat" in small_rows and "get_cached" in small_rows:
         summary["repeat_get_64kib_p50_us"] = round(small_rows["get_repeat"]["p50_us"], 1)
         summary["cached_get_64kib_p50_us"] = round(small_rows["get_cached"]["p50_us"], 1)
+    # Object-cache headline (ISSUE 2 acceptance): cached hot-get latency,
+    # hit ratio, and the A/B speedup over the uncached remote lane.
+    if "get_hot_cached" in small_rows:
+        hc = small_rows["get_hot_cached"]
+        summary["hot_get_64kib_cached_p50_us"] = round(hc["p50_us"], 1)
+        summary["hot_get_64kib_cached_p99_us"] = round(hc["p99_us"], 1)
+        if "get_hot" in small_rows and small_rows["get_hot"].get("gbps"):
+            summary["hot_get_64kib_uncached_p99_us"] = round(
+                small_rows["get_hot"]["p99_us"], 1)
+            summary["cached_hot_get_speedup_x"] = round(
+                hc["gbps"] / small_rows["get_hot"]["gbps"], 2)
+        if "cache" in small_rows:
+            summary["cache_hit_ratio"] = small_rows["cache"]["hit_ratio"]
     print(json.dumps(summary))
     return 0
 

@@ -33,32 +33,63 @@ class Client:
     connect/object_exists/put/get/remove — with numpy-friendly helpers.
     """
 
-    def __init__(self, keystone_endpoint: str, *, verify: bool = True):
+    def __init__(self, keystone_endpoint: str, *, verify: bool = True,
+                 cache_bytes: int | None = None):
         """keystone_endpoint may be a comma-separated list ("host:a,host:b"):
         the first entry is the primary, the rest HA fallbacks the client
         rotates through on NOT_LEADER or connection failure.
 
         verify=False skips CRC verification on reads (and with it
         corrupt-replica failover / corrupt-shard reconstruction) — for
-        latency-critical paths that rely on background scrub instead."""
+        latency-critical paths that rely on background scrub instead.
+
+        cache_bytes arms the lease-coherent client object cache: repeated
+        hot gets of unchanged objects are served from local memory with zero
+        worker round trips, bounded-stale by the keystone-granted read lease
+        and revalidated (one control RTT) at lease expiry. None reads the
+        BTPU_CACHE_BYTES env var (unset/0 = off); see docs/OPERATIONS.md
+        for sizing and lease tuning."""
         self._cluster_ref = None
         self._handle = lib.btpu_client_create_remote(keystone_endpoint.encode())
         if not self._handle:
             raise RuntimeError(f"cannot reach keystone at {keystone_endpoint}")
         if not verify:
             lib.btpu_client_set_verify(self._handle, 0)
+        self._configure_cache(cache_bytes)
 
     def set_verify(self, verify: bool) -> None:
         """Toggle CRC verification on this client's reads (default on)."""
         lib.btpu_client_set_verify(self._handle, 1 if verify else 0)
 
+    def _configure_cache(self, cache_bytes: int | None) -> None:
+        import os
+
+        if cache_bytes is None:
+            cache_bytes = int(os.environ.get("BTPU_CACHE_BYTES", "0") or 0)
+        if cache_bytes and hasattr(lib, "btpu_client_cache_configure"):
+            lib.btpu_client_cache_configure(self._handle, cache_bytes)
+
+    def cache_stats(self) -> dict[str, int]:
+        """Object-cache counters (all zero when the cache is off):
+        hits/misses/fills, invalidations (watch/mutation-dropped entries),
+        stale_rejects (hits refused because the object version moved),
+        lease_expiries (hits that revalidated), evictions (capacity), and
+        the resident bytes/entries."""
+        out = (ctypes.c_uint64 * 9)()
+        if hasattr(lib, "btpu_client_cache_stats"):
+            check(lib.btpu_client_cache_stats(self._handle, out), "cache_stats")
+        keys = ("hits", "misses", "fills", "invalidations", "stale_rejects",
+                "lease_expiries", "evictions", "bytes", "entries")
+        return dict(zip(keys, (int(v) for v in out)))
+
     @classmethod
-    def _embedded(cls, cluster):
+    def _embedded(cls, cluster, cache_bytes: int | None = None):
         self = cls.__new__(cls)
         self._cluster_ref = cluster  # keep alive
         self._handle = lib.btpu_client_create_embedded(cluster._handle)
         if not self._handle:
             raise RuntimeError("embedded client creation failed")
+        self._configure_cache(cache_bytes)
         return self
 
     def put(
@@ -308,8 +339,9 @@ class Client:
         process's bytes, and how many. pvm = same-host one-sided
         process_vm_readv/writev (1 user-space copy per byte), staged =
         shm-staged TCP (2 copies), stream = socket payload (1 client-side
-        copy + the kernel socket path). Keys missing from older prebuilt
-        libraries read as 0."""
+        copy + the kernel socket path), cached = the client object cache
+        (0 wire bytes, 1 user-space copy out of local memory). Keys missing
+        from older prebuilt libraries read as 0."""
         names = {
             "pvm_ops": "btpu_pvm_op_count",
             "pvm_bytes": "btpu_pvm_byte_count",
@@ -317,6 +349,8 @@ class Client:
             "staged_bytes": "btpu_tcp_staged_byte_count",
             "stream_ops": "btpu_tcp_stream_op_count",
             "stream_bytes": "btpu_tcp_stream_byte_count",
+            "cached_ops": "btpu_cached_op_count",
+            "cached_bytes": "btpu_cached_byte_count",
         }
         return {key: int(getattr(lib, fn)()) if hasattr(lib, fn) else 0
                 for key, fn in names.items()}

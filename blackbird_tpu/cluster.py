@@ -36,10 +36,10 @@ class EmbeddedCluster:
         if not self._handle:
             raise RuntimeError("embedded cluster failed to start")
 
-    def client(self):
+    def client(self, cache_bytes: int | None = None):
         from blackbird_tpu.client import Client
 
-        return Client._embedded(self)
+        return Client._embedded(self, cache_bytes=cache_bytes)
 
     @property
     def worker_count(self) -> int:

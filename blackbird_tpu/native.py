@@ -161,11 +161,19 @@ def _load() -> ctypes.CDLL:
     # Lane scoreboard counters (optional for the same prebuilt-library reason).
     for name in ("btpu_pvm_byte_count", "btpu_tcp_staged_op_count",
                  "btpu_tcp_staged_byte_count", "btpu_tcp_stream_op_count",
-                 "btpu_tcp_stream_byte_count"):
+                 "btpu_tcp_stream_byte_count", "btpu_cached_op_count",
+                 "btpu_cached_byte_count"):
         if hasattr(handle, name):
             fn = getattr(handle, name)
             fn.restype = u64
             fn.argtypes = []
+    # Client object cache (optional, same prebuilt-library reason): config +
+    # stats for the lease-coherent cache (native/src/cache/object_cache.cpp).
+    if hasattr(handle, "btpu_client_cache_configure"):
+        handle.btpu_client_cache_configure.restype = None
+        handle.btpu_client_cache_configure.argtypes = [c, u64]
+        handle.btpu_client_cache_stats.restype = i32
+        handle.btpu_client_cache_stats.argtypes = [c, ctypes.POINTER(u64)]
     return handle
 
 

@@ -21,6 +21,7 @@
 #include <unordered_map>
 
 #include "btpu/client/embedded.h"
+#include "tsan_clockwait_shim.h"
 #include "tsan_rma_suppression.h"
 
 using namespace btpu;

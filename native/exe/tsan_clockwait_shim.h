@@ -1,0 +1,41 @@
+// TSan interceptor gap shim for sanitized btpu executables (gcc-10).
+//
+// gcc-10's libtsan has NO interceptor for pthread_cond_clockwait, which
+// glibc's libstdc++ uses for every timed condition-variable wait
+// (condition_variable::wait_for, condition_variable_any::wait_until, ...).
+// TSan therefore never sees the mutex RELEASE inside the wait, believes the
+// waiting thread still holds the lock, and reports a phantom "double lock"
+// the next time any thread takes it — followed by cascades of false data
+// races on perfectly lock-protected state (observed: 128 warnings on the
+// MemCoordinator lease map, all under its mutex).
+//
+// Interposing the symbol in the EXECUTABLE (dynamic lookup order: exe before
+// libpthread) and routing through the intercepted pthread_cond_timedwait
+// restores correct lock modeling. The monotonic absolute deadline is
+// converted to the condvar's default CLOCK_REALTIME; the conversion races
+// wall-clock steps by nanoseconds, which is immaterial for the predicate
+// loops these waits all sit in.
+//
+// Like tsan_rma_suppression.h, include this from executables only.
+#pragma once
+
+#if defined(__SANITIZE_THREAD__)
+#include <pthread.h>
+#include <time.h>
+
+extern "C" int pthread_cond_clockwait(pthread_cond_t* cond, pthread_mutex_t* mutex,
+                                      clockid_t clock, const struct timespec* abstime) {
+  struct timespec now, target = *abstime;
+  if (clock != CLOCK_REALTIME) {
+    clock_gettime(clock, &now);
+    long long delta_ns = (abstime->tv_sec - now.tv_sec) * 1000000000LL +
+                         (abstime->tv_nsec - now.tv_nsec);
+    if (delta_ns < 0) delta_ns = 0;
+    clock_gettime(CLOCK_REALTIME, &now);
+    const long long tgt = now.tv_sec * 1000000000LL + now.tv_nsec + delta_ns;
+    target.tv_sec = static_cast<time_t>(tgt / 1000000000LL);
+    target.tv_nsec = static_cast<long>(tgt % 1000000000LL);
+  }
+  return pthread_cond_timedwait(cond, mutex, &target);
+}
+#endif

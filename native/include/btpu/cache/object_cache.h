@@ -1,0 +1,205 @@
+// Lease-coherent client object cache: a size-bounded, sharded segmented-LRU
+// holding VERIFIED object bytes keyed by (key, version), so a hot repeated
+// read is served at memory speed with zero worker involvement.
+//
+// Role parity: FaRM-style near-client caching and Mooncake-store's client
+// buffer pool (PAPERS.md) — the reference blackbird has no data cache at all
+// (every repeated get pays a full worker round trip).
+//
+// Coherence contract (the part that makes stale bytes structurally
+// impossible rather than merely unlikely):
+//   * Every entry records the keystone-stamped object version — the
+//     (incarnation generation, epoch) pair the keystone returns with
+//     placements. The keystone bumps the epoch on EVERY placement/content
+//     mutation (put/overwrite/remove/evict/demote/repair-rewrite) and mints
+//     a fresh generation per incarnation, so a (gen, epoch) pair never
+//     renames different bytes.
+//   * Embedded clients validate every hit directly against the in-process
+//     keystone's current version (a shared-lock map read, ~100 ns): a hit is
+//     linearizable with the metadata — no staleness window at all.
+//   * Remote clients hold a TTL read lease per entry (granted with the
+//     placements). Within the lease, invalidations fanned out over the
+//     coordinator watch lane delete entries eagerly; at lease expiry — or
+//     whenever the watch stream is severed — the entry degrades to
+//     "must revalidate": one keystone control RTT compares the current
+//     version and either renews the lease (bytes untouched, zero data-plane
+//     work) or drops the entry. Staleness is therefore bounded by the lease
+//     TTL even with the watch lane down, and near-zero with it up.
+//
+// Concurrency: N shards, each with its own mutex and its own two-segment
+// (probation/protected) LRU. Entry bytes are immutable and shared_ptr-held:
+// a reader resolves the hit under the shard lock, then copies out of the
+// pinned buffer WITHOUT the lock — an invalidation racing the copy retires
+// the entry from the map but can never tear or free the bytes mid-read.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <list>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "btpu/common/types.h"
+
+namespace btpu::cache {
+
+// Keystone-stamped object version: `gen` names the keystone incarnation
+// (fresh per process / promotion, so epochs re-minted after a restart can
+// never collide with cached ones), `epoch` the per-mutation revision.
+struct ObjectVersion {
+  uint64_t gen{0};
+  uint64_t epoch{0};
+  bool operator==(const ObjectVersion&) const = default;
+  // 0/0 = "server did not stamp" (pre-cache keystone): never cacheable.
+  bool valid() const noexcept { return gen != 0 || epoch != 0; }
+};
+
+struct CacheStats {
+  uint64_t hits{0};
+  uint64_t misses{0};
+  uint64_t fills{0};
+  uint64_t invalidations{0};   // entries dropped by watch/direct invalidation
+  uint64_t stale_rejects{0};   // hits rejected because the version moved
+  uint64_t lease_expiries{0};  // hits that had to revalidate (lease lapsed)
+  uint64_t evictions{0};       // capacity evictions (segmented-LRU)
+  uint64_t bytes{0};           // resident payload bytes
+  uint64_t entries{0};         // resident entries
+};
+
+// Process-global cache counters (sum over every ObjectCache in the process):
+// exported through capi for bench/tests and through /metrics for operators,
+// exactly like the transport lane counters.
+uint64_t cache_hit_count() noexcept;
+uint64_t cache_miss_count() noexcept;
+uint64_t cache_invalidation_count() noexcept;
+uint64_t cache_stale_reject_count() noexcept;
+// "cached" data lane: ops/bytes served out of the cache (0 wire bytes, one
+// user-space copy per byte) — rides next to pvm/staged/stream in
+// lane_counters() and copies_per_byte accounting. note_cached_serve is
+// called by the CLIENT at the moment bytes are actually copied to the
+// caller (a validated hit whose caller buffer turns out too small is a hit,
+// but never a served byte — the lanes row must not inflate).
+uint64_t cached_op_count() noexcept;
+uint64_t cached_byte_count() noexcept;
+void note_cached_serve(uint64_t served_bytes) noexcept;
+
+class ObjectCache {
+ public:
+  using Clock = std::chrono::steady_clock;
+  using Bytes = std::shared_ptr<const std::vector<uint8_t>>;
+
+  // capacity_bytes bounds the sum of payload bytes (metadata overhead is
+  // not charged; keys are tiny next to payloads). Objects larger than
+  // max_object_bytes (or a shard's capacity) are never cached.
+  explicit ObjectCache(uint64_t capacity_bytes, uint64_t max_object_bytes = 0,
+                       uint32_t shard_count = 8);
+
+  // Hit resolution. kExpired hands the caller the bytes WITHOUT counting a
+  // hit: the caller must revalidate the version against the keystone and
+  // then call renew() (serve) or invalidate() (drop).
+  enum class Outcome { kMiss, kHit, kExpired };
+  struct Hit {
+    Outcome outcome{Outcome::kMiss};
+    Bytes bytes;
+    ObjectVersion version;
+    uint32_t content_crc{0};
+    // lookup_validated only: the hit is valid (version-checked) but its
+    // lease period has lapsed — the embedded client uses this as a cheap
+    // once-per-lease cue to touch the keystone's last_access so pressure
+    // eviction doesn't judge the hottest cached objects coldest.
+    bool lease_lapsed{false};
+  };
+  Hit lookup(const ObjectKey& key);
+
+  // Validated hit for in-process (embedded) clients: `current` is the
+  // keystone's version for the key RIGHT NOW (invalid() = object gone). A
+  // mismatch drops the entry (stale_reject) and reports a miss.
+  Hit lookup_validated(const ObjectKey& key, const ObjectVersion& current);
+
+  // Counter-free, promotion-free inspection (size probes): kHit when the
+  // entry's lease is live, kExpired when lapsed, kMiss when absent. Never
+  // mutates state.
+  Hit peek(const ObjectKey& key) const;
+
+  // Counts a hit that bypassed lookup()'s accounting — the revalidate-
+  // then-serve path, which already holds the pinned bytes from its
+  // kExpired lookup.
+  void count_revalidated_hit();
+
+  // Inserts verified bytes. Refused (no-op) when the version is unstamped,
+  // the object exceeds the size bounds, or an entry with a NEWER version is
+  // already resident. lease_deadline is ABSOLUTE and must be anchored at
+  // the time the version/lease grant was FETCHED (not at fill time): a slow
+  // transfer between grant and fill must shorten the serve window, never
+  // extend the staleness bound past grant + lease. (Ignored by
+  // lookup_validated, which validates every hit anyway.)
+  void fill(const ObjectKey& key, const ObjectVersion& version, uint32_t content_crc,
+            Bytes bytes, Clock::time_point lease_deadline);
+
+  // Revalidation verdict for a kExpired entry: renews the resident entry's
+  // lease iff it still holds `version` (anchor the deadline at the
+  // revalidating metadata fetch, like fill), and drops it (stale_reject)
+  // when the resident version moved.
+  void renew(const ObjectKey& key, const ObjectVersion& version,
+             Clock::time_point lease_deadline);
+
+  // Coherence: drop the entry (watch invalidation, version mismatch,
+  // re-created key). Counted as an invalidation when an entry was resident.
+  void invalidate(const ObjectKey& key);
+  // Drops the entry ONLY while it still holds `version`: the safe form for
+  // verdicts about a snapshot — a concurrent reader may have refilled the
+  // key with newer (valid) bytes that must not be clobbered.
+  void invalidate_if_version(const ObjectKey& key, const ObjectVersion& version);
+  void invalidate_all();
+
+  // Collapses every entry's lease deadline to "already expired": called when
+  // the invalidation watch stream is severed, so entries filled under push
+  // coherence immediately degrade to revalidate-on-hit instead of trusting
+  // a lane that can no longer deliver.
+  void expire_all_leases();
+
+  CacheStats stats() const;
+  uint64_t capacity_bytes() const noexcept { return capacity_; }
+
+ private:
+  struct Entry {
+    ObjectKey key;
+    ObjectVersion version;
+    uint32_t content_crc{0};
+    Bytes bytes;
+    Clock::time_point lease_deadline;
+    bool is_protected{false};
+  };
+  using EntryList = std::list<Entry>;
+  struct Shard {
+    mutable std::mutex mutex;
+    // Segmented LRU: first-time entries enter probation; a second hit
+    // promotes to protected (capped at ~80% of the shard), which scan
+    // traffic cannot flush. Eviction takes probation's tail first.
+    EntryList probation;   // front = most recent
+    EntryList protected_;  // front = most recent
+    std::unordered_map<ObjectKey, EntryList::iterator> index;
+    uint64_t bytes{0};
+    uint64_t protected_bytes{0};
+  };
+
+  Shard& shard_for(const ObjectKey& key);
+  // Both run under the shard lock.
+  void promote_locked(Shard& s, EntryList::iterator it);
+  void evict_for_space_locked(Shard& s, uint64_t need);
+  void erase_locked(Shard& s, EntryList::iterator it);
+
+  uint64_t capacity_;
+  uint64_t max_object_;
+  uint64_t shard_capacity_;
+  std::vector<std::unique_ptr<Shard>> shards_;
+
+  mutable std::atomic<uint64_t> hits_{0}, misses_{0}, fills_{0}, invalidations_{0},
+      stale_rejects_{0}, lease_expiries_{0}, evictions_{0};
+};
+
+}  // namespace btpu::cache

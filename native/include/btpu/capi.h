@@ -105,12 +105,29 @@ uint64_t btpu_pvm_op_count(void);
 /* Lane scoreboard: ops and bytes per client data lane, for the
  * copies-per-byte line in bench.py. pvm moves one user-space copy per byte,
  * staged (shm segment) moves two, stream (socket payload) one client-side
- * plus the kernel socket path. */
+ * plus the kernel socket path, cached serves straight from the client
+ * object cache (ZERO wire bytes, one user-space copy). */
 uint64_t btpu_pvm_byte_count(void);
 uint64_t btpu_tcp_staged_op_count(void);
 uint64_t btpu_tcp_staged_byte_count(void);
 uint64_t btpu_tcp_stream_op_count(void);
 uint64_t btpu_tcp_stream_byte_count(void);
+uint64_t btpu_cached_op_count(void);
+uint64_t btpu_cached_byte_count(void);
+
+/* ---- client object cache (lease-coherent, btpu/cache/object_cache.h) -----
+ * cache_bytes > 0 arms a client-side cache of verified object bytes:
+ * repeated hot gets are served from memory with zero worker round trips.
+ * Coherence: embedded clients validate every hit against the in-process
+ * keystone version (never stale); remote clients hold the keystone-granted
+ * read lease per entry and revalidate with one control RTT at expiry.
+ * cache_bytes 0 tears the cache down. Call before issuing reads (not
+ * thread-safe against in-flight ops). */
+void btpu_client_cache_configure(btpu_client* client, uint64_t cache_bytes);
+/* Stats snapshot: [hits, misses, fills, invalidations, stale_rejects,
+ * lease_expiries, evictions, resident_bytes, entries]. Zeros when no cache
+ * is configured. */
+int32_t btpu_client_cache_stats(btpu_client* client, uint64_t out[9]);
 
 /* ---- client-driven device fabric (runtime-owning clients) ----------------
  * A client that owns a JAX runtime moves device-tier bytes itself over the

@@ -23,6 +23,8 @@
 #include <optional>
 #include <unordered_map>
 
+#include "btpu/cache/object_cache.h"
+#include "btpu/coord/coordinator.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/rpc/rpc_client.h"
 #include "btpu/transport/transport.h"
@@ -94,6 +96,37 @@ struct ClientOptions {
   // put falls back to slots/placed and the client remembers the refusal
   // for a while). 0 disables.
   uint64_t inline_max_bytes{4096};
+
+  // ---- client object cache (btpu/cache/object_cache.h) -------------------
+  // 0 disables (the default). When set, verified whole-object reads at or
+  // below cache_max_object_bytes are kept in a local lease-coherent cache
+  // and a repeated read of an unchanged object is served from memory with
+  // ZERO worker involvement. Coherence (stale bytes structurally
+  // impossible, see object_cache.h):
+  //   * embedded clients validate every hit against the in-process
+  //     keystone's (gen, epoch) version — linearizable, no staleness window;
+  //   * remote clients serve within the keystone-granted read lease,
+  //     invalidated eagerly over the coordinator watch lane
+  //     (coordinator_endpoints / cache_coordinator) and revalidated with
+  //     ONE control RTT at lease expiry — the lease TTL is the hard
+  //     staleness bound even with the watch lane severed.
+  uint64_t cache_bytes{0};
+  // Objects larger than this are never cached (bandwidth-bound sizes gain
+  // little and would churn the whole cache).
+  uint64_t cache_max_object_bytes{4ull << 20};
+  // Cluster id namespacing the invalidation watch topic (must match the
+  // keystone's cluster_id).
+  std::string cluster_id{kDefaultClusterId};
+  // Invalidation watch lane for REMOTE caching clients: bb-coord endpoints
+  // ("" = none — the client then relies on lease expiry + version
+  // revalidation alone, still correct, just a wider invalidation window).
+  std::string coordinator_endpoints;
+  // Programmatic coordinator handle (embedded/lease-mode tests); takes
+  // precedence over coordinator_endpoints.
+  std::shared_ptr<coord::Coordinator> cache_coordinator;
+  // Test hook: force an embedded client onto the remote (lease + watch)
+  // coherence path so the lease machinery is testable hermetically.
+  bool cache_force_lease_mode{false};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).
@@ -206,6 +239,25 @@ class ObjectClient {
   Result<ClusterStats> cluster_stats();
   Result<ViewVersionId> ping();
 
+  // ---- client object cache ------------------------------------------------
+  // (Re)configures the object cache after construction (the capi hook; the
+  // usual path is ClientOptions::cache_bytes at construction). 0 tears the
+  // cache down. Not thread-safe against in-flight reads — call before use.
+  void configure_cache(uint64_t cache_bytes);
+  // Zero stats when no cache is configured.
+  cache::CacheStats cache_stats() const {
+    return cache_ ? cache_->stats() : cache::CacheStats{};
+  }
+  bool cache_enabled() const noexcept { return cache_ != nullptr; }
+  // Size of the cached entry for `key`, validated the same way a cached
+  // read would be (nullopt = not serveable from cache). Lets size probes
+  // skip the metadata RTT for hot keys.
+  std::optional<uint64_t> cached_object_size(const ObjectKey& key);
+  // Test hook: severs the invalidation watch stream mid-flight — entries
+  // immediately degrade from push coherence to their lease deadline, the
+  // exact fallback a dead coordinator connection produces.
+  void sever_cache_watch_for_test();
+
   // Test-only: swaps the data-plane transport so fault-injection tests can
   // fail the n-th shard transfer (make_faulty_transport_client). Not
   // thread-safe against in-flight transfers.
@@ -238,9 +290,42 @@ class ObjectClient {
   void cache_placements(const ObjectKey& key, const std::vector<CopyPlacement>& copies);
   void invalidate_placements(const ObjectKey& key);
   void invalidate_all_placements();
+  // `attempt` additionally learns whether the placements came from the
+  // placement cache — the object cache only fills from FRESH metadata.
   ErrorCode read_with_cache(
       const ObjectKey& key, bool verify,
-      const std::function<ErrorCode(const std::vector<CopyPlacement>&)>& attempt);
+      const std::function<ErrorCode(const std::vector<CopyPlacement>&, bool)>& attempt);
+
+  // ---- object cache internals (see ClientOptions::cache_bytes) ----
+  void setup_cache();
+  void teardown_cache_watch();
+  // Coherent cached bytes for `key`, or nullptr on miss. Embedded clients
+  // validate against the in-process keystone version; remote clients serve
+  // within the lease and revalidate (one control RTT) past it.
+  cache::ObjectCache::Bytes cache_acquire(const ObjectKey& key);
+  // Applies a revalidation verdict to the expired entry snapshot `hit`:
+  // renews the lease (anchored at `meta_at`, when `meta` was fetched) and
+  // returns true iff the snapshot is still current (version + content
+  // stamp); otherwise drops the snapshot — never a newer concurrent fill —
+  // and returns false. The ONE home of the revalidation rules, shared by
+  // the single-read and batched paths.
+  bool cache_revalidate(const ObjectKey& key, const cache::ObjectCache::Hit& hit,
+                        const Result<std::vector<CopyPlacement>>& meta,
+                        std::chrono::steady_clock::time_point meta_at);
+  // The pre-cache get_many body: one batched metadata + data round for
+  // every item (fills the cache on verified successes).
+  std::vector<Result<uint64_t>> get_many_uncached(const std::vector<GetItem>& items,
+                                                  std::optional<bool> verify);
+  // Serves `key` from the cache into `out` when a coherent entry exists
+  // (embedded: version-validated; remote: lease-validated, revalidating at
+  // expiry with one control RTT). Returns false on miss/too-small buffer.
+  bool cache_serve(const ObjectKey& key, void* out, uint64_t out_cap, uint64_t& got);
+  // Records freshly read + verified bytes (copied out of `data`) under the
+  // version stamped on `copy`; `granted_at` = when the stamped placements
+  // were fetched (anchors the lease, see ObjectCache::fill). No-op for
+  // unstamped/oversized objects.
+  void cache_fill(const ObjectKey& key, const CopyPlacement& copy, const uint8_t* data,
+                  uint64_t size, std::chrono::steady_clock::time_point granted_at);
 
   static ErrorCode error_of(ErrorCode ec) noexcept { return ec; }
   template <typename T>
@@ -285,6 +370,13 @@ class ObjectClient {
   };
   std::mutex placement_cache_mutex_;
   std::unordered_map<ObjectKey, PlacementCacheEntry> placement_cache_;
+
+  // Object cache (shared_ptr: the invalidation watch callback holds a
+  // weak_ptr, so a late event racing client destruction pins the cache
+  // instead of dereferencing a dead client).
+  std::shared_ptr<cache::ObjectCache> cache_;
+  std::shared_ptr<coord::Coordinator> inval_coord_;
+  coord::WatchId inval_watch_{-1};
 
   // Pooled put slots (ClientOptions::put_slots): classes keyed by
   // (size, wire-encoded config). nullopt result = not applicable here, the

@@ -35,6 +35,9 @@ class EmbeddedCluster {
   worker::WorkerService& worker(size_t i) { return *workers_.at(i); }
   size_t worker_count() const { return workers_.size(); }
   coord::MemCoordinator* coordinator() { return coordinator_.get(); }
+  // Shared handle for clients that subscribe to the invalidation watch lane
+  // (ClientOptions::cache_coordinator in lease-mode cache tests).
+  std::shared_ptr<coord::MemCoordinator> coordinator_shared() { return coordinator_; }
 
   // A client wired to this cluster (embedded keystone, local data plane).
   std::unique_ptr<ObjectClient> make_client(ClientOptions options = {});

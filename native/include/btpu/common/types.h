@@ -200,6 +200,19 @@ struct CopyPlacement {
   // hop), and put_inline stores them in one RPC. Wire-append-only: older
   // peers decode this struct fine and see a shardless copy.
   std::string inline_data;
+  // Client object-cache coherence stamps (btpu/cache/object_cache.h),
+  // filled by the keystone on READ replies only (get_workers /
+  // batch_get_workers — never persisted): cache_version is the object's
+  // current epoch (bumped on every placement/content mutation), cache_gen
+  // the keystone incarnation that minted it (fresh per process/promotion,
+  // so re-minted epochs after a restart can never collide with cached
+  // ones), and cache_lease_ms how long a client may serve the bytes from
+  // its cache before revalidating (KeystoneConfig::cache_lease_ms; 0 = the
+  // server grants no caching). Wire-append-only: a pre-cache server leaves
+  // all three 0 and clients simply never cache.
+  uint64_t cache_version{0};
+  uint64_t cache_gen{0};
+  uint32_t cache_lease_ms{0};
   size_t shards_size() const noexcept { return shards.size(); }
 };
 
@@ -501,6 +514,17 @@ struct KeystoneConfig {
   // has no integrity checking at all.
   int64_t scrub_interval_sec{0};
   uint32_t scrub_objects_per_pass{16};
+
+  // Client object-cache lease (btpu/cache): get_workers replies grant
+  // readers the right to serve the returned object version from a local
+  // cache for this long without revalidation. Invalidations fan out over
+  // the coordinator watch lane ("cacheinval" topic) and usually land well
+  // inside the lease; the lease is the HARD staleness bound when that lane
+  // is down or severed. 0 disables granting (clients fall back to uncached
+  // reads). Short by design: a lease only saves a control RTT per hot
+  // object per TTL, while a long lease stretches the worst-case staleness
+  // window a severed watch stream can produce.
+  uint32_t cache_lease_ms{2000};
 
   // Inline tier: objects up to inline_max_bytes are stored IN the keystone's
   // object map (durable record + HA mirror carry the bytes) instead of on

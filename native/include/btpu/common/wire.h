@@ -273,11 +273,13 @@ inline bool decode(Reader& r, ShardPlacement& s) {
 
 inline void encode(Writer& w, const CopyPlacement& c) {
   encode_struct(w, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data);
+                c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data,
+                c.cache_version, c.cache_gen, c.cache_lease_ms);
 }
 inline bool decode(Reader& r, CopyPlacement& c) {
   return decode_struct(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
-                       c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data);
+                       c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data,
+                       c.cache_version, c.cache_gen, c.cache_lease_ms);
 }
 
 inline void encode(Writer& w, const PutSlot& s) {

@@ -116,5 +116,12 @@ std::string heartbeat_key(const std::string& cluster_id, const std::string& work
 std::string services_prefix(const std::string& service_name);
 std::string objects_prefix(const std::string& cluster_id);
 std::string object_record_key(const std::string& cluster_id, const std::string& object_key);
+// Client object-cache invalidation topic: the keystone publishes
+// "<new version>" (or "0" for removal) under the object's key here on every
+// placement/content mutation; caching clients watch the prefix and drop the
+// entry on any event. Values are TTL'd — the topic is a fan-out lane, not a
+// registry, so it self-cleans.
+std::string cache_inval_prefix(const std::string& cluster_id);
+std::string cache_inval_key(const std::string& cluster_id, const std::string& object_key);
 
 }  // namespace btpu::coord

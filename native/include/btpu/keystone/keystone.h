@@ -186,6 +186,15 @@ class KeystoneService {
   // which carry their stamps without the staged lane's streaming CRC gate.
   void queue_scrub_target(const ObjectKey& key);
 
+  // ---- client object-cache coherence (btpu/cache/object_cache.h) ----
+  // Current cache version of `key` for IN-PROCESS (embedded) clients:
+  // {incarnation generation, epoch}, or {0, 0} when the object is absent or
+  // still pending. A shared-lock map read — cheap enough to validate every
+  // cache hit against, which is what makes embedded hits linearizable with
+  // the metadata (no staleness window at all).
+  std::pair<uint64_t, uint64_t> object_cache_version(const ObjectKey& key) const;
+  uint64_t cache_generation() const noexcept { return cache_gen_; }
+
   Result<ClusterStats> get_cluster_stats() const;
   // Allocator view with per-storage-class breakdowns (metrics exports the
   // same numbers tier-aware eviction keys off).
@@ -235,6 +244,17 @@ class KeystoneService {
   void bump_view() noexcept { view_version_.fetch_add(1); }
   std::string election_name() const { return "btpu-keystone-leader/" + config_.cluster_id; }
   int64_t now_wall_ms() const;
+
+  // Fan out a cache invalidation for `key` over the coordinator watch lane
+  // ("cacheinval" topic): version = the new epoch, 0 = object gone. Fired on
+  // DELETION and BYTE-MOVE events (remove/GC/evict/demote/repair/drain) —
+  // never on the put path: a fresh put's key has no live cached entries
+  // (its prior removal already published), so puts stay zero-overhead.
+  // Best-effort: clients that miss an event (severed watch) are bounded by
+  // their lease TTL + version revalidation. TTL'd value; fine to call with
+  // or without objects_mutex_ held (watch callbacks never re-enter the
+  // keystone).
+  void publish_cache_invalidation(const ObjectKey& key, uint64_t version);
 
   ErrorCode setup_coordinator_integration();
   void load_existing_state();
@@ -355,6 +375,13 @@ class KeystoneService {
 
   std::atomic<ViewVersionId> view_version_{0};
   std::atomic<uint64_t> next_epoch_{1};  // feeds ObjectInfo::epoch
+  // Cache-coherence incarnation nonce (random 64-bit, minted per keystone
+  // construction): epochs are process-local and re-minted on restart/
+  // failover, so clients compare (gen, epoch) pairs — a fresh incarnation's
+  // epochs can never validate bytes cached from a previous one. Paired with
+  // the cached content CRC at revalidation, a cross-incarnation false match
+  // is out of the failure model.
+  uint64_t cache_gen_{0};
   // Set when a promotion had to be refused (reconcile failed): the keepalive
   // thread resigns and re-campaigns. Deferred because leader callbacks run
   // on the coordinator's event thread, where issuing coordinator RPCs would

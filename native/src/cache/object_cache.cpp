@@ -1,0 +1,279 @@
+#include "btpu/cache/object_cache.h"
+
+#include <algorithm>
+#include <functional>
+
+namespace btpu::cache {
+
+namespace {
+// Process-global counters, mirrored from every cache instance (capi +
+// /metrics read these, like the transport lane counters).
+std::atomic<uint64_t> g_hits{0}, g_misses{0}, g_invalidations{0}, g_stale_rejects{0};
+std::atomic<uint64_t> g_cached_ops{0}, g_cached_bytes{0};
+}  // namespace
+
+uint64_t cache_hit_count() noexcept { return g_hits.load(std::memory_order_relaxed); }
+uint64_t cache_miss_count() noexcept { return g_misses.load(std::memory_order_relaxed); }
+uint64_t cache_invalidation_count() noexcept {
+  return g_invalidations.load(std::memory_order_relaxed);
+}
+uint64_t cache_stale_reject_count() noexcept {
+  return g_stale_rejects.load(std::memory_order_relaxed);
+}
+uint64_t cached_op_count() noexcept { return g_cached_ops.load(std::memory_order_relaxed); }
+uint64_t cached_byte_count() noexcept {
+  return g_cached_bytes.load(std::memory_order_relaxed);
+}
+void note_cached_serve(uint64_t served_bytes) noexcept {
+  g_cached_ops.fetch_add(1, std::memory_order_relaxed);
+  g_cached_bytes.fetch_add(served_bytes, std::memory_order_relaxed);
+}
+
+ObjectCache::ObjectCache(uint64_t capacity_bytes, uint64_t max_object_bytes,
+                         uint32_t shard_count)
+    : capacity_(capacity_bytes) {
+  shard_count = std::max<uint32_t>(1, shard_count);
+  // Tiny capacities collapse to one shard so the whole budget is usable
+  // (8 shards of capacity/8 would reject any object > capacity/8).
+  if (capacity_ / shard_count < (64u << 10)) shard_count = 1;
+  shard_capacity_ = capacity_ / shard_count;
+  // Per-object ceiling: explicit bound, else whatever fits a shard. The
+  // shard bound always applies — fill() charges one shard only.
+  max_object_ = max_object_bytes ? std::min(max_object_bytes, shard_capacity_)
+                                 : shard_capacity_;
+  shards_.reserve(shard_count);
+  for (uint32_t i = 0; i < shard_count; ++i) shards_.push_back(std::make_unique<Shard>());
+}
+
+ObjectCache::Shard& ObjectCache::shard_for(const ObjectKey& key) {
+  return *shards_[std::hash<ObjectKey>{}(key) % shards_.size()];
+}
+
+// Second hit promotes probation -> protected; protected overflow demotes its
+// tail back to probation's MRU end (standard SLRU: a demoted entry was
+// re-touched at some point, so it outranks never-re-touched scan entries —
+// eviction still takes probation's LRU tail first).
+void ObjectCache::promote_locked(Shard& s, EntryList::iterator it) {
+  if (it->is_protected) {
+    if (it != s.protected_.begin())
+      s.protected_.splice(s.protected_.begin(), s.protected_, it);
+    return;
+  }
+  it->is_protected = true;
+  s.protected_bytes += it->bytes->size();
+  s.protected_.splice(s.protected_.begin(), s.probation, it);
+  const uint64_t protected_cap = shard_capacity_ - shard_capacity_ / 5;  // ~80%
+  while (s.protected_bytes > protected_cap && !s.protected_.empty()) {
+    auto tail = std::prev(s.protected_.end());
+    if (tail == it) break;  // never demote the entry just promoted
+    tail->is_protected = false;
+    s.protected_bytes -= tail->bytes->size();
+    s.probation.splice(s.probation.begin(), s.protected_, tail);
+  }
+}
+
+void ObjectCache::erase_locked(Shard& s, EntryList::iterator it) {
+  s.bytes -= it->bytes->size();
+  if (it->is_protected) {
+    s.protected_bytes -= it->bytes->size();
+    s.index.erase(it->key);
+    s.protected_.erase(it);
+  } else {
+    s.index.erase(it->key);
+    s.probation.erase(it);
+  }
+}
+
+void ObjectCache::evict_for_space_locked(Shard& s, uint64_t need) {
+  while (s.bytes + need > shard_capacity_) {
+    EntryList& victims = !s.probation.empty() ? s.probation : s.protected_;
+    if (victims.empty()) return;
+    erase_locked(s, std::prev(victims.end()));
+    evictions_.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+ObjectCache::Hit ObjectCache::lookup(const ObjectKey& key) {
+  Shard& s = shard_for(key);
+  Hit hit;
+  {
+    std::lock_guard<std::mutex> lock(s.mutex);
+    auto idx = s.index.find(key);
+    if (idx == s.index.end()) {
+      misses_.fetch_add(1, std::memory_order_relaxed);
+      g_misses.fetch_add(1, std::memory_order_relaxed);
+      return hit;
+    }
+    auto it = idx->second;
+    hit.bytes = it->bytes;  // pinned: safe to copy from after unlock
+    hit.version = it->version;
+    hit.content_crc = it->content_crc;
+    if (Clock::now() >= it->lease_deadline) {
+      // Lease lapsed: the caller must revalidate before serving. Not a miss
+      // (the bytes may still be current) and not yet a hit.
+      hit.outcome = Outcome::kExpired;
+      lease_expiries_.fetch_add(1, std::memory_order_relaxed);
+      return hit;
+    }
+    promote_locked(s, it);
+  }
+  hit.outcome = Outcome::kHit;
+  hits_.fetch_add(1, std::memory_order_relaxed);
+  g_hits.fetch_add(1, std::memory_order_relaxed);
+  return hit;
+}
+
+ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
+                                               const ObjectVersion& current) {
+  Shard& s = shard_for(key);
+  Hit hit;
+  {
+    std::lock_guard<std::mutex> lock(s.mutex);
+    auto idx = s.index.find(key);
+    if (idx == s.index.end()) {
+      misses_.fetch_add(1, std::memory_order_relaxed);
+      g_misses.fetch_add(1, std::memory_order_relaxed);
+      return hit;
+    }
+    auto it = idx->second;
+    if (!current.valid() || !(it->version == current)) {
+      // The key mutated (or vanished) under us: structurally impossible to
+      // serve — drop the entry and report a miss.
+      erase_locked(s, it);
+      stale_rejects_.fetch_add(1, std::memory_order_relaxed);
+      g_stale_rejects.fetch_add(1, std::memory_order_relaxed);
+      misses_.fetch_add(1, std::memory_order_relaxed);
+      g_misses.fetch_add(1, std::memory_order_relaxed);
+      return hit;
+    }
+    hit.bytes = it->bytes;
+    hit.version = it->version;
+    hit.content_crc = it->content_crc;
+    hit.lease_lapsed = Clock::now() >= it->lease_deadline;
+    promote_locked(s, it);
+  }
+  hit.outcome = Outcome::kHit;
+  hits_.fetch_add(1, std::memory_order_relaxed);
+  g_hits.fetch_add(1, std::memory_order_relaxed);
+  return hit;
+}
+
+ObjectCache::Hit ObjectCache::peek(const ObjectKey& key) const {
+  auto& s = const_cast<ObjectCache*>(this)->shard_for(key);
+  Hit hit;
+  std::lock_guard<std::mutex> lock(s.mutex);
+  auto idx = s.index.find(key);
+  if (idx == s.index.end()) return hit;
+  const auto it = idx->second;
+  hit.bytes = it->bytes;
+  hit.version = it->version;
+  hit.content_crc = it->content_crc;
+  hit.outcome = Clock::now() < it->lease_deadline ? Outcome::kHit : Outcome::kExpired;
+  return hit;
+}
+
+void ObjectCache::count_revalidated_hit() {
+  hits_.fetch_add(1, std::memory_order_relaxed);
+  g_hits.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ObjectCache::fill(const ObjectKey& key, const ObjectVersion& version,
+                       uint32_t content_crc, Bytes bytes, Clock::time_point lease_deadline) {
+  if (!version.valid() || !bytes || bytes->empty() || bytes->size() > max_object_) return;
+  Shard& s = shard_for(key);
+  const auto deadline = lease_deadline;
+  std::lock_guard<std::mutex> lock(s.mutex);
+  auto idx = s.index.find(key);
+  if (idx != s.index.end()) {
+    auto it = idx->second;
+    // Same-gen epochs order fills racing an overwrite; a cross-gen fill
+    // (keystone failover mid-race) has no order, so newest-write wins.
+    if (it->version.gen == version.gen && it->version.epoch > version.epoch) return;
+    erase_locked(s, it);
+  }
+  evict_for_space_locked(s, bytes->size());
+  if (s.bytes + bytes->size() > shard_capacity_) return;  // larger than the shard
+  s.bytes += bytes->size();
+  s.probation.push_front(
+      {key, version, content_crc, std::move(bytes), deadline, /*is_protected=*/false});
+  s.index[key] = s.probation.begin();
+  fills_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ObjectCache::renew(const ObjectKey& key, const ObjectVersion& version,
+                        Clock::time_point lease_deadline) {
+  Shard& s = shard_for(key);
+  std::lock_guard<std::mutex> lock(s.mutex);
+  auto idx = s.index.find(key);
+  if (idx == s.index.end()) return;
+  auto it = idx->second;
+  if (!(it->version == version)) {
+    // Revalidation says the resident entry is someone else's bytes now.
+    erase_locked(s, it);
+    stale_rejects_.fetch_add(1, std::memory_order_relaxed);
+    g_stale_rejects.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  it->lease_deadline = lease_deadline;
+}
+
+void ObjectCache::invalidate(const ObjectKey& key) {
+  Shard& s = shard_for(key);
+  std::lock_guard<std::mutex> lock(s.mutex);
+  auto idx = s.index.find(key);
+  if (idx == s.index.end()) return;
+  erase_locked(s, idx->second);
+  invalidations_.fetch_add(1, std::memory_order_relaxed);
+  g_invalidations.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ObjectCache::invalidate_if_version(const ObjectKey& key, const ObjectVersion& version) {
+  Shard& s = shard_for(key);
+  std::lock_guard<std::mutex> lock(s.mutex);
+  auto idx = s.index.find(key);
+  if (idx == s.index.end() || !(idx->second->version == version)) return;
+  erase_locked(s, idx->second);
+  invalidations_.fetch_add(1, std::memory_order_relaxed);
+  g_invalidations.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ObjectCache::invalidate_all() {
+  for (auto& sp : shards_) {
+    std::lock_guard<std::mutex> lock(sp->mutex);
+    const uint64_t n = sp->index.size();
+    sp->probation.clear();
+    sp->protected_.clear();
+    sp->index.clear();
+    sp->bytes = sp->protected_bytes = 0;
+    invalidations_.fetch_add(n, std::memory_order_relaxed);
+    g_invalidations.fetch_add(n, std::memory_order_relaxed);
+  }
+}
+
+void ObjectCache::expire_all_leases() {
+  const auto past = Clock::now() - std::chrono::milliseconds(1);
+  for (auto& sp : shards_) {
+    std::lock_guard<std::mutex> lock(sp->mutex);
+    for (auto& e : sp->probation) e.lease_deadline = past;
+    for (auto& e : sp->protected_) e.lease_deadline = past;
+  }
+}
+
+CacheStats ObjectCache::stats() const {
+  CacheStats out;
+  out.hits = hits_.load(std::memory_order_relaxed);
+  out.misses = misses_.load(std::memory_order_relaxed);
+  out.fills = fills_.load(std::memory_order_relaxed);
+  out.invalidations = invalidations_.load(std::memory_order_relaxed);
+  out.stale_rejects = stale_rejects_.load(std::memory_order_relaxed);
+  out.lease_expiries = lease_expiries_.load(std::memory_order_relaxed);
+  out.evictions = evictions_.load(std::memory_order_relaxed);
+  for (const auto& sp : shards_) {
+    std::lock_guard<std::mutex> lock(sp->mutex);
+    out.bytes += sp->bytes;
+    out.entries += sp->index.size();
+  }
+  return out;
+}
+
+}  // namespace btpu::cache

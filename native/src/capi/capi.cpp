@@ -203,6 +203,12 @@ int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t bu
                  uint64_t* out_size) {
   if (!client || !key || !out_size) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   if (!buffer) {
+    // Size probe: a coherent cached entry answers without the metadata RTT
+    // (the probe+read pattern Python's get() uses stays two cache hits).
+    if (auto cached = client->impl->cached_object_size(key)) {
+      *out_size = *cached;
+      return 0;
+    }
     auto placements = client->impl->get_workers(key);
     if (!placements.ok()) return static_cast<int32_t>(placements.error());
     *out_size = placements.value().empty() ? 0 : copy_logical_size(placements.value().front());
@@ -255,22 +261,38 @@ int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys
                         uint64_t* out_sizes, int32_t* out_codes) {
   if (!client || (n && !keys) || !out_sizes || !out_codes)
     return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
-  std::vector<ObjectKey> key_vec(keys, keys + n);
-  const auto placements = client->impl->get_workers_many(key_vec);
+  // Coherent cached entries answer their size probe locally (same shortcut
+  // as btpu_get's null-buffer probe): a fully hot batch costs zero keystone
+  // RTTs, and only the remainder rides the batched metadata round.
+  std::vector<ObjectKey> key_vec;
+  std::vector<uint32_t> miss_idx;
+  key_vec.reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
-    if (!placements[i].ok()) {
+    if (auto cached = client->impl->cached_object_size(keys[i])) {
+      out_sizes[i] = *cached;
+      out_codes[i] = 0;
+    } else {
+      miss_idx.push_back(i);
+      key_vec.emplace_back(keys[i]);
+    }
+  }
+  if (key_vec.empty()) return 0;
+  const auto placements = client->impl->get_workers_many(key_vec);
+  for (uint32_t j = 0; j < miss_idx.size() && j < placements.size(); ++j) {
+    const uint32_t i = miss_idx[j];
+    if (!placements[j].ok()) {
       out_sizes[i] = 0;
-      out_codes[i] = static_cast<int32_t>(placements[i].error());
+      out_codes[i] = static_cast<int32_t>(placements[j].error());
       continue;
     }
-    if (placements[i].value().empty()) {
+    if (placements[j].value().empty()) {
       // Object known but no complete copy (failed put, eviction in
       // flight): distinguishable from a genuine zero-byte object.
       out_sizes[i] = 0;
       out_codes[i] = static_cast<int32_t>(ErrorCode::NO_COMPLETE_WORKER);
       continue;
     }
-    out_sizes[i] = copy_logical_size(placements[i].value().front());
+    out_sizes[i] = copy_logical_size(placements[j].value().front());
     out_codes[i] = 0;
   }
   return 0;
@@ -307,6 +329,28 @@ uint64_t btpu_tcp_staged_op_count(void) { return transport::tcp_staged_op_count(
 uint64_t btpu_tcp_staged_byte_count(void) { return transport::tcp_staged_byte_count(); }
 uint64_t btpu_tcp_stream_op_count(void) { return transport::tcp_stream_op_count(); }
 uint64_t btpu_tcp_stream_byte_count(void) { return transport::tcp_stream_byte_count(); }
+uint64_t btpu_cached_op_count(void) { return cache::cached_op_count(); }
+uint64_t btpu_cached_byte_count(void) { return cache::cached_byte_count(); }
+
+void btpu_client_cache_configure(btpu_client* client, uint64_t cache_bytes) {
+  if (client && client->impl) client->impl->configure_cache(cache_bytes);
+}
+
+int32_t btpu_client_cache_stats(btpu_client* client, uint64_t out[9]) {
+  if (!client || !client->impl || !out)
+    return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  const auto s = client->impl->cache_stats();
+  out[0] = s.hits;
+  out[1] = s.misses;
+  out[2] = s.fills;
+  out[3] = s.invalidations;
+  out[4] = s.stale_rejects;
+  out[5] = s.lease_expiries;
+  out[6] = s.evictions;
+  out[7] = s.bytes;
+  out[8] = s.entries;
+  return 0;
+}
 
 int32_t btpu_drain_worker(btpu_client* client, const char* worker_id, uint64_t* out_moved) {
   if (!client || !worker_id) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);

@@ -9,6 +9,7 @@
 #include "btpu/common/wire.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
+#include "btpu/coord/remote_coordinator.h"
 #include "btpu/ec/rs.h"
 #include "btpu/rpc/rpc.h"
 #include "btpu/storage/hbm_provider.h"
@@ -50,15 +51,21 @@ ObjectClient::ObjectClient(ClientOptions options)
       data_(transport::make_transport_client()),
       slot_tag_(random_slot_tag()) {
   rpc_ = std::make_unique<rpc::KeystoneRpcClient>(options_.keystone_address);
+  setup_cache();
 }
 
 ObjectClient::ObjectClient(ClientOptions options, keystone::KeystoneService* embedded)
     : options_(std::move(options)),
       verify_default_(options_.verify_reads),
       embedded_(embedded),
-      data_(transport::make_transport_client()) {}
+      data_(transport::make_transport_client()) {
+  setup_cache();
+}
 
-ObjectClient::~ObjectClient() { cancel_pooled_slots(); }
+ObjectClient::~ObjectClient() {
+  teardown_cache_watch();
+  cancel_pooled_slots();
+}
 
 ErrorCode ObjectClient::connect() {
   if (embedded_) return ErrorCode::OK;
@@ -133,15 +140,185 @@ void ObjectClient::cache_placements(const ObjectKey& key,
 }
 
 void ObjectClient::invalidate_placements(const ObjectKey& key) {
+  // This client's own mutations drop the OBJECT cache entry too (a
+  // re-created key must not serve the previous object's bytes from either
+  // cache); cross-client mutations ride the watch/lease machinery.
+  if (cache_) cache_->invalidate(key);
   if (options_.placement_cache_ms == 0 || embedded_) return;
   std::lock_guard<std::mutex> lock(placement_cache_mutex_);
   placement_cache_.erase(key);
 }
 
 void ObjectClient::invalidate_all_placements() {
+  if (cache_) cache_->invalidate_all();
   if (options_.placement_cache_ms == 0 || embedded_) return;
   std::lock_guard<std::mutex> lock(placement_cache_mutex_);
   placement_cache_.clear();
+}
+
+// ---- client object cache (ClientOptions::cache_bytes) ----------------------
+
+void ObjectClient::setup_cache() {
+  if (options_.cache_bytes == 0) return;
+  cache_ = std::make_shared<cache::ObjectCache>(options_.cache_bytes,
+                                                options_.cache_max_object_bytes);
+  // Embedded clients validate every hit against the in-process keystone's
+  // version — strictly stronger than any invalidation stream, so no watch.
+  if (embedded_ && !options_.cache_force_lease_mode) return;
+  inval_coord_ = options_.cache_coordinator;
+  if (!inval_coord_ && !options_.coordinator_endpoints.empty()) {
+    auto rc = std::make_shared<coord::RemoteCoordinator>(options_.coordinator_endpoints);
+    if (rc->connect() == ErrorCode::OK) {
+      inval_coord_ = std::move(rc);
+    } else {
+      LOG_WARN << "object cache: coordinator " << options_.coordinator_endpoints
+               << " unreachable; invalidations degrade to lease expiry";
+    }
+  }
+  if (!inval_coord_) return;  // lease-expiry + revalidation coherence only
+  const std::string prefix = coord::cache_inval_prefix(options_.cluster_id);
+  // weak_ptr: a late watch event racing client destruction pins the cache
+  // (or finds it gone) instead of dereferencing a dead client.
+  std::weak_ptr<cache::ObjectCache> weak = cache_;
+  auto watch =
+      inval_coord_->watch_prefix(prefix, [prefix, weak](const coord::WatchEvent& ev) {
+        // PUT events only: the topic's TTL'd values self-clean with a
+        // kDelete ~30 s after each publish, which must not evict an entry
+        // legitimately re-cached since the original invalidation.
+        if (ev.type != coord::WatchEvent::Type::kPut) return;
+        if (ev.key.size() <= prefix.size()) return;
+        if (auto cache = weak.lock()) cache->invalidate(ev.key.substr(prefix.size()));
+      });
+  if (watch.ok()) {
+    inval_watch_ = watch.value();
+  } else {
+    LOG_WARN << "object cache: invalidation watch failed ("
+             << to_string(watch.error()) << "); degrading to lease expiry";
+  }
+}
+
+void ObjectClient::teardown_cache_watch() {
+  if (inval_coord_ && inval_watch_ >= 0) inval_coord_->unwatch(inval_watch_);
+  inval_watch_ = -1;
+  inval_coord_.reset();
+}
+
+void ObjectClient::configure_cache(uint64_t cache_bytes) {
+  teardown_cache_watch();
+  cache_.reset();
+  options_.cache_bytes = cache_bytes;
+  setup_cache();
+}
+
+void ObjectClient::sever_cache_watch_for_test() {
+  teardown_cache_watch();
+  // Push coherence is gone: entries must not outlive their lease.
+  if (cache_) cache_->expire_all_leases();
+}
+
+cache::ObjectCache::Bytes ObjectClient::cache_acquire(const ObjectKey& key) {
+  if (!cache_) return nullptr;
+  using Outcome = cache::ObjectCache::Outcome;
+  cache::ObjectCache::Hit hit;
+  if (embedded_ && !options_.cache_force_lease_mode) {
+    // Direct validation: linearizable with the in-process metadata.
+    const auto [gen, epoch] = embedded_->object_cache_version(key);
+    hit = cache_->lookup_validated(key, {gen, epoch});
+    if (hit.outcome == Outcome::kHit && hit.lease_lapsed) {
+      // Keep the keystone's LRU honest: validated hits never pass through
+      // get_workers, so once per lease period run a real (in-process)
+      // metadata read — it touches the object's last_access, without which
+      // pressure eviction would judge the hottest cached objects coldest
+      // and destroy them under their readers.
+      auto copies = get_workers(key);
+      const auto meta_at = std::chrono::steady_clock::now();
+      if (copies.ok() && !copies.value().empty()) {
+        const auto& c0 = copies.value().front();
+        const cache::ObjectVersion current{c0.cache_gen, c0.cache_version};
+        if (current.valid() && c0.cache_lease_ms > 0)
+          cache_->renew(key, current,
+                        meta_at + std::chrono::milliseconds(c0.cache_lease_ms));
+      }
+    }
+  } else {
+    hit = cache_->lookup(key);
+    if (hit.outcome == Outcome::kExpired) {
+      // Lease lapsed: ONE control RTT revalidates, then cache_revalidate
+      // applies the verdict (renew-and-serve vs snapshot-guarded drop).
+      auto copies = get_workers(key);
+      const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
+      if (!cache_revalidate(key, hit, copies, meta_at)) return nullptr;
+      hit.outcome = Outcome::kHit;
+    }
+  }
+  return hit.outcome == Outcome::kHit ? hit.bytes : nullptr;
+}
+
+bool ObjectClient::cache_revalidate(const ObjectKey& key,
+                                    const cache::ObjectCache::Hit& hit,
+                                    const Result<std::vector<CopyPlacement>>& meta,
+                                    std::chrono::steady_clock::time_point meta_at) {
+  if (meta.ok() && !meta.value().empty()) {
+    const auto& c0 = meta.value().front();
+    const cache::ObjectVersion current{c0.cache_gen, c0.cache_version};
+    if (current.valid() && c0.cache_lease_ms > 0) {
+      // renew() keeps/renews the resident entry iff it matches `current` —
+      // including one a concurrent reader refilled at `current` while we
+      // revalidated, which must not be clobbered; a moved resident version
+      // is dropped there (stale_reject). The snapshot is serveable only on
+      // a full version + content-stamp match (the stamp is the belt over
+      // braces across keystone incarnations).
+      cache_->renew(key, current, meta_at + std::chrono::milliseconds(c0.cache_lease_ms));
+      if (current == hit.version && c0.content_crc == hit.content_crc) {
+        cache_->count_revalidated_hit();
+        return true;
+      }
+      return false;
+    }
+  }
+  // Object gone, metadata unreachable, or the server stopped granting:
+  // drop OUR snapshot only (never a newer concurrent fill).
+  cache_->invalidate_if_version(key, hit.version);
+  return false;
+}
+
+bool ObjectClient::cache_serve(const ObjectKey& key, void* out, uint64_t out_cap,
+                               uint64_t& got) {
+  auto bytes = cache_acquire(key);
+  if (!bytes || bytes->size() > out_cap) return false;
+  std::memcpy(out, bytes->data(), bytes->size());
+  got = bytes->size();
+  cache::note_cached_serve(got);  // lane counts bytes actually delivered
+  return true;
+}
+
+void ObjectClient::cache_fill(const ObjectKey& key, const CopyPlacement& copy,
+                              const uint8_t* data, uint64_t size,
+                              std::chrono::steady_clock::time_point granted_at) {
+  if (!cache_ || size == 0 || size > options_.cache_max_object_bytes) return;
+  const cache::ObjectVersion version{copy.cache_gen, copy.cache_version};
+  // Only keystone-granted (version + lease), CRC-stamped reads are
+  // cacheable — "a hit returns verified bytes" is a contract, not a mood.
+  if (!version.valid() || copy.cache_lease_ms == 0 || copy.content_crc == 0) return;
+  // The lease runs from the moment the grant was FETCHED, not from fill:
+  // a slow transfer between the two must never stretch the staleness bound
+  // past grant + lease.
+  cache_->fill(key, version, copy.content_crc,
+               std::make_shared<const std::vector<uint8_t>>(data, data + size),
+               granted_at + std::chrono::milliseconds(copy.cache_lease_ms));
+}
+
+std::optional<uint64_t> ObjectClient::cached_object_size(const ObjectKey& key) {
+  if (!cache_) return std::nullopt;
+  auto hit = cache_->peek(key);
+  if (!hit.bytes) return std::nullopt;
+  if (embedded_ && !options_.cache_force_lease_mode) {
+    const auto [gen, epoch] = embedded_->object_cache_version(key);
+    if (!(cache::ObjectVersion{gen, epoch} == hit.version)) return std::nullopt;
+  } else if (hit.outcome != cache::ObjectCache::Outcome::kHit) {
+    return std::nullopt;  // lease lapsed: let the probe revalidate normally
+  }
+  return hit.bytes->size();
 }
 
 // Runs `attempt` against possibly-cached placements with ONE fresh-metadata
@@ -149,11 +326,11 @@ void ObjectClient::invalidate_all_placements() {
 // discipline documented on ClientOptions::placement_cache_ms.
 ErrorCode ObjectClient::read_with_cache(
     const ObjectKey& key, bool verify,
-    const std::function<ErrorCode(const std::vector<CopyPlacement>&)>& attempt) {
+    const std::function<ErrorCode(const std::vector<CopyPlacement>&, bool)>& attempt) {
   bool from_cache = false;
   auto copies = verify ? get_workers_cached(key, from_cache) : get_workers(key);
   if (!copies.ok()) return copies.error();
-  ErrorCode ec = attempt(copies.value());
+  ErrorCode ec = attempt(copies.value(), from_cache);
   if (ec == ErrorCode::OK || !from_cache) return ec;
   // Cached placements failed (moved bytes, dead worker, size change):
   // drop the entry and retry once with fresh metadata.
@@ -161,7 +338,7 @@ ErrorCode ObjectClient::read_with_cache(
   from_cache = false;
   copies = get_workers_cached(key, from_cache);
   if (!copies.ok()) return copies.error();
-  return attempt(copies.value());
+  return attempt(copies.value(), from_cache);
 }
 
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size) {
@@ -192,21 +369,31 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
                                                std::optional<bool> verify) {
   TRACE_SPAN("client.get");
+  // Hot path: a coherent cached entry answers with one memcpy and zero
+  // worker involvement (the bytes were verified at fill time).
+  if (auto cached = cache_acquire(key)) {
+    cache::note_cached_serve(cached->size());
+    return std::vector<uint8_t>(cached->begin(), cached->end());
+  }
   const bool v = verify.value_or(verify_reads());
   std::vector<uint8_t> buffer;
   const ErrorCode ec = read_with_cache(
-      key, v, [&](const std::vector<CopyPlacement>& copies) -> ErrorCode {
+      key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
+        const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
         uint64_t size = 0;
         if (!copies.empty()) size = copy_logical_size(copies.front());
         buffer.resize(size);
-        if (try_split_read(copies, buffer.data(), size, v) == ErrorCode::OK)
+        if (try_split_read(copies, buffer.data(), size, v) == ErrorCode::OK) {
+          if (v && !stale_meta) cache_fill(key, copies.front(), buffer.data(), size, meta_at);
           return ErrorCode::OK;
+        }
         ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
         for (const auto& copy : copies) {
           const uint64_t copy_size = copy_logical_size(copy);
           if (copy_size != size) buffer.resize(copy_size);
           if (auto tec = transfer_copy_get(copy, buffer.data(), copy_size, v);
               tec == ErrorCode::OK) {
+            if (v && !stale_meta) cache_fill(key, copy, buffer.data(), copy_size, meta_at);
             return ErrorCode::OK;
           } else {
             // Corruption is the strongest signal — a later replica's
@@ -226,16 +413,24 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
 Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
                                         uint64_t buffer_size, std::optional<bool> verify) {
   TRACE_SPAN("client.get");
-  const bool v = verify.value_or(verify_reads());
   uint64_t got = 0;
+  // Hot path: serve verified bytes straight out of the object cache (an
+  // entry too large for `buffer` falls through; the normal path reports
+  // BUFFER_OVERFLOW with fresh metadata).
+  if (cache_ && cache_serve(key, buffer, buffer_size, got)) return got;
+  const bool v = verify.value_or(verify_reads());
   const ErrorCode ec = read_with_cache(
-      key, v, [&](const std::vector<CopyPlacement>& copies) -> ErrorCode {
+      key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
+        const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
         uint64_t size = 0;
         if (!copies.empty()) size = copy_logical_size(copies.front());
         if (size <= buffer_size &&
             try_split_read(copies, static_cast<uint8_t*>(buffer), size, v) ==
                 ErrorCode::OK) {
           got = size;
+          if (v && !stale_meta)
+            cache_fill(key, copies.front(), static_cast<const uint8_t*>(buffer), size,
+                       meta_at);
           return ErrorCode::OK;
         }
         ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
@@ -251,6 +446,9 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
                                            copy_size, v);
               tec == ErrorCode::OK) {
             got = copy_size;
+            if (v && !stale_meta)
+              cache_fill(key, copy, static_cast<const uint8_t*>(buffer), copy_size,
+                         meta_at);
             return ErrorCode::OK;
           } else {
             if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
@@ -1497,6 +1695,81 @@ void ObjectClient::cancel_pooled_slots() {
 
 std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,
                                                      std::optional<bool> verify) {
+  if (!cache_ || items.empty()) return get_many_uncached(items, verify);
+  // Cache pass first: hits (e.g. a checkpoint's hot shards re-read by
+  // load_sharded) are served locally; only the misses ride the batch.
+  std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
+  std::vector<GetItem> missing;
+  std::vector<size_t> missing_idx;
+  const bool direct = embedded_ && !options_.cache_force_lease_mode;
+  using Outcome = cache::ObjectCache::Outcome;
+  // Lease-mode entries whose lease lapsed: revalidated as ONE batched
+  // metadata round below, never one control RTT per key (an idle-then-
+  // reloaded checkpoint would otherwise serialize N round trips).
+  struct ExpiredItem {
+    size_t idx;
+    cache::ObjectCache::Hit hit;
+  };
+  std::vector<ExpiredItem> expired;
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!items[i].buffer) {
+      missing.push_back(items[i]);
+      missing_idx.push_back(i);
+      continue;
+    }
+    if (direct) {
+      uint64_t got = 0;
+      if (cache_serve(items[i].key, items[i].buffer, items[i].buffer_size, got)) {
+        results[i] = got;
+      } else {
+        missing.push_back(items[i]);
+        missing_idx.push_back(i);
+      }
+      continue;
+    }
+    auto hit = cache_->lookup(items[i].key);
+    if (hit.outcome == Outcome::kHit && hit.bytes->size() <= items[i].buffer_size) {
+      std::memcpy(items[i].buffer, hit.bytes->data(), hit.bytes->size());
+      results[i] = hit.bytes->size();
+      cache::note_cached_serve(hit.bytes->size());
+    } else if (hit.outcome == Outcome::kExpired &&
+               hit.bytes->size() <= items[i].buffer_size) {
+      expired.push_back({i, std::move(hit)});
+    } else {
+      missing.push_back(items[i]);
+      missing_idx.push_back(i);
+    }
+  }
+  if (!expired.empty()) {
+    std::vector<ObjectKey> keys;
+    keys.reserve(expired.size());
+    for (const auto& e : expired) keys.push_back(items[e.idx].key);
+    auto metas = get_workers_many(keys);
+    const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
+    for (size_t j = 0; j < expired.size(); ++j) {
+      auto& e = expired[j];
+      const Result<std::vector<CopyPlacement>> meta =
+          j < metas.size() ? std::move(metas[j])
+                           : Result<std::vector<CopyPlacement>>(ErrorCode::OBJECT_NOT_FOUND);
+      if (cache_revalidate(items[e.idx].key, e.hit, meta, meta_at)) {
+        std::memcpy(items[e.idx].buffer, e.hit.bytes->data(), e.hit.bytes->size());
+        results[e.idx] = e.hit.bytes->size();
+        cache::note_cached_serve(e.hit.bytes->size());
+      } else {
+        missing.push_back(items[e.idx]);
+        missing_idx.push_back(e.idx);
+      }
+    }
+  }
+  if (missing.empty()) return results;
+  auto sub = get_many_uncached(missing, verify);
+  for (size_t j = 0; j < missing_idx.size() && j < sub.size(); ++j)
+    results[missing_idx[j]] = sub[j];
+  return results;
+}
+
+std::vector<Result<uint64_t>> ObjectClient::get_many_uncached(
+    const std::vector<GetItem>& items, std::optional<bool> verify) {
   TRACE_SPAN("client.get_many");
   const bool v = verify.value_or(verify_reads());
   std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
@@ -1515,6 +1788,7 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
     if (!r.ok()) return std::vector<Result<uint64_t>>(items.size(), r.error());
     placements = std::move(r.value());
   }
+  const auto meta_at = std::chrono::steady_clock::now();  // cache lease anchor
 
   // First pass: batched transfer of every item's first replica.
   BatchJobs jobs;
@@ -1611,6 +1885,9 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
     }
     if (errors[i] == ErrorCode::OK) {
       results[i] = sizes[i];
+      if (v)
+        cache_fill(items[i].key, placements[i].value().front(),
+                   static_cast<const uint8_t*>(items[i].buffer), sizes[i], meta_at);
       continue;
     }
     // Replica failover, one item at a time (first copy already failed).
@@ -1623,6 +1900,9 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
       if (transfer_copy_ec(copies.front(), static_cast<uint8_t*>(items[i].buffer), sizes[i],
                            /*is_write=*/false, v) == ErrorCode::OK) {
         results[i] = sizes[i];
+        if (v)
+          cache_fill(items[i].key, copies.front(),
+                     static_cast<const uint8_t*>(items[i].buffer), sizes[i], meta_at);
       } else {
         results[i] = last;
       }
@@ -1638,6 +1918,9 @@ std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>&
                                       copy_size, v);
           ec == ErrorCode::OK) {
         results[i] = copy_size;
+        if (v)
+          cache_fill(items[i].key, copies[c],
+                     static_cast<const uint8_t*>(items[i].buffer), copy_size, meta_at);
         done = true;
       } else {
         last = ec;

@@ -37,6 +37,12 @@ std::string objects_prefix(const std::string& c) { return "/btpu/clusters/" + c 
 std::string object_record_key(const std::string& c, const std::string& key) {
   return objects_prefix(c) + key;
 }
+std::string cache_inval_prefix(const std::string& c) {
+  return "/btpu/clusters/" + c + "/cacheinval/";
+}
+std::string cache_inval_key(const std::string& c, const std::string& key) {
+  return cache_inval_prefix(c) + key;
+}
 
 // ---- journal --------------------------------------------------------------
 //

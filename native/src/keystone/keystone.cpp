@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <optional>
+#include <random>
 #include <unordered_set>
 
 #include "btpu/common/log.h"
@@ -26,6 +27,13 @@ KeystoneService::KeystoneService(KeystoneConfig config,
   service_id_ = config_.service_id.empty()
                     ? config_.cluster_id + "-keystone-" + std::to_string(now_wall_ms())
                     : config_.service_id;
+  // Cache-coherence incarnation nonce (see cache_gen_ in the header):
+  // nonzero so stamped placements are distinguishable from a pre-cache
+  // server's zeros.
+  std::random_device rd;
+  do {
+    cache_gen_ = (static_cast<uint64_t>(rd()) << 32) | rd();
+  } while (cache_gen_ == 0);
 }
 
 KeystoneService::~KeystoneService() { stop(); }
@@ -397,6 +405,10 @@ void KeystoneService::run_gc_once() {
       LOG_DEBUG << "gc collected expired object " << key;
     }
     bump_view();
+    lock.unlock();
+    // Pending reclaims were never readable, so only TTL expiries of
+    // complete objects need the cache fan-out.
+    if (!stale_pending) publish_cache_invalidation(key, 0);
   }
 }
 
@@ -464,7 +476,34 @@ Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey&
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   it->second.last_access = std::chrono::steady_clock::now();
   ++counters_.gets;
-  return it->second.copies;
+  auto copies = it->second.copies;
+  // Cache-coherence grant, on the REPLY only (never the stored/persisted
+  // copies): the object's current version plus a read lease. Complete
+  // objects only — a pending put's bytes are not a committed version.
+  if (config_.cache_lease_ms > 0 && it->second.state == ObjectState::kComplete) {
+    for (auto& copy : copies) {
+      copy.cache_version = it->second.epoch;
+      copy.cache_gen = cache_gen_;
+      copy.cache_lease_ms = config_.cache_lease_ms;
+    }
+  }
+  return copies;
+}
+
+std::pair<uint64_t, uint64_t> KeystoneService::object_cache_version(
+    const ObjectKey& key) const {
+  std::shared_lock lock(objects_mutex_);
+  auto it = objects_.find(key);
+  if (it == objects_.end() || it->second.state != ObjectState::kComplete) return {0, 0};
+  return {cache_gen_, it->second.epoch};
+}
+
+void KeystoneService::publish_cache_invalidation(const ObjectKey& key, uint64_t version) {
+  if (!coordinator_ || config_.cache_lease_ms == 0) return;
+  // Watchers act on the EVENT; the stored value only needs to outlive slow
+  // delivery, so it is TTL'd and the topic self-cleans.
+  coordinator_->put_with_ttl(coord::cache_inval_key(config_.cluster_id, key),
+                             std::to_string(version), 30'000);
 }
 
 ErrorCode KeystoneService::normalize_put_config(WorkerConfig& effective) const {
@@ -767,11 +806,14 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   objects_.erase(it);
   ++counters_.removes;
   bump_view();
+  lock.unlock();
+  publish_cache_invalidation(key, 0);
   return ErrorCode::OK;
 }
 
 Result<uint64_t> KeystoneService::remove_all_objects() {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
+  std::vector<ObjectKey> removed;
   std::unique_lock lock(objects_mutex_);
   uint64_t count = 0;
   for (auto it = objects_.begin(); it != objects_.end();) {
@@ -786,12 +828,15 @@ Result<uint64_t> KeystoneService::remove_all_objects() {
       continue;
     }
     if (it->second.slot) slot_objects_.fetch_sub(1);
+    removed.push_back(it->first);
     free_object_locked(it->first, it->second);
     it = objects_.erase(it);
     ++count;
   }
   counters_.removes += count;
   bump_view();
+  lock.unlock();
+  for (const auto& key : removed) publish_cache_invalidation(key, 0);
   return count;
 }
 

@@ -247,6 +247,8 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       }
       bump_view();
       ++moved;
+      lock.unlock();
+      publish_cache_invalidation(m.key, epoch_now[m.key]);
     }
     total_moved += moved;
     if (moved == 0 && !pending_touches) break;  // no progress: stop retrying

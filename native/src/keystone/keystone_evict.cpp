@@ -122,6 +122,8 @@ void KeystoneService::evict_for_pressure() {
       objects_.erase(it);
       ++counters_.evicted;
       bump_view();
+      lock.unlock();
+      publish_cache_invalidation(key, 0);
       LOG_INFO << "evicted object " << key << " for tier pressure";
     }
   }
@@ -290,6 +292,9 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     unpersist_object(key);
     ++counters_.objects_lost;
     bump_view();
+    lock.unlock();
+    // A deletion like any other: caching clients must hear about it.
+    publish_cache_invalidation(key, 0);
     return DemoteOutcome::kSkipped;
   }
   it->second.copies = std::move(placed).value();
@@ -299,6 +304,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     carry_shard_crcs(*moved_src, copy);
   }
   it->second.epoch = next_epoch_.fetch_add(1);
+  const uint64_t new_epoch = it->second.epoch;
   // Fabric/device moves carry stamps without the staged lane's CRC gate:
   // scrub them.
   if (used_unchecked) queue_scrub_target(key);
@@ -311,9 +317,15 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     LOG_ERROR << "demotion of " << key << " not durably recorded: " << to_string(ec);
     mark_persist_dirty(key);
     bump_view();
+    lock.unlock();
+    publish_cache_invalidation(key, new_epoch);
     return DemoteOutcome::kSkipped;
   }
   bump_view();
+  lock.unlock();
+  // The bytes moved (old ranges are freed and reusable): cached placements
+  // and cached bytes alike must revalidate against the new epoch.
+  publish_cache_invalidation(key, new_epoch);
   return DemoteOutcome::kDemoted;
 }
 

@@ -132,6 +132,11 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   }
 
   std::vector<PendingRepair> pending;
+  // Cache invalidations for keys this pass mutated (version bump) or lost
+  // (0): collected under the lock, fanned out after it — the watch lane
+  // must not ride inside the object-map critical section when the
+  // coordinator is remote.
+  std::vector<std::pair<ObjectKey, uint64_t>> cache_invals;
   // Any durable write that fails mid-pass defers the rest of this worker's
   // repair to the health loop (repair_retry_): the death event fires once,
   // so without the retry a transient coordinator outage would strand
@@ -226,6 +231,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           it = objects_.erase(it);
           ++counters_.objects_lost;
           bump_view();
+          cache_invals.emplace_back(key, 0);
           continue;
         }
         // Persist the bumped epoch BEFORE touching allocator state: a
@@ -241,6 +247,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         }
         drop_dead_worker_bookkeeping();
         bump_view();
+        cache_invals.emplace_back(key, info.epoch);
         if (info.state == ObjectState::kComplete) {
           // Queue reconstruction of EVERY dead shard (including ones from
           // earlier deaths): without healing, losses accumulate until the
@@ -322,6 +329,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         it = objects_.erase(it);
         ++counters_.objects_lost;
         bump_view();
+        cache_invals.emplace_back(key, 0);
         continue;
       }
       // Make the pruned state durable BEFORE releasing any ranges: if the
@@ -357,6 +365,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
                                 ? info.config.replication_factor - surviving.size()
                                 : 0;
       bump_view();
+      cache_invals.emplace_back(key, info.epoch);
       if (needed > 0 && info.state == ObjectState::kComplete) {
         pending.push_back(
             {key, info.size, info.epoch, needed, info.config, std::move(surviving)});
@@ -364,6 +373,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       ++it;
     }
   }
+  for (const auto& [key, version] : cache_invals) publish_cache_invalidation(key, version);
 
   // Pass 2 — no metadata lock while bytes move: stage the top-up copies
   // under a temporary allocator key, stream from a survivor, then merge the
@@ -442,6 +452,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       it->second.copies.push_back(std::move(copy));
     }
     it->second.epoch = next_epoch_.fetch_add(1);
+    const uint64_t spliced_epoch = it->second.epoch;
     // Fabric- and chip-to-chip-moved bytes bypassed the staged lane's
     // streaming CRC gate but carry the source's stamps: have the scrub
     // verify them ahead of its ring walk (and heal from a sibling if the
@@ -459,12 +470,16 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       LOG_ERROR << "repair of " << p.key << " not durably recorded: " << to_string(ec);
       mark_persist_dirty(p.key);
       bump_view();
+      lock.unlock();
+      publish_cache_invalidation(p.key, spliced_epoch);
       deferred = true;
       continue;
     }
     ++counters_.objects_repaired;
     ++repaired;
     bump_view();
+    lock.unlock();
+    publish_cache_invalidation(p.key, spliced_epoch);
   }
 
   // Pass 2b — erasure-coded objects: reconstruct every dead shard from any
@@ -747,6 +762,7 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
       it->second.copies.front().shard_crcs[d] = rebuilt_crcs[j];
   }
   it->second.epoch = next_epoch_.fetch_add(1);
+  const uint64_t spliced_epoch = it->second.epoch;
   if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
     // Same discipline as the replicated merge path: the splice already landed
     // locally (memory + allocator are consistent) but the durable record is
@@ -757,9 +773,13 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
     LOG_ERROR << "ec repair of " << key << " not durably recorded: " << to_string(ec);
     mark_persist_dirty(key);
     bump_view();
+    lock.unlock();
+    publish_cache_invalidation(key, spliced_epoch);
     return false;
   }
   bump_view();
+  lock.unlock();
+  publish_cache_invalidation(key, spliced_epoch);
   LOG_INFO << "ec repair rebuilt " << targets.size() << " shard(s) of " << key;
   return true;
 }

@@ -5,6 +5,7 @@
 #include <map>
 #include <sstream>
 
+#include "btpu/cache/object_cache.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/keystone/keystone.h"
@@ -94,6 +95,23 @@ std::string MetricsHttpServer::render_metrics() const {
           c.scrub_corrupt.load());
   counter("btpu_scrub_healed_total", "corrupt shards restored by the background scrub",
           c.scrub_healed.load());
+  // Client object cache (btpu/cache): process-global, so embedded clients
+  // sharing this process surface their hit/invalidation behavior here; a
+  // standalone keystone naturally reports zeros.
+  counter("btpu_cache_hits_total",
+          "object-cache hits served in this process (zero worker RTTs)",
+          cache::cache_hit_count());
+  counter("btpu_cache_misses_total", "object-cache misses in this process",
+          cache::cache_miss_count());
+  counter("btpu_cache_invalidations_total",
+          "object-cache entries dropped by invalidation events",
+          cache::cache_invalidation_count());
+  counter("btpu_cache_stale_rejects_total",
+          "object-cache hits rejected because the object version moved",
+          cache::cache_stale_reject_count());
+  counter("btpu_cached_bytes_total",
+          "bytes served from the client object cache (zero wire bytes)",
+          cache::cached_byte_count());
 
   auto stats = service_.get_cluster_stats();
   if (stats.ok()) {

@@ -1,6 +1,8 @@
 #include "btest.h"
 
-// TSan one-sided-RMA suppression, shared with the sanitized executables.
+// TSan one-sided-RMA suppression + clockwait interceptor shim, shared with
+// the sanitized executables.
+#include "../exe/tsan_clockwait_shim.h"
 #include "../exe/tsan_rma_suppression.h"
 
 int main(int argc, char** argv) { return btest::run_all(argc, argv); }

@@ -1,0 +1,386 @@
+// Lease-coherent client object cache (btpu/cache/object_cache.h): unit tests
+// for the segmented-LRU core, plus end-to-end coherence proofs against the
+// embedded cluster — invalidation on overwrite/remove/evict/repair, torn-free
+// concurrent readers during invalidation, and the lease-expiry fallback with
+// the invalidation watch stream severed mid-flight.
+#include <atomic>
+#include <cstring>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/cache/object_cache.h"
+#include "btpu/client/embedded.h"
+#include "btpu/common/crc32c.h"
+
+using namespace btpu;
+using cache::ObjectCache;
+using cache::ObjectVersion;
+
+namespace {
+
+ObjectCache::Bytes make_bytes(size_t n, uint8_t seed) {
+  auto v = std::make_shared<std::vector<uint8_t>>(n);
+  for (size_t i = 0; i < n; ++i) (*v)[i] = static_cast<uint8_t>(seed + i * 131);
+  return v;
+}
+
+std::vector<uint8_t> pattern(size_t n, uint8_t seed) {
+  std::vector<uint8_t> v(n);
+  for (size_t i = 0; i < n; ++i) v[i] = static_cast<uint8_t>(seed + i * 131);
+  return v;
+}
+
+ObjectCache::Clock::time_point lease(int ms) {
+  return ObjectCache::Clock::now() + std::chrono::milliseconds(ms);
+}
+
+client::ClientOptions cached_options(uint64_t cache_bytes) {
+  client::ClientOptions opts;
+  opts.cache_bytes = cache_bytes;
+  return opts;
+}
+
+}  // namespace
+
+// ---- unit: segmented LRU core ----------------------------------------------
+
+BTEST(Cache, HitMissAndVersionedFill) {
+  ObjectCache cache(1 << 20);
+  const ObjectVersion v1{7, 1};
+  BT_EXPECT(cache.lookup_validated("k", v1).outcome == ObjectCache::Outcome::kMiss);
+  cache.fill("k", v1, 123, make_bytes(1024, 1), lease(60'000));
+  auto hit = cache.lookup_validated("k", v1);
+  BT_ASSERT(hit.outcome == ObjectCache::Outcome::kHit);
+  BT_EXPECT_EQ(hit.bytes->size(), size_t{1024});
+  BT_EXPECT_EQ(hit.content_crc, 123u);
+  // A moved version rejects the resident entry (stale_reject) and misses.
+  auto stale = cache.lookup_validated("k", ObjectVersion{7, 2});
+  BT_EXPECT(stale.outcome == ObjectCache::Outcome::kMiss);
+  const auto stats = cache.stats();
+  BT_EXPECT_EQ(stats.stale_rejects, uint64_t{1});
+  BT_EXPECT_EQ(stats.entries, uint64_t{0});  // rejected entry is gone
+  // An unstamped version is never cacheable.
+  cache.fill("u", ObjectVersion{}, 1, make_bytes(64, 2), lease(60'000));
+  BT_EXPECT_EQ(cache.stats().fills, uint64_t{1});
+}
+
+BTEST(Cache, FillRefusesOlderEpochOfSameGeneration) {
+  ObjectCache cache(1 << 20);
+  cache.fill("k", {9, 5}, 1, make_bytes(64, 5), lease(60'000));
+  cache.fill("k", {9, 3}, 2, make_bytes(64, 3), lease(60'000));  // stale racer loses
+  auto hit = cache.lookup_validated("k", {9, 5});
+  BT_ASSERT(hit.outcome == ObjectCache::Outcome::kHit);
+  BT_EXPECT_EQ(hit.content_crc, 1u);
+}
+
+BTEST(Cache, CapacityEvictionIsSegmented) {
+  // One shard (tiny capacity), 4 KiB budget: hot entries promoted to the
+  // protected segment must survive a probation scan that evicts cold ones.
+  ObjectCache cache(4 << 10);
+  const ObjectVersion v{1, 1};
+  cache.fill("hot", v, 1, make_bytes(1 << 10, 1), lease(60'000));
+  // Second touch promotes "hot" into protected.
+  BT_EXPECT(cache.lookup_validated("hot", v).outcome == ObjectCache::Outcome::kHit);
+  for (int i = 0; i < 16; ++i)
+    cache.fill("scan/" + std::to_string(i), v, 1, make_bytes(1 << 10, uint8_t(i)), lease(60'000));
+  const auto stats = cache.stats();
+  BT_EXPECT(stats.evictions > 0);
+  BT_EXPECT(stats.bytes <= 4 << 10);
+  BT_EXPECT(cache.lookup_validated("hot", v).outcome == ObjectCache::Outcome::kHit);
+}
+
+BTEST(Cache, OversizedObjectsAreRefused) {
+  ObjectCache cache(64 << 10, /*max_object_bytes=*/8 << 10);
+  cache.fill("big", {1, 1}, 1, make_bytes(16 << 10, 1), lease(60'000));
+  BT_EXPECT_EQ(cache.stats().fills, uint64_t{0});
+  BT_EXPECT_EQ(cache.stats().bytes, uint64_t{0});
+}
+
+BTEST(Cache, LeaseExpiryDemandsRevalidation) {
+  ObjectCache cache(1 << 20);
+  cache.fill("k", {3, 4}, 9, make_bytes(256, 1), lease(0));  // born expired
+  auto hit = cache.lookup("k");
+  BT_ASSERT(hit.outcome == ObjectCache::Outcome::kExpired);
+  // Matching revalidation renews; the next lookup serves.
+  cache.renew("k", {3, 4}, lease(60'000));
+  BT_EXPECT(cache.lookup("k").outcome == ObjectCache::Outcome::kHit);
+  // Mismatching revalidation drops the entry.
+  cache.renew("k", {3, 9}, lease(60'000));
+  BT_EXPECT(cache.lookup("k").outcome == ObjectCache::Outcome::kMiss);
+  BT_EXPECT_EQ(cache.stats().stale_rejects, uint64_t{1});
+}
+
+// ---- end-to-end: embedded cluster, direct-validated coherence --------------
+
+BTEST(Cache, EmbeddedHitsServeWithoutWorkerOps) {
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(2, 32 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto c = cluster.make_client(cached_options(8 << 20));
+  const auto data = pattern(64 << 10, 42);
+  BT_ASSERT(c->put("hot", data.data(), data.size()) == ErrorCode::OK);
+  std::vector<uint8_t> out(data.size());
+  // First read misses and fills; the next ones hit.
+  for (int i = 0; i < 5; ++i) {
+    auto got = c->get_into("hot", out.data(), out.size());
+    BT_ASSERT_OK(got);
+    BT_EXPECT_EQ(got.value(), data.size());
+    BT_EXPECT(out == data);
+  }
+  const auto stats = c->cache_stats();
+  BT_EXPECT_EQ(stats.fills, uint64_t{1});
+  BT_EXPECT(stats.hits >= 4);
+  // get() (allocating variant) also serves from the same entry.
+  auto whole = c->get("hot");
+  BT_ASSERT_OK(whole);
+  BT_EXPECT(whole.value() == data);
+  cluster.stop();
+}
+
+BTEST(Cache, InvalidationOnOverwriteRemoveAndGc) {
+  client::EmbeddedClusterOptions opts = client::EmbeddedClusterOptions::simple(2, 32 << 20);
+  client::EmbeddedCluster cluster(opts);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto reader = cluster.make_client(cached_options(8 << 20));
+  auto writer = cluster.make_client();  // uncached second client
+
+  const auto v1 = pattern(32 << 10, 1), v2 = pattern(32 << 10, 2);
+  BT_ASSERT(writer->put("k", v1.data(), v1.size()) == ErrorCode::OK);
+  BT_EXPECT(reader->get("k").value() == v1);       // fill
+  BT_EXPECT(reader->get("k").value() == v1);       // hit
+
+  // Overwrite (remove + re-put) by ANOTHER client: the very next read must
+  // see the new bytes — the version check makes stale structurally
+  // impossible, no grace period.
+  BT_ASSERT(writer->remove("k") == ErrorCode::OK);
+  BT_ASSERT(writer->put("k", v2.data(), v2.size()) == ErrorCode::OK);
+  BT_EXPECT(reader->get("k").value() == v2);
+  BT_EXPECT(reader->cache_stats().stale_rejects >= 1);
+
+  // Remove: the cached bytes must not resurrect the object.
+  BT_ASSERT(writer->remove("k") == ErrorCode::OK);
+  BT_EXPECT(!reader->get("k").ok());
+
+  // TTL GC (the eviction-shaped deletion a client never asked for): cached
+  // bytes must not outlive the object.
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.ttl_ms = 1;
+  BT_ASSERT(writer->put("ttl", v1.data(), v1.size(), wc) == ErrorCode::OK);
+  BT_EXPECT(reader->get("ttl").value() == v1);
+  std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  cluster.keystone().run_gc_once();
+  BT_EXPECT(!reader->get("ttl").ok());
+  cluster.stop();
+}
+
+BTEST(Cache, InvalidationOnWatermarkEviction) {
+  // Keystone watermark eviction (delete-shaped, no client asked for it):
+  // cached bytes of an evicted object must not serve once it is gone.
+  auto opts = client::EmbeddedClusterOptions::simple(1, 512 << 10);
+  opts.keystone.high_watermark = 0.5;
+  opts.keystone.eviction_ratio = 0.2;
+  client::EmbeddedCluster cluster(opts);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto c = cluster.make_client(cached_options(8 << 20));
+  WorkerConfig wc;
+  wc.replication_factor = 1;
+  wc.max_workers_per_copy = 1;
+  std::vector<std::vector<uint8_t>> datas;
+  for (int i = 0; i < 5; ++i) {  // 5 x 64 KiB = 62% of the pool, > watermark
+    datas.push_back(pattern(64 << 10, static_cast<uint8_t>(i)));
+    BT_ASSERT(c->put("ev/" + std::to_string(i), datas[i].data(), datas[i].size(), wc) ==
+              ErrorCode::OK);
+    BT_EXPECT(c->get("ev/" + std::to_string(i)).value() == datas[i]);  // fill
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));  // LRU order
+  }
+  cluster.keystone().run_health_check_once();
+  BT_ASSERT(cluster.keystone().counters().evicted.load() > 0);
+  size_t evicted_seen = 0;
+  for (int i = 0; i < 5; ++i) {
+    auto got = c->get("ev/" + std::to_string(i));
+    if (got.ok()) {
+      BT_EXPECT(got.value() == datas[i]);  // survivors still verify
+    } else {
+      // Evicted: the cached bytes must NOT have resurrected the object.
+      BT_EXPECT(got.error() == ErrorCode::OBJECT_NOT_FOUND);
+      ++evicted_seen;
+    }
+  }
+  BT_EXPECT(evicted_seen > 0);
+  cluster.stop();
+}
+
+BTEST(Cache, InvalidationAfterRepairRewrite) {
+  // Repair rewrites a replica after worker death: the epoch bump must force
+  // cached readers to revalidate (and the refreshed read must verify).
+  auto opts = client::EmbeddedClusterOptions::simple(3, 32 << 20);
+  opts.use_coordinator = false;  // direct feed: kill_worker drives repair
+  client::EmbeddedCluster cluster(opts);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto c = cluster.make_client(cached_options(8 << 20));
+  const auto data = pattern(64 << 10, 7);
+  WorkerConfig wc;
+  wc.replication_factor = 2;
+  wc.max_workers_per_copy = 1;
+  BT_ASSERT(c->put("rep", data.data(), data.size(), wc) == ErrorCode::OK);
+  BT_EXPECT(c->get("rep").value() == data);  // fill
+  const auto placements = cluster.keystone().get_workers("rep");
+  BT_ASSERT_OK(placements);
+  BT_ASSERT(!placements.value().empty());
+  BT_ASSERT(!placements.value().front().shards.empty());
+  const NodeId victim = placements.value().front().shards.front().worker_id;
+  size_t victim_idx = 0;
+  for (size_t i = 0; i < cluster.worker_count(); ++i) {
+    if (cluster.worker_alive(i) && cluster.worker(i).config().worker_id == victim)
+      victim_idx = i;
+  }
+  cluster.kill_worker(victim_idx);  // synchronously triggers repair
+  const auto before = c->cache_stats();
+  auto after_repair = c->get("rep");
+  BT_ASSERT_OK(after_repair);
+  BT_EXPECT(after_repair.value() == data);
+  // The repair's epoch bump rejected the resident entry: no stale serve.
+  BT_EXPECT(c->cache_stats().stale_rejects > before.stale_rejects);
+  cluster.stop();
+}
+
+BTEST(Cache, ConcurrentReadersDuringInvalidationNeverTear) {
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(2, 64 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto writer = cluster.make_client();
+  const size_t n = 32 << 10;
+  const auto a = std::vector<uint8_t>(n, 0xAA), b = std::vector<uint8_t>(n, 0xBB);
+  BT_ASSERT(writer->put("flip", a.data(), n) == ErrorCode::OK);
+
+  std::atomic<bool> stop{false};
+  std::atomic<bool> torn{false};
+  std::vector<std::thread> readers;
+  for (int t = 0; t < 3; ++t) {
+    readers.emplace_back([&, t] {
+      auto c = cluster.make_client(cached_options(4 << 20));
+      std::vector<uint8_t> out(n);
+      (void)t;
+      while (!stop.load()) {
+        auto got = c->get_into("flip", out.data(), out.size());
+        if (!got.ok()) continue;  // overwrite gap (removed, not yet re-put)
+        // Every successful read must be ENTIRELY one version: a mixed
+        // buffer means an invalidation tore a concurrent cached serve.
+        const uint8_t first = out[0];
+        if (first != 0xAA && first != 0xBB) torn.store(true);
+        for (size_t i = 1; i < n; ++i) {
+          if (out[i] != first) {
+            torn.store(true);
+            break;
+          }
+        }
+      }
+    });
+  }
+  for (int round = 0; round < 40; ++round) {
+    const auto& next = (round & 1) ? b : a;
+    writer->remove("flip");
+    BT_ASSERT(writer->put("flip", next.data(), n) == ErrorCode::OK);
+  }
+  stop.store(true);
+  for (auto& th : readers) th.join();
+  BT_EXPECT(!torn.load());
+  cluster.stop();
+}
+
+BTEST(Cache, ClientCapacityEvictionUnderTinyBudget) {
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(2, 64 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  // 128 KiB cache, 10 x 64 KiB objects: at most two resident at a time.
+  auto c = cluster.make_client(cached_options(128 << 10));
+  std::vector<std::vector<uint8_t>> datas;
+  for (int i = 0; i < 10; ++i) {
+    datas.push_back(pattern(64 << 10, static_cast<uint8_t>(i)));
+    BT_ASSERT(c->put("obj/" + std::to_string(i), datas[i].data(), datas[i].size()) ==
+              ErrorCode::OK);
+  }
+  for (int pass = 0; pass < 3; ++pass) {
+    for (int i = 0; i < 10; ++i) {
+      auto got = c->get("obj/" + std::to_string(i));
+      BT_ASSERT_OK(got);
+      BT_EXPECT(got.value() == datas[i]);
+    }
+  }
+  const auto stats = c->cache_stats();
+  BT_EXPECT(stats.evictions > 0);
+  BT_EXPECT(stats.bytes <= 128 << 10);
+  cluster.stop();
+}
+
+// ---- end-to-end: lease + watch coherence (the remote-client path) ----------
+
+BTEST(Cache, LeaseModeWatchInvalidationAndSeveredFallback) {
+  // Embedded cluster, but the caching client is FORCED onto the remote
+  // coherence path: keystone-granted leases + the coordinator invalidation
+  // watch — hermetic coverage of exactly what a remote client runs.
+  auto opts = client::EmbeddedClusterOptions::simple(2, 32 << 20);
+  opts.keystone.cache_lease_ms = 150;  // short lease: the severed bound below
+  client::EmbeddedCluster cluster(opts);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+
+  client::ClientOptions copts = cached_options(8 << 20);
+  copts.cache_force_lease_mode = true;
+  copts.cache_coordinator = cluster.coordinator_shared();
+  copts.cluster_id = opts.keystone.cluster_id;
+  auto reader = cluster.make_client(copts);
+  auto writer = cluster.make_client();
+
+  const auto v1 = pattern(32 << 10, 1), v2 = pattern(32 << 10, 2),
+             v3 = pattern(32 << 10, 3);
+  BT_ASSERT(writer->put("k", v1.data(), v1.size()) == ErrorCode::OK);
+  BT_EXPECT(reader->get("k").value() == v1);  // fill under lease
+  BT_EXPECT(reader->get("k").value() == v1);  // hit within lease
+  BT_EXPECT(reader->cache_stats().hits >= 1);
+
+  // Overwrite with the watch LIVE: the MemCoordinator delivers the remove's
+  // invalidation before the writer's call returns, so the next read is
+  // fresh even though the reader's lease had not expired.
+  BT_ASSERT(writer->remove("k") == ErrorCode::OK);
+  BT_ASSERT(writer->put("k", v2.data(), v2.size()) == ErrorCode::OK);
+  BT_EXPECT(reader->get("k").value() == v2);
+  BT_EXPECT(reader->cache_stats().invalidations >= 1);
+
+  // Sever the watch stream mid-flight: entries degrade to their lease
+  // deadline and every hit must revalidate — the next read pays one control
+  // RTT, matches the version, and serves the cached bytes.
+  reader->sever_cache_watch_for_test();
+  const auto before = reader->cache_stats();
+  BT_EXPECT(reader->get("k").value() == v2);
+  BT_EXPECT(reader->cache_stats().lease_expiries > before.lease_expiries);
+
+  // Overwrite with the stream severed: within the (renewed) lease the
+  // reader may serve v2, but past the lease deadline the revalidation MUST
+  // observe the new version — the lease-expiry bound, honored.
+  BT_ASSERT(writer->remove("k") == ErrorCode::OK);
+  BT_ASSERT(writer->put("k", v3.data(), v3.size()) == ErrorCode::OK);
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));  // > lease TTL
+  BT_EXPECT(reader->get("k").value() == v3);
+  BT_EXPECT(reader->cache_stats().stale_rejects >= 1);
+  cluster.stop();
+}
+
+BTEST(Cache, LeaseOnlyClientHonorsExpiryBoundWithoutAnyWatch) {
+  // No coordinator handle at all (the remote-client-without-bb-coord
+  // shape): coherence rests entirely on lease expiry + revalidation.
+  auto opts = client::EmbeddedClusterOptions::simple(2, 32 << 20);
+  opts.keystone.cache_lease_ms = 100;
+  client::EmbeddedCluster cluster(opts);
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  client::ClientOptions copts = cached_options(8 << 20);
+  copts.cache_force_lease_mode = true;  // and no cache_coordinator
+  auto reader = cluster.make_client(copts);
+  auto writer = cluster.make_client();
+
+  const auto v1 = pattern(16 << 10, 1), v2 = pattern(16 << 10, 2);
+  BT_ASSERT(writer->put("k", v1.data(), v1.size()) == ErrorCode::OK);
+  BT_EXPECT(reader->get("k").value() == v1);
+  BT_ASSERT(writer->remove("k") == ErrorCode::OK);
+  BT_ASSERT(writer->put("k", v2.data(), v2.size()) == ErrorCode::OK);
+  std::this_thread::sleep_for(std::chrono::milliseconds(150));  // > lease
+  BT_EXPECT(reader->get("k").value() == v2);
+  cluster.stop();
+}

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# One-command ThreadSanitizer leg: builds the native tree under
+# -fsanitize=thread (separate build/tsan object tree) and runs the
+# concurrency-heavy suites — the client object cache and the transports.
+# Extra suites: TSAN_FILTERS="Cache Transport EndToEnd" scripts/tsan.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec make tsan ${TSAN_FILTERS:+TSAN_FILTERS="${TSAN_FILTERS}"}

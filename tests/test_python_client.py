@@ -208,6 +208,51 @@ def test_object_ttl_and_soft_pin():
         assert client.get("ttl/pinned") == b"pinned"
 
 
+def test_object_cache_hot_reads_and_coherence():
+    """Lease-coherent client object cache: repeated hot gets are served from
+    local memory (hits counted, cached lane bytes counted), and an
+    overwrite/remove by ANOTHER client is never served stale."""
+    with EmbeddedCluster(workers=2, pool_bytes=32 << 20) as cluster:
+        reader = cluster.client(cache_bytes=8 << 20)
+        writer = cluster.client()
+        payload_a = np.random.default_rng(1).bytes(64 * 1024)
+        payload_b = np.random.default_rng(2).bytes(64 * 1024)
+        writer.put("cache/hot", payload_a)
+
+        lane0 = Client.lane_counters().get("cached_bytes", 0)
+        assert reader.get("cache/hot") == payload_a  # miss + fill
+        for _ in range(4):
+            assert reader.get("cache/hot") == payload_a  # hits
+        stats = reader.cache_stats()
+        assert stats["fills"] == 1
+        assert stats["hits"] >= 4
+        assert stats["bytes"] == len(payload_a)
+        assert Client.lane_counters().get("cached_bytes", 0) > lane0
+
+        # Cross-client overwrite: the next read must observe the new bytes
+        # (version validation makes a stale serve structurally impossible).
+        writer.remove("cache/hot")
+        writer.put("cache/hot", payload_b)
+        assert reader.get("cache/hot") == payload_b
+        assert reader.cache_stats()["stale_rejects"] >= 1
+
+        # Remove: cached bytes must not resurrect the object.
+        writer.remove("cache/hot")
+        with pytest.raises(BtpuError) as excinfo:
+            reader.get("cache/hot")
+        assert excinfo.value.code == ErrorCode.OBJECT_NOT_FOUND
+
+        # get_many rides the cache too (the checkpoint load_sharded shape).
+        items = {f"cache/m{i}": np.random.default_rng(i).bytes(16 * 1024)
+                 for i in range(4)}
+        for key, val in items.items():
+            writer.put(key, val)
+        assert reader.get_many(list(items)) == list(items.values())
+        before = reader.cache_stats()["hits"]
+        assert reader.get_many(list(items)) == list(items.values())
+        assert reader.cache_stats()["hits"] >= before + 4
+
+
 def test_drain_worker_preserves_rf1_objects():
     """Graceful evacuation vs crash: a replicas=1 object on the drained
     worker survives (streamed off the live source) where kill_worker would

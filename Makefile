@@ -24,31 +24,80 @@ EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
 
 HDRS := $(shell find native/include native/src -name '*.h')
 
-.PHONY: all native examples clean tsan
+.PHONY: all native examples clean tsan asan lint check wire-golden
 all: native
 native: $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES)
 examples: $(EXAMPLES)
 
-# ThreadSanitizer leg: rebuilds the native suite under -fsanitize=thread into
-# its own tree (objects are ABI-incompatible with the normal build) and runs
-# the concurrency-heavy suites — the object cache (lookup/fill/invalidate
-# races are its whole job) plus transport. main.cpp already compiles in
-# exe/tsan_rma_suppression.h, which silences the MODELED one-sided-RMA race
-# of the LOCAL transport (reader racing a remote write is emulated hardware
-# behavior, discarded through epoch/CRC gates downstream).
-# One command: `make tsan` (or scripts/tsan.sh).
+# ---- sanitizer matrix (docs/CORRECTNESS.md) --------------------------------
+# Each sanitizer rebuilds into its own object tree (sanitized objects are
+# ABI-incompatible with the normal build) and runs the FULL native suite by
+# default. bb-soak is built in both trees so the soak harness can run
+# sanitized too. main.cpp compiles in exe/tsan_rma_suppression.h — the only
+# RACE suppression in the tree (the MODELED one-sided-RMA race of the LOCAL
+# transport: a reader racing a remote write is emulated hardware behavior,
+# discarded through epoch/CRC gates downstream) — plus
+# exe/tsan_clockwait_shim.h, an interceptor shim for gcc-10 libtsan's
+# missing pthread_cond_clockwait (see docs/CORRECTNESS.md).
+#
+#   make tsan                      # ThreadSanitizer, all suites + bb-soak build
+#   make asan                      # Address+UB(+Leak) sanitizers, all suites
+#   TSAN_FILTERS="Cache Transport" make tsan    # narrow to suites
 TSAN_BUILD := $(BUILD)/tsan
-TSAN_FILTERS ?= Cache Transport
-tsan:
-	$(MAKE) BUILD=$(TSAN_BUILD) \
+TSAN_FILTERS ?=
+# AddressSanitizer + UndefinedBehaviorSanitizer; LeakSanitizer rides along
+# with ASan on Linux. -fno-sanitize-recover turns every UB finding into a
+# hard failure instead of a log line.
+ASAN_BUILD := $(BUILD)/asan
+ASAN_FILTERS ?=
+
+# One protocol for every sanitizer leg: $(call sanitizer_run,name,builddir,
+# sanitize-flags,filters). Adding a suite/exe or changing the run loop
+# happens HERE, once.
+define sanitizer_run
+	$(MAKE) BUILD=$(2) \
 	  CXXFLAGS="-std=c++20 -O1 -g -fPIC -Wall -Wextra -Wno-unused-parameter \
-	            -Inative/include -pthread -fsanitize=thread" \
-	  LDFLAGS="-pthread -lrt -fsanitize=thread" \
-	  $(TSAN_BUILD)/libbtpu.so $(TSAN_BUILD)/btpu_tests
-	@set -e; for f in $(TSAN_FILTERS); do \
-	  echo "== tsan: $$f =="; \
-	  $(TSAN_BUILD)/btpu_tests --filter=$$f; \
-	done
+	            -Inative/include -pthread $(3)" \
+	  LDFLAGS="-pthread -lrt $(3)" \
+	  $(2)/libbtpu.so $(2)/btpu_tests $(2)/bb-soak
+	@set -e; if [ -z "$(strip $(4))" ]; then \
+	  echo "== $(1): all suites =="; \
+	  $(2)/btpu_tests; \
+	else \
+	  for f in $(4); do \
+	    echo "== $(1): $$f =="; \
+	    $(2)/btpu_tests --filter=$$f; \
+	  done; \
+	fi
+endef
+
+comma := ,
+tsan:
+	$(call sanitizer_run,tsan,$(TSAN_BUILD),-fsanitize=thread,$(TSAN_FILTERS))
+asan:
+	$(call sanitizer_run,asan,$(ASAN_BUILD),-fsanitize=address$(comma)undefined \
+	  -fno-sanitize-recover=all,$(ASAN_FILTERS))
+
+# ---- static gates ----------------------------------------------------------
+# clang -Wthread-safety sweep over every native source (the machine check
+# behind the GUARDED_BY/REQUIRES annotations) + python bytecode lint.
+# Degrades to a skip-with-notice when clang is not installed.
+lint:
+	scripts/lint.sh
+
+# Regenerate the wire-layout golden table (append-only changes ONLY — the
+# diff of wire_golden.txt is the wire-compat review).
+# Dump to a temp file and move into place only on success: a crashing
+# binary must not clobber the checked-in table.
+wire-golden: $(BUILD)/btpu_tests
+	$(BUILD)/btpu_tests --dump-wire-golden > native/tests/wire_golden.txt.tmp
+	mv native/tests/wire_golden.txt.tmp native/tests/wire_golden.txt
+	@echo "wrote native/tests/wire_golden.txt"
+
+# ---- the one-command correctness gate --------------------------------------
+# tier-1 pytest + lint + full native suite + asan + tsan. Every PR runs this.
+check:
+	scripts/check.sh
 
 $(BUILD)/obj/%.o: %.cpp $(HDRS)
 	@mkdir -p $(dir $@)

@@ -21,6 +21,7 @@
 #include <unordered_map>
 
 #include "btpu/client/embedded.h"
+#include "btpu/common/thread_annotations.h"
 #include "tsan_clockwait_shim.h"
 #include "tsan_rma_suppression.h"
 
@@ -42,21 +43,21 @@ std::vector<uint8_t> pattern_for(const std::string& key, uint64_t size) {
 }
 
 struct LiveSet {
-  std::mutex mutex;
-  std::unordered_map<std::string, uint64_t> sizes;  // key -> size
-  uint64_t bytes{0};
+  btpu::Mutex mutex;
+  std::unordered_map<std::string, uint64_t> sizes BTPU_GUARDED_BY(mutex);  // key -> size
+  uint64_t bytes BTPU_GUARDED_BY(mutex){0};
 
   void add(const std::string& key, uint64_t size) {
-    std::lock_guard<std::mutex> lock(mutex);
+    btpu::MutexLock lock(mutex);
     sizes[key] = size;
     bytes += size;
   }
   uint64_t total_bytes() {
-    std::lock_guard<std::mutex> lock(mutex);
+    btpu::MutexLock lock(mutex);
     return bytes;
   }
   bool take_random(std::mt19937_64& rng, std::string& key, uint64_t& size, bool erase) {
-    std::lock_guard<std::mutex> lock(mutex);
+    btpu::MutexLock lock(mutex);
     if (sizes.empty()) return false;
     auto it = sizes.begin();
     std::advance(it, std::uniform_int_distribution<size_t>(0, sizes.size() - 1)(rng));
@@ -69,11 +70,11 @@ struct LiveSet {
     return true;
   }
   size_t count() {
-    std::lock_guard<std::mutex> lock(mutex);
+    btpu::MutexLock lock(mutex);
     return sizes.size();
   }
   std::vector<std::pair<std::string, uint64_t>> snapshot() {
-    std::lock_guard<std::mutex> lock(mutex);
+    btpu::MutexLock lock(mutex);
     return {sizes.begin(), sizes.end()};
   }
 };

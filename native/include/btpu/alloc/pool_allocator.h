@@ -12,10 +12,10 @@
 #pragma once
 
 #include <map>
-#include <mutex>
 #include <optional>
 
 #include "btpu/alloc/allocator.h"
+#include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 
 namespace btpu::alloc {
@@ -67,12 +67,13 @@ class PoolAllocator {
   uint64_t pool_size_;
   uint64_t alignment_{0};  // 0/1 = unaligned
 
-  mutable std::mutex mutex_;
-  std::map<uint64_t, uint64_t> free_by_offset_;          // offset -> length
-  std::multimap<uint64_t, uint64_t> free_by_size_;       // length -> offset
+  mutable Mutex mutex_;
+  // offset -> length / length -> offset views of the free map.
+  std::map<uint64_t, uint64_t> free_by_offset_ BTPU_GUARDED_BY(mutex_);
+  std::multimap<uint64_t, uint64_t> free_by_size_ BTPU_GUARDED_BY(mutex_);
 
-  void insert_free(uint64_t offset, uint64_t length);
-  void erase_free(std::map<uint64_t, uint64_t>::iterator it);
+  void insert_free(uint64_t offset, uint64_t length) BTPU_REQUIRES(mutex_);
+  void erase_free(std::map<uint64_t, uint64_t>::iterator it) BTPU_REQUIRES(mutex_);
 };
 
 }  // namespace btpu::alloc

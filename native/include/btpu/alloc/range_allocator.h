@@ -19,10 +19,9 @@
 //   * forget_pool supports worker-death repair.
 #pragma once
 
-#include <shared_mutex>
-
 #include "btpu/alloc/allocator.h"
 #include "btpu/alloc/pool_allocator.h"
+#include "btpu/common/thread_annotations.h"
 
 namespace btpu::alloc {
 
@@ -52,15 +51,19 @@ class RangeAllocator : public IAllocator {
                           const Range& range) override;
 
  private:
-  mutable std::shared_mutex pools_mutex_;
-  std::unordered_map<MemoryPoolId, std::unique_ptr<PoolAllocator>> pool_allocators_;
+  mutable SharedMutex pools_mutex_;
+  std::unordered_map<MemoryPoolId, std::unique_ptr<PoolAllocator>> pool_allocators_
+      BTPU_GUARDED_BY(pools_mutex_);
 
   struct ObjectAllocation {
     std::vector<std::pair<MemoryPoolId, Range>> ranges;
     uint64_t total_size{0};
   };
-  mutable std::shared_mutex allocations_mutex_;
-  std::unordered_map<ObjectKey, ObjectAllocation> object_allocations_;
+  // Lock order: pools_mutex_ before allocations_mutex_ (free/adopt/release
+  // hoist a pool snapshot, then splice the allocation map).
+  mutable SharedMutex allocations_mutex_ BTPU_ACQUIRED_AFTER(pools_mutex_);
+  std::unordered_map<ObjectKey, ObjectAllocation> object_allocations_
+      BTPU_GUARDED_BY(allocations_mutex_);
 
   ErrorCode ensure_pool_allocator(const MemoryPool& pool);
   std::vector<MemoryPoolId> select_candidate_pools(const AllocationRequest& request,

@@ -43,6 +43,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 
 namespace btpu::cache {
@@ -176,22 +177,22 @@ class ObjectCache {
   };
   using EntryList = std::list<Entry>;
   struct Shard {
-    mutable std::mutex mutex;
+    mutable Mutex mutex;
     // Segmented LRU: first-time entries enter probation; a second hit
     // promotes to protected (capped at ~80% of the shard), which scan
     // traffic cannot flush. Eviction takes probation's tail first.
-    EntryList probation;   // front = most recent
-    EntryList protected_;  // front = most recent
-    std::unordered_map<ObjectKey, EntryList::iterator> index;
-    uint64_t bytes{0};
-    uint64_t protected_bytes{0};
+    EntryList probation BTPU_GUARDED_BY(mutex);   // front = most recent
+    EntryList protected_ BTPU_GUARDED_BY(mutex);  // front = most recent
+    std::unordered_map<ObjectKey, EntryList::iterator> index BTPU_GUARDED_BY(mutex);
+    uint64_t bytes BTPU_GUARDED_BY(mutex){0};
+    uint64_t protected_bytes BTPU_GUARDED_BY(mutex){0};
   };
 
   Shard& shard_for(const ObjectKey& key);
-  // Both run under the shard lock.
-  void promote_locked(Shard& s, EntryList::iterator it);
-  void evict_for_space_locked(Shard& s, uint64_t need);
-  void erase_locked(Shard& s, EntryList::iterator it);
+  // All three run under the shard lock.
+  void promote_locked(Shard& s, EntryList::iterator it) BTPU_REQUIRES(s.mutex);
+  void evict_for_space_locked(Shard& s, uint64_t need) BTPU_REQUIRES(s.mutex);
+  void erase_locked(Shard& s, EntryList::iterator it) BTPU_REQUIRES(s.mutex);
 
   uint64_t capacity_;
   uint64_t max_object_;

@@ -19,11 +19,11 @@
 #include <chrono>
 #include <functional>
 #include <memory>
-#include <mutex>
 #include <optional>
 #include <unordered_map>
 
 #include "btpu/cache/object_cache.h"
+#include "btpu/common/thread_annotations.h"
 #include "btpu/coord/coordinator.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/rpc/rpc_client.h"
@@ -332,8 +332,19 @@ class ObjectClient {
   static ErrorCode error_of(const Result<T>& r) noexcept {
     return r.ok() ? ErrorCode::OK : r.error();
   }
-  // Points rpc_ at the next configured keystone endpoint.
-  void rotate_keystone();
+  // Points rpc_ at the next configured keystone endpoint. Thread-safe:
+  // concurrent in-flight calls keep their snapshot of the OLD client alive
+  // (shared_ptr) while the swap installs the new one — reassigning the
+  // pointer unlocked was a use-after-free under concurrent failover
+  // (caught by the thread-safety annotations). `failed` is the snapshot the
+  // caller's call failed on: when a sibling thread already rotated past it,
+  // the rotation is skipped so N concurrent failures advance the endpoint
+  // index once, not N times (which would step past the live endpoint).
+  void rotate_keystone(const std::shared_ptr<rpc::KeystoneRpcClient>& failed = nullptr);
+  std::shared_ptr<rpc::KeystoneRpcClient> rpc_snapshot() const {
+    MutexLock lock(rpc_mutex_);
+    return rpc_;
+  }
   // Runs `fn(rpc client)`, rotating through the configured endpoints and
   // retrying once per endpoint. Always rotates on NOT_LEADER (the standby
   // provably did not execute) and CONNECTION_FAILED (the request was never
@@ -342,7 +353,8 @@ class ObjectClient {
   // `idempotent`: a mutation may have executed before the reply vanished.
   template <typename Fn>
   auto rpc_failover(bool idempotent, Fn&& fn) {
-    auto result = fn(*rpc_);
+    auto client = rpc_snapshot();
+    auto result = fn(*client);
     auto should_retry = [&](ErrorCode ec) {
       if (ec == ErrorCode::NOT_LEADER || ec == ErrorCode::CONNECTION_FAILED) return true;
       return idempotent &&
@@ -351,16 +363,19 @@ class ObjectClient {
     };
     const size_t endpoints = 1 + options_.keystone_fallbacks.size();
     for (size_t i = 0; i + 1 < endpoints && should_retry(error_of(result)); ++i) {
-      rotate_keystone();
-      result = fn(*rpc_);
+      rotate_keystone(client);
+      client = rpc_snapshot();
+      result = fn(*client);
     }
     return result;
   }
 
   ClientOptions options_;
   std::atomic<bool> verify_default_{true};  // seeded from options_.verify_reads
-  std::unique_ptr<rpc::KeystoneRpcClient> rpc_;
-  size_t keystone_index_{0};  // into [keystone_address] + keystone_fallbacks
+  mutable Mutex rpc_mutex_;
+  std::shared_ptr<rpc::KeystoneRpcClient> rpc_ BTPU_GUARDED_BY(rpc_mutex_);
+  // Into [keystone_address] + keystone_fallbacks.
+  size_t keystone_index_ BTPU_GUARDED_BY(rpc_mutex_){0};
   keystone::KeystoneService* embedded_{nullptr};
   std::unique_ptr<transport::TransportClient> data_;
 
@@ -368,8 +383,9 @@ class ObjectClient {
     std::vector<CopyPlacement> copies;
     std::chrono::steady_clock::time_point fetched_at;
   };
-  std::mutex placement_cache_mutex_;
-  std::unordered_map<ObjectKey, PlacementCacheEntry> placement_cache_;
+  Mutex placement_cache_mutex_;
+  std::unordered_map<ObjectKey, PlacementCacheEntry> placement_cache_
+      BTPU_GUARDED_BY(placement_cache_mutex_);
 
   // Object cache (shared_ptr: the invalidation watch callback holds a
   // weak_ptr, so a late event racing client destruction pins the cache
@@ -388,10 +404,12 @@ class ObjectClient {
     PutSlot slot;
     std::chrono::steady_clock::time_point granted_at;
   };
-  std::mutex slot_mutex_;
-  std::unordered_map<std::string, std::vector<PooledSlot>> slot_pool_;
+  Mutex slot_mutex_;
+  std::unordered_map<std::string, std::vector<PooledSlot>> slot_pool_
+      BTPU_GUARDED_BY(slot_mutex_);
   std::string slot_tag_;          // random per client session
-  bool slots_unsupported_{false};  // server predates the opcodes (guarded by slot_mutex_)
+  // Server predates the opcodes.
+  bool slots_unsupported_ BTPU_GUARDED_BY(slot_mutex_){false};
 
   // Inline tier (ClientOptions::inline_max_bytes): nullopt = not applicable
   // (disabled, oversized, EC, or the server refused recently) — the caller

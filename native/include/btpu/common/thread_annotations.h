@@ -1,0 +1,172 @@
+// Machine-checked lock discipline: Clang Thread Safety Analysis attributes
+// ("C/C++ Thread Safety Analysis", Hutchins et al., SCAM 2014) plus the
+// annotated mutex/guard types the rest of the native tree locks with.
+//
+// The repo grew ~30 mutexes and a hand-enforced `*_locked` naming convention
+// with nothing checking it. These macros turn the convention into a compile
+// error under `clang -Wthread-safety -Werror` (`make lint`); under gcc (which
+// has no equivalent analysis) every attribute expands to nothing and the
+// wrapper types compile down to the std primitives they hold, so the normal
+// build is unchanged.
+//
+// Usage pattern (see docs/CORRECTNESS.md for the full rules):
+//
+//   btpu::Mutex mutex_;
+//   int counter_ BTPU_GUARDED_BY(mutex_);
+//   void bump_locked() BTPU_REQUIRES(mutex_);   // caller must hold mutex_
+//   ...
+//   btpu::MutexLock lk(mutex_);   // scoped acquire, analysis-visible
+//
+// The std lock RAII types (std::lock_guard / std::unique_lock /
+// std::shared_lock) are NOT visible to the analysis — code under them reads
+// as "accessed without the guard". That is why the native tree locks through
+// btpu::MutexLock / btpu::SharedLock / btpu::WriterLock below instead; they
+// wrap the std types 1:1 (including defer/adopt, early unlock, relock, and
+// condition_variable_any waits) and only add the attributes.
+#pragma once
+
+#include <mutex>
+#include <shared_mutex>
+
+// clang exposes the analysis attributes through __has_attribute; gcc defines
+// neither, so everything collapses to no-ops there.
+#if defined(__clang__) && defined(__has_attribute)
+#define BTPU_TSA_HAS(x) __has_attribute(x)
+#else
+#define BTPU_TSA_HAS(x) 0
+#endif
+
+#if BTPU_TSA_HAS(capability)
+#define BTPU_TSA(x) __attribute__((x))
+#else
+#define BTPU_TSA(x)
+#endif
+
+// ---- declaration-site attributes ----------------------------------------
+// A type that protects other state (our Mutex/SharedMutex below).
+#define BTPU_CAPABILITY(x) BTPU_TSA(capability(x))
+// RAII type that acquires in its constructor and releases in its destructor.
+#define BTPU_SCOPED_CAPABILITY BTPU_TSA(scoped_lockable)
+// Field/variable may only be touched while holding the named capability.
+#define BTPU_GUARDED_BY(x) BTPU_TSA(guarded_by(x))
+// Pointer whose POINTEE is guarded (the pointer itself may be read freely).
+#define BTPU_PT_GUARDED_BY(x) BTPU_TSA(pt_guarded_by(x))
+// Static lock-order edges: this capability must be acquired before/after the
+// listed ones — the analysis then flags inverted acquisition orders.
+#define BTPU_ACQUIRED_BEFORE(...) BTPU_TSA(acquired_before(__VA_ARGS__))
+#define BTPU_ACQUIRED_AFTER(...) BTPU_TSA(acquired_after(__VA_ARGS__))
+
+// ---- function contracts --------------------------------------------------
+// Caller must already hold the capability (the `*_locked` helper contract).
+#define BTPU_REQUIRES(...) BTPU_TSA(requires_capability(__VA_ARGS__))
+#define BTPU_REQUIRES_SHARED(...) BTPU_TSA(requires_shared_capability(__VA_ARGS__))
+// Function acquires/releases the capability itself.
+#define BTPU_ACQUIRE(...) BTPU_TSA(acquire_capability(__VA_ARGS__))
+#define BTPU_ACQUIRE_SHARED(...) BTPU_TSA(acquire_shared_capability(__VA_ARGS__))
+#define BTPU_RELEASE(...) BTPU_TSA(release_capability(__VA_ARGS__))
+#define BTPU_RELEASE_SHARED(...) BTPU_TSA(release_shared_capability(__VA_ARGS__))
+// Destructor of a scoped capability that may hold either mode.
+#define BTPU_RELEASE_GENERIC(...) BTPU_TSA(release_generic_capability(__VA_ARGS__))
+#define BTPU_TRY_ACQUIRE(...) BTPU_TSA(try_acquire_capability(__VA_ARGS__))
+#define BTPU_TRY_ACQUIRE_SHARED(...) BTPU_TSA(try_acquire_shared_capability(__VA_ARGS__))
+// Caller must NOT hold the capability (deadlock documentation).
+#define BTPU_EXCLUDES(...) BTPU_TSA(locks_excluded(__VA_ARGS__))
+// Returns a reference to state guarded by the named capability.
+#define BTPU_RETURN_CAPABILITY(x) BTPU_TSA(lock_returned(x))
+// Escape hatch for locking the analysis cannot model (conditional
+// acquisition, locks handed across threads). Every use needs a comment.
+#define BTPU_NO_THREAD_SAFETY_ANALYSIS BTPU_TSA(no_thread_safety_analysis)
+
+namespace btpu {
+
+// std::mutex / std::shared_mutex carry no capability attribute under
+// libstdc++, so GUARDED_BY(a std::mutex member) is itself a -Wthread-safety
+// warning. These wrappers hold the std type, forward the Lockable surface
+// 1:1 (so std::unique_lock, std::condition_variable_any, std::scoped_lock
+// all still work on them), and add the attributes.
+class BTPU_CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+
+  void lock() BTPU_ACQUIRE() { m_.lock(); }
+  bool try_lock() BTPU_TRY_ACQUIRE(true) { return m_.try_lock(); }
+  void unlock() BTPU_RELEASE() { m_.unlock(); }
+
+ private:
+  std::mutex m_;
+};
+
+class BTPU_CAPABILITY("shared_mutex") SharedMutex {
+ public:
+  SharedMutex() = default;
+  SharedMutex(const SharedMutex&) = delete;
+  SharedMutex& operator=(const SharedMutex&) = delete;
+
+  void lock() BTPU_ACQUIRE() { m_.lock(); }
+  bool try_lock() BTPU_TRY_ACQUIRE(true) { return m_.try_lock(); }
+  void unlock() BTPU_RELEASE() { m_.unlock(); }
+  void lock_shared() BTPU_ACQUIRE_SHARED() { m_.lock_shared(); }
+  bool try_lock_shared() BTPU_TRY_ACQUIRE_SHARED(true) { return m_.try_lock_shared(); }
+  void unlock_shared() BTPU_RELEASE_SHARED() { m_.unlock_shared(); }
+
+ private:
+  std::shared_mutex m_;
+};
+
+// Exclusive scoped lock over Mutex or SharedMutex (writer side). Mirrors
+// std::unique_lock: constructed-locked by default, defer/adopt variants,
+// relockable (lock/unlock are analysis-visible), and BasicLockable so
+// condition_variable_any can wait on it (wait returns with the lock re-held,
+// which is a capability no-op — exactly what the analysis assumes for an
+// unannotated callee).
+template <typename M>
+class BTPU_SCOPED_CAPABILITY BasicMutexLock {
+ public:
+  explicit BasicMutexLock(M& m) BTPU_ACQUIRE(m) : lk_(m) {}
+  BasicMutexLock(M& m, std::defer_lock_t) BTPU_EXCLUDES(m) : lk_(m, std::defer_lock) {}
+  BasicMutexLock(M& m, std::adopt_lock_t) BTPU_REQUIRES(m) : lk_(m, std::adopt_lock) {}
+  // Try-acquire: the analysis models the conditional hold through a branch
+  // on the object itself (`if (!lock) return;` then guarded access is OK).
+  BasicMutexLock(M& m, std::try_to_lock_t) BTPU_TRY_ACQUIRE(true, m)
+      : lk_(m, std::try_to_lock) {}
+  ~BasicMutexLock() BTPU_RELEASE() = default;
+
+  BasicMutexLock(const BasicMutexLock&) = delete;
+  BasicMutexLock& operator=(const BasicMutexLock&) = delete;
+
+  void lock() BTPU_ACQUIRE() { lk_.lock(); }
+  bool try_lock() BTPU_TRY_ACQUIRE(true) { return lk_.try_lock(); }
+  void unlock() BTPU_RELEASE() { lk_.unlock(); }
+  bool owns_lock() const noexcept { return lk_.owns_lock(); }
+  explicit operator bool() const noexcept { return lk_.owns_lock(); }
+
+ private:
+  std::unique_lock<M> lk_;
+};
+
+using MutexLock = BasicMutexLock<Mutex>;
+using WriterLock = BasicMutexLock<SharedMutex>;
+
+// Reader-side scoped lock over SharedMutex (std::shared_lock semantics).
+class BTPU_SCOPED_CAPABILITY SharedLock {
+ public:
+  explicit SharedLock(SharedMutex& m) BTPU_ACQUIRE_SHARED(m) : lk_(m) {}
+  SharedLock(SharedMutex& m, std::defer_lock_t) BTPU_EXCLUDES(m) : lk_(m, std::defer_lock) {}
+  ~SharedLock() BTPU_RELEASE_GENERIC() = default;
+
+  SharedLock(const SharedLock&) = delete;
+  SharedLock& operator=(const SharedLock&) = delete;
+
+  void lock() BTPU_ACQUIRE_SHARED() { lk_.lock(); }
+  bool try_lock() BTPU_TRY_ACQUIRE_SHARED(true) { return lk_.try_lock(); }
+  void unlock() BTPU_RELEASE_GENERIC() { lk_.unlock(); }
+  bool owns_lock() const noexcept { return lk_.owns_lock(); }
+  explicit operator bool() const noexcept { return lk_.owns_lock(); }
+
+ private:
+  std::shared_lock<SharedMutex> lk_;
+};
+
+}  // namespace btpu

@@ -1,0 +1,76 @@
+// Compile-time wire-layout lint.
+//
+// The wire format (btpu/common/wire.h) is append-only: fields are encoded in
+// a fixed order with fixed widths, and cross-version compatibility (rolling
+// upgrades, durable coordinator records, PR-2's CopyPlacement cache stamps)
+// depends on nobody reordering fields, changing a scalar's width, or
+// widening an enum. Nothing enforced that rule until now; this header turns
+// the load-bearing widths into static_asserts, and the macros below freeze
+// the handful of RAW structs that cross a socket via memcpy (packed request
+// headers). The field-by-field encodings are frozen at runtime by the wire
+// golden table (native/tests/test_wire_layout.cpp + wire_golden.txt,
+// regenerate with `make wire-golden`).
+//
+// Included from native/src/common/types.cpp so every build of libbtpu.so
+// evaluates the asserts — a width change fails the build, not a code review.
+#pragma once
+
+#include <cstddef>
+#include <type_traits>
+
+#include "btpu/common/error.h"
+#include "btpu/common/types.h"
+#include "btpu/coord/coord_proto.h"
+#include "btpu/rpc/rpc.h"
+
+// A type whose bytes go on the wire raw (Writer::put / packed header
+// memcpy): must be trivially copyable AND padding-free, or the "layout" is
+// whatever the compiler invented this week.
+#define BTPU_WIRE_RAW_TYPE(T)                                                   \
+  static_assert(std::is_trivially_copyable_v<T>,                                \
+                "wire layout: " #T " must be trivially copyable");              \
+  static_assert(std::has_unique_object_representations_v<T>,                    \
+                "wire layout: " #T " has padding or non-unique representation")
+
+// Freeze a raw struct's size / a field's offset. The numbers are the wire
+// contract: changing one breaks decode on every peer that still runs the
+// old build. New fields go AFTER the last frozen offset (append-only).
+#define BTPU_WIRE_FROZEN_SIZEOF(T, n)                                           \
+  static_assert(sizeof(T) == (n),                                               \
+                "wire layout: sizeof(" #T ") changed — append-only rule broken")
+#define BTPU_WIRE_FROZEN_OFFSET(T, member, n)                                   \
+  static_assert(offsetof(T, member) == (n),                                     \
+                "wire layout: offsetof(" #T ", " #member                        \
+                ") moved — fields may only be appended")
+
+namespace btpu::wire_layout {
+
+// ---- scalar/enum widths every encoder relies on ---------------------------
+// Result<T>'s error arm, every *Response's error_code.
+static_assert(sizeof(ErrorCode) == 4, "wire: ErrorCode is u32 on the wire");
+static_assert(std::is_same_v<std::underlying_type_t<ErrorCode>, uint32_t>);
+// Pool/placement records (durable in the coordinator).
+static_assert(sizeof(StorageClass) == 4, "wire: StorageClass is u32");
+static_assert(sizeof(TransportKind) == 4, "wire: TransportKind is u32");
+// RPC + coordinator opcodes ride one frame byte.
+static_assert(sizeof(rpc::Method) == 1, "wire: rpc opcode is u8");
+static_assert(sizeof(coord::Op) == 1, "wire: coordinator opcode is u8");
+// Frame header: u8 opcode + u32 length (net::send_frame/recv_frame).
+static_assert(sizeof(uint32_t) == 4 && sizeof(uint8_t) == 1);
+// Scalars embedded in encoded structs.
+static_assert(sizeof(ViewVersionId) == 8 && sizeof(LeaseId) == 8 && sizeof(Version) == 8);
+static_assert(sizeof(double) == 8, "wire: ClusterStats.avg_utilization is f64");
+// TopoCoord members are encoded as i32 each.
+static_assert(sizeof(decltype(TopoCoord{}.slice_id)) == 4);
+
+// Raw-encoded scalar/enum types must be padding-free by construction; the
+// composite structs are NOT raw (they encode field-by-field), so nothing
+// here asserts sizeof(CopyPlacement) — that would freeze an ABI no peer
+// ever sees. The encoded form is frozen by the golden table instead.
+BTPU_WIRE_RAW_TYPE(ErrorCode);
+BTPU_WIRE_RAW_TYPE(StorageClass);
+BTPU_WIRE_RAW_TYPE(TransportKind);
+BTPU_WIRE_RAW_TYPE(coord::Op);
+BTPU_WIRE_RAW_TYPE(rpc::Method);
+
+}  // namespace btpu::wire_layout

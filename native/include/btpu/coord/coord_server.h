@@ -19,10 +19,10 @@
 #include <condition_variable>
 #include <deque>
 #include <memory>
-#include <mutex>
 #include <thread>
 #include <vector>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/coord/mem_coordinator.h"
 #include "btpu/net/net.h"
 
@@ -59,19 +59,20 @@ class CoordServer {
   std::atomic<bool> running_{false};
   std::atomic<bool> follower_{false};
 
-  std::mutex conns_mutex_;
-  std::vector<std::thread> conn_threads_;
-  std::vector<std::shared_ptr<net::Socket>> conns_;  // live sockets, for shutdown
+  Mutex conns_mutex_;
+  std::vector<std::thread> conn_threads_ BTPU_GUARDED_BY(conns_mutex_);
+  // Live sockets, for shutdown.
+  std::vector<std::shared_ptr<net::Socket>> conns_ BTPU_GUARDED_BY(conns_mutex_);
 
   // Replication fan-out: every mutation record lands here (from the store's
   // sink, under the store mutex — enqueue only); mirror connections stream
   // records with seq > their snapshot point. Bounded: a follower that lags
   // past the window is disconnected and re-syncs from a fresh snapshot.
   static constexpr size_t kReplBufferMax = 16384;
-  std::mutex repl_mutex_;
-  std::condition_variable repl_cv_;
-  std::deque<std::pair<uint64_t, std::vector<uint8_t>>> repl_buffer_;
-  size_t mirror_count_{0};  // guarded by repl_mutex_; buffer retained while > 0
+  Mutex repl_mutex_;
+  std::condition_variable_any repl_cv_;
+  std::deque<std::pair<uint64_t, std::vector<uint8_t>>> repl_buffer_ BTPU_GUARDED_BY(repl_mutex_);
+  size_t mirror_count_ BTPU_GUARDED_BY(repl_mutex_){0};  // buffer retained while > 0
 };
 
 // Standby engine: mirrors `primary_endpoint` into `server`'s store and
@@ -103,8 +104,9 @@ class CoordFollower {
   std::thread thread_;
   std::atomic<bool> stopping_{false};
   std::atomic<bool> promoted_{false};
-  std::mutex sock_mutex_;
-  net::Socket* live_sock_{nullptr};  // for stop() to shutdown a blocked recv
+  Mutex sock_mutex_;
+  // For stop() to shutdown a blocked recv.
+  net::Socket* live_sock_ BTPU_GUARDED_BY(sock_mutex_){nullptr};
 };
 
 }  // namespace btpu::coord

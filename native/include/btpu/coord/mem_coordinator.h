@@ -5,10 +5,10 @@
 #include <atomic>
 #include <condition_variable>
 #include <map>
-#include <mutex>
 #include <thread>
 #include <unordered_map>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/coord/coordinator.h"
 
 namespace btpu::coord {
@@ -114,56 +114,66 @@ class MemCoordinator : public Coordinator {
 
   void expiry_loop();
   // Collects matching callbacks under the lock, invokes them outside it.
-  void notify(WatchEvent::Type type, const std::string& key, const std::string& value);
-  ErrorCode del_locked(const std::string& key, std::unique_lock<std::mutex>& lock);
-  void promote_next_locked(const std::string& election, std::unique_lock<std::mutex>& lock);
+  void notify(WatchEvent::Type type, const std::string& key, const std::string& value)
+      BTPU_EXCLUDES(mutex_);
+  // del_locked / promote_next_locked / apply_record_locked take the caller's
+  // guard BY REFERENCE because they drop and re-take it around watch/leader
+  // callbacks (callbacks must run unlocked). The REQUIRES contract holds at
+  // both entry and exit; the interior dance is invisible to the analysis, so
+  // their DEFINITIONS carry BTPU_NO_THREAD_SAFETY_ANALYSIS.
+  ErrorCode del_locked(const std::string& key, MutexLock& lock) BTPU_REQUIRES(mutex_);
+  void promote_next_locked(const std::string& election, MutexLock& lock)
+      BTPU_REQUIRES(mutex_);
   // Mints the next fencing epoch for `election` (monotonic across restarts
   // and across all elections: journaled).
-  uint64_t mint_epoch_locked(const std::string& election);
+  uint64_t mint_epoch_locked(const std::string& election) BTPU_REQUIRES(mutex_);
   // OK iff `election` currently has a leader whose epoch == `epoch`.
-  ErrorCode check_fence_locked(const std::string& election, uint64_t epoch) const;
+  ErrorCode check_fence_locked(const std::string& election, uint64_t epoch) const
+      BTPU_REQUIRES(mutex_);
 
   // ---- durability (no-ops when durability_.dir is empty) ----
   void journal_load();                       // ctor only, before threads
-  void journal_append_locked(const std::vector<uint8_t>& record);
-  void journal_compact_locked();             // snapshot + truncate WAL
+  void journal_append_locked(const std::vector<uint8_t>& record) BTPU_REQUIRES(mutex_);
+  void journal_compact_locked() BTPU_REQUIRES(mutex_);  // snapshot + truncate WAL
   std::string snapshot_path() const;
   std::string wal_path() const;
   // Journal + replication sink, every mutation goes through here.
-  void log_locked(const std::vector<uint8_t>& record);
-  std::vector<uint8_t> snapshot_bytes_locked() const;
-  bool decode_snapshot_locked(const std::vector<uint8_t>& bytes);
+  void log_locked(const std::vector<uint8_t>& record) BTPU_REQUIRES(mutex_);
+  std::vector<uint8_t> snapshot_bytes_locked() const BTPU_REQUIRES(mutex_);
+  bool decode_snapshot_locked(const std::vector<uint8_t>& bytes) BTPU_REQUIRES(mutex_);
   // Applies one WAL-encoded record: shared by crash recovery (no journal fd
   // open yet, no watches registered) and live follower mirroring (journals
   // and notifies). Returns false on a malformed record.
-  bool apply_record_locked(const uint8_t* data, size_t len,
-                           std::unique_lock<std::mutex>& lock);
+  bool apply_record_locked(const uint8_t* data, size_t len, MutexLock& lock)
+      BTPU_REQUIRES(mutex_);
 
   DurabilityOptions durability_;
-  int wal_fd_{-1};
-  size_t wal_records_{0};
-  std::function<void(uint64_t, const std::vector<uint8_t>&)> repl_sink_;
-  uint64_t repl_seq_{0};
-  bool follower_{false};
+  int wal_fd_ BTPU_GUARDED_BY(mutex_){-1};
+  size_t wal_records_ BTPU_GUARDED_BY(mutex_){0};
+  std::function<void(uint64_t, const std::vector<uint8_t>&)> repl_sink_ BTPU_GUARDED_BY(mutex_);
+  uint64_t repl_seq_ BTPU_GUARDED_BY(mutex_){0};
+  bool follower_ BTPU_GUARDED_BY(mutex_){false};
 
-  mutable std::mutex mutex_;
-  std::map<std::string, Entry> data_;  // ordered: prefix scans are ranges
-  std::unordered_map<LeaseId, Lease> leases_;
-  std::vector<Watch> watches_;
-  std::map<std::string, Election> elections_;
+  mutable Mutex mutex_;
+  // Ordered: prefix scans are ranges.
+  std::map<std::string, Entry> data_ BTPU_GUARDED_BY(mutex_);
+  std::unordered_map<LeaseId, Lease> leases_ BTPU_GUARDED_BY(mutex_);
+  std::vector<Watch> watches_ BTPU_GUARDED_BY(mutex_);
+  std::map<std::string, Election> elections_ BTPU_GUARDED_BY(mutex_);
   // Fencing clock. max_epoch_ is the mint counter (global: tokens are
   // unique across elections); election_epochs_ remembers each election's
   // last minted epoch DURABLY, so the fence still judges correctly in the
   // window after a coordinator restart when elections_ (session state) is
   // empty but leaders still hold their tokens.
-  uint64_t max_epoch_{0};
-  std::map<std::string, uint64_t> election_epochs_;
+  uint64_t max_epoch_ BTPU_GUARDED_BY(mutex_){0};
+  std::map<std::string, uint64_t> election_epochs_ BTPU_GUARDED_BY(mutex_);
   std::atomic<LeaseId> next_lease_{1};
   std::atomic<WatchId> next_watch_{1};
 
   std::thread expiry_thread_;
-  std::condition_variable expiry_cv_;
-  bool stopping_{false};
+  // condition_variable_any: waits on the annotated MutexLock (BasicLockable).
+  std::condition_variable_any expiry_cv_;
+  bool stopping_ BTPU_GUARDED_BY(mutex_){false};
 };
 
 }  // namespace btpu::coord

@@ -4,11 +4,11 @@
 
 #include <atomic>
 #include <condition_variable>
-#include <mutex>
 #include <thread>
 #include <tuple>
 #include <unordered_map>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/coord/coordinator.h"
 #include "btpu/net/net.h"
 
@@ -88,7 +88,7 @@ class RemoteCoordinator : public Coordinator {
   // Tears down and redials unless another thread already reconnected since
   // `seen_generation`; replays watches + campaigns on success.
   ErrorCode reconnect(uint64_t seen_generation);
-  ErrorCode connect_locked();
+  ErrorCode connect_locked() BTPU_REQUIRES(reconnect_mutex_);
   // Sends the registration for one watch / one campaign (used live + replay).
   ErrorCode send_watch(int64_t id, const std::string& prefix);
   ErrorCode send_campaign(const std::string& election, const std::string& candidate,
@@ -97,40 +97,48 @@ class RemoteCoordinator : public Coordinator {
   // handling). Skipped when another thread already reconnected since
   // `seen_generation` (same guard as reconnect()). No-op single-endpoint.
   ErrorCode rotate_endpoint(uint64_t seen_generation);
-  const std::string& endpoint() const { return endpoints_[endpoint_index_]; }
+  const std::string& endpoint() const BTPU_REQUIRES(reconnect_mutex_) {
+    return endpoints_[endpoint_index_];
+  }
 
   std::vector<std::string> endpoints_;
-  size_t endpoint_index_{0};
+  size_t endpoint_index_ BTPU_GUARDED_BY(reconnect_mutex_){0};
   std::atomic<bool> connected_{false};
   std::atomic<bool> stopping_{false};
-  // Set by disconnect() (under reconnect_mutex_): auto-reconnect must never
-  // resurrect a connection the owner explicitly tore down.
-  bool terminated_{false};
+  // Set by disconnect(): auto-reconnect must never resurrect a connection
+  // the owner explicitly tore down.
+  bool terminated_ BTPU_GUARDED_BY(reconnect_mutex_){false};
 
-  std::mutex call_mutex_;
-  net::Socket call_sock_;
+  // Lock order (outermost first): reconnect_mutex_ -> call_mutex_ ->
+  // event_write_mutex_ -> resp_mutex_. watch_mutex_ is a leaf.
+  Mutex call_mutex_;
+  net::Socket call_sock_ BTPU_GUARDED_BY(call_mutex_);
 
-  std::mutex event_write_mutex_;
-  net::Socket event_sock_;
+  Mutex event_write_mutex_ BTPU_ACQUIRED_AFTER(call_mutex_);
+  net::Socket event_sock_;  // writes under event_write_mutex_; reader thread reads
   std::thread event_reader_;
 
   // Rendezvous for event-channel responses.
-  std::mutex resp_mutex_;
-  std::condition_variable resp_cv_;
-  bool resp_ready_{false};
-  bool reader_dead_{false};  // reader exited on connection loss: wake waiters
-  uint8_t resp_opcode_{0};
-  std::vector<uint8_t> resp_payload_;
+  Mutex resp_mutex_ BTPU_ACQUIRED_AFTER(event_write_mutex_);
+  std::condition_variable_any resp_cv_;
+  bool resp_ready_ BTPU_GUARDED_BY(resp_mutex_){false};
+  // Reader exited on connection loss: wake waiters.
+  bool reader_dead_ BTPU_GUARDED_BY(resp_mutex_){false};
+  uint8_t resp_opcode_ BTPU_GUARDED_BY(resp_mutex_){0};
+  std::vector<uint8_t> resp_payload_ BTPU_GUARDED_BY(resp_mutex_);
 
-  std::mutex watch_mutex_;
-  std::unordered_map<int64_t, WatchCallback> watch_cbs_;
-  std::unordered_map<int64_t, std::string> watch_prefixes_;  // for replay
-  std::unordered_map<std::string, CampaignCallback> leader_cbs_;  // election/candidate
+  Mutex watch_mutex_;
+  std::unordered_map<int64_t, WatchCallback> watch_cbs_ BTPU_GUARDED_BY(watch_mutex_);
+  // Prefixes kept for replay after reconnect.
+  std::unordered_map<int64_t, std::string> watch_prefixes_ BTPU_GUARDED_BY(watch_mutex_);
+  // election/candidate -> callback.
+  std::unordered_map<std::string, CampaignCallback> leader_cbs_ BTPU_GUARDED_BY(watch_mutex_);
   // election/candidate -> (election, candidate, lease ttl), for replay.
-  std::unordered_map<std::string, std::tuple<std::string, std::string, int64_t>> campaigns_;
+  std::unordered_map<std::string, std::tuple<std::string, std::string, int64_t>> campaigns_
+      BTPU_GUARDED_BY(watch_mutex_);
   std::atomic<int64_t> next_watch_{1};
 
-  std::mutex reconnect_mutex_;
+  Mutex reconnect_mutex_ BTPU_ACQUIRED_BEFORE(call_mutex_);
   std::atomic<uint64_t> generation_{0};  // bumped on every successful connect
   // The event reader's thread id: user callbacks run on that thread, and a
   // reconnect from inside one would self-join (deadlock) — such calls fail

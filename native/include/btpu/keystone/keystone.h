@@ -22,11 +22,11 @@
 #include <atomic>
 #include <condition_variable>
 #include <memory>
-#include <shared_mutex>
 #include <unordered_set>
 #include <thread>
 
 #include "btpu/alloc/keystone_adapter.h"
+#include "btpu/common/thread_annotations.h"
 #include "btpu/coord/coordinator.h"
 #include "btpu/transport/transport.h"
 
@@ -359,19 +359,28 @@ class KeystoneService {
   void evict_for_pressure();
   double tier_utilization(std::optional<StorageClass> cls) const;
 
-  ErrorCode free_object_locked(const ObjectKey& key, ObjectInfo& info);
+  ErrorCode free_object_locked(const ObjectKey& key, ObjectInfo& info)
+      BTPU_REQUIRES(objects_mutex_);
 
   KeystoneConfig config_;
   std::shared_ptr<coord::Coordinator> coordinator_;
   alloc::KeystoneAllocatorAdapter adapter_;
   std::unique_ptr<transport::TransportClient> data_client_;  // for repair
 
-  mutable std::shared_mutex objects_mutex_;
-  std::unordered_map<ObjectKey, ObjectInfo> objects_;
+  // Keystone lock order (outermost first; see docs/CORRECTNESS.md):
+  //   drain_mutex_ -> objects_mutex_ -> {registry_mutex_, readopt_checks_mutex_}
+  // registry_mutex_ and objects_mutex_ are normally taken in SEPARATE scopes
+  // (snapshot the registry, release, then splice objects); the one place
+  // they nest is the repair path, which consults offline_pools_ (registry,
+  // shared) while splicing placements (objects, exclusive) — so when nested,
+  // objects comes FIRST. The annotations let clang flag any new path that
+  // inverts this.
+  mutable SharedMutex objects_mutex_;
+  std::unordered_map<ObjectKey, ObjectInfo> objects_ BTPU_GUARDED_BY(objects_mutex_);
 
-  mutable std::shared_mutex registry_mutex_;
-  std::unordered_map<NodeId, WorkerInfo> workers_;
-  alloc::PoolMap pools_;
+  mutable SharedMutex registry_mutex_ BTPU_ACQUIRED_AFTER(objects_mutex_);
+  std::unordered_map<NodeId, WorkerInfo> workers_ BTPU_GUARDED_BY(registry_mutex_);
+  alloc::PoolMap pools_ BTPU_GUARDED_BY(registry_mutex_);
 
   std::atomic<ViewVersionId> view_version_{0};
   std::atomic<uint64_t> next_epoch_{1};  // feeds ObjectInfo::epoch
@@ -401,23 +410,23 @@ class KeystoneService {
   std::atomic<uint64_t> leader_epoch_{0};  // fencing token from promotion
   std::thread gc_thread_, health_thread_, keepalive_thread_, scrub_thread_;
   std::condition_variable_any stop_cv_;
-  std::mutex stop_mutex_;
+  Mutex stop_mutex_;
 
   std::vector<coord::WatchId> watch_ids_;
   KeystoneCounters counters_;
-  std::unordered_set<NodeId> draining_;  // guarded by registry_mutex_
+  std::unordered_set<NodeId> draining_ BTPU_GUARDED_BY(registry_mutex_);
   // Dead workers whose repair pass could not finish (coordinator outage or
   // deposition mid-pass): the health loop re-runs repair for them — the
   // death event itself fires only once per worker.
-  std::mutex repair_retry_mutex_;
-  std::unordered_set<NodeId> repair_retry_;
+  Mutex repair_retry_mutex_;
+  std::unordered_set<NodeId> repair_retry_ BTPU_GUARDED_BY(repair_retry_mutex_);
   // Objects whose in-memory state advanced but whose durable-record write
   // failed (coordinator outage, fence race): repair/demotion/drain splices
   // are irreversible in memory, so "fail closed" is not available to them —
   // instead the health loop re-persists these keys from current memory
   // until the record catches up (retry_dirty_persists).
-  std::mutex persist_retry_mutex_;
-  std::unordered_set<ObjectKey> persist_retry_;
+  Mutex persist_retry_mutex_;
+  std::unordered_set<ObjectKey> persist_retry_ BTPU_GUARDED_BY(persist_retry_mutex_);
   // Background scrub ring position (scrub thread only).
   ObjectKey scrub_cursor_;
   std::atomic<uint64_t> slot_seq_{0};  // unique suffix for pooled slot keys
@@ -428,12 +437,12 @@ class KeystoneService {
   // Live pooled slots (granted, not yet committed/cancelled/reclaimed):
   // keeps get_cluster_stats O(1) when excluding them from total_objects.
   std::atomic<int64_t> slot_objects_{0};
-  std::mutex drain_mutex_;               // serializes drain_worker per service
+  Mutex drain_mutex_;                    // serializes drain_worker per service
   std::string service_id_;
   // Persistent-tier pools of dead workers, as last advertised (old base +
   // rkey), awaiting re-adoption when the restarted worker re-registers them
   // (guarded by registry_mutex_). Consumed by readopt_offline_pool.
-  std::unordered_map<MemoryPoolId, MemoryPool> offline_pools_;
+  std::unordered_map<MemoryPoolId, MemoryPool> offline_pools_ BTPU_GUARDED_BY(registry_mutex_);
   // Re-adopted stamped shards pending CRC revalidation (run_readopt_checks).
   // Keyed by the shard's placement + stamped CRC, not the object epoch:
   // epochs move for unrelated reasons (a second pool adopting the same
@@ -449,18 +458,18 @@ class KeystoneService {
     // raced a pool bounce could condemn bytes the second adoption restored.
     uint64_t seq{0};
   };
-  std::mutex readopt_checks_mutex_;
-  std::vector<ReadoptCheck> readopt_checks_;
-  // Latest adoption sequence per pool (guarded by readopt_checks_mutex_;
-  // written under objects_mutex_ so checkers holding it see a stable value).
-  std::unordered_map<MemoryPoolId, uint64_t> readopt_seq_;
+  Mutex readopt_checks_mutex_ BTPU_ACQUIRED_AFTER(objects_mutex_);
+  std::vector<ReadoptCheck> readopt_checks_ BTPU_GUARDED_BY(readopt_checks_mutex_);
+  // Latest adoption sequence per pool (written while ALSO under
+  // objects_mutex_ so checkers holding either see a stable value).
+  std::unordered_map<MemoryPoolId, uint64_t> readopt_seq_ BTPU_GUARDED_BY(readopt_checks_mutex_);
   std::atomic<uint64_t> readopt_seq_counter_{0};
   // Objects whose bytes moved over the device fabric without the staged
   // lane's streaming CRC gate (stamps are carried, bytes unchecked). The
   // scrub verifies them on its next pass, ahead of the ring walk, healing
   // through the normal sibling/parity machinery.
-  std::mutex scrub_targets_mutex_;
-  std::unordered_set<ObjectKey> scrub_targets_;
+  Mutex scrub_targets_mutex_;
+  std::unordered_set<ObjectKey> scrub_targets_ BTPU_GUARDED_BY(scrub_targets_mutex_);
 };
 
 }  // namespace btpu::keystone

@@ -3,8 +3,8 @@
 #pragma once
 
 #include <atomic>
-#include <mutex>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 #include "btpu/net/net.h"
 
@@ -17,7 +17,12 @@ class KeystoneRpcClient {
 
   ErrorCode connect();
   void disconnect();
-  bool connected() const noexcept { return sock_.valid(); }
+  // Non-blocking try-lock probe: sock_ is closed/reassigned by concurrent
+  // calls, so the old unguarded valid() read was a data race (caught by the
+  // thread-safety annotations) — but destructor-path callers also must not
+  // park behind an in-flight call's connect timeout, so a busy client
+  // simply reports false.
+  bool connected() const;
 
   Result<bool> object_exists(const ObjectKey& key);
   Result<std::vector<CopyPlacement>> get_workers(const ObjectKey& key);
@@ -68,11 +73,11 @@ class KeystoneRpcClient {
   ErrorCode call(uint8_t opcode, const Req& req, Resp& resp);
   ErrorCode call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                      std::vector<uint8_t>& resp);
-  ErrorCode ensure_connected_locked();
+  ErrorCode ensure_connected_locked() BTPU_REQUIRES(mutex_);
 
   std::string endpoint_;
-  std::mutex mutex_;
-  net::Socket sock_;
+  mutable Mutex mutex_;
+  net::Socket sock_ BTPU_GUARDED_BY(mutex_);
   std::atomic<uint32_t> server_proto_version_{0};
 };
 

@@ -5,9 +5,9 @@
 
 #include <atomic>
 #include <memory>
-#include <mutex>
 #include <thread>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/net/net.h"
 #include "btpu/rpc/http_metrics.h"
@@ -35,9 +35,9 @@ class KeystoneRpcServer {
   net::Socket listener_;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
-  std::mutex conns_mutex_;
-  std::vector<std::thread> conn_threads_;
-  std::vector<std::shared_ptr<net::Socket>> conns_;
+  Mutex conns_mutex_;
+  std::vector<std::thread> conn_threads_ BTPU_GUARDED_BY(conns_mutex_);
+  std::vector<std::shared_ptr<net::Socket>> conns_ BTPU_GUARDED_BY(conns_mutex_);
 };
 
 // Bundled keystone + RPC + metrics, one call to boot a control plane

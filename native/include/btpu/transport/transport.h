@@ -27,6 +27,10 @@
 #include <functional>
 #include <memory>
 
+// The transport interfaces are stateless, but every implementation guards
+// registries/pools/staging with the annotated mutexes; pulling the
+// annotation macros in here keeps all transport TUs on one idiom.
+#include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 
 namespace btpu::transport {

@@ -18,6 +18,7 @@
 #include <memory>
 #include <thread>
 
+#include "btpu/common/thread_annotations.h"
 #include "btpu/coord/coordinator.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/storage/backend.h"
@@ -97,9 +98,11 @@ class WorkerService {
 
   std::atomic<bool> running_{false};
   std::thread heartbeat_thread_;
-  std::condition_variable stop_cv_;
-  std::mutex stop_mutex_;
-  bool initialized_{false};
+  // condition_variable_any: waits on the annotated Mutex (BasicLockable),
+  // which plain condition_variable cannot.
+  std::condition_variable_any stop_cv_;
+  Mutex stop_mutex_;
+  bool initialized_{false};  // initialize()/start() sequencing, caller thread only
 };
 
 }  // namespace btpu::worker

@@ -54,7 +54,7 @@ void PoolAllocator::erase_free(std::map<uint64_t, uint64_t>::iterator it) {
 
 std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit) {
   if (size == 0) return std::nullopt;
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
 
   // Alignment only pays off for shards of at least one aligned unit (e.g.
   // a whole HBM chunk): smaller shards are partial-chunk no matter where
@@ -103,7 +103,7 @@ std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit
 
 bool PoolAllocator::allocate_at(const Range& range) {
   if (range.length == 0 || range.end() > pool_size_) return false;
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   // Find the free block starting at or before range.offset.
   auto it = free_by_offset_.upper_bound(range.offset);
   if (it == free_by_offset_.begin()) return false;
@@ -120,7 +120,7 @@ bool PoolAllocator::allocate_at(const Range& range) {
 
 void PoolAllocator::free(const Range& range) {
   if (range.length == 0) return;
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
 
   uint64_t offset = range.offset;
   uint64_t length = range.length;
@@ -145,19 +145,19 @@ void PoolAllocator::free(const Range& range) {
 }
 
 uint64_t PoolAllocator::total_free() const {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   uint64_t total = 0;
   for (const auto& [off, len] : free_by_offset_) total += len;
   return total;
 }
 
 uint64_t PoolAllocator::largest_free_block() const {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return free_by_size_.empty() ? 0 : free_by_size_.rbegin()->first;
 }
 
 double PoolAllocator::fragmentation_ratio() const {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   uint64_t total = 0;
   for (const auto& [off, len] : free_by_offset_) total += len;
   if (total == 0) return 0.0;
@@ -167,7 +167,7 @@ double PoolAllocator::fragmentation_ratio() const {
 
 bool PoolAllocator::can_allocate(uint64_t size) const {
   if (size == 0) return false;
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   if (free_by_size_.empty() || free_by_size_.rbegin()->first < size) return false;
   if (alignment_ <= 1 || size < alignment_) return true;  // mirrors allocate()
   for (const auto& [off, len] : free_by_offset_) {
@@ -178,7 +178,7 @@ bool PoolAllocator::can_allocate(uint64_t size) const {
 }
 
 size_t PoolAllocator::free_range_count() const {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return free_by_offset_.size();
 }
 

@@ -50,7 +50,7 @@ std::vector<MemoryPoolId> interleave_nodes(const NodeGroups& g) {
 }  // namespace
 
 ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
-  std::unique_lock lock(pools_mutex_);
+  WriterLock lock(pools_mutex_);
   if (pool_allocators_.contains(pool.id)) return ErrorCode::OK;
   try {
     pool_allocators_[pool.id] = std::make_unique<PoolAllocator>(pool);
@@ -67,7 +67,7 @@ ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
 }
 
 uint64_t RangeAllocator::avail_of(const MemoryPoolId& id, const MemoryPool& pool) const {
-  std::shared_lock lock(pools_mutex_);
+  SharedLock lock(pools_mutex_);
   auto it = pool_allocators_.find(id);
   return it != pool_allocators_.end() ? it->second->total_free() : pool.available();
 }
@@ -252,7 +252,7 @@ Result<AllocationResult> RangeAllocator::allocate_ec(
     const MemoryPoolId& pool_id = ordered[i % ordered.size()];
     std::optional<Range> range;
     {
-      std::shared_lock lock(pools_mutex_);
+      SharedLock lock(pools_mutex_);
       auto it = pool_allocators_.find(pool_id);
       if (it == pool_allocators_.end()) {
         rollback_allocation(all_ranges);
@@ -357,7 +357,7 @@ Result<AllocationResult> RangeAllocator::allocate_with_striping(
 
         std::optional<Range> range;
         {
-          std::shared_lock lock(pools_mutex_);
+          SharedLock lock(pools_mutex_);
           auto it = pool_allocators_.find(pool_id);
           if (it == pool_allocators_.end()) {
             rollback_allocation(all_ranges);
@@ -414,7 +414,7 @@ Result<AllocationResult> RangeAllocator::allocate_with_striping(
     }
   }
   {
-    std::shared_lock lock(pools_mutex_);
+    SharedLock lock(pools_mutex_);
     double frag = 0.0;
     size_t counted = 0;
     for (const auto& id : candidates) {
@@ -437,7 +437,7 @@ Result<ShardPlacement> RangeAllocator::create_shard_placement(const MemoryPoolId
   if (pool_it == pools.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
   const MemoryPool& pool = pool_it->second;
 
-  std::shared_lock lock(pools_mutex_);
+  SharedLock lock(pools_mutex_);
   auto alloc_it = pool_allocators_.find(pool_id);
   if (alloc_it == pool_allocators_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
 
@@ -466,7 +466,7 @@ Result<ShardPlacement> RangeAllocator::create_shard_placement(const MemoryPoolId
 
 ErrorCode RangeAllocator::commit_allocation(
     const ObjectKey& key, const std::vector<std::pair<MemoryPoolId, Range>>& ranges) {
-  std::unique_lock lock(allocations_mutex_);
+  WriterLock lock(allocations_mutex_);
   if (object_allocations_.contains(key)) {
     LOG_WARN << "object " << key << " already has an allocation";
     return ErrorCode::OBJECT_ALREADY_EXISTS;
@@ -482,7 +482,7 @@ ErrorCode RangeAllocator::commit_allocation(
 
 void RangeAllocator::rollback_allocation(
     const std::vector<std::pair<MemoryPoolId, Range>>& ranges) {
-  std::shared_lock lock(pools_mutex_);
+  SharedLock lock(pools_mutex_);
   for (const auto& [pool_id, range] : ranges) {
     auto it = pool_allocators_.find(pool_id);
     if (it != pool_allocators_.end()) it->second->free(range);
@@ -500,7 +500,7 @@ ErrorCode RangeAllocator::adopt_allocation(
   }
   std::vector<std::pair<MemoryPoolId, Range>> carved;
   {
-    std::shared_lock lock(pools_mutex_);
+    SharedLock lock(pools_mutex_);
     for (const auto& [pool_id, range] : ranges) {
       auto it = pool_allocators_.find(pool_id);
       if (it == pool_allocators_.end() || !it->second->allocate_at(range)) {
@@ -522,7 +522,7 @@ ErrorCode RangeAllocator::adopt_allocation(
 }
 
 ErrorCode RangeAllocator::rename_object(const ObjectKey& from, const ObjectKey& to) {
-  std::unique_lock lock(allocations_mutex_);
+  WriterLock lock(allocations_mutex_);
   auto it = object_allocations_.find(from);
   if (it == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   if (object_allocations_.contains(to)) return ErrorCode::OBJECT_ALREADY_EXISTS;
@@ -532,7 +532,7 @@ ErrorCode RangeAllocator::rename_object(const ObjectKey& from, const ObjectKey& 
 }
 
 ErrorCode RangeAllocator::merge_objects(const ObjectKey& from, const ObjectKey& to) {
-  std::unique_lock lock(allocations_mutex_);
+  WriterLock lock(allocations_mutex_);
   auto src = object_allocations_.find(from);
   if (src == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   auto dst = object_allocations_.find(to);
@@ -548,8 +548,8 @@ ErrorCode RangeAllocator::merge_objects(const ObjectKey& from, const ObjectKey& 
 ErrorCode RangeAllocator::release_range(const ObjectKey& key, const MemoryPoolId& pool_id,
                                         const Range& range) {
   // Lock order: pools before allocations, matching free()/get_stats.
-  std::shared_lock pools_lock(pools_mutex_);
-  std::unique_lock lock(allocations_mutex_);
+  SharedLock pools_lock(pools_mutex_);
+  WriterLock lock(allocations_mutex_);
   auto it = object_allocations_.find(key);
   if (it == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   auto& ranges = it->second.ranges;
@@ -567,7 +567,7 @@ ErrorCode RangeAllocator::release_range(const ObjectKey& key, const MemoryPoolId
 }
 
 void RangeAllocator::remove_pool_ranges(const ObjectKey& key, const MemoryPoolId& pool_id) {
-  std::unique_lock lock(allocations_mutex_);
+  WriterLock lock(allocations_mutex_);
   auto it = object_allocations_.find(key);
   if (it == object_allocations_.end()) return;
   auto& ranges = it->second.ranges;
@@ -585,8 +585,8 @@ void RangeAllocator::remove_pool_ranges(const ObjectKey& key, const MemoryPoolId
 ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
   // Lock order: pools before allocations, matching get_stats (verified by
   // TSan: the reverse order forms a cycle with the stats path).
-  std::shared_lock pools_lock(pools_mutex_);
-  std::unique_lock lock(allocations_mutex_);
+  SharedLock pools_lock(pools_mutex_);
+  WriterLock lock(allocations_mutex_);
   auto it = object_allocations_.find(object_key);
   if (it == object_allocations_.end()) {
     LOG_DEBUG << "free of unknown object " << object_key;
@@ -603,8 +603,8 @@ ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
 }
 
 AllocatorStats RangeAllocator::get_stats(std::optional<StorageClass> storage_class) const {
-  std::shared_lock pools_lock(pools_mutex_);
-  std::shared_lock alloc_lock(allocations_mutex_);
+  SharedLock pools_lock(pools_mutex_);
+  SharedLock alloc_lock(allocations_mutex_);
 
   AllocatorStats stats{};
   for (const auto& [id, pa] : pool_allocators_) {
@@ -641,7 +641,7 @@ AllocatorStats RangeAllocator::get_stats(std::optional<StorageClass> storage_cla
 }
 
 uint64_t RangeAllocator::get_free_space(StorageClass storage_class) const {
-  std::shared_lock lock(pools_mutex_);
+  SharedLock lock(pools_mutex_);
   uint64_t total = 0;
   for (const auto& [id, pa] : pool_allocators_) {
     if (pa->storage_class() == storage_class) total += pa->total_free();
@@ -668,14 +668,14 @@ bool RangeAllocator::can_allocate(const AllocationRequest& request, const PoolMa
 }
 
 void RangeAllocator::forget_pool(const MemoryPoolId& pool_id) {
-  std::unique_lock lock(pools_mutex_);
+  WriterLock lock(pools_mutex_);
   pool_allocators_.erase(pool_id);
 }
 
 ErrorCode RangeAllocator::readopt_pool_ranges(const MemoryPool& pool,
                                               const std::vector<Range>& ranges) {
   BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
-  std::shared_lock lock(pools_mutex_);
+  SharedLock lock(pools_mutex_);
   auto it = pool_allocators_.find(pool.id);
   if (it == pool_allocators_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
   std::vector<Range> carved;

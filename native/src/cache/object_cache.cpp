@@ -97,7 +97,7 @@ ObjectCache::Hit ObjectCache::lookup(const ObjectKey& key) {
   Shard& s = shard_for(key);
   Hit hit;
   {
-    std::lock_guard<std::mutex> lock(s.mutex);
+    MutexLock lock(s.mutex);
     auto idx = s.index.find(key);
     if (idx == s.index.end()) {
       misses_.fetch_add(1, std::memory_order_relaxed);
@@ -128,7 +128,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
   Shard& s = shard_for(key);
   Hit hit;
   {
-    std::lock_guard<std::mutex> lock(s.mutex);
+    MutexLock lock(s.mutex);
     auto idx = s.index.find(key);
     if (idx == s.index.end()) {
       misses_.fetch_add(1, std::memory_order_relaxed);
@@ -161,7 +161,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
 ObjectCache::Hit ObjectCache::peek(const ObjectKey& key) const {
   auto& s = const_cast<ObjectCache*>(this)->shard_for(key);
   Hit hit;
-  std::lock_guard<std::mutex> lock(s.mutex);
+  MutexLock lock(s.mutex);
   auto idx = s.index.find(key);
   if (idx == s.index.end()) return hit;
   const auto it = idx->second;
@@ -182,7 +182,7 @@ void ObjectCache::fill(const ObjectKey& key, const ObjectVersion& version,
   if (!version.valid() || !bytes || bytes->empty() || bytes->size() > max_object_) return;
   Shard& s = shard_for(key);
   const auto deadline = lease_deadline;
-  std::lock_guard<std::mutex> lock(s.mutex);
+  MutexLock lock(s.mutex);
   auto idx = s.index.find(key);
   if (idx != s.index.end()) {
     auto it = idx->second;
@@ -203,7 +203,7 @@ void ObjectCache::fill(const ObjectKey& key, const ObjectVersion& version,
 void ObjectCache::renew(const ObjectKey& key, const ObjectVersion& version,
                         Clock::time_point lease_deadline) {
   Shard& s = shard_for(key);
-  std::lock_guard<std::mutex> lock(s.mutex);
+  MutexLock lock(s.mutex);
   auto idx = s.index.find(key);
   if (idx == s.index.end()) return;
   auto it = idx->second;
@@ -219,7 +219,7 @@ void ObjectCache::renew(const ObjectKey& key, const ObjectVersion& version,
 
 void ObjectCache::invalidate(const ObjectKey& key) {
   Shard& s = shard_for(key);
-  std::lock_guard<std::mutex> lock(s.mutex);
+  MutexLock lock(s.mutex);
   auto idx = s.index.find(key);
   if (idx == s.index.end()) return;
   erase_locked(s, idx->second);
@@ -229,7 +229,7 @@ void ObjectCache::invalidate(const ObjectKey& key) {
 
 void ObjectCache::invalidate_if_version(const ObjectKey& key, const ObjectVersion& version) {
   Shard& s = shard_for(key);
-  std::lock_guard<std::mutex> lock(s.mutex);
+  MutexLock lock(s.mutex);
   auto idx = s.index.find(key);
   if (idx == s.index.end() || !(idx->second->version == version)) return;
   erase_locked(s, idx->second);
@@ -239,7 +239,7 @@ void ObjectCache::invalidate_if_version(const ObjectKey& key, const ObjectVersio
 
 void ObjectCache::invalidate_all() {
   for (auto& sp : shards_) {
-    std::lock_guard<std::mutex> lock(sp->mutex);
+    MutexLock lock(sp->mutex);
     const uint64_t n = sp->index.size();
     sp->probation.clear();
     sp->protected_.clear();
@@ -253,7 +253,7 @@ void ObjectCache::invalidate_all() {
 void ObjectCache::expire_all_leases() {
   const auto past = Clock::now() - std::chrono::milliseconds(1);
   for (auto& sp : shards_) {
-    std::lock_guard<std::mutex> lock(sp->mutex);
+    MutexLock lock(sp->mutex);
     for (auto& e : sp->probation) e.lease_deadline = past;
     for (auto& e : sp->protected_) e.lease_deadline = past;
   }
@@ -269,7 +269,7 @@ CacheStats ObjectCache::stats() const {
   out.lease_expiries = lease_expiries_.load(std::memory_order_relaxed);
   out.evictions = evictions_.load(std::memory_order_relaxed);
   for (const auto& sp : shards_) {
-    std::lock_guard<std::mutex> lock(sp->mutex);
+    MutexLock lock(sp->mutex);
     out.bytes += sp->bytes;
     out.entries += sp->index.size();
   }

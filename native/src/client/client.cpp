@@ -50,7 +50,10 @@ ObjectClient::ObjectClient(ClientOptions options)
       verify_default_(options_.verify_reads),
       data_(transport::make_transport_client()),
       slot_tag_(random_slot_tag()) {
-  rpc_ = std::make_unique<rpc::KeystoneRpcClient>(options_.keystone_address);
+  {
+    MutexLock lock(rpc_mutex_);
+    rpc_ = std::make_shared<rpc::KeystoneRpcClient>(options_.keystone_address);
+  }
   setup_cache();
 }
 
@@ -69,26 +72,41 @@ ObjectClient::~ObjectClient() {
 
 ErrorCode ObjectClient::connect() {
   if (embedded_) return ErrorCode::OK;
-  auto ec = rpc_->connect();
+  auto snap = rpc_snapshot();
+  auto ec = snap->connect();
   // Initial connect participates in failover too: the configured primary
   // may already be a dead or standby keystone.
   const size_t endpoints = 1 + options_.keystone_fallbacks.size();
   for (size_t i = 0; i + 1 < endpoints && ec != ErrorCode::OK; ++i) {
-    rotate_keystone();
-    ec = rpc_->connect();
+    rotate_keystone(snap);
+    snap = rpc_snapshot();
+    ec = snap->connect();
   }
   return ec;
 }
 
-void ObjectClient::rotate_keystone() {
-  const size_t endpoints = 1 + options_.keystone_fallbacks.size();
-  keystone_index_ = (keystone_index_ + 1) % endpoints;
-  const std::string& address = keystone_index_ == 0
-                                   ? options_.keystone_address
+void ObjectClient::rotate_keystone(const std::shared_ptr<rpc::KeystoneRpcClient>& failed) {
+  // The decision and the swap are ONE critical section: N threads failing
+  // on the same dead keystone must produce one rotation, not N (each extra
+  // rotation steps the shared index past the live endpoint and burns a
+  // caller's only retry). A caller whose failed snapshot is no longer
+  // installed simply adopts the sibling's rotation. The dial is deferred:
+  // constructing KeystoneRpcClient is cheap, and call_raw connects lazily,
+  // so the lock is never held across a (possibly seconds-long) connect.
+  std::shared_ptr<rpc::KeystoneRpcClient> fresh;
+  std::string address;
+  {
+    MutexLock lock(rpc_mutex_);
+    if (failed && rpc_ != failed) return;  // a sibling already rotated past it
+    const size_t endpoints = 1 + options_.keystone_fallbacks.size();
+    keystone_index_ = (keystone_index_ + 1) % endpoints;
+    address = keystone_index_ == 0 ? options_.keystone_address
                                    : options_.keystone_fallbacks[keystone_index_ - 1];
+    fresh = std::make_shared<rpc::KeystoneRpcClient>(address);
+    rpc_ = fresh;
+  }
   LOG_WARN << "keystone failover: switching to " << address;
-  rpc_ = std::make_unique<rpc::KeystoneRpcClient>(address);
-  rpc_->connect();
+  fresh->connect();  // best-effort pre-dial; calls reconnect lazily anyway
 }
 
 Result<bool> ObjectClient::object_exists(const ObjectKey& key) {
@@ -108,7 +126,7 @@ Result<std::vector<CopyPlacement>> ObjectClient::get_workers_cached(const Object
   from_cache = false;
   if (options_.placement_cache_ms > 0 && !embedded_) {
     const auto now = std::chrono::steady_clock::now();
-    std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+    MutexLock lock(placement_cache_mutex_);
     auto it = placement_cache_.find(key);
     if (it != placement_cache_.end()) {
       if (now - it->second.fetched_at <=
@@ -132,7 +150,7 @@ void ObjectClient::cache_placements(const ObjectKey& key,
   for (const auto& copy : copies) {
     if (copy.content_crc == 0) return;
   }
-  std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+  MutexLock lock(placement_cache_mutex_);
   // Bounded: entries expire by TTL anyway, so a rare full reset under churn
   // beats per-access LRU bookkeeping on the hot read path.
   if (placement_cache_.size() >= 4096) placement_cache_.clear();
@@ -145,14 +163,14 @@ void ObjectClient::invalidate_placements(const ObjectKey& key) {
   // cache); cross-client mutations ride the watch/lease machinery.
   if (cache_) cache_->invalidate(key);
   if (options_.placement_cache_ms == 0 || embedded_) return;
-  std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+  MutexLock lock(placement_cache_mutex_);
   placement_cache_.erase(key);
 }
 
 void ObjectClient::invalidate_all_placements() {
   if (cache_) cache_->invalidate_all();
   if (options_.placement_cache_ms == 0 || embedded_) return;
-  std::lock_guard<std::mutex> lock(placement_cache_mutex_);
+  MutexLock lock(placement_cache_mutex_);
   placement_cache_.clear();
 }
 
@@ -1529,7 +1547,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
   auto slot_granted_at = std::chrono::steady_clock::now();
   std::vector<ObjectKey> expired;
   {
-    std::lock_guard<std::mutex> lock(slot_mutex_);
+    MutexLock lock(slot_mutex_);
     if (slots_unsupported_) return std::nullopt;
     auto& pool = slot_pool_[class_key];
     // Age gate: a slot the keystone may have reclaimed (slot TTL) must
@@ -1564,7 +1582,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
     if (!r.ok() || r.value().empty()) {
       if (r.error() == ErrorCode::NOT_IMPLEMENTED) {
         // Old server or slots disabled server-side: stop asking.
-        std::lock_guard<std::mutex> lock(slot_mutex_);
+        MutexLock lock(slot_mutex_);
         slots_unsupported_ = true;
       }
       return std::nullopt;  // the normal path reports the real outcome
@@ -1574,7 +1592,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
     slots.pop_back();
     if (!slots.empty()) {
       const auto now = std::chrono::steady_clock::now();
-      std::lock_guard<std::mutex> lock(slot_mutex_);
+      MutexLock lock(slot_mutex_);
       auto& pool = slot_pool_[class_key];
       for (auto& s : slots) pool.push_back({std::move(s), now});
     }
@@ -1633,7 +1651,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
   req.config = config;
   req.client_tag = slot_tag_;
   {
-    std::lock_guard<std::mutex> lock(slot_mutex_);
+    MutexLock lock(slot_mutex_);
     const size_t have = slot_pool_[class_key].size();
     req.refill_count =
         have < options_.put_slots ? static_cast<uint32_t>(options_.put_slots - have) : 0;
@@ -1646,7 +1664,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
     std::vector<ObjectKey> overflow;
     {
       const auto now = std::chrono::steady_clock::now();
-      std::lock_guard<std::mutex> lock(slot_mutex_);
+      MutexLock lock(slot_mutex_);
       auto& pool = slot_pool_[class_key];
       for (auto& s : refills) {
         // Overflow (a concurrent put of this class refilled first) is
@@ -1672,7 +1690,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
   // Duplicate key, fail-closed persist, etc.: the slot survives server-side
   // (commit rolled it back), so it can serve the next put of this class.
   {
-    std::lock_guard<std::mutex> lock(slot_mutex_);
+    MutexLock lock(slot_mutex_);
     slot_pool_[class_key].push_back({std::move(slot), slot_granted_at});
   }
   return ec;
@@ -1681,7 +1699,7 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
 void ObjectClient::cancel_pooled_slots() {
   std::vector<ObjectKey> keys;
   {
-    std::lock_guard<std::mutex> lock(slot_mutex_);
+    MutexLock lock(slot_mutex_);
     for (auto& [cls, pool] : slot_pool_) {
       for (auto& s : pool) keys.push_back(std::move(s.slot.slot_key));
     }
@@ -1689,8 +1707,10 @@ void ObjectClient::cancel_pooled_slots() {
   }
   // Only when already connected: the destructor must not pay a connect
   // timeout for a dead keystone — the slot TTL reclaims either way.
-  if (keys.empty() || embedded_ || !rpc_ || !rpc_->connected()) return;
-  rpc_->batch_put_cancel(keys);
+  std::shared_ptr<rpc::KeystoneRpcClient> rpc;
+  if (!embedded_) rpc = rpc_snapshot();
+  if (keys.empty() || !rpc || !rpc->connected()) return;
+  rpc->batch_put_cancel(keys);
 }
 
 std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,

@@ -1,5 +1,7 @@
 #include "btpu/common/crc32c.h"
 
+#include "btpu/common/thread_annotations.h"
+
 #include <algorithm>
 #include <array>
 #include <cstring>
@@ -315,12 +317,12 @@ uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b) {
   // steady state every lookup is a read. Reader-writer lock: N client
   // threads folding per-chunk CRCs share the hit path instead of convoying
   // on one mutex per fold.
-  static std::shared_mutex ops_mutex;
+  static SharedMutex ops_mutex;
   static std::unordered_map<uint64_t, std::array<uint32_t, 32>> ops;
   std::array<uint32_t, 32> op{};
   bool found = false;
   {
-    std::shared_lock<std::shared_mutex> lock(ops_mutex);
+    SharedLock lock(ops_mutex);
     if (auto it = ops.find(len_b); it != ops.end()) {
       op = it->second;
       found = true;
@@ -333,7 +335,7 @@ uint32_t crc32c_combine(uint32_t crc_a, uint32_t crc_b, uint64_t len_b) {
     std::array<uint32_t, 32> m{};
     for (int bit = 0; bit < 32; ++bit)
       m[static_cast<size_t>(bit)] = crc32c_shift(1u << bit, len_b);
-    std::unique_lock<std::shared_mutex> lock(ops_mutex);
+    WriterLock lock(ops_mutex);
     if (ops.size() >= 256) ops.clear();  // degenerate workloads only
     ops.emplace(len_b, m);
     op = m;

@@ -1,5 +1,7 @@
 #include "btpu/common/log.h"
 
+#include "btpu/common/thread_annotations.h"
+
 #include <cstdio>
 #include <ctime>
 
@@ -25,13 +27,13 @@ const char* basename_of(const char* path) {
 
 void emit(Level l, const char* file, int line, const std::string& msg) {
   using namespace std::chrono;
-  static std::mutex mu;
+  static Mutex mu;
   const auto now = system_clock::now();
   const auto t = system_clock::to_time_t(now);
   const auto us = duration_cast<microseconds>(now.time_since_epoch()).count() % 1000000;
   std::tm tm{};
   localtime_r(&t, &tm);
-  std::lock_guard<std::mutex> lock(mu);
+  MutexLock lock(mu);
   std::fprintf(stderr, "%s%02d%02d %02d:%02d:%02d.%06ld %s:%d] %s\n", level_tag(l),
                tm.tm_mon + 1, tm.tm_mday, tm.tm_hour, tm.tm_min, tm.tm_sec,
                static_cast<long>(us), basename_of(file), line, msg.c_str());

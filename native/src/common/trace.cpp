@@ -1,5 +1,7 @@
 #include "btpu/common/trace.h"
 
+#include "btpu/common/thread_annotations.h"
+
 #include <algorithm>
 #include <cstdio>
 #include <cstdlib>
@@ -33,9 +35,9 @@ struct SpanAccumulator {
 };
 
 struct Registry {
-  std::mutex mutex;
-  std::map<std::string, SpanAccumulator, std::less<>> spans;
-  FILE* jsonl{nullptr};
+  Mutex mutex;
+  std::map<std::string, SpanAccumulator, std::less<>> spans BTPU_GUARDED_BY(mutex);
+  FILE* jsonl BTPU_GUARDED_BY(mutex){nullptr};
 
   Registry() {
     if (const char* path = std::getenv("BTPU_TRACE")) {
@@ -60,7 +62,7 @@ double percentile_of(std::vector<double>& sorted, double p) {
 
 void record(std::string_view name, double duration_us) {
   auto& reg = Registry::instance();
-  std::lock_guard<std::mutex> lock(reg.mutex);
+  MutexLock lock(reg.mutex);
   auto it = reg.spans.find(name);
   if (it == reg.spans.end()) {
     it = reg.spans.emplace(std::string(name), SpanAccumulator{}).first;
@@ -74,7 +76,7 @@ void record(std::string_view name, double duration_us) {
 
 std::vector<SpanStats> summary() {
   auto& reg = Registry::instance();
-  std::lock_guard<std::mutex> lock(reg.mutex);
+  MutexLock lock(reg.mutex);
   std::vector<SpanStats> out;
   out.reserve(reg.spans.size());
   for (auto& [name, acc] : reg.spans) {
@@ -94,7 +96,7 @@ std::vector<SpanStats> summary() {
 
 void reset() {
   auto& reg = Registry::instance();
-  std::lock_guard<std::mutex> lock(reg.mutex);
+  MutexLock lock(reg.mutex);
   reg.spans.clear();
 }
 

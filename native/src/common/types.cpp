@@ -1,5 +1,8 @@
 #include "btpu/common/types.h"
 
+// Every libbtpu build evaluates the wire-layout static_asserts.
+#include "btpu/common/wire_layout_check.h"
+
 namespace btpu {
 
 std::string_view storage_class_name(StorageClass c) noexcept {

@@ -139,12 +139,12 @@ void MemCoordinator::log_locked(const std::vector<uint8_t>& record) {
 
 void MemCoordinator::set_replication_sink(
     std::function<void(uint64_t, const std::vector<uint8_t>&)> sink) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   repl_sink_ = std::move(sink);
 }
 
 std::pair<std::vector<uint8_t>, uint64_t> MemCoordinator::snapshot_with_seq() {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return {snapshot_bytes_locked(), repl_seq_};
 }
 
@@ -154,7 +154,7 @@ ErrorCode MemCoordinator::load_replica_snapshot(const std::vector<uint8_t>& byte
   // same events the live stream would have.
   std::vector<WatchEvent> events;
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     std::map<std::string, std::string> old_values;
     for (const auto& [key, entry] : data_) old_values.emplace(key, entry.value);
     data_.clear();
@@ -180,25 +180,25 @@ ErrorCode MemCoordinator::load_replica_snapshot(const std::vector<uint8_t>& byte
 }
 
 ErrorCode MemCoordinator::apply_replica_record(const std::vector<uint8_t>& record) {
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return apply_record_locked(record.data(), record.size(), lock)
              ? ErrorCode::OK
              : ErrorCode::DATA_CORRUPTION;
 }
 
 void MemCoordinator::set_follower(bool follower) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   follower_ = follower;
 }
 
 bool MemCoordinator::is_follower() const {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return follower_;
 }
 
 void MemCoordinator::promote() {
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     if (!follower_) return;
     follower_ = false;
     const auto now = Clock::now();
@@ -315,7 +315,7 @@ bool MemCoordinator::decode_snapshot_locked(const std::vector<uint8_t>& bytes) {
 }
 
 bool MemCoordinator::apply_record_locked(const uint8_t* bytes, size_t len,
-                                         std::unique_lock<std::mutex>& lock) {
+                                         MutexLock& lock) BTPU_NO_THREAD_SAFETY_ANALYSIS {
   wire::Reader r(bytes, len);
   uint8_t type = 0;
   if (!r.get(type)) return false;
@@ -399,7 +399,7 @@ void MemCoordinator::journal_load() {
   // Snapshot first. No lock needed (ctor, pre-thread) but apply_record_locked
   // wants one for its unlock-notify-relock dance (a no-op here: no watches,
   // no WAL fd, no sink yet).
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   {
     std::ifstream in(snapshot_path(), std::ios::binary);
     if (in) {
@@ -460,16 +460,18 @@ MemCoordinator::MemCoordinator(DurabilityOptions durability)
 
 MemCoordinator::~MemCoordinator() {
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     stopping_ = true;
   }
   expiry_cv_.notify_all();
   if (expiry_thread_.joinable()) expiry_thread_.join();
+  // Single-threaded from here, but the guard keeps the annotation honest.
+  MutexLock lock(mutex_);
   if (wal_fd_ >= 0) ::close(wal_fd_);
 }
 
 void MemCoordinator::expiry_loop() {
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   while (!stopping_) {
     expiry_cv_.wait_for(lock, std::chrono::milliseconds(20));
     if (stopping_) break;
@@ -514,7 +516,7 @@ void MemCoordinator::notify(WatchEvent::Type type, const std::string& key,
                             const std::string& value) {
   std::vector<WatchCallback> to_call;
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     for (const auto& w : watches_) {
       if (key.rfind(w.prefix, 0) == 0) to_call.push_back(w.cb);
     }
@@ -523,7 +525,10 @@ void MemCoordinator::notify(WatchEvent::Type type, const std::string& key,
   for (auto& cb : to_call) cb(ev);
 }
 
-ErrorCode MemCoordinator::del_locked(const std::string& key, std::unique_lock<std::mutex>& lock) {
+// Caller-owned guard dance (unlock around callbacks): contract checked at
+// call sites via REQUIRES; body excluded from the analysis.
+ErrorCode MemCoordinator::del_locked(const std::string& key, MutexLock& lock)
+    BTPU_NO_THREAD_SAFETY_ANALYSIS {
   auto it = data_.find(key);
   if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
   data_.erase(it);
@@ -542,7 +547,7 @@ ErrorCode MemCoordinator::del_locked(const std::string& key, std::unique_lock<st
 }
 
 Result<std::string> MemCoordinator::get(const std::string& key) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = data_.find(key);
   if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
   return it->second.value;
@@ -550,7 +555,7 @@ Result<std::string> MemCoordinator::get(const std::string& key) {
 
 ErrorCode MemCoordinator::put(const std::string& key, const std::string& value) {
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     data_[key] = Entry{value, 0};
     log_locked(rec_put(key, value, 0));
   }
@@ -568,7 +573,7 @@ ErrorCode MemCoordinator::put_with_ttl(const std::string& key, const std::string
 ErrorCode MemCoordinator::put_with_lease(const std::string& key, const std::string& value,
                                          LeaseId lease) {
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     auto it = leases_.find(lease);
     if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
     it->second.keys.push_back(key);
@@ -580,12 +585,12 @@ ErrorCode MemCoordinator::put_with_lease(const std::string& key, const std::stri
 }
 
 ErrorCode MemCoordinator::del(const std::string& key) {
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return del_locked(key, lock);
 }
 
 Result<std::vector<KeyValue>> MemCoordinator::get_with_prefix(const std::string& prefix) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   std::vector<KeyValue> out;
   for (auto it = data_.lower_bound(prefix); it != data_.end(); ++it) {
     if (it->first.rfind(prefix, 0) != 0) break;
@@ -596,7 +601,7 @@ Result<std::vector<KeyValue>> MemCoordinator::get_with_prefix(const std::string&
 
 Result<LeaseId> MemCoordinator::lease_grant(int64_t ttl_ms) {
   if (ttl_ms <= 0) return ErrorCode::INVALID_PARAMETERS;
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   LeaseId id = next_lease_++;
   leases_[id] = Lease{ttl_ms, Clock::now() + std::chrono::milliseconds(ttl_ms), {}};
   log_locked(rec_grant(id, ttl_ms));
@@ -604,7 +609,7 @@ Result<LeaseId> MemCoordinator::lease_grant(int64_t ttl_ms) {
 }
 
 ErrorCode MemCoordinator::lease_keepalive(LeaseId lease) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = leases_.find(lease);
   if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
   it->second.deadline = Clock::now() + std::chrono::milliseconds(it->second.ttl_ms);
@@ -612,7 +617,7 @@ ErrorCode MemCoordinator::lease_keepalive(LeaseId lease) {
 }
 
 ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = leases_.find(lease);
   if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
   auto keys = it->second.keys;
@@ -636,14 +641,14 @@ ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
 }
 
 Result<WatchId> MemCoordinator::watch_prefix(const std::string& prefix, WatchCallback cb) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   WatchId id = next_watch_++;
   watches_.push_back({id, prefix, std::move(cb)});
   return id;
 }
 
 ErrorCode MemCoordinator::unwatch(WatchId id) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = std::find_if(watches_.begin(), watches_.end(),
                          [id](const Watch& w) { return w.id == id; });
   if (it == watches_.end()) return ErrorCode::COORD_WATCH_ERROR;
@@ -688,7 +693,7 @@ ErrorCode MemCoordinator::check_fence_locked(const std::string& election,
 }
 
 void MemCoordinator::promote_next_locked(const std::string& election,
-                                         std::unique_lock<std::mutex>& lock) {
+                                         MutexLock& lock) BTPU_NO_THREAD_SAFETY_ANALYSIS {
   auto it = elections_.find(election);
   if (it == elections_.end() || it->second.candidates.empty()) return;
   it->second.epoch = mint_epoch_locked(election);
@@ -711,7 +716,7 @@ ErrorCode MemCoordinator::campaign(const std::string& election, const std::strin
   bool is_leader = false;
   uint64_t epoch = 0;
   {
-    std::unique_lock<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     auto& e = elections_[election];
     if (std::any_of(e.candidates.begin(), e.candidates.end(),
                     [&](const Candidate& c) { return c.id == candidate_id; }))
@@ -726,7 +731,7 @@ ErrorCode MemCoordinator::campaign(const std::string& election, const std::strin
 }
 
 ErrorCode MemCoordinator::resign(const std::string& election, const std::string& candidate_id) {
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = elections_.find(election);
   if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
   auto& candidates = it->second.candidates;
@@ -746,7 +751,7 @@ ErrorCode MemCoordinator::campaign_keepalive(const std::string& election,
                                              const std::string& candidate_id) {
   LeaseId lease = 0;
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     auto it = elections_.find(election);
     if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
     auto me = std::find_if(it->second.candidates.begin(), it->second.candidates.end(),
@@ -758,7 +763,7 @@ ErrorCode MemCoordinator::campaign_keepalive(const std::string& election,
 }
 
 Result<std::string> MemCoordinator::current_leader(const std::string& election) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = elections_.find(election);
   if (it == elections_.end() || it->second.candidates.empty())
     return ErrorCode::COORD_KEY_NOT_FOUND;
@@ -766,7 +771,7 @@ Result<std::string> MemCoordinator::current_leader(const std::string& election) 
 }
 
 Result<uint64_t> MemCoordinator::election_epoch(const std::string& election) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   auto it = elections_.find(election);
   if (it == elections_.end() || it->second.candidates.empty())
     return ErrorCode::COORD_KEY_NOT_FOUND;
@@ -776,7 +781,7 @@ Result<uint64_t> MemCoordinator::election_epoch(const std::string& election) {
 ErrorCode MemCoordinator::put_fenced(const std::string& key, const std::string& value,
                                      const std::string& election, uint64_t epoch) {
   {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     if (auto ec = check_fence_locked(election, epoch); ec != ErrorCode::OK) return ec;
     data_[key] = Entry{value, 0};
     log_locked(rec_put(key, value, 0));
@@ -787,7 +792,7 @@ ErrorCode MemCoordinator::put_fenced(const std::string& key, const std::string& 
 
 ErrorCode MemCoordinator::del_fenced(const std::string& key, const std::string& election,
                                      uint64_t epoch) {
-  std::unique_lock<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   if (auto ec = check_fence_locked(election, epoch); ec != ErrorCode::OK) return ec;
   return del_locked(key, lock);
 }

@@ -52,7 +52,7 @@ RemoteCoordinator::RemoteCoordinator(std::string endpoint) {
 RemoteCoordinator::~RemoteCoordinator() { disconnect(); }
 
 ErrorCode RemoteCoordinator::connect() {
-  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  MutexLock lock(reconnect_mutex_);
   terminated_ = false;  // an explicit connect() revives a disconnected client
   return connect_locked();
 }
@@ -80,7 +80,7 @@ ErrorCode RemoteCoordinator::connect_locked() {
   if (!dialed) return dial_ec;
   stopping_ = false;
   {
-    std::lock_guard<std::mutex> rlock(resp_mutex_);
+    MutexLock rlock(resp_mutex_);
     reader_dead_ = false;
   }
   connected_ = true;
@@ -97,7 +97,7 @@ ErrorCode RemoteCoordinator::connect_locked() {
   std::vector<std::pair<int64_t, std::string>> watches;
   std::vector<std::tuple<std::string, std::string, int64_t>> campaigns;
   {
-    std::lock_guard<std::mutex> wlock(watch_mutex_);
+    MutexLock wlock(watch_mutex_);
     for (const auto& [id, prefix] : watch_prefixes_) watches.emplace_back(id, prefix);
     for (const auto& [key, meta] : campaigns_) campaigns.push_back(meta);
   }
@@ -117,7 +117,7 @@ void RemoteCoordinator::disconnect() {
   // Serialize against auto-reconnect: taking reconnect_mutex_ waits out any
   // in-flight redial, and terminated_ stops later ones from resurrecting
   // the connection after we tear it down.
-  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  MutexLock lock(reconnect_mutex_);
   terminated_ = true;
   stopping_ = true;
   connected_ = false;
@@ -140,7 +140,7 @@ ErrorCode RemoteCoordinator::reconnect(uint64_t seen_generation) {
   // any other thread redials.
   if (std::this_thread::get_id() == reader_thread_id_.load())
     return ErrorCode::CONNECTION_FAILED;
-  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  MutexLock lock(reconnect_mutex_);
   if (terminated_) return ErrorCode::CLIENT_DISCONNECTED;
   if (generation_.load() != seen_generation) {
     // Another thread already reconnected since the failure was observed.
@@ -156,7 +156,8 @@ ErrorCode RemoteCoordinator::reconnect(uint64_t seen_generation) {
   call_sock_.shutdown();
   event_sock_.shutdown();
   {
-    std::scoped_lock<std::mutex, std::mutex> drain(call_mutex_, event_write_mutex_);
+    MutexLock drain_call(call_mutex_);
+    MutexLock drain_event(event_write_mutex_);
   }
   if (event_reader_.joinable()) event_reader_.join();
   call_sock_.close();
@@ -169,7 +170,7 @@ ErrorCode RemoteCoordinator::rotate_endpoint(uint64_t seen_generation) {
   if (endpoints_.size() < 2) return ErrorCode::NOT_LEADER;
   if (std::this_thread::get_id() == reader_thread_id_.load())
     return ErrorCode::NOT_LEADER;  // see reconnect(): never from the reader
-  std::lock_guard<std::mutex> lock(reconnect_mutex_);
+  MutexLock lock(reconnect_mutex_);
   if (terminated_) return ErrorCode::CLIENT_DISCONNECTED;
   if (generation_.load() != seen_generation) {
     // Another thread already rotated/reconnected since this NOT_LEADER was
@@ -183,7 +184,8 @@ ErrorCode RemoteCoordinator::rotate_endpoint(uint64_t seen_generation) {
   call_sock_.shutdown();
   event_sock_.shutdown();
   {
-    std::scoped_lock<std::mutex, std::mutex> drain(call_mutex_, event_write_mutex_);
+    MutexLock drain_call(call_mutex_);
+    MutexLock drain_event(event_write_mutex_);
   }
   if (event_reader_.joinable()) event_reader_.join();
   call_sock_.close();
@@ -210,7 +212,7 @@ ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& re
   auto attempt = [&]() -> ErrorCode {
     attempt_gen = generation_.load();
     if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
-    std::lock_guard<std::mutex> lock(call_mutex_);
+    MutexLock lock(call_mutex_);
     BTPU_RETURN_IF_ERROR(net::send_frame(call_sock_.fd(), opcode, req.data(), req.size()));
     uint8_t resp_op = 0;
     BTPU_RETURN_IF_ERROR(net::recv_frame(call_sock_.fd(), resp_op, resp));
@@ -239,16 +241,23 @@ ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& re
 ErrorCode RemoteCoordinator::event_call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                             std::vector<uint8_t>& resp) {
   if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
-  std::unique_lock<std::mutex> lock(event_write_mutex_);
+  MutexLock lock(event_write_mutex_);
   {
-    std::lock_guard<std::mutex> rlock(resp_mutex_);
+    MutexLock rlock(resp_mutex_);
     resp_ready_ = false;
   }
   BTPU_RETURN_IF_ERROR(net::send_frame(event_sock_.fd(), opcode, req.data(), req.size()));
-  std::unique_lock<std::mutex> rlock(resp_mutex_);
-  if (!resp_cv_.wait_for(rlock, std::chrono::seconds(10),
-                         [this] { return resp_ready_ || reader_dead_; }))
-    return ErrorCode::OPERATION_TIMEOUT;
+  MutexLock rlock(resp_mutex_);
+  // Explicit deadline loop instead of the predicate overload: a predicate
+  // lambda is analyzed as an unannotated function and would flag the
+  // guarded resp_ready_/reader_dead_ reads; this body is checked with
+  // resp_mutex_ held.
+  const auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  while (!resp_ready_ && !reader_dead_) {
+    if (resp_cv_.wait_until(rlock, deadline) == std::cv_status::timeout &&
+        !resp_ready_ && !reader_dead_)
+      return ErrorCode::OPERATION_TIMEOUT;
+  }
   if (!resp_ready_) return ErrorCode::CLIENT_DISCONNECTED;  // reader died
   if (resp_opcode_ != opcode) return ErrorCode::RPC_FAILED;
   resp = std::move(resp_payload_);
@@ -329,7 +338,7 @@ void RemoteCoordinator::event_reader_loop() {
       // its full timeout (leadership keepalives are TTL-sensitive).
       if (!stopping_) connected_ = false;
       {
-        std::lock_guard<std::mutex> rlock(resp_mutex_);
+        MutexLock rlock(resp_mutex_);
         reader_dead_ = true;
       }
       resp_cv_.notify_all();
@@ -345,7 +354,7 @@ void RemoteCoordinator::event_reader_loop() {
         continue;
       WatchCallback cb;
       {
-        std::lock_guard<std::mutex> lock(watch_mutex_);
+        MutexLock lock(watch_mutex_);
         auto it = watch_cbs_.find(watch_id);
         if (it != watch_cbs_.end()) cb = it->second;
       }
@@ -365,14 +374,14 @@ void RemoteCoordinator::event_reader_loop() {
       if (!wire::decode_fields_tail(r, epoch)) continue;
       CampaignCallback cb;
       {
-        std::lock_guard<std::mutex> lock(watch_mutex_);
+        MutexLock lock(watch_mutex_);
         auto it = leader_cbs_.find(election + "/" + candidate);
         if (it != leader_cbs_.end()) cb = it->second;
       }
       if (cb) cb(is_leader, epoch);
     } else {
       // Response to an event-channel request.
-      std::lock_guard<std::mutex> lock(resp_mutex_);
+      MutexLock lock(resp_mutex_);
       resp_opcode_ = opcode;
       resp_payload_ = std::move(payload);
       resp_ready_ = true;
@@ -495,7 +504,7 @@ ErrorCode RemoteCoordinator::put_with_lease(const std::string& key, const std::s
 Result<WatchId> RemoteCoordinator::watch_prefix(const std::string& prefix, WatchCallback cb) {
   const int64_t id = next_watch_++;
   {
-    std::lock_guard<std::mutex> lock(watch_mutex_);
+    MutexLock lock(watch_mutex_);
     watch_cbs_[id] = std::move(cb);
     watch_prefixes_[id] = prefix;  // recorded first: a mid-call reconnect replays it
   }
@@ -506,7 +515,7 @@ Result<WatchId> RemoteCoordinator::watch_prefix(const std::string& prefix, Watch
     ec = reconnect(gen);
   }
   if (ec != ErrorCode::OK) {
-    std::lock_guard<std::mutex> lock(watch_mutex_);
+    MutexLock lock(watch_mutex_);
     watch_cbs_.erase(id);
     watch_prefixes_.erase(id);
     return ec;
@@ -523,7 +532,7 @@ ErrorCode RemoteCoordinator::unwatch(WatchId id) {
     Reader r(resp);
     ec = take_status(r);
   }
-  std::lock_guard<std::mutex> lock(watch_mutex_);
+  MutexLock lock(watch_mutex_);
   watch_cbs_.erase(id);
   watch_prefixes_.erase(id);
   return ec;
@@ -550,7 +559,7 @@ ErrorCode RemoteCoordinator::campaign(const std::string& election,
                                       CampaignCallback cb) {
   const std::string key = election + "/" + candidate_id;
   {
-    std::lock_guard<std::mutex> lock(watch_mutex_);
+    MutexLock lock(watch_mutex_);
     leader_cbs_[key] = std::move(cb);
     campaigns_[key] = {election, candidate_id, lease_ttl_ms};
   }
@@ -569,7 +578,7 @@ ErrorCode RemoteCoordinator::campaign(const std::string& election,
     ec = send_campaign(election, candidate_id, lease_ttl_ms);
   }
   if (ec != ErrorCode::OK) {
-    std::lock_guard<std::mutex> lock(watch_mutex_);
+    MutexLock lock(watch_mutex_);
     leader_cbs_.erase(key);
     campaigns_.erase(key);
   }
@@ -586,7 +595,7 @@ ErrorCode RemoteCoordinator::resign(const std::string& election,
     Reader r(resp);
     ec = take_status(r);
   }
-  std::lock_guard<std::mutex> lock(watch_mutex_);
+  MutexLock lock(watch_mutex_);
   leader_cbs_.erase(election + "/" + candidate_id);
   campaigns_.erase(election + "/" + candidate_id);
   return ec;

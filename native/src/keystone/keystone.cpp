@@ -181,7 +181,7 @@ bool KeystoneService::on_promoted() {
   // that would otherwise conflict with re-applying valid records below.
   std::vector<ObjectKey> stale;
   {
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     for (const auto& [key, info] : objects_) {
       if (!persisted.contains(key)) stale.push_back(key);
     }
@@ -190,7 +190,7 @@ bool KeystoneService::on_promoted() {
 
   alloc::PoolMap pools_snapshot;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     pools_snapshot = pools_;
   }
   size_t applied = 0;
@@ -226,11 +226,11 @@ void KeystoneService::on_demoted() {
   // leader owns the durable records now, and replaying a stale entry after
   // re-promotion could unpersist a record the reconcile intentionally kept.
   {
-    std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+    MutexLock lock(persist_retry_mutex_);
     persist_retry_.clear();
   }
   size_t dropped = 0;
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   for (auto it = objects_.begin(); it != objects_.end();) {
     if (it->second.state == ObjectState::kPending) {
       if (it->second.slot) slot_objects_.fetch_sub(1);
@@ -281,7 +281,7 @@ void KeystoneService::stop() {
 // ---- threads --------------------------------------------------------------
 
 void KeystoneService::gc_loop() {
-  std::unique_lock<std::mutex> lock(stop_mutex_);
+  MutexLock lock(stop_mutex_);
   while (running_) {
     stop_cv_.wait_for(lock, std::chrono::seconds(config_.gc_interval_sec),
                       [this] { return !running_.load(); });
@@ -293,7 +293,7 @@ void KeystoneService::gc_loop() {
 }
 
 void KeystoneService::health_loop() {
-  std::unique_lock<std::mutex> lock(stop_mutex_);
+  MutexLock lock(stop_mutex_);
   while (running_) {
     stop_cv_.wait_for(lock, std::chrono::seconds(config_.health_check_interval_sec),
                       [this] { return !running_.load(); });
@@ -305,7 +305,7 @@ void KeystoneService::health_loop() {
 }
 
 void KeystoneService::keepalive_loop() {
-  std::unique_lock<std::mutex> lock(stop_mutex_);
+  MutexLock lock(stop_mutex_);
   while (running_) {
     stop_cv_.wait_for(lock, std::chrono::seconds(config_.service_refresh_interval_sec),
                       [this] { return !running_.load() || recampaign_asap_.load(); });
@@ -379,13 +379,13 @@ void KeystoneService::run_gc_once() {
   };
   std::vector<ObjectKey> expired;
   {
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     for (const auto& [key, info] : objects_) {
       if (info.expired(now) || pending_stale(info, now)) expired.push_back(key);
     }
   }
   for (const auto& key : expired) {
-    std::unique_lock lock(objects_mutex_);
+    WriterLock lock(objects_mutex_);
     auto it = objects_.find(key);
     if (it == objects_.end()) continue;
     const auto recheck = std::chrono::steady_clock::now();
@@ -422,7 +422,7 @@ void KeystoneService::run_health_check_once() {
     // short (see repair_retry_): the death event only fires once.
     std::vector<NodeId> retry;
     {
-      std::lock_guard<std::mutex> lock(repair_retry_mutex_);
+      MutexLock lock(repair_retry_mutex_);
       retry.assign(repair_retry_.begin(), repair_retry_.end());
     }
     for (const auto& id : retry) {
@@ -438,7 +438,7 @@ void KeystoneService::run_health_check_once() {
 // ---- object API -----------------------------------------------------------
 
 Result<bool> KeystoneService::object_exists(const ObjectKey& key) {
-  std::shared_lock lock(objects_mutex_);
+  SharedLock lock(objects_mutex_);
   return objects_.contains(key);
 }
 
@@ -452,7 +452,7 @@ Result<std::vector<ObjectSummary>> KeystoneService::list_objects(const std::stri
   };
   std::vector<ObjectSummary> out;
   {
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     for (const auto& [key, info] : objects_) {
       if (info.state != ObjectState::kComplete) continue;
       if (key.compare(0, prefix.size(), prefix) != 0) continue;
@@ -471,7 +471,7 @@ Result<std::vector<ObjectSummary>> KeystoneService::list_objects(const std::stri
 }
 
 Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey& key) {
-  std::unique_lock lock(objects_mutex_);  // touch mutates last_access
+  WriterLock lock(objects_mutex_);  // touch mutates last_access
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   it->second.last_access = std::chrono::steady_clock::now();
@@ -492,7 +492,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey&
 
 std::pair<uint64_t, uint64_t> KeystoneService::object_cache_version(
     const ObjectKey& key) const {
-  std::shared_lock lock(objects_mutex_);
+  SharedLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end() || it->second.state != ObjectState::kComplete) return {0, 0};
   return {cache_gen_, it->second.epoch};
@@ -540,7 +540,7 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   if (auto ec = normalize_put_config(effective); ec != ErrorCode::OK) return ec;
 
   TRACE_SPAN("keystone.put_start");
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
 
   const alloc::PoolMap pools_snapshot = allocatable_pools_snapshot();
@@ -571,7 +571,7 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
                                         const std::vector<CopyShardCrcs>& shard_crcs,
                                         uint32_t content_crc) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   for (const auto& sc : shard_crcs) {
@@ -600,7 +600,7 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
 
 ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   // Deletes fence FIRST: destroying worker ranges and only then discovering
@@ -641,7 +641,7 @@ ErrorCode KeystoneService::put_inline(const ObjectKey& key, const WorkerConfig& 
     inline_bytes_.fetch_sub(size);
     return ErrorCode::NOT_IMPLEMENTED;
   }
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   if (objects_.contains(key)) {
     inline_bytes_.fetch_sub(size);
     return ErrorCode::OBJECT_ALREADY_EXISTS;
@@ -688,7 +688,7 @@ Result<std::vector<PutSlot>> KeystoneService::put_start_pooled(uint64_t size,
   count = std::min<uint32_t>(count, 16);
 
   TRACE_SPAN("keystone.put_start_pooled");
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   const alloc::PoolMap pools_snapshot = allocatable_pools_snapshot();
   std::vector<PutSlot> slots;
   for (uint32_t i = 0; i < count; ++i) {
@@ -730,7 +730,7 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
 
   TRACE_SPAN("keystone.put_commit_slot");
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(slot_key);
   // Reclaimed (slot TTL) or minted by a previous leader: the client falls
   // back to the two-RTT path on this code.
@@ -796,7 +796,7 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
 
 ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
   // Same fence-first ordering as put_cancel (see comment there).
@@ -814,7 +814,7 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
 Result<uint64_t> KeystoneService::remove_all_objects() {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::vector<ObjectKey> removed;
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   uint64_t count = 0;
   for (auto it = objects_.begin(); it != objects_.end();) {
     // Once deposed (first FENCED stepped us down) every further RPC is
@@ -898,13 +898,13 @@ std::vector<ErrorCode> KeystoneService::batch_put_cancel(const std::vector<Objec
 Result<ClusterStats> KeystoneService::get_cluster_stats() const {
   ClusterStats stats;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     stats.total_workers = workers_.size();
     stats.total_memory_pools = pools_.size();
     for (const auto& [id, pool] : pools_) stats.total_capacity += pool.size;
   }
   {
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     // Pooled put slots are internal plumbing, not objects an operator put:
     // keep them out of the count (their reserved capacity still shows in
     // used_capacity, which is honest — the ranges are really held). O(1):
@@ -928,7 +928,7 @@ Result<ClusterStats> KeystoneService::get_cluster_stats() const {
 
 ErrorCode KeystoneService::register_worker(const WorkerInfo& worker) {
   if (worker.worker_id.empty()) return ErrorCode::INVALID_WORKER;
-  std::unique_lock lock(registry_mutex_);
+  WriterLock lock(registry_mutex_);
   auto& slot = workers_[worker.worker_id];
   const bool fresh = slot.worker_id.empty();
   slot = worker;
@@ -947,7 +947,7 @@ ErrorCode KeystoneService::register_memory_pool(const MemoryPool& pool) {
   // Adoption runs BEFORE the pool becomes allocatable, so fresh allocations
   // cannot carve over the spared objects' re-adopted ranges.
   readopt_offline_pool(pool);
-  std::unique_lock lock(registry_mutex_);
+  WriterLock lock(registry_mutex_);
   const bool fresh = !pools_.contains(pool.id);
   pools_[pool.id] = pool;
   lock.unlock();
@@ -960,7 +960,7 @@ ErrorCode KeystoneService::register_memory_pool(const MemoryPool& pool) {
 }
 
 alloc::PoolMap KeystoneService::allocatable_pools_snapshot() const {
-  std::shared_lock lock(registry_mutex_);
+  SharedLock lock(registry_mutex_);
   if (draining_.empty()) return pools_;
   alloc::PoolMap out;
   for (const auto& [id, pool] : pools_) {
@@ -971,7 +971,7 @@ alloc::PoolMap KeystoneService::allocatable_pools_snapshot() const {
 
 ErrorCode KeystoneService::remove_worker(const NodeId& worker_id) {
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
   }
   cleanup_dead_worker(worker_id);
@@ -979,7 +979,7 @@ ErrorCode KeystoneService::remove_worker(const NodeId& worker_id) {
 }
 
 std::vector<WorkerInfo> KeystoneService::workers() const {
-  std::shared_lock lock(registry_mutex_);
+  SharedLock lock(registry_mutex_);
   std::vector<WorkerInfo> out;
   out.reserve(workers_.size());
   for (const auto& [id, info] : workers_) out.push_back(info);
@@ -987,7 +987,7 @@ std::vector<WorkerInfo> KeystoneService::workers() const {
 }
 
 alloc::PoolMap KeystoneService::memory_pools() const {
-  std::shared_lock lock(registry_mutex_);
+  SharedLock lock(registry_mutex_);
   return pools_;
 }
 
@@ -1019,7 +1019,7 @@ void KeystoneService::on_object_event(const WatchEvent& ev) {
   if (ev.type == WatchEvent::Type::kPut) {
     alloc::PoolMap pools_snapshot;
     {
-      std::shared_lock lock(registry_mutex_);
+      SharedLock lock(registry_mutex_);
       pools_snapshot = pools_;
     }
     apply_object_record(key, ev.value, pools_snapshot);
@@ -1034,7 +1034,7 @@ void KeystoneService::on_heartbeat_event(const WatchEvent& ev) {
   if (ev.key.size() <= prefix.size()) return;
   const NodeId worker_id = ev.key.substr(prefix.size());
   if (ev.type == WatchEvent::Type::kPut) {
-    std::unique_lock lock(registry_mutex_);
+    WriterLock lock(registry_mutex_);
     auto it = workers_.find(worker_id);
     if (it != workers_.end()) it->second.last_heartbeat_ms = now_wall_ms();
   } else {

@@ -24,9 +24,9 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   // Drains are rare, operator-triggered, and share staging bookkeeping —
   // serialize them per service instead of reasoning about interleavings.
-  std::lock_guard<std::mutex> drain_lock(drain_mutex_);
+  MutexLock drain_lock(drain_mutex_);
   {
-    std::unique_lock lock(registry_mutex_);
+    WriterLock lock(registry_mutex_);
     if (!workers_.contains(worker_id)) return ErrorCode::INVALID_WORKER;
     draining_.insert(worker_id);
   }
@@ -38,7 +38,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   // the worker until the slot TTL. A slot whose commit is racing this
   // cancel commits as OBJECT_NOT_FOUND and the client re-puts normally.
   {
-    std::unique_lock lock(objects_mutex_);
+    WriterLock lock(objects_mutex_);
     for (auto it = objects_.begin(); it != objects_.end();) {
       bool on_worker = false;
       if (it->second.slot) {
@@ -75,7 +75,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   auto scan_moves = [&](bool& pending_touches) {
     std::vector<Move> moves;
     pending_touches = false;
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     for (const auto& [key, info] : objects_) {
       for (size_t ci = 0; ci < info.copies.size(); ++ci) {
         for (size_t si = 0; si < info.copies[ci].shards.size(); ++si) {
@@ -123,7 +123,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
     // drain now; the operator retries against it).
     if (!is_leader_.load()) {
       counters_.shards_drained.fetch_add(total_moved);
-      std::unique_lock lock(registry_mutex_);
+      WriterLock lock(registry_mutex_);
       draining_.erase(worker_id);
       return ErrorCode::NOT_LEADER;
     }
@@ -190,7 +190,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
         continue;
       }
 
-      std::unique_lock lock(objects_mutex_);
+      WriterLock lock(objects_mutex_);
       auto it = objects_.find(m.key);
       const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
       if (it == objects_.end() || it->second.epoch != expect ||
@@ -268,7 +268,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   // flag drops only AFTER retirement, so no allocation window reopens.
   cleanup_dead_worker(worker_id);
   {
-    std::unique_lock lock(registry_mutex_);
+    WriterLock lock(registry_mutex_);
     draining_.erase(worker_id);
   }
   counters_.shards_drained.fetch_add(total_moved);

@@ -25,7 +25,7 @@ using namespace detail;
 double KeystoneService::tier_utilization(std::optional<StorageClass> cls) const {
   uint64_t capacity = 0;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     for (const auto& [id, pool] : pools_) {
       if (!cls || pool.storage_class == *cls) capacity += pool.size;
     }
@@ -53,7 +53,7 @@ void KeystoneService::evict_for_pressure() {
   if (config_.tier_aware_eviction) {
     std::vector<StorageClass> classes;
     {
-      std::shared_lock lock(registry_mutex_);
+      SharedLock lock(registry_mutex_);
       for (const auto& [id, pool] : pools_) {
         if (std::find(classes.begin(), classes.end(), pool.storage_class) == classes.end())
           classes.push_back(pool.storage_class);
@@ -79,7 +79,7 @@ void KeystoneService::evict_for_pressure() {
     // LRU order over evictable objects in this scope.
     std::vector<std::pair<std::chrono::steady_clock::time_point, ObjectKey>> candidates;
     {
-      std::shared_lock lock(objects_mutex_);
+      SharedLock lock(objects_mutex_);
       for (const auto& [key, info] : objects_) {
         if (info.soft_pin || info.state != ObjectState::kComplete) continue;
         // Inline objects hold no pool capacity: evicting one cannot relieve
@@ -113,7 +113,7 @@ void KeystoneService::evict_for_pressure() {
         }
         if (outcome == DemoteOutcome::kSkipped) continue;
       }
-      std::unique_lock lock(objects_mutex_);
+      WriterLock lock(objects_mutex_);
       auto it = objects_.find(key);
       if (it == objects_.end()) continue;
       // Fence-first (see gc): never free ranges a promoted leader still maps.
@@ -154,7 +154,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   WorkerConfig config;
   std::vector<CopyPlacement> old_copies;
   {
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     auto it = objects_.find(key);
     if (it == objects_.end() || it->second.state != ObjectState::kComplete)
       return DemoteOutcome::kSkipped;
@@ -275,7 +275,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   }
 
   // Swap the placements in only if the object didn't change underneath us.
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end() || it->second.epoch != epoch_snap) {
     lock.unlock();

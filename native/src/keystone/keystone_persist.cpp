@@ -346,7 +346,7 @@ ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
 
 void KeystoneService::mark_persist_dirty(const ObjectKey& key) {
   if (!coordinator_ || !config_.persist_objects) return;
-  std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+  MutexLock lock(persist_retry_mutex_);
   persist_retry_.insert(key);
 }
 
@@ -354,7 +354,7 @@ void KeystoneService::retry_dirty_persists() {
   if (!coordinator_ || !config_.persist_objects) return;
   std::vector<ObjectKey> keys;
   {
-    std::lock_guard<std::mutex> lock(persist_retry_mutex_);
+    MutexLock lock(persist_retry_mutex_);
     if (persist_retry_.empty()) return;
     keys.assign(persist_retry_.begin(), persist_retry_.end());
   }
@@ -365,7 +365,7 @@ void KeystoneService::retry_dirty_persists() {
     // key mid-write, so the retry can never clobber a NEWER durable record
     // with this snapshot. Rare path (persist previously failed), bounded by
     // the coordinator RPC timeout.
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     auto it = objects_.find(key);
     ErrorCode ec;
     bool caught_up = false;
@@ -388,7 +388,7 @@ void KeystoneService::retry_dirty_persists() {
       // Erase while still holding the objects lock: mutators mark keys dirty
       // under the unique lock, so a FRESHER dirty mark (splice + failed
       // persist racing this loop) cannot be interleaved and wiped here.
-      std::lock_guard<std::mutex> dirty(persist_retry_mutex_);
+      MutexLock dirty(persist_retry_mutex_);
       persist_retry_.erase(key);
       if (caught_up) {
         LOG_INFO << "durable record for " << key << " caught up after deferred persist";
@@ -429,7 +429,7 @@ void KeystoneService::fence_stepdown() {
     // check and its park (lost wakeup = stale node out of the election for
     // a full refresh interval).
     {
-      std::lock_guard<std::mutex> lock(stop_mutex_);
+      MutexLock lock(stop_mutex_);
       needs_recampaign_ = true;
       recampaign_asap_ = true;
       // on_demoted() cannot run here: the fenced op's caller holds
@@ -450,7 +450,7 @@ void KeystoneService::load_persisted_objects() {
   const auto prefix = coord::objects_prefix(config_.cluster_id);
   alloc::PoolMap pools_snapshot;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     pools_snapshot = pools_;
   }
   size_t restored = 0, dropped = 0;
@@ -498,7 +498,7 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
   }
   if (live_copies.empty()) return ApplyResult::kFailed;
 
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   std::optional<ObjectInfo> previous;
   if (auto it = objects_.find(key); it != objects_.end()) {
     // Replace semantics: the record wins. The old ranges must be freed
@@ -555,7 +555,7 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
 }
 
 void KeystoneService::drop_object_locally(const ObjectKey& key) {
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end()) return;
   free_object_locked(key, it->second);

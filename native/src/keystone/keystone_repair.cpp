@@ -28,7 +28,7 @@ void KeystoneService::cleanup_stale_workers() {
   const int64_t ttl = config_.worker_heartbeat_ttl_sec * 1000;
   std::vector<NodeId> stale;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     for (const auto& [id, info] : workers_) {
       if (info.is_stale(now, ttl)) stale.push_back(id);
     }
@@ -42,7 +42,7 @@ void KeystoneService::cleanup_stale_workers() {
 void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
   std::vector<MemoryPoolId> dead_pools;
   {
-    std::unique_lock lock(registry_mutex_);
+    WriterLock lock(registry_mutex_);
     // A worker that dies mid-drain (or after a failed drain) must not leave
     // its id in draining_ forever — a replacement re-registering under the
     // same id would be silently unallocatable.
@@ -96,7 +96,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // still map back correctly); ALLOCATION targets exclude draining workers.
   alloc::PoolMap live_pools;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     live_pools = pools_;
   }
   const alloc::PoolMap target_pools = allocatable_pools_snapshot();
@@ -125,7 +125,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // already carry shards lost to EARLIER deaths; tolerance is cumulative).
   std::unordered_set<NodeId> live_workers;
   {
-    std::shared_lock lock(registry_mutex_);
+    SharedLock lock(registry_mutex_);
     for (const auto& [id, w] : workers_) {
       if (id != worker_id) live_workers.insert(id);
     }
@@ -143,7 +143,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // objects with dead placements forever.
   bool deferred = false;
   {
-    std::unique_lock lock(objects_mutex_);
+    WriterLock lock(objects_mutex_);
     for (auto it = objects_.begin(); it != objects_.end();) {
       if (!is_leader_.load()) {  // deposed mid-pass: stop issuing doomed RPCs
         deferred = true;
@@ -200,7 +200,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           // Same persistent-tier exception as the replicated loss branch.
           bool adoptable = true;
           {
-            std::shared_lock rlock(registry_mutex_);
+            SharedLock rlock(registry_mutex_);
             for (const auto& shard : copy.shards) {
               if (live_workers.contains(shard.worker_id)) continue;
               if (!offline_pools_.contains(shard.pool_id)) {
@@ -286,7 +286,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
         // metadata; here neither side forgets.
         bool adoptable = false;
         {
-          std::shared_lock rlock(registry_mutex_);
+          SharedLock rlock(registry_mutex_);
           for (const auto& copy : info.copies) {
             bool ok = !copy.shards.empty();
             for (const auto& shard : copy.shards) {
@@ -429,7 +429,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       continue;
     }
 
-    std::unique_lock lock(objects_mutex_);
+    WriterLock lock(objects_mutex_);
     auto it = objects_.find(p.key);
     if (it == objects_.end() || it->second.epoch != p.epoch) {
       lock.unlock();
@@ -498,7 +498,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     }
   }
   {
-    std::lock_guard<std::mutex> lock(repair_retry_mutex_);
+    MutexLock lock(repair_retry_mutex_);
     if (deferred) {
       repair_retry_.insert(worker_id);
     } else {
@@ -728,7 +728,7 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
   }
 
   // 4. Splice under the lock iff the object didn't change underneath us.
-  std::unique_lock lock(objects_mutex_);
+  WriterLock lock(objects_mutex_);
   auto it = objects_.find(key);
   if (it == objects_.end() || it->second.epoch != epoch ||
       it->second.copies.empty() || it->second.copies.front().shards.size() != n) {

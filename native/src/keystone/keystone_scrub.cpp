@@ -31,7 +31,7 @@ void KeystoneService::queue_scrub_target(const ObjectKey& key) {
   // the queue, so don't grow it. Movers call this from metadata critical
   // sections — hence the O(1) set insert, not a scan.
   if (config_.scrub_interval_sec <= 0 || config_.scrub_objects_per_pass == 0) return;
-  std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
+  MutexLock lock(scrub_targets_mutex_);
   scrub_targets_.insert(key);
 }
 
@@ -51,7 +51,7 @@ size_t KeystoneService::run_scrub_once() {
     // drain/repair can queue thousands of targets, and an unbounded batch
     // would full-read them all in one pass, defeating the budget's purpose.
     // The overflow keeps its priority and drains on subsequent passes.
-    std::lock_guard<std::mutex> lock(scrub_targets_mutex_);
+    MutexLock lock(scrub_targets_mutex_);
     auto it = scrub_targets_.begin();
     while (it != scrub_targets_.end() && priority.size() < config_.scrub_objects_per_pass) {
       priority.push_back(*it);
@@ -59,7 +59,7 @@ size_t KeystoneService::run_scrub_once() {
     }
   }
   {
-    std::shared_lock lock(objects_mutex_);
+    SharedLock lock(objects_mutex_);
     std::unordered_set<std::string_view> taken_keys;
     for (const auto& key : priority) {
       auto it = objects_.find(key);
@@ -188,7 +188,7 @@ size_t KeystoneService::run_scrub_once() {
             if (transport::copy_range_io(*data_client_, t.copies[sj], off, buf.data(), n,
                                          /*is_write=*/false) != ErrorCode::OK)
               return false;
-            std::shared_lock lock(objects_mutex_);
+            SharedLock lock(objects_mutex_);
             auto it = objects_.find(t.key);
             if (it == objects_.end() || it->second.epoch != t.epoch) {
               stale = true;
@@ -234,7 +234,7 @@ size_t KeystoneService::run_scrub_once() {
                                            buf.data(), n,
                                            /*is_write=*/false) != ErrorCode::OK)
                 return false;
-              std::shared_lock lock(objects_mutex_);
+              SharedLock lock(objects_mutex_);
               auto it = objects_.find(t.key);
               if (it == objects_.end() || it->second.epoch != t.epoch) {
                 stale = true;
@@ -267,7 +267,7 @@ size_t KeystoneService::run_scrub_once() {
 // on the health thread would stall failure detection and eviction for the
 // pass duration.
 void KeystoneService::scrub_loop() {
-  std::unique_lock<std::mutex> lock(stop_mutex_);
+  MutexLock lock(stop_mutex_);
   while (running_) {
     stop_cv_.wait_for(lock, std::chrono::seconds(config_.scrub_interval_sec),
                       [this] { return !running_.load(); });
@@ -287,7 +287,7 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
   if (!is_leader_.load()) return;  // keep the entry: a promoted leader adopts
   MemoryPool old;
   {
-    std::unique_lock lock(registry_mutex_);
+    WriterLock lock(registry_mutex_);
     auto it = offline_pools_.find(pool.id);
     if (it == offline_pools_.end()) return;
     old = it->second;
@@ -322,9 +322,9 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
   // run_readopt_checks (which holds it when acting) sees a stable value.
   const uint64_t adoption_seq = readopt_seq_counter_.fetch_add(1) + 1;
   {
-    std::unique_lock lock(objects_mutex_);
+    WriterLock lock(objects_mutex_);
     {
-      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      MutexLock qlock(readopt_checks_mutex_);
       readopt_seq_[pool.id] = adoption_seq;
     }
     for (auto it = objects_.begin(); it != objects_.end();) {
@@ -407,7 +407,7 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
     // reached from the coordinator watch thread, which must not stall on
     // streaming a multi-GB pool. Until the checks run, reads are guarded by
     // the client-side verify default (stale bytes fail their CRC).
-    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
+    MutexLock lock(readopt_checks_mutex_);
     readopt_checks_.insert(readopt_checks_.end(),
                            std::make_move_iterator(checks.begin()),
                            std::make_move_iterator(checks.end()));
@@ -421,7 +421,7 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
 void KeystoneService::run_readopt_checks() {
   std::vector<ReadoptCheck> checks;
   {
-    std::lock_guard<std::mutex> lock(readopt_checks_mutex_);
+    MutexLock lock(readopt_checks_mutex_);
     checks.swap(readopt_checks_);
   }
   if (checks.empty()) return;
@@ -441,13 +441,13 @@ void KeystoneService::run_readopt_checks() {
     LOG_WARN << "re-adopted shard of " << check.key << " failed revalidation ("
              << (io_ok ? "crc mismatch: stale/replaced backing file" : "unreadable")
              << "); dropping the object";
-    std::unique_lock lock(objects_mutex_);
+    WriterLock lock(objects_mutex_);
     // A later re-adoption of the same pool supersedes this check: its
     // placement rewrite may have raced the lock-free CRC read above, and
     // its OWN queued checks govern the restored bytes. (Checked under
     // objects_mutex_, which every adoption holds while stamping its seq.)
     {
-      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      MutexLock qlock(readopt_checks_mutex_);
       auto seq_it = readopt_seq_.find(check.shard.pool_id);
       if (seq_it != readopt_seq_.end() && seq_it->second != check.seq) continue;
     }
@@ -475,7 +475,7 @@ void KeystoneService::run_readopt_checks() {
       // Fence-first failed (outage): the corrupt object must not quietly
       // keep serving — re-queue so the next health tick retries the drop.
       lock.unlock();
-      std::lock_guard<std::mutex> qlock(readopt_checks_mutex_);
+      MutexLock qlock(readopt_checks_mutex_);
       readopt_checks_.push_back(check);
       continue;
     }

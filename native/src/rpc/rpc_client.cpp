@@ -11,14 +11,25 @@ KeystoneRpcClient::KeystoneRpcClient(std::string endpoint) : endpoint_(std::move
 KeystoneRpcClient::~KeystoneRpcClient() { disconnect(); }
 
 ErrorCode KeystoneRpcClient::connect() {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   return ensure_connected_locked();
 }
 
 void KeystoneRpcClient::disconnect() {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   sock_.shutdown();
   sock_.close();
+}
+
+bool KeystoneRpcClient::connected() const {
+  // Non-blocking probe: destructor-path callers (cancel_pooled_slots) use
+  // this precisely to AVOID paying a connect timeout an in-flight call may
+  // be stuck in — parking behind mutex_ here would defeat that. A busy
+  // client reports "not idle-connected" and best-effort work is skipped
+  // (the server-side slot TTL covers it either way).
+  MutexLock lock(mutex_, std::try_to_lock);
+  if (!lock) return false;
+  return sock_.valid();
 }
 
 ErrorCode KeystoneRpcClient::ensure_connected_locked() {
@@ -33,7 +44,7 @@ ErrorCode KeystoneRpcClient::ensure_connected_locked() {
 
 ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                       std::vector<uint8_t>& resp) {
-  std::lock_guard<std::mutex> lock(mutex_);
+  MutexLock lock(mutex_);
   // CONNECTION_FAILED is a *contract*: it may only be returned when no whole
   // frame was ever delivered, so callers (client failover) can safely replay
   // the call against another keystone. Once a mutation frame went out, a

@@ -33,7 +33,7 @@ void KeystoneRpcServer::stop() {
   listener_.close();
   std::vector<std::thread> threads;
   {
-    std::lock_guard<std::mutex> lock(conns_mutex_);
+    MutexLock lock(conns_mutex_);
     threads.swap(conn_threads_);
     for (auto& s : conns_) s->shutdown();
     conns_.clear();
@@ -47,7 +47,7 @@ void KeystoneRpcServer::accept_loop() {
     auto sock = net::tcp_accept(listener_, 200);
     if (!sock.ok()) continue;
     auto conn = std::make_shared<net::Socket>(std::move(sock).value());
-    std::lock_guard<std::mutex> lock(conns_mutex_);
+    MutexLock lock(conns_mutex_);
     conns_.push_back(conn);
     conn_threads_.emplace_back([this, conn] { serve(conn); });
   }

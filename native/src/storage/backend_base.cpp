@@ -40,7 +40,7 @@ void OffsetBackendBase::sweep_expired_locked() {
 Result<ReservationToken> OffsetBackendBase::reserve_shard(uint64_t size) {
   if (!allocator_) return ErrorCode::INVALID_STATE;
   if (size == 0) return ErrorCode::INVALID_PARAMETERS;
-  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  MutexLock lock(lifecycle_mutex_);
   sweep_expired_locked();
   auto range = allocator_->allocate(size);
   if (!range) return ErrorCode::INSUFFICIENT_SPACE;
@@ -56,7 +56,7 @@ Result<ReservationToken> OffsetBackendBase::reserve_shard(uint64_t size) {
 }
 
 ErrorCode OffsetBackendBase::commit_shard(const ReservationToken& token) {
-  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  MutexLock lock(lifecycle_mutex_);
   auto it = reservations_.find(token.id);
   if (it == reservations_.end()) return ErrorCode::INVALID_PARAMETERS;
   if (it->second.expired()) {
@@ -73,7 +73,7 @@ ErrorCode OffsetBackendBase::commit_shard(const ReservationToken& token) {
 }
 
 ErrorCode OffsetBackendBase::abort_shard(const ReservationToken& token) {
-  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  MutexLock lock(lifecycle_mutex_);
   auto it = reservations_.find(token.id);
   if (it == reservations_.end()) return ErrorCode::INVALID_PARAMETERS;
   allocator_->free({it->second.offset, it->second.size});
@@ -83,7 +83,7 @@ ErrorCode OffsetBackendBase::abort_shard(const ReservationToken& token) {
 }
 
 ErrorCode OffsetBackendBase::free_shard(uint64_t offset, uint64_t size) {
-  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  MutexLock lock(lifecycle_mutex_);
   auto it = committed_.find(offset);
   if (it == committed_.end() || it->second != size) return ErrorCode::INVALID_PARAMETERS;
   committed_.erase(it);
@@ -93,7 +93,7 @@ ErrorCode OffsetBackendBase::free_shard(uint64_t offset, uint64_t size) {
 }
 
 uint64_t OffsetBackendBase::used() const {
-  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  MutexLock lock(lifecycle_mutex_);
   uint64_t total = 0;
   for (const auto& [off, size] : committed_) total += size;
   for (const auto& [id, token] : reservations_) total += token.size;
@@ -101,7 +101,7 @@ uint64_t OffsetBackendBase::used() const {
 }
 
 StorageStats OffsetBackendBase::stats() const {
-  std::lock_guard<std::mutex> lock(lifecycle_mutex_);
+  MutexLock lock(lifecycle_mutex_);
   StorageStats s;
   s.capacity = config_.capacity;
   for (const auto& [off, size] : committed_) s.used += size;

@@ -7,6 +7,7 @@
 #include <mutex>
 
 #include "btpu/alloc/pool_allocator.h"
+#include "btpu/common/thread_annotations.h"
 #include "btpu/storage/backend.h"
 
 namespace btpu::storage {
@@ -30,14 +31,15 @@ class OffsetBackendBase : public StorageBackend {
   // Called by initialize() in subclasses once memory/files are ready.
   ErrorCode init_allocator();
   // Reclaims expired reservations (called opportunistically from reserve).
-  void sweep_expired_locked();
+  void sweep_expired_locked() BTPU_REQUIRES(lifecycle_mutex_);
 
   BackendConfig config_;
   std::unique_ptr<alloc::PoolAllocator> allocator_;
 
-  mutable std::mutex lifecycle_mutex_;
-  std::map<uint64_t, ReservationToken> reservations_;     // token id -> token
-  std::map<uint64_t, uint64_t> committed_;                // offset -> size
+  mutable Mutex lifecycle_mutex_;
+  // token id -> token / offset -> size.
+  std::map<uint64_t, ReservationToken> reservations_ BTPU_GUARDED_BY(lifecycle_mutex_);
+  std::map<uint64_t, uint64_t> committed_ BTPU_GUARDED_BY(lifecycle_mutex_);
   std::atomic<uint64_t> next_token_{1};
 
   // counters

@@ -20,9 +20,9 @@ namespace btpu::storage {
 namespace {
 
 struct EmulatedState {
-  std::mutex mutex;
-  std::unordered_map<uint64_t, std::pair<uint8_t*, uint64_t>> regions;
-  uint64_t next_id{1};
+  Mutex mutex;
+  std::unordered_map<uint64_t, std::pair<uint8_t*, uint64_t>> regions BTPU_GUARDED_BY(mutex);
+  uint64_t next_id BTPU_GUARDED_BY(mutex){1};
 
   static EmulatedState& instance() {
     static EmulatedState s;
@@ -34,7 +34,7 @@ int emu_alloc(void*, const char*, uint64_t size, uint64_t* out_id) {
   auto* mem = static_cast<uint8_t*>(std::malloc(size));
   if (!mem) return 1;
   auto& st = EmulatedState::instance();
-  std::lock_guard<std::mutex> lock(st.mutex);
+  MutexLock lock(st.mutex);
   *out_id = st.next_id++;
   st.regions[*out_id] = {mem, size};
   return 0;
@@ -42,7 +42,7 @@ int emu_alloc(void*, const char*, uint64_t size, uint64_t* out_id) {
 
 int emu_free(void*, uint64_t region_id) {
   auto& st = EmulatedState::instance();
-  std::lock_guard<std::mutex> lock(st.mutex);
+  MutexLock lock(st.mutex);
   auto it = st.regions.find(region_id);
   if (it == st.regions.end()) return 1;
   std::free(it->second.first);
@@ -52,7 +52,7 @@ int emu_free(void*, uint64_t region_id) {
 
 int emu_write(void*, uint64_t region_id, uint64_t offset, const void* src, uint64_t len) {
   auto& st = EmulatedState::instance();
-  std::lock_guard<std::mutex> lock(st.mutex);
+  MutexLock lock(st.mutex);
   auto it = st.regions.find(region_id);
   if (it == st.regions.end() || len > it->second.second || offset > it->second.second - len)
     return 1;
@@ -62,7 +62,7 @@ int emu_write(void*, uint64_t region_id, uint64_t offset, const void* src, uint6
 
 int emu_read(void*, uint64_t region_id, uint64_t offset, void* dst, uint64_t len) {
   auto& st = EmulatedState::instance();
-  std::lock_guard<std::mutex> lock(st.mutex);
+  MutexLock lock(st.mutex);
   auto it = st.regions.find(region_id);
   if (it == st.regions.end() || len > it->second.second || offset > it->second.second - len)
     return 1;
@@ -93,7 +93,7 @@ int emu_flush(void*) { return 0; }  // memcpy writes are synchronous
 int emu_copy(void*, uint64_t src_region, uint64_t src_off, uint64_t dst_region,
              uint64_t dst_off, uint64_t len) {
   auto& st = EmulatedState::instance();
-  std::lock_guard<std::mutex> lock(st.mutex);
+  MutexLock lock(st.mutex);
   auto src = st.regions.find(src_region);
   auto dst = st.regions.find(dst_region);
   if (src == st.regions.end() || dst == st.regions.end()) return 1;
@@ -108,9 +108,9 @@ const BtpuHbmProviderV3 kEmulatedProvider = {
     emu_available, emu_write_batch, emu_read_batch, emu_flush, emu_copy,
 };
 
-std::mutex g_provider_mutex;
-BtpuHbmProviderV3 g_provider = kEmulatedProvider;
-bool g_provider_emulated = true;
+Mutex g_provider_mutex;
+BtpuHbmProviderV3 g_provider BTPU_GUARDED_BY(g_provider_mutex) = kEmulatedProvider;
+bool g_provider_emulated BTPU_GUARDED_BY(g_provider_mutex) = true;
 // v4 fabric entries; all-null for v3 registrations and the emulation.
 struct FabricEntries {
   int (*address)(void*, char*, uint64_t){nullptr};
@@ -128,12 +128,12 @@ std::atomic<uint64_t> g_provider_gen{1};
 }  // namespace
 
 const BtpuHbmProviderV3& hbm_provider() {
-  std::lock_guard<std::mutex> lock(g_provider_mutex);
+  MutexLock lock(g_provider_mutex);
   return g_provider;
 }
 
 bool hbm_provider_is_emulated() {
-  std::lock_guard<std::mutex> lock(g_provider_mutex);
+  MutexLock lock(g_provider_mutex);
   return g_provider_emulated;
 }
 
@@ -304,7 +304,7 @@ void* hbm_host_view_base(uint64_t region_id) {
   void* (*fn)(void*, uint64_t);
   void* ctx;
   {
-    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    MutexLock lock(g_provider_mutex);
     fn = g_host_view_base;
     ctx = g_provider.ctx;
   }
@@ -315,7 +315,7 @@ std::string hbm_fabric_address() {
   FabricEntries fabric;
   void* ctx;
   {
-    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    MutexLock lock(g_provider_mutex);
     fabric = g_fabric;
     ctx = g_provider.ctx;
   }
@@ -331,7 +331,7 @@ ErrorCode hbm_fabric_offer(uint64_t region_id, uint64_t offset, uint64_t len,
   FabricEntries fabric;
   void* ctx;
   {
-    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    MutexLock lock(g_provider_mutex);
     fabric = g_fabric;
     ctx = g_provider.ctx;
   }
@@ -346,7 +346,7 @@ ErrorCode hbm_fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
   FabricEntries fabric;
   void* ctx;
   {
-    std::lock_guard<std::mutex> lock(g_provider_mutex);
+    MutexLock lock(g_provider_mutex);
     fabric = g_fabric;
     ctx = g_provider.ctx;
   }
@@ -359,7 +359,7 @@ ErrorCode hbm_fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
 }  // namespace btpu::storage
 
 extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider) {
-  std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::MutexLock lock(btpu::storage::g_provider_mutex);
   btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_fabric = {};  // v3 has no fabric entries
   btpu::storage::g_host_view_base = nullptr;
@@ -373,7 +373,7 @@ extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider)
 }
 
 extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider) {
-  std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::MutexLock lock(btpu::storage::g_provider_mutex);
   btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_host_view_base = nullptr;
   if (provider) {
@@ -390,7 +390,7 @@ extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider)
 
 extern "C" void btpu_register_hbm_provider_v5(const BtpuHbmProviderV5* provider) {
   btpu_register_hbm_provider_v4(provider ? &provider->base : nullptr);
-  std::lock_guard<std::mutex> lock(btpu::storage::g_provider_mutex);
+  btpu::MutexLock lock(btpu::storage::g_provider_mutex);
   btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_host_view_base = provider ? provider->host_view_base : nullptr;
 }

@@ -76,7 +76,7 @@ class MiniRing {
 
   // Blocking single-op submit+wait. Returns op result (>=0) or -errno.
   int32_t run(uint8_t opcode, int fd, void* buf, uint32_t len, uint64_t file_offset) {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     const unsigned tail = sq_tail_->load(std::memory_order_relaxed);
     const unsigned idx = tail & sq_mask_;
     io_uring_sqe& sqe = sqes_[idx];
@@ -121,7 +121,7 @@ class MiniRing {
   unsigned sq_mask_{0}, cq_mask_{0};
   unsigned* sq_array_{nullptr};
   io_uring_cqe* cqes_{nullptr};
-  std::mutex mutex_;
+  Mutex mutex_;
 };
 
 constexpr uint64_t kAlign = 512;
@@ -208,7 +208,7 @@ class IoUringDiskBackend : public OffsetBackendBase {
     if (aligned) return raw_io(offset, buf, len, is_write);
 
     // Unaligned O_DIRECT: widen to aligned window through the bounce buffer.
-    std::lock_guard<std::mutex> lock(bounce_mutex_);
+    MutexLock lock(bounce_mutex_);
     uint64_t pos = offset;
     auto* user = static_cast<uint8_t*>(buf);
     uint64_t remaining = len;
@@ -271,7 +271,7 @@ class IoUringDiskBackend : public OffsetBackendBase {
   std::unique_ptr<MiniRing> ring_;
   std::vector<uint8_t> bounce_;  // sizing only; aligned buffer is below
   void* bounce_aligned_{nullptr};
-  std::mutex bounce_mutex_;
+  Mutex bounce_mutex_;
 };
 
 std::unique_ptr<StorageBackend> make_iouring_disk_backend(const BackendConfig& config) {

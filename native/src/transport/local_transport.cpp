@@ -26,9 +26,9 @@ struct LocalRegion {
 struct LocalRegistry {
   // Reader-writer lock: the access path (every LOCAL one-sided op) takes a
   // shared lock for its rkey lookup; registration/teardown take it unique.
-  std::shared_mutex mutex;
-  std::unordered_map<uint64_t, LocalRegion> by_rkey;
-  std::mt19937_64 rng{0x6274707545ull};  // deterministic for debuggability
+  SharedMutex mutex;
+  std::unordered_map<uint64_t, LocalRegion> by_rkey BTPU_GUARDED_BY(mutex);
+  std::mt19937_64 rng BTPU_GUARDED_BY(mutex){0x6274707545ull};  // deterministic for debuggability
 
   static LocalRegistry& instance() {
     static LocalRegistry r;
@@ -43,7 +43,7 @@ class LocalTransportServer : public TransportServer {
   ErrorCode start(const std::string&, uint16_t) override { return ErrorCode::OK; }
   void stop() override {
     auto& reg = LocalRegistry::instance();
-    std::unique_lock<std::shared_mutex> lock(reg.mutex);
+    WriterLock lock(reg.mutex);
     for (uint64_t rkey : my_rkeys_) reg.by_rkey.erase(rkey);
     my_rkeys_.clear();
   }
@@ -52,7 +52,7 @@ class LocalTransportServer : public TransportServer {
                                            const std::string& tag) override {
     if (!base || len == 0) return ErrorCode::INVALID_PARAMETERS;
     auto& reg = LocalRegistry::instance();
-    std::unique_lock<std::shared_mutex> lock(reg.mutex);
+    WriterLock lock(reg.mutex);
     uint64_t rkey = reg.rng() | 1;  // nonzero
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
@@ -71,7 +71,7 @@ class LocalTransportServer : public TransportServer {
                                                    RegionWriteFn write_fn) override {
     if (len == 0 || !read_fn || !write_fn) return ErrorCode::INVALID_PARAMETERS;
     auto& reg = LocalRegistry::instance();
-    std::unique_lock<std::shared_mutex> lock(reg.mutex);
+    WriterLock lock(reg.mutex);
     uint64_t rkey = reg.rng() | 1;
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
     reg.by_rkey[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn)};
@@ -92,7 +92,7 @@ class LocalTransportServer : public TransportServer {
       return ErrorCode::INVALID_PARAMETERS;
     }
     auto& reg = LocalRegistry::instance();
-    std::unique_lock<std::shared_mutex> lock(reg.mutex);
+    WriterLock lock(reg.mutex);
     reg.by_rkey.erase(rkey);
     std::erase(my_rkeys_, rkey);
     return ErrorCode::OK;
@@ -127,7 +127,7 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
   RegionWriteFn write_fn;
   uint64_t offset = 0;
   {
-    std::shared_lock<std::shared_mutex> lock(reg.mutex);
+    SharedLock lock(reg.mutex);
     auto it = reg.by_rkey.find(rkey);
     if (it == reg.by_rkey.end()) return ErrorCode::MEMORY_ACCESS_ERROR;
     const LocalRegion& region = it->second;

@@ -135,9 +135,9 @@ struct SelfRegistry {
     uint64_t len{0};
     uint64_t gen{0};  // distinguishes re-registrations at a REUSED address
   };
-  std::shared_mutex mutex;
-  std::unordered_map<uint64_t, Entry> regions;  // base -> entry
-  uint64_t next_gen{1};
+  SharedMutex mutex;
+  std::unordered_map<uint64_t, Entry> regions BTPU_GUARDED_BY(mutex);  // base -> entry
+  uint64_t next_gen BTPU_GUARDED_BY(mutex){1};
 
   static SelfRegistry& instance() {
     static SelfRegistry r;
@@ -157,8 +157,8 @@ struct CacheEntry {
   std::chrono::steady_clock::time_point checked;
 };
 
-std::mutex g_cache_mutex;
-std::unordered_map<std::string, CacheEntry> g_cache;
+Mutex g_cache_mutex;
+std::unordered_map<std::string, CacheEntry> g_cache BTPU_GUARDED_BY(g_cache_mutex);
 
 bool parse_endpoint(const std::string& ep, std::string& boot, long& pid,
                     unsigned long long& starttime, uint64_t& base, uint64_t& len,
@@ -255,7 +255,7 @@ bool resolve(const std::string& ep, PvmTarget& out, bool for_write) {
       tl_cache.clear();
   }
   {
-    std::lock_guard<std::mutex> lock(g_cache_mutex);
+    MutexLock lock(g_cache_mutex);
     auto it = g_cache.find(ep);
     if (it != g_cache.end()) {
       // Negative entries retry after a beat: a transient failure (EPERM
@@ -316,7 +316,7 @@ bool resolve(const std::string& ep, PvmTarget& out, bool for_write) {
       }
     }
   }
-  std::lock_guard<std::mutex> lock(g_cache_mutex);
+  MutexLock lock(g_cache_mutex);
   // Bound the cache: every worker restart mints a fresh endpoint string per
   // pool, so a long-lived client would otherwise accumulate dead entries
   // forever. Unusable entries are pure negatives — safe to drop wholesale.
@@ -338,7 +338,7 @@ bool resolve(const std::string& ep, PvmTarget& out, bool for_write) {
 void invalidate(const std::string& ep) {
   // A negative entry (not an erase): the 5 s backoff in resolve() keeps a
   // persistently failing endpoint from re-probing /proc on every op.
-  std::lock_guard<std::mutex> lock(g_cache_mutex);
+  MutexLock lock(g_cache_mutex);
   CacheEntry entry;
   entry.checked = std::chrono::steady_clock::now();
   g_cache[ep] = entry;
@@ -372,7 +372,7 @@ std::string pvm_make_endpoint(const void* base, uint64_t len, bool writable,
 uint64_t pvm_register_self_region(const void* base, uint64_t len) {
   if (!base || len == 0) return 0;
   auto& sr = SelfRegistry::instance();
-  std::unique_lock<std::shared_mutex> lock(sr.mutex);
+  WriterLock lock(sr.mutex);
   const uint64_t gen = sr.next_gen++;
   sr.regions[reinterpret_cast<uintptr_t>(base)] = {len, gen};
   return gen;
@@ -384,7 +384,7 @@ void pvm_retire_self_region(const void* base) {
   // The unique lock is the teardown fence: it waits out every in-flight
   // direct copy (shared holders), after which no new access can resolve the
   // region — only then may the caller free the memory.
-  std::unique_lock<std::shared_mutex> lock(sr.mutex);
+  WriterLock lock(sr.mutex);
   sr.regions.erase(reinterpret_cast<uintptr_t>(base));
 }
 
@@ -406,7 +406,7 @@ bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf,
     // teardown's munmap (pvm_retire_self_region takes it unique before the
     // backend frees the memory).
     auto& sr = SelfRegistry::instance();
-    std::shared_lock<std::shared_mutex> lock(sr.mutex);
+    SharedLock lock(sr.mutex);
     auto it = sr.regions.find(target.base);
     // Generation must match the endpoint's `:sN` token: a revived worker
     // whose pool mmap reused this address registered a NEW generation, and

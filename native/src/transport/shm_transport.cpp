@@ -40,7 +40,7 @@ class ShmTransportServer : public TransportServer {
   ErrorCode start(const std::string&, uint16_t) override { return ErrorCode::OK; }
 
   void stop() override {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     for (auto& [base, seg] : segments_) {
       ::munmap(seg.base, seg.len);
       ::shm_unlink(seg.name.c_str());
@@ -68,7 +68,7 @@ class ShmTransportServer : public TransportServer {
       ::shm_unlink(name.c_str());
       return nullptr;
     }
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     segments_[base] = {name, static_cast<uint8_t*>(base), len};
     LOG_DEBUG << "shm segment " << name << " (" << len << " bytes)";
     return base;
@@ -76,7 +76,7 @@ class ShmTransportServer : public TransportServer {
 
   Result<RemoteDescriptor> register_region(void* base, uint64_t len,
                                            const std::string& tag) override {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     auto it = segments_.find(base);
     if (it == segments_.end() || it->second.len < len) {
       LOG_ERROR << "shm register_region for memory not allocated via alloc_region";
@@ -91,7 +91,7 @@ class ShmTransportServer : public TransportServer {
   }
 
   ErrorCode unregister_region(const RemoteDescriptor& desc) override {
-    std::lock_guard<std::mutex> lock(mutex_);
+    MutexLock lock(mutex_);
     for (auto it = segments_.begin(); it != segments_.end(); ++it) {
       if (it->second.name == desc.endpoint) {
         ::munmap(it->second.base, it->second.len);
@@ -110,9 +110,9 @@ class ShmTransportServer : public TransportServer {
     return out;
   }
 
-  std::mutex mutex_;
-  std::unordered_map<void*, ShmSegment> segments_;
-  std::mt19937_64 rng_{0x73686d726567ull};
+  Mutex mutex_;
+  std::unordered_map<void*, ShmSegment> segments_ BTPU_GUARDED_BY(mutex_);
+  std::mt19937_64 rng_ BTPU_GUARDED_BY(mutex_){0x73686d726567ull};
 };
 
 // Client-side cache of mapped segments. Reader-writer lock: every same-host
@@ -129,7 +129,7 @@ class ShmMapCache {
   // Maps (or returns cached) segment; out_len = segment size.
   uint8_t* map(const std::string& name, uint64_t& out_len) {
     {
-      std::shared_lock<std::shared_mutex> lock(mutex_);
+      SharedLock lock(mutex_);
       auto it = maps_.find(name);
       if (it != maps_.end()) {
         out_len = it->second.len;
@@ -147,7 +147,7 @@ class ShmMapCache {
         ::mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
     ::close(fd);
     if (base == MAP_FAILED) return nullptr;
-    std::unique_lock<std::shared_mutex> lock(mutex_);
+    WriterLock lock(mutex_);
     auto [it, inserted] = maps_.try_emplace(
         name, ShmSegment{name, static_cast<uint8_t*>(base), static_cast<uint64_t>(st.st_size)});
     if (!inserted) {
@@ -159,7 +159,7 @@ class ShmMapCache {
   }
 
   void drop(const std::string& name) {
-    std::unique_lock<std::shared_mutex> lock(mutex_);
+    WriterLock lock(mutex_);
     auto it = maps_.find(name);
     if (it != maps_.end()) {
       ::munmap(it->second.base, it->second.len);
@@ -168,8 +168,8 @@ class ShmMapCache {
   }
 
  private:
-  std::shared_mutex mutex_;
-  std::unordered_map<std::string, ShmSegment> maps_;
+  SharedMutex mutex_;
+  std::unordered_map<std::string, ShmSegment> maps_ BTPU_GUARDED_BY(mutex_);
 };
 
 }  // namespace

@@ -33,6 +33,7 @@
 #include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/common/stripe_counter.h"
+#include "btpu/common/wire_layout_check.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/transport.h"
 
@@ -74,7 +75,14 @@ struct DataRequestHeader {
   uint64_t len;
 };
 #pragma pack(pop)
-static_assert(sizeof(DataRequestHeader) == 25);
+// This header crosses the socket as raw bytes: freeze every offset, not
+// just the total, so an inserted field cannot shift the tail silently.
+BTPU_WIRE_RAW_TYPE(DataRequestHeader);
+BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 25);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, op, 0);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, addr, 1);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, rkey, 9);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, len, 17);
 
 struct Region {
   uint8_t* base{nullptr};  // null for virtual (callback-backed) regions
@@ -115,7 +123,7 @@ class TcpTransportServer : public TransportServer {
     listener_.close();
     std::vector<std::thread> threads;
     {
-      std::lock_guard<std::mutex> lock(conns_mutex_);
+      MutexLock lock(conns_mutex_);
       threads.swap(conn_threads_);
       for (auto& s : conns_) s->shutdown();
       conns_.clear();
@@ -128,7 +136,7 @@ class TcpTransportServer : public TransportServer {
                                            const std::string& tag) override {
     if (!base || len == 0) return ErrorCode::INVALID_PARAMETERS;
     if (!running_) return ErrorCode::INVALID_STATE;
-    std::lock_guard<std::mutex> lock(regions_mutex_);
+    MutexLock lock(regions_mutex_);
     uint64_t rkey = rng_() | 1;
     while (regions_.contains(rkey)) rkey = rng_() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
@@ -147,7 +155,7 @@ class TcpTransportServer : public TransportServer {
                                                    RegionWriteFn write_fn) override {
     if (len == 0 || !read_fn || !write_fn) return ErrorCode::INVALID_PARAMETERS;
     if (!running_) return ErrorCode::INVALID_STATE;
-    std::lock_guard<std::mutex> lock(regions_mutex_);
+    MutexLock lock(regions_mutex_);
     uint64_t rkey = rng_() | 1;
     while (regions_.contains(rkey)) rkey = rng_() | 1;
     regions_[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn)};
@@ -167,7 +175,7 @@ class TcpTransportServer : public TransportServer {
     } catch (...) {
       return ErrorCode::INVALID_PARAMETERS;
     }
-    std::lock_guard<std::mutex> lock(regions_mutex_);
+    MutexLock lock(regions_mutex_);
     return regions_.erase(rkey) ? ErrorCode::OK : ErrorCode::MEMORY_POOL_NOT_FOUND;
   }
 
@@ -179,7 +187,7 @@ class TcpTransportServer : public TransportServer {
     } catch (...) {
       return ErrorCode::INVALID_PARAMETERS;
     }
-    std::lock_guard<std::mutex> lock(regions_mutex_);
+    MutexLock lock(regions_mutex_);
     auto it = regions_.find(rkey);
     if (it == regions_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
     it->second.offer_fn = std::move(offer_fn);
@@ -193,7 +201,7 @@ class TcpTransportServer : public TransportServer {
       auto sock = net::tcp_accept(listener_, 200);
       if (!sock.ok()) continue;
       auto conn = std::make_shared<net::Socket>(std::move(sock).value());
-      std::lock_guard<std::mutex> lock(conns_mutex_);
+      MutexLock lock(conns_mutex_);
       conns_.push_back(conn);
       conn_threads_.emplace_back([this, conn] { serve(conn); });
     }
@@ -203,7 +211,7 @@ class TcpTransportServer : public TransportServer {
   // `target` points into a flat region or `region_out` carries callbacks.
   bool resolve(uint64_t addr, uint64_t rkey, uint64_t len, uint8_t*& target, Region& region_out,
                uint64_t& offset) {
-    std::lock_guard<std::mutex> lock(regions_mutex_);
+    MutexLock lock(regions_mutex_);
     auto it = regions_.find(rkey);
     if (it == regions_.end()) return false;
     const Region& region = it->second;
@@ -380,12 +388,12 @@ class TcpTransportServer : public TransportServer {
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
 
-  std::mutex conns_mutex_;
-  std::vector<std::thread> conn_threads_;
-  std::vector<std::shared_ptr<net::Socket>> conns_;
+  Mutex conns_mutex_;
+  std::vector<std::thread> conn_threads_ BTPU_GUARDED_BY(conns_mutex_);
+  std::vector<std::shared_ptr<net::Socket>> conns_ BTPU_GUARDED_BY(conns_mutex_);
 
-  std::mutex regions_mutex_;
-  std::unordered_map<uint64_t, Region> regions_;
+  Mutex regions_mutex_;
+  std::unordered_map<uint64_t, Region> regions_ BTPU_GUARDED_BY(regions_mutex_);
   std::mt19937_64 rng_{0x7463707265670aull};
 };
 
@@ -472,7 +480,7 @@ class TcpEndpointPool {
     Shard& shard = shard_for(endpoint);
     int staged_hint;
     {
-      std::lock_guard<std::mutex> lock(shard.mutex);
+      MutexLock lock(shard.mutex);
       auto& free_list = shard.pools[endpoint];
       if (!free_list.empty()) {
         PooledConn c = std::move(free_list.back());
@@ -500,7 +508,7 @@ class TcpEndpointPool {
         // 0 = client-local shm setup failed (/dev/shm full, EMFILE):
         // transient, so the next connection re-probes. Only a server
         // answer (yes / refused / dropped) is worth remembering.
-        std::lock_guard<std::mutex> lock(shard.mutex);
+        MutexLock lock(shard.mutex);
         shard.staged_support[endpoint] = verdict;
       }
     }
@@ -509,7 +517,7 @@ class TcpEndpointPool {
 
   void release(const std::string& endpoint, PooledConn conn) {
     Shard& shard = shard_for(endpoint);
-    std::lock_guard<std::mutex> lock(shard.mutex);
+    MutexLock lock(shard.mutex);
     auto& free_list = shard.pools[endpoint];
     if (free_list.size() < kMaxPooledPerEndpoint) free_list.push_back(std::move(conn));
     // else: dtor closes socket + unmaps staging
@@ -517,15 +525,16 @@ class TcpEndpointPool {
 
   void drop_endpoint(const std::string& endpoint) {
     Shard& shard = shard_for(endpoint);
-    std::lock_guard<std::mutex> lock(shard.mutex);
+    MutexLock lock(shard.mutex);
     shard.pools.erase(endpoint);
   }
 
  private:
   struct Shard {
-    std::mutex mutex;
-    std::unordered_map<std::string, std::vector<PooledConn>> pools;
-    std::unordered_map<std::string, int> staged_support;  // 1 yes, -1 no
+    Mutex mutex;
+    std::unordered_map<std::string, std::vector<PooledConn>> pools BTPU_GUARDED_BY(mutex);
+    // 1 yes, -1 no.
+    std::unordered_map<std::string, int> staged_support BTPU_GUARDED_BY(mutex);
   };
 
   Shard& shard_for(const std::string& endpoint) {
@@ -609,14 +618,14 @@ class WireWorkers {
     job->fn = &fn;
     job->n = n;
     {
-      std::lock_guard<std::mutex> lock(mutex_);
+      MutexLock lock(mutex_);
       jobs_.push_back(job);
     }
     cv_.notify_all();
     help(*job);
-    std::unique_lock<std::mutex> lock(job->done_mutex);
+    MutexLock lock(job->done_mutex);
     job->done_cv.wait(lock, [&] { return job->done.load() >= job->n; });
-    std::lock_guard<std::mutex> qlock(mutex_);
+    MutexLock qlock(mutex_);
     std::erase(jobs_, job);
   }
 
@@ -626,8 +635,8 @@ class WireWorkers {
     size_t n{0};
     std::atomic<size_t> next{0};
     std::atomic<size_t> done{0};
-    std::mutex done_mutex;
-    std::condition_variable done_cv;
+    Mutex done_mutex;
+    std::condition_variable_any done_cv;
   };
 
   WireWorkers() {
@@ -652,7 +661,7 @@ class WireWorkers {
       } catch (...) {
       }
       if (job.done.fetch_add(1) + 1 == job.n) {
-        std::lock_guard<std::mutex> lock(job.done_mutex);
+        MutexLock lock(job.done_mutex);
         job.done_cv.notify_all();
       }
     }
@@ -662,8 +671,10 @@ class WireWorkers {
     for (;;) {
       std::shared_ptr<Job> job;
       {
-        std::unique_lock<std::mutex> lock(mutex_);
-        cv_.wait(lock, [&] { return !jobs_.empty(); });
+        MutexLock lock(mutex_);
+        // Explicit loop: a predicate lambda is analyzed as an unannotated
+        // function and would flag the guarded jobs_ read.
+        while (jobs_.empty()) cv_.wait(lock);
         job = jobs_.front();
         if (job->next.load() >= job->n) {
           // Exhausted but not yet erased by its owner: skip past it so a
@@ -677,9 +688,9 @@ class WireWorkers {
   }
 
   size_t nthreads_{0};
-  std::mutex mutex_;
-  std::condition_variable cv_;
-  std::deque<std::shared_ptr<Job>> jobs_;
+  Mutex mutex_;
+  std::condition_variable_any cv_;
+  std::deque<std::shared_ptr<Job>> jobs_ BTPU_GUARDED_BY(mutex_);
 };
 
 // ---- pipelined batch engine ------------------------------------------------
@@ -772,6 +783,9 @@ struct StagedFrame {
   DataRequestHeader h;
   uint64_t shm_off;
 } __attribute__((packed));
+BTPU_WIRE_RAW_TYPE(StagedFrame);
+BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 33);
+BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 25);
 
 ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
   if (use_staged(c, sub)) {
@@ -908,24 +922,24 @@ bool is_socket_failure(ErrorCode ec) {
 // another replica). Ops are partitioned whole onto slices, so op->status
 // stays single-writer; only `dead` and `first` cross threads.
 struct BatchShared {
-  std::mutex mutex;
-  std::unordered_map<std::string, ErrorCode> dead;
-  ErrorCode first{ErrorCode::OK};
+  Mutex mutex;
+  std::unordered_map<std::string, ErrorCode> dead BTPU_GUARDED_BY(mutex);
+  ErrorCode first BTPU_GUARDED_BY(mutex){ErrorCode::OK};
 
   bool known_dead(const std::string& endpoint, ErrorCode& ec) {
-    std::lock_guard<std::mutex> lock(mutex);
+    MutexLock lock(mutex);
     auto it = dead.find(endpoint);
     if (it == dead.end()) return false;
     ec = it->second;
     return true;
   }
   void mark_dead(const std::string& endpoint, ErrorCode ec) {
-    std::lock_guard<std::mutex> lock(mutex);
+    MutexLock lock(mutex);
     dead.emplace(endpoint, ec);
   }
   void fail(WireOp* op, ErrorCode ec) {
     if (op->status == ErrorCode::OK) op->status = ec;
-    std::lock_guard<std::mutex> lock(mutex);
+    MutexLock lock(mutex);
     if (first == ErrorCode::OK) first = ec;
   }
 };

@@ -357,7 +357,7 @@ ErrorCode WorkerService::start() {
 }
 
 void WorkerService::heartbeat_loop() {
-  std::unique_lock<std::mutex> lock(stop_mutex_);
+  MutexLock lock(stop_mutex_);
   while (running_) {
     stop_cv_.wait_for(lock, std::chrono::milliseconds(config_.heartbeat_interval_ms),
                       [this] { return !running_.load(); });

@@ -1,3 +1,5 @@
+#include <cstring>
+
 #include "btest.h"
 
 // TSan one-sided-RMA suppression + clockwait interceptor shim, shared with
@@ -5,4 +7,12 @@
 #include "../exe/tsan_clockwait_shim.h"
 #include "../exe/tsan_rma_suppression.h"
 
-int main(int argc, char** argv) { return btest::run_all(argc, argv); }
+// test_wire_layout.cpp: prints the current wire golden table (make wire-golden).
+int btpu_dump_wire_golden();
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--dump-wire-golden") == 0) return btpu_dump_wire_golden();
+  }
+  return btest::run_all(argc, argv);
+}

@@ -2,9 +2,12 @@
 // and the live /metrics endpoint (the reference's was unimplemented).
 #include <unistd.h>
 
+#include <atomic>
 #include <cstring>
+#include <thread>
 
 #include "btest.h"
+#include "btpu/client/client.h"
 #include "btpu/common/crc32c.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
@@ -485,4 +488,36 @@ BTEST(Rpc, V1EpochOpcodeFailsLoudlyNotSilently) {
   std::memcpy(&ec, resp_bytes.data(), sizeof(ec));
   BT_EXPECT(ec == ErrorCode::NOT_IMPLEMENTED);
   BT_EXPECT(!f.ks.object_exists("v1/obj").value());  // nothing was placed
+}
+
+BTEST(Rpc, ConcurrentFailoverRotation) {
+  // Regression: ObjectClient::rotate_keystone() used to reassign the rpc_
+  // unique_ptr with NO lock while sibling threads were mid-call through the
+  // same pointer — concurrent failover was a use-after-free (surfaced by
+  // the thread-safety annotations, visible to TSan). rpc_ is now a
+  // mutex-guarded shared_ptr snapshot: in-flight calls pin the client they
+  // started on while the swap installs the replacement.
+  //
+  // Dead primary (nothing listens on port 1 -> instant ECONNREFUSED) + the
+  // live keystone as fallback, NO pre-connect: every thread's first call
+  // hits CONNECTION_FAILED and races into rotate_keystone simultaneously.
+  RpcFixture f;
+  BT_ASSERT(f.up());
+  client::ClientOptions opt;
+  opt.keystone_address = "127.0.0.1:1";
+  opt.keystone_fallbacks = {f.server->endpoint()};
+  client::ObjectClient c(opt);
+
+  std::atomic<int> ok{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&] {
+      for (int i = 0; i < 8; ++i) {
+        auto r = c.object_exists("rpc/failover/none");
+        if (r.ok() && !r.value()) ok.fetch_add(1);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  BT_EXPECT_EQ(ok.load(), 32);
 }

@@ -1,0 +1,341 @@
+// Wire golden-table test: freezes the ENCODED layout of every struct that
+// crosses the wire (btpu/common/wire.h) against a checked-in table,
+// native/tests/wire_golden.txt.
+//
+// The wire format's compat story is append-only (wire.h header comment):
+// fields encode in a fixed order with fixed widths, missing trailing fields
+// default, unknown trailing bytes are skipped. A field inserted mid-struct,
+// a reordered pair, or a widened scalar silently breaks every peer running
+// the old build — and nothing caught it until decode failed in production.
+// This test encodes a canonical instance of every wire struct and diffs the
+// exact bytes against the golden table, so ANY layout change fails the
+// suite. Intentional (append-only!) changes regenerate the table:
+//
+//     make wire-golden        # wraps: build/btpu_tests --dump-wire-golden
+//
+// and the diff of wire_golden.txt in review IS the wire-compat review.
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+#include "btpu/common/wire.h"
+#include "btest.h"
+
+namespace {
+
+using namespace btpu;
+
+// ---- canonical instances --------------------------------------------------
+// Deterministic, fully-populated values: every field non-default and
+// distinct, nested structs/vectors non-empty, so each field's bytes appear
+// in the encoding at a stable offset.
+
+TopoCoord canon_topo() { return {3, 7, 1}; }
+
+RemoteDescriptor canon_remote() {
+  RemoteDescriptor d;
+  d.transport = TransportKind::TCP;
+  d.endpoint = "h:1";
+  d.remote_base = 0x1111;
+  d.rkey_hex = "ab";
+  d.fabric_addr = "fa";
+  d.pvm_endpoint = "pv";
+  return d;
+}
+
+MemoryLocation canon_memloc() { return {0x2222, 0x3333, 0x44}; }
+FileLocation canon_fileloc() { return {"/f", 0x55}; }
+DeviceLocation canon_devloc() { return {"tpu:0", 9, 0x66, 0x77}; }
+
+ShardPlacement canon_shard() {
+  ShardPlacement s;
+  s.pool_id = "p1";
+  s.worker_id = "w1";
+  s.remote = canon_remote();
+  s.storage_class = StorageClass::RAM_CPU;
+  s.length = 0x88;
+  s.location = canon_memloc();
+  return s;
+}
+
+CopyPlacement canon_copy() {
+  CopyPlacement c;
+  c.copy_index = 2;
+  c.shards = {canon_shard()};
+  c.ec_data_shards = 4;
+  c.ec_parity_shards = 2;
+  c.ec_object_size = 0x99;
+  c.content_crc = 0xAA;
+  c.shard_crcs = {0xBB, 0xCC};
+  c.inline_data = "in";
+  c.cache_version = 0xDD;
+  c.cache_gen = 0xEE;
+  c.cache_lease_ms = 0xFF;
+  return c;
+}
+
+WorkerConfig canon_config() {
+  WorkerConfig c;
+  c.replication_factor = 2;
+  c.max_workers_per_copy = 3;
+  c.enable_soft_pin = true;
+  c.preferred_node = "n1";
+  c.preferred_classes = {StorageClass::HBM_TPU, StorageClass::NVME};
+  c.ttl_ms = 0x111;
+  c.enable_locality_awareness = false;
+  c.prefer_contiguous = true;
+  c.min_shard_size = 0x222;
+  c.preferred_slice = 5;
+  c.ec_data_shards = 6;
+  c.ec_parity_shards = 3;
+  return c;
+}
+
+ClusterStats canon_stats() { return {1, 2, 3, 4, 5, 0.5, 6}; }
+
+MemoryPool canon_pool() {
+  MemoryPool p;
+  p.id = "pool";
+  p.node_id = "node";
+  p.base_addr = 0x333;
+  p.size = 0x444;
+  p.used = 0x55;
+  p.storage_class = StorageClass::SSD;
+  p.remote = canon_remote();
+  p.topo = canon_topo();
+  p.alignment = 0x66;
+  p.fabric_addr = "fb";
+  return p;
+}
+
+ObjectSummary canon_summary() { return {"k1", 0x777, 2, true}; }
+BatchPutStartItem canon_bpsi() { return {"k2", 0x888, canon_config(), 0x99}; }
+CopyShardCrcs canon_cscrcs() { return {1, {0xAB, 0xCD}}; }
+PutSlot canon_slot() { return {"\x01slot/t/1", {canon_copy()}}; }
+
+std::string hex(const std::vector<uint8_t>& v) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  out.reserve(v.size() * 2);
+  for (uint8_t b : v) {
+    out.push_back(d[b >> 4]);
+    out.push_back(d[b & 0xf]);
+  }
+  return out.empty() ? "-" : out;
+}
+
+template <typename T>
+std::string enc(const T& v) {
+  wire::Writer w;
+  wire::encode(w, v);
+  return hex(w.buffer());
+}
+
+// One row per wire struct: name -> hex of the canonical encoding.
+std::vector<std::pair<std::string, std::string>> golden_rows() {
+  std::vector<std::pair<std::string, std::string>> rows;
+  auto add = [&](const char* name, std::string h) { rows.emplace_back(name, std::move(h)); };
+
+  // Data-model composites (size-prefixed encode_struct bodies).
+  add("TopoCoord", enc(canon_topo()));
+  add("RemoteDescriptor", enc(canon_remote()));
+  add("MemoryLocation", enc(canon_memloc()));
+  add("FileLocation", enc(canon_fileloc()));
+  add("DeviceLocation", enc(canon_devloc()));
+  add("LocationDetail/Memory", enc(LocationDetail{canon_memloc()}));
+  add("LocationDetail/File", enc(LocationDetail{canon_fileloc()}));
+  add("LocationDetail/Device", enc(LocationDetail{canon_devloc()}));
+  add("ShardPlacement", enc(canon_shard()));
+  add("CopyPlacement", enc(canon_copy()));
+  add("PutSlot", enc(canon_slot()));
+  add("WorkerConfig", enc(canon_config()));
+  add("ClusterStats", enc(canon_stats()));
+  add("MemoryPool", enc(canon_pool()));
+  add("ObjectSummary", enc(canon_summary()));
+  add("BatchPutStartItem", enc(canon_bpsi()));
+  add("CopyShardCrcs", enc(canon_cscrcs()));
+  add("Result<bool>/ok", enc(Result<bool>(true)));
+  add("Result<bool>/err", enc(Result<bool>(ErrorCode::OBJECT_NOT_FOUND)));
+
+  // RPC messages (frame-bounded, tail-tolerant field lists).
+  add("ObjectExistsRequest", enc(ObjectExistsRequest{"k"}));
+  add("ObjectExistsResponse", enc(ObjectExistsResponse{true, ErrorCode::OK}));
+  add("GetWorkersRequest", enc(GetWorkersRequest{"k"}));
+  add("GetWorkersResponse",
+      enc(GetWorkersResponse{{canon_copy()}, ErrorCode::OBJECT_NOT_FOUND}));
+  add("PutStartRequest", enc(PutStartRequest{"k", 0x123, canon_config(), 0x45}));
+  add("PutStartResponse", enc(PutStartResponse{{canon_copy()}, ErrorCode::OK}));
+  add("PutCompleteRequest", enc(PutCompleteRequest{"k", {canon_cscrcs()}, 0x67}));
+  add("PutCompleteResponse", enc(PutCompleteResponse{ErrorCode::OK}));
+  add("PutCancelRequest", enc(PutCancelRequest{"k"}));
+  add("PutCancelResponse", enc(PutCancelResponse{ErrorCode::OK}));
+  add("RemoveObjectRequest", enc(RemoveObjectRequest{"k"}));
+  add("RemoveObjectResponse", enc(RemoveObjectResponse{ErrorCode::OK}));
+  add("RemoveAllObjectsRequest", enc(RemoveAllObjectsRequest{}));
+  add("RemoveAllObjectsResponse", enc(RemoveAllObjectsResponse{7, ErrorCode::OK}));
+  add("DrainWorkerRequest", enc(DrainWorkerRequest{"w"}));
+  add("DrainWorkerResponse", enc(DrainWorkerResponse{8, ErrorCode::OK}));
+  add("GetClusterStatsRequest", enc(GetClusterStatsRequest{}));
+  add("GetClusterStatsResponse", enc(GetClusterStatsResponse{canon_stats(), ErrorCode::OK}));
+  add("GetViewVersionRequest", enc(GetViewVersionRequest{}));
+  add("GetViewVersionResponse", enc(GetViewVersionResponse{9, ErrorCode::OK}));
+  add("ListObjectsRequest", enc(ListObjectsRequest{"pre", 10}));
+  add("ListObjectsResponse", enc(ListObjectsResponse{{canon_summary()}, ErrorCode::OK}));
+  add("BatchObjectExistsRequest", enc(BatchObjectExistsRequest{{"a", "b"}}));
+  add("BatchObjectExistsResponse",
+      enc(BatchObjectExistsResponse{{Result<bool>(true)}, ErrorCode::OK}));
+  add("BatchGetWorkersRequest", enc(BatchGetWorkersRequest{{"a"}}));
+  add("BatchGetWorkersResponse",
+      enc(BatchGetWorkersResponse{
+          {Result<std::vector<CopyPlacement>>(std::vector<CopyPlacement>{canon_copy()})},
+          ErrorCode::OK}));
+  add("BatchPutStartRequest", enc(BatchPutStartRequest{{canon_bpsi()}}));
+  add("BatchPutStartResponse",
+      enc(BatchPutStartResponse{
+          {Result<std::vector<CopyPlacement>>(ErrorCode::INSUFFICIENT_SPACE)},
+          ErrorCode::OK}));
+  add("BatchPutCompleteRequest",
+      enc(BatchPutCompleteRequest{{"a"}, {{canon_cscrcs()}}, {0x12}}));
+  add("BatchPutCompleteResponse",
+      enc(BatchPutCompleteResponse{{ErrorCode::OK}, ErrorCode::OK}));
+  add("BatchPutCancelRequest", enc(BatchPutCancelRequest{{"a"}}));
+  add("BatchPutCancelResponse", enc(BatchPutCancelResponse{{ErrorCode::OK}, ErrorCode::OK}));
+  add("PutStartPooledRequest", enc(PutStartPooledRequest{0x234, canon_config(), 2, "tag"}));
+  add("PutStartPooledResponse",
+      enc(PutStartPooledResponse{ErrorCode::OK, {canon_slot()}}));
+  add("PutCommitSlotRequest",
+      enc(PutCommitSlotRequest{"s", "k", 0x34, {canon_cscrcs()}, 1, 0x345, canon_config(),
+                               "tag"}));
+  add("PutCommitSlotResponse", enc(PutCommitSlotResponse{ErrorCode::OK, {canon_slot()}}));
+  add("PutInlineRequest", enc(PutInlineRequest{"k", canon_config(), 0x56, "data"}));
+  add("PutInlineResponse", enc(PutInlineResponse{ErrorCode::OK}));
+  add("PingRequest", enc(PingRequest{3}));
+  add("PingResponse", enc(PingResponse{11, 3}));
+  return rows;
+}
+
+// Locates native/tests/wire_golden.txt from the test binary's location
+// (build/ or build/{tsan,asan}/) or the repo-root cwd; BTPU_WIRE_GOLDEN
+// overrides.
+std::string golden_path() {
+  if (const char* env = ::getenv("BTPU_WIRE_GOLDEN")) return env;
+  std::vector<std::string> candidates = {"native/tests/wire_golden.txt"};
+  char exe[4096];
+  const ssize_t n = ::readlink("/proc/self/exe", exe, sizeof(exe) - 1);
+  if (n > 0) {
+    exe[n] = '\0';
+    std::string dir(exe);
+    dir = dir.substr(0, dir.find_last_of('/'));
+    candidates.push_back(dir + "/../native/tests/wire_golden.txt");
+    candidates.push_back(dir + "/../../native/tests/wire_golden.txt");
+  }
+  for (const auto& c : candidates) {
+    if (std::ifstream(c).good()) return c;
+  }
+  return candidates.front();
+}
+
+}  // namespace
+
+// Regen entry point (main.cpp --dump-wire-golden): prints the current table.
+int btpu_dump_wire_golden() {
+  std::printf("# Wire layout golden table — encoded bytes of one canonical instance per\n");
+  std::printf("# wire struct (native/tests/test_wire_layout.cpp). Regenerate with\n");
+  std::printf("# `make wire-golden` ONLY for append-only changes; any other diff here\n");
+  std::printf("# is a wire-compat break. Format: <name> <hex|- >\n");
+  for (const auto& [name, h] : golden_rows()) std::printf("%s %s\n", name.c_str(), h.c_str());
+  return 0;
+}
+
+BTEST(Wire, GoldenLayoutTable) {
+  const std::string path = golden_path();
+  std::ifstream in(path);
+  BT_ASSERT(in.good());
+
+  std::map<std::string, std::string> want;
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    const auto sp = line.find(' ');
+    BT_ASSERT(sp != std::string::npos);
+    want[line.substr(0, sp)] = line.substr(sp + 1);
+  }
+
+  const auto rows = golden_rows();
+  // Every current struct must match its golden row byte-for-byte.
+  for (const auto& [name, h] : rows) {
+    auto it = want.find(name);
+    if (it == want.end()) {
+      btest::report_failure(__FILE__, __LINE__,
+                            "wire struct '" + name +
+                                "' missing from wire_golden.txt — run `make wire-golden` "
+                                "and review the diff as a wire-compat change");
+      continue;
+    }
+    if (it->second != h) {
+      btest::report_failure(
+          __FILE__, __LINE__,
+          "wire layout of '" + name + "' CHANGED\n    golden:  " + it->second +
+              "\n    current: " + h +
+              "\n  If this is an intentional append-only addition, regenerate with "
+              "`make wire-golden`; anything else breaks rolling upgrades and durable "
+              "coordinator records.");
+    }
+  }
+  // And no golden row may vanish (a deleted struct breaks old peers too).
+  for (const auto& [name, h] : want) {
+    bool found = false;
+    for (const auto& [n2, h2] : rows) found |= n2 == name;
+    if (!found) {
+      btest::report_failure(__FILE__, __LINE__,
+                            "golden row '" + name +
+                                "' no longer produced — wire structs must not disappear; "
+                                "run `make wire-golden` only if this removal is deliberate");
+    }
+  }
+}
+
+// The append-only contract itself: a tail-extended frame decodes (newer
+// peer), a truncated-at-field-boundary frame defaults the tail (older
+// peer). Guards the rule the golden table assumes.
+BTEST(Wire, GoldenTailTolerance) {
+  CopyPlacement c = canon_copy();
+  wire::Writer w;
+  wire::encode(w, c);
+  // Newer peer: append 4 unknown bytes INSIDE the struct body (the
+  // size-prefix covers them) — decode must skip them.
+  {
+    std::vector<uint8_t> bytes = w.buffer();
+    uint32_t body = 0;
+    std::memcpy(&body, bytes.data(), 4);
+    body += 4;
+    std::memcpy(bytes.data(), &body, 4);
+    bytes.insert(bytes.end(), {0xde, 0xad, 0xbe, 0xef});
+    CopyPlacement out;
+    wire::Reader r(bytes);
+    BT_EXPECT(wire::decode(r, out));
+    BT_EXPECT_EQ(out.cache_lease_ms, c.cache_lease_ms);
+  }
+  // Older peer: body truncated before the cache stamps — they default to 0.
+  {
+    std::vector<uint8_t> bytes = w.buffer();
+    // Re-encode without the last three fields by shrinking the body to the
+    // inline_data boundary: compute it by encoding a copy of the prefix.
+    wire::Writer prefix;
+    wire::encode_struct(prefix, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
+                        c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data);
+    CopyPlacement out;
+    wire::Reader r(prefix.buffer());
+    BT_EXPECT(wire::decode(r, out));
+    BT_EXPECT_EQ(out.inline_data, c.inline_data);
+    BT_EXPECT_EQ(out.cache_version, 0u);
+    BT_EXPECT_EQ(out.cache_gen, 0u);
+    BT_EXPECT_EQ(out.cache_lease_ms, 0u);
+  }
+}

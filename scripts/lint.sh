@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Static lint gate (make lint):
+#   1. clang -fsyntax-only -Wthread-safety -Werror sweep over every native
+#      source — the machine check behind the GUARDED_BY/REQUIRES annotations
+#      in btpu/common/thread_annotations.h. Skipped WITH A NOTICE when clang
+#      is not installed (gcc has no equivalent analysis; the annotations
+#      compile to no-ops there).
+#   2. python -m compileall over blackbird_tpu/ and tests/ so syntax rot in
+#      the bindings fails the gate even on machines that never import them.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+# ---- clang thread-safety sweep --------------------------------------------
+CLANG="${CLANG:-}"
+if [ -z "${CLANG}" ]; then
+  for cand in clang++ clang++-21 clang++-20 clang++-19 clang++-18 clang++-17 \
+              clang++-16 clang++-15 clang++-14; do
+    if command -v "$cand" > /dev/null 2>&1; then CLANG="$cand"; break; fi
+  done
+fi
+
+if [ -z "${CLANG}" ]; then
+  if [ "${BTPU_REQUIRE_CLANG:-0}" = "1" ]; then
+    echo "lint: FAIL — BTPU_REQUIRE_CLANG=1 but clang not found" >&2
+    fail=1
+  else
+    echo "lint: NOTICE — clang not found; skipping the -Wthread-safety sweep" >&2
+    echo "lint:          (annotations still compile as no-ops under gcc;" >&2
+    echo "lint:          install clang to machine-check the lock discipline)" >&2
+  fi
+else
+  echo "lint: ${CLANG} -Wthread-safety sweep over native/"
+  srcs=$(find native/src native/exe native/tests examples -name '*.cpp' | sort)
+  for src in $srcs; do
+    # -fsyntax-only: the analysis runs in the frontend; no objects are
+    # written, so the sweep is fast and needs no link environment.
+    if ! "${CLANG}" -std=c++20 -fsyntax-only -Inative/include -Inative/tests \
+         -Wall -Wextra -Wno-unused-parameter \
+         -Wthread-safety -Werror=thread-safety "$src"; then
+      echo "lint: FAIL ${src}" >&2
+      fail=1
+    fi
+  done
+  [ "$fail" -eq 0 ] && echo "lint: thread-safety sweep clean"
+fi
+
+# ---- python bytecode lint --------------------------------------------------
+PY="${PYTHON:-python3}"
+if command -v "$PY" > /dev/null 2>&1; then
+  echo "lint: ${PY} -m compileall blackbird_tpu/ tests/ bench.py"
+  if ! "$PY" -m compileall -q blackbird_tpu tests bench.py; then
+    echo "lint: FAIL — python sources do not byte-compile" >&2
+    fail=1
+  fi
+else
+  echo "lint: NOTICE — python3 not found; skipping compileall" >&2
+fi
+
+exit "$fail"

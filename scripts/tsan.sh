@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
-# One-command ThreadSanitizer leg: builds the native tree under
-# -fsanitize=thread (separate build/tsan object tree) and runs the
-# concurrency-heavy suites — the client object cache and the transports.
-# Extra suites: TSAN_FILTERS="Cache Transport EndToEnd" scripts/tsan.sh
+# One-command ThreadSanitizer leg: builds the native tree (plus bb-soak)
+# under -fsanitize=thread into a separate build/tsan object tree and runs
+# the FULL native suite — all 25 suites, not just the concurrency-heavy
+# ones (PR 3 widened this from "Cache Transport").
+# Narrow when iterating: TSAN_FILTERS="Cache Transport" scripts/tsan.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec make tsan ${TSAN_FILTERS:+TSAN_FILTERS="${TSAN_FILTERS}"}

@@ -237,14 +237,25 @@ int main(int argc, char** argv) {
     constexpr int kOpsPerCycle = 4;  // put_start, get_workers, put_cancel, exists
     const double ops_per_sec =
         static_cast<double>(total_cycles.load()) * kOpsPerCycle / wall_s;
+    // Shard count + cpu count ride along so the scaling row is
+    // interpretable: ops/s x4 vs x1 only means something relative to how
+    // many cores the box can actually run threads on, and which shard
+    // layout the keystone resolved (BTPU_KEYSTONE_SHARDS / auto).
+    const size_t shards = cluster ? cluster->keystone().metadata_shard_count() : 0;
+    const unsigned cpus = std::thread::hardware_concurrency();
     if (json) {
       std::printf(
           "{\"op\": \"meta\", \"threads\": %d, \"ops_per_sec\": %.0f, "
-          "\"cycle_p50_us\": %.1f, \"cycle_p99_us\": %.1f}\n",
-          threads, ops_per_sec, percentile(merged, 50), percentile(merged, 99));
+          "\"cycle_p50_us\": %.1f, \"cycle_p99_us\": %.1f, \"shards\": %zu, "
+          "\"cpus\": %u}\n",
+          threads, ops_per_sec, percentile(merged, 50), percentile(merged, 99), shards,
+          cpus);
     } else {
-      std::printf("meta x%d threads: %.0f ops/s (4-op cycle p50 %.1f us p99 %.1f us)\n",
-                  threads, ops_per_sec, percentile(merged, 50), percentile(merged, 99));
+      std::printf(
+          "meta x%d threads: %.0f ops/s (4-op cycle p50 %.1f us p99 %.1f us, "
+          "%zu shards, %u cpus)\n",
+          threads, ops_per_sec, percentile(merged, 50), percentile(merged, 99), shards,
+          cpus);
     }
     return 0;
   }

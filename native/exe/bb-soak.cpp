@@ -33,8 +33,7 @@ namespace {
 // Deterministic per-key payload: verification needs no stored bytes.
 std::vector<uint8_t> pattern_for(const std::string& key, uint64_t size) {
   std::vector<uint8_t> data(size);
-  uint64_t h = 1469598103934665603ull;
-  for (char ch : key) h = (h ^ static_cast<uint8_t>(ch)) * 1099511628211ull;
+  uint64_t h = fnv1a64(key);
   for (uint64_t i = 0; i < size; ++i) {
     h = h * 6364136223846793005ull + 1442695040888963407ull;
     data[i] = static_cast<uint8_t>(h >> 56);

@@ -59,13 +59,36 @@ class RangeAllocator : public IAllocator {
     std::vector<std::pair<MemoryPoolId, Range>> ranges;
     uint64_t total_size{0};
   };
-  // Lock order: pools_mutex_ before allocations_mutex_ (free/adopt/release
-  // hoist a pool snapshot, then splice the allocation map).
-  mutable SharedMutex allocations_mutex_ BTPU_ACQUIRED_AFTER(pools_mutex_);
-  std::unordered_map<ObjectKey, ObjectAllocation> object_allocations_
-      BTPU_GUARDED_BY(allocations_mutex_);
+  // The allocation map is lock-striped by object key (FNV-1a, same family
+  // as the keystone's object shards): commit/free on distinct keys never
+  // serialize on one map-wide mutex, which is what lets the keystone's
+  // sharded put_start/put_cancel paths scale through the allocator.
+  // Lock order: pools_mutex_ before any alloc_shards_[i].mutex (free/adopt/
+  // release hoist a pool view, then splice the allocation map). At most one
+  // allocation shard is held at a time; the two-key ops (rename/merge)
+  // transfer ownership — extract under the source shard, insert under the
+  // destination — instead of nesting (their callers own both keys, see the
+  // definitions).
+  static constexpr size_t kAllocShards = 16;
+  struct AllocShard {
+    mutable SharedMutex mutex;
+    std::unordered_map<ObjectKey, ObjectAllocation> map BTPU_GUARDED_BY(mutex);
+  };
+  AllocShard alloc_shards_[kAllocShards];
+  static size_t alloc_shard_index(const ObjectKey& key) noexcept {
+    return static_cast<size_t>(fnv1a64(key) % kAllocShards);
+  }
+  AllocShard& alloc_shard_for(const ObjectKey& key) {
+    return alloc_shards_[alloc_shard_index(key)];
+  }
+  const AllocShard& alloc_shard_for(const ObjectKey& key) const {
+    return alloc_shards_[alloc_shard_index(key)];
+  }
 
   ErrorCode ensure_pool_allocator(const MemoryPool& pool);
+  // Fast path for allocate(): one shared probe confirms every pool already
+  // has its allocator (the common case) before any exclusive lock is taken.
+  ErrorCode ensure_pool_allocators(const PoolMap& pools);
   std::vector<MemoryPoolId> select_candidate_pools(const AllocationRequest& request,
                                                    const PoolMap& pools) const;
   // Live free space for a pool: the pool allocator's view when it exists

@@ -33,6 +33,20 @@ using Version = uint64_t;
 using ViewVersionId = int64_t;
 using LeaseId = int64_t;
 
+// FNV-1a 64-bit: THE key-hash family for every lock-striped map keyed by
+// object key (keystone object shards, allocator allocation shards). One
+// definition so the "same family" relationship those maps document is
+// enforced, and stable across processes/boots by construction — persisted
+// records must re-shard identically, and no seed may leak layout.
+inline uint64_t fnv1a64(const std::string& bytes) noexcept {
+  uint64_t h = 1469598103934665603ull;
+  for (const char c : bytes) {
+    h ^= static_cast<uint8_t>(c);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
 // -------------------------------------------------------------------------
 // Constants (reference types.h:69-74)
 // -------------------------------------------------------------------------
@@ -548,6 +562,14 @@ struct KeystoneConfig {
   // restart recovers the object map (the reference forgets all objects on
   // restart, SURVEY §5 checkpoint/resume). No-op without a coordinator.
   bool persist_objects{true};
+
+  // Object-map shard count (lock striping): single-key metadata ops lock
+  // exactly one shard, so control-plane throughput scales with cores
+  // instead of serializing on one map-wide mutex. 0 = auto: the
+  // BTPU_KEYSTONE_SHARDS env var when set, else min(hw_concurrency, 16).
+  // Resolved once at service construction and clamped to [1, 256];
+  // KeystoneService::metadata_shard_count() reports the value in effect.
+  uint32_t metadata_shards{0};
 
   // Loads a YAML config file (subset grammar, see config.h). Throws
   // std::runtime_error on parse/validation failure like the reference

@@ -53,6 +53,36 @@ bool decode_worker_info(const std::string& bytes, WorkerInfo& out);
 std::string encode_pool_record(const MemoryPool& pool);
 bool decode_pool_record(const std::string& bytes, MemoryPool& out);
 
+// Relaxed-atomic steady_clock stamp: get_workers touches last_access on
+// every read, and making that touch atomic is what lets reads hold the
+// object shard SHARED (a reader-parallel hot path) instead of exclusively.
+// Copyable so ObjectInfo keeps value semantics (snapshot/restore paths);
+// store() is const because an LRU touch is logically non-mutating state.
+class AtomicAccessStamp {
+ public:
+  using TimePoint = std::chrono::steady_clock::time_point;
+  AtomicAccessStamp() = default;
+  AtomicAccessStamp(const AtomicAccessStamp& other)
+      : rep_(other.rep_.load(std::memory_order_relaxed)) {}
+  AtomicAccessStamp& operator=(const AtomicAccessStamp& other) {
+    rep_.store(other.rep_.load(std::memory_order_relaxed), std::memory_order_relaxed);
+    return *this;
+  }
+  AtomicAccessStamp& operator=(TimePoint tp) {
+    store(tp);
+    return *this;
+  }
+  TimePoint load() const {
+    return TimePoint(TimePoint::duration(rep_.load(std::memory_order_relaxed)));
+  }
+  void store(TimePoint tp) const {
+    rep_.store(tp.time_since_epoch().count(), std::memory_order_relaxed);
+  }
+
+ private:
+  mutable std::atomic<TimePoint::duration::rep> rep_{0};
+};
+
 struct ObjectInfo {
   uint64_t size{0};
   uint64_t ttl_ms{0};
@@ -60,7 +90,7 @@ struct ObjectInfo {
   ObjectState state{ObjectState::kPending};
   WorkerConfig config;  // original placement policy (needed for repair)
   std::chrono::steady_clock::time_point created_at;
-  std::chrono::steady_clock::time_point last_access;
+  AtomicAccessStamp last_access;
   std::vector<CopyPlacement> copies;
   // Monotonic placement revision (process-local, from a keystone-wide
   // counter; bumped on every copies mutation and fresh on every create).
@@ -225,6 +255,9 @@ class KeystoneService {
   const KeystoneConfig& config() const noexcept { return config_; }
   const KeystoneCounters& counters() const noexcept { return counters_; }
   bool is_leader() const noexcept { return is_leader_.load(); }
+  // Resolved object-map shard count (config/$BTPU_KEYSTONE_SHARDS/auto —
+  // see KeystoneConfig::metadata_shards). Fixed for the service lifetime.
+  size_t metadata_shard_count() const noexcept { return shard_count_; }
 
   // Exposed for tests/ops: run one GC / health sweep synchronously.
   void run_gc_once();
@@ -252,8 +285,8 @@ class KeystoneService {
   // (its prior removal already published), so puts stay zero-overhead.
   // Best-effort: clients that miss an event (severed watch) are bounded by
   // their lease TTL + version revalidation. TTL'd value; fine to call with
-  // or without objects_mutex_ held (watch callbacks never re-enter the
-  // keystone).
+  // or without an object-shard mutex held (watch callbacks never re-enter
+  // the keystone).
   void publish_cache_invalidation(const ObjectKey& key, uint64_t version);
 
   ErrorCode setup_coordinator_integration();
@@ -344,7 +377,7 @@ class KeystoneService {
   // Demotion: move an object's bytes out of the pressured tier `from` into
   // the nearest lower tier with capacity (ladder order per tier_rank, capped
   // at HDD — CUSTOM/unspecified pools are never an eviction backstop), over
-  // the data plane. The transfer runs WITHOUT objects_mutex_ held: the new
+  // the data plane. The transfer runs WITHOUT any shard mutex held: the new
   // placement is staged under a temporary allocator key while the old ranges
   // stay live, then swapped in under the lock only if the object did not
   // change in the meantime (wire-encoded placement fingerprint).
@@ -359,8 +392,26 @@ class KeystoneService {
   void evict_for_pressure();
   double tier_utilization(std::optional<StorageClass> cls) const;
 
-  ErrorCode free_object_locked(const ObjectKey& key, ObjectInfo& info)
-      BTPU_REQUIRES(objects_mutex_);
+  // One lock-striped shard of the object map. The map field is guarded by
+  // the SHARD's own mutex; clang's analysis resolves `s.map` against
+  // `s.mutex` through the local reference, so every access point is still
+  // machine-checked (take the reference ONCE per scope — two aliases to the
+  // same shard defeat the textual matching).
+  struct ObjectShard {
+    mutable SharedMutex mutex;
+    std::unordered_map<ObjectKey, ObjectInfo> map BTPU_GUARDED_BY(mutex);
+  };
+
+  // Stable key -> shard mapping (FNV-1a, process-independent): persisted
+  // records re-hash identically on every boot, and remote clients cannot
+  // observe the shard layout at all.
+  size_t shard_index(const ObjectKey& key) const noexcept {
+    return static_cast<size_t>(fnv1a64(key) % shard_count_);
+  }
+  ObjectShard& shard_for(const ObjectKey& key) const { return shards_[shard_index(key)]; }
+
+  ErrorCode free_object_locked(ObjectShard& shard, const ObjectKey& key, ObjectInfo& info)
+      BTPU_REQUIRES(shard.mutex);
 
   KeystoneConfig config_;
   std::shared_ptr<coord::Coordinator> coordinator_;
@@ -368,17 +419,29 @@ class KeystoneService {
   std::unique_ptr<transport::TransportClient> data_client_;  // for repair
 
   // Keystone lock order (outermost first; see docs/CORRECTNESS.md):
-  //   drain_mutex_ -> objects_mutex_ -> {registry_mutex_, readopt_checks_mutex_}
-  // registry_mutex_ and objects_mutex_ are normally taken in SEPARATE scopes
-  // (snapshot the registry, release, then splice objects); the one place
-  // they nest is the repair path, which consults offline_pools_ (registry,
-  // shared) while splicing placements (objects, exclusive) — so when nested,
-  // objects comes FIRST. The annotations let clang flag any new path that
-  // inverts this.
-  mutable SharedMutex objects_mutex_;
-  std::unordered_map<ObjectKey, ObjectInfo> objects_ BTPU_GUARDED_BY(objects_mutex_);
+  //   drain_mutex_ -> shards_[i].mutex -> {registry_mutex_,
+  //                                        readopt_checks_mutex_,
+  //                                        persist_retry_mutex_,
+  //                                        allocator internals}
+  // Shard discipline: AT MOST ONE shard mutex is ever held at a time.
+  // Single-key ops lock exactly their key's shard; multi-key walks (GC,
+  // eviction scan, listing, scrub, drain/repair passes, remove_all) visit
+  // shards strictly in ascending index order, releasing each before the
+  // next. Cross-shard moves (put_commit_slot's slot -> final key) transfer
+  // OWNERSHIP instead of nesting: the entry is extracted under the source
+  // shard's lock, then inserted under the destination's — no thread can
+  // double-claim the extracted entry, and no two shard locks ever nest.
+  // clang's analysis cannot encode ordering edges over a dynamic mutex
+  // array, so the per-shard position in the hierarchy is enforced by this
+  // convention (the static edges below still pin drain -> registry/readopt);
+  // registry_mutex_ and a shard mutex are normally taken in SEPARATE scopes
+  // (snapshot the registry, release, then splice objects); where they nest
+  // (repair consults offline_pools_ while splicing placements) the SHARD
+  // comes FIRST.
+  size_t shard_count_{1};
+  std::unique_ptr<ObjectShard[]> shards_;
 
-  mutable SharedMutex registry_mutex_ BTPU_ACQUIRED_AFTER(objects_mutex_);
+  mutable SharedMutex registry_mutex_ BTPU_ACQUIRED_AFTER(drain_mutex_);
   std::unordered_map<NodeId, WorkerInfo> workers_ BTPU_GUARDED_BY(registry_mutex_);
   alloc::PoolMap pools_ BTPU_GUARDED_BY(registry_mutex_);
 
@@ -403,7 +466,8 @@ class KeystoneService {
   std::atomic<uint32_t> promotion_refusals_{0};  // streak; reset on success
   // Set by fence_stepdown(): on_demoted() must run (drop this node's own
   // never-persisted pending objects), but the fenced op's caller holds
-  // objects_mutex_, so the cleanup is deferred to the keepalive thread.
+  // an object-shard mutex, so the cleanup is deferred to the keepalive
+  // thread.
   std::atomic<bool> pending_demote_cleanup_{false};
   std::atomic<bool> running_{false};
   std::atomic<bool> is_leader_{false};
@@ -458,10 +522,12 @@ class KeystoneService {
     // raced a pool bounce could condemn bytes the second adoption restored.
     uint64_t seq{0};
   };
-  Mutex readopt_checks_mutex_ BTPU_ACQUIRED_AFTER(objects_mutex_);
+  Mutex readopt_checks_mutex_ BTPU_ACQUIRED_AFTER(drain_mutex_);
   std::vector<ReadoptCheck> readopt_checks_ BTPU_GUARDED_BY(readopt_checks_mutex_);
-  // Latest adoption sequence per pool (written while ALSO under
-  // objects_mutex_ so checkers holding either see a stable value).
+  // Latest adoption sequence per pool. Adoptions stamp their seq BEFORE
+  // rewriting any placement; checkers read it under readopt_checks_mutex_
+  // while holding their key's shard lock — see readopt_offline_pool for
+  // the ordering argument.
   std::unordered_map<MemoryPoolId, uint64_t> readopt_seq_ BTPU_GUARDED_BY(readopt_checks_mutex_);
   std::atomic<uint64_t> readopt_seq_counter_{0};
   // Objects whose bytes moved over the device fabric without the staged

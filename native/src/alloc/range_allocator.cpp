@@ -50,6 +50,15 @@ std::vector<MemoryPoolId> interleave_nodes(const NodeGroups& g) {
 }  // namespace
 
 ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
+  {
+    // Double-checked: the common case (allocator already exists) must not
+    // take the exclusive pools lock — that would re-serialize EVERY
+    // allocation behind a single writer mutex and undo the keystone's
+    // control-plane sharding.
+    SharedLock lock(pools_mutex_);
+    const auto& allocators = pool_allocators_;
+    if (allocators.contains(pool.id)) return ErrorCode::OK;
+  }
   WriterLock lock(pools_mutex_);
   if (pool_allocators_.contains(pool.id)) return ErrorCode::OK;
   try {
@@ -64,6 +73,25 @@ ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
     LOG_ERROR << "pool " << pool.id << ": " << e.what();
     return ErrorCode::INTERNAL_ERROR;
   }
+}
+
+ErrorCode RangeAllocator::ensure_pool_allocators(const PoolMap& pools) {
+  {
+    SharedLock lock(pools_mutex_);
+    const auto& allocators = pool_allocators_;
+    bool missing = false;
+    for (const auto& [id, pool] : pools) {
+      if (!allocators.contains(id)) {
+        missing = true;
+        break;
+      }
+    }
+    if (!missing) return ErrorCode::OK;
+  }
+  for (const auto& [id, pool] : pools) {
+    BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
+  }
+  return ErrorCode::OK;
 }
 
 uint64_t RangeAllocator::avail_of(const MemoryPoolId& id, const MemoryPool& pool) const {
@@ -106,13 +134,32 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
     preferred.push_back(id);
   }
 
+  // One availability snapshot for ranking AND the w-search below, taken
+  // under a single shared pools lock: the old per-candidate avail_of calls
+  // paid 2+ shared-mutex acquisitions per pool per allocation, which adds
+  // up at control-plane rates. The snapshot is equally racy either way —
+  // commit detects a stale choice when the pool allocator refuses the carve
+  // and the whole request rolls back.
+  std::unordered_map<MemoryPoolId, uint64_t> avail;
+  {
+    SharedLock lock(pools_mutex_);
+    const auto& allocators = pool_allocators_;
+    auto snapshot = [&](const std::vector<MemoryPoolId>& v) {
+      for (const auto& id : v) {
+        auto it = allocators.find(id);
+        avail.emplace(id, it != allocators.end() ? it->second->total_free()
+                                                 : pools.at(id).available());
+      }
+    };
+    avail.reserve(preferred.size() + fallback.size());
+    snapshot(preferred);
+    snapshot(fallback);
+  }
+
   auto rank = [&](std::vector<MemoryPoolId>& v) {
-    // Snapshot availability BEFORE sorting: concurrent allocations mutate
+    // The snapshot is taken BEFORE sorting: concurrent allocations mutate
     // per-pool free space, and a comparator whose keys change mid-sort
     // violates strict weak ordering — UB that can corrupt the vector.
-    std::unordered_map<MemoryPoolId, uint64_t> avail;
-    avail.reserve(v.size());
-    for (const auto& id : v) avail.emplace(id, avail_of(id, pools.at(id)));
     std::sort(v.begin(), v.end(), [&](const MemoryPoolId& a, const MemoryPoolId& b) {
       if (request.preferred_slice >= 0) {
         const bool sa = pools.at(a).topo.slice_id == request.preferred_slice;
@@ -159,11 +206,11 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
     selected.reserve(w);
     for (const auto& id : preferred) {
       if (selected.size() == w) break;
-      if (avail_of(id, pools.at(id)) >= per_pool) selected.push_back(id);
+      if (avail.at(id) >= per_pool) selected.push_back(id);
     }
     for (const auto& id : fallback) {
       if (selected.size() == w) break;
-      if (avail_of(id, pools.at(id)) >= per_pool) selected.push_back(id);
+      if (avail.at(id) >= per_pool) selected.push_back(id);
     }
     if (selected.size() == w) return selected;
     if (w == 1) break;
@@ -180,9 +227,7 @@ Result<AllocationResult> RangeAllocator::allocate(const AllocationRequest& reque
        request.ec_data_shards + request.ec_parity_shards > ec::kMaxTotalShards))
     return ErrorCode::INVALID_PARAMETERS;
 
-  for (const auto& [id, pool] : pools) {
-    BTPU_RETURN_IF_ERROR(ensure_pool_allocator(pool));
-  }
+  BTPU_RETURN_IF_ERROR(ensure_pool_allocators(pools));
 
   auto candidates = select_candidate_pools(request, pools);
   if (candidates.empty()) {
@@ -466,8 +511,9 @@ Result<ShardPlacement> RangeAllocator::create_shard_placement(const MemoryPoolId
 
 ErrorCode RangeAllocator::commit_allocation(
     const ObjectKey& key, const std::vector<std::pair<MemoryPoolId, Range>>& ranges) {
-  WriterLock lock(allocations_mutex_);
-  if (object_allocations_.contains(key)) {
+  AllocShard& s = alloc_shard_for(key);
+  WriterLock lock(s.mutex);
+  if (s.map.contains(key)) {
     LOG_WARN << "object " << key << " already has an allocation";
     return ErrorCode::OBJECT_ALREADY_EXISTS;
   }
@@ -476,7 +522,7 @@ ErrorCode RangeAllocator::commit_allocation(
   alloc.total_size = std::accumulate(
       ranges.begin(), ranges.end(), uint64_t{0},
       [](uint64_t sum, const auto& pr) { return sum + pr.second.length; });
-  object_allocations_[key] = std::move(alloc);
+  s.map[key] = std::move(alloc);
   return ErrorCode::OK;
 }
 
@@ -521,37 +567,73 @@ ErrorCode RangeAllocator::adopt_allocation(
   return ErrorCode::OK;
 }
 
+// Two-key ops (rename/merge) transfer OWNERSHIP between shards rather than
+// nesting two shard locks: the entry is extracted under the source shard,
+// re-inserted under the destination, and put back if the destination check
+// fails. The transient not-in-either-map window is safe because every
+// caller OWNS both keys for the duration (slot commits own their slot key
+// and the not-yet-published final key; movers own their '\x01'-staging
+// keys) — nothing else can legitimately address them mid-op.
 ErrorCode RangeAllocator::rename_object(const ObjectKey& from, const ObjectKey& to) {
-  WriterLock lock(allocations_mutex_);
-  auto it = object_allocations_.find(from);
-  if (it == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
-  if (object_allocations_.contains(to)) return ErrorCode::OBJECT_ALREADY_EXISTS;
-  object_allocations_[to] = std::move(it->second);
-  object_allocations_.erase(it);
-  return ErrorCode::OK;
+  ObjectAllocation moved;
+  {
+    AllocShard& s = alloc_shard_for(from);
+    WriterLock lock(s.mutex);
+    auto it = s.map.find(from);
+    if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
+    moved = std::move(it->second);
+    s.map.erase(it);
+  }
+  {
+    AllocShard& s = alloc_shard_for(to);
+    WriterLock lock(s.mutex);
+    if (!s.map.contains(to)) {
+      s.map[to] = std::move(moved);
+      return ErrorCode::OK;
+    }
+  }
+  AllocShard& s = alloc_shard_for(from);
+  WriterLock lock(s.mutex);
+  s.map[from] = std::move(moved);
+  return ErrorCode::OBJECT_ALREADY_EXISTS;
 }
 
 ErrorCode RangeAllocator::merge_objects(const ObjectKey& from, const ObjectKey& to) {
-  WriterLock lock(allocations_mutex_);
-  auto src = object_allocations_.find(from);
-  if (src == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
-  auto dst = object_allocations_.find(to);
-  if (dst == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
-  dst->second.ranges.insert(dst->second.ranges.end(),
-                            std::make_move_iterator(src->second.ranges.begin()),
-                            std::make_move_iterator(src->second.ranges.end()));
-  dst->second.total_size += src->second.total_size;
-  object_allocations_.erase(src);
-  return ErrorCode::OK;
+  ObjectAllocation src;
+  {
+    AllocShard& s = alloc_shard_for(from);
+    WriterLock lock(s.mutex);
+    auto it = s.map.find(from);
+    if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
+    src = std::move(it->second);
+    s.map.erase(it);
+  }
+  {
+    AllocShard& s = alloc_shard_for(to);
+    WriterLock lock(s.mutex);
+    auto dst = s.map.find(to);
+    if (dst != s.map.end()) {
+      dst->second.ranges.insert(dst->second.ranges.end(),
+                                std::make_move_iterator(src.ranges.begin()),
+                                std::make_move_iterator(src.ranges.end()));
+      dst->second.total_size += src.total_size;
+      return ErrorCode::OK;
+    }
+  }
+  AllocShard& s = alloc_shard_for(from);
+  WriterLock lock(s.mutex);
+  s.map[from] = std::move(src);
+  return ErrorCode::OBJECT_NOT_FOUND;
 }
 
 ErrorCode RangeAllocator::release_range(const ObjectKey& key, const MemoryPoolId& pool_id,
                                         const Range& range) {
-  // Lock order: pools before allocations, matching free()/get_stats.
+  // Lock order: pools before the allocation shard, matching free()/get_stats.
   SharedLock pools_lock(pools_mutex_);
-  WriterLock lock(allocations_mutex_);
-  auto it = object_allocations_.find(key);
-  if (it == object_allocations_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  AllocShard& s = alloc_shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
   auto& ranges = it->second.ranges;
   auto rit = std::find_if(ranges.begin(), ranges.end(),
                           [&](const std::pair<MemoryPoolId, Range>& pr) {
@@ -567,9 +649,10 @@ ErrorCode RangeAllocator::release_range(const ObjectKey& key, const MemoryPoolId
 }
 
 void RangeAllocator::remove_pool_ranges(const ObjectKey& key, const MemoryPoolId& pool_id) {
-  WriterLock lock(allocations_mutex_);
-  auto it = object_allocations_.find(key);
-  if (it == object_allocations_.end()) return;
+  AllocShard& s = alloc_shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return;
   auto& ranges = it->second.ranges;
   uint64_t dropped = 0;
   ranges.erase(std::remove_if(ranges.begin(), ranges.end(),
@@ -583,12 +666,13 @@ void RangeAllocator::remove_pool_ranges(const ObjectKey& key, const MemoryPoolId
 }
 
 ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
-  // Lock order: pools before allocations, matching get_stats (verified by
-  // TSan: the reverse order forms a cycle with the stats path).
+  // Lock order: pools before the allocation shard, matching get_stats
+  // (verified by TSan: the reverse order forms a cycle with the stats path).
   SharedLock pools_lock(pools_mutex_);
-  WriterLock lock(allocations_mutex_);
-  auto it = object_allocations_.find(object_key);
-  if (it == object_allocations_.end()) {
+  AllocShard& s = alloc_shard_for(object_key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(object_key);
+  if (it == s.map.end()) {
     LOG_DEBUG << "free of unknown object " << object_key;
     return ErrorCode::OBJECT_NOT_FOUND;
   }
@@ -598,13 +682,12 @@ ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
   }
   LOG_DEBUG << "freed object " << object_key << " (" << it->second.total_size << " bytes, "
             << it->second.ranges.size() << " ranges)";
-  object_allocations_.erase(it);
+  s.map.erase(it);
   return ErrorCode::OK;
 }
 
 AllocatorStats RangeAllocator::get_stats(std::optional<StorageClass> storage_class) const {
   SharedLock pools_lock(pools_mutex_);
-  SharedLock alloc_lock(allocations_mutex_);
 
   AllocatorStats stats{};
   for (const auto& [id, pa] : pool_allocators_) {
@@ -613,14 +696,21 @@ AllocatorStats RangeAllocator::get_stats(std::optional<StorageClass> storage_cla
     stats.total_free_bytes += free_bytes;
     stats.bytes_per_class[pa->storage_class()] += free_bytes;
   }
-  for (const auto& [key, alloc] : object_allocations_) {
-    stats.total_allocated_bytes += alloc.total_size;
-    stats.total_shards += alloc.ranges.size();
-    ++stats.total_objects;
-    for (const auto& [pool_id, range] : alloc.ranges) {
-      auto pa = pool_allocators_.find(pool_id);
-      if (pa != pool_allocators_.end())
-        stats.allocated_per_class[pa->second->storage_class()] += range.length;
+  // Allocation shards are folded one shared lock at a time (ascending):
+  // the result is per-shard-consistent, which is all a stats snapshot over
+  // a concurrently mutating allocator ever was.
+  for (size_t si = 0; si < kAllocShards; ++si) {
+    const AllocShard& s = alloc_shards_[si];
+    SharedLock alloc_lock(s.mutex);
+    for (const auto& [key, alloc] : s.map) {
+      stats.total_allocated_bytes += alloc.total_size;
+      stats.total_shards += alloc.ranges.size();
+      ++stats.total_objects;
+      for (const auto& [pool_id, range] : alloc.ranges) {
+        auto pa = pool_allocators_.find(pool_id);
+        if (pa != pool_allocators_.end())
+          stats.allocated_per_class[pa->second->storage_class()] += range.length;
+      }
     }
   }
   // Free-weighted mean fragmentation across pools (reference :215-254).

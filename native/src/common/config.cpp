@@ -354,6 +354,8 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
     cfg.enable_tier_demotion = n->bool_or(cfg.enable_tier_demotion);
   if (auto n = root.get("persist_objects"))
     cfg.persist_objects = n->bool_or(cfg.persist_objects);
+  if (auto n = root.get("metadata_shards"))
+    cfg.metadata_shards = static_cast<uint32_t>(n->int_or(cfg.metadata_shards));
 
   if (auto ec = cfg.validate(); ec != ErrorCode::OK) {
     throw std::runtime_error("invalid keystone config " + file_path + ": " +

@@ -1,6 +1,7 @@
 #include "btpu/keystone/keystone.h"
 
 #include <algorithm>
+#include <cstdlib>
 #include <optional>
 #include <random>
 #include <unordered_set>
@@ -16,6 +17,35 @@ namespace btpu::keystone {
 
 using coord::WatchEvent;
 
+namespace {
+// Shard-count resolution (KeystoneConfig::metadata_shards): explicit config
+// wins, then $BTPU_KEYSTONE_SHARDS, then min(hw_concurrency, 16). Clamped
+// to [1, 256] — a shard is two cache lines of mutex plus an empty map, so
+// over-provisioning is cheap, but an absurd count only fragments iteration.
+size_t resolve_shard_count(uint32_t configured) {
+  uint64_t n = configured;
+  if (n == 0) {
+    if (const char* env = std::getenv("BTPU_KEYSTONE_SHARDS")) {
+      char* end = nullptr;
+      const unsigned long long v = std::strtoull(env, &end, 10);
+      if (end && *end == '\0' && *env != '\0') {
+        n = v;
+      } else {
+        // An operator who pinned the count believes the pin took effect —
+        // falling back silently would have them debug the wrong layout.
+        LOG_WARN << "BTPU_KEYSTONE_SHARDS=\"" << env
+                 << "\" is not a number; using auto shard count";
+      }
+    }
+  }
+  if (n == 0) {
+    const unsigned hw = std::thread::hardware_concurrency();
+    n = std::min<uint64_t>(hw ? hw : 1, 16);
+  }
+  return static_cast<size_t>(std::clamp<uint64_t>(n, 1, 256));
+}
+}  // namespace
+
 // ---- lifecycle ------------------------------------------------------------
 
 KeystoneService::KeystoneService(KeystoneConfig config,
@@ -23,7 +53,9 @@ KeystoneService::KeystoneService(KeystoneConfig config,
     : config_(std::move(config)),
       coordinator_(std::move(coordinator)),
       adapter_(alloc::AllocatorFactory::create_range_based()),
-      data_client_(transport::make_transport_client()) {
+      data_client_(transport::make_transport_client()),
+      shard_count_(resolve_shard_count(config_.metadata_shards)),
+      shards_(std::make_unique<ObjectShard[]>(shard_count_)) {
   service_id_ = config_.service_id.empty()
                     ? config_.cluster_id + "-keystone-" + std::to_string(now_wall_ms())
                     : config_.service_id;
@@ -180,9 +212,10 @@ bool KeystoneService::on_promoted() {
   // (delete event lost with the old leader) still holds allocator ranges
   // that would otherwise conflict with re-applying valid records below.
   std::vector<ObjectKey> stale;
-  {
-    SharedLock lock(objects_mutex_);
-    for (const auto& [key, info] : objects_) {
+  for (size_t si = 0; si < shard_count_; ++si) {
+    ObjectShard& s = shards_[si];
+    SharedLock lock(s.mutex);
+    for (const auto& [key, info] : s.map) {
       if (!persisted.contains(key)) stale.push_back(key);
     }
   }
@@ -230,15 +263,18 @@ void KeystoneService::on_demoted() {
     persist_retry_.clear();
   }
   size_t dropped = 0;
-  WriterLock lock(objects_mutex_);
-  for (auto it = objects_.begin(); it != objects_.end();) {
-    if (it->second.state == ObjectState::kPending) {
-      if (it->second.slot) slot_objects_.fetch_sub(1);
-      adapter_.free_object(it->first);
-      it = objects_.erase(it);
-      ++dropped;
-    } else {
-      ++it;
+  for (size_t si = 0; si < shard_count_; ++si) {
+    ObjectShard& s = shards_[si];
+    WriterLock lock(s.mutex);
+    for (auto it = s.map.begin(); it != s.map.end();) {
+      if (it->second.state == ObjectState::kPending) {
+        if (it->second.slot) slot_objects_.fetch_sub(1);
+        adapter_.free_object(it->first);
+        it = s.map.erase(it);
+        ++dropped;
+      } else {
+        ++it;
+      }
     }
   }
   if (dropped) {
@@ -378,16 +414,18 @@ void KeystoneService::run_gc_once() {
     return at >= info.created_at + deadline;
   };
   std::vector<ObjectKey> expired;
-  {
-    SharedLock lock(objects_mutex_);
-    for (const auto& [key, info] : objects_) {
+  for (size_t si = 0; si < shard_count_; ++si) {
+    ObjectShard& s = shards_[si];
+    SharedLock lock(s.mutex);
+    for (const auto& [key, info] : s.map) {
       if (info.expired(now) || pending_stale(info, now)) expired.push_back(key);
     }
   }
   for (const auto& key : expired) {
-    WriterLock lock(objects_mutex_);
-    auto it = objects_.find(key);
-    if (it == objects_.end()) continue;
+    ObjectShard& s = shard_for(key);
+    WriterLock lock(s.mutex);
+    auto it = s.map.find(key);
+    if (it == s.map.end()) continue;
     const auto recheck = std::chrono::steady_clock::now();
     const bool stale_pending = pending_stale(it->second, recheck);
     if (!it->second.expired(recheck) && !stale_pending) continue;
@@ -395,8 +433,8 @@ void KeystoneService::run_gc_once() {
     // the promoted leader's record still references; retry next GC pass.
     if (unpersist_object(key) != ErrorCode::OK) continue;
     if (it->second.slot) slot_objects_.fetch_sub(1);
-    free_object_locked(key, it->second);
-    objects_.erase(it);
+    free_object_locked(s, key, it->second);
+    s.map.erase(it);
     if (stale_pending) {
       ++counters_.pending_reclaimed;
       LOG_WARN << "gc reclaimed abandoned pending put " << key;
@@ -438,8 +476,9 @@ void KeystoneService::run_health_check_once() {
 // ---- object API -----------------------------------------------------------
 
 Result<bool> KeystoneService::object_exists(const ObjectKey& key) {
-  SharedLock lock(objects_mutex_);
-  return objects_.contains(key);
+  const ObjectShard& s = shard_for(key);
+  SharedLock lock(s.mutex);
+  return s.map.contains(key);
 }
 
 Result<std::vector<ObjectSummary>> KeystoneService::list_objects(const std::string& prefix,
@@ -451,9 +490,14 @@ Result<std::vector<ObjectSummary>> KeystoneService::list_objects(const std::stri
     return a.key < b.key;
   };
   std::vector<ObjectSummary> out;
-  {
-    SharedLock lock(objects_mutex_);
-    for (const auto& [key, info] : objects_) {
+  // Shards are visited in ascending order, one shared lock at a time; the
+  // bounded heap is scan-order independent, so the listing stays O(n log k).
+  // The listing is per-shard-consistent, not a point-in-time snapshot of
+  // the whole map — same contract a prefix scan over any sharded store has.
+  for (size_t si = 0; si < shard_count_; ++si) {
+    const ObjectShard& s = shards_[si];
+    SharedLock lock(s.mutex);
+    for (const auto& [key, info] : s.map) {
       if (info.state != ObjectState::kComplete) continue;
       if (key.compare(0, prefix.size(), prefix) != 0) continue;
       if (limit != 0 && out.size() == limit) {
@@ -471,10 +515,14 @@ Result<std::vector<ObjectSummary>> KeystoneService::list_objects(const std::stri
 }
 
 Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey& key) {
-  WriterLock lock(objects_mutex_);  // touch mutates last_access
-  auto it = objects_.find(key);
-  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
-  it->second.last_access = std::chrono::steady_clock::now();
+  // Reads hold their shard SHARED: the LRU touch is a relaxed-atomic stamp
+  // (AtomicAccessStamp), so hot gets on one shard run reader-parallel and
+  // never serialize behind each other.
+  const ObjectShard& s = shard_for(key);
+  SharedLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  it->second.last_access.store(std::chrono::steady_clock::now());
   ++counters_.gets;
   auto copies = it->second.copies;
   // Cache-coherence grant, on the REPLY only (never the stored/persisted
@@ -492,9 +540,10 @@ Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey&
 
 std::pair<uint64_t, uint64_t> KeystoneService::object_cache_version(
     const ObjectKey& key) const {
-  SharedLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end() || it->second.state != ObjectState::kComplete) return {0, 0};
+  const ObjectShard& s = shard_for(key);
+  SharedLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end() || it->second.state != ObjectState::kComplete) return {0, 0};
   return {cache_gen_, it->second.epoch};
 }
 
@@ -540,8 +589,12 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   if (auto ec = normalize_put_config(effective); ec != ErrorCode::OK) return ec;
 
   TRACE_SPAN("keystone.put_start");
-  WriterLock lock(objects_mutex_);
-  if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
+  // One shard, held exclusively across check + allocate + insert: the
+  // duplicate-key check stays atomic per key, while puts on other shards
+  // allocate concurrently (the allocator has its own striped locking).
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  if (s.map.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
 
   const alloc::PoolMap pools_snapshot = allocatable_pools_snapshot();
   Result<std::vector<CopyPlacement>> placed = ErrorCode::INTERNAL_ERROR;
@@ -558,10 +611,11 @@ Result<std::vector<CopyPlacement>> KeystoneService::put_start(const ObjectKey& k
   info.soft_pin = effective.enable_soft_pin;
   info.config = effective;
   info.state = ObjectState::kPending;
-  info.created_at = info.last_access = std::chrono::steady_clock::now();
+  info.created_at = std::chrono::steady_clock::now();
+  info.last_access = info.created_at;
   info.copies = placed.value();
   info.epoch = next_epoch_.fetch_add(1);
-  objects_[key] = std::move(info);
+  s.map[key] = std::move(info);
   ++counters_.put_starts;
   bump_view();
   return placed;
@@ -571,9 +625,10 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
                                         const std::vector<CopyShardCrcs>& shard_crcs,
                                         uint32_t content_crc) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
   for (const auto& sc : shard_crcs) {
     for (auto& copy : it->second.copies) {
       if (copy.copy_index == sc.copy_index && copy.shards.size() == sc.crcs.size()) {
@@ -600,16 +655,17 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
 
 ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
   // Deletes fence FIRST: destroying worker ranges and only then discovering
   // the durable delete is rejected (deposed leader) would ack a removal the
   // promoted leader still lists — its metadata would point at freed bytes.
   if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
   if (it->second.slot) slot_objects_.fetch_sub(1);
-  free_object_locked(key, it->second);
-  objects_.erase(it);
+  free_object_locked(s, key, it->second);
+  s.map.erase(it);
   ++counters_.put_cancels;
   bump_view();
   return ErrorCode::OK;
@@ -641,8 +697,9 @@ ErrorCode KeystoneService::put_inline(const ObjectKey& key, const WorkerConfig& 
     inline_bytes_.fetch_sub(size);
     return ErrorCode::NOT_IMPLEMENTED;
   }
-  WriterLock lock(objects_mutex_);
-  if (objects_.contains(key)) {
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  if (s.map.contains(key)) {
     inline_bytes_.fetch_sub(size);
     return ErrorCode::OBJECT_ALREADY_EXISTS;
   }
@@ -652,19 +709,20 @@ ErrorCode KeystoneService::put_inline(const ObjectKey& key, const WorkerConfig& 
   info.soft_pin = config.enable_soft_pin;
   info.config = config;
   info.state = ObjectState::kComplete;
-  info.created_at = info.last_access = std::chrono::steady_clock::now();
+  info.created_at = std::chrono::steady_clock::now();
+  info.last_access = info.created_at;
   CopyPlacement copy;
   copy.copy_index = 0;
   copy.content_crc = content_crc;
   copy.inline_data = std::move(data);
   info.copies.push_back(std::move(copy));
   info.epoch = next_epoch_.fetch_add(1);
-  auto [it, inserted] = objects_.emplace(key, std::move(info));
+  auto [it, inserted] = s.map.emplace(key, std::move(info));
   (void)inserted;
   if (auto ec = persist_object(key, it->second); ec != ErrorCode::OK) {
     // Same fail-closed commit point as put_complete: no durable record, no
     // ack — and nothing to keep, since the bytes live nowhere else.
-    objects_.erase(it);
+    s.map.erase(it);
     inline_bytes_.fetch_sub(size);
     return ec;
   }
@@ -688,7 +746,6 @@ Result<std::vector<PutSlot>> KeystoneService::put_start_pooled(uint64_t size,
   count = std::min<uint32_t>(count, 16);
 
   TRACE_SPAN("keystone.put_start_pooled");
-  WriterLock lock(objects_mutex_);
   const alloc::PoolMap pools_snapshot = allocatable_pools_snapshot();
   std::vector<PutSlot> slots;
   for (uint32_t i = 0; i < count; ++i) {
@@ -710,10 +767,17 @@ Result<std::vector<PutSlot>> KeystoneService::put_start_pooled(uint64_t size,
     info.config = effective;
     info.state = ObjectState::kPending;
     info.slot = true;
-    info.created_at = info.last_access = std::chrono::steady_clock::now();
+    info.created_at = std::chrono::steady_clock::now();
+    info.last_access = info.created_at;
     info.copies = placed.value();
     info.epoch = next_epoch_.fetch_add(1);
-    objects_[slot_key] = std::move(info);
+    {
+      // Slot keys are unique (slot_seq_), so per-slot shard locking loses
+      // no atomicity — nothing can observe a half-granted batch by key.
+      ObjectShard& s = shard_for(slot_key);
+      WriterLock lock(s.mutex);
+      s.map[slot_key] = std::move(info);
+    }
     slots.push_back({std::move(slot_key), std::move(placed).value()});
   }
   counters_.slots_granted.fetch_add(slots.size());
@@ -730,23 +794,62 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
 
   TRACE_SPAN("keystone.put_commit_slot");
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(slot_key);
-  // Reclaimed (slot TTL) or minted by a previous leader: the client falls
-  // back to the two-RTT path on this code.
-  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
-  if (!it->second.slot || it->second.state != ObjectState::kPending)
-    return ErrorCode::INVALID_STATE;
-  if (objects_.contains(key)) return ErrorCode::OBJECT_ALREADY_EXISTS;
-  if (auto ec = adapter_.allocator().rename_object(slot_key, key); ec != ErrorCode::OK)
-    return ec;  // slot untouched; client falls back
+  // slot_key and key usually live in DIFFERENT shards. Instead of nesting
+  // two shard locks (which would need a global acquisition order the
+  // analysis cannot check), the commit transfers OWNERSHIP: the slot entry
+  // is extracted under its shard's lock — after which no concurrent
+  // commit/cancel/GC can double-claim it (they see OBJECT_NOT_FOUND, the
+  // documented fall-back code) — and inserted under the destination's.
+  // At most one shard mutex is held at any point.
+  ObjectInfo info;
+  {
+    ObjectShard& s = shard_for(slot_key);
+    WriterLock lock(s.mutex);
+    auto it = s.map.find(slot_key);
+    // Reclaimed (slot TTL) or minted by a previous leader: the client falls
+    // back to the two-RTT path on this code.
+    if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
+    if (!it->second.slot || it->second.state != ObjectState::kPending)
+      return ErrorCode::INVALID_STATE;
+    info = std::move(it->second);
+    s.map.erase(it);
+  }
+  // Reinstates the extracted slot intact (pending, unstamped) so the TTL
+  // reclaims it and the client's fallback finds a consistent world.
+  auto restore_slot = [&](ObjectInfo&& back) {
+    back.slot = true;
+    back.state = ObjectState::kPending;
+    for (auto& copy : back.copies) {
+      copy.content_crc = 0;
+      copy.shard_crcs.clear();
+    }
+    {
+      ObjectShard& s = shard_for(slot_key);
+      WriterLock lock(s.mutex);
+      s.map[slot_key] = std::move(back);
+    }
+    // The slot spent a window OUTSIDE any shard (ownership transfer): a
+    // demotion sweep that ran during that window could not see it, so a
+    // reinstated slot on a now-follower would outlive its term. Re-arm the
+    // deferred cleanup and the keepalive thread re-sweeps (on_demoted is
+    // idempotent; worst case on a re-promoted node is dropping a pending
+    // slot whose client takes the documented fallback).
+    if (!is_leader_.load()) pending_demote_cleanup_.store(true);
+  };
+  if (auto ec = adapter_.allocator().rename_object(slot_key, key); ec != ErrorCode::OK) {
+    // Covers the key-already-exists race too: the allocator tracks `key`
+    // whenever the object map does (OBJECT_ALREADY_EXISTS), and the final
+    // map check below backstops it. Client falls back.
+    restore_slot(std::move(info));
+    return ec;
+  }
 
-  ObjectInfo info = std::move(it->second);
   info.slot = false;
   info.state = ObjectState::kComplete;
   // TTL runs from the COMMIT, not from the slot grant — the object is born
   // now as far as its writer is concerned.
-  info.created_at = info.last_access = std::chrono::steady_clock::now();
+  info.created_at = std::chrono::steady_clock::now();
+  info.last_access = info.created_at;
   for (auto& copy : info.copies) copy.content_crc = content_crc;
   for (const auto& sc : shard_crcs) {
     for (auto& copy : info.copies) {
@@ -757,53 +860,59 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
     }
   }
   info.epoch = next_epoch_.fetch_add(1);
-  objects_.erase(it);
-  auto [fit, inserted] = objects_.emplace(key, std::move(info));
-  (void)inserted;
-  if (auto ec = persist_object(key, fit->second); ec != ErrorCode::OK) {
-    // Same fail-closed commit point as put_complete: the durable record
-    // never landed, so the commit must not ack. Roll the slot back intact
-    // (pending, unstamped) so the TTL reclaims it; the client falls back.
-    ObjectInfo back = std::move(fit->second);
-    objects_.erase(fit);
-    back.slot = true;
-    back.state = ObjectState::kPending;
-    for (auto& copy : back.copies) {
-      copy.content_crc = 0;
-      copy.shard_crcs.clear();
-    }
+
+  // Undo path shared by the duplicate-key and failed-persist branches:
+  // rename the allocation back and reinstate the slot; if even the
+  // back-rename fails, reclaim the allocation under the key the allocator
+  // actually tracks rather than leak the reserved ranges until restart.
+  auto roll_back = [&](ObjectInfo&& back, ErrorCode ec) {
     if (adapter_.allocator().rename_object(key, slot_key) != ErrorCode::OK) {
-      // Allocator bookkeeping is stuck under `key` with no object entry to
-      // match: reinstating the slot would leave its TTL reclaim freeing
-      // nothing while the reserved ranges leak until restart. Reclaim the
-      // allocation now, under the key the allocator actually tracks, and
-      // drop the slot — the client's fallback re-places from scratch.
       LOG_ERROR << "slot commit rollback: back-rename to " << slot_key
                 << " failed; freeing the allocation under " << key;
       adapter_.free_object(key);
       slot_objects_.fetch_sub(1);
       return ec;
     }
-    objects_[slot_key] = std::move(back);
+    restore_slot(std::move(back));
     return ec;
+  };
+  {
+    ObjectShard& s = shard_for(key);
+    WriterLock lock(s.mutex);
+    if (s.map.contains(key)) {
+      lock.unlock();
+      return roll_back(std::move(info), ErrorCode::OBJECT_ALREADY_EXISTS);
+    }
+    auto [fit, inserted] = s.map.emplace(key, std::move(info));
+    (void)inserted;
+    if (auto ec = persist_object(key, fit->second); ec != ErrorCode::OK) {
+      // Same fail-closed commit point as put_complete: the durable record
+      // never landed, so the commit must not ack. Roll the slot back so the
+      // TTL reclaims it; the client falls back.
+      ObjectInfo back = std::move(fit->second);
+      s.map.erase(fit);
+      lock.unlock();
+      return roll_back(std::move(back), ec);
+    }
+    ++counters_.put_completes;
+    ++counters_.slot_commits;
+    slot_objects_.fetch_sub(1);
+    bump_view();
   }
-  ++counters_.put_completes;
-  ++counters_.slot_commits;
-  slot_objects_.fetch_sub(1);
-  bump_view();
   return ErrorCode::OK;
 }
 
 ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
   // Same fence-first ordering as put_cancel (see comment there).
   if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
   if (it->second.slot) slot_objects_.fetch_sub(1);
-  free_object_locked(key, it->second);
-  objects_.erase(it);
+  free_object_locked(s, key, it->second);
+  s.map.erase(it);
   ++counters_.removes;
   bump_view();
   lock.unlock();
@@ -814,33 +923,38 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
 Result<uint64_t> KeystoneService::remove_all_objects() {
   if (!is_leader_.load()) return ErrorCode::NOT_LEADER;
   std::vector<ObjectKey> removed;
-  WriterLock lock(objects_mutex_);
   uint64_t count = 0;
-  for (auto it = objects_.begin(); it != objects_.end();) {
-    // Once deposed (first FENCED stepped us down) every further RPC is
-    // doomed — bail instead of round-tripping once per remaining object
-    // while holding the exclusive objects lock.
-    if (!is_leader_.load()) break;
-    // Fence-first per object; a failed durable delete keeps the object (the
-    // caller sees a partial count and can retry).
-    if (unpersist_object(it->first) != ErrorCode::OK) {
-      ++it;
-      continue;
+  for (size_t si = 0; si < shard_count_; ++si) {
+    ObjectShard& s = shards_[si];
+    WriterLock lock(s.mutex);
+    for (auto it = s.map.begin(); it != s.map.end();) {
+      // Once deposed (first FENCED stepped us down) every further RPC is
+      // doomed — bail instead of round-tripping once per remaining object
+      // while holding an exclusive shard lock.
+      if (!is_leader_.load()) break;
+      // Fence-first per object; a failed durable delete keeps the object
+      // (the caller sees a partial count and can retry).
+      if (unpersist_object(it->first) != ErrorCode::OK) {
+        ++it;
+        continue;
+      }
+      if (it->second.slot) slot_objects_.fetch_sub(1);
+      removed.push_back(it->first);
+      free_object_locked(s, it->first, it->second);
+      it = s.map.erase(it);
+      ++count;
     }
-    if (it->second.slot) slot_objects_.fetch_sub(1);
-    removed.push_back(it->first);
-    free_object_locked(it->first, it->second);
-    it = objects_.erase(it);
-    ++count;
+    if (!is_leader_.load()) break;
   }
   counters_.removes += count;
   bump_view();
-  lock.unlock();
   for (const auto& key : removed) publish_cache_invalidation(key, 0);
   return count;
 }
 
-ErrorCode KeystoneService::free_object_locked(const ObjectKey& key, ObjectInfo& info) {
+ErrorCode KeystoneService::free_object_locked(ObjectShard& shard, const ObjectKey& key,
+                                              ObjectInfo& info) {
+  (void)shard;  // the REQUIRES(shard.mutex) contract is what matters
   // Inline objects own no allocator ranges; their exit returns budget.
   if (!info.copies.empty() && !info.copies.front().inline_data.empty()) {
     inline_bytes_.fetch_sub(info.copies.front().inline_data.size());
@@ -904,15 +1018,20 @@ Result<ClusterStats> KeystoneService::get_cluster_stats() const {
     for (const auto& [id, pool] : pools_) stats.total_capacity += pool.size;
   }
   {
-    SharedLock lock(objects_mutex_);
+    // Folded-on-read shard sizes (no global map lock exists anymore).
+    uint64_t total = 0;
+    for (size_t si = 0; si < shard_count_; ++si) {
+      const ObjectShard& s = shards_[si];
+      SharedLock lock(s.mutex);
+      total += s.map.size();
+    }
     // Pooled put slots are internal plumbing, not objects an operator put:
     // keep them out of the count (their reserved capacity still shows in
     // used_capacity, which is honest — the ranges are really held). O(1):
     // slot_objects_ is maintained at every grant/commit/cancel/reclaim
     // site; the clamp keeps a (bug-grade) drift from underflowing.
     const int64_t slots = std::max<int64_t>(0, slot_objects_.load());
-    stats.total_objects =
-        objects_.size() - std::min<uint64_t>(objects_.size(), static_cast<uint64_t>(slots));
+    stats.total_objects = total - std::min<uint64_t>(total, static_cast<uint64_t>(slots));
   }
   auto alloc_stats = adapter_.get_stats();
   stats.used_capacity = alloc_stats.total_allocated_bytes;

@@ -37,9 +37,10 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   // transparently fall back / refill elsewhere, and leaving them would pin
   // the worker until the slot TTL. A slot whose commit is racing this
   // cancel commits as OBJECT_NOT_FOUND and the client re-puts normally.
-  {
-    WriterLock lock(objects_mutex_);
-    for (auto it = objects_.begin(); it != objects_.end();) {
+  for (size_t si = 0; si < shard_count_; ++si) {
+    ObjectShard& s = shards_[si];
+    WriterLock lock(s.mutex);
+    for (auto it = s.map.begin(); it != s.map.end();) {
       bool on_worker = false;
       if (it->second.slot) {
         for (const auto& copy : it->second.copies) {
@@ -53,12 +54,12 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
         continue;
       }
       slot_objects_.fetch_sub(1);
-      free_object_locked(it->first, it->second);
-      it = objects_.erase(it);
+      free_object_locked(s, it->first, it->second);
+      it = s.map.erase(it);
       ++counters_.put_cancels;
     }
-    bump_view();
   }
+  bump_view();
 
   // One migration unit per SHARD on the draining worker (not per copy):
   // bytes already correct on surviving workers are never re-streamed, which
@@ -75,34 +76,40 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
   auto scan_moves = [&](bool& pending_touches) {
     std::vector<Move> moves;
     pending_touches = false;
-    SharedLock lock(objects_mutex_);
-    for (const auto& [key, info] : objects_) {
-      for (size_t ci = 0; ci < info.copies.size(); ++ci) {
-        for (size_t si = 0; si < info.copies[ci].shards.size(); ++si) {
-          const ShardPlacement& sh = info.copies[ci].shards[si];
-          if (sh.worker_id != worker_id) continue;
-          if (info.state != ObjectState::kComplete) {
-            // In-flight put placed before the draining flag: it completes
-            // (or cancels) shortly; a later round migrates it.
-            pending_touches = true;
-            continue;
-          }
-          Move m{key, info.epoch, ci, si, sh, info.config, {}};
-          for (size_t cj = 0; cj < info.copies.size(); ++cj) {
-            if (cj == ci) continue;
-            for (const auto& other : info.copies[cj].shards)
-              m.other_workers.push_back(other.worker_id);
-          }
-          if (info.copies[ci].ec_data_shards > 0) {
-            // Coded copy: the SIBLING shards are the failure domains the
-            // "any m worker losses" contract counts — never stack the
-            // migrated shard behind one of them.
-            for (size_t sj = 0; sj < info.copies[ci].shards.size(); ++sj) {
-              if (sj != si)
-                m.other_workers.push_back(info.copies[ci].shards[sj].worker_id);
+    // Map shards scanned in ascending order, one shared lock at a time; the
+    // round structure already tolerates a scan that is not a point-in-time
+    // snapshot (every round re-scans until nothing references the worker).
+    for (size_t msi = 0; msi < shard_count_; ++msi) {
+      const ObjectShard& s = shards_[msi];
+      SharedLock lock(s.mutex);
+      for (const auto& [key, info] : s.map) {
+        for (size_t ci = 0; ci < info.copies.size(); ++ci) {
+          for (size_t si = 0; si < info.copies[ci].shards.size(); ++si) {
+            const ShardPlacement& sh = info.copies[ci].shards[si];
+            if (sh.worker_id != worker_id) continue;
+            if (info.state != ObjectState::kComplete) {
+              // In-flight put placed before the draining flag: it completes
+              // (or cancels) shortly; a later round migrates it.
+              pending_touches = true;
+              continue;
             }
+            Move m{key, info.epoch, ci, si, sh, info.config, {}};
+            for (size_t cj = 0; cj < info.copies.size(); ++cj) {
+              if (cj == ci) continue;
+              for (const auto& other : info.copies[cj].shards)
+                m.other_workers.push_back(other.worker_id);
+            }
+            if (info.copies[ci].ec_data_shards > 0) {
+              // Coded copy: the SIBLING shards are the failure domains the
+              // "any m worker losses" contract counts — never stack the
+              // migrated shard behind one of them.
+              for (size_t sj = 0; sj < info.copies[ci].shards.size(); ++sj) {
+                if (sj != si)
+                  m.other_workers.push_back(info.copies[ci].shards[sj].worker_id);
+              }
+            }
+            moves.push_back(std::move(m));
           }
-          moves.push_back(std::move(m));
         }
       }
     }
@@ -190,10 +197,11 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
         continue;
       }
 
-      WriterLock lock(objects_mutex_);
-      auto it = objects_.find(m.key);
+      ObjectShard& s = shard_for(m.key);
+      WriterLock lock(s.mutex);
+      auto it = s.map.find(m.key);
       const uint64_t expect = epoch_now.contains(m.key) ? epoch_now[m.key] : m.epoch;
-      if (it == objects_.end() || it->second.epoch != expect ||
+      if (it == s.map.end() || it->second.epoch != expect ||
           m.copy_index >= it->second.copies.size() ||
           m.shard_index >= it->second.copies[m.copy_index].shards.size() ||
           // Our own earlier splice in this copy may have shifted indices

@@ -76,11 +76,14 @@ void KeystoneService::evict_for_pressure() {
              << (scope ? storage_class_name(*scope) : "all") << " (util "
              << tier_utilization(scope) << " >= " << config_.high_watermark << ")";
 
-    // LRU order over evictable objects in this scope.
+    // LRU order over evictable objects in this scope. Shards are scanned
+    // in ascending order, one shared lock at a time; LRU ranking happens
+    // after the scan, so cross-shard ordering needs no global lock.
     std::vector<std::pair<std::chrono::steady_clock::time_point, ObjectKey>> candidates;
-    {
-      SharedLock lock(objects_mutex_);
-      for (const auto& [key, info] : objects_) {
+    for (size_t si = 0; si < shard_count_; ++si) {
+      const ObjectShard& s = shards_[si];
+      SharedLock lock(s.mutex);
+      for (const auto& [key, info] : s.map) {
         if (info.soft_pin || info.state != ObjectState::kComplete) continue;
         // Inline objects hold no pool capacity: evicting one cannot relieve
         // allocator pressure (the loop's exit condition), so under the
@@ -96,7 +99,7 @@ void KeystoneService::evict_for_pressure() {
           }
           if (!touches_tier) continue;
         }
-        candidates.emplace_back(info.last_access, key);
+        candidates.emplace_back(info.last_access.load(), key);
       }
     }
     std::sort(candidates.begin(), candidates.end());
@@ -113,13 +116,14 @@ void KeystoneService::evict_for_pressure() {
         }
         if (outcome == DemoteOutcome::kSkipped) continue;
       }
-      WriterLock lock(objects_mutex_);
-      auto it = objects_.find(key);
-      if (it == objects_.end()) continue;
+      ObjectShard& s = shard_for(key);
+      WriterLock lock(s.mutex);
+      auto it = s.map.find(key);
+      if (it == s.map.end()) continue;
       // Fence-first (see gc): never free ranges a promoted leader still maps.
       if (unpersist_object(key) != ErrorCode::OK) continue;
-      free_object_locked(key, it->second);
-      objects_.erase(it);
+      free_object_locked(s, key, it->second);
+      s.map.erase(it);
       ++counters_.evicted;
       bump_view();
       lock.unlock();
@@ -154,9 +158,10 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   WorkerConfig config;
   std::vector<CopyPlacement> old_copies;
   {
-    SharedLock lock(objects_mutex_);
-    auto it = objects_.find(key);
-    if (it == objects_.end() || it->second.state != ObjectState::kComplete)
+    const ObjectShard& s = shard_for(key);
+    SharedLock lock(s.mutex);
+    auto it = s.map.find(key);
+    if (it == s.map.end() || it->second.state != ObjectState::kComplete)
       return DemoteOutcome::kSkipped;
     size = it->second.size;
     epoch_snap = it->second.epoch;
@@ -275,9 +280,10 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   }
 
   // Swap the placements in only if the object didn't change underneath us.
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end() || it->second.epoch != epoch_snap) {
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end() || it->second.epoch != epoch_snap) {
     lock.unlock();
     adapter_.free_object(staging_key);
     return DemoteOutcome::kSkipped;
@@ -288,7 +294,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     // object as lost rather than leave metadata pointing at freed ranges.
     LOG_ERROR << "demotion rename failed for " << key << ": " << to_string(ec);
     adapter_.free_object(staging_key);
-    objects_.erase(it);
+    s.map.erase(it);
     unpersist_object(key);
     ++counters_.objects_lost;
     bump_view();

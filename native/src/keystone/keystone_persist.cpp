@@ -333,7 +333,7 @@ ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo
   rec.config = info.config;
   rec.copies = info.copies;
   rec.created_wall_ms = to_wall(info.created_at);
-  rec.last_access_wall_ms = to_wall(info.last_access);
+  rec.last_access_wall_ms = to_wall(info.last_access.load());
   return coord_put_record(coord::object_record_key(config_.cluster_id, key),
                           encode_object_record(rec));
 }
@@ -360,16 +360,18 @@ void KeystoneService::retry_dirty_persists() {
   }
   for (const auto& key : keys) {
     if (!is_leader_.load()) return;  // deposed: the promoted leader owns truth
-    // The coordinator RPC runs under the shared objects lock on purpose: no
-    // mutator (unique lock) can advance the object or re-create a removed
-    // key mid-write, so the retry can never clobber a NEWER durable record
-    // with this snapshot. Rare path (persist previously failed), bounded by
-    // the coordinator RPC timeout.
-    SharedLock lock(objects_mutex_);
-    auto it = objects_.find(key);
+    // The coordinator RPC runs under the key's shared SHARD lock on
+    // purpose: no mutator (unique lock on the same shard) can advance the
+    // object or re-create a removed key mid-write, so the retry can never
+    // clobber a NEWER durable record with this snapshot. Rare path
+    // (persist previously failed), bounded by the coordinator RPC timeout —
+    // and now stalls only this key's shard, not every metadata writer.
+    const ObjectShard& s = shard_for(key);
+    SharedLock lock(s.mutex);
+    auto it = s.map.find(key);
     ErrorCode ec;
     bool caught_up = false;
-    if (it == objects_.end()) {
+    if (it == s.map.end()) {
       // Removed since it went dirty. The remove itself failed closed on its
       // durable delete, so any remaining record for this key is the stale
       // one this entry tracked — deleting it is the catch-up.
@@ -433,7 +435,8 @@ void KeystoneService::fence_stepdown() {
       needs_recampaign_ = true;
       recampaign_asap_ = true;
       // on_demoted() cannot run here: the fenced op's caller holds
-      // objects_mutex_ and on_demoted takes it. The keepalive thread runs
+      // an object-shard mutex and on_demoted takes them all in turn. The
+      // keepalive thread runs
       // the cleanup before its next campaign step.
       pending_demote_cleanup_ = true;
     }
@@ -498,15 +501,16 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
   }
   if (live_copies.empty()) return ApplyResult::kFailed;
 
-  WriterLock lock(objects_mutex_);
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
   std::optional<ObjectInfo> previous;
-  if (auto it = objects_.find(key); it != objects_.end()) {
+  if (auto it = s.map.find(key); it != s.map.end()) {
     // Replace semantics: the record wins. The old ranges must be freed
     // before adopting the new ones (records usually reuse most of them) —
     // free_object_locked also returns an inline object's budget.
     previous = std::move(it->second);
-    free_object_locked(key, *previous);
-    objects_.erase(it);
+    free_object_locked(s, key, *previous);
+    s.map.erase(it);
   }
   // Inline records own no ranges: adopting an empty allocation would leave
   // a stray allocator entry that nothing ever frees (free_object_locked
@@ -524,7 +528,7 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
            adapter_.adopt_allocation(key, *old_ranges, pools) == ErrorCode::OK)) {
         if (!previous->copies.empty() && !previous->copies.front().inline_data.empty())
           inline_bytes_.fetch_add(previous->copies.front().inline_data.size());
-        objects_[key] = std::move(*previous);
+        s.map[key] = std::move(*previous);
       } else {
         LOG_ERROR << "object " << key << " lost during record re-apply";
         bump_view();
@@ -549,17 +553,18 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
   info.epoch = next_epoch_.fetch_add(1);
   if (!info.copies.empty() && !info.copies.front().inline_data.empty())
     inline_bytes_.fetch_add(info.copies.front().inline_data.size());
-  objects_[key] = std::move(info);
+  s.map[key] = std::move(info);
   bump_view();
   return ApplyResult::kApplied;
 }
 
 void KeystoneService::drop_object_locally(const ObjectKey& key) {
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end()) return;
-  free_object_locked(key, it->second);
-  objects_.erase(it);
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return;
+  free_object_locked(s, key, it->second);
+  s.map.erase(it);
   bump_view();
 }
 

@@ -142,9 +142,15 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
   // so without the retry a transient coordinator outage would strand
   // objects with dead placements forever.
   bool deferred = false;
-  {
-    WriterLock lock(objects_mutex_);
-    for (auto it = objects_.begin(); it != objects_.end();) {
+  // Shards in ascending order, one exclusive lock at a time: each shard's
+  // prune is atomic for its keys, and clients on other shards keep moving
+  // while this one is swept. The pass was never atomic across the whole map
+  // (pass 2 re-checks epochs per key), so per-shard locking changes nothing
+  // the retry machinery doesn't already absorb.
+  for (size_t msi = 0; msi < shard_count_ && !deferred; ++msi) {
+    ObjectShard& s = shards_[msi];
+    WriterLock lock(s.mutex);
+    for (auto it = s.map.begin(); it != s.map.end();) {
       if (!is_leader_.load()) {  // deposed mid-pass: stop issuing doomed RPCs
         deferred = true;
         break;
@@ -167,8 +173,8 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           }
         }
         slot_objects_.fetch_sub(1);
-        free_object_locked(key, info);
-        it = objects_.erase(it);
+        free_object_locked(s, key, info);
+        it = s.map.erase(it);
         ++counters_.put_cancels;
         bump_view();
         continue;
@@ -228,7 +234,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           }
           drop_dead_worker_bookkeeping();
           adapter_.free_object(key);
-          it = objects_.erase(it);
+          it = s.map.erase(it);
           ++counters_.objects_lost;
           bump_view();
           cache_invals.emplace_back(key, 0);
@@ -326,7 +332,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           }
         }
         adapter_.free_object(key);
-        it = objects_.erase(it);
+        it = s.map.erase(it);
         ++counters_.objects_lost;
         bump_view();
         cache_invals.emplace_back(key, 0);
@@ -429,9 +435,10 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       continue;
     }
 
-    WriterLock lock(objects_mutex_);
-    auto it = objects_.find(p.key);
-    if (it == objects_.end() || it->second.epoch != p.epoch) {
+    ObjectShard& s = shard_for(p.key);
+    WriterLock lock(s.mutex);
+    auto it = s.map.find(p.key);
+    if (it == s.map.end() || it->second.epoch != p.epoch) {
       lock.unlock();
       adapter_.free_object(staging_key);
       continue;  // object changed while the bytes moved; its new state wins
@@ -728,9 +735,10 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
   }
 
   // 4. Splice under the lock iff the object didn't change underneath us.
-  WriterLock lock(objects_mutex_);
-  auto it = objects_.find(key);
-  if (it == objects_.end() || it->second.epoch != epoch ||
+  ObjectShard& s = shard_for(key);
+  WriterLock lock(s.mutex);
+  auto it = s.map.find(key);
+  if (it == s.map.end() || it->second.epoch != epoch ||
       it->second.copies.empty() || it->second.copies.front().shards.size() != n) {
     lock.unlock();
     free_all_staged();

@@ -58,37 +58,48 @@ size_t KeystoneService::run_scrub_once() {
       it = scrub_targets_.erase(it);
     }
   }
+  std::unordered_set<ObjectKey> taken_keys;
+  for (const auto& key : priority) {
+    const ObjectShard& s = shard_for(key);
+    SharedLock lock(s.mutex);
+    auto it = s.map.find(key);
+    if (it != s.map.end() && it->second.state == ObjectState::kComplete &&
+        taken_keys.insert(key).second)
+      batch.push_back({key, it->second.epoch, it->second.copies});
+  }
   {
-    SharedLock lock(objects_mutex_);
-    std::unordered_set<std::string_view> taken_keys;
-    for (const auto& key : priority) {
-      auto it = objects_.find(key);
-      if (it != objects_.end() && it->second.state == ObjectState::kComplete &&
-          taken_keys.insert(it->first).second)
-        batch.push_back({key, it->second.epoch, it->second.copies});
+    // Ring walk over the sharded map: collect the complete keys (owned
+    // copies — each shard's lock is released before the next is taken),
+    // sort, take the budget after the cursor, then re-fetch each selected
+    // key's snapshot from its shard. A key removed between collect and
+    // fetch is simply skipped; the scrub is a background sweep, not a
+    // consistent scan, exactly as before.
+    std::vector<ObjectKey> keys;
+    for (size_t si = 0; si < shard_count_; ++si) {
+      const ObjectShard& s = shards_[si];
+      SharedLock lock(s.mutex);
+      for (const auto& [k, info] : s.map) {
+        if (info.state == ObjectState::kComplete) keys.push_back(k);
+      }
     }
-    std::vector<const ObjectKey*> keys;
-    keys.reserve(objects_.size());
-    for (const auto& [k, info] : objects_) {
-      if (info.state == ObjectState::kComplete) keys.push_back(&k);
-    }
-    std::sort(keys.begin(), keys.end(),
-              [](const ObjectKey* a, const ObjectKey* b) { return *a < *b; });
+    std::sort(keys.begin(), keys.end());
     if (!keys.empty()) {
       // The smallest keys strictly after the cursor, wrapping — a ring walk.
       // Keys already taken as priority targets are visited (the cursor must
       // advance past them) but not scrubbed twice in one pass.
-      auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_,
-                                    [](const ObjectKey& c, const ObjectKey* k) { return c < *k; });
+      auto start = std::upper_bound(keys.begin(), keys.end(), scrub_cursor_);
       const ObjectKey* last_visited = nullptr;
       for (size_t taken = 0; taken < config_.scrub_objects_per_pass &&
                              taken < keys.size();
            ++taken) {
         if (start == keys.end()) start = keys.begin();
-        last_visited = *start;
-        if (!taken_keys.contains(**start)) {
-          const auto& info = objects_.at(**start);
-          batch.push_back({**start, info.epoch, info.copies});
+        last_visited = &*start;
+        if (!taken_keys.contains(*start)) {
+          const ObjectShard& s = shard_for(*start);
+          SharedLock lock(s.mutex);
+          auto it = s.map.find(*start);
+          if (it != s.map.end() && it->second.state == ObjectState::kComplete)
+            batch.push_back({*start, it->second.epoch, it->second.copies});
         }
         ++start;
       }
@@ -188,9 +199,10 @@ size_t KeystoneService::run_scrub_once() {
             if (transport::copy_range_io(*data_client_, t.copies[sj], off, buf.data(), n,
                                          /*is_write=*/false) != ErrorCode::OK)
               return false;
-            SharedLock lock(objects_mutex_);
-            auto it = objects_.find(t.key);
-            if (it == objects_.end() || it->second.epoch != t.epoch) {
+            const ObjectShard& s = shard_for(t.key);
+            SharedLock lock(s.mutex);
+            auto it = s.map.find(t.key);
+            if (it == s.map.end() || it->second.epoch != t.epoch) {
               stale = true;
               return false;
             }
@@ -227,16 +239,17 @@ size_t KeystoneService::run_scrub_once() {
             if (sj == ci) continue;
             const auto src_crc = segmented_crc(len, [&](uint64_t off, uint64_t n) {
               // The sibling read runs lock-free so a hung source worker never
-              // stalls metadata writers behind objects_mutex_; a read off a
-              // concurrently freed range yields garbage, which the epoch
-              // re-check below (or the final CRC gate) discards.
+              // stalls metadata writers behind the key's shard mutex; a read
+              // off a concurrently freed range yields garbage, which the
+              // epoch re-check below (or the final CRC gate) discards.
               if (transport::copy_range_io(*data_client_, t.copies[sj], shard_off + off,
                                            buf.data(), n,
                                            /*is_write=*/false) != ErrorCode::OK)
                 return false;
-              SharedLock lock(objects_mutex_);
-              auto it = objects_.find(t.key);
-              if (it == objects_.end() || it->second.epoch != t.epoch) {
+              const ObjectShard& s = shard_for(t.key);
+              SharedLock lock(s.mutex);
+              auto it = s.map.find(t.key);
+              if (it == s.map.end() || it->second.epoch != t.epoch) {
                 stale = true;
                 return false;
               }
@@ -318,16 +331,22 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
   // This adoption supersedes any outstanding revalidation checks for the
   // same pool: their lock-free CRC reads may race this pass's placement
   // rewrite, and condemning bytes this adoption just restored would turn a
-  // healthy pool bounce into data loss. Stamped under objects_mutex_ so
-  // run_readopt_checks (which holds it when acting) sees a stable value.
+  // healthy pool bounce into data loss. The seq is stamped BEFORE any
+  // placement is rewritten: a checker that still reads the OLD seq (under
+  // readopt_checks_mutex_, while holding its key's shard lock) therefore
+  // proves no rewrite of this adoption preceded its CRC read — so its
+  // verdict is about the pre-adoption bytes it was queued for; one that
+  // reads the NEW seq stands down and lets this adoption's own checks
+  // govern.
   const uint64_t adoption_seq = readopt_seq_counter_.fetch_add(1) + 1;
   {
-    WriterLock lock(objects_mutex_);
-    {
-      MutexLock qlock(readopt_checks_mutex_);
-      readopt_seq_[pool.id] = adoption_seq;
-    }
-    for (auto it = objects_.begin(); it != objects_.end();) {
+    MutexLock qlock(readopt_checks_mutex_);
+    readopt_seq_[pool.id] = adoption_seq;
+  }
+  for (size_t msi = 0; msi < shard_count_; ++msi) {
+    ObjectShard& mshard = shards_[msi];
+    WriterLock lock(mshard.mutex);
+    for (auto it = mshard.map.begin(); it != mshard.map.end();) {
       auto& [key, info] = *it;
       struct Hit {
         CopyPlacement* copy;
@@ -362,8 +381,8 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
         LOG_ERROR << "re-adoption carve failed for " << key << " on pool " << pool.id
                   << "; dropping the object";
         if (unpersist_object(key) == ErrorCode::OK) {
-          free_object_locked(key, info);
-          it = objects_.erase(it);
+          free_object_locked(mshard, key, info);
+          it = mshard.map.erase(it);
           ++counters_.objects_lost;
         } else {
           ++it;  // stays offline (old placements); a later pass may retry
@@ -441,17 +460,20 @@ void KeystoneService::run_readopt_checks() {
     LOG_WARN << "re-adopted shard of " << check.key << " failed revalidation ("
              << (io_ok ? "crc mismatch: stale/replaced backing file" : "unreadable")
              << "); dropping the object";
-    WriterLock lock(objects_mutex_);
+    ObjectShard& s = shard_for(check.key);
+    WriterLock lock(s.mutex);
     // A later re-adoption of the same pool supersedes this check: its
     // placement rewrite may have raced the lock-free CRC read above, and
-    // its OWN queued checks govern the restored bytes. (Checked under
-    // objects_mutex_, which every adoption holds while stamping its seq.)
+    // its OWN queued checks govern the restored bytes. (Adoptions stamp
+    // their seq BEFORE rewriting any placement — see readopt_offline_pool —
+    // so reading the OLD seq here proves the CRC read above saw only
+    // pre-adoption bytes.)
     {
       MutexLock qlock(readopt_checks_mutex_);
       auto seq_it = readopt_seq_.find(check.shard.pool_id);
       if (seq_it != readopt_seq_.end() && seq_it->second != check.seq) continue;
     }
-    auto it = objects_.find(check.key);
+    auto it = s.map.find(check.key);
     // The check condemns only the exact shard it was queued for: same
     // placement AND same stamp. An epoch comparison would be both too strict
     // (a second offline pool's adoption of the same object bumps the epoch
@@ -459,7 +481,7 @@ void KeystoneService::run_readopt_checks() {
     // too loose once dropped (a re-put or repair may have landed fresh
     // bytes at the same address, which this stale expectation must not
     // drop).
-    if (it == objects_.end()) continue;
+    if (it == s.map.end()) continue;
     const bool still_applies = [&] {
       for (const auto& copy : it->second.copies) {
         if (copy.shard_crcs.size() != copy.shards.size()) continue;
@@ -479,8 +501,8 @@ void KeystoneService::run_readopt_checks() {
       readopt_checks_.push_back(check);
       continue;
     }
-    free_object_locked(check.key, it->second);
-    objects_.erase(it);
+    free_object_locked(s, check.key, it->second);
+    s.map.erase(it);
     ++counters_.objects_lost;
     bump_view();
   }

@@ -1,0 +1,415 @@
+// Concurrent metadata hammer: N threads mixing put_start / get_workers /
+// put_complete / remove across colliding and non-colliding shards, plus
+// cross-shard batch ops interleaved with GC / eviction / repair sweeps and
+// pooled-slot commit races. This is the adversarial companion to the
+// sharded keystone object map (docs/CORRECTNESS.md "Keystone shard
+// discipline"): every invariant here held trivially under the old map-wide
+// mutex and must keep holding per shard. Runs in the default suite and
+// under `make tsan` (the sanitizer is what turns an interleaving bug into
+// a hard failure rather than a flake).
+#include <atomic>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "btest.h"
+#include "btpu/keystone/keystone.h"
+#include "btpu/transport/transport.h"
+
+using namespace btpu;
+using namespace btpu::keystone;
+
+namespace {
+
+// A fake worker: local-transport region + registered pool (same harness as
+// test_keystone.cpp, duplicated to keep the TUs self-contained).
+struct HammerWorker {
+  std::string id;
+  std::vector<uint8_t> memory;
+  std::unique_ptr<transport::TransportServer> server;
+  MemoryPool pool;
+
+  HammerWorker(const std::string& worker_id, uint64_t size)
+      : id(worker_id), memory(size) {
+    server = transport::make_transport_server(TransportKind::LOCAL);
+    server->start("", 0);
+    auto reg = server->register_region(memory.data(), size, worker_id + "-pool");
+    pool.id = worker_id + "-pool";
+    pool.node_id = worker_id;
+    pool.size = size;
+    pool.storage_class = StorageClass::RAM_CPU;
+    pool.remote = reg.value();
+    pool.topo = {0, 0, -1};
+  }
+
+  WorkerInfo info() const {
+    WorkerInfo w;
+    w.worker_id = id;
+    w.address = "local:" + id;
+    w.topo = pool.topo;
+    return w;
+  }
+};
+
+KeystoneConfig hammer_config(uint32_t shards) {
+  KeystoneConfig cfg;
+  cfg.gc_interval_sec = 1;
+  cfg.health_check_interval_sec = 1;
+  cfg.metadata_shards = shards;
+  return cfg;
+}
+
+// Zero leaked allocator state is THE end-of-run invariant: every interleaving
+// of put/cancel/remove/gc must pair each carve with exactly one free.
+void expect_no_leaked_allocations(KeystoneService& ks) {
+  const auto stats = ks.allocator_stats();
+  BT_EXPECT_EQ(stats.total_allocated_bytes, 0ull);
+  BT_EXPECT_EQ(stats.total_objects, 0ull);
+}
+
+}  // namespace
+
+BTEST(KeystoneHammer, ShardCountResolution) {
+  // Explicit config wins and is reported back.
+  {
+    KeystoneService ks(hammer_config(3), nullptr);
+    BT_EXPECT_EQ(ks.metadata_shard_count(), 3u);
+  }
+  // 0 = auto: env override, restored afterwards so suite order is benign.
+  setenv("BTPU_KEYSTONE_SHARDS", "5", 1);
+  {
+    KeystoneService ks(hammer_config(0), nullptr);
+    BT_EXPECT_EQ(ks.metadata_shard_count(), 5u);
+  }
+  unsetenv("BTPU_KEYSTONE_SHARDS");
+  {
+    // Auto default: min(hw_concurrency, 16), at least 1.
+    KeystoneService ks(hammer_config(0), nullptr);
+    BT_EXPECT(ks.metadata_shard_count() >= 1 && ks.metadata_shard_count() <= 16);
+  }
+  // Clamped, never zero, never absurd.
+  {
+    KeystoneService ks(hammer_config(100000), nullptr);
+    BT_EXPECT_EQ(ks.metadata_shard_count(), 256u);
+  }
+}
+
+// 4 threads on DISJOINT key spaces (keys spread over all 8 shards by hash):
+// the full single-key lifecycle must be linearizable per key with no
+// cross-talk, and the books must balance exactly at the end.
+BTEST(KeystoneHammer, MixedOpsDisjointKeys) {
+  KeystoneService ks(hammer_config(8), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  HammerWorker w1("hw1", 64 << 20), w2("hw2", 64 << 20);
+  for (auto* w : {&w1, &w2}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+
+  constexpr int kThreads = 4;
+  constexpr int kIters = 200;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> pool;
+  for (int t = 0; t < kThreads; ++t) {
+    pool.emplace_back([&, t] {
+      WorkerConfig cfg;
+      cfg.replication_factor = 1;
+      cfg.max_workers_per_copy = 2;
+      for (int i = 0; i < kIters; ++i) {
+        const ObjectKey key = "hammer/t" + std::to_string(t) + "/" + std::to_string(i);
+        if (!ks.put_start(key, 4096, cfg).ok()) { ++failures; return; }
+        auto exists = ks.object_exists(key);
+        if (!exists.ok() || !exists.value()) { ++failures; return; }
+        if (ks.put_complete(key) != ErrorCode::OK) { ++failures; return; }
+        if (!ks.get_workers(key).ok()) { ++failures; return; }
+        if (ks.object_cache_version(key).first == 0) { ++failures; return; }
+        // Remove half now; the rest exercise the bulk teardown below.
+        if (i % 2 == 0 && ks.remove_object(key) != ErrorCode::OK) { ++failures; return; }
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  BT_EXPECT_EQ(failures.load(), 0);
+  BT_EXPECT_EQ(ks.counters().put_starts.load(),
+               static_cast<uint64_t>(kThreads) * kIters);
+  BT_EXPECT_EQ(ks.counters().put_completes.load(),
+               static_cast<uint64_t>(kThreads) * kIters);
+  BT_EXPECT_EQ(ks.counters().removes.load(),
+               static_cast<uint64_t>(kThreads) * kIters / 2);
+
+  auto stats = ks.get_cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().total_objects, static_cast<uint64_t>(kThreads) * kIters / 2);
+  auto removed = ks.remove_all_objects();
+  BT_ASSERT_OK(removed);
+  BT_EXPECT_EQ(removed.value(), static_cast<uint64_t>(kThreads) * kIters / 2);
+  expect_no_leaked_allocations(ks);
+}
+
+// All threads fight over the SAME small key set — with metadata_shards=1
+// every op collides on one shard (the degenerate single-lock layout), with
+// 8 the collisions are per-key. Both layouts must agree on the invariants:
+// each key's lifecycle transitions stay legal, errors are only the
+// documented races, and nothing leaks.
+BTEST(KeystoneHammer, CollidingKeysBothLayouts) {
+  for (uint32_t shards : {1u, 8u}) {
+    KeystoneService ks(hammer_config(shards), nullptr);
+    BT_ASSERT(ks.initialize() == ErrorCode::OK);
+    HammerWorker w("hwc" + std::to_string(shards), 64 << 20);
+    ks.register_worker(w.info());
+    ks.register_memory_pool(w.pool);
+
+    constexpr int kThreads = 4;
+    constexpr int kIters = 150;
+    constexpr int kHotKeys = 4;
+    std::atomic<int> unexpected{0};
+    std::vector<std::thread> pool;
+    for (int t = 0; t < kThreads; ++t) {
+      pool.emplace_back([&, t] {
+        WorkerConfig cfg;
+        cfg.replication_factor = 1;
+        for (int i = 0; i < kIters; ++i) {
+          const ObjectKey key = "hot/" + std::to_string((t + i) % kHotKeys);
+          auto placed = ks.put_start(key, 1024, cfg);
+          if (placed.ok()) {
+            // We own the pending put: complete or cancel it.
+            const ErrorCode ec =
+                (i % 3 == 0) ? ks.put_cancel(key) : ks.put_complete(key);
+            if (ec != ErrorCode::OK && ec != ErrorCode::OBJECT_NOT_FOUND) ++unexpected;
+          } else if (placed.error() != ErrorCode::OBJECT_ALREADY_EXISTS) {
+            ++unexpected;
+          }
+          // Reads and removes race freely; only documented codes may surface.
+          auto got = ks.get_workers(key);
+          if (!got.ok() && got.error() != ErrorCode::OBJECT_NOT_FOUND) ++unexpected;
+          const ErrorCode rm = ks.remove_object(key);
+          if (rm != ErrorCode::OK && rm != ErrorCode::OBJECT_NOT_FOUND) ++unexpected;
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+    BT_EXPECT_EQ(unexpected.load(), 0);
+    auto removed = ks.remove_all_objects();
+    BT_ASSERT_OK(removed);
+    expect_no_leaked_allocations(ks);
+  }
+}
+
+// Cross-shard batch ops racing GC + watermark eviction + list/stats
+// readers: multi-key paths walk shards in ascending order while single-key
+// traffic keeps mutating them. TTL'd objects expire mid-walk, the health
+// sweep runs eviction/repair legs, and the listing/stat folds must never
+// see a torn entry (tsan proves the absence of data races; the assertions
+// prove the books still balance).
+BTEST(KeystoneHammer, BatchesVsGcEvictAndReaders) {
+  KeystoneConfig cfg = hammer_config(8);
+  cfg.enable_gc = false;  // driven synchronously below for determinism
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  HammerWorker w1("hwb1", 64 << 20), w2("hwb2", 64 << 20);
+  for (auto* w : {&w1, &w2}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+
+  constexpr int kWriters = 2;
+  constexpr int kRounds = 40;
+  constexpr int kBatch = 8;
+  std::atomic<int> unexpected{0};
+  std::atomic<bool> done{false};
+  std::vector<std::thread> pool;
+  for (int t = 0; t < kWriters; ++t) {
+    pool.emplace_back([&, t] {
+      WorkerConfig wc;
+      wc.replication_factor = 1;
+      for (int r = 0; r < kRounds; ++r) {
+        std::vector<BatchPutStartItem> items;
+        std::vector<ObjectKey> keys;
+        for (int b = 0; b < kBatch; ++b) {
+          BatchPutStartItem item;
+          item.key = "batch/t" + std::to_string(t) + "/" + std::to_string(r) + "/" +
+                     std::to_string(b);
+          item.data_size = 2048;
+          item.config = wc;
+          // Half the keys are born expired-soon so the concurrent GC pass
+          // has something to collect mid-run.
+          if (b % 2 == 0) item.config.ttl_ms = 1;
+          keys.push_back(item.key);
+          items.push_back(std::move(item));
+        }
+        auto placed = ks.batch_put_start(items);
+        for (const auto& p : placed) {
+          if (!p.ok()) ++unexpected;
+        }
+        for (const auto& ec : ks.batch_put_complete(keys)) {
+          if (ec != ErrorCode::OK && ec != ErrorCode::OBJECT_NOT_FOUND) ++unexpected;
+        }
+        for (const auto& g : ks.batch_get_workers(keys)) {
+          if (!g.ok() && g.error() != ErrorCode::OBJECT_NOT_FOUND) ++unexpected;
+        }
+        // Cancel the odd (non-TTL) half; GC reclaims the even half.
+        std::vector<ObjectKey> cancels;
+        for (int b = 1; b < kBatch; b += 2) cancels.push_back(keys[b]);
+        for (const auto& ec : ks.batch_put_cancel(cancels)) {
+          if (ec != ErrorCode::OK && ec != ErrorCode::OBJECT_NOT_FOUND) ++unexpected;
+        }
+      }
+    });
+  }
+  pool.emplace_back([&] {  // GC + health sweeps interleaving the batches
+    while (!done.load()) {
+      ks.run_gc_once();
+      ks.run_health_check_once();
+      std::this_thread::yield();
+    }
+  });
+  pool.emplace_back([&] {  // multi-shard readers
+    while (!done.load()) {
+      auto listing = ks.list_objects("batch/", 16);
+      if (!listing.ok()) ++unexpected;
+      if (!ks.get_cluster_stats().ok()) ++unexpected;
+      std::this_thread::yield();
+    }
+  });
+  for (int t = 0; t < kWriters; ++t) pool[t].join();
+  done.store(true);
+  pool[kWriters].join();
+  pool[kWriters + 1].join();
+  BT_EXPECT_EQ(unexpected.load(), 0);
+
+  // Everything is either cancelled, GC'd, or still resident-complete; a
+  // final GC pass (TTL=1ms is long past) plus remove_all must zero it out.
+  ks.run_gc_once();
+  ks.remove_all_objects();
+  expect_no_leaked_allocations(ks);
+}
+
+// Dead-worker repair (multi-shard writer pass + staged re-replication)
+// interleaved with live put/get/remove traffic on other keys. The repair
+// pass must prune and re-replicate without tripping over concurrent
+// mutators, and the post-repair world must be fully consistent.
+BTEST(KeystoneHammer, RepairInterleavesWithTraffic) {
+  KeystoneService ks(hammer_config(8), nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  HammerWorker w1("hwr1", 64 << 20), w2("hwr2", 64 << 20), w3("hwr3", 64 << 20);
+  for (auto* w : {&w1, &w2, &w3}) {
+    ks.register_worker(w->info());
+    ks.register_memory_pool(w->pool);
+  }
+
+  // Seed replicated objects whose copies span the workers.
+  WorkerConfig rcfg;
+  rcfg.replication_factor = 2;
+  rcfg.max_workers_per_copy = 1;
+  constexpr int kSeeded = 24;
+  for (int i = 0; i < kSeeded; ++i) {
+    const ObjectKey key = "repair/seed/" + std::to_string(i);
+    BT_ASSERT_OK(ks.put_start(key, 8192, rcfg));
+    BT_ASSERT(ks.put_complete(key) == ErrorCode::OK);
+  }
+
+  std::atomic<int> unexpected{0};
+  std::atomic<bool> done{false};
+  std::vector<std::thread> pool;
+  for (int t = 0; t < 3; ++t) {
+    pool.emplace_back([&, t] {
+      WorkerConfig cfg;
+      cfg.replication_factor = 1;
+      // Pin live traffic to the SURVIVING workers: an unreplicated object
+      // that landed on the dying worker would be legitimately dropped by
+      // the loss path, which is not what this test is about — it asserts
+      // that traffic off the dead worker is completely untouched by the
+      // concurrent repair pass.
+      cfg.preferred_node = (t % 2 == 0) ? "hwr1" : "hwr2";  // hard node filter
+      for (int i = 0; i < 120; ++i) {
+        const ObjectKey key = "repair/live/t" + std::to_string(t) + "/" + std::to_string(i);
+        auto placed = ks.put_start(key, 1024, cfg);
+        if (!placed.ok()) { ++unexpected; return; }
+        if (ks.put_complete(key) != ErrorCode::OK) { ++unexpected; return; }
+        if (!ks.get_workers(key).ok()) { ++unexpected; return; }
+        if (ks.remove_object(key) != ErrorCode::OK) { ++unexpected; return; }
+      }
+    });
+  }
+  pool.emplace_back([&] {
+    // Kill w3 while traffic flows: cleanup + repair run on this thread.
+    ks.remove_worker("hwr3");
+    done.store(true);
+  });
+  for (auto& th : pool) th.join();
+  BT_EXPECT(done.load());
+  BT_EXPECT_EQ(unexpected.load(), 0);
+  BT_EXPECT_EQ(ks.counters().workers_lost.load(), 1ull);
+
+  // Every seeded object survives with both replicas off the dead worker.
+  for (int i = 0; i < kSeeded; ++i) {
+    auto got = ks.get_workers("repair/seed/" + std::to_string(i));
+    BT_ASSERT_OK(got);
+    for (const auto& copy : got.value()) {
+      for (const auto& shard : copy.shards) BT_EXPECT_NE(shard.worker_id, "hwr3");
+    }
+  }
+  ks.remove_all_objects();
+  expect_no_leaked_allocations(ks);
+}
+
+// Pooled-slot commits racing onto COLLIDING final keys (slot shard != key
+// shard in general, so this is the cross-shard ownership-transfer path):
+// exactly one commit per final key may win; losers fall back with the
+// documented codes and their slots stay reclaimable, never leaked.
+BTEST(KeystoneHammer, SlotCommitRaces) {
+  KeystoneConfig cfg = hammer_config(8);
+  cfg.slot_ttl_sec = 60;
+  KeystoneService ks(cfg, nullptr);
+  BT_ASSERT(ks.initialize() == ErrorCode::OK);
+  HammerWorker w("hws", 64 << 20);
+  ks.register_worker(w.info());
+  ks.register_memory_pool(w.pool);
+
+  constexpr int kThreads = 4;
+  constexpr int kCommitsPerThread = 12;
+  constexpr int kTargets = 6;  // colliding final keys
+  std::atomic<int> wins{0};
+  std::atomic<int> unexpected{0};
+  std::vector<std::thread> pool;
+  for (int t = 0; t < kThreads; ++t) {
+    pool.emplace_back([&, t] {
+      WorkerConfig wc;
+      wc.replication_factor = 1;
+      for (int i = 0; i < kCommitsPerThread; ++i) {
+        auto slots = ks.put_start_pooled(1024, wc, 1, "racer" + std::to_string(t));
+        if (!slots.ok() || slots.value().empty()) { ++unexpected; return; }
+        const ObjectKey target = "slotrace/" + std::to_string(i % kTargets);
+        const ErrorCode ec =
+            ks.put_commit_slot(slots.value()[0].slot_key, target, 0, {});
+        if (ec == ErrorCode::OK) {
+          ++wins;
+        } else if (ec != ErrorCode::OBJECT_ALREADY_EXISTS &&
+                   ec != ErrorCode::OBJECT_NOT_FOUND) {
+          ++unexpected;
+        } else {
+          // Loser: the slot must have been reinstated for the TTL to
+          // reclaim — cancel it now to keep the books checkable.
+          if (ks.put_cancel(slots.value()[0].slot_key) != ErrorCode::OK) ++unexpected;
+        }
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  BT_EXPECT_EQ(unexpected.load(), 0);
+  // Exactly one winner per distinct target key.
+  BT_EXPECT_EQ(wins.load(), kTargets);
+  for (int k = 0; k < kTargets; ++k) {
+    auto got = ks.get_workers("slotrace/" + std::to_string(k));
+    BT_ASSERT_OK(got);
+  }
+  auto stats = ks.get_cluster_stats();
+  BT_ASSERT_OK(stats);
+  BT_EXPECT_EQ(stats.value().total_objects, static_cast<uint64_t>(kTargets));
+  auto removed = ks.remove_all_objects();
+  BT_ASSERT_OK(removed);
+  BT_EXPECT_EQ(removed.value(), static_cast<uint64_t>(kTargets));
+  expect_no_leaked_allocations(ks);
+}

@@ -744,6 +744,33 @@ def main() -> int:
         )
     except Exception as exc:
         print(f"concurrency rows skipped: {exc}", file=sys.stderr)
+    # Overload row (ISSUE 5): one worker latency-injected to >= 50x the
+    # healthy median (FaultSpec), replicated 2x reads with hedging OFF vs
+    # ON. Hedging's whole job is closing the tail that replication already
+    # paid for: the unhedged p99 IS the injected latency, the hedged p99 is
+    # ~hedge-trigger + one healthy read (acceptance: >= 5x better p99).
+    overload = {}
+    try:
+        r = subprocess.run(
+            [str(binary), "--embedded", "2", "--size", str(64 << 10),
+             "--iterations", "300", "--overload", "--json"],
+            capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr[-300:])
+        overload = json.loads(r.stdout.strip().splitlines()[-1])
+        print(
+            f"overload 64KiB (1 slow worker @ {overload['slow_ms']}ms, rf=2): "
+            f"hedging OFF p50 {overload['off_p50_us']:.0f} / p99 "
+            f"{overload['off_p99_us']:.0f} / p99.9 {overload['off_p999_us']:.0f}us | "
+            f"ON p50 {overload['on_p50_us']:.0f} / p99 {overload['on_p99_us']:.0f} / "
+            f"p99.9 {overload['on_p999_us']:.0f}us "
+            f"({overload['hedge_p99_improvement_x']:.1f}x better p99, "
+            f"{overload['hedge_wins']}/{overload['hedges_fired']} hedges won)",
+            file=sys.stderr,
+        )
+    except Exception as exc:
+        print(f"overload row skipped: {exc}", file=sys.stderr)
     # Multi-PROCESS clients against a real worker process — the production
     # concurrency shape (N consumers on one TPU-VM host). Each client is a
     # whole bb-bench process with its own key namespace (--prefix); on the
@@ -879,6 +906,20 @@ def main() -> int:
             meta_scaling["x4"] / max(meta_scaling["x1"], 1), 2)
         summary["keystone_shards"] = meta_scaling["shards"]
         summary["bench_cpus"] = meta_scaling["cpus"]
+    # Overload/tail headline (ISSUE 5 acceptance): slow-worker replicated
+    # read percentiles, hedging off vs on, and the p99 improvement ratio.
+    if overload:
+        summary["overload_slow_ms"] = overload["slow_ms"]
+        summary["overload_off_p50_us"] = round(overload["off_p50_us"], 1)
+        summary["overload_off_p99_us"] = round(overload["off_p99_us"], 1)
+        summary["overload_off_p999_us"] = round(overload["off_p999_us"], 1)
+        summary["overload_on_p50_us"] = round(overload["on_p50_us"], 1)
+        summary["overload_on_p99_us"] = round(overload["on_p99_us"], 1)
+        summary["overload_on_p999_us"] = round(overload["on_p999_us"], 1)
+        summary["hedge_p99_improvement_x"] = round(
+            overload["hedge_p99_improvement_x"], 1)
+        summary["hedges_fired"] = overload["hedges_fired"]
+        summary["hedge_wins"] = overload["hedge_wins"]
     print(json.dumps(summary))
     return 0
 

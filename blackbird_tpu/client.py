@@ -351,6 +351,17 @@ class Client:
             "stream_bytes": "btpu_tcp_stream_byte_count",
             "cached_ops": "btpu_cached_op_count",
             "cached_bytes": "btpu_cached_byte_count",
+            # Overload-robustness scoreboard (deadlines / sheds / hedges /
+            # breakers); process-global like the lanes above.
+            "deadline_exceeded": "btpu_deadline_exceeded_count",
+            "shed": "btpu_shed_count",
+            "client_deadline_exceeded": "btpu_client_deadline_exceeded_count",
+            "retries": "btpu_retry_count",
+            "retry_budget_exhausted": "btpu_retry_budget_exhausted_count",
+            "hedges_fired": "btpu_hedge_fired_count",
+            "hedge_wins": "btpu_hedge_win_count",
+            "breaker_trips": "btpu_breaker_trip_count",
+            "breaker_skips": "btpu_breaker_skip_count",
         }
         return {key: int(getattr(lib, fn)()) if hasattr(lib, fn) else 0
                 for key, fn in names.items()}

@@ -64,6 +64,7 @@ int main(int argc, char** argv) {
   wc.max_workers_per_copy = 4;
   bool json = false, sweep = false, no_verify = false, repeat_rows = false;
   bool control_plane = false;  // metadata ops/sec closed loop, no data plane
+  bool overload = false;  // slow-worker tail row: hedging off vs on
   int batch = 0;  // >0: measure put_many/get_many over `batch` objects per op
   int threads = 1;  // >1: concurrent clients, each its own connection
   std::string prefix = "bench";  // key namespace (multi-process runs pass distinct ones)
@@ -90,6 +91,7 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--prefix") && i + 1 < argc)
       prefix = argv[++i];  // key namespace: lets N bb-bench PROCESSES share a cluster
     else if (!std::strcmp(argv[i], "--control-plane")) control_plane = true;
+    else if (!std::strcmp(argv[i], "--overload")) overload = true;
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
       const std::string km = argv[++i];
       if (km.find('-') != std::string::npos) {  // stoul silently wraps negatives
@@ -256,6 +258,118 @@ int main(int argc, char** argv) {
           "%zu shards, %u cpus)\n",
           threads, ops_per_sec, percentile(merged, 50), percentile(merged, 99), shards,
           cpus);
+    }
+    return 0;
+  }
+
+  if (overload) {
+    // Tail-at-scale row: one replica 50x-slowed via latency fault
+    // injection, replicated 2x reads with hedging OFF then ON. The entire
+    // point of hedged reads is closing the tail that replication already
+    // paid for: with one slow worker the unhedged p99 IS the injected
+    // latency, the hedged p99 is ~hedge-trigger + a healthy read.
+    if (!cluster) {
+      std::fprintf(stderr, "--overload needs --embedded N (>= 2)\n");
+      return 1;
+    }
+    WorkerConfig owc;
+    owc.replication_factor = 2;
+    owc.max_workers_per_copy = 1;
+    const std::string okey = prefix + "/overload";
+    std::vector<uint8_t> data(size, 0x5c);
+    if (client.put(okey, data.data(), size, owc) != ErrorCode::OK) {
+      std::fprintf(stderr, "overload: put failed\n");
+      return 1;
+    }
+    auto placements = client.get_workers(okey);
+    if (!placements.ok() || placements.value().size() < 2) {
+      std::fprintf(stderr, "overload: need 2 replicas\n");
+      return 1;
+    }
+    std::string slow_endpoint;
+    for (const auto& shard : placements.value()[0].shards) {
+      if (!shard.remote.endpoint.empty()) { slow_endpoint = shard.remote.endpoint; break; }
+    }
+    if (slow_endpoint.empty()) {
+      std::fprintf(stderr, "overload: copy 0 has no wire endpoint\n");
+      return 1;
+    }
+    // Healthy median (no injection) sets the slow worker's scale.
+    std::vector<uint8_t> buf(size);
+    std::vector<double> healthy;
+    for (int it = 0; it < 50; ++it) {
+      const auto t0 = Clock::now();
+      if (!client.get_into(okey, buf.data(), buf.size()).ok()) {
+        std::fprintf(stderr, "overload: healthy read failed\n");
+        return 1;
+      }
+      healthy.push_back(std::chrono::duration<double>(Clock::now() - t0).count() * 1e6);
+    }
+    std::sort(healthy.begin(), healthy.end());
+    const double median_us = percentile(healthy, 50);
+    // >= 50x the healthy median, floored at 10ms so the injected tail is
+    // unambiguous against scheduler noise on tiny-median boxes.
+    const uint32_t slow_ms = std::max<uint32_t>(
+        10, static_cast<uint32_t>(50.0 * median_us / 1000.0 + 0.5));
+
+    auto run_phase = [&](bool hedge, uint64_t& fired, uint64_t& wins) -> std::vector<double> {
+      client::ClientOptions copts;
+      copts.hedge_reads = hedge;
+      copts.hedge_delay_ms = 1;  // fixed trigger: the A/B isolates hedging
+      // Neutralize the latency-tripped breaker for BOTH phases: routing
+      // around the slow replica is the breaker's (separately tested) job;
+      // this row measures what hedging alone buys.
+      copts.breaker.slow_threshold = 1'000'000'000;
+      auto c = cluster->make_client(copts);
+      transport::FaultSpec spec;
+      spec.latency_ms = slow_ms;
+      spec.latency_endpoint = slow_endpoint;
+      c->inject_data_client_for_test(transport::make_faulty_transport_client(
+          transport::make_transport_client(), spec));
+      const uint64_t fired0 = robust_counters().hedges_fired.load();
+      const uint64_t wins0 = robust_counters().hedge_wins.load();
+      std::vector<double> lat;
+      lat.reserve(static_cast<size_t>(iterations));
+      for (int it = 0; it < iterations; ++it) {
+        const auto t0 = Clock::now();
+        if (!c->get_into(okey, buf.data(), buf.size()).ok()) return {};
+        lat.push_back(std::chrono::duration<double>(Clock::now() - t0).count() * 1e6);
+      }
+      std::sort(lat.begin(), lat.end());
+      fired = robust_counters().hedges_fired.load() - fired0;
+      wins = robust_counters().hedge_wins.load() - wins0;
+      return lat;
+    };
+    uint64_t off_fired = 0, off_wins = 0, on_fired = 0, on_wins = 0;
+    auto off = run_phase(false, off_fired, off_wins);
+    auto on = run_phase(true, on_fired, on_wins);
+    if (off.empty() || on.empty()) {
+      std::fprintf(stderr, "overload: phase read failed\n");
+      return 1;
+    }
+    const double ratio = percentile(on, 99) > 0 ? percentile(off, 99) / percentile(on, 99)
+                                                : 0.0;
+    if (json) {
+      std::printf(
+          "{\"op\": \"overload\", \"bytes\": %llu, \"median_us\": %.1f, "
+          "\"slow_ms\": %u, "
+          "\"off_p50_us\": %.1f, \"off_p99_us\": %.1f, \"off_p999_us\": %.1f, "
+          "\"on_p50_us\": %.1f, \"on_p99_us\": %.1f, \"on_p999_us\": %.1f, "
+          "\"hedge_p99_improvement_x\": %.1f, \"hedges_fired\": %llu, "
+          "\"hedge_wins\": %llu}\n",
+          (unsigned long long)size, median_us, slow_ms, percentile(off, 50),
+          percentile(off, 99), percentile(off, 99.9), percentile(on, 50),
+          percentile(on, 99), percentile(on, 99.9), ratio,
+          (unsigned long long)on_fired, (unsigned long long)on_wins);
+    } else {
+      std::printf(
+          "overload (1 slow worker, %u ms ~ %.0fx median): hedging OFF "
+          "p50 %.0f p99 %.0f p99.9 %.0f us | ON p50 %.0f p99 %.0f p99.9 %.0f us "
+          "(p99 %.1fx better; %llu hedges, %llu wins)\n",
+          slow_ms, slow_ms * 1000.0 / std::max(1.0, median_us), percentile(off, 50),
+          percentile(off, 99), percentile(off, 99.9), percentile(on, 50),
+          percentile(on, 99), percentile(on, 99.9), ratio,
+          (unsigned long long)on_fired, (unsigned long long)on_wins);
     }
     return 0;
   }

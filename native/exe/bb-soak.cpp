@@ -83,11 +83,13 @@ struct LiveSet {
 int main(int argc, char** argv) {
   int seconds = 60;
   uint64_t seed = 42;
+  bool slow_worker = false;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--seconds") && i + 1 < argc) seconds = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--seed") && i + 1 < argc) seed = std::stoull(argv[++i]);
+    else if (!std::strcmp(argv[i], "--slow-worker")) slow_worker = true;
     else if (!std::strcmp(argv[i], "--help")) {
-      std::printf("usage: bb-soak [--seconds N] [--seed S]\n");
+      std::printf("usage: bb-soak [--seconds N] [--seed S] [--slow-worker]\n");
       return 0;
     }
   }
@@ -99,6 +101,25 @@ int main(int argc, char** argv) {
   if (cluster.start() != ErrorCode::OK) {
     std::fprintf(stderr, "soak: cluster start failed\n");
     return 1;
+  }
+
+  // --slow-worker chaos mode: instead of killing workers, worker 0's
+  // endpoint gets RANDOM LATENCY SPIKES (the tail-at-scale failure mode —
+  // a node that is alive but 50x slow). Writer clients read through a
+  // latency-injecting transport whose per-op delay follows this dial, so
+  // the chaos thread can spike and clear it mid-run without swapping
+  // transports under I/O; hedged reads + replica failover must keep every
+  // invariant (byte-correct live set, zero losses) intact regardless.
+  auto slow_dial = std::make_shared<std::atomic<uint32_t>>(0);
+  std::string slow_endpoint;
+  if (slow_worker) {
+    auto pools = cluster.worker(0).pools();
+    if (pools.empty() || pools.front().remote.endpoint.empty()) {
+      std::fprintf(stderr, "soak: --slow-worker found no endpoint to slow\n");
+      return 1;
+    }
+    slow_endpoint = pools.front().remote.endpoint;
+    std::printf("soak: slow-worker mode, spiking %s\n", slow_endpoint.c_str());
   }
 
   const auto deadline = Clock::now() + std::chrono::seconds(seconds);
@@ -121,7 +142,18 @@ int main(int argc, char** argv) {
   std::vector<std::thread> writers;
   for (int w = 0; w < 2; ++w) {
     writers.emplace_back([&, w] {
-      auto client = cluster.make_client();
+      client::ClientOptions copts;
+      // Slow-worker mode reads hedge aggressively: a spiked replica must
+      // not gate a read that replication already paid to duplicate.
+      if (slow_worker) copts.hedge_delay_ms = 20;
+      auto client = cluster.make_client(copts);
+      if (slow_worker) {
+        transport::FaultSpec spec;
+        spec.latency_endpoint = slow_endpoint;
+        spec.latency_override_ms = slow_dial;
+        client->inject_data_client_for_test(transport::make_faulty_transport_client(
+            transport::make_transport_client(), spec));
+      }
       std::mt19937_64 rng(seed * 977 + static_cast<uint64_t>(w));
       WorkerConfig wc;
       wc.replication_factor = 2;
@@ -185,6 +217,22 @@ int main(int argc, char** argv) {
   std::thread chaos([&] {
     std::mt19937_64 rng(seed);
     auto client = cluster.make_client();
+    if (slow_worker) {
+      // Latency-spike chaos: spike worker 0's endpoint to 25-250ms per op
+      // (vs ~us-scale healthy local ops — well past 50x median), hold the
+      // spike for a while, clear it, repeat; a scrub pass rides along
+      // sometimes. No kills in this mode: the point is SLOWNESS, with
+      // every worker nominally alive the whole time.
+      while (!stop.load() && Clock::now() < deadline) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(300 + rng() % 700));
+        if (stop.load() || Clock::now() >= deadline) break;
+        slow_dial->store(static_cast<uint32_t>(25 + rng() % 226));
+        std::this_thread::sleep_for(std::chrono::milliseconds(500 + rng() % 1500));
+        slow_dial->store(0);
+        if (rng() % 4 == 0) cluster.keystone().run_scrub_once();
+      }
+      return;
+    }
     while (!stop.load() && Clock::now() < deadline) {
       std::this_thread::sleep_for(std::chrono::milliseconds(1500 + rng() % 2000));
       if (stop.load() || Clock::now() >= deadline) break;

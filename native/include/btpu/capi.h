@@ -115,6 +115,21 @@ uint64_t btpu_tcp_stream_byte_count(void);
 uint64_t btpu_cached_op_count(void);
 uint64_t btpu_cached_byte_count(void);
 
+/* Overload-robustness scoreboard (process-global, btpu RobustCounters):
+ * deadline rejections, sheds, retries, hedged reads, and circuit-breaker
+ * activity in THIS process. Embedded clusters share one process, so both
+ * the server- and client-side counters tell the whole story here; remote
+ * deployments read the server half off the keystone's /metrics. */
+uint64_t btpu_deadline_exceeded_count(void);        /* server: budget spent */
+uint64_t btpu_shed_count(void);                     /* server: overload sheds */
+uint64_t btpu_client_deadline_exceeded_count(void); /* client: failed locally */
+uint64_t btpu_retry_count(void);                    /* client: backoff retries */
+uint64_t btpu_retry_budget_exhausted_count(void);   /* client: retries suppressed */
+uint64_t btpu_hedge_fired_count(void);              /* client: hedges started */
+uint64_t btpu_hedge_win_count(void);                /* client: hedge beat primary */
+uint64_t btpu_breaker_trip_count(void);             /* client: breakers opened */
+uint64_t btpu_breaker_skip_count(void);             /* client: open-endpoint deprioritizations */
+
 /* ---- client object cache (lease-coherent, btpu/cache/object_cache.h) -----
  * cache_bytes > 0 arms a client-side cache of verified object bytes:
  * repeated hot gets are served from memory with zero worker round trips.

@@ -17,12 +17,16 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <functional>
 #include <memory>
 #include <optional>
+#include <thread>
 #include <unordered_map>
 
 #include "btpu/cache/object_cache.h"
+#include "btpu/common/circuit_breaker.h"
+#include "btpu/common/deadline.h"
 #include "btpu/common/thread_annotations.h"
 #include "btpu/coord/coordinator.h"
 #include "btpu/keystone/keystone.h"
@@ -127,6 +131,37 @@ struct ClientOptions {
   // Test hook: force an embedded client onto the remote (lease + watch)
   // coherence path so the lease machinery is testable hermetically.
   bool cache_force_lease_mode{false};
+
+  // ---- overload robustness (deadlines / retries / hedging / breakers) -----
+  // End-to-end deadline applied to every public operation (put/get/remove/
+  // batch...): the budget covers metadata RPCs, data transfers, and every
+  // retry inside the op, and propagates on the wire so servers refuse doomed
+  // work. 0 = no deadline (the pre-deadline behavior). Env override:
+  // BTPU_OP_DEADLINE_MS.
+  uint32_t op_deadline_ms{0};
+  // Backoff for RETRY_LATER sheds and transient transport failures, applied
+  // by the op-level retry loop (and handed to the keystone RPC client).
+  // Retries are additionally gated by a token-bucket retry budget so a
+  // brownout's retry storm self-extinguishes.
+  RetryPolicy retry;
+  // Hedged replica reads (The Tail at Scale): when a replicated read's
+  // first copy exceeds the op's observed p95 latency, fire a second fetch
+  // against another replica and take whichever finishes first. Only engages
+  // with >= 2 host-addressable copies. Env override: BTPU_HEDGE_READS=0/1.
+  bool hedge_reads{true};
+  // Fixed hedge trigger for tests/benches; 0 = adaptive (observed p95,
+  // after hedge_min_samples reads).
+  uint32_t hedge_delay_ms{0};
+  uint32_t hedge_min_samples{16};
+  // Per-worker-endpoint circuit breakers feeding replica choice: copies
+  // served by OPEN endpoints are tried LAST (never skipped entirely — when
+  // every replica is open the read still proceeds). Latency-tripped as well
+  // as error-tripped; see btpu/common/circuit_breaker.h.
+  CircuitBreaker::Options breaker;
+  // How long a put_via_inline refusal pins the fallback before re-probing
+  // (was a hardcoded 60 s penalty). Jittered so a fleet of clients does not
+  // re-probe in lockstep. Env override: BTPU_INLINE_RETRY_MS.
+  uint32_t inline_refusal_backoff_ms{60'000};
 
   // Splits "host:a,host:b,host:c" into keystone_address + keystone_fallbacks
   // (empty segments are skipped).
@@ -265,7 +300,66 @@ class ObjectClient {
     data_ = std::move(data);
   }
 
+  // ---- robustness observability (tests/bench) ------------------------------
+  // The per-endpoint breakers feeding replica choice.
+  BreakerRegistry& breakers() noexcept { return breakers_; }
+  // Observed effective read latency (feeds the hedge trigger).
+  const LatencyTracker& read_latency() const noexcept { return read_latency_; }
+
  private:
+  // ---- replica attempt engine (breakers + hedged reads) --------------------
+  // Shared by get()/get_into(): tries `copies` until one succeeds.
+  // `buffer_for(copy_size)` returns the destination buffer (nullptr = this
+  // copy cannot be accepted, e.g. caller's buffer too small). Copies served
+  // by OPEN circuit breakers are tried last; when the copies are hedgeable
+  // and the op's observed latency justifies it, the first two candidates
+  // race (second fired after the hedge delay, first success wins). On
+  // success `got_size`/`winner` name the serving copy.
+  ErrorCode attempt_copies(const std::vector<CopyPlacement>& copies, bool verify,
+                           const std::function<uint8_t*(uint64_t)>& buffer_for,
+                           uint64_t& got_size, const CopyPlacement** winner);
+  // Breaker-aware candidate order: CLOSED/HALF_OPEN endpoints first, OPEN
+  // ones last (deprioritized, never dropped — all-open still reads).
+  std::vector<size_t> order_copies(const std::vector<CopyPlacement>& copies);
+  void record_copy_outcome(const CopyPlacement& copy, ErrorCode ec, uint64_t us);
+  // Hedge trigger delay in us; 0 = do not hedge this read.
+  uint64_t hedge_delay_us() const;
+  // The threaded two-candidate race (see attempt_copies).
+  ErrorCode hedged_race(const CopyPlacement& primary, const CopyPlacement& secondary,
+                        uint64_t size, bool verify, uint8_t* out,
+                        const CopyPlacement** winner);
+  // Bounded op-level retry on RETRY_LATER sheds (jittered backoff, retry
+  // budget, op deadline) — the client-side half of graceful degradation.
+  template <typename Fn>
+  auto with_shed_retry(Fn&& fn) {
+    auto result = fn();
+    // ONE re-run, not a series: keystone sheds already got the full
+    // hinted-backoff series inside KeystoneRpcClient::call_raw, so looping
+    // here would multiply wire attempts (max_attempts^2) against a server
+    // that is telling us it is overloaded. The single re-run exists for the
+    // data plane (whose gate rejections have no lower retry layer) and as
+    // one last poll after the RPC layer gave up; sustained overload then
+    // surfaces RETRY_LATER to the app — fail fast is the contract.
+    for (uint32_t attempt = 1;
+         error_of(result) == ErrorCode::RETRY_LATER && attempt < 2; ++attempt) {
+      const Deadline deadline = current_op_deadline();
+      if (deadline.expired()) break;
+      if (!op_retry_budget_.try_spend()) {
+        robust_counters().retry_budget_exhausted.fetch_add(1, std::memory_order_relaxed);
+        break;
+      }
+      uint64_t wait_ms = options_.retry.backoff_ms(attempt - 1);
+      if (!deadline.is_infinite())
+        wait_ms = std::min<uint64_t>(wait_ms,
+                                     static_cast<uint64_t>(deadline.remaining_ms()));
+      std::this_thread::sleep_for(std::chrono::milliseconds(wait_ms));
+      robust_counters().retries.fetch_add(1, std::memory_order_relaxed);
+      result = fn();
+    }
+    if (error_of(result) == ErrorCode::OK) op_retry_budget_.on_success();
+    return result;
+  }
+
   // Fast path for wide replicated reads: slices the byte range round-robin
   // across replicas and pulls the slices in parallel. Returns NOT_IMPLEMENTED
   // when not applicable (single copy, small object, device shards, or
@@ -420,6 +514,18 @@ class ObjectClient {
   // spent) is remembered for a while so every small put doesn't pay a
   // wasted refusal RTT; budget refusals are transient, hence the re-probe.
   std::atomic<int64_t> inline_retry_after_ms_{0};
+
+  // ---- overload robustness state -------------------------------------------
+  BreakerRegistry breakers_{};
+  LatencyTracker read_latency_;
+  RetryBudget op_retry_budget_{10.0, 0.5};
+  // In-flight hedge attempt threads (they reference this client): the
+  // destructor must not return while any are running. Loser attempts finish
+  // into their own buffers and are discarded — "cancel" is first-wins at
+  // the caller plus the propagated deadline aborting server-side chunks.
+  std::atomic<uint32_t> hedge_inflight_{0};
+  Mutex hedge_mutex_;
+  std::condition_variable_any hedge_cv_;
 };
 
 }  // namespace btpu::client

@@ -40,7 +40,7 @@ class EmbeddedCluster {
   std::shared_ptr<coord::MemCoordinator> coordinator_shared() { return coordinator_; }
 
   // A client wired to this cluster (embedded keystone, local data plane).
-  std::unique_ptr<ObjectClient> make_client(ClientOptions options = {});
+  std::unique_ptr<ObjectClient> make_client(ClientOptions options = ClientOptions());
 
   // Kills worker i abruptly (no clean unregister): stops heartbeats and
   // drops its transport, as a preemption would.

@@ -1,0 +1,181 @@
+// Server-side admission control: a bounded request gate with adaptive-LIFO
+// shedding, shared by the keystone RPC server and the TCP data-plane server.
+//
+// The failure mode this kills: an overloaded server that keeps accepting
+// work builds an unbounded queue, every queued request eventually times out
+// client-side, and the server spends its capacity producing answers nobody
+// is still waiting for — one slow node browns out the cluster. Instead:
+//   * at most `max_inflight` requests are serviced concurrently;
+//   * at most `max_queue` more may WAIT, newest-first (LIFO): under a burst
+//     the requests most likely to still have a live waiter are served
+//     first, and the oldest waiter — the one closest to its client-side
+//     deadline — is shed with RETRY_LATER + a backoff hint;
+//   * a waiter whose own deadline expires in the queue is rejected with
+//     DEADLINE_EXCEEDED before any work is done for it;
+//   * bytes watermark: admission can also be charged in payload bytes
+//     (data plane), so a few giant transfers cannot monopolize the gate
+//     that op-count alone would admit.
+// Control-plane traffic bypasses the gate entirely at the call site —
+// health checks and leadership probes must work exactly when the gate is
+// closed (that is when operators need them).
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+
+#include "btpu/common/deadline.h"
+#include "btpu/common/thread_annotations.h"
+
+namespace btpu {
+
+class AdmissionGate {
+ public:
+  struct Options {
+    uint32_t max_inflight{64};
+    uint32_t max_queue{128};
+    // Bytes watermark for payload-charged admission; 0 = op count only.
+    uint64_t max_inflight_bytes{0};
+    // Hint returned with RETRY_LATER sheds (the client jitters around it).
+    uint32_t backoff_hint_ms{50};
+  };
+
+  enum class Verdict : uint8_t {
+    kAdmitted = 0,
+    kShed = 1,      // queue over watermark: RETRY_LATER(backoff_hint_ms)
+    kDeadline = 2,  // the waiter's own budget expired while queued
+  };
+
+  explicit AdmissionGate(Options options) : options_(options) {}
+
+  // Blocks until admitted, shed, or the deadline expires. Every kAdmitted
+  // MUST be paired with release(bytes) with the same byte charge.
+  Verdict admit(const Deadline& deadline, uint64_t bytes = 0) {
+    MutexLock lock(mutex_);
+    if (can_enter_locked(bytes)) {
+      enter_locked(bytes);
+      return Verdict::kAdmitted;
+    }
+    if (queue_.size() >= options_.max_queue) {
+      // Adaptive LIFO: shed the OLDEST waiter (front), not the newcomer —
+      // the newcomer's client deadline has the most budget left, so serving
+      // it first maximizes work that still has a live waiter. The shed
+      // waiter gets RETRY_LATER, which is cheaper for its client than the
+      // timeout it was marching toward.
+      if (!queue_.empty()) {
+        Waiter* oldest = queue_.front();
+        queue_.pop_front();
+        oldest->verdict = Verdict::kShed;
+        oldest->decided = true;
+        cv_.notify_all();
+      } else {
+        return Verdict::kShed;  // max_queue == 0: never wait
+      }
+    }
+    Waiter self;
+    self.bytes = bytes;
+    queue_.push_back(&self);
+    while (!self.decided) {
+      if (deadline.is_infinite()) {
+        cv_.wait(lock);
+      } else if (cv_.wait_until(lock, deadline.time_point()) == std::cv_status::timeout &&
+                 !self.decided) {
+        remove_locked(&self);
+        return Verdict::kDeadline;
+      }
+    }
+    return self.verdict;
+  }
+
+  void release(uint64_t bytes = 0) {
+    MutexLock lock(mutex_);
+    --inflight_;
+    inflight_bytes_ -= bytes;
+    wake_locked();
+  }
+
+  uint32_t backoff_hint_ms() const noexcept { return options_.backoff_hint_ms; }
+  const Options& options() const noexcept { return options_; }
+
+  uint32_t inflight() const {
+    MutexLock lock(mutex_);
+    return inflight_;
+  }
+  size_t queued() const {
+    MutexLock lock(mutex_);
+    return queue_.size();
+  }
+
+ private:
+  struct Waiter {
+    uint64_t bytes{0};
+    bool decided{false};
+    Verdict verdict{Verdict::kAdmitted};
+  };
+
+  bool can_enter_locked(uint64_t bytes) const BTPU_REQUIRES(mutex_) {
+    if (inflight_ >= options_.max_inflight) return false;
+    // A gate must never deadlock on one oversized request: bytes are only
+    // a brake when something else is already in flight.
+    if (options_.max_inflight_bytes != 0 && inflight_ > 0 &&
+        inflight_bytes_ + bytes > options_.max_inflight_bytes)
+      return false;
+    return true;
+  }
+  void enter_locked(uint64_t bytes) BTPU_REQUIRES(mutex_) {
+    ++inflight_;
+    inflight_bytes_ += bytes;
+  }
+  void wake_locked() BTPU_REQUIRES(mutex_) {
+    // Admit from the BACK (newest) while capacity allows.
+    bool woke = false;
+    while (!queue_.empty() && can_enter_locked(queue_.back()->bytes)) {
+      Waiter* w = queue_.back();
+      queue_.pop_back();
+      enter_locked(w->bytes);
+      w->verdict = Verdict::kAdmitted;
+      w->decided = true;
+      woke = true;
+    }
+    if (woke) cv_.notify_all();
+  }
+  void remove_locked(Waiter* w) BTPU_REQUIRES(mutex_) {
+    for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+      if (*it == w) {
+        queue_.erase(it);
+        return;
+      }
+    }
+  }
+
+  const Options options_;
+  mutable Mutex mutex_;
+  uint32_t inflight_ BTPU_GUARDED_BY(mutex_){0};
+  uint64_t inflight_bytes_ BTPU_GUARDED_BY(mutex_){0};
+  std::deque<Waiter*> queue_ BTPU_GUARDED_BY(mutex_);
+  std::condition_variable_any cv_;
+};
+
+// RAII admission: verdict() tells the caller whether to serve or reject.
+class AdmissionTicket {
+ public:
+  AdmissionTicket(AdmissionGate& gate, const Deadline& deadline, uint64_t bytes = 0)
+      : gate_(gate), bytes_(bytes), verdict_(gate.admit(deadline, bytes)) {}
+  ~AdmissionTicket() {
+    if (verdict_ == AdmissionGate::Verdict::kAdmitted) gate_.release(bytes_);
+  }
+  AdmissionTicket(const AdmissionTicket&) = delete;
+  AdmissionTicket& operator=(const AdmissionTicket&) = delete;
+
+  AdmissionGate::Verdict verdict() const noexcept { return verdict_; }
+  bool admitted() const noexcept {
+    return verdict_ == AdmissionGate::Verdict::kAdmitted;
+  }
+
+ private:
+  AdmissionGate& gate_;
+  uint64_t bytes_;
+  AdmissionGate::Verdict verdict_;
+};
+
+}  // namespace btpu

@@ -1,0 +1,197 @@
+// Per-endpoint circuit breaker (client-side degradation). Classic three
+// states:
+//   CLOSED    — healthy; every request allowed. Consecutive failures, or
+//               consecutive successes slower than the latency trip line,
+//               open the breaker.
+//   OPEN      — failing; requests are refused locally (the caller routes to
+//               another replica) until the cooldown elapses.
+//   HALF_OPEN — cooldown elapsed; a limited number of probe requests are
+//               let through. A probe success closes the breaker, a probe
+//               failure re-opens it for another (jittered) cooldown.
+// The latency trip exists because a worker that answers correctly but 50x
+// slower than its peers is operationally DOWN for tail-latency purposes —
+// error-rate-only breakers never notice it (The Tail at Scale).
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+
+#include "btpu/common/deadline.h"
+#include "btpu/common/thread_annotations.h"
+
+namespace btpu {
+
+// Namespace-scope (not nested) so it is complete before any default
+// argument references it — gcc-10 rejects both nested-incomplete and
+// brace-init default args for aggregates with member initializers (PR 88165).
+struct BreakerOptions {
+  uint32_t failure_threshold{3};   // consecutive failures to trip
+  uint32_t slow_threshold{5};      // consecutive over-line successes to trip
+  uint32_t open_ms{2000};          // cooldown before half-open probes
+  uint32_t half_open_probes{1};    // probes allowed per half-open window
+  // Latency trip line: a success slower than max(slow_floor_us,
+  // slow_factor * rolling mean) counts as "slow". 0 floor + factor keeps
+  // fast endpoints honest without tripping on cold-start noise.
+  uint64_t slow_floor_us{2000};
+  double slow_factor{8.0};
+};
+
+class CircuitBreaker {
+ public:
+  enum class State : uint8_t { kClosed = 0, kOpen = 1, kHalfOpen = 2 };
+
+  using Options = BreakerOptions;
+
+  explicit CircuitBreaker(Options options = Options()) : options_(options) {}
+
+  // May this request proceed? OPEN returns false (caller skips the
+  // endpoint); an elapsed cooldown transitions to HALF_OPEN and admits up
+  // to half_open_probes callers as probes.
+  bool allow() {
+    MutexLock lock(mutex_);
+    switch (state_) {
+      case State::kClosed:
+        return true;
+      case State::kOpen:
+        if (Clock::now() < open_until_) return false;
+        state_ = State::kHalfOpen;
+        probes_inflight_ = 0;
+        [[fallthrough]];
+      case State::kHalfOpen:
+        if (probes_inflight_ >= options_.half_open_probes) return false;
+        ++probes_inflight_;
+        return true;
+    }
+    return true;
+  }
+
+  void record_success(uint64_t latency_us) {
+    MutexLock lock(mutex_);
+    consecutive_failures_ = 0;
+    if (state_ == State::kHalfOpen) {
+      // A probe that answers but is still over the line has NOT recovered —
+      // closing on it (and folding its latency) would converge the EWMA
+      // onto the slow endpoint's latency and permanently defeat the
+      // latency trip via the recovery path. Re-open instead.
+      const uint64_t probe_line = slow_line_locked();
+      if (latency_us > 0 && probe_line > 0 && latency_us > probe_line) {
+        trip_locked();
+        return;
+      }
+      state_ = State::kClosed;
+      consecutive_slow_ = 0;
+      if (latency_us > 0) fold_mean_locked(latency_us);
+      return;
+    }
+    // Judge against the PRE-update baseline, and keep slow outliers OUT of
+    // the EWMA: folding them first drags the trip line up behind the very
+    // slowness it is supposed to catch (a 50x-slow worker would raise its
+    // own bar past tripping within three samples).
+    const uint64_t line = slow_line_locked();
+    if (latency_us > 0 && line > 0 && latency_us > line) {
+      if (++consecutive_slow_ >= options_.slow_threshold) trip_locked();
+      return;
+    }
+    consecutive_slow_ = 0;
+    // Rolling mean (EWMA, alpha 1/8) over healthy successes only: failures
+    // and over-line outliers carry no baseline information.
+    if (latency_us > 0) fold_mean_locked(latency_us);
+  }
+
+  void record_failure() {
+    MutexLock lock(mutex_);
+    consecutive_slow_ = 0;
+    if (state_ == State::kHalfOpen) {
+      trip_locked();  // the probe failed: straight back to OPEN
+      return;
+    }
+    if (state_ == State::kClosed && ++consecutive_failures_ >= options_.failure_threshold)
+      trip_locked();
+  }
+
+  // Non-mutating ordering hint: is this endpoint currently refusing
+  // requests? Unlike allow(), never consumes a half-open probe slot — use
+  // for candidate ORDERING, and allow() only for attempts actually made
+  // (an admitted probe that is never attempted would wedge HALF_OPEN).
+  bool open_now() const {
+    MutexLock lock(mutex_);
+    return state_ == State::kOpen && Clock::now() < open_until_;
+  }
+
+  State state() const {
+    MutexLock lock(mutex_);
+    return state_;
+  }
+  uint64_t mean_latency_us() const {
+    MutexLock lock(mutex_);
+    return mean_us_;
+  }
+
+ private:
+  using Clock = std::chrono::steady_clock;
+
+  void fold_mean_locked(uint64_t latency_us) BTPU_REQUIRES(mutex_) {
+    mean_us_ = mean_us_ == 0 ? latency_us : (mean_us_ * 7 + latency_us) / 8;
+  }
+
+  uint64_t slow_line_locked() const BTPU_REQUIRES(mutex_) {
+    if (mean_us_ == 0) return 0;  // no baseline yet: never trip on latency
+    const auto scaled = static_cast<uint64_t>(static_cast<double>(mean_us_) *
+                                              options_.slow_factor);
+    return scaled > options_.slow_floor_us ? scaled : options_.slow_floor_us;
+  }
+
+  void trip_locked() BTPU_REQUIRES(mutex_) {
+    state_ = State::kOpen;
+    consecutive_failures_ = 0;
+    consecutive_slow_ = 0;
+    // Jittered cooldown: replicas tripped by one event must not all probe
+    // the sick endpoint in the same instant.
+    RetryPolicy jitter{options_.open_ms, options_.open_ms, 1.0, 1};
+    open_until_ = Clock::now() + std::chrono::milliseconds(jitter.backoff_ms(0));
+    robust_counters().breaker_trips.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  const Options options_;
+  mutable Mutex mutex_;
+  State state_ BTPU_GUARDED_BY(mutex_){State::kClosed};
+  uint32_t consecutive_failures_ BTPU_GUARDED_BY(mutex_){0};
+  uint32_t consecutive_slow_ BTPU_GUARDED_BY(mutex_){0};
+  uint32_t probes_inflight_ BTPU_GUARDED_BY(mutex_){0};
+  uint64_t mean_us_ BTPU_GUARDED_BY(mutex_){0};
+  Clock::time_point open_until_ BTPU_GUARDED_BY(mutex_){};
+};
+
+// Endpoint-keyed breaker registry (one per ObjectClient). Breakers are
+// created on first sight and live for the registry's lifetime — endpoints
+// are worker transport addresses, a small, stable set.
+class BreakerRegistry {
+ public:
+  explicit BreakerRegistry(CircuitBreaker::Options options = CircuitBreaker::Options())
+      : options_(options) {}
+
+  std::shared_ptr<CircuitBreaker> for_endpoint(const std::string& endpoint) {
+    MutexLock lock(mutex_);
+    auto& slot = breakers_[endpoint];
+    if (!slot) slot = std::make_shared<CircuitBreaker>(options_);
+    return slot;
+  }
+
+  // Peek without creating (counter/test surface).
+  std::shared_ptr<CircuitBreaker> peek(const std::string& endpoint) const {
+    MutexLock lock(mutex_);
+    auto it = breakers_.find(endpoint);
+    return it == breakers_.end() ? nullptr : it->second;
+  }
+
+ private:
+  const CircuitBreaker::Options options_;
+  mutable Mutex mutex_;
+  std::unordered_map<std::string, std::shared_ptr<CircuitBreaker>> breakers_
+      BTPU_GUARDED_BY(mutex_);
+};
+
+}  // namespace btpu

@@ -1,0 +1,201 @@
+// End-to-end deadlines, retry policies, and retry budgets — the
+// overload-robustness primitives adopted by every layer that waits or
+// retries (keystone RPC client/server, TCP data plane, remote coordinator,
+// object client). The design follows Dean & Barroso's *The Tail at Scale*:
+//   * a Deadline is ABSOLUTE (steady_clock) and propagates as a RELATIVE
+//     remaining-budget field on the wire, so cross-host clock skew can
+//     never expire a request spuriously — each hop restarts the clock from
+//     the budget it received;
+//   * retries use jittered exponential backoff (RetryPolicy) gated by a
+//     per-client token-bucket RetryBudget, so a brownout's retry storm
+//     self-extinguishes instead of amplifying the overload;
+//   * servers reject work they cannot finish in budget (DEADLINE_EXCEEDED)
+//     or cannot start at all (RETRY_LATER + backoff hint) instead of
+//     queueing unboundedly — see btpu/common/admission.h.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <limits>
+
+namespace btpu {
+
+// Absolute per-operation deadline. Default-constructed = infinite (no
+// deadline), which keeps every existing call site's behavior until a caller
+// opts in. Cheap to copy; steady_clock only (never wall time).
+class Deadline {
+ public:
+  using Clock = std::chrono::steady_clock;
+
+  constexpr Deadline() = default;
+
+  static Deadline infinite() noexcept { return Deadline{}; }
+  static Deadline at(Clock::time_point tp) noexcept {
+    Deadline d;
+    d.tp_ = tp;
+    return d;
+  }
+  // ms <= 0 = infinite (the "disabled" config value).
+  static Deadline after_ms(int64_t ms) noexcept {
+    if (ms <= 0) return infinite();
+    return at(Clock::now() + std::chrono::milliseconds(ms));
+  }
+  // Reconstructs a deadline from a wire budget (remaining ms at the
+  // sender): 0 = none. The receiver's clock starts at receipt, which is
+  // the skew-free interpretation of a relative budget.
+  static Deadline from_wire(uint32_t budget_ms) noexcept {
+    return budget_ms == 0 ? infinite() : after_ms(budget_ms);
+  }
+
+  bool is_infinite() const noexcept { return tp_ == Clock::time_point::max(); }
+  bool expired() const noexcept { return !is_infinite() && Clock::now() >= tp_; }
+  Clock::time_point time_point() const noexcept { return tp_; }
+
+  // Remaining budget, clamped to >= 0. Infinite reports INT64_MAX.
+  int64_t remaining_ms() const noexcept {
+    if (is_infinite()) return std::numeric_limits<int64_t>::max();
+    const auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                          tp_ - Clock::now())
+                          .count();
+    return left > 0 ? left : 0;
+  }
+
+  // The relative budget stamped on the wire: 0 = no deadline. An expired
+  // deadline reports... nothing useful — callers must fail locally instead
+  // of sending (a 0 budget on the wire means "none", and an explicit
+  // 0-remaining send would be doomed work for the server). Clamped to u32.
+  uint32_t wire_budget_ms() const noexcept {
+    if (is_infinite()) return 0;
+    const int64_t left = remaining_ms();
+    if (left <= 0) return 1;  // callers check expired() first; never send 0
+    return left > std::numeric_limits<uint32_t>::max()
+               ? std::numeric_limits<uint32_t>::max()
+               : static_cast<uint32_t>(left);
+  }
+
+  // The tighter of two deadlines.
+  Deadline min(const Deadline& other) const noexcept {
+    return tp_ <= other.tp_ ? *this : other;
+  }
+
+ private:
+  Clock::time_point tp_{Clock::time_point::max()};
+};
+
+// Jittered exponential backoff. backoff_ms(0) is the first retry's wait.
+// The jitter is "equal jitter": wait = raw/2 + uniform(0, raw/2], so
+// synchronized failures decorrelate while the floor keeps backoff honest.
+struct RetryPolicy {
+  uint32_t base_ms{5};
+  uint32_t max_ms{2000};
+  double multiplier{2.0};
+  uint32_t max_attempts{4};  // total attempts including the first
+
+  uint64_t backoff_ms(uint32_t attempt) const noexcept;
+};
+
+// Per-client retry *budget* (the gRPC retry-throttler shape): every retry
+// spends one token, every success refunds `refund` tokens, and retries are
+// only permitted while the bucket is above half capacity. Under a sustained
+// brownout the bucket drains in O(capacity) retries and the client stops
+// amplifying load until real successes refill it. Thread-safe, lock-free.
+class RetryBudget {
+ public:
+  explicit RetryBudget(double capacity = 10.0, double refund = 0.5) noexcept
+      : capacity_mil_(static_cast<int64_t>(capacity * 1000)),
+        refund_mil_(static_cast<int64_t>(refund * 1000)),
+        tokens_mil_(static_cast<int64_t>(capacity * 1000)) {}
+
+  // True (and spends a token) when a retry is currently affordable.
+  bool try_spend() noexcept {
+    int64_t cur = tokens_mil_.load(std::memory_order_relaxed);
+    while (true) {
+      if (cur <= capacity_mil_ / 2) return false;
+      if (tokens_mil_.compare_exchange_weak(cur, cur - 1000,
+                                            std::memory_order_relaxed))
+        return true;
+    }
+  }
+
+  void on_success() noexcept {
+    int64_t cur = tokens_mil_.load(std::memory_order_relaxed);
+    while (true) {
+      const int64_t next = cur + refund_mil_ > capacity_mil_ ? capacity_mil_
+                                                             : cur + refund_mil_;
+      if (next == cur) return;
+      if (tokens_mil_.compare_exchange_weak(cur, next, std::memory_order_relaxed))
+        return;
+    }
+  }
+
+  double tokens() const noexcept {
+    return static_cast<double>(tokens_mil_.load(std::memory_order_relaxed)) / 1000.0;
+  }
+
+ private:
+  const int64_t capacity_mil_;
+  const int64_t refund_mil_;
+  std::atomic<int64_t> tokens_mil_;
+};
+
+// ---- ambient per-operation deadline ----------------------------------------
+// The object client opens an OpDeadlineScope at each public entry point;
+// everything beneath it on the same thread (keystone RPC calls, wire-op
+// construction, coordinator calls) inherits the deadline without threading
+// a parameter through every signature. Fan-out worker threads do NOT
+// inherit it — deadline-carrying state that crosses threads rides the
+// WireOp itself (transport.h), which is stamped on the calling thread.
+Deadline current_op_deadline() noexcept;
+
+class OpDeadlineScope {
+ public:
+  explicit OpDeadlineScope(Deadline d) noexcept;
+  // ms <= 0 = no deadline (scope still nests correctly).
+  explicit OpDeadlineScope(int64_t ms) noexcept : OpDeadlineScope(Deadline::after_ms(ms)) {}
+  ~OpDeadlineScope();
+  OpDeadlineScope(const OpDeadlineScope&) = delete;
+  OpDeadlineScope& operator=(const OpDeadlineScope&) = delete;
+
+ private:
+  Deadline saved_;
+};
+
+// ---- streaming latency estimate (hedging trigger) --------------------------
+// Fixed ring of recent samples; quantile() copies + selects under the lock.
+// Cheap enough for once-per-hedged-read use; the record path is O(1).
+class LatencyTracker {
+ public:
+  void record_us(uint64_t us) noexcept;
+  // 0 when fewer than min_samples recorded (callers fall back to a fixed
+  // hedge delay or skip hedging).
+  uint64_t quantile_us(double q, size_t min_samples = 16) const noexcept;
+  size_t samples() const noexcept { return count_.load(std::memory_order_relaxed); }
+
+ private:
+  static constexpr size_t kRing = 256;
+  mutable std::atomic<uint64_t> ring_[kRing] = {};
+  std::atomic<size_t> count_{0};
+};
+
+// ---- process-global robustness counters ------------------------------------
+// One home for the overload-path scoreboard, exported through /metrics
+// (keystone process) and the capi lane counters (client process). Embedded
+// clusters share a process, so both views see the whole story there.
+struct RobustCounters {
+  // Server side (this process's keystone RPC server + data-plane server).
+  std::atomic<uint64_t> deadline_exceeded{0};  // requests rejected: budget spent
+  std::atomic<uint64_t> shed{0};               // requests shed: queue/bytes over watermark
+  // Client side (this process's object/RPC clients).
+  std::atomic<uint64_t> client_deadline_exceeded{0};  // ops failed locally on expiry
+  std::atomic<uint64_t> retries{0};                   // backoff retries performed
+  std::atomic<uint64_t> retry_budget_exhausted{0};    // retries suppressed by budget
+  std::atomic<uint64_t> hedges_fired{0};              // secondary replica fetches started
+  std::atomic<uint64_t> hedge_wins{0};                // hedge finished before the primary
+  std::atomic<uint64_t> breaker_trips{0};             // breakers moved CLOSED -> OPEN
+  std::atomic<uint64_t> breaker_skips{0};             // replica attempts skipped while open
+};
+
+RobustCounters& robust_counters() noexcept;
+
+}  // namespace btpu

@@ -1,0 +1,24 @@
+// Shared environment-knob parsing. Every operator override in the native
+// tree reads through these, so empty-string / garbage handling stays
+// uniform: unset OR empty falls back, non-numeric parses as 0 (strtoul
+// semantics) — a deliberate "explicitly off" escape hatch.
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+
+namespace btpu {
+
+inline uint64_t env_u64(const char* name, uint64_t fallback) {
+  const char* v = std::getenv(name);
+  if (!v || !v[0]) return fallback;
+  return std::strtoull(v, nullptr, 10);
+}
+
+inline uint32_t env_u32(const char* name, uint32_t fallback) {
+  const char* v = std::getenv(name);
+  if (!v || !v[0]) return fallback;
+  return static_cast<uint32_t>(std::strtoul(v, nullptr, 10));
+}
+
+}  // namespace btpu

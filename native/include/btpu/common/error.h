@@ -33,6 +33,13 @@ enum class ErrorCode : uint32_t {
   OPERATION_TIMEOUT,
   RESOURCE_EXHAUSTED,
   NOT_IMPLEMENTED,
+  // Appended (wire append-only rule): the request's end-to-end deadline
+  // budget was spent — retrying is pointless unless the caller extends it.
+  DEADLINE_EXCEEDED,
+  // Appended: the server shed the request under overload before doing any
+  // work. Safe to retry for EVERY method (mutations included — shed happens
+  // before dispatch), after the backoff hint that rides the rejection.
+  RETRY_LATER,
 
   // Storage (2000-2999)
   BUFFER_OVERFLOW = domain_base(Domain::STORAGE),

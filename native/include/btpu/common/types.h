@@ -141,6 +141,14 @@ struct RemoteDescriptor {
   // stack where the syscall is denied) fall back to the primary transport
   // above. Wire-append-only.
   std::string pvm_endpoint;
+  // Raw-framing dialect of the endpoint's data plane (tcp: the packed
+  // DataRequestHeader/StagedFrame layout, which has NO length prefix and so
+  // no tail tolerance). Advertised at region registration, checked by the
+  // client before the first byte goes out: a mismatched pair fails fast
+  // with REMOTE_ENDPOINT_ERROR instead of desyncing the byte stream.
+  // 0 = pre-versioned peer (or a transport whose framing is self-describing
+  // and never checks). Wire-append-only.
+  uint32_t data_wire_version{0};
 
   bool operator==(const RemoteDescriptor&) const = default;
 };
@@ -562,6 +570,18 @@ struct KeystoneConfig {
   // restart recovers the object map (the reference forgets all objects on
   // restart, SURVEY §5 checkpoint/resume). No-op without a coordinator.
   bool persist_objects{true};
+
+  // RPC admission control (btpu/common/admission.h): at most
+  // rpc_max_inflight non-control requests are serviced concurrently, at
+  // most rpc_max_queue more wait (adaptive LIFO — the oldest waiter is shed
+  // with RETRY_LATER + rpc_shed_backoff_hint_ms when the queue overflows).
+  // Control-plane ops (ping, view version, cluster stats, drain) bypass the
+  // gate so operators can observe an overloaded keystone. 0 = auto
+  // (BTPU_RPC_MAX_INFLIGHT / BTPU_RPC_MAX_QUEUE env overrides, else
+  // 4 x metadata shard count inflight, 4 x that queued).
+  uint32_t rpc_max_inflight{0};
+  uint32_t rpc_max_queue{0};
+  uint32_t rpc_shed_backoff_hint_ms{50};
 
   // Object-map shard count (lock striping): single-key metadata ops lock
   // exactly one shard, so control-plane throughput scales with cores

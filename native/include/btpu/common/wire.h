@@ -228,12 +228,14 @@ inline bool decode(Reader& r, TopoCoord& t) { return decode_struct(r, t.slice_id
 
 inline void encode(Writer& w, const RemoteDescriptor& d) {
   encode_struct(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr,
-                d.pvm_endpoint);
+                d.pvm_endpoint, d.data_wire_version);
 }
 inline bool decode(Reader& r, RemoteDescriptor& d) {
   // `pvm_endpoint` appended after fabric_addr; old frames leave it "".
+  // `data_wire_version` appended after that; old frames leave it 0
+  // (pre-versioned peer — the tcp client refuses those, see types.h).
   return decode_struct(r, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr,
-                       d.pvm_endpoint);
+                       d.pvm_endpoint, d.data_wire_version);
 }
 
 inline void encode(Writer& w, const MemoryLocation& m) { encode_struct(w, m.remote_addr, m.rkey, m.size); }

@@ -68,6 +68,19 @@ class RemoteCoordinator : public Coordinator {
 
   bool connected() const override { return connected_.load(); }
 
+  // Bound on how long an event-channel call waits for its routed response
+  // (the reader thread may be wedged behind a dead server). Replaces the
+  // old hardcoded 10 s: configurable here, via BTPU_COORD_RESPONSE_TIMEOUT_MS
+  // at construction, and always tightened by the caller's ambient per-op
+  // deadline (btpu/common/deadline.h). Not thread-safe against in-flight
+  // calls — configure before use. 0 restores the default.
+  void set_response_timeout_ms(uint32_t ms) noexcept {
+    response_timeout_ms_ = ms ? ms : kDefaultResponseTimeoutMs;
+  }
+  uint32_t response_timeout_ms() const noexcept { return response_timeout_ms_; }
+
+  static constexpr uint32_t kDefaultResponseTimeoutMs = 10'000;
+
  private:
   // Strict request/response on the call channel. `retried` (optional)
   // reports whether the op was re-sent after a reconnect — callers of
@@ -102,6 +115,7 @@ class RemoteCoordinator : public Coordinator {
   }
 
   std::vector<std::string> endpoints_;
+  uint32_t response_timeout_ms_{kDefaultResponseTimeoutMs};
   size_t endpoint_index_ BTPU_GUARDED_BY(reconnect_mutex_){0};
   std::atomic<bool> connected_{false};
   std::atomic<bool> stopping_{false};

@@ -19,12 +19,19 @@
 #pragma once
 
 #include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "btpu/common/error.h"
 
 namespace btpu::rpc {
 
 // Wire-protocol version advertised in the kPing handshake. Bump when the
 // append-only rule is insufficient to describe a change (should be rare).
-inline constexpr uint32_t kProtocolVersion = 3;
+// v4: requests may carry a deadline trailer (below) and servers may answer
+// any request with a control-error frame (kControlErrorOpcode) — both are
+// ignored-by-old-peers constructs, so v3<->v4 still interoperates.
+inline constexpr uint32_t kProtocolVersion = 4;
 
 // First version whose put_complete APPLIES the appended content_crc field.
 // A newer client talking to an older keystone must keep stamping the
@@ -55,5 +62,69 @@ enum class Method : uint8_t {
   kPutCommitSlot = 83,
   kPutInline = 84,
 };
+
+// ---- deadline propagation (protocol v4) ------------------------------------
+// The per-request deadline rides as a TRAILER appended after the encoded
+// request struct: [u64 magic][u32 remaining_budget_ms]. Request payloads are
+// decoded tail-tolerantly (wire.h from_bytes_lax), so a pre-v4 server simply
+// ignores the 12 extra bytes; a v4 server strips and honors them. The budget
+// is RELATIVE (remaining ms at send time) so clock skew between hosts can
+// never expire a request spuriously — the receiving server restarts the
+// clock at receipt. budget_ms == 0 on the wire is reserved for "already
+// expired" (hand-framed only; clients fail locally instead of sending it).
+inline constexpr uint64_t kDeadlineTrailerMagic = 0xB7D0DEAD11A3C4F5ull;
+inline constexpr size_t kDeadlineTrailerBytes = 12;
+
+inline void append_deadline_trailer(std::vector<uint8_t>& payload, uint32_t budget_ms) {
+  const size_t at = payload.size();
+  payload.resize(at + kDeadlineTrailerBytes);
+  std::memcpy(payload.data() + at, &kDeadlineTrailerMagic, sizeof(kDeadlineTrailerMagic));
+  std::memcpy(payload.data() + at + sizeof(kDeadlineTrailerMagic), &budget_ms,
+              sizeof(budget_ms));
+}
+
+// Strips a trailing deadline trailer when present. Returns true and the
+// budget (which may legitimately be 0 = expired-on-arrival) iff the magic
+// matched; payload is truncated to the bare request bytes either way a
+// trailer was found.
+inline bool strip_deadline_trailer(std::vector<uint8_t>& payload, uint32_t& budget_ms) {
+  if (payload.size() < kDeadlineTrailerBytes) return false;
+  const size_t at = payload.size() - kDeadlineTrailerBytes;
+  uint64_t magic = 0;
+  std::memcpy(&magic, payload.data() + at, sizeof(magic));
+  if (magic != kDeadlineTrailerMagic) return false;
+  std::memcpy(&budget_ms, payload.data() + at + sizeof(magic), sizeof(budget_ms));
+  payload.resize(at);
+  return true;
+}
+
+// ---- control-error frames (protocol v4) ------------------------------------
+// Overload rejections (RETRY_LATER + backoff hint) and deadline rejections
+// (DEADLINE_EXCEEDED) are answered BEFORE the request is dispatched, so they
+// cannot ride the per-method response structs. The server instead answers
+// with this reserved response opcode and payload [u32 error][u32 hint_ms].
+// A v4 client surfaces the error without closing the connection; a pre-v4
+// client sees a mismatched response opcode and treats the call as failed —
+// which under overload it is.
+inline constexpr uint8_t kControlErrorOpcode = 0xEE;
+
+inline std::vector<uint8_t> encode_control_error(ErrorCode code, uint32_t hint_ms) {
+  std::vector<uint8_t> out(8);
+  const uint32_t raw = static_cast<uint32_t>(code);
+  std::memcpy(out.data(), &raw, sizeof(raw));
+  std::memcpy(out.data() + 4, &hint_ms, sizeof(hint_ms));
+  return out;
+}
+
+inline bool decode_control_error(const std::vector<uint8_t>& payload, ErrorCode& code,
+                                 uint32_t& hint_ms) {
+  if (payload.size() < 8) return false;
+  uint32_t raw = 0;
+  std::memcpy(&raw, payload.data(), sizeof(raw));
+  std::memcpy(&hint_ms, payload.data() + 4, sizeof(hint_ms));
+  code = static_cast<ErrorCode>(raw);
+  return code == ErrorCode::RETRY_LATER || code == ErrorCode::DEADLINE_EXCEEDED ||
+         code == ErrorCode::RESOURCE_EXHAUSTED;
+}
 
 }  // namespace btpu::rpc

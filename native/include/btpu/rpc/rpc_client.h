@@ -1,9 +1,15 @@
 // Keystone RPC client: same method surface as KeystoneService, over TCP.
-// Reconnects transparently after keystone restarts (one retry per call).
+// Reconnects transparently after keystone restarts. Retries (stale
+// connections, RETRY_LATER sheds) follow a jittered-exponential RetryPolicy
+// gated by a token-bucket RetryBudget, and every call honors the ambient
+// per-op deadline (btpu/common/deadline.h): the remaining budget rides the
+// request as a v4 trailer, connects are capped to the remaining budget, and
+// an expired deadline fails locally instead of sending doomed work.
 #pragma once
 
 #include <atomic>
 
+#include "btpu/common/deadline.h"
 #include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 #include "btpu/net/net.h"
@@ -57,6 +63,11 @@ class KeystoneRpcClient {
     return server_proto_version_.load(std::memory_order_relaxed);
   }
 
+  // Retry behavior for stale connections and RETRY_LATER sheds. Not
+  // thread-safe against in-flight calls — configure before use.
+  void set_retry_policy(const RetryPolicy& policy) noexcept { retry_policy_ = policy; }
+  const RetryPolicy& retry_policy() const noexcept { return retry_policy_; }
+
   Result<std::vector<Result<bool>>> batch_object_exists(const std::vector<ObjectKey>& keys);
   Result<std::vector<Result<std::vector<CopyPlacement>>>> batch_get_workers(
       const std::vector<ObjectKey>& keys);
@@ -73,12 +84,15 @@ class KeystoneRpcClient {
   ErrorCode call(uint8_t opcode, const Req& req, Resp& resp);
   ErrorCode call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                      std::vector<uint8_t>& resp);
-  ErrorCode ensure_connected_locked() BTPU_REQUIRES(mutex_);
+  ErrorCode ensure_connected_locked(const Deadline& deadline) BTPU_REQUIRES(mutex_);
 
   std::string endpoint_;
   mutable Mutex mutex_;
   net::Socket sock_ BTPU_GUARDED_BY(mutex_);
   std::atomic<uint32_t> server_proto_version_{0};
+  // Calls serialize on mutex_, so plain members are fine.
+  RetryPolicy retry_policy_{};
+  RetryBudget retry_budget_{10.0, 0.5};
 };
 
 }  // namespace btpu::rpc

@@ -7,6 +7,8 @@
 #include <memory>
 #include <thread>
 
+#include "btpu/common/admission.h"
+#include "btpu/common/deadline.h"
 #include "btpu/common/thread_annotations.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/net/net.h"
@@ -23,6 +25,8 @@ class KeystoneRpcServer {
   void stop();
   uint16_t port() const noexcept { return port_; }
   std::string endpoint() const { return host_ + ":" + std::to_string(port_); }
+  // Observability for tests/metrics.
+  const AdmissionGate& gate() const noexcept { return *gate_; }
 
  private:
   void accept_loop();
@@ -32,6 +36,14 @@ class KeystoneRpcServer {
   keystone::KeystoneService& service_;
   std::string host_;
   uint16_t port_;
+  // Admission gate for non-control ops (see AdmissionGate). Control ops —
+  // ping, view version, cluster stats, drain — bypass it so the control
+  // plane stays observable exactly when the gate is closed.
+  std::unique_ptr<AdmissionGate> gate_;
+  // Test hook: per-request service delay (BTPU_RPC_TEST_DELAY_MS at
+  // construction) so admission/deadline behavior is deterministically
+  // testable without a genuinely slow keystone.
+  uint32_t test_delay_ms_{0};
   net::Socket listener_;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;

@@ -24,12 +24,14 @@
 //   * every access is validated against the registered region bounds + rkey.
 #pragma once
 
+#include <atomic>
 #include <functional>
 #include <memory>
 
 // The transport interfaces are stateless, but every implementation guards
 // registries/pools/staging with the annotated mutexes; pulling the
 // annotation macros in here keeps all transport TUs on one idiom.
+#include "btpu/common/deadline.h"
 #include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 
@@ -98,6 +100,16 @@ class TransportServer {
 // One wire-level one-sided transfer in a batch. Always flat addressing
 // (MemoryLocation-style, including virtual regions); device shards batch
 // through shard_io_batch instead.
+// Dialect of the tcp data plane's raw packed framing (DataRequestHeader /
+// StagedFrame — no length prefix, so no tail tolerance). Bump on ANY layout
+// change to those headers. Advertised in RemoteDescriptor::data_wire_version
+// at region registration; the tcp client refuses a POSITIVE mismatch
+// (v != 0 && v != ours) before the first byte goes out, so a mixed-version
+// client/worker pair fails fast with REMOTE_ENDPOINT_ERROR instead of
+// desyncing the stream. 0 (pre-versioned metadata: legacy peers, WAL-restored
+// placements) is served on the documented both-sides-ship-together contract.
+inline constexpr uint32_t kTcpDataWireVersion = 1;
+
 struct WireOp {
   const RemoteDescriptor* remote{nullptr};
   uint64_t addr{0};
@@ -112,6 +124,14 @@ struct WireOp {
   // CRCs with ~no extra sweep of the bytes.
   bool want_crc{false};
   uint32_t crc{0};
+  // End-to-end deadline for this op (default infinite). Stamped by
+  // make_wire_op from the ambient per-op deadline on the CALLING thread
+  // (fan-out worker threads read it from here, never from the thread-local).
+  // The TCP engine propagates the remaining budget on every request header
+  // it issues, skips sub-ops whose budget is already spent
+  // (DEADLINE_EXCEEDED locally), and the serving side aborts chunks whose
+  // budget expired in flight.
+  Deadline deadline{};
 };
 
 // Client side: one-sided read/write against any advertised descriptor.
@@ -161,12 +181,25 @@ std::unique_ptr<TransportClient> make_transport_client();
 // Fault injection for hermetic failure-path tests (the reference has no
 // fault injection of any kind, SURVEY §5): wraps a client and fails the
 // n-th read/write exactly once with the given error, and/or persistently
-// fails every op aimed at one endpoint (a dead replica/worker).
+// fails every op aimed at one endpoint (a dead replica/worker), and/or
+// injects LATENCY (fixed + jitter per op) so slow-worker scenarios — the
+// tail-at-scale failure mode — are testable, not just hard errors.
 struct FaultSpec {
   uint32_t fail_nth_write{0};  // 1-based op count; 0 = never fail
   uint32_t fail_nth_read{0};
   std::string fail_endpoint;   // every op on this endpoint fails; "" = off
   ErrorCode error{ErrorCode::NETWORK_ERROR};
+  // Injected latency: every matching op sleeps latency_ms plus uniform
+  // [0, latency_jitter_ms] BEFORE executing. latency_endpoint narrows the
+  // injection to one endpoint ("" = all ops) — "one slow worker" is
+  // latency_endpoint = that worker's pool endpoint.
+  uint32_t latency_ms{0};
+  uint32_t latency_jitter_ms{0};
+  std::string latency_endpoint;
+  // Dynamic override (chaos harnesses): when set, the value read per op
+  // REPLACES latency_ms, so a soak's chaos thread can spike and clear a
+  // worker's latency mid-run without swapping transports under I/O.
+  std::shared_ptr<const std::atomic<uint32_t>> latency_override_ms;
 };
 std::unique_ptr<TransportClient> make_faulty_transport_client(
     std::unique_ptr<TransportClient> inner, FaultSpec spec);

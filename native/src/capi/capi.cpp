@@ -332,6 +332,34 @@ uint64_t btpu_tcp_stream_byte_count(void) { return transport::tcp_stream_byte_co
 uint64_t btpu_cached_op_count(void) { return cache::cached_op_count(); }
 uint64_t btpu_cached_byte_count(void) { return cache::cached_byte_count(); }
 
+uint64_t btpu_deadline_exceeded_count(void) {
+  return robust_counters().deadline_exceeded.load(std::memory_order_relaxed);
+}
+uint64_t btpu_shed_count(void) {
+  return robust_counters().shed.load(std::memory_order_relaxed);
+}
+uint64_t btpu_client_deadline_exceeded_count(void) {
+  return robust_counters().client_deadline_exceeded.load(std::memory_order_relaxed);
+}
+uint64_t btpu_retry_count(void) {
+  return robust_counters().retries.load(std::memory_order_relaxed);
+}
+uint64_t btpu_retry_budget_exhausted_count(void) {
+  return robust_counters().retry_budget_exhausted.load(std::memory_order_relaxed);
+}
+uint64_t btpu_hedge_fired_count(void) {
+  return robust_counters().hedges_fired.load(std::memory_order_relaxed);
+}
+uint64_t btpu_hedge_win_count(void) {
+  return robust_counters().hedge_wins.load(std::memory_order_relaxed);
+}
+uint64_t btpu_breaker_trip_count(void) {
+  return robust_counters().breaker_trips.load(std::memory_order_relaxed);
+}
+uint64_t btpu_breaker_skip_count(void) {
+  return robust_counters().breaker_skips.load(std::memory_order_relaxed);
+}
+
 void btpu_client_cache_configure(btpu_client* client, uint64_t cache_bytes) {
   if (client && client->impl) client->impl->configure_cache(cache_bytes);
 }

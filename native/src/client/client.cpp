@@ -1,11 +1,13 @@
 #include "btpu/client/client.h"
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <random>
 
 #include "btpu/common/crc32c.h"
+#include "btpu/common/env.h"
 #include "btpu/common/wire.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
@@ -43,16 +45,29 @@ std::string random_slot_tag() {
   std::snprintf(buf, sizeof(buf), "%08x%08x", rd(), rd());
   return buf;
 }
+
+// Operator/env overrides for the robustness knobs (tests and deployments
+// flip these without a code change).
+void apply_robustness_env(ClientOptions& options) {
+  options.op_deadline_ms = env_u32("BTPU_OP_DEADLINE_MS", options.op_deadline_ms);
+  if (const char* v = std::getenv("BTPU_HEDGE_READS"); v && v[0])
+    options.hedge_reads = v[0] != '0';
+  options.inline_refusal_backoff_ms =
+      env_u32("BTPU_INLINE_RETRY_MS", options.inline_refusal_backoff_ms);
+}
 }  // namespace
 
 ObjectClient::ObjectClient(ClientOptions options)
     : options_(std::move(options)),
       verify_default_(options_.verify_reads),
       data_(transport::make_transport_client()),
-      slot_tag_(random_slot_tag()) {
+      slot_tag_(random_slot_tag()),
+      breakers_(options_.breaker) {
+  apply_robustness_env(options_);
   {
     MutexLock lock(rpc_mutex_);
     rpc_ = std::make_shared<rpc::KeystoneRpcClient>(options_.keystone_address);
+    rpc_->set_retry_policy(options_.retry);
   }
   setup_cache();
 }
@@ -61,13 +76,19 @@ ObjectClient::ObjectClient(ClientOptions options, keystone::KeystoneService* emb
     : options_(std::move(options)),
       verify_default_(options_.verify_reads),
       embedded_(embedded),
-      data_(transport::make_transport_client()) {
+      data_(transport::make_transport_client()),
+      breakers_(options_.breaker) {
+  apply_robustness_env(options_);
   setup_cache();
 }
 
 ObjectClient::~ObjectClient() {
   teardown_cache_watch();
   cancel_pooled_slots();
+  // Loser hedge attempts still reference this client's transport; wait for
+  // them to drain into their discard buffers before tearing anything down.
+  MutexLock lock(hedge_mutex_);
+  while (hedge_inflight_.load(std::memory_order_acquire) != 0) hedge_cv_.wait(lock);
 }
 
 ErrorCode ObjectClient::connect() {
@@ -103,6 +124,7 @@ void ObjectClient::rotate_keystone(const std::shared_ptr<rpc::KeystoneRpcClient>
     address = keystone_index_ == 0 ? options_.keystone_address
                                    : options_.keystone_fallbacks[keystone_index_ - 1];
     fresh = std::make_shared<rpc::KeystoneRpcClient>(address);
+    fresh->set_retry_policy(options_.retry);  // survives failover rotation
     rpc_ = fresh;
   }
   LOG_WARN << "keystone failover: switching to " << address;
@@ -110,11 +132,13 @@ void ObjectClient::rotate_keystone(const std::shared_ptr<rpc::KeystoneRpcClient>
 }
 
 Result<bool> ObjectClient::object_exists(const ObjectKey& key) {
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   if (embedded_) return embedded_->object_exists(key);
   return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.object_exists(key); });
 }
 
 Result<std::vector<CopyPlacement>> ObjectClient::get_workers(const ObjectKey& key) {
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   if (embedded_) return embedded_->get_workers(key);
   return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.get_workers(key); });
 }
@@ -366,22 +390,29 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
                             const WorkerConfig& config) {
   TRACE_SPAN("client.put");
-  // Tiny objects ride the inline tier when the keystone grants it: ONE
-  // control RTT stores the bytes in the object map, and the first verified
-  // read needs no data-plane hop at all. nullopt = not applicable — fall
-  // through to slots/placed.
-  if (auto inl = put_via_inline(key, data, size, config)) return *inl;
-  // Small objects ride the pooled-slot path when possible: write into a
-  // pre-allocated slot, then ONE control RTT commits it as `key` (and
-  // refills the pool in the same round trip). nullopt = not applicable
-  // (disabled, oversized, EC, embedded, slot reclaimed) — fall through.
-  if (auto pooled = put_via_slot(key, data, size, config)) return *pooled;
-  // One-item batch: put_many pipelines the wire shards of EVERY copy in a
-  // single pass (a replicated put costs ~one round trip, not one per copy),
-  // coalesces device shards, and rolls back failed reservations — the exact
-  // single-object semantics (put_start -> transfer -> complete/cancel,
-  // reference blackbird_client.cpp:87-117) with none of the code repeated.
-  return put_many({{key, data, size}}, config)[0];
+  // The end-to-end budget covers every tier probe, transfer, and retry
+  // below; a RETRY_LATER shed re-runs the whole body after jittered backoff
+  // (safe: a shed provably did not execute, and put_many rolls back failed
+  // reservations before reporting).
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+  return with_shed_retry([&]() -> ErrorCode {
+    // Tiny objects ride the inline tier when the keystone grants it: ONE
+    // control RTT stores the bytes in the object map, and the first verified
+    // read needs no data-plane hop at all. nullopt = not applicable — fall
+    // through to slots/placed.
+    if (auto inl = put_via_inline(key, data, size, config)) return *inl;
+    // Small objects ride the pooled-slot path when possible: write into a
+    // pre-allocated slot, then ONE control RTT commits it as `key` (and
+    // refills the pool in the same round trip). nullopt = not applicable
+    // (disabled, oversized, EC, embedded, slot reclaimed) — fall through.
+    if (auto pooled = put_via_slot(key, data, size, config)) return *pooled;
+    // One-item batch: put_many pipelines the wire shards of EVERY copy in a
+    // single pass (a replicated put costs ~one round trip, not one per copy),
+    // coalesces device shards, and rolls back failed reservations — the exact
+    // single-object semantics (put_start -> transfer -> complete/cancel,
+    // reference blackbird_client.cpp:87-117) with none of the code repeated.
+    return put_many({{key, data, size}}, config)[0];
+  });
 }
 
 Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
@@ -393,9 +424,10 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
     cache::note_cached_serve(cached->size());
     return std::vector<uint8_t>(cached->begin(), cached->end());
   }
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   const bool v = verify.value_or(verify_reads());
   std::vector<uint8_t> buffer;
-  const ErrorCode ec = read_with_cache(
+  const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
       key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
         const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
         uint64_t size = 0;
@@ -405,25 +437,23 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
           if (v && !stale_meta) cache_fill(key, copies.front(), buffer.data(), size, meta_at);
           return ErrorCode::OK;
         }
-        ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
-        for (const auto& copy : copies) {
-          const uint64_t copy_size = copy_logical_size(copy);
-          if (copy_size != size) buffer.resize(copy_size);
-          if (auto tec = transfer_copy_get(copy, buffer.data(), copy_size, v);
-              tec == ErrorCode::OK) {
-            if (v && !stale_meta) cache_fill(key, copy, buffer.data(), copy_size, meta_at);
-            return ErrorCode::OK;
-          } else {
-            // Corruption is the strongest signal — a later replica's
-            // transport error must not mask it (scrubbers key off
-            // CHECKSUM_MISMATCH).
-            if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
-            LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
-                     << to_string(tec) << "), trying next replica";
-          }
-        }
-        return last;
-      });
+        // Per-copy failover via the replica attempt engine: breaker-aware
+        // candidate order, hedged when the first copy runs long. Corruption
+        // stays the strongest reported signal (see attempt_copies).
+        uint64_t got_size = 0;
+        const CopyPlacement* winner = nullptr;
+        const ErrorCode aec = attempt_copies(
+            copies, v,
+            [&](uint64_t copy_size) -> uint8_t* {
+              buffer.resize(copy_size);
+              return buffer.data();
+            },
+            got_size, &winner);
+        if (aec != ErrorCode::OK) return aec;
+        if (v && !stale_meta && winner)
+          cache_fill(key, *winner, buffer.data(), got_size, meta_at);
+        return ErrorCode::OK;
+      }); });
   if (ec != ErrorCode::OK) return ec;
   return buffer;
 }
@@ -436,8 +466,9 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
   // entry too large for `buffer` falls through; the normal path reports
   // BUFFER_OVERFLOW with fresh metadata).
   if (cache_ && cache_serve(key, buffer, buffer_size, got)) return got;
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   const bool v = verify.value_or(verify_reads());
-  const ErrorCode ec = read_with_cache(
+  const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
       key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
         const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
         uint64_t size = 0;
@@ -451,31 +482,21 @@ Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
                        meta_at);
           return ErrorCode::OK;
         }
-        ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
-        for (const auto& copy : copies) {
-          const uint64_t copy_size = copy_logical_size(copy);
-          if (copy_size > buffer_size) {
-            // Participates in the cache-retry: a stale cached size must not
-            // surface as a spurious overflow when fresh metadata fits.
-            if (last == ErrorCode::NO_COMPLETE_WORKER) last = ErrorCode::BUFFER_OVERFLOW;
-            continue;
-          }
-          if (auto tec = transfer_copy_get(copy, static_cast<uint8_t*>(buffer),
-                                           copy_size, v);
-              tec == ErrorCode::OK) {
-            got = copy_size;
-            if (v && !stale_meta)
-              cache_fill(key, copy, static_cast<const uint8_t*>(buffer), copy_size,
-                         meta_at);
-            return ErrorCode::OK;
-          } else {
-            if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
-            LOG_WARN << "get " << key << " copy " << copy.copy_index << " failed ("
-                     << to_string(tec) << "), trying next replica";
-          }
-        }
-        return last;
-      });
+        // Replica attempt engine (breakers + hedging); an oversized copy is
+        // refused by the buffer callback and participates in the
+        // cache-retry as BUFFER_OVERFLOW, exactly like the old loop.
+        const CopyPlacement* winner = nullptr;
+        const ErrorCode aec = attempt_copies(
+            copies, v,
+            [&](uint64_t copy_size) -> uint8_t* {
+              return copy_size > buffer_size ? nullptr : static_cast<uint8_t*>(buffer);
+            },
+            got, &winner);
+        if (aec != ErrorCode::OK) return aec;
+        if (v && !stale_meta && winner)
+          cache_fill(key, *winner, static_cast<const uint8_t*>(buffer), got, meta_at);
+        return ErrorCode::OK;
+      }); });
   if (ec != ErrorCode::OK) return ec;
   return got;
 }
@@ -495,6 +516,7 @@ Result<std::vector<CopyPlacement>> ObjectClient::put_start(const ObjectKey& key,
                                                            uint64_t size,
                                                            const WorkerConfig& config,
                                                            uint32_t content_crc) {
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   invalidate_placements(key);  // same re-created-key rule as put()
   if (embedded_) return embedded_->put_start(key, size, config, content_crc);
   return rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
@@ -517,6 +539,7 @@ ErrorCode ObjectClient::put_cancel(const ObjectKey& key) {
 }
 
 ErrorCode ObjectClient::remove(const ObjectKey& key) {
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   invalidate_placements(key);  // a re-created key must not serve stale bytes
   if (embedded_) return embedded_->remove_object(key);
   return rpc_failover(/*idempotent=*/false,
@@ -524,6 +547,7 @@ ErrorCode ObjectClient::remove(const ObjectKey& key) {
 }
 
 Result<uint64_t> ObjectClient::remove_all() {
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   invalidate_all_placements();  // same re-created-key rule as remove()
   if (embedded_) return embedded_->remove_all_objects();
   return rpc_failover(/*idempotent=*/false,
@@ -980,6 +1004,239 @@ ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* da
   return transfer_copy(copy, data, size, /*is_write=*/false, verify);
 }
 
+// ---- replica attempt engine (breakers + hedged reads) -----------------------
+
+namespace {
+// Breaker/hedge identity of a copy: its first wire-addressable shard's
+// transport endpoint. Inline and device-only copies have none ("") — they
+// are served locally, so they are neither breaker-ordered nor hedged.
+const std::string& copy_endpoint(const CopyPlacement& copy) {
+  static const std::string kNone;
+  if (!copy.inline_data.empty()) return kNone;
+  for (const auto& shard : copy.shards) {
+    if (!shard.remote.endpoint.empty() &&
+        std::holds_alternative<MemoryLocation>(shard.location))
+      return shard.remote.endpoint;
+  }
+  return kNone;
+}
+
+uint64_t us_since(std::chrono::steady_clock::time_point t0) {
+  return static_cast<uint64_t>(std::chrono::duration_cast<std::chrono::microseconds>(
+                                   std::chrono::steady_clock::now() - t0)
+                                   .count());
+}
+}  // namespace
+
+std::vector<size_t> ObjectClient::order_copies(const std::vector<CopyPlacement>& copies) {
+  std::vector<size_t> order(copies.size());
+  for (size_t i = 0; i < copies.size(); ++i) order[i] = i;
+  if (copies.size() < 2) return order;
+  // Stable partition: copies on OPEN endpoints sort last — deprioritized,
+  // never dropped. When every replica's breaker is open the read proceeds
+  // in the original order (a degraded read beats no read).
+  std::stable_partition(order.begin(), order.end(), [&](size_t i) {
+    const std::string& ep = copy_endpoint(copies[i]);
+    if (ep.empty()) return true;
+    if (!breakers_.for_endpoint(ep)->open_now()) return true;
+    robust_counters().breaker_skips.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  });
+  return order;
+}
+
+void ObjectClient::record_copy_outcome(const CopyPlacement& copy, ErrorCode ec,
+                                       uint64_t us) {
+  const std::string& ep = copy_endpoint(copy);
+  if (ep.empty()) return;
+  auto breaker = breakers_.for_endpoint(ep);
+  if (ec == ErrorCode::OK) {
+    breaker->record_success(us);
+  } else if (ec != ErrorCode::DEADLINE_EXCEEDED) {
+    // A spent budget indicts the caller's deadline, not this endpoint;
+    // everything else (transport error, corruption, shed) is the replica
+    // failing to serve and feeds the trip counter.
+    breaker->record_failure();
+  }
+}
+
+uint64_t ObjectClient::hedge_delay_us() const {
+  if (!options_.hedge_reads) return 0;
+  if (options_.hedge_delay_ms > 0) return static_cast<uint64_t>(options_.hedge_delay_ms) * 1000;
+  // Adaptive trigger: the op's observed p95 — ~5% of reads hedge, which is
+  // the Tail-at-Scale sweet spot (tail coverage at ~negligible extra load).
+  return read_latency_.quantile_us(0.95, options_.hedge_min_samples);
+}
+
+// Every race pays one thread spawn + one size-byte private buffer UP FRONT,
+// even for the ~95% of reads whose primary beats the trigger. That price is
+// structural, not an oversight: transfers block, so first-wins (returning
+// the moment EITHER replica finishes — the entire p99 win) requires the
+// primary off the calling thread from t0, and the primary needs a private
+// buffer because the caller may have returned with the hedge's bytes while
+// the primary thread is still writing. Callers that cannot hedge (one
+// endpoint, no trigger samples, hedging off) never enter here; a persistent
+// hedge executor would amortize the spawn if this path ever shows up hot.
+ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
+                                    const CopyPlacement& secondary, uint64_t size,
+                                    bool verify, uint8_t* out,
+                                    const CopyPlacement** winner) {
+  struct Race {
+    Mutex m;
+    std::condition_variable_any cv;
+    bool primary_done BTPU_GUARDED_BY(m){false};
+    ErrorCode primary_ec BTPU_GUARDED_BY(m){ErrorCode::OK};
+    // The primary fills a PRIVATE buffer: first-wins must never race the
+    // caller's buffer (the hedge writes `out` directly on this thread).
+    std::vector<uint8_t> primary_buf;
+  };
+  auto race = std::make_shared<Race>();
+  race->primary_buf.resize(size);
+  const auto t0 = std::chrono::steady_clock::now();
+  // The ambient deadline is thread-local: hand it to the primary's thread
+  // explicitly so its wire ops still carry the caller's budget.
+  const Deadline op_deadline = current_op_deadline();
+  if (!copy_endpoint(primary).empty()) breakers_.for_endpoint(copy_endpoint(primary))->allow();
+  hedge_inflight_.fetch_add(1, std::memory_order_acq_rel);
+  std::thread([this, race, copy = primary, size, verify, op_deadline, t0] {
+    OpDeadlineScope scope(op_deadline);
+    const ErrorCode ec = transfer_copy_get(copy, race->primary_buf.data(), size, verify);
+    record_copy_outcome(copy, ec, us_since(t0));
+    {
+      MutexLock lock(race->m);
+      race->primary_ec = ec;
+      race->primary_done = true;
+    }
+    race->cv.notify_all();
+    {
+      // Notify UNDER the mutex: the destructor's drain loop frees the client
+      // the instant it observes inflight == 0, so a notify after unlock would
+      // touch a destroyed condition variable.
+      MutexLock lock(hedge_mutex_);
+      hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      hedge_cv_.notify_all();
+    }
+  }).detach();
+
+  const uint64_t delay_us = hedge_delay_us();
+  bool hedged = false;
+  {
+    MutexLock lock(race->m);
+    const auto trigger = t0 + std::chrono::microseconds(delay_us);
+    while (!race->primary_done) {
+      if (race->cv.wait_until(lock, trigger) == std::cv_status::timeout &&
+          !race->primary_done)
+        break;
+    }
+    if (race->primary_done) {
+      if (race->primary_ec == ErrorCode::OK) {
+        std::memcpy(out, race->primary_buf.data(), size);
+        read_latency_.record_us(us_since(t0));
+        if (winner) *winner = &primary;
+        return ErrorCode::OK;
+      }
+      // Primary failed before the trigger: the second attempt below is
+      // ordinary failover, not a hedge.
+    } else {
+      hedged = true;
+      robust_counters().hedges_fired.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+
+  // The hedge (or failover) runs on the calling thread, straight into `out`.
+  if (!copy_endpoint(secondary).empty())
+    breakers_.for_endpoint(copy_endpoint(secondary))->allow();
+  const auto s0 = std::chrono::steady_clock::now();
+  const ErrorCode sec_ec = transfer_copy_get(secondary, out, size, verify);
+  record_copy_outcome(secondary, sec_ec, us_since(s0));
+
+  MutexLock lock(race->m);
+  if (sec_ec == ErrorCode::OK) {
+    if (hedged && !race->primary_done)
+      robust_counters().hedge_wins.fetch_add(1, std::memory_order_relaxed);
+    read_latency_.record_us(us_since(t0));
+    if (winner) *winner = &secondary;
+    return ErrorCode::OK;  // bytes already in `out`; the primary drains into its loser buffer
+  }
+  // Hedge failed: the primary is the only hope left — wait it out (its own
+  // wire ops carry the deadline, so a spent budget aborts it server-side).
+  while (!race->primary_done) race->cv.wait(lock);
+  if (race->primary_ec == ErrorCode::OK) {
+    std::memcpy(out, race->primary_buf.data(), size);
+    read_latency_.record_us(us_since(t0));
+    if (winner) *winner = &primary;
+    return ErrorCode::OK;
+  }
+  // Corruption is the strongest signal (scrubbers key off it).
+  if (sec_ec == ErrorCode::CHECKSUM_MISMATCH || race->primary_ec == ErrorCode::CHECKSUM_MISMATCH)
+    return ErrorCode::CHECKSUM_MISMATCH;
+  return race->primary_ec;
+}
+
+ErrorCode ObjectClient::attempt_copies(const std::vector<CopyPlacement>& copies,
+                                       bool verify,
+                                       const std::function<uint8_t*(uint64_t)>& buffer_for,
+                                       uint64_t& got_size, const CopyPlacement** winner) {
+  if (winner) *winner = nullptr;
+  const std::vector<size_t> order = order_copies(copies);
+  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
+  bool tried_hedge = false;
+  for (size_t oi = 0; oi < order.size(); ++oi) {
+    // A spent budget fails the op here instead of starting another replica
+    // transfer nobody is waiting for (transport-independent: TCP ops also
+    // carry the budget on the wire, but LOCAL/SHM have no wire to carry it).
+    if (oi > 0 && current_op_deadline().expired()) {
+      robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      return ErrorCode::DEADLINE_EXCEEDED;
+    }
+    const CopyPlacement& copy = copies[order[oi]];
+    const uint64_t copy_size = copy_logical_size(copy);
+    uint8_t* dst = buffer_for(copy_size);
+    if (!dst) {
+      // This copy cannot be accepted (caller's buffer too small). Keep the
+      // cache-retry semantics: a stale cached size must not mask a fit.
+      if (last == ErrorCode::NO_COMPLETE_WORKER) last = ErrorCode::BUFFER_OVERFLOW;
+      continue;
+    }
+    // Hedge opportunity: two wire-served same-size candidates on DIFFERENT
+    // endpoints, hedging enabled, and a trigger delay is known (fixed knob
+    // or enough observed samples for a p95).
+    if (!tried_hedge && options_.hedge_reads && oi + 1 < order.size()) {
+      const CopyPlacement& second = copies[order[oi + 1]];
+      const std::string& ep1 = copy_endpoint(copy);
+      const std::string& ep2 = copy_endpoint(second);
+      if (!ep1.empty() && !ep2.empty() && ep1 != ep2 &&
+          copy_logical_size(second) == copy_size && hedge_delay_us() > 0) {
+        tried_hedge = true;
+        const ErrorCode hec = hedged_race(copy, second, copy_size, verify, dst, winner);
+        if (hec == ErrorCode::OK) {
+          got_size = copy_size;
+          return ErrorCode::OK;
+        }
+        if (last != ErrorCode::CHECKSUM_MISMATCH) last = hec;
+        ++oi;  // both candidates consumed
+        continue;
+      }
+    }
+    const std::string& ep = copy_endpoint(copy);
+    if (!ep.empty()) breakers_.for_endpoint(ep)->allow();
+    const auto t0 = std::chrono::steady_clock::now();
+    const ErrorCode tec = transfer_copy_get(copy, dst, copy_size, verify);
+    const uint64_t us = us_since(t0);
+    record_copy_outcome(copy, tec, us);
+    if (tec == ErrorCode::OK) {
+      read_latency_.record_us(us);
+      got_size = copy_size;
+      if (winner) *winner = &copy;
+      return ErrorCode::OK;
+    }
+    if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
+    LOG_WARN << "get copy " << copy.copy_index << " failed (" << to_string(tec)
+             << "), trying next replica";
+  }
+  return last;
+}
+
 Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
     const ObjectKey& key) {
   auto copies = get_workers(key);
@@ -1332,6 +1589,9 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items)
 std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
                                               const WorkerConfig& config) {
   TRACE_SPAN("client.put_many");
+  // Nested scopes tighten: when put() already opened the op deadline this
+  // is a no-op, and a direct put_many call gets its own budget.
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   std::vector<ErrorCode> results(items.size(), ErrorCode::OK);
   if (items.empty()) return results;
 
@@ -1516,8 +1776,13 @@ std::optional<ErrorCode> ObjectClient::put_via_inline(const ObjectKey& key, cons
   if (ec == ErrorCode::NOT_IMPLEMENTED) {
     // Refused: disabled, the server's limit is smaller than ours, or the
     // budget is spent. Budget refusals clear as objects expire, so re-probe
-    // after a while rather than pinning the fallback forever.
-    inline_retry_after_ms_.store(now_ms + 60'000, std::memory_order_relaxed);
+    // after a while rather than pinning the fallback forever. Jittered
+    // around the configured backoff (was a fixed 60 s) so a fleet of
+    // clients does not re-probe a recovering keystone in lockstep.
+    const RetryPolicy probe{options_.inline_refusal_backoff_ms,
+                            options_.inline_refusal_backoff_ms, 1.0, 1};
+    inline_retry_after_ms_.store(now_ms + static_cast<int64_t>(probe.backoff_ms(0)),
+                                 std::memory_order_relaxed);
     return std::nullopt;
   }
   return ec;
@@ -1715,6 +1980,7 @@ void ObjectClient::cancel_pooled_slots() {
 
 std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,
                                                      std::optional<bool> verify) {
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   if (!cache_ || items.empty()) return get_many_uncached(items, verify);
   // Cache pass first: hits (e.g. a checkpoint's hot shards re-read by
   // load_sharded) are served locally; only the misses ride the batch.

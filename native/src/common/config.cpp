@@ -356,6 +356,13 @@ KeystoneConfig KeystoneConfig::from_yaml(const std::string& file_path) {
     cfg.persist_objects = n->bool_or(cfg.persist_objects);
   if (auto n = root.get("metadata_shards"))
     cfg.metadata_shards = static_cast<uint32_t>(n->int_or(cfg.metadata_shards));
+  if (auto n = root.get("rpc_max_inflight"))
+    cfg.rpc_max_inflight = static_cast<uint32_t>(n->int_or(cfg.rpc_max_inflight));
+  if (auto n = root.get("rpc_max_queue"))
+    cfg.rpc_max_queue = static_cast<uint32_t>(n->int_or(cfg.rpc_max_queue));
+  if (auto n = root.get("rpc_shed_backoff_hint_ms"))
+    cfg.rpc_shed_backoff_hint_ms =
+        static_cast<uint32_t>(n->int_or(cfg.rpc_shed_backoff_hint_ms));
 
   if (auto ec = cfg.validate(); ec != ErrorCode::OK) {
     throw std::runtime_error("invalid keystone config " + file_path + ": " +

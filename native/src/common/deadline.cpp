@@ -1,0 +1,63 @@
+#include "btpu/common/deadline.h"
+
+#include <algorithm>
+#include <random>
+#include <vector>
+
+namespace btpu {
+
+namespace {
+thread_local Deadline t_op_deadline;  // infinite by default
+
+uint64_t jitter_below(uint64_t n) noexcept {
+  if (n == 0) return 0;
+  thread_local std::mt19937_64 rng{std::random_device{}()};
+  return rng() % n;
+}
+}  // namespace
+
+uint64_t RetryPolicy::backoff_ms(uint32_t attempt) const noexcept {
+  double raw = static_cast<double>(base_ms);
+  for (uint32_t i = 0; i < attempt && raw < static_cast<double>(max_ms); ++i)
+    raw *= multiplier;
+  const uint64_t capped = std::min<uint64_t>(static_cast<uint64_t>(raw), max_ms);
+  if (capped <= 1) return capped;
+  return capped / 2 + 1 + jitter_below(capped / 2);
+}
+
+Deadline current_op_deadline() noexcept { return t_op_deadline; }
+
+OpDeadlineScope::OpDeadlineScope(Deadline d) noexcept : saved_(t_op_deadline) {
+  // Nested scopes tighten, never loosen: a sub-operation cannot outlive the
+  // deadline its caller is already bound by.
+  t_op_deadline = d.min(saved_);
+}
+
+OpDeadlineScope::~OpDeadlineScope() { t_op_deadline = saved_; }
+
+void LatencyTracker::record_us(uint64_t us) noexcept {
+  const size_t i = count_.fetch_add(1, std::memory_order_relaxed) % kRing;
+  ring_[i].store(us == 0 ? 1 : us, std::memory_order_relaxed);
+}
+
+uint64_t LatencyTracker::quantile_us(double q, size_t min_samples) const noexcept {
+  const size_t n = std::min(count_.load(std::memory_order_relaxed), kRing);
+  if (n < min_samples || n == 0) return 0;
+  uint64_t local[kRing];
+  size_t m = 0;
+  for (size_t i = 0; i < n; ++i) {
+    const uint64_t v = ring_[i].load(std::memory_order_relaxed);
+    if (v != 0) local[m++] = v;
+  }
+  if (m == 0) return 0;
+  const size_t k = std::min(m - 1, static_cast<size_t>(q * static_cast<double>(m)));
+  std::nth_element(local, local + k, local + m);
+  return local[k];
+}
+
+RobustCounters& robust_counters() noexcept {
+  static RobustCounters counters;
+  return counters;
+}
+
+}  // namespace btpu

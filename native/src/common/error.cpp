@@ -11,6 +11,8 @@ std::string_view to_string(ErrorCode code) noexcept {
     case ErrorCode::OPERATION_TIMEOUT: return "OPERATION_TIMEOUT";
     case ErrorCode::RESOURCE_EXHAUSTED: return "RESOURCE_EXHAUSTED";
     case ErrorCode::NOT_IMPLEMENTED: return "NOT_IMPLEMENTED";
+    case ErrorCode::DEADLINE_EXCEEDED: return "DEADLINE_EXCEEDED";
+    case ErrorCode::RETRY_LATER: return "RETRY_LATER";
     case ErrorCode::BUFFER_OVERFLOW: return "BUFFER_OVERFLOW";
     case ErrorCode::OUT_OF_MEMORY: return "OUT_OF_MEMORY";
     case ErrorCode::MEMORY_POOL_NOT_FOUND: return "MEMORY_POOL_NOT_FOUND";
@@ -68,6 +70,8 @@ std::string_view describe(ErrorCode code) noexcept {
     case ErrorCode::OPERATION_TIMEOUT: return "operation did not complete in time";
     case ErrorCode::RESOURCE_EXHAUSTED: return "a system resource is exhausted";
     case ErrorCode::NOT_IMPLEMENTED: return "feature not implemented";
+    case ErrorCode::DEADLINE_EXCEEDED: return "end-to-end deadline budget spent before completion";
+    case ErrorCode::RETRY_LATER: return "server shed the request under overload; retry after backoff";
     case ErrorCode::BUFFER_OVERFLOW: return "write past the end of a buffer";
     case ErrorCode::OUT_OF_MEMORY: return "memory allocation failed";
     case ErrorCode::MEMORY_POOL_NOT_FOUND: return "referenced memory pool does not exist";

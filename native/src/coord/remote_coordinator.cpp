@@ -1,5 +1,6 @@
 #include "btpu/coord/remote_coordinator.h"
 
+#include "btpu/common/deadline.h"
 #include "btpu/common/log.h"
 #include "btpu/common/wire.h"
 #include "btpu/coord/coord_proto.h"
@@ -47,6 +48,8 @@ RemoteCoordinator::RemoteCoordinator(std::string endpoint) {
     start = comma + 1;
   }
   if (endpoints_.empty()) endpoints_.push_back("");
+  if (const char* v = std::getenv("BTPU_COORD_RESPONSE_TIMEOUT_MS"); v && v[0])
+    set_response_timeout_ms(static_cast<uint32_t>(std::strtoul(v, nullptr, 10)));
 }
 
 RemoteCoordinator::~RemoteCoordinator() { disconnect(); }
@@ -241,6 +244,16 @@ ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& re
 ErrorCode RemoteCoordinator::event_call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                             std::vector<uint8_t>& resp) {
   if (!connected_) return ErrorCode::CLIENT_DISCONNECTED;
+  // Response wait = the configured bound (was a hardcoded 10 s) tightened
+  // by the caller's ambient per-op deadline; an already-spent budget fails
+  // before the request is even framed.
+  const Deadline ambient = current_op_deadline();
+  if (ambient.expired()) {
+    robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+    return ErrorCode::DEADLINE_EXCEEDED;
+  }
+  const Deadline wait =
+      Deadline::after_ms(static_cast<int64_t>(response_timeout_ms_)).min(ambient);
   MutexLock lock(event_write_mutex_);
   {
     MutexLock rlock(resp_mutex_);
@@ -252,11 +265,12 @@ ErrorCode RemoteCoordinator::event_call_raw(uint8_t opcode, const std::vector<ui
   // lambda is analyzed as an unannotated function and would flag the
   // guarded resp_ready_/reader_dead_ reads; this body is checked with
   // resp_mutex_ held.
-  const auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  const auto deadline = wait.time_point();
   while (!resp_ready_ && !reader_dead_) {
     if (resp_cv_.wait_until(rlock, deadline) == std::cv_status::timeout &&
         !resp_ready_ && !reader_dead_)
-      return ErrorCode::OPERATION_TIMEOUT;
+      return ambient.expired() ? ErrorCode::DEADLINE_EXCEEDED
+                               : ErrorCode::OPERATION_TIMEOUT;
   }
   if (!resp_ready_) return ErrorCode::CLIENT_DISCONNECTED;  // reader died
   if (resp_opcode_ != opcode) return ErrorCode::RPC_FAILED;

@@ -112,6 +112,36 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_cached_bytes_total",
           "bytes served from the client object cache (zero wire bytes)",
           cache::cached_byte_count());
+  // Overload-robustness scoreboard (btpu RobustCounters): process-global.
+  // The server-side half (deadline rejections, sheds) is this keystone's
+  // own admission behavior; the client-side half is nonzero when this
+  // process also hosts clients (embedded clusters).
+  {
+    const auto& r = robust_counters();
+    counter("btpu_deadline_exceeded_total",
+            "requests rejected because their end-to-end budget was spent",
+            r.deadline_exceeded.load());
+    counter("btpu_shed_total",
+            "requests shed under overload (RETRY_LATER + backoff hint)",
+            r.shed.load());
+    counter("btpu_client_deadline_exceeded_total",
+            "client ops failed locally on deadline expiry",
+            r.client_deadline_exceeded.load());
+    counter("btpu_retries_total", "client backoff retries performed", r.retries.load());
+    counter("btpu_retry_budget_exhausted_total",
+            "client retries suppressed by the retry token bucket",
+            r.retry_budget_exhausted.load());
+    counter("btpu_hedges_fired_total",
+            "secondary replica fetches started past the hedge trigger",
+            r.hedges_fired.load());
+    counter("btpu_hedge_wins_total", "hedged fetches that beat the primary replica",
+            r.hedge_wins.load());
+    counter("btpu_breaker_trips_total", "circuit breakers moved CLOSED -> OPEN",
+            r.breaker_trips.load());
+    counter("btpu_breaker_skips_total",
+            "replica candidates deprioritized because their breaker was open",
+            r.breaker_skips.load());
+  }
 
   auto stats = service_.get_cluster_stats();
   if (stats.ok()) {

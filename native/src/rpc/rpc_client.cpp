@@ -1,5 +1,8 @@
 #include "btpu/rpc/rpc_client.h"
 
+#include <algorithm>
+#include <thread>
+
 #include "btpu/common/log.h"
 #include "btpu/common/wire.h"
 #include "btpu/rpc/rpc.h"
@@ -12,7 +15,7 @@ KeystoneRpcClient::~KeystoneRpcClient() { disconnect(); }
 
 ErrorCode KeystoneRpcClient::connect() {
   MutexLock lock(mutex_);
-  return ensure_connected_locked();
+  return ensure_connected_locked(current_op_deadline());
 }
 
 void KeystoneRpcClient::disconnect() {
@@ -32,11 +35,19 @@ bool KeystoneRpcClient::connected() const {
   return sock_.valid();
 }
 
-ErrorCode KeystoneRpcClient::ensure_connected_locked() {
+ErrorCode KeystoneRpcClient::ensure_connected_locked(const Deadline& deadline) {
   if (sock_.valid()) return ErrorCode::OK;
   auto hp = net::parse_host_port(endpoint_);
   if (!hp) return ErrorCode::INVALID_ADDRESS;
-  auto sock = net::tcp_connect(hp->host, hp->port);
+  // The dial itself honors the op deadline: a dead keystone must not cost a
+  // caller with 50 ms of budget a 5 s connect timeout.
+  int timeout_ms = 5000;
+  if (!deadline.is_infinite()) {
+    const int64_t left = deadline.remaining_ms();
+    if (left <= 0) return ErrorCode::DEADLINE_EXCEEDED;
+    timeout_ms = static_cast<int>(std::min<int64_t>(timeout_ms, left));
+  }
+  auto sock = net::tcp_connect(hp->host, hp->port, timeout_ms);
   if (!sock.ok()) return sock.error();
   sock_ = std::move(sock).value();
   return ErrorCode::OK;
@@ -44,6 +55,11 @@ ErrorCode KeystoneRpcClient::ensure_connected_locked() {
 
 ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                       std::vector<uint8_t>& resp) {
+  const Deadline deadline = current_op_deadline();
+  if (deadline.expired()) {
+    robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+    return ErrorCode::DEADLINE_EXCEEDED;
+  }
   MutexLock lock(mutex_);
   // CONNECTION_FAILED is a *contract*: it may only be returned when no whole
   // frame was ever delivered, so callers (client failover) can safely replay
@@ -51,7 +67,9 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
   // lost reply is RPC_FAILED and the request is never re-sent — it may have
   // executed. Read-only methods ARE re-sent after a lost reply (stale
   // pooled connection, keystone restart): replaying them is harmless and
-  // keeps single-endpoint clients transparent across restarts.
+  // keeps single-endpoint clients transparent across restarts. RETRY_LATER
+  // sheds are retryable for EVERY method: the server rejects before
+  // dispatch, so the request provably did not execute.
   const bool read_only = opcode == static_cast<uint8_t>(Method::kObjectExists) ||
                          opcode == static_cast<uint8_t>(Method::kGetWorkers) ||
                          opcode == static_cast<uint8_t>(Method::kGetClusterStats) ||
@@ -59,23 +77,95 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
                          opcode == static_cast<uint8_t>(Method::kBatchObjectExists) ||
                          opcode == static_cast<uint8_t>(Method::kBatchGetWorkers) ||
                          opcode == static_cast<uint8_t>(Method::kPing);
-  for (int attempt = 0; attempt < 2; ++attempt) {
-    if (ensure_connected_locked() != ErrorCode::OK) continue;
-    if (net::send_frame(sock_.fd(), opcode, req.data(), req.size()) != ErrorCode::OK) {
+  // max_attempts counts TOTAL attempts; 1 = fail-fast (no retry, no replay)
+  // as the storm tests configure. The default policy (4) keeps single-
+  // endpoint clients transparent across keystone restarts via the read-only
+  // replay contract above. 0 is nonsense — treat as 1.
+  const uint32_t max_attempts = std::max<uint32_t>(1, retry_policy_.max_attempts);
+  uint32_t shed_hint_ms = 0;
+  ErrorCode last = ErrorCode::CONNECTION_FAILED;
+  for (uint32_t attempt = 0; attempt < max_attempts; ++attempt) {
+    if (attempt > 0) {
+      // Jittered exponential backoff, stretched to any server-provided
+      // backoff hint, bounded by the retry BUDGET (token bucket: a retry
+      // storm drains it and the client stops amplifying the overload) and
+      // by the caller's remaining deadline.
+      if (deadline.expired()) {
+        robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+        return ErrorCode::DEADLINE_EXCEEDED;
+      }
+      if (!retry_budget_.try_spend()) {
+        robust_counters().retry_budget_exhausted.fetch_add(1, std::memory_order_relaxed);
+        break;
+      }
+      uint64_t wait_ms = retry_policy_.backoff_ms(attempt - 1);
+      if (shed_hint_ms > 0) {
+        const RetryPolicy hint{shed_hint_ms, shed_hint_ms, 1.0, 1};
+        wait_ms = std::max(wait_ms, hint.backoff_ms(0));
+      }
+      if (!deadline.is_infinite())
+        wait_ms = std::min<uint64_t>(wait_ms, static_cast<uint64_t>(deadline.remaining_ms()));
+      if (wait_ms > 0) {
+        // Sleep UNLOCKED: sibling threads sharing this client must not stall
+        // behind one caller's backoff series. The loop revalidates the
+        // connection after relocking, so concurrent close/rotate is safe.
+        lock.unlock();
+        std::this_thread::sleep_for(std::chrono::milliseconds(wait_ms));
+        lock.lock();
+      }
+      robust_counters().retries.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (auto cec = ensure_connected_locked(deadline); cec != ErrorCode::OK) {
+      last = cec == ErrorCode::DEADLINE_EXCEEDED ? cec : ErrorCode::CONNECTION_FAILED;
+      if (last == ErrorCode::DEADLINE_EXCEEDED) return last;
+      continue;
+    }
+    const std::vector<uint8_t>* framed = &req;
+    std::vector<uint8_t> with_trailer;
+    if (!deadline.is_infinite()) {
+      if (deadline.expired()) {
+        robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+        return ErrorCode::DEADLINE_EXCEEDED;
+      }
+      with_trailer = req;
+      append_deadline_trailer(with_trailer, deadline.wire_budget_ms());
+      framed = &with_trailer;
+    }
+    if (net::send_frame(sock_.fd(), opcode, framed->data(), framed->size()) !=
+        ErrorCode::OK) {
       // Stale connection discovered at send time (keystone restarted): at
       // most a partial frame left this socket, which the server discards
       // without executing — safe to reconnect and try again.
       sock_.close();
+      last = ErrorCode::CONNECTION_FAILED;
       continue;
     }
     uint8_t resp_op = 0;
-    if (net::recv_frame(sock_.fd(), resp_op, resp) == ErrorCode::OK && resp_op == opcode) {
-      return ErrorCode::OK;
+    if (net::recv_frame(sock_.fd(), resp_op, resp) == ErrorCode::OK) {
+      if (resp_op == opcode) {
+        retry_budget_.on_success();
+        return ErrorCode::OK;
+      }
+      if (resp_op == kControlErrorOpcode) {
+        // Overload/deadline rejection before dispatch: the connection is
+        // still aligned (the server answered cleanly), so keep it.
+        ErrorCode code{};
+        uint32_t hint = 0;
+        if (decode_control_error(resp, code, hint)) {
+          if (code == ErrorCode::RETRY_LATER) {
+            shed_hint_ms = hint ? hint : 50;
+            last = ErrorCode::RETRY_LATER;
+            continue;  // provably not executed: safe for every method
+          }
+          return code;  // DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED: not retryable here
+        }
+      }
     }
     sock_.close();
     if (!read_only) return ErrorCode::RPC_FAILED;  // delivered, outcome unknown
+    last = ErrorCode::CONNECTION_FAILED;
   }
-  return ErrorCode::CONNECTION_FAILED;
+  return last;
 }
 
 template <typename Req, typename Resp>

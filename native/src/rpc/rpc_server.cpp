@@ -1,5 +1,9 @@
 #include "btpu/rpc/rpc_server.h"
 
+#include <cstdlib>
+#include <thread>
+
+#include "btpu/common/env.h"
 #include "btpu/common/log.h"
 #include "btpu/common/wire.h"
 #include "btpu/rpc/rpc.h"
@@ -9,9 +13,61 @@ namespace btpu::rpc {
 using wire::Reader;
 using wire::Writer;
 
+namespace {
+
+// Ops that must keep working while the gate is closed: health/leadership
+// probes, capacity observation, and operator-driven evacuation. Everything
+// that creates/reads/deletes object data is gated.
+bool is_control_op(uint8_t opcode) {
+  switch (static_cast<Method>(opcode)) {
+    case Method::kPing:
+    case Method::kGetViewVersion:
+    case Method::kGetClusterStats:
+    case Method::kDrainWorker:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Read-only ops may have their late answer replaced by DEADLINE_EXCEEDED —
+// nothing happened server-side that the client needs to learn about. A
+// MUTATION that ran past the budget must still ship its real outcome:
+// answering DEADLINE_EXCEEDED for an executed put_complete would make the
+// client misreport a committed write as failed.
+bool is_read_only_op(uint8_t opcode) {
+  switch (static_cast<Method>(opcode)) {
+    case Method::kObjectExists:
+    case Method::kGetWorkers:
+    case Method::kBatchObjectExists:
+    case Method::kBatchGetWorkers:
+    case Method::kListObjects:
+      return true;
+    default:
+      return false;
+  }
+}
+
+}  // namespace
+
 KeystoneRpcServer::KeystoneRpcServer(keystone::KeystoneService& service, std::string host,
                                      uint16_t port)
-    : service_(service), host_(std::move(host)), port_(port) {}
+    : service_(service), host_(std::move(host)), port_(port) {
+  const auto& cfg = service_.config();
+  AdmissionGate::Options opts;
+  // Auto-sizing tracks the metadata plane's parallelism: with S shards the
+  // keystone digests ~S concurrent single-key ops; 4x covers batch fan-in
+  // without letting a storm queue unboundedly.
+  const uint32_t shards = static_cast<uint32_t>(service_.metadata_shard_count());
+  opts.max_inflight = cfg.rpc_max_inflight ? cfg.rpc_max_inflight
+                                           : env_u32("BTPU_RPC_MAX_INFLIGHT", 4 * shards);
+  opts.max_queue =
+      cfg.rpc_max_queue ? cfg.rpc_max_queue
+                        : env_u32("BTPU_RPC_MAX_QUEUE", 4 * opts.max_inflight);
+  opts.backoff_hint_ms = cfg.rpc_shed_backoff_hint_ms;
+  gate_ = std::make_unique<AdmissionGate>(opts);
+  test_delay_ms_ = env_u32("BTPU_RPC_TEST_DELAY_MS", 0);
+}
 
 KeystoneRpcServer::~KeystoneRpcServer() { stop(); }
 
@@ -59,6 +115,51 @@ void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
   std::vector<uint8_t> payload;
   while (running_) {
     if (net::recv_frame(fd, opcode, payload) != ErrorCode::OK) break;
+    // Deadline propagation (protocol v4): honor the remaining-budget
+    // trailer. A 0 budget is "expired on arrival" — reject before any work.
+    uint32_t budget_ms = 0;
+    const bool has_deadline = strip_deadline_trailer(payload, budget_ms);
+    const Deadline deadline =
+        has_deadline ? Deadline::from_wire(budget_ms) : Deadline::infinite();
+    auto reject = [&](ErrorCode code, uint32_t hint_ms) {
+      auto& counter = code == ErrorCode::RETRY_LATER ? robust_counters().shed
+                                                     : robust_counters().deadline_exceeded;
+      counter.fetch_add(1, std::memory_order_relaxed);
+      const auto resp = encode_control_error(code, hint_ms);
+      return net::send_frame(fd, kControlErrorOpcode, resp.data(), resp.size()) ==
+             ErrorCode::OK;
+    };
+    if (has_deadline && budget_ms == 0) {
+      if (!reject(ErrorCode::DEADLINE_EXCEEDED, 0)) break;
+      continue;
+    }
+    if (!is_control_op(opcode)) {
+      // Bounded admission: wait LIFO-shedded, within the caller's budget.
+      AdmissionTicket ticket(*gate_, deadline);
+      if (ticket.verdict() == AdmissionGate::Verdict::kShed) {
+        if (!reject(ErrorCode::RETRY_LATER, gate_->backoff_hint_ms())) break;
+        continue;
+      }
+      if (ticket.verdict() == AdmissionGate::Verdict::kDeadline || deadline.expired()) {
+        // Budget spent while queued ("during service", before dispatch):
+        // doomed work is refused, not performed.
+        if (!reject(ErrorCode::DEADLINE_EXCEEDED, 0)) break;
+        continue;
+      }
+      if (test_delay_ms_ > 0)
+        std::this_thread::sleep_for(std::chrono::milliseconds(test_delay_ms_));
+      auto response = dispatch(opcode, payload);
+      if (deadline.expired() && is_read_only_op(opcode)) {
+        // Mid-service expiry on a read: the answer outlived its asker —
+        // report DEADLINE_EXCEEDED instead (mutations ship their real
+        // outcome; see is_read_only_op).
+        if (!reject(ErrorCode::DEADLINE_EXCEEDED, 0)) break;
+        continue;
+      }
+      if (net::send_frame(fd, opcode, response.data(), response.size()) != ErrorCode::OK)
+        break;
+      continue;
+    }
     auto response = dispatch(opcode, payload);
     if (net::send_frame(fd, opcode, response.data(), response.size()) != ErrorCode::OK) break;
   }

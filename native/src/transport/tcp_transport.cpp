@@ -29,8 +29,11 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <optional>
 
+#include "btpu/common/admission.h"
 #include "btpu/common/crc32c.h"
+#include "btpu/common/env.h"
 #include "btpu/common/log.h"
 #include "btpu/common/stripe_counter.h"
 #include "btpu/common/wire_layout_check.h"
@@ -40,6 +43,18 @@
 namespace btpu::transport {
 
 namespace {
+
+// Data-plane admission options (read once per server instance at start):
+// bounded concurrent data ops AND in-flight payload bytes, so neither a
+// flood of small ops nor a few giant transfers can queue unboundedly.
+AdmissionGate::Options data_gate_options() {
+  AdmissionGate::Options opts;
+  opts.max_inflight = static_cast<uint32_t>(env_u64("BTPU_DATA_MAX_INFLIGHT_OPS", 64));
+  opts.max_queue = static_cast<uint32_t>(env_u64("BTPU_DATA_MAX_QUEUE", 128));
+  opts.max_inflight_bytes = env_u64("BTPU_DATA_MAX_INFLIGHT_BYTES", 256ull << 20);
+  opts.backoff_hint_ms = static_cast<uint32_t>(env_u64("BTPU_DATA_SHED_HINT_MS", 25));
+  return opts;
+}
 
 constexpr uint8_t kOpRead = 1;
 constexpr uint8_t kOpWrite = 2;
@@ -73,16 +88,25 @@ struct DataRequestHeader {
   uint64_t addr;
   uint64_t rkey;
   uint64_t len;
+  // Remaining end-to-end budget in ms (0 = no deadline), appended at the
+  // TAIL per the append-only rule. The server restarts the clock at header
+  // receipt (relative budget = skew-free) and refuses/aborts work whose
+  // budget is spent instead of serving answers nobody is waiting for.
+  uint32_t deadline_ms;
 };
 #pragma pack(pop)
 // This header crosses the socket as raw bytes: freeze every offset, not
 // just the total, so an inserted field cannot shift the tail silently.
+// deadline_ms was APPENDED in the deadline-propagation change — both sides
+// of the data plane ship together (no length prefix tolerates a tail here),
+// so the frozen size moved 25 -> 29 in the same commit as every peer.
 BTPU_WIRE_RAW_TYPE(DataRequestHeader);
-BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 25);
+BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 29);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, op, 0);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, addr, 1);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, rkey, 9);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, len, 17);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, deadline_ms, 25);
 
 struct Region {
   uint8_t* base{nullptr};  // null for virtual (callback-backed) regions
@@ -102,6 +126,7 @@ class TcpTransportServer : public TransportServer {
 
   ErrorCode start(const std::string& host, uint16_t port) override {
     uint16_t bound = 0;
+    gate_ = std::make_unique<AdmissionGate>(data_gate_options());
     auto listener = net::tcp_listen(host, port, &bound);
     if (!listener.ok()) return listener.error();
     listener_ = std::move(listener).value();
@@ -146,6 +171,7 @@ class TcpTransportServer : public TransportServer {
     d.endpoint = host_ + ":" + std::to_string(port_);
     d.remote_base = remote_base;
     d.rkey_hex = rkey_to_hex(rkey);
+    d.data_wire_version = kTcpDataWireVersion;
     LOG_DEBUG << "registered tcp region " << tag << " rkey=" << d.rkey_hex << " len=" << len;
     return d;
   }
@@ -164,6 +190,7 @@ class TcpTransportServer : public TransportServer {
     d.endpoint = host_ + ":" + std::to_string(port_);
     d.remote_base = 0;
     d.rkey_hex = rkey_to_hex(rkey);
+    d.data_wire_version = kTcpDataWireVersion;
     LOG_DEBUG << "registered tcp virtual region " << tag << " rkey=" << d.rkey_hex;
     return d;
   }
@@ -242,8 +269,24 @@ class TcpTransportServer : public TransportServer {
         if (base) ::munmap(base, len);
       }
     } staging_guard{stg_base, stg_len};
+    // Overload/deadline rejection codes share the status channel; the
+    // counters make sheds visible on the robustness scoreboard.
+    auto rejection = [](const AdmissionTicket& ticket) -> uint32_t {
+      if (ticket.verdict() == AdmissionGate::Verdict::kShed) {
+        robust_counters().shed.fetch_add(1, std::memory_order_relaxed);
+        return static_cast<uint32_t>(ErrorCode::RETRY_LATER);
+      }
+      robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      return static_cast<uint32_t>(ErrorCode::DEADLINE_EXCEEDED);
+    };
+    auto expired_status = []() -> uint32_t {
+      robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      return static_cast<uint32_t>(ErrorCode::DEADLINE_EXCEEDED);
+    };
     while (running_) {
       if (net::read_exact(fd, &hdr, sizeof(hdr)) != ErrorCode::OK) break;
+      // Relative budget -> absolute deadline anchored at receipt (0 = none).
+      const Deadline op_deadline = Deadline::from_wire(hdr.deadline_ms);
       if (hdr.op == kOpHello) {
         if (hdr.len == 0 || hdr.len > 255) break;  // protocol violation
         char name[256] = {};
@@ -277,8 +320,26 @@ class TcpTransportServer : public TransportServer {
         uint64_t offset = 0;
         const bool valid = resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
+        // Admission + deadline gate PER CHUNK: staged sub-ops arrive as a
+        // pipeline of chunk headers, so a budget that expires mid-transfer
+        // aborts the remaining chunks ("during service") instead of
+        // finishing a copy whose reader has given up.
+        std::optional<AdmissionTicket> ticket;
+        // hdr is #pragma pack(1): copy len out before emplace forwards it
+        // by reference (a reference to the packed member is misaligned UB).
+        const uint64_t chunk_len = hdr.len;
+        if (valid) {
+          ticket.emplace(*gate_, op_deadline, chunk_len);
+          if (!ticket->admitted()) {
+            status = rejection(*ticket);
+          } else if (op_deadline.expired()) {
+            status = expired_status();
+          }
+        }
         if (!valid || !stg_base || shm_off > stg_len || hdr.len > stg_len - shm_off) {
           status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+        } else if (status != static_cast<uint32_t>(ErrorCode::OK)) {
+          // rejected above: acknowledge without touching the region
         } else if (hdr.op == kOpWriteStaged) {
           if (target) {
             std::memcpy(target, stg_base + shm_off, hdr.len);
@@ -334,9 +395,16 @@ class TcpTransportServer : public TransportServer {
 
       if (hdr.op == kOpWrite) {
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
-        if (!valid) {
-          // Must still drain the payload to keep the stream aligned.
-          status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+        std::optional<AdmissionTicket> ticket;
+        const uint64_t op_len = hdr.len;  // packed member: no reference binds
+        if (valid) {
+          ticket.emplace(*gate_, op_deadline, op_len);
+          if (!ticket->admitted()) status = rejection(*ticket);
+        }
+        if (!valid || status != static_cast<uint32_t>(ErrorCode::OK)) {
+          // Must still drain the payload to keep the stream aligned —
+          // shed/expired writes drain to a sink, never into the region.
+          if (!valid) status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
           std::vector<uint8_t> sink(64 * 1024);
           uint64_t left = hdr.len;
           while (left > 0) {
@@ -347,15 +415,33 @@ class TcpTransportServer : public TransportServer {
         } else if (target) {
           // Bytes land directly in the registered region: zero copy.
           if (net::read_exact(fd, target, hdr.len) != ErrorCode::OK) return;
+          // Mid-service expiry (a slow sender dribbled past the budget):
+          // the bytes landed — one-sided writes are unacknowledged until
+          // this status, so the client treats them as not-written and the
+          // range stays unreferenced until a successful put completes.
+          if (op_deadline.expired()) status = expired_status();
         } else {
           scratch.resize(hdr.len);
           if (net::read_exact(fd, scratch.data(), hdr.len) != ErrorCode::OK) return;
-          status = static_cast<uint32_t>(virt.write_fn(offset, scratch.data(), hdr.len));
+          if (op_deadline.expired()) {
+            // Budget spent during the drain: refuse the (possibly
+            // expensive) backing-store apply — that is the doomed work.
+            status = expired_status();
+          } else {
+            status = static_cast<uint32_t>(virt.write_fn(offset, scratch.data(), hdr.len));
+          }
         }
         if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
       } else if (hdr.op == kOpRead) {
         if (!valid) {
           const uint32_t status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+          if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
+          continue;
+        }
+        AdmissionTicket ticket(*gate_, op_deadline, hdr.len);
+        if (!ticket.admitted() || op_deadline.expired()) {
+          const uint32_t status =
+              !ticket.admitted() ? rejection(ticket) : expired_status();
           if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
           continue;
         }
@@ -395,6 +481,9 @@ class TcpTransportServer : public TransportServer {
   Mutex regions_mutex_;
   std::unordered_map<uint64_t, Region> regions_ BTPU_GUARDED_BY(regions_mutex_);
   std::mt19937_64 rng_{0x7463707265670aull};
+  // Data-plane admission (one gate per server; all connection threads
+  // share it). Created at start() so env-configured tests see their knobs.
+  std::unique_ptr<AdmissionGate> gate_;
 };
 
 }  // namespace
@@ -560,7 +649,7 @@ class TcpEndpointPool {
       ::shm_unlink(name.c_str());
       return 0;
     }
-    DataRequestHeader hdr{kOpHello, 0, 0, name.size()};
+    DataRequestHeader hdr{kOpHello, 0, 0, name.size(), 0};
     uint32_t status = ~0u;
     const bool ok =
         net::write_iov2(conn.sock.fd(), &hdr, sizeof(hdr), name.data(), name.size()) ==
@@ -784,8 +873,14 @@ struct StagedFrame {
   uint64_t shm_off;
 } __attribute__((packed));
 BTPU_WIRE_RAW_TYPE(StagedFrame);
-BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 33);
-BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 25);
+BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 37);
+BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 29);
+
+// Remaining budget for this sub-op's next request header (0 = none).
+uint32_t sub_budget_ms(const SubOp& sub) {
+  const Deadline& d = sub.op->deadline;
+  return d.is_infinite() ? 0 : d.wire_budget_ms();
+}
 
 ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
   if (use_staged(c, sub)) {
@@ -804,7 +899,9 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
         } else {
           std::memcpy(c.stg_base + off, sub.buf + off, n);
         }
-        StagedFrame framed{{kOpWriteStaged, sub.addr + off, sub.op->rkey, n}, off};
+        StagedFrame framed{{kOpWriteStaged, sub.addr + off, sub.op->rkey, n,
+                            sub_budget_ms(sub)},
+                           off};
         if (auto ec = net::write_all(c.sock.fd(), &framed, sizeof(framed));
             ec != ErrorCode::OK)
           return ec;
@@ -819,11 +916,13 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
     size_t nframes = 0;
     for (uint64_t off = 0; off < sub.len; off += pipe) {
       const uint64_t n = std::min(pipe, sub.len - off);
-      frames[nframes++] = {{kOpReadStaged, sub.addr + off, sub.op->rkey, n}, off};
+      frames[nframes++] = {{kOpReadStaged, sub.addr + off, sub.op->rkey, n,
+                            sub_budget_ms(sub)},
+                          off};
     }
     return net::write_all(c.sock.fd(), frames, nframes * sizeof(StagedFrame));
   }
-  DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len};
+  DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len, sub_budget_ms(sub)};
   if (opcode == kOpWrite) {
     const ErrorCode ec = net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
     // No copy to fuse into on the plain socket lane: hash after the send so
@@ -982,6 +1081,14 @@ void run_subs(std::vector<SubOp>& subs, const std::vector<size_t>& order, uint8_
         ++next;
         continue;
       }
+      if (sub.op->deadline.expired()) {
+        // Budget spent before this sub-op even left: fail locally instead
+        // of shipping doomed work to the worker.
+        robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+        shared.fail(sub.op, ErrorCode::DEADLINE_EXCEEDED);
+        ++next;
+        continue;
+      }
       if (ErrorCode dead_ec; shared.known_dead(sub.op->remote->endpoint, dead_ec)) {
         shared.fail(sub.op, dead_ec);
         ++next;
@@ -1061,6 +1168,7 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
   const uint64_t chunk_bytes = pick_chunk_bytes(total_bytes);
   std::vector<SubOp> subs;
   subs.reserve(n);
+  ErrorCode refused = ErrorCode::OK;
   // Sub-ops of one op stay contiguous (the CRC fold below relies on offset
   // order) and `groups` records each op's [begin, end) span so the parallel
   // path can partition whole ops onto slices.
@@ -1069,6 +1177,17 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
   for (size_t i = 0; i < n; ++i) {
     ops[i].status = ErrorCode::OK;
     ops[i].crc = 0;
+    // Framing-dialect guard: a peer advertising a DIFFERENT raw-header
+    // version would desync the byte stream on the first request (the packed
+    // headers carry no length prefix) — refuse before any byte goes out.
+    // 0 = pre-versioned metadata (legacy peer or WAL-restored placement):
+    // served under the documented ship-together contract.
+    const uint32_t peer_v = ops[i].remote ? ops[i].remote->data_wire_version : 0;
+    if (peer_v != 0 && peer_v != kTcpDataWireVersion) {
+      ops[i].status = ErrorCode::REMOTE_ENDPOINT_ERROR;
+      refused = ErrorCode::REMOTE_ENDPOINT_ERROR;
+      continue;
+    }
     const size_t begin = subs.size();
     for (uint64_t off = 0; off < ops[i].len; off += chunk_bytes) {
       const uint64_t len = std::min(chunk_bytes, ops[i].len - off);
@@ -1116,7 +1235,11 @@ ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency
     if (!op->want_crc || op->status != ErrorCode::OK) continue;
     op->crc = sub.off == 0 ? sub.crc : crc32c_combine(op->crc, sub.crc, sub.len);
   }
-  return shared.first;
+  {
+    MutexLock lock(shared.mutex);
+    if (shared.first != ErrorCode::OK) return shared.first;
+  }
+  return refused;
 }
 
 namespace {
@@ -1128,7 +1251,9 @@ ErrorCode tcp_fabric_command(const std::string& endpoint, uint8_t opcode, uint64
   auto acquired = pool.acquire(endpoint);
   if (!acquired.ok()) return acquired.error();
   PooledConn c = std::move(acquired).value();
-  DataRequestHeader hdr{opcode, addr, rkey, len};
+  const Deadline ambient = current_op_deadline();
+  DataRequestHeader hdr{opcode, addr, rkey, len,
+                        ambient.is_infinite() ? 0 : ambient.wire_budget_ms()};
   uint32_t status = 0;
   // Deadline on the status read: a wedged provider on the far side must not
   // hang the caller's drain/repair thread forever — time out, drop the
@@ -1176,6 +1301,7 @@ ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, vo
   remote.transport = TransportKind::TCP;
   remote.endpoint = endpoint;
   WireOp op{&remote, addr, rkey, static_cast<uint8_t*>(dst), len};
+  op.deadline = current_op_deadline();
   return tcp_batch(&op, 1, /*is_write=*/false, 0);
 }
 
@@ -1185,6 +1311,7 @@ ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, c
   remote.transport = TransportKind::TCP;
   remote.endpoint = endpoint;
   WireOp op{&remote, addr, rkey, const_cast<uint8_t*>(static_cast<const uint8_t*>(src)), len};
+  op.deadline = current_op_deadline();
   return tcp_batch(&op, 1, /*is_write=*/true, 0);
 }
 

@@ -2,7 +2,9 @@
 #include <algorithm>
 #include <atomic>
 #include <charconv>
+#include <chrono>
 #include <cstdio>
+#include <thread>
 
 #include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
@@ -213,6 +215,12 @@ class MuxTransportClient : public TransportClient {
       case TransportKind::TCP: {
         // Same-host one-sided lane first (see batch()); then the sockets.
         if (pvm_access(remote, addr, buf, len, is_write, crc_out)) return ErrorCode::OK;
+        // Raw-framing dialect guard (socket lanes only — pvm above never
+        // frames): refuse a POSITIVE version mismatch before any byte goes
+        // out; 0 = pre-versioned metadata, served as today (transport.h).
+        if (remote.data_wire_version != 0 &&
+            remote.data_wire_version != kTcpDataWireVersion)
+          return ErrorCode::REMOTE_ENDPOINT_ERROR;
         // The single-op helpers route through tcp_batch, which fills crc
         // for want_crc ops; plain single ops hash post-hoc when asked.
         const ErrorCode ec = is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len)
@@ -263,6 +271,9 @@ bool make_wire_op(const ShardPlacement& shard, uint64_t in_off, uint8_t* buf, ui
   const auto* mem = std::get_if<MemoryLocation>(&shard.location);
   if (!mem) return false;
   op = {&shard.remote, mem->remote_addr + in_off, mem->rkey, buf, len, ErrorCode::OK};
+  // Ops are built on the calling thread, so the ambient per-op deadline is
+  // in scope here; fan-out workers read it from the op from now on.
+  op.deadline = current_op_deadline();
   return true;
 }
 
@@ -294,10 +305,11 @@ namespace {
 class FaultyTransportClient final : public TransportClient {
  public:
   FaultyTransportClient(std::unique_ptr<TransportClient> inner, FaultSpec spec)
-      : inner_(std::move(inner)), spec_(spec) {}
+      : inner_(std::move(inner)), spec_(std::move(spec)) {}
 
   ErrorCode read(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
                  void* dst, uint64_t len) override {
+    inject_latency(remote);
     if (!spec_.fail_endpoint.empty() && remote.endpoint == spec_.fail_endpoint)
       return spec_.error;
     if (spec_.fail_nth_read != 0 &&
@@ -307,6 +319,7 @@ class FaultyTransportClient final : public TransportClient {
   }
   ErrorCode write(const RemoteDescriptor& remote, uint64_t remote_addr, uint64_t rkey,
                   const void* src, uint64_t len) override {
+    inject_latency(remote);
     if (!spec_.fail_endpoint.empty() && remote.endpoint == spec_.fail_endpoint)
       return spec_.error;
     if (spec_.fail_nth_write != 0 &&
@@ -316,10 +329,26 @@ class FaultyTransportClient final : public TransportClient {
   }
 
  private:
+  void inject_latency(const RemoteDescriptor& remote) {
+    if (!spec_.latency_endpoint.empty() && remote.endpoint != spec_.latency_endpoint)
+      return;
+    uint32_t ms = spec_.latency_override_ms
+                      ? spec_.latency_override_ms->load(std::memory_order_relaxed)
+                      : spec_.latency_ms;
+    if (ms == 0 && spec_.latency_jitter_ms == 0) return;
+    if (spec_.latency_jitter_ms > 0) {
+      // Cheap per-op jitter; determinism is not a goal for latency faults.
+      ms += static_cast<uint32_t>(jitter_rng_.fetch_add(0x9E3779B97F4A7C15ull) >> 40) %
+            (spec_.latency_jitter_ms + 1);
+    }
+    if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+  }
+
   std::unique_ptr<TransportClient> inner_;
   FaultSpec spec_;
   std::atomic<uint32_t> reads_{0};
   std::atomic<uint32_t> writes_{0};
+  std::atomic<uint64_t> jitter_rng_{0x6C617465ull};
 };
 }  // namespace
 

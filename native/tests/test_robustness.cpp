@@ -1,0 +1,825 @@
+// Overload-robustness layer: deadlines + retry budgets (btpu/common/
+// deadline.h), admission control (admission.h), circuit breakers
+// (circuit_breaker.h), deadline propagation over the keystone RPC wire and
+// the TCP data plane, latency fault injection, and hedged replica reads.
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+
+#include "btest.h"
+#include "btpu/client/embedded.h"
+#include "btpu/common/admission.h"
+#include "btpu/common/circuit_breaker.h"
+#include "btpu/common/deadline.h"
+#include "btpu/common/wire.h"
+#include "btpu/net/net.h"
+#include "btpu/rpc/rpc.h"
+#include "btpu/rpc/rpc_client.h"
+#include "btpu/rpc/rpc_server.h"
+#include "btpu/transport/transport.h"
+
+using namespace btpu;
+using namespace btpu::client;
+
+namespace {
+
+std::vector<uint8_t> pattern(uint64_t size, uint8_t seed = 1) {
+  std::vector<uint8_t> data(size);
+  for (uint64_t i = 0; i < size; ++i) data[i] = static_cast<uint8_t>(i * 131 + seed);
+  return data;
+}
+
+uint64_t parse_rkey(const RemoteDescriptor& d) { return std::stoull(d.rkey_hex, nullptr, 16); }
+
+uint64_t ms_since(std::chrono::steady_clock::time_point t0) {
+  return static_cast<uint64_t>(std::chrono::duration_cast<std::chrono::milliseconds>(
+                                   std::chrono::steady_clock::now() - t0)
+                                   .count());
+}
+
+// Scoped setenv: the admission/test-delay knobs are read at server
+// construction, so tests set them around the fixture and restore after.
+struct ScopedEnv {
+  ScopedEnv(const char* name, const std::string& value) : name_(name) {
+    if (const char* old = std::getenv(name)) saved_ = old;
+    ::setenv(name, value.c_str(), 1);
+  }
+  ~ScopedEnv() {
+    if (saved_.empty())
+      ::unsetenv(name_);
+    else
+      ::setenv(name_, saved_.c_str(), 1);
+  }
+  const char* name_;
+  std::string saved_;
+};
+
+// The first wire endpoint of copy `i` of `key` (latency/fault targets).
+std::string first_endpoint(ObjectClient& client, const ObjectKey& key, size_t copy) {
+  auto placements = client.get_workers(key);
+  if (!placements.ok() || placements.value().size() <= copy) return "";
+  for (const auto& shard : placements.value()[copy].shards) {
+    if (!shard.remote.endpoint.empty()) return shard.remote.endpoint;
+  }
+  return "";
+}
+
+}  // namespace
+
+// ---- primitives ------------------------------------------------------------
+
+BTEST(Robust, DeadlineBasics) {
+  Deadline none;
+  BT_EXPECT(none.is_infinite());
+  BT_EXPECT(!none.expired());
+  BT_EXPECT_EQ(none.wire_budget_ms(), 0u);
+  BT_EXPECT(Deadline::after_ms(0).is_infinite());
+  BT_EXPECT(Deadline::after_ms(-5).is_infinite());
+  BT_EXPECT(Deadline::from_wire(0).is_infinite());
+
+  Deadline soon = Deadline::after_ms(10'000);
+  BT_EXPECT(!soon.expired());
+  BT_EXPECT(soon.remaining_ms() > 9'000 && soon.remaining_ms() <= 10'000);
+  BT_EXPECT(soon.wire_budget_ms() > 9'000 && soon.wire_budget_ms() <= 10'000);
+
+  Deadline past = Deadline::at(Deadline::Clock::now() - std::chrono::milliseconds(5));
+  BT_EXPECT(past.expired());
+  BT_EXPECT_EQ(past.remaining_ms(), 0);
+  BT_EXPECT_EQ(past.wire_budget_ms(), 1u);  // never 0 on the wire (= "none")
+
+  BT_EXPECT(soon.min(none).time_point() == soon.time_point());
+  BT_EXPECT(past.min(soon).time_point() == past.time_point());
+}
+
+BTEST(Robust, OpDeadlineScopeNestsAndTightens) {
+  BT_EXPECT(current_op_deadline().is_infinite());
+  {
+    OpDeadlineScope outer(static_cast<int64_t>(50));
+    const Deadline d1 = current_op_deadline();
+    BT_EXPECT(!d1.is_infinite());
+    {
+      // A LOOSER nested scope must not extend the caller's budget.
+      OpDeadlineScope inner(static_cast<int64_t>(60'000));
+      BT_EXPECT(current_op_deadline().time_point() == d1.time_point());
+      // A tighter one tightens.
+      OpDeadlineScope tighter(static_cast<int64_t>(1));
+      BT_EXPECT(current_op_deadline().time_point() < d1.time_point());
+    }
+    BT_EXPECT(current_op_deadline().time_point() == d1.time_point());
+  }
+  BT_EXPECT(current_op_deadline().is_infinite());
+}
+
+BTEST(Robust, RetryPolicyJitteredExponentialBackoff) {
+  RetryPolicy policy{100, 1000, 2.0, 5};
+  for (int round = 0; round < 20; ++round) {
+    const uint64_t b0 = policy.backoff_ms(0);
+    BT_EXPECT(b0 > 100 / 2 && b0 <= 100);  // equal jitter: (raw/2, raw]
+    const uint64_t b2 = policy.backoff_ms(2);
+    BT_EXPECT(b2 > 400 / 2 && b2 <= 400);
+    const uint64_t b9 = policy.backoff_ms(9);
+    BT_EXPECT(b9 > 1000 / 2 && b9 <= 1000);  // capped at max_ms
+  }
+}
+
+BTEST(Robust, RetryBudgetExtinguishesStormsAndRefills) {
+  RetryBudget budget(4.0, 1.0);
+  // Above half capacity retries are affordable; the bucket drains in
+  // O(capacity) and then refuses until successes refill it.
+  BT_EXPECT(budget.try_spend());
+  BT_EXPECT(budget.try_spend());
+  BT_EXPECT(!budget.try_spend());  // at half capacity (2.0): refused
+  BT_EXPECT(!budget.try_spend());
+  budget.on_success();
+  BT_EXPECT(budget.try_spend());
+  // Refunds cap at capacity.
+  for (int i = 0; i < 100; ++i) budget.on_success();
+  BT_EXPECT(budget.tokens() <= 4.0 + 1e-9);
+}
+
+BTEST(Robust, LatencyTrackerQuantiles) {
+  LatencyTracker tracker;
+  BT_EXPECT_EQ(tracker.quantile_us(0.95, 16), 0ull);  // too few samples
+  for (uint64_t i = 1; i <= 100; ++i) tracker.record_us(i * 10);
+  const uint64_t p50 = tracker.quantile_us(0.50, 16);
+  const uint64_t p95 = tracker.quantile_us(0.95, 16);
+  BT_EXPECT(p50 >= 400 && p50 <= 600);
+  BT_EXPECT(p95 >= 900 && p95 <= 1000);
+}
+
+// ---- circuit breaker -------------------------------------------------------
+
+BTEST(Robust, CircuitBreakerTripHalfOpenRecover) {
+  CircuitBreaker::Options opts;
+  opts.failure_threshold = 3;
+  opts.open_ms = 40;
+  opts.half_open_probes = 1;
+  CircuitBreaker breaker(opts);
+
+  BT_EXPECT(breaker.allow());
+  breaker.record_failure();
+  breaker.record_failure();
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kClosed);
+  breaker.record_failure();  // third consecutive: trip
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kOpen);
+  BT_EXPECT(breaker.open_now());
+  BT_EXPECT(!breaker.allow());
+
+  // Cooldown (jittered within [open_ms/2, open_ms]) elapses -> HALF_OPEN
+  // admits exactly one probe.
+  std::this_thread::sleep_for(std::chrono::milliseconds(opts.open_ms + 5));
+  BT_EXPECT(!breaker.open_now());
+  BT_EXPECT(breaker.allow());  // the probe
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kHalfOpen);
+  BT_EXPECT(!breaker.allow());  // probe budget spent
+  // Probe fails: straight back to OPEN for another cooldown.
+  breaker.record_failure();
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kOpen);
+
+  std::this_thread::sleep_for(std::chrono::milliseconds(opts.open_ms + 5));
+  BT_EXPECT(breaker.allow());
+  breaker.record_success(100);  // probe succeeds: recovered
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kClosed);
+  BT_EXPECT(breaker.allow());
+}
+
+BTEST(Robust, CircuitBreakerLatencyTrip) {
+  CircuitBreaker::Options opts;
+  opts.slow_threshold = 3;
+  opts.slow_floor_us = 100;
+  opts.slow_factor = 4.0;
+  opts.open_ms = 30;
+  CircuitBreaker breaker(opts);
+  // Build a fast baseline (EWMA mean ~100us; trip line = 400us).
+  for (int i = 0; i < 32; ++i) breaker.record_success(100);
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kClosed);
+  // A worker answering correctly but far over the line is operationally
+  // DOWN for tail purposes: consecutive slow successes trip the breaker.
+  // (Slow outliers are excluded from the EWMA, so the trip line cannot
+  // chase the slowness it exists to catch.)
+  breaker.record_success(5'000);
+  breaker.record_success(5'000);
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kClosed);
+  breaker.record_success(5'000);
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kOpen);
+
+  // A probe that answers but is STILL over the line must re-open, not
+  // close-and-fold: folding the slow probe would converge the EWMA onto the
+  // slow latency and permanently defeat the trip via the recovery path.
+  std::this_thread::sleep_for(std::chrono::milliseconds(opts.open_ms + 5));
+  BT_EXPECT(breaker.allow());  // the probe
+  breaker.record_success(5'000);
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kOpen);
+  const uint64_t mean_after = breaker.mean_latency_us();
+  BT_EXPECT(mean_after < 400);  // slow probe stayed OUT of the baseline
+  // A genuinely fast probe recovers.
+  std::this_thread::sleep_for(std::chrono::milliseconds(opts.open_ms + 5));
+  BT_EXPECT(breaker.allow());
+  breaker.record_success(100);
+  BT_EXPECT(breaker.state() == CircuitBreaker::State::kClosed);
+}
+
+// ---- admission gate --------------------------------------------------------
+
+BTEST(Robust, AdmissionGateLifoShedsOldestWaiter) {
+  AdmissionGate::Options opts;
+  opts.max_inflight = 1;
+  opts.max_queue = 1;
+  opts.backoff_hint_ms = 17;
+  AdmissionGate gate(opts);
+
+  BT_EXPECT(gate.admit(Deadline::infinite()) == AdmissionGate::Verdict::kAdmitted);
+
+  // Waiter A queues; a later arrival overflows the queue and A — the OLDEST
+  // waiter, the one closest to its client-side timeout — is the one shed.
+  std::atomic<int> a_verdict{-1};
+  std::thread a([&] {
+    a_verdict = static_cast<int>(gate.admit(Deadline::infinite()));
+    if (a_verdict.load() == static_cast<int>(AdmissionGate::Verdict::kAdmitted))
+      gate.release();
+  });
+  while (gate.queued() == 0) std::this_thread::sleep_for(std::chrono::milliseconds(1));
+
+  std::atomic<int> b_verdict{-1};
+  std::thread b([&] {
+    b_verdict = static_cast<int>(gate.admit(Deadline::infinite()));
+    if (b_verdict.load() == static_cast<int>(AdmissionGate::Verdict::kAdmitted))
+      gate.release();
+  });
+  a.join();  // A was shed synchronously by B's arrival
+  BT_EXPECT_EQ(a_verdict.load(), static_cast<int>(AdmissionGate::Verdict::kShed));
+  BT_EXPECT_EQ(gate.backoff_hint_ms(), 17u);
+
+  gate.release();  // the original holder leaves; B (newest) is admitted
+  b.join();
+  BT_EXPECT_EQ(b_verdict.load(), static_cast<int>(AdmissionGate::Verdict::kAdmitted));
+  BT_EXPECT_EQ(gate.inflight(), 0u);
+  BT_EXPECT_EQ(gate.queued(), 0ull);
+}
+
+BTEST(Robust, AdmissionGateHonorsWaiterDeadline) {
+  AdmissionGate::Options opts;
+  opts.max_inflight = 1;
+  opts.max_queue = 4;
+  AdmissionGate gate(opts);
+  BT_EXPECT(gate.admit(Deadline::infinite()) == AdmissionGate::Verdict::kAdmitted);
+  // A queued waiter whose own budget expires is rejected without service.
+  const auto t0 = std::chrono::steady_clock::now();
+  BT_EXPECT(gate.admit(Deadline::after_ms(30)) == AdmissionGate::Verdict::kDeadline);
+  BT_EXPECT(ms_since(t0) >= 25);
+  gate.release();
+  BT_EXPECT_EQ(gate.queued(), 0ull);  // the dead waiter removed itself
+}
+
+BTEST(Robust, AdmissionGateBytesWatermark) {
+  AdmissionGate::Options opts;
+  opts.max_inflight = 8;
+  opts.max_queue = 0;  // never wait: refusals are immediate
+  opts.max_inflight_bytes = 1000;
+  AdmissionGate gate(opts);
+  BT_EXPECT(gate.admit(Deadline::infinite(), 900) == AdmissionGate::Verdict::kAdmitted);
+  // Over the bytes watermark with something already in flight: shed.
+  BT_EXPECT(gate.admit(Deadline::infinite(), 200) == AdmissionGate::Verdict::kShed);
+  gate.release(900);
+  // An oversized single request is never deadlocked out: bytes only brake
+  // when something else is in flight.
+  BT_EXPECT(gate.admit(Deadline::infinite(), 5000) == AdmissionGate::Verdict::kAdmitted);
+  gate.release(5000);
+}
+
+// ---- keystone RPC deadline propagation + admission -------------------------
+
+namespace {
+struct RpcRobustFixture {
+  keystone::KeystoneService ks{[] {
+                                 KeystoneConfig c;
+                                 c.gc_interval_sec = 1;
+                                 c.health_check_interval_sec = 1;
+                                 return c;
+                               }(),
+                               nullptr};
+  std::unique_ptr<transport::TransportServer> transport_server;
+  std::vector<uint8_t> memory;
+  std::unique_ptr<rpc::KeystoneRpcServer> server;
+  std::unique_ptr<rpc::KeystoneRpcClient> client;
+
+  bool up() {
+    if (ks.initialize() != ErrorCode::OK) return false;
+    memory.resize(1 << 20);
+    transport_server = transport::make_transport_server(TransportKind::LOCAL);
+    transport_server->start("", 0);
+    auto reg = transport_server->register_region(memory.data(), memory.size(), "p0");
+    if (!reg.ok()) return false;
+    keystone::WorkerInfo w;
+    w.worker_id = "w0";
+    w.address = "local:w0";
+    ks.register_worker(w);
+    MemoryPool pool;
+    pool.id = "p0";
+    pool.node_id = "w0";
+    pool.size = memory.size();
+    pool.storage_class = StorageClass::RAM_CPU;
+    pool.remote = reg.value();
+    ks.register_memory_pool(pool);
+    server = std::make_unique<rpc::KeystoneRpcServer>(ks, "127.0.0.1", 0);
+    if (server->start() != ErrorCode::OK) return false;
+    client = std::make_unique<rpc::KeystoneRpcClient>(server->endpoint());
+    return client->connect() == ErrorCode::OK;
+  }
+};
+}  // namespace
+
+BTEST(RpcRobust, ExpiredOnArrivalRejectedBeforeAnyWork) {
+  RpcRobustFixture f;
+  BT_ASSERT(f.up());
+  const uint64_t rejected_before = robust_counters().deadline_exceeded.load();
+
+  // Hand-framed request whose wire budget is 0 = "expired on arrival"
+  // (clients never send this; the server must refuse before dispatch).
+  auto hp = net::parse_host_port(f.server->endpoint());
+  BT_ASSERT(hp.has_value());
+  auto sock = net::tcp_connect(hp->host, hp->port);
+  BT_ASSERT(sock.ok());
+  std::vector<uint8_t> payload = wire::to_bytes(ObjectExistsRequest{"any"});
+  rpc::append_deadline_trailer(payload, 0);
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(rpc::Method::kObjectExists),
+                            payload.data(), payload.size()) == ErrorCode::OK);
+  uint8_t resp_op = 0;
+  std::vector<uint8_t> resp;
+  BT_ASSERT(net::recv_frame(sock.value().fd(), resp_op, resp) == ErrorCode::OK);
+  BT_EXPECT_EQ(resp_op, rpc::kControlErrorOpcode);
+  ErrorCode code{};
+  uint32_t hint = 0;
+  BT_ASSERT(rpc::decode_control_error(resp, code, hint));
+  BT_EXPECT(code == ErrorCode::DEADLINE_EXCEEDED);
+  BT_EXPECT(robust_counters().deadline_exceeded.load() > rejected_before);
+
+  // The connection survives a rejection: a fresh healthy request on the
+  // same socket is answered normally.
+  payload = wire::to_bytes(ObjectExistsRequest{"any"});
+  BT_ASSERT(net::send_frame(sock.value().fd(), static_cast<uint8_t>(rpc::Method::kObjectExists),
+                            payload.data(), payload.size()) == ErrorCode::OK);
+  BT_ASSERT(net::recv_frame(sock.value().fd(), resp_op, resp) == ErrorCode::OK);
+  BT_EXPECT_EQ(resp_op, static_cast<uint8_t>(rpc::Method::kObjectExists));
+}
+
+BTEST(RpcRobust, ClientFailsLocallyWhenBudgetAlreadySpent) {
+  RpcRobustFixture f;
+  BT_ASSERT(f.up());
+  OpDeadlineScope expired(Deadline::at(Deadline::Clock::now() - std::chrono::milliseconds(1)));
+  auto result = f.client->object_exists("any");
+  BT_ASSERT(!result.ok());
+  BT_EXPECT(result.error() == ErrorCode::DEADLINE_EXCEEDED);
+}
+
+BTEST(RpcRobust, MidServiceExpiryAnswersDeadlineExceededForReads) {
+  // The service delay outlives the caller's budget: the keystone performs
+  // the (read-only) dispatch but must answer DEADLINE_EXCEEDED — the answer
+  // outlived its asker.
+  ScopedEnv delay("BTPU_RPC_TEST_DELAY_MS", "120");
+  RpcRobustFixture f;
+  BT_ASSERT(f.up());
+  {
+    OpDeadlineScope scope(static_cast<int64_t>(60));
+    auto result = f.client->object_exists("any");
+    BT_ASSERT(!result.ok());
+    BT_EXPECT(result.error() == ErrorCode::DEADLINE_EXCEEDED);
+  }
+  // Without a deadline the same slow call completes fine.
+  BT_ASSERT_OK(f.client->object_exists("any"));
+}
+
+BTEST(RpcRobust, OverloadShedsWithRetryLaterWhileControlPlaneAnswers) {
+  // A 1-deep gate with a 1-deep queue and a slow service: a burst must shed
+  // with RETRY_LATER (+hint) while control-plane pings keep answering.
+  ScopedEnv inflight("BTPU_RPC_MAX_INFLIGHT", "1");
+  ScopedEnv queue("BTPU_RPC_MAX_QUEUE", "1");
+  ScopedEnv delay("BTPU_RPC_TEST_DELAY_MS", "120");
+  RpcRobustFixture f;
+  BT_ASSERT(f.up());
+
+  const uint64_t shed_before = robust_counters().shed.load();
+  // Retries OFF for the storm clients: the point is to observe the shed.
+  RetryPolicy no_retry{1, 1, 1.0, 1};
+
+  constexpr int kStorm = 6;
+  std::vector<std::unique_ptr<rpc::KeystoneRpcClient>> clients;
+  for (int i = 0; i < kStorm; ++i) {
+    clients.push_back(std::make_unique<rpc::KeystoneRpcClient>(f.server->endpoint()));
+    clients.back()->set_retry_policy(no_retry);
+    BT_ASSERT(clients.back()->connect() == ErrorCode::OK);
+  }
+  std::atomic<int> shed_seen{0}, ok_seen{0};
+  std::vector<std::thread> storm;
+  for (int i = 0; i < kStorm; ++i) {
+    storm.emplace_back([&, i] {
+      auto result = clients[i]->object_exists("storm");
+      if (!result.ok() && result.error() == ErrorCode::RETRY_LATER)
+        shed_seen.fetch_add(1);
+      else if (result.ok())
+        ok_seen.fetch_add(1);
+    });
+  }
+  // While the storm saturates the gate, the control plane stays usable:
+  // ping bypasses admission entirely.
+  std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  const auto t0 = std::chrono::steady_clock::now();
+  BT_ASSERT_OK(f.client->ping());
+  BT_EXPECT(ms_since(t0) < 100);  // did not queue behind the 120ms-each storm
+  for (auto& t : storm) t.join();
+
+  BT_EXPECT(shed_seen.load() >= 1);
+  BT_EXPECT(ok_seen.load() >= 1);  // inflight + queued still complete
+  BT_EXPECT(robust_counters().shed.load() > shed_before);
+}
+
+BTEST(RpcRobust, ShedsRetryTransparentlyWithBackoffHint) {
+  // Default retry policy: the storm client retries RETRY_LATER sheds after
+  // the hinted backoff, so a transient burst is absorbed, not surfaced.
+  ScopedEnv inflight("BTPU_RPC_MAX_INFLIGHT", "1");
+  ScopedEnv queue("BTPU_RPC_MAX_QUEUE", "0");  // every concurrent call sheds
+  ScopedEnv delay("BTPU_RPC_TEST_DELAY_MS", "40");
+  RpcRobustFixture f;
+  BT_ASSERT(f.up());
+
+  const uint64_t retries_before = robust_counters().retries.load();
+  constexpr int kCallers = 3;
+  std::vector<std::unique_ptr<rpc::KeystoneRpcClient>> clients;
+  for (int i = 0; i < kCallers; ++i) {
+    clients.push_back(std::make_unique<rpc::KeystoneRpcClient>(f.server->endpoint()));
+    RetryPolicy patient{5, 50, 2.0, 8};
+    clients.back()->set_retry_policy(patient);
+    BT_ASSERT(clients.back()->connect() == ErrorCode::OK);
+  }
+  std::atomic<int> ok_seen{0};
+  std::vector<std::thread> callers;
+  for (int i = 0; i < kCallers; ++i) {
+    callers.emplace_back([&, i] {
+      if (clients[i]->object_exists("burst").ok()) ok_seen.fetch_add(1);
+    });
+  }
+  for (auto& t : callers) t.join();
+  BT_EXPECT_EQ(ok_seen.load(), kCallers);  // everyone eventually served
+  BT_EXPECT(robust_counters().retries.load() > retries_before);
+}
+
+// ---- latency fault injection ------------------------------------------------
+
+BTEST(Transport, FaultSpecInjectsLatencyFixedJitterAndOverride) {
+  // A local loopback region to read through the faulty wrapper.
+  auto server = transport::make_transport_server(TransportKind::LOCAL);
+  BT_ASSERT(server->start("", 0) == ErrorCode::OK);
+  std::vector<uint8_t> region(4096, 0xAB);
+  auto reg = server->register_region(region.data(), region.size(), "lat0");
+  BT_ASSERT(reg.ok());
+
+  transport::FaultSpec spec;
+  spec.latency_ms = 40;
+  auto slow = transport::make_faulty_transport_client(transport::make_transport_client(),
+                                                      spec);
+  std::vector<uint8_t> buf(256);
+  auto t0 = std::chrono::steady_clock::now();
+  BT_ASSERT(slow->read(reg.value(), reg.value().remote_base, parse_rkey(reg.value()), buf.data(),
+                       buf.size()) == ErrorCode::OK);
+  BT_EXPECT(ms_since(t0) >= 40);
+  BT_EXPECT_EQ(buf[0], 0xAB);
+
+  // Endpoint-narrowed: a different endpoint is unaffected.
+  transport::FaultSpec narrow;
+  narrow.latency_ms = 200;
+  narrow.latency_endpoint = "someone-else:1234";
+  auto fast = transport::make_faulty_transport_client(transport::make_transport_client(),
+                                                      narrow);
+  t0 = std::chrono::steady_clock::now();
+  BT_ASSERT(fast->read(reg.value(), reg.value().remote_base, parse_rkey(reg.value()), buf.data(),
+                       buf.size()) == ErrorCode::OK);
+  BT_EXPECT(ms_since(t0) < 100);
+
+  // Dynamic override: a chaos thread spikes and clears latency mid-run
+  // without swapping transports under I/O.
+  auto dial = std::make_shared<std::atomic<uint32_t>>(0);
+  transport::FaultSpec dynamic;
+  dynamic.latency_override_ms = dial;
+  auto dialed = transport::make_faulty_transport_client(transport::make_transport_client(),
+                                                        dynamic);
+  t0 = std::chrono::steady_clock::now();
+  BT_ASSERT(dialed->read(reg.value(), reg.value().remote_base, parse_rkey(reg.value()), buf.data(),
+                         buf.size()) == ErrorCode::OK);
+  BT_EXPECT(ms_since(t0) < 30);  // dial at 0: no injection
+  dial->store(50);
+  t0 = std::chrono::steady_clock::now();
+  BT_ASSERT(dialed->read(reg.value(), reg.value().remote_base, parse_rkey(reg.value()), buf.data(),
+                         buf.size()) == ErrorCode::OK);
+  BT_EXPECT(ms_since(t0) >= 50);
+}
+
+// ---- hedged replica reads + breakers, end to end ---------------------------
+
+BTEST(EndToEnd, HedgedReadFirstWinsUnderSlowReplica) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.hedge_reads = true;
+  copts.hedge_delay_ms = 10;  // fixed trigger: deterministic for the test
+  auto client = cluster.make_client(copts);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(64 * 1024, 77);
+  BT_ASSERT(client->put("hedge/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  // Copy 0 (the first candidate) goes 300ms slow; the hedge fires at 10ms
+  // against copy 1 and must win long before the primary would finish.
+  const std::string slow_ep = first_endpoint(*client, "hedge/obj", 0);
+  BT_ASSERT(!slow_ep.empty());
+  transport::FaultSpec spec;
+  spec.latency_ms = 300;
+  spec.latency_endpoint = slow_ep;
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+
+  const uint64_t fired_before = robust_counters().hedges_fired.load();
+  const uint64_t wins_before = robust_counters().hedge_wins.load();
+  const size_t samples_before = client->read_latency().samples();
+
+  const auto t0 = std::chrono::steady_clock::now();
+  auto back = client->get("hedge/obj");
+  const uint64_t took_ms = ms_since(t0);
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+  BT_EXPECT(took_ms < 200);  // the 300ms primary did NOT gate the read
+  BT_EXPECT(robust_counters().hedges_fired.load() > fired_before);
+  BT_EXPECT(robust_counters().hedge_wins.load() > wins_before);
+  // First-wins, counted once: exactly one effective-latency sample for one
+  // logical read (the loser drains into a discard buffer).
+  BT_EXPECT_EQ(client->read_latency().samples(), samples_before + 1);
+
+  // The client must be destructible while a loser attempt is still
+  // in flight — the destructor drains hedge threads (tsan covers the rest).
+  client.reset();
+}
+
+BTEST(EndToEnd, HedgeLoserFailureDoesNotPoisonWinner) {
+  // The slow replica is also BROKEN: the hedge wins with good bytes, and
+  // the loser's eventual failure must not surface.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.hedge_reads = true;
+  copts.hedge_delay_ms = 5;
+  auto client = cluster.make_client(copts);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(32 * 1024, 91);
+  BT_ASSERT(client->put("hedge/poison", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  const std::string bad_ep = first_endpoint(*client, "hedge/poison", 0);
+  BT_ASSERT(!bad_ep.empty());
+  transport::FaultSpec spec;
+  spec.latency_ms = 100;
+  spec.latency_endpoint = bad_ep;
+  spec.fail_endpoint = bad_ep;  // slow AND failing
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+
+  auto back = client->get("hedge/poison");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(EndToEnd, BreakerTripsAndRoutesAroundFailingReplicaThenRecovers) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.hedge_reads = false;  // isolate the breaker behavior
+  copts.breaker.failure_threshold = 2;
+  copts.breaker.open_ms = 60;
+  auto client = cluster.make_client(copts);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(16 * 1024, 13);
+  BT_ASSERT(client->put("breaker/obj", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  const std::string bad_ep = first_endpoint(*client, "breaker/obj", 0);
+  BT_ASSERT(!bad_ep.empty());
+  transport::FaultSpec spec;
+  spec.fail_endpoint = bad_ep;
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+
+  const uint64_t trips_before = robust_counters().breaker_trips.load();
+  const uint64_t skips_before = robust_counters().breaker_skips.load();
+  // Each read fails over to the healthy replica; after failure_threshold
+  // failures the breaker opens and later reads don't even try the bad one.
+  for (int i = 0; i < 4; ++i) {
+    auto back = client->get("breaker/obj");
+    BT_ASSERT_OK(back);
+    BT_EXPECT(back.value() == data);
+  }
+  auto breaker = client->breakers().peek(bad_ep);
+  BT_ASSERT(breaker != nullptr);
+  BT_EXPECT(breaker->state() == CircuitBreaker::State::kOpen);
+  BT_EXPECT(robust_counters().breaker_trips.load() > trips_before);
+  BT_EXPECT(robust_counters().breaker_skips.load() > skips_before);
+
+  // Heal the endpoint; after the cooldown a half-open probe closes the
+  // breaker again (reads keep succeeding throughout).
+  client->inject_data_client_for_test(transport::make_transport_client());
+  std::this_thread::sleep_for(std::chrono::milliseconds(80));
+  for (int i = 0; i < 3; ++i) BT_ASSERT_OK(client->get("breaker/obj"));
+  BT_EXPECT(breaker->state() == CircuitBreaker::State::kClosed);
+}
+
+BTEST(EndToEnd, AllBreakersOpenStillReads) {
+  // Degraded beats dead: when EVERY replica's breaker is open the read must
+  // still proceed in original order rather than refuse.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.hedge_reads = false;
+  copts.breaker.failure_threshold = 1;
+  copts.breaker.open_ms = 60'000;  // stays open for the whole test
+  auto client = cluster.make_client(copts);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 2;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(8 * 1024, 44);
+  BT_ASSERT(client->put("breaker/all", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  // Trip copy 0's endpoint, then copy 1's, with one failing read each.
+  transport::FaultSpec all_fail;
+  all_fail.fail_endpoint = first_endpoint(*client, "breaker/all", 0);
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), all_fail));
+  BT_ASSERT_OK(client->get("breaker/all"));  // copy0 fails (trips), copy1 serves
+  transport::FaultSpec other_fail;
+  other_fail.fail_endpoint = first_endpoint(*client, "breaker/all", 1);
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), other_fail));
+  BT_ASSERT_OK(client->get("breaker/all"));  // copy1 fails (trips), copy0 serves
+
+  // Both open now; a healthy transport must still serve the read.
+  client->inject_data_client_for_test(transport::make_transport_client());
+  auto back = client->get("breaker/all");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(EndToEnd, OpDeadlineFailsDoomedReplicaCascade) {
+  // With every replica's transfer slower than the whole budget, the op must
+  // fail DEADLINE_EXCEEDED after the first attempt instead of marching
+  // through the remaining replicas (doomed work).
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(3, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.hedge_reads = false;
+  copts.op_deadline_ms = 40;
+  auto client = cluster.make_client(copts);
+
+  WorkerConfig cfg;
+  cfg.replication_factor = 3;
+  cfg.max_workers_per_copy = 1;
+  auto data = pattern(16 * 1024, 3);
+  BT_ASSERT(client->put("deadline/cascade", data.data(), data.size(), cfg) == ErrorCode::OK);
+
+  transport::FaultSpec spec;
+  spec.latency_ms = 60;          // every transfer outlives the 40ms budget
+  spec.fail_nth_read = 1;        // and the first read also fails outright
+  client->inject_data_client_for_test(
+      transport::make_faulty_transport_client(transport::make_transport_client(), spec));
+
+  const auto t0 = std::chrono::steady_clock::now();
+  auto back = client->get("deadline/cascade");
+  const uint64_t took_ms = ms_since(t0);
+  BT_ASSERT(!back.ok());
+  BT_EXPECT(back.error() == ErrorCode::DEADLINE_EXCEEDED);
+  // One 60ms attempt, not three: the cascade was cut at the deadline check.
+  BT_EXPECT(took_ms < 150);
+}
+
+// ---- data-plane (TCP) admission + deadline ---------------------------------
+
+BTEST(TcpRobust, WireVersionMismatchRefusedBeforeAnyByte) {
+  // The raw packed data-plane headers have no length prefix: a peer on a
+  // DIFFERENT framing dialect would desync the stream. The descriptor
+  // advertises the dialect; a positive mismatch is refused locally with
+  // REMOTE_ENDPOINT_ERROR (before any byte goes out), while 0 (legacy /
+  // WAL-restored metadata) and the matching version are served.
+  auto server = transport::make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  std::vector<uint8_t> backing(1 << 16, 0x3C);
+  auto reg = server->register_region(backing.data(), backing.size(), "verchk");
+  BT_ASSERT(reg.ok());
+  BT_EXPECT_EQ(reg.value().data_wire_version, transport::kTcpDataWireVersion);
+
+  auto client = transport::make_transport_client();
+  std::vector<uint8_t> buf(4096);
+  // Matching version: served.
+  BT_EXPECT(client->read(reg.value(), reg.value().remote_base, parse_rkey(reg.value()),
+                         buf.data(), buf.size()) == ErrorCode::OK);
+  BT_EXPECT_EQ(buf[0], 0x3C);
+  // Pre-versioned metadata (0): served under the ship-together contract.
+  RemoteDescriptor legacy = reg.value();
+  legacy.data_wire_version = 0;
+  BT_EXPECT(client->read(legacy, legacy.remote_base, parse_rkey(legacy), buf.data(),
+                         buf.size()) == ErrorCode::OK);
+  // Positive mismatch: refused, single-op and batch lanes both.
+  RemoteDescriptor future = reg.value();
+  future.data_wire_version = transport::kTcpDataWireVersion + 1;
+  BT_EXPECT(client->read(future, future.remote_base, parse_rkey(future), buf.data(),
+                         buf.size()) == ErrorCode::REMOTE_ENDPOINT_ERROR);
+  transport::WireOp op{};
+  op.remote = &future;
+  op.addr = future.remote_base;
+  op.rkey = parse_rkey(future);
+  op.buf = buf.data();
+  op.len = buf.size();
+  BT_EXPECT(client->read_batch(&op, 1, 0) == ErrorCode::REMOTE_ENDPOINT_ERROR);
+  BT_EXPECT(op.status == ErrorCode::REMOTE_ENDPOINT_ERROR);
+}
+
+BTEST(TcpRobust, DataGateShedsUnderSaturationAndServesAfter) {
+  // A 1-op gate with no queue on the TCP data server: a second concurrent
+  // op sheds with RETRY_LATER while the first (slow, virtual-region-backed)
+  // is in flight; after the gate clears, ops are served again.
+  ScopedEnv ops("BTPU_DATA_MAX_INFLIGHT_OPS", "1");
+  ScopedEnv queue("BTPU_DATA_MAX_QUEUE", "0");
+  auto server = transport::make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+
+  // A virtual region whose reads take 150ms (a wedged/slow backend).
+  std::atomic<int> served{0};
+  auto reg = server->register_virtual_region(
+      1 << 20, "slowvr",
+      [&](uint64_t, void* dst, uint64_t len) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(150));
+        std::memset(dst, 0x5A, len);
+        served.fetch_add(1);
+        return ErrorCode::OK;
+      },
+      [&](uint64_t, const void*, uint64_t) { return ErrorCode::OK; });
+  BT_ASSERT(reg.ok());
+
+  const uint64_t shed_before = robust_counters().shed.load();
+  auto client = transport::make_transport_client();
+  std::atomic<int> ok_count{0}, shed_count{0};
+  std::vector<std::thread> readers;
+  for (int i = 0; i < 3; ++i) {
+    readers.emplace_back([&] {
+      std::vector<uint8_t> buf(4096);
+      const auto ec = client->read(reg.value(), 0, parse_rkey(reg.value()), buf.data(), buf.size());
+      if (ec == ErrorCode::OK)
+        ok_count.fetch_add(1);
+      else if (ec == ErrorCode::RETRY_LATER)
+        shed_count.fetch_add(1);
+    });
+    // Stagger so the first is mid-service when the rest arrive.
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  for (auto& t : readers) t.join();
+  BT_EXPECT(ok_count.load() >= 1);
+  BT_EXPECT(shed_count.load() >= 1);
+  BT_EXPECT(robust_counters().shed.load() > shed_before);
+
+  // Gate cleared: the next read is served.
+  std::vector<uint8_t> buf(4096);
+  BT_EXPECT(client->read(reg.value(), 0, parse_rkey(reg.value()), buf.data(), buf.size()) ==
+            ErrorCode::OK);
+  BT_EXPECT_EQ(buf[0], 0x5A);
+}
+
+BTEST(TcpRobust, WireDeadlinePropagatesAndExpiredSubOpsFailLocally) {
+  auto server = transport::make_transport_server(TransportKind::TCP);
+  BT_ASSERT(server->start("127.0.0.1", 0) == ErrorCode::OK);
+  std::vector<uint8_t> region(1 << 20);
+  auto reg = server->register_region(region.data(), region.size(), "dlr");
+  BT_ASSERT(reg.ok());
+  auto client = transport::make_transport_client();
+
+  // A healthy deadline rides the wire and the op completes.
+  {
+    OpDeadlineScope scope(static_cast<int64_t>(5'000));
+    std::vector<uint8_t> buf(64 * 1024, 0x33);
+    BT_EXPECT(client->write(reg.value(), reg.value().remote_base, parse_rkey(reg.value()),
+                            buf.data(), buf.size()) == ErrorCode::OK);
+    BT_EXPECT_EQ(region[0], 0x33);
+  }
+  // A spent budget fails locally before any bytes move.
+  {
+    OpDeadlineScope scope(Deadline::at(Deadline::Clock::now() - std::chrono::milliseconds(1)));
+    const uint64_t before = robust_counters().client_deadline_exceeded.load();
+    std::vector<uint8_t> buf(4096, 0x44);
+    BT_EXPECT(client->write(reg.value(), reg.value().remote_base, parse_rkey(reg.value()),
+                            buf.data(), buf.size()) == ErrorCode::DEADLINE_EXCEEDED);
+    BT_EXPECT(robust_counters().client_deadline_exceeded.load() > before);
+  }
+}

@@ -46,6 +46,7 @@ RemoteDescriptor canon_remote() {
   d.rkey_hex = "ab";
   d.fabric_addr = "fa";
   d.pvm_endpoint = "pv";
+  d.data_wire_version = 0x55;
   return d;
 }
 

@@ -8,7 +8,14 @@
 
 CXX      ?= g++
 BUILD    ?= build
-CXXFLAGS ?= -std=c++20 -O2 -g -fPIC -Wall -Wextra -Wno-unused-parameter \
+# Warning hygiene (docs/CORRECTNESS.md): the whole tree is -Werror, and
+# -Werror=unused-result is the teeth behind the BTPU_NODISCARD /
+# [[nodiscard]]-typed ErrorCode/Result sweep — a dropped error is a compile
+# error. Wire/decoder TUs additionally build with -Wconversion (see
+# WCONV_SRCS below): silent narrowing in a length/offset computation is
+# exactly how bounds checks rot.
+WARNFLAGS := -Wall -Wextra -Wno-unused-parameter -Werror -Werror=unused-result
+CXXFLAGS ?= -std=c++20 -O2 -g -fPIC $(WARNFLAGS) \
             -Inative/include -pthread
 # -lrt: shm_open/shm_unlink live in librt on pre-2.34 glibc
 LDFLAGS  ?= -pthread -lrt
@@ -24,7 +31,7 @@ EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
 
 HDRS := $(shell find native/include native/src -name '*.h')
 
-.PHONY: all native examples clean tsan asan lint check wire-golden
+.PHONY: all native examples clean tsan asan lint check wire-golden fuzz fuzz-replay
 all: native
 native: $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES)
 examples: $(EXAMPLES)
@@ -56,7 +63,7 @@ ASAN_FILTERS ?=
 # happens HERE, once.
 define sanitizer_run
 	$(MAKE) BUILD=$(2) \
-	  CXXFLAGS="-std=c++20 -O1 -g -fPIC -Wall -Wextra -Wno-unused-parameter \
+	  CXXFLAGS="-std=c++20 -O1 -g -fPIC $(WARNFLAGS) \
 	            -Inative/include -pthread $(3)" \
 	  LDFLAGS="-pthread -lrt $(3)" \
 	  $(2)/libbtpu.so $(2)/btpu_tests $(2)/bb-soak
@@ -72,11 +79,29 @@ define sanitizer_run
 endef
 
 comma := ,
+ASAN_FLAGS := -fsanitize=address$(comma)undefined -fno-sanitize-recover=all
 tsan:
 	$(call sanitizer_run,tsan,$(TSAN_BUILD),-fsanitize=thread,$(TSAN_FILTERS))
 asan:
-	$(call sanitizer_run,asan,$(ASAN_BUILD),-fsanitize=address$(comma)undefined \
-	  -fno-sanitize-recover=all,$(ASAN_FILTERS))
+	$(call sanitizer_run,asan,$(ASAN_BUILD),$(ASAN_FLAGS),$(ASAN_FILTERS))
+
+# ---- hostile-input fuzz gate (docs/CORRECTNESS.md) -------------------------
+# `make fuzz` drives every wire-decode surface with hostile bytes: libFuzzer
+# harnesses under clang (exploration), and ALWAYS the deterministic
+# corpus-replay + mutation sweep (reproducible everywhere, asan+ubsan
+# instrumented). Knobs: BTPU_FUZZ_EXECS (per-target executions for the
+# deterministic leg), BTPU_FUZZ_TIME (seconds per libFuzzer target).
+fuzz:
+	scripts/fuzz.sh
+
+# Internal: the asan+ubsan-instrumented replay binary (also the seed-corpus
+# generator: build/asan/btpu_fuzz_replay --gen-seeds native/fuzz/corpus).
+fuzz-replay:
+	$(MAKE) BUILD=$(ASAN_BUILD) \
+	  CXXFLAGS="-std=c++20 -O1 -g -fPIC $(WARNFLAGS) \
+	            -Inative/include -pthread $(ASAN_FLAGS)" \
+	  LDFLAGS="-pthread -lrt $(ASAN_FLAGS)" \
+	  $(ASAN_BUILD)/btpu_fuzz_replay
 
 # ---- static gates ----------------------------------------------------------
 # clang -Wthread-safety sweep over every native source (the machine check
@@ -99,9 +124,18 @@ wire-golden: $(BUILD)/btpu_tests
 check:
 	scripts/check.sh
 
+# Wire/decoder TUs carry the extra -Wconversion hammer: these parse hostile
+# bytes, where a u64->u32 narrowing in a length check is a security bug.
+WCONV_SRCS := native/src/net/net.cpp native/src/rpc/rpc_client.cpp \
+              native/src/rpc/rpc_server.cpp native/src/common/types.cpp \
+              native/src/common/error.cpp native/src/common/deadline.cpp \
+              native/src/keystone/keystone_persist.cpp \
+              native/src/transport/tcp_transport.cpp
+$(patsubst %.cpp,$(BUILD)/obj/%.o,$(WCONV_SRCS)): WARN_EXTRA := -Wconversion
+
 $(BUILD)/obj/%.o: %.cpp $(HDRS)
 	@mkdir -p $(dir $@)
-	$(CXX) $(CXXFLAGS) -c $< -o $@
+	$(CXX) $(CXXFLAGS) $(WARN_EXTRA) -c $< -o $@
 
 $(BUILD)/libbtpu.so: $(LIB_OBJS)
 	$(CXX) -shared $^ $(LDFLAGS) -o $@
@@ -110,6 +144,9 @@ $(BUILD)/btpu_tests: $(TEST_OBJS) $(BUILD)/libbtpu.so
 	$(CXX) $(TEST_OBJS) -L$(BUILD) -lbtpu $(LDFLAGS) -Wl,-rpath,'$$ORIGIN' -o $@
 
 $(BUILD)/%: $(BUILD)/obj/native/exe/%.o $(BUILD)/libbtpu.so
+	$(CXX) $< -L$(BUILD) -lbtpu $(LDFLAGS) -Wl,-rpath,'$$ORIGIN' -o $@
+
+$(BUILD)/btpu_fuzz_replay: $(BUILD)/obj/native/fuzz/fuzz_replay_main.o $(BUILD)/libbtpu.so
 	$(CXX) $< -L$(BUILD) -lbtpu $(LDFLAGS) -Wl,-rpath,'$$ORIGIN' -o $@
 
 $(BUILD)/example_%: $(BUILD)/obj/examples/%.o $(BUILD)/libbtpu.so

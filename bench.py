@@ -438,6 +438,40 @@ def bench_fabric_client() -> None:
         )
 
 
+def bench_decode_guard(get_gbps_1mib: float) -> dict | None:
+    """Decode-overhead guard row (checked WireReader vs the data path).
+
+    Two pieces of evidence, strongest first:
+      - in-run: ns spent in the checked decoders per 1 MiB striped get (4
+        data-plane headers + 1 placement response), as a % of this run's
+        measured op time — immune to the box's +-30% cross-run swing;
+      - cross-run: this run's headline vs the BENCH_r05 recording, for the
+        trend line (interpret with the interference swing in mind).
+    """
+    try:
+        subprocess.run(["make", "build/btpu_fuzz_replay"], cwd=REPO_ROOT,
+                       capture_output=True, timeout=600, check=True)
+        out = subprocess.run([str(REPO_ROOT / "build" / "btpu_fuzz_replay"),
+                              "--bench-decode"],
+                             capture_output=True, text=True, timeout=300,
+                             cwd=REPO_ROOT, check=True)
+        d = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # missing make/binary: report, never fake a pass
+        print(f"decode guard row skipped: {exc}", file=sys.stderr)
+        return None
+    # One 1 MiB striped-4 get parses ~4 data-plane headers (one 256 KiB
+    # staged chunk per shard) plus one GetWorkersResponse.
+    decode_ns = 4 * d["header_decode_ns"] + d["rpc_response_decode_ns"]
+    guard = {
+        "decode_header_ns": round(d["header_decode_ns"], 1),
+        "decode_rpc_response_ns": round(d["rpc_response_decode_ns"], 1),
+    }
+    if get_gbps_1mib > 0:
+        op_ns = (1 << 20) / (get_gbps_1mib * 1e9) * 1e9
+        guard["decode_overhead_pct_1mib"] = round(decode_ns / op_ns * 100, 3)
+    return guard
+
+
 def main() -> int:
     if "--hbm-only" in sys.argv:
         # Child-process mode (see below): only the device-tier bench runs.
@@ -862,6 +896,38 @@ def main() -> int:
                       file=sys.stderr)
         except subprocess.TimeoutExpired:
             print("real-TPU fabric row skipped: timed out", file=sys.stderr)
+    # Decode-overhead guard (ISSUE 6): prove the checked WireReader keeps
+    # the 1 MiB striped get and hot cached get within noise of BENCH_r05.
+    decode_guard = bench_decode_guard(get_gbps)
+    if decode_guard is not None:
+        r05 = {}
+        try:
+            with open(REPO_ROOT / "BENCH_r05.json") as fh:
+                r05 = json.load(fh).get("parsed", {})
+        except Exception:
+            pass
+        vs = []
+        if r05.get("value"):
+            decode_guard["guard_get_1mib_vs_r05"] = round(get_gbps / r05["value"], 3)
+            vs.append(f"1MiB get {get_gbps:.2f} GB/s vs r05 {r05['value']:.2f} "
+                      f"(x{decode_guard['guard_get_1mib_vs_r05']:.2f})")
+        if r05.get("cached_get_64kib_p50_us") and "get_cached" in small_rows:
+            now_p50 = small_rows["get_cached"]["p50_us"]
+            decode_guard["guard_cached_p50_vs_r05"] = round(
+                r05["cached_get_64kib_p50_us"] / now_p50, 3)
+            vs.append(f"cached get p50 {now_p50:.1f}us vs r05 "
+                      f"{r05['cached_get_64kib_p50_us']:.1f}us")
+        pct = decode_guard.get("decode_overhead_pct_1mib")
+        decode_guard["guard_pass"] = bool(pct is not None and pct <= 3.0)
+        print(
+            "decode guard (checked WireReader): "
+            f"{decode_guard['decode_header_ns']:.1f}ns/header, "
+            f"{decode_guard['decode_rpc_response_ns']:.0f}ns/placement decode = "
+            f"{pct if pct is not None else '?'}% of a 1MiB striped get "
+            f"({'PASS <=3%' if decode_guard['guard_pass'] else 'FAIL >3%'})"
+            + (" | " + " | ".join(vs) if vs else ""),
+            file=sys.stderr,
+        )
     summary = {
         "metric": "get_gbps_1mib_striped4_tcp",
         "value": round(get_gbps, 3),
@@ -894,6 +960,9 @@ def main() -> int:
                 hc["gbps"] / small_rows["get_hot"]["gbps"], 2)
         if "cache" in small_rows:
             summary["cache_hit_ratio"] = small_rows["cache"]["hit_ratio"]
+    # Decode-overhead guard fields (ISSUE 6 acceptance).
+    if decode_guard is not None:
+        summary.update(decode_guard)
     # Control-plane shard-scaling headline (ISSUE 4 acceptance): metadata
     # ops/s at 1/2/4 threads, the x4/x1 ratio, and the shard + cpu counts
     # that make the ratio interpretable (a 1-cpu box caps the ratio at ~1.0

@@ -30,8 +30,8 @@ static void bench_tier(StorageClass cls, const std::string& dir) {
   auto t0 = Clock::now();
   for (int i = 0; i < kOps; ++i) {
     auto token = backend->reserve_shard(4096);
-    backend->commit_shard(token.value());
-    backend->free_shard(token.value().offset, 4096);
+    (void)backend->commit_shard(token.value());  // bench loop: timing only
+    (void)backend->free_shard(token.value().offset, 4096);  // bench loop: timing only
   }
   const double ops_sec = kOps / std::chrono::duration<double>(Clock::now() - t0).count();
 
@@ -40,12 +40,12 @@ static void bench_tier(StorageClass cls, const std::string& dir) {
   constexpr int kBlocks = 32;
   t0 = Clock::now();
   for (int i = 0; i < kBlocks; ++i)
-    backend->write_at(static_cast<uint64_t>(i) * block.size(), block.data(), block.size());
+    (void)backend->write_at(static_cast<uint64_t>(i) * block.size(), block.data(), block.size());  // bench loop: timing only
   const double write_gbps = kBlocks * double(block.size()) /
                             std::chrono::duration<double>(Clock::now() - t0).count() / 1e9;
   t0 = Clock::now();
   for (int i = 0; i < kBlocks; ++i)
-    backend->read_at(static_cast<uint64_t>(i) * block.size(), block.data(), block.size());
+    (void)backend->read_at(static_cast<uint64_t>(i) * block.size(), block.data(), block.size());  // bench loop: timing only
   const double read_gbps = kBlocks * double(block.size()) /
                            std::chrono::duration<double>(Clock::now() - t0).count() / 1e9;
 

@@ -33,7 +33,7 @@ int main() {
   // A client that knows both endpoints.
   coord::RemoteCoordinator client(primary->endpoint() + "," + standby.endpoint());
   if (client.connect() != ErrorCode::OK) return 1;
-  client.put("/demo/config", "v1");
+  (void)client.put("/demo/config", "v1");  // demo: failure shows in the reads below
   std::printf("wrote /demo/config=v1 via the primary\n");
 
   // The standby serves reads but refuses writes while the primary lives.

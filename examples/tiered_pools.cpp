@@ -34,8 +34,8 @@ int main() {
   hot.preferred_classes = {StorageClass::HBM_TPU};
 
   std::vector<uint8_t> small(1 << 20, 1), large(32 << 20, 2);
-  client->put("hot-object", small.data(), small.size(), hot);
-  client->put("big-object", large.data(), large.size(), hot);  // spills past HBM
+  (void)client->put("hot-object", small.data(), small.size(), hot);  // demo: placement inspected below
+  (void)client->put("big-object", large.data(), large.size(), hot);  // spills past HBM
 
   for (const char* key : {"hot-object", "big-object"}) {
     auto placements = client->get_workers(key).value();

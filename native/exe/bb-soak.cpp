@@ -267,7 +267,7 @@ int main(int argc, char** argv) {
 
   // Settle: every worker alive, give repair/health a few beats to converge.
   for (size_t i = 0; i < cluster.worker_count(); ++i) {
-    if (!cluster.worker_alive(i)) cluster.revive_worker(i);
+    if (!cluster.worker_alive(i)) (void)cluster.revive_worker(i);  // retried next chaos round
   }
   std::this_thread::sleep_for(std::chrono::seconds(3));
 

@@ -1,0 +1,38 @@
+// Shared corpus-file helpers for the fuzz tooling: used by the replay
+// driver (fuzz_replay_main.cpp) AND the default-suite corpus test
+// (test_wire_fuzz_corpus.cpp), so both always agree on which inputs exist
+// (same directory listing rules, same ordering, same read semantics).
+#pragma once
+
+#include <dirent.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <fstream>
+#include <iterator>
+#include <string>
+#include <vector>
+
+namespace btpu_fuzz {
+
+inline std::vector<std::string> list_corpus_dir(const std::string& dir) {
+  std::vector<std::string> out;
+  DIR* d = ::opendir(dir.c_str());
+  if (!d) return out;
+  while (dirent* e = ::readdir(d)) {
+    const std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    out.push_back(dir + "/" + name);
+  }
+  ::closedir(d);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+inline std::vector<uint8_t> read_corpus_file(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  return std::vector<uint8_t>((std::istreambuf_iterator<char>(in)),
+                              std::istreambuf_iterator<char>());
+}
+
+}  // namespace btpu_fuzz

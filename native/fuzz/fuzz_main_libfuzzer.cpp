@@ -1,0 +1,16 @@
+// libFuzzer entry point (clang boxes; scripts/fuzz.sh builds one binary per
+// target with -DBTPU_FUZZ_TARGET=<name> and -fsanitize=fuzzer,address).
+// Clang-less boxes run the deterministic sweep in fuzz_replay_main.cpp
+// instead; both share the target functions in fuzz_targets.h.
+#include "fuzz_targets.h"
+
+#ifndef BTPU_FUZZ_TARGET
+#error "build with -DBTPU_FUZZ_TARGET=rpc_frame|control_error|tcp_header|record"
+#endif
+
+#define BTPU_FUZZ_CAT_(a, b) a##b
+#define BTPU_FUZZ_CAT(a, b) BTPU_FUZZ_CAT_(a, b)
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  return btpu_fuzz::BTPU_FUZZ_CAT(run_, BTPU_FUZZ_TARGET)(data, size);
+}

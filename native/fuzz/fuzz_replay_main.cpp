@@ -1,0 +1,383 @@
+// Deterministic fuzz driver for clang-less builds (gcc has no libFuzzer) —
+// and the seed-corpus generator for boxes that do have it.
+//
+//   btpu_fuzz_replay --corpus DIR [--execs N] [--target NAME]
+//       Replays every checked-in input under DIR/<target>/ through its
+//       decoder, then runs a deterministic mutation sweep (xorshift64 with
+//       a seed derived from the input bytes — the SAME inputs every run,
+//       so a failure here reproduces everywhere) until >= N total
+//       executions per target. Exit 0 = no crash, no invariant violation.
+//
+//   btpu_fuzz_replay --gen-seeds DIR
+//       Writes the seed corpus: valid encodings of canonical messages,
+//       truncations of each, and the known-hostile regression inputs.
+//       Found crashers get added to the same directories by hand (see
+//       docs/CORRECTNESS.md, "add-a-crasher" workflow).
+//
+// Build: scripts/fuzz.sh (make fuzz). Under clang the libFuzzer harnesses
+// (fuzz_main_libfuzzer.cpp) take over the exploration job; this binary
+// still runs as the deterministic leg so the two agree on the corpus.
+#include <dirent.h>
+#include <sys/stat.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "../fuzz/fuzz_corpus.h"
+#include "../fuzz/fuzz_targets.h"
+
+namespace {
+
+using btpu_fuzz::kFuzzTargets;
+
+uint64_t xorshift64(uint64_t& s) {
+  s ^= s << 13;
+  s ^= s >> 7;
+  s ^= s << 17;
+  return s;
+}
+
+uint64_t fnv1a(const std::vector<uint8_t>& v) {
+  uint64_t h = 1469598103934665603ull;
+  for (uint8_t b : v) {
+    h ^= b;
+    h *= 1099511628211ull;
+  }
+  return h ? h : 1;  // xorshift state must be non-zero
+}
+
+// One mutation step: the classic byte/bit/length edits plus "interesting"
+// integer splices (the values length checks get wrong).
+void mutate(std::vector<uint8_t>& v, uint64_t& s) {
+  static const uint64_t kInteresting[] = {0,        1,         0x7f,       0xff,
+                                          0x7fff,   0xffff,    0x7fffffff, 0xffffffffull,
+                                          1ull << 32, ~0ull >> 1, ~0ull};
+  const uint64_t op = xorshift64(s) % 6;
+  if (v.empty() && op != 4) {
+    v.push_back(static_cast<uint8_t>(xorshift64(s)));
+    return;
+  }
+  switch (op) {
+    case 0:  // bit flip
+      v[xorshift64(s) % v.size()] ^= static_cast<uint8_t>(1u << (xorshift64(s) % 8));
+      break;
+    case 1:  // byte set
+      v[xorshift64(s) % v.size()] = static_cast<uint8_t>(xorshift64(s));
+      break;
+    case 2:  // truncate
+      v.resize(xorshift64(s) % (v.size() + 1));
+      break;
+    case 3: {  // interesting integer splice (u8..u64 at a random offset)
+      const uint64_t val = kInteresting[xorshift64(s) % (sizeof(kInteresting) / 8)];
+      const size_t width = 1u << (xorshift64(s) % 4);  // 1,2,4,8
+      if (v.size() >= width) {
+        const size_t at = xorshift64(s) % (v.size() - width + 1);
+        std::memcpy(v.data() + at, &val, width);
+      }
+      break;
+    }
+    case 4:  // extend with random bytes
+      for (size_t i = 0, n = 1 + xorshift64(s) % 16; i < n; ++i)
+        v.push_back(static_cast<uint8_t>(xorshift64(s)));
+      break;
+    case 5: {  // duplicate a slice (grows nested vectors/strings)
+      const size_t at = xorshift64(s) % v.size();
+      const size_t n = std::min<size_t>(1 + xorshift64(s) % 32, v.size() - at);
+      v.insert(v.end(), v.begin() + static_cast<ptrdiff_t>(at),
+               v.begin() + static_cast<ptrdiff_t>(at + n));
+      break;
+    }
+  }
+}
+
+using btpu_fuzz::list_corpus_dir;
+using btpu_fuzz::read_corpus_file;
+
+void write_file(const std::string& path, const std::vector<uint8_t>& bytes) {
+  std::ofstream out(path, std::ios::binary | std::ios::trunc);
+  out.write(reinterpret_cast<const char*>(bytes.data()),
+            static_cast<std::streamsize>(bytes.size()));
+}
+
+// ---- seed generation -------------------------------------------------------
+
+std::vector<uint8_t> with_sel(uint8_t sel, const std::vector<uint8_t>& payload) {
+  std::vector<uint8_t> out;
+  out.push_back(sel);
+  out.insert(out.end(), payload.begin(), payload.end());
+  return out;
+}
+
+void gen_seeds(const std::string& root) {
+  using namespace btpu;
+  auto emit = [&](const char* target, const char* name, const std::vector<uint8_t>& bytes) {
+    const std::string dir = root + "/" + target;
+    ::mkdir(root.c_str(), 0755);
+    ::mkdir(dir.c_str(), 0755);
+    write_file(dir + "/" + name + ".bin", bytes);
+  };
+  auto truncations = [&](const char* target, const char* name,
+                         const std::vector<uint8_t>& bytes) {
+    emit(target, name, bytes);
+    for (size_t cut : {size_t{1}, bytes.size() / 2,
+                       bytes.size() > 0 ? bytes.size() - 1 : size_t{0}}) {
+      if (cut >= bytes.size()) continue;
+      emit(target, (std::string(name) + "_trunc" + std::to_string(cut)).c_str(),
+           std::vector<uint8_t>(bytes.begin(), bytes.begin() + static_cast<ptrdiff_t>(cut)));
+    }
+  };
+
+  // Canonical message payloads (field shapes matter, values do not).
+  CopyPlacement copy;
+  copy.copy_index = 1;
+  ShardPlacement shard;
+  shard.pool_id = "p1";
+  shard.worker_id = "w1";
+  shard.remote = {TransportKind::TCP, "h:1", 0x1000, "ab", "fa", "pv", 1};
+  shard.storage_class = StorageClass::RAM_CPU;
+  shard.length = 64;
+  shard.location = MemoryLocation{0x2000, 0x55, 64};
+  copy.shards = {shard};
+  copy.content_crc = 0x1234;
+  copy.shard_crcs = {0xAB};
+  WorkerConfig wc;
+  wc.replication_factor = 2;
+
+  // rpc_frame: sel byte picks the message shape in run_rpc_frame.
+  truncations("rpc_frame", "get_workers_resp",
+              with_sel(0, wire::to_bytes(GetWorkersResponse{{copy}, ErrorCode::OK})));
+  truncations("rpc_frame", "put_start_req",
+              with_sel(1, wire::to_bytes(PutStartRequest{"k", 4096, wc, 0x77})));
+  truncations("rpc_frame", "batch_get_workers_resp",
+              with_sel(4, wire::to_bytes(BatchGetWorkersResponse{
+                              {Result<std::vector<CopyPlacement>>(std::vector<CopyPlacement>{copy}),
+                               Result<std::vector<CopyPlacement>>(ErrorCode::OBJECT_NOT_FOUND)},
+                              ErrorCode::OK})));
+  truncations("rpc_frame", "batch_put_start_req",
+              with_sel(5, wire::to_bytes(BatchPutStartRequest{{{"k1", 128, wc, 1}}})));
+  truncations("rpc_frame", "commit_slot_req",
+              with_sel(8, wire::to_bytes(PutCommitSlotRequest{"s", "k", 5, {{0, {0xCD}}},
+                                                              1, 128, wc, "tag"})));
+  truncations("rpc_frame", "put_inline_req",
+              with_sel(10, wire::to_bytes(PutInlineRequest{"k", wc, 9, "payload"})));
+  {
+    // With a v4 deadline trailer appended, as real requests carry it.
+    auto p = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0x77});
+    rpc::append_deadline_trailer(p, 250);
+    truncations("rpc_frame", "put_start_req_deadline", with_sel(1, p));
+  }
+
+  // control_error: the three legal codes, plus the clamp-pinning hostile
+  // hint and an over-long (appended-field) frame.
+  truncations("control_error", "retry_later",
+              rpc::encode_control_error(ErrorCode::RETRY_LATER, 25));
+  emit("control_error", "deadline",
+       rpc::encode_control_error(ErrorCode::DEADLINE_EXCEEDED, 0));
+  emit("control_error", "hostile_hint",
+       rpc::encode_control_error(ErrorCode::RETRY_LATER, 0xFFFFFFFFu));
+  {
+    auto v = rpc::encode_control_error(ErrorCode::RESOURCE_EXHAUSTED, 10);
+    v.push_back(0x7);  // a newer peer appended a field; must stay decodable
+    emit("control_error", "appended_field", v);
+  }
+
+  // tcp_header: every op, raw header bytes (+ the staged frame), hostile
+  // unknown-op and absurd-length variants.
+  using namespace btpu::transport::datawire;
+  auto hdr_bytes = [](uint8_t op, uint64_t addr, uint64_t rkey, uint64_t len,
+                      uint32_t dl) {
+    DataRequestHeader h{op, addr, rkey, len, dl};
+    std::vector<uint8_t> v(sizeof(h));
+    std::memcpy(v.data(), &h, sizeof(h));
+    return v;
+  };
+  truncations("tcp_header", "read", hdr_bytes(kOpRead, 0x1000, 0xBEEF, 65536, 0));
+  emit("tcp_header", "write", hdr_bytes(kOpWrite, 0x2000, 0xBEEF, 1 << 20, 250));
+  emit("tcp_header", "hello", hdr_bytes(kOpHello, 0, 0, 24, 0));
+  emit("tcp_header", "fabric_pull", hdr_bytes(kOpFabricPull, 0x3000, 0xF00D, 4096, 50));
+  emit("tcp_header", "hostile_unknown_op", hdr_bytes(0x42, 0, 0, 16, 0));
+  emit("tcp_header", "hostile_len", hdr_bytes(kOpWrite, 0, 0, ~0ull >> 1, 0));
+  emit("tcp_header", "hostile_hello_len", hdr_bytes(kOpHello, 0, 0, 4096, 0));
+  {
+    StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 256 << 10, 100}, 0x40000};
+    std::vector<uint8_t> v(sizeof(f));
+    std::memcpy(v.data(), &f, sizeof(f));
+    truncations("tcp_header", "staged_write", v);
+  }
+
+  // record: worker/pool/object records (sel byte picks the decoder),
+  // truncations, plus the regression-pinned hostile records.
+  keystone::WorkerInfo wi;
+  wi.worker_id = "w1";
+  wi.address = "h:1";
+  wi.topo = {1, 2, 3};
+  wi.registered_at_ms = 111;
+  wi.last_heartbeat_ms = 222;
+  {
+    const std::string b = keystone::encode_worker_info(wi);
+    truncations("record", "worker",
+                with_sel(0, std::vector<uint8_t>(b.begin(), b.end())));
+  }
+  MemoryPool pool;
+  pool.id = "p1";
+  pool.node_id = "n1";
+  pool.base_addr = 0x1000;
+  pool.size = 1 << 20;
+  pool.storage_class = StorageClass::RAM_CPU;
+  pool.remote = shard.remote;
+  pool.topo = {1, 2, 3};
+  {
+    const std::string b = keystone::encode_pool_record(pool);
+    truncations("record", "pool", with_sel(1, std::vector<uint8_t>(b.begin(), b.end())));
+  }
+  {
+    // Current-era object record, hand-framed exactly as
+    // keystone_persist.cpp's encode_object_record writes it:
+    // [u64 ~0][u8 2][size][ttl][soft_pin][state][config][copies][ts][ts].
+    wire::Writer w;
+    w.put<uint64_t>(~0ull);
+    w.put<uint8_t>(2);
+    wire::encode_fields(w, uint64_t{4096}, uint64_t{0}, false, uint8_t{1}, wc,
+                        std::vector<CopyPlacement>{copy}, int64_t{1000}, int64_t{2000});
+    truncations("record", "object", with_sel(2, w.take()));
+    // Same record with a hostile state byte (7): must be rejected.
+    wire::Writer w2;
+    w2.put<uint64_t>(~0ull);
+    w2.put<uint8_t>(2);
+    wire::encode_fields(w2, uint64_t{4096}, uint64_t{0}, false, uint8_t{7}, wc,
+                        std::vector<CopyPlacement>{copy}, int64_t{1000}, int64_t{2000});
+    emit("record", "hostile_state", with_sel(2, w2.take()));
+    // Future-format envelope: must be refused (kept, not garbage).
+    wire::Writer w3;
+    w3.put<uint64_t>(~0ull);
+    w3.put<uint8_t>(9);
+    w3.put<uint32_t>(0xDEAD);
+    emit("record", "future_format", with_sel(2, w3.take()));
+  }
+  std::printf("seed corpus written under %s\n", root.c_str());
+}
+
+// ---- decode-cost microbench (bench.py guard row) ---------------------------
+// Times the checked decoders on the messages a 1 MiB striped get actually
+// parses, so bench.py can show the WireReader bounds checks cost nothing
+// against the wire time. Run on a NON-sanitized build (asan skews timing).
+void bench_decode() {
+  using namespace btpu;
+  using namespace btpu::transport::datawire;
+  using clock = std::chrono::steady_clock;
+
+  // Data-plane header: what the server parses per sub-op.
+  DataRequestHeader h{kOpRead, 0x1000, 0xBEEF, 1 << 20, 250};
+  std::vector<uint8_t> raw(sizeof(h));
+  std::memcpy(raw.data(), &h, sizeof(h));
+  constexpr int kHdrIters = 2'000'000;
+  uint64_t sink = 0;
+  auto t0 = clock::now();
+  for (int i = 0; i < kHdrIters; ++i) {
+    DataRequestHeader out{};
+    if (decode_request_header(raw.data(), raw.size(), out)) sink += out.len;
+  }
+  const double hdr_ns =
+      std::chrono::duration<double, std::nano>(clock::now() - t0).count() / kHdrIters;
+
+  // Control-plane: the GetWorkersResponse a striped get decodes once (4
+  // shards, CRC stamps — the realistic metadata payload).
+  CopyPlacement copy;
+  copy.copy_index = 0;
+  for (int s = 0; s < 4; ++s) {
+    ShardPlacement shard;
+    shard.pool_id = "pool-" + std::to_string(s);
+    shard.worker_id = "worker-" + std::to_string(s);
+    shard.remote = {TransportKind::TCP, "10.0.0.1:7070", 0x1000, "abcd", "fa", "pv", 1};
+    shard.storage_class = StorageClass::RAM_CPU;
+    shard.length = (1 << 20) / 4;
+    shard.location = MemoryLocation{0x2000, 0x55, (1 << 20) / 4};
+    copy.shards.push_back(shard);
+    copy.shard_crcs.push_back(0x1234 + static_cast<uint32_t>(s));
+  }
+  copy.content_crc = 0x9999;
+  const auto payload = wire::to_bytes(GetWorkersResponse{{copy}, ErrorCode::OK});
+  constexpr int kRpcIters = 200'000;
+  t0 = clock::now();
+  for (int i = 0; i < kRpcIters; ++i) {
+    GetWorkersResponse out{};
+    if (wire::from_bytes_lax(payload, out)) sink += out.copies.size();
+  }
+  const double rpc_ns =
+      std::chrono::duration<double, std::nano>(clock::now() - t0).count() / kRpcIters;
+
+  // JSON on stdout for bench.py; sink printed to stderr so nothing folds.
+  std::printf("{\"header_decode_ns\": %.1f, \"rpc_response_decode_ns\": %.1f, "
+              "\"rpc_payload_bytes\": %zu}\n",
+              hdr_ns, rpc_ns, payload.size());
+  std::fprintf(stderr, "sink=%llu\n", static_cast<unsigned long long>(sink));
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string corpus, gen, only_target;
+  uint64_t execs = 250000;
+  for (int i = 1; i < argc; ++i) {
+    const std::string a = argv[i];
+    if (a == "--corpus" && i + 1 < argc) corpus = argv[++i];
+    else if (a == "--gen-seeds" && i + 1 < argc) gen = argv[++i];
+    else if (a == "--execs" && i + 1 < argc) execs = std::strtoull(argv[++i], nullptr, 10);
+    else if (a == "--target" && i + 1 < argc) only_target = argv[++i];
+    else if (a == "--bench-decode") { bench_decode(); return 0; }
+    else {
+      std::fprintf(stderr,
+                   "usage: %s --corpus DIR [--execs N] [--target NAME] | --gen-seeds DIR\n",
+                   argv[0]);
+      return 2;
+    }
+  }
+  if (!gen.empty()) {
+    gen_seeds(gen);
+    return 0;
+  }
+  if (corpus.empty()) {
+    std::fprintf(stderr, "need --corpus or --gen-seeds\n");
+    return 2;
+  }
+  for (const auto& t : kFuzzTargets) {
+    if (!only_target.empty() && only_target != t.name) continue;
+    const auto files = list_corpus_dir(corpus + "/" + t.name);
+    if (files.empty()) {
+      std::fprintf(stderr, "fuzz: no corpus for %s under %s — refusing to claim coverage\n",
+                   t.name, corpus.c_str());
+      return 1;
+    }
+    uint64_t ran = 0;
+    // Phase 1: pure replay (every checked-in input, incl. past crashers).
+    std::vector<std::vector<uint8_t>> inputs;
+    for (const auto& f : files) {
+      inputs.push_back(read_corpus_file(f));
+      t.fn(inputs.back().data(), inputs.back().size());
+      ++ran;
+    }
+    // Phase 2: deterministic mutation sweep until the exec budget is spent.
+    uint64_t seed_idx = 0;
+    while (ran < execs) {
+      const auto& base = inputs[seed_idx % inputs.size()];
+      uint64_t s = fnv1a(base) ^ (0x9E3779B97F4A7C15ull * (seed_idx + 1));
+      std::vector<uint8_t> v = base;
+      const uint64_t steps = 1 + xorshift64(s) % 8;
+      for (uint64_t m = 0; m < steps; ++m) {
+        mutate(v, s);
+        t.fn(v.data(), v.size());
+        if (++ran >= execs) break;
+      }
+      ++seed_idx;
+    }
+    std::printf("fuzz[%s]: %llu execs over %zu seed inputs, 0 crashes\n", t.name,
+                static_cast<unsigned long long>(ran), files.size());
+  }
+  return 0;
+}

@@ -1,0 +1,166 @@
+// Fuzz entry points for the four hostile-input decode surfaces:
+//
+//   rpc_frame      RPC payload decode (all message shapes) + the v4
+//                  deadline trailer strip, incl. an encode/decode
+//                  round-trip invariant
+//   control_error  0xEE pre-dispatch rejection frames
+//   tcp_header     raw TCP DataRequestHeader / StagedFrame (data_wire.h)
+//   record         WAL/persist records: worker info, pool record, object
+//                  record (envelope dispatch + all legacy layouts)
+//
+// Header-only on purpose: the SAME functions compile into (a) the libFuzzer
+// harness (scripts/fuzz.sh under clang), (b) the gcc corpus-replay binary
+// (build/fuzz/btpu_fuzz_replay), and (c) the default-suite regression test
+// (native/tests/test_wire_fuzz_corpus.cpp) — so a crasher found by any of
+// them regresses against the exact decoder production runs.
+//
+// Contract for every target: NEVER crash, NEVER read out of bounds, and
+// uphold the stated invariants (asserted via fuzz_expect, which aborts so
+// both libFuzzer and asan report it as a finding). Return value is 0
+// (libFuzzer convention); "input rejected" is a normal outcome, not a
+// failure.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "btpu/common/wire.h"
+#include "btpu/keystone/keystone.h"
+#include "btpu/rpc/rpc.h"
+#include "btpu/transport/data_wire.h"
+
+namespace btpu_fuzz {
+
+inline void fuzz_expect(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "FUZZ INVARIANT VIOLATED: %s\n", what);
+    std::abort();
+  }
+}
+
+// ---- rpc_frame -------------------------------------------------------------
+// First byte selects the message shape (covering every field pattern the
+// protocol uses: strings, vectors, nested structs, Result<T>, raw bytes,
+// parallel vectors); the rest is the payload. Both the lax (frame-bounded)
+// and strict decodes run, plus the deadline-trailer strip. A payload that
+// decodes must re-encode and re-decode cleanly (round-trip invariant).
+template <typename Msg>
+inline void rpc_roundtrip(const std::vector<uint8_t>& payload) {
+  Msg m{};
+  if (!btpu::wire::from_bytes_lax(payload, m)) return;
+  const auto bytes = btpu::wire::to_bytes(m);
+  Msg again{};
+  fuzz_expect(btpu::wire::from_bytes_lax(bytes, again),
+              "rpc re-encode of a decoded message must decode");
+  Msg strict{};
+  (void)btpu::wire::from_bytes(payload, strict);  // strict verdict may differ; must not crash
+}
+
+inline int run_rpc_frame(const uint8_t* data, size_t size) {
+  using namespace btpu;
+  if (size == 0) return 0;
+  const uint8_t sel = data[0];
+  std::vector<uint8_t> payload(data + 1, data + size);
+  // The server strips the trailer before decoding — mirror that order.
+  uint32_t budget_ms = 0;
+  (void)rpc::strip_deadline_trailer(payload, budget_ms);
+  switch (sel % 14) {
+    case 0: rpc_roundtrip<GetWorkersResponse>(payload); break;
+    case 1: rpc_roundtrip<PutStartRequest>(payload); break;
+    case 2: rpc_roundtrip<PutStartResponse>(payload); break;
+    case 3: rpc_roundtrip<PutCompleteRequest>(payload); break;
+    case 4: rpc_roundtrip<BatchGetWorkersResponse>(payload); break;
+    case 5: rpc_roundtrip<BatchPutStartRequest>(payload); break;
+    case 6: rpc_roundtrip<BatchPutCompleteRequest>(payload); break;
+    case 7: rpc_roundtrip<ListObjectsResponse>(payload); break;
+    case 8: rpc_roundtrip<PutCommitSlotRequest>(payload); break;
+    case 9: rpc_roundtrip<PutStartPooledResponse>(payload); break;
+    case 10: rpc_roundtrip<PutInlineRequest>(payload); break;
+    case 11: rpc_roundtrip<GetClusterStatsResponse>(payload); break;
+    case 12: rpc_roundtrip<PingResponse>(payload); break;
+    case 13: rpc_roundtrip<ObjectExistsResponse>(payload); break;
+  }
+  return 0;
+}
+
+// ---- control_error ---------------------------------------------------------
+inline int run_control_error(const uint8_t* data, size_t size) {
+  using namespace btpu;
+  std::vector<uint8_t> payload(data, data + size);
+  ErrorCode code{};
+  uint32_t hint_ms = 0;
+  if (rpc::decode_control_error(payload, code, hint_ms)) {
+    fuzz_expect(hint_ms <= rpc::kMaxBackoffHintMs,
+                "control-error backoff hint must be clamped");
+    fuzz_expect(code == ErrorCode::RETRY_LATER || code == ErrorCode::DEADLINE_EXCEEDED ||
+                    code == ErrorCode::RESOURCE_EXHAUSTED,
+                "control-error code must be a pre-dispatch rejection code");
+  }
+  return 0;
+}
+
+// ---- tcp_header ------------------------------------------------------------
+inline int run_tcp_header(const uint8_t* data, size_t size) {
+  using namespace btpu::transport::datawire;
+  DataRequestHeader hdr{};
+  if (decode_request_header(data, size, hdr)) {
+    fuzz_expect(valid_op(hdr.op), "decoded header must carry a known op");
+    if (hdr.op == kOpHello) {
+      fuzz_expect(hdr.len >= 1 && hdr.len <= kMaxHelloNameBytes,
+                  "hello name length must be within its ceiling");
+    } else {
+      fuzz_expect(hdr.len <= kMaxDataOpBytes, "data op length must be capped");
+    }
+  }
+  StagedFrame frame{};
+  if (decode_staged_frame(data, size, frame)) {
+    fuzz_expect(frame.h.op == kOpReadStaged || frame.h.op == kOpWriteStaged,
+                "staged frame must carry a staged op");
+    fuzz_expect(frame.h.len <= kMaxDataOpBytes, "staged chunk length must be capped");
+  }
+  return 0;
+}
+
+// ---- record ----------------------------------------------------------------
+// First byte selects the decoder; the rest is the durable record bytes.
+inline int run_record(const uint8_t* data, size_t size) {
+  using namespace btpu;
+  if (size == 0) return 0;
+  const uint8_t sel = data[0];
+  const std::string bytes(reinterpret_cast<const char*>(data + 1), size - 1);
+  switch (sel % 3) {
+    case 0: {
+      keystone::WorkerInfo info;
+      (void)keystone::decode_worker_info(bytes, info);
+      break;
+    }
+    case 1: {
+      MemoryPool pool;
+      (void)keystone::decode_pool_record(bytes, pool);
+      break;
+    }
+    case 2:
+      (void)keystone::probe_object_record(bytes);
+      break;
+  }
+  return 0;
+}
+
+// ---- registry --------------------------------------------------------------
+using FuzzFn = int (*)(const uint8_t*, size_t);
+struct FuzzTarget {
+  const char* name;
+  FuzzFn fn;
+};
+inline constexpr FuzzTarget kFuzzTargets[] = {
+    {"rpc_frame", run_rpc_frame},
+    {"control_error", run_control_error},
+    {"tcp_header", run_tcp_header},
+    {"record", run_record},
+};
+
+}  // namespace btpu_fuzz

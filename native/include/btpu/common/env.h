@@ -2,10 +2,15 @@
 // tree reads through these, so empty-string / garbage handling stays
 // uniform: unset OR empty falls back, non-numeric parses as 0 (strtoul
 // semantics) — a deliberate "explicitly off" escape hatch.
+//
+// This file is the ONLY place in the native tree allowed to call getenv
+// (scripts/btpu_lint.py rule env-via-env-h; native/tests are exempt because
+// they set/save/restore variables to exercise the knobs themselves).
 #pragma once
 
 #include <cstdint>
 #include <cstdlib>
+#include <cstring>
 
 namespace btpu {
 
@@ -19,6 +24,24 @@ inline uint32_t env_u32(const char* name, uint32_t fallback) {
   const char* v = std::getenv(name);
   if (!v || !v[0]) return fallback;
   return static_cast<uint32_t>(std::strtoul(v, nullptr, 10));
+}
+
+// String knob: unset OR empty yields the fallback (which may be nullptr for
+// "no override"). The returned pointer aliases the environment — treat it
+// as borrowed, same as getenv itself.
+inline const char* env_str(const char* name, const char* fallback = nullptr) {
+  const char* v = std::getenv(name);
+  return (v && v[0]) ? v : fallback;
+}
+
+// Boolean knob: unset/empty falls back; "0", "false", "off", "no" are
+// false; anything else present is true (so BTPU_FOO=1 and BTPU_FOO=on both
+// enable).
+inline bool env_bool(const char* name, bool fallback) {
+  const char* v = std::getenv(name);
+  if (!v || !v[0]) return fallback;
+  return !(std::strcmp(v, "0") == 0 || std::strcmp(v, "false") == 0 ||
+           std::strcmp(v, "off") == 0 || std::strcmp(v, "no") == 0);
 }
 
 }  // namespace btpu

@@ -8,6 +8,26 @@
 #include <cstdint>
 #include <string_view>
 
+// BTPU_NODISCARD: an error or decode verdict the caller MUST look at.
+// Applied at the TYPE level to ErrorCode and Result<T> below — which makes
+// every function returning them warn-on-discard automatically, including
+// ones written next year — and at the DECLARATION level to bool-returning
+// decode/parse/validate functions, whose bool carries the same "did this
+// fail" weight but whose type cannot. The whole tree builds with
+// -Werror=unused-result (Makefile/CMake), so a dropped ErrorCode is a
+// compile error, not a latent bug. Deliberate discards spell it out with
+// a (void) cast and a comment saying why ignoring is correct.
+// scripts/btpu_lint.py enforces both the type-level attributes and the
+// per-declaration sweep.
+#if defined(__has_cpp_attribute)
+#if __has_cpp_attribute(nodiscard)
+#define BTPU_NODISCARD [[nodiscard]]
+#endif
+#endif
+#ifndef BTPU_NODISCARD
+#define BTPU_NODISCARD
+#endif
+
 namespace btpu {
 
 enum class Domain : uint32_t {
@@ -23,7 +43,7 @@ enum class Domain : uint32_t {
 
 constexpr uint32_t domain_base(Domain d) noexcept { return static_cast<uint32_t>(d); }
 
-enum class ErrorCode : uint32_t {
+enum class BTPU_NODISCARD ErrorCode : uint32_t {
   OK = 0,
 
   // System (1000-1999)

@@ -6,9 +6,11 @@
 #include <chrono>
 #include <cstdlib>
 #include <cstring>
-#include <mutex>
 #include <sstream>
 #include <string>
+
+#include "btpu/common/env.h"
+#include "btpu/common/error.h"
 
 namespace btpu::log {
 
@@ -16,7 +18,7 @@ enum class Level : int { kError = 0, kWarn = 1, kInfo = 2, kDebug = 3, kTrace = 
 
 inline Level global_level() {
   static Level lvl = [] {
-    const char* e = std::getenv("BTPU_LOG");
+    const char* e = ::btpu::env_str("BTPU_LOG");
     if (!e) return Level::kWarn;
     if (!std::strcmp(e, "error")) return Level::kError;
     if (!std::strcmp(e, "warn")) return Level::kWarn;
@@ -66,3 +68,21 @@ struct Sink {  // swallows the stream when the level is disabled
 #define LOG_INFO BTPU_LOG(kInfo)
 #define LOG_DEBUG BTPU_LOG(kDebug)
 #define LOG_TRACE BTPU_LOG(kTrace)
+
+namespace btpu {
+
+// Error sink for cleanup / best-effort paths. ErrorCode is a [[nodiscard]]
+// type, so every tolerated failure must say so explicitly — and a bare
+// (void) cast hides real failures (a leaked range, a stale durable record)
+// forever. This logs any outcome other than OK (or the one explicitly
+// tolerated code, e.g. NOT_FOUND on an idempotent delete) and keeps the
+// tolerance greppable. Hot paths never call this with a failure in steady
+// state, so the log cost is zero there.
+inline void warn_if_error(ErrorCode ec, const char* what,
+                          ErrorCode tolerated = ErrorCode::OK) {
+  if (ec != ErrorCode::OK && ec != tolerated) {
+    LOG_WARN << what << " failed: " << to_string(ec) << " (tolerated; best-effort path)";
+  }
+}
+
+}  // namespace btpu

@@ -16,7 +16,7 @@
 namespace btpu {
 
 template <typename T>
-class Result {
+class BTPU_NODISCARD Result {
  public:
   // Default state is an error so a forgotten assignment is never a fake success
   // (needed by wire decode, which value-initializes before filling in).

@@ -68,47 +68,120 @@ class Writer {
   std::vector<uint8_t> buf_;
 };
 
-class Reader {
+// WireReader: THE bounds-checked cursor every raw decode surface in the
+// tree parses through (RPC frame trailers, 0xEE control-error frames, the
+// packed TCP data-plane headers, WAL/persist record envelopes — and, via
+// the Reader subclass below, the whole struct codec). Contract:
+//   - every read is validated against the remaining bytes FIRST; a short
+//     buffer returns false and moves nothing (truncation is an error, not
+//     UB — there is no way to read past `size`);
+//   - every accessor is BTPU_NODISCARD, so an unchecked read of hostile
+//     bytes is a compile error under -Werror=unused-result;
+//   - length/count fields read through length_u32/length_u64, which
+//     sanity-cap the value against an explicit ceiling AND the remaining
+//     bytes, so a hostile 2^32 count can neither over-allocate nor wrap
+//     any downstream `pos + n` arithmetic (cursor math is index-based and
+//     checked, never pointer-bumped);
+//   - peeks never advance, so probe-then-dispatch decoders (the record
+//     envelope) cannot desynchronize the cursor.
+class WireReader {
  public:
-  Reader(const uint8_t* data, size_t size) : data_(data), size_(size) {}
-  explicit Reader(const std::vector<uint8_t>& v) : Reader(v.data(), v.size()) {}
+  WireReader(const void* data, size_t size)
+      : data_(static_cast<const uint8_t*>(data)), size_(size) {}
+  explicit WireReader(const std::vector<uint8_t>& v) : WireReader(v.data(), v.size()) {}
 
   size_t remaining() const noexcept { return size_ - pos_; }
+  size_t consumed() const noexcept { return pos_; }
   bool exhausted() const noexcept { return pos_ == size_; }
+  const uint8_t* cursor() const noexcept { return data_ + pos_; }
 
-  bool get_bytes(void* out, size_t n) {
+  BTPU_NODISCARD bool bytes(void* out, size_t n) noexcept {
     if (remaining() < n) return false;
     std::memcpy(out, data_ + pos_, n);
     pos_ += n;
     return true;
   }
 
-  template <typename T>
-    requires std::is_arithmetic_v<T> || std::is_enum_v<T>
-  bool get(T& out) {
-    return get_bytes(&out, sizeof(T));
-  }
+  BTPU_NODISCARD bool u8(uint8_t& out) noexcept { return bytes(&out, 1); }
+  BTPU_NODISCARD bool u16(uint16_t& out) noexcept { return bytes(&out, 2); }
+  BTPU_NODISCARD bool u32(uint32_t& out) noexcept { return bytes(&out, 4); }
+  BTPU_NODISCARD bool u64(uint64_t& out) noexcept { return bytes(&out, 8); }
 
-  bool get_string(std::string& out) {
-    uint32_t n = 0;
-    if (!get(n) || remaining() < n) return false;
-    out.assign(reinterpret_cast<const char*>(data_ + pos_), n);
+  // Borrow `n` bytes in place (no copy); the view aliases the input buffer.
+  BTPU_NODISCARD bool view(const uint8_t*& out, size_t n) noexcept {
+    if (remaining() < n) return false;
+    out = data_ + pos_;
     pos_ += n;
     return true;
   }
 
-  const uint8_t* cursor() const noexcept { return data_ + pos_; }
-
-  bool skip(size_t n) {
+  BTPU_NODISCARD bool skip(size_t n) noexcept {
     if (remaining() < n) return false;
     pos_ += n;
     return true;
   }
 
+  // Probe without consuming: the envelope/dispatch decoders look before
+  // they leap. A short buffer returns false, same as the consuming reads.
+  BTPU_NODISCARD bool peek_u8(uint8_t& out) const noexcept { return peek(&out, 1, 0); }
+  BTPU_NODISCARD bool peek_u64(uint64_t& out) const noexcept { return peek(&out, 8, 0); }
+  BTPU_NODISCARD bool peek_u8_at(uint8_t& out, size_t off) const noexcept {
+    return peek(&out, 1, off);
+  }
+
+  // Length/count fields from untrusted input: the value must fit BOTH the
+  // caller's semantic ceiling and the bytes actually present (each counted
+  // element/byte costs >= `min_unit` bytes of input). Rejecting here keeps
+  // hostile counts from reaching reserve()/resize() at all.
+  BTPU_NODISCARD bool length_u32(uint32_t& out, uint64_t cap, size_t min_unit = 1) noexcept {
+    uint32_t n = 0;
+    if (!u32(n) || n > cap) return false;
+    if (min_unit > 0 && static_cast<uint64_t>(n) > remaining() / min_unit) return false;
+    out = n;
+    return true;
+  }
+  BTPU_NODISCARD bool length_u64(uint64_t& out, uint64_t cap, size_t min_unit = 1) noexcept {
+    uint64_t n = 0;
+    if (!u64(n) || n > cap) return false;
+    if (min_unit > 0 && n > remaining() / min_unit) return false;
+    out = n;
+    return true;
+  }
+
  private:
+  BTPU_NODISCARD bool peek(void* out, size_t n, size_t off) const noexcept {
+    if (remaining() < off || remaining() - off < n) return false;
+    std::memcpy(out, data_ + pos_ + off, n);
+    return true;
+  }
+
   const uint8_t* data_;
   size_t size_;
   size_t pos_{0};
+};
+
+// Reader: the struct-codec cursor — WireReader's checked core plus the
+// typed get<T>/get_string surface the encode/decode overload set uses.
+class Reader : public WireReader {
+ public:
+  using WireReader::WireReader;
+
+  BTPU_NODISCARD bool get_bytes(void* out, size_t n) { return bytes(out, n); }
+
+  template <typename T>
+    requires std::is_arithmetic_v<T> || std::is_enum_v<T>
+  BTPU_NODISCARD bool get(T& out) {
+    return bytes(&out, sizeof(T));
+  }
+
+  BTPU_NODISCARD bool get_string(std::string& out) {
+    uint32_t n = 0;
+    if (!length_u32(n, std::numeric_limits<uint32_t>::max())) return false;
+    const uint8_t* p = nullptr;
+    if (!view(p, n)) return false;
+    out.assign(reinterpret_cast<const char*>(p), n);
+    return true;
+  }
 };
 
 // ---- encode/decode overload set ------------------------------------------
@@ -118,15 +191,15 @@ template <typename T>
 inline void encode(Writer& w, const T& v) { w.put(v); }
 template <typename T>
   requires std::is_arithmetic_v<T> || std::is_enum_v<T>
-inline bool decode(Reader& r, T& v) { return r.get(v); }
+BTPU_NODISCARD inline bool decode(Reader& r, T& v) { return r.get(v); }
 
 inline void encode(Writer& w, const std::string& s) { w.put_string(s); }
-inline bool decode(Reader& r, std::string& s) { return r.get_string(s); }
+BTPU_NODISCARD inline bool decode(Reader& r, std::string& s) { return r.get_string(s); }
 
 // bool gets an explicit one-byte encoding: raw memcpy into a bool from
 // untrusted bytes would create an invalid value representation (UB).
 inline void encode(Writer& w, const bool& v) { w.put<uint8_t>(v ? 1 : 0); }
-inline bool decode(Reader& r, bool& v) {
+BTPU_NODISCARD inline bool decode(Reader& r, bool& v) {
   uint8_t b = 0;
   if (!r.get(b) || b > 1) return false;
   v = (b == 1);
@@ -136,7 +209,7 @@ inline bool decode(Reader& r, bool& v) {
 template <typename T>
 void encode(Writer& w, const std::vector<T>& v);
 template <typename T>
-bool decode(Reader& r, std::vector<T>& v);
+BTPU_NODISCARD bool decode(Reader& r, std::vector<T>& v);
 
 template <typename T>
 void encode(Writer& w, const Result<T>& res) {
@@ -150,7 +223,7 @@ void encode(Writer& w, const Result<T>& res) {
 }
 
 template <typename T>
-bool decode(Reader& r, Result<T>& out) {
+BTPU_NODISCARD bool decode(Reader& r, Result<T>& out) {
   uint8_t tag = 0;
   if (!r.get(tag)) return false;
   if (tag == 0) {
@@ -176,18 +249,18 @@ void encode_fields(Writer& w, const T& first, const Rest&... rest) {
   encode(w, first);
   encode_fields(w, rest...);
 }
-inline bool decode_fields(Reader&) { return true; }
+BTPU_NODISCARD inline bool decode_fields(Reader&) { return true; }
 template <typename T, typename... Rest>
-bool decode_fields(Reader& r, T& first, Rest&... rest) {
+BTPU_NODISCARD bool decode_fields(Reader& r, T& first, Rest&... rest) {
   return decode(r, first) && decode_fields(r, rest...);
 }
 
 // Tail-tolerant variant: a clean end-of-input at a field boundary leaves the
 // remaining fields defaulted (older peer omitted them); a partial field is
 // still an error (corruption, not version skew).
-inline bool decode_fields_tail(Reader&) { return true; }
+BTPU_NODISCARD inline bool decode_fields_tail(Reader&) { return true; }
 template <typename T, typename... Rest>
-bool decode_fields_tail(Reader& r, T& first, Rest&... rest) {
+BTPU_NODISCARD bool decode_fields_tail(Reader& r, T& first, Rest&... rest) {
   if (r.exhausted()) {
     first = T{};
     return decode_fields_tail(r, rest...);
@@ -211,7 +284,7 @@ void encode_struct(Writer& w, const Fields&... fields) {
 }
 
 template <typename... Fields>
-bool decode_struct(Reader& r, Fields&... fields) {
+BTPU_NODISCARD bool decode_struct(Reader& r, Fields&... fields) {
   uint32_t len = 0;
   if (!r.get(len) || r.remaining() < len) return false;
   Reader body(r.cursor(), len);
@@ -224,13 +297,13 @@ bool decode_struct(Reader& r, Fields&... fields) {
 // version-tolerant even when the struct is nested inside vectors/messages.
 
 inline void encode(Writer& w, const TopoCoord& t) { encode_struct(w, t.slice_id, t.host_id, t.chip_id); }
-inline bool decode(Reader& r, TopoCoord& t) { return decode_struct(r, t.slice_id, t.host_id, t.chip_id); }
+BTPU_NODISCARD inline bool decode(Reader& r, TopoCoord& t) { return decode_struct(r, t.slice_id, t.host_id, t.chip_id); }
 
 inline void encode(Writer& w, const RemoteDescriptor& d) {
   encode_struct(w, d.transport, d.endpoint, d.remote_base, d.rkey_hex, d.fabric_addr,
                 d.pvm_endpoint, d.data_wire_version);
 }
-inline bool decode(Reader& r, RemoteDescriptor& d) {
+BTPU_NODISCARD inline bool decode(Reader& r, RemoteDescriptor& d) {
   // `pvm_endpoint` appended after fabric_addr; old frames leave it "".
   // `data_wire_version` appended after that; old frames leave it 0
   // (pre-versioned peer — the tcp client refuses those, see types.h).
@@ -239,15 +312,15 @@ inline bool decode(Reader& r, RemoteDescriptor& d) {
 }
 
 inline void encode(Writer& w, const MemoryLocation& m) { encode_struct(w, m.remote_addr, m.rkey, m.size); }
-inline bool decode(Reader& r, MemoryLocation& m) { return decode_struct(r, m.remote_addr, m.rkey, m.size); }
+BTPU_NODISCARD inline bool decode(Reader& r, MemoryLocation& m) { return decode_struct(r, m.remote_addr, m.rkey, m.size); }
 
 inline void encode(Writer& w, const FileLocation& f) { encode_struct(w, f.file_path, f.file_offset); }
-inline bool decode(Reader& r, FileLocation& f) { return decode_struct(r, f.file_path, f.file_offset); }
+BTPU_NODISCARD inline bool decode(Reader& r, FileLocation& f) { return decode_struct(r, f.file_path, f.file_offset); }
 
 inline void encode(Writer& w, const DeviceLocation& d) {
   encode_struct(w, d.device_id, d.region_id, d.offset, d.size);
 }
-inline bool decode(Reader& r, DeviceLocation& d) {
+BTPU_NODISCARD inline bool decode(Reader& r, DeviceLocation& d) {
   return decode_struct(r, d.device_id, d.region_id, d.offset, d.size);
 }
 
@@ -255,7 +328,7 @@ inline void encode(Writer& w, const LocationDetail& loc) {
   w.put<uint8_t>(static_cast<uint8_t>(loc.index()));
   std::visit([&w](const auto& alt) { encode(w, alt); }, loc);
 }
-inline bool decode(Reader& r, LocationDetail& loc) {
+BTPU_NODISCARD inline bool decode(Reader& r, LocationDetail& loc) {
   uint8_t idx = 0;
   if (!r.get(idx)) return false;
   switch (idx) {
@@ -269,7 +342,7 @@ inline bool decode(Reader& r, LocationDetail& loc) {
 inline void encode(Writer& w, const ShardPlacement& s) {
   encode_struct(w, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
 }
-inline bool decode(Reader& r, ShardPlacement& s) {
+BTPU_NODISCARD inline bool decode(Reader& r, ShardPlacement& s) {
   return decode_struct(r, s.pool_id, s.worker_id, s.remote, s.storage_class, s.length, s.location);
 }
 
@@ -278,7 +351,7 @@ inline void encode(Writer& w, const CopyPlacement& c) {
                 c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data,
                 c.cache_version, c.cache_gen, c.cache_lease_ms);
 }
-inline bool decode(Reader& r, CopyPlacement& c) {
+BTPU_NODISCARD inline bool decode(Reader& r, CopyPlacement& c) {
   return decode_struct(r, c.copy_index, c.shards, c.ec_data_shards, c.ec_parity_shards,
                        c.ec_object_size, c.content_crc, c.shard_crcs, c.inline_data,
                        c.cache_version, c.cache_gen, c.cache_lease_ms);
@@ -287,7 +360,7 @@ inline bool decode(Reader& r, CopyPlacement& c) {
 inline void encode(Writer& w, const PutSlot& s) {
   encode_struct(w, s.slot_key, s.copies);
 }
-inline bool decode(Reader& r, PutSlot& s) {
+BTPU_NODISCARD inline bool decode(Reader& r, PutSlot& s) {
   return decode_struct(r, s.slot_key, s.copies);
 }
 
@@ -299,7 +372,7 @@ inline void encode(Writer& w, const WorkerConfig& c) {
                 static_cast<uint64_t>(c.ec_data_shards),
                 static_cast<uint64_t>(c.ec_parity_shards));
 }
-inline bool decode(Reader& r, WorkerConfig& c) {
+BTPU_NODISCARD inline bool decode(Reader& r, WorkerConfig& c) {
   uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
   if (!decode_struct(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
                      c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
@@ -317,7 +390,7 @@ inline void encode(Writer& w, const ClusterStats& s) {
   encode_struct(w, s.total_workers, s.total_memory_pools, s.total_objects, s.total_capacity,
                 s.used_capacity, s.avg_utilization, s.inline_bytes);
 }
-inline bool decode(Reader& r, ClusterStats& s) {
+BTPU_NODISCARD inline bool decode(Reader& r, ClusterStats& s) {
   return decode_struct(r, s.total_workers, s.total_memory_pools, s.total_objects,
                        s.total_capacity, s.used_capacity, s.avg_utilization, s.inline_bytes);
 }
@@ -326,7 +399,7 @@ inline void encode(Writer& w, const MemoryPool& p) {
   encode_struct(w, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class, p.remote,
                 p.topo, p.alignment, p.fabric_addr);
 }
-inline bool decode(Reader& r, MemoryPool& p) {
+BTPU_NODISCARD inline bool decode(Reader& r, MemoryPool& p) {
   // `alignment` and `fabric_addr` were appended after v1 shipped;
   // decode_struct's tail tolerance defaults them for older records.
   return decode_struct(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class,
@@ -336,19 +409,19 @@ inline bool decode(Reader& r, MemoryPool& p) {
 inline void encode(Writer& w, const ObjectSummary& o) {
   encode_struct(w, o.key, o.size, o.complete_copies, o.soft_pin);
 }
-inline bool decode(Reader& r, ObjectSummary& o) {
+BTPU_NODISCARD inline bool decode(Reader& r, ObjectSummary& o) {
   return decode_struct(r, o.key, o.size, o.complete_copies, o.soft_pin);
 }
 
 inline void encode(Writer& w, const BatchPutStartItem& i) {
   encode_struct(w, i.key, i.data_size, i.config, i.content_crc);
 }
-inline bool decode(Reader& r, BatchPutStartItem& i) {
+BTPU_NODISCARD inline bool decode(Reader& r, BatchPutStartItem& i) {
   return decode_struct(r, i.key, i.data_size, i.config, i.content_crc);
 }
 
 inline void encode(Writer& w, const CopyShardCrcs& c) { encode_struct(w, c.copy_index, c.crcs); }
-inline bool decode(Reader& r, CopyShardCrcs& c) { return decode_struct(r, c.copy_index, c.crcs); }
+BTPU_NODISCARD inline bool decode(Reader& r, CopyShardCrcs& c) { return decode_struct(r, c.copy_index, c.crcs); }
 
 template <typename T>
 void encode(Writer& w, const std::vector<T>& v) {
@@ -359,7 +432,7 @@ void encode(Writer& w, const std::vector<T>& v) {
 }
 
 template <typename T>
-bool decode(Reader& r, std::vector<T>& v) {
+BTPU_NODISCARD bool decode(Reader& r, std::vector<T>& v) {
   uint32_t n = 0;
   if (!r.get(n)) return false;
   // Guard against hostile counts: each element costs >= 1 byte on the wire.
@@ -384,14 +457,14 @@ bool decode(Reader& r, std::vector<T>& v) {
     auto& [__VA_ARGS__] = m;                                          \
     encode_fields(w, __VA_ARGS__);                                    \
   }                                                                   \
-  inline bool decode(Reader& r, Type& m) {                            \
+  BTPU_NODISCARD inline bool decode(Reader& r, Type& m) {             \
     auto& [__VA_ARGS__] = m;                                          \
     return decode_fields_tail(r, __VA_ARGS__);                        \
   }
 
 #define BTPU_WIRE_EMPTY(Type)                       \
   inline void encode(Writer&, const Type&) {}       \
-  inline bool decode(Reader&, Type&) { return true; }
+  BTPU_NODISCARD inline bool decode(Reader&, Type&) { return true; }
 
 BTPU_WIRE_STRUCT(ObjectExistsRequest, f0)
 BTPU_WIRE_STRUCT(ObjectExistsResponse, f0, f1)
@@ -446,7 +519,7 @@ std::vector<uint8_t> to_bytes(const T& msg) {
 }
 
 template <typename T>
-bool from_bytes(const std::vector<uint8_t>& bytes, T& out) {
+BTPU_NODISCARD bool from_bytes(const std::vector<uint8_t>& bytes, T& out) {
   Reader r(bytes);
   return decode(r, out) && r.exhausted();
 }
@@ -455,7 +528,7 @@ bool from_bytes(const std::vector<uint8_t>& bytes, T& out) {
 // after the fields this build knows. Use for RPC frames; from_bytes stays
 // strict for contexts where trailing garbage means corruption.
 template <typename T>
-bool from_bytes_lax(const std::vector<uint8_t>& bytes, T& out) {
+BTPU_NODISCARD bool from_bytes_lax(const std::vector<uint8_t>& bytes, T& out) {
   Reader r(bytes);
   return decode(r, out);
 }

@@ -140,7 +140,8 @@ class MemCoordinator : public Coordinator {
   // Journal + replication sink, every mutation goes through here.
   void log_locked(const std::vector<uint8_t>& record) BTPU_REQUIRES(mutex_);
   std::vector<uint8_t> snapshot_bytes_locked() const BTPU_REQUIRES(mutex_);
-  bool decode_snapshot_locked(const std::vector<uint8_t>& bytes) BTPU_REQUIRES(mutex_);
+  BTPU_NODISCARD bool decode_snapshot_locked(const std::vector<uint8_t>& bytes)
+      BTPU_REQUIRES(mutex_);
   // Applies one WAL-encoded record: shared by crash recovery (no journal fd
   // open yet, no watches registered) and live follower mirroring (journals
   // and notifies). Returns false on a malformed record.

@@ -49,9 +49,16 @@ enum class ObjectState : uint8_t { kPending = 0, kComplete = 1 };
 // Registry advertisement codecs (coordinator store values; also used by the
 // worker service when advertising itself).
 std::string encode_worker_info(const WorkerInfo& info);
-bool decode_worker_info(const std::string& bytes, WorkerInfo& out);
+BTPU_NODISCARD bool decode_worker_info(const std::string& bytes, WorkerInfo& out);
 std::string encode_pool_record(const MemoryPool& pool);
-bool decode_pool_record(const std::string& bytes, MemoryPool& out);
+BTPU_NODISCARD bool decode_pool_record(const std::string& bytes, MemoryPool& out);
+
+// Hostile-input probe for the WAL/persist object-record decoder (all
+// historical layouts + the envelope dispatch): decodes `bytes` and discards
+// the result. Exists so the fuzz harnesses and the corpus-replay regression
+// test can drive the exact decoder a keystone restart runs, without
+// constructing a KeystoneService. Returns decode_object_record's verdict.
+BTPU_NODISCARD bool probe_object_record(const std::string& bytes);
 
 // Relaxed-atomic steady_clock stamp: get_workers touches last_access on
 // every read, and making that touch atomic is what lets reads hold the

@@ -41,6 +41,17 @@ class Socket {
   int fd_{-1};
 };
 
+// Scope guard for server serve loops: on ANY exit (clean EOF, protocol
+// violation, send failure) shut the socket down so the peer sees EOF at
+// once instead of hanging on a half-dead connection — a poisoned-stream
+// drop must be observable. The fd itself stays owned by the server's
+// connection registry until stop(): closing here would race stop()'s
+// shutdown() against a reused descriptor.
+struct SocketShutdownGuard {
+  Socket& s;
+  ~SocketShutdownGuard() { s.shutdown(); }
+};
+
 struct HostPort {
   std::string host;
   uint16_t port{0};

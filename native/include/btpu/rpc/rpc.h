@@ -23,6 +23,7 @@
 #include <vector>
 
 #include "btpu/common/error.h"
+#include "btpu/common/wire.h"
 
 namespace btpu::rpc {
 
@@ -86,14 +87,16 @@ inline void append_deadline_trailer(std::vector<uint8_t>& payload, uint32_t budg
 // Strips a trailing deadline trailer when present. Returns true and the
 // budget (which may legitimately be 0 = expired-on-arrival) iff the magic
 // matched; payload is truncated to the bare request bytes either way a
-// trailer was found.
-inline bool strip_deadline_trailer(std::vector<uint8_t>& payload, uint32_t& budget_ms) {
+// trailer was found. A payload shorter than the trailer simply has no
+// trailer — that is version skew (pre-v4 peer), not corruption.
+BTPU_NODISCARD inline bool strip_deadline_trailer(std::vector<uint8_t>& payload,
+                                                  uint32_t& budget_ms) {
   if (payload.size() < kDeadlineTrailerBytes) return false;
   const size_t at = payload.size() - kDeadlineTrailerBytes;
+  wire::WireReader r(payload.data() + at, kDeadlineTrailerBytes);
   uint64_t magic = 0;
-  std::memcpy(&magic, payload.data() + at, sizeof(magic));
-  if (magic != kDeadlineTrailerMagic) return false;
-  std::memcpy(&budget_ms, payload.data() + at + sizeof(magic), sizeof(budget_ms));
+  if (!r.u64(magic) || magic != kDeadlineTrailerMagic) return false;
+  if (!r.u32(budget_ms)) return false;
   payload.resize(at);
   return true;
 }
@@ -108,6 +111,12 @@ inline bool strip_deadline_trailer(std::vector<uint8_t>& payload, uint32_t& budg
 // which under overload it is.
 inline constexpr uint8_t kControlErrorOpcode = 0xEE;
 
+// The backoff hint is advice from an UNTRUSTED peer: clients sleep on it, so
+// an unclamped hint is a one-frame denial of service (hint_ms = 2^32-1
+// would park a caller for ~49 days). Anything above this ceiling decodes
+// clamped; servers never legitimately hint more than a few seconds.
+inline constexpr uint32_t kMaxBackoffHintMs = 60'000;
+
 inline std::vector<uint8_t> encode_control_error(ErrorCode code, uint32_t hint_ms) {
   std::vector<uint8_t> out(8);
   const uint32_t raw = static_cast<uint32_t>(code);
@@ -116,13 +125,18 @@ inline std::vector<uint8_t> encode_control_error(ErrorCode code, uint32_t hint_m
   return out;
 }
 
-inline bool decode_control_error(const std::vector<uint8_t>& payload, ErrorCode& code,
-                                 uint32_t& hint_ms) {
-  if (payload.size() < 8) return false;
+// Tail-tolerant on purpose (the append-only rule lets a newer server grow
+// this frame), but strict about the error code: only the three pre-dispatch
+// rejection codes may ride a control-error frame — anything else is a
+// corrupt or forged frame and the caller treats the RPC as failed.
+BTPU_NODISCARD inline bool decode_control_error(const std::vector<uint8_t>& payload,
+                                                ErrorCode& code, uint32_t& hint_ms) {
+  wire::WireReader r(payload.data(), payload.size());
   uint32_t raw = 0;
-  std::memcpy(&raw, payload.data(), sizeof(raw));
-  std::memcpy(&hint_ms, payload.data() + 4, sizeof(hint_ms));
+  uint32_t hint = 0;
+  if (!r.u32(raw) || !r.u32(hint)) return false;
   code = static_cast<ErrorCode>(raw);
+  hint_ms = hint > kMaxBackoffHintMs ? kMaxBackoffHintMs : hint;
   return code == ErrorCode::RETRY_LATER || code == ErrorCode::DEADLINE_EXCEEDED ||
          code == ErrorCode::RESOURCE_EXHAUSTED;
 }

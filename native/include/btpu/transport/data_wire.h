@@ -1,0 +1,146 @@
+// Raw TCP data-plane wire layer: packed request headers, their frozen
+// layout, and the CHECKED decoders the server parses them with.
+//
+// Unlike the RPC plane (length-prefixed frames, self-describing structs),
+// the data plane is a prefix-less stream of fixed headers — this is the hot
+// path, and a generic codec would cost a length word and a dispatch per
+// chunk. The price of that rawness is that the decoder is the ONLY line of
+// defense against hostile bytes: every header read off a socket goes
+// through decode_request_header/decode_staged_frame below, which
+// bounds-check via wire::WireReader and sanity-cap every length field
+// before any byte of it is believed. A header that fails to decode is a
+// protocol violation and the server drops the connection — with no frame
+// boundaries there is no way to resynchronize a poisoned stream.
+//
+// This header exists (rather than the structs living in tcp_transport.cpp)
+// so the fuzz harnesses and the corpus-replay regression test drive the
+// exact decoders production runs, not a copy.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "btpu/common/wire.h"
+#include "btpu/common/wire_layout_check.h"
+
+namespace btpu::transport::datawire {
+
+// Wire format (fixed headers, no generic framing):
+//   request:  u8 op (1=read, 2=write), u64 addr, u64 rkey, u64 len,
+//             u32 deadline_ms  [+ len payload bytes for write]
+//   response: u32 status                        (write)
+//             u32 status [+ len payload bytes]  (read, len from request)
+// Staged lane (same-host): payload bytes ride a client-created shm segment,
+// only headers cross the socket. kOpHello names the segment (len = name
+// length, name bytes follow); kOpReadStaged/kOpWriteStaged carry a trailing
+// u64 segment offset instead of streaming the payload. Device-fabric
+// commands: kOpFabricOffer stages a range for one cross-process pull under
+// a trailing u64 transfer id; kOpFabricPull (u64 id + u16 addr_len + remote
+// fabric address) fetches an offered range over the device fabric.
+inline constexpr uint8_t kOpRead = 1;
+inline constexpr uint8_t kOpWrite = 2;
+inline constexpr uint8_t kOpHello = 3;
+inline constexpr uint8_t kOpReadStaged = 4;
+inline constexpr uint8_t kOpWriteStaged = 5;
+inline constexpr uint8_t kOpFabricOffer = 6;
+inline constexpr uint8_t kOpFabricPull = 7;
+
+#pragma pack(push, 1)
+struct DataRequestHeader {
+  uint8_t op;
+  uint64_t addr;
+  uint64_t rkey;
+  uint64_t len;
+  // Remaining end-to-end budget in ms (0 = no deadline), appended at the
+  // TAIL per the append-only rule. The server restarts the clock at header
+  // receipt (relative budget = skew-free) and refuses/aborts work whose
+  // budget is spent instead of serving answers nobody is waiting for.
+  uint32_t deadline_ms;
+};
+
+// A staged request with its trailing segment offset, as it crosses the wire.
+struct StagedFrame {
+  DataRequestHeader h;
+  uint64_t shm_off;
+};
+#pragma pack(pop)
+
+// These headers cross the socket as raw bytes: freeze every offset, not
+// just the total, so an inserted field cannot shift the tail silently.
+// deadline_ms was APPENDED in the deadline-propagation change — both sides
+// of the data plane ship together (no length prefix tolerates a tail here),
+// so the frozen size moved 25 -> 29 in the same commit as every peer.
+BTPU_WIRE_RAW_TYPE(DataRequestHeader);
+BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 29);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, op, 0);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, addr, 1);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, rkey, 9);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, len, 17);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, deadline_ms, 25);
+BTPU_WIRE_RAW_TYPE(StagedFrame);
+BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 37);
+BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 29);
+
+// ---- hostile-input ceilings ------------------------------------------------
+// A single data op moves at most this many payload bytes. Real ops are
+// bounded far below (shards of striped objects, 256 KiB staged chunks); the
+// ceiling only has to reject nonsense — a forged len of 2^63 would
+// otherwise drive a multi-exabyte drain loop or a scratch resize into
+// bad_alloc. Raise it the day a single shard legitimately exceeds 16 GiB.
+inline constexpr uint64_t kMaxDataOpBytes = 1ull << 34;
+// kOpHello's len field is the shm segment NAME length, not a payload size.
+inline constexpr uint64_t kMaxHelloNameBytes = 255;
+// kOpFabricPull's trailing fabric address (u16 length on the wire).
+inline constexpr uint16_t kMaxFabricAddrBytes = 255;
+
+BTPU_NODISCARD inline constexpr bool valid_op(uint8_t op) noexcept {
+  return op >= kOpRead && op <= kOpFabricPull;
+}
+
+// Parses + validates one request header out of `size` raw bytes. False
+// means the bytes are not a well-formed header (short buffer, unknown op,
+// or a length past its ceiling) — the caller must treat the stream as
+// poisoned. Never reads past `size`, never believes an unvalidated length.
+BTPU_NODISCARD inline bool decode_request_header(const void* data, size_t size,
+                                                 DataRequestHeader& out) {
+  wire::WireReader r(data, size);
+  uint8_t op = 0;
+  uint64_t addr = 0, rkey = 0, len = 0;
+  uint32_t deadline_ms = 0;
+  if (!r.u8(op) || !r.u64(addr) || !r.u64(rkey) || !r.u64(len) || !r.u32(deadline_ms))
+    return false;
+  if (!valid_op(op)) return false;
+  if (op == kOpHello) {
+    if (len == 0 || len > kMaxHelloNameBytes) return false;
+  } else if (len > kMaxDataOpBytes) {
+    return false;
+  }
+  out.op = op;
+  out.addr = addr;
+  out.rkey = rkey;
+  out.len = len;
+  out.deadline_ms = deadline_ms;
+  return true;
+}
+
+// Staged frame = request header (must be a staged op) + u64 segment offset.
+BTPU_NODISCARD inline bool decode_staged_frame(const void* data, size_t size,
+                                               StagedFrame& out) {
+  wire::WireReader r(data, size);
+  const uint8_t* hdr = nullptr;
+  if (!r.view(hdr, sizeof(DataRequestHeader))) return false;
+  if (!decode_request_header(hdr, sizeof(DataRequestHeader), out.h)) return false;
+  if (out.h.op != kOpReadStaged && out.h.op != kOpWriteStaged) return false;
+  // Through a local: binding a uint64_t& to the packed member is misaligned
+  // UB (ubsan-caught when this read went straight into out.shm_off).
+  uint64_t shm_off = 0;
+  if (!r.u64(shm_off)) return false;
+  out.shm_off = shm_off;
+  return true;
+}
+
+BTPU_NODISCARD inline constexpr bool valid_fabric_addr_len(uint16_t alen) noexcept {
+  return alen > 0 && alen <= kMaxFabricAddrBytes;
+}
+
+}  // namespace btpu::transport::datawire

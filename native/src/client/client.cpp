@@ -50,8 +50,7 @@ std::string random_slot_tag() {
 // flip these without a code change).
 void apply_robustness_env(ClientOptions& options) {
   options.op_deadline_ms = env_u32("BTPU_OP_DEADLINE_MS", options.op_deadline_ms);
-  if (const char* v = std::getenv("BTPU_HEDGE_READS"); v && v[0])
-    options.hedge_reads = v[0] != '0';
+  options.hedge_reads = env_bool("BTPU_HEDGE_READS", options.hedge_reads);
   options.inline_refusal_backoff_ms =
       env_u32("BTPU_INLINE_RETRY_MS", options.inline_refusal_backoff_ms);
 }
@@ -128,7 +127,7 @@ void ObjectClient::rotate_keystone(const std::shared_ptr<rpc::KeystoneRpcClient>
     rpc_ = fresh;
   }
   LOG_WARN << "keystone failover: switching to " << address;
-  fresh->connect();  // best-effort pre-dial; calls reconnect lazily anyway
+  (void)fresh->connect();  // best-effort pre-dial; calls reconnect lazily anyway
 }
 
 Result<bool> ObjectClient::object_exists(const ObjectKey& key) {
@@ -240,7 +239,7 @@ void ObjectClient::setup_cache() {
 }
 
 void ObjectClient::teardown_cache_watch() {
-  if (inval_coord_ && inval_watch_ >= 0) inval_coord_->unwatch(inval_watch_);
+  if (inval_coord_ && inval_watch_ >= 0) warn_if_error(inval_coord_->unwatch(inval_watch_), "cache-inval unwatch");
   inval_watch_ = -1;
   inval_coord_.reset();
 }
@@ -726,7 +725,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
       ops[i] = {};  // len 0: skipped by the batch
     }
   }
-  data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  (void)data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);  // per-op status consumed below; CRC gate backstops
   // Shard i's current bytes (user buffer or padded temp).
   auto shard_bytes = [&](size_t i) -> const uint8_t* {
     return temps[i].empty() ? data + i * L : temps[i].data();
@@ -770,7 +769,7 @@ ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* dat
         pops[j] = {};
       }
     }
-    data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);
+    (void)data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);  // per-op status consumed below; CRC gate backstops
     for (size_t j = 0; j < m; ++j)
       have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK &&
                     !shard_corrupt(k + j, parity[j].data());
@@ -1290,7 +1289,7 @@ Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
     findings.push_back(std::move(f));
     expected.resize(findings.size(), 0);
   }
-  if (!ops.empty()) data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+  if (!ops.empty()) (void)data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);  // per-op status consumed below
   for (size_t j = 0; j < ops.size(); ++j) {
     auto& f = findings[op_finding[j]];
     if (ops[j].status != ErrorCode::OK) {
@@ -1541,9 +1540,9 @@ void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bo
     op_job.push_back(j);
   }
   if (is_write) {
-    client.write_batch(ops.data(), ops.size(), max_concurrency);
+    (void)client.write_batch(ops.data(), ops.size(), max_concurrency);  // per-op status folded into item_errors below
   } else {
-    client.read_batch(ops.data(), ops.size(), max_concurrency);
+    (void)client.read_batch(ops.data(), ops.size(), max_concurrency);  // per-op status folded into item_errors below
   }
   for (size_t j = 0; j < ops.size(); ++j) {
     if (ops[j].status != ErrorCode::OK && item_errors[op_item[j]] == ErrorCode::OK)
@@ -1617,7 +1616,7 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
       // upgrade would complete unstamped and verified reads would silently
       // skip the CRC gate. One ping learns the version (and a v1 server
       // that cannot answer it stays at 0 = conservative up-front hashing).
-      if (c.server_proto_version() == 0) c.ping();
+      if (c.server_proto_version() == 0) (void)c.ping();  // best-effort probe; 0 keeps conservative stamping
       if (c.server_proto_version() < rpc::kProtoContentCrcAtComplete) {
         for (size_t i = 0; i < starts.size(); ++i) {
           if (starts[i].content_crc == 0)
@@ -1736,8 +1735,8 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
     if (embedded_) {
       embedded_->batch_put_cancel(cancels);
     } else {
-      rpc_failover(/*idempotent=*/false,
-                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(cancels); });
+      (void)rpc_failover(/*idempotent=*/false,
+                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(cancels); });  // best-effort cancel; slot TTL reclaims
     }
   }
   return results;
@@ -1835,8 +1834,8 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
   if (!expired.empty()) {
     // Best-effort release of the stale reservations (the TTL reclaims them
     // regardless); outside the pool lock, one batch RPC.
-    rpc_failover(/*idempotent=*/false,
-                 [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(expired); });
+    (void)rpc_failover(/*idempotent=*/false,
+                 [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(expired); });  // best-effort cancel; slot TTL reclaims
   }
   if (slot.slot_key.empty()) {
     // First put of this class pays the same two RTTs as the normal path,
@@ -1902,8 +1901,8 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
     // healthy workers, preserving the pre-slot availability story.
     LOG_WARN << "put " << key << " slot transfer failed (" << to_string(item_errors[0])
              << "), cancelling slot and falling back";
-    rpc_failover(/*idempotent=*/false,
-                 [&](rpc::KeystoneRpcClient& c) { return c.put_cancel(slot.slot_key); });
+    (void)rpc_failover(/*idempotent=*/false,
+                 [&](rpc::KeystoneRpcClient& c) { return c.put_cancel(slot.slot_key); });  // best-effort cancel; slot TTL reclaims
     return std::nullopt;
   }
 
@@ -1942,8 +1941,8 @@ std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const 
       }
     }
     if (!overflow.empty()) {
-      rpc_failover(/*idempotent=*/false,
-                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(overflow); });
+      (void)rpc_failover(/*idempotent=*/false,
+                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(overflow); });  // best-effort cancel; slot TTL reclaims
     }
     return ErrorCode::OK;
   }
@@ -1975,7 +1974,7 @@ void ObjectClient::cancel_pooled_slots() {
   std::shared_ptr<rpc::KeystoneRpcClient> rpc;
   if (!embedded_) rpc = rpc_snapshot();
   if (keys.empty() || !rpc || !rpc->connected()) return;
-  rpc->batch_put_cancel(keys);
+  (void)rpc->batch_put_cancel(keys);  // best-effort cancel; slot TTL reclaims
 }
 
 std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,

@@ -51,8 +51,8 @@ Result<std::unique_ptr<worker::WorkerService>> EmbeddedCluster::start_worker_ins
   BTPU_RETURN_IF_ERROR(worker->start());
   if (!coordinator_) {
     // Direct feed: no coordination service in the loop.
-    keystone_->register_worker(worker->info());
-    for (const auto& pool : worker->pools()) keystone_->register_memory_pool(pool);
+    warn_if_error(keystone_->register_worker(worker->info()), "embedded worker registration");
+    for (const auto& pool : worker->pools()) warn_if_error(keystone_->register_memory_pool(pool), "embedded pool registration");
   }
   return worker;
 }
@@ -99,7 +99,7 @@ void EmbeddedCluster::kill_worker(size_t i) {
   // keystone death path TTL expiry would (cleanup + repair fire before the
   // surviving workers' regions go anywhere).
   workers_[i].reset();
-  if (!coordinator_) keystone_->remove_worker(id);
+  if (!coordinator_) warn_if_error(keystone_->remove_worker(id), "embedded worker deregistration");
 }
 
 ErrorCode EmbeddedCluster::revive_worker(size_t i) {

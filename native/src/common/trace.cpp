@@ -1,3 +1,4 @@
+#include "btpu/common/env.h"
 #include "btpu/common/trace.h"
 
 #include "btpu/common/thread_annotations.h"
@@ -40,7 +41,7 @@ struct Registry {
   FILE* jsonl BTPU_GUARDED_BY(mutex){nullptr};
 
   Registry() {
-    if (const char* path = std::getenv("BTPU_TRACE")) {
+    if (const char* path = env_str("BTPU_TRACE")) {
       jsonl = std::fopen(path, "a");
     }
   }

@@ -152,7 +152,7 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
   {
     Writer w;
     w.put(ErrorCode::OK);
-    net::send_frame(fd, opcode, w.buffer().data(), w.size());
+    (void)net::send_frame(fd, opcode, w.buffer().data(), w.size());  // peer gone; serve loop exits on next recv
   }
   if (is_mirror_channel) {
     serve_mirror(sock);
@@ -309,7 +309,7 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
         // would be delivered twice.
         auto existing = watches.find(client_watch_id);
         if (existing != watches.end()) {
-          store_.unwatch(existing->second);
+          warn_if_error(store_.unwatch(existing->second), "replaced-watch unwatch");
           watches.erase(existing);
         }
         auto res = store_.watch_prefix(prefix, [channel, client_watch_id](const WatchEvent& ev) {
@@ -396,8 +396,8 @@ void CoordServer::serve_connection(std::shared_ptr<net::Socket> sock) {
     MutexLock lock(channel->mutex);
     channel->alive = false;
   }
-  for (const auto& [cid, sid] : watches) store_.unwatch(sid);
-  for (const auto& [election, candidate] : campaigns) store_.resign(election, candidate);
+  for (const auto& [cid, sid] : watches) warn_if_error(store_.unwatch(sid), "shutdown unwatch");
+  for (const auto& [election, candidate] : campaigns) warn_if_error(store_.resign(election, candidate), "shutdown resign");
 }
 
 void CoordServer::serve_mirror(std::shared_ptr<net::Socket> sock) {
@@ -562,8 +562,9 @@ void CoordFollower::run(net::Socket sock) {
       uint64_t seq = 0;
       std::vector<uint8_t> rec;
       if (!r.get(seq) || !wire::decode(r, rec)) break;
-      if (auto ec = server_.store().apply_replica_record(rec); ec != ErrorCode::OK)
+      if (auto ec = server_.store().apply_replica_record(rec); ec != ErrorCode::OK) {
         LOG_ERROR << "mirror record " << seq << " failed to apply: " << to_string(ec);
+      }
     }
     {
       MutexLock lock(sock_mutex_);

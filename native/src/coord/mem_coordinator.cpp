@@ -346,7 +346,7 @@ bool MemCoordinator::apply_record_locked(const uint8_t* bytes, size_t len,
     }
     case kRecDel: {
       if (!wire::decode(r, key)) return false;
-      del_locked(key, lock);  // NOT_FOUND is fine (already gone)
+      warn_if_error(del_locked(key, lock), "expired-lease delete", ErrorCode::COORD_KEY_NOT_FOUND);  // NOT_FOUND is fine (already gone)
       return true;
     }
     case kRecGrant: {
@@ -373,7 +373,7 @@ bool MemCoordinator::apply_record_locked(const uint8_t* bytes, size_t len,
       for (const auto& k : keys) {
         auto entry = data_.find(k);
         if (entry == data_.end() || entry->second.lease != id) continue;
-        del_locked(k, lock);
+        warn_if_error(del_locked(k, lock), "expired-lease delete", ErrorCode::COORD_KEY_NOT_FOUND);
       }
       return true;
     }
@@ -405,8 +405,9 @@ void MemCoordinator::journal_load() {
     if (in) {
       std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
                                  std::istreambuf_iterator<char>());
-      if (!bytes.empty() && !decode_snapshot_locked(bytes))
+      if (!bytes.empty() && !decode_snapshot_locked(bytes)) {
         LOG_ERROR << "coordinator snapshot truncated/unreadable; continuing with partial state";
+      }
     }
   }
 
@@ -496,7 +497,7 @@ void MemCoordinator::expiry_loop() {
         auto entry = data_.find(key);
         if (entry == data_.end() || entry->second.lease != id) continue;
         // del_locked unlocks while firing watch callbacks.
-        del_locked(key, lock);
+        warn_if_error(del_locked(key, lock), "expired-ttl delete", ErrorCode::COORD_KEY_NOT_FOUND);
       }
       // A leader whose lease expired loses the election.
       for (auto& [election, e] : elections_) {
@@ -626,7 +627,7 @@ ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
   for (const auto& key : keys) {
     auto entry = data_.find(key);
     if (entry == data_.end() || entry->second.lease != lease) continue;
-    del_locked(key, lock);
+    warn_if_error(del_locked(key, lock), "expired-ttl delete", ErrorCode::COORD_KEY_NOT_FOUND);
   }
   for (auto& [election, e] : elections_) {
     auto dead = std::find_if(e.candidates.begin(), e.candidates.end(),

@@ -1,5 +1,6 @@
 #include "btpu/coord/remote_coordinator.h"
 
+#include "btpu/common/env.h"
 #include "btpu/common/deadline.h"
 #include "btpu/common/log.h"
 #include "btpu/common/wire.h"
@@ -48,8 +49,8 @@ RemoteCoordinator::RemoteCoordinator(std::string endpoint) {
     start = comma + 1;
   }
   if (endpoints_.empty()) endpoints_.push_back("");
-  if (const char* v = std::getenv("BTPU_COORD_RESPONSE_TIMEOUT_MS"); v && v[0])
-    set_response_timeout_ms(static_cast<uint32_t>(std::strtoul(v, nullptr, 10)));
+  if (const uint32_t v = env_u32("BTPU_COORD_RESPONSE_TIMEOUT_MS", 0); v != 0)
+    set_response_timeout_ms(v);
 }
 
 RemoteCoordinator::~RemoteCoordinator() { disconnect(); }
@@ -105,13 +106,15 @@ ErrorCode RemoteCoordinator::connect_locked() {
     for (const auto& [key, meta] : campaigns_) campaigns.push_back(meta);
   }
   for (const auto& [id, prefix] : watches) {
-    if (auto ec = send_watch(id, prefix); ec != ErrorCode::OK)
+    if (auto ec = send_watch(id, prefix); ec != ErrorCode::OK) {
       LOG_WARN << "watch replay failed for prefix " << prefix << ": " << to_string(ec);
+    }
   }
   for (const auto& [election, candidate, ttl] : campaigns) {
-    if (auto ec = send_campaign(election, candidate, ttl); ec != ErrorCode::OK)
+    if (auto ec = send_campaign(election, candidate, ttl); ec != ErrorCode::OK) {
       LOG_WARN << "campaign replay failed for " << election << "/" << candidate << ": "
                << to_string(ec);
+    }
   }
   return ErrorCode::OK;
 }

@@ -1,3 +1,4 @@
+#include "btpu/common/env.h"
 #include "btpu/keystone/keystone.h"
 
 #include <algorithm>
@@ -25,7 +26,7 @@ namespace {
 size_t resolve_shard_count(uint32_t configured) {
   uint64_t n = configured;
   if (n == 0) {
-    if (const char* env = std::getenv("BTPU_KEYSTONE_SHARDS")) {
+    if (const char* env = env_str("BTPU_KEYSTONE_SHARDS")) {
       char* end = nullptr;
       const unsigned long long v = std::strtoull(env, &end, 10);
       if (end && *end == '\0' && *env != '\0') {
@@ -170,14 +171,14 @@ void KeystoneService::load_existing_state() {
   if (workers.ok()) {
     for (const auto& kv : workers.value()) {
       WorkerInfo info;
-      if (decode_worker_info(kv.value, info)) register_worker(info);
+      if (decode_worker_info(kv.value, info)) warn_if_error(register_worker(info), "boot worker registration");
     }
   }
   auto pools = coordinator_->get_with_prefix(coord::pools_prefix(config_.cluster_id));
   if (pools.ok()) {
     for (const auto& kv : pools.value()) {
       MemoryPool pool;
-      if (decode_pool_record(kv.value, pool)) register_memory_pool(pool);
+      if (decode_pool_record(kv.value, pool)) warn_if_error(register_memory_pool(pool), "boot pool registration");
     }
   }
   LOG_INFO << "replayed " << (workers.ok() ? workers.value().size() : 0) << " workers, "
@@ -236,7 +237,7 @@ bool KeystoneService::on_promoted() {
         break;
       case ApplyResult::kGarbage:
         drop_object_locally(key);
-        coordinator_->del(kv.key);
+        warn_if_error(coordinator_->del(kv.key), "garbage record purge", ErrorCode::COORD_KEY_NOT_FOUND);
         break;
       case ApplyResult::kFailed:
         // Do not serve placements we could not adopt, but KEEP the durable
@@ -269,7 +270,7 @@ void KeystoneService::on_demoted() {
     for (auto it = s.map.begin(); it != s.map.end();) {
       if (it->second.state == ObjectState::kPending) {
         if (it->second.slot) slot_objects_.fetch_sub(1);
-        adapter_.free_object(it->first);
+        warn_if_error(adapter_.free_object(it->first), "pending-object free on GC");
         it = s.map.erase(it);
         ++dropped;
       } else {
@@ -304,13 +305,13 @@ void KeystoneService::stop() {
   // keystone holds watches and (under HA) possibly the leadership whether or
   // not start() ever ran, and both must be released exactly once.
   if (coordinator_ && !watch_ids_.empty()) {
-    for (auto id : watch_ids_) coordinator_->unwatch(id);
+    for (auto id : watch_ids_) warn_if_error(coordinator_->unwatch(id), "shutdown unwatch");
     watch_ids_.clear();
     if (config_.enable_ha) {
-      coordinator_->resign(election_name(), service_id_);
+      warn_if_error(coordinator_->resign(election_name(), service_id_), "shutdown resign");
       is_leader_ = false;
     }
-    coordinator_->unregister_service("btpu-keystone", service_id_);
+    warn_if_error(coordinator_->unregister_service("btpu-keystone", service_id_), "shutdown service unregister");
   }
 }
 
@@ -347,8 +348,8 @@ void KeystoneService::keepalive_loop() {
                       [this] { return !running_.load() || recampaign_asap_.load(); });
     if (!running_) break;
     lock.unlock();
-    coordinator_->register_service("btpu-keystone", service_id_, config_.listen_address,
-                                   config_.service_registration_ttl_sec * 1000);
+    warn_if_error(coordinator_->register_service("btpu-keystone", service_id_, config_.listen_address,
+                                   config_.service_registration_ttl_sec * 1000), "service registration refresh");
     if (config_.enable_ha) {
       recampaign_asap_ = false;
       // Deferred demotion cleanup from fence_stepdown (see the flag's
@@ -360,14 +361,14 @@ void KeystoneService::keepalive_loop() {
         // false: step out and rejoin at the back of the queue. Retried
         // every tick until it sticks — dropping out of the election
         // silently would leave the pair leaderless at the next failure.
-        coordinator_->resign(election_name(), service_id_);
+        warn_if_error(coordinator_->resign(election_name(), service_id_), "stale-candidacy resign");
         const ErrorCode ec = start_campaign();
         if (ec != ErrorCode::OK) {
           // CLIENT_ALREADY_EXISTS means a stale server-side candidacy whose
           // leader callback was already torn down client-side — resign so
           // the retry re-registers a candidacy that can actually notify us.
           if (ec == ErrorCode::CLIENT_ALREADY_EXISTS)
-            coordinator_->resign(election_name(), service_id_);
+            warn_if_error(coordinator_->resign(election_name(), service_id_), "resign before re-campaign");
           LOG_ERROR << "re-campaign failed: " << to_string(ec) << "; will retry";
           needs_recampaign_ = true;  // next tick; no asap -> no busy spin
         }
@@ -433,7 +434,7 @@ void KeystoneService::run_gc_once() {
     // the promoted leader's record still references; retry next GC pass.
     if (unpersist_object(key) != ErrorCode::OK) continue;
     if (it->second.slot) slot_objects_.fetch_sub(1);
-    free_object_locked(s, key, it->second);
+    warn_if_error(free_object_locked(s, key, it->second), "evicted-object range free");
     s.map.erase(it);
     if (stale_pending) {
       ++counters_.pending_reclaimed;
@@ -551,8 +552,8 @@ void KeystoneService::publish_cache_invalidation(const ObjectKey& key, uint64_t 
   if (!coordinator_ || config_.cache_lease_ms == 0) return;
   // Watchers act on the EVENT; the stored value only needs to outlive slow
   // delivery, so it is TTL'd and the topic self-cleans.
-  coordinator_->put_with_ttl(coord::cache_inval_key(config_.cluster_id, key),
-                             std::to_string(version), 30'000);
+  warn_if_error(coordinator_->put_with_ttl(coord::cache_inval_key(config_.cluster_id, key),
+                             std::to_string(version), 30'000), "cache-invalidation publish");
 }
 
 ErrorCode KeystoneService::normalize_put_config(WorkerConfig& effective) const {
@@ -664,7 +665,7 @@ ErrorCode KeystoneService::put_cancel(const ObjectKey& key) {
   // promoted leader still lists — its metadata would point at freed bytes.
   if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
   if (it->second.slot) slot_objects_.fetch_sub(1);
-  free_object_locked(s, key, it->second);
+  warn_if_error(free_object_locked(s, key, it->second), "removed-object range free");
   s.map.erase(it);
   ++counters_.put_cancels;
   bump_view();
@@ -869,7 +870,7 @@ ErrorCode KeystoneService::put_commit_slot(const ObjectKey& slot_key, const Obje
     if (adapter_.allocator().rename_object(key, slot_key) != ErrorCode::OK) {
       LOG_ERROR << "slot commit rollback: back-rename to " << slot_key
                 << " failed; freeing the allocation under " << key;
-      adapter_.free_object(key);
+      warn_if_error(adapter_.free_object(key), "slot rollback free");
       slot_objects_.fetch_sub(1);
       return ec;
     }
@@ -911,7 +912,7 @@ ErrorCode KeystoneService::remove_object(const ObjectKey& key) {
   // Same fence-first ordering as put_cancel (see comment there).
   if (auto ec = unpersist_object(key); ec != ErrorCode::OK) return ec;
   if (it->second.slot) slot_objects_.fetch_sub(1);
-  free_object_locked(s, key, it->second);
+  warn_if_error(free_object_locked(s, key, it->second), "removed-object range free");
   s.map.erase(it);
   ++counters_.removes;
   bump_view();
@@ -940,7 +941,7 @@ Result<uint64_t> KeystoneService::remove_all_objects() {
       }
       if (it->second.slot) slot_objects_.fetch_sub(1);
       removed.push_back(it->first);
-      free_object_locked(s, it->first, it->second);
+      warn_if_error(free_object_locked(s, it->first, it->second), "remove_all range free");
       it = s.map.erase(it);
       ++count;
     }
@@ -1115,7 +1116,7 @@ alloc::PoolMap KeystoneService::memory_pools() const {
 void KeystoneService::on_worker_event(const WatchEvent& ev) {
   if (ev.type == WatchEvent::Type::kPut) {
     WorkerInfo info;
-    if (decode_worker_info(ev.value, info)) register_worker(info);
+    if (decode_worker_info(ev.value, info)) warn_if_error(register_worker(info), "watch worker registration");
   }
   // Persistent-key DELETE means a clean unregister; the heartbeat watcher is
   // the authoritative death signal, so nothing else to do here.
@@ -1124,7 +1125,7 @@ void KeystoneService::on_worker_event(const WatchEvent& ev) {
 void KeystoneService::on_pool_event(const WatchEvent& ev) {
   if (ev.type == WatchEvent::Type::kPut) {
     MemoryPool pool;
-    if (decode_pool_record(ev.value, pool)) register_memory_pool(pool);
+    if (decode_pool_record(ev.value, pool)) warn_if_error(register_memory_pool(pool), "watch pool registration");
   }
 }
 

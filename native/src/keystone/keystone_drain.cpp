@@ -54,7 +54,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
         continue;
       }
       slot_objects_.fetch_sub(1);
-      free_object_locked(s, it->first, it->second);
+      warn_if_error(free_object_locked(s, it->first, it->second), "drained-object range free");
       it = s.map.erase(it);
       ++counters_.put_cancels;
     }
@@ -184,7 +184,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       // splice would leave the object unreadable (and clear the stamps the
       // scrub needs). A fragmented pool just defers this shard's move.
       if (coded && staged[0].shards.size() != 1) {
-        adapter_.free_object(staging_key);
+        warn_if_error(adapter_.free_object(staging_key), "drain staging free");
         continue;
       }
 
@@ -193,7 +193,7 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
       uint32_t host_crc = 0;
       if (stream_shard(m.shard, staged[0], all_pools, &used_unchecked, &host_crc) !=
           ErrorCode::OK) {
-        adapter_.free_object(staging_key);
+        warn_if_error(adapter_.free_object(staging_key), "drain staging free");
         continue;
       }
 
@@ -210,19 +210,19 @@ Result<uint64_t> KeystoneService::drain_worker(const NodeId& worker_id) {
           // would free a healthy live range. Mismatches retry via re-scan.
           !(it->second.copies[m.copy_index].shards[m.shard_index] == m.shard)) {
         lock.unlock();
-        adapter_.free_object(staging_key);
+        warn_if_error(adapter_.free_object(staging_key), "drain staging free");
         continue;  // object changed underneath the move; the re-scan retries
       }
       if (adapter_.allocator().merge_objects(staging_key, m.key) != ErrorCode::OK) {
         lock.unlock();
-        adapter_.free_object(staging_key);
+        warn_if_error(adapter_.free_object(staging_key), "drain staging free");
         continue;
       }
       // Release the evacuated shard's range and splice the replacement in
       // (the staged allocation may itself be several ranges).
       auto& shards = it->second.copies[m.copy_index].shards;
       if (auto pr = shard_to_range(shards[m.shard_index], memory_pools())) {
-        adapter_.allocator().release_range(m.key, pr->first, pr->second);
+        warn_if_error(adapter_.allocator().release_range(m.key, pr->first, pr->second), "evacuated shard range release");
       }
       // Shard CRCs: a 1:1 splice moves identical bytes, so the stamp at this
       // index stays valid untouched. A 1:n splice changes the shard layout —

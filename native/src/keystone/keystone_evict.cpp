@@ -122,7 +122,7 @@ void KeystoneService::evict_for_pressure() {
       if (it == s.map.end()) continue;
       // Fence-first (see gc): never free ranges a promoted leader still maps.
       if (unpersist_object(key) != ErrorCode::OK) continue;
-      free_object_locked(s, key, it->second);
+      warn_if_error(free_object_locked(s, key, it->second), "evicted-object range free");
       s.map.erase(it);
       ++counters_.evicted;
       bump_view();
@@ -251,7 +251,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
         LOG_WARN << "demotion of coded " << key
                  << " aborted: source failed crc verification (still "
                     "parity-recoverable in place)";
-        adapter_.free_object(staging_key);
+        warn_if_error(adapter_.free_object(staging_key), "demote staging free");
         return DemoteOutcome::kSkipped;
       }
     }
@@ -259,7 +259,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
       // A transiently unreadable shard (hung worker, death inside the
       // heartbeat TTL) or a staging-geometry surprise must NEVER funnel a
       // parity-recoverable object into the caller's delete fallback.
-      adapter_.free_object(staging_key);
+      warn_if_error(adapter_.free_object(staging_key), "demote staging free");
       return DemoteOutcome::kSkipped;
     }
   } else {
@@ -275,7 +275,7 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
     }
   }
   if (!moved) {
-    adapter_.free_object(staging_key);
+    warn_if_error(adapter_.free_object(staging_key), "demote staging free");
     return DemoteOutcome::kFailed;
   }
 
@@ -285,17 +285,17 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   auto it = s.map.find(key);
   if (it == s.map.end() || it->second.epoch != epoch_snap) {
     lock.unlock();
-    adapter_.free_object(staging_key);
+    warn_if_error(adapter_.free_object(staging_key), "demote staging free");
     return DemoteOutcome::kSkipped;
   }
-  adapter_.free_object(key);
+  warn_if_error(adapter_.free_object(key), "demoted-object allocation free");
   if (auto ec = adapter_.allocator().rename_object(staging_key, key); ec != ErrorCode::OK) {
     // Unreachable in practice (staging exists, key was just freed); treat the
     // object as lost rather than leave metadata pointing at freed ranges.
     LOG_ERROR << "demotion rename failed for " << key << ": " << to_string(ec);
-    adapter_.free_object(staging_key);
+    warn_if_error(adapter_.free_object(staging_key), "demote staging free");
     s.map.erase(it);
-    unpersist_object(key);
+    warn_if_error(unpersist_object(key), "evicted-object unpersist");
     ++counters_.objects_lost;
     bump_view();
     lock.unlock();

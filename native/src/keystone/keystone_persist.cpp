@@ -61,15 +61,18 @@ void put_record_envelope(wire::Writer& w) {
 }
 
 RecordEra take_record_envelope(wire::Reader& r) {
-  if (r.remaining() < 9) return RecordEra::kLegacy;
+  // Checked peeks (WireReader): a record shorter than the envelope cannot
+  // carry one, so it is legacy by definition — and the cursor must not move
+  // unless the envelope is OURS (legacy decoders re-read from offset 0,
+  // future records are returned untouched for safekeeping).
   uint64_t magic = 0;
-  std::memcpy(&magic, r.cursor(), sizeof(magic));
-  if (magic != kRecordMagic) return RecordEra::kLegacy;
   uint8_t format = 0;
-  std::memcpy(&format, r.cursor() + sizeof(magic), sizeof(format));
+  if (!r.peek_u64(magic) || !r.peek_u8_at(format, sizeof(magic))) return RecordEra::kLegacy;
+  if (magic != kRecordMagic) return RecordEra::kLegacy;
   // Append-only evolution never bumps the format byte, so != is "future".
   if (format != kRecordFormat) return RecordEra::kFuture;
-  r.skip(sizeof(magic) + sizeof(format));
+  // Both peeks succeeded, so the skip cannot fail; the (void) is the proof.
+  (void)r.skip(sizeof(magic) + sizeof(format));
   return RecordEra::kCurrent;
 }
 
@@ -187,10 +190,13 @@ bool pool_record(const std::string& bytes, MemoryPool& p) {
   if (!wire::decode_fields(r, p.id, p.node_id, p.base_addr, p.size, p.used, p.storage_class) ||
       !remote(r, p.remote) || !topo(r, p.topo))
     return false;
-  // `alignment` was a trailing optional field in the v1 layout.
+  // `alignment` was a trailing optional field in the v1 layout — and it was
+  // the LAST one ever (v1 is frozen history; later fields only exist in the
+  // enveloped format). Bytes past it are corruption, not version skew:
+  // reject instead of silently accepting a mangled record.
   p.alignment = 0;
   if (!r.exhausted() && !wire::decode(r, p.alignment)) return false;
-  return true;
+  return r.exhausted();
 }
 
 bool worker_record(const std::string& bytes, WorkerInfo& out) {
@@ -297,12 +303,20 @@ bool decode_object_record_generation(const std::string& bytes, ObjectRecord& out
   return wire::decode_fields(r, out.created_wall_ms, out.last_access_wall_ms);
 }
 
+// The state byte crosses a trust boundary (coordinator records survive
+// binaries and hosts): an out-of-range value would otherwise be
+// static_cast into ObjectState and flow into every state comparison.
+bool valid_object_state(uint8_t state) {
+  return state <= static_cast<uint8_t>(ObjectState::kComplete);
+}
+
 bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
   wire::Reader r(reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size());
   switch (take_record_envelope(r)) {
     case RecordEra::kCurrent:
       return wire::decode_fields(r, out.size, out.ttl_ms, out.soft_pin, out.state, out.config,
-                                 out.copies, out.created_wall_ms, out.last_access_wall_ms);
+                                 out.copies, out.created_wall_ms, out.last_access_wall_ms) &&
+             valid_object_state(out.state);
     case RecordEra::kFuture:
       return false;  // apply_object_record pre-screens this era; belt+braces
     case RecordEra::kLegacy:
@@ -310,12 +324,19 @@ bool decode_object_record(const std::string& bytes, ObjectRecord& out) {
   }
   // Newest envelope-less layout (content CRCs) first, then EC-era, then
   // pre-EC.
-  if (decode_object_record_generation(bytes, out, true, v1::copy)) return true;
-  if (decode_object_record_generation(bytes, out, true, v1::copy_ec_era)) return true;
-  return decode_object_record_generation(bytes, out, false, v1::copy_pre_ec);
+  if (!(decode_object_record_generation(bytes, out, true, v1::copy) ||
+        decode_object_record_generation(bytes, out, true, v1::copy_ec_era) ||
+        decode_object_record_generation(bytes, out, false, v1::copy_pre_ec)))
+    return false;
+  return valid_object_state(out.state);
 }
 
 }  // namespace
+
+bool probe_object_record(const std::string& bytes) {
+  ObjectRecord rec;
+  return decode_object_record(bytes, rec);
+}
 
 ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
   if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
@@ -467,7 +488,7 @@ void KeystoneService::load_persisted_objects() {
       case ApplyResult::kGarbage:
         // Undecodable records are purged; deleting garbage is idempotent and
         // safe from any keystone (leadership is not resolved yet at boot).
-        coordinator_->del(kv.key);
+        warn_if_error(coordinator_->del(kv.key), "garbage record purge", ErrorCode::COORD_KEY_NOT_FOUND);
         ++dropped;
         break;
       case ApplyResult::kFailed:
@@ -509,7 +530,7 @@ KeystoneService::ApplyResult KeystoneService::apply_object_record(
     // before adopting the new ones (records usually reuse most of them) —
     // free_object_locked also returns an inline object's budget.
     previous = std::move(it->second);
-    free_object_locked(s, key, *previous);
+    warn_if_error(free_object_locked(s, key, *previous), "replaced-object range free");
     s.map.erase(it);
   }
   // Inline records own no ranges: adopting an empty allocation would leave
@@ -563,7 +584,7 @@ void KeystoneService::drop_object_locally(const ObjectKey& key) {
   WriterLock lock(s.mutex);
   auto it = s.map.find(key);
   if (it == s.map.end()) return;
-  free_object_locked(s, key, it->second);
+  warn_if_error(free_object_locked(s, key, it->second), "dropped-object range free");
   s.map.erase(it);
   bump_view();
 }

@@ -71,10 +71,10 @@ void KeystoneService::cleanup_dead_worker(const NodeId& worker_id) {
   // heartbeat prefix); coordinator-state deletion and repair are the
   // leader's job — a standby mutating either would race the leader.
   if (coordinator_ && is_leader_.load()) {
-    coord_del_record(coord::worker_key(config_.cluster_id, worker_id));
+    warn_if_error(coord_del_record(coord::worker_key(config_.cluster_id, worker_id)), "dead-worker record delete", ErrorCode::COORD_KEY_NOT_FOUND);
     for (const auto& pool_id : dead_pools)
-      coord_del_record(coord::pool_key(config_.cluster_id, worker_id, pool_id));
-    coord_del_record(coord::heartbeat_key(config_.cluster_id, worker_id));
+      warn_if_error(coord_del_record(coord::pool_key(config_.cluster_id, worker_id, pool_id)), "dead-worker record delete", ErrorCode::COORD_KEY_NOT_FOUND);
+    warn_if_error(coord_del_record(coord::heartbeat_key(config_.cluster_id, worker_id)), "dead-worker record delete", ErrorCode::COORD_KEY_NOT_FOUND);
   }
   bump_view();
   LOG_WARN << "worker " << worker_id << " removed (" << dead_pools.size() << " pools)";
@@ -173,7 +173,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           }
         }
         slot_objects_.fetch_sub(1);
-        free_object_locked(s, key, info);
+        warn_if_error(free_object_locked(s, key, info), "lost-object range free");
         it = s.map.erase(it);
         ++counters_.put_cancels;
         bump_view();
@@ -233,7 +233,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
             continue;
           }
           drop_dead_worker_bookkeeping();
-          adapter_.free_object(key);
+          warn_if_error(adapter_.free_object(key), "unplaceable-object free");
           it = s.map.erase(it);
           ++counters_.objects_lost;
           bump_view();
@@ -331,7 +331,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
               adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
           }
         }
-        adapter_.free_object(key);
+        warn_if_error(adapter_.free_object(key), "repair rollback free");
         it = s.map.erase(it);
         ++counters_.objects_lost;
         bump_view();
@@ -362,7 +362,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
           if (shard.worker_id == worker_id) {
             adapter_.allocator().remove_pool_ranges(key, shard.pool_id);
           } else if (auto pr = shard_to_range(shard, live_pools)) {
-            adapter_.allocator().release_range(key, pr->first, pr->second);
+            warn_if_error(adapter_.allocator().release_range(key, pr->first, pr->second), "repaired shard range release");
           }
         }
       }
@@ -430,7 +430,7 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
       }
     }
     if (!streamed_src) {
-      adapter_.free_object(staging_key);
+      warn_if_error(adapter_.free_object(staging_key), "repair staging free");
       deferred = true;  // survivors still serve reads; health loop retries
       continue;
     }
@@ -440,13 +440,13 @@ size_t KeystoneService::repair_objects_for_dead_worker(const NodeId& worker_id) 
     auto it = s.map.find(p.key);
     if (it == s.map.end() || it->second.epoch != p.epoch) {
       lock.unlock();
-      adapter_.free_object(staging_key);
+      warn_if_error(adapter_.free_object(staging_key), "repair staging free");
       continue;  // object changed while the bytes moved; its new state wins
     }
     if (adapter_.allocator().merge_objects(staging_key, p.key) != ErrorCode::OK) {
       lock.unlock();
       LOG_ERROR << "repair merge failed for " << p.key;
-      adapter_.free_object(staging_key);
+      warn_if_error(adapter_.free_object(staging_key), "repair staging free");
       deferred = true;
       continue;
     }
@@ -547,7 +547,7 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
   };
   std::vector<Staged> staged;
   auto free_all_staged = [&] {
-    for (auto& st : staged) adapter_.free_object(st.staging_key);
+    for (auto& st : staged) warn_if_error(adapter_.free_object(st.staging_key), "repair staging free");
     staged.clear();
   };
   std::vector<uint32_t> rebuilt_crcs;
@@ -590,7 +590,7 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
       if (!attempt.ok() || attempt.value().copies[0].shards.size() != 1 ||
           std::holds_alternative<DeviceLocation>(
               attempt.value().copies[0].shards[0].location)) {
-        if (attempt.ok()) adapter_.free_object(staged[j].staging_key);
+        if (attempt.ok()) warn_if_error(adapter_.free_object(staged[j].staging_key), "repair staging free");
         staged.resize(j);
         staged_ok = false;
         LOG_WARN << "ec repair of " << key << " stays degraded: no placement for shard "
@@ -761,7 +761,7 @@ bool KeystoneService::repair_ec_object(const ObjectKey& key, uint64_t epoch,
     // or the pool leaks the space forever.
     if (std::find(original_dead.begin(), original_dead.end(), d) == original_dead.end()) {
       if (auto pr = shard_to_range(it->second.copies.front().shards[d], memory_pools())) {
-        adapter_.allocator().release_range(key, pr->first, pr->second);
+        warn_if_error(adapter_.allocator().release_range(key, pr->first, pr->second), "splice range release");
       }
     }
     // Entries are replaced in place, preserving the geometry order.

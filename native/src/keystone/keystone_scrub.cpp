@@ -381,7 +381,7 @@ void KeystoneService::readopt_offline_pool(const MemoryPool& pool) {
         LOG_ERROR << "re-adoption carve failed for " << key << " on pool " << pool.id
                   << "; dropping the object";
         if (unpersist_object(key) == ErrorCode::OK) {
-          free_object_locked(mshard, key, info);
+          warn_if_error(free_object_locked(mshard, key, info), "scrub-lost object free");
           it = mshard.map.erase(it);
           ++counters_.objects_lost;
         } else {
@@ -501,7 +501,7 @@ void KeystoneService::run_readopt_checks() {
       readopt_checks_.push_back(check);
       continue;
     }
-    free_object_locked(s, check.key, it->second);
+    warn_if_error(free_object_locked(s, check.key, it->second), "scrub-lost object free");
     s.map.erase(it);
     ++counters_.objects_lost;
     bump_view();

@@ -15,7 +15,9 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "btpu/common/env.h"
 #include "btpu/common/log.h"
+#include "btpu/common/wire.h"
 
 namespace btpu::net {
 
@@ -221,7 +223,7 @@ void set_bulk_buffers(int fd, int bytes) {
   // for 1 MiB gets on same-host paths, which is where the shm/tcp data
   // plane actually runs. BTPU_SOCK_BUFS=auto leaves both directions to
   // autotuning for WAN-ish deployments; =N pins both to N bytes.
-  static const char* mode = std::getenv("BTPU_SOCK_BUFS");
+  static const char* mode = env_str("BTPU_SOCK_BUFS");
   if (mode && std::strcmp(mode, "auto") == 0) return;
   if (mode) {
     int custom = std::atoi(mode);
@@ -248,9 +250,11 @@ ErrorCode send_frame(int fd, uint8_t opcode, const void* payload, size_t n) {
 ErrorCode recv_frame(int fd, uint8_t& opcode, std::vector<uint8_t>& payload) {
   uint8_t header[5];
   BTPU_RETURN_IF_ERROR(read_exact(fd, header, sizeof(header)));
+  // Checked parse of the frame header; the length is a hostile-controlled
+  // allocation size, so it must clear kMaxFrameBytes BEFORE resize().
+  wire::WireReader r(header, sizeof(header));
   uint32_t len = 0;
-  std::memcpy(&len, header, 4);
-  opcode = header[4];
+  if (!r.u32(len) || !r.u8(opcode)) return ErrorCode::NETWORK_ERROR;  // unreachable: 5 bytes
   if (len > kMaxFrameBytes) return ErrorCode::BUFFER_OVERFLOW;
   payload.resize(len);
   if (len > 0) BTPU_RETURN_IF_ERROR(read_exact(fd, payload.data(), len));

@@ -229,7 +229,7 @@ void MetricsHttpServer::accept_loop() {
          << "\r\nContent-Length: " << body.size() << "\r\nConnection: close\r\n\r\n"
          << body;
     const std::string text = resp.str();
-    net::write_all(conn.fd(), text.data(), text.size());
+    (void)net::write_all(conn.fd(), text.data(), text.size());  // best-effort response; connection closes either way
   }
 }
 

@@ -111,6 +111,7 @@ void KeystoneRpcServer::accept_loop() {
 
 void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
   const int fd = sock->fd();
+  net::SocketShutdownGuard shutdown_guard{*sock};
   uint8_t opcode = 0;
   std::vector<uint8_t> payload;
   while (running_) {

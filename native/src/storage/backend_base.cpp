@@ -13,7 +13,7 @@ ErrorCode OffsetBackendBase::init_allocator() {
   pool.node_id = config_.node_id;
   pool.size = config_.capacity;
   pool.storage_class = config_.storage_class;
-  pool.remote = {TransportKind::LOCAL, "backend:" + config_.pool_id, 0, ""};
+  pool.remote = {TransportKind::LOCAL, "backend:" + config_.pool_id, 0, "", "", "", 0};
   try {
     allocator_ = std::make_unique<alloc::PoolAllocator>(pool);
   } catch (const std::exception& e) {

@@ -50,6 +50,7 @@
 #include <unistd.h>
 #include <unordered_map>
 
+#include "btpu/common/env.h"
 #include "btpu/common/error.h"
 #include "btpu/common/log.h"
 #include "btpu/common/crc32c.h"
@@ -223,8 +224,7 @@ bool still_same_process(long pid, unsigned long long starttime) {
 // reads keep the no-syscall fast path.
 bool resolve(const std::string& ep, PvmTarget& out, bool for_write) {
   static const bool disabled = [] {
-    const char* env = std::getenv("BTPU_PVM");
-    return env && std::strcmp(env, "0") == 0;
+    return !env_bool("BTPU_PVM", true);
   }();
   if (disabled) return false;
   const auto now = std::chrono::steady_clock::now();

@@ -335,11 +335,11 @@ storage::StorageBackend* WorkerService::backend(const std::string& pool_id) {
 
 void WorkerService::advertise() {
   if (!coordinator_) return;
-  coordinator_->put(coord::worker_key(config_.cluster_id, config_.worker_id),
-                    keystone::encode_worker_info(info()));
+  warn_if_error(coordinator_->put(coord::worker_key(config_.cluster_id, config_.worker_id),
+                    keystone::encode_worker_info(info())), "worker advertise");
   for (const auto& p : pools_) {
-    coordinator_->put(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id),
-                      keystone::encode_pool_record(p.record));
+    warn_if_error(coordinator_->put(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id),
+                      keystone::encode_pool_record(p.record)), "pool advertise");
   }
 }
 
@@ -348,8 +348,8 @@ ErrorCode WorkerService::start() {
   if (running_.exchange(true)) return ErrorCode::INVALID_STATE;
   advertise();
   if (coordinator_) {
-    coordinator_->put_with_ttl(coord::heartbeat_key(config_.cluster_id, config_.worker_id),
-                               "alive", config_.heartbeat_ttl_ms);
+    warn_if_error(coordinator_->put_with_ttl(coord::heartbeat_key(config_.cluster_id, config_.worker_id),
+                               "alive", config_.heartbeat_ttl_ms), "heartbeat publish");
     heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
   }
   LOG_INFO << "worker " << config_.worker_id << " started";
@@ -363,8 +363,8 @@ void WorkerService::heartbeat_loop() {
                       [this] { return !running_.load(); });
     if (!running_) break;
     lock.unlock();
-    coordinator_->put_with_ttl(coord::heartbeat_key(config_.cluster_id, config_.worker_id),
-                               "alive", config_.heartbeat_ttl_ms);
+    warn_if_error(coordinator_->put_with_ttl(coord::heartbeat_key(config_.cluster_id, config_.worker_id),
+                               "alive", config_.heartbeat_ttl_ms), "heartbeat publish");
     lock.lock();
   }
 }
@@ -376,10 +376,10 @@ void WorkerService::stop() {
     if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
     if (coordinator_) {
       // Clean unregister (reference worker_service.cpp:256-297).
-      coordinator_->del(coord::heartbeat_key(config_.cluster_id, config_.worker_id));
-      coordinator_->del(coord::worker_key(config_.cluster_id, config_.worker_id));
+      warn_if_error(coordinator_->del(coord::heartbeat_key(config_.cluster_id, config_.worker_id)), "worker deregister", ErrorCode::COORD_KEY_NOT_FOUND);
+      warn_if_error(coordinator_->del(coord::worker_key(config_.cluster_id, config_.worker_id)), "worker deregister", ErrorCode::COORD_KEY_NOT_FOUND);
       for (const auto& p : pools_)
-        coordinator_->del(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id));
+        warn_if_error(coordinator_->del(coord::pool_key(config_.cluster_id, config_.worker_id, p.config.id)), "worker deregister", ErrorCode::COORD_KEY_NOT_FOUND);
     }
   }
   // Transports first: their connection threads may be mid-transfer inside

@@ -4,13 +4,50 @@
 // Runner:  btpu_tests [--filter=substring] [--list]
 #pragma once
 
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <cstdio>
 #include <functional>
 #include <sstream>
 #include <string>
 #include <vector>
 
+#include "btpu/common/env.h"
+#include "btpu/common/result.h"
+
 namespace btest {
+
+// BT_EXPECT_OK accepts both conventions: a bare ErrorCode and a Result<T>
+// (whose .error() is OK when it holds a value).
+inline ::btpu::ErrorCode to_error_code(::btpu::ErrorCode ec) { return ec; }
+template <typename T>
+::btpu::ErrorCode to_error_code(const ::btpu::Result<T>& r) {
+  return r.error();
+}
+
+// Locates a repo-relative file/dir from the test binary's location
+// (build/ or build/{asan,tsan}/) or the repo-root cwd; `env_var` overrides.
+// Shared by the golden-table and fuzz-corpus tests so their path-resolution
+// behavior cannot drift.
+inline std::string locate_repo_path(const char* env_var, const char* rel) {
+  if (const char* env = ::btpu::env_str(env_var)) return env;
+  std::vector<std::string> candidates = {rel};
+  char exe[4096];
+  const ssize_t n = ::readlink("/proc/self/exe", exe, sizeof(exe) - 1);
+  if (n > 0) {
+    exe[n] = '\0';
+    std::string dir(exe);
+    dir = dir.substr(0, dir.find_last_of('/'));
+    candidates.push_back(dir + "/../" + rel);
+    candidates.push_back(dir + "/../../" + rel);
+  }
+  for (const auto& c : candidates) {
+    struct ::stat st {};
+    if (::stat(c.c_str(), &st) == 0) return c;
+  }
+  return candidates.front();
+}
 
 struct TestCase {
   std::string name;
@@ -125,6 +162,18 @@ inline int run_all(int argc, char** argv) {
       ::btest::report_failure(__FILE__, __LINE__, "required: " #cond);       \
       return;                                                                \
     }                                                                        \
+  } while (0)
+
+// Non-fatal OK check for ErrorCode- or Result-returning calls. Variadic so
+// call expressions containing top-level commas need no extra parens. Safe in
+// fixtures and helpers (no `return` on failure, unlike BT_ASSERT_OK).
+#define BT_EXPECT_OK(...)                                                    \
+  do {                                                                       \
+    const ::btpu::ErrorCode _btec = ::btest::to_error_code((__VA_ARGS__));   \
+    if (_btec != ::btpu::ErrorCode::OK)                                      \
+      ::btest::report_failure(__FILE__, __LINE__,                            \
+                              std::string("expected OK, got ") +             \
+                                  std::string(::btpu::to_string(_btec)));    \
   } while (0)
 
 #define BT_ASSERT_OK(result_expr)                                            \

@@ -260,12 +260,14 @@ BTEST(Wire, PlacementRoundtrip) {
   ShardPlacement shard{
       .pool_id = "pool-7",
       .worker_id = "worker-3",
-      .remote = {TransportKind::TCP, "10.0.0.3:7070", 0x7f0000000000ull, "a1b2c3"},
+      .remote = {TransportKind::TCP, "10.0.0.3:7070", 0x7f0000000000ull, "a1b2c3", "", "", 0},
       .storage_class = StorageClass::HBM_TPU,
       .length = 1 << 20,
       .location = MemoryLocation{0x7f0000001000ull, 0x55aaull, 1 << 20},
   };
-  CopyPlacement copy{.copy_index = 2, .shards = {shard, shard}};
+  CopyPlacement copy;
+  copy.copy_index = 2;
+  copy.shards = {shard, shard};
   PutStartResponse resp{.copies = {copy}, .error_code = ErrorCode::OK};
 
   auto bytes = wire::to_bytes(resp);

@@ -42,9 +42,9 @@ void run_kv_suite(Coordinator& c) {
   BT_EXPECT(c.del("/a/b") == ErrorCode::COORD_KEY_NOT_FOUND);
 
   // prefix scan is ordered and bounded
-  c.put("/p/1", "a");
-  c.put("/p/2", "b");
-  c.put("/p2/x", "c");
+  BT_EXPECT_OK(c.put("/p/1", "a"));
+  BT_EXPECT_OK(c.put("/p/2", "b"));
+  BT_EXPECT_OK(c.put("/p2/x", "c"));
   auto scan = c.get_with_prefix("/p/");
   BT_ASSERT_OK(scan);
   BT_ASSERT(scan.value().size() == 2);
@@ -92,7 +92,7 @@ void run_ttl_watch_suite(Coordinator& c) {
 
   const int puts_before = puts.load();
   BT_EXPECT(c.unwatch(watch.value()) == ErrorCode::OK);
-  c.put("/hb/worker-3", "x");
+  BT_EXPECT_OK(c.put("/hb/worker-3", "x"));
   std::this_thread::sleep_for(30ms);
   BT_EXPECT_EQ(puts.load(), puts_before);  // no events after unwatch
 }
@@ -120,7 +120,7 @@ void run_heartbeat_refresh_suite(Coordinator& c) {
   // Stop refreshing: the key dies exactly once.
   BT_EXPECT(eventually([&] { return deletes.load() == 1; }, 2000));
   BT_EXPECT(!c.get("/hb2/w").ok());
-  c.unwatch(watch.value());
+  BT_EXPECT_OK(c.unwatch(watch.value()));
 }
 
 void run_registry_suite(Coordinator& c) {
@@ -406,7 +406,7 @@ BTEST(Durability, ServerRestartClientsReconnectAndResume) {
 BTEST(CoordHA, StandbyMirrorsServesReadsRejectsWrites) {
   coord::CoordServer primary("127.0.0.1", 0);
   BT_ASSERT(primary.start() == ErrorCode::OK);
-  primary.store().put("/pre/a", "1");
+  BT_EXPECT_OK(primary.store().put("/pre/a", "1"));
 
   coord::CoordServer standby("127.0.0.1", 0);
   standby.set_follower(true);
@@ -417,7 +417,7 @@ BTEST(CoordHA, StandbyMirrorsServesReadsRejectsWrites) {
 
   // Snapshot carried the pre-existing key; the stream carries later ones.
   BT_EXPECT(standby.store().get("/pre/a").ok());
-  primary.store().put("/live/b", "2");
+  BT_EXPECT_OK(primary.store().put("/live/b", "2"));
   BT_EXPECT(eventually([&] { return standby.store().get("/live/b").ok(); }));
 
   // Through the wire: a client pointed at the standby can read but not write.
@@ -429,8 +429,8 @@ BTEST(CoordHA, StandbyMirrorsServesReadsRejectsWrites) {
   BT_EXPECT(client.put("/live/c", "3") == ErrorCode::NOT_LEADER);
 
   // Deletes and TTL state mirror too; the standby must NOT expire leases.
-  primary.store().put_with_ttl("/live/ttl", "x", 200);
-  primary.store().del("/live/b");
+  BT_EXPECT_OK(primary.store().put_with_ttl("/live/ttl", "x", 200));
+  BT_EXPECT_OK(primary.store().del("/live/b"));
   BT_EXPECT(eventually([&] { return !standby.store().get("/live/b").ok(); }));
   BT_EXPECT(standby.store().get("/live/ttl").ok());
   std::this_thread::sleep_for(std::chrono::milliseconds(350));
@@ -487,7 +487,7 @@ BTEST(CoordHA, StandbyResyncsWhenPrimaryComesBackInGrace) {
   coord::CoordServer primary("127.0.0.1", 0);
   BT_ASSERT(primary.start() == ErrorCode::OK);
   const uint16_t primary_port = primary.port();
-  primary.store().put("/rs/a", "1");
+  BT_EXPECT_OK(primary.store().put("/rs/a", "1"));
 
   coord::CoordServer standby("127.0.0.1", 0);
   standby.set_follower(true);
@@ -503,7 +503,7 @@ BTEST(CoordHA, StandbyResyncsWhenPrimaryComesBackInGrace) {
   primary.stop();
   coord::CoordServer primary2("127.0.0.1", primary_port);
   BT_ASSERT(primary2.start() == ErrorCode::OK);
-  primary2.store().put("/rs/b", "2");
+  BT_EXPECT_OK(primary2.store().put("/rs/b", "2"));
 
   BT_EXPECT(eventually([&] { return standby.store().get("/rs/b").ok(); }, 5000));
   BT_EXPECT(!follower.promoted());

@@ -459,8 +459,8 @@ BTEST(EndToEnd, TierPressureDemotesHbmObjectsToDiskThroughRealBackends) {
     BT_ASSERT(client->put(key, payloads[i].data(), payloads[i].size(), cfg) == ErrorCode::OK);
     std::this_thread::sleep_for(std::chrono::milliseconds(5));
   }
-  client->get_workers("demote/1");  // touch: demote/0 is the LRU victim
-  client->get_workers("demote/2");
+  (void)client->get_workers("demote/1");  // touch: demote/0 is the LRU victim
+  (void)client->get_workers("demote/2");
 
   cluster.keystone().run_health_check_once();
   BT_EXPECT(cluster.keystone().counters().objects_demoted.load() >= 1ull);

@@ -40,7 +40,7 @@ struct FakeWorker {
              StorageClass cls = StorageClass::RAM_CPU, int32_t slice = 0)
       : id(worker_id), memory(size) {
     server = transport::make_transport_server(TransportKind::LOCAL);
-    server->start("", 0);
+    BT_EXPECT_OK(server->start("", 0));
     auto reg = server->register_region(memory.data(), size, worker_id + "-pool");
     pool.id = worker_id + "-pool";
     pool.node_id = worker_id;
@@ -80,8 +80,8 @@ BTEST(Keystone, PutLifecycleAndLookup) {
   KeystoneService ks(fast_config(), nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   const auto v0 = ks.get_view_version();
   WorkerConfig cfg;
@@ -123,8 +123,8 @@ BTEST(Keystone, PutCompleteCarriesContentCrc) {
   KeystoneService ks(fast_config(), nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
   WorkerConfig cfg;
   cfg.replication_factor = 1;
   cfg.max_workers_per_copy = 1;
@@ -156,8 +156,8 @@ BTEST(Keystone, GcReclaimsAbandonedPendingPuts) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   BT_ASSERT(ks.start() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -173,7 +173,7 @@ BTEST(Keystone, GcReclaimsAbandonedPendingPuts) {
   // legitimately reclaim this pending put too if the test thread stalls
   // past the (deliberately tiny) timeout.
   BT_ASSERT_OK(ks.put_start("fresh/obj", 900 * 1024, wc));
-  ks.put_cancel("fresh/obj");
+  (void)ks.put_cancel("fresh/obj");  // GC may have reclaimed the pending put already
   ks.stop();
 }
 
@@ -181,8 +181,8 @@ BTEST(Keystone, ListObjectsPrefixOrderLimit) {
   KeystoneService ks(fast_config(), nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 4 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   WorkerConfig cfg;
   cfg.replication_factor = 1;
@@ -225,10 +225,10 @@ BTEST(Keystone, ValidationAndDefaults) {
   KeystoneService ks(cfg, nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
-  ks.register_worker(w2.info());
-  ks.register_memory_pool(w2.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
+  BT_EXPECT_OK(ks.register_worker(w2.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w2.pool));
 
   BT_EXPECT(ks.put_start("", 1024, {}).error() == ErrorCode::INVALID_KEY);
   // 0x01 is the reserved staging-key separator (demotion/repair).
@@ -253,8 +253,8 @@ BTEST(Keystone, BatchOperations) {
   KeystoneService ks(fast_config(), nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   WorkerConfig cfg;
   cfg.replication_factor = 1;
@@ -290,8 +290,8 @@ BTEST(Keystone, TtlGcCollectsExpiredObjects) {
   KeystoneService ks(fast_config(), nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   WorkerConfig cfg;
   cfg.replication_factor = 1;
@@ -318,8 +318,8 @@ BTEST(Keystone, WatermarkEvictionLruHonorsSoftPin) {
   KeystoneService ks(cfg, nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 100 * 1024);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -327,15 +327,15 @@ BTEST(Keystone, WatermarkEvictionLruHonorsSoftPin) {
   // Fill to 60%: three 20KB objects. First is soft-pinned.
   wc.enable_soft_pin = true;
   BT_ASSERT_OK(ks.put_start("pinned", 20 * 1024, wc));
-  ks.put_complete("pinned");
+  BT_EXPECT_OK(ks.put_complete("pinned"));
   wc.enable_soft_pin = false;
   BT_ASSERT_OK(ks.put_start("old", 20 * 1024, wc));
-  ks.put_complete("old");
+  BT_EXPECT_OK(ks.put_complete("old"));
   std::this_thread::sleep_for(5ms);
   BT_ASSERT_OK(ks.put_start("newer", 20 * 1024, wc));
-  ks.put_complete("newer");
+  BT_EXPECT_OK(ks.put_complete("newer"));
   std::this_thread::sleep_for(5ms);
-  ks.get_workers("old");  // touch: now "newer" is the LRU victim
+  (void)ks.get_workers("old");  // touch: now "newer" is the LRU victim
 
   ks.run_health_check_once();
   BT_EXPECT(ks.object_exists("pinned").value());   // soft-pin survives
@@ -351,8 +351,8 @@ BTEST(Keystone, PartiallyDamagedStripedCopyReleasesLiveRemnants) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
   for (auto* w : {&w1, &w2}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
 
   WorkerConfig cfg;
@@ -362,7 +362,7 @@ BTEST(Keystone, PartiallyDamagedStripedCopyReleasesLiveRemnants) {
   auto placed = ks.put_start("striped", 64 * 1024, cfg);
   BT_ASSERT_OK(placed);
   BT_ASSERT(placed.value()[0].shards.size() == 2);
-  ks.put_complete("striped");
+  BT_EXPECT_OK(ks.put_complete("striped"));
 
   const NodeId victim = placed.value()[0].shards[0].worker_id;
   BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
@@ -391,8 +391,8 @@ BTEST(Keystone, TierPressureDemotesDownLadderWithBytesIntact) {
   FakeWorker hot("hot", 100 * 1024, StorageClass::HBM_TPU);
   FakeWorker cold("cold", 1 << 20, StorageClass::SSD);
   for (auto* w : {&hot, &cold}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
 
   WorkerConfig wc;
@@ -414,11 +414,11 @@ BTEST(Keystone, TierPressureDemotesDownLadderWithBytesIntact) {
                               shard.length) == ErrorCode::OK);
       off += shard.length;
     }
-    ks.put_complete(key);
+    BT_EXPECT_OK(ks.put_complete(key));
     std::this_thread::sleep_for(5ms);
   }
-  ks.get_workers("a");  // touch: "b" becomes the LRU victim
-  ks.get_workers("c");
+  (void)ks.get_workers("a");  // touch: "b" becomes the LRU victim
+  (void)ks.get_workers("c");  // touch
 
   const auto v0 = ks.get_view_version();
   ks.run_health_check_once();
@@ -460,8 +460,8 @@ BTEST(Keystone, DemotionDisabledFallsBackToEviction) {
   FakeWorker hot("hot", 100 * 1024, StorageClass::HBM_TPU);
   FakeWorker cold("cold", 1 << 20, StorageClass::SSD);
   for (auto* w : {&hot, &cold}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -469,7 +469,7 @@ BTEST(Keystone, DemotionDisabledFallsBackToEviction) {
   wc.preferred_classes = {StorageClass::HBM_TPU};
   for (const char* key : {"a", "b", "c"}) {
     BT_ASSERT_OK(ks.put_start(key, 20 * 1024, wc));
-    ks.put_complete(key);
+    BT_EXPECT_OK(ks.put_complete(key));
     std::this_thread::sleep_for(5ms);
   }
   ks.run_health_check_once();
@@ -489,9 +489,9 @@ BTEST(Keystone, CoordinatorRegistryAndHeartbeatDeath) {
 
   FakeWorker w1("w1", 1 << 20);
   const auto cluster = cfg.cluster_id;
-  coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info()));
-  coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool));
-  coordinator->put_with_ttl(coord::heartbeat_key(cluster, "w1"), "alive", 100);
+  BT_EXPECT_OK(coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info())));
+  BT_EXPECT_OK(coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool)));
+  BT_EXPECT_OK(coordinator->put_with_ttl(coord::heartbeat_key(cluster, "w1"), "alive", 100));
 
   BT_EXPECT(eventually([&] { return ks.workers().size() == 1; }));
   BT_EXPECT(eventually([&] { return ks.memory_pools().size() == 1; }));
@@ -525,8 +525,8 @@ BTEST(Keystone, HaStandbyMirrorsObjectsAndTakesOverOnLeaderDeath) {
   // Worker advertises through the coordinator so BOTH keystones mirror it.
   FakeWorker w1("w1", 1 << 20);
   const auto cluster = cfg.cluster_id;
-  coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info()));
-  coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool));
+  BT_EXPECT_OK(coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info())));
+  BT_EXPECT_OK(coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool)));
   BT_ASSERT(eventually([&] { return !ks_a->memory_pools().empty(); }));
   BT_ASSERT(eventually([&] { return !ks_b.memory_pools().empty(); }));
 
@@ -588,8 +588,8 @@ BTEST(Keystone, BootReplayFromCoordinator) {
   auto coordinator = std::make_shared<coord::MemCoordinator>();
   FakeWorker w1("w1", 1 << 20);
   const std::string cluster = "btpu_cluster";
-  coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info()));
-  coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool));
+  BT_EXPECT_OK(coordinator->put(coord::worker_key(cluster, "w1"), encode_worker_info(w1.info())));
+  BT_EXPECT_OK(coordinator->put(coord::pool_key(cluster, "w1", w1.pool.id), encode_pool_record(w1.pool)));
 
   KeystoneService ks(fast_config(), coordinator);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);  // replays state
@@ -602,8 +602,8 @@ BTEST(Keystone, DeadWorkerRepairRebuildsReplicas) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20), w3("w3", 1 << 20);
   for (auto* w : {&w1, &w2, &w3}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
 
   // Two replicas, one shard each -> two distinct workers hold the object.
@@ -734,9 +734,9 @@ BTEST(Keystone, RestartRecoversPersistedObjects) {
   FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
   const auto cluster = cfg.cluster_id;
   auto advertise = [&](FakeWorker& w) {
-    coordinator->put(coord::worker_key(cluster, w.id), encode_worker_info(w.info()));
-    coordinator->put(coord::pool_key(cluster, w.id, w.pool.id), encode_pool_record(w.pool));
-    coordinator->put_with_ttl(coord::heartbeat_key(cluster, w.id), "alive", 60000);
+    BT_EXPECT_OK(coordinator->put(coord::worker_key(cluster, w.id), encode_worker_info(w.info())));
+    BT_EXPECT_OK(coordinator->put(coord::pool_key(cluster, w.id, w.pool.id), encode_pool_record(w.pool)));
+    BT_EXPECT_OK(coordinator->put_with_ttl(coord::heartbeat_key(cluster, w.id), "alive", 60000));
   };
 
   std::vector<CopyPlacement> original;
@@ -760,8 +760,8 @@ BTEST(Keystone, RestartRecoversPersistedObjects) {
       uint64_t off = 0;
       for (const auto& shard : copy.shards) {
         const auto& mem = std::get<MemoryLocation>(shard.location);
-        client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
-                      shard.length);
+        BT_EXPECT_OK(client->write(shard.remote, mem.remote_addr, mem.rkey, payload.data() + off,
+                      shard.length));
         off += shard.length;
       }
     }
@@ -866,10 +866,10 @@ BTEST(Keystone, DeferredPersistCatchesUpAfterCoordinatorOutage) {
   // Advertised through the coordinator so the post-outage restart can
   // re-adopt placements against replayed pools.
   for (auto* w : {&w1, &w2, &w3}) {
-    coordinator->put(coord::worker_key(cfg.cluster_id, w->id), encode_worker_info(w->info()));
-    coordinator->put(coord::pool_key(cfg.cluster_id, w->id, w->pool.id),
-                     encode_pool_record(w->pool));
-    coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w->id), "alive", 60000);
+    BT_EXPECT_OK(coordinator->put(coord::worker_key(cfg.cluster_id, w->id), encode_worker_info(w->info())));
+    BT_EXPECT_OK(coordinator->put(coord::pool_key(cfg.cluster_id, w->id, w->pool.id),
+                     encode_pool_record(w->pool)));
+    BT_EXPECT_OK(coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w->id), "alive", 60000));
   }
   BT_EXPECT(eventually([&] { return ks.memory_pools().size() == 3; }));
 
@@ -936,8 +936,8 @@ BTEST(Keystone, IdleSlotsReclaimedOnSlotTtlAndCancelledByDrain) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
   for (auto* w : {&w1, &w2}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -978,8 +978,8 @@ BTEST(Keystone, WorkerRestartReadoptsPersistentPools) {
   KeystoneService ks(cfg, nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   auto w1 = std::make_unique<FakeWorker>("w1", 1 << 20, StorageClass::NVME);
-  ks.register_worker(w1->info());
-  ks.register_memory_pool(w1->pool);
+  BT_EXPECT_OK(ks.register_worker(w1->info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1->pool));
 
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -1021,8 +1021,8 @@ BTEST(Keystone, WorkerRestartReadoptsPersistentPools) {
   // "Restart": same worker id + pool id, same bytes, NEW base + rkey.
   FakeWorker w1b("w1", 1 << 20, StorageClass::NVME);
   std::copy(backing.begin(), backing.end(), w1b.memory.begin());
-  ks.register_worker(w1b.info());
-  ks.register_memory_pool(w1b.pool);
+  BT_EXPECT_OK(ks.register_worker(w1b.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1b.pool));
 
   auto got = ks.get_workers("disk/obj");
   BT_ASSERT_OK(got);
@@ -1055,8 +1055,8 @@ BTEST(Keystone, StaleBackingFileFailsReadoptionValidation) {
   KeystoneService ks(cfg, nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   auto w1 = std::make_unique<FakeWorker>("w1", 1 << 20, StorageClass::HDD);
-  ks.register_worker(w1->info());
-  ks.register_memory_pool(w1->pool);
+  BT_EXPECT_OK(ks.register_worker(w1->info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1->pool));
 
   WorkerConfig wc;
   wc.replication_factor = 1;
@@ -1083,8 +1083,8 @@ BTEST(Keystone, StaleBackingFileFailsReadoptionValidation) {
   // Restart with a ZEROED "backing file": revalidation must fail. The CRC
   // checks run on the health loop (the watch thread must not stream bytes).
   FakeWorker w1b("w1", 1 << 20, StorageClass::HDD);  // memory starts zeroed
-  ks.register_worker(w1b.info());
-  ks.register_memory_pool(w1b.pool);
+  BT_EXPECT_OK(ks.register_worker(w1b.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1b.pool));
   ks.run_health_check_once();
   BT_EXPECT(!ks.object_exists("stale/obj").value());
   BT_EXPECT_EQ(ks.counters().objects_lost.load(), 1ull);
@@ -1095,17 +1095,17 @@ BTEST(Keystone, SingleReplicaLostObjectIsDropped) {
   KeystoneService ks(cfg, nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   FakeWorker w1("w1", 1 << 20), w2("w2", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
-  ks.register_worker(w2.info());
-  ks.register_memory_pool(w2.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
+  BT_EXPECT_OK(ks.register_worker(w2.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w2.pool));
 
   WorkerConfig wc;
   wc.replication_factor = 1;
   wc.max_workers_per_copy = 1;
   auto placed = ks.put_start("fragile", 4096, wc);
   BT_ASSERT_OK(placed);
-  ks.put_complete("fragile");
+  BT_EXPECT_OK(ks.put_complete("fragile"));
   const NodeId victim = placed.value()[0].shards[0].worker_id;
   BT_EXPECT(ks.remove_worker(victim) == ErrorCode::OK);
   BT_EXPECT(!ks.object_exists("fragile").value());
@@ -1127,7 +1127,7 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
                         info.topo.host_id, info.topo.chip_id, info.registered_at_ms,
                         info.last_heartbeat_ms);
     auto b = w.take();
-    coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), std::string(b.begin(), b.end()));
+    BT_EXPECT_OK(coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), std::string(b.begin(), b.end())));
   }
   {
     wire::Writer w;
@@ -1138,10 +1138,10 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
                         w1.pool.topo.chip_id);
     // v1 pool records could end here (pre-alignment) — exercise exactly that.
     auto b = w.take();
-    coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
-                     std::string(b.begin(), b.end()));
+    BT_EXPECT_OK(coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
+                     std::string(b.begin(), b.end())));
   }
-  coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000);
+  BT_EXPECT_OK(coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000));
 
   // Shards in the historical layouts were UNPREFIXED (pre-wire-v2): every
   // nested field back-to-back, exactly as those builds wrote them.
@@ -1171,8 +1171,8 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
     encode_shard(w, 0, 4096);
     wire::encode_fields(w, int64_t{1}, int64_t{2});  // wall-clock stamps
     auto bytes = w.take();
-    coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/pre-ec"),
-                     std::string(bytes.begin(), bytes.end()));
+    BT_EXPECT_OK(coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/pre-ec"),
+                     std::string(bytes.begin(), bytes.end())));
   }
   {  // Layout 2: EC-era (copy carries ec fields, config carries ec fields,
      //           but neither has content_crc).
@@ -1190,8 +1190,8 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
     wire::encode_fields(w, uint32_t{2}, uint32_t{1}, uint64_t{8000});  // ec geometry
     wire::encode_fields(w, int64_t{3}, int64_t{4});
     auto bytes = w.take();
-    coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/ec-era"),
-                     std::string(bytes.begin(), bytes.end()));
+    BT_EXPECT_OK(coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/ec-era"),
+                     std::string(bytes.begin(), bytes.end())));
   }
   {  // Layout 3: last pre-envelope generation — content_crc present, but no
      //           struct length prefixes and no record envelope.
@@ -1208,8 +1208,8 @@ BTEST(Keystone, RestartRecoversPreUpgradeRecordLayouts) {
     wire::encode_fields(w, uint32_t{0xABCD1234});                   // content_crc
     wire::encode_fields(w, int64_t{5}, int64_t{6});
     auto bytes = w.take();
-    coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/crc-era"),
-                     std::string(bytes.begin(), bytes.end()));
+    BT_EXPECT_OK(coordinator->put(coord::object_record_key(cfg.cluster_id, "legacy/crc-era"),
+                     std::string(bytes.begin(), bytes.end())));
   }
 
   KeystoneService ks(cfg, coordinator);
@@ -1261,10 +1261,10 @@ BTEST(Keystone, FutureFormatRecordsAreKeptNotDeleted) {
   auto coordinator = std::make_shared<coord::MemCoordinator>();
   auto cfg = fast_config();
   FakeWorker w1("w1", 1 << 20);
-  coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), encode_worker_info(w1.info()));
-  coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
-                   encode_pool_record(w1.pool));
-  coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000);
+  BT_EXPECT_OK(coordinator->put(coord::worker_key(cfg.cluster_id, w1.id), encode_worker_info(w1.info())));
+  BT_EXPECT_OK(coordinator->put(coord::pool_key(cfg.cluster_id, w1.id, w1.pool.id),
+                   encode_pool_record(w1.pool)));
+  BT_EXPECT_OK(coordinator->put_with_ttl(coord::heartbeat_key(cfg.cluster_id, w1.id), "alive", 60000));
 
   const auto key = coord::object_record_key(cfg.cluster_id, "future/obj");
   {
@@ -1273,14 +1273,14 @@ BTEST(Keystone, FutureFormatRecordsAreKeptNotDeleted) {
     w.put<uint8_t>(3);     // bumped format: incompatible future layout
     wire::encode_fields(w, std::string("opaque future payload"));
     auto b = w.take();
-    coordinator->put(key, std::string(b.begin(), b.end()));
+    BT_EXPECT_OK(coordinator->put(key, std::string(b.begin(), b.end())));
   }
   {  // Plain garbage (no envelope, undecodable) IS deleted at boot.
     wire::Writer w;
     wire::encode_fields(w, std::string("#!"));
     auto b = w.take();
-    coordinator->put(coord::object_record_key(cfg.cluster_id, "garbage/obj"),
-                     std::string(b.begin(), b.end()));
+    BT_EXPECT_OK(coordinator->put(coord::object_record_key(cfg.cluster_id, "garbage/obj"),
+                     std::string(b.begin(), b.end())));
   }
 
   KeystoneService ks(cfg, coordinator);
@@ -1314,8 +1314,8 @@ BTEST(Keystone, FencedPersistStepsDownStaleLeader) {
   BT_EXPECT(eventually([&] { return ks.is_leader(); }));
 
   FakeWorker w1("w1", 1 << 20);
-  ks.register_worker(w1.info());
-  ks.register_memory_pool(w1.pool);
+  BT_EXPECT_OK(ks.register_worker(w1.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w1.pool));
 
   WorkerConfig wc;
   wc.replication_factor = 1;

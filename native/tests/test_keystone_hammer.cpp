@@ -34,7 +34,7 @@ struct HammerWorker {
   HammerWorker(const std::string& worker_id, uint64_t size)
       : id(worker_id), memory(size) {
     server = transport::make_transport_server(TransportKind::LOCAL);
-    server->start("", 0);
+    BT_EXPECT_OK(server->start("", 0));
     auto reg = server->register_region(memory.data(), size, worker_id + "-pool");
     pool.id = worker_id + "-pool";
     pool.node_id = worker_id;
@@ -104,8 +104,8 @@ BTEST(KeystoneHammer, MixedOpsDisjointKeys) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   HammerWorker w1("hw1", 64 << 20), w2("hw2", 64 << 20);
   for (auto* w : {&w1, &w2}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
 
   constexpr int kThreads = 4;
@@ -158,8 +158,8 @@ BTEST(KeystoneHammer, CollidingKeysBothLayouts) {
     KeystoneService ks(hammer_config(shards), nullptr);
     BT_ASSERT(ks.initialize() == ErrorCode::OK);
     HammerWorker w("hwc" + std::to_string(shards), 64 << 20);
-    ks.register_worker(w.info());
-    ks.register_memory_pool(w.pool);
+    BT_EXPECT_OK(ks.register_worker(w.info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w.pool));
 
     constexpr int kThreads = 4;
     constexpr int kIters = 150;
@@ -210,8 +210,8 @@ BTEST(KeystoneHammer, BatchesVsGcEvictAndReaders) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   HammerWorker w1("hwb1", 64 << 20), w2("hwb2", 64 << 20);
   for (auto* w : {&w1, &w2}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
 
   constexpr int kWriters = 2;
@@ -282,7 +282,7 @@ BTEST(KeystoneHammer, BatchesVsGcEvictAndReaders) {
   // Everything is either cancelled, GC'd, or still resident-complete; a
   // final GC pass (TTL=1ms is long past) plus remove_all must zero it out.
   ks.run_gc_once();
-  ks.remove_all_objects();
+  BT_EXPECT_OK(ks.remove_all_objects());
   expect_no_leaked_allocations(ks);
 }
 
@@ -295,8 +295,8 @@ BTEST(KeystoneHammer, RepairInterleavesWithTraffic) {
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   HammerWorker w1("hwr1", 64 << 20), w2("hwr2", 64 << 20), w3("hwr3", 64 << 20);
   for (auto* w : {&w1, &w2, &w3}) {
-    ks.register_worker(w->info());
-    ks.register_memory_pool(w->pool);
+    BT_EXPECT_OK(ks.register_worker(w->info()));
+    BT_EXPECT_OK(ks.register_memory_pool(w->pool));
   }
 
   // Seed replicated objects whose copies span the workers.
@@ -335,7 +335,7 @@ BTEST(KeystoneHammer, RepairInterleavesWithTraffic) {
   }
   pool.emplace_back([&] {
     // Kill w3 while traffic flows: cleanup + repair run on this thread.
-    ks.remove_worker("hwr3");
+    (void)ks.remove_worker("hwr3");  // chaos thread; asserted via workers_lost below
     done.store(true);
   });
   for (auto& th : pool) th.join();
@@ -351,7 +351,7 @@ BTEST(KeystoneHammer, RepairInterleavesWithTraffic) {
       for (const auto& shard : copy.shards) BT_EXPECT_NE(shard.worker_id, "hwr3");
     }
   }
-  ks.remove_all_objects();
+  BT_EXPECT_OK(ks.remove_all_objects());
   expect_no_leaked_allocations(ks);
 }
 
@@ -365,8 +365,8 @@ BTEST(KeystoneHammer, SlotCommitRaces) {
   KeystoneService ks(cfg, nullptr);
   BT_ASSERT(ks.initialize() == ErrorCode::OK);
   HammerWorker w("hws", 64 << 20);
-  ks.register_worker(w.info());
-  ks.register_memory_pool(w.pool);
+  BT_EXPECT_OK(ks.register_worker(w.info()));
+  BT_EXPECT_OK(ks.register_memory_pool(w.pool));
 
   constexpr int kThreads = 4;
   constexpr int kCommitsPerThread = 12;

@@ -20,7 +20,7 @@ MemoryPool make_pool(const std::string& id = "pool-0", uint64_t size = 1 << 20,
   p.node_id = "node-0";
   p.size = size;
   p.storage_class = cls;
-  p.remote = {TransportKind::TCP, "127.0.0.1:7000", 0x10000000, "beef"};
+  p.remote = {TransportKind::TCP, "127.0.0.1:7000", 0x10000000, "beef", "", "", 0};
   return p;
 }
 }  // namespace

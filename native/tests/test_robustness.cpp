@@ -310,20 +310,20 @@ struct RpcRobustFixture {
     if (ks.initialize() != ErrorCode::OK) return false;
     memory.resize(1 << 20);
     transport_server = transport::make_transport_server(TransportKind::LOCAL);
-    transport_server->start("", 0);
+    BT_EXPECT_OK(transport_server->start("", 0));
     auto reg = transport_server->register_region(memory.data(), memory.size(), "p0");
     if (!reg.ok()) return false;
     keystone::WorkerInfo w;
     w.worker_id = "w0";
     w.address = "local:w0";
-    ks.register_worker(w);
+    BT_EXPECT_OK(ks.register_worker(w));
     MemoryPool pool;
     pool.id = "p0";
     pool.node_id = "w0";
     pool.size = memory.size();
     pool.storage_class = StorageClass::RAM_CPU;
     pool.remote = reg.value();
-    ks.register_memory_pool(pool);
+    BT_EXPECT_OK(ks.register_memory_pool(pool));
     server = std::make_unique<rpc::KeystoneRpcServer>(ks, "127.0.0.1", 0);
     if (server->start() != ErrorCode::OK) return false;
     client = std::make_unique<rpc::KeystoneRpcClient>(server->endpoint());

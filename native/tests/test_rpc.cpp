@@ -39,20 +39,20 @@ struct RpcFixture {
     if (ks.initialize() != ErrorCode::OK) return false;
     memory.resize(1 << 20);
     transport_server = transport::make_transport_server(TransportKind::LOCAL);
-    transport_server->start("", 0);
+    BT_EXPECT_OK(transport_server->start("", 0));
     auto reg = transport_server->register_region(memory.data(), memory.size(), "p0");
     if (!reg.ok()) return false;
     keystone::WorkerInfo w;
     w.worker_id = "w0";
     w.address = "local:w0";
-    ks.register_worker(w);
+    BT_EXPECT_OK(ks.register_worker(w));
     MemoryPool pool;
     pool.id = "p0";
     pool.node_id = "w0";
     pool.size = memory.size();
     pool.storage_class = StorageClass::RAM_CPU;
     pool.remote = reg.value();
-    ks.register_memory_pool(pool);
+    BT_EXPECT_OK(ks.register_memory_pool(pool));
 
     server = std::make_unique<KeystoneRpcServer>(ks, "127.0.0.1", 0);
     if (server->start() != ErrorCode::OK) return false;
@@ -250,8 +250,8 @@ BTEST(Rpc, MetricsEndpointServesPrometheusText) {
   WorkerConfig wc;
   wc.replication_factor = 1;
   wc.max_workers_per_copy = 1;
-  f.client->put_start("m/obj", 2048, wc);
-  f.client->put_complete("m/obj");
+  BT_EXPECT_OK(f.client->put_start("m/obj", 2048, wc));
+  BT_EXPECT_OK(f.client->put_complete("m/obj"));
 
   auto sock = net::tcp_connect("127.0.0.1", metrics.port());
   BT_ASSERT(sock.ok());
@@ -272,7 +272,7 @@ BTEST(Rpc, MetricsEndpointServesPrometheusText) {
   // /healthz and 404.
   auto sock2 = net::tcp_connect("127.0.0.1", metrics.port());
   const std::string req2 = "GET /nope HTTP/1.1\r\nHost: x\r\n\r\n";
-  net::write_all(sock2.value().fd(), req2.data(), req2.size());
+  BT_EXPECT_OK(net::write_all(sock2.value().fd(), req2.data(), req2.size()));
   std::string response2;
   while ((n = ::read(sock2.value().fd(), buf, sizeof(buf))) > 0)
     response2.append(buf, static_cast<size_t>(n));
@@ -289,8 +289,8 @@ BTEST(Trace, SpansAggregateAndExportInMetrics) {
     wc.replication_factor = 1;
     wc.max_workers_per_copy = 1;
     for (int i = 0; i < 20; ++i) {
-      f.client->put_start("t/" + std::to_string(i), 1024, wc);
-      f.client->put_complete("t/" + std::to_string(i));
+      BT_EXPECT_OK(f.client->put_start("t/" + std::to_string(i), 1024, wc));
+      BT_EXPECT_OK(f.client->put_complete("t/" + std::to_string(i)));
     }
     auto spans = btpu::trace::summary();
     bool found_alloc = false;

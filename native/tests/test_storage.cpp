@@ -117,7 +117,7 @@ void run_backend_suite(StorageBackend& backend) {
   for (auto& th : threads) th.join();
   BT_EXPECT_EQ(failures.load(), 0);
 
-  for (const auto& t : tokens) backend.free_shard(t.offset, t.size);
+  for (const auto& t : tokens) BT_EXPECT_OK(backend.free_shard(t.offset, t.size));
   backend.shutdown();
 }
 

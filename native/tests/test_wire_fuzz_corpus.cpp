@@ -1,0 +1,159 @@
+// Corpus-replay regression gate: every checked-in fuzz input (seeds AND
+// past crashers, native/fuzz/corpus/<target>/) replays through the exact
+// decoders production runs, in the DEFAULT suite — so a crasher found once
+// regresses forever, clang or no clang, fuzzer or no fuzzer.
+//
+// The hostile-input pins below additionally freeze the post-hardening
+// verdicts the decoders must reach. Each one FAILS against the pre-hardened
+// decoders (unclamped backoff hints, unvalidated object-state bytes,
+// trailing-garbage-tolerant v1 pool records, an unvalidated packed TCP
+// header) — they are the proof the WireReader migration changed behavior
+// where it had to, not just shuffled code.
+#include <dirent.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "../fuzz/fuzz_corpus.h"
+#include "../fuzz/fuzz_targets.h"
+#include "btpu/common/env.h"
+#include "btest.h"
+
+namespace {
+
+using namespace btpu;
+
+std::string corpus_root() {
+  return btest::locate_repo_path("BTPU_FUZZ_CORPUS", "native/fuzz/corpus");
+}
+
+BTEST(WireFuzzCorpus, ReplayAllTargets) {
+  const std::string root = corpus_root();
+  size_t total = 0;
+  for (const auto& target : btpu_fuzz::kFuzzTargets) {
+    const auto files = btpu_fuzz::list_corpus_dir(root + "/" + std::string(target.name));
+    // An empty directory means the corpus went missing — that must FAIL,
+    // not silently pass as "replayed zero inputs".
+    BT_EXPECT(!files.empty());
+    for (const auto& f : files) {
+      const auto bytes = btpu_fuzz::read_corpus_file(f);
+      target.fn(bytes.data(), bytes.size());  // must not crash / violate invariants
+      ++total;
+    }
+  }
+  BT_EXPECT(total >= 40);  // seeds alone exceed this; shrinkage = lost corpus
+}
+
+// ---- regression-pinned hostile inputs --------------------------------------
+
+BTEST(WireFuzzCorpus, ControlErrorHintIsClamped) {
+  // Pre-hardening, decode_control_error handed the raw u32 to the caller
+  // and the rpc client slept on it: one forged frame = a ~49-day stall.
+  ErrorCode code{};
+  uint32_t hint = 0;
+  const auto frame = rpc::encode_control_error(ErrorCode::RETRY_LATER, 0xFFFFFFFFu);
+  BT_ASSERT(rpc::decode_control_error(frame, code, hint));
+  BT_EXPECT_EQ(hint, rpc::kMaxBackoffHintMs);
+  BT_EXPECT(code == ErrorCode::RETRY_LATER);
+  // Only the three pre-dispatch rejection codes may ride the frame.
+  const auto forged = rpc::encode_control_error(ErrorCode::OK, 10);
+  BT_EXPECT(!rpc::decode_control_error(forged, code, hint));
+  // Truncation is rejected, appended fields (newer peer) are tolerated.
+  std::vector<uint8_t> shortframe(frame.begin(), frame.begin() + 7);
+  BT_EXPECT(!rpc::decode_control_error(shortframe, code, hint));
+  auto extended = rpc::encode_control_error(ErrorCode::DEADLINE_EXCEEDED, 5);
+  extended.push_back(0x99);
+  BT_EXPECT(rpc::decode_control_error(extended, code, hint));
+}
+
+BTEST(WireFuzzCorpus, ObjectRecordStateByteValidated) {
+  // Pre-hardening, a corrupt/hostile durable record with state=7 decoded
+  // "successfully" and static_cast poured 7 into ObjectState, where every
+  // downstream comparison misread it. Now: garbage, rejected.
+  auto record_with_state = [](uint8_t state) {
+    wire::Writer w;
+    w.put<uint64_t>(~0ull);  // envelope magic
+    w.put<uint8_t>(2);       // current format
+    WorkerConfig wc;
+    wire::encode_fields(w, uint64_t{4096}, uint64_t{0}, false, state, wc,
+                        std::vector<CopyPlacement>{}, int64_t{1}, int64_t{2});
+    const auto b = w.take();
+    return std::string(b.begin(), b.end());
+  };
+  BT_EXPECT(keystone::probe_object_record(record_with_state(0)));   // kPending
+  BT_EXPECT(keystone::probe_object_record(record_with_state(1)));   // kComplete
+  BT_EXPECT(!keystone::probe_object_record(record_with_state(7)));  // hostile
+  BT_EXPECT(!keystone::probe_object_record(record_with_state(0xFF)));
+}
+
+BTEST(WireFuzzCorpus, V1PoolRecordRejectsTrailingGarbage) {
+  // A v1 (envelope-less) pool record, hand-framed to the frozen legacy
+  // layout: fields + v1 remote (4 fields) + topo + optional alignment.
+  wire::Writer w;
+  wire::encode_fields(w, std::string("p1"), std::string("n1"), uint64_t{0x1000},
+                      uint64_t{1 << 20}, uint64_t{0}, StorageClass::RAM_CPU);
+  wire::encode_fields(w, TransportKind::TCP, std::string("h:1"), uint64_t{0x1000},
+                      std::string("ab"));                      // v1 remote
+  wire::encode_fields(w, int32_t{1}, int32_t{2}, int32_t{3});  // topo
+  wire::encode_fields(w, uint64_t{64});                        // alignment (last v1 field)
+  auto bytes = w.take();
+  MemoryPool pool;
+  BT_EXPECT(keystone::decode_pool_record(std::string(bytes.begin(), bytes.end()), pool));
+  BT_EXPECT_EQ(pool.alignment, 64ull);
+  // v1 is frozen history: bytes past the last field are corruption, not
+  // version skew. Pre-hardening this decoded "successfully".
+  bytes.push_back(0xEE);
+  BT_EXPECT(!keystone::decode_pool_record(std::string(bytes.begin(), bytes.end()), pool));
+}
+
+BTEST(WireFuzzCorpus, TcpHeaderRejectsHostileOpAndLength) {
+  using namespace transport::datawire;
+  auto raw = [](uint8_t op, uint64_t len) {
+    DataRequestHeader h{op, 0x1000, 0xBEEF, len, 0};
+    std::vector<uint8_t> v(sizeof(h));
+    std::memcpy(v.data(), &h, sizeof(h));
+    return v;
+  };
+  DataRequestHeader hdr{};
+  // Pre-hardening the server read the packed struct straight off the
+  // socket: any op byte was dispatched, and a forged len drove a
+  // multi-exabyte drain loop / scratch resize. All rejected at parse now.
+  BT_EXPECT(decode_request_header(raw(kOpRead, 1 << 20).data(), 29, hdr));
+  BT_EXPECT(!decode_request_header(raw(0x42, 16).data(), 29, hdr));          // unknown op
+  BT_EXPECT(!decode_request_header(raw(0, 16).data(), 29, hdr));             // op 0
+  BT_EXPECT(!decode_request_header(raw(kOpWrite, ~0ull >> 1).data(), 29, hdr));  // 2^63 len
+  BT_EXPECT(!decode_request_header(raw(kOpHello, 0).data(), 29, hdr));       // empty name
+  BT_EXPECT(!decode_request_header(raw(kOpHello, 4096).data(), 29, hdr));    // name > 255
+  BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), 28, hdr));       // truncated
+  // Staged frames: wrong inner op rejected, truncation rejected.
+  StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 4096, 0}, 0x100};
+  std::vector<uint8_t> fv(sizeof(f));
+  std::memcpy(fv.data(), &f, sizeof(f));
+  StagedFrame out{};
+  BT_EXPECT(decode_staged_frame(fv.data(), fv.size(), out));
+  BT_EXPECT_EQ(out.shm_off, uint64_t{0x100});
+  BT_EXPECT(!decode_staged_frame(fv.data(), fv.size() - 1, out));
+  fv[0] = kOpRead;  // not a staged op
+  BT_EXPECT(!decode_staged_frame(fv.data(), fv.size(), out));
+}
+
+BTEST(WireFuzzCorpus, DeadlineTrailerStripIsExact) {
+  WorkerConfig wc;
+  auto payload = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0});
+  const size_t bare = payload.size();
+  rpc::append_deadline_trailer(payload, 123);
+  uint32_t budget = 0;
+  BT_ASSERT(rpc::strip_deadline_trailer(payload, budget));
+  BT_EXPECT_EQ(budget, 123u);
+  BT_EXPECT_EQ(payload.size(), bare);
+  // No trailer, wrong magic, short payload: never stripped, never read OOB.
+  BT_EXPECT(!rpc::strip_deadline_trailer(payload, budget));
+  std::vector<uint8_t> tiny{1, 2, 3};
+  BT_EXPECT(!rpc::strip_deadline_trailer(tiny, budget));
+}
+
+}  // namespace

@@ -24,6 +24,7 @@
 
 #include <unistd.h>
 
+#include "btpu/common/env.h"
 #include "btpu/common/wire.h"
 #include "btest.h"
 
@@ -225,21 +226,7 @@ std::vector<std::pair<std::string, std::string>> golden_rows() {
 // (build/ or build/{tsan,asan}/) or the repo-root cwd; BTPU_WIRE_GOLDEN
 // overrides.
 std::string golden_path() {
-  if (const char* env = ::getenv("BTPU_WIRE_GOLDEN")) return env;
-  std::vector<std::string> candidates = {"native/tests/wire_golden.txt"};
-  char exe[4096];
-  const ssize_t n = ::readlink("/proc/self/exe", exe, sizeof(exe) - 1);
-  if (n > 0) {
-    exe[n] = '\0';
-    std::string dir(exe);
-    dir = dir.substr(0, dir.find_last_of('/'));
-    candidates.push_back(dir + "/../native/tests/wire_golden.txt");
-    candidates.push_back(dir + "/../../native/tests/wire_golden.txt");
-  }
-  for (const auto& c : candidates) {
-    if (std::ifstream(c).good()) return c;
-  }
-  return candidates.front();
+  return btest::locate_repo_path("BTPU_WIRE_GOLDEN", "native/tests/wire_golden.txt");
 }
 
 }  // namespace

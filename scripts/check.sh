@@ -62,20 +62,30 @@ else
   results[lint]=PASS
 fi
 run_leg "native-suite" ./build/btpu_tests
-if command -v python3 > /dev/null 2>&1 && python3 -c 'import pytest' 2> /dev/null; then
+# tests/conftest.py hard-imports jax, so probe BOTH: a box with pytest but
+# no jax would otherwise fail at conftest load (exit 4), not skip cleanly.
+if command -v python3 > /dev/null 2>&1 && python3 -c 'import pytest, jax' 2> /dev/null; then
   run_leg "tier1-pytest" env JAX_PLATFORMS=cpu python3 -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
 else
-  echo "check: NOTICE — pytest unavailable; skipping the tier-1 leg"
+  echo "check: NOTICE — pytest and/or jax unavailable; skipping the tier-1 leg"
 fi
 run_leg "asan" make -j"$jobs" asan
 run_leg "tsan" make -j"$jobs" tsan
+# Bounded hostile-input sweep: the full-budget run is `make fuzz` (1M
+# execs/target); the check gate replays the corpus plus a smaller
+# deterministic sweep so a decoder regression fails here too. Deliberately
+# keyed on BTPU_CHECK_FUZZ_* (not BTPU_FUZZ_*): a CI job that exports the
+# full-budget knobs for its dedicated fuzz leg must not silently double
+# this smoke leg's cost too.
+run_leg "fuzz-smoke" env BTPU_FUZZ_EXECS="${BTPU_CHECK_FUZZ_EXECS:-100000}" \
+  BTPU_FUZZ_TIME="${BTPU_CHECK_FUZZ_TIME:-15}" scripts/fuzz.sh
 
 echo
 echo "===================================================================="
 echo "== check: summary"
 echo "===================================================================="
-for leg in build lint native-suite tier1-pytest asan tsan; do
+for leg in build lint native-suite tier1-pytest asan tsan fuzz-smoke; do
   [ -n "${results[$leg]:-}" ] && printf '  %-14s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

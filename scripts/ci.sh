@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# CI entrypoint: the one command a CI job runs.
+#
+# Two differences from a developer's `make check`:
+#   - BTPU_REQUIRE_CLANG=1: CI images are expected to ship clang, so the
+#     thread-safety sweep SKIP a laptop tolerates becomes a hard failure
+#     here — the lint gates cannot silently degrade in CI.
+#   - a bounded `make fuzz` leg (BTPU_FUZZ_EXECS/BTPU_FUZZ_TIME below):
+#     enough executions to catch a decoder regression on every push; the
+#     long exploratory runs stay manual/nightly (`make fuzz` with defaults).
+#
+# Exit code is the OR of both legs; each leg's scoreboard prints regardless.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+overall=0
+
+echo "==================================================================="
+echo "== ci: make check (BTPU_REQUIRE_CLANG=1)"
+echo "==================================================================="
+if ! BTPU_REQUIRE_CLANG=1 make check; then
+  overall=1
+fi
+
+echo "==================================================================="
+echo "== ci: make fuzz (smoke: bounded execs/time)"
+echo "==================================================================="
+if ! BTPU_FUZZ_EXECS="${BTPU_FUZZ_EXECS:-200000}" \
+     BTPU_FUZZ_TIME="${BTPU_FUZZ_TIME:-30}" make fuzz; then
+  overall=1
+fi
+
+if [ "$overall" -ne 0 ]; then
+  echo "ci: FAIL (see legs above)" >&2
+fi
+exit "$overall"

@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Hostile-input fuzz gate (make fuzz) over the four wire-decode surfaces
+# (rpc_frame, control_error, tcp_header, record — native/fuzz/fuzz_targets.h):
+#
+#   1. libFuzzer leg (clang only): one coverage-guided harness per target,
+#      -fsanitize=fuzzer,address,undefined, seeded from the checked-in
+#      corpus, BTPU_FUZZ_TIME seconds each (default 60). Skipped WITH A
+#      NOTICE when clang/libFuzzer is unavailable — never silently.
+#   2. Deterministic leg (always): the asan+ubsan corpus-replay binary
+#      replays every checked-in input (including past crashers) and runs a
+#      reproducible mutation sweep to >= BTPU_FUZZ_EXECS executions per
+#      target (default 1,000,000). Same inputs every run, every box.
+#
+# New crashers: copy the reproducer into native/fuzz/corpus/<target>/ and
+# commit it — the replay leg and the default-suite corpus test
+# (test_wire_fuzz_corpus.cpp) then pin it forever. See docs/CORRECTNESS.md.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+EXECS="${BTPU_FUZZ_EXECS:-1000000}"
+FTIME="${BTPU_FUZZ_TIME:-60}"
+JOBS="$(nproc 2> /dev/null || echo 1)"
+CORPUS=native/fuzz/corpus
+fail=0
+
+for t in rpc_frame control_error tcp_header record; do
+  if [ -z "$(ls -A "$CORPUS/$t" 2> /dev/null)" ]; then
+    echo "fuzz: FAIL — no checked-in corpus for $t (expected $CORPUS/$t/*)" >&2
+    exit 1
+  fi
+done
+
+# ---- libFuzzer leg (clang boxes) ------------------------------------------
+CLANG="${CLANG:-}"
+if [ -z "${CLANG}" ]; then
+  for cand in clang++ clang++-21 clang++-20 clang++-19 clang++-18 clang++-17 \
+              clang++-16 clang++-15 clang++-14; do
+    if command -v "$cand" > /dev/null 2>&1; then CLANG="$cand"; break; fi
+  done
+fi
+have_libfuzzer=0
+if [ -n "${CLANG}" ]; then
+  if echo 'extern "C" int LLVMFuzzerTestOneInput(const unsigned char*, unsigned long){return 0;}' \
+     | "${CLANG}" -x c++ -fsanitize=fuzzer - -o /tmp/btpu_fuzz_probe 2> /dev/null; then
+    have_libfuzzer=1
+    rm -f /tmp/btpu_fuzz_probe
+  fi
+fi
+
+if [ "$have_libfuzzer" = "1" ]; then
+  echo "fuzz: libFuzzer leg (${CLANG}, ${FTIME}s per target)"
+  # The record target calls into libbtpu.so (keystone record decoders), so
+  # the library itself must be clang-built with asan+ubsan+coverage
+  # (fuzzer-no-link): linking the gcc build would leave those decoders
+  # uninstrumented — OOB reads invisible, no coverage feedback — and mixing
+  # gcc-libasan with clang-compiler-rt in one process aborts at startup.
+  # No -Werror here: the gcc sweep owns warning hygiene; a clang-only
+  # warning must not take down the fuzz leg.
+  mkdir -p build/fuzz
+  if ! make -j"$JOBS" BUILD=build/fuzz/clang CXX="${CLANG}" \
+       CXXFLAGS="-std=c++20 -O1 -g -fPIC -Inative/include -pthread \
+                 -fsanitize=address,undefined,fuzzer-no-link" \
+       LDFLAGS="-pthread -lrt -fsanitize=address,undefined,fuzzer-no-link" \
+       build/fuzz/clang/libbtpu.so > /dev/null; then
+    echo "fuzz: FAIL — could not build the clang-instrumented libbtpu.so" >&2
+    exit 1
+  fi
+  for t in rpc_frame control_error tcp_header record; do
+    bin="build/fuzz/fuzz_$t"
+    if ! "${CLANG}" -std=c++20 -O1 -g -Inative/include \
+         -fsanitize=fuzzer,address,undefined -DBTPU_FUZZ_TARGET="$t" \
+         native/fuzz/fuzz_main_libfuzzer.cpp \
+         -Lbuild/fuzz/clang -lbtpu -Wl,-rpath,"\$ORIGIN/clang" -pthread -lrt -o "$bin"; then
+      echo "fuzz: FAIL — could not build $bin" >&2
+      fail=1
+      continue
+    fi
+    mkdir -p "build/fuzz/corpus_$t"  # findings dir (kept out of the seed set)
+    if ! "$bin" -max_total_time="$FTIME" -print_final_stats=1 \
+         "build/fuzz/corpus_$t" "$CORPUS/$t"; then
+      echo "fuzz: FAIL — $t crashed; add the reproducer to $CORPUS/$t/ and fix" >&2
+      fail=1
+    fi
+  done
+else
+  echo "fuzz: NOTICE — clang/libFuzzer not available; coverage-guided leg skipped" >&2
+  echo "fuzz:          (the deterministic asan sweep below still runs)" >&2
+fi
+
+# ---- deterministic leg (every box) ----------------------------------------
+echo "fuzz: deterministic corpus-replay + mutation sweep (asan+ubsan, ${EXECS} execs/target)"
+if ! make -j"$JOBS" fuzz-replay; then
+  echo "fuzz: FAIL — could not build the replay binary" >&2
+  exit 1
+fi
+if ! build/asan/btpu_fuzz_replay --corpus "$CORPUS" --execs "$EXECS"; then
+  echo "fuzz: FAIL — deterministic sweep found a crash/invariant violation" >&2
+  fail=1
+fi
+
+exit "$fail"

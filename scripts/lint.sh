@@ -1,16 +1,34 @@
 #!/usr/bin/env bash
 # Static lint gate (make lint):
-#   1. clang -fsyntax-only -Wthread-safety -Werror sweep over every native
+#   1. scripts/btpu_lint.py — the project-invariant linter (annotated-mutex
+#      only, env via env.h, steady-clock deadlines, wire structs registered
+#      in the golden table, nodiscard on error-returning declarations).
+#      Pattern-based with an optional libclang refinement, so it runs — and
+#      can FAIL — on every box, clang or not.
+#   2. clang -fsyntax-only -Wthread-safety -Werror sweep over every native
 #      source — the machine check behind the GUARDED_BY/REQUIRES annotations
 #      in btpu/common/thread_annotations.h. Skipped WITH A NOTICE when clang
 #      is not installed (gcc has no equivalent analysis; the annotations
 #      compile to no-ops there).
-#   2. python -m compileall over blackbird_tpu/ and tests/ so syntax rot in
+#   3. python -m compileall over blackbird_tpu/ and tests/ so syntax rot in
 #      the bindings fails the gate even on machines that never import them.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 fail=0
+
+# ---- project-invariant linter ---------------------------------------------
+PY="${PYTHON:-python3}"
+if command -v "$PY" > /dev/null 2>&1; then
+  echo "lint: ${PY} scripts/btpu_lint.py (project invariants)"
+  if ! "$PY" scripts/btpu_lint.py; then
+    echo "lint: FAIL — project-invariant violations (see above)" >&2
+    fail=1
+  fi
+else
+  echo "lint: FAIL — python3 required for the project-invariant linter" >&2
+  fail=1
+fi
 
 # ---- clang thread-safety sweep --------------------------------------------
 CLANG="${CLANG:-}"

@@ -29,7 +29,7 @@ EXES     := $(patsubst native/exe/%.cpp,$(BUILD)/%,$(EXE_SRCS))
 EXAMPLE_SRCS := $(wildcard examples/*.cpp)
 EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
 
-HDRS := $(shell find native/include native/src -name '*.h')
+HDRS := $(shell find native/include native/src native/exe native/fuzz -name '*.h')
 
 .PHONY: all native examples clean tsan asan lint check wire-golden fuzz fuzz-replay
 all: native
@@ -130,7 +130,8 @@ WCONV_SRCS := native/src/net/net.cpp native/src/rpc/rpc_client.cpp \
               native/src/rpc/rpc_server.cpp native/src/common/types.cpp \
               native/src/common/error.cpp native/src/common/deadline.cpp \
               native/src/keystone/keystone_persist.cpp \
-              native/src/transport/tcp_transport.cpp
+              native/src/transport/tcp_transport.cpp \
+              native/src/coord/mem_coordinator.cpp
 $(patsubst %.cpp,$(BUILD)/obj/%.o,$(WCONV_SRCS)): WARN_EXTRA := -Wconversion
 
 $(BUILD)/obj/%.o: %.cpp $(HDRS)

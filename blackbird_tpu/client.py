@@ -362,6 +362,10 @@ class Client:
             "hedge_wins": "btpu_hedge_win_count",
             "breaker_trips": "btpu_breaker_trip_count",
             "breaker_skips": "btpu_breaker_skip_count",
+            # Durability-lag backlog: objects whose durable record write is
+            # deferred and retrying (acked vs durable state diverged across
+            # every in-process keystone). Alert on sustained nonzero.
+            "persist_retry_backlog": "btpu_persist_retry_backlog",
         }
         return {key: int(getattr(lib, fn)()) if hasattr(lib, fn) else 0
                 for key, fn in names.items()}

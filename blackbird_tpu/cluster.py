@@ -24,10 +24,26 @@ class EmbeddedCluster:
         storage_class: StorageClass = StorageClass.RAM_CPU,
         transport: TransportKind = TransportKind.LOCAL,
         tiered_device_bytes: int | None = None,
+        data_dir: str | None = None,
+        group_commit_us: int = -1,
     ):
+        """data_dir arms coordinator persistence: a new cluster on the SAME
+        dir recovers every acked durable object (inline tier — RAM pool
+        bytes die with the process by design). group_commit_us tunes the
+        WAL group-commit window (0 = fdatasync per record, <0 = env/500us
+        default); see docs/OPERATIONS.md "Durability"."""
         if tiered_device_bytes is not None:
+            if data_dir is not None:
+                raise ValueError("data_dir is not supported with tiered clusters")
             self._handle = lib.btpu_cluster_create_tiered(
                 workers, tiered_device_bytes, pool_bytes
+            )
+        elif data_dir is not None:
+            if not hasattr(lib, "btpu_cluster_create_ex"):
+                raise RuntimeError("this libbtpu build has no durable-cluster support")
+            self._handle = lib.btpu_cluster_create_ex(
+                workers, pool_bytes, int(storage_class), int(transport),
+                str(data_dir).encode(), group_commit_us
             )
         else:
             self._handle = lib.btpu_cluster_create(

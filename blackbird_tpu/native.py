@@ -162,11 +162,17 @@ def _load() -> ctypes.CDLL:
     for name in ("btpu_pvm_byte_count", "btpu_tcp_staged_op_count",
                  "btpu_tcp_staged_byte_count", "btpu_tcp_stream_op_count",
                  "btpu_tcp_stream_byte_count", "btpu_cached_op_count",
-                 "btpu_cached_byte_count"):
+                 "btpu_cached_byte_count", "btpu_persist_retry_backlog"):
         if hasattr(handle, name):
             fn = getattr(handle, name)
             fn.restype = u64
             fn.argtypes = []
+    # Durable embedded cluster (optional, same prebuilt-library reason):
+    # cluster.py probes hasattr before offering data_dir.
+    if hasattr(handle, "btpu_cluster_create_ex"):
+        handle.btpu_cluster_create_ex.restype = c
+        handle.btpu_cluster_create_ex.argtypes = [u32, u64, u32, u32, ctypes.c_char_p,
+                                                  ctypes.c_int64]
     # Client object cache (optional, same prebuilt-library reason): config +
     # stats for the lease-coherent cache (native/src/cache/object_cache.cpp).
     if hasattr(handle, "btpu_client_cache_configure"):

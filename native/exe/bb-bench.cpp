@@ -4,11 +4,15 @@
 // with rotating offsets, CLI --size/--iterations/--replicas/--max-workers)
 // plus what it lacked: p50/p99 latency (the BASELINE.md scoreboard metric),
 // a hermetic --embedded mode, and JSON output for driver harnesses.
+#include <stdlib.h>
+
 #include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <filesystem>
+#include <random>
 #include <thread>
 
 #include "btpu/client/embedded.h"
@@ -65,6 +69,9 @@ int main(int argc, char** argv) {
   bool json = false, sweep = false, no_verify = false, repeat_rows = false;
   bool control_plane = false;  // metadata ops/sec closed loop, no data plane
   bool overload = false;  // slow-worker tail row: hedging off vs on
+  bool durable_put = false;  // acked==durable inline puts vs gets (WAL group commit)
+  int64_t window_us = -1;    // --durable-put WAL window (-1 = env/500 default)
+  std::string data_dir;      // --durable-put persist dir ("" = fresh tmp)
   int batch = 0;  // >0: measure put_many/get_many over `batch` objects per op
   int threads = 1;  // >1: concurrent clients, each its own connection
   std::string prefix = "bench";  // key namespace (multi-process runs pass distinct ones)
@@ -92,6 +99,10 @@ int main(int argc, char** argv) {
       prefix = argv[++i];  // key namespace: lets N bb-bench PROCESSES share a cluster
     else if (!std::strcmp(argv[i], "--control-plane")) control_plane = true;
     else if (!std::strcmp(argv[i], "--overload")) overload = true;
+    else if (!std::strcmp(argv[i], "--durable-put")) durable_put = true;
+    else if (!std::strcmp(argv[i], "--window-us") && i + 1 < argc)
+      window_us = std::stoll(argv[++i]);
+    else if (!std::strcmp(argv[i], "--data-dir") && i + 1 < argc) data_dir = argv[++i];
     else if (!std::strcmp(argv[i], "--ec") && i + 1 < argc) {
       const std::string km = argv[++i];
       if (km.find('-') != std::string::npos) {  // stoul silently wraps negatives
@@ -118,10 +129,138 @@ int main(int argc, char** argv) {
           "                       report aggregate GB/s + merged percentiles\n"
           "       [--control-plane]  metadata ops/sec closed loop\n"
           "                       (put_start/get_workers/put_cancel/exists)\n"
+          "       [--durable-put] acked==durable inline-put vs get latency over a\n"
+          "                       persisted coordinator ([--window-us US] group-commit\n"
+          "                       window, 0 = fdatasync per record; [--data-dir D])\n"
           "       [--no-verify]   skip CRC verification on reads (raw ceiling;\n"
           "                       default reads are verified end to end)\n");
       return 0;
     }
+  }
+
+  if (durable_put) {
+    // Acked == durable small-object row (ROADMAP item 5): inline puts whose
+    // ack waits for the covering WAL fdatasync, vs gets of the same objects,
+    // in the same concurrent scenario. Group commit amortizes the sync
+    // across the writers; --window-us 0 is the sync-per-record baseline.
+    if (data_dir.empty()) {
+      char tmpl[] = "/tmp/bb-bench-durable-XXXXXX";
+      const char* made = mkdtemp(tmpl);
+      if (!made) {
+        std::fprintf(stderr, "mkdtemp failed\n");
+        return 1;
+      }
+      data_dir = made;
+    }
+    // The topology that makes "put p99 vs get p99" a like-for-like durability
+    // comparison: clients speak real keystone RPC (the remote inline-tier
+    // lane), and the keystone persists into an in-process durable
+    // coordinator. A put is one RPC whose ack additionally waits for the
+    // covering WAL fdatasync; a get is one RPC. The ratio between them IS
+    // the price of durability on the ack path.
+    auto options = client::EmbeddedClusterOptions::simple(2, 64ull << 20);
+    options.durability.dir = data_dir;
+    options.durability.group_commit_us = window_us;
+    // Concurrent writers must not serialize on the object map (auto-sharding
+    // sees 1 core on small boxes): pin the shard count like production
+    // keystone hosts run, so persists overlap and actually share fdatasyncs.
+    options.keystone.metadata_shards = 8;
+    client::EmbeddedCluster dcluster(std::move(options));
+    if (dcluster.start() != ErrorCode::OK) {
+      std::fprintf(stderr, "durable embedded cluster failed to start\n");
+      return 1;
+    }
+    rpc::KeystoneRpcServer rpc_server(dcluster.keystone(), "127.0.0.1", 0);
+    if (rpc_server.start() != ErrorCode::OK) {
+      std::fprintf(stderr, "keystone rpc server failed to start\n");
+      return 1;
+    }
+    const int nthreads = std::max(1, threads);
+    const int per_thread = std::max(1, iterations);
+    const uint64_t obj_bytes = std::min<uint64_t>(size, 4096);
+    std::vector<std::vector<double>> put_us(nthreads), get_us(nthreads);
+    std::vector<std::thread> workers;
+    std::atomic<int> put_failures{0};
+    // Sampled BEFORE any writer starts: threads begin syncing while later
+    // threads are still being spawned, and every one of those syncs must
+    // land in the syncs_per_put denominator's numerator.
+    const uint64_t syncs_before = dcluster.coordinator()->wal_sync_count();
+    for (int t = 0; t < nthreads; ++t) {
+      workers.emplace_back([&, t] {
+        client::ClientOptions copts;
+        copts.keystone_address = rpc_server.endpoint();
+        auto client = std::make_unique<client::ObjectClient>(copts);
+        if (client->connect() != ErrorCode::OK) {
+          put_failures.fetch_add(per_thread);
+          return;
+        }
+        WorkerConfig dwc;
+        dwc.replication_factor = 1;  // inline tier: durability IS the WAL
+        dwc.ttl_ms = 0;
+        std::vector<uint8_t> data(obj_bytes);
+        for (size_t i = 0; i < data.size(); ++i)
+          data[i] = static_cast<uint8_t>(i * 131 + static_cast<size_t>(t));
+        put_us[static_cast<size_t>(t)].reserve(static_cast<size_t>(per_thread));
+        get_us[static_cast<size_t>(t)].reserve(static_cast<size_t>(per_thread));
+        auto key_for = [&](int i) {
+          return prefix + "/durable/" + std::to_string(t) + "/" + std::to_string(i);
+        };
+        // Mixed steady-state load: every iteration is one durable put of a
+        // fresh key + one verified get of an earlier key, so both
+        // distributions face the SAME concurrency and the ratio isolates
+        // the durability cost on the ack path.
+        std::mt19937_64 rng(0x5eedull + static_cast<uint64_t>(t));
+        for (int i = 0; i < per_thread; ++i) {
+          const std::string key = key_for(i);
+          const auto t0 = std::chrono::steady_clock::now();
+          const auto ec = client->put(key, data.data(), data.size(), dwc);
+          const auto t1 = std::chrono::steady_clock::now();
+          if (ec != ErrorCode::OK) {
+            put_failures.fetch_add(1);
+            continue;
+          }
+          put_us[static_cast<size_t>(t)].push_back(
+              std::chrono::duration<double, std::micro>(t1 - t0).count());
+          const std::string probe = key_for(static_cast<int>(rng() % (static_cast<uint64_t>(i) + 1)));
+          const auto g0 = std::chrono::steady_clock::now();
+          auto got = client->get(probe, /*verify=*/true);
+          const auto g1 = std::chrono::steady_clock::now();
+          if (got.ok())
+            get_us[static_cast<size_t>(t)].push_back(
+                std::chrono::duration<double, std::micro>(g1 - g0).count());
+        }
+      });
+    }
+    for (auto& w : workers) w.join();
+    const uint64_t wal_syncs = dcluster.coordinator()->wal_sync_count() - syncs_before;
+    std::vector<double> puts, gets;
+    for (auto& v : put_us) puts.insert(puts.end(), v.begin(), v.end());
+    for (auto& v : get_us) gets.insert(gets.end(), v.begin(), v.end());
+    std::sort(puts.begin(), puts.end());
+    std::sort(gets.begin(), gets.end());
+    if (puts.empty() || gets.empty()) {
+      std::fprintf(stderr, "durable-put made no progress (%d put failures)\n",
+                   put_failures.load());
+      return 1;
+    }
+    const double put_p50 = percentile(puts, 50), put_p99 = percentile(puts, 99);
+    const double get_p50 = percentile(gets, 50), get_p99 = percentile(gets, 99);
+    // syncs_per_put is the scheduler-noise-free batching proof: < 1 means
+    // concurrent acks genuinely shared fdatasyncs; sync-per-record reads ~1.
+    std::printf("{\"mode\": \"durable_put\", \"window_us\": %lld, \"threads\": %d, "
+                "\"object_bytes\": %llu, \"puts\": %zu, \"put_failures\": %d, "
+                "\"put_p50_us\": %.1f, \"put_p99_us\": %.1f, \"get_p50_us\": %.1f, "
+                "\"get_p99_us\": %.1f, \"put_over_get_p99_x\": %.2f, "
+                "\"wal_syncs\": %llu, \"syncs_per_put\": %.3f}\n",
+                static_cast<long long>(window_us), nthreads,
+                static_cast<unsigned long long>(obj_bytes), puts.size(),
+                put_failures.load(), put_p50, put_p99, get_p50, get_p99,
+                get_p99 > 0 ? put_p99 / get_p99 : 0.0, (unsigned long long)wal_syncs,
+                puts.empty() ? 0.0 : static_cast<double>(wal_syncs) / static_cast<double>(puts.size()));
+    dcluster.stop();
+    std::error_code fs_ec;
+    std::filesystem::remove_all(data_dir, fs_ec);
+    return 0;
   }
 
   std::unique_ptr<client::EmbeddedCluster> cluster;

@@ -41,6 +41,17 @@ int main(int argc, char** argv) {
     }
   }
   btpu::coord::CoordServer server(host, port, durability);
+  if (server.store().durability_status() != btpu::ErrorCode::OK) {
+    // Recovery refused (mid-log corruption / newer journal format): serving
+    // would answer every call with the failure anyway — exit loudly so the
+    // operator runs the docs/OPERATIONS.md crash-recovery runbook instead.
+    std::fprintf(stderr,
+                 "bb-coord: durable state under %s failed recovery (%s); refusing to "
+                 "serve — see docs/OPERATIONS.md crash-recovery runbook\n",
+                 durability.dir.c_str(),
+                 std::string(btpu::to_string(server.store().durability_status())).c_str());
+    return 2;
+  }
   if (!follow.empty()) server.set_follower(true);
   if (server.start() != btpu::ErrorCode::OK) {
     std::fprintf(stderr, "bb-coord: failed to listen on %s:%u\n", host.c_str(), port);

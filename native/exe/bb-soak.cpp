@@ -11,10 +11,23 @@
 //     writers' live-set size.
 // Intended to run under TSan (build-tsan/bb-soak): the clean run is the
 // data-race check the single-shot tests cannot give.
+//
+// --kill9 swaps the in-process worker chaos for PROCESS-death chaos: a
+// single-threaded parent forks a child cluster (keystone + coordinator +
+// workers in one process, durable coordinator dir), SIGKILLs it mid-traffic
+// at random moments, restarts a fresh child on the SAME dir, and repeats;
+// the final cycle runs the recovery invariant checker (chaos_common.h —
+// zero acked-object loss, no fabricated state, clean accounting). This is
+// the kill -9 half of ROADMAP item 5's "no lost acked objects under chaos".
+#include <sys/wait.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <chrono>
+#include <csignal>
 #include <cstdio>
 #include <cstring>
+#include <filesystem>
 #include <mutex>
 #include <random>
 #include <thread>
@@ -22,6 +35,7 @@
 
 #include "btpu/client/embedded.h"
 #include "btpu/common/thread_annotations.h"
+#include "chaos_common.h"
 #include "tsan_clockwait_shim.h"
 #include "tsan_rma_suppression.h"
 
@@ -80,19 +94,122 @@ struct LiveSet {
 
 }  // namespace
 
+// ---- kill -9 chaos (process-death durability soak) -------------------------
+//
+// Parent stays single-threaded (fork-safe under tsan); each cycle's child
+// runs the whole cluster over the shared durable dir and dies by SIGKILL at
+// a random moment mid-traffic. The final child replays the oracle and runs
+// the recovery invariant checker.
+namespace {
+
+client::EmbeddedClusterOptions kill9_options(const std::string& dir) {
+  auto options = client::EmbeddedClusterOptions::simple(2, 32ull << 20);
+  options.durability.dir = dir;
+  options.durability.compact_every = 64;  // several compactions per cycle
+  return options;
+}
+
+[[noreturn]] void kill9_traffic_child(const std::string& dir, uint64_t cycle, uint64_t seed) {
+  client::EmbeddedCluster cluster(kill9_options(dir));
+  if (cluster.start() != ErrorCode::OK) {
+    std::fprintf(stderr, "soak: kill9 child cluster start failed (cycle %llu)\n",
+                 (unsigned long long)cycle);
+    ::_exit(3);
+  }
+  // Effectively unbounded: the parent's SIGKILL ends this child.
+  chaos::run_traffic(cluster, dir, cycle, /*threads=*/2, /*ops_per_thread=*/1'000'000,
+                     /*max_seconds=*/3600, seed + cycle);
+  cluster.stop();
+  ::_exit(0);
+}
+
+[[noreturn]] void kill9_verify_child(const std::string& dir) {
+  client::EmbeddedCluster cluster(kill9_options(dir));
+  if (cluster.start() != ErrorCode::OK) {
+    std::fprintf(stderr, "soak: RECOVERY REFUSED after kill -9 chaos\n");
+    ::_exit(2);
+  }
+  const bool ok = chaos::check_recovery(cluster, dir);
+  cluster.stop();
+  ::_exit(ok ? 0 : 1);
+}
+
+int run_kill9(int seconds, uint64_t seed, std::string dir) {
+  if (dir.empty()) dir = "/tmp/bb-soak-kill9." + std::to_string(::getpid());
+  std::error_code fs_ec;
+  std::filesystem::remove_all(dir, fs_ec);
+  std::filesystem::create_directories(dir, fs_ec);
+  std::printf("soak: kill9 mode, durable dir %s\n", dir.c_str());
+
+  std::mt19937_64 rng(seed);
+  const auto deadline = Clock::now() + std::chrono::seconds(seconds);
+  uint64_t cycle = 0;
+  int kills = 0;
+  while (Clock::now() < deadline) {
+    ++cycle;
+    const pid_t pid = ::fork();
+    if (pid == 0) kill9_traffic_child(dir, cycle, seed);
+    if (pid < 0) {
+      std::fprintf(stderr, "soak: fork failed (errno %d)\n", errno);
+      return 1;
+    }
+    // Let traffic flow (long enough to span compactions and group-commit
+    // windows), then kill -9 mid-flight.
+    std::this_thread::sleep_for(std::chrono::milliseconds(400 + rng() % 1600));
+    ::kill(pid, SIGKILL);
+    int status = 0;
+    ::waitpid(pid, &status, 0);
+    if (WIFSIGNALED(status) && WTERMSIG(status) == SIGKILL) {
+      ++kills;
+    } else if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) {
+      // Exited 0 = finished its op budget before the kill (fine); anything
+      // else means the cluster could not even run on the recovered dir.
+      std::fprintf(stderr, "soak: kill9 child died wrong (status %d)\n", status);
+      return 1;
+    }
+  }
+  const pid_t vpid = ::fork();
+  if (vpid == 0) kill9_verify_child(dir);
+  int status = 0;
+  ::waitpid(vpid, &status, 0);
+  const bool verified = WIFEXITED(status) && WEXITSTATUS(status) == 0;
+  std::printf("soak: kill9 %llu cycles, %d SIGKILLs, recovery check %s\n",
+              (unsigned long long)cycle, kills, verified ? "OK" : "FAILED");
+  if (!verified || kills == 0) {
+    std::fprintf(stderr, "soak FAILED\n");
+    return 1;
+  }
+  std::filesystem::remove_all(dir, fs_ec);
+  std::printf("soak OK\n");
+  return 0;
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
   int seconds = 60;
   uint64_t seed = 42;
   bool slow_worker = false;
+  bool kill9 = false;
+  std::string kill9_dir;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--seconds") && i + 1 < argc) seconds = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--seed") && i + 1 < argc) seed = std::stoull(argv[++i]);
     else if (!std::strcmp(argv[i], "--slow-worker")) slow_worker = true;
+    else if (!std::strcmp(argv[i], "--kill9")) kill9 = true;
+    else if (!std::strcmp(argv[i], "--dir") && i + 1 < argc) kill9_dir = argv[++i];
     else if (!std::strcmp(argv[i], "--help")) {
-      std::printf("usage: bb-soak [--seconds N] [--seed S] [--slow-worker]\n");
+      std::printf("usage: bb-soak [--seconds N] [--seed S] [--slow-worker]\n"
+                  "               [--kill9 [--dir D]]\n"
+                  "  --kill9  process-death chaos: SIGKILL + restart the cluster\n"
+                  "           process on a durable dir mid-traffic; end-state runs\n"
+                  "           the recovery invariant checker (no lost acked objects)\n");
       return 0;
     }
   }
+  // kill9 forks its children BEFORE any thread exists in this process (the
+  // embedded cluster would start threads), so it must run first.
+  if (kill9) return run_kill9(seconds, seed, kill9_dir);
 
   auto options = client::EmbeddedClusterOptions::simple(4, 64ull << 20);
   options.keystone.scrub_interval_sec = 3600;  // driven by the chaos thread
@@ -266,8 +383,15 @@ int main(int argc, char** argv) {
   chaos.join();
 
   // Settle: every worker alive, give repair/health a few beats to converge.
+  // A revive failure here is a FAILED soak, not a shrug: the end-state
+  // invariants assume full strength, and a cluster that cannot be restored
+  // is exactly the regression this harness exists to catch.
   for (size_t i = 0; i < cluster.worker_count(); ++i) {
-    if (!cluster.worker_alive(i)) (void)cluster.revive_worker(i);  // retried next chaos round
+    if (cluster.worker_alive(i)) continue;
+    if (auto ec = cluster.revive_worker(i); ec != ErrorCode::OK) {
+      fail("end-state revive failed",
+           "worker " + std::to_string(i) + ": " + std::string(to_string(ec)));
+    }
   }
   std::this_thread::sleep_for(std::chrono::seconds(3));
 

@@ -1,0 +1,373 @@
+// Shared machinery for the process-death harnesses: bb-crash (deterministic
+// crash-point matrix) and bb-soak --kill9 (randomized SIGKILL chaos). Both
+// follow the same shape — a single-threaded parent forks a child cluster
+// over a durable data dir, the child dies mid-traffic (at a labeled crash
+// point, or under kill -9), a fresh child restarts on the SAME dir and runs
+// the recovery invariant checker below.
+//
+// THE ORACLE. Each writer thread appends intent/outcome lines to its own
+// file under the chaos dir (oracle.<cycle>.<thread>.log):
+//
+//   I <id> put <key> <size> <salt>   intent, written BEFORE the mutation
+//   I <id> del <key> 0 0
+//   A <id>                           ack    — server returned OK
+//   F <id>                           failed — server REFUSED (fail-closed)
+//
+// Plain write() is durable across PROCESS death (the page cache survives
+// _exit and SIGKILL; only machine death loses it) and the ack line lands
+// strictly AFTER the server's ack, so the oracle only under-approximates
+// acked state — which keeps the checker sound. Keys are unique per thread,
+// so one file totally orders each key's history.
+//
+// RECOVERY INVARIANTS (check_recovery):
+//   1. zero acked-object loss — a key whose last decided op was an acked
+//      put reads back bit-exact; an acked del stays deleted;
+//   2. no fabricated state — the only other legal outcome for a key is the
+//      post-state of its (at most one) in-flight op at death: an
+//      unacked-but-durable mutation is legal, invented or torn bytes never;
+//   3. consistent bookkeeping — every surfaced chaos object matches the
+//      oracle universe, inline-tier byte accounting equals the recovered
+//      set, and the persist-retry backlog is drained.
+#pragma once
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cinttypes>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "btpu/client/embedded.h"
+#include "btpu/common/crc32c.h"
+
+namespace chaos {
+
+using namespace btpu;
+
+// Deterministic payload: the checker re-derives exact bytes from the
+// oracle's (key, salt, size) with no stored data.
+inline std::vector<uint8_t> pattern(const std::string& key, uint64_t salt, uint64_t size) {
+  std::vector<uint8_t> data(size);
+  uint64_t h = fnv1a64(key) ^ (salt * 0x9E3779B97F4A7C15ull + 1);
+  for (uint64_t i = 0; i < size; ++i) {
+    h = h * 6364136223846793005ull + 1442695040888963407ull;
+    data[i] = static_cast<uint8_t>(h >> 56);
+  }
+  return data;
+}
+
+// ---- writer side -----------------------------------------------------------
+
+class Oracle {
+ public:
+  Oracle(const std::string& dir, uint64_t cycle, int thread_idx) {
+    const std::string path =
+        dir + "/oracle." + std::to_string(cycle) + "." + std::to_string(thread_idx) + ".log";
+    fd_ = ::open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    next_id_ = cycle * 1'000'000ull + static_cast<uint64_t>(thread_idx) * 100'000ull;
+  }
+  ~Oracle() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  uint64_t intent(bool is_del, const std::string& key, uint64_t size, uint64_t salt) {
+    const uint64_t id = ++next_id_;
+    char line[512];
+    const int n = std::snprintf(line, sizeof(line), "I %" PRIu64 " %s %s %" PRIu64 " %" PRIu64 "\n",
+                                id, is_del ? "del" : "put", key.c_str(), size, salt);
+    write_line(line, n);
+    return id;
+  }
+  void ack(uint64_t id) { outcome('A', id); }
+  void fail(uint64_t id) { outcome('F', id); }
+
+ private:
+  void outcome(char tag, uint64_t id) {
+    char line[64];
+    const int n = std::snprintf(line, sizeof(line), "%c %" PRIu64 "\n", tag, id);
+    write_line(line, n);
+  }
+  void write_line(const char* s, int n) {
+    if (fd_ >= 0 && n > 0) {
+      // One write() per line; no fsync needed for process-death semantics.
+      if (::write(fd_, s, static_cast<size_t>(n)) != n) {
+        std::fprintf(stderr, "chaos: oracle write failed (errno %d)\n", errno);
+        ::close(fd_);
+        fd_ = -1;
+      }
+    }
+  }
+  int fd_{-1};
+  uint64_t next_id_{0};
+};
+
+// ---- checker side ----------------------------------------------------------
+
+enum class Outcome { kAcked, kFailed, kUnknown };
+struct Op {
+  uint64_t id{0};
+  bool is_del{false};
+  std::string key;
+  uint64_t size{0};
+  uint64_t salt{0};
+  Outcome outcome{Outcome::kUnknown};
+};
+
+// Reads every oracle file under `dir` (any cycle, any thread), resolving
+// outcomes. Per-file op order is preserved, which totally orders each key
+// (a key lives in exactly one file). A torn final line is ignored.
+inline std::vector<Op> load_oracle(const std::string& dir) {
+  std::vector<Op> ops;
+  std::map<uint64_t, size_t> by_id;
+  std::vector<std::string> files;
+  {
+    // Deterministic order (cycle then thread): names sort lexicographically
+    // within one harness run's zero-free numbering.
+    DIR* d = ::opendir(dir.c_str());
+    if (!d) return ops;
+    while (dirent* e = ::readdir(d)) {
+      const std::string name = e->d_name;
+      if (name.rfind("oracle.", 0) == 0) files.push_back(dir + "/" + name);
+    }
+    ::closedir(d);
+    std::sort(files.begin(), files.end());
+  }
+  for (const auto& path : files) {
+    std::ifstream in(path);
+    std::string line;
+    while (std::getline(in, line)) {
+      std::istringstream ls(line);
+      char tag = 0;
+      uint64_t id = 0;
+      if (!(ls >> tag >> id)) continue;  // torn/garbage line: skip
+      if (tag == 'I') {
+        Op op;
+        op.id = id;
+        std::string kind;
+        if (!(ls >> kind >> op.key >> op.size >> op.salt)) continue;
+        op.is_del = kind == "del";
+        by_id[id] = ops.size();
+        ops.push_back(std::move(op));
+      } else if (tag == 'A' || tag == 'F') {
+        auto it = by_id.find(id);
+        if (it != by_id.end())
+          ops[it->second].outcome = tag == 'A' ? Outcome::kAcked : Outcome::kFailed;
+      }
+    }
+  }
+  return ops;
+}
+
+// One legal end state for a key: absent, or a (size, salt) pattern.
+struct KeyState {
+  bool exists{false};
+  uint64_t size{0};
+  uint64_t salt{0};
+};
+
+// Walks one key's op history into the set of legal post-crash states:
+// every acked op COLLAPSES the set to its post-state (acked == durable),
+// a failed op leaves it unchanged (fail-closed), and an unknown op — the
+// at-most-one in-flight at death — ADDS its post-state.
+inline std::vector<KeyState> legal_states(const std::vector<const Op*>& history) {
+  std::vector<KeyState> possible{KeyState{}};  // starts absent
+  for (const Op* op : history) {
+    KeyState post;
+    if (!op->is_del) post = KeyState{true, op->size, op->salt};
+    switch (op->outcome) {
+      case Outcome::kAcked:
+        possible.assign(1, post);
+        break;
+      case Outcome::kFailed:
+        break;
+      case Outcome::kUnknown:
+        possible.push_back(post);
+        break;
+    }
+  }
+  return possible;
+}
+
+// The recovery invariant checker. `cluster` is freshly started over the
+// chaos dir; returns true when every invariant holds (failures printed).
+inline bool check_recovery(client::EmbeddedCluster& cluster, const std::string& dir) {
+  const auto ops = load_oracle(dir);
+  std::map<std::string, std::vector<const Op*>> by_key;
+  for (const auto& op : ops) by_key[op.key].push_back(&op);
+
+  auto client = cluster.make_client();
+  bool ok = true;
+  size_t existing = 0, acked_checked = 0;
+  uint64_t inline_bytes = 0;
+  for (const auto& [key, history] : by_key) {
+    const auto possible = legal_states(history);
+    auto got = client->get(key, /*verify=*/true);
+    KeyState actual;
+    if (got.ok()) {
+      actual.exists = true;
+      actual.size = got.value().size();
+    } else if (got.error() != ErrorCode::OBJECT_NOT_FOUND) {
+      std::fprintf(stderr, "chaos CHECK FAIL: %s unreadable after recovery: %s\n",
+                   key.c_str(), std::string(to_string(got.error())).c_str());
+      ok = false;
+      continue;
+    }
+    bool matched = false;
+    for (const auto& state : possible) {
+      if (state.exists != actual.exists) continue;
+      if (!state.exists) {
+        matched = true;
+        break;
+      }
+      if (state.size == actual.size && got.value() == pattern(key, state.salt, state.size)) {
+        matched = true;
+        break;
+      }
+    }
+    if (!matched) {
+      // Classify for the report: lost ack vs fabricated/wrong bytes.
+      const bool must_exist = possible.size() == 1 && possible.front().exists;
+      const bool must_be_gone = possible.size() == 1 && !possible.front().exists;
+      std::fprintf(stderr,
+                   "chaos CHECK FAIL: %s %s after recovery (%zu legal states)\n", key.c_str(),
+                   !actual.exists && must_exist ? "LOST AN ACKED PUT"
+                   : actual.exists && must_be_gone
+                       ? "RESURRECTED AN ACKED DELETE"
+                       : "holds bytes matching NO intended state",
+                   possible.size());
+      ok = false;
+      continue;
+    }
+    if (actual.exists) {
+      ++existing;
+      inline_bytes += actual.size;
+    }
+    if (possible.size() == 1) ++acked_checked;
+  }
+
+  // No fabricated keys: everything the keystone surfaces must come from the
+  // oracle universe (the chaos dir belongs to this harness alone).
+  auto listed = cluster.keystone().list_objects("");
+  if (!listed.ok()) {
+    std::fprintf(stderr, "chaos CHECK FAIL: list_objects failed after recovery\n");
+    ok = false;
+  } else {
+    for (const auto& summary : listed.value()) {
+      if (!by_key.contains(summary.key)) {
+        std::fprintf(stderr, "chaos CHECK FAIL: fabricated object '%s' surfaced\n",
+                     summary.key.c_str());
+        ok = false;
+      }
+    }
+    if (listed.value().size() != existing) {
+      std::fprintf(stderr,
+                   "chaos CHECK FAIL: keystone lists %zu objects, oracle accounts for %zu\n",
+                   listed.value().size(), existing);
+      ok = false;
+    }
+  }
+  // Inline accounting must equal the recovered set exactly (the whole chaos
+  // write load is inline-tier).
+  if (cluster.keystone().inline_bytes_resident() != inline_bytes) {
+    std::fprintf(stderr,
+                 "chaos CHECK FAIL: inline_bytes_resident %" PRIu64
+                 " != recovered inline set %" PRIu64 "\n",
+                 cluster.keystone().inline_bytes_resident(), inline_bytes);
+    ok = false;
+  }
+  // A clean recovery owes nothing: the deferred-persist backlog starts empty.
+  if (cluster.keystone().persist_retry_backlog() != 0) {
+    std::fprintf(stderr, "chaos CHECK FAIL: persist-retry backlog nonzero after recovery\n");
+    ok = false;
+  }
+  std::printf("chaos check: %zu keys (%zu fully decided), %zu objects, %" PRIu64
+              " inline bytes — %s\n",
+              by_key.size(), acked_checked, existing, inline_bytes, ok ? "OK" : "FAILED");
+  return ok;
+}
+
+// ---- traffic side ----------------------------------------------------------
+
+// Inline-tier chaos load: put / overwrite (del+put) / del on per-thread
+// keys, every op logged through the oracle. Runs until ops_per_thread ops
+// or the deadline; returns early if the cluster dies under it (the caller
+// decides whether that is expected). Object sizes stay inline-eligible
+// (<= 2 KiB) and TTL 0: durability is exactly the coordinator WAL, and
+// nothing may legally expire.
+inline void run_traffic(client::EmbeddedCluster& cluster, const std::string& dir,
+                        uint64_t cycle, int threads, int ops_per_thread,
+                        int64_t max_seconds, uint64_t seed) {
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(max_seconds);
+  std::vector<std::thread> writers;
+  for (int t = 0; t < threads; ++t) {
+    writers.emplace_back([&, t] {
+      Oracle oracle(dir, cycle, t);
+      if (!oracle.ok()) return;
+      auto client = cluster.make_client();
+      std::mt19937_64 rng(seed * 1315423911ull + static_cast<uint64_t>(t));
+      WorkerConfig wc;
+      wc.ttl_ms = 0;  // never expires: recovery owes every acked object
+      // The inline tier refuses explicit multi-replica intent; chaos load
+      // is single-copy BY DESIGN — durability is the coordinator WAL, not
+      // replication (RAM pool bytes die with the process anyway).
+      wc.replication_factor = 1;
+      wc.max_workers_per_copy = 1;
+      for (int n = 0; n < ops_per_thread; ++n) {
+        if (std::chrono::steady_clock::now() >= deadline) break;
+        // ~3 generations per key: create, overwrite, delete histories all
+        // get exercised, and earlier cycles' keys stay frozen as regression
+        // state for repeated recoveries.
+        const std::string key = "chaos/" + std::to_string(cycle) + "/" + std::to_string(t) +
+                                "/" + std::to_string(n / 3);
+        const int gen = n % 3;
+        if (gen == 2 && rng() % 2 == 0) {
+          const uint64_t id = oracle.intent(true, key, 0, 0);
+          const auto ec = cluster.keystone().remove_object(key);
+          if (ec == ErrorCode::OK) oracle.ack(id);
+          else oracle.fail(id);
+          continue;
+        }
+        const uint64_t size = 64 + rng() % 1984;
+        const uint64_t salt = static_cast<uint64_t>(n) + 1;
+        const auto data = pattern(key, salt, size);
+        if (gen > 0) {
+          // Overwrite = acked delete + fresh put (put_inline refuses
+          // existing keys by design).
+          const uint64_t del_id = oracle.intent(true, key, 0, 0);
+          const auto del_ec = cluster.keystone().remove_object(key);
+          if (del_ec == ErrorCode::OK) oracle.ack(del_id);
+          else oracle.fail(del_id);
+          if (del_ec != ErrorCode::OK && del_ec != ErrorCode::OBJECT_NOT_FOUND) continue;
+        }
+        const uint64_t id = oracle.intent(false, key, size, salt);
+        const auto ec = cluster.keystone().put_inline(
+            key, wc, crc32c(data.data(), data.size()),
+            std::string(reinterpret_cast<const char*>(data.data()), data.size()));
+        if (ec == ErrorCode::OK) oracle.ack(id);
+        else oracle.fail(id);
+        // Read-back pressure on a sibling key keeps the get path live under
+        // the same churn (failures here are the checker's job post-crash).
+        if (n % 4 == 3) {
+          const std::string probe = "chaos/" + std::to_string(cycle) + "/" +
+                                    std::to_string(t) + "/" + std::to_string(rng() % (n / 3 + 1));
+          (void)client->get(probe, /*verify=*/true);
+        }
+      }
+    });
+  }
+  for (auto& w : writers) w.join();
+}
+
+}  // namespace chaos

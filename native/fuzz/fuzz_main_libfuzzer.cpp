@@ -5,7 +5,7 @@
 #include "fuzz_targets.h"
 
 #ifndef BTPU_FUZZ_TARGET
-#error "build with -DBTPU_FUZZ_TARGET=rpc_frame|control_error|tcp_header|record"
+#error "build with -DBTPU_FUZZ_TARGET=rpc_frame|control_error|tcp_header|record|wal_record"
 #endif
 
 #define BTPU_FUZZ_CAT_(a, b) a##b

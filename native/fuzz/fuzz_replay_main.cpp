@@ -260,6 +260,80 @@ void gen_seeds(const std::string& root) {
     w3.put<uint32_t>(0xDEAD);
     emit("record", "future_format", with_sel(2, w3.take()));
   }
+  // wal_record: whole coordinator-WAL file images. Valid chains, the torn
+  // shapes recovery must truncate, and the chained-CRC-break / rotten-length
+  // shapes it must REFUSE (hard-fail classification is the regression
+  // surface here), plus legacy / future-version dispatch.
+  {
+    namespace wal = btpu::coord::wal;
+    auto record_payload = [](uint8_t type, const char* key, const char* value) {
+      wire::Writer w;
+      w.put<uint8_t>(type);
+      wire::encode(w, std::string(key));
+      wire::encode(w, std::string(value));
+      w.put<int64_t>(0);
+      return w.take();
+    };
+    std::vector<uint8_t> valid;
+    uint32_t chain = wal::kChainSeed;
+    wal::append_file_header(valid);
+    const auto r1 = record_payload(1, "/k/a", "v1");
+    const auto r2 = record_payload(1, "/k/b", "v2");
+    const auto r3 = record_payload(2, "/k/a", "");
+    wal::append_record(valid, chain, r1.data(), r1.size());
+    wal::append_record(valid, chain, r2.data(), r2.size());
+    wal::append_record(valid, chain, r3.data(), r3.size());
+    emit("wal_record", "valid_chain", valid);
+    emit("wal_record", "header_only",
+         std::vector<uint8_t>(valid.begin(), valid.begin() + sizeof(wal::FileHeader)));
+    emit("wal_record", "empty", {});
+    {  // torn record header (4 stray bytes after the last record)
+      auto v = valid;
+      v.insert(v.end(), {0x10, 0x00, 0x00, 0x00});
+      emit("wal_record", "torn_header", v);
+    }
+    {  // torn payload: full header promising more bytes than exist
+      auto v = valid;
+      uint32_t c2 = chain;
+      const auto r4 = record_payload(1, "/k/torn", "vvvv");
+      wal::append_record(v, c2, r4.data(), r4.size());
+      v.resize(v.size() - 3);
+      emit("wal_record", "torn_payload", v);
+    }
+    {  // torn FILE header (the 8-byte header write itself tore)
+      emit("wal_record", "torn_file_header",
+           std::vector<uint8_t>(valid.begin(), valid.begin() + 5));
+    }
+    {  // chained-CRC break mid-log: one flipped payload byte = REFUSE
+      auto v = valid;
+      v[sizeof(wal::FileHeader) + sizeof(wal::RecordHeader) + 2] ^= 0x40;
+      emit("wal_record", "chain_break_midlog", v);
+    }
+    {  // rotten length field mid-log (complete header, impossible len)
+      auto v = valid;
+      const uint32_t bad = 0xFFFFFFFFu;
+      std::memcpy(v.data() + sizeof(wal::FileHeader), &bad, sizeof(bad));
+      emit("wal_record", "rotten_length_midlog", v);
+    }
+    {  // future journal version: refuse, never truncate
+      auto v = valid;
+      const uint32_t future = wal::kFileVersion + 1;
+      std::memcpy(v.data() + sizeof(uint32_t), &future, sizeof(future));
+      emit("wal_record", "future_version", v);
+    }
+    {  // legacy pre-chain journal ([u32 len][payload], no header, no CRC)
+      std::vector<uint8_t> legacy;
+      for (const auto* rec : {&r1, &r2, &r3}) {
+        const uint32_t len = static_cast<uint32_t>(rec->size());
+        const uint8_t* lp = reinterpret_cast<const uint8_t*>(&len);
+        legacy.insert(legacy.end(), lp, lp + sizeof(len));
+        legacy.insert(legacy.end(), rec->begin(), rec->end());
+      }
+      emit("wal_record", "legacy_journal", legacy);
+      legacy.resize(legacy.size() - 2);  // legacy torn tail
+      emit("wal_record", "legacy_torn", legacy);
+    }
+  }
   std::printf("seed corpus written under %s\n", root.c_str());
 }
 

@@ -7,6 +7,9 @@
 //   tcp_header     raw TCP DataRequestHeader / StagedFrame (data_wire.h)
 //   record         WAL/persist records: worker info, pool record, object
 //                  record (envelope dispatch + all legacy layouts)
+//   wal_record     coordinator WAL v2 scanner (wal_format.h): chain-CRC
+//                  classification (clean / torn tail / corrupt / legacy /
+//                  future) + an append/scan round-trip invariant
 //
 // Header-only on purpose: the SAME functions compile into (a) the libFuzzer
 // harness (scripts/fuzz.sh under clang), (b) the gcc corpus-replay binary
@@ -29,6 +32,7 @@
 #include <vector>
 
 #include "btpu/common/wire.h"
+#include "btpu/coord/wal_format.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/rpc/rpc.h"
 #include "btpu/transport/data_wire.h"
@@ -150,6 +154,74 @@ inline int run_record(const uint8_t* data, size_t size) {
   return 0;
 }
 
+// ---- wal_record ------------------------------------------------------------
+// Input = a whole WAL file image. The scanner is what coordinator crash
+// recovery trusts to separate "truncate and heal" from "refuse to serve",
+// so its classification invariants are pinned here:
+//   * every intact record lies inside the input and inside valid_end;
+//   * kClean accounts for every byte; torn/corrupt valid_end never exceeds
+//     the damage point;
+//   * re-appending the scanned records through the SAME framing (fresh
+//     header + chained CRCs) must scan back kClean with identical payloads
+//     (append/replay round-trip).
+inline int run_wal_record(const uint8_t* data, size_t size) {
+  using namespace btpu::coord;
+  const wal::ScanResult scanned = wal::scan(data, size);
+  fuzz_expect(scanned.valid_end <= size, "wal scan valid_end must stay in bounds");
+  size_t prev_end = sizeof(wal::FileHeader);
+  for (const auto& [off, len] : scanned.records) {
+    fuzz_expect(off >= sizeof(wal::FileHeader) + sizeof(wal::RecordHeader) &&
+                    off + len <= size && off + len <= scanned.valid_end,
+                "wal scan record must lie inside the intact prefix");
+    fuzz_expect(off == prev_end + sizeof(wal::RecordHeader),
+                "wal scan records must tile the file densely");
+    prev_end = off + len;
+  }
+  switch (scanned.status) {
+    case wal::ScanStatus::kClean:
+      fuzz_expect(size == 0 || scanned.valid_end == size,
+                  "a clean scan must account for every byte");
+      break;
+    case wal::ScanStatus::kTornTail:
+    case wal::ScanStatus::kCorrupt:
+      fuzz_expect(scanned.valid_end < size, "damage verdicts require surplus bytes");
+      break;
+    case wal::ScanStatus::kLegacy: {
+      // Legacy files replay through the pre-chain rules: same bounds
+      // invariants, no chain to verify.
+      const wal::ScanResult legacy = wal::scan_legacy(data, size);
+      fuzz_expect(legacy.valid_end <= size, "legacy scan valid_end must stay in bounds");
+      for (const auto& [off, len] : legacy.records)
+        fuzz_expect(off + len <= size, "legacy record must stay in bounds");
+      break;
+    }
+    case wal::ScanStatus::kFuture:
+      break;
+  }
+  // Round trip: rebuild a fresh journal from the recovered payloads; it
+  // must scan clean with the records byte-identical.
+  if (!scanned.records.empty()) {
+    std::vector<uint8_t> rebuilt;
+    uint32_t chain = wal::kChainSeed;
+    wal::append_file_header(rebuilt);
+    for (const auto& [off, len] : scanned.records)
+      wal::append_record(rebuilt, chain, data + off, len);
+    const wal::ScanResult again = wal::scan(rebuilt.data(), rebuilt.size());
+    fuzz_expect(again.status == wal::ScanStatus::kClean,
+                "re-appended journal must scan clean");
+    fuzz_expect(again.records.size() == scanned.records.size(),
+                "re-appended journal must keep every record");
+    for (size_t i = 0; i < again.records.size(); ++i) {
+      const auto& [aoff, alen] = again.records[i];
+      const auto& [soff, slen] = scanned.records[i];
+      fuzz_expect(alen == slen &&
+                      std::memcmp(rebuilt.data() + aoff, data + soff, slen) == 0,
+                  "re-appended record must be byte-identical");
+    }
+  }
+  return 0;
+}
+
 // ---- registry --------------------------------------------------------------
 using FuzzFn = int (*)(const uint8_t*, size_t);
 struct FuzzTarget {
@@ -161,6 +233,7 @@ inline constexpr FuzzTarget kFuzzTargets[] = {
     {"control_error", run_control_error},
     {"tcp_header", run_tcp_header},
     {"record", run_record},
+    {"wal_record", run_wal_record},
 };
 
 }  // namespace btpu_fuzz

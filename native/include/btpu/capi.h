@@ -20,6 +20,15 @@ btpu_cluster* btpu_cluster_create(uint32_t n_workers, uint64_t pool_bytes,
 // tiering tests from Python. device_bytes may be 0 to skip the device pool.
 btpu_cluster* btpu_cluster_create_tiered(uint32_t n_workers, uint64_t device_bytes,
                                          uint64_t host_bytes);
+/* btpu_cluster_create + durability: data_dir (may be NULL/"" = memory-only)
+ * arms the embedded coordinator's WAL+snapshot persistence, so a new
+ * cluster created on the SAME dir recovers every acked durable object
+ * (inline tier; RAM pool bytes die with the process by design).
+ * group_commit_us: WAL group-commit window — 0 = fdatasync per record,
+ * >0 = batch under one fdatasync, <0 = $BTPU_WAL_GROUP_COMMIT_US/500. */
+btpu_cluster* btpu_cluster_create_ex(uint32_t n_workers, uint64_t pool_bytes,
+                                     uint32_t storage_class, uint32_t transport,
+                                     const char* data_dir, int64_t group_commit_us);
 void btpu_cluster_destroy(btpu_cluster* cluster);
 int32_t btpu_cluster_kill_worker(btpu_cluster* cluster, uint32_t index);
 uint32_t btpu_cluster_worker_count(btpu_cluster* cluster);
@@ -129,6 +138,10 @@ uint64_t btpu_hedge_fired_count(void);              /* client: hedges started */
 uint64_t btpu_hedge_win_count(void);                /* client: hedge beat primary */
 uint64_t btpu_breaker_trip_count(void);             /* client: breakers opened */
 uint64_t btpu_breaker_skip_count(void);             /* client: open-endpoint deprioritizations */
+/* Durability-lag backlog: objects whose durable record write is deferred
+ * and retrying (sum over every in-process keystone). Sustained nonzero =
+ * acked vs durable state diverged; alert (docs/OPERATIONS.md). */
+uint64_t btpu_persist_retry_backlog(void);
 
 /* ---- client object cache (lease-coherent, btpu/cache/object_cache.h) -----
  * cache_bytes > 0 arms a client-side cache of verified object bytes:

@@ -17,6 +17,11 @@ struct EmbeddedClusterOptions {
   std::vector<worker::WorkerServiceConfig> workers;
   bool use_coordinator{true};  // in-memory coordinator wiring vs direct feed
   TransportKind transport{TransportKind::LOCAL};
+  // Coordinator persistence (WAL + snapshot under durability.dir): a new
+  // cluster started on the SAME dir recovers every acked durable object —
+  // inline-tier bytes ride the records; RAM pool bytes die with the
+  // process by design. Requires use_coordinator. Empty dir = memory-only.
+  coord::DurabilityOptions durability;
 
   // Convenience: n workers x one RAM pool of pool_bytes each.
   static EmbeddedClusterOptions simple(size_t n_workers, uint64_t pool_bytes,

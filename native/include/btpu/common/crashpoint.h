@@ -1,0 +1,98 @@
+// Deterministic crash-point injection for the durability matrix.
+//
+// A crash point is a labeled site on the WAL / snapshot / persist / ack
+// sequence. Arming one (BTPU_CRASHPOINT=<label>[:N]) makes the process
+// _exit(kExitCode) the Nth time execution reaches that label — no atexit
+// handlers, no stream flushing, no destructors: the closest a process can
+// get to kill -9'ing itself at an exact instruction. bb-crash forks a child
+// cluster per label, lets it die there under live traffic, restarts on the
+// same data dir, and runs the recovery invariant checker
+// (docs/CORRECTNESS.md §crash-point catalog).
+//
+// Disarmed cost is one pointer-load + compare per site: the env var is
+// parsed once, and sites off the armed label return after a strcmp against
+// a <=63-byte local buffer. Sites sit on durability slow paths (append,
+// fsync, snapshot, persist), never on per-byte data paths.
+#pragma once
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+
+#include "btpu/common/env.h"
+
+namespace btpu::crashpoint {
+
+// The child's exit code when a crash point fires (mirrors 128+SIGKILL so a
+// harness can treat "crashed here" and "kill -9" the same way).
+inline constexpr int kExitCode = 137;
+
+// Every labeled site, in rough execution order along the durability path.
+// Harnesses iterate this list — a new site MUST be added here or the matrix
+// silently stops covering it (pinned by test_common.cpp CrashPointCatalog).
+inline constexpr const char* kAll[] = {
+    "wal.mid_append",         // record header written, payload not yet (torn tail)
+    "wal.after_append",       // record fully in the file, not yet fdatasync'd
+    "wal.before_sync",        // syncer about to fdatasync the batch
+    "wal.after_sync",         // batch durable, waiters not yet released
+    "snapshot.before_tmp",    // compaction about to write snapshot.bin.tmp
+    "snapshot.before_rename", // tmp written + fsync'd, rename not yet issued
+    "snapshot.after_rename",  // snapshot live, WAL not yet truncated
+    "snapshot.after_truncate",// WAL reborn, fresh header written
+    "persist.before_record",  // keystone about to write the durable object record
+    "persist.after_record",   // durable record acked by the coordinator
+    "persist.after_ack",      // mutation committed, ack about to reach the client
+};
+
+namespace detail {
+struct Spec {
+  bool armed{false};
+  char label[64]{};
+  std::atomic<long> remaining{1};
+};
+
+inline void parse(Spec& s) {
+  s.armed = false;
+  const char* v = env_str("BTPU_CRASHPOINT");
+  if (!v) return;
+  const char* colon = std::strchr(v, ':');
+  const size_t n = colon ? static_cast<size_t>(colon - v) : std::strlen(v);
+  if (n == 0 || n >= sizeof(s.label)) return;
+  std::memcpy(s.label, v, n);
+  s.label[n] = '\0';
+  const long hits = colon ? std::strtol(colon + 1, nullptr, 10) : 1;
+  s.remaining.store(hits > 0 ? hits : 1);
+  s.armed = true;
+}
+
+inline Spec& spec() {
+  static Spec s;
+  static const bool parsed = [] {
+    parse(s);
+    return true;
+  }();
+  (void)parsed;
+  return s;
+}
+}  // namespace detail
+
+// Test-only: re-read BTPU_CRASHPOINT. The spec is parsed once per process,
+// which is what production wants (harness children arm the env before
+// anything touches a crash point) — but a TEST that forks a child after
+// the parent suite already initialized the spec needs this to arm it.
+// Not thread-safe; call before the child starts threads.
+inline void reparse_for_test() { detail::parse(detail::spec()); }
+
+// Dies at the armed label's Nth hit; free otherwise. Callable from any
+// thread (the syncer, a keystone health loop, a client thread): whichever
+// thread reaches the site dies with the whole process, exactly like a
+// preemption would take it.
+inline void hit(const char* label) {
+  detail::Spec& s = detail::spec();
+  if (!s.armed || std::strcmp(s.label, label) != 0) return;
+  if (s.remaining.fetch_sub(1, std::memory_order_relaxed) == 1) ::_exit(kExitCode);
+}
+
+}  // namespace btpu::crashpoint

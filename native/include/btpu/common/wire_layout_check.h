@@ -21,6 +21,7 @@
 #include "btpu/common/error.h"
 #include "btpu/common/types.h"
 #include "btpu/coord/coord_proto.h"
+#include "btpu/coord/wal_format.h"
 #include "btpu/rpc/rpc.h"
 
 // A type whose bytes go on the wire raw (Writer::put / packed header
@@ -72,5 +73,17 @@ BTPU_WIRE_RAW_TYPE(StorageClass);
 BTPU_WIRE_RAW_TYPE(TransportKind);
 BTPU_WIRE_RAW_TYPE(coord::Op);
 BTPU_WIRE_RAW_TYPE(rpc::Method);
+
+// Coordinator WAL v2 on-disk framing (wal_format.h): raw memcpy'd headers
+// that outlive binaries — frozen like the packed TCP headers. The record
+// byte stream itself is pinned by the golden table (wal/* rows).
+BTPU_WIRE_RAW_TYPE(coord::wal::FileHeader);
+BTPU_WIRE_FROZEN_SIZEOF(coord::wal::FileHeader, 8);
+BTPU_WIRE_FROZEN_OFFSET(coord::wal::FileHeader, magic, 0);
+BTPU_WIRE_FROZEN_OFFSET(coord::wal::FileHeader, version, 4);
+BTPU_WIRE_RAW_TYPE(coord::wal::RecordHeader);
+BTPU_WIRE_FROZEN_SIZEOF(coord::wal::RecordHeader, 8);
+BTPU_WIRE_FROZEN_OFFSET(coord::wal::RecordHeader, len, 0);
+BTPU_WIRE_FROZEN_OFFSET(coord::wal::RecordHeader, chain_crc, 4);
 
 }  // namespace btpu::wire_layout

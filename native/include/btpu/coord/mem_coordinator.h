@@ -16,15 +16,30 @@ namespace btpu::coord {
 // Durability for the coordination store (the etcd-cluster role the
 // reference delegates to deployment — etcd_service.cpp wraps a durable,
 // replicated etcd; bb-coord must survive restarts on its own). State is a
-// write-ahead log + snapshot: every mutation appends a record (fsync'd by
-// default), and the log compacts into a snapshot once it grows. On load,
-// leases are re-armed to their full TTL so live owners get one refresh
-// interval to resume heartbeats before expiry fires; elections and watches
-// are session state and are re-established by reconnecting clients.
+// write-ahead log + snapshot: every mutation appends a CRC-chained record
+// (wal_format.h), and the log compacts into a snapshot once it grows. A
+// mutation acks only after the record is covered by an fdatasync — by
+// default via GROUP COMMIT: appends accumulate for a bounded window
+// (group_commit_us) and one fdatasync covers the whole batch, so the sync
+// cost amortizes across concurrent writers at UNCHANGED durability
+// (acked == durable either way). On load, leases are re-armed to their
+// full TTL so live owners get one refresh interval to resume heartbeats
+// before expiry fires; elections and watches are session state and are
+// re-established by reconnecting clients.
 struct DurabilityOptions {
   std::string dir;             // empty = memory-only (no persistence)
-  bool fsync{true};            // fsync the WAL after every record
+  bool fsync{true};            // false = never sync (tests; crash may lose acks)
   size_t compact_every{4096};  // WAL records between snapshot compactions
+  // Group-commit switch. 0 = sync-per-record (one inline fdatasync per
+  // append, the pre-group-commit behavior); >0 = leader-based group commit
+  // — acks release when a covering fdatasync lands, and the batching
+  // window is the in-flight sync's own duration (appends landing during a
+  // sync ride the next leader), so added ack delay is bounded by the
+  // storage's sync latency, never by an imposed sleep. The magnitude is
+  // advisory (kept in MICROSECONDS for forward compatibility with an
+  // explicit accumulation timer); <0 = $BTPU_WAL_GROUP_COMMIT_US,
+  // default 500.
+  int64_t group_commit_us{-1};
 };
 
 class MemCoordinator : public Coordinator {
@@ -67,6 +82,21 @@ class MemCoordinator : public Coordinator {
                        uint64_t epoch) override;
 
   bool connected() const override { return true; }
+
+  // fdatasync calls issued for WAL durability so far. The group-commit
+  // acceptance signal: syncs/mutation < 1 proves batching regardless of
+  // scheduler noise (sync-per-record mode reads ~1).
+  uint64_t wal_sync_count() const { return wal_syncs_.load(std::memory_order_relaxed); }
+
+  // Recovery verdict, set once during construction (journal_load): OK;
+  // DATA_CORRUPTION (mid-log / snapshot corruption — torn tails do NOT
+  // trip this, they are truncated and healed); INVALID_STATE (journal or
+  // snapshot written by a newer build); or COORD_ERROR (the journal cannot
+  // open/initialize, so every mutation would fail-stop anyway). Non-OK
+  // refuses every read and mutation with the same code: a store that
+  // cannot prove its state serves nothing. bb-coord checks this at startup
+  // and exits instead of serving.
+  ErrorCode durability_status() const { return journal_status_; }
 
   // ---- replication (standby bb-coord mirroring; see coord_server.h) ----
   // The sink receives every mutation record (same encoding as the WAL) with
@@ -133,8 +163,35 @@ class MemCoordinator : public Coordinator {
 
   // ---- durability (no-ops when durability_.dir is empty) ----
   void journal_load();                       // ctor only, before threads
+  // Recovery refused (corruption / future format): record why and clear
+  // every partially-recovered structure so nothing unproven is served.
+  void recovery_fail_locked(ErrorCode status) BTPU_REQUIRES(mutex_);
   void journal_append_locked(const std::vector<uint8_t>& record) BTPU_REQUIRES(mutex_);
   void journal_compact_locked() BTPU_REQUIRES(mutex_);  // snapshot + truncate WAL
+  // Leader-based group commit: after appending (and releasing mutex_), a
+  // mutator parks here until an fdatasync covers its record. The FIRST
+  // unsatisfied waiter becomes the sync leader and issues one fdatasync for
+  // everything appended so far; waiters that landed meanwhile are covered
+  // by the next leader. No handoff to a helper thread — a lone writer pays
+  // exactly one fdatasync (like sync-per-record, but without holding
+  // mutex_ across it), and under concurrency the batch grows to everyone
+  // who appended during the leader's sync. Returns FALSE when the covering
+  // sync failed (journal broken, waiters released, the mutation must NOT
+  // ack). Lock order: mutex_ -> sync_mutex_ (appends publish under both);
+  // a failing leader takes mutex_ -> sync_mutex_ for journal_break_locked
+  // while holding neither.
+  BTPU_NODISCARD bool wait_durable(uint64_t seq) BTPU_EXCLUDES(mutex_);
+  // Sequence a public mutator must wait on: the last record it appended.
+  uint64_t appended_seq_locked() const BTPU_REQUIRES(mutex_) { return wal_appended_; }
+  // Unrecoverable WAL write failure: stop journaling and release every
+  // durability waiter (persistence is loudly degraded, not wedged). The fd
+  // stays open until the destructor — the syncer may be mid-fdatasync on
+  // it, and closing would let the number be reused under that call.
+  void journal_break_locked() BTPU_REQUIRES(mutex_);
+  bool journal_write_header_locked() BTPU_REQUIRES(mutex_);
+  // Rejects values that can never fit one journal frame BEFORE any memory
+  // mutation (durability-configured stores only; framing headroom included).
+  ErrorCode check_journalable(size_t key_bytes, size_t value_bytes) const;
   std::string snapshot_path() const;
   std::string wal_path() const;
   // Journal + replication sink, every mutation goes through here.
@@ -149,8 +206,36 @@ class MemCoordinator : public Coordinator {
       BTPU_REQUIRES(mutex_);
 
   DurabilityOptions durability_;
+  int64_t group_commit_us_{0};  // resolved window (ctor; immutable after)
+  // Set once in journal_load (ctor, pre-thread), read-only afterwards.
+  ErrorCode journal_status_{ErrorCode::OK};
   int wal_fd_ BTPU_GUARDED_BY(mutex_){-1};
   size_t wal_records_ BTPU_GUARDED_BY(mutex_){0};
+  uint64_t wal_appended_ BTPU_GUARDED_BY(mutex_){0};  // records appended ever
+  uint32_t wal_chain_ BTPU_GUARDED_BY(mutex_){0};     // running chain CRC
+  bool wal_broken_ BTPU_GUARDED_BY(mutex_){false};
+  // Sticky per-mutation journal verdict: public mutators clear it before
+  // mutating and FAIL the op (COORD_ERROR) if any of their appends could
+  // not reach the journal — a durability-configured store must never ack
+  // what it cannot persist (memory-only stores never set it).
+  bool journal_op_failed_ BTPU_GUARDED_BY(mutex_){false};
+  // Group-commit rendezvous (leaf lock; see wait_durable above).
+  bool group_commit_{false};  // resolved in ctor; immutable after
+  mutable Mutex sync_mutex_ BTPU_ACQUIRED_AFTER(mutex_);
+  std::condition_variable_any sync_cv_;
+  uint64_t sync_pending_ BTPU_GUARDED_BY(sync_mutex_){0};
+  uint64_t sync_completed_ BTPU_GUARDED_BY(sync_mutex_){0};  // released waiters
+  uint64_t sync_durable_ BTPU_GUARDED_BY(sync_mutex_){0};    // PROVEN synced
+  // File offsets mirroring the seq trio: a failed covering sync ROLLS the
+  // WAL back to sync_durable_end_ before breaking the journal, so a
+  // mutation refused with COORD_ERROR cannot resurface after a restart
+  // (its record would otherwise still scan as an intact chain).
+  off_t wal_end_ BTPU_GUARDED_BY(mutex_){0};                // after last append
+  off_t sync_pending_end_ BTPU_GUARDED_BY(sync_mutex_){0};  // offset of sync_pending_
+  off_t sync_durable_end_ BTPU_GUARDED_BY(sync_mutex_){0};  // offset of sync_durable_
+  int sync_fd_ BTPU_GUARDED_BY(sync_mutex_){-1};
+  bool sync_in_flight_ BTPU_GUARDED_BY(sync_mutex_){false};
+  std::atomic<uint64_t> wal_syncs_{0};
   std::function<void(uint64_t, const std::vector<uint8_t>&)> repl_sink_ BTPU_GUARDED_BY(mutex_);
   uint64_t repl_seq_ BTPU_GUARDED_BY(mutex_){0};
   bool follower_ BTPU_GUARDED_BY(mutex_){false};

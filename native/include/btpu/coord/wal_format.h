@@ -1,0 +1,184 @@
+// Coordinator WAL v2 on-disk format: CRC-chained, length-prefixed records
+// behind a file header, torn-write-proof by construction.
+//
+//   [FileHeader: u32 magic "BTWL" | u32 version=2]
+//   [RecordHeader: u32 len | u32 chain_crc][len payload bytes]  ...repeated
+//
+// chain_crc is CRC32C of the payload SEEDED with the previous record's
+// chain_crc (kChainSeed for the first record after a header/compaction), so
+// a record is only valid in its exact position: torn appends, spliced
+// records, and bit rot all break the chain. Recovery classifies the first
+// bad byte (scan() below):
+//
+//   * torn tail   — the damage is a PARTIAL final append (short header, or
+//                   a record whose extent runs past EOF). The only writes
+//                   that can end mid-record are the crash-interrupted last
+//                   one, so truncating at the last intact record loses
+//                   nothing that was ever acked (acks wait for fdatasync,
+//                   which never covers a partial record).
+//   * corruption  — a COMPLETE record body fails its chain CRC, or a
+//                   complete header carries a length the writer could never
+//                   have produced, with bytes beyond it. That is mid-log
+//                   damage (bit rot, external truncation+append, a spliced
+//                   file): records AFTER the damage may include acked
+//                   mutations, so recovery must hard-fail, never silently
+//                   truncate (docs/OPERATIONS.md crash-recovery runbook).
+//
+// Files without the magic are pre-chain legacy WALs ([u32 len][payload]
+// with no integrity check); MemCoordinator replays them with the legacy
+// rules once, then compacts so the reborn WAL is v2. The raw header
+// layouts are frozen in wire_layout_check.h and the golden table
+// (wal/file_header, wal/record rows) — append-only rules apply.
+//
+// Header-only so the fuzz target (fuzz_targets.h run_wal_record) drives the
+// EXACT scanner recovery uses, not a copy.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <utility>
+#include <vector>
+
+#include "btpu/common/crc32c.h"
+
+namespace btpu::coord::wal {
+
+inline constexpr uint32_t kFileMagic = 0x4C575442u;  // "BTWL" little-endian
+inline constexpr uint32_t kFileVersion = 2;
+inline constexpr uint32_t kChainSeed = 0xB7C0FFEEu;  // chain value before record 1
+inline constexpr uint32_t kMaxRecordBytes = 64u << 20;
+
+struct FileHeader {
+  uint32_t magic;
+  uint32_t version;
+};
+struct RecordHeader {
+  uint32_t len;        // payload bytes following this header
+  uint32_t chain_crc;  // crc32c(payload, seed = previous record's chain_crc)
+};
+static_assert(sizeof(FileHeader) == 8 && sizeof(RecordHeader) == 8);
+
+inline uint32_t chain_next(uint32_t chain, const uint8_t* payload, size_t len) {
+  return crc32c(payload, len, chain);
+}
+
+// True when the bytes begin with the v2 magic (any version). A legacy WAL
+// cannot collide: its first 4 bytes are a record length the legacy writer
+// capped at kMaxRecordBytes, and the magic value is ~1.28e9.
+inline bool has_v2_magic(const uint8_t* data, size_t size) {
+  if (size < sizeof(uint32_t)) return false;
+  uint32_t magic = 0;
+  std::memcpy(&magic, data, sizeof(magic));
+  return magic == kFileMagic;
+}
+
+enum class ScanStatus : uint8_t {
+  kClean,     // every byte accounted for
+  kTornTail,  // intact prefix + a partial final append: truncate at valid_end
+  kCorrupt,   // mid-log damage: REFUSE to serve (valid_end = first bad byte)
+  kLegacy,    // no v2 magic: replay with the pre-chain legacy rules
+  kFuture,    // v2 magic, newer version byte: unusable here, refuse
+};
+
+struct ScanResult {
+  ScanStatus status{ScanStatus::kClean};
+  size_t valid_end{0};          // bytes of intact prefix (incl. file header)
+  uint32_t chain{kChainSeed};   // chain value after the last intact record
+  // (payload offset, payload length) of every intact record, in order.
+  std::vector<std::pair<size_t, uint32_t>> records;
+};
+
+inline ScanResult scan(const uint8_t* data, size_t size) {
+  ScanResult out;
+  if (size == 0) return out;  // fresh file: clean, header written on open
+  if (!has_v2_magic(data, size)) {
+    out.status = ScanStatus::kLegacy;
+    return out;
+  }
+  if (size < sizeof(FileHeader)) {
+    // The 8-byte header write itself tore. Nothing after it can exist.
+    out.status = ScanStatus::kTornTail;
+    return out;
+  }
+  FileHeader fh;
+  std::memcpy(&fh, data, sizeof(fh));
+  if (fh.version != kFileVersion) {
+    out.status = ScanStatus::kFuture;
+    return out;
+  }
+  size_t pos = sizeof(FileHeader);
+  out.valid_end = pos;
+  while (pos < size) {
+    if (size - pos < sizeof(RecordHeader)) {
+      out.status = ScanStatus::kTornTail;
+      return out;
+    }
+    RecordHeader rh;
+    std::memcpy(&rh, data + pos, sizeof(rh));
+    if (rh.len == 0 || rh.len > kMaxRecordBytes) {
+      // A complete header with a length the writer could never emit: the
+      // length field itself rotted. A torn append cannot produce this (a
+      // tear leaves a SHORT header, caught above).
+      out.status = ScanStatus::kCorrupt;
+      return out;
+    }
+    const size_t extent = pos + sizeof(RecordHeader) + rh.len;
+    if (extent > size) {
+      out.status = ScanStatus::kTornTail;
+      return out;
+    }
+    const uint32_t want = chain_next(out.chain, data + pos + sizeof(RecordHeader), rh.len);
+    if (want != rh.chain_crc) {
+      // Complete body, broken chain: in-place damage (or splicing), not a
+      // torn append — a tear leaves the record short, never wrong.
+      out.status = ScanStatus::kCorrupt;
+      return out;
+    }
+    out.records.emplace_back(pos + sizeof(RecordHeader), rh.len);
+    out.chain = want;
+    pos = extent;
+    out.valid_end = pos;
+  }
+  return out;
+}
+
+// Legacy (pre-chain) WAL: [u32 len][payload] repeated, no header, no CRC.
+// The historical recovery rule: stop at the first short/oversized length
+// and truncate there (indistinguishable from a torn tail by design — this
+// is exactly the blind spot the v2 chain closes).
+inline ScanResult scan_legacy(const uint8_t* data, size_t size) {
+  ScanResult out;
+  out.status = ScanStatus::kLegacy;
+  size_t pos = 0;
+  while (pos + sizeof(uint32_t) <= size) {
+    uint32_t len = 0;
+    std::memcpy(&len, data + pos, sizeof(len));
+    if (len == 0 || len > kMaxRecordBytes || pos + sizeof(len) + len > size) break;
+    out.records.emplace_back(pos + sizeof(len), len);
+    pos += sizeof(len) + len;
+    out.valid_end = pos;
+  }
+  return out;
+}
+
+// Appends one v2-framed record to `file`, advancing `chain` — the byte-
+// building half of the round-trip the fuzz target pins against scan().
+inline void append_record(std::vector<uint8_t>& file, uint32_t& chain,
+                          const uint8_t* payload, size_t len) {
+  RecordHeader rh;
+  rh.len = static_cast<uint32_t>(len);
+  rh.chain_crc = chain_next(chain, payload, len);
+  const uint8_t* h = reinterpret_cast<const uint8_t*>(&rh);
+  file.insert(file.end(), h, h + sizeof(rh));
+  file.insert(file.end(), payload, payload + len);
+  chain = rh.chain_crc;
+}
+
+inline void append_file_header(std::vector<uint8_t>& file) {
+  FileHeader fh{kFileMagic, kFileVersion};
+  const uint8_t* h = reinterpret_cast<const uint8_t*>(&fh);
+  file.insert(file.end(), h, h + sizeof(fh));
+}
+
+}  // namespace btpu::coord::wal

@@ -60,6 +60,11 @@ BTPU_NODISCARD bool decode_pool_record(const std::string& bytes, MemoryPool& out
 // constructing a KeystoneService. Returns decode_object_record's verdict.
 BTPU_NODISCARD bool probe_object_record(const std::string& bytes);
 
+// Process-global sum of every in-process keystone's persist_retry_backlog()
+// (capi/lane_counters surface — remote deployments read the per-service
+// /metrics gauge instead). Services subtract their remainder on shutdown.
+uint64_t persist_retry_backlog_process_total();
+
 // Relaxed-atomic steady_clock stamp: get_workers touches last_access on
 // every read, and making that touch atomic is what lets reads hold the
 // object shard SHARED (a reader-parallel hot path) instead of exclusively.
@@ -232,6 +237,13 @@ class KeystoneService {
   std::pair<uint64_t, uint64_t> object_cache_version(const ObjectKey& key) const;
   uint64_t cache_generation() const noexcept { return cache_gen_; }
 
+  // Durability-lag backlog: keys whose durable object record could not be
+  // written at mutation time (coordinator outage / fence) and are being
+  // re-persisted by the health loop. Nonzero means acked state and durable
+  // state have diverged — exported as btpu_persist_retry_backlog on
+  // /metrics, capi, and Client.lane_counters() (docs/OPERATIONS.md alert).
+  size_t persist_retry_backlog() const;
+
   Result<ClusterStats> get_cluster_stats() const;
   // Allocator view with per-storage-class breakdowns (metrics exports the
   // same numbers tier-aware eviction keys off).
@@ -314,6 +326,9 @@ class KeystoneService {
   // memory until the durable record catches up.
   void mark_persist_dirty(const ObjectKey& key);
   void retry_dirty_persists();
+  // Drops every deferred-persist entry (demotion / shutdown), keeping the
+  // process-global backlog gauge in step. Idempotent.
+  void drain_persist_retry();
   // Routes a leader-owned coordinator write through the fence (plain write
   // when HA is off). FENCED triggers fence_stepdown().
   ErrorCode coord_put_record(const std::string& key, const std::string& value);
@@ -496,7 +511,7 @@ class KeystoneService {
   // are irreversible in memory, so "fail closed" is not available to them —
   // instead the health loop re-persists these keys from current memory
   // until the record catches up (retry_dirty_persists).
-  Mutex persist_retry_mutex_;
+  mutable Mutex persist_retry_mutex_;
   std::unordered_set<ObjectKey> persist_retry_ BTPU_GUARDED_BY(persist_retry_mutex_);
   // Background scrub ring position (scrub thread only).
   ObjectKey scrub_cursor_;

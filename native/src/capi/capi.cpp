@@ -25,12 +25,23 @@ extern "C" {
 
 btpu_cluster* btpu_cluster_create(uint32_t n_workers, uint64_t pool_bytes,
                                   uint32_t storage_class, uint32_t transport) {
+  return btpu_cluster_create_ex(n_workers, pool_bytes, storage_class, transport, nullptr,
+                                -1);
+}
+
+btpu_cluster* btpu_cluster_create_ex(uint32_t n_workers, uint64_t pool_bytes,
+                                     uint32_t storage_class, uint32_t transport,
+                                     const char* data_dir, int64_t group_commit_us) {
   auto options = client::EmbeddedClusterOptions::simple(
       n_workers, pool_bytes, static_cast<StorageClass>(storage_class));
   const auto kind = static_cast<TransportKind>(transport);
   for (auto& w : options.workers) {
     w.transport = kind;
     if (kind == TransportKind::TCP) w.listen_host = "127.0.0.1";
+  }
+  if (data_dir && data_dir[0]) {
+    options.durability.dir = data_dir;
+    options.durability.group_commit_us = group_commit_us;
   }
   auto cluster = std::make_unique<client::EmbeddedCluster>(std::move(options));
   if (cluster->start() != ErrorCode::OK) return nullptr;
@@ -358,6 +369,9 @@ uint64_t btpu_breaker_trip_count(void) {
 }
 uint64_t btpu_breaker_skip_count(void) {
   return robust_counters().breaker_skips.load(std::memory_order_relaxed);
+}
+uint64_t btpu_persist_retry_backlog(void) {
+  return keystone::persist_retry_backlog_process_total();
 }
 
 void btpu_client_cache_configure(btpu_client* client, uint64_t cache_bytes) {

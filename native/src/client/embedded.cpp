@@ -59,7 +59,16 @@ Result<std::unique_ptr<worker::WorkerService>> EmbeddedCluster::start_worker_ins
 
 ErrorCode EmbeddedCluster::start() {
   if (running_) return ErrorCode::INVALID_STATE;
-  if (options_.use_coordinator) coordinator_ = std::make_shared<coord::MemCoordinator>();
+  if (options_.use_coordinator) {
+    coordinator_ = std::make_shared<coord::MemCoordinator>(options_.durability);
+    if (auto ec = coordinator_->durability_status(); ec != ErrorCode::OK) {
+      // Recovery refused (corruption / future journal): surface it instead
+      // of running a cluster whose every coordinator call would fail.
+      LOG_ERROR << "embedded cluster: durable coordinator state failed recovery";
+      coordinator_.reset();
+      return ec;
+    }
+  }
   keystone_ = std::make_unique<keystone::KeystoneService>(options_.keystone, coordinator_);
   BTPU_RETURN_IF_ERROR(keystone_->initialize());
   BTPU_RETURN_IF_ERROR(keystone_->start());

@@ -8,8 +8,11 @@
 #include <filesystem>
 #include <fstream>
 
+#include "btpu/common/crashpoint.h"
+#include "btpu/common/env.h"
 #include "btpu/common/log.h"
 #include "btpu/common/wire.h"
+#include "btpu/coord/wal_format.h"
 #include "btpu/net/net.h"
 
 namespace btpu::coord {
@@ -46,22 +49,40 @@ std::string cache_inval_key(const std::string& c, const std::string& key) {
 
 // ---- journal --------------------------------------------------------------
 //
-// WAL record payloads are wire-encoded, length-prefixed in the file:
-//   [u32 len][u8 type][fields]
-// A torn tail (crash mid-append) is detected by a short/oversized length and
-// the file is truncated there on load. Lease keepalives are NOT journaled:
-// recovery re-arms every lease to its full TTL instead, giving live owners
-// one refresh interval to resume before expiry fires.
+// WAL record payloads are wire-encoded ([u8 type][fields]) and framed by
+// wal_format.h's CRC-chained v2 envelope on disk. A torn tail (crash
+// mid-append) breaks the chain at the file's END and is truncated on load;
+// a chain break MID-log is corruption and recovery hard-fails
+// (durability_status()). Lease keepalives are NOT journaled: recovery
+// re-arms every lease to its full TTL instead, giving live owners one
+// refresh interval to resume before expiry fires.
+//
+// Acked == durable: every public mutator appends under mutex_, then waits
+// OUTSIDE it (wait_durable) until an fdatasync covers its record. With
+// group commit (group_commit_us > 0) the first unsatisfied waiter leads
+// ONE fdatasync for every record appended so far (leader-based batching;
+// writers landing during the sync ride the next leader); with a 0 window
+// the append itself fsyncs inline, one sync per record, exactly the
+// pre-group-commit behavior.
 
 namespace {
 constexpr uint32_t kSnapshotMagic = 0x53435442;  // "BTCS"
-constexpr uint32_t kSnapshotVersion = 2;  // v2 appends max_epoch_
+// v2 appends max_epoch_; v3 appends a whole-file CRC32C trailer (always
+// the FINAL 4 bytes — future versions append their fields before it).
+constexpr uint32_t kSnapshotVersion = 3;
 constexpr uint8_t kRecPut = 1;      // key, value, lease id (0 = none)
 constexpr uint8_t kRecDel = 2;      // key
 constexpr uint8_t kRecGrant = 3;    // lease id, ttl_ms
 constexpr uint8_t kRecRevoke = 4;   // lease id (deletes owned keys on replay)
 constexpr uint8_t kRecEpoch = 5;    // fencing epoch minted: {election, epoch}
-constexpr uint32_t kMaxRecordBytes = 64u << 20;
+
+// v3+ snapshots carry a trailer CRC; -1 = not a snapshot at all.
+int snapshot_version(const std::vector<uint8_t>& bytes) {
+  btpu::wire::Reader r(bytes);
+  uint32_t magic = 0, version = 0;
+  if (!r.get(magic) || magic != kSnapshotMagic || !r.get(version)) return -1;
+  return static_cast<int>(version);
+}
 
 std::vector<uint8_t> rec_put(const std::string& key, const std::string& value, int64_t lease) {
   wire::Writer w;
@@ -106,30 +127,194 @@ std::vector<uint8_t> rec_epoch(const std::string& election, uint64_t epoch) {
 std::string MemCoordinator::snapshot_path() const { return durability_.dir + "/snapshot.bin"; }
 std::string MemCoordinator::wal_path() const { return durability_.dir + "/wal.bin"; }
 
+ErrorCode MemCoordinator::check_journalable(size_t key_bytes, size_t value_bytes) const {
+  // durability_ is immutable after construction; no lock needed.
+  if (durability_.dir.empty()) return ErrorCode::OK;
+  if (key_bytes + value_bytes + 64 > wal::kMaxRecordBytes) return ErrorCode::INVALID_PARAMETERS;
+  return ErrorCode::OK;
+}
+
+void MemCoordinator::recovery_fail_locked(ErrorCode status) {
+  // Failed recovery must leave NOTHING serveable: a store that cannot
+  // prove its state answers every call with journal_status_ instead.
+  journal_status_ = status;
+  data_.clear();
+  leases_.clear();
+  election_epochs_.clear();
+  max_epoch_ = 0;
+}
+
+void MemCoordinator::journal_break_locked() {
+  wal_broken_ = true;
+  // Release every durability waiter WITHOUT advancing sync_durable_: their
+  // wait_durable returns false and their mutations answer COORD_ERROR (the
+  // caller already logged why). The fd is NOT closed here — a leader may be
+  // inside fdatasync on it, and a reused descriptor number would silently
+  // sync some other file; the destructor closes it.
+  MutexLock sync(sync_mutex_);
+  sync_fd_ = -1;
+  sync_in_flight_ = false;
+  sync_pending_ = sync_completed_ = wal_appended_;
+  sync_cv_.notify_all();
+}
+
+bool MemCoordinator::journal_write_header_locked() {
+  const wal::FileHeader header{wal::kFileMagic, wal::kFileVersion};
+  if (net::write_all(wal_fd_, &header, sizeof(header)) != ErrorCode::OK) return false;
+  wal_chain_ = wal::kChainSeed;
+  return true;
+}
+
 void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
-  if (wal_fd_ < 0) return;
+  if (durability_.dir.empty()) return;  // memory-only: nothing promised
+  if (wal_fd_ < 0 || wal_broken_) {
+    // Durability was configured but the journal is gone (open failure /
+    // unrecoverable write error): the op must FAIL, not silently ack.
+    journal_op_failed_ = true;
+    return;
+  }
+  if (record.empty() || record.size() > wal::kMaxRecordBytes) {
+    LOG_ERROR << "coordinator WAL record of " << record.size()
+              << " bytes exceeds the journal frame; refusing the mutation";
+    journal_op_failed_ = true;
+    return;
+  }
   // True end of file, not SEEK_CUR: with O_APPEND the descriptor offset is 0
   // until the first write, and a rollback from 0 would wipe the surviving WAL.
   const off_t start = ::lseek(wal_fd_, 0, SEEK_END);
-  const uint32_t len = static_cast<uint32_t>(record.size());
-  if (net::write_all(wal_fd_, &len, sizeof(len)) != ErrorCode::OK ||
-      net::write_all(wal_fd_, record.data(), record.size()) != ErrorCode::OK) {
-    // Roll the partial record back: leaving garbage mid-file would make
-    // recovery's torn-tail truncation silently discard every LATER record.
+  wal::RecordHeader header;
+  header.len = static_cast<uint32_t>(record.size());
+  header.chain_crc = wal::chain_next(wal_chain_, record.data(), record.size());
+  bool wrote = net::write_all(wal_fd_, &header, sizeof(header)) == ErrorCode::OK;
+  if (wrote) crashpoint::hit("wal.mid_append");
+  wrote = wrote && net::write_all(wal_fd_, record.data(), record.size()) == ErrorCode::OK;
+  if (!wrote) {
+    // Roll the partial record back: a complete-looking record with a broken
+    // chain mid-file would read as CORRUPTION (hard recovery failure) on
+    // the next boot, and garbage after it would discard every LATER record.
     if (start < 0 || ::ftruncate(wal_fd_, start) != 0) {
       LOG_ERROR << "coordinator WAL unrecoverable (errno " << errno
-                << "); disabling persistence for this process";
-      ::close(wal_fd_);
-      wal_fd_ = -1;
+                << "); refusing further mutations on this process";
+      journal_break_locked();
+      journal_op_failed_ = true;
       return;
     }
     ::lseek(wal_fd_, start, SEEK_SET);
-    LOG_ERROR << "coordinator WAL append failed (errno " << errno << "); record dropped, "
-              << "state may not survive a restart";
+    LOG_ERROR << "coordinator WAL append failed (errno " << errno
+              << "); refusing the mutation";
+    journal_op_failed_ = true;
     return;
   }
-  if (durability_.fsync) ::fsync(wal_fd_);
+  wal_chain_ = header.chain_crc;
+  ++wal_appended_;
+  wal_end_ = start + static_cast<off_t>(sizeof(header)) + static_cast<off_t>(record.size());
+  crashpoint::hit("wal.after_append");
+  if (durability_.fsync) {
+    if (group_commit_) {
+      // Publish the batch boundary; the caller parks in wait_durable AFTER
+      // releasing mutex_, where the first unsatisfied waiter leads one
+      // fdatasync for everything appended so far.
+      MutexLock sync(sync_mutex_);
+      sync_pending_ = wal_appended_;
+      sync_pending_end_ = wal_end_;
+    } else {
+      // Sync-per-record mode (group_commit_us == 0).
+      crashpoint::hit("wal.before_sync");
+      if (::fdatasync(wal_fd_) != 0) {
+        // A failed sync may have dropped dirty pages AND cleared the error
+        // flag (Linux fsync semantics): the record's durability is
+        // unknowable, so fail the op and stop journaling — and ROLL THE
+        // RECORD BACK first: a refused mutation must not resurface from an
+        // intact-looking chain after a restart.
+        LOG_ERROR << "coordinator WAL fdatasync failed (errno " << errno
+                  << "); refusing further mutations on this process";
+        if (::ftruncate(wal_fd_, start) != 0) {
+          LOG_ERROR << "coordinator cannot roll back the unsynced record (errno " << errno
+                    << "); the REFUSED mutation may resurface after a restart";
+        } else {
+          wal_end_ = start;
+        }
+        journal_break_locked();
+        journal_op_failed_ = true;
+        return;
+      }
+      wal_syncs_.fetch_add(1, std::memory_order_relaxed);
+      crashpoint::hit("wal.after_sync");
+    }
+  }
   if (++wal_records_ >= durability_.compact_every) journal_compact_locked();
+}
+
+bool MemCoordinator::wait_durable(uint64_t seq) {
+  // seq 0 = this op journaled nothing (memory-only store; a configured-but-
+  // failed journal was already reported through journal_op_failed_).
+  // Without group commit the append already sync'd inline (or failed the
+  // op there).
+  if (seq == 0 || !group_commit_) return true;
+  while (true) {
+    uint64_t target = 0;
+    off_t target_end = 0;
+    int fd = -1;
+    {
+      MutexLock lock(sync_mutex_);
+      while (sync_completed_ < seq && sync_in_flight_) sync_cv_.wait(lock);
+      // Released: durable only if a SUCCESSFUL sync (or fsync'd snapshot)
+      // proved it — a journal break releases waiters without proving
+      // anything, and their mutations must not ack.
+      if (sync_completed_ >= seq) return sync_durable_ >= seq;
+      // Become the leader: one fdatasync covers every record appended so
+      // far (each was fully write()n before its seq reached sync_pending_,
+      // both under their own mutexes, so the batch boundary is safe).
+      // Writers that append DURING this sync park and ride the next leader
+      // — the in-flight sync itself is the accumulation window, bounded by
+      // the storage's own sync latency (never an added sleep).
+      sync_in_flight_ = true;
+      target = sync_pending_;
+      target_end = sync_pending_end_;
+      fd = sync_fd_;
+    }
+    crashpoint::hit("wal.before_sync");
+    const bool synced = fd >= 0 && ::fdatasync(fd) == 0;
+    if (synced) wal_syncs_.fetch_add(1, std::memory_order_relaxed);
+    crashpoint::hit("wal.after_sync");
+    if (!synced) {
+      // Same fsync-failure stance as the inline path: durability of the
+      // whole batch is unknowable, so roll the WAL back to the last PROVEN
+      // offset (refused mutations must not resurface from an intact chain
+      // after a restart), break the journal — releasing every waiter
+      // WITHOUT advancing sync_durable_ — and fail this op.
+      LOG_ERROR << "coordinator WAL fdatasync failed (errno " << errno
+                << "); refusing further mutations on this process";
+      off_t durable_end = 0;
+      {
+        MutexLock lock(sync_mutex_);
+        durable_end = sync_durable_end_;
+      }
+      MutexLock lock(mutex_);
+      if (wal_fd_ >= 0 && !wal_broken_) {
+        if (::ftruncate(wal_fd_, durable_end) != 0) {
+          LOG_ERROR << "coordinator cannot roll back the unsynced batch (errno " << errno
+                    << "); REFUSED mutations may resurface after a restart";
+        } else {
+          wal_end_ = durable_end;
+        }
+      }
+      journal_break_locked();
+      return false;
+    }
+    {
+      MutexLock lock(sync_mutex_);
+      sync_in_flight_ = false;
+      if (target > sync_completed_) sync_completed_ = target;
+      if (target > sync_durable_) {
+        sync_durable_ = target;
+        sync_durable_end_ = target_end;
+      }
+      sync_cv_.notify_all();
+      // The leader's own record always sits inside its batch (it appended
+      // before waiting), so this loop terminates on the next check.
+    }
+  }
 }
 
 void MemCoordinator::log_locked(const std::vector<uint8_t>& record) {
@@ -234,27 +419,42 @@ std::vector<uint8_t> MemCoordinator::snapshot_bytes_locked() const {
     wire::encode(w, election);
     w.put<uint64_t>(epoch);
   }
-  return w.take();
+  // v3 trailer: whole-file CRC32C, always the FINAL 4 bytes (future
+  // versions append their fields before it). The rename is atomic, so a
+  // snapshot that fails this check was damaged in place — recovery refuses
+  // it rather than applying a partial decode.
+  auto bytes = w.take();
+  const uint32_t crc = crc32c(bytes.data(), bytes.size());
+  const size_t n = bytes.size();
+  bytes.resize(n + sizeof(crc));
+  std::memcpy(bytes.data() + n, &crc, sizeof(crc));
+  return bytes;
 }
 
 void MemCoordinator::journal_compact_locked() {
-  if (wal_fd_ < 0) return;
+  if (wal_fd_ < 0 || wal_broken_) return;
+  crashpoint::hit("snapshot.before_tmp");
   const std::vector<uint8_t> snapshot = snapshot_bytes_locked();
   const std::string tmp = snapshot_path() + ".tmp";
   int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0 || net::write_all(fd, snapshot.data(), snapshot.size()) != ErrorCode::OK) {
-    LOG_ERROR << "coordinator snapshot write failed (errno " << errno << ")";
+  if (fd < 0 || net::write_all(fd, snapshot.data(), snapshot.size()) != ErrorCode::OK ||
+      ::fsync(fd) != 0) {
+    // The fsync is part of the guard: an unsynced snapshot must never be
+    // renamed into place (the WAL truncate below would then be the only
+    // copy of the data, gone on a crash).
+    LOG_ERROR << "coordinator snapshot write/fsync failed (errno " << errno << ")";
     if (fd >= 0) ::close(fd);
     wal_records_ = 0;  // space retries out; don't re-snapshot on every op
     return;
   }
-  ::fsync(fd);
   ::close(fd);
+  crashpoint::hit("snapshot.before_rename");
   if (::rename(tmp.c_str(), snapshot_path().c_str()) != 0) {
     LOG_ERROR << "coordinator snapshot rename failed (errno " << errno << ")";
     wal_records_ = 0;
     return;
   }
+  crashpoint::hit("snapshot.after_rename");
   // Durable rename, then drop the WAL (replaying a few pre-snapshot records
   // after a crash in this window is idempotent).
   int dir_fd = ::open(durability_.dir.c_str(), O_RDONLY | O_DIRECTORY);
@@ -265,11 +465,50 @@ void MemCoordinator::journal_compact_locked() {
   ::ftruncate(wal_fd_, 0);
   ::lseek(wal_fd_, 0, SEEK_SET);
   wal_records_ = 0;
+  wal_end_ = 0;
+  // Every record appended so far is covered by the fsync'd snapshot:
+  // release any group-commit waiters without another fdatasync and mark
+  // them PROVEN durable (the snapshot fsync was checked above) — BEFORE
+  // the header rewrite below, whose failure must not refuse ops whose
+  // state the snapshot already holds.
+  {
+    MutexLock sync(sync_mutex_);
+    sync_pending_ = wal_appended_;
+    sync_completed_ = wal_appended_;
+    if (wal_appended_ > sync_durable_) sync_durable_ = wal_appended_;
+    sync_pending_end_ = sync_durable_end_ = 0;
+    sync_cv_.notify_all();
+  }
+  // The reborn WAL starts with a fresh header and a reset chain. A crash
+  // between the truncate and this write leaves an EMPTY file — scan()
+  // treats that as a clean fresh journal, and the snapshot carries state.
+  if (!journal_write_header_locked()) {
+    LOG_ERROR << "coordinator WAL header rewrite failed (errno " << errno
+              << ") after compaction; refusing FURTHER mutations on this process "
+                 "(everything up to this snapshot is durable)";
+    journal_break_locked();
+    return;
+  }
+  wal_end_ = static_cast<off_t>(sizeof(wal::FileHeader));
+  {
+    MutexLock sync(sync_mutex_);
+    sync_pending_end_ = sync_durable_end_ = wal_end_;
+  }
+  crashpoint::hit("snapshot.after_truncate");
   LOG_DEBUG << "coordinator journal compacted: " << data_.size() << " entries, "
             << leases_.size() << " leases";
 }
 
 bool MemCoordinator::decode_snapshot_locked(const std::vector<uint8_t>& bytes) {
+  // v3+ integrity gate, checked BEFORE anything is applied: the trailer CRC
+  // covers every preceding byte, so a damaged snapshot is rejected whole
+  // instead of half-applied.
+  if (snapshot_version(bytes) >= 3) {
+    uint32_t stored = 0;
+    if (bytes.size() < sizeof(stored)) return false;
+    std::memcpy(&stored, bytes.data() + bytes.size() - sizeof(stored), sizeof(stored));
+    if (crc32c(bytes.data(), bytes.size() - sizeof(stored)) != stored) return false;
+  }
   wire::Reader r(bytes);
   uint32_t magic = 0, version = 0;
   uint64_t next_lease = 0, n_leases = 0, n_entries = 0;
@@ -400,38 +639,102 @@ void MemCoordinator::journal_load() {
   // wants one for its unlock-notify-relock dance (a no-op here: no watches,
   // no WAL fd, no sink yet).
   MutexLock lock(mutex_);
+  wal_chain_ = wal::kChainSeed;
   {
     std::ifstream in(snapshot_path(), std::ios::binary);
     if (in) {
       std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
                                  std::istreambuf_iterator<char>());
+      const int version = bytes.empty() ? 0 : snapshot_version(bytes);
+      if (version > static_cast<int>(kSnapshotVersion)) {
+        // Intact header from a NEWER build: refuse distinctly from
+        // corruption — the operator rolls the binary forward, nothing is
+        // damaged (checked before the CRC, whose position a future format
+        // still owes us but whose value covers the newer fields).
+        LOG_ERROR << "coordinator snapshot written by a NEWER build (version " << version
+                  << "); refusing recovery — roll the binary forward";
+        recovery_fail_locked(ErrorCode::INVALID_STATE);
+        return;
+      }
       if (!bytes.empty() && !decode_snapshot_locked(bytes)) {
+        // Snapshots have ALWAYS been written temp+fsync+rename, so damage
+        // here is in-place, never a torn write. An unrecognizable magic /
+        // garbage version (version < 1) gets no leniency either — only
+        // structurally-valid PRE-CRC snapshots (v1/v2, written by older
+        // builds) keep the historical partial-state tolerance for their
+        // field-level decode failures.
+        if (version >= 3 || version < 1) {
+          LOG_ERROR << "coordinator snapshot CORRUPT ("
+                    << (version >= 3 ? "v3 CRC/decode failure" : "unrecognizable header")
+                    << "); refusing recovery — see docs/OPERATIONS.md crash-recovery "
+                       "runbook";
+          recovery_fail_locked(ErrorCode::DATA_CORRUPTION);
+          return;
+        }
         LOG_ERROR << "coordinator snapshot truncated/unreadable; continuing with partial state";
       }
     }
   }
 
-  // Then the WAL, tolerating a torn tail.
+  // Then the WAL: chain-verified scan, torn tail truncated, mid-log
+  // corruption refused (wal_format.h spells out the classification).
+  bool legacy_wal = false;
   {
     std::ifstream in(wal_path(), std::ios::binary);
     if (in) {
       std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
                                  std::istreambuf_iterator<char>());
-      size_t pos = 0;
-      size_t valid_end = 0;
-      while (pos + sizeof(uint32_t) <= bytes.size()) {
-        uint32_t len = 0;
-        std::memcpy(&len, bytes.data() + pos, sizeof(len));
-        if (len == 0 || len > kMaxRecordBytes || pos + sizeof(len) + len > bytes.size()) break;
-        if (!apply_record_locked(bytes.data() + pos + sizeof(len), len, lock)) break;
-        pos += sizeof(len) + len;
-        valid_end = pos;
+      wal::ScanResult scanned = wal::scan(bytes.data(), bytes.size());
+      if (scanned.status == wal::ScanStatus::kLegacy) {
+        legacy_wal = true;
+        scanned = wal::scan_legacy(bytes.data(), bytes.size());
+      } else if (scanned.status == wal::ScanStatus::kFuture) {
+        LOG_ERROR << "coordinator WAL written by a NEWER build (unsupported journal "
+                     "version); refusing recovery — roll the binary forward";
+        recovery_fail_locked(ErrorCode::INVALID_STATE);
+        return;
+      } else if (scanned.status == wal::ScanStatus::kCorrupt) {
+        LOG_ERROR << "coordinator WAL CORRUPT mid-log at byte " << scanned.valid_end << "/"
+                  << bytes.size() << " (chain-CRC break on a complete record): records "
+                     "past the damage may hold acked mutations — refusing recovery; see "
+                     "docs/OPERATIONS.md crash-recovery runbook";
+        recovery_fail_locked(ErrorCode::DATA_CORRUPTION);
+        return;
       }
-      if (valid_end < bytes.size()) {
-        LOG_WARN << "coordinator WAL torn tail at " << valid_end << "/" << bytes.size()
+      size_t applied_end = legacy_wal ? 0 : std::min(bytes.size(), sizeof(wal::FileHeader));
+      bool apply_failed = false;
+      for (const auto& [off, len] : scanned.records) {
+        if (!apply_record_locked(bytes.data() + off, len, lock)) {
+          apply_failed = true;
+          break;
+        }
+        applied_end = off + len;
+      }
+      if (apply_failed && !legacy_wal) {
+        // The chain CRC was intact but the payload does not decode: this
+        // build wrote it (same chain), so the damage is one the chain
+        // cannot see — refuse rather than guess. Legacy records keep the
+        // historical stop-at-first-bad-record rule.
+        LOG_ERROR << "coordinator WAL record undecodable despite an intact chain CRC; "
+                     "refusing recovery";
+        recovery_fail_locked(ErrorCode::DATA_CORRUPTION);
+        return;
+      }
+      const size_t keep = apply_failed ? applied_end : scanned.valid_end;
+      if (keep < bytes.size()) {
+        LOG_WARN << "coordinator WAL torn tail at " << keep << "/" << bytes.size()
                  << " bytes; truncating";
-        ::truncate(wal_path().c_str(), static_cast<off_t>(valid_end));
+        if (::truncate(wal_path().c_str(), static_cast<off_t>(keep)) != 0) {
+          // Appending after un-truncated garbage would read as MID-LOG
+          // corruption on the next boot and refuse everything acked from
+          // here on: refuse now instead, while nothing has been lost.
+          LOG_ERROR << "coordinator cannot truncate the torn WAL tail (errno " << errno
+                    << "); refusing recovery";
+          recovery_fail_locked(ErrorCode::DATA_CORRUPTION);
+          return;
+        }
       }
+      wal_chain_ = scanned.chain;
     }
   }
 
@@ -444,10 +747,44 @@ void MemCoordinator::journal_load() {
 
   wal_fd_ = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
   if (wal_fd_ < 0) {
-    LOG_ERROR << "coordinator WAL open failed (errno " << errno << "); running memory-only";
-  } else if (!data_.empty() || !leases_.empty()) {
+    // Durability was configured but the journal cannot even open: refuse to
+    // serve (a store that would fail-stop every mutation anyway must not
+    // masquerade as healthy; bb-coord exits at its startup gate).
+    LOG_ERROR << "coordinator WAL open failed (errno " << errno
+              << "); refusing recovery — fix " << wal_path() << " and restart";
+    recovery_fail_locked(ErrorCode::COORD_ERROR);
+    return;
+  }
+  const off_t end = ::lseek(wal_fd_, 0, SEEK_END);
+  wal_end_ = end > 0 ? end : 0;
+  if (end == 0) {
+    if (!journal_write_header_locked()) {
+      LOG_ERROR << "coordinator WAL header write failed (errno " << errno
+                << "); refusing recovery — fix " << wal_path() << " and restart";
+      ::close(wal_fd_);
+      wal_fd_ = -1;
+      recovery_fail_locked(ErrorCode::COORD_ERROR);
+      return;
+    }
+    wal_end_ = static_cast<off_t>(sizeof(wal::FileHeader));
+  } else if (legacy_wal) {
+    // Rebirth the journal as v2: compacting snapshots the recovered state
+    // and rewrites the WAL with a header + chained records, so the
+    // pre-chain layout is read exactly once per upgrade.
+    LOG_INFO << "coordinator WAL upgraded: pre-chain legacy journal compacted into the "
+                "CRC-chained v2 format";
+    journal_compact_locked();
+  }
+  if (wal_fd_ >= 0 && (!data_.empty() || !leases_.empty())) {
     LOG_INFO << "coordinator recovered " << data_.size() << " keys, " << leases_.size()
              << " leases from " << durability_.dir;
+  }
+  {
+    MutexLock sync(sync_mutex_);
+    sync_fd_ = wal_fd_;
+    // Everything on disk at boot is the recovered baseline: a later failed
+    // sync rolls back to here, never past recovered state.
+    sync_pending_end_ = sync_durable_end_ = wal_end_;
   }
 }
 
@@ -455,7 +792,16 @@ void MemCoordinator::journal_load() {
 
 MemCoordinator::MemCoordinator(DurabilityOptions durability)
     : durability_(std::move(durability)) {
+  group_commit_us_ =
+      durability_.group_commit_us >= 0
+          ? durability_.group_commit_us
+          : static_cast<int64_t>(env_u64("BTPU_WAL_GROUP_COMMIT_US", 500));
   if (!durability_.dir.empty()) journal_load();
+  {
+    MutexLock lock(mutex_);
+    group_commit_ = journal_status_ == ErrorCode::OK && wal_fd_ >= 0 && durability_.fsync &&
+                    group_commit_us_ > 0;
+  }
   expiry_thread_ = std::thread([this] { expiry_loop(); });
 }
 
@@ -466,7 +812,9 @@ MemCoordinator::~MemCoordinator() {
   }
   expiry_cv_.notify_all();
   if (expiry_thread_.joinable()) expiry_thread_.join();
-  // Single-threaded from here, but the guard keeps the annotation honest.
+  // Single-threaded from here (leader-based group commit runs on mutator
+  // threads, which the caller has quiesced), but the guard keeps the
+  // annotation honest.
   MutexLock lock(mutex_);
   if (wal_fd_ >= 0) ::close(wal_fd_);
 }
@@ -548,6 +896,7 @@ ErrorCode MemCoordinator::del_locked(const std::string& key, MutexLock& lock)
 }
 
 Result<std::string> MemCoordinator::get(const std::string& key) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
   MutexLock lock(mutex_);
   auto it = data_.find(key);
   if (it == data_.end()) return ErrorCode::COORD_KEY_NOT_FOUND;
@@ -555,11 +904,22 @@ Result<std::string> MemCoordinator::get(const std::string& key) {
 }
 
 ErrorCode MemCoordinator::put(const std::string& key, const std::string& value) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  if (auto ec = check_journalable(key.size(), value.size()); ec != ErrorCode::OK) return ec;
+  uint64_t seq = 0;
+  bool journal_failed = false;
   {
     MutexLock lock(mutex_);
+    journal_op_failed_ = false;
     data_[key] = Entry{value, 0};
     log_locked(rec_put(key, value, 0));
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
   }
+  // Acked == durable: the caller (and its watchers) only learn of the
+  // mutation once an fdatasync covers the record. A journal/sync failure
+  // refuses the ack (COORD_ERROR) — retries are idempotent.
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
 }
@@ -573,24 +933,45 @@ ErrorCode MemCoordinator::put_with_ttl(const std::string& key, const std::string
 
 ErrorCode MemCoordinator::put_with_lease(const std::string& key, const std::string& value,
                                          LeaseId lease) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  if (auto ec = check_journalable(key.size(), value.size()); ec != ErrorCode::OK) return ec;
+  uint64_t seq = 0;
+  bool journal_failed = false;
   {
     MutexLock lock(mutex_);
+    journal_op_failed_ = false;
     auto it = leases_.find(lease);
     if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
     it->second.keys.push_back(key);
     data_[key] = Entry{value, lease};
     log_locked(rec_put(key, value, lease));
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
   }
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
 }
 
 ErrorCode MemCoordinator::del(const std::string& key) {
-  MutexLock lock(mutex_);
-  return del_locked(key, lock);
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  uint64_t seq = 0;
+  bool journal_failed = false;
+  ErrorCode ec;
+  {
+    MutexLock lock(mutex_);
+    journal_op_failed_ = false;
+    ec = del_locked(key, lock);
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
+  }
+  if (ec == ErrorCode::OK && (journal_failed || !wait_durable(seq)))
+    return ErrorCode::COORD_ERROR;
+  return ec;
 }
 
 Result<std::vector<KeyValue>> MemCoordinator::get_with_prefix(const std::string& prefix) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
   MutexLock lock(mutex_);
   std::vector<KeyValue> out;
   for (auto it = data_.lower_bound(prefix); it != data_.end(); ++it) {
@@ -602,14 +983,25 @@ Result<std::vector<KeyValue>> MemCoordinator::get_with_prefix(const std::string&
 
 Result<LeaseId> MemCoordinator::lease_grant(int64_t ttl_ms) {
   if (ttl_ms <= 0) return ErrorCode::INVALID_PARAMETERS;
-  MutexLock lock(mutex_);
-  LeaseId id = next_lease_++;
-  leases_[id] = Lease{ttl_ms, Clock::now() + std::chrono::milliseconds(ttl_ms), {}};
-  log_locked(rec_grant(id, ttl_ms));
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  LeaseId id = 0;
+  uint64_t seq = 0;
+  bool journal_failed = false;
+  {
+    MutexLock lock(mutex_);
+    journal_op_failed_ = false;
+    id = next_lease_++;
+    leases_[id] = Lease{ttl_ms, Clock::now() + std::chrono::milliseconds(ttl_ms), {}};
+    log_locked(rec_grant(id, ttl_ms));
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
+  }
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   return id;
 }
 
 ErrorCode MemCoordinator::lease_keepalive(LeaseId lease) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
   MutexLock lock(mutex_);
   auto it = leases_.find(lease);
   if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
@@ -618,30 +1010,40 @@ ErrorCode MemCoordinator::lease_keepalive(LeaseId lease) {
 }
 
 ErrorCode MemCoordinator::lease_revoke(LeaseId lease) {
-  MutexLock lock(mutex_);
-  auto it = leases_.find(lease);
-  if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
-  auto keys = it->second.keys;
-  leases_.erase(it);
-  log_locked(rec_revoke(lease));
-  for (const auto& key : keys) {
-    auto entry = data_.find(key);
-    if (entry == data_.end() || entry->second.lease != lease) continue;
-    warn_if_error(del_locked(key, lock), "expired-ttl delete", ErrorCode::COORD_KEY_NOT_FOUND);
-  }
-  for (auto& [election, e] : elections_) {
-    auto dead = std::find_if(e.candidates.begin(), e.candidates.end(),
-                             [&](const Candidate& c) { return c.lease == lease; });
-    if (dead != e.candidates.end()) {
-      const bool was_leader = dead == e.candidates.begin();
-      e.candidates.erase(dead);
-      if (was_leader) promote_next_locked(election, lock);
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  uint64_t seq = 0;
+  bool journal_failed = false;
+  {
+    MutexLock lock(mutex_);
+    journal_op_failed_ = false;
+    auto it = leases_.find(lease);
+    if (it == leases_.end()) return ErrorCode::COORD_LEASE_ERROR;
+    auto keys = it->second.keys;
+    leases_.erase(it);
+    log_locked(rec_revoke(lease));
+    for (const auto& key : keys) {
+      auto entry = data_.find(key);
+      if (entry == data_.end() || entry->second.lease != lease) continue;
+      warn_if_error(del_locked(key, lock), "expired-ttl delete", ErrorCode::COORD_KEY_NOT_FOUND);
     }
+    for (auto& [election, e] : elections_) {
+      auto dead = std::find_if(e.candidates.begin(), e.candidates.end(),
+                               [&](const Candidate& c) { return c.lease == lease; });
+      if (dead != e.candidates.end()) {
+        const bool was_leader = dead == e.candidates.begin();
+        e.candidates.erase(dead);
+        if (was_leader) promote_next_locked(election, lock);
+      }
+    }
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
   }
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   return ErrorCode::OK;
 }
 
 Result<WatchId> MemCoordinator::watch_prefix(const std::string& prefix, WatchCallback cb) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
   MutexLock lock(mutex_);
   WatchId id = next_watch_++;
   watches_.push_back({id, prefix, std::move(cb)});
@@ -716,8 +1118,11 @@ ErrorCode MemCoordinator::campaign(const std::string& election, const std::strin
   if (!lease.ok()) return lease.error();
   bool is_leader = false;
   uint64_t epoch = 0;
+  uint64_t seq = 0;
+  bool journal_failed = false;
   {
     MutexLock lock(mutex_);
+    journal_op_failed_ = false;
     auto& e = elections_[election];
     if (std::any_of(e.candidates.begin(), e.candidates.end(),
                     [&](const Candidate& c) { return c.id == candidate_id; }))
@@ -726,25 +1131,40 @@ ErrorCode MemCoordinator::campaign(const std::string& election, const std::strin
     is_leader = e.candidates.size() == 1;
     if (is_leader) e.epoch = mint_epoch_locked(election);
     epoch = e.epoch;
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
   }
+  // A fencing token must be durable before its holder may act on it: a
+  // crash-revived coordinator that forgot the epoch would let a STALE
+  // leader write through the fence.
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   if (cb) cb(is_leader, is_leader ? epoch : 0);
   return ErrorCode::OK;
 }
 
 ErrorCode MemCoordinator::resign(const std::string& election, const std::string& candidate_id) {
-  MutexLock lock(mutex_);
-  auto it = elections_.find(election);
-  if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
-  auto& candidates = it->second.candidates;
-  auto me = std::find_if(candidates.begin(), candidates.end(),
-                         [&](const Candidate& c) { return c.id == candidate_id; });
-  if (me == candidates.end()) return ErrorCode::LEADER_ELECTION_FAILED;
-  const bool was_leader = me == candidates.begin();
-  const LeaseId lease = me->lease;
-  candidates.erase(me);
-  leases_.erase(lease);
-  log_locked(rec_revoke(lease));
-  if (was_leader) promote_next_locked(election, lock);
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  uint64_t seq = 0;
+  bool journal_failed = false;
+  {
+    MutexLock lock(mutex_);
+    journal_op_failed_ = false;
+    auto it = elections_.find(election);
+    if (it == elections_.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+    auto& candidates = it->second.candidates;
+    auto me = std::find_if(candidates.begin(), candidates.end(),
+                           [&](const Candidate& c) { return c.id == candidate_id; });
+    if (me == candidates.end()) return ErrorCode::LEADER_ELECTION_FAILED;
+    const bool was_leader = me == candidates.begin();
+    const LeaseId lease = me->lease;
+    candidates.erase(me);
+    leases_.erase(lease);
+    log_locked(rec_revoke(lease));
+    if (was_leader) promote_next_locked(election, lock);
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
+  }
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   return ErrorCode::OK;
 }
 
@@ -781,21 +1201,41 @@ Result<uint64_t> MemCoordinator::election_epoch(const std::string& election) {
 
 ErrorCode MemCoordinator::put_fenced(const std::string& key, const std::string& value,
                                      const std::string& election, uint64_t epoch) {
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  if (auto ec = check_journalable(key.size(), value.size()); ec != ErrorCode::OK) return ec;
+  uint64_t seq = 0;
+  bool journal_failed = false;
   {
     MutexLock lock(mutex_);
+    journal_op_failed_ = false;
     if (auto ec = check_fence_locked(election, epoch); ec != ErrorCode::OK) return ec;
     data_[key] = Entry{value, 0};
     log_locked(rec_put(key, value, 0));
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
   }
+  if (journal_failed || !wait_durable(seq)) return ErrorCode::COORD_ERROR;
   notify(WatchEvent::Type::kPut, key, value);
   return ErrorCode::OK;
 }
 
 ErrorCode MemCoordinator::del_fenced(const std::string& key, const std::string& election,
                                      uint64_t epoch) {
-  MutexLock lock(mutex_);
-  if (auto ec = check_fence_locked(election, epoch); ec != ErrorCode::OK) return ec;
-  return del_locked(key, lock);
+  if (journal_status_ != ErrorCode::OK) return journal_status_;
+  uint64_t seq = 0;
+  bool journal_failed = false;
+  ErrorCode ec;
+  {
+    MutexLock lock(mutex_);
+    journal_op_failed_ = false;
+    if (auto fence = check_fence_locked(election, epoch); fence != ErrorCode::OK) return fence;
+    ec = del_locked(key, lock);
+    seq = appended_seq_locked();
+    journal_failed = journal_op_failed_;
+  }
+  if (ec == ErrorCode::OK && (journal_failed || !wait_durable(seq)))
+    return ErrorCode::COORD_ERROR;
+  return ec;
 }
 
 }  // namespace btpu::coord

@@ -7,6 +7,7 @@
 #include <random>
 #include <unordered_set>
 
+#include "btpu/common/crashpoint.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/crc32c.h"
@@ -259,10 +260,7 @@ void KeystoneService::on_demoted() {
   // This node's deferred-persist debts die with its term: the promoted
   // leader owns the durable records now, and replaying a stale entry after
   // re-promotion could unpersist a record the reconcile intentionally kept.
-  {
-    MutexLock lock(persist_retry_mutex_);
-    persist_retry_.clear();
-  }
+  drain_persist_retry();
   size_t dropped = 0;
   for (size_t si = 0; si < shard_count_; ++si) {
     ObjectShard& s = shards_[si];
@@ -313,6 +311,9 @@ void KeystoneService::stop() {
     }
     warn_if_error(coordinator_->unregister_service("btpu-keystone", service_id_), "shutdown service unregister");
   }
+  // Keep the process-global backlog gauge honest across service churn
+  // (embedded tests build many keystones per process).
+  drain_persist_retry();
 }
 
 // ---- threads --------------------------------------------------------------
@@ -651,6 +652,10 @@ ErrorCode KeystoneService::put_complete(const ObjectKey& key,
     return ec;
   }
   ++counters_.put_completes;
+  // Commit point passed: the durable record IS synced (the coordinator put
+  // released only after its covering fdatasync). Dying here must leave the
+  // object recoverable even though the client never saw the ack.
+  crashpoint::hit("persist.after_ack");
   return ErrorCode::OK;
 }
 
@@ -730,6 +735,10 @@ ErrorCode KeystoneService::put_inline(const ObjectKey& key, const WorkerConfig& 
   ++counters_.put_completes;
   ++counters_.inline_puts;
   bump_view();
+  // Same commit-point contract as put_complete: record durable, ack not yet
+  // delivered — recovery must surface the object (an unacked-but-durable
+  // mutation is legal; a lost acked one never is).
+  crashpoint::hit("persist.after_ack");
   return ErrorCode::OK;
 }
 

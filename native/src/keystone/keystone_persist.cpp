@@ -8,6 +8,7 @@
 #include <optional>
 #include <unordered_set>
 
+#include "btpu/common/crashpoint.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/crc32c.h"
@@ -338,6 +339,29 @@ bool probe_object_record(const std::string& bytes) {
   return decode_object_record(bytes, rec);
 }
 
+// ---- durability-lag backlog gauge -----------------------------------------
+// Sum of every in-process keystone's deferred-persist set. A sustained
+// nonzero value means acked metadata and durable records have diverged
+// (coordinator outage): alert on it (docs/OPERATIONS.md).
+namespace {
+std::atomic<uint64_t> g_persist_retry_backlog{0};
+}  // namespace
+
+uint64_t persist_retry_backlog_process_total() {
+  return g_persist_retry_backlog.load(std::memory_order_relaxed);
+}
+
+size_t KeystoneService::persist_retry_backlog() const {
+  MutexLock lock(persist_retry_mutex_);
+  return persist_retry_.size();
+}
+
+void KeystoneService::drain_persist_retry() {
+  MutexLock lock(persist_retry_mutex_);
+  g_persist_retry_backlog.fetch_sub(persist_retry_.size(), std::memory_order_relaxed);
+  persist_retry_.clear();
+}
+
 ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo& info) {
   if (!coordinator_ || !config_.persist_objects) return ErrorCode::OK;
   const auto steady_now = std::chrono::steady_clock::now();
@@ -355,8 +379,11 @@ ErrorCode KeystoneService::persist_object(const ObjectKey& key, const ObjectInfo
   rec.copies = info.copies;
   rec.created_wall_ms = to_wall(info.created_at);
   rec.last_access_wall_ms = to_wall(info.last_access.load());
-  return coord_put_record(coord::object_record_key(config_.cluster_id, key),
-                          encode_object_record(rec));
+  crashpoint::hit("persist.before_record");
+  auto ec = coord_put_record(coord::object_record_key(config_.cluster_id, key),
+                             encode_object_record(rec));
+  if (ec == ErrorCode::OK) crashpoint::hit("persist.after_record");
+  return ec;
 }
 
 ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
@@ -368,7 +395,8 @@ ErrorCode KeystoneService::unpersist_object(const ObjectKey& key) {
 void KeystoneService::mark_persist_dirty(const ObjectKey& key) {
   if (!coordinator_ || !config_.persist_objects) return;
   MutexLock lock(persist_retry_mutex_);
-  persist_retry_.insert(key);
+  if (persist_retry_.insert(key).second)
+    g_persist_retry_backlog.fetch_add(1, std::memory_order_relaxed);
 }
 
 void KeystoneService::retry_dirty_persists() {
@@ -412,7 +440,8 @@ void KeystoneService::retry_dirty_persists() {
       // under the unique lock, so a FRESHER dirty mark (splice + failed
       // persist racing this loop) cannot be interleaved and wiped here.
       MutexLock dirty(persist_retry_mutex_);
-      persist_retry_.erase(key);
+      if (persist_retry_.erase(key))
+        g_persist_retry_backlog.fetch_sub(1, std::memory_order_relaxed);
       if (caught_up) {
         LOG_INFO << "durable record for " << key << " caught up after deferred persist";
       }

@@ -61,6 +61,10 @@ std::string MetricsHttpServer::render_metrics() const {
           c.inline_puts.load());
   gauge("btpu_inline_bytes", "bytes resident in the keystone inline tier",
         static_cast<double>(service_.inline_bytes_resident()));
+  gauge("btpu_persist_retry_backlog",
+        "objects whose durable record write is deferred and retrying (acked vs durable "
+        "state diverged; alert when sustained nonzero)",
+        static_cast<double>(service_.persist_retry_backlog()));
   counter("btpu_fabric_moves_total",
           "cross-process device moves over the device fabric (vs host lane)",
           c.fabric_moves.load());

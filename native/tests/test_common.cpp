@@ -1,8 +1,14 @@
 // Core type / error / wire-format unit tests.
 // Mirrors the serialization-roundtrip test stage from SURVEY.md §7 step 1.
+#include <sys/wait.h>
+#include <unistd.h>
+
 #include <algorithm>
+#include <string>
+#include <vector>
 
 #include "btest.h"
+#include "btpu/common/crashpoint.h"
 #include "btpu/common/crc32c.h"
 #include "btpu/common/error.h"
 #include "btpu/common/result.h"
@@ -376,4 +382,50 @@ BTEST(Types, KeystoneConfigValidation) {
   cfg = {};
   cfg.default_replicas = 5;  // > max_replicas (3)
   BT_EXPECT(cfg.validate() == ErrorCode::VALUE_OUT_OF_RANGE);
+}
+
+// ---- crash-point injection (btpu/common/crashpoint.h) ----------------------
+
+BTEST(CrashPoint, CatalogNamesEveryLabel) {
+  // bb-crash iterates kAll; a label that exists in code but not in the
+  // catalog silently drops out of the matrix. Pin the catalog's shape and
+  // the labels the durability path threads through.
+  const std::vector<std::string> all(std::begin(crashpoint::kAll),
+                                     std::end(crashpoint::kAll));
+  BT_EXPECT(all.size() >= 11);
+  for (const char* expected :
+       {"wal.mid_append", "wal.after_append", "wal.before_sync", "wal.after_sync",
+        "snapshot.before_rename", "snapshot.after_truncate", "persist.before_record",
+        "persist.after_ack"}) {
+    BT_EXPECT(std::find(all.begin(), all.end(), expected) != all.end());
+  }
+}
+
+BTEST(CrashPoint, FiresOnNthHitInForkedChild) {
+  // _exit(kExitCode) on exactly the Nth hit, never before, and only for the
+  // armed label — proven in a forked child so the test process survives.
+  const pid_t pid = fork();
+  BT_ASSERT(pid >= 0);
+  if (pid == 0) {
+    setenv("BTPU_CRASHPOINT", "test.point:3", 1);
+    // The suite's earlier tests already initialized the parsed-once spec
+    // (any WAL append touches a crash point), so the child re-arms it.
+    crashpoint::reparse_for_test();
+    crashpoint::hit("test.other");  // wrong label: free
+    crashpoint::hit("test.point");  // 1
+    crashpoint::hit("test.point");  // 2
+    crashpoint::hit("test.point");  // 3 -> _exit(137)
+    _exit(0);                       // unreachable if the point fired
+  }
+  int status = 0;
+  BT_ASSERT(waitpid(pid, &status, 0) == pid);
+  BT_EXPECT(WIFEXITED(status));
+  BT_EXPECT_EQ(WEXITSTATUS(status), crashpoint::kExitCode);
+}
+
+BTEST(CrashPoint, DisarmedIsFree) {
+  // No env (the parent test process never arms one): hit() must be a no-op.
+  crashpoint::hit("wal.after_append");
+  crashpoint::hit("persist.after_ack");
+  BT_EXPECT(true);
 }

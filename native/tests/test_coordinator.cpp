@@ -10,9 +10,12 @@
 #include <fstream>
 
 #include "btest.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/common/wire.h"
 #include "btpu/coord/coord_server.h"
 #include "btpu/coord/mem_coordinator.h"
 #include "btpu/coord/remote_coordinator.h"
+#include "btpu/coord/wal_format.h"
 
 using namespace btpu;
 using namespace btpu::coord;
@@ -369,6 +372,256 @@ BTEST(Durability, TornWalTailIsTruncated) {
   BT_EXPECT(b.put("/t/after", "fine") == ErrorCode::OK);  // WAL usable again
   MemCoordinator c(opts);
   BT_EXPECT_EQ(c.get("/t/after").value(), "fine");
+}
+
+BTEST(Durability, GroupCommitAcksAreDurableAcrossRestart) {
+  // Group commit ON with real fsync: concurrent writers batch under shared
+  // fdatasyncs, and every acked put must survive a restart bit-exact —
+  // acked == durable is the whole contract.
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/true, 4096, /*group_commit_us=*/300};
+  {
+    MemCoordinator a(opts);
+    std::vector<std::thread> writers;
+    for (int t = 0; t < 4; ++t) {
+      writers.emplace_back([&, t] {
+        for (int i = 0; i < 25; ++i) {
+          const std::string key = "/gc/" + std::to_string(t) + "/" + std::to_string(i);
+          BT_EXPECT(a.put(key, key + "-value") == ErrorCode::OK);
+        }
+      });
+    }
+    for (auto& w : writers) w.join();
+  }
+  MemCoordinator b(opts);
+  BT_EXPECT(b.durability_status() == ErrorCode::OK);
+  for (int t = 0; t < 4; ++t) {
+    for (int i = 0; i < 25; ++i) {
+      const std::string key = "/gc/" + std::to_string(t) + "/" + std::to_string(i);
+      auto got = b.get(key);
+      BT_ASSERT_OK(got);
+      BT_EXPECT_EQ(got.value(), key + "-value");
+    }
+  }
+}
+
+BTEST(Durability, ChainCrcTruncatesTornTailOnly) {
+  // A v2 torn tail — full record header promising more payload than exists
+  // (exactly what a crash between the header and payload writes leaves) —
+  // truncates at the last intact record and the journal stays writable.
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, 4096, /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    BT_ASSERT(a.put("/t/good", "ok") == ErrorCode::OK);
+  }
+  {
+    std::ofstream wal(dir.path + "/wal.bin", std::ios::binary | std::ios::app);
+    const uint32_t len = 100, crc = 0xDEAD;
+    wal.write(reinterpret_cast<const char*>(&len), sizeof(len));
+    wal.write(reinterpret_cast<const char*>(&crc), sizeof(crc));
+    wal.write("partial", 7);
+  }
+  MemCoordinator b(opts);
+  BT_EXPECT(b.durability_status() == ErrorCode::OK);
+  BT_EXPECT_EQ(b.get("/t/good").value(), "ok");
+  BT_EXPECT(b.put("/t/after", "fine") == ErrorCode::OK);
+}
+
+BTEST(Durability, MidLogChainBreakRefusesRecovery) {
+  // Flipping one byte inside an EARLY record's payload breaks the chain
+  // mid-log: silently truncating would discard the LATER (possibly acked)
+  // records, so recovery must hard-fail and the store must serve nothing.
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, 4096, /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    for (int i = 0; i < 8; ++i)
+      BT_ASSERT(a.put("/c/" + std::to_string(i), "v" + std::to_string(i)) == ErrorCode::OK);
+  }
+  {
+    std::fstream wal(dir.path + "/wal.bin",
+                     std::ios::binary | std::ios::in | std::ios::out);
+    wal.seekp(8 + 8 + 2);  // file header + first record header + 2 payload bytes
+    char b = 0;
+    wal.read(&b, 1);
+    wal.seekp(8 + 8 + 2);
+    b = static_cast<char>(b ^ 0x20);
+    wal.write(&b, 1);
+  }
+  MemCoordinator b(opts);
+  BT_EXPECT(b.durability_status() == ErrorCode::DATA_CORRUPTION);
+  // Nothing serveable, nothing mutable: every call answers the verdict.
+  BT_EXPECT(b.get("/c/0").error() == ErrorCode::DATA_CORRUPTION);
+  BT_EXPECT(b.put("/c/new", "x") == ErrorCode::DATA_CORRUPTION);
+  BT_EXPECT(b.lease_grant(1000).error() == ErrorCode::DATA_CORRUPTION);
+  // The damaged file was NOT truncated — forensics keep the bytes.
+  BT_EXPECT(std::filesystem::file_size(dir.path + "/wal.bin") > 8);
+}
+
+BTEST(Durability, LegacyWalUpgradesToChainedJournal) {
+  // A pre-chain journal ([u32 len][payload], no header/CRC) must recover
+  // once through the legacy rules, then compact into the v2 format.
+  TempDir dir;
+  {
+    // Hand-write a legacy WAL: two kRecPut records, exactly the historical
+    // framing (type byte + wire-encoded key/value + lease).
+    std::ofstream wal(dir.path + "/wal.bin", std::ios::binary);
+    for (const auto& [key, value] :
+         {std::pair<std::string, std::string>{"/l/a", "v1"}, {"/l/b", "v2"}}) {
+      wire::Writer w;
+      w.put<uint8_t>(1);  // kRecPut
+      wire::encode(w, key);
+      wire::encode(w, value);
+      w.put<int64_t>(0);
+      const auto rec = w.take();
+      const uint32_t len = static_cast<uint32_t>(rec.size());
+      wal.write(reinterpret_cast<const char*>(&len), sizeof(len));
+      wal.write(reinterpret_cast<const char*>(rec.data()), static_cast<std::streamsize>(rec.size()));
+    }
+  }
+  DurabilityOptions opts{dir.path, /*fsync=*/false, 4096, /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    BT_EXPECT(a.durability_status() == ErrorCode::OK);
+    BT_EXPECT_EQ(a.get("/l/a").value(), "v1");
+    BT_EXPECT_EQ(a.get("/l/b").value(), "v2");
+    BT_EXPECT(a.put("/l/c", "v3") == ErrorCode::OK);
+  }
+  // The reborn journal carries the v2 magic...
+  {
+    std::ifstream wal(dir.path + "/wal.bin", std::ios::binary);
+    uint32_t magic = 0;
+    wal.read(reinterpret_cast<char*>(&magic), sizeof(magic));
+    BT_EXPECT_EQ(magic, wal::kFileMagic);
+  }
+  // ...and a second boot reads everything through the chained path.
+  MemCoordinator b(opts);
+  BT_EXPECT_EQ(b.get("/l/a").value(), "v1");
+  BT_EXPECT_EQ(b.get("/l/c").value(), "v3");
+}
+
+BTEST(Durability, SnapshotCrcRefusesInPlaceDamage) {
+  // v3 snapshots carry a whole-file CRC trailer. The rename is atomic, so
+  // a CRC failure is in-place damage: recovery refuses rather than
+  // applying a partial decode.
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, /*compact_every=*/4,
+                         /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    for (int i = 0; i < 16; ++i)
+      BT_ASSERT(a.put("/s/" + std::to_string(i), "v") == ErrorCode::OK);
+  }
+  BT_ASSERT(std::filesystem::exists(dir.path + "/snapshot.bin"));
+  {  // restart on the intact snapshot first: clean
+    MemCoordinator ok(opts);
+    BT_EXPECT(ok.durability_status() == ErrorCode::OK);
+    BT_EXPECT_EQ(ok.get("/s/3").value(), "v");
+  }
+  {  // flip one byte mid-snapshot
+    std::fstream snap(dir.path + "/snapshot.bin",
+                      std::ios::binary | std::ios::in | std::ios::out);
+    snap.seekp(20);
+    char b = 0;
+    snap.read(&b, 1);
+    snap.seekp(20);
+    b = static_cast<char>(b ^ 0x04);
+    snap.write(&b, 1);
+  }
+  MemCoordinator broken(opts);
+  BT_EXPECT(broken.durability_status() == ErrorCode::DATA_CORRUPTION);
+  BT_EXPECT(broken.get("/s/3").error() == ErrorCode::DATA_CORRUPTION);
+}
+
+BTEST(Durability, OversizedValueRefusedBeforeMutation) {
+  // A value that can never fit one journal frame must be refused UP FRONT
+  // on a durability-configured store — acking it would create a key that
+  // silently dies at the next restart. Memory-only stores take it (nothing
+  // is promised there).
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, 4096, /*group_commit_us=*/0};
+  MemCoordinator durable(opts);
+  const std::string huge(wal::kMaxRecordBytes + 1, 'x');
+  BT_EXPECT(durable.put("/big", huge) == ErrorCode::INVALID_PARAMETERS);
+  BT_EXPECT(durable.get("/big").error() == ErrorCode::COORD_KEY_NOT_FOUND);
+  BT_EXPECT(durable.put("/small", "fits") == ErrorCode::OK);
+  MemCoordinator memory_only;
+  BT_EXPECT(memory_only.put("/big", huge) == ErrorCode::OK);
+}
+
+BTEST(Durability, SnapshotHeaderDamageRefused) {
+  // Snapshots have always been written temp+fsync+rename: a magic that no
+  // longer parses is in-place damage, and treating it as a lenient legacy
+  // snapshot would silently boot with ZERO of the snapshotted keys.
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, /*compact_every=*/4,
+                         /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    for (int i = 0; i < 8; ++i)
+      BT_ASSERT(a.put("/h/" + std::to_string(i), "v") == ErrorCode::OK);
+  }
+  BT_ASSERT(std::filesystem::exists(dir.path + "/snapshot.bin"));
+  {
+    std::fstream snap(dir.path + "/snapshot.bin",
+                      std::ios::binary | std::ios::in | std::ios::out);
+    snap.seekp(0);
+    snap.write("\x00", 1);  // break the magic
+  }
+  MemCoordinator b(opts);
+  BT_EXPECT(b.durability_status() == ErrorCode::DATA_CORRUPTION);
+}
+
+BTEST(Durability, FutureSnapshotVersionRefusedAsInvalidState) {
+  // A snapshot from a NEWER build is intact, not corrupt: the operator
+  // must be told to roll forward, not sent to the corruption runbook.
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, /*compact_every=*/4,
+                         /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    for (int i = 0; i < 8; ++i)
+      BT_ASSERT(a.put("/f/" + std::to_string(i), "v") == ErrorCode::OK);
+  }
+  {
+    // Emulate a v4 writer: bump the version field and recompute the
+    // trailer CRC the way the spec fixes it (final 4 bytes, covering all
+    // preceding bytes — future fields live before the trailer).
+    std::ifstream in(dir.path + "/snapshot.bin", std::ios::binary);
+    std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(in)),
+                               std::istreambuf_iterator<char>());
+    in.close();
+    const uint32_t v4 = 4;
+    std::memcpy(bytes.data() + 4, &v4, sizeof(v4));
+    const uint32_t crc = crc32c(bytes.data(), bytes.size() - 4);
+    std::memcpy(bytes.data() + bytes.size() - 4, &crc, sizeof(crc));
+    std::ofstream out(dir.path + "/snapshot.bin", std::ios::binary | std::ios::trunc);
+    out.write(reinterpret_cast<const char*>(bytes.data()),
+              static_cast<std::streamsize>(bytes.size()));
+  }
+  MemCoordinator b(opts);
+  BT_EXPECT(b.durability_status() == ErrorCode::INVALID_STATE);
+}
+
+BTEST(Durability, FutureWalVersionRefusedWithoutTruncation) {
+  TempDir dir;
+  DurabilityOptions opts{dir.path, /*fsync=*/false, 4096, /*group_commit_us=*/0};
+  {
+    MemCoordinator a(opts);
+    BT_ASSERT(a.put("/f/k", "v") == ErrorCode::OK);
+  }
+  const auto size_before = std::filesystem::file_size(dir.path + "/wal.bin");
+  {
+    std::fstream wal(dir.path + "/wal.bin",
+                     std::ios::binary | std::ios::in | std::ios::out);
+    const uint32_t future = wal::kFileVersion + 1;
+    wal.seekp(4);
+    wal.write(reinterpret_cast<const char*>(&future), sizeof(future));
+  }
+  MemCoordinator b(opts);
+  BT_EXPECT(b.durability_status() == ErrorCode::INVALID_STATE);
+  BT_EXPECT_EQ(std::filesystem::file_size(dir.path + "/wal.bin"), size_before);
 }
 
 BTEST(Durability, ServerRestartClientsReconnectAndResume) {

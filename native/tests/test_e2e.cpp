@@ -5,6 +5,7 @@
 #include <unistd.h>
 
 #include <cstring>
+#include <map>
 #include <random>
 #include <set>
 #include <filesystem>
@@ -1823,4 +1824,62 @@ BTEST(ErasureCoding, TierPressureDemotesCodedObjectsShardVerbatim) {
     BT_EXPECT(back.value() == (std::string(key) == "ecd/obj" ? data : second));
   }
   BT_EXPECT(found_demoted);
+}
+
+BTEST(EndToEnd, DurableClusterRestartServesAckedInlineObjects) {
+  // The embedded half of the crash-durability story (tier-1 pytest mirrors
+  // it from Python): acked inline puts round-trip a FULL cluster restart on
+  // the same persist dir bit-exact, acked removes stay removed, and the
+  // accounting comes back consistent. RAM-placed bytes die with the process
+  // by design — this is exactly why the chaos/crash harnesses drive the
+  // inline tier.
+  char tmpl[] = "/tmp/btpu-e2e-durable-XXXXXX";
+  const std::string dir = mkdtemp(tmpl);
+  auto options = EmbeddedClusterOptions::simple(2, 8 << 20);
+  options.durability.dir = dir;
+  options.durability.group_commit_us = 200;
+
+  std::map<std::string, std::vector<uint8_t>> acked;
+  {
+    EmbeddedCluster cluster(options);
+    BT_ASSERT(cluster.start() == ErrorCode::OK);
+    auto client = cluster.make_client();
+    WorkerConfig wc;
+    wc.replication_factor = 1;  // inline tier refuses multi-replica intent
+    wc.ttl_ms = 0;
+    std::mt19937_64 rng(7);
+    for (int i = 0; i < 24; ++i) {
+      const std::string key = "durable/" + std::to_string(i);
+      std::vector<uint8_t> data(64 + rng() % 1500);
+      for (auto& b : data) b = static_cast<uint8_t>(rng());
+      BT_ASSERT(client->put(key, data.data(), data.size(), wc) == ErrorCode::OK);
+      acked[key] = std::move(data);
+    }
+    for (int i = 0; i < 24; i += 4) {  // acked removes must stay removed
+      const std::string key = "durable/" + std::to_string(i);
+      BT_ASSERT(client->remove(key) == ErrorCode::OK);
+      acked.erase(key);
+    }
+    cluster.stop();
+  }
+  {
+    EmbeddedCluster revived(options);
+    BT_ASSERT(revived.start() == ErrorCode::OK);
+    auto client = revived.make_client();
+    for (const auto& [key, data] : acked) {
+      auto got = client->get(key, /*verify=*/true);
+      BT_ASSERT_OK(got);
+      BT_EXPECT(got.value() == data);
+    }
+    for (int i = 0; i < 24; i += 4) {
+      BT_EXPECT(client->get("durable/" + std::to_string(i)).error() ==
+                ErrorCode::OBJECT_NOT_FOUND);
+    }
+    auto stats = revived.keystone().get_cluster_stats();
+    BT_ASSERT_OK(stats);
+    BT_EXPECT_EQ(stats.value().total_objects, acked.size());
+    BT_EXPECT_EQ(revived.keystone().persist_retry_backlog(), size_t{0});
+    revived.stop();
+  }
+  std::filesystem::remove_all(dir);
 }

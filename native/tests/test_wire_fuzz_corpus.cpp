@@ -141,6 +141,86 @@ BTEST(WireFuzzCorpus, TcpHeaderRejectsHostileOpAndLength) {
   BT_EXPECT(!decode_staged_frame(fv.data(), fv.size(), out));
 }
 
+BTEST(WireFuzzCorpus, WalScanClassifiesTornVsCorrupt) {
+  // The crash-recovery trust boundary: a torn TAIL heals by truncation, a
+  // chain break MID-log must refuse recovery (silently truncating there
+  // would discard acked records). Pinned against the exact scanner
+  // journal_load runs (wal_format.h).
+  namespace wal = btpu::coord::wal;
+  std::vector<uint8_t> file;
+  uint32_t chain = wal::kChainSeed;
+  wal::append_file_header(file);
+  const std::vector<uint8_t> r1{1, 'a', 'b', 'c'};
+  const std::vector<uint8_t> r2{2, 'd', 'e'};
+  wal::append_record(file, chain, r1.data(), r1.size());
+  wal::append_record(file, chain, r2.data(), r2.size());
+
+  auto scan_of = [](std::vector<uint8_t> v) { return wal::scan(v.data(), v.size()); };
+  // Clean: every byte accounted for, both records surfaced.
+  auto clean = scan_of(file);
+  BT_EXPECT(clean.status == wal::ScanStatus::kClean);
+  BT_EXPECT_EQ(clean.records.size(), size_t{2});
+  BT_EXPECT_EQ(clean.valid_end, file.size());
+  // Torn record header: truncate at the last intact record.
+  {
+    auto v = file;
+    v.insert(v.end(), {0x05, 0x00, 0x00});
+    auto res = scan_of(v);
+    BT_EXPECT(res.status == wal::ScanStatus::kTornTail);
+    BT_EXPECT_EQ(res.valid_end, file.size());
+    BT_EXPECT_EQ(res.records.size(), size_t{2});
+  }
+  // Torn payload (complete header, short body): torn tail too.
+  {
+    auto v = file;
+    uint32_t c2 = chain;
+    const std::vector<uint8_t> r3{1, 'z', 'z', 'z', 'z'};
+    wal::append_record(v, c2, r3.data(), r3.size());
+    v.resize(v.size() - 2);
+    auto res = scan_of(v);
+    BT_EXPECT(res.status == wal::ScanStatus::kTornTail);
+    BT_EXPECT_EQ(res.valid_end, file.size());
+  }
+  // Flipped byte mid-log: a COMPLETE record failing its chain CRC is
+  // corruption — valid_end stops before the damage and the verdict is
+  // refuse, not truncate.
+  {
+    auto v = file;
+    v[sizeof(wal::FileHeader) + sizeof(wal::RecordHeader) + 1] ^= 0x01;
+    auto res = scan_of(v);
+    BT_EXPECT(res.status == wal::ScanStatus::kCorrupt);
+    BT_EXPECT_EQ(res.valid_end, sizeof(wal::FileHeader));
+    BT_EXPECT(res.records.empty());
+  }
+  // Rotten length field with bytes beyond it: corruption as well (a torn
+  // append can only leave a SHORT header, never a complete wrong one).
+  {
+    auto v = file;
+    const uint32_t bad = wal::kMaxRecordBytes + 1;
+    std::memcpy(v.data() + sizeof(wal::FileHeader), &bad, sizeof(bad));
+    BT_EXPECT(scan_of(v).status == wal::ScanStatus::kCorrupt);
+  }
+  // Version from the future: refuse outright (kFuture), never truncate.
+  {
+    auto v = file;
+    const uint32_t future = wal::kFileVersion + 1;
+    std::memcpy(v.data() + sizeof(uint32_t), &future, sizeof(future));
+    BT_EXPECT(scan_of(v).status == wal::ScanStatus::kFuture);
+  }
+  // No magic: legacy dispatch; the pre-chain scanner still bounds records.
+  {
+    std::vector<uint8_t> legacy;
+    const uint32_t len = static_cast<uint32_t>(r1.size());
+    const uint8_t* lp = reinterpret_cast<const uint8_t*>(&len);
+    legacy.insert(legacy.end(), lp, lp + sizeof(len));
+    legacy.insert(legacy.end(), r1.begin(), r1.end());
+    BT_EXPECT(scan_of(legacy).status == wal::ScanStatus::kLegacy);
+    auto res = wal::scan_legacy(legacy.data(), legacy.size());
+    BT_EXPECT_EQ(res.records.size(), size_t{1});
+    BT_EXPECT_EQ(res.valid_end, legacy.size());
+  }
+}
+
 BTEST(WireFuzzCorpus, DeadlineTrailerStripIsExact) {
   WorkerConfig wc;
   auto payload = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0});

@@ -26,6 +26,7 @@
 
 #include "btpu/common/env.h"
 #include "btpu/common/wire.h"
+#include "btpu/coord/wal_format.h"
 #include "btest.h"
 
 namespace {
@@ -219,6 +220,19 @@ std::vector<std::pair<std::string, std::string>> golden_rows() {
   add("PutInlineResponse", enc(PutInlineResponse{ErrorCode::OK}));
   add("PingRequest", enc(PingRequest{3}));
   add("PingResponse", enc(PingResponse{11, 3}));
+
+  // Coordinator WAL v2 on-disk framing (wal_format.h): a durable format, so
+  // it is frozen like the durable record envelopes. The canonical journal is
+  // one header + one record ("xyz" payload) — header bytes, chained CRC, and
+  // record framing all pinned by this row.
+  {
+    std::vector<uint8_t> journal;
+    uint32_t chain = coord::wal::kChainSeed;
+    coord::wal::append_file_header(journal);
+    const uint8_t payload[] = {'x', 'y', 'z'};
+    coord::wal::append_record(journal, chain, payload, sizeof(payload));
+    add("wal/file_header+record", hex(journal));
+  }
   return rows;
 }
 

@@ -80,12 +80,20 @@ run_leg "tsan" make -j"$jobs" tsan
 # this smoke leg's cost too.
 run_leg "fuzz-smoke" env BTPU_FUZZ_EXECS="${BTPU_CHECK_FUZZ_EXECS:-100000}" \
   BTPU_FUZZ_TIME="${BTPU_CHECK_FUZZ_TIME:-15}" scripts/fuzz.sh
+# Bounded crash-matrix smoke: every labeled durability crash point
+# (crashpoint.h kAll) fires under live traffic in BOTH WAL sync modes, and
+# each recovery passes the invariant checker (zero acked-object loss, no
+# fabricated state). Keyed on BTPU_CHECK_CRASH_* (same reasoning as the
+# fuzz knobs); the FULL matrix + bb-soak --kill9 run in the nightly CI job.
+run_leg "crash-smoke" ./build/bb-crash --dir /tmp/bb-crash-check \
+  --iters "${BTPU_CHECK_CRASH_ITERS:-1}" --ops "${BTPU_CHECK_CRASH_OPS:-120}" \
+  --windows "${BTPU_CHECK_CRASH_WINDOWS:-400,0}"
 
 echo
 echo "===================================================================="
 echo "== check: summary"
 echo "===================================================================="
-for leg in build lint native-suite tier1-pytest asan tsan fuzz-smoke; do
+for leg in build lint native-suite tier1-pytest asan tsan fuzz-smoke crash-smoke; do
   [ -n "${results[$leg]:-}" ] && printf '  %-14s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
-# Hostile-input fuzz gate (make fuzz) over the four wire-decode surfaces
-# (rpc_frame, control_error, tcp_header, record — native/fuzz/fuzz_targets.h):
+# Hostile-input fuzz gate (make fuzz) over the five wire-decode surfaces
+# (rpc_frame, control_error, tcp_header, record, wal_record —
+# native/fuzz/fuzz_targets.h):
 #
 #   1. libFuzzer leg (clang only): one coverage-guided harness per target,
 #      -fsanitize=fuzzer,address,undefined, seeded from the checked-in
@@ -65,7 +66,7 @@ if [ "$have_libfuzzer" = "1" ]; then
     echo "fuzz: FAIL — could not build the clang-instrumented libbtpu.so" >&2
     exit 1
   fi
-  for t in rpc_frame control_error tcp_header record; do
+  for t in rpc_frame control_error tcp_header record wal_record; do
     bin="build/fuzz/fuzz_$t"
     if ! "${CLANG}" -std=c++20 -O1 -g -Inative/include \
          -fsanitize=fuzzer,address,undefined -DBTPU_FUZZ_TARGET="$t" \

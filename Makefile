@@ -126,7 +126,8 @@ check:
 
 # Wire/decoder TUs carry the extra -Wconversion hammer: these parse hostile
 # bytes, where a u64->u32 narrowing in a length check is a security bug.
-WCONV_SRCS := native/src/net/net.cpp native/src/rpc/rpc_client.cpp \
+WCONV_SRCS := native/src/net/net.cpp native/src/net/uring_engine.cpp \
+              native/src/rpc/rpc_client.cpp \
               native/src/rpc/rpc_server.cpp native/src/common/types.cpp \
               native/src/common/error.cpp native/src/common/deadline.cpp \
               native/src/keystone/keystone_persist.cpp \

@@ -349,6 +349,23 @@ class Client:
             "staged_bytes": "btpu_tcp_staged_byte_count",
             "stream_ops": "btpu_tcp_stream_op_count",
             "stream_bytes": "btpu_tcp_stream_byte_count",
+            # Server-side stream lane: reads this process answered straight
+            # off registered pool pages (zero worker-side staging copies) —
+            # the uring engine's pool-direct sends + the fallback server's
+            # gather-write path.
+            "pool_direct_ops": "btpu_tcp_pool_direct_op_count",
+            "pool_direct_bytes": "btpu_tcp_pool_direct_byte_count",
+            # SEND_ZC completions by kernel verdict (uring engine only):
+            # sent = transmitted straight from pool pages, copied = the
+            # kernel privately copied first (loopback always; sustained
+            # copied on a real NIC is a perf regression signal).
+            "zerocopy_sent": "btpu_tcp_zerocopy_sent_count",
+            "zerocopy_copied": "btpu_tcp_zerocopy_copied_count",
+            # Live io_uring event-loop threads serving TCP data planes in
+            # this process (0 = thread-per-connection fallback), and the
+            # resolved wire worker pool size (BTPU_WIRE_POOL_THREADS).
+            "uring_loops": "btpu_uring_loop_count",
+            "wire_pool_threads": "btpu_wire_pool_threads",
             "cached_ops": "btpu_cached_op_count",
             "cached_bytes": "btpu_cached_byte_count",
             # Overload-robustness scoreboard (deadlines / sheds / hedges /

@@ -161,7 +161,10 @@ def _load() -> ctypes.CDLL:
     # Lane scoreboard counters (optional for the same prebuilt-library reason).
     for name in ("btpu_pvm_byte_count", "btpu_tcp_staged_op_count",
                  "btpu_tcp_staged_byte_count", "btpu_tcp_stream_op_count",
-                 "btpu_tcp_stream_byte_count", "btpu_cached_op_count",
+                 "btpu_tcp_stream_byte_count", "btpu_tcp_pool_direct_op_count",
+                 "btpu_tcp_pool_direct_byte_count", "btpu_tcp_zerocopy_sent_count",
+                 "btpu_tcp_zerocopy_copied_count", "btpu_uring_loop_count",
+                 "btpu_wire_pool_threads", "btpu_cached_op_count",
                  "btpu_cached_byte_count", "btpu_persist_retry_backlog"):
         if hasattr(handle, name):
             fn = getattr(handle, name)

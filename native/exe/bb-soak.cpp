@@ -19,6 +19,9 @@
 // the final cycle runs the recovery invariant checker (chaos_common.h —
 // zero acked-object loss, no fabricated state, clean accounting). This is
 // the kill -9 half of ROADMAP item 5's "no lost acked objects under chaos".
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/resource.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -35,6 +38,8 @@
 
 #include "btpu/client/embedded.h"
 #include "btpu/common/thread_annotations.h"
+#include "btpu/net/net.h"
+#include "fanin_pump.h"
 #include "chaos_common.h"
 #include "tsan_clockwait_shim.h"
 #include "tsan_rma_suppression.h"
@@ -191,19 +196,26 @@ int main(int argc, char** argv) {
   uint64_t seed = 42;
   bool slow_worker = false;
   bool kill9 = false;
+  size_t fanin = 0;
   std::string kill9_dir;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--seconds") && i + 1 < argc) seconds = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--seed") && i + 1 < argc) seed = std::stoull(argv[++i]);
     else if (!std::strcmp(argv[i], "--slow-worker")) slow_worker = true;
     else if (!std::strcmp(argv[i], "--kill9")) kill9 = true;
+    else if (!std::strcmp(argv[i], "--fanin") && i + 1 < argc)
+      fanin = static_cast<size_t>(std::stoull(argv[++i]));
     else if (!std::strcmp(argv[i], "--dir") && i + 1 < argc) kill9_dir = argv[++i];
     else if (!std::strcmp(argv[i], "--help")) {
       std::printf("usage: bb-soak [--seconds N] [--seed S] [--slow-worker]\n"
-                  "               [--kill9 [--dir D]]\n"
+                  "               [--kill9 [--dir D]] [--fanin N]\n"
                   "  --kill9  process-death chaos: SIGKILL + restart the cluster\n"
                   "           process on a durable dir mid-traffic; end-state runs\n"
-                  "           the recovery invariant checker (no lost acked objects)\n");
+                  "           the recovery invariant checker (no lost acked objects)\n"
+                  "  --fanin  N concurrent raw data-plane connections held against\n"
+                  "           worker 0 (TCP wire mode) WHILE the kill/revive chaos\n"
+                  "           runs; the fleet dies with each kill and rebuilds\n"
+                  "           against the revived worker's fresh endpoint\n");
       return 0;
     }
   }
@@ -214,6 +226,24 @@ int main(int argc, char** argv) {
   auto options = client::EmbeddedClusterOptions::simple(4, 64ull << 20);
   options.keystone.scrub_interval_sec = 3600;  // driven by the chaos thread
   options.keystone.scrub_objects_per_pass = 8;
+  if (fanin > 0) {
+    // Fan-in needs a REAL socket data plane to pile connections onto, the
+    // admission gate opened to one-op-per-connection width (no overwrite
+    // if the operator pinned their own), and the fd budget for N sockets
+    // on top of the cluster's own.
+    for (auto& w : options.workers) {
+      w.transport = TransportKind::TCP;
+      w.listen_host = "127.0.0.1";
+    }
+    ::setenv("BTPU_DATA_MAX_INFLIGHT_OPS", "16384", 0);
+    ::setenv("BTPU_DATA_MAX_QUEUE", "16384", 0);
+    ::setenv("BTPU_DATA_MAX_INFLIGHT_BYTES", "8589934592", 0);
+    rlimit lim{};
+    if (::getrlimit(RLIMIT_NOFILE, &lim) == 0 && lim.rlim_cur < lim.rlim_max) {
+      lim.rlim_cur = lim.rlim_max;
+      (void)::setrlimit(RLIMIT_NOFILE, &lim);
+    }
+  }
   client::EmbeddedCluster cluster(std::move(options));
   if (cluster.start() != ErrorCode::OK) {
     std::fprintf(stderr, "soak: cluster start failed\n");
@@ -242,6 +272,14 @@ int main(int argc, char** argv) {
   const auto deadline = Clock::now() + std::chrono::seconds(seconds);
   std::atomic<bool> stop{false};
   std::atomic<bool> failed{false};
+  // Serializes worker OBJECT lifecycle (chaos kill/revive swap the
+  // unique_ptr) against the fan-in driver's raw endpoint resolution
+  // (worker_alive + pools() dereference that object). Held only across
+  // the pointer-touching calls, never across the chaos sleeps. Clients go
+  // through the keystone and need no such gate — this is the price of the
+  // driver reading the worker object directly instead of the control
+  // plane.
+  Mutex worker_gate;
   std::atomic<uint64_t> puts{0}, gets{0}, removes{0}, verify_fails{0}, put_fails{0};
   LiveSet live;
 
@@ -355,20 +393,32 @@ int main(int argc, char** argv) {
       if (stop.load() || Clock::now() >= deadline) break;
       const size_t victim = rng() % cluster.worker_count();
       const int action = static_cast<int>(rng() % 3);
-      if (action == 0 && cluster.worker_alive(victim)) {
-        cluster.kill_worker(victim);
+      auto gated_alive = [&](size_t i) {
+        MutexLock lock(worker_gate);
+        return cluster.worker_alive(i);
+      };
+      auto gated_kill = [&](size_t i) {
+        MutexLock lock(worker_gate);
+        cluster.kill_worker(i);
+      };
+      auto gated_revive = [&](size_t i) {
+        MutexLock lock(worker_gate);
+        return cluster.revive_worker(i);
+      };
+      if (action == 0 && gated_alive(victim)) {
+        gated_kill(victim);
         // Give failure detection + repair a beat, then bring it back.
         std::this_thread::sleep_for(std::chrono::milliseconds(2500));
-        if (cluster.revive_worker(victim) != ErrorCode::OK) {
+        if (gated_revive(victim) != ErrorCode::OK) {
           fail("revive failed", "worker " + std::to_string(victim));
           return;
         }
-      } else if (action == 1 && cluster.worker_alive(victim)) {
+      } else if (action == 1 && gated_alive(victim)) {
         // Graceful drain, then return the capacity as a fresh worker.
         (void)client->drain_worker("worker-" + std::to_string(victim));
-        cluster.kill_worker(victim);  // drop the retired instance
+        gated_kill(victim);  // drop the retired instance
         std::this_thread::sleep_for(std::chrono::milliseconds(500));
-        if (cluster.revive_worker(victim) != ErrorCode::OK) {
+        if (gated_revive(victim) != ErrorCode::OK) {
           fail("revive after drain failed", "worker " + std::to_string(victim));
           return;
         }
@@ -378,9 +428,75 @@ int main(int argc, char** argv) {
     }
   });
 
+  // --fanin N: one driver thread holds N concurrent raw data-plane
+  // connections against worker 0 (the engine multiplexes them on its event
+  // loops; the thread fallback pays a thread each — both must survive the
+  // chaos). Every kill of worker 0 collapses the whole fleet at once —
+  // a mass-EOF wave through the serving engine — and the revived worker
+  // comes back on a FRESH endpoint the driver re-resolves, so the engine's
+  // accept path also sees N-connection reconnect storms. Reads are raw
+  // kOpRead ops against the pool region: bounds-valid, content-agnostic
+  // (the writers own byte correctness; this thread owns fan-in pressure).
+  std::atomic<uint64_t> fanin_ops{0};
+  std::atomic<size_t> fanin_peak{0};
+  std::atomic<uint64_t> fanin_waves{0};
+  std::thread fanin_thread;
+  if (fanin > 0) {
+    fanin_thread = std::thread([&] {
+      constexpr uint64_t kOpLen = 512;
+      while (!stop.load() && Clock::now() < deadline) {
+        // Snapshot the endpoint under the gate: the chaos thread swaps the
+        // worker object under kill/revive, and the descriptor must be
+        // COPIED out before the lock drops (the sockets below then live or
+        // die on their own — a mid-pump kill just EOFs the fleet).
+        RemoteDescriptor remote;
+        uint64_t pool_size = 0;
+        {
+          MutexLock lock(worker_gate);
+          if (cluster.worker_alive(0)) {
+            auto pools = cluster.worker(0).pools();
+            if (!pools.empty()) {
+              remote = pools.front().remote;
+              pool_size = pools.front().size;
+            }
+          }
+        }
+        if (remote.endpoint.empty() || pool_size <= kOpLen) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(50));
+          continue;
+        }
+        auto hp = net::parse_host_port(remote.endpoint);
+        if (!hp) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(50));
+          continue;
+        }
+        const uint64_t rkey = std::stoull(remote.rkey_hex, nullptr, 16);
+        auto cs = exe::fanin_connect(hp->host, hp->port, fanin,
+                                     [&] { return stop.load(); });
+        if (cs.empty()) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(100));
+          continue;
+        }
+        if (cs.size() > fanin_peak.load()) fanin_peak.store(cs.size());
+        fanin_waves.fetch_add(1);
+        // Pump until the kill wave takes the fleet (majority dead — the
+        // chaos working as intended) or time is up; then loop around and
+        // rebuild against the revived worker's fresh endpoint.
+        const size_t fleet = cs.size();
+        const auto st = exe::fanin_pump(
+            cs, remote.remote_base, rkey, pool_size, kOpLen,
+            [&](const exe::FaninStats& s) {
+              return stop.load() || Clock::now() >= deadline || s.dead > fleet / 2;
+            });
+        fanin_ops.fetch_add(st.completed);
+      }
+    });
+  }
+
   for (auto& t : writers) t.join();
   stop.store(true);
   chaos.join();
+  if (fanin_thread.joinable()) fanin_thread.join();
 
   // Settle: every worker alive, give repair/health a few beats to converge.
   // A revive failure here is a FAILED soak, not a shrug: the end-state
@@ -430,6 +546,18 @@ int main(int argc, char** argv) {
       (unsigned long long)unreadable, (unsigned long long)verify_fails.load(),
       (unsigned long long)lost, (unsigned long long)total_objects);
 
+  if (fanin > 0) {
+    std::printf("soak fanin: target %zu conns, peak %zu, %llu ops over %llu waves\n",
+                fanin, fanin_peak.load(), (unsigned long long)fanin_ops.load(),
+                (unsigned long long)fanin_waves.load());
+    // The fleet must actually have stood up (90% slack for mid-kill
+    // connect windows and fd squeeze) and completed ops — a soak where the
+    // fan-in never materialized proves nothing about the engine.
+    if (fanin_peak.load() < fanin - fanin / 10 || fanin_ops.load() == 0) {
+      std::fprintf(stderr, "soak FAILED: fan-in fleet never reached target\n");
+      return 1;
+    }
+  }
   if (failed.load() || unreadable || verify_fails.load() || lost || !accounting_ok) {
     std::fprintf(stderr, "soak FAILED\n");
     return 1;

@@ -1,0 +1,256 @@
+// bb-wire: raw data-plane benchmarks for the serve-engine work.
+//
+//   --stream   remote-TCP-shaped (non-pvm) raw get throughput: the stream
+//              lane (pool-direct, BTPU_STAGED_DATA=0 — the genuinely
+//              cross-host shape) vs the staged shm lane, against the
+//              SAME-RUN in-process one-copy ceiling (a memcpy sweep of the
+//              same transfer size). Reports the lane counters that prove
+//              the stream lane's copies_per_byte: client-side bytes (the
+//              one fused drain) and server pool-direct bytes (zero staging
+//              copies).
+//   --fanin N  connection fan-in: N concurrent connections, each holding
+//              one small read in flight, driven by a single client poll
+//              loop. Ops/s + the engine/thread shape. Raises
+//              RLIMIT_NOFILE toward the hard cap first.
+//
+// JSON rows feed bench.py ("remote stream" / "connection fan-in").
+#include <cerrno>
+#include <csignal>
+#include <poll.h>
+#include <sys/resource.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "btpu/common/env.h"
+#include "btpu/common/procstat.h"
+#include "btpu/net/net.h"
+#include "btpu/transport/data_wire.h"
+#include "btpu/transport/transport.h"
+#include "fanin_pump.h"
+
+using namespace btpu;
+using namespace btpu::transport;
+using namespace btpu::transport::datawire;
+using Clock = std::chrono::steady_clock;
+
+namespace {
+
+double secs_since(Clock::time_point t0) {
+  return std::chrono::duration<double>(Clock::now() - t0).count();
+}
+
+uint64_t parse_rkey_hex(const std::string& hex) { return std::stoull(hex, nullptr, 16); }
+
+// In-process one-copy ceiling for `size`-byte transfers this run: repeated
+// memcpy between two buffers (what a perfect one-copy lane costs). Median
+// of 5 passes — single-pass memcpy rates swing 2x under CFS preemption on
+// small boxes, and a noisy ceiling makes the fraction row meaningless.
+double memcpy_ceiling_gbps(uint64_t size, int iterations) {
+  std::vector<uint8_t> a(size), b(size);
+  for (size_t i = 0; i < a.size(); ++i) a[i] = static_cast<uint8_t>(i * 31 + 7);
+  std::memcpy(b.data(), a.data(), size);  // warm (page-in both buffers)
+  std::vector<double> passes;
+  for (int p = 0; p < 5; ++p) {
+    const auto t0 = Clock::now();
+    for (int i = 0; i < iterations; ++i) {
+      std::memcpy(b.data(), a.data(), size);
+      // Keep the optimizer honest.
+      a[static_cast<size_t>(i) % a.size()] ^= b[0];
+    }
+    passes.push_back(static_cast<double>(size) * iterations / secs_since(t0) / 1e9);
+  }
+  std::sort(passes.begin(), passes.end());
+  return passes[passes.size() / 2];
+}
+
+// One lane measurement: fresh server + fresh region (fresh ephemeral port,
+// so the endpoint pool's per-endpoint staged-support memo can't leak
+// between lanes), `iterations` reads of `size` at rotating offsets.
+double lane_gbps(uint64_t size, int iterations, bool staged, bool* engine_on) {
+  ::setenv("BTPU_STAGED_DATA", staged ? "1" : "0", 1);
+  // Region BEFORE server: locals destruct in reverse order, so every
+  // early return below tears the server down (stop() joins the serving
+  // side) while the registered bytes are still alive — the other order is
+  // a use-after-free window on the error paths (kernel/engine may still
+  // be sending from the region).
+  const uint64_t region_len = std::max<uint64_t>(size * 4, 8ull << 20);
+  std::vector<uint8_t> region(region_len);
+  for (size_t i = 0; i < region.size(); ++i)
+    region[i] = static_cast<uint8_t>((i * 131) >> 3 ^ i);
+  auto server = make_transport_server(TransportKind::TCP);
+  if (!server || server->start("127.0.0.1", 0) != ErrorCode::OK) return 0;
+  if (engine_on) *engine_on = uring_active_loop_count() > 0;
+  auto reg = server->register_region(region.data(), region.size(), "bench");
+  if (!reg.ok()) return 0;
+  auto client = make_transport_client();
+  std::vector<uint8_t> dst(size);
+  const uint64_t rkey = parse_rkey_hex(reg.value().rkey_hex);
+  // Warm (connection + staged handshake).
+  if (client->read(reg.value(), reg.value().remote_base, rkey, dst.data(), size) !=
+      ErrorCode::OK)
+    return 0;
+  const auto t0 = Clock::now();
+  for (int i = 0; i < iterations; ++i) {
+    const uint64_t off = (static_cast<uint64_t>(i) * size) % (region_len - size);
+    if (client->read(reg.value(), reg.value().remote_base + off, rkey, dst.data(), size) !=
+        ErrorCode::OK)
+      return 0;
+  }
+  const double s = secs_since(t0);
+  server->stop();
+  return static_cast<double>(size) * iterations / s / 1e9;
+}
+
+int run_stream_bench(uint64_t size, int iterations) {
+  const double ceiling = memcpy_ceiling_gbps(size, std::max(iterations, 64));
+
+  const uint64_t stream_client_bytes0 = tcp_stream_byte_count();
+  const uint64_t pool_direct_bytes0 = tcp_pool_direct_byte_count();
+  const uint64_t staged_bytes0 = tcp_staged_byte_count();
+  const uint64_t zc_sent0 = tcp_zerocopy_sent_count();
+  const uint64_t zc_copied0 = tcp_zerocopy_copied_count();
+  bool engine = false;
+  const double stream = lane_gbps(size, iterations, /*staged=*/false, &engine);
+  const uint64_t stream_client_bytes = tcp_stream_byte_count() - stream_client_bytes0;
+  const uint64_t pool_direct_bytes = tcp_pool_direct_byte_count() - pool_direct_bytes0;
+  const uint64_t zc_sent = tcp_zerocopy_sent_count() - zc_sent0;
+  const uint64_t zc_copied = tcp_zerocopy_copied_count() - zc_copied0;
+  const double staged = lane_gbps(size, iterations, /*staged=*/true, nullptr);
+  const uint64_t staged_bytes = tcp_staged_byte_count() - staged_bytes0;
+
+  // Stream-lane copies per byte: client fused drain (1) + worker staging
+  // (pool-direct bytes moved with ZERO user-space copies server-side).
+  const double worker_copies =
+      stream_client_bytes ? 1.0 - static_cast<double>(pool_direct_bytes) /
+                                      static_cast<double>(stream_client_bytes)
+                          : 1.0;
+  std::printf(
+      "{\"mode\": \"wire_stream\", \"size\": %llu, \"iterations\": %d, "
+      "\"ceiling_gbps\": %.3f, \"stream_gbps\": %.3f, \"staged_gbps\": %.3f, "
+      "\"ceiling_fraction\": %.3f, \"engine\": %d, "
+      "\"stream_client_bytes\": %llu, \"pool_direct_bytes\": %llu, "
+      "\"staged_lane_bytes\": %llu, \"worker_staging_copies_per_byte\": %.3f, "
+      "\"copies_per_byte_stream\": %.3f, \"zerocopy_sent\": %llu, "
+      "\"zerocopy_copied\": %llu, \"bench_cpus\": %u}\n",
+      static_cast<unsigned long long>(size), iterations, ceiling, stream, staged,
+      ceiling > 0 ? stream / ceiling : 0.0, engine ? 1 : 0,
+      static_cast<unsigned long long>(stream_client_bytes),
+      static_cast<unsigned long long>(pool_direct_bytes),
+      static_cast<unsigned long long>(staged_bytes), worker_copies < 0 ? 0.0 : worker_copies,
+      1.0 + (worker_copies < 0 ? 0.0 : worker_copies),
+      static_cast<unsigned long long>(zc_sent), static_cast<unsigned long long>(zc_copied),
+      std::thread::hardware_concurrency());
+  return 0;
+}
+
+int run_fanin_bench(size_t conns, double seconds, uint64_t op_len) {
+  // One op per connection needs the gate far wider than the serving
+  // default (no overwrite if the operator pinned their own).
+  ::setenv("BTPU_DATA_MAX_INFLIGHT_OPS", "16384", 0);
+  ::setenv("BTPU_DATA_MAX_QUEUE", "16384", 0);
+  ::setenv("BTPU_DATA_MAX_INFLIGHT_BYTES", "8589934592", 0);
+  rlimit lim{};
+  if (::getrlimit(RLIMIT_NOFILE, &lim) == 0 && lim.rlim_cur < lim.rlim_max) {
+    lim.rlim_cur = lim.rlim_max;
+    (void)::setrlimit(RLIMIT_NOFILE, &lim);
+  }
+  const size_t threads_before = process_thread_count();
+  // Region before server: early returns must not free registered bytes
+  // under a still-serving engine (see lane_gbps).
+  std::vector<uint8_t> region(1 << 20);
+  for (size_t i = 0; i < region.size(); ++i) region[i] = static_cast<uint8_t>(i * 13 + 5);
+  auto server = make_transport_server(TransportKind::TCP);
+  if (!server || server->start("127.0.0.1", 0) != ErrorCode::OK) {
+    std::fprintf(stderr, "fanin: server start failed\n");
+    return 1;
+  }
+  const bool engine = uring_active_loop_count() > 0;
+  auto reg = server->register_region(region.data(), region.size(), "fanin");
+  if (!reg.ok()) return 1;
+  auto hp = net::parse_host_port(reg.value().endpoint);
+  if (!hp) return 1;
+  const uint64_t rkey = parse_rkey_hex(reg.value().rkey_hex);
+
+  auto cs = exe::fanin_connect(hp->host, hp->port, conns, nullptr);
+  if (cs.size() < conns)
+    std::fprintf(stderr, "fanin: connected %zu/%zu (fd limit?)\n", cs.size(), conns);
+  if (cs.empty()) return 1;
+  const size_t threads_during = process_thread_count();
+
+  const auto t0 = Clock::now();
+  const auto st = exe::fanin_pump(
+      cs, reg.value().remote_base, rkey, region.size(), op_len,
+      [&](const exe::FaninStats&) { return secs_since(t0) >= seconds; });
+  const double elapsed = secs_since(t0);
+  const uint64_t completed = st.completed;
+  const size_t live_conns = server->debug_connection_count();
+  const size_t connected = cs.size();
+  cs.clear();
+  server->stop();
+  std::printf(
+      "{\"mode\": \"wire_fanin\", \"conns\": %zu, \"seconds\": %.2f, "
+      "\"ops\": %llu, \"ops_per_s\": %.0f, \"op_len\": %llu, \"engine\": %d, "
+      "\"server_live_conns\": %zu, \"threads_before\": %zu, \"threads_during\": %zu, "
+      "\"bench_cpus\": %u}\n",
+      connected, elapsed,
+      static_cast<unsigned long long>(completed), completed / elapsed,
+      static_cast<unsigned long long>(op_len), engine ? 1 : 0, live_conns, threads_before,
+      threads_during, std::thread::hardware_concurrency());
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  ::signal(SIGPIPE, SIG_IGN);  // a dead conn answers via write error, not a kill
+  bool stream = false;
+  bool probe = false;
+  size_t fanin = 0;
+  uint64_t size = 1 << 20;
+  int iterations = 200;
+  double seconds = 3.0;
+  uint64_t op_len = 4096;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--stream")) stream = true;
+    else if (!std::strcmp(argv[i], "--probe")) probe = true;
+    else if (!std::strcmp(argv[i], "--fanin") && i + 1 < argc)
+      fanin = static_cast<size_t>(std::stoull(argv[++i]));
+    else if (!std::strcmp(argv[i], "--size") && i + 1 < argc)
+      size = std::stoull(argv[++i]);
+    else if (!std::strcmp(argv[i], "--iterations") && i + 1 < argc)
+      iterations = std::stoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--seconds") && i + 1 < argc)
+      seconds = std::stod(argv[++i]);
+    else if (!std::strcmp(argv[i], "--op-len") && i + 1 < argc)
+      op_len = std::stoull(argv[++i]);
+    else {
+      std::fprintf(stderr,
+                   "usage: bb-wire --stream [--size BYTES] [--iterations N]\n"
+                   "       bb-wire --fanin N [--seconds S] [--op-len BYTES]\n"
+                   "       bb-wire --probe\n");
+      return 2;
+    }
+  }
+  if (probe) {
+    // CI preflight: exit 0 when this kernel+env can run the io_uring data
+    // plane, 2 when it can't — the BTPU_IOURING_NET=1 leg keys SKIP-vs-run
+    // on this so an incapable kernel scores SKIP, never a hollow PASS.
+    const bool ok = transport::uring_runtime_available();
+    std::printf("{\"uring_available\": %s}\n", ok ? "true" : "false");
+    return ok ? 0 : 2;
+  }
+  if (stream) return run_stream_bench(size, iterations);
+  if (fanin) return run_fanin_bench(fanin, seconds, op_len);
+  std::fprintf(stderr, "need --stream, --fanin N, or --probe\n");
+  return 2;
+}

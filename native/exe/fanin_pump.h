@@ -1,0 +1,117 @@
+// Shared connection fan-in pump for the exe-side harnesses: N nonblocking
+// sockets against one data-plane endpoint, each holding exactly one raw
+// kOpRead in flight, driven by a single poll loop. Used by `bb-wire
+// --fanin` (the bench row) and `bb-soak --fanin` (the kill/revive chaos
+// fleet) so a protocol or drain fix lands ONCE — the two pumps diverging
+// silently is how a bench stops measuring what the soak exercises.
+#pragma once
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "btpu/net/net.h"
+#include "btpu/transport/data_wire.h"
+
+namespace btpu::exe {
+
+struct FaninConn {
+  net::Socket sock;
+  uint64_t recvd{0};  // of the current response (4-byte status + op_len)
+};
+
+struct FaninStats {
+  uint64_t completed{0};
+  size_t dead{0};
+};
+
+// Opens up to `want` nonblocking connections; stops early on connect
+// failure (fd limit, mid-kill) or when `stop` says so — the caller runs
+// with whatever fleet stood up.
+inline std::vector<FaninConn> fanin_connect(const std::string& host, uint16_t port,
+                                            size_t want,
+                                            const std::function<bool()>& stop) {
+  std::vector<FaninConn> conns;
+  conns.reserve(want);
+  for (size_t i = 0; i < want; ++i) {
+    if (stop && stop()) break;
+    auto s = net::tcp_connect(host, port, 2000);
+    if (!s.ok()) break;
+    FaninConn c;
+    c.sock = std::move(s).value();
+    const int fl = ::fcntl(c.sock.fd(), F_GETFL, 0);
+    ::fcntl(c.sock.fd(), F_SETFL, fl | O_NONBLOCK);
+    conns.push_back(std::move(c));
+  }
+  return conns;
+}
+
+// One read op: rotating-stride offset keeps requests spread across the
+// region (4099 is coprime with power-of-two region sizes). 29 bytes into
+// an idle socket: never fills the send buffer.
+inline bool fanin_send(FaninConn& c, size_t idx, uint64_t remote_base, uint64_t rkey,
+                       uint64_t region_len, uint64_t op_len) {
+  const uint64_t off = (idx * 4099) % (region_len - op_len);
+  transport::datawire::DataRequestHeader hdr{transport::datawire::kOpRead,
+                                             remote_base + off, rkey, op_len, 0};
+  return net::write_all(c.sock.fd(), &hdr, sizeof(hdr)) == ErrorCode::OK;
+}
+
+// Primes one op per connection, then pumps poll->drain->resend until
+// `quit(stats)` says stop. Dead connections (peer reset, kill wave) are
+// closed and counted, never retried here — rebuild policy is the
+// caller's (the bench runs one fleet; the soak rebuilds per chaos wave).
+inline FaninStats fanin_pump(std::vector<FaninConn>& conns, uint64_t remote_base,
+                             uint64_t rkey, uint64_t region_len, uint64_t op_len,
+                             const std::function<bool(const FaninStats&)>& quit) {
+  FaninStats st;
+  for (size_t i = 0; i < conns.size(); ++i) {
+    if (!fanin_send(conns[i], i, remote_base, rkey, region_len, op_len)) {
+      conns[i].sock.close();
+      ++st.dead;
+    }
+  }
+  const uint64_t resp_len = 4 + op_len;
+  std::vector<pollfd> fds(conns.size());
+  std::vector<uint8_t> sink(64 * 1024);
+  while (!quit(st)) {
+    for (size_t i = 0; i < conns.size(); ++i)
+      fds[i] = {conns[i].sock.valid() ? conns[i].sock.fd() : -1, POLLIN, 0};
+    const int rc = ::poll(fds.data(), static_cast<nfds_t>(fds.size()), 100);
+    if (rc <= 0) continue;
+    for (size_t i = 0; i < conns.size(); ++i) {
+      if (!conns[i].sock.valid()) continue;
+      if (!(fds[i].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      for (;;) {
+        const uint64_t want = std::min<uint64_t>(resp_len - conns[i].recvd, sink.size());
+        const ssize_t n = ::read(conns[i].sock.fd(), sink.data(), want);
+        if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+          conns[i].sock.close();  // dead conn: drop it, keep the fleet running
+          ++st.dead;
+          break;
+        }
+        if (n < 0) break;  // EAGAIN: come back on the next poll round
+        conns[i].recvd += static_cast<uint64_t>(n);
+        if (conns[i].recvd == resp_len) {
+          ++st.completed;
+          conns[i].recvd = 0;
+          if (!fanin_send(conns[i], i + st.completed, remote_base, rkey, region_len,
+                          op_len)) {
+            conns[i].sock.close();
+            ++st.dead;
+            break;
+          }
+        }
+      }
+    }
+  }
+  return st;
+}
+
+}  // namespace btpu::exe

@@ -3,14 +3,22 @@
 // Rationale (see native/src/transport/local_transport.cpp): the LOCAL
 // transport emulates one-sided RMA with a same-address-space memcpy, so a
 // reader racing a remote write is the modeled hardware behavior — always
-// discarded downstream through an epoch re-check or CRC gate. The hook
-// must live in the EXECUTABLE: TSan reads it during .preinit, before
-// shared-library symbols are guaranteed registered.
+// discarded downstream through an epoch re-check or CRC gate. The pvm
+// lane (pvm_access) is the SAME model over process_vm_readv/writev — for
+// same-process targets it degrades to that same direct memcpy, so a
+// one-sided put racing a concurrent scrub/read of the same pool bytes is
+// again the modeled nondeterminism, CRC-gated downstream (surfaced by
+// bb-soak --fanin, whose TCP wire mode keeps writers on the pvm lane
+// while scrub reads the same pools). The hook must live in the
+// EXECUTABLE: TSan reads it during .preinit, before shared-library
+// symbols are guaranteed registered.
 #pragma once
 
 #if defined(__SANITIZE_THREAD__)
 extern "C" const char* __tsan_default_suppressions() {
-  return "race:btpu::transport::local_access\n";
+  return
+      "race:btpu::transport::local_access\n"
+      "race:btpu::transport::pvm_access\n";
 }
 
 // detect_deadlocks=0: TSan's DYNAMIC lock-order detector is unsound under

@@ -121,6 +121,28 @@ uint64_t btpu_tcp_staged_op_count(void);
 uint64_t btpu_tcp_staged_byte_count(void);
 uint64_t btpu_tcp_stream_op_count(void);
 uint64_t btpu_tcp_stream_byte_count(void);
+/* Server-side stream lane: reads this process answered straight off
+ * registered pool pages (single gather write, ZERO worker-side staging
+ * copies) — the uring engine's pool-direct sends plus the fallback
+ * server's write_iov2 path. Pairs with the client stream counters to prove
+ * remote gets cost exactly one user-space copy (the client's fused
+ * drain). */
+uint64_t btpu_tcp_pool_direct_op_count(void);
+uint64_t btpu_tcp_pool_direct_byte_count(void);
+/* SEND_ZC completions by kernel verdict (uring engine only): sent =
+ * transmitted straight from pool pages, copied = the kernel privately
+ * copied first (loopback always; sustained copied on a real NIC is a perf
+ * regression signal). Both 0 when ZC is off (BTPU_IOURING_ZC=0, payloads
+ * under BTPU_ZC_THRESHOLD, no kernel SEND_ZC, or the fallback server). */
+uint64_t btpu_tcp_zerocopy_sent_count(void);
+uint64_t btpu_tcp_zerocopy_copied_count(void);
+/* Live io_uring event-loop threads serving TCP data planes in this
+ * process; 0 = thread-per-connection fallback everywhere (no kernel
+ * support, or BTPU_FORCE_NO_URING=1). */
+uint64_t btpu_uring_loop_count(void);
+/* Resolved size of the shared wire worker pool (BTPU_WIRE_POOL_THREADS
+ * override, else min(hw-1, 6)); read once per process at first use. */
+uint64_t btpu_wire_pool_threads(void);
 uint64_t btpu_cached_op_count(void);
 uint64_t btpu_cached_byte_count(void);
 

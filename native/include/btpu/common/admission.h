@@ -87,6 +87,20 @@ class AdmissionGate {
     return self.verdict;
   }
 
+  // Non-blocking admission for event-loop servers (the uring data plane):
+  // enters and returns true when capacity allows, false otherwise — the
+  // caller parks the op in its OWN queue (mirroring the adaptive-LIFO
+  // semantics above) and retries after releases. Thread waiters queue
+  // first so an event loop sharing a gate with blocking callers cannot
+  // starve them. Every true MUST be paired with release(bytes).
+  [[nodiscard]] bool try_enter(uint64_t bytes = 0) {
+    MutexLock lock(mutex_);
+    if (!queue_.empty()) return false;
+    if (!can_enter_locked(bytes)) return false;
+    enter_locked(bytes);
+    return true;
+  }
+
   void release(uint64_t bytes = 0) {
     MutexLock lock(mutex_);
     --inflight_;

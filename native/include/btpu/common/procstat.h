@@ -1,0 +1,24 @@
+// Tiny /proc self-introspection helpers for diagnostics: the fan-in tests
+// and bb-wire both pin the "no thread per connection" shape by watching
+// the process thread count, and a shared parser is how the two stay
+// honest together.
+#pragma once
+
+#include <cstddef>
+#include <fstream>
+#include <string>
+
+namespace btpu {
+
+// Live thread count of this process (0 if /proc is unreadable).
+inline size_t process_thread_count() {
+  std::ifstream status("/proc/self/status");
+  std::string line;
+  while (std::getline(status, line)) {
+    if (line.rfind("Threads:", 0) == 0)
+      return static_cast<size_t>(std::stoul(line.substr(8)));
+  }
+  return 0;
+}
+
+}  // namespace btpu

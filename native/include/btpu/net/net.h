@@ -72,6 +72,11 @@ Result<Socket> tcp_accept(const Socket& listener, int timeout_ms = -1);
 
 ErrorCode read_exact(int fd, void* buf, size_t n);
 ErrorCode write_all(int fd, const void* buf, size_t n);
+// write_all for callers that KNOW fd is a regular file (WAL appends,
+// snapshot dumps): plain write(2) loop, skipping the send()-ENOTSOCK
+// probe write_all pays per call to stay SIGPIPE-safe on sockets — that
+// probe is a guaranteed-failing syscall on every file append otherwise.
+ErrorCode file_write_all(int fd, const void* buf, size_t n);
 // Scatter-gather write of header + payload without copying the payload.
 ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn);
 

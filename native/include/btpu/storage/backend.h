@@ -100,6 +100,20 @@ class StorageBackend {
   virtual ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) = 0;
   virtual ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) = 0;
 
+  // Backing-file descriptor for tiers whose region offsets map 1:1 onto a
+  // flat file (the io_uring disk backend), or -1. The TCP data plane's
+  // uring engine uses it to submit region READS on the same ring as its
+  // socket ops — disk bytes flow file -> connection buffer -> socket with
+  // no callback thread and no staging segment. `odirect` (when non-null)
+  // reports whether the fd is O_DIRECT (the engine then 512-aligns).
+  // Ownership: the backend keeps the fd open until shutdown(); the worker
+  // stops transports before backend shutdown, so borrowers never outlive
+  // it. WRITES stay on write_at — only reads ride the direct lane.
+  virtual int direct_io_fd(bool* odirect) const {
+    if (odirect) *odirect = false;
+    return -1;
+  }
+
   // Disk tiers persist bytes across restarts; memory tiers do not.
   virtual bool persistent() const { return false; }
 

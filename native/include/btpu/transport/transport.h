@@ -95,6 +95,24 @@ class TransportServer {
     (void)pull_fn;
     return ErrorCode::NOT_IMPLEMENTED;
   }
+  // Exposes a virtual region's backing FILE to the transport (disk tiers:
+  // region offset == file offset on the flat backing file). The uring data
+  // plane then serves reads by submitting the disk read on the SAME ring
+  // as the socket ops — no callback thread, no staging segment. `odirect`
+  // flags an O_DIRECT fd (the engine 512-aligns its window). The fd is
+  // BORROWED: the backend keeps it open until after the transport stops.
+  // Transports without a ring engine ignore this (callback path serves).
+  virtual ErrorCode attach_direct_io(const RemoteDescriptor& desc, int fd, bool odirect) {
+    (void)desc;
+    (void)fd;
+    (void)odirect;
+    return ErrorCode::NOT_IMPLEMENTED;
+  }
+  // Diagnostics: live data-plane connections (uring engine) or live
+  // serving threads (thread-per-connection fallback). Tests use it to pin
+  // the fan-in shape (thousands of conns, zero per-conn threads) and the
+  // fallback's thread-reaping bound.
+  virtual size_t debug_connection_count() const { return 0; }
 };
 
 // One wire-level one-sided transfer in a batch. Always flat addressing
@@ -265,6 +283,30 @@ uint64_t tcp_staged_op_count() noexcept;
 uint64_t tcp_staged_byte_count() noexcept;
 uint64_t tcp_stream_op_count() noexcept;
 uint64_t tcp_stream_byte_count() noexcept;
+// Server-side stream lane: reads answered straight off registered pool
+// pages (single gather write, ZERO worker-side staging copies) — by the
+// uring engine's pool-direct sends and the fallback server's write_iov2
+// path alike. The pair proves the one-copy claim for remote gets: total
+// user-space copies = the client's fused drain, nothing on the worker.
+uint64_t tcp_pool_direct_op_count() noexcept;
+uint64_t tcp_pool_direct_byte_count() noexcept;
+// SEND_ZC completions by kernel verdict (engine only, REPORT_USAGE
+// notifs): sent = transmitted straight from pool pages, copied = the
+// kernel privately copied first (loopback always lands here — sustained
+// copied on a real NIC means the ZC lane is a net loss; alert on it, see
+// docs/OPERATIONS.md). Both 0 when ZC is off (BTPU_IOURING_ZC=0, payloads
+// under BTPU_ZC_THRESHOLD, kernels without SEND_ZC, or the fallback
+// server).
+uint64_t tcp_zerocopy_sent_count() noexcept;
+uint64_t tcp_zerocopy_copied_count() noexcept;
+// Live io_uring event-loop threads serving TCP data planes in this process
+// (0 = every server is on the thread-per-connection fallback). Defined in
+// net/uring_engine.cpp.
+size_t uring_active_loop_count() noexcept;
+// Whether a TCP server started NOW would run the uring engine: env gate
+// (BTPU_FORCE_NO_URING) + a runtime io_uring probe. Tests and benches use
+// it to know which engine they are measuring.
+bool uring_runtime_available();
 
 // Shared data-path worker pool (tcp_transport.cpp): runs fn(0..n-1) across
 // the pool plus the calling thread and returns when all calls completed.
@@ -273,6 +315,10 @@ uint64_t tcp_stream_byte_count() noexcept;
 // (wire_parallel_capacity() == 0).
 void wire_parallel_for(size_t n, const std::function<void(size_t)>& fn);
 size_t wire_parallel_capacity() noexcept;
+// The size the pool runs (or would run) at, WITHOUT instantiating it —
+// the metrics/capi accessor: a /metrics scrape on a control-plane-only
+// process must not spawn data-path worker threads as a side effect.
+size_t wire_pool_threads_resolved() noexcept;
 
 // PVM lane (same-host one-sided via process_vm_readv/writev — see
 // pvm_transport.cpp). Workers advertise `pvm_make_endpoint(base, len)` on

@@ -340,6 +340,16 @@ uint64_t btpu_tcp_staged_op_count(void) { return transport::tcp_staged_op_count(
 uint64_t btpu_tcp_staged_byte_count(void) { return transport::tcp_staged_byte_count(); }
 uint64_t btpu_tcp_stream_op_count(void) { return transport::tcp_stream_op_count(); }
 uint64_t btpu_tcp_stream_byte_count(void) { return transport::tcp_stream_byte_count(); }
+uint64_t btpu_tcp_pool_direct_op_count(void) { return transport::tcp_pool_direct_op_count(); }
+uint64_t btpu_tcp_pool_direct_byte_count(void) {
+  return transport::tcp_pool_direct_byte_count();
+}
+uint64_t btpu_tcp_zerocopy_sent_count(void) { return transport::tcp_zerocopy_sent_count(); }
+uint64_t btpu_tcp_zerocopy_copied_count(void) {
+  return transport::tcp_zerocopy_copied_count();
+}
+uint64_t btpu_uring_loop_count(void) { return transport::uring_active_loop_count(); }
+uint64_t btpu_wire_pool_threads(void) { return transport::wire_pool_threads_resolved(); }
 uint64_t btpu_cached_op_count(void) { return cache::cached_op_count(); }
 uint64_t btpu_cached_byte_count(void) { return cache::cached_byte_count(); }
 

@@ -160,7 +160,7 @@ void MemCoordinator::journal_break_locked() {
 
 bool MemCoordinator::journal_write_header_locked() {
   const wal::FileHeader header{wal::kFileMagic, wal::kFileVersion};
-  if (net::write_all(wal_fd_, &header, sizeof(header)) != ErrorCode::OK) return false;
+  if (net::file_write_all(wal_fd_, &header, sizeof(header)) != ErrorCode::OK) return false;
   wal_chain_ = wal::kChainSeed;
   return true;
 }
@@ -185,9 +185,9 @@ void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
   wal::RecordHeader header;
   header.len = static_cast<uint32_t>(record.size());
   header.chain_crc = wal::chain_next(wal_chain_, record.data(), record.size());
-  bool wrote = net::write_all(wal_fd_, &header, sizeof(header)) == ErrorCode::OK;
+  bool wrote = net::file_write_all(wal_fd_, &header, sizeof(header)) == ErrorCode::OK;
   if (wrote) crashpoint::hit("wal.mid_append");
-  wrote = wrote && net::write_all(wal_fd_, record.data(), record.size()) == ErrorCode::OK;
+  wrote = wrote && net::file_write_all(wal_fd_, record.data(), record.size()) == ErrorCode::OK;
   if (!wrote) {
     // Roll the partial record back: a complete-looking record with a broken
     // chain mid-file would read as CORRUPTION (hard recovery failure) on
@@ -437,7 +437,7 @@ void MemCoordinator::journal_compact_locked() {
   const std::vector<uint8_t> snapshot = snapshot_bytes_locked();
   const std::string tmp = snapshot_path() + ".tmp";
   int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0 || net::write_all(fd, snapshot.data(), snapshot.size()) != ErrorCode::OK ||
+  if (fd < 0 || net::file_write_all(fd, snapshot.data(), snapshot.size()) != ErrorCode::OK ||
       ::fsync(fd) != 0) {
     // The fsync is part of the guard: an unsynced snapshot must never be
     // renamed into place (the WAL truncate below would then be the only

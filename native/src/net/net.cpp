@@ -173,10 +173,39 @@ ErrorCode read_exact(int fd, void* buf, size_t n) {
   return ErrorCode::OK;
 }
 
-ErrorCode write_all(int fd, const void* buf, size_t n) {
+// Socket sends go through send/sendmsg with MSG_NOSIGNAL, never raw
+// write/writev: a peer that disconnects with a response still pending
+// answers the next send with RST, and a raw write would raise SIGPIPE and
+// KILL the serving process — a vanished client must read as
+// NETWORK_ERROR on that one connection, not as worker death. (Found by
+// the uring-engine fan-in work: the event loop's ring sends get -EPIPE
+// for free, and the thread server's serve loop died where the engine
+// survived.) These helpers also serve FILE fds (the coordinator WAL
+// appends through write_all), where send() answers ENOTSOCK — fall back
+// to plain write/writev there; a regular file cannot SIGPIPE.
+ErrorCode file_write_all(int fd, const void* buf, size_t n) {
   const auto* p = static_cast<const uint8_t*>(buf);
   while (n > 0) {
-    ssize_t rc = ::write(fd, p, n);
+    const ssize_t rc = ::write(fd, p, n);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return ErrorCode::NETWORK_ERROR;
+    }
+    p += rc;
+    n -= static_cast<size_t>(rc);
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode write_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  bool is_file = false;  // sticky per call: don't re-pay the doomed send()
+  while (n > 0) {
+    ssize_t rc = is_file ? ::write(fd, p, n) : ::send(fd, p, n, MSG_NOSIGNAL);
+    if (rc < 0 && !is_file && errno == ENOTSOCK) {
+      is_file = true;
+      continue;
+    }
     if (rc < 0) {
       if (errno == EINTR) continue;
       return ErrorCode::NETWORK_ERROR;
@@ -190,8 +219,21 @@ ErrorCode write_all(int fd, const void* buf, size_t n) {
 ErrorCode write_iov2(int fd, const void* h, size_t hn, const void* p, size_t pn) {
   iovec iov[2] = {{const_cast<void*>(h), hn}, {const_cast<void*>(p), pn}};
   size_t idx = 0;
+  bool is_file = false;  // sticky per call, as in write_all
   while (idx < 2) {
-    ssize_t rc = ::writev(fd, &iov[idx], static_cast<int>(2 - idx));
+    ssize_t rc;
+    if (is_file) {
+      rc = ::writev(fd, &iov[idx], static_cast<int>(2 - idx));
+    } else {
+      msghdr msg{};
+      msg.msg_iov = &iov[idx];
+      msg.msg_iovlen = 2 - idx;
+      rc = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+      if (rc < 0 && errno == ENOTSOCK) {
+        is_file = true;
+        continue;
+      }
+    }
     if (rc < 0) {
       if (errno == EINTR) continue;
       return ErrorCode::NETWORK_ERROR;

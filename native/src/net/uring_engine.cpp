@@ -1,0 +1,1685 @@
+// io_uring event-loop data plane (see uring_engine.h for the design and
+// docs/CORRECTNESS.md §8 for the ownership/locking argument).
+//
+// Raw io_uring syscalls — liburing is not in this image (the disk backend
+// made the same call, iouring_disk_backend.cpp). Each loop owns one ring
+// and every connection accepted on it; a connection is a small state
+// machine with AT MOST ONE submission in flight, so a loop multiplexes
+// thousands of connections with conns+3 outstanding entries and zero
+// per-connection threads. Pool-direct reads answer with a single gather
+// SENDMSG whose payload iovec points INTO the registered pool region — the
+// worker never copies the bytes. At/above BTPU_ZC_THRESHOLD those sends
+// upgrade to IORING_OP_SEND_ZC (kernel-probed; REPORT_USAGE notifs feed
+// btpu_zerocopy_{sent,copied}_count): it pins pages and doubles
+// completions per send, which loses below multi-MiB payloads — and always
+// on loopback, where the kernel copies regardless — so the threshold
+// defaults high and the copied counter is the regression alarm
+// (docs/BYTE_PATHS.md, docs/OPERATIONS.md).
+#include "uring_engine.h"
+
+#include <linux/io_uring.h>
+#include <linux/time_types.h>
+#include <poll.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/uio.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <thread>
+#include <unordered_set>
+#include <vector>
+
+#include "btpu/common/deadline.h"
+#include "btpu/common/env.h"
+#include "btpu/common/log.h"
+#include "btpu/transport/data_wire.h"
+
+namespace btpu::transport {
+
+using namespace datawire;
+
+// This image builds against 5.12-era uapi headers, which predate the 6.x
+// zero-copy send machinery. The KERNEL is what decides support (probed per
+// ring via IORING_REGISTER_PROBE at init); these mirror the upstream
+// values so the binary can use SEND_ZC on kernels that have it.
+#ifndef IORING_OP_SEND_ZC
+#define IORING_OP_SEND_ZC 47
+#endif
+#ifndef IORING_CQE_F_MORE
+#define IORING_CQE_F_MORE (1U << 1)
+#endif
+#ifndef IORING_CQE_F_NOTIF
+#define IORING_CQE_F_NOTIF (1U << 3)
+#endif
+#ifndef IORING_SEND_ZC_REPORT_USAGE
+#define IORING_SEND_ZC_REPORT_USAGE (1U << 3) /* io_uring_sqe.ioprio flag */
+#endif
+#ifndef IORING_NOTIF_USAGE_ZC_COPIED
+#define IORING_NOTIF_USAGE_ZC_COPIED (1U << 31) /* notif cqe.res bit */
+#endif
+#ifndef IORING_REGISTER_PROBE
+#define IORING_REGISTER_PROBE 8
+#endif
+
+// TSan cannot see io_uring: bytes the ring moves over a socket carry none
+// of the happens-before edges libtsan models for INTERCEPTED read/write
+// syscalls, so every engine-served op would falsely race with its client
+// (the kernel's socket ordering is the real edge; TSan just can't observe
+// it). Under TSan builds only, mirror that ordering with zero-length
+// intercepted syscalls on the same fd: recv(fd,·,0) when a ring recv
+// completes (FdAcquire — pairs with the client's write release), and
+// send(fd,·,0) before a response is submitted (FdRelease — pairs with the
+// client's read acquire). Production builds compile these to nothing.
+// Documented in docs/CORRECTNESS.md §8.
+#if defined(__SANITIZE_THREAD__)
+#define BTPU_URING_TSAN_FD_SYNC 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define BTPU_URING_TSAN_FD_SYNC 1
+#endif
+#endif
+
+namespace {
+
+std::atomic<size_t> g_active_loops{0};
+
+inline void tsan_fd_acquire(int fd) {
+#ifdef BTPU_URING_TSAN_FD_SYNC
+  char b;
+  (void)!::recv(fd, &b, 0, MSG_DONTWAIT);
+#else
+  (void)fd;
+#endif
+}
+inline void tsan_fd_release(int fd) {
+#ifdef BTPU_URING_TSAN_FD_SYNC
+  (void)!::send(fd, "", 0, MSG_DONTWAIT | MSG_NOSIGNAL);
+#else
+  (void)fd;
+#endif
+}
+
+int sys_io_uring_setup(unsigned entries, io_uring_params* p) {
+  return static_cast<int>(::syscall(__NR_io_uring_setup, entries, p));
+}
+int sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete, unsigned flags) {
+  return static_cast<int>(
+      ::syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags, nullptr, 0));
+}
+
+// Kernel-side opcode support, asked of the ring itself (headers can't
+// know): true when this kernel can serve IORING_OP_SEND_ZC.
+bool ring_supports_send_zc(int ring_fd) {
+  struct Probe {
+    io_uring_probe head;
+    io_uring_probe_op ops[256];
+  } probe{};
+  if (::syscall(__NR_io_uring_register, ring_fd, IORING_REGISTER_PROBE, &probe,
+                256) < 0)
+    return false;
+  if (probe.head.ops_len <= IORING_OP_SEND_ZC) return false;
+  return (probe.ops[IORING_OP_SEND_ZC].flags & IO_URING_OP_SUPPORTED) != 0;
+}
+
+// user_data encoding: values < 8 are loop-level ops; anything else is the
+// owning Conn* (allocated, so 8-byte aligned — low bits are always clear).
+constexpr uint64_t kUdAccept = 1;
+constexpr uint64_t kUdEvent = 2;
+constexpr uint64_t kUdTimeout = 3;
+constexpr uint64_t kUdCancel = 4;  // completion of an ASYNC_CANCEL itself
+
+io_uring_sqe make_sqe(uint8_t opcode, int fd, const void* addr, uint32_t len, uint64_t off,
+                      uint64_t user_data) {
+  io_uring_sqe s;
+  std::memset(&s, 0, sizeof(s));
+  s.opcode = opcode;
+  s.fd = fd;
+  s.addr = reinterpret_cast<uint64_t>(addr);
+  s.len = len;
+  s.off = off;
+  s.user_data = user_data;
+  return s;
+}
+
+// Single-thread io_uring wrapper: only the owning loop thread touches it.
+// push() never fails — entries that don't fit the SQ wait in a local
+// backlog and flush as the kernel consumes the ring.
+class Ring {
+ public:
+  ~Ring() { close_ring(); }
+
+  bool init(unsigned entries) {
+    for (unsigned want = entries; want >= 16; want /= 2) {
+      io_uring_params params{};
+      // Deep CQ: with one outstanding op per connection, completions scale
+      // with CONNECTIONS, not SQ depth. FEAT_NODROP (5.5+) buffers any
+      // overflow past this in the kernel, so a shallow CQ degrades to
+      // -EBUSY backpressure instead of lost completions.
+      params.flags = IORING_SETUP_CQSIZE;
+      params.cq_entries = want * 8 < 4096 ? 4096 : want * 8;
+      int fd = sys_io_uring_setup(want, &params);
+      if (fd < 0 && errno == EINVAL) {
+        // Pre-CQSIZE kernel: retry plain before shrinking.
+        io_uring_params plain{};
+        fd = sys_io_uring_setup(want, &plain);
+        params = plain;
+      }
+      if (fd < 0) continue;
+      if (!(params.features & IORING_FEAT_NODROP)) {
+        // A kernel that can silently drop completions would wedge the
+        // outstanding-op accounting; let the thread server take over.
+        ::close(fd);
+        return false;
+      }
+      ring_fd_ = fd;
+      if (map_rings(params)) return true;
+      close_ring();
+    }
+    return false;
+  }
+
+  bool ok() const noexcept { return ring_fd_ >= 0; }
+
+  int fd() const noexcept { return ring_fd_; }
+
+  void push(const io_uring_sqe& sqe) {
+    if (backlog_.empty() && try_place(sqe)) return;
+    backlog_.push_back(sqe);
+  }
+
+  void flush() {
+    while (!backlog_.empty() && try_place(backlog_.front())) backlog_.pop_front();
+  }
+
+  // Submits everything staged; blocks for >= wait_nr completions.
+  // Returns >= 0 on success, -errno on failure (-EBUSY/-EINTR are benign:
+  // drain completions and come back).
+  int enter(unsigned wait_nr) {
+    const unsigned to_submit = staged_;
+    const int rc = sys_io_uring_enter(ring_fd_, to_submit, wait_nr, IORING_ENTER_GETEVENTS);
+    if (rc < 0) return -errno;
+    staged_ -= std::min(static_cast<unsigned>(rc), staged_);
+    return rc;
+  }
+
+  unsigned drain(io_uring_cqe* out, unsigned max) {
+    unsigned head = cq_head_->load(std::memory_order_relaxed);
+    const unsigned tail = cq_tail_->load(std::memory_order_acquire);
+    unsigned n = 0;
+    while (head != tail && n < max) {
+      out[n++] = cqes_[head & cq_mask_];
+      ++head;
+    }
+    cq_head_->store(head, std::memory_order_release);
+    return n;
+  }
+
+ private:
+  bool map_rings(const io_uring_params& params) {
+    sq_entries_ = params.sq_entries;
+    sq_ring_sz_ = params.sq_off.array + params.sq_entries * sizeof(unsigned);
+    cq_ring_sz_ = params.cq_off.cqes + params.cq_entries * sizeof(io_uring_cqe);
+    sq_ring_ = ::mmap(nullptr, sq_ring_sz_, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, ring_fd_, IORING_OFF_SQ_RING);
+    cq_ring_ = ::mmap(nullptr, cq_ring_sz_, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, ring_fd_, IORING_OFF_CQ_RING);
+    sqes_sz_ = params.sq_entries * sizeof(io_uring_sqe);
+    sqes_ = static_cast<io_uring_sqe*>(::mmap(nullptr, sqes_sz_, PROT_READ | PROT_WRITE,
+                                              MAP_SHARED | MAP_POPULATE, ring_fd_,
+                                              IORING_OFF_SQES));
+    if (sq_ring_ == MAP_FAILED || cq_ring_ == MAP_FAILED ||
+        sqes_ == reinterpret_cast<io_uring_sqe*>(MAP_FAILED))
+      return false;
+    auto* sq = static_cast<uint8_t*>(sq_ring_);
+    sq_head_ = reinterpret_cast<std::atomic<unsigned>*>(sq + params.sq_off.head);
+    sq_tail_ = reinterpret_cast<std::atomic<unsigned>*>(sq + params.sq_off.tail);
+    sq_mask_ = *reinterpret_cast<unsigned*>(sq + params.sq_off.ring_mask);
+    sq_array_ = reinterpret_cast<unsigned*>(sq + params.sq_off.array);
+    auto* cq = static_cast<uint8_t*>(cq_ring_);
+    cq_head_ = reinterpret_cast<std::atomic<unsigned>*>(cq + params.cq_off.head);
+    cq_tail_ = reinterpret_cast<std::atomic<unsigned>*>(cq + params.cq_off.tail);
+    cq_mask_ = *reinterpret_cast<unsigned*>(cq + params.cq_off.ring_mask);
+    cqes_ = reinterpret_cast<io_uring_cqe*>(cq + params.cq_off.cqes);
+    return true;
+  }
+
+  bool try_place(const io_uring_sqe& sqe) {
+    const unsigned head = sq_head_->load(std::memory_order_acquire);
+    const unsigned tail = sq_tail_->load(std::memory_order_relaxed);
+    if (tail - head >= sq_entries_) return false;
+    const unsigned idx = tail & sq_mask_;
+    sqes_[idx] = sqe;
+    sq_array_[idx] = idx;
+    sq_tail_->store(tail + 1, std::memory_order_release);
+    ++staged_;
+    return true;
+  }
+
+  void close_ring() {
+    if (sq_ring_ && sq_ring_ != MAP_FAILED) ::munmap(sq_ring_, sq_ring_sz_);
+    if (cq_ring_ && cq_ring_ != MAP_FAILED) ::munmap(cq_ring_, cq_ring_sz_);
+    if (sqes_ && sqes_ != reinterpret_cast<io_uring_sqe*>(MAP_FAILED))
+      ::munmap(sqes_, sqes_sz_);
+    sq_ring_ = cq_ring_ = nullptr;
+    sqes_ = nullptr;
+    if (ring_fd_ >= 0) ::close(ring_fd_);
+    ring_fd_ = -1;
+  }
+
+  int ring_fd_{-1};
+  unsigned sq_entries_{0};
+  unsigned staged_{0};  // placed in the SQ, not yet submitted
+  std::deque<io_uring_sqe> backlog_;
+  void* sq_ring_{nullptr};
+  void* cq_ring_{nullptr};
+  io_uring_sqe* sqes_{nullptr};
+  size_t sq_ring_sz_{0}, cq_ring_sz_{0}, sqes_sz_{0};
+  std::atomic<unsigned>*sq_head_{}, *sq_tail_{}, *cq_head_{}, *cq_tail_{};
+  unsigned sq_mask_{0}, cq_mask_{0};
+  unsigned* sq_array_{nullptr};
+  io_uring_cqe* cqes_{nullptr};
+};
+
+// Offload pool for BLOCKING work a loop thread must never run: virtual-
+// region callbacks without a direct fd (device providers, mmap-disk) and
+// fabric offer/pull (pull blocks until the device transfer lands). Threads
+// are lazy up to the cap and exit at pool destruction.
+class ExecPool {
+ public:
+  explicit ExecPool(unsigned max_threads) : max_threads_(max_threads ? max_threads : 1) {}
+
+  ~ExecPool() {
+    {
+      MutexLock lock(mutex_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    std::vector<std::thread> joiners;
+    {
+      MutexLock lock(mutex_);
+      joiners.swap(threads_);
+    }
+    for (auto& t : joiners)
+      if (t.joinable()) t.join();
+  }
+
+  void submit(std::function<void()> task) {
+    MutexLock lock(mutex_);
+    tasks_.push_back(std::move(task));
+    if (idle_ == 0 && threads_.size() < max_threads_)
+      threads_.emplace_back([this] { worker(); });
+    cv_.notify_one();
+  }
+
+ private:
+  void worker() {
+    MutexLock lock(mutex_);
+    for (;;) {
+      while (tasks_.empty() && !stop_) {
+        ++idle_;
+        cv_.wait(lock);
+        --idle_;
+      }
+      if (tasks_.empty() && stop_) return;
+      auto task = std::move(tasks_.front());
+      tasks_.pop_front();
+      lock.unlock();
+      task();  // posts its completion to the owning loop itself
+      lock.lock();
+    }
+  }
+
+  Mutex mutex_;
+  std::condition_variable_any cv_;
+  std::deque<std::function<void()>> tasks_ BTPU_GUARDED_BY(mutex_);
+  std::vector<std::thread> threads_ BTPU_GUARDED_BY(mutex_);
+  bool stop_ BTPU_GUARDED_BY(mutex_){false};
+  unsigned idle_ BTPU_GUARDED_BY(mutex_){0};
+  const size_t max_threads_;
+};
+
+class UringLoop;
+
+// One connection's op state machine. Owned and mutated by exactly one loop
+// thread; an exec-pool task may READ the fields frozen at submit time
+// (offsets, scratch pointer) but the loop never touches the Conn while
+// exec_out is set, so there is no concurrent access (CORRECTNESS §8).
+struct Conn {
+  int fd{-1};
+  UringLoop* loop{nullptr};
+
+  enum class S : uint8_t {
+    kHeader,    // accumulating the fixed request header
+    kTrailer,   // accumulating the op's trailer bytes (staged/hello/fabric)
+    kPayload,   // write-op payload landing (pool-direct, scratch, or drain)
+    kDiskRead,  // ring-submitted read from a region's backing file
+    kExec,      // blocking callback in flight on the exec pool
+    kSend,      // response (status [+ payload iovec]) going out
+    kParked,    // admission-parked: no submission outstanding
+  } state{S::kHeader};
+
+  // Control-plane accumulation: header + largest trailer (fabric pull:
+  // u64 id + u16 alen + 255 addr bytes).
+  uint8_t ctl[sizeof(DataRequestHeader) + 8 + 2 + kMaxFabricAddrBytes]{};
+  uint32_t ctl_have{0};
+  uint32_t ctl_need{0};
+  bool fabric_addr_extended{false};
+
+  DataRequestHeader hdr{};
+  Deadline deadline{};
+
+  // Resolution result for the current op.
+  bool valid{false};
+  uint8_t* target{nullptr};  // flat-region pointer (null for virtual)
+  Region virt;               // callbacks + direct fd when target == null
+  uint64_t offset{0};        // offset within the region
+
+  // Write-payload progress.
+  uint64_t pay_done{0};
+  bool drain_only{false};
+
+  // Scratch for virtual payloads / drains / disk windows (512-aligned for
+  // O_DIRECT ring reads).
+  uint8_t* scratch{nullptr};
+  uint64_t scratch_cap{0};
+
+  // Disk-read window (O_DIRECT widening).
+  uint64_t win_start{0}, win_len{0}, win_done{0};
+
+  // Admission ticket held for the current op.
+  bool ticket{false};
+  uint64_t ticket_bytes{0};
+
+  // Response.
+  uint32_t status{0};
+  const uint8_t* resp_payload{nullptr};
+  uint64_t resp_len{0};
+  uint64_t resp_done{0};
+  bool pool_direct{false};  // payload went straight off pool pages
+  iovec iov[2]{};
+  msghdr msg{};  // stable storage for the SENDMSG sqe (points at iov)
+
+  // Client-created staging segment (hello handshake).
+  uint8_t* stg_base{nullptr};
+  uint64_t stg_len{0};
+
+  // Zero-copy send bookkeeping. zc_send_out marks the currently-submitted
+  // send as a SEND_ZC (its main CQE needs F_MORE inspection);
+  // zc_notif_pending counts kernel buffer-release notifications still due
+  // — the kernel may DMA from the pool pages until each arrives, and every
+  // notif CQE names this Conn, so destruction is deferred on it. Notifs
+  // from a finished op can land while later ops are in flight, so this is
+  // a counter, not a flag.
+  bool zc_send_out{false};
+  uint32_t zc_notif_pending{0};
+
+  // Lifecycle.
+  bool sqe_out{false};
+  bool exec_out{false};
+  bool dead{false};
+
+  ~Conn() {
+    if (stg_base) ::munmap(stg_base, stg_len);
+    if (scratch) std::free(scratch);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+constexpr uint64_t kDrainChunk = 64 * 1024;
+// Free oversized per-connection scratch after each op: a thousand parked
+// connections must not each pin a multi-MiB buffer.
+constexpr uint64_t kScratchKeep = 256 * 1024;
+constexpr uint64_t kOdirectAlign = 512;
+
+class UringLoop {
+ public:
+  UringLoop(int listen_fd, RegionTable* regions, AdmissionGate* gate, ExecPool* exec,
+            DataPlaneCounters counters, std::atomic<size_t>* conn_count,
+            std::atomic<uint32_t>* parked_total, bool zc_want, uint64_t zc_threshold)
+      : listen_fd_(listen_fd),
+        regions_(regions),
+        gate_(gate),
+        exec_(exec),
+        counters_(counters),
+        conn_count_(conn_count),
+        parked_total_(parked_total),
+        zc_want_(zc_want),
+        zc_threshold_(zc_threshold) {}
+
+  ~UringLoop() {
+    if (event_fd_ >= 0) ::close(event_fd_);
+  }
+
+  bool init(unsigned sq_entries) {
+    event_fd_ = ::eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+    if (event_fd_ < 0) return false;
+    if (!ring_.init(sq_entries)) return false;
+    // ZC is a per-ring capability: ask THIS ring, not the headers.
+    zc_ok_ = zc_want_ && ring_supports_send_zc(ring_.fd());
+    return true;
+  }
+
+  void start() {
+    // Counted BEFORE the thread spawns so uring_active_loop_count() is
+    // accurate the moment create() returns (benches/tests read it right
+    // after server start); the loop decrements on exit.
+    g_active_loops.fetch_add(1, std::memory_order_relaxed);
+    thread_ = std::thread([this] {
+      run();
+      g_active_loops.fetch_sub(1, std::memory_order_relaxed);
+    });
+  }
+
+  void request_stop() {
+    stop_.store(true, std::memory_order_release);
+    wake();
+  }
+
+  void join() {
+    if (thread_.joinable()) thread_.join();
+  }
+
+  // Called from exec-pool threads: hand a finished callback's status back
+  // to the loop.
+  void post_exec(Conn* conn, uint32_t status) {
+    {
+      MutexLock lock(done_mutex_);
+      done_.push_back({conn, status});
+    }
+    wake();
+  }
+
+ private:
+  struct ExecDone {
+    Conn* conn;
+    uint32_t status;
+  };
+
+  void wake() {
+    const uint64_t one = 1;
+    // Non-blocking eventfd: a full counter still wakes the reader.
+    (void)!::write(event_fd_, &one, sizeof(one));
+  }
+
+  void submit(const io_uring_sqe& sqe) {
+    ring_.push(sqe);
+    ++outstanding_;
+  }
+
+  // ---- arming ---------------------------------------------------------
+
+  void arm_accept() {
+#ifdef BTPU_URING_TSAN_FD_SYNC
+    // TSan builds accept via POLL_ADD + the real accept4() SYSCALL instead
+    // of IORING_OP_ACCEPT: libtsan only marks an fd as a socket (and wires
+    // it to the global socket sync object the fd shims release/acquire on)
+    // inside its accept interceptor — a ring-accepted fd would leave every
+    // shim below releasing into the void. Accept is the cold path, so the
+    // divergence costs nothing it measures.
+    io_uring_sqe s = make_sqe(IORING_OP_POLL_ADD, listen_fd_, nullptr, 0, 0, kUdAccept);
+    s.poll_events = POLLIN;
+#else
+    io_uring_sqe s = make_sqe(IORING_OP_ACCEPT, listen_fd_, nullptr, 0, 0, kUdAccept);
+    s.accept_flags = SOCK_CLOEXEC;
+#endif
+    submit(s);
+    accept_out_ = true;
+  }
+
+  void arm_event() {
+    submit(make_sqe(IORING_OP_READ, event_fd_, &event_buf_, sizeof(event_buf_), 0, kUdEvent));
+    event_out_ = true;
+  }
+
+  void arm_timeout() {
+    ts_.tv_sec = 0;
+    ts_.tv_nsec = 10 * 1000 * 1000;  // 10ms parked-op sweep tick
+    submit(make_sqe(IORING_OP_TIMEOUT, -1, &ts_, 1, 0, kUdTimeout));
+    timeout_armed_ = true;
+  }
+
+  void arm_recv_ctl(Conn* c) {
+    submit(make_sqe(IORING_OP_RECV, c->fd, c->ctl + c->ctl_have, c->ctl_need - c->ctl_have, 0,
+                    reinterpret_cast<uint64_t>(c)));
+    c->sqe_out = true;
+  }
+
+  void arm_recv_payload(Conn* c) {
+    uint8_t* dst;
+    uint64_t want;
+    if (c->target) {  // pool-direct landing: bytes go straight into the region
+      dst = c->target + c->pay_done;
+      want = c->hdr.len - c->pay_done;
+    } else if (c->drain_only) {
+      dst = c->scratch;
+      want = std::min<uint64_t>(kDrainChunk, c->hdr.len - c->pay_done);
+    } else {
+      dst = c->scratch + c->pay_done;
+      want = c->hdr.len - c->pay_done;
+    }
+    const uint32_t len = static_cast<uint32_t>(std::min<uint64_t>(want, 1u << 30));
+    submit(make_sqe(IORING_OP_RECV, c->fd, dst, len, 0, reinterpret_cast<uint64_t>(c)));
+    c->sqe_out = true;
+  }
+
+  void arm_send(Conn* c) {
+    tsan_fd_release(c->fd);  // no-op outside TSan builds (see file header)
+    const uint64_t head_left = c->resp_done < 4 ? 4 - c->resp_done : 0;
+    const uint64_t pay_sent = c->resp_done > 4 ? c->resp_done - 4 : 0;
+    const uint64_t pay_left = c->resp_payload ? c->resp_len - pay_sent : 0;
+    // Zero-copy eligibility, re-decided per submission: pool-direct
+    // payloads at/above the threshold on a kernel whose ring probe said
+    // yes. SEND_ZC takes one flat buffer, so the 4-byte status goes out on
+    // its own writev first — one extra completion round, amortized over a
+    // >= threshold payload. A partial ZC send that drops the remainder
+    // below the threshold just finishes on the writev path.
+    const bool zc = zc_ok_ && c->pool_direct && pay_left >= zc_threshold_;
+    if (zc && head_left == 0) {
+      io_uring_sqe s = make_sqe(
+          IORING_OP_SEND_ZC, c->fd, c->resp_payload + pay_sent,
+          static_cast<uint32_t>(std::min<uint64_t>(pay_left, 1u << 30)), 0,
+          reinterpret_cast<uint64_t>(c));
+      s.ioprio = IORING_SEND_ZC_REPORT_USAGE;  // notif reports copied-vs-zc
+      s.msg_flags = MSG_NOSIGNAL;
+      submit(s);
+      // The kernel answers a SEND_ZC twice: the send result now, the
+      // buffer-release notif later. Count BOTH up front (handle_cqe
+      // decrements once per CQE); a failed send posts no notif and the
+      // dispatch path refunds the second count there.
+      ++outstanding_;
+      ++c->zc_notif_pending;
+      c->zc_send_out = true;
+      c->sqe_out = true;
+      c->state = Conn::S::kSend;
+      return;
+    }
+    unsigned n = 0;
+    if (head_left) {
+      c->iov[n].iov_base = reinterpret_cast<uint8_t*>(&c->status) + c->resp_done;
+      c->iov[n].iov_len = static_cast<size_t>(head_left);
+      ++n;
+    }
+    if (c->resp_payload && !zc) {
+      c->iov[n].iov_base = const_cast<uint8_t*>(c->resp_payload) + pay_sent;
+      c->iov[n].iov_len = static_cast<size_t>(pay_left);
+      ++n;
+    }
+    // SENDMSG + MSG_NOSIGNAL, NOT WRITEV: a ring WRITEV against a peer
+    // that reset mid-response behaves like raw writev — the kernel raises
+    // SIGPIPE in whichever thread sits in io_uring_enter, killing the
+    // whole worker for one vanished client (net.cpp's "never raw
+    // write/writev on sockets" rule applies on the ring too; caught by
+    // RemoteLane.MidStreamPeerDeath). The gather behavior is identical.
+    c->msg = msghdr{};
+    c->msg.msg_iov = c->iov;
+    c->msg.msg_iovlen = n;
+    io_uring_sqe s = make_sqe(IORING_OP_SENDMSG, c->fd, &c->msg, 1, 0,
+                              reinterpret_cast<uint64_t>(c));
+    s.msg_flags = MSG_NOSIGNAL;
+    submit(s);
+    c->sqe_out = true;
+    c->state = Conn::S::kSend;
+  }
+
+  void arm_disk_read(Conn* c) {
+    const uint64_t left = c->win_len - c->win_done;
+    const uint32_t len = static_cast<uint32_t>(std::min<uint64_t>(left, 1u << 30));
+    submit(make_sqe(IORING_OP_READ, c->virt.direct_fd, c->scratch + c->win_done, len,
+                    c->win_start + c->win_done, reinterpret_cast<uint64_t>(c)));
+    c->sqe_out = true;
+    c->state = Conn::S::kDiskRead;
+  }
+
+  // ---- op state machine ------------------------------------------------
+
+  static uint32_t code(ErrorCode ec) { return static_cast<uint32_t>(ec); }
+
+  void start_header(Conn* c) {
+    c->ctl_have = 0;
+    c->ctl_need = sizeof(DataRequestHeader);
+    c->fabric_addr_extended = false;
+    c->valid = false;
+    c->target = nullptr;
+    c->virt = Region{};
+    c->offset = 0;
+    c->pay_done = 0;
+    c->drain_only = false;
+    c->status = 0;
+    c->resp_payload = nullptr;
+    c->resp_len = 0;
+    c->resp_done = 0;
+    c->pool_direct = false;
+    if (c->scratch && c->scratch_cap > kScratchKeep) {
+      std::free(c->scratch);
+      c->scratch = nullptr;
+      c->scratch_cap = 0;
+    }
+    c->state = Conn::S::kHeader;
+    arm_recv_ctl(c);
+  }
+
+  bool ensure_scratch(Conn* c, uint64_t len) {
+    if (c->scratch_cap >= len) return true;
+    void* p = nullptr;
+    if (posix_memalign(&p, kOdirectAlign, static_cast<size_t>(len)) != 0) return false;
+    if (c->scratch) std::free(c->scratch);
+    c->scratch = static_cast<uint8_t*>(p);
+    c->scratch_cap = len;
+    return true;
+  }
+
+  void header_complete(Conn* c) {
+    if (!decode_request_header(c->ctl, sizeof(DataRequestHeader), c->hdr)) {
+      close_conn(c);  // poisoned stream: no frame boundary to resync on
+      return;
+    }
+    c->deadline = Deadline::from_wire(c->hdr.deadline_ms);
+    uint32_t trailer = 0;
+    switch (c->hdr.op) {
+      case kOpHello:
+        trailer = static_cast<uint32_t>(c->hdr.len);  // decode pinned 1..255
+        break;
+      case kOpReadStaged:
+      case kOpWriteStaged:
+      case kOpFabricOffer:
+        trailer = 8;
+        break;
+      case kOpFabricPull:
+        trailer = 8 + 2;  // id + alen; addr bytes extend in trailer_complete
+        break;
+      default:
+        break;
+    }
+    if (trailer == 0) {
+      dispatch(c);
+      return;
+    }
+    c->ctl_need += trailer;
+    c->state = Conn::S::kTrailer;
+    arm_recv_ctl(c);
+  }
+
+  void trailer_complete(Conn* c) {
+    if (c->hdr.op == kOpFabricPull && !c->fabric_addr_extended) {
+      uint16_t alen = 0;
+      std::memcpy(&alen, c->ctl + sizeof(DataRequestHeader) + 8, sizeof(alen));
+      if (!valid_fabric_addr_len(alen)) {
+        close_conn(c);  // protocol violation, as in the thread server
+        return;
+      }
+      c->fabric_addr_extended = true;
+      c->ctl_need += alen;
+      arm_recv_ctl(c);
+      return;
+    }
+    dispatch(c);
+  }
+
+  // Op header (+ trailer) fully read: resolve, gate, serve.
+  void dispatch(Conn* c) {
+    switch (c->hdr.op) {
+      case kOpHello:
+        do_hello(c);
+        return;
+      case kOpReadStaged:
+      case kOpWriteStaged: {
+        // Re-validate through the exact checked decoder the fuzz corpus
+        // drives (ctl holds header + shm_off contiguously = a StagedFrame).
+        StagedFrame frame{};
+        if (!decode_staged_frame(c->ctl, sizeof(StagedFrame), frame)) {
+          close_conn(c);
+          return;
+        }
+        c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target,
+                                     c->virt, c->offset);
+        if (!c->valid) {
+          // Mirrors the thread server: an unresolvable staged op answers
+          // MEMORY_ACCESS_ERROR without charging admission.
+          finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+          return;
+        }
+        gate_or_park(c);
+        return;
+      }
+      case kOpFabricOffer:
+      case kOpFabricPull:
+        do_fabric(c);
+        return;
+      case kOpWrite:
+        c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target,
+                                     c->virt, c->offset);
+        if (!c->valid) {
+          // Must still drain the payload to keep the stream aligned.
+          begin_drain(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+          return;
+        }
+        gate_or_park(c);
+        return;
+      case kOpRead:
+        c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target,
+                                     c->virt, c->offset);
+        if (!c->valid) {
+          finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+          return;
+        }
+        gate_or_park(c);
+        return;
+      default:
+        close_conn(c);  // decode_request_header whitelists ops; unreachable
+        return;
+    }
+  }
+
+  void do_hello(Conn* c) {
+    char name[kMaxHelloNameBytes + 1] = {};
+    std::memcpy(name, c->ctl + sizeof(DataRequestHeader), c->hdr.len);
+    finish(c, code(map_staging_segment(name, c->stg_base, c->stg_len)));
+  }
+
+  void do_fabric(Conn* c) {
+    c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target, c->virt,
+                                 c->offset);
+    if (!c->valid || c->target) {
+      finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+      return;
+    }
+    uint64_t transfer_id = 0;
+    std::memcpy(&transfer_id, c->ctl + sizeof(DataRequestHeader), sizeof(transfer_id));
+    const uint64_t offset = c->offset;
+    const uint64_t len = c->hdr.len;
+    if (c->hdr.op == kOpFabricOffer && c->virt.offer_fn) {
+      auto fn = c->virt.offer_fn;
+      offload(c, [fn, offset, len, transfer_id] {
+        return static_cast<uint32_t>(fn(offset, len, transfer_id));
+      });
+      return;
+    }
+    if (c->hdr.op == kOpFabricPull && c->virt.pull_fn) {
+      uint16_t alen = 0;
+      std::memcpy(&alen, c->ctl + sizeof(DataRequestHeader) + 8, sizeof(alen));
+      std::string addr(reinterpret_cast<const char*>(c->ctl) + sizeof(DataRequestHeader) + 10,
+                       alen);
+      auto fn = c->virt.pull_fn;
+      offload(c, [fn, addr, transfer_id, offset, len] {
+        // Blocks until the bytes are in device memory — the status send
+        // doubles as the completion, exactly like the thread server.
+        return static_cast<uint32_t>(fn(addr, transfer_id, offset, len));
+      });
+      return;
+    }
+    finish(c, code(ErrorCode::NOT_IMPLEMENTED));
+  }
+
+  // ---- admission -------------------------------------------------------
+
+  void gate_or_park(Conn* c) {
+    if (gate_->try_enter(c->hdr.len)) {
+      c->ticket = true;
+      c->ticket_bytes = c->hdr.len;
+      admitted(c);
+      return;
+    }
+    // Same adaptive-LIFO shape as AdmissionGate's thread path: park the
+    // newcomer, shed the OLDEST waiter once the queue is over watermark.
+    // The watermark is judged against the SERVER-wide parked count
+    // (parked_total_ is shared by every loop on this gate), so
+    // BTPU_DATA_MAX_QUEUE bounds total queueing exactly like the thread
+    // server — a multi-loop engine must not multiply it. Shed order under
+    // pressure is oldest-of-THIS-loop (cross-loop oldest would need a
+    // shared structure on the hot path; the bound is what operators tune).
+    if (parked_total_->load(std::memory_order_relaxed) >= gate_->options().max_queue) {
+      if (!parked_.empty()) {
+        Conn* oldest = parked_.front();
+        parked_.pop_front();
+        parked_total_->fetch_sub(1, std::memory_order_relaxed);
+        oldest->state = Conn::S::kHeader;  // leaves kParked
+        shed(oldest);
+      } else {
+        shed(c);  // max_queue == 0 (or siblings hold the whole quota): never wait
+        return;
+      }
+    }
+    c->state = Conn::S::kParked;
+    parked_.push_back(c);
+    parked_total_->fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void shed(Conn* c) {
+    robust_counters().shed.fetch_add(1, std::memory_order_relaxed);
+    rejected(c, code(ErrorCode::RETRY_LATER));
+  }
+
+  void expire(Conn* c) {
+    robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+    rejected(c, code(ErrorCode::DEADLINE_EXCEEDED));
+  }
+
+  // A gated op refused before service (shed or queue-expired deadline).
+  void rejected(Conn* c, uint32_t status) {
+    if (c->hdr.op == kOpWrite) {
+      begin_drain(c, status);  // keep the stream aligned
+      return;
+    }
+    if (c->hdr.op == kOpReadStaged || c->hdr.op == kOpWriteStaged) {
+      // Thread-server parity: a bad segment outranks the rejection code.
+      uint64_t shm_off = 0;
+      std::memcpy(&shm_off, c->ctl + sizeof(DataRequestHeader), sizeof(shm_off));
+      if (!staging_bounds_ok(c->stg_base, c->stg_len, shm_off, c->hdr.len))
+        status = code(ErrorCode::MEMORY_ACCESS_ERROR);
+    }
+    finish(c, status);
+  }
+
+  // Ticket held: serve the op.
+  void admitted(Conn* c) {
+    if (c->deadline.expired()) {
+      robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      rejected(c, code(ErrorCode::DEADLINE_EXCEEDED));
+      return;
+    }
+    switch (c->hdr.op) {
+      case kOpReadStaged:
+      case kOpWriteStaged:
+        serve_staged(c);
+        return;
+      case kOpWrite:
+        c->drain_only = false;
+        if (!c->target) {
+          if (!ensure_scratch(c, c->hdr.len)) {
+            begin_drain(c, code(ErrorCode::OUT_OF_MEMORY));
+            return;
+          }
+        }
+        if (c->hdr.len == 0) {
+          write_payload_complete(c);
+          return;
+        }
+        c->state = Conn::S::kPayload;
+        arm_recv_payload(c);
+        return;
+      case kOpRead:
+        serve_read(c);
+        return;
+      default:
+        finish(c, code(ErrorCode::INTERNAL_ERROR));  // unreachable
+        return;
+    }
+  }
+
+  void serve_staged(Conn* c) {
+    uint64_t shm_off = 0;
+    std::memcpy(&shm_off, c->ctl + sizeof(DataRequestHeader), sizeof(shm_off));
+    if (!staging_bounds_ok(c->stg_base, c->stg_len, shm_off, c->hdr.len)) {
+      finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+      return;
+    }
+    uint8_t* seg = c->stg_base + shm_off;
+    const uint64_t len = c->hdr.len;
+    if (c->target) {
+      if (c->hdr.op == kOpWriteStaged) {
+        std::memcpy(c->target, seg, len);
+      } else {
+        std::memcpy(seg, c->target, len);
+      }
+      finish(c, code(ErrorCode::OK));
+      return;
+    }
+    // Virtual region: the callback moves bytes directly between the
+    // backing store and the shared segment — possibly blocking (device
+    // tier), so it runs on the exec pool.
+    const uint64_t offset = c->offset;
+    if (c->hdr.op == kOpWriteStaged) {
+      auto fn = c->virt.write_fn;
+      offload(c, [fn, offset, seg, len] { return static_cast<uint32_t>(fn(offset, seg, len)); });
+    } else {
+      auto fn = c->virt.read_fn;
+      offload(c, [fn, offset, seg, len] { return static_cast<uint32_t>(fn(offset, seg, len)); });
+    }
+  }
+
+  void serve_read(Conn* c) {
+    if (c->target) {
+      // Stream lane headline: ONE gather write whose payload iovec points
+      // into the registered pool region. No staging copy exists server-side.
+      c->status = code(ErrorCode::OK);
+      c->resp_payload = c->target;
+      c->resp_len = c->hdr.len;
+      c->pool_direct = true;
+      arm_send(c);
+      return;
+    }
+    if (c->virt.direct_fd >= 0) {
+      start_disk_read(c);
+      return;
+    }
+    exec_read_fallback(c);
+  }
+
+  void exec_read_fallback(Conn* c) {
+    if (!ensure_scratch(c, c->hdr.len)) {
+      finish(c, code(ErrorCode::OUT_OF_MEMORY));
+      return;
+    }
+    const uint64_t offset = c->offset;
+    const uint64_t len = c->hdr.len;
+    uint8_t* dst = c->scratch;
+    auto fn = c->virt.read_fn;
+    offload(c, [fn, offset, dst, len] { return static_cast<uint32_t>(fn(offset, dst, len)); });
+  }
+
+  void start_disk_read(Conn* c) {
+    // Disk tier unified on the SAME ring as the network ops: the backing
+    // file read is submitted as an IORING_OP_READ and the loop keeps
+    // serving other connections while the NVMe completes it. O_DIRECT
+    // files get 512-aligned window widening (scratch is always aligned).
+    if (c->virt.direct_odirect) {
+      c->win_start = c->offset & ~(kOdirectAlign - 1);
+      c->win_len = ((c->offset + c->hdr.len + kOdirectAlign - 1) & ~(kOdirectAlign - 1)) -
+                   c->win_start;
+    } else {
+      c->win_start = c->offset;
+      c->win_len = c->hdr.len;
+    }
+    c->win_done = 0;
+    if (!ensure_scratch(c, c->win_len)) {
+      finish(c, code(ErrorCode::OUT_OF_MEMORY));
+      return;
+    }
+    arm_disk_read(c);
+  }
+
+  void disk_read_cqe(Conn* c, int32_t res) {
+    if (res < 0) {
+      // O_DIRECT alignment quirk or transient I/O error: fall back to the
+      // backend callback, which owns its own bounce machinery.
+      exec_read_fallback(c);
+      return;
+    }
+    if (res == 0) {
+      // EOF inside capacity (sparse backing file): zero-fill, like raw_io.
+      std::memset(c->scratch + c->win_done, 0, static_cast<size_t>(c->win_len - c->win_done));
+      c->win_done = c->win_len;
+    } else {
+      c->win_done += static_cast<uint64_t>(res);
+    }
+    if (c->win_done < c->win_len) {
+      arm_disk_read(c);
+      return;
+    }
+    c->status = code(ErrorCode::OK);
+    c->resp_payload = c->scratch + (c->offset - c->win_start);
+    c->resp_len = c->hdr.len;
+    arm_send(c);
+  }
+
+  // ---- write payload ---------------------------------------------------
+
+  void begin_drain(Conn* c, uint32_t status) {
+    c->status = status;
+    if (c->hdr.len == 0) {
+      finish(c, status);
+      return;
+    }
+    c->drain_only = true;
+    if (!ensure_scratch(c, kDrainChunk)) {
+      close_conn(c);  // cannot even drain: drop the stream
+      return;
+    }
+    c->state = Conn::S::kPayload;
+    arm_recv_payload(c);
+  }
+
+  // read_exact/write_all retry EINTR (and EAGAIN can surface if fast-poll
+  // raced a consumed wakeup); the state machines re-arm instead of killing
+  // the connection, matching the thread server's loops.
+  static bool retryable(int32_t res) { return res == -EINTR || res == -EAGAIN; }
+
+  void payload_cqe(Conn* c, int32_t res) {
+    if (retryable(res)) {
+      arm_recv_payload(c);
+      return;
+    }
+    if (res <= 0) {
+      close_conn(c);
+      return;
+    }
+    c->pay_done += static_cast<uint64_t>(res);
+    if (c->pay_done < c->hdr.len) {
+      arm_recv_payload(c);
+      return;
+    }
+    write_payload_complete(c);
+  }
+
+  void write_payload_complete(Conn* c) {
+    if (c->drain_only) {
+      finish(c, c->status);
+      return;
+    }
+    if (c->target) {
+      // Bytes already landed in the region. Mid-service expiry answers
+      // DEADLINE_EXCEEDED — one-sided writes are unacknowledged until this
+      // status, so the client treats them as not-written.
+      if (c->deadline.expired()) {
+        robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+        finish(c, code(ErrorCode::DEADLINE_EXCEEDED));
+        return;
+      }
+      finish(c, code(ErrorCode::OK));
+      return;
+    }
+    if (c->deadline.expired()) {
+      // Budget spent during the drain: refuse the backing-store apply.
+      robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      finish(c, code(ErrorCode::DEADLINE_EXCEEDED));
+      return;
+    }
+    const uint64_t offset = c->offset;
+    const uint64_t len = c->hdr.len;
+    const uint8_t* src = c->scratch;
+    auto fn = c->virt.write_fn;
+    offload(c, [fn, offset, src, len] { return static_cast<uint32_t>(fn(offset, src, len)); });
+  }
+
+  // ---- completion plumbing --------------------------------------------
+
+  void offload(Conn* c, std::function<uint32_t()> work) {
+    c->state = Conn::S::kExec;
+    c->exec_out = true;
+    UringLoop* loop = this;
+    exec_->submit([loop, c, work = std::move(work)] { loop->post_exec(c, work()); });
+  }
+
+  void exec_done(Conn* c, uint32_t status) {
+    c->exec_out = false;
+    if (c->dead || stopping_) {
+      maybe_destroy(c);
+      return;
+    }
+    if (c->hdr.op == kOpRead && status == code(ErrorCode::OK)) {
+      c->status = status;
+      c->resp_payload = c->scratch;
+      c->resp_len = c->hdr.len;
+      arm_send(c);
+      return;
+    }
+    finish(c, status);
+  }
+
+  // Sends a bare status response (no payload).
+  void finish(Conn* c, uint32_t status) {
+    c->status = status;
+    c->resp_payload = nullptr;
+    c->resp_len = 0;
+    arm_send(c);
+  }
+
+  void send_cqe(Conn* c, int32_t res) {
+    if (retryable(res)) {
+      arm_send(c);
+      return;
+    }
+    if (res <= 0) {
+      close_conn(c);
+      return;
+    }
+    c->resp_done += static_cast<uint64_t>(res);
+    const uint64_t total = 4 + (c->resp_payload ? c->resp_len : 0);
+    if (c->resp_done < total) {
+      arm_send(c);
+      return;
+    }
+    // Lane accounting on COMPLETION only, like the client-side counters.
+    if (c->pool_direct && c->status == code(ErrorCode::OK)) {
+      if (counters_.pool_direct_ops) counters_.pool_direct_ops->add();
+      if (counters_.pool_direct_bytes) counters_.pool_direct_bytes->add(c->resp_len);
+    }
+    release_ticket(c);
+    start_header(c);
+  }
+
+  void release_ticket(Conn* c) {
+    if (!c->ticket) return;
+    c->ticket = false;
+    gate_->release(c->ticket_bytes);
+    unpark();
+  }
+
+  // Admit parked ops newest-first while the gate has room.
+  void unpark() {
+    if (stopping_) return;  // shutdown destroys parked conns, never serves them
+    while (!parked_.empty()) {
+      Conn* newest = parked_.back();
+      if (!gate_->try_enter(newest->hdr.len)) return;
+      parked_.pop_back();
+      parked_total_->fetch_sub(1, std::memory_order_relaxed);
+      newest->state = Conn::S::kHeader;
+      newest->ticket = true;
+      newest->ticket_bytes = newest->hdr.len;
+      admitted(newest);
+    }
+  }
+
+  void sweep_parked() {
+    // Queue-expired deadlines answer DEADLINE_EXCEEDED without service.
+    for (size_t i = 0; i < parked_.size();) {
+      Conn* c = parked_[i];
+      if (!c->deadline.is_infinite() && c->deadline.expired()) {
+        parked_.erase(parked_.begin() + static_cast<ptrdiff_t>(i));
+        parked_total_->fetch_sub(1, std::memory_order_relaxed);
+        c->state = Conn::S::kHeader;
+        expire(c);
+        continue;
+      }
+      ++i;
+    }
+    // Cross-loop capacity: releases on sibling loops don't wake this one,
+    // so the sweep (every completion + the 10ms tick) retries the gate.
+    unpark();
+  }
+
+  // ---- lifecycle -------------------------------------------------------
+
+  void on_accept(int32_t res) {
+    accept_out_ = false;
+#ifdef BTPU_URING_TSAN_FD_SYNC
+    // res is the poll mask; the actual accept happens through the
+    // intercepted syscall (listener is O_NONBLOCK in tsan builds).
+    if (res >= 0)
+      res = ::accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
+    if (res < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) && !stopping_) {
+      arm_accept();  // spurious readiness: re-arm the poll
+      return;
+    }
+#endif
+    if (stopping_) {
+      if (res >= 0) ::close(res);
+      return;
+    }
+    if (res < 0) {
+      // EMFILE/ENFILE under fan-in pressure: back off one tick instead of
+      // re-arming into a hot error loop.
+      accept_rearm_ = true;
+      return;
+    }
+    // IORING_OP_ACCEPT bypasses net::tcp_accept, so apply its socket
+    // options here: without TCP_NODELAY the 4-byte status acks of the
+    // staged lane serialize on delayed ACKs (measured 0.02 GB/s).
+    net::set_nodelay(res);
+    auto* c = new Conn();
+    c->fd = res;
+    c->loop = this;
+    conns_.insert(c);
+    conn_count_->fetch_add(1, std::memory_order_relaxed);
+    start_header(c);
+    arm_accept();
+  }
+
+  void close_conn(Conn* c) {
+    release_ticket(c);
+    if (c->state == Conn::S::kParked) {
+      for (auto it = parked_.begin(); it != parked_.end(); ++it) {
+        if (*it == c) {
+          parked_.erase(it);
+          parked_total_->fetch_sub(1, std::memory_order_relaxed);
+          break;
+        }
+      }
+    }
+    // Peer-visible EOF right away, even if the fd must linger for an
+    // in-flight completion (SocketShutdownGuard parity).
+    ::shutdown(c->fd, SHUT_RDWR);
+    c->dead = true;
+    maybe_destroy(c);
+  }
+
+  void maybe_destroy(Conn* c) {
+    // zc_notif_pending: the kernel still holds (and may DMA from) the send
+    // buffer, and its notif CQE names this Conn — destruction waits.
+    if (c->sqe_out || c->exec_out || c->zc_notif_pending > 0) return;
+    conns_.erase(c);
+    conn_count_->fetch_sub(1, std::memory_order_relaxed);
+    delete c;
+  }
+
+  // ---- CQE dispatch ----------------------------------------------------
+
+  void handle_cqe(const io_uring_cqe& cqe) {
+    --outstanding_;
+    const uint64_t ud = cqe.user_data;
+    if (ud < 8) {
+      switch (ud) {
+        case kUdAccept:
+          on_accept(cqe.res);
+          break;
+        case kUdEvent: {
+          event_out_ = false;
+          // Exec completions ride the eventfd; drain them now.
+          drain_exec_done();
+          if (!stopping_) {
+            if (cqe.res >= 0) {
+              arm_event();
+            } else if (!event_broken_) {
+              // A failing eventfd read must NOT be re-armed into a hot
+              // -EINVAL spin. Degraded mode: the 10ms timeout tick keeps
+              // stop/exec-drain latency bounded instead.
+              event_broken_ = true;
+              LOG_ERROR << "uring loop: eventfd read failed ("
+                        << std::strerror(-cqe.res) << "); degrading to timer wakeups";
+            }
+          }
+          break;
+        }
+        case kUdTimeout:
+          timeout_armed_ = false;
+          if (!stopping_ && accept_rearm_ && !accept_out_) {
+            accept_rearm_ = false;
+            arm_accept();
+          }
+          break;
+        case kUdCancel:
+        default:
+          break;
+      }
+      return;
+    }
+    auto* c = reinterpret_cast<Conn*>(static_cast<uintptr_t>(ud));
+    if (cqe.flags & IORING_CQE_F_NOTIF) {
+      // SEND_ZC buffer-release notification: the kernel is done with the
+      // pool pages. REPORT_USAGE classifies the completion — a kernel that
+      // fell back to copying (loopback always does) is a perf-regression
+      // signal the counters surface, not an error. Does NOT touch sqe_out:
+      // the send's main CQE owns that.
+      if (c->zc_notif_pending > 0) --c->zc_notif_pending;
+      if (static_cast<uint32_t>(cqe.res) & IORING_NOTIF_USAGE_ZC_COPIED) {
+        if (counters_.zerocopy_copied) counters_.zerocopy_copied->add();
+      } else {
+        if (counters_.zerocopy_sent) counters_.zerocopy_sent->add();
+      }
+      if (c->dead || stopping_) maybe_destroy(c);
+      return;
+    }
+    c->sqe_out = false;
+    bool zc_rejected = false;
+    if (c->zc_send_out) {
+      c->zc_send_out = false;
+      if (!(cqe.flags & IORING_CQE_F_MORE)) {
+        // Failed/degenerate SEND_ZC: the kernel posts no notif for it.
+        // Refund the second completion counted at submit.
+        --outstanding_;
+        if (c->zc_notif_pending > 0) --c->zc_notif_pending;
+      }
+      // A kernel that probes SEND_ZC but rejects this submission shape
+      // (6.0/6.1: opcode exists, REPORT_USAGE ioprio flag doesn't) answers
+      // -EINVAL. That's a capability verdict, not a connection error:
+      // disable ZC on this loop and finish the response on writev.
+      zc_rejected = cqe.res == -EINVAL || cqe.res == -EOPNOTSUPP;
+    }
+    if (c->dead || stopping_) {
+      maybe_destroy(c);
+      return;
+    }
+    if (zc_rejected && c->state == Conn::S::kSend) {
+      if (zc_ok_) {
+        zc_ok_ = false;
+        LOG_ERROR << "uring loop: kernel rejected SEND_ZC shape ("
+                  << std::strerror(static_cast<int>(-cqe.res))
+                  << "); zero-copy sends disabled on this loop";
+      }
+      arm_send(c);  // re-decides: zc_ok_ now false -> writev path
+      return;
+    }
+    // Ring recv completed: take the client's write-side release edge
+    // (no-op outside TSan builds, see file header).
+    if (c->state == Conn::S::kHeader || c->state == Conn::S::kTrailer ||
+        c->state == Conn::S::kPayload) {
+      tsan_fd_acquire(c->fd);
+    }
+    switch (c->state) {
+      case Conn::S::kHeader:
+      case Conn::S::kTrailer: {
+        if (retryable(cqe.res)) {
+          arm_recv_ctl(c);
+          return;
+        }
+        if (cqe.res <= 0) {
+          close_conn(c);  // clean EOF or socket error
+          return;
+        }
+        c->ctl_have += static_cast<uint32_t>(cqe.res);
+        if (c->ctl_have < c->ctl_need) {
+          arm_recv_ctl(c);
+          return;
+        }
+        if (c->state == Conn::S::kHeader) {
+          header_complete(c);
+        } else {
+          trailer_complete(c);
+        }
+        return;
+      }
+      case Conn::S::kPayload:
+        payload_cqe(c, cqe.res);
+        return;
+      case Conn::S::kDiskRead:
+        disk_read_cqe(c, cqe.res);
+        return;
+      case Conn::S::kSend:
+        send_cqe(c, cqe.res);
+        return;
+      case Conn::S::kExec:
+      case Conn::S::kParked:
+        // No submission should be outstanding in these states.
+        close_conn(c);
+        return;
+    }
+  }
+
+  void drain_exec_done() {
+    std::deque<ExecDone> done;
+    {
+      MutexLock lock(done_mutex_);
+      done.swap(done_);
+    }
+    for (const auto& d : done) exec_done(d.conn, d.status);
+  }
+
+  void process_cqes() {
+    io_uring_cqe buf[64];
+    for (;;) {
+      const unsigned n = ring_.drain(buf, 64);
+      if (n == 0) return;
+      for (unsigned i = 0; i < n; ++i) handle_cqe(buf[i]);
+    }
+  }
+
+  // ---- main loop -------------------------------------------------------
+
+  void run() {
+    arm_accept();
+    arm_event();
+    while (!stop_.load(std::memory_order_acquire)) {
+      if ((!parked_.empty() || accept_rearm_ || event_broken_) && !timeout_armed_)
+        arm_timeout();
+      ring_.flush();
+      const int rc = ring_.enter(1);
+      if (rc < 0 && rc != -EINTR && rc != -EBUSY && rc != -EAGAIN) {
+        LOG_ERROR << "uring loop: io_uring_enter failed: " << std::strerror(-rc);
+        break;
+      }
+      process_cqes();
+      drain_exec_done();  // eventfd may coalesce several posts into one CQE
+      sweep_parked();
+    }
+    shutdown_all();
+  }
+
+  void shutdown_all() {
+    stopping_ = true;
+    // Parked conns hold no submissions: destroy them now.
+    for (Conn* c : std::vector<Conn*>(parked_.begin(), parked_.end())) close_conn(c);
+    parked_.clear();
+    // Wake every in-flight socket op with an error/EOF.
+    for (Conn* c : conns_) ::shutdown(c->fd, SHUT_RDWR);
+    // ASYNC_CANCEL targets are named by the victim's user_data in addr.
+    auto cancel = [this](uint64_t target_ud) {
+      io_uring_sqe s = make_sqe(IORING_OP_ASYNC_CANCEL, -1, nullptr, 0, 0, kUdCancel);
+      s.addr = target_ud;
+      submit(s);
+    };
+    if (accept_out_) cancel(kUdAccept);
+    if (timeout_armed_) cancel(kUdTimeout);
+    if (event_out_) cancel(kUdEvent);
+    // Drain every outstanding completion (kernel writes into conn buffers
+    // until then) and every exec task (pool threads reference the conns).
+    while (outstanding_ > 0 || !conns_.empty()) {
+      drain_exec_done();
+      for (Conn* c : std::vector<Conn*>(conns_.begin(), conns_.end())) {
+        if (!c->sqe_out && !c->exec_out && c->zc_notif_pending == 0) {
+          conns_.erase(c);
+          conn_count_->fetch_sub(1, std::memory_order_relaxed);
+          delete c;
+        }
+      }
+      if (outstanding_ == 0) {
+        if (conns_.empty()) break;
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        continue;
+      }
+      ring_.flush();
+      const int rc = ring_.enter(1);
+      if (rc < 0 && rc != -EINTR && rc != -EBUSY && rc != -EAGAIN) break;
+      process_cqes();
+    }
+    // Normally conns_ is empty here. If the ring died fatally mid-drain,
+    // exec tasks can still complete (wait for them — the pool threads
+    // dereference these conns), but a conn with a submission the dead ring
+    // will never complete is deliberately LEAKED: the kernel may still DMA
+    // into its buffers, and a leak beats a use-after-free.
+    for (;;) {
+      drain_exec_done();
+      bool exec_busy = false;
+      for (Conn* c : std::vector<Conn*>(conns_.begin(), conns_.end())) {
+        if (c->exec_out) {
+          exec_busy = true;
+          continue;
+        }
+        conns_.erase(c);
+        conn_count_->fetch_sub(1, std::memory_order_relaxed);
+        if (c->sqe_out || c->zc_notif_pending > 0) {
+          // Undrainable submission or an un-notified ZC buffer the kernel
+          // may still DMA from: a leak beats a use-after-free.
+          LOG_ERROR << "uring loop: leaking connection with undrainable submission";
+          continue;
+        }
+        delete c;
+      }
+      if (!exec_busy) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  const int listen_fd_;
+  RegionTable* const regions_;
+  AdmissionGate* const gate_;
+  ExecPool* const exec_;
+  const DataPlaneCounters counters_;
+  std::atomic<size_t>* const conn_count_;
+
+  Ring ring_;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  bool stopping_{false};
+
+  int event_fd_{-1};
+  uint64_t event_buf_{0};
+  __kernel_timespec ts_{};
+
+  bool accept_out_{false};
+  bool accept_rearm_{false};
+  bool event_out_{false};
+  bool event_broken_{false};  // eventfd read failed: timer-wakeup fallback
+  bool timeout_armed_{false};
+  uint64_t outstanding_{0};
+
+  std::unordered_set<Conn*> conns_;
+  std::deque<Conn*> parked_;
+  std::atomic<uint32_t>* const parked_total_;  // server-wide, shared across loops
+
+  const bool zc_want_;           // env said yes (kernel still gets a veto)
+  const uint64_t zc_threshold_;  // min pool-direct payload for SEND_ZC
+  bool zc_ok_{false};            // resolved at init() from the ring probe
+
+  Mutex done_mutex_;
+  std::deque<ExecDone> done_ BTPU_GUARDED_BY(done_mutex_);
+};
+
+}  // namespace
+
+ErrorCode map_staging_segment(const char* name, uint8_t*& stg_base, uint64_t& stg_len) {
+  const int seg = ::shm_open(name, O_RDWR, 0600);
+  struct stat st {};
+  void* mapped = MAP_FAILED;
+  if (seg >= 0 && ::fstat(seg, &st) == 0 && st.st_size > 0) {
+    mapped = ::mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ | PROT_WRITE,
+                    MAP_SHARED, seg, 0);
+  }
+  if (seg >= 0) ::close(seg);
+  if (mapped == MAP_FAILED) {
+    // Different host (name unknown) or mapping failure: the client falls
+    // back to streaming on this status.
+    return ErrorCode::CONNECTION_FAILED;
+  }
+  if (stg_base) ::munmap(stg_base, stg_len);
+  stg_base = static_cast<uint8_t*>(mapped);
+  stg_len = static_cast<uint64_t>(st.st_size);
+  return ErrorCode::OK;
+}
+
+// ---- UringDataPlane --------------------------------------------------------
+
+struct UringDataPlane::Internals {
+  net::Socket listener;
+  std::unique_ptr<ExecPool> exec;
+  std::vector<std::unique_ptr<UringLoop>> loops;
+  std::atomic<size_t> conn_count{0};
+  // Server-wide admission-parked op count: BTPU_DATA_MAX_QUEUE bounds the
+  // TOTAL across loops, exactly like the thread server's single gate queue.
+  std::atomic<uint32_t> parked_total{0};
+  bool stopped{false};
+};
+
+std::unique_ptr<UringDataPlane> UringDataPlane::create(net::Socket& listener,
+                                                       RegionTable* regions,
+                                                       AdmissionGate* gate,
+                                                       const Options& opts) {
+  if (!uring_runtime_available()) return nullptr;
+  unsigned nloops = opts.loops;
+  if (nloops == 0) {
+    const unsigned hw = std::thread::hardware_concurrency();
+    nloops = hw > 1 ? std::min(hw, 4u) : 1u;
+  }
+  auto impl = std::make_unique<Internals>();
+#ifdef BTPU_URING_TSAN_FD_SYNC
+  // TSan builds accept via POLL_ADD + real accept4 (see arm_accept): a
+  // blocking listener could then block the loop on a raced-away
+  // connection, so make it non-blocking and treat EAGAIN as re-arm.
+  {
+    const int fl = ::fcntl(listener.fd(), F_GETFL, 0);
+    ::fcntl(listener.fd(), F_SETFL, fl | O_NONBLOCK);
+  }
+#endif
+  impl->exec = std::make_unique<ExecPool>(opts.exec_threads);
+  // Zero-copy sends: BTPU_IOURING_ZC=auto|0|1 (0 disables; auto and 1 both
+  // defer to the per-ring kernel probe) gated by BTPU_ZC_THRESHOLD — below
+  // it the pin+notif overhead of SEND_ZC loses to plain writev (loopback
+  // loses at ANY size: the kernel copies regardless and says so via the
+  // btpu_zerocopy_copied_count signal). Default 4 MiB.
+  const std::string zc_mode = env_str("BTPU_IOURING_ZC", "auto");
+  const bool zc_want = zc_mode != "0";
+  const uint64_t zc_threshold =
+      std::max<uint64_t>(env_u32("BTPU_ZC_THRESHOLD", 4u << 20), 4096);
+  for (unsigned i = 0; i < nloops; ++i) {
+    // The fd NUMBER is stable across the later Socket move; the caller
+    // keeps ownership until this function commits to success, so a null
+    // return leaves the listener usable for the thread-server fallback.
+    auto loop = std::make_unique<UringLoop>(listener.fd(), regions, gate,
+                                            impl->exec.get(), opts.counters,
+                                            &impl->conn_count, &impl->parked_total,
+                                            zc_want, zc_threshold);
+    if (!loop->init(opts.sq_entries)) {
+      // First loop failing = io_uring effectively unavailable (memlock,
+      // seccomp): report null so the caller runs the thread server. A
+      // LATER loop failing just means fewer loops.
+      if (i == 0) return nullptr;
+      break;
+    }
+    impl->loops.push_back(std::move(loop));
+  }
+  if (impl->loops.empty()) return nullptr;
+  impl->listener = std::move(listener);
+  for (auto& loop : impl->loops) loop->start();
+  auto engine = std::unique_ptr<UringDataPlane>(new UringDataPlane());
+  engine->impl_ = std::move(impl);
+  return engine;
+}
+
+UringDataPlane::~UringDataPlane() { stop(); }
+
+void UringDataPlane::stop() {
+  if (!impl_ || impl_->stopped) return;
+  impl_->stopped = true;
+  for (auto& loop : impl_->loops) loop->request_stop();
+  for (auto& loop : impl_->loops) loop->join();
+  // Exec pool last: loops wait on in-flight exec tasks before exiting.
+  impl_->exec.reset();
+  impl_->listener.close();
+}
+
+size_t UringDataPlane::connection_count() const noexcept {
+  return impl_ ? impl_->conn_count.load(std::memory_order_relaxed) : 0;
+}
+
+bool uring_runtime_available() {
+  // BTPU_IOURING_NET is the operator-facing dial (auto|0|1): 0 pins the
+  // thread-per-connection fallback, 1 *requires* the engine (a kernel that
+  // cannot run it logs once and still falls back — serving beats refusing,
+  // and the CI probe-preflight is what turns "can't" into SKIP rather than
+  // a silent downgrade), auto probes. BTPU_FORCE_NO_URING=1 remains as the
+  // original spelling of =0.
+  const std::string mode = env_str("BTPU_IOURING_NET", "auto");
+  if (mode == "0") return false;
+  if (mode != "1" && env_bool("BTPU_FORCE_NO_URING", false)) return false;
+  io_uring_params params{};
+  const int fd = sys_io_uring_setup(2, &params);
+  if (fd < 0) {
+    if (mode == "1") {
+      static std::atomic<bool> warned{false};
+      if (!warned.exchange(true)) {
+        LOG_ERROR << "BTPU_IOURING_NET=1 but io_uring_setup failed ("
+                  << std::strerror(errno)
+                  << "); falling back to the thread-per-connection server";
+      }
+    }
+    return false;
+  }
+  // NODROP (5.5): overflow CQEs buffer in the kernel instead of vanishing
+  // — without it the outstanding-op accounting would wedge. FAST_POLL
+  // (5.7): socket ops poll-arm inline instead of punting every recv/send
+  // to an io-wq worker thread — without it the engine degrades to exactly
+  // the thread-per-op shape it replaces. Requiring both also guarantees
+  // every opcode the engine submits (RECV/SEND/READ/WRITEV/ACCEPT/
+  // TIMEOUT/ASYNC_CANCEL, all <= 5.6) exists, so a probe-passing kernel
+  // can actually serve — a 5.5 kernel would otherwise pass NODROP and
+  // then fail every connection's first recv with -EINVAL.
+  const bool ok = (params.features & IORING_FEAT_NODROP) != 0 &&
+                  (params.features & IORING_FEAT_FAST_POLL) != 0;
+  ::close(fd);
+  if (!ok && mode == "1") {
+    static std::atomic<bool> warned{false};
+    if (!warned.exchange(true)) {
+      LOG_ERROR << "BTPU_IOURING_NET=1 but this kernel cannot run the io_uring "
+                   "data plane (missing NODROP/FAST_POLL); falling back to the "
+                   "thread-per-connection server";
+    }
+  }
+  return ok;
+}
+
+size_t uring_active_loop_count() noexcept {
+  return g_active_loops.load(std::memory_order_relaxed);
+}
+
+}  // namespace btpu::transport

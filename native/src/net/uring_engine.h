@@ -1,0 +1,170 @@
+// io_uring submission-queue event loop for the TCP data plane.
+//
+// One loop per worker core multiplexes thousands of connections: accepts,
+// request-header reads, pool-direct sends (writev straight off registered
+// pool pages — zero worker-side staging copies), and disk reads submitted
+// on the SAME ring as the network ops (the backend's io_uring file lane,
+// see iouring_disk_backend.cpp), replacing the thread-per-connection serve
+// loop. The wire protocol is byte-identical to the fallback server
+// (data_wire.h packed headers, frozen by wire_layout_check.h + the golden
+// table) — a client cannot tell which engine answered, and the staged shm
+// lane keeps working unchanged on top of it.
+//
+// Availability is a RUNTIME question (sandboxed kernels refuse
+// io_uring_setup; BTPU_IOURING_NET=0 — or its legacy spelling
+// BTPU_FORCE_NO_URING=1 — refuses it on purpose, =1 requires it, auto
+// probes):
+// UringDataPlane::create returns null and the TCP server falls back to the
+// thread-per-connection loop. Both engines share RegionTable and the
+// admission gate, so registration and overload behavior cannot diverge.
+//
+// Ownership model (docs/CORRECTNESS.md §8): every Conn is owned by exactly
+// one loop thread and touched by no other, so per-connection state needs no
+// locks at all. The only cross-thread edges are (a) the RegionTable mutex,
+// (b) the AdmissionGate's internal mutex (try_enter/release), (c) the exec
+// pool's task queue + per-loop completion queue (each a Mutex + eventfd
+// wake), and (d) the stop flag. Blocking region callbacks (virtual-region
+// reads/writes without a direct fd, fabric offer/pull) run on the exec
+// pool, never on a loop thread.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <unordered_map>
+
+#include "btpu/common/admission.h"
+#include "btpu/common/stripe_counter.h"
+#include "btpu/common/thread_annotations.h"
+#include "btpu/net/net.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::transport {
+
+// One registered region, shared verbatim between the uring engine and the
+// thread-per-connection fallback. base != nullptr: flat memory (pool pages,
+// served zero-copy). base == nullptr: callback-backed (virtual) region;
+// direct_fd >= 0 additionally exposes the backing file for ring-submitted
+// reads (region offset == file offset — the disk backends are flat files).
+struct Region {
+  uint8_t* base{nullptr};
+  uint64_t len{0};
+  uint64_t remote_base{0};
+  RegionReadFn read_fn;
+  RegionWriteFn write_fn;
+  RegionOfferFn offer_fn;  // device-fabric hooks (attach_fabric); may be null
+  RegionPullFn pull_fn;
+  int direct_fd{-1};        // backing file for ring-unified reads; -1 = none
+  bool direct_odirect{false};  // O_DIRECT file: 512-align ring reads
+};
+
+// Region registry shared by whichever serve engine is running. The lock is
+// per-lookup (a few map ops); resolved callback copies are used outside it.
+struct RegionTable {
+  Mutex mutex;
+  std::unordered_map<uint64_t, Region> map BTPU_GUARDED_BY(mutex);
+
+  // Resolves (addr, rkey, len); returns false on violation. On success
+  // either `target` points into a flat region or `region_out` carries the
+  // callbacks (+ optional direct fd).
+  bool resolve(uint64_t addr, uint64_t rkey, uint64_t len, uint8_t*& target,
+               Region& region_out, uint64_t& offset) {
+    MutexLock lock(mutex);
+    auto it = map.find(rkey);
+    if (it == map.end()) return false;
+    const Region& region = it->second;
+    if (addr < region.remote_base || len > region.len ||
+        addr - region.remote_base > region.len - len)
+      return false;
+    offset = addr - region.remote_base;
+    if (region.base) {
+      target = region.base + offset;
+    } else {
+      target = nullptr;
+      region_out = region;
+    }
+    return true;
+  }
+};
+
+// Staging-segment handling shared VERBATIM by both serve engines — the
+// invariant is that a client cannot tell which engine answered, and shared
+// code is how that stays true across edits.
+//
+// Maps the client-created shm segment named by a hello op, replacing (and
+// unmapping) any previous mapping on success. Returns OK, or
+// CONNECTION_FAILED when the segment cannot be opened/mapped (different
+// host, stale name) — the client falls back to streaming on that status.
+ErrorCode map_staging_segment(const char* name, uint8_t*& stg_base, uint64_t& stg_len);
+
+// The staged-op bounds check applied before any byte of the segment is
+// believed (also the rejection-override rule: a bad segment outranks
+// shed/deadline statuses).
+inline bool staging_bounds_ok(const uint8_t* stg_base, uint64_t stg_len, uint64_t shm_off,
+                              uint64_t len) {
+  return stg_base != nullptr && shm_off <= stg_len && len <= stg_len - shm_off;
+}
+
+// Server-side lane counters shared with the fallback server (defined in
+// tcp_transport.cpp): ops/bytes served straight off registered pool pages
+// with zero worker-side staging copies, plus SEND_ZC completion
+// classification (a kernel that COPIED a "zero-copy" send reports it via
+// REPORT_USAGE — sustained nonzero copied on a real NIC is a perf
+// regression signal, see docs/OPERATIONS.md).
+struct DataPlaneCounters {
+  StripeCounter* pool_direct_ops{nullptr};
+  StripeCounter* pool_direct_bytes{nullptr};
+  StripeCounter* zerocopy_sent{nullptr};
+  StripeCounter* zerocopy_copied{nullptr};
+};
+
+// The event-loop data plane. create() probes io_uring at runtime and
+// returns null when it (or the env gate) says no — the caller then runs
+// the thread-per-connection fallback on the same listener.
+class UringDataPlane {
+ public:
+  struct Options {
+    unsigned loops{0};        // 0 = auto: min(hw_concurrency, 4)
+    unsigned sq_entries{512};  // per-loop SQ size (descending-retry on init)
+    unsigned exec_threads{2};  // blocking-callback offload pool cap
+    DataPlaneCounters counters{};
+  };
+
+  // Takes ownership of the listener ON SUCCESS ONLY — a null return (no
+  // io_uring on this kernel, env-forced off, init failure) leaves it with
+  // the caller so the thread-per-connection fallback can serve the same
+  // port. `regions` and `gate` must outlive the engine (the owning
+  // TcpTransportServer guarantees it).
+  static std::unique_ptr<UringDataPlane> create(net::Socket& listener, RegionTable* regions,
+                                                AdmissionGate* gate, const Options& opts);
+  ~UringDataPlane();
+
+  UringDataPlane(const UringDataPlane&) = delete;
+  UringDataPlane& operator=(const UringDataPlane&) = delete;
+
+  // Idempotent. Cancels in-flight ops, drains every completion, closes all
+  // connection fds and the listener, joins loop + exec threads.
+  void stop();
+
+  // Live accepted connections across all loops (diagnostics: fan-in tests
+  // assert thousands ride the engine without per-connection threads).
+  size_t connection_count() const noexcept;
+
+  struct Internals;
+
+ private:
+  UringDataPlane() = default;
+  std::unique_ptr<Internals> impl_;
+};
+
+// True when this process is allowed AND able to run the uring data plane:
+// BTPU_IOURING_NET (auto|0|1; legacy alias BTPU_FORCE_NO_URING=1 == 0)
+// permits it and a probe io_uring_setup succeeds. Cheap enough to call per
+// server start (one syscall + close on success).
+bool uring_runtime_available();
+
+// Live engine loops in this process (all UringDataPlane instances): the
+// lane scoreboard's "is the event loop actually on?" signal.
+size_t uring_active_loop_count() noexcept;
+
+}  // namespace btpu::transport

@@ -113,6 +113,35 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_cache_stale_rejects_total",
           "object-cache hits rejected because the object version moved",
           cache::cache_stale_reject_count());
+  // Data-plane stream lane + serve-engine shape (uring_engine.h): alert
+  // guidance in docs/OPERATIONS.md — btpu_uring_loops dropping to 0 on a
+  // box that normally runs the engine means every data server fell back to
+  // thread-per-connection at its last restart.
+  counter("btpu_pool_direct_ops_total",
+          "reads served straight off registered pool pages (zero worker-side staging copies)",
+          transport::tcp_pool_direct_op_count());
+  counter("btpu_pool_direct_bytes_total",
+          "bytes served pool-direct (single gather write, no staging copy)",
+          transport::tcp_pool_direct_byte_count());
+  counter("btpu_stream_op_count",
+          "client stream-lane ops (socket payload, one client-side fused copy)",
+          transport::tcp_stream_op_count());
+  counter("btpu_stream_byte_count", "client stream-lane bytes",
+          transport::tcp_stream_byte_count());
+  // ZC verdicts come from the kernel's REPORT_USAGE notifications. Alert
+  // shape (docs/OPERATIONS.md): copied climbing while sent is flat on a
+  // real NIC means SEND_ZC is paying pin+notif AND the copy — lower
+  // BTPU_ZC_THRESHOLD is hurting, raise it (or set BTPU_IOURING_ZC=0).
+  counter("btpu_zerocopy_sent_count",
+          "SEND_ZC completions the kernel transmitted zero-copy from pool pages",
+          transport::tcp_zerocopy_sent_count());
+  counter("btpu_zerocopy_copied_count",
+          "SEND_ZC completions the kernel had to copy (loopback always lands here)",
+          transport::tcp_zerocopy_copied_count());
+  gauge("btpu_uring_loops", "live io_uring data-plane event loops in this process",
+        static_cast<double>(transport::uring_active_loop_count()));
+  gauge("btpu_wire_pool_threads", "resolved shared wire worker pool size",
+        static_cast<double>(transport::wire_pool_threads_resolved()));
   counter("btpu_cached_bytes_total",
           "bytes served from the client object cache (zero wire bytes)",
           cache::cached_byte_count());

@@ -187,6 +187,13 @@ class IoUringDiskBackend : public OffsetBackendBase {
   void* base_address() const override { return nullptr; }  // served via read/write_at
   bool persistent() const override { return true; }
 
+  // Region offset == file offset (flat backing file): the TCP uring engine
+  // reads shards straight off this fd on its own ring.
+  int direct_io_fd(bool* odirect) const override {
+    if (odirect) *odirect = odirect_active_;
+    return fd_;
+  }
+
   ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
     return io_at(offset, const_cast<void*>(src), len, /*is_write=*/true);
   }

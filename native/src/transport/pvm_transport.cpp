@@ -223,10 +223,10 @@ bool still_same_process(long pid, unsigned long long starttime) {
 // `for_write` gates cached entries behind a starttime re-check (see above);
 // reads keep the no-syscall fast path.
 bool resolve(const std::string& ep, PvmTarget& out, bool for_write) {
-  static const bool disabled = [] {
-    return !env_bool("BTPU_PVM", true);
-  }();
-  if (disabled) return false;
+  // Read per call, like BTPU_STAGED_DATA: operators and the remote-lane
+  // tests flip it without a restart to force cross-host-shaped traffic
+  // (one getenv against a process_vm syscall is noise).
+  if (!env_bool("BTPU_PVM", true)) return false;
   const auto now = std::chrono::steady_clock::now();
   // Per-thread positive cache: the data-path common case (hot endpoint,
   // checked within the liveness window) touches no shared state at all.

@@ -40,6 +40,8 @@
 #include "btpu/transport/data_wire.h"
 #include "btpu/transport/transport.h"
 
+#include "../net/uring_engine.h"
+
 namespace btpu::transport {
 
 // Packed headers + checked decoders live in data_wire.h so the fuzz gate
@@ -47,6 +49,18 @@ namespace btpu::transport {
 using namespace datawire;
 
 namespace {
+
+// Server-side stream lane (shared with the uring engine through
+// DataPlaneCounters): reads answered straight off registered pool pages.
+StripeCounter g_pool_direct_ops;
+StripeCounter g_pool_direct_bytes;
+// SEND_ZC completion classification (engine only; REPORT_USAGE notifs):
+// sent = the kernel transmitted from the pool pages, copied = it fell back
+// to a private copy (loopback always does; on a real NIC a sustained
+// copied stream means the "zero-copy" path is paying for pinning AND the
+// copy it was meant to avoid).
+StripeCounter g_zerocopy_sent;
+StripeCounter g_zerocopy_copied;
 
 // Data-plane admission options (read once per server instance at start):
 // bounded concurrent data ops AND in-flight payload bytes, so neither a
@@ -72,16 +86,11 @@ AdmissionGate::Options data_gate_options() {
 // plane, blackbird_client.cpp:276-343). A server that cannot open the
 // segment (different host, old build) refuses or drops the connection and
 // the client falls back to streaming, remembered per endpoint.
-
-struct Region {
-  uint8_t* base{nullptr};  // null for virtual (callback-backed) regions
-  uint64_t len{0};
-  uint64_t remote_base{0};
-  RegionReadFn read_fn;
-  RegionWriteFn write_fn;
-  RegionOfferFn offer_fn;  // device-fabric hooks (attach_fabric); may be null
-  RegionPullFn pull_fn;
-};
+//
+// `Region` + the shared registry now live in ../net/uring_engine.h: the
+// same table serves whichever engine the server runs — the io_uring event
+// loop (default where the kernel allows it) or this file's thread-per-
+// connection fallback. Both speak the identical wire bytes.
 
 class TcpTransportServer : public TransportServer {
  public:
@@ -102,37 +111,68 @@ class TcpTransportServer : public TransportServer {
     host_ = (host.empty() || host == "0.0.0.0") ? "127.0.0.1" : host;
     port_ = bound;
     running_ = true;
-    accept_thread_ = std::thread([this] { accept_loop(); });
-    LOG_INFO << "tcp transport listening on " << host_ << ":" << port_;
+    // Engine selection at start time: the io_uring event loop where the
+    // kernel allows it (thousands of connections per core, pool-direct
+    // sends), thread-per-connection otherwise. BTPU_FORCE_NO_URING=1
+    // forces the fallback (tests exercise it; ops can pin it on a box
+    // where io_uring misbehaves).
+    // Clamps: a typo'd env value must not spawn a thread/ring storm (same
+    // policy as BTPU_WIRE_POOL_THREADS).
+    UringDataPlane::Options uopts;
+    uopts.loops = std::min(env_u32("BTPU_URING_LOOPS", 0), 64u);  // 0 = auto (min(hw, 4))
+    uopts.sq_entries = std::clamp(env_u32("BTPU_URING_SQ_ENTRIES", 512), 16u, 32768u);
+    // Exec pool sizes for BLOCKING callbacks, which are sleep/IO-bound,
+    // not CPU-bound: the bound that matters is the admission gate's op
+    // concurrency, not cores — 2 threads under a 64-op gate would queue
+    // admitted callback-tier reads 32 deep where the thread server ran
+    // them all concurrently. Threads are lazy, so the cap is free until a
+    // workload actually fans callbacks out.
+    const uint32_t hw = std::max(1u, std::thread::hardware_concurrency());
+    uopts.exec_threads =
+        std::min(env_u32("BTPU_URING_EXEC_THREADS", std::max(8u, hw)), 64u);
+    uopts.counters = {&g_pool_direct_ops, &g_pool_direct_bytes, &g_zerocopy_sent,
+                      &g_zerocopy_copied};
+    engine_ = UringDataPlane::create(listener_, &regions_, gate_.get(), uopts);
+    if (!engine_) {
+      accept_thread_ = std::thread([this] { accept_loop(); });
+    }
+    LOG_INFO << "tcp transport listening on " << host_ << ":" << port_
+             << (engine_ ? " (io_uring engine)" : " (thread-per-connection)");
     return ErrorCode::OK;
   }
 
   void stop() override {
     if (!running_.exchange(false)) return;
+    if (engine_) {
+      engine_->stop();  // cancels in-flight ops, closes conns + listener
+      engine_.reset();
+      return;
+    }
     if (accept_thread_.joinable()) accept_thread_.join();  // poll wakes <=200ms
     listener_.close();
-    std::vector<std::thread> threads;
+    std::vector<ConnSlot> slots;
     {
       MutexLock lock(conns_mutex_);
-      threads.swap(conn_threads_);
-      for (auto& s : conns_) s->shutdown();
-      conns_.clear();
+      slots.swap(conns_);
+      for (auto& s : slots) s.sock->shutdown();
     }
-    for (auto& t : threads)
-      if (t.joinable()) t.join();
+    for (auto& s : slots)
+      if (s.thread.joinable()) s.thread.join();
   }
 
   Result<RemoteDescriptor> register_region(void* base, uint64_t len,
                                            const std::string& tag) override {
     if (!base || len == 0) return ErrorCode::INVALID_PARAMETERS;
     if (!running_) return ErrorCode::INVALID_STATE;
-    MutexLock lock(regions_mutex_);
+    MutexLock lock(regions_.mutex);
     uint64_t rkey = rng_() | 1;
-    while (regions_.contains(rkey)) rkey = rng_() | 1;
+    while (regions_.map.contains(rkey)) rkey = rng_() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
-    regions_[rkey] = {static_cast<uint8_t*>(base), len,     remote_base,
-                      nullptr,                      nullptr, nullptr,
-                      nullptr};
+    Region region;
+    region.base = static_cast<uint8_t*>(base);
+    region.len = len;
+    region.remote_base = remote_base;
+    regions_.map[rkey] = std::move(region);
     RemoteDescriptor d;
     d.transport = TransportKind::TCP;
     d.endpoint = host_ + ":" + std::to_string(port_);
@@ -148,11 +188,14 @@ class TcpTransportServer : public TransportServer {
                                                    RegionWriteFn write_fn) override {
     if (len == 0 || !read_fn || !write_fn) return ErrorCode::INVALID_PARAMETERS;
     if (!running_) return ErrorCode::INVALID_STATE;
-    MutexLock lock(regions_mutex_);
+    MutexLock lock(regions_.mutex);
     uint64_t rkey = rng_() | 1;
-    while (regions_.contains(rkey)) rkey = rng_() | 1;
-    regions_[rkey] = {nullptr, len,     0, std::move(read_fn), std::move(write_fn),
-                      nullptr, nullptr};
+    while (regions_.map.contains(rkey)) rkey = rng_() | 1;
+    Region region;
+    region.len = len;
+    region.read_fn = std::move(read_fn);
+    region.write_fn = std::move(write_fn);
+    regions_.map[rkey] = std::move(region);
     RemoteDescriptor d;
     d.transport = TransportKind::TCP;
     d.endpoint = host_ + ":" + std::to_string(port_);
@@ -170,8 +213,8 @@ class TcpTransportServer : public TransportServer {
     } catch (...) {
       return ErrorCode::INVALID_PARAMETERS;
     }
-    MutexLock lock(regions_mutex_);
-    return regions_.erase(rkey) ? ErrorCode::OK : ErrorCode::MEMORY_POOL_NOT_FOUND;
+    MutexLock lock(regions_.mutex);
+    return regions_.map.erase(rkey) ? ErrorCode::OK : ErrorCode::MEMORY_POOL_NOT_FOUND;
   }
 
   ErrorCode attach_fabric(const RemoteDescriptor& desc, RegionOfferFn offer_fn,
@@ -182,45 +225,90 @@ class TcpTransportServer : public TransportServer {
     } catch (...) {
       return ErrorCode::INVALID_PARAMETERS;
     }
-    MutexLock lock(regions_mutex_);
-    auto it = regions_.find(rkey);
-    if (it == regions_.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
+    MutexLock lock(regions_.mutex);
+    auto it = regions_.map.find(rkey);
+    if (it == regions_.map.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
     it->second.offer_fn = std::move(offer_fn);
     it->second.pull_fn = std::move(pull_fn);
     return ErrorCode::OK;
   }
 
+  ErrorCode attach_direct_io(const RemoteDescriptor& desc, int fd, bool odirect) override {
+    if (fd < 0) return ErrorCode::INVALID_PARAMETERS;
+    uint64_t rkey = 0;
+    try {
+      rkey = std::stoull(desc.rkey_hex, nullptr, 16);
+    } catch (...) {
+      return ErrorCode::INVALID_PARAMETERS;
+    }
+    MutexLock lock(regions_.mutex);
+    auto it = regions_.map.find(rkey);
+    if (it == regions_.map.end()) return ErrorCode::MEMORY_POOL_NOT_FOUND;
+    if (it->second.base) return ErrorCode::INVALID_PARAMETERS;  // flat: already direct
+    it->second.direct_fd = fd;
+    it->second.direct_odirect = odirect;
+    return ErrorCode::OK;
+  }
+
+  size_t debug_connection_count() const override {
+    if (engine_) return engine_->connection_count();
+    MutexLock lock(conns_mutex_);
+    size_t live = 0;
+    for (const auto& s : conns_)
+      if (!s.done->load(std::memory_order_acquire)) ++live;
+    return live;
+  }
+
  private:
+  struct ConnSlot {
+    std::thread thread;
+    std::shared_ptr<net::Socket> sock;
+    // Set by the serving thread as its last act: the accept loop joins and
+    // erases finished slots, so a long-lived worker no longer accumulates
+    // dead thread handles until stop().
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+
   void accept_loop() {
     while (running_) {
       auto sock = net::tcp_accept(listener_, 200);
+      reap_finished();
       if (!sock.ok()) continue;
       auto conn = std::make_shared<net::Socket>(std::move(sock).value());
+      auto done = std::make_shared<std::atomic<bool>>(false);
       MutexLock lock(conns_mutex_);
-      conns_.push_back(conn);
-      conn_threads_.emplace_back([this, conn] { serve(conn); });
+      ConnSlot slot;
+      slot.sock = conn;
+      slot.done = done;
+      slot.thread = std::thread([this, conn, done] {
+        serve(conn);
+        done->store(true, std::memory_order_release);
+      });
+      conns_.push_back(std::move(slot));
     }
   }
 
-  // Resolves (addr, rkey, len); returns false on violation. On success either
-  // `target` points into a flat region or `region_out` carries callbacks.
-  bool resolve(uint64_t addr, uint64_t rkey, uint64_t len, uint8_t*& target, Region& region_out,
-               uint64_t& offset) {
-    MutexLock lock(regions_mutex_);
-    auto it = regions_.find(rkey);
-    if (it == regions_.end()) return false;
-    const Region& region = it->second;
-    if (addr < region.remote_base || len > region.len ||
-        addr - region.remote_base > region.len - len)
-      return false;
-    offset = addr - region.remote_base;
-    if (region.base) {
-      target = region.base + offset;
-    } else {
-      target = nullptr;
-      region_out = region;
+  // Joins and erases every finished serving thread. Runs on the accept
+  // loop (each accept + each 200ms accept timeout), so the live-slot count
+  // tracks live CONNECTIONS instead of growing monotonically.
+  void reap_finished() {
+    std::vector<ConnSlot> finished;
+    {
+      MutexLock lock(conns_mutex_);
+      for (size_t i = 0; i < conns_.size();) {
+        if (conns_[i].done->load(std::memory_order_acquire)) {
+          finished.push_back(std::move(conns_[i]));
+          conns_[i] = std::move(conns_.back());
+          conns_.pop_back();
+        } else {
+          ++i;
+        }
+      }
     }
-    return true;
+    // Join OUTSIDE the lock: `done` flips just before thread exit, so the
+    // join may still wait a few instructions.
+    for (auto& s : finished)
+      if (s.thread.joinable()) s.thread.join();
   }
 
   void serve(std::shared_ptr<net::Socket> sock) {
@@ -266,24 +354,10 @@ class TcpTransportServer : public TransportServer {
         // decode_request_header pinned len to [1, kMaxHelloNameBytes].
         char name[256] = {};
         if (net::read_exact(fd, name, hdr.len) != ErrorCode::OK) break;
-        uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
-        const int seg = ::shm_open(name, O_RDWR, 0600);
-        struct stat st {};
-        void* mapped = MAP_FAILED;
-        if (seg >= 0 && ::fstat(seg, &st) == 0 && st.st_size > 0) {
-          mapped = ::mmap(nullptr, static_cast<size_t>(st.st_size),
-                          PROT_READ | PROT_WRITE, MAP_SHARED, seg, 0);
-        }
-        if (seg >= 0) ::close(seg);
-        if (mapped == MAP_FAILED) {
-          // Different host (name unknown) or mapping failure: the client
-          // falls back to streaming on this ACK.
-          status = static_cast<uint32_t>(ErrorCode::CONNECTION_FAILED);
-        } else {
-          if (stg_base) ::munmap(stg_base, stg_len);
-          stg_base = static_cast<uint8_t*>(mapped);
-          stg_len = static_cast<uint64_t>(st.st_size);
-        }
+        // Shared with the uring engine (uring_engine.h): both engines must
+        // map — and refuse — segments identically.
+        const uint32_t status =
+            static_cast<uint32_t>(map_staging_segment(name, stg_base, stg_len));
         if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
         continue;
       }
@@ -293,7 +367,7 @@ class TcpTransportServer : public TransportServer {
         uint8_t* target = nullptr;
         Region virt;
         uint64_t offset = 0;
-        const bool valid = resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
+        const bool valid = regions_.resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
         // Admission + deadline gate PER CHUNK: staged sub-ops arrive as a
         // pipeline of chunk headers, so a budget that expires mid-transfer
@@ -311,7 +385,7 @@ class TcpTransportServer : public TransportServer {
             status = expired_status();
           }
         }
-        if (!valid || !stg_base || shm_off > stg_len || hdr.len > stg_len - shm_off) {
+        if (!valid || !staging_bounds_ok(stg_base, stg_len, shm_off, hdr.len)) {
           status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
         } else if (status != static_cast<uint32_t>(ErrorCode::OK)) {
           // rejected above: acknowledge without touching the region
@@ -350,7 +424,7 @@ class TcpTransportServer : public TransportServer {
         Region virt;
         uint64_t offset = 0;
         uint32_t status = static_cast<uint32_t>(ErrorCode::NOT_IMPLEMENTED);
-        if (!resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset) || target) {
+        if (!regions_.resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset) || target) {
           status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
         } else if (hdr.op == kOpFabricOffer && virt.offer_fn) {
           status = static_cast<uint32_t>(virt.offer_fn(offset, hdr.len, transfer_id));
@@ -366,7 +440,7 @@ class TcpTransportServer : public TransportServer {
       uint8_t* target = nullptr;
       Region virt;
       uint64_t offset = 0;
-      const bool valid = resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
+      const bool valid = regions_.resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
 
       if (hdr.op == kOpWrite) {
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
@@ -433,10 +507,13 @@ class TcpTransportServer : public TransportServer {
             return;
           continue;
         }
-        // Header + region bytes in one gather write: zero copy out.
+        // Header + region bytes in one gather write: zero copy out. Same
+        // pool-direct lane the uring engine serves (completion-only count).
         const uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
         if (net::write_iov2(fd, &status, sizeof(status), target, hdr.len) != ErrorCode::OK)
           return;
+        g_pool_direct_ops.add();
+        g_pool_direct_bytes.add(hdr.len);
       } else {
         break;  // protocol violation
       }
@@ -449,16 +526,18 @@ class TcpTransportServer : public TransportServer {
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
 
-  Mutex conns_mutex_;
-  std::vector<std::thread> conn_threads_ BTPU_GUARDED_BY(conns_mutex_);
-  std::vector<std::shared_ptr<net::Socket>> conns_ BTPU_GUARDED_BY(conns_mutex_);
+  mutable Mutex conns_mutex_;
+  std::vector<ConnSlot> conns_ BTPU_GUARDED_BY(conns_mutex_);
 
-  Mutex regions_mutex_;
-  std::unordered_map<uint64_t, Region> regions_ BTPU_GUARDED_BY(regions_mutex_);
+  // Shared with the uring engine (uring_engine.h): one registry, one
+  // resolve, whichever engine is serving.
+  RegionTable regions_;
   std::mt19937_64 rng_{0x7463707265670aull};
-  // Data-plane admission (one gate per server; all connection threads
-  // share it). Created at start() so env-configured tests see their knobs.
+  // Data-plane admission (one gate per server; both engines share it).
+  // Created at start() so env-configured tests see their knobs.
   std::unique_ptr<AdmissionGate> gate_;
+  // Event-loop engine (null = thread-per-connection fallback active).
+  std::unique_ptr<UringDataPlane> engine_;
 };
 
 }  // namespace
@@ -486,6 +565,10 @@ uint64_t tcp_staged_op_count() noexcept { return g_staged_ops.total(); }
 uint64_t tcp_staged_byte_count() noexcept { return g_staged_bytes.total(); }
 uint64_t tcp_stream_op_count() noexcept { return g_stream_ops.total(); }
 uint64_t tcp_stream_byte_count() noexcept { return g_stream_bytes.total(); }
+uint64_t tcp_pool_direct_op_count() noexcept { return g_pool_direct_ops.total(); }
+uint64_t tcp_pool_direct_byte_count() noexcept { return g_pool_direct_bytes.total(); }
+uint64_t tcp_zerocopy_sent_count() noexcept { return g_zerocopy_sent.total(); }
+uint64_t tcp_zerocopy_copied_count() noexcept { return g_zerocopy_copied.total(); }
 
 // A pooled data-plane connection, optionally with a negotiated same-host
 // staging segment (see the opcode block comment).
@@ -655,16 +738,28 @@ class TcpEndpointPool {
 //
 // A small process-wide pool for data-path parallelism: shard-parallel
 // striped transfers (each worker drives its own sub-ops on its own pooled
-// connections) and parallel memory-lane copies. Threads are lazy, detached,
-// and park on a condvar between jobs; on a single-core machine the pool is
-// empty and run() degrades to the caller's inline loop. The caller always
-// participates, so a saturated pool delays work but can never deadlock it.
+// connections) and parallel memory-lane copies. Threads are lazy, JOINABLE
+// (a detached pool made shutdown unfenceable: workers could touch freed
+// globals at process exit under asan/tsan), and park on a condvar between
+// jobs; the destructor raises stop_ and joins every worker. On a
+// single-core machine the pool is empty and run() degrades to the caller's
+// inline loop. The caller always participates — even against a stopped or
+// empty pool a job completes inline — so a saturated (or drained) pool
+// delays work but can never deadlock it.
 class WireWorkers {
  public:
   static WireWorkers& instance() {
-    // Leaked on purpose: detached workers may outlive static destructors.
-    static WireWorkers* pool = new WireWorkers();
-    return *pool;
+    static WireWorkers pool;  // destructor joins the workers at exit
+    return pool;
+  }
+
+  ~WireWorkers() {
+    {
+      MutexLock lock(mutex_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
   }
 
   size_t capacity() const noexcept { return nthreads_; }
@@ -702,11 +797,32 @@ class WireWorkers {
     std::condition_variable_any done_cv;
   };
 
+ public:
+  // Resolution is shared with the NON-instantiating metrics accessor
+  // (wire_pool_threads_resolved): a /metrics scrape on a process that
+  // never touches the data path must not spawn the pool as a side effect.
+  static size_t resolved_size() {
+    // Default: leave one core for the caller, cap at 6 (measured knee for
+    // shard-parallel drains). BTPU_WIRE_POOL_THREADS overrides — 0 is an
+    // explicit "inline only" (single-core semantics everywhere); values
+    // are clamped to 64 so a typo can't spawn a thread storm. Latched on
+    // FIRST call so the exported wire_pool_threads scoreboard value always
+    // matches the thread count the pool actually runs — a re-read would
+    // let a post-spawn setenv make the metric lie about the pool.
+    static const size_t resolved = [] {
+      const unsigned hw = std::thread::hardware_concurrency();
+      const unsigned fallback = hw > 1 ? std::min(hw - 1, 6u) : 0;
+      return std::min<size_t>(env_u32("BTPU_WIRE_POOL_THREADS", fallback), 64);
+    }();
+    return resolved;
+  }
+
+ private:
   WireWorkers() {
-    const unsigned hw = std::thread::hardware_concurrency();
-    nthreads_ = hw > 1 ? std::min(hw - 1, 6u) : 0;
+    nthreads_ = resolved_size();
+    threads_.reserve(nthreads_);
     for (size_t i = 0; i < nthreads_; ++i) {
-      std::thread([this] { worker_loop(); }).detach();
+      threads_.emplace_back([this] { worker_loop(); });
     }
   }
 
@@ -716,8 +832,8 @@ class WireWorkers {
       if (i >= job.n) return;
       // Containment, not handling: fn owns its error reporting (the batch
       // call sites catch inside fn and mark their ops failed). An escaped
-      // exception here would std::terminate a detached worker, or strand
-      // the job with dangling captures if it escaped the calling thread's
+      // exception here would std::terminate a pool worker, or strand the
+      // job with dangling captures if it escaped the calling thread's
       // help() — either way `done` must still advance.
       try {
         (*job.fn)(i);
@@ -737,7 +853,11 @@ class WireWorkers {
         MutexLock lock(mutex_);
         // Explicit loop: a predicate lambda is analyzed as an unannotated
         // function and would flag the guarded jobs_ read.
-        while (jobs_.empty()) cv_.wait(lock);
+        while (jobs_.empty() && !stop_) cv_.wait(lock);
+        // Drain-before-exit: a job enqueued concurrently with the
+        // destructor still completes (its owner is blocked in run() until
+        // `done` reaches n), THEN the worker honors stop_.
+        if (jobs_.empty()) return;
         job = jobs_.front();
         if (job->next.load() >= job->n) {
           // Exhausted but not yet erased by its owner: skip past it so a
@@ -754,6 +874,8 @@ class WireWorkers {
   Mutex mutex_;
   std::condition_variable_any cv_;
   std::deque<std::shared_ptr<Job>> jobs_ BTPU_GUARDED_BY(mutex_);
+  bool stop_ BTPU_GUARDED_BY(mutex_){false};
+  std::vector<std::thread> threads_;  // written once in the ctor, joined in the dtor
 };
 
 // ---- pipelined batch engine ------------------------------------------------
@@ -1121,6 +1243,8 @@ void wire_parallel_for(size_t n, const std::function<void(size_t)>& fn) {
 }
 
 size_t wire_parallel_capacity() noexcept { return WireWorkers::instance().capacity(); }
+
+size_t wire_pool_threads_resolved() noexcept { return WireWorkers::resolved_size(); }
 
 ErrorCode tcp_batch(WireOp* ops, size_t n, bool is_write, size_t max_concurrency) {
   const uint8_t opcode = is_write ? kOpWrite : kOpRead;

@@ -203,6 +203,19 @@ ErrorCode WorkerService::initialize() {
         registered = host->register_virtual_region(pool_cfg.capacity, pool_cfg.id,
                                                    read_fn, write_fn);
       }
+      // Disk tiers expose their flat backing file: the TCP uring engine
+      // then serves reads by submitting the file read on the same ring as
+      // its socket ops (no callback thread, no staging buffer). Transports
+      // without a ring engine answer NOT_IMPLEMENTED and keep the
+      // callbacks — tolerated, not an error.
+      if (registered.ok()) {
+        bool odirect = false;
+        const int direct_fd = backend->direct_io_fd(&odirect);
+        if (direct_fd >= 0) {
+          warn_if_error(host->attach_direct_io(registered.value(), direct_fd, odirect),
+                        "attach_direct_io", ErrorCode::NOT_IMPLEMENTED);
+        }
+      }
       // Device fabric (hbm_provider v4): advertise the provider's fabric
       // endpoint and serve offer/pull commands for this region, so
       // keystone-driven cross-process moves ride the device fabric instead

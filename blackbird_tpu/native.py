@@ -165,11 +165,26 @@ def _load() -> ctypes.CDLL:
                  "btpu_tcp_pool_direct_byte_count", "btpu_tcp_zerocopy_sent_count",
                  "btpu_tcp_zerocopy_copied_count", "btpu_uring_loop_count",
                  "btpu_wire_pool_threads", "btpu_cached_op_count",
-                 "btpu_cached_byte_count", "btpu_persist_retry_backlog"):
+                 "btpu_cached_byte_count", "btpu_persist_retry_backlog",
+                 "btpu_op_get_count", "btpu_op_get_p50_us", "btpu_op_get_p99_us",
+                 "btpu_flight_event_count", "btpu_trace_span_count"):
         if hasattr(handle, name):
             fn = getattr(handle, name)
             fn.restype = u64
             fn.argtypes = []
+    # Observability exports (optional, same prebuilt-library reason):
+    # histogram/trace/flight JSON dumps + the tracing master switch.
+    if hasattr(handle, "btpu_histograms_json"):
+        handle.btpu_histograms_json.restype = i32
+        handle.btpu_histograms_json.argtypes = [ctypes.c_char_p, u64,
+                                                ctypes.POINTER(u64)]
+        handle.btpu_trace_spans_json.restype = i32
+        handle.btpu_trace_spans_json.argtypes = [u64, ctypes.c_char_p, u64,
+                                                 ctypes.POINTER(u64)]
+        handle.btpu_flight_json.restype = i32
+        handle.btpu_flight_json.argtypes = [ctypes.c_char_p, u64, ctypes.POINTER(u64)]
+        handle.btpu_set_tracing.restype = None
+        handle.btpu_set_tracing.argtypes = [i32]
     # Durable embedded cluster (optional, same prebuilt-library reason):
     # cluster.py probes hasattr before offering data_dir.
     if hasattr(handle, "btpu_cluster_create_ex"):

@@ -6,6 +6,7 @@
 #include <fstream>
 
 #include "btpu/client/client.h"
+#include "btpu/common/trace.h"
 
 using namespace btpu;
 
@@ -29,6 +30,11 @@ int usage() {
 }  // namespace
 
 int main(int argc, char** argv) {
+  // Every op here is traced (OpScope in the client SDK); with
+  // BTPU_TRACE_DUMP=<dir> the span ring lands in <dir>/spans-bb-client-*.jsonl
+  // at exit for bb-trace to stitch, and BTPU_TRACE_SLOW_US prints the
+  // trace id of any slow op.
+  trace::set_process_name("bb-client");
   std::string keystone, command, key, file, out;
   uint64_t size = 0;
   WorkerConfig wc;

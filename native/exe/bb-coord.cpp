@@ -7,8 +7,12 @@
 #include <memory>
 #include <string>
 
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/coord/coord_server.h"
+#include "btpu/rpc/http_metrics.h"
 
 namespace {
 volatile std::sig_atomic_t g_stop = 0;
@@ -16,6 +20,8 @@ void handle_signal(int) { g_stop = 1; }
 }  // namespace
 
 int main(int argc, char** argv) {
+  btpu::trace::set_process_name("bb-coord");
+  btpu::flight::install_fatal_dump();
   std::string host = "0.0.0.0";
   uint16_t port = 9290;
   std::string follow;
@@ -71,6 +77,20 @@ int main(int argc, char** argv) {
                 follow.c_str());
   } else {
     std::printf("bb-coord listening on %s\n", server.endpoint().c_str());
+  }
+  // Observability HTTP server (BTPU_OBS_PORT; 0 = ephemeral): the WAL
+  // append/sync histograms + flight events of the durability path live in
+  // THIS process — /metrics + /debug/flight + /debug/trace serve them.
+  std::unique_ptr<btpu::rpc::MetricsHttpServer> obs;
+  if (btpu::env_str("BTPU_OBS_PORT")) {
+    obs = std::make_unique<btpu::rpc::MetricsHttpServer>(
+        nullptr, "0.0.0.0", static_cast<uint16_t>(btpu::env_u32("BTPU_OBS_PORT", 0)));
+    if (obs->start() == btpu::ErrorCode::OK) {
+      std::printf("bb-coord obs http on :%u\n", obs->port());
+    } else {
+      std::fprintf(stderr, "bb-coord: obs http failed to listen (continuing)\n");
+      obs.reset();
+    }
   }
   std::fflush(stdout);
   std::signal(SIGINT, handle_signal);

@@ -6,7 +6,9 @@
 #include <cstring>
 #include <thread>
 
+#include "btpu/common/flight_recorder.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/coord/remote_coordinator.h"
 #include "btpu/rpc/rpc_server.h"
 
@@ -16,6 +18,8 @@ void handle_signal(int) { g_stop = 1; }
 }  // namespace
 
 int main(int argc, char** argv) {
+  btpu::trace::set_process_name("bb-keystone");
+  btpu::flight::install_fatal_dump();
   std::string config_path;
   std::string coord_override;
   std::string listen_override;

@@ -1,0 +1,295 @@
+// bb-trace: collects span-ring dumps from every process of a cluster and
+// stitches ONE distributed trace into Chrome/Perfetto trace_event JSON.
+//
+// Sources (mix freely):
+//   --endpoint H:P   GET /debug/trace from a process's metrics/obs HTTP
+//                    server (bb-keystone --metrics-port, bb-worker/bb-coord
+//                    BTPU_OBS_PORT)
+//   --file PATH      a spans-*.jsonl file (BTPU_TRACE_DUMP at-exit dumps,
+//                    or a saved /debug/trace body)
+//   --dir DIR        every spans-*.jsonl under DIR
+//
+// Selection:
+//   --trace HEX      stitch exactly this 64-bit trace id (the id a slow-op
+//                    log line / bb-client prints)
+//   --list           print the collected trace ids (span count, root op,
+//                    total duration) and exit
+//   (default)        the trace with the LONGEST root span — "explain the
+//                    slowest op I just ran"
+//
+// Output (--out, default trace.json): {"traceEvents":[...]} with complete
+// ("X") events on the collecting processes' real pid/tid tracks and
+// process_name metadata — drag into https://ui.perfetto.dev. Timestamps
+// are CLOCK_MONOTONIC microseconds, comparable across processes on one
+// host (cross-host spans still nest per process; absolute alignment needs
+// synchronized clocks).
+#include <dirent.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "btpu/net/net.h"
+
+using namespace btpu;
+
+namespace {
+
+struct SpanRec {
+  std::string name;
+  uint64_t trace{0}, span{0}, parent{0};
+  double start_us{0}, dur_us{0};
+  int pid{0};
+  uint64_t tid{0};
+  std::string proc;
+};
+
+// Minimal field extraction for OUR fixed span-line format (trace.cpp
+// dump_spans_json) — not a general JSON parser on purpose: hostile input
+// here is a malformed line, and the answer is skipping it.
+bool find_string(const std::string& line, const char* key, std::string& out) {
+  const std::string pat = std::string("\"") + key + "\":\"";
+  const auto at = line.find(pat);
+  if (at == std::string::npos) return false;
+  const auto start = at + pat.size();
+  const auto end = line.find('"', start);
+  if (end == std::string::npos) return false;
+  out = line.substr(start, end - start);
+  return true;
+}
+
+bool find_number(const std::string& line, const char* key, double& out) {
+  const std::string pat = std::string("\"") + key + "\":";
+  const auto at = line.find(pat);
+  if (at == std::string::npos) return false;
+  out = std::strtod(line.c_str() + at + pat.size(), nullptr);
+  return true;
+}
+
+bool parse_span_line(const std::string& line, SpanRec& rec) {
+  std::string trace_hex, span_hex, parent_hex;
+  double start = 0, dur = 0, pid = 0, tid = 0;
+  if (!find_string(line, "name", rec.name)) return false;
+  if (!find_string(line, "trace", trace_hex)) return false;
+  if (!find_string(line, "span", span_hex)) return false;
+  if (!find_string(line, "parent", parent_hex)) return false;
+  if (!find_number(line, "start_us", start)) return false;
+  if (!find_number(line, "dur_us", dur)) return false;
+  (void)find_number(line, "pid", pid);
+  (void)find_number(line, "tid", tid);
+  (void)find_string(line, "proc", rec.proc);
+  rec.trace = std::strtoull(trace_hex.c_str(), nullptr, 16);
+  rec.span = std::strtoull(span_hex.c_str(), nullptr, 16);
+  rec.parent = std::strtoull(parent_hex.c_str(), nullptr, 16);
+  rec.start_us = start;
+  rec.dur_us = dur;
+  rec.pid = static_cast<int>(pid);
+  rec.tid = static_cast<uint64_t>(tid);
+  return rec.trace != 0;
+}
+
+void parse_body(const std::string& body, std::vector<SpanRec>& out) {
+  std::istringstream in(body);
+  std::string line;
+  while (std::getline(in, line)) {
+    SpanRec rec;
+    if (parse_span_line(line, rec)) out.push_back(std::move(rec));
+  }
+}
+
+// One-shot HTTP GET, returning the body (empty on any failure).
+std::string http_get(const std::string& endpoint, const std::string& path) {
+  auto hp = net::parse_host_port(endpoint);
+  if (!hp) {
+    std::fprintf(stderr, "bb-trace: bad endpoint '%s'\n", endpoint.c_str());
+    return "";
+  }
+  auto sock = net::tcp_connect(hp->host, hp->port, 3000);
+  if (!sock.ok()) {
+    std::fprintf(stderr, "bb-trace: cannot reach %s\n", endpoint.c_str());
+    return "";
+  }
+  const std::string req = "GET " + path + " HTTP/1.1\r\nHost: " + endpoint +
+                          "\r\nConnection: close\r\n\r\n";
+  if (net::write_all(sock.value().fd(), req.data(), req.size()) != ErrorCode::OK) return "";
+  std::string resp;
+  char buf[16384];
+  while (true) {
+    const ssize_t n = ::read(sock.value().fd(), buf, sizeof(buf));
+    if (n <= 0) break;
+    resp.append(buf, static_cast<size_t>(n));
+    if (resp.size() > (256u << 20)) break;  // runaway peer
+  }
+  const auto at = resp.find("\r\n\r\n");
+  return at == std::string::npos ? "" : resp.substr(at + 4);
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') {
+      out.push_back('\\');
+      out.push_back(ch);
+    } else if (static_cast<unsigned char>(ch) >= 0x20) {
+      out.push_back(ch);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::vector<std::string> endpoints, files;
+  std::string out_path = "trace.json";
+  uint64_t want_trace = 0;
+  bool list_only = false;
+  for (int i = 1; i < argc; ++i) {
+    const auto need = [&](const char* flag) {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "bb-trace: %s needs a value\n", flag);
+        std::exit(2);
+      }
+      return std::string(argv[++i]);
+    };
+    if (!std::strcmp(argv[i], "--endpoint")) endpoints.push_back(need("--endpoint"));
+    else if (!std::strcmp(argv[i], "--file")) files.push_back(need("--file"));
+    else if (!std::strcmp(argv[i], "--dir")) {
+      const std::string dir = need("--dir");
+      if (DIR* d = ::opendir(dir.c_str())) {
+        while (dirent* e = ::readdir(d)) {
+          const std::string n = e->d_name;
+          if (n.rfind("spans-", 0) == 0) files.push_back(dir + "/" + n);
+        }
+        ::closedir(d);
+      } else {
+        std::fprintf(stderr, "bb-trace: cannot read dir %s\n", dir.c_str());
+      }
+    } else if (!std::strcmp(argv[i], "--trace")) {
+      want_trace = std::strtoull(need("--trace").c_str(), nullptr, 16);
+    } else if (!std::strcmp(argv[i], "--out")) out_path = need("--out");
+    else if (!std::strcmp(argv[i], "--list")) list_only = true;
+    else {
+      std::printf(
+          "usage: bb-trace [--endpoint H:P]... [--file PATH]... [--dir DIR]\n"
+          "                [--trace HEX] [--list] [--out trace.json]\n"
+          "Collects /debug/trace span dumps from cluster processes (or\n"
+          "BTPU_TRACE_DUMP files) and stitches one trace id into\n"
+          "Chrome/Perfetto trace_event JSON (load at ui.perfetto.dev).\n");
+      return std::strcmp(argv[i], "--help") == 0 ? 0 : 2;
+    }
+  }
+  if (endpoints.empty() && files.empty()) {
+    std::fprintf(stderr, "bb-trace: no sources (need --endpoint/--file/--dir; --help)\n");
+    return 2;
+  }
+
+  std::vector<SpanRec> spans;
+  for (const auto& ep : endpoints) parse_body(http_get(ep, "/debug/trace"), spans);
+  for (const auto& f : files) {
+    std::ifstream in(f);
+    if (!in.good()) {
+      std::fprintf(stderr, "bb-trace: cannot read %s\n", f.c_str());
+      continue;
+    }
+    std::stringstream ss;
+    ss << in.rdbuf();
+    parse_body(ss.str(), spans);
+  }
+  if (spans.empty()) {
+    std::fprintf(stderr, "bb-trace: no spans collected\n");
+    return 1;
+  }
+
+  // Per-trace rollup: span count + the root span (parent == 0).
+  struct TraceInfo {
+    size_t count{0};
+    double root_dur_us{0};
+    std::string root_name;
+  };
+  std::map<uint64_t, TraceInfo> traces;
+  for (const auto& s : spans) {
+    auto& t = traces[s.trace];
+    ++t.count;
+    if (s.parent == 0 && s.dur_us >= t.root_dur_us) {
+      t.root_dur_us = s.dur_us;
+      t.root_name = s.name;
+    }
+  }
+  if (list_only) {
+    std::printf("%-18s %7s %12s  %s\n", "trace_id", "spans", "root_dur_us", "root_op");
+    std::vector<std::pair<uint64_t, TraceInfo>> rows(traces.begin(), traces.end());
+    std::sort(rows.begin(), rows.end(), [](const auto& a, const auto& b) {
+      return a.second.root_dur_us > b.second.root_dur_us;
+    });
+    for (const auto& [id, t] : rows)
+      std::printf("%016llx %7zu %12.1f  %s\n", static_cast<unsigned long long>(id),
+                  t.count, t.root_dur_us, t.root_name.c_str());
+    return 0;
+  }
+  if (want_trace == 0) {
+    // Default: the trace whose ROOT span ran longest — the op to explain.
+    double best = -1;
+    for (const auto& [id, t] : traces) {
+      if (t.root_dur_us > best) {
+        best = t.root_dur_us;
+        want_trace = id;
+      }
+    }
+  }
+  if (traces.find(want_trace) == traces.end()) {
+    std::fprintf(stderr, "bb-trace: trace %016llx not found in the collected spans "
+                 "(try --list)\n",
+                 static_cast<unsigned long long>(want_trace));
+    return 1;
+  }
+
+  std::ofstream out(out_path);
+  if (!out.good()) {
+    std::fprintf(stderr, "bb-trace: cannot write %s\n", out_path.c_str());
+    return 1;
+  }
+  out << "{\"traceEvents\":[\n";
+  bool first = true;
+  std::map<int, std::string> proc_names;
+  size_t emitted = 0;
+  for (const auto& s : spans) {
+    if (s.trace != want_trace) continue;
+    if (!proc_names.count(s.pid)) proc_names[s.pid] = s.proc;
+    char line[768];
+    std::snprintf(line, sizeof(line),
+                  "%s{\"ph\":\"X\",\"name\":\"%s\",\"cat\":\"btpu\",\"ts\":%.3f,"
+                  "\"dur\":%.3f,\"pid\":%d,\"tid\":%llu,\"args\":{\"span\":\"%016llx\","
+                  "\"parent\":\"%016llx\",\"trace\":\"%016llx\"}}",
+                  first ? "" : ",\n", json_escape(s.name).c_str(), s.start_us,
+                  s.dur_us > 0 ? s.dur_us : 0.001, s.pid,
+                  static_cast<unsigned long long>(s.tid),
+                  static_cast<unsigned long long>(s.span),
+                  static_cast<unsigned long long>(s.parent),
+                  static_cast<unsigned long long>(s.trace));
+    out << line;
+    first = false;
+    ++emitted;
+  }
+  for (const auto& [pid, name] : proc_names) {
+    char line[256];
+    std::snprintf(line, sizeof(line),
+                  "%s{\"ph\":\"M\",\"name\":\"process_name\",\"pid\":%d,"
+                  "\"args\":{\"name\":\"%s\"}}",
+                  first ? "" : ",\n", pid, json_escape(name).c_str());
+    out << line;
+    first = false;
+  }
+  out << "\n]}\n";
+  std::printf("bb-trace: wrote %zu spans of trace %016llx (%zu process(es)) to %s\n",
+              emitted, static_cast<unsigned long long>(want_trace), proc_names.size(),
+              out_path.c_str());
+  return 0;
+}

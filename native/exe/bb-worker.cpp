@@ -3,9 +3,14 @@
 #include <csignal>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <thread>
 
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
+#include "btpu/rpc/http_metrics.h"
 #include "btpu/worker/worker.h"
 
 namespace {
@@ -14,6 +19,8 @@ void handle_signal(int) { g_stop = 1; }
 }  // namespace
 
 int main(int argc, char** argv) {
+  btpu::trace::set_process_name("bb-worker");
+  btpu::flight::install_fatal_dump();
   std::string config_path;
   std::string coord_override;
   for (int i = 1; i < argc; ++i) {
@@ -40,6 +47,20 @@ int main(int argc, char** argv) {
   const auto& config = worker.config();
   std::printf("bb-worker %s up with %zu pools\n", config.worker_id.c_str(),
               config.pools.size());
+  // Observability HTTP server (BTPU_OBS_PORT; 0 = ephemeral): process-wide
+  // /metrics (histograms, lane counters) + /debug/flight + /debug/trace —
+  // bb-trace collects the worker hop of a distributed trace from here.
+  std::unique_ptr<btpu::rpc::MetricsHttpServer> obs;
+  if (btpu::env_str("BTPU_OBS_PORT")) {
+    obs = std::make_unique<btpu::rpc::MetricsHttpServer>(
+        nullptr, "0.0.0.0", static_cast<uint16_t>(btpu::env_u32("BTPU_OBS_PORT", 0)));
+    if (obs->start() == btpu::ErrorCode::OK) {
+      std::printf("bb-worker obs http on :%u\n", obs->port());
+    } else {
+      std::fprintf(stderr, "bb-worker: obs http failed to listen (continuing)\n");
+      obs.reset();
+    }
+  }
   std::fflush(stdout);
 
   std::signal(SIGINT, handle_signal);

@@ -172,6 +172,30 @@ void gen_seeds(const std::string& root) {
     rpc::append_deadline_trailer(p, 250);
     truncations("rpc_frame", "put_start_req_deadline", with_sel(1, p));
   }
+  {
+    // Fully-traced request: trace trailer INSIDE, deadline trailer
+    // OUTERMOST — the exact v5 client framing run_rpc_frame strips.
+    auto p = wire::to_bytes(GetWorkersRequest{"k"});
+    rpc::append_trace_trailer(p, 0xABCDEF0123456789ull, 0x42ull);
+    rpc::append_deadline_trailer(p, 250);
+    truncations("rpc_frame", "get_workers_req_traced", with_sel(0, p));
+  }
+  {
+    // Hostile: a trace trailer truncated mid-ids (magic intact, span id
+    // missing) — must strip nothing and decode as plain payload bytes.
+    auto p = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0x77});
+    rpc::append_trace_trailer(p, 0x1111222233334444ull, 0x5555ull);
+    p.resize(p.size() - 6);
+    emit("rpc_frame", "hostile_truncated_trace_trailer", with_sel(1, p));
+  }
+  {
+    // Hostile: a forged trace trailer carrying the reserved untraced id 0
+    // — strip_trace_trailer must refuse it (0 stays unambiguous).
+    auto p = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0x77});
+    rpc::append_trace_trailer(p, 1, 1);
+    std::memset(p.data() + p.size() - 16, 0, 8);  // zero the trace id in place
+    emit("rpc_frame", "hostile_zero_trace_id", with_sel(1, p));
+  }
 
   // control_error: the three legal codes, plus the clamp-pinning hostile
   // hint and an over-long (appended-field) frame.
@@ -191,8 +215,8 @@ void gen_seeds(const std::string& root) {
   // unknown-op and absurd-length variants.
   using namespace btpu::transport::datawire;
   auto hdr_bytes = [](uint8_t op, uint64_t addr, uint64_t rkey, uint64_t len,
-                      uint32_t dl) {
-    DataRequestHeader h{op, addr, rkey, len, dl};
+                      uint32_t dl, uint64_t trace_id = 0, uint64_t span_id = 0) {
+    DataRequestHeader h{op, addr, rkey, len, dl, trace_id, span_id};
     std::vector<uint8_t> v(sizeof(h));
     std::memcpy(v.data(), &h, sizeof(h));
     return v;
@@ -205,10 +229,31 @@ void gen_seeds(const std::string& root) {
   emit("tcp_header", "hostile_len", hdr_bytes(kOpWrite, 0, 0, ~0ull >> 1, 0));
   emit("tcp_header", "hostile_hello_len", hdr_bytes(kOpHello, 0, 0, 4096, 0));
   {
-    StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 256 << 10, 100}, 0x40000};
+    StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 256 << 10, 100, 0, 0}, 0x40000};
     std::vector<uint8_t> v(sizeof(f));
     std::memcpy(v.data(), &f, sizeof(f));
     truncations("tcp_header", "staged_write", v);
+  }
+  // Distributed-trace propagation seeds (observability change): a traced
+  // header, the legacy zero = untraced shape at the OLD 29-byte size (must
+  // now be rejected as truncated, never mis-decoded), and ids at the u64
+  // ceiling.
+  emit("tcp_header", "traced_read",
+       hdr_bytes(kOpRead, 0x1000, 0xBEEF, 65536, 250, 0x1122334455667788ull,
+                 0x99AABBCCDDEEFF00ull));
+  {
+    auto legacy = hdr_bytes(kOpRead, 0x1000, 0xBEEF, 65536, 0, 0, 0);
+    legacy.resize(29);  // the pre-trace header size
+    emit("tcp_header", "legacy_29b_truncated", legacy);
+  }
+  emit("tcp_header", "max_trace_ids",
+       hdr_bytes(kOpWrite, 0x2000, 0xBEEF, 4096, 0, ~0ull, ~0ull));
+  {
+    StagedFrame f{{kOpReadStaged, 0x1000, 0xBEEF, 64 << 10, 50, 0xD15711B07ull, 0x51A9ull},
+                  0x2000};
+    std::vector<uint8_t> v(sizeof(f));
+    std::memcpy(v.data(), &f, sizeof(f));
+    truncations("tcp_header", "traced_staged_read", v);
   }
 
   // record: worker/pool/object records (sel byte picks the decoder),
@@ -347,7 +392,7 @@ void bench_decode() {
   using clock = std::chrono::steady_clock;
 
   // Data-plane header: what the server parses per sub-op.
-  DataRequestHeader h{kOpRead, 0x1000, 0xBEEF, 1 << 20, 250};
+  DataRequestHeader h{kOpRead, 0x1000, 0xBEEF, 1 << 20, 250, 0xFEEDull, 0xBEEFull};
   std::vector<uint8_t> raw(sizeof(h));
   std::memcpy(raw.data(), &h, sizeof(h));
   constexpr int kHdrIters = 2'000'000;

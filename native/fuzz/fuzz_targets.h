@@ -1,8 +1,8 @@
 // Fuzz entry points for the four hostile-input decode surfaces:
 //
 //   rpc_frame      RPC payload decode (all message shapes) + the v4
-//                  deadline trailer strip, incl. an encode/decode
-//                  round-trip invariant
+//                  deadline and v5 trace trailer strips (server order),
+//                  incl. an encode/decode round-trip invariant
 //   control_error  0xEE pre-dispatch rejection frames
 //   tcp_header     raw TCP DataRequestHeader / StagedFrame (data_wire.h)
 //   record         WAL/persist records: worker info, pool record, object
@@ -69,9 +69,15 @@ inline int run_rpc_frame(const uint8_t* data, size_t size) {
   if (size == 0) return 0;
   const uint8_t sel = data[0];
   std::vector<uint8_t> payload(data + 1, data + size);
-  // The server strips the trailer before decoding — mirror that order.
+  // The server strips the trailers before decoding — mirror its order
+  // exactly: deadline (outermost, v4) first, then trace (v5).
   uint32_t budget_ms = 0;
   (void)rpc::strip_deadline_trailer(payload, budget_ms);
+  uint64_t trace_id = 0, parent_span = 0;
+  if (rpc::strip_trace_trailer(payload, trace_id, parent_span)) {
+    fuzz_expect(trace_id != 0,
+                "a stripped trace trailer must never carry the untraced id 0");
+  }
   switch (sel % 14) {
     case 0: rpc_roundtrip<GetWorkersResponse>(payload); break;
     case 1: rpc_roundtrip<PutStartRequest>(payload); break;

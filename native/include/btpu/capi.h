@@ -165,6 +165,33 @@ uint64_t btpu_breaker_skip_count(void);             /* client: open-endpoint dep
  * acked vs durable state diverged; alert (docs/OPERATIONS.md). */
 uint64_t btpu_persist_retry_backlog(void);
 
+/* ---- observability: histograms, distributed traces, flight recorder ------
+ * Real log-bucket latency histograms (btpu/common/histogram.h) replace the
+ * reservoir p50/p99 gauges: the "get" family summaries ride the lane
+ * counters; the full set (every op family, rpc methods, data ops, WAL
+ * sync, uring send) exports as JSON below and as _bucket/_sum/_count on
+ * /metrics. */
+uint64_t btpu_op_get_count(void);   /* samples in btpu_op_duration_us{op="get"} */
+uint64_t btpu_op_get_p50_us(void);  /* bucket-interpolated quantiles */
+uint64_t btpu_op_get_p99_us(void);
+uint64_t btpu_flight_event_count(void); /* flight-recorder events recorded */
+uint64_t btpu_trace_span_count(void);   /* spans recorded into the span ring */
+/* Master tracing switch (BTPU_TRACING env sets the default): 0 stops id
+ * minting, span recording, and flight events — the bench.py overhead
+ * guard's A/B dial. */
+void btpu_set_tracing(int32_t on);
+/* JSON exports, btpu_placements_json truncation contract (NULL buffer
+ * sizes; out_len reports the full length):
+ *   histograms: [{"family","label_key","label_value","count","sum_us",
+ *                 "p50_us","p99_us","buckets":[{"le_us","n"}...]}...]
+ *   trace spans: JSON lines (one object per span; trace_id 0 = all) — the
+ *                same body /debug/trace serves, consumable by bb-trace
+ *   flight: JSON lines, oldest first — the /debug/flight body */
+int32_t btpu_histograms_json(char* buffer, uint64_t buffer_size, uint64_t* out_len);
+int32_t btpu_trace_spans_json(uint64_t trace_id, char* buffer, uint64_t buffer_size,
+                              uint64_t* out_len);
+int32_t btpu_flight_json(char* buffer, uint64_t buffer_size, uint64_t* out_len);
+
 /* ---- client object cache (lease-coherent, btpu/cache/object_cache.h) -----
  * cache_bytes > 0 arms a client-side cache of verified object bytes:
  * repeated hot gets are served from memory with zero worker round trips.

@@ -20,6 +20,7 @@
 #include <unordered_map>
 
 #include "btpu/common/deadline.h"
+#include "btpu/common/flight_recorder.h"
 #include "btpu/common/thread_annotations.h"
 
 namespace btpu {
@@ -153,6 +154,7 @@ class CircuitBreaker {
     RetryPolicy jitter{options_.open_ms, options_.open_ms, 1.0, 1};
     open_until_ = Clock::now() + std::chrono::milliseconds(jitter.backoff_ms(0));
     robust_counters().breaker_trips.fetch_add(1, std::memory_order_relaxed);
+    flight::record(flight::Ev::kBreakerTrip);
   }
 
   const Options options_;

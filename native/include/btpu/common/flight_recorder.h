@@ -1,0 +1,110 @@
+// Per-process lock-free flight recorder: a striped ring of fixed-size
+// structured events (op start/end, retry/hedge/shed/breaker, cache
+// hit/miss, WAL append/sync, uring submit/complete) that is ALWAYS on.
+// When something goes wrong — a fatal signal, a hung op, an operator
+// asking "what was this process doing?" — the last N events are dumpable
+// as JSON (/debug/flight on any obs/metrics HTTP server, capi
+// btpu_flight_json) or written signal-safely to stderr by the fatal-signal
+// hook.
+//
+// Cost model: one relaxed fetch_add on a per-stripe head plus seven
+// relaxed atomic stores — tens of ns, cheap enough for every hot-path
+// event. Threads spread across 16 stripes (round-robin at first use, the
+// StripeCounter idiom), so concurrent recorders do not bounce one head
+// cache line.
+//
+// Memory ordering (docs/CORRECTNESS.md §9): each slot is a seqlock-lite.
+// The writer claims an index with fetch_add, stores seq=0 (release) to
+// mark the slot in flight, fills the payload fields (relaxed), then
+// publishes seq=index+1 (release). A dumper loads seq (acquire), reads the
+// payload, and re-loads seq: unchanged nonzero seq means the payload is a
+// consistent snapshot; anything else is discarded. All fields are atomics,
+// so a racing dump is tear-free field-by-field and tsan-clean; a slot
+// being overwritten during the dump is simply dropped.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace btpu::flight {
+
+// Event vocabulary. Append-only: dump consumers map by name, but the raw
+// value rides capi/json dumps, so renumbering breaks old readers.
+enum class Ev : uint8_t {
+  kOpStart = 1,       // a0 = 0, a1 = 0 (op name via trace ring / a0 unused)
+  kOpEnd = 2,         // a0 = duration us, a1 = error code (0 = OK)
+  kRpcStart = 3,      // a0 = opcode
+  kRpcEnd = 4,        // a0 = opcode, a1 = duration us
+  kRetry = 5,         // a0 = attempt number
+  kRetryBudgetOut = 6,
+  kHedgeFired = 7,
+  kHedgeWin = 8,
+  kShed = 9,          // a0 = 1 rpc plane, 2 data plane
+  kDeadlineExceeded = 10,  // a0 = 1 server-side, 0 client-side
+  kBreakerTrip = 11,
+  kCacheHit = 12,     // a0 = bytes served
+  kCacheMiss = 13,
+  kWalAppend = 14,    // a0 = record bytes
+  kWalSync = 15,      // a0 = sync duration us, a1 = records covered
+  kUringSubmit = 16,  // a0 = data op, a1 = len
+  kUringComplete = 17,  // a0 = data op, a1 = status (ErrorCode)
+  kDataOp = 18,       // thread-server data op served: a0 = op, a1 = dur us
+  kSlowOp = 19,       // a0 = duration us (threshold exceeded)
+  kSampled = 20,      // 1/N sampling hit: trace id is the one to stitch
+};
+
+const char* ev_name(Ev ev) noexcept;
+
+class Recorder {
+ public:
+  // Capacities are rounded up to powers of two. Events are dropped-oldest
+  // per stripe once a stripe wraps.
+  Recorder(size_t events_per_stripe, size_t stripes);
+  ~Recorder();
+  Recorder(const Recorder&) = delete;
+  Recorder& operator=(const Recorder&) = delete;
+
+  void record(Ev ev, uint64_t a0, uint64_t a1, uint64_t trace_id,
+              uint64_t t_ns) noexcept;
+
+  // JSON-lines dump, oldest first across all stripes:
+  //   {"t_us":...,"ev":"wal_sync","a0":...,"a1":...,"trace":"<hex>","tid":...}
+  std::string dump_json(size_t max_events = 0) const;
+
+  // Async-signal-safe-ish dump (snprintf + write(2) only, no allocation):
+  // the fatal-signal path. Best effort by design.
+  void dump_to_fd(int fd) const noexcept;
+
+  uint64_t recorded() const noexcept;  // total events ever recorded
+  size_t capacity() const noexcept;
+
+  struct Stripe;
+
+ private:
+  std::unique_ptr<Stripe[]> stripes_;
+  size_t nstripes_;
+  size_t per_stripe_;  // power of two
+};
+
+// The process-global recorder (BTPU_FLIGHT_EVENTS total capacity, default
+// 65536, floor 1024; always allocated — the whole point is that the data
+// is already there when the process dies).
+Recorder& recorder();
+
+// Stamps now_ns + the ambient trace context. No-ops when tracing is
+// disabled (BTPU_TRACING=0) so the overhead dial covers flight events too.
+void record(Ev ev, uint64_t a0 = 0, uint64_t a1 = 0) noexcept;
+// Caller already has a timestamp and context (hot paths avoid a second
+// clock read; event-loop code has no ambient context).
+void record_at(uint64_t t_ns, Ev ev, uint64_t a0, uint64_t a1,
+               uint64_t trace_id) noexcept;
+
+// Installs SIGSEGV/SIGBUS/SIGABRT handlers that dump the recorder to
+// stderr and re-raise. Called by the bb-* daemon mains (NOT library init:
+// sanitizer runtimes own these signals in test builds, and BTPU_FLIGHT_FATAL_DUMP=0
+// opts out entirely). Idempotent.
+void install_fatal_dump();
+
+}  // namespace btpu::flight

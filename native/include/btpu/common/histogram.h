@@ -1,0 +1,131 @@
+// Real Prometheus histograms for the hot op families — fixed log2 buckets,
+// cache-line-striped atomic counters, rendered as native
+// `_bucket`/`_sum`/`_count` exposition on /metrics.
+//
+// Replaces the reservoir p50/p99 gauges (trace::summary) as the latency
+// surface: a gauge of a reservoir percentile cannot be aggregated across
+// processes or windowed by a scraper; cumulative buckets can
+// (histogram_quantile over rate() — queries in docs/OPERATIONS.md).
+//
+// Bucket scheme: le = 1,2,4,...,2^26 microseconds (27 bounds, ~67s top)
+// plus +Inf. Fixed and identical for every family, so recording is one
+// bit-scan — no per-family configuration to drift. Sub-microsecond ops
+// land in le="1"; anything above ~67s is +Inf (and long since alerted).
+//
+// Recording is wait-free: pick a stripe (round-robin per thread), two
+// relaxed fetch_adds (bucket + sum) — safe from any thread, ~10 ns.
+// Snapshots sum the stripes relaxed; totals are monotonic, and a snapshot
+// of a moving histogram is exactly as consistent as any scrape.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <string>
+
+namespace btpu::hist {
+
+inline constexpr size_t kBucketCount = 28;  // [0..26] = le 2^i us, [27] = +Inf
+inline constexpr size_t kInfBucket = kBucketCount - 1;
+
+// Upper bound (inclusive, us) of bucket i; UINT64_MAX for +Inf.
+inline constexpr uint64_t bucket_le_us(size_t i) noexcept {
+  return i >= kInfBucket ? UINT64_MAX : (1ull << i);
+}
+
+// Smallest bucket whose bound covers `us`.
+inline size_t bucket_index(uint64_t us) noexcept {
+  if (us <= 1) return 0;
+  // i = ceil(log2(us)): 2^(i-1) < us <= 2^i.
+  const int bits = 64 - __builtin_clzll(us - 1);
+  return bits > 26 ? kInfBucket : static_cast<size_t>(bits);
+}
+
+class Histogram {
+ public:
+  void record_us(uint64_t us) noexcept { record_us_weighted(us, 1); }
+
+  // Sampled recording: one measured op stands for `weight` unmeasured
+  // peers (the cached-get fast path measures 1-in-8 — uniform sampling is
+  // quantile-unbiased, and the weight keeps _count/_sum rate math honest).
+  void record_us_weighted(uint64_t us, uint64_t weight) noexcept {
+    Stripe& s = stripe();
+    s.buckets[bucket_index(us)].fetch_add(weight, std::memory_order_relaxed);
+    s.sum_us.fetch_add(us * weight, std::memory_order_relaxed);
+  }
+
+  struct Snapshot {
+    uint64_t buckets[kBucketCount]{};  // per-bucket (NOT cumulative)
+    uint64_t count{0};
+    uint64_t sum_us{0};
+  };
+  Snapshot snapshot() const noexcept;
+
+  // Quantile estimate from bucket counts (log-midpoint interpolation
+  // within the winning bucket). 0 when empty. Good to ~the bucket width,
+  // which is what the capi/lane-counter summaries need.
+  static double quantile_us(const Snapshot& s, double q) noexcept;
+
+ private:
+  struct alignas(64) Stripe {
+    std::atomic<uint64_t> buckets[kBucketCount]{};
+    std::atomic<uint64_t> sum_us{0};
+  };
+
+  Stripe& stripe() noexcept {
+    static std::atomic<unsigned> next{0};
+    thread_local const unsigned idx = next.fetch_add(1, std::memory_order_relaxed) & 3u;
+    return stripes_[idx];
+  }
+
+  Stripe stripes_[4];
+};
+
+// ---- registry --------------------------------------------------------------
+// Histograms are registered under (family, label_key, label_value); all
+// strings must be literals (they are stored by pointer and rendered
+// forever). Lookup takes a mutex — call sites on hot paths cache the
+// reference in a function-local static.
+Histogram& get_histogram(const char* family, const char* help, const char* label_key,
+                         const char* label_value);
+
+// The core op families (docs/OPERATIONS.md documents every one):
+//   btpu_op_duration_us{op=...}       client ops: get, get_cached, get_many,
+//                                     put_inline, put_slot, put, put_many,
+//                                     remove (OpScope records these)
+//   btpu_rpc_duration_us{method=...}  keystone RPC service time per method
+//   btpu_data_op_duration_us{op=...}  data-plane ops served, both engines:
+//                                     read/write (stream lane), read_staged/
+//                                     write_staged (staged lane)
+//   btpu_wal_sync_duration_us         coordinator WAL fdatasync (group
+//                                     commit leader or per-record)
+//   btpu_uring_send_duration_us       uring response send: first submit ->
+//                                     final completion
+Histogram& op(const char* op_name);
+Histogram& rpc_method(const char* method);
+Histogram& data_op(const char* op_name);
+Histogram& wal_sync();
+Histogram& uring_send();
+
+struct SeriesView {
+  const char* family;
+  const char* help;
+  const char* label_key;    // nullptr = unlabeled family
+  const char* label_value;
+  const Histogram* h;
+};
+// Registration order, stable for the life of the process.
+void for_each_series(const std::function<void(const SeriesView&)>& fn);
+
+// Prometheus exposition for every registered series: one HELP/TYPE pair
+// per family, then every series' _bucket (cumulative, le-labeled, +Inf),
+// _sum, and _count lines. Appended to /metrics by http_metrics.cpp.
+std::string render_prometheus();
+
+// JSON for capi btpu_histograms_json / python Client.histograms():
+// [{"family":...,"label_key":...,"label_value":...,"count":...,"sum_us":...,
+//   "p50_us":...,"p99_us":...,"buckets":[{"le_us":...,"n":...},...]}, ...]
+// (buckets non-cumulative, zero buckets omitted).
+std::string dump_json();
+
+}  // namespace btpu::hist

@@ -1,11 +1,33 @@
-// First-class span timing.
+// Distributed op tracing: 64-bit trace ids minted at every client entry,
+// per-hop span ids, an in-memory span ring every process can dump, and
+// first-class span timing (the Dapper shape — PAPERS.md).
 //
-// Role parity: the reference has no structured tracing — demo clients
-// hand-roll high_resolution_clock spans (clients/ucx_client.cpp:116-148).
-// Since the scoreboard metric is p50/p99 latency (BASELINE.md), the
-// framework aggregates spans always-on (~20ns/op) and can emit JSONL events
-// when BTPU_TRACE=<path> is set. Aggregates surface in /metrics as
-// btpu_span_{p50,p99}_us{span="..."} gauges.
+// Three layers, cheapest first:
+//   * Aggregates (record/summary): per-name duration stats, always on.
+//   * Span ring: every Span that closes under a live trace context lands in
+//     a bounded lock-free ring of structured records {trace_id, span_id,
+//     parent, name, start_ns, dur_ns, tid}. `bb-trace` collects each
+//     process's ring (over /debug/trace or BTPU_TRACE_DUMP files) and
+//     stitches one trace id's records from every process into a
+//     Chrome/Perfetto trace_event JSON.
+//   * Slow-op / sampled surfacing: OpScope (opened at each ObjectClient
+//     public entry) mints the trace id, owns the op histogram sample, and
+//     on close logs the trace id of any op slower than BTPU_TRACE_SLOW_US
+//     (or every 1/BTPU_TRACE_SAMPLE'th op) so an operator knows WHICH id to
+//     stitch.
+//
+// Propagation: the ids ride the wire exactly like the PR-5 deadline — an
+// append-only tagged trailer on the RPC protocol (rpc.h) and appended
+// fields on the packed TCP data headers (data_wire.h). Zero = untraced
+// (legacy peers). Servers adopt the ids with RemoteScope / record spans
+// directly with record_remote_span (event-loop code with no thread
+// identity).
+//
+// Span names must be STRING LITERALS (static storage duration): the ring
+// stores the pointer, not a copy — enforced by scripts/btpu_lint.py
+// (trace-span-literal) so a dangling name cannot compile in. This also
+// fixes the historic footgun where Span held a std::string_view over a
+// caller temporary.
 //
 // Usage:  { TRACE_SPAN("client.put.transfer"); ...hot path... }
 #pragma once
@@ -18,6 +40,116 @@
 
 namespace btpu::trace {
 
+// ---- master switch ---------------------------------------------------------
+// BTPU_TRACING=0 turns id minting, span recording, and flight/op events off
+// (a single relaxed load per check). Default on: the bench.py trace-overhead
+// guard proves the hot cached get pays <= 5% for it.
+bool enabled() noexcept;
+void set_enabled(bool on) noexcept;
+
+// ---- ambient trace context -------------------------------------------------
+struct TraceContext {
+  uint64_t trace_id{0};  // 0 = untraced
+  uint64_t span_id{0};   // the CURRENT span (parent for anything opened now)
+};
+
+TraceContext current() noexcept;
+// Non-zero 64-bit id (thread-local xorshift128+; never returns 0).
+uint64_t mint_id() noexcept;
+
+// Process identity stamped on every dumped span (bb-trace shows it as the
+// Perfetto process name). Defaults to "proc". `name` must be a literal.
+void set_process_name(const char* name) noexcept;
+const char* process_name() noexcept;
+
+// ---- span records ----------------------------------------------------------
+// Steady-clock ns (CLOCK_MONOTONIC): comparable across processes on one
+// host, which is what makes single-host stitching line up. Cross-host
+// traces still nest correctly per process; absolute alignment needs a
+// synchronized clock and is out of scope.
+uint64_t now_ns() noexcept;
+
+// Records one completed span into the ring. `name` must be a string
+// literal. Used directly by event-loop servers (uring engine) whose ops
+// interleave on one thread; everything else goes through Span/OpScope.
+// Mints and returns the record's own span id.
+uint64_t record_remote_span(const char* name, uint64_t trace_id, uint64_t parent_span,
+                            uint64_t start_ns, uint64_t end_ns) noexcept;
+
+// JSON-lines dump of the span ring, oldest first, optionally filtered to
+// one trace id (0 = all). One object per line:
+//   {"name":...,"trace":"<hex>","span":"<hex>","parent":"<hex>",
+//    "start_us":...,"dur_us":...,"pid":...,"tid":...,"proc":...}
+// This is the exact body /debug/trace serves and bb-trace consumes.
+std::string dump_spans_json(uint64_t trace_id = 0);
+
+// Spans recorded into the ring since process start (diagnostics/tests).
+uint64_t span_ring_recorded() noexcept;
+
+// ---- slow-op surfacing -----------------------------------------------------
+// BTPU_TRACE_SLOW_US (0 = off): OpScope logs any op that closes slower,
+// with its trace id, and remembers the most recent ones here so tools can
+// pick a trace id without scraping logs.
+struct SlowOp {
+  const char* op{nullptr};
+  uint64_t trace_id{0};
+  uint64_t dur_us{0};
+};
+std::vector<SlowOp> recent_slow_ops();
+// Env-latched threshold, overridable at runtime (tests, live tuning).
+uint64_t slow_threshold_us() noexcept;
+void set_slow_threshold_us(uint64_t us) noexcept;
+
+// ---- per-op scope (client public entries) ----------------------------------
+// Mints a fresh trace context when none is active; nested entries (put()
+// calling put_many()) are fully INERT — the outer scope owns the histogram
+// sample and root span, so btpu_op_duration_us{op=...} stays the
+// distribution of the entry the caller invoked. On close: records the
+// duration into the op histogram, emits op start/end flight-recorder
+// events, writes the root span into the ring, and applies the
+// slow/sampled surfacing rules. `op` must be a string literal; relabel()
+// lets an entry refine the op family once the serving tier is known
+// (put -> put_inline/put_slot). The cached-get fast path deliberately
+// does NOT open one (client.cpp cached_probe_*: sampled light
+// instrumentation — a ~2us local serve cannot absorb this scope's cost
+// inside the bench.py 5% overhead budget).
+class OpScope {
+ public:
+  explicit OpScope(const char* op) noexcept;
+  ~OpScope();
+  OpScope(const OpScope&) = delete;
+  OpScope& operator=(const OpScope&) = delete;
+
+  void relabel(const char* op) noexcept { op_ = op; }
+  // 0 when tracing is disabled or this scope joined an outer op.
+  uint64_t trace_id() const noexcept { return root_ ? ctx_.trace_id : 0; }
+
+ private:
+  const char* op_;
+  TraceContext ctx_{};     // context this scope installed (root_ only)
+  TraceContext saved_{};   // restored on close
+  uint64_t start_ns_{0};
+  bool root_{false};
+  bool active_{false};
+};
+
+// ---- server-side adoption --------------------------------------------------
+// Installs wire-received ids as this thread's ambient context for the
+// handler's duration (keystone RPC dispatch, thread-per-connection data
+// server). trace_id 0 = untraced request: installs nothing.
+class RemoteScope {
+ public:
+  RemoteScope(uint64_t trace_id, uint64_t span_id) noexcept;
+  ~RemoteScope();
+  RemoteScope(const RemoteScope&) = delete;
+  RemoteScope& operator=(const RemoteScope&) = delete;
+
+ private:
+  TraceContext saved_{};
+  bool active_{false};
+};
+
+// ---- aggregate span timing (pre-existing layer) ----------------------------
 struct SpanStats {
   std::string name;
   uint64_t count{0};
@@ -27,28 +159,31 @@ struct SpanStats {
   double max_us{0};
 };
 
-// Records one duration sample for `name`.
+// Records one duration sample for `name` (reservoir aggregates + optional
+// BTPU_TRACE jsonl). Copies the name — any lifetime is fine HERE; the ring
+// layer is what requires literals.
 void record(std::string_view name, double duration_us);
 
 // Aggregated percentiles per span name (reservoir of recent samples).
 std::vector<SpanStats> summary();
 void reset();
 
-// RAII span.
+// RAII span. `name` MUST be a string literal (static storage duration):
+// the span ring stores the pointer (scripts/btpu_lint.py trace-span-literal
+// enforces call sites). Under a live trace context the span also becomes
+// the ambient parent for anything opened within it.
 class Span {
  public:
-  explicit Span(std::string_view name)
-      : name_(name), start_(std::chrono::steady_clock::now()) {}
-  ~Span() {
-    const auto end = std::chrono::steady_clock::now();
-    record(name_, std::chrono::duration<double, std::micro>(end - start_).count());
-  }
+  explicit Span(const char* name) noexcept;
+  ~Span();
   Span(const Span&) = delete;
   Span& operator=(const Span&) = delete;
 
  private:
-  std::string_view name_;
-  std::chrono::steady_clock::time_point start_;
+  const char* name_;
+  uint64_t start_ns_;
+  uint64_t own_span_{0};     // minted when traced; restored to parent on close
+  uint64_t parent_span_{0};
 };
 
 }  // namespace btpu::trace

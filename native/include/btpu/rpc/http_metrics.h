@@ -1,4 +1,16 @@
-// Minimal HTTP/1.1 server exposing Prometheus text metrics + /healthz.
+// Minimal HTTP/1.1 server exposing Prometheus text metrics, /healthz, and
+// the observability debug endpoints:
+//   /metrics       counters + gauges + REAL histograms (_bucket/_sum/_count)
+//   /healthz       liveness probe
+//   /debug/flight  flight-recorder dump (JSON lines, oldest first)
+//   /debug/trace   span-ring dump (JSON lines); ?trace=<16-hex-id> filters
+//                  to one trace — the endpoint bb-trace stitches from
+//
+// The keystone is OPTIONAL: a worker/coordinator process runs this server
+// too (BTPU_OBS_PORT in bb-worker/bb-coord) and serves the process-wide
+// sections — histograms, lane/robustness counters, flight, trace — without
+// any control-plane state. That is what makes every hop of a distributed
+// trace collectable over HTTP.
 //
 // Parity target: the reference runs a coro_http metrics server but never
 // registers the /metrics route (rpc_service.cpp:387-390, README claims
@@ -20,20 +32,25 @@ namespace btpu::rpc {
 
 class MetricsHttpServer {
  public:
-  MetricsHttpServer(keystone::KeystoneService& service, std::string host, uint16_t port);
+  // service == nullptr: process-wide observability only (worker/coord
+  // processes) — the keystone sections are simply omitted from /metrics.
+  MetricsHttpServer(keystone::KeystoneService* service, std::string host, uint16_t port);
+  MetricsHttpServer(keystone::KeystoneService& service, std::string host, uint16_t port)
+      : MetricsHttpServer(&service, std::move(host), port) {}
   ~MetricsHttpServer();
 
   ErrorCode start();
   void stop();
   uint16_t port() const noexcept { return port_; }
 
-  // Prometheus exposition text for the wrapped keystone (exposed for tests).
+  // Prometheus exposition text (exposed for tests — the /metrics
+  // self-check test parses exactly this).
   std::string render_metrics() const;
 
  private:
   void accept_loop();
 
-  keystone::KeystoneService& service_;
+  keystone::KeystoneService* service_;
   std::string host_;
   uint16_t port_;
   net::Socket listener_;

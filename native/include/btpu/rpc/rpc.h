@@ -32,7 +32,13 @@ namespace btpu::rpc {
 // v4: requests may carry a deadline trailer (below) and servers may answer
 // any request with a control-error frame (kControlErrorOpcode) — both are
 // ignored-by-old-peers constructs, so v3<->v4 still interoperates.
-inline constexpr uint32_t kProtocolVersion = 4;
+// v5: requests may additionally carry a trace trailer (trace id + parent
+// span id, Dapper-style propagation). Appended BEFORE the deadline trailer
+// so a v4 server still finds its deadline magic at the payload tail and
+// the trace bytes fall into the tail-tolerant decode; v4<->v5
+// interoperates in both directions (traced requests to an old server are
+// simply served untraced).
+inline constexpr uint32_t kProtocolVersion = 5;
 
 // First version whose put_complete APPLIES the appended content_crc field.
 // A newer client talking to an older keystone must keep stamping the
@@ -99,6 +105,105 @@ BTPU_NODISCARD inline bool strip_deadline_trailer(std::vector<uint8_t>& payload,
   if (!r.u32(budget_ms)) return false;
   payload.resize(at);
   return true;
+}
+
+// ---- trace propagation (protocol v5) ---------------------------------------
+// The ambient trace context rides as a second tagged trailer:
+// [u64 magic][u64 trace_id][u64 parent_span_id]. Append ORDER is the
+// compatibility contract: [request][trace trailer][deadline trailer] — the
+// deadline trailer stays OUTERMOST (at the payload tail) so a pre-v5
+// server's strip_deadline_trailer still matches, after which the trace
+// bytes are trailing garbage its tail-tolerant request decode ignores. A
+// v5 server strips deadline first, then trace. trace_id 0 is never sent
+// (untraced requests simply omit the trailer), so 0 stays the unambiguous
+// "untraced" value everywhere.
+inline constexpr uint64_t kTraceTrailerMagic = 0xB7D07A1DC0FFEE15ull;
+inline constexpr size_t kTraceTrailerBytes = 24;
+
+inline void append_trace_trailer(std::vector<uint8_t>& payload, uint64_t trace_id,
+                                 uint64_t parent_span_id) {
+  const size_t at = payload.size();
+  payload.resize(at + kTraceTrailerBytes);
+  std::memcpy(payload.data() + at, &kTraceTrailerMagic, sizeof(kTraceTrailerMagic));
+  std::memcpy(payload.data() + at + 8, &trace_id, sizeof(trace_id));
+  std::memcpy(payload.data() + at + 16, &parent_span_id, sizeof(parent_span_id));
+}
+
+// Strips a trailing trace trailer when present: true iff the magic matched
+// AND the carried trace id is nonzero (a forged zero id would alias the
+// "untraced" sentinel downstream — treat it as no trailer). The payload is
+// truncated to the bare bytes only when a valid trailer was found.
+BTPU_NODISCARD inline bool strip_trace_trailer(std::vector<uint8_t>& payload,
+                                               uint64_t& trace_id,
+                                               uint64_t& parent_span_id) {
+  if (payload.size() < kTraceTrailerBytes) return false;
+  const size_t at = payload.size() - kTraceTrailerBytes;
+  wire::WireReader r(payload.data() + at, kTraceTrailerBytes);
+  uint64_t magic = 0;
+  if (!r.u64(magic) || magic != kTraceTrailerMagic) return false;
+  uint64_t tid = 0, sid = 0;
+  if (!r.u64(tid) || !r.u64(sid)) return false;
+  if (tid == 0) return false;  // forged/hand-framed: 0 means untraced
+  trace_id = tid;
+  parent_span_id = sid;
+  payload.resize(at);
+  return true;
+}
+
+// Human-readable method names: histogram labels
+// (btpu_rpc_duration_us{method=...}) and span names share these literals.
+inline const char* method_name(uint8_t opcode) noexcept {
+  switch (static_cast<Method>(opcode)) {
+    case Method::kObjectExists: return "object_exists";
+    case Method::kGetWorkers: return "get_workers";
+    case Method::kPutStart: return "put_start";
+    case Method::kPutComplete: return "put_complete";
+    case Method::kPutCancel: return "put_cancel";
+    case Method::kRemoveObject: return "remove_object";
+    case Method::kRemoveAllObjects: return "remove_all_objects";
+    case Method::kGetClusterStats: return "get_cluster_stats";
+    case Method::kGetViewVersion: return "get_view_version";
+    case Method::kBatchObjectExists: return "batch_object_exists";
+    case Method::kBatchGetWorkers: return "batch_get_workers";
+    case Method::kBatchPutStart: return "batch_put_start";
+    case Method::kBatchPutComplete: return "batch_put_complete";
+    case Method::kBatchPutCancel: return "batch_put_cancel";
+    case Method::kPing: return "ping";
+    case Method::kDrainWorker: return "drain_worker";
+    case Method::kListObjects: return "list_objects";
+    case Method::kPutStartPooled: return "put_start_pooled";
+    case Method::kPutCommitSlot: return "put_commit_slot";
+    case Method::kPutInline: return "put_inline";
+  }
+  return "unknown";
+}
+
+// Span names for the server-side dispatch span (must be literals: the span
+// ring stores pointers — see trace.h).
+inline const char* method_span_name(uint8_t opcode) noexcept {
+  switch (static_cast<Method>(opcode)) {
+    case Method::kObjectExists: return "keystone.rpc.object_exists";
+    case Method::kGetWorkers: return "keystone.rpc.get_workers";
+    case Method::kPutStart: return "keystone.rpc.put_start";
+    case Method::kPutComplete: return "keystone.rpc.put_complete";
+    case Method::kPutCancel: return "keystone.rpc.put_cancel";
+    case Method::kRemoveObject: return "keystone.rpc.remove_object";
+    case Method::kRemoveAllObjects: return "keystone.rpc.remove_all_objects";
+    case Method::kGetClusterStats: return "keystone.rpc.get_cluster_stats";
+    case Method::kGetViewVersion: return "keystone.rpc.get_view_version";
+    case Method::kBatchObjectExists: return "keystone.rpc.batch_object_exists";
+    case Method::kBatchGetWorkers: return "keystone.rpc.batch_get_workers";
+    case Method::kBatchPutStart: return "keystone.rpc.batch_put_start";
+    case Method::kBatchPutComplete: return "keystone.rpc.batch_put_complete";
+    case Method::kBatchPutCancel: return "keystone.rpc.batch_put_cancel";
+    case Method::kPing: return "keystone.rpc.ping";
+    case Method::kDrainWorker: return "keystone.rpc.drain_worker";
+    case Method::kListObjects: return "keystone.rpc.list_objects";
+    case Method::kPutStartPooled: return "keystone.rpc.put_start_pooled";
+    case Method::kPutCommitSlot: return "keystone.rpc.put_commit_slot";
+    case Method::kPutInline: return "keystone.rpc.put_inline";
+  }
+  return "keystone.rpc.unknown";
 }
 
 // ---- control-error frames (protocol v4) ------------------------------------

@@ -56,6 +56,12 @@ struct DataRequestHeader {
   // receipt (relative budget = skew-free) and refuses/aborts work whose
   // budget is spent instead of serving answers nobody is waiting for.
   uint32_t deadline_ms;
+  // Distributed-trace propagation (appended with deadline_ms's contract:
+  // both sides of the data plane ship together). trace_id 0 = untraced
+  // (legacy peers, untraced ops); span_id is the CLIENT-side span that
+  // issued this request — the serving side parents its own span under it.
+  uint64_t trace_id;
+  uint64_t span_id;
 };
 
 // A staged request with its trailing segment offset, as it crosses the wire.
@@ -67,19 +73,24 @@ struct StagedFrame {
 
 // These headers cross the socket as raw bytes: freeze every offset, not
 // just the total, so an inserted field cannot shift the tail silently.
-// deadline_ms was APPENDED in the deadline-propagation change — both sides
-// of the data plane ship together (no length prefix tolerates a tail here),
-// so the frozen size moved 25 -> 29 in the same commit as every peer.
+// deadline_ms was APPENDED in the deadline-propagation change (25 -> 29);
+// trace_id/span_id were APPENDED in the distributed-tracing change
+// (29 -> 45, StagedFrame 37 -> 53) — both sides of the data plane ship
+// together (no length prefix tolerates a tail here), and
+// kTcpDataWireVersion (transport.h) fences mixed-version client/worker
+// pairs into a fast REMOTE_ENDPOINT_ERROR instead of a desynced stream.
 BTPU_WIRE_RAW_TYPE(DataRequestHeader);
-BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 29);
+BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 45);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, op, 0);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, addr, 1);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, rkey, 9);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, len, 17);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, deadline_ms, 25);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, trace_id, 29);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, span_id, 37);
 BTPU_WIRE_RAW_TYPE(StagedFrame);
-BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 37);
-BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 29);
+BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 53);
+BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 45);
 
 // ---- hostile-input ceilings ------------------------------------------------
 // A single data op moves at most this many payload bytes. Real ops are
@@ -107,7 +118,9 @@ BTPU_NODISCARD inline bool decode_request_header(const void* data, size_t size,
   uint8_t op = 0;
   uint64_t addr = 0, rkey = 0, len = 0;
   uint32_t deadline_ms = 0;
-  if (!r.u8(op) || !r.u64(addr) || !r.u64(rkey) || !r.u64(len) || !r.u32(deadline_ms))
+  uint64_t trace_id = 0, span_id = 0;
+  if (!r.u8(op) || !r.u64(addr) || !r.u64(rkey) || !r.u64(len) || !r.u32(deadline_ms) ||
+      !r.u64(trace_id) || !r.u64(span_id))
     return false;
   if (!valid_op(op)) return false;
   if (op == kOpHello) {
@@ -120,7 +133,40 @@ BTPU_NODISCARD inline bool decode_request_header(const void* data, size_t size,
   out.rkey = rkey;
   out.len = len;
   out.deadline_ms = deadline_ms;
+  // No validity constraint beyond their width: 0 = untraced, anything else
+  // is an opaque id — a hostile value can at worst pollute a trace view,
+  // never address memory or size a buffer.
+  out.trace_id = trace_id;
+  out.span_id = span_id;
   return true;
+}
+
+// Data-op span names (literals — the span ring stores pointers, trace.h).
+inline const char* data_op_span_name(uint8_t op) noexcept {
+  switch (op) {
+    case kOpRead: return "worker.data.read";
+    case kOpWrite: return "worker.data.write";
+    case kOpReadStaged: return "worker.data.read_staged";
+    case kOpWriteStaged: return "worker.data.write_staged";
+    case kOpHello: return "worker.data.hello";
+    case kOpFabricOffer: return "worker.data.fabric_offer";
+    case kOpFabricPull: return "worker.data.fabric_pull";
+  }
+  return "worker.data.unknown";
+}
+
+// Histogram labels for btpu_data_op_duration_us{op=...}.
+inline const char* data_op_hist_name(uint8_t op) noexcept {
+  switch (op) {
+    case kOpRead: return "read";
+    case kOpWrite: return "write";
+    case kOpReadStaged: return "read_staged";
+    case kOpWriteStaged: return "write_staged";
+    case kOpHello: return "hello";
+    case kOpFabricOffer: return "fabric_offer";
+    case kOpFabricPull: return "fabric_pull";
+  }
+  return "unknown";
 }
 
 // Staged frame = request header (must be a staged op) + u64 segment offset.

@@ -126,7 +126,8 @@ class TransportServer {
 // client/worker pair fails fast with REMOTE_ENDPOINT_ERROR instead of
 // desyncing the stream. 0 (pre-versioned metadata: legacy peers, WAL-restored
 // placements) is served on the documented both-sides-ship-together contract.
-inline constexpr uint32_t kTcpDataWireVersion = 1;
+// v2: trace_id/span_id appended to DataRequestHeader (29 -> 45 bytes).
+inline constexpr uint32_t kTcpDataWireVersion = 2;
 
 struct WireOp {
   const RemoteDescriptor* remote{nullptr};
@@ -150,6 +151,12 @@ struct WireOp {
   // (DEADLINE_EXCEEDED locally), and the serving side aborts chunks whose
   // budget expired in flight.
   Deadline deadline{};
+  // Distributed-trace context, stamped alongside the deadline (same
+  // calling-thread rule — fan-out threads must never read the ambient
+  // thread-local). Propagated on every TCP request header this op issues;
+  // 0 = untraced.
+  uint64_t trace_id{0};
+  uint64_t span_id{0};
 };
 
 // Client side: one-sided read/write against any advertised descriptor.

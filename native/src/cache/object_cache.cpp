@@ -3,6 +3,8 @@
 #include <algorithm>
 #include <functional>
 
+#include "btpu/common/flight_recorder.h"
+
 namespace btpu::cache {
 
 namespace {
@@ -10,6 +12,13 @@ namespace {
 // /metrics read these, like the transport lane counters).
 std::atomic<uint64_t> g_hits{0}, g_misses{0}, g_invalidations{0}, g_stale_rejects{0};
 std::atomic<uint64_t> g_cached_ops{0}, g_cached_bytes{0};
+
+// One flight-recorder event per process-global miss: the op is about to
+// pay a wire round trip — exactly what a flight dump wants to show.
+void global_miss() noexcept {
+  g_misses.fetch_add(1, std::memory_order_relaxed);
+  flight::record(flight::Ev::kCacheMiss);
+}
 }  // namespace
 
 uint64_t cache_hit_count() noexcept { return g_hits.load(std::memory_order_relaxed); }
@@ -24,6 +33,10 @@ uint64_t cached_op_count() noexcept { return g_cached_ops.load(std::memory_order
 uint64_t cached_byte_count() noexcept {
   return g_cached_bytes.load(std::memory_order_relaxed);
 }
+// No flight event here on purpose: this is the cached-get FAST path (the
+// bench.py trace-overhead budget), and the serving site already records a
+// light op_end event. Misses record kCacheMiss (global_miss above) — they
+// are about to pay a wire round trip, where one event is invisible.
 void note_cached_serve(uint64_t served_bytes) noexcept {
   g_cached_ops.fetch_add(1, std::memory_order_relaxed);
   g_cached_bytes.fetch_add(served_bytes, std::memory_order_relaxed);
@@ -101,7 +114,7 @@ ObjectCache::Hit ObjectCache::lookup(const ObjectKey& key) {
     auto idx = s.index.find(key);
     if (idx == s.index.end()) {
       misses_.fetch_add(1, std::memory_order_relaxed);
-      g_misses.fetch_add(1, std::memory_order_relaxed);
+      global_miss();
       return hit;
     }
     auto it = idx->second;
@@ -132,7 +145,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
     auto idx = s.index.find(key);
     if (idx == s.index.end()) {
       misses_.fetch_add(1, std::memory_order_relaxed);
-      g_misses.fetch_add(1, std::memory_order_relaxed);
+      global_miss();
       return hit;
     }
     auto it = idx->second;
@@ -143,7 +156,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
       stale_rejects_.fetch_add(1, std::memory_order_relaxed);
       g_stale_rejects.fetch_add(1, std::memory_order_relaxed);
       misses_.fetch_add(1, std::memory_order_relaxed);
-      g_misses.fetch_add(1, std::memory_order_relaxed);
+      global_miss();
       return hit;
     }
     hit.bytes = it->bytes;

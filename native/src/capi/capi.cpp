@@ -3,8 +3,13 @@
 #include <cstdio>
 #include <cstring>
 
+#include <algorithm>
+
 #include "btpu/client/embedded.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/transport/transport.h"
 
 using namespace btpu;
@@ -382,6 +387,50 @@ uint64_t btpu_breaker_skip_count(void) {
 }
 uint64_t btpu_persist_retry_backlog(void) {
   return keystone::persist_retry_backlog_process_total();
+}
+
+/* ---- observability: histograms, trace spans, flight recorder ------------- */
+
+namespace {
+// Shared truncating-copy contract of every *_json exporter (NULL buffer
+// sizes; out_len always reports the full length).
+int32_t copy_json_out(const std::string& json, char* buffer, uint64_t buffer_size,
+                      uint64_t* out_len) {
+  if (!out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  *out_len = json.size();
+  if (buffer && buffer_size > 0) {
+    const uint64_t n = std::min<uint64_t>(buffer_size, json.size());
+    std::memcpy(buffer, json.data(), n);
+  }
+  return 0;
+}
+}  // namespace
+
+uint64_t btpu_op_get_count(void) { return hist::op("get").snapshot().count; }
+uint64_t btpu_op_get_p50_us(void) {
+  const auto s = hist::op("get").snapshot();
+  return static_cast<uint64_t>(hist::Histogram::quantile_us(s, 0.50));
+}
+uint64_t btpu_op_get_p99_us(void) {
+  const auto s = hist::op("get").snapshot();
+  return static_cast<uint64_t>(hist::Histogram::quantile_us(s, 0.99));
+}
+uint64_t btpu_flight_event_count(void) { return flight::recorder().recorded(); }
+uint64_t btpu_trace_span_count(void) { return trace::span_ring_recorded(); }
+
+void btpu_set_tracing(int32_t on) { trace::set_enabled(on != 0); }
+
+int32_t btpu_histograms_json(char* buffer, uint64_t buffer_size, uint64_t* out_len) {
+  return copy_json_out(hist::dump_json(), buffer, buffer_size, out_len);
+}
+
+int32_t btpu_trace_spans_json(uint64_t trace_id, char* buffer, uint64_t buffer_size,
+                              uint64_t* out_len) {
+  return copy_json_out(trace::dump_spans_json(trace_id), buffer, buffer_size, out_len);
+}
+
+int32_t btpu_flight_json(char* buffer, uint64_t buffer_size, uint64_t* out_len) {
+  return copy_json_out(flight::recorder().dump_json(), buffer, buffer_size, out_len);
 }
 
 void btpu_client_cache_configure(btpu_client* client, uint64_t cache_bytes) {

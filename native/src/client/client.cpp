@@ -8,6 +8,8 @@
 
 #include "btpu/common/crc32c.h"
 #include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/wire.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
@@ -53,6 +55,27 @@ void apply_robustness_env(ClientOptions& options) {
   options.hedge_reads = env_bool("BTPU_HEDGE_READS", options.hedge_reads);
   options.inline_refusal_backoff_ms =
       env_u32("BTPU_INLINE_RETRY_MS", options.inline_refusal_backoff_ms);
+}
+
+// Sampled latency probe for the cached-get fast path: a ~2us local memcpy
+// cannot absorb the full tracing scope (two clock reads alone are ~3% of
+// it — the bench.py trace-overhead guard holds the line at 5%), so
+// 1-in-8 hits measure and record with weight 8 into
+// btpu_op_duration_us{op="get_cached"} + one flight op_end event. Uniform
+// sampling is quantile-unbiased, and the weight keeps _count/_sum rates
+// honest; the unmeasured 7/8 pay one tls increment and a branch. Cache
+// hits make no wire calls, so there is nothing to trace-propagate here.
+inline uint64_t cached_probe_start() {
+  thread_local uint32_t tick = 0;
+  if ((++tick & 7u) != 0 || !trace::enabled()) return 0;
+  return trace::now_ns();
+}
+
+inline void cached_probe_finish(uint64_t t0) {
+  if (t0 == 0) return;
+  const uint64_t dur_us = (trace::now_ns() - t0) / 1000;
+  hist::op("get_cached").record_us_weighted(dur_us, 8);
+  flight::record_at(t0 + dur_us * 1000, flight::Ev::kOpEnd, dur_us, 0, 0);
 }
 }  // namespace
 
@@ -388,6 +411,7 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 
 ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
                             const WorkerConfig& config) {
+  trace::OpScope op_trace("put");  // relabeled once the serving tier is known
   TRACE_SPAN("client.put");
   // The end-to-end budget covers every tier probe, transfer, and retry
   // below; a RETRY_LATER shed re-runs the whole body after jittered backoff
@@ -399,12 +423,18 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
     // control RTT stores the bytes in the object map, and the first verified
     // read needs no data-plane hop at all. nullopt = not applicable — fall
     // through to slots/placed.
-    if (auto inl = put_via_inline(key, data, size, config)) return *inl;
+    if (auto inl = put_via_inline(key, data, size, config)) {
+      op_trace.relabel("put_inline");
+      return *inl;
+    }
     // Small objects ride the pooled-slot path when possible: write into a
     // pre-allocated slot, then ONE control RTT commits it as `key` (and
     // refills the pool in the same round trip). nullopt = not applicable
     // (disabled, oversized, EC, embedded, slot reclaimed) — fall through.
-    if (auto pooled = put_via_slot(key, data, size, config)) return *pooled;
+    if (auto pooled = put_via_slot(key, data, size, config)) {
+      op_trace.relabel("put_slot");
+      return *pooled;
+    }
     // One-item batch: put_many pipelines the wire shards of EVERY copy in a
     // single pass (a replicated put costs ~one round trip, not one per copy),
     // coalesces device shards, and rolls back failed reservations — the exact
@@ -416,13 +446,21 @@ ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t siz
 
 Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
                                                std::optional<bool> verify) {
-  TRACE_SPAN("client.get");
   // Hot path: a coherent cached entry answers with one memcpy and zero
-  // worker involvement (the bytes were verified at fill time).
+  // worker involvement (the bytes were verified at fill time). It gets the
+  // SAMPLED light instrumentation (cached_probe_*): the full OpScope below
+  // costs a few hundred ns, which the ~2us cached serve cannot absorb
+  // inside the bench.py trace-overhead budget, while the wire-bound path
+  // below hides it completely.
+  const uint64_t cached_t0 = cached_probe_start();
   if (auto cached = cache_acquire(key)) {
     cache::note_cached_serve(cached->size());
-    return std::vector<uint8_t>(cached->begin(), cached->end());
+    std::vector<uint8_t> out(cached->begin(), cached->end());
+    cached_probe_finish(cached_t0);
+    return out;
   }
+  trace::OpScope op_trace("get");
+  TRACE_SPAN("client.get");
   OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   const bool v = verify.value_or(verify_reads());
   std::vector<uint8_t> buffer;
@@ -459,12 +497,18 @@ Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
 
 Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
                                         uint64_t buffer_size, std::optional<bool> verify) {
-  TRACE_SPAN("client.get");
   uint64_t got = 0;
   // Hot path: serve verified bytes straight out of the object cache (an
   // entry too large for `buffer` falls through; the normal path reports
-  // BUFFER_OVERFLOW with fresh metadata).
-  if (cache_ && cache_serve(key, buffer, buffer_size, got)) return got;
+  // BUFFER_OVERFLOW with fresh metadata). Sampled light instrumentation —
+  // see cached_probe_start for the overhead-budget rationale.
+  const uint64_t cached_t0 = cached_probe_start();
+  if (cache_ && cache_serve(key, buffer, buffer_size, got)) {
+    cached_probe_finish(cached_t0);
+    return got;
+  }
+  trace::OpScope op_trace("get");
+  TRACE_SPAN("client.get");
   OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   const bool v = verify.value_or(verify_reads());
   const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
@@ -538,6 +582,7 @@ ErrorCode ObjectClient::put_cancel(const ObjectKey& key) {
 }
 
 ErrorCode ObjectClient::remove(const ObjectKey& key) {
+  trace::OpScope op_trace("remove");
   OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   invalidate_placements(key);  // a re-created key must not serve stale bytes
   if (embedded_) return embedded_->remove_object(key);
@@ -1139,6 +1184,7 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
     } else {
       hedged = true;
       robust_counters().hedges_fired.fetch_add(1, std::memory_order_relaxed);
+      flight::record(flight::Ev::kHedgeFired);
     }
   }
 
@@ -1151,8 +1197,10 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
 
   MutexLock lock(race->m);
   if (sec_ec == ErrorCode::OK) {
-    if (hedged && !race->primary_done)
+    if (hedged && !race->primary_done) {
       robust_counters().hedge_wins.fetch_add(1, std::memory_order_relaxed);
+      flight::record(flight::Ev::kHedgeWin);
+    }
     read_latency_.record_us(us_since(t0));
     if (winner) *winner = &secondary;
     return ErrorCode::OK;  // bytes already in `out`; the primary drains into its loser buffer
@@ -1587,6 +1635,7 @@ std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items)
 
 std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
                                               const WorkerConfig& config) {
+  trace::OpScope op_trace("put_many");  // inert when put() already opened one
   TRACE_SPAN("client.put_many");
   // Nested scopes tighten: when put() already opened the op deadline this
   // is a no-op, and a direct put_many call gets its own budget.
@@ -1979,6 +2028,7 @@ void ObjectClient::cancel_pooled_slots() {
 
 std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,
                                                      std::optional<bool> verify) {
+  trace::OpScope op_trace("get_many");
   OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
   if (!cache_ || items.empty()) return get_many_uncached(items, verify);
   // Cache pass first: hits (e.g. a checkpoint's hot shards re-read by

@@ -1,0 +1,257 @@
+#include "btpu/common/flight_recorder.h"
+
+#include <csignal>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <vector>
+
+#include "btpu/common/env.h"
+#include "btpu/common/trace.h"
+
+namespace btpu::flight {
+
+const char* ev_name(Ev ev) noexcept {
+  switch (ev) {
+    case Ev::kOpStart: return "op_start";
+    case Ev::kOpEnd: return "op_end";
+    case Ev::kRpcStart: return "rpc_start";
+    case Ev::kRpcEnd: return "rpc_end";
+    case Ev::kRetry: return "retry";
+    case Ev::kRetryBudgetOut: return "retry_budget_out";
+    case Ev::kHedgeFired: return "hedge_fired";
+    case Ev::kHedgeWin: return "hedge_win";
+    case Ev::kShed: return "shed";
+    case Ev::kDeadlineExceeded: return "deadline_exceeded";
+    case Ev::kBreakerTrip: return "breaker_trip";
+    case Ev::kCacheHit: return "cache_hit";
+    case Ev::kCacheMiss: return "cache_miss";
+    case Ev::kWalAppend: return "wal_append";
+    case Ev::kWalSync: return "wal_sync";
+    case Ev::kUringSubmit: return "uring_submit";
+    case Ev::kUringComplete: return "uring_complete";
+    case Ev::kDataOp: return "data_op";
+    case Ev::kSlowOp: return "slow_op";
+    case Ev::kSampled: return "sampled";
+  }
+  return "unknown";
+}
+
+// One event slot: seqlock-lite, all-atomic (see header + CORRECTNESS §9).
+struct alignas(64) Slot {
+  std::atomic<uint64_t> seq{0};
+  std::atomic<uint64_t> t_ns{0};
+  std::atomic<uint64_t> trace_id{0};
+  std::atomic<uint64_t> a0{0};
+  std::atomic<uint64_t> a1{0};
+  std::atomic<uint64_t> ev_tid{0};  // ev in high 8 bits, tid low 32
+};
+
+struct Recorder::Stripe {
+  std::atomic<uint64_t> head{0};
+  std::unique_ptr<Slot[]> slots;
+};
+
+namespace {
+
+uint32_t flight_tid() noexcept {
+  // One syscall per thread; the recorder must not depend on trace.cpp's
+  // internals, so it keeps its own cached tid.
+  thread_local const uint32_t tid = static_cast<uint32_t>(::syscall(SYS_gettid));
+  return tid;
+}
+
+size_t round_pow2(size_t v, size_t floor_pow2) {
+  size_t p = floor_pow2;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+Recorder::Recorder(size_t events_per_stripe, size_t stripes)
+    : nstripes_(std::max<size_t>(stripes, 1)),
+      per_stripe_(round_pow2(std::max<size_t>(events_per_stripe, 64), 64)) {
+  stripes_ = std::make_unique<Stripe[]>(nstripes_);
+  for (size_t i = 0; i < nstripes_; ++i)
+    stripes_[i].slots = std::make_unique<Slot[]>(per_stripe_);
+}
+
+Recorder::~Recorder() = default;
+
+void Recorder::record(Ev ev, uint64_t a0, uint64_t a1, uint64_t trace_id,
+                      uint64_t t_ns) noexcept {
+  // Round-robin stripe per thread (StripeCounter idiom): stable for the
+  // thread's lifetime, spreads writers without a hash.
+  static std::atomic<unsigned> next{0};
+  thread_local const unsigned sidx = next.fetch_add(1, std::memory_order_relaxed);
+  Stripe& s = stripes_[sidx % nstripes_];
+  const uint64_t i = s.head.fetch_add(1, std::memory_order_relaxed);
+  Slot& slot = s.slots[i & (per_stripe_ - 1)];
+  slot.seq.store(0, std::memory_order_release);  // in flight
+  slot.t_ns.store(t_ns, std::memory_order_relaxed);
+  slot.trace_id.store(trace_id, std::memory_order_relaxed);
+  slot.a0.store(a0, std::memory_order_relaxed);
+  slot.a1.store(a1, std::memory_order_relaxed);
+  slot.ev_tid.store((static_cast<uint64_t>(ev) << 56) | flight_tid(),
+                    std::memory_order_relaxed);
+  slot.seq.store(i + 1, std::memory_order_release);
+}
+
+namespace {
+
+struct Snapped {
+  uint64_t t_ns, trace_id, a0, a1;
+  uint32_t tid;
+  Ev ev;
+};
+
+// Snapshot one slot; false when in flight / overwritten mid-read.
+bool snap_slot(const Slot& slot, uint64_t want_seq, Snapped& out) noexcept {
+  if (slot.seq.load(std::memory_order_acquire) != want_seq) return false;
+  out.t_ns = slot.t_ns.load(std::memory_order_relaxed);
+  out.trace_id = slot.trace_id.load(std::memory_order_relaxed);
+  out.a0 = slot.a0.load(std::memory_order_relaxed);
+  out.a1 = slot.a1.load(std::memory_order_relaxed);
+  const uint64_t et = slot.ev_tid.load(std::memory_order_relaxed);
+  out.tid = static_cast<uint32_t>(et & 0xffffffffu);
+  out.ev = static_cast<Ev>(et >> 56);
+  return slot.seq.load(std::memory_order_acquire) == want_seq;
+}
+
+int format_event(char* buf, size_t cap, const Snapped& e) noexcept {
+  return std::snprintf(buf, cap,
+                       "{\"t_us\":%.3f,\"ev\":\"%s\",\"a0\":%llu,\"a1\":%llu,"
+                       "\"trace\":\"%016llx\",\"tid\":%u}\n",
+                       static_cast<double>(e.t_ns) / 1000.0, ev_name(e.ev),
+                       static_cast<unsigned long long>(e.a0),
+                       static_cast<unsigned long long>(e.a1),
+                       static_cast<unsigned long long>(e.trace_id), e.tid);
+}
+
+}  // namespace
+
+std::string Recorder::dump_json(size_t max_events) const {
+  std::vector<Snapped> events;
+  events.reserve(256);
+  for (size_t si = 0; si < nstripes_; ++si) {
+    const Stripe& s = stripes_[si];
+    const uint64_t head = s.head.load(std::memory_order_acquire);
+    const uint64_t first = head > per_stripe_ ? head - per_stripe_ : 0;
+    for (uint64_t i = first; i < head; ++i) {
+      Snapped e{};
+      if (snap_slot(s.slots[i & (per_stripe_ - 1)], i + 1, e)) events.push_back(e);
+    }
+  }
+  std::sort(events.begin(), events.end(),
+            [](const Snapped& a, const Snapped& b) { return a.t_ns < b.t_ns; });
+  if (max_events > 0 && events.size() > max_events)
+    events.erase(events.begin(), events.end() - static_cast<ptrdiff_t>(max_events));
+  std::string out;
+  out.reserve(events.size() * 96);
+  char line[256];
+  for (const Snapped& e : events) {
+    const int n = format_event(line, sizeof(line), e);
+    if (n > 0) out.append(line, std::min<size_t>(static_cast<size_t>(n), sizeof(line) - 1));
+  }
+  return out;
+}
+
+void Recorder::dump_to_fd(int fd) const noexcept {
+  // No allocation, no locks: snprintf into a stack buffer + write(2). Runs
+  // from the fatal-signal handler; a torn or overwritten slot is skipped,
+  // ordering across stripes is NOT reconstructed (sorting needs memory).
+  static const char hdr[] = "---- flight recorder (unsorted, per stripe) ----\n";
+  (void)!::write(fd, hdr, sizeof(hdr) - 1);
+  char line[256];
+  for (size_t si = 0; si < nstripes_; ++si) {
+    const Stripe& s = stripes_[si];
+    const uint64_t head = s.head.load(std::memory_order_acquire);
+    const uint64_t first = head > per_stripe_ ? head - per_stripe_ : 0;
+    for (uint64_t i = first; i < head; ++i) {
+      Snapped e{};
+      if (!snap_slot(s.slots[i & (per_stripe_ - 1)], i + 1, e)) continue;
+      const int n = format_event(line, sizeof(line), e);
+      if (n > 0) (void)!::write(fd, line, std::min<size_t>(static_cast<size_t>(n), sizeof(line) - 1));
+    }
+  }
+  static const char tail[] = "---- end flight recorder ----\n";
+  (void)!::write(fd, tail, sizeof(tail) - 1);
+}
+
+uint64_t Recorder::recorded() const noexcept {
+  uint64_t sum = 0;
+  for (size_t i = 0; i < nstripes_; ++i)
+    sum += stripes_[i].head.load(std::memory_order_relaxed);
+  return sum;
+}
+
+size_t Recorder::capacity() const noexcept { return nstripes_ * per_stripe_; }
+
+Recorder& recorder() {
+  static Recorder* r = [] {
+    constexpr size_t kStripes = 16;
+    size_t total = env_u64("BTPU_FLIGHT_EVENTS", 65536);
+    total = std::max<size_t>(total, 1024);
+    return new Recorder(total / kStripes, kStripes);  // leaked: dumped at fatal
+  }();
+  return *r;
+}
+
+void record(Ev ev, uint64_t a0, uint64_t a1) noexcept {
+  if (!trace::enabled()) return;
+  record_at(trace::now_ns(), ev, a0, a1, trace::current().trace_id);
+}
+
+void record_at(uint64_t t_ns, Ev ev, uint64_t a0, uint64_t a1,
+               uint64_t trace_id) noexcept {
+  if (!trace::enabled()) return;
+  recorder().record(ev, a0, a1, trace_id, t_ns);
+}
+
+// ---- fatal dump ------------------------------------------------------------
+
+namespace {
+
+struct sigaction g_prev[3];
+const int g_signals[3] = {SIGSEGV, SIGBUS, SIGABRT};
+
+void fatal_handler(int sig, siginfo_t* info, void* uctx) {
+  static const char msg[] = "fatal signal; dumping flight recorder to stderr\n";
+  (void)!::write(2, msg, sizeof(msg) - 1);
+  recorder().dump_to_fd(2);
+  // Restore the previous disposition and re-raise so the default (or the
+  // prior handler's) crash semantics are preserved.
+  for (int i = 0; i < 3; ++i) {
+    if (g_signals[i] == sig) {
+      ::sigaction(sig, &g_prev[i], nullptr);
+      break;
+    }
+  }
+  ::raise(sig);
+  (void)info;
+  (void)uctx;
+}
+
+}  // namespace
+
+void install_fatal_dump() {
+  static bool installed = [] {
+    if (!env_bool("BTPU_FLIGHT_FATAL_DUMP", true)) return false;
+    // Construct the recorder NOW: the handler must never be the first
+    // caller (operator new + a magic-static guard inside a SIGSEGV —
+    // possibly under a held heap lock — deadlocks instead of dumping).
+    (void)recorder();
+    struct sigaction sa{};
+    sa.sa_sigaction = fatal_handler;
+    sa.sa_flags = SA_SIGINFO | SA_RESETHAND;
+    sigemptyset(&sa.sa_mask);
+    for (int i = 0; i < 3; ++i) ::sigaction(g_signals[i], &sa, &g_prev[i]);
+    return true;
+  }();
+  (void)installed;
+}
+
+}  // namespace btpu::flight

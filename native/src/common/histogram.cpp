@@ -1,0 +1,249 @@
+#include "btpu/common/histogram.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include "btpu/common/thread_annotations.h"
+
+namespace btpu::hist {
+
+Histogram::Snapshot Histogram::snapshot() const noexcept {
+  Snapshot s;
+  for (const Stripe& st : stripes_) {
+    for (size_t i = 0; i < kBucketCount; ++i)
+      s.buckets[i] += st.buckets[i].load(std::memory_order_relaxed);
+    s.sum_us += st.sum_us.load(std::memory_order_relaxed);
+  }
+  for (size_t i = 0; i < kBucketCount; ++i) s.count += s.buckets[i];
+  return s;
+}
+
+double Histogram::quantile_us(const Snapshot& s, double q) noexcept {
+  if (s.count == 0) return 0.0;
+  const double target = q * static_cast<double>(s.count);
+  uint64_t seen = 0;
+  for (size_t i = 0; i < kBucketCount; ++i) {
+    if (s.buckets[i] == 0) continue;
+    const uint64_t next = seen + s.buckets[i];
+    if (static_cast<double>(next) >= target) {
+      if (i >= kInfBucket) return static_cast<double>(bucket_le_us(kInfBucket - 1));
+      // Log-linear interpolation inside the winning bucket [lo, hi].
+      const double lo = i == 0 ? 0.5 : static_cast<double>(bucket_le_us(i - 1));
+      const double hi = static_cast<double>(bucket_le_us(i));
+      const double frac =
+          (target - static_cast<double>(seen)) / static_cast<double>(s.buckets[i]);
+      return lo * std::pow(hi / lo, frac);
+    }
+    seen = next;
+  }
+  return static_cast<double>(bucket_le_us(kInfBucket - 1));
+}
+
+// ---- registry --------------------------------------------------------------
+// Lock-free read path (hot: every OpScope close resolves its series): an
+// atomic singly-linked list walked with pointer-equality fast path then
+// strcmp. Insertions are rare and mutex-serialized.
+
+namespace {
+
+struct Series {
+  const char* family;
+  const char* help;
+  const char* label_key;
+  const char* label_value;
+  Histogram h;
+  Series* next;  // toward older registrations
+};
+
+std::atomic<Series*> g_series_head{nullptr};
+Mutex g_register_mutex;
+
+bool label_eq(const char* a, const char* b) {
+  if (a == b) return true;
+  if (!a || !b) return false;
+  return std::strcmp(a, b) == 0;
+}
+
+}  // namespace
+
+Histogram& get_histogram(const char* family, const char* help, const char* label_key,
+                         const char* label_value) {
+  for (Series* s = g_series_head.load(std::memory_order_acquire); s; s = s->next) {
+    if (label_eq(s->family, family) && label_eq(s->label_key, label_key) &&
+        label_eq(s->label_value, label_value))
+      return s->h;
+  }
+  MutexLock lock(g_register_mutex);
+  // Re-check under the lock (two threads registering the same series).
+  for (Series* s = g_series_head.load(std::memory_order_acquire); s; s = s->next) {
+    if (label_eq(s->family, family) && label_eq(s->label_key, label_key) &&
+        label_eq(s->label_value, label_value))
+      return s->h;
+  }
+  Series* fresh = new Series{family, help, label_key, label_value, {}, nullptr};
+  fresh->next = g_series_head.load(std::memory_order_relaxed);
+  g_series_head.store(fresh, std::memory_order_release);
+  return fresh->h;
+}
+
+namespace {
+
+// Per-thread pointer-identity memo for the hot accessors: label values are
+// literals, so the SAME call site always passes the same pointer — a hit
+// is a few pointer compares instead of the registry walk's strcmps (which
+// measured on the cached-get fast path). Misses (first touch per thread,
+// or a literal duplicated across TUs) fall through to the registry.
+Histogram& memoized(const char* family, const char* help, const char* label_key,
+                    const char* label_value) {
+  struct Entry {
+    const char* family;  // both keys: the compiler may merge identical
+    const char* value;   // literals ACROSS families (e.g. "read")
+    Histogram* h;
+  };
+  thread_local Entry cache[8] = {};
+  thread_local unsigned next = 0;
+  for (const Entry& e : cache)
+    if (e.value == label_value && e.family == family && e.h) return *e.h;
+  Histogram& h = get_histogram(family, help, label_key, label_value);
+  cache[next++ & 7u] = {family, label_value, &h};
+  return h;
+}
+
+}  // namespace
+
+Histogram& op(const char* op_name) {
+  return memoized("btpu_op_duration_us",
+                  "client op latency (us) by op family", "op", op_name);
+}
+
+Histogram& rpc_method(const char* method) {
+  return memoized("btpu_rpc_duration_us",
+                  "keystone RPC service time (us) by method", "method", method);
+}
+
+Histogram& data_op(const char* op_name) {
+  return memoized("btpu_data_op_duration_us",
+                  "data-plane op service time (us), both serve engines", "op",
+                  op_name);
+}
+
+Histogram& wal_sync() {
+  static Histogram& h = get_histogram(
+      "btpu_wal_sync_duration_us",
+      "coordinator WAL fdatasync latency (us; group-commit leader or per-record)",
+      nullptr, nullptr);
+  return h;
+}
+
+Histogram& uring_send() {
+  static Histogram& h = get_histogram(
+      "btpu_uring_send_duration_us",
+      "uring response send latency (us): first submit to final completion", nullptr,
+      nullptr);
+  return h;
+}
+
+void for_each_series(const std::function<void(const SeriesView&)>& fn) {
+  // The list is newest-first; render registration order for stable output.
+  std::vector<Series*> all;
+  for (Series* s = g_series_head.load(std::memory_order_acquire); s; s = s->next)
+    all.push_back(s);
+  for (auto it = all.rbegin(); it != all.rend(); ++it) {
+    Series* s = *it;
+    fn(SeriesView{s->family, s->help, s->label_key, s->label_value, &s->h});
+  }
+}
+
+std::string render_prometheus() {
+  // Group series by family: HELP/TYPE exactly once per family, then every
+  // series' cumulative buckets + _sum + _count.
+  std::string out;
+  out.reserve(4096);
+  std::vector<const char*> rendered;
+  std::vector<SeriesView> views;
+  for_each_series([&](const SeriesView& v) { views.push_back(v); });
+  char line[256];
+  auto append = [&](int n) {
+    if (n > 0) out.append(line, std::min<size_t>(static_cast<size_t>(n), sizeof(line) - 1));
+  };
+  for (const SeriesView& v : views) {
+    bool seen = false;
+    for (const char* f : rendered) seen = seen || std::strcmp(f, v.family) == 0;
+    if (seen) continue;
+    rendered.push_back(v.family);
+    append(std::snprintf(line, sizeof(line), "# HELP %s %s\n# TYPE %s histogram\n",
+                         v.family, v.help, v.family));
+    for (const SeriesView& s : views) {
+      if (std::strcmp(s.family, v.family) != 0) continue;
+      const Histogram::Snapshot snap = s.h->snapshot();
+      uint64_t cum = 0;
+      for (size_t i = 0; i < kBucketCount; ++i) {
+        cum += snap.buckets[i];
+        char le[32];
+        if (i == kInfBucket)
+          std::snprintf(le, sizeof(le), "+Inf");
+        else
+          std::snprintf(le, sizeof(le), "%llu",
+                        static_cast<unsigned long long>(bucket_le_us(i)));
+        if (s.label_key)
+          append(std::snprintf(line, sizeof(line), "%s_bucket{%s=\"%s\",le=\"%s\"} %llu\n",
+                               s.family, s.label_key, s.label_value, le,
+                               static_cast<unsigned long long>(cum)));
+        else
+          append(std::snprintf(line, sizeof(line), "%s_bucket{le=\"%s\"} %llu\n", s.family,
+                               le, static_cast<unsigned long long>(cum)));
+      }
+      if (s.label_key) {
+        append(std::snprintf(line, sizeof(line), "%s_sum{%s=\"%s\"} %llu\n", s.family,
+                             s.label_key, s.label_value,
+                             static_cast<unsigned long long>(snap.sum_us)));
+        append(std::snprintf(line, sizeof(line), "%s_count{%s=\"%s\"} %llu\n", s.family,
+                             s.label_key, s.label_value,
+                             static_cast<unsigned long long>(snap.count)));
+      } else {
+        append(std::snprintf(line, sizeof(line), "%s_sum %llu\n", s.family,
+                             static_cast<unsigned long long>(snap.sum_us)));
+        append(std::snprintf(line, sizeof(line), "%s_count %llu\n", s.family,
+                             static_cast<unsigned long long>(snap.count)));
+      }
+    }
+  }
+  return out;
+}
+
+std::string dump_json() {
+  std::string out = "[";
+  bool first = true;
+  for_each_series([&](const SeriesView& v) {
+    const Histogram::Snapshot snap = v.h->snapshot();
+    char buf[256];
+    if (!first) out += ",";
+    first = false;
+    std::snprintf(buf, sizeof(buf),
+                  "{\"family\":\"%s\",\"label_key\":\"%s\",\"label_value\":\"%s\","
+                  "\"count\":%llu,\"sum_us\":%llu,\"p50_us\":%.1f,\"p99_us\":%.1f,"
+                  "\"buckets\":[",
+                  v.family, v.label_key ? v.label_key : "", v.label_value ? v.label_value : "",
+                  static_cast<unsigned long long>(snap.count),
+                  static_cast<unsigned long long>(snap.sum_us),
+                  Histogram::quantile_us(snap, 0.50), Histogram::quantile_us(snap, 0.99));
+    out += buf;
+    bool bfirst = true;
+    for (size_t i = 0; i < kBucketCount; ++i) {
+      if (snap.buckets[i] == 0) continue;
+      std::snprintf(buf, sizeof(buf), "%s{\"le_us\":%llu,\"n\":%llu}", bfirst ? "" : ",",
+                    static_cast<unsigned long long>(
+                        i == kInfBucket ? 0 : bucket_le_us(i)),  // 0 marks +Inf
+                    static_cast<unsigned long long>(snap.buckets[i]));
+      out += buf;
+      bfirst = false;
+    }
+    out += "]}";
+  });
+  out += "]";
+  return out;
+}
+
+}  // namespace btpu::hist

@@ -1,17 +1,157 @@
-#include "btpu/common/env.h"
 #include "btpu/common/trace.h"
 
-#include "btpu/common/thread_annotations.h"
+#include <sys/syscall.h>
+#include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <map>
 #include <mutex>
 
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
+#include "btpu/common/log.h"
+#include "btpu/common/thread_annotations.h"
+
 namespace btpu::trace {
 
 namespace {
+
+// ---- master switch + knobs -------------------------------------------------
+
+std::atomic<bool> g_enabled{true};
+std::atomic<uint64_t> g_slow_us{0};
+std::atomic<const char*> g_proc_name{"proc"};
+
+// Function-local static guard: the post-init fast path is one acquire load
+// (an atomic EXCHANGE here showed up as ~2% of a cached get — enabled() is
+// on every hot-path event).
+void init_switches() {
+  static const bool once = [] {
+    g_enabled.store(env_bool("BTPU_TRACING", true), std::memory_order_relaxed);
+    g_slow_us.store(env_u64("BTPU_TRACE_SLOW_US", 0), std::memory_order_relaxed);
+    return true;
+  }();
+  (void)once;
+}
+
+// ---- ambient context -------------------------------------------------------
+
+thread_local TraceContext t_ctx{};
+
+uint32_t cached_tid() noexcept {
+  thread_local const uint32_t tid = static_cast<uint32_t>(::syscall(SYS_gettid));
+  return tid;
+}
+
+// ---- span ring -------------------------------------------------------------
+// Seqlock-lite slots (docs/CORRECTNESS.md §9): claim index, seq=0 release,
+// relaxed payload stores, seq=index+1 release; readers acquire-load seq
+// around the payload read and discard on mismatch.
+
+struct SpanSlot {
+  std::atomic<uint64_t> seq{0};
+  std::atomic<uint64_t> trace_id{0};
+  std::atomic<uint64_t> span_id{0};
+  std::atomic<uint64_t> parent_id{0};
+  std::atomic<uint64_t> start_ns{0};
+  std::atomic<uint64_t> dur_ns{0};
+  std::atomic<const char*> name{nullptr};
+  std::atomic<uint32_t> tid{0};
+};
+
+struct SpanRing {
+  SpanSlot* slots;
+  size_t mask;
+  std::atomic<uint64_t> head{0};
+
+  SpanRing();
+
+  static SpanRing& instance() {
+    static SpanRing* r = new SpanRing;  // leaked: dumped at exit/fatal
+    return *r;
+  }
+
+  void push(const char* name, uint64_t trace, uint64_t span, uint64_t parent,
+            uint64_t start, uint64_t dur) noexcept {
+    const uint64_t i = head.fetch_add(1, std::memory_order_relaxed);
+    SpanSlot& s = slots[i & mask];
+    s.seq.store(0, std::memory_order_release);  // in flight: dumpers skip
+    s.trace_id.store(trace, std::memory_order_relaxed);
+    s.span_id.store(span, std::memory_order_relaxed);
+    s.parent_id.store(parent, std::memory_order_relaxed);
+    s.start_ns.store(start, std::memory_order_relaxed);
+    s.dur_ns.store(dur, std::memory_order_relaxed);
+    s.name.store(name, std::memory_order_relaxed);
+    s.tid.store(cached_tid(), std::memory_order_relaxed);
+    s.seq.store(i + 1, std::memory_order_release);
+  }
+};
+
+void hex_u64(char* out, uint64_t v) {  // 16 chars + NUL
+  static const char* d = "0123456789abcdef";
+  for (int i = 15; i >= 0; --i) {
+    out[i] = d[v & 0xf];
+    v >>= 4;
+  }
+  out[16] = '\0';
+}
+
+// ---- slow-op ring ----------------------------------------------------------
+
+struct SlowRing {
+  static constexpr size_t kCap = 64;
+  Mutex mutex;
+  SlowOp ops[kCap] BTPU_GUARDED_BY(mutex);
+  size_t next BTPU_GUARDED_BY(mutex){0};
+  size_t count BTPU_GUARDED_BY(mutex){0};
+
+  static SlowRing& instance() {
+    static SlowRing* r = new SlowRing;
+    return *r;
+  }
+};
+
+// ---- BTPU_TRACE_DUMP at-exit file dump -------------------------------------
+
+void dump_spans_to_file_at_exit();
+
+struct DumpRegistrar {
+  DumpRegistrar() {
+    if (env_str("BTPU_TRACE_DUMP")) std::atexit(dump_spans_to_file_at_exit);
+  }
+};
+
+// Defined after DumpRegistrar so constructing the ring (first span) also
+// arms the BTPU_TRACE_DUMP at-exit file dump.
+SpanRing::SpanRing() {
+  size_t cap = env_u64("BTPU_TRACE_RING_SPANS", 16384);
+  cap = std::max<size_t>(cap, 256);
+  size_t pow2 = 256;
+  while (pow2 < cap) pow2 <<= 1;
+  slots = new SpanSlot[pow2];
+  mask = pow2 - 1;
+  static DumpRegistrar registrar;
+  (void)registrar;
+}
+
+void dump_spans_to_file_at_exit() {
+  const char* dir = env_str("BTPU_TRACE_DUMP");
+  if (!dir) return;
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s/spans-%s-%d.jsonl", dir, process_name(),
+                static_cast<int>(::getpid()));
+  if (FILE* f = std::fopen(path, "w")) {
+    const std::string body = dump_spans_json(0);
+    std::fwrite(body.data(), 1, body.size(), f);
+    std::fclose(f);
+  }
+}
+
+// ---- aggregate layer (pre-existing) ----------------------------------------
 
 constexpr size_t kReservoir = 4096;
 
@@ -60,6 +200,233 @@ double percentile_of(std::vector<double>& sorted, double p) {
 }
 
 }  // namespace
+
+// ---- switches --------------------------------------------------------------
+
+bool enabled() noexcept {
+  init_switches();
+  return g_enabled.load(std::memory_order_relaxed);
+}
+
+void set_enabled(bool on) noexcept {
+  init_switches();
+  g_enabled.store(on, std::memory_order_relaxed);
+}
+
+uint64_t slow_threshold_us() noexcept {
+  init_switches();
+  return g_slow_us.load(std::memory_order_relaxed);
+}
+
+void set_slow_threshold_us(uint64_t us) noexcept {
+  init_switches();
+  g_slow_us.store(us, std::memory_order_relaxed);
+}
+
+void set_process_name(const char* name) noexcept {
+  g_proc_name.store(name, std::memory_order_relaxed);
+}
+
+const char* process_name() noexcept { return g_proc_name.load(std::memory_order_relaxed); }
+
+// ---- ids + clock -----------------------------------------------------------
+
+TraceContext current() noexcept { return t_ctx; }
+
+uint64_t mint_id() noexcept {
+  // xorshift128+ per thread, seeded from the monotonic clock + tid so two
+  // threads (or two processes started the same ns) diverge immediately.
+  thread_local uint64_t s0 = now_ns() ^ (static_cast<uint64_t>(cached_tid()) << 32) ^
+                             0x9e3779b97f4a7c15ull;
+  thread_local uint64_t s1 = (now_ns() << 1) ^ static_cast<uint64_t>(::getpid()) ^
+                             0xbf58476d1ce4e5b9ull;
+  uint64_t x = s0;
+  const uint64_t y = s1;
+  s0 = y;
+  x ^= x << 23;
+  s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+  const uint64_t v = s1 + y;
+  return v ? v : 0x1d;  // never 0 (0 = untraced on the wire)
+}
+
+uint64_t now_ns() noexcept {
+  return static_cast<uint64_t>(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                                   std::chrono::steady_clock::now().time_since_epoch())
+                                   .count());
+}
+
+// ---- span ring -------------------------------------------------------------
+
+uint64_t record_remote_span(const char* name, uint64_t trace_id, uint64_t parent_span,
+                            uint64_t start_ns, uint64_t end_ns) noexcept {
+  if (trace_id == 0 || !enabled()) return 0;
+  const uint64_t own = mint_id();
+  SpanRing::instance().push(name, trace_id, own, parent_span, start_ns,
+                            end_ns > start_ns ? end_ns - start_ns : 0);
+  return own;
+}
+
+uint64_t span_ring_recorded() noexcept {
+  return SpanRing::instance().head.load(std::memory_order_relaxed);
+}
+
+std::string dump_spans_json(uint64_t trace_id) {
+  SpanRing& ring = SpanRing::instance();
+  const uint64_t head = ring.head.load(std::memory_order_acquire);
+  const size_t cap = ring.mask + 1;
+  const uint64_t first = head > cap ? head - cap : 0;
+  std::string out;
+  out.reserve(4096);
+  const int pid = static_cast<int>(::getpid());
+  const char* proc = process_name();
+  char tb[17], sb[17], pb[17];
+  for (uint64_t i = first; i < head; ++i) {
+    SpanSlot& s = ring.slots[i & ring.mask];
+    const uint64_t seq = s.seq.load(std::memory_order_acquire);
+    if (seq != i + 1) continue;  // overwritten or in flight
+    const uint64_t tr = s.trace_id.load(std::memory_order_relaxed);
+    const uint64_t span = s.span_id.load(std::memory_order_relaxed);
+    const uint64_t parent = s.parent_id.load(std::memory_order_relaxed);
+    const uint64_t start = s.start_ns.load(std::memory_order_relaxed);
+    const uint64_t dur = s.dur_ns.load(std::memory_order_relaxed);
+    const char* name = s.name.load(std::memory_order_relaxed);
+    const uint32_t tid = s.tid.load(std::memory_order_relaxed);
+    if (s.seq.load(std::memory_order_acquire) != i + 1) continue;  // torn: drop
+    if (trace_id != 0 && tr != trace_id) continue;
+    if (!name) continue;
+    hex_u64(tb, tr);
+    hex_u64(sb, span);
+    hex_u64(pb, parent);
+    char line[512];
+    const int n = std::snprintf(
+        line, sizeof(line),
+        "{\"name\":\"%s\",\"trace\":\"%s\",\"span\":\"%s\",\"parent\":\"%s\","
+        "\"start_us\":%.3f,\"dur_us\":%.3f,\"pid\":%d,\"tid\":%u,\"proc\":\"%s\"}\n",
+        name, tb, sb, pb, static_cast<double>(start) / 1000.0,
+        static_cast<double>(dur) / 1000.0, pid, tid, proc);
+    if (n > 0) out.append(line, std::min<size_t>(static_cast<size_t>(n), sizeof(line) - 1));
+  }
+  return out;
+}
+
+// ---- slow-op surfacing -----------------------------------------------------
+
+std::vector<SlowOp> recent_slow_ops() {
+  SlowRing& r = SlowRing::instance();
+  MutexLock lock(r.mutex);
+  std::vector<SlowOp> out;
+  const size_t n = std::min(r.count, SlowRing::kCap);
+  out.reserve(n);
+  // Oldest first.
+  const size_t start = r.count > SlowRing::kCap ? r.next : 0;
+  for (size_t i = 0; i < n; ++i) out.push_back(r.ops[(start + i) % SlowRing::kCap]);
+  return out;
+}
+
+namespace {
+
+void note_slow_op(const char* op, uint64_t trace_id, uint64_t dur_us) {
+  {
+    SlowRing& r = SlowRing::instance();
+    MutexLock lock(r.mutex);
+    r.ops[r.next] = {op, trace_id, dur_us};
+    r.next = (r.next + 1) % SlowRing::kCap;
+    ++r.count;
+  }
+  char tb[17];
+  hex_u64(tb, trace_id);
+  LOG_WARN << "slow op " << op << ": " << dur_us << "us, trace_id=" << tb
+           << " (stitch with: bb-trace --trace " << tb << ")";
+}
+
+// 1/N sampling (BTPU_TRACE_SAMPLE, 0 = off): per-thread countdown.
+bool sample_hit() noexcept {
+  static const uint64_t n = env_u64("BTPU_TRACE_SAMPLE", 0);
+  if (n == 0) return false;
+  thread_local uint64_t left = n;
+  if (--left > 0) return false;
+  left = n;
+  return true;
+}
+
+}  // namespace
+
+// ---- OpScope ---------------------------------------------------------------
+
+OpScope::OpScope(const char* op) noexcept : op_(op) {
+  // Nested public entries (put() -> put_many()) are inert: the outer scope
+  // owns the histogram sample and the root span, while TRACE_SPANs inside
+  // still record child spans under the outer context. This keeps
+  // btpu_op_duration_us{op=...} the distribution of the entry the CALLER
+  // invoked, not an echo per internal layer.
+  if (!enabled() || t_ctx.trace_id != 0) return;
+  active_ = true;
+  root_ = true;
+  start_ns_ = now_ns();
+  saved_ = t_ctx;
+  ctx_.trace_id = mint_id();
+  ctx_.span_id = mint_id();
+  t_ctx = ctx_;
+  flight::record_at(start_ns_, flight::Ev::kOpStart, 0, 0, ctx_.trace_id);
+}
+
+OpScope::~OpScope() {
+  if (!active_) return;
+  const uint64_t end = now_ns();
+  const uint64_t dur_us = (end - start_ns_) / 1000;
+  hist::op(op_).record_us(dur_us);
+  flight::record_at(end, flight::Ev::kOpEnd, dur_us, 0, ctx_.trace_id);
+  // The root span: everything this op did, in this process.
+  SpanRing::instance().push(op_, ctx_.trace_id, ctx_.span_id, 0, start_ns_,
+                            end - start_ns_);
+  const uint64_t slow = slow_threshold_us();
+  if (slow > 0 && dur_us >= slow) {
+    flight::record_at(end, flight::Ev::kSlowOp, dur_us, 0, ctx_.trace_id);
+    note_slow_op(op_, ctx_.trace_id, dur_us);
+  }
+  if (sample_hit()) {
+    flight::record_at(end, flight::Ev::kSampled, dur_us, 0, ctx_.trace_id);
+    char tb[17];
+    hex_u64(tb, ctx_.trace_id);
+    LOG_INFO << "sampled op " << op_ << ": " << dur_us << "us, trace_id=" << tb;
+  }
+  t_ctx = saved_;
+}
+
+// ---- RemoteScope -----------------------------------------------------------
+
+RemoteScope::RemoteScope(uint64_t trace_id, uint64_t span_id) noexcept {
+  if (trace_id == 0 || !enabled()) return;
+  active_ = true;
+  saved_ = t_ctx;
+  t_ctx = {trace_id, span_id};
+}
+
+RemoteScope::~RemoteScope() {
+  if (active_) t_ctx = saved_;
+}
+
+// ---- Span ------------------------------------------------------------------
+
+Span::Span(const char* name) noexcept : name_(name), start_ns_(now_ns()) {
+  if (t_ctx.trace_id != 0 && enabled()) {
+    parent_span_ = t_ctx.span_id;
+    own_span_ = mint_id();
+    t_ctx.span_id = own_span_;
+  }
+}
+
+Span::~Span() {
+  const uint64_t end = now_ns();
+  record(name_, static_cast<double>(end - start_ns_) / 1000.0);
+  if (own_span_ != 0) {
+    SpanRing::instance().push(name_, t_ctx.trace_id, own_span_, parent_span_, start_ns_,
+                              end - start_ns_);
+    t_ctx.span_id = parent_span_;
+  }
+}
+
+// ---- aggregate layer -------------------------------------------------------
 
 void record(std::string_view name, double duration_us) {
   auto& reg = Registry::instance();

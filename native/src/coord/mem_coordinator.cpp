@@ -10,7 +10,10 @@
 
 #include "btpu/common/crashpoint.h"
 #include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/coord/wal_format.h"
 #include "btpu/net/net.h"
@@ -208,6 +211,7 @@ void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
   wal_chain_ = header.chain_crc;
   ++wal_appended_;
   wal_end_ = start + static_cast<off_t>(sizeof(header)) + static_cast<off_t>(record.size());
+  flight::record(flight::Ev::kWalAppend, record.size());
   crashpoint::hit("wal.after_append");
   if (durability_.fsync) {
     if (group_commit_) {
@@ -220,6 +224,7 @@ void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
     } else {
       // Sync-per-record mode (group_commit_us == 0).
       crashpoint::hit("wal.before_sync");
+      const uint64_t sync_t0 = trace::now_ns();
       if (::fdatasync(wal_fd_) != 0) {
         // A failed sync may have dropped dirty pages AND cleared the error
         // flag (Linux fsync semantics): the record's durability is
@@ -239,6 +244,9 @@ void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
         return;
       }
       wal_syncs_.fetch_add(1, std::memory_order_relaxed);
+      const uint64_t sync_us = (trace::now_ns() - sync_t0) / 1000;
+      hist::wal_sync().record_us(sync_us);
+      flight::record(flight::Ev::kWalSync, sync_us, /*records covered*/ 1);
       crashpoint::hit("wal.after_sync");
     }
   }
@@ -274,8 +282,15 @@ bool MemCoordinator::wait_durable(uint64_t seq) {
       fd = sync_fd_;
     }
     crashpoint::hit("wal.before_sync");
+    const uint64_t sync_t0 = trace::now_ns();
     const bool synced = fd >= 0 && ::fdatasync(fd) == 0;
-    if (synced) wal_syncs_.fetch_add(1, std::memory_order_relaxed);
+    if (synced) {
+      wal_syncs_.fetch_add(1, std::memory_order_relaxed);
+      const uint64_t sync_us = (trace::now_ns() - sync_t0) / 1000;
+      hist::wal_sync().record_us(sync_us);
+      // a1 = records this leader's sync covered (the group-commit batch).
+      flight::record(flight::Ev::kWalSync, sync_us, target - seq + 1);
+    }
     crashpoint::hit("wal.after_sync");
     if (!synced) {
       // Same fsync-failure stance as the inline path: durability of the

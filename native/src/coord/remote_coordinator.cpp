@@ -3,6 +3,7 @@
 #include "btpu/common/env.h"
 #include "btpu/common/deadline.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/coord/coord_proto.h"
 
@@ -209,6 +210,9 @@ static ErrorCode peek_status(const std::vector<uint8_t>& resp) {
 
 ErrorCode RemoteCoordinator::call(uint8_t opcode, const std::vector<uint8_t>& req,
                                   std::vector<uint8_t>& resp, bool* retried) {
+  // Under a traced keystone RPC this shows up as a child span — the
+  // "keystone waited on the coordinator" slice of a slow mutation.
+  TRACE_SPAN("keystone.coord_call");
   if (retried) *retried = false;
   // The generation of the connection each attempt ran on: a NOT_LEADER
   // answer only justifies rotating away from THAT connection (another

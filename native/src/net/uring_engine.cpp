@@ -43,7 +43,10 @@
 
 #include "btpu/common/deadline.h"
 #include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/transport/data_wire.h"
 
 namespace btpu::transport {
@@ -425,6 +428,12 @@ struct Conn {
   bool zc_send_out{false};
   uint32_t zc_notif_pending{0};
 
+  // Observability: op service window (header decoded -> response fully
+  // sent) and the response-send window (first send submit -> final send
+  // completion). Loop-owned like every other Conn field.
+  uint64_t op_start_ns{0};
+  uint64_t send_start_ns{0};
+
   // Lifecycle.
   bool sqe_out{false};
   bool exec_out{false};
@@ -576,6 +585,7 @@ class UringLoop {
 
   void arm_send(Conn* c) {
     tsan_fd_release(c->fd);  // no-op outside TSan builds (see file header)
+    if (c->send_start_ns == 0) c->send_start_ns = trace::now_ns();
     const uint64_t head_left = c->resp_done < 4 ? 4 - c->resp_done : 0;
     const uint64_t pay_sent = c->resp_done > 4 ? c->resp_done - 4 : 0;
     const uint64_t pay_left = c->resp_payload ? c->resp_len - pay_sent : 0;
@@ -649,6 +659,8 @@ class UringLoop {
   void start_header(Conn* c) {
     c->ctl_have = 0;
     c->ctl_need = sizeof(DataRequestHeader);
+    c->op_start_ns = 0;
+    c->send_start_ns = 0;
     c->fabric_addr_extended = false;
     c->valid = false;
     c->target = nullptr;
@@ -702,6 +714,9 @@ class UringLoop {
       default:
         break;
     }
+    c->op_start_ns = trace::now_ns();
+    flight::record_at(c->op_start_ns, flight::Ev::kUringSubmit, c->hdr.op, c->hdr.len,
+                      c->hdr.trace_id);
     if (trailer == 0) {
       dispatch(c);
       return;
@@ -858,11 +873,15 @@ class UringLoop {
 
   void shed(Conn* c) {
     robust_counters().shed.fetch_add(1, std::memory_order_relaxed);
+    flight::record_at(trace::now_ns(), flight::Ev::kShed, /*a0=data plane*/ 2, 0,
+                      c->hdr.trace_id);
     rejected(c, code(ErrorCode::RETRY_LATER));
   }
 
   void expire(Conn* c) {
     robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+    flight::record_at(trace::now_ns(), flight::Ev::kDeadlineExceeded, /*a0=server*/ 1, 0,
+                      c->hdr.trace_id);
     rejected(c, code(ErrorCode::DEADLINE_EXCEEDED));
   }
 
@@ -1146,8 +1165,28 @@ class UringLoop {
       if (counters_.pool_direct_ops) counters_.pool_direct_ops->add();
       if (counters_.pool_direct_bytes) counters_.pool_direct_bytes->add(c->resp_len);
     }
+    observe_op_complete(c);
     release_ticket(c);
     start_header(c);
+  }
+
+  // Op fully answered: histogram samples always, span + flight completion
+  // stamped with the header's trace id (ops interleave on one loop thread,
+  // so there is no ambient context here — record_remote_span is the
+  // explicit-ids path).
+  void observe_op_complete(Conn* c) {
+    if (c->op_start_ns == 0) return;
+    const uint64_t t1 = trace::now_ns();
+    hist::data_op(data_op_hist_name(c->hdr.op)).record_us((t1 - c->op_start_ns) / 1000);
+    if (c->send_start_ns != 0 && t1 > c->send_start_ns)
+      hist::uring_send().record_us((t1 - c->send_start_ns) / 1000);
+    if (c->hdr.trace_id != 0)
+      trace::record_remote_span(data_op_span_name(c->hdr.op), c->hdr.trace_id,
+                                c->hdr.span_id, c->op_start_ns, t1);
+    flight::record_at(t1, flight::Ev::kUringComplete, c->hdr.op, c->status,
+                      c->hdr.trace_id);
+    c->op_start_ns = 0;
+    c->send_start_ns = 0;
   }
 
   void release_ticket(Conn* c) {

@@ -6,6 +6,8 @@
 #include <sstream>
 
 #include "btpu/cache/object_cache.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
 #include "btpu/common/trace.h"
 #include "btpu/keystone/keystone.h"
@@ -13,7 +15,7 @@
 
 namespace btpu::rpc {
 
-MetricsHttpServer::MetricsHttpServer(keystone::KeystoneService& service, std::string host,
+MetricsHttpServer::MetricsHttpServer(keystone::KeystoneService* service, std::string host,
                                      uint16_t port)
     : service_(service), host_(std::move(host)), port_(port) {}
 
@@ -39,7 +41,6 @@ void MetricsHttpServer::stop() {
 
 std::string MetricsHttpServer::render_metrics() const {
   std::ostringstream out;
-  const auto& c = service_.counters();
   auto counter = [&](const char* name, const char* help, uint64_t value) {
     out << "# HELP " << name << " " << help << "\n# TYPE " << name << " counter\n"
         << name << " " << value << "\n";
@@ -50,55 +51,57 @@ std::string MetricsHttpServer::render_metrics() const {
         << name << labels << " " << value << "\n";
   };
 
-  counter("btpu_put_starts_total", "put_start calls", c.put_starts.load());
-  counter("btpu_put_completes_total", "put_complete calls", c.put_completes.load());
-  counter("btpu_put_cancels_total", "put_cancel calls", c.put_cancels.load());
-  counter("btpu_put_slots_granted_total", "pooled put slots granted (put_start_pooled)",
-          c.slots_granted.load());
-  counter("btpu_put_slot_commits_total", "puts committed through a pooled slot (1-RTT path)",
-          c.slot_commits.load());
-  counter("btpu_inline_puts_total", "puts absorbed by the keystone inline tier (1-RTT, no data plane)",
-          c.inline_puts.load());
-  gauge("btpu_inline_bytes", "bytes resident in the keystone inline tier",
-        static_cast<double>(service_.inline_bytes_resident()));
-  gauge("btpu_persist_retry_backlog",
-        "objects whose durable record write is deferred and retrying (acked vs durable "
-        "state diverged; alert when sustained nonzero)",
-        static_cast<double>(service_.persist_retry_backlog()));
-  counter("btpu_fabric_moves_total",
-          "cross-process device moves over the device fabric (vs host lane)",
-          c.fabric_moves.load());
-  counter("btpu_pvm_ops_total",
-          "data-plane ops THIS process completed over the same-host one-sided "
-          "PVM lane (keystone-side: repair/demotion/drain byte moves)",
-          static_cast<uint64_t>(transport::pvm_op_count()));
-  counter("btpu_objects_offline_total",
-          "objects spared from loss: bytes persist on a dead worker's file-backed pools",
-          c.objects_offline.load());
-  counter("btpu_objects_adopted_total",
-          "offline objects re-validated and refreshed after a worker restart",
-          c.objects_adopted.load());
-  counter("btpu_gets_total", "get_workers calls", c.gets.load());
-  counter("btpu_removes_total", "remove_object calls", c.removes.load());
-  counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());
-  counter("btpu_pending_reclaimed_total", "abandoned mid-put reservations reclaimed",
-          c.pending_reclaimed.load());
-  counter("btpu_evicted_total", "objects evicted for watermark pressure", c.evicted.load());
-  counter("btpu_objects_demoted_total", "objects moved down the tier ladder under pressure",
-          c.objects_demoted.load());
-  counter("btpu_workers_lost_total", "workers declared dead", c.workers_lost.load());
-  counter("btpu_objects_repaired_total", "objects re-replicated after worker death",
-          c.objects_repaired.load());
-  counter("btpu_objects_lost_total", "objects lost with their last replica",
-          c.objects_lost.load());
-  counter("btpu_shards_drained_total", "shards migrated by graceful worker drains",
-          c.shards_drained.load());
-  counter("btpu_scrub_checked_total", "objects verified by the background scrub",
-          c.scrub_checked.load());
-  counter("btpu_scrub_corrupt_total", "corrupt shards found by the background scrub",
-          c.scrub_corrupt.load());
-  counter("btpu_scrub_healed_total", "corrupt shards restored by the background scrub",
-          c.scrub_healed.load());
+  // ---- keystone control-plane sections (absent on worker/coord obs) ----
+  if (service_) {
+    auto& service = *service_;
+    const auto& c = service.counters();
+    counter("btpu_put_starts_total", "put_start calls", c.put_starts.load());
+    counter("btpu_put_completes_total", "put_complete calls", c.put_completes.load());
+    counter("btpu_put_cancels_total", "put_cancel calls", c.put_cancels.load());
+    counter("btpu_put_slots_granted_total", "pooled put slots granted (put_start_pooled)",
+            c.slots_granted.load());
+    counter("btpu_put_slot_commits_total",
+            "puts committed through a pooled slot (1-RTT path)", c.slot_commits.load());
+    counter("btpu_inline_puts_total",
+            "puts absorbed by the keystone inline tier (1-RTT, no data plane)",
+            c.inline_puts.load());
+    gauge("btpu_inline_bytes", "bytes resident in the keystone inline tier",
+          static_cast<double>(service.inline_bytes_resident()));
+    gauge("btpu_persist_retry_backlog",
+          "objects whose durable record write is deferred and retrying (acked vs durable "
+          "state diverged; alert when sustained nonzero)",
+          static_cast<double>(service.persist_retry_backlog()));
+    counter("btpu_fabric_moves_total",
+            "cross-process device moves over the device fabric (vs host lane)",
+            c.fabric_moves.load());
+    counter("btpu_objects_offline_total",
+            "objects spared from loss: bytes persist on a dead worker's file-backed pools",
+            c.objects_offline.load());
+    counter("btpu_objects_adopted_total",
+            "offline objects re-validated and refreshed after a worker restart",
+            c.objects_adopted.load());
+    counter("btpu_gets_total", "get_workers calls", c.gets.load());
+    counter("btpu_removes_total", "remove_object calls", c.removes.load());
+    counter("btpu_gc_collected_total", "objects collected by ttl gc", c.gc_collected.load());
+    counter("btpu_pending_reclaimed_total", "abandoned mid-put reservations reclaimed",
+            c.pending_reclaimed.load());
+    counter("btpu_evicted_total", "objects evicted for watermark pressure", c.evicted.load());
+    counter("btpu_objects_demoted_total",
+            "objects moved down the tier ladder under pressure", c.objects_demoted.load());
+    counter("btpu_workers_lost_total", "workers declared dead", c.workers_lost.load());
+    counter("btpu_objects_repaired_total", "objects re-replicated after worker death",
+            c.objects_repaired.load());
+    counter("btpu_objects_lost_total", "objects lost with their last replica",
+            c.objects_lost.load());
+    counter("btpu_shards_drained_total", "shards migrated by graceful worker drains",
+            c.shards_drained.load());
+    counter("btpu_scrub_checked_total", "objects verified by the background scrub",
+            c.scrub_checked.load());
+    counter("btpu_scrub_corrupt_total", "corrupt shards found by the background scrub",
+            c.scrub_corrupt.load());
+    counter("btpu_scrub_healed_total", "corrupt shards restored by the background scrub",
+            c.scrub_healed.load());
+  }
   // Client object cache (btpu/cache): process-global, so embedded clients
   // sharing this process surface their hit/invalidation behavior here; a
   // standalone keystone naturally reports zeros.
@@ -113,6 +116,10 @@ std::string MetricsHttpServer::render_metrics() const {
   counter("btpu_cache_stale_rejects_total",
           "object-cache hits rejected because the object version moved",
           cache::cache_stale_reject_count());
+  counter("btpu_pvm_ops_total",
+          "data-plane ops THIS process completed over the same-host one-sided "
+          "PVM lane (keystone-side: repair/demotion/drain byte moves)",
+          static_cast<uint64_t>(transport::pvm_op_count()));
   // Data-plane stream lane + serve-engine shape (uring_engine.h): alert
   // guidance in docs/OPERATIONS.md — btpu_uring_loops dropping to 0 on a
   // box that normally runs the engine means every data server fell back to
@@ -175,55 +182,57 @@ std::string MetricsHttpServer::render_metrics() const {
             "replica candidates deprioritized because their breaker was open",
             r.breaker_skips.load());
   }
+  // Flight recorder + span ring health (the dumps live at /debug/flight
+  // and /debug/trace; these gauges say whether anything is flowing).
+  counter("btpu_flight_events_total", "flight-recorder events recorded in this process",
+          flight::recorder().recorded());
+  counter("btpu_trace_spans_total", "trace spans recorded into this process's span ring",
+          trace::span_ring_recorded());
 
-  auto stats = service_.get_cluster_stats();
-  if (stats.ok()) {
-    const auto& s = stats.value();
-    gauge("btpu_workers", "registered workers", static_cast<double>(s.total_workers));
-    gauge("btpu_memory_pools", "registered memory pools",
-          static_cast<double>(s.total_memory_pools));
-    gauge("btpu_objects", "stored objects", static_cast<double>(s.total_objects));
-    gauge("btpu_capacity_bytes", "total cluster capacity",
-          static_cast<double>(s.total_capacity));
-    gauge("btpu_used_bytes", "allocated bytes", static_cast<double>(s.used_capacity));
-    gauge("btpu_utilization", "used/capacity", s.avg_utilization);
-  }
-  // Per-tier breakdown: the same utilizations tier-aware eviction keys off
-  // (evict_for_pressure), so dashboards and the health loop agree.
-  {
-    std::map<StorageClass, uint64_t> cap_per_class;
-    for (const auto& [id, pool] : service_.memory_pools())
-      cap_per_class[pool.storage_class] += pool.size;
-    const auto alloc_stats = service_.allocator_stats();
-    out << "# HELP btpu_tier_capacity_bytes capacity by storage class\n"
-           "# TYPE btpu_tier_capacity_bytes gauge\n";
-    for (const auto& [cls, cap] : cap_per_class)
-      out << "btpu_tier_capacity_bytes{class=\"" << storage_class_name(cls) << "\"} " << cap
-          << "\n";
-    out << "# HELP btpu_tier_used_bytes allocated bytes by storage class\n"
-           "# TYPE btpu_tier_used_bytes gauge\n";
-    for (const auto& [cls, cap] : cap_per_class) {
-      auto it = alloc_stats.allocated_per_class.find(cls);
-      out << "btpu_tier_used_bytes{class=\"" << storage_class_name(cls) << "\"} "
-          << (it == alloc_stats.allocated_per_class.end() ? 0 : it->second) << "\n";
+  if (service_) {
+    auto& service = *service_;
+    auto stats = service.get_cluster_stats();
+    if (stats.ok()) {
+      const auto& s = stats.value();
+      gauge("btpu_workers", "registered workers", static_cast<double>(s.total_workers));
+      gauge("btpu_memory_pools", "registered memory pools",
+            static_cast<double>(s.total_memory_pools));
+      gauge("btpu_objects", "stored objects", static_cast<double>(s.total_objects));
+      gauge("btpu_capacity_bytes", "total cluster capacity",
+            static_cast<double>(s.total_capacity));
+      gauge("btpu_used_bytes", "allocated bytes", static_cast<double>(s.used_capacity));
+      gauge("btpu_utilization", "used/capacity", s.avg_utilization);
     }
+    // Per-tier breakdown: the same utilizations tier-aware eviction keys off
+    // (evict_for_pressure), so dashboards and the health loop agree.
+    {
+      std::map<StorageClass, uint64_t> cap_per_class;
+      for (const auto& [id, pool] : service.memory_pools())
+        cap_per_class[pool.storage_class] += pool.size;
+      const auto alloc_stats = service.allocator_stats();
+      out << "# HELP btpu_tier_capacity_bytes capacity by storage class\n"
+             "# TYPE btpu_tier_capacity_bytes gauge\n";
+      for (const auto& [cls, cap] : cap_per_class)
+        out << "btpu_tier_capacity_bytes{class=\"" << storage_class_name(cls) << "\"} "
+            << cap << "\n";
+      out << "# HELP btpu_tier_used_bytes allocated bytes by storage class\n"
+             "# TYPE btpu_tier_used_bytes gauge\n";
+      for (const auto& [cls, cap] : cap_per_class) {
+        auto it = alloc_stats.allocated_per_class.find(cls);
+        out << "btpu_tier_used_bytes{class=\"" << storage_class_name(cls) << "\"} "
+            << (it == alloc_stats.allocated_per_class.end() ? 0 : it->second) << "\n";
+      }
+    }
+    gauge("btpu_view_version", "placement view version",
+          static_cast<double>(service.get_view_version()));
+    gauge("btpu_keystone_leader", "1 when this keystone holds leadership",
+          service.is_leader() ? 1.0 : 0.0);
   }
-  gauge("btpu_view_version", "placement view version",
-        static_cast<double>(service_.get_view_version()));
-  gauge("btpu_keystone_leader", "1 when this keystone holds leadership",
-        service_.is_leader() ? 1.0 : 0.0);
 
-  // Span latency aggregates (count + p50/p99 over recent samples).
-  out << "# HELP btpu_span_p50_us span p50 latency (us)\n# TYPE btpu_span_p50_us gauge\n";
-  auto spans = trace::summary();
-  for (const auto& s : spans)
-    out << "btpu_span_p50_us{span=\"" << s.name << "\"} " << s.p50_us << "\n";
-  out << "# HELP btpu_span_p99_us span p99 latency (us)\n# TYPE btpu_span_p99_us gauge\n";
-  for (const auto& s : spans)
-    out << "btpu_span_p99_us{span=\"" << s.name << "\"} " << s.p99_us << "\n";
-  out << "# HELP btpu_span_count_total span samples\n# TYPE btpu_span_count_total counter\n";
-  for (const auto& s : spans)
-    out << "btpu_span_count_total{span=\"" << s.name << "\"} " << s.count << "\n";
+  // Real latency histograms (btpu/common/histogram.h): the reservoir
+  // btpu_span_{p50,p99}_us gauges this replaced could not be aggregated
+  // across processes or windowed by a scraper; cumulative buckets can.
+  out << hist::render_prometheus();
   return out.str();
 }
 
@@ -241,18 +250,37 @@ void MetricsHttpServer::accept_loop() {
       request.append(buf, static_cast<size_t>(n));
       if (request.size() > 64 * 1024) break;
     }
-    std::string path;
+    std::string target;
     {
       auto sp1 = request.find(' ');
       auto sp2 = request.find(' ', sp1 + 1);
       if (sp1 != std::string::npos && sp2 != std::string::npos)
-        path = request.substr(sp1 + 1, sp2 - sp1 - 1);
+        target = request.substr(sp1 + 1, sp2 - sp1 - 1);
+    }
+    std::string path = target, query;
+    if (auto q = target.find('?'); q != std::string::npos) {
+      path = target.substr(0, q);
+      query = target.substr(q + 1);
     }
     std::string body, status = "200 OK", content_type = "text/plain; version=0.0.4";
     if (path == "/metrics") {
       body = render_metrics();
     } else if (path == "/healthz") {
       body = "ok\n";
+    } else if (path == "/debug/flight") {
+      // Flight-recorder dump: what this process was doing, most recent
+      // events last (docs/OPERATIONS.md flight-dump runbook).
+      content_type = "application/x-ndjson";
+      body = flight::recorder().dump_json();
+    } else if (path == "/debug/trace") {
+      // Span-ring dump; ?trace=<16-hex> narrows to one trace id. This is
+      // the endpoint bb-trace collects from on every process of a cluster.
+      content_type = "application/x-ndjson";
+      uint64_t want = 0;
+      if (auto at = query.find("trace="); at != std::string::npos) {
+        want = std::strtoull(query.c_str() + at + 6, nullptr, 16);
+      }
+      body = trace::dump_spans_json(want);
     } else {
       status = "404 Not Found";
       body = "not found\n";

@@ -3,7 +3,9 @@
 #include <algorithm>
 #include <thread>
 
+#include "btpu/common/flight_recorder.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/rpc/rpc.h"
 
@@ -56,8 +58,17 @@ ErrorCode KeystoneRpcClient::ensure_connected_locked(const Deadline& deadline) {
 ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>& req,
                                       std::vector<uint8_t>& resp) {
   const Deadline deadline = current_op_deadline();
+  // The RPC round trip as a span under the caller's op (the keystone-side
+  // dispatch span stitches under it by the propagated ids), plus the wire
+  // context snapshot — read ONCE here on the calling thread (retry attempts
+  // reuse it; backoff sleeps must not re-read another op's context).
+  TRACE_SPAN("client.rpc");
+  const trace::TraceContext tctx =
+      trace::enabled() ? trace::current() : trace::TraceContext{};
+  flight::record(flight::Ev::kRpcStart, opcode);
   if (deadline.expired()) {
     robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+    flight::record(flight::Ev::kDeadlineExceeded, /*a0=client*/ 0);
     return ErrorCode::DEADLINE_EXCEEDED;
   }
   MutexLock lock(mutex_);
@@ -92,10 +103,12 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
       // by the caller's remaining deadline.
       if (deadline.expired()) {
         robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+        flight::record(flight::Ev::kDeadlineExceeded, /*a0=client*/ 0);
         return ErrorCode::DEADLINE_EXCEEDED;
       }
       if (!retry_budget_.try_spend()) {
         robust_counters().retry_budget_exhausted.fetch_add(1, std::memory_order_relaxed);
+        flight::record(flight::Ev::kRetryBudgetOut);
         break;
       }
       uint64_t wait_ms = retry_policy_.backoff_ms(attempt - 1);
@@ -114,6 +127,7 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
         lock.lock();
       }
       robust_counters().retries.fetch_add(1, std::memory_order_relaxed);
+      flight::record(flight::Ev::kRetry, attempt);
     }
     if (auto cec = ensure_connected_locked(deadline); cec != ErrorCode::OK) {
       last = cec == ErrorCode::DEADLINE_EXCEEDED ? cec : ErrorCode::CONNECTION_FAILED;
@@ -122,13 +136,20 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
     }
     const std::vector<uint8_t>* framed = &req;
     std::vector<uint8_t> with_trailer;
-    if (!deadline.is_infinite()) {
-      if (deadline.expired()) {
+    if (!deadline.is_infinite() || tctx.trace_id != 0) {
+      if (!deadline.is_infinite() && deadline.expired()) {
         robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+        flight::record(flight::Ev::kDeadlineExceeded, /*a0=client*/ 0);
         return ErrorCode::DEADLINE_EXCEEDED;
       }
       with_trailer = req;
-      append_deadline_trailer(with_trailer, deadline.wire_budget_ms());
+      // Order is the v4<->v5 compat contract (rpc.h): trace INSIDE,
+      // deadline OUTERMOST so a pre-v5 server still finds its magic at the
+      // payload tail.
+      if (tctx.trace_id != 0)
+        append_trace_trailer(with_trailer, tctx.trace_id, tctx.span_id);
+      if (!deadline.is_infinite())
+        append_deadline_trailer(with_trailer, deadline.wire_budget_ms());
       framed = &with_trailer;
     }
     if (net::send_frame(sock_.fd(), opcode, framed->data(), framed->size()) !=

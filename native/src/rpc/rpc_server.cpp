@@ -4,7 +4,10 @@
 #include <thread>
 
 #include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/common/wire.h"
 #include "btpu/rpc/rpc.h"
 
@@ -122,10 +125,20 @@ void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
     const bool has_deadline = strip_deadline_trailer(payload, budget_ms);
     const Deadline deadline =
         has_deadline ? Deadline::from_wire(budget_ms) : Deadline::infinite();
+    // Trace propagation (protocol v5): deadline trailer is OUTERMOST, so
+    // the trace trailer — when present — is now at the payload tail.
+    uint64_t trace_id = 0, parent_span = 0;
+    const bool traced = strip_trace_trailer(payload, trace_id, parent_span);
     auto reject = [&](ErrorCode code, uint32_t hint_ms) {
       auto& counter = code == ErrorCode::RETRY_LATER ? robust_counters().shed
                                                      : robust_counters().deadline_exceeded;
       counter.fetch_add(1, std::memory_order_relaxed);
+      flight::record_at(trace::now_ns(),
+                        code == ErrorCode::RETRY_LATER ? flight::Ev::kShed
+                                                       : flight::Ev::kDeadlineExceeded,
+                        code == ErrorCode::RETRY_LATER ? /*a0=rpc plane*/ 1
+                                                       : /*a0=server*/ 1,
+                        0, trace_id);
       const auto resp = encode_control_error(code, hint_ms);
       return net::send_frame(fd, kControlErrorOpcode, resp.data(), resp.size()) ==
              ErrorCode::OK;
@@ -134,6 +147,23 @@ void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
       if (!reject(ErrorCode::DEADLINE_EXCEEDED, 0)) break;
       continue;
     }
+    // Dispatch under the adopted trace context: the method span parents
+    // every TRACE_SPAN the keystone opens beneath it, and the method
+    // histogram is the real service-time distribution (admission wait
+    // excluded — that story is the shed/deadline counters').
+    auto serve_dispatch = [&](uint8_t op, const std::vector<uint8_t>& pl) {
+      const uint64_t t0 = trace::now_ns();
+      std::vector<uint8_t> response;
+      {
+        trace::RemoteScope remote(traced ? trace_id : 0, parent_span);
+        trace::Span span(method_span_name(op));
+        response = dispatch(op, pl);
+      }
+      const uint64_t dur_us = (trace::now_ns() - t0) / 1000;
+      hist::rpc_method(method_name(op)).record_us(dur_us);
+      flight::record_at(t0 + dur_us * 1000, flight::Ev::kRpcEnd, op, dur_us, trace_id);
+      return response;
+    };
     if (!is_control_op(opcode)) {
       // Bounded admission: wait LIFO-shedded, within the caller's budget.
       AdmissionTicket ticket(*gate_, deadline);
@@ -149,7 +179,7 @@ void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
       }
       if (test_delay_ms_ > 0)
         std::this_thread::sleep_for(std::chrono::milliseconds(test_delay_ms_));
-      auto response = dispatch(opcode, payload);
+      auto response = serve_dispatch(opcode, payload);
       if (deadline.expired() && is_read_only_op(opcode)) {
         // Mid-service expiry on a read: the answer outlived its asker —
         // report DEADLINE_EXCEEDED instead (mutations ship their real
@@ -161,7 +191,7 @@ void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
         break;
       continue;
     }
-    auto response = dispatch(opcode, payload);
+    auto response = serve_dispatch(opcode, payload);
     if (net::send_frame(fd, opcode, response.data(), response.size()) != ErrorCode::OK) break;
   }
 }

@@ -34,8 +34,11 @@
 #include "btpu/common/admission.h"
 #include "btpu/common/crc32c.h"
 #include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
 #include "btpu/common/stripe_counter.h"
+#include "btpu/common/trace.h"
 #include "btpu/net/net.h"
 #include "btpu/transport/data_wire.h"
 #include "btpu/transport/transport.h"
@@ -328,19 +331,53 @@ class TcpTransportServer : public TransportServer {
     } staging_guard{stg_base, stg_len};
     // Overload/deadline rejection codes share the status channel; the
     // counters make sheds visible on the robustness scoreboard.
-    auto rejection = [](const AdmissionTicket& ticket) -> uint32_t {
+    // Rejection flight events carry the REQUEST's trace id explicitly
+    // (record_at): serving threads never install an ambient context, and a
+    // shed op whose trace cannot see WHY it failed defeats the stitching
+    // (the uring engine's shed()/expire() stamp the same way).
+    auto rejection = [&hdr](const AdmissionTicket& ticket) -> uint32_t {
       if (ticket.verdict() == AdmissionGate::Verdict::kShed) {
         robust_counters().shed.fetch_add(1, std::memory_order_relaxed);
+        flight::record_at(trace::now_ns(), flight::Ev::kShed, /*a0=data plane*/ 2, 0,
+                          hdr.trace_id);
         return static_cast<uint32_t>(ErrorCode::RETRY_LATER);
       }
       robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      flight::record_at(trace::now_ns(), flight::Ev::kDeadlineExceeded, /*a0=server*/ 1,
+                        0, hdr.trace_id);
       return static_cast<uint32_t>(ErrorCode::DEADLINE_EXCEEDED);
     };
-    auto expired_status = []() -> uint32_t {
+    auto expired_status = [&hdr]() -> uint32_t {
       robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      flight::record_at(trace::now_ns(), flight::Ev::kDeadlineExceeded, /*a0=server*/ 1,
+                        0, hdr.trace_id);
       return static_cast<uint32_t>(ErrorCode::DEADLINE_EXCEEDED);
     };
+    // Per-request observability: histogram sample always, span record when
+    // the request carries a trace id (see data_wire.h field notes). RAII so
+    // every continue/break path in the op dispatch below closes the op.
+    struct ServedOp {
+      const DataRequestHeader& hdr;
+      uint64_t t0{0};
+      explicit ServedOp(const DataRequestHeader& h) : hdr(h) {}
+      void open() { t0 = trace::now_ns(); }
+      void close() {
+        if (t0 == 0) return;
+        const uint64_t t1 = trace::now_ns();
+        hist::data_op(data_op_hist_name(hdr.op)).record_us((t1 - t0) / 1000);
+        if (hdr.trace_id != 0) {
+          trace::record_remote_span(data_op_span_name(hdr.op), hdr.trace_id, hdr.span_id,
+                                    t0, t1);
+          flight::record_at(t1, flight::Ev::kDataOp, hdr.op, (t1 - t0) / 1000,
+                            hdr.trace_id);
+        }
+        t0 = 0;
+      }
+    } served{hdr};
     while (running_) {
+      // Close the PREVIOUS op before blocking on the next header: the
+      // measured window is decode -> response written, never read idle.
+      served.close();
       uint8_t raw_hdr[sizeof(DataRequestHeader)];
       if (net::read_exact(fd, raw_hdr, sizeof(raw_hdr)) != ErrorCode::OK) break;
       // Checked parse (data_wire.h): unknown op or a length past its
@@ -348,6 +385,7 @@ class TcpTransportServer : public TransportServer {
       // only safe answer is dropping the connection — continuing would
       // interpret attacker-positioned payload bytes as the next header.
       if (!decode_request_header(raw_hdr, sizeof(raw_hdr), hdr)) break;
+      served.open();
       // Relative budget -> absolute deadline anchored at receipt (0 = none).
       const Deadline op_deadline = Deadline::from_wire(hdr.deadline_ms);
       if (hdr.op == kOpHello) {
@@ -518,6 +556,7 @@ class TcpTransportServer : public TransportServer {
         break;  // protocol violation
       }
     }
+    served.close();  // the loop's final op (exit via break)
   }
 
   std::string host_;
@@ -706,7 +745,7 @@ class TcpEndpointPool {
       ::shm_unlink(name.c_str());
       return 0;
     }
-    DataRequestHeader hdr{kOpHello, 0, 0, name.size(), 0};
+    DataRequestHeader hdr{kOpHello, 0, 0, name.size(), 0, 0, 0};
     uint32_t status = ~0u;
     const bool ok =
         net::write_iov2(conn.sock.fd(), &hdr, sizeof(hdr), name.data(), name.size()) ==
@@ -985,7 +1024,7 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
           std::memcpy(c.stg_base + off, sub.buf + off, n);
         }
         StagedFrame framed{{kOpWriteStaged, sub.addr + off, sub.op->rkey, n,
-                            sub_budget_ms(sub)},
+                            sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id},
                            off};
         if (auto ec = net::write_all(c.sock.fd(), &framed, sizeof(framed));
             ec != ErrorCode::OK)
@@ -1002,12 +1041,13 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
     for (uint64_t off = 0; off < sub.len; off += pipe) {
       const uint64_t n = std::min(pipe, sub.len - off);
       frames[nframes++] = {{kOpReadStaged, sub.addr + off, sub.op->rkey, n,
-                            sub_budget_ms(sub)},
+                            sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id},
                           off};
     }
     return net::write_all(c.sock.fd(), frames, nframes * sizeof(StagedFrame));
   }
-  DataRequestHeader hdr{opcode, sub.addr, sub.op->rkey, sub.len, sub_budget_ms(sub)};
+  DataRequestHeader hdr{opcode, sub.addr,         sub.op->rkey,    sub.len,
+                        sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id};
   if (opcode == kOpWrite) {
     const ErrorCode ec = net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
     // No copy to fuse into on the plain socket lane: hash after the send so
@@ -1339,8 +1379,10 @@ ErrorCode tcp_fabric_command(const std::string& endpoint, uint8_t opcode, uint64
   if (!acquired.ok()) return acquired.error();
   PooledConn c = std::move(acquired).value();
   const Deadline ambient = current_op_deadline();
+  const auto tctx = trace::current();
   DataRequestHeader hdr{opcode, addr, rkey, len,
-                        ambient.is_infinite() ? 0 : ambient.wire_budget_ms()};
+                        ambient.is_infinite() ? 0 : ambient.wire_budget_ms(),
+                        tctx.trace_id, tctx.span_id};
   uint32_t status = 0;
   // Deadline on the status read: a wedged provider on the far side must not
   // hang the caller's drain/repair thread forever — time out, drop the
@@ -1389,6 +1431,9 @@ ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, vo
   remote.endpoint = endpoint;
   WireOp op{&remote, addr, rkey, static_cast<uint8_t*>(dst), len};
   op.deadline = current_op_deadline();
+  const auto rctx = trace::current();
+  op.trace_id = rctx.trace_id;
+  op.span_id = rctx.span_id;
   return tcp_batch(&op, 1, /*is_write=*/false, 0);
 }
 
@@ -1399,6 +1444,9 @@ ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, c
   remote.endpoint = endpoint;
   WireOp op{&remote, addr, rkey, const_cast<uint8_t*>(static_cast<const uint8_t*>(src)), len};
   op.deadline = current_op_deadline();
+  const auto wctx = trace::current();
+  op.trace_id = wctx.trace_id;
+  op.span_id = wctx.span_id;
   return tcp_batch(&op, 1, /*is_write=*/true, 0);
 }
 

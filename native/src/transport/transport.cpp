@@ -8,6 +8,7 @@
 
 #include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
+#include "btpu/common/trace.h"
 #include "btpu/storage/hbm_provider.h"
 #include "btpu/transport/transport.h"
 
@@ -271,9 +272,13 @@ bool make_wire_op(const ShardPlacement& shard, uint64_t in_off, uint8_t* buf, ui
   const auto* mem = std::get_if<MemoryLocation>(&shard.location);
   if (!mem) return false;
   op = {&shard.remote, mem->remote_addr + in_off, mem->rkey, buf, len, ErrorCode::OK};
-  // Ops are built on the calling thread, so the ambient per-op deadline is
-  // in scope here; fan-out workers read it from the op from now on.
+  // Ops are built on the calling thread, so the ambient per-op deadline and
+  // trace context are in scope here; fan-out workers read them from the op
+  // from now on.
   op.deadline = current_op_deadline();
+  const auto ctx = trace::current();
+  op.trace_id = ctx.trace_id;
+  op.span_id = ctx.span_id;
   return true;
 }
 

@@ -308,9 +308,16 @@ BTEST(Trace, SpansAggregateAndExportInMetrics) {
     MetricsHttpServer metrics(f.ks, "127.0.0.1", 0);
     BT_ASSERT(metrics.start() == ErrorCode::OK);
     auto text = metrics.render_metrics();
-    BT_EXPECT(text.find("btpu_span_p99_us{span=\"keystone.allocate\"}") != std::string::npos);
-    BT_EXPECT(text.find("btpu_span_count_total{span=\"keystone.put_start\"} 20") !=
+    // The reservoir span gauges were replaced by REAL histograms: the 20
+    // put_starts above went through the RPC server, so the method family
+    // must export native _bucket/_sum/_count series (exact counts are
+    // process-cumulative across tests — presence, not equality).
+    BT_EXPECT(text.find("# TYPE btpu_rpc_duration_us histogram") != std::string::npos);
+    BT_EXPECT(text.find("btpu_rpc_duration_us_bucket{method=\"put_start\",le=\"+Inf\"}") !=
               std::string::npos);
+    BT_EXPECT(text.find("btpu_rpc_duration_us_count{method=\"put_complete\"}") !=
+              std::string::npos);
+    BT_EXPECT(text.find("btpu_span_p99_us") == std::string::npos);  // gauges retired
     metrics.stop();
   }
 }

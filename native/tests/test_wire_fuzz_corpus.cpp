@@ -113,24 +113,28 @@ BTEST(WireFuzzCorpus, V1PoolRecordRejectsTrailingGarbage) {
 BTEST(WireFuzzCorpus, TcpHeaderRejectsHostileOpAndLength) {
   using namespace transport::datawire;
   auto raw = [](uint8_t op, uint64_t len) {
-    DataRequestHeader h{op, 0x1000, 0xBEEF, len, 0};
+    DataRequestHeader h{op, 0x1000, 0xBEEF, len, 0, 0, 0};
     std::vector<uint8_t> v(sizeof(h));
     std::memcpy(v.data(), &h, sizeof(h));
     return v;
   };
+  constexpr size_t kHdr = sizeof(DataRequestHeader);  // 45 since the trace fields
   DataRequestHeader hdr{};
   // Pre-hardening the server read the packed struct straight off the
   // socket: any op byte was dispatched, and a forged len drove a
   // multi-exabyte drain loop / scratch resize. All rejected at parse now.
-  BT_EXPECT(decode_request_header(raw(kOpRead, 1 << 20).data(), 29, hdr));
-  BT_EXPECT(!decode_request_header(raw(0x42, 16).data(), 29, hdr));          // unknown op
-  BT_EXPECT(!decode_request_header(raw(0, 16).data(), 29, hdr));             // op 0
-  BT_EXPECT(!decode_request_header(raw(kOpWrite, ~0ull >> 1).data(), 29, hdr));  // 2^63 len
-  BT_EXPECT(!decode_request_header(raw(kOpHello, 0).data(), 29, hdr));       // empty name
-  BT_EXPECT(!decode_request_header(raw(kOpHello, 4096).data(), 29, hdr));    // name > 255
-  BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), 28, hdr));       // truncated
+  BT_EXPECT(decode_request_header(raw(kOpRead, 1 << 20).data(), kHdr, hdr));
+  BT_EXPECT(!decode_request_header(raw(0x42, 16).data(), kHdr, hdr));          // unknown op
+  BT_EXPECT(!decode_request_header(raw(0, 16).data(), kHdr, hdr));             // op 0
+  BT_EXPECT(!decode_request_header(raw(kOpWrite, ~0ull >> 1).data(), kHdr, hdr));  // 2^63 len
+  BT_EXPECT(!decode_request_header(raw(kOpHello, 0).data(), kHdr, hdr));       // empty name
+  BT_EXPECT(!decode_request_header(raw(kOpHello, 4096).data(), kHdr, hdr));    // name > 255
+  BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), kHdr - 1, hdr));   // truncated
+  // A legacy 29-byte (pre-trace) header is TRUNCATED under the
+  // ship-together contract — rejected, never mis-decoded into garbage ids.
+  BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), 29, hdr));
   // Staged frames: wrong inner op rejected, truncation rejected.
-  StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 4096, 0}, 0x100};
+  StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 4096, 0, 0, 0}, 0x100};
   std::vector<uint8_t> fv(sizeof(f));
   std::memcpy(fv.data(), &f, sizeof(f));
   StagedFrame out{};
@@ -234,6 +238,37 @@ BTEST(WireFuzzCorpus, DeadlineTrailerStripIsExact) {
   BT_EXPECT(!rpc::strip_deadline_trailer(payload, budget));
   std::vector<uint8_t> tiny{1, 2, 3};
   BT_EXPECT(!rpc::strip_deadline_trailer(tiny, budget));
+}
+
+BTEST(WireFuzzCorpus, TraceTrailerStripIsExactAndOrdered) {
+  WorkerConfig wc;
+  auto payload = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0});
+  const size_t bare = payload.size();
+  // v5 client framing: trace INSIDE, deadline OUTERMOST; the server strips
+  // in reverse append order. Both round-trip exactly.
+  rpc::append_trace_trailer(payload, 0xABCDEF0123456789ull, 0x42ull);
+  rpc::append_deadline_trailer(payload, 250);
+  uint32_t budget = 0;
+  uint64_t trace_id = 0, parent = 0;
+  BT_ASSERT(rpc::strip_deadline_trailer(payload, budget));
+  BT_EXPECT_EQ(budget, 250u);
+  BT_ASSERT(rpc::strip_trace_trailer(payload, trace_id, parent));
+  BT_EXPECT_EQ(trace_id, 0xABCDEF0123456789ull);
+  BT_EXPECT_EQ(parent, 0x42ull);
+  BT_EXPECT_EQ(payload.size(), bare);
+  // Truncated mid-trailer: nothing stripped, payload untouched.
+  auto truncated = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0});
+  rpc::append_trace_trailer(truncated, 0x1111222233334444ull, 0x5555ull);
+  truncated.resize(truncated.size() - 6);
+  const size_t tsize = truncated.size();
+  BT_EXPECT(!rpc::strip_trace_trailer(truncated, trace_id, parent));
+  BT_EXPECT_EQ(truncated.size(), tsize);
+  // A forged trailer carrying trace id 0 (the reserved untraced value) is
+  // refused — 0 must stay unambiguous everywhere downstream.
+  auto forged = wire::to_bytes(PutStartRequest{"k", 4096, wc, 0});
+  rpc::append_trace_trailer(forged, 1, 1);
+  std::memset(forged.data() + forged.size() - 16, 0, 8);
+  BT_EXPECT(!rpc::strip_trace_trailer(forged, trace_id, parent));
 }
 
 }  // namespace

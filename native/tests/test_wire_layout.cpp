@@ -27,6 +27,7 @@
 #include "btpu/common/env.h"
 #include "btpu/common/wire.h"
 #include "btpu/coord/wal_format.h"
+#include "btpu/rpc/rpc.h"
 #include "btest.h"
 
 namespace {
@@ -220,6 +221,19 @@ std::vector<std::pair<std::string, std::string>> golden_rows() {
   add("PutInlineResponse", enc(PutInlineResponse{ErrorCode::OK}));
   add("PingRequest", enc(PingRequest{3}));
   add("PingResponse", enc(PingResponse{11, 3}));
+
+  // RPC tagged trailers (rpc.h): raw appended bytes, not wire-struct
+  // encodes — pin the exact framing (magic + fields) a peer strips.
+  {
+    std::vector<uint8_t> t;
+    rpc::append_deadline_trailer(t, 250);
+    add("rpc/deadline_trailer", hex(t));
+  }
+  {
+    std::vector<uint8_t> t;
+    rpc::append_trace_trailer(t, 0x1122334455667788ull, 0x99AABBCCDDEEFF00ull);
+    add("rpc/trace_trailer", hex(t));
+  }
 
   // Coordinator WAL v2 on-disk framing (wal_format.h): a durable format, so
   // it is frozen like the durable record envelopes. The canonical journal is

@@ -39,6 +39,15 @@ Rules (each also documented in docs/CORRECTNESS.md):
                          decode/parse/validate declarations in headers carry
                          it per-declaration.
 
+  trace-span-literal     Every TRACE_SPAN( argument is a string LITERAL.
+                         The span ring and flight recorder store the name
+                         by pointer (trace.h), so a non-literal name is a
+                         use-after-free waiting for its dump — the historic
+                         Span(string_view) footgun, now impossible to
+                         reintroduce. Named-literal tables (rpc.h
+                         method_span_name) construct trace::Span directly
+                         and document their static storage duration.
+
 Mechanics: uses libclang when importable (AST-accurate), else a pattern
 fallback that is deliberately conservative — comments and string literals
 are stripped before matching, so a mention in prose never fires.
@@ -325,6 +334,33 @@ def rule_nodiscard(report: Report):
             )
 
 
+# ---- rule: trace-span-literal ----------------------------------------------
+
+# Raw text on purpose: the literal IS what we check for, and the shared
+# stripper blanks string contents. The macro's own definition in trace.h is
+# the one legal non-literal spelling.
+TRACE_SPAN_CALL = re.compile(r"\bTRACE_SPAN\s*\(\s*([^)\s])")
+TRACE_SPAN_ALLOW = {"include/btpu/common/trace.h"}
+
+
+def rule_trace_span(report: Report):
+    for p in src_files(scopes=["src", "include", "exe"]):
+        rel = str(p.relative_to(NATIVE))
+        if rel in TRACE_SPAN_ALLOW:
+            continue
+        text = p.read_text()
+        for m in TRACE_SPAN_CALL.finditer(text):
+            if m.group(1) == '"':
+                continue
+            line = text.count("\n", 0, m.start()) + 1
+            report.flag(
+                "trace-span-literal", p, line,
+                "TRACE_SPAN with a non-literal name — the span ring stores "
+                "the POINTER (trace.h); pass a string literal (or construct "
+                "trace::Span from a documented static-literal table)",
+            )
+
+
 # ---- optional libclang refinement -----------------------------------------
 
 
@@ -385,6 +421,7 @@ def main() -> int:
     rule_steady(report)
     rule_wire_golden(report)
     rule_nodiscard(report)
+    rule_trace_span(report)
     mode = "libclang+patterns" if try_libclang(report) else "patterns"
     if report.violations:
         print(f"btpu_lint ({mode}): {len(report.violations)} violation(s)",
@@ -393,7 +430,8 @@ def main() -> int:
             print(f"  {v}", file=sys.stderr)
         return 1
     print(f"btpu_lint ({mode}): clean "
-          "(mutex/env/steady-clock/wire-golden/nodiscard invariants hold)")
+          "(mutex/env/steady-clock/wire-golden/nodiscard/trace-span "
+          "invariants hold)")
     return 0
 
 

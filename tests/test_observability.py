@@ -1,0 +1,76 @@
+"""Observability surface through the Python bindings: real latency
+histograms, trace spans, and the flight recorder (ISSUE 10)."""
+
+from blackbird_tpu import Client, EmbeddedCluster
+
+
+def _series(histograms, family, label_value=None):
+    return [
+        h for h in histograms
+        if h["family"] == family and
+        (label_value is None or h["label_value"] == label_value)
+    ]
+
+
+def test_histograms_and_lane_counter_summaries():
+    with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        payload = b"x" * 65536
+        for i in range(8):
+            client.put(f"obs/{i}", payload)
+            assert client.get(f"obs/{i}") == payload
+
+        hists = Client.histograms()
+        gets = _series(hists, "btpu_op_duration_us", "get")
+        assert gets and gets[0]["count"] >= 8
+        assert gets[0]["p99_us"] >= gets[0]["p50_us"] > 0
+        # Buckets are non-cumulative and sum to the count.
+        assert sum(b["n"] for b in gets[0]["buckets"]) == gets[0]["count"]
+        # Put rode one of the put families (inline/slot/placed by size).
+        puts = [h for h in _series(hists, "btpu_op_duration_us")
+                if h["label_value"].startswith("put")]
+        assert sum(h["count"] for h in puts) >= 8
+
+        lanes = Client.lane_counters()
+        assert lanes["hist_get_count"] == gets[0]["count"]
+        assert lanes["hist_get_p99_us"] >= lanes["hist_get_p50_us"] > 0
+        assert lanes["flight_events"] > 0
+        assert lanes["trace_spans"] > 0
+
+
+def test_trace_spans_stitch_by_trace_id():
+    with EmbeddedCluster(workers=1, pool_bytes=8 << 20) as cluster:
+        client = cluster.client()
+        client.put("obs/traced", b"y" * 4096)
+        assert client.get("obs/traced") == b"y" * 4096
+
+        spans = Client.trace_spans()
+        assert spans, "span ring empty after traced ops"
+        roots = [s for s in spans if s["name"] == "get"]
+        assert roots, f"no root get span in {[s['name'] for s in spans][:10]}"
+        trace_id = int(roots[-1]["trace"], 16)
+        assert trace_id != 0
+        one = Client.trace_spans(trace_id)
+        assert one and all(s["trace"] == roots[-1]["trace"] for s in one)
+        for s in one:
+            assert s["dur_us"] >= 0 and s["start_us"] > 0 and s["pid"] > 0
+
+
+def test_flight_events_flow_and_tracing_switch():
+    with EmbeddedCluster(workers=1, pool_bytes=8 << 20) as cluster:
+        client = cluster.client()
+        client.put("obs/flight", b"z" * 1024)
+        events = Client.flight_events()
+        assert events
+        assert any(e["ev"] == "op_end" for e in events)
+
+        # The master switch stops new events; re-enabling resumes.
+        Client.set_tracing(False)
+        try:
+            before = Client.lane_counters()["flight_events"]
+            client.put("obs/off", b"q" * 512)
+            assert Client.lane_counters()["flight_events"] == before
+        finally:
+            Client.set_tracing(True)
+        client.put("obs/on", b"r" * 512)
+        assert any(e["ev"] == "op_end" for e in Client.flight_events())

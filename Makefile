@@ -31,7 +31,7 @@ EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
 
 HDRS := $(shell find native/include native/src native/exe native/fuzz -name '*.h')
 
-.PHONY: all native examples clean tsan asan lint check wire-golden fuzz fuzz-replay
+.PHONY: all native examples clean tsan asan sched lint check wire-golden fuzz fuzz-replay
 all: native
 native: $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES)
 examples: $(EXAMPLES)
@@ -52,6 +52,12 @@ examples: $(EXAMPLES)
 #   TSAN_FILTERS="Cache Transport" make tsan    # narrow to suites
 TSAN_BUILD := $(BUILD)/tsan
 TSAN_FILTERS ?=
+# Schedule-exploration hooks (btpu/common/sched.h) ride every sanitizer
+# tree: the Sched/SchedDfs/SchedMutants suites need them, and for all other
+# suites a disarmed hook is one relaxed load per lock op. The NORMAL build
+# deliberately does NOT define this — bench.py's cached-get guard proves the
+# release hot path carries zero sched cost because the hooks don't exist.
+SCHED_FLAGS := -DBTPU_SCHED=1
 # AddressSanitizer + UndefinedBehaviorSanitizer; LeakSanitizer rides along
 # with ASan on Linux. -fno-sanitize-recover turns every UB finding into a
 # hard failure instead of a log line.
@@ -81,9 +87,25 @@ endef
 comma := ,
 ASAN_FLAGS := -fsanitize=address$(comma)undefined -fno-sanitize-recover=all
 tsan:
-	$(call sanitizer_run,tsan,$(TSAN_BUILD),-fsanitize=thread,$(TSAN_FILTERS))
+	$(call sanitizer_run,tsan,$(TSAN_BUILD),-fsanitize=thread $(SCHED_FLAGS),$(TSAN_FILTERS))
 asan:
-	$(call sanitizer_run,asan,$(ASAN_BUILD),$(ASAN_FLAGS),$(ASAN_FILTERS))
+	$(call sanitizer_run,asan,$(ASAN_BUILD),$(ASAN_FLAGS) $(SCHED_FLAGS),$(ASAN_FILTERS))
+
+# ---- schedule-exploration campaign (docs/CORRECTNESS.md §10) ---------------
+# Builds the asan tree (which carries the sched hooks) and runs the full
+# schedule-exploration surface at campaign budget: seeded PCT sweeps over
+# the Sched fixtures, the exhaustive DFS model check of the lock-free
+# kernels, and the planted-mutant matrix. Knobs:
+#   BTPU_SCHED_SEEDS          seeds per fixture          (default here: 200)
+#   BTPU_SCHED_MUTANT_BUDGET  seed budget per planted mutant (default: 150)
+#   BTPU_SCHED_SEED           pin ONE seed — the replay path
+sched:
+	$(MAKE) BUILD=$(ASAN_BUILD) \
+	  CXXFLAGS="-std=c++20 -O1 -g -fPIC $(WARNFLAGS) \
+	            -Inative/include -pthread $(ASAN_FLAGS) $(SCHED_FLAGS)" \
+	  LDFLAGS="-pthread -lrt $(ASAN_FLAGS)" \
+	  $(ASAN_BUILD)/libbtpu.so $(ASAN_BUILD)/btpu_tests
+	env BTPU_SCHED_SEEDS="$${BTPU_SCHED_SEEDS:-200}" $(ASAN_BUILD)/btpu_tests --filter=Sched
 
 # ---- hostile-input fuzz gate (docs/CORRECTNESS.md) -------------------------
 # `make fuzz` drives every wire-decode surface with hostile bytes: libFuzzer
@@ -99,7 +121,7 @@ fuzz:
 fuzz-replay:
 	$(MAKE) BUILD=$(ASAN_BUILD) \
 	  CXXFLAGS="-std=c++20 -O1 -g -fPIC $(WARNFLAGS) \
-	            -Inative/include -pthread $(ASAN_FLAGS)" \
+	            -Inative/include -pthread $(ASAN_FLAGS) $(SCHED_FLAGS)" \
 	  LDFLAGS="-pthread -lrt $(ASAN_FLAGS)" \
 	  $(ASAN_BUILD)/btpu_fuzz_replay
 

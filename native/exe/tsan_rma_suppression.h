@@ -17,6 +17,19 @@
 #if defined(__SANITIZE_THREAD__)
 extern "C" const char* __tsan_default_suppressions() {
   return
+      // Audit trail (CORRECTNESS §2/§10): each suppression names its
+      // schedule-exploration evidence so the list cannot silently accrete.
+      //   local_access — modeled one-sided-RMA tear; the DISCARD gates that
+      //     make it benign (epoch re-check, CRC) are the same epoch
+      //     machinery the sched mutant demote_skip_epoch_check proves the
+      //     hunter can convict when bypassed. TODO(sched): a DFS fixture
+      //     modeling reader-vs-one-sided-write over local_access with the
+      //     epoch re-check as the invariant would retire this entry's
+      //     hand-argument entirely.
+      //   pvm_access — same model, pvm lane degraded to the same-process
+      //     memcpy (surfaced by bb-soak --fanin). Covered by the same TODO:
+      //     the kernel the DFS mode should eventually cover is the
+      //     local/pvm one-sided copy vs scrub-read pair.
       "race:btpu::transport::local_access\n"
       "race:btpu::transport::pvm_access\n";
 }

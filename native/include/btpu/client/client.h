@@ -300,6 +300,13 @@ class ObjectClient {
     data_ = std::move(data);
   }
 
+#if defined(BTPU_SCHED)
+  // Test-only (schedule-exploration victims, test_sched.cpp): drive a
+  // keystone rotation directly — the same swap the failover path performs
+  // on RPC failure, minus the need to kill a keystone mid-test.
+  void rotate_keystone_for_test() { rotate_keystone(); }
+#endif
+
   // ---- robustness observability (tests/bench) ------------------------------
   // The per-endpoint breakers feeding replica choice.
   BreakerRegistry& breakers() noexcept { return breakers_; }
@@ -345,6 +352,7 @@ class ObjectClient {
       const Deadline deadline = current_op_deadline();
       if (deadline.expired()) break;
       if (!op_retry_budget_.try_spend()) {
+        // ordering: relaxed — monotonic stat counter.
         robust_counters().retry_budget_exhausted.fetch_add(1, std::memory_order_relaxed);
         break;
       }
@@ -353,6 +361,7 @@ class ObjectClient {
         wait_ms = std::min<uint64_t>(wait_ms,
                                      static_cast<uint64_t>(deadline.remaining_ms()));
       std::this_thread::sleep_for(std::chrono::milliseconds(wait_ms));
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().retries.fetch_add(1, std::memory_order_relaxed);
       result = fn();
     }
@@ -448,6 +457,19 @@ class ObjectClient {
   template <typename Fn>
   auto rpc_failover(bool idempotent, Fn&& fn) {
     auto client = rpc_snapshot();
+#if defined(BTPU_SCHED)
+    if (sched::mutant_enabled("rpc_swap_unlocked")) {
+      // PLANTED MUTANT — the exact pre-PR-3 rotate_keystone UAF: callers
+      // went through the raw pointer with no pin, so a concurrent rotation
+      // destroyed the client mid-call. Dropping the shared_ptr pin here
+      // reproduces those semantics byte-for-byte; the SchedMutants matrix
+      // must detect the ASan heap-use-after-free within the seed budget.
+      rpc::KeystoneRpcClient* raw = client.get();
+      client.reset();
+      auto result = fn(*raw);
+      return result;
+    }
+#endif
     auto result = fn(*client);
     auto should_retry = [&](ErrorCode ec) {
       if (ec == ErrorCode::NOT_LEADER || ec == ErrorCode::CONNECTION_FAILED) return true;
@@ -525,7 +547,7 @@ class ObjectClient {
   // the caller plus the propagated deadline aborting server-side chunks.
   std::atomic<uint32_t> hedge_inflight_{0};
   Mutex hedge_mutex_;
-  std::condition_variable_any hedge_cv_;
+  CondVarAny hedge_cv_;
 };
 
 }  // namespace btpu::client

@@ -151,6 +151,15 @@ class AdmissionGate {
       w->decided = true;
       woke = true;
     }
+#if defined(BTPU_SCHED)
+    if (woke && sched::mutant_enabled("admission_lost_wakeup")) {
+      // PLANTED MUTANT — lost-wakeup class: decide the waiter but skip the
+      // notify. An admitted waiter parks forever on cv_; the scheduler's
+      // all-blocked watchdog convicts it as a deadlock with the seed
+      // printed (SchedMutants matrix).
+      return;
+    }
+#endif
     if (woke) cv_.notify_all();
   }
   void remove_locked(Waiter* w) BTPU_REQUIRES(mutex_) {
@@ -167,7 +176,7 @@ class AdmissionGate {
   uint32_t inflight_ BTPU_GUARDED_BY(mutex_){0};
   uint64_t inflight_bytes_ BTPU_GUARDED_BY(mutex_){0};
   std::deque<Waiter*> queue_ BTPU_GUARDED_BY(mutex_);
-  std::condition_variable_any cv_;
+  CondVarAny cv_;
 };
 
 // RAII admission: verdict() tells the caller whether to serve or reject.

@@ -153,6 +153,7 @@ class CircuitBreaker {
     // the sick endpoint in the same instant.
     RetryPolicy jitter{options_.open_ms, options_.open_ms, 1.0, 1};
     open_until_ = Clock::now() + std::chrono::milliseconds(jitter.backoff_ms(0));
+    // ordering: relaxed — monotonic stat counter (breaker state itself is mutex-guarded).
     robust_counters().breaker_trips.fetch_add(1, std::memory_order_relaxed);
     flight::record(flight::Ev::kBreakerTrip);
   }

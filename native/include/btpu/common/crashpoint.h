@@ -92,6 +92,7 @@ inline void reparse_for_test() { detail::parse(detail::spec()); }
 inline void hit(const char* label) {
   detail::Spec& s = detail::spec();
   if (!s.armed || std::strcmp(s.label, label) != 0) return;
+  // ordering: relaxed — hit countdown; the _exit makes any cross-thread ordering moot, and overshoot by concurrent hits is impossible past the fetch_sub reaching 1 exactly once.
   if (s.remaining.fetch_sub(1, std::memory_order_relaxed) == 1) ::_exit(kExitCode);
 }
 

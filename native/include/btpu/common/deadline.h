@@ -109,6 +109,7 @@ class RetryBudget {
 
   // True (and spends a token) when a retry is currently affordable.
   bool try_spend() noexcept {
+    // ordering: relaxed CAS loop — the token count is the only shared word; no payload is transferred on spend/refund, so success needs no acquire edge.
     int64_t cur = tokens_mil_.load(std::memory_order_relaxed);
     while (true) {
       if (cur <= capacity_mil_ / 2) return false;
@@ -119,6 +120,7 @@ class RetryBudget {
   }
 
   void on_success() noexcept {
+    // ordering: relaxed CAS loop — same single-word argument as try_spend.
     int64_t cur = tokens_mil_.load(std::memory_order_relaxed);
     while (true) {
       const int64_t next = cur + refund_mil_ > capacity_mil_ ? capacity_mil_
@@ -130,6 +132,7 @@ class RetryBudget {
   }
 
   double tokens() const noexcept {
+    // ordering: relaxed — point-in-time gauge read.
     return static_cast<double>(tokens_mil_.load(std::memory_order_relaxed)) / 1000.0;
   }
 
@@ -170,6 +173,7 @@ class LatencyTracker {
   // 0 when fewer than min_samples recorded (callers fall back to a fixed
   // hedge delay or skip hedging).
   uint64_t quantile_us(double q, size_t min_samples = 16) const noexcept;
+  // ordering: relaxed — sample-count gauge read.
   size_t samples() const noexcept { return count_.load(std::memory_order_relaxed); }
 
  private:

@@ -23,6 +23,8 @@
 #include <functional>
 #include <string>
 
+#include "btpu/common/sched.h"
+
 namespace btpu::hist {
 
 inline constexpr size_t kBucketCount = 28;  // [0..26] = le 2^i us, [27] = +Inf
@@ -50,7 +52,12 @@ class Histogram {
   // quantile-unbiased, and the weight keeps _count/_sum rate math honest).
   void record_us_weighted(uint64_t us, uint64_t weight) noexcept {
     Stripe& s = stripe();
+    // ordering: relaxed on both counters — monotonic totals folded on read;
+    // a snapshot between the two adds sees count ahead of sum by one
+    // in-flight sample, exactly as consistent as any Prometheus scrape
+    // (SchedDfs.HistogramStripes enumerates the window and pins it).
     s.buckets[bucket_index(us)].fetch_add(weight, std::memory_order_relaxed);
+    BTPU_ATOMIC_YIELD();
     s.sum_us.fetch_add(us * weight, std::memory_order_relaxed);
   }
 
@@ -74,6 +81,7 @@ class Histogram {
 
   Stripe& stripe() noexcept {
     static std::atomic<unsigned> next{0};
+    // ordering: relaxed — round-robin stripe assignment; any interleaving is a valid spreading.
     thread_local const unsigned idx = next.fetch_add(1, std::memory_order_relaxed) & 3u;
     return stripes_[idx];
   }

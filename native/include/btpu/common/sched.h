@@ -1,0 +1,271 @@
+// Schedule-exploration harness ("the race hunter"): deterministic
+// interleaving control over the annotated lock layer plus the hand-rolled
+// lock-free kernels.
+//
+// ASan/TSan only ever observe the ONE schedule the OS happens to run; every
+// real concurrency bug this repo has shipped (the rotate_keystone UAF, the
+// hedge notify-after-unlock race, the bb-soak worker-swap race) was a
+// SCHEDULE bug that survived many green sanitizer runs. This harness makes
+// schedules a searchable input instead of an accident:
+//
+//   * Preemption points are injected at every annotated lock acquire /
+//     release (btpu::Mutex / SharedMutex / the scoped guards), every
+//     CondVarAny wait/notify, and at BTPU_ATOMIC_YIELD() markers threaded
+//     through the lock-free kernels (flight recorder, histograms, span
+//     ring, AtomicAccessStamp).
+//   * While a sched::Run is armed, exactly ONE enrolled thread runs at a
+//     time; at each preemption point a policy picks who runs next:
+//       - PCT (Burckhardt et al., ASPLOS '10): seeded random thread
+//         priorities with d-1 random priority-change points — probabilistic
+//         bug-depth guarantees, one uint64 seed reproduces the schedule.
+//       - DFS: bounded-exhaustive enumeration of EVERY interleaving of a
+//         small fixture (sched::explore_dfs), for the lock-free kernels.
+//   * Any assertion/sanitizer failure while armed prints the seed; running
+//     with BTPU_SCHED_SEED=<n> (or the same Run options) replays the exact
+//     interleaving, deterministically.
+//
+// Build shape: everything here compiles to zero-cost no-ops unless
+// BTPU_SCHED is defined (the asan/tsan/`make sched` trees define it; the
+// release/bench build does NOT — the bench.py cached-get guard proves the
+// hot path is untouched). Unscheduled processes in a sched build pay one
+// relaxed atomic load per hook.
+//
+// Threading model (docs/CORRECTNESS.md §10 for the full map):
+//   * Threads participate only when ENROLLED (sched::Enroll RAII with an
+//     explicit deterministic id, or the adopt protocol below for
+//     library-spawned threads). Unenrolled threads run free; their
+//     unlock/notify still wake enrolled waiters, so fixtures may lean on
+//     unenrolled helpers (embedded servers) without wedging the scheduler.
+//   * A blocked enrolled thread (mutex wait, cv wait) hands the token over;
+//     if every enrolled thread blocks and nothing can wake them, the hang
+//     watchdog prints the seed + per-thread wait states and aborts — the
+//     hunter detects deadlocks and lost wakeups, not just races.
+//   * Library code that spawns a thread an armed fixture must control
+//     calls BTPU_SCHED_DECL_SPAWN() before std::thread{...} and
+//     BTPU_SCHED_ADOPT_SPAWNED() first thing inside the body (see
+//     client.cpp hedged_race). Both are no-ops unless a Run is armed.
+#pragma once
+
+#include <cstdint>
+
+#if defined(BTPU_SCHED)
+#include <atomic>
+#include <functional>
+#include <vector>
+#endif
+
+namespace btpu::sched {
+
+// True in builds with the hooks compiled in (-DBTPU_SCHED). Tests print a
+// notice and run their fixtures unscheduled when false.
+#if defined(BTPU_SCHED)
+inline constexpr bool kCompiledIn = true;
+#else
+inline constexpr bool kCompiledIn = false;
+#endif
+inline constexpr bool compiled_in() noexcept { return kCompiledIn; }
+
+#if defined(BTPU_SCHED)
+
+// Preemption-point vocabulary (reported in hang dumps; also the hook map).
+enum class Point : uint8_t {
+  kLock = 0,      // about to acquire a Mutex/SharedMutex (exclusive)
+  kLockShared,    // about to acquire shared
+  kUnlock,        // just released (exclusive or shared)
+  kCvWait,        // CondVarAny wait about to park
+  kCvNotify,      // CondVarAny notify_one/notify_all
+  kAtomic,        // BTPU_ATOMIC_YIELD() inside a lock-free kernel
+  kYield,         // explicit test yield (BTPU_SCHED_YIELD)
+};
+
+// ---- fast-path gates (one relaxed load when disarmed) ----------------------
+extern std::atomic<bool> g_armed;
+struct ThreadState;
+ThreadState*& self_slot() noexcept;  // thread_local enrollment pointer
+
+// ordering: relaxed — arming gate: enrollment (the other half of on()) happens-before any schedule decision via the scheduler mutex; unenrolled threads only ever see a cheap false.
+inline bool armed() noexcept { return g_armed.load(std::memory_order_relaxed); }
+// This thread is enrolled in an armed run: hooks must take the slow path.
+inline bool on() noexcept { return armed() && self_slot() != nullptr; }
+
+// ---- slow-path entry points (sched.cpp) ------------------------------------
+// Decision point: hand the token to whoever the policy picks (possibly us).
+void preempt(Point p, const void* addr) noexcept;
+// Scheduled blocking-acquire protocol: deterministic try_lock/park loop.
+// try_fn is invoked with the scheduler lock held, so it must be nonblocking
+// (std try_lock is). Returns once the lock is held.
+void acquire(Point p, const void* addr, bool (*try_fn)(void*), void* m) noexcept;
+// Release notification: wakes enrolled threads parked on `addr`. Safe (and
+// cheap) from ANY thread while a run is armed, enrolled or not.
+void on_unlock(const void* addr) noexcept;
+// CondVar protocol: register under the scheduler lock BEFORE releasing the
+// user lock (no lost wakeups), park after, reacquire outside. park_wait
+// returns true when woken by a notify, false when the scheduler fired the
+// (virtual) timeout of a timed wait — time never passes for real.
+struct CvWaitTicket {
+  void* rep{nullptr};
+};
+CvWaitTicket cv_register(const void* cv_addr, bool timed) noexcept;
+bool cv_park(CvWaitTicket t) noexcept;
+void on_notify(const void* cv_addr, bool all) noexcept;
+
+// ---- enrollment ------------------------------------------------------------
+// RAII enrollment with an explicit deterministic id (0-based, unique per
+// Run; fixtures assign ids in spawn order). Inert when no run is armed.
+class Enroll {
+ public:
+  explicit Enroll(uint32_t id) noexcept;
+  ~Enroll();
+  Enroll(const Enroll&) = delete;
+  Enroll& operator=(const Enroll&) = delete;
+
+ private:
+  bool active_{false};
+};
+
+// Adopt protocol for library-spawned threads (see header comment).
+void decl_spawn() noexcept;
+class AdoptScope {
+ public:
+  AdoptScope() noexcept;
+  ~AdoptScope();
+  AdoptScope(const AdoptScope&) = delete;
+  AdoptScope& operator=(const AdoptScope&) = delete;
+
+ private:
+  bool active_{false};
+};
+
+// ---- run control -----------------------------------------------------------
+enum class Mode : uint8_t { kPct = 0, kDfs = 1 };
+
+struct RunOptions {
+  uint64_t seed{1};
+  Mode mode{Mode::kPct};
+  // Enrollment barrier: no thread runs until this many have enrolled
+  // (deterministic start). 0 = start immediately, schedule as they arrive.
+  uint32_t threads{0};
+  // PCT depth d: d-1 priority-change points (bug depth the run targets).
+  uint32_t pct_depth{3};
+  // Estimated step count the change points are sampled from.
+  uint32_t pct_steps{64};
+  // Step budget: exceeding it is a livelock verdict (seed printed, abort).
+  uint64_t max_steps{1u << 20};
+  // All-blocked / no-progress watchdog, ms (BTPU_SCHED_HANG_MS overrides).
+  uint32_t hang_ms{5000};
+};
+
+// Arms schedule control for its scope. Construct BEFORE spawning enrolled
+// threads and destroy AFTER joining them (the destructor waits for every
+// enrolled thread — including adopted detached ones — to retire). One Run
+// at a time per process.
+class Run {
+ public:
+  explicit Run(const RunOptions& options);
+  ~Run();
+  Run(const Run&) = delete;
+  Run& operator=(const Run&) = delete;
+};
+
+// Seed of the innermost armed run (0 = none) — failure banners print it.
+uint64_t current_seed() noexcept;
+
+// ---- bounded-exhaustive DFS ------------------------------------------------
+struct ExploreResult {
+  uint64_t schedules{0};   // schedules fully executed
+  bool complete{false};    // the bounded space was exhausted (no truncation)
+  uint64_t max_decisions{0};
+};
+
+struct ExploreOptions {
+  uint32_t threads{0};          // enrollment barrier per schedule
+  uint64_t max_schedules{0};    // 0 = BTPU_SCHED_DFS_MAX (default 200000)
+  uint64_t max_steps{1u << 16};
+};
+
+// Runs `fixture` repeatedly, enumerating every scheduling decision of the
+// enrolled threads depth-first. The fixture must be deterministic given the
+// schedule (spawn the same threads with the same ids, bounded ops). Stops
+// early (complete=false) only when max_schedules is hit — callers must
+// treat that as a failure, never as coverage.
+ExploreResult explore_dfs(const ExploreOptions& options,
+                          const std::function<void()>& fixture);
+
+// ---- planted mutants (test-only) -------------------------------------------
+// True when BTPU_SCHED_MUTANT names `name`. Library code re-injects a
+// historical concurrency bug behind this so the planted-mutant matrix can
+// prove the hunter finds the exact bug class this repo actually ships.
+// Never true outside BTPU_SCHED builds (the code is compiled out).
+bool mutant_enabled(const char* name) noexcept;
+
+#else  // !BTPU_SCHED — inert stand-ins so tests compile hook-free
+
+enum class Mode : uint8_t { kPct = 0, kDfs = 1 };
+struct RunOptions {
+  uint64_t seed{1};
+  Mode mode{Mode::kPct};
+  uint32_t threads{0};
+  uint32_t pct_depth{3};
+  uint32_t pct_steps{64};
+  uint64_t max_steps{1u << 20};
+  uint32_t hang_ms{5000};
+};
+class Run {
+ public:
+  explicit Run(const RunOptions&) noexcept {}
+};
+class Enroll {
+ public:
+  explicit Enroll(uint32_t) noexcept {}
+};
+inline uint64_t current_seed() noexcept { return 0; }
+struct ExploreResult {
+  uint64_t schedules{0};
+  bool complete{false};
+  uint64_t max_decisions{0};
+};
+struct ExploreOptions {
+  uint32_t threads{0};
+  uint64_t max_schedules{0};
+  uint64_t max_steps{1u << 16};
+};
+
+// Hookless stub: runs the fixture once, free-scheduled. complete=false so
+// callers can tell no exhaustive exploration happened.
+template <typename Fn>
+inline ExploreResult explore_dfs(const ExploreOptions&, Fn&& fixture) {
+  fixture();
+  return ExploreResult{1, false, 0};
+}
+
+#endif  // BTPU_SCHED
+
+}  // namespace btpu::sched
+
+// ---- hook macros ------------------------------------------------------------
+// BTPU_ATOMIC_YIELD(): a preemption point inside a lock-free kernel. Place
+// one between the atomic steps whose interleavings the DFS mode must
+// enumerate (claim/publish/read-validate edges). Compiles to nothing
+// outside BTPU_SCHED builds.
+#if defined(BTPU_SCHED)
+#define BTPU_ATOMIC_YIELD()                                            \
+  do {                                                                 \
+    if (::btpu::sched::on())                                           \
+      ::btpu::sched::preempt(::btpu::sched::Point::kAtomic, nullptr);  \
+  } while (0)
+#define BTPU_SCHED_YIELD()                                             \
+  do {                                                                 \
+    if (::btpu::sched::on())                                           \
+      ::btpu::sched::preempt(::btpu::sched::Point::kYield, nullptr);   \
+  } while (0)
+#define BTPU_SCHED_DECL_SPAWN()                                        \
+  do {                                                                 \
+    if (::btpu::sched::armed()) ::btpu::sched::decl_spawn();           \
+  } while (0)
+#define BTPU_SCHED_ADOPT_SPAWNED() ::btpu::sched::AdoptScope _btpu_sched_adopt
+#else
+#define BTPU_ATOMIC_YIELD() ((void)0)
+#define BTPU_SCHED_YIELD() ((void)0)
+#define BTPU_SCHED_DECL_SPAWN() ((void)0)
+#define BTPU_SCHED_ADOPT_SPAWNED() ((void)0)
+#endif

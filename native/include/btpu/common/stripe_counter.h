@@ -15,10 +15,12 @@ namespace btpu {
 
 class StripeCounter {
  public:
+  // ordering: relaxed — monotonic striped counter; folded on read.
   void add(uint64_t n = 1) noexcept { stripe().fetch_add(n, std::memory_order_relaxed); }
 
   uint64_t total() const noexcept {
     uint64_t sum = 0;
+    // ordering: relaxed — fold of monotonic stripes; a moving total is any valid scrape.
     for (const auto& s : stripes_) sum += s.v.load(std::memory_order_relaxed);
     return sum;
   }
@@ -30,6 +32,7 @@ class StripeCounter {
 
   std::atomic<uint64_t>& stripe() noexcept {
     static std::atomic<unsigned> next{0};
+    // ordering: relaxed — round-robin stripe assignment; any interleaving is a valid spreading.
     thread_local const unsigned idx = next.fetch_add(1, std::memory_order_relaxed) & 7u;
     return stripes_[idx].v;
   }

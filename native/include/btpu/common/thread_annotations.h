@@ -23,10 +23,20 @@
 // btpu::MutexLock / btpu::SharedLock / btpu::WriterLock below instead; they
 // wrap the std types 1:1 (including defer/adopt, early unlock, relock, and
 // condition_variable_any waits) and only add the attributes.
+//
+// Schedule exploration (PR 11): under BTPU_SCHED builds every acquire /
+// release below (and every CondVarAny wait/notify) is also a deterministic
+// preemption point for the btpu::sched race hunter — the single lock choke
+// point PR 3 created is exactly the hook a PCT/DFS scheduler needs. Release
+// builds compile the hooks to nothing (sched.h).
 #pragma once
 
+#include <chrono>
+#include <condition_variable>
 #include <mutex>
 #include <shared_mutex>
+
+#include "btpu/common/sched.h"
 
 // clang exposes the analysis attributes through __has_attribute; gcc defines
 // neither, so everything collapses to no-ops there.
@@ -90,9 +100,32 @@ class BTPU_CAPABILITY("mutex") Mutex {
   Mutex(const Mutex&) = delete;
   Mutex& operator=(const Mutex&) = delete;
 
-  void lock() BTPU_ACQUIRE() { m_.lock(); }
-  bool try_lock() BTPU_TRY_ACQUIRE(true) { return m_.try_lock(); }
-  void unlock() BTPU_RELEASE() { m_.unlock(); }
+  void lock() BTPU_ACQUIRE() {
+#if defined(BTPU_SCHED)
+    if (sched::on()) {
+      // Scheduled acquire: a deterministic try_lock/park loop — the
+      // scheduler decides who wins a contended lock, not the OS.
+      sched::acquire(sched::Point::kLock, this,
+                     [](void* m) { return static_cast<std::mutex*>(m)->try_lock(); }, &m_);
+      return;
+    }
+#endif
+    m_.lock();
+  }
+  bool try_lock() BTPU_TRY_ACQUIRE(true) {
+#if defined(BTPU_SCHED)
+    if (sched::on()) sched::preempt(sched::Point::kLock, this);
+#endif
+    return m_.try_lock();
+  }
+  void unlock() BTPU_RELEASE() {
+    m_.unlock();
+#if defined(BTPU_SCHED)
+    // Any thread (enrolled or not) releasing wakes enrolled waiters; for
+    // an enrolled thread this is also a preemption point.
+    if (sched::armed()) sched::on_unlock(this);
+#endif
+  }
 
  private:
   std::mutex m_;
@@ -104,12 +137,52 @@ class BTPU_CAPABILITY("shared_mutex") SharedMutex {
   SharedMutex(const SharedMutex&) = delete;
   SharedMutex& operator=(const SharedMutex&) = delete;
 
-  void lock() BTPU_ACQUIRE() { m_.lock(); }
-  bool try_lock() BTPU_TRY_ACQUIRE(true) { return m_.try_lock(); }
-  void unlock() BTPU_RELEASE() { m_.unlock(); }
-  void lock_shared() BTPU_ACQUIRE_SHARED() { m_.lock_shared(); }
-  bool try_lock_shared() BTPU_TRY_ACQUIRE_SHARED(true) { return m_.try_lock_shared(); }
-  void unlock_shared() BTPU_RELEASE_SHARED() { m_.unlock_shared(); }
+  void lock() BTPU_ACQUIRE() {
+#if defined(BTPU_SCHED)
+    if (sched::on()) {
+      sched::acquire(sched::Point::kLock, this,
+                     [](void* m) { return static_cast<std::shared_mutex*>(m)->try_lock(); },
+                     &m_);
+      return;
+    }
+#endif
+    m_.lock();
+  }
+  bool try_lock() BTPU_TRY_ACQUIRE(true) {
+#if defined(BTPU_SCHED)
+    if (sched::on()) sched::preempt(sched::Point::kLock, this);
+#endif
+    return m_.try_lock();
+  }
+  void unlock() BTPU_RELEASE() {
+    m_.unlock();
+#if defined(BTPU_SCHED)
+    if (sched::armed()) sched::on_unlock(this);
+#endif
+  }
+  void lock_shared() BTPU_ACQUIRE_SHARED() {
+#if defined(BTPU_SCHED)
+    if (sched::on()) {
+      sched::acquire(
+          sched::Point::kLockShared, this,
+          [](void* m) { return static_cast<std::shared_mutex*>(m)->try_lock_shared(); }, &m_);
+      return;
+    }
+#endif
+    m_.lock_shared();
+  }
+  bool try_lock_shared() BTPU_TRY_ACQUIRE_SHARED(true) {
+#if defined(BTPU_SCHED)
+    if (sched::on()) sched::preempt(sched::Point::kLockShared, this);
+#endif
+    return m_.try_lock_shared();
+  }
+  void unlock_shared() BTPU_RELEASE_SHARED() {
+    m_.unlock_shared();
+#if defined(BTPU_SCHED)
+    if (sched::armed()) sched::on_unlock(this);
+#endif
+  }
 
  private:
   std::shared_mutex m_;
@@ -167,6 +240,103 @@ class BTPU_SCOPED_CAPABILITY SharedLock {
 
  private:
   std::shared_lock<SharedMutex> lk_;
+};
+
+// Condition variable for the annotated lock layer: the exact
+// std::condition_variable_any surface, plus btpu::sched preemption points
+// at wait/notify. Under an armed schedule-exploration run an enrolled
+// thread's wait parks in the SCHEDULER (registered before the lock is
+// released, so no wakeup can be lost to the scheduler itself), and timed
+// waits become virtual: wall time never passes — the scheduler chooses if
+// and when the timeout fires, which is what turns the sleep-calibrated
+// robustness fixtures into deterministic schedule searches. Unenrolled
+// threads (and release builds) use the inner std cv untouched, and
+// notify_* always signals both worlds.
+class CondVarAny {
+ public:
+  CondVarAny() = default;
+  CondVarAny(const CondVarAny&) = delete;
+  CondVarAny& operator=(const CondVarAny&) = delete;
+
+  void notify_one() noexcept {
+#if defined(BTPU_SCHED)
+    if (sched::armed()) sched::on_notify(this, /*all=*/false);
+#endif
+    cv_.notify_one();
+  }
+  void notify_all() noexcept {
+#if defined(BTPU_SCHED)
+    if (sched::armed()) sched::on_notify(this, /*all=*/true);
+#endif
+    cv_.notify_all();
+  }
+
+  template <typename Lock>
+  void wait(Lock& lk) {
+#if defined(BTPU_SCHED)
+    if (sched::on()) {
+      (void)scheduled_wait(lk, /*timed=*/false);
+      return;
+    }
+#endif
+    cv_.wait(lk);
+  }
+  template <typename Lock, typename Pred>
+  void wait(Lock& lk, Pred pred) {
+    while (!pred()) wait(lk);
+  }
+
+  template <typename Lock, typename Clock, typename Duration>
+  std::cv_status wait_until(Lock& lk, const std::chrono::time_point<Clock, Duration>& tp) {
+#if defined(BTPU_SCHED)
+    if (sched::on())
+      return scheduled_wait(lk, /*timed=*/true) ? std::cv_status::no_timeout
+                                                : std::cv_status::timeout;
+#endif
+    return cv_.wait_until(lk, tp);
+  }
+  template <typename Lock, typename Clock, typename Duration, typename Pred>
+  bool wait_until(Lock& lk, const std::chrono::time_point<Clock, Duration>& tp, Pred pred) {
+    while (!pred()) {
+      if (wait_until(lk, tp) == std::cv_status::timeout) return pred();
+    }
+    return true;
+  }
+
+  template <typename Lock, typename Rep, typename Period>
+  std::cv_status wait_for(Lock& lk, const std::chrono::duration<Rep, Period>& d) {
+#if defined(BTPU_SCHED)
+    if (sched::on())
+      return scheduled_wait(lk, /*timed=*/true) ? std::cv_status::no_timeout
+                                                : std::cv_status::timeout;
+#endif
+    return cv_.wait_for(lk, d);
+  }
+  template <typename Lock, typename Rep, typename Period, typename Pred>
+  bool wait_for(Lock& lk, const std::chrono::duration<Rep, Period>& d, Pred pred) {
+    // Anchor the deadline ONCE, exactly like std::condition_variable_any:
+    // re-waiting the full relative duration after every spurious/unmatched
+    // wakeup would make the total wait unbounded (a heartbeat loop could
+    // silently overshoot its TTL under wakeup pressure).
+    return wait_until(lk, std::chrono::steady_clock::now() + d, std::move(pred));
+  }
+
+ private:
+#if defined(BTPU_SCHED)
+  // Unlock/relock around the scheduler park. Net-neutral for the capability
+  // (released then reacquired before returning), which the analysis cannot
+  // see through a template lock parameter — same contract a cv wait always
+  // has, hence the escape hatch.
+  template <typename Lock>
+  bool scheduled_wait(Lock& lk, bool timed) BTPU_NO_THREAD_SAFETY_ANALYSIS {
+    auto ticket = sched::cv_register(this, timed);
+    lk.unlock();
+    const bool notified = sched::cv_park(ticket);
+    lk.lock();
+    return notified;
+  }
+#endif
+  std::condition_variable_any cv_;
 };
 
 }  // namespace btpu

@@ -86,6 +86,14 @@ std::string dump_spans_json(uint64_t trace_id = 0);
 // Spans recorded into the ring since process start (diagnostics/tests).
 uint64_t span_ring_recorded() noexcept;
 
+#if defined(BTPU_SCHED)
+// Test-only (schedule exploration): empties the span ring so the DFS model
+// check starts every enumerated schedule from the identical ring state —
+// stale live slots would both skew the yield-point tree between replays and
+// unbound the dump's preemption count.
+void span_ring_reset_for_test() noexcept;
+#endif
+
 // ---- slow-op surfacing -----------------------------------------------------
 // BTPU_TRACE_SLOW_US (0 = off): OpScope logs any op that closes slower,
 // with its trace id, and remembers the most recent ones here so tools can

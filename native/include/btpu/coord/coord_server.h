@@ -70,7 +70,7 @@ class CoordServer {
   // past the window is disconnected and re-syncs from a fresh snapshot.
   static constexpr size_t kReplBufferMax = 16384;
   Mutex repl_mutex_;
-  std::condition_variable_any repl_cv_;
+  CondVarAny repl_cv_;
   std::deque<std::pair<uint64_t, std::vector<uint8_t>>> repl_buffer_ BTPU_GUARDED_BY(repl_mutex_);
   size_t mirror_count_ BTPU_GUARDED_BY(repl_mutex_){0};  // buffer retained while > 0
 };

@@ -86,6 +86,7 @@ class MemCoordinator : public Coordinator {
   // fdatasync calls issued for WAL durability so far. The group-commit
   // acceptance signal: syncs/mutation < 1 proves batching regardless of
   // scheduler noise (sync-per-record mode reads ~1).
+  // ordering: relaxed — diagnostic gauge; durability is proven under sync_mutex_, not here.
   uint64_t wal_sync_count() const { return wal_syncs_.load(std::memory_order_relaxed); }
 
   // Recovery verdict, set once during construction (journal_load): OK;
@@ -222,7 +223,7 @@ class MemCoordinator : public Coordinator {
   // Group-commit rendezvous (leaf lock; see wait_durable above).
   bool group_commit_{false};  // resolved in ctor; immutable after
   mutable Mutex sync_mutex_ BTPU_ACQUIRED_AFTER(mutex_);
-  std::condition_variable_any sync_cv_;
+  CondVarAny sync_cv_;
   uint64_t sync_pending_ BTPU_GUARDED_BY(sync_mutex_){0};
   uint64_t sync_completed_ BTPU_GUARDED_BY(sync_mutex_){0};  // released waiters
   uint64_t sync_durable_ BTPU_GUARDED_BY(sync_mutex_){0};    // PROVEN synced
@@ -258,7 +259,7 @@ class MemCoordinator : public Coordinator {
 
   std::thread expiry_thread_;
   // condition_variable_any: waits on the annotated MutexLock (BasicLockable).
-  std::condition_variable_any expiry_cv_;
+  CondVarAny expiry_cv_;
   bool stopping_ BTPU_GUARDED_BY(mutex_){false};
 };
 

@@ -134,7 +134,7 @@ class RemoteCoordinator : public Coordinator {
 
   // Rendezvous for event-channel responses.
   Mutex resp_mutex_ BTPU_ACQUIRED_AFTER(event_write_mutex_);
-  std::condition_variable_any resp_cv_;
+  CondVarAny resp_cv_;
   bool resp_ready_ BTPU_GUARDED_BY(resp_mutex_){false};
   // Reader exited on connection loss: wake waiters.
   bool reader_dead_ BTPU_GUARDED_BY(resp_mutex_){false};

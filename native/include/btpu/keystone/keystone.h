@@ -74,9 +74,15 @@ class AtomicAccessStamp {
  public:
   using TimePoint = std::chrono::steady_clock::time_point;
   AtomicAccessStamp() = default;
+  // ordering: relaxed throughout — the stamp is a single 64-bit freshness
+  // hint folded by eviction scans; readers need any non-torn value, never
+  // an ordering edge with other state (copies are shard-lock-guarded).
+  // SchedDfs.AtomicAccessStamp enumerates store/load interleavings and pins
+  // value-set membership + per-reader coherence.
   AtomicAccessStamp(const AtomicAccessStamp& other)
       : rep_(other.rep_.load(std::memory_order_relaxed)) {}
   AtomicAccessStamp& operator=(const AtomicAccessStamp& other) {
+    // ordering: relaxed — see class comment above.
     rep_.store(other.rep_.load(std::memory_order_relaxed), std::memory_order_relaxed);
     return *this;
   }
@@ -85,9 +91,13 @@ class AtomicAccessStamp {
     return *this;
   }
   TimePoint load() const {
+    BTPU_ATOMIC_YIELD();
+    // ordering: relaxed — see class comment above.
     return TimePoint(TimePoint::duration(rep_.load(std::memory_order_relaxed)));
   }
   void store(TimePoint tp) const {
+    BTPU_ATOMIC_YIELD();
+    // ordering: relaxed — see class comment above.
     rep_.store(tp.time_since_epoch().count(), std::memory_order_relaxed);
   }
 
@@ -495,7 +505,7 @@ class KeystoneService {
   std::atomic<bool> is_leader_{false};
   std::atomic<uint64_t> leader_epoch_{0};  // fencing token from promotion
   std::thread gc_thread_, health_thread_, keepalive_thread_, scrub_thread_;
-  std::condition_variable_any stop_cv_;
+  CondVarAny stop_cv_;
   Mutex stop_mutex_;
 
   std::vector<coord::WatchId> watch_ids_;

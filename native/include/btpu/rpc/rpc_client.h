@@ -60,6 +60,7 @@ class KeystoneRpcClient {
   // Wire-protocol version the server reported in the last successful ping
   // (0 = never pinged, or the server predates the handshake).
   uint32_t server_proto_version() const noexcept {
+    // ordering: relaxed — advisory version cache (see the ping path).
     return server_proto_version_.load(std::memory_order_relaxed);
   }
 

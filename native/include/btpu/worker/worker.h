@@ -100,7 +100,7 @@ class WorkerService {
   std::thread heartbeat_thread_;
   // condition_variable_any: waits on the annotated Mutex (BasicLockable),
   // which plain condition_variable cannot.
-  std::condition_variable_any stop_cv_;
+  CondVarAny stop_cv_;
   Mutex stop_mutex_;
   bool initialized_{false};  // initialize()/start() sequencing, caller thread only
 };

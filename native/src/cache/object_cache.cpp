@@ -16,11 +16,13 @@ std::atomic<uint64_t> g_cached_ops{0}, g_cached_bytes{0};
 // One flight-recorder event per process-global miss: the op is about to
 // pay a wire round trip — exactly what a flight dump wants to show.
 void global_miss() noexcept {
+  // ordering: relaxed — monotonic stat counter; no payload is published through it.
   g_misses.fetch_add(1, std::memory_order_relaxed);
   flight::record(flight::Ev::kCacheMiss);
 }
 }  // namespace
 
+// ordering: relaxed — stat folds; a point-in-time scrape has no ordering needs.
 uint64_t cache_hit_count() noexcept { return g_hits.load(std::memory_order_relaxed); }
 uint64_t cache_miss_count() noexcept { return g_misses.load(std::memory_order_relaxed); }
 uint64_t cache_invalidation_count() noexcept {
@@ -29,6 +31,7 @@ uint64_t cache_invalidation_count() noexcept {
 uint64_t cache_stale_reject_count() noexcept {
   return g_stale_rejects.load(std::memory_order_relaxed);
 }
+// ordering: relaxed — stat folds; a point-in-time scrape has no ordering needs.
 uint64_t cached_op_count() noexcept { return g_cached_ops.load(std::memory_order_relaxed); }
 uint64_t cached_byte_count() noexcept {
   return g_cached_bytes.load(std::memory_order_relaxed);
@@ -38,6 +41,7 @@ uint64_t cached_byte_count() noexcept {
 // light op_end event. Misses record kCacheMiss (global_miss above) — they
 // are about to pay a wire round trip, where one event is invisible.
 void note_cached_serve(uint64_t served_bytes) noexcept {
+  // ordering: relaxed — monotonic stat counters; no payload is published through them.
   g_cached_ops.fetch_add(1, std::memory_order_relaxed);
   g_cached_bytes.fetch_add(served_bytes, std::memory_order_relaxed);
 }
@@ -102,6 +106,7 @@ void ObjectCache::evict_for_space_locked(Shard& s, uint64_t need) {
     EntryList& victims = !s.probation.empty() ? s.probation : s.protected_;
     if (victims.empty()) return;
     erase_locked(s, std::prev(victims.end()));
+    // ordering: relaxed — monotonic stat counter; entry payloads publish via the shard mutex.
     evictions_.fetch_add(1, std::memory_order_relaxed);
   }
 }
@@ -113,6 +118,7 @@ ObjectCache::Hit ObjectCache::lookup(const ObjectKey& key) {
     MutexLock lock(s.mutex);
     auto idx = s.index.find(key);
     if (idx == s.index.end()) {
+      // ordering: relaxed — monotonic stat counter; entry payloads publish via the shard mutex.
       misses_.fetch_add(1, std::memory_order_relaxed);
       global_miss();
       return hit;
@@ -125,12 +131,14 @@ ObjectCache::Hit ObjectCache::lookup(const ObjectKey& key) {
       // Lease lapsed: the caller must revalidate before serving. Not a miss
       // (the bytes may still be current) and not yet a hit.
       hit.outcome = Outcome::kExpired;
+      // ordering: relaxed — monotonic stat counter; entry payloads publish via the shard mutex.
       lease_expiries_.fetch_add(1, std::memory_order_relaxed);
       return hit;
     }
     promote_locked(s, it);
   }
   hit.outcome = Outcome::kHit;
+  // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
   hits_.fetch_add(1, std::memory_order_relaxed);
   g_hits.fetch_add(1, std::memory_order_relaxed);
   return hit;
@@ -144,6 +152,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
     MutexLock lock(s.mutex);
     auto idx = s.index.find(key);
     if (idx == s.index.end()) {
+      // ordering: relaxed — monotonic stat counter; entry payloads publish via the shard mutex.
       misses_.fetch_add(1, std::memory_order_relaxed);
       global_miss();
       return hit;
@@ -153,6 +162,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
       // The key mutated (or vanished) under us: structurally impossible to
       // serve — drop the entry and report a miss.
       erase_locked(s, it);
+      // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
       stale_rejects_.fetch_add(1, std::memory_order_relaxed);
       g_stale_rejects.fetch_add(1, std::memory_order_relaxed);
       misses_.fetch_add(1, std::memory_order_relaxed);
@@ -166,6 +176,7 @@ ObjectCache::Hit ObjectCache::lookup_validated(const ObjectKey& key,
     promote_locked(s, it);
   }
   hit.outcome = Outcome::kHit;
+  // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
   hits_.fetch_add(1, std::memory_order_relaxed);
   g_hits.fetch_add(1, std::memory_order_relaxed);
   return hit;
@@ -186,6 +197,7 @@ ObjectCache::Hit ObjectCache::peek(const ObjectKey& key) const {
 }
 
 void ObjectCache::count_revalidated_hit() {
+  // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
   hits_.fetch_add(1, std::memory_order_relaxed);
   g_hits.fetch_add(1, std::memory_order_relaxed);
 }
@@ -210,6 +222,7 @@ void ObjectCache::fill(const ObjectKey& key, const ObjectVersion& version,
   s.probation.push_front(
       {key, version, content_crc, std::move(bytes), deadline, /*is_protected=*/false});
   s.index[key] = s.probation.begin();
+  // ordering: relaxed — monotonic stat counter; entry payloads publish via the shard mutex.
   fills_.fetch_add(1, std::memory_order_relaxed);
 }
 
@@ -223,6 +236,7 @@ void ObjectCache::renew(const ObjectKey& key, const ObjectVersion& version,
   if (!(it->version == version)) {
     // Revalidation says the resident entry is someone else's bytes now.
     erase_locked(s, it);
+    // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
     stale_rejects_.fetch_add(1, std::memory_order_relaxed);
     g_stale_rejects.fetch_add(1, std::memory_order_relaxed);
     return;
@@ -236,6 +250,7 @@ void ObjectCache::invalidate(const ObjectKey& key) {
   auto idx = s.index.find(key);
   if (idx == s.index.end()) return;
   erase_locked(s, idx->second);
+  // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
   invalidations_.fetch_add(1, std::memory_order_relaxed);
   g_invalidations.fetch_add(1, std::memory_order_relaxed);
 }
@@ -246,6 +261,7 @@ void ObjectCache::invalidate_if_version(const ObjectKey& key, const ObjectVersio
   auto idx = s.index.find(key);
   if (idx == s.index.end() || !(idx->second->version == version)) return;
   erase_locked(s, idx->second);
+  // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
   invalidations_.fetch_add(1, std::memory_order_relaxed);
   g_invalidations.fetch_add(1, std::memory_order_relaxed);
 }
@@ -258,6 +274,7 @@ void ObjectCache::invalidate_all() {
     sp->protected_.clear();
     sp->index.clear();
     sp->bytes = sp->protected_bytes = 0;
+    // ordering: relaxed — monotonic stat counters; entry payloads publish via the shard mutex.
     invalidations_.fetch_add(n, std::memory_order_relaxed);
     g_invalidations.fetch_add(n, std::memory_order_relaxed);
   }
@@ -274,6 +291,7 @@ void ObjectCache::expire_all_leases() {
 
 CacheStats ObjectCache::stats() const {
   CacheStats out;
+  // ordering: relaxed — stat folds into one snapshot; exactly as consistent as any scrape.
   out.hits = hits_.load(std::memory_order_relaxed);
   out.misses = misses_.load(std::memory_order_relaxed);
   out.fills = fills_.load(std::memory_order_relaxed);

@@ -359,6 +359,7 @@ uint64_t btpu_cached_op_count(void) { return cache::cached_op_count(); }
 uint64_t btpu_cached_byte_count(void) { return cache::cached_byte_count(); }
 
 uint64_t btpu_deadline_exceeded_count(void) {
+  // ordering: relaxed — stat folds for the C API; point-in-time reads of monotonic counters (this block and the seven below).
   return robust_counters().deadline_exceeded.load(std::memory_order_relaxed);
 }
 uint64_t btpu_shed_count(void) {
@@ -368,6 +369,7 @@ uint64_t btpu_client_deadline_exceeded_count(void) {
   return robust_counters().client_deadline_exceeded.load(std::memory_order_relaxed);
 }
 uint64_t btpu_retry_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
   return robust_counters().retries.load(std::memory_order_relaxed);
 }
 uint64_t btpu_retry_budget_exhausted_count(void) {
@@ -377,12 +379,14 @@ uint64_t btpu_hedge_fired_count(void) {
   return robust_counters().hedges_fired.load(std::memory_order_relaxed);
 }
 uint64_t btpu_hedge_win_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
   return robust_counters().hedge_wins.load(std::memory_order_relaxed);
 }
 uint64_t btpu_breaker_trip_count(void) {
   return robust_counters().breaker_trips.load(std::memory_order_relaxed);
 }
 uint64_t btpu_breaker_skip_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
   return robust_counters().breaker_skips.load(std::memory_order_relaxed);
 }
 uint64_t btpu_persist_retry_backlog(void) {

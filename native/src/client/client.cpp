@@ -110,6 +110,7 @@ ObjectClient::~ObjectClient() {
   // Loser hedge attempts still reference this client's transport; wait for
   // them to drain into their discard buffers before tearing anything down.
   MutexLock lock(hedge_mutex_);
+  // ordering: acquire — pairs with the losers' acq_rel decrement: observing 0 means every loser's last touch of this client happened-before teardown.
   while (hedge_inflight_.load(std::memory_order_acquire) != 0) hedge_cv_.wait(lock);
 }
 
@@ -1083,6 +1084,7 @@ std::vector<size_t> ObjectClient::order_copies(const std::vector<CopyPlacement>&
     const std::string& ep = copy_endpoint(copies[i]);
     if (ep.empty()) return true;
     if (!breakers_.for_endpoint(ep)->open_now()) return true;
+    // ordering: relaxed — monotonic stat counter.
     robust_counters().breaker_skips.fetch_add(1, std::memory_order_relaxed);
     return false;
   });
@@ -1127,7 +1129,7 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
                                     const CopyPlacement** winner) {
   struct Race {
     Mutex m;
-    std::condition_variable_any cv;
+    CondVarAny cv;
     bool primary_done BTPU_GUARDED_BY(m){false};
     ErrorCode primary_ec BTPU_GUARDED_BY(m){ErrorCode::OK};
     // The primary fills a PRIVATE buffer: first-wins must never race the
@@ -1141,8 +1143,13 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
   // explicitly so its wire ops still carry the caller's budget.
   const Deadline op_deadline = current_op_deadline();
   if (!copy_endpoint(primary).empty()) breakers_.for_endpoint(copy_endpoint(primary))->allow();
+  // ordering: acq_rel — the increment must be visible before the spawned
+  // thread can decrement (release), and the destructor's acquire load of 0
+  // must see every loser's writes as retired.
   hedge_inflight_.fetch_add(1, std::memory_order_acq_rel);
+  BTPU_SCHED_DECL_SPAWN();
   std::thread([this, race, copy = primary, size, verify, op_deadline, t0] {
+    BTPU_SCHED_ADOPT_SPAWNED();
     OpDeadlineScope scope(op_deadline);
     const ErrorCode ec = transfer_copy_get(copy, race->primary_buf.data(), size, verify);
     record_copy_outcome(copy, ec, us_since(t0));
@@ -1152,11 +1159,29 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
       race->primary_done = true;
     }
     race->cv.notify_all();
+#if defined(BTPU_SCHED)
+    if (sched::mutant_enabled("hedge_notify_after_unlock")) {
+      // PLANTED MUTANT — the exact pre-PR-5 bug shape this block's comment
+      // below exists to prevent: decrement under the mutex but notify AFTER
+      // unlock. The destructor's drain loop may observe inflight == 0 in
+      // the unlock/notify window and free the client, so the notify below
+      // touches a destroyed hedge_cv_ (SchedMutants matrix detects this as
+      // an ASan heap-use-after-free within the seed budget).
+      {
+        MutexLock lock(hedge_mutex_);
+        // ordering: acq_rel — pairs with the destructor's acquire drain load.
+        hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      }
+      hedge_cv_.notify_all();
+      return;
+    }
+#endif
     {
       // Notify UNDER the mutex: the destructor's drain loop frees the client
       // the instant it observes inflight == 0, so a notify after unlock would
       // touch a destroyed condition variable.
       MutexLock lock(hedge_mutex_);
+      // ordering: acq_rel — pairs with the destructor's acquire drain load.
       hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
       hedge_cv_.notify_all();
     }
@@ -1183,6 +1208,7 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
       // ordinary failover, not a hedge.
     } else {
       hedged = true;
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().hedges_fired.fetch_add(1, std::memory_order_relaxed);
       flight::record(flight::Ev::kHedgeFired);
     }
@@ -1198,6 +1224,7 @@ ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
   MutexLock lock(race->m);
   if (sec_ec == ErrorCode::OK) {
     if (hedged && !race->primary_done) {
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().hedge_wins.fetch_add(1, std::memory_order_relaxed);
       flight::record(flight::Ev::kHedgeWin);
     }
@@ -1233,6 +1260,7 @@ ErrorCode ObjectClient::attempt_copies(const std::vector<CopyPlacement>& copies,
     // transfer nobody is waiting for (transport-independent: TCP ops also
     // carry the budget on the wire, but LOCAL/SHM have no wire to carry it).
     if (oi > 0 && current_op_deadline().expired()) {
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
       return ErrorCode::DEADLINE_EXCEEDED;
     }
@@ -1806,6 +1834,7 @@ std::optional<ErrorCode> ObjectClient::put_via_inline(const ObjectKey& key, cons
   const int64_t now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                              std::chrono::steady_clock::now().time_since_epoch())
                              .count();
+  // ordering: relaxed — advisory backoff gate: a stale read just means one extra (harmless) inline probe.
   if (now_ms < inline_retry_after_ms_.load(std::memory_order_relaxed)) return std::nullopt;
 
   invalidate_placements(key);  // same re-created-key rule as the normal path
@@ -1830,6 +1859,7 @@ std::optional<ErrorCode> ObjectClient::put_via_inline(const ObjectKey& key, cons
     const RetryPolicy probe{options_.inline_refusal_backoff_ms,
                             options_.inline_refusal_backoff_ms, 1.0, 1};
     inline_retry_after_ms_.store(now_ms + static_cast<int64_t>(probe.backoff_ms(0)),
+                                 // ordering: relaxed — advisory backoff gate (see the read above).
                                  std::memory_order_relaxed);
     return std::nullopt;
   }

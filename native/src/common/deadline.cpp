@@ -36,11 +36,13 @@ OpDeadlineScope::OpDeadlineScope(Deadline d) noexcept : saved_(t_op_deadline) {
 OpDeadlineScope::~OpDeadlineScope() { t_op_deadline = saved_; }
 
 void LatencyTracker::record_us(uint64_t us) noexcept {
+  // ordering: relaxed — lossy sampling ring: the claim only spreads writers across slots, and samples are single-word; a racing quantile fold reading a mix of generations is the accepted statistics of a sliding window.
   const size_t i = count_.fetch_add(1, std::memory_order_relaxed) % kRing;
   ring_[i].store(us == 0 ? 1 : us, std::memory_order_relaxed);
 }
 
 uint64_t LatencyTracker::quantile_us(double q, size_t min_samples) const noexcept {
+  // ordering: relaxed — quantile fold over the lossy ring (see record_us); any torn-free snapshot is a valid sample set.
   const size_t n = std::min(count_.load(std::memory_order_relaxed), kRing);
   if (n < min_samples || n == 0) return 0;
   uint64_t local[kRing];

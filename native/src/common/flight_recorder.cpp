@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "btpu/common/env.h"
+#include "btpu/common/sched.h"
 #include "btpu/common/trace.h"
 
 namespace btpu::flight {
@@ -86,17 +87,35 @@ void Recorder::record(Ev ev, uint64_t a0, uint64_t a1, uint64_t trace_id,
   // Round-robin stripe per thread (StripeCounter idiom): stable for the
   // thread's lifetime, spreads writers without a hash.
   static std::atomic<unsigned> next{0};
+  // ordering: relaxed — round-robin stripe assignment; any interleaving of the counter is a valid spreading.
   thread_local const unsigned sidx = next.fetch_add(1, std::memory_order_relaxed);
   Stripe& s = stripes_[sidx % nstripes_];
+  // ordering: relaxed claim — the index only partitions slots between
+  // writers; publication order is carried by each slot's seq, not the head.
   const uint64_t i = s.head.fetch_add(1, std::memory_order_relaxed);
   Slot& slot = s.slots[i & (per_stripe_ - 1)];
+  // The BTPU_ATOMIC_YIELD points mark the seqlock-lite protocol edges the
+  // DFS model check enumerates (SchedDfs.FlightRecorderSeqlock): claim /
+  // invalidate / payload / publish.
+  BTPU_ATOMIC_YIELD();
+  // ordering: release on seq=0 — the in-flight mark must not sink below a
+  // racing dumper's acquire re-read, or a torn payload could validate.
   slot.seq.store(0, std::memory_order_release);  // in flight
+  BTPU_ATOMIC_YIELD();
+  // ordering: relaxed payload stores — each field is its own atomic (no
+  // torn reads); cross-field consistency is proven by the seq protocol, so
+  // only the seq stores need ordering.
   slot.t_ns.store(t_ns, std::memory_order_relaxed);
   slot.trace_id.store(trace_id, std::memory_order_relaxed);
+  BTPU_ATOMIC_YIELD();
   slot.a0.store(a0, std::memory_order_relaxed);
   slot.a1.store(a1, std::memory_order_relaxed);
   slot.ev_tid.store((static_cast<uint64_t>(ev) << 56) | flight_tid(),
+                    // ordering: relaxed payload (cont.) — the seq bracket proves set-consistency.
                     std::memory_order_relaxed);
+  BTPU_ATOMIC_YIELD();
+  // ordering: release publish — orders every payload store above before the
+  // new seq; a dumper that acquire-loads this seq sees the whole payload.
   slot.seq.store(i + 1, std::memory_order_release);
 }
 
@@ -110,14 +129,23 @@ struct Snapped {
 
 // Snapshot one slot; false when in flight / overwritten mid-read.
 bool snap_slot(const Slot& slot, uint64_t want_seq, Snapped& out) noexcept {
+  // ordering: acquire validate — pairs with the writer's release publish so
+  // a matching seq proves the payload reads below see that generation.
   if (slot.seq.load(std::memory_order_acquire) != want_seq) return false;
+  BTPU_ATOMIC_YIELD();
+  // ordering: relaxed payload loads — single-field atomicity suffices; the
+  // bracketing seq loads decide whether the SET is consistent.
   out.t_ns = slot.t_ns.load(std::memory_order_relaxed);
   out.trace_id = slot.trace_id.load(std::memory_order_relaxed);
+  BTPU_ATOMIC_YIELD();
   out.a0 = slot.a0.load(std::memory_order_relaxed);
   out.a1 = slot.a1.load(std::memory_order_relaxed);
   const uint64_t et = slot.ev_tid.load(std::memory_order_relaxed);
   out.tid = static_cast<uint32_t>(et & 0xffffffffu);
   out.ev = static_cast<Ev>(et >> 56);
+  BTPU_ATOMIC_YIELD();
+  // ordering: acquire re-validate — any concurrent overwrite passed through
+  // seq=0 (release), so an unchanged nonzero seq rules out a mixed payload.
   return slot.seq.load(std::memory_order_acquire) == want_seq;
 }
 
@@ -138,6 +166,7 @@ std::string Recorder::dump_json(size_t max_events) const {
   events.reserve(256);
   for (size_t si = 0; si < nstripes_; ++si) {
     const Stripe& s = stripes_[si];
+    // ordering: acquire — bounds the scan at a head whose slots' seq stores are visible.
     const uint64_t head = s.head.load(std::memory_order_acquire);
     const uint64_t first = head > per_stripe_ ? head - per_stripe_ : 0;
     for (uint64_t i = first; i < head; ++i) {
@@ -168,6 +197,7 @@ void Recorder::dump_to_fd(int fd) const noexcept {
   char line[256];
   for (size_t si = 0; si < nstripes_; ++si) {
     const Stripe& s = stripes_[si];
+    // ordering: acquire — bounds the scan at a head whose slots' seq stores are visible.
     const uint64_t head = s.head.load(std::memory_order_acquire);
     const uint64_t first = head > per_stripe_ ? head - per_stripe_ : 0;
     for (uint64_t i = first; i < head; ++i) {
@@ -184,6 +214,7 @@ void Recorder::dump_to_fd(int fd) const noexcept {
 uint64_t Recorder::recorded() const noexcept {
   uint64_t sum = 0;
   for (size_t i = 0; i < nstripes_; ++i)
+    // ordering: relaxed — diagnostic fold of monotonic heads.
     sum += stripes_[i].head.load(std::memory_order_relaxed);
   return sum;
 }

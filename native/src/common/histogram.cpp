@@ -12,8 +12,17 @@ namespace btpu::hist {
 Histogram::Snapshot Histogram::snapshot() const noexcept {
   Snapshot s;
   for (const Stripe& st : stripes_) {
+    BTPU_ATOMIC_YIELD();
+    // ordering: relaxed folds — every counter is monotonic, so any
+    // interleaved fold is some valid scrape point; count/sum may disagree
+    // by in-flight samples in EITHER direction (sum lags a sample whose
+    // bucket is added but sum not yet; sum leads when a sample lands
+    // between this fold and the later sum fold) — pinned exhaustively by
+    // SchedDfs.HistogramStripes.
     for (size_t i = 0; i < kBucketCount; ++i)
       s.buckets[i] += st.buckets[i].load(std::memory_order_relaxed);
+    BTPU_ATOMIC_YIELD();
+    // ordering: relaxed — same monotonic-fold argument as the buckets above.
     s.sum_us += st.sum_us.load(std::memory_order_relaxed);
   }
   for (size_t i = 0; i < kBucketCount; ++i) s.count += s.buckets[i];
@@ -70,6 +79,7 @@ bool label_eq(const char* a, const char* b) {
 
 Histogram& get_histogram(const char* family, const char* help, const char* label_key,
                          const char* label_value) {
+  // ordering: acquire — lock-free read of the CAS-published series list: pairs with the release store below so a found node's fields are fully visible.
   for (Series* s = g_series_head.load(std::memory_order_acquire); s; s = s->next) {
     if (label_eq(s->family, family) && label_eq(s->label_key, label_key) &&
         label_eq(s->label_value, label_value))
@@ -77,12 +87,14 @@ Histogram& get_histogram(const char* family, const char* help, const char* label
   }
   MutexLock lock(g_register_mutex);
   // Re-check under the lock (two threads registering the same series).
+  // ordering: acquire — re-check under the registration mutex (double-checked publish).
   for (Series* s = g_series_head.load(std::memory_order_acquire); s; s = s->next) {
     if (label_eq(s->family, family) && label_eq(s->label_key, label_key) &&
         label_eq(s->label_value, label_value))
       return s->h;
   }
   Series* fresh = new Series{family, help, label_key, label_value, {}, nullptr};
+  // ordering: relaxed next-load (the mutex serializes writers) + release publish — readers' acquire sees the fresh node complete; insertion is head-only so the tail is immutable.
   fresh->next = g_series_head.load(std::memory_order_relaxed);
   g_series_head.store(fresh, std::memory_order_release);
   return fresh->h;
@@ -148,6 +160,7 @@ Histogram& uring_send() {
 void for_each_series(const std::function<void(const SeriesView&)>& fn) {
   // The list is newest-first; render registration order for stable output.
   std::vector<Series*> all;
+  // ordering: acquire — lock-free list read (see get_histogram).
   for (Series* s = g_series_head.load(std::memory_order_acquire); s; s = s->next)
     all.push_back(s);
   for (auto it = all.rbegin(); it != all.rend(); ++it) {

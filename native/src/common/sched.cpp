@@ -1,0 +1,689 @@
+// Schedule-exploration scheduler (see sched.h for the model). The whole
+// file is compiled only under BTPU_SCHED; release builds get an empty TU.
+//
+// Implementation shape: while a Run is armed, enrolled threads serialize on
+// a token — exactly one is in St::kRunning at a time, everyone else is
+// parked on a per-thread condition variable under one scheduler mutex. At
+// every preemption point the running thread returns the token and a policy
+// (seeded PCT priorities, or the DFS choice stack) picks the next holder.
+// Blocking operations never block for real: a contended annotated mutex
+// becomes a deterministic try_lock/park loop, a CondVarAny wait parks in
+// the scheduler until a notify (or, for timed waits, until the scheduler
+// chooses to fire the virtual timeout — wall time never passes).
+//
+// The scheduler's own primitives are deliberately the RAW std types: going
+// through the annotated/hooked wrappers would recurse straight back into
+// the scheduler (scripts/btpu_lint.py mutex-annotated-only allowlists this
+// file for exactly that reason).
+#include "btpu/common/sched.h"
+
+#if defined(BTPU_SCHED)
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+
+#include "btpu/common/env.h"
+
+namespace btpu::sched {
+
+std::atomic<bool> g_armed{false};
+
+ThreadState*& self_slot() noexcept {
+  thread_local ThreadState* s = nullptr;
+  return s;
+}
+
+struct ThreadState {
+  enum class St : uint8_t {
+    kRunnable,       // wants the token
+    kRunning,        // holds the token
+    kBlockedMutex,   // parked until on_unlock(wait_addr)
+    kBlockedCv,      // parked until on_notify(cv_addr)
+    kBlockedCvTimed, // parked, but the scheduler may fire the timeout
+    kFinished,
+  };
+
+  uint32_t id{0};
+  St st{St::kRunnable};
+  const void* wait_addr{nullptr};
+  Point point{Point::kYield};
+  // CondVar protocol state (valid while cv_armed).
+  bool cv_armed{false};
+  const void* cv_addr{nullptr};
+  bool cv_notified{false};
+  bool cv_timed{false};
+  bool cv_timeout_fired{false};
+  uint64_t priority{0};
+  std::condition_variable parked;
+};
+
+namespace {
+
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct Global {
+  std::mutex mu;
+  std::condition_variable any_cv;  // run-teardown + adoption rendezvous
+  bool run_active{false};
+  RunOptions opts;
+  std::vector<std::unique_ptr<ThreadState>> threads;
+  uint32_t enrolled{0};
+  bool started{false};
+  int running{-1};  // id of the token holder, -1 = idle
+  uint64_t steps{0};
+  uint64_t progress{0};  // bumps on every grant/state change (watchdog)
+  uint32_t pending_adopt{0};
+  uint32_t next_adopt_id{0};
+  uint32_t hang_ms{5000};
+  // PCT state.
+  std::vector<uint64_t> change_steps;  // sorted step indices
+  uint64_t low_priority_next{0};       // descending: preempted-at-change-point
+  // DFS state (valid when opts.mode == kDfs).
+  const std::vector<uint32_t>* dfs_prefix{nullptr};
+  std::vector<uint32_t> dfs_chosen;
+  std::vector<uint32_t> dfs_alts;
+  // Async-signal-safe failure banner, formatted at arm time.
+  char banner[192]{};
+  struct sigaction prev_sig[3]{};
+  bool sig_installed{false};
+};
+
+Global& g() {
+  static Global* instance = new Global();  // leaked: hooks may race teardown
+  return *instance;
+}
+
+const int kBannerSignals[3] = {SIGABRT, SIGSEGV, SIGBUS};
+
+void banner_handler(int sig, siginfo_t*, void*) {
+  Global& gl = g();
+  (void)!::write(2, gl.banner, ::strnlen(gl.banner, sizeof(gl.banner)));
+  for (int i = 0; i < 3; ++i) {
+    if (kBannerSignals[i] == sig) {
+      ::sigaction(sig, &gl.prev_sig[i], nullptr);
+      break;
+    }
+  }
+  ::raise(sig);
+}
+
+ThreadState* find_locked(Global& gl, uint32_t id) {
+  for (auto& t : gl.threads)
+    if (t->id == id) return t.get();
+  return nullptr;
+}
+
+bool is_candidate(const ThreadState& t) {
+  return t.st == ThreadState::St::kRunnable || t.st == ThreadState::St::kBlockedCvTimed;
+}
+
+const char* st_name(ThreadState::St st) {
+  switch (st) {
+    case ThreadState::St::kRunnable: return "runnable";
+    case ThreadState::St::kRunning: return "running";
+    case ThreadState::St::kBlockedMutex: return "blocked-mutex";
+    case ThreadState::St::kBlockedCv: return "blocked-cv";
+    case ThreadState::St::kBlockedCvTimed: return "blocked-cv-timed";
+    case ThreadState::St::kFinished: return "finished";
+  }
+  return "?";
+}
+
+const char* point_name(Point p) {
+  switch (p) {
+    case Point::kLock: return "lock";
+    case Point::kLockShared: return "lock-shared";
+    case Point::kUnlock: return "unlock";
+    case Point::kCvWait: return "cv-wait";
+    case Point::kCvNotify: return "cv-notify";
+    case Point::kAtomic: return "atomic";
+    case Point::kYield: return "yield";
+  }
+  return "?";
+}
+
+[[noreturn]] void die_locked(Global& gl, const char* why) {
+  std::fprintf(stderr, "%s", gl.banner);
+  std::fprintf(stderr, "BTPU_SCHED: %s (seed=%llu, step=%llu)\n", why,
+               static_cast<unsigned long long>(gl.opts.seed),
+               static_cast<unsigned long long>(gl.steps));
+  for (const auto& t : gl.threads) {
+    std::fprintf(stderr, "  thread %u: %s at %s addr=%p\n", t->id, st_name(t->st),
+                 point_name(t->point), t->wait_addr);
+  }
+  std::fflush(stderr);
+  std::abort();
+}
+
+// Picks the next token holder among the candidates; nullptr = idle. Called
+// with gl.mu held; consumes one DFS decision when >1 candidate.
+ThreadState* choose_locked(Global& gl) {
+  std::vector<ThreadState*> cand;
+  for (auto& t : gl.threads)
+    if (is_candidate(*t)) cand.push_back(t.get());
+  if (cand.empty()) return nullptr;
+  std::sort(cand.begin(), cand.end(),
+            [](const ThreadState* a, const ThreadState* b) { return a->id < b->id; });
+  if (cand.size() == 1) return cand.front();
+  if (gl.opts.mode == Mode::kDfs) {
+    const size_t decision = gl.dfs_chosen.size();
+    uint32_t idx = 0;
+    if (gl.dfs_prefix && decision < gl.dfs_prefix->size()) idx = (*gl.dfs_prefix)[decision];
+    if (idx >= cand.size()) {
+      // The replayed prefix saw MORE candidates here than this run does:
+      // the fixture is nondeterministic across replays, and silently
+      // redirecting the branch would corrupt the enumeration while still
+      // reporting complete=true — the exact silent-truncation lie the DFS
+      // mode exists to never tell. Convict loudly instead.
+      die_locked(gl, "DFS prefix index out of range — fixture is nondeterministic "
+                     "between replayed schedules");
+    }
+    gl.dfs_chosen.push_back(idx);
+    gl.dfs_alts.push_back(static_cast<uint32_t>(cand.size()));
+    return cand[idx];
+  }
+  // PCT: highest priority runs (ties impossible in practice — splitmix64).
+  ThreadState* best = cand.front();
+  for (ThreadState* t : cand)
+    if (t->priority > best->priority) best = t;
+  return best;
+}
+
+void grant_locked(Global& gl, ThreadState* t) {
+  ++gl.progress;
+  if (t->st == ThreadState::St::kBlockedCvTimed) {
+    // Chosen while un-notified: the virtual timeout fires NOW.
+    t->cv_timeout_fired = true;
+    t->cv_armed = false;
+  }
+  t->st = ThreadState::St::kRunning;
+  gl.running = static_cast<int>(t->id);
+  t->parked.notify_one();
+  gl.any_cv.notify_all();
+}
+
+// One scheduling step charged to the RUNNING thread `me`: PCT priority
+// change points apply here; the step budget is the livelock detector.
+void bump_step_locked(Global& gl, ThreadState* me) {
+  ++gl.steps;
+  if (gl.steps > gl.opts.max_steps)
+    die_locked(gl, "step budget exceeded — livelock or an unbounded scheduled loop");
+  if (gl.opts.mode == Mode::kPct &&
+      std::binary_search(gl.change_steps.begin(), gl.change_steps.end(), gl.steps)) {
+    me->priority = gl.low_priority_next--;
+  }
+}
+
+// Deterministic-start rendezvous: a decision must not race a declared
+// spawn, or the runnable set (and the whole schedule) would depend on how
+// fast the OS starts the new thread. Bounded so a spawn that dies before
+// adopting cannot wedge the run.
+void wait_adoptions_locked(Global& gl, std::unique_lock<std::mutex>& lk) {
+  if (gl.pending_adopt == 0) return;
+  gl.any_cv.wait_for(lk, std::chrono::milliseconds(2000),
+                     [&gl] { return gl.pending_adopt == 0; });
+}
+
+void park_until_running(Global& gl, std::unique_lock<std::mutex>& lk, ThreadState* me) {
+  uint64_t last_progress = gl.progress;
+  uint32_t stale_adopt_windows = 0;
+  while (me->st != ThreadState::St::kRunning) {
+    if (me->parked.wait_for(lk, std::chrono::milliseconds(gl.hang_ms)) ==
+        std::cv_status::timeout) {
+      if (me->st == ThreadState::St::kRunning) break;
+      if (gl.progress != last_progress) {
+        last_progress = gl.progress;
+        stale_adopt_windows = 0;
+        continue;  // someone is making progress; keep waiting
+      }
+      // No progress for a full hang window. If a candidate exists but the
+      // token is idle that is a scheduler bug — self-heal and note it;
+      // otherwise every controllable thread is blocked: deadlock verdict.
+      if (gl.running == -1 && gl.started) {
+        if (ThreadState* next = choose_locked(gl)) {
+          grant_locked(gl, next);
+          continue;
+        }
+        if (gl.pending_adopt == 0)
+          die_locked(gl, "deadlock — every enrolled thread is blocked and nothing can wake them");
+        // A declared spawn that never adopts (thread ctor threw, body died
+        // early) must become a verdict too, or it gates this watchdog off
+        // forever and a real deadlock hangs silently.
+        if (++stale_adopt_windows >= 3)
+          die_locked(gl, "declared spawn never adopted — pending_adopt stuck with no progress");
+      } else if (!gl.started) {
+        die_locked(gl, "enrollment barrier never completed — fewer threads enrolled than "
+                       "RunOptions.threads promised");
+      }
+      last_progress = gl.progress;
+    }
+  }
+}
+
+// The running thread offers the token at a preemption point.
+void yield_point_locked(Global& gl, std::unique_lock<std::mutex>& lk, ThreadState* me,
+                        Point p, const void* addr) {
+  bump_step_locked(gl, me);
+  me->point = p;
+  me->wait_addr = addr;
+  me->st = ThreadState::St::kRunnable;
+  gl.running = -1;
+  wait_adoptions_locked(gl, lk);
+  if (me->st == ThreadState::St::kRunning) {
+    // An external (unenrolled) unlock/notify saw the idle token and granted
+    // it to us while we waited on the adoption rendezvous — we already hold
+    // it; choosing again here would double-grant (or, with no other
+    // candidate, null-deref): the exactly-one-runner invariant lives here.
+    return;
+  }
+  ThreadState* next = choose_locked(gl);
+  if (next == me) {
+    me->st = ThreadState::St::kRunning;
+    gl.running = static_cast<int>(me->id);
+    ++gl.progress;
+    return;
+  }
+  grant_locked(gl, next);  // never null: me is still a candidate
+  park_until_running(gl, lk, me);
+}
+
+void enroll_locked(Global& gl, std::unique_lock<std::mutex>& lk, ThreadState* me) {
+  me->priority = splitmix64(gl.opts.seed ^ (0x51edULL + me->id));
+  gl.threads.emplace_back(me);
+  self_slot() = me;
+  ++gl.enrolled;
+  ++gl.progress;  // enrollment is progress: keeps the watchdog off slow spawns
+  me->st = ThreadState::St::kRunnable;
+  if (!gl.started) {
+    if (gl.opts.threads == 0 || gl.enrolled >= gl.opts.threads) {
+      gl.started = true;
+      ThreadState* first = choose_locked(gl);
+      if (first) grant_locked(gl, first);
+    }
+  } else if (gl.running == -1) {
+    ThreadState* next = choose_locked(gl);
+    if (next) grant_locked(gl, next);
+  }
+  park_until_running(gl, lk, me);
+}
+
+void retire_locked(Global& gl, ThreadState* me) {
+  me->st = ThreadState::St::kFinished;
+  me->cv_armed = false;
+  self_slot() = nullptr;
+  if (gl.running == static_cast<int>(me->id)) gl.running = -1;
+  ++gl.progress;
+  ThreadState* next = choose_locked(gl);
+  if (next && gl.running == -1) grant_locked(gl, next);
+  gl.any_cv.notify_all();
+}
+
+void arm(const RunOptions& options) {
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (gl.run_active) die_locked(gl, "nested sched::Run — one run at a time per process");
+  gl.opts = options;
+  gl.threads.clear();
+  gl.enrolled = 0;
+  gl.started = false;
+  gl.running = -1;
+  gl.steps = 0;
+  gl.progress = 0;
+  gl.pending_adopt = 0;
+  gl.next_adopt_id = options.threads == 0 ? 1000 : options.threads + 1000;
+  gl.hang_ms = env_u32("BTPU_SCHED_HANG_MS", options.hang_ms);
+  gl.opts.max_steps = env_u64("BTPU_SCHED_MAX_STEPS", options.max_steps);
+  gl.change_steps.clear();
+  gl.dfs_prefix = nullptr;
+  gl.dfs_chosen.clear();
+  gl.dfs_alts.clear();
+  if (options.mode == Mode::kPct) {
+    // d-1 priority-change points sampled from the estimated step range.
+    uint64_t x = splitmix64(options.seed);
+    for (uint32_t i = 1; i < options.pct_depth; ++i) {
+      x = splitmix64(x);
+      gl.change_steps.push_back(1 + x % std::max<uint32_t>(options.pct_steps, 1));
+    }
+    std::sort(gl.change_steps.begin(), gl.change_steps.end());
+    gl.low_priority_next = options.pct_depth;  // below every splitmix priority
+  }
+  if (options.mode == Mode::kDfs) {
+    // BTPU_SCHED_SEED is inert in DFS mode (the "seed" is just the schedule
+    // ordinal) — telling the operator to set it would send them down a dead
+    // runbook path; the deterministic enumeration itself is the replay.
+    std::snprintf(gl.banner, sizeof(gl.banner),
+                  "\nBTPU_SCHED: failure under DFS schedule ordinal %llu — re-run the "
+                  "same fixture; the enumeration is deterministic\n",
+                  static_cast<unsigned long long>(options.seed));
+  } else {
+    std::snprintf(gl.banner, sizeof(gl.banner),
+                  "\nBTPU_SCHED: failure under schedule control — BTPU_SCHED_SEED=%llu "
+                  "(mode=pct) replays this interleaving\n",
+                  static_cast<unsigned long long>(options.seed));
+  }
+  struct sigaction sa {};
+  sa.sa_sigaction = banner_handler;
+  sa.sa_flags = SA_SIGINFO;
+  sigemptyset(&sa.sa_mask);
+  for (int i = 0; i < 3; ++i) ::sigaction(kBannerSignals[i], &sa, &gl.prev_sig[i]);
+  gl.sig_installed = true;
+  gl.run_active = true;
+  g_armed.store(true, std::memory_order_seq_cst);
+}
+
+void disarm() {
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  // Every enrolled thread — including adopted detached ones — must retire
+  // before control-flow leaves the run; scheduling keeps running meanwhile,
+  // driven by the threads themselves.
+  const auto all_done = [&gl] {
+    if (gl.pending_adopt != 0) return false;
+    for (const auto& t : gl.threads)
+      if (t->st != ThreadState::St::kFinished) return false;
+    return true;
+  };
+  uint64_t last_progress = gl.progress;
+  uint32_t stale_adopt_windows = 0;
+  while (!all_done()) {
+    if (gl.any_cv.wait_for(lk, std::chrono::milliseconds(gl.hang_ms)) ==
+        std::cv_status::timeout) {
+      if (gl.progress != last_progress) {
+        last_progress = gl.progress;
+        stale_adopt_windows = 0;
+        continue;
+      }
+      if (gl.pending_adopt != 0) {
+        // No progress AND a declared spawn that never adopted: bounded
+        // patience, then a verdict — an infinite wait here would hang the
+        // Run destructor with no banner (the one failure mode worse than
+        // aborting).
+        if (++stale_adopt_windows >= 3)
+          die_locked(gl, "teardown: declared spawn never adopted — pending_adopt stuck");
+        continue;
+      }
+      if (gl.running == -1) {
+        if (ThreadState* next = choose_locked(gl)) {
+          grant_locked(gl, next);
+          continue;
+        }
+        die_locked(gl, "teardown deadlock — enrolled threads never retired");
+      }
+      last_progress = gl.progress;
+    }
+  }
+  g_armed.store(false, std::memory_order_seq_cst);
+  gl.run_active = false;
+  gl.threads.clear();
+  if (gl.sig_installed) {
+    for (int i = 0; i < 3; ++i) ::sigaction(kBannerSignals[i], &gl.prev_sig[i], nullptr);
+    gl.sig_installed = false;
+  }
+}
+
+}  // namespace
+
+// ---- hook entry points -----------------------------------------------------
+
+void preempt(Point p, const void* addr) noexcept {
+  ThreadState* me = self_slot();
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active || me == nullptr) return;
+  yield_point_locked(gl, lk, me, p, addr);
+}
+
+void acquire(Point p, const void* addr, bool (*try_fn)(void*), void* m) noexcept {
+  ThreadState* me = self_slot();
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active || me == nullptr) {
+    lk.unlock();
+    // Raced a disarm: fall back to a plain blocking acquire via try-spin
+    // (the caller already committed to the scheduled path).
+    while (!try_fn(m)) ::usleep(100);
+    return;
+  }
+  for (;;) {
+    // The decision point sits BEFORE the acquisition attempt: whoever runs
+    // next may take the lock first — that is the interleaving under test.
+    yield_point_locked(gl, lk, me, p, addr);
+    if (try_fn(m)) return;  // nonblocking probe; scheduler lock held is fine
+    me->point = p;
+    me->wait_addr = addr;
+    me->st = ThreadState::St::kBlockedMutex;
+    gl.running = -1;
+    ++gl.progress;
+    ThreadState* next = choose_locked(gl);
+    if (next) grant_locked(gl, next);
+    park_until_running(gl, lk, me);
+  }
+}
+
+void on_unlock(const void* addr) noexcept {
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active) return;
+  bool woke = false;
+  for (auto& t : gl.threads) {
+    if (t->st == ThreadState::St::kBlockedMutex && t->wait_addr == addr) {
+      t->st = ThreadState::St::kRunnable;
+      woke = true;
+    }
+  }
+  if (woke) ++gl.progress;
+  ThreadState* me = self_slot();
+  if (me != nullptr && me->st == ThreadState::St::kRunning) {
+    yield_point_locked(gl, lk, me, Point::kUnlock, addr);
+  } else if (gl.running == -1 && gl.started) {
+    // An unenrolled thread released a lock enrolled threads were parked on
+    // while the token was idle: hand it to whoever the policy picks.
+    if (ThreadState* next = choose_locked(gl)) grant_locked(gl, next);
+  }
+}
+
+CvWaitTicket cv_register(const void* cv_addr, bool timed) noexcept {
+  ThreadState* me = self_slot();
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active || me == nullptr) return CvWaitTicket{};
+  me->cv_armed = true;
+  me->cv_addr = cv_addr;
+  me->cv_notified = false;
+  me->cv_timed = timed;
+  me->cv_timeout_fired = false;
+  return CvWaitTicket{me};
+}
+
+bool cv_park(CvWaitTicket t) noexcept {
+  ThreadState* me = static_cast<ThreadState*>(t.rep);
+  if (me == nullptr) return true;
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active) return true;
+  bump_step_locked(gl, me);
+  if (me->cv_notified) {  // notify landed between register and park
+    me->cv_armed = false;
+    return true;
+  }
+  me->point = Point::kCvWait;
+  me->wait_addr = me->cv_addr;
+  me->st = me->cv_timed ? ThreadState::St::kBlockedCvTimed : ThreadState::St::kBlockedCv;
+  gl.running = -1;
+  ++gl.progress;
+  wait_adoptions_locked(gl, lk);
+  if (me->st != ThreadState::St::kRunning) {
+    // Same external-grant window as yield_point_locked: an unenrolled
+    // notify during the adoption rendezvous may have woken AND granted us
+    // already — only choose a successor if we are genuinely parked.
+    ThreadState* next = choose_locked(gl);
+    if (next) grant_locked(gl, next);
+    park_until_running(gl, lk, me);
+  }
+  me->cv_armed = false;
+  return me->cv_notified && !me->cv_timeout_fired;
+}
+
+void on_notify(const void* cv_addr, bool all) noexcept {
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active) return;
+  // notify_one targets the lowest-id waiter — deterministic by design (the
+  // DFS bound does not enumerate notify targets; documented in §10).
+  std::vector<ThreadState*> waiters;
+  for (auto& t : gl.threads) {
+    if (t->cv_armed && t->cv_addr == cv_addr && !t->cv_notified)
+      waiters.push_back(t.get());
+  }
+  std::sort(waiters.begin(), waiters.end(),
+            [](const ThreadState* a, const ThreadState* b) { return a->id < b->id; });
+  if (!all && waiters.size() > 1) waiters.resize(1);
+  bool woke = false;
+  for (ThreadState* w : waiters) {
+    w->cv_notified = true;
+    if (w->st == ThreadState::St::kBlockedCv || w->st == ThreadState::St::kBlockedCvTimed) {
+      w->st = ThreadState::St::kRunnable;
+      woke = true;
+    }
+  }
+  if (woke) ++gl.progress;
+  ThreadState* me = self_slot();
+  if (me != nullptr && me->st == ThreadState::St::kRunning) {
+    yield_point_locked(gl, lk, me, Point::kCvNotify, cv_addr);
+  } else if (gl.running == -1 && gl.started) {
+    if (ThreadState* next = choose_locked(gl)) grant_locked(gl, next);
+  }
+}
+
+// ---- enrollment ------------------------------------------------------------
+
+Enroll::Enroll(uint32_t id) noexcept {
+  if (!armed()) return;
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active || self_slot() != nullptr) return;
+  if (find_locked(gl, id) != nullptr) die_locked(gl, "duplicate sched::Enroll id");
+  auto* t = new ThreadState();
+  t->id = id;
+  active_ = true;
+  enroll_locked(gl, lk, t);
+}
+
+Enroll::~Enroll() {
+  if (!active_) return;
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  ThreadState* me = self_slot();
+  if (!gl.run_active || me == nullptr) return;
+  retire_locked(gl, me);
+}
+
+void decl_spawn() noexcept {
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active) return;
+  ++gl.pending_adopt;
+}
+
+AdoptScope::AdoptScope() noexcept {
+  if (!armed()) return;
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  if (!gl.run_active || gl.pending_adopt == 0 || self_slot() != nullptr) return;
+  --gl.pending_adopt;
+  auto* t = new ThreadState();
+  t->id = gl.next_adopt_id++;
+  active_ = true;
+  gl.any_cv.notify_all();  // decision points rendezvous on pending_adopt
+  enroll_locked(gl, lk, t);
+}
+
+AdoptScope::~AdoptScope() {
+  if (!active_) return;
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  ThreadState* me = self_slot();
+  if (!gl.run_active || me == nullptr) return;
+  retire_locked(gl, me);
+}
+
+// ---- run control -----------------------------------------------------------
+
+Run::Run(const RunOptions& options) { arm(options); }
+Run::~Run() { disarm(); }
+
+uint64_t current_seed() noexcept {
+  Global& gl = g();
+  std::unique_lock<std::mutex> lk(gl.mu);
+  return gl.run_active ? gl.opts.seed : 0;
+}
+
+ExploreResult explore_dfs(const ExploreOptions& options,
+                          const std::function<void()>& fixture) {
+  ExploreResult result;
+  const uint64_t max_schedules =
+      options.max_schedules != 0 ? options.max_schedules
+                                 : env_u64("BTPU_SCHED_DFS_MAX", 200000);
+  std::vector<uint32_t> prefix;
+  for (;;) {
+    RunOptions ro;
+    ro.mode = Mode::kDfs;
+    ro.threads = options.threads;
+    ro.seed = result.schedules + 1;  // schedule ordinal, printed on failure
+    ro.max_steps = options.max_steps;
+    std::vector<uint32_t> chosen, alts;
+    {
+      Run run(ro);
+      {
+        Global& gl = g();
+        std::unique_lock<std::mutex> lk(gl.mu);
+        gl.dfs_prefix = &prefix;
+      }
+      fixture();
+      Global& gl = g();
+      std::unique_lock<std::mutex> lk(gl.mu);
+      // disarm() has not run yet (Run is alive); the choice log is intact.
+      chosen = gl.dfs_chosen;
+      alts = gl.dfs_alts;
+      gl.dfs_prefix = nullptr;
+    }
+    ++result.schedules;
+    result.max_decisions = std::max<uint64_t>(result.max_decisions, chosen.size());
+    // Backtrack: deepest decision with an unexplored sibling.
+    size_t i = chosen.size();
+    while (i > 0 && chosen[i - 1] + 1 >= alts[i - 1]) --i;
+    if (i == 0) {
+      result.complete = true;
+      break;
+    }
+    prefix.assign(chosen.begin(), chosen.begin() + static_cast<ptrdiff_t>(i));
+    ++prefix.back();
+    if (result.schedules >= max_schedules) {
+      result.complete = false;  // truncated: callers MUST fail on this
+      break;
+    }
+  }
+  return result;
+}
+
+bool mutant_enabled(const char* name) noexcept {
+  static const char* armed_mutant = env_str("BTPU_SCHED_MUTANT");
+  return armed_mutant != nullptr && std::strcmp(armed_mutant, name) == 0;
+}
+
+}  // namespace btpu::sched
+
+#endif  // BTPU_SCHED

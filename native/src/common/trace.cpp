@@ -31,6 +31,7 @@ std::atomic<const char*> g_proc_name{"proc"};
 // on every hot-path event).
 void init_switches() {
   static const bool once = [] {
+    // ordering: relaxed — master switches; single word each, latched once, no payload ordered through them.
     g_enabled.store(env_bool("BTPU_TRACING", true), std::memory_order_relaxed);
     g_slow_us.store(env_u64("BTPU_TRACE_SLOW_US", 0), std::memory_order_relaxed);
     return true;
@@ -77,16 +78,29 @@ struct SpanRing {
 
   void push(const char* name, uint64_t trace, uint64_t span, uint64_t parent,
             uint64_t start, uint64_t dur) noexcept {
+    // ordering: relaxed claim — the head only hands out slot indices;
+    // publication rides each slot's seq (same protocol as flight_recorder,
+    // DFS-checked by SchedDfs.SpanRingSeqlock).
     const uint64_t i = head.fetch_add(1, std::memory_order_relaxed);
     SpanSlot& s = slots[i & mask];
+    BTPU_ATOMIC_YIELD();
+    // ordering: release seq=0 — invalidate must be visible before any new
+    // payload field, so a dumper can never validate a mixed generation.
     s.seq.store(0, std::memory_order_release);  // in flight: dumpers skip
+    BTPU_ATOMIC_YIELD();
+    // ordering: relaxed payload — per-field atomics; set-consistency is the
+    // seq bracket's job, not the fields'.
     s.trace_id.store(trace, std::memory_order_relaxed);
     s.span_id.store(span, std::memory_order_relaxed);
     s.parent_id.store(parent, std::memory_order_relaxed);
+    BTPU_ATOMIC_YIELD();
     s.start_ns.store(start, std::memory_order_relaxed);
     s.dur_ns.store(dur, std::memory_order_relaxed);
     s.name.store(name, std::memory_order_relaxed);
+    // ordering: relaxed payload (cont.) — per-field atomics; the seq bracket proves set-consistency.
     s.tid.store(cached_tid(), std::memory_order_relaxed);
+    BTPU_ATOMIC_YIELD();
+    // ordering: release publish — pairs with the dumper's acquire loads.
     s.seq.store(i + 1, std::memory_order_release);
   }
 };
@@ -205,28 +219,34 @@ double percentile_of(std::vector<double>& sorted, double p) {
 
 bool enabled() noexcept {
   init_switches();
+  // ordering: relaxed — master-switch read; one word, nothing published through it.
   return g_enabled.load(std::memory_order_relaxed);
 }
 
 void set_enabled(bool on) noexcept {
   init_switches();
+  // ordering: relaxed — master-switch write; readers need the new value eventually, not an edge.
   g_enabled.store(on, std::memory_order_relaxed);
 }
 
 uint64_t slow_threshold_us() noexcept {
   init_switches();
+  // ordering: relaxed — threshold read; one word, advisory.
   return g_slow_us.load(std::memory_order_relaxed);
 }
 
 void set_slow_threshold_us(uint64_t us) noexcept {
   init_switches();
+  // ordering: relaxed — threshold write; advisory knob.
   g_slow_us.store(us, std::memory_order_relaxed);
 }
 
 void set_process_name(const char* name) noexcept {
+  // ordering: relaxed — the name is a string LITERAL (static storage): the pointer is the whole payload.
   g_proc_name.store(name, std::memory_order_relaxed);
 }
 
+// ordering: relaxed — literal pointer read (see set_process_name).
 const char* process_name() noexcept { return g_proc_name.load(std::memory_order_relaxed); }
 
 // ---- ids + clock -----------------------------------------------------------
@@ -267,11 +287,24 @@ uint64_t record_remote_span(const char* name, uint64_t trace_id, uint64_t parent
 }
 
 uint64_t span_ring_recorded() noexcept {
+  // ordering: relaxed — diagnostic count; no payload is read through it.
   return SpanRing::instance().head.load(std::memory_order_relaxed);
 }
 
+#if defined(BTPU_SCHED)
+void span_ring_reset_for_test() noexcept {
+  SpanRing& ring = SpanRing::instance();
+  // ordering: relaxed throughout — test-only quiescent reset (no concurrent
+  // writers by contract); values need only be plain-visible afterwards.
+  for (size_t i = 0; i <= ring.mask; ++i)
+    ring.slots[i].seq.store(0, std::memory_order_relaxed);
+  ring.head.store(0, std::memory_order_relaxed);
+}
+#endif
+
 std::string dump_spans_json(uint64_t trace_id) {
   SpanRing& ring = SpanRing::instance();
+  // ordering: acquire — bounds the scan at a head whose slots' seq stores are visible.
   const uint64_t head = ring.head.load(std::memory_order_acquire);
   const size_t cap = ring.mask + 1;
   const uint64_t first = head > cap ? head - cap : 0;
@@ -282,15 +315,22 @@ std::string dump_spans_json(uint64_t trace_id) {
   char tb[17], sb[17], pb[17];
   for (uint64_t i = first; i < head; ++i) {
     SpanSlot& s = ring.slots[i & ring.mask];
+    // ordering: acquire validate/re-validate bracket around relaxed payload
+    // loads — the writer's release pair makes an unchanged seq prove a
+    // single-generation snapshot (§9; DFS-checked).
     const uint64_t seq = s.seq.load(std::memory_order_acquire);
     if (seq != i + 1) continue;  // overwritten or in flight
+    BTPU_ATOMIC_YIELD();
     const uint64_t tr = s.trace_id.load(std::memory_order_relaxed);
     const uint64_t span = s.span_id.load(std::memory_order_relaxed);
     const uint64_t parent = s.parent_id.load(std::memory_order_relaxed);
+    // ordering: relaxed payload (cont.) — the seq bracket below decides validity.
     const uint64_t start = s.start_ns.load(std::memory_order_relaxed);
     const uint64_t dur = s.dur_ns.load(std::memory_order_relaxed);
     const char* name = s.name.load(std::memory_order_relaxed);
     const uint32_t tid = s.tid.load(std::memory_order_relaxed);
+    // ordering: relaxed payload (cont.) — the seq bracket below decides validity.
+    BTPU_ATOMIC_YIELD();
     if (s.seq.load(std::memory_order_acquire) != i + 1) continue;  // torn: drop
     if (trace_id != 0 && tr != trace_id) continue;
     if (!name) continue;

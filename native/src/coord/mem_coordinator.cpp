@@ -243,6 +243,7 @@ void MemCoordinator::journal_append_locked(const std::vector<uint8_t>& record) {
         journal_op_failed_ = true;
         return;
       }
+      // ordering: relaxed — monotonic stat counter (durability is proven by sync_durable_ under sync_mutex_, not this).
       wal_syncs_.fetch_add(1, std::memory_order_relaxed);
       const uint64_t sync_us = (trace::now_ns() - sync_t0) / 1000;
       hist::wal_sync().record_us(sync_us);
@@ -285,6 +286,7 @@ bool MemCoordinator::wait_durable(uint64_t seq) {
     const uint64_t sync_t0 = trace::now_ns();
     const bool synced = fd >= 0 && ::fdatasync(fd) == 0;
     if (synced) {
+      // ordering: relaxed — monotonic stat counter (see the inline-sync path).
       wal_syncs_.fetch_add(1, std::memory_order_relaxed);
       const uint64_t sync_us = (trace::now_ns() - sync_t0) / 1000;
       hist::wal_sync().record_us(sync_us);

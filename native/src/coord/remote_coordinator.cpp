@@ -256,6 +256,7 @@ ErrorCode RemoteCoordinator::event_call_raw(uint8_t opcode, const std::vector<ui
   // before the request is even framed.
   const Deadline ambient = current_op_deadline();
   if (ambient.expired()) {
+    // ordering: relaxed — monotonic stat counter.
     robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
     return ErrorCode::DEADLINE_EXCEEDED;
   }

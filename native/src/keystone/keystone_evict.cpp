@@ -283,7 +283,17 @@ KeystoneService::DemoteOutcome KeystoneService::demote_object(const ObjectKey& k
   ObjectShard& s = shard_for(key);
   WriterLock lock(s.mutex);
   auto it = s.map.find(key);
-  if (it == s.map.end() || it->second.epoch != epoch_snap) {
+#if defined(BTPU_SCHED)
+  // PLANTED MUTANT — ABA/lost-update class (the race the epoch exists to
+  // kill): splice the staged placements in WITHOUT re-checking the epoch,
+  // so a remove+re-put that landed during the unlocked byte move gets its
+  // placements clobbered by the old object's staging. The SchedMutants
+  // matrix detects it as a read-back mismatch within the seed budget.
+  const bool skip_epoch_check = sched::mutant_enabled("demote_skip_epoch_check");
+#else
+  constexpr bool skip_epoch_check = false;
+#endif
+  if (it == s.map.end() || (!skip_epoch_check && it->second.epoch != epoch_snap)) {
     lock.unlock();
     warn_if_error(adapter_.free_object(staging_key), "demote staging free");
     return DemoteOutcome::kSkipped;

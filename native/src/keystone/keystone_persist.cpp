@@ -348,6 +348,7 @@ std::atomic<uint64_t> g_persist_retry_backlog{0};
 }  // namespace
 
 uint64_t persist_retry_backlog_process_total() {
+  // ordering: relaxed — gauge read; the retry sets themselves are mutex-guarded.
   return g_persist_retry_backlog.load(std::memory_order_relaxed);
 }
 
@@ -358,6 +359,7 @@ size_t KeystoneService::persist_retry_backlog() const {
 
 void KeystoneService::drain_persist_retry() {
   MutexLock lock(persist_retry_mutex_);
+  // ordering: relaxed — gauge tracking the mutex-guarded set; the set is the truth.
   g_persist_retry_backlog.fetch_sub(persist_retry_.size(), std::memory_order_relaxed);
   persist_retry_.clear();
 }
@@ -396,6 +398,7 @@ void KeystoneService::mark_persist_dirty(const ObjectKey& key) {
   if (!coordinator_ || !config_.persist_objects) return;
   MutexLock lock(persist_retry_mutex_);
   if (persist_retry_.insert(key).second)
+    // ordering: relaxed — gauge tracking the mutex-guarded set; the set is the truth.
     g_persist_retry_backlog.fetch_add(1, std::memory_order_relaxed);
 }
 
@@ -441,6 +444,7 @@ void KeystoneService::retry_dirty_persists() {
       // persist racing this loop) cannot be interleaved and wiped here.
       MutexLock dirty(persist_retry_mutex_);
       if (persist_retry_.erase(key))
+        // ordering: relaxed — gauge tracking the mutex-guarded set; the set is the truth.
         g_persist_retry_backlog.fetch_sub(1, std::memory_order_relaxed);
       if (caught_up) {
         LOG_INFO << "durable record for " << key << " caught up after deferred persist";

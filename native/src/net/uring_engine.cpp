@@ -218,6 +218,7 @@ class Ring {
   }
 
   unsigned drain(io_uring_cqe* out, unsigned max) {
+    // ordering: relaxed head (only this thread advances it) + acquire tail — pairs with the kernel's release publish of new CQEs, so the entries read below are fully written.
     unsigned head = cq_head_->load(std::memory_order_relaxed);
     const unsigned tail = cq_tail_->load(std::memory_order_acquire);
     unsigned n = 0;
@@ -225,6 +226,7 @@ class Ring {
       out[n++] = cqes_[head & cq_mask_];
       ++head;
     }
+    // ordering: release — returns the consumed slots to the kernel only after the copies above complete.
     cq_head_->store(head, std::memory_order_release);
     return n;
   }
@@ -259,12 +261,14 @@ class Ring {
   }
 
   bool try_place(const io_uring_sqe& sqe) {
+    // ordering: acquire head — pairs with the kernel's release as it frees SQ slots; relaxed tail (only this thread advances it).
     const unsigned head = sq_head_->load(std::memory_order_acquire);
     const unsigned tail = sq_tail_->load(std::memory_order_relaxed);
     if (tail - head >= sq_entries_) return false;
     const unsigned idx = tail & sq_mask_;
     sqes_[idx] = sqe;
     sq_array_[idx] = idx;
+    // ordering: release — publishes the fully-written SQE before the kernel can observe the new tail.
     sq_tail_->store(tail + 1, std::memory_order_release);
     ++staged_;
     return true;
@@ -345,7 +349,7 @@ class ExecPool {
   }
 
   Mutex mutex_;
-  std::condition_variable_any cv_;
+  CondVarAny cv_;
   std::deque<std::function<void()>> tasks_ BTPU_GUARDED_BY(mutex_);
   std::vector<std::thread> threads_ BTPU_GUARDED_BY(mutex_);
   bool stop_ BTPU_GUARDED_BY(mutex_){false};
@@ -484,6 +488,7 @@ class UringLoop {
     // Counted BEFORE the thread spawns so uring_active_loop_count() is
     // accurate the moment create() returns (benches/tests read it right
     // after server start); the loop decrements on exit.
+    // ordering: relaxed — diagnostic loop counter (tests/benches poll it); no state is published through it.
     g_active_loops.fetch_add(1, std::memory_order_relaxed);
     thread_ = std::thread([this] {
       run();
@@ -492,6 +497,7 @@ class UringLoop {
   }
 
   void request_stop() {
+    // ordering: release — pairs with the loop's acquire poll so everything written before the stop request is visible when the loop observes it.
     stop_.store(true, std::memory_order_release);
     wake();
   }
@@ -854,10 +860,12 @@ class UringLoop {
     // server — a multi-loop engine must not multiply it. Shed order under
     // pressure is oldest-of-THIS-loop (cross-loop oldest would need a
     // shared structure on the hot path; the bound is what operators tune).
+    // ordering: relaxed — cross-loop advisory watermark; each deque is loop-owned, so the count only tunes shed pressure, never guards data.
     if (parked_total_->load(std::memory_order_relaxed) >= gate_->options().max_queue) {
       if (!parked_.empty()) {
         Conn* oldest = parked_.front();
         parked_.pop_front();
+        // ordering: relaxed — advisory watermark (see try_park).
         parked_total_->fetch_sub(1, std::memory_order_relaxed);
         oldest->state = Conn::S::kHeader;  // leaves kParked
         shed(oldest);
@@ -868,10 +876,12 @@ class UringLoop {
     }
     c->state = Conn::S::kParked;
     parked_.push_back(c);
+    // ordering: relaxed — advisory watermark (see try_park).
     parked_total_->fetch_add(1, std::memory_order_relaxed);
   }
 
   void shed(Conn* c) {
+    // ordering: relaxed — monotonic stat counter.
     robust_counters().shed.fetch_add(1, std::memory_order_relaxed);
     flight::record_at(trace::now_ns(), flight::Ev::kShed, /*a0=data plane*/ 2, 0,
                       c->hdr.trace_id);
@@ -879,6 +889,7 @@ class UringLoop {
   }
 
   void expire(Conn* c) {
+    // ordering: relaxed — monotonic stat counter.
     robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
     flight::record_at(trace::now_ns(), flight::Ev::kDeadlineExceeded, /*a0=server*/ 1, 0,
                       c->hdr.trace_id);
@@ -904,6 +915,7 @@ class UringLoop {
   // Ticket held: serve the op.
   void admitted(Conn* c) {
     if (c->deadline.expired()) {
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
       rejected(c, code(ErrorCode::DEADLINE_EXCEEDED));
       return;
@@ -1092,6 +1104,7 @@ class UringLoop {
       // DEADLINE_EXCEEDED — one-sided writes are unacknowledged until this
       // status, so the client treats them as not-written.
       if (c->deadline.expired()) {
+        // ordering: relaxed — monotonic stat counter.
         robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
         finish(c, code(ErrorCode::DEADLINE_EXCEEDED));
         return;
@@ -1101,6 +1114,7 @@ class UringLoop {
     }
     if (c->deadline.expired()) {
       // Budget spent during the drain: refuse the backing-store apply.
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
       finish(c, code(ErrorCode::DEADLINE_EXCEEDED));
       return;
@@ -1203,6 +1217,7 @@ class UringLoop {
       Conn* newest = parked_.back();
       if (!gate_->try_enter(newest->hdr.len)) return;
       parked_.pop_back();
+      // ordering: relaxed — advisory watermark (see try_park).
       parked_total_->fetch_sub(1, std::memory_order_relaxed);
       newest->state = Conn::S::kHeader;
       newest->ticket = true;
@@ -1217,6 +1232,7 @@ class UringLoop {
       Conn* c = parked_[i];
       if (!c->deadline.is_infinite() && c->deadline.expired()) {
         parked_.erase(parked_.begin() + static_cast<ptrdiff_t>(i));
+        // ordering: relaxed — advisory watermark (see try_park).
         parked_total_->fetch_sub(1, std::memory_order_relaxed);
         c->state = Conn::S::kHeader;
         expire(c);
@@ -1261,6 +1277,7 @@ class UringLoop {
     c->fd = res;
     c->loop = this;
     conns_.insert(c);
+    // ordering: relaxed — diagnostic connection gauge; conn lifetime is loop-owned.
     conn_count_->fetch_add(1, std::memory_order_relaxed);
     start_header(c);
     arm_accept();
@@ -1272,6 +1289,7 @@ class UringLoop {
       for (auto it = parked_.begin(); it != parked_.end(); ++it) {
         if (*it == c) {
           parked_.erase(it);
+          // ordering: relaxed — advisory watermark (see try_park).
           parked_total_->fetch_sub(1, std::memory_order_relaxed);
           break;
         }
@@ -1289,6 +1307,7 @@ class UringLoop {
     // buffer, and its notif CQE names this Conn — destruction waits.
     if (c->sqe_out || c->exec_out || c->zc_notif_pending > 0) return;
     conns_.erase(c);
+    // ordering: relaxed — diagnostic connection gauge; conn lifetime is loop-owned.
     conn_count_->fetch_sub(1, std::memory_order_relaxed);
     delete c;
   }
@@ -1449,6 +1468,7 @@ class UringLoop {
   void run() {
     arm_accept();
     arm_event();
+    // ordering: acquire — pairs with request_stop's release store.
     while (!stop_.load(std::memory_order_acquire)) {
       if ((!parked_.empty() || accept_rearm_ || event_broken_) && !timeout_armed_)
         arm_timeout();
@@ -1488,6 +1508,7 @@ class UringLoop {
       for (Conn* c : std::vector<Conn*>(conns_.begin(), conns_.end())) {
         if (!c->sqe_out && !c->exec_out && c->zc_notif_pending == 0) {
           conns_.erase(c);
+          // ordering: relaxed — diagnostic connection gauge; conn lifetime is loop-owned.
           conn_count_->fetch_sub(1, std::memory_order_relaxed);
           delete c;
         }
@@ -1516,6 +1537,7 @@ class UringLoop {
           continue;
         }
         conns_.erase(c);
+        // ordering: relaxed — diagnostic connection gauge; conn lifetime is loop-owned.
         conn_count_->fetch_sub(1, std::memory_order_relaxed);
         if (c->sqe_out || c->zc_notif_pending > 0) {
           // Undrainable submission or an un-notified ZC buffer the kernel
@@ -1668,6 +1690,7 @@ void UringDataPlane::stop() {
 }
 
 size_t UringDataPlane::connection_count() const noexcept {
+  // ordering: relaxed — point-in-time gauge read.
   return impl_ ? impl_->conn_count.load(std::memory_order_relaxed) : 0;
 }
 
@@ -1718,6 +1741,7 @@ bool uring_runtime_available() {
 }
 
 size_t uring_active_loop_count() noexcept {
+  // ordering: relaxed — point-in-time gauge read.
   return g_active_loops.load(std::memory_order_relaxed);
 }
 

@@ -67,6 +67,7 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
       trace::enabled() ? trace::current() : trace::TraceContext{};
   flight::record(flight::Ev::kRpcStart, opcode);
   if (deadline.expired()) {
+    // ordering: relaxed — monotonic stat counter.
     robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
     flight::record(flight::Ev::kDeadlineExceeded, /*a0=client*/ 0);
     return ErrorCode::DEADLINE_EXCEEDED;
@@ -102,11 +103,13 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
       // storm drains it and the client stops amplifying the overload) and
       // by the caller's remaining deadline.
       if (deadline.expired()) {
+        // ordering: relaxed — monotonic stat counter.
         robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
         flight::record(flight::Ev::kDeadlineExceeded, /*a0=client*/ 0);
         return ErrorCode::DEADLINE_EXCEEDED;
       }
       if (!retry_budget_.try_spend()) {
+        // ordering: relaxed — monotonic stat counter.
         robust_counters().retry_budget_exhausted.fetch_add(1, std::memory_order_relaxed);
         flight::record(flight::Ev::kRetryBudgetOut);
         break;
@@ -126,6 +129,7 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
         std::this_thread::sleep_for(std::chrono::milliseconds(wait_ms));
         lock.lock();
       }
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().retries.fetch_add(1, std::memory_order_relaxed);
       flight::record(flight::Ev::kRetry, attempt);
     }
@@ -138,6 +142,7 @@ ErrorCode KeystoneRpcClient::call_raw(uint8_t opcode, const std::vector<uint8_t>
     std::vector<uint8_t> with_trailer;
     if (!deadline.is_infinite() || tctx.trace_id != 0) {
       if (!deadline.is_infinite() && deadline.expired()) {
+        // ordering: relaxed — monotonic stat counter.
         robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
         flight::record(flight::Ev::kDeadlineExceeded, /*a0=client*/ 0);
         return ErrorCode::DEADLINE_EXCEEDED;
@@ -322,6 +327,7 @@ Result<ViewVersionId> KeystoneRpcClient::ping() {
                                 wire::to_bytes(PingRequest{kProtocolVersion}), resp_bytes));
   PingResponse resp;
   if (!wire::from_bytes_lax(resp_bytes, resp)) return ErrorCode::RPC_FAILED;
+  // ordering: relaxed — advisory protocol-version cache; any torn-free value is fine and the caller re-pings on mismatch.
   server_proto_version_.store(resp.proto_version, std::memory_order_relaxed);
   return resp.view_version;
 }

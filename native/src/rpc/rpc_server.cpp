@@ -132,6 +132,7 @@ void KeystoneRpcServer::serve(std::shared_ptr<net::Socket> sock) {
     auto reject = [&](ErrorCode code, uint32_t hint_ms) {
       auto& counter = code == ErrorCode::RETRY_LATER ? robust_counters().shed
                                                      : robust_counters().deadline_exceeded;
+      // ordering: relaxed — monotonic stat counter.
       counter.fetch_add(1, std::memory_order_relaxed);
       flight::record_at(trace::now_ns(),
                         code == ErrorCode::RETRY_LATER ? flight::Ev::kShed

@@ -231,6 +231,7 @@ class HbmBackend : public OffsetBackendBase {
   // flight must never leave us copying through freed Python memory.
   uint8_t* host_view() const {
     const uint64_t gen = hbm_provider_generation();
+    // ordering: acquire/release generation check — pairs with the registrars' acq_rel bump so a stale cached view pointer is revalidated before any byte is copied through it (a swapped provider must never leave us in freed Python memory).
     if (gen != view_gen_.load(std::memory_order_acquire)) {
       host_view_.store(static_cast<uint8_t*>(hbm_host_view_base(region_id_)),
                        std::memory_order_release);
@@ -298,6 +299,7 @@ std::unique_ptr<StorageBackend> make_hbm_backend(const BackendConfig& config) {
   return std::make_unique<HbmBackend>(config);
 }
 
+// ordering: acquire — pairs with the registrar bumps; callers revalidate cached pointers against it.
 uint64_t hbm_provider_generation() { return g_provider_gen.load(std::memory_order_acquire); }
 
 void* hbm_host_view_base(uint64_t region_id) {
@@ -360,6 +362,7 @@ ErrorCode hbm_fabric_pull(const std::string& remote_addr, uint64_t transfer_id,
 
 extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider) {
   btpu::MutexLock lock(btpu::storage::g_provider_mutex);
+  // ordering: acq_rel — the bump publishes the swap (old viewers revalidate) and orders it after the provider fields written under g_provider_mutex.
   btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_fabric = {};  // v3 has no fabric entries
   btpu::storage::g_host_view_base = nullptr;
@@ -374,6 +377,7 @@ extern "C" void btpu_register_hbm_provider_v3(const BtpuHbmProviderV3* provider)
 
 extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider) {
   btpu::MutexLock lock(btpu::storage::g_provider_mutex);
+  // ordering: acq_rel — see the v3 registrar.
   btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_host_view_base = nullptr;
   if (provider) {
@@ -391,6 +395,7 @@ extern "C" void btpu_register_hbm_provider_v4(const BtpuHbmProviderV4* provider)
 extern "C" void btpu_register_hbm_provider_v5(const BtpuHbmProviderV5* provider) {
   btpu_register_hbm_provider_v4(provider ? &provider->base : nullptr);
   btpu::MutexLock lock(btpu::storage::g_provider_mutex);
+  // ordering: acq_rel — see the v3 registrar.
   btpu::storage::g_provider_gen.fetch_add(1, std::memory_order_acq_rel);
   btpu::storage::g_host_view_base = provider ? provider->host_view_base : nullptr;
 }

@@ -77,6 +77,7 @@ class MiniRing {
   // Blocking single-op submit+wait. Returns op result (>=0) or -errno.
   int32_t run(uint8_t opcode, int fd, void* buf, uint32_t len, uint64_t file_offset) {
     MutexLock lock(mutex_);
+    // ordering: relaxed — only this (mutex-serialized) submitter advances the tail; the kernel side synchronizes via the release store below.
     const unsigned tail = sq_tail_->load(std::memory_order_relaxed);
     const unsigned idx = tail & sq_mask_;
     io_uring_sqe& sqe = sqes_[idx];
@@ -87,14 +88,17 @@ class MiniRing {
     sqe.len = len;
     sqe.off = file_offset;
     sq_array_[idx] = idx;
+    // ordering: release — publishes the fully-written SQE before the kernel observes the new tail.
     sq_tail_->store(tail + 1, std::memory_order_release);
 
     if (io_uring_enter(ring_fd_, 1, 1, IORING_ENTER_GETEVENTS) < 0) return -errno;
 
+    // ordering: acquire (both) — pairs with the kernel's release publish of the CQE so res below reads the completed value.
     const unsigned head = cq_head_->load(std::memory_order_acquire);
     if (head == cq_tail_->load(std::memory_order_acquire)) return -EIO;
     const io_uring_cqe& cqe = cqes_[head & cq_mask_];
     const int32_t res = cqe.res;
+    // ordering: release — returns the consumed CQE slot to the kernel after the read above.
     cq_head_->store(head + 1, std::memory_order_release);
     return res;
   }

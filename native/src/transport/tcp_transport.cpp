@@ -258,6 +258,7 @@ class TcpTransportServer : public TransportServer {
     MutexLock lock(conns_mutex_);
     size_t live = 0;
     for (const auto& s : conns_)
+      // ordering: acquire — pairs with the serve thread's release store; a true flag means the thread's serving writes are done and it is joinable.
       if (!s.done->load(std::memory_order_acquire)) ++live;
     return live;
   }
@@ -285,6 +286,7 @@ class TcpTransportServer : public TransportServer {
       slot.done = done;
       slot.thread = std::thread([this, conn, done] {
         serve(conn);
+        // ordering: release — publishes every serving-side write before the reaper's acquire read can observe done.
         done->store(true, std::memory_order_release);
       });
       conns_.push_back(std::move(slot));
@@ -299,6 +301,7 @@ class TcpTransportServer : public TransportServer {
     {
       MutexLock lock(conns_mutex_);
       for (size_t i = 0; i < conns_.size();) {
+        // ordering: acquire — see live_connections(): done pairs release/acquire with the serve thread.
         if (conns_[i].done->load(std::memory_order_acquire)) {
           finished.push_back(std::move(conns_[i]));
           conns_[i] = std::move(conns_.back());
@@ -337,6 +340,7 @@ class TcpTransportServer : public TransportServer {
     // (the uring engine's shed()/expire() stamp the same way).
     auto rejection = [&hdr](const AdmissionTicket& ticket) -> uint32_t {
       if (ticket.verdict() == AdmissionGate::Verdict::kShed) {
+        // ordering: relaxed — monotonic stat counters (this lambda and the two below).
         robust_counters().shed.fetch_add(1, std::memory_order_relaxed);
         flight::record_at(trace::now_ns(), flight::Ev::kShed, /*a0=data plane*/ 2, 0,
                           hdr.trace_id);
@@ -348,6 +352,7 @@ class TcpTransportServer : public TransportServer {
       return static_cast<uint32_t>(ErrorCode::DEADLINE_EXCEEDED);
     };
     auto expired_status = [&hdr]() -> uint32_t {
+      // ordering: relaxed — monotonic stat counter.
       robust_counters().deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
       flight::record_at(trace::now_ns(), flight::Ev::kDeadlineExceeded, /*a0=server*/ 1,
                         0, hdr.trace_id);
@@ -833,7 +838,7 @@ class WireWorkers {
     std::atomic<size_t> next{0};
     std::atomic<size_t> done{0};
     Mutex done_mutex;
-    std::condition_variable_any done_cv;
+    CondVarAny done_cv;
   };
 
  public:
@@ -911,7 +916,7 @@ class WireWorkers {
 
   size_t nthreads_{0};
   Mutex mutex_;
-  std::condition_variable_any cv_;
+  CondVarAny cv_;
   std::deque<std::shared_ptr<Job>> jobs_ BTPU_GUARDED_BY(mutex_);
   bool stop_ BTPU_GUARDED_BY(mutex_){false};
   std::vector<std::thread> threads_;  // written once in the ctor, joined in the dtor
@@ -1209,6 +1214,7 @@ void run_subs(std::vector<SubOp>& subs, const std::vector<size_t>& order, uint8_
       if (sub.op->deadline.expired()) {
         // Budget spent before this sub-op even left: fail locally instead
         // of shipping doomed work to the worker.
+        // ordering: relaxed — monotonic stat counter.
         robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
         shared.fail(sub.op, ErrorCode::DEADLINE_EXCEEDED);
         ++next;

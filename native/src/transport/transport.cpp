@@ -338,6 +338,7 @@ class FaultyTransportClient final : public TransportClient {
     if (!spec_.latency_endpoint.empty() && remote.endpoint != spec_.latency_endpoint)
       return;
     uint32_t ms = spec_.latency_override_ms
+                      // ordering: relaxed — chaos latency dial: a single word read each op; stale values just shift when the injected latency starts.
                       ? spec_.latency_override_ms->load(std::memory_order_relaxed)
                       : spec_.latency_ms;
     if (ms == 0 && spec_.latency_jitter_ms == 0) return;

@@ -163,7 +163,13 @@ RAW_MUTEX = re.compile(
 )
 # The annotated wrappers are implemented in terms of the std primitives —
 # the one legal home. The gcc-10 tsan shim interposes pthreads, not std.
-MUTEX_ALLOW = {"include/btpu/common/thread_annotations.h"}
+# sched.cpp is the schedule-exploration scheduler itself: locking through
+# the hooked wrappers would recurse straight back into it, so it runs on
+# the raw std types by construction (docs/CORRECTNESS.md §10).
+MUTEX_ALLOW = {
+    "include/btpu/common/thread_annotations.h",
+    "src/common/sched.cpp",
+}
 
 
 def rule_mutex(report: Report):
@@ -361,6 +367,54 @@ def rule_trace_span(report: Report):
             )
 
 
+# ---- rule: atomic-ordering-comment -----------------------------------------
+# Every non-seq_cst std::atomic operation is a proof obligation: the author
+# claims some weaker ordering suffices, and that claim must be written down
+# where the next reader (and the schedule-exploration DFS fixtures) can
+# audit it. The justification is a comment containing `ordering:` on the
+# same line or within the few lines above (one comment may cover a short
+# contiguous cluster — the flight-recorder store sequence is the canonical
+# case). seq_cst needs no comment: it is the safe default, not a claim.
+
+NONSEQ_ORDER = re.compile(
+    r"\bmemory_order_(relaxed|acquire|release|acq_rel|consume)\b"
+)
+ORDERING_WINDOW = 8  # same line or up to this many lines above
+
+_RAW_LINES: dict = {}
+
+
+def raw_lines(p: Path) -> list:
+    if p not in _RAW_LINES:
+        _RAW_LINES[p] = p.read_text().splitlines()
+    return _RAW_LINES[p]
+
+
+def ordering_justified(p: Path, line_no: int) -> bool:
+    """line_no is 1-based; accepts `ordering:` in a comment on the line
+    itself or in the ORDERING_WINDOW lines above it."""
+    lines = raw_lines(p)
+    lo = max(0, line_no - 1 - ORDERING_WINDOW)
+    return any("ordering:" in lines[j] for j in range(lo, min(line_no, len(lines))))
+
+
+def rule_atomic_ordering(report: Report):
+    for p in src_files():
+        stripped = read_stripped(p).splitlines()
+        for i, line in enumerate(stripped):
+            if not NONSEQ_ORDER.search(line):
+                continue
+            if ordering_justified(p, i + 1):
+                continue
+            report.flag(
+                "atomic-ordering-comment", p, i + 1,
+                "non-seq_cst atomic operation without an `// ordering:` "
+                "justification within reach — weaker-than-seq_cst is a "
+                "claim about every concurrent observer; write the argument "
+                "down (docs/CORRECTNESS.md §10)",
+            )
+
+
 # ---- optional libclang refinement -----------------------------------------
 
 
@@ -381,6 +435,12 @@ def try_libclang(report: Report) -> bool:
 
     raw = {"std::mutex", "std::shared_mutex", "std::lock_guard",
            "std::unique_lock", "std::scoped_lock", "std::shared_lock"}
+    # Non-seq_cst ordering spellings the AST can see through aliases the
+    # pattern pass cannot (`constexpr auto mo = std::memory_order_relaxed`).
+    weak_orderings = {"memory_order_relaxed", "memory_order_acquire",
+                      "memory_order_release", "memory_order_acq_rel",
+                      "memory_order_consume", "relaxed", "acquire",
+                      "release", "acq_rel", "consume"}
     # Budgeted: this pass only ADDS alias-hidden findings on top of the
     # pattern pass, so running out of time degrades coverage, never
     # correctness. Walk only subtrees rooted in the file itself — a full
@@ -396,17 +456,38 @@ def try_libclang(report: Report) -> bool:
             tu = index.parse(str(p), args=["-std=c++20", f"-I{NATIVE}/include"])
         except Exception:
             continue
+        rel = str(p.relative_to(NATIVE))
         for top in tu.cursor.get_children():
             if top.location.file is None or Path(str(top.location.file)) != p:
                 continue
             for cur in top.walk_preorder():
                 if cur.kind in (cindex.CursorKind.VAR_DECL,
                                 cindex.CursorKind.FIELD_DECL):
+                    if rel in MUTEX_ALLOW:
+                        continue
                     spelling = cur.type.get_canonical().spelling
                     if any(r in spelling for r in raw):
                         report.flag(
                             "mutex-annotated-only/ast", p, cur.location.line,
                             f"alias-hidden raw mutex type: {spelling}",
+                        )
+                elif cur.kind == cindex.CursorKind.DECL_REF_EXPR:
+                    # Alias-hidden weak orderings: a DECL_REF to one of the
+                    # std::memory_order constants on a line the pattern pass
+                    # saw nothing on still needs its `ordering:` comment.
+                    if cur.spelling not in weak_orderings:
+                        continue
+                    if "memory_order" not in cur.type.get_canonical().spelling:
+                        continue
+                    line_no = cur.location.line
+                    line_text = raw_lines(p)[line_no - 1] if line_no <= len(raw_lines(p)) else ""
+                    if NONSEQ_ORDER.search(line_text):
+                        continue  # the pattern pass already judged this line
+                    if not ordering_justified(p, line_no):
+                        report.flag(
+                            "atomic-ordering-comment/ast", p, line_no,
+                            f"alias-hidden non-seq_cst ordering ({cur.spelling}) "
+                            "without an `// ordering:` justification",
                         )
     return True
 
@@ -422,6 +503,7 @@ def main() -> int:
     rule_wire_golden(report)
     rule_nodiscard(report)
     rule_trace_span(report)
+    rule_atomic_ordering(report)
     mode = "libclang+patterns" if try_libclang(report) else "patterns"
     if report.violations:
         print(f"btpu_lint ({mode}): {len(report.violations)} violation(s)",
@@ -430,8 +512,8 @@ def main() -> int:
             print(f"  {v}", file=sys.stderr)
         return 1
     print(f"btpu_lint ({mode}): clean "
-          "(mutex/env/steady-clock/wire-golden/nodiscard/trace-span "
-          "invariants hold)")
+          "(mutex/env/steady-clock/wire-golden/nodiscard/trace-span/"
+          "atomic-ordering invariants hold)")
     return 0
 
 

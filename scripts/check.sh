@@ -91,8 +91,12 @@ if command -v python3 > /dev/null 2>&1 && python3 -c 'import pytest, jax' 2> /de
 else
   echo "check: NOTICE — pytest and/or jax unavailable; skipping the tier-1 leg"
 fi
-run_leg "asan" make -j"$jobs" asan
-run_leg "tsan" make -j"$jobs" tsan
+# The planted-mutant matrix (SchedMutants, ~60-90 forked child processes
+# per pass) is owned by the sched-smoke leg below / `make sched` / nightly —
+# running it at full budget inside BOTH sanitizer full-suite legs too would
+# triple the fork-exec bill on every check for zero extra coverage.
+run_leg "asan" env BTPU_SCHED_MUTANTS=0 make -j"$jobs" asan
+run_leg "tsan" env BTPU_SCHED_MUTANTS=0 make -j"$jobs" tsan
 # Bounded hostile-input sweep: the full-budget run is `make fuzz` (1M
 # execs/target); the check gate replays the corpus plus a smaller
 # deterministic sweep so a decoder regression fails here too. Deliberately
@@ -109,6 +113,24 @@ run_leg "fuzz-smoke" env BTPU_FUZZ_EXECS="${BTPU_CHECK_FUZZ_EXECS:-100000}" \
 run_leg "crash-smoke" ./build/bb-crash --dir /tmp/bb-crash-check \
   --iters "${BTPU_CHECK_CRASH_ITERS:-1}" --ops "${BTPU_CHECK_CRASH_OPS:-120}" \
   --windows "${BTPU_CHECK_CRASH_WINDOWS:-400,0}"
+# Bounded schedule-exploration smoke: the seeded PCT sweep, the exhaustive
+# DFS model check of the lock-free kernels, and the planted-mutant matrix,
+# on the asan tree (built by the asan leg above — the sched hooks ride every
+# sanitizer build). Keyed BTPU_CHECK_SCHED_* like the fuzz/crash smokes; the
+# full-budget campaign is `make sched` / the nightly CI job. Disabling the
+# leg scores SKIP, never PASS — an unexplored schedule space is not a green
+# schedule space.
+if [ "${BTPU_CHECK_SCHED:-1}" = "0" ]; then
+  results[sched-smoke]="SKIP (disabled via BTPU_CHECK_SCHED=0 — no schedules explored)"
+elif [ ! -x build/asan/btpu_tests ]; then
+  results[sched-smoke]=FAIL
+  overall=1
+  echo "check: sched-smoke FAIL — build/asan/btpu_tests missing (asan leg did not build)" >&2
+else
+  run_leg "sched-smoke" env BTPU_SCHED_SEEDS="${BTPU_CHECK_SCHED_SEEDS:-12}" \
+    BTPU_SCHED_MUTANT_BUDGET="${BTPU_CHECK_SCHED_MUTANT_BUDGET:-80}" \
+    ./build/asan/btpu_tests --filter=Sched
+fi
 
 echo
 echo "===================================================================="
@@ -116,7 +138,7 @@ echo "== check: summary"
 echo "===================================================================="
 for leg in build lint native-suite iouring-net-0-uring iouring-net-0-transport \
            iouring-net-0-remote-lane iouring-net-1-uring iouring-net-1-remote-lane \
-           tier1-pytest asan tsan fuzz-smoke crash-smoke; do
+           tier1-pytest asan tsan fuzz-smoke crash-smoke sched-smoke; do
   [ -n "${results[$leg]:-}" ] && printf '  %-14s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

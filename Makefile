@@ -31,7 +31,8 @@ EXAMPLES := $(patsubst examples/%.cpp,$(BUILD)/example_%,$(EXAMPLE_SRCS))
 
 HDRS := $(shell find native/include native/src native/exe native/fuzz -name '*.h')
 
-.PHONY: all native examples clean tsan asan sched lint check wire-golden fuzz fuzz-replay
+.PHONY: all native examples clean tsan asan sched lint check wire-golden \
+        capi-golden fuzz fuzz-replay
 all: native
 native: $(BUILD)/libbtpu.so $(BUILD)/btpu_tests $(EXES)
 examples: $(EXAMPLES)
@@ -140,6 +141,15 @@ wire-golden: $(BUILD)/btpu_tests
 	$(BUILD)/btpu_tests --dump-wire-golden > native/tests/wire_golden.txt.tmp
 	mv native/tests/wire_golden.txt.tmp native/tests/wire_golden.txt
 	@echo "wrote native/tests/wire_golden.txt"
+
+# Regenerate the FFI golden manifest (native/tests/capi_golden.txt) from the
+# headers — the diff is the ABI review, like wire-golden above. Purely
+# textual (scripts/capi_check.py parses the headers); no build needed.
+# Temp-file dance for the same reason as wire-golden.
+capi-golden:
+	python3 scripts/capi_check.py --dump-golden > native/tests/capi_golden.txt.tmp
+	mv native/tests/capi_golden.txt.tmp native/tests/capi_golden.txt
+	@echo "wrote native/tests/capi_golden.txt"
 
 # ---- the one-command correctness gate --------------------------------------
 # tier-1 pytest + lint + full native suite + asan + tsan. Every PR runs this.

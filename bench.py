@@ -13,12 +13,18 @@ Secondary numbers (put GB/s, 64 KiB p99 vs the <50 us north star) go to
 stderr so the stdout contract stays one line.
 """
 
+from __future__ import annotations
+
 import json
 import os
 import subprocess
 import sys
 import time
 from pathlib import Path
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from blackbird_tpu.procluster import ProcessCluster
 
 REPO_ROOT = Path(__file__).resolve().parent
 BASELINE_GBPS = 3.125  # 25 Gbps reference link (configs/worker.yaml:24)
@@ -26,10 +32,10 @@ BASELINE_GBPS = 3.125  # 25 Gbps reference link (configs/worker.yaml:24)
 # Memoized TPU-device probe verdict (see tpu_probe below). The tunnel's
 # health is a process-lifetime fact; the old flow re-ran the 2x75 s timeout
 # dance for every device-dependent section.
-_TPU_PROBE: dict | None = None
+_TPU_PROBE: dict[str, Any] | None = None
 
 
-def tpu_probe() -> dict:
+def tpu_probe() -> dict[str, Any]:
     """Bounded TPU-device probe: throwaway subprocess + hard timeout, run AT
     MOST ONCE per bench process. Two attempts because the tunnel flaps on
     the scale of minutes and answers within ~20 s when healthy. The verdict
@@ -41,7 +47,7 @@ def tpu_probe() -> dict:
     global _TPU_PROBE
     if _TPU_PROBE is not None:
         return _TPU_PROBE
-    probe_detail: dict = {}
+    probe_detail: dict[str, Any] = {}
     for attempt in (1, 2):
         try:
             pr = subprocess.run(
@@ -77,7 +83,8 @@ def ensure_built() -> Path:
 
 
 def run_bench(binary: Path, size: int, iterations: int, transport: str = "tcp",
-              max_workers: int = 4, workers: int = 4, extra_args: tuple = ()):
+              max_workers: int = 4, workers: int = 4,
+              extra_args: tuple[str, ...] = ()) -> dict[str, Any]:
     result = subprocess.run(
         [
             str(binary), "--embedded", str(workers), "--size", str(size),
@@ -104,7 +111,6 @@ def bench_hbm_tier() -> None:
     dev TPUs the link itself can be ~MB/s-slow and asymmetric; on a real
     TPU VM it is PCIe-class.) Secondary metric -> stderr (stdout stays the
     one-line contract)."""
-    import time
 
     try:
         import jax
@@ -150,7 +156,7 @@ def bench_hbm_tier() -> None:
                 warm = {f"bench/warm{i}": payloads[f"bench/hbm{i}"] for i in range(33)}
                 client.put_many(warm, max_workers=1)
 
-                put_rounds = []  # (tier_s, matched link_s)
+                put_rounds: list[tuple[float, float]] = []  # (tier_s, matched link_s)
                 for r in range(3):
                     t0 = time.perf_counter()
                     dev_arr = jax.device_put(flat, device)
@@ -163,7 +169,7 @@ def bench_hbm_tier() -> None:
                 put_s, link_h2d_s = sorted(put_rounds)[1]  # median round
 
                 client.get_many(list(warm))  # warm the gather executables
-                get_times = []
+                get_times: list[float] = []
                 for r in range(3):
                     t0 = time.perf_counter()
                     client.get_many([f"bench/put{r}/{i}" for i in range(iters)])
@@ -229,7 +235,7 @@ def bench_cross_process(shm_get_gbps: float | None, hbm: bool) -> None:
             # under the p50-implied rate). Interference only ever makes
             # numbers worse; the best short run is the least-biased estimate
             # of the lane's capability.
-            per_op: dict = {}
+            per_op: dict[str, Any] = {}
             for _ in range(3):
                 result = subprocess.run(
                     [str(REPO_ROOT / "build" / "bb-bench"), "--keystone",
@@ -321,6 +327,7 @@ def _raw_fabric_substrate_gbps(nbytes: int) -> float:
             [sys.executable, "-c", _SUBSTRATE_SERVER_SRC, str(nbytes)],
             stdout=subprocess.PIPE, text=True, cwd=REPO_ROOT)
         try:
+            assert proc.stdout is not None  # PIPE above guarantees it
             addr = proc.stdout.readline().strip()
             if not addr:
                 return 0.0
@@ -438,13 +445,13 @@ def bench_fabric_client() -> None:
         )
 
 
-def bench_trace_overhead(binary: Path) -> dict | None:
+def bench_trace_overhead(binary: Path) -> dict[str, Any] | None:
     """Trace-overhead guard row (ISSUE 10): tracing-on vs tracing-off over
     the hot cached get, A/B'd INSIDE one bb-bench process (--trace-ab runs
     the same loop twice flipping trace::set_enabled) so the box's +-30%
     cross-run swing cancels. PASS = on-p50 <= 1.05x off-p50; best ratio of
     3 runs (interference only ever makes the traced half look worse)."""
-    runs = []
+    runs: list[tuple[float, dict[str, Any], dict[str, Any]]] = []
     for _ in range(3):
         try:
             r = subprocess.run(
@@ -454,7 +461,7 @@ def bench_trace_overhead(binary: Path) -> dict | None:
                 capture_output=True, text=True, timeout=600, cwd=REPO_ROOT)
             if r.returncode != 0:
                 raise RuntimeError(r.stderr[-300:])
-            rows = {}
+            rows: dict[str, Any] = {}
             for line in r.stdout.splitlines():
                 line = line.strip()
                 if line.startswith("{"):
@@ -483,7 +490,7 @@ def bench_trace_overhead(binary: Path) -> dict | None:
     return guard
 
 
-def bench_decode_guard(get_gbps_1mib: float) -> dict | None:
+def bench_decode_guard(get_gbps_1mib: float) -> dict[str, Any] | None:
     """Decode-overhead guard row (checked WireReader vs the data path).
 
     Two pieces of evidence, strongest first:
@@ -507,7 +514,7 @@ def bench_decode_guard(get_gbps_1mib: float) -> dict | None:
     # One 1 MiB striped-4 get parses ~4 data-plane headers (one 256 KiB
     # staged chunk per shard) plus one GetWorkersResponse.
     decode_ns = 4 * d["header_decode_ns"] + d["rpc_response_decode_ns"]
-    guard = {
+    guard: dict[str, Any] = {
         "decode_header_ns": round(d["header_decode_ns"], 1),
         "decode_rpc_response_ns": round(d["rpc_response_decode_ns"], 1),
     }
@@ -555,9 +562,9 @@ def main() -> int:
     # single runs swing +-30%. Interference only ever makes numbers WORSE,
     # so best-of-3 short runs is the least-biased estimate of the actual
     # capability (max throughput, min p99).
-    def best_of(n, **kwargs):
+    def best_of(n: int, **kwargs: Any) -> dict[str, Any]:
         runs = [run_bench(binary, **kwargs) for _ in range(n)]
-        return max(runs, key=lambda rows: rows["get"]["gbps"])
+        return max(runs, key=lambda rows: float(rows["get"]["gbps"]))
 
     main_rows = best_of(3, size=1 << 20, iterations=150, transport="tcp")
     # Raw (verify=off) companion row: same workload without the end-to-end
@@ -633,7 +640,7 @@ def main() -> int:
         capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
     )
     if result.returncode == 0:
-        sweep = {}
+        sweep: dict[tuple[str, int], Any] = {}
         for line in result.stdout.splitlines():
             row = json.loads(line)
             if "bytes" not in row:  # e.g. the trailing counters row
@@ -754,9 +761,10 @@ def main() -> int:
     # 4 clients share one CPU, so PER-OP latency necessarily degrades ~4x;
     # the honest capacity signals are the aggregate GB/s and the metadata
     # ops/sec scaling.
-    meta_scaling = {}
+    meta_scaling: dict[str, Any] = {}
     try:
-        def run_raw(args, timeout=600, env=None):
+        def run_raw(args: list[str], timeout: int = 600,
+                    env: dict[str, str] | None = None) -> list[Any]:
             r = subprocess.run([str(binary), *args], capture_output=True,
                                text=True, timeout=timeout, cwd=REPO_ROOT, env=env)
             if r.returncode != 0:
@@ -799,7 +807,7 @@ def main() -> int:
         # (parallel scaling needs cores; lock collapse would show as well
         # BELOW 1.0x with convoying p99s).
         env_sh = dict(os.environ, BTPU_KEYSTONE_SHARDS="8")
-        def meta_row(threads, iters):
+        def meta_row(threads: int, iters: int) -> dict[str, Any]:
             rows = [run_raw(["--embedded", "1", "--size", str(64 << 10),
                              "--iterations", str(iters), "--control-plane",
                              "--threads", str(threads), "--json"], env=env_sh)[0]
@@ -828,7 +836,7 @@ def main() -> int:
     # ON. Hedging's whole job is closing the tail that replication already
     # paid for: the unhedged p99 IS the injected latency, the hedged p99 is
     # ~hedge-trigger + one healthy read (acceptance: >= 5x better p99).
-    overload = {}
+    overload: dict[str, Any] = {}
     try:
         r = subprocess.run(
             [str(binary), "--embedded", "2", "--size", str(64 << 10),
@@ -860,9 +868,9 @@ def main() -> int:
     # the scheduler-noise-FREE acceptance signal is syncs_per_put: < 1 means
     # concurrent acks genuinely shared fdatasyncs (the 1.5x p99-ratio shape
     # needs a multi-core keystone host, like the shard-scaling 3x).
-    durable = {}
+    durable: dict[str, Any] = {}
     try:
-        def durable_row(window_us):
+        def durable_row(window_us: int) -> dict[str, Any]:
             rows = [json.loads(subprocess.run(
                 [str(binary), "--durable-put", "--threads", "4",
                  "--iterations", "150", "--window-us", str(window_us)],
@@ -892,7 +900,8 @@ def main() -> int:
     try:
         from blackbird_tpu.procluster import ProcessCluster
 
-        def spawn_clients(pc, n, iters):
+        def spawn_clients(pc: ProcessCluster, n: int,
+                          iters: int) -> dict[str, float]:
             procs = [subprocess.Popen(
                 [str(binary), "--keystone", f"127.0.0.1:{pc.keystone_port}",
                  "--size", str(64 << 10), "--iterations", str(iters),
@@ -902,6 +911,7 @@ def main() -> int:
             for p in procs:
                 if p.wait() != 0:
                     raise RuntimeError("client process failed")
+                assert p.stdout is not None  # PIPE above guarantees it
                 for line in p.stdout.read().splitlines():
                     row = json.loads(line)
                     if row["op"] in agg:
@@ -978,13 +988,13 @@ def main() -> int:
     # the 1 MiB striped get and hot cached get within noise of BENCH_r05.
     decode_guard = bench_decode_guard(get_gbps)
     if decode_guard is not None:
-        r05 = {}
+        r05: dict[str, Any] = {}
         try:
             with open(REPO_ROOT / "BENCH_r05.json") as fh:
                 r05 = json.load(fh).get("parsed", {})
         except Exception:
             pass
-        vs = []
+        vs: list[str] = []
         if r05.get("value"):
             decode_guard["guard_get_1mib_vs_r05"] = round(get_gbps / r05["value"], 3)
             vs.append(f"1MiB get {get_gbps:.2f} GB/s vs r05 {r05['value']:.2f} "
@@ -1020,11 +1030,12 @@ def main() -> int:
     # only interpretable against bench_cpus — on a 1-cpu box client and
     # server SHARE the core, so the 2-kernel-copy loopback path is bounded
     # near 50% of memcpy before any protocol overhead.
-    wire = {}
+    wire: dict[str, Any] = {}
     try:
         wire_bin = binary.parent / "bb-wire"
 
-        def run_wire(args, timeout=300, env_extra=None):
+        def run_wire(args: list[str], timeout: int = 300,
+                     env_extra: dict[str, str] | None = None) -> Any:
             env = dict(os.environ, **env_extra) if env_extra else None
             r = subprocess.run([str(wire_bin), *args], capture_output=True,
                                text=True, timeout=timeout, cwd=REPO_ROOT, env=env)
@@ -1072,7 +1083,7 @@ def main() -> int:
         )
     except Exception as exc:
         print(f"wire stream/fanin rows skipped: {exc}", file=sys.stderr)
-    summary = {
+    summary: dict[str, Any] = {
         "metric": "get_gbps_1mib_striped4_tcp",
         "value": round(get_gbps, 3),
         "unit": "GB/s",

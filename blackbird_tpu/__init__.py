@@ -20,9 +20,21 @@ Layout:
                             worker from the runtime (pods)
 """
 
-from blackbird_tpu.native import ErrorCode, StorageClass, TransportKind, lib  # noqa: F401
-from blackbird_tpu.cluster import EmbeddedCluster  # noqa: F401
-from blackbird_tpu.client import Client  # noqa: F401
-from blackbird_tpu.fabric import FabricClient, FabricUnavailable  # noqa: F401
+from blackbird_tpu.native import ErrorCode, StorageClass, TransportKind, lib
+from blackbird_tpu.cluster import EmbeddedCluster
+from blackbird_tpu.client import Client
+from blackbird_tpu.fabric import FabricClient, FabricUnavailable
+
+# Explicit export surface (mypy runs with no_implicit_reexport).
+__all__ = [
+    "Client",
+    "EmbeddedCluster",
+    "ErrorCode",
+    "FabricClient",
+    "FabricUnavailable",
+    "StorageClass",
+    "TransportKind",
+    "lib",
+]
 
 __version__ = "0.1.0"

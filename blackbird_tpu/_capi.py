@@ -1,0 +1,286 @@
+"""The FFI boundary manifest: ONE table describing every C symbol Python
+binds, in canonical ABI tokens, plus complete mirrors of the native enums.
+
+This module is the machine-checked seam between `native/include/btpu/capi.h`
+(+ the `extern "C"` block of `storage/hbm_provider.h`) and the ctypes layer:
+
+  - `native.py::_load()` consumes SIGNATURES verbatim to set argtypes/restype
+    — there is no second hand-synced table to drift.
+  - `scripts/capi_check.py` parses the headers into the same token language
+    and convicts ANY divergence (missing/extra symbols, wrong integer width,
+    wrong pointerness, stale enum value) as a `make lint` failure. The
+    checked-in review artifact is native/tests/capi_golden.txt
+    (`make capi-golden` regenerates it, like the wire golden table).
+  - The enum classes below are exact bijections of their native enums
+    (error.h ErrorCode, types.h StorageClass/TransportKind) — also enforced
+    by capi_check.py, and at runtime by the btpu_error_name round-trip test
+    (tests/test_capi_boundary.py).
+
+Import cost: ctypes + enum only. Importing this module NEVER builds or loads
+libbtpu.so — tooling (capi_check.py, mypy) reads the manifest without paying
+for, or requiring, a native build.
+
+Adding a capi function (docs/CORRECTNESS.md §11): declare it in capi.h,
+implement it, run `make capi-golden`, add its SIGNATURES row (+ OPTIONAL if
+version-gated) and its NativeAPI method in native.py, then `make lint`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+from typing import Final
+
+# ---- canonical ABI tokens --------------------------------------------------
+# One token per ABI-distinct parameter class. The header side canonicalizes
+# to the same tokens (const-ness and opaque-struct names are ABI-irrelevant;
+# `uint64_t out[6]` decays to u64*), so comparison is exact, not fuzzy.
+TOKEN_CTYPES: Final[dict[str, object]] = {
+    "void": None,  # return position only
+    "i32": ctypes.c_int32,
+    "i64": ctypes.c_int64,
+    "u32": ctypes.c_uint32,
+    "u64": ctypes.c_uint64,
+    "cstr": ctypes.c_char_p,  # const char* / char* (incl. out string buffers)
+    "ptr": ctypes.c_void_p,  # void* and every opaque/struct pointer
+    "cstr*": ctypes.POINTER(ctypes.c_char_p),  # const char* const*
+    "ptr*": ctypes.POINTER(ctypes.c_void_p),  # const void* const* / void* const*
+    "u64*": ctypes.POINTER(ctypes.c_uint64),
+    "i32*": ctypes.POINTER(ctypes.c_int32),
+}
+
+_COUNTER: Final[tuple[str, tuple[str, ...]]] = ("u64", ())
+
+# name -> (return token, argument tokens). Ordered as in capi.h for a
+# readable golden diff; the hbm_provider.h registration trio sits last.
+SIGNATURES: Final[dict[str, tuple[str, tuple[str, ...]]]] = {
+    # -- embedded cluster ----------------------------------------------------
+    "btpu_cluster_create": ("ptr", ("u32", "u64", "u32", "u32")),
+    "btpu_cluster_create_tiered": ("ptr", ("u32", "u64", "u64")),
+    "btpu_cluster_create_ex": ("ptr", ("u32", "u64", "u32", "u32", "cstr", "i64")),
+    "btpu_cluster_destroy": ("void", ("ptr",)),
+    "btpu_cluster_kill_worker": ("i32", ("ptr", "u32")),
+    "btpu_cluster_worker_count": ("u32", ("ptr",)),
+    "btpu_cluster_counters": ("void", ("ptr", "u64*")),
+    # -- standalone worker daemon -------------------------------------------
+    "btpu_worker_create": ("ptr", ("cstr", "cstr")),
+    "btpu_worker_pool_count": ("u32", ("ptr",)),
+    "btpu_worker_id": ("cstr", ("ptr",)),
+    "btpu_worker_destroy": ("void", ("ptr",)),
+    # -- client lifecycle ----------------------------------------------------
+    "btpu_client_create_embedded": ("ptr", ("ptr",)),
+    "btpu_client_create_remote": ("ptr", ("cstr",)),
+    "btpu_client_destroy": ("void", ("ptr",)),
+    "btpu_client_set_verify": ("void", ("ptr", "i32")),
+    # -- object I/O ----------------------------------------------------------
+    "btpu_put": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32")),
+    "btpu_put_ex": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32",
+                            "i64", "i32")),
+    "btpu_put_ex2": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32",
+                             "i64", "i32", "i32")),
+    "btpu_get": ("i32", ("ptr", "cstr", "ptr", "u64", "u64*")),
+    "btpu_put_many": ("i32", ("ptr", "u32", "cstr*", "ptr*", "u64*", "u32",
+                              "u32", "u32", "i32*")),
+    "btpu_get_many": ("i32", ("ptr", "u32", "cstr*", "ptr*", "u64*", "u64*",
+                              "i32*")),
+    "btpu_sizes_many": ("i32", ("ptr", "u32", "cstr*", "u64*", "i32*")),
+    "btpu_placements_json": ("i32", ("ptr", "cstr", "cstr", "u64", "u64*")),
+    "btpu_drain_worker": ("i32", ("ptr", "cstr", "u64*")),
+    # -- lane scoreboard -----------------------------------------------------
+    "btpu_pvm_op_count": _COUNTER,
+    "btpu_pvm_byte_count": _COUNTER,
+    "btpu_tcp_staged_op_count": _COUNTER,
+    "btpu_tcp_staged_byte_count": _COUNTER,
+    "btpu_tcp_stream_op_count": _COUNTER,
+    "btpu_tcp_stream_byte_count": _COUNTER,
+    "btpu_tcp_pool_direct_op_count": _COUNTER,
+    "btpu_tcp_pool_direct_byte_count": _COUNTER,
+    "btpu_tcp_zerocopy_sent_count": _COUNTER,
+    "btpu_tcp_zerocopy_copied_count": _COUNTER,
+    "btpu_uring_loop_count": _COUNTER,
+    "btpu_wire_pool_threads": _COUNTER,
+    "btpu_cached_op_count": _COUNTER,
+    "btpu_cached_byte_count": _COUNTER,
+    # -- overload-robustness scoreboard --------------------------------------
+    "btpu_deadline_exceeded_count": _COUNTER,
+    "btpu_shed_count": _COUNTER,
+    "btpu_client_deadline_exceeded_count": _COUNTER,
+    "btpu_retry_count": _COUNTER,
+    "btpu_retry_budget_exhausted_count": _COUNTER,
+    "btpu_hedge_fired_count": _COUNTER,
+    "btpu_hedge_win_count": _COUNTER,
+    "btpu_breaker_trip_count": _COUNTER,
+    "btpu_breaker_skip_count": _COUNTER,
+    "btpu_persist_retry_backlog": _COUNTER,
+    # -- observability -------------------------------------------------------
+    "btpu_op_get_count": _COUNTER,
+    "btpu_op_get_p50_us": _COUNTER,
+    "btpu_op_get_p99_us": _COUNTER,
+    "btpu_flight_event_count": _COUNTER,
+    "btpu_trace_span_count": _COUNTER,
+    "btpu_set_tracing": ("void", ("i32",)),
+    "btpu_histograms_json": ("i32", ("cstr", "u64", "u64*")),
+    "btpu_trace_spans_json": ("i32", ("u64", "cstr", "u64", "u64*")),
+    "btpu_flight_json": ("i32", ("cstr", "u64", "u64*")),
+    # -- client object cache -------------------------------------------------
+    "btpu_client_cache_configure": ("void", ("ptr", "u64")),
+    "btpu_client_cache_stats": ("i32", ("ptr", "u64*")),
+    # -- client-driven device fabric -----------------------------------------
+    "btpu_put_start_json": ("i32", ("ptr", "cstr", "u64", "u32", "u32", "cstr",
+                                    "cstr", "u64", "u64*")),
+    "btpu_put_complete": ("i32", ("ptr", "cstr")),
+    "btpu_put_cancel": ("i32", ("ptr", "cstr")),
+    "btpu_fabric_offer": ("i32", ("ptr", "cstr", "cstr", "u64", "u64", "u64",
+                                  "u64")),
+    "btpu_fabric_pull": ("i32", ("ptr", "cstr", "cstr", "u64", "u64", "u64",
+                                 "u64", "cstr")),
+    # -- erasure coding ------------------------------------------------------
+    "btpu_put_ec": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32",
+                            "i64", "i32")),
+    "btpu_put_ec2": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32",
+                             "i64", "i32", "i32")),
+    # -- introspection -------------------------------------------------------
+    "btpu_list_json": ("i32", ("ptr", "cstr", "u64", "cstr", "u64", "u64*")),
+    "btpu_exists": ("i32", ("ptr", "cstr", "i32*")),
+    "btpu_remove": ("i32", ("ptr", "cstr")),
+    "btpu_stats": ("i32", ("ptr", "u64*")),
+    "btpu_error_name": ("cstr", ("i32",)),
+    # -- HBM provider registration (storage/hbm_provider.h) ------------------
+    "btpu_register_hbm_provider_v3": ("void", ("ptr",)),
+    "btpu_register_hbm_provider_v4": ("void", ("ptr",)),
+    "btpu_register_hbm_provider_v5": ("void", ("ptr",)),
+}
+
+# Symbols a PREBUILT OLDER libbtpu.so may legitimately lack: binding skips
+# them with a record (native.have()), and callers either degrade explicitly
+# (hbm.py walks the provider version chain down) or raise a clear error
+# (cluster.py refuses data_dir without btpu_cluster_create_ex). Everything
+# NOT listed here is REQUIRED: a missing symbol fails the import loudly
+# instead of silently reporting 0 (the historic client.py:397 hasattr bug).
+# capi_check.py still requires every OPTIONAL name to exist in the headers —
+# optional means "may be absent from an old BINARY", never "unknown".
+OPTIONAL: Final[frozenset[str]] = frozenset({
+    "btpu_cluster_create_ex",
+    "btpu_histograms_json",
+    "btpu_trace_spans_json",
+    "btpu_flight_json",
+    "btpu_set_tracing",
+    "btpu_client_cache_configure",
+    "btpu_client_cache_stats",
+    "btpu_register_hbm_provider_v4",
+    "btpu_register_hbm_provider_v5",
+})
+
+
+# ---- native enum mirrors ---------------------------------------------------
+# Exact bijections (names AND values) of the native enums; capi_check.py
+# convicts any divergence against the parsed headers, and
+# tests/test_capi_boundary.py round-trips every ErrorCode value through the
+# live library's btpu_error_name().
+
+
+class ErrorCode(enum.IntEnum):
+    """Mirror of btpu::ErrorCode (native/include/btpu/common/error.h) —
+    complete, value-exact, machine-checked. Codes are domain-partitioned in
+    1000-blocks (error.h Domain)."""
+
+    OK = 0
+
+    # System (1000-1999)
+    INTERNAL_ERROR = 1000
+    INITIALIZATION_FAILED = 1001
+    INVALID_STATE = 1002
+    OPERATION_TIMEOUT = 1003
+    RESOURCE_EXHAUSTED = 1004
+    NOT_IMPLEMENTED = 1005
+    DEADLINE_EXCEEDED = 1006
+    RETRY_LATER = 1007
+
+    # Storage (2000-2999)
+    BUFFER_OVERFLOW = 2000
+    OUT_OF_MEMORY = 2001
+    MEMORY_POOL_NOT_FOUND = 2002
+    MEMORY_POOL_ALREADY_EXISTS = 2003
+    INVALID_MEMORY_POOL = 2004
+    ALLOCATION_FAILED = 2005
+    INSUFFICIENT_SPACE = 2006
+    MEMORY_ACCESS_ERROR = 2007
+
+    # Network (3000-3999)
+    NETWORK_ERROR = 3000
+    CONNECTION_FAILED = 3001
+    TRANSFER_FAILED = 3002
+    TRANSPORT_ERROR = 3003
+    INVALID_ADDRESS = 3004
+    REMOTE_ENDPOINT_ERROR = 3005
+    RPC_FAILED = 3006
+
+    # Coordination (4000-4999)
+    COORD_ERROR = 4000
+    COORD_KEY_NOT_FOUND = 4001
+    COORD_TRANSACTION_FAILED = 4002
+    COORD_LEASE_ERROR = 4003
+    COORD_WATCH_ERROR = 4004
+    LEADER_ELECTION_FAILED = 4005
+    SERVICE_REGISTRATION_FAILED = 4006
+    NOT_LEADER = 4007
+    FENCED = 4008
+
+    # Data (5000-5999)
+    OBJECT_NOT_FOUND = 5000
+    OBJECT_ALREADY_EXISTS = 5001
+    INVALID_KEY = 5002
+    INVALID_WORKER = 5003
+    WORKER_NOT_READY = 5004
+    NO_COMPLETE_WORKER = 5005
+    WORKER_DRAIN_INCOMPLETE = 5006
+    DATA_CORRUPTION = 5007
+    CHECKSUM_MISMATCH = 5008
+
+    # Client (6000-6999)
+    CLIENT_ERROR = 6000
+    CLIENT_NOT_FOUND = 6001
+    CLIENT_ALREADY_EXISTS = 6002
+    CLIENT_DISCONNECTED = 6003
+    SESSION_EXPIRED = 6004
+    INVALID_CLIENT_STATE = 6005
+
+    # Config (7000-7999)
+    CONFIG_ERROR = 7000
+    INVALID_CONFIGURATION = 7001
+    INVALID_PARAMETERS = 7002
+    MISSING_REQUIRED_FIELD = 7003
+    VALUE_OUT_OF_RANGE = 7004
+
+
+class StorageClass(enum.IntEnum):
+    """Mirror of btpu::StorageClass (btpu/common/types.h) — machine-checked."""
+
+    STORAGE_UNSPECIFIED = 0
+    RAM_CPU = 1
+    HBM_TPU = 2
+    NVME = 3
+    SSD = 4
+    HDD = 5
+    CXL_MEMORY = 6
+    CXL_TYPE2_DEVICE = 7
+    CUSTOM = 999
+
+
+class TransportKind(enum.IntEnum):
+    """Mirror of btpu::TransportKind (btpu/common/types.h) — machine-checked."""
+
+    TRANSPORT_UNSPECIFIED = 0
+    LOCAL = 1
+    SHM = 2
+    TCP = 3
+    ICI = 4
+    HBM = 5
+
+
+# The enum mirrors capi_check.py verifies, keyed by (header, native name).
+MIRRORED_ENUMS: Final[dict[str, type[enum.IntEnum]]] = {
+    "ErrorCode": ErrorCode,
+    "StorageClass": StorageClass,
+    "TransportKind": TransportKind,
+}

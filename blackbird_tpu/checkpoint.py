@@ -21,23 +21,29 @@ topology.
 from __future__ import annotations
 
 import json
-from typing import Any
+from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
+import numpy.typing as npt
+
+if TYPE_CHECKING:
+    from blackbird_tpu.client import Client
+    from blackbird_tpu.fabric import FabricClient
 
 _META_SUFFIX = "/meta"
 _SHARD_SUFFIX = "/shard/"
 
 
-def _index_to_boxes(index) -> list[list[int]]:
+def _index_to_boxes(index: Sequence[slice]) -> list[list[int]]:
     """A shard index (tuple of slices) -> [[start, stop], ...] per dim."""
-    boxes = []
+    boxes: list[list[int]] = []
     for sl in index:
         boxes.append([int(sl.start or 0), int(sl.stop) if sl.stop is not None else -1])
     return boxes
 
 
-def _boxes_to_index(boxes, shape) -> tuple[slice, ...]:
+def _boxes_to_index(boxes: Sequence[Sequence[int]],
+                    shape: Sequence[int]) -> tuple[slice, ...]:
     return tuple(
         slice(start, stop if stop >= 0 else dim)
         for (start, stop), dim in zip(boxes, shape)
@@ -49,7 +55,7 @@ def _box_name(boxes: list[list[int]]) -> str:
     return "x".join(f"{a}-{b}" for a, b in boxes) if boxes else "scalar"
 
 
-def _overwrite(client, key: str, do_put) -> None:
+def _overwrite(client: Client, key: str, do_put: Callable[[], None]) -> None:
     """Runs `do_put` with overwrite semantics: on OBJECT_ALREADY_EXISTS,
     remove + retry once.
 
@@ -72,17 +78,18 @@ def _overwrite(client, key: str, do_put) -> None:
     do_put()
 
 
-def _put_fresh(client, key: str, data, **kwargs) -> None:
+def _put_fresh(client: Client, key: str, data: Any, **kwargs: Any) -> None:
     _overwrite(client, key, lambda: client.put(key, data, **kwargs))
 
 
-def _is_device_class(preferred_class) -> bool:
+def _is_device_class(preferred_class: Any) -> bool:
     name = (preferred_class.name.lower() if hasattr(preferred_class, "name")
             else str(preferred_class or "")).lower()
     return name == "hbm_tpu"
 
 
-def _fabric_put_fresh(client, fabric, key: str, shard_data, kwargs) -> bool:
+def _fabric_put_fresh(client: Client, fabric: FabricClient, key: str,
+                      shard_data: Any, kwargs: dict[str, Any]) -> bool:
     """Fabric leg of the checkpoint writer: True when the shard landed over
     the fabric (with the same overwrite semantics as _put_fresh), False =
     use the staged byte path."""
@@ -90,7 +97,8 @@ def _fabric_put_fresh(client, fabric, key: str, shard_data, kwargs) -> bool:
 
     pc = kwargs.get("preferred_class")
     name = pc.name.lower() if hasattr(pc, "name") else (pc or "hbm_tpu")
-    fabric_kwargs = {"replicas": kwargs.get("replicas", 1), "preferred_class": name}
+    fabric_kwargs: dict[str, Any] = {"replicas": kwargs.get("replicas", 1),
+                                     "preferred_class": name}
     try:
         _overwrite(client, key, lambda: fabric.put(key, shard_data, **fabric_kwargs))
         return True
@@ -98,9 +106,9 @@ def _fabric_put_fresh(client, fabric, key: str, shard_data, kwargs) -> bool:
         return False
 
 
-def save_sharded(client, prefix: str, array, *, replicas: int = 1,
-                 preferred_class=None, ec: tuple[int, int] | None = None,
-                 fabric=None) -> None:
+def save_sharded(client: Client, prefix: str, array: Any, *, replicas: int = 1,
+                 preferred_class: Any = None, ec: tuple[int, int] | None = None,
+                 fabric: FabricClient | None = None) -> None:
     """Saves `array` (sharded or single-device) under `prefix`.
 
     With `fabric` (a `blackbird_tpu.FabricClient`), device-resident shard
@@ -124,7 +132,7 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
 
     if not isinstance(array, jax.Array):
         array = jax.numpy.asarray(array)
-    kwargs = {"replicas": replicas}
+    kwargs: dict[str, Any] = {"replicas": replicas}
     if ec is not None:
         # Checkpoints are the natural erasure-coding consumer: large, cold,
         # durability-critical. ec=(k, m) stores each shard object as one
@@ -186,7 +194,7 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
 
     if meta_owner.process_index != my_process:
         return
-    meta = {
+    meta: dict[str, Any] = {
         "global_shape": list(array.shape),
         "dtype": np.dtype(array.dtype).str,
         "shards": shards_meta,
@@ -213,7 +221,8 @@ def save_sharded(client, prefix: str, array, *, replicas: int = 1,
             pass
 
 
-def load_sharded(client, prefix: str, *, sharding=None, fabric=None):
+def load_sharded(client: Client, prefix: str, *, sharding: Any = None,
+                 fabric: FabricClient | None = None) -> Any:
     """Restores an array saved by `save_sharded`.
 
     With `sharding` (any `jax.sharding.Sharding`), returns a `jax.Array`
@@ -230,9 +239,9 @@ def load_sharded(client, prefix: str, *, sharding=None, fabric=None):
     dtype = np.dtype(meta["dtype"])
 
     # Source shards fetched lazily, at most once each.
-    cache: dict[str, np.ndarray] = {}
+    cache: dict[str, npt.NDArray[Any]] = {}
 
-    def fetch(shard_meta) -> np.ndarray:
+    def fetch(shard_meta: dict[str, Any]) -> npt.NDArray[Any]:
         key = shard_meta["key"]
         if key not in cache:
             if fabric is not None:
@@ -242,7 +251,7 @@ def load_sharded(client, prefix: str, *, sharding=None, fabric=None):
             cache[key] = raw.view(dtype).reshape(shard_meta["shape"])
         return cache[key]
 
-    def read_slice(index: tuple[slice, ...]) -> np.ndarray:
+    def read_slice(index: tuple[slice, ...]) -> npt.NDArray[Any]:
         """Assembles [index] of the global array from overlapping shards."""
         starts = [sl.start or 0 for sl in index]
         stops = [sl.stop if sl.stop is not None else dim
@@ -252,14 +261,15 @@ def load_sharded(client, prefix: str, *, sharding=None, fabric=None):
         for shard_meta in meta["shards"]:
             src_index = _boxes_to_index(shard_meta["boxes"], global_shape)
             # Overlap box between the request and this stored shard.
-            o_starts, o_stops = [], []
+            o_starts: list[int] = []
+            o_stops: list[int] = []
             for (a, b), sl in zip(zip(starts, stops), src_index):
                 o_starts.append(max(a, sl.start))
                 o_stops.append(min(b, sl.stop))
             if any(a >= b for a, b in zip(o_starts, o_stops)):
                 continue
             src = fetch(shard_meta)
-            src_sel = tuple(
+            src_sel: tuple[slice, ...] = tuple(
                 slice(a - sl.start, b - sl.start)
                 for a, b, sl in zip(o_starts, o_stops, src_index)
             )
@@ -281,7 +291,7 @@ def load_sharded(client, prefix: str, *, sharding=None, fabric=None):
     return jax.make_array_from_callback(global_shape, sharding, read_slice)
 
 
-def list_checkpoints(client, root: str = "") -> list[str]:
+def list_checkpoints(client: Client, root: str = "") -> list[str]:
     """Checkpoint prefixes under `root` (keys holding a readable meta).
 
     Discovery for resume-after-preemption: a restarting trainer lists
@@ -298,7 +308,7 @@ def list_checkpoints(client, root: str = "") -> list[str]:
     ]
 
 
-def remove_checkpoint(client, prefix: str) -> None:
+def remove_checkpoint(client: Client, prefix: str) -> None:
     """Deletes the metadata and every shard object of a checkpoint.
 
     The meta goes FIRST: a removal interrupted halfway must not leave a
@@ -306,7 +316,7 @@ def remove_checkpoint(client, prefix: str) -> None:
     The shard sweep then unions the prefix listing (orphans from
     interrupted saves, never listed in any meta) with the meta's own shard
     list (shards stranded mid-put are PENDING and invisible to listing)."""
-    shard_keys = set()
+    shard_keys: set[str] = set()
     try:
         meta = json.loads(bytes(client.get(prefix + _META_SUFFIX)))
         shard_keys.update(s["key"] for s in meta.get("shards", []))

@@ -3,10 +3,20 @@
 from __future__ import annotations
 
 import ctypes
+from typing import TYPE_CHECKING, Any, TypeAlias, cast
 
 import numpy as np
+import numpy.typing as npt
 
+from blackbird_tpu import native
 from blackbird_tpu.native import StorageClass, check, lib
+
+if TYPE_CHECKING:
+    from blackbird_tpu.cluster import EmbeddedCluster
+
+# Accepted put() payloads; ndarray dtype is irrelevant (raw bytes move).
+AnyArray: TypeAlias = "np.ndarray[Any, np.dtype[Any]]"
+Buffer: TypeAlias = "bytes | bytearray | memoryview | AnyArray"
 
 # Uninitialized bytes objects the C side fills in place: a fresh bytes of n
 # NULs (bytes(n), create_string_buffer) costs a zero-fill pass PLUS the copy
@@ -19,7 +29,7 @@ _PyBytes_FromStringAndSize.argtypes = [ctypes.c_char_p, ctypes.c_ssize_t]
 
 
 def _uninit_bytes(n: int) -> bytes:
-    return _PyBytes_FromStringAndSize(None, n)
+    return cast(bytes, _PyBytes_FromStringAndSize(None, n))
 
 
 def _bytes_addr(b: bytes) -> ctypes.c_void_p:
@@ -34,7 +44,7 @@ class Client:
     """
 
     def __init__(self, keystone_endpoint: str, *, verify: bool = True,
-                 cache_bytes: int | None = None):
+                 cache_bytes: int | None = None) -> None:
         """keystone_endpoint may be a comma-separated list ("host:a,host:b"):
         the first entry is the primary, the rest HA fallbacks the client
         rotates through on NOT_LEADER or connection failure.
@@ -49,8 +59,9 @@ class Client:
         and revalidated (one control RTT) at lease expiry. None reads the
         BTPU_CACHE_BYTES env var (unset/0 = off); see docs/OPERATIONS.md
         for sizing and lease tuning."""
-        self._cluster_ref = None
-        self._handle = lib.btpu_client_create_remote(keystone_endpoint.encode())
+        self._cluster_ref: EmbeddedCluster | None = None
+        self._handle: int | None = lib.btpu_client_create_remote(
+            keystone_endpoint.encode())
         if not self._handle:
             raise RuntimeError(f"cannot reach keystone at {keystone_endpoint}")
         if not verify:
@@ -66,8 +77,16 @@ class Client:
 
         if cache_bytes is None:
             cache_bytes = int(os.environ.get("BTPU_CACHE_BYTES", "0") or 0)
-        if cache_bytes and hasattr(lib, "btpu_client_cache_configure"):
-            lib.btpu_client_cache_configure(self._handle, cache_bytes)
+        if not cache_bytes:
+            return
+        # native.have(), not hasattr: the manifest says whether this build
+        # can cache; asking for a cache it cannot provide must raise, not
+        # silently serve uncached (docs/CORRECTNESS.md §11).
+        if not native.have("btpu_client_cache_configure"):
+            raise RuntimeError(
+                "cache_bytes requested but this libbtpu build has no client "
+                "object cache (btpu_client_cache_configure missing)")
+        lib.btpu_client_cache_configure(self._handle, cache_bytes)
 
     def cache_stats(self) -> dict[str, int]:
         """Object-cache counters (all zero when the cache is off):
@@ -76,14 +95,15 @@ class Client:
         lease_expiries (hits that revalidated), evictions (capacity), and
         the resident bytes/entries."""
         out = (ctypes.c_uint64 * 9)()
-        if hasattr(lib, "btpu_client_cache_stats"):
+        if native.have("btpu_client_cache_stats"):
             check(lib.btpu_client_cache_stats(self._handle, out), "cache_stats")
         keys = ("hits", "misses", "fills", "invalidations", "stale_rejects",
                 "lease_expiries", "evictions", "bytes", "entries")
         return dict(zip(keys, (int(v) for v in out)))
 
     @classmethod
-    def _embedded(cls, cluster, cache_bytes: int | None = None):
+    def _embedded(cls, cluster: EmbeddedCluster,
+                  cache_bytes: int | None = None) -> Client:
         self = cls.__new__(cls)
         self._cluster_ref = cluster  # keep alive
         self._handle = lib.btpu_client_create_embedded(cluster._handle)
@@ -95,7 +115,7 @@ class Client:
     def put(
         self,
         key: str,
-        data: bytes | bytearray | memoryview | np.ndarray,
+        data: Buffer,
         *,
         replicas: int = 1,
         max_workers: int = 4,
@@ -177,11 +197,12 @@ class Client:
         )
         return buffer if out.value == size.value else buffer[: out.value]
 
-    def get_array(self, key: str, dtype=np.uint8, shape=None) -> np.ndarray:
+    def get_array(self, key: str, dtype: npt.DTypeLike = np.uint8,
+                  shape: tuple[int, ...] | None = None) -> AnyArray:
         raw = np.frombuffer(self.get(key), dtype=dtype)
         return raw.reshape(shape) if shape is not None else raw
 
-    def get_into(self, key: str, out: np.ndarray) -> int:
+    def get_into(self, key: str, out: AnyArray) -> int:
         """Reads into a preallocated array; returns the object size."""
         assert out.flags["C_CONTIGUOUS"]
         got = ctypes.c_uint64()
@@ -195,11 +216,11 @@ class Client:
             ),
             f"get {key!r}",
         )
-        return got.value
+        return int(got.value)
 
     def put_many(
         self,
-        items: dict[str, bytes | bytearray | memoryview | np.ndarray],
+        items: dict[str, Buffer],
         *,
         replicas: int = 1,
         max_workers: int = 4,
@@ -213,7 +234,7 @@ class Client:
         bufs = (ctypes.c_void_p * n)()
         sizes = (ctypes.c_uint64 * n)()
         codes = (ctypes.c_int32 * n)()
-        keep_alive = []
+        keep_alive: list[bytes | AnyArray] = []
         for i, (key, data) in enumerate(items.items()):
             if isinstance(data, np.ndarray):
                 data = np.ascontiguousarray(data)
@@ -262,7 +283,7 @@ class Client:
         return [b if out_sizes[i] == len(b) else b[: out_sizes[i]]
                 for i, b in enumerate(buffers)]
 
-    def list(self, prefix: str = "", limit: int = 0) -> list[dict]:
+    def list(self, prefix: str = "", limit: int = 0) -> list[dict[str, Any]]:
         """Complete objects whose key starts with `prefix`, lexicographic:
         [{"key", "size", "copies", "soft_pin"}]. limit 0 = unlimited. No
         reference counterpart — its object map was not enumerable."""
@@ -279,9 +300,10 @@ class Client:
                                      cap, ctypes.byref(size)),
                   f"list {prefix!r}")
             if size.value <= cap:  # else grew between calls (concurrent puts)
-                return json.loads(buffer.raw[: size.value].decode())
+                return cast("list[dict[str, Any]]",
+                            json.loads(buffer.raw[: size.value].decode()))
 
-    def placements(self, key: str) -> list[dict]:
+    def placements(self, key: str) -> list[dict[str, Any]]:
         """Where the object's bytes live: one dict per copy, with shards
         carrying worker/pool/storage-class/transport and the location
         (memory address, device region, or file). Parity: the C++ SDK's
@@ -299,7 +321,8 @@ class Client:
                                            cap, ctypes.byref(size)),
                   f"placements {key!r}")
             if size.value <= cap:  # else grew between calls (repair/demotion)
-                return json.loads(buffer.raw[: size.value].decode())
+                return cast("list[dict[str, Any]]",
+                            json.loads(buffer.raw[: size.value].decode()))
 
     def drain_worker(self, worker_id: str) -> int:
         """Gracefully evacuates a LIVE worker (e.g. on a TPU preemption
@@ -311,7 +334,7 @@ class Client:
         check(lib.btpu_drain_worker(self._handle, worker_id.encode(),
                                     ctypes.byref(moved)),
               f"drain {worker_id!r}")
-        return moved.value
+        return int(moved.value)
 
     def exists(self, key: str) -> bool:
         flag = ctypes.c_int32()
@@ -340,8 +363,12 @@ class Client:
         process_vm_readv/writev (1 user-space copy per byte), staged =
         shm-staged TCP (2 copies), stream = socket payload (1 client-side
         copy + the kernel socket path), cached = the client object cache
-        (0 wire bytes, 1 user-space copy out of local memory). Keys missing
-        from older prebuilt libraries read as 0."""
+        (0 wire bytes, 1 user-space copy out of local memory). Every counter
+        symbol here is REQUIRED by the blackbird_tpu/_capi.py manifest:
+        binding fails at import if one is missing, so a 0 in this dict means
+        the count IS zero — the old hasattr guard that silently reported 0
+        for a missing (or worse, bound-without-restype, u64-truncating)
+        symbol is gone (docs/CORRECTNESS.md §11)."""
         names = {
             "pvm_ops": "btpu_pvm_op_count",
             "pvm_bytes": "btpu_pvm_byte_count",
@@ -394,17 +421,24 @@ class Client:
             "flight_events": "btpu_flight_event_count",
             "trace_spans": "btpu_trace_span_count",
         }
-        return {key: int(getattr(lib, fn)()) if hasattr(lib, fn) else 0
-                for key, fn in names.items()}
+        counters: dict[str, int] = {}
+        for key, fn_name in names.items():
+            # Direct call, no hasattr: every name is a required manifest
+            # symbol, typed u64 by _load(). An unknown name would raise
+            # AttributeError here — loudly, as drift should.
+            counters[key] = int(getattr(lib, fn_name)())
+        return counters
 
     @staticmethod
-    def _json_export(fn_name: str, *args):
+    def _json_export(fn_name: str, *args: Any) -> str:
         """Shared NULL-probe-then-fill pattern of the capi *_json exports.
         Retries when the dump GREW between probe and fill (a live process
-        records events continuously) — same loop as placements()/list()."""
-        fn = getattr(lib, fn_name, None)
-        if fn is None:
+        records events continuously) — same loop as placements()/list().
+        The *_json exports are OPTIONAL manifest symbols (prebuilt older
+        libraries); absent ones report an empty dump, explicitly."""
+        if not native.have(fn_name):
             return ""
+        fn = getattr(lib, fn_name)
         size = ctypes.c_uint64()
         check(fn(*args, None, 0, ctypes.byref(size)), fn_name)
         while True:
@@ -417,7 +451,7 @@ class Client:
                 return buffer.raw[: size.value].decode()
 
     @staticmethod
-    def histograms() -> list[dict]:
+    def histograms() -> list[dict[str, Any]]:
         """Every registered latency histogram in this process (op families,
         keystone RPC methods, data-plane ops, WAL sync, uring send):
         count/sum plus bucket-interpolated p50/p99 and the non-zero
@@ -425,10 +459,10 @@ class Client:
         Prometheus _bucket/_sum/_count series."""
         import json
         body = Client._json_export("btpu_histograms_json")
-        return json.loads(body) if body else []
+        return cast("list[dict[str, Any]]", json.loads(body)) if body else []
 
     @staticmethod
-    def trace_spans(trace_id: int = 0) -> list[dict]:
+    def trace_spans(trace_id: int = 0) -> list[dict[str, Any]]:
         """Completed spans in this process's span ring (optionally filtered
         to one 64-bit trace id). Each record carries name, trace/span/parent
         ids (hex), start_us/dur_us on the host-wide monotonic clock, and
@@ -436,30 +470,33 @@ class Client:
         import json
         body = Client._json_export("btpu_trace_spans_json",
                                    ctypes.c_uint64(trace_id))
-        return [json.loads(line) for line in body.splitlines() if line.strip()]
+        return [cast("dict[str, Any]", json.loads(line))
+                for line in body.splitlines() if line.strip()]
 
     @staticmethod
-    def flight_events() -> list[dict]:
+    def flight_events() -> list[dict[str, Any]]:
         """The process flight recorder: the last N structured events (op
         start/end, retries, hedges, sheds, cache hits/misses, WAL
         append/sync, uring submit/complete), oldest first."""
         import json
         body = Client._json_export("btpu_flight_json")
-        return [json.loads(line) for line in body.splitlines() if line.strip()]
+        return [cast("dict[str, Any]", json.loads(line))
+                for line in body.splitlines() if line.strip()]
 
     @staticmethod
     def set_tracing(on: bool) -> None:
         """Master tracing switch (trace-id minting + span recording + flight
-        events). Default from BTPU_TRACING (on)."""
-        if hasattr(lib, "btpu_set_tracing"):
-            lib.btpu_set_tracing(ctypes.c_int32(1 if on else 0))
+        events). Default from BTPU_TRACING (on). No-op on prebuilt older
+        libraries without the switch (OPTIONAL manifest symbol)."""
+        if native.have("btpu_set_tracing"):
+            lib.btpu_set_tracing(1 if on else 0)
 
     def close(self) -> None:
         if self._handle:
             lib.btpu_client_destroy(self._handle)
             self._handle = None
 
-    def __del__(self):
+    def __del__(self) -> None:
         try:
             self.close()
         except Exception:

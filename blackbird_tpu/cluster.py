@@ -3,8 +3,13 @@
 from __future__ import annotations
 
 import ctypes
+from typing import TYPE_CHECKING
 
+from blackbird_tpu import native
 from blackbird_tpu.native import StorageClass, TransportKind, lib
+
+if TYPE_CHECKING:
+    from blackbird_tpu.client import Client
 
 
 class EmbeddedCluster:
@@ -26,12 +31,13 @@ class EmbeddedCluster:
         tiered_device_bytes: int | None = None,
         data_dir: str | None = None,
         group_commit_us: int = -1,
-    ):
+    ) -> None:
         """data_dir arms coordinator persistence: a new cluster on the SAME
         dir recovers every acked durable object (inline tier — RAM pool
         bytes die with the process by design). group_commit_us tunes the
         WAL group-commit window (0 = fdatasync per record, <0 = env/500us
         default); see docs/OPERATIONS.md "Durability"."""
+        self._handle: int | None
         if tiered_device_bytes is not None:
             if data_dir is not None:
                 raise ValueError("data_dir is not supported with tiered clusters")
@@ -39,7 +45,11 @@ class EmbeddedCluster:
                 workers, tiered_device_bytes, pool_bytes
             )
         elif data_dir is not None:
-            if not hasattr(lib, "btpu_cluster_create_ex"):
+            # Manifest-backed capability probe (native.have, not hasattr):
+            # btpu_cluster_create_ex is an OPTIONAL symbol a prebuilt older
+            # library may lack, and asking for durability it cannot provide
+            # must raise, not degrade.
+            if not native.have("btpu_cluster_create_ex"):
                 raise RuntimeError("this libbtpu build has no durable-cluster support")
             self._handle = lib.btpu_cluster_create_ex(
                 workers, pool_bytes, int(storage_class), int(transport),
@@ -52,7 +62,7 @@ class EmbeddedCluster:
         if not self._handle:
             raise RuntimeError("embedded cluster failed to start")
 
-    def client(self, cache_bytes: int | None = None):
+    def client(self, cache_bytes: int | None = None) -> Client:
         from blackbird_tpu.client import Client
 
         return Client._embedded(self, cache_bytes=cache_bytes)
@@ -69,12 +79,12 @@ class EmbeddedCluster:
         out = (ctypes.c_uint64 * 6)()
         lib.btpu_cluster_counters(self._handle, out)
         return {
-            "objects_repaired": out[0],
-            "objects_lost": out[1],
-            "evicted": out[2],
-            "gc_collected": out[3],
-            "workers_lost": out[4],
-            "objects_demoted": out[5],
+            "objects_repaired": int(out[0]),
+            "objects_lost": int(out[1]),
+            "evicted": int(out[2]),
+            "gc_collected": int(out[3]),
+            "workers_lost": int(out[4]),
+            "objects_demoted": int(out[5]),
         }
 
     def close(self) -> None:
@@ -82,13 +92,13 @@ class EmbeddedCluster:
             lib.btpu_cluster_destroy(self._handle)
             self._handle = None
 
-    def __enter__(self):
+    def __enter__(self) -> EmbeddedCluster:
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.close()
 
-    def __del__(self):
+    def __del__(self) -> None:
         try:
             self.close()
         except Exception:

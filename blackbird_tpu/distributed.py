@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import tempfile
 from pathlib import Path
+from typing import Any
 
 
 _initialized = False
@@ -99,7 +100,7 @@ def _advertise_host_for(coord_endpoints: str) -> str:
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
             probe.connect((host or first, int(port) if port else 9300))
-            return probe.getsockname()[0]
+            return str(probe.getsockname()[0])
     except OSError:
         try:
             return socket.gethostbyname(socket.gethostname())
@@ -132,9 +133,9 @@ def worker_config_for_this_host(
 
     from blackbird_tpu.worker import write_worker_yaml
 
-    process_index = jax.process_index()
+    process_index = int(jax.process_index())
     worker_id = f"{cluster_id}-host{process_index}"
-    pools = [
+    pools: list[dict[str, Any]] = [
         {"id": f"{worker_id}-hbm-{d}", "storage_class": "hbm_tpu",
          "capacity": pool_bytes_per_device, "device_id": f"tpu:{d}"}
         for d in range(len(jax.local_devices()))
@@ -157,7 +158,7 @@ def worker_config_for_this_host(
 
 def serve(coord_endpoints: str, *, pool_bytes_per_device: int,
           dram_pool_bytes: int = 0, cluster_id: str = "blackbird",
-          keystone_endpoints: str | None = None, **config_kwargs) -> int:
+          keystone_endpoints: str | None = None, **config_kwargs: Any) -> int:
     """Derives this host's worker config and runs the worker host until a
     signal arrives; SIGTERM (the preemption notice) drains through
     `keystone_endpoints` first when given. Blocks; returns the exit code."""

@@ -27,6 +27,7 @@ import ctypes
 import json
 import secrets
 from concurrent.futures import ThreadPoolExecutor
+from typing import TYPE_CHECKING, Any, TypeAlias
 
 import numpy as np
 
@@ -34,7 +35,16 @@ from blackbird_tpu.client import Client
 from blackbird_tpu.native import check, lib
 from blackbird_tpu.transferlink import TransferLink
 
+if TYPE_CHECKING:
+    from concurrent.futures import Future
+
 __all__ = ["FabricClient", "FabricUnavailable"]
+
+# Offer command tuple: (key, transport, endpoint, remote_addr, rkey, length,
+# transfer_id). Values come from the placements JSON.
+_OfferCmd: TypeAlias = "tuple[str, str, str, int, int, int, int]"
+# A staged shard awaiting pull: (fabric_addr, transfer_id, length).
+_PendingPull: TypeAlias = "tuple[str, int, int]"
 
 
 class FabricUnavailable(RuntimeError):
@@ -51,9 +61,12 @@ class FabricClient:
     mirrors the worker-side provider (hbm.py) one-server-per-process rule.
     """
 
-    def __init__(self, client: Client, jax_module=None, link=None):
+    def __init__(self, client: Client, jax_module: Any = None,
+                 link: TransferLink | None = None) -> None:
         if jax_module is None:
-            import jax as jax_module  # noqa: PLC0415 - optional heavy import
+            import jax  # noqa: PLC0415 - optional heavy import
+
+            jax_module = jax
         self._client = client
         self._jax = jax_module
         # Shared fabric lifecycle (server, connections, offer GC) — the same
@@ -66,14 +79,14 @@ class FabricClient:
         self.fabric_gets = 0
         self.fabric_puts = 0
 
-    def _no_server(self) -> "FabricUnavailable":
+    def _no_server(self) -> FabricUnavailable:
         reason = self._link.unavailable_reason
         return FabricUnavailable(
             "no transfer server in this process"
             + (f" ({reason})" if reason else ""))
 
     @staticmethod
-    def _eligible(copy: dict) -> bool:
+    def _eligible(copy: dict[str, Any]) -> bool:
         shards = copy.get("shards", [])
         if not shards or "ec" in copy:
             return False
@@ -83,7 +96,7 @@ class FabricClient:
 
     # -- fabric get ---------------------------------------------------------
 
-    def get(self, key: str):
+    def get(self, key: str) -> Any:
         """Returns the object as a uint8[size] jax.Array on this process's
         device, pulled shard-by-shard over the fabric. Raises
         FabricUnavailable when no copy is fully fabric-reachable (caller
@@ -101,7 +114,7 @@ class FabricClient:
             # pending: offers commanded but not yet pulled — drained on ANY
             # failure so a mid-list error cannot strand shards pinned in
             # worker device memory until the 60s stale-offer GC.
-            pending = []
+            pending: list[_PendingPull] = []
             try:
                 # Phase 1: command every worker to offer its shard (the
                 # workers stage concurrently); phase 2: pull them in order.
@@ -116,7 +129,7 @@ class FabricClient:
                             loc.get("rkey", 0), shard["length"], tid),
                         f"fabric offer {key!r}")
                     pending.append((shard["fabric"], tid, shard["length"]))
-                parts = []
+                parts: list[Any] = []
                 while pending:
                     addr, tid, length = pending[0]
                     parts.append(self._link.pull(addr, tid, length))
@@ -139,14 +152,14 @@ class FabricClient:
         """Fabric get with a transparent staged fallback; returns host bytes
         (the convenience shape for checkpoint tooling)."""
         try:
-            return np.asarray(self.get(key)).tobytes()
+            return bytes(np.asarray(self.get(key)).tobytes())
         except FabricUnavailable:
             return self._client.get(key)
 
     # Shard-offer command: blocks until the worker has staged the range
     # onto its fabric server. cmd = (key, transport, endpoint, remote_addr,
     # rkey, length, tid).
-    def _command_offer(self, cmd):
+    def _command_offer(self, cmd: _OfferCmd) -> None:
         key, transport, endpoint, raddr, rkey, length, tid = cmd
         check(
             lib.btpu_fabric_offer(self._client._handle, transport.encode(),
@@ -158,12 +171,12 @@ class FabricClient:
     # against ONE worker only adds contention — measured slower). `landed`
     # collects tids whose offers definitely staged, so a partial failure
     # drains exactly those (pulling a never-landed id could block).
-    def _command_offers(self, cmds, landed: set):
-        by_endpoint: dict[str, list] = {}
+    def _command_offers(self, cmds: list[_OfferCmd], landed: set[int]) -> None:
+        by_endpoint: dict[str, list[_OfferCmd]] = {}
         for cmd in cmds:
             by_endpoint.setdefault(cmd[2], []).append(cmd)
 
-        def _run(group):
+        def _run(group: list[_OfferCmd]) -> None:
             for cmd in group:
                 self._command_offer(cmd)
                 landed.add(cmd[6])  # set.add is atomic under the GIL
@@ -175,7 +188,7 @@ class FabricClient:
             for f in [pool.submit(_run, g) for g in by_endpoint.values()]:
                 f.result()
 
-    def get_many(self, keys: list[str], *, pipeline_ahead: int = 0) -> list:
+    def get_many(self, keys: list[str], *, pipeline_ahead: int = 0) -> list[Any]:
         """Fabric gets with the metadata phase hoisted (all placements
         resolved before the first byte moves) and each key's offers
         commanded just-in-time — a striped key's workers stage in parallel,
@@ -193,13 +206,15 @@ class FabricClient:
         jnp = self._jax.numpy
         if self._link.address() is None:
             raise self._no_server()
-        plan = []  # per key: (cmds, shards=(fabric_addr, tid, length))
+        # per key: (offer cmds, shards to pull)
+        plan: list[tuple[list[_OfferCmd], list[_PendingPull]]] = []
         for key in keys:
             copies = self._client.placements(key)
             copy = next((c for c in copies if self._eligible(c)), None)
             if copy is None:
                 raise FabricUnavailable(f"no fabric-reachable copy of {key!r}")
-            cmds, shards = [], []
+            cmds: list[_OfferCmd] = []
+            shards: list[_PendingPull] = []
             for shard in copy["shards"]:
                 loc = shard["location"]
                 tid = secrets.randbits(63)
@@ -211,16 +226,17 @@ class FabricClient:
 
         landed: set[int] = set()  # tids whose offer command succeeded
         pulled: set[int] = set()  # tids this thread consumed
-        prefetch = None  # in-flight offer commands for the NEXT key
+        # In-flight offer commands for the NEXT key.
+        prefetch: Future[None] | None = None
         try:
             self._command_offers(plan[0][0], landed)
-            out = []
+            out: list[Any] = []
             with ThreadPoolExecutor(max_workers=1) as ahead:
                 for k, (_cmds, shards) in enumerate(plan):
                     if pipeline_ahead > 0 and k + 1 < len(plan):
                         prefetch = ahead.submit(self._command_offers, plan[k + 1][0],
                                                 landed)
-                    parts = []
+                    parts: list[Any] = []
                     for addr, tid, length in shards:
                         parts.append(self._link.pull(addr, tid, length))
                         pulled.add(tid)
@@ -252,7 +268,7 @@ class FabricClient:
 
     # -- fabric put ---------------------------------------------------------
 
-    def put(self, key: str, data, *, replicas: int = 1, max_workers: int = 4,
+    def put(self, key: str, data: Any, *, replicas: int = 1, max_workers: int = 4,
             preferred_class: str = "hbm_tpu") -> None:
         """Stores `data` (jax.Array / numpy, any dtype) under `key` with the
         bytes moving over the fabric: this process offers each shard range
@@ -325,7 +341,8 @@ class FabricClient:
             lib.btpu_put_cancel(handle, key.encode())
             raise
 
-    def put_many(self, items: dict, *, replicas: int = 1, max_workers: int = 4,
+    def put_many(self, items: dict[str, Any], *, replicas: int = 1,
+                 max_workers: int = 4,
                  preferred_class: str = "hbm_tpu") -> None:
         """Fabric puts with the command phase pipelined across keys: every
         local offer is registered and every worker-side pull commanded
@@ -360,7 +377,7 @@ class FabricClient:
                     raise FabricUnavailable(f"placements for {key!r} exceed {len(buf)} bytes")
                 copies = json.loads(buf.raw[: out_len.value].decode())
                 pushed = 0
-                pull_cmds = []  # this key's (key, transport, endpoint, raddr, rkey, n, tid)
+                pull_cmds: list[_OfferCmd] = []  # this key's pull commands
                 for copy in copies:
                     if not self._eligible(copy):
                         continue
@@ -387,7 +404,7 @@ class FabricClient:
                 # unpulled bytes bounded (offering the whole batch up front
                 # was measured slower — staged arrays evict each other from
                 # cache before their pulls arrive).
-                def _pull_endpoint(cmds):
+                def _pull_endpoint(cmds: list[_OfferCmd]) -> None:
                     for pkey, transport, endpoint, raddr, rkey, n, tid in cmds:
                         check(
                             lib.btpu_fabric_pull(handle, transport.encode(),
@@ -395,7 +412,7 @@ class FabricClient:
                                                  tid, addr.encode()),
                             f"fabric pull {pkey!r}")
 
-                by_endpoint: dict[str, list] = {}
+                by_endpoint: dict[str, list[_OfferCmd]] = {}
                 for cmd in pull_cmds:
                     by_endpoint.setdefault(cmd[2], []).append(cmd)
                 if len(by_endpoint) <= 1:

@@ -46,11 +46,21 @@ import ctypes
 import os
 import threading
 from functools import partial
+from typing import Any, Callable, TypeAlias
 import warnings
 
 import numpy as np
+import numpy.typing as npt
 
+from blackbird_tpu import native
 from blackbird_tpu.native import lib
+
+# One I/O vector as the C ABI hands it over: (region_id, offset, buf
+# pointer, length). A region's bookkeeping dict and the staging machinery
+# stay Any-valued — they hold jax arrays, devices, locks, and executors,
+# none of which have stable typed surfaces.
+_Vec: TypeAlias = "tuple[int, int, int, int]"
+_Region: TypeAlias = "dict[str, Any]"
 
 _u64 = ctypes.c_uint64
 
@@ -113,7 +123,7 @@ class JaxHbmProvider:
     """Page-batched device-buffer regions managed through JAX."""
 
     def __init__(self, page_bytes: int = 64 << 10, max_staging_bytes: int = 32 << 20,
-                 host_view: str | bool = "auto"):
+                 host_view: str | bool = "auto") -> None:
         import jax
 
         # Donation is an optimization (in-place region updates); backends
@@ -148,10 +158,10 @@ class JaxHbmProvider:
         # transfer of round N (two staging slots per device).
         self.max_staging_bytes = max_staging_bytes
         self._lock = threading.Lock()            # region table
-        self._regions: dict[int, dict] = {}
+        self._regions: dict[int, _Region] = {}
         self._view_regions = 0                   # count with a host view
         self._next_id = 1
-        self._struct = None                      # built in register()
+        self._struct: _ProviderStruct | None = None  # built in register()
         self._dirty: set[int] = set()            # regions with in-flight writes
         self.copy_calls = 0                      # device-to-device copies served
         # Reusable host staging buffers: re-faulting a fresh multi-MiB array
@@ -164,7 +174,7 @@ class JaxHbmProvider:
         # entry lock may take a region lock inside; nothing takes an entry
         # lock while holding a region lock (synchronize releases region
         # locks first).
-        self._staging: dict = {}
+        self._staging: dict[Any, dict[str, Any]] = {}
         self._staging_lock = threading.Lock()
         # Cross-process device fabric: the shared per-process transfer
         # endpoint (server + connections + offer GC) lives in TransferLink,
@@ -185,7 +195,7 @@ class JaxHbmProvider:
         # executables per region shape. Duplicate page indices within one
         # batch would scatter in undefined order — the host-side caller
         # routes those batches through the per-vec fallback.
-        def write_pages(region, pages, meta):
+        def write_pages(region: Any, pages: Any, meta: Any) -> Any:
             idx, v0, v1 = meta[0], meta[1], meta[2]
             cur = region.at[idx].get(mode="clip")
             io = jnp.arange(P, dtype=jnp.int32)
@@ -207,10 +217,10 @@ class JaxHbmProvider:
         # DEVICE from a scalar start, saving one host->device transfer per
         # op (device links pay per-operation latency). Cached per padded run
         # length, so the jit cache stays log2-bounded like the idx paths.
-        self._read_run_fns: dict[int, object] = {}
-        self._write_run_fns: dict[int, object] = {}
+        self._read_run_fns: dict[int, Any] = {}
+        self._write_run_fns: dict[int, Any] = {}
 
-    def _read_run_fn(self, m: int):
+    def _read_run_fn(self, m: int) -> Any:
         fn = self._read_run_fns.get(m)
         if fn is None:
             jnp = self._jax.numpy
@@ -218,12 +228,12 @@ class JaxHbmProvider:
                 lambda r, p0: r.at[p0 + jnp.arange(m, dtype=jnp.int32)].get(mode="clip"))
         return fn
 
-    def _write_run_fn(self, m: int):
+    def _write_run_fn(self, m: int) -> Any:
         fn = self._write_run_fns.get(m)
         if fn is None:
             jnp = self._jax.numpy
 
-            def set_run(r, pages, p0, n_valid):
+            def set_run(r: Any, pages: Any, p0: Any, n_valid: Any) -> Any:
                 k = jnp.arange(m, dtype=jnp.int32)
                 # Padding rows get an out-of-bounds index -> dropped.
                 idx = jnp.where(k < n_valid, p0 + k, r.shape[0])
@@ -234,7 +244,7 @@ class JaxHbmProvider:
 
     # -- device helpers ----------------------------------------------------
 
-    def _device_for(self, device_id: str):
+    def _device_for(self, device_id: str) -> Any:
         devices = self._jax.local_devices()
         if ":" in device_id:
             try:
@@ -247,7 +257,8 @@ class JaxHbmProvider:
 
     # -- provider callbacks ------------------------------------------------
 
-    def _alloc(self, _ctx, device_id, size, out_id):
+    def _alloc(self, _ctx: Any, device_id: bytes | None, size: int,
+               out_id: Any) -> int:
         try:
             jnp = self._jax.numpy
             device = self._device_for(device_id.decode() if device_id else "tpu:0")
@@ -284,7 +295,8 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001 - must not raise through the C ABI
             return 1
 
-    def _probe_host_view(self, buf, device, n_pages):
+    def _probe_host_view(self, buf: Any, device: Any,
+                         n_pages: int) -> npt.NDArray[np.uint8] | None:
         """A writable zero-copy alias of `buf`'s memory, or None.
 
         Gated on the platform claiming host-addressable buffers, then PROVEN
@@ -309,7 +321,7 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001 - fall back to the device path
             return None
 
-    def _free(self, _ctx, region_id):
+    def _free(self, _ctx: Any, region_id: int) -> int:
         with self._lock:
             self._dirty.discard(region_id)
             region = self._regions.pop(region_id, None)
@@ -319,7 +331,9 @@ class JaxHbmProvider:
 
     # -- page decomposition (host-side, pure numpy) ------------------------
 
-    def _decompose(self, vecs):
+    def _decompose(
+        self, vecs: list[_Vec],
+    ) -> tuple[dict[int, _Region], dict[int, list[Any]]]:
         """Validates vecs and groups them by region.
 
         Returns {region_id: (region, spans)} where spans is a list of
@@ -329,7 +343,7 @@ class JaxHbmProvider:
         P = self.page_bytes
         with self._lock:
             regions = dict(self._regions)
-        grouped: dict[int, list] = {}
+        grouped: dict[int, list[Any]] = {}
         for region_id, offset, buf, length in vecs:
             region = regions.get(region_id)
             if region is None or offset + length > region["size"]:
@@ -349,7 +363,7 @@ class JaxHbmProvider:
         return regions, grouped
 
     @staticmethod
-    def _join_pending(slot) -> None:
+    def _join_pending(slot: dict[str, Any]) -> None:
         """Joins a slot's in-flight dispatch without consuming it (the
         result is cached, so a later join is free). Fences are appended by
         the dispatcher thread; a slot's fence list is only complete — and
@@ -363,7 +377,7 @@ class JaxHbmProvider:
                 pass
 
     @staticmethod
-    def _await_fences(entry) -> None:
+    def _await_fences(entry: dict[str, Any]) -> None:
         """Blocks until every fence for `entry`'s buffer has executed.
 
         Fences are never donated (this provider holds their only reference),
@@ -378,7 +392,7 @@ class JaxHbmProvider:
                 pass
         entry["fences"] = []
 
-    def _staging_entry(self, dev) -> dict:
+    def _staging_entry(self, dev: Any) -> dict[str, Any]:
         with self._staging_lock:
             entry = self._staging.get(dev)
             if entry is None:
@@ -406,7 +420,8 @@ class JaxHbmProvider:
                 }
             return entry
 
-    def _staging_for(self, entry, rows: int, page_bytes: int):
+    def _staging_for(self, entry: dict[str, Any], rows: int,
+                     page_bytes: int) -> tuple[npt.NDArray[np.uint8], dict[str, Any]]:
         """A reusable (rows, page) host staging view for one device, plus
         the slot whose fences the caller must append its dispatches to.
 
@@ -430,8 +445,9 @@ class JaxHbmProvider:
             buf = slot["buf"] = np.empty((rows, page_bytes), dtype=np.uint8)
         return buf[:rows], slot
 
-    def _run_single_round(self, flat, slot, region, region_id, p0, n,
-                          m_padded) -> None:
+    def _run_single_round(self, flat: Any, slot: dict[str, Any], region: _Region,
+                          region_id: int, p0: int, n: int,
+                          m_padded: int) -> None:
         """Dispatcher-thread body for the single-region single-run fast path
         (no meta array: the scatter index is p0 + arange on device)."""
         dev_flat = self._jax.device_put(flat, region["device"])
@@ -443,7 +459,9 @@ class JaxHbmProvider:
             if region_id in self._regions:
                 self._dirty.add(region_id)
 
-    def _run_device_round(self, flat, meta, dev, layouts, slot, regions) -> None:
+    def _run_device_round(self, flat: Any, meta: Any, dev: Any,
+                          layouts: list[Any], slot: dict[str, Any],
+                          regions: dict[int, _Region]) -> None:
         """Dispatcher-thread body shared by the aligned and generic write
         paths: ONE H2D of the filled staging segment + metadata, then each
         region's donated merge over its slice, fence append, dirty mark."""
@@ -465,7 +483,8 @@ class JaxHbmProvider:
                 if region_id in self._regions:
                     self._dirty.add(region_id)
 
-    def _dispatch(self, entry, slot, fn, futures: list) -> None:
+    def _dispatch(self, entry: dict[str, Any], slot: dict[str, Any],
+                  fn: Callable[[], None], futures: list[Any]) -> None:
         """Queues `fn` (device_put + merge dispatches for one filled slot)
         on the device's dispatcher thread. The caller thread is then free to
         fill the next slot while this round's H2D occupies the link. Every
@@ -477,8 +496,8 @@ class JaxHbmProvider:
         futures.append(fut)
 
     @staticmethod
-    def _join_dispatches(futures: list) -> None:
-        err = None
+    def _join_dispatches(futures: list[Any]) -> None:
+        err: Exception | None = None
         for fut in futures:  # settle ALL before raising: slots stay sane
             try:
                 fut.result()
@@ -489,7 +508,9 @@ class JaxHbmProvider:
 
     # -- aligned fast path -------------------------------------------------
 
-    def _aligned_runs(self, vecs, *, check_overlap: bool):
+    def _aligned_runs(
+        self, vecs: list[_Vec], *, check_overlap: bool,
+    ) -> tuple[dict[int, _Region], dict[int, list[Any]]] | None:
         """Groups whole-page-aligned vecs as (page0, n_pages, host_view) runs.
 
         Returns (regions, {region_id: [runs]}) when EVERY vec is page-aligned
@@ -500,7 +521,7 @@ class JaxHbmProvider:
         P = self.page_bytes
         with self._lock:
             regions = dict(self._regions)
-        per_region: dict[int, list] = {}
+        per_region: dict[int, list[Any]] = {}
         for region_id, offset, buf, length in vecs:
             if length == 0:
                 continue
@@ -522,7 +543,8 @@ class JaxHbmProvider:
                     last_end = p0 + n
         return regions, per_region
 
-    def _write_vecs_aligned(self, regions, per_region) -> None:
+    def _write_vecs_aligned(self, regions: dict[int, _Region],
+                            per_region: dict[int, list[Any]]) -> None:
         """Whole-page batch write: one BULK staging copy per run (the
         generic span path below fills page by page in Python — on 64x1MiB
         batches that loop cost more than the copy itself), then the same
@@ -538,11 +560,11 @@ class JaxHbmProvider:
         does."""
         P = self.page_bytes
         cap = max(1, self.max_staging_bytes // P)
-        round_pr: dict[int, list] = {}
+        round_pr: dict[int, list[Any]] = {}
         count = 0
-        futures: list = []
+        futures: list[Any] = []
 
-        def flush_round():
+        def flush_round() -> None:
             nonlocal round_pr, count
             if round_pr:
                 self._write_aligned_round(regions, round_pr, futures)
@@ -565,7 +587,9 @@ class JaxHbmProvider:
         finally:
             self._join_dispatches(futures)
 
-    def _write_aligned_round(self, regions, per_region, futures: list) -> None:
+    def _write_aligned_round(self, regions: dict[int, _Region],
+                             per_region: dict[int, list[Any]],
+                             futures: list[Any]) -> None:
         """Fills staging for one round on the CALLER thread, then queues the
         device work (H2D + merge dispatch) on the device's dispatcher thread
         — the caller immediately proceeds to fill the next round's slot, so
@@ -594,12 +618,12 @@ class JaxHbmProvider:
                                 region_id, p0, n, m_padded),
                         futures)
                 return
-        by_device: dict = {}
+        by_device: dict[Any, list[Any]] = {}
         for region_id, runs in per_region.items():
             by_device.setdefault(regions[region_id]["device"], []).append(
                 (region_id, runs))
         for dev, entries in by_device.items():
-            layouts = []  # (region_id, start_row, m_padded, runs)
+            layouts: list[Any] = []  # (region_id, start_row, m_padded, runs)
             total_rows = 0
             for region_id, runs in entries:
                 m_padded = _pow2_at_least(sum(n for _p0, n, _h in runs))
@@ -628,7 +652,7 @@ class JaxHbmProvider:
 
     # -- host-view fast path -----------------------------------------------
 
-    def _serve_view_vecs(self, vecs, *, is_write):
+    def _serve_view_vecs(self, vecs: list[_Vec], *, is_write: bool) -> list[_Vec]:
         """Serves vecs whose region has a host view; returns the remainder.
 
         Pure memcpy, no locks: writes are synchronous (nothing to flush) and
@@ -641,7 +665,7 @@ class JaxHbmProvider:
             if self._view_regions == 0:
                 return vecs
             regions = dict(self._regions)
-        rest = []
+        rest: list[_Vec] = []
         for vec in vecs:
             region_id, offset, buf, length = vec
             region = regions.get(region_id)
@@ -663,7 +687,7 @@ class JaxHbmProvider:
 
     # -- batched write -----------------------------------------------------
 
-    def _write_vecs(self, vecs):
+    def _write_vecs(self, vecs: list[_Vec]) -> None:
         vecs = self._serve_view_vecs(vecs, is_write=True)
         if not vecs:
             return
@@ -681,10 +705,10 @@ class JaxHbmProvider:
         # into ordered chunks with unique page indices (duplicates only occur
         # when one batch writes the same page twice — later chunks land in
         # later rounds, preserving write order).
-        chunks: list[tuple[int, list]] = []
+        chunks: list[tuple[int, list[Any]]] = []
         for region_id, spans in grouped.items():
             seen: set[int] = set()
-            cur: list = []
+            cur: list[Any] = []
             for span in spans:
                 if span[0] in seen:
                     chunks.append((region_id, cur))
@@ -700,8 +724,8 @@ class JaxHbmProvider:
         # region, so counting raw spans would let it grow to ~2x the cap.
         max_pages = max(1, self.max_staging_bytes // P)
         max_pages = 1 << (max_pages.bit_length() - 1)  # pow2 so splits fit
-        rounds: list[dict[int, list]] = []
-        current: dict[int, list] = {}
+        rounds: list[dict[int, list[Any]]] = []
+        current: dict[int, list[Any]] = {}
         count = 0
         for region_id, spans in chunks:
             if region_id in current or count + _pow2_at_least(len(spans)) > max_pages:
@@ -717,7 +741,7 @@ class JaxHbmProvider:
         if current:
             rounds.append(current)
 
-        futures: list = []
+        futures: list[Any] = []
         try:
             for round_spans in rounds:
                 # Group regions by device; per device, build ONE flat (M, P)
@@ -727,12 +751,12 @@ class JaxHbmProvider:
                 # next round — same pipeline as the aligned path). Each
                 # region then runs one donated scan over its segment of the
                 # staging array.
-                by_device: dict = {}
+                by_device: dict[Any, list[Any]] = {}
                 for region_id, spans in round_spans.items():
                     dev = regions[region_id]["device"]
                     by_device.setdefault(dev, []).append((region_id, spans))
                 for dev, entries in by_device.items():
-                    layouts = []  # (region_id, start_row, m_padded, spans)
+                    layouts: list[Any] = []  # (region_id, start_row, m_padded, spans)
                     total = 0
                     for region_id, spans in entries:
                         m_padded = _pow2_at_least(len(spans))
@@ -764,13 +788,14 @@ class JaxHbmProvider:
 
     # -- batched read ------------------------------------------------------
 
-    def _read_vecs_aligned(self, regions, per_region) -> None:
+    def _read_vecs_aligned(self, regions: dict[int, _Region],
+                           per_region: dict[int, list[Any]]) -> None:
         """Whole-page batch read: one gather dispatch per region, async D2H,
         then ONE vectorized copy per destination buffer (the generic span
         path below scatters page by page in Python)."""
         jax = self._jax
         P = self.page_bytes
-        fetches = []  # (out device array, runs)
+        fetches: list[Any] = []  # (out device array, runs)
         for region_id, runs in per_region.items():
             region = regions[region_id]
             total = sum(n for _p0, n, _h in runs)
@@ -801,7 +826,7 @@ class JaxHbmProvider:
                 dst[:] = host[row : row + n].reshape(-1)
                 row += n
 
-    def _read_vecs(self, vecs):
+    def _read_vecs(self, vecs: list[_Vec]) -> None:
         vecs = self._serve_view_vecs(vecs, is_write=False)
         if not vecs:
             return
@@ -813,7 +838,7 @@ class JaxHbmProvider:
         regions, grouped = self._decompose(vecs)
         if not grouped:
             return
-        fetches = []  # (out device array, spans)
+        fetches: list[Any] = []  # (out device array, spans)
         for region_id, spans in grouped.items():
             region = regions[region_id]
             m_padded = _pow2_at_least(len(spans))
@@ -839,21 +864,23 @@ class JaxHbmProvider:
 
     # -- C ABI entry points ------------------------------------------------
 
-    def _write(self, _ctx, region_id, offset, buf, length):
+    def _write(self, _ctx: Any, region_id: int, offset: int, buf: int,
+               length: int) -> int:
         try:
             self._write_vecs([(region_id, offset, buf, length)])
             return 0
         except Exception:  # noqa: BLE001
             return 1
 
-    def _read(self, _ctx, region_id, offset, buf, length):
+    def _read(self, _ctx: Any, region_id: int, offset: int, buf: int,
+              length: int) -> int:
         try:
             self._read_vecs([(region_id, offset, buf, length)])
             return 0
         except Exception:  # noqa: BLE001
             return 1
 
-    def _write_batch(self, _ctx, vecs_ptr, n):
+    def _write_batch(self, _ctx: Any, vecs_ptr: Any, n: int) -> int:
         try:
             vecs = [(vecs_ptr[i].region_id, vecs_ptr[i].offset, vecs_ptr[i].buf,
                      vecs_ptr[i].len) for i in range(n)]
@@ -862,7 +889,7 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
-    def _read_batch(self, _ctx, vecs_ptr, n):
+    def _read_batch(self, _ctx: Any, vecs_ptr: Any, n: int) -> int:
         try:
             vecs = [(vecs_ptr[i].region_id, vecs_ptr[i].offset, vecs_ptr[i].buf,
                      vecs_ptr[i].len) for i in range(n)]
@@ -873,7 +900,8 @@ class JaxHbmProvider:
 
     # -- device-to-device copy (the ICI path) ------------------------------
 
-    def _copy(self, _ctx, src_region, src_off, dst_region, dst_off, length):
+    def _copy(self, _ctx: Any, src_region: int, src_off: int, dst_region: int,
+              dst_off: int, length: int) -> int:
         """Region-to-region copy with no host staging.
 
         Pages are gathered on the source device, moved with ONE device_put —
@@ -913,7 +941,7 @@ class JaxHbmProvider:
                 # let the native side stage through read/write, each of which
                 # picks its own fast path.
                 return 1
-            spans = []  # (src_page, dst_page, v0, v1)
+            spans: list[tuple[int, int, int, int]] = []  # (src_page, dst_page, v0, v1)
             pos = 0
             while pos < length:
                 a = (src_off + pos) % P
@@ -954,21 +982,22 @@ class JaxHbmProvider:
     # TransferLink; this provider adds only the region <-> array glue.
 
     @property
-    def fabric_offers(self):
+    def fabric_offers(self) -> int:
         return self._link.offers
 
     @property
-    def fabric_discards(self):
+    def fabric_discards(self) -> int:
         return self._link.discards
 
     @property
-    def fabric_gc_dropped(self):
+    def fabric_gc_dropped(self) -> int:
         return self._link.gc_dropped
 
-    def _fabric_server(self):
+    def _fabric_server(self) -> Any:
         return self._link.server()
 
-    def _fabric_range_array(self, region, offset: int, length: int):
+    def _fabric_range_array(self, region: _Region, offset: int,
+                            length: int) -> Any:
         """The region's [offset, offset+len) bytes as a 1-D device array —
         the unit the fabric transfers (both sides agree on uint8[len])."""
         if region["view"] is not None:
@@ -983,7 +1012,7 @@ class JaxHbmProvider:
         # padded rows (clipped reads) fall off the slice.
         return pages.reshape(-1)[a : a + length]
 
-    def _fabric_address(self, _ctx, buf, cap):
+    def _fabric_address(self, _ctx: Any, buf: int, cap: int) -> int:
         try:
             server = self._fabric_server()
             if server is None:
@@ -996,7 +1025,8 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
-    def _fabric_offer(self, _ctx, region_id, offset, length, transfer_id):
+    def _fabric_offer(self, _ctx: Any, region_id: int, offset: int, length: int,
+                      transfer_id: int) -> int:
         try:
             with self._lock:
                 region = self._regions.get(region_id)
@@ -1009,7 +1039,8 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
-    def _fabric_pull(self, _ctx, remote_addr, transfer_id, region_id, offset, length):
+    def _fabric_pull(self, _ctx: Any, remote_addr: bytes, transfer_id: int,
+                     region_id: int, offset: int, length: int) -> int:
         try:
             jax = self._jax
             jnp = jax.numpy
@@ -1049,7 +1080,7 @@ class JaxHbmProvider:
         except Exception:  # noqa: BLE001
             return 1
 
-    def _host_view_base(self, _ctx, region_id):
+    def _host_view_base(self, _ctx: Any, region_id: int) -> int | None:
         """v5: the region's stable CPU-addressable base, or None. Only
         host-view regions qualify — their buffer is never donated (all I/O
         is memcpy through the probed view), so the pointer stays valid for
@@ -1060,18 +1091,18 @@ class JaxHbmProvider:
                 region = self._regions.get(region_id)
             if region is None or region["view"] is None:
                 return None
-            return region["view"].ctypes.data
+            return int(region["view"].ctypes.data)
         except Exception:  # noqa: BLE001
             return None
 
-    def _flush(self, _ctx):
+    def _flush(self, _ctx: Any) -> int:
         try:
             self.synchronize()
             return 0
         except Exception:  # noqa: BLE001
             return 1
 
-    def _available(self, _ctx, _device_id):
+    def _available(self, _ctx: Any, _device_id: Any) -> int:
         return 0  # unknown
 
     # -- registration ------------------------------------------------------
@@ -1091,7 +1122,7 @@ class JaxHbmProvider:
                     self._await_fences(slot)
             entry["exec"].shutdown(wait=True)
 
-    def register(self) -> "JaxHbmProvider":
+    def register(self) -> JaxHbmProvider:
         """Installs this provider process-wide for all HBM_TPU backends."""
         self._struct = _ProviderStruct(
             ctx=None,
@@ -1110,24 +1141,27 @@ class JaxHbmProvider:
             host_view_base=_HOST_VIEW_FN(self._host_view_base),
         )
         ptr = ctypes.cast(ctypes.pointer(self._struct), ctypes.c_void_p)
-        if hasattr(lib, "btpu_register_hbm_provider_v5"):
+        # Walk the provider-version chain through the manifest (native.have,
+        # not hasattr): v4/v5 are OPTIONAL symbols a prebuilt older library
+        # may lack; the v3 prefix of the struct matches exactly either way.
+        if native.have("btpu_register_hbm_provider_v5"):
             lib.btpu_register_hbm_provider_v5(ptr)
-        elif hasattr(lib, "btpu_register_hbm_provider_v4"):
+        elif native.have("btpu_register_hbm_provider_v4"):
             lib.btpu_register_hbm_provider_v4(ptr)  # v4 prefix matches
-        else:  # older library: the v3 prefix of the struct matches exactly
+        else:
             lib.btpu_register_hbm_provider_v3(ptr)
         JaxHbmProvider._registered = self
         return self
 
-    _registered: "JaxHbmProvider | None" = None
+    _registered: JaxHbmProvider | None = None
 
     @staticmethod
     def unregister() -> None:
         """Restores the built-in host-memory emulation and tears down the
         registered provider's dispatcher threads (see close())."""
-        if hasattr(lib, "btpu_register_hbm_provider_v5"):
+        if native.have("btpu_register_hbm_provider_v5"):
             lib.btpu_register_hbm_provider_v5(None)
-        elif hasattr(lib, "btpu_register_hbm_provider_v4"):
+        elif native.have("btpu_register_hbm_provider_v4"):
             lib.btpu_register_hbm_provider_v4(None)
         else:
             lib.btpu_register_hbm_provider_v3(None)

@@ -1,51 +1,47 @@
-"""ctypes bindings to the native core (libbtpu.so), with build-on-demand."""
+"""ctypes bindings to the native core (libbtpu.so), with build-on-demand.
+
+The symbol table lives in `blackbird_tpu/_capi.py` (the machine-checked FFI
+manifest — see its docstring and docs/CORRECTNESS.md §11). This module only
+(1) builds/loads the library, (2) binds every manifest signature STRICTLY —
+a required symbol the library lacks fails the import loudly, never silently,
+and (3) fronts the handle with the typed `NativeAPI` protocol so every call
+site type-checks under strict mypy.
+"""
 
 from __future__ import annotations
 
 import ctypes
-import enum
 import os
 import shutil
 import subprocess
 from pathlib import Path
+from typing import TYPE_CHECKING, Protocol, cast
+
+from blackbird_tpu._capi import (
+    OPTIONAL,
+    SIGNATURES,
+    TOKEN_CTYPES,
+    ErrorCode,
+    StorageClass,
+    TransportKind,
+)
+
+__all__ = [
+    "ErrorCode",
+    "StorageClass",
+    "TransportKind",
+    "NativeAPI",
+    "BtpuError",
+    "build_native",
+    "check",
+    "error_name",
+    "have",
+    "lib",
+]
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent
 _BUILD_DIR = _REPO_ROOT / "build"
 _LIB_PATH = _BUILD_DIR / "libbtpu.so"
-
-
-class ErrorCode(enum.IntEnum):
-    """Mirror of btpu::ErrorCode domain bases + common codes (error.h)."""
-
-    OK = 0
-    INTERNAL_ERROR = 1000
-    NOT_IMPLEMENTED = 1005
-    MEMORY_POOL_NOT_FOUND = 2002
-    INSUFFICIENT_SPACE = 2006
-    MEMORY_ACCESS_ERROR = 2007
-    CONNECTION_FAILED = 3001
-    TRANSFER_FAILED = 3002
-    OBJECT_NOT_FOUND = 5000
-    OBJECT_ALREADY_EXISTS = 5001
-    NO_COMPLETE_WORKER = 5005
-    INVALID_PARAMETERS = 7002
-
-
-class StorageClass(enum.IntEnum):
-    RAM_CPU = 1
-    HBM_TPU = 2
-    NVME = 3
-    SSD = 4
-    HDD = 5
-    CXL_MEMORY = 6
-
-
-class TransportKind(enum.IntEnum):
-    LOCAL = 1
-    SHM = 2
-    TCP = 3
-    ICI = 4
-    HBM = 5
 
 
 def _needs_build() -> bool:
@@ -90,125 +86,214 @@ def build_native(force: bool = False) -> None:
     )
 
 
-def _load() -> ctypes.CDLL:
+if TYPE_CHECKING:
+    # ctypes interop aliases (typeshed-only names are quoted in the unions):
+    #   Handle   opaque struct pointer: what c_void_p restypes RETURN
+    #            (int | None) and what handle parameters accept.
+    #   Buf      void* data pointer (ndarray.ctypes.data_as, _bytes_addr).
+    #   CStr     const char* / char* — bytes, or an out string buffer
+    #            (create_string_buffer's Array[c_char]).
+    #   U64Out / I32Out   out-parameter arrays (byref() or ctypes arrays).
+    from ctypes import Array, _CArgObject, c_char, c_char_p, c_uint64, c_void_p
+    from typing import TypeAlias
+
+    Handle: TypeAlias = "int | c_void_p | None"
+    Buf: TypeAlias = "int | c_void_p | Array[c_char] | None"
+    CStr: TypeAlias = "bytes | Array[c_char] | None"
+    U64Out: TypeAlias = "Array[c_uint64] | _CArgObject | None"
+    I32Out: TypeAlias = "Array[ctypes.c_int32] | _CArgObject | None"
+    CStrArr: TypeAlias = "Array[c_char_p]"
+    PtrArr: TypeAlias = "Array[c_void_p]"
+
+
+class NativeAPI(Protocol):
+    """Typed stub of the bound libbtpu.so handle.
+
+    One method per manifest symbol (capi_check.py enforces the 1:1 set match
+    against _capi.SIGNATURES; mypy then type-checks every call site against
+    these signatures). Methods listed in _capi.OPTIONAL may be absent from a
+    prebuilt older library — gate those call sites on `native.have()`.
+    """
+
+    # -- embedded cluster ----------------------------------------------------
+    def btpu_cluster_create(self, n_workers: int, pool_bytes: int,
+                            storage_class: int, transport: int) -> int | None: ...
+    def btpu_cluster_create_tiered(self, n_workers: int, device_bytes: int,
+                                   host_bytes: int) -> int | None: ...
+    def btpu_cluster_create_ex(self, n_workers: int, pool_bytes: int,
+                               storage_class: int, transport: int,
+                               data_dir: CStr, group_commit_us: int) -> int | None: ...
+    def btpu_cluster_destroy(self, cluster: Handle) -> None: ...
+    def btpu_cluster_kill_worker(self, cluster: Handle, index: int) -> int: ...
+    def btpu_cluster_worker_count(self, cluster: Handle) -> int: ...
+    def btpu_cluster_counters(self, cluster: Handle, out: U64Out) -> None: ...
+    # -- standalone worker daemon -------------------------------------------
+    def btpu_worker_create(self, config_yaml_path: CStr,
+                           coord_endpoints: CStr) -> int | None: ...
+    def btpu_worker_pool_count(self, worker: Handle) -> int: ...
+    def btpu_worker_id(self, worker: Handle) -> bytes | None: ...
+    def btpu_worker_destroy(self, worker: Handle) -> None: ...
+    # -- client lifecycle ----------------------------------------------------
+    def btpu_client_create_embedded(self, cluster: Handle) -> int | None: ...
+    def btpu_client_create_remote(self, keystone_endpoint: CStr) -> int | None: ...
+    def btpu_client_destroy(self, client: Handle) -> None: ...
+    def btpu_client_set_verify(self, client: Handle, verify: int) -> None: ...
+    # -- object I/O ----------------------------------------------------------
+    def btpu_put(self, client: Handle, key: CStr, data: Buf, size: int,
+                 replicas: int, max_workers: int, preferred_class: int) -> int: ...
+    def btpu_put_ex(self, client: Handle, key: CStr, data: Buf, size: int,
+                    replicas: int, max_workers: int, preferred_class: int,
+                    ttl_ms: int, soft_pin: int) -> int: ...
+    def btpu_put_ex2(self, client: Handle, key: CStr, data: Buf, size: int,
+                     replicas: int, max_workers: int, preferred_class: int,
+                     ttl_ms: int, soft_pin: int, preferred_slice: int) -> int: ...
+    def btpu_get(self, client: Handle, key: CStr, buffer: Buf,
+                 buffer_size: int, out_size: U64Out) -> int: ...
+    def btpu_put_many(self, client: Handle, n: int, keys: CStrArr, bufs: PtrArr,
+                      sizes: U64Out, replicas: int, max_workers: int,
+                      preferred_class: int, out_codes: I32Out) -> int: ...
+    def btpu_get_many(self, client: Handle, n: int, keys: CStrArr, bufs: PtrArr,
+                      buf_sizes: U64Out, out_sizes: U64Out,
+                      out_codes: I32Out) -> int: ...
+    def btpu_sizes_many(self, client: Handle, n: int, keys: CStrArr,
+                        out_sizes: U64Out, out_codes: I32Out) -> int: ...
+    def btpu_placements_json(self, client: Handle, key: CStr, buffer: CStr,
+                             buffer_size: int, out_len: U64Out) -> int: ...
+    def btpu_drain_worker(self, client: Handle, worker_id: CStr,
+                          out_moved: U64Out) -> int: ...
+    # -- lane scoreboard -----------------------------------------------------
+    def btpu_pvm_op_count(self) -> int: ...
+    def btpu_pvm_byte_count(self) -> int: ...
+    def btpu_tcp_staged_op_count(self) -> int: ...
+    def btpu_tcp_staged_byte_count(self) -> int: ...
+    def btpu_tcp_stream_op_count(self) -> int: ...
+    def btpu_tcp_stream_byte_count(self) -> int: ...
+    def btpu_tcp_pool_direct_op_count(self) -> int: ...
+    def btpu_tcp_pool_direct_byte_count(self) -> int: ...
+    def btpu_tcp_zerocopy_sent_count(self) -> int: ...
+    def btpu_tcp_zerocopy_copied_count(self) -> int: ...
+    def btpu_uring_loop_count(self) -> int: ...
+    def btpu_wire_pool_threads(self) -> int: ...
+    def btpu_cached_op_count(self) -> int: ...
+    def btpu_cached_byte_count(self) -> int: ...
+    # -- overload-robustness scoreboard --------------------------------------
+    def btpu_deadline_exceeded_count(self) -> int: ...
+    def btpu_shed_count(self) -> int: ...
+    def btpu_client_deadline_exceeded_count(self) -> int: ...
+    def btpu_retry_count(self) -> int: ...
+    def btpu_retry_budget_exhausted_count(self) -> int: ...
+    def btpu_hedge_fired_count(self) -> int: ...
+    def btpu_hedge_win_count(self) -> int: ...
+    def btpu_breaker_trip_count(self) -> int: ...
+    def btpu_breaker_skip_count(self) -> int: ...
+    def btpu_persist_retry_backlog(self) -> int: ...
+    # -- observability -------------------------------------------------------
+    def btpu_op_get_count(self) -> int: ...
+    def btpu_op_get_p50_us(self) -> int: ...
+    def btpu_op_get_p99_us(self) -> int: ...
+    def btpu_flight_event_count(self) -> int: ...
+    def btpu_trace_span_count(self) -> int: ...
+    def btpu_set_tracing(self, on: int) -> None: ...
+    def btpu_histograms_json(self, buffer: CStr, buffer_size: int,
+                             out_len: U64Out) -> int: ...
+    def btpu_trace_spans_json(self, trace_id: int, buffer: CStr,
+                              buffer_size: int, out_len: U64Out) -> int: ...
+    def btpu_flight_json(self, buffer: CStr, buffer_size: int,
+                         out_len: U64Out) -> int: ...
+    # -- client object cache -------------------------------------------------
+    def btpu_client_cache_configure(self, client: Handle, cache_bytes: int) -> None: ...
+    def btpu_client_cache_stats(self, client: Handle, out: U64Out) -> int: ...
+    # -- client-driven device fabric -----------------------------------------
+    def btpu_put_start_json(self, client: Handle, key: CStr, size: int,
+                            replicas: int, max_workers: int,
+                            preferred_class: CStr, buffer: CStr,
+                            buffer_size: int, out_len: U64Out) -> int: ...
+    def btpu_put_complete(self, client: Handle, key: CStr) -> int: ...
+    def btpu_put_cancel(self, client: Handle, key: CStr) -> int: ...
+    def btpu_fabric_offer(self, client: Handle, transport: CStr, endpoint: CStr,
+                          remote_addr: int, rkey: int, length: int,
+                          transfer_id: int) -> int: ...
+    def btpu_fabric_pull(self, client: Handle, transport: CStr, endpoint: CStr,
+                         remote_addr: int, rkey: int, length: int,
+                         transfer_id: int, src_fabric: CStr) -> int: ...
+    # -- erasure coding ------------------------------------------------------
+    def btpu_put_ec(self, client: Handle, key: CStr, data: Buf, size: int,
+                    ec_data: int, ec_parity: int, preferred_class: int,
+                    ttl_ms: int, soft_pin: int) -> int: ...
+    def btpu_put_ec2(self, client: Handle, key: CStr, data: Buf, size: int,
+                     ec_data: int, ec_parity: int, preferred_class: int,
+                     ttl_ms: int, soft_pin: int, preferred_slice: int) -> int: ...
+    # -- introspection -------------------------------------------------------
+    def btpu_list_json(self, client: Handle, prefix: CStr, limit: int,
+                       buffer: CStr, buffer_size: int, out_len: U64Out) -> int: ...
+    def btpu_exists(self, client: Handle, key: CStr, out_exists: I32Out) -> int: ...
+    def btpu_remove(self, client: Handle, key: CStr) -> int: ...
+    def btpu_stats(self, client: Handle, out: U64Out) -> int: ...
+    def btpu_error_name(self, code: int) -> bytes | None: ...
+    # -- HBM provider registration (storage/hbm_provider.h) ------------------
+    def btpu_register_hbm_provider_v3(self, provider: Handle) -> None: ...
+    def btpu_register_hbm_provider_v4(self, provider: Handle) -> None: ...
+    def btpu_register_hbm_provider_v5(self, provider: Handle) -> None: ...
+
+
+# OPTIONAL manifest symbols this library build does NOT export (see have()).
+_ABSENT: set[str] = set()
+
+
+def _load() -> NativeAPI:
     build_native()
     handle = ctypes.CDLL(str(_LIB_PATH))
 
-    c = ctypes.c_void_p
-    u32, u64, i32 = ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int32
-    sig = {
-        "btpu_cluster_create": (c, [u32, u64, u32, u32]),
-        "btpu_cluster_create_tiered": (c, [u32, u64, u64]),
-        "btpu_cluster_destroy": (None, [c]),
-        "btpu_cluster_kill_worker": (i32, [c, u32]),
-        "btpu_cluster_worker_count": (u32, [c]),
-        "btpu_cluster_counters": (None, [c, ctypes.POINTER(u64)]),
-        "btpu_client_create_embedded": (c, [c]),
-        "btpu_client_create_remote": (c, [ctypes.c_char_p]),
-        "btpu_client_destroy": (None, [c]),
-        "btpu_client_set_verify": (None, [c, i32]),
-        "btpu_put": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32, u32]),
-        "btpu_get": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, ctypes.POINTER(u64)]),
-        "btpu_put_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),
-                                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64),
-                                u32, u32, u32, ctypes.POINTER(i32)]),
-        "btpu_get_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),
-                                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64),
-                                ctypes.POINTER(u64), ctypes.POINTER(i32)]),
-        "btpu_sizes_many": (i32, [c, u32, ctypes.POINTER(ctypes.c_char_p),
-                                  ctypes.POINTER(u64), ctypes.POINTER(i32)]),
-        "btpu_exists": (i32, [c, ctypes.c_char_p, ctypes.POINTER(i32)]),
-        "btpu_remove": (i32, [c, ctypes.c_char_p]),
-        "btpu_stats": (i32, [c, ctypes.POINTER(u64)]),
-        "btpu_pvm_op_count": (u64, []),
-        "btpu_error_name": (ctypes.c_char_p, [i32]),
-        "btpu_register_hbm_provider_v3": (None, [ctypes.c_void_p]),
-        "btpu_placements_json": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64,
-                                       ctypes.POINTER(u64)]),
-        "btpu_list_json": (i32, [c, ctypes.c_char_p, u64, ctypes.c_char_p, u64,
-                                 ctypes.POINTER(u64)]),
-        "btpu_put_ex2": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
-                               u32, ctypes.c_int64, i32, i32]),
-        "btpu_put_ec2": (i32, [c, ctypes.c_char_p, ctypes.c_void_p, u64, u32, u32,
-                               u32, ctypes.c_int64, i32, i32]),
-        "btpu_drain_worker": (i32, [c, ctypes.c_char_p, ctypes.POINTER(u64)]),
-        "btpu_put_start_json": (i32, [c, ctypes.c_char_p, u64, u32, u32,
-                                      ctypes.c_char_p, ctypes.c_char_p, u64,
-                                      ctypes.POINTER(u64)]),
-        "btpu_put_complete": (i32, [c, ctypes.c_char_p]),
-        "btpu_put_cancel": (i32, [c, ctypes.c_char_p]),
-        "btpu_fabric_offer": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64, u64,
-                                    u64, u64]),
-        "btpu_fabric_pull": (i32, [c, ctypes.c_char_p, ctypes.c_char_p, u64, u64,
-                                   u64, u64, ctypes.c_char_p]),
-        "btpu_worker_create": (c, [ctypes.c_char_p, ctypes.c_char_p]),
-        "btpu_worker_pool_count": (u32, [c]),
-        "btpu_worker_id": (ctypes.c_char_p, [c]),
-        "btpu_worker_destroy": (None, [c]),
-    }
-    for name, (restype, argtypes) in sig.items():
-        fn = getattr(handle, name)
-        fn.restype = restype
-        fn.argtypes = argtypes
-    # Newer provider-registration entry points are OPTIONAL: hbm.py probes
-    # with hasattr() and falls back down the version chain, so a prebuilt
-    # older library must not fail the whole import here.
-    for name in ("btpu_register_hbm_provider_v4", "btpu_register_hbm_provider_v5"):
-        if hasattr(handle, name):
+    missing: list[str] = []
+    for name, (ret, args) in SIGNATURES.items():
+        try:
             fn = getattr(handle, name)
-            fn.restype = None
-            fn.argtypes = [ctypes.c_void_p]
-    # Lane scoreboard counters (optional for the same prebuilt-library reason).
-    for name in ("btpu_pvm_byte_count", "btpu_tcp_staged_op_count",
-                 "btpu_tcp_staged_byte_count", "btpu_tcp_stream_op_count",
-                 "btpu_tcp_stream_byte_count", "btpu_tcp_pool_direct_op_count",
-                 "btpu_tcp_pool_direct_byte_count", "btpu_tcp_zerocopy_sent_count",
-                 "btpu_tcp_zerocopy_copied_count", "btpu_uring_loop_count",
-                 "btpu_wire_pool_threads", "btpu_cached_op_count",
-                 "btpu_cached_byte_count", "btpu_persist_retry_backlog",
-                 "btpu_op_get_count", "btpu_op_get_p50_us", "btpu_op_get_p99_us",
-                 "btpu_flight_event_count", "btpu_trace_span_count"):
-        if hasattr(handle, name):
-            fn = getattr(handle, name)
-            fn.restype = u64
-            fn.argtypes = []
-    # Observability exports (optional, same prebuilt-library reason):
-    # histogram/trace/flight JSON dumps + the tracing master switch.
-    if hasattr(handle, "btpu_histograms_json"):
-        handle.btpu_histograms_json.restype = i32
-        handle.btpu_histograms_json.argtypes = [ctypes.c_char_p, u64,
-                                                ctypes.POINTER(u64)]
-        handle.btpu_trace_spans_json.restype = i32
-        handle.btpu_trace_spans_json.argtypes = [u64, ctypes.c_char_p, u64,
-                                                 ctypes.POINTER(u64)]
-        handle.btpu_flight_json.restype = i32
-        handle.btpu_flight_json.argtypes = [ctypes.c_char_p, u64, ctypes.POINTER(u64)]
-        handle.btpu_set_tracing.restype = None
-        handle.btpu_set_tracing.argtypes = [i32]
-    # Durable embedded cluster (optional, same prebuilt-library reason):
-    # cluster.py probes hasattr before offering data_dir.
-    if hasattr(handle, "btpu_cluster_create_ex"):
-        handle.btpu_cluster_create_ex.restype = c
-        handle.btpu_cluster_create_ex.argtypes = [u32, u64, u32, u32, ctypes.c_char_p,
-                                                  ctypes.c_int64]
-    # Client object cache (optional, same prebuilt-library reason): config +
-    # stats for the lease-coherent cache (native/src/cache/object_cache.cpp).
-    if hasattr(handle, "btpu_client_cache_configure"):
-        handle.btpu_client_cache_configure.restype = None
-        handle.btpu_client_cache_configure.argtypes = [c, u64]
-        handle.btpu_client_cache_stats.restype = i32
-        handle.btpu_client_cache_stats.argtypes = [c, ctypes.POINTER(u64)]
-    return handle
+        except AttributeError:
+            # Version-gated entry points (e.g. newer provider registrations)
+            # may be absent from a prebuilt older library; anything else
+            # missing is manifest drift and must fail HERE, not read as 0
+            # at some far-away call site.
+            if name in OPTIONAL:
+                _ABSENT.add(name)
+                continue
+            missing.append(name)
+            continue
+        fn.restype = TOKEN_CTYPES[ret]
+        fn.argtypes = [TOKEN_CTYPES[t] for t in args]
+    if missing:
+        raise RuntimeError(
+            f"libbtpu.so at {_LIB_PATH} lacks {len(missing)} required manifest "
+            f"symbol(s): {', '.join(sorted(missing))} — the library and "
+            "blackbird_tpu/_capi.py disagree; rebuild (make native) or fix the "
+            "manifest (scripts/capi_check.py pinpoints the drift)"
+        )
+    return cast(NativeAPI, handle)
 
 
-lib = _load()
+lib: NativeAPI = _load()
+
+
+def have(name: str) -> bool:
+    """True when manifest symbol `name` is bound in THIS library build.
+
+    Only _capi.OPTIONAL symbols can be absent (required ones failed the
+    import already); asking about a name outside the manifest is a
+    programming error and raises."""
+    if name not in SIGNATURES:
+        raise KeyError(f"{name} is not in the blackbird_tpu/_capi.py manifest")
+    return name not in _ABSENT
+
+
+def error_name(code: int) -> str:
+    """Native symbolic name for an ErrorCode value, e.g. 'OBJECT_NOT_FOUND'."""
+    raw = lib.btpu_error_name(code)
+    return raw.decode() if raw is not None else f"UNKNOWN({code})"
 
 
 class BtpuError(RuntimeError):
     def __init__(self, code: int, operation: str):
         self.code = code
-        name = lib.btpu_error_name(code).decode()
-        super().__init__(f"{operation} failed: {name} ({code})")
+        super().__init__(f"{operation} failed: {error_name(code)} ({code})")
 
 
 def check(code: int, operation: str) -> None:

@@ -1,1 +1,3 @@
-from blackbird_tpu.ops.checksum import checksum_u32  # noqa: F401
+from blackbird_tpu.ops.checksum import checksum_u32
+
+__all__ = ["checksum_u32"]

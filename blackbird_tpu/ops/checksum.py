@@ -10,6 +10,7 @@ partials. CPU/interpret fallbacks keep the same semantics for dev machines.
 from __future__ import annotations
 
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -27,7 +28,7 @@ def _pallas_partials(x2d: jax.Array, interpret: bool) -> jax.Array:
     rows = x2d.shape[0]
     grid = rows // _BLOCK_ROWS
 
-    def kernel(x_ref, o_ref):
+    def kernel(x_ref: Any, o_ref: Any) -> None:
         o_ref[0, 0] = jnp.sum(x_ref[...], dtype=jnp.uint32)
 
     return pl.pallas_call(
@@ -41,7 +42,8 @@ def _pallas_partials(x2d: jax.Array, interpret: bool) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("use_pallas", "interpret"))
-def checksum_u32(data: jax.Array, use_pallas: bool = False, interpret: bool = False):
+def checksum_u32(data: jax.Array, use_pallas: bool = False,
+                 interpret: bool = False) -> jax.Array:
     """Additive uint32 checksum (mod 2^32) of a uint32 array of any shape.
 
     With use_pallas=True the partial sums run as a pallas kernel (TPU, or

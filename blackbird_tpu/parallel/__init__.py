@@ -1,5 +1,7 @@
-from blackbird_tpu.parallel.engine import (  # noqa: F401
+from blackbird_tpu.parallel.engine import (
     ShardedPool,
     make_mesh,
     replicate_ring_step,
 )
+
+__all__ = ["ShardedPool", "make_mesh", "replicate_ring_step"]

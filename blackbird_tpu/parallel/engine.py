@@ -15,12 +15,18 @@ real placement; this engine is the device-resident fast tier).
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import numpy.typing as npt
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+if TYPE_CHECKING:
+    from blackbird_tpu.client import Client
+    from blackbird_tpu.cluster import EmbeddedCluster
 
 # jax.shard_map landed in 0.4.x-late / 0.5; older runtimes ship it as
 # jax.experimental.shard_map.shard_map with the same signature. Resolve once
@@ -45,10 +51,10 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
-def _pool_write(pool, shards, offset, *, mesh):
+def _pool_write(pool: Any, shards: Any, offset: Any, *, mesh: Mesh) -> Any:
     """Each worker writes its shard row into its pool row at `offset`."""
 
-    def write_one(pool_row, shard_row):
+    def write_one(pool_row: Any, shard_row: Any) -> Any:
         return jax.lax.dynamic_update_slice(pool_row, shard_row, (0, offset))
 
     return _shard_map(
@@ -58,10 +64,11 @@ def _pool_write(pool, shards, offset, *, mesh):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "shard_elems"))
-def _pool_read_gather(pool, offset, *, mesh, shard_elems):
+def _pool_read_gather(pool: Any, offset: Any, *, mesh: Mesh,
+                      shard_elems: int) -> Any:
     """Assembles the object on every device: slice rows + all_gather (ICI)."""
 
-    def read_one(pool_row):
+    def read_one(pool_row: Any) -> Any:
         shard = jax.lax.dynamic_slice(pool_row, (0, offset), (1, shard_elems))
         gathered = jax.lax.all_gather(shard[0], AXIS)  # [workers, shard_elems]
         return gathered.reshape(1, -1)
@@ -72,7 +79,8 @@ def _pool_read_gather(pool, offset, *, mesh, shard_elems):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "shard_elems"))
-def _pool_ring_replicate(pool, src_offset, dst_offset, *, mesh, shard_elems):
+def _pool_ring_replicate(pool: Any, src_offset: Any, dst_offset: Any, *,
+                         mesh: Mesh, shard_elems: int) -> Any:
     """Ring re-replication: every worker stores its right neighbor's shard.
 
     This is the repair primitive: after it, worker i holds shard i at
@@ -83,7 +91,7 @@ def _pool_ring_replicate(pool, src_offset, dst_offset, *, mesh, shard_elems):
     n = mesh.shape[AXIS]
     perm = [(i, (i - 1) % n) for i in range(n)]  # send to left neighbor
 
-    def step(pool_row):
+    def step(pool_row: Any) -> Any:
         shard = jax.lax.dynamic_slice(pool_row, (0, src_offset), (1, shard_elems))
         neighbor = jax.lax.ppermute(shard[0], AXIS, perm)
         return jax.lax.dynamic_update_slice(pool_row, neighbor[None, :], (0, dst_offset))
@@ -94,10 +102,11 @@ def _pool_ring_replicate(pool, src_offset, dst_offset, *, mesh, shard_elems):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "shard_elems"))
-def _pool_checksum_agree(pool, offset, *, mesh, shard_elems):
+def _pool_checksum_agree(pool: Any, offset: Any, *, mesh: Mesh,
+                         shard_elems: int) -> Any:
     """Sum of per-shard checksums via psum — equal on every device."""
 
-    def digest(pool_row):
+    def digest(pool_row: Any) -> Any:
         shard = jax.lax.dynamic_slice(pool_row, (0, offset), (1, shard_elems))
         partial = jnp.sum(shard, dtype=jnp.uint32)
         return jax.lax.psum(partial, AXIS)[None]
@@ -143,19 +152,20 @@ class ShardedPool:
     """
 
     def __init__(self, mesh: Mesh, pool_elems_per_worker: int, *,
-                 cluster=None, replicas: int = 1):
+                 cluster: EmbeddedCluster | None = None,
+                 replicas: int = 1) -> None:
         self.mesh = mesh
-        self.n = mesh.shape[AXIS]
+        self.n = int(mesh.shape[AXIS])
         self.pool_elems = pool_elems_per_worker
         self.replicas = replicas
-        self._client = None
+        self._client: Client | None = None
+        self.pool: Any = None
         if cluster is not None:
             if cluster.worker_count != self.n:
                 raise ValueError(
                     f"cluster has {cluster.worker_count} workers but the mesh "
                     f"has {self.n} devices — need one device pool per row")
             self._client = cluster.client()
-            self.pool = None
         else:
             sharding = NamedSharding(mesh, P(AXIS, None))
             self.pool = jax.device_put(
@@ -167,7 +177,7 @@ class ShardedPool:
     def shard_elems_for(self, n_elems: int) -> int:
         return (n_elems + self.n - 1) // self.n
 
-    def put(self, key: str, data: np.ndarray) -> None:
+    def put(self, key: str, data: npt.NDArray[Any]) -> None:
         """Stripes a uint32 array across the mesh and writes it in."""
         data = np.asarray(data, dtype=np.uint32).ravel()
         if self._client is not None:
@@ -199,7 +209,7 @@ class ShardedPool:
         self._objects[key] = _Extent(self._cursor, shard_elems)
         self._cursor += shard_elems
 
-    def get(self, key: str, n_elems: int | None = None) -> np.ndarray:
+    def get(self, key: str, n_elems: int | None = None) -> npt.NDArray[np.uint32]:
         """Gathers the object onto the host (all_gather across ICI)."""
         if self._client is not None:
             raw = self._client.get(key)
@@ -256,8 +266,8 @@ class ShardedPool:
         return replica_key
 
 
-def replicate_ring_step(mesh: Mesh, pool, src_offset: int, dst_offset: int,
-                        shard_elems: int):
+def replicate_ring_step(mesh: Mesh, pool: Any, src_offset: int, dst_offset: int,
+                        shard_elems: int) -> Any:
     """Standalone jitted ring-replication step (exposed for the dryrun)."""
     return _pool_ring_replicate(pool, src_offset, dst_offset, mesh=mesh,
                                 shard_elems=shard_elems)

@@ -35,6 +35,10 @@ import tempfile
 import time
 import urllib.request
 from pathlib import Path
+from typing import TYPE_CHECKING, Any, Callable
+
+if TYPE_CHECKING:
+    from blackbird_tpu.client import Client
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 BUILD_DIR = REPO_ROOT / "build"
@@ -43,7 +47,7 @@ BUILD_DIR = REPO_ROOT / "build"
 def free_port() -> int:
     with socket.socket() as sock:
         sock.bind(("127.0.0.1", 0))
-        return sock.getsockname()[1]
+        return int(sock.getsockname()[1])
 
 
 def _port_open(port: int) -> bool:
@@ -52,7 +56,7 @@ def _port_open(port: int) -> bool:
         return sock.connect_ex(("127.0.0.1", port)) == 0
 
 
-def write_keystone_yaml(path, *, cluster_id: str, coord_port: int,
+def write_keystone_yaml(path: str | Path, *, cluster_id: str, coord_port: int,
                         keystone_port: int, metrics_port: int | None = None,
                         heartbeat_ttl_sec: int = 2) -> None:
     """The single source for programmatic keystone configs (ProcessCluster,
@@ -72,7 +76,9 @@ def write_keystone_yaml(path, *, cluster_id: str, coord_port: int,
     Path(path).write_text("\n".join(lines) + "\n")
 
 
-def spawn_logged(args, log_path, *, cwd=REPO_ROOT, env=None):
+def spawn_logged(args: list[str], log_path: str | Path, *,
+                 cwd: str | Path = REPO_ROOT,
+                 env: dict[str, str] | None = None) -> subprocess.Popen[str]:
     """Popen with output to a FILE, never a pipe: a long-lived chatty child
     (XLA warnings + logging) would fill a 64 KiB pipe buffer, block on its
     next write, stop heartbeating, and wedge the cluster with spurious
@@ -99,8 +105,8 @@ class ProcessCluster:
         workdir: str | None = None,
         heartbeat_ttl_ms: int = 2000,
         slice_ids: list[int] | None = None,
-        worker_env: dict | None = None,
-    ):
+        worker_env: dict[str, str] | None = None,
+    ) -> None:
         """slice_ids: per-worker TPU slice id (default: all slice 0).
         Workers on different slices model the multi-slice pod: placement
         ranks same-slice pools first and spills across slices (the DCN
@@ -113,9 +119,9 @@ class ProcessCluster:
             raise ValueError(
                 f"slice_ids has {len(slice_ids)} entries for {workers} workers")
         self.slice_ids = slice_ids or [0] * workers
-        self._procs: list[tuple[str, subprocess.Popen]] = []
-        self.worker_procs: list[subprocess.Popen] = []
-        self._tmp = None
+        self._procs: list[tuple[str, subprocess.Popen[str]]] = []
+        self.worker_procs: list[subprocess.Popen[str]] = []
+        self._tmp: tempfile.TemporaryDirectory[str] | None = None
         if workdir is None:
             self._tmp = tempfile.TemporaryDirectory(prefix="btpu_procluster_")
             workdir = self._tmp.name
@@ -163,7 +169,7 @@ class ProcessCluster:
                        heartbeat_ttl_ms: int) -> Path:
         from blackbird_tpu.worker import write_worker_yaml
 
-        pools = [
+        pools: list[dict[str, Any]] = [
             {"id": f"mc-{index}-hbm-{d}", "storage_class": "hbm_tpu",
              "capacity": f"{pool_mb}MB", "device_id": f"tpu:{d}"}
             for d in range(self.devices_per_worker)
@@ -180,7 +186,8 @@ class ProcessCluster:
             heartbeat_interval_ms=300, heartbeat_ttl_ms=heartbeat_ttl_ms)
         return path
 
-    def _spawn(self, args: list[str], name: str, env: dict | None = None):
+    def _spawn(self, args: list[str], name: str,
+               env: dict[str, str] | None = None) -> subprocess.Popen[str]:
         proc = spawn_logged(args, self.workdir / f"{name}.log", env=env)
         self._procs.append((name, proc))
         return proc
@@ -190,7 +197,7 @@ class ProcessCluster:
         return path.read_text()[-tail:] if path.exists() else ""
 
     @staticmethod
-    def _wait(predicate, timeout: float, what: str) -> None:
+    def _wait(predicate: Callable[[], bool], timeout: float, what: str) -> None:
         deadline = time.time() + timeout
         while time.time() < deadline:
             if predicate():
@@ -200,12 +207,12 @@ class ProcessCluster:
 
     # -- cluster interaction -------------------------------------------------
 
-    def client(self):
+    def client(self) -> Client:
         from blackbird_tpu.client import Client
 
         return Client(f"127.0.0.1:{self.keystone_port}")
 
-    def wait_ready(self, timeout: float = 300.0):
+    def wait_ready(self, timeout: float = 300.0) -> Client:
         """Blocks until every worker process registered all its pools.
 
         Generous by default: each worker pays a cold JAX import (+ jit
@@ -214,14 +221,14 @@ class ProcessCluster:
         client = self.client()
         expected_pools = self.expected_pools
 
-        def ready():
+        def ready() -> bool:
             for name, proc in self._procs:
                 if name.startswith("worker") and proc.poll() is not None:
                     raise RuntimeError(
                         f"{name} exited early:\n{self.process_log(name)}")
             stats = client.stats()
-            return (stats["workers"] == self.n_workers
-                    and stats["pools"] >= expected_pools)
+            return bool(stats["workers"] == self.n_workers
+                        and stats["pools"] >= expected_pools)
 
         self._wait(ready, timeout, f"{self.n_workers} workers / {expected_pools} pools")
         return client
@@ -231,9 +238,10 @@ class ProcessCluster:
         self.worker_procs[index].kill()
 
     def metrics(self) -> str:
-        return urllib.request.urlopen(
+        body: bytes = urllib.request.urlopen(
             f"http://127.0.0.1:{self.metrics_port}/metrics", timeout=5
-        ).read().decode()
+        ).read()
+        return body.decode()
 
     def objects_repaired(self) -> int:
         for line in self.metrics().splitlines():
@@ -255,8 +263,8 @@ class ProcessCluster:
             self._tmp.cleanup()
             self._tmp = None
 
-    def __enter__(self):
+    def __enter__(self) -> ProcessCluster:
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.close()

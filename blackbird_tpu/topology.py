@@ -22,7 +22,7 @@ def discover() -> list[TopoCoord]:
     """One TopoCoord per addressable device, in jax.devices() order."""
     import jax
 
-    coords = []
+    coords: list[TopoCoord] = []
     for device in jax.devices():
         slice_id = getattr(device, "slice_index", 0) or 0
         host_id = getattr(device, "process_index", 0) or 0

@@ -27,15 +27,24 @@ from __future__ import annotations
 import os
 import threading
 import time
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    import queue
 
 __all__ = ["TransferLink"]
 
 
 class TransferLink:
-    def __init__(self, jax_module, device=None):
+    # The jax module, devices, transfer server/connections are all Any on
+    # purpose: jax.experimental.transfer has no stable typed surface and the
+    # module is injected (tests substitute fakes). The typed boundary is
+    # this class's own API.
+    def __init__(self, jax_module: Any, device: Any = None) -> None:
         self._jax = jax_module
         self._device = device  # default: first local device, resolved lazily
-        self._server = None  # None = unprobed, False = unavailable/disabled
+        self._server: Any = None  # None = unprobed, False = unavailable/disabled
+        self._probe_failed_server: Any = None  # keep half-dead servers alive
         self.unavailable_reason: str | None = None  # set when probe fails
         self._lock = threading.Lock()
         self._conns: dict[str, object] = {}
@@ -48,19 +57,19 @@ class TransferLink:
         self._offer_lock = threading.Lock()
         self._conn_locks: dict[str, threading.Lock] = {}
         self._offered: dict[int, tuple[object, float]] = {}
-        self._gc_queue = None
+        self._gc_queue: queue.Queue[tuple[int, object]] | None = None
         self.offers = 0
         self.discards = 0  # stale offers drained by the GC self-pull
         self.gc_dropped = 0  # stale offers dropped: drainer is stuck
 
     # -- server / connections ----------------------------------------------
 
-    def device(self):
+    def device(self) -> Any:
         if self._device is None:
             self._device = self._jax.local_devices()[0]
         return self._device
 
-    def server(self):
+    def server(self) -> Any:
         """The lazily started per-process transfer server, or None
         (disabled via BTPU_HBM_FABRIC=0, or unavailable on this stack).
 
@@ -100,9 +109,9 @@ class TransferLink:
             import secrets  # noqa: PLC0415
             import numpy as np  # noqa: PLC0415
 
-            result: dict = {}
+            result: dict[str, Any] = {}
 
-            def _probe():
+            def _probe() -> None:
                 try:
                     tid = secrets.randbits(63)
                     arr = self._jax.device_put(
@@ -137,9 +146,9 @@ class TransferLink:
 
     def address(self) -> str | None:
         server = self.server()
-        return server.address() if server is not None else None
+        return str(server.address()) if server is not None else None
 
-    def connect(self, addr: str):
+    def connect(self, addr: str) -> Any:
         server = self.server()  # before the lock: it takes the same lock
         with self._lock:
             conn = self._conns.get(addr)
@@ -151,7 +160,7 @@ class TransferLink:
         with self._lock:
             return self._conn_locks.setdefault(addr, threading.Lock())
 
-    def _spec(self, shape, dtype, device):
+    def _spec(self, shape: Any, dtype: Any, device: Any) -> Any:
         from jax.sharding import SingleDeviceSharding  # noqa: PLC0415
 
         return self._jax.ShapeDtypeStruct(
@@ -159,7 +168,7 @@ class TransferLink:
 
     # -- offers --------------------------------------------------------------
 
-    def offer(self, transfer_id: int, arr, device=None) -> None:
+    def offer(self, transfer_id: int, arr: Any, device: Any = None) -> None:
         """Registers `arr` for a remote pull under `transfer_id` and tracks
         it for GC. Raises when the server is unavailable."""
         server = self.server()
@@ -173,7 +182,8 @@ class TransferLink:
             self._offered[int(transfer_id)] = (spec, time.monotonic())
         self.offers += 1
 
-    def pull(self, addr: str, transfer_id: int, length: int, device=None):
+    def pull(self, addr: str, transfer_id: int, length: int,
+             device: Any = None) -> Any:
         """Pulls uint8[length] offered under `transfer_id` at `addr` into
         this process's runtime; returns the device array."""
         import numpy as np  # noqa: PLC0415
@@ -200,11 +210,11 @@ class TransferLink:
             if self._gc_queue is None:
                 import queue  # noqa: PLC0415
 
-                self._gc_queue = queue.Queue(maxsize=256)
+                gc_queue = self._gc_queue = queue.Queue(maxsize=256)
 
-                def _drain():
+                def _drain() -> None:
                     while True:
-                        tid, spec = self._gc_queue.get()
+                        tid, spec = gc_queue.get()
                         try:
                             gc_addr = self.server().address()
                             conn = self.connect(gc_addr)

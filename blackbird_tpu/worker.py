@@ -27,12 +27,18 @@ import os
 import signal
 import sys
 import threading
+from typing import TYPE_CHECKING, Any
 
 from blackbird_tpu.native import lib
 
+if TYPE_CHECKING:
+    from pathlib import Path
 
-def write_worker_yaml(path, *, worker_id: str, cluster_id: str,
-                      coord_endpoints: str, pools: list[dict],
+    from blackbird_tpu.hbm import JaxHbmProvider
+
+
+def write_worker_yaml(path: str | Path, *, worker_id: str, cluster_id: str,
+                      coord_endpoints: str, pools: list[dict[str, Any]],
                       listen_host: str = "0.0.0.0", host_id: int = 0,
                       slice_id: int = 0, heartbeat_interval_ms: int = 1000,
                       heartbeat_ttl_ms: int = 5000) -> None:
@@ -42,7 +48,7 @@ def write_worker_yaml(path, *, worker_id: str, cluster_id: str,
     Each pool dict: {"id", "storage_class", "capacity" (int bytes or a
     "8MB"-style string), optional "device_id"}."""
 
-    def q(value) -> str:
+    def q(value: object) -> str:
         # Interpolated strings are single-quoted so ':'/'#' cannot corrupt
         # the document; the native parser strips one layer of quotes but has
         # no escape for an embedded quote, so those are rejected outright.
@@ -95,14 +101,14 @@ class WorkerHost:
     """A running native worker, optionally fronting JAX device memory."""
 
     def __init__(self, config_path: str, coord: str | None = None,
-                 jax_provider: bool = True):
-        self._provider = None
+                 jax_provider: bool = True) -> None:
+        self._provider: JaxHbmProvider | None = None
         if jax_provider:
             _pin_jax_platform()
             from blackbird_tpu.hbm import JaxHbmProvider
 
             self._provider = JaxHbmProvider().register()
-        self._handle = lib.btpu_worker_create(
+        self._handle: int | None = lib.btpu_worker_create(
             config_path.encode(), coord.encode() if coord else None)
         if not self._handle:
             if self._provider is not None:
@@ -115,7 +121,8 @@ class WorkerHost:
 
     @property
     def worker_id(self) -> str:
-        return lib.btpu_worker_id(self._handle).decode()
+        raw = lib.btpu_worker_id(self._handle)
+        return raw.decode() if raw is not None else ""
 
     def close(self) -> None:
         if self._handle:
@@ -125,15 +132,15 @@ class WorkerHost:
             self._provider.unregister()
             self._provider = None
 
-    def __enter__(self):
+    def __enter__(self) -> WorkerHost:
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.close()
 
 
 def main(argv: list[str] | None = None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser = argparse.ArgumentParser(description=(__doc__ or "").splitlines()[0])
     parser.add_argument("--config", required=True, help="worker.yaml path")
     parser.add_argument("--coord", default=None,
                         help="coordinator endpoint list override (host:port,...)")
@@ -150,9 +157,9 @@ def main(argv: list[str] | None = None) -> int:
     print(f"worker up with {host.pool_count} pools", flush=True)
 
     stop = threading.Event()
-    got_signal = {"sig": None}
+    got_signal: dict[str, int | None] = {"sig": None}
 
-    def on_signal(signum, _frame):
+    def on_signal(signum: int, _frame: object) -> None:
         got_signal["sig"] = signum
         stop.set()
 

@@ -1,0 +1,761 @@
+#!/usr/bin/env python3
+"""FFI-boundary drift checker: machine-checks the C ABI the Python plane
+binds (make lint / make check; docs/CORRECTNESS.md §11).
+
+Three artifacts must agree, entry by entry:
+
+  1. the headers — every `extern "C"` declaration in
+     native/include/btpu/capi.h and storage/hbm_provider.h, plus the
+     mirrored enums (error.h ErrorCode, types.h StorageClass/TransportKind),
+  2. the checked-in golden manifest native/tests/capi_golden.txt
+     (regenerate with `make capi-golden`; its diff IS the ABI review,
+     exactly like wire_golden.txt),
+  3. the Python manifest blackbird_tpu/_capi.py (which native.py consumes
+     verbatim to set every argtypes/restype) and the NativeAPI typed stub.
+
+Any divergence — missing/extra/unbound symbol, wrong integer width, wrong
+pointerness, stale or renamed enum value — FAILS the gate. A one-word drift
+here is silent memory corruption (ctypes happily truncates a u64 to c_int)
+or a misclassified error, never a build failure, which is why this check
+exists.
+
+Mechanics mirror scripts/btpu_lint.py: a pattern pass that runs — and can
+FAIL — on every box, plus a libclang refinement (budgeted,
+BTPU_LINT_LIBCLANG_BUDGET_S) that re-derives every signature from the real
+AST and convicts the pattern parser itself if they ever disagree. Boxes
+without libclang SKIP the refinement with a notice — never PASS it —
+and BTPU_REQUIRE_CLANG=1 (CI) turns that skip into a hard failure.
+
+  --dump-golden   print the golden manifest for the CURRENT headers
+  --self-test     planted-drift conviction test: mutates one signature and
+                  one enum value in a temp copy of the headers and asserts
+                  this checker convicts both (runs in make check)
+
+Exit code: 0 clean, 1 violations, 2 internal error.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN = REPO / "native/tests/capi_golden.txt"
+
+# Headers owning the FFI surface, relative to the repo root. capi.h is the
+# main C ABI; hbm_provider.h's extern "C" block carries the provider
+# registration entry points hbm.py binds.
+FFI_HEADERS = (
+    "native/include/btpu/capi.h",
+    "native/include/btpu/storage/hbm_provider.h",
+)
+ERROR_H = "native/include/btpu/common/error.h"
+TYPES_H = "native/include/btpu/common/types.h"
+
+# The enum mirrors: native enum name -> (header, C++ qualified-name hint).
+MIRRORED_ENUM_HEADERS = {
+    "ErrorCode": ERROR_H,
+    "StorageClass": TYPES_H,
+    "TransportKind": TYPES_H,
+}
+
+
+class CheckError(Exception):
+    """Internal error (malformed header, unparsable manifest) — exit 2."""
+
+
+# ---- comment stripping (shared with btpu_lint) -----------------------------
+# ONE stripper for both linters: btpu_lint's is exactly length-preserving
+# (offsets computed on stripped text slice the raw text correctly) and
+# handles char literals too — a second copy would drift.
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from btpu_lint import strip_comments_and_strings as strip_comments  # noqa: E402
+
+
+# ---- C type canonicalization ----------------------------------------------
+
+# Fixed-width (and fixed-width-on-this-ABI) integer spellings.
+_INT_TOKENS = {
+    "int32_t": "i32",
+    "uint32_t": "u32",
+    "int64_t": "i64",
+    "uint64_t": "u64",
+    "int": "i32",  # callbacks only; top-level capi uses fixed-width
+}
+
+
+def canonical_type(c_type: str) -> str:
+    """Canonicalize one C type spelling into the manifest token language.
+
+    const-ness and struct identity are ABI-irrelevant for ctypes: every
+    struct pointer is `ptr`. Pointer depth and integer width are exactly
+    what ctypes must match, so they survive canonicalization.
+    """
+    t = c_type.strip()
+    # Array-of-T parameters decay to T* (e.g. `uint64_t out[6]`).
+    arrays = len(re.findall(r"\[\s*\d*\s*\]", t))
+    t = re.sub(r"\[\s*\d*\s*\]", "", t)
+    stars = t.count("*") + arrays
+    t = t.replace("*", " ")
+    words = [w for w in t.split() if w not in ("const", "struct", "volatile")]
+    if not words:
+        raise CheckError(f"unparsable C type: {c_type!r}")
+    base = words[-1] if words[-1] not in ("unsigned", "signed") else " ".join(words)
+    if base == "void":
+        if stars == 0:
+            return "void"
+        return "ptr" if stars == 1 else "ptr*"
+    if base == "char":
+        if stars == 1:
+            return "cstr"
+        if stars == 2:
+            return "cstr*"
+        raise CheckError(f"unsupported char pointer depth in {c_type!r}")
+    if base in _INT_TOKENS:
+        tok = _INT_TOKENS[base]
+        if stars == 0:
+            return tok
+        if stars == 1 and tok in ("u64", "i32"):
+            return f"{tok}*"
+        raise CheckError(f"unsupported pointer depth/width in {c_type!r}")
+    # Anything else is a struct/opaque type: only pointers to it may cross
+    # the boundary.
+    if stars >= 1:
+        return "ptr"
+    raise CheckError(f"by-value struct at the FFI boundary: {c_type!r}")
+
+
+# ---- extern "C" prototype parsing ------------------------------------------
+
+
+def extern_c_regions(stripped: str) -> list[str]:
+    """The text inside each `extern "C" { ... }` block (brace-matched)."""
+    regions = []
+    # NB: strip_comments blanks string-literal CONTENTS (keeping the quotes),
+    # so the linkage spelling matches any quoted token here.
+    for m in re.finditer(r'extern\s+"[^"]*"\s*\{', stripped):
+        depth, i = 1, m.end()
+        start = i
+        while i < len(stripped) and depth:
+            if stripped[i] == "{":
+                depth += 1
+            elif stripped[i] == "}":
+                depth -= 1
+            i += 1
+        regions.append(stripped[start : i - 1])
+    return regions
+
+
+_PROTO = re.compile(
+    r"(?P<ret>[A-Za-z_][\w\s]*?[\w\*]\s*\**)\s*"
+    r"(?P<name>btpu_\w+)\s*\((?P<args>[^()]*)\)\s*$"
+)
+
+
+def parse_functions(header_text: str) -> dict[str, tuple[str, tuple[str, ...]]]:
+    """Every `extern "C"` btpu_* prototype as name -> (ret, arg tokens)."""
+    stripped = strip_comments(header_text)
+    decls: dict[str, tuple[str, tuple[str, ...]]] = {}
+    for region in extern_c_regions(stripped):
+        # Drop struct/typedef bodies so function-pointer FIELDS (provider
+        # vtables) never parse as top-level prototypes.
+        region = re.sub(r"\{[^{}]*\}", " ", region)
+        for stmt in region.split(";"):
+            stmt = " ".join(stmt.split())
+            m = _PROTO.search(stmt)
+            if not m:
+                continue
+            # A function-pointer field or a call in a default arg would put
+            # '(' or '*' right before the name; prototypes never do.
+            before = stmt[: m.start("name")].rstrip()
+            if before.endswith(("(", ",")):
+                continue
+            name = m.group("name")
+            ret = canonical_type(m.group("ret"))
+            args: list[str] = []
+            arg_text = m.group("args").strip()
+            if arg_text and arg_text != "void":
+                for arg in arg_text.split(","):
+                    arg = arg.strip()
+                    # Strip the parameter name (last identifier, unless the
+                    # arg is a bare type like `void` or ends in '*').
+                    am = re.match(r"^(?P<type>.*?)(?P<n>\b[A-Za-z_]\w*)?"
+                                  r"(?P<arr>(\s*\[\s*\d*\s*\])*)\s*$", arg)
+                    if am is None:
+                        raise CheckError(f"unparsable parameter {arg!r} in {name}")
+                    type_part = (am.group("type") or "") + (am.group("arr") or "")
+                    # `const char` + name `key` → type `const char`; but a
+                    # nameless `uint64_t` must keep its word.
+                    if not am.group("type", ).strip():
+                        type_part = am.group("n") or ""
+                    args.append(canonical_type(type_part))
+            if name in decls and decls[name] != (ret, tuple(args)):
+                raise CheckError(f"conflicting declarations for {name}")
+            decls[name] = (ret, tuple(args))
+    return decls
+
+
+# ---- enum parsing ----------------------------------------------------------
+
+
+def parse_enum(header_text: str, enum_name: str,
+               env: dict[str, int] | None = None) -> dict[str, int]:
+    """`enum class [ATTR] Name [: type] { ... }` -> name -> value, honoring
+    auto-increment and `domain_base(Domain::X)` initializers via `env`."""
+    stripped = strip_comments(header_text)
+    m = re.search(
+        rf"enum\s+class\s+(?:[A-Z_][A-Z0-9_]*\s+)?{enum_name}\b[^{{]*\{{",
+        stripped,
+    )
+    if not m:
+        raise CheckError(f"enum {enum_name} not found")
+    depth, i = 1, m.end()
+    start = i
+    while i < len(stripped) and depth:
+        if stripped[i] == "{":
+            depth += 1
+        elif stripped[i] == "}":
+            depth -= 1
+        i += 1
+    body = stripped[start : i - 1]
+    values: dict[str, int] = {}
+    next_value = 0
+    for entry in body.split(","):
+        entry = " ".join(entry.split())
+        if not entry:
+            continue
+        if "=" in entry:
+            name, expr = (s.strip() for s in entry.split("=", 1))
+            dm = re.match(r"domain_base\s*\(\s*Domain\s*::\s*(\w+)\s*\)", expr)
+            if dm:
+                key = dm.group(1)
+                if env is None or key not in env:
+                    raise CheckError(f"{enum_name}.{name}: unknown Domain::{key}")
+                value = env[key]
+            else:
+                try:
+                    value = int(expr.rstrip("uUlL"), 0)
+                except ValueError as e:
+                    raise CheckError(
+                        f"{enum_name}.{name}: unevaluable initializer {expr!r}"
+                    ) from e
+        else:
+            name, value = entry, next_value
+        if not re.fullmatch(r"[A-Za-z_]\w*", name):
+            raise CheckError(f"{enum_name}: malformed enumerator {entry!r}")
+        values[name] = value
+        next_value = value + 1
+    return values
+
+
+def parse_mirrored_enums(root: Path) -> dict[str, dict[str, int]]:
+    domain = parse_enum((root / ERROR_H).read_text(), "Domain")
+    return {
+        "ErrorCode": parse_enum((root / ERROR_H).read_text(), "ErrorCode",
+                                env=domain),
+        "StorageClass": parse_enum((root / TYPES_H).read_text(), "StorageClass"),
+        "TransportKind": parse_enum((root / TYPES_H).read_text(), "TransportKind"),
+    }
+
+
+def parse_header_surface(root: Path) -> dict[str, tuple[str, tuple[str, ...]]]:
+    decls: dict[str, tuple[str, tuple[str, ...]]] = {}
+    for rel in FFI_HEADERS:
+        for name, sig in parse_functions((root / rel).read_text()).items():
+            if name in decls:
+                raise CheckError(f"{name} declared in more than one FFI header")
+            decls[name] = sig
+    if not decls:
+        raise CheckError("no extern-C declarations found — parser broken?")
+    return decls
+
+
+# ---- golden manifest -------------------------------------------------------
+
+
+def render_golden(decls: dict[str, tuple[str, tuple[str, ...]]],
+                  enums: dict[str, dict[str, int]]) -> str:
+    lines = [
+        "# capi golden manifest — the reviewed FFI surface.",
+        "# Regenerate with `make capi-golden` after editing capi.h /",
+        "# hbm_provider.h or a mirrored enum; the DIFF of this file is the",
+        "# ABI review (docs/CORRECTNESS.md §11). scripts/capi_check.py fails",
+        "# `make lint` whenever headers, this file, and blackbird_tpu/_capi.py",
+        "# disagree.",
+        "[functions]",
+    ]
+    for name in sorted(decls):
+        ret, args = decls[name]
+        lines.append(f"{name} {ret} : {' '.join(args)}".rstrip())
+    for enum_name in sorted(enums):
+        lines.append(f"[enum {enum_name}]")
+        for member, value in sorted(enums[enum_name].items(), key=lambda kv: kv[1]):
+            lines.append(f"{member} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_golden(text: str) -> tuple[dict[str, tuple[str, tuple[str, ...]]],
+                                     dict[str, dict[str, int]]]:
+    decls: dict[str, tuple[str, tuple[str, ...]]] = {}
+    enums: dict[str, dict[str, int]] = {}
+    section = None
+    for line_no, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            section = line.strip("[]")
+            if section.startswith("enum "):
+                enums[section.split()[1]] = {}
+            continue
+        if section == "functions":
+            try:
+                head, args = line.split(":", 1)
+                name, ret = head.split()
+                decls[name] = (ret, tuple(args.split()))
+            except ValueError as e:
+                raise CheckError(f"capi_golden.txt:{line_no}: bad row") from e
+        elif section and section.startswith("enum "):
+            try:
+                member, value = line.split()
+                enums[section.split()[1]][member] = int(value)
+            except ValueError as e:
+                raise CheckError(f"capi_golden.txt:{line_no}: bad enum row") from e
+        else:
+            raise CheckError(f"capi_golden.txt:{line_no}: row outside a section")
+    return decls, enums
+
+
+# ---- the Python side -------------------------------------------------------
+
+
+def load_python_manifest() -> tuple[dict[str, tuple[str, tuple[str, ...]]],
+                                    frozenset[str], dict[str, dict[str, int]]]:
+    """blackbird_tpu/_capi.py: signatures, OPTIONAL set, enum mirrors.
+
+    Loaded STANDALONE via importlib, bypassing the blackbird_tpu package
+    __init__ — which imports native.py and would build + dlopen libbtpu.so.
+    This is a static gate: it must run (and report drift) on boxes with no
+    toolchain and against .so files whose very brokenness is the thing
+    being diagnosed. _capi.py itself imports only ctypes/enum/typing."""
+    import importlib.util
+
+    path = REPO / "blackbird_tpu" / "_capi.py"
+    spec = importlib.util.spec_from_file_location("btpu_capi_manifest", path)
+    if spec is None or spec.loader is None:
+        raise CheckError(f"cannot load manifest module {path}")
+    _capi = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(_capi)
+    mirrors = {
+        name: {m.name: int(m.value) for m in enum_cls}
+        for name, enum_cls in _capi.MIRRORED_ENUMS.items()
+    }
+    sigs = {name: (ret, tuple(args))
+            for name, (ret, args) in _capi.SIGNATURES.items()}
+    return sigs, frozenset(_capi.OPTIONAL), mirrors
+
+
+def parse_protocol_members() -> set[str]:
+    """Method names of native.py's NativeAPI protocol, by TEXT — importing
+    native.py would build and load the library, which a static gate must
+    never do."""
+    text = (REPO / "blackbird_tpu/native.py").read_text()
+    m = re.search(r"^class NativeAPI\b.*?:$", text, re.M)
+    if not m:
+        raise CheckError("native.py: class NativeAPI not found")
+    members: set[str] = set()
+    for line in text[m.end():].splitlines():
+        if re.match(r"^(?:class |[A-Za-z_@])", line):  # next top-level stmt
+            break
+        dm = re.match(r"\s+def (btpu_\w+)\s*\(", line)
+        if dm:
+            members.add(dm.group(1))
+    if not members:
+        raise CheckError("native.py: NativeAPI has no btpu_* methods?")
+    return members
+
+
+# ---- comparison ------------------------------------------------------------
+
+
+def compare(decls: dict[str, tuple[str, tuple[str, ...]]],
+            enums: dict[str, dict[str, int]]) -> list[str]:
+    """All drift findings between header-derived truth (`decls`/`enums` —
+    which MAY come from a mutated temp tree, as in the self-test) and the
+    two checked-in artifacts: the repo's golden and Python manifest."""
+    violations: list[str] = []
+    py_sigs, optional, mirrors = load_python_manifest()
+
+    # 1. headers vs golden: the review trigger.
+    if not GOLDEN.is_file():
+        violations.append(
+            f"golden: {GOLDEN.relative_to(REPO)} missing — run `make capi-golden`")
+    else:
+        gold_decls, gold_enums = parse_golden(GOLDEN.read_text())
+        for name in sorted(set(decls) | set(gold_decls)):
+            if name not in gold_decls:
+                violations.append(
+                    f"golden: {name} declared in headers but not in "
+                    "capi_golden.txt — run `make capi-golden` and review the diff")
+            elif name not in decls:
+                violations.append(
+                    f"golden: {name} in capi_golden.txt but gone from the "
+                    "headers — removing ABI is a breaking change; run "
+                    "`make capi-golden` and review the diff")
+            elif decls[name] != gold_decls[name]:
+                violations.append(
+                    f"golden: {name} signature drifted: headers say "
+                    f"{fmt(decls[name])}, golden says {fmt(gold_decls[name])}"
+                    " — run `make capi-golden` and review the diff")
+        for enum_name in sorted(set(enums) | set(gold_enums)):
+            h, g = enums.get(enum_name, {}), gold_enums.get(enum_name, {})
+            for member in sorted(set(h) | set(g), key=lambda k: (h.get(k, g.get(k, 0)), k)):
+                if h.get(member) != g.get(member):
+                    violations.append(
+                        f"golden: enum {enum_name}.{member}: headers say "
+                        f"{h.get(member, '<absent>')}, golden says "
+                        f"{g.get(member, '<absent>')} — run `make capi-golden`")
+
+    # 2. headers vs the ctypes manifest: the memory-safety check.
+    for name in sorted(set(decls) | set(py_sigs)):
+        if name not in py_sigs:
+            violations.append(
+                f"bindings: {name} declared in the headers but missing from "
+                "blackbird_tpu/_capi.py SIGNATURES — unbound symbols called "
+                "via raw CDLL default to int restype (u64 truncation); bind it")
+        elif name not in decls:
+            violations.append(
+                f"bindings: {name} bound in blackbird_tpu/_capi.py but not "
+                "declared in any FFI header — stale binding or missing "
+                "declaration")
+        elif py_sigs[name] != decls[name]:
+            violations.append(
+                f"bindings: {name} type drift: headers say {fmt(decls[name])}, "
+                f"_capi.py says {fmt(py_sigs[name])} — wrong width/pointerness "
+                "is silent memory corruption; fix the manifest (or the header)")
+    for name in sorted(optional - set(decls)):
+        violations.append(
+            f"bindings: OPTIONAL symbol {name} is not declared in any FFI "
+            "header — optional means 'absent from old binaries', never "
+            "'unknown to the headers'")
+
+    # 3. enum mirrors: exact bijection.
+    for enum_name, native_values in sorted(enums.items()):
+        mirror = mirrors.get(enum_name)
+        if mirror is None:
+            violations.append(f"enums: {enum_name} has no Python mirror in _capi.py")
+            continue
+        for member in sorted(set(native_values) | set(mirror),
+                             key=lambda k: (native_values.get(k, mirror.get(k, 0)), k)):
+            nv, pv = native_values.get(member), mirror.get(member)
+            if nv is None:
+                violations.append(
+                    f"enums: {enum_name}.{member} = {pv} exists only in the "
+                    "Python mirror — stale or renamed enumerator")
+            elif pv is None:
+                violations.append(
+                    f"enums: {enum_name}.{member} = {nv} missing from the "
+                    "Python mirror — add it (mirrors are complete bijections)")
+            elif nv != pv:
+                violations.append(
+                    f"enums: {enum_name}.{member}: native {nv} != python {pv} "
+                    "— a misnumbered mirror misclassifies every such error")
+
+    # 4. the typed stub: NativeAPI must cover the manifest 1:1 (mypy checks
+    # the annotations; this check pins the SET so a new binding cannot land
+    # without its typed method).
+    proto = parse_protocol_members()
+    for name in sorted(set(py_sigs) - proto):
+        violations.append(
+            f"stub: {name} is in _capi.py SIGNATURES but NativeAPI (native.py) "
+            "has no typed method for it")
+    for name in sorted(proto - set(py_sigs)):
+        violations.append(
+            f"stub: NativeAPI.{name} has no _capi.py SIGNATURES row — stub "
+            "methods must bind real symbols")
+    return violations
+
+
+def fmt(sig: tuple[str, tuple[str, ...]]) -> str:
+    ret, args = sig
+    return f"({', '.join(args)}) -> {ret}"
+
+
+# ---- libclang refinement ---------------------------------------------------
+
+
+def clang_type_token(t: "object") -> str:
+    """cindex.Type -> manifest token (canonical kinds, so typedef chains and
+    platform spellings cannot fool it)."""
+    from clang import cindex  # local: only called when importable
+
+    t = t.get_canonical()
+    k = t.kind
+    if k == cindex.TypeKind.VOID:
+        return "void"
+    int_kinds = {
+        cindex.TypeKind.INT: ("i", 4), cindex.TypeKind.UINT: ("u", 4),
+        cindex.TypeKind.LONG: ("i", t.get_size()),
+        cindex.TypeKind.ULONG: ("u", t.get_size()),
+        cindex.TypeKind.LONGLONG: ("i", 8), cindex.TypeKind.ULONGLONG: ("u", 8),
+    }
+    if k in int_kinds:
+        sign, size = int_kinds[k]
+        return f"{sign}{int(size) * 8}"
+    if k in (cindex.TypeKind.CONSTANTARRAY, cindex.TypeKind.INCOMPLETEARRAY):
+        inner = clang_type_token(t.element_type)
+        return {"u64": "u64*", "i32": "i32*"}.get(inner, "ptr")
+    if k == cindex.TypeKind.POINTER:
+        p = t.get_pointee().get_canonical()
+        pk = p.kind
+        if pk == cindex.TypeKind.VOID:
+            return "ptr"
+        if pk in (cindex.TypeKind.CHAR_S, cindex.TypeKind.SCHAR,
+                  cindex.TypeKind.CHAR_U, cindex.TypeKind.UCHAR):
+            return "cstr"
+        if pk == cindex.TypeKind.POINTER:
+            pp = p.get_pointee().get_canonical()
+            if pp.kind in (cindex.TypeKind.CHAR_S, cindex.TypeKind.SCHAR):
+                return "cstr*"
+            return "ptr*"
+        if pk in int_kinds:
+            sign, size = int_kinds[pk]
+            return f"{sign}{int(size) * 8}*"
+        return "ptr"  # struct / record pointer
+    raise CheckError(f"libclang: unsupported FFI type {t.spelling!r}")
+
+
+# Hermetic preamble: the extern-C regions only need the fixed-width integer
+# typedefs, so the synthetic TU includes NOTHING from the filesystem — the
+# refinement runs identically on gcc-only boxes where libclang has no hosted
+# header tree, and costs one sub-second parse.
+_SYNTH_PREAMBLE = """\
+typedef int int32_t;
+typedef unsigned int uint32_t;
+typedef long long int64_t;
+typedef unsigned long long uint64_t;
+"""
+
+
+def extern_c_raw_regions(raw: str) -> list[str]:
+    """extern "C" region text from the RAW header (comments intact for
+    clang). Offsets come from the stripped text — the stripper is exactly
+    length-preserving, so the slices line up."""
+    stripped = strip_comments(raw)
+    regions = []
+    for m in re.finditer(r'extern\s+"[^"]*"\s*\{', stripped):
+        depth, i = 1, m.end()
+        while i < len(stripped) and depth:
+            if stripped[i] == "{":
+                depth += 1
+            elif stripped[i] == "}":
+                depth -= 1
+            i += 1
+        regions.append(raw[m.end() : i - 1])
+    return regions
+
+
+def clang_refine(root: Path,
+                 pattern_decls: dict[str, tuple[str, tuple[str, ...]]]) -> tuple[bool, list[str]]:
+    """Re-derive every extern-C signature from the clang AST and convict the
+    pattern parser on any disagreement. Returns (ran, violations)."""
+    try:
+        from clang import cindex
+        index = cindex.Index.create()
+    except Exception:
+        return False, []
+    import time
+    deadline = time.monotonic() + float(
+        os.environ.get("BTPU_LINT_LIBCLANG_BUDGET_S", "20"))
+    violations: list[str] = []
+    ast_decls: dict[str, tuple[str, tuple[str, ...]]] = {}
+    body = "".join(
+        # The C++-guard pattern (`#ifdef __cplusplus` around the braces)
+        # leaves unbalanced directives inside a region sliced by brace
+        # matching; regions carry no other directives, so blank them all.
+        re.sub(r"^\s*#.*$", "", region, flags=re.M)
+        for rel in FFI_HEADERS
+        for region in extern_c_raw_regions((root / rel).read_text())
+    )
+    synth = f'{_SYNTH_PREAMBLE}extern "C" {{\n{body}\n}}\n'
+    tu = index.parse(
+        "btpu_capi_synth.cpp",
+        args=["-x", "c++", "-std=c++20", "-nostdinc", "-nostdinc++"],
+        unsaved_files=[("btpu_capi_synth.cpp", synth)],
+    )
+    for d in tu.diagnostics:
+        if d.severity >= cindex.Diagnostic.Error:
+            violations.append(f"libclang: synthetic TU parse error: {d.spelling}")
+    complete = True
+    for cur in tu.cursor.walk_preorder():
+        if time.monotonic() > deadline:
+            print("capi_check: libclang budget spent; pattern pass covers "
+                  "the remainder", file=sys.stderr)
+            complete = False
+            break
+        if cur.kind != cindex.CursorKind.FUNCTION_DECL:
+            continue
+        if not cur.spelling.startswith("btpu_"):
+            continue
+        ret = clang_type_token(cur.result_type)
+        args = tuple(clang_type_token(a.type) for a in cur.get_arguments())
+        ast_decls[cur.spelling] = (ret, args)
+    for name in sorted(ast_decls):
+        if name not in pattern_decls:
+            violations.append(
+                f"libclang: {name} visible to the AST but missed by the "
+                "pattern parser — parser bug, fix capi_check.py")
+        elif ast_decls[name] != pattern_decls[name]:
+            violations.append(
+                f"libclang: {name}: AST says {fmt(ast_decls[name])}, pattern "
+                f"parser says {fmt(pattern_decls[name])} — parser bug or an "
+                "exotic declaration; reconcile before trusting the gate")
+    # Pattern-parsed symbols the AST never reported are only evidence of a
+    # parser bug when the walk COMPLETED — a budget-cut walk legitimately
+    # leaves names unvisited, and convicting those would fail a clean tree.
+    if complete:
+        for name in sorted(set(pattern_decls) - set(ast_decls)):
+            violations.append(
+                f"libclang: {name} parsed by the pattern pass but absent from "
+                "the AST — parser bug, fix capi_check.py")
+    return True, violations
+
+
+# ---- self-test: planted drift must convict ---------------------------------
+
+
+def self_test(require_clang: bool) -> int:
+    """Copies the FFI headers into a temp tree, plants (a) one integer-width
+    signature drift and (b) one enum-value drift, and asserts this checker
+    convicts BOTH against the real golden/manifest. A checker that cannot
+    convict a planted lie is scenery, not a gate."""
+    import shutil
+    import tempfile
+
+    failures: list[str] = []
+
+    def run_against(mutate: "dict[str, tuple[str, str]]",
+                    expect_fragment: str, label: str) -> None:
+        with tempfile.TemporaryDirectory(prefix="capi-selftest-") as tmp:
+            tmp_root = Path(tmp)
+            for rel in (*FFI_HEADERS, ERROR_H, TYPES_H):
+                dst = tmp_root / rel
+                dst.parent.mkdir(parents=True, exist_ok=True)
+                shutil.copy(REPO / rel, dst)
+            for rel, (old, new) in mutate.items():
+                path = tmp_root / rel
+                text = path.read_text()
+                if old not in text:
+                    raise CheckError(
+                        f"self-test: mutation anchor {old!r} not found in {rel} "
+                        "— update the self-test alongside the header")
+                path.write_text(text.replace(old, new, 1))
+            decls = parse_header_surface(tmp_root)
+            enums = parse_mirrored_enums(tmp_root)
+            violations = compare(decls, enums)
+            hits = [v for v in violations if expect_fragment in v]
+            if hits:
+                print(f"capi_check self-test: {label}: CONVICTED "
+                      f"({len(hits)} finding(s); e.g. {hits[0]!r})")
+            else:
+                failures.append(
+                    f"{label}: planted drift NOT convicted "
+                    f"(violations seen: {violations or 'none'})")
+            # The libclang half: the AST must also see the planted signature
+            # drift (it re-derives signatures independently, so the mutated
+            # header now disagrees with the pattern-parse of the ORIGINAL).
+            if label.startswith("signature"):
+                ran, clang_violations = clang_refine(
+                    tmp_root, parse_header_surface(REPO))
+                if ran:
+                    if any("btpu_get" in v for v in clang_violations):
+                        print("capi_check self-test: libclang leg: CONVICTED")
+                    else:
+                        failures.append(
+                            "libclang leg: planted signature drift NOT "
+                            "convicted by the AST pass")
+                elif require_clang:
+                    failures.append(
+                        "libclang leg: BTPU_REQUIRE_CLANG=1 but libclang is "
+                        "not importable — the refinement did not run")
+                else:
+                    print("capi_check self-test: NOTICE — libclang not "
+                          "importable; AST conviction SKIPPED (never PASS)",
+                          file=sys.stderr)
+
+    # (a) width drift: btpu_get's buffer_size narrows u64 -> u32. On a
+    # 64-bit ABI that reads garbage for the high word — the exact silent
+    # corruption class the gate exists for.
+    run_against(
+        {FFI_HEADERS[0]: (
+            "int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint64_t buffer_size",
+            "int32_t btpu_get(btpu_client* client, const char* key, void* buffer, uint32_t buffer_size",
+        )},
+        "btpu_get",
+        "signature width drift (btpu_get u64->u32)",
+    )
+    # (b) enum drift: a new enumerator spliced in front of
+    # MEMORY_POOL_NOT_FOUND shifts every later Storage value by one.
+    run_against(
+        {ERROR_H: (
+            "  MEMORY_POOL_NOT_FOUND,",
+            "  STORAGE_SELFTEST_DRIFT,\n  MEMORY_POOL_NOT_FOUND,",
+        )},
+        "enums:",
+        "enum value drift (Storage block shifted)",
+    )
+    if failures:
+        print(f"capi_check self-test: FAIL — {len(failures)} problem(s)",
+              file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("capi_check self-test: both planted drifts convicted")
+    return 0
+
+
+# ---- main ------------------------------------------------------------------
+
+
+def main(argv: list[str]) -> int:
+    require_clang = os.environ.get("BTPU_REQUIRE_CLANG", "0") == "1"
+    try:
+        if "--self-test" in argv:
+            return self_test(require_clang)
+        decls = parse_header_surface(REPO)
+        enums = parse_mirrored_enums(REPO)
+        if "--dump-golden" in argv:
+            sys.stdout.write(render_golden(decls, enums))
+            return 0
+        violations = compare(decls, enums)
+        ran, clang_violations = clang_refine(REPO, decls)
+        violations += clang_violations
+        if not ran:
+            if require_clang:
+                violations.append(
+                    "libclang: BTPU_REQUIRE_CLANG=1 but libclang is not "
+                    "importable — the AST refinement may not silently skip in CI")
+            else:
+                print("capi_check: NOTICE — libclang not importable; AST "
+                      "refinement skipped (pattern pass still gates)",
+                      file=sys.stderr)
+        mode = "libclang+patterns" if ran else "patterns"
+        if violations:
+            print(f"capi_check ({mode}): {len(violations)} violation(s)",
+                  file=sys.stderr)
+            for v in violations:
+                print(f"  {v}", file=sys.stderr)
+            return 1
+        print(f"capi_check ({mode}): clean — {len(decls)} extern-C signatures "
+              f"and {sum(len(v) for v in enums.values())} enum values agree "
+              "across headers, golden, and the Python manifest")
+        return 0
+    except CheckError as e:
+        print(f"capi_check: internal error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -2,11 +2,14 @@
 # The one-command correctness gate (make check):
 #
 #   1. make native      — normal build (includes the compile-time wire lint)
-#   2. make lint        — clang -Wthread-safety sweep + python compileall
-#   3. native suite     — all 25 suites incl. the wire golden-table diff
-#   4. tier-1 pytest    — the Python/JAX layer (skips cleanly without jax)
-#   5. make asan        — address + undefined + leak, full native suite
-#   6. make tsan        — thread sanitizer, full native suite
+#   2. make lint        — project invariants + FFI-boundary capi check +
+#                         clang TSA sweep + compileall + mypy strict + ruff
+#                         (one scoreboard row per sub-leg)
+#   3. capi self-test   — planted FFI drift must CONVICT (capi_check.py)
+#   4. native suite     — all 25 suites incl. the wire golden-table diff
+#   5. tier-1 pytest    — the Python/JAX layer (skips cleanly without jax)
+#   6. make asan        — address + undefined + leak, full native suite
+#   7. make tsan        — thread sanitizer, full native suite
 #
 # Every leg runs even after an earlier one fails (you want the whole
 # scoreboard, not the first stumble); the exit code is the OR of all legs.
@@ -36,10 +39,12 @@ jobs="$(nproc 2> /dev/null || echo 1)"
 
 run_leg "build" make -j"$jobs" native
 
-# Lint is special-cased: without clang the thread-safety sweep cannot run,
-# and that must show as SKIP in the scoreboard, never as PASS (the sweep is
-# the gate's headline check). CI images that are expected to have clang set
-# BTPU_REQUIRE_CLANG=1, which turns the skip into a hard failure.
+# Lint is special-cased: its sub-legs (project invariants, FFI-boundary
+# capi check, clang TSA sweep, compileall, mypy strict, ruff) each get their
+# own scoreboard row, parsed from lint.sh's machine-readable
+# `lint-scoreboard:` lines. Tool-absent legs show SKIP — never PASS — and
+# the BTPU_REQUIRE_{CLANG,MYPY,RUFF}=1 knobs (CI) turn those skips into
+# failures inside lint.sh itself.
 echo
 echo "===================================================================="
 echo "== check: lint"
@@ -48,19 +53,26 @@ lint_out="$(scripts/lint.sh 2>&1)"
 lint_rc=$?
 printf '%s\n' "$lint_out"
 if [ "$lint_rc" -ne 0 ]; then
-  results[lint]=FAIL
   overall=1
-elif printf '%s' "$lint_out" | grep -q "clang not found"; then
-  if [ "${BTPU_REQUIRE_CLANG:-0}" = "1" ]; then
-    echo "check: FAIL — BTPU_REQUIRE_CLANG=1 but clang is not installed" >&2
-    results[lint]=FAIL
+fi
+for row in invariants capi-check tsa-sweep compileall mypy ruff; do
+  status="$(printf '%s\n' "$lint_out" \
+            | sed -n "s/^lint-scoreboard: ${row}=//p" | tail -n 1)"
+  if [ -z "$status" ]; then
+    # A missing row means lint.sh crashed or the format drifted — that must
+    # fail the GATE, not just render a FAIL row in a green run.
+    results[lint-$row]="FAIL (no scoreboard line — lint.sh crashed?)"
     overall=1
   else
-    results[lint]="SKIP (no clang — sweep did not run)"
+    results[lint-$row]="$status"
   fi
-else
-  results[lint]=PASS
-fi
+done
+
+# The FFI checker must be able to CONVICT, not just agree: the planted-drift
+# self-test mutates one signature and one enum value in a temp header copy
+# and asserts conviction. Its libclang half SKIPs with a notice on boxes
+# without libclang (never PASS); BTPU_REQUIRE_CLANG=1 makes that skip fatal.
+run_leg "capi-selftest" python3 scripts/capi_check.py --self-test
 run_leg "native-suite" ./build/btpu_tests
 # The io_uring engine is the default TCP data plane wherever the kernel
 # allows it, which means the whole suite above exercised it (and asan/tsan
@@ -136,9 +148,11 @@ echo
 echo "===================================================================="
 echo "== check: summary"
 echo "===================================================================="
-for leg in build lint native-suite iouring-net-0-uring iouring-net-0-transport \
+for leg in build lint-invariants lint-capi-check lint-tsa-sweep \
+           lint-compileall lint-mypy lint-ruff capi-selftest native-suite \
+           iouring-net-0-uring iouring-net-0-transport \
            iouring-net-0-remote-lane iouring-net-1-uring iouring-net-1-remote-lane \
            tier1-pytest asan tsan fuzz-smoke crash-smoke sched-smoke; do
-  [ -n "${results[$leg]:-}" ] && printf '  %-14s %s\n' "$leg" "${results[$leg]}"
+  [ -n "${results[$leg]:-}" ] && printf '  %-18s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

@@ -2,8 +2,10 @@
 # CI entrypoint: the one command a CI job runs.
 #
 # Two differences from a developer's `make check`:
-#   - BTPU_REQUIRE_CLANG=1: CI images are expected to ship clang, so the
-#     thread-safety sweep SKIP a laptop tolerates becomes a hard failure
+#   - BTPU_REQUIRE_CLANG=1 / BTPU_REQUIRE_MYPY=1 / BTPU_REQUIRE_RUFF=1:
+#     CI images are expected to ship clang, mypy, and ruff, so the
+#     tool-absent SKIPs a laptop tolerates (TSA sweep, strict type check,
+#     pyflakes-class sweep, capi libclang refinement) become hard failures
 #     here — the lint gates cannot silently degrade in CI.
 #   - a bounded `make fuzz` leg (BTPU_FUZZ_EXECS/BTPU_FUZZ_TIME below):
 #     enough executions to catch a decoder regression on every push; the
@@ -16,9 +18,9 @@ cd "$(dirname "$0")/.."
 overall=0
 
 echo "==================================================================="
-echo "== ci: make check (BTPU_REQUIRE_CLANG=1)"
+echo "== ci: make check (BTPU_REQUIRE_CLANG=1, BTPU_REQUIRE_MYPY=1, BTPU_REQUIRE_RUFF=1)"
 echo "==================================================================="
-if ! BTPU_REQUIRE_CLANG=1 make check; then
+if ! BTPU_REQUIRE_CLANG=1 BTPU_REQUIRE_MYPY=1 BTPU_REQUIRE_RUFF=1 make check; then
   overall=1
 fi
 

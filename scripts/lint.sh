@@ -5,28 +5,62 @@
 #      in the golden table, nodiscard on error-returning declarations).
 #      Pattern-based with an optional libclang refinement, so it runs — and
 #      can FAIL — on every box, clang or not.
-#   2. clang -fsyntax-only -Wthread-safety -Werror sweep over every native
+#   2. scripts/capi_check.py — the FFI-boundary drift checker: every
+#      extern "C" signature and mirrored enum must agree across the headers,
+#      native/tests/capi_golden.txt, and blackbird_tpu/_capi.py (docs/
+#      CORRECTNESS.md §11). Pattern pass always runs; libclang refinement
+#      rides the same budget/require knobs as btpu_lint.
+#   3. clang -fsyntax-only -Wthread-safety -Werror sweep over every native
 #      source — the machine check behind the GUARDED_BY/REQUIRES annotations
-#      in btpu/common/thread_annotations.h. Skipped WITH A NOTICE when clang
-#      is not installed (gcc has no equivalent analysis; the annotations
-#      compile to no-ops there).
-#   3. python -m compileall over blackbird_tpu/ and tests/ so syntax rot in
+#      in btpu/common/thread_annotations.h. SKIP with a notice when clang is
+#      not installed (BTPU_REQUIRE_CLANG=1 turns the skip into a failure).
+#   4. python -m compileall over blackbird_tpu/ and tests/ so syntax rot in
 #      the bindings fails the gate even on machines that never import them.
+#   5. mypy --strict over the Python plane (mypy.ini pins the config).
+#      SKIP with a notice when mypy is not installed — never PASS —
+#      and BTPU_REQUIRE_MYPY=1 (CI) turns that skip into a failure.
+#   6. ruff check (pyflakes fallback) over the same files; ruff.toml pins
+#      the rule set. SKIP-never-PASS when neither tool exists;
+#      BTPU_REQUIRE_RUFF=1 (CI) turns the skip into a failure.
+#
+# Every leg runs even after an earlier one fails. The trailing
+# `lint-scoreboard:` lines are machine-readable (check.sh turns them into
+# summary rows); keep their format stable.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 fail=0
+declare -A leg
 
 # ---- project-invariant linter ---------------------------------------------
 PY="${PYTHON:-python3}"
 if command -v "$PY" > /dev/null 2>&1; then
   echo "lint: ${PY} scripts/btpu_lint.py (project invariants)"
-  if ! "$PY" scripts/btpu_lint.py; then
+  if "$PY" scripts/btpu_lint.py; then
+    leg[invariants]=PASS
+  else
     echo "lint: FAIL — project-invariant violations (see above)" >&2
+    leg[invariants]=FAIL
     fail=1
   fi
 else
   echo "lint: FAIL — python3 required for the project-invariant linter" >&2
+  leg[invariants]=FAIL
+  fail=1
+fi
+
+# ---- FFI-boundary drift check ----------------------------------------------
+if command -v "$PY" > /dev/null 2>&1; then
+  echo "lint: ${PY} scripts/capi_check.py (FFI boundary: headers vs golden vs ctypes manifest)"
+  if "$PY" scripts/capi_check.py; then
+    leg[capi-check]=PASS
+  else
+    echo "lint: FAIL — FFI boundary drift (see above; docs/CORRECTNESS.md §11)" >&2
+    leg[capi-check]=FAIL
+    fail=1
+  fi
+else
+  leg[capi-check]=FAIL
   fail=1
 fi
 
@@ -42,14 +76,17 @@ fi
 if [ -z "${CLANG}" ]; then
   if [ "${BTPU_REQUIRE_CLANG:-0}" = "1" ]; then
     echo "lint: FAIL — BTPU_REQUIRE_CLANG=1 but clang not found" >&2
+    leg[tsa-sweep]=FAIL
     fail=1
   else
     echo "lint: NOTICE — clang not found; skipping the -Wthread-safety sweep" >&2
     echo "lint:          (annotations still compile as no-ops under gcc;" >&2
     echo "lint:          install clang to machine-check the lock discipline)" >&2
+    leg[tsa-sweep]="SKIP (no clang — sweep did not run)"
   fi
 else
   echo "lint: ${CLANG} -Wthread-safety sweep over native/"
+  sweep_fail=0
   srcs=$(find native/src native/exe native/tests examples -name '*.cpp' | sort)
   for src in $srcs; do
     # -fsyntax-only: the analysis runs in the frontend; no objects are
@@ -58,22 +95,88 @@ else
          -Wall -Wextra -Wno-unused-parameter \
          -Wthread-safety -Werror=thread-safety "$src"; then
       echo "lint: FAIL ${src}" >&2
+      sweep_fail=1
       fail=1
     fi
   done
-  [ "$fail" -eq 0 ] && echo "lint: thread-safety sweep clean"
+  if [ "$sweep_fail" -eq 0 ]; then
+    echo "lint: thread-safety sweep clean"
+    leg[tsa-sweep]=PASS
+  else
+    leg[tsa-sweep]=FAIL
+  fi
 fi
 
 # ---- python bytecode lint --------------------------------------------------
-PY="${PYTHON:-python3}"
 if command -v "$PY" > /dev/null 2>&1; then
   echo "lint: ${PY} -m compileall blackbird_tpu/ tests/ bench.py"
-  if ! "$PY" -m compileall -q blackbird_tpu tests bench.py; then
+  if "$PY" -m compileall -q blackbird_tpu tests bench.py; then
+    leg[compileall]=PASS
+  else
     echo "lint: FAIL — python sources do not byte-compile" >&2
+    leg[compileall]=FAIL
     fail=1
   fi
 else
   echo "lint: NOTICE — python3 not found; skipping compileall" >&2
+  leg[compileall]="SKIP (no python3)"
 fi
+
+# ---- mypy strict type check ------------------------------------------------
+# The Python plane is strictly typed (mypy.ini pins the mode and the module
+# overrides; blackbird_tpu ships py.typed). Absent mypy, the leg SKIPs with
+# a notice — never PASSes — because an unchecked plane is not a typed plane.
+if command -v "$PY" > /dev/null 2>&1 && "$PY" -m mypy --version > /dev/null 2>&1; then
+  echo "lint: ${PY} -m mypy (strict, mypy.ini)"
+  if "$PY" -m mypy --config-file mypy.ini; then
+    leg[mypy]=PASS
+  else
+    echo "lint: FAIL — mypy strict violations (see above)" >&2
+    leg[mypy]=FAIL
+    fail=1
+  fi
+elif [ "${BTPU_REQUIRE_MYPY:-0}" = "1" ]; then
+  echo "lint: FAIL — BTPU_REQUIRE_MYPY=1 but mypy is not installed" >&2
+  leg[mypy]=FAIL
+  fail=1
+else
+  echo "lint: NOTICE — mypy not found; skipping the strict type check" >&2
+  echo "lint:          (pip install mypy to machine-check the Python plane)" >&2
+  leg[mypy]="SKIP (mypy not installed — plane not type-checked)"
+fi
+
+# ---- ruff (pyflakes fallback) ----------------------------------------------
+PYFILES=(blackbird_tpu tests bench.py scripts/capi_check.py scripts/btpu_lint.py)
+if command -v ruff > /dev/null 2>&1; then
+  echo "lint: ruff check (ruff.toml)"
+  if ruff check "${PYFILES[@]}"; then
+    leg[ruff]=PASS
+  else
+    echo "lint: FAIL — ruff findings (see above)" >&2
+    leg[ruff]=FAIL
+    fail=1
+  fi
+elif command -v "$PY" > /dev/null 2>&1 && "$PY" -c 'import pyflakes' 2> /dev/null; then
+  echo "lint: ${PY} -m pyflakes (ruff fallback)"
+  if "$PY" -m pyflakes "${PYFILES[@]}"; then
+    leg[ruff]="PASS (pyflakes fallback)"
+  else
+    echo "lint: FAIL — pyflakes findings (see above)" >&2
+    leg[ruff]=FAIL
+    fail=1
+  fi
+elif [ "${BTPU_REQUIRE_RUFF:-0}" = "1" ]; then
+  echo "lint: FAIL — BTPU_REQUIRE_RUFF=1 but neither ruff nor pyflakes is installed" >&2
+  leg[ruff]=FAIL
+  fail=1
+else
+  echo "lint: NOTICE — ruff/pyflakes not found; skipping the pyflakes-class sweep" >&2
+  leg[ruff]="SKIP (ruff/pyflakes not installed)"
+fi
+
+# ---- machine-readable scoreboard (parsed by check.sh) -----------------------
+for name in invariants capi-check tsa-sweep compileall mypy ruff; do
+  echo "lint-scoreboard: ${name}=${leg[$name]}"
+done
 
 exit "$fail"

@@ -31,9 +31,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from typing import Any  # noqa: E402
+
 
 @pytest.fixture(scope="session", autouse=True)
-def built_native():
+def built_native() -> Any:
     from blackbird_tpu import native
 
     native.build_native()

@@ -16,8 +16,12 @@ src/worker/worker_service.cpp:399-459 — which has no automated multi-host
 test at all (SURVEY §4).
 """
 
+from __future__ import annotations
+
 import argparse
 import signal
+import subprocess
+from typing import Callable, Sequence
 import sys
 import time
 from pathlib import Path
@@ -42,7 +46,6 @@ def run_pod_drill(workdir: str) -> None:
     processes, cross-host put/get, SIGKILL of host 1, cross-host repair,
     byte verification from this (third) process. Raises on any failure."""
     import os
-    import subprocess
     import urllib.request
 
     from blackbird_tpu.procluster import (_port_open, free_port, spawn_logged,
@@ -64,10 +67,12 @@ def run_pod_drill(workdir: str) -> None:
                         coord_port=coord_port, keystone_port=keystone_port,
                         metrics_port=metrics_port, heartbeat_ttl_sec=10)
 
-    def spawn(args, log_path, env=None):
+    def spawn(args: list[str], log_path: Path,
+              env: dict[str, str] | None = None) -> subprocess.Popen[str]:
         return spawn_logged(args, log_path, cwd=repo_root, env=env)
 
-    def wait(pred, timeout, what, watch=()):
+    def wait(pred: Callable[[], bool], timeout: float, what: str,
+             watch: Sequence[tuple[str, subprocess.Popen[str]]] = ()) -> None:
         deadline = time.time() + timeout
         while time.time() < deadline:
             for name, proc in watch:
@@ -252,7 +257,7 @@ def main() -> int:
         # host 1 instead gets SIGKILLed to exercise crash repair.
         stop = [False]
 
-        def on_term(_sig, _frm):
+        def on_term(_sig: int, _frm: object) -> None:
             stop[0] = True
 
         signal.signal(signal.SIGTERM, on_term)

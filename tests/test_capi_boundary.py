@@ -1,0 +1,99 @@
+"""Runtime complement of the static FFI-boundary check (capi_check.py).
+
+The static gate proves the headers, the golden manifest, and the Python
+manifest agree as TEXT; these tests prove the LIVE library agrees too:
+every ErrorCode mirror value round-trips through btpu_error_name, every
+required symbol actually bound, and the checker itself still convicts
+planted drift (docs/CORRECTNESS.md §11).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+from blackbird_tpu import native
+from blackbird_tpu._capi import OPTIONAL, SIGNATURES, ErrorCode
+
+REPO = Path(__file__).resolve().parent.parent
+CAPI_CHECK = REPO / "scripts" / "capi_check.py"
+
+
+def test_error_names_round_trip() -> None:
+    """Every Python ErrorCode mirror value must resolve to ITS OWN name in
+    the native to_string table: a renumbered or renamed mirror entry makes
+    Python report the wrong error for the rest of time, silently."""
+    for code in ErrorCode:
+        assert native.error_name(int(code)) == code.name, (
+            f"ErrorCode.{code.name} = {int(code)} names "
+            f"{native.error_name(int(code))!r} natively — mirror drift"
+        )
+
+
+def test_unknown_code_does_not_crash() -> None:
+    assert native.error_name(987654) == "UNKNOWN_ERROR"
+
+
+def test_every_required_symbol_bound() -> None:
+    """_load() must have bound every non-OPTIONAL manifest symbol with its
+    manifest types — a silent fallback-to-zero path must not exist."""
+    for name in SIGNATURES:
+        if name in OPTIONAL:
+            # OPTIONAL symbols answer have() honestly either way.
+            assert isinstance(native.have(name), bool)
+            continue
+        assert native.have(name), f"required symbol {name} not bound"
+        fn = getattr(native.lib, name)
+        assert fn.argtypes is not None, f"{name} bound without argtypes"
+
+
+def test_have_rejects_unknown_symbols() -> None:
+    """have() is a manifest query, not a symbol probe: asking about a name
+    outside the manifest is a programming error."""
+    import pytest
+
+    with pytest.raises(KeyError):
+        native.have("btpu_totally_made_up")
+
+
+def test_capi_check_clean_on_tree() -> None:
+    """The static checker agrees with the tree as committed."""
+    proc = subprocess.run(
+        [sys.executable, str(CAPI_CHECK)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"capi_check found drift:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_capi_check_convicts_planted_drift() -> None:
+    """The checker can CONVICT, not just agree: the planted-drift self-test
+    mutates one signature width and one enum value in a temp header copy
+    and must flag both."""
+    proc = subprocess.run(
+        [sys.executable, str(CAPI_CHECK), "--self-test"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"self-test failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert "both planted drifts convicted" in proc.stdout
+
+
+def test_lane_counters_all_ints() -> None:
+    """lane_counters() reads every counter through a typed required binding
+    (no hasattr-silent-zero path); sanity-check the shapes."""
+    from blackbird_tpu.client import Client
+
+    counters = Client.lane_counters()
+    assert counters, "no counters?"
+    for key, value in counters.items():
+        assert isinstance(value, int) and value >= 0, (key, value)
+    # Spot-check that the robustness family is present (these were the
+    # symbols the old code read WITHOUT argtypes/restype — a u64 truncation
+    # hazard).
+    for key in ("deadline_exceeded", "retries", "hedges_fired",
+                "breaker_trips", "persist_retry_backlog"):
+        assert key in counters, key

@@ -9,15 +9,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from blackbird_tpu import EmbeddedCluster
 from blackbird_tpu.checkpoint import load_sharded, remove_checkpoint, save_sharded
 from blackbird_tpu.parallel import make_mesh
+from typing import Any, Generator
 
 
 @pytest.fixture()
-def store():
+def store() -> Generator[Any, None, None]:
     with EmbeddedCluster(workers=4, pool_bytes=64 << 20) as cluster:
         yield cluster.client()
 
 
-def test_save_and_restore_same_sharding(store):
+def test_save_and_restore_same_sharding(store: Any) -> None:
     mesh = make_mesh(8)
     sharding = NamedSharding(mesh, P("workers", None))
     arr = jax.device_put(
@@ -29,7 +30,7 @@ def test_save_and_restore_same_sharding(store):
     np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
 
 
-def test_restore_onto_different_mesh_layout(store):
+def test_restore_onto_different_mesh_layout(store: Any) -> None:
     mesh8 = make_mesh(8)
     arr = jax.device_put(
         np.random.default_rng(5).normal(size=(64, 48)).astype(np.float32),
@@ -49,14 +50,14 @@ def test_restore_onto_different_mesh_layout(store):
     np.testing.assert_array_equal(host, np.asarray(arr))
 
 
-def _shard_keys(store, prefix):
+def _shard_keys(store: Any, prefix: str) -> list[str]:
     import json
 
     meta = json.loads(bytes(store.get(prefix + "/meta")))
     return [s["key"] for s in meta["shards"]]
 
 
-def test_replicated_sharding_stores_one_copy(store):
+def test_replicated_sharding_stores_one_copy(store: Any) -> None:
     mesh = make_mesh(8)
     replicated = NamedSharding(mesh, P())  # same bytes on every device
     arr = jax.device_put(np.arange(1024, dtype=np.int32), replicated)
@@ -68,7 +69,7 @@ def test_replicated_sharding_stores_one_copy(store):
     np.testing.assert_array_equal(np.asarray(back), np.asarray(arr))
 
 
-def test_remove_checkpoint_cleans_all_objects(store):
+def test_remove_checkpoint_cleans_all_objects(store: Any) -> None:
     mesh = make_mesh(8)
     arr = jax.device_put(
         np.zeros((32, 8), dtype=np.float32), NamedSharding(mesh, P("workers", None))
@@ -85,7 +86,7 @@ def test_remove_checkpoint_cleans_all_objects(store):
     assert not store.exists("ckpt/tmp/shard/999-1000")
 
 
-def test_list_checkpoints_discovers_prefixes(store):
+def test_list_checkpoints_discovers_prefixes(store: Any) -> None:
     from blackbird_tpu.checkpoint import list_checkpoints
 
     mesh = make_mesh(8)
@@ -102,7 +103,7 @@ def test_list_checkpoints_discovers_prefixes(store):
     assert latest == "ckpt/step1000"
 
 
-def test_int_dtypes_and_odd_shapes(store):
+def test_int_dtypes_and_odd_shapes(store: Any) -> None:
     mesh = make_mesh(8)
     arr = jax.device_put(
         np.random.default_rng(9).integers(-1000, 1000, size=(17, 13, 5),
@@ -113,7 +114,7 @@ def test_int_dtypes_and_odd_shapes(store):
     np.testing.assert_array_equal(load_sharded(store, "ckpt/odd"), np.asarray(arr))
 
 
-def test_resave_replaces_and_reclaims_stale_shards(store):
+def test_resave_replaces_and_reclaims_stale_shards(store: Any) -> None:
     mesh = make_mesh(8)
     arr8 = jax.device_put(
         np.arange(64 * 8, dtype=np.float32).reshape(64, 8),
@@ -138,13 +139,13 @@ def test_resave_replaces_and_reclaims_stale_shards(store):
     )
 
 
-def test_scalar_and_zero_d_arrays(store):
+def test_scalar_and_zero_d_arrays(store: Any) -> None:
     step = jax.numpy.asarray(12345, dtype=jax.numpy.int32)  # 0-d
     save_sharded(store, "ckpt/step", step)
     assert int(load_sharded(store, "ckpt/step")) == 12345
 
 
-def test_save_overwrites_orphaned_objects(store):
+def test_save_overwrites_orphaned_objects(store: Any) -> None:
     """A crashed previous save can leave shard/meta objects that no readable
     meta lists (or a meta listing shards never written). A fresh save must
     win over both without raising."""
@@ -177,7 +178,7 @@ def test_save_overwrites_orphaned_objects(store):
     np.testing.assert_array_equal(load_sharded(store, "ckpt/orphan"), np.asarray(arr))
 
 
-def test_each_object_has_single_writer(store):
+def test_each_object_has_single_writer(store: Any) -> None:
     """Multi-host safety invariant (single-process proxy): every shard box
     is written by exactly one owner device, so replicated shards never
     double-put. With 8 devices replicating one box, a save must issue
@@ -189,14 +190,14 @@ def test_each_object_has_single_writer(store):
     puts = []
 
     class Counting:
-        def __init__(self, inner):
+        def __init__(self, inner: Any) -> None:
             self._inner = inner
 
-        def put(self, key, data, **kw):
+        def put(self, key: str, data: Any, **kw: Any) -> None:
             puts.append(key)
             return self._inner.put(key, data, **kw)
 
-        def __getattr__(self, name):
+        def __getattr__(self, name: str) -> Any:
             return getattr(self._inner, name)
 
     save_sharded(Counting(store), "ckpt/single", arr)
@@ -204,7 +205,7 @@ def test_each_object_has_single_writer(store):
     assert len(shard_puts) == 1, shard_puts
 
 
-def test_checkpoint_onto_ici_device_mesh():
+def test_checkpoint_onto_ici_device_mesh() -> None:
     """Sharded checkpoint whose bytes live ON the device mesh: save with
     preferred_class=HBM_TPU against an ICI cluster (one JAX device pool per
     chip), then restore under a different sharding. Ties together the
@@ -247,7 +248,7 @@ def test_checkpoint_onto_ici_device_mesh():
         JaxHbmProvider.unregister()
 
 
-def test_erasure_coded_checkpoint_roundtrip(store):
+def test_erasure_coded_checkpoint_roundtrip(store: Any) -> None:
     mesh = make_mesh(8)
     arr = jax.device_put(
         np.arange(8192, dtype=np.float32).reshape(64, 128),

@@ -7,6 +7,7 @@ import time
 from pathlib import Path
 
 import jax
+import pytest
 
 from blackbird_tpu.procluster import free_port
 
@@ -14,7 +15,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 BUILD = REPO_ROOT / "build"
 
 
-def test_init_is_noop_without_coordinator(monkeypatch):
+def test_init_is_noop_without_coordinator(monkeypatch: pytest.MonkeyPatch) -> None:
     import blackbird_tpu.distributed as btd
 
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
@@ -23,7 +24,7 @@ def test_init_is_noop_without_coordinator(monkeypatch):
     assert len(jax.devices()) == 8  # runtime untouched
 
 
-def test_worker_config_matches_local_devices(tmp_path):
+def test_worker_config_matches_local_devices(tmp_path: Path) -> None:
     import blackbird_tpu.distributed as btd
 
     cfg = btd.worker_config_for_this_host(
@@ -43,7 +44,7 @@ def test_worker_config_matches_local_devices(tmp_path):
     assert "listen_host: '0.0.0.0'" not in text
 
 
-def test_derived_worker_serves_device_tier_end_to_end(tmp_path):
+def test_derived_worker_serves_device_tier_end_to_end(tmp_path: Path) -> None:
     """The generated config actually boots: WorkerHost (in this process,
     owning the 8 virtual devices through JaxHbmProvider) registers
     8 hbm pools + 1 dram pool with a real coordinator/keystone pair, and a

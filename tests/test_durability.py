@@ -13,9 +13,10 @@ import os
 
 from blackbird_tpu import Client, EmbeddedCluster
 from blackbird_tpu.native import BtpuError, ErrorCode
+from pathlib import Path
 
 
-def test_acked_objects_survive_cluster_restart(tmp_path):
+def test_acked_objects_survive_cluster_restart(tmp_path: Path) -> None:
     data_dir = str(tmp_path / "persist")
     rng = os.urandom
     acked = {f"dur/obj{i}": rng(64 + 137 * i % 1900) for i in range(24)}
@@ -48,7 +49,7 @@ def test_acked_objects_survive_cluster_restart(tmp_path):
         assert client.get("dur/fresh") == b"post-restart"
 
 
-def test_sync_per_record_mode_round_trips(tmp_path):
+def test_sync_per_record_mode_round_trips(tmp_path: Path) -> None:
     """group_commit_us=0 (fdatasync per record) is the compatibility mode —
     same acked==durable contract, no batching."""
     data_dir = str(tmp_path / "sync-each")
@@ -61,7 +62,7 @@ def test_sync_per_record_mode_round_trips(tmp_path):
         assert revived.client().get("dur/sync") == b"x" * 512
 
 
-def test_lane_counters_export_persist_backlog():
+def test_lane_counters_export_persist_backlog() -> None:
     counters = Client.lane_counters()
     assert "persist_retry_backlog" in counters
     assert counters["persist_retry_backlog"] == 0

@@ -11,17 +11,18 @@ import pytest
 from blackbird_tpu import EmbeddedCluster, StorageClass
 from blackbird_tpu.hbm import JaxHbmProvider
 from conftest import transfer_api_available
+from typing import Any, Generator
 
 
 @pytest.fixture(params=["auto", False], ids=["host-view", "device-path"])
-def jax_provider(request):
+def jax_provider(request: pytest.FixtureRequest) -> Generator[Any, None, None]:
     provider = JaxHbmProvider(page_bytes=64 * 1024,
                               host_view=request.param).register()
     yield provider
     JaxHbmProvider.unregister()
 
 
-def test_hbm_tier_backed_by_jax_buffers(jax_provider):
+def test_hbm_tier_backed_by_jax_buffers(jax_provider: Any) -> None:
     with EmbeddedCluster(workers=2, pool_bytes=4 << 20,
                          storage_class=StorageClass.HBM_TPU) as cluster:
         assert jax_provider.region_count() == 2  # one region per worker pool
@@ -38,7 +39,7 @@ def test_hbm_tier_backed_by_jax_buffers(jax_provider):
     assert jax_provider.region_count() == 0  # regions freed on shutdown
 
 
-def test_hbm_unaligned_edges(jax_provider):
+def test_hbm_unaligned_edges(jax_provider: Any) -> None:
     with EmbeddedCluster(workers=1, pool_bytes=1 << 20,
                          storage_class=StorageClass.HBM_TPU) as cluster:
         client = cluster.client()
@@ -48,7 +49,7 @@ def test_hbm_unaligned_edges(jax_provider):
             assert client.get(f"hbm/sz{size}") == payload
 
 
-def test_hbm_batched_put_get_many(jax_provider):
+def test_hbm_batched_put_get_many(jax_provider: Any) -> None:
     """The batched client path must coalesce the whole batch through the
     provider's scatter/gather entry points (BASELINE.md ladder item 2)."""
     with EmbeddedCluster(workers=2, pool_bytes=32 << 20,
@@ -66,7 +67,7 @@ def test_hbm_batched_put_get_many(jax_provider):
             client.put_many({"hbm/batch0": b"x"})
 
 
-def test_hbm_write_visible_before_flush(jax_provider):
+def test_hbm_write_visible_before_flush(jax_provider: Any) -> None:
     """Reads must observe prior writes even though writes dispatch
     asynchronously (same-stream ordering): put then immediate get."""
     with EmbeddedCluster(workers=1, pool_bytes=8 << 20,
@@ -77,7 +78,7 @@ def test_hbm_write_visible_before_flush(jax_provider):
         assert client.get("hbm/rw") == payload  # no explicit synchronize
 
 
-def test_host_view_mode_engages_on_cpu(monkeypatch):
+def test_host_view_mode_engages_on_cpu(monkeypatch: pytest.MonkeyPatch) -> None:
     """On a host-addressable backend the probe must actually engage the
     memcpy fast path (a silent fall-through to the dispatch path would be
     correct but 6x slower — the exact regression this guards)."""
@@ -104,7 +105,7 @@ def test_host_view_mode_engages_on_cpu(monkeypatch):
         JaxHbmProvider.unregister()
 
 
-def test_hbm_overwrite_neighbor_isolation(jax_provider):
+def test_hbm_overwrite_neighbor_isolation(jax_provider: Any) -> None:
     """Partial-page merges must not disturb neighboring bytes: two objects
     sharing the same region, rewrite one, the other stays intact."""
     with EmbeddedCluster(workers=1, pool_bytes=4 << 20,
@@ -124,7 +125,7 @@ def test_hbm_overwrite_neighbor_isolation(jax_provider):
 @pytest.mark.skipif(not transfer_api_available(),
                     reason="jax.experimental.transfer absent in this jax "
                            "(the library itself degrades via TransferLink)")
-def test_transfer_probe_degrades_gracefully(monkeypatch):
+def test_transfer_probe_degrades_gracefully(monkeypatch: pytest.MonkeyPatch) -> None:
     """A stack whose transfer server STARTS but cannot move bytes (the
     tunneled axon TPU: PJRT_Client_CreateBuffersForAsyncHostToDevice /
     PJRT_Buffer_CopyRawToHost unimplemented) must read as fabric-unavailable
@@ -138,19 +139,19 @@ def test_transfer_probe_degrades_gracefully(monkeypatch):
     from blackbird_tpu.transferlink import TransferLink
 
     class StubConn:
-        def pull(self, tid, specs):
+        def pull(self, tid: Any, specs: Any) -> Any:
             raise RuntimeError(
                 "UNIMPLEMENTED: PJRT_Client_CreateBuffersForAsyncHostToDevice "
                 "is not implemented")
 
     class StubServer:
-        def address(self):
+        def address(self) -> str:
             return "127.0.0.1:1"
 
-        def await_pull(self, tid, arrs):
+        def await_pull(self, tid: Any, arrs: Any) -> None:
             pass
 
-        def connect(self, addr):
+        def connect(self, addr: Any) -> Any:
             return StubConn()
 
     from jax.experimental import transfer
@@ -172,7 +173,7 @@ def test_transfer_probe_degrades_gracefully(monkeypatch):
         fc.put_many({"k": np.zeros(4, np.uint8)})
 
 
-def test_transfer_probe_passes_on_working_stack():
+def test_transfer_probe_passes_on_working_stack() -> None:
     """The CPU runtime's transfer fabric is real: the self-pull probe must
     pass and leave the server usable (offer -> pull roundtrip)."""
     import jax
@@ -189,7 +190,7 @@ def test_transfer_probe_passes_on_working_stack():
     assert np.array_equal(np.asarray(out), payload)
 
 
-def test_pipelined_write_rounds_order_and_contents():
+def test_pipelined_write_rounds_order_and_contents() -> None:
     """The per-device dispatcher pipelines multi-round batches (fill N+1
     under transfer N) — rounds must still land IN ORDER (duplicate-page
     chunks depend on it) and every byte must read back. A small staging cap
@@ -230,7 +231,7 @@ def test_pipelined_write_rounds_order_and_contents():
                   for t in range(4)}
         errs = []
 
-        def writer(t):
+        def writer(t: int) -> None:
             try:
                 b = np.ascontiguousarray(blocks[t])
                 for _ in range(5):

@@ -15,10 +15,11 @@ import pytest
 from blackbird_tpu import EmbeddedCluster, StorageClass
 from blackbird_tpu.hbm import JaxHbmProvider
 from blackbird_tpu.native import TransportKind
+from typing import Any, Callable, Generator
 
 
 @pytest.fixture(params=["auto", False], ids=["host-view", "device-path"])
-def jax_provider(request):
+def jax_provider(request: pytest.FixtureRequest) -> Generator[Any, None, None]:
     # Both region modes: "auto" serves via host views on these CPU devices;
     # False forces the jit/device_put machinery — the path real TPU chips
     # take, including the device-to-device copy span in _copy.
@@ -28,7 +29,7 @@ def jax_provider(request):
     JaxHbmProvider.unregister()
 
 
-def _wait_for(pred, timeout_s=10.0):
+def _wait_for(pred: Callable[[], bool], timeout_s: float = 10.0) -> bool:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if pred():
@@ -37,7 +38,7 @@ def _wait_for(pred, timeout_s=10.0):
     return pred()
 
 
-def test_ici_mesh_one_region_per_device_put_get(jax_provider):
+def test_ici_mesh_one_region_per_device_put_get(jax_provider: Any) -> None:
     with EmbeddedCluster(workers=8, pool_bytes=4 << 20,
                          storage_class=StorageClass.HBM_TPU,
                          transport=TransportKind.ICI) as cluster:
@@ -52,7 +53,7 @@ def test_ici_mesh_one_region_per_device_put_get(jax_provider):
         assert client.get("ici/wide") == payload
 
 
-def test_ici_repair_streams_chip_to_chip(jax_provider):
+def test_ici_repair_streams_chip_to_chip(jax_provider: Any) -> None:
     with EmbeddedCluster(workers=4, pool_bytes=8 << 20,
                          storage_class=StorageClass.HBM_TPU,
                          transport=TransportKind.ICI) as cluster:
@@ -69,7 +70,7 @@ def test_ici_repair_streams_chip_to_chip(jax_provider):
         assert client.get("ici/rep") == payload
 
 
-def test_ici_batched_many_objects_roundtrip(jax_provider):
+def test_ici_batched_many_objects_roundtrip(jax_provider: Any) -> None:
     with EmbeddedCluster(workers=8, pool_bytes=8 << 20,
                          storage_class=StorageClass.HBM_TPU,
                          transport=TransportKind.ICI) as cluster:

@@ -14,7 +14,8 @@ the reference.
 """
 
 import jaxdist_host
+from pathlib import Path
 
 
-def test_two_process_jax_distributed_pod(tmp_path):
+def test_two_process_jax_distributed_pod(tmp_path: Path) -> None:
     jaxdist_host.run_pod_drill(str(tmp_path))

@@ -21,9 +21,11 @@ BUILD = REPO_ROOT / "build"
 
 from blackbird_tpu.procluster import free_port  # shared with the launcher
 from conftest import transfer_api_available
+from typing import Any, Callable
 
 
-def wait_for(predicate, timeout=10.0, what="condition"):
+def wait_for(predicate: Callable[[], bool], timeout: float = 10.0,
+             what: str = "condition") -> None:
     deadline = time.time() + timeout
     while time.time() < deadline:
         if predicate():
@@ -58,10 +60,10 @@ pools:
     return path
 
 
-def make_spawner(procs):
+def make_spawner(procs: list[tuple[str, subprocess.Popen[str]]]) -> Any:
     """Returns spawn(args, name) appending to `procs` for teardown()."""
 
-    def spawn(args, name):
+    def spawn(args: list[str], name: str) -> subprocess.Popen[str]:
         proc = subprocess.Popen(
             args, cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
@@ -72,7 +74,8 @@ def make_spawner(procs):
     return spawn
 
 
-def teardown(procs, timeout=10):
+def teardown(procs: list[tuple[str, subprocess.Popen[str]]],
+             timeout: float = 10) -> None:
     for name, proc in reversed(procs):
         if proc.poll() is None:
             proc.send_signal(signal.SIGTERM)
@@ -84,7 +87,7 @@ def teardown(procs, timeout=10):
 
 
 @pytest.fixture()
-def cluster(tmp_path):
+def cluster(tmp_path: Path) -> Any:
     coord_port = free_port()
     keystone_port = free_port()
     metrics_port = free_port()
@@ -124,7 +127,7 @@ worker_heartbeat_ttl_sec: 2
         teardown(procs, timeout=5)
 
 
-def test_multiprocess_put_get_failover(cluster):
+def test_multiprocess_put_get_failover(cluster: Any) -> None:
     from blackbird_tpu import Client
 
     client = Client(f"127.0.0.1:{cluster['keystone_port']}")
@@ -152,7 +155,7 @@ def test_multiprocess_put_get_failover(cluster):
     assert "btpu_objects 1" in body
 
 
-def test_multiprocess_ha_keystone_failover(tmp_path):
+def test_multiprocess_ha_keystone_failover(tmp_path: Path) -> None:
     """Active/standby keystone pair over a real bb-coord: the Python client
     holds both endpoints, the leader is SIGKILLed, and puts/gets keep
     working against the promoted standby (which mirrored the records)."""
@@ -237,7 +240,7 @@ pools:
         teardown(procs, timeout=5)
 
 
-def test_multiprocess_coordinator_crash_restart(tmp_path):
+def test_multiprocess_coordinator_crash_restart(tmp_path: Path) -> None:
     """kill -9 the coordinator mid-cluster, restart it on the same port and
     data dir: durable state (workers, pools, keystone's object records)
     recovers from the WAL, every process transparently reconnects, and
@@ -264,7 +267,7 @@ health_check_interval_sec: 1
 worker_heartbeat_ttl_sec: 5
 """)
 
-    def coord_args():
+    def coord_args() -> list[str]:
         return [str(BUILD / "bb-coord"), "--host", "127.0.0.1", "--port",
                 str(coord_port), "--data-dir", str(coord_dir)]
 
@@ -315,7 +318,7 @@ worker_heartbeat_ttl_sec: 5
         teardown(procs, timeout=5)
 
 
-def test_multiprocess_leader_kill_during_inflight_puts(tmp_path):
+def test_multiprocess_leader_kill_during_inflight_puts(tmp_path: Path) -> None:
     """SIGKILL the keystone leader while a writer thread streams puts.
     Exactly-once safety across process death: every put that REPORTED
     success must be readable with intact bytes from the promoted standby;
@@ -370,7 +373,7 @@ service_refresh_interval_sec: 1
         stop_at = 60
         started = threading.Event()
 
-        def writer():
+        def writer() -> None:
             for i in range(stop_at):
                 try:
                     client.put(f"if/obj{i}", payload_for(i))
@@ -398,7 +401,7 @@ service_refresh_interval_sec: 1
         teardown(procs, timeout=5)
 
 
-def test_multiprocess_python_worker_serves_jax_hbm_tier(tmp_path):
+def test_multiprocess_python_worker_serves_jax_hbm_tier(tmp_path: Path) -> None:
     """The production TPU-VM worker shape: a separate Python worker process
     owns the (virtual) device via JaxHbmProvider and serves an HBM_TPU pool
     through the native worker's TCP callback path. A client in THIS process
@@ -457,7 +460,7 @@ pools:
         client = Client(f"127.0.0.1:{keystone_port}")
         # JAX import + jit warmup in the worker can take minutes on a loaded
         # single-CPU box; poll generously but bail fast if it died.
-        def pools_up():
+        def pools_up() -> bool:
             assert worker.poll() is None, "python worker exited early"
             return client.stats()["pools"] == 2
 
@@ -490,7 +493,7 @@ pools:
 @pytest.mark.skipif(not transfer_api_available(),
                     reason="jax.experimental.transfer absent in this jax — "
                            "no fabric substrate to ride")
-def test_fabric_client_moves_device_bytes_itself(tmp_path):
+def test_fabric_client_moves_device_bytes_itself(tmp_path: Path) -> None:
     """VERDICT r4 item 1 (the reference's defining property, TPU-shaped):
     a client that OWNS a JAX runtime moves device-tier bytes ITSELF over
     the transfer fabric — put offers shard ranges from this process's
@@ -548,7 +551,7 @@ pools:
 
         client = Client(f"127.0.0.1:{keystone_port}")
 
-        def pools_up():
+        def pools_up() -> bool:
             assert worker.poll() is None, "python worker exited early"
             return client.stats()["pools"] == 2
 
@@ -624,7 +627,7 @@ pools:
         teardown(procs)
 
 
-def test_multiprocess_coordinator_standby_failover(tmp_path):
+def test_multiprocess_coordinator_standby_failover(tmp_path: Path) -> None:
     """Primary + standby bb-coord pair: the standby mirrors state over the
     replication stream; when the primary is SIGKILLed, the standby promotes
     within its takeover grace and every process (keystone, workers, clients)
@@ -680,7 +683,7 @@ worker_heartbeat_ttl_sec: 2
         # The cluster keeps working through the promoted standby: worker
         # registrations survive (mirrored state + resumed heartbeats), and
         # new puts land durable object records on the new primary.
-        def cluster_usable():
+        def cluster_usable() -> bool:
             try:
                 key = f"ha/after-{time.monotonic_ns()}"
                 client.put(key, b"post-failover", max_workers=1)
@@ -707,7 +710,7 @@ worker_heartbeat_ttl_sec: 2
         teardown(procs)
 
 
-def test_multiprocess_full_control_plane_failover(tmp_path):
+def test_multiprocess_full_control_plane_failover(tmp_path: Path) -> None:
     """The maximal availability scenario: BOTH control services lose their
     primary at once. Coordinator primary + standby, keystone leader +
     standby (elected through the coordinator), two workers. SIGKILL the
@@ -775,7 +778,7 @@ service_refresh_interval_sec: 1
         coord_primary.kill()
         ks_leader.kill()
 
-        def recovered():
+        def recovered() -> bool:
             try:
                 key = f"full/after-{time.monotonic_ns()}"
                 client.put(key, b"alive", max_workers=1)
@@ -791,7 +794,7 @@ service_refresh_interval_sec: 1
         teardown(procs)
 
 
-def test_multiprocess_python_worker_drains_itself_on_sigterm(tmp_path):
+def test_multiprocess_python_worker_drains_itself_on_sigterm(tmp_path: Path) -> None:
     """The complete preemption story: the Python worker host receives
     SIGTERM (the TPU preemption notice), asks the keystone to drain it —
     its replicas=1 shards migrate to the surviving worker while the process
@@ -852,7 +855,7 @@ worker_heartbeat_ttl_sec: 2
         teardown(procs)
 
 
-def test_multiprocess_erasure_coded_survives_worker_kill(tmp_path):
+def test_multiprocess_erasure_coded_survives_worker_kill(tmp_path: Path) -> None:
     """Erasure coding over REAL worker processes: rs(2,1) across 3 workers,
     SIGKILL one, reads reconstruct through parity, and the repairer heals
     the lost shard onto the survivors (visible in /metrics)."""
@@ -899,7 +902,7 @@ worker_heartbeat_ttl_sec: 2
         wait_for(lambda: client.stats()["workers"] == 2, timeout=15, what="death detection")
         assert client.get("mp/ec") == payload  # degraded or healed: identical bytes
 
-        def healed():
+        def healed() -> bool:
             try:
                 body = urllib.request.urlopen(
                     f"http://127.0.0.1:{metrics_port}/metrics", timeout=5).read().decode()
@@ -920,7 +923,7 @@ worker_heartbeat_ttl_sec: 2
         teardown(procs, timeout=5)
 
 
-def test_multicontroller_device_plane(tmp_path):
+def test_multicontroller_device_plane(tmp_path: Path) -> None:
     """VERDICT r2 item 1 — the multi-controller device plane: two worker
     PROCESSES, each owning a disjoint 4-device (virtual) mesh slice with one
     HBM pool per device, registered with ONE keystone. A put stripes each
@@ -978,7 +981,7 @@ def test_multicontroller_device_plane(tmp_path):
         assert client.get("mc/obj") == payload
 
 
-def test_churn_worker_killed_and_replaced_under_write_load(tmp_path):
+def test_churn_worker_killed_and_replaced_under_write_load(tmp_path: Path) -> None:
     """Data-plane churn: a writer streams replicated puts while a worker
     process is SIGKILLed mid-stream and a REPLACEMENT worker (fresh id)
     joins. Every put that REPORTED success must read back byte-correct at
@@ -1022,7 +1025,7 @@ worker_heartbeat_ttl_sec: 2
         victim_killed = threading.Event()
         total = 100
 
-        def writer():
+        def writer() -> None:
             for i in range(total):
                 try:
                     client.put(f"ch/{i}", payload_for(i), replicas=2, max_workers=1)
@@ -1059,7 +1062,7 @@ worker_heartbeat_ttl_sec: 2
         teardown(procs, timeout=5)
 
 
-def test_drain_evacuates_device_tier_across_processes(tmp_path):
+def test_drain_evacuates_device_tier_across_processes(tmp_path: Path) -> None:
     """TPU preemption on the device tier: drain a LIVE device-owning worker
     process and every shard it holds — replicas=1 included — streams off
     its device memory onto the other process's devices before it retires.
@@ -1089,7 +1092,7 @@ def test_drain_evacuates_device_tier_across_processes(tmp_path):
 
 
 @pytest.mark.parametrize("disk_class", ["nvme", "hdd"])
-def test_worker_restart_readopts_disk_objects(tmp_path, disk_class):
+def test_worker_restart_readopts_disk_objects(tmp_path: Path, disk_class: str) -> None:
     """VERDICT r3 item 4, the real-process version: SIGKILL a worker whose
     only pool is FILE-BACKED while it holds a replicas=1 object; the
     keystone keeps the object OFFLINE instead of declaring it lost, and a
@@ -1117,7 +1120,7 @@ def test_worker_restart_readopts_disk_objects(tmp_path, disk_class):
         pools=[{"id": "disk-0-pool", "storage_class": disk_class,
                 "capacity": "16MB", "path": str(tmp_path / "backing.dat")}])
 
-    def metric(name):
+    def metric(name: str) -> int:
         text = urllib.request.urlopen(
             f"http://127.0.0.1:{metrics_port}/metrics", timeout=5).read().decode()
         for line in text.splitlines():
@@ -1125,7 +1128,7 @@ def test_worker_restart_readopts_disk_objects(tmp_path, disk_class):
                 return int(line.split()[-1])
         return 0
 
-    def start_worker():
+    def start_worker() -> subprocess.Popen[str]:
         return spawn_logged(
             [str(BUILD / "bb-worker"), "--config", str(cfg)],
             tmp_path / "worker.log")
@@ -1183,7 +1186,7 @@ def test_worker_restart_readopts_disk_objects(tmp_path, disk_class):
                            "no fabric substrate to ride")
 @pytest.mark.parametrize("worker_env", [{}, {"BTPU_HBM_HOST_VIEW": "0"}],
                          ids=["host-view", "device-path"])
-def test_cross_process_device_moves_ride_the_fabric(tmp_path, worker_env):
+def test_cross_process_device_moves_ride_the_fabric(tmp_path: Path, worker_env: dict[str, str]) -> None:
     """VERDICT r3 item 8: when both ends of a keystone-driven move are
     device pools in DIFFERENT worker processes, the bytes ride the device
     fabric (jax.experimental.transfer — the chip fabric on TPU) instead of
@@ -1216,7 +1219,7 @@ def test_cross_process_device_moves_ride_the_fabric(tmp_path, worker_env):
         assert fabric_moves >= 1, "drain moved device bytes over the host lane"
 
 
-def test_erasure_coding_over_cross_process_device_tier(tmp_path):
+def test_erasure_coding_over_cross_process_device_tier(tmp_path: Path) -> None:
     """Coded objects on DEVICE memory across worker processes: in-process
     device pools are wire-unreachable (coded shards need a client data
     path), but a standalone worker's HBM pool is served over the staged TCP
@@ -1251,7 +1254,7 @@ def test_erasure_coding_over_cross_process_device_tier(tmp_path):
         assert client.get("xec/obj") == payload
 
 
-def test_multislice_placement_prefers_the_requested_slice(tmp_path):
+def test_multislice_placement_prefers_the_requested_slice(tmp_path: Path) -> None:
     """Acceptance ladder item 5, multi-slice flavor: two worker PROCESSES on
     DIFFERENT TPU slices under one keystone. preferred_slice ranks the
     same-slice process's pools first (the ICI side), and placement spills to
@@ -1283,7 +1286,7 @@ def test_multislice_placement_prefers_the_requested_slice(tmp_path):
         assert workers_used == {"mc-0", "mc-1"}, workers_used
 
 
-def test_multiprocess_fencing_sigstopped_leader_cannot_commit(tmp_path):
+def test_multiprocess_fencing_sigstopped_leader_cannot_commit(tmp_path: Path) -> None:
     """Split-brain fencing (VERDICT r2 item 7): SIGSTOP the leader keystone,
     let its election lease lapse so the standby promotes with a newer
     fencing epoch, then SIGCONT the old leader and fire mutations at it
@@ -1343,7 +1346,7 @@ service_refresh_interval_sec: 1
         # promotes the standby with a freshly minted epoch.
         ks_procs[0].send_signal(signal.SIGSTOP)
 
-        def standby_leads():
+        def standby_leads() -> bool:
             try:
                 standby.put("fence/during", payload)
                 return True
@@ -1383,7 +1386,7 @@ service_refresh_interval_sec: 1
         teardown(procs, timeout=5)
 
 
-def test_pvm_lane_serves_cross_process_reads_one_sided(tmp_path):
+def test_pvm_lane_serves_cross_process_reads_one_sided(tmp_path: Path) -> None:
     """Same-host one-sided lane (the reference's ucp_get_nbx principle,
     blackbird_client.cpp:276-343): a separate worker process advertises its
     pool region for process_vm_readv/writev, and THIS process's client
@@ -1429,7 +1432,7 @@ def test_pvm_lane_serves_cross_process_reads_one_sided(tmp_path):
         assert "staged ok" in r.stdout
 
 
-def test_pvm_lane_striped_across_two_worker_processes(tmp_path):
+def test_pvm_lane_striped_across_two_worker_processes(tmp_path: Path) -> None:
     """A striped object (max_workers=2) whose shards live in TWO separate
     worker processes: the client one-sided-reads each shard from its owning
     process over the PVM lane, and the reassembled object is byte-correct
@@ -1456,7 +1459,7 @@ def test_pvm_lane_striped_across_two_worker_processes(tmp_path):
         assert lib.btpu_pvm_op_count() >= before + 2, "shards did not ride PVM"
 
 
-def test_pvm_soak_concurrent_clients_survive_worker_churn(tmp_path):
+def test_pvm_soak_concurrent_clients_survive_worker_churn(tmp_path: Path) -> None:
     """Process-level chaos for the one-sided lane (bb-soak covers the
     in-process/self-registry shape; this covers the process_vm_readv
     cross-process shape, whose failure modes — dead pids, partial copies —

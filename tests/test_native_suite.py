@@ -7,11 +7,12 @@ wrapper keeps `python -m pytest tests/` the single green/red signal.
 
 import subprocess
 from pathlib import Path
+from typing import Any
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def test_native_suite_passes(built_native):
+def test_native_suite_passes(built_native: Any) -> None:
     binary = REPO_ROOT / "build" / "btpu_tests"
     assert binary.exists(), "btpu_tests missing — native build failed?"
     result = subprocess.run(

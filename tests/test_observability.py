@@ -1,10 +1,15 @@
 """Observability surface through the Python bindings: real latency
 histograms, trace spans, and the flight recorder (ISSUE 10)."""
 
+from __future__ import annotations
+
+from typing import Any
+
 from blackbird_tpu import Client, EmbeddedCluster
 
 
-def _series(histograms, family, label_value=None):
+def _series(histograms: list[dict[str, Any]], family: str,
+            label_value: str | None = None) -> list[dict[str, Any]]:
     return [
         h for h in histograms
         if h["family"] == family and
@@ -12,7 +17,7 @@ def _series(histograms, family, label_value=None):
     ]
 
 
-def test_histograms_and_lane_counter_summaries():
+def test_histograms_and_lane_counter_summaries() -> None:
     with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
         client = cluster.client()
         payload = b"x" * 65536
@@ -38,7 +43,7 @@ def test_histograms_and_lane_counter_summaries():
         assert lanes["trace_spans"] > 0
 
 
-def test_trace_spans_stitch_by_trace_id():
+def test_trace_spans_stitch_by_trace_id() -> None:
     with EmbeddedCluster(workers=1, pool_bytes=8 << 20) as cluster:
         client = cluster.client()
         client.put("obs/traced", b"y" * 4096)
@@ -56,7 +61,7 @@ def test_trace_spans_stitch_by_trace_id():
             assert s["dur_us"] >= 0 and s["start_us"] > 0 and s["pid"] > 0
 
 
-def test_flight_events_flow_and_tracing_switch():
+def test_flight_events_flow_and_tracing_switch() -> None:
     with EmbeddedCluster(workers=1, pool_bytes=8 << 20) as cluster:
         client = cluster.client()
         client.put("obs/flight", b"z" * 1024)

@@ -7,7 +7,7 @@ from blackbird_tpu import Client, EmbeddedCluster, StorageClass, TransportKind
 from blackbird_tpu.native import BtpuError, ErrorCode
 
 
-def test_put_get_bytes_roundtrip():
+def test_put_get_bytes_roundtrip() -> None:
     with EmbeddedCluster(workers=4, pool_bytes=16 << 20) as cluster:
         client = cluster.client()
         payload = bytes(bytearray(range(256)) * 1024)  # 256 KiB
@@ -18,7 +18,7 @@ def test_put_get_bytes_roundtrip():
         assert not client.exists("py/obj")
 
 
-def test_put_get_numpy_roundtrip():
+def test_put_get_numpy_roundtrip() -> None:
     with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
         client = cluster.client()
         array = np.arange(65536, dtype=np.float32).reshape(256, 256)
@@ -32,7 +32,7 @@ def test_put_get_numpy_roundtrip():
         np.testing.assert_array_equal(array, out)
 
 
-def test_missing_object_raises_object_not_found():
+def test_missing_object_raises_object_not_found() -> None:
     with EmbeddedCluster(workers=1, pool_bytes=1 << 20) as cluster:
         client = cluster.client()
         with pytest.raises(BtpuError) as excinfo:
@@ -43,7 +43,7 @@ def test_missing_object_raises_object_not_found():
             client.put("dup", b"x")
 
 
-def test_replication_and_worker_death_repair():
+def test_replication_and_worker_death_repair() -> None:
     with EmbeddedCluster(workers=3, pool_bytes=16 << 20) as cluster:
         client = cluster.client()
         payload = np.random.default_rng(7).bytes(128 * 1024)
@@ -56,7 +56,7 @@ def test_replication_and_worker_death_repair():
         assert client.get("py/precious") == payload
 
 
-def test_stats_and_cluster_shapes():
+def test_stats_and_cluster_shapes() -> None:
     with EmbeddedCluster(workers=2, pool_bytes=8 << 20) as cluster:
         client = cluster.client()
         stats = client.stats()
@@ -74,7 +74,7 @@ def test_stats_and_cluster_shapes():
         assert client.stats()["used"] >= 65536
 
 
-def test_shm_transport_cluster():
+def test_shm_transport_cluster() -> None:
     with EmbeddedCluster(workers=2, pool_bytes=8 << 20,
                          transport=TransportKind.SHM) as cluster:
         client = cluster.client()
@@ -83,7 +83,7 @@ def test_shm_transport_cluster():
         assert client.get("py/shm") == payload
 
 
-def test_tiered_cluster_hbm_preference():
+def test_tiered_cluster_hbm_preference() -> None:
     with EmbeddedCluster(workers=1, pool_bytes=16 << 20,
                          tiered_device_bytes=1 << 20) as cluster:
         client = cluster.client()
@@ -96,7 +96,7 @@ def test_tiered_cluster_hbm_preference():
         assert client.get("py/cold") == big
 
 
-def test_tiered_cluster_demotes_under_pressure():
+def test_tiered_cluster_demotes_under_pressure() -> None:
     """Watermark pressure on the device tier moves objects down to DRAM
     (objects_demoted counter) instead of deleting them; bytes stay intact."""
     import time
@@ -126,7 +126,7 @@ def test_tiered_cluster_demotes_under_pressure():
             assert client.get(key) == expected
 
 
-def test_placements_introspection():
+def test_placements_introspection() -> None:
     from blackbird_tpu import EmbeddedCluster
 
     with EmbeddedCluster(workers=4, pool_bytes=16 << 20) as cluster:
@@ -145,7 +145,7 @@ def test_placements_introspection():
         assert len(workers) == 4  # copies spread over disjoint workers
 
 
-def test_list_objects_by_prefix():
+def test_list_objects_by_prefix() -> None:
     with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
         client = cluster.client()
         client.put("ls/a", b"x" * 1024)
@@ -165,7 +165,7 @@ def test_list_objects_by_prefix():
         assert client.list("nope/") == []
 
 
-def test_erasure_coded_put_get():
+def test_erasure_coded_put_get() -> None:
     with EmbeddedCluster(workers=6, pool_bytes=16 << 20) as cluster:
         client = cluster.client()
         payload = bytes(bytearray(range(256)) * 2048)  # 512 KiB
@@ -188,7 +188,7 @@ def test_erasure_coded_put_get():
             client.put("ec/bad", b"x", ec=(0, 2))
 
 
-def test_object_ttl_and_soft_pin():
+def test_object_ttl_and_soft_pin() -> None:
     import time
 
     from blackbird_tpu import EmbeddedCluster
@@ -208,7 +208,7 @@ def test_object_ttl_and_soft_pin():
         assert client.get("ttl/pinned") == b"pinned"
 
 
-def test_object_cache_hot_reads_and_coherence():
+def test_object_cache_hot_reads_and_coherence() -> None:
     """Lease-coherent client object cache: repeated hot gets are served from
     local memory (hits counted, cached lane bytes counted), and an
     overwrite/remove by ANOTHER client is never served stale."""
@@ -253,7 +253,7 @@ def test_object_cache_hot_reads_and_coherence():
         assert reader.cache_stats()["hits"] >= before + 4
 
 
-def test_drain_worker_preserves_rf1_objects():
+def test_drain_worker_preserves_rf1_objects() -> None:
     """Graceful evacuation vs crash: a replicas=1 object on the drained
     worker survives (streamed off the live source) where kill_worker would
     have lost it."""

@@ -8,15 +8,16 @@ import pytest
 from blackbird_tpu.ops import checksum_u32
 from blackbird_tpu.ops.checksum import checksum_bytes
 from blackbird_tpu.parallel import ShardedPool, make_mesh
+from typing import Any, Generator
 
 
 @pytest.fixture(scope="module")
-def mesh():
+def mesh() -> Any:
     assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
     return make_mesh(8)
 
 
-def test_striped_put_get_roundtrip(mesh):
+def test_striped_put_get_roundtrip(mesh: Any) -> None:
     pool = ShardedPool(mesh, pool_elems_per_worker=4096)
     rng = np.random.default_rng(0)
     obj = rng.integers(0, 2**32, size=10_000, dtype=np.uint32)
@@ -31,7 +32,7 @@ def test_striped_put_get_roundtrip(mesh):
     np.testing.assert_array_equal(pool.get("obj", n_elems=obj.size), obj)
 
 
-def test_checksum_agreement_via_psum(mesh):
+def test_checksum_agreement_via_psum(mesh: Any) -> None:
     pool = ShardedPool(mesh, pool_elems_per_worker=2048)
     obj = np.arange(8_000, dtype=np.uint32)
     pool.put("sum", obj)
@@ -39,7 +40,7 @@ def test_checksum_agreement_via_psum(mesh):
     assert pool.checksum("sum") == expected
 
 
-def test_ring_replication_recovers_any_single_loss(mesh):
+def test_ring_replication_recovers_any_single_loss(mesh: Any) -> None:
     pool = ShardedPool(mesh, pool_elems_per_worker=2048)
     obj = np.arange(4_096, dtype=np.uint32)
     pool.put("r", obj)
@@ -56,7 +57,7 @@ def test_ring_replication_recovers_any_single_loss(mesh):
     np.testing.assert_array_equal(np.roll(orig_shards, -1, axis=0), rot_shards)
 
 
-def test_pool_capacity_enforced(mesh):
+def test_pool_capacity_enforced(mesh: Any) -> None:
     pool = ShardedPool(mesh, pool_elems_per_worker=128)
     pool.put("a", np.zeros(8 * 128, dtype=np.uint32))
     with pytest.raises(MemoryError):
@@ -65,7 +66,7 @@ def test_pool_capacity_enforced(mesh):
         pool.put("a", np.zeros(8, dtype=np.uint32))
 
 
-def test_checksum_kernel_matches_host():
+def test_checksum_kernel_matches_host() -> None:
     data = np.random.default_rng(5).integers(0, 2**32, size=5_000, dtype=np.uint32)
     host = int(np.sum(data, dtype=np.uint64) % (1 << 32))
     assert int(checksum_u32(jax.numpy.asarray(data))) == host
@@ -75,7 +76,7 @@ def test_checksum_kernel_matches_host():
     assert checksum_bytes(data.tobytes()) == host
 
 
-def test_sharded_put_get_jit_compiles_once(mesh):
+def test_sharded_put_get_jit_compiles_once(mesh: Any) -> None:
     # Same shapes -> no retrace (guards against accidental dynamic shapes).
     pool = ShardedPool(mesh, pool_elems_per_worker=1024)
     obj = np.ones(1024, dtype=np.uint32)
@@ -91,7 +92,7 @@ def test_sharded_put_get_jit_compiles_once(mesh):
 
 
 @pytest.fixture()
-def ici_cluster():
+def ici_cluster() -> Generator[Any, None, None]:
     from blackbird_tpu import EmbeddedCluster, StorageClass
     from blackbird_tpu.hbm import JaxHbmProvider
     from blackbird_tpu.native import TransportKind
@@ -106,7 +107,7 @@ def ici_cluster():
         JaxHbmProvider.unregister()
 
 
-def test_keystone_mode_shares_namespace_with_native_client(mesh, ici_cluster):
+def test_keystone_mode_shares_namespace_with_native_client(mesh: Any, ici_cluster: Any) -> None:
     cluster, _provider = ici_cluster
     pool = ShardedPool(mesh, pool_elems_per_worker=1 << 20, cluster=cluster)
     obj = np.random.default_rng(1).integers(0, 2**32, size=200_000, dtype=np.uint32)
@@ -131,7 +132,7 @@ def test_keystone_mode_shares_namespace_with_native_client(mesh, ici_cluster):
     assert not native_client.exists("shared/obj")
 
 
-def test_keystone_mode_replicated_object_survives_worker_death(mesh, ici_cluster):
+def test_keystone_mode_replicated_object_survives_worker_death(mesh: Any, ici_cluster: Any) -> None:
     import time
 
     cluster, provider = ici_cluster
@@ -151,7 +152,7 @@ def test_keystone_mode_replicated_object_survives_worker_death(mesh, ici_cluster
     assert pool.checksum("ha/obj") == expected
 
 
-def test_keystone_mode_rejects_mismatched_mesh(ici_cluster):
+def test_keystone_mode_rejects_mismatched_mesh(ici_cluster: Any) -> None:
     cluster, _provider = ici_cluster
     with pytest.raises(ValueError, match="one device pool per row"):
         ShardedPool(make_mesh(4), pool_elems_per_worker=1024, cluster=cluster)

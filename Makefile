@@ -59,6 +59,12 @@ TSAN_FILTERS ?=
 # deliberately does NOT define this — bench.py's cached-get guard proves the
 # release hot path carries zero sched cost because the hooks don't exist.
 SCHED_FLAGS := -DBTPU_SCHED=1
+# Pool sanitizer (btpu/common/poolsan.h): shadow extent state, generation
+# checks, red zones, quarantine — armed by default in every sanitizer tree
+# (env dial BTPU_POOLSAN=0|1), compiled OUT of the release build so the
+# hot-path resolve is a pure bounds proof (bench.py "poolsan overhead"
+# guard row proves the release cost).
+POOLSAN_FLAGS := -DBTPU_POOLSAN=1
 # AddressSanitizer + UndefinedBehaviorSanitizer; LeakSanitizer rides along
 # with ASan on Linux. -fno-sanitize-recover turns every UB finding into a
 # hard failure instead of a log line.
@@ -88,9 +94,9 @@ endef
 comma := ,
 ASAN_FLAGS := -fsanitize=address$(comma)undefined -fno-sanitize-recover=all
 tsan:
-	$(call sanitizer_run,tsan,$(TSAN_BUILD),-fsanitize=thread $(SCHED_FLAGS),$(TSAN_FILTERS))
+	$(call sanitizer_run,tsan,$(TSAN_BUILD),-fsanitize=thread $(SCHED_FLAGS) $(POOLSAN_FLAGS),$(TSAN_FILTERS))
 asan:
-	$(call sanitizer_run,asan,$(ASAN_BUILD),$(ASAN_FLAGS) $(SCHED_FLAGS),$(ASAN_FILTERS))
+	$(call sanitizer_run,asan,$(ASAN_BUILD),$(ASAN_FLAGS) $(SCHED_FLAGS) $(POOLSAN_FLAGS),$(ASAN_FILTERS))
 
 # ---- schedule-exploration campaign (docs/CORRECTNESS.md §10) ---------------
 # Builds the asan tree (which carries the sched hooks) and runs the full
@@ -103,7 +109,7 @@ asan:
 sched:
 	$(MAKE) BUILD=$(ASAN_BUILD) \
 	  CXXFLAGS="-std=c++20 -O1 -g -fPIC $(WARNFLAGS) \
-	            -Inative/include -pthread $(ASAN_FLAGS) $(SCHED_FLAGS)" \
+	            -Inative/include -pthread $(ASAN_FLAGS) $(SCHED_FLAGS) $(POOLSAN_FLAGS)" \
 	  LDFLAGS="-pthread -lrt $(ASAN_FLAGS)" \
 	  $(ASAN_BUILD)/libbtpu.so $(ASAN_BUILD)/btpu_tests
 	env BTPU_SCHED_SEEDS="$${BTPU_SCHED_SEEDS:-200}" $(ASAN_BUILD)/btpu_tests --filter=Sched
@@ -122,7 +128,7 @@ fuzz:
 fuzz-replay:
 	$(MAKE) BUILD=$(ASAN_BUILD) \
 	  CXXFLAGS="-std=c++20 -O1 -g -fPIC $(WARNFLAGS) \
-	            -Inative/include -pthread $(ASAN_FLAGS) $(SCHED_FLAGS)" \
+	            -Inative/include -pthread $(ASAN_FLAGS) $(SCHED_FLAGS) $(POOLSAN_FLAGS)" \
 	  LDFLAGS="-pthread -lrt $(ASAN_FLAGS)" \
 	  $(ASAN_BUILD)/btpu_fuzz_replay
 

@@ -112,6 +112,13 @@ SIGNATURES: Final[dict[str, tuple[str, tuple[str, ...]]]] = {
     "btpu_breaker_trip_count": _COUNTER,
     "btpu_breaker_skip_count": _COUNTER,
     "btpu_persist_retry_backlog": _COUNTER,
+    # -- pool sanitizer ------------------------------------------------------
+    "btpu_poolsan_armed": _COUNTER,
+    "btpu_poolsan_conviction_count": _COUNTER,
+    "btpu_poolsan_stale_extent_count": _COUNTER,
+    "btpu_poolsan_redzone_smash_count": _COUNTER,
+    "btpu_poolsan_double_free_count": _COUNTER,
+    "btpu_poolsan_quarantine_bytes": _COUNTER,
     # -- observability -------------------------------------------------------
     "btpu_op_get_count": _COUNTER,
     "btpu_op_get_p50_us": _COUNTER,
@@ -205,6 +212,7 @@ class ErrorCode(enum.IntEnum):
     ALLOCATION_FAILED = 2005
     INSUFFICIENT_SPACE = 2006
     MEMORY_ACCESS_ERROR = 2007
+    STALE_EXTENT = 2008
 
     # Network (3000-3999)
     NETWORK_ERROR = 3000

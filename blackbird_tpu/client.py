@@ -410,6 +410,15 @@ class Client:
             # deferred and retrying (acked vs durable state diverged across
             # every in-process keystone). Alert on sustained nonzero.
             "persist_retry_backlog": "btpu_persist_retry_backlog",
+            # Pool sanitizer (btpu/common/poolsan.h): 0 in release builds
+            # (compiled out); any nonzero conviction count in a
+            # production-shadow run is an alert (docs/OPERATIONS.md).
+            "poolsan_armed": "btpu_poolsan_armed",
+            "poolsan_convictions": "btpu_poolsan_conviction_count",
+            "poolsan_stale_extent": "btpu_poolsan_stale_extent_count",
+            "poolsan_redzone_smash": "btpu_poolsan_redzone_smash_count",
+            "poolsan_double_free": "btpu_poolsan_double_free_count",
+            "poolsan_quarantine_bytes": "btpu_poolsan_quarantine_bytes",
             # Real histogram summaries for the hot get family (full set via
             # Client.histograms()): sample count + bucket-interpolated
             # p50/p99 of btpu_op_duration_us{op="get"}.

@@ -187,6 +187,13 @@ class NativeAPI(Protocol):
     def btpu_breaker_trip_count(self) -> int: ...
     def btpu_breaker_skip_count(self) -> int: ...
     def btpu_persist_retry_backlog(self) -> int: ...
+    # -- pool sanitizer ------------------------------------------------------
+    def btpu_poolsan_armed(self) -> int: ...
+    def btpu_poolsan_conviction_count(self) -> int: ...
+    def btpu_poolsan_stale_extent_count(self) -> int: ...
+    def btpu_poolsan_redzone_smash_count(self) -> int: ...
+    def btpu_poolsan_double_free_count(self) -> int: ...
+    def btpu_poolsan_quarantine_bytes(self) -> int: ...
     # -- observability -------------------------------------------------------
     def btpu_op_get_count(self) -> int: ...
     def btpu_op_get_p50_us(self) -> int: ...

@@ -16,6 +16,7 @@
 #include <thread>
 
 #include "btpu/client/embedded.h"
+#include "btpu/common/pool_span.h"
 #include "btpu/common/trace.h"
 #include "btpu/rpc/rpc_server.h"
 
@@ -69,6 +70,7 @@ int main(int argc, char** argv) {
   wc.max_workers_per_copy = 4;
   bool json = false, sweep = false, no_verify = false, repeat_rows = false;
   bool trace_ab = false;  // tracing-on/off A/B over the hot cached get
+  bool poolsan_ab = false;  // pool-span resolve microbench (release-overhead guard)
   bool control_plane = false;  // metadata ops/sec closed loop, no data plane
   bool overload = false;  // slow-worker tail row: hedging off vs on
   bool durable_put = false;  // acked==durable inline puts vs gets (WAL group commit)
@@ -94,6 +96,7 @@ int main(int argc, char** argv) {
     else if (!std::strcmp(argv[i], "--no-verify")) no_verify = true;
     else if (!std::strcmp(argv[i], "--repeat-rows")) repeat_rows = true;
     else if (!std::strcmp(argv[i], "--trace-ab")) trace_ab = true;
+    else if (!std::strcmp(argv[i], "--poolsan-ab")) poolsan_ab = true;
     else if (!std::strcmp(argv[i], "--sweep")) sweep = true;
     else if (!std::strcmp(argv[i], "--batch") && i + 1 < argc) batch = std::stoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--threads") && i + 1 < argc)
@@ -139,6 +142,44 @@ int main(int argc, char** argv) {
           "                       default reads are verified end to end)\n");
       return 0;
     }
+  }
+
+  if (poolsan_ab) {
+    // Pool-span overhead microbench (release-build guard, bench.py
+    // "poolsan overhead" row): the per-resolve cost of poolspan::resolve —
+    // the ONE chokepoint every pool access now funnels through — measured
+    // against the raw base+offset it replaced, on THIS binary. In release
+    // builds the sanitizer is compiled out, so the delta is the pure
+    // bounds-proof cost; bench.py scales it by resolves-per-op for the
+    // cached-get and 1 MiB stream paths (PASS <= 1.05x). In-process A/B on
+    // purpose: cross-run numbers on this box swing +-30%.
+    using Clk = std::chrono::steady_clock;
+    std::vector<uint8_t> region(1 << 20, 1);
+    constexpr uint64_t kIters = 2'000'000;
+    uint64_t sink = 0;
+    auto t0 = Clk::now();
+    for (uint64_t i = 0; i < kIters; ++i) {
+      const uint64_t off = (i * 4099) & ((1u << 20) - 1 - 4096);
+      auto span = poolspan::resolve(region.data(), region.size(), off, 4096, 0,
+                                    poolspan::Access::kRead, "poolsan-ab");
+      if (!span.ok()) return 1;
+      sink += span.value().data()[0];
+    }
+    auto t1 = Clk::now();
+    for (uint64_t i = 0; i < kIters; ++i) {
+      const uint64_t off = (i * 4099) & ((1u << 20) - 1 - 4096);
+      sink += *(region.data() + off);
+    }
+    auto t2 = Clk::now();
+    const double resolve_ns =
+        std::chrono::duration<double, std::nano>(t1 - t0).count() / kIters;
+    const double raw_ns = std::chrono::duration<double, std::nano>(t2 - t1).count() / kIters;
+    std::printf(
+        "{\"op\": \"poolsan_ab\", \"resolve_ns\": %.2f, \"raw_ns\": %.2f, "
+        "\"delta_ns\": %.2f, \"compiled_in\": %d, \"armed\": %d, \"sink\": %llu}\n",
+        resolve_ns, raw_ns, resolve_ns - raw_ns, poolsan::compiled_in() ? 1 : 0,
+        poolsan::armed() ? 1 : 0, (unsigned long long)(sink & 1));
+    return 0;
   }
 
   if (durable_put) {

@@ -53,13 +53,13 @@ inline std::vector<FaninConn> fanin_connect(const std::string& host, uint16_t po
 }
 
 // One read op: rotating-stride offset keeps requests spread across the
-// region (4099 is coprime with power-of-two region sizes). 45 bytes into
+// region (4099 is coprime with power-of-two region sizes). 53 bytes into
 // an idle socket: never fills the send buffer.
 inline bool fanin_send(FaninConn& c, size_t idx, uint64_t remote_base, uint64_t rkey,
                        uint64_t region_len, uint64_t op_len) {
   const uint64_t off = (idx * 4099) % (region_len - op_len);
   transport::datawire::DataRequestHeader hdr{transport::datawire::kOpRead,
-                                             remote_base + off, rkey, op_len, 0, 0, 0};
+                                             remote_base + off, rkey, op_len, 0, 0, 0, 0};
   return net::write_all(c.sock.fd(), &hdr, sizeof(hdr)) == ErrorCode::OK;
 }
 

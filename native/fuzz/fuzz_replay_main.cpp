@@ -215,8 +215,9 @@ void gen_seeds(const std::string& root) {
   // unknown-op and absurd-length variants.
   using namespace btpu::transport::datawire;
   auto hdr_bytes = [](uint8_t op, uint64_t addr, uint64_t rkey, uint64_t len,
-                      uint32_t dl, uint64_t trace_id = 0, uint64_t span_id = 0) {
-    DataRequestHeader h{op, addr, rkey, len, dl, trace_id, span_id};
+                      uint32_t dl, uint64_t trace_id = 0, uint64_t span_id = 0,
+                      uint64_t extent_gen = 0) {
+    DataRequestHeader h{op, addr, rkey, len, dl, trace_id, span_id, extent_gen};
     std::vector<uint8_t> v(sizeof(h));
     std::memcpy(v.data(), &h, sizeof(h));
     return v;
@@ -229,7 +230,7 @@ void gen_seeds(const std::string& root) {
   emit("tcp_header", "hostile_len", hdr_bytes(kOpWrite, 0, 0, ~0ull >> 1, 0));
   emit("tcp_header", "hostile_hello_len", hdr_bytes(kOpHello, 0, 0, 4096, 0));
   {
-    StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 256 << 10, 100, 0, 0}, 0x40000};
+    StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 256 << 10, 100, 0, 0, 0}, 0x40000};
     std::vector<uint8_t> v(sizeof(f));
     std::memcpy(v.data(), &f, sizeof(f));
     truncations("tcp_header", "staged_write", v);
@@ -248,8 +249,19 @@ void gen_seeds(const std::string& root) {
   }
   emit("tcp_header", "max_trace_ids",
        hdr_bytes(kOpWrite, 0x2000, 0xBEEF, 4096, 0, ~0ull, ~0ull));
+  // Pool-sanitizer generation seeds: a stamped header, the ceiling value,
+  // and the pre-poolsan 45-byte size (rejected as truncated under the
+  // ship-together contract, like the 29-byte shape above).
+  emit("tcp_header", "genstamped_read",
+       hdr_bytes(kOpRead, 0x1000, 0xBEEF, 65536, 0, 0, 0, 0x0123456789ABCDEFull));
+  emit("tcp_header", "max_extent_gen", hdr_bytes(kOpWrite, 0x2000, 0xBEEF, 4096, 0, 0, 0, ~0ull));
   {
-    StagedFrame f{{kOpReadStaged, 0x1000, 0xBEEF, 64 << 10, 50, 0xD15711B07ull, 0x51A9ull},
+    auto legacy45 = hdr_bytes(kOpRead, 0x1000, 0xBEEF, 65536, 0, 7, 9);
+    legacy45.resize(45);  // the pre-poolsan header size
+    emit("tcp_header", "legacy_45b_truncated", legacy45);
+  }
+  {
+    StagedFrame f{{kOpReadStaged, 0x1000, 0xBEEF, 64 << 10, 50, 0xD15711B07ull, 0x51A9ull, 3},
                   0x2000};
     std::vector<uint8_t> v(sizeof(f));
     std::memcpy(v.data(), &f, sizeof(f));
@@ -392,7 +404,7 @@ void bench_decode() {
   using clock = std::chrono::steady_clock;
 
   // Data-plane header: what the server parses per sub-op.
-  DataRequestHeader h{kOpRead, 0x1000, 0xBEEF, 1 << 20, 250, 0xFEEDull, 0xBEEFull};
+  DataRequestHeader h{kOpRead, 0x1000, 0xBEEF, 1 << 20, 250, 0xFEEDull, 0xBEEFull, 7};
   std::vector<uint8_t> raw(sizeof(h));
   std::memcpy(raw.data(), &h, sizeof(h));
   constexpr int kHdrIters = 2'000'000;

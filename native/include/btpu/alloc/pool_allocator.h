@@ -13,8 +13,10 @@
 
 #include <map>
 #include <optional>
+#include <string_view>
 
 #include "btpu/alloc/allocator.h"
+#include "btpu/common/poolsan.h"
 #include "btpu/common/thread_annotations.h"
 #include "btpu/common/types.h"
 
@@ -26,16 +28,28 @@ class PoolAllocator {
   // has zero size, an unspecified transport, an empty endpoint, or a
   // non-hex rkey (parity: reference PoolAllocator ctor + to_memory_location
   // strict rkey validation, range_allocator.cpp:12-35,125-131).
-  explicit PoolAllocator(const MemoryPool& pool);
+  // `poolsan_track` registers the pool with btpu::poolsan (shadow extent
+  // map + generations + red zones + quarantine) — set by the keystone-side
+  // RangeAllocator, the one authority on placement carve/free. Backend-
+  // internal reservation allocators stay untracked: they share the pool id
+  // with the keystone's view of the same region, and two shadows over one
+  // address space would convict each other's carves.
+  explicit PoolAllocator(const MemoryPool& pool, bool poolsan_track = false);
 
   // Carved offsets honor the pool's advertised alignment (MemoryPool::
   // alignment): the chosen block is padded up to the boundary and the
-  // leading gap returns to the free map.
+  // leading gap returns to the free map. Tracked pools additionally carve
+  // a trailing red zone when the pool has room (dropped, never failing the
+  // allocation, when it does not) and stamp a fresh generation.
   std::optional<Range> allocate(uint64_t size, bool prefer_best_fit = true);
   // Carves a SPECIFIC range out of the free map (keystone restart replay of
   // persisted placements). Fails when any byte of it is already allocated.
   bool allocate_at(const Range& range);
-  void free(const Range& range);
+  // `who` is poolsan report context (the owning object key when known).
+  // Tracked pools park the extent in the bounded quarantine FIFO instead
+  // of reusing it immediately; a convicted free (double free, wild free)
+  // is REFUSED — the free map stays intact.
+  void free(const Range& range, std::string_view who = {});
 
   uint64_t total_free() const;
   uint64_t largest_free_block() const;
@@ -67,6 +81,12 @@ class PoolAllocator {
   uint64_t pool_size_;
   uint64_t alignment_{0};  // 0/1 = unaligned
 
+  // Pool-sanitizer shadow (null = untracked: release builds, BTPU_POOLSAN=0,
+  // or backend-internal allocators). Leaf state with its own mutex; the
+  // only lock edge is mutex_ -> shadow (allocate stamps/drains under
+  // mutex_; free consults the shadow BEFORE taking mutex_).
+  poolsan::ShadowPtr shadow_;
+
   mutable Mutex mutex_;
   // offset -> length / length -> offset views of the free map.
   std::map<uint64_t, uint64_t> free_by_offset_ BTPU_GUARDED_BY(mutex_);
@@ -74,6 +94,17 @@ class PoolAllocator {
 
   void insert_free(uint64_t offset, uint64_t length) BTPU_REQUIRES(mutex_);
   void erase_free(std::map<uint64_t, uint64_t>::iterator it) BTPU_REQUIRES(mutex_);
+  // The carve search (best-fit via the size index or first-fit by offset),
+  // factored out so allocate() can retry after a quarantine drain. Returns
+  // the carved start offset, or nullopt when no block fits.
+  std::optional<uint64_t> carve(uint64_t size, bool prefer_best_fit)
+      BTPU_REQUIRES(mutex_);
+  // allocate_at's exact carve, factored out so IT can retry after a
+  // quarantine drain too (record re-apply frees then re-adopts ranges).
+  bool carve_exact(const Range& range) BTPU_REQUIRES(mutex_);
+  // free() minus the locking: merge-with-neighbors insert, shared with the
+  // quarantine-release path (which already holds mutex_).
+  void free_locked(uint64_t offset, uint64_t length) BTPU_REQUIRES(mutex_);
 };
 
 }  // namespace btpu::alloc

@@ -165,6 +165,19 @@ uint64_t btpu_breaker_skip_count(void);             /* client: open-endpoint dep
  * acked vs durable state diverged; alert (docs/OPERATIONS.md). */
 uint64_t btpu_persist_retry_backlog(void);
 
+/* ---- pool sanitizer (btpu/common/poolsan.h; -DBTPU_POOLSAN trees) --------
+ * Conviction counters are monotonic and 0 in release builds (the sanitizer
+ * is compiled out; btpu_poolsan_armed reports 0 there). ANY nonzero
+ * conviction count in a production-shadow run is an alert
+ * (docs/OPERATIONS.md): a stale descriptor / pool-memory bug was served an
+ * error instead of a neighbor object's bytes. */
+uint64_t btpu_poolsan_armed(void);               /* 1 = compiled in AND enabled */
+uint64_t btpu_poolsan_conviction_count(void);    /* total, all fault classes */
+uint64_t btpu_poolsan_stale_extent_count(void);  /* stale gen + quarantined access */
+uint64_t btpu_poolsan_redzone_smash_count(void); /* canary damage at free/scrub */
+uint64_t btpu_poolsan_double_free_count(void);   /* refused double/wild frees */
+uint64_t btpu_poolsan_quarantine_bytes(void);    /* live: bytes parked against reuse */
+
 /* ---- observability: histograms, distributed traces, flight recorder ------
  * Real log-bucket latency histograms (btpu/common/histogram.h) replace the
  * reservoir p50/p99 gauges: the "get" family summaries ride the lane

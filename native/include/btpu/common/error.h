@@ -70,6 +70,12 @@ enum class BTPU_NODISCARD ErrorCode : uint32_t {
   ALLOCATION_FAILED,
   INSUFFICIENT_SPACE,
   MEMORY_ACCESS_ERROR,
+  // Appended (wire append-only rule): a pool access through a descriptor
+  // whose extent has since been freed/quarantined/reused — the placement's
+  // generation stamp no longer matches the extent's (btpu::poolsan). The
+  // access was convicted at the resolve site instead of served as a
+  // neighbor object's bytes; the caller must re-fetch placements.
+  STALE_EXTENT,
 
   // Network (3000-3999)
   NETWORK_ERROR = domain_base(Domain::NETWORK),

@@ -53,6 +53,7 @@ enum class Ev : uint8_t {
   kDataOp = 18,       // thread-server data op served: a0 = op, a1 = dur us
   kSlowOp = 19,       // a0 = duration us (threshold exceeded)
   kSampled = 20,      // 1/N sampling hit: trace id is the one to stitch
+  kPoolsanConviction = 21,  // a0 = poolsan::Fault, a1 = pool offset
 };
 
 const char* ev_name(Ev ev) noexcept;

@@ -1,0 +1,81 @@
+// PoolSpan — the ONE sanctioned way to turn a registered pool region's base
+// pointer plus an (offset, length) extent into a dereferenceable span.
+//
+// Every raw `base + offset` in the tree (serving engines, transports,
+// storage backends) funnels through resolve() below, which
+//   1. bounds-PROVES the access against the region length (overflow-safe:
+//      no sum is formed before both operands are vetted), and
+//   2. in -DBTPU_POOLSAN trees, consults the pool's shadow state
+//      (btpu/common/poolsan.h): extent allocated? generation stamp on the
+//      placement still the live one? not a red zone, not quarantine? —
+//      convicting stale/wild accesses AT THE ACCESS SITE with a replayable
+//      report instead of serving a neighbor object's bytes.
+//
+// The `pool-span-only` rule in scripts/btpu_lint.py fails `make lint` on
+// any pool-base pointer arithmetic outside this header and the backends'
+// own region setup — the chokepoint stays the chokepoint.
+//
+// Release builds compile step 2 out entirely; resolve() is then a handful
+// of compares and one add (see bench.py's "poolsan overhead" guard row,
+// PASS <= 1.05x on the cached-get and 1 MiB stream paths).
+#pragma once
+
+#include <cstdint>
+
+#include "btpu/common/poolsan.h"
+#include "btpu/common/result.h"
+
+namespace btpu::poolspan {
+
+using poolsan::Access;
+
+// A bounds-proved window into a registered pool region. Constructible only
+// by resolve() — holding a PoolSpan IS the proof the access was vetted.
+class PoolSpan {
+ public:
+  PoolSpan() = default;  // empty (Result plumbing); data() == nullptr
+  uint8_t* data() const noexcept { return data_; }
+  uint64_t size() const noexcept { return len_; }
+
+ private:
+  PoolSpan(uint8_t* d, uint64_t n) noexcept : data_(d), len_(n) {}
+  friend Result<PoolSpan> resolve(void*, uint64_t, uint64_t, uint64_t, uint64_t, Access,
+                                  const char*, uint64_t) noexcept;
+
+  uint8_t* data_{nullptr};
+  uint64_t len_{0};
+};
+
+// Resolves extent [offset, offset+len) of the region [base, base+region_len)
+// into a span. `gen` is the placement's generation stamp (0 = unstamped —
+// bounds + shadow-state checks only, no generation comparison); `tag` is
+// the pool id / region tag when the caller knows it (shadow lookup falls
+// back to it when the base address is not the registered one, e.g. a
+// client-side shm mapping); `trace_id` attributes convictions to the
+// requesting op in the flight recorder.
+BTPU_NODISCARD inline Result<PoolSpan> resolve(void* base, uint64_t region_len,
+                                               uint64_t offset, uint64_t len,
+                                               uint64_t gen = 0,
+                                               Access access = Access::kRead,
+                                               const char* tag = nullptr,
+                                               uint64_t trace_id = 0) noexcept {
+  if (base == nullptr) return ErrorCode::MEMORY_ACCESS_ERROR;
+  // Overflow-safe bounds proof: compare before any sum is trusted.
+  if (offset > region_len || len > region_len - offset)
+    return ErrorCode::MEMORY_ACCESS_ERROR;
+#if defined(BTPU_POOLSAN)
+  if (poolsan::armed()) {
+    const ErrorCode verdict =
+        poolsan::check_access(base, tag, region_len, offset, len, gen, access, trace_id);
+    if (verdict != ErrorCode::OK) return verdict;
+  }
+#else
+  (void)gen;
+  (void)access;
+  (void)tag;
+  (void)trace_id;
+#endif
+  return PoolSpan(static_cast<uint8_t*>(base) + offset, len);
+}
+
+}  // namespace btpu::poolspan

@@ -1,0 +1,236 @@
+// btpu::poolsan — pool-memory sanitizer: shadow state, generations,
+// red zones, quarantine (the ASan recipe, pool-native).
+//
+// The data plane hands out raw (offset, length) placements into giant
+// registered pool regions that clients and both serving engines dereference
+// directly. Because each pool is ONE live allocation, ASan/TSan see every
+// byte as valid: an off-by-one past an extent, a read through a stale
+// RemoteDescriptor after remove/GC/evict/demote, or a double-free in the
+// allocator silently corrupts a NEIGHBOR OBJECT and surfaces (maybe) as a
+// CRC mismatch much later. This layer rebuilds what AddressSanitizer
+// (Serebryany et al., USENIX ATC'12) built for malloc, at pool granularity:
+//
+//   * shadow state — per-pool extent map (allocated / quarantined) kept by
+//     the allocator, consulted by EVERY pool_span.h resolve;
+//   * generation counters — each carve gets a fresh generation, stamped
+//     into placements (MemoryLocation::extent_gen, the TCP request header)
+//     and validated at the access site, so a stale descriptor is convicted
+//     with {pool, extent, generation pair} instead of served as garbage;
+//   * red zones — the allocator carves a dead band after every extent; on
+//     asan builds it is __asan_poison'd (wild accesses trap natively), on
+//     gcc-only builds it carries a pattern canary verified on free and by
+//     the scrub hook;
+//   * quarantine — freed extents are held (poisoned / pattern-filled) in a
+//     bounded FIFO before reuse, so use-after-free hits dead bytes and is
+//     convicted, not absorbed by the next allocation.
+//
+// Compiled in only under -DBTPU_POOLSAN (the asan/tsan/sched check trees;
+// the Makefile's POOLSAN_FLAGS). In those trees it is ON by default and the
+// env dial BTPU_POOLSAN=0|1 overrides. Release builds compile the hot-path
+// checks out entirely (pool_span.h resolve is a pure bounds proof) and the
+// allocator hooks reduce to one null-pointer test. Knobs (armed trees):
+//   BTPU_POOLSAN                  0|1 (default 1 when compiled in)
+//   BTPU_POOLSAN_REDZONE          red-zone bytes per extent (default 64)
+//   BTPU_POOLSAN_QUARANTINE_BYTES per-pool quarantine budget (default 1 MiB)
+//   BTPU_POOLSAN_MUTANT           planted-mutant arm (tests only):
+//                                 overrun | stale_read | double_free
+// Reports: every conviction logs one replayable line (pool, fault class,
+// offset/len, placement vs extent generation, state, caller context),
+// lands a flight-recorder event, and bumps the btpu_poolsan_* counters
+// (capi + /metrics). See docs/CORRECTNESS.md §12.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "btpu/common/error.h"
+
+namespace btpu::poolsan {
+
+// Access intent, for reports and (future) read-only extents.
+enum class Access : uint8_t { kRead = 0, kWrite = 1 };
+
+// Conviction classes. Order is frozen: the values ride flight-recorder
+// events and the per-class counters below.
+enum class Fault : uint8_t {
+  kStaleGeneration = 0,   // placement gen != live extent gen
+  kQuarantinedAccess = 1, // access inside a freed-but-quarantined extent
+  kRedzoneAccess = 2,     // access inside an inter-extent red zone
+  kOverrun = 3,           // access starts in an extent, runs past its end
+  kRedzoneSmash = 4,      // canary/poison damage found at free or scrub
+  kQuarantineSmash = 5,   // quarantined bytes mutated before reuse (UAF write)
+  kDoubleFree = 6,        // free of an extent already freed/quarantined
+};
+const char* fault_name(Fault f) noexcept;
+
+// True iff this build carries the sanitizer (-DBTPU_POOLSAN).
+bool compiled_in() noexcept;
+// True iff compiled in AND the BTPU_POOLSAN env dial (default on) says yes
+// AND no ScopedDisarm is active. Read per call so tests can flip it.
+bool armed() noexcept;
+
+// Process-global scoreboard (monotonic counters + live gauges).
+struct Counters {
+  uint64_t convictions{0};        // total, all classes
+  uint64_t stale_generation{0};   // kStaleGeneration + kQuarantinedAccess
+  uint64_t redzone_smash{0};      // kRedzoneSmash + kQuarantineSmash
+  uint64_t double_free{0};        // kDoubleFree
+  uint64_t quarantine_bytes{0};   // live: usable bytes parked in quarantine
+  uint64_t quarantined_extents{0};// live
+  uint64_t pools_tracked{0};      // live: shadows currently registered
+};
+Counters counters() noexcept;
+void reset_counters_for_test() noexcept;  // monotonic counters only
+
+// A span to hand back to the free map: the extent's FULL footprint
+// (usable bytes + its red zone), expressed pool-relative.
+struct ReleasedSpan {
+  uint64_t offset{0};
+  uint64_t length{0};
+};
+
+// Per-pool shadow. Created by the keystone-side PoolAllocator (the one
+// authority on carve/free), consulted by every serve engine in the same
+// process through the registry below. All methods are thread-safe.
+class Shadow;
+using ShadowPtr = std::shared_ptr<Shadow>;
+
+// Returns null when !armed() — callers skip every hook on null, which is
+// the whole release-build cost. `pool_id` keys the registry (serve-path
+// lookups by region tag / segment name); `size` pins the region length so
+// a colliding re-registration of the same id with a different geometry
+// degrades to untracked instead of mis-convicting.
+ShadowPtr create_shadow(const std::string& pool_id, uint64_t size);
+
+// Worker-side host binding: the process that OWNS the region's memory
+// declares it, which is what authorizes byte-level red-zone canaries /
+// asan poisoning and indexes the shadow by base address for the serving
+// engines' resolve path. Never bind memory this process does not own.
+// Call unbind_host BEFORE freeing the region (it unpoisons everything).
+void bind_host(const std::string& pool_id, void* base, uint64_t len);
+void unbind_host(const std::string& pool_id);
+
+// Registers a second name for a pool's shadow (the SHM transport's segment
+// name: a same-host client addresses the pool through its own mapping, so
+// only the segment name survives to the access site). Aliases must be
+// unique per pool — never alias a shared endpoint like "host:port".
+void alias_pool(const std::string& alias, const std::string& pool_id);
+
+// The serve-path check behind poolspan::resolve. Looks the shadow up by
+// host base address first (worker side), then by `tag` (pool id / segment
+// name; may be null). No shadow — or a shadow whose recorded size differs
+// from region_len — means "untracked": OK. Convictions are reported
+// internally (log + flight event + counters); the returned code is what
+// the engine answers on the wire: STALE_EXTENT for stale/quarantined/
+// generation faults, MEMORY_ACCESS_ERROR for red-zone/overrun faults.
+ErrorCode check_access(const void* base, const char* tag, uint64_t region_len,
+                       uint64_t offset, uint64_t len, uint64_t gen, Access access,
+                       uint64_t trace_id = 0) noexcept;
+
+// Canary sweep over every host-bound shadow (keystone scrub hook, tests):
+// verifies red zones and quarantined ranges, reporting any smash. Returns
+// the number of NEW smashes found this sweep. No-op (0) under asan builds
+// — there the poisoned ranges trap at the faulting instruction instead.
+uint64_t scrub_canaries();
+
+// Planted-mutant matrix (BTPU_POOLSAN_MUTANT; armed trees only). Each
+// re-injects one historical bug class so the test suite proves the
+// sanitizer CONVICTS it deterministically (PR 11 pattern):
+//   overrun     — a backend write_at writes one byte past the extent
+//   stale_read  — the client reuses a cached placement after remove
+//   double_free — RangeAllocator::free releases the first range twice
+enum class Mutant : uint8_t { kNone = 0, kOverrun, kStaleRead, kDoubleFree };
+Mutant mutant() noexcept;  // reads the env per call (tests arm/disarm live)
+
+// Scoped process-wide disarm for accounting-exact allocator unit tests
+// (red zones / quarantine change free-space math). Test harness is
+// single-threaded between tests; do not use in library code.
+class ScopedDisarm {
+ public:
+  ScopedDisarm();
+  ~ScopedDisarm();
+  ScopedDisarm(const ScopedDisarm&) = delete;
+  ScopedDisarm& operator=(const ScopedDisarm&) = delete;
+};
+
+// ---- allocator-side hooks (PoolAllocator) ---------------------------------
+// Everything below is called with the allocator's own locks NOT held across
+// calls into here; Shadow has its own leaf mutex (no lock-order edges out).
+
+struct FreeOutcome {
+  // Conviction (double free / free of untracked-but-overlapping space):
+  // the caller must NOT touch its free map — refusing is what keeps the
+  // neighbor extent intact.
+  bool refused{false};
+  // The freed extent was parked in quarantine — the caller must NOT return
+  // it to the free map now (it comes back later via `release` / drain_all).
+  // false with !refused = untracked extent: free verbatim.
+  bool quarantined{false};
+  // Red-zone canary was smashed during the extent's life (reported; the
+  // free itself still proceeds into quarantine).
+  bool smashed{false};
+  // Quarantine overflow: these spans' hold expired NOW — return each to
+  // the free map (full footprint, red zone included).
+  std::vector<ReleasedSpan> release;
+};
+
+class Shadow {
+ public:
+  explicit Shadow(std::string pool_id, uint64_t size);
+  ~Shadow();
+  Shadow(const Shadow&) = delete;
+  Shadow& operator=(const Shadow&) = delete;
+
+  const std::string& pool_id() const noexcept { return pool_id_; }
+  uint64_t size() const noexcept { return size_; }
+
+  // Preferred red-zone width for a fresh carve (0 when quarantining is
+  // off). The allocator carves len + redzone and reports both here.
+  uint64_t redzone_bytes() const noexcept;
+
+  // Records a fresh extent [offset, offset+len) with rz_len dead bytes
+  // after it; returns the extent's generation (monotonic per pool, never
+  // 0). Writes the red-zone canary / asan poison when the host is bound.
+  uint64_t on_alloc(uint64_t offset, uint64_t len, uint64_t rz_len);
+
+  // Restart replay (allocate_at): adopts an extent whose generation is
+  // unknown (0 = wildcard — placements from before the restart validate
+  // against it without conviction). No red zone is assumed.
+  void on_adopt(uint64_t offset, uint64_t len);
+
+  // Free-time transition: verify canary, convict double frees, park the
+  // extent in quarantine (pattern-fill / poison), pop expired quarantine
+  // entries. `who` is report context (the object key when known).
+  FreeOutcome on_free(uint64_t offset, uint64_t len, std::string_view who);
+
+  // Pressure valve: release EVERY quarantined extent now (verifying
+  // quarantine canaries on the way out). The allocator calls this when a
+  // carve fails, then retries — capacity is never lost to the sanitizer.
+  std::vector<ReleasedSpan> drain_all();
+
+  // Generation of the extent containing `offset` (0 = untracked): stamps
+  // placements in PoolAllocator::to_memory_location.
+  uint64_t gen_at(uint64_t offset) const noexcept;
+
+  // Usable bytes currently parked in quarantine (the btpu_poolsan_
+  // quarantine_bytes gauge).
+  uint64_t quarantined_usable_bytes() const noexcept;
+  // Full footprint parked in quarantine (usable + red zones): what the free
+  // map gets back on a drain. The allocator folds THIS into total_free()
+  // so capacity accounting never shrinks under the sanitizer.
+  uint64_t quarantined_span_bytes() const noexcept;
+
+  // Opaque state; public so the registry surface in poolsan.cpp (the only
+  // code that can see Impl's definition) reaches it without a friend list.
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+
+ private:
+  std::string pool_id_;
+  uint64_t size_;
+};
+
+}  // namespace btpu::poolsan

@@ -160,6 +160,14 @@ struct MemoryLocation {
   uint64_t remote_addr{0};
   uint64_t rkey{0};  // 64-bit; the reference truncates to u32 (types.h:109)
   uint64_t size{0};
+  // Pool-sanitizer generation stamp (btpu/common/poolsan.h), minted when the
+  // extent was carved and validated on every resolve in -DBTPU_POOLSAN
+  // trees: a descriptor cached across a remove/GC/evict/demote is convicted
+  // STALE_EXTENT at the access site instead of served as a neighbor
+  // object's bytes. 0 = unstamped (release builds, pre-poolsan records) —
+  // bounds + shadow-state checks still apply, generation comparison is
+  // skipped. Wire-append-only.
+  uint64_t extent_gen{0};
   bool operator==(const MemoryLocation&) const = default;
 };
 

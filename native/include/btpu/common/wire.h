@@ -311,8 +311,14 @@ BTPU_NODISCARD inline bool decode(Reader& r, RemoteDescriptor& d) {
                        d.pvm_endpoint, d.data_wire_version);
 }
 
-inline void encode(Writer& w, const MemoryLocation& m) { encode_struct(w, m.remote_addr, m.rkey, m.size); }
-BTPU_NODISCARD inline bool decode(Reader& r, MemoryLocation& m) { return decode_struct(r, m.remote_addr, m.rkey, m.size); }
+// `extent_gen` appended (poolsan generation stamp); old frames leave it 0
+// (unstamped — generation validation is skipped, see types.h).
+inline void encode(Writer& w, const MemoryLocation& m) {
+  encode_struct(w, m.remote_addr, m.rkey, m.size, m.extent_gen);
+}
+BTPU_NODISCARD inline bool decode(Reader& r, MemoryLocation& m) {
+  return decode_struct(r, m.remote_addr, m.rkey, m.size, m.extent_gen);
+}
 
 inline void encode(Writer& w, const FileLocation& f) { encode_struct(w, f.file_path, f.file_offset); }
 BTPU_NODISCARD inline bool decode(Reader& r, FileLocation& f) { return decode_struct(r, f.file_path, f.file_offset); }

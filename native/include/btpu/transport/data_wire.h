@@ -62,6 +62,15 @@ struct DataRequestHeader {
   // issued this request — the serving side parents its own span under it.
   uint64_t trace_id;
   uint64_t span_id;
+  // Pool-sanitizer generation stamp of the extent this op addresses
+  // (MemoryLocation::extent_gen, appended under the same ship-together
+  // contract). The serving side validates it against the pool's shadow
+  // state in -DBTPU_POOLSAN trees and answers STALE_EXTENT on a mismatch —
+  // a client holding a placement across a remove/GC/evict/demote is
+  // convicted at the access site instead of served a neighbor's bytes.
+  // 0 = unstamped (release builds, legacy placements): bounds + shadow-
+  // state checks only.
+  uint64_t extent_gen;
 };
 
 // A staged request with its trailing segment offset, as it crosses the wire.
@@ -75,12 +84,13 @@ struct StagedFrame {
 // just the total, so an inserted field cannot shift the tail silently.
 // deadline_ms was APPENDED in the deadline-propagation change (25 -> 29);
 // trace_id/span_id were APPENDED in the distributed-tracing change
-// (29 -> 45, StagedFrame 37 -> 53) — both sides of the data plane ship
-// together (no length prefix tolerates a tail here), and
+// (29 -> 45, StagedFrame 37 -> 53); extent_gen was APPENDED in the pool-
+// sanitizer change (45 -> 53, StagedFrame 53 -> 61) — both sides of the
+// data plane ship together (no length prefix tolerates a tail here), and
 // kTcpDataWireVersion (transport.h) fences mixed-version client/worker
 // pairs into a fast REMOTE_ENDPOINT_ERROR instead of a desynced stream.
 BTPU_WIRE_RAW_TYPE(DataRequestHeader);
-BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 45);
+BTPU_WIRE_FROZEN_SIZEOF(DataRequestHeader, 53);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, op, 0);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, addr, 1);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, rkey, 9);
@@ -88,9 +98,10 @@ BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, len, 17);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, deadline_ms, 25);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, trace_id, 29);
 BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, span_id, 37);
+BTPU_WIRE_FROZEN_OFFSET(DataRequestHeader, extent_gen, 45);
 BTPU_WIRE_RAW_TYPE(StagedFrame);
-BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 53);
-BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 45);
+BTPU_WIRE_FROZEN_SIZEOF(StagedFrame, 61);
+BTPU_WIRE_FROZEN_OFFSET(StagedFrame, shm_off, 53);
 
 // ---- hostile-input ceilings ------------------------------------------------
 // A single data op moves at most this many payload bytes. Real ops are
@@ -118,9 +129,9 @@ BTPU_NODISCARD inline bool decode_request_header(const void* data, size_t size,
   uint8_t op = 0;
   uint64_t addr = 0, rkey = 0, len = 0;
   uint32_t deadline_ms = 0;
-  uint64_t trace_id = 0, span_id = 0;
+  uint64_t trace_id = 0, span_id = 0, extent_gen = 0;
   if (!r.u8(op) || !r.u64(addr) || !r.u64(rkey) || !r.u64(len) || !r.u32(deadline_ms) ||
-      !r.u64(trace_id) || !r.u64(span_id))
+      !r.u64(trace_id) || !r.u64(span_id) || !r.u64(extent_gen))
     return false;
   if (!valid_op(op)) return false;
   if (op == kOpHello) {
@@ -138,6 +149,9 @@ BTPU_NODISCARD inline bool decode_request_header(const void* data, size_t size,
   // never address memory or size a buffer.
   out.trace_id = trace_id;
   out.span_id = span_id;
+  // Same non-constraint: a forged generation can only make an access FAIL
+  // (stale conviction), never widen it.
+  out.extent_gen = extent_gen;
   return true;
 }
 

@@ -127,7 +127,8 @@ class TransportServer {
 // desyncing the stream. 0 (pre-versioned metadata: legacy peers, WAL-restored
 // placements) is served on the documented both-sides-ship-together contract.
 // v2: trace_id/span_id appended to DataRequestHeader (29 -> 45 bytes).
-inline constexpr uint32_t kTcpDataWireVersion = 2;
+// v3: extent_gen (poolsan generation stamp) appended (45 -> 53 bytes).
+inline constexpr uint32_t kTcpDataWireVersion = 3;
 
 struct WireOp {
   const RemoteDescriptor* remote{nullptr};
@@ -157,6 +158,12 @@ struct WireOp {
   // 0 = untraced.
   uint64_t trace_id{0};
   uint64_t span_id{0};
+  // Pool-sanitizer generation stamp of the extent this op addresses
+  // (copied from MemoryLocation::extent_gen by make_wire_op). Rides every
+  // TCP request header and the local/shm/pvm resolve paths; the serving
+  // side validates it against the pool's shadow state in -DBTPU_POOLSAN
+  // trees. 0 = unstamped.
+  uint64_t extent_gen{0};
 };
 
 // Client side: one-sided read/write against any advertised descriptor.
@@ -354,8 +361,15 @@ std::string pvm_make_endpoint_for_pid(long pid, const void* base, uint64_t len,
 // syscall/staged lanes, so skipping registration is safe but slower.
 uint64_t pvm_register_self_region(const void* base, uint64_t len);
 void pvm_retire_self_region(const void* base);
+// `extent_gen` is the placement's poolsan generation stamp (0 = unstamped);
+// the same-process direct lane validates it against the pool's shadow
+// state. On a poolsan conviction the lane sets *fail_out (STALE_EXTENT /
+// MEMORY_ACCESS_ERROR) and returns false — the caller must FAIL the op
+// with that code instead of falling back to a slower lane that would only
+// re-convict the same stale descriptor.
 bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf, uint64_t len,
-                bool is_write, uint32_t* crc_out);
+                bool is_write, uint32_t* crc_out, uint64_t extent_gen = 0,
+                ErrorCode* fail_out = nullptr);
 // Ops/bytes this process completed over the PVM lane (diagnostics, like
 // tcp_staged_op_count).
 uint64_t pvm_op_count() noexcept;

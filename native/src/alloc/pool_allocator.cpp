@@ -17,7 +17,7 @@ bool parse_hex_u64(const std::string& hex, uint64_t& out) {
 }
 }  // namespace
 
-PoolAllocator::PoolAllocator(const MemoryPool& pool)
+PoolAllocator::PoolAllocator(const MemoryPool& pool, bool poolsan_track)
     : pool_id_(pool.id),
       storage_class_(pool.storage_class),
       node_id_(pool.node_id),
@@ -33,6 +33,7 @@ PoolAllocator::PoolAllocator(const MemoryPool& pool)
   if (!pool.remote.rkey_hex.empty() && !parse_hex_u64(pool.remote.rkey_hex, rkey_))
     throw std::invalid_argument("pool " + pool.id + " has invalid rkey_hex '" +
                                 pool.remote.rkey_hex + "'");
+  if (poolsan_track) shadow_ = poolsan::create_shadow(pool.id, pool.size);
   insert_free(0, pool.size);
 }
 
@@ -52,10 +53,7 @@ void PoolAllocator::erase_free(std::map<uint64_t, uint64_t>::iterator it) {
   free_by_offset_.erase(it);
 }
 
-std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit) {
-  if (size == 0) return std::nullopt;
-  MutexLock lock(mutex_);
-
+std::optional<uint64_t> PoolAllocator::carve(uint64_t size, bool prefer_best_fit) {
   // Alignment only pays off for shards of at least one aligned unit (e.g.
   // a whole HBM chunk): smaller shards are partial-chunk no matter where
   // they land, and rounding them up would waste a full unit each.
@@ -96,14 +94,41 @@ std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit
   if (pad > 0) insert_free(offset, pad);  // leading gap stays free
   const uint64_t carved = offset + pad;
   if (block_len > pad + size) insert_free(carved + size, block_len - pad - size);
-
-  LOG_TRACE << "pool " << pool_id_ << " carved [" << carved << "," << carved + size << ")";
-  return Range{carved, size};
+  return carved;
 }
 
-bool PoolAllocator::allocate_at(const Range& range) {
-  if (range.length == 0 || range.end() > pool_size_) return false;
+std::optional<Range> PoolAllocator::allocate(uint64_t size, bool prefer_best_fit) {
+  if (size == 0) return std::nullopt;
   MutexLock lock(mutex_);
+
+  // Tracked pools carve a trailing red zone so an off-by-one write past the
+  // extent lands in sanitizer-owned dead bytes, never a neighbor object.
+  // The red zone is best-effort: when even `size` alone cannot be carved
+  // we drain the quarantine (freed extents parked against reuse) back into
+  // the free map and retry — the sanitizer never costs an allocation.
+  const uint64_t want_rz = shadow_ ? shadow_->redzone_bytes() : 0;
+  uint64_t rz = want_rz;
+  auto carve_with_rz = [&]() -> std::optional<uint64_t> {
+    if (rz > 0) {
+      if (auto off = carve(size + rz, prefer_best_fit)) return off;
+      rz = 0;
+    }
+    return carve(size, prefer_best_fit);
+  };
+  std::optional<uint64_t> carved = carve_with_rz();
+  if (!carved && shadow_) {
+    for (const auto& span : shadow_->drain_all()) free_locked(span.offset, span.length);
+    rz = want_rz;
+    carved = carve_with_rz();
+  }
+  if (!carved) return std::nullopt;
+
+  if (shadow_) shadow_->on_alloc(*carved, size, rz);
+  LOG_TRACE << "pool " << pool_id_ << " carved [" << *carved << "," << *carved + size << ")";
+  return Range{*carved, size};
+}
+
+bool PoolAllocator::carve_exact(const Range& range) {
   // Find the free block starting at or before range.offset.
   auto it = free_by_offset_.upper_bound(range.offset);
   if (it == free_by_offset_.begin()) return false;
@@ -118,13 +143,46 @@ bool PoolAllocator::allocate_at(const Range& range) {
   return true;
 }
 
-void PoolAllocator::free(const Range& range) {
-  if (range.length == 0) return;
+bool PoolAllocator::allocate_at(const Range& range) {
+  if (range.length == 0 || range.end() > pool_size_) return false;
   MutexLock lock(mutex_);
+  bool ok = carve_exact(range);
+  if (!ok && shadow_) {
+    // The requested space may be parked in quarantine: record re-apply and
+    // restart replay free an object's ranges and immediately re-adopt the
+    // SAME ranges (keystone_persist "record wins" semantics). Drain the
+    // quarantine back into the free map and retry — refusing here would
+    // turn the sanitizer into a data-loss bug.
+    for (const auto& span : shadow_->drain_all()) free_locked(span.offset, span.length);
+    ok = carve_exact(range);
+  }
+  if (!ok) return false;
+  if (shadow_) shadow_->on_adopt(range.offset, range.length);
+  return true;
+}
 
-  uint64_t offset = range.offset;
-  uint64_t length = range.length;
+void PoolAllocator::free(const Range& range, std::string_view who) {
+  if (range.length == 0) return;
+  if (shadow_) {
+    // Shadow first, WITHOUT mutex_ held (the only lock edge stays
+    // mutex_ -> shadow, from allocate's stamp/drain). A convicted free —
+    // double free, wild free — is REFUSED: the free map stays exactly as
+    // it was, so the extent the range actually belongs to (or its current
+    // owner after reuse) is never handed out twice.
+    poolsan::FreeOutcome out = shadow_->on_free(range.offset, range.length, who);
+    if (out.refused) return;
+    MutexLock lock(mutex_);
+    for (const auto& span : out.release) free_locked(span.offset, span.length);
+    // Quarantined extents come back via `release`/drain_all later — with
+    // their red zones — not now.
+    if (!out.quarantined) free_locked(range.offset, range.length);
+    return;
+  }
+  MutexLock lock(mutex_);
+  free_locked(range.offset, range.length);
+}
 
+void PoolAllocator::free_locked(uint64_t offset, uint64_t length) {
   // Merge with right neighbor.
   auto right = free_by_offset_.lower_bound(offset);
   if (right != free_by_offset_.end() && right->first == offset + length) {
@@ -145,9 +203,15 @@ void PoolAllocator::free(const Range& range) {
 }
 
 uint64_t PoolAllocator::total_free() const {
-  MutexLock lock(mutex_);
   uint64_t total = 0;
-  for (const auto& [off, len] : free_by_offset_) total += len;
+  {
+    MutexLock lock(mutex_);
+    for (const auto& [off, len] : free_by_offset_) total += len;
+  }
+  // Quarantined extents are allocatable after a drain (allocate() and
+  // allocate_at() drain on pressure), so capacity accounting counts their
+  // FULL spans — usable + red zones — as free.
+  if (shadow_) total += shadow_->quarantined_span_bytes();
   return total;
 }
 
@@ -167,14 +231,23 @@ double PoolAllocator::fragmentation_ratio() const {
 
 bool PoolAllocator::can_allocate(uint64_t size) const {
   if (size == 0) return false;
-  MutexLock lock(mutex_);
-  if (free_by_size_.empty() || free_by_size_.rbegin()->first < size) return false;
-  if (alignment_ <= 1 || size < alignment_) return true;  // mirrors allocate()
-  for (const auto& [off, len] : free_by_offset_) {
-    const uint64_t pad = (alignment_ - off % alignment_) % alignment_;
-    if (len >= pad + size) return true;
+  {
+    MutexLock lock(mutex_);
+    if (!free_by_size_.empty() && free_by_size_.rbegin()->first >= size) {
+      if (alignment_ <= 1 || size < alignment_) return true;  // mirrors allocate()
+      for (const auto& [off, len] : free_by_offset_) {
+        const uint64_t pad = (alignment_ - off % alignment_) % alignment_;
+        if (len >= pad + size) return true;
+      }
+    }
   }
-  return false;
+  // Optimistic: quarantined bytes become free the moment allocate() drains
+  // them (same advisory confidence the registry's stale `used` field gives).
+  // Aligned requests don't take the shortcut — scattered quarantined spans
+  // say nothing about whether an aligned block exists after the drain, and
+  // a false yes here steers placement INTO a pool that then fails the carve.
+  if (alignment_ > 1 && size >= alignment_) return false;
+  return shadow_ && shadow_->quarantined_span_bytes() >= size;
 }
 
 size_t PoolAllocator::free_range_count() const {
@@ -187,6 +260,9 @@ MemoryLocation PoolAllocator::to_memory_location(const Range& range) const {
       .remote_addr = remote_.remote_base + range.offset,
       .rkey = rkey_,
       .size = range.length,
+      // Generation stamp: validated on every resolve in poolsan trees, so a
+      // descriptor held across a free/reuse is convicted at the access site.
+      .extent_gen = shadow_ ? shadow_->gen_at(range.offset) : 0,
   };
 }
 

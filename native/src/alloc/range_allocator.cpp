@@ -62,7 +62,10 @@ ErrorCode RangeAllocator::ensure_pool_allocator(const MemoryPool& pool) {
   WriterLock lock(pools_mutex_);
   if (pool_allocators_.contains(pool.id)) return ErrorCode::OK;
   try {
-    pool_allocators_[pool.id] = std::make_unique<PoolAllocator>(pool);
+    // poolsan_track: the keystone-side allocator is the one authority on
+    // placement carve/free, so it owns the pool's sanitizer shadow
+    // (generations, red zones, quarantine — btpu/common/poolsan.h).
+    pool_allocators_[pool.id] = std::make_unique<PoolAllocator>(pool, /*poolsan_track=*/true);
     LOG_DEBUG << "created allocator for pool " << pool.id << " (" << pool.size << " bytes, "
               << storage_class_name(pool.storage_class) << ")";
     return ErrorCode::OK;
@@ -531,7 +534,7 @@ void RangeAllocator::rollback_allocation(
   SharedLock lock(pools_mutex_);
   for (const auto& [pool_id, range] : ranges) {
     auto it = pool_allocators_.find(pool_id);
-    if (it != pool_allocators_.end()) it->second->free(range);
+    if (it != pool_allocators_.end()) it->second->free(range, "rollback");
   }
   if (!ranges.empty()) {
     LOG_DEBUG << "rolled back " << ranges.size() << " ranges";
@@ -642,7 +645,7 @@ ErrorCode RangeAllocator::release_range(const ObjectKey& key, const MemoryPoolId
                           });
   if (rit == ranges.end()) return ErrorCode::OBJECT_NOT_FOUND;
   auto pa = pool_allocators_.find(pool_id);
-  if (pa != pool_allocators_.end()) pa->second->free(range);
+  if (pa != pool_allocators_.end()) pa->second->free(range, key);
   it->second.total_size -= std::min(it->second.total_size, range.length);
   ranges.erase(rit);
   return ErrorCode::OK;
@@ -678,8 +681,21 @@ ErrorCode RangeAllocator::free(const ObjectKey& object_key) {
   }
   for (const auto& [pool_id, range] : it->second.ranges) {
     auto pa = pool_allocators_.find(pool_id);
-    if (pa != pool_allocators_.end()) pa->second->free(range);
+    if (pa != pool_allocators_.end()) pa->second->free(range, object_key);
   }
+#if defined(BTPU_POOLSAN)
+  // PLANTED MUTANT — double-free class (the allocator bug poolsan's shadow
+  // exists to convict): release the object's first range a SECOND time, the
+  // way a racing remove/GC pair or a rollback-after-commit once could. The
+  // shadow sees the extent already quarantined, CONVICTS with a replayable
+  // report, and REFUSES the free — the free map (and whoever owns the bytes
+  // by then) stays intact. Pinned by Poolsan.MutantDoubleFree.
+  if (poolsan::mutant() == poolsan::Mutant::kDoubleFree && !it->second.ranges.empty()) {
+    const auto& [mpool, mrange] = it->second.ranges.front();
+    auto pa = pool_allocators_.find(mpool);
+    if (pa != pool_allocators_.end()) pa->second->free(mrange, object_key);
+  }
+#endif
   LOG_DEBUG << "freed object " << object_key << " (" << it->second.total_size << " bytes, "
             << it->second.ranges.size() << " ranges)";
   s.map.erase(it);

@@ -9,6 +9,7 @@
 #include "btpu/common/flight_recorder.h"
 #include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
 #include "btpu/common/trace.h"
 #include "btpu/transport/transport.h"
 
@@ -392,6 +393,15 @@ uint64_t btpu_breaker_skip_count(void) {
 uint64_t btpu_persist_retry_backlog(void) {
   return keystone::persist_retry_backlog_process_total();
 }
+
+/* ---- pool sanitizer ------------------------------------------------------ */
+
+uint64_t btpu_poolsan_armed(void) { return poolsan::armed() ? 1 : 0; }
+uint64_t btpu_poolsan_conviction_count(void) { return poolsan::counters().convictions; }
+uint64_t btpu_poolsan_stale_extent_count(void) { return poolsan::counters().stale_generation; }
+uint64_t btpu_poolsan_redzone_smash_count(void) { return poolsan::counters().redzone_smash; }
+uint64_t btpu_poolsan_double_free_count(void) { return poolsan::counters().double_free; }
+uint64_t btpu_poolsan_quarantine_bytes(void) { return poolsan::counters().quarantine_bytes; }
 
 /* ---- observability: histograms, trace spans, flight recorder ------------- */
 

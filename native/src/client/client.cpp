@@ -12,6 +12,7 @@
 #include "btpu/common/histogram.h"
 #include "btpu/common/wire.h"
 #include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
 #include "btpu/common/trace.h"
 #include "btpu/coord/remote_coordinator.h"
 #include "btpu/ec/rs.h"
@@ -162,6 +163,32 @@ Result<bool> ObjectClient::object_exists(const ObjectKey& key) {
 
 Result<std::vector<CopyPlacement>> ObjectClient::get_workers(const ObjectKey& key) {
   OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+#if defined(BTPU_POOLSAN)
+  // PLANTED MUTANT — stale-descriptor class (the bug generation stamps
+  // exist to convict): serve placements from a never-invalidated memo, the
+  // way an over-eager placement cache once could across a remove/GC. The
+  // first get memoizes; every later get reuses the stale descriptors, and
+  // the data plane must answer STALE_EXTENT — never a neighbor object's
+  // bytes. Pinned by Poolsan.MutantStaleRead.
+  if (poolsan::mutant() == poolsan::Mutant::kStaleRead) {
+    static Mutex memo_mutex;
+    static std::unordered_map<ObjectKey, std::vector<CopyPlacement>> memo;
+    {
+      MutexLock lock(memo_mutex);
+      auto it = memo.find(key);
+      if (it != memo.end()) return it->second;
+    }
+    auto fresh = embedded_ ? embedded_->get_workers(key)
+                           : rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) {
+                               return r.get_workers(key);
+                             });
+    if (fresh.ok()) {
+      MutexLock lock(memo_mutex);
+      memo[key] = fresh.value();
+    }
+    return fresh;
+  }
+#endif
   if (embedded_) return embedded_->get_workers(key);
   return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.get_workers(key); });
 }

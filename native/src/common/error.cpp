@@ -21,6 +21,7 @@ std::string_view to_string(ErrorCode code) noexcept {
     case ErrorCode::ALLOCATION_FAILED: return "ALLOCATION_FAILED";
     case ErrorCode::INSUFFICIENT_SPACE: return "INSUFFICIENT_SPACE";
     case ErrorCode::MEMORY_ACCESS_ERROR: return "MEMORY_ACCESS_ERROR";
+    case ErrorCode::STALE_EXTENT: return "STALE_EXTENT";
     case ErrorCode::NETWORK_ERROR: return "NETWORK_ERROR";
     case ErrorCode::CONNECTION_FAILED: return "CONNECTION_FAILED";
     case ErrorCode::TRANSFER_FAILED: return "TRANSFER_FAILED";
@@ -80,6 +81,9 @@ std::string_view describe(ErrorCode code) noexcept {
     case ErrorCode::ALLOCATION_FAILED: return "allocator could not satisfy the request";
     case ErrorCode::INSUFFICIENT_SPACE: return "not enough free space in eligible pools";
     case ErrorCode::MEMORY_ACCESS_ERROR: return "invalid access to a registered region";
+    case ErrorCode::STALE_EXTENT:
+      return "pool access through a stale descriptor: the extent was freed, quarantined, or "
+             "reused under a newer generation (re-fetch placements)";
     case ErrorCode::NETWORK_ERROR: return "generic network failure";
     case ErrorCode::CONNECTION_FAILED: return "could not connect to remote endpoint";
     case ErrorCode::TRANSFER_FAILED: return "one-sided data transfer failed";

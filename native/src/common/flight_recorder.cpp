@@ -36,6 +36,7 @@ const char* ev_name(Ev ev) noexcept {
     case Ev::kDataOp: return "data_op";
     case Ev::kSlowOp: return "slow_op";
     case Ev::kSampled: return "sampled";
+    case Ev::kPoolsanConviction: return "poolsan_conviction";
   }
   return "unknown";
 }

@@ -1,0 +1,619 @@
+// btpu::poolsan implementation — see poolsan.h for the model and
+// docs/CORRECTNESS.md §12 for the report-reading runbook.
+#include "btpu/common/poolsan.h"
+
+#include <atomic>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <unordered_map>
+
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/log.h"
+#include "btpu/common/thread_annotations.h"
+#include "btpu/common/trace.h"
+
+#if defined(BTPU_POOLSAN) && defined(__SANITIZE_ADDRESS__) && \
+    __has_include(<sanitizer/asan_interface.h>)
+#include <sanitizer/asan_interface.h>
+#define BTPU_POOLSAN_ASAN 1
+#endif
+
+namespace btpu::poolsan {
+
+namespace {
+
+// Dead-byte patterns (gcc-only trees; asan trees poison instead).
+constexpr uint8_t kRedzonePattern = 0xBD;
+constexpr uint8_t kQuarantinePattern = 0xDE;
+
+// Monotonic conviction counters + live gauges. ordering: relaxed throughout
+// — independent monotonic stats/gauges, folded on read with no cross-field
+// invariant (same policy as the robustness counters).
+std::atomic<uint64_t> g_convictions{0};
+std::atomic<uint64_t> g_stale_generation{0};
+std::atomic<uint64_t> g_redzone_smash{0};
+std::atomic<uint64_t> g_double_free{0};
+std::atomic<uint64_t> g_quarantine_bytes{0};
+std::atomic<uint64_t> g_quarantined_extents{0};
+std::atomic<uint64_t> g_pools_tracked{0};
+std::atomic<int> g_disarm_depth{0};
+
+void count_fault(Fault f) {
+  // ordering: relaxed — monotonic stat counters (this whole function).
+  g_convictions.fetch_add(1, std::memory_order_relaxed);
+  switch (f) {
+    case Fault::kStaleGeneration:
+    case Fault::kQuarantinedAccess:
+      g_stale_generation.fetch_add(1, std::memory_order_relaxed);
+      break;
+    case Fault::kRedzoneSmash:
+    case Fault::kQuarantineSmash:
+      // ordering: relaxed — monotonic stat counters (whole switch).
+      g_redzone_smash.fetch_add(1, std::memory_order_relaxed);
+      break;
+    case Fault::kDoubleFree:
+      g_double_free.fetch_add(1, std::memory_order_relaxed);
+      break;
+    default:
+      break;
+  }
+}
+
+// One replayable report per conviction: everything needed to reproduce the
+// access (pool, fault class, extent window, both generations, state) in one
+// log line, plus a flight-recorder event stitched to the requesting op.
+void convict(Fault f, const std::string& pool, Access access, uint64_t offset, uint64_t len,
+             uint64_t placement_gen, uint64_t extent_gen, const char* state,
+             std::string_view who, uint64_t trace_id) {
+  count_fault(f);
+  LOG_ERROR << "poolsan: CONVICTED " << fault_name(f) << " pool=" << pool << " "
+            << (access == Access::kWrite ? "write" : "read") << " [" << offset << ","
+            << offset + len << ") placement_gen=" << placement_gen
+            << " extent_gen=" << extent_gen << " state=" << state
+            << (who.empty() ? "" : " who=") << who << " trace_id=" << trace_id
+            << " (replay: same op against the same shadow state; see "
+               "docs/CORRECTNESS.md section 12)";
+  flight::record_at(trace::now_ns(), flight::Ev::kPoolsanConviction,
+                    static_cast<uint64_t>(f), offset, trace_id);
+}
+
+void poison_bytes(uint8_t* p, uint64_t n, uint8_t pattern) {
+  if (p == nullptr || n == 0) return;
+#if defined(BTPU_POOLSAN_ASAN)
+  (void)pattern;
+  ASAN_POISON_MEMORY_REGION(p, n);
+#else
+  std::memset(p, pattern, n);
+#endif
+}
+
+void unpoison_bytes(uint8_t* p, uint64_t n) {
+  if (p == nullptr || n == 0) return;
+#if defined(BTPU_POOLSAN_ASAN)
+  ASAN_UNPOISON_MEMORY_REGION(p, n);
+#endif
+}
+
+// Canary verification (gcc trees only — under asan the poisoned bytes trap
+// the offender at the faulting instruction, which is strictly better).
+bool canary_intact(const uint8_t* p, uint64_t n, uint8_t pattern) {
+#if defined(BTPU_POOLSAN_ASAN)
+  (void)p;
+  (void)n;
+  (void)pattern;
+  return true;
+#else
+  if (p == nullptr) return true;
+  for (uint64_t i = 0; i < n; ++i)
+    if (p[i] != pattern) return false;
+  return true;
+#endif
+}
+
+}  // namespace
+
+const char* fault_name(Fault f) noexcept {
+  switch (f) {
+    case Fault::kStaleGeneration: return "stale_generation";
+    case Fault::kQuarantinedAccess: return "quarantined_access";
+    case Fault::kRedzoneAccess: return "redzone_access";
+    case Fault::kOverrun: return "extent_overrun";
+    case Fault::kRedzoneSmash: return "redzone_smash";
+    case Fault::kQuarantineSmash: return "quarantine_smash";
+    case Fault::kDoubleFree: return "double_free";
+  }
+  return "unknown";
+}
+
+bool compiled_in() noexcept {
+#if defined(BTPU_POOLSAN)
+  return true;
+#else
+  return false;
+#endif
+}
+
+bool armed() noexcept {
+#if defined(BTPU_POOLSAN)
+  // ordering: relaxed — the disarm depth is a test-harness toggle flipped
+  // between serial tests, not a synchronization point.
+  if (g_disarm_depth.load(std::memory_order_relaxed) > 0) return false;
+  return env_bool("BTPU_POOLSAN", true);
+#else
+  return false;
+#endif
+}
+
+Counters counters() noexcept {
+  Counters c;
+  // ordering: relaxed — independent monotonic counters/gauges, folded on read.
+  c.convictions = g_convictions.load(std::memory_order_relaxed);
+  c.stale_generation = g_stale_generation.load(std::memory_order_relaxed);
+  c.redzone_smash = g_redzone_smash.load(std::memory_order_relaxed);
+  c.double_free = g_double_free.load(std::memory_order_relaxed);
+  c.quarantine_bytes = g_quarantine_bytes.load(std::memory_order_relaxed);
+  c.quarantined_extents = g_quarantined_extents.load(std::memory_order_relaxed);
+  c.pools_tracked = g_pools_tracked.load(std::memory_order_relaxed);
+  return c;
+}
+
+void reset_counters_for_test() noexcept {
+  // ordering: relaxed — test-harness reset between serial tests.
+  g_convictions.store(0, std::memory_order_relaxed);
+  g_stale_generation.store(0, std::memory_order_relaxed);
+  g_redzone_smash.store(0, std::memory_order_relaxed);
+  g_double_free.store(0, std::memory_order_relaxed);
+}
+
+Mutant mutant() noexcept {
+#if defined(BTPU_POOLSAN)
+  const char* m = env_str("BTPU_POOLSAN_MUTANT");
+  if (m == nullptr || *m == '\0') return Mutant::kNone;
+  if (std::strcmp(m, "overrun") == 0) return Mutant::kOverrun;
+  if (std::strcmp(m, "stale_read") == 0) return Mutant::kStaleRead;
+  if (std::strcmp(m, "double_free") == 0) return Mutant::kDoubleFree;
+  return Mutant::kNone;
+#else
+  return Mutant::kNone;
+#endif
+}
+
+ScopedDisarm::ScopedDisarm() {
+  // ordering: relaxed — see armed().
+  g_disarm_depth.fetch_add(1, std::memory_order_relaxed);
+}
+ScopedDisarm::~ScopedDisarm() {
+  // ordering: relaxed — see armed().
+  g_disarm_depth.fetch_sub(1, std::memory_order_relaxed);
+}
+
+// ---- shadow state ----------------------------------------------------------
+
+struct Shadow::Impl {
+  mutable Mutex mutex;
+  struct Extent {
+    uint64_t len{0};
+    uint64_t rz{0};
+    uint64_t gen{0};
+    bool quarantined{false};
+  };
+  // offset -> extent; the authoritative map every resolve consults.
+  std::map<uint64_t, Extent> extents BTPU_GUARDED_BY(mutex);
+  std::deque<uint64_t> quarantine BTPU_GUARDED_BY(mutex);  // FIFO of offsets
+  uint64_t q_usable BTPU_GUARDED_BY(mutex){0};
+  uint64_t gen_counter BTPU_GUARDED_BY(mutex){0};
+  // Host binding: set only by the process that owns the region's memory
+  // (bind_host). Guarded by the same mutex so canary writes can never race
+  // an unbind's unpoison-and-detach.
+  uint8_t* host BTPU_GUARDED_BY(mutex){nullptr};
+  uint64_t host_len BTPU_GUARDED_BY(mutex){0};
+  uint64_t q_budget{0};
+  uint64_t rz_default{0};
+
+  // Finds the extent containing `offset` (usable bytes OR red zone).
+  // Returns extents.end() when offset falls in untracked space.
+  std::map<uint64_t, Extent>::iterator containing(uint64_t offset) BTPU_REQUIRES(mutex) {
+    auto it = extents.upper_bound(offset);
+    if (it == extents.begin()) return extents.end();
+    --it;
+    const uint64_t span = it->second.len + it->second.rz;
+    if (offset >= it->first + span) return extents.end();
+    return it;
+  }
+
+  // Pops quarantine FIFO entries until `q_usable <= budget`, verifying
+  // quarantine canaries on the way out. Appends released full spans.
+  void pop_quarantine_to(uint64_t budget, const std::string& pool,
+                         std::vector<ReleasedSpan>& out) BTPU_REQUIRES(mutex) {
+    while (q_usable > budget && !quarantine.empty()) {
+      const uint64_t off = quarantine.front();
+      quarantine.pop_front();
+      auto it = extents.find(off);
+      if (it == extents.end() || !it->second.quarantined) continue;  // defensive
+      const Extent e = it->second;
+      if (host != nullptr) {
+        if (!canary_intact(host + off, e.len, kQuarantinePattern)) {
+          convict(Fault::kQuarantineSmash, pool, Access::kWrite, off, e.len, 0, e.gen,
+                  "quarantined", /*who=*/{}, /*trace_id=*/0);
+        }
+        unpoison_bytes(host + off, e.len + e.rz);
+      }
+      q_usable -= e.len;
+      // ordering: relaxed — live gauges.
+      g_quarantine_bytes.fetch_sub(e.len, std::memory_order_relaxed);
+      g_quarantined_extents.fetch_sub(1, std::memory_order_relaxed);
+      out.push_back({off, e.len + e.rz});
+      extents.erase(it);
+    }
+  }
+};
+
+// ---- registry --------------------------------------------------------------
+
+namespace {
+
+struct Registry {
+  SharedMutex mutex;
+  std::unordered_map<std::string, std::weak_ptr<Shadow>> by_name BTPU_GUARDED_BY(mutex);
+  std::unordered_map<uintptr_t, std::weak_ptr<Shadow>> by_base BTPU_GUARDED_BY(mutex);
+  // Host bindings declared before the shadow exists (worker registers its
+  // regions before the keystone materializes the pool's allocator).
+  struct Binding {
+    uintptr_t base{0};
+    uint64_t len{0};
+  };
+  std::unordered_map<std::string, Binding> bindings BTPU_GUARDED_BY(mutex);
+  // alias -> pool id (SHM segment names; see alias_pool).
+  std::unordered_map<std::string, std::string> aliases BTPU_GUARDED_BY(mutex);
+
+  static Registry& instance() {
+    static Registry r;
+    return r;
+  }
+};
+
+// Attaches a host binding to a live shadow (registry lock held by caller;
+// takes the shadow's leaf mutex). Rejects size mismatches — a colliding
+// pool id must degrade to untracked-by-base, never mis-poison.
+void attach_host_locked(const ShadowPtr& shadow, uint8_t* base, uint64_t len) {
+  MutexLock lock(shadow->impl_->mutex);
+  if (len != shadow->size()) {
+    LOG_WARN << "poolsan: host binding for pool " << shadow->pool_id() << " is " << len
+             << " bytes but the shadow tracks " << shadow->size() << " — not binding";
+    return;
+  }
+  shadow->impl_->host = base;
+  shadow->impl_->host_len = len;
+}
+
+}  // namespace
+
+Shadow::Shadow(std::string pool_id, uint64_t size)
+    : impl_(std::make_unique<Impl>()), pool_id_(std::move(pool_id)), size_(size) {
+  impl_->q_budget = env_u64("BTPU_POOLSAN_QUARANTINE_BYTES", 1ull << 20);
+  impl_->rz_default = env_u64("BTPU_POOLSAN_REDZONE", 64);
+  // ordering: relaxed — live gauge.
+  g_pools_tracked.fetch_add(1, std::memory_order_relaxed);
+}
+
+Shadow::~Shadow() {
+  // Unpoison everything this shadow ever poisoned: the region's memory can
+  // outlive the shadow (keystone restart, forget_pool), and leftover asan
+  // poison on recycled heap would convict innocent future allocations.
+  uintptr_t bound = 0;
+  {
+    MutexLock lock(impl_->mutex);
+    if (impl_->host != nullptr) {
+      bound = reinterpret_cast<uintptr_t>(impl_->host);
+      for (const auto& [off, e] : impl_->extents) {
+        if (e.quarantined) unpoison_bytes(impl_->host + off, e.len + e.rz);
+        else if (e.rz) unpoison_bytes(impl_->host + off + e.len, e.rz);
+      }
+      impl_->host = nullptr;
+    }
+    // ordering: relaxed — live gauges.
+    g_quarantine_bytes.fetch_sub(impl_->q_usable, std::memory_order_relaxed);
+    g_quarantined_extents.fetch_sub(impl_->quarantine.size(), std::memory_order_relaxed);
+  }
+  auto& reg = Registry::instance();
+  WriterLock lock(reg.mutex);
+  if (bound != 0) {
+    auto it = reg.by_base.find(bound);
+    if (it != reg.by_base.end() && it->second.expired()) reg.by_base.erase(it);
+  }
+  // ordering: relaxed — live gauge.
+  g_pools_tracked.fetch_sub(1, std::memory_order_relaxed);
+}
+
+uint64_t Shadow::redzone_bytes() const noexcept { return impl_->rz_default; }
+
+uint64_t Shadow::on_alloc(uint64_t offset, uint64_t len, uint64_t rz_len) {
+  MutexLock lock(impl_->mutex);
+  const uint64_t gen = ++impl_->gen_counter;
+  impl_->extents[offset] = Impl::Extent{len, rz_len, gen, false};
+  if (impl_->host != nullptr) {
+    // Fresh extent: its bytes may have been poisoned as part of an earlier
+    // quarantined span — make them writable again, then arm the red zone.
+    unpoison_bytes(impl_->host + offset, len);
+    if (rz_len) poison_bytes(impl_->host + offset + len, rz_len, kRedzonePattern);
+  }
+  return gen;
+}
+
+void Shadow::on_adopt(uint64_t offset, uint64_t len) {
+  MutexLock lock(impl_->mutex);
+  // Replayed placements predate this shadow: generation 0 = wildcard (any
+  // placement stamp validates), no red zone assumed.
+  impl_->extents[offset] = Impl::Extent{len, 0, 0, false};
+}
+
+FreeOutcome Shadow::on_free(uint64_t offset, uint64_t len, std::string_view who) {
+  FreeOutcome out;
+  MutexLock lock(impl_->mutex);
+  auto it = impl_->extents.find(offset);
+  if (it == impl_->extents.end()) {
+    // Untracked start: a pre-arm carve frees verbatim, but a range that
+    // OVERLAPS tracked space is a wild free — refusing it is what keeps
+    // the neighbor extent's bytes (and the free map) intact.
+    auto over = impl_->containing(offset);
+    if (over == impl_->extents.end()) {
+      auto next = impl_->extents.lower_bound(offset);
+      if (next != impl_->extents.end() && next->first < offset + len)
+        over = next;
+    }
+    if (over != impl_->extents.end()) {
+      convict(Fault::kDoubleFree, pool_id_, Access::kWrite, offset, len, 0,
+              over->second.gen, over->second.quarantined ? "quarantined" : "allocated",
+              who, 0);
+      out.refused = true;
+    }
+    return out;  // untracked: caller frees verbatim
+  }
+  Impl::Extent& e = it->second;
+  if (e.quarantined) {
+    convict(Fault::kDoubleFree, pool_id_, Access::kWrite, offset, len, 0, e.gen,
+            "quarantined", who, 0);
+    out.refused = true;
+    return out;
+  }
+  if (len != e.len) {
+    convict(Fault::kDoubleFree, pool_id_, Access::kWrite, offset, len, 0, e.gen,
+            "allocated (length mismatch)", who, 0);
+    out.refused = true;
+    return out;
+  }
+  if (impl_->host != nullptr && e.rz &&
+      !canary_intact(impl_->host + offset + e.len, e.rz, kRedzonePattern)) {
+    convict(Fault::kRedzoneSmash, pool_id_, Access::kWrite, offset, e.len, 0, e.gen,
+            "allocated", who, 0);
+    out.smashed = true;  // reported; the free itself still proceeds
+  }
+  e.quarantined = true;
+  if (impl_->host != nullptr) poison_bytes(impl_->host + offset, e.len, kQuarantinePattern);
+  impl_->quarantine.push_back(offset);
+  impl_->q_usable += e.len;
+  // ordering: relaxed — live gauges.
+  g_quarantine_bytes.fetch_add(e.len, std::memory_order_relaxed);
+  g_quarantined_extents.fetch_add(1, std::memory_order_relaxed);
+  out.quarantined = true;
+  // Budget re-read per free (ctor value as fallback): frees are control-
+  // plane rate, and a live dial lets tests/operators shrink the hold
+  // without rebuilding pools.
+  impl_->pop_quarantine_to(env_u64("BTPU_POOLSAN_QUARANTINE_BYTES", impl_->q_budget),
+                           pool_id_, out.release);
+  return out;
+}
+
+std::vector<ReleasedSpan> Shadow::drain_all() {
+  std::vector<ReleasedSpan> out;
+  MutexLock lock(impl_->mutex);
+  impl_->pop_quarantine_to(0, pool_id_, out);
+  return out;
+}
+
+uint64_t Shadow::gen_at(uint64_t offset) const noexcept {
+  MutexLock lock(impl_->mutex);
+  auto it = impl_->extents.find(offset);
+  return it != impl_->extents.end() && !it->second.quarantined ? it->second.gen : 0;
+}
+
+uint64_t Shadow::quarantined_usable_bytes() const noexcept {
+  MutexLock lock(impl_->mutex);
+  return impl_->q_usable;
+}
+
+uint64_t Shadow::quarantined_span_bytes() const noexcept {
+  MutexLock lock(impl_->mutex);
+  uint64_t total = 0;
+  for (const uint64_t off : impl_->quarantine) {
+    auto it = impl_->extents.find(off);
+    if (it != impl_->extents.end() && it->second.quarantined)
+      total += it->second.len + it->second.rz;
+  }
+  return total;
+}
+
+// ---- registry surface ------------------------------------------------------
+
+ShadowPtr create_shadow(const std::string& pool_id, uint64_t size) {
+  if (!armed() || size == 0) return nullptr;
+  auto shadow = std::make_shared<Shadow>(pool_id, size);
+  auto& reg = Registry::instance();
+  WriterLock lock(reg.mutex);
+  reg.by_name[pool_id] = shadow;
+  auto bit = reg.bindings.find(pool_id);
+  if (bit != reg.bindings.end()) {
+    attach_host_locked(shadow, reinterpret_cast<uint8_t*>(bit->second.base),
+                       bit->second.len);
+    reg.by_base[bit->second.base] = shadow;
+  }
+  return shadow;
+}
+
+void bind_host(const std::string& pool_id, void* base, uint64_t len) {
+  if (!armed() || base == nullptr || len == 0) return;
+  auto& reg = Registry::instance();
+  WriterLock lock(reg.mutex);
+  // A re-bind (worker re-initialized the pool without an intervening
+  // unbind) must retire the PREVIOUS base's index entry: a later heap
+  // placement at that address would otherwise resolve a foreign shadow.
+  if (auto prev = reg.bindings.find(pool_id);
+      prev != reg.bindings.end() && prev->second.base != reinterpret_cast<uintptr_t>(base))
+    reg.by_base.erase(prev->second.base);
+  reg.bindings[pool_id] = {reinterpret_cast<uintptr_t>(base), len};
+  auto it = reg.by_name.find(pool_id);
+  if (it != reg.by_name.end()) {
+    if (ShadowPtr shadow = it->second.lock()) {
+      attach_host_locked(shadow, static_cast<uint8_t*>(base), len);
+      reg.by_base[reinterpret_cast<uintptr_t>(base)] = shadow;
+    }
+  }
+}
+
+void unbind_host(const std::string& pool_id) {
+  auto& reg = Registry::instance();
+  WriterLock lock(reg.mutex);
+  auto bit = reg.bindings.find(pool_id);
+  if (bit == reg.bindings.end()) return;
+  const uintptr_t base = bit->second.base;
+  reg.bindings.erase(bit);
+  auto nit = reg.by_name.find(pool_id);
+  if (nit != reg.by_name.end()) {
+    if (ShadowPtr shadow = nit->second.lock()) {
+      MutexLock lock2(shadow->impl_->mutex);
+      if (shadow->impl_->host != nullptr) {
+        // The region's memory is about to be freed by its owner: unpoison
+        // everything so recycled heap starts clean, then detach — no byte
+        // of it may be touched through this shadow again.
+        for (const auto& [off, e] : shadow->impl_->extents) {
+          if (e.quarantined) unpoison_bytes(shadow->impl_->host + off, e.len + e.rz);
+          else if (e.rz) unpoison_bytes(shadow->impl_->host + off + e.len, e.rz);
+        }
+        shadow->impl_->host = nullptr;
+        shadow->impl_->host_len = 0;
+      }
+    }
+  }
+  reg.by_base.erase(base);
+}
+
+void alias_pool(const std::string& alias, const std::string& pool_id) {
+  if (!armed() || alias.empty() || alias == pool_id) return;
+  auto& reg = Registry::instance();
+  WriterLock lock(reg.mutex);
+  reg.aliases[alias] = pool_id;
+}
+
+ErrorCode check_access(const void* base, const char* tag, uint64_t region_len,
+                       uint64_t offset, uint64_t len, uint64_t gen, Access access,
+                       uint64_t trace_id) noexcept {
+  ShadowPtr shadow;
+  {
+    auto& reg = Registry::instance();
+    SharedLock lock(reg.mutex);
+    auto it = reg.by_base.find(reinterpret_cast<uintptr_t>(base));
+    if (it != reg.by_base.end()) shadow = it->second.lock();
+    if (!shadow && tag != nullptr) {
+      auto nit = reg.by_name.find(tag);
+      if (nit == reg.by_name.end()) {
+        auto ait = reg.aliases.find(tag);
+        if (ait != reg.aliases.end()) nit = reg.by_name.find(ait->second);
+      }
+      if (nit != reg.by_name.end()) shadow = nit->second.lock();
+    }
+  }
+  if (!shadow) return ErrorCode::OK;  // untracked region: bounds proof only
+  // A shadow whose geometry disagrees with the caller's region is a pool-id
+  // collision (two clusters in one process) — degrade to untracked rather
+  // than convict against the wrong extent map.
+  if (shadow->size() != region_len) return ErrorCode::OK;
+  MutexLock lock(shadow->impl_->mutex);
+  auto it = shadow->impl_->containing(offset);
+  if (it == shadow->impl_->extents.end()) {
+    // Untracked space. A placement CARRYING a generation believed an extent
+    // lived here — it was freed and drained: stale by definition.
+    if (gen != 0) {
+      convict(Fault::kStaleGeneration, shadow->pool_id(), access, offset, len, gen, 0,
+              "free", /*who=*/{}, trace_id);
+      return ErrorCode::STALE_EXTENT;
+    }
+    // Unstamped access starting in free space but RUNNING INTO a tracked
+    // extent is the neighbor-corruption shape from the other side (the red
+    // zone only guards the left neighbor, and may have been dropped under
+    // pressure) — convict it like on_free convicts the wild free.
+    auto next = shadow->impl_->extents.lower_bound(offset);
+    if (next != shadow->impl_->extents.end() && len > next->first - offset) {
+      convict(Fault::kOverrun, shadow->pool_id(), access, offset, len, gen,
+              next->second.gen, next->second.quarantined ? "quarantined" : "allocated",
+              /*who=*/{}, trace_id);
+      return ErrorCode::MEMORY_ACCESS_ERROR;
+    }
+    return ErrorCode::OK;
+  }
+  const auto& e = it->second;
+  const uint64_t ext_off = it->first;
+  if (offset >= ext_off + e.len) {
+    // Inside the extent's red zone.
+    convict(Fault::kRedzoneAccess, shadow->pool_id(), access, offset, len, gen, e.gen,
+            e.quarantined ? "quarantined" : "redzone", /*who=*/{}, trace_id);
+    return ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  if (e.quarantined) {
+    convict(Fault::kQuarantinedAccess, shadow->pool_id(), access, offset, len, gen, e.gen,
+            "quarantined", /*who=*/{}, trace_id);
+    return ErrorCode::STALE_EXTENT;
+  }
+  if (offset + len > ext_off + e.len) {
+    convict(Fault::kOverrun, shadow->pool_id(), access, offset, len, gen, e.gen,
+            "allocated", /*who=*/{}, trace_id);
+    return ErrorCode::MEMORY_ACCESS_ERROR;
+  }
+  if (gen != 0 && e.gen != 0 && gen != e.gen) {
+    convict(Fault::kStaleGeneration, shadow->pool_id(), access, offset, len, gen, e.gen,
+            "allocated", /*who=*/{}, trace_id);
+    return ErrorCode::STALE_EXTENT;
+  }
+  return ErrorCode::OK;
+}
+
+uint64_t scrub_canaries() {
+#if defined(BTPU_POOLSAN_ASAN)
+  return 0;  // asan traps at the faulting instruction; nothing to sweep
+#else
+  std::vector<ShadowPtr> shadows;
+  {
+    auto& reg = Registry::instance();
+    SharedLock lock(reg.mutex);
+    shadows.reserve(reg.by_name.size());
+    for (const auto& [name, weak] : reg.by_name)
+      if (ShadowPtr s = weak.lock()) shadows.push_back(std::move(s));
+  }
+  uint64_t smashes = 0;
+  for (const auto& shadow : shadows) {
+    MutexLock lock(shadow->impl_->mutex);
+    if (shadow->impl_->host == nullptr) continue;
+    for (auto& [off, e] : shadow->impl_->extents) {
+      if (e.quarantined) {
+        if (!canary_intact(shadow->impl_->host + off, e.len, kQuarantinePattern)) {
+          convict(Fault::kQuarantineSmash, shadow->pool_id(), Access::kWrite, off, e.len,
+                  0, e.gen, "quarantined", "scrub", 0);
+          ++smashes;
+          // Re-arm so one smash is one report per scrub epoch, not per pass.
+          poison_bytes(shadow->impl_->host + off, e.len, kQuarantinePattern);
+        }
+      } else if (e.rz &&
+                 !canary_intact(shadow->impl_->host + off + e.len, e.rz, kRedzonePattern)) {
+        convict(Fault::kRedzoneSmash, shadow->pool_id(), Access::kWrite, off, e.len, 0,
+                e.gen, "allocated", "scrub", 0);
+        ++smashes;
+        poison_bytes(shadow->impl_->host + off + e.len, e.rz, kRedzonePattern);
+      }
+    }
+  }
+  return smashes;
+#endif
+}
+
+}  // namespace btpu::poolsan

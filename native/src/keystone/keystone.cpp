@@ -524,6 +524,13 @@ Result<std::vector<CopyPlacement>> KeystoneService::get_workers(const ObjectKey&
   SharedLock lock(s.mutex);
   auto it = s.map.find(key);
   if (it == s.map.end()) return ErrorCode::OBJECT_NOT_FOUND;
+  // A pending put is not a committed object: its placements carry no CRC
+  // stamp yet, so a reader served them would read UNVERIFIABLE bytes from
+  // an extent the writer may not have filled. (Latent hole the pool
+  // sanitizer exposed: pre-quarantine, extent reuse made those bytes look
+  // plausibly like the previous object's.) Readers see the object the
+  // moment put_complete commits it, and not a placement sooner.
+  if (it->second.state == ObjectState::kPending) return ErrorCode::OBJECT_NOT_FOUND;
   it->second.last_access.store(std::chrono::steady_clock::now());
   ++counters_.gets;
   auto copies = it->second.copies;

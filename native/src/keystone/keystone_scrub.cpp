@@ -7,6 +7,7 @@
 #include <unordered_set>
 
 #include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
 #include "btpu/common/trace.h"
 #include "btpu/common/crc32c.h"
 #include "btpu/common/wire.h"
@@ -36,6 +37,18 @@ void KeystoneService::queue_scrub_target(const ObjectKey& key) {
 }
 
 size_t KeystoneService::run_scrub_once() {
+  // Pool-sanitizer canary sweep rides the scrub cadence: red zones and
+  // quarantined ranges of every host-bound pool are pattern-verified, so an
+  // overrun/UAF write that happened BETWEEN a free and the next access is
+  // still convicted (gcc trees; asan trees trap at the faulting store and
+  // the sweep is a no-op). BEFORE the leader/budget gate on purpose — the
+  // shadows this process can see deserve the sweep even on followers and
+  // scrub-disabled configs. Cheap when disarmed: one registry walk of zero
+  // shadows.
+  if (const uint64_t smashes = poolsan::scrub_canaries(); smashes > 0) {
+    LOG_ERROR << "scrub: poolsan canary sweep convicted " << smashes
+              << " smash(es) — see the poolsan reports above";
+  }
   if (!is_leader_.load() || config_.scrub_objects_per_pass == 0) return 0;
   struct Target {
     ObjectKey key;

@@ -763,12 +763,16 @@ class UringLoop {
           close_conn(c);
           return;
         }
-        c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target,
-                                     c->virt, c->offset);
+        ErrorCode resolved = regions_->resolve(
+            c->hdr.addr, c->hdr.rkey, c->hdr.len, c->hdr.extent_gen,
+            c->hdr.op == kOpWriteStaged ? poolspan::Access::kWrite : poolspan::Access::kRead,
+            c->hdr.trace_id, c->target, c->virt, c->offset);
+        c->valid = resolved == ErrorCode::OK;
         if (!c->valid) {
           // Mirrors the thread server: an unresolvable staged op answers
-          // MEMORY_ACCESS_ERROR without charging admission.
-          finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+          // the resolve verdict (STALE_EXTENT for a poolsan conviction,
+          // MEMORY_ACCESS_ERROR otherwise) without charging admission.
+          finish(c, code(resolved));
           return;
         }
         gate_or_park(c);
@@ -778,25 +782,31 @@ class UringLoop {
       case kOpFabricPull:
         do_fabric(c);
         return;
-      case kOpWrite:
-        c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target,
-                                     c->virt, c->offset);
+      case kOpWrite: {
+        const ErrorCode resolved = regions_->resolve(
+            c->hdr.addr, c->hdr.rkey, c->hdr.len, c->hdr.extent_gen,
+            poolspan::Access::kWrite, c->hdr.trace_id, c->target, c->virt, c->offset);
+        c->valid = resolved == ErrorCode::OK;
         if (!c->valid) {
           // Must still drain the payload to keep the stream aligned.
-          begin_drain(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+          begin_drain(c, code(resolved));
           return;
         }
         gate_or_park(c);
         return;
-      case kOpRead:
-        c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target,
-                                     c->virt, c->offset);
+      }
+      case kOpRead: {
+        const ErrorCode resolved = regions_->resolve(
+            c->hdr.addr, c->hdr.rkey, c->hdr.len, c->hdr.extent_gen,
+            poolspan::Access::kRead, c->hdr.trace_id, c->target, c->virt, c->offset);
+        c->valid = resolved == ErrorCode::OK;
         if (!c->valid) {
-          finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+          finish(c, code(resolved));
           return;
         }
         gate_or_park(c);
         return;
+      }
       default:
         close_conn(c);  // decode_request_header whitelists ops; unreachable
         return;
@@ -810,10 +820,15 @@ class UringLoop {
   }
 
   void do_fabric(Conn* c) {
-    c->valid = regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->target, c->virt,
-                                 c->offset);
+    const ErrorCode resolved =
+        regions_->resolve(c->hdr.addr, c->hdr.rkey, c->hdr.len, c->hdr.extent_gen,
+                          poolspan::Access::kRead, c->hdr.trace_id, c->target, c->virt,
+                          c->offset);
+    c->valid = resolved == ErrorCode::OK;
     if (!c->valid || c->target) {
-      finish(c, code(ErrorCode::MEMORY_ACCESS_ERROR));
+      // Conviction verdicts (STALE_EXTENT) ride through verbatim, exactly
+      // like the thread server's fabric branch.
+      finish(c, code(!c->valid ? resolved : ErrorCode::MEMORY_ACCESS_ERROR));
       return;
     }
     uint64_t transfer_id = 0;

@@ -33,7 +33,10 @@
 #include <memory>
 #include <unordered_map>
 
+#include <string>
+
 #include "btpu/common/admission.h"
+#include "btpu/common/pool_span.h"
 #include "btpu/common/stripe_counter.h"
 #include "btpu/common/thread_annotations.h"
 #include "btpu/net/net.h"
@@ -56,6 +59,7 @@ struct Region {
   RegionPullFn pull_fn;
   int direct_fd{-1};        // backing file for ring-unified reads; -1 = none
   bool direct_odirect{false};  // O_DIRECT file: 512-align ring reads
+  std::string tag;  // pool id at registration — the poolsan shadow lookup key
 };
 
 // Region registry shared by whichever serve engine is running. The lock is
@@ -64,26 +68,35 @@ struct RegionTable {
   Mutex mutex;
   std::unordered_map<uint64_t, Region> map BTPU_GUARDED_BY(mutex);
 
-  // Resolves (addr, rkey, len); returns false on violation. On success
-  // either `target` points into a flat region or `region_out` carries the
-  // callbacks (+ optional direct fd).
-  bool resolve(uint64_t addr, uint64_t rkey, uint64_t len, uint8_t*& target,
-               Region& region_out, uint64_t& offset) {
+  // Resolves (addr, rkey, len, extent_gen) through poolspan::resolve — the
+  // one sanctioned base+offset chokepoint. Returns OK with either `target`
+  // pointing into a flat region (bounds- and shadow-proved) or `target` ==
+  // nullptr and `region_out` carrying the callbacks (+ optional direct fd);
+  // MEMORY_ACCESS_ERROR on a bounds/rkey/red-zone violation; STALE_EXTENT
+  // on a poolsan conviction (stale generation, quarantined extent) — the
+  // engine answers that status verbatim so the client learns WHY.
+  BTPU_NODISCARD ErrorCode resolve(uint64_t addr, uint64_t rkey, uint64_t len,
+                                   uint64_t extent_gen, poolspan::Access access,
+                                   uint64_t trace_id, uint8_t*& target, Region& region_out,
+                                   uint64_t& offset) {
     MutexLock lock(mutex);
     auto it = map.find(rkey);
-    if (it == map.end()) return false;
+    if (it == map.end()) return ErrorCode::MEMORY_ACCESS_ERROR;
     const Region& region = it->second;
     if (addr < region.remote_base || len > region.len ||
         addr - region.remote_base > region.len - len)
-      return false;
+      return ErrorCode::MEMORY_ACCESS_ERROR;
     offset = addr - region.remote_base;
     if (region.base) {
-      target = region.base + offset;
+      auto span = poolspan::resolve(region.base, region.len, offset, len, extent_gen,
+                                    access, region.tag.c_str(), trace_id);
+      if (!span.ok()) return span.error();
+      target = span.value().data();
     } else {
       target = nullptr;
       region_out = region;
     }
-    return true;
+    return ErrorCode::OK;
   }
 };
 

@@ -9,6 +9,7 @@
 #include "btpu/common/flight_recorder.h"
 #include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
 #include "btpu/common/trace.h"
 #include "btpu/keystone/keystone.h"
 #include "btpu/transport/transport.h"
@@ -149,6 +150,30 @@ std::string MetricsHttpServer::render_metrics() const {
         static_cast<double>(transport::uring_active_loop_count()));
   gauge("btpu_wire_pool_threads", "resolved shared wire worker pool size",
         static_cast<double>(transport::wire_pool_threads_resolved()));
+  // Pool sanitizer (btpu/common/poolsan.h): all 0 in release builds (the
+  // sanitizer is compiled out). ANY nonzero conviction count in a
+  // production-shadow run is an alert — a stale descriptor or pool-memory
+  // bug was convicted instead of served (docs/OPERATIONS.md).
+  {
+    const auto ps = poolsan::counters();
+    gauge("btpu_poolsan_armed", "1 when the pool sanitizer is compiled in and enabled",
+          poolsan::armed() ? 1.0 : 0.0);
+    counter("btpu_poolsan_convictions_total",
+            "pool-memory accesses convicted by the sanitizer (all fault classes)",
+            ps.convictions);
+    counter("btpu_poolsan_stale_extent_total",
+            "accesses through stale/quarantined extents (generation mismatch)",
+            ps.stale_generation);
+    counter("btpu_poolsan_redzone_smash_total",
+            "red-zone/quarantine canary damage found at free or by the scrub sweep",
+            ps.redzone_smash);
+    counter("btpu_poolsan_double_free_total",
+            "double/wild extent frees refused by the shadow state",
+            ps.double_free);
+    gauge("btpu_poolsan_quarantine_bytes",
+          "usable bytes currently parked in the reuse quarantine",
+          static_cast<double>(ps.quarantine_bytes));
+  }
   counter("btpu_cached_bytes_total",
           "bytes served from the client object cache (zero wire bytes)",
           cache::cached_byte_count());

@@ -11,6 +11,7 @@
 
 #include "backend_base.h"
 #include "btpu/common/log.h"
+#include "btpu/common/pool_span.h"
 #include "btpu/storage/hbm_provider.h"
 
 namespace btpu::storage {
@@ -252,7 +253,10 @@ class HbmBackend : public OffsetBackendBase {
     if (len > config_.capacity || offset > config_.capacity - len)
       return ErrorCode::MEMORY_ACCESS_ERROR;
     if (uint8_t* view = host_view()) {
-      std::memcpy(view + offset, src, len);
+      auto span = poolspan::resolve(view, config_.capacity, offset, len, 0,
+                                    poolspan::Access::kWrite, config_.pool_id.c_str());
+      if (!span.ok()) return span.error();
+      std::memcpy(span.value().data(), src, len);
       return ErrorCode::OK;
     }
     const auto& provider = hbm_provider();
@@ -266,7 +270,10 @@ class HbmBackend : public OffsetBackendBase {
     if (len > config_.capacity || offset > config_.capacity - len)
       return ErrorCode::MEMORY_ACCESS_ERROR;
     if (uint8_t* view = host_view()) {
-      std::memcpy(dst, view + offset, len);
+      auto span = poolspan::resolve(view, config_.capacity, offset, len, 0,
+                                    poolspan::Access::kRead, config_.pool_id.c_str());
+      if (!span.ok()) return span.error();
+      std::memcpy(dst, span.value().data(), len);
       return ErrorCode::OK;
     }
     const auto& provider = hbm_provider();

@@ -24,6 +24,7 @@
 
 #include "backend_base.h"
 #include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
 
 namespace btpu::storage {
 
@@ -212,6 +213,17 @@ class IoUringDiskBackend : public OffsetBackendBase {
     if (len > config_.capacity || offset > config_.capacity - len)
       return ErrorCode::MEMORY_ACCESS_ERROR;
     if (len == 0) return ErrorCode::OK;
+#if defined(BTPU_POOLSAN)
+    // No host mapping to resolve a span against (file-backed tier) — the
+    // shadow-state check runs by pool name instead, so stale/quarantined
+    // extents are convicted on this tier too.
+    if (poolsan::armed()) {
+      const ErrorCode verdict = poolsan::check_access(
+          nullptr, config_.pool_id.c_str(), config_.capacity, offset, len, 0,
+          is_write ? poolsan::Access::kWrite : poolsan::Access::kRead);
+      if (verdict != ErrorCode::OK) return verdict;
+    }
+#endif
 
     const bool aligned = !odirect_active_ ||
                          ((offset % kAlign) == 0 && (len % kAlign) == 0 &&

@@ -15,6 +15,7 @@
 
 #include "backend_base.h"
 #include "btpu/common/log.h"
+#include "btpu/common/pool_span.h"
 
 namespace btpu::storage {
 
@@ -65,17 +66,19 @@ class MmapDiskBackend : public OffsetBackendBase {
 
   ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
     if (!base_) return ErrorCode::INVALID_STATE;
-    if (len > config_.capacity || offset > config_.capacity - len)
-      return ErrorCode::MEMORY_ACCESS_ERROR;
-    std::memcpy(base_ + offset, src, len);
+    auto span = poolspan::resolve(base_, config_.capacity, offset, len, 0,
+                                  poolspan::Access::kWrite, config_.pool_id.c_str());
+    if (!span.ok()) return span.error();
+    std::memcpy(span.value().data(), src, len);
     return ErrorCode::OK;
   }
 
   ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
     if (!base_) return ErrorCode::INVALID_STATE;
-    if (len > config_.capacity || offset > config_.capacity - len)
-      return ErrorCode::MEMORY_ACCESS_ERROR;
-    std::memcpy(dst, base_ + offset, len);
+    auto span = poolspan::resolve(base_, config_.capacity, offset, len, 0,
+                                  poolspan::Access::kRead, config_.pool_id.c_str());
+    if (!span.ok()) return span.error();
+    std::memcpy(dst, span.value().data(), len);
     return ErrorCode::OK;
   }
 

@@ -10,6 +10,7 @@
 
 #include "backend_base.h"
 #include "btpu/common/log.h"
+#include "btpu/common/pool_span.h"
 
 namespace btpu::storage {
 
@@ -43,17 +44,29 @@ class RamBackend : public OffsetBackendBase {
 
   ErrorCode write_at(uint64_t offset, const void* src, uint64_t len) override {
     if (!base_) return ErrorCode::INVALID_STATE;
-    if (len > config_.capacity || offset > config_.capacity - len)
-      return ErrorCode::MEMORY_ACCESS_ERROR;
-    std::memcpy(base_ + offset, src, len);
+    auto span = poolspan::resolve(base_, config_.capacity, offset, len, 0,
+                                  poolspan::Access::kWrite, config_.pool_id.c_str());
+    if (!span.ok()) return span.error();
+    std::memcpy(span.value().data(), src, len);
+#if defined(BTPU_POOLSAN)
+    // PLANTED MUTANT — 1-byte extent overrun (the neighbor-corruption class
+    // red zones exist to catch): smear one byte past the written window,
+    // the way an off-by-one length computation once would. On asan trees
+    // the poisoned red zone traps this store natively; on gcc trees the
+    // smashed canary is CONVICTED at free/scrub with a replayable report.
+    // Pinned by Poolsan.MutantOverrun.
+    if (poolsan::mutant() == poolsan::Mutant::kOverrun && offset + len < config_.capacity)
+      span.value().data()[len] = 0x5A;
+#endif
     return ErrorCode::OK;
   }
 
   ErrorCode read_at(uint64_t offset, void* dst, uint64_t len) override {
     if (!base_) return ErrorCode::INVALID_STATE;
-    if (len > config_.capacity || offset > config_.capacity - len)
-      return ErrorCode::MEMORY_ACCESS_ERROR;
-    std::memcpy(dst, base_ + offset, len);
+    auto span = poolspan::resolve(base_, config_.capacity, offset, len, 0,
+                                  poolspan::Access::kRead, config_.pool_id.c_str());
+    if (!span.ok()) return span.error();
+    std::memcpy(dst, span.value().data(), len);
     return ErrorCode::OK;
   }
 

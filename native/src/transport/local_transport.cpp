@@ -9,6 +9,7 @@
 
 #include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
+#include "btpu/common/pool_span.h"
 #include "btpu/transport/transport.h"
 
 namespace btpu::transport {
@@ -21,6 +22,7 @@ struct LocalRegion {
   uint64_t remote_base{0};  // advertised == (uintptr_t)base; 0 for virtual
   RegionReadFn read_fn;
   RegionWriteFn write_fn;
+  std::string tag;  // pool id at registration — the poolsan shadow lookup key
 };
 
 struct LocalRegistry {
@@ -56,7 +58,7 @@ class LocalTransportServer : public TransportServer {
     uint64_t rkey = reg.rng() | 1;  // nonzero
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
     const uint64_t remote_base = reinterpret_cast<uint64_t>(base);
-    reg.by_rkey[rkey] = {static_cast<uint8_t*>(base), len, remote_base, nullptr, nullptr};
+    reg.by_rkey[rkey] = {static_cast<uint8_t*>(base), len, remote_base, nullptr, nullptr, tag};
     my_rkeys_.push_back(rkey);
     RemoteDescriptor d;
     d.transport = TransportKind::LOCAL;
@@ -74,7 +76,7 @@ class LocalTransportServer : public TransportServer {
     WriterLock lock(reg.mutex);
     uint64_t rkey = reg.rng() | 1;
     while (reg.by_rkey.contains(rkey)) rkey = reg.rng() | 1;
-    reg.by_rkey[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn)};
+    reg.by_rkey[rkey] = {nullptr, len, 0, std::move(read_fn), std::move(write_fn), tag};
     my_rkeys_.push_back(rkey);
     RemoteDescriptor d;
     d.transport = TransportKind::LOCAL;
@@ -118,9 +120,12 @@ class LocalTransportServer : public TransportServer {
 // full power over the actual shared-state code (registries, object map,
 // allocator), where a report IS a bug.
 
-// Bounds+rkey-checked access used by the mux client (local kind).
+// Bounds+rkey-checked access used by the mux client (local kind). The flat
+// path resolves through poolspan::resolve — the one sanctioned base+offset
+// chokepoint — so stale-generation / quarantined-extent accesses are
+// convicted here exactly like on the TCP serving engines.
 ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
-                       bool is_write, uint32_t* crc_out) {
+                       bool is_write, uint32_t* crc_out, uint64_t extent_gen) {
   auto& reg = LocalRegistry::instance();
   uint8_t* target = nullptr;
   RegionReadFn read_fn;
@@ -136,7 +141,12 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
       return ErrorCode::MEMORY_ACCESS_ERROR;
     offset = remote_addr - region.remote_base;
     if (region.base) {
-      target = region.base + offset;
+      auto span = poolspan::resolve(region.base, region.len, offset, len, extent_gen,
+                                    is_write ? poolspan::Access::kWrite
+                                             : poolspan::Access::kRead,
+                                    region.tag.c_str());
+      if (!span.ok()) return span.error();
+      target = span.value().data();
     } else {
       read_fn = region.read_fn;
       write_fn = region.write_fn;

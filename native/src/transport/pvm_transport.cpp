@@ -54,6 +54,7 @@
 #include "btpu/common/error.h"
 #include "btpu/common/log.h"
 #include "btpu/common/crc32c.h"
+#include "btpu/common/pool_span.h"
 #include "btpu/common/stripe_counter.h"
 #include "btpu/transport/transport.h"
 
@@ -389,7 +390,7 @@ void pvm_retire_self_region(const void* base) {
 }
 
 bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf, uint64_t len,
-                bool is_write, uint32_t* crc_out) {
+                bool is_write, uint32_t* crc_out, uint64_t extent_gen, ErrorCode* fail_out) {
   if (remote.pvm_endpoint.empty() || len == 0) return false;
   PvmTarget target;
   if (!resolve(remote.pvm_endpoint, target, is_write)) return false;
@@ -413,7 +414,19 @@ bool pvm_access(const RemoteDescriptor& remote, uint64_t remote_addr, void* buf,
     // serving the old placement against it would address the wrong bytes.
     if (it != sr.regions.end() && it->second.gen == target.self_gen &&
         off <= it->second.len && len <= it->second.len - off) {
-      auto* p = reinterpret_cast<uint8_t*>(static_cast<uintptr_t>(target.base + off));
+      // The one sanctioned base+offset chokepoint, poolsan-armed in check
+      // trees: a stale placement (freed/quarantined extent, generation
+      // mismatch) is convicted HERE — and the op must FAIL with that code,
+      // not fall back to a socket lane that would only re-convict it.
+      auto span = poolspan::resolve(
+          reinterpret_cast<uint8_t*>(static_cast<uintptr_t>(target.base)), it->second.len,
+          off, len, extent_gen,
+          is_write ? poolspan::Access::kWrite : poolspan::Access::kRead);
+      if (!span.ok()) {
+        if (fail_out) *fail_out = span.error();
+        return false;
+      }
+      uint8_t* p = span.value().data();
       if (is_write) {
         if (crc_out) {
           *crc_out = crc32c_copy(p, buf, len);  // fused: hash while moving

@@ -18,6 +18,8 @@
 #include <shared_mutex>
 #include <unordered_map>
 
+#include "btpu/common/pool_span.h"
+
 #include "btpu/common/crc32c.h"
 #include "btpu/common/log.h"
 #include "btpu/transport/transport.h"
@@ -175,21 +177,30 @@ class ShmMapCache {
 }  // namespace
 
 ErrorCode shm_access(const std::string& name, uint64_t offset, void* buf, uint64_t len,
-                     bool is_write, uint32_t* crc_out) {
+                     bool is_write, uint32_t* crc_out, uint64_t extent_gen) {
   uint64_t seg_len = 0;
   uint8_t* base = ShmMapCache::instance().map(name, seg_len);
   if (!base) return ErrorCode::CONNECTION_FAILED;
-  if (len > seg_len || offset > seg_len - len) return ErrorCode::MEMORY_ACCESS_ERROR;
+  // The segment name doubles as the poolsan shadow alias (the worker
+  // aliases it to the pool id at registration): a client addressing the
+  // pool through its own mapping still gets stale/quarantined extents
+  // convicted. Addresses here are segment offsets == pool offsets.
+  auto span = poolspan::resolve(base, seg_len, offset, len, extent_gen,
+                                is_write ? poolspan::Access::kWrite
+                                         : poolspan::Access::kRead,
+                                name.c_str());
+  if (!span.ok()) return span.error();
+  uint8_t* target = span.value().data();
   if (is_write) {
     if (crc_out) {
-      *crc_out = crc32c_copy(base + offset, buf, len);  // fused: hash while moving
+      *crc_out = crc32c_copy(target, buf, len);  // fused: hash while moving
     } else {
-      std::memcpy(base + offset, buf, len);
+      std::memcpy(target, buf, len);
     }
   } else if (crc_out) {
-    *crc_out = crc32c_copy(buf, base + offset, len);  // fused: hash while moving
+    *crc_out = crc32c_copy(buf, target, len);  // fused: hash while moving
   } else {
-    std::memcpy(buf, base + offset, len);
+    std::memcpy(buf, target, len);
   }
   return ErrorCode::OK;
 }

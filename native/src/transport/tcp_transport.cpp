@@ -175,6 +175,7 @@ class TcpTransportServer : public TransportServer {
     region.base = static_cast<uint8_t*>(base);
     region.len = len;
     region.remote_base = remote_base;
+    region.tag = tag;  // poolsan shadow lookup key (pool id)
     regions_.map[rkey] = std::move(region);
     RemoteDescriptor d;
     d.transport = TransportKind::TCP;
@@ -198,6 +199,7 @@ class TcpTransportServer : public TransportServer {
     region.len = len;
     region.read_fn = std::move(read_fn);
     region.write_fn = std::move(write_fn);
+    region.tag = tag;
     regions_.map[rkey] = std::move(region);
     RemoteDescriptor d;
     d.transport = TransportKind::TCP;
@@ -410,7 +412,11 @@ class TcpTransportServer : public TransportServer {
         uint8_t* target = nullptr;
         Region virt;
         uint64_t offset = 0;
-        const bool valid = regions_.resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
+        const ErrorCode resolved = regions_.resolve(
+            hdr.addr, hdr.rkey, hdr.len, hdr.extent_gen,
+            hdr.op == kOpWriteStaged ? poolspan::Access::kWrite : poolspan::Access::kRead,
+            hdr.trace_id, target, virt, offset);
+        const bool valid = resolved == ErrorCode::OK;
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
         // Admission + deadline gate PER CHUNK: staged sub-ops arrive as a
         // pipeline of chunk headers, so a budget that expires mid-transfer
@@ -429,7 +435,10 @@ class TcpTransportServer : public TransportServer {
           }
         }
         if (!valid || !staging_bounds_ok(stg_base, stg_len, shm_off, hdr.len)) {
-          status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+          // A poolsan conviction (STALE_EXTENT) outranks the generic access
+          // error — the client must learn its descriptor is stale, not
+          // merely out of bounds.
+          status = static_cast<uint32_t>(valid ? ErrorCode::MEMORY_ACCESS_ERROR : resolved);
         } else if (status != static_cast<uint32_t>(ErrorCode::OK)) {
           // rejected above: acknowledge without touching the region
         } else if (hdr.op == kOpWriteStaged) {
@@ -467,8 +476,15 @@ class TcpTransportServer : public TransportServer {
         Region virt;
         uint64_t offset = 0;
         uint32_t status = static_cast<uint32_t>(ErrorCode::NOT_IMPLEMENTED);
-        if (!regions_.resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset) || target) {
-          status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+        const ErrorCode fab_resolved =
+            regions_.resolve(hdr.addr, hdr.rkey, hdr.len, hdr.extent_gen,
+                             poolspan::Access::kRead, hdr.trace_id, target, virt, offset);
+        if (fab_resolved != ErrorCode::OK || target) {
+          // A poolsan conviction rides through verbatim (STALE_EXTENT —
+          // the caller must refetch placements); a flat-region fabric op
+          // stays the generic access error.
+          status = static_cast<uint32_t>(
+              fab_resolved != ErrorCode::OK ? fab_resolved : ErrorCode::MEMORY_ACCESS_ERROR);
         } else if (hdr.op == kOpFabricOffer && virt.offer_fn) {
           status = static_cast<uint32_t>(virt.offer_fn(offset, hdr.len, transfer_id));
         } else if (hdr.op == kOpFabricPull && virt.pull_fn) {
@@ -483,7 +499,11 @@ class TcpTransportServer : public TransportServer {
       uint8_t* target = nullptr;
       Region virt;
       uint64_t offset = 0;
-      const bool valid = regions_.resolve(hdr.addr, hdr.rkey, hdr.len, target, virt, offset);
+      const ErrorCode resolved = regions_.resolve(
+          hdr.addr, hdr.rkey, hdr.len, hdr.extent_gen,
+          hdr.op == kOpWrite ? poolspan::Access::kWrite : poolspan::Access::kRead,
+          hdr.trace_id, target, virt, offset);
+      const bool valid = resolved == ErrorCode::OK;
 
       if (hdr.op == kOpWrite) {
         uint32_t status = static_cast<uint32_t>(ErrorCode::OK);
@@ -495,8 +515,9 @@ class TcpTransportServer : public TransportServer {
         }
         if (!valid || status != static_cast<uint32_t>(ErrorCode::OK)) {
           // Must still drain the payload to keep the stream aligned —
-          // shed/expired writes drain to a sink, never into the region.
-          if (!valid) status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+          // shed/expired/convicted writes drain to a sink, never into the
+          // region (a STALE_EXTENT resolve answers that exact code).
+          if (!valid) status = static_cast<uint32_t>(resolved);
           std::vector<uint8_t> sink(64 * 1024);
           uint64_t left = hdr.len;
           while (left > 0) {
@@ -526,7 +547,7 @@ class TcpTransportServer : public TransportServer {
         if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
       } else if (hdr.op == kOpRead) {
         if (!valid) {
-          const uint32_t status = static_cast<uint32_t>(ErrorCode::MEMORY_ACCESS_ERROR);
+          const uint32_t status = static_cast<uint32_t>(resolved);
           if (net::write_all(fd, &status, sizeof(status)) != ErrorCode::OK) return;
           continue;
         }
@@ -750,7 +771,7 @@ class TcpEndpointPool {
       ::shm_unlink(name.c_str());
       return 0;
     }
-    DataRequestHeader hdr{kOpHello, 0, 0, name.size(), 0, 0, 0};
+    DataRequestHeader hdr{kOpHello, 0, 0, name.size(), 0, 0, 0, 0};
     uint32_t status = ~0u;
     const bool ok =
         net::write_iov2(conn.sock.fd(), &hdr, sizeof(hdr), name.data(), name.size()) ==
@@ -1029,7 +1050,8 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
           std::memcpy(c.stg_base + off, sub.buf + off, n);
         }
         StagedFrame framed{{kOpWriteStaged, sub.addr + off, sub.op->rkey, n,
-                            sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id},
+                            sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id,
+                            sub.op->extent_gen},
                            off};
         if (auto ec = net::write_all(c.sock.fd(), &framed, sizeof(framed));
             ec != ErrorCode::OK)
@@ -1046,13 +1068,15 @@ ErrorCode issue_sub(const PooledConn& c, SubOp& sub, uint8_t opcode) {
     for (uint64_t off = 0; off < sub.len; off += pipe) {
       const uint64_t n = std::min(pipe, sub.len - off);
       frames[nframes++] = {{kOpReadStaged, sub.addr + off, sub.op->rkey, n,
-                            sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id},
+                            sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id,
+                            sub.op->extent_gen},
                           off};
     }
     return net::write_all(c.sock.fd(), frames, nframes * sizeof(StagedFrame));
   }
-  DataRequestHeader hdr{opcode, sub.addr,         sub.op->rkey,    sub.len,
-                        sub_budget_ms(sub), sub.op->trace_id, sub.op->span_id};
+  DataRequestHeader hdr{opcode,           sub.addr,         sub.op->rkey,
+                        sub.len,          sub_budget_ms(sub), sub.op->trace_id,
+                        sub.op->span_id,  sub.op->extent_gen};
   if (opcode == kOpWrite) {
     const ErrorCode ec = net::write_iov2(c.sock.fd(), &hdr, sizeof(hdr), sub.buf, sub.len);
     // No copy to fuse into on the plain socket lane: hash after the send so
@@ -1388,7 +1412,7 @@ ErrorCode tcp_fabric_command(const std::string& endpoint, uint8_t opcode, uint64
   const auto tctx = trace::current();
   DataRequestHeader hdr{opcode, addr, rkey, len,
                         ambient.is_infinite() ? 0 : ambient.wire_budget_ms(),
-                        tctx.trace_id, tctx.span_id};
+                        tctx.trace_id, tctx.span_id, /*extent_gen=*/0};
   uint32_t status = 0;
   // Deadline on the status read: a wedged provider on the far side must not
   // hang the caller's drain/repair thread forever — time out, drop the
@@ -1431,7 +1455,7 @@ ErrorCode tcp_fabric_pull(const std::string& endpoint, uint64_t addr, uint64_t r
 }
 
 ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
-                   uint64_t len) {
+                   uint64_t len, uint64_t extent_gen) {
   RemoteDescriptor remote;
   remote.transport = TransportKind::TCP;
   remote.endpoint = endpoint;
@@ -1440,11 +1464,12 @@ ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, vo
   const auto rctx = trace::current();
   op.trace_id = rctx.trace_id;
   op.span_id = rctx.span_id;
+  op.extent_gen = extent_gen;
   return tcp_batch(&op, 1, /*is_write=*/false, 0);
 }
 
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
-                    uint64_t len) {
+                    uint64_t len, uint64_t extent_gen) {
   RemoteDescriptor remote;
   remote.transport = TransportKind::TCP;
   remote.endpoint = endpoint;
@@ -1453,6 +1478,7 @@ ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, c
   const auto wctx = trace::current();
   op.trace_id = wctx.trace_id;
   op.span_id = wctx.span_id;
+  op.extent_gen = extent_gen;
   return tcp_batch(&op, 1, /*is_write=*/true, 0);
 }
 

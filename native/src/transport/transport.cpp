@@ -19,13 +19,13 @@ std::unique_ptr<TransportServer> make_local_transport_server();
 std::unique_ptr<TransportServer> make_tcp_transport_server();
 std::unique_ptr<TransportServer> make_shm_transport_server();
 ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t len,
-                       bool is_write, uint32_t* crc_out = nullptr);
+                       bool is_write, uint32_t* crc_out = nullptr, uint64_t extent_gen = 0);
 ErrorCode shm_access(const std::string& name, uint64_t offset, void* buf, uint64_t len,
-                     bool is_write, uint32_t* crc_out = nullptr);
+                     bool is_write, uint32_t* crc_out = nullptr, uint64_t extent_gen = 0);
 ErrorCode tcp_read(const std::string& endpoint, uint64_t addr, uint64_t rkey, void* dst,
-                   uint64_t len);
+                   uint64_t len, uint64_t extent_gen = 0);
 ErrorCode tcp_write(const std::string& endpoint, uint64_t addr, uint64_t rkey, const void* src,
-                    uint64_t len);
+                    uint64_t len, uint64_t extent_gen = 0);
 ErrorCode tcp_fabric_offer(const std::string& endpoint, uint64_t addr, uint64_t rkey,
                            uint64_t len, uint64_t transfer_id);
 ErrorCode tcp_fabric_pull(const std::string& endpoint, uint64_t addr, uint64_t rkey,
@@ -152,15 +152,23 @@ class MuxTransportClient : public TransportClient {
         // (one kernel copy, zero worker CPU) instead of the two-copy staged
         // pipeline. Only TCP descriptors consult it — LOCAL is already an
         // in-process memcpy and SHM a direct segment copy, both cheaper
-        // than a process_vm syscall. false = op proceeds on the pipeline.
+        // than a process_vm syscall. false = op proceeds on the pipeline —
+        // UNLESS the lane convicted the descriptor (poolsan): a stale
+        // placement fails HERE with the conviction code rather than paying
+        // a socket round trip to be re-convicted by the server.
+        ErrorCode convicted = ErrorCode::OK;
         if (!pvm_access(*op.remote, op.addr, op.buf, op.len, is_write,
-                        op.want_crc ? &op.crc : nullptr)) {
-          to_tcp[i] = 1;
+                        op.want_crc ? &op.crc : nullptr, op.extent_gen, &convicted)) {
+          if (convicted != ErrorCode::OK) {
+            op.status = convicted;
+          } else {
+            to_tcp[i] = 1;
+          }
         }
         return;
       }
       op.status = access(*op.remote, op.addr, op.rkey, op.buf, op.len, is_write,
-                         op.want_crc ? &op.crc : nullptr);
+                         op.want_crc ? &op.crc : nullptr, op.extent_gen);
     };
     // The wrapper (not run_one itself) owns exception containment: on a
     // pool worker an escaped exception is swallowed by the pool and the op
@@ -203,19 +211,24 @@ class MuxTransportClient : public TransportClient {
 
   static ErrorCode access(const RemoteDescriptor& remote, uint64_t addr, uint64_t rkey,
                           void* buf, uint64_t len, bool is_write,
-                          uint32_t* crc_out = nullptr) {
+                          uint32_t* crc_out = nullptr, uint64_t extent_gen = 0) {
     if (len == 0) {
       if (crc_out) *crc_out = 0;
       return ErrorCode::OK;
     }
     switch (remote.transport) {
       case TransportKind::LOCAL:
-        return local_access(addr, rkey, buf, len, is_write, crc_out);
+        return local_access(addr, rkey, buf, len, is_write, crc_out, extent_gen);
       case TransportKind::SHM:
-        return shm_access(remote.endpoint, addr, buf, len, is_write, crc_out);
+        return shm_access(remote.endpoint, addr, buf, len, is_write, crc_out, extent_gen);
       case TransportKind::TCP: {
         // Same-host one-sided lane first (see batch()); then the sockets.
-        if (pvm_access(remote, addr, buf, len, is_write, crc_out)) return ErrorCode::OK;
+        // A poolsan conviction in the lane fails the op outright — the
+        // server would only re-convict the same stale descriptor.
+        ErrorCode convicted = ErrorCode::OK;
+        if (pvm_access(remote, addr, buf, len, is_write, crc_out, extent_gen, &convicted))
+          return ErrorCode::OK;
+        if (convicted != ErrorCode::OK) return convicted;
         // Raw-framing dialect guard (socket lanes only — pvm above never
         // frames): refuse a POSITIVE version mismatch before any byte goes
         // out; 0 = pre-versioned metadata, served as today (transport.h).
@@ -224,8 +237,9 @@ class MuxTransportClient : public TransportClient {
           return ErrorCode::REMOTE_ENDPOINT_ERROR;
         // The single-op helpers route through tcp_batch, which fills crc
         // for want_crc ops; plain single ops hash post-hoc when asked.
-        const ErrorCode ec = is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len)
-                                      : tcp_read(remote.endpoint, addr, rkey, buf, len);
+        const ErrorCode ec =
+            is_write ? tcp_write(remote.endpoint, addr, rkey, buf, len, extent_gen)
+                     : tcp_read(remote.endpoint, addr, rkey, buf, len, extent_gen);
         if (ec == ErrorCode::OK && crc_out) *crc_out = crc32c(buf, len);
         return ec;
       }
@@ -279,6 +293,10 @@ bool make_wire_op(const ShardPlacement& shard, uint64_t in_off, uint8_t* buf, ui
   const auto ctx = trace::current();
   op.trace_id = ctx.trace_id;
   op.span_id = ctx.span_id;
+  // Poolsan generation stamp rides every lane this op takes (TCP header,
+  // local/shm/pvm resolve): a placement held across a free is convicted at
+  // the access site, never served as a neighbor object's bytes.
+  op.extent_gen = mem->extent_gen;
   return true;
 }
 

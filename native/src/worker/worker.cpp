@@ -4,6 +4,7 @@
 
 #include "btpu/common/config.h"
 #include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
 
 namespace btpu::worker {
 
@@ -268,6 +269,16 @@ ErrorCode WorkerService::initialize() {
           transport::pvm_register_self_region(base, pool_cfg.capacity);
       runtime.record.remote.pvm_endpoint = transport::pvm_make_endpoint(
           base, pool_cfg.capacity, /*writable=*/true, self_gen);
+      // Pool sanitizer host binding: this process OWNS the region's memory,
+      // which is what authorizes byte-level red-zone canaries / asan
+      // poisoning and lets the serving engines' resolve path find the
+      // shadow by base address. stop() unbinds BEFORE backend shutdown
+      // frees the bytes. Under the SHM transport the segment name is an
+      // alias — a same-host client addressing the pool through its own
+      // mapping still resolves the shadow by name.
+      poolsan::bind_host(pool_cfg.id, base, pool_cfg.capacity);
+      if (runtime.record.remote.transport == TransportKind::SHM)
+        poolsan::alias_pool(runtime.record.remote.endpoint, pool_cfg.id);
     } else if (const void* view = runtime.backend->host_view_base()) {
       runtime.record.remote.pvm_endpoint =
           transport::pvm_make_endpoint(view, pool_cfg.capacity, /*writable=*/false);
@@ -405,6 +416,10 @@ void WorkerService::stop() {
     // this blocks until in-flight direct copies drain (see transport.h).
     if (p.backend) {
       if (void* b = p.backend->base_address()) transport::pvm_retire_self_region(b);
+      // Unbind the poolsan host view too: unpoisons every red zone /
+      // quarantined range so recycled heap starts clean, and no canary
+      // write can touch the bytes after the backend frees them.
+      poolsan::unbind_host(p.config.id);
     }
   }
   for (auto& p : pools_) {

@@ -265,10 +265,18 @@ BTEST(Cache, ConcurrentReadersDuringInvalidationNeverTear) {
         if (!got.ok()) continue;  // overwrite gap (removed, not yet re-put)
         // Every successful read must be ENTIRELY one version: a mixed
         // buffer means an invalidation tore a concurrent cached serve.
+        // (A third byte value — e.g. 0x00 from an unwritten extent — once
+        // meant a PENDING object's placements were served; the diagnostic
+        // names the bytes so the next regression is attributable.)
         const uint8_t first = out[0];
-        if (first != 0xAA && first != 0xBB) torn.store(true);
+        if (first != 0xAA && first != 0xBB) {
+          std::printf("        torn: first byte 0x%02x (size %llu)\n", first,
+                      (unsigned long long)got.value());
+          torn.store(true);
+        }
         for (size_t i = 1; i < n; ++i) {
           if (out[i] != first) {
+            std::printf("        torn: out[0]=0x%02x out[%zu]=0x%02x\n", first, i, out[i]);
             torn.store(true);
             break;
           }

@@ -1,0 +1,471 @@
+// Pool-sanitizer tests (btpu/common/poolsan.h; docs/CORRECTNESS.md §12):
+// shadow state + generations + red zones + quarantine, the stale-descriptor
+// lifecycle through BOTH TCP serving engines, the alloc/free churn hammer,
+// and the planted-mutant matrix (overrun / stale_read / double_free — each
+// must be CONVICTED deterministically, 3/3 forked replays).
+//
+// Everything here is inert in release builds (poolsan compiled out): each
+// test opens with a compiled_in() gate and prints a skip notice — the
+// sanitizer trees (asan/tsan/sched, `make check`'s poolsan-smoke leg) run
+// the real thing.
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "btest.h"
+#include "btpu/alloc/pool_allocator.h"
+#include "btpu/client/embedded.h"
+#include "btpu/common/env.h"
+#include "btpu/common/pool_span.h"
+#include "btpu/common/poolsan.h"
+#include "btpu/storage/backend.h"
+#include "btpu/transport/transport.h"
+
+using namespace btpu;
+using namespace btpu::alloc;
+
+namespace {
+
+bool poolsan_ready(const char* test) {
+  if (poolsan::compiled_in() && poolsan::armed()) return true;
+  std::printf("        [skip] %s: poolsan not compiled in/armed (release tree)\n", test);
+  return false;
+}
+
+// Scoped env var (tests arm knobs/mutants live; poolsan reads env per call).
+struct ScopedEnv {
+  std::string name;
+  ScopedEnv(const char* n, const char* v) : name(n) { ::setenv(n, v, 1); }
+  ~ScopedEnv() { ::unsetenv(name.c_str()); }
+};
+
+MemoryPool make_pool(const std::string& id, uint64_t size, const RemoteDescriptor& remote) {
+  MemoryPool p;
+  p.id = id;
+  p.node_id = "node-ps";
+  p.size = size;
+  p.storage_class = StorageClass::RAM_CPU;
+  p.remote = remote;
+  return p;
+}
+
+// A registered region + tracked allocator over one buffer: the minimal
+// serving-side fixture (LOCAL or TCP kind).
+struct TrackedRegion {
+  std::vector<uint8_t> bytes;
+  std::unique_ptr<transport::TransportServer> server;
+  RemoteDescriptor desc;
+  std::unique_ptr<PoolAllocator> pa;
+  std::string pool_id;
+
+  ~TrackedRegion() {
+    pa.reset();  // shadow released before the host unbinds/frees
+    poolsan::unbind_host(pool_id);
+    if (server) server->stop();
+  }
+};
+
+std::unique_ptr<TrackedRegion> make_tracked(TransportKind kind, const std::string& pool_id,
+                                            uint64_t size) {
+  auto t = std::make_unique<TrackedRegion>();
+  t->pool_id = pool_id;
+  t->bytes.assign(size, 0);
+  t->server = transport::make_transport_server(kind);
+  if (t->server->start("127.0.0.1", 0) != ErrorCode::OK) return nullptr;
+  auto reg = t->server->register_region(t->bytes.data(), size, pool_id);
+  if (!reg.ok()) return nullptr;
+  t->desc = std::move(reg).value();
+  poolsan::bind_host(pool_id, t->bytes.data(), size);
+  t->pa = std::make_unique<PoolAllocator>(make_pool(pool_id, size, t->desc),
+                                          /*poolsan_track=*/true);
+  return t;
+}
+
+ShardPlacement placement_for(const TrackedRegion& t, const Range& r) {
+  ShardPlacement s;
+  s.pool_id = t.pool_id;
+  s.worker_id = "node-ps";
+  s.remote = t.desc;
+  s.storage_class = StorageClass::RAM_CPU;
+  s.length = r.length;
+  s.location = t.pa->to_memory_location(r);
+  return s;
+}
+
+ErrorCode batch_io(transport::TransportClient& client, const ShardPlacement& shard,
+                   uint8_t* buf, uint64_t len, bool is_write) {
+  transport::WireOp op;
+  if (!transport::make_wire_op(shard, 0, buf, len, op)) return ErrorCode::INTERNAL_ERROR;
+  return is_write ? client.write_batch(&op, 1) : client.read_batch(&op, 1);
+}
+
+// The stale-descriptor lifecycle against ONE serving engine: a client
+// caches a placement, the extent is freed (remove/GC shape), and re-reads
+// MUST fail STALE_EXTENT-class — never return another object's bytes —
+// both while quarantined and after the space is reused under a new
+// generation.
+void stale_lifecycle_against(TransportKind kind, const std::string& pool_id) {
+  auto t = make_tracked(kind, pool_id, 1 << 20);
+  BT_ASSERT(t != nullptr);
+  auto client = transport::make_transport_client();
+
+  auto r1 = t->pa->allocate(4096);
+  BT_ASSERT(r1.has_value());
+  ShardPlacement stale = placement_for(*t, *r1);
+  const auto* mem = std::get_if<MemoryLocation>(&stale.location);
+  BT_ASSERT(mem != nullptr && mem->extent_gen != 0);  // generation stamped
+
+  std::vector<uint8_t> data(4096, 0xAB);
+  BT_EXPECT_OK(batch_io(*client, stale, data.data(), data.size(), /*is_write=*/true));
+  std::vector<uint8_t> back(4096, 0);
+  BT_EXPECT_OK(batch_io(*client, stale, back.data(), back.size(), /*is_write=*/false));
+  BT_EXPECT(back == data);
+
+  const auto before = poolsan::counters();
+  t->pa->free(*r1, "poolsan-test");
+
+  // Quarantined: the read is convicted, and the buffer keeps its sentinel
+  // (the engine answered an error, not bytes).
+  std::vector<uint8_t> probe(4096, 0x11);
+  const ErrorCode quarantined = batch_io(*client, stale, probe.data(), probe.size(), false);
+  BT_EXPECT(quarantined == ErrorCode::STALE_EXTENT);
+  BT_EXPECT(probe == std::vector<uint8_t>(4096, 0x11));
+
+  // Drain the quarantine and reuse the space under a NEW generation + new
+  // bytes: the stale generation stamp convicts, the neighbor's bytes are
+  // never served.
+  {
+    ScopedEnv q("BTPU_POOLSAN_QUARANTINE_BYTES", "1");  // next free drains all
+    auto churn = t->pa->allocate(64);
+    BT_ASSERT(churn.has_value());
+    t->pa->free(*churn, "churn");
+  }
+  auto r2 = t->pa->allocate(4096);
+  BT_ASSERT(r2.has_value());
+  std::vector<uint8_t> fresh(4096, 0xEE);
+  ShardPlacement live = placement_for(*t, *r2);
+  BT_EXPECT_OK(batch_io(*client, live, fresh.data(), fresh.size(), /*is_write=*/true));
+
+  const ErrorCode reused = batch_io(*client, stale, probe.data(), probe.size(), false);
+  BT_EXPECT(reused == ErrorCode::STALE_EXTENT);
+  BT_EXPECT(probe == std::vector<uint8_t>(4096, 0x11));  // 0xEE never leaked
+
+  const auto after = poolsan::counters();
+  BT_EXPECT(after.stale_generation >= before.stale_generation + 2);
+  BT_EXPECT(after.convictions > before.convictions);
+  t->pa->free(*r2, "cleanup");
+}
+
+}  // namespace
+
+BTEST(Poolsan, ShadowGenerationAndQuarantineBasics) {
+  if (!poolsan_ready("ShadowGenerationAndQuarantineBasics")) return;
+  auto t = make_tracked(TransportKind::LOCAL, "ps-basics", 1 << 20);
+  BT_ASSERT(t != nullptr);
+
+  auto a = t->pa->allocate(4096);
+  auto b = t->pa->allocate(4096);
+  BT_ASSERT(a.has_value() && b.has_value());
+  const auto la = t->pa->to_memory_location(*a);
+  const auto lb = t->pa->to_memory_location(*b);
+  BT_EXPECT(la.extent_gen != 0 && lb.extent_gen != 0);
+  BT_EXPECT(la.extent_gen != lb.extent_gen);  // fresh generation per carve
+
+  // Free parks in quarantine (bytes counted, capacity still reachable).
+  const uint64_t free_before = t->pa->total_free();
+  t->pa->free(*a, "basics");
+  BT_EXPECT(poolsan::counters().quarantine_bytes >= 4096);
+  // Quarantined spans (usable + red zone) count as free: no capacity lost.
+  BT_EXPECT(t->pa->total_free() >= free_before + 4096);
+
+  // Resolve through the chokepoint: live extent OK, quarantined convicted.
+  auto live = poolspan::resolve(t->bytes.data(), t->bytes.size(), b->offset, b->length,
+                                lb.extent_gen, poolspan::Access::kRead, t->pool_id.c_str());
+  BT_EXPECT_OK(live.error());
+  auto dead = poolspan::resolve(t->bytes.data(), t->bytes.size(), a->offset, a->length,
+                                la.extent_gen, poolspan::Access::kRead, t->pool_id.c_str());
+  BT_EXPECT(dead.error() == ErrorCode::STALE_EXTENT);
+
+  // A wrong-generation stamp on a LIVE extent is convicted too (ABA).
+  auto aba = poolspan::resolve(t->bytes.data(), t->bytes.size(), b->offset, b->length,
+                               lb.extent_gen + 17, poolspan::Access::kRead,
+                               t->pool_id.c_str());
+  BT_EXPECT(aba.error() == ErrorCode::STALE_EXTENT);
+
+  // Cross-extent overrun at the access site.
+  auto over = poolspan::resolve(t->bytes.data(), t->bytes.size(), b->offset, b->length + 1,
+                                0, poolspan::Access::kRead, t->pool_id.c_str());
+  BT_EXPECT(over.error() == ErrorCode::MEMORY_ACCESS_ERROR);
+
+  // Capacity is never lost to the quarantine: a pool-sized carve drains it.
+  t->pa->free(*b, "basics");
+  auto big = t->pa->allocate((1 << 20) - 8192);
+  BT_EXPECT(big.has_value());
+  if (big) t->pa->free(*big, "basics");
+}
+
+BTEST(Poolsan, DoubleFreeIsRefusedAndConvicted) {
+  if (!poolsan_ready("DoubleFreeIsRefusedAndConvicted")) return;
+  auto t = make_tracked(TransportKind::LOCAL, "ps-dfree", 1 << 20);
+  BT_ASSERT(t != nullptr);
+  auto a = t->pa->allocate(8192);
+  BT_ASSERT(a.has_value());
+  const auto before = poolsan::counters();
+  const uint64_t free_after_first = [&] {
+    t->pa->free(*a, "first");
+    return t->pa->total_free();
+  }();
+  t->pa->free(*a, "second");  // the classic double free: REFUSED
+  BT_EXPECT_EQ(poolsan::counters().double_free, before.double_free + 1);
+  BT_EXPECT_EQ(t->pa->total_free(), free_after_first);  // free map untouched
+}
+
+BTEST(Poolsan, StaleDescriptorThreadEngine) {
+  if (!poolsan_ready("StaleDescriptorThreadEngine")) return;
+  // Pin the thread-per-connection fallback explicitly.
+  ScopedEnv eng("BTPU_IOURING_NET", "0");
+  stale_lifecycle_against(TransportKind::TCP, "ps-tcp-thread");
+}
+
+BTEST(Poolsan, StaleDescriptorUringEngine) {
+  if (!poolsan_ready("StaleDescriptorUringEngine")) return;
+  if (!transport::uring_runtime_available()) {
+    std::printf("        [skip] io_uring unavailable on this kernel\n");
+    return;
+  }
+  ScopedEnv eng("BTPU_IOURING_NET", "1");
+  stale_lifecycle_against(TransportKind::TCP, "ps-tcp-uring");
+}
+
+BTEST(Poolsan, StaleDescriptorLocalLane) {
+  if (!poolsan_ready("StaleDescriptorLocalLane")) return;
+  stale_lifecycle_against(TransportKind::LOCAL, "ps-local");
+}
+
+// Cluster-level lifecycle: a client that captured placements before a
+// remove must get STALE_EXTENT-class failures when it replays them against
+// the data plane — the exact cached-RemoteDescriptor bug class.
+BTEST(Poolsan, ClusterRemoveConvictsCapturedPlacements) {
+  if (!poolsan_ready("ClusterRemoveConvictsCapturedPlacements")) return;
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(1, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client();
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+
+  std::vector<uint8_t> data(128 * 1024);
+  for (size_t i = 0; i < data.size(); ++i) data[i] = static_cast<uint8_t>(i * 31 + 7);
+  BT_ASSERT(client->put("ps/victim", data.data(), data.size(), cfg) == ErrorCode::OK);
+  auto placements = client->get_workers("ps/victim");
+  BT_ASSERT_OK(placements);
+  BT_ASSERT(!placements.value().empty() && !placements.value()[0].shards.empty());
+  const ShardPlacement stale = placements.value()[0].shards[0];
+
+  BT_EXPECT(client->remove("ps/victim") == ErrorCode::OK);
+  // Refill the pool so the victim's extent is likely reused with new bytes.
+  std::vector<uint8_t> other(128 * 1024, 0x42);
+  BT_ASSERT(client->put("ps/squatter", other.data(), other.size(), cfg) == ErrorCode::OK);
+
+  auto raw = transport::make_transport_client();
+  std::vector<uint8_t> probe(stale.length, 0x11);
+  const ErrorCode ec = batch_io(*raw, stale, probe.data(), probe.size(), /*is_write=*/false);
+  BT_EXPECT(ec == ErrorCode::STALE_EXTENT || ec == ErrorCode::MEMORY_ACCESS_ERROR);
+  BT_EXPECT(probe == std::vector<uint8_t>(stale.length, 0x11));  // no neighbor bytes
+  cluster.stop();
+}
+
+// Quarantine-reuse hammer: alloc/free churn with live readers. The
+// invariant under the sanitizer is NO false positives — every read of an
+// extent its thread still owns succeeds byte-exact — while quarantine
+// cycling runs flat out. tsan runs this in the sanitizer suite; the
+// Sched.PoolsanQuarantineChurn fixture explores the interleavings.
+BTEST(Poolsan, QuarantineReuseHammer) {
+  if (!poolsan_ready("QuarantineReuseHammer")) return;
+  ScopedEnv q("BTPU_POOLSAN_QUARANTINE_BYTES", "16384");  // cycle hard
+  auto t = make_tracked(TransportKind::LOCAL, "ps-hammer", 1 << 20);
+  BT_ASSERT(t != nullptr);
+  const auto before = poolsan::counters();
+
+  constexpr int kThreads = 4;
+  constexpr int kIters = 250;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  threads.reserve(kThreads);
+  for (int ti = 0; ti < kThreads; ++ti) {
+    threads.emplace_back([&, ti] {
+      auto client = transport::make_transport_client();
+      for (int i = 0; i < kIters; ++i) {
+        const uint64_t len = 512 + static_cast<uint64_t>((ti * 131 + i * 17) % 2048);
+        auto r = t->pa->allocate(len);
+        if (!r) continue;  // transient pressure is fine; convictions are not
+        std::vector<uint8_t> data(len, static_cast<uint8_t>(ti * 16 + (i & 15) + 1));
+        ShardPlacement shard = placement_for(*t, *r);
+        transport::WireOp op;
+        if (!transport::make_wire_op(shard, 0, data.data(), len, op) ||
+            client->write_batch(&op, 1) != ErrorCode::OK) {
+          failures.fetch_add(1);
+        } else {
+          std::vector<uint8_t> back(len, 0);
+          transport::WireOp rop;
+          (void)transport::make_wire_op(shard, 0, back.data(), len, rop);
+          if (client->read_batch(&rop, 1) != ErrorCode::OK || back != data)
+            failures.fetch_add(1);
+        }
+        t->pa->free(*r, "hammer");
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  BT_EXPECT_EQ(failures.load(), 0);  // zero false positives under churn
+  const auto after = poolsan::counters();
+  BT_EXPECT_EQ(after.convictions, before.convictions);
+}
+
+// ---- planted-mutant matrix (BTPU_POOLSAN_MUTANT; PR 11 pattern) -----------
+// Each mutant re-injects one historical bug class in a FORKED child and
+// must be convicted deterministically on every replay. Child exit protocol:
+// 42 = convicted via counters/refusal, 7 = the bug went UNDETECTED (fails
+// the test), anything else (asan abort on the poisoned red zone) = native
+// conviction.
+
+namespace {
+
+constexpr int kConvicted = 42;
+constexpr int kUndetected = 7;
+
+// Runs `scenario` in a forked child 3x with the given mutant armed; every
+// replay must convict (exit 42, or die under asan's poison).
+void run_mutant_replays(const char* mutant, int (*scenario)()) {
+  for (int replay = 0; replay < 3; ++replay) {
+    const pid_t pid = ::fork();
+    BT_ASSERT(pid >= 0);
+    if (pid == 0) {
+      ::setenv("BTPU_POOLSAN_MUTANT", mutant, 1);
+      ::_exit(scenario());
+    }
+    int status = 0;
+    BT_ASSERT(::waitpid(pid, &status, 0) == pid);
+    const bool convicted_by_counter = WIFEXITED(status) && WEXITSTATUS(status) == kConvicted;
+    const bool convicted_by_sanitizer =
+        WIFSIGNALED(status) ||
+        (WIFEXITED(status) && WEXITSTATUS(status) != 0 && WEXITSTATUS(status) != kUndetected);
+    if (!(convicted_by_counter || convicted_by_sanitizer)) {
+      std::printf("        mutant %s replay %d NOT convicted (status 0x%x)\n", mutant,
+                  replay, status);
+    }
+    BT_EXPECT(convicted_by_counter || convicted_by_sanitizer);
+  }
+}
+
+// Mutant 1: a backend write_at smears one byte past the extent
+// (ram_backend.cpp). gcc trees convict the smashed red-zone canary at free;
+// asan trees trap the store in the poisoned red zone.
+int scenario_overrun() {
+  const uint64_t kPool = 1 << 20;
+  auto region = std::make_unique<std::vector<uint8_t>>(kPool, 0);
+  storage::BackendConfig cfg;
+  cfg.pool_id = "ps-mut-overrun";
+  cfg.capacity = kPool;
+  auto backend = storage::create_ram_backend_with_region(cfg, region->data());
+  if (!backend || backend->initialize() != ErrorCode::OK) return kUndetected;
+
+  auto server = transport::make_transport_server(TransportKind::LOCAL);
+  if (server->start("", 0) != ErrorCode::OK) return kUndetected;
+  auto reg = server->register_region(region->data(), kPool, cfg.pool_id);
+  if (!reg.ok()) return kUndetected;
+  poolsan::bind_host(cfg.pool_id, region->data(), kPool);
+  PoolAllocator pa(make_pool(cfg.pool_id, kPool, reg.value()), /*poolsan_track=*/true);
+
+  auto r = pa.allocate(4096);
+  if (!r) return kUndetected;
+  std::vector<uint8_t> data(4096, 0x77);
+  // The mutant smears data[4096] into the red zone (asan: traps HERE).
+  if (backend->write_at(r->offset, data.data(), data.size()) != ErrorCode::OK)
+    return kUndetected;
+  const auto before = poolsan::counters();
+  pa.free(*r, "mut-overrun");        // gcc: canary verify convicts here
+  (void)poolsan::scrub_canaries();   // and the scrub hook would, too
+  const bool convicted = poolsan::counters().redzone_smash > before.redzone_smash;
+  poolsan::unbind_host(cfg.pool_id);
+  return convicted ? kConvicted : kUndetected;
+}
+
+// Mutant 2: the client memoizes placements and never revalidates across a
+// remove (client.cpp get_workers). The reuse read MUST surface a
+// STALE_EXTENT-class failure, never another object's bytes.
+int scenario_stale_read() {
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(1, 8 << 20));
+  if (cluster.start() != ErrorCode::OK) return kUndetected;
+  auto client = cluster.make_client();
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  std::vector<uint8_t> data(128 * 1024, 0xA5);
+  if (client->put("mut/stale", data.data(), data.size(), cfg) != ErrorCode::OK)
+    return kUndetected;
+  auto first = client->get("mut/stale");  // memoizes the placements
+  if (!first.ok() || first.value() != data) return kUndetected;
+  if (client->remove("mut/stale") != ErrorCode::OK) return kUndetected;
+  std::vector<uint8_t> other(128 * 1024, 0x42);
+  if (client->put("mut/squatter", other.data(), other.size(), cfg) != ErrorCode::OK)
+    return kUndetected;
+
+  const auto before = poolsan::counters();
+  auto reread = client->get("mut/stale");  // mutant replays the stale memo
+  const bool convicted = !reread.ok() &&
+                         poolsan::counters().stale_generation > before.stale_generation;
+  const bool leaked = reread.ok() && reread.value() == other;  // neighbor bytes!
+  cluster.stop();
+  if (leaked) return kUndetected;
+  return convicted ? kConvicted : kUndetected;
+}
+
+// Mutant 3: RangeAllocator::free releases the first range twice. The
+// shadow refuses the second free; the pool stays consistent (a follow-up
+// put/get round-trips byte-exact).
+int scenario_double_free() {
+  client::EmbeddedCluster cluster(client::EmbeddedClusterOptions::simple(1, 8 << 20));
+  if (cluster.start() != ErrorCode::OK) return kUndetected;
+  auto client = cluster.make_client();
+  WorkerConfig cfg;
+  cfg.replication_factor = 1;
+  std::vector<uint8_t> data(128 * 1024, 0x3C);
+  if (client->put("mut/dfree", data.data(), data.size(), cfg) != ErrorCode::OK)
+    return kUndetected;
+  const auto before = poolsan::counters();
+  if (client->remove("mut/dfree") != ErrorCode::OK) return kUndetected;  // double-frees
+  if (poolsan::counters().double_free <= before.double_free) {
+    cluster.stop();
+    return kUndetected;
+  }
+  // The refused free kept the free map intact: the pool still round-trips.
+  std::vector<uint8_t> again(128 * 1024);
+  for (size_t i = 0; i < again.size(); ++i) again[i] = static_cast<uint8_t>(i * 13 + 5);
+  bool ok = client->put("mut/after", again.data(), again.size(), cfg) == ErrorCode::OK;
+  if (ok) {
+    auto back = client->get("mut/after");
+    ok = back.ok() && back.value() == again;
+  }
+  cluster.stop();
+  return ok ? kConvicted : kUndetected;
+}
+
+}  // namespace
+
+BTEST(PoolsanMutants, MutantOverrunConvicted3of3) {
+  if (!poolsan_ready("MutantOverrunConvicted3of3")) return;
+  run_mutant_replays("overrun", scenario_overrun);
+}
+
+BTEST(PoolsanMutants, MutantStaleReadConvicted3of3) {
+  if (!poolsan_ready("MutantStaleReadConvicted3of3")) return;
+  run_mutant_replays("stale_read", scenario_stale_read);
+}
+
+BTEST(PoolsanMutants, MutantDoubleFreeConvicted3of3) {
+  if (!poolsan_ready("MutantDoubleFreeConvicted3of3")) return;
+  run_mutant_replays("double_free", scenario_double_free);
+}

@@ -10,6 +10,7 @@
 
 #include "btest.h"
 #include "btpu/alloc/keystone_adapter.h"
+#include "btpu/common/poolsan.h"
 #include "btpu/alloc/range_allocator.h"
 
 using namespace btpu;
@@ -253,6 +254,10 @@ BTEST(RangeAllocator, ZeroSizeRejected) {
 }
 
 BTEST(RangeAllocator, DuplicateKeyRejectedAndRolledBack) {
+  // Byte-exact free-space/offset assertions: run untracked — red zones
+  // and quarantine deliberately change this math (poolsan tests own the
+  // tracked-math coverage).
+  poolsan::ScopedDisarm poolsan_off;
   RangeAllocator ra;
   auto pools = six_pools();
   BT_ASSERT_OK(ra.allocate(make_request("dup", 4096, 1, 1), pools));
@@ -267,6 +272,10 @@ BTEST(RangeAllocator, DuplicateKeyRejectedAndRolledBack) {
 }
 
 BTEST(RangeAllocator, FreeReturnsSpaceAndForgetsObject) {
+  // Byte-exact free-space/offset assertions: run untracked — red zones
+  // and quarantine deliberately change this math (poolsan tests own the
+  // tracked-math coverage).
+  poolsan::ScopedDisarm poolsan_off;
   RangeAllocator ra;
   auto pools = six_pools();
   BT_ASSERT_OK(ra.allocate(make_request("obj", 256 * 1024, 2, 2), pools));
@@ -346,6 +355,9 @@ BTEST(RangeAllocator, ExcludedNodesNeverSelected) {
 }
 
 BTEST(RangeAllocator, RenameMergeAndPoolRangeRemoval) {
+  // Byte-exact free-space assertions: run untracked (see the disarmed
+  // accounting tests above).
+  poolsan::ScopedDisarm poolsan_off;
   RangeAllocator ra;
   PoolMap pools = six_pools();
   BT_ASSERT_OK(ra.allocate(make_request("a", 64 * 1024, 1, 1), pools));
@@ -405,6 +417,10 @@ BTEST(RangeAllocator, SliceAffinityRanksIciPoolsFirst) {
 }
 
 BTEST(RangeAllocator, PlacementCarriesEndpointRkeyAndAbsoluteAddr) {
+  // Byte-exact free-space/offset assertions: run untracked — red zones
+  // and quarantine deliberately change this math (poolsan tests own the
+  // tracked-math coverage).
+  poolsan::ScopedDisarm poolsan_off;
   RangeAllocator ra;
   PoolMap pools;
   auto pool = make_pool("p0", "n0", 1 << 20);
@@ -490,6 +506,10 @@ BTEST(RangeAllocator, CanAllocateHonorsClassFilter) {
 }
 
 BTEST(RangeAllocator, GetFreeSpacePerClass) {
+  // Byte-exact free-space/offset assertions: run untracked — red zones
+  // and quarantine deliberately change this math (poolsan tests own the
+  // tracked-math coverage).
+  poolsan::ScopedDisarm poolsan_off;
   RangeAllocator ra;
   PoolMap pools;
   pools["hbm"] = make_pool("hbm", "n0", 1 << 20, StorageClass::HBM_TPU);
@@ -512,6 +532,10 @@ BTEST(RangeAllocator, ForgetPoolDropsItsFreeSpace) {
 }
 
 BTEST(RangeAllocator, ConcurrentAllocationsStayConsistent) {
+  // Byte-exact free-space/offset assertions: run untracked — red zones
+  // and quarantine deliberately change this math (poolsan tests own the
+  // tracked-math coverage).
+  poolsan::ScopedDisarm poolsan_off;
   RangeAllocator ra;
   auto pools = six_pools(8 << 20);
   constexpr int kThreads = 6;

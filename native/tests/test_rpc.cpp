@@ -107,13 +107,19 @@ BTEST(Rpc, FullMethodSurfaceOverTcp) {
   BT_ASSERT_OK(bstart);
   BT_EXPECT(bstart.value()[0].ok());
   BT_EXPECT(bstart.value()[1].error() == ErrorCode::OBJECT_ALREADY_EXISTS);
+  // A PENDING put is invisible to readers (committed-reads-only contract:
+  // its placements carry no CRC stamp yet, and serving them would hand out
+  // unverifiable extent bytes — the hole the pool sanitizer exposed).
+  auto bpending = c.batch_get_workers({"rpc/b1"});
+  BT_ASSERT_OK(bpending);
+  BT_EXPECT(bpending.value()[0].error() == ErrorCode::OBJECT_NOT_FOUND);
+  auto bcomplete = c.batch_put_complete({"rpc/b1"});
+  BT_ASSERT_OK(bcomplete);
+  BT_EXPECT(bcomplete.value()[0] == ErrorCode::OK);
   auto bget = c.batch_get_workers({"rpc/b1", "missing"});
   BT_ASSERT_OK(bget);
   BT_EXPECT(bget.value()[0].ok());
   BT_EXPECT(bget.value()[1].error() == ErrorCode::OBJECT_NOT_FOUND);
-  auto bcomplete = c.batch_put_complete({"rpc/b1"});
-  BT_ASSERT_OK(bcomplete);
-  BT_EXPECT(bcomplete.value()[0] == ErrorCode::OK);
   auto bcancel = c.batch_put_cancel({"rpc/b1", "missing"});
   BT_ASSERT_OK(bcancel);
   BT_EXPECT(bcancel.value()[0] == ErrorCode::OK);

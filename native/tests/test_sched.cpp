@@ -37,6 +37,7 @@
 #include <vector>
 
 #include "btest.h"
+#include "btpu/alloc/pool_allocator.h"
 #include "btpu/cache/object_cache.h"
 #include "btpu/client/client.h"
 #include "btpu/client/embedded.h"
@@ -392,6 +393,52 @@ BTEST(Sched, CacheFillInvalidateCoherence) {
 // ===========================================================================
 // SchedDfs.* — exhaustive model check of the four lock-free kernels
 // ===========================================================================
+
+BTEST(Sched, PoolsanQuarantineChurn) {
+  // The pool sanitizer's alloc/quarantine/drain state machine under every
+  // interleaving the scheduler can produce: concurrent carve/free churn
+  // against one tracked pool must never convict (no false positives), and
+  // every generation stamp a thread reads for its OWN live extent must
+  // validate. The annotated allocator + shadow mutexes are the preemption
+  // points; a lost update between free's shadow-then-map two-step and
+  // allocate's map-then-shadow stamp would surface as a conviction or a
+  // failed carve-after-drain here.
+  if (!poolsan::compiled_in() || !poolsan::armed()) {
+    std::printf("  [sched] poolsan not compiled in/armed — fixture skipped\n");
+    return;
+  }
+  run_seeds("poolsan-churn", 8, 3, 192, [] {
+    MemoryPool pool;
+    pool.id = "sched-poolsan";
+    pool.node_id = "n";
+    pool.size = 64 * 1024;
+    pool.storage_class = StorageClass::RAM_CPU;
+    pool.remote = {TransportKind::LOCAL, "local:sched-poolsan", 0x1000, "", "", "", 0};
+    const auto before = poolsan::counters();
+    ::setenv("BTPU_POOLSAN_QUARANTINE_BYTES", "4096", 1);  // cycle hard
+    {
+      alloc::PoolAllocator pa(pool, /*poolsan_track=*/true);
+      auto body = [&](uint32_t id) {
+        sched::Enroll enroll(id);
+        for (int i = 0; i < 3; ++i) {
+          auto r = pa.allocate(1024 + 512 * id);
+          BTPU_SCHED_YIELD();
+          if (!r) continue;  // transient pressure is legal; convictions are not
+          const auto loc = pa.to_memory_location(*r);
+          BT_EXPECT(loc.extent_gen != 0);  // own live extent always stamped
+          pa.free(*r, "sched-churn");
+        }
+      };
+      std::thread a(body, 0), b(body, 1), c(body, 2);
+      a.join();
+      b.join();
+      c.join();
+    }
+    ::unsetenv("BTPU_POOLSAN_QUARANTINE_BYTES");
+    const auto after = poolsan::counters();
+    BT_EXPECT_EQ(after.convictions, before.convictions);  // zero false positives
+  });
+}
 
 namespace {
 

@@ -60,7 +60,7 @@ bool engine_on() { return uring_active_loop_count() > 0; }
 DataRequestHeader make_read_header(uint64_t addr, uint64_t rkey, uint64_t len,
                                    uint32_t deadline_ms = 0, uint64_t trace_id = 0,
                                    uint64_t span_id = 0) {
-  return DataRequestHeader{kOpRead, addr, rkey, len, deadline_ms, trace_id, span_id};
+  return DataRequestHeader{kOpRead, addr, rkey, len, deadline_ms, trace_id, span_id, 0};
 }
 
 }  // namespace
@@ -310,11 +310,11 @@ BTEST(Uring, HostileBytesDropConnectionImmediately) {
     BT_EXPECT(net::read_exact(sock.value().fd(), &b, 1) != ErrorCode::OK);  // EOF
   };
 
-  DataRequestHeader bad_op{99, 0, 0, 16, 0, 0, 0};
+  DataRequestHeader bad_op{99, 0, 0, 16, 0, 0, 0, 0};
   expect_eof_after(&bad_op, sizeof(bad_op));
   DataRequestHeader huge_len = make_read_header(0, parse_rkey(reg.value()), 1ull << 62);
   expect_eof_after(&huge_len, sizeof(huge_len));
-  DataRequestHeader bad_hello{kOpHello, 0, 0, 0, 0, 0, 0};  // hello name len 0
+  DataRequestHeader bad_hello{kOpHello, 0, 0, 0, 0, 0, 0, 0};  // hello name len 0
   expect_eof_after(&bad_hello, sizeof(bad_hello));
 
   // Dribbled-but-valid header: the engine accumulates partial reads and

@@ -113,12 +113,12 @@ BTEST(WireFuzzCorpus, V1PoolRecordRejectsTrailingGarbage) {
 BTEST(WireFuzzCorpus, TcpHeaderRejectsHostileOpAndLength) {
   using namespace transport::datawire;
   auto raw = [](uint8_t op, uint64_t len) {
-    DataRequestHeader h{op, 0x1000, 0xBEEF, len, 0, 0, 0};
+    DataRequestHeader h{op, 0x1000, 0xBEEF, len, 0, 0, 0, 0};
     std::vector<uint8_t> v(sizeof(h));
     std::memcpy(v.data(), &h, sizeof(h));
     return v;
   };
-  constexpr size_t kHdr = sizeof(DataRequestHeader);  // 45 since the trace fields
+  constexpr size_t kHdr = sizeof(DataRequestHeader);  // 53 since extent_gen
   DataRequestHeader hdr{};
   // Pre-hardening the server read the packed struct straight off the
   // socket: any op byte was dispatched, and a forged len drove a
@@ -130,11 +130,13 @@ BTEST(WireFuzzCorpus, TcpHeaderRejectsHostileOpAndLength) {
   BT_EXPECT(!decode_request_header(raw(kOpHello, 0).data(), kHdr, hdr));       // empty name
   BT_EXPECT(!decode_request_header(raw(kOpHello, 4096).data(), kHdr, hdr));    // name > 255
   BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), kHdr - 1, hdr));   // truncated
-  // A legacy 29-byte (pre-trace) header is TRUNCATED under the
-  // ship-together contract — rejected, never mis-decoded into garbage ids.
+  // A legacy 29-byte (pre-trace) or 45-byte (pre-poolsan) header is
+  // TRUNCATED under the ship-together contract — rejected, never
+  // mis-decoded into garbage ids/generations.
   BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), 29, hdr));
+  BT_EXPECT(!decode_request_header(raw(kOpRead, 16).data(), 45, hdr));
   // Staged frames: wrong inner op rejected, truncation rejected.
-  StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 4096, 0, 0, 0}, 0x100};
+  StagedFrame f{{kOpWriteStaged, 0x1000, 0xBEEF, 4096, 0, 0, 0, 0}, 0x100};
   std::vector<uint8_t> fv(sizeof(f));
   std::memcpy(fv.data(), &f, sizeof(f));
   StagedFrame out{};

@@ -53,7 +53,9 @@ RemoteDescriptor canon_remote() {
   return d;
 }
 
-MemoryLocation canon_memloc() { return {0x2222, 0x3333, 0x44}; }
+// extent_gen appended (poolsan generation stamp) — nonzero here so the
+// golden row pins the field's encoding, not just its presence.
+MemoryLocation canon_memloc() { return {0x2222, 0x3333, 0x44, 0x55}; }
 FileLocation canon_fileloc() { return {"/f", 0x55}; }
 DeviceLocation canon_devloc() { return {"tpu:0", 9, 0x66, 0x77}; }
 

@@ -415,6 +415,52 @@ def rule_atomic_ordering(report: Report):
             )
 
 
+# ---- rule: pool-span-only --------------------------------------------------
+# Pool-base pointer arithmetic is allowed in exactly ONE place:
+# poolspan::resolve (btpu/common/pool_span.h), where it is bounds-proved and
+# (in -DBTPU_POOLSAN trees) shadow-checked. A raw `base + offset` anywhere
+# else reopens the neighbor-corruption hole the sanitizer closes — stale
+# descriptors and off-by-ones would dereference unvetted again. Patterns:
+# member/field pool bases (`.base +`, `->base +`, `base_ +`), the backends'
+# host view (`host_view() +`), and any arithmetic on base_address().
+# Deliberately NOT matched: `remote_base + off` (u64 wire-address math, no
+# pointer is formed) and `stg_base + off` (client-created staging segments
+# are not pool memory).
+
+POOL_BASE_ARITH = re.compile(
+    r"(?:\.base|->base|\bbase_|host_view\(\))\s*\+(?!\+)"
+)
+BASE_ADDRESS_ARITH = re.compile(r"base_address\(\)\s*(?:\)\s*)?\+(?!\+)")
+POOL_SPAN_ALLOW = {
+    # The chokepoint itself and the shadow that backs it.
+    "include/btpu/common/pool_span.h",
+    "src/common/poolsan.cpp",
+    # Remote-space address math for process_vm_readv/writev iovecs: the sum
+    # names an address in ANOTHER process and is never dereferenced here
+    # (the self-region direct lane resolves through pool_span).
+    "src/transport/pvm_transport.cpp",
+}
+
+
+def rule_pool_span(report: Report):
+    for p in src_files(scopes=["src", "include", "exe"]):
+        rel = str(p.relative_to(NATIVE))
+        if rel in POOL_SPAN_ALLOW:
+            continue
+        stripped = read_stripped(p).splitlines()
+        for i, line in enumerate(stripped):
+            m = POOL_BASE_ARITH.search(line) or BASE_ADDRESS_ARITH.search(line)
+            if not m:
+                continue
+            report.flag(
+                "pool-span-only", p, i + 1,
+                "raw pool-base pointer arithmetic — resolve the extent "
+                "through poolspan::resolve (btpu/common/pool_span.h), the "
+                "one bounds-proved + shadow-checked chokepoint "
+                "(docs/CORRECTNESS.md §12)",
+            )
+
+
 # ---- optional libclang refinement -----------------------------------------
 
 
@@ -471,6 +517,34 @@ def try_libclang(report: Report) -> bool:
                             "mutex-annotated-only/ast", p, cur.location.line,
                             f"alias-hidden raw mutex type: {spelling}",
                         )
+                elif cur.kind == cindex.CursorKind.BINARY_OPERATOR:
+                    # pool-span-only, alias-hidden: pointer-typed `+` whose
+                    # operand tokens name a pool base the pattern pass could
+                    # miss (`auto* b = region.base; ... b + off`) — only the
+                    # direct spellings are checkable cheaply, so this pass
+                    # re-derives the same judgement from the AST: a binary +
+                    # yielding a pointer with a base-ish token on the line.
+                    if rel in POOL_SPAN_ALLOW:
+                        continue
+                    if "*" not in cur.type.get_canonical().spelling:
+                        continue
+                    toks = [t.spelling for t in cur.get_tokens()]
+                    if "+" not in toks:
+                        continue
+                    if not any(t in ("base", "base_") or t == "base_address"
+                               for t in toks):
+                        continue
+                    line_no = cur.location.line
+                    line_text = (raw_lines(p)[line_no - 1]
+                                 if line_no <= len(raw_lines(p)) else "")
+                    if POOL_BASE_ARITH.search(line_text) or \
+                            BASE_ADDRESS_ARITH.search(line_text):
+                        continue  # the pattern pass already judged this line
+                    report.flag(
+                        "pool-span-only/ast", p, line_no,
+                        "pointer arithmetic on a pool base (AST) — resolve "
+                        "through poolspan::resolve (pool_span.h)",
+                    )
                 elif cur.kind == cindex.CursorKind.DECL_REF_EXPR:
                     # Alias-hidden weak orderings: a DECL_REF to one of the
                     # std::memory_order constants on a line the pattern pass
@@ -504,6 +578,7 @@ def main() -> int:
     rule_nodiscard(report)
     rule_trace_span(report)
     rule_atomic_ordering(report)
+    rule_pool_span(report)
     mode = "libclang+patterns" if try_libclang(report) else "patterns"
     if report.violations:
         print(f"btpu_lint ({mode}): {len(report.violations)} violation(s)",
@@ -513,7 +588,7 @@ def main() -> int:
         return 1
     print(f"btpu_lint ({mode}): clean "
           "(mutex/env/steady-clock/wire-golden/nodiscard/trace-span/"
-          "atomic-ordering invariants hold)")
+          "atomic-ordering/pool-span-only invariants hold)")
     return 0
 
 

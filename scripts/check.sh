@@ -144,6 +144,27 @@ else
     ./build/asan/btpu_tests --filter=Sched
 fi
 
+# Pool-sanitizer smoke: the full native suite on the asan tree with
+# BTPU_POOLSAN=1 FORCED (red zones + quarantine + generation checks armed on
+# every pool in every test) — the asan leg above already arms it by default,
+# this leg pins the explicit dial and catches an accidentally-disarmed tree.
+# SKIP never PASS when the asan binary is missing; BTPU_CHECK_POOLSAN_FILTERS
+# narrows for bounded CI smokes (nightly runs the full suite + bb-soak armed).
+if [ "${BTPU_CHECK_POOLSAN:-1}" = "0" ]; then
+  results[poolsan-smoke]="SKIP (disabled via BTPU_CHECK_POOLSAN=0 — pools ran unshadowed)"
+elif [ ! -x build/asan/btpu_tests ]; then
+  results[poolsan-smoke]=FAIL
+  overall=1
+  echo "check: poolsan-smoke FAIL — build/asan/btpu_tests missing (asan leg did not build)" >&2
+else
+  if [ -n "${BTPU_CHECK_POOLSAN_FILTERS:-}" ]; then
+    run_leg "poolsan-smoke" env BTPU_POOLSAN=1 BTPU_SCHED_MUTANTS=0 \
+      ./build/asan/btpu_tests --filter="${BTPU_CHECK_POOLSAN_FILTERS}"
+  else
+    run_leg "poolsan-smoke" env BTPU_POOLSAN=1 BTPU_SCHED_MUTANTS=0 ./build/asan/btpu_tests
+  fi
+fi
+
 echo
 echo "===================================================================="
 echo "== check: summary"
@@ -152,7 +173,8 @@ for leg in build lint-invariants lint-capi-check lint-tsa-sweep \
            lint-compileall lint-mypy lint-ruff capi-selftest native-suite \
            iouring-net-0-uring iouring-net-0-transport \
            iouring-net-0-remote-lane iouring-net-1-uring iouring-net-1-remote-lane \
-           tier1-pytest asan tsan fuzz-smoke crash-smoke sched-smoke; do
+           tier1-pytest asan tsan fuzz-smoke crash-smoke sched-smoke \
+           poolsan-smoke; do
   [ -n "${results[$leg]:-}" ] && printf '  %-18s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

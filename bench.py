@@ -911,6 +911,37 @@ def main() -> int:
         )
     except Exception as exc:
         print(f"overload row skipped: {exc}", file=sys.stderr)
+    # Client op-core row (ISSUE 16): the completion-based async core. Three
+    # acceptance signals in one in-process run: >= 1000 concurrent ops in
+    # flight from ONE submitter thread (in-flight ops are completion-queue
+    # entries, not threads), async beats the thread-per-op shape it replaced
+    # (same gets, same run, so box noise cancels), and optimistic reads take
+    # ZERO keystone turns on the happy path (the keystone's own gets counter,
+    # not an inference) while a rewrite still revalidates to the new bytes.
+    core_row: dict[str, Any] = {}
+    try:
+        r = subprocess.run(
+            [str(binary), "--client-core", "--embedded", "2", "--size",
+             str(16 << 10), "--iterations", "1500", "--json"],
+            capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr[-300:])
+        core_row = json.loads(r.stdout.strip().splitlines()[-1])
+        print(
+            f"client core (async completion core, 16KiB gets): "
+            f"{core_row['async_inflight_peak']} ops in flight from one thread | "
+            f"async {core_row['async_ops_per_s']:.0f} ops/s vs thread-per-op "
+            f"{core_row['thread_per_op_ops_per_s']:.0f} ops/s "
+            f"({core_row['async_vs_thread_x']:.2f}x) | optimistic get p50 "
+            f"{core_row['optimistic_p50_us']:.1f}us, "
+            f"{core_row['optimistic_keystone_turns']} keystone turns/300 reads "
+            f"({core_row['optimistic_hits']} cache-served), rewrite revalidated="
+            f"{bool(core_row['reval_ok'])}",
+            file=sys.stderr,
+        )
+    except Exception as exc:
+        print(f"client core row skipped: {exc}", file=sys.stderr)
     # Durable-put row (ISSUE 7): acked==durable inline puts vs gets through
     # real keystone RPC over a PERSISTED coordinator (group-commit WAL).
     # Both ops pay one control RPC; the put's ack additionally waits for its
@@ -1208,6 +1239,21 @@ def main() -> int:
             overload["hedge_p99_improvement_x"], 1)
         summary["hedges_fired"] = overload["hedges_fired"]
         summary["hedge_wins"] = overload["hedge_wins"]
+    # Client op-core headline (ISSUE 16 acceptance): single-thread in-flight
+    # floor, async-vs-thread-per-op A/B, and the optimistic-read zero-
+    # keystone-turn proof + rewrite revalidation verdict.
+    if core_row:
+        summary["client_core_inflight_peak"] = core_row["async_inflight_peak"]
+        summary["client_core_async_ops_per_s"] = round(core_row["async_ops_per_s"])
+        summary["client_core_thread_per_op_ops_per_s"] = round(
+            core_row["thread_per_op_ops_per_s"])
+        summary["client_core_async_vs_thread_x"] = round(
+            core_row["async_vs_thread_x"], 2)
+        summary["optimistic_get_p50_us"] = round(core_row["optimistic_p50_us"], 1)
+        summary["optimistic_get_p99_us"] = round(core_row["optimistic_p99_us"], 1)
+        summary["optimistic_keystone_turns_300_reads"] = core_row[
+            "optimistic_keystone_turns"]
+        summary["optimistic_reval_ok"] = bool(core_row["reval_ok"])
     # Durable-put headline (ISSUE 7 acceptance): acked==durable inline put
     # vs get p99 through rpc over a persisted coordinator, group commit vs
     # sync-per-record, plus the scheduler-noise-free batching proof

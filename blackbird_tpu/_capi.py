@@ -84,6 +84,15 @@ SIGNATURES: Final[dict[str, tuple[str, tuple[str, ...]]]] = {
     "btpu_get_many": ("i32", ("ptr", "u32", "cstr*", "ptr*", "u64*", "u64*",
                               "i32*")),
     "btpu_sizes_many": ("i32", ("ptr", "u32", "cstr*", "u64*", "i32*")),
+    # -- async batched I/O (client op core) ----------------------------------
+    "btpu_get_many_async": ("ptr", ("ptr", "u32", "cstr*", "ptr*", "u64*")),
+    "btpu_put_many_async": ("ptr", ("ptr", "u32", "cstr*", "ptr*", "u64*",
+                                    "u32", "u32", "u32")),
+    "btpu_async_batch_done": ("i32", ("ptr",)),
+    "btpu_async_batch_wait": ("i32", ("ptr", "u32")),
+    "btpu_async_batch_cancel": ("void", ("ptr",)),
+    "btpu_async_batch_results": ("i32", ("ptr", "i32*", "u64*")),
+    "btpu_async_batch_free": ("void", ("ptr",)),
     "btpu_placements_json": ("i32", ("ptr", "cstr", "cstr", "u64", "u64*")),
     "btpu_drain_worker": ("i32", ("ptr", "cstr", "u64*")),
     # -- lane scoreboard -----------------------------------------------------
@@ -112,6 +121,15 @@ SIGNATURES: Final[dict[str, tuple[str, tuple[str, ...]]]] = {
     "btpu_breaker_trip_count": _COUNTER,
     "btpu_breaker_skip_count": _COUNTER,
     "btpu_persist_retry_backlog": _COUNTER,
+    # -- client op-core scoreboard -------------------------------------------
+    "btpu_client_inflight_ops": _COUNTER,
+    "btpu_client_peak_inflight_ops": _COUNTER,
+    "btpu_client_cq_depth": _COUNTER,
+    "btpu_client_ops_submitted_count": _COUNTER,
+    "btpu_client_ops_completed_count": _COUNTER,
+    "btpu_client_ops_cancelled_count": _COUNTER,
+    "btpu_optimistic_hit_count": _COUNTER,
+    "btpu_optimistic_revalidate_count": _COUNTER,
     # -- pool sanitizer ------------------------------------------------------
     "btpu_poolsan_armed": _COUNTER,
     "btpu_poolsan_conviction_count": _COUNTER,
@@ -252,6 +270,7 @@ class ErrorCode(enum.IntEnum):
     CLIENT_DISCONNECTED = 6003
     SESSION_EXPIRED = 6004
     INVALID_CLIENT_STATE = 6005
+    OPERATION_CANCELLED = 6006
 
     # Config (7000-7999)
     CONFIG_ERROR = 7000

@@ -36,6 +36,70 @@ def _bytes_addr(b: bytes) -> ctypes.c_void_p:
     return ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
 
 
+class AsyncBatch:
+    """One in-flight async batch on the client op core.
+
+    Returned by Client.get_many_async / put_many_async. The batch owns its
+    item buffers (kept alive until the native side reports completion), so
+    the caller only holds this object: poll done(), block on wait(), or call
+    result() — which waits, raises on the first failed item (same contract
+    as the sync batch calls), and for gets returns the bytes in key order.
+    close() cancels a still-running batch and waits it out before freeing
+    the native handle (buffer safety); dropping the last reference does the
+    same via __del__."""
+
+    def __init__(self, handle: int, keys: list[str],
+                 buffers: list[bytes] | None, keep_alive: list[Any]) -> None:
+        self._handle: int | None = handle
+        self._keys = keys
+        self._buffers = buffers  # get batches only; None for puts
+        self._keep_alive = keep_alive
+
+    def _live(self) -> int:
+        if self._handle is None:
+            raise RuntimeError("async batch is closed")
+        return self._handle
+
+    def done(self) -> bool:
+        return bool(lib.btpu_async_batch_done(self._live()))
+
+    def wait(self, timeout_ms: int = 0) -> bool:
+        """Blocks until the batch completes; False on timeout (0 = forever;
+        the batch keeps running after a timeout)."""
+        return bool(lib.btpu_async_batch_wait(self._live(), timeout_ms))
+
+    def cancel(self) -> None:
+        """Best-effort: stages not yet run are skipped; items the op never
+        reached raise OPERATION_CANCELLED from result()."""
+        lib.btpu_async_batch_cancel(self._live())
+
+    def result(self) -> list[bytes] | None:
+        """Waits for completion, raises on the first failed item, and
+        returns the fetched bytes in key order (None for put batches)."""
+        handle = self._live()
+        self.wait()
+        n = len(self._keys)
+        codes = (ctypes.c_int32 * n)()
+        out_sizes = (ctypes.c_uint64 * n)()
+        check(lib.btpu_async_batch_results(handle, codes, out_sizes), "async batch")
+        for i, key in enumerate(self._keys):
+            check(codes[i], f"async {key!r}")
+        if self._buffers is None:
+            return None
+        return [b if out_sizes[i] == len(b) else b[: out_sizes[i]]
+                for i, b in enumerate(self._buffers)]
+
+    def close(self) -> None:
+        if self._handle is not None:
+            # The native free cancels + waits a still-running batch, so the
+            # buffers this object keeps alive are safe to release after.
+            lib.btpu_async_batch_free(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        self.close()
+
+
 class Client:
     """put/get/exists/remove against an embedded or remote cluster.
 
@@ -283,6 +347,60 @@ class Client:
         return [b if out_sizes[i] == len(b) else b[: out_sizes[i]]
                 for i, b in enumerate(buffers)]
 
+    def get_many_async(self, keys: list[str]) -> AsyncBatch:
+        """Async batched get: one synchronous keystone size probe to size
+        the buffers (served locally for cached/hot keys), then the data
+        movement rides the client op core and this call returns immediately
+        — one thread can keep thousands of batches in flight. Read the
+        bytes with AsyncBatch.result()."""
+        n = len(keys)
+        sizes = (ctypes.c_uint64 * n)()
+        codes = (ctypes.c_int32 * n)()
+        ckeys = (ctypes.c_char_p * n)(*[k.encode() for k in keys])
+        check(lib.btpu_sizes_many(self._handle, n, ckeys, sizes, codes), "sizes_many")
+        for i, key in enumerate(keys):
+            check(codes[i], f"get {key!r}")
+        buffers = [_uninit_bytes(sizes[i]) for i in range(n)]
+        bufs = (ctypes.c_void_p * n)(*[_bytes_addr(b) for b in buffers])
+        handle = lib.btpu_get_many_async(self._handle, n, ckeys, bufs, sizes)
+        assert handle is not None  # NULL only on invalid args; ours are built here
+        return AsyncBatch(handle, keys, buffers, keep_alive=[buffers])
+
+    def put_many_async(
+        self,
+        items: dict[str, Buffer],
+        *,
+        replicas: int = 1,
+        max_workers: int = 4,
+        preferred_class: StorageClass | None = None,
+    ) -> AsyncBatch:
+        """Async batched put: returns immediately with the batch in flight
+        on the client op core. The payloads are kept alive by the returned
+        AsyncBatch; call result() (or wait()) to confirm the writes."""
+        n = len(items)
+        keys = (ctypes.c_char_p * n)()
+        bufs = (ctypes.c_void_p * n)()
+        sizes = (ctypes.c_uint64 * n)()
+        keep_alive: list[bytes | AnyArray] = []
+        for i, (key, data) in enumerate(items.items()):
+            if isinstance(data, np.ndarray):
+                data = np.ascontiguousarray(data)
+                keep_alive.append(data)
+                bufs[i] = data.ctypes.data_as(ctypes.c_void_p)
+                sizes[i] = data.nbytes
+            else:
+                data = bytes(data)
+                keep_alive.append(data)
+                bufs[i] = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
+                sizes[i] = len(data)
+            keys[i] = key.encode()
+        handle = lib.btpu_put_many_async(
+            self._handle, n, keys, bufs, sizes, replicas, max_workers,
+            int(preferred_class) if preferred_class else 0,
+        )
+        assert handle is not None  # NULL only on invalid args; ours are built here
+        return AsyncBatch(handle, list(items.keys()), None, keep_alive=[keep_alive])
+
     def list(self, prefix: str = "", limit: int = 0) -> list[dict[str, Any]]:
         """Complete objects whose key starts with `prefix`, lexicographic:
         [{"key", "size", "copies", "soft_pin"}]. limit 0 = unlimited. No
@@ -425,6 +543,20 @@ class Client:
             "hist_get_count": "btpu_op_get_count",
             "hist_get_p50_us": "btpu_op_get_p50_us",
             "hist_get_p99_us": "btpu_op_get_p99_us",
+            # Client op core (the completion-based async engine behind
+            # get_many_async/put_many_async and lane-hosted hedge
+            # primaries): inflight/cq_depth are gauges, the rest monotonic.
+            "client_inflight_ops": "btpu_client_inflight_ops",
+            "client_peak_inflight_ops": "btpu_client_peak_inflight_ops",
+            "client_cq_depth": "btpu_client_cq_depth",
+            "client_ops_submitted": "btpu_client_ops_submitted_count",
+            "client_ops_completed": "btpu_client_ops_completed_count",
+            "client_ops_cancelled": "btpu_client_ops_cancelled_count",
+            # FaRM-style optimistic reads: placement-cache serves with zero
+            # keystone turns, and revalidation retries after a cached
+            # attempt failed (BTPU_OPTIMISTIC_READS=1 arms the lane).
+            "optimistic_hits": "btpu_optimistic_hit_count",
+            "optimistic_revalidates": "btpu_optimistic_revalidate_count",
             # Observability plumbing health: flight-recorder events and
             # trace spans recorded in this process.
             "flight_events": "btpu_flight_event_count",

@@ -157,6 +157,18 @@ class NativeAPI(Protocol):
                       out_codes: I32Out) -> int: ...
     def btpu_sizes_many(self, client: Handle, n: int, keys: CStrArr,
                         out_sizes: U64Out, out_codes: I32Out) -> int: ...
+    # -- async batched I/O (client op core) ----------------------------------
+    def btpu_get_many_async(self, client: Handle, n: int, keys: CStrArr,
+                            bufs: PtrArr, buf_sizes: U64Out) -> int | None: ...
+    def btpu_put_many_async(self, client: Handle, n: int, keys: CStrArr,
+                            bufs: PtrArr, sizes: U64Out, replicas: int,
+                            max_workers: int, preferred_class: int) -> int | None: ...
+    def btpu_async_batch_done(self, batch: Handle) -> int: ...
+    def btpu_async_batch_wait(self, batch: Handle, timeout_ms: int) -> int: ...
+    def btpu_async_batch_cancel(self, batch: Handle) -> None: ...
+    def btpu_async_batch_results(self, batch: Handle, out_codes: I32Out,
+                                 out_sizes: U64Out) -> int: ...
+    def btpu_async_batch_free(self, batch: Handle) -> None: ...
     def btpu_placements_json(self, client: Handle, key: CStr, buffer: CStr,
                              buffer_size: int, out_len: U64Out) -> int: ...
     def btpu_drain_worker(self, client: Handle, worker_id: CStr,
@@ -187,6 +199,15 @@ class NativeAPI(Protocol):
     def btpu_breaker_trip_count(self) -> int: ...
     def btpu_breaker_skip_count(self) -> int: ...
     def btpu_persist_retry_backlog(self) -> int: ...
+    # -- client op-core scoreboard -------------------------------------------
+    def btpu_client_inflight_ops(self) -> int: ...
+    def btpu_client_peak_inflight_ops(self) -> int: ...
+    def btpu_client_cq_depth(self) -> int: ...
+    def btpu_client_ops_submitted_count(self) -> int: ...
+    def btpu_client_ops_completed_count(self) -> int: ...
+    def btpu_client_ops_cancelled_count(self) -> int: ...
+    def btpu_optimistic_hit_count(self) -> int: ...
+    def btpu_optimistic_revalidate_count(self) -> int: ...
     # -- pool sanitizer ------------------------------------------------------
     def btpu_poolsan_armed(self) -> int: ...
     def btpu_poolsan_conviction_count(self) -> int: ...

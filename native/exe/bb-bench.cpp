@@ -16,6 +16,7 @@
 #include <thread>
 
 #include "btpu/client/embedded.h"
+#include "btpu/client/op_core.h"
 #include "btpu/common/pool_span.h"
 #include "btpu/common/trace.h"
 #include "btpu/rpc/rpc_server.h"
@@ -73,6 +74,7 @@ int main(int argc, char** argv) {
   bool poolsan_ab = false;  // pool-span resolve microbench (release-overhead guard)
   bool control_plane = false;  // metadata ops/sec closed loop, no data plane
   bool overload = false;  // slow-worker tail row: hedging off vs on
+  bool client_core = false;  // async op-core rows: in-flight floor, A/B, optimistic
   bool durable_put = false;  // acked==durable inline puts vs gets (WAL group commit)
   int64_t window_us = -1;    // --durable-put WAL window (-1 = env/500 default)
   std::string data_dir;      // --durable-put persist dir ("" = fresh tmp)
@@ -105,6 +107,7 @@ int main(int argc, char** argv) {
       prefix = argv[++i];  // key namespace: lets N bb-bench PROCESSES share a cluster
     else if (!std::strcmp(argv[i], "--control-plane")) control_plane = true;
     else if (!std::strcmp(argv[i], "--overload")) overload = true;
+    else if (!std::strcmp(argv[i], "--client-core")) client_core = true;
     else if (!std::strcmp(argv[i], "--durable-put")) durable_put = true;
     else if (!std::strcmp(argv[i], "--window-us") && i + 1 < argc)
       window_us = std::stoll(argv[++i]);
@@ -135,6 +138,9 @@ int main(int argc, char** argv) {
           "                       report aggregate GB/s + merged percentiles\n"
           "       [--control-plane]  metadata ops/sec closed loop\n"
           "                       (put_start/get_workers/put_cancel/exists)\n"
+          "       [--client-core] async op-core rows: single-thread in-flight\n"
+          "                       floor, async vs thread-per-op A/B, optimistic\n"
+          "                       read RTT with keystone-turn accounting\n"
           "       [--durable-put] acked==durable inline-put vs get latency over a\n"
           "                       persisted coordinator ([--window-us US] group-commit\n"
           "                       window, 0 = fdatasync per record; [--data-dir D])\n"
@@ -371,6 +377,178 @@ int main(int argc, char** argv) {
     if (no_verify) c->set_verify_reads(false);
     return c;
   };
+
+  if (client_core) {
+    // Async op-core rows (ISSUE 16 acceptance, bench.py "client core" line):
+    //   1. in-flight floor: ONE submitter thread parks >= 1000 concurrent
+    //      async gets in the completion core before the first wait;
+    //   2. async vs thread-per-op A/B, same run, same gets: the completion
+    //      core against the one-thread-per-op shape it replaced;
+    //   3. optimistic-read RTT: cached-placement reads with the keystone
+    //      turn counter proving the happy path takes ZERO metadata round
+    //      trips, then a rewrite proving revalidation returns the new bytes.
+    if (!cluster) {
+      std::fprintf(stderr, "--client-core needs --embedded N\n");
+      return 1;
+    }
+    auto& cc = client::client_core_counters();
+    const int n_ops = std::max(1, iterations);
+    constexpr int kKeys = 64;
+    std::vector<uint8_t> data(size);
+    for (uint64_t i = 0; i < size; ++i) data[i] = static_cast<uint8_t>(i * 131 + 29);
+    std::vector<client::ObjectClient::PutItem> seed;
+    std::vector<std::string> keys;
+    keys.reserve(kKeys);
+    for (int i = 0; i < kKeys; ++i)
+      keys.push_back(prefix + "/core/" + std::to_string(i));
+    for (const auto& key : keys) seed.push_back({key, data.data(), data.size()});
+    for (const ErrorCode ec : client.put_many(seed)) {
+      if (ec != ErrorCode::OK) {
+        std::fprintf(stderr, "client-core: seed put failed\n");
+        return 1;
+      }
+    }
+
+    // Leg 1+2a: async — one thread submits n_ops single-item get batches,
+    // sampling the in-flight gauge after each submit, THEN waits them all.
+    std::vector<std::vector<uint8_t>> bufs(static_cast<size_t>(n_ops));
+    for (auto& b : bufs) b.resize(size);
+    std::vector<std::shared_ptr<client::AsyncBatch>> batches;
+    batches.reserve(static_cast<size_t>(n_ops));
+    const uint64_t inflight0 = cc.inflight.load();
+    uint64_t inflight_peak = 0;
+    const auto async0 = Clock::now();
+    for (int i = 0; i < n_ops; ++i) {
+      std::vector<client::ObjectClient::GetItem> items;
+      items.push_back({keys[static_cast<size_t>(i) % kKeys],
+                       bufs[static_cast<size_t>(i)].data(), size});
+      batches.push_back(client.get_many_async(std::move(items)));
+      const uint64_t now_inflight = cc.inflight.load() - inflight0;
+      if (now_inflight > inflight_peak) inflight_peak = now_inflight;
+    }
+    for (const auto& b : batches) {
+      if (!b->wait() || b->status() != ErrorCode::OK ||
+          b->codes()[0] != ErrorCode::OK) {
+        std::fprintf(stderr, "client-core: async get failed\n");
+        return 1;
+      }
+    }
+    const double async_s = std::chrono::duration<double>(Clock::now() - async0).count();
+    for (const auto& b : bufs) {
+      if (b != data) {
+        std::fprintf(stderr, "client-core: async readback mismatch\n");
+        return 1;
+      }
+    }
+    batches.clear();
+
+    // Leg 2b: thread-per-op — the shape the completion core replaced: the
+    // SAME n_ops gets, each paying a thread spawn + stack + join.
+    std::atomic<int> thread_failures{0};
+    const auto thr0 = Clock::now();
+    {
+      std::vector<std::thread> pool;
+      pool.reserve(static_cast<size_t>(n_ops));
+      for (int i = 0; i < n_ops; ++i)
+        pool.emplace_back([&, i] {
+          auto got = client.get_into(keys[static_cast<size_t>(i) % kKeys],
+                                     bufs[static_cast<size_t>(i)].data(), size);
+          if (!got.ok() || got.value() != size) thread_failures.fetch_add(1);
+        });
+      for (auto& t : pool) t.join();
+    }
+    const double thread_s = std::chrono::duration<double>(Clock::now() - thr0).count();
+    if (thread_failures.load() > 0) {
+      std::fprintf(stderr, "client-core: thread-per-op get failed\n");
+      return 1;
+    }
+    const double async_ops_s = n_ops / async_s;
+    const double thread_ops_s = n_ops / thread_s;
+
+    // Leg 3: optimistic reads. Warm get fills the placement cache (one
+    // keystone turn); the timed loop must then take ZERO keystone turns —
+    // proven by the keystone's own gets counter, not inferred. The plain
+    // client runs the same loop as the A/B baseline (one turn per get).
+    client::ClientOptions oopts;
+    oopts.optimistic_reads = true;
+    auto opt_client = cluster->make_client(oopts);
+    auto plain_client = cluster->make_client();
+    const std::string okey = prefix + "/core/opt";
+    if (client.put(okey, data.data(), size) != ErrorCode::OK) {
+      std::fprintf(stderr, "client-core: optimistic seed put failed\n");
+      return 1;
+    }
+    std::vector<uint8_t> obuf(size);
+    auto timed_loop = [&](client::ObjectClient& c, int iters,
+                          std::vector<double>& lat) -> bool {
+      lat.reserve(static_cast<size_t>(iters));
+      for (int i = 0; i < iters; ++i) {
+        const auto t0 = Clock::now();
+        auto got = c.get_into(okey, obuf.data(), size);
+        if (!got.ok() || got.value() != size) return false;
+        lat.push_back(std::chrono::duration<double>(Clock::now() - t0).count() * 1e6);
+      }
+      std::sort(lat.begin(), lat.end());
+      return true;
+    };
+    constexpr int kOptIters = 300;
+    if (!opt_client->get_into(okey, obuf.data(), size).ok()) {  // warm: fills cache
+      std::fprintf(stderr, "client-core: optimistic warm get failed\n");
+      return 1;
+    }
+    const uint64_t turns0 = cluster->keystone().counters().gets.load();
+    const uint64_t hits0 = cc.optimistic_hits.load();
+    std::vector<double> opt_lat, plain_lat;
+    if (!timed_loop(*opt_client, kOptIters, opt_lat)) {
+      std::fprintf(stderr, "client-core: optimistic loop failed\n");
+      return 1;
+    }
+    const uint64_t keystone_turns = cluster->keystone().counters().gets.load() - turns0;
+    const uint64_t opt_hits = cc.optimistic_hits.load() - hits0;
+    if (!timed_loop(*plain_client, kOptIters, plain_lat)) {
+      std::fprintf(stderr, "client-core: plain loop failed\n");
+      return 1;
+    }
+    // Staleness half: rewrite the key (new bytes, new size class) and read
+    // through the SAME optimistic client — the cached placement must not
+    // serve; the read revalidates and returns the new payload.
+    const uint64_t reval0 = cc.optimistic_revalidates.load();
+    std::vector<uint8_t> fresh(size);
+    for (uint64_t i = 0; i < size; ++i) fresh[i] = static_cast<uint8_t>(i * 17 + 113);
+    if (client.remove(okey) != ErrorCode::OK ||
+        client.put(okey, fresh.data(), size) != ErrorCode::OK) {
+      std::fprintf(stderr, "client-core: rewrite failed\n");
+      return 1;
+    }
+    auto reread = opt_client->get(okey);
+    const bool reval_ok = reread.ok() && reread.value() == fresh;
+    const uint64_t revalidates = cc.optimistic_revalidates.load() - reval0;
+    if (json) {
+      std::printf(
+          "{\"op\": \"client_core\", \"bytes\": %llu, \"ops\": %d, "
+          "\"async_inflight_peak\": %llu, \"async_ops_per_s\": %.0f, "
+          "\"thread_per_op_ops_per_s\": %.0f, \"async_vs_thread_x\": %.2f, "
+          "\"optimistic_p50_us\": %.1f, \"optimistic_p99_us\": %.1f, "
+          "\"plain_p50_us\": %.1f, \"optimistic_keystone_turns\": %llu, "
+          "\"optimistic_hits\": %llu, \"optimistic_revalidates\": %llu, "
+          "\"reval_ok\": %d}\n",
+          (unsigned long long)size, n_ops, (unsigned long long)inflight_peak,
+          async_ops_s, thread_ops_s, async_ops_s / thread_ops_s,
+          percentile(opt_lat, 50), percentile(opt_lat, 99), percentile(plain_lat, 50),
+          (unsigned long long)keystone_turns, (unsigned long long)opt_hits,
+          (unsigned long long)revalidates, reval_ok ? 1 : 0);
+    } else {
+      std::printf(
+          "client-core %llu B x%d: %llu in flight from one thread | async %.0f "
+          "ops/s vs thread-per-op %.0f ops/s (%.2fx) | optimistic get p50 %.1f us "
+          "(plain %.1f us, %llu keystone turns over %d reads, reval_ok=%d)\n",
+          (unsigned long long)size, n_ops, (unsigned long long)inflight_peak,
+          async_ops_s, thread_ops_s, async_ops_s / thread_ops_s,
+          percentile(opt_lat, 50), percentile(plain_lat, 50),
+          (unsigned long long)keystone_turns, kOptIters, reval_ok ? 1 : 0);
+    }
+    return 0;
+  }
 
   if (control_plane) {
     // Metadata ops/sec: a closed loop of pure control-plane calls —

@@ -94,6 +94,40 @@ int32_t btpu_get_many(btpu_client* client, uint32_t n, const char* const* keys,
 int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys,
                         uint64_t* out_sizes, int32_t* out_codes);
 
+/* ---- async batched I/O (client op core, btpu/client/op_core.h) -----------
+ * Same per-item semantics as btpu_get_many/btpu_put_many, but the call
+ * returns IMMEDIATELY with a batch handle: the batch is a state machine
+ * advanced by op-core lanes, so one caller thread keeps thousands of
+ * batches in flight. Item data buffers are caller-owned and must stay
+ * alive — and, for gets, untouched — until the batch reports done (the key
+ * strings are copied at submit and may be freed right away).
+ * btpu_async_batch_free cancels a still-running batch and WAITS for it
+ * before returning, so freeing the handle is always buffer-safe. Returns
+ * NULL only on invalid arguments. */
+typedef struct btpu_async_batch btpu_async_batch;
+btpu_async_batch* btpu_get_many_async(btpu_client* client, uint32_t n,
+                                      const char* const* keys, void* const* bufs,
+                                      const uint64_t* buf_sizes);
+btpu_async_batch* btpu_put_many_async(btpu_client* client, uint32_t n,
+                                      const char* const* keys, const void* const* bufs,
+                                      const uint64_t* sizes, uint32_t replicas,
+                                      uint32_t max_workers, uint32_t preferred_class);
+int32_t btpu_async_batch_done(btpu_async_batch* batch); /* 1 = complete */
+/* Blocks until complete; timeout_ms 0 = forever. 1 = complete, 0 = timed
+ * out (the batch keeps running). */
+int32_t btpu_async_batch_wait(btpu_async_batch* batch, uint32_t timeout_ms);
+/* Best-effort: stages not yet run are skipped; unreached items report
+ * OPERATION_CANCELLED. */
+void btpu_async_batch_cancel(btpu_async_batch* batch);
+/* Per-item verdicts, input order; out_sizes[i] = object size for gets
+ * (echoed input size for puts), 0 on per-item failure. Returns the
+ * batch-level status (0 even when individual items failed; RETRY_LATER
+ * while the batch is still running — poll done/wait first; either out
+ * array may be NULL). */
+int32_t btpu_async_batch_results(btpu_async_batch* batch, int32_t* out_codes,
+                                 uint64_t* out_sizes);
+void btpu_async_batch_free(btpu_async_batch* batch);
+
 /* Placement introspection: writes a JSON array of copies
  * [{"copy_index":N,"shards":[{"worker","pool","class","transport",
  *   "length","location":{...}}]}] into buffer. Returns the full length via
@@ -164,6 +198,22 @@ uint64_t btpu_breaker_skip_count(void);             /* client: open-endpoint dep
  * and retrying (sum over every in-process keystone). Sustained nonzero =
  * acked vs durable state diverged; alert (docs/OPERATIONS.md). */
 uint64_t btpu_persist_retry_backlog(void);
+
+/* Client op-core scoreboard (process-global, ClientCoreCounters): the
+ * completion-based async core behind get_many_async/put_many_async and
+ * lane-hosted hedge primaries. inflight/cq_depth are gauges (ops submitted
+ * and not yet completed / ops parked in completion queues right now); the
+ * rest are monotonic. The optimistic pair counts reads served straight from
+ * cached placements with zero keystone turns, and revalidation round trips
+ * taken after a cached attempt failed (docs/OPERATIONS.md alerts). */
+uint64_t btpu_client_inflight_ops(void);      /* gauge */
+uint64_t btpu_client_peak_inflight_ops(void); /* high-water mark */
+uint64_t btpu_client_cq_depth(void);          /* gauge */
+uint64_t btpu_client_ops_submitted_count(void);
+uint64_t btpu_client_ops_completed_count(void);
+uint64_t btpu_client_ops_cancelled_count(void);
+uint64_t btpu_optimistic_hit_count(void);
+uint64_t btpu_optimistic_revalidate_count(void);
 
 /* ---- pool sanitizer (btpu/common/poolsan.h; -DBTPU_POOLSAN trees) --------
  * Conviction counters are monotonic and 0 in release builds (the sanitizer

@@ -20,11 +20,13 @@
 #include <condition_variable>
 #include <functional>
 #include <memory>
+#include <mutex>
 #include <optional>
 #include <thread>
 #include <unordered_map>
 
 #include "btpu/cache/object_cache.h"
+#include "btpu/client/op_core.h"
 #include "btpu/common/circuit_breaker.h"
 #include "btpu/common/deadline.h"
 #include "btpu/common/thread_annotations.h"
@@ -34,6 +36,8 @@
 #include "btpu/transport/transport.h"
 
 namespace btpu::client {
+
+class AsyncBatch;
 
 struct ClientOptions {
   std::string keystone_address;   // "host:port"
@@ -66,6 +70,21 @@ struct ClientOptions {
   // common object-store discipline). Raw (verify=false) reads never use the
   // cache; remote clients only — embedded metadata is already in-process.
   uint32_t placement_cache_ms{0};
+  // FaRM-style optimistic reads (the stretch lane of the op-core refactor):
+  // fire data-plane reads straight from cached placements with ZERO
+  // keystone turns on the happy path, treating any cached-attempt failure —
+  // a STALE_EXTENT conviction (poolsan-armed trees), a content-CRC
+  // mismatch, a dead worker — plus lease/TTL expiry as revalidate-and-retry
+  // through read_with_cache's fresh-metadata pass. Embedded clients join
+  // the placement cache under this flag and validate every cached entry
+  // against the in-process keystone version (linearizable — a re-put is
+  // seen immediately); remote clients keep the placement_cache_ms TTL + CRC
+  // contract, with optimistic_ttl_ms as the backstop when that knob is 0.
+  // Env override: BTPU_OPTIMISTIC_READS=0/1.
+  bool optimistic_reads{false};
+  // TTL backstop for optimistic placement entries when placement_cache_ms
+  // is unset. Remote entries only; embedded entries are version-validated.
+  uint32_t optimistic_ttl_ms{2'000};
   // Pooled small puts: keep up to this many pre-allocated anonymous PENDING
   // slots per (size, config) class, so a repeat put of that class costs ONE
   // control round trip (commit-with-refill) instead of two
@@ -226,6 +245,21 @@ class ObjectClient {
                                   const WorkerConfig& config);
   std::vector<Result<uint64_t>> get_many(const std::vector<GetItem>& items,
                                          std::optional<bool> verify = std::nullopt);
+
+  // ---- async batched I/O (the completion op core, op_core.h) --------------
+  // Submits the batch to the op core and returns immediately: the batch is a
+  // state machine advanced by core lanes, so ONE client thread can keep
+  // thousands of batches in flight (no thread parked per op). Item data
+  // buffers are caller-owned and must stay alive — and, for gets, untouched —
+  // until the batch reports done(); the item descriptor vectors are moved in.
+  // Semantics per item are identical to the sync get_many/put_many (which
+  // remain unchanged). Under sched::armed() every op runs on its own adopted
+  // thread so the schedule explorer owns the interleavings.
+  std::shared_ptr<AsyncBatch> get_many_async(std::vector<GetItem> items,
+                                             std::optional<bool> verify = std::nullopt);
+  std::shared_ptr<AsyncBatch> put_many_async(std::vector<PutItem> items);
+  std::shared_ptr<AsyncBatch> put_many_async(std::vector<PutItem> items,
+                                             const WorkerConfig& config);
 
   // Per-shard integrity report for one object (the scrub localization
   // surface): reads every shard of every copy individually and checks it
@@ -537,6 +571,23 @@ class ObjectClient {
   // wasted refusal RTT; budget refusals are transient, hence the re-probe.
   std::atomic<int64_t> inline_retry_after_ms_{0};
 
+  // ---- async op core (btpu/client/op_core.h) -------------------------------
+  // Lazily built on the first async submission (or hedge primary): clients
+  // that never go async never pay the lane threads. The raw-pointer mirror
+  // makes the fast path a single acquire load; construction and teardown
+  // serialize on op_core_mutex_. Destroyed FIRST in ~ObjectClient (after the
+  // cache watch) — queued ops reference client state that must outlive them.
+  OpCore& ensure_op_core();
+  // Hedge primaries ride an idle core lane when one can take them promptly;
+  // false = caller spawns its own thread (the pre-core shape, kept as the
+  // deterministic-mode and backlog safety valve).
+  bool core_try_run_detached(std::function<void()> fn);
+  // The shared 2-stage batch submission body behind {get,put}_many_async.
+  std::shared_ptr<AsyncBatch> submit_batch(std::shared_ptr<AsyncBatch> batch);
+  std::atomic<OpCore*> op_core_ptr_{nullptr};
+  Mutex op_core_mutex_;
+  std::unique_ptr<OpCore> op_core_ BTPU_GUARDED_BY(op_core_mutex_);
+
   // ---- overload robustness state -------------------------------------------
   BreakerRegistry breakers_{};
   LatencyTracker read_latency_;
@@ -548,6 +599,63 @@ class ObjectClient {
   std::atomic<uint32_t> hedge_inflight_{0};
   Mutex hedge_mutex_;
   CondVarAny hedge_cv_;
+};
+
+// One in-flight async batch on the client op core. Obtained from
+// ObjectClient::{get,put}_many_async; the shared_ptr is the batch's lifetime
+// (the in-flight op pins it too, so dropping the caller's reference before
+// completion is safe — though for gets the DATA buffers are still
+// caller-owned and must outlive the op; call cancel() + wait() first if they
+// will not). Completion is published under the op's mutex (Handle::done
+// acquires it), so reading codes()/sizes() after done() is race-free.
+class AsyncBatch {
+ public:
+  bool done() const { return handle_.done(); }
+  // Blocks until the batch completes; false on timeout (0 = wait forever).
+  bool wait(uint32_t timeout_ms = 0) const {
+    return handle_.wait(timeout_ms == 0 ? Deadline::infinite()
+                                        : Deadline::after_ms(timeout_ms));
+  }
+  // Best-effort: stages not yet run are skipped, already-transferred bytes
+  // stay transferred. Items the op never reached report the batch status.
+  void cancel() const { handle_.cancel(); }
+  // Batch-level verdict: OK even when individual items failed (read codes());
+  // OPERATION_CANCELLED / DEADLINE_EXCEEDED when the op was cut short.
+  ErrorCode status() const { return handle_.status(); }
+  // Per-item results, input order (a snapshot copy — the batch may still be
+  // mutating its own arrays). Settled only after done(): before that items
+  // uniformly read RETRY_LATER. When the op was cut short before the I/O
+  // stage ran, every item folds to status().
+  std::vector<ErrorCode> codes() const;
+  // Object sizes for get batches (0 where the item failed); echoed input
+  // sizes for put batches. Same snapshot semantics as codes().
+  std::vector<uint64_t> sizes() const;
+  size_t size() const noexcept { return size_; }
+
+ private:
+  friend class ObjectClient;
+  AsyncBatch() = default;
+  OpCore::Handle handle_;
+  // Submission inputs (moved in; data pointers remain caller-owned).
+  std::vector<ObjectClient::GetItem> gets_;
+  std::vector<ObjectClient::PutItem> puts_;
+  WorkerConfig config_;
+  bool have_config_{false};
+  std::optional<bool> verify_;
+  // Runner-only state: written by the op's owning lane (one thread advances
+  // a machine at a time — op_core.h ownership model), never by callers.
+  uint32_t stage_{0};
+  std::vector<uint8_t> served_;  // stage-0 cache pre-serve verdicts (gets)
+  size_t size_{0};               // item count, fixed at submit
+  // Result arrays are shared with callers (codes()/sizes() may legally poll
+  // PRE-done for the RETRY_LATER sentinel), so writes and snapshot reads
+  // both go through m_. Lock order: m_ before Op::m (codes() holds m_ while
+  // consulting handle_; the runner and finish() never hold both).
+  mutable Mutex m_;
+  bool results_published_ BTPU_GUARDED_BY(m_){false};
+  mutable bool finalized_ BTPU_GUARDED_BY(m_){false};
+  mutable std::vector<ErrorCode> codes_ BTPU_GUARDED_BY(m_);
+  mutable std::vector<uint64_t> sizes_ BTPU_GUARDED_BY(m_);
 };
 
 }  // namespace btpu::client

@@ -1,0 +1,141 @@
+// Completion-based client op core (ISSUE 16 / ROADMAP item 3): each client
+// op is a state machine advanced by completions, not a parked thread. A
+// small pool of persistent lanes drains a completion queue of ops; a
+// multi-stage op re-enqueues itself between stages (Step::kYield), so one
+// submitter thread keeps thousands of ops in flight while the lanes
+// interleave them. The sync SDK surface is untouched — sync ops still run
+// inline on the caller's thread through the same decomposed stage
+// functions — and hedged reads ride the core as second in-flight
+// submissions instead of hedged_race's former spawn-per-race thread.
+//
+// Ownership / lock model (docs/CORRECTNESS.md "client op core"):
+//   * A state machine is advanced by EXACTLY ONE thread at a time: the lane
+//     that dequeued it (or, under the schedule explorer, the per-op adopted
+//     thread). Re-enqueue happens after the stage returns, so no two lanes
+//     ever run the same op concurrently.
+//   * Op completion publishes under Op::m (done flag + status), and waiters
+//     block on Op::cv — the btpu::Mutex/CondVarAny pair, so the schedule
+//     explorer preempts at every queue/complete edge.
+//   * The queue itself is guarded by OpCore::m_; the queue_depth/inflight
+//     gauges are relaxed atomics (stat folds, not synchronization).
+//   * Shutdown drains: remaining queued ops RUN to completion (they may
+//     reference client state that outlives the core in the destructor
+//     order), then lanes join. Nothing is dropped on the floor.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <thread>
+#include <vector>
+
+#include "btpu/common/deadline.h"
+#include "btpu/common/error.h"
+#include "btpu/common/thread_annotations.h"
+
+namespace btpu::client {
+
+// Process-global client-core scoreboard (capi btpu_client_inflight_ops and
+// friends; the /metrics gauges and Client.lane_counters() read the same
+// struct). inflight/queue_depth are gauges; the rest are monotonic.
+struct ClientCoreCounters {
+  std::atomic<uint64_t> submitted{0};
+  std::atomic<uint64_t> completed{0};
+  std::atomic<uint64_t> cancelled{0};
+  // Ops submitted and not yet completed (queued ops count: a completion
+  // core's in-flight set is everything the submitter no longer holds).
+  std::atomic<uint64_t> inflight{0};
+  std::atomic<uint64_t> peak_inflight{0};
+  // Ops parked in completion queues right now (across every live core).
+  std::atomic<uint64_t> queue_depth{0};
+  // Optimistic-read lane (client_cache.cpp): reads served straight from
+  // cached placements with zero keystone turns / revalidation round trips
+  // taken after a cached attempt failed (STALE_EXTENT, CRC, lease expiry).
+  std::atomic<uint64_t> optimistic_hits{0};
+  std::atomic<uint64_t> optimistic_revalidates{0};
+};
+ClientCoreCounters& client_core_counters() noexcept;
+
+class OpCore {
+ public:
+  // What a stage returns: kDone completes the op (waiters wake); kYield
+  // re-enqueues it at the queue tail — the stage function is called again
+  // when a lane next dequeues it (the closure owns its stage cursor).
+  enum class Step : uint8_t { kDone, kYield };
+
+  struct Op {
+    std::function<Step()> step;
+    Deadline deadline;  // checked before every stage; expiry completes the op
+    std::atomic<bool> cancel{false};
+    mutable Mutex m;
+    CondVarAny cv;
+    bool done BTPU_GUARDED_BY(m){false};
+    ErrorCode status BTPU_GUARDED_BY(m){ErrorCode::OK};
+  };
+
+  // Completion handle (the "future" half): shared with the core, so a
+  // dropped handle never dangles an in-flight op.
+  class Handle {
+   public:
+    Handle() = default;
+    bool valid() const noexcept { return op_ != nullptr; }
+    bool done() const;
+    // Blocks until completion; false on deadline expiry (op keeps running).
+    bool wait(const Deadline& deadline = Deadline::infinite()) const;
+    // Best-effort: stages not yet started are skipped and the op completes
+    // CANCELLED; a stage already running finishes first.
+    void cancel() const;
+    // The op's completion status (OK / CANCELLED / DEADLINE_EXCEEDED).
+    // Meaningful only after done().
+    ErrorCode status() const;
+
+   private:
+    friend class OpCore;
+    explicit Handle(std::shared_ptr<Op> op) : op_(std::move(op)) {}
+    std::shared_ptr<Op> op_;
+  };
+
+  // lanes == 0 resolves $BTPU_CLIENT_LANES, default min(4, max(1, hw)).
+  explicit OpCore(uint32_t lanes = 0);
+  ~OpCore();  // drains the queue (ops run to completion), then joins lanes
+
+  // Submits a state machine. Under the schedule explorer (sched::armed())
+  // the op runs on a dedicated adopted thread instead of a lane — the
+  // explorer owns every interleaving decision, exactly like the former
+  // spawn-per-race shape the Sched fixtures pin.
+  Handle submit(std::function<Step()> step, Deadline deadline = Deadline::infinite());
+
+  // Fire-and-forget single-stage op for latency rescues (hedge primaries):
+  // taken ONLY when a lane is idle and the queue is shallow — a hedge
+  // parked behind a deep queue would rescue nothing — and never under the
+  // schedule explorer. Returns false when the caller should fall back to
+  // its own spawn.
+  bool try_run_detached(std::function<void()> fn);
+
+  uint32_t lanes() const noexcept { return lanes_; }
+  // Ops queued in THIS core right now (the process gauge sums all cores).
+  uint64_t queue_depth() const;
+
+ private:
+  void lane_main();
+  void start_lanes_locked() BTPU_REQUIRES(m_);
+  void advance(const std::shared_ptr<Op>& op);
+  static void finish(const std::shared_ptr<Op>& op, ErrorCode status);
+
+  const uint32_t lanes_;
+  mutable Mutex m_;
+  CondVarAny cv_;
+  std::deque<std::shared_ptr<Op>> queue_ BTPU_GUARDED_BY(m_);
+  bool stopping_ BTPU_GUARDED_BY(m_){false};
+  bool started_ BTPU_GUARDED_BY(m_){false};
+  uint32_t idle_lanes_ BTPU_GUARDED_BY(m_){0};
+  std::vector<std::thread> threads_ BTPU_GUARDED_BY(m_);
+  // Sched-armed per-op threads in flight (joined at shutdown via drain).
+  std::atomic<uint32_t> spawned_{0};
+  Mutex spawn_mutex_;
+  CondVarAny spawn_cv_;
+};
+
+}  // namespace btpu::client

@@ -118,6 +118,9 @@ enum class BTPU_NODISCARD ErrorCode : uint32_t {
   CLIENT_DISCONNECTED,
   SESSION_EXPIRED,
   INVALID_CLIENT_STATE,
+  // Appended (wire append-only rule): an async op/batch was cancelled
+  // before its remaining stages ran (client op core, btpu/client/op_core.h).
+  OPERATION_CANCELLED,
 
   // Config (7000-7999)
   CONFIG_ERROR = domain_base(Domain::CONFIG),

@@ -130,6 +130,65 @@ ErrorCode check_access(const void* base, const char* tag, uint64_t region_len,
                        uint64_t offset, uint64_t len, uint64_t gen, Access access,
                        uint64_t trace_id = 0) noexcept;
 
+// ---- access pins (the in-flight copy window) -------------------------------
+// A resolve proves the extent live at CHECK time, but the engine's copy runs
+// after the proof with no lock held: a concurrent free can quarantine the
+// extent mid-copy. On release builds that race is sanctioned — the reader
+// gets stale-but-mapped bytes and the CRC gate judges them as copy loss
+// (docs/BYTE_PATHS.md failure semantics). Under an armed asan tree, though,
+// the quarantine POISON itself would turn the sanctioned race into a hard
+// trap at the copy instruction — convicting the instrumentation, not the
+// product. An AccessPin brackets the copy to restore release semantics
+// without weakening detection:
+//   * freed extents still flip to quarantined IMMEDIATELY — every resolve
+//     that arrives after the free is convicted exactly as before;
+//   * only the byte-level effects (quarantine poison / pattern fill, and
+//     fresh red-zone arming on reused space) are DEFERRED while any pin is
+//     open on the pool, and flushed when the last pin drops.
+// Open the pin BEFORE the resolve proof and hold it across the copy. Cost:
+// one registry lookup + a counter under the shadow's leaf mutex; empty (and
+// free) when poolsan is compiled out or disarmed. Today only the LOCAL
+// transport's flat path pins its copies; the TCP serve engines' pool-direct
+// sends can outlive any reasonable pin (kernel async send) and stay
+// governed by the CRC gate alone.
+namespace internal {
+ShadowPtr pin_shadow(const void* base, const char* tag, uint64_t region_len) noexcept;
+void unpin_shadow(const ShadowPtr& shadow) noexcept;
+}  // namespace internal
+
+class AccessPin {
+ public:
+  AccessPin() noexcept = default;
+  // Pins the shadow covering (base, region_len) / `tag` — the same lookup
+  // rules as check_access. No shadow, geometry mismatch, or !armed(): the
+  // pin is empty and every operation on it is a no-op.
+  AccessPin(const void* base, const char* tag, uint64_t region_len) noexcept {
+#if defined(BTPU_POOLSAN)
+    shadow_ = internal::pin_shadow(base, tag, region_len);
+#else
+    (void)base;
+    (void)tag;
+    (void)region_len;
+#endif
+  }
+  ~AccessPin() {
+    if (shadow_) internal::unpin_shadow(shadow_);
+  }
+  AccessPin(AccessPin&& other) noexcept : shadow_(std::move(other.shadow_)) {}
+  AccessPin& operator=(AccessPin&& other) noexcept {
+    if (this != &other) {
+      if (shadow_) internal::unpin_shadow(shadow_);
+      shadow_ = std::move(other.shadow_);
+    }
+    return *this;
+  }
+  AccessPin(const AccessPin&) = delete;
+  AccessPin& operator=(const AccessPin&) = delete;
+
+ private:
+  ShadowPtr shadow_;
+};
+
 // Canary sweep over every host-bound shadow (keystone scrub hook, tests):
 // verifies red zones and quarantined ranges, reporting any smash. Returns
 // the number of NEW smashes found this sweep. No-op (0) under asan builds

@@ -218,6 +218,11 @@ class Enroll {
  public:
   explicit Enroll(uint32_t) noexcept {}
 };
+// Hook-free builds never arm a run: callers that branch on armed() (e.g. the
+// client op core picking per-op adopted threads over persistent lanes) fold
+// to the production path at compile time.
+inline bool armed() noexcept { return false; }
+inline bool on() noexcept { return false; }
 inline uint64_t current_seed() noexcept { return 0; }
 struct ExploreResult {
   uint64_t schedules{0};

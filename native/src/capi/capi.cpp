@@ -27,6 +27,10 @@ struct btpu_client {
   std::unique_ptr<client::ObjectClient> impl;
 };
 
+struct btpu_async_batch {
+  std::shared_ptr<client::AsyncBatch> impl;
+};
+
 extern "C" {
 
 btpu_cluster* btpu_cluster_create(uint32_t n_workers, uint64_t pool_bytes,
@@ -315,6 +319,71 @@ int32_t btpu_sizes_many(btpu_client* client, uint32_t n, const char* const* keys
   return 0;
 }
 
+btpu_async_batch* btpu_get_many_async(btpu_client* client, uint32_t n,
+                                      const char* const* keys, void* const* bufs,
+                                      const uint64_t* buf_sizes) {
+  if (!client || (n && (!keys || !bufs || !buf_sizes))) return nullptr;
+  std::vector<client::ObjectClient::GetItem> items(n);
+  for (uint32_t i = 0; i < n; ++i) items[i] = {keys[i], bufs[i], buf_sizes[i]};
+  auto* batch = new btpu_async_batch;
+  batch->impl = client->impl->get_many_async(std::move(items));
+  return batch;
+}
+
+btpu_async_batch* btpu_put_many_async(btpu_client* client, uint32_t n,
+                                      const char* const* keys, const void* const* bufs,
+                                      const uint64_t* sizes, uint32_t replicas,
+                                      uint32_t max_workers, uint32_t preferred_class) {
+  if (!client || (n && (!keys || !bufs || !sizes))) return nullptr;
+  WorkerConfig cfg;
+  cfg.replication_factor = replicas == 0 ? 1 : replicas;
+  cfg.max_workers_per_copy = max_workers == 0 ? 1 : max_workers;
+  if (preferred_class != 0)
+    cfg.preferred_classes = {static_cast<StorageClass>(preferred_class)};
+  std::vector<client::ObjectClient::PutItem> items(n);
+  for (uint32_t i = 0; i < n; ++i) items[i] = {keys[i], bufs[i], sizes[i]};
+  auto* batch = new btpu_async_batch;
+  batch->impl = client->impl->put_many_async(std::move(items), cfg);
+  return batch;
+}
+
+int32_t btpu_async_batch_done(btpu_async_batch* batch) {
+  return batch && batch->impl->done() ? 1 : 0;
+}
+
+int32_t btpu_async_batch_wait(btpu_async_batch* batch, uint32_t timeout_ms) {
+  return batch && batch->impl->wait(timeout_ms) ? 1 : 0;
+}
+
+void btpu_async_batch_cancel(btpu_async_batch* batch) {
+  if (batch) batch->impl->cancel();
+}
+
+int32_t btpu_async_batch_results(btpu_async_batch* batch, int32_t* out_codes,
+                                 uint64_t* out_sizes) {
+  if (!batch) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  if (!batch->impl->done()) return static_cast<int32_t>(ErrorCode::RETRY_LATER);
+  const auto& codes = batch->impl->codes();
+  const auto& sizes = batch->impl->sizes();
+  for (size_t i = 0; i < codes.size(); ++i) {
+    if (out_codes) out_codes[i] = static_cast<int32_t>(codes[i]);
+    if (out_sizes) out_sizes[i] = sizes[i];
+  }
+  return static_cast<int32_t>(batch->impl->status());
+}
+
+void btpu_async_batch_free(btpu_async_batch* batch) {
+  if (!batch) return;
+  // Buffer-safety contract (capi.h): the caller may free item buffers the
+  // moment this returns, so a still-running batch is cancelled and waited
+  // out — never left racing freed memory.
+  if (!batch->impl->done()) {
+    batch->impl->cancel();
+    (void)batch->impl->wait(0);  // 0 = forever; cancel bounds the wait
+  }
+  delete batch;
+}
+
 int32_t btpu_exists(btpu_client* client, const char* key, int32_t* out_exists) {
   if (!client || !key || !out_exists) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   auto r = client->impl->object_exists(key);
@@ -392,6 +461,39 @@ uint64_t btpu_breaker_skip_count(void) {
 }
 uint64_t btpu_persist_retry_backlog(void) {
   return keystone::persist_retry_backlog_process_total();
+}
+uint64_t btpu_client_inflight_ops(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().inflight.load(std::memory_order_relaxed);
+}
+uint64_t btpu_client_peak_inflight_ops(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().peak_inflight.load(std::memory_order_relaxed);
+}
+uint64_t btpu_client_cq_depth(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().queue_depth.load(std::memory_order_relaxed);
+}
+uint64_t btpu_client_ops_submitted_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().submitted.load(std::memory_order_relaxed);
+}
+uint64_t btpu_client_ops_completed_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().completed.load(std::memory_order_relaxed);
+}
+uint64_t btpu_client_ops_cancelled_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().cancelled.load(std::memory_order_relaxed);
+}
+uint64_t btpu_optimistic_hit_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().optimistic_hits.load(std::memory_order_relaxed);
+}
+uint64_t btpu_optimistic_revalidate_count(void) {
+  // ordering: relaxed — stat fold (see btpu_deadline_exceeded_count).
+  return client::client_core_counters().optimistic_revalidates.load(
+      std::memory_order_relaxed);
 }
 
 /* ---- pool sanitizer ------------------------------------------------------ */

@@ -1,0 +1,272 @@
+// Batch engine: the shard-job machinery shared by the batched object
+// I/O paths (put_many / get_many) and the pooled-slot put path — per-
+// item jobs partitioned by data path (device vs wire), one pipelined
+// wire batch with fused CRCs, one provider batch for device shards.
+// Internal header (native/src/client); not part of the public SDK.
+#pragma once
+
+#include <cstring>
+#include <map>
+#include <vector>
+
+#include "btpu/client/client.h"
+#include "btpu/common/crc32c.h"
+#include "btpu/ec/rs.h"
+#include "btpu/transport/transport.h"
+
+namespace btpu::client {
+
+// Per-item shard jobs for a whole batch, partitioned by data path.
+struct BatchJobs {
+  std::vector<transport::ShardJob> device;   // all items' device shards
+  std::vector<size_t> device_item;           // item index per device job
+  std::vector<transport::ShardJob> wire;     // all items' wire shards
+  std::vector<size_t> wire_item;
+};
+
+// Splits one copy of `size` bytes at `data` into jobs, appending to `jobs`.
+// Returns INVALID_PARAMETERS when the shard lengths do not sum to size.
+// `crcs_out` (when non-null) receives this copy's per-shard CRC32C stamps —
+// computed here because the put path is the one place the shard boundaries
+// and the bytes are both in hand.
+inline ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                           size_t item_index, BatchJobs& jobs,
+                           CopyShardCrcs* crcs_out = nullptr) {
+  if (crcs_out) {
+    crcs_out->copy_index = copy.copy_index;
+    crcs_out->crcs.clear();
+    crcs_out->crcs.reserve(copy.shards.size());
+  }
+  uint64_t off = 0;
+  for (const auto& shard : copy.shards) {
+    if (off + shard.length > size) return ErrorCode::INVALID_PARAMETERS;
+    transport::ShardJob job{&shard, 0, data + off, shard.length};
+    if (std::holds_alternative<DeviceLocation>(shard.location)) {
+      jobs.device.push_back(job);
+      jobs.device_item.push_back(item_index);
+    } else {
+      jobs.wire.push_back(job);
+      jobs.wire_item.push_back(item_index);
+    }
+    if (crcs_out) crcs_out->crcs.push_back(crc32c(data + off, shard.length));
+    off += shard.length;
+  }
+  return off == size ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
+}
+
+// Coded-copy batch helpers. Arena owns padded-data and parity buffers until
+// the wire batch executes (inner-vector buffers stay put when the arena
+// grows). EC pools are wire-only by placement, so every job is a wire job.
+inline ErrorCode append_ec_put_jobs(const CopyPlacement& copy, const uint8_t* data, uint64_t size,
+                             size_t item_index, std::vector<std::vector<uint8_t>>& arena,
+                             BatchJobs& jobs, CopyShardCrcs* crcs_out = nullptr) {
+  const size_t k = copy.ec_data_shards, m = copy.ec_parity_shards;
+  if (copy.shards.size() != k + m || size != copy.ec_object_size)
+    return ErrorCode::INVALID_PARAMETERS;
+  const uint64_t L = copy.shards.front().length;
+  for (const auto& s : copy.shards) {
+    if (s.length != L) return ErrorCode::INVALID_PARAMETERS;
+  }
+  std::vector<const uint8_t*> data_ptrs(k);
+  for (size_t i = 0; i < k; ++i) {
+    const uint64_t start = i * L;
+    const uint64_t valid = start >= size ? 0 : std::min<uint64_t>(L, size - start);
+    if (valid == L) {
+      data_ptrs[i] = data + start;
+    } else {
+      arena.emplace_back(L, 0);
+      if (valid > 0) std::memcpy(arena.back().data(), data + start, valid);
+      data_ptrs[i] = arena.back().data();
+    }
+  }
+  std::vector<uint8_t*> parity_ptrs(m);
+  for (size_t j = 0; j < m; ++j) {
+    arena.emplace_back(L);
+    parity_ptrs[j] = arena.back().data();
+  }
+  if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
+    return ErrorCode::INVALID_PARAMETERS;
+  if (crcs_out) {
+    crcs_out->copy_index = copy.copy_index;
+    crcs_out->crcs.clear();
+    crcs_out->crcs.reserve(k + m);
+  }
+  for (size_t i = 0; i < k + m; ++i) {
+    uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity_ptrs[i - k];
+    jobs.wire.push_back({&copy.shards[i], 0, buf, L});
+    jobs.wire_item.push_back(item_index);
+    // Shard CRCs cover the full L wire bytes (padding included) so readers
+    // and scrubbers can verify a shard without knowing the object size.
+    if (crcs_out) crcs_out->crcs.push_back(crc32c(buf, L));
+  }
+  return ErrorCode::OK;
+}
+
+// Post-batch copy of a padded shard's valid bytes into the user buffer.
+struct EcReadFixup {
+  size_t item;
+  uint8_t* dst;
+  const uint8_t* src;
+  uint64_t n;
+};
+
+// Appends the k data-shard reads of one coded copy (the healthy fast path;
+// a failed item falls back to the full reconstructing read).
+inline void append_ec_get_jobs(const CopyPlacement& copy, uint8_t* buffer, uint64_t size,
+                        size_t item_index, std::vector<std::vector<uint8_t>>& arena,
+                        BatchJobs& jobs, std::vector<EcReadFixup>& fixups) {
+  const size_t k = copy.ec_data_shards;
+  const uint64_t L = copy.shards.front().length;
+  for (size_t i = 0; i < k; ++i) {
+    const uint64_t start = i * L;
+    const uint64_t valid = start >= size ? 0 : std::min<uint64_t>(L, size - start);
+    if (valid == 0) continue;  // pure padding: nothing to read
+    uint8_t* buf;
+    if (valid == L) {
+      buf = buffer + start;
+    } else {
+      arena.emplace_back(L);
+      buf = arena.back().data();
+      fixups.push_back({item_index, buffer + start, buf, valid});
+    }
+    jobs.wire.push_back({&copy.shards[i], 0, buf, L});
+    jobs.wire_item.push_back(item_index);
+  }
+}
+
+// Range (offset, length) -> CRC32C map. Prefilled by the transport's fused
+// write hashes; stamp_copy_crcs fills the gaps (device shards, failed ops).
+using RangeCrcMap = std::map<std::pair<uint64_t, uint64_t>, uint32_t>;
+
+// Per-copy shard CRC stamps for replicated/striped copies: replica copies
+// cover the SAME bytes, so each distinct (offset, length) range is hashed
+// once and reused. Wire shards arrive pre-hashed in `range_crc` (the
+// transport fused the CRC into its copy/send of the bytes), so the typical
+// put stamps every shard with ZERO standalone passes; only device shards
+// and retried ranges fall back to hashing here.
+inline std::vector<CopyShardCrcs> stamp_copy_crcs(const std::vector<CopyPlacement>& copies,
+                                           const uint8_t* data, RangeCrcMap& range_crc) {
+  std::vector<CopyShardCrcs> out;
+  out.reserve(copies.size());
+  for (const auto& copy : copies) {
+    CopyShardCrcs crcs;
+    crcs.copy_index = copy.copy_index;
+    crcs.crcs.reserve(copy.shards.size());
+    uint64_t off = 0;
+    for (const auto& shard : copy.shards) {
+      auto [it, fresh] = range_crc.try_emplace({off, shard.length}, 0);
+      if (fresh) it->second = crc32c(data + off, shard.length);
+      crcs.crcs.push_back(it->second);
+      off += shard.length;
+    }
+    out.push_back(std::move(crcs));
+  }
+  return out;
+}
+
+// Whole-object CRC folded from one copy's shard stamps (shards tile the
+// object contiguously in order — append_copy_jobs enforces exact cover).
+// With fused wire hashes this makes the content stamp FREE: no pass over
+// the bytes anywhere in the put path.
+inline uint32_t fold_content_crc(const CopyShardCrcs& crcs, const CopyPlacement& copy) {
+  uint32_t crc = 0;
+  for (size_t i = 0; i < crcs.crcs.size(); ++i)
+    crc = i == 0 ? crcs.crcs[0] : crc32c_combine(crc, crcs.crcs[i], copy.shards[i].length);
+  return crc;
+}
+
+// Read-side mirror of stamp_copy_crcs: folds one copy's object CRC from the
+// transport's fused read hashes, hashing only the gaps (device shards,
+// skipped ops, the rare genuine-zero crc). The batched verified get then
+// checks integrity with ~no second pass over wire bytes.
+inline uint32_t fold_ranges_crc(const CopyPlacement& copy, const uint8_t* base, RangeCrcMap& ranges) {
+  uint32_t crc = 0;
+  uint64_t off = 0;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    const uint64_t len = copy.shards[i].length;
+    auto [it, fresh] = ranges.try_emplace({off, len}, 0);
+    if (fresh) it->second = crc32c(base + off, len);
+    crc = i == 0 ? it->second : crc32c_combine(crc, it->second, len);
+    off += len;
+  }
+  return crc;
+}
+
+// Collects one item's fused write hashes out of run_wire_jobs' output into
+// the (object offset, length) -> crc form stamp_copy_crcs consumes. `item`
+// filters a batch down to one object; 0-crc entries (skipped/failed ops, or
+// the rare genuine zero) fall through to stamp_copy_crcs' own hashing.
+inline void harvest_wire_ranges(const BatchJobs& jobs, const std::vector<uint32_t>& wire_crcs,
+                         size_t item, const uint8_t* base, RangeCrcMap& ranges) {
+  for (size_t j = 0; j < jobs.wire.size() && j < wire_crcs.size(); ++j) {
+    if (jobs.wire_item[j] != item || wire_crcs[j] == 0) continue;
+    ranges[{static_cast<uint64_t>(jobs.wire[j].buf - base), jobs.wire[j].len}] =
+        wire_crcs[j];
+  }
+}
+
+// Runs the wire jobs as ONE pipelined batch; per-op failures land on their
+// item, jobs of items that already failed are skipped (their reservation is
+// cancelled by the caller anyway). With `wire_crcs` (put path) ops ask the
+// transport for a fused hash of the bytes they moved; (*wire_crcs)[j] gets
+// job j's crc for ops that completed (entries stay 0 for skipped/failed
+// jobs — stamp_copy_crcs treats a missing range as "hash it here").
+// `crc_items` (parallel to the caller's items, may be null = all) limits
+// the request to items whose hashes will actually be harvested — EC items
+// stamp during encode, so hashing their padded/parity ranges is waste.
+inline void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
+                   size_t max_concurrency, std::vector<ErrorCode>& item_errors,
+                   std::vector<uint32_t>* wire_crcs = nullptr,
+                   const std::vector<bool>* crc_items = nullptr) {
+  if (jobs.wire.empty()) return;
+  if (wire_crcs) wire_crcs->assign(jobs.wire.size(), 0);
+  std::vector<transport::WireOp> ops;
+  std::vector<size_t> op_item, op_job;
+  ops.reserve(jobs.wire.size());
+  for (size_t j = 0; j < jobs.wire.size(); ++j) {
+    const size_t item = jobs.wire_item[j];
+    if (item_errors[item] != ErrorCode::OK) continue;
+    const auto& job = jobs.wire[j];
+    transport::WireOp op;
+    if (!transport::make_wire_op(*job.shard, job.in_off, job.buf, job.len, op)) {
+      // FileLocation: worker-served, never a client target.
+      item_errors[item] = ErrorCode::NOT_IMPLEMENTED;
+      continue;
+    }
+    op.want_crc =
+        wire_crcs != nullptr && (!crc_items || (item < crc_items->size() && (*crc_items)[item]));
+    ops.push_back(op);
+    op_item.push_back(item);
+    op_job.push_back(j);
+  }
+  if (is_write) {
+    (void)client.write_batch(ops.data(), ops.size(), max_concurrency);  // per-op status folded into item_errors below
+  } else {
+    (void)client.read_batch(ops.data(), ops.size(), max_concurrency);  // per-op status folded into item_errors below
+  }
+  for (size_t j = 0; j < ops.size(); ++j) {
+    if (ops[j].status != ErrorCode::OK && item_errors[op_item[j]] == ErrorCode::OK)
+      item_errors[op_item[j]] = ops[j].status;
+    if (wire_crcs && ops[j].status == ErrorCode::OK) (*wire_crcs)[op_job[j]] = ops[j].crc;
+  }
+}
+
+// Runs the device jobs as ONE provider batch; when the whole batch fails,
+// retries per job so one poisoned item cannot sink the rest, recording
+// errors into per-item slots.
+inline void run_device_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
+                     std::vector<ErrorCode>& item_errors) {
+  if (jobs.device.empty()) return;
+  if (transport::shard_io_batch(client, jobs.device.data(), jobs.device.size(), is_write) ==
+      ErrorCode::OK)
+    return;
+  for (size_t j = 0; j < jobs.device.size(); ++j) {
+    if (item_errors[jobs.device_item[j]] != ErrorCode::OK) continue;
+    if (auto ec = transport::shard_io_batch(client, &jobs.device[j], 1, is_write);
+        ec != ErrorCode::OK)
+      item_errors[jobs.device_item[j]] = ec;
+  }
+}
+
+
+}  // namespace btpu::client

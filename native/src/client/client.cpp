@@ -54,6 +54,7 @@ std::string random_slot_tag() {
 void apply_robustness_env(ClientOptions& options) {
   options.op_deadline_ms = env_u32("BTPU_OP_DEADLINE_MS", options.op_deadline_ms);
   options.hedge_reads = env_bool("BTPU_HEDGE_READS", options.hedge_reads);
+  options.optimistic_reads = env_bool("BTPU_OPTIMISTIC_READS", options.optimistic_reads);
   options.inline_refusal_backoff_ms =
       env_u32("BTPU_INLINE_RETRY_MS", options.inline_refusal_backoff_ms);
 }
@@ -108,6 +109,18 @@ ObjectClient::ObjectClient(ClientOptions options, keystone::KeystoneService* emb
 ObjectClient::~ObjectClient() {
   teardown_cache_watch();
   cancel_pooled_slots();
+  // Op core first: queued async ops (and lane-hosted hedge primaries)
+  // reference client state that must outlive them — the core's destructor
+  // runs every queued op to completion and joins its lanes.
+  {
+    MutexLock lock(op_core_mutex_);
+    // ordering: release — fast-path loads must not observe a core that is
+    // mid-destruction (new submissions after this point would be a caller
+    // bug; the null mirror turns them into a fresh-core build, also a bug,
+    // but never a dangling dereference).
+    op_core_ptr_.store(nullptr, std::memory_order_release);
+    op_core_.reset();
+  }
   // Loser hedge attempts still reference this client's transport; wait for
   // them to drain into their discard buffers before tearing anything down.
   MutexLock lock(hedge_mutex_);
@@ -193,384 +206,6 @@ Result<std::vector<CopyPlacement>> ObjectClient::get_workers(const ObjectKey& ke
   return rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& r) { return r.get_workers(key); });
 }
 
-// ---- placement cache (ClientOptions::placement_cache_ms) -------------------
-
-Result<std::vector<CopyPlacement>> ObjectClient::get_workers_cached(const ObjectKey& key,
-                                                                    bool& from_cache) {
-  from_cache = false;
-  if (options_.placement_cache_ms > 0 && !embedded_) {
-    const auto now = std::chrono::steady_clock::now();
-    MutexLock lock(placement_cache_mutex_);
-    auto it = placement_cache_.find(key);
-    if (it != placement_cache_.end()) {
-      if (now - it->second.fetched_at <=
-          std::chrono::milliseconds(options_.placement_cache_ms)) {
-        from_cache = true;
-        return it->second.copies;
-      }
-      placement_cache_.erase(it);
-    }
-  }
-  auto copies = get_workers(key);
-  if (copies.ok()) cache_placements(key, copies.value());
-  return copies;
-}
-
-void ObjectClient::cache_placements(const ObjectKey& key,
-                                    const std::vector<CopyPlacement>& copies) {
-  if (options_.placement_cache_ms == 0 || embedded_) return;
-  // Staleness detection rides the content CRC; an unstamped copy (legacy
-  // record) could serve stale bytes undetected, so it is never cached.
-  for (const auto& copy : copies) {
-    if (copy.content_crc == 0) return;
-  }
-  MutexLock lock(placement_cache_mutex_);
-  // Bounded: entries expire by TTL anyway, so a rare full reset under churn
-  // beats per-access LRU bookkeeping on the hot read path.
-  if (placement_cache_.size() >= 4096) placement_cache_.clear();
-  placement_cache_[key] = {copies, std::chrono::steady_clock::now()};
-}
-
-void ObjectClient::invalidate_placements(const ObjectKey& key) {
-  // This client's own mutations drop the OBJECT cache entry too (a
-  // re-created key must not serve the previous object's bytes from either
-  // cache); cross-client mutations ride the watch/lease machinery.
-  if (cache_) cache_->invalidate(key);
-  if (options_.placement_cache_ms == 0 || embedded_) return;
-  MutexLock lock(placement_cache_mutex_);
-  placement_cache_.erase(key);
-}
-
-void ObjectClient::invalidate_all_placements() {
-  if (cache_) cache_->invalidate_all();
-  if (options_.placement_cache_ms == 0 || embedded_) return;
-  MutexLock lock(placement_cache_mutex_);
-  placement_cache_.clear();
-}
-
-// ---- client object cache (ClientOptions::cache_bytes) ----------------------
-
-void ObjectClient::setup_cache() {
-  if (options_.cache_bytes == 0) return;
-  cache_ = std::make_shared<cache::ObjectCache>(options_.cache_bytes,
-                                                options_.cache_max_object_bytes);
-  // Embedded clients validate every hit against the in-process keystone's
-  // version — strictly stronger than any invalidation stream, so no watch.
-  if (embedded_ && !options_.cache_force_lease_mode) return;
-  inval_coord_ = options_.cache_coordinator;
-  if (!inval_coord_ && !options_.coordinator_endpoints.empty()) {
-    auto rc = std::make_shared<coord::RemoteCoordinator>(options_.coordinator_endpoints);
-    if (rc->connect() == ErrorCode::OK) {
-      inval_coord_ = std::move(rc);
-    } else {
-      LOG_WARN << "object cache: coordinator " << options_.coordinator_endpoints
-               << " unreachable; invalidations degrade to lease expiry";
-    }
-  }
-  if (!inval_coord_) return;  // lease-expiry + revalidation coherence only
-  const std::string prefix = coord::cache_inval_prefix(options_.cluster_id);
-  // weak_ptr: a late watch event racing client destruction pins the cache
-  // (or finds it gone) instead of dereferencing a dead client.
-  std::weak_ptr<cache::ObjectCache> weak = cache_;
-  auto watch =
-      inval_coord_->watch_prefix(prefix, [prefix, weak](const coord::WatchEvent& ev) {
-        // PUT events only: the topic's TTL'd values self-clean with a
-        // kDelete ~30 s after each publish, which must not evict an entry
-        // legitimately re-cached since the original invalidation.
-        if (ev.type != coord::WatchEvent::Type::kPut) return;
-        if (ev.key.size() <= prefix.size()) return;
-        if (auto cache = weak.lock()) cache->invalidate(ev.key.substr(prefix.size()));
-      });
-  if (watch.ok()) {
-    inval_watch_ = watch.value();
-  } else {
-    LOG_WARN << "object cache: invalidation watch failed ("
-             << to_string(watch.error()) << "); degrading to lease expiry";
-  }
-}
-
-void ObjectClient::teardown_cache_watch() {
-  if (inval_coord_ && inval_watch_ >= 0) warn_if_error(inval_coord_->unwatch(inval_watch_), "cache-inval unwatch");
-  inval_watch_ = -1;
-  inval_coord_.reset();
-}
-
-void ObjectClient::configure_cache(uint64_t cache_bytes) {
-  teardown_cache_watch();
-  cache_.reset();
-  options_.cache_bytes = cache_bytes;
-  setup_cache();
-}
-
-void ObjectClient::sever_cache_watch_for_test() {
-  teardown_cache_watch();
-  // Push coherence is gone: entries must not outlive their lease.
-  if (cache_) cache_->expire_all_leases();
-}
-
-cache::ObjectCache::Bytes ObjectClient::cache_acquire(const ObjectKey& key) {
-  if (!cache_) return nullptr;
-  using Outcome = cache::ObjectCache::Outcome;
-  cache::ObjectCache::Hit hit;
-  if (embedded_ && !options_.cache_force_lease_mode) {
-    // Direct validation: linearizable with the in-process metadata.
-    const auto [gen, epoch] = embedded_->object_cache_version(key);
-    hit = cache_->lookup_validated(key, {gen, epoch});
-    if (hit.outcome == Outcome::kHit && hit.lease_lapsed) {
-      // Keep the keystone's LRU honest: validated hits never pass through
-      // get_workers, so once per lease period run a real (in-process)
-      // metadata read — it touches the object's last_access, without which
-      // pressure eviction would judge the hottest cached objects coldest
-      // and destroy them under their readers.
-      auto copies = get_workers(key);
-      const auto meta_at = std::chrono::steady_clock::now();
-      if (copies.ok() && !copies.value().empty()) {
-        const auto& c0 = copies.value().front();
-        const cache::ObjectVersion current{c0.cache_gen, c0.cache_version};
-        if (current.valid() && c0.cache_lease_ms > 0)
-          cache_->renew(key, current,
-                        meta_at + std::chrono::milliseconds(c0.cache_lease_ms));
-      }
-    }
-  } else {
-    hit = cache_->lookup(key);
-    if (hit.outcome == Outcome::kExpired) {
-      // Lease lapsed: ONE control RTT revalidates, then cache_revalidate
-      // applies the verdict (renew-and-serve vs snapshot-guarded drop).
-      auto copies = get_workers(key);
-      const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
-      if (!cache_revalidate(key, hit, copies, meta_at)) return nullptr;
-      hit.outcome = Outcome::kHit;
-    }
-  }
-  return hit.outcome == Outcome::kHit ? hit.bytes : nullptr;
-}
-
-bool ObjectClient::cache_revalidate(const ObjectKey& key,
-                                    const cache::ObjectCache::Hit& hit,
-                                    const Result<std::vector<CopyPlacement>>& meta,
-                                    std::chrono::steady_clock::time_point meta_at) {
-  if (meta.ok() && !meta.value().empty()) {
-    const auto& c0 = meta.value().front();
-    const cache::ObjectVersion current{c0.cache_gen, c0.cache_version};
-    if (current.valid() && c0.cache_lease_ms > 0) {
-      // renew() keeps/renews the resident entry iff it matches `current` —
-      // including one a concurrent reader refilled at `current` while we
-      // revalidated, which must not be clobbered; a moved resident version
-      // is dropped there (stale_reject). The snapshot is serveable only on
-      // a full version + content-stamp match (the stamp is the belt over
-      // braces across keystone incarnations).
-      cache_->renew(key, current, meta_at + std::chrono::milliseconds(c0.cache_lease_ms));
-      if (current == hit.version && c0.content_crc == hit.content_crc) {
-        cache_->count_revalidated_hit();
-        return true;
-      }
-      return false;
-    }
-  }
-  // Object gone, metadata unreachable, or the server stopped granting:
-  // drop OUR snapshot only (never a newer concurrent fill).
-  cache_->invalidate_if_version(key, hit.version);
-  return false;
-}
-
-bool ObjectClient::cache_serve(const ObjectKey& key, void* out, uint64_t out_cap,
-                               uint64_t& got) {
-  auto bytes = cache_acquire(key);
-  if (!bytes || bytes->size() > out_cap) return false;
-  std::memcpy(out, bytes->data(), bytes->size());
-  got = bytes->size();
-  cache::note_cached_serve(got);  // lane counts bytes actually delivered
-  return true;
-}
-
-void ObjectClient::cache_fill(const ObjectKey& key, const CopyPlacement& copy,
-                              const uint8_t* data, uint64_t size,
-                              std::chrono::steady_clock::time_point granted_at) {
-  if (!cache_ || size == 0 || size > options_.cache_max_object_bytes) return;
-  const cache::ObjectVersion version{copy.cache_gen, copy.cache_version};
-  // Only keystone-granted (version + lease), CRC-stamped reads are
-  // cacheable — "a hit returns verified bytes" is a contract, not a mood.
-  if (!version.valid() || copy.cache_lease_ms == 0 || copy.content_crc == 0) return;
-  // The lease runs from the moment the grant was FETCHED, not from fill:
-  // a slow transfer between the two must never stretch the staleness bound
-  // past grant + lease.
-  cache_->fill(key, version, copy.content_crc,
-               std::make_shared<const std::vector<uint8_t>>(data, data + size),
-               granted_at + std::chrono::milliseconds(copy.cache_lease_ms));
-}
-
-std::optional<uint64_t> ObjectClient::cached_object_size(const ObjectKey& key) {
-  if (!cache_) return std::nullopt;
-  auto hit = cache_->peek(key);
-  if (!hit.bytes) return std::nullopt;
-  if (embedded_ && !options_.cache_force_lease_mode) {
-    const auto [gen, epoch] = embedded_->object_cache_version(key);
-    if (!(cache::ObjectVersion{gen, epoch} == hit.version)) return std::nullopt;
-  } else if (hit.outcome != cache::ObjectCache::Outcome::kHit) {
-    return std::nullopt;  // lease lapsed: let the probe revalidate normally
-  }
-  return hit.bytes->size();
-}
-
-// Runs `attempt` against possibly-cached placements with ONE fresh-metadata
-// retry when every cached placement failed — the single home of the cache
-// discipline documented on ClientOptions::placement_cache_ms.
-ErrorCode ObjectClient::read_with_cache(
-    const ObjectKey& key, bool verify,
-    const std::function<ErrorCode(const std::vector<CopyPlacement>&, bool)>& attempt) {
-  bool from_cache = false;
-  auto copies = verify ? get_workers_cached(key, from_cache) : get_workers(key);
-  if (!copies.ok()) return copies.error();
-  ErrorCode ec = attempt(copies.value(), from_cache);
-  if (ec == ErrorCode::OK || !from_cache) return ec;
-  // Cached placements failed (moved bytes, dead worker, size change):
-  // drop the entry and retry once with fresh metadata.
-  invalidate_placements(key);
-  from_cache = false;
-  copies = get_workers_cached(key, from_cache);
-  if (!copies.ok()) return copies.error();
-  return attempt(copies.value(), from_cache);
-}
-
-ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size) {
-  return put(key, data, size, options_.default_config);
-}
-
-ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
-                            const WorkerConfig& config) {
-  trace::OpScope op_trace("put");  // relabeled once the serving tier is known
-  TRACE_SPAN("client.put");
-  // The end-to-end budget covers every tier probe, transfer, and retry
-  // below; a RETRY_LATER shed re-runs the whole body after jittered backoff
-  // (safe: a shed provably did not execute, and put_many rolls back failed
-  // reservations before reporting).
-  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
-  return with_shed_retry([&]() -> ErrorCode {
-    // Tiny objects ride the inline tier when the keystone grants it: ONE
-    // control RTT stores the bytes in the object map, and the first verified
-    // read needs no data-plane hop at all. nullopt = not applicable — fall
-    // through to slots/placed.
-    if (auto inl = put_via_inline(key, data, size, config)) {
-      op_trace.relabel("put_inline");
-      return *inl;
-    }
-    // Small objects ride the pooled-slot path when possible: write into a
-    // pre-allocated slot, then ONE control RTT commits it as `key` (and
-    // refills the pool in the same round trip). nullopt = not applicable
-    // (disabled, oversized, EC, embedded, slot reclaimed) — fall through.
-    if (auto pooled = put_via_slot(key, data, size, config)) {
-      op_trace.relabel("put_slot");
-      return *pooled;
-    }
-    // One-item batch: put_many pipelines the wire shards of EVERY copy in a
-    // single pass (a replicated put costs ~one round trip, not one per copy),
-    // coalesces device shards, and rolls back failed reservations — the exact
-    // single-object semantics (put_start -> transfer -> complete/cancel,
-    // reference blackbird_client.cpp:87-117) with none of the code repeated.
-    return put_many({{key, data, size}}, config)[0];
-  });
-}
-
-Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
-                                               std::optional<bool> verify) {
-  // Hot path: a coherent cached entry answers with one memcpy and zero
-  // worker involvement (the bytes were verified at fill time). It gets the
-  // SAMPLED light instrumentation (cached_probe_*): the full OpScope below
-  // costs a few hundred ns, which the ~2us cached serve cannot absorb
-  // inside the bench.py trace-overhead budget, while the wire-bound path
-  // below hides it completely.
-  const uint64_t cached_t0 = cached_probe_start();
-  if (auto cached = cache_acquire(key)) {
-    cache::note_cached_serve(cached->size());
-    std::vector<uint8_t> out(cached->begin(), cached->end());
-    cached_probe_finish(cached_t0);
-    return out;
-  }
-  trace::OpScope op_trace("get");
-  TRACE_SPAN("client.get");
-  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
-  const bool v = verify.value_or(verify_reads());
-  std::vector<uint8_t> buffer;
-  const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
-      key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
-        const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
-        uint64_t size = 0;
-        if (!copies.empty()) size = copy_logical_size(copies.front());
-        buffer.resize(size);
-        if (try_split_read(copies, buffer.data(), size, v) == ErrorCode::OK) {
-          if (v && !stale_meta) cache_fill(key, copies.front(), buffer.data(), size, meta_at);
-          return ErrorCode::OK;
-        }
-        // Per-copy failover via the replica attempt engine: breaker-aware
-        // candidate order, hedged when the first copy runs long. Corruption
-        // stays the strongest reported signal (see attempt_copies).
-        uint64_t got_size = 0;
-        const CopyPlacement* winner = nullptr;
-        const ErrorCode aec = attempt_copies(
-            copies, v,
-            [&](uint64_t copy_size) -> uint8_t* {
-              buffer.resize(copy_size);
-              return buffer.data();
-            },
-            got_size, &winner);
-        if (aec != ErrorCode::OK) return aec;
-        if (v && !stale_meta && winner)
-          cache_fill(key, *winner, buffer.data(), got_size, meta_at);
-        return ErrorCode::OK;
-      }); });
-  if (ec != ErrorCode::OK) return ec;
-  return buffer;
-}
-
-Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
-                                        uint64_t buffer_size, std::optional<bool> verify) {
-  uint64_t got = 0;
-  // Hot path: serve verified bytes straight out of the object cache (an
-  // entry too large for `buffer` falls through; the normal path reports
-  // BUFFER_OVERFLOW with fresh metadata). Sampled light instrumentation —
-  // see cached_probe_start for the overhead-budget rationale.
-  const uint64_t cached_t0 = cached_probe_start();
-  if (cache_ && cache_serve(key, buffer, buffer_size, got)) {
-    cached_probe_finish(cached_t0);
-    return got;
-  }
-  trace::OpScope op_trace("get");
-  TRACE_SPAN("client.get");
-  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
-  const bool v = verify.value_or(verify_reads());
-  const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
-      key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
-        const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
-        uint64_t size = 0;
-        if (!copies.empty()) size = copy_logical_size(copies.front());
-        if (size <= buffer_size &&
-            try_split_read(copies, static_cast<uint8_t*>(buffer), size, v) ==
-                ErrorCode::OK) {
-          got = size;
-          if (v && !stale_meta)
-            cache_fill(key, copies.front(), static_cast<const uint8_t*>(buffer), size,
-                       meta_at);
-          return ErrorCode::OK;
-        }
-        // Replica attempt engine (breakers + hedging); an oversized copy is
-        // refused by the buffer callback and participates in the
-        // cache-retry as BUFFER_OVERFLOW, exactly like the old loop.
-        const CopyPlacement* winner = nullptr;
-        const ErrorCode aec = attempt_copies(
-            copies, v,
-            [&](uint64_t copy_size) -> uint8_t* {
-              return copy_size > buffer_size ? nullptr : static_cast<uint8_t*>(buffer);
-            },
-            got, &winner);
-        if (aec != ErrorCode::OK) return aec;
-        if (v && !stale_meta && winner)
-          cache_fill(key, *winner, static_cast<const uint8_t*>(buffer), got, meta_at);
-        return ErrorCode::OK;
-      }); });
-  if (ec != ErrorCode::OK) return ec;
-  return got;
-}
 
 ErrorCode ObjectClient::fabric_offer(const RemoteDescriptor& remote, uint64_t addr,
                                      uint64_t rkey, uint64_t len, uint64_t transfer_id) {
@@ -658,1669 +293,6 @@ Result<ViewVersionId> ObjectClient::ping() {
 // repair/demotion data movers.
 ErrorCode ObjectClient::shard_io(const ShardPlacement& shard, uint8_t* buf, bool is_write) {
   return transport::shard_io(*data_, shard, 0, buf, shard.length, is_write);
-}
-
-// Wide replicated reads split the byte range into slices assigned
-// round-robin across replicas, issued as ONE pipelined batch — aggregate
-// read bandwidth is every replica's link, not one (the reference left this
-// as a TODO, blackbird_client.cpp:283). Any failure reports back and the
-// caller falls back to sequential per-copy reads, so a dead replica costs a
-// retry, never the object.
-ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
-                                       uint8_t* buffer, uint64_t size, bool verify) {
-  constexpr uint64_t kSplitReadMin = 512 * 1024;  // below this, one copy wins
-  if (copies.size() < 2 || size < kSplitReadMin || options_.io_parallelism < 2)
-    return ErrorCode::NOT_IMPLEMENTED;
-  for (const auto& copy : copies) {
-    uint64_t copy_size = 0;
-    for (const auto& shard : copy.shards) {
-      if (!std::holds_alternative<MemoryLocation>(shard.location))
-        return ErrorCode::NOT_IMPLEMENTED;  // device reads batch better whole
-      copy_size += shard.length;
-    }
-    if (copy_size != size) return ErrorCode::NOT_IMPLEMENTED;  // divergent copies
-  }
-  const uint64_t n_slices =
-      std::min<uint64_t>(options_.io_parallelism, size / (kSplitReadMin / 2));
-  const uint64_t slice = (size + n_slices - 1) / n_slices;
-  std::vector<transport::WireOp> ops;
-  for (uint64_t j = 0; j < n_slices; ++j) {
-    const uint64_t lo = j * slice;
-    const uint64_t len = std::min(slice, size - lo);
-    if (!transport::append_range_wire_ops(copies[j % copies.size()], lo, len, buffer + lo,
-                                          ops))
-      return ErrorCode::NOT_IMPLEMENTED;
-  }
-  const uint32_t expect = copies.front().content_crc;
-  // Content-unstamped but shard-stamped (pre-v3 completion): bow out so the
-  // per-copy path runs its shard-stamp fallback — a split read here would
-  // silently skip verification.
-  if (verify && expect == 0 &&
-      copies.front().shard_crcs.size() == copies.front().shards.size())
-    return ErrorCode::NOT_IMPLEMENTED;
-  const bool check = verify && expect != 0;
-  // Transport-computed CRCs: ops cover [0, size) contiguously in array
-  // order (slices ascending, ranges within a slice ascending), so their
-  // ordered combine IS the object CRC — no post-pass over the buffer.
-  for (auto& op : ops) op.want_crc = check;
-  if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
-      ec != ErrorCode::OK)
-    return ec;
-  if (check) {
-    uint32_t combined = 0;
-    for (size_t j = 0; j < ops.size(); ++j) {
-      combined = j == 0 ? ops[j].crc : crc32c_combine(combined, ops[j].crc, ops[j].len);
-    }
-    if (combined != expect) {
-      // Some slice came from a corrupt replica; the caller's per-copy
-      // (verified) reads identify the healthy one.
-      LOG_WARN << "content crc mismatch on split-replica read: retrying per copy";
-      return ErrorCode::CHECKSUM_MISMATCH;
-    }
-  }
-  return ErrorCode::OK;
-}
-
-// ---- erasure-coded copies --------------------------------------------------
-//
-// An EC copy holds k data shards (equal length L = ceil(size/k), last one
-// zero-padded) + m Reed-Solomon parity shards (btpu/ec/rs.h). Writes encode
-// and send all k+m in one pipelined batch; reads fetch the k data shards
-// and only on failure fetch survivors + parity and reconstruct (systematic
-// code: the healthy path never decodes).
-
-ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* data,
-                                         uint64_t size, bool is_write, bool verify) {
-  const size_t k = copy.ec_data_shards;
-  const size_t m = copy.ec_parity_shards;
-  if (copy.shards.size() != k + m || size != copy.ec_object_size)
-    return ErrorCode::INVALID_PARAMETERS;
-  const uint64_t L = copy.shards.front().length;
-  for (const auto& shard : copy.shards) {
-    if (shard.length != L) return ErrorCode::INVALID_PARAMETERS;
-  }
-  // Data shard i holds object bytes [i*L, i*L+valid_of(i)); with small
-  // objects (size < k*L - L) SEVERAL trailing shards are partly or wholly
-  // padding, not just the last one.
-  auto valid_of = [&](size_t i) -> uint64_t {
-    const uint64_t start = i * L;
-    return start >= size ? 0 : std::min<uint64_t>(L, size - start);
-  };
-  // Shards with padding read/write through a temp; full shards use the
-  // user buffer directly.
-  std::vector<std::vector<uint8_t>> temps(k);
-  auto shard_buf = [&](size_t i) -> uint8_t* {
-    if (valid_of(i) == L) return data + i * L;
-    if (temps[i].empty()) temps[i].assign(L, 0);
-    return temps[i].data();
-  };
-
-  if (is_write) {
-    std::vector<const uint8_t*> data_ptrs(k);
-    for (size_t i = 0; i < k; ++i) {
-      uint8_t* buf = shard_buf(i);
-      if (valid_of(i) < L && valid_of(i) > 0) std::memcpy(buf, data + i * L, valid_of(i));
-      data_ptrs[i] = buf;
-    }
-    std::vector<std::vector<uint8_t>> parity(m, std::vector<uint8_t>(L));
-    std::vector<uint8_t*> parity_ptrs(m);
-    for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity[j].data();
-    if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
-      return ErrorCode::INVALID_PARAMETERS;
-
-    std::vector<transport::WireOp> ops(k + m);
-    for (size_t i = 0; i < k + m; ++i) {
-      uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity[i - k].data();
-      if (!transport::make_wire_op(copy.shards[i], 0, buf, L, ops[i]))
-        return ErrorCode::NOT_IMPLEMENTED;
-    }
-    return data_->write_batch(ops.data(), ops.size(), options_.io_parallelism);
-  }
-
-  // Read path: fetch the k data shards (systematic code: no decode when
-  // they all arrive). A shard with no wire address (e.g. one mid-repair or
-  // mis-placed on a device tier) counts as MISSING — that is exactly the
-  // failure parity exists to absorb, not a reason to abort the read.
-  std::vector<transport::WireOp> ops(k);
-  std::vector<bool> addressable(k + m, true);
-  std::vector<bool> padding_only(k, false);
-  for (size_t i = 0; i < k; ++i) {
-    if (valid_of(i) == 0) {
-      // Pure padding: content is all zeros by construction — shard_buf's
-      // temp already is; no wire fetch, and it can serve reconstruction.
-      padding_only[i] = true;
-      (void)shard_buf(i);
-      ops[i] = {};
-      continue;
-    }
-    if (!transport::make_wire_op(copy.shards[i], 0, shard_buf(i), L, ops[i])) {
-      addressable[i] = false;
-      ops[i] = {};  // len 0: skipped by the batch
-    }
-  }
-  (void)data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);  // per-op status consumed below; CRC gate backstops
-  // Shard i's current bytes (user buffer or padded temp).
-  auto shard_bytes = [&](size_t i) -> const uint8_t* {
-    return temps[i].empty() ? data + i * L : temps[i].data();
-  };
-  // Per-shard CRCs (when the writer stamped them) LOCALIZE corruption: a
-  // shard whose bytes arrived but fail its own CRC is treated exactly like
-  // a missing shard, so the one reconstruction path below absorbs any mix
-  // of lost and bit-rotten shards up to m — multi-shard corruption included
-  // (the object-level CRC alone can only detect that case, not repair it).
-  const bool stamped = verify && copy.shard_crcs.size() == k + m;
-  size_t condemned = 0;  // shards whose bytes arrived but failed their CRC
-  auto shard_corrupt = [&](size_t i, const uint8_t* bytes) {
-    if (!stamped) return false;
-    if (crc32c(bytes, L) == copy.shard_crcs[i]) return false;
-    const auto& s = copy.shards[i];
-    LOG_WARN << "ec read: shard " << i << " corrupt (pool " << s.pool_id << ", worker "
-             << s.worker_id << ")";
-    ++condemned;
-    return true;
-  };
-  std::vector<bool> have(k + m, false);
-  size_t missing = 0;
-  for (size_t i = 0; i < k; ++i) {
-    have[i] = padding_only[i] ||
-              (addressable[i] && ops[i].status == ErrorCode::OK &&
-               !shard_corrupt(i, shard_bytes(i)));
-    if (!have[i]) ++missing;
-  }
-  auto copy_out = [&](size_t i, const uint8_t* src) {
-    if (valid_of(i) > 0 && valid_of(i) < L) std::memcpy(data + i * L, src, valid_of(i));
-  };
-  // Parity fetch (shared by the degraded path and the corruption hunt).
-  std::vector<std::vector<uint8_t>> parity;
-  auto fetch_parity = [&] {
-    if (!parity.empty()) return;
-    parity.assign(m, std::vector<uint8_t>(L));
-    std::vector<transport::WireOp> pops(m);
-    for (size_t j = 0; j < m; ++j) {
-      if (!transport::make_wire_op(copy.shards[k + j], 0, parity[j].data(), L, pops[j])) {
-        addressable[k + j] = false;
-        pops[j] = {};
-      }
-    }
-    (void)data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);  // per-op status consumed below; CRC gate backstops
-    for (size_t j = 0; j < m; ++j)
-      have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK &&
-                    !shard_corrupt(k + j, parity[j].data());
-  };
-  // Verifies the object CRC treating per-shard sources; `override_i`/bytes
-  // substitute one shard (the corruption hunt's candidate reconstruction).
-  auto crc_with = [&](size_t override_i, const uint8_t* override_bytes) {
-    uint32_t crc = 0;
-    for (size_t i = 0; i < k; ++i) {
-      const uint64_t valid = valid_of(i);
-      if (valid == 0) break;
-      const uint8_t* src = i == override_i ? override_bytes : shard_bytes(i);
-      crc = crc32c(src, valid, crc);
-    }
-    return crc;
-  };
-
-  if (missing == 0) {
-    if (!verify || copy.content_crc == 0 || crc_with(k + m, nullptr) == copy.content_crc) {
-      for (size_t i = 0; i < k; ++i) {
-        if (!temps[i].empty()) copy_out(i, temps[i].data());
-      }
-      return ErrorCode::OK;
-    }
-    // CRC mismatch with every data shard readable: one of them is silently
-    // corrupt (bit rot). Hunt it — reconstruct each candidate from parity
-    // in turn and keep the variant whose CRC matches.
-    LOG_WARN << "ec read: content crc mismatch, hunting the corrupt shard";
-    fetch_parity();
-    std::vector<uint8_t> candidate(L);
-    for (size_t i = 0; i < k; ++i) {
-      if (valid_of(i) == 0) break;  // padding shards cannot corrupt the crc
-      std::vector<const uint8_t*> present(k + m, nullptr);
-      for (size_t x = 0; x < k; ++x) {
-        if (x != i) present[x] = shard_bytes(x);
-      }
-      for (size_t j = 0; j < m; ++j) {
-        if (have[k + j]) present[k + j] = parity[j].data();
-      }
-      std::vector<uint8_t*> out(k, nullptr);
-      out[i] = candidate.data();
-      if (!ec::rs_reconstruct(present.data(), k, m, L, out.data())) continue;
-      if (crc_with(i, candidate.data()) == copy.content_crc) {
-        LOG_WARN << "ec read: shard " << i << " was corrupt; reconstructed through parity";
-        const uint64_t valid = valid_of(i);
-        std::memcpy(data + i * L, candidate.data(), valid);
-        for (size_t x = 0; x < k; ++x) {
-          if (x != i && !temps[x].empty()) copy_out(x, temps[x].data());
-        }
-        return ErrorCode::OK;
-      }
-    }
-    return ErrorCode::CHECKSUM_MISMATCH;  // multi-shard corruption: beyond m=?
-  }
-  // Beyond tolerance: when CRC condemnation contributed, report corruption
-  // (scrubbers key off CHECKSUM_MISMATCH, not transport loss).
-  if (missing > m) {
-    return condemned > 0 ? ErrorCode::CHECKSUM_MISMATCH : ErrorCode::NO_COMPLETE_WORKER;
-  }
-
-  // Degraded read: fetch parity shards, reconstruct the missing data.
-  LOG_WARN << "ec read: " << missing << " data shard(s) unreadable, reconstructing";
-  fetch_parity();
-
-  std::vector<std::vector<uint8_t>> rebuilt(k);
-  std::vector<const uint8_t*> present(k + m, nullptr);
-  std::vector<uint8_t*> out(k, nullptr);
-  for (size_t i = 0; i < k; ++i) {
-    if (have[i]) {
-      present[i] = shard_bytes(i);
-    } else {
-      rebuilt[i].resize(L);
-      out[i] = rebuilt[i].data();
-    }
-  }
-  for (size_t j = 0; j < m; ++j) {
-    if (have[k + j]) present[k + j] = parity[j].data();
-  }
-  if (!ec::rs_reconstruct(present.data(), k, m, L, out.data()))
-    return condemned > 0 ? ErrorCode::CHECKSUM_MISMATCH : ErrorCode::NO_COMPLETE_WORKER;
-  for (size_t i = 0; i < k; ++i) {
-    if (have[i]) {
-      if (!temps[i].empty()) copy_out(i, temps[i].data());
-    } else if (valid_of(i) > 0) {
-      std::memcpy(data + i * L, rebuilt[i].data(), valid_of(i));
-    }
-  }
-  if (verify && copy.content_crc != 0) {
-    uint32_t crc = 0;
-    for (size_t i = 0; i < k && valid_of(i) > 0; ++i) {
-      const uint8_t* src = have[i] ? shard_bytes(i) : rebuilt[i].data();
-      crc = crc32c(src, valid_of(i), crc);
-    }
-    if (crc != copy.content_crc) {
-      LOG_WARN << "ec read: crc mismatch after degraded reconstruction";
-      return ErrorCode::CHECKSUM_MISMATCH;
-    }
-  }
-  return ErrorCode::OK;
-}
-
-// Shared by the single-object and batched paths: device-location shards are
-// coalesced into ONE provider scatter/gather call (per-op device latency is
-// the enemy, hbm_provider.h v2), wire shards move as one pipelined batch.
-ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
-                                      bool is_write, bool verify) {
-  if (!copy.inline_data.empty()) {
-    // Inline tier: the metadata reply already carried the bytes — a read is
-    // a memcpy (plus the CRC gate), and a write is meaningless here (inline
-    // objects are written whole through put_inline, never through
-    // placements).
-    if (is_write || size != copy.inline_data.size()) return ErrorCode::INVALID_PARAMETERS;
-    if (verify && copy.content_crc != 0 &&
-        crc32c(copy.inline_data.data(), copy.inline_data.size()) != copy.content_crc)
-      return ErrorCode::CHECKSUM_MISMATCH;
-    std::memcpy(data, copy.inline_data.data(), copy.inline_data.size());
-    return ErrorCode::OK;
-  }
-  if (copy.ec_data_shards > 0) return transfer_copy_ec(copy, data, size, is_write, verify);
-  // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
-  std::vector<uint64_t> offsets(copy.shards.size());
-  uint64_t off = 0;
-  for (size_t i = 0; i < copy.shards.size(); ++i) {
-    offsets[i] = off;
-    off += copy.shards[i].length;
-  }
-  if (off != size) return ErrorCode::INVALID_PARAMETERS;
-  std::vector<transport::ShardJob> device_jobs;
-  std::vector<size_t> wire_idx;
-  for (size_t i = 0; i < copy.shards.size(); ++i) {
-    if (std::holds_alternative<DeviceLocation>(copy.shards[i].location)) {
-      device_jobs.push_back({&copy.shards[i], 0, data + offsets[i], copy.shards[i].length});
-    } else {
-      wire_idx.push_back(i);
-    }
-  }
-  if (!device_jobs.empty()) {
-    if (auto ec = transport::shard_io_batch(*data_, device_jobs.data(), device_jobs.size(),
-                                            is_write);
-        ec != ErrorCode::OK)
-      return ec;
-    // Device writes may be asynchronous; a single-object put must be durable
-    // in the tier before put_complete is sent (put_many batches this flush).
-    if (is_write) {
-      if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
-    }
-  }
-  // Whole-object stamp preferred; per-shard stamps arm verification when
-  // the content stamp is missing (e.g. an object completed through a
-  // pre-v3 keystone during a rolling upgrade drops the appended
-  // content_crc field but still applies shard_crcs — integrity must not
-  // silently lapse for those).
-  const bool have_shard_stamps =
-      copy.shard_crcs.size() == copy.shards.size() && !copy.shards.empty();
-  const bool check = verify && !is_write && (copy.content_crc != 0 || have_shard_stamps);
-  std::vector<transport::WireOp> ops;
-  if (!wire_idx.empty()) {
-    // Wire shards move as one pipelined batch: every request issued before
-    // any response is awaited, so a striped object costs ~one round trip.
-    ops.reserve(wire_idx.size());
-    for (size_t i : wire_idx) {
-      const auto& shard = copy.shards[i];
-      transport::WireOp op;
-      if (!transport::make_wire_op(shard, 0, data + offsets[i], shard.length, op))
-        return ErrorCode::NOT_IMPLEMENTED;  // FileLocation: worker-served
-      // Verified reads: the transport hashes the bytes WHILE they move
-      // (per-segment under the socket drain, fused with staging copies), so
-      // the integrity check below needs no second pass over wire shards.
-      op.want_crc = check;
-      ops.push_back(op);
-    }
-    if (is_write)
-      return data_->write_batch(ops.data(), ops.size(), options_.io_parallelism);
-    if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
-        ec != ErrorCode::OK)
-      return ec;
-  } else if (is_write) {
-    return ErrorCode::OK;
-  }
-  // Verify AFTER every shard (device and wire alike) has landed: a
-  // device-only copy bit-rots just as silently as a host one. Wire shard
-  // CRCs come from the transport; device shards (provider-filled) are
-  // hashed here; the object CRC is their ordered combine.
-  if (check) {
-    std::vector<uint32_t> shard_crc(copy.shards.size(), 0);
-    for (size_t j = 0; j < wire_idx.size(); ++j) shard_crc[wire_idx[j]] = ops[j].crc;
-    for (size_t i = 0; i < copy.shards.size(); ++i) {
-      if (std::holds_alternative<DeviceLocation>(copy.shards[i].location))
-        shard_crc[i] = crc32c(data + offsets[i], copy.shards[i].length);
-    }
-    bool ok;
-    if (copy.content_crc != 0) {
-      uint32_t combined = 0;
-      for (size_t i = 0; i < copy.shards.size(); ++i)
-        combined = i == 0 ? shard_crc[i]
-                          : crc32c_combine(combined, shard_crc[i], copy.shards[i].length);
-      ok = combined == copy.content_crc;
-    } else {
-      // Shard-stamp fallback: every shard must match its own stamp.
-      ok = true;
-      for (size_t i = 0; i < copy.shards.size(); ++i) ok &= shard_crc[i] == copy.shard_crcs[i];
-    }
-    if (!ok) {
-      LOG_WARN << "content crc mismatch on copy " << copy.copy_index
-               << " (bit rot or torn write): treating as copy loss";
-      // Stamped shard CRCs localize the rot for the operator/scrubber.
-      if (have_shard_stamps) {
-        for (size_t i = 0; i < copy.shards.size(); ++i) {
-          if (shard_crc[i] != copy.shard_crcs[i]) {
-            const auto& s = copy.shards[i];
-            LOG_WARN << "  corrupt shard " << i << " (pool " << s.pool_id << ", worker "
-                     << s.worker_id << ")";
-          }
-        }
-      }
-      return ErrorCode::CHECKSUM_MISMATCH;
-    }
-  }
-  return ErrorCode::OK;
-}
-
-ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
-                                          uint64_t size) {
-  // Writes never verify-on-read; the flag is meaningless here.
-  return transfer_copy(copy, const_cast<uint8_t*>(data), size, /*is_write=*/true,
-                       /*verify=*/false);
-}
-
-ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* data,
-                                          uint64_t size, bool verify) {
-  return transfer_copy(copy, data, size, /*is_write=*/false, verify);
-}
-
-// ---- replica attempt engine (breakers + hedged reads) -----------------------
-
-namespace {
-// Breaker/hedge identity of a copy: its first wire-addressable shard's
-// transport endpoint. Inline and device-only copies have none ("") — they
-// are served locally, so they are neither breaker-ordered nor hedged.
-const std::string& copy_endpoint(const CopyPlacement& copy) {
-  static const std::string kNone;
-  if (!copy.inline_data.empty()) return kNone;
-  for (const auto& shard : copy.shards) {
-    if (!shard.remote.endpoint.empty() &&
-        std::holds_alternative<MemoryLocation>(shard.location))
-      return shard.remote.endpoint;
-  }
-  return kNone;
-}
-
-uint64_t us_since(std::chrono::steady_clock::time_point t0) {
-  return static_cast<uint64_t>(std::chrono::duration_cast<std::chrono::microseconds>(
-                                   std::chrono::steady_clock::now() - t0)
-                                   .count());
-}
-}  // namespace
-
-std::vector<size_t> ObjectClient::order_copies(const std::vector<CopyPlacement>& copies) {
-  std::vector<size_t> order(copies.size());
-  for (size_t i = 0; i < copies.size(); ++i) order[i] = i;
-  if (copies.size() < 2) return order;
-  // Stable partition: copies on OPEN endpoints sort last — deprioritized,
-  // never dropped. When every replica's breaker is open the read proceeds
-  // in the original order (a degraded read beats no read).
-  std::stable_partition(order.begin(), order.end(), [&](size_t i) {
-    const std::string& ep = copy_endpoint(copies[i]);
-    if (ep.empty()) return true;
-    if (!breakers_.for_endpoint(ep)->open_now()) return true;
-    // ordering: relaxed — monotonic stat counter.
-    robust_counters().breaker_skips.fetch_add(1, std::memory_order_relaxed);
-    return false;
-  });
-  return order;
-}
-
-void ObjectClient::record_copy_outcome(const CopyPlacement& copy, ErrorCode ec,
-                                       uint64_t us) {
-  const std::string& ep = copy_endpoint(copy);
-  if (ep.empty()) return;
-  auto breaker = breakers_.for_endpoint(ep);
-  if (ec == ErrorCode::OK) {
-    breaker->record_success(us);
-  } else if (ec != ErrorCode::DEADLINE_EXCEEDED) {
-    // A spent budget indicts the caller's deadline, not this endpoint;
-    // everything else (transport error, corruption, shed) is the replica
-    // failing to serve and feeds the trip counter.
-    breaker->record_failure();
-  }
-}
-
-uint64_t ObjectClient::hedge_delay_us() const {
-  if (!options_.hedge_reads) return 0;
-  if (options_.hedge_delay_ms > 0) return static_cast<uint64_t>(options_.hedge_delay_ms) * 1000;
-  // Adaptive trigger: the op's observed p95 — ~5% of reads hedge, which is
-  // the Tail-at-Scale sweet spot (tail coverage at ~negligible extra load).
-  return read_latency_.quantile_us(0.95, options_.hedge_min_samples);
-}
-
-// Every race pays one thread spawn + one size-byte private buffer UP FRONT,
-// even for the ~95% of reads whose primary beats the trigger. That price is
-// structural, not an oversight: transfers block, so first-wins (returning
-// the moment EITHER replica finishes — the entire p99 win) requires the
-// primary off the calling thread from t0, and the primary needs a private
-// buffer because the caller may have returned with the hedge's bytes while
-// the primary thread is still writing. Callers that cannot hedge (one
-// endpoint, no trigger samples, hedging off) never enter here; a persistent
-// hedge executor would amortize the spawn if this path ever shows up hot.
-ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
-                                    const CopyPlacement& secondary, uint64_t size,
-                                    bool verify, uint8_t* out,
-                                    const CopyPlacement** winner) {
-  struct Race {
-    Mutex m;
-    CondVarAny cv;
-    bool primary_done BTPU_GUARDED_BY(m){false};
-    ErrorCode primary_ec BTPU_GUARDED_BY(m){ErrorCode::OK};
-    // The primary fills a PRIVATE buffer: first-wins must never race the
-    // caller's buffer (the hedge writes `out` directly on this thread).
-    std::vector<uint8_t> primary_buf;
-  };
-  auto race = std::make_shared<Race>();
-  race->primary_buf.resize(size);
-  const auto t0 = std::chrono::steady_clock::now();
-  // The ambient deadline is thread-local: hand it to the primary's thread
-  // explicitly so its wire ops still carry the caller's budget.
-  const Deadline op_deadline = current_op_deadline();
-  if (!copy_endpoint(primary).empty()) breakers_.for_endpoint(copy_endpoint(primary))->allow();
-  // ordering: acq_rel — the increment must be visible before the spawned
-  // thread can decrement (release), and the destructor's acquire load of 0
-  // must see every loser's writes as retired.
-  hedge_inflight_.fetch_add(1, std::memory_order_acq_rel);
-  BTPU_SCHED_DECL_SPAWN();
-  std::thread([this, race, copy = primary, size, verify, op_deadline, t0] {
-    BTPU_SCHED_ADOPT_SPAWNED();
-    OpDeadlineScope scope(op_deadline);
-    const ErrorCode ec = transfer_copy_get(copy, race->primary_buf.data(), size, verify);
-    record_copy_outcome(copy, ec, us_since(t0));
-    {
-      MutexLock lock(race->m);
-      race->primary_ec = ec;
-      race->primary_done = true;
-    }
-    race->cv.notify_all();
-#if defined(BTPU_SCHED)
-    if (sched::mutant_enabled("hedge_notify_after_unlock")) {
-      // PLANTED MUTANT — the exact pre-PR-5 bug shape this block's comment
-      // below exists to prevent: decrement under the mutex but notify AFTER
-      // unlock. The destructor's drain loop may observe inflight == 0 in
-      // the unlock/notify window and free the client, so the notify below
-      // touches a destroyed hedge_cv_ (SchedMutants matrix detects this as
-      // an ASan heap-use-after-free within the seed budget).
-      {
-        MutexLock lock(hedge_mutex_);
-        // ordering: acq_rel — pairs with the destructor's acquire drain load.
-        hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
-      }
-      hedge_cv_.notify_all();
-      return;
-    }
-#endif
-    {
-      // Notify UNDER the mutex: the destructor's drain loop frees the client
-      // the instant it observes inflight == 0, so a notify after unlock would
-      // touch a destroyed condition variable.
-      MutexLock lock(hedge_mutex_);
-      // ordering: acq_rel — pairs with the destructor's acquire drain load.
-      hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
-      hedge_cv_.notify_all();
-    }
-  }).detach();
-
-  const uint64_t delay_us = hedge_delay_us();
-  bool hedged = false;
-  {
-    MutexLock lock(race->m);
-    const auto trigger = t0 + std::chrono::microseconds(delay_us);
-    while (!race->primary_done) {
-      if (race->cv.wait_until(lock, trigger) == std::cv_status::timeout &&
-          !race->primary_done)
-        break;
-    }
-    if (race->primary_done) {
-      if (race->primary_ec == ErrorCode::OK) {
-        std::memcpy(out, race->primary_buf.data(), size);
-        read_latency_.record_us(us_since(t0));
-        if (winner) *winner = &primary;
-        return ErrorCode::OK;
-      }
-      // Primary failed before the trigger: the second attempt below is
-      // ordinary failover, not a hedge.
-    } else {
-      hedged = true;
-      // ordering: relaxed — monotonic stat counter.
-      robust_counters().hedges_fired.fetch_add(1, std::memory_order_relaxed);
-      flight::record(flight::Ev::kHedgeFired);
-    }
-  }
-
-  // The hedge (or failover) runs on the calling thread, straight into `out`.
-  if (!copy_endpoint(secondary).empty())
-    breakers_.for_endpoint(copy_endpoint(secondary))->allow();
-  const auto s0 = std::chrono::steady_clock::now();
-  const ErrorCode sec_ec = transfer_copy_get(secondary, out, size, verify);
-  record_copy_outcome(secondary, sec_ec, us_since(s0));
-
-  MutexLock lock(race->m);
-  if (sec_ec == ErrorCode::OK) {
-    if (hedged && !race->primary_done) {
-      // ordering: relaxed — monotonic stat counter.
-      robust_counters().hedge_wins.fetch_add(1, std::memory_order_relaxed);
-      flight::record(flight::Ev::kHedgeWin);
-    }
-    read_latency_.record_us(us_since(t0));
-    if (winner) *winner = &secondary;
-    return ErrorCode::OK;  // bytes already in `out`; the primary drains into its loser buffer
-  }
-  // Hedge failed: the primary is the only hope left — wait it out (its own
-  // wire ops carry the deadline, so a spent budget aborts it server-side).
-  while (!race->primary_done) race->cv.wait(lock);
-  if (race->primary_ec == ErrorCode::OK) {
-    std::memcpy(out, race->primary_buf.data(), size);
-    read_latency_.record_us(us_since(t0));
-    if (winner) *winner = &primary;
-    return ErrorCode::OK;
-  }
-  // Corruption is the strongest signal (scrubbers key off it).
-  if (sec_ec == ErrorCode::CHECKSUM_MISMATCH || race->primary_ec == ErrorCode::CHECKSUM_MISMATCH)
-    return ErrorCode::CHECKSUM_MISMATCH;
-  return race->primary_ec;
-}
-
-ErrorCode ObjectClient::attempt_copies(const std::vector<CopyPlacement>& copies,
-                                       bool verify,
-                                       const std::function<uint8_t*(uint64_t)>& buffer_for,
-                                       uint64_t& got_size, const CopyPlacement** winner) {
-  if (winner) *winner = nullptr;
-  const std::vector<size_t> order = order_copies(copies);
-  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
-  bool tried_hedge = false;
-  for (size_t oi = 0; oi < order.size(); ++oi) {
-    // A spent budget fails the op here instead of starting another replica
-    // transfer nobody is waiting for (transport-independent: TCP ops also
-    // carry the budget on the wire, but LOCAL/SHM have no wire to carry it).
-    if (oi > 0 && current_op_deadline().expired()) {
-      // ordering: relaxed — monotonic stat counter.
-      robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
-      return ErrorCode::DEADLINE_EXCEEDED;
-    }
-    const CopyPlacement& copy = copies[order[oi]];
-    const uint64_t copy_size = copy_logical_size(copy);
-    uint8_t* dst = buffer_for(copy_size);
-    if (!dst) {
-      // This copy cannot be accepted (caller's buffer too small). Keep the
-      // cache-retry semantics: a stale cached size must not mask a fit.
-      if (last == ErrorCode::NO_COMPLETE_WORKER) last = ErrorCode::BUFFER_OVERFLOW;
-      continue;
-    }
-    // Hedge opportunity: two wire-served same-size candidates on DIFFERENT
-    // endpoints, hedging enabled, and a trigger delay is known (fixed knob
-    // or enough observed samples for a p95).
-    if (!tried_hedge && options_.hedge_reads && oi + 1 < order.size()) {
-      const CopyPlacement& second = copies[order[oi + 1]];
-      const std::string& ep1 = copy_endpoint(copy);
-      const std::string& ep2 = copy_endpoint(second);
-      if (!ep1.empty() && !ep2.empty() && ep1 != ep2 &&
-          copy_logical_size(second) == copy_size && hedge_delay_us() > 0) {
-        tried_hedge = true;
-        const ErrorCode hec = hedged_race(copy, second, copy_size, verify, dst, winner);
-        if (hec == ErrorCode::OK) {
-          got_size = copy_size;
-          return ErrorCode::OK;
-        }
-        if (last != ErrorCode::CHECKSUM_MISMATCH) last = hec;
-        ++oi;  // both candidates consumed
-        continue;
-      }
-    }
-    const std::string& ep = copy_endpoint(copy);
-    if (!ep.empty()) breakers_.for_endpoint(ep)->allow();
-    const auto t0 = std::chrono::steady_clock::now();
-    const ErrorCode tec = transfer_copy_get(copy, dst, copy_size, verify);
-    const uint64_t us = us_since(t0);
-    record_copy_outcome(copy, tec, us);
-    if (tec == ErrorCode::OK) {
-      read_latency_.record_us(us);
-      got_size = copy_size;
-      if (winner) *winner = &copy;
-      return ErrorCode::OK;
-    }
-    if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
-    LOG_WARN << "get copy " << copy.copy_index << " failed (" << to_string(tec)
-             << "), trying next replica";
-  }
-  return last;
-}
-
-Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
-    const ObjectKey& key) {
-  auto copies = get_workers(key);
-  if (!copies.ok()) return copies.error();
-  std::vector<ShardFinding> findings;
-  // Stamped copies: every shard of every copy reads as ONE pipelined wire
-  // batch (per-op status lands on its finding), so the audit costs ~one
-  // round trip per object, not one per shard. Device-located shards can't
-  // ride the wire batch; they go through shard_io below.
-  std::vector<transport::WireOp> ops;
-  std::vector<size_t> op_finding;
-  std::vector<std::vector<uint8_t>> bufs;
-  struct Deferred {  // device shards + expected CRC, checked after the batch
-    size_t finding;
-    const ShardPlacement* shard;
-    uint32_t expect;
-  };
-  std::vector<Deferred> deferred;
-  std::vector<uint32_t> expected;  // parallel to findings (stamped ones)
-  std::vector<uint8_t> buf;
-  for (const auto& copy : copies.value()) {
-    if (copy.shard_crcs.size() == copy.shards.size() && !copy.shards.empty()) {
-      // Writer-stamped shard CRCs: verify each shard in isolation so the
-      // report names exactly which worker/pool holds rotten bytes.
-      for (size_t i = 0; i < copy.shards.size(); ++i) {
-        const auto& shard = copy.shards[i];
-        findings.push_back({copy.copy_index, static_cast<uint32_t>(i), shard.pool_id,
-                            shard.worker_id, ErrorCode::OK});
-        expected.resize(findings.size(), 0);
-        expected.back() = copy.shard_crcs[i];
-        bufs.emplace_back(shard.length);
-        transport::WireOp op;
-        if (transport::make_wire_op(shard, 0, bufs.back().data(), shard.length, op)) {
-          ops.push_back(op);
-          op_finding.push_back(findings.size() - 1);
-        } else {
-          deferred.push_back({findings.size() - 1, &shard, copy.shard_crcs[i]});
-        }
-      }
-      continue;
-    }
-    // Pre-shard-CRC copy: the object CRC can only judge the copy as a whole.
-    const uint64_t size = copy_logical_size(copy);
-    ShardFinding f{copy.copy_index, ShardFinding::kWholeCopy, {}, {}, ErrorCode::OK};
-    try {
-      buf.resize(size);
-      f.status = transfer_copy_get(copy, buf.data(), size, /*verify=*/true);
-    } catch (const std::bad_alloc&) {
-      f.status = ErrorCode::OUT_OF_MEMORY;
-    }
-    findings.push_back(std::move(f));
-    expected.resize(findings.size(), 0);
-  }
-  if (!ops.empty()) (void)data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);  // per-op status consumed below
-  for (size_t j = 0; j < ops.size(); ++j) {
-    auto& f = findings[op_finding[j]];
-    if (ops[j].status != ErrorCode::OK) {
-      f.status = ops[j].status;
-    } else if (crc32c(ops[j].buf, ops[j].len) != expected[op_finding[j]]) {
-      f.status = ErrorCode::CHECKSUM_MISMATCH;
-    }
-  }
-  for (const auto& d : deferred) {
-    auto& f = findings[d.finding];
-    buf.resize(d.shard->length);
-    if (auto ec = transport::shard_io(*data_, *d.shard, 0, buf.data(), d.shard->length,
-                                      /*is_write=*/false);
-        ec != ErrorCode::OK) {
-      f.status = ec;
-    } else if (crc32c(buf.data(), d.shard->length) != d.expect) {
-      f.status = ErrorCode::CHECKSUM_MISMATCH;
-    }
-  }
-  return findings;
-}
-
-// ---- batched object I/O ----------------------------------------------------
-
-namespace {
-
-// Per-item shard jobs for a whole batch, partitioned by data path.
-struct BatchJobs {
-  std::vector<transport::ShardJob> device;   // all items' device shards
-  std::vector<size_t> device_item;           // item index per device job
-  std::vector<transport::ShardJob> wire;     // all items' wire shards
-  std::vector<size_t> wire_item;
-};
-
-// Splits one copy of `size` bytes at `data` into jobs, appending to `jobs`.
-// Returns INVALID_PARAMETERS when the shard lengths do not sum to size.
-// `crcs_out` (when non-null) receives this copy's per-shard CRC32C stamps —
-// computed here because the put path is the one place the shard boundaries
-// and the bytes are both in hand.
-ErrorCode append_copy_jobs(const CopyPlacement& copy, uint8_t* data, uint64_t size,
-                           size_t item_index, BatchJobs& jobs,
-                           CopyShardCrcs* crcs_out = nullptr) {
-  if (crcs_out) {
-    crcs_out->copy_index = copy.copy_index;
-    crcs_out->crcs.clear();
-    crcs_out->crcs.reserve(copy.shards.size());
-  }
-  uint64_t off = 0;
-  for (const auto& shard : copy.shards) {
-    if (off + shard.length > size) return ErrorCode::INVALID_PARAMETERS;
-    transport::ShardJob job{&shard, 0, data + off, shard.length};
-    if (std::holds_alternative<DeviceLocation>(shard.location)) {
-      jobs.device.push_back(job);
-      jobs.device_item.push_back(item_index);
-    } else {
-      jobs.wire.push_back(job);
-      jobs.wire_item.push_back(item_index);
-    }
-    if (crcs_out) crcs_out->crcs.push_back(crc32c(data + off, shard.length));
-    off += shard.length;
-  }
-  return off == size ? ErrorCode::OK : ErrorCode::INVALID_PARAMETERS;
-}
-
-// Coded-copy batch helpers. Arena owns padded-data and parity buffers until
-// the wire batch executes (inner-vector buffers stay put when the arena
-// grows). EC pools are wire-only by placement, so every job is a wire job.
-ErrorCode append_ec_put_jobs(const CopyPlacement& copy, const uint8_t* data, uint64_t size,
-                             size_t item_index, std::vector<std::vector<uint8_t>>& arena,
-                             BatchJobs& jobs, CopyShardCrcs* crcs_out = nullptr) {
-  const size_t k = copy.ec_data_shards, m = copy.ec_parity_shards;
-  if (copy.shards.size() != k + m || size != copy.ec_object_size)
-    return ErrorCode::INVALID_PARAMETERS;
-  const uint64_t L = copy.shards.front().length;
-  for (const auto& s : copy.shards) {
-    if (s.length != L) return ErrorCode::INVALID_PARAMETERS;
-  }
-  std::vector<const uint8_t*> data_ptrs(k);
-  for (size_t i = 0; i < k; ++i) {
-    const uint64_t start = i * L;
-    const uint64_t valid = start >= size ? 0 : std::min<uint64_t>(L, size - start);
-    if (valid == L) {
-      data_ptrs[i] = data + start;
-    } else {
-      arena.emplace_back(L, 0);
-      if (valid > 0) std::memcpy(arena.back().data(), data + start, valid);
-      data_ptrs[i] = arena.back().data();
-    }
-  }
-  std::vector<uint8_t*> parity_ptrs(m);
-  for (size_t j = 0; j < m; ++j) {
-    arena.emplace_back(L);
-    parity_ptrs[j] = arena.back().data();
-  }
-  if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
-    return ErrorCode::INVALID_PARAMETERS;
-  if (crcs_out) {
-    crcs_out->copy_index = copy.copy_index;
-    crcs_out->crcs.clear();
-    crcs_out->crcs.reserve(k + m);
-  }
-  for (size_t i = 0; i < k + m; ++i) {
-    uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity_ptrs[i - k];
-    jobs.wire.push_back({&copy.shards[i], 0, buf, L});
-    jobs.wire_item.push_back(item_index);
-    // Shard CRCs cover the full L wire bytes (padding included) so readers
-    // and scrubbers can verify a shard without knowing the object size.
-    if (crcs_out) crcs_out->crcs.push_back(crc32c(buf, L));
-  }
-  return ErrorCode::OK;
-}
-
-// Post-batch copy of a padded shard's valid bytes into the user buffer.
-struct EcReadFixup {
-  size_t item;
-  uint8_t* dst;
-  const uint8_t* src;
-  uint64_t n;
-};
-
-// Appends the k data-shard reads of one coded copy (the healthy fast path;
-// a failed item falls back to the full reconstructing read).
-void append_ec_get_jobs(const CopyPlacement& copy, uint8_t* buffer, uint64_t size,
-                        size_t item_index, std::vector<std::vector<uint8_t>>& arena,
-                        BatchJobs& jobs, std::vector<EcReadFixup>& fixups) {
-  const size_t k = copy.ec_data_shards;
-  const uint64_t L = copy.shards.front().length;
-  for (size_t i = 0; i < k; ++i) {
-    const uint64_t start = i * L;
-    const uint64_t valid = start >= size ? 0 : std::min<uint64_t>(L, size - start);
-    if (valid == 0) continue;  // pure padding: nothing to read
-    uint8_t* buf;
-    if (valid == L) {
-      buf = buffer + start;
-    } else {
-      arena.emplace_back(L);
-      buf = arena.back().data();
-      fixups.push_back({item_index, buffer + start, buf, valid});
-    }
-    jobs.wire.push_back({&copy.shards[i], 0, buf, L});
-    jobs.wire_item.push_back(item_index);
-  }
-}
-
-// Range (offset, length) -> CRC32C map. Prefilled by the transport's fused
-// write hashes; stamp_copy_crcs fills the gaps (device shards, failed ops).
-using RangeCrcMap = std::map<std::pair<uint64_t, uint64_t>, uint32_t>;
-
-// Per-copy shard CRC stamps for replicated/striped copies: replica copies
-// cover the SAME bytes, so each distinct (offset, length) range is hashed
-// once and reused. Wire shards arrive pre-hashed in `range_crc` (the
-// transport fused the CRC into its copy/send of the bytes), so the typical
-// put stamps every shard with ZERO standalone passes; only device shards
-// and retried ranges fall back to hashing here.
-std::vector<CopyShardCrcs> stamp_copy_crcs(const std::vector<CopyPlacement>& copies,
-                                           const uint8_t* data, RangeCrcMap& range_crc) {
-  std::vector<CopyShardCrcs> out;
-  out.reserve(copies.size());
-  for (const auto& copy : copies) {
-    CopyShardCrcs crcs;
-    crcs.copy_index = copy.copy_index;
-    crcs.crcs.reserve(copy.shards.size());
-    uint64_t off = 0;
-    for (const auto& shard : copy.shards) {
-      auto [it, fresh] = range_crc.try_emplace({off, shard.length}, 0);
-      if (fresh) it->second = crc32c(data + off, shard.length);
-      crcs.crcs.push_back(it->second);
-      off += shard.length;
-    }
-    out.push_back(std::move(crcs));
-  }
-  return out;
-}
-
-// Whole-object CRC folded from one copy's shard stamps (shards tile the
-// object contiguously in order — append_copy_jobs enforces exact cover).
-// With fused wire hashes this makes the content stamp FREE: no pass over
-// the bytes anywhere in the put path.
-uint32_t fold_content_crc(const CopyShardCrcs& crcs, const CopyPlacement& copy) {
-  uint32_t crc = 0;
-  for (size_t i = 0; i < crcs.crcs.size(); ++i)
-    crc = i == 0 ? crcs.crcs[0] : crc32c_combine(crc, crcs.crcs[i], copy.shards[i].length);
-  return crc;
-}
-
-// Read-side mirror of stamp_copy_crcs: folds one copy's object CRC from the
-// transport's fused read hashes, hashing only the gaps (device shards,
-// skipped ops, the rare genuine-zero crc). The batched verified get then
-// checks integrity with ~no second pass over wire bytes.
-uint32_t fold_ranges_crc(const CopyPlacement& copy, const uint8_t* base, RangeCrcMap& ranges) {
-  uint32_t crc = 0;
-  uint64_t off = 0;
-  for (size_t i = 0; i < copy.shards.size(); ++i) {
-    const uint64_t len = copy.shards[i].length;
-    auto [it, fresh] = ranges.try_emplace({off, len}, 0);
-    if (fresh) it->second = crc32c(base + off, len);
-    crc = i == 0 ? it->second : crc32c_combine(crc, it->second, len);
-    off += len;
-  }
-  return crc;
-}
-
-// Collects one item's fused write hashes out of run_wire_jobs' output into
-// the (object offset, length) -> crc form stamp_copy_crcs consumes. `item`
-// filters a batch down to one object; 0-crc entries (skipped/failed ops, or
-// the rare genuine zero) fall through to stamp_copy_crcs' own hashing.
-void harvest_wire_ranges(const BatchJobs& jobs, const std::vector<uint32_t>& wire_crcs,
-                         size_t item, const uint8_t* base, RangeCrcMap& ranges) {
-  for (size_t j = 0; j < jobs.wire.size() && j < wire_crcs.size(); ++j) {
-    if (jobs.wire_item[j] != item || wire_crcs[j] == 0) continue;
-    ranges[{static_cast<uint64_t>(jobs.wire[j].buf - base), jobs.wire[j].len}] =
-        wire_crcs[j];
-  }
-}
-
-// Runs the wire jobs as ONE pipelined batch; per-op failures land on their
-// item, jobs of items that already failed are skipped (their reservation is
-// cancelled by the caller anyway). With `wire_crcs` (put path) ops ask the
-// transport for a fused hash of the bytes they moved; (*wire_crcs)[j] gets
-// job j's crc for ops that completed (entries stay 0 for skipped/failed
-// jobs — stamp_copy_crcs treats a missing range as "hash it here").
-// `crc_items` (parallel to the caller's items, may be null = all) limits
-// the request to items whose hashes will actually be harvested — EC items
-// stamp during encode, so hashing their padded/parity ranges is waste.
-void run_wire_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
-                   size_t max_concurrency, std::vector<ErrorCode>& item_errors,
-                   std::vector<uint32_t>* wire_crcs = nullptr,
-                   const std::vector<bool>* crc_items = nullptr) {
-  if (jobs.wire.empty()) return;
-  if (wire_crcs) wire_crcs->assign(jobs.wire.size(), 0);
-  std::vector<transport::WireOp> ops;
-  std::vector<size_t> op_item, op_job;
-  ops.reserve(jobs.wire.size());
-  for (size_t j = 0; j < jobs.wire.size(); ++j) {
-    const size_t item = jobs.wire_item[j];
-    if (item_errors[item] != ErrorCode::OK) continue;
-    const auto& job = jobs.wire[j];
-    transport::WireOp op;
-    if (!transport::make_wire_op(*job.shard, job.in_off, job.buf, job.len, op)) {
-      // FileLocation: worker-served, never a client target.
-      item_errors[item] = ErrorCode::NOT_IMPLEMENTED;
-      continue;
-    }
-    op.want_crc =
-        wire_crcs != nullptr && (!crc_items || (item < crc_items->size() && (*crc_items)[item]));
-    ops.push_back(op);
-    op_item.push_back(item);
-    op_job.push_back(j);
-  }
-  if (is_write) {
-    (void)client.write_batch(ops.data(), ops.size(), max_concurrency);  // per-op status folded into item_errors below
-  } else {
-    (void)client.read_batch(ops.data(), ops.size(), max_concurrency);  // per-op status folded into item_errors below
-  }
-  for (size_t j = 0; j < ops.size(); ++j) {
-    if (ops[j].status != ErrorCode::OK && item_errors[op_item[j]] == ErrorCode::OK)
-      item_errors[op_item[j]] = ops[j].status;
-    if (wire_crcs && ops[j].status == ErrorCode::OK) (*wire_crcs)[op_job[j]] = ops[j].crc;
-  }
-}
-
-// Runs the device jobs as ONE provider batch; when the whole batch fails,
-// retries per job so one poisoned item cannot sink the rest, recording
-// errors into per-item slots.
-void run_device_jobs(transport::TransportClient& client, const BatchJobs& jobs, bool is_write,
-                     std::vector<ErrorCode>& item_errors) {
-  if (jobs.device.empty()) return;
-  if (transport::shard_io_batch(client, jobs.device.data(), jobs.device.size(), is_write) ==
-      ErrorCode::OK)
-    return;
-  for (size_t j = 0; j < jobs.device.size(); ++j) {
-    if (item_errors[jobs.device_item[j]] != ErrorCode::OK) continue;
-    if (auto ec = transport::shard_io_batch(client, &jobs.device[j], 1, is_write);
-        ec != ErrorCode::OK)
-      item_errors[jobs.device_item[j]] = ec;
-  }
-}
-
-}  // namespace
-
-std::vector<Result<std::vector<CopyPlacement>>> ObjectClient::get_workers_many(
-    const std::vector<ObjectKey>& keys) {
-  if (embedded_) return embedded_->batch_get_workers(keys);
-  auto r = rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& c) {
-    return c.batch_get_workers(keys);
-  });
-  if (!r.ok())
-    return std::vector<Result<std::vector<CopyPlacement>>>(keys.size(), r.error());
-  return std::move(r.value());
-}
-
-std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items) {
-  return put_many(items, options_.default_config);
-}
-
-std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
-                                              const WorkerConfig& config) {
-  trace::OpScope op_trace("put_many");  // inert when put() already opened one
-  TRACE_SPAN("client.put_many");
-  // Nested scopes tighten: when put() already opened the op deadline this
-  // is a no-op, and a direct put_many call gets its own budget.
-  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
-  std::vector<ErrorCode> results(items.size(), ErrorCode::OK);
-  if (items.empty()) return results;
-
-  std::vector<BatchPutStartItem> starts;
-  starts.reserve(items.size());
-  for (const auto& item : items) {
-    // A put of a removed-then-recreated key must not let this client's own
-    // cached placement serve the PREVIOUS object's bytes afterwards.
-    invalidate_placements(item.key);
-    // content_crc rides in batch_put_complete instead (folded from the
-    // transport's fused shard hashes) — hashing the bytes here would cost a
-    // full standalone pass before the transfer even starts.
-    starts.push_back({item.key, item.size, config, 0});
-  }
-  std::vector<Result<std::vector<CopyPlacement>>> placed;
-  if (embedded_) {
-    placed = embedded_->batch_put_start(starts);
-  } else {
-    auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
-      // Deferred content stamps require a keystone that applies them at
-      // put_complete. Against an older server, stamp at put_start like the
-      // pre-fusion path — otherwise every object written during a rolling
-      // upgrade would complete unstamped and verified reads would silently
-      // skip the CRC gate. One ping learns the version (and a v1 server
-      // that cannot answer it stays at 0 = conservative up-front hashing).
-      if (c.server_proto_version() == 0) (void)c.ping();  // best-effort probe; 0 keeps conservative stamping
-      if (c.server_proto_version() < rpc::kProtoContentCrcAtComplete) {
-        for (size_t i = 0; i < starts.size(); ++i) {
-          if (starts[i].content_crc == 0)
-            starts[i].content_crc = crc32c(items[i].data, items[i].size);
-        }
-      }
-      return c.batch_put_start(starts);
-    });
-    if (!r.ok()) return std::vector<ErrorCode>(items.size(), r.error());
-    placed = std::move(r.value());
-  }
-
-  BatchJobs jobs;
-  std::vector<std::vector<uint8_t>> ec_arena;
-  std::vector<std::vector<CopyShardCrcs>> item_crcs(items.size());
-  std::vector<bool> fuse_crc(items.size(), true);  // EC items stamp at encode
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (!placed[i].ok()) {
-      results[i] = placed[i].error();
-      continue;
-    }
-    auto* data = const_cast<uint8_t*>(static_cast<const uint8_t*>(items[i].data));
-    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
-      // Erasure-coded item: encode now, ship with the shared wire batch.
-      fuse_crc[i] = false;
-      CopyShardCrcs crcs;
-      results[i] = append_ec_put_jobs(placed[i].value().front(), data, items[i].size, i,
-                                      ec_arena, jobs, &crcs);
-      if (results[i] == ErrorCode::OK) item_crcs[i].push_back(std::move(crcs));
-      continue;
-    }
-    for (const auto& copy : placed[i].value()) {
-      // Shard CRCs are computed AFTER the device dispatch below, riding
-      // under the in-flight transfer instead of serializing before it.
-      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs, nullptr);
-          ec != ErrorCode::OK) {
-        results[i] = ec;
-        break;
-      }
-    }
-  }
-
-  std::vector<uint32_t> wire_crcs;
-  {
-    TRACE_SPAN("client.put.transfer");
-    run_device_jobs(*data_, jobs, /*is_write=*/true, results);
-    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results,
-                  &wire_crcs, &fuse_crc);
-  }
-  // Replicated/striped shard CRC stamps: harvested from the transport's
-  // FUSED write hashes (computed while the bytes moved), so the typical put
-  // sweeps the source bytes zero extra times; device shards and retried
-  // ranges are hashed in stamp_copy_crcs, overlapped with any still-
-  // draining device DMA (the flush below is the only wait). EC items
-  // computed theirs during encode (parity shards have no plain-data
-  // source; their wire bufs live in the arena, so they are excluded from
-  // the offset harvest).
-  std::vector<uint32_t> item_content_crcs(items.size(), 0);
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (!placed[i].ok() || results[i] != ErrorCode::OK) continue;
-    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
-      // Coded object: shard stamps cover padded/parity wire bytes, so the
-      // whole-object stamp still needs its own pass here.
-      item_content_crcs[i] = crc32c(items[i].data, items[i].size);
-      continue;
-    }
-    const auto* base = static_cast<const uint8_t*>(items[i].data);
-    RangeCrcMap ranges;
-    harvest_wire_ranges(jobs, wire_crcs, i, base, ranges);
-    item_crcs[i] = stamp_copy_crcs(placed[i].value(), base, ranges);
-    if (!item_crcs[i].empty() && !placed[i].value().empty())
-      item_content_crcs[i] = fold_content_crc(item_crcs[i][0], placed[i].value()[0]);
-  }
-  // Device writes may be asynchronous; put_complete must not be sent until
-  // the bytes are durably in the tier.
-  if (!jobs.device.empty()) {
-    if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) {
-      for (size_t j = 0; j < jobs.device.size(); ++j) {
-        if (results[jobs.device_item[j]] == ErrorCode::OK) results[jobs.device_item[j]] = ec;
-      }
-    }
-  }
-
-  std::vector<ObjectKey> completes, cancels;
-  std::vector<std::vector<CopyShardCrcs>> complete_crcs;
-  std::vector<uint32_t> complete_content_crcs;
-  std::vector<size_t> complete_idx;
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (!placed[i].ok()) continue;  // never reserved
-    if (results[i] == ErrorCode::OK) {
-      completes.push_back(items[i].key);
-      complete_crcs.push_back(std::move(item_crcs[i]));
-      complete_content_crcs.push_back(item_content_crcs[i]);
-      complete_idx.push_back(i);
-    } else {
-      LOG_WARN << "put " << items[i].key << " transfer failed ("
-               << to_string(results[i]) << "), cancelling";
-      cancels.push_back(items[i].key);
-    }
-  }
-  if (!completes.empty()) {
-    std::vector<ErrorCode> ecs;
-    if (embedded_) {
-      ecs = embedded_->batch_put_complete(completes, complete_crcs, complete_content_crcs);
-    } else {
-      auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
-        return c.batch_put_complete(completes, complete_crcs, complete_content_crcs);
-      });
-      ecs = r.ok() ? std::move(r.value())
-                   : std::vector<ErrorCode>(completes.size(), r.error());
-    }
-    for (size_t j = 0; j < complete_idx.size() && j < ecs.size(); ++j)
-      results[complete_idx[j]] = ecs[j];
-  }
-  if (!cancels.empty()) {
-    if (embedded_) {
-      embedded_->batch_put_cancel(cancels);
-    } else {
-      (void)rpc_failover(/*idempotent=*/false,
-                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(cancels); });  // best-effort cancel; slot TTL reclaims
-    }
-  }
-  return results;
-}
-
-std::optional<ErrorCode> ObjectClient::put_via_inline(const ObjectKey& key, const void* data,
-                                                      uint64_t size,
-                                                      const WorkerConfig& config) {
-  // Explicit placement intent (replicas, EC, a tier or node preference)
-  // means the caller wants bytes ON THE DATA PLANE — e.g. 2 KiB of HBM-tier
-  // metadata read device-locally — so only default-placement puts are
-  // offered to the inline tier.
-  if (options_.inline_max_bytes == 0 || size == 0 || size > options_.inline_max_bytes ||
-      config.replication_factor > 1 || config.ec_parity_shards > 0 ||
-      !config.preferred_classes.empty() || !config.preferred_node.empty() || key.empty() ||
-      key.find('\x01') != ObjectKey::npos)
-    return std::nullopt;
-  const int64_t now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
-                             std::chrono::steady_clock::now().time_since_epoch())
-                             .count();
-  // ordering: relaxed — advisory backoff gate: a stale read just means one extra (harmless) inline probe.
-  if (now_ms < inline_retry_after_ms_.load(std::memory_order_relaxed)) return std::nullopt;
-
-  invalidate_placements(key);  // same re-created-key rule as the normal path
-  const uint32_t crc = crc32c(data, size);
-  std::string bytes(static_cast<const char*>(data), size);
-  ErrorCode ec;
-  if (embedded_) {
-    ec = embedded_->put_inline(key, config, crc, std::move(bytes));
-  } else {
-    // Mutation: NOT_LEADER rotates, lost replies do not retry (matching
-    // put_complete's stance — a resend could misreport ALREADY_EXISTS).
-    ec = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
-      return r.put_inline(key, config, crc, bytes);
-    });
-  }
-  if (ec == ErrorCode::NOT_IMPLEMENTED) {
-    // Refused: disabled, the server's limit is smaller than ours, or the
-    // budget is spent. Budget refusals clear as objects expire, so re-probe
-    // after a while rather than pinning the fallback forever. Jittered
-    // around the configured backoff (was a fixed 60 s) so a fleet of
-    // clients does not re-probe a recovering keystone in lockstep.
-    const RetryPolicy probe{options_.inline_refusal_backoff_ms,
-                            options_.inline_refusal_backoff_ms, 1.0, 1};
-    inline_retry_after_ms_.store(now_ms + static_cast<int64_t>(probe.backoff_ms(0)),
-                                 // ordering: relaxed — advisory backoff gate (see the read above).
-                                 std::memory_order_relaxed);
-    return std::nullopt;
-  }
-  return ec;
-}
-
-std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const void* data,
-                                                    uint64_t size,
-                                                    const WorkerConfig& config) {
-  if (embedded_ || options_.put_slots == 0 || size == 0 ||
-      size > options_.put_slot_max_bytes || config.ec_parity_shards > 0 || key.empty() ||
-      key.find('\x01') != ObjectKey::npos)
-    return std::nullopt;
-  // Slot classes are exact-(size, config): the commit renames placements
-  // verbatim, so shard geometry must match the bytes exactly. Repeat puts
-  // of one class — the fixed-block serving pattern — hit the pool.
-  std::string class_key;
-  {
-    wire::Writer w;
-    wire::encode(w, config);
-    const auto cfg = w.take();
-    class_key.assign(reinterpret_cast<const char*>(cfg.data()), cfg.size());
-    class_key += '/' + std::to_string(size);
-  }
-
-  invalidate_placements(key);  // same re-created-key rule as the normal path
-  PutSlot slot;
-  auto slot_granted_at = std::chrono::steady_clock::now();
-  std::vector<ObjectKey> expired;
-  {
-    MutexLock lock(slot_mutex_);
-    if (slots_unsupported_) return std::nullopt;
-    auto& pool = slot_pool_[class_key];
-    // Age gate: a slot the keystone may have reclaimed (slot TTL) must
-    // never see a data-plane write — its ranges could already belong to
-    // another object. Expired entries are cancelled below, not used.
-    const auto now = std::chrono::steady_clock::now();
-    const auto max_age = std::chrono::milliseconds(options_.put_slot_max_age_ms);
-    while (!pool.empty()) {
-      PooledSlot entry = std::move(pool.back());
-      pool.pop_back();
-      if (now - entry.granted_at > max_age) {
-        expired.push_back(std::move(entry.slot.slot_key));
-        continue;
-      }
-      slot = std::move(entry.slot);
-      slot_granted_at = entry.granted_at;
-      break;
-    }
-  }
-  if (!expired.empty()) {
-    // Best-effort release of the stale reservations (the TTL reclaims them
-    // regardless); outside the pool lock, one batch RPC.
-    (void)rpc_failover(/*idempotent=*/false,
-                 [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(expired); });  // best-effort cancel; slot TTL reclaims
-  }
-  if (slot.slot_key.empty()) {
-    // First put of this class pays the same two RTTs as the normal path,
-    // but the grant covers this put AND the pool for the next ones.
-    auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
-      return c.put_start_pooled(size, config, options_.put_slots + 1, slot_tag_);
-    });
-    if (!r.ok() || r.value().empty()) {
-      if (r.error() == ErrorCode::NOT_IMPLEMENTED) {
-        // Old server or slots disabled server-side: stop asking.
-        MutexLock lock(slot_mutex_);
-        slots_unsupported_ = true;
-      }
-      return std::nullopt;  // the normal path reports the real outcome
-    }
-    auto slots = std::move(r).value();
-    slot = std::move(slots.back());
-    slots.pop_back();
-    if (!slots.empty()) {
-      const auto now = std::chrono::steady_clock::now();
-      MutexLock lock(slot_mutex_);
-      auto& pool = slot_pool_[class_key];
-      for (auto& s : slots) pool.push_back({std::move(s), now});
-    }
-  }
-
-  // Transfer into the slot's placements — the same jobs machinery as
-  // put_many, for one item.
-  auto* bytes = const_cast<uint8_t*>(static_cast<const uint8_t*>(data));
-  uint32_t content_crc = 0;
-  BatchJobs jobs;
-  std::vector<ErrorCode> item_errors(1, ErrorCode::OK);
-  std::vector<CopyShardCrcs> crcs;
-  for (const auto& copy : slot.copies) {
-    if (auto ec = append_copy_jobs(copy, bytes, size, 0, jobs, nullptr);
-        ec != ErrorCode::OK) {
-      item_errors[0] = ec;
-      break;
-    }
-  }
-  if (item_errors[0] == ErrorCode::OK) {
-    TRACE_SPAN("client.put.transfer");
-    std::vector<uint32_t> wire_crcs;
-    run_device_jobs(*data_, jobs, /*is_write=*/true, item_errors);
-    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, item_errors,
-                  &wire_crcs);
-    if (item_errors[0] == ErrorCode::OK) {
-      // Shard stamps come from the transport's fused write hashes; the
-      // content stamp folds out of them — zero standalone passes for the
-      // single-shard small-put norm. (Skipped entirely on transfer failure:
-      // the fallback branch below discards them.)
-      RangeCrcMap ranges;
-      harvest_wire_ranges(jobs, wire_crcs, 0, bytes, ranges);
-      crcs = stamp_copy_crcs(slot.copies, bytes, ranges);
-      if (!crcs.empty() && !slot.copies.empty())
-        content_crc = fold_content_crc(crcs[0], slot.copies[0]);
-      if (!jobs.device.empty()) item_errors[0] = storage::hbm_flush();
-    }
-  }
-  if (item_errors[0] != ErrorCode::OK) {
-    // The slot's worker may be the problem (crashed after the grant): drop
-    // the slot and FALL BACK — the normal path re-reserves on currently
-    // healthy workers, preserving the pre-slot availability story.
-    LOG_WARN << "put " << key << " slot transfer failed (" << to_string(item_errors[0])
-             << "), cancelling slot and falling back";
-    (void)rpc_failover(/*idempotent=*/false,
-                 [&](rpc::KeystoneRpcClient& c) { return c.put_cancel(slot.slot_key); });  // best-effort cancel; slot TTL reclaims
-    return std::nullopt;
-  }
-
-  PutCommitSlotRequest req;
-  req.slot_key = slot.slot_key;
-  req.key = key;
-  req.content_crc = content_crc;
-  req.shard_crcs = std::move(crcs);
-  req.data_size = size;
-  req.config = config;
-  req.client_tag = slot_tag_;
-  {
-    MutexLock lock(slot_mutex_);
-    const size_t have = slot_pool_[class_key].size();
-    req.refill_count =
-        have < options_.put_slots ? static_cast<uint32_t>(options_.put_slots - have) : 0;
-  }
-  std::vector<PutSlot> refills;
-  const ErrorCode ec = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
-    return c.put_commit_slot(req, &refills);
-  });
-  if (ec == ErrorCode::OK) {
-    std::vector<ObjectKey> overflow;
-    {
-      const auto now = std::chrono::steady_clock::now();
-      MutexLock lock(slot_mutex_);
-      auto& pool = slot_pool_[class_key];
-      for (auto& s : refills) {
-        // Overflow (a concurrent put of this class refilled first) is
-        // cancelled, not dropped: each refill reserves real capacity.
-        if (pool.size() >= options_.put_slots) {
-          overflow.push_back(std::move(s.slot_key));
-        } else {
-          pool.push_back({std::move(s), now});
-        }
-      }
-    }
-    if (!overflow.empty()) {
-      (void)rpc_failover(/*idempotent=*/false,
-                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(overflow); });  // best-effort cancel; slot TTL reclaims
-    }
-    return ErrorCode::OK;
-  }
-  if (ec == ErrorCode::OBJECT_NOT_FOUND) {
-    // Slot reclaimed (TTL) or minted by a deposed leader: transparent
-    // fallback — the normal path re-reserves and re-writes.
-    return std::nullopt;
-  }
-  // Duplicate key, fail-closed persist, etc.: the slot survives server-side
-  // (commit rolled it back), so it can serve the next put of this class.
-  {
-    MutexLock lock(slot_mutex_);
-    slot_pool_[class_key].push_back({std::move(slot), slot_granted_at});
-  }
-  return ec;
-}
-
-void ObjectClient::cancel_pooled_slots() {
-  std::vector<ObjectKey> keys;
-  {
-    MutexLock lock(slot_mutex_);
-    for (auto& [cls, pool] : slot_pool_) {
-      for (auto& s : pool) keys.push_back(std::move(s.slot.slot_key));
-    }
-    slot_pool_.clear();
-  }
-  // Only when already connected: the destructor must not pay a connect
-  // timeout for a dead keystone — the slot TTL reclaims either way.
-  std::shared_ptr<rpc::KeystoneRpcClient> rpc;
-  if (!embedded_) rpc = rpc_snapshot();
-  if (keys.empty() || !rpc || !rpc->connected()) return;
-  (void)rpc->batch_put_cancel(keys);  // best-effort cancel; slot TTL reclaims
-}
-
-std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,
-                                                     std::optional<bool> verify) {
-  trace::OpScope op_trace("get_many");
-  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
-  if (!cache_ || items.empty()) return get_many_uncached(items, verify);
-  // Cache pass first: hits (e.g. a checkpoint's hot shards re-read by
-  // load_sharded) are served locally; only the misses ride the batch.
-  std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
-  std::vector<GetItem> missing;
-  std::vector<size_t> missing_idx;
-  const bool direct = embedded_ && !options_.cache_force_lease_mode;
-  using Outcome = cache::ObjectCache::Outcome;
-  // Lease-mode entries whose lease lapsed: revalidated as ONE batched
-  // metadata round below, never one control RTT per key (an idle-then-
-  // reloaded checkpoint would otherwise serialize N round trips).
-  struct ExpiredItem {
-    size_t idx;
-    cache::ObjectCache::Hit hit;
-  };
-  std::vector<ExpiredItem> expired;
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (!items[i].buffer) {
-      missing.push_back(items[i]);
-      missing_idx.push_back(i);
-      continue;
-    }
-    if (direct) {
-      uint64_t got = 0;
-      if (cache_serve(items[i].key, items[i].buffer, items[i].buffer_size, got)) {
-        results[i] = got;
-      } else {
-        missing.push_back(items[i]);
-        missing_idx.push_back(i);
-      }
-      continue;
-    }
-    auto hit = cache_->lookup(items[i].key);
-    if (hit.outcome == Outcome::kHit && hit.bytes->size() <= items[i].buffer_size) {
-      std::memcpy(items[i].buffer, hit.bytes->data(), hit.bytes->size());
-      results[i] = hit.bytes->size();
-      cache::note_cached_serve(hit.bytes->size());
-    } else if (hit.outcome == Outcome::kExpired &&
-               hit.bytes->size() <= items[i].buffer_size) {
-      expired.push_back({i, std::move(hit)});
-    } else {
-      missing.push_back(items[i]);
-      missing_idx.push_back(i);
-    }
-  }
-  if (!expired.empty()) {
-    std::vector<ObjectKey> keys;
-    keys.reserve(expired.size());
-    for (const auto& e : expired) keys.push_back(items[e.idx].key);
-    auto metas = get_workers_many(keys);
-    const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
-    for (size_t j = 0; j < expired.size(); ++j) {
-      auto& e = expired[j];
-      const Result<std::vector<CopyPlacement>> meta =
-          j < metas.size() ? std::move(metas[j])
-                           : Result<std::vector<CopyPlacement>>(ErrorCode::OBJECT_NOT_FOUND);
-      if (cache_revalidate(items[e.idx].key, e.hit, meta, meta_at)) {
-        std::memcpy(items[e.idx].buffer, e.hit.bytes->data(), e.hit.bytes->size());
-        results[e.idx] = e.hit.bytes->size();
-        cache::note_cached_serve(e.hit.bytes->size());
-      } else {
-        missing.push_back(items[e.idx]);
-        missing_idx.push_back(e.idx);
-      }
-    }
-  }
-  if (missing.empty()) return results;
-  auto sub = get_many_uncached(missing, verify);
-  for (size_t j = 0; j < missing_idx.size() && j < sub.size(); ++j)
-    results[missing_idx[j]] = sub[j];
-  return results;
-}
-
-std::vector<Result<uint64_t>> ObjectClient::get_many_uncached(
-    const std::vector<GetItem>& items, std::optional<bool> verify) {
-  TRACE_SPAN("client.get_many");
-  const bool v = verify.value_or(verify_reads());
-  std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
-  if (items.empty()) return results;
-
-  std::vector<ObjectKey> keys;
-  keys.reserve(items.size());
-  for (const auto& item : items) keys.push_back(item.key);
-  std::vector<Result<std::vector<CopyPlacement>>> placements;
-  if (embedded_) {
-    placements = embedded_->batch_get_workers(keys);
-  } else {
-    auto r = rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& c) {
-      return c.batch_get_workers(keys);
-    });
-    if (!r.ok()) return std::vector<Result<uint64_t>>(items.size(), r.error());
-    placements = std::move(r.value());
-  }
-  const auto meta_at = std::chrono::steady_clock::now();  // cache lease anchor
-
-  // First pass: batched transfer of every item's first replica.
-  BatchJobs jobs;
-  std::vector<std::vector<uint8_t>> ec_arena;
-  std::vector<EcReadFixup> ec_fixups;
-  std::vector<ErrorCode> errors(items.size(), ErrorCode::OK);
-  std::vector<uint64_t> sizes(items.size(), 0);
-  // Items whose integrity gate can fold the transport's fused read hashes
-  // instead of re-hashing the whole buffer: plain striped/replicated copies
-  // with a content stamp. EC reads cover padded arena buffers (their ranges
-  // don't map onto the object) and inline items carry no wire ops.
-  std::vector<bool> fuse_crc(items.size(), false);
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (!placements[i].ok()) {
-      errors[i] = placements[i].error();
-      continue;
-    }
-    if (placements[i].value().empty()) {
-      errors[i] = ErrorCode::NO_COMPLETE_WORKER;
-      continue;
-    }
-    const auto& copy = placements[i].value().front();
-    const uint64_t copy_size = copy_logical_size(copy);
-    sizes[i] = copy_size;
-    if (copy_size > items[i].buffer_size) {
-      errors[i] = ErrorCode::BUFFER_OVERFLOW;
-      continue;
-    }
-    if (!copy.inline_data.empty()) {
-      // Inline item: the metadata reply already carried the bytes (the CRC
-      // gate below judges them like any other first-pass read).
-      std::memcpy(items[i].buffer, copy.inline_data.data(), copy.inline_data.size());
-      continue;
-    }
-    if (copy.ec_data_shards > 0) {
-      // Erasure-coded item: data-shard reads ride the shared batch; a
-      // failed item retries below through the reconstructing path.
-      append_ec_get_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
-                         ec_arena, jobs, ec_fixups);
-      continue;
-    }
-    if (auto ec = append_copy_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
-                                   jobs);
-        ec != ErrorCode::OK)
-      errors[i] = ec;
-    else
-      fuse_crc[i] = v && copy.content_crc != 0;
-  }
-  run_device_jobs(*data_, jobs, /*is_write=*/false, errors);
-  std::vector<uint32_t> wire_crcs;
-  run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors,
-                v ? &wire_crcs : nullptr, v ? &fuse_crc : nullptr);
-  for (const auto& fix : ec_fixups) {
-    if (errors[fix.item] == ErrorCode::OK) std::memcpy(fix.dst, fix.src, fix.n);
-  }
-  // Integrity gate: a clean-looking first-pass read with a CRC mismatch is
-  // demoted to a failure so the per-item retry below heals it (replica
-  // failover, or the coded path's corruption hunt). Wire shards were hashed
-  // WHILE they moved (fuse_crc items): their fold replaces the old whole-
-  // buffer post-pass, which cost ~11% of verified get throughput at 1 MiB.
-  // One pass over the batch's jobs distributes the fused hashes to their
-  // items (a per-item harvest would rescan the whole job list K times).
-  std::vector<RangeCrcMap> item_ranges(v ? items.size() : 0);
-  if (v) {
-    for (size_t j = 0; j < jobs.wire.size() && j < wire_crcs.size(); ++j) {
-      const size_t item = jobs.wire_item[j];
-      if (wire_crcs[j] == 0 || !fuse_crc[item]) continue;
-      const auto* base = static_cast<const uint8_t*>(items[item].buffer);
-      item_ranges[item][{static_cast<uint64_t>(jobs.wire[j].buf - base),
-                         jobs.wire[j].len}] = wire_crcs[j];
-    }
-  }
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (errors[i] != ErrorCode::OK || !placements[i].ok() || placements[i].value().empty())
-      continue;
-    const auto& copy = placements[i].value().front();
-    const uint32_t expect = copy.content_crc;
-    if (!v || expect == 0) continue;
-    const uint32_t got =
-        fuse_crc[i] ? fold_ranges_crc(copy, static_cast<const uint8_t*>(items[i].buffer),
-                                      item_ranges[i])
-                    : crc32c(items[i].buffer, sizes[i]);
-    if (got != expect) {
-      LOG_WARN << "get_many: content crc mismatch on " << items[i].key << "; retrying";
-      errors[i] = ErrorCode::CHECKSUM_MISMATCH;
-    }
-  }
-
-  for (size_t i = 0; i < items.size(); ++i) {
-    if (!placements[i].ok() || placements[i].value().empty() ||
-        errors[i] == ErrorCode::BUFFER_OVERFLOW) {
-      results[i] = errors[i];
-      continue;
-    }
-    if (errors[i] == ErrorCode::OK) {
-      results[i] = sizes[i];
-      if (v)
-        cache_fill(items[i].key, placements[i].value().front(),
-                   static_cast<const uint8_t*>(items[i].buffer), sizes[i], meta_at);
-      continue;
-    }
-    // Replica failover, one item at a time (first copy already failed).
-    ErrorCode last = errors[i];
-    bool done = false;
-    const auto& copies = placements[i].value();
-    if (copies.front().ec_data_shards > 0) {
-      // Coded object: the retry IS the degraded read (fetch survivors +
-      // parity, reconstruct).
-      if (transfer_copy_ec(copies.front(), static_cast<uint8_t*>(items[i].buffer), sizes[i],
-                           /*is_write=*/false, v) == ErrorCode::OK) {
-        results[i] = sizes[i];
-        if (v)
-          cache_fill(items[i].key, copies.front(),
-                     static_cast<const uint8_t*>(items[i].buffer), sizes[i], meta_at);
-      } else {
-        results[i] = last;
-      }
-      continue;
-    }
-    for (size_t c = 1; c < copies.size() && !done; ++c) {
-      const uint64_t copy_size = copy_logical_size(copies[c]);
-      if (copy_size > items[i].buffer_size) {
-        last = ErrorCode::BUFFER_OVERFLOW;
-        continue;
-      }
-      if (auto ec = transfer_copy_get(copies[c], static_cast<uint8_t*>(items[i].buffer),
-                                      copy_size, v);
-          ec == ErrorCode::OK) {
-        results[i] = copy_size;
-        if (v)
-          cache_fill(items[i].key, copies[c],
-                     static_cast<const uint8_t*>(items[i].buffer), copy_size, meta_at);
-        done = true;
-      } else {
-        last = ec;
-      }
-    }
-    if (!done) results[i] = last;
-  }
-  return results;
 }
 
 }  // namespace btpu::client

@@ -1,0 +1,173 @@
+// Async batch surface of the client op core (op_core.h): get_many_async /
+// put_many_async submit a TWO-stage state machine — stage 0 pre-serves gets
+// from the coherent object cache (pure memory, no wire work), stage 1 runs
+// the remaining items through the sync batch engine — so core lanes
+// interleave the cache stage of one batch with the I/O stage of another and
+// a single submitter thread keeps thousands of batches in flight.
+#include <cstdint>
+#include <vector>
+
+#include "btpu/client/client.h"
+#include "btpu/common/sched.h"
+
+namespace btpu::client {
+
+// ---- AsyncBatch result accessors -------------------------------------------
+// codes()/sizes() may legally poll PRE-done (the RETRY_LATER sentinel), so
+// every result-array access — runner writes, caller snapshots, the finalize
+// fold — goes through AsyncBatch::m_. The finalize folds the batch status
+// into items the op never reached (cancel / deadline before the I/O stage).
+
+std::vector<ErrorCode> AsyncBatch::codes() const {
+  MutexLock lock(m_);
+  // Lock order m_ -> Op::m (done()/status() take the op mutex).
+  if (!results_published_ && !finalized_ && handle_.done()) {
+    const ErrorCode st = handle_.status();
+    codes_.assign(codes_.size(),
+                  st == ErrorCode::OK ? ErrorCode::OPERATION_CANCELLED : st);
+    sizes_.assign(sizes_.size(), 0);
+    finalized_ = true;
+  }
+  return codes_;
+}
+
+std::vector<uint64_t> AsyncBatch::sizes() const {
+  (void)codes();  // same finalize fence
+  MutexLock lock(m_);
+  return sizes_;
+}
+
+// ---- op-core plumbing ------------------------------------------------------
+
+OpCore& ObjectClient::ensure_op_core() {
+  // ordering: acquire — pairs with the release publish below so the fast
+  // path observes a fully constructed core.
+  if (auto* core = op_core_ptr_.load(std::memory_order_acquire)) return *core;
+  MutexLock lock(op_core_mutex_);
+  if (!op_core_) {
+    op_core_ = std::make_unique<OpCore>();
+    // ordering: release — publishes the constructed core to fast-path loads.
+    op_core_ptr_.store(op_core_.get(), std::memory_order_release);
+  }
+  return *op_core_;
+}
+
+bool ObjectClient::core_try_run_detached(std::function<void()> fn) {
+  // Deterministic mode spawns + adopts at the caller (the shape the Sched
+  // fixtures pin); don't even build the core for it.
+  if (sched::armed()) return false;
+  return ensure_op_core().try_run_detached(std::move(fn));
+}
+
+// ---- batch submission ------------------------------------------------------
+
+std::shared_ptr<AsyncBatch> ObjectClient::submit_batch(std::shared_ptr<AsyncBatch> batch) {
+  const size_t n = batch->gets_.size() + batch->puts_.size();
+  batch->size_ = n;
+  {
+    // Pre-done reads of codes() see this uniform sentinel (documented
+    // contract); no reader exists yet, the lock satisfies the annotations.
+    MutexLock lock(batch->m_);
+    batch->codes_.assign(n, ErrorCode::RETRY_LATER);
+    batch->sizes_.assign(n, 0);
+  }
+  batch->served_.assign(batch->gets_.size(), 0);
+  const Deadline deadline = options_.op_deadline_ms == 0
+                                ? Deadline::infinite()
+                                : Deadline::after_ms(options_.op_deadline_ms);
+  // The op pins the batch: a caller may drop its handle before completion.
+  auto b = batch;
+  batch->handle_ = ensure_op_core().submit(
+      [this, b]() -> OpCore::Step {
+        AsyncBatch& batch = *b;
+        if (batch.stage_ == 0) {
+          batch.stage_ = 1;
+          // Stage 0: cache pre-serve — verified gets with a coherent cached
+          // copy complete right here with zero wire work. Always yields so
+          // lanes interleave this batch's I/O stage with other ops.
+          if (!batch.gets_.empty() && cache_enabled() &&
+              batch.verify_.value_or(verify_reads())) {
+            for (size_t i = 0; i < batch.gets_.size(); ++i) {
+              auto& item = batch.gets_[i];
+              uint64_t got = 0;
+              if (cache_serve(item.key, item.buffer, item.buffer_size, got)) {
+                batch.served_[i] = 1;
+                MutexLock lock(batch.m_);
+                batch.codes_[i] = ErrorCode::OK;
+                batch.sizes_[i] = got;
+              }
+            }
+          }
+          return OpCore::Step::kYield;
+        }
+        // Stage 1: remaining items through the sync batch engine (identical
+        // per-item semantics to get_many/put_many — that is the contract).
+        if (!batch.gets_.empty()) {
+          std::vector<GetItem> misses;
+          std::vector<size_t> where;
+          misses.reserve(batch.gets_.size());
+          where.reserve(batch.gets_.size());
+          for (size_t i = 0; i < batch.gets_.size(); ++i) {
+            if (batch.served_[i]) continue;
+            misses.push_back(batch.gets_[i]);
+            where.push_back(i);
+          }
+          if (!misses.empty()) {
+            const auto results = get_many(misses, batch.verify_);
+            MutexLock lock(batch.m_);
+            for (size_t j = 0; j < results.size(); ++j) {
+              const size_t i = where[j];
+              if (results[j].ok()) {
+                batch.codes_[i] = ErrorCode::OK;
+                batch.sizes_[i] = results[j].value();
+              } else {
+                batch.codes_[i] = results[j].error();
+                batch.sizes_[i] = 0;
+              }
+            }
+          }
+          MutexLock lock(batch.m_);
+          batch.results_published_ = true;
+        } else if (!batch.puts_.empty()) {
+          const auto codes = batch.have_config_ ? put_many(batch.puts_, batch.config_)
+                                                : put_many(batch.puts_);
+          MutexLock lock(batch.m_);
+          for (size_t i = 0; i < codes.size(); ++i) {
+            batch.codes_[i] = codes[i];
+            batch.sizes_[i] = batch.puts_[i].size;  // echoed (doc contract)
+          }
+          batch.results_published_ = true;
+        } else {
+          MutexLock lock(batch.m_);
+          batch.results_published_ = true;
+        }
+        return OpCore::Step::kDone;
+      },
+      deadline);
+  return batch;
+}
+
+std::shared_ptr<AsyncBatch> ObjectClient::get_many_async(std::vector<GetItem> items,
+                                                         std::optional<bool> verify) {
+  std::shared_ptr<AsyncBatch> batch(new AsyncBatch());
+  batch->gets_ = std::move(items);
+  batch->verify_ = verify;
+  return submit_batch(std::move(batch));
+}
+
+std::shared_ptr<AsyncBatch> ObjectClient::put_many_async(std::vector<PutItem> items) {
+  std::shared_ptr<AsyncBatch> batch(new AsyncBatch());
+  batch->puts_ = std::move(items);
+  return submit_batch(std::move(batch));
+}
+
+std::shared_ptr<AsyncBatch> ObjectClient::put_many_async(std::vector<PutItem> items,
+                                                         const WorkerConfig& config) {
+  std::shared_ptr<AsyncBatch> batch(new AsyncBatch());
+  batch->puts_ = std::move(items);
+  batch->config_ = config;
+  batch->have_config_ = true;
+  return submit_batch(std::move(batch));
+}
+
+}  // namespace btpu::client

@@ -1,0 +1,443 @@
+// Batched object I/O: get_workers_many / put_many / get_many — one
+// keystone round trip and one coalesced transfer per batch, riding
+// the shared batch engine (batch_engine.h). Split out of the
+// monolithic client.cpp; see docs/BYTE_PATHS.md (client core).
+#include "btpu/client/client.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <random>
+
+#include "btpu/common/crc32c.h"
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
+#include "btpu/common/wire.h"
+#include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
+#include "btpu/common/trace.h"
+#include "btpu/coord/remote_coordinator.h"
+#include "btpu/ec/rs.h"
+#include "btpu/rpc/rpc.h"
+#include "btpu/storage/hbm_provider.h"
+
+#include "batch_engine.h"
+
+namespace btpu::client {
+
+std::vector<Result<std::vector<CopyPlacement>>> ObjectClient::get_workers_many(
+    const std::vector<ObjectKey>& keys) {
+  if (embedded_) return embedded_->batch_get_workers(keys);
+  auto r = rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& c) {
+    return c.batch_get_workers(keys);
+  });
+  if (!r.ok())
+    return std::vector<Result<std::vector<CopyPlacement>>>(keys.size(), r.error());
+  return std::move(r.value());
+}
+
+std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items) {
+  return put_many(items, options_.default_config);
+}
+
+std::vector<ErrorCode> ObjectClient::put_many(const std::vector<PutItem>& items,
+                                              const WorkerConfig& config) {
+  trace::OpScope op_trace("put_many");  // inert when put() already opened one
+  TRACE_SPAN("client.put_many");
+  // Nested scopes tighten: when put() already opened the op deadline this
+  // is a no-op, and a direct put_many call gets its own budget.
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+  std::vector<ErrorCode> results(items.size(), ErrorCode::OK);
+  if (items.empty()) return results;
+
+  std::vector<BatchPutStartItem> starts;
+  starts.reserve(items.size());
+  for (const auto& item : items) {
+    // A put of a removed-then-recreated key must not let this client's own
+    // cached placement serve the PREVIOUS object's bytes afterwards.
+    invalidate_placements(item.key);
+    // content_crc rides in batch_put_complete instead (folded from the
+    // transport's fused shard hashes) — hashing the bytes here would cost a
+    // full standalone pass before the transfer even starts.
+    starts.push_back({item.key, item.size, config, 0});
+  }
+  std::vector<Result<std::vector<CopyPlacement>>> placed;
+  if (embedded_) {
+    placed = embedded_->batch_put_start(starts);
+  } else {
+    auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+      // Deferred content stamps require a keystone that applies them at
+      // put_complete. Against an older server, stamp at put_start like the
+      // pre-fusion path — otherwise every object written during a rolling
+      // upgrade would complete unstamped and verified reads would silently
+      // skip the CRC gate. One ping learns the version (and a v1 server
+      // that cannot answer it stays at 0 = conservative up-front hashing).
+      if (c.server_proto_version() == 0) (void)c.ping();  // best-effort probe; 0 keeps conservative stamping
+      if (c.server_proto_version() < rpc::kProtoContentCrcAtComplete) {
+        for (size_t i = 0; i < starts.size(); ++i) {
+          if (starts[i].content_crc == 0)
+            starts[i].content_crc = crc32c(items[i].data, items[i].size);
+        }
+      }
+      return c.batch_put_start(starts);
+    });
+    if (!r.ok()) return std::vector<ErrorCode>(items.size(), r.error());
+    placed = std::move(r.value());
+  }
+
+  BatchJobs jobs;
+  std::vector<std::vector<uint8_t>> ec_arena;
+  std::vector<std::vector<CopyShardCrcs>> item_crcs(items.size());
+  std::vector<bool> fuse_crc(items.size(), true);  // EC items stamp at encode
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placed[i].ok()) {
+      results[i] = placed[i].error();
+      continue;
+    }
+    auto* data = const_cast<uint8_t*>(static_cast<const uint8_t*>(items[i].data));
+    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
+      // Erasure-coded item: encode now, ship with the shared wire batch.
+      fuse_crc[i] = false;
+      CopyShardCrcs crcs;
+      results[i] = append_ec_put_jobs(placed[i].value().front(), data, items[i].size, i,
+                                      ec_arena, jobs, &crcs);
+      if (results[i] == ErrorCode::OK) item_crcs[i].push_back(std::move(crcs));
+      continue;
+    }
+    for (const auto& copy : placed[i].value()) {
+      // Shard CRCs are computed AFTER the device dispatch below, riding
+      // under the in-flight transfer instead of serializing before it.
+      if (auto ec = append_copy_jobs(copy, data, items[i].size, i, jobs, nullptr);
+          ec != ErrorCode::OK) {
+        results[i] = ec;
+        break;
+      }
+    }
+  }
+
+  std::vector<uint32_t> wire_crcs;
+  {
+    TRACE_SPAN("client.put.transfer");
+    run_device_jobs(*data_, jobs, /*is_write=*/true, results);
+    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, results,
+                  &wire_crcs, &fuse_crc);
+  }
+  // Replicated/striped shard CRC stamps: harvested from the transport's
+  // FUSED write hashes (computed while the bytes moved), so the typical put
+  // sweeps the source bytes zero extra times; device shards and retried
+  // ranges are hashed in stamp_copy_crcs, overlapped with any still-
+  // draining device DMA (the flush below is the only wait). EC items
+  // computed theirs during encode (parity shards have no plain-data
+  // source; their wire bufs live in the arena, so they are excluded from
+  // the offset harvest).
+  std::vector<uint32_t> item_content_crcs(items.size(), 0);
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placed[i].ok() || results[i] != ErrorCode::OK) continue;
+    if (!placed[i].value().empty() && placed[i].value().front().ec_data_shards > 0) {
+      // Coded object: shard stamps cover padded/parity wire bytes, so the
+      // whole-object stamp still needs its own pass here.
+      item_content_crcs[i] = crc32c(items[i].data, items[i].size);
+      continue;
+    }
+    const auto* base = static_cast<const uint8_t*>(items[i].data);
+    RangeCrcMap ranges;
+    harvest_wire_ranges(jobs, wire_crcs, i, base, ranges);
+    item_crcs[i] = stamp_copy_crcs(placed[i].value(), base, ranges);
+    if (!item_crcs[i].empty() && !placed[i].value().empty())
+      item_content_crcs[i] = fold_content_crc(item_crcs[i][0], placed[i].value()[0]);
+  }
+  // Device writes may be asynchronous; put_complete must not be sent until
+  // the bytes are durably in the tier.
+  if (!jobs.device.empty()) {
+    if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) {
+      for (size_t j = 0; j < jobs.device.size(); ++j) {
+        if (results[jobs.device_item[j]] == ErrorCode::OK) results[jobs.device_item[j]] = ec;
+      }
+    }
+  }
+
+  std::vector<ObjectKey> completes, cancels;
+  std::vector<std::vector<CopyShardCrcs>> complete_crcs;
+  std::vector<uint32_t> complete_content_crcs;
+  std::vector<size_t> complete_idx;
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placed[i].ok()) continue;  // never reserved
+    if (results[i] == ErrorCode::OK) {
+      completes.push_back(items[i].key);
+      complete_crcs.push_back(std::move(item_crcs[i]));
+      complete_content_crcs.push_back(item_content_crcs[i]);
+      complete_idx.push_back(i);
+    } else {
+      LOG_WARN << "put " << items[i].key << " transfer failed ("
+               << to_string(results[i]) << "), cancelling";
+      cancels.push_back(items[i].key);
+    }
+  }
+  if (!completes.empty()) {
+    std::vector<ErrorCode> ecs;
+    if (embedded_) {
+      ecs = embedded_->batch_put_complete(completes, complete_crcs, complete_content_crcs);
+    } else {
+      auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+        return c.batch_put_complete(completes, complete_crcs, complete_content_crcs);
+      });
+      ecs = r.ok() ? std::move(r.value())
+                   : std::vector<ErrorCode>(completes.size(), r.error());
+    }
+    for (size_t j = 0; j < complete_idx.size() && j < ecs.size(); ++j)
+      results[complete_idx[j]] = ecs[j];
+  }
+  if (!cancels.empty()) {
+    if (embedded_) {
+      embedded_->batch_put_cancel(cancels);
+    } else {
+      (void)rpc_failover(/*idempotent=*/false,
+                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(cancels); });  // best-effort cancel; slot TTL reclaims
+    }
+  }
+  return results;
+}
+
+std::vector<Result<uint64_t>> ObjectClient::get_many(const std::vector<GetItem>& items,
+                                                     std::optional<bool> verify) {
+  trace::OpScope op_trace("get_many");
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+  if (!cache_ || items.empty()) return get_many_uncached(items, verify);
+  // Cache pass first: hits (e.g. a checkpoint's hot shards re-read by
+  // load_sharded) are served locally; only the misses ride the batch.
+  std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
+  std::vector<GetItem> missing;
+  std::vector<size_t> missing_idx;
+  const bool direct = embedded_ && !options_.cache_force_lease_mode;
+  using Outcome = cache::ObjectCache::Outcome;
+  // Lease-mode entries whose lease lapsed: revalidated as ONE batched
+  // metadata round below, never one control RTT per key (an idle-then-
+  // reloaded checkpoint would otherwise serialize N round trips).
+  struct ExpiredItem {
+    size_t idx;
+    cache::ObjectCache::Hit hit;
+  };
+  std::vector<ExpiredItem> expired;
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!items[i].buffer) {
+      missing.push_back(items[i]);
+      missing_idx.push_back(i);
+      continue;
+    }
+    if (direct) {
+      uint64_t got = 0;
+      if (cache_serve(items[i].key, items[i].buffer, items[i].buffer_size, got)) {
+        results[i] = got;
+      } else {
+        missing.push_back(items[i]);
+        missing_idx.push_back(i);
+      }
+      continue;
+    }
+    auto hit = cache_->lookup(items[i].key);
+    if (hit.outcome == Outcome::kHit && hit.bytes->size() <= items[i].buffer_size) {
+      std::memcpy(items[i].buffer, hit.bytes->data(), hit.bytes->size());
+      results[i] = hit.bytes->size();
+      cache::note_cached_serve(hit.bytes->size());
+    } else if (hit.outcome == Outcome::kExpired &&
+               hit.bytes->size() <= items[i].buffer_size) {
+      expired.push_back({i, std::move(hit)});
+    } else {
+      missing.push_back(items[i]);
+      missing_idx.push_back(i);
+    }
+  }
+  if (!expired.empty()) {
+    std::vector<ObjectKey> keys;
+    keys.reserve(expired.size());
+    for (const auto& e : expired) keys.push_back(items[e.idx].key);
+    auto metas = get_workers_many(keys);
+    const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
+    for (size_t j = 0; j < expired.size(); ++j) {
+      auto& e = expired[j];
+      const Result<std::vector<CopyPlacement>> meta =
+          j < metas.size() ? std::move(metas[j])
+                           : Result<std::vector<CopyPlacement>>(ErrorCode::OBJECT_NOT_FOUND);
+      if (cache_revalidate(items[e.idx].key, e.hit, meta, meta_at)) {
+        std::memcpy(items[e.idx].buffer, e.hit.bytes->data(), e.hit.bytes->size());
+        results[e.idx] = e.hit.bytes->size();
+        cache::note_cached_serve(e.hit.bytes->size());
+      } else {
+        missing.push_back(items[e.idx]);
+        missing_idx.push_back(e.idx);
+      }
+    }
+  }
+  if (missing.empty()) return results;
+  auto sub = get_many_uncached(missing, verify);
+  for (size_t j = 0; j < missing_idx.size() && j < sub.size(); ++j)
+    results[missing_idx[j]] = sub[j];
+  return results;
+}
+
+std::vector<Result<uint64_t>> ObjectClient::get_many_uncached(
+    const std::vector<GetItem>& items, std::optional<bool> verify) {
+  TRACE_SPAN("client.get_many");
+  const bool v = verify.value_or(verify_reads());
+  std::vector<Result<uint64_t>> results(items.size(), ErrorCode::NO_COMPLETE_WORKER);
+  if (items.empty()) return results;
+
+  std::vector<ObjectKey> keys;
+  keys.reserve(items.size());
+  for (const auto& item : items) keys.push_back(item.key);
+  std::vector<Result<std::vector<CopyPlacement>>> placements;
+  if (embedded_) {
+    placements = embedded_->batch_get_workers(keys);
+  } else {
+    auto r = rpc_failover(/*idempotent=*/true, [&](rpc::KeystoneRpcClient& c) {
+      return c.batch_get_workers(keys);
+    });
+    if (!r.ok()) return std::vector<Result<uint64_t>>(items.size(), r.error());
+    placements = std::move(r.value());
+  }
+  const auto meta_at = std::chrono::steady_clock::now();  // cache lease anchor
+
+  // First pass: batched transfer of every item's first replica.
+  BatchJobs jobs;
+  std::vector<std::vector<uint8_t>> ec_arena;
+  std::vector<EcReadFixup> ec_fixups;
+  std::vector<ErrorCode> errors(items.size(), ErrorCode::OK);
+  std::vector<uint64_t> sizes(items.size(), 0);
+  // Items whose integrity gate can fold the transport's fused read hashes
+  // instead of re-hashing the whole buffer: plain striped/replicated copies
+  // with a content stamp. EC reads cover padded arena buffers (their ranges
+  // don't map onto the object) and inline items carry no wire ops.
+  std::vector<bool> fuse_crc(items.size(), false);
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placements[i].ok()) {
+      errors[i] = placements[i].error();
+      continue;
+    }
+    if (placements[i].value().empty()) {
+      errors[i] = ErrorCode::NO_COMPLETE_WORKER;
+      continue;
+    }
+    const auto& copy = placements[i].value().front();
+    const uint64_t copy_size = copy_logical_size(copy);
+    sizes[i] = copy_size;
+    if (copy_size > items[i].buffer_size) {
+      errors[i] = ErrorCode::BUFFER_OVERFLOW;
+      continue;
+    }
+    if (!copy.inline_data.empty()) {
+      // Inline item: the metadata reply already carried the bytes (the CRC
+      // gate below judges them like any other first-pass read).
+      std::memcpy(items[i].buffer, copy.inline_data.data(), copy.inline_data.size());
+      continue;
+    }
+    if (copy.ec_data_shards > 0) {
+      // Erasure-coded item: data-shard reads ride the shared batch; a
+      // failed item retries below through the reconstructing path.
+      append_ec_get_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
+                         ec_arena, jobs, ec_fixups);
+      continue;
+    }
+    if (auto ec = append_copy_jobs(copy, static_cast<uint8_t*>(items[i].buffer), copy_size, i,
+                                   jobs);
+        ec != ErrorCode::OK)
+      errors[i] = ec;
+    else
+      fuse_crc[i] = v && copy.content_crc != 0;
+  }
+  run_device_jobs(*data_, jobs, /*is_write=*/false, errors);
+  std::vector<uint32_t> wire_crcs;
+  run_wire_jobs(*data_, jobs, /*is_write=*/false, options_.io_parallelism, errors,
+                v ? &wire_crcs : nullptr, v ? &fuse_crc : nullptr);
+  for (const auto& fix : ec_fixups) {
+    if (errors[fix.item] == ErrorCode::OK) std::memcpy(fix.dst, fix.src, fix.n);
+  }
+  // Integrity gate: a clean-looking first-pass read with a CRC mismatch is
+  // demoted to a failure so the per-item retry below heals it (replica
+  // failover, or the coded path's corruption hunt). Wire shards were hashed
+  // WHILE they moved (fuse_crc items): their fold replaces the old whole-
+  // buffer post-pass, which cost ~11% of verified get throughput at 1 MiB.
+  // One pass over the batch's jobs distributes the fused hashes to their
+  // items (a per-item harvest would rescan the whole job list K times).
+  std::vector<RangeCrcMap> item_ranges(v ? items.size() : 0);
+  if (v) {
+    for (size_t j = 0; j < jobs.wire.size() && j < wire_crcs.size(); ++j) {
+      const size_t item = jobs.wire_item[j];
+      if (wire_crcs[j] == 0 || !fuse_crc[item]) continue;
+      const auto* base = static_cast<const uint8_t*>(items[item].buffer);
+      item_ranges[item][{static_cast<uint64_t>(jobs.wire[j].buf - base),
+                         jobs.wire[j].len}] = wire_crcs[j];
+    }
+  }
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (errors[i] != ErrorCode::OK || !placements[i].ok() || placements[i].value().empty())
+      continue;
+    const auto& copy = placements[i].value().front();
+    const uint32_t expect = copy.content_crc;
+    if (!v || expect == 0) continue;
+    const uint32_t got =
+        fuse_crc[i] ? fold_ranges_crc(copy, static_cast<const uint8_t*>(items[i].buffer),
+                                      item_ranges[i])
+                    : crc32c(items[i].buffer, sizes[i]);
+    if (got != expect) {
+      LOG_WARN << "get_many: content crc mismatch on " << items[i].key << "; retrying";
+      errors[i] = ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (!placements[i].ok() || placements[i].value().empty() ||
+        errors[i] == ErrorCode::BUFFER_OVERFLOW) {
+      results[i] = errors[i];
+      continue;
+    }
+    if (errors[i] == ErrorCode::OK) {
+      results[i] = sizes[i];
+      if (v)
+        cache_fill(items[i].key, placements[i].value().front(),
+                   static_cast<const uint8_t*>(items[i].buffer), sizes[i], meta_at);
+      continue;
+    }
+    // Replica failover, one item at a time (first copy already failed).
+    ErrorCode last = errors[i];
+    bool done = false;
+    const auto& copies = placements[i].value();
+    if (copies.front().ec_data_shards > 0) {
+      // Coded object: the retry IS the degraded read (fetch survivors +
+      // parity, reconstruct).
+      if (transfer_copy_ec(copies.front(), static_cast<uint8_t*>(items[i].buffer), sizes[i],
+                           /*is_write=*/false, v) == ErrorCode::OK) {
+        results[i] = sizes[i];
+        if (v)
+          cache_fill(items[i].key, copies.front(),
+                     static_cast<const uint8_t*>(items[i].buffer), sizes[i], meta_at);
+      } else {
+        results[i] = last;
+      }
+      continue;
+    }
+    for (size_t c = 1; c < copies.size() && !done; ++c) {
+      const uint64_t copy_size = copy_logical_size(copies[c]);
+      if (copy_size > items[i].buffer_size) {
+        last = ErrorCode::BUFFER_OVERFLOW;
+        continue;
+      }
+      if (auto ec = transfer_copy_get(copies[c], static_cast<uint8_t*>(items[i].buffer),
+                                      copy_size, v);
+          ec == ErrorCode::OK) {
+        results[i] = copy_size;
+        if (v)
+          cache_fill(items[i].key, copies[c],
+                     static_cast<const uint8_t*>(items[i].buffer), copy_size, meta_at);
+        done = true;
+      } else {
+        last = ec;
+      }
+    }
+    if (!done) results[i] = last;
+  }
+  return results;
+}
+
+}  // namespace btpu::client

@@ -1,0 +1,310 @@
+// Cache integration: the placement cache (ClientOptions::
+// placement_cache_ms + the optimistic-read lane) and the lease-
+// coherent object cache, plus read_with_cache — the one home of the
+// revalidate-and-retry discipline. Split out of the monolithic
+// client.cpp; see docs/BYTE_PATHS.md (client core).
+#include "btpu/client/client.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <random>
+
+#include "btpu/common/crc32c.h"
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
+#include "btpu/common/wire.h"
+#include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
+#include "btpu/common/trace.h"
+#include "btpu/coord/remote_coordinator.h"
+#include "btpu/ec/rs.h"
+#include "btpu/rpc/rpc.h"
+#include "btpu/storage/hbm_provider.h"
+
+
+namespace btpu::client {
+
+// ---- placement cache (placement_cache_ms + the optimistic-read lane) -------
+
+namespace {
+
+// The placement cache serves two masters: the original TTL lane
+// (placement_cache_ms, remote clients only) and the FaRM-style optimistic
+// lane (optimistic_reads), which extends it to embedded clients — their
+// entries are validated against the in-process keystone version instead of
+// a TTL, so a cached read costs ZERO keystone turns yet can never serve a
+// removed/rewritten object's placements.
+inline bool placement_cache_on(const ClientOptions& o, bool embedded) {
+  return o.optimistic_reads || (o.placement_cache_ms > 0 && !embedded);
+}
+
+}  // namespace
+
+Result<std::vector<CopyPlacement>> ObjectClient::get_workers_cached(const ObjectKey& key,
+                                                                    bool& from_cache) {
+  from_cache = false;
+  if (placement_cache_on(options_, embedded_ != nullptr)) {
+    const auto now = std::chrono::steady_clock::now();
+    MutexLock lock(placement_cache_mutex_);
+    auto it = placement_cache_.find(key);
+    if (it != placement_cache_.end()) {
+      bool serveable;
+      if (embedded_) {
+        // Optimistic embedded lane: version-validate in process (free, and
+        // NOT a keystone get — the zero-keystone-turn claim is measurable
+        // against btpu_gets_total). Linearizable: a remove/re-put bumps the
+        // version, so the stale entry dies here, never at the data plane.
+        const auto& copies = it->second.copies;
+        const auto [gen, epoch] = embedded_->object_cache_version(key);
+        serveable = !copies.empty() && copies.front().cache_gen == gen &&
+                    copies.front().cache_version == epoch;
+      } else {
+        // Remote lane: TTL bound (placement_cache_ms, or the optimistic
+        // backstop when that knob is 0) + the content-CRC gate at read time.
+        const uint32_t ttl_ms = options_.placement_cache_ms > 0
+                                    ? options_.placement_cache_ms
+                                    : options_.optimistic_ttl_ms;
+        serveable = now - it->second.fetched_at <= std::chrono::milliseconds(ttl_ms);
+      }
+      if (serveable) {
+        from_cache = true;
+        if (options_.optimistic_reads)
+          // ordering: relaxed — stat fold (op_core.h counter doc).
+          client_core_counters().optimistic_hits.fetch_add(1, std::memory_order_relaxed);
+        return it->second.copies;
+      }
+      placement_cache_.erase(it);
+    }
+  }
+  auto copies = get_workers(key);
+  if (copies.ok()) cache_placements(key, copies.value());
+  return copies;
+}
+
+void ObjectClient::cache_placements(const ObjectKey& key,
+                                    const std::vector<CopyPlacement>& copies) {
+  if (!placement_cache_on(options_, embedded_ != nullptr)) return;
+  // Staleness detection rides the content CRC; an unstamped copy (legacy
+  // record) could serve stale bytes undetected, so it is never cached.
+  for (const auto& copy : copies) {
+    if (copy.content_crc == 0) return;
+  }
+  MutexLock lock(placement_cache_mutex_);
+  // Bounded: entries expire by TTL anyway, so a rare full reset under churn
+  // beats per-access LRU bookkeeping on the hot read path.
+  if (placement_cache_.size() >= 4096) placement_cache_.clear();
+  placement_cache_[key] = {copies, std::chrono::steady_clock::now()};
+}
+
+void ObjectClient::invalidate_placements(const ObjectKey& key) {
+  // This client's own mutations drop the OBJECT cache entry too (a
+  // re-created key must not serve the previous object's bytes from either
+  // cache); cross-client mutations ride the watch/lease machinery.
+  if (cache_) cache_->invalidate(key);
+  if (!placement_cache_on(options_, embedded_ != nullptr)) return;
+  MutexLock lock(placement_cache_mutex_);
+  placement_cache_.erase(key);
+}
+
+void ObjectClient::invalidate_all_placements() {
+  if (cache_) cache_->invalidate_all();
+  if (!placement_cache_on(options_, embedded_ != nullptr)) return;
+  MutexLock lock(placement_cache_mutex_);
+  placement_cache_.clear();
+}
+
+// ---- client object cache (ClientOptions::cache_bytes) ----------------------
+
+void ObjectClient::setup_cache() {
+  if (options_.cache_bytes == 0) return;
+  cache_ = std::make_shared<cache::ObjectCache>(options_.cache_bytes,
+                                                options_.cache_max_object_bytes);
+  // Embedded clients validate every hit against the in-process keystone's
+  // version — strictly stronger than any invalidation stream, so no watch.
+  if (embedded_ && !options_.cache_force_lease_mode) return;
+  inval_coord_ = options_.cache_coordinator;
+  if (!inval_coord_ && !options_.coordinator_endpoints.empty()) {
+    auto rc = std::make_shared<coord::RemoteCoordinator>(options_.coordinator_endpoints);
+    if (rc->connect() == ErrorCode::OK) {
+      inval_coord_ = std::move(rc);
+    } else {
+      LOG_WARN << "object cache: coordinator " << options_.coordinator_endpoints
+               << " unreachable; invalidations degrade to lease expiry";
+    }
+  }
+  if (!inval_coord_) return;  // lease-expiry + revalidation coherence only
+  const std::string prefix = coord::cache_inval_prefix(options_.cluster_id);
+  // weak_ptr: a late watch event racing client destruction pins the cache
+  // (or finds it gone) instead of dereferencing a dead client.
+  std::weak_ptr<cache::ObjectCache> weak = cache_;
+  auto watch =
+      inval_coord_->watch_prefix(prefix, [prefix, weak](const coord::WatchEvent& ev) {
+        // PUT events only: the topic's TTL'd values self-clean with a
+        // kDelete ~30 s after each publish, which must not evict an entry
+        // legitimately re-cached since the original invalidation.
+        if (ev.type != coord::WatchEvent::Type::kPut) return;
+        if (ev.key.size() <= prefix.size()) return;
+        if (auto cache = weak.lock()) cache->invalidate(ev.key.substr(prefix.size()));
+      });
+  if (watch.ok()) {
+    inval_watch_ = watch.value();
+  } else {
+    LOG_WARN << "object cache: invalidation watch failed ("
+             << to_string(watch.error()) << "); degrading to lease expiry";
+  }
+}
+
+void ObjectClient::teardown_cache_watch() {
+  if (inval_coord_ && inval_watch_ >= 0) warn_if_error(inval_coord_->unwatch(inval_watch_), "cache-inval unwatch");
+  inval_watch_ = -1;
+  inval_coord_.reset();
+}
+
+void ObjectClient::configure_cache(uint64_t cache_bytes) {
+  teardown_cache_watch();
+  cache_.reset();
+  options_.cache_bytes = cache_bytes;
+  setup_cache();
+}
+
+void ObjectClient::sever_cache_watch_for_test() {
+  teardown_cache_watch();
+  // Push coherence is gone: entries must not outlive their lease.
+  if (cache_) cache_->expire_all_leases();
+}
+
+cache::ObjectCache::Bytes ObjectClient::cache_acquire(const ObjectKey& key) {
+  if (!cache_) return nullptr;
+  using Outcome = cache::ObjectCache::Outcome;
+  cache::ObjectCache::Hit hit;
+  if (embedded_ && !options_.cache_force_lease_mode) {
+    // Direct validation: linearizable with the in-process metadata.
+    const auto [gen, epoch] = embedded_->object_cache_version(key);
+    hit = cache_->lookup_validated(key, {gen, epoch});
+    if (hit.outcome == Outcome::kHit && hit.lease_lapsed) {
+      // Keep the keystone's LRU honest: validated hits never pass through
+      // get_workers, so once per lease period run a real (in-process)
+      // metadata read — it touches the object's last_access, without which
+      // pressure eviction would judge the hottest cached objects coldest
+      // and destroy them under their readers.
+      auto copies = get_workers(key);
+      const auto meta_at = std::chrono::steady_clock::now();
+      if (copies.ok() && !copies.value().empty()) {
+        const auto& c0 = copies.value().front();
+        const cache::ObjectVersion current{c0.cache_gen, c0.cache_version};
+        if (current.valid() && c0.cache_lease_ms > 0)
+          cache_->renew(key, current,
+                        meta_at + std::chrono::milliseconds(c0.cache_lease_ms));
+      }
+    }
+  } else {
+    hit = cache_->lookup(key);
+    if (hit.outcome == Outcome::kExpired) {
+      // Lease lapsed: ONE control RTT revalidates, then cache_revalidate
+      // applies the verdict (renew-and-serve vs snapshot-guarded drop).
+      auto copies = get_workers(key);
+      const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
+      if (!cache_revalidate(key, hit, copies, meta_at)) return nullptr;
+      hit.outcome = Outcome::kHit;
+    }
+  }
+  return hit.outcome == Outcome::kHit ? hit.bytes : nullptr;
+}
+
+bool ObjectClient::cache_revalidate(const ObjectKey& key,
+                                    const cache::ObjectCache::Hit& hit,
+                                    const Result<std::vector<CopyPlacement>>& meta,
+                                    std::chrono::steady_clock::time_point meta_at) {
+  if (meta.ok() && !meta.value().empty()) {
+    const auto& c0 = meta.value().front();
+    const cache::ObjectVersion current{c0.cache_gen, c0.cache_version};
+    if (current.valid() && c0.cache_lease_ms > 0) {
+      // renew() keeps/renews the resident entry iff it matches `current` —
+      // including one a concurrent reader refilled at `current` while we
+      // revalidated, which must not be clobbered; a moved resident version
+      // is dropped there (stale_reject). The snapshot is serveable only on
+      // a full version + content-stamp match (the stamp is the belt over
+      // braces across keystone incarnations).
+      cache_->renew(key, current, meta_at + std::chrono::milliseconds(c0.cache_lease_ms));
+      if (current == hit.version && c0.content_crc == hit.content_crc) {
+        cache_->count_revalidated_hit();
+        return true;
+      }
+      return false;
+    }
+  }
+  // Object gone, metadata unreachable, or the server stopped granting:
+  // drop OUR snapshot only (never a newer concurrent fill).
+  cache_->invalidate_if_version(key, hit.version);
+  return false;
+}
+
+bool ObjectClient::cache_serve(const ObjectKey& key, void* out, uint64_t out_cap,
+                               uint64_t& got) {
+  auto bytes = cache_acquire(key);
+  if (!bytes || bytes->size() > out_cap) return false;
+  std::memcpy(out, bytes->data(), bytes->size());
+  got = bytes->size();
+  cache::note_cached_serve(got);  // lane counts bytes actually delivered
+  return true;
+}
+
+void ObjectClient::cache_fill(const ObjectKey& key, const CopyPlacement& copy,
+                              const uint8_t* data, uint64_t size,
+                              std::chrono::steady_clock::time_point granted_at) {
+  if (!cache_ || size == 0 || size > options_.cache_max_object_bytes) return;
+  const cache::ObjectVersion version{copy.cache_gen, copy.cache_version};
+  // Only keystone-granted (version + lease), CRC-stamped reads are
+  // cacheable — "a hit returns verified bytes" is a contract, not a mood.
+  if (!version.valid() || copy.cache_lease_ms == 0 || copy.content_crc == 0) return;
+  // The lease runs from the moment the grant was FETCHED, not from fill:
+  // a slow transfer between the two must never stretch the staleness bound
+  // past grant + lease.
+  cache_->fill(key, version, copy.content_crc,
+               std::make_shared<const std::vector<uint8_t>>(data, data + size),
+               granted_at + std::chrono::milliseconds(copy.cache_lease_ms));
+}
+
+std::optional<uint64_t> ObjectClient::cached_object_size(const ObjectKey& key) {
+  if (!cache_) return std::nullopt;
+  auto hit = cache_->peek(key);
+  if (!hit.bytes) return std::nullopt;
+  if (embedded_ && !options_.cache_force_lease_mode) {
+    const auto [gen, epoch] = embedded_->object_cache_version(key);
+    if (!(cache::ObjectVersion{gen, epoch} == hit.version)) return std::nullopt;
+  } else if (hit.outcome != cache::ObjectCache::Outcome::kHit) {
+    return std::nullopt;  // lease lapsed: let the probe revalidate normally
+  }
+  return hit.bytes->size();
+}
+
+// Runs `attempt` against possibly-cached placements with ONE fresh-metadata
+// retry when every cached placement failed — the single home of the cache
+// discipline documented on ClientOptions::placement_cache_ms.
+ErrorCode ObjectClient::read_with_cache(
+    const ObjectKey& key, bool verify,
+    const std::function<ErrorCode(const std::vector<CopyPlacement>&, bool)>& attempt) {
+  bool from_cache = false;
+  auto copies = verify ? get_workers_cached(key, from_cache) : get_workers(key);
+  if (!copies.ok()) return copies.error();
+  ErrorCode ec = attempt(copies.value(), from_cache);
+  if (ec == ErrorCode::OK || !from_cache) return ec;
+  // Cached placements failed (moved bytes → CRC mismatch, a STALE_EXTENT
+  // conviction on poolsan-armed trees, dead worker, size change): drop the
+  // entry and retry once with fresh metadata. This is the optimistic lane's
+  // revalidate-and-retry edge, so it is the one place the revalidation
+  // counter folds.
+  if (options_.optimistic_reads)
+    // ordering: relaxed — stat fold (op_core.h counter doc).
+    client_core_counters().optimistic_revalidates.fetch_add(1, std::memory_order_relaxed);
+  invalidate_placements(key);
+  from_cache = false;
+  copies = get_workers_cached(key, from_cache);
+  if (!copies.ok()) return copies.error();
+  return attempt(copies.value(), from_cache);
+}
+
+}  // namespace btpu::client

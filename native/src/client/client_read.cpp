@@ -1,0 +1,919 @@
+// Client read path: single-object get/get_into, the replica attempt
+// engine (breaker ordering + hedged races), split reads, the shard
+// transfer family (replicated + EC), and scrub. Split out of the
+// monolithic client.cpp; see docs/BYTE_PATHS.md (client core).
+#include "btpu/client/client.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <random>
+
+#include "btpu/common/crc32c.h"
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
+#include "btpu/common/wire.h"
+#include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
+#include "btpu/common/trace.h"
+#include "btpu/coord/remote_coordinator.h"
+#include "btpu/ec/rs.h"
+#include "btpu/rpc/rpc.h"
+#include "btpu/storage/hbm_provider.h"
+
+
+namespace btpu::client {
+
+namespace {
+// Sampled latency probe for the cached-get fast path: a ~2us local memcpy
+// cannot absorb the full tracing scope (two clock reads alone are ~3% of
+// it — the bench.py trace-overhead guard holds the line at 5%), so
+// 1-in-8 hits measure and record with weight 8 into
+// btpu_op_duration_us{op="get_cached"} + one flight op_end event. Uniform
+// sampling is quantile-unbiased, and the weight keeps _count/_sum rates
+// honest; the unmeasured 7/8 pay one tls increment and a branch. Cache
+// hits make no wire calls, so there is nothing to trace-propagate here.
+inline uint64_t cached_probe_start() {
+  thread_local uint32_t tick = 0;
+  if ((++tick & 7u) != 0 || !trace::enabled()) return 0;
+  return trace::now_ns();
+}
+
+inline void cached_probe_finish(uint64_t t0) {
+  if (t0 == 0) return;
+  const uint64_t dur_us = (trace::now_ns() - t0) / 1000;
+  hist::op("get_cached").record_us_weighted(dur_us, 8);
+  flight::record_at(t0 + dur_us * 1000, flight::Ev::kOpEnd, dur_us, 0, 0);
+}
+}  // namespace
+
+Result<std::vector<uint8_t>> ObjectClient::get(const ObjectKey& key,
+                                               std::optional<bool> verify) {
+  // Hot path: a coherent cached entry answers with one memcpy and zero
+  // worker involvement (the bytes were verified at fill time). It gets the
+  // SAMPLED light instrumentation (cached_probe_*): the full OpScope below
+  // costs a few hundred ns, which the ~2us cached serve cannot absorb
+  // inside the bench.py trace-overhead budget, while the wire-bound path
+  // below hides it completely.
+  const uint64_t cached_t0 = cached_probe_start();
+  if (auto cached = cache_acquire(key)) {
+    cache::note_cached_serve(cached->size());
+    std::vector<uint8_t> out(cached->begin(), cached->end());
+    cached_probe_finish(cached_t0);
+    return out;
+  }
+  trace::OpScope op_trace("get");
+  TRACE_SPAN("client.get");
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+  const bool v = verify.value_or(verify_reads());
+  std::vector<uint8_t> buffer;
+  const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
+      key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
+        const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
+        uint64_t size = 0;
+        if (!copies.empty()) size = copy_logical_size(copies.front());
+        buffer.resize(size);
+        if (try_split_read(copies, buffer.data(), size, v) == ErrorCode::OK) {
+          if (v && !stale_meta) cache_fill(key, copies.front(), buffer.data(), size, meta_at);
+          return ErrorCode::OK;
+        }
+        // Per-copy failover via the replica attempt engine: breaker-aware
+        // candidate order, hedged when the first copy runs long. Corruption
+        // stays the strongest reported signal (see attempt_copies).
+        uint64_t got_size = 0;
+        const CopyPlacement* winner = nullptr;
+        const ErrorCode aec = attempt_copies(
+            copies, v,
+            [&](uint64_t copy_size) -> uint8_t* {
+              buffer.resize(copy_size);
+              return buffer.data();
+            },
+            got_size, &winner);
+        if (aec != ErrorCode::OK) return aec;
+        if (v && !stale_meta && winner)
+          cache_fill(key, *winner, buffer.data(), got_size, meta_at);
+        return ErrorCode::OK;
+      }); });
+  if (ec != ErrorCode::OK) return ec;
+  return buffer;
+}
+
+Result<uint64_t> ObjectClient::get_into(const ObjectKey& key, void* buffer,
+                                        uint64_t buffer_size, std::optional<bool> verify) {
+  uint64_t got = 0;
+  // Hot path: serve verified bytes straight out of the object cache (an
+  // entry too large for `buffer` falls through; the normal path reports
+  // BUFFER_OVERFLOW with fresh metadata). Sampled light instrumentation —
+  // see cached_probe_start for the overhead-budget rationale.
+  const uint64_t cached_t0 = cached_probe_start();
+  if (cache_ && cache_serve(key, buffer, buffer_size, got)) {
+    cached_probe_finish(cached_t0);
+    return got;
+  }
+  trace::OpScope op_trace("get");
+  TRACE_SPAN("client.get");
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+  const bool v = verify.value_or(verify_reads());
+  const ErrorCode ec = with_shed_retry([&] { return read_with_cache(
+      key, v, [&](const std::vector<CopyPlacement>& copies, bool stale_meta) -> ErrorCode {
+        const auto meta_at = std::chrono::steady_clock::now();  // lease anchor
+        uint64_t size = 0;
+        if (!copies.empty()) size = copy_logical_size(copies.front());
+        if (size <= buffer_size &&
+            try_split_read(copies, static_cast<uint8_t*>(buffer), size, v) ==
+                ErrorCode::OK) {
+          got = size;
+          if (v && !stale_meta)
+            cache_fill(key, copies.front(), static_cast<const uint8_t*>(buffer), size,
+                       meta_at);
+          return ErrorCode::OK;
+        }
+        // Replica attempt engine (breakers + hedging); an oversized copy is
+        // refused by the buffer callback and participates in the
+        // cache-retry as BUFFER_OVERFLOW, exactly like the old loop.
+        const CopyPlacement* winner = nullptr;
+        const ErrorCode aec = attempt_copies(
+            copies, v,
+            [&](uint64_t copy_size) -> uint8_t* {
+              return copy_size > buffer_size ? nullptr : static_cast<uint8_t*>(buffer);
+            },
+            got, &winner);
+        if (aec != ErrorCode::OK) return aec;
+        if (v && !stale_meta && winner)
+          cache_fill(key, *winner, static_cast<const uint8_t*>(buffer), got, meta_at);
+        return ErrorCode::OK;
+      }); });
+  if (ec != ErrorCode::OK) return ec;
+  return got;
+}
+
+// Wide replicated reads split the byte range into slices assigned
+// round-robin across replicas, issued as ONE pipelined batch — aggregate
+// read bandwidth is every replica's link, not one (the reference left this
+// as a TODO, blackbird_client.cpp:283). Any failure reports back and the
+// caller falls back to sequential per-copy reads, so a dead replica costs a
+// retry, never the object.
+ErrorCode ObjectClient::try_split_read(const std::vector<CopyPlacement>& copies,
+                                       uint8_t* buffer, uint64_t size, bool verify) {
+  constexpr uint64_t kSplitReadMin = 512 * 1024;  // below this, one copy wins
+  if (copies.size() < 2 || size < kSplitReadMin || options_.io_parallelism < 2)
+    return ErrorCode::NOT_IMPLEMENTED;
+  for (const auto& copy : copies) {
+    uint64_t copy_size = 0;
+    for (const auto& shard : copy.shards) {
+      if (!std::holds_alternative<MemoryLocation>(shard.location))
+        return ErrorCode::NOT_IMPLEMENTED;  // device reads batch better whole
+      copy_size += shard.length;
+    }
+    if (copy_size != size) return ErrorCode::NOT_IMPLEMENTED;  // divergent copies
+  }
+  const uint64_t n_slices =
+      std::min<uint64_t>(options_.io_parallelism, size / (kSplitReadMin / 2));
+  const uint64_t slice = (size + n_slices - 1) / n_slices;
+  std::vector<transport::WireOp> ops;
+  for (uint64_t j = 0; j < n_slices; ++j) {
+    const uint64_t lo = j * slice;
+    const uint64_t len = std::min(slice, size - lo);
+    if (!transport::append_range_wire_ops(copies[j % copies.size()], lo, len, buffer + lo,
+                                          ops))
+      return ErrorCode::NOT_IMPLEMENTED;
+  }
+  const uint32_t expect = copies.front().content_crc;
+  // Content-unstamped but shard-stamped (pre-v3 completion): bow out so the
+  // per-copy path runs its shard-stamp fallback — a split read here would
+  // silently skip verification.
+  if (verify && expect == 0 &&
+      copies.front().shard_crcs.size() == copies.front().shards.size())
+    return ErrorCode::NOT_IMPLEMENTED;
+  const bool check = verify && expect != 0;
+  // Transport-computed CRCs: ops cover [0, size) contiguously in array
+  // order (slices ascending, ranges within a slice ascending), so their
+  // ordered combine IS the object CRC — no post-pass over the buffer.
+  for (auto& op : ops) op.want_crc = check;
+  if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+      ec != ErrorCode::OK)
+    return ec;
+  if (check) {
+    uint32_t combined = 0;
+    for (size_t j = 0; j < ops.size(); ++j) {
+      combined = j == 0 ? ops[j].crc : crc32c_combine(combined, ops[j].crc, ops[j].len);
+    }
+    if (combined != expect) {
+      // Some slice came from a corrupt replica; the caller's per-copy
+      // (verified) reads identify the healthy one.
+      LOG_WARN << "content crc mismatch on split-replica read: retrying per copy";
+      return ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  return ErrorCode::OK;
+}
+
+// ---- erasure-coded copies --------------------------------------------------
+//
+// An EC copy holds k data shards (equal length L = ceil(size/k), last one
+// zero-padded) + m Reed-Solomon parity shards (btpu/ec/rs.h). Writes encode
+// and send all k+m in one pipelined batch; reads fetch the k data shards
+// and only on failure fetch survivors + parity and reconstruct (systematic
+// code: the healthy path never decodes).
+
+ErrorCode ObjectClient::transfer_copy_ec(const CopyPlacement& copy, uint8_t* data,
+                                         uint64_t size, bool is_write, bool verify) {
+  const size_t k = copy.ec_data_shards;
+  const size_t m = copy.ec_parity_shards;
+  if (copy.shards.size() != k + m || size != copy.ec_object_size)
+    return ErrorCode::INVALID_PARAMETERS;
+  const uint64_t L = copy.shards.front().length;
+  for (const auto& shard : copy.shards) {
+    if (shard.length != L) return ErrorCode::INVALID_PARAMETERS;
+  }
+  // Data shard i holds object bytes [i*L, i*L+valid_of(i)); with small
+  // objects (size < k*L - L) SEVERAL trailing shards are partly or wholly
+  // padding, not just the last one.
+  auto valid_of = [&](size_t i) -> uint64_t {
+    const uint64_t start = i * L;
+    return start >= size ? 0 : std::min<uint64_t>(L, size - start);
+  };
+  // Shards with padding read/write through a temp; full shards use the
+  // user buffer directly.
+  std::vector<std::vector<uint8_t>> temps(k);
+  auto shard_buf = [&](size_t i) -> uint8_t* {
+    if (valid_of(i) == L) return data + i * L;
+    if (temps[i].empty()) temps[i].assign(L, 0);
+    return temps[i].data();
+  };
+
+  if (is_write) {
+    std::vector<const uint8_t*> data_ptrs(k);
+    for (size_t i = 0; i < k; ++i) {
+      uint8_t* buf = shard_buf(i);
+      if (valid_of(i) < L && valid_of(i) > 0) std::memcpy(buf, data + i * L, valid_of(i));
+      data_ptrs[i] = buf;
+    }
+    std::vector<std::vector<uint8_t>> parity(m, std::vector<uint8_t>(L));
+    std::vector<uint8_t*> parity_ptrs(m);
+    for (size_t j = 0; j < m; ++j) parity_ptrs[j] = parity[j].data();
+    if (!ec::rs_encode(data_ptrs.data(), k, parity_ptrs.data(), m, L))
+      return ErrorCode::INVALID_PARAMETERS;
+
+    std::vector<transport::WireOp> ops(k + m);
+    for (size_t i = 0; i < k + m; ++i) {
+      uint8_t* buf = i < k ? const_cast<uint8_t*>(data_ptrs[i]) : parity[i - k].data();
+      if (!transport::make_wire_op(copy.shards[i], 0, buf, L, ops[i]))
+        return ErrorCode::NOT_IMPLEMENTED;
+    }
+    return data_->write_batch(ops.data(), ops.size(), options_.io_parallelism);
+  }
+
+  // Read path: fetch the k data shards (systematic code: no decode when
+  // they all arrive). A shard with no wire address (e.g. one mid-repair or
+  // mis-placed on a device tier) counts as MISSING — that is exactly the
+  // failure parity exists to absorb, not a reason to abort the read.
+  std::vector<transport::WireOp> ops(k);
+  std::vector<bool> addressable(k + m, true);
+  std::vector<bool> padding_only(k, false);
+  for (size_t i = 0; i < k; ++i) {
+    if (valid_of(i) == 0) {
+      // Pure padding: content is all zeros by construction — shard_buf's
+      // temp already is; no wire fetch, and it can serve reconstruction.
+      padding_only[i] = true;
+      (void)shard_buf(i);
+      ops[i] = {};
+      continue;
+    }
+    if (!transport::make_wire_op(copy.shards[i], 0, shard_buf(i), L, ops[i])) {
+      addressable[i] = false;
+      ops[i] = {};  // len 0: skipped by the batch
+    }
+  }
+  (void)data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);  // per-op status consumed below; CRC gate backstops
+  // Shard i's current bytes (user buffer or padded temp).
+  auto shard_bytes = [&](size_t i) -> const uint8_t* {
+    return temps[i].empty() ? data + i * L : temps[i].data();
+  };
+  // Per-shard CRCs (when the writer stamped them) LOCALIZE corruption: a
+  // shard whose bytes arrived but fail its own CRC is treated exactly like
+  // a missing shard, so the one reconstruction path below absorbs any mix
+  // of lost and bit-rotten shards up to m — multi-shard corruption included
+  // (the object-level CRC alone can only detect that case, not repair it).
+  const bool stamped = verify && copy.shard_crcs.size() == k + m;
+  size_t condemned = 0;  // shards whose bytes arrived but failed their CRC
+  auto shard_corrupt = [&](size_t i, const uint8_t* bytes) {
+    if (!stamped) return false;
+    if (crc32c(bytes, L) == copy.shard_crcs[i]) return false;
+    const auto& s = copy.shards[i];
+    LOG_WARN << "ec read: shard " << i << " corrupt (pool " << s.pool_id << ", worker "
+             << s.worker_id << ")";
+    ++condemned;
+    return true;
+  };
+  std::vector<bool> have(k + m, false);
+  size_t missing = 0;
+  for (size_t i = 0; i < k; ++i) {
+    have[i] = padding_only[i] ||
+              (addressable[i] && ops[i].status == ErrorCode::OK &&
+               !shard_corrupt(i, shard_bytes(i)));
+    if (!have[i]) ++missing;
+  }
+  auto copy_out = [&](size_t i, const uint8_t* src) {
+    if (valid_of(i) > 0 && valid_of(i) < L) std::memcpy(data + i * L, src, valid_of(i));
+  };
+  // Parity fetch (shared by the degraded path and the corruption hunt).
+  std::vector<std::vector<uint8_t>> parity;
+  auto fetch_parity = [&] {
+    if (!parity.empty()) return;
+    parity.assign(m, std::vector<uint8_t>(L));
+    std::vector<transport::WireOp> pops(m);
+    for (size_t j = 0; j < m; ++j) {
+      if (!transport::make_wire_op(copy.shards[k + j], 0, parity[j].data(), L, pops[j])) {
+        addressable[k + j] = false;
+        pops[j] = {};
+      }
+    }
+    (void)data_->read_batch(pops.data(), pops.size(), options_.io_parallelism);  // per-op status consumed below; CRC gate backstops
+    for (size_t j = 0; j < m; ++j)
+      have[k + j] = addressable[k + j] && pops[j].status == ErrorCode::OK &&
+                    !shard_corrupt(k + j, parity[j].data());
+  };
+  // Verifies the object CRC treating per-shard sources; `override_i`/bytes
+  // substitute one shard (the corruption hunt's candidate reconstruction).
+  auto crc_with = [&](size_t override_i, const uint8_t* override_bytes) {
+    uint32_t crc = 0;
+    for (size_t i = 0; i < k; ++i) {
+      const uint64_t valid = valid_of(i);
+      if (valid == 0) break;
+      const uint8_t* src = i == override_i ? override_bytes : shard_bytes(i);
+      crc = crc32c(src, valid, crc);
+    }
+    return crc;
+  };
+
+  if (missing == 0) {
+    if (!verify || copy.content_crc == 0 || crc_with(k + m, nullptr) == copy.content_crc) {
+      for (size_t i = 0; i < k; ++i) {
+        if (!temps[i].empty()) copy_out(i, temps[i].data());
+      }
+      return ErrorCode::OK;
+    }
+    // CRC mismatch with every data shard readable: one of them is silently
+    // corrupt (bit rot). Hunt it — reconstruct each candidate from parity
+    // in turn and keep the variant whose CRC matches.
+    LOG_WARN << "ec read: content crc mismatch, hunting the corrupt shard";
+    fetch_parity();
+    std::vector<uint8_t> candidate(L);
+    for (size_t i = 0; i < k; ++i) {
+      if (valid_of(i) == 0) break;  // padding shards cannot corrupt the crc
+      std::vector<const uint8_t*> present(k + m, nullptr);
+      for (size_t x = 0; x < k; ++x) {
+        if (x != i) present[x] = shard_bytes(x);
+      }
+      for (size_t j = 0; j < m; ++j) {
+        if (have[k + j]) present[k + j] = parity[j].data();
+      }
+      std::vector<uint8_t*> out(k, nullptr);
+      out[i] = candidate.data();
+      if (!ec::rs_reconstruct(present.data(), k, m, L, out.data())) continue;
+      if (crc_with(i, candidate.data()) == copy.content_crc) {
+        LOG_WARN << "ec read: shard " << i << " was corrupt; reconstructed through parity";
+        const uint64_t valid = valid_of(i);
+        std::memcpy(data + i * L, candidate.data(), valid);
+        for (size_t x = 0; x < k; ++x) {
+          if (x != i && !temps[x].empty()) copy_out(x, temps[x].data());
+        }
+        return ErrorCode::OK;
+      }
+    }
+    return ErrorCode::CHECKSUM_MISMATCH;  // multi-shard corruption: beyond m=?
+  }
+  // Beyond tolerance: when CRC condemnation contributed, report corruption
+  // (scrubbers key off CHECKSUM_MISMATCH, not transport loss).
+  if (missing > m) {
+    return condemned > 0 ? ErrorCode::CHECKSUM_MISMATCH : ErrorCode::NO_COMPLETE_WORKER;
+  }
+
+  // Degraded read: fetch parity shards, reconstruct the missing data.
+  LOG_WARN << "ec read: " << missing << " data shard(s) unreadable, reconstructing";
+  fetch_parity();
+
+  std::vector<std::vector<uint8_t>> rebuilt(k);
+  std::vector<const uint8_t*> present(k + m, nullptr);
+  std::vector<uint8_t*> out(k, nullptr);
+  for (size_t i = 0; i < k; ++i) {
+    if (have[i]) {
+      present[i] = shard_bytes(i);
+    } else {
+      rebuilt[i].resize(L);
+      out[i] = rebuilt[i].data();
+    }
+  }
+  for (size_t j = 0; j < m; ++j) {
+    if (have[k + j]) present[k + j] = parity[j].data();
+  }
+  if (!ec::rs_reconstruct(present.data(), k, m, L, out.data()))
+    return condemned > 0 ? ErrorCode::CHECKSUM_MISMATCH : ErrorCode::NO_COMPLETE_WORKER;
+  for (size_t i = 0; i < k; ++i) {
+    if (have[i]) {
+      if (!temps[i].empty()) copy_out(i, temps[i].data());
+    } else if (valid_of(i) > 0) {
+      std::memcpy(data + i * L, rebuilt[i].data(), valid_of(i));
+    }
+  }
+  if (verify && copy.content_crc != 0) {
+    uint32_t crc = 0;
+    for (size_t i = 0; i < k && valid_of(i) > 0; ++i) {
+      const uint8_t* src = have[i] ? shard_bytes(i) : rebuilt[i].data();
+      crc = crc32c(src, valid_of(i), crc);
+    }
+    if (crc != copy.content_crc) {
+      LOG_WARN << "ec read: crc mismatch after degraded reconstruction";
+      return ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  return ErrorCode::OK;
+}
+
+// Shared by the single-object and batched paths: device-location shards are
+// coalesced into ONE provider scatter/gather call (per-op device latency is
+// the enemy, hbm_provider.h v2), wire shards move as one pipelined batch.
+ErrorCode ObjectClient::transfer_copy(const CopyPlacement& copy, uint8_t* data, uint64_t size,
+                                      bool is_write, bool verify) {
+  if (!copy.inline_data.empty()) {
+    // Inline tier: the metadata reply already carried the bytes — a read is
+    // a memcpy (plus the CRC gate), and a write is meaningless here (inline
+    // objects are written whole through put_inline, never through
+    // placements).
+    if (is_write || size != copy.inline_data.size()) return ErrorCode::INVALID_PARAMETERS;
+    if (verify && copy.content_crc != 0 &&
+        crc32c(copy.inline_data.data(), copy.inline_data.size()) != copy.content_crc)
+      return ErrorCode::CHECKSUM_MISMATCH;
+    std::memcpy(data, copy.inline_data.data(), copy.inline_data.size());
+    return ErrorCode::OK;
+  }
+  if (copy.ec_data_shards > 0) return transfer_copy_ec(copy, data, size, is_write, verify);
+  // Running-offset layout: shard i covers [offsets[i], offsets[i]+len).
+  std::vector<uint64_t> offsets(copy.shards.size());
+  uint64_t off = 0;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    offsets[i] = off;
+    off += copy.shards[i].length;
+  }
+  if (off != size) return ErrorCode::INVALID_PARAMETERS;
+  std::vector<transport::ShardJob> device_jobs;
+  std::vector<size_t> wire_idx;
+  for (size_t i = 0; i < copy.shards.size(); ++i) {
+    if (std::holds_alternative<DeviceLocation>(copy.shards[i].location)) {
+      device_jobs.push_back({&copy.shards[i], 0, data + offsets[i], copy.shards[i].length});
+    } else {
+      wire_idx.push_back(i);
+    }
+  }
+  if (!device_jobs.empty()) {
+    if (auto ec = transport::shard_io_batch(*data_, device_jobs.data(), device_jobs.size(),
+                                            is_write);
+        ec != ErrorCode::OK)
+      return ec;
+    // Device writes may be asynchronous; a single-object put must be durable
+    // in the tier before put_complete is sent (put_many batches this flush).
+    if (is_write) {
+      if (auto ec = storage::hbm_flush(); ec != ErrorCode::OK) return ec;
+    }
+  }
+  // Whole-object stamp preferred; per-shard stamps arm verification when
+  // the content stamp is missing (e.g. an object completed through a
+  // pre-v3 keystone during a rolling upgrade drops the appended
+  // content_crc field but still applies shard_crcs — integrity must not
+  // silently lapse for those).
+  const bool have_shard_stamps =
+      copy.shard_crcs.size() == copy.shards.size() && !copy.shards.empty();
+  const bool check = verify && !is_write && (copy.content_crc != 0 || have_shard_stamps);
+  std::vector<transport::WireOp> ops;
+  if (!wire_idx.empty()) {
+    // Wire shards move as one pipelined batch: every request issued before
+    // any response is awaited, so a striped object costs ~one round trip.
+    ops.reserve(wire_idx.size());
+    for (size_t i : wire_idx) {
+      const auto& shard = copy.shards[i];
+      transport::WireOp op;
+      if (!transport::make_wire_op(shard, 0, data + offsets[i], shard.length, op))
+        return ErrorCode::NOT_IMPLEMENTED;  // FileLocation: worker-served
+      // Verified reads: the transport hashes the bytes WHILE they move
+      // (per-segment under the socket drain, fused with staging copies), so
+      // the integrity check below needs no second pass over wire shards.
+      op.want_crc = check;
+      ops.push_back(op);
+    }
+    if (is_write)
+      return data_->write_batch(ops.data(), ops.size(), options_.io_parallelism);
+    if (auto ec = data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);
+        ec != ErrorCode::OK)
+      return ec;
+  } else if (is_write) {
+    return ErrorCode::OK;
+  }
+  // Verify AFTER every shard (device and wire alike) has landed: a
+  // device-only copy bit-rots just as silently as a host one. Wire shard
+  // CRCs come from the transport; device shards (provider-filled) are
+  // hashed here; the object CRC is their ordered combine.
+  if (check) {
+    std::vector<uint32_t> shard_crc(copy.shards.size(), 0);
+    for (size_t j = 0; j < wire_idx.size(); ++j) shard_crc[wire_idx[j]] = ops[j].crc;
+    for (size_t i = 0; i < copy.shards.size(); ++i) {
+      if (std::holds_alternative<DeviceLocation>(copy.shards[i].location))
+        shard_crc[i] = crc32c(data + offsets[i], copy.shards[i].length);
+    }
+    bool ok;
+    if (copy.content_crc != 0) {
+      uint32_t combined = 0;
+      for (size_t i = 0; i < copy.shards.size(); ++i)
+        combined = i == 0 ? shard_crc[i]
+                          : crc32c_combine(combined, shard_crc[i], copy.shards[i].length);
+      ok = combined == copy.content_crc;
+    } else {
+      // Shard-stamp fallback: every shard must match its own stamp.
+      ok = true;
+      for (size_t i = 0; i < copy.shards.size(); ++i) ok &= shard_crc[i] == copy.shard_crcs[i];
+    }
+    if (!ok) {
+      LOG_WARN << "content crc mismatch on copy " << copy.copy_index
+               << " (bit rot or torn write): treating as copy loss";
+      // Stamped shard CRCs localize the rot for the operator/scrubber.
+      if (have_shard_stamps) {
+        for (size_t i = 0; i < copy.shards.size(); ++i) {
+          if (shard_crc[i] != copy.shard_crcs[i]) {
+            const auto& s = copy.shards[i];
+            LOG_WARN << "  corrupt shard " << i << " (pool " << s.pool_id << ", worker "
+                     << s.worker_id << ")";
+          }
+        }
+      }
+      return ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  return ErrorCode::OK;
+}
+
+ErrorCode ObjectClient::transfer_copy_put(const CopyPlacement& copy, const uint8_t* data,
+                                          uint64_t size) {
+  // Writes never verify-on-read; the flag is meaningless here.
+  return transfer_copy(copy, const_cast<uint8_t*>(data), size, /*is_write=*/true,
+                       /*verify=*/false);
+}
+
+ErrorCode ObjectClient::transfer_copy_get(const CopyPlacement& copy, uint8_t* data,
+                                          uint64_t size, bool verify) {
+  return transfer_copy(copy, data, size, /*is_write=*/false, verify);
+}
+
+// ---- replica attempt engine (breakers + hedged reads) -----------------------
+
+namespace {
+// Breaker/hedge identity of a copy: its first wire-addressable shard's
+// transport endpoint. Inline and device-only copies have none ("") — they
+// are served locally, so they are neither breaker-ordered nor hedged.
+const std::string& copy_endpoint(const CopyPlacement& copy) {
+  static const std::string kNone;
+  if (!copy.inline_data.empty()) return kNone;
+  for (const auto& shard : copy.shards) {
+    if (!shard.remote.endpoint.empty() &&
+        std::holds_alternative<MemoryLocation>(shard.location))
+      return shard.remote.endpoint;
+  }
+  return kNone;
+}
+
+uint64_t us_since(std::chrono::steady_clock::time_point t0) {
+  return static_cast<uint64_t>(std::chrono::duration_cast<std::chrono::microseconds>(
+                                   std::chrono::steady_clock::now() - t0)
+                                   .count());
+}
+}  // namespace
+
+std::vector<size_t> ObjectClient::order_copies(const std::vector<CopyPlacement>& copies) {
+  std::vector<size_t> order(copies.size());
+  for (size_t i = 0; i < copies.size(); ++i) order[i] = i;
+  if (copies.size() < 2) return order;
+  // Stable partition: copies on OPEN endpoints sort last — deprioritized,
+  // never dropped. When every replica's breaker is open the read proceeds
+  // in the original order (a degraded read beats no read).
+  std::stable_partition(order.begin(), order.end(), [&](size_t i) {
+    const std::string& ep = copy_endpoint(copies[i]);
+    if (ep.empty()) return true;
+    if (!breakers_.for_endpoint(ep)->open_now()) return true;
+    // ordering: relaxed — monotonic stat counter.
+    robust_counters().breaker_skips.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  });
+  return order;
+}
+
+void ObjectClient::record_copy_outcome(const CopyPlacement& copy, ErrorCode ec,
+                                       uint64_t us) {
+  const std::string& ep = copy_endpoint(copy);
+  if (ep.empty()) return;
+  auto breaker = breakers_.for_endpoint(ep);
+  if (ec == ErrorCode::OK) {
+    breaker->record_success(us);
+  } else if (ec != ErrorCode::DEADLINE_EXCEEDED) {
+    // A spent budget indicts the caller's deadline, not this endpoint;
+    // everything else (transport error, corruption, shed) is the replica
+    // failing to serve and feeds the trip counter.
+    breaker->record_failure();
+  }
+}
+
+uint64_t ObjectClient::hedge_delay_us() const {
+  if (!options_.hedge_reads) return 0;
+  if (options_.hedge_delay_ms > 0) return static_cast<uint64_t>(options_.hedge_delay_ms) * 1000;
+  // Adaptive trigger: the op's observed p95 — ~5% of reads hedge, which is
+  // the Tail-at-Scale sweet spot (tail coverage at ~negligible extra load).
+  return read_latency_.quantile_us(0.95, options_.hedge_min_samples);
+}
+
+// The primary runs off the calling thread from t0 with a size-byte PRIVATE
+// buffer. That shape is structural: transfers block, so first-wins
+// (returning the moment EITHER replica finishes — the entire p99 win)
+// requires the primary off the calling thread, and it needs a private
+// buffer because the caller may have returned with the hedge's bytes while
+// the primary is still writing. What is NOT structural anymore is the
+// per-race thread spawn: the primary is now a second submission on the
+// client op core (op_core.h) whenever an idle lane can take it promptly,
+// amortizing the spawn across races. The spawn survives only as the safety
+// valve — deep core queue, every lane busy (including a lane-hosted op that
+// itself hedges), or the schedule explorer armed (per-race spawn is the
+// exact interleaving shape the Sched hedge fixtures pin). Callers that
+// cannot hedge (one endpoint, no trigger samples, hedging off) never enter.
+ErrorCode ObjectClient::hedged_race(const CopyPlacement& primary,
+                                    const CopyPlacement& secondary, uint64_t size,
+                                    bool verify, uint8_t* out,
+                                    const CopyPlacement** winner) {
+  struct Race {
+    Mutex m;
+    CondVarAny cv;
+    bool primary_done BTPU_GUARDED_BY(m){false};
+    ErrorCode primary_ec BTPU_GUARDED_BY(m){ErrorCode::OK};
+    // The primary fills a PRIVATE buffer: first-wins must never race the
+    // caller's buffer (the hedge writes `out` directly on this thread).
+    std::vector<uint8_t> primary_buf;
+  };
+  auto race = std::make_shared<Race>();
+  race->primary_buf.resize(size);
+  const auto t0 = std::chrono::steady_clock::now();
+  // The ambient deadline is thread-local: hand it to the primary's thread
+  // explicitly so its wire ops still carry the caller's budget.
+  const Deadline op_deadline = current_op_deadline();
+  if (!copy_endpoint(primary).empty()) breakers_.for_endpoint(copy_endpoint(primary))->allow();
+  // ordering: acq_rel — the increment must be visible before the spawned
+  // thread can decrement (release), and the destructor's acquire load of 0
+  // must see every loser's writes as retired.
+  hedge_inflight_.fetch_add(1, std::memory_order_acq_rel);
+  auto primary_work = [this, race, copy = primary, size, verify, op_deadline, t0] {
+    OpDeadlineScope scope(op_deadline);
+    const ErrorCode ec = transfer_copy_get(copy, race->primary_buf.data(), size, verify);
+    record_copy_outcome(copy, ec, us_since(t0));
+    {
+      MutexLock lock(race->m);
+      race->primary_ec = ec;
+      race->primary_done = true;
+    }
+    race->cv.notify_all();
+#if defined(BTPU_SCHED)
+    if (sched::mutant_enabled("hedge_notify_after_unlock")) {
+      // PLANTED MUTANT — the exact pre-PR-5 bug shape this block's comment
+      // below exists to prevent: decrement under the mutex but notify AFTER
+      // unlock. The destructor's drain loop may observe inflight == 0 in
+      // the unlock/notify window and free the client, so the notify below
+      // touches a destroyed hedge_cv_ (SchedMutants matrix detects this as
+      // an ASan heap-use-after-free within the seed budget).
+      {
+        MutexLock lock(hedge_mutex_);
+        // ordering: acq_rel — pairs with the destructor's acquire drain load.
+        hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      }
+      hedge_cv_.notify_all();
+      return;
+    }
+#endif
+    {
+      // Notify UNDER the mutex: the destructor's drain loop frees the client
+      // the instant it observes inflight == 0, so a notify after unlock would
+      // touch a destroyed condition variable.
+      MutexLock lock(hedge_mutex_);
+      // ordering: acq_rel — pairs with the destructor's acquire drain load.
+      hedge_inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      hedge_cv_.notify_all();
+    }
+  };
+  // Second submission on the op core when a lane can take it promptly;
+  // otherwise the spawn valve (always under the schedule explorer — see the
+  // block comment above).
+  if (!core_try_run_detached(primary_work)) {
+    BTPU_SCHED_DECL_SPAWN();
+    std::thread([work = std::move(primary_work)] {
+      BTPU_SCHED_ADOPT_SPAWNED();
+      work();
+    }).detach();
+  }
+
+  const uint64_t delay_us = hedge_delay_us();
+  bool hedged = false;
+  {
+    MutexLock lock(race->m);
+    const auto trigger = t0 + std::chrono::microseconds(delay_us);
+    while (!race->primary_done) {
+      if (race->cv.wait_until(lock, trigger) == std::cv_status::timeout &&
+          !race->primary_done)
+        break;
+    }
+    if (race->primary_done) {
+      if (race->primary_ec == ErrorCode::OK) {
+        std::memcpy(out, race->primary_buf.data(), size);
+        read_latency_.record_us(us_since(t0));
+        if (winner) *winner = &primary;
+        return ErrorCode::OK;
+      }
+      // Primary failed before the trigger: the second attempt below is
+      // ordinary failover, not a hedge.
+    } else {
+      hedged = true;
+      // ordering: relaxed — monotonic stat counter.
+      robust_counters().hedges_fired.fetch_add(1, std::memory_order_relaxed);
+      flight::record(flight::Ev::kHedgeFired);
+    }
+  }
+
+  // The hedge (or failover) runs on the calling thread, straight into `out`.
+  if (!copy_endpoint(secondary).empty())
+    breakers_.for_endpoint(copy_endpoint(secondary))->allow();
+  const auto s0 = std::chrono::steady_clock::now();
+  const ErrorCode sec_ec = transfer_copy_get(secondary, out, size, verify);
+  record_copy_outcome(secondary, sec_ec, us_since(s0));
+
+  MutexLock lock(race->m);
+  if (sec_ec == ErrorCode::OK) {
+    if (hedged && !race->primary_done) {
+      // ordering: relaxed — monotonic stat counter.
+      robust_counters().hedge_wins.fetch_add(1, std::memory_order_relaxed);
+      flight::record(flight::Ev::kHedgeWin);
+    }
+    read_latency_.record_us(us_since(t0));
+    if (winner) *winner = &secondary;
+    return ErrorCode::OK;  // bytes already in `out`; the primary drains into its loser buffer
+  }
+  // Hedge failed: the primary is the only hope left — wait it out (its own
+  // wire ops carry the deadline, so a spent budget aborts it server-side).
+  while (!race->primary_done) race->cv.wait(lock);
+  if (race->primary_ec == ErrorCode::OK) {
+    std::memcpy(out, race->primary_buf.data(), size);
+    read_latency_.record_us(us_since(t0));
+    if (winner) *winner = &primary;
+    return ErrorCode::OK;
+  }
+  // Corruption is the strongest signal (scrubbers key off it).
+  if (sec_ec == ErrorCode::CHECKSUM_MISMATCH || race->primary_ec == ErrorCode::CHECKSUM_MISMATCH)
+    return ErrorCode::CHECKSUM_MISMATCH;
+  return race->primary_ec;
+}
+
+ErrorCode ObjectClient::attempt_copies(const std::vector<CopyPlacement>& copies,
+                                       bool verify,
+                                       const std::function<uint8_t*(uint64_t)>& buffer_for,
+                                       uint64_t& got_size, const CopyPlacement** winner) {
+  if (winner) *winner = nullptr;
+  const std::vector<size_t> order = order_copies(copies);
+  ErrorCode last = ErrorCode::NO_COMPLETE_WORKER;
+  bool tried_hedge = false;
+  for (size_t oi = 0; oi < order.size(); ++oi) {
+    // A spent budget fails the op here instead of starting another replica
+    // transfer nobody is waiting for (transport-independent: TCP ops also
+    // carry the budget on the wire, but LOCAL/SHM have no wire to carry it).
+    if (oi > 0 && current_op_deadline().expired()) {
+      // ordering: relaxed — monotonic stat counter.
+      robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+      return ErrorCode::DEADLINE_EXCEEDED;
+    }
+    const CopyPlacement& copy = copies[order[oi]];
+    const uint64_t copy_size = copy_logical_size(copy);
+    uint8_t* dst = buffer_for(copy_size);
+    if (!dst) {
+      // This copy cannot be accepted (caller's buffer too small). Keep the
+      // cache-retry semantics: a stale cached size must not mask a fit.
+      if (last == ErrorCode::NO_COMPLETE_WORKER) last = ErrorCode::BUFFER_OVERFLOW;
+      continue;
+    }
+    // Hedge opportunity: two wire-served same-size candidates on DIFFERENT
+    // endpoints, hedging enabled, and a trigger delay is known (fixed knob
+    // or enough observed samples for a p95).
+    if (!tried_hedge && options_.hedge_reads && oi + 1 < order.size()) {
+      const CopyPlacement& second = copies[order[oi + 1]];
+      const std::string& ep1 = copy_endpoint(copy);
+      const std::string& ep2 = copy_endpoint(second);
+      if (!ep1.empty() && !ep2.empty() && ep1 != ep2 &&
+          copy_logical_size(second) == copy_size && hedge_delay_us() > 0) {
+        tried_hedge = true;
+        const ErrorCode hec = hedged_race(copy, second, copy_size, verify, dst, winner);
+        if (hec == ErrorCode::OK) {
+          got_size = copy_size;
+          return ErrorCode::OK;
+        }
+        if (last != ErrorCode::CHECKSUM_MISMATCH) last = hec;
+        ++oi;  // both candidates consumed
+        continue;
+      }
+    }
+    const std::string& ep = copy_endpoint(copy);
+    if (!ep.empty()) breakers_.for_endpoint(ep)->allow();
+    const auto t0 = std::chrono::steady_clock::now();
+    const ErrorCode tec = transfer_copy_get(copy, dst, copy_size, verify);
+    const uint64_t us = us_since(t0);
+    record_copy_outcome(copy, tec, us);
+    if (tec == ErrorCode::OK) {
+      read_latency_.record_us(us);
+      got_size = copy_size;
+      if (winner) *winner = &copy;
+      return ErrorCode::OK;
+    }
+    if (last != ErrorCode::CHECKSUM_MISMATCH) last = tec;
+    LOG_WARN << "get copy " << copy.copy_index << " failed (" << to_string(tec)
+             << "), trying next replica";
+  }
+  return last;
+}
+
+Result<std::vector<ObjectClient::ShardFinding>> ObjectClient::scrub_object(
+    const ObjectKey& key) {
+  auto copies = get_workers(key);
+  if (!copies.ok()) return copies.error();
+  std::vector<ShardFinding> findings;
+  // Stamped copies: every shard of every copy reads as ONE pipelined wire
+  // batch (per-op status lands on its finding), so the audit costs ~one
+  // round trip per object, not one per shard. Device-located shards can't
+  // ride the wire batch; they go through shard_io below.
+  std::vector<transport::WireOp> ops;
+  std::vector<size_t> op_finding;
+  std::vector<std::vector<uint8_t>> bufs;
+  struct Deferred {  // device shards + expected CRC, checked after the batch
+    size_t finding;
+    const ShardPlacement* shard;
+    uint32_t expect;
+  };
+  std::vector<Deferred> deferred;
+  std::vector<uint32_t> expected;  // parallel to findings (stamped ones)
+  std::vector<uint8_t> buf;
+  for (const auto& copy : copies.value()) {
+    if (copy.shard_crcs.size() == copy.shards.size() && !copy.shards.empty()) {
+      // Writer-stamped shard CRCs: verify each shard in isolation so the
+      // report names exactly which worker/pool holds rotten bytes.
+      for (size_t i = 0; i < copy.shards.size(); ++i) {
+        const auto& shard = copy.shards[i];
+        findings.push_back({copy.copy_index, static_cast<uint32_t>(i), shard.pool_id,
+                            shard.worker_id, ErrorCode::OK});
+        expected.resize(findings.size(), 0);
+        expected.back() = copy.shard_crcs[i];
+        bufs.emplace_back(shard.length);
+        transport::WireOp op;
+        if (transport::make_wire_op(shard, 0, bufs.back().data(), shard.length, op)) {
+          ops.push_back(op);
+          op_finding.push_back(findings.size() - 1);
+        } else {
+          deferred.push_back({findings.size() - 1, &shard, copy.shard_crcs[i]});
+        }
+      }
+      continue;
+    }
+    // Pre-shard-CRC copy: the object CRC can only judge the copy as a whole.
+    const uint64_t size = copy_logical_size(copy);
+    ShardFinding f{copy.copy_index, ShardFinding::kWholeCopy, {}, {}, ErrorCode::OK};
+    try {
+      buf.resize(size);
+      f.status = transfer_copy_get(copy, buf.data(), size, /*verify=*/true);
+    } catch (const std::bad_alloc&) {
+      f.status = ErrorCode::OUT_OF_MEMORY;
+    }
+    findings.push_back(std::move(f));
+    expected.resize(findings.size(), 0);
+  }
+  if (!ops.empty()) (void)data_->read_batch(ops.data(), ops.size(), options_.io_parallelism);  // per-op status consumed below
+  for (size_t j = 0; j < ops.size(); ++j) {
+    auto& f = findings[op_finding[j]];
+    if (ops[j].status != ErrorCode::OK) {
+      f.status = ops[j].status;
+    } else if (crc32c(ops[j].buf, ops[j].len) != expected[op_finding[j]]) {
+      f.status = ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  for (const auto& d : deferred) {
+    auto& f = findings[d.finding];
+    buf.resize(d.shard->length);
+    if (auto ec = transport::shard_io(*data_, *d.shard, 0, buf.data(), d.shard->length,
+                                      /*is_write=*/false);
+        ec != ErrorCode::OK) {
+      f.status = ec;
+    } else if (crc32c(buf.data(), d.shard->length) != d.expect) {
+      f.status = ErrorCode::CHECKSUM_MISMATCH;
+    }
+  }
+  return findings;
+}
+
+}  // namespace btpu::client

@@ -1,0 +1,306 @@
+// Client write path: single-object put plus its two fast tiers — the
+// keystone inline tier (one control RTT, no data plane) and pooled
+// put slots (commit-with-refill). Split out of the monolithic
+// client.cpp; see docs/BYTE_PATHS.md (client core).
+#include "btpu/client/client.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <random>
+
+#include "btpu/common/crc32c.h"
+#include "btpu/common/env.h"
+#include "btpu/common/flight_recorder.h"
+#include "btpu/common/histogram.h"
+#include "btpu/common/wire.h"
+#include "btpu/common/log.h"
+#include "btpu/common/poolsan.h"
+#include "btpu/common/trace.h"
+#include "btpu/coord/remote_coordinator.h"
+#include "btpu/ec/rs.h"
+#include "btpu/rpc/rpc.h"
+#include "btpu/storage/hbm_provider.h"
+
+#include "batch_engine.h"
+
+namespace btpu::client {
+
+ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size) {
+  return put(key, data, size, options_.default_config);
+}
+
+ErrorCode ObjectClient::put(const ObjectKey& key, const void* data, uint64_t size,
+                            const WorkerConfig& config) {
+  trace::OpScope op_trace("put");  // relabeled once the serving tier is known
+  TRACE_SPAN("client.put");
+  // The end-to-end budget covers every tier probe, transfer, and retry
+  // below; a RETRY_LATER shed re-runs the whole body after jittered backoff
+  // (safe: a shed provably did not execute, and put_many rolls back failed
+  // reservations before reporting).
+  OpDeadlineScope op_scope(static_cast<int64_t>(options_.op_deadline_ms));
+  return with_shed_retry([&]() -> ErrorCode {
+    // Tiny objects ride the inline tier when the keystone grants it: ONE
+    // control RTT stores the bytes in the object map, and the first verified
+    // read needs no data-plane hop at all. nullopt = not applicable — fall
+    // through to slots/placed.
+    if (auto inl = put_via_inline(key, data, size, config)) {
+      op_trace.relabel("put_inline");
+      return *inl;
+    }
+    // Small objects ride the pooled-slot path when possible: write into a
+    // pre-allocated slot, then ONE control RTT commits it as `key` (and
+    // refills the pool in the same round trip). nullopt = not applicable
+    // (disabled, oversized, EC, embedded, slot reclaimed) — fall through.
+    if (auto pooled = put_via_slot(key, data, size, config)) {
+      op_trace.relabel("put_slot");
+      return *pooled;
+    }
+    // One-item batch: put_many pipelines the wire shards of EVERY copy in a
+    // single pass (a replicated put costs ~one round trip, not one per copy),
+    // coalesces device shards, and rolls back failed reservations — the exact
+    // single-object semantics (put_start -> transfer -> complete/cancel,
+    // reference blackbird_client.cpp:87-117) with none of the code repeated.
+    return put_many({{key, data, size}}, config)[0];
+  });
+}
+
+std::optional<ErrorCode> ObjectClient::put_via_inline(const ObjectKey& key, const void* data,
+                                                      uint64_t size,
+                                                      const WorkerConfig& config) {
+  // Explicit placement intent (replicas, EC, a tier or node preference)
+  // means the caller wants bytes ON THE DATA PLANE — e.g. 2 KiB of HBM-tier
+  // metadata read device-locally — so only default-placement puts are
+  // offered to the inline tier.
+  if (options_.inline_max_bytes == 0 || size == 0 || size > options_.inline_max_bytes ||
+      config.replication_factor > 1 || config.ec_parity_shards > 0 ||
+      !config.preferred_classes.empty() || !config.preferred_node.empty() || key.empty() ||
+      key.find('\x01') != ObjectKey::npos)
+    return std::nullopt;
+  const int64_t now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                             std::chrono::steady_clock::now().time_since_epoch())
+                             .count();
+  // ordering: relaxed — advisory backoff gate: a stale read just means one extra (harmless) inline probe.
+  if (now_ms < inline_retry_after_ms_.load(std::memory_order_relaxed)) return std::nullopt;
+
+  invalidate_placements(key);  // same re-created-key rule as the normal path
+  const uint32_t crc = crc32c(data, size);
+  std::string bytes(static_cast<const char*>(data), size);
+  ErrorCode ec;
+  if (embedded_) {
+    ec = embedded_->put_inline(key, config, crc, std::move(bytes));
+  } else {
+    // Mutation: NOT_LEADER rotates, lost replies do not retry (matching
+    // put_complete's stance — a resend could misreport ALREADY_EXISTS).
+    ec = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& r) {
+      return r.put_inline(key, config, crc, bytes);
+    });
+  }
+  if (ec == ErrorCode::NOT_IMPLEMENTED) {
+    // Refused: disabled, the server's limit is smaller than ours, or the
+    // budget is spent. Budget refusals clear as objects expire, so re-probe
+    // after a while rather than pinning the fallback forever. Jittered
+    // around the configured backoff (was a fixed 60 s) so a fleet of
+    // clients does not re-probe a recovering keystone in lockstep.
+    const RetryPolicy probe{options_.inline_refusal_backoff_ms,
+                            options_.inline_refusal_backoff_ms, 1.0, 1};
+    inline_retry_after_ms_.store(now_ms + static_cast<int64_t>(probe.backoff_ms(0)),
+                                 // ordering: relaxed — advisory backoff gate (see the read above).
+                                 std::memory_order_relaxed);
+    return std::nullopt;
+  }
+  return ec;
+}
+
+std::optional<ErrorCode> ObjectClient::put_via_slot(const ObjectKey& key, const void* data,
+                                                    uint64_t size,
+                                                    const WorkerConfig& config) {
+  if (embedded_ || options_.put_slots == 0 || size == 0 ||
+      size > options_.put_slot_max_bytes || config.ec_parity_shards > 0 || key.empty() ||
+      key.find('\x01') != ObjectKey::npos)
+    return std::nullopt;
+  // Slot classes are exact-(size, config): the commit renames placements
+  // verbatim, so shard geometry must match the bytes exactly. Repeat puts
+  // of one class — the fixed-block serving pattern — hit the pool.
+  std::string class_key;
+  {
+    wire::Writer w;
+    wire::encode(w, config);
+    const auto cfg = w.take();
+    class_key.assign(reinterpret_cast<const char*>(cfg.data()), cfg.size());
+    class_key += '/' + std::to_string(size);
+  }
+
+  invalidate_placements(key);  // same re-created-key rule as the normal path
+  PutSlot slot;
+  auto slot_granted_at = std::chrono::steady_clock::now();
+  std::vector<ObjectKey> expired;
+  {
+    MutexLock lock(slot_mutex_);
+    if (slots_unsupported_) return std::nullopt;
+    auto& pool = slot_pool_[class_key];
+    // Age gate: a slot the keystone may have reclaimed (slot TTL) must
+    // never see a data-plane write — its ranges could already belong to
+    // another object. Expired entries are cancelled below, not used.
+    const auto now = std::chrono::steady_clock::now();
+    const auto max_age = std::chrono::milliseconds(options_.put_slot_max_age_ms);
+    while (!pool.empty()) {
+      PooledSlot entry = std::move(pool.back());
+      pool.pop_back();
+      if (now - entry.granted_at > max_age) {
+        expired.push_back(std::move(entry.slot.slot_key));
+        continue;
+      }
+      slot = std::move(entry.slot);
+      slot_granted_at = entry.granted_at;
+      break;
+    }
+  }
+  if (!expired.empty()) {
+    // Best-effort release of the stale reservations (the TTL reclaims them
+    // regardless); outside the pool lock, one batch RPC.
+    (void)rpc_failover(/*idempotent=*/false,
+                 [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(expired); });  // best-effort cancel; slot TTL reclaims
+  }
+  if (slot.slot_key.empty()) {
+    // First put of this class pays the same two RTTs as the normal path,
+    // but the grant covers this put AND the pool for the next ones.
+    auto r = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+      return c.put_start_pooled(size, config, options_.put_slots + 1, slot_tag_);
+    });
+    if (!r.ok() || r.value().empty()) {
+      if (r.error() == ErrorCode::NOT_IMPLEMENTED) {
+        // Old server or slots disabled server-side: stop asking.
+        MutexLock lock(slot_mutex_);
+        slots_unsupported_ = true;
+      }
+      return std::nullopt;  // the normal path reports the real outcome
+    }
+    auto slots = std::move(r).value();
+    slot = std::move(slots.back());
+    slots.pop_back();
+    if (!slots.empty()) {
+      const auto now = std::chrono::steady_clock::now();
+      MutexLock lock(slot_mutex_);
+      auto& pool = slot_pool_[class_key];
+      for (auto& s : slots) pool.push_back({std::move(s), now});
+    }
+  }
+
+  // Transfer into the slot's placements — the same jobs machinery as
+  // put_many, for one item.
+  auto* bytes = const_cast<uint8_t*>(static_cast<const uint8_t*>(data));
+  uint32_t content_crc = 0;
+  BatchJobs jobs;
+  std::vector<ErrorCode> item_errors(1, ErrorCode::OK);
+  std::vector<CopyShardCrcs> crcs;
+  for (const auto& copy : slot.copies) {
+    if (auto ec = append_copy_jobs(copy, bytes, size, 0, jobs, nullptr);
+        ec != ErrorCode::OK) {
+      item_errors[0] = ec;
+      break;
+    }
+  }
+  if (item_errors[0] == ErrorCode::OK) {
+    TRACE_SPAN("client.put.transfer");
+    std::vector<uint32_t> wire_crcs;
+    run_device_jobs(*data_, jobs, /*is_write=*/true, item_errors);
+    run_wire_jobs(*data_, jobs, /*is_write=*/true, options_.io_parallelism, item_errors,
+                  &wire_crcs);
+    if (item_errors[0] == ErrorCode::OK) {
+      // Shard stamps come from the transport's fused write hashes; the
+      // content stamp folds out of them — zero standalone passes for the
+      // single-shard small-put norm. (Skipped entirely on transfer failure:
+      // the fallback branch below discards them.)
+      RangeCrcMap ranges;
+      harvest_wire_ranges(jobs, wire_crcs, 0, bytes, ranges);
+      crcs = stamp_copy_crcs(slot.copies, bytes, ranges);
+      if (!crcs.empty() && !slot.copies.empty())
+        content_crc = fold_content_crc(crcs[0], slot.copies[0]);
+      if (!jobs.device.empty()) item_errors[0] = storage::hbm_flush();
+    }
+  }
+  if (item_errors[0] != ErrorCode::OK) {
+    // The slot's worker may be the problem (crashed after the grant): drop
+    // the slot and FALL BACK — the normal path re-reserves on currently
+    // healthy workers, preserving the pre-slot availability story.
+    LOG_WARN << "put " << key << " slot transfer failed (" << to_string(item_errors[0])
+             << "), cancelling slot and falling back";
+    (void)rpc_failover(/*idempotent=*/false,
+                 [&](rpc::KeystoneRpcClient& c) { return c.put_cancel(slot.slot_key); });  // best-effort cancel; slot TTL reclaims
+    return std::nullopt;
+  }
+
+  PutCommitSlotRequest req;
+  req.slot_key = slot.slot_key;
+  req.key = key;
+  req.content_crc = content_crc;
+  req.shard_crcs = std::move(crcs);
+  req.data_size = size;
+  req.config = config;
+  req.client_tag = slot_tag_;
+  {
+    MutexLock lock(slot_mutex_);
+    const size_t have = slot_pool_[class_key].size();
+    req.refill_count =
+        have < options_.put_slots ? static_cast<uint32_t>(options_.put_slots - have) : 0;
+  }
+  std::vector<PutSlot> refills;
+  const ErrorCode ec = rpc_failover(/*idempotent=*/false, [&](rpc::KeystoneRpcClient& c) {
+    return c.put_commit_slot(req, &refills);
+  });
+  if (ec == ErrorCode::OK) {
+    std::vector<ObjectKey> overflow;
+    {
+      const auto now = std::chrono::steady_clock::now();
+      MutexLock lock(slot_mutex_);
+      auto& pool = slot_pool_[class_key];
+      for (auto& s : refills) {
+        // Overflow (a concurrent put of this class refilled first) is
+        // cancelled, not dropped: each refill reserves real capacity.
+        if (pool.size() >= options_.put_slots) {
+          overflow.push_back(std::move(s.slot_key));
+        } else {
+          pool.push_back({std::move(s), now});
+        }
+      }
+    }
+    if (!overflow.empty()) {
+      (void)rpc_failover(/*idempotent=*/false,
+                   [&](rpc::KeystoneRpcClient& c) { return c.batch_put_cancel(overflow); });  // best-effort cancel; slot TTL reclaims
+    }
+    return ErrorCode::OK;
+  }
+  if (ec == ErrorCode::OBJECT_NOT_FOUND) {
+    // Slot reclaimed (TTL) or minted by a deposed leader: transparent
+    // fallback — the normal path re-reserves and re-writes.
+    return std::nullopt;
+  }
+  // Duplicate key, fail-closed persist, etc.: the slot survives server-side
+  // (commit rolled it back), so it can serve the next put of this class.
+  {
+    MutexLock lock(slot_mutex_);
+    slot_pool_[class_key].push_back({std::move(slot), slot_granted_at});
+  }
+  return ec;
+}
+
+void ObjectClient::cancel_pooled_slots() {
+  std::vector<ObjectKey> keys;
+  {
+    MutexLock lock(slot_mutex_);
+    for (auto& [cls, pool] : slot_pool_) {
+      for (auto& s : pool) keys.push_back(std::move(s.slot.slot_key));
+    }
+    slot_pool_.clear();
+  }
+  // Only when already connected: the destructor must not pay a connect
+  // timeout for a dead keystone — the slot TTL reclaims either way.
+  std::shared_ptr<rpc::KeystoneRpcClient> rpc;
+  if (!embedded_) rpc = rpc_snapshot();
+  if (keys.empty() || !rpc || !rpc->connected()) return;
+  (void)rpc->batch_put_cancel(keys);  // best-effort cancel; slot TTL reclaims
+}
+
+}  // namespace btpu::client

@@ -1,0 +1,280 @@
+#include "btpu/client/op_core.h"
+
+#include <algorithm>
+
+#include "btpu/common/env.h"
+#include "btpu/common/sched.h"
+
+namespace btpu::client {
+
+ClientCoreCounters& client_core_counters() noexcept {
+  static ClientCoreCounters counters;
+  return counters;
+}
+
+namespace {
+
+uint32_t resolve_lanes(uint32_t requested) {
+  if (requested > 0) return std::min(requested, 64u);
+  const uint64_t env = env_u64("BTPU_CLIENT_LANES", 0);
+  if (env > 0) return static_cast<uint32_t>(std::min<uint64_t>(env, 64));
+  const uint32_t hw = std::max(1u, std::thread::hardware_concurrency());
+  return std::min(4u, hw);
+}
+
+// One op entered the in-flight set: gauge up, peak folded in.
+void note_submitted() {
+  auto& c = client_core_counters();
+  // ordering: relaxed — stat fold.
+  c.submitted.fetch_add(1, std::memory_order_relaxed);
+  // ordering: relaxed — gauge; readers want a recent value, not an edge.
+  const uint64_t now = c.inflight.fetch_add(1, std::memory_order_relaxed) + 1;
+  // ordering: relaxed — monotonic max fold; losers retry on a newer peak.
+  uint64_t peak = c.peak_inflight.load(std::memory_order_relaxed);
+  while (now > peak &&
+         !c.peak_inflight.compare_exchange_weak(peak, now, std::memory_order_relaxed)) {
+  }
+}
+
+void note_completed(ErrorCode status) {
+  auto& c = client_core_counters();
+  // ordering: relaxed — stat fold.
+  c.completed.fetch_add(1, std::memory_order_relaxed);
+  if (status == ErrorCode::OPERATION_CANCELLED)
+    // ordering: relaxed — stat fold.
+    c.cancelled.fetch_add(1, std::memory_order_relaxed);
+  // ordering: relaxed — gauge decrement.
+  c.inflight.fetch_sub(1, std::memory_order_relaxed);
+}
+
+}  // namespace
+
+bool OpCore::Handle::done() const {
+  if (!op_) return true;
+  MutexLock lock(op_->m);
+  return op_->done;
+}
+
+bool OpCore::Handle::wait(const Deadline& deadline) const {
+  if (!op_) return true;
+  MutexLock lock(op_->m);
+  while (!op_->done) {
+    if (deadline.is_infinite()) {
+      op_->cv.wait(lock);
+    } else {
+      if (op_->cv.wait_until(lock, deadline.time_point()) == std::cv_status::timeout &&
+          !op_->done)
+        return false;
+    }
+  }
+  return true;
+}
+
+void OpCore::Handle::cancel() const {
+  if (!op_) return;
+  // ordering: relaxed — the flag is re-checked under Op::m-adjacent control
+  // flow before every stage; a late observation only delays the skip by one
+  // stage, never corrupts state.
+  op_->cancel.store(true, std::memory_order_relaxed);
+}
+
+ErrorCode OpCore::Handle::status() const {
+  if (!op_) return ErrorCode::OK;
+  MutexLock lock(op_->m);
+  return op_->status;
+}
+
+OpCore::OpCore(uint32_t lanes) : lanes_(resolve_lanes(lanes)) {}
+
+OpCore::~OpCore() {
+  {
+    MutexLock lock(m_);
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  std::vector<std::thread> threads;
+  {
+    MutexLock lock(m_);
+    threads.swap(threads_);
+  }
+  for (auto& t : threads) t.join();
+  // Sched-armed per-op threads: wait them out the same way the hedge drain
+  // does (notify-under-mutex on the other side, see finish()).
+  MutexLock lock(spawn_mutex_);
+  // ordering: acquire — pairs with the per-op threads' acq_rel decrement:
+  // observing 0 means every spawned op's last touch happened-before teardown.
+  while (spawned_.load(std::memory_order_acquire) != 0) spawn_cv_.wait(lock);
+}
+
+void OpCore::start_lanes_locked() {
+  if (started_) return;
+  started_ = true;
+  threads_.reserve(lanes_);
+  for (uint32_t i = 0; i < lanes_; ++i) threads_.emplace_back([this] { lane_main(); });
+}
+
+void OpCore::finish(const std::shared_ptr<Op>& op, ErrorCode status) {
+  // Counters fold BEFORE completion publishes: a waiter that wakes on done
+  // must already see this op counted completed/cancelled and out of the
+  // inflight gauge (ClientCore.CancelBeforeStageSkipsIt pins that order).
+  note_completed(status);
+  {
+    // Notify UNDER the mutex: a waiter (or the batch owner) may free the op
+    // handle the instant it observes done, the same discipline as the hedge
+    // drain (docs/CORRECTNESS.md).
+    MutexLock lock(op->m);
+    op->status = status;
+    op->done = true;
+    op->cv.notify_all();
+  }
+  // Drop the stage closure: it may pin its own submitter (an async batch
+  // holds the op's Handle while the closure holds the batch — a refcount
+  // cycle), so a completed op keeping it would leak the whole chain. Only
+  // the finishing runner ever touches step, and the op outlives this call
+  // through the caller's shared_ptr.
+  op->step = nullptr;
+}
+
+void OpCore::advance(const std::shared_ptr<Op>& op) {
+  // ordering: relaxed — see Handle::cancel.
+  if (op->cancel.load(std::memory_order_relaxed)) {
+    finish(op, ErrorCode::OPERATION_CANCELLED);
+    return;
+  }
+  if (op->deadline.expired()) {
+    // ordering: relaxed — monotonic stat counter.
+    robust_counters().client_deadline_exceeded.fetch_add(1, std::memory_order_relaxed);
+    finish(op, ErrorCode::DEADLINE_EXCEEDED);
+    return;
+  }
+  Step step;
+  {
+    // Stages run under the op's deadline so every wire call inside carries
+    // the caller's budget (the ambient deadline is thread-local).
+    OpDeadlineScope scope(op->deadline);
+    step = op->step();
+  }
+  if (step == Step::kDone) {
+    finish(op, ErrorCode::OK);
+    return;
+  }
+  // kYield: back of the queue — lanes interleave every in-flight op.
+  {
+    MutexLock lock(m_);
+    queue_.push_back(op);
+  }
+  // ordering: relaxed — gauge increment.
+  client_core_counters().queue_depth.fetch_add(1, std::memory_order_relaxed);
+  cv_.notify_one();
+}
+
+void OpCore::lane_main() {
+  for (;;) {
+    std::shared_ptr<Op> op;
+    {
+      MutexLock lock(m_);
+      ++idle_lanes_;
+      while (queue_.empty() && !stopping_) cv_.wait(lock);
+      --idle_lanes_;
+      if (queue_.empty()) return;  // stopping_ and drained
+      op = std::move(queue_.front());
+      queue_.pop_front();
+    }
+    // ordering: relaxed — gauge decrement.
+    client_core_counters().queue_depth.fetch_sub(1, std::memory_order_relaxed);
+    advance(op);
+  }
+}
+
+OpCore::Handle OpCore::submit(std::function<Step()> step, Deadline deadline) {
+  auto op = std::make_shared<Op>();
+  op->step = std::move(step);
+  op->deadline = deadline;
+  note_submitted();
+  if (sched::armed()) {
+    // Deterministic mode: the schedule explorer owns every interleaving, so
+    // each op gets an adopted thread (the exact shape the Sched fixtures
+    // pin) instead of a free-running persistent lane.
+    // ordering: acq_rel — increment visible before the spawned thread's
+    // decrement; the destructor's acquire drain sees every op retired.
+    spawned_.fetch_add(1, std::memory_order_acq_rel);
+    BTPU_SCHED_DECL_SPAWN();
+    std::thread([this, op] {
+      BTPU_SCHED_ADOPT_SPAWNED();
+      for (;;) {
+        // ordering: relaxed — see Handle::cancel.
+        if (op->cancel.load(std::memory_order_relaxed)) {
+          finish(op, ErrorCode::OPERATION_CANCELLED);
+          break;
+        }
+        if (op->deadline.expired()) {
+          // ordering: relaxed — monotonic stat counter.
+          robust_counters().client_deadline_exceeded.fetch_add(1,
+                                                               std::memory_order_relaxed);
+          finish(op, ErrorCode::DEADLINE_EXCEEDED);
+          break;
+        }
+        Step step_result;
+        {
+          OpDeadlineScope scope(op->deadline);
+          step_result = op->step();
+        }
+        if (step_result == Step::kDone) {
+          finish(op, ErrorCode::OK);
+          break;
+        }
+        BTPU_SCHED_YIELD();  // the explorer decides who advances next
+      }
+      {
+        MutexLock lock(spawn_mutex_);
+        // ordering: acq_rel — pairs with the destructor's acquire drain load.
+        spawned_.fetch_sub(1, std::memory_order_acq_rel);
+        spawn_cv_.notify_all();
+      }
+    }).detach();
+    return Handle(std::move(op));
+  }
+  {
+    MutexLock lock(m_);
+    start_lanes_locked();
+    queue_.push_back(op);
+  }
+  // ordering: relaxed — gauge increment.
+  client_core_counters().queue_depth.fetch_add(1, std::memory_order_relaxed);
+  cv_.notify_one();
+  return Handle(std::move(op));
+}
+
+bool OpCore::try_run_detached(std::function<void()> fn) {
+  if (sched::armed()) return false;  // determinism: the caller spawns + adopts
+  auto op = std::make_shared<Op>();
+  op->step = [work = std::move(fn)]() {
+    work();
+    return Step::kDone;
+  };
+  {
+    MutexLock lock(m_);
+    if (stopping_) return false;
+    start_lanes_locked();
+    // A hedge primary queued behind a deep backlog — or with every lane
+    // busy and none to dequeue it promptly — would rescue no tail latency;
+    // the caller's own spawn is the right valve there. (A lane running an
+    // op that hedges also lands here: it is itself busy, so when it is the
+    // last free-looking lane this check forces the spawn path and no lane
+    // ever waits on an op only itself could run.)
+    if (idle_lanes_ == 0 || queue_.size() >= lanes_) return false;
+    queue_.push_back(op);
+  }
+  note_submitted();
+  // ordering: relaxed — gauge increment.
+  client_core_counters().queue_depth.fetch_add(1, std::memory_order_relaxed);
+  cv_.notify_one();
+  return true;
+}
+
+uint64_t OpCore::queue_depth() const {
+  MutexLock lock(m_);
+  return queue_.size();
+}
+
+}  // namespace btpu::client

@@ -53,6 +53,7 @@ std::string_view to_string(ErrorCode code) noexcept {
     case ErrorCode::CLIENT_DISCONNECTED: return "CLIENT_DISCONNECTED";
     case ErrorCode::SESSION_EXPIRED: return "SESSION_EXPIRED";
     case ErrorCode::INVALID_CLIENT_STATE: return "INVALID_CLIENT_STATE";
+    case ErrorCode::OPERATION_CANCELLED: return "OPERATION_CANCELLED";
     case ErrorCode::CONFIG_ERROR: return "CONFIG_ERROR";
     case ErrorCode::INVALID_CONFIGURATION: return "INVALID_CONFIGURATION";
     case ErrorCode::INVALID_PARAMETERS: return "INVALID_PARAMETERS";
@@ -117,6 +118,7 @@ std::string_view describe(ErrorCode code) noexcept {
     case ErrorCode::CLIENT_DISCONNECTED: return "client connection lost";
     case ErrorCode::SESSION_EXPIRED: return "client session ttl expired";
     case ErrorCode::INVALID_CLIENT_STATE: return "client operation out of order";
+    case ErrorCode::OPERATION_CANCELLED: return "async op cancelled before completion";
     case ErrorCode::CONFIG_ERROR: return "configuration system failure";
     case ErrorCode::INVALID_CONFIGURATION: return "configuration failed validation";
     case ErrorCode::INVALID_PARAMETERS: return "call parameters failed validation";

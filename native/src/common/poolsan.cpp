@@ -198,9 +198,19 @@ struct Shadow::Impl {
     uint64_t rz{0};
     uint64_t gen{0};
     bool quarantined{false};
+    // Byte-level effect deferred by an open AccessPin (poolsan.h): the
+    // quarantine fill / red-zone arm has NOT been written yet, so the
+    // matching canary check must be skipped until the flush applies it.
+    bool fill_pending{false};
+    bool rz_pending{false};
   };
   // offset -> extent; the authoritative map every resolve consults.
   std::map<uint64_t, Extent> extents BTPU_GUARDED_BY(mutex);
+  // Open AccessPins on this pool; while nonzero, on_free/on_alloc defer
+  // their poison/pattern writes (state flips stay immediate). The dirty
+  // flag makes the last unpin's flush O(extents) only when needed.
+  uint64_t pins BTPU_GUARDED_BY(mutex){0};
+  bool deferred_dirty BTPU_GUARDED_BY(mutex){false};
   std::deque<uint64_t> quarantine BTPU_GUARDED_BY(mutex);  // FIFO of offsets
   uint64_t q_usable BTPU_GUARDED_BY(mutex){0};
   uint64_t gen_counter BTPU_GUARDED_BY(mutex){0};
@@ -223,6 +233,25 @@ struct Shadow::Impl {
     return it;
   }
 
+  // Applies every deferred byte-level effect once the last pin drops. An
+  // extent that was freed AND released (or the whole pool unbound) while
+  // pinned simply lost its pending flag with the state that carried it —
+  // the flush only writes what the CURRENT state still calls for.
+  void flush_deferred() BTPU_REQUIRES(mutex) {
+    if (!deferred_dirty) return;
+    deferred_dirty = false;
+    for (auto& [off, e] : extents) {
+      if (host != nullptr) {
+        if (e.fill_pending && e.quarantined)
+          poison_bytes(host + off, e.len, kQuarantinePattern);
+        if (e.rz_pending && !e.quarantined && e.rz)
+          poison_bytes(host + off + e.len, e.rz, kRedzonePattern);
+      }
+      e.fill_pending = false;
+      e.rz_pending = false;
+    }
+  }
+
   // Pops quarantine FIFO entries until `q_usable <= budget`, verifying
   // quarantine canaries on the way out. Appends released full spans.
   void pop_quarantine_to(uint64_t budget, const std::string& pool,
@@ -234,7 +263,8 @@ struct Shadow::Impl {
       if (it == extents.end() || !it->second.quarantined) continue;  // defensive
       const Extent e = it->second;
       if (host != nullptr) {
-        if (!canary_intact(host + off, e.len, kQuarantinePattern)) {
+        // A fill deferred by a pin was never written — nothing to verify.
+        if (!e.fill_pending && !canary_intact(host + off, e.len, kQuarantinePattern)) {
           convict(Fault::kQuarantineSmash, pool, Access::kWrite, off, e.len, 0, e.gen,
                   "quarantined", /*who=*/{}, /*trace_id=*/0);
         }
@@ -273,6 +303,26 @@ struct Registry {
     return r;
   }
 };
+
+// The serve-path shadow lookup: host base address first (worker side), then
+// the region tag as a pool id or alias. Shared by check_access and the
+// AccessPin surface so both resolve the SAME shadow for a given region.
+ShadowPtr lookup_shadow(const void* base, const char* tag) {
+  ShadowPtr shadow;
+  auto& reg = Registry::instance();
+  SharedLock lock(reg.mutex);
+  auto it = reg.by_base.find(reinterpret_cast<uintptr_t>(base));
+  if (it != reg.by_base.end()) shadow = it->second.lock();
+  if (!shadow && tag != nullptr) {
+    auto nit = reg.by_name.find(tag);
+    if (nit == reg.by_name.end()) {
+      auto ait = reg.aliases.find(tag);
+      if (ait != reg.aliases.end()) nit = reg.by_name.find(ait->second);
+    }
+    if (nit != reg.by_name.end()) shadow = nit->second.lock();
+  }
+  return shadow;
+}
 
 // Attaches a host binding to a live shadow (registry lock held by caller;
 // takes the shadow's leaf mutex). Rejects size mismatches — a colliding
@@ -332,12 +382,21 @@ uint64_t Shadow::redzone_bytes() const noexcept { return impl_->rz_default; }
 uint64_t Shadow::on_alloc(uint64_t offset, uint64_t len, uint64_t rz_len) {
   MutexLock lock(impl_->mutex);
   const uint64_t gen = ++impl_->gen_counter;
-  impl_->extents[offset] = Impl::Extent{len, rz_len, gen, false};
+  Impl::Extent& e = impl_->extents[offset] = Impl::Extent{len, rz_len, gen, false};
   if (impl_->host != nullptr) {
     // Fresh extent: its bytes may have been poisoned as part of an earlier
     // quarantined span — make them writable again, then arm the red zone.
+    // Arming is a byte-level effect, so an open pin defers it: this carve
+    // may reuse space a pinned copy is still streaming out of.
     unpoison_bytes(impl_->host + offset, len);
-    if (rz_len) poison_bytes(impl_->host + offset + len, rz_len, kRedzonePattern);
+    if (rz_len) {
+      if (impl_->pins > 0) {
+        e.rz_pending = true;
+        impl_->deferred_dirty = true;
+      } else {
+        poison_bytes(impl_->host + offset + len, rz_len, kRedzonePattern);
+      }
+    }
   }
   return gen;
 }
@@ -384,14 +443,27 @@ FreeOutcome Shadow::on_free(uint64_t offset, uint64_t len, std::string_view who)
     out.refused = true;
     return out;
   }
-  if (impl_->host != nullptr && e.rz &&
+  // A red zone whose arming a pin deferred was never written: no canary to
+  // verify (and none to smash).
+  if (impl_->host != nullptr && e.rz && !e.rz_pending &&
       !canary_intact(impl_->host + offset + e.len, e.rz, kRedzonePattern)) {
     convict(Fault::kRedzoneSmash, pool_id_, Access::kWrite, offset, e.len, 0, e.gen,
             "allocated", who, 0);
     out.smashed = true;  // reported; the free itself still proceeds
   }
+  // The state flip is IMMEDIATE even under a pin — the very next resolve
+  // convicts this extent — but the poison/pattern fill waits for the last
+  // pin to drop: a copy the pool already vouched for may still be reading
+  // these bytes (the sanctioned RMA race; poolsan.h "access pins").
   e.quarantined = true;
-  if (impl_->host != nullptr) poison_bytes(impl_->host + offset, e.len, kQuarantinePattern);
+  if (impl_->host != nullptr) {
+    if (impl_->pins > 0) {
+      e.fill_pending = true;
+      impl_->deferred_dirty = true;
+    } else {
+      poison_bytes(impl_->host + offset, e.len, kQuarantinePattern);
+    }
+  }
   impl_->quarantine.push_back(offset);
   impl_->q_usable += e.len;
   // ordering: relaxed — live gauges.
@@ -509,21 +581,7 @@ void alias_pool(const std::string& alias, const std::string& pool_id) {
 ErrorCode check_access(const void* base, const char* tag, uint64_t region_len,
                        uint64_t offset, uint64_t len, uint64_t gen, Access access,
                        uint64_t trace_id) noexcept {
-  ShadowPtr shadow;
-  {
-    auto& reg = Registry::instance();
-    SharedLock lock(reg.mutex);
-    auto it = reg.by_base.find(reinterpret_cast<uintptr_t>(base));
-    if (it != reg.by_base.end()) shadow = it->second.lock();
-    if (!shadow && tag != nullptr) {
-      auto nit = reg.by_name.find(tag);
-      if (nit == reg.by_name.end()) {
-        auto ait = reg.aliases.find(tag);
-        if (ait != reg.aliases.end()) nit = reg.by_name.find(ait->second);
-      }
-      if (nit != reg.by_name.end()) shadow = nit->second.lock();
-    }
-  }
+  ShadowPtr shadow = lookup_shadow(base, tag);
   if (!shadow) return ErrorCode::OK;  // untracked region: bounds proof only
   // A shadow whose geometry disagrees with the caller's region is a pool-id
   // collision (two clusters in one process) — degrade to untracked rather
@@ -578,6 +636,27 @@ ErrorCode check_access(const void* base, const char* tag, uint64_t region_len,
   return ErrorCode::OK;
 }
 
+namespace internal {
+
+ShadowPtr pin_shadow(const void* base, const char* tag, uint64_t region_len) noexcept {
+  if (!armed()) return nullptr;
+  ShadowPtr shadow = lookup_shadow(base, tag);
+  // Same degrade rule as check_access: a geometry mismatch is a pool-id
+  // collision — pinning the wrong shadow would defer a stranger's poison.
+  if (!shadow || shadow->size() != region_len) return nullptr;
+  MutexLock lock(shadow->impl_->mutex);
+  ++shadow->impl_->pins;
+  return shadow;
+}
+
+void unpin_shadow(const ShadowPtr& shadow) noexcept {
+  if (!shadow) return;
+  MutexLock lock(shadow->impl_->mutex);
+  if (--shadow->impl_->pins == 0) shadow->impl_->flush_deferred();
+}
+
+}  // namespace internal
+
 uint64_t scrub_canaries() {
 #if defined(BTPU_POOLSAN_ASAN)
   return 0;  // asan traps at the faulting instruction; nothing to sweep
@@ -595,15 +674,17 @@ uint64_t scrub_canaries() {
     MutexLock lock(shadow->impl_->mutex);
     if (shadow->impl_->host == nullptr) continue;
     for (auto& [off, e] : shadow->impl_->extents) {
+      // Pending = deferred by an open pin, never written: nothing to verify.
       if (e.quarantined) {
-        if (!canary_intact(shadow->impl_->host + off, e.len, kQuarantinePattern)) {
+        if (!e.fill_pending &&
+            !canary_intact(shadow->impl_->host + off, e.len, kQuarantinePattern)) {
           convict(Fault::kQuarantineSmash, shadow->pool_id(), Access::kWrite, off, e.len,
                   0, e.gen, "quarantined", "scrub", 0);
           ++smashes;
           // Re-arm so one smash is one report per scrub epoch, not per pass.
           poison_bytes(shadow->impl_->host + off, e.len, kQuarantinePattern);
         }
-      } else if (e.rz &&
+      } else if (e.rz && !e.rz_pending &&
                  !canary_intact(shadow->impl_->host + off + e.len, e.rz, kRedzonePattern)) {
         convict(Fault::kRedzoneSmash, shadow->pool_id(), Access::kWrite, off, e.len, 0,
                 e.gen, "allocated", "scrub", 0);

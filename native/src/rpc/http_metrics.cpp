@@ -6,6 +6,7 @@
 #include <sstream>
 
 #include "btpu/cache/object_cache.h"
+#include "btpu/client/op_core.h"
 #include "btpu/common/flight_recorder.h"
 #include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
@@ -206,6 +207,32 @@ std::string MetricsHttpServer::render_metrics() const {
     counter("btpu_breaker_skips_total",
             "replica candidates deprioritized because their breaker was open",
             r.breaker_skips.load());
+  }
+  {
+    // Client op core (btpu/client/op_core.h): the completion-based async
+    // engine. Sustained inflight at peak with cq depth near zero = lanes
+    // starved on downstream I/O; cq depth growing unboundedly = submitters
+    // outrunning the lanes (docs/OPERATIONS.md alerts).
+    const auto& c = client::client_core_counters();
+    gauge("btpu_client_inflight_ops",
+          "async client ops submitted and not yet completed",
+          static_cast<double>(c.inflight.load()));
+    gauge("btpu_client_cq_depth", "ops parked in client completion queues right now",
+          static_cast<double>(c.queue_depth.load()));
+    counter("btpu_client_peak_inflight_ops", "high-water mark of in-flight async ops",
+            c.peak_inflight.load());
+    counter("btpu_client_ops_submitted_total", "async client ops submitted",
+            c.submitted.load());
+    counter("btpu_client_ops_completed_total", "async client ops completed",
+            c.completed.load());
+    counter("btpu_client_ops_cancelled_total", "async client ops cancelled",
+            c.cancelled.load());
+    counter("btpu_optimistic_hits_total",
+            "reads served from cached placements with zero keystone turns",
+            c.optimistic_hits.load());
+    counter("btpu_optimistic_revalidates_total",
+            "optimistic reads that fell back to a fresh-metadata retry",
+            c.optimistic_revalidates.load());
   }
   // Flight recorder + span ring health (the dumps live at /debug/flight
   // and /debug/trace; these gauges say whether anything is flowing).

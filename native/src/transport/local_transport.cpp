@@ -131,6 +131,13 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
   RegionReadFn read_fn;
   RegionWriteFn write_fn;
   uint64_t offset = 0;
+  // Held across the post-lock copy below. The registry lock only proves the
+  // extent live at RESOLVE time; a concurrent free may quarantine it while
+  // the memcpy runs — the sanctioned one-sided RMA race (CRC gate judges the
+  // stale bytes). The pin keeps an armed poolsan from turning that race into
+  // a use-after-poison trap: it defers the freed extent's byte-level poison
+  // (never the conviction) until the copy is out (poolsan.h "access pins").
+  poolsan::AccessPin pin;
   {
     SharedLock lock(reg.mutex);
     auto it = reg.by_rkey.find(rkey);
@@ -141,6 +148,9 @@ ErrorCode local_access(uint64_t remote_addr, uint64_t rkey, void* buf, uint64_t 
       return ErrorCode::MEMORY_ACCESS_ERROR;
     offset = remote_addr - region.remote_base;
     if (region.base) {
+      // Pin BEFORE the proof: a free landing in between is convicted by the
+      // resolve; one landing after it finds the pin already open.
+      pin = poolsan::AccessPin(region.base, region.tag.c_str(), region.len);
       auto span = poolspan::resolve(region.base, region.len, offset, len, extent_gen,
                                     is_write ? poolspan::Access::kWrite
                                              : poolspan::Access::kRead,

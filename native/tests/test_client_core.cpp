@@ -1,0 +1,588 @@
+// Client op-core suite (ISSUE 16):
+//
+//   ClientCore.*   — the completion-based op core as a unit: submit/yield/
+//                    cancel/deadline state machines, the thousand-in-flight
+//                    property (one submitter thread, ops parked in the
+//                    completion queue, not threads), the 4-submitter hammer
+//                    the tsan tree leans on, the async batch API end to end
+//                    against an EmbeddedCluster, and the optimistic-read
+//                    staleness contract (rewrite mid-read -> revalidation
+//                    returns the NEW bytes, never garbage).
+//   Sched.OpCore*  — the same machinery under seeded PCT schedules: under
+//                    sched::armed() every submitted op runs on its own
+//                    adopted thread, so the explorer owns the submit/
+//                    complete/cancel/deadline/shutdown interleavings.
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "btest.h"
+#include "btpu/client/client.h"
+#include "btpu/client/embedded.h"
+#include "btpu/client/op_core.h"
+#include "btpu/common/deadline.h"
+#include "btpu/common/env.h"
+#include "btpu/common/sched.h"
+
+using namespace btpu;
+using namespace btpu::client;
+
+namespace {
+
+// Same shape as test_sched.cpp's run_seeds (duplicated on purpose: each TU
+// is self-contained so --filter=Sched works from either).
+void run_seeds(const char* what, uint32_t default_seeds, uint32_t threads,
+               uint32_t pct_steps, const std::function<void()>& fixture) {
+  if (!sched::compiled_in()) {
+    fixture();
+    return;
+  }
+  const uint64_t pinned = env_u64("BTPU_SCHED_SEED", 0);
+  const uint64_t n = std::max<uint64_t>(1, env_u64("BTPU_SCHED_SEEDS", default_seeds));
+  const uint64_t first = pinned ? pinned : 1;
+  const uint64_t last = pinned ? pinned : n;
+  for (uint64_t seed = first; seed <= last; ++seed) {
+    const bool failed_before = btest::current_failed();
+    {
+      sched::RunOptions ro;
+      ro.seed = seed;
+      ro.threads = threads;
+      ro.pct_steps = pct_steps;
+      sched::Run run(ro);
+      fixture();
+    }
+    if (!failed_before && btest::current_failed()) {
+      std::fprintf(stderr,
+                   "  [sched] %s FAILED at seed %llu — BTPU_SCHED_SEED=%llu "
+                   "./btpu_tests --filter=... replays it\n",
+                   what, static_cast<unsigned long long>(seed),
+                   static_cast<unsigned long long>(seed));
+      return;
+    }
+  }
+}
+
+std::vector<uint8_t> pattern(uint64_t size, uint8_t seed) {
+  std::vector<uint8_t> data(size);
+  for (uint64_t i = 0; i < size; ++i) data[i] = static_cast<uint8_t>(i * 131 + seed);
+  return data;
+}
+
+// A flag the submitter releases to unblock ops parked in a stage. Ops spin
+// with a real sleep: these fixtures run free-scheduled (no sched::Run), so
+// the lanes are genuine OS threads.
+struct Gate {
+  std::atomic<bool> open{false};
+  void wait() const {
+    while (!open.load(std::memory_order_acquire))
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+  }
+};
+
+}  // namespace
+
+// ===========================================================================
+// ClientCore.* — the op core as a unit (free-scheduled)
+// ===========================================================================
+
+BTEST(ClientCore, SubmitCompleteCountersBalance) {
+  auto& cc = client_core_counters();
+  const uint64_t sub0 = cc.submitted.load();
+  const uint64_t com0 = cc.completed.load();
+  const uint64_t inf0 = cc.inflight.load();
+  std::atomic<int> ran{0};
+  {
+    OpCore core(2);
+    std::vector<OpCore::Handle> handles;
+    for (int i = 0; i < 64; ++i)
+      handles.push_back(core.submit([&ran] {
+        ran.fetch_add(1, std::memory_order_relaxed);
+        return OpCore::Step::kDone;
+      }));
+    for (const auto& h : handles) {
+      BT_EXPECT(h.valid());
+      BT_EXPECT(h.wait());
+      BT_EXPECT(h.done());
+      BT_EXPECT(h.status() == ErrorCode::OK);
+    }
+    BT_EXPECT_EQ(core.queue_depth(), 0ull);
+  }
+  BT_EXPECT_EQ(ran.load(), 64);
+  BT_EXPECT_EQ(cc.submitted.load() - sub0, 64ull);
+  BT_EXPECT_EQ(cc.completed.load() - com0, 64ull);
+  BT_EXPECT_EQ(cc.inflight.load(), inf0);  // gauge returned to baseline
+}
+
+BTEST(ClientCore, MultiStageYieldAdvancesInOrder) {
+  // The closure owns its stage cursor; kYield re-enqueues at the tail and
+  // the SAME closure is called for the next stage — never concurrently.
+  OpCore core(2);
+  auto stage = std::make_shared<std::atomic<int>>(0);
+  auto h = core.submit([stage] {
+    const int s = stage->fetch_add(1, std::memory_order_relaxed);
+    return s < 2 ? OpCore::Step::kYield : OpCore::Step::kDone;
+  });
+  BT_EXPECT(h.wait());
+  BT_EXPECT(h.status() == ErrorCode::OK);
+  BT_EXPECT_EQ(stage->load(), 3);  // three stage entries: yield, yield, done
+}
+
+BTEST(ClientCore, CancelBeforeStageSkipsIt) {
+  auto& cc = client_core_counters();
+  const uint64_t can0 = cc.cancelled.load();
+  Gate gate;
+  std::atomic<bool> victim_ran{false};
+  OpCore core(1);  // one lane: the blocker pins it, the victim queues behind
+  auto blocker = core.submit([&gate] {
+    gate.wait();
+    return OpCore::Step::kDone;
+  });
+  auto victim = core.submit([&victim_ran] {
+    victim_ran.store(true, std::memory_order_relaxed);
+    return OpCore::Step::kDone;
+  });
+  victim.cancel();  // still queued: its stage must never run
+  gate.open.store(true, std::memory_order_release);
+  BT_EXPECT(blocker.wait());
+  BT_EXPECT(victim.wait());
+  BT_EXPECT(victim.status() == ErrorCode::OPERATION_CANCELLED);
+  BT_EXPECT(!victim_ran.load());
+  BT_EXPECT(cc.cancelled.load() - can0 >= 1);
+}
+
+BTEST(ClientCore, DeadlineExpiryCompletesWithoutRunning) {
+  const uint64_t dl0 = robust_counters().client_deadline_exceeded.load();
+  Gate gate;
+  std::atomic<bool> victim_ran{false};
+  OpCore core(1);
+  auto blocker = core.submit([&gate] {
+    gate.wait();
+    return OpCore::Step::kDone;
+  });
+  auto victim = core.submit(
+      [&victim_ran] {
+        victim_ran.store(true, std::memory_order_relaxed);
+        return OpCore::Step::kDone;
+      },
+      Deadline::after_ms(1));
+  std::this_thread::sleep_for(std::chrono::milliseconds(10));  // let it expire queued
+  gate.open.store(true, std::memory_order_release);
+  BT_EXPECT(blocker.wait());
+  BT_EXPECT(victim.wait());
+  BT_EXPECT(victim.status() == ErrorCode::DEADLINE_EXCEEDED);
+  BT_EXPECT(!victim_ran.load());
+  BT_EXPECT(robust_counters().client_deadline_exceeded.load() - dl0 >= 1);
+}
+
+BTEST(ClientCore, WaitTimesOutWhileOpKeepsRunning) {
+  Gate gate;
+  OpCore core(1);
+  auto h = core.submit([&gate] {
+    gate.wait();
+    return OpCore::Step::kDone;
+  });
+  BT_EXPECT(!h.wait(Deadline::after_ms(5)));  // timed out, op still in flight
+  BT_EXPECT(!h.done());
+  gate.open.store(true, std::memory_order_release);
+  BT_EXPECT(h.wait());
+  BT_EXPECT(h.status() == ErrorCode::OK);
+}
+
+BTEST(ClientCore, TryRunDetachedRefusesWhenLanesBusy) {
+  Gate gate;
+  OpCore core(1);
+  auto blocker = core.submit([&gate] {
+    gate.wait();
+    return OpCore::Step::kDone;
+  });
+  // Give the lane a beat to dequeue the blocker, then the core must refuse:
+  // a hedge parked behind a busy lane would rescue nothing.
+  for (int i = 0; i < 200 && core.queue_depth() > 0; ++i)
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  std::atomic<bool> ran{false};
+  const bool accepted =
+      core.try_run_detached([&ran] { ran.store(true, std::memory_order_relaxed); });
+  BT_EXPECT(!accepted);
+  BT_EXPECT(!ran.load());
+  gate.open.store(true, std::memory_order_release);
+  BT_EXPECT(blocker.wait());
+  // Idle again: the valve opens. (Poll: the lane flips to idle after done.)
+  bool accepted_idle = false;
+  for (int i = 0; i < 200 && !accepted_idle; ++i) {
+    accepted_idle =
+        core.try_run_detached([&ran] { ran.store(true, std::memory_order_relaxed); });
+    if (!accepted_idle) std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+  if (sched::compiled_in() && sched::armed()) return;  // armed: always refuses
+  BT_EXPECT(accepted_idle);
+  for (int i = 0; i < 200 && !ran.load(); ++i)
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  BT_EXPECT(ran.load());
+}
+
+BTEST(ClientCore, ThousandOpsInFlightFromOneThread) {
+  // THE tentpole property: one submitter thread parks >= 1000 concurrent
+  // ops in the completion queue — in-flight ops are queue entries, not
+  // threads. (bb-bench's client-core row measures the same thing with real
+  // I/O; this is the machine-checked floor.)
+  auto& cc = client_core_counters();
+  const uint64_t inf0 = cc.inflight.load();
+  Gate gate;
+  std::atomic<int> ran{0};
+  OpCore core(2);
+  std::vector<OpCore::Handle> handles;
+  handles.reserve(1200);
+  for (int i = 0; i < 1200; ++i)
+    handles.push_back(core.submit([&gate, &ran] {
+      gate.wait();
+      ran.fetch_add(1, std::memory_order_relaxed);
+      return OpCore::Step::kDone;
+    }));
+  // All 1200 submitted from THIS thread before any completion was waited
+  // on; at most `lanes` of them occupy threads.
+  BT_EXPECT(cc.inflight.load() - inf0 >= 1000);
+  BT_EXPECT(core.queue_depth() >= 1000);
+  BT_EXPECT(cc.peak_inflight.load() >= 1000);
+  gate.open.store(true, std::memory_order_release);
+  for (const auto& h : handles) BT_EXPECT(h.wait());
+  BT_EXPECT_EQ(ran.load(), 1200);
+  BT_EXPECT_EQ(cc.inflight.load(), inf0);
+}
+
+BTEST(ClientCore, ManyOpHammerFourSubmitters) {
+  // The tsan tree's target: 4 submitter threads x 300 ops (mixed
+  // single-stage / multi-stage / cancelled) against 4 lanes. Invariant per
+  // op: effect happened iff status == OK.
+  constexpr int kThreads = 4, kOpsPer = 300;
+  OpCore core(4);
+  struct Slot {
+    std::atomic<bool> effect{false};
+    OpCore::Handle handle;
+  };
+  std::vector<Slot> slots(kThreads * kOpsPer);
+  auto submitter = [&](int t) {
+    for (int i = 0; i < kOpsPer; ++i) {
+      Slot& slot = slots[t * kOpsPer + i];
+      if (i % 3 == 0) {
+        // Multi-stage: two yields before the effect lands.
+        auto stage = std::make_shared<int>(0);
+        slot.handle = core.submit([&slot, stage] {
+          if (++*stage < 3) return OpCore::Step::kYield;
+          slot.effect.store(true, std::memory_order_relaxed);
+          return OpCore::Step::kDone;
+        });
+      } else {
+        slot.handle = core.submit([&slot] {
+          slot.effect.store(true, std::memory_order_relaxed);
+          return OpCore::Step::kDone;
+        });
+      }
+      if (i % 7 == 0) slot.handle.cancel();  // races the lanes: either verdict legal
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) threads.emplace_back(submitter, t);
+  for (auto& t : threads) t.join();
+  int ok = 0, cancelled = 0;
+  for (auto& slot : slots) {
+    BT_EXPECT(slot.handle.wait());
+    const ErrorCode ec = slot.handle.status();
+    BT_EXPECT(ec == ErrorCode::OK || ec == ErrorCode::OPERATION_CANCELLED);
+    BT_EXPECT_EQ(slot.effect.load(), ec == ErrorCode::OK);
+    (ec == ErrorCode::OK ? ok : cancelled)++;
+  }
+  BT_EXPECT_EQ(ok + cancelled, kThreads * kOpsPer);
+  BT_EXPECT(ok >= kThreads * kOpsPer * 6 / 7);  // only the %7 submissions may cancel
+  BT_EXPECT_EQ(core.queue_depth(), 0ull);
+}
+
+BTEST(ClientCore, AsyncBatchPutGetEndToEnd) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 16 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client(ClientOptions());
+  constexpr int kN = 24;
+  std::vector<std::vector<uint8_t>> payloads;
+  std::vector<ObjectClient::PutItem> puts;
+  for (int i = 0; i < kN; ++i) {
+    payloads.push_back(pattern(2048 + 64 * i, static_cast<uint8_t>(i)));
+    puts.push_back({"core/k" + std::to_string(i), payloads.back().data(),
+                    payloads.back().size()});
+  }
+  auto put_batch = client->put_many_async(puts);
+  // Pre-done reads are the documented sentinel, whether or not it is still
+  // running by the time we look.
+  if (!put_batch->done())
+    for (const ErrorCode ec : put_batch->codes())
+      BT_EXPECT(ec == ErrorCode::RETRY_LATER);
+  BT_EXPECT(put_batch->wait());
+  BT_EXPECT(put_batch->status() == ErrorCode::OK);
+  BT_EXPECT_EQ(put_batch->size(), static_cast<size_t>(kN));
+  for (int i = 0; i < kN; ++i) {
+    BT_EXPECT(put_batch->codes()[i] == ErrorCode::OK);
+    BT_EXPECT_EQ(put_batch->sizes()[i], payloads[i].size());
+  }
+
+  std::vector<std::vector<uint8_t>> bufs(kN);
+  std::vector<ObjectClient::GetItem> gets;
+  for (int i = 0; i < kN; ++i) {
+    bufs[i].assign(payloads[i].size(), 0);
+    gets.push_back({"core/k" + std::to_string(i), bufs[i].data(), bufs[i].size()});
+  }
+  auto get_batch = client->get_many_async(gets);
+  BT_EXPECT(get_batch->wait());
+  BT_EXPECT(get_batch->status() == ErrorCode::OK);
+  for (int i = 0; i < kN; ++i) {
+    BT_EXPECT(get_batch->codes()[i] == ErrorCode::OK);
+    BT_EXPECT_EQ(get_batch->sizes()[i], payloads[i].size());
+    BT_EXPECT(bufs[i] == payloads[i]);
+  }
+}
+
+BTEST(ClientCore, AsyncBatchCancelLeavesClientServiceable) {
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 16 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  auto client = cluster.make_client(ClientOptions());
+  const auto data = pattern(4096, 11);
+  std::vector<ObjectClient::PutItem> puts;
+  for (int i = 0; i < 16; ++i)
+    puts.push_back({"cancel/k" + std::to_string(i), data.data(), data.size()});
+  auto batch = client->put_many_async(puts);
+  batch->cancel();  // races the lanes: either the stage ran or it didn't
+  BT_EXPECT(batch->wait());
+  BT_EXPECT(batch->status() == ErrorCode::OK ||
+            batch->status() == ErrorCode::OPERATION_CANCELLED);
+  for (const ErrorCode ec : batch->codes())
+    BT_EXPECT(ec == ErrorCode::OK || ec == ErrorCode::OPERATION_CANCELLED ||
+              ec == ErrorCode::OBJECT_ALREADY_EXISTS);
+  // Whatever the race decided, the client keeps working.
+  BT_EXPECT_OK(client->put("cancel/after", data.data(), data.size()));
+  auto back = client->get("cancel/after");
+  BT_ASSERT_OK(back);
+  BT_EXPECT(back.value() == data);
+}
+
+BTEST(ClientCore, OptimisticReadRevalidatesOnRewrite) {
+  // FaRM-style optimistic reads: the hot path serves from cached placements
+  // with zero keystone turns; a rewrite bumps the embedded version stamp so
+  // the NEXT read revalidates and returns the NEW bytes — never the old
+  // placement's garbage.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(1, 16 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.optimistic_reads = true;
+  auto client = cluster.make_client(copts);
+  auto& cc = client_core_counters();
+
+  const auto v1 = pattern(8192, 1);
+  const auto v2 = pattern(12288, 2);  // different size AND bytes
+  BT_EXPECT_OK(client->put("opt/key", v1.data(), v1.size()));
+  auto first = client->get("opt/key");  // fills the placement cache
+  BT_ASSERT_OK(first);
+  BT_EXPECT(first.value() == v1);
+
+  const uint64_t hits0 = cc.optimistic_hits.load();
+  auto hot = client->get("opt/key");  // served from cached placements
+  BT_ASSERT_OK(hot);
+  BT_EXPECT(hot.value() == v1);
+  BT_EXPECT(cc.optimistic_hits.load() > hits0);
+
+  BT_EXPECT_OK(client->remove("opt/key"));
+  BT_EXPECT_OK(client->put("opt/key", v2.data(), v2.size()));
+  auto after = client->get("opt/key");  // stale entry must not serve
+  BT_ASSERT_OK(after);
+  BT_EXPECT(after.value() == v2);
+}
+
+BTEST(ClientCore, OptimisticReadNeverTornUnderRewriteChurn) {
+  // Reader loops optimistic gets while a writer remove+reputs the key with
+  // alternating payloads. Every successful read must be EXACTLY one of the
+  // two payloads (transient NOT_FOUND mid-swap is legal); a torn or stale-
+  // extent byte pattern is the bug the revalidation lane exists to kill.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(1, 32 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  ClientOptions copts;
+  copts.optimistic_reads = true;
+  auto reader = cluster.make_client(copts);
+  auto writer = cluster.make_client(ClientOptions());
+
+  const auto a = pattern(16384, 3);
+  const auto b = pattern(16384, 4);  // same size: a torn read would blend them
+  BT_EXPECT_OK(writer->put("churn/key", a.data(), a.size()));
+
+  std::atomic<bool> stop{false};
+  std::atomic<int> good_reads{0};
+  std::thread read_loop([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      auto got = reader->get("churn/key");
+      if (!got.ok()) continue;  // mid-swap miss: legal
+      BT_EXPECT(got.value() == a || got.value() == b);
+      good_reads.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
+  for (int i = 0; i < 40; ++i) {
+    const auto& next = (i % 2 == 0) ? b : a;
+    (void)writer->remove("churn/key");
+    BT_EXPECT_OK(writer->put("churn/key", next.data(), next.size()));
+  }
+  // Churn done, key stable: hold the reader open until it lands a few
+  // successful (and byte-checked) reads — the in-process churn can outrun
+  // the reader's first iteration entirely.
+  for (int i = 0; i < 20000 && good_reads.load() < 5; ++i)
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  stop.store(true, std::memory_order_release);
+  read_loop.join();
+  BT_EXPECT(good_reads.load() >= 5);
+}
+
+// ===========================================================================
+// Sched.OpCore* — the op core under seeded PCT schedules
+// ===========================================================================
+
+BTEST(Sched, OpCoreSubmitCancelRaces) {
+  // A submitter and a canceller race over one op across every schedule the
+  // explorer produces. Invariant: the op completes exactly once, and the
+  // effect happened iff the verdict is OK. Under sched::armed() the op runs
+  // on its own adopted thread — the explorer owns the interleaving.
+  run_seeds("opcore-cancel", 8, 2, 128, [] {
+    OpCore core(1);
+    std::atomic<bool> effect{false};
+    OpCore::Handle handle;
+    Mutex handoff;
+    auto submitter = [&] {
+      sched::Enroll enroll(0);
+      {
+        MutexLock lock(handoff);
+        handle = core.submit([&effect] {
+          effect.store(true, std::memory_order_relaxed);
+          return OpCore::Step::kDone;
+        });
+      }
+      BT_EXPECT(handle.wait());
+    };
+    auto canceller = [&] {
+      sched::Enroll enroll(1);
+      MutexLock lock(handoff);
+      if (handle.valid()) handle.cancel();
+    };
+    std::thread a(submitter), b(canceller);
+    a.join();
+    b.join();
+    BT_EXPECT(handle.done());
+    const ErrorCode ec = handle.status();
+    BT_EXPECT(ec == ErrorCode::OK || ec == ErrorCode::OPERATION_CANCELLED);
+    BT_EXPECT_EQ(effect.load(), ec == ErrorCode::OK);
+  });
+}
+
+BTEST(Sched, OpCoreDeadlineVsCompleteRaces) {
+  // A multi-stage op with a finite deadline: under sched the expiry is
+  // virtual, so the explorer enumerates {completed before expiry, expired
+  // between stages}. A partial effect with an OK verdict — or a full effect
+  // with DEADLINE_EXCEEDED — fails.
+  run_seeds("opcore-deadline", 8, 1, 128, [] {
+    OpCore core(1);
+    auto stages_run = std::make_shared<std::atomic<int>>(0);
+    std::thread t([&] {
+      sched::Enroll enroll(0);
+      auto h = core.submit(
+          [stages_run] {
+            const int s = stages_run->fetch_add(1, std::memory_order_relaxed);
+            BTPU_SCHED_YIELD();
+            return s < 1 ? OpCore::Step::kYield : OpCore::Step::kDone;
+          },
+          Deadline::after_ms(30));
+      BT_EXPECT(h.wait());
+      const ErrorCode ec = h.status();
+      BT_EXPECT(ec == ErrorCode::OK || ec == ErrorCode::DEADLINE_EXCEEDED);
+      if (ec == ErrorCode::OK) BT_EXPECT_EQ(stages_run->load(), 2);
+      if (ec == ErrorCode::DEADLINE_EXCEEDED) BT_EXPECT(stages_run->load() <= 2);
+    });
+    t.join();
+  });
+}
+
+BTEST(Sched, OpCoreShutdownDrainsQueuedOps) {
+  // The destructor contract the client relies on (~ObjectClient resets the
+  // core while queued async batches may reference client state): queued ops
+  // RUN to completion before the lanes join — nothing is dropped, no
+  // schedule may wedge the drain.
+  run_seeds("opcore-shutdown", 8, 1, 128, [] {
+    std::thread t([] {
+      sched::Enroll enroll(0);
+      std::atomic<int> ran{0};
+      OpCore::Handle h1, h2;
+      {
+        OpCore core(1);
+        auto stage = std::make_shared<int>(0);
+        h1 = core.submit([&ran, stage] {
+          ran.fetch_add(1, std::memory_order_relaxed);
+          BTPU_SCHED_YIELD();
+          return ++*stage < 2 ? OpCore::Step::kYield : OpCore::Step::kDone;
+        });
+        h2 = core.submit([&ran] {
+          ran.fetch_add(1, std::memory_order_relaxed);
+          return OpCore::Step::kDone;
+        });
+        // Destroy without waiting: the drain must finish both.
+      }
+      BT_EXPECT(h1.done());
+      BT_EXPECT(h2.done());
+      BT_EXPECT(ran.load() >= 2);
+    });
+    t.join();
+  });
+}
+
+BTEST(Sched, OpCoreAsyncBatchRaces) {
+  // The async batch surface under the explorer: a put batch and a get batch
+  // race from two enrolled threads against an embedded cluster. Correct
+  // bytes and clean verdicts in every schedule.
+  EmbeddedCluster cluster(EmbeddedClusterOptions::simple(2, 8 << 20));
+  BT_ASSERT(cluster.start() == ErrorCode::OK);
+  const auto seeded = pattern(4096, 9);
+  {
+    auto setup = cluster.make_client(ClientOptions());
+    BT_ASSERT(setup->put("sched/async0", seeded.data(), seeded.size()) == ErrorCode::OK);
+    BT_ASSERT(setup->put("sched/async1", seeded.data(), seeded.size()) == ErrorCode::OK);
+  }
+  static std::atomic<int> invocation{0};
+  run_seeds("opcore-async", 6, 2, 256, [&] {
+    auto client = cluster.make_client(ClientOptions());
+    const int round = invocation.fetch_add(1);
+    const auto fresh = pattern(2048, static_cast<uint8_t>(round));
+    auto putter = [&] {
+      sched::Enroll enroll(0);
+      std::vector<ObjectClient::PutItem> items;
+      items.push_back({"sched/put" + std::to_string(round), fresh.data(), fresh.size()});
+      auto batch = client->put_many_async(std::move(items));
+      BT_EXPECT(batch->wait());
+      BT_EXPECT(batch->status() == ErrorCode::OK);
+      BT_EXPECT(batch->codes()[0] == ErrorCode::OK);
+    };
+    std::vector<uint8_t> buf0(seeded.size(), 0), buf1(seeded.size(), 0);
+    auto getter = [&] {
+      sched::Enroll enroll(1);
+      std::vector<ObjectClient::GetItem> items;
+      items.push_back({"sched/async0", buf0.data(), buf0.size()});
+      items.push_back({"sched/async1", buf1.data(), buf1.size()});
+      auto batch = client->get_many_async(std::move(items));
+      BT_EXPECT(batch->wait());
+      BT_EXPECT(batch->status() == ErrorCode::OK);
+      BT_EXPECT(batch->codes()[0] == ErrorCode::OK);
+      BT_EXPECT(batch->codes()[1] == ErrorCode::OK);
+    };
+    std::thread a(putter), b(getter);
+    a.join();
+    b.join();
+    BT_EXPECT(buf0 == seeded);
+    BT_EXPECT(buf1 == seeded);
+    auto back = client->get("sched/put" + std::to_string(round));
+    BT_ASSERT_OK(back);
+    BT_EXPECT(back.value() == fresh);
+  });
+}

@@ -11,6 +11,10 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#if defined(__SANITIZE_ADDRESS__) && __has_include(<sanitizer/asan_interface.h>)
+#include <sanitizer/asan_interface.h>
+#endif
+
 #include <atomic>
 #include <cstring>
 #include <thread>
@@ -222,6 +226,74 @@ BTEST(Poolsan, DoubleFreeIsRefusedAndConvicted) {
   t->pa->free(*a, "second");  // the classic double free: REFUSED
   BT_EXPECT_EQ(poolsan::counters().double_free, before.double_free + 1);
   BT_EXPECT_EQ(t->pa->total_free(), free_after_first);  // free map untouched
+}
+
+// Access pin: while a pin is open (an in-flight RMA copy), a free's state
+// flip is immediate — the next resolve convicts — but the byte-level
+// quarantine poison is deferred, so the bytes the pool already vouched for
+// stay readable until the LAST pin drops. This is what keeps the sanctioned
+// one-sided-read-vs-free race (docs/BYTE_PATHS.md) from turning into a
+// use-after-poison abort under the armed asan tree.
+BTEST(Poolsan, AccessPinDefersPoisonUntilLastUnpin) {
+  if (!poolsan_ready("AccessPinDefersPoisonUntilLastUnpin")) return;
+  auto t = make_tracked(TransportKind::LOCAL, "ps-pin", 1 << 20);
+  BT_ASSERT(t != nullptr);
+  auto a = t->pa->allocate(4096);
+  BT_ASSERT(a.has_value());
+  const auto la = t->pa->to_memory_location(*a);
+
+  std::vector<uint8_t> data(4096, 0xAB);
+  {
+    auto span = poolspan::resolve(t->bytes.data(), t->bytes.size(), a->offset, a->length,
+                                  la.extent_gen, poolspan::Access::kWrite,
+                                  t->pool_id.c_str());
+    BT_ASSERT_OK(span);
+    std::memcpy(span.value().data(), data.data(), data.size());
+  }
+
+  {
+    poolsan::AccessPin outer(t->bytes.data(), t->pool_id.c_str(), t->bytes.size());
+    poolsan::AccessPin inner(t->bytes.data(), t->pool_id.c_str(), t->bytes.size());
+    t->pa->free(*a, "pinned-free");
+
+    // Detection never weakens: a resolve arriving after the free convicts.
+    auto dead = poolspan::resolve(t->bytes.data(), t->bytes.size(), a->offset, a->length,
+                                  la.extent_gen, poolspan::Access::kRead,
+                                  t->pool_id.c_str());
+    BT_EXPECT(dead.error() == ErrorCode::STALE_EXTENT);
+
+    // The in-flight copy window: bytes stay readable (an asan tree would
+    // abort right here on the deferred-but-applied poison) and still carry
+    // the extent's last contents.
+    std::vector<uint8_t> copy(4096, 0);
+    std::memcpy(copy.data(), t->bytes.data() + a->offset, copy.size());
+    BT_EXPECT(copy == data);
+
+    // One pin down, one still open: the fill stays deferred.
+    inner = poolsan::AccessPin();
+    std::memcpy(copy.data(), t->bytes.data() + a->offset, copy.size());
+    BT_EXPECT(copy == data);
+  }
+
+  // Last pin dropped: the quarantine fill applied. On the asan tree reading
+  // the bytes now would abort, so probe the poison state instead; on the
+  // gcc tree the pattern canary must be in place (verified by the drain).
+#if defined(__SANITIZE_ADDRESS__) && __has_include(<sanitizer/asan_interface.h>)
+  BT_EXPECT(__asan_region_is_poisoned(t->bytes.data() + a->offset, 4096) != nullptr);
+#else
+  BT_EXPECT(t->bytes[a->offset] != 0xAB);  // pattern-filled, old bytes gone
+#endif
+
+  // The quarantine canary survives its normal verification on the way out
+  // (a deferred-then-applied fill must read back as a well-formed canary).
+  const auto before = poolsan::counters();
+  {
+    ScopedEnv q("BTPU_POOLSAN_QUARANTINE_BYTES", "1");
+    auto churn = t->pa->allocate(64);
+    BT_ASSERT(churn.has_value());
+    t->pa->free(*churn, "drain");
+  }
+  BT_EXPECT_EQ(poolsan::counters().redzone_smash, before.redzone_smash);
 }
 
 BTEST(Poolsan, StaleDescriptorThreadEngine) {

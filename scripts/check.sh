@@ -84,6 +84,11 @@ run_leg "native-suite" ./build/btpu_tests
 run_leg "iouring-net-0-uring" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=Uring
 run_leg "iouring-net-0-transport" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=Transport
 run_leg "iouring-net-0-remote-lane" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=RemoteLane
+# The async client op core (ClientCore suite: completion queue, cancel/
+# deadline machines, many-op hammer, async batches, optimistic reads) moves
+# bytes through whichever socket engine the box resolved, so it gets the
+# same both-engines treatment as the remote lane.
+run_leg "iouring-net-0-client-core" env BTPU_IOURING_NET=0 ./build/btpu_tests --filter=ClientCore
 # The engine-required legs key on a capability probe: a kernel that cannot
 # run io_uring scores SKIP — never PASS — because the engine genuinely did
 # not run there (BTPU_IOURING_NET=1 still serves via the fallback rather
@@ -91,9 +96,11 @@ run_leg "iouring-net-0-remote-lane" env BTPU_IOURING_NET=0 ./build/btpu_tests --
 if ./build/bb-wire --probe > /dev/null 2>&1; then
   run_leg "iouring-net-1-uring" env BTPU_IOURING_NET=1 ./build/btpu_tests --filter=Uring
   run_leg "iouring-net-1-remote-lane" env BTPU_IOURING_NET=1 ./build/btpu_tests --filter=RemoteLane
+  run_leg "iouring-net-1-client-core" env BTPU_IOURING_NET=1 ./build/btpu_tests --filter=ClientCore
 else
   results[iouring-net-1-uring]="SKIP (kernel cannot run io_uring — probe failed)"
   results[iouring-net-1-remote-lane]="SKIP (kernel cannot run io_uring — probe failed)"
+  results[iouring-net-1-client-core]="SKIP (kernel cannot run io_uring — probe failed)"
 fi
 # tests/conftest.py hard-imports jax, so probe BOTH: a box with pytest but
 # no jax would otherwise fail at conftest load (exit 4), not skip cleanly.
@@ -172,9 +179,11 @@ echo "===================================================================="
 for leg in build lint-invariants lint-capi-check lint-tsa-sweep \
            lint-compileall lint-mypy lint-ruff capi-selftest native-suite \
            iouring-net-0-uring iouring-net-0-transport \
-           iouring-net-0-remote-lane iouring-net-1-uring iouring-net-1-remote-lane \
+           iouring-net-0-remote-lane iouring-net-0-client-core \
+           iouring-net-1-uring iouring-net-1-remote-lane \
+           iouring-net-1-client-core \
            tier1-pytest asan tsan fuzz-smoke crash-smoke sched-smoke \
            poolsan-smoke; do
-  [ -n "${results[$leg]:-}" ] && printf '  %-18s %s\n' "$leg" "${results[$leg]}"
+  [ -n "${results[$leg]:-}" ] && printf '  %-26s %s\n' "$leg" "${results[$leg]}"
 done
 exit "$overall"

@@ -1,0 +1,99 @@
+"""Async batch API (client op core): submit-now/complete-later batches
+through the typed Python plane, end to end against an EmbeddedCluster.
+
+The native side is covered shard-by-shard in native/tests/
+test_client_core.cpp; these tests pin the PYTHON contract: result() raises
+per item like the sync batch calls, handles survive close/cancel in any
+order, and the op-core counters surface through lane_counters().
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from blackbird_tpu import Client, EmbeddedCluster
+from blackbird_tpu.native import BtpuError, ErrorCode
+
+
+def test_async_put_then_get_roundtrip() -> None:
+    with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        payloads = {f"async/k{i}": bytes([i % 256]) * (1024 + i) for i in range(32)}
+        put_batch = client.put_many_async(payloads)
+        assert put_batch.result() is None  # waits; raises on any failed item
+        assert put_batch.done()
+
+        get_batch = client.get_many_async(list(payloads))
+        data = get_batch.result()
+        assert data is not None
+        assert {k: d for k, d in zip(payloads, data)} == payloads
+        put_batch.close()
+        get_batch.close()
+
+
+def test_async_batches_overlap_from_one_thread() -> None:
+    """One submitter thread keeps many batches in flight simultaneously —
+    the completion-core property the sync API cannot express."""
+    with EmbeddedCluster(workers=2, pool_bytes=32 << 20) as cluster:
+        client = cluster.client()
+        before = Client.lane_counters()
+        batches = [
+            client.put_many_async({f"overlap/{b}/{i}": b"x" * 512 for i in range(8)})
+            for b in range(16)
+        ]
+        for batch in batches:  # all 16 were in flight before the first wait
+            assert batch.result() is None
+        after = Client.lane_counters()
+        assert after["client_ops_submitted"] - before["client_ops_submitted"] >= 16
+        assert after["client_ops_completed"] - before["client_ops_completed"] >= 16
+        assert after["client_inflight_ops"] == 0
+        assert after["client_peak_inflight_ops"] >= 2
+        got = client.get_many_async([f"overlap/3/{i}" for i in range(8)]).result()
+        assert got == [b"x" * 512] * 8
+
+
+def test_async_get_missing_key_raises_per_item() -> None:
+    with EmbeddedCluster(workers=1, pool_bytes=4 << 20) as cluster:
+        client = cluster.client()
+        client.put("async/present", b"hello")
+        # The size probe runs at submit, so a missing key fails fast there —
+        # same first-failed-item contract as the sync get_many.
+        with pytest.raises(BtpuError) as excinfo:
+            client.get_many_async(["async/present", "async/missing"])
+        assert excinfo.value.code == ErrorCode.OBJECT_NOT_FOUND
+
+
+def test_async_put_duplicate_key_raises_from_result() -> None:
+    with EmbeddedCluster(workers=1, pool_bytes=4 << 20) as cluster:
+        client = cluster.client()
+        client.put("async/dup", b"first")
+        batch = client.put_many_async({"async/dup": b"second", "async/ok": b"x"})
+        with pytest.raises(BtpuError) as excinfo:
+            batch.result()
+        assert excinfo.value.code == ErrorCode.OBJECT_ALREADY_EXISTS
+        # The non-conflicting sibling item still landed.
+        assert client.get("async/ok") == b"x"
+
+
+def test_async_close_is_idempotent_and_blocks_use() -> None:
+    with EmbeddedCluster(workers=1, pool_bytes=4 << 20) as cluster:
+        client = cluster.client()
+        batch = client.put_many_async({"async/closed": b"x"})
+        assert batch.wait(timeout_ms=10_000)
+        batch.close()
+        batch.close()  # idempotent
+        with pytest.raises(RuntimeError):
+            batch.done()
+
+
+def test_async_cancel_then_close_is_safe() -> None:
+    """cancel() then close() must never deadlock or touch freed buffers —
+    close() waits out whatever stage is still running."""
+    with EmbeddedCluster(workers=2, pool_bytes=16 << 20) as cluster:
+        client = cluster.client()
+        batch = client.put_many_async({f"async/c{i}": b"y" * 4096 for i in range(16)})
+        batch.cancel()
+        batch.close()
+        # The cluster is still fully serviceable afterwards.
+        client.put("async/after-cancel", b"alive")
+        assert client.get("async/after-cancel") == b"alive"

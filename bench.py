@@ -445,6 +445,72 @@ def bench_fabric_client() -> None:
         )
 
 
+def bench_sharded_checkpoint() -> None:
+    """Sharded checkpoint/restore row (ISSUE 17): pod-shape save of a
+    NamedSharding array through the mesh-aware placement plane, restored
+    under the same sharding. Reports save/restore GB/s plus the cross-host
+    byte fraction from the placement scoreboard — the hint-effectiveness
+    number: 0.0 means every shard's bytes landed on (and were read back
+    from) its own host's worker. Runs in a --ckpt-only child so the JAX
+    runtime (CPU-pinned or ambient TPU) never touches the parent bench
+    process; prints the row JSON to stdout for the parent to merge.
+    """
+    import time as clock
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from blackbird_tpu import EmbeddedCluster
+    from blackbird_tpu.checkpoint import load_sharded, save_sharded
+    from blackbird_tpu.parallel import make_mesh
+    from blackbird_tpu.placement import PodPlacement
+
+    devices = jax.devices()
+    mesh = make_mesh(len(devices))
+    sharding = NamedSharding(mesh, PartitionSpec("workers", None))
+    # One 4 MiB f32 shard per device: big enough that per-op keystone
+    # latency is noise, small enough for a 1-core microVM's memory.
+    rows_per_dev = (4 << 20) // (1024 * 4)
+    source = np.arange(len(devices) * rows_per_dev * 1024,
+                       dtype=np.float32).reshape(-1, 1024)
+    arr = jax.block_until_ready(jax.device_put(source, sharding))
+
+    with EmbeddedCluster(workers=4, pool_bytes=256 << 20) as cluster:
+        client = cluster.client()
+        pp = PodPlacement(client)
+        t0 = clock.perf_counter()
+        save_sharded(client, "bench/ckpt", arr, placement=pp)
+        save_s = clock.perf_counter() - t0
+        counters = pp.counters()
+        placed = counters["host_local_bytes"] + counters["cross_host_bytes"]
+        t0 = clock.perf_counter()
+        back = jax.block_until_ready(
+            load_sharded(client, "bench/ckpt", sharding=sharding))
+        restore_s = clock.perf_counter() - t0
+        if not np.array_equal(np.asarray(back), source):
+            raise RuntimeError("sharded checkpoint restore mismatch")
+
+    row = {
+        "row": "sharded_checkpoint",
+        "platform": str(jax.default_backend()),
+        "devices": len(devices),
+        "nbytes": int(source.nbytes),
+        "save_gbps": source.nbytes / save_s / 1e9,
+        "restore_gbps": source.nbytes / restore_s / 1e9,
+        "cross_host_fraction":
+            (counters["cross_host_bytes"] / placed) if placed else 0.0,
+    }
+    print(json.dumps(row))
+    print(
+        f"sharded checkpoint ({row['platform']}, {row['devices']} devices, "
+        f"{source.nbytes >> 20} MiB): save {row['save_gbps']:.2f} GB/s | "
+        f"restore {row['restore_gbps']:.2f} GB/s | cross-host byte fraction "
+        f"{row['cross_host_fraction']:.3f}",
+        file=sys.stderr,
+    )
+
+
 def bench_trace_overhead(binary: Path) -> dict[str, Any] | None:
     """Trace-overhead guard row (ISSUE 10): tracing-on vs tracing-off over
     the hot cached get, A/B'd INSIDE one bb-bench process (--trace-ab runs
@@ -585,6 +651,10 @@ def main() -> int:
 
         native.build_native()
         bench_hbm_tier()
+        return 0
+    if "--ckpt-only" in sys.argv:
+        sys.path.insert(0, str(REPO_ROOT))
+        bench_sharded_checkpoint()
         return 0
     if "--fabric-only" in sys.argv:
         sys.path.insert(0, str(REPO_ROOT))
@@ -1038,6 +1108,7 @@ def main() -> int:
     # genuine device-backend regression can never hide behind the
     # environment excuse (VERDICT r4 item 5), and the 2x75 s timeout dance
     # runs at most once per bench run, not once per section.
+    ckpt_hbm_row: dict[str, Any] | None = None
     probe_detail = tpu_probe()
     if "skipped" in probe_detail:
         print("hbm tier bench skipped (see tpu probe verdict above)", file=sys.stderr)
@@ -1068,6 +1139,22 @@ def main() -> int:
                       file=sys.stderr)
         except subprocess.TimeoutExpired:
             print("real-TPU fabric row skipped: timed out", file=sys.stderr)
+        # Real-chip sharded checkpoint: the same --ckpt-only row on the
+        # ambient (TPU) platform — save/restore straight out of real HBM.
+        try:
+            child = subprocess.run(
+                [sys.executable, str(Path(__file__).resolve()), "--ckpt-only"],
+                capture_output=True, text=True, timeout=900, cwd=REPO_ROOT,
+            )
+            sys.stderr.write("real-TPU " + child.stderr if child.stderr else "")
+            if child.returncode == 0:
+                ckpt_hbm_row = json.loads(child.stdout.strip().splitlines()[-1])
+            else:
+                print("real-TPU sharded checkpoint row skipped: child exited "
+                      f"{child.returncode}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print("real-TPU sharded checkpoint row skipped: device backend "
+                  "hung AFTER a good probe", file=sys.stderr)
     # Decode-overhead guard (ISSUE 6): prove the checked WireReader keeps
     # the 1 MiB striped get and hot cached get within noise of BENCH_r05.
     decode_guard = bench_decode_guard(get_gbps)
@@ -1173,6 +1260,26 @@ def main() -> int:
         )
     except Exception as exc:
         print(f"wire stream/fanin rows skipped: {exc}", file=sys.stderr)
+    # Sharded-checkpoint row (ISSUE 17): pod-shape save/restore through the
+    # placement plane. CPU-pinned child with 8 forced host devices — the
+    # same sharding shape the pod drill proves, sized for one box; the
+    # real-chip variant runs above, gated on the TPU probe.
+    ckpt_row: dict[str, Any] | None = None
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        child = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()), "--ckpt-only"],
+            capture_output=True, text=True, timeout=600, cwd=REPO_ROOT, env=env,
+        )
+        sys.stderr.write(child.stderr)
+        if child.returncode == 0:
+            ckpt_row = json.loads(child.stdout.strip().splitlines()[-1])
+        else:
+            print(f"sharded checkpoint row skipped: child exited "
+                  f"{child.returncode}: {child.stderr[-300:]}", file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("sharded checkpoint row skipped: timed out", file=sys.stderr)
     summary: dict[str, Any] = {
         "metric": "get_gbps_1mib_striped4_tcp",
         "value": round(get_gbps, 3),
@@ -1293,6 +1400,15 @@ def main() -> int:
         summary["zc_completions_sent"] = zc["zerocopy_sent"]
         summary["zc_completions_copied"] = zc["zerocopy_copied"]
         summary["bench_cpus"] = st["bench_cpus"]
+    if ckpt_row is not None:
+        summary["ckpt_save_gbps"] = round(ckpt_row["save_gbps"], 3)
+        summary["ckpt_restore_gbps"] = round(ckpt_row["restore_gbps"], 3)
+        summary["ckpt_cross_host_fraction"] = round(
+            ckpt_row["cross_host_fraction"], 4)
+    if ckpt_hbm_row is not None:
+        summary["ckpt_hbm_save_gbps"] = round(ckpt_hbm_row["save_gbps"], 3)
+        summary["ckpt_hbm_restore_gbps"] = round(
+            ckpt_hbm_row["restore_gbps"], 3)
     print(json.dumps(summary))
     return 0
 

@@ -78,6 +78,8 @@ SIGNATURES: Final[dict[str, tuple[str, tuple[str, ...]]]] = {
                             "i64", "i32")),
     "btpu_put_ex2": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32",
                              "i64", "i32", "i32")),
+    "btpu_put_ex3": ("i32", ("ptr", "cstr", "ptr", "u64", "u32", "u32", "u32",
+                             "i64", "i32", "i32", "i32")),
     "btpu_get": ("i32", ("ptr", "cstr", "ptr", "u64", "u64*")),
     "btpu_put_many": ("i32", ("ptr", "u32", "cstr*", "ptr*", "u64*", "u32",
                               "u32", "u32", "i32*")),
@@ -166,6 +168,8 @@ SIGNATURES: Final[dict[str, tuple[str, tuple[str, ...]]]] = {
                              "i64", "i32", "i32")),
     # -- introspection -------------------------------------------------------
     "btpu_list_json": ("i32", ("ptr", "cstr", "u64", "cstr", "u64", "u64*")),
+    "btpu_pools_json": ("i32", ("ptr", "cstr", "u64", "u64*")),
+    "btpu_crc32c": ("u32", ("ptr", "u64", "u32")),
     "btpu_exists": ("i32", ("ptr", "cstr", "i32*")),
     "btpu_remove": ("i32", ("ptr", "cstr")),
     "btpu_stats": ("i32", ("ptr", "u64*")),

@@ -1,9 +1,34 @@
-"""Checkpoint sharded JAX arrays through the object store.
+"""Distributed, resumable checkpoint/restore for sharded JAX arrays.
 
-Each device shard of a `jax.Array` is saved as its own object (so saves
+Each device shard of a `jax.Array` is saved as its own object (saves
 parallelize over the striped native data path and, multi-host, every host
-writes only the shards it owns), plus one small JSON metadata object with
-the global shape, dtype, and each shard's index box.
+writes only the shards it owns), under a MANIFEST-COMMITTED-LAST layout:
+
+    <prefix>/attempt/<save_id>    claim marker, written FIRST (atomic: the
+                                  store's put_start rejects existing keys,
+                                  so concurrent savers get disjoint ids)
+    <prefix>/data/<save_id>/<box> one object per distinct shard box
+    <prefix>/manifest/<save_id>   global shape + dtype + shard keys,
+                                  written LAST by exactly one process
+
+A checkpoint exists if and only if its manifest does. Readers resolve the
+HIGHEST committed manifest, so concurrent savers serialize by id: the last
+committed manifest wins atomically, and a crashed or in-flight save — any
+number of data shards without a manifest — is invisible to
+`list_checkpoints`/`load_sharded` (the same committed-reads-only contract
+the store applies to PENDING objects).
+
+Resumability: a restarted save claims a FRESH id, but reuses committed
+shard objects from the newest unfinished attempt with the same layout when
+the bytes still match — proven by comparing the store's recorded content
+crc32c (placements) against the local shard bytes via the native crc — and
+references those keys directly in the new manifest. Only fully-written,
+bit-verified shards are skipped; everything else is rewritten.
+
+Placement: shard writes carry (slice, host) affinity hints from the
+mesh-aware placement plane (`blackbird_tpu.placement.PodPlacement`), so
+each shard's bytes land on the shard's own host's worker — zero cross-host
+data movement when the save sharding matches the pod layout.
 
 Restore is sharding-polymorphic: `load_sharded` rebuilds the array under
 ANY target sharding — same mesh, fewer/more devices, or a different layout
@@ -15,13 +40,14 @@ Role: the device-tier half of SURVEY §5 checkpoint/resume. The native
 keystone already persists object *metadata* durably; this persists device
 *bytes* — e.g. model weights sharded over a v5e slice checkpointed into
 the DRAM/NVMe tiers and restored after a preemption onto a different
-topology.
+topology. Operational runbook: docs/OPERATIONS.md §checkpointing.
 """
 
 from __future__ import annotations
 
 import json
-from typing import TYPE_CHECKING, Any, Callable, Sequence
+import time
+from typing import TYPE_CHECKING, Any, Sequence
 
 import numpy as np
 import numpy.typing as npt
@@ -29,9 +55,15 @@ import numpy.typing as npt
 if TYPE_CHECKING:
     from blackbird_tpu.client import Client
     from blackbird_tpu.fabric import FabricClient
+    from blackbird_tpu.placement import PodPlacement
 
-_META_SUFFIX = "/meta"
-_SHARD_SUFFIX = "/shard/"
+_MANIFEST_DIR = "/manifest/"
+_DATA_DIR = "/data/"
+_ATTEMPT_DIR = "/attempt/"
+# Pre-manifest layout (single meta object, read-modify-write overwrite):
+# still readable, reclaimed by the first committed save over the prefix.
+_LEGACY_META_SUFFIX = "/meta"
+_LEGACY_SHARD_SUFFIX = "/shard/"
 
 
 def _index_to_boxes(index: Sequence[slice]) -> list[list[int]]:
@@ -55,31 +87,39 @@ def _box_name(boxes: list[list[int]]) -> str:
     return "x".join(f"{a}-{b}" for a, b in boxes) if boxes else "scalar"
 
 
-def _overwrite(client: Client, key: str, do_put: Callable[[], None]) -> None:
-    """Runs `do_put` with overwrite semantics: on OBJECT_ALREADY_EXISTS,
-    remove + retry once.
-
-    The store's put_start rejects existing keys (keystone.cpp put lifecycle);
-    a checkpoint save must win over whatever a crashed/partial previous save
-    left behind, including shards no longer listed in any readable meta.
-    """
-    try:
-        do_put()
-        return
-    except Exception as exc:  # noqa: BLE001 - duck-typed client
-        from blackbird_tpu.native import ErrorCode
-
-        if getattr(exc, "code", None) != int(ErrorCode.OBJECT_ALREADY_EXISTS):
-            raise
-    try:
-        client.remove(key)
-    except Exception:  # noqa: BLE001 - lost race / already gone
-        pass
-    do_put()
+def _save_id_str(save_id: int) -> str:
+    # Zero-padded so lexicographic listing order == numeric order; parsing
+    # stays numeric everywhere regardless.
+    return f"{save_id:08d}"
 
 
-def _put_fresh(client: Client, key: str, data: Any, **kwargs: Any) -> None:
-    _overwrite(client, key, lambda: client.put(key, data, **kwargs))
+def _ids_under(client: Client, prefix: str) -> list[int]:
+    """Numeric save ids present under `<prefix>` (a /manifest/ or /attempt/
+    directory prefix), ascending. Only COMMITTED objects are listed, which
+    is exactly the visibility the id scheme wants."""
+    ids = []
+    for obj in client.list(prefix):
+        tail = obj["key"][len(prefix):]
+        if tail.isdigit():
+            ids.append(int(tail))
+    return sorted(ids)
+
+
+def committed_save_id(client: Client, prefix: str) -> int | None:
+    """Highest committed manifest id under `prefix` (None: no checkpoint).
+    THE commit point: a save is visible exactly when its manifest is."""
+    ids = _ids_under(client, prefix + _MANIFEST_DIR)
+    return ids[-1] if ids else None
+
+
+def read_manifest(client: Client, prefix: str) -> dict[str, Any]:
+    """The committed manifest readers resolve: highest id wins. Falls back
+    to the legacy single-meta layout for pre-manifest checkpoints."""
+    sid = committed_save_id(client, prefix)
+    if sid is not None:
+        return dict(json.loads(bytes(client.get(
+            prefix + _MANIFEST_DIR + _save_id_str(sid)))))
+    return dict(json.loads(bytes(client.get(prefix + _LEGACY_META_SUFFIX))))
 
 
 def _is_device_class(preferred_class: Any) -> bool:
@@ -88,45 +128,199 @@ def _is_device_class(preferred_class: Any) -> bool:
     return name == "hbm_tpu"
 
 
-def _fabric_put_fresh(client: Client, fabric: FabricClient, key: str,
-                      shard_data: Any, kwargs: dict[str, Any]) -> bool:
+def _class_name(preferred_class: Any) -> str:
+    return (preferred_class.name.lower() if hasattr(preferred_class, "name")
+            else str(preferred_class or ""))
+
+
+def _already_exists(exc: Exception) -> bool:
+    from blackbird_tpu.native import ErrorCode
+
+    return getattr(exc, "code", None) == int(ErrorCode.OBJECT_ALREADY_EXISTS)
+
+
+def _shard_plan(array: Any) -> tuple[list[dict[str, Any]], dict[str, Any], Any]:
+    """Global layout from the sharding, identical on every host: per-box
+    meta entries (name/boxes/shape, sorted by name so every process agrees
+    on box ordinals), box -> owner device (lowest device id among the
+    replicas of that box), and the meta/commit owner (lowest device id in
+    the sharding). One writer per object, by construction."""
+    index_map = array.sharding.devices_indices_map(array.shape)
+    entries: dict[str, dict[str, Any]] = {}
+    box_owner: dict[str, Any] = {}
+    for device, index in index_map.items():
+        boxes = _index_to_boxes(index)
+        name = _box_name(boxes)
+        if name not in entries:
+            shape = [
+                (b if b >= 0 else dim) - a for (a, b), dim in zip(boxes, array.shape)
+            ]
+            entries[name] = {"name": name, "boxes": boxes, "shape": shape}
+        if name not in box_owner or device.id < box_owner[name].id:
+            box_owner[name] = device
+    plan = [entries[name] for name in sorted(entries)]
+    return plan, box_owner, min(index_map, key=lambda d: d.id)
+
+
+def _layout_fingerprint(array: Any, plan: list[dict[str, Any]],
+                        ec: tuple[int, int] | None,
+                        preferred_class: Any) -> str:
+    """Identity of a save's layout: shard reuse across attempts is only
+    safe between saves that would write byte-identical objects to the same
+    box names with the same durability shape."""
+    return json.dumps({
+        "global_shape": list(array.shape),
+        "dtype": np.dtype(array.dtype).str,
+        "boxes": [s["name"] for s in plan],
+        "ec": list(ec) if ec else None,
+        "class": _class_name(preferred_class),
+    }, sort_keys=True)
+
+
+def _claim_attempt(client: Client, prefix: str, fingerprint: str) -> int:
+    """Claims a fresh save id by atomically creating its attempt marker.
+
+    put_start rejects existing keys, so two concurrent savers computing the
+    same next id race on the marker put and the loser moves to id+1:
+    attempts are disjoint WITHOUT any read-modify-write (this is the
+    versioned-put fix for the old single-meta overwrite race — concurrent
+    savers never touch each other's objects, and readers take the highest
+    committed manifest)."""
+    used = set(_ids_under(client, prefix + _MANIFEST_DIR))
+    used.update(_ids_under(client, prefix + _ATTEMPT_DIR))
+    sid = (max(used) + 1) if used else 1
+    claim = json.dumps({"layout": fingerprint}).encode()
+    while True:
+        try:
+            client.put(prefix + _ATTEMPT_DIR + _save_id_str(sid), claim,
+                       replicas=1)
+            return sid
+        except Exception as exc:  # noqa: BLE001 - duck-typed client
+            if not _already_exists(exc):
+                raise
+            sid += 1  # lost the race to a concurrent saver
+
+
+def _resume_candidate(client: Client, prefix: str, my_sid: int,
+                      fingerprint: str) -> int | None:
+    """Newest UNFINISHED attempt whose layout matches ours: its committed
+    shard objects are reuse candidates. Committed attempts are excluded
+    (their data is a complete checkpoint, not a partial to salvage), as is
+    anything at or above our own id (concurrent savers, not predecessors)."""
+    committed = committed_save_id(client, prefix) or 0
+    for sid in reversed(_ids_under(client, prefix + _ATTEMPT_DIR)):
+        if sid >= my_sid or sid <= committed:
+            continue
+        try:
+            claim = json.loads(bytes(client.get(
+                prefix + _ATTEMPT_DIR + _save_id_str(sid))))
+        except Exception:  # noqa: BLE001 - marker gone mid-scan
+            continue
+        if claim.get("layout") == fingerprint:
+            return sid
+    return None
+
+
+def _stored_crc(client: Client, key: str) -> int | None:
+    """content crc32c of a COMMITTED object (None: missing, pending, or
+    stored without a crc — e.g. striped multi-worker copies on an old
+    build). Placements of a PENDING object fail, which is exactly the
+    partial-write filter the resume path needs."""
+    try:
+        copies = client.placements(key)
+    except Exception:  # noqa: BLE001 - not found / pending
+        return None
+    for copy in copies:
+        crc = copy.get("crc")
+        if crc:
+            return int(crc)
+    return None
+
+
+def _local_crc(data: npt.NDArray[Any]) -> int | None:
+    """crc32c of the shard bytes via the native export (None: library too
+    old — resume then rewrites instead of reusing, which is always safe)."""
+    from blackbird_tpu import native
+    from blackbird_tpu.native import lib
+
+    if not native.have("btpu_crc32c"):
+        return None
+    import ctypes
+
+    return int(lib.btpu_crc32c(
+        data.ctypes.data_as(ctypes.c_void_p), data.nbytes, 0))
+
+
+def _fabric_put(client: Client, fabric: FabricClient, key: str,
+                shard_data: Any, kwargs: dict[str, Any]) -> bool:
     """Fabric leg of the checkpoint writer: True when the shard landed over
-    the fabric (with the same overwrite semantics as _put_fresh), False =
-    use the staged byte path."""
+    the transfer fabric, False = use the staged byte path."""
     from blackbird_tpu.fabric import FabricUnavailable
 
     pc = kwargs.get("preferred_class")
     name = pc.name.lower() if hasattr(pc, "name") else (pc or "hbm_tpu")
-    fabric_kwargs: dict[str, Any] = {"replicas": kwargs.get("replicas", 1),
-                                     "preferred_class": name}
     try:
-        _overwrite(client, key, lambda: fabric.put(key, shard_data, **fabric_kwargs))
+        fabric.put(key, shard_data, replicas=kwargs.get("replicas", 1),
+                   preferred_class=name)
         return True
     except FabricUnavailable:
         return False
 
 
+def _sync_reuse_bits(reuse: npt.NDArray[np.int32], multi_process: bool) -> \
+        npt.NDArray[np.int32]:
+    """Agrees the per-box reuse decisions across the pod: each box owner
+    knows only its OWN boxes' bits; the manifest writer needs all of them.
+    Rides the jax.distributed runtime (max-reduce over the gathered bits) —
+    also a barrier, so when it returns every process's synchronous shard
+    puts have committed and the manifest can be written immediately."""
+    if not multi_process:
+        return reuse
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(reuse)
+    return np.asarray(gathered).reshape(-1, reuse.size).max(axis=0)
+
+
 def save_sharded(client: Client, prefix: str, array: Any, *, replicas: int = 1,
                  preferred_class: Any = None, ec: tuple[int, int] | None = None,
-                 fabric: FabricClient | None = None) -> None:
-    """Saves `array` (sharded or single-device) under `prefix`.
+                 fabric: FabricClient | None = None,
+                 placement: PodPlacement | None = None) -> int:
+    """Saves `array` (sharded or single-device) under `prefix`; returns the
+    committed save id.
+
+    Layout and crash semantics are described at module level: claim marker
+    first, one object per distinct shard box (replicated shards are
+    deduplicated), manifest last. Every object has exactly ONE writer —
+    each box is written by the process owning the lowest device id
+    replicating it, the claim/manifest by the process owning the lowest
+    device id overall; other processes never touch those keys, so no host
+    trips on another's put. A save interrupted anywhere before the manifest
+    put leaves nothing visible; rerunning it claims a fresh id and reuses
+    the interrupted attempt's bit-verified shards (crc-compared against the
+    local bytes) instead of rewriting them.
 
     With `fabric` (a `blackbird_tpu.FabricClient`), device-resident shard
     bytes move over the transfer fabric — this process offers each shard
     from its own runtime and the worker pulls it straight into device
     memory, no host staging (the production TPU checkpoint shape). Shards
-    the fabric cannot take (no fabric endpoints, EC requested) fall back
-    to the staged byte path transparently.
+    the fabric cannot take (no fabric endpoints, EC requested) fall back to
+    the staged byte path transparently.
 
-    Writes one object per *distinct* shard box (replicated shards are
-    deduplicated) and a `<prefix>/meta` JSON object describing them. The
-    layout is multi-host safe by construction: shard keys are derived from
-    the shard's index box (not a per-process counter), and every object has
-    exactly ONE writer — each shard box is written by the process owning
-    the lowest device id replicating that box, and the meta object (plus
-    stale-shard cleanup) by the process owning the lowest device id in the
-    sharding. Other hosts skip those keys entirely, so no host ever trips
-    on another's put.
+    With `placement` (default: discovered from the client's pool registry),
+    each shard put carries the owning device's (slice, host) affinity hint,
+    and the placement scoreboard records how many bytes stayed host-local.
+
+    `ec=(k, m)` erasure-codes each shard object (any m worker losses at
+    (k+m)/k overhead); the manifest and claim are stored at ec=(1, m) — the
+    same loss tolerance for the metadata as for the data, via m+1
+    single-shard copies on distinct workers. EC placements are anti-affine
+    by design, so host-affinity hints are skipped.
+
+    Per-shard save durations and placed workers land in the manifest
+    (`shards[*].duration_ms` / `workers`): the slow-shard triage hooks —
+    every shard put is its own traced op, so `bb-trace` around a slow
+    shard's window shows where its bytes stalled (docs/OPERATIONS.md).
     """
     import jax  # local: keep module import-light for non-JAX users
 
@@ -135,95 +329,171 @@ def save_sharded(client: Client, prefix: str, array: Any, *, replicas: int = 1,
     kwargs: dict[str, Any] = {"replicas": replicas}
     if ec is not None:
         # Checkpoints are the natural erasure-coding consumer: large, cold,
-        # durability-critical. ec=(k, m) stores each shard object as one
-        # RS-coded copy — any m worker losses tolerated at (k+m)/k storage
-        # (replicas is ignored by the store when ec is set). The tiny meta
-        # object stays replicated: coding a few hundred bytes k-ways wastes
-        # placement slots for no durability gain.
+        # durability-critical. replicas is ignored by the store when ec is
+        # set.
+        k, m = ec
+        if k < 1 or m < 1:
+            raise ValueError(f"ec needs k >= 1 and m >= 1, got {ec}")
         kwargs["ec"] = ec
     if preferred_class is not None:
         kwargs["preferred_class"] = preferred_class
     my_process = jax.process_index()
+    multi_process = jax.process_count() > 1
 
-    # Global layout from the sharding, identical on every host; the owner
-    # of each box (lowest device id among its replicas) is its sole writer.
-    index_map = array.sharding.devices_indices_map(array.shape)
-    shards_meta: list[dict[str, Any]] = []
-    box_owner: dict[str, Any] = {}
-    for device, index in index_map.items():
-        boxes = _index_to_boxes(index)
-        name = _box_name(boxes)
-        if name not in box_owner:
-            shape = [
-                (b if b >= 0 else dim) - a for (a, b), dim in zip(boxes, array.shape)
-            ]
-            shards_meta.append(
-                {"key": f"{prefix}{_SHARD_SUFFIX}{name}", "boxes": boxes, "shape": shape}
-            )
-        if name not in box_owner or device.id < box_owner[name].id:
-            box_owner[name] = device
-    meta_owner = min(index_map, key=lambda d: d.id)
+    plan, box_owner, meta_owner = _shard_plan(array)
+    fingerprint = _layout_fingerprint(array, plan, ec, preferred_class)
+    i_commit = meta_owner.process_index == my_process
 
-    # Stale shards from a previous save under this prefix must go, or a
-    # re-save with fewer/different boxes would leak the rest forever.
-    old_keys: set[str] = set()
-    try:
-        old_meta = json.loads(bytes(client.get(prefix + _META_SUFFIX)))
-        old_keys = {s["key"] for s in old_meta.get("shards", [])}
-    except Exception:  # noqa: BLE001 - no previous checkpoint
-        pass
+    if placement is None and ec is None:
+        from blackbird_tpu.placement import PodPlacement
 
+        try:
+            placement = PodPlacement(client)
+        except Exception:  # noqa: BLE001 - registry listing unavailable
+            placement = None
+
+    # Claim the save id on the commit owner; the other processes learn it
+    # through the distributed runtime (one tiny broadcast), never by
+    # guessing from store listings that concurrent savers may be mutating.
+    if i_commit:
+        sid = _claim_attempt(client, prefix, fingerprint)
+    else:
+        sid = 0
+    if multi_process:
+        from jax.experimental import multihost_utils
+
+        sid = int(multihost_utils.broadcast_one_to_all(
+            np.int32(sid), is_source=i_commit))
+    data_dir = f"{prefix}{_DATA_DIR}{_save_id_str(sid)}/"
+
+    # Resume: the newest unfinished attempt with OUR layout donates its
+    # bit-verified shards. The candidate is resolved once, under the fresh
+    # claim, so every process sees the same predecessor.
+    prior = _resume_candidate(client, prefix, sid, fingerprint)
+    prior_dir = (f"{prefix}{_DATA_DIR}{_save_id_str(prior)}/"
+                 if prior is not None else None)
+
+    box_index = {s["name"]: i for i, s in enumerate(plan)}
+    reuse = np.zeros(len(plan), dtype=np.int32)
+    durations: dict[str, int] = {}
     for shard in array.addressable_shards:
         name = _box_name(_index_to_boxes(shard.index))
         if shard.device != box_owner[name]:
             continue  # another device/host owns this box
-        key = f"{prefix}{_SHARD_SUFFIX}{name}"
-        if key in old_keys:  # re-save over an existing object
-            try:
-                client.remove(key)
-            except Exception:  # noqa: BLE001 - listed but never written/evicted
-                pass
-        # Fabric attempt only for device-tier targets: a host-tier
-        # placement can never carry fabric endpoints, and probing it would
-        # cost a reserve+cancel keystone round trip per shard.
-        if fabric is not None and ec is None and _is_device_class(preferred_class):
-            if _fabric_put_fresh(client, fabric, key, shard.data, kwargs):
-                continue
         host = np.ascontiguousarray(np.asarray(shard.data))
-        _put_fresh(client, key, host.reshape(-1).view(np.uint8), **kwargs)
+        flat = host.reshape(-1).view(np.uint8)
+        if prior_dir is not None:
+            stored = _stored_crc(client, prior_dir + name)
+            if stored is not None and stored == _local_crc(flat):
+                reuse[box_index[name]] = 1  # verified: reference, don't move
+                continue
+        key = data_dir + name
+        started = time.monotonic()
+        # Fabric attempt only for device-tier targets: a host-tier placement
+        # can never carry fabric endpoints, and probing it would cost a
+        # reserve+cancel keystone round trip per shard.
+        if not (fabric is not None and ec is None
+                and _is_device_class(preferred_class)
+                and _fabric_put(client, fabric, key, shard.data, kwargs)):
+            # No affinity hint for EC: coded shards are anti-affine by design.
+            hint = (placement.hint_for(shard.device)
+                    if placement is not None and ec is None else {})
+            if "preferred_host" in hint:
+                # Host-affine shards pin to ONE worker: striping the object
+                # across workers would reintroduce cross-host bytes.
+                hint["max_workers"] = 1
+            client.put(key, flat, **kwargs, **hint)
+        durations[name] = int((time.monotonic() - started) * 1000)
+        if placement is not None:
+            from blackbird_tpu.placement import device_coord
 
-    if meta_owner.process_index != my_process:
-        return
-    meta: dict[str, Any] = {
+            placement.record(key, device_coord(shard.device))
+
+    # Barrier + decision exchange: after this, every process's shard puts
+    # have committed and everyone knows which boxes were reused.
+    reuse = _sync_reuse_bits(reuse, multi_process)
+    if not i_commit:
+        return sid
+
+    shards_meta: list[dict[str, Any]] = []
+    for i, s in enumerate(plan):
+        key = (prior_dir if reuse[i] else data_dir) + s["name"]
+        entry: dict[str, Any] = {"key": key, "boxes": s["boxes"],
+                                 "shape": s["shape"]}
+        if reuse[i]:
+            entry["reused"] = True
+        elif s["name"] in durations:
+            entry["duration_ms"] = durations[s["name"]]
+        try:  # slow-shard triage: where each shard's bytes actually live
+            entry["workers"] = sorted(
+                {sh["worker"] for copy in client.placements(key)
+                 for sh in copy["shards"]})
+        except Exception:  # noqa: BLE001 - placement listing is advisory
+            pass
+        shards_meta.append(entry)
+
+    manifest = {
+        "save_id": sid,
         "global_shape": list(array.shape),
         "dtype": np.dtype(array.dtype).str,
         "shards": shards_meta,
     }
-    if old_keys:
-        try:
-            client.remove(prefix + _META_SUFFIX)
-        except Exception:  # noqa: BLE001
-            pass
     meta_kwargs = {k: v for k, v in kwargs.items() if k != "ec"}
     if ec is not None:
-        # The meta must survive what the coded shards survive (m losses).
-        # ec=(1, m) degenerates to m+1 single-shard copies (scalar multiples
-        # of the data; any ONE reconstructs it) on distinct workers — unlike
-        # `replicas`, not clamped by the keystone's max_replicas, so the
-        # tolerance actually matches.
+        # The manifest must survive what the coded shards survive (m
+        # losses). ec=(1, m) degenerates to m+1 single-shard copies (any ONE
+        # reconstructs it) on distinct workers — unlike `replicas`, not
+        # clamped by the keystone's max_replicas, so the tolerance matches.
         meta_kwargs["ec"] = (1, ec[1])
-    _put_fresh(client, prefix + _META_SUFFIX, json.dumps(meta).encode(), **meta_kwargs)
-    # Drop old shard objects the new layout no longer references.
-    for stale in old_keys - {s["key"] for s in shards_meta}:
+    # THE commit: everything before this line is invisible to readers.
+    client.put(prefix + _MANIFEST_DIR + _save_id_str(sid),
+               json.dumps(manifest).encode(), **meta_kwargs)
+    _reclaim_superseded(client, prefix, sid,
+                        keep={s["key"] for s in shards_meta})
+    return sid
+
+
+def _reclaim_superseded(client: Client, prefix: str, sid: int,
+                        keep: set[str]) -> None:
+    """Post-commit garbage collection: manifests, attempt markers, and data
+    of every save id below the just-committed one — except objects the new
+    manifest references (resumed shards live in their original attempt's
+    data directory) — plus any legacy single-meta layout under the prefix.
+    Strictly `< sid`: a concurrent saver that claimed a higher id is mid-
+    flight, not garbage. All best-effort: a failed removal leaks bytes the
+    next committed save reclaims, never correctness."""
+    doomed: set[str] = set()
+    for old in _ids_under(client, prefix + _MANIFEST_DIR):
+        if old < sid:
+            doomed.add(prefix + _MANIFEST_DIR + _save_id_str(old))
+    for old in _ids_under(client, prefix + _ATTEMPT_DIR):
+        if old <= sid:
+            doomed.add(prefix + _ATTEMPT_DIR + _save_id_str(old))
+    for obj in client.list(prefix + _DATA_DIR):
+        tail = obj["key"][len(prefix + _DATA_DIR):]
+        sid_part = tail.split("/", 1)[0]
+        if sid_part.isdigit() and int(sid_part) < sid:
+            doomed.add(obj["key"])
+    if client.exists(prefix + _LEGACY_META_SUFFIX):
         try:
-            client.remove(stale)
-        except Exception:  # noqa: BLE001
+            legacy = json.loads(bytes(client.get(prefix + _LEGACY_META_SUFFIX)))
+            doomed.update(s["key"] for s in legacy.get("shards", []))
+        except Exception:  # noqa: BLE001 - unreadable legacy meta
+            pass
+        doomed.add(prefix + _LEGACY_META_SUFFIX)
+    doomed.update(obj["key"]
+                  for obj in client.list(prefix + _LEGACY_SHARD_SUFFIX))
+    for key in doomed - keep:
+        try:
+            client.remove(key)
+        except Exception:  # noqa: BLE001 - lost race / already gone
             pass
 
 
 def load_sharded(client: Client, prefix: str, *, sharding: Any = None,
-                 fabric: FabricClient | None = None) -> Any:
-    """Restores an array saved by `save_sharded`.
+                 fabric: FabricClient | None = None,
+                 placement: PodPlacement | None = None) -> Any:
+    """Restores the checkpoint committed under `prefix` (highest manifest).
 
     With `sharding` (any `jax.sharding.Sharding`), returns a `jax.Array`
     laid out accordingly — the target does not need to match the sharding
@@ -233,10 +503,24 @@ def load_sharded(client: Client, prefix: str, *, sharding: Any = None,
     pulled over the transfer fabric by THIS process's runtime instead of
     the worker's staged host lane; host-tier shards fall back to the
     staged path transparently.
+
+    With `placement`, every fetched shard is scored against this process's
+    pod coordinate on the placement scoreboard — restoring under the save
+    sharding reads purely host-locally.
     """
-    meta = json.loads(bytes(client.get(prefix + _META_SUFFIX)))
+    meta = read_manifest(client, prefix)
     global_shape = tuple(meta["global_shape"])
     dtype = np.dtype(meta["dtype"])
+
+    my_coord: tuple[int, int] | None = None
+    if placement is not None:
+        import jax
+
+        local = jax.local_devices()
+        if local:
+            from blackbird_tpu.placement import device_coord
+
+            my_coord = device_coord(local[0])
 
     # Source shards fetched lazily, at most once each.
     cache: dict[str, npt.NDArray[Any]] = {}
@@ -249,6 +533,8 @@ def load_sharded(client: Client, prefix: str, *, sharding: Any = None,
             else:
                 raw = np.frombuffer(bytes(client.get(key)), dtype=np.uint8)
             cache[key] = raw.view(dtype).reshape(shard_meta["shape"])
+            if placement is not None:
+                placement.record(key, my_coord)
         return cache[key]
 
     def read_slice(index: tuple[slice, ...]) -> npt.NDArray[Any]:
@@ -292,7 +578,10 @@ def load_sharded(client: Client, prefix: str, *, sharding: Any = None,
 
 
 def list_checkpoints(client: Client, root: str = "") -> list[str]:
-    """Checkpoint prefixes under `root` (keys holding a readable meta).
+    """COMMITTED checkpoint prefixes under `root`: prefixes holding at
+    least one manifest (or a legacy single-meta object). Claimed attempts
+    and data shards without a manifest — in-flight or interrupted saves —
+    are not checkpoints and never appear here.
 
     Discovery for resume-after-preemption: a restarting trainer lists
     `ckpt/` and picks its checkpoint without tracking keys externally
@@ -300,33 +589,50 @@ def list_checkpoints(client: Client, root: str = "") -> list[str]:
     the LATEST step, parse the step number — lexicographic max() breaks
     across digit-count boundaries ("step999" > "step1000") unless step
     names are zero-padded."""
-    suffix = _META_SUFFIX
-    return [
-        obj["key"][: -len(suffix)]
-        for obj in client.list(root)
-        if obj["key"].endswith(suffix)
-    ]
+    found: set[str] = set()
+    for obj in client.list(root):
+        key = obj["key"]
+        if _MANIFEST_DIR in key:
+            head, tail = key.rsplit(_MANIFEST_DIR, 1)
+            if tail.isdigit():
+                found.add(head)
+        elif key.endswith(_LEGACY_META_SUFFIX):
+            found.add(key[: -len(_LEGACY_META_SUFFIX)])
+    return sorted(found)
 
 
 def remove_checkpoint(client: Client, prefix: str) -> None:
-    """Deletes the metadata and every shard object of a checkpoint.
+    """Deletes every object of a checkpoint: manifests, attempt markers,
+    data shards, and any legacy layout under the prefix.
 
-    The meta goes FIRST: a removal interrupted halfway must not leave a
+    The manifests go FIRST: a removal interrupted halfway must not leave a
     discoverable-but-unloadable checkpoint for `list_checkpoints` resume.
-    The shard sweep then unions the prefix listing (orphans from
-    interrupted saves, never listed in any meta) with the meta's own shard
-    list (shards stranded mid-put are PENDING and invisible to listing)."""
+    The data sweep then unions the prefix listing (orphans from interrupted
+    saves) with every manifest's own shard list (shards stranded mid-put
+    are PENDING and invisible to listing)."""
     shard_keys: set[str] = set()
+    for sid in _ids_under(client, prefix + _MANIFEST_DIR):
+        mkey = prefix + _MANIFEST_DIR + _save_id_str(sid)
+        try:
+            manifest = json.loads(bytes(client.get(mkey)))
+            shard_keys.update(s["key"] for s in manifest.get("shards", []))
+        except Exception:  # noqa: BLE001 - racing removal
+            pass
+        try:
+            client.remove(mkey)
+        except Exception:  # noqa: BLE001 - already gone
+            pass
     try:
-        meta = json.loads(bytes(client.get(prefix + _META_SUFFIX)))
-        shard_keys.update(s["key"] for s in meta.get("shards", []))
-    except Exception:  # noqa: BLE001 - missing/unreadable meta (partial save)
+        legacy = json.loads(bytes(client.get(prefix + _LEGACY_META_SUFFIX)))
+        shard_keys.update(s["key"] for s in legacy.get("shards", []))
+    except Exception:  # noqa: BLE001 - no legacy meta (the common case)
         pass
     try:
-        client.remove(prefix + _META_SUFFIX)
+        client.remove(prefix + _LEGACY_META_SUFFIX)
     except Exception:  # noqa: BLE001 - already gone
         pass
-    shard_keys.update(obj["key"] for obj in client.list(prefix + _SHARD_SUFFIX))
+    for directory in (_ATTEMPT_DIR, _DATA_DIR, _LEGACY_SHARD_SUFFIX):
+        shard_keys.update(obj["key"] for obj in client.list(prefix + directory))
     for key in shard_keys:
         try:
             client.remove(key)

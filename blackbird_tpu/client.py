@@ -188,6 +188,7 @@ class Client:
         soft_pin: bool = False,
         ec: tuple[int, int] | None = None,
         preferred_slice: int | None = None,
+        preferred_host: int | None = None,
     ) -> None:
         """ttl_ms: None = the framework default (30 min), 0 = never
         expires, >0 = the GC collects the object that long after CREATION
@@ -198,9 +199,21 @@ class Client:
         tolerated at (k+m)/k storage overhead (e.g. ec=(4, 2) survives two
         losses at 1.5x, where replicas=3 costs 3x). preferred_slice ranks
         pools on that TPU slice first so placements ride ICI and spill to
-        other slices (the DCN path) only when the slice is full."""
+        other slices (the DCN path) only when the slice is full.
+        preferred_host (requires preferred_slice: host ids are per-slice
+        coordinates) ranks that host's pools above the rest of the slice,
+        so a sharded writer can pin each shard's bytes to the worker on the
+        shard's own host — the placement plane's zero-cross-host lane. Host
+        affinity is incompatible with ec: coded shards are deliberately
+        spread across workers for loss independence."""
         if ttl_ms is not None and ttl_ms < 0:
             raise ValueError(f"ttl_ms must be >= 0, got {ttl_ms}")
+        if preferred_host is not None and preferred_slice is None:
+            raise ValueError("preferred_host requires preferred_slice "
+                             "(host ids are per-slice coordinates)")
+        if preferred_host is not None and ec is not None:
+            raise ValueError("preferred_host is incompatible with ec "
+                             "(coded shards are placed anti-affine)")
         if isinstance(data, np.ndarray):
             data = np.ascontiguousarray(data)
             buf = data.ctypes.data_as(ctypes.c_void_p)
@@ -230,7 +243,7 @@ class Client:
             )
             return
         check(
-            lib.btpu_put_ex2(
+            lib.btpu_put_ex3(
                 self._handle,
                 key.encode(),
                 buf,
@@ -241,6 +254,7 @@ class Client:
                 -1 if ttl_ms is None else ttl_ms,
                 1 if soft_pin else 0,
                 -1 if preferred_slice is None else preferred_slice,
+                -1 if preferred_host is None else preferred_host,
             ),
             f"put {key!r}",
         )
@@ -439,6 +453,28 @@ class Client:
                                            cap, ctypes.byref(size)),
                   f"placements {key!r}")
             if size.value <= cap:  # else grew between calls (repair/demotion)
+                return cast("list[dict[str, Any]]",
+                            json.loads(buffer.raw[: size.value].decode()))
+
+    def pools(self) -> list[dict[str, Any]]:
+        """Every registered memory pool with its topology coordinates:
+        [{"pool", "worker", "class", "transport", "slice", "host", "chip",
+        "capacity", "used", "fabric"?}], ordered by pool id. This is the
+        placement plane's topology-discovery read: PodPlacement maps each
+        (slice, host) coordinate to the worker whose pools live there and
+        routes sharded puts host-locally (blackbird_tpu/placement.py)."""
+        import json
+
+        size = ctypes.c_uint64()
+        check(lib.btpu_pools_json(self._handle, None, 0, ctypes.byref(size)),
+              "pools")
+        while True:
+            cap = max(size.value, 2)
+            buffer = ctypes.create_string_buffer(cap)
+            check(lib.btpu_pools_json(self._handle, buffer, cap,
+                                      ctypes.byref(size)),
+                  "pools")
+            if size.value <= cap:  # else grew between calls (worker joined)
                 return cast("list[dict[str, Any]]",
                             json.loads(buffer.raw[: size.value].decode()))
 

@@ -147,6 +147,10 @@ class NativeAPI(Protocol):
     def btpu_put_ex2(self, client: Handle, key: CStr, data: Buf, size: int,
                      replicas: int, max_workers: int, preferred_class: int,
                      ttl_ms: int, soft_pin: int, preferred_slice: int) -> int: ...
+    def btpu_put_ex3(self, client: Handle, key: CStr, data: Buf, size: int,
+                     replicas: int, max_workers: int, preferred_class: int,
+                     ttl_ms: int, soft_pin: int, preferred_slice: int,
+                     preferred_host: int) -> int: ...
     def btpu_get(self, client: Handle, key: CStr, buffer: Buf,
                  buffer_size: int, out_size: U64Out) -> int: ...
     def btpu_put_many(self, client: Handle, n: int, keys: CStrArr, bufs: PtrArr,
@@ -254,6 +258,9 @@ class NativeAPI(Protocol):
     # -- introspection -------------------------------------------------------
     def btpu_list_json(self, client: Handle, prefix: CStr, limit: int,
                        buffer: CStr, buffer_size: int, out_len: U64Out) -> int: ...
+    def btpu_pools_json(self, client: Handle, buffer: CStr, buffer_size: int,
+                        out_len: U64Out) -> int: ...
+    def btpu_crc32c(self, data: Buf, size: int, seed: int) -> int: ...
     def btpu_exists(self, client: Handle, key: CStr, out_exists: I32Out) -> int: ...
     def btpu_remove(self, client: Handle, key: CStr) -> int: ...
     def btpu_stats(self, client: Handle, out: U64Out) -> int: ...

@@ -1,0 +1,280 @@
+"""Mesh-aware placement plane: route sharded-array bytes host-locally.
+
+The native allocator already ranks pools by (host, slice) affinity when a
+put carries `preferred_slice`/`preferred_host` (range_allocator.cpp's
+candidate ranking). This module closes the loop from a `jax.Array`: every
+shard of a NamedSharding lives on a device whose process is one pod host,
+and that host runs exactly one worker advertising its TopoCoord
+(topology.worker_yaml_fields -> worker yaml -> pool registration). Mapping
+shard -> owning device -> (slice, host) -> placement hint makes each
+shard's bytes land on the shard's OWN host's worker: a sharded put moves
+zero cross-host bytes when the write sharding matches the pod layout.
+
+`PodPlacement` discovers the worker topology from the keystone's pool
+registry (`Client.pools()`), turns devices into placement hints, and keeps
+a Python-side scoreboard classifying every placed/fetched shard byte as
+host-local or cross-host by comparing the placement's worker coordinate
+against the shard's intended coordinate. That scoreboard is the
+lane-counter proof used by tests/test_jaxdist_pod.py and bench.py: the
+native lane counters (pvm/stream) cannot distinguish simulated hosts on
+one machine, the worker registry can.
+
+`put_array`/`get_array` are the typed surface: save a `jax.Array` under a
+key (one object per distinct shard box + a meta object written LAST, so
+readers only ever see complete arrays), and rebuild it under ANY sharding
+via `jax.make_array_from_callback` — reads are sharding-polymorphic, with
+each target device fetching only the stored shards it overlaps.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from blackbird_tpu.client import Client
+
+
+def device_coord(device: Any) -> tuple[int, int]:
+    """(slice_id, host_id) of a jax device, the worker-config convention:
+    slice_index (0 off-TPU) names the ICI domain, process_index the host."""
+    return (getattr(device, "slice_index", 0) or 0,
+            getattr(device, "process_index", 0) or 0)
+
+
+class PodPlacement:
+    """Topology-aware placement hints + host-locality scoreboard.
+
+    Built from a connected Client; `refresh()` re-reads the pool registry
+    (workers join/leave on preemption). All byte counters are cumulative
+    until `reset_counters()`.
+    """
+
+    def __init__(self, client: Client) -> None:
+        self._client = client
+        self.worker_coord: dict[str, tuple[int, int]] = {}
+        self.hosts: set[tuple[int, int]] = set()
+        self.slices: set[int] = set()
+        self.host_local_bytes = 0
+        self.cross_host_bytes = 0
+        self.host_local_shards = 0
+        self.cross_host_shards = 0
+        self.refresh()
+
+    def refresh(self) -> None:
+        """Re-derive worker -> (slice, host) from the live pool registry."""
+        worker_coord: dict[str, tuple[int, int]] = {}
+        for pool in self._client.pools():
+            worker_coord[pool["worker"]] = (int(pool["slice"]),
+                                            int(pool["host"]))
+        self.worker_coord = worker_coord
+        self.hosts = set(worker_coord.values())
+        self.slices = {s for s, _ in self.hosts}
+
+    def hint_for(self, device: Any) -> dict[str, int]:
+        """put() kwargs routing bytes toward `device`'s host worker.
+
+        Degrades honestly: full (slice, host) affinity when that exact
+        coordinate has registered pools, slice-only when just the slice
+        does, and no hint at all for a coordinate the registry has never
+        seen (a mesh larger than the store — let free-space ranking run).
+        """
+        slice_id, host_id = device_coord(device)
+        if (slice_id, host_id) in self.hosts:
+            return {"preferred_slice": slice_id, "preferred_host": host_id}
+        if slice_id in self.slices:
+            return {"preferred_slice": slice_id}
+        return {}
+
+    def record(self, key: str, coord: tuple[int, int] | None) -> None:
+        """Scores one placed/fetched object against its intended coordinate:
+        every shard byte whose worker sits at `coord` is host-local, the
+        rest crossed a host boundary (the DCN lane on a real pod). coord
+        None (unknown intent) scores everything cross-host — the honest
+        default for the proof this scoreboard backs."""
+        for copy in self._client.placements(key):
+            for shard in copy["shards"]:
+                length = int(shard.get("length", 0))
+                if coord is not None and \
+                        self.worker_coord.get(shard["worker"]) == coord:
+                    self.host_local_bytes += length
+                    self.host_local_shards += 1
+                else:
+                    self.cross_host_bytes += length
+                    self.cross_host_shards += 1
+
+    def counters(self) -> dict[str, int]:
+        return {
+            "host_local_bytes": self.host_local_bytes,
+            "cross_host_bytes": self.cross_host_bytes,
+            "host_local_shards": self.host_local_shards,
+            "cross_host_shards": self.cross_host_shards,
+        }
+
+    def reset_counters(self) -> None:
+        self.host_local_bytes = self.cross_host_bytes = 0
+        self.host_local_shards = self.cross_host_shards = 0
+
+
+def _shard_plan(array: Any) -> tuple[list[dict[str, Any]], dict[str, Any], Any]:
+    """Global layout from the sharding, identical on every host: per-box
+    meta entries, box -> owning device (lowest device id among replicas),
+    and the meta writer (lowest device id overall)."""
+    from blackbird_tpu.checkpoint import _box_name, _index_to_boxes
+
+    index_map = array.sharding.devices_indices_map(array.shape)
+    shards_meta: list[dict[str, Any]] = []
+    box_owner: dict[str, Any] = {}
+    for device, index in index_map.items():
+        boxes = _index_to_boxes(index)
+        name = _box_name(boxes)
+        if name not in box_owner:
+            shape = [(b if b >= 0 else dim) - a
+                     for (a, b), dim in zip(boxes, array.shape)]
+            shards_meta.append({"name": name, "boxes": boxes, "shape": shape})
+        if name not in box_owner or device.id < box_owner[name].id:
+            box_owner[name] = device
+    return shards_meta, box_owner, min(index_map, key=lambda d: d.id)
+
+
+def put_array(client: Client, key: str, array: Any, *,
+              placement: PodPlacement | None = None, replicas: int = 1,
+              preferred_class: Any = None, ttl_ms: int | None = None) -> None:
+    """Stores a (possibly sharded) jax.Array under `key`, each distinct
+    shard box as its own object routed to the shard's host-local worker.
+
+    Multi-host safe by construction (same ownership rule as the
+    checkpoint writer): each box is written only by the process owning the
+    lowest device id replicating it, and the `<key>/meta` object — written
+    LAST, after every data shard this process owns — only by the process
+    owning the lowest device id overall. Keys must be fresh: this is the
+    typed object surface, not a checkpoint; overwrite semantics (resume,
+    versioning) live in blackbird_tpu.checkpoint.
+    """
+    import jax
+
+    from blackbird_tpu.checkpoint import _box_name, _index_to_boxes
+
+    if not isinstance(array, jax.Array):
+        array = jax.numpy.asarray(array)
+    if placement is None:
+        placement = PodPlacement(client)
+    shards_meta, box_owner, meta_owner = _shard_plan(array)
+    my_process = jax.process_index()
+
+    kwargs: dict[str, Any] = {"replicas": replicas}
+    if preferred_class is not None:
+        kwargs["preferred_class"] = preferred_class
+    if ttl_ms is not None:
+        kwargs["ttl_ms"] = ttl_ms
+
+    for shard in array.addressable_shards:
+        name = _box_name(_index_to_boxes(shard.index))
+        if shard.device != box_owner[name]:
+            continue  # another device/host owns this box
+        shard_key = f"{key}/shard/{name}"
+        host = np.ascontiguousarray(np.asarray(shard.data))
+        hint = placement.hint_for(shard.device)
+        if "preferred_host" in hint:
+            # Host-affine shards pin to ONE worker: striping the object
+            # across workers would reintroduce cross-host bytes.
+            hint["max_workers"] = 1
+        client.put(shard_key, host.reshape(-1).view(np.uint8),
+                   **kwargs, **hint)
+        placement.record(shard_key, device_coord(shard.device))
+
+    if meta_owner.process_index != my_process:
+        return
+    meta = {
+        "global_shape": list(array.shape),
+        "dtype": np.dtype(array.dtype).str,
+        "shards": [{"key": f"{key}/shard/{s['name']}", "boxes": s["boxes"],
+                    "shape": s["shape"]} for s in shards_meta],
+    }
+    client.put(f"{key}/meta", json.dumps(meta).encode(), **kwargs)
+
+
+def get_array(client: Client, key: str, *, sharding: Any = None,
+              placement: PodPlacement | None = None) -> Any:
+    """Rebuilds an array stored by `put_array` under ANY target sharding
+    (None returns a host numpy array). Each target device slice fetches
+    only the stored shards it overlaps; with `placement`, every fetched
+    shard is scored against THIS process's coordinate — when the read
+    sharding matches the write sharding, the scoreboard stays all
+    host-local, which is the zero-cross-host proof."""
+    from blackbird_tpu.checkpoint import _boxes_to_index
+
+    meta = json.loads(bytes(client.get(f"{key}/meta")))
+    global_shape = tuple(meta["global_shape"])
+    dtype = np.dtype(meta["dtype"])
+    my_coord: tuple[int, int] | None = None
+    if placement is not None:
+        import jax
+
+        local = jax.local_devices()
+        my_coord = device_coord(local[0]) if local else None
+
+    cache: dict[str, Any] = {}
+
+    def fetch(shard_meta: dict[str, Any]) -> Any:
+        skey = shard_meta["key"]
+        if skey not in cache:
+            raw = np.frombuffer(bytes(client.get(skey)), dtype=np.uint8)
+            cache[skey] = raw.view(dtype).reshape(shard_meta["shape"])
+            if placement is not None:
+                placement.record(skey, my_coord)
+        return cache[skey]
+
+    def read_slice(index: tuple[slice, ...]) -> Any:
+        starts = [sl.start or 0 for sl in index]
+        stops = [sl.stop if sl.stop is not None else dim
+                 for sl, dim in zip(index, global_shape)]
+        out = np.empty([b - a for a, b in zip(starts, stops)], dtype=dtype)
+        filled = 0
+        for shard_meta in meta["shards"]:
+            src_index = _boxes_to_index(shard_meta["boxes"], global_shape)
+            o_starts = [max(a, sl.start)
+                        for a, sl in zip(starts, src_index)]
+            o_stops = [min(b, sl.stop) for b, sl in zip(stops, src_index)]
+            if any(a >= b for a, b in zip(o_starts, o_stops)):
+                continue
+            src = fetch(shard_meta)
+            src_sel = tuple(slice(a - sl.start, b - sl.start)
+                            for a, b, sl in zip(o_starts, o_stops, src_index))
+            dst_sel = tuple(slice(a - s, b - s)
+                            for a, b, s in zip(o_starts, o_stops, starts))
+            out[dst_sel] = src[src_sel]
+            filled += int(np.prod([b - a for a, b in zip(o_starts, o_stops)]))
+        if filled != out.size:
+            raise ValueError(f"array {key!r} is missing data for {index}")
+        return out
+
+    if sharding is None:
+        return read_slice(tuple(slice(0, dim) for dim in global_shape))
+
+    import jax
+
+    return jax.make_array_from_callback(global_shape, sharding, read_slice)
+
+
+def remove_array(client: Client, key: str) -> None:
+    """Deletes the meta and every shard of a stored array, meta FIRST so
+    an interrupted removal never leaves a readable-looking torso."""
+    shard_keys: set[str] = set()
+    try:
+        meta = json.loads(bytes(client.get(f"{key}/meta")))
+        shard_keys.update(s["key"] for s in meta.get("shards", []))
+    except Exception:  # noqa: BLE001 - missing/unreadable meta
+        pass
+    try:
+        client.remove(f"{key}/meta")
+    except Exception:  # noqa: BLE001 - already gone
+        pass
+    shard_keys.update(obj["key"] for obj in client.list(f"{key}/shard/"))
+    for skey in shard_keys:
+        try:
+            client.remove(skey)
+        except Exception:  # noqa: BLE001 - lost race / already gone
+            pass

@@ -78,6 +78,11 @@ struct AllocationRequest {
   // TPU extension: slice affinity. >=0 ranks same-slice pools first so
   // copies ride ICI; cross-slice (DCN) pools are used only as spillover.
   int32_t preferred_slice{-1};
+  // Host affinity within preferred_slice: >=0 ranks pools on this
+  // (slice, host) coordinate above mere same-slice pools, so a sharded
+  // put lands each shard on its own host's worker (zero cross-host bytes).
+  // Ignored without preferred_slice — host ids are per-slice coordinates.
+  int32_t preferred_host{-1};
 };
 
 struct AllocationResult {
@@ -103,6 +108,10 @@ class IAllocator {
   virtual AllocatorStats get_stats(
       std::optional<StorageClass> storage_class = std::nullopt) const = 0;
   virtual uint64_t get_free_space(StorageClass storage_class) const = 0;
+  // Live bytes carved out of ONE pool (0 for an untouched or unknown pool).
+  // Topology/ops listings overlay this over the registry's static
+  // MemoryPool::used, which workers advertise once and never refresh.
+  virtual uint64_t pool_used_bytes(const MemoryPoolId& pool_id) const = 0;
   virtual bool can_allocate(const AllocationRequest& request,
                             const PoolMap& pools) const = 0;
   // Drops per-pool state for a pool that left the cluster (worker death).

@@ -30,6 +30,10 @@ class KeystoneAllocatorAdapter {
 
   AllocatorStats get_stats() const { return allocator_->get_stats(); }
 
+  uint64_t pool_used_bytes(const MemoryPoolId& pool_id) const {
+    return allocator_->pool_used_bytes(pool_id);
+  }
+
   bool can_allocate(const ObjectKey& key, uint64_t data_size, const WorkerConfig& config,
                     const PoolMap& pools) const {
     return allocator_->can_allocate(to_allocation_request(key, data_size, config), pools);
@@ -60,6 +64,7 @@ class KeystoneAllocatorAdapter {
     req.prefer_contiguous = config.prefer_contiguous;
     req.min_shard_size = config.min_shard_size;
     req.preferred_slice = config.preferred_slice;
+    req.preferred_host = config.preferred_host;
     req.ec_data_shards = config.ec_data_shards;
     req.ec_parity_shards = config.ec_parity_shards;
     return req;

@@ -42,6 +42,7 @@ class RangeAllocator : public IAllocator {
   ErrorCode free(const ObjectKey& object_key) override;
   AllocatorStats get_stats(std::optional<StorageClass> storage_class) const override;
   uint64_t get_free_space(StorageClass storage_class) const override;
+  uint64_t pool_used_bytes(const MemoryPoolId& pool_id) const override;
   bool can_allocate(const AllocationRequest& request, const PoolMap& pools) const override;
   void forget_pool(const MemoryPoolId& pool_id) override;
   ErrorCode rename_object(const ObjectKey& from, const ObjectKey& to) override;

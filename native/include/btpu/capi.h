@@ -304,6 +304,30 @@ int32_t btpu_put_ec2(btpu_client* client, const char* key, const void* data, uin
                      uint32_t ec_data, uint32_t ec_parity, uint32_t preferred_class,
                      int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice);
 
+/* v3 put: appends the mesh-aware host-affinity hint. preferred_host >= 0
+ * (with preferred_slice >= 0) ranks pools on that (slice, host) coordinate
+ * first, so a sharded put lands each shard on its writer's own host —
+ * the zero-cross-host checkpoint lane. -1 = no host affinity. EC puts have
+ * no v3: coded shards are deliberately anti-affine across workers, a
+ * single-host hint would concentrate correlated-failure domains. */
+int32_t btpu_put_ex3(btpu_client* client, const char* key, const void* data, uint64_t size,
+                     uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
+                     int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice,
+                     int32_t preferred_host);
+
+/* Pool-registry listing for placement-plane topology discovery: writes a
+ * JSON array [{"pool","worker","class","transport","slice","host","chip",
+ * "capacity","used","fabric"}] into buffer, ordered by pool id. Same
+ * truncation contract as btpu_placements_json (NULL buffer sizes). */
+int32_t btpu_pools_json(btpu_client* client, char* buffer, uint64_t buffer_size,
+                        uint64_t* out_len);
+
+/* CRC32C (Castagnoli) of [data, data+size), seeded with `seed` (0 to
+ * start a fresh chain) — the store's end-to-end content checksum, exported
+ * so Python-side tooling (checkpoint shard reuse) can compare local bytes
+ * against stamped placements without a data-plane read. */
+uint32_t btpu_crc32c(const void* data, uint64_t size, uint32_t seed);
+
 /* Prefix listing of COMPLETE objects, lexicographic (limit 0 = unlimited):
  * writes a JSON array [{"key","size","copies","soft_pin"}] into buffer.
  * Same truncation contract as btpu_placements_json (NULL buffer sizes). */

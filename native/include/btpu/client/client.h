@@ -305,6 +305,9 @@ class ObjectClient {
   // Prefix listing of complete objects, lexicographic, limit 0 = unlimited.
   Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix,
                                                   uint64_t limit = 0);
+  // Pool registry with topology coordinates — the placement plane's
+  // discovery read (mesh-aware clients derive host-local hints from it).
+  Result<std::vector<MemoryPool>> list_pools();
   Result<ClusterStats> cluster_stats();
   Result<ViewVersionId> ping();
 

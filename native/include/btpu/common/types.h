@@ -277,6 +277,14 @@ struct WorkerConfig {
   // TPU extension: when set, placement prefers pools on this slice and only
   // spills across slices (DCN) when the slice cannot hold the object.
   int32_t preferred_slice{-1};
+  // Mesh-aware extension of the slice hint: when set (with preferred_slice),
+  // placement prefers pools on this HOST within the slice — the shard-local
+  // lane of a pod checkpoint writes each shard to its own host's worker,
+  // zero cross-host data-plane bytes when shardings match. Ranked above the
+  // slice hint, spills to same-slice then anywhere when the host is full.
+  // Without preferred_slice the host id alone is meaningless (host ids are
+  // per-slice coordinates) and the hint is ignored.
+  int32_t preferred_host{-1};
   // Erasure coding (no reference counterpart — it only replicates): when
   // ec_parity_shards > 0 the object is stored as ONE coded copy of
   // ec_data_shards data + ec_parity_shards parity shards (any
@@ -394,6 +402,16 @@ struct ObjectSummary {
 struct ListObjectsRequest { std::string prefix; uint64_t limit{0}; };  // 0 = unlimited
 struct ListObjectsResponse {
   std::vector<ObjectSummary> objects;
+  ErrorCode error_code{ErrorCode::OK};
+};
+
+// Pool-registry listing (no reference counterpart): the placement plane's
+// topology discovery. A mesh-aware client lists pools once, learns each
+// worker's TopoCoord (slice/host/chip) and capacity, and derives its own
+// host-local placement hints from them — no side-channel config file.
+struct ListPoolsRequest {};
+struct ListPoolsResponse {
+  std::vector<MemoryPool> pools;
   ErrorCode error_code{ErrorCode::OK};
 };
 

@@ -376,13 +376,15 @@ inline void encode(Writer& w, const WorkerConfig& c) {
                 c.preferred_node, c.preferred_classes, c.ttl_ms, c.enable_locality_awareness,
                 c.prefer_contiguous, static_cast<uint64_t>(c.min_shard_size), c.preferred_slice,
                 static_cast<uint64_t>(c.ec_data_shards),
-                static_cast<uint64_t>(c.ec_parity_shards));
+                static_cast<uint64_t>(c.ec_parity_shards), c.preferred_host);
 }
 BTPU_NODISCARD inline bool decode(Reader& r, WorkerConfig& c) {
+  // `preferred_host` was appended after the EC fields shipped; decode_struct's
+  // tail tolerance defaults it to -1 for records from older peers.
   uint64_t rf = 0, mw = 0, ms = 0, eck = 0, ecm = 0;
   if (!decode_struct(r, rf, mw, c.enable_soft_pin, c.preferred_node, c.preferred_classes,
                      c.ttl_ms, c.enable_locality_awareness, c.prefer_contiguous, ms,
-                     c.preferred_slice, eck, ecm))
+                     c.preferred_slice, eck, ecm, c.preferred_host))
     return false;
   c.replication_factor = rf;
   c.max_workers_per_copy = mw;
@@ -494,6 +496,8 @@ BTPU_WIRE_EMPTY(GetViewVersionRequest)
 BTPU_WIRE_STRUCT(GetViewVersionResponse, f0, f1)
 BTPU_WIRE_STRUCT(ListObjectsRequest, f0, f1)
 BTPU_WIRE_STRUCT(ListObjectsResponse, f0, f1)
+BTPU_WIRE_EMPTY(ListPoolsRequest)
+BTPU_WIRE_STRUCT(ListPoolsResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchObjectExistsRequest, f0)
 BTPU_WIRE_STRUCT(BatchObjectExistsResponse, f0, f1)
 BTPU_WIRE_STRUCT(BatchGetWorkersRequest, f0)

@@ -226,6 +226,11 @@ class KeystoneService {
   Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix,
                                                   uint64_t limit = 0) const;
 
+  // Pool-registry listing for placement-plane topology discovery: every
+  // registered pool with its TopoCoord, capacity, and transport descriptor,
+  // ordered by pool id (deterministic). A read: standbys serve it too.
+  Result<std::vector<MemoryPool>> list_pools() const;
+
   // One background-scrub pass (the health loop drives this on
   // scrub_interval_sec; tools/tests may call it directly): verifies up to
   // config_.scrub_objects_per_pass complete objects' stamped shards against

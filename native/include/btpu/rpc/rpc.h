@@ -68,6 +68,7 @@ enum class Method : uint8_t {
   kPutStartPooled = 82,
   kPutCommitSlot = 83,
   kPutInline = 84,
+  kListPools = 85,
 };
 
 // ---- deadline propagation (protocol v4) ------------------------------------
@@ -174,6 +175,7 @@ inline const char* method_name(uint8_t opcode) noexcept {
     case Method::kPutStartPooled: return "put_start_pooled";
     case Method::kPutCommitSlot: return "put_commit_slot";
     case Method::kPutInline: return "put_inline";
+    case Method::kListPools: return "list_pools";
   }
   return "unknown";
 }
@@ -202,6 +204,7 @@ inline const char* method_span_name(uint8_t opcode) noexcept {
     case Method::kPutStartPooled: return "keystone.rpc.put_start_pooled";
     case Method::kPutCommitSlot: return "keystone.rpc.put_commit_slot";
     case Method::kPutInline: return "keystone.rpc.put_inline";
+    case Method::kListPools: return "keystone.rpc.list_pools";
   }
   return "keystone.rpc.unknown";
 }

@@ -54,6 +54,7 @@ class KeystoneRpcClient {
   Result<uint64_t> remove_all_objects();
   Result<uint64_t> drain_worker(const NodeId& worker_id);
   Result<std::vector<ObjectSummary>> list_objects(const std::string& prefix, uint64_t limit);
+  Result<std::vector<MemoryPool>> list_pools();
   Result<ClusterStats> get_cluster_stats();
   Result<ViewVersionId> get_view_version();
   Result<ViewVersionId> ping();

@@ -165,6 +165,18 @@ std::vector<MemoryPoolId> RangeAllocator::select_candidate_pools(
     // violates strict weak ordering — UB that can corrupt the vector.
     std::sort(v.begin(), v.end(), [&](const MemoryPoolId& a, const MemoryPoolId& b) {
       if (request.preferred_slice >= 0) {
+        if (request.preferred_host >= 0) {
+          // Host-local pools outrank merely same-slice ones: the mesh-aware
+          // shard lane wants the writer's own host first, ICI-reachable
+          // same-slice hosts as the first spillover, DCN last.
+          auto host_local = [&](const MemoryPoolId& id) {
+            const auto& t = pools.at(id).topo;
+            return t.slice_id == request.preferred_slice && t.host_id == request.preferred_host;
+          };
+          const bool ha = host_local(a);
+          const bool hb = host_local(b);
+          if (ha != hb) return ha;
+        }
         const bool sa = pools.at(a).topo.slice_id == request.preferred_slice;
         const bool sb = pools.at(b).topo.slice_id == request.preferred_slice;
         if (sa != sb) return sa;  // same-slice (ICI-reachable) pools first
@@ -753,6 +765,15 @@ uint64_t RangeAllocator::get_free_space(StorageClass storage_class) const {
     if (pa->storage_class() == storage_class) total += pa->total_free();
   }
   return total;
+}
+
+uint64_t RangeAllocator::pool_used_bytes(const MemoryPoolId& pool_id) const {
+  SharedLock lock(pools_mutex_);
+  auto it = pool_allocators_.find(pool_id);
+  if (it == pool_allocators_.end()) return 0;  // lazily unmaterialized: empty
+  // Red zones and quarantined extents count as used — those bytes really
+  // are unavailable to placement.
+  return it->second->pool_size() - it->second->total_free();
 }
 
 // Feasibility probe mirroring select_candidate_pools' class/node filter.

@@ -6,6 +6,7 @@
 #include <algorithm>
 
 #include "btpu/client/embedded.h"
+#include "btpu/common/crc32c.h"
 #include "btpu/common/flight_recorder.h"
 #include "btpu/common/histogram.h"
 #include "btpu/common/log.h"
@@ -186,6 +187,14 @@ int32_t btpu_put_ex(btpu_client* client, const char* key, const void* data, uint
 int32_t btpu_put_ex2(btpu_client* client, const char* key, const void* data, uint64_t size,
                      uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
                      int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice) {
+  return btpu_put_ex3(client, key, data, size, replicas, max_workers, preferred_class,
+                      ttl_ms, soft_pin, preferred_slice, /*preferred_host=*/-1);
+}
+
+int32_t btpu_put_ex3(btpu_client* client, const char* key, const void* data, uint64_t size,
+                     uint32_t replicas, uint32_t max_workers, uint32_t preferred_class,
+                     int64_t ttl_ms, int32_t soft_pin, int32_t preferred_slice,
+                     int32_t preferred_host) {
   if (!client || !key || !data) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
   WorkerConfig cfg;
   cfg.replication_factor = replicas == 0 ? 1 : replicas;
@@ -195,6 +204,7 @@ int32_t btpu_put_ex2(btpu_client* client, const char* key, const void* data, uin
   if (ttl_ms >= 0) cfg.ttl_ms = static_cast<uint64_t>(ttl_ms);
   cfg.enable_soft_pin = soft_pin != 0;
   cfg.preferred_slice = preferred_slice;  // -1 = no slice affinity
+  cfg.preferred_host = preferred_host;    // -1 = no host affinity
   return static_cast<int32_t>(client->impl->put(key, data, size, cfg));
 }
 
@@ -769,6 +779,38 @@ int32_t btpu_list_json(btpu_client* client, const char* prefix, uint64_t limit, 
     std::memcpy(buffer, json.data(), n);
   }
   return 0;
+}
+
+int32_t btpu_pools_json(btpu_client* client, char* buffer, uint64_t buffer_size,
+                        uint64_t* out_len) {
+  if (!client || !out_len) return static_cast<int32_t>(ErrorCode::INVALID_PARAMETERS);
+  auto pools = client->impl->list_pools();
+  if (!pools.ok()) return static_cast<int32_t>(pools.error());
+
+  const auto& esc = json_escape;
+  std::string json = "[";
+  bool first = true;
+  for (const auto& p : pools.value()) {
+    if (!first) json += ",";
+    first = false;
+    json += "{\"pool\":\"" + esc(p.id) + "\",\"worker\":\"" + esc(p.node_id) +
+            "\",\"class\":\"" + std::string(storage_class_name(p.storage_class)) +
+            "\",\"transport\":\"" + std::string(transport_kind_name(p.remote.transport)) +
+            "\",\"slice\":" + std::to_string(p.topo.slice_id) +
+            ",\"host\":" + std::to_string(p.topo.host_id) +
+            ",\"chip\":" + std::to_string(p.topo.chip_id) +
+            ",\"capacity\":" + std::to_string(p.size) +
+            ",\"used\":" + std::to_string(p.used);
+    if (!p.fabric_addr.empty()) json += ",\"fabric\":\"" + esc(p.fabric_addr) + "\"";
+    json += "}";
+  }
+  json += "]";
+  return copy_json_out(json, buffer, buffer_size, out_len);
+}
+
+uint32_t btpu_crc32c(const void* data, uint64_t size, uint32_t seed) {
+  if (!data || size == 0) return seed;
+  return crc32c(data, size, seed);
 }
 
 const char* btpu_error_name(int32_t code) {

@@ -276,6 +276,12 @@ Result<std::vector<ObjectSummary>> ObjectClient::list_objects(const std::string&
   });
 }
 
+Result<std::vector<MemoryPool>> ObjectClient::list_pools() {
+  if (embedded_) return embedded_->list_pools();
+  return rpc_failover(/*idempotent=*/true,
+                      [&](rpc::KeystoneRpcClient& r) { return r.list_pools(); });
+}
+
 Result<ClusterStats> ObjectClient::cluster_stats() {
   if (embedded_) return embedded_->get_cluster_stats();
   return rpc_failover(/*idempotent=*/true,

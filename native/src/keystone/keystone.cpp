@@ -1127,6 +1127,24 @@ alloc::PoolMap KeystoneService::memory_pools() const {
   return pools_;
 }
 
+Result<std::vector<MemoryPool>> KeystoneService::list_pools() const {
+  std::vector<MemoryPool> out;
+  {
+    SharedLock lock(registry_mutex_);
+    out.reserve(pools_.size());
+    for (const auto& [id, pool] : pools_) out.push_back(pool);
+  }
+  // Overlay live occupancy: the registry's `used` is whatever the worker
+  // advertised at registration (static, usually 0); placement carves are
+  // the allocator's to report.
+  for (auto& pool : out) pool.used = adapter_.pool_used_bytes(pool.id);
+  // Deterministic order: the registry map is unordered, but topology
+  // discovery diffs successive listings.
+  std::sort(out.begin(), out.end(),
+            [](const MemoryPool& a, const MemoryPool& b) { return a.id < b.id; });
+  return out;
+}
+
 // ---- coordinator watch handlers ------------------------------------------
 
 void KeystoneService::on_worker_event(const WatchEvent& ev) {

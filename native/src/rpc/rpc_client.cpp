@@ -305,6 +305,13 @@ Result<std::vector<ObjectSummary>> KeystoneRpcClient::list_objects(const std::st
   return std::move(resp.objects);
 }
 
+Result<std::vector<MemoryPool>> KeystoneRpcClient::list_pools() {
+  ListPoolsResponse resp;
+  BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kListPools), ListPoolsRequest{}, resp));
+  if (resp.error_code != ErrorCode::OK) return resp.error_code;
+  return std::move(resp.pools);
+}
+
 Result<ClusterStats> KeystoneRpcClient::get_cluster_stats() {
   GetClusterStatsResponse resp;
   BTPU_RETURN_IF_ERROR(call(static_cast<uint8_t>(Method::kGetClusterStats),

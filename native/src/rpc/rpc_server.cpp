@@ -45,6 +45,7 @@ bool is_read_only_op(uint8_t opcode) {
     case Method::kBatchObjectExists:
     case Method::kBatchGetWorkers:
     case Method::kListObjects:
+    case Method::kListPools:
       return true;
     default:
       return false;
@@ -275,6 +276,13 @@ std::vector<uint8_t> KeystoneRpcServer::dispatch(uint8_t opcode,
           payload, [&](const auto& req, auto& resp) {
             auto r = ks.list_objects(req.prefix, req.limit);
             if (r.ok()) resp.objects = std::move(r).value();
+            resp.error_code = r.error();
+          });
+    case Method::kListPools:
+      return handle<ListPoolsRequest, ListPoolsResponse>(
+          payload, [&](const auto&, auto& resp) {
+            auto r = ks.list_pools();
+            if (r.ok()) resp.pools = std::move(r).value();
             resp.error_code = r.error();
           });
     case Method::kBatchObjectExists:

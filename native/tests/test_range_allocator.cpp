@@ -19,14 +19,15 @@ using namespace btpu::alloc;
 namespace {
 
 MemoryPool make_pool(const std::string& id, const std::string& node, uint64_t size,
-                     StorageClass cls = StorageClass::RAM_CPU, int32_t slice = 0) {
+                     StorageClass cls = StorageClass::RAM_CPU, int32_t slice = 0,
+                     int32_t host = 0) {
   MemoryPool p;
   p.id = id;
   p.node_id = node;
   p.size = size;
   p.storage_class = cls;
   p.remote = {TransportKind::TCP, node + ":7000", 0x100000000ull, "abcd", "", "", 0};
-  p.topo = {slice, 0, -1};
+  p.topo = {slice, host, -1};
   return p;
 }
 
@@ -414,6 +415,40 @@ BTEST(RangeAllocator, SliceAffinityRanksIciPoolsFirst) {
   BT_ASSERT_OK(res);
   // "far" has more free space, but "near" is on the preferred slice.
   BT_EXPECT_EQ(res.value().copies[0].shards[0].pool_id, "near");
+}
+
+BTEST(RangeAllocator, HostAffinityRanksHostLocalAboveSameSlice) {
+  RangeAllocator ra;
+  PoolMap pools;
+  // Same slice, two hosts; a cross-slice pool with the most space.
+  pools["h0"] = make_pool("h0", "n0", 2 << 20, StorageClass::RAM_CPU, /*slice=*/0, /*host=*/0);
+  pools["h1"] = make_pool("h1", "n1", 1 << 20, StorageClass::RAM_CPU, /*slice=*/0, /*host=*/1);
+  pools["far"] = make_pool("far", "nf", 4 << 20, StorageClass::RAM_CPU, /*slice=*/1, /*host=*/1);
+  auto req = make_request("obj", 4096, 1, 1);
+  req.preferred_slice = 0;
+  req.preferred_host = 1;
+  auto res = ra.allocate(req, pools);
+  BT_ASSERT_OK(res);
+  // "far" has the most space and matches host_id=1, but on the wrong slice;
+  // "h0" is same-slice with more space; "h1" is the (slice, host) match and
+  // must win anyway.
+  BT_EXPECT_EQ(res.value().copies[0].shards[0].pool_id, "h1");
+
+  // Host full: spills to same-slice first (h0), not cross-slice (far).
+  auto big = make_request("obj2", (1 << 20) + 4096, 1, 1);
+  big.preferred_slice = 0;
+  big.preferred_host = 1;
+  auto res2 = ra.allocate(big, pools);
+  BT_ASSERT_OK(res2);
+  BT_EXPECT_EQ(res2.value().copies[0].shards[0].pool_id, "h0");
+
+  // Without preferred_slice the host hint is inert (per-slice coordinate):
+  // ranking falls back to free space, which "far" wins.
+  auto bare = make_request("obj3", 4096, 1, 1);
+  bare.preferred_host = 1;
+  auto res3 = ra.allocate(bare, pools);
+  BT_ASSERT_OK(res3);
+  BT_EXPECT_EQ(res3.value().copies[0].shards[0].pool_id, "far");
 }
 
 BTEST(RangeAllocator, PlacementCarriesEndpointRkeyAndAbsoluteAddr) {

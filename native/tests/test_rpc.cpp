@@ -98,6 +98,17 @@ BTEST(Rpc, FullMethodSurfaceOverTcp) {
   BT_EXPECT_EQ(listed.value()[0].complete_copies, 1u);
   BT_EXPECT(c.list_objects("zzz/", 0).value().empty());
 
+  // Pool-registry listing: the placement plane's topology discovery read
+  // carries the pool's TopoCoord and capacity across the wire.
+  auto pools = c.list_pools();
+  BT_ASSERT_OK(pools);
+  BT_ASSERT(pools.value().size() == 1);
+  BT_EXPECT_EQ(pools.value()[0].id, "p0");
+  BT_EXPECT_EQ(pools.value()[0].node_id, "w0");
+  BT_EXPECT_EQ(pools.value()[0].size, f.memory.size());
+  BT_EXPECT(pools.value()[0].used >= 4096ull);
+  BT_EXPECT_EQ(pools.value()[0].topo.host_id, 0);
+
   // Batches (values and per-item errors).
   auto bexists = c.batch_object_exists({"rpc/obj", "missing"});
   BT_ASSERT_OK(bexists);

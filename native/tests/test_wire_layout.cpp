@@ -100,6 +100,7 @@ WorkerConfig canon_config() {
   c.preferred_slice = 5;
   c.ec_data_shards = 6;
   c.ec_parity_shards = 3;
+  c.preferred_host = 7;
   return c;
 }
 
@@ -193,6 +194,8 @@ std::vector<std::pair<std::string, std::string>> golden_rows() {
   add("GetViewVersionResponse", enc(GetViewVersionResponse{9, ErrorCode::OK}));
   add("ListObjectsRequest", enc(ListObjectsRequest{"pre", 10}));
   add("ListObjectsResponse", enc(ListObjectsResponse{{canon_summary()}, ErrorCode::OK}));
+  add("ListPoolsRequest", enc(ListPoolsRequest{}));
+  add("ListPoolsResponse", enc(ListPoolsResponse{{canon_pool()}, ErrorCode::OK}));
   add("BatchObjectExistsRequest", enc(BatchObjectExistsRequest{{"a", "b"}}));
   add("BatchObjectExistsResponse",
       enc(BatchObjectExistsResponse{{Result<bool>(true)}, ErrorCode::OK}));

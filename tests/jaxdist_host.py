@@ -7,9 +7,14 @@ cross-process barrier, derives its worker from the DISTRIBUTED runtime
 (``jax.process_index()`` -> host id, local devices -> hbm pools), serves
 device-tier pools against the shared keystone, and participates in a
 cross-host data exchange: host 0 puts, host 1 reads the same bytes back
-through the other process's pools and acks with a marker object. The
-process then serves until signalled — host 1 is SIGKILLed by the
-orchestrator to exercise cross-host repair.
+through the other process's pools and acks with a marker object. Both
+hosts then run the sharded-array lane drill: a NamedSharding jax.Array is
+put through the mesh-aware placement plane (each shard routed to its own
+host's worker), restored under the same sharding with ZERO cross-host
+bytes (proved by the placement scoreboard, published as per-host proof
+objects the orchestrator verifies), and restored again under a different
+sharding bit-exact. The process then serves until signalled — host 1 is
+SIGKILLed by the orchestrator to exercise cross-host repair.
 
 Role parity: multi-host worker registration in the reference,
 src/worker/worker_service.cpp:399-459 — which has no automated multi-host
@@ -30,6 +35,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 DRILL_KEY = "pod/drill"
 DONE_KEY = "pod/done"
+SHARDED_KEY = "pod/sharded"
+PROOF_KEY = "pod/proof{}"
 PAYLOAD_SEED = 1234
 PAYLOAD_BYTES = 512 * 1024
 
@@ -38,6 +45,21 @@ def drill_payload() -> bytes:
     import numpy as np
 
     return np.random.default_rng(PAYLOAD_SEED).bytes(PAYLOAD_BYTES)
+
+
+def _read_json_retry(client: object, key: str, timeout: float = 60.0) -> dict:
+    """get() an existing-but-possibly-PENDING object: a read racing the
+    writer's commit fails its CRC by design, so poll until it lands."""
+    import json
+
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return dict(json.loads(bytes(client.get(key))))  # type: ignore[attr-defined]
+        except Exception:  # noqa: BLE001 - put still in flight
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
 
 
 def run_pod_drill(workdir: str) -> None:
@@ -121,6 +143,21 @@ def run_pod_drill(workdir: str) -> None:
         # UNDER the shared jax.distributed runtime: barrier passed, both
         # workers registered, host0's bytes read back by host1.
         wait(lambda: client.exists(DONE_KEY), 180, "cross-host exchange", procs)
+
+        # Both hosts must finish the sharded-array phase and publish their
+        # placement scoreboards BEFORE host 1 is crashed: the proof keys
+        # carry each host's lane counters for the sharded put/get.
+        wait(lambda: client.exists(PROOF_KEY.format(0))
+             and client.exists(PROOF_KEY.format(1)),
+             180, "sharded lane proof", procs)
+        for pid in range(2):
+            counters = _read_json_retry(client, PROOF_KEY.format(pid))
+            # Zero cross-host data-lane bytes when the read sharding
+            # matches the write sharding — the keystone routed every
+            # shard to its own host's worker.
+            assert counters["cross_host_bytes"] == 0, (pid, counters)
+            assert counters["host_local_bytes"] > 0, (pid, counters)
+            assert counters["cross_host_shards"] == 0, (pid, counters)
 
         # The two replicas live on disjoint host processes.
         copies = client.placements(DRILL_KEY)
@@ -252,6 +289,58 @@ def main() -> int:
             assert got == payload, "cross-host readback mismatch"
             client.put(DONE_KEY, b"host1-read-ok", replicas=1)
             print("host1: cross-host read verified", flush=True)
+
+        # ---- sharded-array lane proof (both hosts, symmetric) ----------
+        # The typed surface over THIS distributed runtime: a NamedSharding
+        # array put through the mesh-aware placement plane, each shard
+        # routed to its OWN host's worker, then restored under the same
+        # sharding — the scoreboard must show zero cross-host bytes.
+        import json
+
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from blackbird_tpu.placement import (PodPlacement, get_array,
+                                             put_array)
+
+        mesh = Mesh(np.array(jax.devices()), ("pod",))
+        sharding = NamedSharding(mesh, PartitionSpec("pod", None))
+        # Per-device shards of 64x32 f32 = 8 KiB: above the keystone's
+        # 4 KiB inline tier, so every shard really places bytes on a
+        # worker pool the scoreboard can attribute to a host.
+        source = np.arange(len(jax.devices()) * 64 * 32,
+                           dtype=np.float32).reshape(-1, 32)
+        arr = jax.make_array_from_callback(source.shape, sharding,
+                                           lambda idx: source[idx])
+        pp = PodPlacement(client)
+        put_array(client, SHARDED_KEY, arr, placement=pp)
+        multihost_utils.sync_global_devices("btpu_sharded_put")
+
+        # Matching read sharding: each host fetches only its own shards.
+        back = get_array(client, SHARDED_KEY, sharding=sharding,
+                         placement=pp)
+        for shard in back.addressable_shards:
+            assert np.array_equal(np.asarray(shard.data),
+                                  source[shard.index]), shard.index
+        assert pp.cross_host_bytes == 0, pp.counters()
+        assert pp.host_local_bytes > 0, pp.counters()
+
+        # Restore under a DIFFERENT sharding (columns, not rows): that
+        # necessarily pulls the other host's shards — bits must still be
+        # exact. Unscored: the proof above stays pure.
+        resharded = get_array(
+            client, SHARDED_KEY,
+            sharding=NamedSharding(mesh, PartitionSpec(None, "pod")))
+        for shard in resharded.addressable_shards:
+            assert np.array_equal(np.asarray(shard.data),
+                                  source[shard.index]), shard.index
+        # And the plain host read of the whole array.
+        assert np.array_equal(get_array(client, SHARDED_KEY), source)
+
+        client.put(PROOF_KEY.format(args.process_id),
+                   json.dumps(pp.counters()).encode(), replicas=1)
+        print(f"host{args.process_id}: sharded lane proof "
+              f"{pp.counters()}", flush=True)
 
         # Serve until the orchestrator signals. SIGTERM = clean exit;
         # host 1 instead gets SIGKILLed to exercise crash repair.

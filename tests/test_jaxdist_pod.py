@@ -5,9 +5,12 @@ runtime; here two host processes join ONE ``jax.distributed`` runtime (CPU
 backend, real Gloo collectives, cross-process barrier) and each serves the
 worker derived from it (blackbird_tpu/distributed.py) against one shared
 keystone. Host 0 puts; host 1 reads the bytes back across the process
-boundary and acks; then host 1 is SIGKILLed and the keystone re-replicates
-the drill object onto the survivor, where a third process verifies the
-bytes. The drill itself lives in jaxdist_host.run_pod_drill so the
+boundary and acks; both hosts then put/get a sharded jax.Array through the
+mesh-aware placement plane and publish lane-counter proofs showing zero
+cross-host bytes when the read sharding matches the write sharding (and a
+bit-exact restore under a different sharding); then host 1 is SIGKILLed
+and the keystone re-replicates the drill object onto the survivor, where a
+third process verifies the bytes. The drill itself lives in jaxdist_host.run_pod_drill so the
 driver's dryrun runs the identical leg. Reference analog: multi-host
 worker registration, src/worker/worker_service.cpp:399-459 — untested in
 the reference.

@@ -1,0 +1,116 @@
+"""Mesh-aware placement plane: pool-registry topology discovery, host-
+affinity routing through keystone placement, and the typed
+put_array/get_array surface with its host-locality scoreboard."""
+
+from types import SimpleNamespace
+from typing import Any, Generator
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from blackbird_tpu import EmbeddedCluster
+from blackbird_tpu.parallel import make_mesh
+from blackbird_tpu.placement import (PodPlacement, device_coord, get_array,
+                                     put_array, remove_array)
+
+
+@pytest.fixture()
+def store() -> Generator[Any, None, None]:
+    with EmbeddedCluster(workers=4, pool_bytes=32 << 20) as cluster:
+        yield cluster.client()
+
+
+def test_pools_lists_topology_and_live_occupancy(store: Any) -> None:
+    pools = store.pools()
+    assert len(pools) == 4
+    assert [p["pool"] for p in pools] == sorted(p["pool"] for p in pools)
+    for p in pools:
+        assert p["worker"]
+        assert p["capacity"] == 32 << 20
+        assert p["slice"] == 0
+        assert p["used"] == 0
+    # Each embedded worker models one pod host.
+    assert sorted(p["host"] for p in pools) == [0, 1, 2, 3]
+    # `used` is the LIVE allocator view, not the static registry record:
+    # a put must show up, its removal must free it again.
+    store.put("plc/occ", b"\xab" * 8192)
+    assert sum(p["used"] for p in store.pools()) >= 8192
+    store.remove("plc/occ")
+    assert sum(p["used"] for p in store.pools()) == 0
+
+
+def test_put_array_roundtrip_reshard_and_remove(store: Any) -> None:
+    mesh = make_mesh(8)
+    sharding = NamedSharding(mesh, P("workers", None))
+    # Shards must clear the 4 KiB inline tier (an inline object lives in
+    # keystone metadata, placing no bytes on any worker to score).
+    arr = jax.device_put(
+        np.arange(8 * 64 * 32, dtype=np.float32).reshape(8 * 64, 32), sharding)
+    placement = PodPlacement(store)
+    put_array(store, "plc/arr", arr, placement=placement)
+    # Every byte this process placed was scored (one host: all host-local).
+    assert placement.host_local_bytes == arr.nbytes
+    assert placement.cross_host_bytes == 0
+
+    same = get_array(store, "plc/arr", sharding=sharding, placement=placement)
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(arr))
+    other = get_array(store, "plc/arr",
+                      sharding=NamedSharding(make_mesh(4), P(None, "workers")))
+    np.testing.assert_array_equal(np.asarray(other), np.asarray(arr))
+    np.testing.assert_array_equal(get_array(store, "plc/arr"), np.asarray(arr))
+
+    remove_array(store, "plc/arr")
+    assert store.list("plc/arr") == []
+
+
+def test_put_validates_host_affinity_arguments(store: Any) -> None:
+    with pytest.raises(ValueError, match="requires preferred_slice"):
+        store.put("plc/bad", b"x", preferred_host=0)
+    with pytest.raises(ValueError, match="incompatible with ec"):
+        store.put("plc/bad", b"x", ec=(2, 1), preferred_slice=0,
+                  preferred_host=0)
+
+
+def test_host_affinity_routes_to_host_local_worker() -> None:
+    """End-to-end keystone placement: two workers on the same slice but
+    different pod hosts; a put hinted at (slice 0, host h) must land on
+    host h's worker — the shard-local placement lane — and the placement
+    plane must discover exactly that topology from the pool registry."""
+    from blackbird_tpu.procluster import ProcessCluster
+
+    with ProcessCluster(workers=2, devices_per_worker=0, pool_mb=0,
+                        dram_pool_mb=16) as cluster:
+        client = cluster.wait_ready()
+        placement = PodPlacement(client)
+        assert placement.worker_coord == {"mc-0": (0, 0), "mc-1": (0, 1)}
+        assert placement.hosts == {(0, 0), (0, 1)}
+
+        for host in (0, 1):
+            fake_device = SimpleNamespace(slice_index=0, process_index=host)
+            hint = placement.hint_for(fake_device)
+            assert hint == {"preferred_slice": 0, "preferred_host": host}
+            key = f"plc/host{host}"
+            client.put(key, b"\x5a" * 65536, **hint)  # > inline threshold
+            workers = {s["worker"] for copy in client.placements(key)
+                       for s in copy["shards"]}
+            assert workers == {f"mc-{host}"}, workers
+            # Scoreboard agrees: against the intended coordinate the bytes
+            # are host-local, against the other host they are cross-host.
+            placement.record(key, (0, host))
+            placement.record(key, (0, 1 - host))
+        assert placement.host_local_bytes == 2 * 65536
+        assert placement.cross_host_bytes == 2 * 65536
+        assert placement.counters()["host_local_shards"] == 2
+
+        # A coordinate the registry has never seen degrades to a slice-only
+        # hint (or none): never a blind preferred_host the allocator would
+        # ignore anyway.
+        assert placement.hint_for(
+            SimpleNamespace(slice_index=0, process_index=7)
+        ) == {"preferred_slice": 0}
+        assert placement.hint_for(
+            SimpleNamespace(slice_index=3, process_index=0)) == {}
+        assert device_coord(SimpleNamespace(slice_index=None,
+                                            process_index=None)) == (0, 0)
